@@ -1,0 +1,31 @@
+"""spark_rapids_ml_tpu — a TPU-native ML accelerator framework.
+
+A from-scratch, TPU-first re-design of the capabilities of NVIDIA's
+``spark-rapids-ml`` (the 21.12 "RAPIDS Accelerator for Apache Spark ML"
+snapshot): drop-in-style Estimator/Model APIs whose heavy linear algebra runs
+on TPU through JAX/XLA instead of cuBLAS/cuSolver through JNI.
+
+Architecture (vs. the reference's six layers, see SURVEY.md §1):
+
+* the user-facing API keeps Spark ML Estimator/Model/Params semantics
+  (``models/``), including model persistence in Spark ML's on-disk format
+  (``io/``);
+* distributed covariance/gram assembly is a sharded XLA program over a
+  ``jax.sharding.Mesh`` — per-device partial Gram matrices are combined with
+  an on-device ``psum`` over ICI rather than a driver-side reduce
+  (``parallel/``);
+* the device kernels are jit-compiled XLA programs (MXU matmuls, fused
+  center+scale+gram Pallas kernel, ``eigh`` eigensolver) instead of per-call
+  JNI → cudaMalloc → cublas round trips (``ops/``);
+* the native runtime layer is a C++ host library (``native/``) exposing the
+  same six-call surface the reference's ``librapidsml_jni.so`` had, used for
+  the CPU fallback paths and host-side buffer management, bound via ctypes
+  (no JNI / no CUDA toolkit anywhere in the build).
+"""
+
+__version__ = "0.1.0"
+
+from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
+from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, Vectors  # noqa: F401
+
+__all__ = ["PCA", "PCAModel", "DenseVector", "SparseVector", "Vectors", "__version__"]

@@ -1,0 +1,4 @@
+from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, Vectors
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+__all__ = ["DenseVector", "SparseVector", "Vectors", "VectorFrame"]

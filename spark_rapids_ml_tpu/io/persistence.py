@@ -1,0 +1,241 @@
+"""Model persistence in Spark ML's on-disk layout.
+
+Wire-format parity with the reference's writer/reader
+(``/root/reference/src/main/scala/org/apache/spark/ml/feature/RapidsPCA.scala:218-254``):
+
+* ``path/metadata/part-00000`` — one JSON line: class, timestamp, uid,
+  paramMap (Spark's ``DefaultParamsWriter.saveMetadata``);
+* ``path/metadata/_SUCCESS`` — empty marker;
+* ``path/data/part-00000.parquet`` — one row with columns
+  ``pc`` (Spark DenseMatrix struct: type=1, numRows, numCols, values
+  column-major, isTransposed=false) and ``explainedVariance`` (Spark
+  DenseVector struct: type=1, values) — the same schema Spark writes, so a
+  model trained here round-trips into a Spark ML reader and vice versa.
+
+Estimators (no learned state) persist metadata only, like Spark's
+``DefaultParamsWritable`` (``PCA.scala:27-37`` companion ``load``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+_FORMAT_VERSION = "1.0"
+
+
+def _require_target(path: str, overwrite: bool) -> None:
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(
+                f"path {path!r} already exists; use overwrite=True "
+                "(Spark: .write().overwrite())"
+            )
+        shutil.rmtree(path)
+
+
+# Spark class names for metadata, so a Spark DefaultParamsReader accepts
+# the file (it asserts className and reads metadata['sparkVersion']); the
+# Python class path travels in 'pythonClass'. The reference's user-facing
+# class is com.nvidia.spark.ml.feature.PCA(Model) (PCA.scala:27-37).
+_SPARK_CLASS_ALIASES = {
+    "PCA": "org.apache.spark.ml.feature.PCA",
+    "PCAModel": "org.apache.spark.ml.feature.PCAModel",
+}
+
+
+def _write_metadata(path: str, cls: str, uid: str, param_map: Dict[str, Any]) -> None:
+    meta_dir = os.path.join(path, "metadata")
+    os.makedirs(meta_dir, exist_ok=True)
+    simple_name = cls.rsplit(".", 1)[-1]
+    metadata = {
+        "class": _SPARK_CLASS_ALIASES.get(simple_name, cls),
+        "pythonClass": cls,
+        "timestamp": int(time.time() * 1000),
+        "sparkVersion": "3.1.2",  # wire-format vintage (reference pom.xml:68)
+        "frameworkVersion": _FORMAT_VERSION,
+        "uid": uid,
+        "paramMap": param_map,
+        "defaultParamMap": {},
+    }
+    with open(os.path.join(meta_dir, "part-00000"), "w") as f:
+        f.write(json.dumps(metadata))
+    open(os.path.join(meta_dir, "_SUCCESS"), "w").close()
+
+
+def _read_metadata(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "metadata", "part-00000")) as f:
+        return json.loads(f.readline())
+
+
+def save_params(estimator, path: str, overwrite: bool = False) -> None:
+    """Persist an unfitted estimator (params only)."""
+    _require_target(path, overwrite)
+    cls = f"{type(estimator).__module__}.{type(estimator).__qualname__}"
+    _write_metadata(path, cls, estimator.uid, estimator.param_map_for_metadata())
+
+
+def load_params(estimator_cls, path: str):
+    meta = _read_metadata(path)
+    est = estimator_cls()
+    est.uid = meta["uid"]
+    for name, value in meta.get("paramMap", {}).items():
+        if est.has_param(name) and value is not None:
+            est.set(name, value)
+    return est
+
+
+# -- dense matrix/vector structs (Spark ml.linalg UDT serialized form) ----
+def _dense_matrix_struct(m: np.ndarray) -> Dict[str, Any]:
+    m = np.asarray(m, dtype=np.float64)
+    return {
+        "type": 1,
+        "numRows": int(m.shape[0]),
+        "numCols": int(m.shape[1]),
+        "colPtrs": None,
+        "rowIndices": None,
+        "values": np.asfortranarray(m).ravel(order="F").tolist(),
+        "isTransposed": False,
+    }
+
+
+def _dense_matrix_from_struct(s: Dict[str, Any]) -> np.ndarray:
+    values = np.asarray(s["values"], dtype=np.float64)
+    n_rows, n_cols = int(s["numRows"]), int(s["numCols"])
+    if s.get("isTransposed"):
+        return values.reshape(n_rows, n_cols)
+    return values.reshape(n_cols, n_rows).T
+
+
+def _dense_vector_struct(v: np.ndarray) -> Dict[str, Any]:
+    return {
+        "type": 1,
+        "size": None,
+        "indices": None,
+        "values": np.asarray(v, dtype=np.float64).ravel().tolist(),
+    }
+
+
+def _dense_vector_from_struct(s: Dict[str, Any]) -> np.ndarray:
+    return np.asarray(s["values"], dtype=np.float64)
+
+
+def _matrix_arrow_type():
+    """Spark ``MatrixUDT`` sql type: struct<type:tinyint, numRows:int,
+    numCols:int, colPtrs:array<int>, rowIndices:array<int>,
+    values:array<double>, isTransposed:boolean>."""
+    import pyarrow as pa
+
+    return pa.struct(
+        [
+            ("type", pa.int8()),
+            ("numRows", pa.int32()),
+            ("numCols", pa.int32()),
+            ("colPtrs", pa.list_(pa.int32())),
+            ("rowIndices", pa.list_(pa.int32())),
+            ("values", pa.list_(pa.float64())),
+            ("isTransposed", pa.bool_()),
+        ]
+    )
+
+
+def _vector_arrow_type():
+    """Spark ``VectorUDT`` sql type: struct<type:tinyint, size:int,
+    indices:array<int>, values:array<double>>."""
+    import pyarrow as pa
+
+    return pa.struct(
+        [
+            ("type", pa.int8()),
+            ("size", pa.int32()),
+            ("indices", pa.list_(pa.int32())),
+            ("values", pa.list_(pa.float64())),
+        ]
+    )
+
+
+def _write_data_row(path: str, row: Dict[str, Any], schema=None) -> None:
+    """Single-row payload as Parquet (pyarrow), JSON fallback otherwise —
+    the reference repartitions to 1 before writing (``RapidsPCA.scala:223``),
+    so one file is exactly its on-disk shape."""
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.Table.from_pylist([row], schema=schema)
+        pq.write_table(table, os.path.join(data_dir, "part-00000.parquet"))
+    except ImportError:  # pragma: no cover - pyarrow is baked in
+        with open(os.path.join(data_dir, "part-00000.json"), "w") as f:
+            json.dump(row, f)
+    open(os.path.join(data_dir, "_SUCCESS"), "w").close()
+
+
+def _read_data_row(path: str) -> Dict[str, Any]:
+    data_dir = os.path.join(path, "data")
+    pq_files = sorted(
+        f for f in os.listdir(data_dir) if f.endswith(".parquet")
+    )
+    if pq_files:
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(os.path.join(data_dir, pq_files[0]))
+        return table.to_pylist()[0]
+    json_files = sorted(f for f in os.listdir(data_dir) if f.endswith(".json"))
+    if json_files:  # pragma: no cover
+        with open(os.path.join(data_dir, json_files[0])) as f:
+            return json.load(f)
+    raise FileNotFoundError(f"no data payload under {data_dir}")
+
+
+def save_pca_model(model, path: str, overwrite: bool = False) -> None:
+    if model.pc is None:
+        raise ValueError("cannot save an unfitted PCAModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "pc": _dense_matrix_struct(model.pc),
+        "explainedVariance": _dense_vector_struct(model.explained_variance),
+        # `mean` is an extension column (Spark stores none); readers that
+        # don't know it ignore it.
+        "mean": _dense_vector_struct(
+            model.mean if model.mean is not None else np.zeros(model.pc.shape[0])
+        ),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("pc", _matrix_arrow_type()),
+                ("explainedVariance", _vector_arrow_type()),
+                ("mean", _vector_arrow_type()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema)
+
+
+def load_pca_model(path: str):
+    from spark_rapids_ml_tpu.models.pca import PCAModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = PCAModel(
+        pc=_dense_matrix_from_struct(row["pc"]),
+        explained_variance=_dense_vector_from_struct(row["explainedVariance"]),
+        mean=_dense_vector_from_struct(row["mean"]) if "mean" in row else None,
+        uid=meta["uid"],
+    )
+    for name, value in meta.get("paramMap", {}).items():
+        if model.has_param(name) and value is not None:
+            model.set(name, value)
+    return model

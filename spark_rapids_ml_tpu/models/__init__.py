@@ -1,0 +1,3 @@
+from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+
+__all__ = ["PCA", "PCAModel"]

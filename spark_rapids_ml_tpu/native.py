@@ -1,0 +1,242 @@
+"""ctypes bindings to the native C++ runtime ``libtpuml.so``.
+
+The reference ships one native library, ``librapidsml_jni.so``, reached over
+JNI with per-call device malloc/copy churn
+(``/root/reference/src/main/java/com/nvidia/spark/ml/linalg/JniRAPIDSML.java:64-70``,
+``native/src/rapidsml_jni.cu``). This framework's native runtime serves a
+different role — the TPU compute path is XLA — but keeps native parity for
+everything around it: host fallback kernels (gemm / syevd, mirroring
+``dgemm``/``calSVD``), the batched transform (``dgemm_b``), trace range
+markers (``NvtxRange push/pop``), and an aligned host buffer pool (what the
+reference's RMM dependency should have been doing, SURVEY.md §2 checklist
+item 6).
+
+Loading is lazy and OPTIONAL: every caller falls back to NumPy when the
+library is absent (the reference hard-requires its .so even on CPU paths —
+a coupling we deliberately avoid, SURVEY.md §3.4). Set
+``SPARK_RAPIDS_ML_TPU_NATIVE=0`` to force the fallback, ``=require`` to fail
+hard when missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_HERE)
+_SO_CANDIDATES = (
+    os.path.join(_HERE, "_native", "libtpuml.so"),
+    os.path.join(_REPO_ROOT, "native", "build", "libtpuml.so"),
+)
+
+
+def _try_build() -> Optional[str]:
+    """Build the native library with make if the toolchain is present.
+
+    Equivalent in spirit to the reference's Maven antrun step that drives
+    cmake/ninja at build time (``pom.xml:337-360``), but on demand.
+    """
+    makefile_dir = os.path.join(_REPO_ROOT, "native")
+    if not os.path.isfile(os.path.join(makefile_dir, "Makefile")):
+        return None
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=makefile_dir,
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+    except Exception:
+        return None
+    out = os.path.join(makefile_dir, "build", "libtpuml.so")
+    return out if os.path.isfile(out) else None
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    d = ctypes.POINTER(ctypes.c_double)
+    lib.tpuml_version.restype = ctypes.c_char_p
+    lib.tpuml_trace_push.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    lib.tpuml_trace_push.restype = ctypes.c_int
+    lib.tpuml_trace_pop.restype = ctypes.c_int
+    lib.tpuml_trace_depth.restype = ctypes.c_int
+    lib.tpuml_trace_event_count.restype = ctypes.c_longlong
+    lib.tpuml_dgemm.argtypes = [
+        ctypes.c_int, ctypes.c_int,                 # transa, transb
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,  # m, n, k
+        ctypes.c_double, d, ctypes.c_longlong,      # alpha, A, lda
+        d, ctypes.c_longlong,                       # B, ldb
+        ctypes.c_double, d, ctypes.c_longlong,      # beta, C, ldc
+    ]
+    lib.tpuml_dgemm.restype = ctypes.c_int
+    lib.tpuml_dsyevd.argtypes = [ctypes.c_longlong, d, d, d]
+    lib.tpuml_dsyevd.restype = ctypes.c_int
+    lib.tpuml_alloc.argtypes = [ctypes.c_size_t]
+    lib.tpuml_alloc.restype = ctypes.c_void_p
+    lib.tpuml_free.argtypes = [ctypes.c_void_p]
+    lib.tpuml_pool_bytes_in_use.restype = ctypes.c_size_t
+    lib.tpuml_pool_bytes_pooled.restype = ctypes.c_size_t
+    lib.tpuml_pool_trim.restype = None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed); returns None when unavailable."""
+    global _lib, _load_attempted
+    if _load_attempted:
+        # Lock-free fast path once the load decision is final — trace
+        # push/pop sit on per-phase hot paths and must not serialize
+        # threads on _lock.
+        return _lib
+    with _lock:
+        if _load_attempted:
+            return _lib
+        try:
+            mode = os.environ.get("SPARK_RAPIDS_ML_TPU_NATIVE", "1")
+            if mode == "0":
+                return None
+            path = next((p for p in _SO_CANDIDATES if os.path.isfile(p)), None)
+            if path is None:
+                path = _try_build()
+            if path is None:
+                if mode == "require":
+                    raise OSError("libtpuml.so not found and could not be built")
+                return None
+            try:
+                lib = ctypes.CDLL(path)
+                _configure(lib)
+                _lib = lib
+            except (OSError, AttributeError):
+                # AttributeError: stale/incompatible .so missing a symbol —
+                # fall back to NumPy rather than poisoning every caller.
+                if mode == "require":
+                    raise
+                _lib = None
+            return _lib
+        finally:
+            # Set last (under the lock) so the lock-free fast path never
+            # observes attempted=True with a half-configured _lib.
+            _load_attempted = True
+
+
+def is_loaded() -> bool:
+    return load() is not None
+
+
+def version() -> str:
+    lib = load()
+    if lib is None:
+        raise OSError("native library not loaded")
+    return lib.tpuml_version().decode()
+
+
+# -- trace ranges (NvtxRange push/pop parity) ----------------------------
+def trace_push(name: str, color: int = 0xFFFFFFFF) -> None:
+    lib = load()
+    if lib is not None:
+        lib.tpuml_trace_push(name.encode(), ctypes.c_uint32(color & 0xFFFFFFFF))
+
+
+def trace_pop() -> None:
+    lib = load()
+    if lib is not None:
+        lib.tpuml_trace_pop()
+
+
+def trace_depth() -> int:
+    lib = load()
+    return int(lib.tpuml_trace_depth()) if lib is not None else 0
+
+
+def trace_event_count() -> int:
+    lib = load()
+    return int(lib.tpuml_trace_event_count()) if lib is not None else 0
+
+
+# -- BLAS-like host kernels (dgemm / dgemm_b / calSVD parity) ------------
+def _as_f64(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float64)
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def gemm(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """C = A @ B for row-major 2-D arrays (the ``dgemm`` surface)."""
+    lib = load()
+    a, b = _as_f64(a), _as_f64(b)
+    m, kk = a.shape
+    k2, n = b.shape
+    if kk != k2:
+        raise ValueError(f"shape mismatch: {a.shape} @ {b.shape}")
+    if lib is None:
+        return a @ b
+    c = np.zeros((m, n), dtype=np.float64)
+    rc = lib.tpuml_dgemm(
+        0, 0, m, n, kk, 1.0, _ptr(a), kk, _ptr(b), n, 0.0, _ptr(c), n
+    )
+    if rc != 0:
+        raise RuntimeError(f"tpuml_dgemm failed with code {rc}")
+    return c
+
+
+def gram(a: np.ndarray) -> np.ndarray:
+    """AᵀA (the covariance-assembly GEMM, transa=T shape)."""
+    lib = load()
+    a = _as_f64(a)
+    m, n = a.shape
+    if lib is None:
+        return a.T @ a
+    c = np.zeros((n, n), dtype=np.float64)
+    rc = lib.tpuml_dgemm(
+        1, 0, n, n, m, 1.0, _ptr(a), n, _ptr(a), n, 0.0, _ptr(c), n
+    )
+    if rc != 0:
+        raise RuntimeError(f"tpuml_dgemm failed with code {rc}")
+    return c
+
+
+def syevd(a: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric eigendecomposition, ascending eigenvalues (``calSVD``'s
+    eigDC core). Returns (eigenvalues, eigenvectors-as-columns)."""
+    lib = load()
+    a = _as_f64(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError("syevd requires a square matrix")
+    if lib is None:
+        return np.linalg.eigh(a)
+    evals = np.zeros(n, dtype=np.float64)
+    evecs = np.zeros((n, n), dtype=np.float64)
+    rc = lib.tpuml_dsyevd(n, _ptr(a), _ptr(evals), _ptr(evecs))
+    if rc != 0:
+        raise RuntimeError(f"tpuml_dsyevd failed with code {rc}")
+    # C layer returns eigenvectors row-major with vector j in column j.
+    return evals, evecs
+
+
+# -- host buffer pool ----------------------------------------------------
+def pool_bytes_in_use() -> int:
+    lib = load()
+    return int(lib.tpuml_pool_bytes_in_use()) if lib is not None else 0
+
+
+def pool_bytes_pooled() -> int:
+    lib = load()
+    return int(lib.tpuml_pool_bytes_pooled()) if lib is not None else 0
+
+
+def pool_trim() -> None:
+    lib = load()
+    if lib is not None:
+        lib.tpuml_pool_trim()

@@ -1,0 +1,121 @@
+"""Covariance / Gram assembly — the MXU-heavy half of PCA.
+
+Replaces the reference's per-partition ``dgemm`` JNI kernel
+(``/root/reference/native/src/rapidsml_jni.cu:172-258``: per-call cudaMalloc,
+H2D copy, cuBLAS GEMM, D2H copy) with jit-compiled XLA programs: centering,
+scaling and the rank-update all fuse into one MXU matmul with no host round
+trips. The dead ``dspr`` packed rank-1 path
+(``rapidsml_jni.cu:107-170``) is intentionally dropped — an outer-product
+accumulate is just a Gram matmul on TPU (SURVEY.md §2 checklist item 4).
+
+Semantics follow the *corrected* spec (SURVEY.md §3.6): covariance normalizes
+by ``numRows - 1`` everywhere (the reference's GEMM path wrongly scales by
+``1/√(numCols−1)``, ``RapidsRowMatrix.scala:169``), and ``meanCentering=False``
+is supported on every path (the reference's CPU spr path crashes,
+``RapidsRowMatrix.scala:219-225``).
+
+All kernels take an optional per-row ``mask`` so callers can pad row counts
+to static bucket shapes (XLA requires static shapes; uneven data partitions
+are padded and masked rather than recompiled per shape).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _masked(x: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    if mask is None:
+        return x
+    return x * mask[:, None].astype(x.dtype)
+
+
+def row_count(x: jnp.ndarray, mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Number of valid rows (scalar, same dtype as x)."""
+    if mask is None:
+        return jnp.asarray(x.shape[0], dtype=x.dtype)
+    return jnp.sum(mask).astype(x.dtype)
+
+
+def column_means(x: jnp.ndarray, mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Per-column mean over valid rows.
+
+    Equivalent of the reference's driver-side ``Statistics.colStats(rows).mean``
+    pass (``RapidsRowMatrix.scala:152-162``), but computed on device.
+    """
+    n = row_count(x, mask)
+    return jnp.sum(_masked(x, mask), axis=0) / n
+
+
+def gram(x: jnp.ndarray, precision=lax.Precision.HIGHEST) -> jnp.ndarray:
+    """xᵀx on the MXU. ``precision=HIGHEST`` keeps f32 accumulation exact
+    enough for the 1e-5 oracle bar (see SURVEY.md §7 "float64")."""
+    return lax.dot_general(
+        x, x, (((0,), (0,)), ((), ())), precision=precision
+    )
+
+
+def covariance(
+    x: jnp.ndarray,
+    mean: Optional[jnp.ndarray] = None,
+    mask: Optional[jnp.ndarray] = None,
+    ddof: int = 1,
+    precision=lax.Precision.HIGHEST,
+) -> jnp.ndarray:
+    """Sample covariance ``(X−μ)ᵀ(X−μ) / (n − ddof)``.
+
+    Mirrors the reference's GEMM covariance path
+    (``RapidsRowMatrix.scala:168-202``) but folds the ``1/√(n−ddof)`` row
+    scaling into XLA's fusion rather than a Scala per-row hot loop, and fixes
+    the normalizer to use the row count (§3.6 caveat).
+
+    ``mean=None`` skips centering (the ``meanCentering=false`` mode,
+    ``RapidsRowMatrix.scala:163-165``).
+    """
+    xc = x if mean is None else x - mean[None, :]
+    xc = _masked(xc, mask)
+    n = row_count(x, mask)
+    scale = 1.0 / jnp.sqrt(jnp.maximum(n - ddof, 1).astype(x.dtype))
+    return gram(xc * scale, precision=precision)
+
+
+def partial_gram_stats(
+    x: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    precision=lax.Precision.HIGHEST,
+):
+    """One-pass per-shard sufficient statistics: (xᵀx, Σx, count).
+
+    The building block of the distributed path: each device computes these on
+    its row shard, then a single fused ``psum`` combines them across the mesh
+    — replacing the reference's executor→driver serialization of n×n partials
+    (``RapidsRowMatrix.scala:202``).
+    """
+    xm = _masked(x, mask)
+    g = gram(xm, precision=precision)
+    s = jnp.sum(xm, axis=0)
+    cnt = row_count(x, mask)
+    return g, s, cnt
+
+
+def covariance_from_stats(
+    g: jnp.ndarray, s: jnp.ndarray, cnt: jnp.ndarray, ddof: int = 1,
+    mean_centering: bool = True,
+) -> jnp.ndarray:
+    """Combine global (Σxxᵀ, Σx, n) into covariance: (G − n·μμᵀ)/(n−ddof).
+
+    The one-pass formulation; numerically safe at f32 only when paired with
+    HIGHEST-precision Gram accumulation. The two-pass variant (center first,
+    then Gram) is used by default in the fit kernel for parity with the
+    reference's semantics; this is the low-communication option.
+    """
+    denom = jnp.maximum(cnt - ddof, 1).astype(g.dtype)
+    if not mean_centering:
+        return g / denom
+    mu = s / cnt
+    return (g - cnt * jnp.outer(mu, mu)) / denom
+
+

@@ -1,0 +1,90 @@
+"""Eigendecomposition + PCA postprocessing, fused into one XLA program.
+
+Replaces the reference's driver-GPU ``calSVD`` native kernel
+(``/root/reference/native/src/rapidsml_jni.cu:338-392``): RAFT ``eigDC``
+(cuSolver syevd) → colReverse/rowReverse → S←√S → Thrust signFlip. Here the
+whole chain — ``eigh``, descending reorder, sign-flip, explained-variance —
+is one jitted program; XLA fuses the postprocessing into a few vector ops.
+
+Semantic corrections vs the reference (SURVEY.md §3.6):
+* explained variance is λ/Σλ (Spark CPU semantics), not √λ/Σ√λ
+  (the reference GPU path's known inconsistency,
+  ``RapidsRowMatrix.scala:101-102`` + ``rapidsml_jni.cu:377``);
+* the sign-flip convention (each component's max-|·| coordinate positive,
+  ``rapidsml_jni.cu:37-64``) is kept — it makes results deterministic and
+  matches sklearn.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def eigh_descending(cov: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric eigendecomposition with eigenvalues in descending order.
+
+    ``jnp.linalg.eigh`` returns ascending order; the reference reverses with
+    ``colReverse``/``rowReverse`` (``rapidsml_jni.cu:374-375``) — here it is a
+    negative-stride gather XLA folds away.
+    """
+    evals, evecs = jnp.linalg.eigh(cov)
+    return evals[::-1], evecs[:, ::-1]
+
+
+def sign_flip(evecs: jnp.ndarray) -> jnp.ndarray:
+    """Flip each column's sign so its max-|·| entry is positive.
+
+    Vectorized equivalent of the reference's Thrust ``signFlip`` kernel
+    (``rapidsml_jni.cu:37-64``): one argmax + gather + broadcast multiply,
+    no per-column loop.
+    """
+    idx = jnp.argmax(jnp.abs(evecs), axis=0)
+    picked = evecs[idx, jnp.arange(evecs.shape[1])]
+    signs = jnp.where(picked < 0, -1.0, 1.0).astype(evecs.dtype)
+    return evecs * signs[None, :]
+
+
+def explained_variance_ratio(evals: jnp.ndarray) -> jnp.ndarray:
+    """λᵢ/Σλ over all eigenvalues (clamped at 0 for tiny negatives).
+
+    Denominator is the sum over ALL eigenvalues; truncation to k happens
+    after, as in ``RapidsRowMatrix.scala:101-109``.
+    """
+    lam = jnp.maximum(evals, 0.0)
+    total = jnp.sum(lam)
+    return lam / jnp.where(total > 0, total, 1.0)
+
+
+def pca_postprocess_host(evals, evecs, k: int):
+    """NumPy version of the postprocessing chain for the host fallback
+    paths — same semantics as the XLA chain above (descending order,
+    sign-flip, λ/Σλ, top-k), shared so the two can't drift. Takes LAPACK
+    ascending-order output."""
+    import numpy as np
+
+    evals = np.asarray(evals)[::-1]
+    evecs = np.asarray(evecs)[:, ::-1]
+    idx = np.argmax(np.abs(evecs), axis=0)
+    signs = np.where(evecs[idx, np.arange(evecs.shape[1])] < 0, -1.0, 1.0)
+    evecs = evecs * signs[None, :]
+    lam = np.maximum(evals, 0.0)
+    total = lam.sum()
+    evr = lam / (total if total > 0 else 1.0)
+    return evecs[:, :k], evr[:k]
+
+
+def pca_from_covariance(
+    cov: jnp.ndarray, k: int, flip_signs: bool = True
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(components[n,k], explained_variance_ratio[k]) from covariance.
+
+    ``k`` is static (compile-time), matching the top-k truncation
+    ``Arrays.copyOfRange(u.data, 0, n*k)`` (``RapidsRowMatrix.scala:104-109``).
+    """
+    evals, evecs = eigh_descending(cov)
+    if flip_signs:
+        evecs = sign_flip(evecs)
+    evr = explained_variance_ratio(evals)
+    return evecs[:, :k], evr[:k]

@@ -1,0 +1,12 @@
+from spark_rapids_ml_tpu.parallel.mesh import data_mesh, device_count
+from spark_rapids_ml_tpu.parallel.distributed_pca import (
+    distributed_pca_fit,
+    distributed_pca_fit_kernel,
+)
+
+__all__ = [
+    "data_mesh",
+    "device_count",
+    "distributed_pca_fit",
+    "distributed_pca_fit_kernel",
+]

@@ -1,0 +1,4 @@
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+
+__all__ = ["TraceColor", "TraceRange", "PhaseTimer"]

@@ -1,0 +1,31 @@
+"""Structured per-phase timings — the observability the reference lacks.
+
+The reference extends Spark ``Logging`` but emits no metrics
+(SURVEY.md §5 "Metrics / logging"). Estimators here record wall-clock per
+phase (mean / covariance / solve / transform) into a dict surfaced on the
+fitted model as ``model.fit_timings_``.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+
+class PhaseTimer:
+    def __init__(self):
+        self.timings: Dict[str, float] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timings[name] = self.timings.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.timings)

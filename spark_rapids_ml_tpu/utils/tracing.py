@@ -1,0 +1,88 @@
+"""Profiler range annotations — the NVTX-equivalent, decoupled from native code.
+
+The reference's ``NvtxRange`` is an AutoCloseable that JNI-pushes an NVTX
+range (``/root/reference/src/main/java/com/nvidia/spark/ml/linalg/NvtxRange.java:37-59``,
+``rapidsml_jni.cu:82-105``) — and because its static block force-loads the
+native library, even pure-CPU paths require the ``.so``
+(SURVEY.md §3.4). Here ranges are context managers over
+``jax.profiler.TraceAnnotation`` (visible in xprof/TensorBoard traces) that
+degrade to no-ops when profiling is unavailable — profiling is optional by
+construction. When the native runtime (``libtpuml.so``) is loaded, ranges are
+additionally forwarded to its trace ring-buffer so host-side phases show up
+in the same timeline.
+
+The 9-color palette mirrors ``NvtxColor.java:20-29`` for familiarity; colors
+are advisory metadata on TPU (xprof has no color channel) but are recorded in
+the native trace buffer.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Optional
+
+
+class TraceColor(enum.Enum):
+    """ARGB color bits, same palette as the reference's NvtxColor."""
+
+    GREEN = 0xFF76B900
+    BLUE = 0xFF0071C5
+    PURPLE = 0xFF7F00FF
+    YELLOW = 0xFFFFFF00
+    RED = 0xFFFF0000
+    WHITE = 0xFFFFFFFF
+    DARK_GREEN = 0xFF004D00
+    ORANGE = 0xFFFFA500
+    CYAN = 0xFF00FFFF
+
+
+class TraceRange:
+    """Context manager: ``with TraceRange("compute cov", TraceColor.RED): ...``
+
+    Mirrors the reference's try-with-resources usage at its six
+    instrumentation sites (SURVEY.md §3.5). Safe to use with no profiler
+    session and no native library.
+    """
+
+    def __init__(self, name: str, color: TraceColor = TraceColor.WHITE):
+        self.name = name
+        self.color = color
+        self._annotation = None
+        self._native = None
+        self._t0: Optional[float] = None
+
+    def __enter__(self) -> "TraceRange":
+        self._t0 = time.perf_counter()
+        try:
+            import jax.profiler
+
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception:
+            self._annotation = None
+        try:
+            from spark_rapids_ml_tpu import native
+
+            if native.is_loaded():
+                native.trace_push(self.name, self.color.value)
+                self._native = native
+        except Exception:
+            self._native = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._native is not None:
+            try:
+                self._native.trace_pop()
+            except Exception:
+                pass
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+
+    @property
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0 if self._t0 is not None else 0.0

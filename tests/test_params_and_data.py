@@ -1,0 +1,142 @@
+"""Param plumbing + dense/sparse input equivalence + frame coercion.
+
+Mirrors ``PCASuite`` "params" (``PCASuite.scala:33-39``) and "dense ... and
+sparse vectors ... same results" (``:155-190``).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import PCA, PCAModel, Vectors
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, rows_to_matrix
+
+
+def test_param_defaults():
+    pca = PCA()
+    assert pca.getInputCol() == "features"
+    assert pca.getOutputCol() == "pca_features"
+    assert pca.getMeanCentering() is True
+    assert pca.getUseXlaDot() is True
+    assert pca.getUseXlaSvd() is True
+    assert pca.getDeviceId() == -1
+    assert pca.getK() is None
+
+
+def test_param_fluent_setters_and_copy():
+    pca = PCA().setK(3).setInputCol("vec").setUseXlaDot(False)
+    assert pca.getK() == 3 and pca.getInputCol() == "vec"
+    clone = pca.copy({"k": 5})
+    assert clone.getK() == 5 and pca.getK() == 3
+    assert clone.uid == pca.uid
+    assert clone.getInputCol() == "vec"
+
+
+def test_param_validation():
+    with pytest.raises(ValueError):
+        PCA().setK(0)
+    with pytest.raises(ValueError):
+        PCA().setDtype("float16")
+    with pytest.raises(AttributeError):
+        PCA().setNope(1)
+    with pytest.raises(KeyError):
+        PCA().set("nope", 1)
+
+
+def test_explain_params_mentions_all():
+    text = PCA().explainParams()
+    for name in ["k", "inputCol", "outputCol", "meanCentering", "useXlaDot",
+                 "useXlaSvd", "deviceId", "dtype"]:
+        assert name in text
+
+
+def test_model_copy_carries_state(rng):
+    x = rng.normal(size=(20, 4))
+    model = PCA().setK(2).fit(x)
+    clone = model.copy()
+    assert isinstance(clone, PCAModel)
+    np.testing.assert_array_equal(clone.pc, model.pc)
+    np.testing.assert_array_equal(clone.explained_variance, model.explained_variance)
+
+
+def test_dense_sparse_same_results(rng):
+    # PCASuite.scala:155-190 with default params (device cov + device solve).
+    dense_rows = [
+        Vectors.dense([1.0, 0.0, 3.0, 0.0]),
+        Vectors.dense([0.0, 2.0, 0.0, 4.0]),
+        Vectors.dense([1.5, 2.5, 0.0, 0.0]),
+        Vectors.dense([0.0, 0.0, 1.0, 1.0]),
+        Vectors.dense([2.0, 0.5, 0.5, 2.0]),
+    ]
+    sparse_rows = [
+        Vectors.sparse(4, [0, 2], [1.0, 3.0]),
+        Vectors.sparse(4, [1, 3], [2.0, 4.0]),
+        Vectors.sparse(4, [0, 1], [1.5, 2.5]),
+        Vectors.sparse(4, [2, 3], [1.0, 1.0]),
+        Vectors.sparse(4, [(0, 2.0), (1, 0.5), (2, 0.5), (3, 2.0)]),
+    ]
+    m_dense = PCA().setK(2).fit(dense_rows)
+    m_sparse = PCA().setK(2).fit(sparse_rows)
+    np.testing.assert_allclose(m_sparse.pc, m_dense.pc, atol=1e-12)
+    np.testing.assert_allclose(
+        m_sparse.explained_variance, m_dense.explained_variance, atol=1e-12
+    )
+    out_d = np.asarray(m_dense.transform(dense_rows).column("pca_features"))
+    out_s = np.asarray(m_sparse.transform(sparse_rows).column("pca_features"))
+    np.testing.assert_allclose(out_s, out_d, atol=1e-12)
+
+
+def test_vector_types():
+    d = DenseVector([1.0, 2.0])
+    s = SparseVector(2, [0, 1], [1.0, 2.0])
+    assert d == s and s == d
+    assert d[1] == 2.0 and len(s) == 2
+    with pytest.raises(ValueError):
+        SparseVector(2, [1, 0], [1.0, 2.0])  # unsorted
+    with pytest.raises(ValueError):
+        SparseVector(2, [0, 2], [1.0, 2.0])  # out of range
+    with pytest.raises(ValueError):
+        rows_to_matrix([DenseVector([1.0]), DenseVector([1.0, 2.0])])
+
+
+def test_frame_coercion_paths(rng):
+    x = rng.normal(size=(10, 3))
+    # ndarray
+    f1 = as_vector_frame(x, "features")
+    np.testing.assert_array_equal(f1.vectors_as_matrix("features"), x)
+    # VectorFrame passthrough with extra columns preserved by transform
+    frame = VectorFrame({"id": list(range(10)), "features": x})
+    model = PCA().setK(2).fit(frame)
+    out = model.transform(frame)
+    assert out.columns == ["id", "features", "pca_features"]
+    assert out.column("id") == list(range(10))
+    # pandas round trip
+    pd = pytest.importorskip("pandas")
+    df = frame.to_pandas()
+    assert isinstance(df, pd.DataFrame)
+    f2 = VectorFrame.from_pandas(df)
+    model2 = PCA().setK(2).fit(f2)
+    np.testing.assert_allclose(model2.pc, model.pc, atol=1e-12)
+
+
+def test_frame_errors():
+    with pytest.raises(ValueError, match="length"):
+        VectorFrame({"a": [1, 2], "b": [1]})
+    with pytest.raises(KeyError):
+        VectorFrame({"a": [1, 2]}).column("b")
+    with pytest.raises(TypeError):
+        as_vector_frame("nope", "features")
+
+
+def test_output_col_rename(rng):
+    x = rng.normal(size=(10, 3))
+    model = PCA().setK(2).setOutputCol("proj").fit(x)
+    out = model.transform(x)
+    assert "proj" in out.columns
+
+
+def test_transform_schema_conflict(rng):
+    x = rng.normal(size=(10, 3))
+    model = PCA().setK(2).fit(x)
+    with pytest.raises(ValueError, match="already exists"):
+        model.transform_schema(["features", "pca_features"])

@@ -1,0 +1,87 @@
+"""Model/estimator save-load round trips — ``DefaultReadWriteTest`` parity
+(``PCASuite.scala:192-206``), including Spark's on-disk layout."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import PCA, PCAModel
+
+
+def test_model_roundtrip(tmp_path, rng):
+    x = rng.normal(size=(30, 5))
+    model = PCA().setK(3).setOutputCol("proj").fit(x)
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = PCAModel.load(path)
+    np.testing.assert_allclose(loaded.pc, model.pc, atol=0)
+    np.testing.assert_allclose(
+        loaded.explained_variance, model.explained_variance, atol=0
+    )
+    np.testing.assert_allclose(loaded.mean, model.mean, atol=0)
+    assert loaded.uid == model.uid
+    assert loaded.getK() == 3
+    assert loaded.getOutputCol() == "proj"
+    # loaded model transforms identically
+    a = np.asarray(model.transform(x).column("proj"))
+    b = np.asarray(loaded.transform(x).column("proj"))
+    np.testing.assert_allclose(a, b, atol=0)
+
+
+def test_spark_on_disk_layout(tmp_path, rng):
+    x = rng.normal(size=(10, 4))
+    model = PCA().setK(2).fit(x)
+    path = str(tmp_path / "model")
+    model.save(path)
+    # Spark ML layout: metadata/part-00000 JSON + data/ parquet + _SUCCESS.
+    assert os.path.isfile(os.path.join(path, "metadata", "part-00000"))
+    assert os.path.isfile(os.path.join(path, "metadata", "_SUCCESS"))
+    assert os.path.isfile(os.path.join(path, "data", "_SUCCESS"))
+    meta = json.loads(
+        open(os.path.join(path, "metadata", "part-00000")).readline()
+    )
+    assert meta["uid"] == model.uid
+    assert meta["paramMap"]["k"] == 2
+    assert "class" in meta and "timestamp" in meta
+    # Parquet payload with Spark DenseMatrix struct (column-major values).
+    import pyarrow.parquet as pq
+
+    row = pq.read_table(os.path.join(path, "data", "part-00000.parquet")).to_pylist()[0]
+    assert row["pc"]["numRows"] == 4 and row["pc"]["numCols"] == 2
+    got = np.asarray(row["pc"]["values"]).reshape(2, 4).T  # column-major
+    np.testing.assert_allclose(got, model.pc, atol=0)
+    assert row["pc"]["type"] == 1 and row["pc"]["isTransposed"] is False
+    np.testing.assert_allclose(
+        np.asarray(row["explainedVariance"]["values"]),
+        model.explained_variance,
+        atol=0,
+    )
+
+
+def test_overwrite_semantics(tmp_path, rng):
+    x = rng.normal(size=(10, 4))
+    model = PCA().setK(2).fit(x)
+    path = str(tmp_path / "model")
+    model.save(path)
+    with pytest.raises(FileExistsError):
+        model.save(path)
+    model.write().overwrite().save(path)  # fluent writer API
+    assert PCAModel.load(path).getK() == 2
+
+
+def test_estimator_roundtrip(tmp_path):
+    est = PCA().setK(7).setInputCol("vec").setUseXlaSvd(False)
+    path = str(tmp_path / "est")
+    est.save(path)
+    loaded = PCA.load(path)
+    assert loaded.getK() == 7
+    assert loaded.getInputCol() == "vec"
+    assert loaded.getUseXlaSvd() is False
+    assert loaded.uid == est.uid
+
+
+def test_unfitted_model_save_fails(tmp_path):
+    with pytest.raises(ValueError, match="unfitted"):
+        PCAModel().save(str(tmp_path / "m"))
