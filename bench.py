@@ -1,0 +1,102 @@
+"""Benchmark: PCA.fit throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the north-star config (BASELINE.md): PCA fit over 10M×4096 rows,
+k=256, f32, via the streaming sufficient-statistics pipeline (bounded HBM:
+one batch + one 4096² Gram resident; batches stream through the MXU with
+donated accumulators). The reference publishes no numbers (SURVEY.md §6),
+so ``vs_baseline`` is the speedup over the host-CPU oracle path (NumPy/
+LAPACK dgemm+syevd) measured on a subsample and scaled per-row — the same
+"accelerated vs CPU Spark ML" comparison the reference's own tests imply.
+
+Env knobs: BENCH_ROWS, BENCH_COLS, BENCH_K, BENCH_BATCH, BENCH_CPU_ROWS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_ROWS", 10_000_000))
+    cols = int(os.environ.get("BENCH_COLS", 4096))
+    k = int(os.environ.get("BENCH_K", 256))
+    batch = int(os.environ.get("BENCH_BATCH", 65536))
+    cpu_rows = int(os.environ.get("BENCH_CPU_ROWS", 100_000))
+
+    import jax
+
+    from spark_rapids_ml_tpu.utils.platform import force_cpu_if_requested
+
+    force_cpu_if_requested()
+
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.streaming import (
+        finalize_stats,
+        init_stats,
+        update_stats,
+    )
+
+    device = jax.devices()[0]
+    platform = device.platform
+
+    # On-device synthetic batch: the bench measures the fit pipeline (Gram
+    # accumulation + eigensolve), not host data generation.
+    key = jax.random.PRNGKey(0)
+    x_batch = jax.device_put(
+        jax.random.normal(key, (batch, cols), dtype=jnp.float32), device
+    )
+    n_steps = max(1, rows // batch)
+    actual_rows = n_steps * batch
+
+    # warm-up: compile update + finalize once
+    stats = init_stats(cols, dtype=jnp.float32, device=device)
+    stats = jax.block_until_ready(update_stats(stats, x_batch))
+    jax.block_until_ready(finalize_stats(stats, k))
+
+    stats = init_stats(cols, dtype=jnp.float32, device=device)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        stats = update_stats(stats, x_batch)
+    result = jax.block_until_ready(finalize_stats(stats, k))
+    fit_seconds = time.perf_counter() - t0
+    assert np.isfinite(np.asarray(result.components)).all()
+
+    tpu_rows_per_sec = actual_rows / fit_seconds
+
+    # CPU baseline proxy: same pipeline via NumPy/LAPACK on a subsample.
+    x_cpu = np.asarray(x_batch[: min(cpu_rows, batch)], dtype=np.float64)
+    reps = max(1, cpu_rows // x_cpu.shape[0])
+    t0 = time.perf_counter()
+    g = np.zeros((cols, cols))
+    s = np.zeros(cols)
+    for _ in range(reps):
+        g += x_cpu.T @ x_cpu
+        s += x_cpu.sum(axis=0)
+    n = reps * x_cpu.shape[0]
+    mu = s / n
+    cov = (g - n * np.outer(mu, mu)) / (n - 1)
+    np.linalg.eigh(cov)
+    cpu_seconds = time.perf_counter() - t0
+    cpu_rows_per_sec = n / cpu_seconds
+
+    print(
+        json.dumps(
+            {
+                "metric": f"PCA.fit rows/sec/chip ({actual_rows}x{cols}, k={k}, {platform})",
+                "value": round(tpu_rows_per_sec, 1),
+                "unit": "rows/sec",
+                "vs_baseline": round(tpu_rows_per_sec / cpu_rows_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

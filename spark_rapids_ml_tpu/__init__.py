@@ -26,6 +26,22 @@ Architecture (vs. the reference's six layers, see SURVEY.md §1):
 __version__ = "0.1.0"
 
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
+from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel  # noqa: F401
+from spark_rapids_ml_tpu.models.linear_regression import (  # noqa: F401
+    LinearRegression,
+    LinearRegressionModel,
+)
 from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, Vectors  # noqa: F401
 
-__all__ = ["PCA", "PCAModel", "DenseVector", "SparseVector", "Vectors", "__version__"]
+__all__ = [
+    "PCA",
+    "PCAModel",
+    "KMeans",
+    "KMeansModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+    "DenseVector",
+    "SparseVector",
+    "Vectors",
+    "__version__",
+]

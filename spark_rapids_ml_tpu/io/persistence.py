@@ -46,6 +46,10 @@ def _require_target(path: str, overwrite: bool) -> None:
 _SPARK_CLASS_ALIASES = {
     "PCA": "org.apache.spark.ml.feature.PCA",
     "PCAModel": "org.apache.spark.ml.feature.PCAModel",
+    "KMeans": "org.apache.spark.ml.clustering.KMeans",
+    "KMeansModel": "org.apache.spark.ml.clustering.KMeansModel",
+    "LinearRegression": "org.apache.spark.ml.regression.LinearRegression",
+    "LinearRegressionModel": "org.apache.spark.ml.regression.LinearRegressionModel",
 }
 
 
@@ -80,14 +84,20 @@ def save_params(estimator, path: str, overwrite: bool = False) -> None:
     _write_metadata(path, cls, estimator.uid, estimator.param_map_for_metadata())
 
 
+def _restore_params(obj, meta: Dict[str, Any]):
+    """Apply metadata paramMap onto a Params object (Spark's
+    ``metadata.getAndSetParams``, ``RapidsPCA.scala:251``)."""
+    for name, value in meta.get("paramMap", {}).items():
+        if obj.has_param(name) and value is not None:
+            obj.set(name, value)
+    return obj
+
+
 def load_params(estimator_cls, path: str):
     meta = _read_metadata(path)
     est = estimator_cls()
     est.uid = meta["uid"]
-    for name, value in meta.get("paramMap", {}).items():
-        if est.has_param(name) and value is not None:
-            est.set(name, value)
-    return est
+    return _restore_params(est, meta)
 
 
 # -- dense matrix/vector structs (Spark ml.linalg UDT serialized form) ----
@@ -224,6 +234,84 @@ def save_pca_model(model, path: str, overwrite: bool = False) -> None:
     _write_data_row(path, row, schema=schema)
 
 
+def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
+    if model.cluster_centers is None:
+        raise ValueError("cannot save an unfitted KMeansModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "clusterCenters": _dense_matrix_struct(model.cluster_centers),
+        "trainingCost": (
+            float(model.training_cost_) if model.training_cost_ is not None else None
+        ),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("clusterCenters", _matrix_arrow_type()),
+                ("trainingCost", pa.float64()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema)
+
+
+def load_kmeans_model(path: str):
+    from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = KMeansModel(
+        cluster_centers=_dense_matrix_from_struct(row["clusterCenters"]),
+        uid=meta["uid"],
+    )
+    model.training_cost_ = row.get("trainingCost")
+    return _restore_params(model, meta)
+
+
+def save_linreg_model(model, path: str, overwrite: bool = False) -> None:
+    if model.coefficients is None:
+        raise ValueError("cannot save an unfitted LinearRegressionModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "coefficients": _dense_vector_struct(model.coefficients),
+        "intercept": float(model.intercept),
+        "scale": 1.0,  # Spark writes (intercept, coefficients, scale)
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("coefficients", _vector_arrow_type()),
+                ("intercept", pa.float64()),
+                ("scale", pa.float64()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema)
+
+
+def load_linreg_model(path: str):
+    from spark_rapids_ml_tpu.models.linear_regression import LinearRegressionModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = LinearRegressionModel(
+        coefficients=_dense_vector_from_struct(row["coefficients"]),
+        intercept=float(row["intercept"]),
+        uid=meta["uid"],
+    )
+    return _restore_params(model, meta)
+
+
 def load_pca_model(path: str):
     from spark_rapids_ml_tpu.models.pca import PCAModel
 
@@ -235,7 +323,4 @@ def load_pca_model(path: str):
         mean=_dense_vector_from_struct(row["mean"]) if "mean" in row else None,
         uid=meta["uid"],
     )
-    for name, value in meta.get("paramMap", {}).items():
-        if model.has_param(name) and value is not None:
-            model.set(name, value)
-    return model
+    return _restore_params(model, meta)

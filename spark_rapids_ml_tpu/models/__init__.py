@@ -1,3 +1,15 @@
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel
+from spark_rapids_ml_tpu.models.linear_regression import (
+    LinearRegression,
+    LinearRegressionModel,
+)
 
-__all__ = ["PCA", "PCAModel"]
+__all__ = [
+    "PCA",
+    "PCAModel",
+    "KMeans",
+    "KMeansModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+]

@@ -1,0 +1,202 @@
+"""KMeans Estimator / Model with the Spark ML param surface.
+
+Second-algorithm coverage (BASELINE.md config 5). Param names follow Spark's
+``org.apache.spark.ml.clustering.KMeans``: k, maxIter, tol, seed,
+featuresCol(=inputCol), predictionCol. The accelerated path runs k-means++
+seeding + Lloyd entirely on device (one compiled program,
+``ops/kmeans_kernel.py``); host fallback is a NumPy Lloyd with identical
+semantics for the no-accelerator case.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    Param,
+)
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class KMeansParams(HasInputCol, HasDeviceId):
+    k = Param("k", "number of clusters", 2,
+              validator=lambda v: isinstance(v, int) and v >= 1)
+    maxIter = Param("maxIter", "maximum Lloyd iterations", 20,
+                    validator=lambda v: isinstance(v, int) and v >= 0)
+    tol = Param("tol", "center-shift convergence tolerance", 1e-4,
+                validator=lambda v: v >= 0)
+    seed = Param("seed", "random seed for k-means++ init", 0,
+                 validator=lambda v: isinstance(v, int))
+    predictionCol = Param("predictionCol", "output cluster-id column",
+                          "prediction")
+    useXlaDot = Param(
+        "useXlaDot",
+        "run seeding+Lloyd on the accelerator (True) or host NumPy (False)",
+        True, validator=lambda v: isinstance(v, bool))
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+class KMeans(KMeansParams):
+    """``KMeans().setK(8).fit(df)`` → KMeansModel."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "KMeans":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(KMeans, path)
+
+    def fit(self, dataset) -> "KMeansModel":
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+        k = self.getK()
+        if k > x.shape[0]:
+            raise ValueError(f"k = {k} must be at most the number of rows {x.shape[0]}")
+        if self.getUseXlaDot():
+            centers, cost, n_iter = self._fit_xla(x, k, timer)
+        else:
+            centers, cost, n_iter = self._fit_host(x, k, timer)
+        model = KMeansModel(cluster_centers=np.asarray(centers, dtype=np.float64))
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.training_cost_ = float(cost)
+        model.n_iter_ = int(n_iter)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def _fit_xla(self, x, k, timer):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.kmeans_kernel import (
+            kmeans_fit_kernel,
+            kmeans_plus_plus_init,
+        )
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        with timer.phase("h2d"):
+            x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+        key = jax.random.PRNGKey(self.getSeed())
+        with timer.phase("fit_kernel"), TraceRange("kmeans lloyd", TraceColor.GREEN):
+            init = kmeans_plus_plus_init(x_dev, k, key)
+            result = jax.block_until_ready(
+                kmeans_fit_kernel(
+                    x_dev, init, max_iter=self.getMaxIter(), tol=self.getTol()
+                )
+            )
+        return result.centers, result.cost, result.n_iter
+
+    def _fit_host(self, x, k, timer):
+        """NumPy Lloyd with the same init/update/empty-cluster semantics."""
+        rng = np.random.default_rng(self.getSeed())
+        with timer.phase("fit_kernel"), TraceRange("kmeans host", TraceColor.ORANGE):
+            centers = _host_kmeans_pp(x, k, rng)
+            n_iter = 0
+            for n_iter in range(1, self.getMaxIter() + 1):
+                d = _sqdist(x, centers)
+                labels = d.argmin(axis=1)
+                new_centers = centers.copy()
+                for j in range(k):
+                    pts = x[labels == j]
+                    if len(pts):
+                        new_centers[j] = pts.mean(axis=0)
+                moved = np.sqrt(((new_centers - centers) ** 2).sum(axis=1).max())
+                centers = new_centers
+                if moved <= self.getTol():
+                    break
+            cost = _sqdist(x, centers).min(axis=1).sum()
+        return centers, cost, n_iter
+
+
+def _sqdist(x, centers):
+    x2 = (x * x).sum(axis=1)[:, None]
+    c2 = (centers * centers).sum(axis=1)[None, :]
+    return np.maximum(x2 + c2 - 2.0 * (x @ centers.T), 0.0)
+
+
+def _host_kmeans_pp(x, k, rng):
+    centers = np.empty((k, x.shape[1]), dtype=np.float64)
+    centers[0] = x[rng.integers(len(x))]
+    min_d = ((x - centers[0]) ** 2).sum(axis=1)
+    for i in range(1, k):
+        p = min_d / min_d.sum() if min_d.sum() > 0 else None
+        centers[i] = x[rng.choice(len(x), p=p)]
+        min_d = np.minimum(min_d, ((x - centers[i]) ** 2).sum(axis=1))
+    return centers
+
+
+class KMeansModel(KMeansParams):
+    def __init__(self, cluster_centers: Optional[np.ndarray] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.cluster_centers = cluster_centers
+        self.training_cost_ = None
+        self.n_iter_ = None
+        self.fit_timings_ = {}
+
+    def _copy_internal_state(self, other: "KMeansModel") -> None:
+        other.cluster_centers = self.cluster_centers
+        other.training_cost_ = self.training_cost_
+        other.n_iter_ = self.n_iter_
+
+    # Spark API naming
+    def clusterCenters(self):
+        return [c for c in self.cluster_centers]
+
+    def transform(self, dataset) -> VectorFrame:
+        if self.cluster_centers is None:
+            raise ValueError("model has no centers; fit first or load")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        if self.getUseXlaDot():
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.kmeans_kernel import assign_clusters
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+            c_dev = jax.device_put(
+                jnp.asarray(self.cluster_centers, dtype=dtype), device
+            )
+            labels = np.asarray(jax.jit(assign_clusters)(x_dev, c_dev))
+        else:
+            labels = _sqdist(x, self.cluster_centers).argmin(axis=1)
+        return frame.with_column(
+            self.getPredictionCol(), labels.astype(np.int32).tolist()
+        )
+
+    def compute_cost(self, dataset) -> float:
+        """Sum of squared distances to nearest center (Spark computeCost)."""
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        return float(_sqdist(x, self.cluster_centers).min(axis=1).sum())
+
+    computeCost = compute_cost
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_kmeans_model
+
+        save_kmeans_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "KMeansModel":
+        from spark_rapids_ml_tpu.io.persistence import load_kmeans_model
+
+        return load_kmeans_model(path)

@@ -1,0 +1,183 @@
+"""LinearRegression Estimator / Model (normal-equations solver).
+
+Spark ``org.apache.spark.ml.regression.LinearRegression`` param surface
+subset: featuresCol(=inputCol), labelCol, predictionCol, fitIntercept,
+regParam (L2), solver fixed to "normal" — the shape that maps onto the
+partial-aggregate + small-dense-solve pattern shared with PCA
+(SURVEY.md §7 step 6). Accelerated path: sufficient statistics on the MXU +
+Cholesky solve in one program (``ops/linreg_kernel.py``); host fallback via
+NumPy with identical math.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class LinearRegressionParams(HasInputCol, HasDeviceId):
+    labelCol = Param("labelCol", "label column name", "label")
+    predictionCol = Param("predictionCol", "prediction output column",
+                          "prediction")
+    fitIntercept = Param("fitIntercept", "whether to fit an intercept", True,
+                         validator=lambda v: isinstance(v, bool))
+    regParam = Param("regParam", "L2 regularization strength lambda", 0.0,
+                     validator=lambda v: v >= 0)
+    useXlaDot = Param(
+        "useXlaDot",
+        "solve on the accelerator (True) or host NumPy (False)",
+        True, validator=lambda v: isinstance(v, bool))
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+class LinearRegression(LinearRegressionParams):
+    """``LinearRegression().setRegParam(0.1).fit(df)``; df needs features +
+    label columns."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "LinearRegression":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(LinearRegression, path)
+
+    def fit(self, dataset, labels=None) -> "LinearRegressionModel":
+        """``dataset`` may carry the label column, or pass ``labels``
+        explicitly alongside a bare feature matrix."""
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+            if labels is not None:
+                y = np.asarray(labels, dtype=np.float64).reshape(-1)
+            else:
+                y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
+        if y.shape[0] != x.shape[0]:
+            raise ValueError(
+                f"labels length {y.shape[0]} != rows {x.shape[0]}"
+            )
+        if self.getUseXlaDot():
+            coef, intercept = self._fit_xla(x, y, timer)
+        else:
+            coef, intercept = self._fit_host(x, y, timer)
+        model = LinearRegressionModel(
+            coefficients=np.asarray(coef, dtype=np.float64),
+            intercept=float(intercept),
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def _fit_xla(self, x, y, timer):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.linreg_kernel import linreg_fit_kernel
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        with timer.phase("h2d"):
+            x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+            y_dev = jax.device_put(jnp.asarray(y, dtype=dtype), device)
+        with timer.phase("fit_kernel"), TraceRange("linreg normal", TraceColor.GREEN):
+            result = jax.block_until_ready(
+                linreg_fit_kernel(
+                    x_dev, y_dev,
+                    reg_param=float(self.getRegParam()),
+                    fit_intercept=self.getFitIntercept(),
+                )
+            )
+        return result.coefficients, result.intercept
+
+    def _fit_host(self, x, y, timer):
+        with timer.phase("fit_kernel"), TraceRange("linreg host", TraceColor.ORANGE):
+            n = x.shape[0]
+            lam = float(self.getRegParam())
+            if self.getFitIntercept():
+                mu_x, mu_y = x.mean(axis=0), y.mean()
+                a = x.T @ x / n - np.outer(mu_x, mu_x)
+                b = x.T @ y / n - mu_x * mu_y
+            else:
+                a = x.T @ x / n
+                b = x.T @ y / n
+            coef = np.linalg.solve(a + lam * np.eye(x.shape[1]), b)
+            intercept = (y.mean() - x.mean(axis=0) @ coef) if self.getFitIntercept() else 0.0
+        return coef, intercept
+
+
+class LinearRegressionModel(LinearRegressionParams):
+    def __init__(self, coefficients: Optional[np.ndarray] = None,
+                 intercept: float = 0.0, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.coefficients = coefficients
+        self.intercept = intercept
+        self.fit_timings_ = {}
+
+    def _copy_internal_state(self, other: "LinearRegressionModel") -> None:
+        other.coefficients = self.coefficients
+        other.intercept = self.intercept
+
+    def transform(self, dataset) -> VectorFrame:
+        if self.coefficients is None:
+            raise ValueError("model has no coefficients; fit first or load")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        if self.getUseXlaDot():
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.linreg_kernel import linreg_predict_kernel
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            pred = np.asarray(
+                linreg_predict_kernel(
+                    jax.device_put(jnp.asarray(x, dtype=dtype), device),
+                    jnp.asarray(self.coefficients, dtype=dtype),
+                    jnp.asarray(self.intercept, dtype=dtype),
+                )
+            )
+        else:
+            pred = x @ self.coefficients + self.intercept
+        return frame.with_column(
+            self.getPredictionCol(), pred.astype(np.float64)
+        )
+
+    def evaluate(self, dataset, labels=None) -> dict:
+        """RMSE / MSE / R² summary (Spark's LinearRegressionSummary core)."""
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        if labels is not None:
+            y = np.asarray(labels, dtype=np.float64).reshape(-1)
+        else:
+            y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
+        pred = x @ self.coefficients + self.intercept
+        resid = y - pred
+        mse = float((resid**2).mean())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        r2 = 1.0 - float((resid**2).sum()) / ss_tot if ss_tot > 0 else 0.0
+        return {"mse": mse, "rmse": mse**0.5, "r2": r2}
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_linreg_model
+
+        save_linreg_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "LinearRegressionModel":
+        from spark_rapids_ml_tpu.io.persistence import load_linreg_model
+
+        return load_linreg_model(path)

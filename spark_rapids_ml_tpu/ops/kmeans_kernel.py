@@ -1,0 +1,157 @@
+"""KMeans device kernels: k-means++ init and Lloyd iterations.
+
+Second-algorithm coverage (BASELINE.md config 5: "KMeans / LinearRegression
+... second-algo stretch"). Same TPU shape as PCA: the hot op is an MXU
+matmul (the −2·X·Cᵀ term of the pairwise distances and the one-hot
+cluster-sum reduction), iteration is a ``lax.while_loop`` compiled into the
+program (no per-iteration host round trip), and the distributed form
+all-reduces per-cluster sufficient statistics with ``psum`` — never rows.
+
+All shapes static; padded rows are excluded via ``mask`` everywhere
+(assignment, sums, cost).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class KMeansResult(NamedTuple):
+    centers: jnp.ndarray      # (k, n_features)
+    cost: jnp.ndarray         # scalar: sum of squared distances (inertia)
+    n_iter: jnp.ndarray       # scalar int
+    converged: jnp.ndarray    # scalar bool
+
+
+def _pairwise_sqdist(x: jnp.ndarray, centers: jnp.ndarray) -> jnp.ndarray:
+    """||x−c||² via the expanded form — the cross term is one MXU matmul."""
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]
+    cross = lax.dot_general(
+        x, centers, (((1,), (1,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+    )
+    return jnp.maximum(x2 + c2 - 2.0 * cross, 0.0)
+
+
+def assign_clusters(x: jnp.ndarray, centers: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argmin(_pairwise_sqdist(x, centers), axis=1)
+
+
+@partial(jax.jit, static_argnames=("n_clusters",))
+def kmeans_plus_plus_init(
+    x: jnp.ndarray,
+    n_clusters: int,
+    key: jax.Array,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """k-means++ seeding on device: next center sampled ∝ min-distance².
+
+    Plays the role of Spark's k-means|| default init — same D²-weighting
+    idea, run as a k-step ``fori_loop`` in one compiled program.
+    """
+    m, n = x.shape
+    valid = jnp.ones(m, dtype=x.dtype) if mask is None else mask.astype(x.dtype)
+    neg_inf = jnp.asarray(-jnp.inf, dtype=x.dtype)
+    key, sub = jax.random.split(key)
+    first = jax.random.categorical(
+        sub, jnp.where(valid > 0, 0.0, neg_inf)
+    )
+    centers0 = jnp.zeros((n_clusters, n), dtype=x.dtype).at[0].set(x[first])
+    min_d0 = jnp.sum((x - x[first][None, :]) ** 2, axis=1) * valid
+
+    def body(i, state):
+        centers, min_d, key = state
+        key, sub = jax.random.split(key)
+        # sample ∝ D² over VALID rows only — masked (padding) rows must
+        # stay -inf even when all valid distances are zero (duplicate-heavy
+        # shards), else a zero-filled padding row becomes a center.
+        logits = jnp.where(
+            valid > 0, jnp.log(jnp.maximum(min_d, 1e-30)), neg_inf
+        )
+        idx = jax.random.categorical(sub, logits)
+        c = x[idx]
+        centers = centers.at[i].set(c)
+        d_new = jnp.sum((x - c[None, :]) ** 2, axis=1) * valid
+        return centers, jnp.minimum(min_d, d_new), key
+
+    centers, _, _ = lax.fori_loop(1, n_clusters, body, (centers0, min_d0, key))
+    return centers
+
+
+def _cluster_stats(x, centers, valid):
+    """One Lloyd half-step: assignment + per-cluster (Σx, count, cost).
+
+    The one-hot reduction ``onehotᵀ·X`` is an MXU matmul, not a scatter —
+    the TPU-friendly formulation of the cluster sum.
+    """
+    k = centers.shape[0]
+    d = _pairwise_sqdist(x, centers)
+    labels = jnp.argmin(d, axis=1)
+    onehot = jax.nn.one_hot(labels, k, dtype=x.dtype) * valid[:, None]
+    sums = lax.dot_general(
+        onehot, x, (((0,), (0,)), ((), ())), precision=lax.Precision.HIGHEST
+    )
+    counts = jnp.sum(onehot, axis=0)
+    cost = jnp.sum(jnp.min(d, axis=1) * valid)
+    return sums, counts, cost
+
+
+def lloyd_iterations(
+    x: jnp.ndarray,
+    init_centers: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    max_iter: int,
+    tol: float,
+    reduce_fn: Callable = lambda t: t,
+) -> KMeansResult:
+    """Lloyd's algorithm as a ``lax.while_loop``.
+
+    ``reduce_fn`` combines (sums, counts, cost) across shards — identity on
+    one device, ``psum`` over the mesh in the distributed path; everything
+    else is shared between the two.
+    """
+    valid = (
+        jnp.ones(x.shape[0], dtype=x.dtype) if mask is None else mask.astype(x.dtype)
+    )
+
+    def step(state):
+        centers, _, it, _ = state
+        sums, counts, cost = reduce_fn(_cluster_stats(x, centers, valid))
+        # empty cluster: keep its previous center (Spark behavior)
+        safe = jnp.maximum(counts, 1.0)[:, None]
+        new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+        shift2 = jnp.sum((new_centers - centers) ** 2, axis=1)
+        moved = jnp.sqrt(jnp.max(shift2))
+        return new_centers, cost, it + 1, moved <= tol
+
+    def cond(state):
+        _, _, it, done = state
+        return jnp.logical_and(it < max_iter, jnp.logical_not(done))
+
+    init_state = (
+        init_centers,
+        jnp.array(jnp.inf, dtype=x.dtype),
+        jnp.array(0, dtype=jnp.int32),
+        jnp.array(False),
+    )
+    centers, _, n_iter, converged = lax.while_loop(cond, step, init_state)
+    # final cost under the final centers
+    _, _, cost = reduce_fn(_cluster_stats(x, centers, valid))
+    return KMeansResult(centers, cost, n_iter, converged)
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def kmeans_fit_kernel(
+    x: jnp.ndarray,
+    init_centers: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    max_iter: int = 20,
+    tol: float = 1e-4,
+) -> KMeansResult:
+    return lloyd_iterations(x, init_centers, mask, max_iter, tol)

@@ -1,0 +1,100 @@
+"""Linear regression device kernels: normal-equations via sufficient stats.
+
+Same partial-aggregate shape as PCA's covariance (SURVEY.md §7 step 6:
+"LinearRegression ... also 'partial-aggregate + small dense solve'"): the
+hot op is the Gram XᵀX on the MXU, the solve is a small dense Cholesky on
+the (n+1)-sized system, and the distributed form psums (XᵀX, Xᵀy, Σx, Σy,
+n) — rows never leave their shard.
+
+Objective (Spark ``LinearRegression`` with ``solver="normal"``):
+    min_w  (1/2n)·Σᵢ (yᵢ − xᵢᵀw − b)² + (λ/2)·||w||²
+i.e. ridge on mean-centered data; intercept unpenalized.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LinRegStats(NamedTuple):
+    xtx: jnp.ndarray     # (n, n)
+    xty: jnp.ndarray     # (n,)
+    x_sum: jnp.ndarray   # (n,)
+    y_sum: jnp.ndarray   # scalar
+    y_sq: jnp.ndarray    # scalar Σy²
+    count: jnp.ndarray   # scalar
+
+
+class LinRegResult(NamedTuple):
+    coefficients: jnp.ndarray  # (n,)
+    intercept: jnp.ndarray     # scalar
+
+
+def linreg_partial_stats(
+    x: jnp.ndarray, y: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+) -> LinRegStats:
+    m = (
+        jnp.ones(x.shape[0], dtype=x.dtype) if mask is None else mask.astype(x.dtype)
+    )
+    xm = x * m[:, None]
+    ym = y * m
+    xtx = lax.dot_general(
+        xm, x, (((0,), (0,)), ((), ())), precision=lax.Precision.HIGHEST
+    )
+    xty = xm.T @ y
+    return LinRegStats(
+        xtx=xtx,
+        xty=xty,
+        x_sum=jnp.sum(xm, axis=0),
+        y_sum=jnp.sum(ym),
+        y_sq=jnp.sum(ym * y),
+        count=jnp.sum(m),
+    )
+
+
+def solve_normal_equations(
+    stats: LinRegStats, reg_param: float, fit_intercept: bool
+) -> LinRegResult:
+    n = stats.count
+    if fit_intercept:
+        mu_x = stats.x_sum / n
+        mu_y = stats.y_sum / n
+        # centered moments: Xcᵀ·Xc = XᵀX − n·μₓμₓᵀ ; Xcᵀ·yc = Xᵀy − n·μₓμ_y
+        a = stats.xtx / n - jnp.outer(mu_x, mu_x)
+        b = stats.xty / n - mu_x * mu_y
+    else:
+        a = stats.xtx / n
+        b = stats.xty / n
+    a = a + reg_param * jnp.eye(a.shape[0], dtype=a.dtype)
+    # SPD system: Cholesky solve; jitter-free because reg/centered Gram is
+    # PSD and XLA's cho_factor handles the tiny-n case on device.
+    coef = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(a), b)
+    if fit_intercept:
+        intercept = stats.y_sum / n - jnp.dot(stats.x_sum / n, coef)
+    else:
+        intercept = jnp.zeros((), dtype=coef.dtype)
+    return LinRegResult(coef, intercept)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def linreg_fit_kernel(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+) -> LinRegResult:
+    stats = linreg_partial_stats(x, y, mask)
+    return solve_normal_equations(stats, reg_param, fit_intercept)
+
+
+@jax.jit
+def linreg_predict_kernel(
+    x: jnp.ndarray, coefficients: jnp.ndarray, intercept: jnp.ndarray
+) -> jnp.ndarray:
+    return x @ coefficients.astype(x.dtype) + intercept.astype(x.dtype)

@@ -1,0 +1,125 @@
+"""Pallas TPU kernel: fused center+scale+mask+Gram.
+
+The covariance pipeline's HBM-bandwidth hazard is materializing the centered
+matrix ``(X−μ)·s`` before the Gram matmul — an extra full read+write of X.
+XLA usually fuses the subtraction into the matmul's operand load; this
+kernel makes that guarantee explicit and adds the row-mask multiply in the
+same pass: X is read from HBM exactly once per (i,j) output tile pair, the
+center/scale/mask arithmetic happens in VMEM, and the MXU accumulates
+``Gᵢⱼ += x̃ᵢᵀ x̃ⱼ`` tile by tile.
+
+Grid: (row_tiles as the MINOR axis for revisiting-accumulation, col_tile_i,
+col_tile_j). Output tile (i,j) is initialized on the first row tile and
+accumulated across the rest — the standard Pallas reduction pattern.
+
+Used on TPU when shapes are tile-aligned; everywhere else the XLA
+``covariance`` path is identical semantics (tests assert equality in
+interpret mode).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+# f32 min tile is (8,128); 256×256 output tiles with 512-row strips keep
+# VMEM well under budget: 2×(512×256) inputs + (256×256) acc ≈ 1.3 MB.
+_BLOCK_N = 256
+_BLOCK_R = 512
+
+
+def _gram_kernel(x_i_ref, x_j_ref, mean_i_ref, mean_j_ref, rowmul_ref, o_ref):
+    r = pl.program_id(2)
+
+    @pl.when(r == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    m = rowmul_ref[:]  # (BLOCK_R, 1): mask × 1/√(n−1), zero on padding
+    xi = (x_i_ref[:] - mean_i_ref[:]) * m
+    xj = (x_j_ref[:] - mean_j_ref[:]) * m
+    o_ref[:] += jax.lax.dot_general(
+        xi, xj, (((0,), (0,)), ((), ())), preferred_element_type=o_ref.dtype
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_centered_gram(
+    x: jnp.ndarray,
+    mean: jnp.ndarray,
+    rowmul: jnp.ndarray,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``(diag(rowmul)·(X − mean))ᵀ (diag(rowmul)·(X − mean))`` in one pass.
+
+    ``rowmul`` is the per-row multiplier (mask × global 1/√(n−1) scaling —
+    the reference folded the same normalizer into rows before its GEMM,
+    ``RapidsRowMatrix.scala:169,179-181``). Requires row/col extents padded
+    to the tile grid (use ``pad_for_fused_gram``); padding rows carry
+    rowmul=0 so they contribute nothing.
+    """
+    rows, n = x.shape
+    if rows % _BLOCK_R or n % _BLOCK_N:
+        raise ValueError(
+            f"shape {(rows, n)} must be padded to multiples of "
+            f"({_BLOCK_R}, {_BLOCK_N}); use pad_for_fused_gram"
+        )
+    grid = (n // _BLOCK_N, n // _BLOCK_N, rows // _BLOCK_R)
+    mean2d = mean.reshape(1, n).astype(x.dtype)
+    rowmul2d = rowmul.reshape(rows, 1).astype(x.dtype)
+    return pl.pallas_call(
+        _gram_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, n), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_R, _BLOCK_N), lambda i, j, r: (r, i)),
+            pl.BlockSpec((_BLOCK_R, _BLOCK_N), lambda i, j, r: (r, j)),
+            pl.BlockSpec((1, _BLOCK_N), lambda i, j, r: (0, i)),
+            pl.BlockSpec((1, _BLOCK_N), lambda i, j, r: (0, j)),
+            pl.BlockSpec((_BLOCK_R, 1), lambda i, j, r: (r, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_N, _BLOCK_N), lambda i, j, r: (i, j)),
+        interpret=interpret,
+    )(x, x, mean2d, mean2d, rowmul2d)
+
+
+def pad_for_fused_gram(x, mask=None):
+    """Pad rows to _BLOCK_R and features to _BLOCK_N; returns
+    (x_padded, rowmask_padded, n_features_original)."""
+    import numpy as np
+
+    x = np.asarray(x)
+    rows, n = x.shape
+    pr = (-rows) % _BLOCK_R
+    pn = (-n) % _BLOCK_N
+    rowmask = np.ones(rows, dtype=x.dtype) if mask is None else np.asarray(mask, dtype=x.dtype)
+    if pr:
+        x = np.concatenate([x, np.zeros((pr, n), dtype=x.dtype)])
+        rowmask = np.concatenate([rowmask, np.zeros(pr, dtype=x.dtype)])
+    if pn:
+        x = np.concatenate([x, np.zeros((x.shape[0], pn), dtype=x.dtype)], axis=1)
+    return x, rowmask, n
+
+
+def covariance_fused(x, mask=None, mean_centering: bool = True, interpret: bool = False):
+    """Covariance via the fused kernel: host-side padding + on-device
+    mean pass + single fused Gram. Returns (cov[n,n], mean[n])."""
+    import numpy as np
+
+    x_p, rowmask, n = pad_for_fused_gram(x, mask)
+    x_dev = jnp.asarray(x_p)
+    rowmask_dev = jnp.asarray(rowmask)
+    cnt = jnp.sum(rowmask_dev)
+    if mean_centering:
+        mean = jnp.sum(x_dev * rowmask_dev[:, None], axis=0) / cnt
+    else:
+        mean = jnp.zeros((x_p.shape[1],), dtype=x_dev.dtype)
+    scale = 1.0 / jnp.sqrt(jnp.maximum(cnt - 1.0, 1.0))
+    cov_full = fused_centered_gram(
+        x_dev, mean, rowmask_dev * scale, interpret=interpret
+    )
+    return cov_full[:n, :n], mean[:n]

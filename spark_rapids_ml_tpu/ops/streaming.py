@@ -1,0 +1,94 @@
+"""Streaming (chunked) PCA fit: bounded HBM for unbounded rows.
+
+The reference streams per-partition chunks through the GPU (one JNI GEMM
+per partition, ``RapidsRowMatrix.scala:168-202``). The TPU-native analogue:
+an on-device sufficient-statistics accumulator ``(Σxxᵀ, Σx, n)`` updated by
+a jitted, buffer-donating step per batch — HBM usage is one batch + one
+n×n Gram regardless of total rows, and batches stream through while the
+MXU stays busy. Finalization (covariance → eigh → postprocess) is the same
+program the one-shot kernel uses.
+
+This is also the host data-loader contract: feed fixed-shape batches
+(pad + mask the tail — no recompilation), call ``update``, then
+``finalize(k)``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.covariance import covariance_from_stats, partial_gram_stats
+from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
+from spark_rapids_ml_tpu.ops.pca_kernel import PCAFitResult
+
+
+class GramStats(NamedTuple):
+    """Device-resident accumulator: Gram (n×n), column sum (n,), row count."""
+
+    gram: jnp.ndarray
+    col_sum: jnp.ndarray
+    count: jnp.ndarray
+
+
+def init_stats(n_features: int, dtype=jnp.float32, device=None) -> GramStats:
+    zeros = partial(jnp.zeros, dtype=dtype)
+    stats = GramStats(
+        gram=zeros((n_features, n_features)),
+        col_sum=zeros((n_features,)),
+        count=jnp.zeros((), dtype=dtype),
+    )
+    if device is not None:
+        stats = jax.device_put(stats, device)
+    return stats
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_stats(
+    stats: GramStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+) -> GramStats:
+    """Accumulate one batch. ``stats`` buffers are DONATED — XLA updates the
+    Gram in place (no n×n copy per batch)."""
+    g, s, cnt = partial_gram_stats(batch.astype(stats.gram.dtype), mask)
+    return GramStats(stats.gram + g, stats.col_sum + s, stats.count + cnt)
+
+
+@partial(jax.jit, static_argnames=("k", "mean_centering", "flip_signs"))
+def finalize_stats(
+    stats: GramStats,
+    k: int,
+    mean_centering: bool = True,
+    flip_signs: bool = True,
+) -> PCAFitResult:
+    cov = covariance_from_stats(
+        stats.gram, stats.col_sum, stats.count, mean_centering=mean_centering
+    )
+    if mean_centering:
+        mean = stats.col_sum / stats.count
+    else:
+        mean = jnp.zeros_like(stats.col_sum)
+    components, evr = pca_from_covariance(cov, k, flip_signs=flip_signs)
+    return PCAFitResult(components, evr, mean)
+
+
+class StreamingPCA:
+    """Convenience wrapper: ``StreamingPCA(n).partial_fit(b)...finalize(k)``."""
+
+    def __init__(self, n_features: int, dtype=jnp.float32, device=None):
+        self._stats = init_stats(n_features, dtype=dtype, device=device)
+
+    def partial_fit(self, batch, mask=None) -> "StreamingPCA":
+        self._stats = update_stats(self._stats, batch, mask)
+        return self
+
+    @property
+    def rows_seen(self) -> float:
+        return float(self._stats.count)
+
+    def finalize(self, k: int, mean_centering: bool = True) -> PCAFitResult:
+        return jax.block_until_ready(
+            finalize_stats(self._stats, k, mean_centering=mean_centering)
+        )

@@ -1,0 +1,23 @@
+"""Platform-selection helper shared by bench, driver entry points and tests.
+
+A TPU PJRT plugin registered at interpreter startup (sitecustomize) may
+override ``jax_platforms`` via ``config.update``, silently ignoring a
+``JAX_PLATFORMS=cpu`` environment request — and initializing that plugin
+blocks when its device tunnel is down, hanging CPU-only runs. The config
+update is the authoritative switch, so re-assert the env request there.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_if_requested() -> None:
+    """Honor an explicit ``JAX_PLATFORMS=cpu`` env request even when a
+    plugin's register() overrode the config."""
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    tokens = want.split(",") if want else []
+    if "cpu" in tokens and "axon" not in tokens:
+        jax.config.update("jax_platforms", "cpu")

@@ -25,7 +25,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+from spark_rapids_ml_tpu.utils.platform import force_cpu_if_requested  # noqa: E402
+
+force_cpu_if_requested()
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

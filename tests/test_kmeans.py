@@ -1,0 +1,113 @@
+"""KMeans: device kernel vs sklearn/host oracle + distributed agreement."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import KMeans, KMeansModel
+
+ABS_TOL = 1e-5
+
+
+def make_blobs(rng, n=300, centers=None):
+    centers = centers if centers is not None else np.array(
+        [[0.0, 0.0], [10.0, 10.0], [-10.0, 8.0]]
+    )
+    pts = np.concatenate(
+        [c + rng.normal(scale=0.5, size=(n // len(centers), 2)) for c in centers]
+    )
+    rng.shuffle(pts)
+    return pts, centers
+
+
+def _match_centers(got, want):
+    """Order-invariant center comparison: greedy nearest matching."""
+    got = np.asarray(got, dtype=np.float64)
+    used = set()
+    err = 0.0
+    for w in want:
+        d = np.linalg.norm(got - w, axis=1)
+        for i in np.argsort(d):
+            if i not in used:
+                used.add(i)
+                err = max(err, d[i])
+                break
+    return err
+
+
+def test_kmeans_recovers_blobs(rng):
+    x, true_centers = make_blobs(rng)
+    model = KMeans().setK(3).setSeed(7).fit(x)
+    assert _match_centers(model.cluster_centers, true_centers) < 0.2
+    assert model.n_iter_ >= 1
+    assert model.training_cost_ > 0
+
+
+def test_kmeans_host_path_agrees_on_blobs(rng):
+    x, true_centers = make_blobs(rng)
+    host = KMeans().setK(3).setSeed(7).setUseXlaDot(False).fit(x)
+    assert _match_centers(host.cluster_centers, true_centers) < 0.2
+
+
+def test_kmeans_vs_sklearn_inertia(rng):
+    sklearn_cluster = pytest.importorskip("sklearn.cluster")
+    x = rng.normal(size=(400, 6))
+    ours = KMeans().setK(5).setSeed(3).setMaxIter(100).setTol(1e-8).fit(x)
+    sk = sklearn_cluster.KMeans(
+        n_clusters=5, n_init=10, random_state=0, tol=1e-8
+    ).fit(x)
+    # local optima may differ; inertia must be in the same ballpark
+    assert ours.training_cost_ <= sk.inertia_ * 1.15
+
+
+def test_kmeans_transform_labels_consistent(rng):
+    x, _ = make_blobs(rng)
+    model = KMeans().setK(3).setSeed(1).fit(x)
+    out = model.transform(x)
+    labels = np.asarray(out.column("prediction"))
+    assert labels.shape == (x.shape[0],)
+    assert set(np.unique(labels)) <= {0, 1, 2}
+    # points in the same blob share labels
+    host_labels = np.asarray(
+        model.copy({"useXlaDot": False}).transform(x).column("prediction")
+    )
+    np.testing.assert_array_equal(labels, host_labels)
+
+
+def test_kmeans_compute_cost_matches_training(rng):
+    x, _ = make_blobs(rng)
+    model = KMeans().setK(3).setSeed(1).setMaxIter(50).fit(x)
+    assert model.compute_cost(x) == pytest.approx(model.training_cost_, rel=1e-6)
+
+
+def test_kmeans_persistence_roundtrip(tmp_path, rng):
+    x, _ = make_blobs(rng)
+    model = KMeans().setK(3).setSeed(1).fit(x)
+    path = str(tmp_path / "km")
+    model.save(path)
+    loaded = KMeansModel.load(path)
+    np.testing.assert_allclose(loaded.cluster_centers, model.cluster_centers, atol=0)
+    assert loaded.getK() == 3
+    assert loaded.training_cost_ == pytest.approx(model.training_cost_)
+    a = np.asarray(model.transform(x).column("prediction"))
+    b = np.asarray(loaded.transform(x).column("prediction"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_kmeans_k_validation(rng):
+    with pytest.raises(ValueError, match="rows"):
+        KMeans().setK(10).fit(np.ones((3, 2)) * np.arange(3)[:, None])
+
+
+def test_distributed_kmeans_matches_single_device(rng):
+    from spark_rapids_ml_tpu.parallel import data_mesh
+    from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
+        distributed_kmeans_fit,
+    )
+
+    x, true_centers = make_blobs(rng, n=600)
+    mesh = data_mesh(8)
+    res = distributed_kmeans_fit(x, 3, mesh, max_iter=50, seed=5)
+    assert _match_centers(np.asarray(res.centers), true_centers) < 0.2
+    # cost equals a full-data host evaluation of the same centers
+    model = KMeansModel(cluster_centers=np.asarray(res.centers, dtype=np.float64))
+    assert model.compute_cost(x) == pytest.approx(float(res.cost), rel=1e-5)

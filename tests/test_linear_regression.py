@@ -1,0 +1,96 @@
+"""LinearRegression: exact recovery, sklearn parity, distributed agreement."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import LinearRegression, LinearRegressionModel
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+ABS_TOL = 1e-5
+
+
+def make_data(rng, n=200, p=6, noise=0.0):
+    x = rng.normal(size=(n, p))
+    w = rng.normal(size=p)
+    b = 2.5
+    y = x @ w + b + noise * rng.normal(size=n)
+    return x, y, w, b
+
+
+def test_exact_recovery_no_noise(rng):
+    x, y, w, b = make_data(rng)
+    model = LinearRegression().fit(x, labels=y)
+    np.testing.assert_allclose(model.coefficients, w, atol=ABS_TOL)
+    assert model.intercept == pytest.approx(b, abs=ABS_TOL)
+
+
+def test_no_intercept(rng):
+    x, y, w, _ = make_data(rng)
+    y = y - 2.5  # remove the intercept term
+    model = LinearRegression().setFitIntercept(False).fit(x, labels=y)
+    np.testing.assert_allclose(model.coefficients, w, atol=ABS_TOL)
+    assert model.intercept == 0.0
+
+
+def test_ridge_matches_sklearn(rng):
+    sklearn_lm = pytest.importorskip("sklearn.linear_model")
+    x, y, _, _ = make_data(rng, noise=0.5)
+    lam = 0.3
+    ours = LinearRegression().setRegParam(lam).fit(x, labels=y)
+    # our objective: (1/2n)Σerr² + (λ/2)||w||²  ⇔  sklearn Ridge alpha = n·λ
+    sk = sklearn_lm.Ridge(alpha=lam * len(x)).fit(x, y)
+    np.testing.assert_allclose(ours.coefficients, sk.coef_, atol=1e-6)
+    assert ours.intercept == pytest.approx(sk.intercept_, abs=1e-6)
+
+
+def test_host_path_agrees(rng):
+    x, y, _, _ = make_data(rng, noise=0.3)
+    dev = LinearRegression().setRegParam(0.1).fit(x, labels=y)
+    host = LinearRegression().setRegParam(0.1).setUseXlaDot(False).fit(x, labels=y)
+    np.testing.assert_allclose(host.coefficients, dev.coefficients, atol=1e-8)
+    assert host.intercept == pytest.approx(dev.intercept, abs=1e-8)
+
+
+def test_label_column_in_frame(rng):
+    x, y, w, b = make_data(rng)
+    frame = VectorFrame({"features": x, "label": y.tolist()})
+    model = LinearRegression().fit(frame)
+    np.testing.assert_allclose(model.coefficients, w, atol=ABS_TOL)
+    out = model.transform(frame)
+    pred = np.asarray(out.column("prediction"))
+    np.testing.assert_allclose(pred, y, atol=1e-4)
+    summary = model.evaluate(frame)
+    assert summary["r2"] == pytest.approx(1.0, abs=1e-6)
+    assert summary["rmse"] < 1e-4
+
+
+def test_label_length_mismatch(rng):
+    with pytest.raises(ValueError, match="labels length"):
+        LinearRegression().fit(np.ones((5, 2)), labels=np.ones(4))
+
+
+def test_persistence_roundtrip(tmp_path, rng):
+    x, y, _, _ = make_data(rng, noise=0.2)
+    model = LinearRegression().setRegParam(0.05).fit(x, labels=y)
+    path = str(tmp_path / "lr")
+    model.save(path)
+    loaded = LinearRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients, atol=0)
+    assert loaded.intercept == model.intercept
+    assert loaded.getRegParam() == 0.05
+
+
+def test_distributed_matches_single_device(rng):
+    from spark_rapids_ml_tpu.parallel import data_mesh
+    from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
+        distributed_linreg_fit,
+    )
+
+    x, y, _, _ = make_data(rng, n=203, noise=0.4)  # uneven rows: padding
+    single = LinearRegression().setRegParam(0.2).fit(x, labels=y)
+    mesh = data_mesh(8)
+    res = distributed_linreg_fit(x, y, mesh, reg_param=0.2)
+    np.testing.assert_allclose(
+        np.asarray(res.coefficients), single.coefficients, atol=1e-8
+    )
+    assert float(res.intercept) == pytest.approx(single.intercept, abs=1e-8)

@@ -1,0 +1,69 @@
+"""Fused Pallas Gram kernel vs the XLA covariance path (interpret mode on
+CPU; the same kernel compiles for TPU tiles)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.ops.covariance import covariance
+from spark_rapids_ml_tpu.ops.pallas_gram import (
+    _BLOCK_N,
+    _BLOCK_R,
+    covariance_fused,
+    fused_centered_gram,
+    pad_for_fused_gram,
+)
+
+
+def test_fused_matches_xla_exact_tiles(rng):
+    x = rng.normal(size=(_BLOCK_R, _BLOCK_N)).astype(np.float32)
+    mean = x.mean(axis=0)
+    n = x.shape[0]
+    rowmul = np.full(n, 1.0 / np.sqrt(n - 1), dtype=np.float32)
+    got = fused_centered_gram(
+        jnp.asarray(x), jnp.asarray(mean), jnp.asarray(rowmul), interpret=True
+    )
+    want = covariance(jnp.asarray(x), mean=jnp.asarray(mean))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_fused_covariance_padded_and_masked(rng):
+    # 700×37: both axes need padding; padded rows/cols must not leak.
+    x = rng.normal(loc=2.0, size=(700, 37)).astype(np.float32)
+    cov, mean = covariance_fused(x, interpret=True)
+    x64 = x.astype(np.float64)
+    want = np.cov(x64, rowvar=False)
+    np.testing.assert_allclose(np.asarray(cov), want, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(mean), x64.mean(0), atol=1e-5)
+    assert cov.shape == (37, 37)
+
+
+def test_fused_covariance_no_centering(rng):
+    x = rng.normal(size=(600, 40)).astype(np.float32)
+    cov, mean = covariance_fused(x, mean_centering=False, interpret=True)
+    want = x.astype(np.float64).T @ x.astype(np.float64) / (600 - 1)
+    np.testing.assert_allclose(np.asarray(cov), want, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(mean), np.zeros(40), atol=0)
+
+
+def test_fused_respects_row_mask(rng):
+    x = rng.normal(size=(520, 30)).astype(np.float32)
+    mask = np.ones(520, dtype=np.float32)
+    mask[500:] = 0.0  # rows beyond 500 are garbage
+    x[500:] = 1e6
+    cov, _ = covariance_fused(x, mask=mask, interpret=True)
+    want = np.cov(x[:500].astype(np.float64), rowvar=False)
+    np.testing.assert_allclose(np.asarray(cov), want, atol=5e-3)
+
+
+def test_unpadded_shape_rejected(rng):
+    x = jnp.asarray(rng.normal(size=(100, 37)).astype(np.float32))
+    with pytest.raises(ValueError, match="padded"):
+        fused_centered_gram(x, jnp.zeros(37), jnp.ones(100), interpret=True)
+
+
+def test_pad_helper():
+    x = np.ones((10, 5), dtype=np.float32)
+    xp, rm, n = pad_for_fused_gram(x)
+    assert xp.shape == (_BLOCK_R, _BLOCK_N) and n == 5
+    assert rm.sum() == 10

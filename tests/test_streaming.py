@@ -1,0 +1,61 @@
+"""Streaming accumulation must equal one-shot fit (batch-size invariance)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.ops.streaming import StreamingPCA, init_stats, update_stats
+
+from conftest import numpy_pca_oracle
+
+ABS_TOL = 1e-5
+
+
+def test_streaming_matches_oracle(rng):
+    x = rng.normal(loc=1.5, size=(300, 10))
+    s = StreamingPCA(10, dtype=jnp.float64)
+    for i in range(0, 300, 64):  # uneven final batch via mask padding
+        batch = x[i : i + 64]
+        pad = 64 - batch.shape[0]
+        mask = np.ones(64)
+        if pad:
+            batch = np.concatenate([batch, np.zeros((pad, 10))])
+            mask[64 - pad :] = 0.0
+        s.partial_fit(jnp.asarray(batch), jnp.asarray(mask))
+    assert s.rows_seen == 300
+    res = s.finalize(4)
+    pc, evr, mean = numpy_pca_oracle(x, 4)
+    np.testing.assert_allclose(np.asarray(res.components), pc, atol=ABS_TOL)
+    np.testing.assert_allclose(np.asarray(res.explained_variance), evr, atol=ABS_TOL)
+    np.testing.assert_allclose(np.asarray(res.mean), mean, atol=ABS_TOL)
+
+
+def test_batch_size_invariance(rng):
+    x = rng.normal(size=(120, 6))
+    results = []
+    for bs in (8, 40, 120):
+        s = StreamingPCA(6, dtype=jnp.float64)
+        for i in range(0, 120, bs):
+            s.partial_fit(jnp.asarray(x[i : i + bs]))
+        results.append(np.asarray(s.finalize(3).components))
+    np.testing.assert_allclose(results[0], results[1], atol=1e-10)
+    np.testing.assert_allclose(results[0], results[2], atol=1e-10)
+
+
+def test_no_mean_centering(rng):
+    x = rng.normal(loc=3.0, size=(80, 5))
+    s = StreamingPCA(5, dtype=jnp.float64)
+    s.partial_fit(jnp.asarray(x))
+    res = s.finalize(2, mean_centering=False)
+    pc, evr, _ = numpy_pca_oracle(x, 2, mean_centering=False)
+    np.testing.assert_allclose(np.asarray(res.components), pc, atol=ABS_TOL)
+    np.testing.assert_allclose(np.asarray(res.mean), np.zeros(5), atol=0)
+
+
+def test_donation_keeps_single_gram_buffer(rng):
+    # update_stats donates: repeated updates must not error on reuse of the
+    # donated buffers and count must accumulate exactly.
+    stats = init_stats(4, dtype=jnp.float64)
+    b = jnp.asarray(rng.normal(size=(16, 4)))
+    for _ in range(5):
+        stats = update_stats(stats, b)
+    assert float(stats.count) == 80.0
