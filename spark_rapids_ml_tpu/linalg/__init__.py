@@ -1,0 +1,14 @@
+"""Distributed linear algebra layer (L3 of the reference's stack).
+
+The reference's ``org.apache.spark.ml.linalg.distributed.RapidsRowMatrix``
+sits between the Estimator and the device kernels; this subpackage is its
+TPU-native equivalent.
+"""
+
+from spark_rapids_ml_tpu.linalg.row_matrix import (  # noqa: F401
+    MAX_SPR_COLS,
+    RowMatrix,
+    triu_to_full,
+)
+
+__all__ = ["RowMatrix", "triu_to_full", "MAX_SPR_COLS"]

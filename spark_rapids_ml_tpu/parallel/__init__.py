@@ -1,12 +1,25 @@
-from spark_rapids_ml_tpu.parallel.mesh import data_mesh, device_count
+from spark_rapids_ml_tpu.parallel.mesh import data_mesh, device_count, grid_mesh
 from spark_rapids_ml_tpu.parallel.distributed_pca import (
     distributed_pca_fit,
     distributed_pca_fit_kernel,
+)
+from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
+    distributed_kmeans_fit,
+    distributed_kmeans_fit_kernel,
+)
+from spark_rapids_ml_tpu.parallel.distributed_linreg import (
+    distributed_linreg_fit,
+    distributed_linreg_fit_kernel,
 )
 
 __all__ = [
     "data_mesh",
     "device_count",
+    "grid_mesh",
     "distributed_pca_fit",
     "distributed_pca_fit_kernel",
+    "distributed_kmeans_fit",
+    "distributed_kmeans_fit_kernel",
+    "distributed_linreg_fit",
+    "distributed_linreg_fit_kernel",
 ]

@@ -1,9 +1,9 @@
-"""Distributed KMeans and LinearRegression over the mesh.
+"""Distributed KMeans over the mesh.
 
-Both reuse the PCA pattern (``distributed_pca.py``): rows sharded over
+Reuses the PCA pattern (``distributed_pca.py``): rows sharded over
 ``data``, per-shard sufficient statistics, ``psum`` all-reduce, replicated
-small solve. For KMeans the psum runs INSIDE the compiled Lloyd loop —
-one all-reduce of (k×n sums, k counts, cost) per iteration over ICI, versus
+small solve — with the psum running INSIDE the compiled Lloyd loop: one
+all-reduce of (k×n sums, k counts, cost) per iteration over ICI, versus
 the reference-era pattern of shipping assignments to a driver.
 """
 
@@ -20,11 +20,6 @@ from spark_rapids_ml_tpu.ops.kmeans_kernel import (
     KMeansResult,
     kmeans_plus_plus_init,
     lloyd_iterations,
-)
-from spark_rapids_ml_tpu.ops.linreg_kernel import (
-    LinRegResult,
-    linreg_partial_stats,
-    solve_normal_equations,
 )
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple, row_sharding
 
@@ -94,59 +89,5 @@ def distributed_kmeans_fit(
         distributed_kmeans_fit_kernel(
             x_dev, mask_dev, key,
             mesh=mesh, n_clusters=n_clusters, max_iter=max_iter, tol=tol,
-        )
-    )
-
-
-@partial(jax.jit, static_argnames=("mesh", "fit_intercept"))
-def distributed_linreg_fit_kernel(
-    x: jnp.ndarray,
-    y: jnp.ndarray,
-    mask: jnp.ndarray,
-    *,
-    mesh: Mesh,
-    reg_param: float = 0.0,
-    fit_intercept: bool = True,
-) -> LinRegResult:
-    def shard_fn(x_shard, y_shard, mask_shard):
-        stats = linreg_partial_stats(x_shard, y_shard, mask_shard)
-        stats = type(stats)(*jax.lax.psum(tuple(stats), DATA_AXIS))
-        return tuple(solve_normal_equations(stats, reg_param, fit_intercept))
-
-    fn = jax.shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P()),
-    )
-    coef, intercept = fn(x, y, mask)
-    return LinRegResult(coef, intercept)
-
-
-def distributed_linreg_fit(
-    x_host: np.ndarray,
-    y_host: np.ndarray,
-    mesh: Mesh,
-    reg_param: float = 0.0,
-    fit_intercept: bool = True,
-    dtype=None,
-) -> LinRegResult:
-    x_host = np.asarray(x_host)
-    y_host = np.asarray(y_host).reshape(-1)
-    n_dev = mesh.devices.size
-    x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
-    y_padded = np.zeros(x_padded.shape[0], dtype=y_host.dtype)
-    y_padded[: y_host.shape[0]] = y_host
-    if dtype is not None:
-        x_padded = x_padded.astype(dtype)
-        y_padded = y_padded.astype(dtype)
-        mask = mask.astype(dtype)
-    x_dev = jax.device_put(x_padded, row_sharding(mesh))
-    y_dev = jax.device_put(y_padded, NamedSharding(mesh, P(DATA_AXIS)))
-    mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
-    return jax.block_until_ready(
-        distributed_linreg_fit_kernel(
-            x_dev, y_dev, mask_dev,
-            mesh=mesh, reg_param=reg_param, fit_intercept=fit_intercept,
         )
     )

@@ -82,7 +82,7 @@ def test_persistence_roundtrip(tmp_path, rng):
 
 def test_distributed_matches_single_device(rng):
     from spark_rapids_ml_tpu.parallel import data_mesh
-    from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
+    from spark_rapids_ml_tpu.parallel.distributed_linreg import (
         distributed_linreg_fit,
     )
 

@@ -1,0 +1,139 @@
+"""RowMatrix (L3) parity: both covariance schedules, packed helpers, PCA
+driver, and projection — vs the NumPy oracle.
+
+Mirrors the reference's ``RapidsRowMatrix`` behavior
+(``RapidsRowMatrix.scala:30-289``) with its §3.6 bugs corrected: the packed
+spr path normalizes by numRows−1, supports mean_centering=False, and the
+two paths agree on rectangular data.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.linalg import MAX_SPR_COLS, RowMatrix, triu_to_full
+
+from conftest import numpy_pca_oracle
+
+ABS_TOL = 1e-5
+
+
+def np_cov(x, mean_centering=True):
+    x = np.asarray(x, dtype=np.float64)
+    mu = x.mean(axis=0) if mean_centering else np.zeros(x.shape[1])
+    xc = x - mu
+    return xc.T @ xc / max(x.shape[0] - 1, 1)
+
+
+def test_lazy_dims_and_partitions(rng):
+    x = rng.normal(size=(23, 5))
+    m = RowMatrix(x, num_partitions=4)
+    assert m.num_rows() == 23
+    assert m.num_cols() == 5
+    assert m.num_partitions == 4
+    np.testing.assert_allclose(m.to_numpy(), x)
+
+
+@pytest.mark.parametrize("use_xla_dot", [True, False])
+@pytest.mark.parametrize("mean_centering", [True, False])
+def test_covariance_both_paths(rng, use_xla_dot, mean_centering):
+    # Rectangular data: numRows != numCols catches the reference's
+    # numCols-normalizer bug (RapidsRowMatrix.scala:169 vs :241).
+    x = rng.normal(size=(57, 9))
+    m = RowMatrix(
+        x,
+        mean_centering=mean_centering,
+        use_xla_dot=use_xla_dot,
+        num_partitions=3,
+    )
+    np.testing.assert_allclose(
+        m.compute_covariance(), np_cov(x, mean_centering), atol=ABS_TOL
+    )
+
+
+def test_covariance_partitioned_input_chunks(rng):
+    # Explicit chunk list (the "RDD partitions" form).
+    chunks = [rng.normal(size=(n, 6)) for n in (11, 3, 20)]
+    x = np.concatenate(chunks, axis=0)
+    m = RowMatrix(chunks)
+    assert m.num_partitions == 3
+    np.testing.assert_allclose(m.compute_covariance(), np_cov(x), atol=ABS_TOL)
+
+
+@pytest.mark.parametrize("use_xla_dot", [True, False])
+@pytest.mark.parametrize("use_xla_svd", [True, False])
+def test_pca_driver_matches_oracle(rng, use_xla_dot, use_xla_svd):
+    x = rng.normal(size=(48, 7))
+    k = 4
+    pc_exp, evr_exp, _ = numpy_pca_oracle(x, k)
+    m = RowMatrix(x, use_xla_dot=use_xla_dot, use_xla_svd=use_xla_svd,
+                  num_partitions=2)
+    pc, evr = m.compute_principal_components_and_explained_variance(k)
+    np.testing.assert_allclose(pc, pc_exp, atol=ABS_TOL)
+    np.testing.assert_allclose(evr, evr_exp, atol=ABS_TOL)
+
+
+def test_k_equals_n_full_basis(rng):
+    x = rng.normal(size=(30, 6))
+    m = RowMatrix(x)
+    pc, evr = m.compute_principal_components_and_explained_variance(6)
+    assert pc.shape == (6, 6)
+    np.testing.assert_allclose(evr.sum(), 1.0, atol=ABS_TOL)
+    # orthonormal columns
+    np.testing.assert_allclose(pc.T @ pc, np.eye(6), atol=1e-8)
+
+
+def test_k_out_of_range(rng):
+    m = RowMatrix(rng.normal(size=(10, 4)))
+    with pytest.raises(ValueError):
+        m.compute_principal_components_and_explained_variance(5)
+    with pytest.raises(ValueError):
+        m.compute_principal_components_and_explained_variance(0)
+
+
+def test_mean_centering_requires_two_rows():
+    m = RowMatrix(np.ones((1, 3)))
+    with pytest.raises(ValueError, match="more than one row"):
+        m.compute_covariance()
+
+
+def test_triu_to_full_round_trip(rng):
+    a = rng.normal(size=(7, 7))
+    sym = (a + a.T) / 2
+    from spark_rapids_ml_tpu.linalg.row_matrix import _full_to_triu
+
+    np.testing.assert_allclose(triu_to_full(7, _full_to_triu(sym)), sym)
+
+
+def test_triu_to_full_bad_length():
+    with pytest.raises(ValueError):
+        triu_to_full(4, np.zeros(9))
+
+
+def test_packed_path_column_limit():
+    m = RowMatrix(np.zeros((2, 3)), use_xla_dot=False)
+    m._num_cols = MAX_SPR_COLS + 1  # simulate a too-wide matrix
+    with pytest.raises(ValueError, match="at most"):
+        m.compute_covariance()
+
+
+@pytest.mark.parametrize("use_xla_dot", [True, False])
+def test_multiply_projection(rng, use_xla_dot):
+    # The test-oracle op: mat.multiply(pc) (PCASuite.scala:50-54).
+    x = rng.normal(size=(25, 6))
+    p = rng.normal(size=(6, 3))
+    m = RowMatrix(x, use_xla_dot=use_xla_dot, num_partitions=2)
+    out = m.multiply(p)
+    assert out.num_rows() == 25
+    assert out.num_cols() == 3
+    np.testing.assert_allclose(out.to_numpy(), x @ p, atol=ABS_TOL)
+
+
+def test_multiply_shape_mismatch(rng):
+    m = RowMatrix(rng.normal(size=(10, 4)))
+    with pytest.raises(ValueError):
+        m.multiply(np.zeros((5, 2)))
+
+
+def test_inconsistent_partition_columns(rng):
+    with pytest.raises(ValueError, match="inconsistent column counts"):
+        RowMatrix([rng.normal(size=(3, 4)), rng.normal(size=(3, 5))])
