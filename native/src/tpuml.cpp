@@ -8,13 +8,18 @@
 //
 //   * host fallback kernels with the same call surface the JNI layer had:
 //       tpuml_dgemm   <- Java_..._dgemm   (rapidsml_jni.cu:172-258)
-//                        also covers dgemm_b (:260-336): that entry is the
-//                        same GEMM with transa=T hardcoded
+//       tpuml_dgemm_b <- Java_..._dgemm_1b (:260-336): the batched
+//                        transform entry, C = AᵀB with alpha=1/beta=0
+//                        hardcoded like the reference (minus its dev_B leak)
 //       tpuml_dsyevd  <- Java_..._calSVD's eigDC core (:338-392); the
 //                        postprocessing (reorder/sqrt/signFlip) deliberately
 //                        lives one layer up, shared with the XLA path
-//       (dspr         <- intentionally dropped: dead code in the reference,
-//                        SURVEY.md §2 checklist item 4)
+//       tpuml_dspr    <- Java_..._dspr (:107-170): packed upper-triangular
+//                        rank-1 update. Dead code in the reference (the live
+//                        CPU path uses Spark's own BLAS.spr) but part of its
+//                        declared native surface, so provided for parity;
+//                        the accelerator path folds outer products into the
+//                        Gram matmul instead (SURVEY.md §2 checklist item 4)
 //   * trace range markers <- Java_..._NvtxRange_push/pop (:82-105), as a
 //     lock-guarded in-memory ring buffer (host-side timeline, merged with
 //     jax.profiler annotations by the Python layer)
@@ -288,6 +293,31 @@ TPUML_API int tpuml_dgemm(int transa, int transb, int64_t m, int64_t n,
     gemm_nn(m, n, k, alpha, A, lda, B, ldb, beta, C, ldc);
   } else {
     gemm_tn(m, n, k, alpha, A, lda, B, ldb, beta, C, ldc);
+  }
+  return 0;
+}
+
+// Batched transform GEMM: C(m×n) = Aᵀ·B where A is k×m and B is k×n, both
+// row-major; alpha=1, beta=0 hardcoded — the reference's dgemm_1b entry
+// (rapidsml_jni.cu:260-336) used by the (there disabled) GPU model
+// transform.
+TPUML_API int tpuml_dgemm_b(int64_t m, int64_t n, int64_t k, const double* A,
+                            const double* B, double* C) {
+  if (!A || !B || !C || m < 0 || n < 0 || k < 0) return 1;
+  gemm_tn(m, n, k, 1.0, A, m, B, n, 0.0, C, n);
+  return 0;
+}
+
+// Packed upper-triangular rank-1 update: AP[j(j+1)/2 + i] += alpha·x[i]·x[j]
+// for i ≤ j (column-major packed, cublasDspr's CUBLAS_FILL_MODE_UPPER
+// layout — the reference's dspr entry, rapidsml_jni.cu:107-170).
+TPUML_API int tpuml_dspr(int64_t n, double alpha, const double* x,
+                         double* AP) {
+  if (!x || !AP || n <= 0) return 1;
+  for (int64_t j = 0; j < n; ++j) {
+    double axj = alpha * x[j];
+    double* col = &AP[j * (j + 1) / 2];
+    for (int64_t i = 0; i <= j; ++i) col[i] += x[i] * axj;
   }
   return 0;
 }

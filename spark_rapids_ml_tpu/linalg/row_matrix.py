@@ -79,7 +79,7 @@ def _as_partitions(rows, num_partitions: Optional[int]) -> List[np.ndarray]:
     partial-aggregate schedule is exercised like the reference's
     ``sc.parallelize(data, 2)`` tests do (``PCASuite.scala:48``).
     """
-    from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, rows_to_matrix
+    from spark_rapids_ml_tpu.data.vector import rows_to_matrix
 
     if isinstance(rows, np.ndarray) and rows.ndim == 2:
         parts = [np.asarray(rows, dtype=np.float64)]
@@ -312,13 +312,10 @@ class RowMatrix:
                 ]
             else:
                 parts = [p @ m for p in self._parts]
-        out = RowMatrix.__new__(RowMatrix)
+        import copy
+
+        out = copy.copy(self)
         out._parts = parts
-        out.mean_centering = self.mean_centering
-        out.use_xla_dot = self.use_xla_dot
-        out.use_xla_svd = self.use_xla_svd
-        out.device_id = self.device_id
-        out._num_rows = self._num_rows
         out._num_cols = m.shape[1]
         return out
 

@@ -79,6 +79,12 @@ def _configure(lib: ctypes.CDLL) -> None:
         ctypes.c_double, d, ctypes.c_longlong,      # beta, C, ldc
     ]
     lib.tpuml_dgemm.restype = ctypes.c_int
+    lib.tpuml_dgemm_b.argtypes = [
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong, d, d, d
+    ]
+    lib.tpuml_dgemm_b.restype = ctypes.c_int
+    lib.tpuml_dspr.argtypes = [ctypes.c_longlong, ctypes.c_double, d, d]
+    lib.tpuml_dspr.restype = ctypes.c_int
     lib.tpuml_dsyevd.argtypes = [ctypes.c_longlong, d, d, d]
     lib.tpuml_dsyevd.restype = ctypes.c_int
     lib.tpuml_alloc.argtypes = [ctypes.c_size_t]
@@ -204,6 +210,63 @@ def gram(a: np.ndarray) -> np.ndarray:
     if rc != 0:
         raise RuntimeError(f"tpuml_dgemm failed with code {rc}")
     return c
+
+
+def gemm_b(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """C = AᵀB (the batched-transform ``dgemm_b`` surface,
+    ``rapidsml_jni.cu:260-336``). ``a`` is k×m, ``b`` is k×n."""
+    lib = load()
+    a, b = _as_f64(a), _as_f64(b)
+    k, m = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"shape mismatch: {a.shape}ᵀ @ {b.shape}")
+    if lib is None:
+        return a.T @ b
+    c = np.zeros((m, n), dtype=np.float64)
+    rc = lib.tpuml_dgemm_b(m, n, k, _ptr(a), _ptr(b), _ptr(c))
+    if rc != 0:
+        raise RuntimeError(f"tpuml_dgemm_b failed with code {rc}")
+    return c
+
+
+def spr(x: np.ndarray, packed: Optional[np.ndarray] = None,
+        alpha: float = 1.0) -> np.ndarray:
+    """Packed upper-triangular rank-1 update ``AP += α·x·xᵀ`` (the ``dspr``
+    surface, ``rapidsml_jni.cu:107-170``); column-major packed layout,
+    element (i, j) at ``AP[j(j+1)/2 + i]`` for i ≤ j."""
+    x = _as_f64(x).reshape(-1)
+    n = x.shape[0]
+    plen = n * (n + 1) // 2
+    if packed is None:
+        packed = np.zeros(plen, dtype=np.float64)
+    else:
+        if not (
+            isinstance(packed, np.ndarray)
+            and packed.dtype == np.float64
+            and packed.flags.c_contiguous
+        ):
+            # A silent ascontiguousarray copy would break the documented
+            # in-place semantics (updates landing in a private copy).
+            raise ValueError(
+                "packed must be a C-contiguous float64 array (updated "
+                "in place); got "
+                f"dtype={getattr(packed, 'dtype', type(packed).__name__)}"
+            )
+        if packed.shape != (plen,):
+            raise ValueError(
+                f"packed length {packed.shape} does not match n={n} "
+                f"(expected {plen})"
+            )
+    lib = load()
+    if lib is None:
+        rows, cs = np.triu_indices(n)
+        packed[cs * (cs + 1) // 2 + rows] += alpha * x[rows] * x[cs]
+        return packed
+    rc = lib.tpuml_dspr(n, float(alpha), _ptr(x), _ptr(packed))
+    if rc != 0:
+        raise RuntimeError(f"tpuml_dspr failed with code {rc}")
+    return packed
 
 
 def syevd(a: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

@@ -103,3 +103,38 @@ def test_host_pca_path_uses_native(rng):
     np.testing.assert_allclose(model.explained_variance, evr, atol=1e-5)
     # native trace ranges were recorded for the host phases
     assert native.trace_event_count() > events_before
+
+
+def test_gemm_b_matches_numpy(rng):
+    # dgemm_b parity: C = AᵀB with alpha=1/beta=0 (rapidsml_jni.cu:260-336).
+    a = rng.normal(size=(19, 7))
+    b = rng.normal(size=(19, 5))
+    np.testing.assert_allclose(native.gemm_b(a, b), a.T @ b, atol=1e-12)
+
+
+def test_gemm_b_shape_mismatch(rng):
+    with pytest.raises(ValueError, match="shape mismatch"):
+        native.gemm_b(np.ones((3, 4)), np.ones((5, 2)))
+
+
+def test_spr_accumulates_outer_product(rng):
+    # dspr parity (rapidsml_jni.cu:107-170): packed upper-triangular
+    # rank-1 updates sum to the Gram matrix.
+    from spark_rapids_ml_tpu.linalg import triu_to_full
+
+    x = rng.normal(size=(12, 6))
+    packed = None
+    for row in x:
+        packed = native.spr(row, packed)
+    np.testing.assert_allclose(triu_to_full(6, packed), x.T @ x, atol=1e-11)
+
+
+def test_spr_alpha_and_length_check(rng):
+    v = rng.normal(size=4)
+    packed = native.spr(v, alpha=2.5)
+    from spark_rapids_ml_tpu.linalg import triu_to_full
+
+    np.testing.assert_allclose(triu_to_full(4, packed), 2.5 * np.outer(v, v),
+                               atol=1e-12)
+    with pytest.raises(ValueError, match="packed length"):
+        native.spr(v, np.zeros(11))
