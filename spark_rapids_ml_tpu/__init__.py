@@ -31,6 +31,7 @@ from spark_rapids_ml_tpu.models.linear_regression import (  # noqa: F401
     LinearRegression,
     LinearRegressionModel,
 )
+from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, Vectors  # noqa: F401
 
 __all__ = [
@@ -40,6 +41,8 @@ __all__ = [
     "KMeansModel",
     "LinearRegression",
     "LinearRegressionModel",
+    "Pipeline",
+    "PipelineModel",
     "DenseVector",
     "SparseVector",
     "Vectors",

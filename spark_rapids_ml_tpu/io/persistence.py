@@ -50,6 +50,8 @@ _SPARK_CLASS_ALIASES = {
     "KMeansModel": "org.apache.spark.ml.clustering.KMeansModel",
     "LinearRegression": "org.apache.spark.ml.regression.LinearRegression",
     "LinearRegressionModel": "org.apache.spark.ml.regression.LinearRegressionModel",
+    "Pipeline": "org.apache.spark.ml.Pipeline",
+    "PipelineModel": "org.apache.spark.ml.PipelineModel",
 }
 
 

@@ -4,6 +4,7 @@ from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegression,
     LinearRegressionModel,
 )
+from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
 
 __all__ = [
     "PCA",
@@ -12,4 +13,6 @@ __all__ = [
     "KMeansModel",
     "LinearRegression",
     "LinearRegressionModel",
+    "Pipeline",
+    "PipelineModel",
 ]

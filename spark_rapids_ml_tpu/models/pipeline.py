@@ -1,0 +1,163 @@
+"""Pipeline / PipelineModel — chained estimators and transformers.
+
+The reference is consumed through Spark ML Pipelines (its PCA is "a drop-in
+replacement ... same Estimator/Model API", ``README.md:12-28``), so a user
+switching here expects the same chaining surface:
+``Pipeline(stages=[pca, linreg]).fit(df).transform(df)``.
+
+Spark semantics (``org.apache.spark.ml.Pipeline``): ``fit`` walks the
+stages in order — an Estimator is fitted and (if later stages need its
+output) the fitted model transforms the running dataset; a Transformer
+just transforms. The result is a ``PipelineModel`` holding only
+transformers. Persistence mirrors Spark's layout: pipeline metadata plus
+one subdirectory per stage under ``stages/``, each stage in its own
+standard metadata+data format.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from spark_rapids_ml_tpu.models.params import Params
+
+
+def _is_estimator(stage) -> bool:
+    """Estimators carry ``fit``; fitted models / transformers don't."""
+    return hasattr(stage, "fit")
+
+
+def _save_stage(stage, path: str) -> None:
+    stage.save(path, overwrite=True)
+
+
+def _load_stage(path: str):
+    """Generic stage loader: resolve the concrete class recorded in the
+    stage's metadata (``pythonClass``) and delegate to its ``load``."""
+    import importlib
+
+    from spark_rapids_ml_tpu.io.persistence import _read_metadata
+
+    meta = _read_metadata(path)
+    dotted = meta.get("pythonClass") or meta["class"]
+    module_name, cls_name = dotted.rsplit(".", 1)
+    cls = getattr(importlib.import_module(module_name), cls_name)
+    return cls.load(path)
+
+
+class Pipeline(Params):
+    """``Pipeline(stages=[...]).fit(df) -> PipelineModel``."""
+
+    def __init__(self, stages: Optional[List] = None, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self._stages: List = list(stages) if stages else []
+
+    def setStages(self, stages: List) -> "Pipeline":
+        self._stages = list(stages)
+        return self
+
+    def getStages(self) -> List:
+        return list(self._stages)
+
+    set_stages = setStages
+    get_stages = getStages
+
+    def _copy_internal_state(self, other: "Pipeline") -> None:
+        other._stages = list(self._stages)
+
+    def fit(self, dataset) -> "PipelineModel":
+        transformers: List = []
+        df = dataset
+        # Spark's indexOfLastEstimator rule: the running dataset is only
+        # transformed up to the last estimator; trailing transformers are
+        # appended without a wasted pass during fit.
+        last_est = max(
+            (i for i, s in enumerate(self._stages) if _is_estimator(s)),
+            default=-1,
+        )
+        for i, stage in enumerate(self._stages):
+            if _is_estimator(stage):
+                model = stage.fit(df)
+                transformers.append(model)
+                if i < last_est:
+                    df = model.transform(df)
+            else:
+                transformers.append(stage)
+                if i < last_est:
+                    df = stage.transform(df)
+        model = PipelineModel(stages=transformers)
+        model.uid = self.uid
+        return model
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path: str, overwrite: bool = False) -> None:
+        _save_pipeline_like(self, self._stages, path, overwrite)
+
+    @staticmethod
+    def load(path: str) -> "Pipeline":
+        uid, stages = _load_pipeline_like(path, expect="Pipeline")
+        out = Pipeline(stages=stages)
+        out.uid = uid
+        return out
+
+
+class PipelineModel(Params):
+    """A fitted pipeline: transformers applied in sequence."""
+
+    def __init__(self, stages: Optional[List] = None, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self._stages: List = list(stages) if stages else []
+
+    @property
+    def stages(self) -> List:
+        return list(self._stages)
+
+    def _copy_internal_state(self, other: "PipelineModel") -> None:
+        other._stages = list(self._stages)
+
+    def transform(self, dataset):
+        df = dataset
+        for stage in self._stages:
+            df = stage.transform(df)
+        return df
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        _save_pipeline_like(self, self._stages, path, overwrite)
+
+    @staticmethod
+    def load(path: str) -> "PipelineModel":
+        uid, stages = _load_pipeline_like(path, expect="PipelineModel")
+        out = PipelineModel(stages=stages)
+        out.uid = uid
+        return out
+
+
+def _save_pipeline_like(obj, stages, path: str, overwrite: bool) -> None:
+    from spark_rapids_ml_tpu.io.persistence import _require_target, _write_metadata
+
+    _require_target(path, overwrite)
+    cls = f"{type(obj).__module__}.{type(obj).__qualname__}"
+    # Spark stores the stage uids in metadata and each stage under
+    # stages/<index>_<uid>/ — same layout here, with one shared fallback
+    # so the metadata uid always matches the directory name.
+    uids = [getattr(s, "uid", f"stage_{i}") for i, s in enumerate(stages)]
+    _write_metadata(path, cls, obj.uid, {"stageUids": uids})
+    for i, (stage, uid) in enumerate(zip(stages, uids)):
+        _save_stage(stage, os.path.join(path, "stages", f"{i}_{uid}"))
+
+
+def _load_pipeline_like(path: str, expect: str):
+    from spark_rapids_ml_tpu.io.persistence import _read_metadata
+
+    meta = _read_metadata(path)
+    cls = meta.get("pythonClass", meta.get("class", ""))
+    if cls.rsplit(".", 1)[-1] != expect:
+        raise ValueError(f"{path!r} holds {cls!r}, expected a {expect}")
+    stages_dir = os.path.join(path, "stages")
+    stage_dirs = []
+    if os.path.isdir(stages_dir):
+        stage_dirs = sorted(
+            os.listdir(stages_dir), key=lambda d: int(d.split("_", 1)[0])
+        )
+    stages = [_load_stage(os.path.join(stages_dir, d)) for d in stage_dirs]
+    return meta["uid"], stages

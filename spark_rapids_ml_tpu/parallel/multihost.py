@@ -1,0 +1,162 @@
+"""Multi-host runtime: process initialization and global-mesh construction.
+
+The reference's "multi-node" story is Spark's: executors each own one GPU,
+all cross-node communication is Spark RPC (driver-side ``reduce`` of n×n
+partials, ``RapidsRowMatrix.scala:202``), and device assignment comes from
+``spark.executor.resource.gpu`` with a discovery script (``README.md:81-89``).
+The TPU-native replacement: every host runs one process, processes join a
+PJRT coordination service (``jax.distributed.initialize``), and XLA compiles
+collectives over ICI within a slice / DCN across slices. The data plane
+(Spark, Ray, a queue) only feeds each host its row shard and triggers the
+same compiled program everywhere — it never moves tensors.
+
+Configuration resolution order mirrors the reference's two-level config
+(Spark conf → task resources): explicit arguments, then
+``SPARK_RAPIDS_ML_TPU_COORDINATOR``/``_NUM_PROCESSES``/``_PROCESS_ID`` env
+vars, then the TPU pod metadata JAX discovers natively (on Cloud TPU,
+``initialize()`` needs no arguments at all).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+_ENV_COORD = "SPARK_RAPIDS_ML_TPU_COORDINATOR"
+_ENV_NPROC = "SPARK_RAPIDS_ML_TPU_NUM_PROCESSES"
+_ENV_PID = "SPARK_RAPIDS_ML_TPU_PROCESS_ID"
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join (or skip joining) the multi-host runtime. Idempotent.
+
+    Returns True when running multi-host after the call, False for
+    single-host (no coordinator configured anywhere — the common local
+    case, where calling ``jax.distributed.initialize`` would fail).
+    """
+    global _initialized
+    import jax
+
+    # Idempotency check must NOT touch backend-initializing APIs
+    # (jax.process_count() would create the backend and make a later
+    # initialize() impossible); is_initialized() only reads client state.
+    if _initialized or jax.distributed.is_initialized():
+        _initialized = True
+        return jax.process_count() > 1
+
+    coordinator_address = coordinator_address or os.environ.get(_ENV_COORD)
+    if num_processes is None and os.environ.get(_ENV_NPROC):
+        num_processes = int(os.environ[_ENV_NPROC])
+    if process_id is None and os.environ.get(_ENV_PID):
+        process_id = int(os.environ[_ENV_PID])
+
+    # Pod metadata indicates a real multi-worker job only when more than
+    # one worker hostname is listed (single-chip PJRT plugins also set
+    # TPU_WORKER_HOSTNAMES, to "localhost").
+    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    on_multiworker_pod = (
+        len([w for w in workers.split(",") if w.strip()]) > 1
+        or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+    )
+    if coordinator_address is None and not on_multiworker_pod:
+        return False
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # Backend already initialized (a JAX call ran first). With an
+        # explicit coordinator this is a real misuse — surface it; from
+        # ambient pod metadata it just means single-process mode.
+        if coordinator_address is not None:
+            raise
+        return False
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def global_data_mesh():
+    """1-D ``data`` mesh over ALL devices across hosts.
+
+    Device order follows ``jax.devices()`` (grouped by process), so each
+    host's addressable shard of a mesh-sharded array corresponds to its
+    local chips — the property ``host_local_shard`` relies on.
+    """
+    import jax
+
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (DATA_AXIS,))
+
+
+def process_info() -> dict:
+    """Who am I in the job? (for logging / data-plane partition routing)."""
+    import jax
+
+    return {
+        "process_id": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def host_local_shard(
+    n_rows: int,
+    process_id: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> slice:
+    """The half-open row range this host should load, splitting ``n_rows``
+    as evenly as possible across processes (earlier processes take the
+    remainder — same convention as ``np.array_split``). ``process_id``/
+    ``process_count`` default to the runtime's values.
+
+    This is the data-plane contract: each host loads ONLY its slice, then
+    the sharded fit runs one compiled program over the global mesh with
+    ``jax.make_array_from_process_local_data``-style placement.
+    """
+    if process_id is None or process_count is None:
+        import jax
+
+        process_id = jax.process_index() if process_id is None else process_id
+        process_count = (
+            jax.process_count() if process_count is None else process_count
+        )
+    pid, pcount = process_id, process_count
+    base, rem = divmod(n_rows, pcount)
+    start = pid * base + min(pid, rem)
+    stop = start + base + (1 if pid < rem else 0)
+    return slice(start, stop)
+
+
+def make_global_array(local_rows: np.ndarray, mesh, n_global_rows: int):
+    """Assemble a globally-sharded array from per-process local rows.
+
+    Single-process: a plain ``device_put`` with the mesh sharding.
+    Multi-process: ``jax.make_array_from_process_local_data``, which places
+    each host's rows on its local chips without any cross-host copy.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, local_rows, (n_global_rows,) + local_rows.shape[1:]
+    )

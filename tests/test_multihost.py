@@ -1,0 +1,53 @@
+"""Multi-host runtime helpers (single-process semantics + shard math).
+
+True multi-host needs multiple coordinated processes; here we verify the
+single-process behavior (no-op initialize, correct shard arithmetic, global
+mesh + array assembly over the 8 virtual devices) — the same posture as the
+reference's tests, which exercise the partial-aggregate logic with in-JVM
+partitions rather than a real cluster (SURVEY.md §4).
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu.parallel import distributed_pca_fit
+from spark_rapids_ml_tpu.parallel.multihost import (
+    global_data_mesh,
+    host_local_shard,
+    initialize_multihost,
+    make_global_array,
+    process_info,
+)
+
+
+def test_initialize_single_host_is_noop():
+    assert initialize_multihost() is False
+    info = process_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] == 8
+
+
+def test_host_local_shard_partitions_all_rows():
+    s = host_local_shard(103)
+    assert s == slice(0, 103)  # single process takes everything
+
+
+def test_host_local_shard_math():
+    # Drive the real function with explicit pid/pcount (the in-test runtime
+    # is single-process): 4 processes over 10 rows → 3,3,2,2, contiguous.
+    slices = [host_local_shard(10, p, 4) for p in range(4)]
+    assert [s.stop - s.start for s in slices] == [3, 3, 2, 2]
+    assert slices[0].start == 0 and slices[-1].stop == 10
+    for a, b in zip(slices, slices[1:]):
+        assert a.stop == b.start
+
+
+def test_global_mesh_and_array_assembly(rng):
+    mesh = global_data_mesh()
+    assert mesh.devices.size == 8
+    x = rng.normal(size=(16, 4))
+    arr = make_global_array(x, mesh, 16)
+    assert arr.shape == (16, 4)
+    np.testing.assert_allclose(np.asarray(arr), x)
+    # and the global mesh drives the standard distributed fit
+    res = distributed_pca_fit(x, 2, mesh)
+    assert np.asarray(res.components).shape == (4, 2)

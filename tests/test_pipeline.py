@@ -1,0 +1,126 @@
+"""Pipeline / PipelineModel: chaining, fit semantics, persistence.
+
+The reference is used through Spark ML Pipelines (drop-in Estimator/Model,
+``README.md:12-28``); these tests cover the chaining surface a migrating
+user relies on.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    LinearRegression,
+    PCA,
+    PCAModel,
+    Pipeline,
+    PipelineModel,
+    Vectors,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def make_frame(rng, n=80, d=10):
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = x @ w + 0.1 * rng.normal(size=n)
+    return VectorFrame({"features": x, "label": list(y)}), x, y
+
+
+def test_fit_chains_estimators(rng):
+    frame, x, y = make_frame(rng)
+    pca = PCA().setK(6).setOutputCol("pca_features")
+    lr = (
+        LinearRegression()
+        .setInputCol("pca_features")
+        .setLabelCol("label")
+        .setRegParam(0.01)
+    )
+    model = Pipeline(stages=[pca, lr]).fit(frame)
+    assert isinstance(model, PipelineModel)
+    assert len(model.stages) == 2
+    assert isinstance(model.stages[0], PCAModel)
+    out = model.transform(frame)
+    pred = np.asarray(out.column("prediction"))
+    assert pred.shape == (len(frame),)
+    # projecting to 6 of 10 dims still predicts decently on low-noise data
+    resid = pred - y
+    assert float((resid**2).mean()) < float((y**2).mean())
+
+
+def test_transformer_stage_passthrough(rng):
+    frame, x, _ = make_frame(rng)
+    # A fitted model used as a pure transformer stage inside a pipeline.
+    pca_model = PCA().setK(4).setOutputCol("p4").fit(frame)
+    lr = LinearRegression().setInputCol("p4").setLabelCol("label")
+    model = Pipeline(stages=[pca_model, lr]).fit(frame)
+    assert model.stages[0] is pca_model
+    out = model.transform(frame)
+    assert "prediction" in out.columns
+
+
+def test_empty_pipeline_is_identity(rng):
+    frame, _, _ = make_frame(rng)
+    out = Pipeline(stages=[]).fit(frame).transform(frame)
+    assert out is frame
+
+
+def test_pipeline_model_persistence_roundtrip(tmp_path, rng):
+    frame, _, _ = make_frame(rng)
+    pca = PCA().setK(5).setOutputCol("pca_features")
+    lr = (
+        LinearRegression()
+        .setInputCol("pca_features")
+        .setLabelCol("label")
+        .setRegParam(0.02)
+    )
+    model = Pipeline(stages=[pca, lr]).fit(frame)
+    path = str(tmp_path / "pipe_model")
+    model.save(path)
+    loaded = PipelineModel.load(path)
+    assert loaded.uid == model.uid
+    assert [type(s).__name__ for s in loaded.stages] == [
+        "PCAModel",
+        "LinearRegressionModel",
+    ]
+    np.testing.assert_allclose(loaded.stages[0].pc, model.stages[0].pc)
+    np.testing.assert_allclose(
+        np.asarray(loaded.transform(frame).column("prediction")),
+        np.asarray(model.transform(frame).column("prediction")),
+        atol=1e-12,
+    )
+
+
+def test_unfitted_pipeline_persistence_roundtrip(tmp_path):
+    pca = PCA().setK(3)
+    lr = LinearRegression().setRegParam(0.5)
+    pipe = Pipeline(stages=[pca, lr])
+    path = str(tmp_path / "pipe")
+    pipe.save(path)
+    loaded = Pipeline.load(path)
+    assert loaded.uid == pipe.uid
+    stages = loaded.getStages()
+    assert [type(s).__name__ for s in stages] == ["PCA", "LinearRegression"]
+    assert stages[0].getK() == 3
+    assert stages[1].getRegParam() == 0.5
+
+
+def test_load_wrong_kind_raises(tmp_path):
+    pipe = Pipeline(stages=[PCA().setK(2)])
+    path = str(tmp_path / "pipe")
+    pipe.save(path)
+    with pytest.raises(ValueError, match="expected a PipelineModel"):
+        PipelineModel.load(path)
+
+
+def test_vector_rows_through_pipeline(rng):
+    # Spark-style row vectors (dense + sparse mixed) feed a pipeline.
+    rows = [
+        Vectors.dense(1.0, 0.0, 3.0),
+        Vectors.sparse(3, [1], [2.0]),
+        Vectors.dense(0.5, 1.5, -1.0),
+        Vectors.sparse(3, [0, 2], [1.0, 1.0]),
+    ] * 5
+    frame = VectorFrame({"features": rows})
+    model = Pipeline(stages=[PCA().setK(2).setOutputCol("out")]).fit(frame)
+    out = model.transform(frame)
+    assert np.asarray(out.column("out")).shape == (20, 2)
