@@ -1,0 +1,50 @@
+"""Build integration: compile the native runtime into the wheel.
+
+The reference's Maven build drives cmake+ninja at the validate phase and
+packages the resulting ``librapidsml_jni.so`` into the jar under
+``native-deps/{os.arch}/{os.name}`` (``/root/reference/pom.xml:337-388``),
+from which a loader extracts it at runtime (``JniRAPIDSML.java:44-57``).
+
+The equivalent here: ``python -m build`` (or ``pip install .``) runs ``make``
+in ``native/`` and ships ``spark_rapids_ml_tpu/_native/libtpuml.so`` inside
+the wheel — which is the first path the ctypes loader probes
+(``spark_rapids_ml_tpu/native.py``). No extraction step is needed because
+Python packages are directories, not jars. A missing C++ toolchain degrades
+to a pure-Python wheel (the runtime then uses its NumPy fallbacks) instead
+of failing the build.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        self._build_native()
+        super().run()
+
+    def _build_native(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        native_dir = os.path.join(here, "native")
+        dest_dir = os.path.join(here, "spark_rapids_ml_tpu", "_native")
+        if not os.path.isfile(os.path.join(native_dir, "Makefile")):
+            return
+        try:
+            subprocess.run(
+                ["make", "-s"], cwd=native_dir, check=True, timeout=600
+            )
+        except Exception as exc:  # toolchain absent → pure-Python wheel
+            print(f"[setup.py] native build skipped: {exc}")
+            return
+        so = os.path.join(native_dir, "build", "libtpuml.so")
+        if os.path.isfile(so):
+            os.makedirs(dest_dir, exist_ok=True)
+            shutil.copy2(so, os.path.join(dest_dir, "libtpuml.so"))
+            print(f"[setup.py] packaged {so} -> {dest_dir}")
+
+
+setup(cmdclass={"build_py": BuildWithNative})
