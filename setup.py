@@ -20,6 +20,18 @@ import subprocess
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.join(_HERE, "native")
+
+
+def _toolchain_present() -> bool:
+    return (
+        os.path.isfile(os.path.join(_NATIVE_DIR, "Makefile"))
+        and shutil.which("make") is not None
+        and shutil.which(os.environ.get("CXX", "g++")) is not None
+    )
 
 
 class BuildWithNative(build_py):
@@ -28,23 +40,29 @@ class BuildWithNative(build_py):
         super().run()
 
     def _build_native(self):
-        here = os.path.dirname(os.path.abspath(__file__))
-        native_dir = os.path.join(here, "native")
-        dest_dir = os.path.join(here, "spark_rapids_ml_tpu", "_native")
-        if not os.path.isfile(os.path.join(native_dir, "Makefile")):
+        dest_dir = os.path.join(_HERE, "spark_rapids_ml_tpu", "_native")
+        if not _toolchain_present():
+            # No compiler → pure-Python install with NumPy fallbacks. A
+            # PRESENT toolchain that fails to compile is a real error and
+            # propagates (CalledProcessError) — silent degradation would
+            # ship wheels missing their native runtime unnoticed.
+            print("[setup.py] no C++ toolchain; building pure-Python")
             return
-        try:
-            subprocess.run(
-                ["make", "-s"], cwd=native_dir, check=True, timeout=600
-            )
-        except Exception as exc:  # toolchain absent → pure-Python wheel
-            print(f"[setup.py] native build skipped: {exc}")
-            return
-        so = os.path.join(native_dir, "build", "libtpuml.so")
-        if os.path.isfile(so):
-            os.makedirs(dest_dir, exist_ok=True)
-            shutil.copy2(so, os.path.join(dest_dir, "libtpuml.so"))
-            print(f"[setup.py] packaged {so} -> {dest_dir}")
+        subprocess.run(
+            ["make", "-s"], cwd=_NATIVE_DIR, check=True, timeout=600
+        )
+        so = os.path.join(_NATIVE_DIR, "build", "libtpuml.so")
+        os.makedirs(dest_dir, exist_ok=True)
+        shutil.copy2(so, os.path.join(dest_dir, "libtpuml.so"))
+        print(f"[setup.py] packaged {so} -> {dest_dir}")
 
 
-setup(cmdclass={"build_py": BuildWithNative})
+class NativeDistribution(Distribution):
+    def has_ext_modules(self):
+        # Wheels that embed libtpuml.so are platform-specific and must not
+        # be tagged py3-none-any; report ext modules whenever the native
+        # build will run.
+        return _toolchain_present()
+
+
+setup(cmdclass={"build_py": BuildWithNative}, distclass=NativeDistribution)

@@ -1,0 +1,103 @@
+"""Randomized top-k eigensolver (subspace iteration) for large covariances.
+
+The reference's eigensolve is a dense full-spectrum ``syevd`` on the driver
+GPU (``/root/reference/native/src/rapidsml_jni.cu:338-392``), which caps the
+feature dimension at whatever one device can factorize. For PCA only the top
+k eigenpairs are needed; randomized subspace iteration (Halko-Martinsson-
+Tropp) gets them with a handful of tall-skinny matmuls — MXU-friendly,
+O(n²·l) instead of O(n³), and the only primitive it needs from the matrix is
+``v ↦ Cov·v``. That matvec abstraction is what lets the same solver run on a
+replicated covariance (here) or on a feature-sharded covariance where no
+device ever holds the full n×n (``parallel/feature_sharded.py``) — the
+"feature-dimension scaling" answer sketched in SURVEY.md §5.
+
+All iteration counts are static, so the whole solve jit-compiles into one
+XLA program (QR + matmul chain) with no host round trips.
+
+Accuracy caveat (inherent to randomized methods, same as sklearn's
+``svd_solver='randomized'``): individual eigenvectors converge at a rate set
+by the gaps between consecutive eigenvalues. On decaying spectra — the
+regime where PCA is meaningful — a few power iterations reach oracle
+accuracy (see tests/test_feature_sharded.py). On near-degenerate spectra
+(e.g. isotropic noise) the top-k SUBSPACE is still captured but individual
+vectors within a degenerate cluster are arbitrary rotations of each other;
+use the dense ``eigh`` solver when exact per-vector parity on gapless
+spectra matters.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.eigh import eigh_descending, sign_flip
+
+
+def subspace_iteration(
+    matvec: Callable[[jnp.ndarray], jnp.ndarray],
+    n: int,
+    l: int,
+    n_iter: int,
+    key: jax.Array,
+    dtype,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-l eigenpairs of a symmetric PSD operator given only its matvec.
+
+    ``matvec`` maps an (n, l) block to Cov @ block (full rows, whatever the
+    caller's covariance layout). Returns (evals[l] descending, evecs[n, l]).
+    QR re-orthonormalization every step keeps the power iteration stable at
+    f32; the Rayleigh-Ritz projection B = QᵀCovQ recovers the eigenvalues.
+    """
+    omega = jax.random.normal(key, (n, l), dtype=dtype)
+    y = matvec(omega)
+    for _ in range(max(n_iter, 0)):
+        q, _ = jnp.linalg.qr(y)
+        y = matvec(q)
+    q, _ = jnp.linalg.qr(y)
+    b = q.T @ matvec(q)
+    b = (b + b.T) / 2  # exact symmetry for eigh
+    evals, vecs = eigh_descending(b)
+    return evals, q @ vecs
+
+
+def topk_from_subspace(
+    evals: jnp.ndarray,
+    evecs: jnp.ndarray,
+    k: int,
+    total_variance: jnp.ndarray,
+    flip_signs: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared postprocessing for randomized solves: sign-flip, top-k
+    truncation, λ/Σλ with clamped Rayleigh-Ritz eigenvalues.
+
+    ``total_variance`` (= trace(Cov)) is passed in rather than derived so the
+    λ/Σλ denominator stays EXACT while the λᵢ are estimates — sharded
+    callers compute the trace with a cheap collective. One implementation so
+    the replicated and sharded paths cannot drift.
+    """
+    if flip_signs:
+        evecs = sign_flip(evecs)
+    lam = jnp.maximum(evals[:k], 0.0)
+    evr = lam / jnp.where(total_variance > 0, total_variance, 1.0)
+    return evecs[:, :k], evr
+
+
+def randomized_pca_from_covariance(
+    cov: jnp.ndarray,
+    k: int,
+    total_variance: jnp.ndarray,
+    oversample: int = 10,
+    n_iter: int = 4,
+    seed: int = 0,
+    flip_signs: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(components[n, k], explained_variance_ratio[k]) from a replicated
+    covariance, without factorizing the full spectrum."""
+    n = cov.shape[0]
+    l = min(k + oversample, n)
+    evals, evecs = subspace_iteration(
+        lambda v: cov @ v, n, l, n_iter, jax.random.PRNGKey(seed), cov.dtype
+    )
+    return topk_from_subspace(evals, evecs, k, total_variance, flip_signs)

@@ -11,6 +11,10 @@ from spark_rapids_ml_tpu.parallel.distributed_linreg import (
     distributed_linreg_fit,
     distributed_linreg_fit_kernel,
 )
+from spark_rapids_ml_tpu.parallel.feature_sharded import (
+    feature_sharded_covariance_kernel,
+    feature_sharded_pca_fit,
+)
 
 __all__ = [
     "data_mesh",
@@ -22,4 +26,6 @@ __all__ = [
     "distributed_kmeans_fit_kernel",
     "distributed_linreg_fit",
     "distributed_linreg_fit_kernel",
+    "feature_sharded_covariance_kernel",
+    "feature_sharded_pca_fit",
 ]

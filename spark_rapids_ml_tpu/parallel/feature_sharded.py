@@ -1,0 +1,296 @@
+"""Feature-sharded PCA over a 2-D (data × feature) mesh.
+
+The reference caps the feature dimension twice: the spr path's packed
+triangle overflows past 65,535 columns (``RapidsRowMatrix.scala:147,204-206``)
+and every path materializes the full n×n covariance on ONE device for the
+eigensolve (driver GPU, ``RapidsRowMatrix.scala:94-95``). SURVEY.md §5 names
+the TPU-native answer: shard the n×n Gram across the mesh. This module is
+that path — the workload's analogue of sequence/context parallelism, with
+the same communication shape as ring attention: block-resident operands
+rotate around a ring while each device accumulates its output block.
+
+Layout. Rows shard over the ``data`` axis, columns over ``feature``; device
+(d, f) holds an (m/D, n/F) tile of X. The covariance comes out sharded as
+block rows, P(feature, None) — no device ever holds all of it.
+
+Schedules for the n_loc×n block-row Gram:
+
+* ``ring``: F−1 ``ppermute`` hops around the feature axis; each step one
+  (n_loc × m_loc)·(m_loc × n_loc) MXU matmul against the tile currently in
+  flight. Peak extra memory = ONE remote tile; XLA overlaps the permute with
+  the matmul. This is the long-feature scaling path.
+* ``allgather``: one ``all_gather`` of the row-shard's full width, then a
+  single big matmul. Fewer, larger ops; peak memory F× the tile. Better when
+  the tiles are small and ICI latency dominates.
+
+Solvers on the sharded covariance:
+
+* ``eigh``: all-gather the (small enough) covariance and factorize
+  replicated — the parity-exact dense path.
+* ``randomized``: subspace iteration where the matvec keeps the covariance
+  sharded (local block-row matmul + all-gather of the thin (n, l) iterate)
+  — full n×n never exists on any device; this is the n ≫ device-memory
+  regime (``ops/randomized.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
+from spark_rapids_ml_tpu.ops.randomized import (
+    subspace_iteration,
+    topk_from_subspace,
+)
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FEATURE_AXIS,
+    pad_rows_to_multiple,
+)
+
+
+class FeatureShardedPCAResult(NamedTuple):
+    components: jnp.ndarray
+    explained_variance: jnp.ndarray
+    mean: jnp.ndarray
+
+
+def _block_row_gram(xc: jnp.ndarray, schedule: str) -> jnp.ndarray:
+    """This device's (n_loc × n_total) block row of XᵀX over the feature ring.
+
+    ``xc`` is the local (m_loc × n_loc) tile, already centered and scaled.
+    Runs inside shard_map; communication is over the ``feature`` axis only.
+    """
+    F = lax.axis_size(FEATURE_AXIS)
+    j = lax.axis_index(FEATURE_AXIS)
+    n_loc = xc.shape[1]
+    if schedule == "allgather":
+        x_full = lax.all_gather(xc, FEATURE_AXIS, axis=1, tiled=True)
+        return lax.dot_general(
+            xc, x_full, (((0,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+        )
+    # ring: at step t this device holds tile (j+t) mod F and fills that
+    # column block of its output row; then the tile moves one hop.
+    g_row = jnp.zeros((n_loc, F * n_loc), dtype=xc.dtype)
+    held = xc
+    for t in range(F):
+        blk = lax.dot_general(
+            xc, held, (((0,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+        )
+        col = ((j + t) % F) * n_loc
+        g_row = lax.dynamic_update_slice(
+            g_row, blk, (jnp.zeros((), dtype=col.dtype), col)
+        )
+        if t + 1 < F:
+            held = lax.ppermute(
+                held, FEATURE_AXIS, [(i, (i - 1) % F) for i in range(F)]
+            )
+    return g_row
+
+
+def _sharded_cov_and_mean(x_tile, mask_shard, *, mean_centering, schedule):
+    """Per-device: (block row of Cov, local slice of mean). Collectives:
+    one psum over data for the column stats, the feature-axis schedule for
+    the Gram, one psum over data for the block row."""
+    dtype = x_tile.dtype
+    m = mask_shard[:, None].astype(dtype)
+    local_sum = jnp.sum(x_tile * m, axis=0)
+    local_cnt = jnp.sum(mask_shard).astype(dtype)
+    total_sum, cnt = lax.psum((local_sum, local_cnt), DATA_AXIS)
+    mean_loc = total_sum / cnt if mean_centering else jnp.zeros_like(total_sum)
+    scale = 1.0 / jnp.sqrt(jnp.maximum(cnt - 1.0, 1.0))
+    xc = (x_tile - mean_loc[None, :]) * m * scale
+    g_row = lax.psum(_block_row_gram(xc, schedule), DATA_AXIS)
+    return g_row, mean_loc
+
+
+def _local_trace(g_row: jnp.ndarray) -> jnp.ndarray:
+    """Sum of the global-diagonal entries that land in this block row."""
+    n_loc = g_row.shape[0]
+    j = lax.axis_index(FEATURE_AXIS)
+    start = j * n_loc
+    diag_block = lax.dynamic_slice(
+        g_row, (jnp.zeros((), dtype=start.dtype), start), (n_loc, n_loc)
+    )
+    return jnp.trace(diag_block)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "mean_centering", "schedule"),
+)
+def feature_sharded_covariance_kernel(
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    mean_centering: bool = True,
+    schedule: str = "ring",
+):
+    """Covariance sharded as block rows over ``feature``; mean sharded over
+    ``feature``. One compiled program; partials never touch the host."""
+    fn = jax.shard_map(
+        partial(
+            _sharded_cov_and_mean,
+            mean_centering=mean_centering,
+            schedule=schedule,
+        ),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS)),
+        out_specs=(P(FEATURE_AXIS, None), P(FEATURE_AXIS)),
+    )
+    return fn(x, mask)
+
+
+def _randomized_shard(
+    g_row, *, k, oversample, n_iter, seed, flip_signs
+):
+    """Sharded-matvec subspace iteration (runs inside shard_map over the
+    feature axis; the data axis is already reduced out of ``g_row``).
+
+    The iterate Q (n × l, thin) is replicated; Cov stays sharded: each
+    device multiplies its block row, then an all_gather over ``feature``
+    reassembles the full (n, l) product. QR/eigh on the thin/l×l matrices
+    run replicated — identical on every device, no extra communication.
+    """
+    n_loc, n = g_row.shape
+    l = min(k + oversample, n)
+
+    def matvec(v):
+        y_loc = g_row @ v  # (n_loc, l)
+        return lax.all_gather(y_loc, FEATURE_AXIS, axis=0, tiled=True)
+
+    evals, evecs = subspace_iteration(
+        matvec, n, l, n_iter, jax.random.PRNGKey(seed), g_row.dtype
+    )
+    total_var = lax.psum(_local_trace(g_row), FEATURE_AXIS)
+    return topk_from_subspace(evals, evecs, k, total_var, flip_signs)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "k", "oversample", "n_iter", "seed", "flip_signs"
+    ),
+)
+def randomized_sharded_pca_kernel(
+    g_rows: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    k: int,
+    oversample: int = 10,
+    n_iter: int = 4,
+    seed: int = 0,
+    flip_signs: bool = True,
+):
+    fn = jax.shard_map(
+        partial(
+            _randomized_shard,
+            k=k,
+            oversample=oversample,
+            n_iter=n_iter,
+            seed=seed,
+            flip_signs=flip_signs,
+        ),
+        mesh=mesh,
+        in_specs=(P(FEATURE_AXIS, None),),
+        out_specs=(P(), P()),
+        # Outputs are replicated by construction (thin iterates are
+        # all_gathered, the small eigh runs identically everywhere), but the
+        # static VMA checker cannot infer replication through all_gather.
+        check_vma=False,
+    )
+    return fn(g_rows)
+
+
+# Module-level wrapper so repeated eigh-solver fits hit the jit cache
+# instead of re-tracing per call.
+_jitted_pca_from_covariance = partial(
+    jax.jit, static_argnames=("k", "flip_signs")
+)(pca_from_covariance)
+
+
+def pad_cols_to_multiple(x: np.ndarray, multiple: int) -> np.ndarray:
+    """Zero-pad columns so the feature dim divides the mesh. Zero columns
+    contribute zero mean / zero covariance rows+cols, so they are inert in
+    both solvers; outputs are sliced back to the true width."""
+    rem = (-x.shape[1]) % multiple
+    if rem:
+        x = np.concatenate(
+            [x, np.zeros((x.shape[0], rem), dtype=x.dtype)], axis=1
+        )
+    return x
+
+
+def feature_sharded_pca_fit(
+    x_host: np.ndarray,
+    k: int,
+    mesh: Mesh,
+    mean_centering: bool = True,
+    schedule: str = "ring",
+    solver: str = "eigh",
+    oversample: int = 10,
+    n_iter: int = 4,
+    flip_signs: bool = True,
+    dtype=None,
+    seed: int = 0,
+) -> FeatureShardedPCAResult:
+    """Full fit over a 2-D mesh: pad + place tiles, sharded covariance,
+    then the chosen eigensolver. ``solver='eigh'`` gathers the covariance
+    (exact, parity path); ``solver='randomized'`` keeps it sharded
+    (large-n path)."""
+    if schedule not in ("ring", "allgather"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if solver not in ("eigh", "randomized"):
+        raise ValueError(f"unknown solver {solver!r}")
+    if DATA_AXIS not in mesh.axis_names or FEATURE_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"mesh must have ({DATA_AXIS!r}, {FEATURE_AXIS!r}) axes; "
+            f"got {mesh.axis_names}"
+        )
+    n_data = mesh.shape[DATA_AXIS]
+    n_feature = mesh.shape[FEATURE_AXIS]
+    x_host = np.asarray(x_host)
+    n_rows, n_features = x_host.shape
+    if k > n_features:
+        raise ValueError(
+            f"k = {k} must be at most the number of features {n_features}"
+        )
+    x_padded, mask = pad_rows_to_multiple(x_host, n_data)
+    x_padded = pad_cols_to_multiple(x_padded, n_feature)
+    if dtype is not None:
+        x_padded = x_padded.astype(dtype)
+        mask = mask.astype(dtype)
+    x_dev = jax.device_put(
+        x_padded, NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS))
+    )
+    mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
+    g_rows, mean = feature_sharded_covariance_kernel(
+        x_dev, mask_dev, mesh=mesh,
+        mean_centering=mean_centering, schedule=schedule,
+    )
+    if solver == "randomized":
+        components, evr = randomized_sharded_pca_kernel(
+            g_rows, mesh=mesh, k=k, oversample=oversample,
+            n_iter=n_iter, seed=seed, flip_signs=flip_signs,
+        )
+    else:
+        cov = jnp.asarray(g_rows)[:n_features, :n_features]
+        components, evr = _jitted_pca_from_covariance(
+            cov, k=k, flip_signs=flip_signs
+        )
+    result = FeatureShardedPCAResult(
+        components=components[:n_features],
+        explained_variance=evr,
+        mean=jnp.asarray(mean)[:n_features],
+    )
+    return jax.block_until_ready(result)
