@@ -99,18 +99,21 @@ def _resolve_dtype(dtype_param: str):
 
 
 def _resolve_device(device_id: int):
-    """deviceId −1 ⇒ runtime default, else ordinal — the reference's gpuId
-    discovery semantics (``RapidsRowMatrix.scala:171-175``) without Spark."""
+    """deviceId −1 ⇒ task-assigned resource / env / default 0, else the
+    explicit ordinal — the reference's gpuId discovery semantics
+    (``RapidsRowMatrix.scala:171-175``), with the TaskContext role played by
+    ``utils.resources.resolve_device_ordinal``."""
     import jax
 
+    from spark_rapids_ml_tpu.utils.resources import resolve_device_ordinal
+
     devices = jax.devices()
-    if device_id == -1:
-        return devices[0]
-    if device_id < -1 or device_id >= len(devices):
+    ordinal = resolve_device_ordinal(device_id)
+    if ordinal < 0 or ordinal >= len(devices):
         raise ValueError(
-            f"deviceId {device_id} out of range: {len(devices)} devices visible"
+            f"deviceId {ordinal} out of range: {len(devices)} devices visible"
         )
-    return devices[device_id]
+    return devices[ordinal]
 
 
 class PCA(PCAParams):

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# TPU resource discovery script for Spark executors — the analogue of the
+# getGpusResources.sh the reference's README points executors at
+# (/root/reference/README.md:87-88). Configure with:
+#   spark.executor.resource.tpu.discoveryScript=/path/to/get_tpus_resources.sh
+# Prints one Spark ResourceInformation JSON line, e.g.
+#   {"name": "tpu", "addresses": ["0", "1", "2", "3"]}
+set -euo pipefail
+
+# Fast paths that need no Python: explicit pinning env, then device nodes.
+if [[ -n "${TPU_VISIBLE_CHIPS:-}" || -n "${TPU_VISIBLE_DEVICES:-}" ]]; then
+  CHIPS="${TPU_VISIBLE_CHIPS:-${TPU_VISIBLE_DEVICES}}"
+  ADDRS=$(echo "$CHIPS" | tr ',' '\n' | sed 's/^ *//; s/ *$//' | grep -v '^$' \
+    | sed 's/.*/"&"/' | paste -sd, -)
+  echo "{\"name\": \"tpu\", \"addresses\": [${ADDRS}]}"
+  exit 0
+fi
+
+shopt -s nullglob
+NODES=(/dev/accel[0-9]*)
+if [[ ${#NODES[@]} -gt 0 ]]; then
+  ADDRS=$(printf '%s\n' "${NODES[@]}" | sed 's|/dev/accel||' | sort -n \
+    | sed 's/.*/"&"/' | paste -sd, -)
+  echo "{\"name\": \"tpu\", \"addresses\": [${ADDRS}]}"
+  exit 0
+fi
+
+# Last resort: ask the Python runtime (initializes the JAX backend).
+exec python3 -c 'from spark_rapids_ml_tpu.utils.resources import discovery_json; print(discovery_json(probe_jax=True))'

@@ -122,6 +122,29 @@ def test_randomized_solver_general_spectrum(rng):
     )
 
 
+def test_randomized_replicated_matches_sharded(rng):
+    # The single-device entry point shares subspace_iteration +
+    # topk_from_subspace with the sharded kernel; same data → same result.
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.randomized import (
+        randomized_pca_from_covariance,
+    )
+
+    n, k = 16, 3
+    basis, _ = np.linalg.qr(rng.normal(size=(n, n)))
+    x = rng.normal(size=(200, n)) @ (basis * np.exp(-np.arange(n) * 0.7))
+    xc = x - x.mean(axis=0)
+    cov = xc.T @ xc / (x.shape[0] - 1)
+    pc_rep, evr_rep = randomized_pca_from_covariance(
+        jnp.asarray(cov), k, jnp.trace(jnp.asarray(cov)),
+        oversample=10, n_iter=6,
+    )
+    pc, evr, _ = numpy_pca_oracle(x, k)
+    np.testing.assert_allclose(np.asarray(pc_rep), pc, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(evr_rep), evr, atol=1e-8)
+
+
 def test_feature_sharded_validations(rng):
     x = rng.normal(size=(10, 4))
     mesh = grid_mesh(2, 2)

@@ -1,0 +1,110 @@
+"""Resource discovery / device-assignment parity
+(``spark.executor.resource.tpu.*`` + discovery script, SURVEY.md §5)."""
+
+import json
+import os
+import stat
+import subprocess
+
+import pytest
+
+from spark_rapids_ml_tpu.utils.resources import (
+    DISCOVERY_SCRIPT_KEY,
+    EXECUTOR_AMOUNT_KEY,
+    TASK_AMOUNT_KEY,
+    ResourceConf,
+    ResourceInformation,
+    discover_tpu_addresses,
+    discovery_json,
+    resolve_device_ordinal,
+)
+
+from spark_rapids_ml_tpu.utils.resources import discovery_script_path
+
+SCRIPT = discovery_script_path()
+
+
+def test_resource_information_roundtrip():
+    info = ResourceInformation("tpu", ["0", "1"])
+    back = ResourceInformation.from_json(info.to_json())
+    assert back == info
+    with pytest.raises(ValueError):
+        ResourceInformation.from_json('{"name": "tpu"}')
+
+
+def test_conf_from_properties_and_accessors():
+    conf = ResourceConf.from_properties(
+        """
+        # spark-defaults.conf style
+        spark.task.resource.tpu.amount 0.25
+        spark.executor.resource.tpu.amount=4
+        spark.executor.resource.tpu.discoveryScript /opt/get_tpus_resources.sh
+        """
+    )
+    assert conf.task_tpu_amount() == 0.25
+    assert conf.executor_tpu_amount() == 4
+    assert conf.discovery_script() == "/opt/get_tpus_resources.sh"
+    assert conf.get("missing.key") is None
+    empty = ResourceConf()
+    assert empty.task_tpu_amount() == 0.0
+    assert empty.executor_tpu_amount() == 0
+
+
+def test_conf_values_containing_equals():
+    # split must happen at the FIRST separator: values with '=' survive
+    conf = ResourceConf.from_properties(
+        "spark.executor.extraJavaOptions=-Dfoo=bar -Dbaz=qux"
+    )
+    assert (
+        conf.get("spark.executor.extraJavaOptions") == "-Dfoo=bar -Dbaz=qux"
+    )
+
+
+def test_conf_keys_mirror_reference_naming():
+    # one-import-change parity: same key shape as the reference README's
+    # spark.{task,executor}.resource.gpu.* with gpu → tpu
+    assert TASK_AMOUNT_KEY == "spark.task.resource.tpu.amount"
+    assert EXECUTOR_AMOUNT_KEY == "spark.executor.resource.tpu.amount"
+    assert DISCOVERY_SCRIPT_KEY == "spark.executor.resource.tpu.discoveryScript"
+
+
+def test_discover_addresses_env_pinning(monkeypatch):
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "2, 3")
+    assert discover_tpu_addresses() == ["2", "3"]
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS")
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "0")
+    assert discover_tpu_addresses() == ["0"]
+
+
+def test_discovery_json_contract(monkeypatch):
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3")
+    obj = json.loads(discovery_json())
+    assert obj == {"name": "tpu", "addresses": ["0", "1", "2", "3"]}
+
+
+def test_resolve_device_ordinal_precedence():
+    # explicit deviceId wins (gpuId != -1 semantics)
+    assert resolve_device_ordinal(3) == 3
+    # task resources next (TaskContext.resources()("gpu").addresses(0))
+    res = {"tpu": ResourceInformation("tpu", ["5", "6"])}
+    assert resolve_device_ordinal(-1, task_resources=res) == 5
+    assert resolve_device_ordinal(2, task_resources=res) == 2
+    # env var next, then default 0
+    assert (
+        resolve_device_ordinal(-1, env={"SPARK_RAPIDS_ML_TPU_DEVICE": "7"})
+        == 7
+    )
+    assert resolve_device_ordinal(-1, env={}) == 0
+
+
+def test_discovery_script_executable_and_output():
+    assert os.access(SCRIPT, os.X_OK), "discovery script must be executable"
+    mode = os.stat(SCRIPT).st_mode
+    assert mode & stat.S_IXUSR
+    env = dict(os.environ, TPU_VISIBLE_CHIPS="0,1")
+    out = subprocess.run(
+        [SCRIPT], capture_output=True, text=True, env=env, timeout=30
+    )
+    assert out.returncode == 0, out.stderr
+    obj = json.loads(out.stdout.strip())
+    assert obj == {"name": "tpu", "addresses": ["0", "1"]}
