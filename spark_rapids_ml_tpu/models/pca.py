@@ -107,13 +107,24 @@ def _resolve_device(device_id: int):
 
     from spark_rapids_ml_tpu.utils.resources import resolve_device_ordinal
 
-    devices = jax.devices()
+    devices = jax.local_devices()
     ordinal = resolve_device_ordinal(device_id)
-    if ordinal < 0 or ordinal >= len(devices):
-        raise ValueError(
-            f"deviceId {ordinal} out of range: {len(devices)} devices visible"
-        )
-    return devices[ordinal]
+    # Addresses name chips, not list positions: match by device.id first
+    # (JAX's stable chip id, correct on multi-host where jax.devices() spans
+    # hosts), then positionally; a pinned executor (TPU_VISIBLE_CHIPS="2")
+    # re-enumerates its single visible device, so the assigned address maps
+    # to the only device present.
+    for d in devices:
+        if d.id == ordinal:
+            return d
+    if 0 <= ordinal < len(devices):
+        return devices[ordinal]
+    if len(devices) == 1:
+        return devices[0]
+    raise ValueError(
+        f"deviceId {ordinal} matches none of the {len(devices)} visible "
+        f"local devices (ids {[d.id for d in devices]})"
+    )
 
 
 class PCA(PCAParams):

@@ -49,6 +49,7 @@ from spark_rapids_ml_tpu.ops.randomized import (
     subspace_iteration,
     topk_from_subspace,
 )
+from spark_rapids_ml_tpu.ops.covariance import DEFAULT_GRAM_PRECISION
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     FEATURE_AXIS,
@@ -75,7 +76,7 @@ def _block_row_gram(xc: jnp.ndarray, schedule: str) -> jnp.ndarray:
         x_full = lax.all_gather(xc, FEATURE_AXIS, axis=1, tiled=True)
         return lax.dot_general(
             xc, x_full, (((0,), (0,)), ((), ())),
-            precision=lax.Precision.HIGHEST,
+            precision=DEFAULT_GRAM_PRECISION,
         )
     # ring: at step t this device holds tile (j+t) mod F and fills that
     # column block of its output row; then the tile moves one hop.
@@ -84,7 +85,7 @@ def _block_row_gram(xc: jnp.ndarray, schedule: str) -> jnp.ndarray:
     for t in range(F):
         blk = lax.dot_general(
             xc, held, (((0,), (0,)), ((), ())),
-            precision=lax.Precision.HIGHEST,
+            precision=DEFAULT_GRAM_PRECISION,
         )
         col = ((j + t) % F) * n_loc
         g_row = lax.dynamic_update_slice(

@@ -10,8 +10,10 @@ set -euo pipefail
 # Fast paths that need no Python: explicit pinning env, then device nodes.
 if [[ -n "${TPU_VISIBLE_CHIPS:-}" || -n "${TPU_VISIBLE_DEVICES:-}" ]]; then
   CHIPS="${TPU_VISIBLE_CHIPS:-${TPU_VISIBLE_DEVICES}}"
-  ADDRS=$(echo "$CHIPS" | tr ',' '\n' | sed 's/^ *//; s/ *$//' | grep -v '^$' \
-    | sed 's/.*/"&"/' | paste -sd, -)
+  # `|| true`: grep exits 1 on zero matches (e.g. TPU_VISIBLE_CHIPS=","),
+  # which would abort the whole script under pipefail instead of printing []
+  ADDRS=$(echo "$CHIPS" | tr ',' '\n' | sed 's/^ *//; s/ *$//' \
+    | { grep -v '^$' || true; } | sed 's/.*/"&"/' | paste -sd, -)
   echo "{\"name\": \"tpu\", \"addresses\": [${ADDRS}]}"
   exit 0
 fi

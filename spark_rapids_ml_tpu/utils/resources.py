@@ -118,13 +118,20 @@ def discover_tpu_addresses(probe_jax: bool = False) -> List[str]:
         val = os.environ.get(var)
         if val:
             return [a.strip() for a in val.split(",") if a.strip()]
-    nodes = sorted(glob.glob("/dev/accel[0-9]*"))
+    # numeric sort (matching the shell script's `sort -n`): lexicographic
+    # order would interleave accel10 between accel1 and accel2
+    nodes = sorted(
+        glob.glob("/dev/accel[0-9]*"),
+        key=lambda n: int(re.sub(r"^/dev/accel", "", n)),
+    )
     if nodes:
         return [re.sub(r"^/dev/accel", "", n) for n in nodes]
     if probe_jax:
         import jax
 
-        return [str(d.id) for d in jax.local_devices()]
+        # filter by platform: on a TPU-less host local_devices() falls back
+        # to CPU devices, which must not be advertised as tpu addresses
+        return [str(d.id) for d in jax.local_devices() if d.platform == "tpu"]
     return []
 
 

@@ -16,10 +16,9 @@ from spark_rapids_ml_tpu.utils.resources import (
     ResourceInformation,
     discover_tpu_addresses,
     discovery_json,
+    discovery_script_path,
     resolve_device_ordinal,
 )
-
-from spark_rapids_ml_tpu.utils.resources import discovery_script_path
 
 SCRIPT = discovery_script_path()
 
@@ -108,3 +107,37 @@ def test_discovery_script_executable_and_output():
     assert out.returncode == 0, out.stderr
     obj = json.loads(out.stdout.strip())
     assert obj == {"name": "tpu", "addresses": ["0", "1"]}
+
+
+def test_discovery_script_degenerate_pinning_prints_empty_list():
+    # TPU_VISIBLE_CHIPS="," passes the non-empty env check but holds no
+    # addresses; under pipefail the zero-match grep must not abort the script
+    env = dict(os.environ, TPU_VISIBLE_CHIPS=",")
+    out = subprocess.run(
+        [SCRIPT], capture_output=True, text=True, env=env, timeout=30
+    )
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip()) == {"name": "tpu", "addresses": []}
+
+
+def test_probe_jax_does_not_advertise_cpu_devices(monkeypatch):
+    # on a TPU-less host the JAX fallback enumerates CPU devices — those
+    # must not be reported as tpu resources (conftest forces the cpu
+    # platform, so this exercises exactly that situation)
+    for var in ("TPU_VISIBLE_CHIPS", "TPU_VISIBLE_DEVICES"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(
+        "spark_rapids_ml_tpu.utils.resources.glob.glob", lambda pat: []
+    )
+    assert discover_tpu_addresses(probe_jax=True) == []
+
+
+def test_dev_accel_nodes_sort_numerically(monkeypatch):
+    for var in ("TPU_VISIBLE_CHIPS", "TPU_VISIBLE_DEVICES"):
+        monkeypatch.delenv(var, raising=False)
+    fake = [f"/dev/accel{i}" for i in (0, 1, 10, 11, 2, 3)]
+    monkeypatch.setattr(
+        "spark_rapids_ml_tpu.utils.resources.glob.glob",
+        lambda pat: fake,
+    )
+    assert discover_tpu_addresses() == ["0", "1", "2", "3", "10", "11"]
