@@ -1,0 +1,29 @@
+"""Basic PCA fit/transform — the reference README's spark-shell walkthrough
+(/root/reference/README.md:12-78: random 1000x10 vector DataFrame, k=3,
+fit, transform, show) as a Python script.
+
+Run:  python examples/pca_example.py
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu import PCA, PCAModel
+
+rng = np.random.default_rng(0)
+data = rng.random(size=(1000, 10))  # the README's 1k x 10 random vectors
+
+pca = PCA().setInputCol("features").setOutputCol("pca_features").setK(3)
+model = pca.fit(data)
+
+print("components (10 x 3):", np.asarray(model.pc).shape)
+print("explained variance ratio:", np.asarray(model.explained_variance))
+print("phase timings:", model.fit_timings_)
+
+projected = model.transform(data[:5])
+print("first rows projected:\n", np.asarray(projected.column("pca_features")))
+
+# Spark-ML-style persistence round trip (metadata JSON + parquet payload)
+model.save("/tmp/pca_model_example", overwrite=True)
+reloaded = PCAModel.load("/tmp/pca_model_example")
+assert np.array_equal(np.asarray(model.pc), np.asarray(reloaded.pc))
+print("save/load round-trip OK")

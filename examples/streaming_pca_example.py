@@ -1,0 +1,26 @@
+"""Streaming PCA over unbounded rows with bounded device memory.
+
+The accumulator keeps only (Σxxᵀ, Σx, n) on device; batches stream through
+the MXU with donated buffers, so HBM usage is one batch + one n_features²
+Gram no matter how many rows arrive. This is the shape the bench harness
+(bench.py) measures at 10M x 4096.
+
+Run:  python examples/streaming_pca_example.py
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu.ops.streaming import StreamingPCA
+
+N_FEATURES, BATCH, N_BATCHES, K = 512, 4096, 10, 16
+
+rng = np.random.default_rng(7)
+pca = StreamingPCA(N_FEATURES)
+for i in range(N_BATCHES):
+    batch = rng.normal(size=(BATCH, N_FEATURES)).astype(np.float32)
+    pca.partial_fit(batch)
+    print(f"batch {i + 1}/{N_BATCHES}: rows seen = {int(pca.rows_seen)}")
+
+result = pca.finalize(K)
+print("components:", np.asarray(result.components).shape)
+print("explained variance ratio:", np.asarray(result.explained_variance)[:4])

@@ -1,0 +1,27 @@
+"""Drop-in import namespace: ``from spark_rapids_ml_tpu.feature import PCA``.
+
+The reference's user-facing layer is a one-import-change shim — users swap
+``org.apache.spark.ml.feature.PCA`` for ``com.nvidia.spark.ml.feature.PCA``
+and keep the rest of their pipeline untouched (``PCA.scala:27-37``,
+``README.md:12-28``). This module plays that role for Python callers coming
+from ``pyspark.ml.feature``: the same class names under a ``feature``
+module path, re-exported with zero added logic (the shim layer holds no
+behavior in the reference either — just ``copy`` + ``load`` plumbing, which
+here lives on the classes themselves).
+"""
+
+from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel
+from spark_rapids_ml_tpu.models.linear_regression import (
+    LinearRegression,
+    LinearRegressionModel,
+)
+from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+
+__all__ = [
+    "PCA",
+    "PCAModel",
+    "KMeans",
+    "KMeansModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+]

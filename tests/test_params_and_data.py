@@ -140,3 +140,15 @@ def test_transform_schema_conflict(rng):
     model = PCA().setK(2).fit(x)
     with pytest.raises(ValueError, match="already exists"):
         model.transform_schema(["features", "pca_features"])
+
+
+def test_feature_namespace_shim():
+    # one-import-change parity with the reference's shim layer
+    # (com/nvidia/spark/ml/feature/PCA.scala:27-37): same classes, zero
+    # added logic, under a pyspark.ml.feature-shaped module path
+    from spark_rapids_ml_tpu import feature
+    from spark_rapids_ml_tpu.models.pca import PCA as CanonicalPCA
+
+    assert feature.PCA is CanonicalPCA
+    assert {"PCA", "PCAModel", "KMeans", "KMeansModel", "LinearRegression",
+            "LinearRegressionModel"} <= set(feature.__all__)
