@@ -1,0 +1,113 @@
+"""Multi-process job launcher: ``python -m spark_rapids_ml_tpu.launch``.
+
+The reference has no launcher of its own — Spark starts executors and each
+JVM joins the job implicitly (``spark.executor.resource.gpu.*``,
+``/root/reference/README.md:81-89``). Here the equivalent glue is explicit:
+spawn N processes on this host (or print the env for remote hosts to use),
+each of which calls ``parallel.multihost.initialize_multihost()`` and joins
+the PJRT coordination service, after which one compiled program spans every
+process's devices.
+
+Usage (2 simulated hosts, virtual CPU devices):
+
+    python -m spark_rapids_ml_tpu.launch --nprocs 2 \
+        --env JAX_PLATFORMS=cpu \
+        --env XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+        script.py arg1 arg2
+
+On a real multi-host TPU slice, run one process per host with
+``--nprocs <hosts> --node-rank <i> --coordinator <host0>:<port>`` (or rely
+on the pod metadata path where ``initialize()`` needs no configuration).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from spark_rapids_ml_tpu.parallel.multihost import (
+    _ENV_COORD,
+    _ENV_NPROC,
+    _ENV_PID,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="spark_rapids_ml_tpu.launch", description=__doc__
+    )
+    ap.add_argument("--nprocs", type=int, required=True,
+                    help="total number of processes in the job")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (default: local, free port)")
+    ap.add_argument("--node-rank", type=int, default=None,
+                    help="launch only this process id (remote-host mode); "
+                    "default launches all nprocs locally")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE env for the children (repeatable)")
+    ap.add_argument("script", help="python script to run in each process")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+
+    if ns.node_rank is not None and ns.coordinator is None:
+        # a per-host random local port can never rendezvous across hosts
+        ap.error("--node-rank requires --coordinator (host0's host:port)")
+    coord = ns.coordinator or f"127.0.0.1:{_free_port()}"
+    for kv in ns.env:
+        if "=" not in kv:
+            ap.error(f"--env expects KEY=VALUE, got {kv!r}")
+    extra = dict(kv.split("=", 1) for kv in ns.env)
+    ranks = [ns.node_rank] if ns.node_rank is not None else range(ns.nprocs)
+
+    procs = []
+    for pid in ranks:
+        env = dict(os.environ)
+        env.update(extra)
+        env[_ENV_COORD] = coord
+        env[_ENV_NPROC] = str(ns.nprocs)
+        env[_ENV_PID] = str(pid)
+        procs.append(
+            subprocess.Popen([sys.executable, ns.script, *ns.args], env=env)
+        )
+
+    # Fail fast: one dead rank blocks the others inside distributed init,
+    # so on the first nonzero exit tear the rest down instead of waiting
+    # out the rendezvous timeout.
+    rc = 0
+    try:
+        live = list(procs)
+        while live and rc == 0:
+            for p in list(live):
+                code = p.poll()
+                if code is None:
+                    continue
+                live.remove(p)
+                if code != 0:
+                    rc = code
+            if live and rc == 0:
+                time.sleep(0.1)
+        if rc != 0:
+            for p in live:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        rc = 130
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
