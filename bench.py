@@ -29,6 +29,26 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", 65536))
     cpu_rows = int(os.environ.get("BENCH_CPU_ROWS", 100_000))
 
+    # Fail-safe: a wedged device tunnel hangs backend init forever. Probe in
+    # a bounded subprocess first; if the accelerator is unreachable, run the
+    # bench on CPU (the metric string carries the platform) instead of
+    # hanging the harness.
+    from spark_rapids_ml_tpu.utils.health import check_devices_subprocess
+
+    probe = check_devices_subprocess(
+        timeout_seconds=float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    )
+    if not probe.healthy or probe.platform == "cpu":
+        # unreachable accelerator OR a silent JAX cpu fallback (no plugin
+        # installed): either way, CPU can't chew 10M×4096 in bounded time
+        if not probe.healthy:
+            print(
+                f"# accelerator unreachable ({probe.error}); benching on CPU",
+                flush=True,
+            )
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        rows = min(rows, 2 * batch)
+
     import jax
 
     from spark_rapids_ml_tpu.utils.platform import force_cpu_if_requested

@@ -32,6 +32,7 @@ from spark_rapids_ml_tpu.models.linear_regression import (  # noqa: F401
     LinearRegressionModel,
 )
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
+from spark_rapids_ml_tpu.models.svd import TruncatedSVD, TruncatedSVDModel  # noqa: F401
 from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, Vectors  # noqa: F401
 
 __all__ = [
@@ -43,6 +44,8 @@ __all__ = [
     "LinearRegressionModel",
     "Pipeline",
     "PipelineModel",
+    "TruncatedSVD",
+    "TruncatedSVDModel",
     "DenseVector",
     "SparseVector",
     "Vectors",

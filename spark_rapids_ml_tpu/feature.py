@@ -16,6 +16,7 @@ from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegressionModel,
 )
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+from spark_rapids_ml_tpu.models.svd import TruncatedSVD, TruncatedSVDModel
 
 __all__ = [
     "PCA",
@@ -24,4 +25,6 @@ __all__ = [
     "KMeansModel",
     "LinearRegression",
     "LinearRegressionModel",
+    "TruncatedSVD",
+    "TruncatedSVDModel",
 ]

@@ -326,3 +326,37 @@ def load_pca_model(path: str):
         uid=meta["uid"],
     )
     return _restore_params(model, meta)
+
+
+def save_svd_model(model, path: str, overwrite: bool = False) -> None:
+    if model.components is None:
+        raise ValueError("cannot save an unfitted TruncatedSVDModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "V": _dense_matrix_struct(model.components),
+        "s": _dense_vector_struct(model.singular_values),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [("V", _matrix_arrow_type()), ("s", _vector_arrow_type())]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema)
+
+
+def load_svd_model(path: str):
+    from spark_rapids_ml_tpu.models.svd import TruncatedSVDModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = TruncatedSVDModel(
+        components=_dense_matrix_from_struct(row["V"]),
+        singular_values=_dense_vector_from_struct(row["s"]),
+        uid=meta["uid"],
+    )
+    return _restore_params(model, meta)

@@ -1,0 +1,202 @@
+"""TruncatedSVD Estimator / Model (top-k singular structure of X).
+
+The reference's native eigensolver entry is literally named ``calSVD``
+(``/root/reference/native/src/rapidsml_jni.cu:338-392``): an SVD of the
+symmetric covariance via eigendecomposition with **S ← √eigenvalues** —
+and its vestigial JNI header shows the API once exposed raw
+``cusolverDnDgesvd`` alongside ``eigDC``
+(``com_nvidia_spark_ml_linalg_JniCUBLAS.h:1-53``, SURVEY.md §2 "vestigial
+artifacts"). This estimator is that capability as a first-class model:
+right singular vectors V and singular values σ of X (no mean centering —
+the difference from PCA), computed the same MXU-friendly way: Gram XᵀX on
+device, eigh, descending reorder, σ = √(λ), sign-flip. Singular values
+relate by σ = √λ exactly as ``calSVD``'s ``seqRoot`` step
+(``rapidsml_jni.cu:374-377``).
+
+``transform`` projects X @ V (batched on device, like PCAModel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+)
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class TruncatedSVDParams(HasInputCol, HasOutputCol, HasDeviceId):
+    k = Param("k", "number of singular vectors", None,
+              validator=lambda v: isinstance(v, int) and v >= 1)
+    outputCol = Param("outputCol", "output column name", "svd_features")
+    useXlaDot = Param(
+        "useXlaDot",
+        "Gram on the accelerator (True) or host fallback (False)",
+        True, validator=lambda v: isinstance(v, bool))
+    useXlaSvd = Param(
+        "useXlaSvd",
+        "eigensolve on the accelerator (True) or host LAPACK (False)",
+        True, validator=lambda v: isinstance(v, bool))
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+class TruncatedSVD(TruncatedSVDParams):
+    """``TruncatedSVD().setK(8).fit(X)`` → V (n×k), σ (k,)."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "TruncatedSVD":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(TruncatedSVD, path)
+
+    def fit(self, dataset) -> "TruncatedSVDModel":
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+        n_rows, n_features = x.shape
+        k = self.getK()
+        if k is None:
+            raise ValueError("k must be set before fit()")
+        if k > n_features:
+            raise ValueError(
+                f"k = {k} must be <= number of features = {n_features}"
+            )
+
+        g = self._gram(x, timer)
+        v, s = self._solve(g, k, timer)
+
+        model = TruncatedSVDModel(components=v, singular_values=s)
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def _gram(self, x, timer) -> np.ndarray:
+        """XᵀX — on the accelerator (useXlaDot) or on host in f64. The host
+        mode never touches the device: that's the flag's contract (mirrors
+        ``PCA._fit_*``; X may not fit in HBM)."""
+        if self.getUseXlaDot():
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.covariance import gram
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            with timer.phase("h2d"):
+                xd = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+            with timer.phase("gram"), TraceRange("svd gram", TraceColor.GREEN):
+                return np.asarray(jax.block_until_ready(gram(xd)))
+        from spark_rapids_ml_tpu import native
+
+        with timer.phase("gram"), TraceRange("host gram", TraceColor.ORANGE):
+            return native.gram(np.asarray(x, dtype=np.float64))
+
+    def _solve(self, g: np.ndarray, k: int, timer):
+        """Eigensolve of the small n×n Gram + the calSVD postprocessing:
+        descending order, sign-flip, **σ = √λ** (seqRoot,
+        ``rapidsml_jni.cu:374-377``; tiny f32 negatives clamped)."""
+        if self.getUseXlaSvd():
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.eigh import eigh_descending, sign_flip
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
+                gd = jax.device_put(jnp.asarray(g, dtype=dtype), device)
+                evals, evecs = eigh_descending(gd)
+                evecs = sign_flip(evecs)
+                s = jnp.sqrt(jnp.maximum(evals[:k], 0))
+                v, s = jax.block_until_ready((evecs[:, :k], s))
+            return np.asarray(v, np.float64), np.asarray(s, np.float64)
+        from spark_rapids_ml_tpu import native
+        from spark_rapids_ml_tpu.ops.eigh import eigh_postprocess_host
+
+        with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
+            w, u = native.syevd(np.asarray(g, dtype=np.float64))
+            evals, evecs = eigh_postprocess_host(w, u)
+        return evecs[:, :k], np.sqrt(np.maximum(evals[:k], 0))
+
+
+class TruncatedSVDModel(TruncatedSVDParams):
+    def __init__(self, components: Optional[np.ndarray] = None,
+                 singular_values: Optional[np.ndarray] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.components = components          # (n_features, k), V
+        self.singular_values = singular_values  # (k,), descending
+        self.fit_timings_ = {}
+
+    def _copy_internal_state(self, other: "TruncatedSVDModel") -> None:
+        other.components = self.components
+        other.singular_values = self.singular_values
+
+    def transform(self, dataset) -> VectorFrame:
+        """X @ V, batched on device (the posture the reference's transform
+        path declared but disabled, ``RapidsPCA.scala:172-185``)."""
+        if self.components is None:
+            raise ValueError("model has no components; fit first or load")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        self.transform_schema(frame.columns)
+        x = frame.vectors_as_matrix(self.getInputCol())
+        if x.shape[1] != self.components.shape[0]:
+            raise ValueError(
+                f"input has {x.shape[1]} features, model expects "
+                f"{self.components.shape[0]}"
+            )
+        if self.getUseXlaDot():
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.pca_kernel import pca_transform_kernel
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            proj = np.asarray(
+                pca_transform_kernel(
+                    jax.device_put(jnp.asarray(x, dtype=dtype), device),
+                    jnp.asarray(self.components, dtype=dtype),
+                )
+            )
+        else:
+            proj = x @ self.components
+        return frame.with_column(self.getOutputCol(), proj.astype(np.float64))
+
+    def transform_schema(self, columns):
+        """Appends outputCol; raises when it would clobber an existing
+        column (same contract as ``PCAModel.transform_schema``)."""
+        out = list(columns)
+        if self.getOutputCol() in out:
+            raise ValueError(
+                f"output column {self.getOutputCol()!r} already exists"
+            )
+        out.append(self.getOutputCol())
+        return out
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_svd_model
+
+        save_svd_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "TruncatedSVDModel":
+        from spark_rapids_ml_tpu.io.persistence import load_svd_model
+
+        return load_svd_model(path)

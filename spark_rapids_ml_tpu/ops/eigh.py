@@ -57,18 +57,25 @@ def explained_variance_ratio(evals: jnp.ndarray) -> jnp.ndarray:
     return lam / jnp.where(total > 0, total, 1.0)
 
 
-def pca_postprocess_host(evals, evecs, k: int):
-    """NumPy version of the postprocessing chain for the host fallback
-    paths — same semantics as the XLA chain above (descending order,
-    sign-flip, λ/Σλ, top-k), shared so the two can't drift. Takes LAPACK
-    ascending-order output."""
+def eigh_postprocess_host(evals, evecs):
+    """NumPy version of the descending-reorder + sign-flip chain — same
+    semantics as the XLA chain above, shared by every host fallback (PCA,
+    TruncatedSVD) so the conventions can't drift. Takes LAPACK
+    ascending-order output; returns (evals_descending, evecs_flipped)."""
     import numpy as np
 
     evals = np.asarray(evals)[::-1]
     evecs = np.asarray(evecs)[:, ::-1]
     idx = np.argmax(np.abs(evecs), axis=0)
     signs = np.where(evecs[idx, np.arange(evecs.shape[1])] < 0, -1.0, 1.0)
-    evecs = evecs * signs[None, :]
+    return evals, evecs * signs[None, :]
+
+
+def pca_postprocess_host(evals, evecs, k: int):
+    """Host postprocessing for PCA: reorder/flip + λ/Σλ + top-k."""
+    import numpy as np
+
+    evals, evecs = eigh_postprocess_host(evals, evecs)
     lam = np.maximum(evals, 0.0)
     total = lam.sum()
     evr = lam / (total if total > 0 else 1.0)

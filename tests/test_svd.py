@@ -1,0 +1,104 @@
+"""TruncatedSVD vs the NumPy SVD oracle.
+
+The reference's ``calSVD`` is SVD-via-eigh with S ← √eigenvalues
+(``rapidsml_jni.cu:338-392``); this estimator exposes that capability as a
+model. Oracle: ``np.linalg.svd`` right singular vectors/values, abs-value
+comparison where sign is ambiguous (same convention as ``PCASuite``'s
+cuSolver test, ``PCASuite.scala:136-143``).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import TruncatedSVD, TruncatedSVDModel
+
+ABS_TOL = 1e-5
+
+
+@pytest.fixture
+def data(rng):
+    # non-degenerate spectrum: scale columns so singular values separate
+    x = rng.normal(size=(300, 24)) * np.linspace(5.0, 0.5, 24)[None, :]
+    return x
+
+
+def _oracle(x, k):
+    _, s, vt = np.linalg.svd(x, full_matrices=False)
+    return vt[:k].T, s[:k]
+
+
+@pytest.mark.parametrize("use_dot,use_svd", [
+    (True, True), (True, False), (False, True), (False, False),
+])
+def test_svd_matches_oracle(data, use_dot, use_svd):
+    k = 5
+    model = (
+        TruncatedSVD().setK(k)
+        .setUseXlaDot(use_dot).setUseXlaSvd(use_svd)
+        .fit(data)
+    )
+    v_ref, s_ref = _oracle(data, k)
+    np.testing.assert_allclose(model.singular_values, s_ref, rtol=1e-9)
+    np.testing.assert_allclose(
+        np.abs(model.components), np.abs(v_ref), atol=ABS_TOL
+    )
+
+
+def test_svd_transform_is_projection(data):
+    model = TruncatedSVD().setK(4).fit(data)
+    out = model.transform(data[:50])
+    np.testing.assert_allclose(
+        np.asarray(out.column("svd_features")),
+        data[:50] @ model.components,
+        atol=1e-8,
+    )
+
+
+def test_svd_sign_convention(data):
+    # max-|.| entry of every component is positive (calSVD's signFlip,
+    # rapidsml_jni.cu:37-64)
+    model = TruncatedSVD().setK(6).fit(data)
+    v = np.asarray(model.components)
+    assert (v[np.abs(v).argmax(axis=0), np.arange(v.shape[1])] > 0).all()
+
+
+def test_svd_persistence_roundtrip(data, tmp_path):
+    model = TruncatedSVD().setK(3).setOutputCol("o").fit(data)
+    p = str(tmp_path / "m")
+    model.save(p)
+    back = TruncatedSVDModel.load(p)
+    np.testing.assert_array_equal(back.components, model.components)
+    np.testing.assert_array_equal(back.singular_values, model.singular_values)
+    assert back.getOutputCol() == "o"
+    assert back.getK() == 3
+
+
+def test_svd_k_validation(data):
+    with pytest.raises(ValueError):
+        TruncatedSVD().fit(data)
+    with pytest.raises(ValueError):
+        TruncatedSVD().setK(25).fit(data)
+
+
+def test_svd_relates_to_pca_without_centering(rng):
+    # on pre-centered data, PCA components == SVD components and
+    # eigenvalues = sigma^2/(n-1)
+    x = rng.normal(size=(400, 12)) * np.linspace(3, 1, 12)[None, :]
+    x = x - x.mean(axis=0)
+    from spark_rapids_ml_tpu import PCA
+
+    k = 4
+    svd = TruncatedSVD().setK(k).fit(x)
+    pca = PCA().setK(k).fit(x)
+    np.testing.assert_allclose(
+        np.abs(svd.components), np.abs(np.asarray(pca.pc)), atol=1e-6
+    )
+
+
+def test_svd_transform_rejects_width_mismatch_and_clobber(data):
+    model = TruncatedSVD().setK(3).fit(data)
+    with pytest.raises(ValueError, match="features"):
+        model.transform(data[:10, :7])
+    out = model.transform(data[:10])
+    with pytest.raises(ValueError, match="already exists"):
+        model.transform(out)  # output col present -> must not clobber
