@@ -1,0 +1,49 @@
+"""The wider estimator family: StandardScaler → PCA pipeline, KMeans,
+LinearRegression, TruncatedSVD — all the same Estimator/Model surface.
+
+Run:  python examples/estimators_example.py
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu import (
+    KMeans,
+    LinearRegression,
+    PCA,
+    Pipeline,
+    StandardScaler,
+    TruncatedSVD,
+)
+
+rng = np.random.default_rng(0)
+
+# -- scaler → PCA pipeline ------------------------------------------------
+X = rng.normal(size=(2000, 32)) * np.linspace(0.2, 5.0, 32)
+pipe = Pipeline(stages=[
+    StandardScaler().setWithMean(True).setOutputCol("scaled"),
+    PCA().setInputCol("scaled").setK(4),
+])
+out = pipe.fit(X).transform(X)
+print("pipeline projected:", np.asarray(out.column("pca_features")).shape)
+
+# -- KMeans ---------------------------------------------------------------
+blobs = np.concatenate([
+    rng.normal(loc=c, scale=0.3, size=(300, 8)) for c in (-4.0, 0.0, 4.0)
+])
+km = KMeans().setK(3).setSeed(7).fit(blobs)
+labels = np.asarray(km.transform(blobs).column("prediction"))
+print("kmeans centers:", np.sort(np.asarray(km.cluster_centers)[:, 0]).round(1))
+print("cluster sizes:", np.bincount(labels.astype(int)))
+
+# -- LinearRegression -----------------------------------------------------
+w_true = rng.normal(size=16)
+Xr = rng.normal(size=(5000, 16))
+y = Xr @ w_true + 2.5 + 0.01 * rng.normal(size=5000)
+lr = LinearRegression().setRegParam(1e-6).fit(Xr, labels=y)
+print("linreg |w-w*|:", np.abs(np.asarray(lr.coefficients) - w_true).max().round(4),
+      "intercept:", round(float(lr.intercept), 3))
+print("metrics:", {k: round(v, 4) for k, v in lr.evaluate(Xr, labels=y).items()})
+
+# -- TruncatedSVD ---------------------------------------------------------
+svd = TruncatedSVD().setK(5).fit(X)
+print("singular values:", np.asarray(svd.singular_values).round(1))

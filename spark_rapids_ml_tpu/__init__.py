@@ -33,6 +33,7 @@ from spark_rapids_ml_tpu.models.linear_regression import (  # noqa: F401
 )
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.models.svd import TruncatedSVD, TruncatedSVDModel  # noqa: F401
+from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel  # noqa: F401
 from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, Vectors  # noqa: F401
 
 __all__ = [
@@ -46,6 +47,8 @@ __all__ = [
     "PipelineModel",
     "TruncatedSVD",
     "TruncatedSVDModel",
+    "StandardScaler",
+    "StandardScalerModel",
     "DenseVector",
     "SparseVector",
     "Vectors",

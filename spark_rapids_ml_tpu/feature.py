@@ -16,6 +16,7 @@ from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegressionModel,
 )
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel
 from spark_rapids_ml_tpu.models.svd import TruncatedSVD, TruncatedSVDModel
 
 __all__ = [
@@ -27,4 +28,6 @@ __all__ = [
     "LinearRegressionModel",
     "TruncatedSVD",
     "TruncatedSVDModel",
+    "StandardScaler",
+    "StandardScalerModel",
 ]

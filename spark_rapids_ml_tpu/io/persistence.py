@@ -360,3 +360,37 @@ def load_svd_model(path: str):
         uid=meta["uid"],
     )
     return _restore_params(model, meta)
+
+
+def save_scaler_model(model, path: str, overwrite: bool = False) -> None:
+    if model.mean is None:
+        raise ValueError("cannot save an unfitted StandardScalerModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "mean": _dense_vector_struct(model.mean),
+        "std": _dense_vector_struct(model.std),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [("mean", _vector_arrow_type()), ("std", _vector_arrow_type())]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema)
+
+
+def load_scaler_model(path: str):
+    from spark_rapids_ml_tpu.models.scaler import StandardScalerModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = StandardScalerModel(
+        mean=_dense_vector_from_struct(row["mean"]),
+        std=_dense_vector_from_struct(row["std"]),
+        uid=meta["uid"],
+    )
+    return _restore_params(model, meta)

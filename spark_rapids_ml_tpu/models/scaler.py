@@ -1,0 +1,146 @@
+"""StandardScaler Estimator / Model.
+
+Spark ``org.apache.spark.ml.feature.StandardScaler`` param surface
+(``withMean`` default false, ``withStd`` default true — Spark's defaults,
+which avoid densifying sparse data) for the pipeline story the reference is
+consumed through (its PCA slots into Spark ML Pipelines, ``README.md:12-28``).
+Fitting is one pass of per-column sufficient statistics (Σx, Σx², n) — the
+same partial-aggregate shape as the covariance path, so the device kernel
+is a trivially-fused pair of column reductions; ``std`` uses the unbiased
+(n−1) normalizer like Spark's ``Summarizer``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+)
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+
+
+class StandardScalerParams(HasInputCol, HasOutputCol, HasDeviceId):
+    outputCol = Param("outputCol", "output column name", "scaled_features")
+    withMean = Param("withMean", "center to zero mean before scaling", False,
+                     validator=lambda v: isinstance(v, bool))
+    withStd = Param("withStd", "scale to unit standard deviation", True,
+                    validator=lambda v: isinstance(v, bool))
+    useXlaDot = Param(
+        "useXlaDot",
+        "statistics on the accelerator (True) or host NumPy (False)",
+        True, validator=lambda v: isinstance(v, bool))
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+class StandardScaler(StandardScalerParams):
+    """``StandardScaler().setWithMean(True).fit(df)``."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "StandardScaler":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(StandardScaler, path)
+
+    def fit(self, dataset) -> "StandardScalerModel":
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+        if x.shape[0] < 2:
+            raise ValueError("StandardScaler requires at least 2 rows")
+        if self.getUseXlaDot():
+            import jax
+            import jax.numpy as jnp
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+
+            with timer.phase("fit_kernel"):
+                xd = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+                n = x.shape[0]
+                mean = jnp.sum(xd, axis=0) / n
+                # two-pass Σ(x−μ)²/(n−1): the expanded one-pass identity
+                # catastrophically cancels at f32 for |μ| ≫ σ (same hazard
+                # ops/covariance.py documents for the Gram)
+                centered = xd - mean[None, :]
+                var = jnp.sum(centered * centered, axis=0) / (n - 1)
+                mean, var = jax.block_until_ready((mean, var))
+            mean = np.asarray(mean, np.float64)
+            std = np.sqrt(np.maximum(np.asarray(var, np.float64), 0))
+        else:
+            with timer.phase("fit_kernel"):
+                mean = x.mean(axis=0)
+                std = x.std(axis=0, ddof=1)
+        model = StandardScalerModel(mean=mean, std=std)
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+
+class StandardScalerModel(StandardScalerParams):
+    def __init__(self, mean: Optional[np.ndarray] = None,
+                 std: Optional[np.ndarray] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.mean = mean
+        self.std = std
+        self.fit_timings_ = {}
+
+    def _copy_internal_state(self, other: "StandardScalerModel") -> None:
+        other.mean = self.mean
+        other.std = self.std
+
+    def transform(self, dataset) -> VectorFrame:
+        if self.mean is None:
+            raise ValueError("model has no statistics; fit first or load")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        self.transform_schema(frame.columns)
+        x = frame.vectors_as_matrix(self.getInputCol())
+        if x.shape[1] != self.mean.shape[0]:
+            raise ValueError(
+                f"input has {x.shape[1]} features, model expects "
+                f"{self.mean.shape[0]}"
+            )
+        out = np.asarray(x, dtype=np.float64)
+        if self.getWithMean():
+            out = out - self.mean[None, :]
+        if self.getWithStd():
+            # Spark semantics: zero-std columns get scale factor 0.0 (the
+            # constant column maps to 0), not a pass-through
+            factor = np.where(self.std > 0, 1.0 / np.where(self.std > 0, self.std, 1.0), 0.0)
+            out = out * factor[None, :]
+        return frame.with_column(self.getOutputCol(), out)
+
+    def transform_schema(self, columns):
+        out = list(columns)
+        if self.getOutputCol() in out:
+            raise ValueError(
+                f"output column {self.getOutputCol()!r} already exists"
+            )
+        out.append(self.getOutputCol())
+        return out
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_scaler_model
+
+        save_scaler_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "StandardScalerModel":
+        from spark_rapids_ml_tpu.io.persistence import load_scaler_model
+
+        return load_scaler_model(path)

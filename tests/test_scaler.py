@@ -1,0 +1,75 @@
+"""StandardScaler vs NumPy/Spark semantics: defaults (withStd only), both
+flags, zero-variance pass-through, pipeline chaining with PCA, persistence."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    PCA,
+    Pipeline,
+    StandardScaler,
+    StandardScalerModel,
+)
+
+
+@pytest.fixture
+def data(rng):
+    x = rng.normal(size=(200, 8)) * np.linspace(0.5, 4, 8) + 3.0
+    x[:, 5] = 7.0  # zero-variance column
+    return x
+
+
+@pytest.mark.parametrize("use_xla", [True, False])
+def test_scaler_statistics(data, use_xla):
+    model = StandardScaler().setUseXlaDot(use_xla).fit(data)
+    np.testing.assert_allclose(model.mean, data.mean(axis=0), atol=1e-9)
+    np.testing.assert_allclose(model.std, data.std(axis=0, ddof=1), atol=1e-9)
+
+
+def test_scaler_defaults_scale_only(data):
+    out = StandardScaler().fit(data).transform(data)
+    got = np.asarray(out.column("scaled_features"))
+    std = data.std(axis=0, ddof=1)
+    expected = data / np.where(std > 0, std, 1.0)[None, :]
+    np.testing.assert_allclose(got, expected, atol=1e-9)
+    # zero-variance column passes through unscaled
+    np.testing.assert_allclose(got[:, 5], data[:, 5])
+
+
+def test_scaler_with_mean_and_std(data):
+    model = StandardScaler().setWithMean(True).setWithStd(True).fit(data)
+    got = np.asarray(model.transform(data).column("scaled_features"))
+    nonconst = [c for c in range(8) if c != 5]
+    np.testing.assert_allclose(got[:, nonconst].mean(axis=0), 0, atol=1e-9)
+    np.testing.assert_allclose(got[:, nonconst].std(axis=0, ddof=1), 1, atol=1e-9)
+
+
+def test_scaler_pipeline_with_pca(data):
+    pipe = Pipeline(stages=[
+        StandardScaler().setWithMean(True).setOutputCol("scaled"),
+        PCA().setInputCol("scaled").setK(3),
+    ])
+    fitted = pipe.fit(data)
+    out = fitted.transform(data)
+    assert np.asarray(out.column("pca_features")).shape == (200, 3)
+
+
+def test_scaler_persistence(data, tmp_path):
+    model = StandardScaler().setWithMean(True).fit(data)
+    p = str(tmp_path / "m")
+    model.save(p)
+    back = StandardScalerModel.load(p)
+    np.testing.assert_array_equal(back.mean, model.mean)
+    np.testing.assert_array_equal(back.std, model.std)
+    assert back.getWithMean() is True
+
+
+def test_scaler_guards(data):
+    model = StandardScaler().fit(data)
+    with pytest.raises(ValueError, match="features"):
+        model.transform(data[:, :4])
+    out = model.transform(data)
+    with pytest.raises(ValueError, match="already exists"):
+        model.transform(out)
+    with pytest.raises(ValueError, match="2 rows"):
+        StandardScaler().fit(data[:1])
