@@ -67,6 +67,19 @@ int fail_if(PJRT_Error* err, const char* what) {
   return -1;
 }
 
+// For advisory queries whose failure is tolerated: destroys the error
+// object (the caller owns it per the PJRT protocol) without touching
+// g_last_error. Returns true when the call succeeded.
+bool query_ok(PJRT_Error* err) {
+  if (!err) return true;
+  PJRT_Error_Destroy_Args d;
+  std::memset(&d, 0, sizeof d);
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_api->PJRT_Error_Destroy(&d);
+  return false;
+}
+
 int await_and_destroy(PJRT_Event* ev, const char* what) {
   if (!ev) return 0;
   PJRT_Event_Await_Args a;
@@ -165,37 +178,82 @@ int execute_locked(int handle, const float* const* inputs,
   if (!rc) rc = await_and_destroy(done[0], "execute-await");
 
   if (!rc) {
+    // Ask the plugin to deliver the output in dense row-major directly: an
+    // explicit untiled descending minor_to_major host_layout makes the
+    // plugin do any detiling/transpose during the copy, so no host-side
+    // fixup is needed regardless of the device layout.
+    PJRT_Buffer_Dimensions_Args bd;
+    std::memset(&bd, 0, sizeof bd);
+    bd.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    bd.buffer = out_list[0];
+    size_t out_rank = 0;
+    bool have_dims = query_ok(g_api->PJRT_Buffer_Dimensions(&bd));
+    if (have_dims) out_rank = bd.num_dims;
+    int64_t row_major_m2m[8];
+    for (size_t i = 0; i < out_rank && i < 8; i++)
+      row_major_m2m[i] = static_cast<int64_t>(out_rank - 1 - i);
+    PJRT_Buffer_MemoryLayout host_layout;
+    std::memset(&host_layout, 0, sizeof host_layout);
+    host_layout.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+    host_layout.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+    host_layout.tiled.minor_to_major = row_major_m2m;
+    host_layout.tiled.minor_to_major_size = out_rank;
+    host_layout.tiled.num_tiles = 0;
+
     PJRT_Buffer_ToHostBuffer_Args th;
     std::memset(&th, 0, sizeof th);
     th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
     th.src = out_list[0];
     th.dst = out;
     th.dst_size = out_bytes;
+    bool explicit_layout = out_rank > 0 && out_rank <= 8;
+    bool layout_rejected = false;
+    if (explicit_layout) th.host_layout = &host_layout;
     rc = fail_if(g_api->PJRT_Buffer_ToHostBuffer(&th), "d2h");
+    if (rc && explicit_layout) {
+      // Plugin rejected the explicit layout request; retry source-layout
+      // copy and normalize on the host below.
+      explicit_layout = false;
+      layout_rejected = true;
+      std::memset(&th, 0, sizeof th);
+      th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      th.src = out_list[0];
+      th.dst = out;
+      th.dst_size = out_bytes;
+      rc = fail_if(g_api->PJRT_Buffer_ToHostBuffer(&th), "d2h");
+    }
     if (!rc) rc = await_and_destroy(th.event, "d2h-await");
-    // ToHostBuffer copies in the SOURCE buffer's layout when host_layout is
-    // null, and executable outputs commonly come back column-major
-    // (minor_to_major {0,1}). Callers expect row-major; fix up 2-D outputs
-    // in place. (Symmetric outputs like the Gram are unaffected either way.)
-    if (!rc) {
-      PJRT_Buffer_Dimensions_Args bd;
-      std::memset(&bd, 0, sizeof bd);
-      bd.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
-      bd.buffer = out_list[0];
+    // When the copy used the SOURCE buffer's layout (no/rejected explicit
+    // layout), normalize to row-major on the host: transpose a dense
+    // column-major 2-D output; fail loudly on a genuinely tiled layout —
+    // the bytes are tile-swizzled and a naive transpose would scramble
+    // them further, so returning them silently is worse than an error.
+    if (!rc && !explicit_layout) {
       PJRT_Buffer_GetMemoryLayout_Args gl;
       std::memset(&gl, 0, sizeof gl);
       gl.struct_size = PJRT_Buffer_GetMemoryLayout_Args_STRUCT_SIZE;
       gl.buffer = out_list[0];
-      if (!g_api->PJRT_Buffer_Dimensions(&bd) && bd.num_dims == 2 &&
-          !g_api->PJRT_Buffer_GetMemoryLayout(&gl) &&
-          gl.layout.type == PJRT_Buffer_MemoryLayout_Type_Tiled &&
-          gl.layout.tiled.minor_to_major_size == 2 &&
-          gl.layout.tiled.minor_to_major[0] == 0) {
-        int64_t r = bd.dims[0], c = bd.dims[1];
-        std::vector<float> tmp(out, out + static_cast<size_t>(r) * c);
-        for (int64_t i = 0; i < r; i++)
-          for (int64_t j = 0; j < c; j++)
-            out[i * c + j] = tmp[j * r + i];
+      if (query_ok(g_api->PJRT_Buffer_GetMemoryLayout(&gl)) &&
+          gl.layout.type == PJRT_Buffer_MemoryLayout_Type_Tiled) {
+        if (gl.layout.tiled.num_tiles != 0) {
+          set_error(std::string("d2h: output buffer has a tiled device "
+                    "layout that was copied as-is (") +
+                    (layout_rejected
+                         ? "the plugin rejected an explicit row-major "
+                           "host layout"
+                         : "no explicit host layout was requested: "
+                           "dimensions query failed or rank > 8") +
+                    "); refusing to return tile-swizzled bytes", nullptr);
+          rc = -1;
+        } else if (have_dims && bd.num_dims == 2 &&
+                   gl.layout.tiled.minor_to_major_size == 2 &&
+                   gl.layout.tiled.minor_to_major[0] == 0) {
+          int64_t r = bd.dims[0], c = bd.dims[1];
+          std::vector<float> tmp(out, out + static_cast<size_t>(r) * c);
+          for (int64_t i = 0; i < r; i++)
+            for (int64_t j = 0; j < c; j++)
+              out[i * c + j] = tmp[j * r + i];
+        }
       }
     }
   }

@@ -120,6 +120,27 @@ def _resolve_device(device_id: int):
     if 0 <= ordinal < len(devices):
         return devices[ordinal]
     if len(devices) == 1:
+        # Last resort: run on the only visible device even though its id
+        # doesn't match the assignment. With pinning env present this is the
+        # normal pinned-executor shape (TPU_VISIBLE_CHIPS="2" re-enumerates
+        # the sole visible chip as id 0) — silent. Without pinning env the
+        # assignment has nothing backing it (env lost or mis-set): warn so a
+        # misrouted task is diagnosable instead of silently computing on the
+        # wrong chip.
+        import os
+        import warnings
+
+        from spark_rapids_ml_tpu.utils.resources import _ENV_VISIBLE
+
+        if not any(os.environ.get(v) for v in _ENV_VISIBLE):
+            warnings.warn(
+                f"deviceId {ordinal} does not match the single visible "
+                f"device (id {devices[0].id}) and no chip-pinning env "
+                f"({'/'.join(_ENV_VISIBLE)}) is set; running on the visible "
+                f"device anyway. Check task resource assignment.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         return devices[0]
     raise ValueError(
         f"deviceId {ordinal} matches none of the {len(devices)} visible "

@@ -7,7 +7,9 @@ consumed through (its PCA slots into Spark ML Pipelines, ``README.md:12-28``).
 Fitting is one pass of per-column sufficient statistics (Σx, Σx², n) — the
 same partial-aggregate shape as the covariance path, so the device kernel
 is a trivially-fused pair of column reductions; ``std`` uses the unbiased
-(n−1) normalizer like Spark's ``Summarizer``.
+(n−1) normalizer like Spark's ``Summarizer``. Transform follows Spark's
+``StandardScalerModel`` exactly: a zero-std column gets scale factor 0.0
+(the constant column maps to 0), not a pass-through.
 """
 
 from __future__ import annotations
@@ -121,7 +123,8 @@ class StandardScalerModel(StandardScalerParams):
         if self.getWithStd():
             # Spark semantics: zero-std columns get scale factor 0.0 (the
             # constant column maps to 0), not a pass-through
-            factor = np.where(self.std > 0, 1.0 / np.where(self.std > 0, self.std, 1.0), 0.0)
+            safe = np.where(self.std > 0, self.std, 1.0)
+            factor = np.where(self.std > 0, 1.0 / safe, 0.0)
             out = out * factor[None, :]
         return frame.with_column(self.getOutputCol(), out)
 

@@ -36,14 +36,22 @@ from jax import lax
 # on mean-100 data where one-pass cancellation dominates both modes alike —
 # while running ~1.3× faster on the MXU. ``highest`` (full f32 passes) and
 # ``default`` (single-pass bf16 — fails the 1e-5 bar) remain selectable.
-# Read ONCE at import; ignored on CPU, where matmuls are always f32.
+# Resolved lazily at each call site so a bad env value fails where a Gram is
+# requested (with a clear message), not at ``import spark_rapids_ml_tpu``.
+# Note: inside jit-compiled kernels the value is read at TRACE time and baked
+# into the compiled program — changing the env var later affects new traces
+# (new shapes) but not already-cached executables.
 _ALLOWED_PRECISIONS = ("default", "bfloat16", "bfloat16_3x", "float32", "highest")
-DEFAULT_GRAM_PRECISION = os.environ.get("TPUML_GRAM_PRECISION", "bfloat16_3x")
-if DEFAULT_GRAM_PRECISION not in _ALLOWED_PRECISIONS:
-    raise ValueError(
-        f"TPUML_GRAM_PRECISION={DEFAULT_GRAM_PRECISION!r} is not one of "
-        f"{_ALLOWED_PRECISIONS}"
-    )
+
+
+def default_gram_precision() -> str:
+    """Gram MXU precision from ``TPUML_GRAM_PRECISION`` (default bfloat16_3x)."""
+    value = os.environ.get("TPUML_GRAM_PRECISION", "bfloat16_3x")
+    if value not in _ALLOWED_PRECISIONS:
+        raise ValueError(
+            f"TPUML_GRAM_PRECISION={value!r} is not one of {_ALLOWED_PRECISIONS}"
+        )
+    return value
 
 
 def _masked(x: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
@@ -71,13 +79,13 @@ def column_means(x: jnp.ndarray, mask: Optional[jnp.ndarray] = None) -> jnp.ndar
 
 def gram(x: jnp.ndarray, precision=None) -> jnp.ndarray:
     """xᵀx on the MXU. ``precision=None`` resolves to
-    ``DEFAULT_GRAM_PRECISION``; both it and ``highest`` keep f32 accumulation
+    ``default_gram_precision()``; both it and ``highest`` keep f32 accumulation
     exact enough for the 1e-5 oracle bar (see SURVEY.md §7 "float64")."""
     return lax.dot_general(
         x,
         x,
         (((0,), (0,)), ((), ())),
-        precision=DEFAULT_GRAM_PRECISION if precision is None else precision,
+        precision=default_gram_precision() if precision is None else precision,
     )
 
 

@@ -49,7 +49,7 @@ from spark_rapids_ml_tpu.ops.randomized import (
     subspace_iteration,
     topk_from_subspace,
 )
-from spark_rapids_ml_tpu.ops.covariance import DEFAULT_GRAM_PRECISION
+from spark_rapids_ml_tpu.ops.covariance import default_gram_precision
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     FEATURE_AXIS,
@@ -76,7 +76,7 @@ def _block_row_gram(xc: jnp.ndarray, schedule: str) -> jnp.ndarray:
         x_full = lax.all_gather(xc, FEATURE_AXIS, axis=1, tiled=True)
         return lax.dot_general(
             xc, x_full, (((0,), (0,)), ((), ())),
-            precision=DEFAULT_GRAM_PRECISION,
+            precision=default_gram_precision(),
         )
     # ring: at step t this device holds tile (j+t) mod F and fills that
     # column block of its output row; then the tile moves one hop.
@@ -85,7 +85,7 @@ def _block_row_gram(xc: jnp.ndarray, schedule: str) -> jnp.ndarray:
     for t in range(F):
         blk = lax.dot_general(
             xc, held, (((0,), (0,)), ((), ())),
-            precision=DEFAULT_GRAM_PRECISION,
+            precision=default_gram_precision(),
         )
         col = ((j + t) % F) * n_loc
         g_row = lax.dynamic_update_slice(

@@ -1,5 +1,6 @@
 """StandardScaler vs NumPy/Spark semantics: defaults (withStd only), both
-flags, zero-variance pass-through, pipeline chaining with PCA, persistence."""
+flags, zero-variance columns mapped to 0.0 (Spark's scale factor for
+std == 0), pipeline chaining with PCA, persistence."""
 
 import numpy as np
 import pytest
@@ -30,10 +31,10 @@ def test_scaler_defaults_scale_only(data):
     out = StandardScaler().fit(data).transform(data)
     got = np.asarray(out.column("scaled_features"))
     std = data.std(axis=0, ddof=1)
-    expected = data / np.where(std > 0, std, 1.0)[None, :]
+    expected = data * np.where(std > 0, 1.0 / np.where(std > 0, std, 1.0), 0.0)[None, :]
     np.testing.assert_allclose(got, expected, atol=1e-9)
-    # zero-variance column passes through unscaled
-    np.testing.assert_allclose(got[:, 5], data[:, 5])
+    # Spark semantics: zero-variance column gets scale factor 0.0
+    np.testing.assert_allclose(got[:, 5], 0.0)
 
 
 def test_scaler_with_mean_and_std(data):
