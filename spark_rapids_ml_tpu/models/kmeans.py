@@ -59,17 +59,44 @@ class KMeans(KMeansParams):
         return load_params(KMeans, path)
 
     def fit(self, dataset) -> "KMeansModel":
+        """Also accepts an out-of-core source: a zero-arg callable returning
+        an iterable of row chunks (re-iterable — Lloyd needs one pass per
+        iteration); seeding runs k-means++ on a reservoir sample."""
         timer = PhaseTimer()
-        frame = as_vector_frame(dataset, self.getInputCol())
-        with timer.phase("densify"):
-            x = frame.vectors_as_matrix(self.getInputCol())
         k = self.getK()
-        if k > x.shape[0]:
-            raise ValueError(f"k = {k} must be at most the number of rows {x.shape[0]}")
-        if self.getUseXlaDot():
-            centers, cost, n_iter = self._fit_xla(x, k, timer)
+
+        from spark_rapids_ml_tpu.data.batches import streaming_source
+
+        source = streaming_source(dataset, 0)
+        if source is None:
+            frame = as_vector_frame(dataset, self.getInputCol())
+            with timer.phase("densify"):
+                x = frame.vectors_as_matrix(self.getInputCol())
+            from spark_rapids_ml_tpu.data.batches import (
+                BatchSource,
+                stream_threshold_bytes,
+            )
+
+            if self.getUseXlaDot() and x.nbytes > stream_threshold_bytes():
+                source = BatchSource(x)
+
+        if source is not None:
+            if not source.reiterable:
+                raise ValueError(
+                    "KMeans streaming requires a re-iterable source (a "
+                    "zero-arg callable returning a fresh chunk iterator): "
+                    "Lloyd makes one pass per iteration"
+                )
+            centers, cost, n_iter = self._fit_streamed(source, k, timer)
         else:
-            centers, cost, n_iter = self._fit_host(x, k, timer)
+            if k > x.shape[0]:
+                raise ValueError(
+                    f"k = {k} must be at most the number of rows {x.shape[0]}"
+                )
+            if self.getUseXlaDot():
+                centers, cost, n_iter = self._fit_xla(x, k, timer)
+            else:
+                centers, cost, n_iter = self._fit_host(x, k, timer)
         model = KMeansModel(cluster_centers=np.asarray(centers, dtype=np.float64))
         model.uid = self.uid
         model.copy_values_from(self)
@@ -101,6 +128,107 @@ class KMeans(KMeansParams):
             )
         return result.centers, result.cost, result.n_iter
 
+    def _fit_streamed(self, source, k, timer):
+        """Out-of-core Lloyd: one streamed pass per iteration, per-batch
+        (Σx, count, cost) folded into an accumulator — a donated device
+        accumulator (``ops.kmeans_kernel.update_cluster_stats``) when
+        ``useXlaDot``, NumPy float64 otherwise. Seeding is k-means++ on a
+        uniform reservoir sample — the sample-then-stream shape of scalable
+        k-means variants. As on the other fit paths, the reported cost is
+        measured under the FINAL centers (one extra stats pass)."""
+        rng = np.random.default_rng(self.getSeed())
+        with timer.phase("seed"), TraceRange("kmeans seed", TraceColor.ORANGE):
+            sample = _reservoir_sample(source, max(4096, 8 * k), rng)
+            if k > sample.shape[0]:
+                raise ValueError(
+                    f"k = {k} must be at most the number of rows "
+                    f"{sample.shape[0]}"
+                )
+            centers = _host_kmeans_pp(np.asarray(sample, dtype=np.float64), k, rng)
+
+        if self.getUseXlaDot():
+            return self._streamed_lloyd_xla(source, centers, timer)
+        return self._streamed_lloyd_host(source, centers, timer)
+
+    def _streamed_lloyd_xla(self, source, centers, timer):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+        from spark_rapids_ml_tpu.ops.kmeans_kernel import update_cluster_stats
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        k, n = centers.shape
+        centers_dev = jax.device_put(jnp.asarray(centers, dtype=dtype), device)
+
+        def pass_stats(c_dev):
+            carry = jax.device_put(
+                (
+                    jnp.zeros((k, n), dtype=dtype),
+                    jnp.zeros((k,), dtype=dtype),
+                    jnp.zeros((), dtype=dtype),
+                ),
+                device,
+            )
+            for batch, mask in source.batches():
+                carry = update_cluster_stats(
+                    carry, c_dev, jnp.asarray(batch, dtype=dtype),
+                    None if mask is None else jnp.asarray(mask))
+            return jax.block_until_ready(carry)
+
+        n_iter = 0
+        with timer.phase("fit_kernel"), TraceRange("kmeans streamed", TraceColor.GREEN):
+            for n_iter in range(1, self.getMaxIter() + 1):
+                sums, counts, _ = pass_stats(centers_dev)
+                safe = jnp.maximum(counts, 1.0)[:, None]
+                new_centers = jnp.where(
+                    counts[:, None] > 0, sums / safe, centers_dev
+                )
+                moved = float(jnp.sqrt(
+                    jnp.max(jnp.sum((new_centers - centers_dev) ** 2, axis=1))
+                ))
+                centers_dev = new_centers
+                if moved <= self.getTol():
+                    break
+            _, _, cost_dev = pass_stats(centers_dev)
+        return np.asarray(centers_dev), float(cost_dev), n_iter
+
+    def _streamed_lloyd_host(self, source, centers, timer):
+        k, n = centers.shape
+
+        def pass_stats(c):
+            sums = np.zeros((k, n))
+            counts = np.zeros(k)
+            cost = 0.0
+            for batch, mask in source.batches():
+                b = np.asarray(batch if mask is None else batch[mask],
+                               dtype=np.float64)
+                d = _sqdist(b, c)
+                labels = d.argmin(axis=1)
+                np.add.at(sums, labels, b)
+                np.add.at(counts, labels, 1.0)
+                cost += float(d.min(axis=1).sum())
+            return sums, counts, cost
+
+        n_iter = 0
+        with timer.phase("fit_kernel"), TraceRange("kmeans host", TraceColor.ORANGE):
+            for n_iter in range(1, self.getMaxIter() + 1):
+                sums, counts, _ = pass_stats(centers)
+                new_centers = np.where(
+                    counts[:, None] > 0,
+                    sums / np.maximum(counts, 1.0)[:, None],
+                    centers,
+                )
+                moved = float(np.sqrt(
+                    ((new_centers - centers) ** 2).sum(axis=1).max()
+                ))
+                centers = new_centers
+                if moved <= self.getTol():
+                    break
+            _, _, cost = pass_stats(centers)
+        return centers, cost, n_iter
+
     def _fit_host(self, x, k, timer):
         """NumPy Lloyd with the same init/update/empty-cluster semantics."""
         rng = np.random.default_rng(self.getSeed())
@@ -127,6 +255,39 @@ def _sqdist(x, centers):
     x2 = (x * x).sum(axis=1)[:, None]
     c2 = (centers * centers).sum(axis=1)[None, :]
     return np.maximum(x2 + c2 - 2.0 * (x @ centers.T), 0.0)
+
+
+def _reservoir_sample(source, size: int, rng) -> np.ndarray:
+    """Uniform-ish sample of up to ``size`` rows in one streamed pass.
+
+    Vectorized batch reservoir: row t (0-based global index) replaces a
+    random slot with probability size/(t+1) — per-batch vectorization of
+    Algorithm R, accepted approximation for seeding purposes."""
+    reservoir = None
+    filled = 0
+    seen = 0
+    for batch, mask in source.batches():
+        rows = batch if mask is None else batch[mask]
+        if reservoir is None:
+            reservoir = np.empty((size, rows.shape[1]), dtype=np.float64)
+        take = min(size - filled, rows.shape[0])
+        if take > 0:
+            reservoir[filled:filled + take] = rows[:take]
+            filled += take
+            seen += take
+            rows = rows[take:]
+        if rows.shape[0] == 0:
+            continue
+        t = seen + np.arange(rows.shape[0])
+        keep = rng.random(rows.shape[0]) < size / (t + 1)
+        idx = np.nonzero(keep)[0]
+        if idx.size:
+            slots = rng.integers(0, size, size=idx.size)
+            reservoir[slots] = rows[idx]
+        seen += rows.shape[0]
+    if reservoir is None:
+        raise ValueError("empty dataset")
+    return reservoir[:filled] if filled < size else reservoir
 
 
 def _host_kmeans_pp(x, k, rng):

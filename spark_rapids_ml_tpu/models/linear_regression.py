@@ -55,23 +55,36 @@ class LinearRegression(LinearRegressionParams):
 
     def fit(self, dataset, labels=None) -> "LinearRegressionModel":
         """``dataset`` may carry the label column, or pass ``labels``
-        explicitly alongside a bare feature matrix."""
+        explicitly alongside a bare feature matrix. Out-of-core: ``dataset``
+        may also be a generator (or zero-arg callable producing one) of
+        ``(X_chunk, y_chunk)`` pairs — sufficient statistics stream through
+        the device with bounded memory."""
         timer = PhaseTimer()
-        frame = as_vector_frame(dataset, self.getInputCol())
-        with timer.phase("densify"):
-            x = frame.vectors_as_matrix(self.getInputCol())
-            if labels is not None:
-                y = np.asarray(labels, dtype=np.float64).reshape(-1)
-            else:
-                y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
-        if y.shape[0] != x.shape[0]:
-            raise ValueError(
-                f"labels length {y.shape[0]} != rows {x.shape[0]}"
-            )
-        if self.getUseXlaDot():
-            coef, intercept = self._fit_xla(x, y, timer)
+        source = _streaming_xy_source(dataset, labels)
+        if source is not None:
+            coef, intercept = self._fit_streamed(source, timer)
         else:
-            coef, intercept = self._fit_host(x, y, timer)
+            frame = as_vector_frame(dataset, self.getInputCol())
+            with timer.phase("densify"):
+                x = frame.vectors_as_matrix(self.getInputCol())
+                if labels is not None:
+                    y = np.asarray(labels, dtype=np.float64).reshape(-1)
+                else:
+                    y = np.asarray(frame.column(self.getLabelCol()),
+                                   dtype=np.float64)
+            if y.shape[0] != x.shape[0]:
+                raise ValueError(
+                    f"labels length {y.shape[0]} != rows {x.shape[0]}"
+                )
+            from spark_rapids_ml_tpu.data.batches import stream_threshold_bytes
+
+            if self.getUseXlaDot() and x.nbytes > stream_threshold_bytes():
+                source = _xy_batch_source(x, y)
+                coef, intercept = self._fit_streamed(source, timer)
+            elif self.getUseXlaDot():
+                coef, intercept = self._fit_xla(x, y, timer)
+            else:
+                coef, intercept = self._fit_host(x, y, timer)
         model = LinearRegressionModel(
             coefficients=np.asarray(coef, dtype=np.float64),
             intercept=float(intercept),
@@ -80,6 +93,68 @@ class LinearRegression(LinearRegressionParams):
         model.copy_values_from(self)
         model.fit_timings_ = timer.as_dict()
         return model
+
+    def _fit_streamed(self, source, timer):
+        """One pass of Z=[X|y] sufficient statistics (ZᵀZ, Σz, n) — on the
+        device accumulator when ``useXlaDot``, NumPy float64 otherwise —
+        then the tiny (n_features+1) normal-equations solve on host in
+        float64. Mathematically identical to the one-shot kernel; memory is
+        one batch + one (n+1)² Gram."""
+        nz = source.n_features  # n_features + 1 (label column)
+        if self.getUseXlaDot():
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.models.pca import (
+                _resolve_device,
+                _resolve_dtype,
+            )
+            from spark_rapids_ml_tpu.ops.streaming import init_stats, update_stats
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            with timer.phase("fit_kernel"), TraceRange(
+                "linreg streamed", TraceColor.GREEN
+            ):
+                stats = init_stats(nz, dtype=dtype, device=device)
+                for batch, mask in source.batches():
+                    stats = update_stats(
+                        stats, jnp.asarray(batch, dtype=dtype),
+                        None if mask is None else jnp.asarray(mask))
+                g = np.asarray(stats.gram, dtype=np.float64)
+                s = np.asarray(stats.col_sum, dtype=np.float64)
+                cnt = float(stats.count)
+        else:
+            with timer.phase("fit_kernel"), TraceRange(
+                "linreg host", TraceColor.ORANGE
+            ):
+                g = np.zeros((nz, nz))
+                s = np.zeros(nz)
+                cnt = 0.0
+                for batch, mask in source.batches():
+                    b = np.asarray(batch if mask is None else batch[mask],
+                                   dtype=np.float64)
+                    g += b.T @ b
+                    s += b.sum(axis=0)
+                    cnt += b.shape[0]
+        if cnt < 1:
+            raise ValueError("empty dataset")
+        n = nz - 1
+        lam = float(self.getRegParam())
+        gxx, gxy = g[:n, :n], g[:n, n]
+        if self.getFitIntercept():
+            mu = s / cnt
+            mu_x, mu_y = mu[:n], mu[n]
+            a = gxx / cnt - np.outer(mu_x, mu_x)
+            b = gxy / cnt - mu_x * mu_y
+            coef = np.linalg.solve(a + lam * np.eye(n), b)
+            intercept = mu_y - mu_x @ coef
+        else:
+            a = gxx / cnt
+            b = gxy / cnt
+            coef = np.linalg.solve(a + lam * np.eye(n), b)
+            intercept = 0.0
+        return coef, intercept
 
     def _fit_xla(self, x, y, timer):
         import jax
@@ -116,6 +191,59 @@ class LinearRegression(LinearRegressionParams):
             coef = np.linalg.solve(a + lam * np.eye(x.shape[1]), b)
             intercept = (y.mean() - x.mean(axis=0) @ coef) if self.getFitIntercept() else 0.0
         return coef, intercept
+
+
+def _zip_xy(chunk) -> np.ndarray:
+    """(X_chunk, y_chunk) → Z_chunk = [X | y]."""
+    if not (isinstance(chunk, tuple) and len(chunk) == 2):
+        raise ValueError(
+            "streamed LinearRegression chunks must be (X, y) tuples"
+        )
+    x, y = chunk
+    x = np.asarray(x)
+    if x.ndim == 1:
+        x = x[None, :]
+    y = np.asarray(y)
+    # Promote to a common float dtype (at least f32) — casting y to x's
+    # dtype would silently floor float labels when X chunks are integer.
+    dt = np.promote_types(np.result_type(x.dtype, y.dtype), np.float32)
+    x = x.astype(dt, copy=False)
+    y = y.astype(dt, copy=False).reshape(-1, 1)
+    if y.shape[0] != x.shape[0]:
+        raise ValueError(
+            f"chunk labels length {y.shape[0]} != chunk rows {x.shape[0]}"
+        )
+    return np.concatenate([x, y], axis=1)
+
+
+def _streaming_xy_source(dataset, labels):
+    """BatchSource over Z=[X|y] for generator/callable inputs, else None."""
+    from spark_rapids_ml_tpu.data.batches import BatchSource
+
+    if callable(dataset) and labels is None:
+        return BatchSource(
+            lambda: (_zip_xy(c) for c in dataset()),
+            batch_rows=0,
+        )
+    if hasattr(dataset, "__next__") and labels is None:
+        return BatchSource(
+            (_zip_xy(c) for c in dataset), batch_rows=0
+        )
+    return None
+
+
+def _xy_batch_source(x: np.ndarray, y: np.ndarray):
+    """Re-iterable Z=[X|y] source over big in-memory arrays, chunk-wise (no
+    whole-matrix hstack copy)."""
+    from spark_rapids_ml_tpu.data.batches import BatchSource, auto_batch_rows
+
+    rows = auto_batch_rows(x.shape[1] + 1)
+
+    def chunks():
+        for i in range(0, x.shape[0], rows):
+            yield _zip_xy((x[i:i + rows], y[i:i + rows]))
+
+    return BatchSource(chunks, batch_rows=rows, n_features=x.shape[1] + 1)
 
 
 class LinearRegressionModel(LinearRegressionParams):

@@ -79,6 +79,13 @@ class PCAParams(HasInputCol, HasOutputCol, HasDeviceId):
         "auto",
         validator=lambda v: v in ("auto", "float32", "float64"),
     )
+    batchRows = Param(
+        "batchRows",
+        "rows per streamed device batch for out-of-core fits; 0 = auto-size "
+        "so one f32 batch is ~128 MiB",
+        0,
+        validator=lambda v: isinstance(v, int) and v >= 0,
+    )
 
 
 def _resolve_dtype(dtype_param: str):
@@ -166,25 +173,54 @@ class PCA(PCAParams):
 
     def fit(self, dataset) -> "PCAModel":
         timer = PhaseTimer()
-        frame = as_vector_frame(dataset, self.getInputCol())
-        with timer.phase("densify"):
-            x_host = frame.vectors_as_matrix(self.getInputCol())
-        n_rows, n_features = x_host.shape
         k = self.getK()
         if k is None:
             raise ValueError("k must be set before fit()")
-        if k > n_features:
-            raise ValueError(
-                f"k = {k} must be at most the number of features {n_features}"
-            )
-        if n_rows < 2 and self.getMeanCentering():
-            # matches `require(count > 1)` (RapidsRowMatrix.scala:160)
-            raise ValueError("mean centering requires more than one row")
 
         use_xla_dot = self.getUseXlaDot()
         use_xla_svd = self.getUseXlaSvd()
 
-        if use_xla_dot or use_xla_svd:
+        from spark_rapids_ml_tpu.data.batches import streaming_source
+
+        source = streaming_source(dataset, self.getBatchRows())
+        if source is None:
+            frame = as_vector_frame(dataset, self.getInputCol())
+            with timer.phase("densify"):
+                x_host = frame.vectors_as_matrix(self.getInputCol())
+            n_rows, n_features = x_host.shape
+            if k > n_features:
+                raise ValueError(
+                    f"k = {k} must be at most the number of features "
+                    f"{n_features}"
+                )
+            if n_rows < 2 and self.getMeanCentering():
+                # matches `require(count > 1)` (RapidsRowMatrix.scala:160)
+                raise ValueError("mean centering requires more than one row")
+            from spark_rapids_ml_tpu.data.batches import (
+                BatchSource,
+                stream_threshold_bytes,
+            )
+
+            if (
+                use_xla_dot
+                and x_host.nbytes > stream_threshold_bytes()
+            ):
+                # Out-of-HBM: stream buckets through the device accumulator
+                # instead of one whole-matrix device_put — the analogue of
+                # the reference's per-partition chunking
+                # (RapidsRowMatrix.scala:168-202).
+                source = BatchSource(x_host, batch_rows=self.getBatchRows())
+
+        if source is not None:
+            if k > source.n_features:
+                raise ValueError(
+                    f"k = {k} must be at most the number of features "
+                    f"{source.n_features}"
+                )
+            pc, evr, mean = self._fit_streamed(
+                source, k, use_xla_dot, use_xla_svd, timer
+            )
+        elif use_xla_dot or use_xla_svd:
             pc, evr, mean = self._fit_xla(
                 x_host, k, use_xla_dot, use_xla_svd, timer
             )
@@ -200,6 +236,61 @@ class PCA(PCAParams):
         model.copy_values_from(self)
         model.fit_timings_ = timer.as_dict()
         return model
+
+    # -- streamed (out-of-core) path -------------------------------------
+    def _fit_streamed(self, source, k, use_xla_dot, use_xla_svd, timer):
+        if use_xla_dot:
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
+            from spark_rapids_ml_tpu.ops.streaming import stream_covariance
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            with timer.phase("covariance"), TraceRange(
+                "streamed cov", TraceColor.RED
+            ):
+                cov, mean, count = stream_covariance(
+                    source,
+                    mean_centering=self.getMeanCentering(),
+                    dtype=dtype,
+                    device=device,
+                )
+                cov = jax.block_until_ready(cov)
+            if self.getMeanCentering() and float(count) < 2:
+                raise ValueError("mean centering requires more than one row")
+            if use_xla_svd:
+                with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
+                    pc, evr = jax.block_until_ready(pca_from_covariance(cov, k))
+                return np.asarray(pc), np.asarray(evr), np.asarray(mean)
+            with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
+                pc, evr = _host_eig_topk(np.asarray(cov, dtype=np.float64), k)
+            return pc, evr, np.asarray(mean)
+
+        # Host accumulation (useXlaDot=False) — out-of-core on the host in
+        # float64, then device or host eigensolve per useXlaSvd.
+        with timer.phase("covariance"), TraceRange("host cov", TraceColor.ORANGE):
+            cov, mean, count = _host_covariance_streamed(
+                source, self.getMeanCentering()
+            )
+        if self.getMeanCentering() and count < 2:
+            raise ValueError("mean centering requires more than one row")
+        if use_xla_svd:
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
+                cov_dev = jax.device_put(jnp.asarray(cov, dtype=dtype), device)
+                pc, evr = jax.block_until_ready(pca_from_covariance(cov_dev, k))
+            return np.asarray(pc), np.asarray(evr), mean
+        with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
+            pc, evr = _host_eig_topk(cov, k)
+        return pc, evr, mean
 
     # -- XLA (accelerator) path ------------------------------------------
     def _fit_xla(self, x_host, k, use_xla_dot, use_xla_svd, timer):
@@ -257,6 +348,45 @@ class PCA(PCAParams):
         with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
             pc, evr = _host_eig_topk(cov, k)
         return pc, evr, mean
+
+
+def _host_covariance_streamed(source, mean_centering: bool):
+    """Out-of-core host covariance: float64 accumulation per bucket.
+
+    Two-pass (mean, then centered Gram) for re-iterable sources — the same
+    schedule the device path uses; one-pass sufficient statistics otherwise.
+    """
+    n = source.n_features
+    if mean_centering and source.reiterable:
+        col_sum = np.zeros(n)
+        count = 0
+        for batch, mask in source.batches():
+            b = batch if mask is None else batch[mask]
+            col_sum += b.sum(axis=0)
+            count += b.shape[0]
+        mean = col_sum / max(count, 1)
+        g = np.zeros((n, n))
+        for batch, mask in source.batches():
+            b = batch if mask is None else batch[mask]
+            bc = np.asarray(b, dtype=np.float64) - mean
+            g += bc.T @ bc
+        return g / max(count - 1, 1), mean, count
+
+    g = np.zeros((n, n))
+    col_sum = np.zeros(n)
+    count = 0
+    for batch, mask in source.batches():
+        b = batch if mask is None else batch[mask]
+        b = np.asarray(b, dtype=np.float64)
+        g += b.T @ b
+        col_sum += b.sum(axis=0)
+        count += b.shape[0]
+    denom = max(count - 1, 1)
+    if not mean_centering:
+        return g / denom, np.zeros(n), count
+    mean = col_sum / max(count, 1)
+    cov = (g - count * np.outer(mean, mean)) / denom
+    return cov, mean, count
 
 
 def _host_covariance(x: np.ndarray, mean_centering: bool):

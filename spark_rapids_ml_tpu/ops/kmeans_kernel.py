@@ -146,6 +146,27 @@ def lloyd_iterations(
     return KMeansResult(centers, cost, n_iter, converged)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def update_cluster_stats(
+    carry,
+    centers: jnp.ndarray,
+    batch: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+):
+    """Out-of-core Lloyd building block: fold one batch's per-cluster
+    (Σx, count, cost) into a donated accumulator. One streamed pass with
+    this per batch = one Lloyd assignment half-step over the full dataset,
+    HBM bounded at one batch + one (k, n) accumulator."""
+    sums, counts, cost = carry
+    valid = (
+        jnp.ones(batch.shape[0], dtype=batch.dtype)
+        if mask is None
+        else mask.astype(batch.dtype)
+    )
+    s, c, co = _cluster_stats(batch.astype(sums.dtype), centers, valid)
+    return sums + s, counts + c, cost + co
+
+
 @partial(jax.jit, static_argnames=("max_iter",))
 def kmeans_fit_kernel(
     x: jnp.ndarray,

@@ -92,3 +92,102 @@ class StreamingPCA:
         return jax.block_until_ready(
             finalize_stats(self._stats, k, mean_centering=mean_centering)
         )
+
+
+# -- two-pass streaming (exact reference semantics, out-of-core) -----------
+#
+# The one-pass (Σxxᵀ, Σx, n) accumulator above loses accuracy to f32
+# cancellation in G − n·μμᵀ when |μ| ≫ σ. A re-iterable source affords the
+# reference's own schedule out-of-core: pass 1 streams (Σx, n) → μ, pass 2
+# streams the CENTERED Gram — numerically the two-pass fit kernel, with HBM
+# bounded at one batch + one n×n accumulator.
+
+class MeanStats(NamedTuple):
+    col_sum: jnp.ndarray
+    count: jnp.ndarray
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_mean_stats(
+    stats: MeanStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+) -> MeanStats:
+    from spark_rapids_ml_tpu.ops.covariance import _masked, row_count
+
+    b = batch.astype(stats.col_sum.dtype)
+    return MeanStats(
+        stats.col_sum + jnp.sum(_masked(b, mask), axis=0),
+        stats.count + row_count(b, mask),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_centered_gram(
+    gram_acc: jnp.ndarray,
+    batch: jnp.ndarray,
+    mean: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    from spark_rapids_ml_tpu.ops.covariance import _masked, gram
+
+    b = batch.astype(gram_acc.dtype) - mean[None, :]
+    return gram_acc + gram(_masked(b, mask))
+
+
+def stream_covariance(
+    source,
+    mean_centering: bool = True,
+    dtype=jnp.float32,
+    device=None,
+):
+    """Stream a ``data.batches.BatchSource`` into (covariance, mean, count).
+
+    Two-pass (center → Gram) when the source is re-iterable and centering is
+    requested; one-pass sufficient statistics otherwise. Returns device
+    arrays; covariance is normalized by n−1 as everywhere in this package.
+    """
+    n = source.n_features
+    if mean_centering and source.reiterable:
+        mstats = MeanStats(
+            jnp.zeros((n,), dtype=dtype), jnp.zeros((), dtype=dtype)
+        )
+        if device is not None:
+            mstats = jax.device_put(mstats, device)
+        for batch, mask in source.batches():
+            mstats = update_mean_stats(mstats, jnp.asarray(batch, dtype=dtype),
+                                       None if mask is None else jnp.asarray(mask))
+        count = mstats.count
+        mean = mstats.col_sum / count
+        gram_acc = jnp.zeros((n, n), dtype=dtype)
+        if device is not None:
+            gram_acc = jax.device_put(gram_acc, device)
+        pass2_rows = 0
+        for batch, mask in source.batches():
+            pass2_rows += batch.shape[0] if mask is None else int(mask.sum())
+            gram_acc = update_centered_gram(
+                gram_acc, jnp.asarray(batch, dtype=dtype), mean,
+                None if mask is None else jnp.asarray(mask))
+        if pass2_rows != int(count):
+            # A "re-iterable" factory that hands back a partially-consumed
+            # iterator would silently zero the Gram; fail instead.
+            raise RuntimeError(
+                f"two-pass streaming saw {int(count)} rows on pass 1 but "
+                f"{pass2_rows} on pass 2; the source factory must return a "
+                f"FRESH iterator on every call"
+            )
+        denom = jnp.maximum(count - 1, 1)
+        return gram_acc / denom, mean, count
+
+    stats = init_stats(n, dtype=dtype, device=device)
+    for batch, mask in source.batches():
+        stats = update_stats(stats, jnp.asarray(batch, dtype=dtype),
+                             None if mask is None else jnp.asarray(mask))
+    cov = covariance_from_stats(
+        stats.gram, stats.col_sum, stats.count, mean_centering=mean_centering
+    )
+    if mean_centering:
+        mean = stats.col_sum / stats.count
+    else:
+        mean = jnp.zeros_like(stats.col_sum)
+    return cov, mean, stats.count
+
+
