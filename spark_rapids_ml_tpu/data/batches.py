@@ -104,10 +104,16 @@ class BatchSource:
     """
 
     def __init__(self, source, batch_rows: int = 0,
-                 n_features: Optional[int] = None):
+                 n_features: Optional[int] = None, chunk_transform=None):
+        """``chunk_transform`` (chunk → 2-D array) runs on each raw chunk
+        BEFORE re-blocking — callers with structured chunks (e.g.
+        LinearRegression's (X, y) pairs) pass it here instead of wrapping
+        the source in a generator expression, which would defeat the
+        non-fresh-factory detection below."""
         self._matrix: Optional[np.ndarray] = None
         self._factory = None
         self._oneshot: Optional[Iterator] = None
+        self._transform = chunk_transform
 
         if callable(source):
             # A factory must produce a FRESH iterator per call. `lambda: gen`
@@ -122,7 +128,7 @@ class BatchSource:
             else:
                 self._factory = source
         elif isinstance(source, (list, tuple)):
-            chunks = [_as_chunk(c) for c in source]
+            chunks = [self._prep(c) for c in source]
             self._factory = lambda: iter(chunks)
         elif hasattr(source, "__array__") or isinstance(source, np.ndarray):
             self._matrix = np.asarray(source)
@@ -136,6 +142,7 @@ class BatchSource:
             )
 
         self._consumed = False
+        self._first_pass_rows: Optional[int] = None
         self.n_features = n_features
         self._peeked: Optional[np.ndarray] = None
         if self._matrix is not None:
@@ -144,7 +151,7 @@ class BatchSource:
             # Peek one chunk to learn the width (stashed and re-yielded).
             it = self._factory() if self._factory else self._oneshot
             try:
-                first = _as_chunk(next(iter(it)))
+                first = self._prep(next(iter(it)))
             except StopIteration:
                 raise ValueError("batch source is empty") from None
             self.n_features = first.shape[1]
@@ -164,6 +171,11 @@ class BatchSource:
     def reiterable(self) -> bool:
         return self._matrix is not None or self._factory is not None
 
+    def _prep(self, chunk) -> np.ndarray:
+        if self._transform is not None:
+            chunk = self._transform(chunk)
+        return _as_chunk(chunk)
+
     def _chunks(self) -> Iterator[np.ndarray]:
         if self._matrix is not None:
             b = self.batch_rows
@@ -172,7 +184,7 @@ class BatchSource:
             return
         if self._factory is not None:
             for c in self._factory():
-                yield _as_chunk(c)
+                yield self._prep(c)
             return
         if self._consumed:
             raise RuntimeError(
@@ -185,14 +197,22 @@ class BatchSource:
             yield self._peeked
             self._peeked = None
         for c in self._oneshot:
-            yield _as_chunk(c)
+            yield self._prep(c)
 
     def batches(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
-        """Yield fixed-shape ``(batch, mask)`` pairs; mask None = all valid."""
+        """Yield fixed-shape ``(batch, mask)`` pairs; mask None = all valid.
+
+        Every FULLY-consumed pass must see the same number of rows as the
+        first one — a "re-iterable" factory that actually hands back a
+        shared, partially-exhausted underlying iterator (one the identity
+        check in ``__init__`` cannot see, e.g. ``lambda: map(f, shared_gen)``)
+        would otherwise silently zero out second-pass accumulations."""
         b, n = self.batch_rows, self.n_features
         carry: list = []
         carry_rows = 0
+        pass_rows = 0
         for chunk in self._chunks():
+            pass_rows += chunk.shape[0]
             if chunk.shape[1] != n:
                 raise ValueError(
                     f"chunk has {chunk.shape[1]} features, expected {n}"
@@ -216,12 +236,19 @@ class BatchSource:
                 carry.append(chunk[start:])
                 carry_rows += chunk.shape[0] - start
         if carry_rows:
+            # the fill stage flushes exactly at b, so any remainder here is
+            # strictly short: pad + mask
             tail = np.concatenate(carry, axis=0) if len(carry) > 1 else carry[0]
-            if carry_rows == b:
-                yield tail, None
-            else:
-                padded = np.zeros((b, n), dtype=tail.dtype)
-                padded[:carry_rows] = tail
-                mask = np.zeros((b,), dtype=bool)
-                mask[:carry_rows] = True
-                yield padded, mask
+            padded = np.zeros((b, n), dtype=tail.dtype)
+            padded[:carry_rows] = tail
+            mask = np.zeros((b,), dtype=bool)
+            mask[:carry_rows] = True
+            yield padded, mask
+        if self._first_pass_rows is None:
+            self._first_pass_rows = pass_rows
+        elif pass_rows != self._first_pass_rows:
+            raise RuntimeError(
+                f"streaming pass saw {pass_rows} rows but the first pass saw "
+                f"{self._first_pass_rows}; the source factory must return a "
+                f"FRESH iterator over the same data on every call"
+            )

@@ -54,11 +54,34 @@ _SPARK_CLASS_ALIASES = {
     "PipelineModel": "org.apache.spark.ml.PipelineModel",
 }
 
+# Params a real Spark DefaultParamsReader recognizes per class. Extras
+# (useXlaDot, deviceId, ...) would make pyspark's getAndSetParams throw
+# "cannot recognize param", so they travel under the top-level
+# 'tpuParamMap' key, which Spark readers ignore; our reader merges both.
+_SPARK_PARAM_ALLOWLIST = {
+    "PCA": {"k", "inputCol", "outputCol"},
+    "PCAModel": {"k", "inputCol", "outputCol"},
+    "KMeans": {"k", "maxIter", "tol", "seed", "predictionCol"},
+    "KMeansModel": {"k", "maxIter", "tol", "seed", "predictionCol"},
+    "LinearRegression": {"labelCol", "predictionCol", "fitIntercept",
+                         "regParam"},
+    "LinearRegressionModel": {"labelCol", "predictionCol", "fitIntercept",
+                              "regParam"},
+    "StandardScaler": {"withMean", "withStd", "inputCol", "outputCol"},
+    "StandardScalerModel": {"withMean", "withStd", "inputCol", "outputCol"},
+}
+
 
 def _write_metadata(path: str, cls: str, uid: str, param_map: Dict[str, Any]) -> None:
     meta_dir = os.path.join(path, "metadata")
     os.makedirs(meta_dir, exist_ok=True)
     simple_name = cls.rsplit(".", 1)[-1]
+    allowed = _SPARK_PARAM_ALLOWLIST.get(simple_name)
+    if allowed is None:
+        spark_params, extra_params = param_map, {}
+    else:
+        spark_params = {k: v for k, v in param_map.items() if k in allowed}
+        extra_params = {k: v for k, v in param_map.items() if k not in allowed}
     metadata = {
         "class": _SPARK_CLASS_ALIASES.get(simple_name, cls),
         "pythonClass": cls,
@@ -66,8 +89,9 @@ def _write_metadata(path: str, cls: str, uid: str, param_map: Dict[str, Any]) ->
         "sparkVersion": "3.1.2",  # wire-format vintage (reference pom.xml:68)
         "frameworkVersion": _FORMAT_VERSION,
         "uid": uid,
-        "paramMap": param_map,
+        "paramMap": spark_params,
         "defaultParamMap": {},
+        "tpuParamMap": extra_params,
     }
     with open(os.path.join(meta_dir, "part-00000"), "w") as f:
         f.write(json.dumps(metadata))
@@ -88,10 +112,12 @@ def save_params(estimator, path: str, overwrite: bool = False) -> None:
 
 def _restore_params(obj, meta: Dict[str, Any]):
     """Apply metadata paramMap onto a Params object (Spark's
-    ``metadata.getAndSetParams``, ``RapidsPCA.scala:251``)."""
-    for name, value in meta.get("paramMap", {}).items():
-        if obj.has_param(name) and value is not None:
-            obj.set(name, value)
+    ``metadata.getAndSetParams``, ``RapidsPCA.scala:251``). Extension
+    params live under 'tpuParamMap' (see ``_write_metadata``)."""
+    for key in ("paramMap", "tpuParamMap"):
+        for name, value in meta.get(key, {}).items():
+            if obj.has_param(name) and value is not None:
+                obj.set(name, value)
     return obj
 
 
@@ -171,10 +197,90 @@ def _vector_arrow_type():
     )
 
 
-def _write_data_row(path: str, row: Dict[str, Any], schema=None) -> None:
+# Spark catalyst type JSON for the ml.linalg UDTs — written into the parquet
+# footer under 'org.apache.spark.sql.parquet.row.metadata' so a real Spark
+# reader deserializes the struct columns as Matrix/Vector values instead of
+# plain Rows (the mechanism behind `spark.read.parquet(path/"data")` in
+# ``RapidsPCA.scala:245-249``).
+_MATRIX_UDT_JSON = {
+    "type": "udt",
+    "class": "org.apache.spark.ml.linalg.MatrixUDT",
+    "pyClass": "pyspark.ml.linalg.MatrixUDT",
+    "sqlType": {
+        "type": "struct",
+        "fields": [
+            {"name": "type", "type": "byte", "nullable": False, "metadata": {}},
+            {"name": "numRows", "type": "integer", "nullable": False,
+             "metadata": {}},
+            {"name": "numCols", "type": "integer", "nullable": False,
+             "metadata": {}},
+            {"name": "colPtrs",
+             "type": {"type": "array", "elementType": "integer",
+                      "containsNull": False},
+             "nullable": True, "metadata": {}},
+            {"name": "rowIndices",
+             "type": {"type": "array", "elementType": "integer",
+                      "containsNull": False},
+             "nullable": True, "metadata": {}},
+            {"name": "values",
+             "type": {"type": "array", "elementType": "double",
+                      "containsNull": False},
+             "nullable": True, "metadata": {}},
+            {"name": "isTransposed", "type": "boolean", "nullable": False,
+             "metadata": {}},
+        ],
+    },
+}
+
+_VECTOR_UDT_JSON = {
+    "type": "udt",
+    "class": "org.apache.spark.ml.linalg.VectorUDT",
+    "pyClass": "pyspark.ml.linalg.VectorUDT",
+    "sqlType": {
+        "type": "struct",
+        "fields": [
+            {"name": "type", "type": "byte", "nullable": False, "metadata": {}},
+            {"name": "size", "type": "integer", "nullable": True,
+             "metadata": {}},
+            {"name": "indices",
+             "type": {"type": "array", "elementType": "integer",
+                      "containsNull": False},
+             "nullable": True, "metadata": {}},
+            {"name": "values",
+             "type": {"type": "array", "elementType": "double",
+                      "containsNull": False},
+             "nullable": True, "metadata": {}},
+        ],
+    },
+}
+
+_SPARK_FIELD_TYPES = {
+    "matrix": _MATRIX_UDT_JSON,
+    "vector": _VECTOR_UDT_JSON,
+    "double": "double",
+    "long": "long",
+}
+
+
+def spark_row_metadata(fields) -> str:
+    """Catalyst StructType JSON for ``(name, kind)`` pairs; kind is one of
+    ``_SPARK_FIELD_TYPES``."""
+    return json.dumps({
+        "type": "struct",
+        "fields": [
+            {"name": name, "type": _SPARK_FIELD_TYPES[kind],
+             "nullable": True, "metadata": {}}
+            for name, kind in fields
+        ],
+    })
+
+
+def _write_data_row(path: str, row: Dict[str, Any], schema=None,
+                    spark_fields=None) -> None:
     """Single-row payload as Parquet (pyarrow), JSON fallback otherwise —
     the reference repartitions to 1 before writing (``RapidsPCA.scala:223``),
-    so one file is exactly its on-disk shape."""
+    so one file is exactly its on-disk shape. ``spark_fields`` adds the
+    Spark row-metadata footer entry declaring UDT columns."""
     data_dir = os.path.join(path, "data")
     os.makedirs(data_dir, exist_ok=True)
     try:
@@ -182,6 +288,11 @@ def _write_data_row(path: str, row: Dict[str, Any], schema=None) -> None:
         import pyarrow.parquet as pq
 
         table = pa.Table.from_pylist([row], schema=schema)
+        if spark_fields is not None:
+            table = table.replace_schema_metadata({
+                "org.apache.spark.sql.parquet.row.metadata":
+                    spark_row_metadata(spark_fields)
+            })
         pq.write_table(table, os.path.join(data_dir, "part-00000.parquet"))
     except ImportError:  # pragma: no cover - pyarrow is baked in
         with open(os.path.join(data_dir, "part-00000.json"), "w") as f:
@@ -233,7 +344,9 @@ def save_pca_model(model, path: str, overwrite: bool = False) -> None:
         )
     except ImportError:  # pragma: no cover
         schema = None
-    _write_data_row(path, row, schema=schema)
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("pc", "matrix"), ("explainedVariance", "vector"), ("mean", "vector"),
+    ])
 
 
 def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
@@ -259,7 +372,9 @@ def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
         )
     except ImportError:  # pragma: no cover
         schema = None
-    _write_data_row(path, row, schema=schema)
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("clusterCenters", "matrix"), ("trainingCost", "double"),
+    ])
 
 
 def load_kmeans_model(path: str):
@@ -298,7 +413,9 @@ def save_linreg_model(model, path: str, overwrite: bool = False) -> None:
         )
     except ImportError:  # pragma: no cover
         schema = None
-    _write_data_row(path, row, schema=schema)
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("coefficients", "vector"), ("intercept", "double"), ("scale", "double"),
+    ])
 
 
 def load_linreg_model(path: str):
@@ -346,7 +463,9 @@ def save_svd_model(model, path: str, overwrite: bool = False) -> None:
         )
     except ImportError:  # pragma: no cover
         schema = None
-    _write_data_row(path, row, schema=schema)
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("V", "matrix"), ("s", "vector"),
+    ])
 
 
 def load_svd_model(path: str):
@@ -380,7 +499,9 @@ def save_scaler_model(model, path: str, overwrite: bool = False) -> None:
         )
     except ImportError:  # pragma: no cover
         schema = None
-    _write_data_row(path, row, schema=schema)
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("mean", "vector"), ("std", "vector"),
+    ])
 
 
 def load_scaler_model(path: str):

@@ -166,7 +166,8 @@ class KMeans(KMeansParams):
             carry = jax.device_put(
                 (
                     jnp.zeros((k, n), dtype=dtype),
-                    jnp.zeros((k,), dtype=dtype),
+                    # int32 counts: exact past 2^24 rows per cluster
+                    jnp.zeros((k,), dtype=jnp.int32),
                     jnp.zeros((), dtype=dtype),
                 ),
                 device,
@@ -181,7 +182,7 @@ class KMeans(KMeansParams):
         with timer.phase("fit_kernel"), TraceRange("kmeans streamed", TraceColor.GREEN):
             for n_iter in range(1, self.getMaxIter() + 1):
                 sums, counts, _ = pass_stats(centers_dev)
-                safe = jnp.maximum(counts, 1.0)[:, None]
+                safe = jnp.maximum(counts, 1).astype(dtype)[:, None]
                 new_centers = jnp.where(
                     counts[:, None] > 0, sums / safe, centers_dev
                 )

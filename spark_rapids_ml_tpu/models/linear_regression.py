@@ -217,18 +217,15 @@ def _zip_xy(chunk) -> np.ndarray:
 
 
 def _streaming_xy_source(dataset, labels):
-    """BatchSource over Z=[X|y] for generator/callable inputs, else None."""
+    """BatchSource over Z=[X|y] for generator/callable inputs, else None.
+
+    The user's callable/iterator goes to BatchSource UNWRAPPED (``_zip_xy``
+    rides along as ``chunk_transform``) so the non-fresh-factory detection
+    in ``BatchSource.__init__`` still sees the underlying iterator."""
     from spark_rapids_ml_tpu.data.batches import BatchSource
 
-    if callable(dataset) and labels is None:
-        return BatchSource(
-            lambda: (_zip_xy(c) for c in dataset()),
-            batch_rows=0,
-        )
-    if hasattr(dataset, "__next__") and labels is None:
-        return BatchSource(
-            (_zip_xy(c) for c in dataset), batch_rows=0
-        )
+    if labels is None and (callable(dataset) or hasattr(dataset, "__next__")):
+        return BatchSource(dataset, batch_rows=0, chunk_transform=_zip_xy)
     return None
 
 
@@ -241,9 +238,10 @@ def _xy_batch_source(x: np.ndarray, y: np.ndarray):
 
     def chunks():
         for i in range(0, x.shape[0], rows):
-            yield _zip_xy((x[i:i + rows], y[i:i + rows]))
+            yield (x[i:i + rows], y[i:i + rows])
 
-    return BatchSource(chunks, batch_rows=rows, n_features=x.shape[1] + 1)
+    return BatchSource(chunks, batch_rows=rows, n_features=x.shape[1] + 1,
+                       chunk_transform=_zip_xy)
 
 
 class LinearRegressionModel(LinearRegressionParams):

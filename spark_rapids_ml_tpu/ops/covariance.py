@@ -61,10 +61,17 @@ def _masked(x: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
 
 
 def row_count(x: jnp.ndarray, mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Number of valid rows (scalar, same dtype as x)."""
+    """Number of valid rows (scalar int32).
+
+    Integer, NOT x's dtype: accumulated f32 counts stop being exact at 2²⁴
+    (16.7M) rows — squarely inside the out-of-core/streaming regime — and
+    would silently corrupt the mean and the ``n·μμᵀ`` correction. int32 is
+    exact to 2.1e9 rows and TPU-native (x64 off would demote int64 anyway).
+    Callers divide by it / scale with it, which promotes to float as needed.
+    """
     if mask is None:
-        return jnp.asarray(x.shape[0], dtype=x.dtype)
-    return jnp.sum(mask).astype(x.dtype)
+        return jnp.asarray(x.shape[0], dtype=jnp.int32)
+    return jnp.sum(mask).astype(jnp.int32)
 
 
 def column_means(x: jnp.ndarray, mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:

@@ -164,7 +164,9 @@ def update_cluster_stats(
         else mask.astype(batch.dtype)
     )
     s, c, co = _cluster_stats(batch.astype(sums.dtype), centers, valid)
-    return sums + s, counts + c, cost + co
+    # per-batch one-hot counts are exact integers in f32; accumulate in the
+    # carry's integer dtype so totals stay exact past 2^24 rows
+    return sums + s, counts + c.astype(counts.dtype), cost + co
 
 
 @partial(jax.jit, static_argnames=("max_iter",))

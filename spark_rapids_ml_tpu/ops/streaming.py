@@ -39,7 +39,9 @@ def init_stats(n_features: int, dtype=jnp.float32, device=None) -> GramStats:
     stats = GramStats(
         gram=zeros((n_features, n_features)),
         col_sum=zeros((n_features,)),
-        count=jnp.zeros((), dtype=dtype),
+        # int32, not the compute dtype: f32 counts lose exactness past 2^24
+        # rows (see ops.covariance.row_count)
+        count=jnp.zeros((), dtype=jnp.int32),
     )
     if device is not None:
         stats = jax.device_put(stats, device)
@@ -148,7 +150,7 @@ def stream_covariance(
     n = source.n_features
     if mean_centering and source.reiterable:
         mstats = MeanStats(
-            jnp.zeros((n,), dtype=dtype), jnp.zeros((), dtype=dtype)
+            jnp.zeros((n,), dtype=dtype), jnp.zeros((), dtype=jnp.int32)
         )
         if device is not None:
             mstats = jax.device_put(mstats, device)

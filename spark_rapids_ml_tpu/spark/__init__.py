@@ -1,0 +1,37 @@
+"""Spark data-plane integration (optional dependency).
+
+``from spark_rapids_ml_tpu.spark import PCA`` is the one-import-change
+drop-in the reference advertises (``/root/reference/README.md:12-28``),
+running against real pyspark DataFrames. The Arrow aggregation logic lives
+in ``spark.aggregate`` and imports without pyspark; the Estimator/Model
+classes require it.
+"""
+
+from spark_rapids_ml_tpu.spark.aggregate import (  # noqa: F401
+    combine_stats,
+    finalize_pca_from_stats,
+    partition_gram_stats,
+    vector_column_to_matrix,
+)
+
+__all__ = [
+    "PCA",
+    "PCAModel",
+    "combine_stats",
+    "finalize_pca_from_stats",
+    "partition_gram_stats",
+    "vector_column_to_matrix",
+]
+
+
+def __getattr__(name):
+    if name in ("PCA", "PCAModel"):
+        try:
+            from spark_rapids_ml_tpu.spark import estimator
+        except ImportError as exc:  # pragma: no cover - depends on env
+            raise ImportError(
+                "spark_rapids_ml_tpu.spark.PCA requires pyspark "
+                "(an optional dependency): pip install pyspark"
+            ) from exc
+        return getattr(estimator, name)
+    raise AttributeError(name)

@@ -1,0 +1,283 @@
+"""pyspark-facing PCA Estimator/Model: the drop-in the reference ships.
+
+The reference is consumed from spark-shell as a one-import-change drop-in
+over Spark DataFrames (``/root/reference/README.md:12-28``); its ``fit``
+pulls an ``RDD[Vector]`` (``RapidsPCA.scala:111-125``) and runs one GPU GEMM
+per partition on executors (``RapidsRowMatrix.scala:168-202``). This module
+is that front-end for the TPU framework:
+
+* ``fit(df)``: ``mapInArrow`` over the input column — executors densify
+  Arrow vector batches and emit per-partition sufficient statistics
+  (``spark.aggregate``, no JVM→Python per-row hop) — then a driver-side
+  combine and a one-program finalize on the driver's accelerator, exactly
+  where the reference put its driver-GPU ``calSVD``
+  (``RapidsRowMatrix.scala:94-95``).
+* ``transform(df)``: batched projection via a pandas UDF (Arrow transport),
+  the path the reference left disabled ("TODO(rongou): make this faster",
+  ``RapidsPCA.scala:172-190``).
+* persistence: the shared Spark-ML metadata+Parquet wire format
+  (``io.persistence``), so models round-trip with plain ``pyspark.ml``.
+
+Requires ``pyspark`` (an optional dependency); everything importable
+without it lives in ``spark.aggregate``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pyspark import keyword_only
+from pyspark.ml import Estimator, Model
+from pyspark.ml.linalg import DenseMatrix, DenseVector, VectorUDT
+from pyspark.ml.param import Param, Params, TypeConverters
+from pyspark.ml.param.shared import HasInputCol, HasOutputCol
+
+from spark_rapids_ml_tpu.spark.aggregate import (
+    combine_stats,
+    finalize_pca_from_stats,
+    partition_gram_stats_arrow,
+    stats_spark_ddl,
+)
+
+
+class _TpuPCAParams(HasInputCol, HasOutputCol):
+    """Param surface mirroring ``RapidsPCAParams`` (``RapidsPCA.scala:30-75``)
+    with the reference's GPU toggles renamed to their XLA analogues."""
+
+    k = Param(Params._dummy(), "k", "number of principal components",
+              typeConverter=TypeConverters.toInt)
+    meanCentering = Param(Params._dummy(), "meanCentering",
+                          "center data before covariance",
+                          typeConverter=TypeConverters.toBoolean)
+    useXlaDot = Param(Params._dummy(), "useXlaDot",
+                      "finalize covariance/transform on the accelerator",
+                      typeConverter=TypeConverters.toBoolean)
+    useXlaSvd = Param(Params._dummy(), "useXlaSvd",
+                      "eigensolve on the accelerator",
+                      typeConverter=TypeConverters.toBoolean)
+    deviceId = Param(Params._dummy(), "deviceId",
+                     "driver accelerator ordinal; -1 = task/env assignment",
+                     typeConverter=TypeConverters.toInt)
+
+    def __init__(self):
+        super().__init__()
+        self._setDefault(k=None, meanCentering=True, useXlaDot=True,
+                         useXlaSvd=True, deviceId=-1)
+
+    def getK(self):
+        return self.getOrDefault(self.k)
+
+    def getMeanCentering(self):
+        return self.getOrDefault(self.meanCentering)
+
+    def getUseXlaDot(self):
+        return self.getOrDefault(self.useXlaDot)
+
+    def getUseXlaSvd(self):
+        return self.getOrDefault(self.useXlaSvd)
+
+    def getDeviceId(self):
+        return self.getOrDefault(self.deviceId)
+
+
+class PCA(Estimator, _TpuPCAParams):
+    """``PCA(k=3, inputCol="features", outputCol="pca_features").fit(df)`` —
+    the README example shape (``/root/reference/README.md:12-28``)."""
+
+    @keyword_only
+    def __init__(self, *, k=None, inputCol=None, outputCol="pca_features",
+                 meanCentering=True, useXlaDot=True, useXlaSvd=True,
+                 deviceId=-1):
+        super().__init__()
+        self._setDefault(outputCol="pca_features")
+        kwargs = self._input_kwargs
+        self.setParams(**{k_: v for k_, v in kwargs.items() if v is not None})
+
+    @keyword_only
+    def setParams(self, *, k=None, inputCol=None, outputCol=None,
+                  meanCentering=None, useXlaDot=None, useXlaSvd=None,
+                  deviceId=None):
+        kwargs = self._input_kwargs
+        return self._set(**{k_: v for k_, v in kwargs.items() if v is not None})
+
+    def setK(self, value):
+        return self._set(k=value)
+
+    def setInputCol(self, value):
+        return self._set(inputCol=value)
+
+    def setOutputCol(self, value):
+        return self._set(outputCol=value)
+
+    def setMeanCentering(self, value):
+        return self._set(meanCentering=value)
+
+    def setUseXlaDot(self, value):
+        return self._set(useXlaDot=value)
+
+    def setUseXlaSvd(self, value):
+        return self._set(useXlaSvd=value)
+
+    def setDeviceId(self, value):
+        return self._set(deviceId=value)
+
+    def _fit(self, dataset) -> "PCAModel":
+        k = self.getK()
+        if k is None:
+            raise ValueError("k must be set before fit()")
+        input_col = self.getInputCol()
+        df = dataset.select(input_col)
+
+        def stats(batches):
+            return partition_gram_stats_arrow(batches, input_col)
+
+        rows = df.mapInArrow(stats, stats_spark_ddl()).collect()
+        gram, col_sum, count = combine_stats(rows)
+        n_features = col_sum.shape[0]
+        if k > n_features:
+            raise ValueError(
+                f"k = {k} must be at most the number of features {n_features}"
+            )
+        pc, evr, mean = finalize_pca_from_stats(
+            gram, col_sum, count, k,
+            mean_centering=self.getMeanCentering(),
+            use_xla_svd=self.getUseXlaSvd(),
+            device_id=self.getDeviceId(),
+        )
+        model = PCAModel(
+            pc=DenseMatrix(n_features, k, pc.ravel(order="F").tolist()),
+            explainedVariance=DenseVector(evr.tolist()),
+            mean=DenseVector(mean.tolist()),
+        )
+        return self._copyValues(model)
+
+    def save(self, path: str) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(_LocalParamsProxy(self), path)
+
+    @staticmethod
+    def load(path: str) -> "PCA":
+        from spark_rapids_ml_tpu.io.persistence import _read_metadata
+
+        meta = _read_metadata(path)
+        est = PCA()
+        est._resetUid(meta["uid"])
+        _apply_param_map(est, meta.get("paramMap", {}))
+        _apply_param_map(est, meta.get("tpuParamMap", {}))
+        return est
+
+
+class PCAModel(Model, _TpuPCAParams):
+    """Fitted transformer: ``pc`` (n×k DenseMatrix), ``explainedVariance``
+    (k,), as ``RapidsPCAModel`` (``RapidsPCA.scala:146-210``)."""
+
+    def __init__(self, pc=None, explainedVariance=None, mean=None):
+        super().__init__()
+        self.pc = pc
+        self.explainedVariance = explainedVariance
+        self.mean = mean
+
+    def _transform(self, dataset):
+        import pandas as pd
+        from pyspark.sql.functions import pandas_udf
+
+        pc_np = self.pc.toArray()  # (n_features, k), column-major storage
+        out_col = self.getOutputCol()
+        use_xla = self.getUseXlaDot()
+        device_id = self.getDeviceId()
+
+        @pandas_udf(returnType=VectorUDT())
+        def project(v: pd.Series) -> pd.Series:
+            x = np.stack([row.toArray() for row in v])
+            if use_xla:
+                try:
+                    import jax
+                    import jax.numpy as jnp
+
+                    from spark_rapids_ml_tpu.models.pca import _resolve_device
+                    from spark_rapids_ml_tpu.ops.pca_kernel import (
+                        pca_transform_kernel,
+                    )
+
+                    device = _resolve_device(device_id)
+                    y = np.asarray(pca_transform_kernel(
+                        jax.device_put(jnp.asarray(x, dtype=jnp.float32), device),
+                        jax.device_put(jnp.asarray(pc_np, dtype=jnp.float32), device),
+                    ))
+                except Exception:
+                    y = x @ pc_np
+            else:
+                y = x @ pc_np
+            return pd.Series([DenseVector(row) for row in y])
+
+        return dataset.withColumn(out_col, project(dataset[self.getInputCol()]))
+
+    # -- persistence (shared wire format) ---------------------------------
+    def _to_local(self):
+        from spark_rapids_ml_tpu.models.pca import PCAModel as LocalPCAModel
+
+        local = LocalPCAModel(
+            pc=self.pc.toArray(),
+            explained_variance=self.explainedVariance.toArray(),
+            mean=self.mean.toArray() if self.mean is not None else None,
+            uid=self.uid,
+        )
+        for name in ("k", "inputCol", "outputCol", "meanCentering",
+                     "useXlaDot", "useXlaSvd", "deviceId"):
+            if self.isSet(getattr(self, name)) or self.hasDefault(getattr(self, name)):
+                value = self.getOrDefault(getattr(self, name))
+                if value is not None and local.has_param(name):
+                    local.set(name, value)
+        return local
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        self._to_local().save(path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "PCAModel":
+        from spark_rapids_ml_tpu.models.pca import PCAModel as LocalPCAModel
+
+        local = LocalPCAModel.load(path)
+        n, k = local.pc.shape
+        model = PCAModel(
+            pc=DenseMatrix(n, k, local.pc.ravel(order="F").tolist()),
+            explainedVariance=DenseVector(local.explained_variance.tolist()),
+            mean=(DenseVector(local.mean.tolist())
+                  if local.mean is not None else None),
+        )
+        model._resetUid(local.uid)
+        for name in ("k", "inputCol", "outputCol", "meanCentering",
+                     "useXlaDot", "useXlaSvd", "deviceId"):
+            if local.is_set(name):
+                model._set(**{name: local.get(name)})
+        return model
+
+
+class _LocalParamsProxy:
+    """Adapts a pyspark Params object to io.persistence's estimator
+    interface (uid + param_map_for_metadata)."""
+
+    def __init__(self, obj):
+        self._obj = obj
+        self.uid = obj.uid
+
+    def param_map_for_metadata(self):
+        out = {}
+        for p in self._obj.params:
+            if self._obj.isSet(p) or self._obj.hasDefault(p):
+                v = self._obj.getOrDefault(p)
+                if v is not None:
+                    out[p.name] = v
+        return out
+
+
+def _apply_param_map(obj, param_map):
+    for name, value in param_map.items():
+        if obj.hasParam(name) and value is not None:
+            obj._set(**{name: value})
+
+
+# type(estimator).__module__ resolution in save_params sees the proxy class;
+# keep the Spark class alias mapping working by naming it after PCA.
+_LocalParamsProxy.__qualname__ = "PCA"

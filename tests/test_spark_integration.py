@@ -1,0 +1,223 @@
+"""Spark data-plane integration: Arrow partition aggregation, the shared
+wire format's Spark-readability markers, and (when pyspark is installed) a
+real DataFrame fit + model round-trip.
+
+The reference is consumed as a spark-shell drop-in
+(``/root/reference/README.md:12-28``) validated by Spark's own
+``DefaultReadWriteTest`` (``PCASuite.scala:192-206``); these tests pin the
+same contracts. Integration tests skip when pyspark is absent (it is an
+optional dependency).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import PCA as LocalPCA
+from spark_rapids_ml_tpu.models.pca import PCAModel as LocalPCAModel
+from spark_rapids_ml_tpu.spark.aggregate import (
+    combine_stats,
+    finalize_pca_from_stats,
+    partition_gram_stats,
+    partition_gram_stats_arrow,
+    stats_arrow_schema,
+    vector_column_to_matrix,
+)
+
+
+@pytest.fixture
+def data(rng):
+    return rng.normal(size=(600, 10)) * np.linspace(1, 3, 10) + 1.0
+
+
+# -- Arrow ingestion -------------------------------------------------------
+
+def test_vector_column_dense_sparse_equivalence():
+    dense = [
+        {"type": 1, "size": None, "indices": None, "values": [1.0, 0.0, 2.0]},
+        {"type": 1, "size": None, "indices": None, "values": [0.0, 3.0, 0.0]},
+    ]
+    sparse = [
+        {"type": 0, "size": 3, "indices": [0, 2], "values": [1.0, 2.0]},
+        {"type": 0, "size": 3, "indices": [1], "values": [3.0]},
+    ]
+    plain = [[1.0, 0.0, 2.0], [0.0, 3.0, 0.0]]
+    expected = np.array([[1.0, 0.0, 2.0], [0.0, 3.0, 0.0]])
+    for col in (dense, sparse, plain, [dense[0], sparse[1]]):
+        np.testing.assert_array_equal(
+            vector_column_to_matrix(col), expected
+        )
+
+
+def test_vector_column_from_arrow_struct():
+    pa = pytest.importorskip("pyarrow")
+    col = pa.array(
+        [
+            {"type": 1, "size": None, "indices": None, "values": [1.0, 2.0]},
+            {"type": 0, "size": 2, "indices": [1], "values": [5.0]},
+        ]
+    )
+    np.testing.assert_array_equal(
+        vector_column_to_matrix(col), np.array([[1.0, 2.0], [0.0, 5.0]])
+    )
+
+
+# -- partition stats → combine → finalize ----------------------------------
+
+def test_partition_stats_combine_finalize_oracle(data):
+    # three uneven "partitions", plain-array form
+    parts = [data[:100], data[100:350], data[350:]]
+    rows = []
+    for p in parts:
+        rows.extend(partition_gram_stats([p], input_col="features"))
+    gram, col_sum, count = combine_stats(rows)
+    assert count == 600
+    pc, evr, mean = finalize_pca_from_stats(gram, col_sum, count, k=3)
+
+    oneshot = LocalPCA().setK(3).fit(data)
+    np.testing.assert_allclose(np.abs(pc), np.abs(oneshot.pc), atol=2e-4)
+    np.testing.assert_allclose(mean, oneshot.mean, atol=1e-9)
+    np.testing.assert_allclose(
+        evr, oneshot.explained_variance, rtol=1e-3
+    )
+
+
+def test_partition_stats_arrow_round_trip(data):
+    pa = pytest.importorskip("pyarrow")
+    # simulate mapInArrow: input RecordBatches with a VectorUDT struct column
+    vec_col = pa.array(
+        [{"type": 1, "size": None, "indices": None, "values": row.tolist()}
+         for row in data[:50]]
+    )
+    batch = pa.RecordBatch.from_arrays([vec_col], names=["features"])
+    out = list(partition_gram_stats_arrow([batch], "features"))
+    assert len(out) == 1
+    assert out[0].schema.equals(stats_arrow_schema())
+    gram, col_sum, count = combine_stats(out[0].to_pylist())
+    np.testing.assert_allclose(
+        gram, data[:50].T @ data[:50], rtol=1e-12
+    )
+    assert count == 50
+
+
+def test_empty_partition_yields_nothing():
+    assert list(partition_gram_stats([], input_col="f")) == []
+    with pytest.raises(ValueError, match="empty dataset"):
+        combine_stats([])
+
+
+def test_finalize_host_path_matches_xla(data):
+    rows = list(partition_gram_stats([data], input_col="f"))
+    gram, col_sum, count = combine_stats(rows)
+    pc_x, evr_x, _ = finalize_pca_from_stats(
+        gram, col_sum, count, 4, use_xla_svd=True
+    )
+    pc_h, evr_h, _ = finalize_pca_from_stats(
+        gram, col_sum, count, 4, use_xla_svd=False
+    )
+    np.testing.assert_allclose(np.abs(pc_x), np.abs(pc_h), atol=2e-4)
+    np.testing.assert_allclose(evr_x, evr_h, rtol=1e-4)
+
+
+# -- wire format: Spark-readability markers --------------------------------
+
+def test_parquet_footer_declares_spark_udts(data, tmp_path):
+    pq = pytest.importorskip("pyarrow.parquet")
+    model = LocalPCA().setK(2).fit(data)
+    path = str(tmp_path / "m")
+    model.save(path)
+    meta = pq.read_metadata(path + "/data/part-00000.parquet").metadata
+    row_meta = json.loads(
+        meta[b"org.apache.spark.sql.parquet.row.metadata"].decode()
+    )
+    fields = {f["name"]: f["type"] for f in row_meta["fields"]}
+    assert fields["pc"]["class"] == "org.apache.spark.ml.linalg.MatrixUDT"
+    assert (
+        fields["explainedVariance"]["class"]
+        == "org.apache.spark.ml.linalg.VectorUDT"
+    )
+
+
+def test_metadata_splits_spark_and_extension_params(data, tmp_path):
+    model = LocalPCA().setK(2).setUseXlaDot(False).fit(data)
+    path = str(tmp_path / "m")
+    model.save(path)
+    with open(path + "/metadata/part-00000") as f:
+        meta = json.loads(f.readline())
+    assert meta["class"] == "org.apache.spark.ml.feature.PCAModel"
+    # a real pyspark DefaultParamsReader must not see unknown params
+    assert set(meta["paramMap"]) <= {"k", "inputCol", "outputCol"}
+    assert "useXlaDot" in meta["tpuParamMap"]
+    back = LocalPCAModel.load(path)
+    assert back.getUseXlaDot() is False
+    assert back.getK() == 2
+
+
+# -- pyspark integration (optional dependency) -----------------------------
+# importorskip lives inside the fixture/tests (NOT module level) so the
+# Arrow/wire-format tests above always run.
+
+
+@pytest.fixture(scope="module")
+def spark():
+    pytest.importorskip("pyspark")
+    from pyspark.sql import SparkSession
+
+    spark = (
+        SparkSession.builder.master("local[2]")
+        .appName("tpu-ml-test")
+        .config("spark.sql.execution.arrow.pyspark.enabled", "true")
+        .getOrCreate()
+    )
+    yield spark
+    spark.stop()
+
+
+def _make_df(spark, data):
+    from pyspark.ml.linalg import Vectors
+
+    return spark.createDataFrame(
+        [(Vectors.dense(row),) for row in data], ["features"]
+    )
+
+
+def test_spark_fit_matches_local(spark, rng):
+    from spark_rapids_ml_tpu.spark import PCA
+
+    data = rng.normal(size=(300, 8)) + 0.5
+    df = _make_df(spark, data).repartition(3)
+    model = PCA(k=3, inputCol="features").fit(df)
+    local = LocalPCA().setK(3).fit(data)
+    np.testing.assert_allclose(
+        np.abs(model.pc.toArray()), np.abs(local.pc), atol=2e-4
+    )
+    out = model.transform(df).select("pca_features").collect()
+    assert len(out) == 300
+    assert len(out[0][0]) == 3
+
+
+def test_spark_model_round_trips_with_pyspark_ml(spark, rng, tmp_path):
+    """Save here → load with pyspark.ml.feature.PCAModel, and the reverse —
+    what DefaultReadWriteTest gives the reference (PCASuite.scala:192-206)."""
+    pytest.importorskip("pyspark")
+    from pyspark.ml.feature import PCA as SparkMlPCA, PCAModel as SparkMlPCAModel
+
+    data = rng.normal(size=(200, 6))
+    local = LocalPCA().setK(2).setInputCol("features").fit(data)
+    path = str(tmp_path / "ours")
+    local.save(path)
+    theirs = SparkMlPCAModel.load(path)
+    np.testing.assert_allclose(
+        np.abs(theirs.pc.toArray()), np.abs(local.pc), atol=1e-12
+    )
+
+    df = _make_df(spark, data)
+    spark_model = SparkMlPCA(k=2, inputCol="features",
+                             outputCol="p").fit(df)
+    path2 = str(tmp_path / "theirs")
+    spark_model.write().save(path2)
+    back = LocalPCAModel.load(path2)
+    np.testing.assert_allclose(
+        np.abs(back.pc), np.abs(spark_model.pc.toArray()), atol=1e-12
+    )

@@ -48,6 +48,30 @@ def test_batch_source_oneshot_single_pass(rng):
         list(src.batches())
 
 
+def test_batch_source_detects_shared_underlying_iterator(rng):
+    """A factory the identity check can't see through (fresh map object over
+    one shared generator) must raise, not silently zero pass 2."""
+    shared = (rng.normal(size=(20, 4)) for _ in range(5))
+    src = BatchSource(lambda: map(np.asarray, shared), batch_rows=16)
+    assert src.reiterable  # looks re-iterable...
+    list(src.batches())
+    with pytest.raises(RuntimeError, match="FRESH iterator"):
+        list(src.batches())
+
+
+def test_linreg_fake_factory_demoted_not_truncated(rng):
+    """`lambda: gen` over one (X, y) generator: the one-shot demotion must
+    still fire through the chunk transform, fitting on ALL the data."""
+    x = rng.normal(size=(900, 5))
+    y = x @ np.arange(1.0, 6.0) + 0.25
+    gen = ((x[i:i + 100], y[i:i + 100]) for i in range(0, 900, 100))
+    streamed = LinearRegression().fit(lambda: gen)
+    oneshot = LinearRegression().fit(x, y)
+    np.testing.assert_allclose(
+        streamed.coefficients, oneshot.coefficients, atol=5e-4
+    )
+
+
 def test_batch_source_empty_raises():
     with pytest.raises(ValueError, match="empty"):
         BatchSource(iter([]))
