@@ -9,8 +9,9 @@
 //   * host fallback kernels with the same call surface the JNI layer had:
 //       tpuml_dgemm   <- Java_..._dgemm   (rapidsml_jni.cu:172-258)
 //       tpuml_dgemm_b <- Java_..._dgemm_1b (:260-336): the batched
-//                        transform entry, C = AᵀB with alpha=1/beta=0
-//                        hardcoded like the reference (minus its dev_B leak)
+//                        transform entry, C = α·AᵀB + β·C (the reference
+//                        hardcoded α=1/β=0 and leaked dev_B; widened for
+//                        signature parity, leak-free)
 //       tpuml_dsyevd  <- Java_..._calSVD's eigDC core (:338-392); the
 //                        postprocessing (reorder/sqrt/signFlip) deliberately
 //                        lives one layer up, shared with the XLA path
@@ -31,6 +32,7 @@
 // Plain C ABI (bound via ctypes — no JNI, no CUDA, no Python headers).
 
 #include <algorithm>
+#include <dlfcn.h>
 #include <atomic>
 #include <chrono>
 #include <cmath>
@@ -185,6 +187,50 @@ void gemm_tn(int64_t m, int64_t n, int64_t k, double alpha, const double* A,
   }
 }
 
+// C(m×n) = alpha·A·Bᵀ + beta·C. A is m×k row-major, B is n×k row-major:
+// C[i,j] = Σ_p A[i,p]·B[j,p] — both inner loops unit-stride (dot of rows).
+void gemm_nt(int64_t m, int64_t n, int64_t k, double alpha, const double* A,
+             int64_t lda, const double* B, int64_t ldb, double beta, double* C,
+             int64_t ldc) {
+  for (int64_t i = 0; i < m; ++i)
+    for (int64_t j = 0; j < n; ++j) C[i * ldc + j] *= beta;
+  for (int64_t ii = 0; ii < m; ii += kBlk) {
+    int64_t ie = std::min(ii + kBlk, m);
+    for (int64_t jj = 0; jj < n; jj += kBlk) {
+      int64_t je = std::min(jj + kBlk, n);
+      for (int64_t i = ii; i < ie; ++i) {
+        const double* Ai = &A[i * lda];
+        for (int64_t j = jj; j < je; ++j) {
+          const double* Bj = &B[j * ldb];
+          double acc = 0.0;
+          for (int64_t p = 0; p < k; ++p) acc += Ai[p] * Bj[p];
+          C[i * ldc + j] += alpha * acc;
+        }
+      }
+    }
+  }
+}
+
+// C(m×n) = alpha·Aᵀ·Bᵀ + beta·C. A is k×m row-major, B is n×k row-major:
+// C[i,j] = Σ_p A[p,i]·B[j,p].
+void gemm_tt(int64_t m, int64_t n, int64_t k, double alpha, const double* A,
+             int64_t lda, const double* B, int64_t ldb, double beta, double* C,
+             int64_t ldc) {
+  for (int64_t i = 0; i < m; ++i)
+    for (int64_t j = 0; j < n; ++j) C[i * ldc + j] *= beta;
+  for (int64_t pp = 0; pp < k; pp += kBlk) {
+    int64_t pe = std::min(pp + kBlk, k);
+    for (int64_t i = 0; i < m; ++i) {
+      for (int64_t p = pp; p < pe; ++p) {
+        double a = alpha * A[p * lda + i];
+        const double* Bcol = &B[p];  // B[j*ldb + p] walked over j
+        double* Cp = &C[i * ldc];
+        for (int64_t j = 0; j < n; ++j) Cp[j] += a * Bcol[j * ldb];
+      }
+    }
+  }
+}
+
 // ----------------------------------------------------------------- syevd --
 // Symmetric eigensolver: cyclic Jacobi with threshold sweeps. O(n³) per
 // sweep, converges quadratically; right-sized for the n×n covariance solve
@@ -288,11 +334,17 @@ TPUML_API int tpuml_dgemm(int transa, int transb, int64_t m, int64_t n,
                           int64_t lda, const double* B, int64_t ldb,
                           double beta, double* C, int64_t ldc) {
   if (!A || !B || !C || m < 0 || n < 0 || k < 0) return 1;
-  if (transb != 0) return 2;  // OP_T on B never used by the surface
-  if (transa == 0) {
+  // Full transa×transb surface — parity with the reference's declared
+  // cuBLAS signature (RAPIDSML.scala:71-74), whose live covariance call
+  // uses OP_T on B (RapidsRowMatrix.scala:195-196).
+  if (transa == 0 && transb == 0) {
     gemm_nn(m, n, k, alpha, A, lda, B, ldb, beta, C, ldc);
-  } else {
+  } else if (transa != 0 && transb == 0) {
     gemm_tn(m, n, k, alpha, A, lda, B, ldb, beta, C, ldc);
+  } else if (transa == 0) {
+    gemm_nt(m, n, k, alpha, A, lda, B, ldb, beta, C, ldc);
+  } else {
+    gemm_tt(m, n, k, alpha, A, lda, B, ldb, beta, C, ldc);
   }
   return 0;
 }
@@ -301,10 +353,11 @@ TPUML_API int tpuml_dgemm(int transa, int transb, int64_t m, int64_t n,
 // row-major; alpha=1, beta=0 hardcoded — the reference's dgemm_1b entry
 // (rapidsml_jni.cu:260-336) used by the (there disabled) GPU model
 // transform.
-TPUML_API int tpuml_dgemm_b(int64_t m, int64_t n, int64_t k, const double* A,
-                            const double* B, double* C) {
+TPUML_API int tpuml_dgemm_b(int64_t m, int64_t n, int64_t k, double alpha,
+                            const double* A, const double* B, double beta,
+                            double* C) {
   if (!A || !B || !C || m < 0 || n < 0 || k < 0) return 1;
-  gemm_tn(m, n, k, 1.0, A, m, B, n, 0.0, C, n);
+  gemm_tn(m, n, k, alpha, A, m, B, n, beta, C, n);
   return 0;
 }
 
@@ -324,9 +377,72 @@ TPUML_API int tpuml_dspr(int64_t n, double alpha, const double* x,
 
 // Eigendecomposition of a symmetric n×n row-major matrix. Ascending
 // eigenvalues in w[0..n), eigenvector j in column j of row-major V.
+// ------------------------------------------------------- LAPACK dsyevd --
+// Production host eigensolver: dlopen the system LAPACK and call dsyevd_
+// (the same divide-and-conquer solver cuSolver's syevd wraps for the
+// reference, rapidsml_jni.cu:338-392). The hand-written Jacobi above stays
+// as the zero-dependency fallback — it is minutes-to-hours at n ≳ 2k,
+// which is exactly the regime the host fallback serves when a device is
+// unavailable, so LAPACK is preferred whenever loadable.
+typedef void (*dsyevd_fn)(const char* jobz, const char* uplo, const int* n,
+                          double* a, const int* lda, double* w, double* work,
+                          const int* lwork, int* iwork, const int* liwork,
+                          int* info);
+
+dsyevd_fn lapack_dsyevd() {
+  static dsyevd_fn fn = []() -> dsyevd_fn {
+    const char* env = std::getenv("TPUML_HOST_EIGH");
+    if (env && std::string(env) == "jacobi") return nullptr;
+    const char* names[] = {"liblapack.so.3", "liblapack.so",
+                           "libopenblas.so.0", "libopenblas.so"};
+    for (const char* nm : names) {
+      void* h = dlopen(nm, RTLD_NOW | RTLD_LOCAL);
+      if (!h) continue;
+      if (void* s = dlsym(h, "dsyevd_")) return reinterpret_cast<dsyevd_fn>(s);
+      dlclose(h);
+    }
+    return nullptr;
+  }();
+  return fn;
+}
+
+int lapack_eigh(int64_t n64, const double* A_in, double* w, double* V) {
+  dsyevd_fn syevd = lapack_dsyevd();
+  if (!syevd) return -1;
+  if (n64 > INT32_MAX) return -1;
+  int n = static_cast<int>(n64);
+  // LAPACK works column-major in place; symmetric input makes the layout
+  // moot on the way in. On exit eigenvector k is column k (memory
+  // a[k*n + i]); our contract is row-major V with eigenvector j in column
+  // j (V[i*n + j]) — a transpose on the way out.
+  std::vector<double> a(A_in, A_in + n64 * n64);
+  int info = 0, lwork = -1, liwork = -1;
+  double work_q = 0;
+  int iwork_q = 0;
+  syevd("V", "U", &n, a.data(), &n, w, &work_q, &lwork, &iwork_q, &liwork,
+        &info);
+  if (info != 0) return info;
+  lwork = static_cast<int>(work_q);
+  liwork = iwork_q;
+  std::vector<double> work(static_cast<size_t>(lwork));
+  std::vector<int> iwork(static_cast<size_t>(liwork));
+  syevd("V", "U", &n, a.data(), &n, w, work.data(), &lwork, iwork.data(),
+        &liwork, &info);
+  if (info != 0) return info;
+  for (int64_t j = 0; j < n64; ++j)
+    for (int64_t i = 0; i < n64; ++i) V[i * n64 + j] = a[j * n64 + i];
+  return 0;
+}
+
 TPUML_API int tpuml_dsyevd(int64_t n, const double* A, double* w, double* V) {
   if (!A || !w || !V || n <= 0) return 1;
+  if (lapack_eigh(n, A, w, V) == 0) return 0;
   return jacobi_eigh(n, A, w, V);
+}
+
+// Which host eigensolver tpuml_dsyevd will use: 1 = LAPACK, 0 = Jacobi.
+TPUML_API int tpuml_host_eigh_is_lapack() {
+  return lapack_dsyevd() != nullptr ? 1 : 0;
 }
 
 TPUML_API void* tpuml_alloc(size_t bytes) { return g_pool.alloc(bytes); }

@@ -305,6 +305,31 @@ class PCA(PCAParams):
         dtype = _resolve_dtype(self.getDtype())
         mean_centering = self.getMeanCentering()
 
+        if use_xla_dot and _pallas_gram_enabled(device, dtype):
+            # Fused Pallas center+scale+mask+Gram (ops/pallas_gram.py):
+            # X is read from HBM once per output tile pair, no centered
+            # copy materialized. Flag-gated (TPUML_PALLAS_GRAM=1) pending
+            # the on-chip A/B bench vs lax.dot_general (bench.py records
+            # both rates).
+            from spark_rapids_ml_tpu.ops.pallas_gram import covariance_fused
+
+            with timer.phase("covariance"), TraceRange(
+                "pallas fused gram", TraceColor.RED
+            ):
+                cov, mean = covariance_fused(
+                    np.asarray(x_host, dtype=np.float32),
+                    mean_centering=mean_centering,
+                    device=device,
+                )
+                cov = jax.block_until_ready(cov)
+            if use_xla_svd:
+                with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
+                    pc, evr = jax.block_until_ready(pca_from_covariance(cov, k))
+                return np.asarray(pc), np.asarray(evr), np.asarray(mean)
+            with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
+                pc, evr = _host_eig_topk(np.asarray(cov, dtype=np.float64), k)
+            return pc, evr, np.asarray(mean)
+
         if use_xla_dot and use_xla_svd:
             # Whole pipeline in ONE compiled program on device.
             with timer.phase("h2d"):
@@ -348,6 +373,21 @@ class PCA(PCAParams):
         with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
             pc, evr = _host_eig_topk(cov, k)
         return pc, evr, mean
+
+
+def _pallas_gram_enabled(device, dtype) -> bool:
+    """Whether the fused Pallas Gram path is selected: explicit opt-in via
+    TPUML_PALLAS_GRAM=1, a real TPU-family backend (Pallas lowers there;
+    interpret mode is test-only), and f32 compute."""
+    import os
+
+    import jax.numpy as jnp
+
+    if os.environ.get("TPUML_PALLAS_GRAM") != "1":
+        return False
+    if dtype != jnp.float32:
+        return False
+    return getattr(device, "platform", "") in ("tpu", "axon")
 
 
 def _host_covariance_streamed(source, mean_centering: bool):
@@ -410,13 +450,21 @@ def _host_covariance(x: np.ndarray, mean_centering: bool):
     return cov, mean
 
 
+# Above this n the host eigensolve routes to NumPy's threaded OpenBLAS:
+# the native entry dlopens the SYSTEM LAPACK (netlib), measured ~9× slower
+# at n=4096 (95s vs 10.7s) though numerically identical. Below it the
+# native path is sub-second and keeps the parity surface exercised.
+_NATIVE_EIGH_MAX_N = 1024
+
+
 def _host_eig_topk(cov: np.ndarray, k: int):
     """Host eigensolve + shared postprocessing (descending order, sign-flip,
-    λ/Σλ). Native C++ syevd when built, LAPACK otherwise."""
+    λ/Σλ). Native C++ (LAPACK dsyevd via dlopen, Jacobi fallback) for small
+    n when built; NumPy/OpenBLAS otherwise or for large n."""
     from spark_rapids_ml_tpu import native
     from spark_rapids_ml_tpu.ops.eigh import pca_postprocess_host
 
-    if native.is_loaded():
+    if native.is_loaded() and cov.shape[0] <= _NATIVE_EIGH_MAX_N:
         evals, evecs = native.syevd(np.ascontiguousarray(cov, dtype=np.float64))
     else:
         evals, evecs = np.linalg.eigh(cov)

@@ -80,13 +80,15 @@ def _configure(lib: ctypes.CDLL) -> None:
     ]
     lib.tpuml_dgemm.restype = ctypes.c_int
     lib.tpuml_dgemm_b.argtypes = [
-        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong, d, d, d
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_double, d, d, ctypes.c_double, d,  # alpha, A, B, beta, C
     ]
     lib.tpuml_dgemm_b.restype = ctypes.c_int
     lib.tpuml_dspr.argtypes = [ctypes.c_longlong, ctypes.c_double, d, d]
     lib.tpuml_dspr.restype = ctypes.c_int
     lib.tpuml_dsyevd.argtypes = [ctypes.c_longlong, d, d, d]
     lib.tpuml_dsyevd.restype = ctypes.c_int
+    lib.tpuml_host_eigh_is_lapack.restype = ctypes.c_int
     lib.tpuml_alloc.argtypes = [ctypes.c_size_t]
     lib.tpuml_alloc.restype = ctypes.c_void_p
     lib.tpuml_free.argtypes = [ctypes.c_void_p]
@@ -219,19 +221,37 @@ def _ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
 
 
-def gemm(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """C = A @ B for row-major 2-D arrays (the ``dgemm`` surface)."""
+def gemm(a: np.ndarray, b: np.ndarray, transa: bool = False,
+         transb: bool = False, alpha: float = 1.0, beta: float = 0.0,
+         c: Optional[np.ndarray] = None) -> np.ndarray:
+    """C = α·op(A)·op(B) + β·C for row-major 2-D arrays — the full
+    ``dgemm`` surface of ``RAPIDSML.scala:71-74`` (all four transpose
+    combos; the reference's live covariance call uses OP_T,
+    ``RapidsRowMatrix.scala:195-196``)."""
     lib = load()
     a, b = _as_f64(a), _as_f64(b)
-    m, kk = a.shape
-    k2, n = b.shape
+    m, kk = (a.shape[1], a.shape[0]) if transa else a.shape
+    k2, n = (b.shape[1], b.shape[0]) if transb else b.shape
     if kk != k2:
-        raise ValueError(f"shape mismatch: {a.shape} @ {b.shape}")
+        raise ValueError(
+            f"shape mismatch: op({a.shape}) @ op({b.shape})"
+        )
+    if c is None:
+        c = np.zeros((m, n), dtype=np.float64)
+    else:
+        c = _as_f64(c)
+        if c.shape != (m, n):
+            raise ValueError(f"C has shape {c.shape}, expected {(m, n)}")
     if lib is None:
-        return a @ b
-    c = np.zeros((m, n), dtype=np.float64)
+        op_a = a.T if transa else a
+        op_b = b.T if transb else b
+        # write THROUGH c like the native path does, so a caller-supplied
+        # accumulator behaves identically with and without the .so
+        np.copyto(c, alpha * (op_a @ op_b) + beta * c)
+        return c
     rc = lib.tpuml_dgemm(
-        0, 0, m, n, kk, 1.0, _ptr(a), kk, _ptr(b), n, 0.0, _ptr(c), n
+        int(transa), int(transb), m, n, kk, alpha,
+        _ptr(a), a.shape[1], _ptr(b), b.shape[1], beta, _ptr(c), n
     )
     if rc != 0:
         raise RuntimeError(f"tpuml_dgemm failed with code {rc}")
@@ -254,19 +274,27 @@ def gram(a: np.ndarray) -> np.ndarray:
     return c
 
 
-def gemm_b(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """C = AᵀB (the batched-transform ``dgemm_b`` surface,
-    ``rapidsml_jni.cu:260-336``). ``a`` is k×m, ``b`` is k×n."""
+def gemm_b(a: np.ndarray, b: np.ndarray, alpha: float = 1.0,
+           beta: float = 0.0, c: Optional[np.ndarray] = None) -> np.ndarray:
+    """C = α·AᵀB + β·C (the batched-transform ``dgemm_b`` surface,
+    ``rapidsml_jni.cu:260-336``, widened with the α/β the reference
+    hardcoded to 1/0). ``a`` is k×m, ``b`` is k×n."""
     lib = load()
     a, b = _as_f64(a), _as_f64(b)
     k, m = a.shape
     k2, n = b.shape
     if k != k2:
         raise ValueError(f"shape mismatch: {a.shape}ᵀ @ {b.shape}")
+    if c is None:
+        c = np.zeros((m, n), dtype=np.float64)
+    else:
+        c = _as_f64(c)
+        if c.shape != (m, n):
+            raise ValueError(f"C has shape {c.shape}, expected {(m, n)}")
     if lib is None:
-        return a.T @ b
-    c = np.zeros((m, n), dtype=np.float64)
-    rc = lib.tpuml_dgemm_b(m, n, k, _ptr(a), _ptr(b), _ptr(c))
+        np.copyto(c, alpha * (a.T @ b) + beta * c)
+        return c
+    rc = lib.tpuml_dgemm_b(m, n, k, alpha, _ptr(a), _ptr(b), beta, _ptr(c))
     if rc != 0:
         raise RuntimeError(f"tpuml_dgemm_b failed with code {rc}")
     return c
@@ -328,6 +356,15 @@ def syevd(a: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         raise RuntimeError(f"tpuml_dsyevd failed with code {rc}")
     # C layer returns eigenvectors row-major with vector j in column j.
     return evals, evecs
+
+
+def host_eigh_is_lapack() -> bool:
+    """Whether ``syevd`` runs on a dlopen'd system LAPACK ``dsyevd_``
+    (production solver) rather than the built-in Jacobi fallback."""
+    lib = load()
+    if lib is None:
+        return False
+    return bool(lib.tpuml_host_eigh_is_lapack())
 
 
 # -- host buffer pool ----------------------------------------------------

@@ -76,6 +76,23 @@ def finalize_stats(
     return PCAFitResult(components, evr, mean)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def update_stats_fused(stats: GramStats, batch: jnp.ndarray) -> GramStats:
+    """``update_stats`` with the Gram computed by the Pallas fused kernel
+    (``ops.pallas_gram``) instead of ``lax.dot_general`` — the bench's
+    A/B arm for selecting the faster Gram on real hardware. Requires
+    tile-aligned batches (rows % 512 == 0, cols % 256 == 0) and no mask."""
+    from spark_rapids_ml_tpu.ops.pallas_gram import fused_centered_gram
+
+    b = batch.astype(stats.gram.dtype)
+    zero_mean = jnp.zeros((b.shape[1],), dtype=b.dtype)
+    ones = jnp.ones((b.shape[0],), dtype=b.dtype)
+    g = fused_centered_gram(b, zero_mean, ones)
+    s = jnp.sum(b, axis=0)
+    cnt = jnp.asarray(b.shape[0], dtype=jnp.int32)
+    return GramStats(stats.gram + g, stats.col_sum + s, stats.count + cnt)
+
+
 class StreamingPCA:
     """Convenience wrapper: ``StreamingPCA(n).partial_fit(b)...finalize(k)``."""
 

@@ -16,12 +16,65 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from jax import lax
+
 from spark_rapids_ml_tpu.ops.kmeans_kernel import (
     KMeansResult,
-    kmeans_plus_plus_init,
     lloyd_iterations,
 )
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple, row_sharding
+
+
+def _global_kmeans_pp(x_shard, mask_shard, key, n_clusters: int):
+    """k-means++ seeding with GLOBAL D²-weighted sampling across shards.
+
+    Spark's k-means|| samples over the whole dataset; seeding from one
+    shard's local rows (the round-1 shortcut) is biased under non-IID row
+    sharding — a shard holding one cluster's points seeds every center
+    inside it. Exact global categorical sampling without gathering rows:
+    the Gumbel-max trick. Each shard perturbs its local log-D² with Gumbel
+    noise (per-shard folded key), takes its local argmax, and a ``pmax``
+    picks the global winner — distributionally identical to sampling
+    ∝ D² over the union. Per step: one pmax + two psums (scalar + row).
+    """
+    m, n = x_shard.shape
+    valid = (
+        jnp.ones(m, dtype=x_shard.dtype)
+        if mask_shard is None
+        else mask_shard.astype(x_shard.dtype)
+    )
+    j = lax.axis_index(DATA_AXIS)
+    neg_inf = jnp.asarray(-jnp.inf, dtype=x_shard.dtype)
+
+    def sample_global(logits, step_key):
+        g = jax.random.gumbel(
+            jax.random.fold_in(step_key, j), logits.shape, dtype=logits.dtype
+        ) + logits
+        local_best = jnp.max(g)
+        local_row = x_shard[jnp.argmax(g)]
+        global_best = lax.pmax(local_best, DATA_AXIS)
+        owner = (local_best >= global_best).astype(x_shard.dtype)
+        n_owners = lax.psum(owner, DATA_AXIS)  # ties: average (p≈0 event)
+        return lax.psum(local_row * owner, DATA_AXIS) / jnp.maximum(n_owners, 1)
+
+    key, sub = jax.random.split(key)
+    first = sample_global(jnp.where(valid > 0, 0.0, neg_inf), sub)
+    centers0 = jnp.zeros((n_clusters, n), dtype=x_shard.dtype).at[0].set(first)
+    min_d0 = jnp.sum((x_shard - first[None, :]) ** 2, axis=1) * valid
+
+    def body(i, state):
+        centers, min_d, key = state
+        key, sub = jax.random.split(key)
+        logits = jnp.where(
+            valid > 0, jnp.log(jnp.maximum(min_d, 1e-30)), neg_inf
+        )
+        c = sample_global(logits, sub)
+        centers = centers.at[i].set(c)
+        d_new = jnp.sum((x_shard - c[None, :]) ** 2, axis=1) * valid
+        return centers, jnp.minimum(min_d, d_new), key
+
+    centers, _, _ = lax.fori_loop(1, n_clusters, body, (centers0, min_d0, key))
+    return centers
 
 
 @partial(
@@ -38,13 +91,9 @@ def distributed_kmeans_fit_kernel(
     tol: float = 1e-4,
 ) -> KMeansResult:
     def shard_fn(x_shard, mask_shard, key_repl):
-        # Seeding: shard 0 runs k-means++ over its local rows and the
-        # result is broadcast with a psum (other shards contribute zeros) —
-        # deterministic, one k×n all-reduce, and Lloyd over the full data
-        # erases the locality of the seed sample.
-        local = kmeans_plus_plus_init(x_shard, n_clusters, key_repl, mask_shard)
-        is_first = (jax.lax.axis_index(DATA_AXIS) == 0).astype(local.dtype)
-        init_centers = jax.lax.psum(local * is_first, DATA_AXIS)
+        init_centers = _global_kmeans_pp(
+            x_shard, mask_shard, key_repl, n_clusters
+        )
         # plain tuple: shard_map out_specs prefixes don't match NamedTuples
         return tuple(
             lloyd_iterations(

@@ -111,3 +111,30 @@ def test_distributed_kmeans_matches_single_device(rng):
     # cost equals a full-data host evaluation of the same centers
     model = KMeansModel(cluster_centers=np.asarray(res.centers, dtype=np.float64))
     assert model.compute_cost(x) == pytest.approx(float(res.cost), rel=1e-5)
+
+
+def test_distributed_kmeans_adversarially_skewed_shards(rng):
+    """Global k-means|| seeding under non-IID sharding: rows SORTED by
+    cluster so each of the 8 shards holds exactly one cluster's points.
+    Shard-local seeding (round-1 shortcut) would draw every initial center
+    from shard 0's single cluster and Lloyd then splits one blob while
+    missing others; global D²-weighted sampling must recover all 8."""
+    from spark_rapids_ml_tpu.parallel import data_mesh
+    from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
+        distributed_kmeans_fit,
+    )
+
+    true_centers = np.array(
+        [[i * 20.0, (i % 2) * 20.0, (i % 3) * 20.0] for i in range(8)]
+    )
+    # 100 rows per cluster, kept SORTED (cluster i → shard i exactly)
+    x = np.concatenate(
+        [c + 0.5 * rng.normal(size=(100, 3)) for c in true_centers]
+    )
+    mesh = data_mesh(8)
+    res = distributed_kmeans_fit(x, 8, mesh, max_iter=30, seed=2)
+    found = np.asarray(res.centers)
+    for c in true_centers:
+        assert np.min(np.linalg.norm(found - c, axis=1)) < 1.0, (
+            f"cluster at {c} not recovered; centers:\n{found}"
+        )

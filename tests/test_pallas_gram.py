@@ -67,3 +67,18 @@ def test_pad_helper():
     xp, rm, n = pad_for_fused_gram(x)
     assert xp.shape == (_BLOCK_R, _BLOCK_N) and n == 5
     assert rm.sum() == 10
+
+
+def test_pallas_flag_harmless_on_cpu(rng, monkeypatch):
+    """TPUML_PALLAS_GRAM=1 must not change behavior off-TPU (Pallas only
+    lowers on the TPU family; CPU silently keeps the XLA path)."""
+    from spark_rapids_ml_tpu import PCA
+
+    monkeypatch.setenv("TPUML_PALLAS_GRAM", "1")
+    x = rng.normal(size=(300, 12))
+    m = PCA().setK(3).fit(x)
+    monkeypatch.delenv("TPUML_PALLAS_GRAM")
+    base = PCA().setK(3).fit(x)
+    import numpy as np
+
+    np.testing.assert_allclose(np.abs(m.pc), np.abs(base.pc), atol=1e-7)
