@@ -138,7 +138,9 @@ def main() -> None:
     # trustworthy fence. The flush cadence also enforces BENCH_MAX_SECONDS:
     # a slow platform truncates the run and says so instead of hanging.
     stats = init_stats(cols, dtype=jnp.float32, device=device)
-    flush = 16
+    # On CPU a single 16-step burst is tens of uninterruptible minutes
+    # (~2.2 TFLOP per 65536×4096 step); check the deadline every step there.
+    flush = 1 if platform == "cpu" else 16
     steps_done = 0
     t0 = time.perf_counter()
     while steps_done < n_steps:

@@ -422,6 +422,11 @@ int lapack_eigh(int64_t n64, const double* A_in, double* w, double* V) {
   syevd("V", "U", &n, a.data(), &n, w, &work_q, &lwork, &iwork_q, &liwork,
         &info);
   if (info != 0) return info;
+  // dsyevd's optimal lwork is ~2n²; past n ≈ 32k it exceeds INT32_MAX and
+  // the int cast would wrap negative (then vector::resize aborts through
+  // the extern-C boundary). Refuse instead — the caller falls back.
+  if (work_q < 0 || work_q > static_cast<double>(INT32_MAX) || iwork_q < 0)
+    return -1;
   lwork = static_cast<int>(work_q);
   liwork = iwork_q;
   std::vector<double> work(static_cast<size_t>(lwork));

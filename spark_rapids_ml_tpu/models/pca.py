@@ -317,7 +317,7 @@ class PCA(PCAParams):
                 "pallas fused gram", TraceColor.RED
             ):
                 cov, mean = covariance_fused(
-                    np.asarray(x_host, dtype=np.float32),
+                    x_host,
                     mean_centering=mean_centering,
                     device=device,
                 )

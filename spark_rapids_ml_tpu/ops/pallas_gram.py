@@ -32,33 +32,38 @@ _BLOCK_N = 256
 _BLOCK_R = 512
 
 
-def _gram_kernel(x_i_ref, x_j_ref, mean_i_ref, mean_j_ref, rowmul_ref, o_ref):
-    r = pl.program_id(2)
+def _make_gram_kernel(precision):
+    def _gram_kernel(x_i_ref, x_j_ref, mean_i_ref, mean_j_ref, rowmul_ref,
+                     o_ref):
+        r = pl.program_id(2)
 
-    @pl.when(r == 0)
-    def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
+        @pl.when(r == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
 
-    m = rowmul_ref[:]  # (BLOCK_R, 1): mask × 1/√(n−1), zero on padding
-    xi = (x_i_ref[:] - mean_i_ref[:]) * m
-    xj = (x_j_ref[:] - mean_j_ref[:]) * m
-    # Precision PINNED to HIGHEST (full-f32 MXU passes): the fused path must
-    # meet the 1e-5 oracle bar unconditionally — and the bench A/B against
-    # the XLA path must measure kernel quality, not a silent precision drop
-    # to single-pass bf16 (which covariance.py documents as failing the bar).
-    o_ref[:] += jax.lax.dot_general(
-        xi, xj, (((0,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=o_ref.dtype,
-    )
+        m = rowmul_ref[:]  # (BLOCK_R, 1): mask × 1/√(n−1), zero on padding
+        xi = (x_i_ref[:] - mean_i_ref[:]) * m
+        xj = (x_j_ref[:] - mean_j_ref[:]) * m
+        # Precision follows the SAME policy as the XLA gram()
+        # (TPUML_GRAM_PRECISION, default bfloat16_3x) so the bench A/B
+        # against lax.dot_general compares kernels doing identical MXU
+        # work, and a user's precision request is honored on this path too.
+        o_ref[:] += jax.lax.dot_general(
+            xi, xj, (((0,), (0,)), ((), ())),
+            precision=precision,
+            preferred_element_type=o_ref.dtype,
+        )
+
+    return _gram_kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "precision"))
 def fused_centered_gram(
     x: jnp.ndarray,
     mean: jnp.ndarray,
     rowmul: jnp.ndarray,
     interpret: bool = False,
+    precision=None,
 ) -> jnp.ndarray:
     """``(diag(rowmul)·(X − mean))ᵀ (diag(rowmul)·(X − mean))`` in one pass.
 
@@ -74,11 +79,15 @@ def fused_centered_gram(
             f"shape {(rows, n)} must be padded to multiples of "
             f"({_BLOCK_R}, {_BLOCK_N}); use pad_for_fused_gram"
         )
+    from spark_rapids_ml_tpu.ops.covariance import default_gram_precision
+
+    if precision is None:
+        precision = default_gram_precision()
     grid = (n // _BLOCK_N, n // _BLOCK_N, rows // _BLOCK_R)
     mean2d = mean.reshape(1, n).astype(x.dtype)
     rowmul2d = rowmul.reshape(rows, 1).astype(x.dtype)
     return pl.pallas_call(
-        _gram_kernel,
+        _make_gram_kernel(precision),
         out_shape=jax.ShapeDtypeStruct((n, n), x.dtype),
         grid=grid,
         in_specs=[
@@ -93,33 +102,44 @@ def fused_centered_gram(
     )(x, x, mean2d, mean2d, rowmul2d)
 
 
-def pad_for_fused_gram(x, mask=None):
+def pad_for_fused_gram(x, mask=None, dtype=None):
     """Pad rows to _BLOCK_R and features to _BLOCK_N; returns
-    (x_padded, rowmask_padded, n_features_original)."""
+    (x_padded, rowmask_padded, n_features_original).
+
+    One allocation + one copy total (dtype cast included): at the 1M×4096
+    target a concatenate-per-axis implementation would transiently hold
+    2-3 full copies of X on the host.
+    """
     import numpy as np
 
     x = np.asarray(x)
+    dtype = x.dtype if dtype is None else np.dtype(dtype)
     rows, n = x.shape
     pr = (-rows) % _BLOCK_R
     pn = (-n) % _BLOCK_N
-    rowmask = np.ones(rows, dtype=x.dtype) if mask is None else np.asarray(mask, dtype=x.dtype)
+    rowmask = (
+        np.ones(rows, dtype=dtype) if mask is None
+        else np.asarray(mask, dtype=dtype)
+    )
     if pr:
-        x = np.concatenate([x, np.zeros((pr, n), dtype=x.dtype)])
-        rowmask = np.concatenate([rowmask, np.zeros(pr, dtype=x.dtype)])
-    if pn:
-        x = np.concatenate([x, np.zeros((x.shape[0], pn), dtype=x.dtype)], axis=1)
-    return x, rowmask, n
+        rowmask = np.concatenate([rowmask, np.zeros(pr, dtype=dtype)])
+    if pr == 0 and pn == 0 and x.dtype == dtype:
+        return x, rowmask, n
+    out = np.zeros((rows + pr, n + pn), dtype=dtype)
+    out[:rows, :n] = x
+    return out, rowmask, n
 
 
 def covariance_fused(x, mask=None, mean_centering: bool = True,
-                     interpret: bool = False, device=None):
+                     interpret: bool = False, device=None,
+                     dtype=jnp.float32):
     """Covariance via the fused kernel: host-side padding + on-device
     mean pass + single fused Gram. Returns (cov[n,n], mean[n]); arrays land
     on ``device`` when given (the estimator's resolved chip), else the
-    default device."""
+    default device. Padding + dtype cast happen in a single host copy."""
     import numpy as np
 
-    x_p, rowmask, n = pad_for_fused_gram(x, mask)
+    x_p, rowmask, n = pad_for_fused_gram(x, mask, dtype=np.dtype(dtype))
     if device is not None:
         x_dev = jax.device_put(jnp.asarray(x_p), device)
         rowmask_dev = jax.device_put(jnp.asarray(rowmask), device)

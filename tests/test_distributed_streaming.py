@@ -1,0 +1,74 @@
+"""Distributed + out-of-core combined: streamed batches over the virtual
+8-device mesh vs the single-shot oracle (the north-star config-4 shape)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import PCA
+from spark_rapids_ml_tpu.data.batches import BatchSource
+from spark_rapids_ml_tpu.parallel import data_mesh
+from spark_rapids_ml_tpu.parallel.streaming import (
+    DistributedStreamingPCA,
+    distributed_streaming_pca_fit,
+)
+
+
+@pytest.fixture
+def data(rng):
+    return (rng.normal(size=(4096, 24)) * np.linspace(0.5, 3, 24) + 1.5).astype(
+        np.float32
+    )
+
+
+def test_distributed_streaming_matches_oneshot(data):
+    mesh = data_mesh(8)
+    src = BatchSource(data, batch_rows=512)
+    res = distributed_streaming_pca_fit(src, k=4, mesh=mesh)
+    oneshot = PCA().setK(4).fit(data)
+    np.testing.assert_allclose(
+        np.abs(np.asarray(res.components)), np.abs(oneshot.pc), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.mean), oneshot.mean, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.explained_variance),
+        oneshot.explained_variance,
+        rtol=5e-3,
+    )
+
+
+def test_distributed_streaming_generator_source(data, rng):
+    """A chunked generator factory streams over the mesh without ever
+    materializing the matrix in one device buffer."""
+    mesh = data_mesh(8)
+    src = BatchSource(
+        lambda: (data[i:i + 300] for i in range(0, len(data), 300)),
+        batch_rows=512,
+    )
+    res = distributed_streaming_pca_fit(src, k=3, mesh=mesh)
+    oneshot = PCA().setK(3).fit(data)
+    np.testing.assert_allclose(
+        np.abs(np.asarray(res.components)), np.abs(oneshot.pc), atol=5e-4
+    )
+
+
+def test_distributed_streaming_accumulator_api(data):
+    mesh = data_mesh(8)
+    acc = DistributedStreamingPCA(24, mesh)
+    for i in range(0, len(data), 1024):
+        acc.partial_fit(data[i:i + 1024])
+    assert acc.rows_seen == 4096
+    res = acc.finalize(3)
+    assert np.asarray(res.components).shape == (24, 3)
+
+
+def test_distributed_streaming_batch_divisibility(data):
+    mesh = data_mesh(8)
+    acc = DistributedStreamingPCA(24, mesh)
+    with pytest.raises(ValueError, match="divide evenly"):
+        acc.partial_fit(data[:100])  # 100 % 8 != 0
+    with pytest.raises(ValueError, match="multiple of"):
+        distributed_streaming_pca_fit(
+            BatchSource(data, batch_rows=500), k=2, mesh=mesh
+        )
