@@ -31,6 +31,10 @@ from spark_rapids_ml_tpu.models.linear_regression import (  # noqa: F401
     LinearRegression,
     LinearRegressionModel,
 )
+from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
+    LogisticRegression,
+    LogisticRegressionModel,
+)
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.models.svd import TruncatedSVD, TruncatedSVDModel  # noqa: F401
 from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel  # noqa: F401
@@ -43,6 +47,8 @@ __all__ = [
     "KMeansModel",
     "LinearRegression",
     "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
     "Pipeline",
     "PipelineModel",
     "TruncatedSVD",

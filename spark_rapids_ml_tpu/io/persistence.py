@@ -50,6 +50,8 @@ _SPARK_CLASS_ALIASES = {
     "KMeansModel": "org.apache.spark.ml.clustering.KMeansModel",
     "LinearRegression": "org.apache.spark.ml.regression.LinearRegression",
     "LinearRegressionModel": "org.apache.spark.ml.regression.LinearRegressionModel",
+    "LogisticRegression": "org.apache.spark.ml.classification.LogisticRegression",
+    "LogisticRegressionModel": "org.apache.spark.ml.classification.LogisticRegressionModel",
     "Pipeline": "org.apache.spark.ml.Pipeline",
     "PipelineModel": "org.apache.spark.ml.PipelineModel",
 }
@@ -67,6 +69,10 @@ _SPARK_PARAM_ALLOWLIST = {
                          "regParam"},
     "LinearRegressionModel": {"labelCol", "predictionCol", "fitIntercept",
                               "regParam"},
+    "LogisticRegression": {"labelCol", "predictionCol", "probabilityCol",
+                           "maxIter", "tol", "regParam", "fitIntercept"},
+    "LogisticRegressionModel": {"labelCol", "predictionCol", "probabilityCol",
+                                "maxIter", "tol", "regParam", "fitIntercept"},
     "StandardScaler": {"withMean", "withStd", "inputCol", "outputCol"},
     "StandardScalerModel": {"withMean", "withStd", "inputCol", "outputCol"},
 }
@@ -259,6 +265,7 @@ _SPARK_FIELD_TYPES = {
     "vector": _VECTOR_UDT_JSON,
     "double": "double",
     "long": "long",
+    "integer": "integer",
 }
 
 
@@ -416,6 +423,52 @@ def save_linreg_model(model, path: str, overwrite: bool = False) -> None:
     _write_data_row(path, row, schema=schema, spark_fields=[
         ("coefficients", "vector"), ("intercept", "double"), ("scale", "double"),
     ])
+
+
+def save_logreg_model(model, path: str, overwrite: bool = False) -> None:
+    if model.coefficients is None:
+        raise ValueError("cannot save an unfitted LogisticRegressionModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "coefficients": _dense_vector_struct(model.coefficients),
+        "intercept": float(model.intercept),
+        "numClasses": 2,
+        "numFeatures": int(np.asarray(model.coefficients).shape[0]),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("coefficients", _vector_arrow_type()),
+                ("intercept", pa.float64()),
+                ("numClasses", pa.int32()),
+                ("numFeatures", pa.int32()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("coefficients", "vector"), ("intercept", "double"),
+        ("numClasses", "integer"), ("numFeatures", "integer"),
+    ])
+
+
+def load_logreg_model(path: str):
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        LogisticRegressionModel,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = LogisticRegressionModel(
+        coefficients=_dense_vector_from_struct(row["coefficients"]),
+        intercept=float(row["intercept"]),
+        uid=meta["uid"],
+    )
+    return _restore_params(model, meta)
 
 
 def load_linreg_model(path: str):

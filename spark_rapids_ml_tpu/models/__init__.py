@@ -4,6 +4,10 @@ from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegression,
     LinearRegressionModel,
 )
+from spark_rapids_ml_tpu.models.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
 
 __all__ = [
@@ -13,6 +17,8 @@ __all__ = [
     "KMeansModel",
     "LinearRegression",
     "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
     "Pipeline",
     "PipelineModel",
 ]

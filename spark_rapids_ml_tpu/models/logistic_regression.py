@@ -1,0 +1,364 @@
+"""LogisticRegression Estimator / Model (binary, L2, Newton-IRLS).
+
+Spark ``org.apache.spark.ml.classification.LogisticRegression`` param
+surface subset: featuresCol(=inputCol), labelCol, predictionCol,
+probabilityCol, maxIter, tol, regParam (L2 / elasticNetParam=0),
+fitIntercept — the same objective convention ((1/n)·logloss + λ/2·||w||²,
+intercept unpenalized). Accelerated path: Newton-IRLS compiled into one
+XLA program (``ops/logreg_kernel.py``); host fallback is a NumPy IRLS
+with identical math; out-of-core sources stream one (gradient, Hessian)
+accumulation pass per Newton step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class LogisticRegressionParams(HasInputCol, HasDeviceId):
+    labelCol = Param("labelCol", "label column name (binary 0/1)", "label")
+    predictionCol = Param("predictionCol", "predicted class column",
+                          "prediction")
+    probabilityCol = Param("probabilityCol", "P(y=1) output column",
+                           "probability")
+    maxIter = Param("maxIter", "maximum Newton iterations", 100,
+                    validator=lambda v: isinstance(v, int) and v >= 0)
+    tol = Param("tol", "Newton step-size convergence tolerance", 1e-8,
+                validator=lambda v: v >= 0)
+    regParam = Param("regParam", "L2 regularization strength lambda", 0.0,
+                     validator=lambda v: v >= 0)
+    fitIntercept = Param("fitIntercept", "whether to fit an intercept", True,
+                         validator=lambda v: isinstance(v, bool))
+    useXlaDot = Param(
+        "useXlaDot",
+        "solve on the accelerator (True) or host NumPy (False)",
+        True, validator=lambda v: isinstance(v, bool))
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+class LogisticRegression(LogisticRegressionParams):
+    """``LogisticRegression().setRegParam(0.01).fit(df)``; df carries the
+    features + binary label columns (or pass ``labels=`` explicitly).
+    Out-of-core: ``dataset`` may be a zero-arg callable yielding
+    ``(X_chunk, y_chunk)`` pairs — re-iterable, one pass per Newton step."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "LogisticRegression":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(LogisticRegression, path)
+
+    def fit(self, dataset, labels=None) -> "LogisticRegressionModel":
+        timer = PhaseTimer()
+        from spark_rapids_ml_tpu.models.linear_regression import (
+            _streaming_xy_source,
+        )
+
+        source = _streaming_xy_source(dataset, labels)
+        if source is not None:
+            coef, intercept, n_iter = self._fit_streamed(source, timer)
+        else:
+            frame = as_vector_frame(dataset, self.getInputCol())
+            with timer.phase("densify"):
+                x = frame.vectors_as_matrix(self.getInputCol())
+                if labels is not None:
+                    y = np.asarray(labels, dtype=np.float64).reshape(-1)
+                else:
+                    y = np.asarray(frame.column(self.getLabelCol()),
+                                   dtype=np.float64)
+            if y.shape[0] != x.shape[0]:
+                raise ValueError(
+                    f"labels length {y.shape[0]} != rows {x.shape[0]}"
+                )
+            _check_binary(y)
+            if self.getUseXlaDot():
+                coef, intercept, n_iter = self._fit_xla(x, y, timer)
+            else:
+                coef, intercept, n_iter = self._fit_host(x, y, timer)
+        model = LogisticRegressionModel(
+            coefficients=np.asarray(coef, dtype=np.float64),
+            intercept=float(intercept),
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.n_iter_ = int(n_iter)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def _fit_xla(self, x, y, timer):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.logreg_kernel import logreg_fit_kernel
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        with timer.phase("h2d"):
+            x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+            y_dev = jax.device_put(jnp.asarray(y, dtype=dtype), device)
+        with timer.phase("fit_kernel"), TraceRange("logreg newton", TraceColor.GREEN):
+            result = jax.block_until_ready(
+                logreg_fit_kernel(
+                    x_dev, y_dev,
+                    reg_param=float(self.getRegParam()),
+                    fit_intercept=self.getFitIntercept(),
+                    max_iter=self.getMaxIter(),
+                    tol=float(self.getTol()),
+                )
+            )
+        return result.coefficients, result.intercept, result.n_iter
+
+    def _fit_host(self, x, y, timer):
+        """NumPy Newton-IRLS, same objective and update rule."""
+        with timer.phase("fit_kernel"), TraceRange("logreg host", TraceColor.ORANGE):
+            coef, intercept, n_iter = _host_newton(
+                lambda w, b: _full_grad_hess(
+                    x, y, w, b, float(self.getRegParam()),
+                    self.getFitIntercept(),
+                ),
+                x.shape[1],
+                self.getMaxIter(),
+                float(self.getTol()),
+                self.getFitIntercept(),
+            )
+        return coef, intercept, n_iter
+
+    def _fit_streamed(self, source, timer):
+        """Newton with one streamed accumulation pass per iteration.
+
+        Requires a re-iterable source. Per pass, each fixed-shape batch
+        contributes its (Xᵀr, XᵀWX, Σr, ΣW, n) partials on device via a
+        donated accumulator; the (n+1)² solve happens on host in f64.
+        """
+        if not source.reiterable:
+            raise ValueError(
+                "LogisticRegression streaming requires a re-iterable source "
+                "(a zero-arg callable returning a fresh chunk iterator): "
+                "Newton makes one pass per iteration"
+            )
+        use_xla = self.getUseXlaDot()
+        if use_xla:
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.logreg_kernel import (
+                update_logreg_stats,
+            )
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+        nz = source.n_features          # n_features + 1 (label column)
+        n = nz - 1
+        lam = float(self.getRegParam())
+        fit_b = self.getFitIntercept()
+        w = np.zeros(n)
+        b = 0.0
+        n_iter = 0
+        with timer.phase("fit_kernel"), TraceRange(
+            "logreg streamed",
+            TraceColor.GREEN if use_xla else TraceColor.ORANGE,
+        ):
+            for n_iter in range(1, self.getMaxIter() + 1):
+                if use_xla:
+                    carry = jax.device_put(
+                        (
+                            jnp.zeros((n,), dtype=dtype),
+                            jnp.zeros((n, n), dtype=dtype),
+                            jnp.zeros((n,), dtype=dtype),
+                            jnp.zeros((), dtype=dtype),
+                            jnp.zeros((), dtype=dtype),
+                            jnp.zeros((), dtype=dtype),
+                        ),
+                        device,
+                    )
+                    w_dev = jnp.asarray(w, dtype=dtype)
+                    b_dev = jnp.asarray(b, dtype=dtype)
+                else:
+                    carry = [np.zeros(n), np.zeros((n, n)), np.zeros(n),
+                             0.0, 0.0, 0.0]
+                for batch, mask in source.batches():
+                    if n_iter == 1:
+                        # labels only need validating once; the jitted
+                        # accumulator can't raise, so check on host here
+                        yb = batch[:, -1] if mask is None else batch[mask, -1]
+                        _check_binary(np.asarray(yb, dtype=np.float64))
+                    if use_xla:
+                        carry = update_logreg_stats(
+                            carry, jnp.asarray(batch, dtype=dtype), w_dev,
+                            b_dev,
+                            None if mask is None else jnp.asarray(mask))
+                    else:
+                        zb = np.asarray(
+                            batch if mask is None else batch[mask],
+                            dtype=np.float64,
+                        )
+                        xb, yb = zb[:, :n], zb[:, n]
+                        p = 1.0 / (1.0 + np.exp(-(xb @ w + b)))
+                        r = p - yb
+                        s = p * (1.0 - p)
+                        carry[0] += xb.T @ r
+                        carry[1] += xb.T @ (xb * s[:, None])
+                        carry[2] += xb.T @ s
+                        carry[3] += float(r.sum())
+                        carry[4] += float(s.sum())
+                        carry[5] += float(len(yb))
+                if use_xla:
+                    carry = jax.block_until_ready(carry)
+                gx, hxx, hxb, rsum, ssum, cnt = (
+                    np.asarray(v, dtype=np.float64) for v in carry
+                )
+                g, h = _assemble_newton(
+                    gx, hxx, hxb, float(rsum), float(ssum), float(cnt),
+                    w, lam, fit_b,
+                )
+                delta = np.linalg.solve(h, g)
+                w = w - delta[:n]
+                if fit_b:
+                    b = b - delta[n]
+                if np.max(np.abs(delta)) <= float(self.getTol()):
+                    break
+        return w, b, n_iter
+
+
+def _check_binary(y: np.ndarray) -> None:
+    bad = ~np.isin(y, (0.0, 1.0))
+    if bad.any():
+        raise ValueError(
+            f"binary LogisticRegression requires 0/1 labels; found "
+            f"{np.unique(y[bad])[:5]}"
+        )
+
+
+def _full_grad_hess(x, y, w, b, lam, fit_intercept):
+    z = x @ w + b
+    p = 1.0 / (1.0 + np.exp(-z))
+    r = p - y
+    s = p * (1.0 - p)
+    gx = x.T @ r
+    hxx = x.T @ (x * s[:, None])
+    return _assemble_newton(
+        gx, hxx, x.T @ s, float(r.sum()), float(s.sum()), float(len(y)),
+        w, lam, fit_intercept,
+    )
+
+
+def _assemble_newton(gx, hxx, hxb, rsum, ssum, cnt, w, lam, fit_intercept):
+    """Spark-convention (1/n)-scaled gradient/Hessian with unpenalized
+    intercept, shared by the host and streamed paths."""
+    n = w.shape[0]
+    inv_n = 1.0 / max(cnt, 1.0)
+    g = np.zeros(n + 1)
+    g[:n] = gx * inv_n + lam * w
+    h = np.zeros((n + 1, n + 1))
+    h[:n, :n] = hxx * inv_n + lam * np.eye(n)
+    if fit_intercept:
+        g[n] = rsum * inv_n
+        h[:n, n] = hxb * inv_n
+        h[n, :n] = hxb * inv_n
+        h[n, n] = ssum * inv_n
+    else:
+        h[n, n] = 1.0
+    return g, h
+
+
+def _host_newton(grad_hess, n, max_iter, tol, fit_intercept):
+    w = np.zeros(n)
+    b = 0.0
+    n_iter = 0
+    for n_iter in range(1, max_iter + 1):
+        g, h = grad_hess(w, b)
+        delta = np.linalg.solve(h, g)
+        w = w - delta[:n]
+        if fit_intercept:
+            b = b - delta[n]
+        if np.max(np.abs(delta)) <= tol:
+            break
+    return w, b, n_iter
+
+
+class LogisticRegressionModel(LogisticRegressionParams):
+    def __init__(self, coefficients: Optional[np.ndarray] = None,
+                 intercept: float = 0.0, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.coefficients = coefficients
+        self.intercept = intercept
+        self.n_iter_ = None
+        self.fit_timings_ = {}
+
+    def _copy_internal_state(self, other: "LogisticRegressionModel") -> None:
+        other.coefficients = self.coefficients
+        other.intercept = self.intercept
+        other.n_iter_ = self.n_iter_
+
+    def predict_proba(self, dataset) -> np.ndarray:
+        if self.coefficients is None:
+            raise ValueError("model has no coefficients; fit first or load")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        if self.getUseXlaDot():
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.logreg_kernel import (
+                logreg_predict_kernel,
+            )
+
+            device = _resolve_device(self.getDeviceId())
+            dtype = _resolve_dtype(self.getDtype())
+            proba = np.asarray(
+                logreg_predict_kernel(
+                    jax.device_put(jnp.asarray(x, dtype=dtype), device),
+                    jnp.asarray(self.coefficients, dtype=dtype),
+                    jnp.asarray(self.intercept, dtype=dtype),
+                )
+            )
+        else:
+            z = x @ self.coefficients + self.intercept
+            proba = 1.0 / (1.0 + np.exp(-z))
+        return proba.astype(np.float64)
+
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        proba = self.predict_proba(frame)  # reuse the built frame
+        out = frame.with_column(self.getProbabilityCol(), proba.tolist())
+        return out.with_column(
+            self.getPredictionCol(),
+            (proba >= 0.5).astype(np.int32).tolist(),
+        )
+
+    def evaluate(self, dataset, labels=None) -> dict:
+        """Accuracy / log-loss summary."""
+        frame = as_vector_frame(dataset, self.getInputCol())
+        if labels is not None:
+            y = np.asarray(labels, dtype=np.float64).reshape(-1)
+        else:
+            y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
+        p = np.clip(self.predict_proba(dataset), 1e-12, 1 - 1e-12)
+        acc = float(((p >= 0.5) == (y >= 0.5)).mean())
+        logloss = float(-(y * np.log(p) + (1 - y) * np.log(1 - p)).mean())
+        return {"accuracy": acc, "logLoss": logloss}
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_logreg_model
+
+        save_logreg_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "LogisticRegressionModel":
+        from spark_rapids_ml_tpu.io.persistence import load_logreg_model
+
+        return load_logreg_model(path)

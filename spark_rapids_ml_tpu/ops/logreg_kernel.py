@@ -1,0 +1,165 @@
+"""LogisticRegression device kernels: Newton-IRLS in one compiled program.
+
+Third-algorithm coverage beyond the reference (whose roadmap stops at PCA;
+KMeans/LinearRegression are BASELINE.md config 5). Binary logistic
+regression with L2, in Spark ML's objective convention:
+
+    min_w  (1/n) Σ logloss(yᵢ, σ(xᵢ·w + b)) + (λ/2)·||w||²   (intercept
+    unpenalized, like Spark's ``LogisticRegression`` with
+    ``elasticNetParam=0``)
+
+solved by Newton-IRLS — each iteration is two MXU matmuls (the logits
+``X·w`` and the weighted Hessian ``Xᵀdiag(σ')X``) plus an (n+1)² Cholesky
+solve, the same "big matmul + small dense solve" shape as every other
+algorithm here. The iteration is a ``lax.while_loop`` compiled into the
+program; masked (padding) rows contribute nothing to loss, gradient, or
+Hessian.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LogRegResult(NamedTuple):
+    coefficients: jnp.ndarray   # (n_features,)
+    intercept: jnp.ndarray      # scalar
+    n_iter: jnp.ndarray         # scalar int
+    converged: jnp.ndarray      # scalar bool
+
+
+def _grad_hess(w, x, y, valid, reg_param, fit_intercept, reduce_fn):
+    """(gradient, Hessian) of the Spark-convention objective at w.
+
+    ``w`` is (n+1,): coefficients ++ intercept slot (zero-pinned when
+    ``fit_intercept`` is False). ``reduce_fn`` combines the per-shard
+    (Xᵀr, XᵀWX, Σr, ΣW, n) partials — identity on one device, ``psum``
+    over the mesh in the distributed form.
+    """
+    n_feat = x.shape[1]
+    coef, b = w[:n_feat], w[n_feat]
+    z = x @ coef + b
+    p = jax.nn.sigmoid(z)
+    r = (p - y) * valid                 # residual, masked
+    s = p * (1.0 - p) * valid           # IRLS weights, masked
+    gx = lax.dot_general(x, r, (((0,), (0,)), ((), ())),
+                         precision=lax.Precision.HIGHEST)
+    # Hessian core: Xᵀ diag(s) X — one MXU matmul of the s-scaled rows
+    xs = x * s[:, None]
+    hxx = lax.dot_general(x, xs, (((0,), (0,)), ((), ())),
+                          precision=lax.Precision.HIGHEST)
+    hxb = jnp.sum(xs, axis=0)
+    stats = reduce_fn((gx, hxx, hxb, jnp.sum(r), jnp.sum(s),
+                       jnp.sum(valid)))
+    gx, hxx, hxb, rsum, ssum, cnt = stats
+    inv_n = 1.0 / jnp.maximum(cnt, 1.0)
+
+    g = jnp.zeros_like(w)
+    g = g.at[:n_feat].set(gx * inv_n + reg_param * coef)
+    h = jnp.zeros((n_feat + 1, n_feat + 1), dtype=w.dtype)
+    h = h.at[:n_feat, :n_feat].set(
+        hxx * inv_n + reg_param * jnp.eye(n_feat, dtype=w.dtype)
+    )
+    if fit_intercept:
+        g = g.at[n_feat].set(rsum * inv_n)
+        h = h.at[:n_feat, n_feat].set(hxb * inv_n)
+        h = h.at[n_feat, :n_feat].set(hxb * inv_n)
+        h = h.at[n_feat, n_feat].set(ssum * inv_n)
+    else:
+        # pin the intercept slot: unit diagonal, zero gradient
+        h = h.at[n_feat, n_feat].set(1.0)
+    return g, h
+
+
+def newton_iterations(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    reg_param: float,
+    fit_intercept: bool,
+    max_iter: int,
+    tol: float,
+    reduce_fn=lambda t: t,
+) -> LogRegResult:
+    dtype = x.dtype
+    valid = (
+        jnp.ones(x.shape[0], dtype=dtype) if mask is None
+        else mask.astype(dtype)
+    )
+    n_feat = x.shape[1]
+    w0 = jnp.zeros((n_feat + 1,), dtype=dtype)
+
+    def step(state):
+        w, _, it, _ = state
+        g, h = _grad_hess(w, x, y, valid, reg_param, fit_intercept, reduce_fn)
+        # Damped-free Newton with a Cholesky solve; the ridge term (or the
+        # pinned intercept slot) keeps H positive definite.
+        delta = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(h), g)
+        w_new = w - delta
+        moved = jnp.max(jnp.abs(delta))
+        return w_new, moved, it + 1, moved <= tol
+
+    def cond(state):
+        _, _, it, done = state
+        return jnp.logical_and(it < max_iter, jnp.logical_not(done))
+
+    init = (w0, jnp.asarray(jnp.inf, dtype=dtype),
+            jnp.asarray(0, dtype=jnp.int32), jnp.asarray(False))
+    w, _, n_iter, converged = lax.while_loop(cond, step, init)
+    return LogRegResult(w[:n_feat], w[n_feat], n_iter, converged)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "max_iter"))
+def logreg_fit_kernel(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-8,
+) -> LogRegResult:
+    return newton_iterations(
+        x, y, mask, reg_param, fit_intercept, max_iter, tol
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_logreg_stats(carry, batch_z, w, b, mask=None):
+    """Out-of-core Newton building block: fold one ``[X | y]`` batch's
+    (Xᵀr, XᵀWX, Xᵀs, Σr, Σs, n) partials at the current (w, b) into a
+    donated accumulator. One streamed pass with this per batch = one
+    Newton gradient/Hessian evaluation over the full dataset."""
+    gx, hxx, hxb, rsum, ssum, cnt = carry
+    x = batch_z[:, :-1].astype(gx.dtype)
+    y = batch_z[:, -1].astype(gx.dtype)
+    valid = (
+        jnp.ones(x.shape[0], dtype=x.dtype) if mask is None
+        else mask.astype(x.dtype)
+    )
+    p = jax.nn.sigmoid(x @ w + b)
+    r = (p - y) * valid
+    s = p * (1.0 - p) * valid
+    xs = x * s[:, None]
+    return (
+        gx + lax.dot_general(x, r, (((0,), (0,)), ((), ())),
+                             precision=lax.Precision.HIGHEST),
+        hxx + lax.dot_general(x, xs, (((0,), (0,)), ((), ())),
+                              precision=lax.Precision.HIGHEST),
+        hxb + jnp.sum(xs, axis=0),
+        rsum + jnp.sum(r),
+        ssum + jnp.sum(s),
+        cnt + jnp.sum(valid),
+    )
+
+
+@jax.jit
+def logreg_predict_kernel(x, coefficients, intercept):
+    """Class probabilities σ(X·w + b) — one batched MXU matmul (the
+    enabled-batch-transform posture shared with PCAModel.transform)."""
+    return jax.nn.sigmoid(x @ coefficients + intercept)

@@ -11,6 +11,10 @@ from spark_rapids_ml_tpu.parallel.distributed_linreg import (
     distributed_linreg_fit,
     distributed_linreg_fit_kernel,
 )
+from spark_rapids_ml_tpu.parallel.distributed_logreg import (
+    distributed_logreg_fit,
+    distributed_logreg_fit_kernel,
+)
 from spark_rapids_ml_tpu.parallel.feature_sharded import (
     feature_sharded_covariance_kernel,
     feature_sharded_pca_fit,
@@ -26,6 +30,8 @@ __all__ = [
     "distributed_kmeans_fit_kernel",
     "distributed_linreg_fit",
     "distributed_linreg_fit_kernel",
+    "distributed_logreg_fit",
+    "distributed_logreg_fit_kernel",
     "feature_sharded_covariance_kernel",
     "feature_sharded_pca_fit",
 ]

@@ -1,0 +1,139 @@
+"""LogisticRegression vs the sklearn oracle: device/host path equality,
+Spark objective convention (λ ↔ sklearn C = 1/(n·λ)), streamed and
+distributed fits, persistence, guards."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import LogisticRegression, LogisticRegressionModel
+
+sklearn_linear = pytest.importorskip("sklearn.linear_model")
+
+
+@pytest.fixture
+def data(rng):
+    n = 2000
+    x = rng.normal(size=(n, 8))
+    w_true = np.array([1.5, -2.0, 0.7, 0.0, 3.0, -0.3, 1.0, -1.2])
+    p = 1.0 / (1.0 + np.exp(-(x @ w_true + 0.4)))
+    y = (rng.random(n) < p).astype(np.float64)
+    return x, y
+
+
+def _sklearn_fit(x, y, reg_param, fit_intercept=True):
+    c = 1e12 if reg_param == 0 else 1.0 / (len(y) * reg_param)
+    m = sklearn_linear.LogisticRegression(
+        C=c, fit_intercept=fit_intercept, tol=1e-10, max_iter=2000,
+        solver="lbfgs",
+    ).fit(x, y)
+    return m.coef_.ravel(), float(m.intercept_[0]) if fit_intercept else 0.0
+
+
+@pytest.mark.parametrize("use_xla", [True, False])
+@pytest.mark.parametrize("reg_param", [0.01, 0.1])
+def test_logreg_matches_sklearn(data, use_xla, reg_param):
+    x, y = data
+    model = (
+        LogisticRegression().setRegParam(reg_param).setUseXlaDot(use_xla)
+        .fit(x, y)
+    )
+    coef_sk, b_sk = _sklearn_fit(x, y, reg_param)
+    np.testing.assert_allclose(model.coefficients, coef_sk, atol=2e-4)
+    assert abs(model.intercept - b_sk) < 2e-4
+
+
+def test_logreg_no_intercept(data):
+    x, y = data
+    model = (
+        LogisticRegression().setRegParam(0.05).setFitIntercept(False)
+        .fit(x, y)
+    )
+    coef_sk, _ = _sklearn_fit(x, y, 0.05, fit_intercept=False)
+    np.testing.assert_allclose(model.coefficients, coef_sk, atol=2e-4)
+    assert model.intercept == 0.0
+
+
+def test_logreg_transform_and_evaluate(data):
+    x, y = data
+    model = LogisticRegression().setRegParam(0.01).fit(x, y)
+    out = model.transform(x)
+    proba = np.asarray(out.column("probability"))
+    pred = np.asarray(out.column("prediction"))
+    assert ((proba >= 0) & (proba <= 1)).all()
+    np.testing.assert_array_equal(pred, (proba >= 0.5).astype(np.int32))
+    summary = model.evaluate(x, y)
+    assert summary["accuracy"] > 0.85
+    assert summary["logLoss"] < 0.45
+
+
+def test_logreg_streamed_matches_oneshot(data):
+    x, y = data
+    oneshot = LogisticRegression().setRegParam(0.02).fit(x, y)
+    streamed = LogisticRegression().setRegParam(0.02).fit(
+        lambda: ((x[i:i + 333], y[i:i + 333]) for i in range(0, len(y), 333))
+    )
+    np.testing.assert_allclose(
+        streamed.coefficients, oneshot.coefficients, atol=5e-4
+    )
+    assert abs(streamed.intercept - oneshot.intercept) < 5e-4
+
+
+def test_logreg_streamed_host_path(data):
+    x, y = data
+    oneshot = LogisticRegression().setRegParam(0.02).setUseXlaDot(False).fit(x, y)
+    streamed = LogisticRegression().setRegParam(0.02).setUseXlaDot(False).fit(
+        lambda: ((x[i:i + 400], y[i:i + 400]) for i in range(0, len(y), 400))
+    )
+    np.testing.assert_allclose(
+        streamed.coefficients, oneshot.coefficients, atol=1e-8
+    )
+
+
+def test_logreg_streamed_label_validation(rng):
+    x = rng.normal(size=(200, 3))
+    y = np.full(200, 2.0)
+    with pytest.raises(ValueError, match="0/1 labels"):
+        LogisticRegression().fit(
+            lambda: ((x[i:i + 50], y[i:i + 50]) for i in range(0, 200, 50))
+        )
+
+
+def test_logreg_streamed_requires_reiterable(data):
+    x, y = data
+    gen = iter([(x[:100], y[:100])])
+    with pytest.raises(ValueError, match="re-iterable"):
+        LogisticRegression().fit(gen)
+
+
+def test_logreg_distributed_matches_single_device(data):
+    from spark_rapids_ml_tpu.parallel import data_mesh, distributed_logreg_fit
+
+    x, y = data
+    res = distributed_logreg_fit(x, y, data_mesh(8), reg_param=0.02)
+    oneshot = LogisticRegression().setRegParam(0.02).fit(x, y)
+    np.testing.assert_allclose(
+        np.asarray(res.coefficients), oneshot.coefficients, atol=5e-4
+    )
+    assert abs(float(res.intercept) - oneshot.intercept) < 5e-4
+    assert bool(res.converged)
+
+
+def test_logreg_persistence(data, tmp_path):
+    x, y = data
+    model = LogisticRegression().setRegParam(0.01).fit(x, y)
+    p = str(tmp_path / "m")
+    model.save(p)
+    back = LogisticRegressionModel.load(p)
+    np.testing.assert_array_equal(back.coefficients, model.coefficients)
+    assert back.intercept == model.intercept
+    assert back.getRegParam() == 0.01
+    np.testing.assert_allclose(
+        back.predict_proba(x[:50]), model.predict_proba(x[:50]), atol=1e-12
+    )
+
+
+def test_logreg_label_validation(rng):
+    x = rng.normal(size=(50, 3))
+    y = rng.integers(0, 3, size=50).astype(float)  # has label 2
+    with pytest.raises(ValueError, match="0/1 labels"):
+        LogisticRegression().fit(x, y)
