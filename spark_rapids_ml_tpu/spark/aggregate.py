@@ -128,6 +128,146 @@ def stats_spark_ddl() -> str:
     return "gram array<double>, col_sum array<double>, count bigint"
 
 
+def partition_xy_stats(
+    batches: Iterable, features_col: str, label_col: str
+) -> Iterator[Dict[str, object]]:
+    """One partition's sufficient statistics over Z = [X | y].
+
+    Shaped for ``mapInArrow`` on a two-column (features, label) selection;
+    the (n+1)² Gram of Z carries XᵀX, Xᵀy and yᵀy at once — the same
+    augmented-column trick the local streamed LinearRegression uses."""
+    gram: Optional[np.ndarray] = None
+    col_sum: Optional[np.ndarray] = None
+    count = 0
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+            y = np.asarray(batch.column(label_col).to_pylist(),
+                           dtype=np.float64)
+        else:
+            x, y = batch
+            x = np.asarray(x, dtype=np.float64)
+            y = np.asarray(y, dtype=np.float64)
+        if x.shape[0] == 0:
+            continue
+        z = np.concatenate([x, y.reshape(-1, 1)], axis=1)
+        if gram is None:
+            nz = z.shape[1]
+            gram = np.zeros((nz, nz))
+            col_sum = np.zeros(nz)
+        gram += z.T @ z
+        col_sum += z.sum(axis=0)
+        count += z.shape[0]
+    if gram is None:
+        return
+    yield {
+        "gram": gram.ravel().tolist(),
+        "col_sum": col_sum.tolist(),
+        "count": count,
+    }
+
+
+def partition_xy_stats_arrow(batches, features_col: str, label_col: str):
+    import pyarrow as pa
+
+    for row in partition_xy_stats(batches, features_col, label_col):
+        yield pa.RecordBatch.from_pylist([row], schema=stats_arrow_schema())
+
+
+def solve_linreg_from_stats(
+    gram: np.ndarray,
+    col_sum: np.ndarray,
+    count: int,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+) -> Tuple[np.ndarray, float]:
+    """Normal-equations solve from combined Z=[X|y] statistics — identical
+    math to the local streamed fit (``models/linear_regression.py``)."""
+    if count < 1:
+        raise ValueError("empty dataset")
+    n = col_sum.shape[0] - 1
+    gxx, gxy = gram[:n, :n], gram[:n, n]
+    if fit_intercept:
+        mu = col_sum / count
+        mu_x, mu_y = mu[:n], mu[n]
+        a = gxx / count - np.outer(mu_x, mu_x)
+        b = gxy / count - mu_x * mu_y
+        coef = np.linalg.solve(a + reg_param * np.eye(n), b)
+        return coef, float(mu_y - mu_x @ coef)
+    coef = np.linalg.solve(gxx / count + reg_param * np.eye(n), gxy / count)
+    return coef, 0.0
+
+
+def partition_kmeans_stats(
+    batches: Iterable, input_col: str, centers: np.ndarray
+) -> Iterator[Dict[str, object]]:
+    """One partition's per-cluster (Σx, count, cost) under fixed centers —
+    one Lloyd assignment half-step, shaped for ``mapInArrow`` with the
+    (small) centers broadcast via closure capture."""
+    k, n = centers.shape
+    sums = np.zeros((k, n))
+    counts = np.zeros(k)
+    cost = 0.0
+    seen = 0
+    c2 = (centers * centers).sum(axis=1)[None, :]
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(input_col))
+        else:
+            x = np.asarray(batch, dtype=np.float64)
+        if x.shape[0] == 0:
+            continue
+        d = np.maximum(
+            (x * x).sum(axis=1)[:, None] + c2 - 2.0 * (x @ centers.T), 0.0
+        )
+        labels = d.argmin(axis=1)
+        np.add.at(sums, labels, x)
+        np.add.at(counts, labels, 1.0)
+        cost += float(d.min(axis=1).sum())
+        seen += x.shape[0]
+    if seen == 0:
+        return
+    yield {
+        "sums": sums.ravel().tolist(),
+        "counts": counts.tolist(),
+        "cost": cost,
+        "count": seen,
+    }
+
+
+def kmeans_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema(
+        [
+            ("sums", pa.list_(pa.float64())),
+            ("counts", pa.list_(pa.float64())),
+            ("cost", pa.float64()),
+            ("count", pa.int64()),
+        ]
+    )
+
+
+def kmeans_stats_spark_ddl() -> str:
+    return "sums array<double>, counts array<double>, cost double, count bigint"
+
+
+def combine_kmeans_stats(rows: Iterable, k: int, n: int):
+    """Driver-side reduce of per-partition Lloyd stats →
+    (sums (k,n), counts (k,), cost, rows_seen)."""
+    sums = np.zeros((k, n))
+    counts = np.zeros(k)
+    cost = 0.0
+    seen = 0
+    for row in rows:
+        get = row.get if isinstance(row, dict) else row.__getitem__
+        sums += np.asarray(get("sums"), dtype=np.float64).reshape(k, n)
+        counts += np.asarray(get("counts"), dtype=np.float64)
+        cost += float(get("cost"))
+        seen += int(get("count"))
+    return sums, counts, cost, seen
+
+
 def combine_stats(
     rows: Iterable,
 ) -> Tuple[np.ndarray, np.ndarray, int]:

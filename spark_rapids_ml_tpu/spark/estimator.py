@@ -254,6 +254,213 @@ class PCAModel(Model, _TpuPCAParams):
         return model
 
 
+class _TpuLinRegParams(Params):
+    featuresCol = Param(Params._dummy(), "featuresCol", "features column",
+                        typeConverter=TypeConverters.toString)
+    labelCol = Param(Params._dummy(), "labelCol", "label column",
+                     typeConverter=TypeConverters.toString)
+    predictionCol = Param(Params._dummy(), "predictionCol",
+                          "prediction output column",
+                          typeConverter=TypeConverters.toString)
+    regParam = Param(Params._dummy(), "regParam", "L2 strength lambda",
+                     typeConverter=TypeConverters.toFloat)
+    fitIntercept = Param(Params._dummy(), "fitIntercept", "fit an intercept",
+                         typeConverter=TypeConverters.toBoolean)
+
+    def __init__(self):
+        super().__init__()
+        self._setDefault(featuresCol="features", labelCol="label",
+                         predictionCol="prediction", regParam=0.0,
+                         fitIntercept=True)
+
+
+class LinearRegression(Estimator, _TpuLinRegParams):
+    """Normal-equations LinearRegression over a Spark DataFrame: ONE
+    ``mapInArrow`` pass of Z=[X|y] sufficient statistics on executors, a
+    driver combine, and the tiny (n+1)² solve — the same partial-aggregate
+    data plane as the PCA fit."""
+
+    @keyword_only
+    def __init__(self, *, featuresCol="features", labelCol="label",
+                 predictionCol="prediction", regParam=0.0, fitIntercept=True):
+        super().__init__()
+        self._set(**{k_: v for k_, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def setRegParam(self, value):
+        return self._set(regParam=value)
+
+    def setFitIntercept(self, value):
+        return self._set(fitIntercept=value)
+
+    def _fit(self, dataset) -> "LinearRegressionModel":
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            partition_xy_stats_arrow,
+            solve_linreg_from_stats,
+        )
+
+        fcol = self.getOrDefault(self.featuresCol)
+        lcol = self.getOrDefault(self.labelCol)
+        df = dataset.select(fcol, lcol)
+
+        def stats(batches):
+            return partition_xy_stats_arrow(batches, fcol, lcol)
+
+        rows = df.mapInArrow(stats, stats_spark_ddl()).collect()
+        gram, col_sum, count = combine_stats(rows)
+        coef, intercept = solve_linreg_from_stats(
+            gram, col_sum, count,
+            reg_param=float(self.getOrDefault(self.regParam)),
+            fit_intercept=self.getOrDefault(self.fitIntercept),
+        )
+        model = LinearRegressionModel(
+            coefficients=DenseVector(coef.tolist()), intercept=intercept
+        )
+        return self._copyValues(model)
+
+
+class LinearRegressionModel(Model, _TpuLinRegParams):
+    def __init__(self, coefficients=None, intercept=0.0):
+        super().__init__()
+        self.coefficients = coefficients
+        self.intercept = intercept
+
+    def _transform(self, dataset):
+        import pandas as pd
+        from pyspark.sql.functions import pandas_udf
+
+        coef = self.coefficients.toArray()
+        b = float(self.intercept)
+
+        @pandas_udf(returnType="double")
+        def predict(v: pd.Series) -> pd.Series:
+            x = np.stack([row.toArray() for row in v])
+            return pd.Series(x @ coef + b)
+
+        return dataset.withColumn(
+            self.getOrDefault(self.predictionCol),
+            predict(dataset[self.getOrDefault(self.featuresCol)]),
+        )
+
+
+class _TpuKMeansParams(Params):
+    featuresCol = Param(Params._dummy(), "featuresCol", "features column",
+                        typeConverter=TypeConverters.toString)
+    predictionCol = Param(Params._dummy(), "predictionCol",
+                          "cluster-id output column",
+                          typeConverter=TypeConverters.toString)
+    k = Param(Params._dummy(), "k", "number of clusters",
+              typeConverter=TypeConverters.toInt)
+    maxIter = Param(Params._dummy(), "maxIter", "max Lloyd iterations",
+                    typeConverter=TypeConverters.toInt)
+    tol = Param(Params._dummy(), "tol", "center-shift tolerance",
+                typeConverter=TypeConverters.toFloat)
+    seed = Param(Params._dummy(), "seed", "k-means++ seeding RNG seed",
+                 typeConverter=TypeConverters.toInt)
+
+    def __init__(self):
+        super().__init__()
+        self._setDefault(featuresCol="features", predictionCol="prediction",
+                         k=2, maxIter=20, tol=1e-4, seed=0)
+
+
+class KMeans(Estimator, _TpuKMeansParams):
+    """Lloyd over a Spark DataFrame: k-means++ seeding on a driver-collected
+    sample, then one ``mapInArrow`` stats job per iteration (per-cluster
+    sums/counts/cost combined on the driver) — Spark MLlib's own
+    driver-coordinated shape, with Arrow-batch executor math."""
+
+    @keyword_only
+    def __init__(self, *, k=2, featuresCol="features",
+                 predictionCol="prediction", maxIter=20, tol=1e-4, seed=0):
+        super().__init__()
+        self._set(**{k_: v for k_, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def setK(self, value):
+        return self._set(k=value)
+
+    def _fit(self, dataset) -> "KMeansModel":
+        from spark_rapids_ml_tpu.models.kmeans import _host_kmeans_pp
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            combine_kmeans_stats,
+            kmeans_stats_spark_ddl,
+            partition_kmeans_stats,
+            vector_column_to_matrix,
+        )
+
+        fcol = self.getOrDefault(self.featuresCol)
+        k = self.getOrDefault(self.k)
+        df = dataset.select(fcol)
+
+        sample_rows = [r[0] for r in df.limit(max(4096, 8 * k)).collect()]
+        sample = np.stack([np.asarray(r.toArray()) for r in sample_rows])
+        rng = np.random.default_rng(self.getOrDefault(self.seed))
+        centers = _host_kmeans_pp(sample, k, rng)
+
+        n = centers.shape[1]
+        cost = float("inf")
+        for _ in range(self.getOrDefault(self.maxIter)):
+            frozen = centers.copy()
+
+            def stats(batches, _c=frozen):
+                import pyarrow as pa
+
+                from spark_rapids_ml_tpu.spark.aggregate import (
+                    kmeans_stats_arrow_schema,
+                )
+
+                for row in partition_kmeans_stats(batches, fcol, _c):
+                    yield pa.RecordBatch.from_pylist(
+                        [row], schema=kmeans_stats_arrow_schema()
+                    )
+
+            rows = df.mapInArrow(stats, kmeans_stats_spark_ddl()).collect()
+            sums, counts, cost, _ = combine_kmeans_stats(rows, k, n)
+            new_centers = np.where(
+                counts[:, None] > 0,
+                sums / np.maximum(counts, 1.0)[:, None],
+                centers,
+            )
+            moved = float(np.sqrt(((new_centers - centers) ** 2).sum(axis=1).max()))
+            centers = new_centers
+            if moved <= self.getOrDefault(self.tol):
+                break
+        model = KMeansModel(
+            clusterCenters=[DenseVector(c.tolist()) for c in centers]
+        )
+        model.trainingCost = cost
+        return self._copyValues(model)
+
+
+class KMeansModel(Model, _TpuKMeansParams):
+    def __init__(self, clusterCenters=None):
+        super().__init__()
+        self._centers = clusterCenters
+        self.trainingCost = None
+
+    def clusterCenters(self):
+        return [c.toArray() for c in self._centers]
+
+    def _transform(self, dataset):
+        import pandas as pd
+        from pyspark.sql.functions import pandas_udf
+
+        centers = np.stack([c.toArray() for c in self._centers])
+        c2 = (centers * centers).sum(axis=1)[None, :]
+
+        @pandas_udf(returnType="int")
+        def assign(v: pd.Series) -> pd.Series:
+            x = np.stack([row.toArray() for row in v])
+            d = (x * x).sum(axis=1)[:, None] + c2 - 2.0 * (x @ centers.T)
+            return pd.Series(d.argmin(axis=1).astype(np.int32))
+
+        return dataset.withColumn(
+            self.getOrDefault(self.predictionCol),
+            assign(dataset[self.getOrDefault(self.featuresCol)]),
+        )
+
+
 class _LocalParamsProxy:
     """Adapts a pyspark Params object to io.persistence's estimator
     interface (uid + param_map_for_metadata)."""
