@@ -11,7 +11,9 @@ never mutates with the execution platform; ``platform``/``device_kind``/
 comparable (a CPU-fallback number is visibly a CPU number, not a different
 metric). ``mfu`` is useful-FLOPs MFU: 2·rows·cols² for the Gram over the
 chip's peak — with the default ``bfloat16_3x`` Gram precision the MXU does
-3 bf16 passes per useful FLOP, so ~33% is the attainable ceiling.
+3 bf16 passes per useful FLOP, so ~33% is the ceiling for a full Gram; the
+Pallas symmetric folded-grid kernel computes only the upper triangle
+(half the passes), raising the attainable ceiling to ~67%.
 
 The reference publishes no numbers (SURVEY.md §6), so ``vs_baseline`` is
 the speedup over the host-CPU oracle path (NumPy/LAPACK), projected from a
@@ -111,6 +113,7 @@ def main() -> None:
         finalize_stats,
         init_stats,
         update_stats,
+        update_stats_auto,
     )
 
     device = jax.devices()[0]
@@ -126,9 +129,12 @@ def main() -> None:
     n_steps = max(1, rows // batch)
     configured_rows = n_steps * batch
 
-    # warm-up: compile update + finalize once (host read = true barrier)
+    # warm-up: compile update + finalize once (host read = true barrier).
+    # update_stats_auto is the PRODUCTION accumulate: on TPU with aligned
+    # f32 batches it selects the Pallas symmetric folded-grid Gram (half
+    # the MXU/HBM work), elsewhere the XLA dot_general path.
     stats = init_stats(cols, dtype=jnp.float32, device=device)
-    stats = update_stats(stats, x_batch)
+    stats = update_stats_auto(stats, x_batch)
     np.asarray(finalize_stats(stats, k).components)
 
     # Timed run, in flushes of up to 16 queued steps. Each flush ends with a
@@ -146,7 +152,7 @@ def main() -> None:
     while steps_done < n_steps:
         burst = min(flush, n_steps - steps_done)
         for _ in range(burst):
-            stats = update_stats(stats, x_batch)
+            stats = update_stats_auto(stats, x_batch)
         int(np.asarray(stats.count))  # fence
         steps_done += burst
         if time.perf_counter() - t0 > max_seconds:
@@ -172,27 +178,36 @@ def main() -> None:
         else None
     )
 
-    # A/B arm: the Pallas fused-Gram accumulator vs the lax.dot_general one
-    # (VERDICT r1 #5: bench it on the chip and keep whichever wins). Runs a
-    # short steady-state burst; rate lands in the pallas_rows_per_sec field.
+    # A/B arms: steady-state rate of each Gram accumulator (VERDICT r1 #5:
+    # bench both on the chip, ship whichever wins — update_stats_auto above
+    # encodes the winner; these fields keep the evidence in every record).
     pallas_rows_per_sec = None
+    xla_rows_per_sec = None
     if platform not in ("cpu",) and os.environ.get("BENCH_COMPARE_PALLAS", "1") == "1":
+
+        def _arm_rate(step_fn):
+            astats = init_stats(cols, dtype=jnp.float32, device=device)
+            astats = step_fn(astats, x_batch)  # compile
+            int(np.asarray(astats.count))
+            asteps = min(32, n_steps)
+            astats = init_stats(cols, dtype=jnp.float32, device=device)
+            t0 = time.perf_counter()
+            for _ in range(asteps):
+                astats = step_fn(astats, x_batch)
+            int(np.asarray(astats.count))  # fence
+            return round(asteps * batch / (time.perf_counter() - t0), 1)
+
         try:
             from spark_rapids_ml_tpu.ops.streaming import update_stats_fused
 
-            pstats = init_stats(cols, dtype=jnp.float32, device=device)
-            pstats = update_stats_fused(pstats, x_batch)  # compile
-            int(np.asarray(pstats.count))
-            psteps = min(32, n_steps)
-            pstats = init_stats(cols, dtype=jnp.float32, device=device)
-            t0 = time.perf_counter()
-            for _ in range(psteps):
-                pstats = update_stats_fused(pstats, x_batch)
-            int(np.asarray(pstats.count))  # fence
-            pallas_seconds = time.perf_counter() - t0
-            pallas_rows_per_sec = round(psteps * batch / pallas_seconds, 1)
+            pallas_rows_per_sec = _arm_rate(update_stats_fused)
         except Exception as exc:  # noqa: BLE001 - A/B arm must not kill the bench
             print(f"# pallas gram arm failed: {type(exc).__name__}: {exc}",
+                  flush=True)
+        try:
+            xla_rows_per_sec = _arm_rate(update_stats)
+        except Exception as exc:  # noqa: BLE001
+            print(f"# xla gram arm failed: {type(exc).__name__}: {exc}",
                   flush=True)
 
     # CPU baseline proxy: same pipeline via NumPy/LAPACK. The per-row Gram
@@ -233,6 +248,7 @@ def main() -> None:
                 "fit_seconds": round(fit_seconds, 2),
                 "finalize_seconds": round(finalize_seconds, 3),
                 "pallas_rows_per_sec": pallas_rows_per_sec,
+                "xla_rows_per_sec": xla_rows_per_sec,
             }
         )
     )

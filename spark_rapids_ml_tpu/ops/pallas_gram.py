@@ -20,19 +20,92 @@ interpret mode).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
-# f32 min tile is (8,128); 256×256 output tiles with 512-row strips keep
-# VMEM well under budget: 2×(512×256) inputs + (256×256) acc ≈ 1.3 MB.
-_BLOCK_N = 256
-_BLOCK_R = 512
+# f32 min tile is (8,128). Block sizes were swept on a live TPU v5e
+# (bn×br ∈ {256,512,1024,2048}×{512,1024,2048,4096}, 65536×4096 batches):
+# 512×1024 wins (2.29M rows/s in the donated-accumulator bench; 256×512
+# manages only ~0.4M — small output tiles starve the MXU between grid
+# steps) and 2048-wide blocks fail to compile. Scoped-VMEM cost at
+# 512×1024: double-buffered f32 inputs 2×2×(1024×512×4B) = 8 MB, bf16
+# hi/lo split temps 4×(1024×512×2B) = 4 MB, f32 acc + output staging
+# ≈ 2 MB, mean/rowmul slivers — ≈ 17 MB total, past the 16 MB default
+# scoped limit, hence the vmem_limit_bytes override on the pallas_call.
+_BLOCK_N = 512
+_BLOCK_R = 1024
 
 
-def _make_gram_kernel(precision):
+# One policy for "should this Gram use the Pallas kernel?" — shared by the
+# one-shot estimator gate (models/pca.py) and the streaming dispatch
+# (ops/streaming.py) so the two paths can never silently diverge.
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def pallas_gram_flag() -> str:
+    """TPUML_PALLAS_GRAM: '0' = force XLA, '1' = force Pallas (where it can
+    lower at all), unset/other = 'auto' (measured-cost heuristic)."""
+    value = os.environ.get("TPUML_PALLAS_GRAM")
+    return value if value in ("0", "1") else "auto"
+
+
+def symmetric_cost_wins(n_features: int) -> bool:
+    """Whether the folded symmetric kernel beats XLA at this width.
+
+    The kernel pads features to an even number of _BLOCK_N tiles and then
+    does half the padded work: cost ≈ padded² / 2 vs the XLA dot_general's
+    n². Selecting on a flat width threshold regresses in the bands just
+    above each tile boundary (e.g. n=1100 pads to 2048: 2048²/2 ≈ 2× the
+    XLA FLOPs *plus* a padded host copy), so compare actual costs.
+    """
+    block = 2 * _BLOCK_N
+    padded = -(-n_features // block) * block
+    return padded * padded <= 2 * n_features * n_features
+
+
+def pallas_gram_preferred(platform: str, dtype, n_features: int) -> bool:
+    """The shared policy gate: flag override, TPU-family backend, f32
+    compute, and the padded-cost heuristic. Callers add their own shape
+    constraints on top (the streaming path requires exact tile alignment;
+    the one-shot path pads)."""
+    flag = pallas_gram_flag()
+    if flag == "0":
+        return False
+    if platform not in _TPU_PLATFORMS:
+        return False  # Pallas only lowers on the TPU family
+    if jnp.dtype(dtype) != jnp.float32:
+        return False
+    if flag == "1":
+        return True
+    return symmetric_cost_wins(n_features)
+
+
+def _make_gram_kernel(precision, symmetric):
+    # Precision follows the SAME policy as the XLA gram()
+    # (TPUML_GRAM_PRECISION, default bfloat16_3x) so the bench A/B against
+    # lax.dot_general compares kernels doing identical MXU work. Mosaic's
+    # dot lowering accepts only DEFAULT/HIGHEST, so the 3-pass bf16 split
+    # (== lax.Precision.HIGH) is spelled out by hand: x = hi + lo in bf16,
+    # accumulate hiᵀhi + hiᵀlo + loᵀhi in f32 and drop the O(ε²) loᵀlo term.
+    split_bf16 = precision in ("bfloat16_3x", "high", jax.lax.Precision.HIGH)
+    hw_precision = (
+        jax.lax.Precision.DEFAULT if split_bf16 else precision
+    )
+
+    def _dot_t(a, b, acc_dtype):
+        return jax.lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())),
+            precision=hw_precision,
+            preferred_element_type=acc_dtype,
+        )
+
+    del symmetric  # tile selection lives in the grid/index maps, not here
+
     def _gram_kernel(x_i_ref, x_j_ref, mean_i_ref, mean_j_ref, rowmul_ref,
                      o_ref):
         r = pl.program_id(2)
@@ -41,29 +114,61 @@ def _make_gram_kernel(precision):
         def _init():
             o_ref[:] = jnp.zeros_like(o_ref)
 
-        m = rowmul_ref[:]  # (BLOCK_R, 1): mask × 1/√(n−1), zero on padding
+        m = rowmul_ref[:]  # (BLOCK_R, 1): mask × 1/√(n−1), 0 on padding
         xi = (x_i_ref[:] - mean_i_ref[:]) * m
         xj = (x_j_ref[:] - mean_j_ref[:]) * m
-        # Precision follows the SAME policy as the XLA gram()
-        # (TPUML_GRAM_PRECISION, default bfloat16_3x) so the bench A/B
-        # against lax.dot_general compares kernels doing identical MXU
-        # work, and a user's precision request is honored on this path too.
-        o_ref[:] += jax.lax.dot_general(
-            xi, xj, (((0,), (0,)), ((), ())),
-            precision=precision,
-            preferred_element_type=o_ref.dtype,
-        )
+        if split_bf16:
+            xi_hi = xi.astype(jnp.bfloat16)
+            xj_hi = xj.astype(jnp.bfloat16)
+            xi_lo = (xi - xi_hi.astype(xi.dtype)).astype(jnp.bfloat16)
+            xj_lo = (xj - xj_hi.astype(xj.dtype)).astype(jnp.bfloat16)
+            acc = _dot_t(xi_hi, xj_hi, o_ref.dtype)
+            acc += _dot_t(xi_hi, xj_lo, o_ref.dtype)
+            acc += _dot_t(xi_lo, xj_hi, o_ref.dtype)
+            o_ref[:] += acc
+        else:
+            o_ref[:] += _dot_t(xi, xj, o_ref.dtype)
 
     return _gram_kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "precision"))
+def _folded_triangle_maps(n_tiles):
+    """Index maps for a folded triangular grid over a T×T symmetric output.
+
+    The upper triangle (j ≥ i) has T(T+1)/2 tiles. Pairing row p with row
+    T−1−p gives every pair exactly T+1 tiles — row p contributes its T−p
+    upper tiles, row T−1−p its p+1 — so a rectangular grid of
+    ceil(T/2) × (T+1) covers the triangle with no dead cells: half the MXU
+    work AND half the block fetches of the full grid (a skip-with-pl.when
+    variant still streams the dead tiles' operands; measured memory-bound
+    on a v5e at exactly the full grid's HBM time).
+
+    For odd T the fold pairs the middle row with itself; the q ≥ T−p branch
+    then revisits tiles of row p = T−1−p that the first branch already
+    covers. Those duplicates would double-accumulate, so the caller must
+    keep T even (pad features by one extra block if needed).
+    """
+    t = n_tiles
+
+    def _ij(p, q):
+        in_first = q < t - p
+        i = jnp.where(in_first, p, t - 1 - p)
+        j = jnp.where(in_first, p + q, q - (t - p) + t - 1 - p)
+        return i, j
+
+    return _ij
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "precision", "symmetric")
+)
 def fused_centered_gram(
     x: jnp.ndarray,
     mean: jnp.ndarray,
     rowmul: jnp.ndarray,
     interpret: bool = False,
     precision=None,
+    symmetric: bool = True,
 ) -> jnp.ndarray:
     """``(diag(rowmul)·(X − mean))ᵀ (diag(rowmul)·(X − mean))`` in one pass.
 
@@ -72,6 +177,14 @@ def fused_centered_gram(
     ``RapidsRowMatrix.scala:169,179-181``). Requires row/col extents padded
     to the tile grid (use ``pad_for_fused_gram``); padding rows carry
     rowmul=0 so they contribute nothing.
+
+    ``symmetric=True`` (default) exploits Gram symmetry: a folded
+    triangular grid visits only upper block tiles — half the MXU FLOPs and
+    half the HBM block fetches, a structural advantage a generic
+    ``dot_general`` cannot express — then the result is mirrored with an
+    elementwise triu + transpose. Requires an even feature-tile count
+    (``pad_for_fused_gram`` guarantees it); odd tile counts fall back to
+    the full grid.
     """
     rows, n = x.shape
     if rows % _BLOCK_R or n % _BLOCK_N:
@@ -83,23 +196,76 @@ def fused_centered_gram(
 
     if precision is None:
         precision = default_gram_precision()
-    grid = (n // _BLOCK_N, n // _BLOCK_N, rows // _BLOCK_R)
+    n_tiles = n // _BLOCK_N
+    r_tiles = rows // _BLOCK_R
+    symmetric = symmetric and n_tiles % 2 == 0  # odd fold double-counts
     mean2d = mean.reshape(1, n).astype(x.dtype)
     rowmul2d = rowmul.reshape(rows, 1).astype(x.dtype)
-    return pl.pallas_call(
-        _make_gram_kernel(precision),
+    if symmetric:
+        ij = _folded_triangle_maps(n_tiles)
+        grid = (n_tiles // 2, n_tiles + 1, r_tiles)
+
+        def _xi(p, q, r):
+            return (r, ij(p, q)[0])
+
+        def _xj(p, q, r):
+            return (r, ij(p, q)[1])
+
+        def _mi(p, q, r):
+            return (0, ij(p, q)[0])
+
+        def _mj(p, q, r):
+            return (0, ij(p, q)[1])
+
+        def _out(p, q, r):
+            return ij(p, q)
+
+    else:
+        grid = (n_tiles, n_tiles, r_tiles)
+
+        def _xi(i, j, r):
+            return (r, i)
+
+        def _xj(i, j, r):
+            return (r, j)
+
+        def _mi(i, j, r):
+            return (0, i)
+
+        def _mj(i, j, r):
+            return (0, j)
+
+        def _out(i, j, r):
+            return (i, j)
+
+    out = pl.pallas_call(
+        _make_gram_kernel(precision, symmetric),
         out_shape=jax.ShapeDtypeStruct((n, n), x.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_R, _BLOCK_N), lambda i, j, r: (r, i)),
-            pl.BlockSpec((_BLOCK_R, _BLOCK_N), lambda i, j, r: (r, j)),
-            pl.BlockSpec((1, _BLOCK_N), lambda i, j, r: (0, i)),
-            pl.BlockSpec((1, _BLOCK_N), lambda i, j, r: (0, j)),
-            pl.BlockSpec((_BLOCK_R, 1), lambda i, j, r: (r, 0)),
+            pl.BlockSpec((_BLOCK_R, _BLOCK_N), _xi),
+            pl.BlockSpec((_BLOCK_R, _BLOCK_N), _xj),
+            pl.BlockSpec((1, _BLOCK_N), _mi),
+            pl.BlockSpec((1, _BLOCK_N), _mj),
+            pl.BlockSpec((_BLOCK_R, 1), lambda *idx: (idx[-1], 0)),
         ],
-        out_specs=pl.BlockSpec((_BLOCK_N, _BLOCK_N), lambda i, j, r: (i, j)),
+        out_specs=pl.BlockSpec((_BLOCK_N, _BLOCK_N), _out),
         interpret=interpret,
+        # 512×1024 blocks need ~17MB of scoped VMEM (see the block-size
+        # comment above for the breakdown) — just past the 16MB default
+        # scoped limit, well inside the chip's 128MB VMEM.
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
     )(x, x, mean2d, mean2d, rowmul2d)
+    if symmetric:
+        # Diagonal block tiles are computed in full, so their strictly-lower
+        # elements are already correct — the elementwise triu keeps one copy
+        # and the transpose restores the mirrored half exactly. Lower tiles
+        # the folded grid never visited are overwritten here, so their
+        # (uninitialized) contents never escape.
+        out = jnp.triu(out) + jnp.triu(out, 1).T
+    return out
 
 
 def pad_for_fused_gram(x, mask=None, dtype=None):
@@ -116,7 +282,9 @@ def pad_for_fused_gram(x, mask=None, dtype=None):
     dtype = x.dtype if dtype is None else np.dtype(dtype)
     rows, n = x.shape
     pr = (-rows) % _BLOCK_R
-    pn = (-n) % _BLOCK_N
+    # Pad features to an EVEN number of _BLOCK_N tiles so the symmetric
+    # folded-triangle grid applies (an odd tile count can't fold).
+    pn = (-n) % (2 * _BLOCK_N)
     rowmask = (
         np.ones(rows, dtype=dtype) if mask is None
         else np.asarray(mask, dtype=dtype)

@@ -78,10 +78,10 @@ def finalize_stats(
 
 @partial(jax.jit, donate_argnums=(0,))
 def update_stats_fused(stats: GramStats, batch: jnp.ndarray) -> GramStats:
-    """``update_stats`` with the Gram computed by the Pallas fused kernel
-    (``ops.pallas_gram``) instead of ``lax.dot_general`` — the bench's
-    A/B arm for selecting the faster Gram on real hardware. Requires
-    tile-aligned batches (rows % 512 == 0, cols % 256 == 0) and no mask."""
+    """``update_stats`` with the Gram computed by the Pallas symmetric
+    folded-grid kernel (``ops.pallas_gram``) instead of ``lax.dot_general``.
+    Requires tile-aligned batches (rows % _BLOCK_R == 0, an even number of
+    _BLOCK_N feature tiles) and no mask."""
     from spark_rapids_ml_tpu.ops.pallas_gram import fused_centered_gram
 
     b = batch.astype(stats.gram.dtype)
@@ -93,6 +93,55 @@ def update_stats_fused(stats: GramStats, batch: jnp.ndarray) -> GramStats:
     return GramStats(stats.gram + g, stats.col_sum + s, stats.count + cnt)
 
 
+def _gram_platform(gram_acc) -> str:
+    """Platform of the accumulator's device (seam for dispatch tests)."""
+    return next(iter(gram_acc.devices())).platform
+
+
+def fused_update_applicable(gram_acc, batch, mask) -> bool:
+    """Whether the Pallas Gram accumulator handles this (acc, batch, mask).
+
+    The policy (flag override, TPU family, f32, measured-cost heuristic)
+    is ``ops.pallas_gram.pallas_gram_preferred`` — shared with the one-shot
+    estimator gate. On top of it this path requires exact tile alignment
+    and no mask (``update_stats_fused`` does not pad). The env kill switch
+    (TPUML_PALLAS_GRAM=0) is honored BEFORE any pallas import so it also
+    bypasses a pallas module that fails to import.
+    """
+    import os
+
+    if os.environ.get("TPUML_PALLAS_GRAM") == "0":
+        return False
+    if mask is not None or gram_acc.dtype != jnp.float32:
+        return False
+    try:
+        from spark_rapids_ml_tpu.ops.pallas_gram import (
+            _BLOCK_N,
+            _BLOCK_R,
+            pallas_gram_preferred,
+        )
+    except Exception:  # pallas unavailable on this JAX build
+        return False
+    rows, n = batch.shape
+    if rows % _BLOCK_R or n % _BLOCK_N or (n // _BLOCK_N) % 2:
+        return False
+    try:
+        platform = _gram_platform(gram_acc)
+    except Exception:  # tracers / committed-less arrays: stay conservative
+        return False
+    return pallas_gram_preferred(platform, gram_acc.dtype, n)
+
+
+def update_stats_auto(
+    stats: GramStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+) -> GramStats:
+    """The production accumulate step: picks the measured-fastest Gram
+    kernel for this backend/shape (see ``fused_update_applicable``)."""
+    if fused_update_applicable(stats.gram, batch, mask):
+        return update_stats_fused(stats, batch)
+    return update_stats(stats, batch, mask)
+
+
 class StreamingPCA:
     """Convenience wrapper: ``StreamingPCA(n).partial_fit(b)...finalize(k)``."""
 
@@ -100,7 +149,7 @@ class StreamingPCA:
         self._stats = init_stats(n_features, dtype=dtype, device=device)
 
     def partial_fit(self, batch, mask=None) -> "StreamingPCA":
-        self._stats = update_stats(self._stats, batch, mask)
+        self._stats = update_stats_auto(self._stats, batch, mask)
         return self
 
     @property
@@ -152,6 +201,24 @@ def update_centered_gram(
     return gram_acc + gram(_masked(b, mask))
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _update_centered_gram_fused(gram_acc, batch, mean):
+    from spark_rapids_ml_tpu.ops.pallas_gram import fused_centered_gram
+
+    b = batch.astype(gram_acc.dtype)
+    ones = jnp.ones((b.shape[0],), dtype=b.dtype)
+    return gram_acc + fused_centered_gram(b, mean.astype(b.dtype), ones)
+
+
+def update_centered_gram_auto(gram_acc, batch, mean, mask=None):
+    """Centered-Gram accumulate via the measured-fastest kernel: the Pallas
+    kernel centers in VMEM (no (X−μ) materialization at all), same policy
+    gate as ``update_stats_auto``."""
+    if fused_update_applicable(gram_acc, batch, mask):
+        return _update_centered_gram_fused(gram_acc, batch, mean)
+    return update_centered_gram(gram_acc, batch, mean, mask)
+
+
 def stream_covariance(
     source,
     mean_centering: bool = True,
@@ -182,7 +249,7 @@ def stream_covariance(
         pass2_rows = 0
         for batch, mask in source.batches():
             pass2_rows += batch.shape[0] if mask is None else int(mask.sum())
-            gram_acc = update_centered_gram(
+            gram_acc = update_centered_gram_auto(
                 gram_acc, jnp.asarray(batch, dtype=dtype), mean,
                 None if mask is None else jnp.asarray(mask))
         if pass2_rows != int(count):
@@ -198,8 +265,8 @@ def stream_covariance(
 
     stats = init_stats(n, dtype=dtype, device=device)
     for batch, mask in source.batches():
-        stats = update_stats(stats, jnp.asarray(batch, dtype=dtype),
-                             None if mask is None else jnp.asarray(mask))
+        stats = update_stats_auto(stats, jnp.asarray(batch, dtype=dtype),
+                                  None if mask is None else jnp.asarray(mask))
     cov = covariance_from_stats(
         stats.gram, stats.col_sum, stats.count, mean_centering=mean_centering
     )

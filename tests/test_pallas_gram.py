@@ -65,8 +65,28 @@ def test_unpadded_shape_rejected(rng):
 def test_pad_helper():
     x = np.ones((10, 5), dtype=np.float32)
     xp, rm, n = pad_for_fused_gram(x)
-    assert xp.shape == (_BLOCK_R, _BLOCK_N) and n == 5
+    # features pad to an EVEN number of _BLOCK_N tiles (folded-grid req)
+    assert xp.shape == (_BLOCK_R, 2 * _BLOCK_N) and n == 5
     assert rm.sum() == 10
+    assert (xp.shape[1] // _BLOCK_N) % 2 == 0
+
+
+def test_symmetric_matches_full_grid(rng):
+    """The folded triangular grid must equal the full grid bit-for-bit in
+    the mirrored upper triangle (same tile dots, same accumulation order
+    over r) and stay exactly symmetric."""
+    rows, n = 2 * _BLOCK_R, 2 * _BLOCK_N
+    x = jnp.asarray(rng.normal(size=(rows, n)).astype(np.float32))
+    mean = jnp.asarray(rng.normal(size=(n,)).astype(np.float32))
+    rowmul = jnp.asarray(rng.uniform(0.5, 1.5, size=(rows,)).astype(np.float32))
+    full = np.asarray(
+        fused_centered_gram(x, mean, rowmul, interpret=True, symmetric=False)
+    )
+    sym = np.asarray(
+        fused_centered_gram(x, mean, rowmul, interpret=True, symmetric=True)
+    )
+    np.testing.assert_array_equal(sym, sym.T)
+    np.testing.assert_allclose(sym, full, rtol=1e-6, atol=1e-5)
 
 
 def test_pallas_flag_harmless_on_cpu(rng, monkeypatch):

@@ -59,3 +59,98 @@ def test_donation_keeps_single_gram_buffer(rng):
     for _ in range(5):
         stats = update_stats(stats, b)
     assert float(stats.count) == 80.0
+
+
+# -- production Gram dispatch (update_stats_auto / fused_update_applicable) --
+
+
+def _aligned_stats_and_batch(rng, rows=None, n=None, dtype=jnp.float32):
+    from spark_rapids_ml_tpu.ops.pallas_gram import _BLOCK_N, _BLOCK_R
+
+    rows = rows if rows is not None else _BLOCK_R
+    n = n if n is not None else 2 * _BLOCK_N
+    stats = init_stats(n, dtype=dtype)
+    batch = jnp.asarray(rng.normal(size=(rows, n)), dtype=dtype)
+    return stats, batch
+
+
+def test_fused_dispatch_rejects_cpu_and_auto_path_runs(rng):
+    """On CPU the gate must pick the XLA path (Pallas doesn't lower) and
+    update_stats_auto must still accumulate correctly through it."""
+    from spark_rapids_ml_tpu.ops.streaming import (
+        fused_update_applicable,
+        update_stats_auto,
+    )
+
+    stats, batch = _aligned_stats_and_batch(rng)
+    assert not fused_update_applicable(stats.gram, batch, None)
+    out = update_stats_auto(stats, batch)
+    assert int(out.count) == batch.shape[0]
+
+
+def test_fused_dispatch_shape_and_flag_branches(rng, monkeypatch):
+    """Every rejection branch of the gate, with the platform check stubbed
+    to 'tpu' so shape/flag logic is what's under test (CPU CI otherwise
+    short-circuits before reaching it)."""
+    import spark_rapids_ml_tpu.ops.streaming as streaming
+    from spark_rapids_ml_tpu.ops.pallas_gram import _BLOCK_N, _BLOCK_R
+    from spark_rapids_ml_tpu.ops.streaming import fused_update_applicable
+
+    monkeypatch.setattr(streaming, "_gram_platform", lambda acc: "tpu")
+
+    stats, batch = _aligned_stats_and_batch(rng)
+    ok = fused_update_applicable(stats.gram, batch, None)
+    assert ok  # aligned + f32 + tpu + no mask ⇒ fused
+
+    # mask present ⇒ XLA
+    mask = jnp.ones((batch.shape[0],))
+    assert not fused_update_applicable(stats.gram, batch, mask)
+
+    # kill switch wins over everything
+    monkeypatch.setenv("TPUML_PALLAS_GRAM", "0")
+    assert not fused_update_applicable(stats.gram, batch, None)
+    monkeypatch.delenv("TPUML_PALLAS_GRAM")
+
+    # misaligned rows ⇒ XLA (update_stats_fused does not pad)
+    assert not fused_update_applicable(stats.gram, batch[: _BLOCK_R - 8], None)
+
+    # odd feature-tile count can't fold ⇒ XLA
+    stats3, batch3 = _aligned_stats_and_batch(rng, n=3 * _BLOCK_N)
+    assert not fused_update_applicable(stats3.gram, batch3, None)
+
+    # non-f32 accumulator ⇒ XLA
+    stats64, batch64 = _aligned_stats_and_batch(rng, dtype=jnp.float64)
+    assert not fused_update_applicable(stats64.gram, batch64, None)
+
+
+def test_symmetric_cost_heuristic_bands():
+    """The auto gate must not select Pallas in the width bands where
+    padding to an even tile count costs more than the XLA dot_general."""
+    from spark_rapids_ml_tpu.ops.pallas_gram import (
+        _BLOCK_N,
+        symmetric_cost_wins,
+    )
+
+    block = 2 * _BLOCK_N
+    assert symmetric_cost_wins(4 * block)       # aligned: half the work
+    assert symmetric_cost_wins(block)           # aligned at one tile pair
+    assert not symmetric_cost_wins(block + 76)  # pads to 2·block: 2× XLA
+    # above √2·block (≈1449 for 1024-blocks): padding to 2·block wins again
+    assert symmetric_cost_wins(int(block * 1.45))
+
+
+def test_centered_gram_auto_matches_plain(rng, monkeypatch):
+    """update_centered_gram_auto must give the same result whichever kernel
+    the gate picks (CPU here ⇒ XLA arm; the fused arm is covered by the
+    interpret-mode pallas tests and the on-chip bench)."""
+    from spark_rapids_ml_tpu.ops.streaming import (
+        update_centered_gram,
+        update_centered_gram_auto,
+    )
+
+    n = 16
+    batch = jnp.asarray(rng.normal(size=(24, n)), dtype=jnp.float32)
+    mean = jnp.asarray(rng.normal(size=(n,)), dtype=jnp.float32)
+    a = update_centered_gram_auto(jnp.zeros((n, n), jnp.float32), batch, mean)
+    b = update_centered_gram(jnp.zeros((n, n), jnp.float32), batch, mean)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
