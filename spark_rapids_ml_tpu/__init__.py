@@ -27,6 +27,10 @@ __version__ = "0.1.0"
 
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
 from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel  # noqa: F401
+from spark_rapids_ml_tpu.models.nearest_neighbors import (  # noqa: F401
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
 from spark_rapids_ml_tpu.models.linear_regression import (  # noqa: F401
     LinearRegression,
     LinearRegressionModel,
@@ -45,6 +49,8 @@ __all__ = [
     "PCAModel",
     "KMeans",
     "KMeansModel",
+    "NearestNeighbors",
+    "NearestNeighborsModel",
     "LinearRegression",
     "LinearRegressionModel",
     "LogisticRegression",

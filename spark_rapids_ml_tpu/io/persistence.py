@@ -568,3 +568,36 @@ def load_scaler_model(path: str):
         uid=meta["uid"],
     )
     return _restore_params(model, meta)
+
+
+def save_knn_model(model, path: str, overwrite: bool = False) -> None:
+    """NearestNeighborsModel: the fitted item matrix is the model payload
+    (brute-force KNN has no reduced parameters), stored in the same
+    DenseMatrix wire struct every other model uses."""
+    if model.items is None:
+        raise ValueError("cannot save an unfitted NearestNeighborsModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {"items": _dense_matrix_struct(model.items)}
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([("items", _matrix_arrow_type())])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[("items", "matrix")])
+
+
+def load_knn_model(path: str):
+    from spark_rapids_ml_tpu.models.nearest_neighbors import (
+        NearestNeighborsModel,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = NearestNeighborsModel(
+        items=_dense_matrix_from_struct(row["items"])
+    )
+    model.uid = meta["uid"]
+    return _restore_params(model, meta)

@@ -8,6 +8,10 @@ from spark_rapids_ml_tpu.models.logistic_regression import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.nearest_neighbors import (
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
 
 __all__ = [
@@ -19,6 +23,8 @@ __all__ = [
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "NearestNeighbors",
+    "NearestNeighborsModel",
     "Pipeline",
     "PipelineModel",
 ]

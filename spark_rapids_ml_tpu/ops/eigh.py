@@ -83,13 +83,35 @@ def pca_postprocess_host(evals, evecs, k: int):
 
 
 def pca_from_covariance(
-    cov: jnp.ndarray, k: int, flip_signs: bool = True
+    cov: jnp.ndarray, k: int, flip_signs: bool = True, solver: str = "eigh"
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(components[n,k], explained_variance_ratio[k]) from covariance.
 
     ``k`` is static (compile-time), matching the top-k truncation
     ``Arrays.copyOfRange(u.data, 0, n*k)`` (``RapidsRowMatrix.scala:104-109``).
+
+    ``solver``:
+    * ``"eigh"`` (default) — dense full-spectrum factorization, exact
+      per-vector parity with the LAPACK/Spark oracle. O(n³), and the fixed
+      cost that dominates small-row fits (measured 0.9s at n=4096 on a
+      v5e).
+    * ``"randomized"`` — Halko-Martinsson-Tropp subspace iteration for the
+      top k only (``ops.randomized``): a chain of tall-skinny MXU matmuls,
+      O(n²·k), ~100× faster at n=4096 k=256. The λ/Σλ denominator stays
+      EXACT via trace(cov). Per-vector accuracy depends on spectral gaps —
+      see the accuracy caveat in ``ops/randomized.py``; use on decaying
+      spectra (the regime where PCA is meaningful).
     """
+    if solver == "randomized":
+        from spark_rapids_ml_tpu.ops.randomized import (
+            randomized_pca_from_covariance,
+        )
+
+        return randomized_pca_from_covariance(
+            cov, k, jnp.trace(cov), flip_signs=flip_signs
+        )
+    if solver != "eigh":
+        raise ValueError(f"solver={solver!r}: expected 'eigh' or 'randomized'")
     evals, evecs = eigh_descending(cov)
     if flip_signs:
         evecs = sign_flip(evecs)

@@ -31,13 +31,16 @@ class PCAFitResult(NamedTuple):
     mean: jnp.ndarray                # (n_features,) column means (or zeros)
 
 
-@partial(jax.jit, static_argnames=("k", "mean_centering", "flip_signs"))
+@partial(
+    jax.jit, static_argnames=("k", "mean_centering", "flip_signs", "solver")
+)
 def pca_fit_kernel(
     x: jnp.ndarray,
     k: int,
     mask: Optional[jnp.ndarray] = None,
     mean_centering: bool = True,
     flip_signs: bool = True,
+    solver: str = "eigh",
 ) -> PCAFitResult:
     """Full PCA fit on one device: mean → centered Gram → eigh → top-k.
 
@@ -51,7 +54,9 @@ def pca_fit_kernel(
     else:
         mean = jnp.zeros((x.shape[1],), dtype=x.dtype)
         cov = covariance(x, mean=None, mask=mask)
-    components, evr = pca_from_covariance(cov, k, flip_signs=flip_signs)
+    components, evr = pca_from_covariance(
+        cov, k, flip_signs=flip_signs, solver=solver
+    )
     return PCAFitResult(components, evr, mean)
 
 

@@ -35,6 +35,29 @@ import jax.numpy as jnp
 from spark_rapids_ml_tpu.ops.eigh import eigh_descending, sign_flip
 
 
+def _orthonormalize(y: jnp.ndarray) -> jnp.ndarray:
+    """Orthonormal basis of range(Y) via eigh-based whitening.
+
+    ``jnp.linalg.qr`` lowers to a blocked Householder loop that compiles
+    pathologically slowly on the TPU backend (minutes-scale at 4096×266,
+    measured via a hung finalize); the Gram-eigh route is three MXU matmuls
+    plus an l×l eigendecomposition (QDWH — the same primitive the dense
+    solver already compiles): B = YᵀY, B = VΛVᵀ, Q = Y·V·Λ^(−1/2).
+    Like CholeskyQR this squares the condition number, so callers
+    re-orthonormalize EVERY iteration (which subspace iteration does
+    anyway) and tiny Λ entries are clamped — directions that collapsed to
+    numerical zero are renormalized noise and get corrected by the next
+    matvec rather than poisoning the whole basis with NaNs.
+    """
+    b = y.T @ y
+    b = (b + b.T) / 2
+    evals, vecs = jnp.linalg.eigh(b)
+    eps = jnp.asarray(jnp.finfo(y.dtype).eps, y.dtype)
+    floor = jnp.maximum(evals[-1], 0.0) * eps * y.shape[0]
+    inv_sqrt = jnp.where(evals > floor, 1.0 / jnp.sqrt(jnp.maximum(evals, floor)), 0.0)
+    return y @ (vecs * inv_sqrt[None, :])
+
+
 def subspace_iteration(
     matvec: Callable[[jnp.ndarray], jnp.ndarray],
     n: int,
@@ -47,19 +70,24 @@ def subspace_iteration(
 
     ``matvec`` maps an (n, l) block to Cov @ block (full rows, whatever the
     caller's covariance layout). Returns (evals[l] descending, evecs[n, l]).
-    QR re-orthonormalization every step keeps the power iteration stable at
+    Re-orthonormalization every step keeps the power iteration stable at
     f32; the Rayleigh-Ritz projection B = QᵀCovQ recovers the eigenvalues.
     """
-    omega = jax.random.normal(key, (n, l), dtype=dtype)
-    y = matvec(omega)
-    for _ in range(max(n_iter, 0)):
-        q, _ = jnp.linalg.qr(y)
-        y = matvec(q)
-    q, _ = jnp.linalg.qr(y)
-    b = q.T @ matvec(q)
-    b = (b + b.T) / 2  # exact symmetry for eigh
-    evals, vecs = eigh_descending(b)
-    return evals, q @ vecs
+    # Full f32 matmuls throughout: the iteration's convergence and the
+    # Rayleigh-Ritz eigenvalues are sensitive to the single-pass-bf16 TPU
+    # default, and these tall-skinny (n×l) products are a rounding error
+    # next to the O(n²·rows) Gram that produced the covariance.
+    with jax.default_matmul_precision("highest"):
+        omega = jax.random.normal(key, (n, l), dtype=dtype)
+        y = matvec(omega)
+        for _ in range(max(n_iter, 0)):
+            q = _orthonormalize(y)
+            y = matvec(q)
+        q = _orthonormalize(y)
+        b = q.T @ matvec(q)
+        b = (b + b.T) / 2  # exact symmetry for eigh
+        evals, vecs = eigh_descending(b)
+        return evals, q @ vecs
 
 
 def topk_from_subspace(

@@ -58,12 +58,15 @@ def update_stats(
     return GramStats(stats.gram + g, stats.col_sum + s, stats.count + cnt)
 
 
-@partial(jax.jit, static_argnames=("k", "mean_centering", "flip_signs"))
+@partial(
+    jax.jit, static_argnames=("k", "mean_centering", "flip_signs", "solver")
+)
 def finalize_stats(
     stats: GramStats,
     k: int,
     mean_centering: bool = True,
     flip_signs: bool = True,
+    solver: str = "eigh",
 ) -> PCAFitResult:
     cov = covariance_from_stats(
         stats.gram, stats.col_sum, stats.count, mean_centering=mean_centering
@@ -72,7 +75,9 @@ def finalize_stats(
         mean = stats.col_sum / stats.count
     else:
         mean = jnp.zeros_like(stats.col_sum)
-    components, evr = pca_from_covariance(cov, k, flip_signs=flip_signs)
+    components, evr = pca_from_covariance(
+        cov, k, flip_signs=flip_signs, solver=solver
+    )
     return PCAFitResult(components, evr, mean)
 
 
@@ -156,9 +161,13 @@ class StreamingPCA:
     def rows_seen(self) -> float:
         return float(self._stats.count)
 
-    def finalize(self, k: int, mean_centering: bool = True) -> PCAFitResult:
+    def finalize(
+        self, k: int, mean_centering: bool = True, solver: str = "eigh"
+    ) -> PCAFitResult:
         return jax.block_until_ready(
-            finalize_stats(self._stats, k, mean_centering=mean_centering)
+            finalize_stats(
+                self._stats, k, mean_centering=mean_centering, solver=solver
+            )
         )
 
 

@@ -3,6 +3,9 @@ from spark_rapids_ml_tpu.parallel.distributed_pca import (
     distributed_pca_fit,
     distributed_pca_fit_kernel,
 )
+from spark_rapids_ml_tpu.parallel.distributed_knn import (
+    distributed_kneighbors,
+)
 from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     distributed_kmeans_fit,
     distributed_kmeans_fit_kernel,
@@ -26,6 +29,7 @@ __all__ = [
     "grid_mesh",
     "distributed_pca_fit",
     "distributed_pca_fit_kernel",
+    "distributed_kneighbors",
     "distributed_kmeans_fit",
     "distributed_kmeans_fit_kernel",
     "distributed_linreg_fit",

@@ -1,0 +1,126 @@
+"""NearestNeighbors: exact brute-force KNN vs a NumPy argsort oracle.
+
+Oracle pattern per SURVEY.md §4: every accelerated path is checked against
+an independent full-sort NumPy implementation. Distances are compared
+tightly; indices are compared via the distance values they select (tie
+groups may legitimately permute between top_k and argsort).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import NearestNeighbors, NearestNeighborsModel
+
+
+def _oracle(queries, items, k):
+    d2 = (
+        (queries * queries).sum(1, keepdims=True)
+        - 2.0 * queries @ items.T
+        + (items * items).sum(1)[None, :]
+    )
+    order = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    return np.sqrt(np.maximum(np.take_along_axis(d2, order, 1), 0.0)), order
+
+
+def _check_against_oracle(dist, idx, queries, items, k, atol=1e-4):
+    od, oi = _oracle(queries, items, k)
+    np.testing.assert_allclose(dist, od, atol=atol)
+    # index check robust to ties: the items each index selects must be at
+    # the oracle's distance
+    d_of_idx = np.linalg.norm(
+        queries[:, None, :] - items[idx], axis=2
+    )
+    np.testing.assert_allclose(d_of_idx, od, atol=atol)
+
+
+def test_kneighbors_matches_oracle(rng):
+    items = rng.normal(size=(500, 24))
+    queries = rng.normal(size=(37, 24))
+    model = NearestNeighbors().setK(7).fit(items)
+    dist, idx = model.kneighbors(queries)
+    assert dist.shape == (37, 7) and idx.shape == (37, 7)
+    _check_against_oracle(dist, idx, queries, items, 7)
+
+
+def test_kneighbors_crosses_query_bucket_boundary(rng):
+    """Query counts above the static bucket exercise the pad+slice loop."""
+    from spark_rapids_ml_tpu.models import nearest_neighbors as nn_mod
+
+    items = rng.normal(size=(64, 8))
+    queries = rng.normal(size=(nn_mod._QUERY_BUCKET + 13, 8))
+    model = NearestNeighbors().setK(3).fit(items)
+    dist, idx = model.kneighbors(queries)
+    assert dist.shape == (nn_mod._QUERY_BUCKET + 13, 3)
+    _check_against_oracle(dist, idx, queries, items, 3)
+
+
+def test_host_and_xla_paths_agree(rng):
+    items = rng.normal(size=(200, 16))
+    queries = rng.normal(size=(29, 16))
+    m_dev = NearestNeighbors().setK(5).fit(items)
+    m_host = NearestNeighbors().setK(5).setUseXlaDot(False).fit(items)
+    d1, i1 = m_dev.kneighbors(queries)
+    d2, i2 = m_host.kneighbors(queries)
+    np.testing.assert_allclose(d1, d2, atol=1e-4)
+
+
+def test_k_override_and_validation(rng):
+    items = rng.normal(size=(10, 4))
+    model = NearestNeighbors().setK(3).fit(items)
+    d, i = model.kneighbors(items, k=1)
+    assert d.shape == (10, 1)
+    # every row's nearest neighbor is itself at distance 0
+    np.testing.assert_allclose(d[:, 0], 0.0, atol=1e-5)
+    np.testing.assert_array_equal(i[:, 0], np.arange(10))
+    with pytest.raises(ValueError, match="k ="):
+        model.kneighbors(items, k=11)
+    with pytest.raises(ValueError, match="k ="):
+        NearestNeighbors().setK(11).fit(items)
+    with pytest.raises(ValueError, match="dim"):
+        model.kneighbors(np.zeros((2, 5)))
+
+
+def test_persistence_roundtrip(rng, tmp_path):
+    items = rng.normal(size=(50, 6))
+    model = NearestNeighbors().setK(4).fit(items)
+    path = str(tmp_path / "knn")
+    model.save(path)
+    loaded = NearestNeighborsModel.load(path)
+    assert loaded.getK() == 4
+    d1, i1 = model.kneighbors(items[:5])
+    d2, i2 = loaded.kneighbors(items[:5])
+    np.testing.assert_allclose(d1, d2, atol=1e-7)
+    np.testing.assert_array_equal(i1, i2)
+
+
+def test_distributed_matches_single_device(rng):
+    """Items sharded over 8 devices (uneven count ⇒ padded+masked shards)
+    must reproduce the single-device result exactly."""
+    from spark_rapids_ml_tpu.parallel import data_mesh, distributed_kneighbors
+
+    mesh8 = data_mesh(8)
+    items = rng.normal(size=(203, 12)).astype(np.float32)  # 203 % 8 != 0
+    queries = rng.normal(size=(17, 12)).astype(np.float32)
+    d, i = distributed_kneighbors(queries, items, 6, mesh8)
+    assert d.shape == (17, 6) and i.shape == (17, 6)
+    assert int(i.max()) < 203  # padding rows never selected
+    _check_against_oracle(
+        d, i, queries.astype(np.float64), items.astype(np.float64), 6,
+        atol=1e-3,
+    )
+
+
+def test_distributed_skewed_tiny_shards(rng):
+    """Fewer real items than k per shard: the two-level merge must still
+    return the exact global top-k (candidate-sufficiency property)."""
+    from spark_rapids_ml_tpu.parallel import data_mesh, distributed_kneighbors
+
+    mesh8 = data_mesh(8)
+    items = rng.normal(size=(9, 5)).astype(np.float32)  # ~1 row per shard
+    queries = rng.normal(size=(4, 5)).astype(np.float32)
+    d, i = distributed_kneighbors(queries, items, 6, mesh8)
+    assert np.isfinite(d).all()
+    _check_against_oracle(
+        d, i, queries.astype(np.float64), items.astype(np.float64), 6,
+        atol=1e-3,
+    )

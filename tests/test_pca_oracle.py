@@ -156,3 +156,65 @@ def test_transform_kernel_batched(rng):
     pc = rng.normal(size=(6, 3))
     out = pca_transform_kernel(jnp.asarray(x), jnp.asarray(pc))
     np.testing.assert_allclose(np.asarray(out), x @ pc, atol=1e-10)
+
+
+def test_randomized_solver_matches_oracle_on_decaying_spectrum(rng):
+    """svdSolver='randomized' must hit the oracle on a decaying spectrum —
+    the regime the solver documents (ops/randomized.py caveat)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu import PCA
+
+    n, d, k = 400, 48, 6
+    # strongly decaying spectrum: scale columns of an orthonormal basis
+    q, _ = np.linalg.qr(rng.normal(size=(d, d)))
+    scales = 3.0 ** (-np.arange(d))
+    x = rng.normal(size=(n, d)) @ (q * scales) + 5.0
+    m_r = PCA().setK(k).setSvdSolver("randomized").fit(x)
+    m_e = PCA().setK(k).setSvdSolver("eigh").fit(x)
+    np.testing.assert_allclose(
+        np.abs(np.asarray(m_r.pc)), np.abs(np.asarray(m_e.pc)), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_r.explained_variance),
+        np.asarray(m_e.explained_variance),
+        atol=5e-4,
+    )
+
+
+def test_randomized_solver_via_streaming_finalize(rng):
+    """finalize_stats(solver='randomized') shares semantics with the
+    one-shot randomized fit (same trace-exact λ/Σλ denominator)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.streaming import (
+        StreamingPCA,
+    )
+
+    n, d, k = 300, 32, 4
+    q, _ = np.linalg.qr(rng.normal(size=(d, d)))
+    x = (rng.normal(size=(n, d)) @ (q * 2.0 ** (-np.arange(d)))).astype(
+        np.float32
+    )
+    s = StreamingPCA(d)
+    for i in range(0, n, 100):
+        s.partial_fit(jnp.asarray(x[i : i + 100]))
+    res_r = s.finalize(k, solver="randomized")
+    res_e = s.finalize(k, solver="eigh")
+    np.testing.assert_allclose(
+        np.abs(np.asarray(res_r.components)),
+        np.abs(np.asarray(res_e.components)),
+        atol=2e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_r.explained_variance),
+        np.asarray(res_e.explained_variance),
+        atol=2e-3,
+    )
+
+
+def test_invalid_svd_solver_rejected():
+    from spark_rapids_ml_tpu import PCA
+
+    with np.testing.assert_raises(ValueError):
+        PCA().setSvdSolver("lanczos")
