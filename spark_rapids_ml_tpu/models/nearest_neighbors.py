@@ -84,7 +84,10 @@ class NearestNeighborsModel(NearestNeighborsParams):
     def __init__(self, items: Optional[np.ndarray] = None):
         super().__init__()
         self.items = items
-        self._device_items = None  # lazy (device array, mask) cache
+        # lazy device-resident item matrix, keyed on (device, dtype) so a
+        # setDeviceId/setDtype change re-stages instead of leaving the
+        # matrix committed to the old device
+        self._device_items = None
 
     def _copy_internal_state(self, other: "NearestNeighborsModel") -> None:
         other.items = self.items
@@ -123,12 +126,13 @@ class NearestNeighborsModel(NearestNeighborsParams):
 
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.getDtype())
-        if self._device_items is None or self._device_items[0].dtype != dtype:
+        cache_key = (device, jnp.dtype(dtype))
+        if self._device_items is None or self._device_items[0] != cache_key:
             items = jax.device_put(
                 jnp.asarray(self.items, dtype=dtype), device
             )
-            self._device_items = (items,)
-        (items,) = self._device_items
+            self._device_items = (cache_key, items)
+        items = self._device_items[1]
 
         n_q = queries.shape[0]
         out_d = np.empty((n_q, k), dtype=np.float64)

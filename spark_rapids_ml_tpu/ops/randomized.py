@@ -45,9 +45,12 @@ def _orthonormalize(y: jnp.ndarray) -> jnp.ndarray:
     solver already compiles): B = YᵀY, B = VΛVᵀ, Q = Y·V·Λ^(−1/2).
     Like CholeskyQR this squares the condition number, so callers
     re-orthonormalize EVERY iteration (which subspace iteration does
-    anyway) and tiny Λ entries are clamped — directions that collapsed to
-    numerical zero are renormalized noise and get corrected by the next
-    matvec rather than poisoning the whole basis with NaNs.
+    anyway) and tiny Λ entries are clamped. Clamped directions become
+    exactly-zero columns and STAY zero through subsequent matvecs (unlike
+    Householder QR, which would fill them with arbitrary orthonormal
+    vectors): Rayleigh-Ritz then assigns them eigenvalue 0 and they sort
+    last, so they only surface as zero component rows when the requested k
+    exceeds rank(Cov) — preferable to NaNs poisoning the whole basis.
     """
     b = y.T @ y
     b = (b + b.T) / 2

@@ -25,7 +25,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.ops.knn_kernel import pairwise_sqdist
+from spark_rapids_ml_tpu.ops.knn_kernel import knn_merge, pairwise_sqdist
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     pad_rows_to_multiple,
@@ -46,10 +46,11 @@ def _sharded_knn(queries, items_padded, item_mask, k: int, mesh: Mesh):
         offset = lax.axis_index(DATA_AXIS) * x_shard.shape[0]
         gidx = idx + offset
         # gather candidates from every shard, then merge on each replica
+        # (knn_merge = the shared two-level reduction; one implementation
+        # so sign/tie semantics can't drift between call sites)
         all_d = lax.all_gather(-neg, DATA_AXIS, axis=1, tiled=True)
         all_i = lax.all_gather(gidx, DATA_AXIS, axis=1, tiled=True)
-        mneg, mpos = lax.top_k(-all_d, k)
-        return -mneg, jnp.take_along_axis(all_i, mpos, axis=1)
+        return knn_merge(all_d, all_i, k)
 
     # check_vma=False: after the all_gather every shard holds the SAME
     # candidate set and runs the same deterministic merge, so the outputs

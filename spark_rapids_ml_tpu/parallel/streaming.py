@@ -65,10 +65,12 @@ def update_stats_sharded(
     return GramStats(g, s, c)
 
 
-@partial(jax.jit, static_argnames=("k", "mean_centering", "flip_signs"))
+@partial(
+    jax.jit, static_argnames=("k", "mean_centering", "flip_signs", "solver")
+)
 def finalize_stats_sharded(
     stats: GramStats, k: int, mean_centering: bool = True,
-    flip_signs: bool = True,
+    flip_signs: bool = True, solver: str = "eigh",
 ) -> PCAFitResult:
     """One all-reduce (the axis-0 sum over sharded slices), then the same
     covariance → eigh → postprocess chain as every other fit path."""
@@ -77,7 +79,9 @@ def finalize_stats_sharded(
     cnt = jnp.sum(stats.count, axis=0)
     cov = covariance_from_stats(g, s, cnt, mean_centering=mean_centering)
     mean = s / cnt if mean_centering else jnp.zeros_like(s)
-    components, evr = pca_from_covariance(cov, k, flip_signs=flip_signs)
+    components, evr = pca_from_covariance(
+        cov, k, flip_signs=flip_signs, solver=solver
+    )
     return PCAFitResult(components, evr, mean)
 
 
@@ -123,9 +127,13 @@ class DistributedStreamingPCA:
     def rows_seen(self) -> int:
         return int(np.asarray(jnp.sum(self._stats.count)))
 
-    def finalize(self, k: int, mean_centering: bool = True) -> PCAFitResult:
+    def finalize(
+        self, k: int, mean_centering: bool = True, solver: str = "eigh"
+    ) -> PCAFitResult:
         return jax.block_until_ready(
-            finalize_stats_sharded(self._stats, k, mean_centering=mean_centering)
+            finalize_stats_sharded(
+                self._stats, k, mean_centering=mean_centering, solver=solver
+            )
         )
 
 
@@ -135,6 +143,7 @@ def distributed_streaming_pca_fit(
     mesh: Mesh,
     mean_centering: bool = True,
     dtype=jnp.float32,
+    solver: str = "eigh",
 ) -> PCAFitResult:
     """Out-of-core fit of a ``data.batches.BatchSource`` over a mesh.
 
@@ -154,4 +163,4 @@ def distributed_streaming_pca_fit(
         acc.partial_fit(batch.astype(host_dtype, copy=False), mask)
     if mean_centering and acc.rows_seen < 2:
         raise ValueError("mean centering requires more than one row")
-    return acc.finalize(k, mean_centering=mean_centering)
+    return acc.finalize(k, mean_centering=mean_centering, solver=solver)

@@ -72,3 +72,31 @@ def test_distributed_streaming_batch_divisibility(data):
         distributed_streaming_pca_fit(
             BatchSource(data, batch_rows=500), k=2, mesh=mesh
         )
+
+
+def test_distributed_streaming_randomized_finalize(rng):
+    """solver='randomized' reaches the sharded finalize (the large-n regime
+    the O(n²k) solver targets) and agrees with eigh on a decaying
+    spectrum."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.data.batches import BatchSource
+    from spark_rapids_ml_tpu.parallel import data_mesh
+    from spark_rapids_ml_tpu.parallel.streaming import (
+        distributed_streaming_pca_fit,
+    )
+
+    mesh = data_mesh(8)
+    d = 24
+    q, _ = np.linalg.qr(rng.normal(size=(d, d)))
+    x = (rng.normal(size=(256, d)) @ (q * 2.0 ** (-np.arange(d)))).astype(
+        np.float32
+    )
+    src = BatchSource(x, batch_rows=64)
+    res_r = distributed_streaming_pca_fit(src, 4, mesh, solver="randomized")
+    res_e = distributed_streaming_pca_fit(src, 4, mesh, solver="eigh")
+    np.testing.assert_allclose(
+        np.abs(np.asarray(res_r.components)),
+        np.abs(np.asarray(res_e.components)),
+        atol=2e-3,
+    )
