@@ -40,6 +40,17 @@ from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
     LogisticRegressionModel,
 )
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
+from spark_rapids_ml_tpu.models.evaluation import (  # noqa: F401
+    BinaryClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.models.tuning import (  # noqa: F401
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
 from spark_rapids_ml_tpu.models.svd import TruncatedSVD, TruncatedSVDModel  # noqa: F401
 from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel  # noqa: F401
 from spark_rapids_ml_tpu.data.vector import DenseVector, SparseVector, Vectors  # noqa: F401
@@ -57,6 +68,13 @@ __all__ = [
     "LogisticRegressionModel",
     "Pipeline",
     "PipelineModel",
+    "RegressionEvaluator",
+    "BinaryClassificationEvaluator",
+    "ParamGridBuilder",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
     "TruncatedSVD",
     "TruncatedSVDModel",
     "StandardScaler",

@@ -13,6 +13,17 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
     NearestNeighborsModel,
 )
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
+from spark_rapids_ml_tpu.models.evaluation import (
+    BinaryClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.models.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
 
 __all__ = [
     "PCA",
@@ -27,4 +38,11 @@ __all__ = [
     "NearestNeighborsModel",
     "Pipeline",
     "PipelineModel",
+    "RegressionEvaluator",
+    "BinaryClassificationEvaluator",
+    "ParamGridBuilder",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
 ]

@@ -1,0 +1,126 @@
+"""Evaluators: the metric half of the Spark ML tuning API.
+
+Param names and defaults follow ``org.apache.spark.ml.evaluation``
+(RegressionEvaluator / BinaryClassificationEvaluator) — the API surface
+the reference plugs into, since its Estimators are consumed by Spark's
+own CrossValidator. Metrics are NumPy on host: they are O(rows) scalar
+reductions over already-computed predictions, not MXU work.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import as_vector_frame
+from spark_rapids_ml_tpu.models.params import Param, Params
+
+
+class RegressionEvaluator(Params):
+    """rmse (default) / mse / mae / r2 over (labelCol, predictionCol)."""
+
+    labelCol = Param("labelCol", "label column name", "label")
+    predictionCol = Param(
+        "predictionCol", "prediction column name", "prediction"
+    )
+    metricName = Param(
+        "metricName",
+        "rmse | mse | mae | r2",
+        "rmse",
+        validator=lambda v: v in ("rmse", "mse", "mae", "r2"),
+    )
+
+    def is_larger_better(self) -> bool:
+        return self.getMetricName() == "r2"
+
+    def evaluate(self, dataset) -> float:
+        frame = as_vector_frame(dataset, self.getPredictionCol())
+        y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
+        pred = np.asarray(
+            frame.column(self.getPredictionCol()), dtype=np.float64
+        )
+        resid = y - pred
+        name = self.getMetricName()
+        if name == "mse":
+            return float((resid**2).mean())
+        if name == "rmse":
+            return float(np.sqrt((resid**2).mean()))
+        if name == "mae":
+            return float(np.abs(resid).mean())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        if ss_tot <= 0:
+            return 0.0
+        return 1.0 - float((resid**2).sum()) / ss_tot
+
+
+class BinaryClassificationEvaluator(Params):
+    """areaUnderROC (default) / areaUnderPR over (labelCol, score column).
+
+    ``rawPredictionCol`` accepts any monotone score — this framework's
+    LogisticRegression writes P(y=1) to ``probabilityCol``, so the default
+    column name here is ``probability``. AUC is computed by the exact
+    rank statistic (Mann-Whitney), ties handled by midranks, matching
+    sklearn's roc_auc_score.
+    """
+
+    labelCol = Param("labelCol", "label column name", "label")
+    rawPredictionCol = Param(
+        "rawPredictionCol", "score column name", "probability"
+    )
+    metricName = Param(
+        "metricName",
+        "areaUnderROC | areaUnderPR",
+        "areaUnderROC",
+        validator=lambda v: v in ("areaUnderROC", "areaUnderPR"),
+    )
+
+    def is_larger_better(self) -> bool:
+        return True
+
+    def evaluate(self, dataset) -> float:
+        frame = as_vector_frame(dataset, self.getRawPredictionCol())
+        y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
+        y = (y >= 0.5).astype(np.int64)
+        score = np.asarray(
+            frame.column(self.getRawPredictionCol()), dtype=np.float64
+        )
+        n_pos = int(y.sum())
+        n_neg = int(y.size - n_pos)
+        if n_pos == 0 or n_neg == 0:
+            raise ValueError(
+                "AUC requires both classes present in the evaluation set"
+            )
+        if self.getMetricName() == "areaUnderROC":
+            # vectorized midranks: group ties via boundary detection, mean
+            # rank of a tie group = first_rank + (count−1)/2
+            order = np.argsort(score, kind="mergesort")
+            s_sorted = score[order]
+            new_grp = np.concatenate([[False], s_sorted[1:] != s_sorted[:-1]])
+            grp_id = np.cumsum(new_grp)
+            grp_start = np.concatenate([[0], np.nonzero(new_grp)[0]])
+            counts = np.bincount(grp_id)
+            mean_rank = grp_start + 1 + (counts - 1) / 2.0
+            ranks = np.empty(y.size, dtype=np.float64)
+            ranks[order] = mean_rank[grp_id]
+            return float(
+                (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2.0)
+                / (n_pos * n_neg)
+            )
+        # areaUnderPR: trapezoid over the PR curve sampled at DISTINCT
+        # thresholds only — a tie group is one operating point, so cumsums
+        # collapse to each group's last row (per-row sampling would make
+        # tied scores order-dependent and skew the area)
+        order = np.argsort(-score, kind="mergesort")
+        s_sorted = score[order]
+        tp = np.cumsum(y[order] == 1)
+        fp = np.cumsum(y[order] == 0)
+        last = np.nonzero(
+            np.concatenate([s_sorted[1:] != s_sorted[:-1], [True]])
+        )[0]
+        tp, fp = tp[last], fp[last]
+        precision = tp / np.maximum(tp + fp, 1)
+        recall = tp / n_pos
+        # prepend the (recall=0, precision=first) anchor, as Spark does
+        recall = np.concatenate([[0.0], recall])
+        precision = np.concatenate([[precision[0]], precision])
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2
+        return float(trapezoid(precision, recall))

@@ -1,0 +1,272 @@
+"""Model selection: ParamGridBuilder / CrossValidator / TrainValidationSplit.
+
+The Spark ML tuning surface (``org.apache.spark.ml.tuning``) that the
+reference's Estimators are consumed through. Semantics match Spark:
+k-fold (or single split) over shuffled rows, average metric per param
+map, winner refit on the FULL dataset; ``foldCol``-style custom folds are
+out of scope. Fitting is sequential over param maps — each inner fit
+already saturates the chip, so Spark's ``parallelism`` knob would only
+thrash HBM here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import as_vector_frame
+from spark_rapids_ml_tpu.models.params import Param, Params
+
+
+class ParamGridBuilder:
+    """``ParamGridBuilder().addGrid('regParam', [0.0, 0.1]).build()`` →
+    list of {param-name: value} maps (cartesian product, Spark's shape)."""
+
+    def __init__(self):
+        self._grid: Dict[str, List] = {}
+
+    def addGrid(self, name: str, values) -> "ParamGridBuilder":
+        self._grid[name] = list(values)
+        return self
+
+    def baseOn(self, base: Dict[str, object]) -> "ParamGridBuilder":
+        for name, value in base.items():
+            self._grid[name] = [value]
+        return self
+
+    def build(self) -> List[Dict[str, object]]:
+        maps: List[Dict[str, object]] = [{}]
+        for name, values in self._grid.items():
+            maps = [{**m, name: v} for m in maps for v in values]
+        return maps
+
+
+def _input_frame(estimator, dataset):
+    """Resolve the feature column: the estimator's own inputCol, or — for a
+    Pipeline, which has no inputCol — the first stage that declares one."""
+    if estimator.has_param("inputCol"):
+        return as_vector_frame(dataset, estimator.getInputCol())
+    if hasattr(estimator, "getStages"):
+        for stage in estimator.getStages():
+            if hasattr(stage, "has_param") and stage.has_param("inputCol"):
+                return as_vector_frame(dataset, stage.getInputCol())
+    raise ValueError(
+        f"cannot locate an input column on {type(estimator).__name__}"
+    )
+
+
+def _fit_with(estimator, params: Dict[str, object], dataset):
+    """Fit a copy of ``estimator`` with ``params`` applied.
+
+    For a Pipeline, a plain param name is applied to EVERY stage declaring
+    it (error if none does); ``"<stage_index>.<param>"`` pins one stage —
+    the name-keyed stand-in for Spark's stage-bound Param objects.
+    """
+    if hasattr(estimator, "getStages"):
+        stages = [
+            s.copy() if hasattr(s, "copy") else s
+            for s in estimator.getStages()
+        ]
+        for name, value in params.items():
+            if "." in name:
+                idx, pname = name.split(".", 1)
+                stages[int(idx)].set(pname, value)
+                continue
+            hit = False
+            for s in stages:
+                if hasattr(s, "has_param") and s.has_param(name):
+                    s.set(name, value)
+                    hit = True
+            if not hit:
+                raise ValueError(
+                    f"param {name!r} matches no pipeline stage; use "
+                    f"'<stage_index>.{name}' to pin a stage"
+                )
+        return type(estimator)(stages=stages).fit(dataset)
+    est = estimator.copy(extra=params)
+    return est.fit(dataset)
+
+
+def _score(model, evaluator, frame):
+    return evaluator.evaluate(model.transform(frame))
+
+
+class _TuningParams(Params):
+    numFolds = Param(
+        "numFolds",
+        "number of cross-validation folds",
+        3,
+        validator=lambda v: isinstance(v, int) and v >= 2,
+    )
+    trainRatio = Param(
+        "trainRatio",
+        "train fraction for TrainValidationSplit",
+        0.75,
+        validator=lambda v: 0.0 < v < 1.0,
+    )
+    seed = Param(
+        "seed", "shuffle seed", 0, validator=lambda v: isinstance(v, int)
+    )
+
+
+class CrossValidator(_TuningParams):
+    """``CrossValidator(estimator=…, estimatorParamMaps=…, evaluator=…,
+    numFolds=3)`` — Spark's k-fold model selection."""
+
+    def __init__(
+        self,
+        estimator=None,
+        estimatorParamMaps: Optional[List[Dict[str, object]]] = None,
+        evaluator=None,
+        uid: Optional[str] = None,
+        **kwargs,
+    ):
+        super().__init__(uid=uid)
+        self.estimator = estimator
+        self.estimatorParamMaps = estimatorParamMaps or [{}]
+        self.evaluator = evaluator
+        for name, value in kwargs.items():
+            self.set(name, value)
+
+    def fit(self, dataset) -> "CrossValidatorModel":
+        if self.estimator is None or self.evaluator is None:
+            raise ValueError("estimator and evaluator must be set")
+        frame = _input_frame(self.estimator, dataset)
+        n = len(frame)
+        folds = self.getNumFolds()
+        if n < folds:
+            raise ValueError(f"{n} rows cannot make {folds} folds")
+        rng = np.random.default_rng(self.getSeed())
+        perm = rng.permutation(n)
+        bounds = np.linspace(0, n, folds + 1).astype(int)
+
+        avg_metrics = []
+        for params in self.estimatorParamMaps:
+            scores = []
+            for f in range(folds):
+                val_idx = perm[bounds[f] : bounds[f + 1]]
+                train_idx = np.concatenate(
+                    [perm[: bounds[f]], perm[bounds[f + 1] :]]
+                )
+                model = _fit_with(
+                    self.estimator, params, frame.select_rows(train_idx)
+                )
+                scores.append(
+                    _score(model, self.evaluator, frame.select_rows(val_idx))
+                )
+            avg_metrics.append(float(np.mean(scores)))
+
+        pick = np.argmax if self.evaluator.is_larger_better() else np.argmin
+        best_i = int(pick(avg_metrics))
+        best_model = _fit_with(
+            self.estimator, self.estimatorParamMaps[best_i], frame
+        )
+        out = CrossValidatorModel(
+            bestModel=best_model,
+            avgMetrics=avg_metrics,
+            bestIndex=best_i,
+        )
+        out.uid = self.uid
+        out.copy_values_from(self)
+        return out
+
+
+class CrossValidatorModel(_TuningParams):
+    def __init__(
+        self,
+        bestModel=None,
+        avgMetrics: Optional[List[float]] = None,
+        bestIndex: int = 0,
+        uid: Optional[str] = None,
+    ):
+        super().__init__(uid=uid)
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics or []
+        self.bestIndex = bestIndex
+
+    def _copy_internal_state(self, other: "CrossValidatorModel") -> None:
+        other.bestModel = self.bestModel
+        other.avgMetrics = self.avgMetrics
+        other.bestIndex = self.bestIndex
+
+    def transform(self, dataset):
+        if self.bestModel is None:
+            raise ValueError("no bestModel; fit first")
+        return self.bestModel.transform(dataset)
+
+
+class TrainValidationSplit(_TuningParams):
+    """Single random train/validation split (Spark's cheaper CV variant)."""
+
+    def __init__(
+        self,
+        estimator=None,
+        estimatorParamMaps: Optional[List[Dict[str, object]]] = None,
+        evaluator=None,
+        uid: Optional[str] = None,
+        **kwargs,
+    ):
+        super().__init__(uid=uid)
+        self.estimator = estimator
+        self.estimatorParamMaps = estimatorParamMaps or [{}]
+        self.evaluator = evaluator
+        for name, value in kwargs.items():
+            self.set(name, value)
+
+    def fit(self, dataset) -> "TrainValidationSplitModel":
+        if self.estimator is None or self.evaluator is None:
+            raise ValueError("estimator and evaluator must be set")
+        frame = _input_frame(self.estimator, dataset)
+        n = len(frame)
+        rng = np.random.default_rng(self.getSeed())
+        perm = rng.permutation(n)
+        n_train = int(round(n * self.getTrainRatio()))
+        if n_train < 1 or n_train >= n:
+            raise ValueError(
+                f"trainRatio {self.getTrainRatio()} leaves an empty split "
+                f"over {n} rows"
+            )
+        train = frame.select_rows(perm[:n_train])
+        val = frame.select_rows(perm[n_train:])
+
+        metrics = []
+        for params in self.estimatorParamMaps:
+            model = _fit_with(self.estimator, params, train)
+            metrics.append(float(_score(model, self.evaluator, val)))
+
+        pick = np.argmax if self.evaluator.is_larger_better() else np.argmin
+        best_i = int(pick(metrics))
+        best_model = _fit_with(
+            self.estimator, self.estimatorParamMaps[best_i], frame
+        )
+        out = TrainValidationSplitModel(
+            bestModel=best_model, validationMetrics=metrics, bestIndex=best_i
+        )
+        out.uid = self.uid
+        out.copy_values_from(self)
+        return out
+
+
+class TrainValidationSplitModel(_TuningParams):
+    def __init__(
+        self,
+        bestModel=None,
+        validationMetrics: Optional[List[float]] = None,
+        bestIndex: int = 0,
+        uid: Optional[str] = None,
+    ):
+        super().__init__(uid=uid)
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics or []
+        self.bestIndex = bestIndex
+
+    def _copy_internal_state(self, other: "TrainValidationSplitModel") -> None:
+        other.bestModel = self.bestModel
+        other.validationMetrics = self.validationMetrics
+        other.bestIndex = self.bestIndex
+
+    def transform(self, dataset):
+        if self.bestModel is None:
+            raise ValueError("no bestModel; fit first")
+        return self.bestModel.transform(dataset)

@@ -1,0 +1,168 @@
+"""Tuning + evaluation: CrossValidator / TrainValidationSplit select the
+right hyperparameters against sklearn-style oracles."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    BinaryClassificationEvaluator,
+    CrossValidator,
+    LinearRegression,
+    LogisticRegression,
+    ParamGridBuilder,
+    RegressionEvaluator,
+    TrainValidationSplit,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _reg_frame(rng, n=400, d=8, noise=0.1):
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = x @ w + noise * rng.normal(size=n)
+    return VectorFrame({"features": x, "label": y})
+
+
+def test_param_grid_builder_cartesian():
+    grid = (
+        ParamGridBuilder()
+        .addGrid("regParam", [0.0, 0.1, 1.0])
+        .addGrid("fitIntercept", [True, False])
+        .baseOn({"maxIter": 7})
+        .build()
+    )
+    assert len(grid) == 6
+    assert all(m["maxIter"] == 7 for m in grid)
+    assert {(m["regParam"], m["fitIntercept"]) for m in grid} == {
+        (r, f) for r in (0.0, 0.1, 1.0) for f in (True, False)
+    }
+
+
+def test_regression_evaluator_metrics(rng):
+    y = rng.normal(size=100)
+    pred = y + 0.5
+    frame = VectorFrame({"label": y, "prediction": pred})
+    ev = RegressionEvaluator()
+    assert ev.evaluate(frame) == pytest.approx(0.5)  # rmse
+    assert ev.copy(extra={"metricName": "mse"}).evaluate(frame) == pytest.approx(0.25)
+    assert ev.copy(extra={"metricName": "mae"}).evaluate(frame) == pytest.approx(0.5)
+    r2 = ev.copy(extra={"metricName": "r2"}).evaluate(frame)
+    assert r2 == pytest.approx(1.0 - 25.0 / float(((y - y.mean()) ** 2).mean() * 100))
+    assert not ev.is_larger_better()
+    assert ev.copy(extra={"metricName": "r2"}).is_larger_better()
+
+
+def test_auc_matches_rank_oracle(rng):
+    y = (rng.uniform(size=300) > 0.5).astype(float)
+    score = np.where(y > 0, rng.normal(1.0, 1.0, 300), rng.normal(0.0, 1.0, 300))
+    frame = VectorFrame({"label": y, "probability": score})
+    ev = BinaryClassificationEvaluator()
+    got = ev.evaluate(frame)
+    # independent O(n²) pair-counting oracle with tie credit
+    pos, neg = score[y > 0], score[y <= 0]
+    wins = (pos[:, None] > neg[None, :]).sum() + 0.5 * (
+        pos[:, None] == neg[None, :]
+    ).sum()
+    assert got == pytest.approx(wins / (len(pos) * len(neg)))
+    # PR-AUC is a sane probability and larger-better
+    pr = ev.copy(extra={"metricName": "areaUnderPR"}).evaluate(frame)
+    assert 0.5 < pr <= 1.0
+
+
+def test_cross_validator_picks_low_regularization(rng):
+    """On clean near-linear data, tiny ridge must beat huge ridge."""
+    frame = _reg_frame(rng)
+    cv = CrossValidator(
+        estimator=LinearRegression(),
+        estimatorParamMaps=ParamGridBuilder()
+        .addGrid("regParam", [1e-6, 1e4])
+        .build(),
+        evaluator=RegressionEvaluator(),
+        numFolds=3,
+    )
+    model = cv.fit(frame)
+    assert model.bestIndex == 0
+    assert model.avgMetrics[0] < model.avgMetrics[1]
+    # bestModel is refit on the full data and transform round-trips
+    out = model.transform(frame)
+    resid = np.asarray(out.column("prediction")) - np.asarray(
+        frame.column("label")
+    )
+    assert float(np.sqrt((resid**2).mean())) < 0.2
+
+
+def test_train_validation_split_logreg_auc(rng):
+    n, d = 600, 6
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d) * 2.0
+    p = 1.0 / (1.0 + np.exp(-(x @ w)))
+    y = (rng.uniform(size=n) < p).astype(float)
+    frame = VectorFrame({"features": x, "label": y})
+    tvs = TrainValidationSplit(
+        estimator=LogisticRegression().setMaxIter(25),
+        estimatorParamMaps=ParamGridBuilder()
+        .addGrid("regParam", [1e-4, 1e3])
+        .build(),
+        evaluator=BinaryClassificationEvaluator(),
+        trainRatio=0.7,
+    )
+    model = tvs.fit(frame)
+    assert model.bestIndex == 0  # crushing regularization loses on AUC
+    assert model.validationMetrics[0] > model.validationMetrics[1]
+    assert model.validationMetrics[0] > 0.8
+
+
+def test_cv_validation_errors(rng):
+    frame = _reg_frame(rng, n=4)
+    cv = CrossValidator(
+        estimator=LinearRegression(),
+        evaluator=RegressionEvaluator(),
+        numFolds=5,
+    )
+    with pytest.raises(ValueError, match="folds"):
+        cv.fit(frame)
+    with pytest.raises(ValueError, match="estimator and evaluator"):
+        CrossValidator().fit(frame)
+
+
+def test_cross_validator_over_pipeline(rng):
+    """Tuning over a Pipeline (the canonical Spark usage): plain names hit
+    every declaring stage; '<idx>.<param>' pins one stage."""
+    from spark_rapids_ml_tpu import Pipeline, StandardScaler
+
+    frame = _reg_frame(rng)
+    cv = CrossValidator(
+        estimator=Pipeline(
+            stages=[
+                StandardScaler().setOutputCol("scaled"),
+                LinearRegression().setInputCol("scaled"),
+            ]
+        ),
+        estimatorParamMaps=ParamGridBuilder()
+        .addGrid("1.regParam", [1e-6, 1e4])
+        .build(),
+        evaluator=RegressionEvaluator(),
+        numFolds=3,
+    )
+    model = cv.fit(frame)
+    assert model.bestIndex == 0
+    out = model.transform(frame)
+    assert "prediction" in out.columns
+    # unknown plain name errors with the pinning hint
+    bad = CrossValidator(
+        estimator=Pipeline(stages=[LinearRegression()]),
+        estimatorParamMaps=[{"nosuchparam": 1}],
+        evaluator=RegressionEvaluator(),
+        numFolds=2,
+    )
+    with pytest.raises(ValueError, match="stage"):
+        bad.fit(frame)
+
+
+def test_pr_auc_tie_collapse_is_order_independent():
+    """Tied scores are ONE operating point: both row orders must give the
+    tie-collapsed value (0.5 for one pos + one neg at the same score)."""
+    ev = BinaryClassificationEvaluator().set("metricName", "areaUnderPR")
+    a = ev.evaluate(VectorFrame({"label": [1.0, 0.0], "probability": [0.5, 0.5]}))
+    b = ev.evaluate(VectorFrame({"label": [0.0, 1.0], "probability": [0.5, 0.5]}))
+    assert a == b == pytest.approx(0.5)
