@@ -39,6 +39,8 @@ from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
+from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel  # noqa: F401
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.models.evaluation import (  # noqa: F401
     BinaryClassificationEvaluator,
@@ -60,12 +62,16 @@ __all__ = [
     "PCAModel",
     "KMeans",
     "KMeansModel",
+    "DBSCAN",
+    "DBSCANModel",
     "NearestNeighbors",
     "NearestNeighborsModel",
     "LinearRegression",
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "OneVsRest",
+    "OneVsRestModel",
     "Pipeline",
     "PipelineModel",
     "RegressionEvaluator",

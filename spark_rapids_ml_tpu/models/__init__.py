@@ -12,6 +12,8 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
     NearestNeighbors,
     NearestNeighborsModel,
 )
+from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
+from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
 from spark_rapids_ml_tpu.models.evaluation import (
     BinaryClassificationEvaluator,
@@ -34,8 +36,12 @@ __all__ = [
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "DBSCAN",
+    "DBSCANModel",
     "NearestNeighbors",
     "NearestNeighborsModel",
+    "OneVsRest",
+    "OneVsRestModel",
     "Pipeline",
     "PipelineModel",
     "RegressionEvaluator",

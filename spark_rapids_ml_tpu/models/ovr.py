@@ -1,0 +1,112 @@
+"""OneVsRest — multiclass reduction over any binary classifier.
+
+Parity with ``org.apache.spark.ml.classification.OneVsRest``: K binary
+sub-models (class k vs rest), prediction by argmax of the sub-models'
+scores. Spark fits the K sub-models as independent jobs; here they are
+independent device fits in sequence (each already saturates the chip —
+see the parallelism note in ``models/tuning.py``).
+
+Works with any estimator exposing the binary-classifier surface this
+framework uses (``fit(frame)`` reading labelCol, model ``predict_proba``
+or a probability output column) — LogisticRegression in practice.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import HasInputCol, Param
+
+
+class OneVsRestParams(HasInputCol):
+    labelCol = Param("labelCol", "label column name", "label")
+    predictionCol = Param(
+        "predictionCol", "predicted class-index output column", "prediction"
+    )
+    rawPredictionCol = Param(
+        "rawPredictionCol",
+        "per-class score vector output column",
+        "rawPrediction",
+    )
+
+
+class OneVsRest(OneVsRestParams):
+    """``OneVsRest(classifier=LogisticRegression()).fit(df)``."""
+
+    def __init__(self, classifier=None, uid: Optional[str] = None, **kwargs):
+        super().__init__(uid=uid)
+        self.classifier = classifier
+        for name, value in kwargs.items():
+            self.set(name, value)
+
+    def fit(self, dataset) -> "OneVsRestModel":
+        if self.classifier is None:
+            raise ValueError("classifier must be set")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
+        classes = np.unique(y)
+        if classes.size < 2:
+            raise ValueError("OneVsRest needs at least two classes")
+        if not np.allclose(classes, np.round(classes)):
+            raise ValueError("labels must be integer class indices")
+        models: List = []
+        for cls in classes:
+            sub = self.classifier.copy()
+            if sub.has_param("inputCol"):
+                sub.set("inputCol", self.getInputCol())
+            binary = frame.with_column(
+                sub.getLabelCol(), (y == cls).astype(np.float64)
+            )
+            models.append(sub.fit(binary))
+        out = OneVsRestModel(
+            models=models, classes=classes.astype(np.int64)
+        )
+        out.uid = self.uid
+        out.copy_values_from(self)
+        return out
+
+
+class OneVsRestModel(OneVsRestParams):
+    def __init__(
+        self,
+        models: Optional[List] = None,
+        classes: Optional[np.ndarray] = None,
+        uid: Optional[str] = None,
+    ):
+        super().__init__(uid=uid)
+        self.models = models or []
+        self.classes = classes
+
+    def _copy_internal_state(self, other: "OneVsRestModel") -> None:
+        other.models = list(self.models)
+        other.classes = self.classes
+
+    def _scores(self, frame) -> np.ndarray:
+        cols = []
+        for m in self.models:
+            if hasattr(m, "predict_proba"):
+                cols.append(np.asarray(m.predict_proba(frame), dtype=np.float64))
+            else:
+                out = m.transform(frame)
+                cols.append(
+                    np.asarray(
+                        out.column(m.getProbabilityCol()), dtype=np.float64
+                    )
+                )
+        return np.stack(cols, axis=1)  # (n, K)
+
+    def transform(self, dataset) -> VectorFrame:
+        if not self.models:
+            raise ValueError("no sub-models; fit first")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        scores = self._scores(frame)
+        pred = self.classes[np.argmax(scores, axis=1)]
+        out = frame.with_column(
+            self.getRawPredictionCol(), scores.tolist()
+        )
+        return out.with_column(
+            self.getPredictionCol(), pred.astype(np.int64).tolist()
+        )

@@ -1,0 +1,92 @@
+"""DBSCAN: device min-label propagation vs host BFS vs sklearn.
+
+Core-point cluster structure is deterministic in DBSCAN; border
+assignment is queue-order-dependent in classic implementations, so the
+sklearn comparison checks core points + noise exactly and border points
+only for membership-in-some-adjacent-cluster.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import DBSCAN
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _blobs(rng, centers=((0, 0), (10, 10), (20, 0)), per=60, noise=8):
+    pts = [
+        rng.normal(loc=c, scale=0.5, size=(per, 2)) for c in centers
+    ]
+    pts.append(rng.uniform(-5, 25, size=(noise, 2)) + 100.0)  # far noise
+    x = np.concatenate(pts)
+    perm = rng.permutation(len(x))
+    return x[perm]
+
+
+def test_dbscan_finds_blobs_and_noise(rng):
+    x = _blobs(rng)
+    model = DBSCAN().setEps(1.5).setMinPts(5).fit(x)
+    labels = model.labels_
+    assert model.n_clusters_ == 3
+    # the far-away uniform points are mostly noise
+    assert (labels == -1).sum() >= 4
+    # clusters are pure: points within 0.5-scale blobs share a label
+    from spark_rapids_ml_tpu.models.dbscan import _host_dbscan
+
+    host_labels, host_core = _host_dbscan(x, 1.5, 5)
+    from spark_rapids_ml_tpu.models.dbscan import _relabel_consecutive
+
+    np.testing.assert_array_equal(labels, _relabel_consecutive(host_labels))
+    np.testing.assert_array_equal(model.core_mask_, host_core)
+
+
+def test_dbscan_device_matches_host_path(rng):
+    x = _blobs(rng, centers=((0, 0), (6, 6)), per=40, noise=5)
+    m_dev = DBSCAN().setEps(1.2).setMinPts(4).fit(x)
+    m_host = DBSCAN().setEps(1.2).setMinPts(4).setUseXlaDot(False).fit(x)
+    np.testing.assert_array_equal(m_dev.labels_, m_host.labels_)
+    np.testing.assert_array_equal(m_dev.core_mask_, m_host.core_mask_)
+
+
+def test_dbscan_matches_sklearn_structure(rng):
+    from sklearn.cluster import DBSCAN as SkDBSCAN
+
+    x = _blobs(rng)
+    ours = DBSCAN().setEps(1.5).setMinPts(5).fit(x)
+    sk = SkDBSCAN(eps=1.5, min_samples=5).fit(x)
+    core_sk = np.zeros(len(x), dtype=bool)
+    core_sk[sk.core_sample_indices_] = True
+    np.testing.assert_array_equal(ours.core_mask_, core_sk)
+    # exact same partition of CORE points (compare label co-occurrence)
+    ours_core = ours.labels_[core_sk]
+    sk_core = sk.labels_[core_sk]
+    for a in np.unique(ours_core):
+        sk_ids = np.unique(sk_core[ours_core == a])
+        assert len(sk_ids) == 1  # our cluster maps into exactly one sklearn cluster
+    for b in np.unique(sk_core):
+        our_ids = np.unique(ours_core[sk_core == b])
+        assert len(our_ids) == 1
+    # noise agrees exactly on non-border points; border points must sit in
+    # SOME cluster adjacent to them in both
+    assert ((ours.labels_ == -1) == (sk.labels_ == -1)).mean() > 0.95
+
+
+def test_dbscan_transform_and_validation(rng):
+    x = _blobs(rng, per=30, noise=3)
+    model = DBSCAN().setEps(1.5).setMinPts(5).fit(x)
+    out = model.transform(VectorFrame({"features": x}))
+    got = np.asarray(out.column("prediction"))
+    np.testing.assert_array_equal(got, model.labels_)
+    with pytest.raises(ValueError, match="fitted"):
+        model.transform(VectorFrame({"features": x[:5]}))
+
+
+def test_dbscan_all_noise_and_single_cluster(rng):
+    # far-apart singletons: all noise at tiny eps
+    x = np.arange(10, dtype=np.float64)[:, None] * 100.0
+    m = DBSCAN().setEps(0.1).setMinPts(2).fit(x)
+    assert m.n_clusters_ == 0 and (m.labels_ == -1).all()
+    # one dense clump: single cluster, no noise
+    y = rng.normal(size=(50, 3)) * 0.01
+    m2 = DBSCAN().setEps(1.0).setMinPts(3).fit(y)
+    assert m2.n_clusters_ == 1 and (m2.labels_ == 0).all()

@@ -1,0 +1,51 @@
+"""OneVsRest multiclass reduction vs sklearn's OvR logistic regression."""
+
+import numpy as np
+
+from spark_rapids_ml_tpu import LogisticRegression, OneVsRest
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _three_class(rng, n_per=150, d=5):
+    centers = np.array(
+        [[3.0, 0, 0, 0, 0], [0, 3.0, 0, 0, 0], [0, 0, 3.0, 0, 0]]
+    )
+    xs, ys = [], []
+    for k, c in enumerate(centers):
+        xs.append(rng.normal(size=(n_per, d)) + c)
+        ys.append(np.full(n_per, k, dtype=np.float64))
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    perm = rng.permutation(len(x))
+    return x[perm], y[perm]
+
+
+def test_ovr_accuracy_and_shapes(rng):
+    x, y = _three_class(rng)
+    frame = VectorFrame({"features": x, "label": y})
+    model = OneVsRest(
+        classifier=LogisticRegression().setMaxIter(30).setRegParam(1e-3)
+    ).fit(frame)
+    out = model.transform(frame)
+    pred = np.asarray(out.column("prediction"))
+    scores = np.asarray(out.column("rawPrediction"))
+    assert scores.shape == (len(x), 3)
+    assert (pred == y).mean() > 0.95
+    # matches sklearn's one-vs-rest construction closely
+    from sklearn.linear_model import LogisticRegression as SkLR
+    from sklearn.multiclass import OneVsRestClassifier
+
+    sk = OneVsRestClassifier(SkLR(C=1e3, max_iter=200)).fit(x, y)
+    agree = (pred == sk.predict(x)).mean()
+    assert agree > 0.97
+
+
+def test_ovr_validation(rng):
+    import pytest
+
+    x, y = _three_class(rng, n_per=20)
+    frame = VectorFrame({"features": x, "label": np.zeros(len(x))})
+    with pytest.raises(ValueError, match="two classes"):
+        OneVsRest(classifier=LogisticRegression()).fit(frame)
+    with pytest.raises(ValueError, match="classifier"):
+        OneVsRest().fit(VectorFrame({"features": x, "label": y}))
