@@ -41,6 +41,7 @@ from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
 )
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel  # noqa: F401
+from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel  # noqa: F401
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.models.evaluation import (  # noqa: F401
     BinaryClassificationEvaluator,
@@ -71,6 +72,8 @@ __all__ = [
     "LogisticRegression",
     "LogisticRegressionModel",
     "OneVsRest",
+    "UMAP",
+    "UMAPModel",
     "OneVsRestModel",
     "Pipeline",
     "PipelineModel",

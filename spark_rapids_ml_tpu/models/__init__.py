@@ -14,6 +14,7 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
 )
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel
+from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
 from spark_rapids_ml_tpu.models.evaluation import (
     BinaryClassificationEvaluator,
@@ -41,6 +42,8 @@ __all__ = [
     "NearestNeighbors",
     "NearestNeighborsModel",
     "OneVsRest",
+    "UMAP",
+    "UMAPModel",
     "OneVsRestModel",
     "Pipeline",
     "PipelineModel",

@@ -42,6 +42,31 @@ class OneVsRest(OneVsRestParams):
         for name, value in kwargs.items():
             self.set(name, value)
 
+    def _copy_internal_state(self, other: "OneVsRest") -> None:
+        # without this, Params.copy() (used by CrossValidator/_fit_with and
+        # Pipeline stage copies) would reconstruct with classifier=None
+        other.classifier = (
+            self.classifier.copy()
+            if hasattr(self.classifier, "copy")
+            else self.classifier
+        )
+
+    def copy(self, extra=None) -> "OneVsRest":
+        """``extra`` params not declared by OneVsRest itself route to the
+        sub-classifier — the name-keyed analogue of tuning Spark's OvR
+        with classifier-bound Params (e.g. a regParam grid)."""
+        extra = dict(extra or {})
+        own = {k: v for k, v in extra.items() if self.has_param(k)}
+        sub = {k: v for k, v in extra.items() if not self.has_param(k)}
+        out = super().copy(extra=own)
+        if sub:
+            if out.classifier is None:
+                raise ValueError(
+                    f"params {sorted(sub)} need a classifier to apply to"
+                )
+            out.classifier = out.classifier.copy(extra=sub)
+        return out
+
     def fit(self, dataset) -> "OneVsRestModel":
         if self.classifier is None:
             raise ValueError("classifier must be set")

@@ -1,0 +1,207 @@
+"""UMAP Estimator / Model (nonlinear dimensionality reduction).
+
+API mirrors the reference project's current-generation UMAP (cuML-backed
+there): ``UMAP().setNNeighbors(15).setNComponents(2).fit(df)`` learns an
+embedding of the fitted data; ``model.embedding_`` exposes it,
+``model.transform(new_df)`` places NEW rows by membership-weighted
+averaging over their nearest fitted points' coordinates (the standard
+out-of-sample rule) followed by no further optimization.
+
+The construction is ``ops/umap_kernel.py`` — exact-kNN fuzzy graph,
+spectral init, dense-force optimization — everything jit-compiled, dense
+n×n regime (n ≲ 30k). Embeddings match UMAP's objective/structure, not
+umap-learn's per-coordinate output (different optimizer schedule); tests
+check trustworthiness and cluster separation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class UMAPParams(HasInputCol, HasDeviceId):
+    nNeighbors = Param(
+        "nNeighbors",
+        "kNN graph width (local vs global structure trade-off)",
+        15,
+        validator=lambda v: isinstance(v, int) and v >= 2,
+    )
+    nComponents = Param(
+        "nComponents",
+        "embedding dimension",
+        2,
+        validator=lambda v: isinstance(v, int) and v >= 1,
+    )
+    minDist = Param(
+        "minDist",
+        "minimum embedding distance between close points",
+        0.1,
+        validator=lambda v: 0.0 <= float(v) < 3.0,
+    )
+    nEpochs = Param(
+        "nEpochs",
+        "dense-force optimization epochs",
+        200,
+        validator=lambda v: isinstance(v, int) and v >= 1,
+    )
+    learningRate = Param(
+        "learningRate", "initial step size", 1.0,
+        validator=lambda v: float(v) > 0,
+    )
+    repulsionStrength = Param(
+        "repulsionStrength",
+        "gamma weighting of the repulsive force",
+        1.0,
+        validator=lambda v: float(v) >= 0,
+    )
+    outputCol = Param("outputCol", "embedding output column", "embedding")
+    dtype = Param(
+        "dtype", "device compute dtype", "auto",
+        validator=lambda v: v in ("auto", "float32", "float64"),
+    )
+
+
+class UMAP(UMAPParams):
+    """``UMAP().setNNeighbors(15).fit(df)`` → UMAPModel."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "UMAP":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(UMAP, path)
+
+    def fit(self, dataset) -> "UMAPModel":
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.knn_kernel import knn_kernel
+        from spark_rapids_ml_tpu.ops.umap_kernel import (
+            fit_ab,
+            fuzzy_graph,
+            optimize_embedding,
+            spectral_init,
+        )
+
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+        n = x.shape[0]
+        k = self.getNNeighbors()
+        if n <= k:
+            raise ValueError(
+                f"nNeighbors = {k} must be below the row count {n}"
+            )
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        a, b = fit_ab(float(self.getMinDist()))
+
+        x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+        with timer.phase("knn"), TraceRange("umap knn", TraceColor.GREEN):
+            # k+1 then drop self (column 0: distance 0 to itself)
+            dists, idx = knn_kernel(x_dev, x_dev, k + 1)
+            dists, idx = dists[:, 1:], idx[:, 1:]
+        with timer.phase("graph"), TraceRange("umap graph", TraceColor.RED):
+            p = fuzzy_graph(dists, idx, n)
+        with timer.phase("init"):
+            emb0 = spectral_init(p, self.getNComponents())
+        # dense all-pairs repulsion stands in for UMAP's per-edge negative
+        # sampling (n_neg=5): scale gamma so total repulsive mass matches
+        # the sampled variant's ~(edges·n_neg) instead of n²
+        gamma = float(self.getRepulsionStrength()) * (5.0 * 2.0 * k / n)
+        with timer.phase("optimize"), TraceRange("umap opt", TraceColor.BLUE):
+            emb = optimize_embedding(
+                p,
+                emb0,
+                jnp.asarray(a, dtype=dtype),
+                jnp.asarray(b, dtype=dtype),
+                jnp.asarray(float(self.getLearningRate()), dtype=dtype),
+                jnp.asarray(gamma, dtype=dtype),
+                self.getNEpochs(),
+            )
+            emb = np.asarray(jax.block_until_ready(emb), dtype=np.float64)
+        if not np.isfinite(emb).all():
+            raise FloatingPointError("UMAP optimization diverged")
+        model = UMAPModel(
+            embedding=emb,
+            train_items=np.asarray(x, dtype=np.float64),
+            ab=(a, b),
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+
+class UMAPModel(UMAPParams):
+    def __init__(
+        self,
+        embedding: Optional[np.ndarray] = None,
+        train_items: Optional[np.ndarray] = None,
+        ab=None,
+    ):
+        super().__init__()
+        self.embedding_ = embedding
+        self.train_items_ = train_items
+        self.ab_ = ab
+
+    def _copy_internal_state(self, other: "UMAPModel") -> None:
+        other.embedding_ = self.embedding_
+        other.train_items_ = self.train_items_
+        other.ab_ = self.ab_
+
+    def transform(self, dataset) -> VectorFrame:
+        """Out-of-sample placement: each new row lands at the
+        membership-weighted average of its nNeighbors nearest FITTED
+        points' embedding coordinates. A fitted row queried back lands
+        NEAR (not exactly at) its own embedding: itself gets the largest
+        membership weight, but its neighbors' weights also contribute —
+        the standard smoothing of this out-of-sample rule."""
+        if self.embedding_ is None:
+            raise ValueError("model has no embedding; fit first")
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.knn_kernel import knn_kernel
+        from spark_rapids_ml_tpu.ops.umap_kernel import (
+            smooth_knn_calibration,
+        )
+
+        frame = as_vector_frame(dataset, self.getInputCol())
+        q = frame.vectors_as_matrix(self.getInputCol())
+        if q.shape[1] != self.train_items_.shape[1]:
+            raise ValueError(
+                f"query dim {q.shape[1]} != fitted dim "
+                f"{self.train_items_.shape[1]}"
+            )
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        k = min(self.getNNeighbors(), self.train_items_.shape[0])
+        items = jax.device_put(
+            jnp.asarray(self.train_items_, dtype=dtype), device
+        )
+        q_dev = jax.device_put(jnp.asarray(q, dtype=dtype), device)
+        dists, idx = knn_kernel(q_dev, items, k)
+        rho, sigma = smooth_knn_calibration(dists)
+        w = jnp.exp(
+            -jnp.maximum(dists - rho[:, None], 0.0) / sigma[:, None]
+        )
+        w = w / jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-12)
+        emb_dev = jnp.asarray(self.embedding_, dtype=dtype)
+        placed = jnp.einsum("qk,qkd->qd", w, emb_dev[idx])
+        return frame.with_column(
+            self.getOutputCol(), np.asarray(placed, dtype=np.float64).tolist()
+        )

@@ -49,3 +49,36 @@ def test_ovr_validation(rng):
         OneVsRest(classifier=LogisticRegression()).fit(frame)
     with pytest.raises(ValueError, match="classifier"):
         OneVsRest().fit(VectorFrame({"features": x, "label": y}))
+
+
+def test_ovr_copy_keeps_classifier_and_works_in_cv(rng):
+    """Params.copy() must carry the classifier (CrossValidator copies the
+    estimator per param map — a dropped classifier breaks tuning)."""
+    from spark_rapids_ml_tpu import (
+        CrossValidator,
+        ParamGridBuilder,
+        RegressionEvaluator,
+    )
+
+    base = OneVsRest(classifier=LogisticRegression())
+    assert base.copy().classifier is not None
+    x, y = _three_class(rng, n_per=40)
+    frame = VectorFrame({"features": x, "label": y})
+
+    class _Accuracy(RegressionEvaluator):
+        def is_larger_better(self):
+            return True
+
+        def evaluate(self, dataset):
+            pred = np.asarray(dataset.column("prediction"), dtype=np.float64)
+            lab = np.asarray(dataset.column("label"), dtype=np.float64)
+            return float((pred == lab).mean())
+
+    cv = CrossValidator(
+        estimator=OneVsRest(classifier=LogisticRegression().setMaxIter(20)),
+        estimatorParamMaps=ParamGridBuilder().addGrid("regParam", [1e-3]).build(),
+        evaluator=_Accuracy(),
+        numFolds=2,
+    )
+    model = cv.fit(frame)
+    assert model.avgMetrics[0] > 0.9

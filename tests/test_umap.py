@@ -1,0 +1,80 @@
+"""UMAP: structure-preservation tests (trustworthiness + separation).
+
+Coordinates are not comparable to umap-learn (different optimizer);
+what must hold is the STRUCTURE: high-dimensional neighbors stay
+neighbors in the embedding, and well-separated clusters stay separated.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import UMAP
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _trustworthiness(x, emb, k=10):
+    """Standard trustworthiness T(k) in [0,1] via full rank matrices."""
+    n = len(x)
+    dx = np.linalg.norm(x[:, None] - x[None, :], axis=2)
+    de = np.linalg.norm(emb[:, None] - emb[None, :], axis=2)
+    np.fill_diagonal(dx, np.inf)
+    np.fill_diagonal(de, np.inf)
+    rank_x = np.argsort(np.argsort(dx, axis=1), axis=1)  # 0 = nearest
+    knn_e = np.argsort(de, axis=1)[:, :k]
+    penalty = 0.0
+    for i in range(n):
+        r = rank_x[i, knn_e[i]]
+        penalty += np.maximum(r - k + 1, 0).sum()
+    return 1.0 - 2.0 / (n * k * (2 * n - 3 * k - 1)) * penalty
+
+
+def _blobs(rng, centers, per=60, scale=0.3):
+    pts = [rng.normal(loc=c, scale=scale, size=(per, len(c))) for c in centers]
+    x = np.concatenate(pts)
+    y = np.repeat(np.arange(len(centers)), per)
+    perm = rng.permutation(len(x))
+    return x[perm], y[perm]
+
+
+def test_umap_preserves_cluster_structure(rng):
+    centers = [np.r_[np.eye(8)[i] * 8] for i in range(3)]
+    x, y = _blobs(rng, centers)
+    model = UMAP().setNNeighbors(10).setNEpochs(150).fit(x)
+    emb = model.embedding_
+    assert emb.shape == (len(x), 2)
+    assert np.isfinite(emb).all()
+    # separation: centroid gaps dominate within-cluster spread
+    cents = np.stack([emb[y == c].mean(0) for c in range(3)])
+    spread = max(emb[y == c].std() for c in range(3))
+    gaps = [
+        np.linalg.norm(cents[i] - cents[j])
+        for i in range(3)
+        for j in range(i + 1, 3)
+    ]
+    assert min(gaps) > 2.0 * spread
+    # neighbors preserved far above chance
+    t = _trustworthiness(x, emb, k=10)
+    assert t > 0.85, t
+
+
+def test_umap_transform_places_new_points_near_their_cluster(rng):
+    centers = [(0.0,) * 6, (8.0,) * 6]
+    x, y = _blobs(rng, centers, per=50)
+    model = UMAP().setNNeighbors(8).setNEpochs(100).fit(x)
+    emb = model.embedding_
+    q = np.stack([np.full(6, 0.1), np.full(6, 7.9)])
+    out = model.transform(VectorFrame({"features": q}))
+    placed = np.asarray(out.column("embedding"))
+    c0 = emb[y == 0].mean(0)
+    c1 = emb[y == 1].mean(0)
+    assert np.linalg.norm(placed[0] - c0) < np.linalg.norm(placed[0] - c1)
+    assert np.linalg.norm(placed[1] - c1) < np.linalg.norm(placed[1] - c0)
+
+
+def test_umap_validation(rng):
+    x = rng.normal(size=(10, 4))
+    with pytest.raises(ValueError, match="nNeighbors"):
+        UMAP().setNNeighbors(15).fit(x)
+    model = UMAP().setNNeighbors(5).setNEpochs(20).fit(x)
+    with pytest.raises(ValueError, match="dim"):
+        model.transform(VectorFrame({"features": np.zeros((2, 7))}))
