@@ -42,6 +42,12 @@ from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel  # noqa: F401
 from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel  # noqa: F401
+from spark_rapids_ml_tpu.models.random_forest import (  # noqa: F401
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.models.evaluation import (  # noqa: F401
     BinaryClassificationEvaluator,
@@ -72,6 +78,10 @@ __all__ = [
     "LogisticRegression",
     "LogisticRegressionModel",
     "OneVsRest",
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
     "UMAP",
     "UMAPModel",
     "OneVsRestModel",

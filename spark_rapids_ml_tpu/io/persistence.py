@@ -601,3 +601,90 @@ def load_knn_model(path: str):
     )
     model.uid = meta["uid"]
     return _restore_params(model, meta)
+
+
+def save_forest_model(model, path: str, overwrite: bool = False) -> None:
+    """RandomForest models: the ensemble's (feature, threshold, leafValue)
+    arrays plus bin edges — all as DenseMatrix wire structs (int arrays
+    stored as exact small-valued doubles, cast back on load). A 3-D
+    classification leaf tensor flattens to (trees, leaves*classes) with
+    ``numClasses``/``classes`` alongside."""
+    if model.ensemble_ is None:
+        raise ValueError("cannot save an unfitted RandomForest model")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    leaf = np.asarray(model.ensemble_.leaf_value, dtype=np.float64)
+    if leaf.ndim == 3:
+        n_classes = leaf.shape[2]
+        leaf2d = leaf.reshape(leaf.shape[0], -1)
+        classes = np.asarray(model.classes_, dtype=np.float64)
+    else:
+        n_classes = 0
+        leaf2d = leaf
+        classes = np.zeros((0,), dtype=np.float64)
+    row = {
+        "feature": _dense_matrix_struct(
+            np.asarray(model.ensemble_.feature, dtype=np.float64)
+        ),
+        "threshold": _dense_matrix_struct(
+            np.asarray(model.ensemble_.threshold, dtype=np.float64)
+        ),
+        "leafValue": _dense_matrix_struct(leaf2d),
+        "edges": _dense_matrix_struct(
+            np.asarray(model.edges_, dtype=np.float64)
+        ),
+        "classes": _dense_vector_struct(classes),
+        "numClasses": int(n_classes),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("feature", _matrix_arrow_type()),
+                ("threshold", _matrix_arrow_type()),
+                ("leafValue", _matrix_arrow_type()),
+                ("edges", _matrix_arrow_type()),
+                ("classes", _vector_arrow_type()),
+                ("numClasses", pa.int64()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("feature", "matrix"), ("threshold", "matrix"),
+        ("leafValue", "matrix"), ("edges", "matrix"),
+        ("classes", "vector"), ("numClasses", "long"),
+    ])
+
+
+def load_forest_model(path: str):
+    import importlib
+
+    from spark_rapids_ml_tpu.ops.forest_kernel import TreeEnsemble
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    dotted = meta.get("pythonClass") or meta["class"]
+    module_name, cls_name = dotted.rsplit(".", 1)
+    model_cls = getattr(importlib.import_module(module_name), cls_name)
+    feature = _dense_matrix_from_struct(row["feature"]).astype(np.int32)
+    threshold = _dense_matrix_from_struct(row["threshold"]).astype(np.int32)
+    leaf2d = _dense_matrix_from_struct(row["leafValue"])
+    n_classes = int(row["numClasses"])
+    classes = _dense_vector_from_struct(row["classes"])
+    if n_classes:
+        leaf = leaf2d.reshape(leaf2d.shape[0], -1, n_classes)
+    else:
+        leaf = leaf2d
+        classes = None
+    model = model_cls(
+        ensemble=TreeEnsemble(
+            feature=feature, threshold=threshold, leaf_value=leaf
+        ),
+        edges=_dense_matrix_from_struct(row["edges"]),
+        classes=classes,
+    )
+    model.uid = meta["uid"]
+    return _restore_params(model, meta)

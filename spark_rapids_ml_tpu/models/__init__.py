@@ -15,6 +15,12 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel
 from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
+from spark_rapids_ml_tpu.models.random_forest import (
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
 from spark_rapids_ml_tpu.models.evaluation import (
     BinaryClassificationEvaluator,
@@ -42,6 +48,10 @@ __all__ = [
     "NearestNeighbors",
     "NearestNeighborsModel",
     "OneVsRest",
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
     "UMAP",
     "UMAPModel",
     "OneVsRestModel",

@@ -1,0 +1,297 @@
+"""RandomForest Regressor / Classifier with the Spark ML param surface.
+
+Param names follow ``org.apache.spark.ml.{regression,classification}``
+(numTrees, maxDepth, maxBins, minInstancesPerNode, featureSubsetStrategy,
+subsamplingRate via Poisson weights, seed). The builder is
+``ops/forest_kernel.py`` — level-synchronous histogram trees whose split
+search is a dense MXU contraction — so a fit is numTrees × maxDepth
+compiled level steps with NO per-node host control flow.
+
+Determinism: given a seed, bootstrap weights and feature subsets are
+fixed, and every reduction is a deterministic dense op — unlike
+thread-racy CPU forest builders.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class RandomForestParams(HasInputCol, HasDeviceId):
+    labelCol = Param("labelCol", "label column name", "label")
+    predictionCol = Param(
+        "predictionCol", "prediction output column", "prediction"
+    )
+    numTrees = Param(
+        "numTrees", "ensemble size", 20,
+        validator=lambda v: isinstance(v, int) and v >= 1,
+    )
+    maxDepth = Param(
+        "maxDepth", "tree depth (complete binary trees)", 5,
+        validator=lambda v: isinstance(v, int) and 1 <= v <= 12,
+    )
+    maxBins = Param(
+        "maxBins", "feature quantile bins", 32,
+        validator=lambda v: isinstance(v, int) and 2 <= v <= 256,
+    )
+    minInstancesPerNode = Param(
+        "minInstancesPerNode", "minimum samples per child", 1,
+        validator=lambda v: isinstance(v, int) and v >= 1,
+    )
+    featureSubsetStrategy = Param(
+        "featureSubsetStrategy",
+        "features considered per level: all | sqrt | onethird",
+        "all",
+        validator=lambda v: v in ("all", "sqrt", "onethird"),
+    )
+    subsamplingRate = Param(
+        "subsamplingRate",
+        "bootstrap rate: Poisson(rate) sample weights per tree",
+        1.0,
+        validator=lambda v: 0.0 < float(v) <= 1.0,
+    )
+    seed = Param("seed", "bootstrap/subset seed", 0,
+                 validator=lambda v: isinstance(v, int))
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+def _subset_counts(strategy: str, d: int) -> int:
+    if strategy == "sqrt":
+        return max(1, int(np.sqrt(d)))
+    if strategy == "onethird":
+        return max(1, d // 3)
+    return d
+
+
+class _ForestBase(RandomForestParams):
+    _classification = False
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str):
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(cls, path)
+
+    def fit(self, dataset, labels=None):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.forest_kernel import (
+            TreeEnsemble,
+            grow_tree_classification,
+            grow_tree_regression,
+            quantile_bins,
+        )
+
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+            if labels is not None:
+                y = np.asarray(labels, dtype=np.float64).reshape(-1)
+            else:
+                y = np.asarray(
+                    frame.column(self.getLabelCol()), dtype=np.float64
+                )
+        if y.shape[0] != x.shape[0]:
+            raise ValueError(
+                f"labels length {y.shape[0]} != rows {x.shape[0]}"
+            )
+        n, d = x.shape
+        depth = self.getMaxDepth()
+        n_bins = self.getMaxBins()
+        rng = np.random.default_rng(self.getSeed())
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+
+        with timer.phase("binning"):
+            binned_np, edges = quantile_bins(x, n_bins)
+        binned = jax.device_put(
+            jnp.asarray(binned_np, dtype=jnp.int32), device
+        )
+
+        if self._classification:
+            classes = np.unique(y)
+            class_index = {c: i for i, c in enumerate(classes)}
+            y_idx = np.vectorize(class_index.get)(y)
+            y_oh = jax.device_put(
+                jnp.asarray(
+                    np.eye(len(classes))[y_idx], dtype=dtype
+                ),
+                device,
+            )
+        else:
+            y_dev = jax.device_put(jnp.asarray(y, dtype=dtype), device)
+
+        k_feats = _subset_counts(self.getFeatureSubsetStrategy(), d)
+        feats_l, thrs_l, leaves_l = [], [], []
+        with timer.phase("grow"), TraceRange("forest grow", TraceColor.RED):
+            rate = float(self.getSubsamplingRate())
+            for _ in range(self.getNumTrees()):
+                w = jax.device_put(
+                    jnp.asarray(rng.poisson(rate, n), dtype=dtype), device
+                )
+                mask = np.zeros((depth, d), dtype=np.float64)
+                for lvl in range(depth):
+                    cols = rng.choice(d, size=k_feats, replace=False)
+                    mask[lvl, cols] = 1.0
+                mask_dev = jnp.asarray(mask, dtype=dtype)
+                if self._classification:
+                    f, t, leaf = grow_tree_classification(
+                        binned, y_oh, w, mask_dev, depth, n_bins,
+                        len(classes), self.getMinInstancesPerNode(),
+                    )
+                else:
+                    f, t, leaf = grow_tree_regression(
+                        binned, y_dev, w, mask_dev, depth, n_bins,
+                        self.getMinInstancesPerNode(),
+                    )
+                feats_l.append(f)
+                thrs_l.append(t)
+                leaves_l.append(leaf)
+        ensemble = TreeEnsemble(
+            feature=jnp.stack(feats_l),
+            threshold=jnp.stack(thrs_l),
+            leaf_value=jnp.stack(leaves_l),
+        )
+        model = self._model_cls()(
+            ensemble=jax.device_get(ensemble),
+            edges=edges,
+            classes=classes if self._classification else None,
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def _model_cls(self):
+        raise NotImplementedError
+
+
+class _ForestModelBase(RandomForestParams):
+    _classification = False
+
+    def __init__(self, ensemble=None, edges=None, classes=None):
+        super().__init__()
+        self.ensemble_ = ensemble
+        self.edges_ = edges
+        self.classes_ = classes
+
+    def _copy_internal_state(self, other) -> None:
+        other.ensemble_ = self.ensemble_
+        other.edges_ = self.edges_
+        other.classes_ = self.classes_
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_forest_model
+
+        save_forest_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str):
+        from spark_rapids_ml_tpu.io.persistence import load_forest_model
+
+        return load_forest_model(path)
+
+    def _apply(self, x) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.forest_kernel import (
+            TreeEnsemble,
+            forest_apply,
+        )
+
+        if self.ensemble_ is None:
+            raise ValueError("model has no ensemble; fit first")
+        from spark_rapids_ml_tpu.ops.forest_kernel import apply_bin_edges
+
+        x = np.asarray(x, dtype=np.float64)
+        if x.shape[1] != self.edges_.shape[0]:
+            raise ValueError(
+                f"query dim {x.shape[1]} != fitted dim {self.edges_.shape[0]}"
+            )
+        binned = apply_bin_edges(x, self.edges_)
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        ens = TreeEnsemble(
+            feature=jnp.asarray(self.ensemble_.feature, dtype=jnp.int32),
+            threshold=jnp.asarray(self.ensemble_.threshold, dtype=jnp.int32),
+            leaf_value=jnp.asarray(self.ensemble_.leaf_value, dtype=dtype),
+        )
+        out = forest_apply(
+            jax.device_put(jnp.asarray(binned), device),
+            jax.device_put(ens, device),
+            self.getMaxDepth(),
+        )
+        return np.asarray(out, dtype=np.float64)
+
+
+class RandomForestRegressor(_ForestBase):
+    """``RandomForestRegressor().setNumTrees(50).fit(df)``."""
+
+    _classification = False
+
+    def _model_cls(self):
+        return RandomForestRegressionModel
+
+
+class RandomForestRegressionModel(_ForestModelBase):
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        pred = self._apply(frame.vectors_as_matrix(self.getInputCol()))
+        return frame.with_column(
+            self.getPredictionCol(), pred.astype(np.float64)
+        )
+
+
+class RandomForestClassifierParams(RandomForestParams):
+    """Classifier-side params: declared on estimator AND model so the
+    estimator can configure them pre-fit (setProbabilityCol, grids) and
+    copy_values_from carries them to the fitted model."""
+
+    probabilityCol = Param(
+        "probabilityCol", "per-class probability output column", "probability"
+    )
+
+
+class RandomForestClassifier(RandomForestClassifierParams, _ForestBase):
+    """``RandomForestClassifier().setNumTrees(50).fit(df)``."""
+
+    _classification = True
+
+    def _model_cls(self):
+        return RandomForestClassificationModel
+
+
+class RandomForestClassificationModel(
+    RandomForestClassifierParams, _ForestModelBase
+):
+    _classification = True
+
+    def predict_proba(self, dataset) -> np.ndarray:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        return self._apply(frame.vectors_as_matrix(self.getInputCol()))
+
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        proba = self._apply(frame.vectors_as_matrix(self.getInputCol()))
+        pred = self.classes_[np.argmax(proba, axis=1)]
+        out = frame.with_column(self.getProbabilityCol(), proba.tolist())
+        return out.with_column(
+            self.getPredictionCol(), pred.astype(np.float64)
+        )

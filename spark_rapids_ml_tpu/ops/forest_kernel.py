@@ -1,0 +1,262 @@
+"""Random-forest kernels: level-synchronous histogram trees on the MXU.
+
+The reference project's later generations ship cuML-backed random
+forests. CPU/GPU tree builders are pointer-and-queue machines (per-node
+sample lists, recursive splits); the TPU formulation grows ALL nodes of a
+level at once with dense algebra and static shapes:
+
+* features are quantile-binned to small ints once (``quantile_bins``) —
+  splits become bin thresholds, the standard histogram-tree trick; the
+  SAME edge-application helper (``apply_bin_edges``) serves fit and
+  predict so train/inference binning can never diverge;
+* one level step builds per-channel (node, feature, bin) statistics
+  histograms as dense contractions: rows scatter into their node one-hot
+  (n×nodes) and matmul against the per-(feature,bin) one-hot — the MXU
+  does the aggregation a CPU builder does with per-sample scatter-adds;
+* split selection is a cumulative-sum scan over bins and an argmax over
+  (feature, bin) per node — all vectorized, no data-dependent shapes.
+  The scaffold (histograms → scan → argmax → routing) is ONE shared
+  implementation; regression (variance gain) and classification (Gini)
+  plug in only their channel definitions and gain functions;
+* samples route to children by ``node ← 2·node + (x_bin > threshold)``,
+  one gather + compare per level.
+
+Trees are complete binary trees of fixed ``max_depth`` (inactive nodes
+carry zero weight and fall out of the math); bagging draws
+Poisson(subsamplingRate) sample weights per tree — the large-n limit of
+rate-sized bootstrap resampling — so "resampling" is a weight vector,
+never a data copy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def quantile_bins(
+    x: np.ndarray, n_bins: int = 32
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(binned int32 (n,d), edges (d, n_bins−1)): per-feature quantile
+    binning on host (one pass over the data, done once per fit)."""
+    x = np.asarray(x, dtype=np.float64)
+    qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    edges = np.quantile(x, qs, axis=0).T  # (d, n_bins-1)
+    return apply_bin_edges(x, edges), edges
+
+
+def apply_bin_edges(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Bin rows with fitted edges — the ONE binning implementation shared
+    by fit and predict (side='right': bin b ⇔ edges[b−1] < v ≤ edges[b])."""
+    x = np.asarray(x, dtype=np.float64)
+    binned = np.empty(x.shape, dtype=np.int32)
+    for j in range(x.shape[1]):
+        binned[:, j] = np.searchsorted(edges[j], x[:, j], side="right")
+    return binned
+
+
+class TreeEnsemble(NamedTuple):
+    """Complete-binary-tree ensemble, all arrays (trees, 2**depth − 1 …).
+
+    ``feature``/``threshold`` index internal nodes in level order;
+    ``leaf_value`` holds 2**depth leaves per tree (regression: mean;
+    classification: per-class probabilities with an extra trailing axis).
+    """
+
+    feature: jnp.ndarray     # (T, n_internal) int32
+    threshold: jnp.ndarray   # (T, n_internal) int32 (bin id; go right if >)
+    leaf_value: jnp.ndarray  # (T, n_leaves) or (T, n_leaves, n_classes)
+
+
+def _bin_onehot(binned: jnp.ndarray, n_bins: int, dtype) -> jnp.ndarray:
+    """(n, d·n_bins) with exactly one 1 per feature block. Feature j's
+    block sits at offset j·n_bins, so a plain one_hot over bins followed
+    by reshape is bit-identical to (and d× cheaper than) a one_hot over
+    the combined d·n_bins index space."""
+    n, d = binned.shape
+    return jax.nn.one_hot(binned, n_bins, dtype=dtype).reshape(
+        n, d * n_bins
+    )
+
+
+def _channel_histograms(node_oh, bin_oh, channels):
+    """H[c, node, d·B + b] = Σ_s node_oh[s,node]·bin_oh[s,·]·channels[s,c]."""
+
+    def one(stat):
+        return lax.dot_general(
+            node_oh * stat[:, None],
+            bin_oh,
+            (((0,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+        )
+
+    return jnp.stack([one(channels[:, c]) for c in range(channels.shape[1])])
+
+
+def _grow_tree(
+    binned, channels, count_channel_slice, gain_fn, feat_mask,
+    max_depth, n_bins, min_leaf,
+):
+    """Shared level-synchronous scaffold.
+
+    ``channels`` (n, C): per-sample statistics to histogram.
+    ``count_channel_slice``: channels summed to get sample counts.
+    ``gain_fn(H_left, H_total) -> gain (nodes, d, bins)``: split criterion
+    from the prefix-sum (left) and total histograms, both (C, nodes, d, B).
+    Returns (feature, threshold, final node assignment).
+    """
+    n, d = binned.shape
+    dtypef = channels.dtype
+    bin_oh = _bin_onehot(binned, n_bins, dtypef)
+    node = jnp.zeros((n,), dtype=jnp.int32)
+    feats = jnp.zeros((2 ** max_depth - 1,), dtype=jnp.int32)
+    thrs = jnp.full((2 ** max_depth - 1,), n_bins, dtype=jnp.int32)
+
+    for level in range(max_depth):  # static unroll: max_depth compiled steps
+        n_nodes = 2 ** level
+        base = n_nodes - 1  # level-order offset of this level's nodes
+        node_oh = jax.nn.one_hot(node - base, n_nodes, dtype=dtypef)
+        h = _channel_histograms(node_oh, bin_oh, channels).reshape(
+            channels.shape[1], n_nodes, d, n_bins
+        )
+        h_l = jnp.cumsum(h, axis=3)  # stats of LEFT child if split at bin b
+        h_t = h_l[..., -1:]
+        gain = gain_fn(h_l, h_t)
+        c_l = h_l[count_channel_slice].sum(axis=0)
+        c_t = h_t[count_channel_slice].sum(axis=0)
+        valid = (c_l >= min_leaf) & (c_t - c_l >= min_leaf)
+        valid &= feat_mask[level][None, :, None] > 0
+        gain = jnp.where(valid, gain, -jnp.inf)
+        flat = gain.reshape(n_nodes, d * n_bins)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (best // n_bins).astype(jnp.int32)
+        bt = (best % n_bins).astype(jnp.int32)
+        # no-positive-gain nodes become pass-through (threshold = n_bins
+        # sends every sample LEFT; the left subtree inherits the node)
+        bt = jnp.where(best_gain > 1e-12, bt, n_bins)
+        bf = jnp.where(best_gain > 1e-12, bf, 0)
+        feats = lax.dynamic_update_slice(feats, bf, (base,))
+        thrs = lax.dynamic_update_slice(thrs, bt, (base,))
+        x_bin = jnp.take_along_axis(
+            binned, bf[node - base][:, None], axis=1
+        )[:, 0]
+        go_right = (x_bin > bt[node - base]).astype(jnp.int32)
+        node = (node - base) * 2 + go_right + (2 ** (level + 1) - 1)
+
+    return feats, thrs, node
+
+
+@partial(jax.jit, static_argnames=("max_depth", "n_bins", "min_leaf"))
+def grow_tree_regression(
+    binned: jnp.ndarray,     # (n, d) int32 bins
+    y: jnp.ndarray,          # (n,)
+    w: jnp.ndarray,          # (n,) bootstrap weights (Poisson)
+    feat_mask: jnp.ndarray,  # (max_depth, d) 0/1 per-level feature subsample
+    max_depth: int,
+    n_bins: int,
+    min_leaf: int = 1,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One regression tree; returns (feature, threshold, leaf_value).
+
+    Split criterion: weighted variance reduction from the (count, Σy, Σy²)
+    channel histograms; gain = SSE(parent) − SSE(left) − SSE(right).
+    """
+    channels = jnp.stack([w, w * y, w * y * y], axis=1)
+
+    def gain_fn(h_l, h_t):
+        def sse(h):
+            c, s, q = h[0], h[1], h[2]
+            return q - (s * s) / jnp.maximum(c, 1e-12)
+
+        return sse(h_t) - sse(h_l) - sse(h_t - h_l)
+
+    feats, thrs, node = _grow_tree(
+        binned, channels, slice(0, 1), gain_fn, feat_mask,
+        max_depth, n_bins, min_leaf,
+    )
+    n_leaves = 2 ** max_depth
+    leaf_oh = jax.nn.one_hot(node - (n_leaves - 1), n_leaves, dtype=y.dtype)
+    cnt = leaf_oh.T @ w
+    tot = leaf_oh.T @ (w * y)
+    # empty leaves fall back to the global weighted mean
+    gmean = jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-12)
+    leaf = jnp.where(cnt > 0, tot / jnp.maximum(cnt, 1e-12), gmean)
+    return feats, thrs, leaf
+
+
+@partial(
+    jax.jit, static_argnames=("max_depth", "n_bins", "min_leaf", "n_classes")
+)
+def grow_tree_classification(
+    binned: jnp.ndarray,
+    y_onehot: jnp.ndarray,  # (n, n_classes)
+    w: jnp.ndarray,
+    feat_mask: jnp.ndarray,
+    max_depth: int,
+    n_bins: int,
+    n_classes: int,
+    min_leaf: int = 1,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One classification tree (Gini impurity); leaves are per-class
+    probability vectors."""
+    channels = y_onehot * w[:, None]  # (n, C): per-class weighted counts
+
+    def gain_fn(h_l, h_t):
+        def gini_mass(h):  # Σ n·gini = n − Σ_k n_k²/n
+            total = jnp.sum(h, axis=0)
+            return total - jnp.sum(h * h, axis=0) / jnp.maximum(total, 1e-12)
+
+        return gini_mass(h_t) - gini_mass(h_l) - gini_mass(h_t - h_l)
+
+    feats, thrs, node = _grow_tree(
+        binned, channels, slice(0, n_classes), gain_fn, feat_mask,
+        max_depth, n_bins, min_leaf,
+    )
+    n_leaves = 2 ** max_depth
+    leaf_oh = jax.nn.one_hot(
+        node - (n_leaves - 1), n_leaves, dtype=y_onehot.dtype
+    )
+    cls_cnt = lax.dot_general(
+        leaf_oh * w[:, None],
+        y_onehot,
+        (((0,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+    )  # (n_leaves, n_classes)
+    tot = jnp.sum(cls_cnt, axis=1, keepdims=True)
+    prior = jnp.sum(y_onehot * w[:, None], axis=0)
+    prior = prior / jnp.maximum(jnp.sum(prior), 1e-12)
+    proba = jnp.where(
+        tot > 0, cls_cnt / jnp.maximum(tot, 1e-12), prior[None, :]
+    )
+    return feats, thrs, proba
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_apply(
+    binned: jnp.ndarray, ensemble: TreeEnsemble, max_depth: int
+) -> jnp.ndarray:
+    """Route every row through every tree: vectorized gathers per level,
+    no recursion; leaf values averaged over trees."""
+
+    def one_tree(feature, threshold, leaf_value):
+        node = jnp.zeros((binned.shape[0],), dtype=jnp.int32)
+        for level in range(max_depth):
+            base = 2 ** level - 1
+            f = feature[node]
+            t = threshold[node]
+            x_bin = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
+            go_right = (x_bin > t).astype(jnp.int32)
+            node = (node - base) * 2 + go_right + (2 ** (level + 1) - 1)
+        leaf = node - (2 ** max_depth - 1)
+        return leaf_value[leaf]
+
+    per_tree = jax.vmap(one_tree)(
+        ensemble.feature, ensemble.threshold, ensemble.leaf_value
+    )  # (T, n) or (T, n, C)
+    return jnp.mean(per_tree, axis=0)
