@@ -233,10 +233,14 @@ class _ForestModelBase(RandomForestParams):
             threshold=jnp.asarray(self.ensemble_.threshold, dtype=jnp.int32),
             leaf_value=jnp.asarray(self.ensemble_.leaf_value, dtype=dtype),
         )
+        # depth comes from the FITTED ensemble's shape (n_internal =
+        # 2**depth − 1), never from the mutable maxDepth param: a setter
+        # call after fit would otherwise silently misroute predictions
+        depth = int(np.asarray(self.ensemble_.feature).shape[1] + 1).bit_length() - 1
         out = forest_apply(
             jax.device_put(jnp.asarray(binned), device),
             jax.device_put(ens, device),
-            self.getMaxDepth(),
+            depth,
         )
         return np.asarray(out, dtype=np.float64)
 

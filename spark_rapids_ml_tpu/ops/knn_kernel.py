@@ -82,3 +82,45 @@ def knn_merge(
     """
     neg, pos = lax.top_k(-dist_parts, k)
     return -neg, jnp.take_along_axis(idx_parts, pos, axis=1)
+
+
+# -- IVF-Flat approximate search (the reference project's NearestNeighbors
+# exposes brute vs ivfflat; the TPU variant keeps everything dense/static:
+# coarse quantizer = the k-means kernel, buckets padded to one max size) --
+
+
+@partial(jax.jit, static_argnames=("k", "nprobe"))
+def ivf_search(
+    queries: jnp.ndarray,       # (n_q, dim)
+    centroids: jnp.ndarray,     # (nlist, dim)
+    bucket_items: jnp.ndarray,  # (nlist, max_size, dim), zero-padded
+    bucket_ids: jnp.ndarray,    # (nlist, max_size) int32 original row ids
+    bucket_mask: jnp.ndarray,   # (nlist, max_size) 1 = real item
+    k: int,
+    nprobe: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Approximate top-k: search only the ``nprobe`` nearest buckets.
+
+    Returns (sq_distances, indices) each (n_q, k); indices address the
+    ORIGINAL item numbering via ``bucket_ids``. Exact when
+    nprobe == nlist. All shapes static: the bucket gather is
+    (n_q, nprobe·max_size, dim) — bound query batches accordingly.
+    """
+    cd = pairwise_sqdist(queries, centroids)
+    _, probes = lax.top_k(-cd, nprobe)             # (n_q, nprobe)
+    cand = bucket_items[probes]                    # (n_q, nprobe, m, dim)
+    cand_ids = bucket_ids[probes].reshape(queries.shape[0], -1)
+    cand_mask = bucket_mask[probes].reshape(queries.shape[0], -1)
+    # padding slots surface as id −1 / distance +inf, never as item 0
+    cand_ids = jnp.where(cand_mask > 0, cand_ids, -1)
+    n_q, _, m, dim = cand.shape
+    cand = cand.reshape(n_q, nprobe * m, dim)
+    qn = jnp.sum(queries * queries, axis=1)[:, None]
+    xn = jnp.sum(cand * cand, axis=2)
+    cross = jnp.einsum(
+        "qd,qcd->qc", queries, cand, precision=lax.Precision.HIGHEST
+    )
+    d2 = jnp.maximum(qn - 2.0 * cross + xn, 0.0)
+    d2 = jnp.where(cand_mask > 0, d2, jnp.asarray(jnp.inf, d2.dtype))
+    neg, pos = lax.top_k(-d2, k)
+    return -neg, jnp.take_along_axis(cand_ids, pos, axis=1)

@@ -124,3 +124,64 @@ def test_distributed_skewed_tiny_shards(rng):
         d, i, queries.astype(np.float64), items.astype(np.float64), 6,
         atol=1e-3,
     )
+
+
+def test_ivfflat_high_recall_and_exact_at_full_probe(rng):
+    """IVF-Flat: recall@k vs the exact oracle is high at moderate nprobe
+    on clustered data, and EXACT when nprobe == nlist."""
+    centers = rng.normal(scale=10, size=(8, 16))
+    items = np.concatenate(
+        [rng.normal(loc=c, size=(80, 16)) for c in centers]
+    ).astype(np.float32)
+    queries = items[rng.choice(len(items), 40, replace=False)]
+    exact = NearestNeighbors().setK(10).fit(items)
+    ed, ei = exact.kneighbors(queries)
+
+    approx = (
+        NearestNeighbors()
+        .setK(10)
+        .setAlgorithm("ivfflat")
+        .setNlist(8)
+        .setNprobe(2)
+        .fit(items)
+    )
+    ad, ai = approx.kneighbors(queries)
+    recall = np.mean([
+        len(set(ai[i]) & set(ei[i])) / 10 for i in range(len(queries))
+    ])
+    assert recall > 0.9, recall
+
+    full = (
+        NearestNeighbors()
+        .setK(10)
+        .setAlgorithm("ivfflat")
+        .setNlist(8)
+        .setNprobe(8)
+        .fit(items)
+    )
+    fd, fi = full.kneighbors(queries)
+    np.testing.assert_allclose(fd, ed, atol=1e-3)  # exact at full probe
+
+
+def test_ivfflat_defaults_and_small_corpus(rng):
+    items = rng.normal(size=(30, 4)).astype(np.float32)
+    m = NearestNeighbors().setK(3).setAlgorithm("ivfflat").fit(items)
+    d, i = m.kneighbors(items[:5])
+    assert d.shape == (5, 3)
+    # self is found (bucket containing the row is always probed first)
+    np.testing.assert_array_equal(i[:, 0], np.arange(5))
+
+
+def test_ivfflat_k_exceeding_candidate_pool_rejected(rng):
+    """k beyond nprobe x largest bucket must raise, not return padding."""
+    items = rng.normal(scale=5, size=(64, 4)).astype(np.float32)
+    m = (
+        NearestNeighbors()
+        .setK(40)
+        .setAlgorithm("ivfflat")
+        .setNlist(16)
+        .setNprobe(1)
+        .fit(items)
+    )
+    with pytest.raises(ValueError, match="candidate pool"):
+        m.kneighbors(items[:3])

@@ -163,3 +163,16 @@ def test_subsampling_rate_param(rng):
     )
     pred = np.asarray(m.transform(frame).column("prediction"))
     assert np.isfinite(pred).all()
+
+
+def test_apply_depth_comes_from_fitted_ensemble(rng):
+    """Mutating maxDepth on the fitted model must not corrupt routing —
+    depth is derived from the ensemble's array shapes."""
+    x = rng.normal(size=(300, 3))
+    y = x[:, 0] + (x[:, 1] > 0) * 3
+    frame = VectorFrame({"features": x, "label": y})
+    m = RandomForestRegressor().setNumTrees(5).setMaxDepth(5).fit(frame)
+    base = np.asarray(m.transform(frame).column("prediction"))
+    m.set("maxDepth", 2)  # stale param; predictions must be unchanged
+    after = np.asarray(m.transform(frame).column("prediction"))
+    np.testing.assert_array_equal(base, after)
