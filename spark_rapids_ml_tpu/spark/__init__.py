@@ -14,9 +14,17 @@ from spark_rapids_ml_tpu.spark.aggregate import (  # noqa: F401
     vector_column_to_matrix,
 )
 
-__all__ = [
+_PYSPARK_CLASSES = (
     "PCA",
     "PCAModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+    "KMeans",
+    "KMeansModel",
+)
+
+__all__ = [
+    *_PYSPARK_CLASSES,
     "combine_stats",
     "finalize_pca_from_stats",
     "partition_gram_stats",
@@ -25,12 +33,12 @@ __all__ = [
 
 
 def __getattr__(name):
-    if name in ("PCA", "PCAModel"):
+    if name in _PYSPARK_CLASSES:
         try:
             from spark_rapids_ml_tpu.spark import estimator
         except ImportError as exc:  # pragma: no cover - depends on env
             raise ImportError(
-                "spark_rapids_ml_tpu.spark.PCA requires pyspark "
+                f"spark_rapids_ml_tpu.spark.{name} requires pyspark "
                 "(an optional dependency): pip install pyspark"
             ) from exc
         return getattr(estimator, name)
