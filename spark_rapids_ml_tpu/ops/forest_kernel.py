@@ -100,7 +100,7 @@ def _channel_histograms(node_oh, bin_oh, channels):
 
 def _grow_tree(
     binned, channels, count_channel_slice, gain_fn, feat_mask,
-    max_depth, n_bins, min_leaf,
+    max_depth, n_bins, min_leaf, axis_name=None,
 ):
     """Shared level-synchronous scaffold.
 
@@ -109,6 +109,14 @@ def _grow_tree(
     ``gain_fn(H_left, H_total) -> gain (nodes, d, bins)``: split criterion
     from the prefix-sum (left) and total histograms, both (C, nodes, d, B).
     Returns (feature, threshold, final node assignment).
+
+    ``axis_name``: when growing under ``shard_map`` with rows sharded over
+    a mesh axis, per-shard histograms are ``psum``-combined there — the
+    ONLY collective the distributed tree needs, and it moves the tiny
+    (C, nodes, d, bins) statistics rather than data rows (the same
+    partials-aggregation shape the reference used for covariance,
+    ``RapidsRowMatrix.scala:168-202``). Split selection then runs
+    replicated on every shard; routing stays shard-local.
     """
     n, d = binned.shape
     dtypef = channels.dtype
@@ -124,6 +132,8 @@ def _grow_tree(
         h = _channel_histograms(node_oh, bin_oh, channels).reshape(
             channels.shape[1], n_nodes, d, n_bins
         )
+        if axis_name is not None:
+            h = lax.psum(h, axis_name)
         h_l = jnp.cumsum(h, axis=3)  # stats of LEFT child if split at bin b
         h_t = h_l[..., -1:]
         gain = gain_fn(h_l, h_t)
@@ -152,7 +162,9 @@ def _grow_tree(
     return feats, thrs, node
 
 
-@partial(jax.jit, static_argnames=("max_depth", "n_bins", "min_leaf"))
+@partial(
+    jax.jit, static_argnames=("max_depth", "n_bins", "min_leaf", "axis_name")
+)
 def grow_tree_regression(
     binned: jnp.ndarray,     # (n, d) int32 bins
     y: jnp.ndarray,          # (n,)
@@ -161,11 +173,13 @@ def grow_tree_regression(
     max_depth: int,
     n_bins: int,
     min_leaf: int = 1,
+    axis_name=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One regression tree; returns (feature, threshold, leaf_value).
 
     Split criterion: weighted variance reduction from the (count, Σy, Σy²)
     channel histograms; gain = SSE(parent) − SSE(left) − SSE(right).
+    ``axis_name``: see ``_grow_tree`` (sharded-row growth under shard_map).
     """
     channels = jnp.stack([w, w * y, w * y * y], axis=1)
 
@@ -178,20 +192,28 @@ def grow_tree_regression(
 
     feats, thrs, node = _grow_tree(
         binned, channels, slice(0, 1), gain_fn, feat_mask,
-        max_depth, n_bins, min_leaf,
+        max_depth, n_bins, min_leaf, axis_name,
     )
     n_leaves = 2 ** max_depth
     leaf_oh = jax.nn.one_hot(node - (n_leaves - 1), n_leaves, dtype=y.dtype)
     cnt = leaf_oh.T @ w
     tot = leaf_oh.T @ (w * y)
+    wy_sum = jnp.sum(w * y)
+    w_sum = jnp.sum(w)
+    if axis_name is not None:
+        cnt = lax.psum(cnt, axis_name)
+        tot = lax.psum(tot, axis_name)
+        wy_sum = lax.psum(wy_sum, axis_name)
+        w_sum = lax.psum(w_sum, axis_name)
     # empty leaves fall back to the global weighted mean
-    gmean = jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-12)
+    gmean = wy_sum / jnp.maximum(w_sum, 1e-12)
     leaf = jnp.where(cnt > 0, tot / jnp.maximum(cnt, 1e-12), gmean)
     return feats, thrs, leaf
 
 
 @partial(
-    jax.jit, static_argnames=("max_depth", "n_bins", "min_leaf", "n_classes")
+    jax.jit,
+    static_argnames=("max_depth", "n_bins", "min_leaf", "n_classes", "axis_name"),
 )
 def grow_tree_classification(
     binned: jnp.ndarray,
@@ -202,9 +224,10 @@ def grow_tree_classification(
     n_bins: int,
     n_classes: int,
     min_leaf: int = 1,
+    axis_name=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One classification tree (Gini impurity); leaves are per-class
-    probability vectors."""
+    probability vectors. ``axis_name``: see ``_grow_tree``."""
     channels = y_onehot * w[:, None]  # (n, C): per-class weighted counts
 
     def gain_fn(h_l, h_t):
@@ -216,7 +239,7 @@ def grow_tree_classification(
 
     feats, thrs, node = _grow_tree(
         binned, channels, slice(0, n_classes), gain_fn, feat_mask,
-        max_depth, n_bins, min_leaf,
+        max_depth, n_bins, min_leaf, axis_name,
     )
     n_leaves = 2 ** max_depth
     leaf_oh = jax.nn.one_hot(
@@ -228,8 +251,11 @@ def grow_tree_classification(
         (((0,), (0,)), ((), ())),
         precision=lax.Precision.HIGHEST,
     )  # (n_leaves, n_classes)
-    tot = jnp.sum(cls_cnt, axis=1, keepdims=True)
     prior = jnp.sum(y_onehot * w[:, None], axis=0)
+    if axis_name is not None:
+        cls_cnt = lax.psum(cls_cnt, axis_name)
+        prior = lax.psum(prior, axis_name)
+    tot = jnp.sum(cls_cnt, axis=1, keepdims=True)
     prior = prior / jnp.maximum(jnp.sum(prior), 1e-12)
     proba = jnp.where(
         tot > 0, cls_cnt / jnp.maximum(tot, 1e-12), prior[None, :]

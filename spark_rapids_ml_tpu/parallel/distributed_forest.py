@@ -1,0 +1,125 @@
+"""Distributed RandomForest: rows sharded over the mesh, histograms psum'd.
+
+The level-synchronous histogram formulation (``ops/forest_kernel.py``)
+distributes for free: each shard histograms ITS rows into the tiny
+(channels, nodes, features, bins) statistics tensor, one ``psum`` per
+level combines them over ICI, and split selection runs replicated — the
+identical partials-aggregation shape the reference used for distributed
+covariance (``RapidsRowMatrix.scala:168-202``), here applied per tree
+level. No data rows ever move; routing stays shard-local.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops.forest_kernel import (
+    TreeEnsemble,
+    grow_tree_classification,
+    grow_tree_regression,
+    quantile_bins,
+)
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "n_bins", "min_leaf", "n_classes", "mesh"),
+)
+def _sharded_grow(
+    binned, y_or_oh, w, feat_mask, max_depth, n_bins, min_leaf,
+    n_classes, mesh,
+):
+    def per_shard(b, yy, ww, fm):
+        if n_classes:
+            return grow_tree_classification(
+                b, yy, ww, fm, max_depth, n_bins, n_classes, min_leaf,
+                axis_name=DATA_AXIS,
+            )
+        return grow_tree_regression(
+            b, yy, ww, fm, max_depth, n_bins, min_leaf, axis_name=DATA_AXIS,
+        )
+
+    y_spec = P(DATA_AXIS, None) if n_classes else P(DATA_AXIS)
+    # outputs are replicated by construction (every shard sees the SAME
+    # psum'd histograms and runs the same deterministic selection), but
+    # the static analysis can't prove it through argmax/dynamic_update
+    return jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), y_spec, P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )(binned, y_or_oh, w, feat_mask)
+
+
+def distributed_forest_fit(
+    x: np.ndarray,
+    y: np.ndarray,
+    mesh: Mesh,
+    n_trees: int = 20,
+    max_depth: int = 5,
+    n_bins: int = 32,
+    min_leaf: int = 1,
+    subsampling_rate: float = 1.0,
+    classification: bool = False,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> Tuple[TreeEnsemble, np.ndarray, np.ndarray]:
+    """(ensemble, edges, classes) with rows sharded over ``mesh``.
+
+    Bootstrap weights are drawn on host per tree; padding rows carry
+    weight 0 so they contribute to no histogram. ``classes`` is None for
+    regression.
+    """
+    n_dev = int(np.prod(mesh.devices.shape))
+    binned_np, edges = quantile_bins(x, n_bins)
+    y = np.asarray(y, dtype=np.float64).reshape(-1)
+    if classification:
+        classes = np.unique(y)
+        y_idx = np.searchsorted(classes, y)
+        y_payload = np.eye(len(classes))[y_idx]
+    else:
+        classes = None
+        y_payload = y
+    binned_p, mask = pad_rows_to_multiple(binned_np, n_dev)
+    y_p, _ = pad_rows_to_multiple(y_payload, n_dev)
+    rng = np.random.default_rng(seed)
+    d = x.shape[1]
+
+    row_shard = NamedSharding(mesh, P(DATA_AXIS, None))
+    vec_shard = NamedSharding(mesh, P(DATA_AXIS))
+    binned_dev = jax.device_put(
+        jnp.asarray(binned_p, dtype=jnp.int32), row_shard
+    )
+    if classification:
+        y_dev = jax.device_put(jnp.asarray(y_p, dtype=dtype), row_shard)
+    else:
+        y_dev = jax.device_put(jnp.asarray(y_p, dtype=dtype), vec_shard)
+
+    feats_l, thrs_l, leaves_l = [], [], []
+    for _ in range(n_trees):
+        w = rng.poisson(subsampling_rate, binned_p.shape[0]) * mask
+        w_dev = jax.device_put(jnp.asarray(w, dtype=dtype), vec_shard)
+        fm = jnp.asarray(
+            np.ones((max_depth, d)), dtype=dtype
+        )  # feature subsets: host-side choice mirrors the local fit
+        f, t, leaf = _sharded_grow(
+            binned_dev, y_dev, w_dev, fm, max_depth, n_bins, min_leaf,
+            len(classes) if classification else 0, mesh,
+        )
+        feats_l.append(np.asarray(f))
+        thrs_l.append(np.asarray(t))
+        leaves_l.append(np.asarray(leaf))
+    ensemble = TreeEnsemble(
+        feature=np.stack(feats_l),
+        threshold=np.stack(thrs_l),
+        leaf_value=np.stack(leaves_l),
+    )
+    return ensemble, edges, classes
