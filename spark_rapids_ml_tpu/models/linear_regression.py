@@ -24,6 +24,14 @@ from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 class LinearRegressionParams(HasInputCol, HasDeviceId):
     labelCol = Param("labelCol", "label column name", "label")
+    weightCol = Param(
+        "weightCol",
+        "per-row sample-weight column ('' = unweighted). Supported on "
+        "in-memory fits; streamed/out-of-core inputs with weights are "
+        "not supported yet.",
+        "",
+        validator=lambda v: isinstance(v, str),
+    )
     predictionCol = Param("predictionCol", "prediction output column",
                           "prediction")
     fitIntercept = Param("fitIntercept", "whether to fit an intercept", True,
@@ -62,6 +70,11 @@ class LinearRegression(LinearRegressionParams):
         timer = PhaseTimer()
         source = _streaming_xy_source(dataset, labels)
         if source is not None:
+            if self.getWeightCol():
+                raise ValueError(
+                    "weightCol is not supported with streamed/out-of-core "
+                    "input yet; fit in-memory or drop the weights"
+                )
             coef, intercept = self._fit_streamed(source, timer)
         else:
             frame = as_vector_frame(dataset, self.getInputCol())
@@ -76,15 +89,20 @@ class LinearRegression(LinearRegressionParams):
                 raise ValueError(
                     f"labels length {y.shape[0]} != rows {x.shape[0]}"
                 )
+            weights = _extract_weights(self, frame, x.shape[0])
             from spark_rapids_ml_tpu.data.batches import stream_threshold_bytes
 
-            if self.getUseXlaDot() and x.nbytes > stream_threshold_bytes():
+            if (
+                self.getUseXlaDot()
+                and weights is None
+                and x.nbytes > stream_threshold_bytes()
+            ):
                 source = _xy_batch_source(x, y)
                 coef, intercept = self._fit_streamed(source, timer)
             elif self.getUseXlaDot():
-                coef, intercept = self._fit_xla(x, y, timer)
+                coef, intercept = self._fit_xla(x, y, timer, weights)
             else:
-                coef, intercept = self._fit_host(x, y, timer)
+                coef, intercept = self._fit_host(x, y, timer, weights)
         model = LinearRegressionModel(
             coefficients=np.asarray(coef, dtype=np.float64),
             intercept=float(intercept),
@@ -156,7 +174,7 @@ class LinearRegression(LinearRegressionParams):
             intercept = 0.0
         return coef, intercept
 
-    def _fit_xla(self, x, y, timer):
+    def _fit_xla(self, x, y, timer, weights=None):
         import jax
         import jax.numpy as jnp
 
@@ -167,30 +185,57 @@ class LinearRegression(LinearRegressionParams):
         with timer.phase("h2d"):
             x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
             y_dev = jax.device_put(jnp.asarray(y, dtype=dtype), device)
+            # the kernel's mask slot IS a general per-row weight: every
+            # statistic it folds is Σ mᵢ·(…) — exactly weighted least
+            # squares (Spark's weightCol semantics)
+            w_dev = (
+                None
+                if weights is None
+                else jax.device_put(jnp.asarray(weights, dtype=dtype), device)
+            )
         with timer.phase("fit_kernel"), TraceRange("linreg normal", TraceColor.GREEN):
             result = jax.block_until_ready(
                 linreg_fit_kernel(
-                    x_dev, y_dev,
+                    x_dev, y_dev, w_dev,
                     reg_param=float(self.getRegParam()),
                     fit_intercept=self.getFitIntercept(),
                 )
             )
         return result.coefficients, result.intercept
 
-    def _fit_host(self, x, y, timer):
+    def _fit_host(self, x, y, timer, weights=None):
         with timer.phase("fit_kernel"), TraceRange("linreg host", TraceColor.ORANGE):
-            n = x.shape[0]
+            w = np.ones(x.shape[0]) if weights is None else np.asarray(weights)
+            n = w.sum()
             lam = float(self.getRegParam())
+            xw = x * w[:, None]
             if self.getFitIntercept():
-                mu_x, mu_y = x.mean(axis=0), y.mean()
-                a = x.T @ x / n - np.outer(mu_x, mu_x)
-                b = x.T @ y / n - mu_x * mu_y
+                mu_x, mu_y = xw.sum(axis=0) / n, (w * y).sum() / n
+                a = x.T @ xw / n - np.outer(mu_x, mu_x)
+                b = xw.T @ y / n - mu_x * mu_y
             else:
-                a = x.T @ x / n
-                b = x.T @ y / n
+                a = x.T @ xw / n
+                b = xw.T @ y / n
             coef = np.linalg.solve(a + lam * np.eye(x.shape[1]), b)
-            intercept = (y.mean() - x.mean(axis=0) @ coef) if self.getFitIntercept() else 0.0
+            intercept = (
+                (w * y).sum() / n - (xw.sum(axis=0) / n) @ coef
+                if self.getFitIntercept()
+                else 0.0
+            )
         return coef, intercept
+
+
+def _extract_weights(est, frame, n_rows):
+    """weightCol → validated float64 vector (None when unset)."""
+    col = est.getWeightCol()
+    if not col:
+        return None
+    w = np.asarray(frame.column(col), dtype=np.float64).reshape(-1)
+    if w.shape[0] != n_rows:
+        raise ValueError(f"weight column length {w.shape[0]} != rows {n_rows}")
+    if not np.isfinite(w).all() or (w < 0).any():
+        raise ValueError("weights must be finite and non-negative")
+    return w
 
 
 def _zip_xy(chunk) -> np.ndarray:

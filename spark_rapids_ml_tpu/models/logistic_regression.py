@@ -25,6 +25,14 @@ from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 class LogisticRegressionParams(HasInputCol, HasDeviceId):
     labelCol = Param("labelCol", "label column name (binary 0/1)", "label")
+    weightCol = Param(
+        "weightCol",
+        "per-row sample-weight column ('' = unweighted). Supported on "
+        "in-memory fits; streamed/out-of-core inputs with weights are "
+        "not supported yet.",
+        "",
+        validator=lambda v: isinstance(v, str),
+    )
     predictionCol = Param("predictionCol", "predicted class column",
                           "prediction")
     probabilityCol = Param("probabilityCol", "P(y=1) output column",
@@ -70,6 +78,11 @@ class LogisticRegression(LogisticRegressionParams):
 
         source = _streaming_xy_source(dataset, labels)
         if source is not None:
+            if self.getWeightCol():
+                raise ValueError(
+                    "weightCol is not supported with streamed/out-of-core "
+                    "input yet; fit in-memory or drop the weights"
+                )
             coef, intercept, n_iter = self._fit_streamed(source, timer)
         else:
             frame = as_vector_frame(dataset, self.getInputCol())
@@ -85,10 +98,15 @@ class LogisticRegression(LogisticRegressionParams):
                     f"labels length {y.shape[0]} != rows {x.shape[0]}"
                 )
             _check_binary(y)
+            from spark_rapids_ml_tpu.models.linear_regression import (
+                _extract_weights,
+            )
+
+            weights = _extract_weights(self, frame, x.shape[0])
             if self.getUseXlaDot():
-                coef, intercept, n_iter = self._fit_xla(x, y, timer)
+                coef, intercept, n_iter = self._fit_xla(x, y, timer, weights)
             else:
-                coef, intercept, n_iter = self._fit_host(x, y, timer)
+                coef, intercept, n_iter = self._fit_host(x, y, timer, weights)
         model = LogisticRegressionModel(
             coefficients=np.asarray(coef, dtype=np.float64),
             intercept=float(intercept),
@@ -99,7 +117,7 @@ class LogisticRegression(LogisticRegressionParams):
         model.fit_timings_ = timer.as_dict()
         return model
 
-    def _fit_xla(self, x, y, timer):
+    def _fit_xla(self, x, y, timer, weights=None):
         import jax
         import jax.numpy as jnp
 
@@ -110,10 +128,17 @@ class LogisticRegression(LogisticRegressionParams):
         with timer.phase("h2d"):
             x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
             y_dev = jax.device_put(jnp.asarray(y, dtype=dtype), device)
+            # the kernel's mask multiplies residual, IRLS weights, and the
+            # count — exactly the weighted MLE (Spark's weightCol)
+            w_dev = (
+                None
+                if weights is None
+                else jax.device_put(jnp.asarray(weights, dtype=dtype), device)
+            )
         with timer.phase("fit_kernel"), TraceRange("logreg newton", TraceColor.GREEN):
             result = jax.block_until_ready(
                 logreg_fit_kernel(
-                    x_dev, y_dev,
+                    x_dev, y_dev, w_dev,
                     reg_param=float(self.getRegParam()),
                     fit_intercept=self.getFitIntercept(),
                     max_iter=self.getMaxIter(),
@@ -122,13 +147,13 @@ class LogisticRegression(LogisticRegressionParams):
             )
         return result.coefficients, result.intercept, result.n_iter
 
-    def _fit_host(self, x, y, timer):
+    def _fit_host(self, x, y, timer, weights=None):
         """NumPy Newton-IRLS, same objective and update rule."""
         with timer.phase("fit_kernel"), TraceRange("logreg host", TraceColor.ORANGE):
             coef, intercept, n_iter = _host_newton(
                 lambda w, b: _full_grad_hess(
                     x, y, w, b, float(self.getRegParam()),
-                    self.getFitIntercept(),
+                    self.getFitIntercept(), weights,
                 ),
                 x.shape[1],
                 self.getMaxIter(),
@@ -243,15 +268,19 @@ def _check_binary(y: np.ndarray) -> None:
         )
 
 
-def _full_grad_hess(x, y, w, b, lam, fit_intercept):
+def _full_grad_hess(x, y, w, b, lam, fit_intercept, weights=None):
     z = x @ w + b
     p = 1.0 / (1.0 + np.exp(-z))
     r = p - y
     s = p * (1.0 - p)
+    if weights is not None:
+        r = r * weights
+        s = s * weights
     gx = x.T @ r
     hxx = x.T @ (x * s[:, None])
+    cnt = float(len(y)) if weights is None else float(np.sum(weights))
     return _assemble_newton(
-        gx, hxx, x.T @ s, float(r.sum()), float(s.sum()), float(len(y)),
+        gx, hxx, x.T @ s, float(r.sum()), float(s.sum()), cnt,
         w, lam, fit_intercept,
     )
 

@@ -94,3 +94,70 @@ def test_distributed_matches_single_device(rng):
         np.asarray(res.coefficients), single.coefficients, atol=1e-8
     )
     assert float(res.intercept) == pytest.approx(single.intercept, abs=1e-8)
+
+
+def test_weight_col_equals_row_duplication(rng):
+    """weight w=2 on a row ≡ that row appearing twice — the defining
+    property of Spark's weightCol — on both device and host paths."""
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    x = rng.normal(size=(120, 4))
+    y = x @ np.array([1.0, -2.0, 0.5, 3.0]) + 0.3 + 0.05 * rng.normal(size=120)
+    w = rng.integers(1, 4, size=120).astype(np.float64)
+    # expanded dataset: row i repeated w[i] times
+    reps = np.repeat(np.arange(120), w.astype(int))
+    for use_xla in (True, False):
+        weighted = (
+            LinearRegression()
+            .setUseXlaDot(use_xla)
+            .setWeightCol("w")
+            .fit(VectorFrame({"features": x, "label": y, "w": w}))
+        )
+        expanded = (
+            LinearRegression()
+            .setUseXlaDot(use_xla)
+            .fit(VectorFrame({"features": x[reps], "label": y[reps]}))
+        )
+        np.testing.assert_allclose(
+            weighted.coefficients, expanded.coefficients, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            weighted.intercept, expanded.intercept, atol=1e-5
+        )
+
+
+def test_weight_col_matches_sklearn(rng):
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    x = rng.normal(size=(200, 3))
+    y = x @ np.array([2.0, -1.0, 0.5]) + 1.0 + 0.1 * rng.normal(size=200)
+    w = rng.uniform(0.1, 5.0, size=200)
+    ours = (
+        LinearRegression()
+        .setRegParam(0.0)
+        .setWeightCol("w")
+        .fit(VectorFrame({"features": x, "label": y, "w": w}))
+    )
+    sk = SkLR().fit(x, y, sample_weight=w)
+    np.testing.assert_allclose(ours.coefficients, sk.coef_, atol=1e-6)
+    np.testing.assert_allclose(ours.intercept, sk.intercept_, atol=1e-6)
+
+
+def test_weight_col_validation(rng):
+    import pytest
+
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    x = rng.normal(size=(50, 2))
+    y = x[:, 0]
+    frame = VectorFrame({"features": x, "label": y, "w": -np.ones(50)})
+    with pytest.raises(ValueError, match="non-negative"):
+        LinearRegression().setWeightCol("w").fit(frame)
+
+    def chunks():
+        yield (x, y)
+
+    with pytest.raises(ValueError, match="streamed"):
+        LinearRegression().setWeightCol("w").fit(chunks)

@@ -137,3 +137,35 @@ def test_logreg_label_validation(rng):
     y = rng.integers(0, 3, size=50).astype(float)  # has label 2
     with pytest.raises(ValueError, match="0/1 labels"):
         LogisticRegression().fit(x, y)
+
+
+def test_weight_col_equals_row_duplication(rng):
+    """Integer weights ≡ row duplication for the weighted MLE, device and
+    host paths."""
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    x = rng.normal(size=(150, 3))
+    p = 1.0 / (1.0 + np.exp(-(x @ np.array([2.0, -1.0, 0.5]))))
+    y = (rng.uniform(size=150) < p).astype(np.float64)
+    w = rng.integers(1, 4, size=150).astype(np.float64)
+    reps = np.repeat(np.arange(150), w.astype(int))
+    for use_xla in (True, False):
+        weighted = (
+            LogisticRegression()
+            .setUseXlaDot(use_xla)
+            .setMaxIter(30)
+            .setWeightCol("w")
+            .fit(VectorFrame({"features": x, "label": y, "w": w}))
+        )
+        expanded = (
+            LogisticRegression()
+            .setUseXlaDot(use_xla)
+            .setMaxIter(30)
+            .fit(VectorFrame({"features": x[reps], "label": y[reps]}))
+        )
+        np.testing.assert_allclose(
+            weighted.coefficients, expanded.coefficients, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            weighted.intercept, expanded.intercept, atol=1e-4
+        )
