@@ -66,13 +66,15 @@ _SPARK_PARAM_ALLOWLIST = {
     "KMeans": {"k", "maxIter", "tol", "seed", "predictionCol"},
     "KMeansModel": {"k", "maxIter", "tol", "seed", "predictionCol"},
     "LinearRegression": {"labelCol", "predictionCol", "fitIntercept",
-                         "regParam"},
+                         "regParam", "elasticNetParam", "weightCol"},
     "LinearRegressionModel": {"labelCol", "predictionCol", "fitIntercept",
-                              "regParam"},
+                              "regParam", "elasticNetParam", "weightCol"},
     "LogisticRegression": {"labelCol", "predictionCol", "probabilityCol",
-                           "maxIter", "tol", "regParam", "fitIntercept"},
+                           "maxIter", "tol", "regParam", "fitIntercept",
+                           "weightCol"},
     "LogisticRegressionModel": {"labelCol", "predictionCol", "probabilityCol",
-                                "maxIter", "tol", "regParam", "fitIntercept"},
+                                "maxIter", "tol", "regParam", "fitIntercept",
+                                "weightCol"},
     "StandardScaler": {"withMean", "withStd", "inputCol", "outputCol"},
     "StandardScalerModel": {"withMean", "withStd", "inputCol", "outputCol"},
 }

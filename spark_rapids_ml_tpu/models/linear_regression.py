@@ -24,6 +24,15 @@ from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 class LinearRegressionParams(HasInputCol, HasDeviceId):
     labelCol = Param("labelCol", "label column name", "label")
+    elasticNetParam = Param(
+        "elasticNetParam",
+        "L1/L2 mix in [0,1]: penalty = regParam*(a*||w||_1 + (1-a)/2*||w||^2). "
+        "0 = pure ridge (closed-form normal equations); >0 solved by FISTA "
+        "on the same sufficient statistics (works on every fit path, "
+        "intercept unpenalized, matching Spark/sklearn conventions)",
+        0.0,
+        validator=lambda v: 0.0 <= float(v) <= 1.0,
+    )
     weightCol = Param(
         "weightCol",
         "per-row sample-weight column ('' = unweighted). Supported on "
@@ -158,27 +167,18 @@ class LinearRegression(LinearRegressionParams):
         if cnt < 1:
             raise ValueError("empty dataset")
         n = nz - 1
-        lam = float(self.getRegParam())
-        gxx, gxy = g[:n, :n], g[:n, n]
-        if self.getFitIntercept():
-            mu = s / cnt
-            mu_x, mu_y = mu[:n], mu[n]
-            a = gxx / cnt - np.outer(mu_x, mu_x)
-            b = gxy / cnt - mu_x * mu_y
-            coef = np.linalg.solve(a + lam * np.eye(n), b)
-            intercept = mu_y - mu_x @ coef
-        else:
-            a = gxx / cnt
-            b = gxy / cnt
-            coef = np.linalg.solve(a + lam * np.eye(n), b)
-            intercept = 0.0
-        return coef, intercept
+        return self._solve_from_raw_moments(
+            g[:n, :n], g[:n, n], s[:n], s[n], cnt
+        )
 
     def _fit_xla(self, x, y, timer, weights=None):
         import jax
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.ops.linreg_kernel import linreg_fit_kernel
+        from spark_rapids_ml_tpu.ops.linreg_kernel import (
+            linreg_fit_kernel,
+            linreg_partial_stats_kernel,
+        )
 
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.getDtype())
@@ -193,6 +193,22 @@ class LinearRegression(LinearRegressionParams):
                 if weights is None
                 else jax.device_put(jnp.asarray(weights, dtype=dtype), device)
             )
+        if float(self.getElasticNetParam()) > 0.0 and float(self.getRegParam()) > 0.0:
+            # L1 has no closed form: the MXU builds the (XᵀWX, XᵀWy)
+            # stats; the tiny d-dimensional FISTA runs on host f64
+            with timer.phase("fit_kernel"), TraceRange(
+                "linreg stats", TraceColor.GREEN
+            ):
+                stats = jax.block_until_ready(
+                    linreg_partial_stats_kernel(x_dev, y_dev, w_dev)
+                )
+            return self._solve_from_raw_moments(
+                np.asarray(stats.xtx, dtype=np.float64),
+                np.asarray(stats.xty, dtype=np.float64),
+                np.asarray(stats.x_sum, dtype=np.float64),
+                float(stats.y_sum),
+                float(stats.count),
+            )
         with timer.phase("fit_kernel"), TraceRange("linreg normal", TraceColor.GREEN):
             result = jax.block_until_ready(
                 linreg_fit_kernel(
@@ -206,23 +222,78 @@ class LinearRegression(LinearRegressionParams):
     def _fit_host(self, x, y, timer, weights=None):
         with timer.phase("fit_kernel"), TraceRange("linreg host", TraceColor.ORANGE):
             w = np.ones(x.shape[0]) if weights is None else np.asarray(weights)
-            n = w.sum()
-            lam = float(self.getRegParam())
             xw = x * w[:, None]
-            if self.getFitIntercept():
-                mu_x, mu_y = xw.sum(axis=0) / n, (w * y).sum() / n
-                a = x.T @ xw / n - np.outer(mu_x, mu_x)
-                b = xw.T @ y / n - mu_x * mu_y
-            else:
-                a = x.T @ xw / n
-                b = xw.T @ y / n
-            coef = np.linalg.solve(a + lam * np.eye(x.shape[1]), b)
-            intercept = (
-                (w * y).sum() / n - (xw.sum(axis=0) / n) @ coef
-                if self.getFitIntercept()
-                else 0.0
+            coef, intercept = self._solve_from_raw_moments(
+                x.T @ xw, xw.T @ y, xw.sum(axis=0), (w * y).sum(), w.sum()
             )
         return coef, intercept
+
+    def _solve_moments(self, a, b):
+        """Centered moments → coefficients: closed-form ridge, or FISTA
+        when elasticNetParam > 0 brings in the L1 term."""
+        lam = float(self.getRegParam())
+        alpha = float(self.getElasticNetParam())
+        if alpha > 0.0 and lam > 0.0:
+            return _elastic_net_solve(a, b, lam, alpha)
+        return np.linalg.solve(a + lam * np.eye(a.shape[0]), b)
+
+    def _solve_from_raw_moments(self, gxx, gxy, x_sum, y_sum, cnt):
+        """Raw (XᵀWX, XᵀWy, Σwx, Σwy, Σw) → (coef, intercept): the ONE
+        center → solve → intercept sequence every fit path funnels into."""
+        a, b, mu_x, mu_y = _centered_moments(
+            gxx, gxy, x_sum, y_sum, cnt, self.getFitIntercept()
+        )
+        coef = self._solve_moments(a, b)
+        intercept = mu_y - mu_x @ coef if self.getFitIntercept() else 0.0
+        return coef, intercept
+
+
+def _elastic_net_solve(a, b, lam, alpha, max_iter=500, tol=1e-8):
+    """FISTA on the centered second moments: min_w  ½wᵀAw − bᵀw
+    + lam·(alpha·‖w‖₁ + (1−alpha)/2·‖w‖²). A is d×d — the iteration is
+    a tiny host loop; the MXU work (building A = XᵀX/n) already happened.
+    """
+    l1 = lam * alpha
+    l2 = lam * (1.0 - alpha)
+    # Lipschitz constant of the smooth part: exact λmax(A) + l2. A is a
+    # tiny d×d host matrix, so eigvalsh is cheap AND safe — a power
+    # iteration seeded with a fixed vector diverges when that vector is
+    # (near-)orthogonal to the top eigenvector (e.g. negative-
+    # equicorrelation Grams, where ones IS the bottom eigenvector).
+    lip = float(np.linalg.eigvalsh(a)[-1]) + l2 + 1e-12
+
+    def grad(w):
+        return a @ w - b + l2 * w
+
+    w = np.zeros(a.shape[0])
+    z = w.copy()
+    t = 1.0
+    for _ in range(max_iter):
+        g = grad(z)
+        w_new = z - g / lip
+        w_new = np.sign(w_new) * np.maximum(np.abs(w_new) - l1 / lip, 0.0)
+        t_new = (1.0 + np.sqrt(1.0 + 4.0 * t * t)) / 2.0
+        z = w_new + ((t - 1.0) / t_new) * (w_new - w)
+        if np.max(np.abs(w_new - w)) <= tol:
+            w = w_new
+            break
+        w, t = w_new, t_new
+    return w
+
+
+def _centered_moments(gxx, gxy, x_sum, y_sum, cnt, fit_intercept):
+    """(A, b, μx, μy) from raw second moments; A/b are the centered
+    (1/n)-scaled normal-equation operands shared by ridge and FISTA."""
+    if fit_intercept:
+        mu_x, mu_y = x_sum / cnt, y_sum / cnt
+        a = gxx / cnt - np.outer(mu_x, mu_x)
+        b = gxy / cnt - mu_x * mu_y
+    else:
+        mu_x = np.zeros(gxx.shape[0])
+        mu_y = 0.0
+        a = gxx / cnt
+        b = gxy / cnt
+    return a, b, mu_x, mu_y
 
 
 def _extract_weights(est, frame, n_rows):

@@ -35,6 +35,12 @@ class LinRegResult(NamedTuple):
     intercept: jnp.ndarray     # scalar
 
 
+@jax.jit
+def linreg_partial_stats_kernel(x, y, mask=None):
+    """Module-level jitted stats builder (stable jit cache across fits)."""
+    return linreg_partial_stats(x, y, mask)
+
+
 def linreg_partial_stats(
     x: jnp.ndarray, y: jnp.ndarray, mask: Optional[jnp.ndarray] = None
 ) -> LinRegStats:
