@@ -49,7 +49,7 @@ def test_dbscan_device_matches_host_path(rng):
 
 
 def test_dbscan_matches_sklearn_structure(rng):
-    from sklearn.cluster import DBSCAN as SkDBSCAN
+    SkDBSCAN = pytest.importorskip("sklearn.cluster").DBSCAN
 
     x = _blobs(rng)
     ours = DBSCAN().setEps(1.5).setMinPts(5).fit(x)

@@ -127,7 +127,7 @@ def test_weight_col_equals_row_duplication(rng):
 
 
 def test_weight_col_matches_sklearn(rng):
-    from sklearn.linear_model import LinearRegression as SkLR
+    SkLR = pytest.importorskip("sklearn.linear_model").LinearRegression
 
     from spark_rapids_ml_tpu.data.frame import VectorFrame
 

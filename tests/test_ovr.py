@@ -1,6 +1,7 @@
 """OneVsRest multiclass reduction vs sklearn's OvR logistic regression."""
 
 import numpy as np
+import pytest
 
 from spark_rapids_ml_tpu import LogisticRegression, OneVsRest
 from spark_rapids_ml_tpu.data.frame import VectorFrame
@@ -32,8 +33,10 @@ def test_ovr_accuracy_and_shapes(rng):
     assert scores.shape == (len(x), 3)
     assert (pred == y).mean() > 0.95
     # matches sklearn's one-vs-rest construction closely
-    from sklearn.linear_model import LogisticRegression as SkLR
-    from sklearn.multiclass import OneVsRestClassifier
+    SkLR = pytest.importorskip("sklearn.linear_model").LogisticRegression
+    OneVsRestClassifier = pytest.importorskip(
+        "sklearn.multiclass"
+    ).OneVsRestClassifier
 
     sk = OneVsRestClassifier(SkLR(C=1e3, max_iter=200)).fit(x, y)
     agree = (pred == sk.predict(x)).mean()

@@ -37,7 +37,7 @@ def test_regression_learns_nonlinear_signal(rng):
 
 
 def test_regression_comparable_to_sklearn(rng):
-    from sklearn.ensemble import RandomForestRegressor as SkRF
+    SkRF = pytest.importorskip("sklearn.ensemble").RandomForestRegressor
 
     n, d = 1000, 5
     x = rng.uniform(-1, 1, size=(n, d))
