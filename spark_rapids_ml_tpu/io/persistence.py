@@ -428,11 +428,47 @@ def save_linreg_model(model, path: str, overwrite: bool = False) -> None:
 
 
 def save_logreg_model(model, path: str, overwrite: bool = False) -> None:
-    if model.coefficients is None:
+    multinomial = getattr(model, "coefficient_matrix", None) is not None
+    if model.coefficients is None and not multinomial:
         raise ValueError("cannot save an unfitted LogisticRegressionModel")
     _require_target(path, overwrite)
     cls = f"{type(model).__module__}.{type(model).__qualname__}"
     _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    if multinomial:
+        # Spark's multinomial layout: coefficientMatrix flattened row-major
+        # into the vector slot + interceptVector/classes alongside
+        k, d = model.coefficient_matrix.shape
+        row = {
+            "coefficients": _dense_vector_struct(
+                model.coefficient_matrix.reshape(-1)
+            ),
+            "intercept": 0.0,
+            "interceptVector": _dense_vector_struct(model.intercept_vector),
+            "classes": _dense_vector_struct(model.classes_),
+            "numClasses": int(k),
+            "numFeatures": int(d),
+        }
+        try:
+            import pyarrow as pa
+
+            schema = pa.schema(
+                [
+                    ("coefficients", _vector_arrow_type()),
+                    ("intercept", pa.float64()),
+                    ("interceptVector", _vector_arrow_type()),
+                    ("classes", _vector_arrow_type()),
+                    ("numClasses", pa.int32()),
+                    ("numFeatures", pa.int32()),
+                ]
+            )
+        except ImportError:  # pragma: no cover
+            schema = None
+        _write_data_row(path, row, schema=schema, spark_fields=[
+            ("coefficients", "vector"), ("intercept", "double"),
+            ("interceptVector", "vector"), ("classes", "vector"),
+            ("numClasses", "integer"), ("numFeatures", "integer"),
+        ])
+        return
     row = {
         "coefficients": _dense_vector_struct(model.coefficients),
         "intercept": float(model.intercept),
@@ -465,6 +501,18 @@ def load_logreg_model(path: str):
 
     meta = _read_metadata(path)
     row = _read_data_row(path)
+    n_classes = int(row.get("numClasses", 2))
+    if n_classes > 2 and row.get("interceptVector") is not None:
+        d = int(row["numFeatures"])
+        model = LogisticRegressionModel(
+            coefficient_matrix=_dense_vector_from_struct(
+                row["coefficients"]
+            ).reshape(n_classes, d),
+            intercept_vector=_dense_vector_from_struct(row["interceptVector"]),
+            classes=_dense_vector_from_struct(row["classes"]),
+            uid=meta["uid"],
+        )
+        return _restore_params(model, meta)
     model = LogisticRegressionModel(
         coefficients=_dense_vector_from_struct(row["coefficients"]),
         intercept=float(row["intercept"]),

@@ -97,12 +97,29 @@ class LogisticRegression(LogisticRegressionParams):
                 raise ValueError(
                     f"labels length {y.shape[0]} != rows {x.shape[0]}"
                 )
-            _check_binary(y)
             from spark_rapids_ml_tpu.models.linear_regression import (
                 _extract_weights,
             )
 
             weights = _extract_weights(self, frame, x.shape[0])
+            if not np.isfinite(y).all():
+                raise ValueError("labels must be finite")
+            classes = np.unique(y)
+            if classes.size > 2:
+                # Spark's family="auto": more than two classes selects the
+                # multinomial (softmax) objective. A cap guards against a
+                # continuous target passed by mistake (the Newton system
+                # is (K·(d+1))² — unbounded K would OOM, not error).
+                if classes.size > 100:
+                    raise ValueError(
+                        f"{classes.size} distinct label values: looks like "
+                        "a continuous target, not classes (multinomial "
+                        "supports up to 100)"
+                    )
+                return self._fit_multinomial(
+                    x, y, classes, weights, timer
+                )
+            _check_binary(y)
             if self.getUseXlaDot():
                 coef, intercept, n_iter = self._fit_xla(x, y, timer, weights)
             else:
@@ -114,6 +131,63 @@ class LogisticRegression(LogisticRegressionParams):
         model.uid = self.uid
         model.copy_values_from(self)
         model.n_iter_ = int(n_iter)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def _fit_multinomial(self, x, y, classes, weights, timer):
+        """Softmax family (Spark auto-selects it for >2 classes): full
+        Newton on the K·(d+1) system, K² small MXU Grams per iteration
+        (``ops.logreg_kernel.multinomial_fit_kernel``)."""
+        if not self.getUseXlaDot():
+            raise ValueError(
+                "multinomial (>2 classes) LogisticRegression runs on the "
+                "XLA path only; set useXlaDot=True or use OneVsRest for a "
+                "host-only multiclass reduction"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.logreg_kernel import (
+            multinomial_fit_kernel,
+        )
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        y_idx = np.searchsorted(classes, y)
+        y_oh = np.eye(classes.size)[y_idx]
+        with timer.phase("h2d"):
+            x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+            yoh_dev = jax.device_put(jnp.asarray(y_oh, dtype=dtype), device)
+            w_dev = (
+                None
+                if weights is None
+                else jax.device_put(jnp.asarray(weights, dtype=dtype), device)
+            )
+        with timer.phase("fit_kernel"), TraceRange(
+            "logreg softmax", TraceColor.GREEN
+        ):
+            result = jax.block_until_ready(
+                multinomial_fit_kernel(
+                    x_dev, yoh_dev, w_dev,
+                    reg_param=float(self.getRegParam()),
+                    fit_intercept=self.getFitIntercept(),
+                    max_iter=self.getMaxIter(),
+                    tol=float(self.getTol()),
+                    n_classes=int(classes.size),
+                )
+            )
+        model = LogisticRegressionModel(
+            coefficient_matrix=np.asarray(
+                result.coefficients, dtype=np.float64
+            ),
+            intercept_vector=np.asarray(
+                result.intercepts, dtype=np.float64
+            ),
+            classes=classes.astype(np.float64),
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.n_iter_ = int(result.n_iter)
         model.fit_timings_ = timer.as_dict()
         return model
 
@@ -320,20 +394,48 @@ def _host_newton(grad_hess, n, max_iter, tol, fit_intercept):
 
 
 class LogisticRegressionModel(LogisticRegressionParams):
+    """Binary fits populate ``coefficients``/``intercept`` (Spark's
+    binary-only accessors); multinomial fits populate
+    ``coefficient_matrix`` (K, d) / ``intercept_vector`` (K,) /
+    ``classes_`` — mirroring Spark's coefficientMatrix/interceptVector."""
+
     def __init__(self, coefficients: Optional[np.ndarray] = None,
-                 intercept: float = 0.0, uid: Optional[str] = None):
+                 intercept: float = 0.0, uid: Optional[str] = None,
+                 coefficient_matrix: Optional[np.ndarray] = None,
+                 intercept_vector: Optional[np.ndarray] = None,
+                 classes: Optional[np.ndarray] = None):
         super().__init__(uid=uid)
         self.coefficients = coefficients
         self.intercept = intercept
+        self.coefficient_matrix = coefficient_matrix
+        self.intercept_vector = intercept_vector
+        self.classes_ = classes
         self.n_iter_ = None
         self.fit_timings_ = {}
+
+    @property
+    def num_classes(self) -> int:
+        if self.coefficient_matrix is not None:
+            return int(self.coefficient_matrix.shape[0])
+        return 2
 
     def _copy_internal_state(self, other: "LogisticRegressionModel") -> None:
         other.coefficients = self.coefficients
         other.intercept = self.intercept
+        other.coefficient_matrix = self.coefficient_matrix
+        other.intercept_vector = self.intercept_vector
+        other.classes_ = self.classes_
         other.n_iter_ = self.n_iter_
 
     def predict_proba(self, dataset) -> np.ndarray:
+        """Binary: (n,) P(y=1). Multinomial: (n, K) softmax rows."""
+        if self.coefficient_matrix is not None:
+            frame = as_vector_frame(dataset, self.getInputCol())
+            x = frame.vectors_as_matrix(self.getInputCol())
+            z = x @ self.coefficient_matrix.T + self.intercept_vector[None, :]
+            z = z - z.max(axis=1, keepdims=True)
+            e = np.exp(z)
+            return e / e.sum(axis=1, keepdims=True)
         if self.coefficients is None:
             raise ValueError("model has no coefficients; fit first or load")
         frame = as_vector_frame(dataset, self.getInputCol())
@@ -364,19 +466,36 @@ class LogisticRegressionModel(LogisticRegressionParams):
         frame = as_vector_frame(dataset, self.getInputCol())
         proba = self.predict_proba(frame)  # reuse the built frame
         out = frame.with_column(self.getProbabilityCol(), proba.tolist())
+        if self.coefficient_matrix is not None:
+            pred = self.classes_[np.argmax(proba, axis=1)]
+            return out.with_column(
+                self.getPredictionCol(), pred.astype(np.float64).tolist()
+            )
         return out.with_column(
             self.getPredictionCol(),
             (proba >= 0.5).astype(np.int32).tolist(),
         )
 
     def evaluate(self, dataset, labels=None) -> dict:
-        """Accuracy / log-loss summary."""
+        """Accuracy / log-loss summary (binary or multinomial)."""
         frame = as_vector_frame(dataset, self.getInputCol())
         if labels is not None:
             y = np.asarray(labels, dtype=np.float64).reshape(-1)
         else:
             y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
         p = np.clip(self.predict_proba(dataset), 1e-12, 1 - 1e-12)
+        if self.coefficient_matrix is not None:
+            y_idx = np.searchsorted(self.classes_, y)
+            if not (
+                (y_idx < self.classes_.size)
+                & (self.classes_[np.minimum(y_idx, self.classes_.size - 1)] == y)
+            ).all():
+                raise ValueError("labels contain values outside classes_")
+            acc = float((np.argmax(p, axis=1) == y_idx).mean())
+            logloss = float(
+                -np.log(p[np.arange(len(y_idx)), y_idx]).mean()
+            )
+            return {"accuracy": acc, "logLoss": logloss}
         acc = float(((p >= 0.5) == (y >= 0.5)).mean())
         logloss = float(-(y * np.log(p) + (1 - y) * np.log(1 - p)).mean())
         return {"accuracy": acc, "logLoss": logloss}

@@ -163,3 +163,142 @@ def logreg_predict_kernel(x, coefficients, intercept):
     """Class probabilities σ(X·w + b) — one batched MXU matmul (the
     enabled-batch-transform posture shared with PCAModel.transform)."""
     return jax.nn.sigmoid(x @ coefficients + intercept)
+
+
+# -- multinomial (softmax) family ------------------------------------------
+# Spark's LogisticRegression auto-selects multinomial when the label has
+# more than two classes. Parameterization matches Spark/sklearn: one
+# coefficient row per class (over-parameterized "symmetric" softmax, made
+# identifiable by the L2 term), objective
+#   (1/Σw)·Σᵢ wᵢ·CE(softmax(Wxᵢ+b), yᵢ) + (λ/2)·‖W‖²  (intercepts free).
+# Full Newton on the (K·(d+1)) system: the Hessian's (k,l) feature block
+# is Xᵀ diag(wᵢ·(pₖδ(k=l) − pₖpₗ)) X — K² small MXU Grams per iteration,
+# fine for the K ≲ tens regime this targets.
+
+
+class MultinomialResult(NamedTuple):
+    coefficients: jnp.ndarray  # (K, n_features)
+    intercepts: jnp.ndarray    # (K,)
+    n_iter: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def _softmax_grad_hess(wb, x, y_oh, valid, reg_param, fit_intercept):
+    n_feat = x.shape[1]
+    k = y_oh.shape[1]
+    w = wb[:, :n_feat]          # (K, d)
+    b = wb[:, n_feat]           # (K,)
+    z = x @ w.T + b[None, :]
+    p = jax.nn.softmax(z, axis=1)
+    cnt = jnp.maximum(jnp.sum(valid), 1.0)
+    r = (p - y_oh) * valid[:, None]          # (n, K)
+    gx = lax.dot_general(                     # (K, d): rᵀX
+        r, x, (((0,), (0,)), ((), ())), precision=lax.Precision.HIGHEST
+    ) / cnt
+    gb = jnp.sum(r, axis=0) / cnt
+    g = jnp.concatenate([gx + reg_param * w, gb[:, None]], axis=1)
+    if not fit_intercept:
+        g = g.at[:, n_feat].set(0.0)
+
+    # Hessian blocks over the augmented feature vector x̃ = [x, 1]
+    ones = jnp.ones((x.shape[0], 1), dtype=x.dtype)
+    xa = jnp.concatenate([x, ones], axis=1)   # (n, d+1)
+
+    def block(kl):
+        kk, ll = kl // k, kl % k
+        s = p[:, kk] * ((kk == ll) * 1.0 - p[:, ll]) * valid
+        return lax.dot_general(
+            xa * s[:, None], xa, (((0,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+        ) / cnt
+
+    blocks = jax.vmap(block)(jnp.arange(k * k))  # (K², d+1, d+1)
+    h = blocks.reshape(k, k, n_feat + 1, n_feat + 1)
+    h = jnp.transpose(h, (0, 2, 1, 3)).reshape(
+        k * (n_feat + 1), k * (n_feat + 1)
+    )
+    dim = n_feat + 1
+    if not fit_intercept:
+        # Pin the intercept slots COMPLETELY: zero their rows and columns,
+        # identity diagonal. Zeroing only the gradient would still let
+        # Newton steps couple features to implicit intercepts through the
+        # off-diagonal Hessian blocks and silently train the wrong model.
+        keep = jnp.tile(
+            jnp.concatenate([
+                jnp.ones((n_feat,), dtype=x.dtype),
+                jnp.zeros((1,), dtype=x.dtype),
+            ]),
+            k,
+        )
+        h = h * keep[:, None] * keep[None, :]
+
+    # L2 on coefficients. The softmax parameterization is invariant under
+    # a uniform shift of all K (unpenalized) intercepts — an EXACT null
+    # direction for any reg_param — and at reg_param=0 the class-shifted
+    # coefficient direction joins it. Pin the gauge with a dtype-scaled
+    # ridge (sqrt(eps) × the Hessian's diagonal scale): predictions are
+    # invariant to the gauge, and the ridge is far above float32 rounding
+    # (a fixed 1e-8 underflows into H in f32 and leaves the system
+    # exactly singular).
+    eps_ridge = jnp.sqrt(jnp.finfo(x.dtype).eps).astype(x.dtype) * (
+        jnp.maximum(jnp.mean(jnp.diagonal(h)), 1.0)
+    )
+    reg_diag = jnp.tile(
+        jnp.concatenate([
+            jnp.full((n_feat,), reg_param, dtype=x.dtype),
+            jnp.asarray([0.0 if fit_intercept else 1.0], dtype=x.dtype),
+        ]),
+        k,
+    )
+    h = h + jnp.diag(reg_diag) + eps_ridge * jnp.eye(
+        k * dim, dtype=x.dtype
+    )
+    return g, h
+
+
+@partial(
+    jax.jit,
+    static_argnames=("fit_intercept", "max_iter", "n_classes"),
+)
+def multinomial_fit_kernel(
+    x: jnp.ndarray,
+    y_onehot: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 25,
+    tol: float = 1e-6,
+    n_classes: int = 2,
+) -> MultinomialResult:
+    dtype = x.dtype
+    n_feat = x.shape[1]
+    valid = (
+        jnp.ones(x.shape[0], dtype=dtype) if mask is None
+        else mask.astype(dtype)
+    )
+    wb0 = jnp.zeros((n_classes, n_feat + 1), dtype=dtype)
+
+    def cond(state):
+        wb, i, delta = state
+        return jnp.logical_and(i < max_iter, delta > tol)
+
+    def body(state):
+        wb, i, _ = state
+        g, h = _softmax_grad_hess(
+            wb, x, y_onehot, valid, reg_param, fit_intercept
+        )
+        step = jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(h), g.reshape(-1)
+        ).reshape(n_classes, n_feat + 1)
+        wb = wb - step
+        return wb, i + 1, jnp.max(jnp.abs(step))
+
+    wb, n_iter, delta = lax.while_loop(
+        cond, body, (wb0, jnp.asarray(0), jnp.asarray(jnp.inf, dtype))
+    )
+    return MultinomialResult(
+        coefficients=wb[:, :n_feat],
+        intercepts=wb[:, n_feat] * (1.0 if fit_intercept else 0.0),
+        n_iter=n_iter,
+        converged=delta <= tol,
+    )

@@ -133,10 +133,80 @@ def test_logreg_persistence(data, tmp_path):
 
 
 def test_logreg_label_validation(rng):
+    # exactly two classes must be the Spark 0/1 encoding
     x = rng.normal(size=(50, 3))
-    y = rng.integers(0, 3, size=50).astype(float)  # has label 2
+    y = rng.integers(0, 2, size=50).astype(float) + 0.3  # {0.3, 1.3}
     with pytest.raises(ValueError, match="0/1 labels"):
         LogisticRegression().fit(x, y)
+
+
+def test_multinomial_matches_sklearn(rng):
+    """>2 classes auto-selects the softmax family (Spark family='auto');
+    coefficients match sklearn's multinomial solver."""
+    sklin = pytest.importorskip("sklearn.linear_model")
+
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    n, d, k = 600, 4, 3
+    centers = rng.normal(scale=2, size=(k, d))
+    x = np.concatenate([rng.normal(loc=c, size=(n // k, d)) for c in centers])
+    y = np.repeat(np.arange(k, dtype=np.float64), n // k)
+    lam = 0.1
+    model = (
+        LogisticRegression()
+        .setRegParam(lam)
+        .setMaxIter(50)
+        .fit(VectorFrame({"features": x, "label": y}))
+    )
+    assert model.num_classes == 3
+    sk = sklin.LogisticRegression(
+        C=1.0 / (n * lam), max_iter=2000, tol=1e-12
+    ).fit(x, y)
+    np.testing.assert_allclose(
+        model.coefficient_matrix, sk.coef_, atol=5e-4
+    )
+    np.testing.assert_allclose(model.intercept_vector, sk.intercept_, atol=5e-4)
+    # transform: probability vectors + argmax predictions
+    out = model.transform(VectorFrame({"features": x}))
+    proba = np.asarray(out.column("probability"))
+    pred = np.asarray(out.column("prediction"))
+    assert proba.shape == (n, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    assert (pred == sk.predict(x)).mean() > 0.99
+
+
+def test_multinomial_nonconsecutive_labels_and_weights(rng):
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    n = 300
+    x = np.concatenate(
+        [rng.normal(loc=c, size=(n // 3, 2)) for c in (0.0, 4.0, 8.0)]
+    )
+    y = np.repeat([5.0, 17.0, 42.0], n // 3)  # arbitrary class values
+    w = rng.integers(1, 3, size=n).astype(np.float64)
+    model = (
+        LogisticRegression()
+        .setRegParam(1e-3)
+        .setMaxIter(40)
+        .setWeightCol("w")
+        .fit(VectorFrame({"features": x, "label": y, "w": w}))
+    )
+    pred = np.asarray(
+        model.transform(VectorFrame({"features": x})).column("prediction")
+    )
+    assert set(np.unique(pred)) <= {5.0, 17.0, 42.0}
+    assert (pred == y).mean() > 0.95
+    # integer weights == duplication, multinomial edition
+    reps = np.repeat(np.arange(n), w.astype(int))
+    expanded = (
+        LogisticRegression()
+        .setRegParam(1e-3)
+        .setMaxIter(40)
+        .fit(VectorFrame({"features": x[reps], "label": y[reps]}))
+    )
+    np.testing.assert_allclose(
+        model.coefficient_matrix, expanded.coefficient_matrix, atol=1e-3
+    )
 
 
 def test_weight_col_equals_row_duplication(rng):
@@ -168,4 +238,90 @@ def test_weight_col_equals_row_duplication(rng):
         )
         np.testing.assert_allclose(
             weighted.intercept, expanded.intercept, atol=1e-4
+        )
+
+
+def test_multinomial_persistence_roundtrip(rng, tmp_path):
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    n = 240
+    x = np.concatenate(
+        [rng.normal(loc=c, size=(n // 3, 3)) for c in (0.0, 3.0, 6.0)]
+    )
+    y = np.repeat([0.0, 1.0, 2.0], n // 3)
+    model = (
+        LogisticRegression().setRegParam(0.01).setMaxIter(30)
+        .fit(VectorFrame({"features": x, "label": y}))
+    )
+    path = str(tmp_path / "mnlr")
+    model.save(path)
+    loaded = LogisticRegressionModel.load(path)
+    np.testing.assert_allclose(
+        loaded.coefficient_matrix, model.coefficient_matrix, atol=1e-10
+    )
+    np.testing.assert_allclose(
+        loaded.intercept_vector, model.intercept_vector, atol=1e-10
+    )
+    np.testing.assert_array_equal(loaded.classes_, model.classes_)
+    p1 = np.asarray(
+        model.transform(VectorFrame({"features": x})).column("prediction")
+    )
+    p2 = np.asarray(
+        loaded.transform(VectorFrame({"features": x})).column("prediction")
+    )
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_multinomial_no_intercept_matches_sklearn(rng):
+    """fit_intercept=False must train the intercept-FREE optimum (the
+    Hessian's intercept rows/columns are fully pinned, not just the
+    gradient)."""
+    sklin = pytest.importorskip("sklearn.linear_model")
+
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    n = 450
+    # non-centered data: implicit intercepts would visibly distort coefs
+    x = np.concatenate(
+        [rng.normal(loc=c, size=(n // 3, 3)) for c in (1.0, 3.0, 5.0)]
+    )
+    y = np.repeat([0.0, 1.0, 2.0], n // 3)
+    lam = 0.05
+    model = (
+        LogisticRegression()
+        .setRegParam(lam)
+        .setFitIntercept(False)
+        .setMaxIter(60)
+        .fit(VectorFrame({"features": x, "label": y}))
+    )
+    np.testing.assert_array_equal(model.intercept_vector, 0.0)
+    sk = sklin.LogisticRegression(
+        C=1.0 / (n * lam), fit_intercept=False, max_iter=3000, tol=1e-12
+    ).fit(x, y)
+    np.testing.assert_allclose(model.coefficient_matrix, sk.coef_, atol=1e-3)
+
+
+def test_multinomial_evaluate_and_label_guards(rng):
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    n = 240
+    x = np.concatenate(
+        [rng.normal(loc=c, size=(n // 3, 2)) for c in (0.0, 4.0, 8.0)]
+    )
+    y = np.repeat([0.0, 1.0, 2.0], n // 3)
+    model = (
+        LogisticRegression().setRegParam(0.01).setMaxIter(30)
+        .fit(VectorFrame({"features": x, "label": y}))
+    )
+    summary = model.evaluate(VectorFrame({"features": x, "label": y}))
+    assert summary["accuracy"] > 0.95
+    assert 0.0 < summary["logLoss"] < 0.5
+    # NaN labels refuse to train
+    y_bad = y.copy(); y_bad[0] = np.nan
+    with pytest.raises(ValueError, match="finite"):
+        LogisticRegression().fit(VectorFrame({"features": x, "label": y_bad}))
+    # continuous target refuses with a clear message
+    with pytest.raises(ValueError, match="continuous"):
+        LogisticRegression().fit(
+            VectorFrame({"features": x, "label": rng.normal(size=n)})
         )
