@@ -42,6 +42,12 @@ from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel  # noqa: F401
 from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel  # noqa: F401
+from spark_rapids_ml_tpu.models.gbt import (  # noqa: F401
+    GBTClassificationModel,
+    GBTClassifier,
+    GBTRegressionModel,
+    GBTRegressor,
+)
 from spark_rapids_ml_tpu.models.random_forest import (  # noqa: F401
     RandomForestClassificationModel,
     RandomForestClassifier,
@@ -78,6 +84,10 @@ __all__ = [
     "LogisticRegression",
     "LogisticRegressionModel",
     "OneVsRest",
+    "GBTClassifier",
+    "GBTClassificationModel",
+    "GBTRegressor",
+    "GBTRegressionModel",
     "RandomForestClassifier",
     "RandomForestClassificationModel",
     "RandomForestRegressor",

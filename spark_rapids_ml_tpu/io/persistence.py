@@ -738,3 +738,75 @@ def load_forest_model(path: str):
     )
     model.uid = meta["uid"]
     return _restore_params(model, meta)
+
+
+def save_gbt_model(model, path: str, overwrite: bool = False) -> None:
+    """GBT models: the boosted TreeEnsemble plus the additive-model scalars
+    (init, stepSize) — same DenseMatrix wire structs as the forest."""
+    if model.ensemble_ is None:
+        raise ValueError("cannot save an unfitted GBT model")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "feature": _dense_matrix_struct(
+            np.asarray(model.ensemble_.feature, dtype=np.float64)
+        ),
+        "threshold": _dense_matrix_struct(
+            np.asarray(model.ensemble_.threshold, dtype=np.float64)
+        ),
+        "leafValue": _dense_matrix_struct(
+            np.asarray(model.ensemble_.leaf_value, dtype=np.float64)
+        ),
+        "edges": _dense_matrix_struct(
+            np.asarray(model.edges_, dtype=np.float64)
+        ),
+        "init": float(model.init_),
+        "stepSize": float(model.step_size_),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("feature", _matrix_arrow_type()),
+                ("threshold", _matrix_arrow_type()),
+                ("leafValue", _matrix_arrow_type()),
+                ("edges", _matrix_arrow_type()),
+                ("init", pa.float64()),
+                ("stepSize", pa.float64()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("feature", "matrix"), ("threshold", "matrix"),
+        ("leafValue", "matrix"), ("edges", "matrix"),
+        ("init", "double"), ("stepSize", "double"),
+    ])
+
+
+def load_gbt_model(path: str):
+    import importlib
+
+    from spark_rapids_ml_tpu.ops.forest_kernel import TreeEnsemble
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    dotted = meta.get("pythonClass") or meta["class"]
+    module_name, cls_name = dotted.rsplit(".", 1)
+    model_cls = getattr(importlib.import_module(module_name), cls_name)
+    model = model_cls(
+        ensemble=TreeEnsemble(
+            feature=_dense_matrix_from_struct(row["feature"]).astype(np.int32),
+            threshold=_dense_matrix_from_struct(row["threshold"]).astype(
+                np.int32
+            ),
+            leaf_value=_dense_matrix_from_struct(row["leafValue"]),
+        ),
+        edges=_dense_matrix_from_struct(row["edges"]),
+        init=float(row["init"]),
+        step_size=float(row["stepSize"]),
+    )
+    model.uid = meta["uid"]
+    return _restore_params(model, meta)

@@ -15,6 +15,12 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel
 from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
+from spark_rapids_ml_tpu.models.gbt import (
+    GBTClassificationModel,
+    GBTClassifier,
+    GBTRegressionModel,
+    GBTRegressor,
+)
 from spark_rapids_ml_tpu.models.random_forest import (
     RandomForestClassificationModel,
     RandomForestClassifier,
@@ -48,6 +54,10 @@ __all__ = [
     "NearestNeighbors",
     "NearestNeighborsModel",
     "OneVsRest",
+    "GBTClassifier",
+    "GBTClassificationModel",
+    "GBTRegressor",
+    "GBTRegressionModel",
     "RandomForestClassifier",
     "RandomForestClassificationModel",
     "RandomForestRegressor",

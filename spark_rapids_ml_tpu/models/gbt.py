@@ -1,0 +1,317 @@
+"""GBT Regressor / Classifier — gradient-boosted histogram trees.
+
+Spark ML core ships ``GBTRegressor``/``GBTClassifier`` (param names here:
+maxIter, stepSize, maxDepth, maxBins, minInstancesPerNode,
+subsamplingRate, seed — the Spark surface). Boosting reuses the
+level-synchronous histogram grower (``ops/forest_kernel.py``) unchanged:
+each round fits one tree to the loss gradient, so the whole fit is
+maxIter × maxDepth dense MXU level steps.
+
+* Regression (squared loss): residual rᵐ = y − Fᵐ; the grower's leaf
+  means ARE the optimal squared-loss leaf values.
+* Binary classification (logistic loss): trees fit the gradient
+  y − σ(F); leaf values are then REFIT with the one-step Newton formula
+  Σr/Σσ(1−σ) per leaf (the standard GBM leaf), using the shared
+  ``route_to_leaves`` kernel — structure from the gradient, values from
+  the curvature.
+
+Deterministic by seed (Poisson subsampling weights, dense reductions).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class GBTParams(HasInputCol, HasDeviceId):
+    labelCol = Param("labelCol", "label column name", "label")
+    predictionCol = Param(
+        "predictionCol", "prediction output column", "prediction"
+    )
+    maxIter = Param(
+        "maxIter", "number of boosting rounds (trees)", 20,
+        validator=lambda v: isinstance(v, int) and v >= 1,
+    )
+    stepSize = Param(
+        "stepSize", "learning rate in (0, 1]", 0.1,
+        validator=lambda v: 0.0 < float(v) <= 1.0,
+    )
+    maxDepth = Param(
+        "maxDepth", "tree depth", 5,
+        validator=lambda v: isinstance(v, int) and 1 <= v <= 12,
+    )
+    maxBins = Param(
+        "maxBins", "feature quantile bins", 32,
+        validator=lambda v: isinstance(v, int) and 2 <= v <= 256,
+    )
+    minInstancesPerNode = Param(
+        "minInstancesPerNode", "minimum samples per child", 1,
+        validator=lambda v: isinstance(v, int) and v >= 1,
+    )
+    subsamplingRate = Param(
+        "subsamplingRate",
+        "per-round Poisson(rate) row weights (stochastic gradient boosting)",
+        1.0,
+        validator=lambda v: 0.0 < float(v) <= 1.0,
+    )
+    seed = Param("seed", "subsampling seed", 0,
+                 validator=lambda v: isinstance(v, int))
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+class _GBTBase(GBTParams):
+    _classification = False
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str):
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(cls, path)
+
+    def fit(self, dataset, labels=None):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.forest_kernel import (
+            TreeEnsemble,
+            grow_tree_regression,
+            quantile_bins,
+        )
+
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+            if labels is not None:
+                y = np.asarray(labels, dtype=np.float64).reshape(-1)
+            else:
+                y = np.asarray(
+                    frame.column(self.getLabelCol()), dtype=np.float64
+                )
+        if y.shape[0] != x.shape[0]:
+            raise ValueError(
+                f"labels length {y.shape[0]} != rows {x.shape[0]}"
+            )
+        if self._classification and not np.isin(y, (0.0, 1.0)).all():
+            raise ValueError("GBTClassifier requires 0/1 labels")
+        n, d = x.shape
+        depth = self.getMaxDepth()
+        n_bins = self.getMaxBins()
+        lr = float(self.getStepSize())
+        rng = np.random.default_rng(self.getSeed())
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+
+        with timer.phase("binning"):
+            binned_np, edges = quantile_bins(x, n_bins)
+        binned = jax.device_put(jnp.asarray(binned_np, jnp.int32), device)
+        full_mask = jnp.asarray(np.ones((depth, d)), dtype=dtype)
+
+        if self._classification:
+            p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
+            init = float(np.log(p0 / (1.0 - p0)))
+        else:
+            init = float(y.mean())
+        f = np.full(n, init)
+
+        rate = float(self.getSubsamplingRate())
+        feats_l, thrs_l, leaves_l = [], [], []
+        with timer.phase("boost"), TraceRange("gbt boost", TraceColor.RED):
+            for _ in range(self.getMaxIter()):
+                if self._classification:
+                    p = 1.0 / (1.0 + np.exp(-f))
+                    r = y - p
+                    hess = np.maximum(p * (1.0 - p), 1e-12)
+                else:
+                    r = y - f
+                    hess = np.ones(n)
+                # Spark semantics: subsamplingRate=1.0 means NO
+                # subsampling (unit weights, deterministic regardless of
+                # seed); below 1.0, Poisson(rate) row weights implement
+                # stochastic gradient boosting
+                w = (
+                    np.ones(n)
+                    if rate >= 1.0
+                    else rng.poisson(rate, n).astype(np.float64)
+                )
+                ft, tt, leaf, leaf_ids_dev = grow_tree_regression(
+                    binned,
+                    jax.device_put(jnp.asarray(r, dtype=dtype), device),
+                    jax.device_put(jnp.asarray(w, dtype=dtype), device),
+                    full_mask,
+                    depth,
+                    n_bins,
+                    self.getMinInstancesPerNode(),
+                    return_leaf_ids=True,
+                )
+                leaf_ids = np.asarray(leaf_ids_dev)
+                if self._classification:
+                    # Newton leaf refit: Σw·r / Σw·h per leaf (the GBM
+                    # logistic-loss leaf); the grower's mean-residual
+                    # leaves are only the squared-loss optimum
+                    n_leaves = 2 ** depth
+                    num = np.bincount(
+                        leaf_ids, weights=w * r, minlength=n_leaves
+                    )
+                    den = np.bincount(
+                        leaf_ids, weights=w * hess, minlength=n_leaves
+                    )
+                    leaf = np.where(den > 0, num / np.maximum(den, 1e-12), 0.0)
+                else:
+                    leaf = np.asarray(leaf)
+                f = f + lr * leaf[leaf_ids]
+                feats_l.append(np.asarray(ft))
+                thrs_l.append(np.asarray(tt))
+                leaves_l.append(leaf)
+        ensemble = TreeEnsemble(
+            feature=np.stack(feats_l),
+            threshold=np.stack(thrs_l),
+            leaf_value=np.stack(leaves_l),
+        )
+        model = self._model_cls()(
+            ensemble=ensemble, edges=edges, init=init, step_size=lr
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def _model_cls(self):
+        raise NotImplementedError
+
+
+class _GBTModelBase(GBTParams):
+    def __init__(self, ensemble=None, edges=None, init=0.0, step_size=0.1):
+        super().__init__()
+        self.ensemble_ = ensemble
+        self.edges_ = edges
+        self.init_ = init
+        self.step_size_ = step_size
+
+    def _copy_internal_state(self, other) -> None:
+        other.ensemble_ = self.ensemble_
+        other.edges_ = self.edges_
+        other.init_ = self.init_
+        other.step_size_ = self.step_size_
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_gbt_model
+
+        save_gbt_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str):
+        from spark_rapids_ml_tpu.io.persistence import load_gbt_model
+
+        return load_gbt_model(path)
+
+    def _raw_score(self, x) -> np.ndarray:
+        """init + stepSize·Σ trees — boosting SUMS tree outputs (the
+        ensemble-mean apply is a forest concept)."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.forest_kernel import (
+            TreeEnsemble,
+            apply_bin_edges,
+            forest_apply,
+        )
+
+        if self.ensemble_ is None:
+            raise ValueError("model has no ensemble; fit first")
+        x = np.asarray(x, dtype=np.float64)
+        if x.shape[1] != self.edges_.shape[0]:
+            raise ValueError(
+                f"query dim {x.shape[1]} != fitted dim {self.edges_.shape[0]}"
+            )
+        binned = apply_bin_edges(x, self.edges_)
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        depth = int(
+            np.asarray(self.ensemble_.feature).shape[1] + 1
+        ).bit_length() - 1
+        ens = TreeEnsemble(
+            feature=jnp.asarray(self.ensemble_.feature, jnp.int32),
+            threshold=jnp.asarray(self.ensemble_.threshold, jnp.int32),
+            leaf_value=jnp.asarray(self.ensemble_.leaf_value, dtype),
+        )
+        mean = np.asarray(
+            forest_apply(
+                jax.device_put(jnp.asarray(binned), device),
+                jax.device_put(ens, device),
+                depth,
+            ),
+            dtype=np.float64,
+        )
+        n_trees = self.ensemble_.feature.shape[0]
+        return self.init_ + self.step_size_ * mean * n_trees
+
+
+class GBTRegressor(_GBTBase):
+    """``GBTRegressor().setMaxIter(50).setStepSize(0.1).fit(df)``."""
+
+    def _model_cls(self):
+        return GBTRegressionModel
+
+
+class GBTRegressionModel(_GBTModelBase):
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        pred = self._raw_score(frame.vectors_as_matrix(self.getInputCol()))
+        return frame.with_column(
+            self.getPredictionCol(), pred.astype(np.float64)
+        )
+
+
+class GBTClassifierParams(GBTParams):
+    """Shared classifier params: declared once so the estimator can set
+    them pre-fit and copy_values_from carries them to the model (the
+    RandomForest review lesson)."""
+
+    probabilityCol = Param(
+        "probabilityCol", "P(y=1) output column", "probability"
+    )
+
+
+class GBTClassifier(GBTClassifierParams, _GBTBase):
+    """Binary logistic-loss boosting:
+    ``GBTClassifier().setMaxIter(50).fit(df)``."""
+
+    _classification = True
+
+    def _model_cls(self):
+        return GBTClassificationModel
+
+
+class GBTClassificationModel(GBTClassifierParams, _GBTModelBase):
+    _classification = True
+
+    def predict_proba(self, dataset) -> np.ndarray:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        z = self._raw_score(frame.vectors_as_matrix(self.getInputCol()))
+        return 1.0 / (1.0 + np.exp(-z))
+
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        proba = self.predict_proba(frame)
+        out = frame.with_column(self.getProbabilityCol(), proba.tolist())
+        # double-typed predictions, matching Spark and the RandomForest
+        # classifier in this repo
+        return out.with_column(
+            self.getPredictionCol(),
+            (proba >= 0.5).astype(np.float64).tolist(),
+        )

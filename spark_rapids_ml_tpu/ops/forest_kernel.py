@@ -163,7 +163,10 @@ def _grow_tree(
 
 
 @partial(
-    jax.jit, static_argnames=("max_depth", "n_bins", "min_leaf", "axis_name")
+    jax.jit,
+    static_argnames=(
+        "max_depth", "n_bins", "min_leaf", "axis_name", "return_leaf_ids"
+    ),
 )
 def grow_tree_regression(
     binned: jnp.ndarray,     # (n, d) int32 bins
@@ -174,8 +177,12 @@ def grow_tree_regression(
     n_bins: int,
     min_leaf: int = 1,
     axis_name=None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One regression tree; returns (feature, threshold, leaf_value).
+    return_leaf_ids: bool = False,
+) -> Tuple[jnp.ndarray, ...]:
+    """One regression tree; returns (feature, threshold, leaf_value)
+    — plus each row's leaf id when ``return_leaf_ids`` (boosting callers
+    need the assignment the grower already computed; re-routing would
+    duplicate a full pass).
 
     Split criterion: weighted variance reduction from the (count, Σy, Σy²)
     channel histograms; gain = SSE(parent) − SSE(left) − SSE(right).
@@ -208,6 +215,8 @@ def grow_tree_regression(
     # empty leaves fall back to the global weighted mean
     gmean = wy_sum / jnp.maximum(w_sum, 1e-12)
     leaf = jnp.where(cnt > 0, tot / jnp.maximum(cnt, 1e-12), gmean)
+    if return_leaf_ids:
+        return feats, thrs, leaf, node - (n_leaves - 1)
     return feats, thrs, leaf
 
 
@@ -264,22 +273,35 @@ def grow_tree_classification(
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
+def route_to_leaves(
+    binned: jnp.ndarray,
+    feature: jnp.ndarray,
+    threshold: jnp.ndarray,
+    max_depth: int,
+) -> jnp.ndarray:
+    """Leaf index (0..2**depth−1) of every row under ONE tree: vectorized
+    gathers per level, no recursion. Shared by ensemble apply and the
+    boosting leaf-refit (GBT Newton leaves)."""
+    node = jnp.zeros((binned.shape[0],), dtype=jnp.int32)
+    for level in range(max_depth):
+        base = 2 ** level - 1
+        f = feature[node]
+        t = threshold[node]
+        x_bin = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
+        go_right = (x_bin > t).astype(jnp.int32)
+        node = (node - base) * 2 + go_right + (2 ** (level + 1) - 1)
+    return node - (2 ** max_depth - 1)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
 def forest_apply(
     binned: jnp.ndarray, ensemble: TreeEnsemble, max_depth: int
 ) -> jnp.ndarray:
-    """Route every row through every tree: vectorized gathers per level,
-    no recursion; leaf values averaged over trees."""
+    """Route every row through every tree; leaf values averaged over
+    trees."""
 
     def one_tree(feature, threshold, leaf_value):
-        node = jnp.zeros((binned.shape[0],), dtype=jnp.int32)
-        for level in range(max_depth):
-            base = 2 ** level - 1
-            f = feature[node]
-            t = threshold[node]
-            x_bin = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
-            go_right = (x_bin > t).astype(jnp.int32)
-            node = (node - base) * 2 + go_right + (2 ** (level + 1) - 1)
-        leaf = node - (2 ** max_depth - 1)
+        leaf = route_to_leaves(binned, feature, threshold, max_depth)
         return leaf_value[leaf]
 
     per_tree = jax.vmap(one_tree)(

@@ -1,0 +1,107 @@
+"""GBT boosting vs sklearn GradientBoosting oracles."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    GBTClassificationModel,
+    GBTClassifier,
+    GBTRegressionModel,
+    GBTRegressor,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def test_gbt_regression_quality_vs_sklearn(rng):
+    SkGBR = pytest.importorskip("sklearn.ensemble").GradientBoostingRegressor
+
+    n, d = 1200, 5
+    x = rng.uniform(-2, 2, size=(n, d))
+    y = np.sin(2 * x[:, 0]) + x[:, 1] * x[:, 2] + 0.05 * rng.normal(size=n)
+    xt = rng.uniform(-2, 2, size=(400, d))
+    yt = np.sin(2 * xt[:, 0]) + xt[:, 1] * xt[:, 2]
+    model = (
+        GBTRegressor().setMaxIter(60).setStepSize(0.2).setMaxDepth(4)
+        .fit(VectorFrame({"features": x, "label": y}))
+    )
+    ours = np.asarray(
+        model.transform(VectorFrame({"features": xt})).column("prediction")
+    )
+    sk = SkGBR(
+        n_estimators=60, learning_rate=0.2, max_depth=4, random_state=0
+    ).fit(x, y)
+    our_mse = ((ours - yt) ** 2).mean()
+    sk_mse = ((sk.predict(xt) - yt) ** 2).mean()
+    assert our_mse < 2.0 * sk_mse + 1e-3, (our_mse, sk_mse)
+
+
+def test_gbt_train_loss_decreases_with_rounds(rng):
+    n = 500
+    x = rng.uniform(-1, 1, size=(n, 3))
+    y = np.abs(x[:, 0]) + x[:, 1] ** 2
+    frame = VectorFrame({"features": x, "label": y})
+    losses = []
+    for iters in (5, 20, 60):
+        m = GBTRegressor().setMaxIter(iters).setStepSize(0.3).fit(frame)
+        pred = np.asarray(m.transform(frame).column("prediction"))
+        losses.append(((y - pred) ** 2).mean())
+    assert losses[0] > losses[1] > losses[2]
+
+
+def test_gbt_classifier_quality_and_proba(rng):
+    SkGBC = pytest.importorskip("sklearn.ensemble").GradientBoostingClassifier
+
+    n = 900
+    x = rng.normal(size=(n, 4))
+    y = ((x[:, 0] + x[:, 1] ** 2) > 1.0).astype(np.float64)
+    frame = VectorFrame({"features": x, "label": y})
+    model = (
+        GBTClassifier().setMaxIter(50).setStepSize(0.2).setMaxDepth(3)
+        .fit(frame)
+    )
+    out = model.transform(frame)
+    proba = np.asarray(out.column("probability"))
+    pred = np.asarray(out.column("prediction"))
+    assert ((proba >= 0) & (proba <= 1)).all()
+    acc = (pred == y).mean()
+    sk = SkGBC(
+        n_estimators=50, learning_rate=0.2, max_depth=3, random_state=0
+    ).fit(x, y)
+    sk_acc = (sk.predict(x) == y).mean()
+    assert acc > sk_acc - 0.03, (acc, sk_acc)
+    with pytest.raises(ValueError, match="0/1"):
+        GBTClassifier().fit(VectorFrame({"features": x, "label": y + 1}))
+
+
+def test_gbt_determinism_and_persistence(rng, tmp_path):
+    n = 400
+    x = rng.normal(size=(n, 3))
+    y = x[:, 0] * 2 + (x[:, 1] > 0)
+    frame = VectorFrame({"features": x, "label": y})
+    m1 = GBTRegressor().setMaxIter(15).setSeed(3).fit(frame)
+    m2 = GBTRegressor().setMaxIter(15).setSeed(3).fit(frame)
+    p1 = np.asarray(m1.transform(frame).column("prediction"))
+    np.testing.assert_array_equal(
+        p1, np.asarray(m2.transform(frame).column("prediction"))
+    )
+    m1.save(str(tmp_path / "gbtr"))
+    loaded = GBTRegressionModel.load(str(tmp_path / "gbtr"))
+    np.testing.assert_allclose(
+        p1,
+        np.asarray(loaded.transform(frame).column("prediction")),
+        atol=1e-7,
+    )
+
+    yc = (y > y.mean()).astype(np.float64)
+    mc = (
+        GBTClassifier().setMaxIter(10).setProbabilityCol("p")
+        .fit(VectorFrame({"features": x, "label": yc}))
+    )
+    mc.save(str(tmp_path / "gbtc"))
+    lc = GBTClassificationModel.load(str(tmp_path / "gbtc"))
+    assert lc.getProbabilityCol() == "p"
+    np.testing.assert_allclose(
+        np.asarray(mc.transform(frame).column("p")),
+        np.asarray(lc.transform(frame).column("p")),
+        atol=1e-7,
+    )
