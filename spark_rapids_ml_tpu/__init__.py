@@ -42,6 +42,13 @@ from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel  # noqa: F401
 from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel  # noqa: F401
+from spark_rapids_ml_tpu.models.feature_scalers import (  # noqa: F401
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    MinMaxScaler,
+    MinMaxScalerModel,
+    Normalizer,
+)
 from spark_rapids_ml_tpu.models.gbt import (  # noqa: F401
     GBTClassificationModel,
     GBTClassifier,
@@ -84,6 +91,11 @@ __all__ = [
     "LogisticRegression",
     "LogisticRegressionModel",
     "OneVsRest",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
+    "MaxAbsScaler",
+    "MaxAbsScalerModel",
+    "Normalizer",
     "GBTClassifier",
     "GBTClassificationModel",
     "GBTRegressor",

@@ -810,3 +810,69 @@ def load_gbt_model(path: str):
     )
     model.uid = meta["uid"]
     return _restore_params(model, meta)
+
+
+def save_minmax_model(model, path: str, overwrite: bool = False) -> None:
+    if model.original_min is None:
+        raise ValueError("cannot save an unfitted MinMaxScalerModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "originalMin": _dense_vector_struct(model.original_min),
+        "originalMax": _dense_vector_struct(model.original_max),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("originalMin", _vector_arrow_type()),
+            ("originalMax", _vector_arrow_type()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("originalMin", "vector"), ("originalMax", "vector"),
+    ])
+
+
+def load_minmax_model(path: str):
+    from spark_rapids_ml_tpu.models.feature_scalers import MinMaxScalerModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = MinMaxScalerModel(
+        original_min=_dense_vector_from_struct(row["originalMin"]),
+        original_max=_dense_vector_from_struct(row["originalMax"]),
+    )
+    model.uid = meta["uid"]
+    return _restore_params(model, meta)
+
+
+def save_maxabs_model(model, path: str, overwrite: bool = False) -> None:
+    if model.max_abs is None:
+        raise ValueError("cannot save an unfitted MaxAbsScalerModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {"maxAbs": _dense_vector_struct(model.max_abs)}
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([("maxAbs", _vector_arrow_type())])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema,
+                    spark_fields=[("maxAbs", "vector")])
+
+
+def load_maxabs_model(path: str):
+    from spark_rapids_ml_tpu.models.feature_scalers import MaxAbsScalerModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = MaxAbsScalerModel(
+        max_abs=_dense_vector_from_struct(row["maxAbs"])
+    )
+    model.uid = meta["uid"]
+    return _restore_params(model, meta)

@@ -15,6 +15,13 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel
 from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
+from spark_rapids_ml_tpu.models.feature_scalers import (
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    MinMaxScaler,
+    MinMaxScalerModel,
+    Normalizer,
+)
 from spark_rapids_ml_tpu.models.gbt import (
     GBTClassificationModel,
     GBTClassifier,
@@ -54,6 +61,11 @@ __all__ = [
     "NearestNeighbors",
     "NearestNeighborsModel",
     "OneVsRest",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
+    "MaxAbsScaler",
+    "MaxAbsScalerModel",
+    "Normalizer",
     "GBTClassifier",
     "GBTClassificationModel",
     "GBTRegressor",

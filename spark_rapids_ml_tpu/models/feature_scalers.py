@@ -1,0 +1,209 @@
+"""MinMaxScaler / MaxAbsScaler Estimators + Normalizer Transformer.
+
+The remaining small Spark ML feature scalers (``org.apache.spark.ml
+.feature``), completing the pipeline-building story around StandardScaler:
+
+* ``MinMaxScaler`` — rescale each feature to [min, max] (Spark semantics:
+  constant columns map to the RANGE MIDPOINT 0.5·(min+max));
+* ``MaxAbsScaler`` — divide each feature by its max |value| (constant-zero
+  columns pass through unchanged, Spark's convention);
+* ``Normalizer`` — per-ROW p-norm scaling, a pure transformer (no fit).
+
+Fitting is one pass of per-column extrema — the reductions are trivial,
+so these run as NumPy host ops regardless of backend (the same decision
+Spark makes: its scalers are Summarizer passes, not BLAS work). All carry
+the standard persistence surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import (
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    Params,
+)
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+
+
+class MinMaxScalerParams(HasInputCol, HasOutputCol):
+    outputCol = Param("outputCol", "output column name", "scaled_features")
+    min = Param("min", "lower bound after scaling", 0.0,
+                validator=lambda v: isinstance(v, (int, float)))
+    max = Param("max", "upper bound after scaling", 1.0,
+                validator=lambda v: isinstance(v, (int, float)))
+
+
+class MinMaxScaler(MinMaxScalerParams):
+    """``MinMaxScaler().fit(df)`` → rescale features to [min, max]."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "MinMaxScaler":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(MinMaxScaler, path)
+
+    def fit(self, dataset) -> "MinMaxScalerModel":
+        if float(self.getMin()) >= float(self.getMax()):
+            raise ValueError("min must be below max")
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("fit"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+            if x.shape[0] < 1:
+                raise ValueError("fit requires at least one row")
+            lo = x.min(axis=0)
+            hi = x.max(axis=0)
+        model = MinMaxScalerModel(original_min=lo, original_max=hi)
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+
+class MinMaxScalerModel(MinMaxScalerParams):
+    def __init__(
+        self,
+        original_min: Optional[np.ndarray] = None,
+        original_max: Optional[np.ndarray] = None,
+    ):
+        super().__init__()
+        self.original_min = original_min
+        self.original_max = original_max
+
+    def _copy_internal_state(self, other: "MinMaxScalerModel") -> None:
+        other.original_min = self.original_min
+        other.original_max = self.original_max
+
+    def transform(self, dataset) -> VectorFrame:
+        if self.original_min is None:
+            raise ValueError("model is unfitted")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        lo_t, hi_t = float(self.getMin()), float(self.getMax())
+        spread = self.original_max - self.original_min
+        # Spark: constant columns map to the midpoint of the target range
+        safe = np.where(spread > 0, spread, 1.0)
+        scaled = (x - self.original_min) / safe * (hi_t - lo_t) + lo_t
+        scaled = np.where(
+            spread[None, :] > 0, scaled, 0.5 * (lo_t + hi_t)
+        )
+        return frame.with_column(self.getOutputCol(), scaled)
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_minmax_model
+
+        save_minmax_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "MinMaxScalerModel":
+        from spark_rapids_ml_tpu.io.persistence import load_minmax_model
+
+        return load_minmax_model(path)
+
+
+class MaxAbsScalerParams(HasInputCol, HasOutputCol):
+    outputCol = Param("outputCol", "output column name", "scaled_features")
+
+
+class MaxAbsScaler(MaxAbsScalerParams):
+    """``MaxAbsScaler().fit(df)`` → divide features by their max |value|."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "MaxAbsScaler":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(MaxAbsScaler, path)
+
+    def fit(self, dataset) -> "MaxAbsScalerModel":
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("fit"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+            if x.shape[0] < 1:
+                raise ValueError("fit requires at least one row")
+            max_abs = np.abs(x).max(axis=0)
+        model = MaxAbsScalerModel(max_abs=max_abs)
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+
+class MaxAbsScalerModel(MaxAbsScalerParams):
+    def __init__(self, max_abs: Optional[np.ndarray] = None):
+        super().__init__()
+        self.max_abs = max_abs
+
+    def _copy_internal_state(self, other: "MaxAbsScalerModel") -> None:
+        other.max_abs = self.max_abs
+
+    def transform(self, dataset) -> VectorFrame:
+        if self.max_abs is None:
+            raise ValueError("model is unfitted")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        # all-zero columns pass through (Spark divides by 1 there)
+        denom = np.where(self.max_abs > 0, self.max_abs, 1.0)
+        return frame.with_column(self.getOutputCol(), x / denom[None, :])
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_maxabs_model
+
+        save_maxabs_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "MaxAbsScalerModel":
+        from spark_rapids_ml_tpu.io.persistence import load_maxabs_model
+
+        return load_maxabs_model(path)
+
+
+class Normalizer(HasInputCol, HasOutputCol, Params):
+    """Per-row p-norm scaling — a pure Transformer (no fit), Spark's
+    ``Normalizer``. Zero rows pass through unchanged."""
+
+    outputCol = Param("outputCol", "output column name", "normalized_features")
+    p = Param("p", "norm order (p >= 1; inf supported)", 2.0,
+              validator=lambda v: v == float("inf") or float(v) >= 1.0)
+
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        p = float(self.getP())
+        if np.isinf(p):
+            norms = np.abs(x).max(axis=1)
+        else:
+            norms = np.power(
+                np.power(np.abs(x), p).sum(axis=1), 1.0 / p
+            )
+        denom = np.where(norms > 0, norms, 1.0)
+        return frame.with_column(
+            self.getOutputCol(), x / denom[:, None]
+        )
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "Normalizer":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(Normalizer, path)

@@ -1,0 +1,110 @@
+"""MinMaxScaler / MaxAbsScaler / Normalizer vs sklearn oracles + Spark
+edge-case conventions (constant columns, zero rows)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    MinMaxScaler,
+    MinMaxScalerModel,
+    Normalizer,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def test_minmax_matches_sklearn_and_constant_column(rng):
+    pre = pytest.importorskip("sklearn.preprocessing")
+    x = rng.normal(size=(100, 4))
+    x[:, 2] = 7.0  # constant column
+    model = MinMaxScaler().fit(x)
+    got = np.asarray(
+        model.transform(VectorFrame({"features": x})).column(
+            "scaled_features"
+        )
+    )
+    sk = pre.MinMaxScaler().fit_transform(x)
+    # sklearn maps a constant column to the range MINIMUM; Spark maps it
+    # to the midpoint — compare non-constant columns to sklearn, and the
+    # constant column to Spark's convention
+    np.testing.assert_allclose(got[:, [0, 1, 3]], sk[:, [0, 1, 3]], atol=1e-12)
+    np.testing.assert_allclose(got[:, 2], 0.5, atol=1e-12)
+    # custom range
+    m2 = MinMaxScaler().set("min", -1.0).set("max", 3.0).fit(x)
+    g2 = np.asarray(
+        m2.transform(VectorFrame({"features": x})).column("scaled_features")
+    )
+    assert g2[:, 0].min() == pytest.approx(-1.0)
+    assert g2[:, 0].max() == pytest.approx(3.0)
+    np.testing.assert_allclose(g2[:, 2], 1.0, atol=1e-12)  # midpoint
+    with pytest.raises(ValueError, match="min"):
+        MinMaxScaler().set("min", 2.0).set("max", 1.0).fit(x)
+
+
+def test_maxabs_matches_sklearn_and_zero_column(rng):
+    pre = pytest.importorskip("sklearn.preprocessing")
+    x = rng.normal(size=(80, 3))
+    x[:, 1] = 0.0
+    model = MaxAbsScaler().fit(x)
+    got = np.asarray(
+        model.transform(VectorFrame({"features": x})).column(
+            "scaled_features"
+        )
+    )
+    sk = pre.MaxAbsScaler().fit_transform(x)
+    np.testing.assert_allclose(got, sk, atol=1e-12)
+    assert (got[:, 1] == 0).all()
+
+
+def test_normalizer_p_variants(rng):
+    x = rng.normal(size=(50, 4))
+    x[7] = 0.0  # zero row passes through
+    for p in (1.0, 2.0, 3.0, float("inf")):
+        out = np.asarray(
+            Normalizer().set("p", p).transform(
+                VectorFrame({"features": x})
+            ).column("normalized_features")
+        )
+        if np.isinf(p):
+            norms = np.abs(out).max(axis=1)
+        else:
+            norms = np.power(np.power(np.abs(out), p).sum(axis=1), 1 / p)
+        np.testing.assert_allclose(np.delete(norms, 7), 1.0, atol=1e-12)
+        assert (out[7] == 0).all()
+
+
+def test_scaler_persistence_roundtrips(rng, tmp_path):
+    x = rng.normal(size=(60, 3))
+    mm = MinMaxScaler().fit(x)
+    mm.save(str(tmp_path / "mm"))
+    mm2 = MinMaxScalerModel.load(str(tmp_path / "mm"))
+    np.testing.assert_array_equal(mm2.original_min, mm.original_min)
+    ma = MaxAbsScaler().fit(x)
+    ma.save(str(tmp_path / "ma"))
+    ma2 = MaxAbsScalerModel.load(str(tmp_path / "ma"))
+    np.testing.assert_array_equal(ma2.max_abs, ma.max_abs)
+    f1 = np.asarray(
+        mm.transform(VectorFrame({"features": x})).column("scaled_features")
+    )
+    f2 = np.asarray(
+        mm2.transform(VectorFrame({"features": x})).column("scaled_features")
+    )
+    np.testing.assert_array_equal(f1, f2)
+
+
+def test_scalers_compose_in_pipeline(rng):
+    from spark_rapids_ml_tpu import LinearRegression, Pipeline
+
+    x = rng.normal(size=(200, 3)) * np.array([100.0, 0.01, 1.0])
+    y = (x * np.array([0.01, 100.0, 1.0])).sum(axis=1)
+    pipe = Pipeline(
+        stages=[
+            MinMaxScaler().setOutputCol("mm"),
+            Normalizer().setInputCol("mm").setOutputCol("norm"),
+            LinearRegression().setInputCol("norm"),
+        ]
+    )
+    model = pipe.fit(VectorFrame({"features": x, "label": y}))
+    out = model.transform(VectorFrame({"features": x}))
+    assert "prediction" in out.columns
