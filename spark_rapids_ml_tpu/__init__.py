@@ -40,6 +40,10 @@ from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
     LogisticRegressionModel,
 )
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
+from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: F401
+    NaiveBayes,
+    NaiveBayesModel,
+)
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel  # noqa: F401
 from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel  # noqa: F401
 from spark_rapids_ml_tpu.models.feature_scalers import (  # noqa: F401
@@ -90,6 +94,8 @@ __all__ = [
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "NaiveBayes",
+    "NaiveBayesModel",
     "OneVsRest",
     "MinMaxScaler",
     "MinMaxScalerModel",

@@ -268,6 +268,7 @@ _SPARK_FIELD_TYPES = {
     "double": "double",
     "long": "long",
     "integer": "integer",
+    "boolean": "boolean",
 }
 
 
@@ -873,6 +874,59 @@ def load_maxabs_model(path: str):
     row = _read_data_row(path)
     model = MaxAbsScalerModel(
         max_abs=_dense_vector_from_struct(row["maxAbs"])
+    )
+    model.uid = meta["uid"]
+    return _restore_params(model, meta)
+
+
+def save_nb_model(model, path: str, overwrite: bool = False) -> None:
+    """NaiveBayesModel: pi / theta (+ sigma for gaussian) / classes."""
+    if model.theta is None:
+        raise ValueError("cannot save an unfitted NaiveBayesModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    has_sigma = model.sigma is not None
+    row = {
+        "pi": _dense_vector_struct(model.pi),
+        "theta": _dense_matrix_struct(model.theta),
+        "sigma": _dense_matrix_struct(
+            model.sigma if has_sigma else np.zeros((0, 0))
+        ),
+        "classes": _dense_vector_struct(np.asarray(model.classes_, float)),
+        "hasSigma": bool(has_sigma),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("pi", _vector_arrow_type()),
+            ("theta", _matrix_arrow_type()),
+            ("sigma", _matrix_arrow_type()),
+            ("classes", _vector_arrow_type()),
+            ("hasSigma", pa.bool_()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("pi", "vector"), ("theta", "matrix"), ("sigma", "matrix"),
+        ("classes", "vector"), ("hasSigma", "boolean"),
+    ])
+
+
+def load_nb_model(path: str):
+    from spark_rapids_ml_tpu.models.naive_bayes import NaiveBayesModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    sigma = (
+        _dense_matrix_from_struct(row["sigma"]) if row["hasSigma"] else None
+    )
+    model = NaiveBayesModel(
+        pi=_dense_vector_from_struct(row["pi"]),
+        theta=_dense_matrix_from_struct(row["theta"]),
+        sigma=sigma,
+        classes=_dense_vector_from_struct(row["classes"]),
     )
     model.uid = meta["uid"]
     return _restore_params(model, meta)

@@ -13,6 +13,7 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
     NearestNeighborsModel,
 )
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
+from spark_rapids_ml_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel
 from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
 from spark_rapids_ml_tpu.models.feature_scalers import (
@@ -60,6 +61,8 @@ __all__ = [
     "DBSCANModel",
     "NearestNeighbors",
     "NearestNeighborsModel",
+    "NaiveBayes",
+    "NaiveBayesModel",
     "OneVsRest",
     "MinMaxScaler",
     "MinMaxScalerModel",

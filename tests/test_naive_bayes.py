@@ -1,0 +1,96 @@
+"""NaiveBayes (multinomial/bernoulli/gaussian) vs the sklearn oracles."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import NaiveBayes, NaiveBayesModel
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+sk_nb = pytest.importorskip("sklearn.naive_bayes")
+
+
+def test_multinomial_matches_sklearn(rng):
+    n, d, k = 300, 10, 3
+    x = rng.poisson(3.0, size=(n, d)).astype(np.float64)
+    y = rng.integers(0, k, size=n).astype(np.float64)
+    # give classes distinct profiles
+    for c in range(k):
+        x[y == c, c] += 5
+    model = NaiveBayes().fit(VectorFrame({"features": x, "label": y}))
+    sk = sk_nb.MultinomialNB(alpha=1.0).fit(x, y)
+    np.testing.assert_allclose(model.theta, sk.feature_log_prob_, atol=1e-10)
+    np.testing.assert_allclose(model.pi, sk.class_log_prior_, atol=1e-10)
+    got = model.predict_proba(VectorFrame({"features": x}))
+    np.testing.assert_allclose(got, sk.predict_proba(x), atol=1e-8)
+    pred = np.asarray(
+        model.transform(VectorFrame({"features": x})).column("prediction")
+    )
+    np.testing.assert_array_equal(pred, sk.predict(x))
+
+
+def test_bernoulli_matches_sklearn(rng):
+    n, d = 240, 8
+    x = (rng.uniform(size=(n, d)) > 0.6).astype(np.float64)
+    y = (x[:, 0] + x[:, 1] > 0.5).astype(np.float64)
+    model = (
+        NaiveBayes().setModelType("bernoulli")
+        .fit(VectorFrame({"features": x, "label": y}))
+    )
+    sk = sk_nb.BernoulliNB(alpha=1.0).fit(x, y)
+    got = model.predict_proba(VectorFrame({"features": x}))
+    np.testing.assert_allclose(got, sk.predict_proba(x), atol=1e-8)
+    with pytest.raises(ValueError, match="\\{0,1\\}"):
+        NaiveBayes().setModelType("bernoulli").fit(
+            VectorFrame({"features": x + 0.5, "label": y})
+        )
+
+
+def test_gaussian_matches_sklearn(rng):
+    n = 300
+    x = np.concatenate(
+        [rng.normal(loc=c, scale=1 + c, size=(n // 3, 4)) for c in (0, 2, 5)]
+    )
+    y = np.repeat([0.0, 1.0, 2.0], n // 3)
+    model = (
+        NaiveBayes().setModelType("gaussian")
+        .fit(VectorFrame({"features": x, "label": y}))
+    )
+    sk = sk_nb.GaussianNB().fit(x, y)
+    got = model.predict_proba(VectorFrame({"features": x}))
+    agree = (
+        np.argmax(got, axis=1) == np.argmax(sk.predict_proba(x), axis=1)
+    ).mean()
+    assert agree > 0.99
+    np.testing.assert_allclose(model.theta, sk.theta_, atol=1e-8)
+
+
+def test_nb_device_host_agree_and_persistence(rng, tmp_path):
+    n, d = 200, 6
+    x = rng.poisson(2.0, size=(n, d)).astype(np.float64)
+    y = (x[:, 0] > 2).astype(np.float64)
+    frame = VectorFrame({"features": x, "label": y})
+    m_dev = NaiveBayes().fit(frame)
+    m_host = NaiveBayes().setUseXlaDot(False).fit(frame)
+    np.testing.assert_allclose(m_dev.theta, m_host.theta, atol=1e-6)
+    m_dev.save(str(tmp_path / "nb"))
+    loaded = NaiveBayesModel.load(str(tmp_path / "nb"))
+    np.testing.assert_allclose(loaded.theta, m_dev.theta, atol=1e-12)
+    np.testing.assert_array_equal(loaded.classes_, m_dev.classes_)
+    p1 = m_dev.predict_proba(frame)
+    p2 = loaded.predict_proba(frame)
+    np.testing.assert_allclose(p1, p2, atol=1e-12)
+    # gaussian roundtrip (sigma present)
+    g = NaiveBayes().setModelType("gaussian").fit(frame)
+    g.save(str(tmp_path / "gnb"))
+    g2 = NaiveBayesModel.load(str(tmp_path / "gnb"))
+    np.testing.assert_allclose(g2.sigma, g.sigma, atol=1e-12)
+    np.testing.assert_allclose(
+        g2.predict_proba(frame), g.predict_proba(frame), atol=1e-12
+    )
+
+
+def test_multinomial_rejects_negative(rng):
+    x = rng.normal(size=(50, 3))
+    y = (x[:, 0] > 0).astype(np.float64)
+    with pytest.raises(ValueError, match="non-negative"):
+        NaiveBayes().fit(VectorFrame({"features": x, "label": y}))
