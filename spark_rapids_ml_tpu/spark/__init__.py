@@ -19,6 +19,8 @@ _PYSPARK_CLASSES = (
     "PCAModel",
     "LinearRegression",
     "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
     "KMeans",
     "KMeansModel",
 )

@@ -198,6 +198,152 @@ def solve_linreg_from_stats(
     return coef, 0.0
 
 
+def partition_logreg_stats(
+    batches: Iterable,
+    features_col: str,
+    label_col: str,
+    w: np.ndarray,
+    b: float,
+) -> Iterator[Dict[str, object]]:
+    """One partition's Newton/IRLS partials under broadcast coefficients.
+
+    Given the current (w, b) captured by closure (the small-state broadcast
+    of ``RapidsRowMatrix.scala:162-166``, here per Newton iteration), emits
+    (Xᵀr, XᵀSX, XᵀS, Σr, Σs, loss, n) where r = σ(Xw+b) − y and
+    S = diag(σ(1−σ)) — everything the driver needs to assemble one
+    (n+1)² Newton system (``models.logistic_regression._assemble_newton``).
+    One Spark job per iteration, mirroring the per-pass streamed fit.
+    """
+    w = np.asarray(w, dtype=np.float64).reshape(-1)
+    b = float(b)
+    n = w.shape[0]
+    gx = np.zeros(n)
+    hxx = np.zeros((n, n))
+    hxb = np.zeros(n)
+    rsum = ssum = loss = 0.0
+    count = 0
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+            y = np.asarray(batch.column(label_col).to_pylist(),
+                           dtype=np.float64)
+        else:
+            x, y = batch
+            x = np.asarray(x, dtype=np.float64)
+            y = np.asarray(y, dtype=np.float64).reshape(-1)
+        if x.shape[0] == 0:
+            continue
+        bad = ~np.isin(y, (0.0, 1.0))
+        if bad.any():
+            raise ValueError(
+                "binary LogisticRegression requires 0/1 labels; found "
+                f"{np.unique(y[bad])[:5]}"
+            )
+        z = x @ w + b
+        p = 1.0 / (1.0 + np.exp(-z))
+        r = p - y
+        s = p * (1.0 - p)
+        gx += x.T @ r
+        hxx += x.T @ (x * s[:, None])
+        hxb += x.T @ s
+        rsum += float(r.sum())
+        ssum += float(s.sum())
+        # stable per-row NLL: log(1+e^z) − y·z
+        loss += float(np.logaddexp(0.0, z).sum() - y @ z)
+        count += x.shape[0]
+    if count == 0:
+        return
+    yield {
+        "gx": gx.tolist(),
+        "hxx": hxx.ravel().tolist(),
+        "hxb": hxb.tolist(),
+        "rsum": rsum,
+        "ssum": ssum,
+        "loss": loss,
+        "count": count,
+    }
+
+
+def partition_logreg_stats_arrow(batches, features_col: str, label_col: str,
+                                 w: np.ndarray, b: float):
+    import pyarrow as pa
+
+    for row in partition_logreg_stats(batches, features_col, label_col, w, b):
+        yield pa.RecordBatch.from_pylist([row], schema=logreg_stats_arrow_schema())
+
+
+def logreg_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema(
+        [
+            ("gx", pa.list_(pa.float64())),
+            ("hxx", pa.list_(pa.float64())),
+            ("hxb", pa.list_(pa.float64())),
+            ("rsum", pa.float64()),
+            ("ssum", pa.float64()),
+            ("loss", pa.float64()),
+            ("count", pa.int64()),
+        ]
+    )
+
+
+def logreg_stats_spark_ddl() -> str:
+    return ("gx array<double>, hxx array<double>, hxb array<double>, "
+            "rsum double, ssum double, loss double, count bigint")
+
+
+def combine_logreg_stats(rows: Iterable):
+    """Driver-side reduce of per-partition IRLS partials →
+    (gx, hxx, hxb, rsum, ssum, loss, count)."""
+    gx = hxx = hxb = None
+    rsum = ssum = loss = 0.0
+    count = 0
+    for row in rows:
+        get = row.get if isinstance(row, dict) else row.__getitem__
+        g = np.asarray(get("gx"), dtype=np.float64)
+        if gx is None:
+            n = g.shape[0]
+            gx, hxx, hxb = np.zeros(n), np.zeros((n, n)), np.zeros(n)
+        gx += g
+        hxx += np.asarray(get("hxx"), dtype=np.float64).reshape(hxb.shape[0],
+                                                                hxb.shape[0])
+        hxb += np.asarray(get("hxb"), dtype=np.float64)
+        rsum += float(get("rsum"))
+        ssum += float(get("ssum"))
+        loss += float(get("loss"))
+        count += int(get("count"))
+    if gx is None:
+        raise ValueError("no partition statistics to combine (empty dataset)")
+    return gx, hxx, hxb, rsum, ssum, loss, count
+
+
+def logreg_newton_step_from_stats(
+    gx: np.ndarray,
+    hxx: np.ndarray,
+    hxb: np.ndarray,
+    rsum: float,
+    ssum: float,
+    count: int,
+    w: np.ndarray,
+    b: float,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+) -> Tuple[np.ndarray, float, float]:
+    """One damped-free Newton update from combined statistics; returns
+    (w', b', max|Δ|) with the same Spark-convention (1/n)-scaled system as
+    the local fits (shared ``_assemble_newton``)."""
+    from spark_rapids_ml_tpu.models.logistic_regression import _assemble_newton
+
+    n = w.shape[0]
+    g, h = _assemble_newton(gx, hxx, hxb, rsum, ssum, float(count),
+                            w, reg_param, fit_intercept)
+    delta = np.linalg.solve(h, g)
+    w_new = w - delta[:n]
+    b_new = b - delta[n] if fit_intercept else b
+    return w_new, float(b_new), float(np.max(np.abs(delta)))
+
+
 def partition_kmeans_stats(
     batches: Iterable, input_col: str, centers: np.ndarray
 ) -> Iterator[Dict[str, object]]:

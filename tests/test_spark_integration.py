@@ -155,6 +155,92 @@ def test_metadata_splits_spark_and_extension_params(data, tmp_path):
 
 
 # -- pyspark integration (optional dependency) -----------------------------
+# -- logistic regression partition IRLS ------------------------------------
+
+def _newton_loop_over_parts(parts, labels, reg_param=0.0, fit_intercept=True,
+                            max_iter=25, tol=1e-8):
+    """Drive the per-iteration partition-stats plumbing exactly as the
+    Spark estimator does, with plain-array partitions standing in for
+    mapInArrow jobs."""
+    from spark_rapids_ml_tpu.spark.aggregate import (
+        combine_logreg_stats,
+        logreg_newton_step_from_stats,
+        partition_logreg_stats,
+    )
+
+    n = parts[0].shape[1]
+    w, b = np.zeros(n), 0.0
+    for _ in range(max_iter):
+        rows = []
+        for x, y in zip(parts, labels):
+            rows.extend(partition_logreg_stats([(x, y)], "f", "l", w, b))
+        gx, hxx, hxb, rsum, ssum, _loss, count = combine_logreg_stats(rows)
+        w, b, step = logreg_newton_step_from_stats(
+            gx, hxx, hxb, rsum, ssum, count, w, b,
+            reg_param=reg_param, fit_intercept=fit_intercept,
+        )
+        if step <= tol:
+            break
+    return w, b
+
+
+def test_partition_logreg_newton_matches_local(rng):
+    from spark_rapids_ml_tpu import LogisticRegression as LocalLogReg
+
+    x = rng.normal(size=(500, 6))
+    true_w = rng.normal(size=6)
+    y = (x @ true_w + 0.3 + rng.logistic(size=500) > 0).astype(np.float64)
+
+    parts = [x[:150], x[150:400], x[400:]]
+    labels = [y[:150], y[150:400], y[400:]]
+    w, b = _newton_loop_over_parts(parts, labels, reg_param=0.05)
+
+    local = (LocalLogReg().setRegParam(0.05).setUseXlaDot(False)
+             .fit(x, labels=y))
+    np.testing.assert_allclose(w, local.coefficients, atol=1e-6)
+    np.testing.assert_allclose(b, local.intercept, atol=1e-6)
+
+
+def test_partition_logreg_stats_arrow_round_trip(rng):
+    pa = pytest.importorskip("pyarrow")
+    from spark_rapids_ml_tpu.spark.aggregate import (
+        combine_logreg_stats,
+        logreg_stats_arrow_schema,
+        partition_logreg_stats,
+        partition_logreg_stats_arrow,
+    )
+
+    x = rng.normal(size=(40, 4))
+    y = (rng.random(40) > 0.5).astype(np.float64)
+    vec_col = pa.array(
+        [{"type": 1, "size": None, "indices": None, "values": row.tolist()}
+         for row in x]
+    )
+    lab_col = pa.array(y.tolist(), type=pa.float64())
+    batch = pa.RecordBatch.from_arrays([vec_col, lab_col],
+                                       names=["features", "label"])
+    w = rng.normal(size=4)
+    out = list(partition_logreg_stats_arrow([batch], "features", "label",
+                                            w, 0.1))
+    assert len(out) == 1
+    assert out[0].schema.equals(logreg_stats_arrow_schema())
+    via_arrow = combine_logreg_stats(out[0].to_pylist())
+    direct = combine_logreg_stats(
+        partition_logreg_stats([(x, y)], "f", "l", w, 0.1)
+    )
+    for a, d in zip(via_arrow, direct):
+        np.testing.assert_allclose(a, d, rtol=1e-12)
+
+
+def test_partition_logreg_rejects_bad_labels(rng):
+    from spark_rapids_ml_tpu.spark.aggregate import partition_logreg_stats
+
+    x = rng.normal(size=(10, 3))
+    y = np.arange(10, dtype=np.float64)
+    with pytest.raises(ValueError, match="0/1 labels"):
+        list(partition_logreg_stats([(x, y)], "f", "l", np.zeros(3), 0.0))
+
+
 # importorskip lives inside the fixture/tests (NOT module level) so the
 # Arrow/wire-format tests above always run.
 
@@ -195,6 +281,34 @@ def test_spark_fit_matches_local(spark, rng):
     out = model.transform(df).select("pca_features").collect()
     assert len(out) == 300
     assert len(out[0][0]) == 3
+
+
+def test_spark_logreg_matches_local(spark, rng):
+    from pyspark.ml.linalg import Vectors
+
+    from spark_rapids_ml_tpu import LogisticRegression as LocalLogReg
+    from spark_rapids_ml_tpu.spark import LogisticRegression
+
+    x = rng.normal(size=(400, 5))
+    true_w = rng.normal(size=5)
+    y = (x @ true_w - 0.2 + rng.logistic(size=400) > 0).astype(float)
+    df = spark.createDataFrame(
+        [(Vectors.dense(row), float(label)) for row, label in zip(x, y)],
+        ["features", "label"],
+    ).repartition(3)
+    model = LogisticRegression(regParam=0.02).fit(df)
+    local = (LocalLogReg().setRegParam(0.02).setUseXlaDot(False)
+             .fit(x, labels=y))
+    np.testing.assert_allclose(
+        model.coefficients.toArray(), local.coefficients, atol=1e-6
+    )
+    np.testing.assert_allclose(model.intercept, local.intercept, atol=1e-6)
+    # collect label alongside: repartition makes row order nondeterministic
+    out = model.transform(df).select("prediction", "label").collect()
+    preds = np.array([r[0] for r in out])
+    labels = np.array([r[1] for r in out])
+    assert ((preds == 0.0) | (preds == 1.0)).all()
+    assert float((preds == labels).mean()) > 0.8
 
 
 def test_spark_model_round_trips_with_pyspark_ml(spark, rng, tmp_path):
