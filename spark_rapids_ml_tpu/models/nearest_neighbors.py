@@ -35,11 +35,12 @@ class NearestNeighborsParams(HasInputCol, HasDeviceId):
     )
     algorithm = Param(
         "algorithm",
-        "brute (exact) or ivfflat (approximate: k-means coarse quantizer, "
-        "search the nprobe nearest buckets only — the reference project's "
-        "NearestNeighbors algorithm option)",
+        "brute (exact), ivfflat (approximate: k-means coarse quantizer, "
+        "search the nprobe nearest buckets only), or ivfpq (ivfflat "
+        "plus product-quantized residuals scanned via ADC tables) — "
+        "the reference project's NearestNeighbors algorithm options",
         "brute",
-        validator=lambda v: v in ("brute", "ivfflat"),
+        validator=lambda v: v in ("brute", "ivfflat", "ivfpq"),
     )
     nlist = Param(
         "nlist",
@@ -49,9 +50,23 @@ class NearestNeighborsParams(HasInputCol, HasDeviceId):
     )
     nprobe = Param(
         "nprobe",
-        "ivfflat: buckets searched per query (== nlist recovers exact)",
+        "ivfflat/ivfpq: buckets searched per query (== nlist recovers "
+        "exact for ivfflat; ivfpq stays approximate — quantization error)",
         8,
         validator=lambda v: isinstance(v, int) and v >= 1,
+    )
+    pqM = Param(
+        "pqM",
+        "ivfpq: number of subquantizers (must divide the feature dim; "
+        "0 = auto, the largest divisor of dim at most dim/2)",
+        0,
+        validator=lambda v: isinstance(v, int) and v >= 0,
+    )
+    pqBits = Param(
+        "pqBits",
+        "ivfpq: bits per subquantizer code (codebook size 2^bits)",
+        8,
+        validator=lambda v: isinstance(v, int) and 2 <= v <= 8,
     )
     useXlaDot = Param(
         "useXlaDot",
@@ -110,6 +125,10 @@ class NearestNeighborsModel(NearestNeighborsParams):
         self._device_items = None
         # lazy IVF index, keyed on (device, dtype, nlist)
         self._ivf_index_cache = None
+        # lazy IVF-PQ index, keyed on (device, dtype, nlist, pqM, pqBits)
+        self._ivfpq_index_cache = None
+        # shared coarse-quantizer cache, keyed on (device, dtype, nlist)
+        self._coarse_cache = None
 
     def _copy_internal_state(self, other: "NearestNeighborsModel") -> None:
         other.items = self.items
@@ -135,16 +154,28 @@ class NearestNeighborsModel(NearestNeighborsParams):
                 f"query dim {queries.shape[1]} != fitted item dim "
                 f"{self.items.shape[1]}"
             )
-        if self.getAlgorithm() == "ivfflat" and self.getUseXlaDot():
-            return self._kneighbors_ivf(queries, k)
         if self.getUseXlaDot():
+            algorithm = self.getAlgorithm()
+            if algorithm == "ivfflat":
+                return self._kneighbors_ivf(queries, k)
+            if algorithm == "ivfpq":
+                return self._kneighbors_ivfpq(queries, k)
             return self._kneighbors_xla(queries, k)
         return _host_kneighbors(queries, self.items, k)
 
-    # -- IVF-Flat approximate path -----------------------------------------
-    def _ivf_index(self, device, dtype):
-        """Build (and cache) the coarse-quantizer index: k-means centroids
-        + padded per-bucket item/ids/mask arrays on device."""
+    # -- IVF approximate paths (shared coarse quantizer) -------------------
+    def _resolve_nlist(self) -> int:
+        n = self.items.shape[0]
+        nlist = self.getNlist() or max(1, int(np.sqrt(n)))
+        return min(nlist, n)
+
+    def _coarse_quantizer(self, device, dtype, nlist):
+        """k-means coarse quantizer: (device centroids, host assignment).
+
+        Cached on (device, dtype, nlist) — the full-corpus k-means is the
+        dominant index-build cost and is shared verbatim by the ivfflat
+        and ivfpq builders.
+        """
         import jax
         import jax.numpy as jnp
 
@@ -154,31 +185,61 @@ class NearestNeighborsModel(NearestNeighborsParams):
             kmeans_plus_plus_init,
         )
 
-        n = self.items.shape[0]
-        nlist = self.getNlist() or max(1, int(np.sqrt(n)))
-        nlist = min(nlist, n)
         cache_key = (device, jnp.dtype(dtype), nlist)
-        if self._ivf_index_cache and self._ivf_index_cache[0] == cache_key:
-            return self._ivf_index_cache[1]
+        if self._coarse_cache and self._coarse_cache[0] == cache_key:
+            return self._coarse_cache[1]
         items = jax.device_put(jnp.asarray(self.items, dtype=dtype), device)
         init = kmeans_plus_plus_init(items, nlist, jax.random.PRNGKey(0))
         km = kmeans_fit_kernel(items, init, max_iter=20, tol=1e-4)
-        centroids = km.centers
-        assign = np.asarray(assign_clusters(items, centroids))
-        max_size = int(np.bincount(assign, minlength=nlist).max())
-        bucket_items = np.zeros(
-            (nlist, max_size, self.items.shape[1]), dtype=np.float64
-        )
-        bucket_ids = np.zeros((nlist, max_size), dtype=np.int32)
-        bucket_mask = np.zeros((nlist, max_size), dtype=np.float64)
-        # vectorized bucket fill: stable-sort rows by bucket, compute each
-        # row's slot as its rank within the bucket (no per-row Python loop
-        # — this runs at the million-item scales ivfflat targets)
+        assign = np.asarray(assign_clusters(items, km.centers))
+        self._coarse_cache = (cache_key, (km.centers, assign))
+        return km.centers, assign
+
+    def _ivf_pool_check_and_step(self, algorithm: str, k: int, nprobe: int,
+                                 max_size: int) -> int:
+        """Shared candidate-pool guard + query-chunk sizing for the IVF
+        modes; the candidate gather is (chunk, nprobe·max_size, …)."""
+        if k > nprobe * max_size:
+            raise ValueError(
+                f"k = {k} exceeds the {algorithm} candidate pool "
+                f"(nprobe {nprobe} x largest bucket {max_size}); raise "
+                f"nprobe (or nlist) or use algorithm='brute'"
+            )
+        return max(1, _QUERY_BUCKET // max(1, nprobe // 4))
+
+    @staticmethod
+    def _bucket_layout(assign: np.ndarray, nlist: int):
+        """Vectorized bucket fill plan: stable-sort rows by bucket, each
+        row's slot is its rank within the bucket (no per-row Python loop
+        — this runs at the million-item scales the IVF modes target).
+        Returns (order, sorted_assign, slots, max_size)."""
+        n = assign.shape[0]
         order = np.argsort(assign, kind="stable")
         sorted_assign = assign[order]
         counts = np.bincount(assign, minlength=nlist)
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
         slots = np.arange(n, dtype=np.int64) - starts[sorted_assign]
+        return order, sorted_assign, slots, int(counts.max())
+
+    def _ivf_index(self, device, dtype):
+        """Build (and cache) the IVF-Flat index: k-means centroids
+        + padded per-bucket item/ids/mask arrays on device."""
+        import jax
+        import jax.numpy as jnp
+
+        nlist = self._resolve_nlist()
+        cache_key = (device, jnp.dtype(dtype), nlist)
+        if self._ivf_index_cache and self._ivf_index_cache[0] == cache_key:
+            return self._ivf_index_cache[1]
+        centroids, assign = self._coarse_quantizer(device, dtype, nlist)
+        order, sorted_assign, slots, max_size = self._bucket_layout(
+            assign, nlist
+        )
+        bucket_items = np.zeros(
+            (nlist, max_size, self.items.shape[1]), dtype=np.float64
+        )
+        bucket_ids = np.zeros((nlist, max_size), dtype=np.int32)
+        bucket_mask = np.zeros((nlist, max_size), dtype=np.float64)
         bucket_items[sorted_assign, slots] = self.items[order]
         bucket_ids[sorted_assign, slots] = order
         bucket_mask[sorted_assign, slots] = 1.0
@@ -190,6 +251,80 @@ class NearestNeighborsModel(NearestNeighborsParams):
             nlist,
         )
         self._ivf_index_cache = (cache_key, index)
+        return index
+
+    def _resolve_pq_m(self, dim: int) -> int:
+        m_sub = self.getPqM()
+        if m_sub == 0:
+            # auto: the largest divisor of dim at most dim/2 (dsub >= 2
+            # keeps codebook training meaningful); dim=1 degenerates to 1
+            for cand in range(max(1, dim // 2), 0, -1):
+                if dim % cand == 0:
+                    return cand
+        if dim % m_sub != 0:
+            raise ValueError(
+                f"pqM = {m_sub} must divide the feature dimension {dim}"
+            )
+        return m_sub
+
+    def _ivfpq_index(self, device, dtype):
+        """Build (and cache) the IVF-PQ index: coarse quantizer + one
+        k-means codebook per residual subspace + per-bucket code arrays.
+        The compressed (nlist, max_size, M) int32 codes replace the raw
+        bucket rows in HBM."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.kmeans_kernel import (
+            assign_clusters,
+            kmeans_fit_kernel,
+            kmeans_plus_plus_init,
+        )
+
+        n, dim = self.items.shape
+        nlist = self._resolve_nlist()
+        m_sub = self._resolve_pq_m(dim)
+        ksub = min(2 ** self.getPqBits(), n)
+        cache_key = (device, jnp.dtype(dtype), nlist, m_sub, ksub)
+        if (self._ivfpq_index_cache
+                and self._ivfpq_index_cache[0] == cache_key):
+            return self._ivfpq_index_cache[1]
+        centroids, assign = self._coarse_quantizer(device, dtype, nlist)
+        residuals = self.items - np.asarray(
+            centroids, dtype=np.float64
+        )[assign]
+        dsub = dim // m_sub
+        codebooks = np.zeros((m_sub, ksub, dsub))
+        codes = np.zeros((n, m_sub), dtype=np.int32)
+        for m in range(m_sub):
+            sub = jax.device_put(
+                jnp.asarray(residuals[:, m * dsub:(m + 1) * dsub],
+                            dtype=dtype),
+                device,
+            )
+            init = kmeans_plus_plus_init(sub, ksub, jax.random.PRNGKey(m + 1))
+            km = kmeans_fit_kernel(sub, init, max_iter=15, tol=1e-4)
+            codebooks[m] = np.asarray(km.centers, dtype=np.float64)
+            codes[:, m] = np.asarray(assign_clusters(sub, km.centers))
+        order, sorted_assign, slots, max_size = self._bucket_layout(
+            assign, nlist
+        )
+        # subspace-major code layout — see the ivfpq_search layout note
+        bucket_codes = np.zeros((m_sub, nlist, max_size), dtype=np.int32)
+        bucket_ids = np.zeros((nlist, max_size), dtype=np.int32)
+        bucket_mask = np.zeros((nlist, max_size), dtype=np.float64)
+        bucket_codes[:, sorted_assign, slots] = codes[order].T
+        bucket_ids[sorted_assign, slots] = order
+        bucket_mask[sorted_assign, slots] = 1.0
+        index = (
+            centroids,
+            jax.device_put(jnp.asarray(codebooks, dtype=dtype), device),
+            jax.device_put(jnp.asarray(bucket_codes), device),
+            jax.device_put(jnp.asarray(bucket_ids), device),
+            jax.device_put(jnp.asarray(bucket_mask, dtype=dtype), device),
+            nlist,
+        )
+        self._ivfpq_index_cache = (cache_key, index)
         return index
 
     def _kneighbors_ivf(self, queries, k):
@@ -204,16 +339,9 @@ class NearestNeighborsModel(NearestNeighborsParams):
             device, dtype
         )
         nprobe = min(self.getNprobe(), nlist)
-        max_size = int(b_items.shape[1])
-        if k > nprobe * max_size:
-            raise ValueError(
-                f"k = {k} exceeds the ivfflat candidate pool "
-                f"(nprobe {nprobe} x largest bucket {max_size}); raise "
-                f"nprobe (or nlist) or use algorithm='brute'"
-            )
-        # smaller bucket than brute: the candidate gather is
-        # (bucket, nprobe·max_size, dim)
-        step = max(1, _QUERY_BUCKET // max(1, nprobe // 4))
+        step = self._ivf_pool_check_and_step(
+            "ivfflat", k, nprobe, int(b_items.shape[1])
+        )
 
         def kernel(q):
             d2, ids = ivf_search(
@@ -224,6 +352,31 @@ class NearestNeighborsModel(NearestNeighborsParams):
             return jnp.sqrt(jnp.maximum(d2, 0.0)), ids
 
         with TraceRange("knn ivf", TraceColor.GREEN):
+            return self._stream_queries(
+                queries, k, step, device, dtype, kernel
+            )
+
+    def _kneighbors_ivfpq(self, queries, k):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.knn_kernel import ivfpq_search
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        (centroids, codebooks, b_codes, b_ids, b_mask,
+         nlist) = self._ivfpq_index(device, dtype)
+        nprobe = min(self.getNprobe(), nlist)
+        step = self._ivf_pool_check_and_step(
+            "ivfpq", k, nprobe, int(b_ids.shape[1])
+        )
+
+        def kernel(q):
+            d2, ids = ivfpq_search(
+                q, centroids, codebooks, b_codes, b_ids, b_mask, k, nprobe
+            )
+            return jnp.sqrt(jnp.maximum(d2, 0.0)), ids
+
+        with TraceRange("knn ivfpq", TraceColor.GREEN):
             return self._stream_queries(
                 queries, k, step, device, dtype, kernel
             )

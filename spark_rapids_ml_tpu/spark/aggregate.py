@@ -233,12 +233,11 @@ def partition_logreg_stats(
             y = np.asarray(y, dtype=np.float64).reshape(-1)
         if x.shape[0] == 0:
             continue
-        bad = ~np.isin(y, (0.0, 1.0))
-        if bad.any():
-            raise ValueError(
-                "binary LogisticRegression requires 0/1 labels; found "
-                f"{np.unique(y[bad])[:5]}"
-            )
+        from spark_rapids_ml_tpu.models.logistic_regression import (
+            _check_binary,
+        )
+
+        _check_binary(y)
         z = x @ w + b
         p = 1.0 / (1.0 + np.exp(-z))
         r = p - y

@@ -172,6 +172,77 @@ def test_ivfflat_defaults_and_small_corpus(rng):
     np.testing.assert_array_equal(i[:, 0], np.arange(5))
 
 
+def test_ivfpq_recall_on_clustered_data(rng):
+    """IVF-PQ: ADC over product-quantized residuals keeps recall high on
+    clustered data; more probes must not reduce recall."""
+    centers = rng.normal(scale=10, size=(8, 16))
+    items = np.concatenate(
+        [rng.normal(loc=c, size=(80, 16)) for c in centers]
+    ).astype(np.float32)
+    queries = items[rng.choice(len(items), 40, replace=False)]
+    exact = NearestNeighbors().setK(10).fit(items)
+    _, ei = exact.kneighbors(queries)
+
+    def recall(nprobe):
+        m = (
+            NearestNeighbors()
+            .setK(10)
+            .setAlgorithm("ivfpq")
+            .setNlist(8)
+            .setNprobe(nprobe)
+            .setPqM(8)
+            .setPqBits(6)
+            .fit(items)
+        )
+        d, ai = m.kneighbors(queries)
+        assert d.shape == (40, 10) and (ai >= 0).all()
+        assert np.all(np.diff(d, axis=1) >= -1e-6)  # ascending
+        return np.mean([
+            len(set(ai[i]) & set(ei[i])) / 10 for i in range(len(queries))
+        ])
+
+    r_full = recall(8)
+    r_two = recall(2)
+    assert r_full > 0.7, r_full
+    assert r_two > 0.5, r_two
+    assert r_full >= r_two - 1e-9
+
+
+def test_ivfpq_auto_pq_m_and_defaults(rng):
+    items = rng.normal(size=(60, 12)).astype(np.float32)
+    m = NearestNeighbors().setK(5).setAlgorithm("ivfpq").fit(items)
+    d, i = m.kneighbors(items[:7])
+    assert d.shape == (7, 5) and i.shape == (7, 5)
+    assert (i >= 0).all() and (i < 60).all()
+
+
+def test_ivfpq_pq_m_must_divide_dim(rng):
+    items = rng.normal(size=(40, 16)).astype(np.float32)
+    m = (
+        NearestNeighbors()
+        .setK(3)
+        .setAlgorithm("ivfpq")
+        .setPqM(5)
+        .fit(items)
+    )
+    with pytest.raises(ValueError, match="must divide"):
+        m.kneighbors(items[:2])
+
+
+def test_ivfpq_k_exceeding_candidate_pool_rejected(rng):
+    items = rng.normal(scale=5, size=(64, 4)).astype(np.float32)
+    m = (
+        NearestNeighbors()
+        .setK(40)
+        .setAlgorithm("ivfpq")
+        .setNlist(16)
+        .setNprobe(1)
+        .fit(items)
+    )
+    with pytest.raises(ValueError, match="candidate pool"):
+        m.kneighbors(items[:3])
+
+
 def test_ivfflat_k_exceeding_candidate_pool_rejected(rng):
     """k beyond nprobe x largest bucket must raise, not return padding."""
     items = rng.normal(scale=5, size=(64, 4)).astype(np.float32)
