@@ -39,6 +39,10 @@ from spark_rapids_ml_tpu.models.logistic_regression import (  # noqa: F401
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.linear_svc import (  # noqa: F401
+    LinearSVC,
+    LinearSVCModel,
+)
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: F401
     NaiveBayes,
@@ -94,6 +98,8 @@ __all__ = [
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "LinearSVC",
+    "LinearSVCModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

@@ -52,6 +52,8 @@ _SPARK_CLASS_ALIASES = {
     "LinearRegressionModel": "org.apache.spark.ml.regression.LinearRegressionModel",
     "LogisticRegression": "org.apache.spark.ml.classification.LogisticRegression",
     "LogisticRegressionModel": "org.apache.spark.ml.classification.LogisticRegressionModel",
+    "LinearSVC": "org.apache.spark.ml.classification.LinearSVC",
+    "LinearSVCModel": "org.apache.spark.ml.classification.LinearSVCModel",
     "Pipeline": "org.apache.spark.ml.Pipeline",
     "PipelineModel": "org.apache.spark.ml.PipelineModel",
 }
@@ -75,6 +77,12 @@ _SPARK_PARAM_ALLOWLIST = {
     "LogisticRegressionModel": {"labelCol", "predictionCol", "probabilityCol",
                                 "maxIter", "tol", "regParam", "fitIntercept",
                                 "weightCol"},
+    "LinearSVC": {"labelCol", "predictionCol", "rawPredictionCol",
+                  "maxIter", "tol", "regParam", "fitIntercept",
+                  "standardization", "threshold", "weightCol"},
+    "LinearSVCModel": {"labelCol", "predictionCol", "rawPredictionCol",
+                       "maxIter", "tol", "regParam", "fitIntercept",
+                       "standardization", "threshold", "weightCol"},
     "StandardScaler": {"withMean", "withStd", "inputCol", "outputCol"},
     "StandardScalerModel": {"withMean", "withStd", "inputCol", "outputCol"},
 }
@@ -426,6 +434,47 @@ def save_linreg_model(model, path: str, overwrite: bool = False) -> None:
     _write_data_row(path, row, schema=schema, spark_fields=[
         ("coefficients", "vector"), ("intercept", "double"), ("scale", "double"),
     ])
+
+
+def save_svc_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark LinearSVCModel layout: (coefficients, intercept) — matching
+    ``LinearSVCModel.LinearSVCModelWriter`` upstream."""
+    if model.coefficients is None:
+        raise ValueError("cannot save an unfitted LinearSVCModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "coefficients": _dense_vector_struct(model.coefficients),
+        "intercept": float(model.intercept),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("coefficients", _vector_arrow_type()),
+                ("intercept", pa.float64()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("coefficients", "vector"), ("intercept", "double"),
+    ])
+
+
+def load_svc_model(path: str):
+    from spark_rapids_ml_tpu.models.linear_svc import LinearSVCModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = LinearSVCModel(
+        coefficients=_dense_vector_from_struct(row["coefficients"]),
+        intercept=float(row["intercept"]),
+        uid=meta["uid"],
+    )
+    return _restore_params(model, meta)
 
 
 def save_logreg_model(model, path: str, overwrite: bool = False) -> None:

@@ -8,6 +8,7 @@ from spark_rapids_ml_tpu.models.logistic_regression import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.linear_svc import LinearSVC, LinearSVCModel
 from spark_rapids_ml_tpu.models.nearest_neighbors import (
     NearestNeighbors,
     NearestNeighborsModel,
@@ -57,6 +58,8 @@ __all__ = [
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "LinearSVC",
+    "LinearSVCModel",
     "DBSCAN",
     "DBSCANModel",
     "NearestNeighbors",

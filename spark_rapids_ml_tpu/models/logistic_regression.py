@@ -333,11 +333,11 @@ class LogisticRegression(LogisticRegressionParams):
         return w, b, n_iter
 
 
-def _check_binary(y: np.ndarray) -> None:
+def _check_binary(y: np.ndarray, estimator: str = "LogisticRegression") -> None:
     bad = ~np.isin(y, (0.0, 1.0))
     if bad.any():
         raise ValueError(
-            f"binary LogisticRegression requires 0/1 labels; found "
+            f"binary {estimator} requires 0/1 labels; found "
             f"{np.unique(y[bad])[:5]}"
         )
 

@@ -140,3 +140,36 @@ def svc_fit_kernel(
 def svc_decision_kernel(x, coefficients, intercept):
     """Raw decision values x·w + b — Spark's rawPrediction margin."""
     return x @ coefficients + intercept
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_svc_stats(carry, batch_z, w, b, mask=None):
+    """Out-of-core Newton building block: fold one ``[X | y]`` batch's
+    squared-hinge partials (Xᵀ(aỹ), XᵀSX, Xᵀs, Σaỹ, Σs, n) at the
+    current (w, b) into a donated accumulator. One streamed pass with
+    this per batch = one generalized-Newton gradient/Hessian evaluation
+    over the full dataset — the SVC analogue of
+    ``ops.logreg_kernel.update_logreg_stats``."""
+    gx, hxx, hxb, aysum, ssum, cnt = carry
+    x = batch_z[:, :-1].astype(gx.dtype)
+    y = batch_z[:, -1].astype(gx.dtype)
+    valid = (
+        jnp.ones(x.shape[0], dtype=x.dtype) if mask is None
+        else mask.astype(x.dtype)
+    )
+    y_pm = 2.0 * y - 1.0
+    margin = 1.0 - y_pm * (x @ w + b)
+    a = jnp.maximum(margin, 0.0) * valid
+    s = jnp.where(margin > 0, 1.0, 0.0) * valid
+    ay = a * y_pm
+    xs = x * s[:, None]
+    return (
+        gx + lax.dot_general(x, ay, (((0,), (0,)), ((), ())),
+                             precision=lax.Precision.HIGHEST),
+        hxx + lax.dot_general(x, xs, (((0,), (0,)), ((), ())),
+                              precision=lax.Precision.HIGHEST),
+        hxb + jnp.sum(xs, axis=0),
+        aysum + jnp.sum(ay),
+        ssum + jnp.sum(s),
+        cnt + jnp.sum(valid),
+    )

@@ -21,6 +21,10 @@ from spark_rapids_ml_tpu.parallel.distributed_logreg import (
     distributed_logreg_fit,
     distributed_logreg_fit_kernel,
 )
+from spark_rapids_ml_tpu.parallel.distributed_svc import (
+    distributed_svc_fit,
+    distributed_svc_fit_kernel,
+)
 from spark_rapids_ml_tpu.parallel.feature_sharded import (
     feature_sharded_covariance_kernel,
     feature_sharded_pca_fit,
@@ -40,6 +44,8 @@ __all__ = [
     "distributed_linreg_fit_kernel",
     "distributed_logreg_fit",
     "distributed_logreg_fit_kernel",
+    "distributed_svc_fit",
+    "distributed_svc_fit_kernel",
     "feature_sharded_covariance_kernel",
     "feature_sharded_pca_fit",
 ]

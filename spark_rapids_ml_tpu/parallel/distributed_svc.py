@@ -1,0 +1,90 @@
+"""Distributed LinearSVC over the mesh.
+
+Same shape as the other distributed fits: rows sharded over ``data``,
+per-shard squared-hinge partials, one fused ``psum`` per generalized-
+Newton iteration INSIDE the compiled while_loop, replicated (n+1)²
+solve — filling the ``reduce_fn`` slot ``ops/svm_kernel.py`` declares.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops.svm_kernel import SvcResult, svc_newton_iterations
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    pad_rows_to_multiple,
+    row_sharding,
+)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "fit_intercept", "max_iter"),
+)
+def distributed_svc_fit_kernel(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-8,
+) -> SvcResult:
+    def shard_fn(x_shard, y_shard, mask_shard):
+        return tuple(
+            svc_newton_iterations(
+                x_shard, y_shard, mask_shard,
+                reg_param, fit_intercept, max_iter, tol,
+                reduce_fn=lambda t: jax.lax.psum(t, DATA_AXIS),
+            )
+        )
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P(), P()),
+    )
+    coef, intercept, n_iter, converged = fn(x, y, mask)
+    return SvcResult(coef, intercept, n_iter, converged)
+
+
+def distributed_svc_fit(
+    x_host: np.ndarray,
+    y_host: np.ndarray,
+    mesh: Mesh,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-8,
+    dtype=None,
+) -> SvcResult:
+    x_host = np.asarray(x_host)
+    y_host = np.asarray(y_host).reshape(-1)
+    n_dev = mesh.devices.size
+    x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
+    y_padded = np.zeros(x_padded.shape[0], dtype=y_host.dtype)
+    y_padded[: y_host.shape[0]] = y_host
+    if dtype is not None:
+        x_padded = x_padded.astype(dtype)
+        y_padded = y_padded.astype(dtype)
+        mask = mask.astype(dtype)
+    x_dev = jax.device_put(x_padded, row_sharding(mesh))
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+    y_dev = jax.device_put(y_padded, shard1)
+    mask_dev = jax.device_put(mask, shard1)
+    return jax.block_until_ready(
+        distributed_svc_fit_kernel(
+            x_dev, y_dev, mask_dev,
+            mesh=mesh, reg_param=reg_param, fit_intercept=fit_intercept,
+            max_iter=max_iter, tol=tol,
+        )
+    )

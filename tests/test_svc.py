@@ -1,0 +1,202 @@
+"""LinearSVC vs the sklearn squared-hinge oracle: device/host path
+equality, Spark objective convention (λ ↔ sklearn C = 1/(n·λ)),
+standardization semantics, weighted/streamed/distributed fits,
+persistence, OneVsRest compatibility, guards."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import LinearSVC, LinearSVCModel, OneVsRest
+
+sklearn_svm = pytest.importorskip("sklearn.svm")
+
+
+@pytest.fixture
+def data(rng):
+    n = 2000
+    x = rng.normal(size=(n, 8))
+    w_true = np.array([1.5, -2.0, 0.7, 0.0, 3.0, -0.3, 1.0, -1.2])
+    margin = x @ w_true + 0.4 + rng.normal(scale=2.0, size=n)
+    y = (margin > 0).astype(np.float64)
+    return x, y
+
+
+def _sklearn_fit(x, y, reg_param, fit_intercept=True):
+    # same objective up to a 1/(n·λ) factor; intercept_scaling large so
+    # liblinear's penalized-intercept trick approximates Spark's
+    # unpenalized intercept
+    c = 1.0 / (len(y) * reg_param)
+    m = sklearn_svm.LinearSVC(
+        loss="squared_hinge", dual=False, C=c,
+        fit_intercept=fit_intercept, intercept_scaling=1e3,
+        tol=1e-12, max_iter=200000,
+    ).fit(x, y)
+    return m.coef_.ravel(), float(m.intercept_[0]) if fit_intercept else 0.0
+
+
+@pytest.mark.parametrize("use_xla", [True, False])
+@pytest.mark.parametrize("reg_param", [0.01, 0.1])
+def test_svc_matches_sklearn(data, use_xla, reg_param):
+    x, y = data
+    model = (
+        LinearSVC().setRegParam(reg_param).setUseXlaDot(use_xla)
+        .setStandardization(False).fit(x, y)
+    )
+    coef_sk, b_sk = _sklearn_fit(x, y, reg_param)
+    np.testing.assert_allclose(model.coefficients, coef_sk, atol=2e-3)
+    assert abs(model.intercept - b_sk) < 2e-3
+
+
+def test_svc_no_intercept(data):
+    x, y = data
+    model = (
+        LinearSVC().setRegParam(0.05).setFitIntercept(False)
+        .setStandardization(False).fit(x, y)
+    )
+    coef_sk, _ = _sklearn_fit(x, y, 0.05, fit_intercept=False)
+    np.testing.assert_allclose(model.coefficients, coef_sk, atol=2e-3)
+    assert model.intercept == 0.0
+
+
+def test_svc_xla_host_paths_agree(data):
+    x, y = data
+    xla = LinearSVC().setRegParam(0.02).setUseXlaDot(True).fit(x, y)
+    host = LinearSVC().setRegParam(0.02).setUseXlaDot(False).fit(x, y)
+    np.testing.assert_allclose(xla.coefficients, host.coefficients,
+                               atol=1e-8)
+    assert abs(xla.intercept - host.intercept) < 1e-8
+
+
+def test_svc_standardization_matches_manual_prescale(data):
+    x, y = data
+    sd = x.std(axis=0, ddof=1)
+    manual = (
+        LinearSVC().setRegParam(0.03).setStandardization(False)
+        .fit(x / sd[None, :], y)
+    )
+    auto = LinearSVC().setRegParam(0.03).fit(x, y)  # default True
+    np.testing.assert_allclose(
+        auto.coefficients, manual.coefficients / sd, atol=1e-8
+    )
+    assert abs(auto.intercept - manual.intercept) < 1e-8
+
+
+@pytest.mark.parametrize("standardize", [False, True])
+def test_svc_weightcol_equals_row_duplication(rng, standardize):
+    # holds with standardization too: the weighted std uses the
+    # frequency-weight (Σw − 1) denominator, so weight k ≡ k copies
+    x = rng.normal(size=(300, 5))
+    y = (x @ np.array([1.0, -1.0, 0.5, 0.0, 2.0]) > 0).astype(np.float64)
+    w = rng.integers(1, 4, size=300).astype(np.float64)
+    x_dup = np.repeat(x, w.astype(int), axis=0)
+    y_dup = np.repeat(y, w.astype(int))
+    dup = (
+        LinearSVC().setRegParam(0.05).setStandardization(standardize)
+        .fit(x_dup, y_dup)
+    )
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    frame = as_vector_frame(x, "features").with_column(
+        "label", y.tolist()
+    ).with_column("w", w.tolist())
+    weighted = (
+        LinearSVC().setRegParam(0.05).setStandardization(standardize)
+        .setWeightCol("w").fit(frame)
+    )
+    np.testing.assert_allclose(
+        weighted.coefficients, dup.coefficients, atol=1e-7
+    )
+    assert abs(weighted.intercept - dup.intercept) < 1e-7
+
+
+def test_svc_streamed_matches_oneshot(data):
+    x, y = data
+    oneshot = (
+        LinearSVC().setRegParam(0.02).setStandardization(False).fit(x, y)
+    )
+    streamed = LinearSVC().setRegParam(0.02).setStandardization(False).fit(
+        lambda: ((x[i:i + 333], y[i:i + 333]) for i in range(0, len(y), 333))
+    )
+    np.testing.assert_allclose(
+        streamed.coefficients, oneshot.coefficients, atol=5e-6
+    )
+    assert abs(streamed.intercept - oneshot.intercept) < 5e-6
+
+
+def test_svc_distributed_matches_single(data):
+    import jax
+
+    from spark_rapids_ml_tpu.parallel import data_mesh, distributed_svc_fit
+
+    x, y = data
+    mesh = data_mesh(len(jax.devices()))
+    res = distributed_svc_fit(x, y, mesh, reg_param=0.02)
+    single = (
+        LinearSVC().setRegParam(0.02).setStandardization(False).fit(x, y)
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.coefficients), single.coefficients, atol=1e-7
+    )
+    assert abs(float(res.intercept) - single.intercept) < 1e-7
+
+
+def test_svc_transform_and_threshold(data):
+    x, y = data
+    model = LinearSVC().setRegParam(0.01).fit(x, y)
+    out = model.transform(x)
+    raw = np.asarray(out.column("rawPrediction"))
+    pred = np.asarray(out.column("prediction"))
+    np.testing.assert_array_equal(pred, (raw > 0.0).astype(np.float64))
+    assert model.evaluate(x, y)["accuracy"] > 0.8
+    model.set("threshold", float(np.median(raw)))
+    pred2 = model.predict(x)
+    assert 0.4 < pred2.mean() < 0.6
+
+
+def test_svc_persistence_roundtrip(tmp_path, data):
+    x, y = data
+    model = LinearSVC().setRegParam(0.01).setMaxIter(50).fit(x, y)
+    path = str(tmp_path / "svc")
+    model.save(path)
+    loaded = LinearSVCModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+    assert loaded.intercept == model.intercept
+    assert loaded.getMaxIter() == 50
+    np.testing.assert_array_equal(loaded.predict(x), model.predict(x))
+
+
+def test_svc_estimator_params_roundtrip(tmp_path):
+    est = LinearSVC().setRegParam(0.5).setStandardization(False)
+    path = str(tmp_path / "svc_est")
+    est.save(path)
+    loaded = LinearSVC.load(path)
+    assert loaded.getRegParam() == 0.5
+    assert loaded.getStandardization() is False
+
+
+def test_svc_under_onevsrest(rng):
+    x = rng.normal(size=(600, 4))
+    centers = np.array([[3, 0, 0, 0], [0, 3, 0, 0], [0, 0, 3, 0]])
+    y = rng.integers(0, 3, size=600).astype(np.float64)
+    x = x + centers[y.astype(int)]
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    frame = as_vector_frame(x, "features").with_column("label", y.tolist())
+    ovr = OneVsRest(classifier=LinearSVC().setRegParam(0.01)).fit(frame)
+    pred = np.asarray(ovr.transform(frame).column("prediction"))
+    assert (pred == y).mean() > 0.9
+
+
+def test_svc_rejects_nonbinary_labels(rng):
+    x = rng.normal(size=(50, 3))
+    y = rng.integers(0, 3, size=50).astype(np.float64)
+    with pytest.raises(ValueError, match="LinearSVC requires 0/1 labels"):
+        LinearSVC().fit(x, y)
+
+
+def test_svc_streamed_guards(data):
+    x, y = data
+    with pytest.raises(ValueError, match="standardization"):
+        LinearSVC().fit(
+            lambda: ((x[:100], y[:100]),)
+        )
