@@ -86,10 +86,13 @@ class PCAParams(HasInputCol, HasOutputCol, HasDeviceId):
         "(top-k Halko-Martinsson-Tropp subspace iteration, O(n^2 k) MXU "
         "matmuls instead of O(n^3) — ~100x faster at n=4096 k=256, "
         "per-vector accuracy depends on spectral gaps; see "
-        "ops/randomized.py). Host fallbacks (useXlaSvd=False) always use "
-        "dense LAPACK regardless.",
-        "eigh",
-        validator=lambda v: v in ("eigh", "randomized"),
+        "ops/randomized.py) or 'auto' (randomized when k<<n on large "
+        "covariances, residual-gated with dense-eigh fallback on eager "
+        "paths — see ops.eigh.pca_from_covariance_gated; the model "
+        "records the choice in svd_solver_used_). Host fallbacks "
+        "(useXlaSvd=False) always use dense LAPACK regardless.",
+        "auto",
+        validator=lambda v: v in ("auto", "eigh", "randomized"),
     )
     batchRows = Param(
         "batchRows",
@@ -183,8 +186,23 @@ class PCA(PCAParams):
 
         return load_params(PCA, path)
 
+    def _solve_cov_gated(self, cov, k):
+        """Device eigensolve honoring svdSolver, through the residual gate
+        ('auto' → randomized when k ≪ n, verified, dense-eigh fallback);
+        records the choice for ``model.svd_solver_used_``."""
+        import jax
+
+        from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance_gated
+
+        pc, evr, used = pca_from_covariance_gated(
+            cov, k, solver=self.getSvdSolver()
+        )
+        self._svd_solver_used = used
+        return jax.block_until_ready((pc, evr))
+
     def fit(self, dataset) -> "PCAModel":
         timer = PhaseTimer()
+        self._svd_solver_used = None  # set by device solves; None = host LAPACK
         k = self.getK()
         if k is None:
             raise ValueError("k must be set before fit()")
@@ -247,6 +265,7 @@ class PCA(PCAParams):
         model.uid = self.uid
         model.copy_values_from(self)
         model.fit_timings_ = timer.as_dict()
+        model.svd_solver_used_ = getattr(self, "_svd_solver_used", None)
         return model
 
     # -- streamed (out-of-core) path -------------------------------------
@@ -255,7 +274,6 @@ class PCA(PCAParams):
             import jax
             import jax.numpy as jnp
 
-            from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
             from spark_rapids_ml_tpu.ops.streaming import stream_covariance
 
             device = _resolve_device(self.getDeviceId())
@@ -274,7 +292,7 @@ class PCA(PCAParams):
                 raise ValueError("mean centering requires more than one row")
             if use_xla_svd:
                 with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
-                    pc, evr = jax.block_until_ready(pca_from_covariance(cov, k, solver=self.getSvdSolver()))
+                    pc, evr = self._solve_cov_gated(cov, k)
                 return np.asarray(pc), np.asarray(evr), np.asarray(mean)
             with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
                 pc, evr = _host_eig_topk(np.asarray(cov, dtype=np.float64), k)
@@ -292,13 +310,11 @@ class PCA(PCAParams):
             import jax
             import jax.numpy as jnp
 
-            from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
-
             device = _resolve_device(self.getDeviceId())
             dtype = _resolve_dtype(self.getDtype())
             with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
                 cov_dev = jax.device_put(jnp.asarray(cov, dtype=dtype), device)
-                pc, evr = jax.block_until_ready(pca_from_covariance(cov_dev, k, solver=self.getSvdSolver()))
+                pc, evr = self._solve_cov_gated(cov_dev, k)
             return np.asarray(pc), np.asarray(evr), mean
         with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
             pc, evr = _host_eig_topk(cov, k)
@@ -310,7 +326,6 @@ class PCA(PCAParams):
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.ops.covariance import column_means, covariance
-        from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
         from spark_rapids_ml_tpu.ops.pca_kernel import pca_fit_kernel
 
         device = _resolve_device(self.getDeviceId())
@@ -337,22 +352,53 @@ class PCA(PCAParams):
                 cov = jax.block_until_ready(cov)
             if use_xla_svd:
                 with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
-                    pc, evr = jax.block_until_ready(pca_from_covariance(cov, k, solver=self.getSvdSolver()))
+                    pc, evr = self._solve_cov_gated(cov, k)
                 return np.asarray(pc), np.asarray(evr), np.asarray(mean)
             with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
                 pc, evr = _host_eig_topk(np.asarray(cov, dtype=np.float64), k)
             return pc, evr, np.asarray(mean)
 
         if use_xla_dot and use_xla_svd:
+            solver = self.getSvdSolver()
+            from spark_rapids_ml_tpu.ops.eigh import resolve_auto_solver
+
+            if (solver == "auto"
+                    and resolve_auto_solver(x_host.shape[1], k)
+                    == "randomized"):
+                # 'auto' promises the residual-gated randomized solve, and
+                # the gate needs one host read — so this path runs TWO
+                # compiled programs (covariance, gated solve) instead of
+                # one; 'eigh'/'randomized' explicitly keep the fused
+                # single-program pipeline below
+                with timer.phase("h2d"):
+                    x = jax.device_put(jnp.asarray(x_host, dtype=dtype),
+                                       device)
+                with timer.phase("covariance"), TraceRange(
+                    "compute cov", TraceColor.RED
+                ):
+                    if mean_centering:
+                        mean = column_means(x)
+                        cov = covariance(x, mean=mean)
+                    else:
+                        mean = jnp.zeros((x.shape[1],), dtype=x.dtype)
+                        cov = covariance(x)
+                with timer.phase("solve"), TraceRange("xla eigh",
+                                                      TraceColor.BLUE):
+                    pc, evr = self._solve_cov_gated(cov, k)
+                return pc, evr, jax.block_until_ready(mean)
+
             # Whole pipeline in ONE compiled program on device.
             with timer.phase("h2d"):
                 x = jax.device_put(jnp.asarray(x_host, dtype=dtype), device)
             with timer.phase("fit_kernel"), TraceRange("compute cov", TraceColor.RED):
                 result = pca_fit_kernel(
-                    x, k, mean_centering=mean_centering,
-                    solver=self.getSvdSolver(),
+                    x, k, mean_centering=mean_centering, solver=solver,
                 )
                 result = jax.block_until_ready(result)
+            self._svd_solver_used = (
+                resolve_auto_solver(x_host.shape[1], k)
+                if solver == "auto" else solver
+            )
             return result.components, result.explained_variance, result.mean
 
         if use_xla_dot:
@@ -378,8 +424,7 @@ class PCA(PCAParams):
             cov, mean = _host_covariance(x_host, self.getMeanCentering())
         with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
             cov_dev = jax.device_put(jnp.asarray(cov, dtype=dtype), device)
-            pc, evr = pca_from_covariance(cov_dev, k, solver=self.getSvdSolver())
-            pc, evr = jax.block_until_ready((pc, evr))
+            pc, evr = self._solve_cov_gated(cov_dev, k)
         return np.asarray(pc), np.asarray(evr), mean
 
     # -- host fallback path ----------------------------------------------
@@ -511,11 +556,13 @@ class PCAModel(PCAParams):
         self.explained_variance = explained_variance
         self.mean = mean
         self.fit_timings_ = {}
+        self.svd_solver_used_ = None
 
     def _copy_internal_state(self, other: "PCAModel") -> None:
         other.pc = self.pc
         other.explained_variance = self.explained_variance
         other.mean = self.mean
+        other.svd_solver_used_ = self.svd_solver_used_
 
     @property
     def explainedVariance(self):

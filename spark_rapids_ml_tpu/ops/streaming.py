@@ -75,6 +75,10 @@ def finalize_stats(
         mean = stats.col_sum / stats.count
     else:
         mean = jnp.zeros_like(stats.col_sum)
+    # 'auto' resolves statically (this function is jitted, so the residual
+    # gate cannot run here — eager callers wanting the gate use
+    # ops.eigh.pca_from_covariance_gated directly, as bench.py and the
+    # PCA model's _solve_cov_gated do)
     components, evr = pca_from_covariance(
         cov, k, flip_signs=flip_signs, solver=solver
     )
