@@ -25,8 +25,34 @@ _PYSPARK_CLASSES = (
     "KMeansModel",
 )
 
+# generic-adapter front-ends (spark/adapter.py): driver-device fit +
+# pandas_udf transform for the non-sufficient-statistics families
+_ADAPTER_CLASSES = (
+    "RandomForestClassifier",
+    "RandomForestClassifierModel",
+    "RandomForestRegressor",
+    "RandomForestRegressorModel",
+    "GBTClassifier",
+    "GBTClassifierModel",
+    "GBTRegressor",
+    "GBTRegressorModel",
+    "NaiveBayes",
+    "NaiveBayesModel",
+    "LinearSVC",
+    "LinearSVCModel",
+    "StandardScaler",
+    "StandardScalerModel",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
+    "MaxAbsScaler",
+    "MaxAbsScalerModel",
+    "NearestNeighbors",
+    "NearestNeighborsModel",
+)
+
 __all__ = [
     *_PYSPARK_CLASSES,
+    *_ADAPTER_CLASSES,
     "combine_stats",
     "finalize_pca_from_stats",
     "partition_gram_stats",
@@ -35,13 +61,14 @@ __all__ = [
 
 
 def __getattr__(name):
+    # binds to real pyspark when importable, else to the in-repo local
+    # engine (spark/_compat.py) — same front-end code either way
     if name in _PYSPARK_CLASSES:
-        try:
-            from spark_rapids_ml_tpu.spark import estimator
-        except ImportError as exc:  # pragma: no cover - depends on env
-            raise ImportError(
-                f"spark_rapids_ml_tpu.spark.{name} requires pyspark "
-                "(an optional dependency): pip install pyspark"
-            ) from exc
+        from spark_rapids_ml_tpu.spark import estimator
+
         return getattr(estimator, name)
+    if name in _ADAPTER_CLASSES:
+        from spark_rapids_ml_tpu.spark import adapter
+
+        return getattr(adapter, name)
     raise AttributeError(name)

@@ -1,0 +1,271 @@
+"""Executor-side DEVICE aggregation: Arrow batches → stats on this
+executor's accelerator.
+
+The reference's defining architecture puts the accelerator on every
+executor: each Spark partition is centered and multiplied on that
+executor's GPU (``RapidsRowMatrix.scala:168-202``, native GEMM
+``rapidsml_jni.cu:172-258``), with ``spark.executor.resource.gpu``
+scheduling the chips. ``spark/aggregate.py`` is the host-CPU (NumPy f64)
+fallback of that plane; THIS module is the accelerator path: the partition
+iterator streams through the device-resident donated accumulator
+(``ops/streaming.py``) on the executor's own JAX device — the TPU is where
+the O(rows·n²) Gram work happens, executor CPUs only densify Arrow
+batches.
+
+Executor device selection mirrors the reference's ``gpuId`` task-resource
+semantics (``RapidsRowMatrix.scala:171-175``): ``device_id=-1`` resolves
+through ``utils.resources.resolve_device_ordinal`` (task env /
+``TPU_VISIBLE_CHIPS`` pinning from ``scripts/get_tpus_resources.sh``
+discovery), so one chip-pinned executor process sees one chip.
+
+Batches are padded to power-of-two row buckets with a validity mask, so
+an arbitrary partition produces a handful of compiled shapes, not one
+compilation per batch size.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.spark.aggregate import (
+    stats_arrow_schema,
+    vector_column_to_matrix,
+)
+
+_MIN_BUCKET = 256
+
+
+def executor_device_available() -> bool:
+    """True when this process can reach an ACCELERATOR JAX device (the
+    CPU backend always registers a device, so its presence alone must not
+    defeat the documented host-NumPy-f64 fallback of
+    ``executorDevice='auto'``; import failure / no plugin / CPU-only all
+    mean 'use the host path'). ``'on'`` forces the device path regardless
+    — that is how CPU-device tests exercise it."""
+    try:
+        import jax
+
+        return any(d.platform != "cpu" for d in jax.local_devices())
+    except Exception:  # noqa: BLE001 - any init failure ⇒ host fallback
+        return False
+
+
+def _bucket_rows(m: int) -> int:
+    b = _MIN_BUCKET
+    while b < m:
+        b *= 2
+    return b
+
+
+def partition_gram_stats_device(
+    batches: Iterable,
+    input_col: str,
+    device_id: int = -1,
+    dtype: str = "auto",
+) -> Iterator[Dict[str, object]]:
+    """One partition's (Σxxᵀ, Σx, n), accumulated ON this executor's
+    accelerator.
+
+    Same contract and output row as ``aggregate.partition_gram_stats``
+    (so the driver-side ``combine_stats`` is shared), but the Gram runs as
+    jitted MXU matmuls into a donated device accumulator instead of NumPy
+    on the executor CPU. The f64→f32 note: on accelerators the compute
+    dtype follows the platform default (f32 on TPU) — the same documented
+    precision envelope as every other streamed device fit in this repo.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+    from spark_rapids_ml_tpu.ops.streaming import init_stats, update_stats_auto
+
+    device = _resolve_device(device_id)
+    dt = _resolve_dtype(dtype)
+    stats = None
+    n_features: Optional[int] = None
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(input_col))
+        else:
+            x = np.asarray(batch, dtype=np.float64)
+        m = x.shape[0]
+        if m == 0:
+            continue
+        if stats is None:
+            n_features = x.shape[1]
+            stats = init_stats(n_features, dtype=dt, device=device)
+        bucket = _bucket_rows(m)
+        if bucket != m:
+            padded = np.zeros((bucket, n_features), dtype=x.dtype)
+            padded[:m] = x
+            mask = np.zeros(bucket, dtype=bool)
+            mask[:m] = True
+            stats = update_stats_auto(
+                stats, jnp.asarray(padded, dtype=dt), jnp.asarray(mask)
+            )
+        else:
+            stats = update_stats_auto(stats, jnp.asarray(x, dtype=dt))
+    if stats is None:
+        return
+    stats = jax.block_until_ready(stats)
+    yield {
+        "gram": np.asarray(stats.gram, dtype=np.float64).ravel().tolist(),
+        "col_sum": np.asarray(stats.col_sum, dtype=np.float64).tolist(),
+        "count": int(stats.count),
+    }
+
+
+def partition_gram_stats_device_arrow(
+    batches, input_col: str, device_id: int = -1
+):
+    """``mapInArrow`` adapter for the device path — same output schema as
+    the host adapter, so driver combine/finalize code is shared."""
+    import pyarrow as pa
+
+    for row in partition_gram_stats_device(batches, input_col, device_id):
+        yield pa.RecordBatch.from_pylist([row], schema=stats_arrow_schema())
+
+
+def _task_identity():
+    """(partition_id, num_partitions) of the running barrier task.
+
+    pyspark's ``TaskContext`` when available (real clusters); the local
+    engine's exported env otherwise."""
+    import os
+
+    try:  # pragma: no cover - pyspark environments
+        from pyspark import TaskContext
+
+        ctx = TaskContext.get()
+        if ctx is not None:
+            return int(ctx.partitionId()), int(ctx.numPartitions())
+    except ImportError:
+        pass
+    pid = os.environ.get("LOCALSPARK_PARTITION_ID")
+    n = os.environ.get("LOCALSPARK_NUM_PARTITIONS")
+    if pid is None or n is None:
+        raise RuntimeError(
+            "collective executor aggregation needs barrier task identity "
+            "(pyspark TaskContext or the local engine's process executors)"
+        )
+    return int(pid), int(n)
+
+
+def partition_gram_stats_device_collective(
+    batches,
+    input_col: str,
+    coordinator: str,
+    n_features: int,
+    device_id: int = -1,
+    dtype: str = "auto",
+):
+    """Barrier-stage executor aggregation with an ON-DEVICE global reduce.
+
+    The full reference architecture, TPU-native end to end: every barrier
+    task streams its partition through its own accelerator's donated
+    accumulator (as ``partition_gram_stats_device``), then all tasks join
+    one ``jax.distributed`` job (coordinator = the partition-0 host) and
+    the partial (Σxxᵀ, Σx, n) are summed by ONE compiled collective over
+    the global device mesh — the ``psum`` that replaces the reference's
+    executor→driver Spark-RPC reduce of n×n partials
+    (``RapidsRowMatrix.scala:202``). Only partition 0 emits the combined
+    row; the driver-side ``combine_stats`` sees exactly one row and adds
+    nothing.
+
+    Reachability note: the coordinator service binds inside the
+    partition-0 task, so ``coordinator`` must be an address the other
+    executors can reach — automatic for single-host executor fleets (the
+    local engine, one-box Spark); multi-host fleets pre-set
+    ``SPARK_RAPIDS_ML_TPU_COORDINATOR`` to a routable host:port.
+    """
+    import os
+
+    import pyarrow as pa
+
+    part_id, n_parts = _task_identity()
+    os.environ["SPARK_RAPIDS_ML_TPU_COORDINATOR"] = coordinator
+    os.environ["SPARK_RAPIDS_ML_TPU_NUM_PROCESSES"] = str(n_parts)
+    os.environ["SPARK_RAPIDS_ML_TPU_PROCESS_ID"] = str(part_id)
+
+    from spark_rapids_ml_tpu.parallel.multihost import (
+        global_data_mesh,
+        initialize_multihost,
+        make_global_array,
+    )
+
+    joined = initialize_multihost()
+    if not joined and n_parts > 1:
+        raise RuntimeError(
+            "collective aggregation: failed to join the "
+            f"{n_parts}-process jax.distributed job at {coordinator}"
+        )
+
+    local = list(partition_gram_stats_device(
+        batches, input_col, device_id, dtype
+    ))
+    import numpy as np_
+
+    n = int(n_features)
+    if local:
+        gram = np_.asarray(local[0]["gram"], dtype=np_.float64)
+        col_sum = np_.asarray(local[0]["col_sum"], dtype=np_.float64)
+        count = int(local[0]["count"])
+        if col_sum.shape[0] != n:
+            raise ValueError(
+                f"partition feature dim {col_sum.shape[0]} != driver-"
+                f"announced {n}"
+            )
+    else:
+        # empty partition still joins the collective with zeros — bailing
+        # out here would strand every other barrier task inside the reduce
+        gram = np_.zeros(n * n)
+        col_sum = np_.zeros(n)
+        count = 0
+
+    if n_parts == 1:
+        if local:
+            yield pa.RecordBatch.from_pylist([local[0]],
+                                             schema=stats_arrow_schema())
+        return
+
+    import jax
+    import jax.numpy as jnp
+
+    # one (1, n²+n) float row + one (1, 2) int32 count per process,
+    # row-sharded over the global mesh; the jitted sums over the process
+    # axis ARE the cross-host collective (XLA lowers them over ICI/DCN),
+    # outputs replicated to every process. Floats ride f32 — the device
+    # accumulator's own dtype on TPU (x64 is CPU-only). The count rides
+    # TWO int32 lanes (hi = count >> 20, lo = count & 0xFFFFF): int64
+    # would silently downcast without x64, and a single int32 lane wraps
+    # at 2^31 total rows — split lanes stay exact to 2^51 rows for up to
+    # ~2k partitions
+    mesh = global_data_mesh()
+    repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    packed = np_.concatenate([gram.ravel(), col_sum]).astype(
+        np_.float32
+    )[None, :]
+    counts = np_.asarray(
+        [[count >> 20, count & 0xFFFFF]], dtype=np_.int32
+    )
+    global_rows = make_global_array(packed, mesh, n_parts)
+    global_counts = make_global_array(counts, mesh, n_parts)
+    total, count_lanes = jax.jit(
+        lambda r, c: (jnp.sum(r, axis=0), jnp.sum(c, axis=0)),
+        out_shardings=(repl, repl),
+    )(global_rows, global_counts)
+    total = np_.asarray(total, dtype=np_.float64)
+    hi, lo = (int(v) for v in np_.asarray(count_lanes))
+    count_total = (hi << 20) + lo
+    if part_id != 0:
+        return
+    yield pa.RecordBatch.from_pylist(
+        [{
+            "gram": total[: n * n].tolist(),
+            "col_sum": total[n * n :].tolist(),
+            "count": count_total,
+        }],
+        schema=stats_arrow_schema(),
+    )

@@ -26,11 +26,19 @@ from __future__ import annotations
 
 import numpy as np
 
-from pyspark import keyword_only
-from pyspark.ml import Estimator, Model
-from pyspark.ml.linalg import DenseMatrix, DenseVector, VectorUDT
-from pyspark.ml.param import Param, Params, TypeConverters
-from pyspark.ml.param.shared import HasInputCol, HasOutputCol
+from spark_rapids_ml_tpu.spark._compat import (
+    DenseMatrix,
+    DenseVector,
+    Estimator,
+    HasInputCol,
+    HasOutputCol,
+    Model,
+    Param,
+    Params,
+    TypeConverters,
+    VectorUDT,
+    keyword_only,
+)
 
 from spark_rapids_ml_tpu.spark.aggregate import (
     combine_stats,
@@ -58,11 +66,22 @@ class _TpuPCAParams(HasInputCol, HasOutputCol):
     deviceId = Param(Params._dummy(), "deviceId",
                      "driver accelerator ordinal; -1 = task/env assignment",
                      typeConverter=TypeConverters.toInt)
+    executorDevice = Param(
+        Params._dummy(), "executorDevice",
+        "where partition statistics run: 'auto' = each executor's "
+        "accelerator when one is reachable (the reference's "
+        "GPU-on-every-executor architecture), host NumPy otherwise; "
+        "'on' = require the executor device (fail loudly; CPU devices "
+        "allowed — how tests drive it); 'off' = always executor-CPU "
+        "NumPy; 'collective' = barrier stage + on-device global reduce "
+        "over a joint jax.distributed mesh (no executor-to-driver "
+        "partial shipping)",
+        typeConverter=TypeConverters.toString)
 
     def __init__(self):
         super().__init__()
         self._setDefault(k=None, meanCentering=True, useXlaDot=True,
-                         useXlaSvd=True, deviceId=-1)
+                         useXlaSvd=True, deviceId=-1, executorDevice="auto")
 
     def getK(self):
         return self.getOrDefault(self.k)
@@ -79,6 +98,9 @@ class _TpuPCAParams(HasInputCol, HasOutputCol):
     def getDeviceId(self):
         return self.getOrDefault(self.deviceId)
 
+    def getExecutorDevice(self):
+        return self.getOrDefault(self.executorDevice)
+
 
 class PCA(Estimator, _TpuPCAParams):
     """``PCA(k=3, inputCol="features", outputCol="pca_features").fit(df)`` —
@@ -87,7 +109,7 @@ class PCA(Estimator, _TpuPCAParams):
     @keyword_only
     def __init__(self, *, k=None, inputCol=None, outputCol="pca_features",
                  meanCentering=True, useXlaDot=True, useXlaSvd=True,
-                 deviceId=-1):
+                 deviceId=-1, executorDevice="auto"):
         super().__init__()
         self._setDefault(outputCol="pca_features")
         kwargs = self._input_kwargs
@@ -96,7 +118,7 @@ class PCA(Estimator, _TpuPCAParams):
     @keyword_only
     def setParams(self, *, k=None, inputCol=None, outputCol=None,
                   meanCentering=None, useXlaDot=None, useXlaSvd=None,
-                  deviceId=None):
+                  deviceId=None, executorDevice=None):
         kwargs = self._input_kwargs
         return self._set(**{k_: v for k_, v in kwargs.items() if v is not None})
 
@@ -121,17 +143,89 @@ class PCA(Estimator, _TpuPCAParams):
     def setDeviceId(self, value):
         return self._set(deviceId=value)
 
+    def setExecutorDevice(self, value):
+        return self._set(executorDevice=value)
+
     def _fit(self, dataset) -> "PCAModel":
         k = self.getK()
         if k is None:
             raise ValueError("k must be set before fit()")
         input_col = self.getInputCol()
         df = dataset.select(input_col)
+        executor_device = self.getExecutorDevice()
+        if executor_device not in ("auto", "on", "off", "collective"):
+            raise ValueError(
+                f"executorDevice={executor_device!r}: expected "
+                "'auto', 'on', 'off', or 'collective'"
+            )
+        device_id = self.getDeviceId()
 
-        def stats(batches):
-            return partition_gram_stats_arrow(batches, input_col)
+        if executor_device == "collective":
+            # barrier stage + on-device global reduce: each task streams
+            # its partition through its own accelerator, then ONE compiled
+            # collective over the joint jax.distributed mesh sums the
+            # partials — no executor→driver partial shipping at all
+            import os as _os
+            import socket
 
-        rows = df.mapInArrow(stats, stats_spark_ddl()).collect()
+            coordinator = _os.environ.get("SPARK_RAPIDS_ML_TPU_COORDINATOR")
+            if not coordinator:
+                # ephemeral pick-and-release: the real bind happens later
+                # inside the partition-0 task, so another process could in
+                # principle steal the port in between — production fleets
+                # preset SPARK_RAPIDS_ML_TPU_COORDINATOR to a reserved
+                # routable host:port instead
+                with socket.socket() as s:
+                    s.bind(("", 0))
+                    port = s.getsockname()[1]
+                coordinator = f"127.0.0.1:{port}"
+
+            first = df.first()
+            if first is None:
+                raise ValueError("empty dataset")
+            n_features = len(first[0])
+
+            def stats(batches):
+                from spark_rapids_ml_tpu.spark.device_aggregate import (
+                    partition_gram_stats_device_collective,
+                )
+
+                return partition_gram_stats_device_collective(
+                    batches, input_col, coordinator, n_features, device_id
+                )
+
+            try:
+                mapped = df.mapInArrow(
+                    stats, stats_spark_ddl(), barrier=True
+                )
+            except TypeError as exc:
+                raise RuntimeError(
+                    "executorDevice='collective' needs barrier task "
+                    "scheduling: DataFrame.mapInArrow(barrier=True) "
+                    "requires pyspark >= 3.5"
+                ) from exc
+            rows = mapped.collect()
+        else:
+            def stats(batches):
+                # Runs ON the executor. 'auto'/'on' put the Gram on the
+                # executor's accelerator (the reference's per-partition
+                # executor-GPU GEMM, RapidsRowMatrix.scala:168-202); the
+                # host NumPy plane is the fallback, never silently
+                # under 'on'.
+                if executor_device != "off":
+                    from spark_rapids_ml_tpu.spark.device_aggregate import (
+                        executor_device_available,
+                        partition_gram_stats_device_arrow,
+                    )
+
+                    if (executor_device == "on"
+                            or executor_device_available()):
+                        return partition_gram_stats_device_arrow(
+                            batches, input_col, device_id
+                        )
+                return partition_gram_stats_arrow(batches, input_col)
+
+            rows = df.mapInArrow(stats, stats_spark_ddl()).collect()
         gram, col_sum, count = combine_stats(rows)
         n_features = col_sum.shape[0]
         if k > n_features:
@@ -180,7 +274,7 @@ class PCAModel(Model, _TpuPCAParams):
 
     def _transform(self, dataset):
         import pandas as pd
-        from pyspark.sql.functions import pandas_udf
+        from spark_rapids_ml_tpu.spark._compat import pandas_udf
 
         pc_np = self.pc.toArray()  # (n_features, k), column-major storage
         out_col = self.getOutputCol()
@@ -327,7 +421,7 @@ class LinearRegressionModel(Model, _TpuLinRegParams):
 
     def _transform(self, dataset):
         import pandas as pd
-        from pyspark.sql.functions import pandas_udf
+        from spark_rapids_ml_tpu.spark._compat import pandas_udf
 
         coef = self.coefficients.toArray()
         b = float(self.intercept)
@@ -416,34 +510,43 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
         lam = float(self.getOrDefault(self.regParam))
         fit_b = self.getOrDefault(self.fitIntercept)
         tol = float(self.getOrDefault(self.tol))
-        df = dataset.select(fcol, lcol)
+        # cache the two-column projection: the Newton loop re-scans it once
+        # per iteration, and without persist() the input's upstream lineage
+        # would be recomputed up to maxIter times (how Spark ML's own
+        # iterative algorithms cache their instances RDD)
+        df = dataset.select(fcol, lcol).persist()
 
-        first = df.first()
-        if first is None:
-            raise ValueError("empty dataset")
-        n = len(first[0])
-        w = np.zeros(n)
-        b = 0.0
-        n_iter = 0
-        objective_history = []
-        for n_iter in range(1, self.getOrDefault(self.maxIter) + 1):
-            frozen_w, frozen_b = w.copy(), b
+        try:
+            first = df.first()
+            if first is None:
+                raise ValueError("empty dataset")
+            n = len(first[0])
+            w = np.zeros(n)
+            b = 0.0
+            n_iter = 0
+            objective_history = []
+            for n_iter in range(1, self.getOrDefault(self.maxIter) + 1):
+                frozen_w, frozen_b = w.copy(), b
 
-            def stats(batches, _w=frozen_w, _b=frozen_b):
-                return partition_logreg_stats_arrow(batches, fcol, lcol,
-                                                    _w, _b)
+                def stats(batches, _w=frozen_w, _b=frozen_b):
+                    return partition_logreg_stats_arrow(batches, fcol, lcol,
+                                                        _w, _b)
 
-            rows = df.mapInArrow(stats, logreg_stats_spark_ddl()).collect()
-            gx, hxx, hxb, rsum, ssum, loss, count = combine_logreg_stats(rows)
-            objective_history.append(
-                loss / max(count, 1) + 0.5 * lam * float(w @ w)
-            )
-            w, b, step = logreg_newton_step_from_stats(
-                gx, hxx, hxb, rsum, ssum, count, w, b,
-                reg_param=lam, fit_intercept=fit_b,
-            )
-            if step <= tol:
-                break
+                rows = df.mapInArrow(stats, logreg_stats_spark_ddl()).collect()
+                gx, hxx, hxb, rsum, ssum, loss, count = combine_logreg_stats(
+                    rows
+                )
+                objective_history.append(
+                    loss / max(count, 1) + 0.5 * lam * float(w @ w)
+                )
+                w, b, step = logreg_newton_step_from_stats(
+                    gx, hxx, hxb, rsum, ssum, count, w, b,
+                    reg_param=lam, fit_intercept=fit_b,
+                )
+                if step <= tol:
+                    break
+        finally:
+            df.unpersist()
         model = LogisticRegressionModel(
             coefficients=DenseVector(w.tolist()), intercept=b
         )
@@ -462,7 +565,7 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
 
     def _transform(self, dataset):
         import pandas as pd
-        from pyspark.sql.functions import col, pandas_udf
+        from spark_rapids_ml_tpu.spark._compat import col, pandas_udf
 
         coef = self.coefficients.toArray()
         b = float(self.intercept)
@@ -633,7 +736,7 @@ class KMeansModel(Model, _TpuKMeansParams):
 
     def _transform(self, dataset):
         import pandas as pd
-        from pyspark.sql.functions import pandas_udf
+        from spark_rapids_ml_tpu.spark._compat import pandas_udf
 
         centers = np.stack([c.toArray() for c in self._centers])
         c2 = (centers * centers).sum(axis=1)[None, :]

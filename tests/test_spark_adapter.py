@@ -1,0 +1,128 @@
+"""Generic DataFrame adapter: the remaining model families reachable from
+the DataFrame API (VERDICT r2 #4), executed through the local engine."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.spark._compat import HAVE_PYSPARK
+from spark_rapids_ml_tpu.spark.local_engine import (
+    DenseVector,
+    LocalSparkSession,
+)
+
+if HAVE_PYSPARK:  # pragma: no cover
+    pytest.skip("real pyspark present: CI lane covers it",
+                allow_module_level=True)
+
+from spark_rapids_ml_tpu.spark import (  # noqa: E402
+    GBTRegressor,
+    LinearSVC,
+    MinMaxScaler,
+    NaiveBayes,
+    NearestNeighbors,
+    RandomForestClassifier,
+    StandardScaler,
+)
+
+
+@pytest.fixture
+def spark():
+    return LocalSparkSession(n_partitions=2)
+
+
+def _df(spark, x, y=None):
+    rows = []
+    for i, r in enumerate(x):
+        row = {"features": DenseVector(r)}
+        if y is not None:
+            row["label"] = float(y[i])
+        rows.append(row)
+    return spark.createDataFrame(rows)
+
+
+def test_random_forest_classifier_front_end(spark, rng):
+    x = rng.normal(size=(300, 6))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
+    df = _df(spark, x, y)
+    model = RandomForestClassifier(numTrees=15, maxDepth=4, seed=3).fit(df)
+    out = model.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    assert (pred == y).mean() > 0.9
+
+
+def test_gbt_regressor_front_end(spark, rng):
+    x = rng.normal(size=(300, 4))
+    y = 2.0 * x[:, 0] - x[:, 1] + 0.1 * rng.normal(size=300)
+    df = _df(spark, x, y)
+    model = GBTRegressor(maxIter=30, maxDepth=3, seed=5).fit(df)
+    out = model.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+
+def test_naive_bayes_front_end(spark, rng):
+    x = np.abs(rng.normal(size=(200, 5)))
+    x[:100, 0] += 3.0
+    y = np.concatenate([np.zeros(100), np.ones(100)])
+    df = _df(spark, x, y)
+    model = NaiveBayes(modelType="gaussian").fit(df)
+    out = model.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    assert (pred == y).mean() > 0.85
+
+
+def test_linear_svc_front_end(spark, rng):
+    x = rng.normal(size=(400, 5))
+    w = np.array([2.0, -1.0, 0.0, 1.0, -0.5])
+    y = (x @ w + 0.2 > 0).astype(float)
+    df = _df(spark, x, y)
+    model = LinearSVC(regParam=0.01).fit(df)
+    out = model.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    assert (pred == y).mean() > 0.95
+
+
+def test_scalers_front_end(spark, rng):
+    x = rng.normal(size=(150, 4)) * np.array([1.0, 10.0, 0.1, 5.0])
+    df = _df(spark, x)
+    ss_model = StandardScaler(withMean=True, withStd=True).fit(df)
+    out = ss_model.transform(df).collect()
+    scaled = np.stack([r["scaled_features"].toArray() for r in out])
+    np.testing.assert_allclose(scaled.mean(axis=0), 0.0, atol=1e-9)
+    np.testing.assert_allclose(scaled.std(axis=0, ddof=1), 1.0, atol=1e-9)
+
+    mm_model = MinMaxScaler().fit(df)
+    out2 = mm_model.transform(df).collect()
+    col = mm_model._local.getOutputCol()
+    mm = np.stack([r[col].toArray() for r in out2])
+    np.testing.assert_allclose(mm.min(axis=0), 0.0, atol=1e-12)
+    np.testing.assert_allclose(mm.max(axis=0), 1.0, atol=1e-12)
+
+
+def test_nearest_neighbors_front_end(spark, rng):
+    items = rng.normal(size=(200, 8))
+    model = NearestNeighbors(k=5).fit(_df(spark, items))
+    queries = items[:10] + 1e-6
+    dist, idx = model.kneighbors(_df(spark, queries))
+    assert dist.shape == (10, 5) and idx.shape == (10, 5)
+    np.testing.assert_array_equal(idx[:, 0], np.arange(10))
+
+
+def test_adapter_persistence_roundtrip(spark, rng, tmp_path):
+    x = rng.normal(size=(200, 4))
+    y = (x[:, 0] > 0).astype(float)
+    df = _df(spark, x, y)
+    model = RandomForestClassifier(numTrees=8, maxDepth=3, seed=1).fit(df)
+    path = str(tmp_path / "rf_front")
+    model.save(path)
+    from spark_rapids_ml_tpu.spark import RandomForestClassifierModel
+
+    loaded = RandomForestClassifierModel.load(path)
+    p1 = [r["prediction"] for r in model.transform(df).collect()]
+    p2 = [r["prediction"] for r in loaded.transform(df).collect()]
+    assert p1 == p2
+
+
+def test_adapter_unknown_param_raises():
+    with pytest.raises(ValueError, match="no param"):
+        RandomForestClassifier(nope=3)
