@@ -58,7 +58,10 @@ class NearestNeighborsParams(HasInputCol, HasDeviceId):
     pqM = Param(
         "pqM",
         "ivfpq: number of subquantizers (must divide the feature dim; "
-        "0 = auto, the largest divisor of dim at most dim/2)",
+        "0 = auto: the largest divisor whose subspace width dsub lands "
+        "in [4, 8] — i.e. dsub=4 when dim allows, the recall-per-code "
+        "sweet spot, and 2-4x wider subspaces than the old dsub=2 rule "
+        "— falling back to narrower widths only when dim forces it)",
         0,
         validator=lambda v: isinstance(v, int) and v >= 0,
     )
@@ -67,6 +70,15 @@ class NearestNeighborsParams(HasInputCol, HasDeviceId):
         "ivfpq: bits per subquantizer code (codebook size 2^bits)",
         8,
         validator=lambda v: isinstance(v, int) and 2 <= v <= 8,
+    )
+    refineRatio = Param(
+        "refineRatio",
+        "ivfpq: exact-distance re-rank of the top ceil(k*refineRatio) ADC "
+        "candidates (IndexRefineFlat pattern). Costs keeping the raw item "
+        "rows resident in HBM alongside the codes; 0 disables for a "
+        "compressed-codes-only memory footprint",
+        2.0,
+        validator=lambda v: v == 0 or v >= 1.0,
     )
     useXlaDot = Param(
         "useXlaDot",
@@ -256,8 +268,14 @@ class NearestNeighborsModel(NearestNeighborsParams):
     def _resolve_pq_m(self, dim: int) -> int:
         m_sub = self.getPqM()
         if m_sub == 0:
-            # auto: the largest divisor of dim at most dim/2 (dsub >= 2
-            # keeps codebook training meaningful); dim=1 degenerates to 1
+            # auto: the largest divisor with dsub in [4, 8] — dsub=4 when
+            # dim allows (recall-per-code sweet spot; still 2-4x wider
+            # subspaces and fewer sequential codebook fits than dsub=2),
+            # at least 2 subquantizers when dim allows; narrow-dsub
+            # fallback only when dim has no suitable divisor
+            for cand in range(dim, 1, -1):
+                if dim % cand == 0 and 4 <= dim // cand <= 8:
+                    return cand
             for cand in range(max(1, dim // 2), 0, -1):
                 if dim % cand == 0:
                     return cand
@@ -295,7 +313,10 @@ class NearestNeighborsModel(NearestNeighborsParams):
         )[assign]
         dsub = dim // m_sub
         codebooks = np.zeros((m_sub, ksub, dsub))
-        codes = np.zeros((n, m_sub), dtype=np.int32)
+        # uint8: pqBits is validated <= 8, so ksub <= 256 always — the
+        # codes are the HBM-resident payload, 4x smaller than int32
+        code_dtype = np.uint8
+        codes = np.zeros((n, m_sub), dtype=code_dtype)
         for m in range(m_sub):
             sub = jax.device_put(
                 jnp.asarray(residuals[:, m * dsub:(m + 1) * dsub],
@@ -310,7 +331,7 @@ class NearestNeighborsModel(NearestNeighborsParams):
             assign, nlist
         )
         # subspace-major code layout — see the ivfpq_search layout note
-        bucket_codes = np.zeros((m_sub, nlist, max_size), dtype=np.int32)
+        bucket_codes = np.zeros((m_sub, nlist, max_size), dtype=code_dtype)
         bucket_ids = np.zeros((nlist, max_size), dtype=np.int32)
         bucket_mask = np.zeros((nlist, max_size), dtype=np.float64)
         bucket_codes[:, sorted_assign, slots] = codes[order].T
@@ -357,9 +378,13 @@ class NearestNeighborsModel(NearestNeighborsParams):
             )
 
     def _kneighbors_ivfpq(self, queries, k):
+        import numpy as _np
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.ops.knn_kernel import ivfpq_search
+        from spark_rapids_ml_tpu.ops.knn_kernel import (
+            exact_rerank,
+            ivfpq_search,
+        )
 
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.getDtype())
@@ -369,11 +394,23 @@ class NearestNeighborsModel(NearestNeighborsParams):
         step = self._ivf_pool_check_and_step(
             "ivfpq", k, nprobe, int(b_ids.shape[1])
         )
+        refine = float(self.getRefineRatio())
+        pool = nprobe * int(b_ids.shape[1])
+        n_cand = (
+            k if refine == 0
+            else min(pool, max(k, int(_np.ceil(k * refine))))
+        )
+        items_dev = (
+            self._items_on_device(device, dtype) if refine else None
+        )
 
         def kernel(q):
             d2, ids = ivfpq_search(
-                q, centroids, codebooks, b_codes, b_ids, b_mask, k, nprobe
+                q, centroids, codebooks, b_codes, b_ids, b_mask,
+                n_cand, nprobe,
             )
+            if refine:
+                d2, ids = exact_rerank(q, items_dev, ids, k)
             return jnp.sqrt(jnp.maximum(d2, 0.0)), ids
 
         with TraceRange("knn ivfpq", TraceColor.GREEN):
@@ -406,21 +443,26 @@ class NearestNeighborsModel(NearestNeighborsParams):
             out_i[start : start + rows] = np.asarray(i)[:rows]
         return out_d, out_i
 
-    def _kneighbors_xla(self, queries, k):
+    def _items_on_device(self, device, dtype):
+        """Raw item rows on device, cached per (device, dtype) — shared by
+        the brute-force path and the ivfpq exact re-rank."""
         import jax
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.ops.knn_kernel import knn_kernel
-
-        device = _resolve_device(self.getDeviceId())
-        dtype = _resolve_dtype(self.getDtype())
         cache_key = (device, jnp.dtype(dtype))
         if self._device_items is None or self._device_items[0] != cache_key:
             items = jax.device_put(
                 jnp.asarray(self.items, dtype=dtype), device
             )
             self._device_items = (cache_key, items)
-        items = self._device_items[1]
+        return self._device_items[1]
+
+    def _kneighbors_xla(self, queries, k):
+        from spark_rapids_ml_tpu.ops.knn_kernel import knn_kernel
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        items = self._items_on_device(device, dtype)
 
         with TraceRange("knn kneighbors", TraceColor.GREEN):
             return self._stream_queries(

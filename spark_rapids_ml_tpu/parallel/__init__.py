@@ -6,6 +6,9 @@ from spark_rapids_ml_tpu.parallel.distributed_pca import (
 from spark_rapids_ml_tpu.parallel.distributed_knn import (
     distributed_kneighbors,
 )
+from spark_rapids_ml_tpu.parallel.distributed_ivf import (
+    distributed_ivf_search,
+)
 from spark_rapids_ml_tpu.parallel.distributed_forest import (
     distributed_forest_fit,
 )
@@ -37,6 +40,7 @@ __all__ = [
     "distributed_pca_fit",
     "distributed_pca_fit_kernel",
     "distributed_kneighbors",
+    "distributed_ivf_search",
     "distributed_forest_fit",
     "distributed_kmeans_fit",
     "distributed_kmeans_fit_kernel",

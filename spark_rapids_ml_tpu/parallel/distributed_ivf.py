@@ -1,0 +1,173 @@
+"""Distributed IVF-Flat / IVF-PQ search: inverted lists sharded over the mesh.
+
+The approximate-KNN analogue of ``distributed_knn``: the INDEX is what
+grows, so the inverted lists shard over the ``data`` axis — each device
+holds nlist/n_shards coarse cells (centroid + its bucket of items or PQ
+codes), queries and PQ codebooks replicate. Each shard probes its local
+top-``nprobe`` cells and emits its local top-k; the global answer is the
+same two-level all_gather + merge reduction the brute-force path uses.
+
+Semantics note: probing the top ``nprobe`` cells PER SHARD probes at
+least every cell the single-device search would (each globally-nearest
+cell is also among its own shard's nearest), plus up to
+``nprobe·(n_shards−1)`` extras — so recall is ≥ the single-device
+configuration at the same nprobe, approaching it from above as shards
+grow. The PQ variant returns ADC-ranked results (the exact re-rank stays
+a single-device refinement, where the raw rows live).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops.knn_kernel import ivf_search, ivfpq_search, knn_merge
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+_FAR = 1e30  # padded-cell centroid fill: sorts after every real cell
+
+
+def _pad_lists(arr: np.ndarray, nlist_padded: int, axis: int, fill=0):
+    pad = nlist_padded - arr.shape[axis]
+    if pad == 0:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, pad)
+    return np.pad(arr, widths, constant_values=fill)
+
+
+@partial(jax.jit, static_argnames=("k", "nprobe", "mesh"))
+def _sharded_ivf_flat(queries, centroids, b_items, b_ids, b_mask,
+                      k: int, nprobe: int, mesh: Mesh):
+    def per_shard(q, cent, items, ids, mask):
+        local_lists = cent.shape[0]
+        np_local = min(nprobe, local_lists)
+        pool = np_local * items.shape[1]
+        k_local = min(k, pool)
+        d2, gids = ivf_search(q, cent, items, ids, mask, k_local, np_local)
+        all_d = lax.all_gather(d2, DATA_AXIS, axis=1, tiled=True)
+        all_i = lax.all_gather(gids, DATA_AXIS, axis=1, tiled=True)
+        return knn_merge(all_d, all_i, k)
+
+    return jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS, None, None),
+                  P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(queries, centroids, b_items, b_ids, b_mask)
+
+
+@partial(jax.jit, static_argnames=("k", "nprobe", "mesh"))
+def _sharded_ivf_pq(queries, centroids, codebooks, b_codes, b_ids, b_mask,
+                    k: int, nprobe: int, mesh: Mesh):
+    def per_shard(q, cent, books, codes, ids, mask):
+        local_lists = cent.shape[0]
+        np_local = min(nprobe, local_lists)
+        pool = np_local * ids.shape[1]
+        k_local = min(k, pool)
+        d2, gids = ivfpq_search(q, cent, books, codes, ids, mask,
+                                k_local, np_local)
+        all_d = lax.all_gather(d2, DATA_AXIS, axis=1, tiled=True)
+        all_i = lax.all_gather(gids, DATA_AXIS, axis=1, tiled=True)
+        return knn_merge(all_d, all_i, k)
+
+    return jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS, None), P(),
+                  P(None, DATA_AXIS, None), P(DATA_AXIS, None),
+                  P(DATA_AXIS, None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(queries, centroids, codebooks, b_codes, b_ids, b_mask)
+
+
+def distributed_ivf_search(
+    model,
+    queries: np.ndarray,
+    mesh: Mesh,
+    k=None,
+    dtype=jnp.float32,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(distances, indices) for a fitted approximate ``NearestNeighborsModel``
+    with its inverted lists sharded over ``mesh``.
+
+    Builds (or reuses) the model's single-host index, re-lays the list
+    arrays out across the mesh (lists padded to the shard multiple with
+    far-centroid empty cells), and runs the sharded search. ``algorithm``
+    on the model selects ivfflat vs ivfpq.
+    """
+    algorithm = model.getAlgorithm()
+    if algorithm not in ("ivfflat", "ivfpq"):
+        raise ValueError(
+            f"distributed_ivf_search needs algorithm ivfflat/ivfpq, "
+            f"got {algorithm!r}"
+        )
+    k = model.getK() if k is None else k
+    queries = np.asarray(queries, dtype=np.dtype(dtype))
+    n_shards = int(np.prod(mesh.devices.shape))
+    build_device = jax.local_devices()[0]
+    if algorithm == "ivfflat":
+        centroids, b_items, b_ids, b_mask, nlist = model._ivf_index(
+            build_device, dtype
+        )
+    else:
+        centroids, books, b_codes, b_ids, b_mask, nlist = (
+            model._ivfpq_index(build_device, dtype)
+        )
+    nprobe = min(model.getNprobe(), nlist)
+    nlist_p = -(-nlist // n_shards) * n_shards
+    # the sharded analogue of the model's candidate-pool guard: every
+    # shard contributes min(k, local_pool) candidates; the merged set must
+    # still cover k, else top_k would fail with an opaque shape error
+    lists_per_shard = nlist_p // n_shards
+    max_size = int(np.asarray(b_ids).shape[1])
+    per_shard = min(k, min(nprobe, lists_per_shard) * max_size)
+    if n_shards * per_shard < k:
+        raise ValueError(
+            f"k = {k} exceeds the sharded candidate pool "
+            f"({n_shards} shards x {per_shard}): raise nprobe or nlist, "
+            "or use fewer shards"
+        )
+    cent = _pad_lists(np.asarray(centroids, dtype=np.dtype(dtype)),
+                      nlist_p, 0, fill=_FAR)
+    ids = _pad_lists(np.asarray(b_ids), nlist_p, 0)
+    mask = _pad_lists(np.asarray(b_mask, dtype=np.dtype(dtype)), nlist_p, 0)
+    shard_l = NamedSharding(mesh, P(DATA_AXIS, None))
+    repl = NamedSharding(mesh, P())
+    q_dev = jax.device_put(jnp.asarray(queries), repl)
+    cent_dev = jax.device_put(jnp.asarray(cent), shard_l)
+    ids_dev = jax.device_put(jnp.asarray(ids), shard_l)
+    mask_dev = jax.device_put(jnp.asarray(mask), shard_l)
+    if algorithm == "ivfflat":
+        items = _pad_lists(
+            np.asarray(b_items, dtype=np.dtype(dtype)), nlist_p, 0
+        )
+        items_dev = jax.device_put(
+            jnp.asarray(items), NamedSharding(mesh, P(DATA_AXIS, None, None))
+        )
+        d2, i = _sharded_ivf_flat(
+            q_dev, cent_dev, items_dev, ids_dev, mask_dev, k, nprobe, mesh
+        )
+    else:
+        codes = _pad_lists(np.asarray(b_codes), nlist_p, 1)
+        codes_dev = jax.device_put(
+            jnp.asarray(codes), NamedSharding(mesh, P(None, DATA_AXIS, None))
+        )
+        books_dev = jax.device_put(jnp.asarray(books), repl)
+        d2, i = _sharded_ivf_pq(
+            q_dev, cent_dev, books_dev, codes_dev, ids_dev, mask_dev,
+            k, nprobe, mesh,
+        )
+    return (
+        np.sqrt(np.maximum(np.asarray(d2), 0.0)),
+        np.asarray(i, dtype=np.int64),
+    )
