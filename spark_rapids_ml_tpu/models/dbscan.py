@@ -55,6 +55,16 @@ class DBSCANParams(HasInputCol, HasDeviceId):
         "auto",
         validator=lambda v: v in ("auto", "float32", "float64"),
     )
+    blockRows = Param(
+        "blockRows",
+        "rows per tiled ε-graph block. 0 = auto: the one-shot dense "
+        "kernel (whole n×n adjacency in HBM) up to 16384 rows, a 4096-row "
+        "tiled sweep beyond — memory then scales as block×n instead of "
+        "n×n, taking n to the hundreds of thousands. Explicit values "
+        "force the tiled path with that block size.",
+        0,
+        validator=lambda v: isinstance(v, int) and v >= 0,
+    )
 
 
 class DBSCAN(DBSCANParams):
@@ -91,21 +101,53 @@ class DBSCAN(DBSCANParams):
         model.fit_timings_ = timer.as_dict()
         return model
 
+    _DENSE_MAX_ROWS = 16384
+
     def _fit_xla(self, x, timer):
         import jax
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.ops.dbscan_kernel import dbscan_labels
+        from spark_rapids_ml_tpu.ops.dbscan_kernel import (
+            dbscan_labels,
+            dbscan_labels_blocked,
+        )
 
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.getDtype())
+        n = x.shape[0]
+        block = self.getBlockRows()
+        use_blocked = block > 0 or n > self._DENSE_MAX_ROWS
         with timer.phase("cluster"), TraceRange("dbscan", TraceColor.GREEN):
-            x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
-            labels, core = dbscan_labels(
-                x_dev,
-                jnp.asarray(float(self.getEps()), dtype=dtype),
-                self.getMinPts(),
-            )
+            eps_dev = jnp.asarray(float(self.getEps()), dtype=dtype)
+            if not use_blocked:
+                x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+                labels, core = dbscan_labels(
+                    x_dev, eps_dev, self.getMinPts()
+                )
+            else:
+                if block == 0:
+                    block = min(4096, n)
+                if n > 2 ** 24:
+                    # labels ride f32 row indices on device; past 2^24
+                    # they stop being exact integers
+                    raise ValueError(
+                        f"{n} rows exceeds the tiled kernel's 2^24 label "
+                        "envelope"
+                    )
+                from spark_rapids_ml_tpu.parallel.mesh import (
+                    pad_rows_to_multiple,
+                )
+
+                x_pad, mask = pad_rows_to_multiple(np.asarray(x), block)
+                valid = mask > 0
+                x_dev = jax.device_put(jnp.asarray(x_pad, dtype=dtype),
+                                       device)
+                labels, core = dbscan_labels_blocked(
+                    x_dev, jax.device_put(jnp.asarray(valid), device),
+                    eps_dev, self.getMinPts(), block,
+                )
+                labels = labels[:n]
+                core = core[:n]
             labels = np.asarray(labels)
             core = np.asarray(core)
         return labels, core

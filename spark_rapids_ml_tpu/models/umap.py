@@ -67,6 +67,17 @@ class UMAPParams(HasInputCol, HasDeviceId):
         "dtype", "device compute dtype", "auto",
         validator=lambda v: v in ("auto", "float32", "float64"),
     )
+    blockRows = Param(
+        "blockRows",
+        "rows per tiled force/kNN block. 0 = auto: the dense one-matmul "
+        "optimizer (n×n forces in HBM, spectral init) up to 16384 rows, "
+        "a tiled variant beyond — sparse-edge attraction + row-block "
+        "streamed repulsion + PCA init, memory block×n instead of n×n, "
+        "taking n to the hundreds of thousands. Explicit values force "
+        "the tiled path.",
+        0,
+        validator=lambda v: isinstance(v, int) and v >= 0,
+    )
 
 
 class UMAP(UMAPParams):
@@ -109,30 +120,42 @@ class UMAP(UMAPParams):
         dtype = _resolve_dtype(self.getDtype())
         a, b = fit_ab(float(self.getMinDist()))
 
+        block = self.getBlockRows()
+        use_blocked = block > 0 or n > self._DENSE_MAX_ROWS
         x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
-        with timer.phase("knn"), TraceRange("umap knn", TraceColor.GREEN):
-            # k+1 then drop self (column 0: distance 0 to itself)
-            dists, idx = knn_kernel(x_dev, x_dev, k + 1)
-            dists, idx = dists[:, 1:], idx[:, 1:]
-        with timer.phase("graph"), TraceRange("umap graph", TraceColor.RED):
-            p = fuzzy_graph(dists, idx, n)
-        with timer.phase("init"):
-            emb0 = spectral_init(p, self.getNComponents())
         # dense all-pairs repulsion stands in for UMAP's per-edge negative
         # sampling (n_neg=5): scale gamma so total repulsive mass matches
         # the sampled variant's ~(edges·n_neg) instead of n²
         gamma = float(self.getRepulsionStrength()) * (5.0 * 2.0 * k / n)
-        with timer.phase("optimize"), TraceRange("umap opt", TraceColor.BLUE):
-            emb = optimize_embedding(
-                p,
-                emb0,
-                jnp.asarray(a, dtype=dtype),
-                jnp.asarray(b, dtype=dtype),
-                jnp.asarray(float(self.getLearningRate()), dtype=dtype),
-                jnp.asarray(gamma, dtype=dtype),
-                self.getNEpochs(),
+        if use_blocked:
+            emb = self._fit_blocked(
+                x_dev, n, k, a, b, gamma,
+                min(block or 4096, n), device, dtype, timer,
             )
-            emb = np.asarray(jax.block_until_ready(emb), dtype=np.float64)
+        else:
+            with timer.phase("knn"), TraceRange("umap knn",
+                                                TraceColor.GREEN):
+                # k+1 then drop self (column 0: distance 0 to itself)
+                dists, idx = knn_kernel(x_dev, x_dev, k + 1)
+                dists, idx = dists[:, 1:], idx[:, 1:]
+            with timer.phase("graph"), TraceRange("umap graph",
+                                                  TraceColor.RED):
+                p = fuzzy_graph(dists, idx, n)
+            with timer.phase("init"):
+                emb0 = spectral_init(p, self.getNComponents())
+            with timer.phase("optimize"), TraceRange("umap opt",
+                                                     TraceColor.BLUE):
+                emb = optimize_embedding(
+                    p,
+                    emb0,
+                    jnp.asarray(a, dtype=dtype),
+                    jnp.asarray(b, dtype=dtype),
+                    jnp.asarray(float(self.getLearningRate()), dtype=dtype),
+                    jnp.asarray(gamma, dtype=dtype),
+                    self.getNEpochs(),
+                )
+                emb = np.asarray(jax.block_until_ready(emb),
+                                 dtype=np.float64)
         if not np.isfinite(emb).all():
             raise FloatingPointError("UMAP optimization diverged")
         model = UMAPModel(
@@ -144,6 +167,76 @@ class UMAP(UMAPParams):
         model.copy_values_from(self)
         model.fit_timings_ = timer.as_dict()
         return model
+
+    _DENSE_MAX_ROWS = 16384
+
+    def _fit_blocked(self, x_dev, n, k, a, b, gamma, block, device, dtype,
+                     timer):
+        """Large-n fit: tiled kNN-graph build (query chunks × all items),
+        host sparse fuzzy union, PCA init, and the row-block streamed
+        force optimizer — no n×n array anywhere."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.knn_kernel import knn_kernel
+        from spark_rapids_ml_tpu.ops.umap_kernel import (
+            optimize_embedding_blocked,
+            pca_init,
+            smooth_knn_calibration,
+            symmetric_edge_list,
+        )
+
+        with timer.phase("knn"), TraceRange("umap knn", TraceColor.GREEN):
+            dists = np.empty((n, k), dtype=np.float64)
+            idx = np.empty((n, k), dtype=np.int64)
+            for s in range(0, n, block):
+                chunk = x_dev[s:s + block]
+                pad = block - chunk.shape[0]
+                if pad:
+                    chunk = jnp.concatenate(
+                        [chunk, jnp.zeros((pad, chunk.shape[1]),
+                                          dtype=chunk.dtype)], axis=0
+                    )
+                d_c, i_c = knn_kernel(chunk, x_dev, k + 1)
+                rows = block - pad
+                # drop the self column (distance 0)
+                dists[s:s + rows] = np.asarray(d_c)[:rows, 1:]
+                idx[s:s + rows] = np.asarray(i_c)[:rows, 1:]
+        with timer.phase("graph"), TraceRange("umap graph", TraceColor.RED):
+            rho_sigma_d = jnp.asarray(dists, dtype=dtype)
+            rho, sigma = smooth_knn_calibration(rho_sigma_d)
+            mu = np.asarray(
+                jnp.exp(
+                    -jnp.maximum(rho_sigma_d - rho[:, None], 0.0)
+                    / sigma[:, None]
+                )
+            )
+            e_i, e_j, e_p = symmetric_edge_list(mu, idx, n)
+        with timer.phase("init"):
+            emb0 = pca_init(x_dev, self.getNComponents())
+        from spark_rapids_ml_tpu.parallel.mesh import pad_rows_to_multiple
+
+        emb0_pad, mask = pad_rows_to_multiple(
+            np.asarray(emb0, dtype=np.float64), block
+        )
+        emb0 = jnp.asarray(emb0_pad, dtype=emb0.dtype)
+        valid = mask > 0
+        with timer.phase("optimize"), TraceRange("umap opt",
+                                                 TraceColor.BLUE):
+            emb = optimize_embedding_blocked(
+                jnp.asarray(e_i), jnp.asarray(e_j),
+                jnp.asarray(e_p, dtype=dtype),
+                emb0, jax.device_put(jnp.asarray(valid), device),
+                jnp.asarray(a, dtype=dtype),
+                jnp.asarray(b, dtype=dtype),
+                jnp.asarray(float(self.getLearningRate()), dtype=dtype),
+                jnp.asarray(gamma, dtype=dtype),
+                self.getNEpochs(),
+                block,
+            )
+            emb = np.asarray(jax.block_until_ready(emb),
+                             dtype=np.float64)[:n]
+        return emb
 
 
 class UMAPModel(UMAPParams):

@@ -151,6 +151,151 @@ def optimize_embedding(
     return lax.fori_loop(0, n_epochs, epoch, emb0)
 
 
+def symmetric_edge_list(mu, knn_idx, n: int):
+    """Host-side sparse fuzzy-set union: the (i<j, P_ij) edge list.
+
+    The dense kernel scatters μ into an n×n matrix and unions with its
+    transpose (``fuzzy_graph``); at large n that matrix is the memory
+    wall, but the UNION only has support on kNN edges — at most 2·n·k of
+    them. NumPy assembly: dedupe directed duplicates by max (the
+    ``.at[].max`` semantics), then P = μ_ij + μ_ji − μ_ij·μ_ji per
+    undirected pair. Returns (edge_i, edge_j, p) int32/int32/f64 arrays.
+    """
+    import numpy as np
+
+    mu = np.asarray(mu, dtype=np.float64)
+    idx = np.asarray(knn_idx, dtype=np.int64)
+    k = mu.shape[1]
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)
+    cols = idx.reshape(-1)
+    vals = mu.reshape(-1)
+    keep = rows != cols
+    rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    # directed key → max over duplicates
+    key = rows * n + cols
+    order = np.argsort(key, kind="stable")
+    key, vals = key[order], vals[order]
+    uniq, start = np.unique(key, return_index=True)
+    dmax = np.maximum.reduceat(vals, start)
+    # pair up (i→j) with (j→i): canonical undirected key
+    di, dj = uniq // n, uniq % n
+    lo, hi = np.minimum(di, dj), np.maximum(di, dj)
+    ukey = lo * n + hi
+    forward = di < dj
+    uorder = np.argsort(ukey, kind="stable")
+    ukey_s = ukey[uorder]
+    w_s = dmax[uorder]
+    fwd_s = forward[uorder]
+    uu, ustart = np.unique(ukey_s, return_index=True)
+    # each undirected key appears once or twice; accumulate both directions
+    w_ij = np.zeros(len(uu))
+    w_ji = np.zeros(len(uu))
+    pos = np.searchsorted(uu, ukey_s)
+    np.maximum.at(w_ij, pos[fwd_s], w_s[fwd_s])
+    np.maximum.at(w_ji, pos[~fwd_s], w_s[~fwd_s])
+    p = w_ij + w_ji - w_ij * w_ji
+    return (
+        (uu // n).astype(np.int32),
+        (uu % n).astype(np.int32),
+        p,
+    )
+
+
+def pca_init(x: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """Embedding init from the top principal components, scaled to the
+    conventional ±10 box — umap-learn's ``init='pca'``. The blocked
+    large-n path uses this instead of the dense spectral init (whose
+    n×n Laplacian eigh is the O(n³) wall the path exists to avoid).
+    Reuses the shared covariance/eigh chain so precision and ordering
+    conventions live in one place."""
+    from spark_rapids_ml_tpu.ops.covariance import column_means, covariance
+    from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
+
+    mean = column_means(x)
+    comps, _ = pca_from_covariance(
+        covariance(x, mean=mean), dim, flip_signs=False, solver="eigh"
+    )
+    emb = (x - mean[None, :]) @ comps
+    scale = 10.0 / jnp.maximum(jnp.max(jnp.abs(emb)), 1e-12)
+    return emb * scale
+
+
+@partial(jax.jit, static_argnames=("n_epochs", "block_rows"))
+def optimize_embedding_blocked(
+    edge_i: jnp.ndarray,       # (nnz,) int32, i < j
+    edge_j: jnp.ndarray,       # (nnz,) int32
+    edge_p: jnp.ndarray,       # (nnz,) membership P_ij
+    emb0: jnp.ndarray,         # (n_pad, dim), padded to block_rows multiple
+    valid: jnp.ndarray,        # (n_pad,) bool, real rows
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    learning_rate: jnp.ndarray,
+    repulsion_strength: jnp.ndarray,
+    n_epochs: int,
+    block_rows: int,
+) -> jnp.ndarray:
+    """``optimize_embedding`` semantics with the n×n force matrix TILED.
+
+    Same weights as the dense kernel, split by support: the all-pairs
+    repulsion term (weight (2γb)/((ε+d²)(1+a·d^{2b})), support
+    everywhere) streams over row blocks under ``lax.map`` — peak memory
+    one (block_rows × n) distance block; the attraction term and the
+    −P·repulsion correction (support only on graph edges) ride the edge
+    list with two segment-sums. Self-pairs need no masking: their force
+    contribution w_ii·(yᵢ−yᵢ) is identically zero in the
+    rowsum(W)·Y − W·Y form.
+    """
+    n = emb0.shape[0]
+    assert n % block_rows == 0
+    nb = n // block_rows
+    dim = emb0.shape[1]
+    eps = jnp.asarray(1e-3, emb0.dtype)
+    valid_f = valid.astype(emb0.dtype)
+
+    def epoch(i, y):
+        def rep_block(yi):
+            d2 = pairwise_sqdist(yi, y)
+            d2b = jnp.power(jnp.maximum(d2, 1e-12), b)
+            w = jnp.clip(
+                (2.0 * repulsion_strength * b)
+                / ((eps + d2) * (1.0 + a * d2b)),
+                0.0,
+                1e4,
+            ) * valid_f[None, :]
+            return jnp.sum(w, axis=1)[:, None] * yi - w @ y
+        f_rep = lax.map(
+            rep_block, y.reshape(nb, block_rows, dim)
+        ).reshape(n, dim)
+
+        yi, yj = y[edge_i], y[edge_j]
+        d2 = jnp.sum((yi - yj) ** 2, axis=1)
+        d2b = jnp.power(jnp.maximum(d2, 1e-12), b)
+        denom = 1.0 + a * d2b
+        w_att = jnp.clip(
+            edge_p * (-2.0 * a * b * d2b / jnp.maximum(d2, 1e-12)) / denom,
+            -1e4,
+            0.0,
+        )
+        # the dense kernel's repulsion carries (1−P); the blocked pass
+        # above used 1, so subtract the P·repulsion part exactly on edges
+        w_rep_corr = -jnp.clip(
+            edge_p * (2.0 * repulsion_strength * b) / ((eps + d2) * denom),
+            0.0,
+            1e4,
+        )
+        w_edge = (w_att + w_rep_corr)[:, None] * (yi - yj)
+        f_att = (
+            jax.ops.segment_sum(w_edge, edge_i, num_segments=n)
+            - jax.ops.segment_sum(w_edge, edge_j, num_segments=n)
+        )
+
+        force = f_rep + f_att
+        alpha = learning_rate * (1.0 - i / n_epochs)
+        return y + jnp.clip(alpha * force, -4.0, 4.0)
+
+    return lax.fori_loop(0, n_epochs, epoch, emb0)
+
+
 def fit_ab(min_dist: float, spread: float = 1.0) -> Tuple[float, float]:
     """Fit the (a, b) of φ(d)=1/(1+a·d^{2b}) to UMAP's target curve
     (1 for d<min_dist, exp(−(d−min_dist)/spread) beyond) — plain NumPy

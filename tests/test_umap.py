@@ -78,3 +78,40 @@ def test_umap_validation(rng):
     model = UMAP().setNNeighbors(5).setNEpochs(20).fit(x)
     with pytest.raises(ValueError, match="dim"):
         model.transform(VectorFrame({"features": np.zeros((2, 7))}))
+
+
+def test_umap_blocked_preserves_cluster_structure(rng):
+    """The tiled large-n path (blockRows): sparse-edge attraction +
+    row-block repulsion + PCA init must preserve the same structure the
+    dense path does — including a block size that does not divide n."""
+    centers = [np.r_[np.eye(8)[i] * 8] for i in range(3)]
+    x, y = _blobs(rng, centers)
+    model = (
+        UMAP().setNNeighbors(10).setNEpochs(150).setBlockRows(48).fit(x)
+    )
+    emb = model.embedding_
+    assert emb.shape == (len(x), 2)
+    assert np.isfinite(emb).all()
+    cents = np.stack([emb[y == c].mean(0) for c in range(3)])
+    spread = max(emb[y == c].std() for c in range(3))
+    gaps = [
+        np.linalg.norm(cents[i] - cents[j])
+        for i in range(3)
+        for j in range(i + 1, 3)
+    ]
+    assert min(gaps) > 2.0 * spread
+    t = _trustworthiness(x, emb, k=10)
+    assert t > 0.85, t
+
+
+def test_umap_blocked_auto_threshold(rng):
+    centers = [np.r_[np.eye(6)[i] * 9] for i in range(2)]
+    x, y = _blobs(rng, centers, per=40)
+    est = UMAP().setNNeighbors(8).setNEpochs(80)
+    est._DENSE_MAX_ROWS = 50  # force the auto-blocked regime at test scale
+    model = est.fit(x)
+    emb = model.embedding_
+    assert np.isfinite(emb).all() and emb.shape == (80, 2)
+    cents = np.stack([emb[y == c].mean(0) for c in range(2)])
+    spread = max(emb[y == c].std() for c in range(2))
+    assert np.linalg.norm(cents[0] - cents[1]) > 2.0 * spread
