@@ -31,17 +31,9 @@ import time
 
 import numpy as np
 
-# Peak per-chip dense MXU FLOP/s by device kind (bf16). Used only for the
-# MFU field; unknown kinds report mfu=None rather than a made-up number.
-_PEAK_FLOPS_BF16 = {
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+from spark_rapids_ml_tpu.utils.platform import (  # noqa: E402
+    PEAK_FLOPS_BF16 as _PEAK_FLOPS_BF16,
+)
 
 
 def _probe_with_backoff():

@@ -24,15 +24,9 @@ import time
 
 import numpy as np
 
-_PEAK_FLOPS_BF16 = {
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+from spark_rapids_ml_tpu.utils.platform import (  # noqa: E402
+    PEAK_FLOPS_BF16 as _PEAK_FLOPS_BF16,
+)
 
 
 def main() -> None:

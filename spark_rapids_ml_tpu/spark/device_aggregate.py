@@ -59,6 +59,45 @@ def _bucket_rows(m: int) -> int:
     return b
 
 
+def _device_gram_stats(matrices: Iterable[np.ndarray], device, dt):
+    """Core loop shared by the gram and the Z=[X|y] device paths: stream
+    (m, n) host matrices through the donated device accumulator, padded
+    to power-of-two row buckets with a validity mask."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.streaming import init_stats, update_stats_auto
+
+    stats = None
+    n_cols: Optional[int] = None
+    for x in matrices:
+        m = x.shape[0]
+        if m == 0:
+            continue
+        if stats is None:
+            n_cols = x.shape[1]
+            stats = init_stats(n_cols, dtype=dt, device=device)
+        bucket = _bucket_rows(m)
+        if bucket != m:
+            padded = np.zeros((bucket, n_cols), dtype=x.dtype)
+            padded[:m] = x
+            mask = np.zeros(bucket, dtype=bool)
+            mask[:m] = True
+            stats = update_stats_auto(
+                stats, jnp.asarray(padded, dtype=dt), jnp.asarray(mask)
+            )
+        else:
+            stats = update_stats_auto(stats, jnp.asarray(x, dtype=dt))
+    if stats is None:
+        return None
+    stats = jax.block_until_ready(stats)
+    return {
+        "gram": np.asarray(stats.gram, dtype=np.float64).ravel().tolist(),
+        "col_sum": np.asarray(stats.col_sum, dtype=np.float64).tolist(),
+        "count": int(stats.count),
+    }
+
+
 def partition_gram_stats_device(
     batches: Iterable,
     input_col: str,
@@ -75,16 +114,229 @@ def partition_gram_stats_device(
     dtype follows the platform default (f32 on TPU) — the same documented
     precision envelope as every other streamed device fit in this repo.
     """
+    from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+
+    device = _resolve_device(device_id)
+    dt = _resolve_dtype(dtype)
+
+    def matrices():
+        for batch in batches:
+            if hasattr(batch, "column"):
+                yield vector_column_to_matrix(batch.column(input_col))
+            else:
+                yield np.asarray(batch, dtype=np.float64)
+
+    row = _device_gram_stats(matrices(), device, dt)
+    if row is not None:
+        yield row
+
+
+def _xy_matrices(batches, features_col: str, label_col: str):
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+            y = np.asarray(batch.column(label_col).to_pylist(),
+                           dtype=np.float64)
+        else:
+            x, y = batch
+            x = np.asarray(x, dtype=np.float64)
+            y = np.asarray(y, dtype=np.float64).reshape(-1)
+        yield x, y
+
+
+def partition_xy_stats_device(
+    batches: Iterable,
+    features_col: str,
+    label_col: str,
+    device_id: int = -1,
+    dtype: str = "auto",
+) -> Iterator[Dict[str, object]]:
+    """Device counterpart of ``aggregate.partition_xy_stats``: the (n+1)²
+    Gram of Z = [X | y] accumulated on this executor's accelerator (the
+    augmented-column trick shared with the streamed LinearRegression)."""
+    from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+
+    device = _resolve_device(device_id)
+    dt = _resolve_dtype(dtype)
+
+    def matrices():
+        for x, y in _xy_matrices(batches, features_col, label_col):
+            yield np.concatenate([x, y.reshape(-1, 1)], axis=1)
+
+    row = _device_gram_stats(matrices(), device, dt)
+    if row is not None:
+        yield row
+
+
+def partition_xy_stats_device_arrow(batches, features_col: str,
+                                    label_col: str, device_id: int = -1):
+    import pyarrow as pa
+
+    for row in partition_xy_stats_device(batches, features_col, label_col,
+                                         device_id):
+        yield pa.RecordBatch.from_pylist([row], schema=stats_arrow_schema())
+
+
+def partition_logreg_stats_device(
+    batches: Iterable,
+    features_col: str,
+    label_col: str,
+    w: np.ndarray,
+    b: float,
+    device_id: int = -1,
+    dtype: str = "auto",
+) -> Iterator[Dict[str, object]]:
+    """Device counterpart of ``aggregate.partition_logreg_stats``: one
+    partition's Newton/IRLS partials under the closure-broadcast (w, b),
+    folded into a donated device accumulator
+    (``ops.logreg_kernel.update_logreg_stats``) — the Hessian's XᵀWX runs
+    on the executor's MXU, not its CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.models.logistic_regression import _check_binary
+    from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+    from spark_rapids_ml_tpu.ops.logreg_kernel import update_logreg_stats
+
+    device = _resolve_device(device_id)
+    dt = _resolve_dtype(dtype)
+    w = np.asarray(w, dtype=np.float64).reshape(-1)
+    n = w.shape[0]
+    carry = None
+    w_dev = b_dev = None
+    loss = 0.0
+    rows_seen = 0   # host-exact: the device carry's count lane is f32
+    for x, y in _xy_matrices(batches, features_col, label_col):
+        m = x.shape[0]
+        if m == 0:
+            continue
+        rows_seen += m
+        _check_binary(y)
+        if carry is None:
+            carry = jax.device_put(
+                (
+                    jnp.zeros((n,), dtype=dt),
+                    jnp.zeros((n, n), dtype=dt),
+                    jnp.zeros((n,), dtype=dt),
+                    jnp.zeros((), dtype=dt),
+                    jnp.zeros((), dtype=dt),
+                    jnp.zeros((), dtype=dt),
+                ),
+                device,
+            )
+            w_dev = jax.device_put(jnp.asarray(w, dtype=dt), device)
+            b_dev = jax.device_put(jnp.asarray(float(b), dtype=dt), device)
+        bucket = _bucket_rows(m)
+        z = np.concatenate([x, y.reshape(-1, 1)], axis=1)
+        if bucket != m:
+            padded = np.zeros((bucket, n + 1), dtype=z.dtype)
+            padded[:m] = z
+            mask = np.zeros(bucket, dtype=bool)
+            mask[:m] = True
+            carry = update_logreg_stats(
+                carry, jnp.asarray(padded, dtype=dt), w_dev, b_dev,
+                jnp.asarray(mask),
+            )
+        else:
+            carry = update_logreg_stats(
+                carry, jnp.asarray(z, dtype=dt), w_dev, b_dev
+            )
+        # stable per-row NLL on host (one matvec — a rounding error next
+        # to the device XᵀWX): log(1+e^z) − y·z
+        zlin = x @ w + float(b)
+        loss += float(np.logaddexp(0.0, zlin).sum() - y @ zlin)
+    if carry is None:
+        return
+    carry = jax.block_until_ready(carry)
+    gx, hxx, hxb, rsum, ssum, cnt = (
+        np.asarray(v, dtype=np.float64) for v in carry
+    )
+    yield {
+        "gx": gx.tolist(),
+        "hxx": hxx.ravel().tolist(),
+        "hxb": hxb.tolist(),
+        "rsum": float(rsum),
+        "ssum": float(ssum),
+        "loss": loss,
+        "count": rows_seen,
+    }
+
+
+def partition_logreg_stats_device_arrow(batches, features_col: str,
+                                        label_col: str, w: np.ndarray,
+                                        b: float, device_id: int = -1):
+    import pyarrow as pa
+
+    from spark_rapids_ml_tpu.spark.aggregate import (
+        logreg_stats_arrow_schema,
+    )
+
+    for row in partition_logreg_stats_device(
+        batches, features_col, label_col, w, b, device_id
+    ):
+        yield pa.RecordBatch.from_pylist(
+            [row], schema=logreg_stats_arrow_schema()
+        )
+
+
+def _kmeans_stats_update(carry, xb, mask, centers):
+    """One Lloyd assignment half-step into a donated carry — module-level
+    jitted kernel (centers are a runtime argument, so every partition task
+    and Lloyd iteration reuses one compiled program per shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    sums, counts, cost = carry
+    k = centers.shape[0]
+    d2 = (
+        jnp.sum(xb * xb, axis=1)[:, None]
+        + jnp.sum(centers * centers, axis=1)[None, :]
+        - 2.0 * jax.lax.dot_general(
+            xb, centers, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    )
+    d2 = jnp.maximum(d2, 0.0)
+    labels = jnp.argmin(d2, axis=1)
+    onehot = (
+        (labels[:, None] == jnp.arange(k)[None, :]).astype(xb.dtype)
+        * mask[:, None]
+    )
+    sums = sums + jax.lax.dot_general(
+        onehot, xb, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    counts = counts + jnp.sum(onehot, axis=0)
+    cost = cost + jnp.sum(jnp.min(d2, axis=1) * mask)
+    return sums, counts, cost
+
+
+def partition_kmeans_stats_device(
+    batches: Iterable,
+    input_col: str,
+    centers: np.ndarray,
+    device_id: int = -1,
+    dtype: str = "auto",
+) -> Iterator[Dict[str, object]]:
+    """Device counterpart of ``aggregate.partition_kmeans_stats``: one
+    Lloyd assignment half-step per partition on the executor's
+    accelerator — assignment distances and the per-cluster Σx as MXU
+    matmuls (the one-hot-matmul scatter), accumulated in a donated
+    carry."""
     import jax
     import jax.numpy as jnp
 
     from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
-    from spark_rapids_ml_tpu.ops.streaming import init_stats, update_stats_auto
 
     device = _resolve_device(device_id)
     dt = _resolve_dtype(dtype)
-    stats = None
-    n_features: Optional[int] = None
+    centers = np.asarray(centers, dtype=np.float64)
+    k, n = centers.shape
+
+    c_dev = None
+    carry = None
+    rows_seen = 0   # host-exact: float cluster counts are a result, the
+    # partition row count must not ride f32
     for batch in batches:
         if hasattr(batch, "column"):
             x = vector_column_to_matrix(batch.column(input_col))
@@ -93,28 +345,53 @@ def partition_gram_stats_device(
         m = x.shape[0]
         if m == 0:
             continue
-        if stats is None:
-            n_features = x.shape[1]
-            stats = init_stats(n_features, dtype=dt, device=device)
-        bucket = _bucket_rows(m)
-        if bucket != m:
-            padded = np.zeros((bucket, n_features), dtype=x.dtype)
-            padded[:m] = x
-            mask = np.zeros(bucket, dtype=bool)
-            mask[:m] = True
-            stats = update_stats_auto(
-                stats, jnp.asarray(padded, dtype=dt), jnp.asarray(mask)
+        rows_seen += m
+        if carry is None:
+            c_dev = jax.device_put(jnp.asarray(centers, dtype=dt), device)
+            carry = jax.device_put(
+                (
+                    jnp.zeros((k, n), dtype=dt),
+                    jnp.zeros((k,), dtype=dt),
+                    jnp.zeros((), dtype=dt),
+                ),
+                device,
             )
-        else:
-            stats = update_stats_auto(stats, jnp.asarray(x, dtype=dt))
-    if stats is None:
+        bucket = _bucket_rows(m)
+        padded = np.zeros((bucket, n), dtype=x.dtype)
+        padded[:m] = x
+        mask = np.zeros(bucket)
+        mask[:m] = 1.0
+        carry = _kmeans_stats_update(
+            carry, jnp.asarray(padded, dtype=dt),
+            jnp.asarray(mask, dtype=dt), c_dev,
+        )
+    if carry is None:
         return
-    stats = jax.block_until_ready(stats)
+    carry = jax.block_until_ready(carry)
+    sums, counts, cost = (np.asarray(v, dtype=np.float64) for v in carry)
     yield {
-        "gram": np.asarray(stats.gram, dtype=np.float64).ravel().tolist(),
-        "col_sum": np.asarray(stats.col_sum, dtype=np.float64).tolist(),
-        "count": int(stats.count),
+        "sums": sums.ravel().tolist(),
+        "counts": counts.tolist(),
+        "cost": float(cost),
+        "count": rows_seen,
     }
+
+
+def partition_kmeans_stats_device_arrow(batches, input_col: str,
+                                        centers: np.ndarray,
+                                        device_id: int = -1):
+    import pyarrow as pa
+
+    from spark_rapids_ml_tpu.spark.aggregate import (
+        kmeans_stats_arrow_schema,
+    )
+
+    for row in partition_kmeans_stats_device(
+        batches, input_col, centers, device_id
+    ):
+        yield pa.RecordBatch.from_pylist(
+            [row], schema=kmeans_stats_arrow_schema()
+        )
 
 
 def partition_gram_stats_device_arrow(

@@ -48,6 +48,30 @@ from spark_rapids_ml_tpu.spark.aggregate import (
 )
 
 
+def _select_stats_plane(executor_device, device_fn, host_fn):
+    """The executor-side plane chooser shared by the statistics
+    front-ends: 'auto' takes the accelerator when the executor has one,
+    'on' requires it, 'off' forces the NumPy-f64 host plane. Returns a
+    cloudpickle-able closure for mapInArrow."""
+    if executor_device not in ("auto", "on", "off"):
+        raise ValueError(
+            f"executorDevice={executor_device!r}: expected "
+            "'auto', 'on', or 'off'"
+        )
+
+    def stats(batches):
+        if executor_device != "off":
+            from spark_rapids_ml_tpu.spark.device_aggregate import (
+                executor_device_available,
+            )
+
+            if executor_device == "on" or executor_device_available():
+                return device_fn(batches)
+        return host_fn(batches)
+
+    return stats
+
+
 class _TpuPCAParams(HasInputCol, HasOutputCol):
     """Param surface mirroring ``RapidsPCAParams`` (``RapidsPCA.scala:30-75``)
     with the reference's GPU toggles renamed to their XLA analogues."""
@@ -206,25 +230,19 @@ class PCA(Estimator, _TpuPCAParams):
                 ) from exc
             rows = mapped.collect()
         else:
-            def stats(batches):
-                # Runs ON the executor. 'auto'/'on' put the Gram on the
-                # executor's accelerator (the reference's per-partition
-                # executor-GPU GEMM, RapidsRowMatrix.scala:168-202); the
-                # host NumPy plane is the fallback, never silently
-                # under 'on'.
-                if executor_device != "off":
-                    from spark_rapids_ml_tpu.spark.device_aggregate import (
-                        executor_device_available,
-                        partition_gram_stats_device_arrow,
-                    )
+            # 'auto'/'on' put the Gram on the executor's accelerator (the
+            # reference's per-partition executor-GPU GEMM,
+            # RapidsRowMatrix.scala:168-202); host NumPy is the fallback
+            from spark_rapids_ml_tpu.spark.device_aggregate import (
+                partition_gram_stats_device_arrow,
+            )
 
-                    if (executor_device == "on"
-                            or executor_device_available()):
-                        return partition_gram_stats_device_arrow(
-                            batches, input_col, device_id
-                        )
-                return partition_gram_stats_arrow(batches, input_col)
-
+            stats = _select_stats_plane(
+                executor_device,
+                lambda b_: partition_gram_stats_device_arrow(
+                    b_, input_col, device_id),
+                lambda b_: partition_gram_stats_arrow(b_, input_col),
+            )
             rows = df.mapInArrow(stats, stats_spark_ddl()).collect()
         gram, col_sum, count = combine_stats(rows)
         n_features = col_sum.shape[0]
@@ -360,12 +378,20 @@ class _TpuLinRegParams(Params):
                      typeConverter=TypeConverters.toFloat)
     fitIntercept = Param(Params._dummy(), "fitIntercept", "fit an intercept",
                          typeConverter=TypeConverters.toBoolean)
+    executorDevice = Param(Params._dummy(), "executorDevice",
+                           "partition statistics on each executor's "
+                           "accelerator: 'auto'/'on'/'off'",
+                           typeConverter=TypeConverters.toString)
+    deviceId = Param(Params._dummy(), "deviceId",
+                     "executor accelerator ordinal; -1 = task assignment",
+                     typeConverter=TypeConverters.toInt)
 
     def __init__(self):
         super().__init__()
         self._setDefault(featuresCol="features", labelCol="label",
                          predictionCol="prediction", regParam=0.0,
-                         fitIntercept=True)
+                         fitIntercept=True, executorDevice="auto",
+                         deviceId=-1)
 
 
 class LinearRegression(Estimator, _TpuLinRegParams):
@@ -376,7 +402,8 @@ class LinearRegression(Estimator, _TpuLinRegParams):
 
     @keyword_only
     def __init__(self, *, featuresCol="features", labelCol="label",
-                 predictionCol="prediction", regParam=0.0, fitIntercept=True):
+                 predictionCol="prediction", regParam=0.0, fitIntercept=True,
+                 executorDevice="auto", deviceId=-1):
         super().__init__()
         self._set(**{k_: v for k_, v in self._input_kwargs.items()
                      if v is not None})
@@ -395,10 +422,19 @@ class LinearRegression(Estimator, _TpuLinRegParams):
 
         fcol = self.getOrDefault(self.featuresCol)
         lcol = self.getOrDefault(self.labelCol)
+        device_id = self.getOrDefault(self.deviceId)
         df = dataset.select(fcol, lcol)
 
-        def stats(batches):
-            return partition_xy_stats_arrow(batches, fcol, lcol)
+        from spark_rapids_ml_tpu.spark.device_aggregate import (
+            partition_xy_stats_device_arrow,
+        )
+
+        stats = _select_stats_plane(
+            self.getOrDefault(self.executorDevice),
+            lambda b: partition_xy_stats_device_arrow(b, fcol, lcol,
+                                                      device_id),
+            lambda b: partition_xy_stats_arrow(b, fcol, lcol),
+        )
 
         rows = df.mapInArrow(stats, stats_spark_ddl()).collect()
         gram, col_sum, count = combine_stats(rows)
@@ -456,13 +492,21 @@ class _TpuLogRegParams(Params):
                     typeConverter=TypeConverters.toInt)
     tol = Param(Params._dummy(), "tol", "Newton step convergence tolerance",
                 typeConverter=TypeConverters.toFloat)
+    executorDevice = Param(Params._dummy(), "executorDevice",
+                           "partition statistics on each executor's "
+                           "accelerator: 'auto'/'on'/'off'",
+                           typeConverter=TypeConverters.toString)
+    deviceId = Param(Params._dummy(), "deviceId",
+                     "executor accelerator ordinal; -1 = task assignment",
+                     typeConverter=TypeConverters.toInt)
 
     def __init__(self):
         super().__init__()
         self._setDefault(featuresCol="features", labelCol="label",
                          predictionCol="prediction",
                          probabilityCol="probability", regParam=0.0,
-                         fitIntercept=True, maxIter=25, tol=1e-8)
+                         fitIntercept=True, maxIter=25, tol=1e-8,
+                         executorDevice="auto", deviceId=-1)
 
 
 class LogisticRegression(Estimator, _TpuLogRegParams):
@@ -480,7 +524,8 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
     @keyword_only
     def __init__(self, *, featuresCol="features", labelCol="label",
                  predictionCol="prediction", probabilityCol="probability",
-                 regParam=0.0, fitIntercept=True, maxIter=25, tol=1e-8):
+                 regParam=0.0, fitIntercept=True, maxIter=25, tol=1e-8,
+                 executorDevice="auto", deviceId=-1):
         super().__init__()
         self._set(**{k_: v for k_, v in self._input_kwargs.items()
                      if v is not None})
@@ -525,12 +570,23 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
             b = 0.0
             n_iter = 0
             objective_history = []
+            from spark_rapids_ml_tpu.spark.device_aggregate import (
+                partition_logreg_stats_device_arrow,
+            )
+
+            executor_device = self.getOrDefault(self.executorDevice)
+            device_id = self.getOrDefault(self.deviceId)
             for n_iter in range(1, self.getOrDefault(self.maxIter) + 1):
                 frozen_w, frozen_b = w.copy(), b
 
-                def stats(batches, _w=frozen_w, _b=frozen_b):
-                    return partition_logreg_stats_arrow(batches, fcol, lcol,
-                                                        _w, _b)
+                stats = _select_stats_plane(
+                    executor_device,
+                    lambda b_, _w=frozen_w, _b=frozen_b:
+                        partition_logreg_stats_device_arrow(
+                            b_, fcol, lcol, _w, _b, device_id),
+                    lambda b_, _w=frozen_w, _b=frozen_b:
+                        partition_logreg_stats_arrow(b_, fcol, lcol, _w, _b),
+                )
 
                 rows = df.mapInArrow(stats, logreg_stats_spark_ddl()).collect()
                 gx, hxx, hxb, rsum, ssum, loss, count = combine_logreg_stats(
@@ -649,11 +705,19 @@ class _TpuKMeansParams(Params):
                 typeConverter=TypeConverters.toFloat)
     seed = Param(Params._dummy(), "seed", "k-means++ seeding RNG seed",
                  typeConverter=TypeConverters.toInt)
+    executorDevice = Param(Params._dummy(), "executorDevice",
+                           "partition statistics on each executor's "
+                           "accelerator: 'auto'/'on'/'off'",
+                           typeConverter=TypeConverters.toString)
+    deviceId = Param(Params._dummy(), "deviceId",
+                     "executor accelerator ordinal; -1 = task assignment",
+                     typeConverter=TypeConverters.toInt)
 
     def __init__(self):
         super().__init__()
         self._setDefault(featuresCol="features", predictionCol="prediction",
-                         k=2, maxIter=20, tol=1e-4, seed=0)
+                         k=2, maxIter=20, tol=1e-4, seed=0,
+                         executorDevice="auto", deviceId=-1)
 
 
 class KMeans(Estimator, _TpuKMeansParams):
@@ -664,7 +728,8 @@ class KMeans(Estimator, _TpuKMeansParams):
 
     @keyword_only
     def __init__(self, *, k=2, featuresCol="features",
-                 predictionCol="prediction", maxIter=20, tol=1e-4, seed=0):
+                 predictionCol="prediction", maxIter=20, tol=1e-4, seed=0,
+                 executorDevice="auto", deviceId=-1):
         super().__init__()
         self._set(**{k_: v for k_, v in self._input_kwargs.items()
                      if v is not None})
@@ -692,20 +757,34 @@ class KMeans(Estimator, _TpuKMeansParams):
 
         n = centers.shape[1]
         cost = float("inf")
+        from spark_rapids_ml_tpu.spark.device_aggregate import (
+            partition_kmeans_stats_device_arrow,
+        )
+
+        executor_device = self.getOrDefault(self.executorDevice)
+        device_id = self.getOrDefault(self.deviceId)
+
+        def host_stats(batches, _c):
+            import pyarrow as pa
+
+            from spark_rapids_ml_tpu.spark.aggregate import (
+                kmeans_stats_arrow_schema,
+            )
+
+            for row in partition_kmeans_stats(batches, fcol, _c):
+                yield pa.RecordBatch.from_pylist(
+                    [row], schema=kmeans_stats_arrow_schema()
+                )
+
         for _ in range(self.getOrDefault(self.maxIter)):
             frozen = centers.copy()
 
-            def stats(batches, _c=frozen):
-                import pyarrow as pa
-
-                from spark_rapids_ml_tpu.spark.aggregate import (
-                    kmeans_stats_arrow_schema,
-                )
-
-                for row in partition_kmeans_stats(batches, fcol, _c):
-                    yield pa.RecordBatch.from_pylist(
-                        [row], schema=kmeans_stats_arrow_schema()
-                    )
+            stats = _select_stats_plane(
+                executor_device,
+                lambda b_, _c=frozen: partition_kmeans_stats_device_arrow(
+                    b_, fcol, _c, device_id),
+                lambda b_, _c=frozen: host_stats(b_, _c),
+            )
 
             rows = df.mapInArrow(stats, kmeans_stats_spark_ddl()).collect()
             sums, counts, cost, _ = combine_kmeans_stats(rows, k, n)

@@ -21,3 +21,17 @@ def force_cpu_if_requested() -> None:
     tokens = want.split(",") if want else []
     if "cpu" in tokens and "axon" not in tokens:
         jax.config.update("jax_platforms", "cpu")
+
+
+# Peak per-chip dense MXU FLOP/s by device kind (bf16). Shared by the
+# benches so MFU numbers can't drift between them; unknown kinds report
+# None rather than a made-up number.
+PEAK_FLOPS_BF16 = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}

@@ -251,3 +251,42 @@ def test_kmeans_front_end(rng):
     out = model.transform(df).collect()
     labels = np.asarray([r["prediction"] for r in out])
     assert len(np.unique(labels)) == 3
+
+
+def test_linreg_executor_device_matches_host_plane(spark, rng):
+    x = rng.normal(size=(300, 5))
+    y = x @ np.array([1.0, -2.0, 0.5, 3.0, 0.0]) + 0.7
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    on = LinearRegression(executorDevice="on").fit(df)
+    off = LinearRegression(executorDevice="off").fit(df)
+    np.testing.assert_allclose(
+        on.coefficients.toArray(), off.coefficients.toArray(), atol=1e-5
+    )
+    assert abs(on.intercept - off.intercept) < 1e-5
+
+
+def test_logreg_executor_device_matches_host_plane(spark, rng):
+    x = rng.normal(size=(400, 4))
+    p = 1.0 / (1.0 + np.exp(-(x @ np.array([2.0, -1.0, 0.5, 1.5]))))
+    y = (rng.random(400) < p).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    on = LogisticRegression(regParam=0.02, executorDevice="on").fit(df)
+    off = LogisticRegression(regParam=0.02, executorDevice="off").fit(df)
+    np.testing.assert_allclose(
+        on.coefficients.toArray(), off.coefficients.toArray(), atol=1e-4
+    )
+    assert abs(on.intercept - off.intercept) < 1e-4
+
+
+def test_kmeans_executor_device_matches_host_plane(rng):
+    spark = LocalSparkSession(n_partitions=2)
+    centers = np.array([[0.0, 6.0], [6.0, 0.0], [-6.0, -6.0]])
+    x = np.concatenate(
+        [c + 0.3 * rng.normal(size=(50, 2)) for c in centers]
+    )
+    df = _vector_df(spark, x)
+    on = KMeans(k=3, seed=7, executorDevice="on").fit(df)
+    off = KMeans(k=3, seed=7, executorDevice="off").fit(df)
+    c_on = np.sort(np.asarray(on.clusterCenters()), axis=0)
+    c_off = np.sort(np.asarray(off.clusterCenters()), axis=0)
+    np.testing.assert_allclose(c_on, c_off, atol=1e-4)
