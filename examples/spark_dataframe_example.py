@@ -1,0 +1,66 @@
+"""The DataFrame front-ends, end to end.
+
+The reference is consumed from spark-shell as a one-import drop-in over
+DataFrames (`/root/reference/README.md:12-28`). This example drives the
+same surface here. With pyspark installed, swap ``LocalSparkSession`` for
+a real ``SparkSession`` and everything below runs unchanged — the
+front-ends bind to whichever is present (``spark/_compat.py``).
+
+Run: ``python examples/spark_dataframe_example.py``
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu.spark.local_engine import (
+    DenseVector,
+    LocalSparkSession,
+)
+
+
+def main() -> None:
+    # executors="process" runs each partition task in a separate spawned
+    # worker process — the executor boundary, minus the cluster
+    spark = LocalSparkSession(n_partitions=2)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(500, 8))
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(float)
+
+    df = spark.createDataFrame(
+        [{"features": DenseVector(r), "label": float(v)}
+         for r, v in zip(x, y)]
+    )
+
+    # statistics families: executors emit sufficient statistics per
+    # partition (on their accelerator under executorDevice='auto'), the
+    # driver finalizes on its device
+    from spark_rapids_ml_tpu.spark import PCA, LinearRegression
+
+    pca_model = PCA(k=3, inputCol="features").fit(df)
+    projected = pca_model.transform(df).collect()
+    print("PCA:", pca_model.pc.toArray().shape, "->",
+          projected[0]["pca_features"])
+
+    linreg = LinearRegression().fit(df)
+    print("LinearRegression coef:", linreg.coefficients.toArray().round(3))
+
+    # generic-adapter families: driver-device fit, per-batch pandas-UDF
+    # transform on executors
+    from spark_rapids_ml_tpu.spark import LinearSVC, RandomForestClassifier
+
+    rf = RandomForestClassifier(numTrees=10, maxDepth=4, seed=1).fit(df)
+    rf_acc = np.mean([
+        r["prediction"] == yi
+        for r, yi in zip(rf.transform(df).collect(), y)
+    ])
+    print("RandomForest accuracy:", round(float(rf_acc), 3))
+
+    svc = LinearSVC(regParam=0.01).fit(df)
+    svc_acc = np.mean([
+        r["prediction"] == yi
+        for r, yi in zip(svc.transform(df).collect(), y)
+    ])
+    print("LinearSVC accuracy:", round(float(svc_acc), 3))
+
+
+if __name__ == "__main__":
+    main()

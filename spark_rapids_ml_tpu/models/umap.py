@@ -286,15 +286,28 @@ class UMAPModel(UMAPParams):
         items = jax.device_put(
             jnp.asarray(self.train_items_, dtype=dtype), device
         )
-        q_dev = jax.device_put(jnp.asarray(q, dtype=dtype), device)
-        dists, idx = knn_kernel(q_dev, items, k)
-        rho, sigma = smooth_knn_calibration(dists)
-        w = jnp.exp(
-            -jnp.maximum(dists - rho[:, None], 0.0) / sigma[:, None]
-        )
-        w = w / jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-12)
         emb_dev = jnp.asarray(self.embedding_, dtype=dtype)
-        placed = jnp.einsum("qk,qkd->qd", w, emb_dev[idx])
+        # query chunks bound device memory at (chunk x n_train) — the same
+        # tiling discipline as the blocked fit; one compiled shape
+        chunk = int(self.getBlockRows() or 4096)
+        placed = np.empty((q.shape[0], emb_dev.shape[1]), dtype=np.float64)
+        for s in range(0, q.shape[0], chunk):
+            part = q[s:s + chunk]
+            pad = chunk - part.shape[0] if q.shape[0] > chunk else 0
+            if pad:
+                part = np.concatenate(
+                    [part, np.zeros((pad, q.shape[1]))], axis=0
+                )
+            q_dev = jax.device_put(jnp.asarray(part, dtype=dtype), device)
+            dists, idx = knn_kernel(q_dev, items, k)
+            rho, sigma = smooth_knn_calibration(dists)
+            w = jnp.exp(
+                -jnp.maximum(dists - rho[:, None], 0.0) / sigma[:, None]
+            )
+            w = w / jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-12)
+            out = jnp.einsum("qk,qkd->qd", w, emb_dev[idx])
+            rows = part.shape[0] - pad
+            placed[s:s + rows] = np.asarray(out, dtype=np.float64)[:rows]
         return frame.with_column(
-            self.getOutputCol(), np.asarray(placed, dtype=np.float64).tolist()
+            self.getOutputCol(), placed.tolist()
         )

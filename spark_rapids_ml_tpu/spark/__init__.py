@@ -48,6 +48,12 @@ _ADAPTER_CLASSES = (
     "MaxAbsScalerModel",
     "NearestNeighbors",
     "NearestNeighborsModel",
+    "TruncatedSVD",
+    "TruncatedSVDModel",
+    "OneVsRest",
+    "OneVsRestModel",
+    "UMAP",
+    "UMAPModel",
 )
 
 __all__ = [

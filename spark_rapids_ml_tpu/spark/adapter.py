@@ -45,9 +45,12 @@ __all__ = [
     "MinMaxScaler",
     "NaiveBayes",
     "NearestNeighbors",
+    "OneVsRest",
+    "UMAP",
     "RandomForestClassifier",
     "RandomForestRegressor",
     "StandardScaler",
+    "TruncatedSVD",
 ]
 
 
@@ -269,6 +272,10 @@ from spark_rapids_ml_tpu.models.scaler import (  # noqa: E402
     StandardScaler as _LSS,
     StandardScalerModel as _LSS_M,
 )
+from spark_rapids_ml_tpu.models.svd import (  # noqa: E402
+    TruncatedSVD as _LSVD,
+    TruncatedSVDModel as _LSVD_M,
+)
 
 RandomForestClassifier, RandomForestClassifierModel = _make_pair(
     "RandomForestClassifier", _LRFC, _LRFC_M, needs_label=True,
@@ -302,6 +309,57 @@ MaxAbsScaler, MaxAbsScalerModel = _make_pair(
     "MaxAbsScaler", _LMAS, _LMAS_M, needs_label=False,
     out_col_param="outputCol", out_kind="vector",
 )
+TruncatedSVD, TruncatedSVDModel = _make_pair(
+    "TruncatedSVD", _LSVD, _LSVD_M, needs_label=False,
+    out_col_param="outputCol", out_kind="vector",
+    doc="Top-k singular structure on the driver's device.",
+)
+
+
+from spark_rapids_ml_tpu.models.umap import (  # noqa: E402
+    UMAP as _LUMAP,
+    UMAPModel as _LUMAP_M,
+)
+
+UMAP, UMAPModel = _make_pair(
+    "UMAP", _LUMAP, _LUMAP_M, needs_label=False,
+    out_col_param="outputCol", out_kind="vector",
+    doc="Fit embeds the collected items on the driver's device; "
+        "transform is the out-of-sample placement rule, applied per "
+        "Arrow batch on executors.",
+)
+
+
+class OneVsRest(_AdapterEstimator):
+    """DataFrame front-end over ``models.OneVsRest``: multiclass reduction
+    over any local binary classifier (``spark.OneVsRest(classifier=
+    LinearSVC(...)._local)`` or any ``spark_rapids_ml_tpu`` estimator)."""
+
+    from spark_rapids_ml_tpu.models.ovr import OneVsRest as _local_cls_ref
+
+    _local_cls = _local_cls_ref
+    _needs_label = True
+
+    def __init__(self, classifier=None, **kwargs):
+        super().__init__(**kwargs)
+        if classifier is not None:
+            # accept either a local estimator or an adapter wrapper
+            self._local.classifier = getattr(classifier, "_local",
+                                             classifier)
+
+    def _fit(self, dataset):
+        local_model = self._local.fit(self._collect_frame(dataset))
+        return OneVsRestModel(local_model)
+
+
+class OneVsRestModel(_AdapterModel):
+    from spark_rapids_ml_tpu.models.ovr import (
+        OneVsRestModel as _local_model_cls_ref,
+    )
+
+    _local_model_cls = _local_model_cls_ref
+    _out_col_param = "predictionCol"
+    _out_kind = "double"
 
 
 class NearestNeighbors(_AdapterEstimator):

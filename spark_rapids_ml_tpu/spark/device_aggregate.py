@@ -279,10 +279,11 @@ def partition_logreg_stats_device_arrow(batches, features_col: str,
         )
 
 
-def _kmeans_stats_update(carry, xb, mask, centers):
-    """One Lloyd assignment half-step into a donated carry — module-level
-    jitted kernel (centers are a runtime argument, so every partition task
-    and Lloyd iteration reuses one compiled program per shape)."""
+def _kmeans_stats_update_impl(carry, xb, mask, centers):
+    """One Lloyd assignment half-step into a donated carry. Per-cluster
+    counts ride an int32 lane (f32 would saturate at 2^24 and silently
+    bias centers = sums/counts on large partitions); the one-hot matmul
+    scatter stays in the compute dtype for the MXU."""
     import jax
     import jax.numpy as jnp
 
@@ -298,17 +299,32 @@ def _kmeans_stats_update(carry, xb, mask, centers):
     )
     d2 = jnp.maximum(d2, 0.0)
     labels = jnp.argmin(d2, axis=1)
-    onehot = (
-        (labels[:, None] == jnp.arange(k)[None, :]).astype(xb.dtype)
-        * mask[:, None]
-    )
+    hit = (labels[:, None] == jnp.arange(k)[None, :])
+    onehot = hit.astype(xb.dtype) * mask[:, None]
     sums = sums + jax.lax.dot_general(
         onehot, xb, (((0,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
     )
-    counts = counts + jnp.sum(onehot, axis=0)
+    counts = counts + jnp.sum(
+        (hit & (mask[:, None] > 0)).astype(jnp.int32), axis=0
+    )
     cost = cost + jnp.sum(jnp.min(d2, axis=1) * mask)
     return sums, counts, cost
+
+
+_KMEANS_UPDATE = None
+
+
+def _kmeans_stats_update(carry, xb, mask, centers):
+    """Jit-cached (donated-carry) wrapper — one compiled program per
+    shape across every partition task and Lloyd iteration."""
+    global _KMEANS_UPDATE
+    if _KMEANS_UPDATE is None:
+        import jax
+
+        _KMEANS_UPDATE = jax.jit(_kmeans_stats_update_impl,
+                                 donate_argnums=(0,))
+    return _KMEANS_UPDATE(carry, xb, mask, centers)
 
 
 def partition_kmeans_stats_device(
@@ -351,16 +367,20 @@ def partition_kmeans_stats_device(
             carry = jax.device_put(
                 (
                     jnp.zeros((k, n), dtype=dt),
-                    jnp.zeros((k,), dtype=dt),
+                    jnp.zeros((k,), dtype=jnp.int32),
                     jnp.zeros((), dtype=dt),
                 ),
                 device,
             )
         bucket = _bucket_rows(m)
-        padded = np.zeros((bucket, n), dtype=x.dtype)
-        padded[:m] = x
-        mask = np.zeros(bucket)
-        mask[:m] = 1.0
+        if bucket != m:
+            padded = np.zeros((bucket, n), dtype=x.dtype)
+            padded[:m] = x
+            mask = np.zeros(bucket)
+            mask[:m] = 1.0
+        else:
+            padded = x
+            mask = np.ones(m)
         carry = _kmeans_stats_update(
             carry, jnp.asarray(padded, dtype=dt),
             jnp.asarray(mask, dtype=dt), c_dev,

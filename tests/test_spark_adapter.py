@@ -126,3 +126,49 @@ def test_adapter_persistence_roundtrip(spark, rng, tmp_path):
 def test_adapter_unknown_param_raises():
     with pytest.raises(ValueError, match="no param"):
         RandomForestClassifier(nope=3)
+
+
+def test_truncated_svd_front_end(spark, rng):
+    from spark_rapids_ml_tpu.spark import TruncatedSVD
+
+    x = rng.normal(size=(150, 8))
+    df = _df(spark, x)
+    model = TruncatedSVD(k=3).fit(df)
+    out = model.transform(df).collect()
+    col = model._local.getOutputCol()
+    proj = np.stack([r[col].toArray() for r in out])
+    assert proj.shape == (150, 3)
+    # projection variance ordering: leading components carry more energy
+    v = proj.var(axis=0)
+    assert v[0] >= v[1] >= v[2]
+
+
+def test_ovr_front_end(spark, rng):
+    from spark_rapids_ml_tpu import LogisticRegression as LocalLogReg
+    from spark_rapids_ml_tpu.spark import OneVsRest
+
+    centers = np.array([[4, 0, 0], [0, 4, 0], [0, 0, 4]], dtype=float)
+    y = rng.integers(0, 3, size=300).astype(float)
+    x = rng.normal(size=(300, 3)) + centers[y.astype(int)]
+    df = _df(spark, x, y)
+    ovr = OneVsRest(classifier=LocalLogReg().setRegParam(0.01)).fit(df)
+    out = ovr.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    assert (pred == y).mean() > 0.9
+
+
+def test_umap_front_end(spark, rng):
+    from spark_rapids_ml_tpu.spark import UMAP
+
+    centers = np.array([np.eye(6)[i] * 8 for i in range(2)])
+    y = rng.integers(0, 2, size=120)
+    x = rng.normal(size=(120, 6)) * 0.3 + centers[y]
+    df = _df(spark, x)
+    model = UMAP(nNeighbors=8, nEpochs=80).fit(df)
+    out = model.transform(df).collect()
+    col = model._local.getOutputCol()
+    emb = np.stack([r[col].toArray() for r in out])
+    assert emb.shape == (120, 2) and np.isfinite(emb).all()
+    c0, c1 = emb[y == 0].mean(0), emb[y == 1].mean(0)
+    spread = max(emb[y == 0].std(), emb[y == 1].std())
+    assert np.linalg.norm(c0 - c1) > 2.0 * spread
