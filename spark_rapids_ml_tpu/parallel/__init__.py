@@ -9,6 +9,12 @@ from spark_rapids_ml_tpu.parallel.distributed_knn import (
 from spark_rapids_ml_tpu.parallel.distributed_ivf import (
     distributed_ivf_search,
 )
+from spark_rapids_ml_tpu.parallel.distributed_dbscan import (
+    distributed_dbscan_labels,
+)
+from spark_rapids_ml_tpu.parallel.distributed_umap import (
+    distributed_umap_optimize,
+)
 from spark_rapids_ml_tpu.parallel.distributed_forest import (
     distributed_forest_fit,
 )
@@ -41,6 +47,8 @@ __all__ = [
     "distributed_pca_fit_kernel",
     "distributed_kneighbors",
     "distributed_ivf_search",
+    "distributed_dbscan_labels",
+    "distributed_umap_optimize",
     "distributed_forest_fit",
     "distributed_kmeans_fit",
     "distributed_kmeans_fit_kernel",

@@ -1,0 +1,51 @@
+"""Distributed DBSCAN on the 8-virtual-device CPU mesh: exact agreement
+with the single-device kernels."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import DBSCAN
+from spark_rapids_ml_tpu.parallel import data_mesh, distributed_dbscan_labels
+
+
+def _blobs(rng, per=40, noise=5):
+    centers = np.array([[0, 8], [8, 0], [-8, -8]], dtype=float)
+    pts = [c + 0.6 * rng.normal(size=(per, 2)) for c in centers]
+    pts.append(rng.uniform(-30, 30, size=(noise, 2)))
+    return np.concatenate(pts)
+
+
+def test_distributed_matches_single_device(rng):
+    x = _blobs(rng)
+    single = DBSCAN().setEps(1.5).setMinPts(5).fit(x)
+    from spark_rapids_ml_tpu.models.dbscan import _relabel_consecutive
+
+    mesh = data_mesh(8)
+    labels, core = distributed_dbscan_labels(x, 1.5, 5, mesh,
+                                             dtype=np.float64)
+    np.testing.assert_array_equal(
+        _relabel_consecutive(labels), single.labels_
+    )
+    np.testing.assert_array_equal(core, single.core_mask_)
+
+
+def test_distributed_uneven_rows(rng):
+    x = _blobs(rng, per=41, noise=3)   # 126 rows: pads to 128 on 8 devices
+    mesh = data_mesh(8)
+    labels, core = distributed_dbscan_labels(x, 1.5, 5, mesh,
+                                             dtype=np.float64)
+    assert labels.shape == (126,) and core.shape == (126,)
+    single = DBSCAN().setEps(1.5).setMinPts(5).fit(x)
+    from spark_rapids_ml_tpu.models.dbscan import _relabel_consecutive
+
+    np.testing.assert_array_equal(
+        _relabel_consecutive(labels), single.labels_
+    )
+
+
+def test_distributed_envelope_guard():
+    mesh = data_mesh(2)
+    with pytest.raises(ValueError, match="2\\^24"):
+        distributed_dbscan_labels(
+            np.zeros((2 ** 24 + 8, 1), dtype=np.float32), 1.0, 2, mesh
+        )
