@@ -87,7 +87,6 @@ class _GBTBase(GBTParams):
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.ops.forest_kernel import (
-            TreeEnsemble,
             grow_tree_regression,
             quantile_bins,
         )
@@ -126,62 +125,31 @@ class _GBTBase(GBTParams):
             init = float(np.log(p0 / (1.0 - p0)))
         else:
             init = float(y.mean())
-        f = np.full(n, init)
 
         rate = float(self.getSubsamplingRate())
-        feats_l, thrs_l, leaves_l = [], [], []
+
+        def grow_fn(r, w):
+            ft, tt, leaf, leaf_ids_dev = grow_tree_regression(
+                binned,
+                jax.device_put(jnp.asarray(r, dtype=dtype), device),
+                jax.device_put(jnp.asarray(w, dtype=dtype), device),
+                full_mask,
+                depth,
+                n_bins,
+                self.getMinInstancesPerNode(),
+                return_leaf_ids=True,
+            )
+            return (np.asarray(ft), np.asarray(tt), np.asarray(leaf),
+                    np.asarray(leaf_ids_dev))
+
         with timer.phase("boost"), TraceRange("gbt boost", TraceColor.RED):
-            for _ in range(self.getMaxIter()):
-                if self._classification:
-                    p = 1.0 / (1.0 + np.exp(-f))
-                    r = y - p
-                    hess = np.maximum(p * (1.0 - p), 1e-12)
-                else:
-                    r = y - f
-                    hess = np.ones(n)
-                # Spark semantics: subsamplingRate=1.0 means NO
-                # subsampling (unit weights, deterministic regardless of
-                # seed); below 1.0, Poisson(rate) row weights implement
-                # stochastic gradient boosting
-                w = (
-                    np.ones(n)
-                    if rate >= 1.0
-                    else rng.poisson(rate, n).astype(np.float64)
-                )
-                ft, tt, leaf, leaf_ids_dev = grow_tree_regression(
-                    binned,
-                    jax.device_put(jnp.asarray(r, dtype=dtype), device),
-                    jax.device_put(jnp.asarray(w, dtype=dtype), device),
-                    full_mask,
-                    depth,
-                    n_bins,
-                    self.getMinInstancesPerNode(),
-                    return_leaf_ids=True,
-                )
-                leaf_ids = np.asarray(leaf_ids_dev)
-                if self._classification:
-                    # Newton leaf refit: Σw·r / Σw·h per leaf (the GBM
-                    # logistic-loss leaf); the grower's mean-residual
-                    # leaves are only the squared-loss optimum
-                    n_leaves = 2 ** depth
-                    num = np.bincount(
-                        leaf_ids, weights=w * r, minlength=n_leaves
-                    )
-                    den = np.bincount(
-                        leaf_ids, weights=w * hess, minlength=n_leaves
-                    )
-                    leaf = np.where(den > 0, num / np.maximum(den, 1e-12), 0.0)
-                else:
-                    leaf = np.asarray(leaf)
-                f = f + lr * leaf[leaf_ids]
-                feats_l.append(np.asarray(ft))
-                thrs_l.append(np.asarray(tt))
-                leaves_l.append(leaf)
-        ensemble = TreeEnsemble(
-            feature=np.stack(feats_l),
-            threshold=np.stack(thrs_l),
-            leaf_value=np.stack(leaves_l),
-        )
+            ensemble = boosting_loop(
+                y_padded=y, mask=np.ones(n), n_real=n, init=init,
+                max_iter=self.getMaxIter(), step_size=lr,
+                classification=self._classification,
+                subsampling_rate=rate, rng=rng, max_depth=depth,
+                grow_fn=grow_fn,
+            )
         model = self._model_cls()(
             ensemble=ensemble, edges=edges, init=init, step_size=lr
         )
@@ -315,3 +283,56 @@ class GBTClassificationModel(GBTClassifierParams, _GBTModelBase):
             self.getPredictionCol(),
             (proba >= 0.5).astype(np.float64).tolist(),
         )
+
+
+def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
+                  classification, subsampling_rate, rng, max_depth,
+                  grow_fn):
+    """Shared gradient-boosting driver (local and distributed fits).
+
+    ``grow_fn(r, w) -> (feature, threshold, leaf_value, leaf_ids)`` grows
+    one regression tree on the residuals — on one device or sharded over
+    a mesh; everything else (logistic residuals, Spark's
+    subsamplingRate=1.0 no-subsampling convention, the Newton leaf refit
+    Σw·r / Σw·h for classification, the margin update) lives here ONCE.
+    ``y_padded``/``mask`` may carry zero-weight padding rows; Poisson
+    weights are drawn over the REAL ``n_real`` rows so the RNG stream is
+    identical with or without padding.
+    """
+    from spark_rapids_ml_tpu.ops.forest_kernel import TreeEnsemble
+
+    f = np.full(len(y_padded), float(init))
+    n_leaves = 2 ** max_depth
+    feats_l, thrs_l, leaves_l = [], [], []
+    for _ in range(max_iter):
+        if classification:
+            p = 1.0 / (1.0 + np.exp(-f))
+            r = y_padded - p
+            hess = np.maximum(p * (1.0 - p), 1e-12)
+        else:
+            r = y_padded - f
+            hess = np.ones_like(f)
+        if subsampling_rate >= 1.0:
+            # Spark semantics: 1.0 means NO subsampling (unit weights,
+            # deterministic regardless of seed)
+            w = np.asarray(mask, dtype=np.float64).copy()
+        else:
+            w = np.zeros(len(y_padded))
+            w[:n_real] = rng.poisson(subsampling_rate, n_real)
+        ft, tt, leaf, leaf_ids = grow_fn(r, w)
+        if classification:
+            # Newton leaf refit: the grower's mean-residual leaves are
+            # only the squared-loss optimum
+            num = np.bincount(leaf_ids, weights=w * r, minlength=n_leaves)
+            den = np.bincount(leaf_ids, weights=w * hess,
+                              minlength=n_leaves)
+            leaf = np.where(den > 0, num / np.maximum(den, 1e-12), 0.0)
+        f = f + step_size * leaf[leaf_ids]
+        feats_l.append(ft)
+        thrs_l.append(tt)
+        leaves_l.append(leaf)
+    return TreeEnsemble(
+        feature=np.stack(feats_l),
+        threshold=np.stack(thrs_l),
+        leaf_value=np.stack(leaves_l),
+    )

@@ -18,6 +18,9 @@ from spark_rapids_ml_tpu.parallel.distributed_umap import (
 from spark_rapids_ml_tpu.parallel.distributed_forest import (
     distributed_forest_fit,
 )
+from spark_rapids_ml_tpu.parallel.distributed_gbt import (
+    distributed_gbt_fit,
+)
 from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     distributed_kmeans_fit,
     distributed_kmeans_fit_kernel,
@@ -50,6 +53,7 @@ __all__ = [
     "distributed_dbscan_labels",
     "distributed_umap_optimize",
     "distributed_forest_fit",
+    "distributed_gbt_fit",
     "distributed_kmeans_fit",
     "distributed_kmeans_fit_kernel",
     "distributed_linreg_fit",

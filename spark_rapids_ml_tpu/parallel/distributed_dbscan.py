@@ -30,36 +30,51 @@ from spark_rapids_ml_tpu.ops.knn_kernel import pairwise_sqdist
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
 
 
-@partial(jax.jit, static_argnames=("min_pts", "mesh"))
-def _sharded_dbscan(x, valid, eps, min_pts: int, mesh: Mesh):
+@partial(jax.jit, static_argnames=("min_pts", "inner_block", "mesh"))
+def _sharded_dbscan(x, valid, eps, min_pts: int, inner_block: int,
+                    mesh: Mesh):
     n = x.shape[0]
     dt = x.dtype
     inf = jnp.asarray(jnp.inf, dt)
     n_dev = int(np.prod(mesh.devices.shape))
     rows_per = n // n_dev
+    assert rows_per % inner_block == 0
+    nb = rows_per // inner_block
     valid_f = valid.astype(dt)
     x_panels = x.reshape(n_dev, rows_per, x.shape[1])
 
     def per_shard(x_panel):
-        # x_panel: (1, rows_per, d) — this device's row range
+        # x_panel: (1, rows_per, d) — this device's row range. Distance
+        # panels are recomputed per sweep in (inner_block × n) tiles
+        # under lax.map — the blocked kernel's streaming discipline, so
+        # per-device memory is one tile, not rows_per × n.
         xp = x_panel[0]
+        xpb = xp.reshape(nb, inner_block, xp.shape[1])
         idx0 = lax.axis_index(DATA_AXIS) * rows_per
 
-        d2 = pairwise_sqdist(xp, x)
-        adj = (d2 <= eps * eps).astype(dt) * valid_f[None, :]
+        def degree_block(xi):
+            d2 = pairwise_sqdist(xi, x)
+            return jnp.sum(
+                (d2 <= eps * eps).astype(dt) * valid_f[None, :], axis=1
+            )
+
         my_valid = lax.dynamic_slice_in_dim(valid, idx0, rows_per)
-        degree = jnp.sum(adj, axis=1) * my_valid.astype(dt)
+        degree = lax.map(degree_block, xpb).reshape(rows_per)
         core_local = (degree >= min_pts) & my_valid
         core = lax.all_gather(core_local, DATA_AXIS, axis=0, tiled=True)
         core_f = core.astype(dt)
-        adj_core = adj * core_f[None, :]
 
         labels0 = jnp.where(core, jnp.arange(n, dtype=dt), inf)
 
         def neighbor_min(labels):
-            return jnp.min(
-                jnp.where(adj_core > 0, labels[None, :], inf), axis=1
-            )
+            def blk(xi):
+                d2 = pairwise_sqdist(xi, x)
+                adj_core = (d2 <= eps * eps).astype(dt) * core_f[None, :]
+                return jnp.min(
+                    jnp.where(adj_core > 0, labels[None, :], inf), axis=1
+                )
+
+            return lax.map(blk, xpb).reshape(rows_per)
 
         def body(state):
             labels, _ = state
@@ -99,10 +114,12 @@ def distributed_dbscan_labels(
     min_pts: int,
     mesh: Mesh,
     dtype=jnp.float32,
+    inner_block: int = 1024,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """(labels, core_mask) with the ε-graph row panels computed one per
-    device. Labels are cluster representatives (minimum row index), noise
-    −1 — relabel with the estimator's helper for consecutive ids."""
+    device, each panel streamed in (inner_block × n) tiles. Labels are
+    cluster representatives (minimum row index), noise −1 — relabel with
+    the estimator's helper for consecutive ids."""
     x_host = np.asarray(x_host, dtype=np.dtype(dtype))
     n = x_host.shape[0]
     if n > 2 ** 24:
@@ -110,12 +127,16 @@ def distributed_dbscan_labels(
             f"{n} rows exceeds the f32 label-lane envelope (2^24)"
         )
     n_dev = int(np.prod(mesh.devices.shape))
-    x_pad, mask = pad_rows_to_multiple(x_host, n_dev)
+    # rows pad to a multiple of n_dev·inner_block so each device's panel
+    # tiles evenly; shrink the tile rather than over-pad tiny inputs
+    inner = max(1, min(inner_block, -(-n // n_dev)))
+    x_pad, mask = pad_rows_to_multiple(x_host, n_dev * inner)
     valid = mask > 0
     x_dev = jax.device_put(jnp.asarray(x_pad), NamedSharding(mesh, P()))
     valid_dev = jax.device_put(jnp.asarray(valid), NamedSharding(mesh, P()))
     labels, core = _sharded_dbscan(
-        x_dev, valid_dev, jnp.asarray(eps, dtype=x_dev.dtype), min_pts, mesh
+        x_dev, valid_dev, jnp.asarray(eps, dtype=x_dev.dtype), min_pts,
+        inner, mesh,
     )
     return (
         np.asarray(labels)[:n],

