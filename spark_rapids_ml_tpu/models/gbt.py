@@ -105,8 +105,6 @@ class _GBTBase(GBTParams):
             raise ValueError(
                 f"labels length {y.shape[0]} != rows {x.shape[0]}"
             )
-        if self._classification and not np.isin(y, (0.0, 1.0)).all():
-            raise ValueError("GBTClassifier requires 0/1 labels")
         n, d = x.shape
         depth = self.getMaxDepth()
         n_bins = self.getMaxBins()
@@ -120,11 +118,7 @@ class _GBTBase(GBTParams):
         binned = jax.device_put(jnp.asarray(binned_np, jnp.int32), device)
         full_mask = jnp.asarray(np.ones((depth, d)), dtype=dtype)
 
-        if self._classification:
-            p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
-            init = float(np.log(p0 / (1.0 - p0)))
-        else:
-            init = float(y.mean())
+        init = gbt_init_margin(y, self._classification)
 
         rate = float(self.getSubsamplingRate())
 
@@ -283,6 +277,19 @@ class GBTClassificationModel(GBTClassifierParams, _GBTModelBase):
             self.getPredictionCol(),
             (proba >= 0.5).astype(np.float64).tolist(),
         )
+
+
+def gbt_init_margin(y, classification):
+    """Initial boosting margin + label validation — one definition for
+    the local and distributed fits (log-odds of the clipped base rate for
+    classification, the label mean for regression)."""
+    y = np.asarray(y, dtype=np.float64).reshape(-1)
+    if classification and not np.isin(y, (0.0, 1.0)).all():
+        raise ValueError("GBT classification requires 0/1 labels")
+    if classification:
+        p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
+        return float(np.log(p0 / (1.0 - p0)))
+    return float(y.mean())
 
 
 def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,

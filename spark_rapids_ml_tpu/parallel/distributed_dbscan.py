@@ -127,9 +127,14 @@ def distributed_dbscan_labels(
             f"{n} rows exceeds the f32 label-lane envelope (2^24)"
         )
     n_dev = int(np.prod(mesh.devices.shape))
-    # rows pad to a multiple of n_dev·inner_block so each device's panel
-    # tiles evenly; shrink the tile rather than over-pad tiny inputs
-    inner = max(1, min(inner_block, -(-n // n_dev)))
+    # rows pad to a multiple of n_dev·inner so each device's panel tiles
+    # evenly. The tile SHRINKS to fit rather than the input padding up to
+    # the tile: nb tiles of ceil(per_dev/nb) rows bounds padding by
+    # n_dev·nb rows (padding to a blunt n_dev·inner_block multiple could
+    # add up to 64% phantom rows and square into every distance panel)
+    per_dev = -(-n // n_dev)
+    nb = max(1, -(-per_dev // inner_block))
+    inner = -(-per_dev // nb)
     x_pad, mask = pad_rows_to_multiple(x_host, n_dev * inner)
     valid = mask > 0
     x_dev = jax.device_put(jnp.asarray(x_pad), NamedSharding(mesh, P()))

@@ -72,7 +72,7 @@ def distributed_gbt_fit(
 ) -> Tuple[TreeEnsemble, np.ndarray, float]:
     """(ensemble, bin_edges, init_margin) — the same triple the local GBT
     model consumes, fitted with rows sharded over ``mesh``."""
-    from spark_rapids_ml_tpu.models.gbt import boosting_loop
+    from spark_rapids_ml_tpu.models.gbt import boosting_loop, gbt_init_margin
 
     n_dev = int(np.prod(mesh.devices.shape))
     x = np.asarray(x)
@@ -80,8 +80,6 @@ def distributed_gbt_fit(
     n, d = x.shape
     if y.shape[0] != n:
         raise ValueError(f"labels length {y.shape[0]} != rows {n}")
-    if classification and not np.isin(y, (0.0, 1.0)).all():
-        raise ValueError("classification requires 0/1 labels")
     binned_np, edges = quantile_bins(x, n_bins)
     binned_p, mask = pad_rows_to_multiple(binned_np, n_dev)
     y_p = np.zeros(binned_p.shape[0])
@@ -95,11 +93,7 @@ def distributed_gbt_fit(
     )
     full_mask = jnp.asarray(np.ones((max_depth, d)), dtype=dtype)
 
-    if classification:
-        p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
-        init = float(np.log(p0 / (1.0 - p0)))
-    else:
-        init = float(y.mean())
+    init = gbt_init_margin(y, classification)
 
     def grow_fn(r, w):
         ft, tt, leaf, leaf_ids_dev = _sharded_grow_with_leaf_ids(

@@ -23,6 +23,7 @@ _PYSPARK_CLASSES = (
     "LogisticRegressionModel",
     "KMeans",
     "KMeansModel",
+    "NaiveBayes",
 )
 
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
@@ -36,7 +37,6 @@ _ADAPTER_CLASSES = (
     "GBTClassifierModel",
     "GBTRegressor",
     "GBTRegressorModel",
-    "NaiveBayes",
     "NaiveBayesModel",
     "LinearSVC",
     "LinearSVCModel",

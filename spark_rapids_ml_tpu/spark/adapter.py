@@ -43,7 +43,7 @@ __all__ = [
     "LinearSVC",
     "MaxAbsScaler",
     "MinMaxScaler",
-    "NaiveBayes",
+    "NaiveBayesModel",
     "NearestNeighbors",
     "OneVsRest",
     "UMAP",
@@ -290,8 +290,14 @@ GBTClassifier, GBTClassifierModel = _make_pair(
 GBTRegressor, GBTRegressorModel = _make_pair(
     "GBTRegressor", _LGBTR, _LGBTR_M, needs_label=True,
 )
-NaiveBayes, NaiveBayesModel = _make_pair(
-    "NaiveBayes", _LNB, _LNB_M, needs_label=True,
+# NaiveBayes model wrapper only: the ESTIMATOR lives in
+# spark/estimator.py as a mapInArrow statistics plane (per-class
+# count/sum/sq partials), which supersedes the driver-collect strategy
+NaiveBayesModel = type(
+    "NaiveBayesModel",
+    (_AdapterModel,),
+    {"_local_model_cls": _LNB_M,
+     "__doc__": "DataFrame front-end over models.NaiveBayesModel."},
 )
 LinearSVC, LinearSVCModel = _make_pair(
     "LinearSVC", _LSVC, _LSVC_M, needs_label=True,

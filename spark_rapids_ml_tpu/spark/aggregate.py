@@ -413,6 +413,140 @@ def combine_kmeans_stats(rows: Iterable, k: int, n: int):
     return sums, counts, cost, seen
 
 
+def partition_nb_stats(
+    batches: Iterable, features_col: str, label_col: str, model_type: str
+) -> Iterator[Dict[str, object]]:
+    """One partition's per-class NaiveBayes statistics.
+
+    Emits the label values this partition saw with their (count, Σx, Σx²)
+    rows — additively combinable on the driver even when partitions see
+    different class subsets. Input validation (multinomial non-negative,
+    bernoulli {0,1}) runs here, where the rows are."""
+    sums: Dict[float, np.ndarray] = {}
+    sqs: Dict[float, np.ndarray] = {}
+    counts: Dict[float, int] = {}
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+            y = np.asarray(batch.column(label_col).to_pylist(),
+                           dtype=np.float64)
+        else:
+            x, y = batch
+            x = np.asarray(x, dtype=np.float64)
+            y = np.asarray(y, dtype=np.float64).reshape(-1)
+        if x.shape[0] == 0:
+            continue
+        if model_type == "multinomial" and (x < 0).any():
+            raise ValueError(
+                "multinomial NaiveBayes requires non-negative features"
+            )
+        if model_type == "bernoulli" and not np.isin(x, (0.0, 1.0)).all():
+            raise ValueError(
+                "bernoulli NaiveBayes requires {0,1} features"
+            )
+        for cls in np.unique(y):
+            rows_c = x[y == cls]
+            key = float(cls)
+            if key not in sums:
+                sums[key] = np.zeros(x.shape[1])
+                sqs[key] = np.zeros(x.shape[1])
+                counts[key] = 0
+            sums[key] += rows_c.sum(axis=0)
+            sqs[key] += (rows_c * rows_c).sum(axis=0)
+            counts[key] += rows_c.shape[0]
+    if not counts:
+        return
+    labels = sorted(counts)
+    yield {
+        "labels": labels,
+        "counts": [counts[c] for c in labels],
+        "sums": np.concatenate([sums[c] for c in labels]).tolist(),
+        "sq": np.concatenate([sqs[c] for c in labels]).tolist(),
+    }
+
+
+def nb_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema(
+        [
+            ("labels", pa.list_(pa.float64())),
+            ("counts", pa.list_(pa.int64())),
+            ("sums", pa.list_(pa.float64())),
+            ("sq", pa.list_(pa.float64())),
+        ]
+    )
+
+
+def nb_stats_spark_ddl() -> str:
+    return ("labels array<double>, counts array<bigint>, "
+            "sums array<double>, sq array<double>")
+
+
+def combine_nb_stats(rows: Iterable):
+    """Driver-side union+sum of per-partition per-class statistics →
+    (classes, counts (K,), sums (K,d), sq (K,d))."""
+    acc: Dict[float, list] = {}
+    d = None
+    for row in rows:
+        get = row.get if isinstance(row, dict) else row.__getitem__
+        labels = list(get("labels"))
+        counts = list(get("counts"))
+        sums = np.asarray(get("sums"), dtype=np.float64)
+        sq = np.asarray(get("sq"), dtype=np.float64)
+        d = sums.shape[0] // len(labels)
+        sums = sums.reshape(len(labels), d)
+        sq = sq.reshape(len(labels), d)
+        for i, cls in enumerate(labels):
+            if cls not in acc:
+                acc[cls] = [0, np.zeros(d), np.zeros(d)]
+            acc[cls][0] += int(counts[i])
+            acc[cls][1] += sums[i]
+            acc[cls][2] += sq[i]
+    if not acc:
+        raise ValueError("no partition statistics to combine (empty dataset)")
+    classes = np.asarray(sorted(acc), dtype=np.float64)
+    counts = np.asarray([acc[c][0] for c in classes], dtype=np.float64)
+    sums = np.stack([acc[c][1] for c in classes])
+    sq = np.stack([acc[c][2] for c in classes])
+    return classes, counts, sums, sq
+
+
+def finalize_nb_from_stats(
+    classes: np.ndarray,
+    counts: np.ndarray,
+    sums: np.ndarray,
+    sq: np.ndarray,
+    model_type: str,
+    smoothing: float,
+):
+    """(pi, theta, sigma) from combined class statistics — the same math
+    as the local ``models.naive_bayes`` fit, with the gaussian variance
+    floor derived from the GLOBAL per-feature variance (itself exactly
+    recoverable from the class sums)."""
+    lam = float(smoothing)
+    pi = np.log(counts / counts.sum())
+    if model_type == "multinomial":
+        theta = np.log(
+            (sums + lam)
+            / (sums.sum(axis=1, keepdims=True) + lam * sums.shape[1])
+        )
+        return pi, theta, None
+    if model_type == "bernoulli":
+        theta = np.log((sums + lam) / (counts[:, None] + 2.0 * lam))
+        return pi, theta, None
+    n = counts.sum()
+    mean = sums / counts[:, None]
+    var = sq / counts[:, None] - mean * mean
+    # clamp at 0: the E[x²]−E[x]² form can cancel to a tiny negative,
+    # unlike the local fit's x.var() which is non-negative by construction
+    global_var = np.maximum(
+        sq.sum(axis=0) / n - (sums.sum(axis=0) / n) ** 2, 0.0
+    )
+    var = np.maximum(var, 1e-9 * float(global_var.max() or 1.0))
+    return pi, mean, var
+
+
 def combine_stats(
     rows: Iterable,
 ) -> Tuple[np.ndarray, np.ndarray, int]:

@@ -859,3 +859,90 @@ def _apply_param_map(obj, param_map):
 # type(estimator).__module__ resolution in save_params sees the proxy class;
 # keep the Spark class alias mapping working by naming it after PCA.
 _LocalParamsProxy.__qualname__ = "PCA"
+
+
+class NaiveBayes(Estimator, Params):
+    """NaiveBayes over a Spark DataFrame as ONE ``mapInArrow`` statistics
+    pass: partitions emit per-class (count, Σx, Σx²) rows — additively
+    combinable even when partitions see different class subsets — and the
+    driver finalizes the (K, d) log-probability tables. Replaces the
+    driver-collect adapter strategy with the same partial-aggregate data
+    plane the PCA/regression fits use. ``modelType``:
+    multinomial | bernoulli | gaussian (Spark's families + sklearn's
+    GaussianNB)."""
+
+    featuresCol = Param(Params._dummy(), "featuresCol", "features column",
+                        typeConverter=TypeConverters.toString)
+    labelCol = Param(Params._dummy(), "labelCol", "label column",
+                     typeConverter=TypeConverters.toString)
+    predictionCol = Param(Params._dummy(), "predictionCol",
+                          "prediction output column",
+                          typeConverter=TypeConverters.toString)
+    modelType = Param(Params._dummy(), "modelType",
+                      "multinomial | bernoulli | gaussian",
+                      typeConverter=TypeConverters.toString)
+    smoothing = Param(Params._dummy(), "smoothing",
+                      "additive (Laplace) smoothing",
+                      typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, featuresCol="features", labelCol="label",
+                 predictionCol="prediction", modelType="multinomial",
+                 smoothing=1.0):
+        super().__init__()
+        self._setDefault(featuresCol="features", labelCol="label",
+                         predictionCol="prediction",
+                         modelType="multinomial", smoothing=1.0)
+        self._set(**{k_: v for k_, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def setModelType(self, value):
+        return self._set(modelType=value)
+
+    def setSmoothing(self, value):
+        return self._set(smoothing=value)
+
+    def _fit(self, dataset):
+        from spark_rapids_ml_tpu.models.naive_bayes import (
+            NaiveBayesModel as LocalNBModel,
+        )
+        from spark_rapids_ml_tpu.spark.adapter import (
+            NaiveBayesModel as AdapterNBModel,
+        )
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            combine_nb_stats,
+            finalize_nb_from_stats,
+            nb_stats_arrow_schema,
+            nb_stats_spark_ddl,
+            partition_nb_stats,
+        )
+
+        fcol = self.getOrDefault(self.featuresCol)
+        lcol = self.getOrDefault(self.labelCol)
+        kind = self.getOrDefault(self.modelType)
+        if kind not in ("multinomial", "bernoulli", "gaussian"):
+            raise ValueError(f"modelType {kind!r}")
+        df = dataset.select(fcol, lcol)
+
+        def stats(batches):
+            import pyarrow as pa
+
+            for row in partition_nb_stats(batches, fcol, lcol, kind):
+                yield pa.RecordBatch.from_pylist(
+                    [row], schema=nb_stats_arrow_schema()
+                )
+
+        rows = df.mapInArrow(stats, nb_stats_spark_ddl()).collect()
+        classes, counts, sums, sq = combine_nb_stats(rows)
+        pi, theta, sigma = finalize_nb_from_stats(
+            classes, counts, sums, sq, kind,
+            self.getOrDefault(self.smoothing),
+        )
+        local = LocalNBModel(pi=pi, theta=theta, sigma=sigma,
+                             classes=classes)
+        local.set("inputCol", fcol)
+        local.set("labelCol", lcol)
+        local.set("predictionCol", self.getOrDefault(self.predictionCol))
+        local.set("modelType", kind)
+        local.set("smoothing", float(self.getOrDefault(self.smoothing)))
+        return AdapterNBModel(local)

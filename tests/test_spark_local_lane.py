@@ -290,3 +290,35 @@ def test_kmeans_executor_device_matches_host_plane(rng):
     c_on = np.sort(np.asarray(on.clusterCenters()), axis=0)
     c_off = np.sort(np.asarray(off.clusterCenters()), axis=0)
     np.testing.assert_allclose(c_on, c_off, atol=1e-4)
+
+
+def test_naive_bayes_statistics_plane(spark, rng):
+    """NaiveBayes rides the mapInArrow statistics plane (per-class
+    count/sum/sq rows combined on the driver), matching the local fit
+    exactly — including partitions that see different class subsets."""
+    from spark_rapids_ml_tpu import NaiveBayes as LocalNB
+    from spark_rapids_ml_tpu.spark import NaiveBayes
+
+    x = np.abs(rng.normal(size=(240, 5)))
+    y = np.sort(rng.integers(0, 3, size=240).astype(float))  # skewed parts
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    for kind in ("multinomial", "gaussian"):
+        model = NaiveBayes(modelType=kind).fit(df)
+        local = LocalNB().setModelType(kind).fit(x, labels=y)
+        np.testing.assert_allclose(model._local.pi, local.pi, atol=1e-12)
+        np.testing.assert_allclose(model._local.theta, local.theta,
+                                   atol=1e-12)
+        pred = np.asarray([r["prediction"]
+                           for r in model.transform(df).collect()])
+        local_pred = np.asarray(local.transform(x).column("prediction"))
+        np.testing.assert_array_equal(pred, local_pred)
+
+
+def test_naive_bayes_plane_validation(spark, rng):
+    from spark_rapids_ml_tpu.spark import NaiveBayes
+
+    x = rng.normal(size=(40, 3))  # has negatives
+    y = rng.integers(0, 2, 40).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    with pytest.raises(ValueError, match="non-negative"):
+        NaiveBayes(modelType="multinomial").fit(df)
