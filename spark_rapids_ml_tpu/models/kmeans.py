@@ -34,6 +34,15 @@ class KMeansParams(HasInputCol, HasDeviceId):
                 validator=lambda v: v >= 0)
     seed = Param("seed", "random seed for k-means++ init", 0,
                  validator=lambda v: isinstance(v, int))
+    weightCol = Param(
+        "weightCol",
+        "per-row sample-weight column ('' = unweighted): weighted Lloyd "
+        "updates/cost and D^2*w k-means++ sampling (Spark 3.0 weightCol "
+        "semantics). In-memory fits only; streamed inputs with weights "
+        "are not supported yet.",
+        "",
+        validator=lambda v: isinstance(v, str),
+    )
     predictionCol = Param("predictionCol", "output cluster-id column",
                           "prediction")
     useXlaDot = Param(
@@ -68,16 +77,28 @@ class KMeans(KMeansParams):
         from spark_rapids_ml_tpu.data.batches import streaming_source
 
         source = streaming_source(dataset, 0)
+        weights = None
+        if source is not None and self.getWeightCol():
+            raise ValueError(
+                "weightCol is not supported with streamed/out-of-core "
+                "input yet; fit in-memory or drop the weights"
+            )
         if source is None:
             frame = as_vector_frame(dataset, self.getInputCol())
             with timer.phase("densify"):
                 x = frame.vectors_as_matrix(self.getInputCol())
+            from spark_rapids_ml_tpu.models.linear_regression import (
+                _extract_weights,
+            )
+
+            weights = _extract_weights(self, frame, x.shape[0])
             from spark_rapids_ml_tpu.data.batches import (
                 BatchSource,
                 stream_threshold_bytes,
             )
 
-            if self.getUseXlaDot() and x.nbytes > stream_threshold_bytes():
+            if (self.getUseXlaDot() and weights is None
+                    and x.nbytes > stream_threshold_bytes()):
                 source = BatchSource(x)
 
         if source is not None:
@@ -94,9 +115,9 @@ class KMeans(KMeansParams):
                     f"k = {k} must be at most the number of rows {x.shape[0]}"
                 )
             if self.getUseXlaDot():
-                centers, cost, n_iter = self._fit_xla(x, k, timer)
+                centers, cost, n_iter = self._fit_xla(x, k, timer, weights)
             else:
-                centers, cost, n_iter = self._fit_host(x, k, timer)
+                centers, cost, n_iter = self._fit_host(x, k, timer, weights)
         model = KMeansModel(cluster_centers=np.asarray(centers, dtype=np.float64))
         model.uid = self.uid
         model.copy_values_from(self)
@@ -105,7 +126,7 @@ class KMeans(KMeansParams):
         model.fit_timings_ = timer.as_dict()
         return model
 
-    def _fit_xla(self, x, k, timer):
+    def _fit_xla(self, x, k, timer, weights=None):
         import jax
         import jax.numpy as jnp
 
@@ -118,12 +139,21 @@ class KMeans(KMeansParams):
         dtype = _resolve_dtype(self.getDtype())
         with timer.phase("h2d"):
             x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+            # the kernels' mask slot multiplies the D^2 sampling logits,
+            # the one-hot cluster statistics, and the cost — passing the
+            # weights through it IS weighted k-means
+            w_dev = (
+                None
+                if weights is None
+                else jax.device_put(jnp.asarray(weights, dtype=dtype), device)
+            )
         key = jax.random.PRNGKey(self.getSeed())
         with timer.phase("fit_kernel"), TraceRange("kmeans lloyd", TraceColor.GREEN):
-            init = kmeans_plus_plus_init(x_dev, k, key)
+            init = kmeans_plus_plus_init(x_dev, k, key, mask=w_dev)
             result = jax.block_until_ready(
                 kmeans_fit_kernel(
-                    x_dev, init, max_iter=self.getMaxIter(), tol=self.getTol()
+                    x_dev, init, mask=w_dev,
+                    max_iter=self.getMaxIter(), tol=self.getTol()
                 )
             )
         return result.centers, result.cost, result.n_iter
@@ -230,25 +260,29 @@ class KMeans(KMeansParams):
             _, _, cost = pass_stats(centers)
         return centers, cost, n_iter
 
-    def _fit_host(self, x, k, timer):
+    def _fit_host(self, x, k, timer, weights=None):
         """NumPy Lloyd with the same init/update/empty-cluster semantics."""
         rng = np.random.default_rng(self.getSeed())
+        w = np.ones(x.shape[0]) if weights is None else weights
         with timer.phase("fit_kernel"), TraceRange("kmeans host", TraceColor.ORANGE):
-            centers = _host_kmeans_pp(x, k, rng)
+            centers = _host_kmeans_pp(x, k, rng, weights=weights)
             n_iter = 0
             for n_iter in range(1, self.getMaxIter() + 1):
                 d = _sqdist(x, centers)
                 labels = d.argmin(axis=1)
                 new_centers = centers.copy()
                 for j in range(k):
-                    pts = x[labels == j]
-                    if len(pts):
-                        new_centers[j] = pts.mean(axis=0)
+                    sel = labels == j
+                    wj = w[sel]
+                    if wj.sum() > 0:
+                        new_centers[j] = (
+                            (x[sel] * wj[:, None]).sum(axis=0) / wj.sum()
+                        )
                 moved = np.sqrt(((new_centers - centers) ** 2).sum(axis=1).max())
                 centers = new_centers
                 if moved <= self.getTol():
                     break
-            cost = _sqdist(x, centers).min(axis=1).sum()
+            cost = (_sqdist(x, centers).min(axis=1) * w).sum()
         return centers, cost, n_iter
 
 
@@ -291,14 +325,21 @@ def _reservoir_sample(source, size: int, rng) -> np.ndarray:
     return reservoir[:filled] if filled < size else reservoir
 
 
-def _host_kmeans_pp(x, k, rng):
+def _host_kmeans_pp(x, k, rng, weights=None):
     centers = np.empty((k, x.shape[1]), dtype=np.float64)
-    centers[0] = x[rng.integers(len(x))]
-    min_d = ((x - centers[0]) ** 2).sum(axis=1)
+    if weights is None:
+        centers[0] = x[rng.integers(len(x))]
+    else:
+        pw = weights / weights.sum()
+        centers[0] = x[rng.choice(len(x), p=pw)]
+    w = np.ones(len(x)) if weights is None else weights
+    min_d = ((x - centers[0]) ** 2).sum(axis=1) * w
     for i in range(1, k):
-        p = min_d / min_d.sum() if min_d.sum() > 0 else None
+        p = min_d / min_d.sum() if min_d.sum() > 0 else (
+            w / w.sum() if weights is not None else None
+        )
         centers[i] = x[rng.choice(len(x), p=p)]
-        min_d = np.minimum(min_d, ((x - centers[i]) ** 2).sum(axis=1))
+        min_d = np.minimum(min_d, ((x - centers[i]) ** 2).sum(axis=1) * w)
     return centers
 
 

@@ -138,25 +138,17 @@ class NaiveBayes(NaiveBayesParams):
                 x, y_oh, self.getUseXlaDot(), device, dtype,
                 need_sq=(kind == "gaussian"),
             )
-            pi = np.log(counts / counts.sum())
-            if kind == "multinomial":
-                theta = np.log(
-                    (sums + lam)
-                    / (sums.sum(axis=1, keepdims=True) + lam * x.shape[1])
-                )
-                sigma = None
-            elif kind == "bernoulli":
-                theta = np.log(
-                    (sums + lam) / (counts[:, None] + 2.0 * lam)
-                )
-                sigma = None
-            else:  # gaussian
-                mean = sums / counts[:, None]
-                var = sq / counts[:, None] - mean * mean
-                # sklearn's var_smoothing-style epsilon floor
-                var = np.maximum(var, 1e-9 * float(x.var(axis=0).max() or 1.0))
-                theta = mean
-                sigma = var
+            # the per-family closed forms live ONCE, shared with the Spark
+            # statistics plane — the two fits cannot drift
+            from spark_rapids_ml_tpu.spark.aggregate import (
+                finalize_nb_from_stats,
+            )
+
+            if kind != "gaussian":
+                sq = np.zeros_like(sums)
+            pi, theta, sigma = finalize_nb_from_stats(
+                classes, counts, sums, sq, kind, lam
+            )
         model = NaiveBayesModel(
             pi=pi, theta=theta, sigma=sigma, classes=classes
         )

@@ -59,8 +59,12 @@ def kmeans_plus_plus_init(
     valid = jnp.ones(m, dtype=x.dtype) if mask is None else mask.astype(x.dtype)
     neg_inf = jnp.asarray(-jnp.inf, dtype=x.dtype)
     key, sub = jax.random.split(key)
+    # first center sampled proportionally to the mask value: uniform when
+    # the mask is 0/1 validity, and w-proportional when it carries
+    # weightCol (the weighted k-means++ first-draw rule)
     first = jax.random.categorical(
-        sub, jnp.where(valid > 0, 0.0, neg_inf)
+        sub, jnp.where(valid > 0, jnp.log(jnp.maximum(valid, 1e-30)),
+                       neg_inf)
     )
     centers0 = jnp.zeros((n_clusters, n), dtype=x.dtype).at[0].set(x[first])
     min_d0 = jnp.sum((x - x[first][None, :]) ** 2, axis=1) * valid
@@ -123,9 +127,12 @@ def lloyd_iterations(
     def step(state):
         centers, _, it, _ = state
         sums, counts, cost = reduce_fn(_cluster_stats(x, centers, valid))
-        # empty cluster: keep its previous center (Spark behavior)
-        safe = jnp.maximum(counts, 1.0)[:, None]
-        new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+        # empty cluster: keep its previous center (Spark behavior). Divide
+        # by the ACTUAL weight mass, not max(counts, 1): with weightCol
+        # routed through the mask slot, a cluster's total weight can be a
+        # fraction below 1 and flooring it would shrink the center
+        denom = jnp.where(counts > 0, counts, 1.0)[:, None]
+        new_centers = jnp.where(counts[:, None] > 0, sums / denom, centers)
         shift2 = jnp.sum((new_centers - centers) ** 2, axis=1)
         moved = jnp.sqrt(jnp.max(shift2))
         return new_centers, cost, it + 1, moved <= tol

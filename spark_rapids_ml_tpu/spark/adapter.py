@@ -253,7 +253,6 @@ from spark_rapids_ml_tpu.models.linear_svc import (  # noqa: E402
     LinearSVCModel as _LSVC_M,
 )
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: E402
-    NaiveBayes as _LNB,
     NaiveBayesModel as _LNB_M,
 )
 from spark_rapids_ml_tpu.models.feature_scalers import (  # noqa: E402

@@ -902,6 +902,24 @@ class NaiveBayes(Estimator, Params):
     def setSmoothing(self, value):
         return self._set(smoothing=value)
 
+    def save(self, path: str) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        # dedicated proxy subclass so the metadata carries THIS class name
+        proxy_cls = type("NaiveBayes", (_LocalParamsProxy,), {})
+        save_params(proxy_cls(self), path)
+
+    @staticmethod
+    def load(path: str) -> "NaiveBayes":
+        from spark_rapids_ml_tpu.io.persistence import _read_metadata
+
+        meta = _read_metadata(path)
+        est = NaiveBayes()
+        est._resetUid(meta["uid"])
+        _apply_param_map(est, meta.get("paramMap", {}))
+        _apply_param_map(est, meta.get("tpuParamMap", {}))
+        return est
+
     def _fit(self, dataset):
         from spark_rapids_ml_tpu.models.naive_bayes import (
             NaiveBayesModel as LocalNBModel,

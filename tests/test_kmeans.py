@@ -138,3 +138,71 @@ def test_distributed_kmeans_adversarially_skewed_shards(rng):
         assert np.min(np.linalg.norm(found - c, axis=1)) < 1.0, (
             f"cluster at {c} not recovered; centers:\n{found}"
         )
+
+
+@pytest.mark.parametrize("use_xla", [True, False])
+def test_kmeans_weighted_fixed_point_and_cost(rng, use_xla):
+    """weightCol semantics: converged centers are the WEIGHTED means of
+    their assigned rows, and training cost is the weighted distortion."""
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    centers = np.array([[0.0, 8.0], [8.0, 0.0]])
+    x = np.concatenate(
+        [c + 0.4 * rng.normal(size=(80, 2)) for c in centers]
+    )
+    w = rng.uniform(0.5, 3.0, size=len(x))
+    frame = as_vector_frame(x, "features").with_column("w", w.tolist())
+    model = (
+        KMeans().setK(2).setSeed(3).setWeightCol("w").setMaxIter(50)
+        .setUseXlaDot(use_xla).fit(frame)
+    )
+    got = np.asarray(model.cluster_centers)
+    d = ((x[:, None, :] - got[None, :, :]) ** 2).sum(-1)
+    labels = d.argmin(axis=1)
+    for j in range(2):
+        sel = labels == j
+        expect = (x[sel] * w[sel, None]).sum(0) / w[sel].sum()
+        np.testing.assert_allclose(got[j], expect, atol=1e-4)
+    np.testing.assert_allclose(
+        model.training_cost_, (d.min(axis=1) * w).sum(), rtol=1e-4
+    )
+
+
+def test_kmeans_zero_weight_rows_cannot_seed_or_pull(rng):
+    x = np.concatenate([
+        0.3 * rng.normal(size=(60, 2)),            # real cluster at origin
+        np.array([[50.0, 50.0]] * 5),              # zero-weight outliers
+    ])
+    w = np.concatenate([np.ones(60), np.zeros(5)])
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    frame = as_vector_frame(x, "features").with_column("w", w.tolist())
+    model = KMeans().setK(2).setSeed(1).setWeightCol("w").fit(frame)
+    got = np.asarray(model.cluster_centers)
+    # no center may sit at the zero-weight outlier location
+    assert np.linalg.norm(got - np.array([50.0, 50.0]), axis=1).min() > 10
+
+
+def test_kmeans_weighted_streamed_rejected(rng):
+    x = rng.normal(size=(50, 3))
+    est = KMeans().setK(2).setWeightCol("w")
+    with pytest.raises(ValueError, match="weightCol"):
+        est.fit(lambda: (x[i:i + 10] for i in range(0, 50, 10)))
+
+
+def test_kmeans_weighted_tiny_normalized_weights(rng):
+    """Sub-unit total cluster weights must still normalize centers by the
+    ACTUAL weight mass (a max(counts, 1) floor would shrink every center
+    toward the origin)."""
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    centers = np.array([[0.0, 10.0], [10.0, 0.0]])
+    x = np.concatenate(
+        [c + 0.3 * rng.normal(size=(40, 2)) for c in centers]
+    )
+    w = np.full(len(x), 1.0 / len(x))   # every cluster's mass << 1
+    frame = as_vector_frame(x, "features").with_column("w", w.tolist())
+    model = KMeans().setK(2).setSeed(5).setWeightCol("w").fit(frame)
+    got = np.sort(np.asarray(model.cluster_centers), axis=0)
+    expect = np.sort(centers, axis=0)
+    np.testing.assert_allclose(got, expect, atol=0.5)

@@ -322,3 +322,14 @@ def test_naive_bayes_plane_validation(spark, rng):
     df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
     with pytest.raises(ValueError, match="non-negative"):
         NaiveBayes(modelType="multinomial").fit(df)
+
+
+def test_naive_bayes_estimator_persistence(tmp_path):
+    from spark_rapids_ml_tpu.spark import NaiveBayes
+
+    est = NaiveBayes(modelType="gaussian", smoothing=0.5)
+    path = str(tmp_path / "nb_est")
+    est.save(path)
+    loaded = NaiveBayes.load(path)
+    assert loaded.getOrDefault(loaded.modelType) == "gaussian"
+    assert loaded.getOrDefault(loaded.smoothing) == 0.5
