@@ -65,8 +65,9 @@ _SPARK_CLASS_ALIASES = {
 _SPARK_PARAM_ALLOWLIST = {
     "PCA": {"k", "inputCol", "outputCol"},
     "PCAModel": {"k", "inputCol", "outputCol"},
-    "KMeans": {"k", "maxIter", "tol", "seed", "predictionCol"},
-    "KMeansModel": {"k", "maxIter", "tol", "seed", "predictionCol"},
+    "KMeans": {"k", "maxIter", "tol", "seed", "predictionCol", "weightCol"},
+    "KMeansModel": {"k", "maxIter", "tol", "seed", "predictionCol",
+                    "weightCol"},
     "LinearRegression": {"labelCol", "predictionCol", "fitIntercept",
                          "regParam", "elasticNetParam", "weightCol"},
     "LinearRegressionModel": {"labelCol", "predictionCol", "fitIntercept",

@@ -18,6 +18,7 @@ from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
     HasInputCol,
+    HasWeightCol,
     Param,
 )
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
@@ -25,7 +26,7 @@ from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class KMeansParams(HasInputCol, HasDeviceId):
+class KMeansParams(HasInputCol, HasDeviceId, HasWeightCol):
     k = Param("k", "number of clusters", 2,
               validator=lambda v: isinstance(v, int) and v >= 1)
     maxIter = Param("maxIter", "maximum Lloyd iterations", 20,
@@ -34,15 +35,8 @@ class KMeansParams(HasInputCol, HasDeviceId):
                 validator=lambda v: v >= 0)
     seed = Param("seed", "random seed for k-means++ init", 0,
                  validator=lambda v: isinstance(v, int))
-    weightCol = Param(
-        "weightCol",
-        "per-row sample-weight column ('' = unweighted): weighted Lloyd "
-        "updates/cost and D^2*w k-means++ sampling (Spark 3.0 weightCol "
-        "semantics). In-memory fits only; streamed inputs with weights "
-        "are not supported yet.",
-        "",
-        validator=lambda v: isinstance(v, str),
-    )
+    # weightCol (HasWeightCol): weighted Lloyd updates/cost and D^2*w
+    # k-means++ sampling — Spark 3.0 weightCol semantics
     predictionCol = Param("predictionCol", "output cluster-id column",
                           "prediction")
     useXlaDot = Param(
@@ -78,20 +72,13 @@ class KMeans(KMeansParams):
 
         source = streaming_source(dataset, 0)
         weights = None
-        if source is not None and self.getWeightCol():
-            raise ValueError(
-                "weightCol is not supported with streamed/out-of-core "
-                "input yet; fit in-memory or drop the weights"
-            )
+        if source is not None:
+            self._reject_streamed_weights()
         if source is None:
             frame = as_vector_frame(dataset, self.getInputCol())
             with timer.phase("densify"):
                 x = frame.vectors_as_matrix(self.getInputCol())
-            from spark_rapids_ml_tpu.models.linear_regression import (
-                _extract_weights,
-            )
-
-            weights = _extract_weights(self, frame, x.shape[0])
+            weights = self._extract_weights(frame, x.shape[0])
             from spark_rapids_ml_tpu.data.batches import (
                 BatchSource,
                 stream_threshold_bytes,

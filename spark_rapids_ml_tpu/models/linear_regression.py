@@ -16,13 +16,18 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
-from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasWeightCol,
+    Param,
+)
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class LinearRegressionParams(HasInputCol, HasDeviceId):
+class LinearRegressionParams(HasInputCol, HasDeviceId, HasWeightCol):
     labelCol = Param("labelCol", "label column name", "label")
     elasticNetParam = Param(
         "elasticNetParam",
@@ -32,14 +37,6 @@ class LinearRegressionParams(HasInputCol, HasDeviceId):
         "intercept unpenalized, matching Spark/sklearn conventions)",
         0.0,
         validator=lambda v: 0.0 <= float(v) <= 1.0,
-    )
-    weightCol = Param(
-        "weightCol",
-        "per-row sample-weight column ('' = unweighted). Supported on "
-        "in-memory fits; streamed/out-of-core inputs with weights are "
-        "not supported yet.",
-        "",
-        validator=lambda v: isinstance(v, str),
     )
     predictionCol = Param("predictionCol", "prediction output column",
                           "prediction")
@@ -98,7 +95,7 @@ class LinearRegression(LinearRegressionParams):
                 raise ValueError(
                     f"labels length {y.shape[0]} != rows {x.shape[0]}"
                 )
-            weights = _extract_weights(self, frame, x.shape[0])
+            weights = self._extract_weights(frame, x.shape[0])
             from spark_rapids_ml_tpu.data.batches import stream_threshold_bytes
 
             if (
@@ -297,16 +294,8 @@ def _centered_moments(gxx, gxy, x_sum, y_sum, cnt, fit_intercept):
 
 
 def _extract_weights(est, frame, n_rows):
-    """weightCol → validated float64 vector (None when unset)."""
-    col = est.getWeightCol()
-    if not col:
-        return None
-    w = np.asarray(frame.column(col), dtype=np.float64).reshape(-1)
-    if w.shape[0] != n_rows:
-        raise ValueError(f"weight column length {w.shape[0]} != rows {n_rows}")
-    if not np.isfinite(w).all() or (w < 0).any():
-        raise ValueError("weights must be finite and non-negative")
-    return w
+    """Back-compat alias: the validation lives on ``HasWeightCol``."""
+    return est._extract_weights(frame, n_rows)
 
 
 def _zip_xy(chunk) -> np.ndarray:

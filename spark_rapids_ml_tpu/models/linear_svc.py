@@ -33,22 +33,19 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
-from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasWeightCol,
+    Param,
+)
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class LinearSVCParams(HasInputCol, HasDeviceId):
+class LinearSVCParams(HasInputCol, HasDeviceId, HasWeightCol):
     labelCol = Param("labelCol", "label column name (binary 0/1)", "label")
-    weightCol = Param(
-        "weightCol",
-        "per-row sample-weight column ('' = unweighted). Supported on "
-        "in-memory fits; streamed/out-of-core inputs with weights are "
-        "not supported yet.",
-        "",
-        validator=lambda v: isinstance(v, str),
-    )
     predictionCol = Param("predictionCol", "predicted class column",
                           "prediction")
     rawPredictionCol = Param("rawPredictionCol",
@@ -100,7 +97,6 @@ class LinearSVC(LinearSVCParams):
     def fit(self, dataset, labels=None) -> "LinearSVCModel":
         timer = PhaseTimer()
         from spark_rapids_ml_tpu.models.linear_regression import (
-            _extract_weights,
             _streaming_xy_source,
         )
         from spark_rapids_ml_tpu.models.logistic_regression import (
@@ -109,11 +105,7 @@ class LinearSVC(LinearSVCParams):
 
         source = _streaming_xy_source(dataset, labels)
         if source is not None:
-            if self.getWeightCol():
-                raise ValueError(
-                    "weightCol is not supported with streamed/out-of-core "
-                    "input yet; fit in-memory or drop the weights"
-                )
+            self._reject_streamed_weights()
             if self.getStandardization():
                 raise ValueError(
                     "standardization=True needs column stds up front; "
@@ -136,7 +128,7 @@ class LinearSVC(LinearSVCParams):
             if not np.isfinite(y).all():
                 raise ValueError("labels must be finite")
             _check_binary(y, estimator="LinearSVC")
-            weights = _extract_weights(self, frame, x.shape[0])
+            weights = self._extract_weights(frame, x.shape[0])
             scale = None
             if self.getStandardization():
                 # weighted sample std with the frequency-weight (Σw − 1)

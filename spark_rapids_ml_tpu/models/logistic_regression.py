@@ -17,22 +17,19 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
-from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasWeightCol,
+    Param,
+)
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class LogisticRegressionParams(HasInputCol, HasDeviceId):
+class LogisticRegressionParams(HasInputCol, HasDeviceId, HasWeightCol):
     labelCol = Param("labelCol", "label column name (binary 0/1)", "label")
-    weightCol = Param(
-        "weightCol",
-        "per-row sample-weight column ('' = unweighted). Supported on "
-        "in-memory fits; streamed/out-of-core inputs with weights are "
-        "not supported yet.",
-        "",
-        validator=lambda v: isinstance(v, str),
-    )
     predictionCol = Param("predictionCol", "predicted class column",
                           "prediction")
     probabilityCol = Param("probabilityCol", "P(y=1) output column",
@@ -78,12 +75,27 @@ class LogisticRegression(LogisticRegressionParams):
 
         source = _streaming_xy_source(dataset, labels)
         if source is not None:
-            if self.getWeightCol():
-                raise ValueError(
-                    "weightCol is not supported with streamed/out-of-core "
-                    "input yet; fit in-memory or drop the weights"
+            self._reject_streamed_weights()
+            # optimistic binary first — the common case pays no extra
+            # pass; Spark's family="auto" kicks in when iteration 1's
+            # label validation sees more than two classes
+            try:
+                coef, intercept, n_iter = self._fit_streamed(source, timer)
+            except _NonBinaryLabelsError:
+                classes = _streamed_classes(source)
+                if classes.size <= 2:
+                    # two or fewer distinct values that are not {0,1}:
+                    # genuinely bad binary labels, not a multiclass target
+                    raise
+                if classes.size > 100:
+                    raise ValueError(
+                        f"{classes.size} distinct label values: looks like "
+                        "a continuous target, not classes (multinomial "
+                        "supports up to 100)"
+                    )
+                return self._fit_multinomial_streamed(
+                    source, classes, timer
                 )
-            coef, intercept, n_iter = self._fit_streamed(source, timer)
         else:
             frame = as_vector_frame(dataset, self.getInputCol())
             with timer.phase("densify"):
@@ -97,11 +109,7 @@ class LogisticRegression(LogisticRegressionParams):
                 raise ValueError(
                     f"labels length {y.shape[0]} != rows {x.shape[0]}"
                 )
-            from spark_rapids_ml_tpu.models.linear_regression import (
-                _extract_weights,
-            )
-
-            weights = _extract_weights(self, frame, x.shape[0])
+            weights = self._extract_weights(frame, x.shape[0])
             if not np.isfinite(y).all():
                 raise ValueError("labels must be finite")
             classes = np.unique(y)
@@ -188,6 +196,106 @@ class LogisticRegression(LogisticRegressionParams):
         model.uid = self.uid
         model.copy_values_from(self)
         model.n_iter_ = int(result.n_iter)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def _fit_multinomial_streamed(self, source, classes, timer):
+        """Softmax family out-of-core: one streamed raw-partials pass per
+        Newton iteration into a donated device accumulator
+        (``ops.logreg_kernel.update_multinomial_stats``); the K(d+1)
+        system assembles and solves on host per iteration, through the
+        same ``assemble_multinomial_system`` the in-memory kernel uses."""
+        if not source.reiterable:
+            raise ValueError(
+                "LogisticRegression streaming requires a re-iterable "
+                "source: Newton makes one pass per iteration"
+            )
+        if not self.getUseXlaDot():
+            raise ValueError(
+                "multinomial (>2 classes) LogisticRegression runs on the "
+                "XLA path only; set useXlaDot=True or use OneVsRest for a "
+                "host-only multiclass reduction"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.logreg_kernel import (
+            assemble_multinomial_system,
+            update_multinomial_stats,
+        )
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        n = source.n_features - 1
+        k = int(classes.size)
+        dim = n + 1
+        lam = float(self.getRegParam())
+        fit_b = self.getFitIntercept()
+        wb = np.zeros((k, dim))
+        n_iter = 0
+        eye_k = np.eye(k)
+        with timer.phase("fit_kernel"), TraceRange(
+            "logreg softmax streamed", TraceColor.GREEN
+        ):
+            for n_iter in range(1, self.getMaxIter() + 1):
+                carry = jax.device_put(
+                    (
+                        jnp.zeros((k, dim), dtype=dtype),
+                        jnp.zeros((k * dim, k * dim), dtype=dtype),
+                        jnp.zeros((), dtype=dtype),
+                    ),
+                    device,
+                )
+                wb_dev = jnp.asarray(wb, dtype=dtype)
+                for batch, mask in source.batches():
+                    zb = np.asarray(batch, dtype=np.float64)
+                    yb = zb[:, n]
+                    idx = np.searchsorted(classes, yb)
+                    if n_iter == 1:
+                        real = yb if mask is None else yb[np.asarray(mask)]
+                        ridx = np.searchsorted(classes, real)
+                        ok = (ridx < k) & (
+                            classes[np.minimum(ridx, k - 1)] == real
+                        )
+                        if not ok.all():
+                            raise ValueError(
+                                "streamed labels contain values outside "
+                                "the observed class set"
+                            )
+                    y_oh = eye_k[np.clip(idx, 0, k - 1)]
+                    carry = update_multinomial_stats(
+                        carry,
+                        jnp.asarray(zb[:, :n], dtype=dtype),
+                        jnp.asarray(y_oh, dtype=dtype),
+                        wb_dev,
+                        None if mask is None else jnp.asarray(mask),
+                    )
+                carry = jax.block_until_ready(carry)
+                gxa, h_raw, cnt = (
+                    np.asarray(v, dtype=np.float64) for v in carry
+                )
+                g, h = assemble_multinomial_system(
+                    jnp.asarray(gxa), jnp.asarray(h_raw),
+                    jnp.asarray(float(cnt)), jnp.asarray(wb),
+                    lam, fit_b,
+                )
+                step = np.linalg.solve(
+                    np.asarray(h, dtype=np.float64),
+                    np.asarray(g, dtype=np.float64).reshape(-1),
+                ).reshape(k, dim)
+                wb = wb - step
+                if np.max(np.abs(step)) <= float(self.getTol()):
+                    break
+        model = LogisticRegressionModel(
+            coefficient_matrix=wb[:, :n],
+            intercept_vector=(
+                wb[:, n] if fit_b else np.zeros(k)
+            ),
+            classes=classes.astype(np.float64),
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.n_iter_ = int(n_iter)
         model.fit_timings_ = timer.as_dict()
         return model
 
@@ -333,10 +441,33 @@ class LogisticRegression(LogisticRegressionParams):
         return w, b, n_iter
 
 
+def _streamed_classes(source) -> np.ndarray:
+    """One pass over a re-iterable [X | y] source collecting the distinct
+    label values (the streamed analogue of np.unique(y)); raises on
+    non-finite labels like the in-memory fit does."""
+    seen = set()
+    for batch, mask in source.batches():
+        yb = np.asarray(batch, dtype=np.float64)[:, -1]
+        if mask is not None:
+            yb = yb[np.asarray(mask)]
+        if not np.isfinite(yb).all():
+            raise ValueError("labels must be finite")
+        seen.update(np.unique(yb).tolist())
+        if len(seen) > 101:
+            break  # enough to trigger the continuous-target guard
+    return np.asarray(sorted(seen))
+
+
+class _NonBinaryLabelsError(ValueError):
+    """Raised by _check_binary — a subtype so the streamed fit can catch
+    it and re-dispatch to the multinomial family without string
+    matching."""
+
+
 def _check_binary(y: np.ndarray, estimator: str = "LogisticRegression") -> None:
     bad = ~np.isin(y, (0.0, 1.0))
     if bad.any():
-        raise ValueError(
+        raise _NonBinaryLabelsError(
             f"binary {estimator} requires 0/1 labels; found "
             f"{np.unique(y[bad])[:5]}"
         )

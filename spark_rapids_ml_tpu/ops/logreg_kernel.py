@@ -183,41 +183,55 @@ class MultinomialResult(NamedTuple):
     converged: jnp.ndarray
 
 
-def _softmax_grad_hess(wb, x, y_oh, valid, reg_param, fit_intercept):
+def multinomial_raw_stats(wb, x, y_oh, valid):
+    """Per-batch RAW softmax-Newton partials at the current (K, d+1)
+    parameters: (gxa = rᵀ[x,1] (K, d+1), h_raw = the K²·(d+1)² block
+    Hessian numerator, cnt = Σvalid). Additive across batches/shards —
+    the accumulation unit for the streamed multinomial fit."""
     n_feat = x.shape[1]
     k = y_oh.shape[1]
-    w = wb[:, :n_feat]          # (K, d)
-    b = wb[:, n_feat]           # (K,)
+    w = wb[:, :n_feat]
+    b = wb[:, n_feat]
     z = x @ w.T + b[None, :]
     p = jax.nn.softmax(z, axis=1)
-    cnt = jnp.maximum(jnp.sum(valid), 1.0)
     r = (p - y_oh) * valid[:, None]          # (n, K)
-    gx = lax.dot_general(                     # (K, d): rᵀX
-        r, x, (((0,), (0,)), ((), ())), precision=lax.Precision.HIGHEST
-    ) / cnt
-    gb = jnp.sum(r, axis=0) / cnt
-    g = jnp.concatenate([gx + reg_param * w, gb[:, None]], axis=1)
-    if not fit_intercept:
-        g = g.at[:, n_feat].set(0.0)
-
-    # Hessian blocks over the augmented feature vector x̃ = [x, 1]
     ones = jnp.ones((x.shape[0], 1), dtype=x.dtype)
     xa = jnp.concatenate([x, ones], axis=1)   # (n, d+1)
+    gxa = lax.dot_general(
+        r, xa, (((0,), (0,)), ((), ())), precision=lax.Precision.HIGHEST
+    )
 
     def block(kl):
         kk, ll = kl // k, kl % k
-        s = p[:, kk] * ((kk == ll) * 1.0 - p[:, ll]) * valid
+        sblk = p[:, kk] * ((kk == ll) * 1.0 - p[:, ll]) * valid
         return lax.dot_general(
-            xa * s[:, None], xa, (((0,), (0,)), ((), ())),
+            xa * sblk[:, None], xa, (((0,), (0,)), ((), ())),
             precision=lax.Precision.HIGHEST,
-        ) / cnt
+        )
 
     blocks = jax.vmap(block)(jnp.arange(k * k))  # (K², d+1, d+1)
-    h = blocks.reshape(k, k, n_feat + 1, n_feat + 1)
-    h = jnp.transpose(h, (0, 2, 1, 3)).reshape(
-        k * (n_feat + 1), k * (n_feat + 1)
-    )
-    dim = n_feat + 1
+    h_raw = jnp.transpose(
+        blocks.reshape(k, k, n_feat + 1, n_feat + 1), (0, 2, 1, 3)
+    ).reshape(k * (n_feat + 1), k * (n_feat + 1))
+    return gxa, h_raw, jnp.sum(valid)
+
+
+def assemble_multinomial_system(gxa, h_raw, cnt, wb, reg_param,
+                                fit_intercept):
+    """(g, h) of the softmax Newton system from accumulated raw partials
+    — regularization, intercept pinning, and the gauge ridge live HERE,
+    once, shared by the in-memory kernel and the streamed assembler
+    (jnp ops: traced inside jit, eager on host arrays)."""
+    k, dim = wb.shape
+    n_feat = dim - 1
+    dtype = h_raw.dtype
+    cnt = jnp.maximum(cnt, 1.0)
+    w = wb[:, :n_feat]
+    g = gxa / cnt
+    g = g.at[:, :n_feat].add(reg_param * w)
+    if not fit_intercept:
+        g = g.at[:, n_feat].set(0.0)
+    h = h_raw / cnt
     if not fit_intercept:
         # Pin the intercept slots COMPLETELY: zero their rows and columns,
         # identity diagonal. Zeroing only the gradient would still let
@@ -225,8 +239,8 @@ def _softmax_grad_hess(wb, x, y_oh, valid, reg_param, fit_intercept):
         # off-diagonal Hessian blocks and silently train the wrong model.
         keep = jnp.tile(
             jnp.concatenate([
-                jnp.ones((n_feat,), dtype=x.dtype),
-                jnp.zeros((1,), dtype=x.dtype),
+                jnp.ones((n_feat,), dtype=dtype),
+                jnp.zeros((1,), dtype=dtype),
             ]),
             k,
         )
@@ -240,20 +254,40 @@ def _softmax_grad_hess(wb, x, y_oh, valid, reg_param, fit_intercept):
     # invariant to the gauge, and the ridge is far above float32 rounding
     # (a fixed 1e-8 underflows into H in f32 and leaves the system
     # exactly singular).
-    eps_ridge = jnp.sqrt(jnp.finfo(x.dtype).eps).astype(x.dtype) * (
+    eps_ridge = jnp.sqrt(jnp.finfo(dtype).eps).astype(dtype) * (
         jnp.maximum(jnp.mean(jnp.diagonal(h)), 1.0)
     )
     reg_diag = jnp.tile(
         jnp.concatenate([
-            jnp.full((n_feat,), reg_param, dtype=x.dtype),
-            jnp.asarray([0.0 if fit_intercept else 1.0], dtype=x.dtype),
+            jnp.full((n_feat,), reg_param, dtype=dtype),
+            jnp.asarray([0.0 if fit_intercept else 1.0], dtype=dtype),
         ]),
         k,
     )
-    h = h + jnp.diag(reg_diag) + eps_ridge * jnp.eye(
-        k * dim, dtype=x.dtype
-    )
+    h = h + jnp.diag(reg_diag) + eps_ridge * jnp.eye(k * dim, dtype=dtype)
     return g, h
+
+
+def _softmax_grad_hess(wb, x, y_oh, valid, reg_param, fit_intercept):
+    gxa, h_raw, cnt = multinomial_raw_stats(wb, x, y_oh, valid)
+    return assemble_multinomial_system(
+        gxa, h_raw, cnt, wb, reg_param, fit_intercept
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_multinomial_stats(carry, x, y_oh, wb, mask=None):
+    """Out-of-core softmax-Newton building block: fold one batch's raw
+    partials at the current parameters into a donated accumulator. One
+    streamed pass = one Newton gradient/Hessian evaluation."""
+    gxa, h_raw, cnt = carry
+    valid = (
+        jnp.ones(x.shape[0], dtype=x.dtype) if mask is None
+        else mask.astype(x.dtype)
+    )
+    g, h, c = multinomial_raw_stats(wb, x.astype(gxa.dtype),
+                                    y_oh.astype(gxa.dtype), valid)
+    return gxa + g, h_raw + h, cnt + c
 
 
 @partial(

@@ -325,3 +325,35 @@ def test_multinomial_evaluate_and_label_guards(rng):
         LogisticRegression().fit(
             VectorFrame({"features": x, "label": rng.normal(size=n)})
         )
+
+
+def test_multinomial_streamed_matches_oneshot(rng):
+    """Streamed softmax fit (raw-partials pass per Newton iteration)
+    converges to the in-memory multinomial kernel's solution."""
+    n, d, k = 900, 6, 3
+    centers = rng.normal(scale=3, size=(k, d))
+    y = rng.integers(0, k, size=n).astype(np.float64)
+    x = rng.normal(size=(n, d)) + centers[y.astype(int)]
+    oneshot = LogisticRegression().setRegParam(0.05).fit(x, y)
+    streamed = LogisticRegression().setRegParam(0.05).fit(
+        lambda: ((x[i:i + 250], y[i:i + 250]) for i in range(0, n, 250))
+    )
+    np.testing.assert_allclose(
+        streamed.coefficient_matrix, oneshot.coefficient_matrix, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        streamed.intercept_vector, oneshot.intercept_vector, atol=1e-5
+    )
+    np.testing.assert_array_equal(streamed.classes_, oneshot.classes_)
+    p_s = streamed.predict_proba(x)
+    p_o = oneshot.predict_proba(x)
+    np.testing.assert_allclose(p_s, p_o, atol=1e-6)
+
+
+def test_multinomial_streamed_continuous_target_guard(rng):
+    x = rng.normal(size=(300, 4))
+    y = rng.normal(size=300)  # continuous
+    with pytest.raises(ValueError, match="continuous"):
+        LogisticRegression().fit(
+            lambda: ((x[i:i + 100], y[i:i + 100]) for i in range(0, 300, 100))
+        )
