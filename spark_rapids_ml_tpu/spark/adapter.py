@@ -186,6 +186,8 @@ class _AdapterModel(Model):
         local = self._local
         in_col = local.getInputCol()
         out_col = local.get_or_default(self._out_col_param)
+        if not out_col:   # Spark convention: '' disables the column
+            return dataset
         vector_out = self._out_kind == "vector"
         return_type = VectorUDT() if vector_out else "double"
 
@@ -213,16 +215,75 @@ class _AdapterModel(Model):
         return cls(cls._local_model_cls.load(path))
 
 
+class _ClassifierAdapterModel(_AdapterModel):
+    """Classifier variant: ONE inference pass computes the probability
+    column; the prediction column then derives from it with a cheap
+    argmax UDF (classes_-mapped) — no second forest/model evaluation,
+    matching Spark's vector probability + prediction pair. ``''`` in
+    either column param disables that column (Spark convention)."""
+
+    _proba_scalar = False   # local probabilityCol holds P(y=1) scalars
+
+    def _transform(self, dataset):
+        import numpy as np_
+
+        local = self._local
+        in_col = local.getInputCol()
+        proba_col = local.get_or_default("probabilityCol")
+        pred_col = local.get_or_default(self._out_col_param)
+        classes = np_.asarray(
+            getattr(local, "classes_", None)
+            if getattr(local, "classes_", None) is not None
+            else [0.0, 1.0],
+            dtype=np_.float64,
+        )
+        scalar_proba = self._proba_scalar
+
+        if not proba_col:
+            # no probability requested: single prediction-only pass
+            return super()._transform(dataset)
+
+        @pandas_udf(returnType=VectorUDT())
+        def proba_udf(series):
+            import pandas as pd
+
+            x = _densify(series)
+            values = local.transform(x).column(proba_col)
+            if scalar_proba:
+                return pd.Series(
+                    [DenseVector([1.0 - float(v), float(v)])
+                     for v in values]
+                )
+            return pd.Series([DenseVector(v) for v in values])
+
+        result = dataset.withColumn(proba_col, proba_udf(dataset[in_col]))
+        if not pred_col:
+            return result
+
+        @pandas_udf(returnType="double")
+        def pred_udf(series):
+            import pandas as pd
+
+            return pd.Series([
+                float(classes[int(np_.argmax(v.toArray()))])
+                for v in series
+            ])
+
+        return result.withColumn(pred_col, pred_udf(result[proba_col]))
+
+
 def _make_pair(name, local_est, local_model, *, needs_label,
                out_col_param="predictionCol", out_kind="double",
-               aliases=None, doc=""):
+               classifier=False, proba_scalar=False, aliases=None, doc=""):
+    base = _ClassifierAdapterModel if classifier else _AdapterModel
     model_cls = type(
         f"{name}Model",
-        (_AdapterModel,),
+        (base,),
         {
             "_local_model_cls": local_model,
             "_out_col_param": out_col_param,
             "_out_kind": out_kind,
+            "_proba_scalar": proba_scalar,
             "__doc__": f"DataFrame front-end over "
                        f"``models.{local_model.__name__}``. {doc}",
         },
@@ -278,6 +339,7 @@ from spark_rapids_ml_tpu.models.svd import (  # noqa: E402
 
 RandomForestClassifier, RandomForestClassifierModel = _make_pair(
     "RandomForestClassifier", _LRFC, _LRFC_M, needs_label=True,
+    classifier=True,
     doc="Histogram trees with MXU split search on the driver's device.",
 )
 RandomForestRegressor, RandomForestRegressorModel = _make_pair(
@@ -285,6 +347,7 @@ RandomForestRegressor, RandomForestRegressorModel = _make_pair(
 )
 GBTClassifier, GBTClassifierModel = _make_pair(
     "GBTClassifier", _LGBTC, _LGBTC_M, needs_label=True,
+    classifier=True, proba_scalar=True,
 )
 GBTRegressor, GBTRegressorModel = _make_pair(
     "GBTRegressor", _LGBTR, _LGBTR_M, needs_label=True,
@@ -294,7 +357,7 @@ GBTRegressor, GBTRegressorModel = _make_pair(
 # count/sum/sq partials), which supersedes the driver-collect strategy
 NaiveBayesModel = type(
     "NaiveBayesModel",
-    (_AdapterModel,),
+    (_ClassifierAdapterModel,),
     {"_local_model_cls": _LNB_M,
      "__doc__": "DataFrame front-end over models.NaiveBayesModel."},
 )

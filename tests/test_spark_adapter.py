@@ -172,3 +172,34 @@ def test_umap_front_end(spark, rng):
     c0, c1 = emb[y == 0].mean(0), emb[y == 1].mean(0)
     spread = max(emb[y == 0].std(), emb[y == 1].std())
     assert np.linalg.norm(c0 - c1) > 2.0 * spread
+
+
+def test_classifier_front_ends_emit_probabilities(spark, rng):
+    x = rng.normal(size=(200, 4))
+    y = (x[:, 0] > 0).astype(float)
+    df = _df(spark, x, y)
+    rf = RandomForestClassifier(numTrees=8, maxDepth=3, seed=2).fit(df)
+    out = rf.transform(df).collect()
+    proba = np.stack([r["probability"].toArray() for r in out])
+    pred = np.asarray([r["prediction"] for r in out])
+    assert proba.shape == (200, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-9)
+    np.testing.assert_array_equal(pred, proba.argmax(axis=1))
+
+    from spark_rapids_ml_tpu.spark import GBTClassifier
+
+    gbt = GBTClassifier(maxIter=10, maxDepth=2, seed=2).fit(df)
+    out2 = gbt.transform(df).collect()
+    p1 = np.asarray([r["probability"] for r in out2])
+    assert ((p1 >= 0) & (p1 <= 1)).all()
+
+
+def test_probability_column_suppression(spark, rng):
+    x = rng.normal(size=(120, 3))
+    y = (x[:, 0] > 0).astype(float)
+    df = _df(spark, x, y)
+    rf = RandomForestClassifier(numTrees=5, maxDepth=2, seed=1).fit(df)
+    rf.setProbabilityCol("")
+    out = rf.transform(df)
+    assert "probability" not in out.columns and "" not in out.columns
+    assert "prediction" in out.columns
