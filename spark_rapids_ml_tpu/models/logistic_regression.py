@@ -458,6 +458,32 @@ def _streamed_classes(source) -> np.ndarray:
     return np.asarray(sorted(seen))
 
 
+def class_indices(y: np.ndarray, classes: np.ndarray) -> np.ndarray:
+    """Label values → indices into the sorted class set; raises when a
+    value is outside it — ONE definition for every softmax plane."""
+    k = classes.size
+    idx = np.searchsorted(classes, y)
+    ok = (idx < k) & (classes[np.minimum(idx, k - 1)] == y)
+    if not ok.all():
+        raise ValueError(
+            "labels contain values outside the discovered class set"
+        )
+    return idx
+
+
+def softmax_log_loss(x: np.ndarray, wb: np.ndarray, idx: np.ndarray) -> float:
+    """Σ per-row softmax NLL at (K, d+1) parameters (max-shifted, clipped)
+    — shared by the host and device statistics planes."""
+    n = wb.shape[1] - 1
+    z = x @ wb[:, :n].T + wb[:, n][None, :]
+    z = z - z.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=1, keepdims=True)
+    return float(-np.log(
+        np.maximum(p[np.arange(len(idx)), idx], 1e-300)
+    ).sum())
+
+
 class _NonBinaryLabelsError(ValueError):
     """Raised by _check_binary — a subtype so the streamed fit can catch
     it and re-dispatch to the multinomial family without string

@@ -343,6 +343,138 @@ def logreg_newton_step_from_stats(
     return w_new, float(b_new), float(np.max(np.abs(delta)))
 
 
+def partition_label_values(
+    batches: Iterable, label_col: str
+) -> Iterator[Dict[str, object]]:
+    """One row: the distinct (finite-validated) label values this
+    partition saw — the cheap discovery pass Spark's family='auto' needs
+    before choosing binary vs multinomial. Runs over a LABEL-ONLY column
+    selection (no feature densify), and raises as soon as a partition
+    exceeds the 100-class multinomial cap rather than shipping an
+    unbounded set (a continuous target would otherwise collect every
+    distinct double)."""
+    seen = set()
+    for batch in batches:
+        if hasattr(batch, "column"):
+            y = np.asarray(batch.column(label_col).to_pylist(),
+                           dtype=np.float64)
+        else:
+            y = np.asarray(batch, dtype=np.float64).reshape(-1)
+        if y.size == 0:
+            continue
+        if not np.isfinite(y).all():
+            raise ValueError("labels must be finite")
+        seen.update(np.unique(y).tolist())
+        if len(seen) > 101:
+            raise ValueError(
+                f"more than 100 distinct label values: looks like a "
+                "continuous target, not classes (multinomial supports "
+                "up to 100)"
+            )
+    if not seen:
+        return
+    yield {"labels": sorted(seen)}
+
+
+def partition_multinomial_stats(
+    batches: Iterable,
+    features_col: str,
+    label_col: str,
+    classes: np.ndarray,
+    wb: np.ndarray,
+) -> Iterator[Dict[str, object]]:
+    """One partition's raw softmax-Newton partials at the broadcast
+    (K, d+1) parameters: (gxa, h_raw, loss, count) — the additive unit of
+    ``ops.logreg_kernel.multinomial_raw_stats``, here in executor-CPU
+    NumPy f64 (the host plane)."""
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        class_indices,
+        softmax_log_loss,
+    )
+
+    classes = np.asarray(classes, dtype=np.float64)
+    k = classes.size
+    wb = np.asarray(wb, dtype=np.float64)
+    n = wb.shape[1] - 1
+    gxa = np.zeros((k, n + 1))
+    h_raw = np.zeros((k * (n + 1), k * (n + 1)))
+    loss = 0.0
+    count = 0
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+            y = np.asarray(batch.column(label_col).to_pylist(),
+                           dtype=np.float64)
+        else:
+            x, y = batch
+            x = np.asarray(x, dtype=np.float64)
+            y = np.asarray(y, dtype=np.float64).reshape(-1)
+        if x.shape[0] == 0:
+            continue
+        idx = class_indices(y, classes)
+        z = x @ wb[:, :n].T + wb[:, n][None, :]
+        z = z - z.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        p = e / e.sum(axis=1, keepdims=True)
+        y_oh = np.eye(k)[idx]
+        r = p - y_oh
+        xa = np.concatenate([x, np.ones((x.shape[0], 1))], axis=1)
+        gxa += r.T @ xa
+        for kk in range(k):
+            for ll in range(k):
+                s = p[:, kk] * ((kk == ll) * 1.0 - p[:, ll])
+                h_raw[kk * (n + 1):(kk + 1) * (n + 1),
+                      ll * (n + 1):(ll + 1) * (n + 1)] += (
+                    (xa * s[:, None]).T @ xa
+                )
+        loss += softmax_log_loss(x, wb, idx)
+        count += x.shape[0]
+    if count == 0:
+        return
+    yield {
+        "gxa": gxa.ravel().tolist(),
+        "h": h_raw.ravel().tolist(),
+        "loss": loss,
+        "count": count,
+    }
+
+
+def multinomial_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema(
+        [
+            ("gxa", pa.list_(pa.float64())),
+            ("h", pa.list_(pa.float64())),
+            ("loss", pa.float64()),
+            ("count", pa.int64()),
+        ]
+    )
+
+
+def multinomial_stats_spark_ddl() -> str:
+    return "gxa array<double>, h array<double>, loss double, count bigint"
+
+
+def combine_multinomial_stats(rows: Iterable, k: int, dim: int):
+    """Driver-side reduce → (gxa (k, dim), h_raw (k·dim)², loss, count)."""
+    gxa = np.zeros((k, dim))
+    h_raw = np.zeros((k * dim, k * dim))
+    loss = 0.0
+    count = 0
+    for row in rows:
+        get = row.get if isinstance(row, dict) else row.__getitem__
+        gxa += np.asarray(get("gxa"), dtype=np.float64).reshape(k, dim)
+        h_raw += np.asarray(get("h"), dtype=np.float64).reshape(
+            k * dim, k * dim
+        )
+        loss += float(get("loss"))
+        count += int(get("count"))
+    if count == 0:
+        raise ValueError("no partition statistics to combine (empty dataset)")
+    return gxa, h_raw, loss, count
+
+
 def partition_kmeans_stats(
     batches: Iterable, input_col: str, centers: np.ndarray
 ) -> Iterator[Dict[str, object]]:

@@ -566,3 +566,86 @@ def partition_gram_stats_device_collective(
         }],
         schema=stats_arrow_schema(),
     )
+
+
+def partition_multinomial_stats_device(
+    batches,
+    features_col: str,
+    label_col: str,
+    classes: np.ndarray,
+    wb: np.ndarray,
+    device_id: int = -1,
+    dtype: str = "auto",
+):
+    """Device counterpart of ``aggregate.partition_multinomial_stats``:
+    the raw softmax partials fold into a donated device accumulator
+    (``ops.logreg_kernel.update_multinomial_stats``) — the K² Hessian
+    Grams run on the executor's MXU. Loss accumulates on host (one
+    (m, K) logits pass — negligible next to the Hessian)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        class_indices,
+        softmax_log_loss,
+    )
+    from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+    from spark_rapids_ml_tpu.ops.logreg_kernel import update_multinomial_stats
+
+    device = _resolve_device(device_id)
+    dt = _resolve_dtype(dtype)
+    classes = np.asarray(classes, dtype=np.float64)
+    k = classes.size
+    wb = np.asarray(wb, dtype=np.float64)
+    n = wb.shape[1] - 1
+    dim = n + 1
+    eye_k = np.eye(k)
+    carry = None
+    wb_dev = None
+    loss = 0.0
+    rows_seen = 0
+    for x, y in _xy_matrices(batches, features_col, label_col):
+        m = x.shape[0]
+        if m == 0:
+            continue
+        idx = class_indices(y, classes)
+        rows_seen += m
+        if carry is None:
+            carry = jax.device_put(
+                (
+                    jnp.zeros((k, dim), dtype=dt),
+                    jnp.zeros((k * dim, k * dim), dtype=dt),
+                    jnp.zeros((), dtype=dt),
+                ),
+                device,
+            )
+            wb_dev = jax.device_put(jnp.asarray(wb, dtype=dt), device)
+        y_oh = eye_k[idx]
+        bucket = _bucket_rows(m)
+        if bucket != m:
+            x_pad = np.zeros((bucket, n), dtype=x.dtype)
+            x_pad[:m] = x
+            oh_pad = np.zeros((bucket, k))
+            oh_pad[:m] = y_oh
+            mask = np.zeros(bucket, dtype=bool)
+            mask[:m] = True
+            carry = update_multinomial_stats(
+                carry, jnp.asarray(x_pad, dtype=dt),
+                jnp.asarray(oh_pad, dtype=dt), wb_dev, jnp.asarray(mask),
+            )
+        else:
+            carry = update_multinomial_stats(
+                carry, jnp.asarray(x, dtype=dt),
+                jnp.asarray(y_oh, dtype=dt), wb_dev,
+            )
+        loss += softmax_log_loss(x, wb, idx)
+    if carry is None:
+        return
+    carry = jax.block_until_ready(carry)
+    gxa, h_raw, _ = (np.asarray(v, dtype=np.float64) for v in carry)
+    yield {
+        "gxa": gxa.ravel().tolist(),
+        "h": h_raw.ravel().tolist(),
+        "loss": loss,
+        "count": rows_seen,
+    }

@@ -482,7 +482,8 @@ class _TpuLogRegParams(Params):
                           "predicted class output column",
                           typeConverter=TypeConverters.toString)
     probabilityCol = Param(Params._dummy(), "probabilityCol",
-                           "P(y=1) output column",
+                           "probability output column: P(y=1) double for "
+                           "binary fits, per-class vector for multinomial",
                            typeConverter=TypeConverters.toString)
     regParam = Param(Params._dummy(), "regParam", "L2 strength lambda",
                      typeConverter=TypeConverters.toFloat)
@@ -516,9 +517,9 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
     compute (Xᵀr, XᵀSX, …) partials under the closure-broadcast current
     coefficients, the driver combines them and solves the tiny (n+1)²
     system — the per-iteration analogue of the reference's per-partition
-    GEMM + driver reduce (``RapidsRowMatrix.scala:168-202``). Binary
-    labels only; for multinomial fit the local
-    ``spark_rapids_ml_tpu.LogisticRegression`` on collected data.
+    GEMM + driver reduce (``RapidsRowMatrix.scala:168-202``). Spark's
+    family="auto": a label-only discovery pass selects binary Newton-IRLS
+    or the multinomial softmax plane (>2 classes) automatically.
     """
 
     @keyword_only
@@ -566,6 +567,41 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
             if first is None:
                 raise ValueError("empty dataset")
             n = len(first[0])
+
+            # family="auto": one cheap label-discovery pass picks binary
+            # vs multinomial (the softmax plane), like Spark's
+            from spark_rapids_ml_tpu.spark.aggregate import (
+                partition_label_values,
+            )
+
+            def label_job(batches):
+                import pyarrow as pa
+
+                for row in partition_label_values(batches, lcol):
+                    yield pa.RecordBatch.from_pylist(
+                        [row],
+                        schema=pa.schema(
+                            [("labels", pa.list_(pa.float64()))]
+                        ),
+                    )
+
+            # label-only selection: the discovery pass never densifies
+            # the feature vectors
+            label_rows = dataset.select(lcol).mapInArrow(
+                label_job, "labels array<double>"
+            ).collect()
+            classes = np.asarray(sorted({
+                v for r in label_rows for v in r["labels"]
+            }))
+            if classes.size > 2:
+                if classes.size > 100:
+                    raise ValueError(
+                        f"{classes.size} distinct label values: looks "
+                        "like a continuous target, not classes "
+                        "(multinomial supports up to 100)"
+                    )
+                return self._fit_multinomial(df, fcol, lcol, classes, n)
+
             w = np.zeros(n)
             b = 0.0
             n_iter = 0
@@ -611,17 +647,142 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
         return self._copyValues(model)
 
 
+    def _fit_multinomial(self, df, fcol, lcol, classes, n):
+        """Softmax Newton over mapInArrow raw-partials jobs: executors
+        emit (gxa, H_raw, loss, n) at the broadcast parameters — on their
+        accelerator under executorDevice='auto'/'on' — and the driver
+        assembles/solves the K(d+1) system through the same
+        ``assemble_multinomial_system`` every other multinomial fit
+        uses."""
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.logreg_kernel import (
+            assemble_multinomial_system,
+        )
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            combine_multinomial_stats,
+            multinomial_stats_arrow_schema,
+            multinomial_stats_spark_ddl,
+            partition_multinomial_stats,
+        )
+        from spark_rapids_ml_tpu.spark.device_aggregate import (
+            partition_multinomial_stats_device,
+        )
+
+        lam = float(self.getOrDefault(self.regParam))
+        fit_b = self.getOrDefault(self.fitIntercept)
+        tol = float(self.getOrDefault(self.tol))
+        executor_device = self.getOrDefault(self.executorDevice)
+        device_id = self.getOrDefault(self.deviceId)
+        k = int(classes.size)
+        dim = n + 1
+        wb = np.zeros((k, dim))
+        n_iter = 0
+        objective_history = []
+        for n_iter in range(1, self.getOrDefault(self.maxIter) + 1):
+            frozen = wb.copy()
+
+            def host_fn(batches, _wb=frozen):
+                import pyarrow as pa
+
+                for row in partition_multinomial_stats(
+                    batches, fcol, lcol, classes, _wb
+                ):
+                    yield pa.RecordBatch.from_pylist(
+                        [row], schema=multinomial_stats_arrow_schema()
+                    )
+
+            def device_fn(batches, _wb=frozen):
+                import pyarrow as pa
+
+                for row in partition_multinomial_stats_device(
+                    batches, fcol, lcol, classes, _wb, device_id
+                ):
+                    yield pa.RecordBatch.from_pylist(
+                        [row], schema=multinomial_stats_arrow_schema()
+                    )
+
+            stats = _select_stats_plane(executor_device, device_fn, host_fn)
+            rows = df.mapInArrow(
+                stats, multinomial_stats_spark_ddl()
+            ).collect()
+            gxa, h_raw, loss, count = combine_multinomial_stats(rows, k, dim)
+            objective_history.append(
+                loss / max(count, 1)
+                + 0.5 * lam * float((wb[:, :n] ** 2).sum())
+            )
+            g, h = assemble_multinomial_system(
+                jnp.asarray(gxa), jnp.asarray(h_raw),
+                jnp.asarray(float(count)), jnp.asarray(wb), lam, fit_b,
+            )
+            step = np.linalg.solve(
+                np.asarray(h, dtype=np.float64),
+                np.asarray(g, dtype=np.float64).reshape(-1),
+            ).reshape(k, dim)
+            wb = wb - step
+            if np.max(np.abs(step)) <= tol:
+                break
+        model = LogisticRegressionModel(
+            coefficient_matrix=DenseMatrix(
+                k, n, wb[:, :n].ravel(order="F").tolist()
+            ),
+            intercept_vector=DenseVector(
+                (wb[:, n] if fit_b else np.zeros(k)).tolist()
+            ),
+            classes=DenseVector(classes.tolist()),
+        )
+        model.n_iter_ = n_iter
+        model.objective_history_ = objective_history
+        return self._copyValues(model)
+
+
 class LogisticRegressionModel(Model, _TpuLogRegParams):
-    def __init__(self, coefficients=None, intercept=0.0):
+    """Binary fits populate ``coefficients``/``intercept``; multinomial
+    fits populate ``coefficientMatrix``-style fields, as Spark does."""
+
+    def __init__(self, coefficients=None, intercept=0.0,
+                 coefficient_matrix=None, intercept_vector=None,
+                 classes=None):
         super().__init__()
         self.coefficients = coefficients
         self.intercept = intercept
+        self.coefficientMatrix = coefficient_matrix
+        self.interceptVector = intercept_vector
+        self.classes_ = classes
         self.n_iter_ = None
         self.objective_history_ = None
 
     def _transform(self, dataset):
         import pandas as pd
         from spark_rapids_ml_tpu.spark._compat import col, pandas_udf
+
+        pcol = self.getOrDefault(self.probabilityCol)
+        fcol = self.getOrDefault(self.featuresCol)
+        if self.coefficientMatrix is not None:
+            cm = self.coefficientMatrix.toArray()
+            iv = self.interceptVector.toArray()
+            classes = self.classes_.toArray()
+
+            @pandas_udf(returnType=VectorUDT())
+            def proba_m(v: pd.Series) -> pd.Series:
+                x = np.stack([row.toArray() for row in v])
+                z = x @ cm.T + iv[None, :]
+                z = z - z.max(axis=1, keepdims=True)
+                e = np.exp(z)
+                e /= e.sum(axis=1, keepdims=True)
+                return pd.Series([DenseVector(r) for r in e])
+
+            out = dataset.withColumn(pcol, proba_m(dataset[fcol]))
+
+            @pandas_udf(returnType="double")
+            def pred_m(v: pd.Series) -> pd.Series:
+                return pd.Series([
+                    float(classes[int(np.argmax(r.toArray()))]) for r in v
+                ])
+
+            return out.withColumn(
+                self.getOrDefault(self.predictionCol), pred_m(out[pcol])
+            )
 
         coef = self.coefficients.toArray()
         b = float(self.intercept)
@@ -631,10 +792,7 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
             x = np.stack([row.toArray() for row in v])
             return pd.Series(1.0 / (1.0 + np.exp(-(x @ coef + b))))
 
-        pcol = self.getOrDefault(self.probabilityCol)
-        out = dataset.withColumn(
-            pcol, proba(dataset[self.getOrDefault(self.featuresCol)])
-        )
+        out = dataset.withColumn(pcol, proba(dataset[fcol]))
         # prediction derives from probability with a plain column expression
         # — one densifying UDF pass, not two
         return out.withColumn(
@@ -648,11 +806,19 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
             LogisticRegressionModel as LocalModel,
         )
 
-        local = LocalModel(
-            coefficients=self.coefficients.toArray(),
-            intercept=float(self.intercept),
-            uid=self.uid,
-        )
+        if self.coefficientMatrix is not None:
+            local = LocalModel(
+                coefficient_matrix=self.coefficientMatrix.toArray(),
+                intercept_vector=self.interceptVector.toArray(),
+                classes=self.classes_.toArray(),
+                uid=self.uid,
+            )
+        else:
+            local = LocalModel(
+                coefficients=self.coefficients.toArray(),
+                intercept=float(self.intercept),
+                uid=self.uid,
+            )
         # the local model names its features column inputCol (HasInputCol)
         for theirs, ours in (("featuresCol", "inputCol"),
                              ("labelCol", "labelCol"),
@@ -677,10 +843,24 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
         )
 
         local = LocalModel.load(path)
-        model = LogisticRegressionModel(
-            coefficients=DenseVector(np.asarray(local.coefficients).tolist()),
-            intercept=float(local.intercept),
-        )
+        if getattr(local, "coefficient_matrix", None) is not None:
+            cm = np.asarray(local.coefficient_matrix)
+            model = LogisticRegressionModel(
+                coefficient_matrix=DenseMatrix(
+                    cm.shape[0], cm.shape[1], cm.ravel(order="F").tolist()
+                ),
+                intercept_vector=DenseVector(
+                    np.asarray(local.intercept_vector).tolist()
+                ),
+                classes=DenseVector(np.asarray(local.classes_).tolist()),
+            )
+        else:
+            model = LogisticRegressionModel(
+                coefficients=DenseVector(
+                    np.asarray(local.coefficients).tolist()
+                ),
+                intercept=float(local.intercept),
+            )
         model._resetUid(local.uid)
         if local.is_set("inputCol"):
             model._set(featuresCol=local.get("inputCol"))

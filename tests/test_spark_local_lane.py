@@ -333,3 +333,53 @@ def test_naive_bayes_estimator_persistence(tmp_path):
     loaded = NaiveBayes.load(path)
     assert loaded.getOrDefault(loaded.modelType) == "gaussian"
     assert loaded.getOrDefault(loaded.smoothing) == 0.5
+
+
+def test_logreg_front_end_multinomial(spark, rng):
+    """family='auto' on the DataFrame plane: >2 classes selects the
+    softmax Newton over mapInArrow raw partials, matching the local
+    multinomial fit."""
+    from spark_rapids_ml_tpu import LogisticRegression as LocalLogReg
+
+    k, d, n = 3, 5, 450
+    centers = rng.normal(scale=3, size=(k, d))
+    y = rng.integers(0, k, size=n).astype(float)
+    x = rng.normal(size=(n, d)) + centers[y.astype(int)]
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    model = LogisticRegression(regParam=0.05).fit(df)
+    local = LocalLogReg().setRegParam(0.05).fit(x, labels=y)
+    np.testing.assert_allclose(
+        model.coefficientMatrix.toArray(), local.coefficient_matrix,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        model.interceptVector.toArray(), local.intercept_vector, atol=1e-6
+    )
+    out = model.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    proba = np.stack([r["probability"].toArray() for r in out])
+    assert proba.shape == (n, k)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-9)
+    assert (pred == y).mean() > 0.9
+
+
+def test_logreg_front_end_multinomial_persistence(spark, rng, tmp_path):
+    from spark_rapids_ml_tpu.spark.estimator import (
+        LogisticRegressionModel as SparkLRModel,
+    )
+
+    k, d = 3, 4
+    y = rng.integers(0, k, size=240).astype(float)
+    x = rng.normal(size=(240, d)) + np.eye(k, d)[y.astype(int)] * 5
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    model = LogisticRegression(regParam=0.02).fit(df)
+    path = str(tmp_path / "spark_mlr")
+    model.save(path)
+    loaded = SparkLRModel.load(path)
+    np.testing.assert_allclose(
+        loaded.coefficientMatrix.toArray(),
+        model.coefficientMatrix.toArray(),
+    )
+    np.testing.assert_array_equal(
+        loaded.classes_.toArray(), model.classes_.toArray()
+    )
