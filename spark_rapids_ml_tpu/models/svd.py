@@ -48,6 +48,16 @@ class TruncatedSVDParams(HasInputCol, HasOutputCol, HasDeviceId):
         True, validator=lambda v: isinstance(v, bool))
     dtype = Param("dtype", "device compute dtype", "auto",
                   validator=lambda v: v in ("auto", "float32", "float64"))
+    svdSolver = Param(
+        "svdSolver",
+        "eigensolver for the XLA path: 'eigh', 'randomized' (top-k "
+        "subspace iteration), or 'auto' (randomized when k << n, "
+        "residual-gated with dense-eigh fallback — the same chooser as "
+        "PCA's; the model records the choice in svd_solver_used_). Host "
+        "fallbacks always use dense LAPACK.",
+        "auto",
+        validator=lambda v: v in ("auto", "eigh", "randomized"),
+    )
 
 
 class TruncatedSVD(TruncatedSVDParams):
@@ -78,12 +88,14 @@ class TruncatedSVD(TruncatedSVDParams):
                 f"k = {k} must be <= number of features = {n_features}"
             )
 
+        self._svd_solver_used = None  # set by device solves
         g = self._gram(x, timer)
         v, s = self._solve(g, k, timer)
 
         model = TruncatedSVDModel(components=v, singular_values=s)
         model.copy_values_from(self)
         model.fit_timings_ = timer.as_dict()
+        model.svd_solver_used_ = self._svd_solver_used
         return model
 
     def _gram(self, x, timer) -> np.ndarray:
@@ -115,16 +127,23 @@ class TruncatedSVD(TruncatedSVDParams):
             import jax
             import jax.numpy as jnp
 
-            from spark_rapids_ml_tpu.ops.eigh import eigh_descending, sign_flip
+            from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance_gated
 
             device = _resolve_device(self.getDeviceId())
             dtype = _resolve_dtype(self.getDtype())
             with timer.phase("solve"), TraceRange("xla eigh", TraceColor.BLUE):
                 gd = jax.device_put(jnp.asarray(g, dtype=dtype), device)
-                evals, evecs = eigh_descending(gd)
-                evecs = sign_flip(evecs)
-                s = jnp.sqrt(jnp.maximum(evals[:k], 0))
-                v, s = jax.block_until_ready((evecs[:, :k], s))
+                v, _, used = pca_from_covariance_gated(
+                    gd, k, solver=self.getSvdSolver()
+                )
+                # λᵢ as the Rayleigh quotient of the RETURNED basis —
+                # exact for dense-eigh vectors and exactly the estimate
+                # the randomized solver certifies, with no dependence on
+                # the ratio output's normalization
+                lam = jnp.sum(v * (gd @ v), axis=0)
+                s = jnp.sqrt(jnp.maximum(lam, 0))
+                v, s = jax.block_until_ready((v, s))
+            self._svd_solver_used = used
             return np.asarray(v, np.float64), np.asarray(s, np.float64)
         from spark_rapids_ml_tpu import native
         from spark_rapids_ml_tpu.ops.eigh import eigh_postprocess_host
@@ -143,10 +162,12 @@ class TruncatedSVDModel(TruncatedSVDParams):
         self.components = components          # (n_features, k), V
         self.singular_values = singular_values  # (k,), descending
         self.fit_timings_ = {}
+        self.svd_solver_used_ = None
 
     def _copy_internal_state(self, other: "TruncatedSVDModel") -> None:
         other.components = self.components
         other.singular_values = self.singular_values
+        other.svd_solver_used_ = self.svd_solver_used_
 
     def transform(self, dataset) -> VectorFrame:
         """X @ V, batched on device (the posture the reference's transform

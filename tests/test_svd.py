@@ -102,3 +102,26 @@ def test_svd_transform_rejects_width_mismatch_and_clobber(data):
     out = model.transform(data[:10])
     with pytest.raises(ValueError, match="already exists"):
         model.transform(out)  # output col present -> must not clobber
+
+
+def test_svd_auto_solver_matches_eigh_on_decaying_spectrum(rng):
+    """svdSolver='auto' (gated randomized) reproduces the dense result on
+    a decaying spectrum at large-n, and records its choice."""
+    n_feat, k = 1100, 6
+    x = rng.normal(size=(300, 30)) * (0.8 ** np.arange(30))[None, :]
+    x = x @ rng.normal(size=(30, n_feat)) + 0.01 * rng.normal(
+        size=(300, n_feat)
+    )
+    auto = TruncatedSVD().setK(k).fit(x)
+    dense = TruncatedSVD().setK(k).setSvdSolver("eigh").fit(x)
+    assert auto.svd_solver_used_ in ("randomized", "eigh(gated)")
+    assert dense.svd_solver_used_ == "eigh"
+    np.testing.assert_allclose(
+        auto.singular_values, dense.singular_values, rtol=1e-6
+    )
+    # subspace agreement: each auto vector lies (almost) fully inside the
+    # dense top-k subspace — robust to rotation within eigenvalue clusters
+    proj = dense.components.T @ auto.components     # (k, k)
+    np.testing.assert_allclose(
+        np.linalg.norm(proj, axis=0), 1.0, atol=1e-4
+    )
