@@ -245,19 +245,24 @@ class LinearRegression(LinearRegressionParams):
         return coef, intercept
 
 
-def _elastic_net_solve(a, b, lam, alpha, max_iter=500, tol=1e-8):
-    """FISTA on the centered second moments: min_w  ½wᵀAw − bᵀw
-    + lam·(alpha·‖w‖₁ + (1−alpha)/2·‖w‖²). A is d×d — the iteration is
-    a tiny host loop; the MXU work (building A = XᵀX/n) already happened.
+def _elastic_net_solve(a, b, lam, alpha, max_iter=500, tol=1e-8,
+                       penalty_mask=None):
+    """FISTA on a quadratic model: min_w  ½wᵀAw − bᵀw
+    + lam·(alpha·‖w∘m‖₁ + (1−alpha)/2·‖w∘m‖²). A is d×d — the iteration
+    is a tiny host loop; the MXU work (building A) already happened.
+    ``penalty_mask`` (0/1 per coordinate, default all-ones) exempts
+    coordinates — e.g. an unpenalized intercept slot in the prox-Newton
+    logistic subproblem.
     """
-    l1 = lam * alpha
-    l2 = lam * (1.0 - alpha)
+    m = np.ones(a.shape[0]) if penalty_mask is None else penalty_mask
+    l1 = lam * alpha * m
+    l2 = lam * (1.0 - alpha) * m
     # Lipschitz constant of the smooth part: exact λmax(A) + l2. A is a
     # tiny d×d host matrix, so eigvalsh is cheap AND safe — a power
     # iteration seeded with a fixed vector diverges when that vector is
     # (near-)orthogonal to the top eigenvector (e.g. negative-
     # equicorrelation Grams, where ones IS the bottom eigenvector).
-    lip = float(np.linalg.eigvalsh(a)[-1]) + l2 + 1e-12
+    lip = float(np.linalg.eigvalsh(a)[-1]) + float(np.max(l2)) + 1e-12
 
     def grad(w):
         return a @ w - b + l2 * w

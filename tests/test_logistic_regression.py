@@ -357,3 +357,62 @@ def test_multinomial_streamed_continuous_target_guard(rng):
         LogisticRegression().fit(
             lambda: ((x[i:i + 100], y[i:i + 100]) for i in range(0, 300, 100))
         )
+
+
+@pytest.mark.parametrize("use_xla", [True, False])
+def test_logreg_elastic_net_matches_sklearn(data, use_xla):
+    """elasticNetParam (prox-Newton + FISTA subproblems) vs sklearn's
+    saga elastic-net: same objective with C = 1/(n*lam), l1_ratio=alpha."""
+    x, y = data
+    lam, alpha = 0.05, 0.5
+    model = (
+        LogisticRegression().setRegParam(lam).setElasticNetParam(alpha)
+        .setUseXlaDot(use_xla).setMaxIter(50).fit(x, y)
+    )
+    sk = sklearn_linear.LogisticRegression(
+        solver="saga", l1_ratio=alpha,
+        C=1.0 / (len(y) * lam), tol=1e-8, max_iter=20000,
+    ).fit(x, y)
+    np.testing.assert_allclose(
+        model.coefficients, sk.coef_.ravel(), atol=2e-3
+    )
+    assert abs(model.intercept - float(sk.intercept_[0])) < 2e-3
+
+
+def test_logreg_elastic_net_induces_sparsity(rng):
+    x = rng.normal(size=(500, 12))
+    w_true = np.zeros(12)
+    w_true[:3] = (2.0, -3.0, 1.5)   # only 3 informative features
+    p = 1.0 / (1.0 + np.exp(-(x @ w_true)))
+    y = (rng.random(500) < p).astype(np.float64)
+    model = (
+        LogisticRegression().setRegParam(0.05).setElasticNetParam(1.0)
+        .fit(x, y)
+    )
+    assert (np.abs(model.coefficients[3:]) < 1e-8).sum() >= 6
+    assert (np.abs(model.coefficients[:3]) > 0.05).all()
+
+
+def test_logreg_elastic_net_unsupported_paths_raise(rng):
+    x = rng.normal(size=(90, 3))
+    y3 = rng.integers(0, 3, 90).astype(float)
+    est = LogisticRegression().setRegParam(0.1).setElasticNetParam(0.5)
+    with pytest.raises(ValueError, match="elasticNetParam"):
+        est.fit(x, y3)     # multinomial
+    yb = (x[:, 0] > 0).astype(float)
+    with pytest.raises(ValueError, match="elasticNetParam"):
+        est.fit(lambda: ((x[i:i+30], yb[i:i+30]) for i in range(0, 90, 30)))
+
+
+def test_logreg_elastic_net_separable_data_stays_finite(rng):
+    # fully separable: the lam=0 Hessian collapses as p saturates; the
+    # curvature ridge must keep coefficients finite
+    x = rng.normal(size=(200, 4))
+    y = (x[:, 0] > 0).astype(float)
+    model = (
+        LogisticRegression().setRegParam(0.01).setElasticNetParam(1.0)
+        .setMaxIter(40).fit(x, y)
+    )
+    assert np.isfinite(model.coefficients).all()
+    assert np.isfinite(model.intercept)
+    assert model.evaluate(x, y)["accuracy"] > 0.95
