@@ -737,6 +737,13 @@ def save_forest_model(model, path: str, overwrite: bool = False) -> None:
         ),
         "classes": _dense_vector_struct(classes),
         "numClasses": int(n_classes),
+        "featureImportances": _dense_vector_struct(
+            np.asarray(
+                model.feature_importances_
+                if model.feature_importances_ is not None else [],
+                dtype=np.float64,
+            )
+        ),
     }
     try:
         import pyarrow as pa
@@ -749,6 +756,7 @@ def save_forest_model(model, path: str, overwrite: bool = False) -> None:
                 ("edges", _matrix_arrow_type()),
                 ("classes", _vector_arrow_type()),
                 ("numClasses", pa.int64()),
+                ("featureImportances", _vector_arrow_type()),
             ]
         )
     except ImportError:  # pragma: no cover
@@ -757,6 +765,7 @@ def save_forest_model(model, path: str, overwrite: bool = False) -> None:
         ("feature", "matrix"), ("threshold", "matrix"),
         ("leafValue", "matrix"), ("edges", "matrix"),
         ("classes", "vector"), ("numClasses", "long"),
+        ("featureImportances", "vector"),
     ])
 
 
@@ -787,6 +796,10 @@ def load_forest_model(path: str):
         edges=_dense_matrix_from_struct(row["edges"]),
         classes=classes,
     )
+    fi = _dense_vector_from_struct(
+        row.get("featureImportances", {"values": []})
+    )
+    model.feature_importances_ = fi if fi.size else None
     model.uid = meta["uid"]
     return _restore_params(model, meta)
 
@@ -814,6 +827,13 @@ def save_gbt_model(model, path: str, overwrite: bool = False) -> None:
         ),
         "init": float(model.init_),
         "stepSize": float(model.step_size_),
+        "featureImportances": _dense_vector_struct(
+            np.asarray(
+                model.feature_importances_
+                if model.feature_importances_ is not None else [],
+                dtype=np.float64,
+            )
+        ),
     }
     try:
         import pyarrow as pa
@@ -826,6 +846,7 @@ def save_gbt_model(model, path: str, overwrite: bool = False) -> None:
                 ("edges", _matrix_arrow_type()),
                 ("init", pa.float64()),
                 ("stepSize", pa.float64()),
+                ("featureImportances", _vector_arrow_type()),
             ]
         )
     except ImportError:  # pragma: no cover
@@ -834,6 +855,7 @@ def save_gbt_model(model, path: str, overwrite: bool = False) -> None:
         ("feature", "matrix"), ("threshold", "matrix"),
         ("leafValue", "matrix"), ("edges", "matrix"),
         ("init", "double"), ("stepSize", "double"),
+        ("featureImportances", "vector"),
     ])
 
 
@@ -859,6 +881,10 @@ def load_gbt_model(path: str):
         init=float(row["init"]),
         step_size=float(row["stepSize"]),
     )
+    fi = _dense_vector_from_struct(
+        row.get("featureImportances", {"values": []})
+    )
+    model.feature_importances_ = fi if fi.size else None
     model.uid = meta["uid"]
     return _restore_params(model, meta)
 

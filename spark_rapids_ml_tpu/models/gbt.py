@@ -123,7 +123,7 @@ class _GBTBase(GBTParams):
         rate = float(self.getSubsamplingRate())
 
         def grow_fn(r, w):
-            ft, tt, leaf, leaf_ids_dev = grow_tree_regression(
+            ft, tt, leaf, g_tree, leaf_ids_dev = grow_tree_regression(
                 binned,
                 jax.device_put(jnp.asarray(r, dtype=dtype), device),
                 jax.device_put(jnp.asarray(w, dtype=dtype), device),
@@ -134,10 +134,10 @@ class _GBTBase(GBTParams):
                 return_leaf_ids=True,
             )
             return (np.asarray(ft), np.asarray(tt), np.asarray(leaf),
-                    np.asarray(leaf_ids_dev))
+                    np.asarray(g_tree), np.asarray(leaf_ids_dev))
 
         with timer.phase("boost"), TraceRange("gbt boost", TraceColor.RED):
-            ensemble = boosting_loop(
+            ensemble, gains = boosting_loop(
                 y_padded=y, mask=np.ones(n), n_real=n, init=init,
                 max_iter=self.getMaxIter(), step_size=lr,
                 classification=self._classification,
@@ -146,6 +146,11 @@ class _GBTBase(GBTParams):
             )
         model = self._model_cls()(
             ensemble=ensemble, edges=edges, init=init, step_size=lr
+        )
+        from spark_rapids_ml_tpu.ops.forest_kernel import feature_importances
+
+        model.feature_importances_ = feature_importances(
+            ensemble.feature, gains, d
         )
         model.uid = self.uid
         model.copy_values_from(self)
@@ -163,12 +168,14 @@ class _GBTModelBase(GBTParams):
         self.edges_ = edges
         self.init_ = init
         self.step_size_ = step_size
+        self.feature_importances_ = None
 
     def _copy_internal_state(self, other) -> None:
         other.ensemble_ = self.ensemble_
         other.edges_ = self.edges_
         other.init_ = self.init_
         other.step_size_ = self.step_size_
+        other.feature_importances_ = self.feature_importances_
 
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_gbt_model
@@ -310,7 +317,7 @@ def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
 
     f = np.full(len(y_padded), float(init))
     n_leaves = 2 ** max_depth
-    feats_l, thrs_l, leaves_l = [], [], []
+    feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
     for _ in range(max_iter):
         if classification:
             p = 1.0 / (1.0 + np.exp(-f))
@@ -326,7 +333,7 @@ def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
         else:
             w = np.zeros(len(y_padded))
             w[:n_real] = rng.poisson(subsampling_rate, n_real)
-        ft, tt, leaf, leaf_ids = grow_fn(r, w)
+        ft, tt, leaf, g_tree, leaf_ids = grow_fn(r, w)
         if classification:
             # Newton leaf refit: the grower's mean-residual leaves are
             # only the squared-loss optimum
@@ -338,8 +345,9 @@ def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
         feats_l.append(ft)
         thrs_l.append(tt)
         leaves_l.append(leaf)
+        gains_l.append(g_tree)
     return TreeEnsemble(
         feature=np.stack(feats_l),
         threshold=np.stack(thrs_l),
         leaf_value=np.stack(leaves_l),
-    )
+    ), np.stack(gains_l)

@@ -138,7 +138,7 @@ class _ForestBase(RandomForestParams):
             y_dev = jax.device_put(jnp.asarray(y, dtype=dtype), device)
 
         k_feats = _subset_counts(self.getFeatureSubsetStrategy(), d)
-        feats_l, thrs_l, leaves_l = [], [], []
+        feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
         with timer.phase("grow"), TraceRange("forest grow", TraceColor.RED):
             rate = float(self.getSubsamplingRate())
             for _ in range(self.getNumTrees()):
@@ -151,18 +151,19 @@ class _ForestBase(RandomForestParams):
                     mask[lvl, cols] = 1.0
                 mask_dev = jnp.asarray(mask, dtype=dtype)
                 if self._classification:
-                    f, t, leaf = grow_tree_classification(
+                    f, t, leaf, g_tree = grow_tree_classification(
                         binned, y_oh, w, mask_dev, depth, n_bins,
                         len(classes), self.getMinInstancesPerNode(),
                     )
                 else:
-                    f, t, leaf = grow_tree_regression(
+                    f, t, leaf, g_tree = grow_tree_regression(
                         binned, y_dev, w, mask_dev, depth, n_bins,
                         self.getMinInstancesPerNode(),
                     )
                 feats_l.append(f)
                 thrs_l.append(t)
                 leaves_l.append(leaf)
+                gains_l.append(g_tree)
         ensemble = TreeEnsemble(
             feature=jnp.stack(feats_l),
             threshold=jnp.stack(thrs_l),
@@ -172,6 +173,13 @@ class _ForestBase(RandomForestParams):
             ensemble=jax.device_get(ensemble),
             edges=edges,
             classes=classes if self._classification else None,
+        )
+        from spark_rapids_ml_tpu.ops.forest_kernel import feature_importances
+
+        model.feature_importances_ = feature_importances(
+            np.stack([np.asarray(f) for f in feats_l]),
+            np.stack([np.asarray(g) for g in gains_l]),
+            d,
         )
         model.uid = self.uid
         model.copy_values_from(self)
@@ -195,6 +203,7 @@ class _ForestModelBase(RandomForestParams):
         other.ensemble_ = self.ensemble_
         other.edges_ = self.edges_
         other.classes_ = self.classes_
+        other.feature_importances_ = self.feature_importances_
 
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_forest_model

@@ -124,6 +124,7 @@ def _grow_tree(
     node = jnp.zeros((n,), dtype=jnp.int32)
     feats = jnp.zeros((2 ** max_depth - 1,), dtype=jnp.int32)
     thrs = jnp.full((2 ** max_depth - 1,), n_bins, dtype=jnp.int32)
+    gains = jnp.zeros((2 ** max_depth - 1,), dtype=dtypef)
 
     for level in range(max_depth):  # static unroll: max_depth compiled steps
         n_nodes = 2 ** level
@@ -153,13 +154,18 @@ def _grow_tree(
         bf = jnp.where(best_gain > 1e-12, bf, 0)
         feats = lax.dynamic_update_slice(feats, bf, (base,))
         thrs = lax.dynamic_update_slice(thrs, bt, (base,))
+        gains = lax.dynamic_update_slice(
+            gains,
+            jnp.where(best_gain > 1e-12, best_gain, 0.0).astype(dtypef),
+            (base,),
+        )
         x_bin = jnp.take_along_axis(
             binned, bf[node - base][:, None], axis=1
         )[:, 0]
         go_right = (x_bin > bt[node - base]).astype(jnp.int32)
         node = (node - base) * 2 + go_right + (2 ** (level + 1) - 1)
 
-    return feats, thrs, node
+    return feats, thrs, node, gains
 
 
 @partial(
@@ -179,10 +185,12 @@ def grow_tree_regression(
     axis_name=None,
     return_leaf_ids: bool = False,
 ) -> Tuple[jnp.ndarray, ...]:
-    """One regression tree; returns (feature, threshold, leaf_value)
-    — plus each row's leaf id when ``return_leaf_ids`` (boosting callers
-    need the assignment the grower already computed; re-routing would
-    duplicate a full pass).
+    """One regression tree; returns (feature, threshold, leaf_value,
+    split_gains) — plus each row's leaf id when ``return_leaf_ids``
+    (boosting callers need the assignment the grower already computed;
+    re-routing would duplicate a full pass). ``split_gains`` holds each
+    internal node's realized criterion gain (0 at pass-through nodes) —
+    the per-feature accumulation behind Spark's featureImportances.
 
     Split criterion: weighted variance reduction from the (count, Σy, Σy²)
     channel histograms; gain = SSE(parent) − SSE(left) − SSE(right).
@@ -197,7 +205,7 @@ def grow_tree_regression(
 
         return sse(h_t) - sse(h_l) - sse(h_t - h_l)
 
-    feats, thrs, node = _grow_tree(
+    feats, thrs, node, gains = _grow_tree(
         binned, channels, slice(0, 1), gain_fn, feat_mask,
         max_depth, n_bins, min_leaf, axis_name,
     )
@@ -216,8 +224,8 @@ def grow_tree_regression(
     gmean = wy_sum / jnp.maximum(w_sum, 1e-12)
     leaf = jnp.where(cnt > 0, tot / jnp.maximum(cnt, 1e-12), gmean)
     if return_leaf_ids:
-        return feats, thrs, leaf, node - (n_leaves - 1)
-    return feats, thrs, leaf
+        return feats, thrs, leaf, gains, node - (n_leaves - 1)
+    return feats, thrs, leaf, gains
 
 
 @partial(
@@ -234,9 +242,10 @@ def grow_tree_classification(
     n_classes: int,
     min_leaf: int = 1,
     axis_name=None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, ...]:
     """One classification tree (Gini impurity); leaves are per-class
-    probability vectors. ``axis_name``: see ``_grow_tree``."""
+    probability vectors, plus each split's realized gain (for feature
+    importances). ``axis_name``: see ``_grow_tree``."""
     channels = y_onehot * w[:, None]  # (n, C): per-class weighted counts
 
     def gain_fn(h_l, h_t):
@@ -246,7 +255,7 @@ def grow_tree_classification(
 
         return gini_mass(h_t) - gini_mass(h_l) - gini_mass(h_t - h_l)
 
-    feats, thrs, node = _grow_tree(
+    feats, thrs, node, gains = _grow_tree(
         binned, channels, slice(0, n_classes), gain_fn, feat_mask,
         max_depth, n_bins, min_leaf, axis_name,
     )
@@ -269,7 +278,7 @@ def grow_tree_classification(
     proba = jnp.where(
         tot > 0, cls_cnt / jnp.maximum(tot, 1e-12), prior[None, :]
     )
-    return feats, thrs, proba
+    return feats, thrs, proba, gains
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
@@ -308,3 +317,27 @@ def forest_apply(
         ensemble.feature, ensemble.threshold, ensemble.leaf_value
     )  # (T, n) or (T, n, C)
     return jnp.mean(per_tree, axis=0)
+
+
+def feature_importances(features, gains, n_features: int):
+    """Split-gain feature importances, Spark's convention: per tree, sum
+    each internal node's realized gain onto its split feature and
+    normalize the tree to 1; average the trees; normalize again. Host
+    NumPy — runs once per fit on tiny (trees, nodes) arrays."""
+    import numpy as np
+
+    features = np.asarray(features)
+    gains = np.asarray(gains, dtype=np.float64)
+    if features.ndim == 1:
+        features = features[None, :]
+        gains = gains[None, :]
+    total = np.zeros(n_features)
+    for f_tree, g_tree in zip(features, gains):
+        per = np.bincount(
+            f_tree, weights=np.maximum(g_tree, 0.0), minlength=n_features
+        )
+        tree_sum = per.sum()
+        if tree_sum > 0:
+            total += per / tree_sum
+    grand = total.sum()
+    return total / grand if grand > 0 else total

@@ -54,7 +54,7 @@ def _sharded_grow(
         per_shard,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), y_spec, P(DATA_AXIS), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P(), P()),
         check_vma=False,
     )(binned, y_or_oh, w, feat_mask)
 
@@ -110,7 +110,7 @@ def distributed_forest_fit(
         fm = jnp.asarray(
             np.ones((max_depth, d)), dtype=dtype
         )  # feature subsets: host-side choice mirrors the local fit
-        f, t, leaf = _sharded_grow(
+        f, t, leaf, _g = _sharded_grow(
             binned_dev, y_dev, w_dev, fm, max_depth, n_bins, min_leaf,
             len(classes) if classification else 0, mesh,
         )

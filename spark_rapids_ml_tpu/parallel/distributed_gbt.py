@@ -51,7 +51,7 @@ def _sharded_grow_with_leaf_ids(
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P()),
         # tree structure replicated; leaf ids stay with their shard's rows
-        out_specs=(P(), P(), P(), P(DATA_AXIS)),
+        out_specs=(P(), P(), P(), P(), P(DATA_AXIS)),
         check_vma=False,
     )(binned, r, w, feat_mask)
 
@@ -96,16 +96,16 @@ def distributed_gbt_fit(
     init = gbt_init_margin(y, classification)
 
     def grow_fn(r, w):
-        ft, tt, leaf, leaf_ids_dev = _sharded_grow_with_leaf_ids(
+        ft, tt, leaf, g_tree, leaf_ids_dev = _sharded_grow_with_leaf_ids(
             binned_dev,
             jax.device_put(jnp.asarray(r, dtype=dtype), vec_shard),
             jax.device_put(jnp.asarray(w, dtype=dtype), vec_shard),
             full_mask, max_depth, n_bins, min_leaf, mesh,
         )
         return (np.asarray(ft), np.asarray(tt), np.asarray(leaf),
-                np.asarray(leaf_ids_dev))
+                np.asarray(g_tree), np.asarray(leaf_ids_dev))
 
-    ensemble = boosting_loop(
+    ensemble, _gains = boosting_loop(
         y_padded=y_p, mask=mask, n_real=n, init=init, max_iter=max_iter,
         step_size=step_size, classification=classification,
         subsampling_rate=subsampling_rate, rng=rng, max_depth=max_depth,

@@ -105,3 +105,12 @@ def test_gbt_determinism_and_persistence(rng, tmp_path):
         np.asarray(lc.transform(frame).column("p")),
         atol=1e-7,
     )
+
+
+def test_gbt_feature_importances(rng):
+    x = rng.normal(size=(400, 6))
+    y = 3.0 * x[:, 2] + 0.05 * rng.normal(size=400)
+    model = GBTRegressor().setMaxIter(20).setMaxDepth(3).fit(x, y)
+    imp = model.feature_importances_
+    np.testing.assert_allclose(imp.sum(), 1.0, atol=1e-12)
+    assert imp[2] > 0.8

@@ -234,3 +234,40 @@ def test_distributed_forest_matches_quality(rng):
     )
     acc = (classes_c[np.argmax(proba, axis=1)] == yc).mean()
     assert acc > 0.9, acc
+
+
+def test_feature_importances_identify_informative_features(rng):
+    """Split-gain importances (Spark's featureImportances convention):
+    informative features dominate, noise features stay near zero, sums
+    to 1."""
+    x = rng.normal(size=(500, 8))
+    y = (2.0 * x[:, 1] - 1.5 * x[:, 4] > 0).astype(float)
+    model = (
+        RandomForestClassifier().setNumTrees(20).setMaxDepth(4).setSeed(1)
+        .fit(x, y)
+    )
+    imp = model.feature_importances_
+    assert imp.shape == (8,)
+    np.testing.assert_allclose(imp.sum(), 1.0, atol=1e-12)
+    assert imp[1] + imp[4] > 0.7
+    assert imp[1] > imp.max() * 0.3 and imp[4] > imp.max() * 0.3
+
+
+def test_feature_importances_survive_copy_and_persistence(rng, tmp_path):
+    x = rng.normal(size=(200, 5))
+    y = (x[:, 0] > 0).astype(float)
+    model = (
+        RandomForestClassifier().setNumTrees(8).setMaxDepth(3).setSeed(2)
+        .fit(x, y)
+    )
+    np.testing.assert_allclose(
+        model.copy().feature_importances_, model.feature_importances_
+    )
+    from spark_rapids_ml_tpu import RandomForestClassificationModel
+
+    path = str(tmp_path / "rf_fi")
+    model.save(path)
+    loaded = RandomForestClassificationModel.load(path)
+    np.testing.assert_allclose(
+        loaded.feature_importances_, model.feature_importances_
+    )
