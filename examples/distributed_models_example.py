@@ -44,7 +44,7 @@ def main() -> None:
     print("LinearSVC coefficients:", np.asarray(svc.coefficients).shape)
 
     # GBT: per-level histogram psum per boosting iteration
-    ens, edges, init = distributed_gbt_fit(
+    ens, edges, init, _gains = distributed_gbt_fit(
         x, y, mesh, max_iter=10, max_depth=3, classification=True
     )
     print("GBT ensemble:", ens.feature.shape)

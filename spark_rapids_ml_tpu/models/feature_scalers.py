@@ -57,13 +57,28 @@ class MinMaxScaler(MinMaxScalerParams):
         if float(self.getMin()) >= float(self.getMax()):
             raise ValueError("min must be below max")
         timer = PhaseTimer()
-        frame = as_vector_frame(dataset, self.getInputCol())
-        with timer.phase("fit"):
-            x = frame.vectors_as_matrix(self.getInputCol())
-            if x.shape[0] < 1:
-                raise ValueError("fit requires at least one row")
-            lo = x.min(axis=0)
-            hi = x.max(axis=0)
+        from spark_rapids_ml_tpu.data.batches import streaming_source
+
+        source = streaming_source(dataset, 0)
+        if source is not None:
+            from spark_rapids_ml_tpu.data.batches import streamed_reduce
+
+            def minmax(acc, rows):
+                blo, bhi = rows.min(axis=0), rows.max(axis=0)
+                if acc is None:
+                    return blo, bhi
+                return np.minimum(acc[0], blo), np.maximum(acc[1], bhi)
+
+            with timer.phase("fit"):
+                lo, hi = streamed_reduce(source, minmax)
+        else:
+            frame = as_vector_frame(dataset, self.getInputCol())
+            with timer.phase("fit"):
+                x = frame.vectors_as_matrix(self.getInputCol())
+                if x.shape[0] < 1:
+                    raise ValueError("fit requires at least one row")
+                lo = x.min(axis=0)
+                hi = x.max(axis=0)
         model = MinMaxScalerModel(original_min=lo, original_max=hi)
         model.uid = self.uid
         model.copy_values_from(self)
@@ -132,12 +147,25 @@ class MaxAbsScaler(MaxAbsScalerParams):
 
     def fit(self, dataset) -> "MaxAbsScalerModel":
         timer = PhaseTimer()
-        frame = as_vector_frame(dataset, self.getInputCol())
-        with timer.phase("fit"):
-            x = frame.vectors_as_matrix(self.getInputCol())
-            if x.shape[0] < 1:
-                raise ValueError("fit requires at least one row")
-            max_abs = np.abs(x).max(axis=0)
+        from spark_rapids_ml_tpu.data.batches import streaming_source
+
+        source = streaming_source(dataset, 0)
+        if source is not None:
+            from spark_rapids_ml_tpu.data.batches import streamed_reduce
+
+            def absmax(acc, rows):
+                bm = np.abs(rows).max(axis=0)
+                return bm if acc is None else np.maximum(acc, bm)
+
+            with timer.phase("fit"):
+                max_abs = streamed_reduce(source, absmax)
+        else:
+            frame = as_vector_frame(dataset, self.getInputCol())
+            with timer.phase("fit"):
+                x = frame.vectors_as_matrix(self.getInputCol())
+                if x.shape[0] < 1:
+                    raise ValueError("fit requires at least one row")
+                max_abs = np.abs(x).max(axis=0)
         model = MaxAbsScalerModel(max_abs=max_abs)
         model.uid = self.uid
         model.copy_values_from(self)

@@ -177,7 +177,7 @@ class _ForestBase(RandomForestParams):
         from spark_rapids_ml_tpu.ops.forest_kernel import feature_importances
 
         model.feature_importances_ = feature_importances(
-            np.stack([np.asarray(f) for f in feats_l]),
+            np.asarray(ensemble.feature),
             np.stack([np.asarray(g) for g in gains_l]),
             d,
         )
@@ -198,6 +198,7 @@ class _ForestModelBase(RandomForestParams):
         self.ensemble_ = ensemble
         self.edges_ = edges
         self.classes_ = classes
+        self.feature_importances_ = None
 
     def _copy_internal_state(self, other) -> None:
         other.ensemble_ = self.ensemble_

@@ -59,6 +59,37 @@ class StandardScaler(StandardScalerParams):
 
     def fit(self, dataset) -> "StandardScalerModel":
         timer = PhaseTimer()
+        from spark_rapids_ml_tpu.data.batches import streaming_source
+
+        source = streaming_source(dataset, 0)
+        if source is not None:
+            # one host-f64 pass of (Σx, Σx², n): the one-pass identity is
+            # safe at f64 for scaler purposes (same acceptance as the
+            # host-streamed covariance path)
+            from spark_rapids_ml_tpu.data.batches import streamed_reduce
+
+            def moments(acc, rows):
+                s1, s2, n = acc if acc is not None else (
+                    np.zeros(rows.shape[1]), np.zeros(rows.shape[1]), 0
+                )
+                return (s1 + rows.sum(axis=0),
+                        s2 + (rows * rows).sum(axis=0),
+                        n + rows.shape[0])
+
+            with timer.phase("fit_kernel"):
+                s1, s2, n = streamed_reduce(source, moments)
+                if n < 2:
+                    raise ValueError(
+                        "StandardScaler requires at least 2 rows"
+                    )
+                mean = s1 / n
+                var = np.maximum((s2 - n * mean * mean) / (n - 1), 0.0)
+                std = np.sqrt(var)
+            model = StandardScalerModel(mean=mean, std=std)
+            model.copy_values_from(self)
+            model.fit_timings_ = timer.as_dict()
+            return model
+
         frame = as_vector_frame(dataset, self.getInputCol())
         with timer.phase("densify"):
             x = frame.vectors_as_matrix(self.getInputCol())

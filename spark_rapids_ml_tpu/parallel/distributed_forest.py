@@ -71,12 +71,15 @@ def distributed_forest_fit(
     classification: bool = False,
     seed: int = 0,
     dtype=jnp.float32,
-) -> Tuple[TreeEnsemble, np.ndarray, np.ndarray]:
-    """(ensemble, edges, classes) with rows sharded over ``mesh``.
+) -> Tuple[TreeEnsemble, np.ndarray, np.ndarray, np.ndarray]:
+    """(ensemble, edges, classes, split_gains) with rows sharded over
+    ``mesh``.
 
     Bootstrap weights are drawn on host per tree; padding rows carry
     weight 0 so they contribute to no histogram. ``classes`` is None for
-    regression.
+    regression; feed (ensemble.feature, split_gains) to
+    ``ops.forest_kernel.feature_importances`` for Spark-style
+    importances.
     """
     n_dev = int(np.prod(mesh.devices.shape))
     binned_np, edges = quantile_bins(x, n_bins)
@@ -103,23 +106,24 @@ def distributed_forest_fit(
     else:
         y_dev = jax.device_put(jnp.asarray(y_p, dtype=dtype), vec_shard)
 
-    feats_l, thrs_l, leaves_l = [], [], []
+    feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
     for _ in range(n_trees):
         w = rng.poisson(subsampling_rate, binned_p.shape[0]) * mask
         w_dev = jax.device_put(jnp.asarray(w, dtype=dtype), vec_shard)
         fm = jnp.asarray(
             np.ones((max_depth, d)), dtype=dtype
         )  # feature subsets: host-side choice mirrors the local fit
-        f, t, leaf, _g = _sharded_grow(
+        f, t, leaf, g = _sharded_grow(
             binned_dev, y_dev, w_dev, fm, max_depth, n_bins, min_leaf,
             len(classes) if classification else 0, mesh,
         )
         feats_l.append(np.asarray(f))
         thrs_l.append(np.asarray(t))
         leaves_l.append(np.asarray(leaf))
+        gains_l.append(np.asarray(g))
     ensemble = TreeEnsemble(
         feature=np.stack(feats_l),
         threshold=np.stack(thrs_l),
         leaf_value=np.stack(leaves_l),
     )
-    return ensemble, edges, classes
+    return ensemble, edges, classes, np.stack(gains_l)

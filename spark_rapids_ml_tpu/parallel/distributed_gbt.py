@@ -69,9 +69,11 @@ def distributed_gbt_fit(
     subsampling_rate: float = 1.0,
     seed: int = 0,
     dtype=jnp.float32,
-) -> Tuple[TreeEnsemble, np.ndarray, float]:
-    """(ensemble, bin_edges, init_margin) — the same triple the local GBT
-    model consumes, fitted with rows sharded over ``mesh``."""
+) -> Tuple[TreeEnsemble, np.ndarray, float, np.ndarray]:
+    """(ensemble, bin_edges, init_margin, split_gains) — the triple the
+    local GBT model consumes plus the per-node gains for
+    ``ops.forest_kernel.feature_importances``, fitted with rows sharded
+    over ``mesh``."""
     from spark_rapids_ml_tpu.models.gbt import boosting_loop, gbt_init_margin
 
     n_dev = int(np.prod(mesh.devices.shape))
@@ -105,10 +107,10 @@ def distributed_gbt_fit(
         return (np.asarray(ft), np.asarray(tt), np.asarray(leaf),
                 np.asarray(g_tree), np.asarray(leaf_ids_dev))
 
-    ensemble, _gains = boosting_loop(
+    ensemble, gains = boosting_loop(
         y_padded=y_p, mask=mask, n_real=n, init=init, max_iter=max_iter,
         step_size=step_size, classification=classification,
         subsampling_rate=subsampling_rate, rng=rng, max_depth=max_depth,
         grow_fn=grow_fn,
     )
-    return ensemble, edges, init
+    return ensemble, edges, init, gains

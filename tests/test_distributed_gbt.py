@@ -12,10 +12,11 @@ def test_distributed_gbt_regression_matches_local(rng):
     x = rng.normal(size=(400, 5))
     y = 2.0 * x[:, 0] - x[:, 1] + 0.05 * rng.normal(size=400)
     mesh = data_mesh(8)
-    ens, edges, init = distributed_gbt_fit(
+    ens, edges, init, gains = distributed_gbt_fit(
         x, y, mesh, max_iter=15, max_depth=3, step_size=0.2,
         dtype=np.float64,
     )
+    assert gains.shape == ens.feature.shape
     local = (
         GBTRegressor().setMaxIter(15).setMaxDepth(3).setStepSize(0.2)
         .fit(x, y)
@@ -32,7 +33,7 @@ def test_distributed_gbt_classification_quality(rng):
     x = rng.normal(size=(500, 4))
     y = ((x[:, 0] + x[:, 1] ** 2) > 0.8).astype(float)
     mesh = data_mesh(4)
-    ens, edges, init = distributed_gbt_fit(
+    ens, edges, init, _gains = distributed_gbt_fit(
         x, y, mesh, max_iter=25, max_depth=3, step_size=0.3,
         classification=True, dtype=np.float64,
     )

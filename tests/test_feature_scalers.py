@@ -108,3 +108,25 @@ def test_scalers_compose_in_pipeline(rng):
     model = pipe.fit(VectorFrame({"features": x, "label": y}))
     out = model.transform(VectorFrame({"features": x}))
     assert "prediction" in out.columns
+
+
+def test_scalers_streamed_match_inmemory(rng):
+    """Out-of-core scaler fits (chunk generators) match in-memory exactly."""
+    from spark_rapids_ml_tpu import MaxAbsScaler, MinMaxScaler, StandardScaler
+
+    x = rng.normal(size=(500, 6)) * np.array([1, 10, 0.1, 5, 2, 7.0])
+    chunks = lambda: (x[i:i + 123] for i in range(0, 500, 123))  # noqa: E731
+
+    mm_s = MinMaxScaler().fit(chunks)
+    mm_m = MinMaxScaler().fit(x)
+    np.testing.assert_array_equal(mm_s.original_min, mm_m.original_min)
+    np.testing.assert_array_equal(mm_s.original_max, mm_m.original_max)
+
+    ma_s = MaxAbsScaler().fit(chunks)
+    ma_m = MaxAbsScaler().fit(x)
+    np.testing.assert_array_equal(ma_s.max_abs, ma_m.max_abs)
+
+    ss_s = StandardScaler().fit(chunks)
+    ss_m = StandardScaler().setUseXlaDot(False).fit(x)
+    np.testing.assert_allclose(ss_s.mean, ss_m.mean, atol=1e-12)
+    np.testing.assert_allclose(ss_s.std, ss_m.std, atol=1e-10)

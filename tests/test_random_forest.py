@@ -195,7 +195,7 @@ def test_distributed_forest_matches_quality(rng):
     n = 803  # uneven: exercises padded zero-weight rows
     x = rng.uniform(-2, 2, size=(n, 4))
     y = np.sin(2 * x[:, 0]) + (x[:, 1] > 0) * 2.0
-    ens, edges, classes = distributed_forest_fit(
+    ens, edges, classes, _gains = distributed_forest_fit(
         x, y, mesh, n_trees=10, max_depth=5, dtype=jnp.float64
     )
     assert classes is None
@@ -216,7 +216,7 @@ def test_distributed_forest_matches_quality(rng):
 
     # classification over the mesh
     yc = (y > y.mean()).astype(np.float64)
-    ens_c, edges_c, classes_c = distributed_forest_fit(
+    ens_c, edges_c, classes_c, _gains_c = distributed_forest_fit(
         x, yc, mesh, n_trees=10, max_depth=5, classification=True,
         dtype=jnp.float64,
     )
