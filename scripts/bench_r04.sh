@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-4 patient chip-bench loop.
+#
+# Discipline (learned in round 3 after a self-inflicted multi-hour tunnel
+# wedge): ONE chip process at a time, never killed externally. Each probe
+# is allowed to take as long as it takes (a failing probe self-terminates
+# in ~25 min); on the first healthy probe we run the full evidence batch
+# sequentially in the same window, then exit. Poll /tmp/bench_r04/ for
+# progress; do NOT kill this script or anything it spawned.
+cd /root/repo || exit 1
+OUT=/tmp/bench_r04
+mkdir -p "$OUT"
+export PYTHONPATH=/root/repo:/root/.axon_site
+
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+
+for i in $(seq 1 40); do
+  echo "probe $i start: $(stamp)" >> "$OUT/status.log"
+  if python -c "import jax; d=jax.devices()[0]; print(d.platform, getattr(d,'device_kind',''))" \
+      > "$OUT/probe.log" 2>&1 && grep -q -v cpu "$OUT/probe.log"; then
+    echo "probe ok: $(stamp)" >> "$OUT/status.log"
+
+    echo "bench config4 start: $(stamp)" >> "$OUT/status.log"
+    BENCH_SKIP_PROBE=1 python bench.py \
+      > "$OUT/bench_config4.json" 2> "$OUT/bench_config4.err"
+    echo "bench config4 rc=$?: $(stamp)" >> "$OUT/status.log"
+
+    echo "bench_models start: $(stamp)" >> "$OUT/status.log"
+    python bench_models.py \
+      > "$OUT/bench_models.json" 2> "$OUT/bench_models.err"
+    echo "bench_models rc=$?: $(stamp)" >> "$OUT/status.log"
+
+    echo "bench config3 start: $(stamp)" >> "$OUT/status.log"
+    BENCH_SKIP_PROBE=1 BENCH_ROWS=1048576 python bench.py \
+      > "$OUT/bench_config3.json" 2> "$OUT/bench_config3.err"
+    echo "bench config3 rc=$?: $(stamp)" >> "$OUT/status.log"
+
+    echo "bench config2 start: $(stamp)" >> "$OUT/status.log"
+    BENCH_SKIP_PROBE=1 BENCH_ROWS=65536 BENCH_COLS=784 BENCH_K=50 BENCH_BATCH=65536 \
+      python bench.py > "$OUT/bench_config2.json" 2> "$OUT/bench_config2.err"
+    echo "bench config2 rc=$?: $(stamp)" >> "$OUT/status.log"
+
+    echo "pjrt smoke start: $(stamp)" >> "$OUT/status.log"
+    TPUML_PJRT_SMOKE=1 python -m pytest tests/test_native.py -k pjrt -q \
+      > "$OUT/pjrt_smoke.log" 2>&1
+    echo "pjrt smoke rc=$?: $(stamp)" >> "$OUT/status.log"
+
+    if [ -f scripts/bench_scale.py ]; then
+      echo "scale run start: $(stamp)" >> "$OUT/status.log"
+      python scripts/bench_scale.py \
+        > "$OUT/bench_scale.json" 2> "$OUT/bench_scale.err"
+      echo "scale run rc=$?: $(stamp)" >> "$OUT/status.log"
+    fi
+
+    echo "ALL DONE: $(stamp)" >> "$OUT/status.log"
+    touch "$OUT/done"
+    exit 0
+  fi
+  echo "probe $i failed: $(stamp)" >> "$OUT/status.log"
+  sleep 360
+done
+echo "gave up after 40 probes: $(stamp)" >> "$OUT/status.log"
