@@ -37,13 +37,17 @@ from spark_rapids_ml_tpu.utils.platform import (  # noqa: E402
 
 
 def _probe_with_backoff():
-    """Bounded accelerator probes with backoff: a wedged device tunnel can
-    take minutes to release a stale claim, so one 120s probe is not enough
-    evidence to give up on the chip (round-1 lesson)."""
+    """ONE bounded accelerator probe by default (≤60s), so a wedged tunnel
+    costs a minute, not the whole bench budget. Round 3's 3×150s probes plus
+    backoff waits burned 14 minutes and the driver's 20-minute cap then
+    killed the CPU fallback mid-run — the round recorded *nothing* (judge
+    task #2). Patient contexts that want to wait out a wedge should use the
+    retry-loop script (`scripts/bench_r04.sh`) with BENCH_SKIP_PROBE=1, not
+    probe attempts."""
     from spark_rapids_ml_tpu.utils.health import check_devices_subprocess
 
-    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
-    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 1))
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 60))
     probe = None
     for i in range(attempts):
         probe = check_devices_subprocess(timeout_seconds=timeout)
@@ -64,12 +68,44 @@ def _probe_with_backoff():
     return probe
 
 
+def _best_known_chip_record():
+    """Most recent committed real-chip record, for the stale-marker field
+    on CPU fallbacks. Reads the repo's committed measurement files; never
+    raises (a bench must print its line no matter what)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.path.join(here, "BENCH_MEASURED_r04.json"),
+        os.path.join(here, "BENCH_MEASURED.json"),
+    ]
+    for path in candidates:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            head = data.get("headline") or {}
+            if head.get("platform") == "tpu":
+                return {
+                    "stale": True,
+                    "source": os.path.basename(path),
+                    "measured_utc": head.get("measured_utc"),
+                    "metric": head.get("metric"),
+                    "value": head.get("value"),
+                    "unit": head.get("unit", "rows/sec"),
+                    "mfu": head.get("mfu"),
+                }
+        except Exception:  # noqa: BLE001 - fallback metadata only
+            continue
+    return None
+
+
 def main() -> None:
     # Default workload is the BASELINE.md north star (config 4, per-chip):
     # 10M×4096 k=256. The eigh finalize is a fixed ~0.9s; at 1M rows it is
     # 60% of wall-clock, at 10M it amortizes to ~15% — the north-star row
     # count measures the steady-state the metric is defined on.
     rows = int(os.environ.get("BENCH_ROWS", 10_485_760))
+    rows_requested = rows  # metric names the CONFIGURED workload even if
+    # a CPU fallback shrinks the executed row count (measured_rows +
+    # truncated carry the run's actual circumstances)
     cols = int(os.environ.get("BENCH_COLS", 4096))
     k = int(os.environ.get("BENCH_K", 256))
     batch = int(os.environ.get("BENCH_BATCH", 65536))
@@ -87,15 +123,26 @@ def main() -> None:
     else:
         probe = _probe_with_backoff()
         fallback = not probe.healthy or probe.platform == "cpu"
+    fallback_reason = None
     if fallback:
         # unreachable accelerator OR a silent JAX cpu fallback (no plugin
-        # installed): either way CPU can't chew 1M×4096 in bounded time
+        # installed): either way CPU can't chew the configured row count in
+        # bounded time — shrink the workload so the run ALWAYS finishes well
+        # inside the driver's budget and a parsed JSON line always lands
+        # (round 3's unshrunk CPU fallback ran past the 20-minute cap and
+        # recorded nothing).
         if probe is not None and not probe.healthy:
+            fallback_reason = probe.error
             print(
                 f"# accelerator unreachable ({probe.error}); benching on CPU",
                 flush=True,
             )
             os.environ["JAX_PLATFORMS"] = "cpu"
+        else:
+            fallback_reason = "jax platform is cpu (no accelerator plugin)"
+        rows = min(rows, int(os.environ.get("BENCH_CPU_FALLBACK_ROWS", 131072)))
+        max_seconds = min(max_seconds, 120.0)
+        cpu_rows = min(cpu_rows, 32768)
 
     import jax
 
@@ -133,7 +180,7 @@ def main() -> None:
         device,
     )
     n_steps = max(1, rows // batch)
-    configured_rows = n_steps * batch
+    configured_rows = max(1, rows_requested // batch) * batch
 
     # warm-up: compile update + finalize once (host read = true barrier).
     # update_stats_auto is the PRODUCTION accumulate: on TPU with aligned
@@ -165,7 +212,7 @@ def main() -> None:
             break
     accumulate_seconds = time.perf_counter() - t0
     measured_rows = steps_done * batch
-    truncated = steps_done < n_steps
+    truncated = measured_rows < configured_rows
 
     # Headline finalize: svdSolver='auto' through the residual gate
     # (randomized O(n²k) subspace iteration when k ≪ n, verified on device
@@ -194,17 +241,20 @@ def main() -> None:
     # (svdSolver='eigh', exact per-vector parity path). Recorded so every
     # round keeps the auto-vs-eigh evidence.
     finalize_eigh_seconds = None
-    try:
-        r = finalize_stats(stats, k, solver="eigh")
-        np.asarray(r.components)  # compile + fence
-        t0 = time.perf_counter()
-        r = finalize_stats(stats, k, solver="eigh")
-        rc = np.asarray(r.components)
-        finalize_eigh_seconds = round(time.perf_counter() - t0, 3)
-        assert np.isfinite(rc).all()
-    except Exception as exc:  # noqa: BLE001 - secondary arm must not kill bench
-        print(f"# eigh finalize arm failed: {type(exc).__name__}: {exc}",
-              flush=True)
+    # (skipped on CPU fallback: two extra dense eigensolves of a cols²
+    # matrix don't fit the shrunken budget)
+    if not fallback:
+        try:
+            r = finalize_stats(stats, k, solver="eigh")
+            np.asarray(r.components)  # compile + fence
+            t0 = time.perf_counter()
+            r = finalize_stats(stats, k, solver="eigh")
+            rc = np.asarray(r.components)
+            finalize_eigh_seconds = round(time.perf_counter() - t0, 3)
+            assert np.isfinite(rc).all()
+        except Exception as exc:  # noqa: BLE001 - arm must not kill bench
+            print(f"# eigh finalize arm failed: {type(exc).__name__}: {exc}",
+                  flush=True)
 
     fit_seconds = accumulate_seconds + finalize_seconds
     rows_per_sec = measured_rows / fit_seconds
@@ -281,27 +331,33 @@ def main() -> None:
     cpu_seconds_projected = gram_seconds * (measured_rows / n) + eigh_seconds
     cpu_rows_per_sec = measured_rows / cpu_seconds_projected
 
-    print(
-        json.dumps(
-            {
-                "metric": f"PCA.fit rows/sec/chip ({configured_rows}x{cols}, k={k})",
-                "value": round(rows_per_sec, 1),
-                "unit": "rows/sec",
-                "vs_baseline": round(rows_per_sec / cpu_rows_per_sec, 2),
-                "platform": platform,
-                "device_kind": str(device_kind),
-                "measured_rows": measured_rows,
-                "truncated": truncated,
-                "mfu": mfu,
-                "fit_seconds": round(fit_seconds, 2),
-                "finalize_seconds": round(finalize_seconds, 3),
-                "finalize_solver": solver_used,
-                "finalize_eigh_seconds": finalize_eigh_seconds,
-                "pallas_rows_per_sec": pallas_rows_per_sec,
-                "xla_rows_per_sec": xla_rows_per_sec,
-            }
-        )
-    )
+    record = {
+        "metric": f"PCA.fit rows/sec/chip ({configured_rows}x{cols}, k={k})",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / cpu_rows_per_sec, 2),
+        "platform": platform,
+        "device_kind": str(device_kind),
+        "measured_rows": measured_rows,
+        "truncated": truncated,
+        "mfu": mfu,
+        "fit_seconds": round(fit_seconds, 2),
+        "finalize_seconds": round(finalize_seconds, 3),
+        "finalize_solver": solver_used,
+        "finalize_eigh_seconds": finalize_eigh_seconds,
+        "pallas_rows_per_sec": pallas_rows_per_sec,
+        "xla_rows_per_sec": xla_rows_per_sec,
+    }
+    if fallback:
+        # A CPU-fallback number is visibly a CPU number; additionally carry
+        # the most recent COMMITTED chip record (marked stale) so the driver
+        # artifact always holds the best-known chip truth even through a
+        # tunnel outage (judge r3 task #2).
+        record["fallback_reason"] = fallback_reason
+        best = _best_known_chip_record()
+        if best is not None:
+            record["best_known_chip_record"] = best
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
