@@ -24,6 +24,13 @@ with C = 1/(n·λ) is the oracle in tests.
 std-scaled features — so the L2 penalty applies to the scaled
 coefficients — and returns coefficients on the original scale, matching
 Spark's semantics.
+
+Output-shape convention: this LOCAL model's ``rawPredictionCol`` holds
+the scalar margin x·w + b (convenient for columnar frames and OneVsRest
+scoring), whereas Spark's ``LinearSVCModel`` emits the 2-vector
+``[-margin, margin]``. The DataFrame front-end
+(``spark/adapter.py::_SVCAdapterModel``) converts to Spark's 2-vector
+form, so pyspark-facing output matches Spark exactly.
 """
 
 from __future__ import annotations

@@ -29,6 +29,7 @@ _ENV_NPROC = "SPARK_RAPIDS_ML_TPU_NUM_PROCESSES"
 _ENV_PID = "SPARK_RAPIDS_ML_TPU_PROCESS_ID"
 
 _initialized = False
+_initialized_coordinator: Optional[str] = None
 
 
 def initialize_multihost(
@@ -42,13 +43,37 @@ def initialize_multihost(
     single-host (no coordinator configured anywhere — the common local
     case, where calling ``jax.distributed.initialize`` would fail).
     """
-    global _initialized
+    global _initialized, _initialized_coordinator
     import jax
 
     # Idempotency check must NOT touch backend-initializing APIs
     # (jax.process_count() would create the backend and make a later
     # initialize() impossible); is_initialized() only reads client state.
     if _initialized or jax.distributed.is_initialized():
+        # Reuse is only safe when it is the SAME job: a second collective
+        # fit in a long-lived executor process arrives with a freshly
+        # picked driver coordinator, and silently reusing the first job's
+        # runtime would desynchronize the barrier (advisor r3). Surface
+        # the mismatch instead of hanging.
+        requested = coordinator_address or os.environ.get(_ENV_COORD)
+        if requested is not None:
+            if _initialized_coordinator is None:
+                # runtime was initialized outside this module (or from
+                # ambient pod metadata): adopt the first requested
+                # coordinator as the session's, so a LATER different
+                # request is caught as a true conflict
+                _initialized_coordinator = requested
+            elif requested != _initialized_coordinator:
+                raise RuntimeError(
+                    "jax.distributed is already initialized in this "
+                    "process with coordinator "
+                    f"{_initialized_coordinator!r}, but this fit requests "
+                    f"{requested!r}. The distributed runtime joins once "
+                    "per process lifetime — either pre-set "
+                    f"{_ENV_COORD} to one stable coordinator for the "
+                    "whole session, or use fresh executor processes per "
+                    "collective fit (spark.python.worker.reuse=false)."
+                )
         _initialized = True
         return jax.process_count() > 1
 
@@ -83,6 +108,7 @@ def initialize_multihost(
             raise
         return False
     _initialized = True
+    _initialized_coordinator = coordinator_address
     return jax.process_count() > 1
 
 

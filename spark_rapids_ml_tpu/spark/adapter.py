@@ -25,6 +25,7 @@ Works identically against real pyspark and the in-repo local engine
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional, Type
 
 import numpy as np
@@ -52,6 +53,51 @@ __all__ = [
     "StandardScaler",
     "TruncatedSVD",
 ]
+
+
+# Driver-collect envelope (rows). The generic adapter materializes the
+# selected columns on the driver — correct for the non-decomposable fits
+# it serves (e.g. UMAP's spectral init) but bounded by driver memory, the
+# same envelope convention the local models document (models/dbscan.py).
+# Families with executor statistics planes (PCA/LinReg/LogReg/KMeans/
+# NaiveBayes/RandomForest/GBT in spark/estimator.py) never pass through
+# here and have no such bound.
+_COLLECT_WARN_ROWS = int(
+    os.environ.get("SPARK_RAPIDS_ML_TPU_COLLECT_WARN_ROWS", 1_000_000)
+)
+_COLLECT_MAX_ROWS = int(
+    os.environ.get("SPARK_RAPIDS_ML_TPU_COLLECT_MAX_ROWS", 10_000_000)
+)
+
+
+def _check_collect_envelope(dataset, est_name: str) -> None:
+    """Count rows before a driver collect; warn past the soft envelope,
+    raise past the hard one (both configurable via env)."""
+    try:
+        n = int(dataset.count())
+    except Exception:  # noqa: BLE001 - a frame without count() collects as-is
+        return
+    if n > _COLLECT_MAX_ROWS:
+        raise ValueError(
+            f"{est_name}.fit would collect {n:,} rows onto the driver "
+            f"(envelope: {_COLLECT_MAX_ROWS:,}, "
+            "SPARK_RAPIDS_ML_TPU_COLLECT_MAX_ROWS). At this scale use a "
+            "statistics-plane family (PCA, LinearRegression, "
+            "LogisticRegression, KMeans, NaiveBayes, RandomForest, GBT) "
+            "whose executors reduce partials instead of shipping rows, "
+            "or downsample the DataFrame first."
+        )
+    if n > _COLLECT_WARN_ROWS:
+        import warnings
+
+        warnings.warn(
+            f"{est_name}.fit collects {n:,} rows onto the driver "
+            f"(soft envelope {_COLLECT_WARN_ROWS:,}; hard cap "
+            f"{_COLLECT_MAX_ROWS:,} via "
+            "SPARK_RAPIDS_ML_TPU_COLLECT_MAX_ROWS)",
+            ResourceWarning,
+            stacklevel=3,
+        )
 
 
 def _densify(series) -> np.ndarray:
@@ -109,6 +155,7 @@ class _AdapterEstimator(Estimator):
     def _collect_frame(self, dataset):
         from spark_rapids_ml_tpu.data.frame import as_vector_frame
 
+        _check_collect_envelope(dataset, type(self).__name__)
         fcol = self._local.getInputCol()
         cols = [fcol]
         lcol = None
@@ -155,6 +202,29 @@ class _AdapterEstimator(Estimator):
         return out
 
 
+def _host_fitted_state(model) -> None:
+    """Convert a fitted model's device-resident jax Arrays to host numpy,
+    in place. The adapter ships fitted models to executors by cloudpickle
+    closure; a device-resident attribute (e.g. a forest's stacked
+    ``ensemble_``) would force a device sync on the driver at pickle time
+    and make every executor worker initialize an accelerator backend just
+    to deserialize — a hang risk on single-claim device tunnels. Models
+    re-stage to their own device lazily on first use."""
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 - no jax, nothing device-resident
+        return
+
+    def to_host(v):
+        return np.asarray(v) if isinstance(v, jax.Array) else v
+
+    for name, value in list(vars(model).items()):
+        try:
+            vars(model)[name] = jax.tree_util.tree_map(to_host, value)
+        except Exception:  # noqa: BLE001 - unknown containers stay as-is
+            continue
+
+
 class _AdapterModel(Model):
     """Wraps a fitted local model; ``transform`` ships it to executors by
     closure and appends the model's own output column per Arrow batch."""
@@ -166,6 +236,7 @@ class _AdapterModel(Model):
 
     def __init__(self, local_model):
         super().__init__()
+        _host_fitted_state(local_model)
         self._local = local_model
 
     def __getattr__(self, attr: str):
@@ -272,10 +343,57 @@ class _ClassifierAdapterModel(_AdapterModel):
         return result.withColumn(pred_col, pred_udf(result[proba_col]))
 
 
+class _SVCAdapterModel(_AdapterModel):
+    """LinearSVC variant: Spark's ``LinearSVCModel`` emits rawPrediction
+    as the 2-vector ``[-margin, margin]`` (one score per class); the local
+    model keeps the scalar margin (documented there). ONE inference pass
+    computes the raw vector; the prediction column derives from it with a
+    cheap margin-vs-threshold UDF. ``''`` in either column param disables
+    that column (Spark convention)."""
+
+    def _transform(self, dataset):
+        local = self._local
+        in_col = local.getInputCol()
+        raw_col = local.get_or_default("rawPredictionCol")
+        pred_col = local.get_or_default(self._out_col_param)
+        thr = float(local.get_or_default("threshold"))
+
+        if not raw_col:
+            # no raw column requested: single prediction-only pass
+            return super()._transform(dataset)
+
+        @pandas_udf(returnType=VectorUDT())
+        def raw_udf(series):
+            import pandas as pd
+
+            x = _densify(series)
+            margins = local.decision_function(x)
+            return pd.Series(
+                [DenseVector([-float(m), float(m)]) for m in margins]
+            )
+
+        result = dataset.withColumn(raw_col, raw_udf(dataset[in_col]))
+        if not pred_col:
+            return result
+
+        @pandas_udf(returnType="double")
+        def pred_udf(series):
+            import pandas as pd
+
+            return pd.Series([
+                1.0 if float(v.toArray()[1]) > thr else 0.0 for v in series
+            ])
+
+        return result.withColumn(pred_col, pred_udf(result[raw_col]))
+
+
 def _make_pair(name, local_est, local_model, *, needs_label,
                out_col_param="predictionCol", out_kind="double",
-               classifier=False, proba_scalar=False, aliases=None, doc=""):
-    base = _ClassifierAdapterModel if classifier else _AdapterModel
+               classifier=False, proba_scalar=False, aliases=None, doc="",
+               model_base=None):
+    base = model_base or (
+        _ClassifierAdapterModel if classifier else _AdapterModel
+    )
     model_cls = type(
         f"{name}Model",
         (base,),
@@ -363,6 +481,9 @@ NaiveBayesModel = type(
 )
 LinearSVC, LinearSVCModel = _make_pair(
     "LinearSVC", _LSVC, _LSVC_M, needs_label=True,
+    model_base=_SVCAdapterModel,
+    doc="rawPrediction is Spark's 2-vector [-margin, margin]; prediction "
+        "follows the margin-vs-threshold rule.",
 )
 StandardScaler, StandardScalerModel = _make_pair(
     "StandardScaler", _LSS, _LSS_M, needs_label=False,

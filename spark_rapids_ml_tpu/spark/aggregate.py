@@ -365,9 +365,9 @@ def partition_label_values(
         if not np.isfinite(y).all():
             raise ValueError("labels must be finite")
         seen.update(np.unique(y).tolist())
-        if len(seen) > 101:
+        if len(seen) > 100:
             raise ValueError(
-                f"more than 100 distinct label values: looks like a "
+                "more than 100 distinct label values: looks like a "
                 "continuous target, not classes (multinomial supports "
                 "up to 100)"
             )

@@ -593,13 +593,26 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
             classes = np.asarray(sorted({
                 v for r in label_rows for v in r["labels"]
             }))
-            if classes.size > 2:
-                if classes.size > 100:
-                    raise ValueError(
-                        f"{classes.size} distinct label values: looks "
-                        "like a continuous target, not classes "
-                        "(multinomial supports up to 100)"
-                    )
+            if classes.size > 100:
+                raise ValueError(
+                    f"{classes.size} distinct label values: looks "
+                    "like a continuous target, not classes "
+                    "(multinomial supports up to 100)"
+                )
+            if classes.size < 2:
+                # degenerate single-class data gets a clear driver-side
+                # error (whatever the label value is) instead of a
+                # meaningless fit or an opaque executor failure
+                raise ValueError(
+                    f"need at least 2 distinct label values to fit a "
+                    f"classifier, got {classes.tolist()}"
+                )
+            if classes.size > 2 or not set(classes.tolist()) <= {0.0, 1.0}:
+                # Two classes that are NOT {0,1} (e.g. {1,2}) take the
+                # softmax plane, which class-indexes arbitrary label
+                # values like Spark does — sending them down the binary
+                # path would only surface as an opaque executor-task
+                # _check_binary failure (advisor r3).
                 return self._fit_multinomial(df, fcol, lcol, classes, n)
 
             w = np.zeros(n)

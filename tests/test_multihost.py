@@ -51,3 +51,21 @@ def test_global_mesh_and_array_assembly(rng):
     # and the global mesh drives the standard distributed fit
     res = distributed_pca_fit(x, 2, mesh)
     assert np.asarray(res.components).shape == (4, 2)
+
+
+def test_initialize_rejects_coordinator_mismatch(monkeypatch):
+    """A long-lived executor process that already joined one distributed
+    job must not silently reuse it for a fit that requests a different
+    coordinator (advisor r3): the mismatch raises with a clear message."""
+    import pytest
+
+    from spark_rapids_ml_tpu.parallel import multihost as mh
+
+    monkeypatch.setattr(mh, "_initialized", True)
+    monkeypatch.setattr(mh, "_initialized_coordinator", "hostA:1234")
+    with pytest.raises(RuntimeError, match="already initialized"):
+        mh.initialize_multihost(coordinator_address="hostB:9999")
+    # the SAME coordinator is idempotent reuse, not a conflict
+    assert mh.initialize_multihost(
+        coordinator_address="hostA:1234"
+    ) in (True, False)

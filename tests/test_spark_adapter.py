@@ -203,3 +203,66 @@ def test_probability_column_suppression(spark, rng):
     out = rf.transform(df)
     assert "probability" not in out.columns and "" not in out.columns
     assert "prediction" in out.columns
+
+
+def test_linear_svc_raw_prediction_vector(spark, rng):
+    """Spark parity: LinearSVCModel emits rawPrediction as the 2-vector
+    [-margin, margin]; the prediction column follows the
+    margin-vs-threshold rule (advisor r3). The local model keeps the
+    scalar margin — the front-end converts."""
+    x = rng.normal(size=(200, 4))
+    w = np.array([1.0, -2.0, 0.5, 0.0])
+    y = (x @ w > 0).astype(float)
+    df = _df(spark, x, y)
+    model = LinearSVC(regParam=0.01).fit(df)
+    out = model.transform(df).collect()
+    raw = np.stack([r["rawPrediction"].toArray() for r in out])
+    pred = np.asarray([r["prediction"] for r in out])
+    assert raw.shape == (200, 2)
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1])
+    np.testing.assert_array_equal(pred, (raw[:, 1] > 0.0).astype(float))
+    margins = model._local.decision_function(x)
+    np.testing.assert_allclose(raw[:, 1], margins, atol=1e-12)
+
+
+def test_linear_svc_raw_suppression(spark, rng):
+    x = rng.normal(size=(80, 3))
+    y = (x[:, 0] > 0).astype(float)
+    df = _df(spark, x, y)
+    model = LinearSVC(regParam=0.01).fit(df)
+    model.setRawPredictionCol("")
+    out = model.transform(df)
+    assert "rawPrediction" not in out.columns and "" not in out.columns
+    assert "prediction" in out.columns
+
+
+def test_collect_envelope_guard(spark, rng, monkeypatch):
+    """The generic adapter's driver collect is envelope-guarded: warn past
+    the soft row cap, raise past the hard one (VERDICT r3 #6)."""
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+
+    x = rng.normal(size=(60, 3))
+    y = (x[:, 0] > 0).astype(float)
+    df = _df(spark, x, y)
+    monkeypatch.setattr(adapter_mod, "_COLLECT_MAX_ROWS", 50)
+    with pytest.raises(ValueError, match="onto the driver"):
+        LinearSVC().fit(df)
+    monkeypatch.setattr(adapter_mod, "_COLLECT_MAX_ROWS", 10_000)
+    monkeypatch.setattr(adapter_mod, "_COLLECT_WARN_ROWS", 50)
+    with pytest.warns(ResourceWarning):
+        LinearSVC(regParam=0.01).fit(df)
+
+
+def test_fitted_state_is_host_resident(spark, rng):
+    """Adapter models ship to executors by cloudpickle closure, so fitted
+    state must be host numpy — a device-resident jax Array would force
+    backend init in every executor worker at unpickle time (advisor r3)."""
+    import jax
+
+    x = rng.normal(size=(120, 5))
+    y = (x[:, 0] > 0).astype(float)
+    df = _df(spark, x, y)
+    model = RandomForestClassifier(numTrees=5, maxDepth=3, seed=1).fit(df)
+    leaves = jax.tree_util.tree_leaves(vars(model._local))
+    offenders = [type(v) for v in leaves if isinstance(v, jax.Array)]
+    assert not offenders, offenders

@@ -383,3 +383,84 @@ def test_logreg_front_end_multinomial_persistence(spark, rng, tmp_path):
     np.testing.assert_array_equal(
         loaded.classes_.toArray(), model.classes_.toArray()
     )
+
+
+def test_logreg_auto_two_nonstandard_labels(spark, rng):
+    """family='auto' with two distinct labels that are NOT {0,1} (e.g.
+    {1,2}) class-indexes through the softmax plane instead of failing
+    opaquely inside executor tasks (advisor r3)."""
+    n, d = 300, 4
+    w = np.array([1.5, -2.0, 0.5, 0.0])
+    x = rng.normal(size=(n, d))
+    y = np.where(x @ w > 0, 2.0, 1.0)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    model = LogisticRegression(regParam=0.02).fit(df)
+    out = model.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    assert set(np.unique(pred)) <= {1.0, 2.0}
+    assert (pred == y).mean() > 0.9
+
+
+def test_logreg_auto_single_class_raises(spark, rng):
+    """Degenerate single-class data with a non-{0,1} label gets a clear
+    driver-side error before any executor job launches."""
+    x = rng.normal(size=(50, 3))
+    y = np.full(50, 7.0)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    with pytest.raises(ValueError, match="at least 2 distinct"):
+        LogisticRegression().fit(df)
+
+
+def test_forest_plane_never_collects_rows(spark, rng, monkeypatch):
+    """VERDICT r3 #3 done-bar: RF/GBT DataFrame fits run on the executor
+    statistics plane — the driver-collect path must never fire."""
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+    from spark_rapids_ml_tpu.spark import GBTRegressor, RandomForestClassifier
+
+    def boom(self, dataset):
+        raise AssertionError("driver-collect fired on a plane family")
+
+    monkeypatch.setattr(
+        adapter_mod._AdapterEstimator, "_collect_frame", boom
+    )
+    x = rng.normal(size=(240, 5))
+    y = (x[:, 0] > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    m = RandomForestClassifier(numTrees=6, maxDepth=3, seed=1).fit(df)
+    pred = np.asarray(
+        [r["prediction"] for r in m.transform(df).collect()]
+    )
+    assert (pred == y).mean() > 0.85
+
+    y2 = x[:, 0] - 0.5 * x[:, 1]
+    df2 = _vector_df(spark, x, extra_cols=[("label", y2.tolist())])
+    g = GBTRegressor(maxIter=10, maxDepth=2, seed=2).fit(df2)
+    pred2 = np.asarray(
+        [r["prediction"] for r in g.transform(df2).collect()]
+    )
+    assert np.corrcoef(pred2, y2)[0, 1] > 0.9
+
+
+def test_forest_plane_two_worker_processes(rng):
+    """The executor-side tree plane with REAL separate worker processes:
+    partitions histogram in their own executors; the driver only reduces
+    (C, nodes, d, bins) partials and broadcasts splits."""
+    spark = LocalSparkSession(
+        n_partitions=2,
+        executors="process",
+        executor_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        },
+    )
+    from spark_rapids_ml_tpu.spark import RandomForestRegressor
+
+    rng_ = np.random.default_rng(7)
+    x = rng_.normal(size=(400, 6))
+    y = 1.5 * x[:, 0] - x[:, 2] + 0.05 * rng_.normal(size=400)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    m = RandomForestRegressor(numTrees=8, maxDepth=4, seed=5).fit(df)
+    pred = np.asarray(
+        [r["prediction"] for r in m.transform(df).collect()]
+    )
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
