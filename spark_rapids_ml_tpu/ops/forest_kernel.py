@@ -98,6 +98,64 @@ def _channel_histograms(node_oh, bin_oh, channels):
     return jnp.stack([one(channels[:, c]) for c in range(channels.shape[1])])
 
 
+def variance_gain_fn(h_l, h_t):
+    """Regression split criterion from (count, Σy, Σy²) channel
+    histograms: gain = SSE(parent) − SSE(left) − SSE(right)."""
+
+    def sse(h):
+        c, s, q = h[0], h[1], h[2]
+        return q - (s * s) / jnp.maximum(c, 1e-12)
+
+    return sse(h_t) - sse(h_l) - sse(h_t - h_l)
+
+
+def gini_gain_fn(h_l, h_t):
+    """Classification split criterion from per-class weighted-count
+    channel histograms: Gini impurity mass reduction."""
+
+    def gini_mass(h):  # Σ n·gini = n − Σ_k n_k²/n
+        total = jnp.sum(h, axis=0)
+        return total - jnp.sum(h * h, axis=0) / jnp.maximum(total, 1e-12)
+
+    return gini_mass(h_t) - gini_mass(h_l) - gini_mass(h_t - h_l)
+
+
+def level_split(
+    h, gain_fn, count_channel_slice, feat_mask_level, min_leaf, n_bins
+):
+    """Split selection for ONE level from its fully-reduced channel
+    histograms ``h`` (C, nodes, d, bins): cumulative-sum scan over bins,
+    validity masking, argmax over (feature, bin) per node. Returns
+    (best_feature, best_threshold, kept_gain); no-positive-gain nodes
+    become pass-through (threshold = n_bins routes every sample LEFT).
+
+    This is the ONE split-selection implementation: the in-kernel grower
+    (``_grow_tree``) calls it per compiled level step, and the Spark
+    statistics plane (``spark/forest_plane.py``) calls it on the driver
+    over executor-reduced histograms — selection can never diverge
+    between the local, mesh-distributed, and DataFrame fits."""
+    n_nodes, d = h.shape[1], h.shape[2]
+    h_l = jnp.cumsum(h, axis=3)  # stats of LEFT child if split at bin b
+    h_t = h_l[..., -1:]
+    gain = gain_fn(h_l, h_t)
+    c_l = h_l[count_channel_slice].sum(axis=0)
+    c_t = h_t[count_channel_slice].sum(axis=0)
+    valid = (c_l >= min_leaf) & (c_t - c_l >= min_leaf)
+    valid &= feat_mask_level[None, :, None] > 0
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(n_nodes, d * n_bins)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    bf = (best // n_bins).astype(jnp.int32)
+    bt = (best % n_bins).astype(jnp.int32)
+    # no-positive-gain nodes become pass-through (threshold = n_bins
+    # sends every sample LEFT; the left subtree inherits the node)
+    bt = jnp.where(best_gain > 1e-12, bt, n_bins)
+    bf = jnp.where(best_gain > 1e-12, bf, 0)
+    kept = jnp.where(best_gain > 1e-12, best_gain, 0.0)
+    return bf, bt, kept
+
+
 def _grow_tree(
     binned, channels, count_channel_slice, gain_fn, feat_mask,
     max_depth, n_bins, min_leaf, axis_name=None,
@@ -135,29 +193,14 @@ def _grow_tree(
         )
         if axis_name is not None:
             h = lax.psum(h, axis_name)
-        h_l = jnp.cumsum(h, axis=3)  # stats of LEFT child if split at bin b
-        h_t = h_l[..., -1:]
-        gain = gain_fn(h_l, h_t)
-        c_l = h_l[count_channel_slice].sum(axis=0)
-        c_t = h_t[count_channel_slice].sum(axis=0)
-        valid = (c_l >= min_leaf) & (c_t - c_l >= min_leaf)
-        valid &= feat_mask[level][None, :, None] > 0
-        gain = jnp.where(valid, gain, -jnp.inf)
-        flat = gain.reshape(n_nodes, d * n_bins)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bf = (best // n_bins).astype(jnp.int32)
-        bt = (best % n_bins).astype(jnp.int32)
-        # no-positive-gain nodes become pass-through (threshold = n_bins
-        # sends every sample LEFT; the left subtree inherits the node)
-        bt = jnp.where(best_gain > 1e-12, bt, n_bins)
-        bf = jnp.where(best_gain > 1e-12, bf, 0)
+        bf, bt, kept_gain = level_split(
+            h, gain_fn, count_channel_slice, feat_mask[level],
+            min_leaf, n_bins,
+        )
         feats = lax.dynamic_update_slice(feats, bf, (base,))
         thrs = lax.dynamic_update_slice(thrs, bt, (base,))
         gains = lax.dynamic_update_slice(
-            gains,
-            jnp.where(best_gain > 1e-12, best_gain, 0.0).astype(dtypef),
-            (base,),
+            gains, kept_gain.astype(dtypef), (base,)
         )
         x_bin = jnp.take_along_axis(
             binned, bf[node - base][:, None], axis=1
@@ -198,15 +241,8 @@ def grow_tree_regression(
     """
     channels = jnp.stack([w, w * y, w * y * y], axis=1)
 
-    def gain_fn(h_l, h_t):
-        def sse(h):
-            c, s, q = h[0], h[1], h[2]
-            return q - (s * s) / jnp.maximum(c, 1e-12)
-
-        return sse(h_t) - sse(h_l) - sse(h_t - h_l)
-
     feats, thrs, node, gains = _grow_tree(
-        binned, channels, slice(0, 1), gain_fn, feat_mask,
+        binned, channels, slice(0, 1), variance_gain_fn, feat_mask,
         max_depth, n_bins, min_leaf, axis_name,
     )
     n_leaves = 2 ** max_depth
@@ -248,15 +284,8 @@ def grow_tree_classification(
     importances). ``axis_name``: see ``_grow_tree``."""
     channels = y_onehot * w[:, None]  # (n, C): per-class weighted counts
 
-    def gain_fn(h_l, h_t):
-        def gini_mass(h):  # Σ n·gini = n − Σ_k n_k²/n
-            total = jnp.sum(h, axis=0)
-            return total - jnp.sum(h * h, axis=0) / jnp.maximum(total, 1e-12)
-
-        return gini_mass(h_t) - gini_mass(h_l) - gini_mass(h_t - h_l)
-
     feats, thrs, node, gains = _grow_tree(
-        binned, channels, slice(0, n_classes), gain_fn, feat_mask,
+        binned, channels, slice(0, n_classes), gini_gain_fn, feat_mask,
         max_depth, n_bins, min_leaf, axis_name,
     )
     n_leaves = 2 ** max_depth

@@ -26,16 +26,22 @@ _PYSPARK_CLASSES = (
     "NaiveBayes",
 )
 
+# tree-ensemble front-ends (spark/forest_estimator.py): fits run on the
+# executor statistics plane (per-level histogram partials), never
+# collecting rows to the driver; transform stays the adapter pandas_udf
+_FOREST_PLANE_CLASSES = (
+    "RandomForestClassifier",
+    "RandomForestRegressor",
+    "GBTClassifier",
+    "GBTRegressor",
+)
+
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
 # pandas_udf transform for the non-sufficient-statistics families
 _ADAPTER_CLASSES = (
-    "RandomForestClassifier",
     "RandomForestClassifierModel",
-    "RandomForestRegressor",
     "RandomForestRegressorModel",
-    "GBTClassifier",
     "GBTClassifierModel",
-    "GBTRegressor",
     "GBTRegressorModel",
     "NaiveBayesModel",
     "LinearSVC",
@@ -58,6 +64,7 @@ _ADAPTER_CLASSES = (
 
 __all__ = [
     *_PYSPARK_CLASSES,
+    *_FOREST_PLANE_CLASSES,
     *_ADAPTER_CLASSES,
     "combine_stats",
     "finalize_pca_from_stats",
@@ -73,6 +80,10 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.spark import estimator
 
         return getattr(estimator, name)
+    if name in _FOREST_PLANE_CLASSES:
+        from spark_rapids_ml_tpu.spark import forest_estimator
+
+        return getattr(forest_estimator, name)
     if name in _ADAPTER_CLASSES:
         from spark_rapids_ml_tpu.spark import adapter
 

@@ -1,0 +1,421 @@
+"""DataFrame tree-ensemble fits on the executor statistics plane.
+
+Replaces the generic adapter's driver-collect for RandomForest and GBT
+(VERDICT r3 #3): the reference's architecture keeps rows on executors and
+moves only additive partials (``RapidsRowMatrix.scala:168-202``); histogram
+trees decompose the same way PER LEVEL — executors bin + route + histogram
+their partitions (``spark/forest_plane.py``), the driver sums the tiny
+(C, nodes, features, bins) tensors and runs the SAME
+``ops.forest_kernel.level_split`` selection the local and mesh-distributed
+growers compile, then broadcasts the split decisions into the next level's
+job closure. The input DataFrame is ``persist()``-ed once; no driver ever
+materializes rows.
+
+Job count: RandomForest runs (maxDepth + 1) jobs per tree GROUP (trees
+grown level-synchronously together, group size bounded so a partition's
+histogram payload stays ≤ ~64 MB); GBT is sequential by nature —
+maxIter × (maxDepth + 1) jobs, margins recomputed from the broadcast
+prior ensemble (stateless executors, no per-row cache).
+
+The classes subclass the adapter front-ends, so the param surface,
+setters, persistence, and the transform path are IDENTICAL — only the
+fit strategy changes. (UMAP and the scalers keep the adapter's collect;
+those fits are not partition-decomposable.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_ml_tpu.spark import adapter as _adapter
+from spark_rapids_ml_tpu.spark.forest_plane import (
+    combine_hist_rows,
+    hist_arrow_schema,
+    hist_spark_ddl,
+    partition_forest_histograms,
+    partition_forest_leaf_stats,
+    partition_forest_sample,
+    partition_gbt_histograms,
+    partition_gbt_leaf_stats,
+    sample_arrow_schema,
+    sample_spark_ddl,
+)
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+
+_GROUP_BUDGET_BYTES = 64 * 1024 * 1024
+
+
+def _collect_sample(df, fcol, lcol, seed):
+    """Pass 1: driver-side merge of the per-partition samples → (edges
+    input sample, y stats, distinct labels, n, d)."""
+
+    def job(batches):
+        import pyarrow as pa
+
+        for row in partition_forest_sample(batches, fcol, lcol, seed):
+            yield pa.RecordBatch.from_pylist(
+                [row], schema=sample_arrow_schema()
+            )
+
+    rows = df.mapInArrow(job, sample_spark_ddl()).collect()
+    if not rows:
+        raise ValueError("empty dataset")
+    d = int(rows[0]["d"])
+    xs, ys = [], []
+    n_total = 0
+    y_sum = 0.0
+    labels: set = set()
+    for r in rows:
+        if int(r["d"]) != d:
+            raise ValueError(
+                f"inconsistent feature dim across partitions: {r['d']} != {d}"
+            )
+        n_total += int(r["n"])
+        y_sum += float(r["y_sum"])
+        labels.update(float(v) for v in r["labels"])
+        xs.append(np.asarray(r["sample_x"], dtype=np.float64).reshape(-1, d))
+        ys.append(np.asarray(r["sample_y"], dtype=np.float64))
+    return (
+        np.concatenate(xs), np.concatenate(ys), n_total, y_sum,
+        sorted(labels), d,
+    )
+
+
+def _hist_job(df, partition_fn, fcol, lcol, spec):
+    def job(batches):
+        import pyarrow as pa
+
+        for row in partition_fn(batches, fcol, lcol, spec):
+            yield pa.RecordBatch.from_pylist(
+                [row], schema=hist_arrow_schema()
+            )
+
+    return df.mapInArrow(job, hist_spark_ddl()).collect()
+
+
+def _level_split_np(h, classification, feat_mask_level, min_leaf, n_bins):
+    """Driver-side split selection: the kernel's ``level_split`` over the
+    executor-reduced histograms (tiny tensors; jit-compiled once per
+    shape on the driver's default backend)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.forest_kernel import (
+        gini_gain_fn,
+        level_split,
+        variance_gain_fn,
+    )
+
+    n_ch = h.shape[0]
+    gain_fn = gini_gain_fn if classification else variance_gain_fn
+    ccs = slice(0, n_ch) if classification else slice(0, 1)
+    bf, bt, kept = level_split(
+        jnp.asarray(h), gain_fn, ccs,
+        jnp.asarray(feat_mask_level), min_leaf, n_bins,
+    )
+    return np.asarray(bf), np.asarray(bt), np.asarray(kept)
+
+
+def _fit_forest_plane(local_est, dataset, classification):
+    """Grow the whole forest level-synchronously over executor histogram
+    partials; returns the fitted LOCAL model (same class the local fit
+    produces, so transform/persistence are shared)."""
+    from spark_rapids_ml_tpu.models.random_forest import _subset_counts
+    from spark_rapids_ml_tpu.ops.forest_kernel import (
+        TreeEnsemble,
+        feature_importances,
+        quantile_bins,
+    )
+
+    timer = PhaseTimer()
+    fcol = local_est.getInputCol()
+    lcol = local_est.getLabelCol()
+    n_trees = int(local_est.getNumTrees())
+    depth = int(local_est.getMaxDepth())
+    n_bins = int(local_est.getMaxBins())
+    min_leaf = int(local_est.getMinInstancesPerNode())
+    rate = float(local_est.getSubsamplingRate())
+    seed = int(local_est.getSeed())
+
+    df = dataset.select(fcol, lcol).persist()
+    try:
+        with timer.phase("sample"):
+            sx, sy, n_total, _y_sum, labels, d = _collect_sample(
+                df, fcol, lcol, seed
+            )
+            _, edges = quantile_bins(sx, n_bins)
+        classes = None
+        if classification:
+            if len(labels) > 100:
+                raise ValueError(
+                    f"{len(labels)} distinct label values: looks like a "
+                    "continuous target, not classes"
+                )
+            classes = np.asarray(labels)
+
+        n_ch = len(classes) if classification else 3
+        per_tree_bytes = n_ch * 2 ** (depth - 1) * d * n_bins * 8
+        group = int(np.clip(
+            _GROUP_BUDGET_BYTES // max(per_tree_bytes, 1), 1, n_trees
+        ))
+
+        rng = np.random.default_rng(seed)
+        k_feats = _subset_counts(local_est.getFeatureSubsetStrategy(), d)
+        masks = np.zeros((n_trees, depth, d))
+        for t in range(n_trees):
+            for lvl in range(depth):
+                cols = rng.choice(d, size=k_feats, replace=False)
+                masks[t, lvl, cols] = 1.0
+
+        n_int = 2 ** depth - 1
+        n_leaves = 2 ** depth
+        feature_arr = np.zeros((n_trees, n_int), dtype=np.int32)
+        threshold_arr = np.full((n_trees, n_int), n_bins, dtype=np.int32)
+        gains_arr = np.zeros((n_trees, n_int))
+        leaves = [None] * n_trees
+
+        with timer.phase("grow"):
+            for g0 in range(0, n_trees, group):
+                g_trees = list(range(g0, min(g0 + group, n_trees)))
+                for level in range(depth):
+                    n_nodes = 2 ** level
+                    spec = {
+                        "edges": edges, "n_bins": n_bins, "level": level,
+                        "subsampling_rate": rate, "seed": seed,
+                        "classes": classes,
+                        "trees": [
+                            {"tree": t, "feature": feature_arr[t],
+                             "threshold": threshold_arr[t]}
+                            for t in g_trees
+                        ],
+                    }
+                    rows = _hist_job(
+                        df, partition_forest_histograms, fcol, lcol, spec
+                    )
+                    per_tree = combine_hist_rows(
+                        rows, n_ch * n_nodes * d * n_bins
+                    )
+                    base = n_nodes - 1
+                    for t in g_trees:
+                        h = per_tree[t].reshape(n_ch, n_nodes, d, n_bins)
+                        bf, bt, kept = _level_split_np(
+                            h, classification, masks[t, level],
+                            min_leaf, n_bins,
+                        )
+                        feature_arr[t, base:base + n_nodes] = bf
+                        threshold_arr[t, base:base + n_nodes] = bt
+                        gains_arr[t, base:base + n_nodes] = kept
+                # leaf pass for the finished group
+                leaf_ch = len(classes) if classification else 2
+                spec = {
+                    "edges": edges, "depth": depth,
+                    "subsampling_rate": rate, "seed": seed,
+                    "classes": classes,
+                    "trees": [
+                        {"tree": t, "feature": feature_arr[t],
+                         "threshold": threshold_arr[t]}
+                        for t in g_trees
+                    ],
+                }
+                rows = _hist_job(
+                    df, partition_forest_leaf_stats, fcol, lcol, spec
+                )
+                per_tree = combine_hist_rows(rows, leaf_ch * n_leaves)
+                for t in g_trees:
+                    s = per_tree[t].reshape(leaf_ch, n_leaves)
+                    if classification:
+                        cls_cnt = s.T  # (n_leaves, K)
+                        tot = cls_cnt.sum(axis=1, keepdims=True)
+                        prior = cls_cnt.sum(axis=0)
+                        prior = prior / max(prior.sum(), 1e-12)
+                        leaves[t] = np.where(
+                            tot > 0,
+                            cls_cnt / np.maximum(tot, 1e-12),
+                            prior[None, :],
+                        )
+                    else:
+                        cnt, tot = s[0], s[1]
+                        gmean = tot.sum() / max(cnt.sum(), 1e-12)
+                        leaves[t] = np.where(
+                            cnt > 0, tot / np.maximum(cnt, 1e-12), gmean
+                        )
+    finally:
+        df.unpersist()
+
+    ensemble = TreeEnsemble(
+        feature=feature_arr,
+        threshold=threshold_arr,
+        leaf_value=np.stack(leaves),
+    )
+    model = local_est._model_cls()(
+        ensemble=ensemble, edges=edges,
+        classes=classes if classification else None,
+    )
+    model.feature_importances_ = feature_importances(
+        feature_arr, gains_arr, d
+    )
+    model.uid = local_est.uid
+    model.copy_values_from(local_est)
+    model.fit_timings_ = timer.as_dict()
+    return model
+
+
+def _fit_gbt_plane(local_est, dataset, classification):
+    """Sequential boosting over the statistics plane: each round grows one
+    regression tree on residuals via per-level executor histograms, then a
+    leaf pass supplies the (squared-loss or one-step-Newton) leaf values —
+    the same formulas ``models.gbt.boosting_loop`` applies locally."""
+    from spark_rapids_ml_tpu.ops.forest_kernel import (
+        TreeEnsemble,
+        feature_importances,
+        quantile_bins,
+    )
+
+    timer = PhaseTimer()
+    fcol = local_est.getInputCol()
+    lcol = local_est.getLabelCol()
+    max_iter = int(local_est.getMaxIter())
+    step = float(local_est.getStepSize())
+    depth = int(local_est.getMaxDepth())
+    n_bins = int(local_est.getMaxBins())
+    min_leaf = int(local_est.getMinInstancesPerNode())
+    rate = float(local_est.getSubsamplingRate())
+    seed = int(local_est.getSeed())
+
+    df = dataset.select(fcol, lcol).persist()
+    try:
+        with timer.phase("sample"):
+            sx, _sy, n_total, y_sum, labels, d = _collect_sample(
+                df, fcol, lcol, seed
+            )
+            _, edges = quantile_bins(sx, n_bins)
+        if classification:
+            if not set(labels) <= {0.0, 1.0}:
+                raise ValueError("GBT classification requires 0/1 labels")
+            p0 = float(np.clip(y_sum / n_total, 1e-6, 1 - 1e-6))
+            init = float(np.log(p0 / (1.0 - p0)))
+        else:
+            init = float(y_sum / n_total)
+
+        n_int = 2 ** depth - 1
+        n_leaves = 2 ** depth
+        full_mask = np.ones(d)
+        ens_f, ens_t, ens_l, gains_l = [], [], [], []
+
+        with timer.phase("boost"):
+            for m in range(max_iter):
+                feature = np.zeros(n_int, dtype=np.int32)
+                threshold = np.full(n_int, n_bins, dtype=np.int32)
+                gains = np.zeros(n_int)
+                base_spec = {
+                    "edges": edges, "n_bins": n_bins, "depth": depth,
+                    "subsampling_rate": rate, "seed": seed, "tree": m,
+                    "init": init, "step_size": step,
+                    "classification": classification,
+                    "ens_feature": (
+                        np.stack(ens_f) if ens_f else None
+                    ),
+                    "ens_threshold": (
+                        np.stack(ens_t) if ens_t else None
+                    ),
+                    "ens_leaf": np.stack(ens_l) if ens_l else None,
+                }
+                for level in range(depth):
+                    n_nodes = 2 ** level
+                    spec = dict(
+                        base_spec, level=level,
+                        feature=feature, threshold=threshold,
+                    )
+                    rows = _hist_job(
+                        df, partition_gbt_histograms, fcol, lcol, spec
+                    )
+                    h = combine_hist_rows(
+                        rows, 3 * n_nodes * d * n_bins
+                    )[m].reshape(3, n_nodes, d, n_bins)
+                    bf, bt, kept = _level_split_np(
+                        h, False, full_mask, min_leaf, n_bins
+                    )
+                    base = n_nodes - 1
+                    feature[base:base + n_nodes] = bf
+                    threshold[base:base + n_nodes] = bt
+                    gains[base:base + n_nodes] = kept
+                spec = dict(base_spec, feature=feature, threshold=threshold)
+                rows = _hist_job(
+                    df, partition_gbt_leaf_stats, fcol, lcol, spec
+                )
+                s = combine_hist_rows(rows, 3 * n_leaves)[m].reshape(
+                    3, n_leaves
+                )
+                cnt, wr, wh = s[0], s[1], s[2]
+                if classification:
+                    # one-step Newton leaves: Σw·r / Σw·h
+                    leaf = np.where(
+                        wh > 0, wr / np.maximum(wh, 1e-12), 0.0
+                    )
+                else:
+                    gmean = wr.sum() / max(cnt.sum(), 1e-12)
+                    leaf = np.where(
+                        cnt > 0, wr / np.maximum(cnt, 1e-12), gmean
+                    )
+                ens_f.append(feature)
+                ens_t.append(threshold)
+                ens_l.append(leaf)
+                gains_l.append(gains)
+    finally:
+        df.unpersist()
+
+    ensemble = TreeEnsemble(
+        feature=np.stack(ens_f),
+        threshold=np.stack(ens_t),
+        leaf_value=np.stack(ens_l),
+    )
+    model = local_est._model_cls()(
+        ensemble=ensemble, edges=edges, init=init, step_size=step
+    )
+    model.feature_importances_ = feature_importances(
+        np.stack(ens_f), np.stack(gains_l), d
+    )
+    model.uid = local_est.uid
+    model.copy_values_from(local_est)
+    model.fit_timings_ = timer.as_dict()
+    return model
+
+
+class RandomForestClassifier(_adapter.RandomForestClassifier):
+    """DataFrame RandomForestClassifier on the executor statistics plane
+    (histograms reduced per level; rows never leave executors)."""
+
+    def _fit(self, dataset):
+        local_model = _fit_forest_plane(
+            self._local, dataset, classification=True
+        )
+        return self._model_cls(local_model)
+
+
+class RandomForestRegressor(_adapter.RandomForestRegressor):
+    """DataFrame RandomForestRegressor on the executor statistics plane."""
+
+    def _fit(self, dataset):
+        local_model = _fit_forest_plane(
+            self._local, dataset, classification=False
+        )
+        return self._model_cls(local_model)
+
+
+class GBTClassifier(_adapter.GBTClassifier):
+    """DataFrame GBTClassifier on the executor statistics plane."""
+
+    def _fit(self, dataset):
+        local_model = _fit_gbt_plane(
+            self._local, dataset, classification=True
+        )
+        return self._model_cls(local_model)
+
+
+class GBTRegressor(_adapter.GBTRegressor):
+    """DataFrame GBTRegressor on the executor statistics plane."""
+
+    def _fit(self, dataset):
+        local_model = _fit_gbt_plane(
+            self._local, dataset, classification=False
+        )
+        return self._model_cls(local_model)
