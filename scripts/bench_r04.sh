@@ -52,6 +52,13 @@ for i in $(seq 1 40); do
       echo "scale run rc=$?: $(stamp)" >> "$OUT/status.log"
     fi
 
+    if [ -f scripts/bench_gram_sweep.py ]; then
+      echo "gram sweep start: $(stamp)" >> "$OUT/status.log"
+      python scripts/bench_gram_sweep.py \
+        > "$OUT/bench_gram_sweep.json" 2> "$OUT/bench_gram_sweep.err"
+      echo "gram sweep rc=$?: $(stamp)" >> "$OUT/status.log"
+    fi
+
     echo "ALL DONE: $(stamp)" >> "$OUT/status.log"
     touch "$OUT/done"
     exit 0

@@ -1,0 +1,63 @@
+"""Tiled-path scale pins (VERDICT r3 #5): DBSCAN/UMAP at ≥100k rows.
+
+Env-gated (SPARK_RAPIDS_ML_TPU_RUN_SLOW=1): a 100k-row quadratic sweep is
+minutes of CPU in the default suite's environment, so the default lane
+keeps the fast exact-match tiled tests (test_dbscan.py / test_umap.py)
+and this module pins the large-n envelope on demand / in the slow CI
+lane. The chip-scale 200k×64 record comes from ``scripts/bench_scale.py``
+via the patient bench loop.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("SPARK_RAPIDS_ML_TPU_RUN_SLOW") != "1",
+    reason="quadratic 100k-row sweep: set SPARK_RAPIDS_ML_TPU_RUN_SLOW=1",
+)
+
+N = 100_000
+D = 16
+N_BLOBS = 12
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(3)
+    centers = rng.normal(scale=10.0, size=(N_BLOBS, D))
+    assign = rng.integers(0, N_BLOBS, size=N)
+    return centers[assign] + rng.normal(size=(N, D)), assign
+
+
+def test_dbscan_tiled_100k(blobs):
+    from spark_rapids_ml_tpu.models.dbscan import DBSCAN
+
+    x, _ = blobs
+    # n > 16384 auto-selects the tiled sweep (models/dbscan.py); intra
+    # distances concentrate at √(2·16) ≈ 5.7
+    model = DBSCAN().setEps(7.0).setMinPts(5).fit(x)
+    assert model.n_clusters_ >= N_BLOBS - 2
+    assert model.labels_.shape == (N,)
+
+
+def test_umap_tiled_100k(blobs):
+    from spark_rapids_ml_tpu.models.umap import UMAP
+
+    x, assign = blobs
+    model = UMAP().setNNeighbors(10).setNEpochs(3).fit(x)
+    emb = np.asarray(model.embedding_)
+    assert emb.shape == (N, 2)
+    assert np.isfinite(emb).all()
+    cent = np.stack(
+        [emb[assign == b].mean(axis=0) for b in range(N_BLOBS)]
+    )
+    intra = float(np.mean([
+        np.linalg.norm(emb[assign == b] - cent[b], axis=1).mean()
+        for b in range(N_BLOBS)
+    ]))
+    inter = float(np.linalg.norm(
+        cent[:, None, :] - cent[None, :, :], axis=-1
+    )[np.triu_indices(N_BLOBS, 1)].mean())
+    assert inter > 1.15 * intra
