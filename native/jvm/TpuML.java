@@ -1,0 +1,137 @@
+// JVM binding over libtpuml.so via the Panama FFI (java.lang.foreign,
+// JDK 22+ final API) — the front-end seam the reference placed at
+// JniRAPIDSML.java:64-70 (a Scala/Java surface over the native library),
+// SURVEY.md §7 step 2. Unlike the reference's hand-written JNI stubs, the
+// Panama linker binds the C ABI (native/src/tpuml.cpp, TPUML_API symbols)
+// with no native glue code to compile, so the same libtpuml.so serves
+// Python (ctypes, spark_rapids_ml_tpu/native.py) and the JVM.
+//
+// Build/run (requires a JDK; this repo's image ships none, so the smoke
+// is environment-gated exactly like the pyspark CI lane):
+//   make -C native && bash native/jvm/run_smoke.sh
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.nio.file.Path;
+
+public final class TpuML {
+    private final MethodHandle hVersion;
+    private final MethodHandle hDgemm;
+    private final MethodHandle hDsyevd;
+    private final MethodHandle hTracePush;
+    private final MethodHandle hTracePop;
+    private final MethodHandle hTraceDepth;
+
+    public TpuML(Path libtpuml) {
+        Linker linker = Linker.nativeLinker();
+        SymbolLookup lib = SymbolLookup.libraryLookup(
+            libtpuml, Arena.global());
+        hVersion = linker.downcallHandle(
+            lib.find("tpuml_version").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.ADDRESS));
+        // int tpuml_dgemm(int transa, int transb, i64 m, i64 n, i64 k,
+        //                 double alpha, const double* A, i64 lda,
+        //                 const double* B, i64 ldb, double beta,
+        //                 double* C, i64 ldc)
+        hDgemm = linker.downcallHandle(
+            lib.find("tpuml_dgemm").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.JAVA_INT,
+                ValueLayout.JAVA_INT, ValueLayout.JAVA_INT,
+                ValueLayout.JAVA_LONG, ValueLayout.JAVA_LONG,
+                ValueLayout.JAVA_LONG, ValueLayout.JAVA_DOUBLE,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_LONG,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_LONG,
+                ValueLayout.JAVA_DOUBLE, ValueLayout.ADDRESS,
+                ValueLayout.JAVA_LONG));
+        // int tpuml_dsyevd(i64 n, const double* A, double* w, double* V)
+        hDsyevd = linker.downcallHandle(
+            lib.find("tpuml_dsyevd").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.JAVA_INT,
+                ValueLayout.JAVA_LONG, ValueLayout.ADDRESS,
+                ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+        hTracePush = linker.downcallHandle(
+            lib.find("tpuml_trace_push").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.JAVA_INT,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_INT));
+        hTracePop = linker.downcallHandle(
+            lib.find("tpuml_trace_pop").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.JAVA_INT));
+        hTraceDepth = linker.downcallHandle(
+            lib.find("tpuml_trace_depth").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.JAVA_INT));
+    }
+
+    public String version() {
+        try {
+            MemorySegment p = (MemorySegment) hVersion.invoke();
+            return p.reinterpret(256).getString(0);
+        } catch (Throwable t) {
+            throw new RuntimeException(t);
+        }
+    }
+
+    /** C = alpha·op(A)·op(B) + beta·C, row-major, like the ctypes layer. */
+    public double[] dgemm(boolean transA, boolean transB, long m, long n,
+                          long k, double alpha, double[] a, long lda,
+                          double[] b, long ldb, double beta, double[] c,
+                          long ldc) {
+        try (Arena arena = Arena.ofConfined()) {
+            MemorySegment sa = arena.allocateFrom(ValueLayout.JAVA_DOUBLE, a);
+            MemorySegment sb = arena.allocateFrom(ValueLayout.JAVA_DOUBLE, b);
+            MemorySegment sc = arena.allocateFrom(ValueLayout.JAVA_DOUBLE, c);
+            int rc = (int) hDgemm.invoke(transA ? 1 : 0, transB ? 1 : 0,
+                m, n, k, alpha, sa, lda, sb, ldb, beta, sc, ldc);
+            if (rc != 0) throw new RuntimeException("tpuml_dgemm rc=" + rc);
+            return sc.toArray(ValueLayout.JAVA_DOUBLE);
+        } catch (Throwable t) {
+            throw new RuntimeException(t);
+        }
+    }
+
+    /** Eigendecomposition of symmetric n×n A: returns {w (n), V (n×n)}. */
+    public double[][] dsyevd(long n, double[] a) {
+        try (Arena arena = Arena.ofConfined()) {
+            MemorySegment sa = arena.allocateFrom(ValueLayout.JAVA_DOUBLE, a);
+            MemorySegment sw = arena.allocate(ValueLayout.JAVA_DOUBLE, n);
+            MemorySegment sv = arena.allocate(ValueLayout.JAVA_DOUBLE, n * n);
+            int rc = (int) hDsyevd.invoke(n, sa, sw, sv);
+            if (rc != 0) throw new RuntimeException("tpuml_dsyevd rc=" + rc);
+            return new double[][] {
+                sw.toArray(ValueLayout.JAVA_DOUBLE),
+                sv.toArray(ValueLayout.JAVA_DOUBLE),
+            };
+        } catch (Throwable t) {
+            throw new RuntimeException(t);
+        }
+    }
+
+    public int tracePush(String name, int color) {
+        try (Arena arena = Arena.ofConfined()) {
+            return (int) hTracePush.invoke(
+                arena.allocateFrom(name), color);
+        } catch (Throwable t) {
+            throw new RuntimeException(t);
+        }
+    }
+
+    public int tracePop() {
+        try {
+            return (int) hTracePop.invoke();
+        } catch (Throwable t) {
+            throw new RuntimeException(t);
+        }
+    }
+
+    public int traceDepth() {
+        try {
+            return (int) hTraceDepth.invoke();
+        } catch (Throwable t) {
+            throw new RuntimeException(t);
+        }
+    }
+}

@@ -1,0 +1,61 @@
+// libtpuml JVM smoke: a JVM process computes a 4×4 gram Aᵀ·A and a tiny
+// eigendecomposition through the native library and checks the values
+// against an in-JVM reference — the JVM-side analogue of
+// tests/test_native.py's NumPy-oracle checks (reference surface:
+// JniRAPIDSML.java:64-70 consumed by RapidsRowMatrix.scala:195-196).
+//
+// Run: bash native/jvm/run_smoke.sh   (gated on a JDK 22+ being present)
+
+import java.nio.file.Path;
+
+public final class TpuMLSmoke {
+    public static void main(String[] args) {
+        Path lib = Path.of(
+            args.length > 0 ? args[0] : "native/build/libtpuml.so");
+        TpuML t = new TpuML(lib);
+        System.out.println("version: " + t.version());
+
+        // gram: A is 3×4 row-major; G = Aᵀ·A (4×4) via transa=1
+        double[] a = {
+            1, 2, 3, 4,
+            5, 6, 7, 8,
+            9, 10, 11, 12,
+        };
+        int m = 4, n = 4, k = 3;
+        double[] g = t.dgemm(true, false, m, n, k, 1.0, a, 4, a, 4,
+                             0.0, new double[m * n], 4);
+        // in-JVM oracle
+        double maxErr = 0.0;
+        for (int i = 0; i < 4; i++) {
+            for (int j = 0; j < 4; j++) {
+                double want = 0.0;
+                for (int r = 0; r < 3; r++) {
+                    want += a[r * 4 + i] * a[r * 4 + j];
+                }
+                maxErr = Math.max(maxErr, Math.abs(g[i * 4 + j] - want));
+            }
+        }
+        System.out.println("gram max|err| = " + maxErr);
+        if (maxErr > 1e-12) throw new AssertionError("gram mismatch");
+
+        // eigh of diag(1,2,3) + known rotation-free symmetric matrix
+        double[] sym = {
+            2, 1, 0,
+            1, 2, 0,
+            0, 0, 5,
+        };
+        double[][] wv = t.dsyevd(3, sym);
+        double[] w = wv[0];
+        // eigenvalues of [[2,1],[1,2]] are 1 and 3; plus the isolated 5
+        java.util.Arrays.sort(w);
+        double err = Math.abs(w[0] - 1) + Math.abs(w[1] - 3)
+                   + Math.abs(w[2] - 5);
+        System.out.println("eigh |err| = " + err);
+        if (err > 1e-9) throw new AssertionError("eigh mismatch");
+
+        t.tracePush("jvm-smoke", 0);
+        if (t.traceDepth() != 1) throw new AssertionError("trace depth");
+        t.tracePop();
+        System.out.println("JVM smoke OK");
+    }
+}

@@ -1,0 +1,21 @@
+#!/bin/bash
+# JVM smoke over libtpuml.so (SURVEY §7 step 2: the JVM front-end seam).
+# Gated on a JDK 22+ (java.lang.foreign final API); this repo's build
+# image ships no JDK, so CI treats absence like the missing-pyspark lane:
+# a clean skip, not a failure.
+set -e
+cd "$(dirname "$0")/../.."
+
+if ! command -v java >/dev/null 2>&1; then
+  echo "SKIP: no JVM on PATH (need JDK 22+ for java.lang.foreign)"
+  exit 0
+fi
+major=$(java -version 2>&1 | sed -n 's/.*version "\([0-9]*\).*/\1/p')
+if [ -z "$major" ] || [ "$major" -lt 22 ]; then
+  echo "SKIP: JDK $major < 22 (java.lang.foreign needs 22+)"
+  exit 0
+fi
+
+make -C native >/dev/null
+exec java --enable-native-access=ALL-UNNAMED \
+  native/jvm/TpuMLSmoke.java "$@"

@@ -236,3 +236,20 @@ def test_pjrt_gram_and_dot_on_accelerator():
     b = rng.normal(size=(64, 8)).astype(np.float32)
     np.testing.assert_allclose(native.pjrt_dot(a, b), a @ b, atol=5e-4)
     native.pjrt_shutdown()
+
+
+def test_jvm_shim_smoke_script():
+    """SURVEY §7 step 2's JVM front-end seam: the Panama-FFI binding
+    (native/jvm/TpuML.java) smoke runs when a JDK 22+ is present and
+    skips cleanly otherwise (this image ships no JDK — same gating
+    convention as the pyspark lane)."""
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        ["bash", "native/jvm/run_smoke.sh"],
+        capture_output=True, text=True, cwd=repo_root, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = proc.stdout
+    assert ("SKIP" in out) or ("JVM smoke OK" in out), out
