@@ -286,17 +286,25 @@ class GBTClassificationModel(GBTClassifierParams, _GBTModelBase):
         )
 
 
+def gbt_init_from_mean(y_mean: float, classification: bool) -> float:
+    """Initial boosting margin from the (validated) label mean — THE one
+    formula for every fit plane (local, mesh-distributed, and the Spark
+    statistics plane, which only ever sees Σy/n): log-odds of the clipped
+    base rate for classification, the mean itself for regression."""
+    if classification:
+        p0 = float(np.clip(y_mean, 1e-6, 1 - 1e-6))
+        return float(np.log(p0 / (1.0 - p0)))
+    return float(y_mean)
+
+
 def gbt_init_margin(y, classification):
     """Initial boosting margin + label validation — one definition for
-    the local and distributed fits (log-odds of the clipped base rate for
-    classification, the label mean for regression)."""
+    the local and distributed fits (see ``gbt_init_from_mean`` for the
+    summary-statistics form the Spark plane uses)."""
     y = np.asarray(y, dtype=np.float64).reshape(-1)
     if classification and not np.isin(y, (0.0, 1.0)).all():
         raise ValueError("GBT classification requires 0/1 labels")
-    if classification:
-        p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
-        return float(np.log(p0 / (1.0 - p0)))
-    return float(y.mean())
+    return gbt_init_from_mean(float(y.mean()), classification)
 
 
 def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,

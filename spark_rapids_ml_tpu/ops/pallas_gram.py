@@ -160,7 +160,10 @@ def _folded_triangle_maps(n_tiles):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret", "precision", "symmetric")
+    jax.jit,
+    static_argnames=(
+        "interpret", "precision", "symmetric", "block_n", "block_r"
+    ),
 )
 def fused_centered_gram(
     x: jnp.ndarray,
@@ -169,6 +172,8 @@ def fused_centered_gram(
     interpret: bool = False,
     precision=None,
     symmetric: bool = True,
+    block_n: int = _BLOCK_N,
+    block_r: int = _BLOCK_R,
 ) -> jnp.ndarray:
     """``(diag(rowmul)·(X − mean))ᵀ (diag(rowmul)·(X − mean))`` in one pass.
 
@@ -187,17 +192,17 @@ def fused_centered_gram(
     the full grid.
     """
     rows, n = x.shape
-    if rows % _BLOCK_R or n % _BLOCK_N:
+    if rows % block_r or n % block_n:
         raise ValueError(
             f"shape {(rows, n)} must be padded to multiples of "
-            f"({_BLOCK_R}, {_BLOCK_N}); use pad_for_fused_gram"
+            f"({block_r}, {block_n}); use pad_for_fused_gram"
         )
     from spark_rapids_ml_tpu.ops.covariance import default_gram_precision
 
     if precision is None:
         precision = default_gram_precision()
-    n_tiles = n // _BLOCK_N
-    r_tiles = rows // _BLOCK_R
+    n_tiles = n // block_n
+    r_tiles = rows // block_r
     symmetric = symmetric and n_tiles % 2 == 0  # odd fold double-counts
     mean2d = mean.reshape(1, n).astype(x.dtype)
     rowmul2d = rowmul.reshape(rows, 1).astype(x.dtype)
@@ -243,13 +248,13 @@ def fused_centered_gram(
         out_shape=jax.ShapeDtypeStruct((n, n), x.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_R, _BLOCK_N), _xi),
-            pl.BlockSpec((_BLOCK_R, _BLOCK_N), _xj),
-            pl.BlockSpec((1, _BLOCK_N), _mi),
-            pl.BlockSpec((1, _BLOCK_N), _mj),
-            pl.BlockSpec((_BLOCK_R, 1), lambda *idx: (idx[-1], 0)),
+            pl.BlockSpec((block_r, block_n), _xi),
+            pl.BlockSpec((block_r, block_n), _xj),
+            pl.BlockSpec((1, block_n), _mi),
+            pl.BlockSpec((1, block_n), _mj),
+            pl.BlockSpec((block_r, 1), lambda *idx: (idx[-1], 0)),
         ],
-        out_specs=pl.BlockSpec((_BLOCK_N, _BLOCK_N), _out),
+        out_specs=pl.BlockSpec((block_n, block_n), _out),
         interpret=interpret,
         # 512×1024 blocks need ~17MB of scoped VMEM (see the block-size
         # comment above for the breakdown) — just past the 16MB default
@@ -268,8 +273,10 @@ def fused_centered_gram(
     return out
 
 
-def pad_for_fused_gram(x, mask=None, dtype=None):
-    """Pad rows to _BLOCK_R and features to _BLOCK_N; returns
+def pad_for_fused_gram(x, mask=None, dtype=None,
+                       block_n: int = _BLOCK_N, block_r: int = _BLOCK_R):
+    """Pad rows to ``block_r`` and features to ``block_n`` (the same
+    block arguments ``fused_centered_gram`` takes); returns
     (x_padded, rowmask_padded, n_features_original).
 
     One allocation + one copy total (dtype cast included): at the 1M×4096
@@ -281,10 +288,10 @@ def pad_for_fused_gram(x, mask=None, dtype=None):
     x = np.asarray(x)
     dtype = x.dtype if dtype is None else np.dtype(dtype)
     rows, n = x.shape
-    pr = (-rows) % _BLOCK_R
-    # Pad features to an EVEN number of _BLOCK_N tiles so the symmetric
+    pr = (-rows) % block_r
+    # Pad features to an EVEN number of block_n tiles so the symmetric
     # folded-triangle grid applies (an odd tile count can't fold).
-    pn = (-n) % (2 * _BLOCK_N)
+    pn = (-n) % (2 * block_n)
     rowmask = (
         np.ones(rows, dtype=dtype) if mask is None
         else np.asarray(mask, dtype=dtype)

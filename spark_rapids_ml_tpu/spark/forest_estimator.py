@@ -38,6 +38,7 @@ from spark_rapids_ml_tpu.spark.forest_plane import (
     partition_gbt_histograms,
     partition_gbt_leaf_stats,
     sample_arrow_schema,
+    sample_cap_rows,
     sample_spark_ddl,
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
@@ -45,14 +46,35 @@ from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 _GROUP_BUDGET_BYTES = 64 * 1024 * 1024
 
 
+def _num_partitions(df) -> int:
+    try:
+        return int(df.rdd.getNumPartitions())
+    except Exception:  # noqa: BLE001 - local engine
+        pass
+    try:
+        return len(df._partitions)
+    except Exception:  # noqa: BLE001
+        return 8
+
+
 def _collect_sample(df, fcol, lcol, seed):
     """Pass 1: driver-side merge of the per-partition samples → (edges
-    input sample, y stats, distinct labels, n, d)."""
+    input sample, y stats, distinct labels, n, d). The per-partition cap
+    shrinks with feature width and partition count
+    (``forest_plane.sample_cap_rows``) so this merge — the ONLY data that
+    ever reaches the driver — stays bounded at MBs."""
+    first = df.first()
+    if first is None:
+        raise ValueError("empty dataset")
+    width = len(first[0])
+    cap = sample_cap_rows(width, _num_partitions(df))
 
     def job(batches):
         import pyarrow as pa
 
-        for row in partition_forest_sample(batches, fcol, lcol, seed):
+        for row in partition_forest_sample(
+            batches, fcol, lcol, seed, cap=cap
+        ):
             yield pa.RecordBatch.from_pylist(
                 [row], schema=sample_arrow_schema()
             )
@@ -288,13 +310,11 @@ def _fit_gbt_plane(local_est, dataset, classification):
                 df, fcol, lcol, seed
             )
             _, edges = quantile_bins(sx, n_bins)
-        if classification:
-            if not set(labels) <= {0.0, 1.0}:
-                raise ValueError("GBT classification requires 0/1 labels")
-            p0 = float(np.clip(y_sum / n_total, 1e-6, 1 - 1e-6))
-            init = float(np.log(p0 / (1.0 - p0)))
-        else:
-            init = float(y_sum / n_total)
+        from spark_rapids_ml_tpu.models.gbt import gbt_init_from_mean
+
+        if classification and not set(labels) <= {0.0, 1.0}:
+            raise ValueError("GBT classification requires 0/1 labels")
+        init = gbt_init_from_mean(y_sum / n_total, classification)
 
         n_int = 2 ** depth - 1
         n_leaves = 2 ** depth

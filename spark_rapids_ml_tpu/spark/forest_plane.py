@@ -71,6 +71,18 @@ def _batch_xy(batch, features_col: str, label_col: str):
 # pass 1: per-partition row sample (bin edges) + label facts
 # --------------------------------------------------------------------------
 
+def sample_cap_rows(d: int, n_partitions: int) -> int:
+    """Per-partition sample cap: bounded by a ~1M-element per-partition
+    payload (so wide features shrink the row cap) AND a ~128k-row total
+    budget across partitions — the driver merge stays MBs regardless of
+    feature width or partition count (Spark ML's findSplits samples with
+    the same total-budget shape)."""
+    return max(
+        256,
+        min(8192, (1 << 20) // max(d, 1), 131072 // max(n_partitions, 1)),
+    )
+
+
 def partition_forest_sample(
     batches: Iterable,
     features_col: str,
@@ -82,7 +94,8 @@ def partition_forest_sample(
     (x, y) for driver-side quantile-bin fitting, plus the partition's row
     count, label sum, and distinct labels (≤101 retained — enough to
     detect both a class set and a continuous target). One cheap pass, the
-    analogue of Spark ML's sampled ``findSplits``."""
+    analogue of Spark ML's sampled ``findSplits``; callers size ``cap``
+    with ``sample_cap_rows`` so the driver merge stays bounded."""
     rng = np.random.default_rng([seed & 0x7FFFFFFF, partition_identity()])
     buf_x: List[np.ndarray] = []
     buf_y: List[np.ndarray] = []

@@ -102,3 +102,25 @@ def test_pallas_flag_harmless_on_cpu(rng, monkeypatch):
     import numpy as np
 
     np.testing.assert_allclose(np.abs(m.pc), np.abs(base.pc), atol=1e-7)
+
+
+@pytest.mark.parametrize("bn,br", [(256, 512), (128, 256)])
+def test_custom_block_shapes_match(rng, bn, br):
+    """Block-size parametrization (the r4 sweep arms): any tile-aligned
+    (block_n, block_r) computes the identical folded-symmetric Gram."""
+    n, rows = 1024, 2048  # tile-aligned for the default AND custom blocks
+    x = rng.normal(size=(rows, n)).astype(np.float32)
+    mean = rng.normal(size=n).astype(np.float32)
+    rowmul = rng.uniform(0.5, 1.5, size=rows).astype(np.float32)
+    ref = fused_centered_gram(
+        jnp.asarray(x), jnp.asarray(mean), jnp.asarray(rowmul),
+        interpret=True, precision="highest",
+    )
+    out = fused_centered_gram(
+        jnp.asarray(x), jnp.asarray(mean), jnp.asarray(rowmul),
+        interpret=True, precision="highest", block_n=bn, block_r=br,
+    )
+    # different tilings accumulate in different orders: f32 rounding only
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-3
+    )
