@@ -36,6 +36,16 @@ _FOREST_PLANE_CLASSES = (
     "GBTRegressor",
 )
 
+# moments/Gram statistics-plane front-ends (spark/moments_estimator.py):
+# scalers share one executor moments pass; TruncatedSVD reduces the
+# uncentered Gram partial the PCA plane uses
+_MOMENTS_PLANE_CLASSES = (
+    "StandardScaler",
+    "MinMaxScaler",
+    "MaxAbsScaler",
+    "TruncatedSVD",
+)
+
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
 # pandas_udf transform for the non-sufficient-statistics families
 _ADAPTER_CLASSES = (
@@ -46,15 +56,11 @@ _ADAPTER_CLASSES = (
     "NaiveBayesModel",
     "LinearSVC",
     "LinearSVCModel",
-    "StandardScaler",
     "StandardScalerModel",
-    "MinMaxScaler",
     "MinMaxScalerModel",
-    "MaxAbsScaler",
     "MaxAbsScalerModel",
     "NearestNeighbors",
     "NearestNeighborsModel",
-    "TruncatedSVD",
     "TruncatedSVDModel",
     "OneVsRest",
     "OneVsRestModel",
@@ -65,6 +71,7 @@ _ADAPTER_CLASSES = (
 __all__ = [
     *_PYSPARK_CLASSES,
     *_FOREST_PLANE_CLASSES,
+    *_MOMENTS_PLANE_CLASSES,
     *_ADAPTER_CLASSES,
     "combine_stats",
     "finalize_pca_from_stats",
@@ -84,6 +91,10 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.spark import forest_estimator
 
         return getattr(forest_estimator, name)
+    if name in _MOMENTS_PLANE_CLASSES:
+        from spark_rapids_ml_tpu.spark import moments_estimator
+
+        return getattr(moments_estimator, name)
     if name in _ADAPTER_CLASSES:
         from spark_rapids_ml_tpu.spark import adapter
 

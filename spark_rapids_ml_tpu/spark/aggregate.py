@@ -751,3 +751,98 @@ def finalize_pca_from_stats(
 
     pc, evr = _host_eig_topk(cov, k)
     return np.asarray(pc), np.asarray(evr), mean
+
+
+# --------------------------------------------------------------------------
+# per-feature moment partials (the scaler statistics plane)
+# --------------------------------------------------------------------------
+
+def partition_moment_stats(
+    batches: Iterable, input_col: str
+) -> Iterator[Dict[str, object]]:
+    """One partition's per-feature (n, Σx, Σx², min, max) — the additive
+    partial that serves EVERY scaler fit (StandardScaler needs Σx/Σx²/n,
+    MinMaxScaler needs min/max, MaxAbsScaler needs max(|min|, |max|)), so
+    one executor pass replaces three driver collects. Same shape contract
+    as ``partition_gram_stats``: Arrow batches or plain arrays, exactly
+    one row, empty partitions yield nothing."""
+    s1: Optional[np.ndarray] = None
+    s2: Optional[np.ndarray] = None
+    lo: Optional[np.ndarray] = None
+    hi: Optional[np.ndarray] = None
+    count = 0
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(input_col))
+        else:
+            x = np.asarray(batch, dtype=np.float64)
+        if x.shape[0] == 0:
+            continue
+        if s1 is None:
+            d = x.shape[1]
+            s1 = np.zeros(d)
+            s2 = np.zeros(d)
+            lo = np.full(d, np.inf)
+            hi = np.full(d, -np.inf)
+        s1 += x.sum(axis=0)
+        s2 += (x * x).sum(axis=0)
+        lo = np.minimum(lo, x.min(axis=0))
+        hi = np.maximum(hi, x.max(axis=0))
+        count += x.shape[0]
+    if s1 is None:
+        return
+    yield {
+        "count": count,
+        "s1": s1.tolist(),
+        "s2": s2.tolist(),
+        "lo": lo.tolist(),
+        "hi": hi.tolist(),
+    }
+
+
+def partition_moment_stats_arrow(batches, input_col: str):
+    import pyarrow as pa
+
+    for row in partition_moment_stats(batches, input_col):
+        yield pa.RecordBatch.from_pylist(
+            [row], schema=moment_stats_arrow_schema()
+        )
+
+
+def moment_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema([
+        ("count", pa.int64()),
+        ("s1", pa.list_(pa.float64())),
+        ("s2", pa.list_(pa.float64())),
+        ("lo", pa.list_(pa.float64())),
+        ("hi", pa.list_(pa.float64())),
+    ])
+
+
+def moment_stats_spark_ddl() -> str:
+    return ("count bigint, s1 array<double>, s2 array<double>, "
+            "lo array<double>, hi array<double>")
+
+
+def combine_moment_stats(rows: Iterable):
+    """(n, Σx, Σx², min, max) over all partitions."""
+    s1 = s2 = lo = hi = None
+    count = 0
+    for row in rows:
+        get = row.get if isinstance(row, dict) else row.__getitem__
+        if s1 is None:
+            s1 = np.asarray(get("s1"), dtype=np.float64).copy()
+            s2 = np.asarray(get("s2"), dtype=np.float64).copy()
+            lo = np.asarray(get("lo"), dtype=np.float64).copy()
+            hi = np.asarray(get("hi"), dtype=np.float64).copy()
+        else:
+            s1 += np.asarray(get("s1"), dtype=np.float64)
+            s2 += np.asarray(get("s2"), dtype=np.float64)
+            lo = np.minimum(lo, np.asarray(get("lo"), dtype=np.float64))
+            hi = np.maximum(hi, np.asarray(get("hi"), dtype=np.float64))
+        count += int(get("count"))
+    if s1 is None:
+        raise ValueError("no partition statistics to combine (empty dataset)")
+    return count, s1, s2, lo, hi

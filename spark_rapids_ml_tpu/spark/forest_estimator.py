@@ -39,6 +39,7 @@ from spark_rapids_ml_tpu.spark.forest_plane import (
     partition_gbt_leaf_stats,
     sample_arrow_schema,
     sample_cap_rows,
+    sample_partition_count,
     sample_spark_ddl,
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
@@ -67,13 +68,15 @@ def _collect_sample(df, fcol, lcol, seed):
     if first is None:
         raise ValueError("empty dataset")
     width = len(first[0])
-    cap = sample_cap_rows(width, _num_partitions(df))
+    n_parts = _num_partitions(df)
+    cap = sample_cap_rows(width, n_parts)
+    sample_parts = sample_partition_count(cap, width, n_parts)
 
     def job(batches):
         import pyarrow as pa
 
         for row in partition_forest_sample(
-            batches, fcol, lcol, seed, cap=cap
+            batches, fcol, lcol, seed, cap=cap, sample_parts=sample_parts
         ):
             yield pa.RecordBatch.from_pylist(
                 [row], schema=sample_arrow_schema()
@@ -95,8 +98,13 @@ def _collect_sample(df, fcol, lcol, seed):
         n_total += int(r["n"])
         y_sum += float(r["y_sum"])
         labels.update(float(v) for v in r["labels"])
-        xs.append(np.asarray(r["sample_x"], dtype=np.float64).reshape(-1, d))
-        ys.append(np.asarray(r["sample_y"], dtype=np.float64))
+        if len(r["sample_x"]):  # non-sampling partitions send empty arrays
+            xs.append(
+                np.asarray(r["sample_x"], dtype=np.float64).reshape(-1, d)
+            )
+            ys.append(np.asarray(r["sample_y"], dtype=np.float64))
+    if not xs:
+        raise ValueError("no sampled rows (all sampling partitions empty)")
     return (
         np.concatenate(xs), np.concatenate(ys), n_total, y_sum,
         sorted(labels), d,

@@ -72,15 +72,25 @@ def _batch_xy(batch, features_col: str, label_col: str):
 # --------------------------------------------------------------------------
 
 def sample_cap_rows(d: int, n_partitions: int) -> int:
-    """Per-partition sample cap: bounded by a ~1M-element per-partition
-    payload (so wide features shrink the row cap) AND a ~128k-row total
-    budget across partitions — the driver merge stays MBs regardless of
-    feature width or partition count (Spark ML's findSplits samples with
-    the same total-budget shape)."""
+    """Per-partition sample-row cap: bounded by a ~1M-element per-partition
+    payload (wide features shrink the row cap) and a 128k-row total-budget
+    share, floored at 256 rows for quantile quality. The floor can exceed
+    the total budget on many-partition fits — ``sample_partition_count``
+    then bounds HOW MANY partitions emit samples, so the driver merge
+    stays ≤ ~64 MB no matter what (Spark ML's findSplits samples with the
+    same total-budget shape)."""
     return max(
         256,
         min(8192, (1 << 20) // max(d, 1), 131072 // max(n_partitions, 1)),
     )
+
+
+def sample_partition_count(cap: int, d: int, n_partitions: int) -> int:
+    """How many partitions contribute sample ROWS to pass 1 (all
+    partitions still contribute counts/labels): the smallest count whose
+    total sample payload stays under ~64 MB f64."""
+    budget_elems = 1 << 23
+    return int(np.clip(budget_elems // max(cap * d, 1), 1, n_partitions))
 
 
 def partition_forest_sample(
@@ -89,14 +99,19 @@ def partition_forest_sample(
     label_col: str,
     seed: int,
     cap: int = 8192,
+    sample_parts: Optional[int] = None,
 ) -> Iterator[Dict[str, object]]:
-    """One row per partition: a ≤``cap``-row uniform reservoir sample of
-    (x, y) for driver-side quantile-bin fitting, plus the partition's row
-    count, label sum, and distinct labels (≤101 retained — enough to
-    detect both a class set and a continuous target). One cheap pass, the
-    analogue of Spark ML's sampled ``findSplits``; callers size ``cap``
-    with ``sample_cap_rows`` so the driver merge stays bounded."""
-    rng = np.random.default_rng([seed & 0x7FFFFFFF, partition_identity()])
+    """One row per partition: a ≤``cap``-row uniform sample of (x, y) for
+    driver-side quantile-bin fitting, plus the partition's row count,
+    label sum, and distinct labels (≤101 retained — enough to detect both
+    a class set and a continuous target). One cheap pass, the analogue of
+    Spark ML's sampled ``findSplits``; callers size ``cap`` with
+    ``sample_cap_rows`` and ``sample_parts`` with
+    ``sample_partition_count`` — partitions past that index contribute
+    counts/labels but EMPTY sample arrays, bounding the driver merge."""
+    pid = partition_identity()
+    emit_sample = sample_parts is None or pid < sample_parts
+    rng = np.random.default_rng([seed & 0x7FFFFFFF, pid])
     buf_x: List[np.ndarray] = []
     buf_y: List[np.ndarray] = []
     buffered = 0
@@ -117,29 +132,40 @@ def partition_forest_sample(
         # random-downsample to 4·cap whenever the buffer overflows, take
         # cap at the end (exact uniformity doesn't matter for quantile
         # edges; per-row reservoir updates would be Python-loop slow)
-        buf_x.append(x)
-        buf_y.append(y)
-        buffered += x.shape[0]
-        if buffered > 4 * cap:
-            xa = np.concatenate(buf_x)
-            ya = np.concatenate(buf_y)
-            keep = rng.choice(xa.shape[0], 4 * cap, replace=False)
-            buf_x, buf_y = [xa[keep]], [ya[keep]]
-            buffered = 4 * cap
+        if emit_sample:
+            buf_x.append(x)
+            buf_y.append(y)
+            buffered += x.shape[0]
+            if buffered > 4 * cap:
+                xa = np.concatenate(buf_x)
+                ya = np.concatenate(buf_y)
+                keep = rng.choice(xa.shape[0], 4 * cap, replace=False)
+                buf_x, buf_y = [xa[keep]], [ya[keep]]
+                buffered = 4 * cap
+        else:
+            d_seen = x.shape[1]
     if n_seen == 0:
         return
-    xa = np.concatenate(buf_x)
-    ya = np.concatenate(buf_y)
-    if xa.shape[0] > cap:
-        keep = rng.choice(xa.shape[0], cap, replace=False)
-        xa, ya = xa[keep], ya[keep]
+    if emit_sample:
+        xa = np.concatenate(buf_x)
+        ya = np.concatenate(buf_y)
+        if xa.shape[0] > cap:
+            keep = rng.choice(xa.shape[0], cap, replace=False)
+            xa, ya = xa[keep], ya[keep]
+        sample_x = xa.ravel().tolist()
+        sample_y = ya.tolist()
+        d = int(xa.shape[1])
+    else:
+        sample_x = []
+        sample_y = []
+        d = int(d_seen)
     yield {
         "n": n_seen,
         "y_sum": y_sum,
         "labels": sorted(labels)[:102],
-        "sample_x": xa.ravel().tolist(),
-        "sample_y": ya.tolist(),
-        "d": int(xa.shape[1]),
+        "sample_x": sample_x,
+        "sample_y": sample_y,
+        "d": d,
     }
 
 
