@@ -62,5 +62,65 @@ def main() -> None:
     print("LinearSVC accuracy:", round(float(svc_acc), 3))
 
 
+
+
+def statistics_planes_example():
+    """Round-4 planes: RF/GBT grow per-level over executor histogram
+    partials, scalers/TruncatedSVD reduce one moments/Gram pass, and
+    NearestNeighbors answers queries executor-side — no fit here ever
+    collects data rows onto the driver."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.spark import (
+        GBTRegressor,
+        NearestNeighbors,
+        StandardScaler,
+        TruncatedSVD,
+    )
+    from spark_rapids_ml_tpu.spark._compat import HAVE_PYSPARK
+
+    if HAVE_PYSPARK:  # pragma: no cover - example runs either way
+        from pyspark.sql import SparkSession
+
+        spark = SparkSession.builder.master("local[2]").getOrCreate()
+    else:
+        from spark_rapids_ml_tpu.spark.local_engine import LocalSparkSession
+
+        spark = LocalSparkSession(n_partitions=3)
+    # the _compat seam binds pyspark.ml.linalg.DenseVector when pyspark is
+    # importable (schema inference needs the UDT), the local engine's
+    # otherwise
+    from spark_rapids_ml_tpu.spark._compat import DenseVector
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(600, 8))
+    y = 1.5 * x[:, 0] - x[:, 3] + 0.1 * rng.normal(size=600)
+    df = spark.createDataFrame([
+        {"features": DenseVector(r), "label": float(v)}
+        for r, v in zip(x, y)
+    ])
+
+    gbt = GBTRegressor(maxIter=20, maxDepth=3, seed=1).fit(df)
+    pred = np.asarray(
+        [r["prediction"] for r in gbt.transform(df).collect()]
+    )
+    print("GBT (executor histogram plane) corr:",
+          round(float(np.corrcoef(pred, y)[0, 1]), 3))
+
+    scaled = StandardScaler(withMean=True, withStd=True).fit(df)
+    print("StandardScaler (moments plane) mean[0]:",
+          round(float(scaled._local.mean[0]), 4))
+
+    svd = TruncatedSVD(k=3).fit(df)
+    print("TruncatedSVD (Gram plane) sigma:",
+          np.round(svd._local.singular_values, 2).tolist())
+
+    nn = NearestNeighbors(k=3).fit(df)
+    out = nn.kneighbors_frame(df).collect()
+    print("NearestNeighbors (executor queries) first row indices:",
+          out[0]["knn_indices"])
+
+
 if __name__ == "__main__":
     main()
+    statistics_planes_example()

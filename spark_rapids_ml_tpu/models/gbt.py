@@ -25,13 +25,18 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
-from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasWeightCol,
+    Param,
+)
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class GBTParams(HasInputCol, HasDeviceId):
+class GBTParams(HasInputCol, HasDeviceId, HasWeightCol):
     labelCol = Param("labelCol", "label column name", "label")
     predictionCol = Param(
         "predictionCol", "prediction output column", "prediction"
@@ -66,6 +71,12 @@ class GBTParams(HasInputCol, HasDeviceId):
                  validator=lambda v: isinstance(v, int))
     dtype = Param("dtype", "device compute dtype", "auto",
                   validator=lambda v: v in ("auto", "float32", "float64"))
+    executorDevice = Param(
+        "executorDevice",
+        "DataFrame statistics-plane placement of the per-partition "
+        "histogram contraction: auto | on | off (the LOCAL fit always "
+        "runs on the driver's device; this governs executors only)",
+        "auto", validator=lambda v: v in ("auto", "on", "off"))
 
 
 class _GBTBase(GBTParams):
@@ -105,6 +116,9 @@ class _GBTBase(GBTParams):
             raise ValueError(
                 f"labels length {y.shape[0]} != rows {x.shape[0]}"
             )
+        # Spark 3.0 weightCol: user weights ride the mask slot of
+        # boosting_loop (multiplied into the per-round Poisson draws)
+        user_w = self._extract_weights(frame, x.shape[0])
         n, d = x.shape
         depth = self.getMaxDepth()
         n_bins = self.getMaxBins()
@@ -118,7 +132,7 @@ class _GBTBase(GBTParams):
         binned = jax.device_put(jnp.asarray(binned_np, jnp.int32), device)
         full_mask = jnp.asarray(np.ones((depth, d)), dtype=dtype)
 
-        init = gbt_init_margin(y, self._classification)
+        init = gbt_init_margin(y, self._classification, user_w)
 
         rate = float(self.getSubsamplingRate())
 
@@ -138,7 +152,9 @@ class _GBTBase(GBTParams):
 
         with timer.phase("boost"), TraceRange("gbt boost", TraceColor.RED):
             ensemble, gains = boosting_loop(
-                y_padded=y, mask=np.ones(n), n_real=n, init=init,
+                y_padded=y,
+                mask=user_w if user_w is not None else np.ones(n),
+                n_real=n, init=init,
                 max_iter=self.getMaxIter(), step_size=lr,
                 classification=self._classification,
                 subsampling_rate=rate, rng=rng, max_depth=depth,
@@ -297,14 +313,19 @@ def gbt_init_from_mean(y_mean: float, classification: bool) -> float:
     return float(y_mean)
 
 
-def gbt_init_margin(y, classification):
+def gbt_init_margin(y, classification, sample_weight=None):
     """Initial boosting margin + label validation — one definition for
     the local and distributed fits (see ``gbt_init_from_mean`` for the
-    summary-statistics form the Spark plane uses)."""
+    summary-statistics form the Spark plane uses). ``sample_weight``
+    makes the base rate / mean weighted (weightCol semantics)."""
     y = np.asarray(y, dtype=np.float64).reshape(-1)
     if classification and not np.isin(y, (0.0, 1.0)).all():
         raise ValueError("GBT classification requires 0/1 labels")
-    return gbt_init_from_mean(float(y.mean()), classification)
+    if sample_weight is not None:
+        mean = float(np.average(y, weights=sample_weight))
+    else:
+        mean = float(y.mean())
+    return gbt_init_from_mean(mean, classification)
 
 
 def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
@@ -335,12 +356,14 @@ def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
             r = y_padded - f
             hess = np.ones_like(f)
         if subsampling_rate >= 1.0:
-            # Spark semantics: 1.0 means NO subsampling (unit weights,
+            # Spark semantics: 1.0 means NO subsampling (the mask — unit,
+            # padding-zeroed, or user weightCol values — IS the weight,
             # deterministic regardless of seed)
             w = np.asarray(mask, dtype=np.float64).copy()
         else:
             w = np.zeros(len(y_padded))
             w[:n_real] = rng.poisson(subsampling_rate, n_real)
+            w *= np.asarray(mask, dtype=np.float64)
         ft, tt, leaf, g_tree, leaf_ids = grow_fn(r, w)
         if classification:
             # Newton leaf refit: the grower's mean-residual leaves are

@@ -19,13 +19,18 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
-from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasWeightCol,
+    Param,
+)
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class RandomForestParams(HasInputCol, HasDeviceId):
+class RandomForestParams(HasInputCol, HasDeviceId, HasWeightCol):
     labelCol = Param("labelCol", "label column name", "label")
     predictionCol = Param(
         "predictionCol", "prediction output column", "prediction"
@@ -62,6 +67,12 @@ class RandomForestParams(HasInputCol, HasDeviceId):
                  validator=lambda v: isinstance(v, int))
     dtype = Param("dtype", "device compute dtype", "auto",
                   validator=lambda v: v in ("auto", "float32", "float64"))
+    executorDevice = Param(
+        "executorDevice",
+        "DataFrame statistics-plane placement of the per-partition "
+        "histogram contraction: auto | on | off (the LOCAL fit always "
+        "runs on the driver's device; this governs executors only)",
+        "auto", validator=lambda v: v in ("auto", "on", "off"))
 
 
 def _subset_counts(strategy: str, d: int) -> int:
@@ -111,6 +122,9 @@ class _ForestBase(RandomForestParams):
             raise ValueError(
                 f"labels length {y.shape[0]} != rows {x.shape[0]}"
             )
+        # Spark 3.0 weightCol: user weights MULTIPLY the Poisson bootstrap
+        # weights (histograms/leaves are linear in the weight channel)
+        user_w = self._extract_weights(frame, x.shape[0])
         n, d = x.shape
         depth = self.getMaxDepth()
         n_bins = self.getMaxBins()
@@ -142,9 +156,10 @@ class _ForestBase(RandomForestParams):
         with timer.phase("grow"), TraceRange("forest grow", TraceColor.RED):
             rate = float(self.getSubsamplingRate())
             for _ in range(self.getNumTrees()):
-                w = jax.device_put(
-                    jnp.asarray(rng.poisson(rate, n), dtype=dtype), device
-                )
+                w_np = rng.poisson(rate, n).astype(np.float64)
+                if user_w is not None:
+                    w_np *= user_w
+                w = jax.device_put(jnp.asarray(w_np, dtype=dtype), device)
                 mask = np.zeros((depth, d), dtype=np.float64)
                 for lvl in range(depth):
                     cols = rng.choice(d, size=k_feats, replace=False)

@@ -588,8 +588,49 @@ class NearestNeighborsModel(_AdapterModel):
         ])
         return self._local.kneighbors(queries, k=k)
 
+    def kneighbors_frame(self, dataset, k: Optional[int] = None):
+        """Executor-side batch kNN: every partition runs its OWN queries
+        against the broadcast fitted items (host-resident after fit, so
+        closure shipping is cheap) — query rows never collect to the
+        driver, the per-row (indices, distances) results come back as a
+        DataFrame. Row order follows the input's partition-internal
+        order, the ``mapInArrow`` contract."""
+        local = self._local
+        in_col = local.getInputCol()
+        kk = k
+
+        def job(batches):
+            import pyarrow as pa
+
+            from spark_rapids_ml_tpu.spark.aggregate import (
+                vector_column_to_matrix,
+            )
+
+            for batch in batches:
+                x = vector_column_to_matrix(batch.column(in_col))
+                if x.shape[0] == 0:
+                    continue
+                dist, idx = local.kneighbors(x, k=kk)
+                yield pa.RecordBatch.from_pylist(
+                    [
+                        {
+                            "knn_indices": idx[i].tolist(),
+                            "knn_distances": dist[i].tolist(),
+                        }
+                        for i in range(x.shape[0])
+                    ],
+                    schema=pa.schema([
+                        ("knn_indices", pa.list_(pa.int64())),
+                        ("knn_distances", pa.list_(pa.float64())),
+                    ]),
+                )
+
+        return dataset.select(in_col).mapInArrow(
+            job, "knn_indices array<bigint>, knn_distances array<double>"
+        )
+
     def _transform(self, dataset):
         raise NotImplementedError(
             "NearestNeighborsModel has no column-appending transform; "
-            "use kneighbors(query_df)"
+            "use kneighbors(query_df) or kneighbors_frame(query_df)"
         )

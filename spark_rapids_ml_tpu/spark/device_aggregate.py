@@ -649,3 +649,228 @@ def partition_multinomial_stats_device(
         "loss": loss,
         "count": rows_seen,
     }
+
+
+# --------------------------------------------------------------------------
+# tree-ensemble histogram partials ON the executor's accelerator
+# --------------------------------------------------------------------------
+
+_HIST_RUN = None  # lazily-built jitted histogram program (jax is an
+# executor-optional import in this module; the compile cache must outlive
+# calls so per-batch invocations reuse the traced program)
+
+
+def _hist_device_multi(binned, local_nodes, channels, n_nodes, n_bins):
+    """(T, C, nodes, d·bins) histograms for a tree GROUP in one compiled
+    program: the bin one-hot is built ONCE per batch and every tree's
+    node-scatter runs as the same MXU contraction the in-kernel grower
+    uses (``ops.forest_kernel._channel_histograms``) — per-partition
+    executor compute, exactly where the reference put its per-partition
+    GEMM (``RapidsRowMatrix.scala:168-202``)."""
+    global _HIST_RUN
+    if _HIST_RUN is None:
+        import functools
+
+        import jax
+
+        from spark_rapids_ml_tpu.ops.forest_kernel import (
+            _bin_onehot,
+            _channel_histograms,
+        )
+
+        @functools.partial(jax.jit, static_argnames=("nn", "nb"))
+        def run(b, nodes, ch, nn, nb):
+            bin_oh = _bin_onehot(b, nb, ch.dtype)
+
+            def one(nodes_t, ch_t):
+                node_oh = jax.nn.one_hot(nodes_t, nn, dtype=ch_t.dtype)
+                return _channel_histograms(node_oh, bin_oh, ch_t)
+
+            return jax.vmap(one)(nodes, ch)
+
+        _HIST_RUN = run
+    return _HIST_RUN(binned, local_nodes, channels, n_nodes, n_bins)
+
+
+def partition_forest_histograms_device(
+    batches: Iterable,
+    features_col: str,
+    label_col: str,
+    spec: dict,
+    device_id: int = -1,
+    dtype: str = "auto",
+):
+    """Device counterpart of ``forest_plane.partition_forest_histograms``:
+    identical spec/row contract (driver combine is shared), but the
+    (C, nodes, d, bins) statistics accumulate as jitted MXU contractions
+    on this executor's accelerator. Host does the cheap parts (binning,
+    partial-tree routing, bootstrap weights); the scatter-heavy histogram
+    runs on device. f32 accumulate on accelerators — exact for counts to
+    2^24 per partition, then combined in f64 on the driver."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.models.pca import (
+        _resolve_device,
+        _resolve_dtype,
+    )
+    from spark_rapids_ml_tpu.ops.forest_kernel import apply_bin_edges
+    from spark_rapids_ml_tpu.spark.forest_plane import (
+        _batch_weights,
+        _batch_xy,
+        _draw_weights,
+        _tree_weight_stream,
+        partition_identity,
+        route_to_level_np,
+    )
+
+    edges = np.asarray(spec["edges"])
+    n_bins = int(spec["n_bins"])
+    level = int(spec["level"])
+    rate = float(spec["subsampling_rate"])
+    seed = int(spec["seed"])
+    classes = spec.get("classes")
+    trees = spec["trees"]
+    pid = partition_identity()
+    n_nodes = 2 ** level
+    d = edges.shape[0]
+    n_ch = 3 if classes is None else len(classes)
+    device = _resolve_device(device_id)
+    dt = _resolve_dtype(dtype)
+
+    streams = [
+        _tree_weight_stream(rate, seed, int(t["tree"]), pid,
+                            always_poisson=True)
+        for t in trees
+    ]
+    tree_feats = [np.asarray(t["feature"]) for t in trees]
+    tree_thrs = [np.asarray(t["threshold"]) for t in trees]
+    acc = None
+    for batch in batches:
+        x, y = _batch_xy(batch, features_col, label_col)
+        m = x.shape[0]
+        if m == 0:
+            continue
+        binned = apply_bin_edges(x, edges)
+        bucket = _bucket_rows(m)
+        w_user = _batch_weights(batch, spec.get("weight_col"), m)
+        if classes is not None:
+            y_idx = np.searchsorted(np.asarray(classes), y)
+            onehot = np.eye(len(classes))[y_idx]
+        nodes_np = np.zeros((len(trees), bucket), dtype=np.int32)
+        ch_np = np.zeros((len(trees), bucket, n_ch))
+        for ti in range(len(trees)):
+            w = _draw_weights(streams[ti], rate, m)
+            if w_user is not None:
+                w = w * w_user
+            if classes is None:
+                ch_np[ti, :m] = np.stack([w, w * y, w * y * y], axis=1)
+            else:
+                ch_np[ti, :m] = onehot * w[:, None]
+            nodes_np[ti, :m] = route_to_level_np(
+                binned, tree_feats[ti], tree_thrs[ti], level
+            )
+        binned_p = np.zeros((bucket, d), dtype=np.int32)
+        binned_p[:m] = binned
+        out = _hist_device_multi(
+            jax.device_put(jnp.asarray(binned_p), device),
+            jax.device_put(jnp.asarray(nodes_np), device),
+            jax.device_put(jnp.asarray(ch_np, dtype=dt), device),
+            n_nodes, n_bins,
+        )
+        acc = out if acc is None else acc + out
+    if acc is None:
+        return
+    acc_np = np.asarray(acc, dtype=np.float64)
+    for ti, t in enumerate(trees):
+        yield {
+            "tree": int(t["tree"]),
+            "hist": acc_np[ti].ravel().tolist(),
+        }
+
+
+def partition_gbt_histograms_device(
+    batches: Iterable,
+    features_col: str,
+    label_col: str,
+    spec: dict,
+    device_id: int = -1,
+    dtype: str = "auto",
+):
+    """Device counterpart of ``forest_plane.partition_gbt_histograms``:
+    residuals/margins compute on host from the broadcast prior ensemble,
+    the variance-channel histogram contraction runs on this executor's
+    accelerator. Same row contract as the host plane."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.models.pca import (
+        _resolve_device,
+        _resolve_dtype,
+    )
+    from spark_rapids_ml_tpu.ops.forest_kernel import apply_bin_edges
+    from spark_rapids_ml_tpu.spark.forest_plane import (
+        _batch_weights,
+        _batch_xy,
+        _draw_weights,
+        _gbt_margin,
+        _gbt_residual_hess,
+        _tree_weight_stream,
+        partition_identity,
+        route_to_level_np,
+    )
+
+    edges = np.asarray(spec["edges"])
+    n_bins = int(spec["n_bins"])
+    level = int(spec["level"])
+    depth = int(spec["depth"])
+    rate = float(spec["subsampling_rate"])
+    seed = int(spec["seed"])
+    tree_idx = int(spec["tree"])
+    pid = partition_identity()
+    n_nodes = 2 ** level
+    d = edges.shape[0]
+    device = _resolve_device(device_id)
+    dt = _resolve_dtype(dtype)
+
+    stream = _tree_weight_stream(rate, seed, tree_idx, pid,
+                                 always_poisson=False)
+    feature = np.asarray(spec["feature"])
+    threshold = np.asarray(spec["threshold"])
+    acc = None
+    for batch in batches:
+        x, y = _batch_xy(batch, features_col, label_col)
+        m = x.shape[0]
+        if m == 0:
+            continue
+        binned = apply_bin_edges(x, edges)
+        f = _gbt_margin(
+            binned, spec.get("ens_feature"), spec.get("ens_threshold"),
+            spec.get("ens_leaf"), spec["init"], spec["step_size"], depth,
+        )
+        r, _ = _gbt_residual_hess(y, f, bool(spec["classification"]))
+        w = _draw_weights(stream, rate, m)
+        w_user = _batch_weights(batch, spec.get("weight_col"), m)
+        if w_user is not None:
+            w = w * w_user
+        bucket = _bucket_rows(m)
+        ch_np = np.zeros((1, bucket, 3))
+        ch_np[0, :m] = np.stack([w, w * r, w * r * r], axis=1)
+        nodes_np = np.zeros((1, bucket), dtype=np.int32)
+        nodes_np[0, :m] = route_to_level_np(binned, feature, threshold,
+                                            level)
+        binned_p = np.zeros((bucket, d), dtype=np.int32)
+        binned_p[:m] = binned
+        out = _hist_device_multi(
+            jax.device_put(jnp.asarray(binned_p), device),
+            jax.device_put(jnp.asarray(nodes_np), device),
+            jax.device_put(jnp.asarray(ch_np, dtype=dt), device),
+            n_nodes, n_bins,
+        )
+        acc = out if acc is None else acc + out
+    if acc is None:
+        return
+    yield {
+        "tree": tree_idx,
+        "hist": np.asarray(acc[0], dtype=np.float64).ravel().tolist(),
+    }

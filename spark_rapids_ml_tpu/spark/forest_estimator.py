@@ -39,7 +39,7 @@ from spark_rapids_ml_tpu.spark.forest_plane import (
     partition_gbt_leaf_stats,
     sample_arrow_schema,
     sample_cap_rows,
-    sample_partition_count,
+    sample_partition_stride,
     sample_spark_ddl,
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
@@ -58,10 +58,10 @@ def _num_partitions(df) -> int:
         return 8
 
 
-def _collect_sample(df, fcol, lcol, seed):
+def _collect_sample(df, fcol, lcol, seed, wcol=None):
     """Pass 1: driver-side merge of the per-partition samples → (edges
-    input sample, y stats, distinct labels, n, d). The per-partition cap
-    shrinks with feature width and partition count
+    input sample, y stats, distinct labels, n, Σw, d). The per-partition
+    cap shrinks with feature width and partition count
     (``forest_plane.sample_cap_rows``) so this merge — the ONLY data that
     ever reaches the driver — stays bounded at MBs."""
     first = df.first()
@@ -70,13 +70,14 @@ def _collect_sample(df, fcol, lcol, seed):
     width = len(first[0])
     n_parts = _num_partitions(df)
     cap = sample_cap_rows(width, n_parts)
-    sample_parts = sample_partition_count(cap, width, n_parts)
+    stride = sample_partition_stride(cap, width, n_parts)
 
     def job(batches):
         import pyarrow as pa
 
         for row in partition_forest_sample(
-            batches, fcol, lcol, seed, cap=cap, sample_parts=sample_parts
+            batches, fcol, lcol, seed, cap=cap, sample_stride=stride,
+            weight_col=wcol,
         ):
             yield pa.RecordBatch.from_pylist(
                 [row], schema=sample_arrow_schema()
@@ -89,6 +90,7 @@ def _collect_sample(df, fcol, lcol, seed):
     xs, ys = [], []
     n_total = 0
     y_sum = 0.0
+    w_sum = 0.0
     labels: set = set()
     for r in rows:
         if int(r["d"]) != d:
@@ -97,6 +99,7 @@ def _collect_sample(df, fcol, lcol, seed):
             )
         n_total += int(r["n"])
         y_sum += float(r["y_sum"])
+        w_sum += float(r["w_sum"])
         labels.update(float(v) for v in r["labels"])
         if len(r["sample_x"]):  # non-sampling partitions send empty arrays
             xs.append(
@@ -106,16 +109,35 @@ def _collect_sample(df, fcol, lcol, seed):
     if not xs:
         raise ValueError("no sampled rows (all sampling partitions empty)")
     return (
-        np.concatenate(xs), np.concatenate(ys), n_total, y_sum,
+        np.concatenate(xs), np.concatenate(ys), n_total, y_sum, w_sum,
         sorted(labels), d,
     )
 
 
-def _hist_job(df, partition_fn, fcol, lcol, spec):
+def _hist_job(df, partition_fn, fcol, lcol, spec, device_sel=None):
+    """One per-level statistics job. ``device_sel`` = (device_partition_fn,
+    executorDevice, deviceId, dtype): when given, the executor task runs
+    the histogram contraction on its OWN accelerator (auto/on) or the
+    host f64 plane (off) — the same chooser the PCA/LogReg planes use."""
+    if device_sel is not None:
+        from spark_rapids_ml_tpu.spark.estimator import _select_stats_plane
+
+        device_fn, executor_device, device_id, dtype = device_sel
+        fn = _select_stats_plane(
+            executor_device,
+            lambda b, _s=spec: device_fn(
+                b, fcol, lcol, _s, device_id, dtype
+            ),
+            lambda b, _s=spec: partition_fn(b, fcol, lcol, _s),
+        )
+    else:
+        def fn(b, _s=spec):
+            return partition_fn(b, fcol, lcol, _s)
+
     def job(batches):
         import pyarrow as pa
 
-        for row in partition_fn(batches, fcol, lcol, spec):
+        for row in fn(batches):
             yield pa.RecordBatch.from_pylist(
                 [row], schema=hist_arrow_schema()
             )
@@ -165,12 +187,24 @@ def _fit_forest_plane(local_est, dataset, classification):
     min_leaf = int(local_est.getMinInstancesPerNode())
     rate = float(local_est.getSubsamplingRate())
     seed = int(local_est.getSeed())
+    wcol = local_est.get_or_default("weightCol") or None
+    from spark_rapids_ml_tpu.spark.device_aggregate import (
+        partition_forest_histograms_device,
+    )
 
-    df = dataset.select(fcol, lcol).persist()
+    device_sel = (
+        partition_forest_histograms_device,
+        local_est.getExecutorDevice(),
+        int(local_est.getDeviceId()),
+        local_est.getDtype(),
+    )
+
+    cols = [fcol, lcol] + ([wcol] if wcol else [])
+    df = dataset.select(*cols).persist()
     try:
         with timer.phase("sample"):
-            sx, sy, n_total, _y_sum, labels, d = _collect_sample(
-                df, fcol, lcol, seed
+            sx, sy, n_total, _y_sum, _w_sum, labels, d = _collect_sample(
+                df, fcol, lcol, seed, wcol=wcol
             )
             _, edges = quantile_bins(sx, n_bins)
         classes = None
@@ -211,7 +245,7 @@ def _fit_forest_plane(local_est, dataset, classification):
                     spec = {
                         "edges": edges, "n_bins": n_bins, "level": level,
                         "subsampling_rate": rate, "seed": seed,
-                        "classes": classes,
+                        "classes": classes, "weight_col": wcol,
                         "trees": [
                             {"tree": t, "feature": feature_arr[t],
                              "threshold": threshold_arr[t]}
@@ -219,7 +253,8 @@ def _fit_forest_plane(local_est, dataset, classification):
                         ],
                     }
                     rows = _hist_job(
-                        df, partition_forest_histograms, fcol, lcol, spec
+                        df, partition_forest_histograms, fcol, lcol, spec,
+                        device_sel=device_sel,
                     )
                     per_tree = combine_hist_rows(
                         rows, n_ch * n_nodes * d * n_bins
@@ -239,7 +274,7 @@ def _fit_forest_plane(local_est, dataset, classification):
                 spec = {
                     "edges": edges, "depth": depth,
                     "subsampling_rate": rate, "seed": seed,
-                    "classes": classes,
+                    "classes": classes, "weight_col": wcol,
                     "trees": [
                         {"tree": t, "feature": feature_arr[t],
                          "threshold": threshold_arr[t]}
@@ -310,19 +345,32 @@ def _fit_gbt_plane(local_est, dataset, classification):
     min_leaf = int(local_est.getMinInstancesPerNode())
     rate = float(local_est.getSubsamplingRate())
     seed = int(local_est.getSeed())
+    wcol = local_est.get_or_default("weightCol") or None
+    from spark_rapids_ml_tpu.spark.device_aggregate import (
+        partition_gbt_histograms_device,
+    )
 
-    df = dataset.select(fcol, lcol).persist()
+    device_sel = (
+        partition_gbt_histograms_device,
+        local_est.getExecutorDevice(),
+        int(local_est.getDeviceId()),
+        local_est.getDtype(),
+    )
+
+    cols = [fcol, lcol] + ([wcol] if wcol else [])
+    df = dataset.select(*cols).persist()
     try:
         with timer.phase("sample"):
-            sx, _sy, n_total, y_sum, labels, d = _collect_sample(
-                df, fcol, lcol, seed
+            sx, _sy, n_total, y_sum, w_sum, labels, d = _collect_sample(
+                df, fcol, lcol, seed, wcol=wcol
             )
             _, edges = quantile_bins(sx, n_bins)
         from spark_rapids_ml_tpu.models.gbt import gbt_init_from_mean
 
         if classification and not set(labels) <= {0.0, 1.0}:
             raise ValueError("GBT classification requires 0/1 labels")
-        init = gbt_init_from_mean(y_sum / n_total, classification)
+        # weighted label mean (w_sum == n when unweighted)
+        init = gbt_init_from_mean(y_sum / max(w_sum, 1e-300), classification)
 
         n_int = 2 ** depth - 1
         n_leaves = 2 ** depth
@@ -339,6 +387,7 @@ def _fit_gbt_plane(local_est, dataset, classification):
                     "subsampling_rate": rate, "seed": seed, "tree": m,
                     "init": init, "step_size": step,
                     "classification": classification,
+                    "weight_col": wcol,
                     "ens_feature": (
                         np.stack(ens_f) if ens_f else None
                     ),
@@ -354,7 +403,8 @@ def _fit_gbt_plane(local_est, dataset, classification):
                         feature=feature, threshold=threshold,
                     )
                     rows = _hist_job(
-                        df, partition_gbt_histograms, fcol, lcol, spec
+                        df, partition_gbt_histograms, fcol, lcol, spec,
+                        device_sel=device_sel,
                     )
                     h = combine_hist_rows(
                         rows, 3 * n_nodes * d * n_bins

@@ -67,6 +67,23 @@ def _batch_xy(batch, features_col: str, label_col: str):
     return x, y
 
 
+def _batch_weights(batch, weight_col: Optional[str], n: int):
+    """Validated per-row weightCol values for one batch (None when the
+    fit is unweighted or the batch is a plain test tuple)."""
+    if not weight_col or not hasattr(batch, "column"):
+        return None
+    w = np.asarray(
+        batch.column(weight_col).to_pylist(), dtype=np.float64
+    ).reshape(-1)
+    if w.shape[0] != n:
+        raise ValueError(
+            f"weight column length {w.shape[0]} != batch rows {n}"
+        )
+    if not np.isfinite(w).all() or (w < 0).any():
+        raise ValueError("weights must be finite and non-negative")
+    return w
+
+
 # --------------------------------------------------------------------------
 # pass 1: per-partition row sample (bin edges) + label facts
 # --------------------------------------------------------------------------
@@ -85,12 +102,18 @@ def sample_cap_rows(d: int, n_partitions: int) -> int:
     )
 
 
-def sample_partition_count(cap: int, d: int, n_partitions: int) -> int:
-    """How many partitions contribute sample ROWS to pass 1 (all
-    partitions still contribute counts/labels): the smallest count whose
-    total sample payload stays under ~64 MB f64."""
+def sample_partition_stride(cap: int, d: int, n_partitions: int) -> int:
+    """Stride between sampling partitions in pass 1 (all partitions still
+    contribute counts/labels; every stride-th contributes sample ROWS):
+    chosen so the total sample payload stays under ~64 MB f64. A STRIDE —
+    not a prefix — so partition-ordered/clustered data still yields bin
+    edges from across the whole dataset, and a run of empty leading
+    partitions can't starve the sample."""
     budget_elems = 1 << 23
-    return int(np.clip(budget_elems // max(cap * d, 1), 1, n_partitions))
+    n_sampling = int(np.clip(
+        budget_elems // max(cap * d, 1), 1, n_partitions
+    ))
+    return max(1, n_partitions // n_sampling)
 
 
 def partition_forest_sample(
@@ -99,24 +122,27 @@ def partition_forest_sample(
     label_col: str,
     seed: int,
     cap: int = 8192,
-    sample_parts: Optional[int] = None,
+    sample_stride: int = 1,
+    weight_col: Optional[str] = None,
 ) -> Iterator[Dict[str, object]]:
     """One row per partition: a ≤``cap``-row uniform sample of (x, y) for
     driver-side quantile-bin fitting, plus the partition's row count,
     label sum, and distinct labels (≤101 retained — enough to detect both
     a class set and a continuous target). One cheap pass, the analogue of
     Spark ML's sampled ``findSplits``; callers size ``cap`` with
-    ``sample_cap_rows`` and ``sample_parts`` with
-    ``sample_partition_count`` — partitions past that index contribute
-    counts/labels but EMPTY sample arrays, bounding the driver merge."""
+    ``sample_cap_rows`` and ``sample_stride`` with
+    ``sample_partition_stride`` — only every stride-th partition
+    contributes sample ROWS (counts/labels flow from all), bounding the
+    driver merge without biasing toward a partition prefix."""
     pid = partition_identity()
-    emit_sample = sample_parts is None or pid < sample_parts
+    emit_sample = pid % max(sample_stride, 1) == 0
     rng = np.random.default_rng([seed & 0x7FFFFFFF, pid])
     buf_x: List[np.ndarray] = []
     buf_y: List[np.ndarray] = []
     buffered = 0
     n_seen = 0
     y_sum = 0.0
+    w_sum = 0.0
     labels: set = set()
     for batch in batches:
         x, y = _batch_xy(batch, features_col, label_col)
@@ -125,7 +151,14 @@ def partition_forest_sample(
         if not np.isfinite(y).all():
             raise ValueError("labels must be finite")
         n_seen += x.shape[0]
-        y_sum += float(y.sum())
+        w_user = _batch_weights(batch, weight_col, x.shape[0])
+        if w_user is None:
+            y_sum += float(y.sum())
+            w_sum += float(x.shape[0])
+        else:
+            # weighted label mean for the GBT init margin
+            y_sum += float((w_user * y).sum())
+            w_sum += float(w_user.sum())
         if len(labels) <= 101:
             labels.update(np.unique(y).tolist())
         # approximately-uniform vectorized sampling: buffer whole batches,
@@ -162,6 +195,7 @@ def partition_forest_sample(
     yield {
         "n": n_seen,
         "y_sum": y_sum,
+        "w_sum": w_sum,
         "labels": sorted(labels)[:102],
         "sample_x": sample_x,
         "sample_y": sample_y,
@@ -175,6 +209,7 @@ def sample_arrow_schema():
     return pa.schema([
         ("n", pa.int64()),
         ("y_sum", pa.float64()),
+        ("w_sum", pa.float64()),
         ("labels", pa.list_(pa.float64())),
         ("sample_x", pa.list_(pa.float64())),
         ("sample_y", pa.list_(pa.float64())),
@@ -183,7 +218,7 @@ def sample_arrow_schema():
 
 
 def sample_spark_ddl() -> str:
-    return ("n long, y_sum double, labels array<double>, "
+    return ("n long, y_sum double, w_sum double, labels array<double>, "
             "sample_x array<double>, sample_y array<double>, d long")
 
 
@@ -299,12 +334,15 @@ def partition_forest_histograms(
         if x.shape[0] == 0:
             continue
         seen = True
+        w_user = _batch_weights(batch, spec.get("weight_col"), x.shape[0])
         binned = apply_bin_edges(x, edges)
         if classes is not None:
             y_idx = np.searchsorted(np.asarray(classes), y)
             onehot = np.eye(len(classes))[y_idx]
         for ti, t in enumerate(trees):
             w = _draw_weights(streams[ti], rate, x.shape[0])
+            if w_user is not None:
+                w = w * w_user
             if classes is None:
                 channels = np.stack([w, w * y, w * y * y], axis=1)
             else:
@@ -355,12 +393,15 @@ def partition_forest_leaf_stats(
         if x.shape[0] == 0:
             continue
         seen = True
+        w_user = _batch_weights(batch, spec.get("weight_col"), x.shape[0])
         binned = apply_bin_edges(x, edges)
         if classes is not None:
             y_idx = np.searchsorted(np.asarray(classes), y)
             onehot = np.eye(len(classes))[y_idx]
         for ti, t in enumerate(trees):
             w = _draw_weights(streams[ti], rate, x.shape[0])
+            if w_user is not None:
+                w = w * w_user
             leaf = route_to_level_np(
                 binned, np.asarray(t["feature"]),
                 np.asarray(t["threshold"]), depth,
@@ -494,6 +535,9 @@ def partition_gbt_histograms(
         )
         r, _ = _gbt_residual_hess(y, f, bool(spec["classification"]))
         w = _draw_weights(stream, rate, x.shape[0])
+        w_user = _batch_weights(batch, spec.get("weight_col"), x.shape[0])
+        if w_user is not None:
+            w = w * w_user
         channels = np.stack([w, w * r, w * r * r], axis=1)
         local = route_to_level_np(
             binned, np.asarray(spec["feature"]),
@@ -543,6 +587,9 @@ def partition_gbt_leaf_stats(
         )
         r, h = _gbt_residual_hess(y, f, bool(spec["classification"]))
         w = _draw_weights(stream, rate, x.shape[0])
+        w_user = _batch_weights(batch, spec.get("weight_col"), x.shape[0])
+        if w_user is not None:
+            w = w * w_user
         leaf = route_to_level_np(
             binned, np.asarray(spec["feature"]),
             np.asarray(spec["threshold"]), depth,

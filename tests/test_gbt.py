@@ -114,3 +114,47 @@ def test_gbt_feature_importances(rng):
     imp = model.feature_importances_
     np.testing.assert_allclose(imp.sum(), 1.0, atol=1e-12)
     assert imp[2] > 0.8
+
+
+def test_gbt_weight_col_weighted_leaf_means(rng):
+    """weightCol semantics: with constant features (one leaf) and
+    conflicting labels, the prediction is the WEIGHTED label mean."""
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.gbt import GBTRegressor
+
+    x = np.ones((40, 3))
+    y = np.array([10.0] * 20 + [0.0] * 20)
+    w = np.array([3.0] * 20 + [1.0] * 20)
+    frame = as_vector_frame(x, "features").with_column(
+        "label", y.tolist()
+    ).with_column("w", w.tolist())
+    m = (
+        GBTRegressor().setMaxIter(1).setStepSize(1.0)
+        .setWeightCol("w").fit(frame)
+    )
+    pred = np.asarray(
+        [r for r in m.transform(frame).column("prediction")]
+    )
+    np.testing.assert_allclose(pred, 7.5, atol=1e-9)  # (3·10+1·0)/4
+
+
+def test_forest_weight_col_runs(rng):
+    """RandomForest weightCol: user weights multiply the bootstrap; a
+    heavily up-weighted minority class must dominate the vote."""
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.random_forest import (
+        RandomForestClassifier,
+    )
+
+    x = np.ones((60, 2))
+    y = np.array([1.0] * 15 + [0.0] * 45)
+    w = np.array([10.0] * 15 + [1.0] * 45)
+    frame = as_vector_frame(x, "features").with_column(
+        "label", y.tolist()
+    ).with_column("w", w.tolist())
+    m = (
+        RandomForestClassifier().setNumTrees(5).setMaxDepth(2)
+        .setSeed(1).setWeightCol("w").fit(frame)
+    )
+    pred = np.asarray([r for r in m.transform(frame).column("prediction")])
+    assert (pred == 1.0).all()  # 150 vs 45 weighted mass

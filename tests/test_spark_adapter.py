@@ -266,3 +266,21 @@ def test_fitted_state_is_host_resident(spark, rng):
     leaves = jax.tree_util.tree_leaves(vars(model._local))
     offenders = [type(v) for v in leaves if isinstance(v, jax.Array)]
     assert not offenders, offenders
+
+
+def test_nearest_neighbors_frame_matches_driver_query(spark, rng):
+    """kneighbors_frame runs queries on executors (mapInArrow) and must
+    agree row-for-row with the driver-array kneighbors path."""
+    from spark_rapids_ml_tpu.spark import NearestNeighbors
+
+    items = rng.normal(size=(120, 5))
+    queries = rng.normal(size=(40, 5))
+    idf = _df(spark, items)
+    qdf = _df(spark, queries)
+    model = NearestNeighbors(k=4).fit(idf)
+    d_ref, i_ref = model.kneighbors(qdf)
+    out = model.kneighbors_frame(qdf).collect()
+    i_frame = np.stack([np.asarray(r["knn_indices"]) for r in out])
+    d_frame = np.stack([np.asarray(r["knn_distances"]) for r in out])
+    np.testing.assert_array_equal(i_frame, i_ref)
+    np.testing.assert_allclose(d_frame, d_ref, atol=1e-12)

@@ -513,3 +513,88 @@ def test_moments_plane_never_collects_rows(spark, rng, monkeypatch):
     np.testing.assert_allclose(
         svd._local.singular_values, s_ref[:3], rtol=1e-8
     )
+
+
+def test_forest_executor_device_matches_host_plane(spark, rng):
+    """executorDevice='on' runs the per-partition histogram contraction
+    on the executor's accelerator (CPU jax devices here); the grown trees
+    must match the host-f64 plane's."""
+    from spark_rapids_ml_tpu.spark import GBTRegressor, RandomForestClassifier
+
+    x = rng.normal(size=(300, 6))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    on = RandomForestClassifier(
+        numTrees=6, maxDepth=3, seed=2, executorDevice="on"
+    ).fit(df)
+    off = RandomForestClassifier(
+        numTrees=6, maxDepth=3, seed=2, executorDevice="off"
+    ).fit(df)
+    np.testing.assert_array_equal(
+        np.asarray(on._local.ensemble_.feature),
+        np.asarray(off._local.ensemble_.feature),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(on._local.ensemble_.threshold),
+        np.asarray(off._local.ensemble_.threshold),
+    )
+
+    y2 = x[:, 0] - 0.3 * x[:, 2]
+    df2 = _vector_df(spark, x, extra_cols=[("label", y2.tolist())])
+    gon = GBTRegressor(
+        maxIter=8, maxDepth=2, seed=3, executorDevice="on"
+    ).fit(df2)
+    goff = GBTRegressor(
+        maxIter=8, maxDepth=2, seed=3, executorDevice="off"
+    ).fit(df2)
+    np.testing.assert_array_equal(
+        np.asarray(gon._local.ensemble_.feature),
+        np.asarray(goff._local.ensemble_.feature),
+    )
+    p_on = np.asarray(
+        [r["prediction"] for r in gon.transform(df2).collect()]
+    )
+    p_off = np.asarray(
+        [r["prediction"] for r in goff.transform(df2).collect()]
+    )
+    np.testing.assert_allclose(p_on, p_off, atol=1e-8)
+
+
+def test_gbt_plane_weight_col_matches_local(spark, rng):
+    """weightCol on the GBT statistics plane: with subsamplingRate=1.0
+    boosting is deterministic, the plane's sampled bin edges cover every
+    row (n < cap), and the weighted histograms are f64 — so the
+    DataFrame fit must reproduce the LOCAL weighted fit exactly."""
+    from spark_rapids_ml_tpu.models.gbt import GBTRegressor as LocalGBT
+    from spark_rapids_ml_tpu.spark import GBTRegressor
+
+    n, d_ = 200, 4
+    x = rng.normal(size=(n, d_))
+    y = x[:, 0] - 0.5 * x[:, 2] + 0.05 * rng.normal(size=n)
+    w = rng.uniform(0.5, 3.0, size=n)
+    df = _vector_df(
+        spark, x,
+        extra_cols=[("label", y.tolist()), ("w", w.tolist())],
+    )
+    plane = GBTRegressor(
+        maxIter=6, maxDepth=3, seed=5, weightCol="w"
+    ).fit(df)
+
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    frame = as_vector_frame(x, "features").with_column(
+        "label", y.tolist()
+    ).with_column("w", w.tolist())
+    local = (
+        LocalGBT().setMaxIter(6).setMaxDepth(3).setSeed(5)
+        .setWeightCol("w").fit(frame)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plane._local.ensemble_.feature),
+        np.asarray(local.ensemble_.feature),
+    )
+    np.testing.assert_allclose(
+        np.asarray(plane._local.ensemble_.leaf_value),
+        np.asarray(local.ensemble_.leaf_value),
+        atol=1e-8,
+    )
