@@ -102,6 +102,23 @@ class _GBTBase(GBTParams):
             quantile_bins,
         )
 
+        # out-of-core: a zero-arg callable yielding (x, y) chunks fits
+        # through the statistics-plane driver loop (maxIter × (depth+1)
+        # passes; margins recomputed per pass) — bounded memory
+        if callable(dataset) and labels is None:
+            self._reject_streamed_weights()
+            from spark_rapids_ml_tpu.spark.forest_estimator import (
+                fit_gbt_streamed,
+            )
+
+            return fit_gbt_streamed(self, dataset, self._classification)
+        if hasattr(dataset, "__next__"):
+            raise ValueError(
+                "tree fits need a RE-ITERABLE source (one pass per tree "
+                "level): pass a zero-arg callable returning an iterable "
+                "of (x, y) chunks, not a one-shot iterator"
+            )
+
         timer = PhaseTimer()
         frame = as_vector_frame(dataset, self.getInputCol())
         with timer.phase("densify"):

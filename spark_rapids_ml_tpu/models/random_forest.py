@@ -53,9 +53,14 @@ class RandomForestParams(HasInputCol, HasDeviceId, HasWeightCol):
     )
     featureSubsetStrategy = Param(
         "featureSubsetStrategy",
-        "features considered per level: all | sqrt | onethird",
+        "features considered per level: auto | all | sqrt | onethird | "
+        "log2 | an int n | a fraction in (0,1] (Spark's full value "
+        "surface; 'auto' = sqrt for classification, onethird for "
+        "regression, Spark's convention). Default 'all' — a documented "
+        "deviation from Spark's 'auto' default, keeping fits "
+        "deterministic-by-default",
         "all",
-        validator=lambda v: v in ("all", "sqrt", "onethird"),
+        validator=lambda v: _valid_subset_strategy(v),
     )
     subsamplingRate = Param(
         "subsamplingRate",
@@ -75,12 +80,54 @@ class RandomForestParams(HasInputCol, HasDeviceId, HasWeightCol):
         "auto", validator=lambda v: v in ("auto", "on", "off"))
 
 
-def _subset_counts(strategy: str, d: int) -> int:
+def _parse_numeric_subset(v):
+    """(kind, value) for numeric featureSubsetStrategy values, following
+    Spark's lexical rule: an INT (or int-looking string, no decimal
+    point) is a feature COUNT ≥ 1; a decimal is a FRACTION in (0, 1] —
+    so "1.0" means ALL features while "1" means one feature. Returns
+    None when v is not numeric."""
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, int):
+        return ("count", v) if v >= 1 else None
+    if isinstance(v, float):
+        return ("fraction", v) if 0.0 < v <= 1.0 else None
+    if isinstance(v, str):
+        try:
+            f = float(v)
+        except ValueError:
+            return None
+        if "." in v or "e" in v.lower():
+            return ("fraction", f) if 0.0 < f <= 1.0 else None
+        return ("count", int(f)) if f >= 1 else None
+    return None
+
+
+def _valid_subset_strategy(v) -> bool:
+    if isinstance(v, str) and v in ("auto", "all", "sqrt", "onethird",
+                                    "log2"):
+        return True
+    return _parse_numeric_subset(v) is not None
+
+
+def _subset_counts(strategy, d: int, classification: bool = False) -> int:
+    """Features per level under Spark's featureSubsetStrategy surface
+    (RandomForestParams doc): named strategies, an int count, or a
+    fraction of d (fractions and log2 round UP, Spark's convention)."""
+    if strategy == "auto":
+        strategy = "sqrt" if classification else "onethird"
+    if strategy == "all":
+        return d
     if strategy == "sqrt":
         return max(1, int(np.sqrt(d)))
     if strategy == "onethird":
         return max(1, d // 3)
-    return d
+    if strategy == "log2":
+        return max(1, int(np.ceil(np.log2(d))))
+    kind, value = _parse_numeric_subset(strategy)
+    if kind == "count":
+        return min(d, value)
+    return min(d, max(1, int(np.ceil(value * d))))
 
 
 class _ForestBase(RandomForestParams):
@@ -107,6 +154,23 @@ class _ForestBase(RandomForestParams):
             grow_tree_regression,
             quantile_bins,
         )
+
+        # out-of-core: a zero-arg callable yielding (x, y) chunks fits
+        # through the statistics-plane driver loop (one pass per tree
+        # level) — bounded memory, never the dense matrix
+        if callable(dataset) and labels is None:
+            self._reject_streamed_weights()
+            from spark_rapids_ml_tpu.spark.forest_estimator import (
+                fit_forest_streamed,
+            )
+
+            return fit_forest_streamed(self, dataset, self._classification)
+        if hasattr(dataset, "__next__"):
+            raise ValueError(
+                "tree fits need a RE-ITERABLE source (one pass per tree "
+                "level): pass a zero-arg callable returning an iterable "
+                "of (x, y) chunks, not a one-shot iterator"
+            )
 
         timer = PhaseTimer()
         frame = as_vector_frame(dataset, self.getInputCol())
@@ -151,7 +215,9 @@ class _ForestBase(RandomForestParams):
         else:
             y_dev = jax.device_put(jnp.asarray(y, dtype=dtype), device)
 
-        k_feats = _subset_counts(self.getFeatureSubsetStrategy(), d)
+        k_feats = _subset_counts(
+            self.getFeatureSubsetStrategy(), d, self._classification
+        )
         feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
         with timer.phase("grow"), TraceRange("forest grow", TraceColor.RED):
             rate = float(self.getSubsamplingRate())

@@ -223,7 +223,9 @@ def _fit_forest_plane(local_est, dataset, classification):
         ))
 
         rng = np.random.default_rng(seed)
-        k_feats = _subset_counts(local_est.getFeatureSubsetStrategy(), d)
+        k_feats = _subset_counts(
+            local_est.getFeatureSubsetStrategy(), d, classification
+        )
         masks = np.zeros((n_trees, depth, d))
         for t in range(n_trees):
             for lvl in range(depth):
@@ -497,3 +499,66 @@ class GBTRegressor(_adapter.GBTRegressor):
             self._local, dataset, classification=False
         )
         return self._model_cls(local_model)
+
+
+class _StreamFrame:
+    """Minimal DataFrame-shaped shim over a RE-ITERABLE (x, y) chunk
+    factory, letting the LOCAL out-of-core tree fits reuse the Spark
+    statistics-plane driver loop verbatim: the whole stream is one
+    'partition', each per-level job is one pass over the factory. The
+    partition functions already accept plain (x, y) tuples alongside
+    Arrow batches, so nothing else changes."""
+
+    def __init__(self, factory):
+        self._factory = factory
+
+    def select(self, *_cols):
+        return self
+
+    def persist(self, *_):
+        return self
+
+    def unpersist(self, *_):
+        return self
+
+    def first(self):
+        for x, y in self._factory():
+            x = np.asarray(x)
+            if x.shape[0]:
+                return [x[0], float(np.asarray(y).ravel()[0])]
+        return None
+
+    def mapInArrow(self, fn, _ddl):
+        factory = self._factory
+
+        class _Result:
+            @staticmethod
+            def collect():
+                def tuples():
+                    for x, y in factory():
+                        yield (
+                            np.asarray(x, dtype=np.float64),
+                            np.asarray(y, dtype=np.float64).reshape(-1),
+                        )
+
+                out = []
+                for rb in fn(tuples()):
+                    out.extend(rb.to_pylist())
+                return out
+
+        return _Result()
+
+
+def fit_forest_streamed(local_est, factory, classification):
+    """Out-of-core LOCAL RandomForest fit: one bin-edge sampling pass +
+    (depth+1) histogram passes per tree group over the chunk factory —
+    bounded memory (sample + per-level statistics tensors), never the
+    dense matrix. Returns the fitted local model."""
+    return _fit_forest_plane(local_est, _StreamFrame(factory),
+                             classification)
+
+
+def fit_gbt_streamed(local_est, factory, classification):
+    """Out-of-core LOCAL GBT fit over the same shim (maxIter × (depth+1)
+    passes; margins recomputed from the growing ensemble per pass)."""
+    return _fit_gbt_plane(local_est, _StreamFrame(factory), classification)

@@ -92,8 +92,8 @@ def sample_cap_rows(d: int, n_partitions: int) -> int:
     """Per-partition sample-row cap: bounded by a ~1M-element per-partition
     payload (wide features shrink the row cap) and a 128k-row total-budget
     share, floored at 256 rows for quantile quality. The floor can exceed
-    the total budget on many-partition fits — ``sample_partition_count``
-    then bounds HOW MANY partitions emit samples, so the driver merge
+    the total budget on many-partition fits — ``sample_partition_stride``
+    then thins WHICH partitions emit sample rows, so the driver merge
     stays ≤ ~64 MB no matter what (Spark ML's findSplits samples with the
     same total-budget shape)."""
     return max(
@@ -113,7 +113,10 @@ def sample_partition_stride(cap: int, d: int, n_partitions: int) -> int:
     n_sampling = int(np.clip(
         budget_elems // max(cap * d, 1), 1, n_partitions
     ))
-    return max(1, n_partitions // n_sampling)
+    # ceil division: floor would admit up to ~2x n_sampling emitters
+    # (e.g. 15 partitions / 8 budgeted -> stride 1 = all 15), breaking
+    # the 64 MB driver-merge bound
+    return -(-n_partitions // n_sampling)
 
 
 def partition_forest_sample(

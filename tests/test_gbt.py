@@ -158,3 +158,48 @@ def test_forest_weight_col_runs(rng):
     )
     pred = np.asarray([r for r in m.transform(frame).column("prediction")])
     assert (pred == 1.0).all()  # 150 vs 45 weighted mass
+
+
+def test_gbt_streamed_matches_in_memory(rng):
+    """Out-of-core GBT (zero-arg chunk factory through the statistics-
+    plane driver loop): with subsamplingRate=1.0 the boosting is
+    deterministic and n < the sampling cap makes the bin edges cover
+    every row — so the streamed fit must equal the in-memory fit."""
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.gbt import GBTRegressor
+
+    n, d = 300, 4
+    x = rng.normal(size=(n, d))
+    y = x[:, 0] - 0.7 * x[:, 2] + 0.05 * rng.normal(size=n)
+
+    frame = as_vector_frame(x, "features").with_column("label", y.tolist())
+    mem = GBTRegressor().setMaxIter(6).setMaxDepth(3).setSeed(4).fit(frame)
+
+    def chunks():
+        for i in range(0, n, 64):
+            yield x[i:i + 64], y[i:i + 64]
+
+    streamed = (
+        GBTRegressor().setMaxIter(6).setMaxDepth(3).setSeed(4).fit(chunks)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(streamed.ensemble_.feature),
+        np.asarray(mem.ensemble_.feature),
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed.ensemble_.leaf_value),
+        np.asarray(mem.ensemble_.leaf_value),
+        atol=1e-8,
+    )
+    # chunked summation vs np.mean: f64 rounding only
+    np.testing.assert_allclose(streamed.init_, mem.init_, rtol=1e-12)
+
+
+def test_gbt_one_shot_iterator_rejected(rng):
+    from spark_rapids_ml_tpu.models.gbt import GBTRegressor
+
+    gen = iter([(np.ones((4, 2)), np.ones(4))])
+    import pytest
+
+    with pytest.raises(ValueError, match="RE-ITERABLE"):
+        GBTRegressor().fit(gen)

@@ -271,3 +271,86 @@ def test_feature_importances_survive_copy_and_persistence(rng, tmp_path):
     np.testing.assert_allclose(
         loaded.feature_importances_, model.feature_importances_
     )
+
+
+def test_feature_subset_strategy_surface():
+    """Spark's full featureSubsetStrategy value surface resolves to the
+    documented per-level feature counts."""
+    from spark_rapids_ml_tpu.models.random_forest import (
+        RandomForestClassifier,
+        _subset_counts,
+    )
+
+    d = 64
+    assert _subset_counts("all", d) == 64
+    assert _subset_counts("sqrt", d) == 8
+    assert _subset_counts("onethird", d) == 21
+    assert _subset_counts("log2", d) == 6
+    assert _subset_counts("log2", 9) == 4       # ceil, Spark's rounding
+    assert _subset_counts("auto", d, classification=True) == 8
+    assert _subset_counts("auto", d, classification=False) == 21
+    assert _subset_counts("10", d) == 10
+    assert _subset_counts("0.25", d) == 16
+    assert _subset_counts("0.3", 10) == 3       # ceil(0.3·10), not floor
+    assert _subset_counts(4, d) == 4
+    assert _subset_counts(0.5, d) == 32
+    # Spark's lexical rule: "1" is a COUNT of one, "1.0" a FRACTION = all
+    assert _subset_counts("1", d) == 1
+    assert _subset_counts("1.0", d) == 64
+    assert _subset_counts(1, d) == 1
+    assert _subset_counts(1.0, d) == 64
+
+    est = RandomForestClassifier()
+    for ok in ("auto", "log2", "0.5", "7", 3, 0.25, "1.0"):
+        est.set("featureSubsetStrategy", ok)
+    import pytest
+
+    for bad in ("bogus", "0.0", -1, "-3", "1.5", 2.5):
+        with pytest.raises(ValueError):
+            est.set("featureSubsetStrategy", bad)
+
+
+def test_forest_fit_with_log2_subsets(rng):
+    from spark_rapids_ml_tpu.models.random_forest import (
+        RandomForestClassifier,
+    )
+
+    x = rng.normal(size=(240, 9))
+    y = (x[:, 0] + x[:, 1] > 0).astype(float)
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    frame = as_vector_frame(x, "features").with_column("label", y.tolist())
+    m = (
+        RandomForestClassifier().setNumTrees(12).setMaxDepth(4)
+        .setSeed(0).setFeatureSubsetStrategy("log2").fit(frame)
+    )
+    pred = np.asarray([v for v in m.transform(frame).column("prediction")])
+    assert (pred == y).mean() > 0.85
+
+
+def test_forest_streamed_fit_quality(rng):
+    """Out-of-core RandomForest via a chunk factory: bounded memory, the
+    quality bar of the in-memory fit (exact tree equality is not expected
+    — the streamed plane draws bootstrap weights per (seed, tree) stream,
+    the in-memory fit from one joint stream)."""
+    from spark_rapids_ml_tpu.models.random_forest import (
+        RandomForestClassifier,
+    )
+
+    n, d = 400, 6
+    x = rng.normal(size=(n, d))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
+
+    def chunks():
+        for i in range(0, n, 128):
+            yield x[i:i + 128], y[i:i + 128]
+
+    m = (
+        RandomForestClassifier().setNumTrees(10).setMaxDepth(4)
+        .setSeed(2).fit(chunks)
+    )
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    frame = as_vector_frame(x, "features")
+    pred = np.asarray([v for v in m.transform(frame).column("prediction")])
+    assert (pred == y).mean() > 0.9
