@@ -72,6 +72,7 @@ from spark_rapids_ml_tpu.models.random_forest import (  # noqa: F401
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.models.evaluation import (  # noqa: F401
     BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
     RegressionEvaluator,
 )
 from spark_rapids_ml_tpu.models.tuning import (  # noqa: F401
@@ -123,6 +124,7 @@ __all__ = [
     "PipelineModel",
     "RegressionEvaluator",
     "BinaryClassificationEvaluator",
+    "MulticlassClassificationEvaluator",
     "ParamGridBuilder",
     "CrossValidator",
     "CrossValidatorModel",

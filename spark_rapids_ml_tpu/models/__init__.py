@@ -39,6 +39,7 @@ from spark_rapids_ml_tpu.models.random_forest import (
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
 from spark_rapids_ml_tpu.models.evaluation import (
     BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
     RegressionEvaluator,
 )
 from spark_rapids_ml_tpu.models.tuning import (
@@ -87,6 +88,7 @@ __all__ = [
     "PipelineModel",
     "RegressionEvaluator",
     "BinaryClassificationEvaluator",
+    "MulticlassClassificationEvaluator",
     "ParamGridBuilder",
     "CrossValidator",
     "CrossValidatorModel",

@@ -124,3 +124,59 @@ class BinaryClassificationEvaluator(Params):
         precision = np.concatenate([[precision[0]], precision])
         trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2
         return float(trapezoid(precision, recall))
+
+
+class MulticlassClassificationEvaluator(Params):
+    """Spark's multiclass metric set over (labelCol, predictionCol):
+    accuracy | f1 (default) | weightedPrecision | weightedRecall —
+    ``org.apache.spark.ml.evaluation.MulticlassClassificationEvaluator``
+    semantics: per-class precision/recall/F1 weighted by TRUE-class
+    frequency; absent predicted classes contribute precision 0 (Spark's
+    convention, matching sklearn's f1_score(average='weighted') with
+    zero_division=0)."""
+
+    labelCol = Param("labelCol", "label column name", "label")
+    predictionCol = Param(
+        "predictionCol", "prediction column name", "prediction"
+    )
+    metricName = Param(
+        "metricName",
+        "f1 | accuracy | weightedPrecision | weightedRecall",
+        "f1",
+        validator=lambda v: v in (
+            "f1", "accuracy", "weightedPrecision", "weightedRecall"
+        ),
+    )
+
+    def is_larger_better(self) -> bool:
+        return True
+
+    def evaluate(self, dataset) -> float:
+        frame = as_vector_frame(dataset, self.getPredictionCol())
+        y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
+        pred = np.asarray(
+            frame.column(self.getPredictionCol()), dtype=np.float64
+        )
+        if y.shape[0] == 0:
+            raise ValueError("empty dataset")
+        name = self.getMetricName()
+        if name == "accuracy":
+            return float((pred == y).mean())
+        classes = np.unique(np.concatenate([y, pred]))
+        weights = np.array([(y == c).mean() for c in classes])
+        precision = np.zeros(len(classes))
+        recall = np.zeros(len(classes))
+        for i, c in enumerate(classes):
+            tp = float(((pred == c) & (y == c)).sum())
+            pp = float((pred == c).sum())
+            ap = float((y == c).sum())
+            precision[i] = tp / pp if pp > 0 else 0.0
+            recall[i] = tp / ap if ap > 0 else 0.0
+        if name == "weightedPrecision":
+            return float((weights * precision).sum())
+        if name == "weightedRecall":
+            return float((weights * recall).sum())
+        denom = precision + recall
+        f1 = np.where(denom > 0, 2 * precision * recall
+                      / np.maximum(denom, 1e-300), 0.0)
+        return float((weights * f1).sum())

@@ -25,6 +25,8 @@ those fits are not partition-decomposable.)
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from spark_rapids_ml_tpu.spark import adapter as _adapter
@@ -44,7 +46,11 @@ from spark_rapids_ml_tpu.spark.forest_plane import (
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 
-_GROUP_BUDGET_BYTES = 64 * 1024 * 1024
+# per-partition histogram payload budget for level-synchronous tree
+# groups — the analogue of Spark ML's maxMemoryInMB aggregation knob
+_GROUP_BUDGET_BYTES = int(os.environ.get(
+    "SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES", 64 * 1024 * 1024
+))
 
 
 def _num_partitions(df) -> int:

@@ -166,3 +166,69 @@ def test_pr_auc_tie_collapse_is_order_independent():
     a = ev.evaluate(VectorFrame({"label": [1.0, 0.0], "probability": [0.5, 0.5]}))
     b = ev.evaluate(VectorFrame({"label": [0.0, 1.0], "probability": [0.5, 0.5]}))
     assert a == b == pytest.approx(0.5)
+
+
+def test_multiclass_evaluator_matches_sklearn(rng):
+    from spark_rapids_ml_tpu.models.evaluation import (
+        MulticlassClassificationEvaluator,
+    )
+
+    y = rng.integers(0, 4, 500).astype(float)
+    pred = np.where(
+        rng.random(500) < 0.7, y, rng.integers(0, 4, 500)
+    ).astype(float)
+    frame = VectorFrame({"label": y, "prediction": pred})
+    ev = MulticlassClassificationEvaluator()
+    assert ev.is_larger_better()
+    acc = ev.copy(extra={"metricName": "accuracy"}).evaluate(frame)
+    assert acc == pytest.approx(float((pred == y).mean()))
+    sklearn = pytest.importorskip("sklearn.metrics")
+    assert ev.evaluate(frame) == pytest.approx(
+        sklearn.f1_score(y, pred, average="weighted", zero_division=0)
+    )
+    assert ev.copy(
+        extra={"metricName": "weightedPrecision"}
+    ).evaluate(frame) == pytest.approx(
+        sklearn.precision_score(y, pred, average="weighted",
+                                zero_division=0)
+    )
+    assert ev.copy(
+        extra={"metricName": "weightedRecall"}
+    ).evaluate(frame) == pytest.approx(
+        sklearn.recall_score(y, pred, average="weighted", zero_division=0)
+    )
+
+
+def test_cross_validator_multiclass(rng):
+    """CrossValidator over a multinomial LogisticRegression grid with the
+    multiclass evaluator — Spark's standard multiclass tuning loop."""
+    from spark_rapids_ml_tpu import LogisticRegression
+    from spark_rapids_ml_tpu.models.evaluation import (
+        MulticlassClassificationEvaluator,
+    )
+
+    k, d, n = 3, 4, 360
+    centers = rng.normal(scale=3, size=(k, d))
+    y = rng.integers(0, k, size=n).astype(float)
+    x = rng.normal(size=(n, d)) + centers[y.astype(int)]
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    frame = as_vector_frame(x, "features").with_column("label", y.tolist())
+    grid = (
+        ParamGridBuilder()
+        .addGrid("regParam", [0.01, 1.0])
+        .build()
+    )
+    cv = CrossValidator(
+        estimator=LogisticRegression(),
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(),
+        numFolds=3,
+        seed=7,
+    )
+    model = cv.fit(frame)
+    assert len(model.avgMetrics) == 2
+    pred = np.asarray(
+        [v for v in model.transform(frame).column("prediction")]
+    )
+    assert (pred == y).mean() > 0.85
