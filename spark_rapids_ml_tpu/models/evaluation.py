@@ -163,13 +163,14 @@ class MulticlassClassificationEvaluator(Params):
         if name == "accuracy":
             return float((pred == y).mean())
         classes = np.unique(np.concatenate([y, pred]))
-        weights = np.array([(y == c).mean() for c in classes])
+        weights = np.zeros(len(classes))
         precision = np.zeros(len(classes))
         recall = np.zeros(len(classes))
         for i, c in enumerate(classes):
             tp = float(((pred == c) & (y == c)).sum())
             pp = float((pred == c).sum())
             ap = float((y == c).sum())
+            weights[i] = ap / y.shape[0]
             precision[i] = tp / pp if pp > 0 else 0.0
             recall[i] = tp / ap if ap > 0 else 0.0
         if name == "weightedPrecision":

@@ -69,6 +69,18 @@ class GBTParams(HasInputCol, HasDeviceId, HasWeightCol):
     )
     seed = Param("seed", "subsampling seed", 0,
                  validator=lambda v: isinstance(v, int))
+    validationIndicatorCol = Param(
+        "validationIndicatorCol",
+        "boolean column marking VALIDATION rows ('' = no early stopping): "
+        "trees train on the unmarked rows and boosting stops when the "
+        "validation error stops improving by validationTol (Spark's "
+        "runWithValidation rule); the fitted ensemble keeps the trees up "
+        "to the best validation round",
+        "", validator=lambda v: isinstance(v, str))
+    validationTol = Param(
+        "validationTol",
+        "early-stopping threshold on the validation-error improvement",
+        0.01, validator=lambda v: float(v) >= 0)
     dtype = Param("dtype", "device compute dtype", "auto",
                   validator=lambda v: v in ("auto", "float32", "float64"))
     executorDevice = Param(
@@ -136,6 +148,29 @@ class _GBTBase(GBTParams):
         # Spark 3.0 weightCol: user weights ride the mask slot of
         # boosting_loop (multiplied into the per-round Poisson draws)
         user_w = self._extract_weights(frame, x.shape[0])
+
+        # validationIndicatorCol: hold marked rows out of training and
+        # stop boosting when their error stops improving
+        val_col = self.get_or_default("validationIndicatorCol")
+        x_val = y_val = None
+        if val_col:
+            ind = np.asarray(frame.column(val_col)).astype(bool).reshape(-1)
+            if ind.shape[0] != x.shape[0]:
+                raise ValueError(
+                    f"validation indicator length {ind.shape[0]} != rows "
+                    f"{x.shape[0]}"
+                )
+            if ind.all() or not ind.any():
+                raise ValueError(
+                    "validationIndicatorCol must mark SOME rows as "
+                    "validation and leave some for training"
+                )
+            x_val, y_val = x[ind], y[ind]
+            x, y = x[~ind], y[~ind]
+            w_val = None
+            if user_w is not None:
+                w_val = user_w[ind]  # Spark computes a WEIGHTED val error
+                user_w = user_w[~ind]
         n, d = x.shape
         depth = self.getMaxDepth()
         n_bins = self.getMaxBins()
@@ -167,11 +202,41 @@ class _GBTBase(GBTParams):
             return (np.asarray(ft), np.asarray(tt), np.asarray(leaf),
                     np.asarray(g_tree), np.asarray(leaf_ids_dev))
 
+        val_hook = None
+        if x_val is not None:
+            from spark_rapids_ml_tpu.ops.forest_kernel import apply_bin_edges
+            from spark_rapids_ml_tpu.spark.forest_plane import (
+                route_to_level_np,
+            )
+
+            binned_val = apply_bin_edges(x_val, edges)
+            f_val = np.full(y_val.shape[0], float(init))
+            classification = self._classification
+            vw = w_val if w_val is not None else np.ones(y_val.shape[0])
+            vw_sum = max(float(vw.sum()), 1e-300)
+
+            def val_hook(ft, tt, leaf, _f=f_val):
+                _f += lr * np.asarray(leaf)[
+                    route_to_level_np(binned_val, np.asarray(ft),
+                                      np.asarray(tt), depth)
+                ]
+                if classification:
+                    p = 1.0 / (1.0 + np.exp(-_f))
+                    p = np.clip(p, 1e-12, 1 - 1e-12)
+                    per_row = -(
+                        y_val * np.log(p) + (1 - y_val) * np.log(1 - p)
+                    )
+                else:
+                    per_row = (y_val - _f) ** 2
+                return float((vw * per_row).sum() / vw_sum)
+
         with timer.phase("boost"), TraceRange("gbt boost", TraceColor.RED):
             ensemble, gains = boosting_loop(
                 y_padded=y,
                 mask=user_w if user_w is not None else np.ones(n),
                 n_real=n, init=init,
+                val_hook=val_hook,
+                validation_tol=float(self.get_or_default("validationTol")),
                 max_iter=self.getMaxIter(), step_size=lr,
                 classification=self._classification,
                 subsampling_rate=rate, rng=rng, max_depth=depth,
@@ -347,7 +412,7 @@ def gbt_init_margin(y, classification, sample_weight=None):
 
 def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
                   classification, subsampling_rate, rng, max_depth,
-                  grow_fn):
+                  grow_fn, val_hook=None, validation_tol=0.01):
     """Shared gradient-boosting driver (local and distributed fits).
 
     ``grow_fn(r, w) -> (feature, threshold, leaf_value, leaf_ids)`` grows
@@ -358,13 +423,21 @@ def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
     ``y_padded``/``mask`` may carry zero-weight padding rows; Poisson
     weights are drawn over the REAL ``n_real`` rows so the RNG stream is
     identical with or without padding.
+
+    ``val_hook(feature, threshold, leaf) -> float``: when given, called
+    after each round with the new tree; returns the held-out validation
+    error. Boosting stops early by Spark's ``runWithValidation`` rule —
+    ``err − best > validationTol · max(err, 0.01)`` — and the returned
+    ensemble is TRUNCATED to the best validation round.
     """
     from spark_rapids_ml_tpu.ops.forest_kernel import TreeEnsemble
 
     f = np.full(len(y_padded), float(init))
     n_leaves = 2 ** max_depth
     feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
-    for _ in range(max_iter):
+    best_err = np.inf
+    best_m = -1
+    for m in range(max_iter):
         if classification:
             p = 1.0 / (1.0 + np.exp(-f))
             r = y_padded - p
@@ -394,6 +467,20 @@ def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
         thrs_l.append(tt)
         leaves_l.append(leaf)
         gains_l.append(g_tree)
+        if val_hook is not None:
+            err = float(val_hook(ft, tt, leaf))
+            # Spark's runWithValidation rule: stop as soon as the
+            # improvement over the best round falls below the tolerance
+            # (plateaus and slow improvement included); the best round is
+            # NOT advanced on the stopping round
+            if best_err - err < validation_tol * max(err, 0.01):
+                break
+            if err < best_err:
+                best_err, best_m = err, m
+    if val_hook is not None and best_m >= 0:
+        keep = best_m + 1
+        feats_l, thrs_l = feats_l[:keep], thrs_l[:keep]
+        leaves_l, gains_l = leaves_l[:keep], gains_l[:keep]
     return TreeEnsemble(
         feature=np.stack(feats_l),
         threshold=np.stack(thrs_l),

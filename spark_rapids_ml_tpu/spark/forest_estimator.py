@@ -46,11 +46,25 @@ from spark_rapids_ml_tpu.spark.forest_plane import (
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 
-# per-partition histogram payload budget for level-synchronous tree
-# groups — the analogue of Spark ML's maxMemoryInMB aggregation knob
-_GROUP_BUDGET_BYTES = int(os.environ.get(
-    "SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES", 64 * 1024 * 1024
-))
+def _group_budget_bytes() -> int:
+    """Per-partition histogram payload budget for level-synchronous tree
+    groups — the analogue of Spark ML's maxMemoryInMB aggregation knob.
+    Parsed lazily at fit time so a malformed env value fails the FIT with
+    a clear message (and later env changes take effect), not the package
+    import."""
+    raw = os.environ.get("SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES")
+    if raw is None:
+        return 64 * 1024 * 1024
+    try:
+        value = int(raw)
+        if value < 1:
+            raise ValueError
+        return value
+    except ValueError:
+        raise ValueError(
+            f"SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES={raw!r}: expected a "
+            "positive integer byte count"
+        ) from None
 
 
 def _num_partitions(df) -> int:
@@ -225,7 +239,7 @@ def _fit_forest_plane(local_est, dataset, classification):
         n_ch = len(classes) if classification else 3
         per_tree_bytes = n_ch * 2 ** (depth - 1) * d * n_bins * 8
         group = int(np.clip(
-            _GROUP_BUDGET_BYTES // max(per_tree_bytes, 1), 1, n_trees
+            _group_budget_bytes() // max(per_tree_bytes, 1), 1, n_trees
         ))
 
         rng = np.random.default_rng(seed)
@@ -344,6 +358,12 @@ def _fit_gbt_plane(local_est, dataset, classification):
     )
 
     timer = PhaseTimer()
+    if local_est.get_or_default("validationIndicatorCol"):
+        raise ValueError(
+            "validationIndicatorCol early stopping is not supported on "
+            "the DataFrame/streamed statistics plane yet; fit the local "
+            "estimator on in-memory data for early stopping"
+        )
     fcol = local_est.getInputCol()
     lcol = local_est.getLabelCol()
     max_iter = int(local_est.getMaxIter())

@@ -203,3 +203,60 @@ def test_gbt_one_shot_iterator_rejected(rng):
 
     with pytest.raises(ValueError, match="RE-ITERABLE"):
         GBTRegressor().fit(gen)
+
+
+def test_gbt_validation_early_stopping(rng):
+    """validationIndicatorCol: boosting stops when held-out error stops
+    improving and the ensemble truncates to the best round — far fewer
+    trees than maxIter on a noisy target, with held-out quality intact."""
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.gbt import GBTRegressor
+
+    n = 600
+    x = rng.normal(size=(n, 4))
+    y = x[:, 0] + 2.0 * rng.normal(size=n)  # mostly noise: overfits fast
+    ind = np.zeros(n, dtype=bool)
+    ind[rng.choice(n, 200, replace=False)] = True
+    frame = as_vector_frame(x, "features").with_column(
+        "label", y.tolist()
+    ).with_column("is_val", ind.tolist())
+    stopped = (
+        GBTRegressor().setMaxIter(60).setMaxDepth(4).setStepSize(0.3)
+        .setSeed(0).setValidationIndicatorCol("is_val").fit(frame)
+    )
+    n_trees = np.asarray(stopped.ensemble_.feature).shape[0]
+    assert n_trees < 60, "early stopping never triggered on noise"
+
+    full = (
+        GBTRegressor().setMaxIter(60).setMaxDepth(4).setStepSize(0.3)
+        .setSeed(0).fit(
+            as_vector_frame(x[~ind], "features").with_column(
+                "label", y[~ind].tolist()
+            )
+        )
+    )
+    xv = as_vector_frame(x[ind], "features")
+    mse_stop = float(np.mean((
+        np.asarray(list(stopped.transform(xv).column("prediction")))
+        - y[ind]
+    ) ** 2))
+    mse_full = float(np.mean((
+        np.asarray(list(full.transform(xv).column("prediction")))
+        - y[ind]
+    ) ** 2))
+    assert mse_stop <= mse_full * 1.05  # stopping never much worse
+
+
+def test_gbt_validation_requires_both_sides(rng):
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.gbt import GBTRegressor
+
+    x = rng.normal(size=(30, 3))
+    y = x[:, 0]
+    frame = as_vector_frame(x, "features").with_column(
+        "label", y.tolist()
+    ).with_column("is_val", [True] * 30)
+    import pytest
+
+    with pytest.raises(ValueError, match="SOME rows"):
+        GBTRegressor().setValidationIndicatorCol("is_val").fit(frame)
