@@ -28,6 +28,7 @@ from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
     HasInputCol,
+    HasThresholds,
     HasWeightCol,
     Param,
 )
@@ -344,7 +345,7 @@ class GBTRegressionModel(_GBTModelBase):
         )
 
 
-class GBTClassifierParams(GBTParams):
+class GBTClassifierParams(HasThresholds, GBTParams):
     """Shared classifier params: declared once so the estimator can set
     them pre-fit and copy_values_from carries them to the model (the
     RandomForest review lesson)."""
@@ -377,11 +378,12 @@ class GBTClassificationModel(GBTClassifierParams, _GBTModelBase):
         proba = self.predict_proba(frame)
         out = frame.with_column(self.getProbabilityCol(), proba.tolist())
         # double-typed predictions, matching Spark and the RandomForest
-        # classifier in this repo
-        return out.with_column(
-            self.getPredictionCol(),
-            (proba >= 0.5).astype(np.float64).tolist(),
-        )
+        # classifier in this repo; thresholds (if set) scale the implied
+        # [1-p, p] probability pair
+        pred = self._predict_index(
+            np.stack([1.0 - proba, proba], axis=1)
+        ).astype(np.float64)
+        return out.with_column(self.getPredictionCol(), pred.tolist())
 
 
 def gbt_init_from_mean(y_mean: float, classification: bool) -> float:
@@ -427,8 +429,10 @@ def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
     ``val_hook(feature, threshold, leaf) -> float``: when given, called
     after each round with the new tree; returns the held-out validation
     error. Boosting stops early by Spark's ``runWithValidation`` rule —
-    ``err − best > validationTol · max(err, 0.01)`` — and the returned
-    ensemble is TRUNCATED to the best validation round.
+    stop when the improvement over the best round is insufficient,
+    ``best − err < validationTol · max(err, 0.01)`` (plateaus and slow
+    improvement included) — and the returned ensemble is TRUNCATED to
+    the best validation round.
     """
     from spark_rapids_ml_tpu.ops.forest_kernel import TreeEnsemble
 

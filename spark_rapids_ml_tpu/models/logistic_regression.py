@@ -20,6 +20,7 @@ from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
     HasInputCol,
+    HasThresholds,
     HasWeightCol,
     Param,
 )
@@ -28,7 +29,8 @@ from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class LogisticRegressionParams(HasInputCol, HasDeviceId, HasWeightCol):
+class LogisticRegressionParams(HasInputCol, HasDeviceId, HasWeightCol,
+                               HasThresholds):
     labelCol = Param("labelCol", "label column name (binary 0/1)", "label")
     predictionCol = Param("predictionCol", "predicted class column",
                           "prediction")
@@ -751,13 +753,15 @@ class LogisticRegressionModel(LogisticRegressionParams):
         proba = self.predict_proba(frame)  # reuse the built frame
         out = frame.with_column(self.getProbabilityCol(), proba.tolist())
         if self.coefficient_matrix is not None:
-            pred = self.classes_[np.argmax(proba, axis=1)]
+            pred = self.classes_[self._predict_index(proba)]
             return out.with_column(
                 self.getPredictionCol(), pred.astype(np.float64).tolist()
             )
         return out.with_column(
             self.getPredictionCol(),
-            (proba >= 0.5).astype(np.int32).tolist(),
+            self._predict_index(
+                np.stack([1.0 - proba, proba], axis=1)
+            ).astype(np.int32).tolist(),
         )
 
     def evaluate(self, dataset, labels=None) -> dict:
@@ -775,12 +779,16 @@ class LogisticRegressionModel(LogisticRegressionParams):
                 & (self.classes_[np.minimum(y_idx, self.classes_.size - 1)] == y)
             ).all():
                 raise ValueError("labels contain values outside classes_")
-            acc = float((np.argmax(p, axis=1) == y_idx).mean())
+            # accuracy follows the SAME prediction rule transform uses
+            # (thresholds-aware), so reported metrics can never disagree
+            # with the emitted prediction column
+            acc = float((self._predict_index(p) == y_idx).mean())
             logloss = float(
                 -np.log(p[np.arange(len(y_idx)), y_idx]).mean()
             )
             return {"accuracy": acc, "logLoss": logloss}
-        acc = float(((p >= 0.5) == (y >= 0.5)).mean())
+        pred = self._predict_index(np.stack([1.0 - p, p], axis=1))
+        acc = float((pred == (y >= 0.5)).mean())
         logloss = float(-(y * np.log(p) + (1 - y) * np.log(1 - p)).mean())
         return {"accuracy": acc, "logLoss": logloss}
 

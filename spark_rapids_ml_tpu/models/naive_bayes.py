@@ -21,13 +21,18 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
-from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasThresholds,
+    Param,
+)
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class NaiveBayesParams(HasInputCol, HasDeviceId):
+class NaiveBayesParams(HasInputCol, HasDeviceId, HasThresholds):
     labelCol = Param("labelCol", "label column name", "label")
     predictionCol = Param(
         "predictionCol", "predicted class output column", "prediction"
@@ -212,7 +217,7 @@ class NaiveBayesModel(NaiveBayesParams):
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         proba = self.predict_proba(frame)
-        pred = self.classes_[np.argmax(proba, axis=1)]
+        pred = self.classes_[self._predict_index(proba)]
         out = frame.with_column(self.getProbabilityCol(), proba.tolist())
         return out.with_column(
             self.getPredictionCol(), pred.astype(np.float64).tolist()

@@ -22,6 +22,7 @@ from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
     HasInputCol,
+    HasThresholds,
     HasWeightCol,
     Param,
 )
@@ -354,7 +355,7 @@ class RandomForestRegressionModel(_ForestModelBase):
         )
 
 
-class RandomForestClassifierParams(RandomForestParams):
+class RandomForestClassifierParams(HasThresholds, RandomForestParams):
     """Classifier-side params: declared on estimator AND model so the
     estimator can configure them pre-fit (setProbabilityCol, grids) and
     copy_values_from carries them to the fitted model."""
@@ -385,7 +386,7 @@ class RandomForestClassificationModel(
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         proba = self._apply(frame.vectors_as_matrix(self.getInputCol()))
-        pred = self.classes_[np.argmax(proba, axis=1)]
+        pred = self.classes_[self._predict_index(proba)]
         out = frame.with_column(self.getProbabilityCol(), proba.tolist())
         return out.with_column(
             self.getPredictionCol(), pred.astype(np.float64)

@@ -335,10 +335,12 @@ class _ClassifierAdapterModel(_AdapterModel):
         def pred_udf(series):
             import pandas as pd
 
-            return pd.Series([
-                float(classes[int(np_.argmax(v.toArray()))])
-                for v in series
-            ])
+            proba = np_.stack([v.toArray() for v in series])
+            if local.has_param("thresholds"):
+                idx = local._predict_index(proba)
+            else:
+                idx = np_.argmax(proba, axis=1)
+            return pd.Series([float(classes[int(i)]) for i in idx])
 
         return result.withColumn(pred_col, pred_udf(result[proba_col]))
 

@@ -354,3 +354,54 @@ def test_forest_streamed_fit_quality(rng):
     frame = as_vector_frame(x, "features")
     pred = np.asarray([v for v in m.transform(frame).column("prediction")])
     assert (pred == y).mean() > 0.9
+
+
+def test_classifier_thresholds_rule(rng):
+    """Spark's thresholds param: prediction = argmax p(i)/t(i); a tiny
+    threshold inflates its class, a zero threshold wins whenever that
+    class has any probability."""
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.random_forest import (
+        RandomForestClassifier,
+    )
+
+    x = rng.normal(size=(300, 4))
+    y = (x[:, 0] > 0).astype(float)
+    frame = as_vector_frame(x, "features").with_column("label", y.tolist())
+    m = (
+        RandomForestClassifier().setNumTrees(10).setMaxDepth(3)
+        .setSeed(0).fit(frame)
+    )
+    base = np.asarray(list(m.transform(frame).column("prediction")))
+    # heavily favor class 0: anything not near-certain flips to 0
+    m.set("thresholds", [1e-6, 1.0])
+    skewed = np.asarray(list(m.transform(frame).column("prediction")))
+    assert (skewed == 0.0).sum() > (base == 0.0).sum()
+    # symmetric thresholds = plain argmax
+    m.set("thresholds", [0.5, 0.5])
+    np.testing.assert_array_equal(
+        np.asarray(list(m.transform(frame).column("prediction"))), base
+    )
+    import pytest
+
+    with pytest.raises(ValueError):
+        m.set("thresholds", [0.0, 0.0])   # two zeros
+    with pytest.raises(ValueError):
+        m.set("thresholds", [-0.1, 0.5])  # negative
+    m.set("thresholds", [0.3, 0.7])
+    with pytest.raises(ValueError, match="numClasses"):
+        m.set("thresholds", [0.2, 0.3, 0.5])
+        m.transform(frame)
+
+
+def test_gbt_thresholds_binary(rng):
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.gbt import GBTClassifier
+
+    x = rng.normal(size=(200, 3))
+    y = (x[:, 0] > 0).astype(float)
+    frame = as_vector_frame(x, "features").with_column("label", y.tolist())
+    m = GBTClassifier().setMaxIter(15).fit(frame)
+    m.set("thresholds", [1e-9, 1.0])
+    pred = np.asarray(list(m.transform(frame).column("prediction")))
+    assert (pred == 0.0).all()
