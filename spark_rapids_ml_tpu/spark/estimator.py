@@ -765,6 +765,26 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
         self.n_iter_ = None
         self.objective_history_ = None
 
+    @property
+    def summary(self):
+        """Spark's ``LogisticRegressionTrainingSummary`` core surface:
+        ``objectiveHistory`` (per-iteration regularized mean loss recorded
+        by the Newton plane) and ``totalIterations``."""
+        from types import SimpleNamespace
+
+        if self.objective_history_ is None:
+            raise RuntimeError(
+                "no training summary: model was loaded, not fit"
+            )
+        return SimpleNamespace(
+            objectiveHistory=list(self.objective_history_),
+            totalIterations=int(self.n_iter_ or 0),
+        )
+
+    @property
+    def hasSummary(self) -> bool:
+        return self.objective_history_ is not None
+
     def _transform(self, dataset):
         import pandas as pd
         from spark_rapids_ml_tpu.spark._compat import col, pandas_udf

@@ -598,3 +598,18 @@ def test_gbt_plane_weight_col_matches_local(spark, rng):
         np.asarray(local.ensemble_.leaf_value),
         atol=1e-8,
     )
+
+
+def test_logreg_summary_surface(spark, rng):
+    """Spark's model.summary core: objectiveHistory decreasing, iteration
+    count, hasSummary False after a persistence round-trip."""
+    x = rng.normal(size=(200, 3))
+    y = (x[:, 0] > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    m = LogisticRegression(regParam=0.05).fit(df)
+    assert m.hasSummary
+    s = m.summary
+    assert s.totalIterations >= 1
+    assert len(s.objectiveHistory) == s.totalIterations
+    hist = np.asarray(s.objectiveHistory)
+    assert hist[-1] <= hist[0] + 1e-12
