@@ -500,6 +500,11 @@ class _TpuLogRegParams(Params):
     deviceId = Param(Params._dummy(), "deviceId",
                      "executor accelerator ordinal; -1 = task assignment",
                      typeConverter=TypeConverters.toInt)
+    thresholds = Param(Params._dummy(), "thresholds",
+                       "per-class probability thresholds: prediction = "
+                       "argmax p(i)/t(i) (Spark semantics; unset = argmax "
+                       "/ p>=0.5)",
+                       typeConverter=TypeConverters.toListFloat)
 
     def __init__(self):
         super().__init__()
@@ -508,6 +513,12 @@ class _TpuLogRegParams(Params):
                          probabilityCol="probability", regParam=0.0,
                          fitIntercept=True, maxIter=25, tol=1e-8,
                          executorDevice="auto", deviceId=-1)
+
+    def _thresholds_or_none(self):
+        if self.isDefined(self.thresholds):
+            t = self.getOrDefault(self.thresholds)
+            return list(t) if t else None
+        return None
 
 
 class LogisticRegression(Estimator, _TpuLogRegParams):
@@ -807,10 +818,23 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
 
             out = dataset.withColumn(pcol, proba_m(dataset[fcol]))
 
+            thr = self._thresholds_or_none()
+            if thr is not None and len(thr) != len(classes):
+                raise ValueError(
+                    f"thresholds length {len(thr)} != numClasses "
+                    f"{len(classes)}"
+                )
+
             @pandas_udf(returnType="double")
             def pred_m(v: pd.Series) -> pd.Series:
+                proba = np.stack([r.toArray() for r in v])
+                if thr is not None:
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        proba = proba / np.asarray(thr)[None, :]
+                    proba = np.where(np.isnan(proba), -np.inf, proba)
                 return pd.Series([
-                    float(classes[int(np.argmax(r.toArray()))]) for r in v
+                    float(classes[int(i)])
+                    for i in np.argmax(proba, axis=1)
                 ])
 
             return out.withColumn(
@@ -826,11 +850,32 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
             return pd.Series(1.0 / (1.0 + np.exp(-(x @ coef + b))))
 
         out = dataset.withColumn(pcol, proba(dataset[fcol]))
-        # prediction derives from probability with a plain column expression
-        # — one densifying UDF pass, not two
+        thr = self._thresholds_or_none()
+        if thr is None:
+            # prediction derives from probability with a plain column
+            # expression — one densifying UDF pass, not two
+            return out.withColumn(
+                self.getOrDefault(self.predictionCol),
+                (col(pcol) >= 0.5).cast("double"),
+            )
+        if len(thr) != 2:
+            raise ValueError(
+                f"thresholds length {len(thr)} != numClasses 2"
+            )
+        t0, t1 = float(thr[0]), float(thr[1])
+
+        @pandas_udf(returnType="double")
+        def pred_b(v: pd.Series) -> pd.Series:
+            p = np.asarray(v, dtype=np.float64)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                s0 = (1.0 - p) / t0
+                s1 = p / t1
+            s0 = np.where(np.isnan(s0), -np.inf, s0)
+            s1 = np.where(np.isnan(s1), -np.inf, s1)
+            return pd.Series((s1 > s0).astype(np.float64))
+
         return out.withColumn(
-            self.getOrDefault(self.predictionCol),
-            (col(pcol) >= 0.5).cast("double"),
+            self.getOrDefault(self.predictionCol), pred_b(out[pcol])
         )
 
     # -- persistence (shared wire format via the local model) --------------

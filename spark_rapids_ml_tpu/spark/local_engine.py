@@ -167,6 +167,10 @@ class TypeConverters:
     def toString(v):
         return str(v)
 
+    @staticmethod
+    def toListFloat(v):
+        return [float(x) for x in v]
+
 
 class Param:
     def __init__(self, parent, name: str, doc: str = "",
@@ -234,6 +238,14 @@ class Params:
         if name in self._defaultParamMap:
             return self._defaultParamMap[name]
         raise KeyError(f"param {name} is not set and has no default")
+
+    def set(self, p, value):
+        """pyspark's public ``Params.set(param, value)``."""
+        param = self._param(p)
+        if param.typeConverter is not None:
+            value = param.typeConverter(value)
+        self._paramMap[param.name] = value
+        return self
 
     def isSet(self, p) -> bool:
         return self._param(p).name in self._paramMap

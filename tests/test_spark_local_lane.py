@@ -613,3 +613,34 @@ def test_logreg_summary_surface(spark, rng):
     assert len(s.objectiveHistory) == s.totalIterations
     hist = np.asarray(s.objectiveHistory)
     assert hist[-1] <= hist[0] + 1e-12
+
+
+def test_logreg_plane_thresholds(spark, rng):
+    """thresholds on the DataFrame LogisticRegression: binary and
+    multinomial predictions follow argmax p(i)/t(i)."""
+    x = rng.normal(size=(240, 3))
+    y = ((x[:, 0] + rng.normal(scale=1.5, size=240)) > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    m = LogisticRegression(regParam=0.05).fit(df)
+    base = np.asarray([r["prediction"] for r in m.transform(df).collect()])
+    m.set(m.thresholds, [1e-6, 1.0])  # heavily favor class 0
+    skewed = np.asarray(
+        [r["prediction"] for r in m.transform(df).collect()]
+    )
+    assert (skewed == 0.0).sum() > (base == 0.0).sum()
+
+    # multinomial: 3 classes, favor class 2
+    k = 3
+    centers = rng.normal(scale=3, size=(k, 3))
+    y3 = rng.integers(0, k, size=240).astype(float)
+    x3 = rng.normal(size=(240, 3)) + centers[y3.astype(int)]
+    df3 = _vector_df(spark, x3, extra_cols=[("label", y3.tolist())])
+    m3 = LogisticRegression(regParam=0.05).fit(df3)
+    base3 = np.asarray(
+        [r["prediction"] for r in m3.transform(df3).collect()]
+    )
+    m3.set(m3.thresholds, [1.0, 1.0, 1e-9])
+    skew3 = np.asarray(
+        [r["prediction"] for r in m3.transform(df3).collect()]
+    )
+    assert (skew3 == 2.0).sum() > (base3 == 2.0).sum()
