@@ -514,11 +514,23 @@ class _TpuLogRegParams(Params):
                          fitIntercept=True, maxIter=25, tol=1e-8,
                          executorDevice="auto", deviceId=-1)
 
+    def setThresholds(self, value):
+        return self._set(thresholds=value)
+
     def _thresholds_or_none(self):
-        if self.isDefined(self.thresholds):
-            t = self.getOrDefault(self.thresholds)
-            return list(t) if t else None
-        return None
+        if not self.isDefined(self.thresholds):
+            return None
+        t = self.getOrDefault(self.thresholds)
+        if not t:
+            return None
+        t = [float(v) for v in t]
+        if any(v < 0 for v in t) or sum(1 for v in t if v == 0.0) > 1 \
+                or sum(t) <= 0:
+            raise ValueError(
+                f"thresholds must be non-negative with at most one zero "
+                f"and positive sum, got {t}"
+            )
+        return t
 
 
 class LogisticRegression(Estimator, _TpuLogRegParams):
@@ -537,7 +549,7 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
     def __init__(self, *, featuresCol="features", labelCol="label",
                  predictionCol="prediction", probabilityCol="probability",
                  regParam=0.0, fitIntercept=True, maxIter=25, tol=1e-8,
-                 executorDevice="auto", deviceId=-1):
+                 executorDevice="auto", deviceId=-1, thresholds=None):
         super().__init__()
         self._set(**{k_: v for k_, v in self._input_kwargs.items()
                      if v is not None})
@@ -863,19 +875,18 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
                 f"thresholds length {len(thr)} != numClasses 2"
             )
         t0, t1 = float(thr[0]), float(thr[1])
-
-        @pandas_udf(returnType="double")
-        def pred_b(v: pd.Series) -> pd.Series:
-            p = np.asarray(v, dtype=np.float64)
-            with np.errstate(divide="ignore", invalid="ignore"):
-                s0 = (1.0 - p) / t0
-                s1 = p / t1
-            s0 = np.where(np.isnan(s0), -np.inf, s0)
-            s1 = np.where(np.isnan(s1), -np.inf, s1)
-            return pd.Series((s1 > s0).astype(np.float64))
-
+        # closed form of argmax((1-p)/t0, p/t1) as ONE column expression —
+        # the same single-UDF-pass shape as the unthresholded path. Zero
+        # thresholds follow the scaled-argmax limit: t0=0 predicts 1 only
+        # at p==1 exactly; t1=0 predicts 1 whenever p>0.
+        if t0 == 0.0:
+            expr = (col(pcol) >= 1.0)
+        elif t1 == 0.0:
+            expr = (col(pcol) > 0.0)
+        else:
+            expr = (col(pcol) > t1 / (t0 + t1))
         return out.withColumn(
-            self.getOrDefault(self.predictionCol), pred_b(out[pcol])
+            self.getOrDefault(self.predictionCol), expr.cast("double")
         )
 
     # -- persistence (shared wire format via the local model) --------------
@@ -909,6 +920,9 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
             value = self.getOrDefault(getattr(self, theirs))
             if value is not None and local.has_param(ours):
                 local.set(ours, value)
+        thr = self._thresholds_or_none()
+        if thr is not None:
+            local.set("thresholds", thr)
         return local
 
     def save(self, path: str, overwrite: bool = False) -> None:
@@ -943,7 +957,8 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
         if local.is_set("inputCol"):
             model._set(featuresCol=local.get("inputCol"))
         for name in ("labelCol", "predictionCol", "probabilityCol",
-                     "regParam", "fitIntercept", "maxIter", "tol"):
+                     "regParam", "fitIntercept", "maxIter", "tol",
+                     "thresholds"):
             if local.is_set(name):
                 model._set(**{name: local.get(name)})
         return model

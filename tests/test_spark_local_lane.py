@@ -644,3 +644,29 @@ def test_logreg_plane_thresholds(spark, rng):
         [r["prediction"] for r in m3.transform(df3).collect()]
     )
     assert (skew3 == 2.0).sum() > (base3 == 2.0).sum()
+
+
+def test_logreg_plane_thresholds_persist_and_validate(spark, rng, tmp_path):
+    from spark_rapids_ml_tpu.spark.estimator import (
+        LogisticRegressionModel as PlaneModel,
+    )
+
+    x = rng.normal(size=(150, 3))
+    y = ((x[:, 0] + rng.normal(scale=1.5, size=150)) > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    m = LogisticRegression(regParam=0.05, thresholds=[1e-6, 1.0]).fit(df)
+    pred = np.asarray([r["prediction"] for r in m.transform(df).collect()])
+    path = str(tmp_path / "thr_model")
+    m.save(path)
+    loaded = PlaneModel.load(path)
+    pred2 = np.asarray(
+        [r["prediction"] for r in loaded.transform(df).collect()]
+    )
+    np.testing.assert_array_equal(pred, pred2)  # thresholds persisted
+
+    m.setThresholds([-1.0, 0.5])
+    with pytest.raises(ValueError, match="non-negative"):
+        m.transform(df)
+    m.setThresholds([0.0, 0.0])
+    with pytest.raises(ValueError, match="at most one zero"):
+        m.transform(df)
