@@ -79,6 +79,12 @@ class RandomForestParams(HasInputCol, HasDeviceId, HasWeightCol):
         "histogram contraction: auto | on | off (the LOCAL fit always "
         "runs on the driver's device; this governs executors only)",
         "auto", validator=lambda v: v in ("auto", "on", "off"))
+    maxMemoryInMB = Param(
+        "maxMemoryInMB",
+        "per-partition histogram payload budget for level-synchronous "
+        "tree groups on the statistics plane (Spark's aggregation-memory "
+        "knob; SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES overrides)",
+        256, validator=lambda v: isinstance(v, int) and v >= 1)
 
 
 def _parse_numeric_subset(v):

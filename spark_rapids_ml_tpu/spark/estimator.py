@@ -1086,6 +1086,25 @@ class KMeansModel(Model, _TpuKMeansParams):
     def clusterCenters(self):
         return [c.toArray() for c in self._centers]
 
+    @property
+    def hasSummary(self) -> bool:
+        return self.trainingCost is not None
+
+    @property
+    def summary(self):
+        """Spark's ``KMeansSummary`` core: ``trainingCost`` (the final
+        within-cluster SSE the Lloyd plane computed) and ``k``."""
+        from types import SimpleNamespace
+
+        if self.trainingCost is None:
+            raise RuntimeError(
+                "no training summary: model was loaded, not fit"
+            )
+        return SimpleNamespace(
+            trainingCost=float(self.trainingCost),
+            k=len(self._centers),
+        )
+
     def _transform(self, dataset):
         import pandas as pd
         from spark_rapids_ml_tpu.spark._compat import pandas_udf

@@ -46,25 +46,29 @@ from spark_rapids_ml_tpu.spark.forest_plane import (
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 
-def _group_budget_bytes() -> int:
+
+def _group_budget_bytes(local_est=None) -> int:
     """Per-partition histogram payload budget for level-synchronous tree
-    groups — the analogue of Spark ML's maxMemoryInMB aggregation knob.
+    groups: the estimator's ``maxMemoryInMB`` (Spark's aggregation-memory
+    knob, default 256), overridable by SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES.
     Parsed lazily at fit time so a malformed env value fails the FIT with
     a clear message (and later env changes take effect), not the package
     import."""
     raw = os.environ.get("SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES")
-    if raw is None:
-        return 64 * 1024 * 1024
-    try:
-        value = int(raw)
-        if value < 1:
-            raise ValueError
-        return value
-    except ValueError:
-        raise ValueError(
-            f"SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES={raw!r}: expected a "
-            "positive integer byte count"
-        ) from None
+    if raw is not None:
+        try:
+            value = int(raw)
+            if value < 1:
+                raise ValueError
+            return value
+        except ValueError:
+            raise ValueError(
+                f"SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES={raw!r}: expected "
+                "a positive integer byte count"
+            ) from None
+    if local_est is not None and local_est.has_param("maxMemoryInMB"):
+        return int(local_est.get_or_default("maxMemoryInMB")) * 1024 * 1024
+    return 64 * 1024 * 1024
 
 
 def _num_partitions(df) -> int:
@@ -239,7 +243,8 @@ def _fit_forest_plane(local_est, dataset, classification):
         n_ch = len(classes) if classification else 3
         per_tree_bytes = n_ch * 2 ** (depth - 1) * d * n_bins * 8
         group = int(np.clip(
-            _group_budget_bytes() // max(per_tree_bytes, 1), 1, n_trees
+            _group_budget_bytes(local_est) // max(per_tree_bytes, 1),
+            1, n_trees
         ))
 
         rng = np.random.default_rng(seed)

@@ -670,3 +670,27 @@ def test_logreg_plane_thresholds_persist_and_validate(spark, rng, tmp_path):
     m.setThresholds([0.0, 0.0])
     with pytest.raises(ValueError, match="at most one zero"):
         m.transform(df)
+
+
+def test_kmeans_summary_and_max_memory_param(rng):
+    """KMeansModel.summary (trainingCost) + RF maxMemoryInMB reaching the
+    plane's group sizing."""
+    spark = LocalSparkSession(n_partitions=2)
+    x = rng.normal(size=(200, 4))
+    df = _vector_df(spark, x)
+    km = KMeans(k=3, seed=1).fit(df)
+    assert km.hasSummary
+    assert km.summary.trainingCost > 0 and km.summary.k == 3
+
+    from spark_rapids_ml_tpu.spark import RandomForestRegressor
+    from spark_rapids_ml_tpu.spark.forest_estimator import (
+        _group_budget_bytes,
+    )
+
+    est = RandomForestRegressor(numTrees=4, maxDepth=3, maxMemoryInMB=8)
+    assert _group_budget_bytes(est._local) == 8 * 1024 * 1024
+    y = x[:, 0]
+    df2 = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    m = est.fit(df2)
+    pred = np.asarray([r["prediction"] for r in m.transform(df2).collect()])
+    assert np.isfinite(pred).all()
