@@ -34,10 +34,15 @@ def _records(path):
 
 
 def main() -> None:
-    commit = subprocess.run(
+    proc = subprocess.run(
         ["git", "rev-parse", "--short", "HEAD"],
         capture_output=True, text=True,
-    ).stdout.strip()
+    )
+    commit = proc.stdout.strip()
+    if proc.returncode != 0 or not commit:
+        print("run from the repo root (git rev-parse failed)",
+              file=sys.stderr)
+        sys.exit(1)
     doc = {
         "note": (
             "Live-chip measurements captured by the round-4 patient bench "
@@ -65,6 +70,7 @@ def main() -> None:
     sweep = _records(os.path.join(OUT_DIR, "bench_gram_sweep.json"))
     if sweep:
         doc["gram_sweep"] = sweep
+    has_bench_records = len(doc) > 3  # beyond note/commit/collected_utc
     smoke = os.path.join(OUT_DIR, "pjrt_smoke.log")
     if os.path.exists(smoke):
         tail = open(smoke).read().strip().splitlines()
@@ -72,8 +78,8 @@ def main() -> None:
             "verified": tail[-1] if tail else "",
             "measured_utc": doc["collected_utc"],
         }
-    if len(doc) <= 3:
-        print("no records found in", OUT_DIR, file=sys.stderr)
+    if not has_bench_records:
+        print("no bench records found in", OUT_DIR, file=sys.stderr)
         sys.exit(1)
     with open("BENCH_MEASURED_r04.json", "w") as f:
         json.dump(doc, f, indent=2)

@@ -1,7 +1,8 @@
-"""NaiveBayes Estimator / Model (multinomial / bernoulli / gaussian).
+"""NaiveBayes Estimator / Model (multinomial/complement/bernoulli/gaussian).
 
 Spark ``org.apache.spark.ml.classification.NaiveBayes`` surface:
-``modelType`` (multinomial default, bernoulli, gaussian) and ``smoothing``
+``modelType`` (multinomial default, complement — Spark 3.0's Rennie et al.
+variant, bernoulli, gaussian) and ``smoothing``
 (Laplace/Lidstone λ, default 1.0). The entire fit is per-class sufficient
 statistics — one one-hot matmul per statistic on the MXU
 (``y_ohᵀ @ X`` for counts/sums, ``y_ohᵀ @ X²`` for variances) — making
@@ -42,9 +43,9 @@ class NaiveBayesParams(HasInputCol, HasDeviceId, HasThresholds):
     )
     modelType = Param(
         "modelType",
-        "multinomial | bernoulli | gaussian",
+        "multinomial | complement | bernoulli | gaussian",
         "multinomial",
-        validator=lambda v: v in ("multinomial", "bernoulli", "gaussian"),
+        validator=lambda v: v in ("multinomial", "complement", "bernoulli", "gaussian"),
     )
     smoothing = Param(
         "smoothing", "Laplace smoothing lambda", 1.0,
@@ -120,9 +121,9 @@ class NaiveBayes(NaiveBayesParams):
                 f"labels length {y.shape[0]} != rows {x.shape[0]}"
             )
         kind = self.getModelType()
-        if kind == "multinomial" and (x < 0).any():
+        if kind in ("multinomial", "complement") and (x < 0).any():
             raise ValueError(
-                "multinomial NaiveBayes requires non-negative features"
+                f"{kind} NaiveBayes requires non-negative features"
             )
         if kind == "bernoulli" and not np.isin(x, (0.0, 1.0)).all():
             raise ValueError(
@@ -187,6 +188,13 @@ class NaiveBayesModel(NaiveBayesParams):
         kind = self.getModelType()
         if kind == "multinomial":
             return self.pi[None, :] + x @ self.theta.T
+        if kind == "complement":
+            # complement NB ignores the prior for multi-class data
+            # (Rennie et al.; sklearn adds it only for a single class)
+            jll = x @ self.theta.T
+            if self.pi.shape[0] == 1:
+                jll = jll + self.pi[None, :]
+            return jll
         if kind == "bernoulli":
             xb = (x > 0).astype(np.float64)
             log_p = self.theta

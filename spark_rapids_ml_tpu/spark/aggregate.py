@@ -552,7 +552,8 @@ def partition_nb_stats(
 
     Emits the label values this partition saw with their (count, Σx, Σx²)
     rows — additively combinable on the driver even when partitions see
-    different class subsets. Input validation (multinomial non-negative,
+    different class subsets. Input validation (multinomial/complement
+    non-negative,
     bernoulli {0,1}) runs here, where the rows are."""
     sums: Dict[float, np.ndarray] = {}
     sqs: Dict[float, np.ndarray] = {}
@@ -568,9 +569,9 @@ def partition_nb_stats(
             y = np.asarray(y, dtype=np.float64).reshape(-1)
         if x.shape[0] == 0:
             continue
-        if model_type == "multinomial" and (x < 0).any():
+        if model_type in ("multinomial", "complement") and (x < 0).any():
             raise ValueError(
-                "multinomial NaiveBayes requires non-negative features"
+                f"{model_type} NaiveBayes requires non-negative features"
             )
         if model_type == "bernoulli" and not np.isin(x, (0.0, 1.0)).all():
             raise ValueError(
@@ -662,6 +663,16 @@ def finalize_nb_from_stats(
         theta = np.log(
             (sums + lam)
             / (sums.sum(axis=1, keepdims=True) + lam * sums.shape[1])
+        )
+        return pi, theta, None
+    if model_type == "complement":
+        # Rennie et al. 2003 (Spark 3.0 / sklearn ComplementNB,
+        # norm=False): per-class COMPLEMENT feature mass, theta stored
+        # NEGATED so the likelihood stays the one x @ thetaᵀ contraction
+        comp = sums.sum(axis=0, keepdims=True) - sums
+        theta = -np.log(
+            (comp + lam)
+            / (comp.sum(axis=1, keepdims=True) + lam * comp.shape[1])
         )
         return pi, theta, None
     if model_type == "bernoulli":

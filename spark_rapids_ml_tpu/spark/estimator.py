@@ -1160,7 +1160,7 @@ class NaiveBayes(Estimator, Params):
     driver finalizes the (K, d) log-probability tables. Replaces the
     driver-collect adapter strategy with the same partial-aggregate data
     plane the PCA/regression fits use. ``modelType``:
-    multinomial | bernoulli | gaussian (Spark's families + sklearn's
+    multinomial | complement | bernoulli | gaussian (Spark 3's families + sklearn's
     GaussianNB)."""
 
     featuresCol = Param(Params._dummy(), "featuresCol", "features column",
@@ -1171,7 +1171,7 @@ class NaiveBayes(Estimator, Params):
                           "prediction output column",
                           typeConverter=TypeConverters.toString)
     modelType = Param(Params._dummy(), "modelType",
-                      "multinomial | bernoulli | gaussian",
+                      "multinomial | complement | bernoulli | gaussian",
                       typeConverter=TypeConverters.toString)
     smoothing = Param(Params._dummy(), "smoothing",
                       "additive (Laplace) smoothing",
@@ -1230,7 +1230,8 @@ class NaiveBayes(Estimator, Params):
         fcol = self.getOrDefault(self.featuresCol)
         lcol = self.getOrDefault(self.labelCol)
         kind = self.getOrDefault(self.modelType)
-        if kind not in ("multinomial", "bernoulli", "gaussian"):
+        if kind not in ("multinomial", "complement", "bernoulli",
+                        "gaussian"):
             raise ValueError(f"modelType {kind!r}")
         df = dataset.select(fcol, lcol)
 

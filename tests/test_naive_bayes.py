@@ -94,3 +94,65 @@ def test_multinomial_rejects_negative(rng):
     y = (x[:, 0] > 0).astype(np.float64)
     with pytest.raises(ValueError, match="non-negative"):
         NaiveBayes().fit(VectorFrame({"features": x, "label": y}))
+
+
+def test_complement_nb_matches_sklearn(rng):
+    """modelType='complement' (Spark 3.0 / Rennie et al.): joint
+    log-likelihood and predictions equal sklearn's ComplementNB
+    (norm=False) on count data."""
+    SkCNB = pytest.importorskip("sklearn.naive_bayes").ComplementNB
+
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.naive_bayes import NaiveBayes
+
+    n, d, k = 400, 12, 3
+    y = rng.integers(0, k, size=n).astype(float)
+    rates = rng.uniform(0.5, 4.0, size=(k, d))
+    x = rng.poisson(rates[y.astype(int)]).astype(float)
+    frame = as_vector_frame(x, "features").with_column("label", y.tolist())
+    m = NaiveBayes().setModelType("complement").setSmoothing(1.0).fit(frame)
+    pred = np.asarray(list(m.transform(frame).column("prediction")))
+    sk = SkCNB(alpha=1.0).fit(x, y)
+    np.testing.assert_array_equal(pred, sk.predict(x))
+    # theta matches sklearn's feature_log_prob_ exactly
+    np.testing.assert_allclose(
+        m.theta, sk.feature_log_prob_, atol=1e-10
+    )
+    with pytest.raises(ValueError, match="non-negative"):
+        NaiveBayes().setModelType("complement").fit(
+            as_vector_frame(-x, "features").with_column(
+                "label", y.tolist()
+            )
+        )
+
+
+def test_complement_nb_statistics_plane(rng):
+    """The DataFrame NaiveBayes plane serves complement mode through the
+    same per-class sum partials."""
+    from spark_rapids_ml_tpu.spark.local_engine import (
+        DenseVector,
+        LocalSparkSession,
+    )
+    from spark_rapids_ml_tpu.spark import NaiveBayes as SparkNB
+
+    spark = LocalSparkSession(n_partitions=3)
+    n, d, k = 300, 8, 3
+    y = rng.integers(0, k, size=n).astype(float)
+    rates = rng.uniform(0.5, 4.0, size=(k, d))
+    x = rng.poisson(rates[y.astype(int)]).astype(float)
+    df = spark.createDataFrame([
+        {"features": DenseVector(r), "label": float(v)}
+        for r, v in zip(x, y)
+    ])
+    m = SparkNB(modelType="complement").fit(df)
+    pred = np.asarray([r["prediction"] for r in m.transform(df).collect()])
+    from spark_rapids_ml_tpu.models.naive_bayes import NaiveBayes as LocalNB
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    local = LocalNB().setModelType("complement").fit(
+        as_vector_frame(x, "features").with_column("label", y.tolist())
+    )
+    lp = np.asarray(list(local.transform(
+        as_vector_frame(x, "features")
+    ).column("prediction")))
+    np.testing.assert_array_equal(pred, lp)
