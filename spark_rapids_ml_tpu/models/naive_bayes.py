@@ -26,6 +26,7 @@ from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
     HasInputCol,
     HasThresholds,
+    HasWeightCol,
     Param,
 )
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
@@ -33,7 +34,8 @@ from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-class NaiveBayesParams(HasInputCol, HasDeviceId, HasThresholds):
+class NaiveBayesParams(HasInputCol, HasDeviceId, HasThresholds,
+                       HasWeightCol):
     labelCol = Param("labelCol", "label column name", "label")
     predictionCol = Param(
         "predictionCol", "predicted class output column", "prediction"
@@ -133,6 +135,11 @@ class NaiveBayes(NaiveBayesParams):
         classes = np.unique(y)
         y_idx = np.searchsorted(classes, y)
         y_oh = np.eye(classes.size)[y_idx]
+        # Spark weightCol: every per-class statistic becomes a WEIGHTED
+        # sum — one multiply into the one-hot before the matmuls
+        user_w = self._extract_weights(frame, x.shape[0])
+        if user_w is not None:
+            y_oh = y_oh * user_w[:, None]
         lam = float(self.getSmoothing())
 
         device = (

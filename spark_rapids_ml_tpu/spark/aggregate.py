@@ -546,18 +546,19 @@ def combine_kmeans_stats(rows: Iterable, k: int, n: int):
 
 
 def partition_nb_stats(
-    batches: Iterable, features_col: str, label_col: str, model_type: str
+    batches: Iterable, features_col: str, label_col: str, model_type: str,
+    weight_col: Optional[str] = None,
 ) -> Iterator[Dict[str, object]]:
     """One partition's per-class NaiveBayes statistics.
 
-    Emits the label values this partition saw with their (count, Σx, Σx²)
-    rows — additively combinable on the driver even when partitions see
-    different class subsets. Input validation (multinomial/complement
-    non-negative,
-    bernoulli {0,1}) runs here, where the rows are."""
+    Emits the label values this partition saw with their (Σw, Σw·x, Σw·x²)
+    rows (w ≡ 1 unweighted) — additively combinable on the driver even
+    when partitions see different class subsets. Input validation
+    (multinomial/complement non-negative, bernoulli {0,1}, weights
+    finite/non-negative) runs here, where the rows are."""
     sums: Dict[float, np.ndarray] = {}
     sqs: Dict[float, np.ndarray] = {}
-    counts: Dict[float, int] = {}
+    counts: Dict[float, float] = {}
     for batch in batches:
         if hasattr(batch, "column"):
             x = vector_column_to_matrix(batch.column(features_col))
@@ -569,6 +570,13 @@ def partition_nb_stats(
             y = np.asarray(y, dtype=np.float64).reshape(-1)
         if x.shape[0] == 0:
             continue
+        if weight_col and hasattr(batch, "column"):
+            w = np.asarray(batch.column(weight_col).to_pylist(),
+                           dtype=np.float64).reshape(-1)
+            if not np.isfinite(w).all() or (w < 0).any():
+                raise ValueError("weights must be finite and non-negative")
+        else:
+            w = None
         if model_type in ("multinomial", "complement") and (x < 0).any():
             raise ValueError(
                 f"{model_type} NaiveBayes requires non-negative features"
@@ -578,15 +586,22 @@ def partition_nb_stats(
                 "bernoulli NaiveBayes requires {0,1} features"
             )
         for cls in np.unique(y):
-            rows_c = x[y == cls]
+            sel = y == cls
+            rows_c = x[sel]
+            w_c = w[sel] if w is not None else None
             key = float(cls)
             if key not in sums:
                 sums[key] = np.zeros(x.shape[1])
                 sqs[key] = np.zeros(x.shape[1])
-                counts[key] = 0
-            sums[key] += rows_c.sum(axis=0)
-            sqs[key] += (rows_c * rows_c).sum(axis=0)
-            counts[key] += rows_c.shape[0]
+                counts[key] = 0.0
+            if w_c is None:
+                sums[key] += rows_c.sum(axis=0)
+                sqs[key] += (rows_c * rows_c).sum(axis=0)
+                counts[key] += float(rows_c.shape[0])
+            else:
+                sums[key] += (w_c[:, None] * rows_c).sum(axis=0)
+                sqs[key] += (w_c[:, None] * rows_c * rows_c).sum(axis=0)
+                counts[key] += float(w_c.sum())
     if not counts:
         return
     labels = sorted(counts)
@@ -604,7 +619,7 @@ def nb_stats_arrow_schema():
     return pa.schema(
         [
             ("labels", pa.list_(pa.float64())),
-            ("counts", pa.list_(pa.int64())),
+            ("counts", pa.list_(pa.float64())),  # Σw (= row count unweighted)
             ("sums", pa.list_(pa.float64())),
             ("sq", pa.list_(pa.float64())),
         ]
@@ -612,7 +627,7 @@ def nb_stats_arrow_schema():
 
 
 def nb_stats_spark_ddl() -> str:
-    return ("labels array<double>, counts array<bigint>, "
+    return ("labels array<double>, counts array<double>, "
             "sums array<double>, sq array<double>")
 
 
@@ -633,7 +648,7 @@ def combine_nb_stats(rows: Iterable):
         for i, cls in enumerate(labels):
             if cls not in acc:
                 acc[cls] = [0, np.zeros(d), np.zeros(d)]
-            acc[cls][0] += int(counts[i])
+            acc[cls][0] += float(counts[i])
             acc[cls][1] += sums[i]
             acc[cls][2] += sq[i]
     if not acc:

@@ -1176,15 +1176,19 @@ class NaiveBayes(Estimator, Params):
     smoothing = Param(Params._dummy(), "smoothing",
                       "additive (Laplace) smoothing",
                       typeConverter=TypeConverters.toFloat)
+    weightCol = Param(Params._dummy(), "weightCol",
+                      "per-row sample-weight column ('' = unweighted)",
+                      typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self, *, featuresCol="features", labelCol="label",
                  predictionCol="prediction", modelType="multinomial",
-                 smoothing=1.0):
+                 smoothing=1.0, weightCol=""):
         super().__init__()
         self._setDefault(featuresCol="features", labelCol="label",
                          predictionCol="prediction",
-                         modelType="multinomial", smoothing=1.0)
+                         modelType="multinomial", smoothing=1.0,
+                         weightCol="")
         self._set(**{k_: v for k_, v in self._input_kwargs.items()
                      if v is not None})
 
@@ -1193,6 +1197,9 @@ class NaiveBayes(Estimator, Params):
 
     def setSmoothing(self, value):
         return self._set(smoothing=value)
+
+    def setWeightCol(self, value):
+        return self._set(weightCol=value)
 
     def save(self, path: str) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_params
@@ -1233,12 +1240,15 @@ class NaiveBayes(Estimator, Params):
         if kind not in ("multinomial", "complement", "bernoulli",
                         "gaussian"):
             raise ValueError(f"modelType {kind!r}")
-        df = dataset.select(fcol, lcol)
+        wcol = self.getOrDefault(self.weightCol) or None
+        cols = [fcol, lcol] + ([wcol] if wcol else [])
+        df = dataset.select(*cols)
 
         def stats(batches):
             import pyarrow as pa
 
-            for row in partition_nb_stats(batches, fcol, lcol, kind):
+            for row in partition_nb_stats(batches, fcol, lcol, kind,
+                                          weight_col=wcol):
                 yield pa.RecordBatch.from_pylist(
                     [row], schema=nb_stats_arrow_schema()
                 )

@@ -156,3 +156,61 @@ def test_complement_nb_statistics_plane(rng):
         as_vector_frame(x, "features")
     ).column("prediction")))
     np.testing.assert_array_equal(pred, lp)
+
+
+def test_nb_weight_col_equals_duplication(rng):
+    """weightCol: integer weight w == duplicating the row w times — exact
+    for NB because every statistic is a weighted sum (no resampling)."""
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.naive_bayes import NaiveBayes
+
+    n, d, k = 120, 6, 3
+    y = rng.integers(0, k, size=n).astype(float)
+    x = rng.poisson(rng.uniform(0.5, 4.0, (k, d))[y.astype(int)]).astype(
+        float
+    )
+    w = rng.integers(1, 4, size=n).astype(float)
+    frame_w = as_vector_frame(x, "features").with_column(
+        "label", y.tolist()
+    ).with_column("wt", w.tolist())
+    mw = NaiveBayes().setWeightCol("wt").fit(frame_w)
+
+    reps = np.repeat(np.arange(n), w.astype(int))
+    frame_dup = as_vector_frame(x[reps], "features").with_column(
+        "label", y[reps].tolist()
+    )
+    md = NaiveBayes().fit(frame_dup)
+    np.testing.assert_allclose(mw.pi, md.pi, atol=1e-12)
+    np.testing.assert_allclose(mw.theta, md.theta, atol=1e-12)
+
+
+def test_nb_weight_col_statistics_plane(rng):
+    """The DataFrame NB plane with weightCol matches the local weighted
+    fit exactly (one shared finalize)."""
+    from spark_rapids_ml_tpu.spark.local_engine import (
+        DenseVector,
+        LocalSparkSession,
+    )
+    from spark_rapids_ml_tpu.spark import NaiveBayes as SparkNB
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+    from spark_rapids_ml_tpu.models.naive_bayes import NaiveBayes as LocalNB
+
+    spark = LocalSparkSession(n_partitions=3)
+    n, d, k = 200, 5, 3
+    y = rng.integers(0, k, size=n).astype(float)
+    x = rng.poisson(rng.uniform(0.5, 4.0, (k, d))[y.astype(int)]).astype(
+        float
+    )
+    w = rng.uniform(0.5, 2.0, size=n)
+    df = spark.createDataFrame([
+        {"features": DenseVector(r), "label": float(v), "wt": float(wi)}
+        for r, v, wi in zip(x, y, w)
+    ])
+    m = SparkNB(weightCol="wt").fit(df)
+    local = LocalNB().setWeightCol("wt").fit(
+        as_vector_frame(x, "features").with_column(
+            "label", y.tolist()
+        ).with_column("wt", w.tolist())
+    )
+    np.testing.assert_allclose(m._local.pi, local.pi, atol=1e-12)
+    np.testing.assert_allclose(m._local.theta, local.theta, atol=1e-12)
