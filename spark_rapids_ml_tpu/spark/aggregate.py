@@ -66,6 +66,24 @@ def vector_column_to_matrix(column, n_features: Optional[int] = None) -> np.ndar
     return rows_to_matrix(rows)
 
 
+def _batch_weights_agg(batch, weight_col: Optional[str]):
+    """Validated weightCol values for one batch (None when unweighted).
+    Raises for non-Arrow test batches rather than silently fitting
+    unweighted — the tuple/array forms carry no named columns."""
+    if not weight_col:
+        return None
+    if not hasattr(batch, "column"):
+        raise ValueError(
+            "weight_col requires Arrow batches with named columns; "
+            "plain (x, y) tuple batches cannot carry weights"
+        )
+    wt = np.asarray(batch.column(weight_col).to_pylist(),
+                    dtype=np.float64).reshape(-1)
+    if not np.isfinite(wt).all() or (wt < 0).any():
+        raise ValueError("weights must be finite and non-negative")
+    return wt
+
+
 def partition_gram_stats(
     batches: Iterable, input_col: str
 ) -> Iterator[Dict[str, object]]:
@@ -118,27 +136,30 @@ def stats_arrow_schema():
         [
             ("gram", pa.list_(pa.float64())),
             ("col_sum", pa.list_(pa.float64())),
-            ("count", pa.int64()),
+            ("count", pa.float64()),  # Σw (= row count unweighted)
         ]
     )
 
 
 def stats_spark_ddl() -> str:
     """The same schema as a Spark DDL string (mapInArrow's schema arg)."""
-    return "gram array<double>, col_sum array<double>, count bigint"
+    return "gram array<double>, col_sum array<double>, count double"
 
 
 def partition_xy_stats(
-    batches: Iterable, features_col: str, label_col: str
+    batches: Iterable, features_col: str, label_col: str,
+    weight_col: Optional[str] = None,
 ) -> Iterator[Dict[str, object]]:
     """One partition's sufficient statistics over Z = [X | y].
 
-    Shaped for ``mapInArrow`` on a two-column (features, label) selection;
+    Shaped for ``mapInArrow`` on a (features, label[, weight]) selection;
     the (n+1)² Gram of Z carries XᵀX, Xᵀy and yᵀy at once — the same
-    augmented-column trick the local streamed LinearRegression uses."""
+    augmented-column trick the local streamed LinearRegression uses.
+    With ``weight_col`` every statistic is the weighted sum (Σw·zzᵀ,
+    Σw·z, Σw) — weighted least squares."""
     gram: Optional[np.ndarray] = None
     col_sum: Optional[np.ndarray] = None
-    count = 0
+    count = 0.0
     for batch in batches:
         if hasattr(batch, "column"):
             x = vector_column_to_matrix(batch.column(features_col))
@@ -150,14 +171,20 @@ def partition_xy_stats(
             y = np.asarray(y, dtype=np.float64)
         if x.shape[0] == 0:
             continue
+        wt = _batch_weights_agg(batch, weight_col)
         z = np.concatenate([x, y.reshape(-1, 1)], axis=1)
         if gram is None:
             nz = z.shape[1]
             gram = np.zeros((nz, nz))
             col_sum = np.zeros(nz)
-        gram += z.T @ z
-        col_sum += z.sum(axis=0)
-        count += z.shape[0]
+        if wt is None:
+            gram += z.T @ z
+            col_sum += z.sum(axis=0)
+            count += z.shape[0]
+        else:
+            gram += z.T @ (z * wt[:, None])
+            col_sum += (z * wt[:, None]).sum(axis=0)
+            count += float(wt.sum())
     if gram is None:
         return
     yield {
@@ -167,10 +194,12 @@ def partition_xy_stats(
     }
 
 
-def partition_xy_stats_arrow(batches, features_col: str, label_col: str):
+def partition_xy_stats_arrow(batches, features_col: str, label_col: str,
+                             weight_col: Optional[str] = None):
     import pyarrow as pa
 
-    for row in partition_xy_stats(batches, features_col, label_col):
+    for row in partition_xy_stats(batches, features_col, label_col,
+                                  weight_col=weight_col):
         yield pa.RecordBatch.from_pylist([row], schema=stats_arrow_schema())
 
 
@@ -204,6 +233,7 @@ def partition_logreg_stats(
     label_col: str,
     w: np.ndarray,
     b: float,
+    weight_col: Optional[str] = None,
 ) -> Iterator[Dict[str, object]]:
     """One partition's Newton/IRLS partials under broadcast coefficients.
 
@@ -238,18 +268,24 @@ def partition_logreg_stats(
         )
 
         _check_binary(y)
+        wt = _batch_weights_agg(batch, weight_col)
         z = x @ w + b
         p = 1.0 / (1.0 + np.exp(-z))
         r = p - y
         s = p * (1.0 - p)
+        if wt is not None:
+            # weightCol: every Newton partial is a weighted sum
+            r = r * wt
+            s = s * wt
         gx += x.T @ r
         hxx += x.T @ (x * s[:, None])
         hxb += x.T @ s
         rsum += float(r.sum())
         ssum += float(s.sum())
         # stable per-row NLL: log(1+e^z) − y·z
-        loss += float(np.logaddexp(0.0, z).sum() - y @ z)
-        count += x.shape[0]
+        nll = np.logaddexp(0.0, z) - y * z
+        loss += float((nll * wt).sum() if wt is not None else nll.sum())
+        count += float(wt.sum()) if wt is not None else x.shape[0]
     if count == 0:
         return
     yield {
@@ -264,10 +300,12 @@ def partition_logreg_stats(
 
 
 def partition_logreg_stats_arrow(batches, features_col: str, label_col: str,
-                                 w: np.ndarray, b: float):
+                                 w: np.ndarray, b: float,
+                                 weight_col: Optional[str] = None):
     import pyarrow as pa
 
-    for row in partition_logreg_stats(batches, features_col, label_col, w, b):
+    for row in partition_logreg_stats(batches, features_col, label_col, w, b,
+                                      weight_col=weight_col):
         yield pa.RecordBatch.from_pylist([row], schema=logreg_stats_arrow_schema())
 
 
@@ -282,14 +320,14 @@ def logreg_stats_arrow_schema():
             ("rsum", pa.float64()),
             ("ssum", pa.float64()),
             ("loss", pa.float64()),
-            ("count", pa.int64()),
+            ("count", pa.float64()),  # Σw (= row count unweighted)
         ]
     )
 
 
 def logreg_stats_spark_ddl() -> str:
     return ("gx array<double>, hxx array<double>, hxb array<double>, "
-            "rsum double, ssum double, loss double, count bigint")
+            "rsum double, ssum double, loss double, count double")
 
 
 def combine_logreg_stats(rows: Iterable):
@@ -311,7 +349,7 @@ def combine_logreg_stats(rows: Iterable):
         rsum += float(get("rsum"))
         ssum += float(get("ssum"))
         loss += float(get("loss"))
-        count += int(get("count"))
+        count += float(get("count"))  # Σw: fractional under weightCol
     if gx is None:
         raise ValueError("no partition statistics to combine (empty dataset)")
     return gx, hxx, hxb, rsum, ssum, loss, count
@@ -382,6 +420,7 @@ def partition_multinomial_stats(
     label_col: str,
     classes: np.ndarray,
     wb: np.ndarray,
+    weight_col: Optional[str] = None,
 ) -> Iterator[Dict[str, object]]:
     """One partition's raw softmax-Newton partials at the broadcast
     (K, d+1) parameters: (gxa, h_raw, loss, count) — the additive unit of
@@ -412,23 +451,36 @@ def partition_multinomial_stats(
         if x.shape[0] == 0:
             continue
         idx = class_indices(y, classes)
+        wt = _batch_weights_agg(batch, weight_col)
         z = x @ wb[:, :n].T + wb[:, n][None, :]
         z = z - z.max(axis=1, keepdims=True)
         e = np.exp(z)
         p = e / e.sum(axis=1, keepdims=True)
         y_oh = np.eye(k)[idx]
         r = p - y_oh
+        if wt is not None:
+            r = r * wt[:, None]
         xa = np.concatenate([x, np.ones((x.shape[0], 1))], axis=1)
         gxa += r.T @ xa
         for kk in range(k):
             for ll in range(k):
                 s = p[:, kk] * ((kk == ll) * 1.0 - p[:, ll])
+                if wt is not None:
+                    s = s * wt
                 h_raw[kk * (n + 1):(kk + 1) * (n + 1),
                       ll * (n + 1):(ll + 1) * (n + 1)] += (
                     (xa * s[:, None]).T @ xa
                 )
-        loss += softmax_log_loss(x, wb, idx)
-        count += x.shape[0]
+        if wt is None:
+            loss += softmax_log_loss(x, wb, idx)
+            count += x.shape[0]
+        else:
+            # per-row weighted NLL from the shifted logits already in
+            # scope (z, e computed above for the gradient)
+            lse = np.log(e.sum(axis=1))
+            nll = lse - z[np.arange(x.shape[0]), idx]
+            loss += float((wt * nll).sum())
+            count += float(wt.sum())
     if count == 0:
         return
     yield {
@@ -447,17 +499,17 @@ def multinomial_stats_arrow_schema():
             ("gxa", pa.list_(pa.float64())),
             ("h", pa.list_(pa.float64())),
             ("loss", pa.float64()),
-            ("count", pa.int64()),
+            ("count", pa.float64()),  # Σw (= row count unweighted)
         ]
     )
 
 
 def multinomial_stats_spark_ddl() -> str:
-    return "gxa array<double>, h array<double>, loss double, count bigint"
+    return "gxa array<double>, h array<double>, loss double, count double"
 
 
 def combine_multinomial_stats(rows: Iterable, k: int, dim: int):
-    """Driver-side reduce → (gxa (k, dim), h_raw (k·dim)², loss, count)."""
+    """Driver-side reduce → (gxa (k, dim), h_raw (k·dim)², loss, Σw)."""
     gxa = np.zeros((k, dim))
     h_raw = np.zeros((k * dim, k * dim))
     loss = 0.0
@@ -469,18 +521,20 @@ def combine_multinomial_stats(rows: Iterable, k: int, dim: int):
             k * dim, k * dim
         )
         loss += float(get("loss"))
-        count += int(get("count"))
+        count += float(get("count"))
     if count == 0:
         raise ValueError("no partition statistics to combine (empty dataset)")
     return gxa, h_raw, loss, count
 
 
 def partition_kmeans_stats(
-    batches: Iterable, input_col: str, centers: np.ndarray
+    batches: Iterable, input_col: str, centers: np.ndarray,
+    weight_col: Optional[str] = None,
 ) -> Iterator[Dict[str, object]]:
-    """One partition's per-cluster (Σx, count, cost) under fixed centers —
-    one Lloyd assignment half-step, shaped for ``mapInArrow`` with the
-    (small) centers broadcast via closure capture."""
+    """One partition's per-cluster (Σw·x, Σw, Σw·cost) under fixed
+    centers — one Lloyd assignment half-step, shaped for ``mapInArrow``
+    with the (small) centers broadcast via closure capture (w ≡ 1
+    unweighted — Spark 3.0 weightCol semantics otherwise)."""
     k, n = centers.shape
     sums = np.zeros((k, n))
     counts = np.zeros(k)
@@ -494,13 +548,19 @@ def partition_kmeans_stats(
             x = np.asarray(batch, dtype=np.float64)
         if x.shape[0] == 0:
             continue
+        wt = _batch_weights_agg(batch, weight_col)
         d = np.maximum(
             (x * x).sum(axis=1)[:, None] + c2 - 2.0 * (x @ centers.T), 0.0
         )
         labels = d.argmin(axis=1)
-        np.add.at(sums, labels, x)
-        np.add.at(counts, labels, 1.0)
-        cost += float(d.min(axis=1).sum())
+        if wt is None:
+            np.add.at(sums, labels, x)
+            np.add.at(counts, labels, 1.0)
+            cost += float(d.min(axis=1).sum())
+        else:
+            np.add.at(sums, labels, x * wt[:, None])
+            np.add.at(counts, labels, wt)
+            cost += float((wt * d.min(axis=1)).sum())
         seen += x.shape[0]
     if seen == 0:
         return
@@ -570,13 +630,7 @@ def partition_nb_stats(
             y = np.asarray(y, dtype=np.float64).reshape(-1)
         if x.shape[0] == 0:
             continue
-        if weight_col and hasattr(batch, "column"):
-            w = np.asarray(batch.column(weight_col).to_pylist(),
-                           dtype=np.float64).reshape(-1)
-            if not np.isfinite(w).all() or (w < 0).any():
-                raise ValueError("weights must be finite and non-negative")
-        else:
-            w = None
+        w = _batch_weights_agg(batch, weight_col)
         if model_type in ("multinomial", "complement") and (x < 0).any():
             raise ValueError(
                 f"{model_type} NaiveBayes requires non-negative features"
@@ -726,7 +780,7 @@ def combine_stats(
             col_sum = np.zeros(n)
         gram += g.reshape(col_sum.shape[0], col_sum.shape[0])
         col_sum += s
-        count += int(get("count"))
+        count += float(get("count"))  # Σw: fractional under weightCol
     if gram is None:
         raise ValueError("no partition statistics to combine (empty dataset)")
     return gram, col_sum, count

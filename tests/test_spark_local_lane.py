@@ -694,3 +694,96 @@ def test_kmeans_summary_and_max_memory_param(rng):
     m = est.fit(df2)
     pred = np.asarray([r["prediction"] for r in m.transform(df2).collect()])
     assert np.isfinite(pred).all()
+
+
+def test_logreg_plane_weight_col(spark, rng):
+    """weightCol on the DataFrame LogisticRegression: integer weights
+    equal row duplication exactly (Newton partials are weighted sums),
+    binary and multinomial."""
+    n, d_ = 160, 3
+    x = rng.normal(size=(n, d_))
+    y = ((x[:, 0] + 0.5 * rng.normal(size=n)) > 0).astype(float)
+    w = rng.integers(1, 4, size=n).astype(float)
+    df_w = _vector_df(spark, x, extra_cols=[
+        ("label", y.tolist()), ("wt", w.tolist())
+    ])
+    mw = LogisticRegression(regParam=0.05, weightCol="wt").fit(df_w)
+
+    reps = np.repeat(np.arange(n), w.astype(int))
+    df_dup = _vector_df(spark, x[reps], extra_cols=[
+        ("label", y[reps].tolist())
+    ])
+    md = LogisticRegression(regParam=0.05).fit(df_dup)
+    # regularization scales by 1 while loss scales by sum(w): identical
+    # objective, identical Newton iterates
+    np.testing.assert_allclose(
+        mw.coefficients.toArray(), md.coefficients.toArray(), atol=1e-9
+    )
+    np.testing.assert_allclose(
+        float(mw.intercept), float(md.intercept), atol=1e-9
+    )
+
+    # multinomial {0,1,2}
+    y3 = rng.integers(0, 3, size=n).astype(float)
+    centers = rng.normal(scale=3, size=(3, d_))
+    x3 = rng.normal(size=(n, d_)) + centers[y3.astype(int)]
+    w3 = rng.integers(1, 3, size=n).astype(float)
+    df3 = _vector_df(spark, x3, extra_cols=[
+        ("label", y3.tolist()), ("wt", w3.tolist())
+    ])
+    m3 = LogisticRegression(regParam=0.05, weightCol="wt").fit(df3)
+    reps3 = np.repeat(np.arange(n), w3.astype(int))
+    d3 = _vector_df(spark, x3[reps3], extra_cols=[
+        ("label", y3[reps3].tolist())
+    ])
+    md3 = LogisticRegression(regParam=0.05).fit(d3)
+    np.testing.assert_allclose(
+        m3.coefficientMatrix.toArray(), md3.coefficientMatrix.toArray(),
+        atol=1e-8,
+    )
+
+
+def test_linreg_kmeans_plane_weight_col(spark, rng):
+    """weightCol on the LinearRegression and KMeans planes: weighted
+    least squares equals row duplication exactly; weighted Lloyd
+    partials move centroids toward the up-weighted mass."""
+    n, d_ = 120, 3
+    x = rng.normal(size=(n, d_))
+    y = x @ np.array([2.0, -1.0, 0.5]) + 0.1 * rng.normal(size=n)
+    w = rng.integers(1, 4, size=n).astype(float)
+    df_w = _vector_df(spark, x, extra_cols=[
+        ("label", y.tolist()), ("wt", w.tolist())
+    ])
+    mw = LinearRegression(weightCol="wt").fit(df_w)
+    reps = np.repeat(np.arange(n), w.astype(int))
+    df_dup = _vector_df(spark, x[reps], extra_cols=[
+        ("label", y[reps].tolist())
+    ])
+    md = LinearRegression().fit(df_dup)
+    np.testing.assert_allclose(
+        mw.coefficients.toArray(), md.coefficients.toArray(), atol=1e-9
+    )
+
+    # KMeans: two clusters of points at x=0 and x=10; weighting the x=10
+    # group 100x pulls its centroid stats accordingly. Verify the
+    # weighted partial directly (init is sample-based, so end-to-end
+    # equality isn't defined).
+    from spark_rapids_ml_tpu.spark.aggregate import partition_kmeans_stats
+    import pyarrow as pa
+
+    xk = np.concatenate([np.zeros((50, 2)), np.full((50, 2), 10.0)])
+    wk = np.concatenate([np.ones(50), np.full(50, 100.0)])
+    batch = pa.RecordBatch.from_pylist(
+        [{"f": {"type": 1, "values": r.tolist()}, "wt": float(v)}
+         for r, v in zip(xk, wk)],
+        schema=pa.schema([
+            ("f", pa.struct([("type", pa.int8()),
+                             ("values", pa.list_(pa.float64()))])),
+            ("wt", pa.float64()),
+        ]),
+    )
+    centers = np.array([[0.0, 0.0], [10.0, 10.0]])
+    row = next(partition_kmeans_stats([batch], "f", centers,
+                                      weight_col="wt"))
+    counts = np.asarray(row["counts"])
+    np.testing.assert_allclose(counts, [50.0, 5000.0])
