@@ -44,6 +44,7 @@ _MOMENTS_PLANE_CLASSES = (
     "MinMaxScaler",
     "MaxAbsScaler",
     "TruncatedSVD",
+    "LinearSVC",
 )
 
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
@@ -54,7 +55,6 @@ _ADAPTER_CLASSES = (
     "GBTClassifierModel",
     "GBTRegressorModel",
     "NaiveBayesModel",
-    "LinearSVC",
     "LinearSVCModel",
     "StandardScalerModel",
     "MinMaxScalerModel",

@@ -838,7 +838,8 @@ def finalize_pca_from_stats(
 # --------------------------------------------------------------------------
 
 def partition_moment_stats(
-    batches: Iterable, input_col: str
+    batches: Iterable, input_col: str,
+    weight_col: Optional[str] = None,
 ) -> Iterator[Dict[str, object]]:
     """One partition's per-feature (n, Σx, Σx², min, max) — the additive
     partial that serves EVERY scaler fit (StandardScaler needs Σx/Σx²/n,
@@ -850,7 +851,7 @@ def partition_moment_stats(
     s2: Optional[np.ndarray] = None
     lo: Optional[np.ndarray] = None
     hi: Optional[np.ndarray] = None
-    count = 0
+    count = 0.0
     for batch in batches:
         if hasattr(batch, "column"):
             x = vector_column_to_matrix(batch.column(input_col))
@@ -858,17 +859,25 @@ def partition_moment_stats(
             x = np.asarray(batch, dtype=np.float64)
         if x.shape[0] == 0:
             continue
+        wt = _batch_weights_agg(batch, weight_col)
         if s1 is None:
             d = x.shape[1]
             s1 = np.zeros(d)
             s2 = np.zeros(d)
             lo = np.full(d, np.inf)
             hi = np.full(d, -np.inf)
-        s1 += x.sum(axis=0)
-        s2 += (x * x).sum(axis=0)
+        if wt is None:
+            s1 += x.sum(axis=0)
+            s2 += (x * x).sum(axis=0)
+            count += x.shape[0]
+        else:
+            # weighted first/second moments (min/max stay unweighted —
+            # a weight scales mass, it does not move the value range)
+            s1 += (wt[:, None] * x).sum(axis=0)
+            s2 += (wt[:, None] * x * x).sum(axis=0)
+            count += float(wt.sum())
         lo = np.minimum(lo, x.min(axis=0))
         hi = np.maximum(hi, x.max(axis=0))
-        count += x.shape[0]
     if s1 is None:
         return
     yield {
@@ -880,10 +889,12 @@ def partition_moment_stats(
     }
 
 
-def partition_moment_stats_arrow(batches, input_col: str):
+def partition_moment_stats_arrow(batches, input_col: str,
+                                 weight_col: Optional[str] = None):
     import pyarrow as pa
 
-    for row in partition_moment_stats(batches, input_col):
+    for row in partition_moment_stats(batches, input_col,
+                                      weight_col=weight_col):
         yield pa.RecordBatch.from_pylist(
             [row], schema=moment_stats_arrow_schema()
         )
@@ -893,7 +904,7 @@ def moment_stats_arrow_schema():
     import pyarrow as pa
 
     return pa.schema([
-        ("count", pa.int64()),
+        ("count", pa.float64()),  # Σw (= row count unweighted)
         ("s1", pa.list_(pa.float64())),
         ("s2", pa.list_(pa.float64())),
         ("lo", pa.list_(pa.float64())),
@@ -902,7 +913,7 @@ def moment_stats_arrow_schema():
 
 
 def moment_stats_spark_ddl() -> str:
-    return ("count bigint, s1 array<double>, s2 array<double>, "
+    return ("count double, s1 array<double>, s2 array<double>, "
             "lo array<double>, hi array<double>")
 
 
@@ -922,7 +933,91 @@ def combine_moment_stats(rows: Iterable):
             s2 += np.asarray(get("s2"), dtype=np.float64)
             lo = np.minimum(lo, np.asarray(get("lo"), dtype=np.float64))
             hi = np.maximum(hi, np.asarray(get("hi"), dtype=np.float64))
-        count += int(get("count"))
+        count += float(get("count"))
     if s1 is None:
         raise ValueError("no partition statistics to combine (empty dataset)")
     return count, s1, s2, lo, hi
+
+
+def partition_svc_stats(
+    batches: Iterable,
+    features_col: str,
+    label_col: str,
+    w: np.ndarray,
+    b: float,
+    scale: Optional[np.ndarray] = None,
+    weight_col: Optional[str] = None,
+) -> Iterator[Dict[str, object]]:
+    """One partition's squared-hinge Newton partials under broadcast
+    (w, b) — the LinearSVC analogue of ``partition_logreg_stats``,
+    emitting the SAME row shape (gx, hxx, hxb, rsum≡Σaỹ, ssum≡Σs,
+    loss≡Σw·max(margin,0)², count≡Σw) so the logreg schema/combine are
+    shared. ``scale`` (per-feature stds, broadcast) makes executors
+    optimize in the standardized space, matching the local
+    ``standardization=True`` semantics; the driver unscales at the end.
+    """
+    from spark_rapids_ml_tpu.models.logistic_regression import _check_binary
+
+    w = np.asarray(w, dtype=np.float64).reshape(-1)
+    b = float(b)
+    n = w.shape[0]
+    gx = np.zeros(n)
+    hxx = np.zeros((n, n))
+    hxb = np.zeros(n)
+    aysum = ssum = loss = 0.0
+    count = 0.0
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+            y = np.asarray(batch.column(label_col).to_pylist(),
+                           dtype=np.float64)
+        else:
+            x, y = batch
+            x = np.asarray(x, dtype=np.float64)
+            y = np.asarray(y, dtype=np.float64).reshape(-1)
+        if x.shape[0] == 0:
+            continue
+        _check_binary(y, estimator="LinearSVC")
+        wt = _batch_weights_agg(batch, weight_col)
+        if scale is not None:
+            x = x / np.asarray(scale)[None, :]
+        ypm = 2.0 * y - 1.0
+        margin = 1.0 - ypm * (x @ w + b)
+        a = np.maximum(margin, 0.0)
+        s = (margin > 0).astype(np.float64)
+        if wt is not None:
+            a = a * wt
+            s = s * wt
+        xs = x * s[:, None]
+        ay = a * ypm
+        gx += x.T @ ay
+        hxx += x.T @ xs
+        hxb += xs.sum(axis=0)
+        aysum += float(ay.sum())
+        ssum += float(s.sum())
+        loss += float((a * np.maximum(margin, 0.0)).sum())
+        count += float(wt.sum()) if wt is not None else x.shape[0]
+    if count == 0:
+        return
+    yield {
+        "gx": gx.tolist(),
+        "hxx": hxx.ravel().tolist(),
+        "hxb": hxb.tolist(),
+        "rsum": aysum,
+        "ssum": ssum,
+        "loss": loss,
+        "count": count,
+    }
+
+
+def partition_svc_stats_arrow(batches, features_col: str, label_col: str,
+                              w: np.ndarray, b: float,
+                              scale: Optional[np.ndarray] = None,
+                              weight_col: Optional[str] = None):
+    import pyarrow as pa
+
+    for row in partition_svc_stats(batches, features_col, label_col, w, b,
+                                   scale=scale, weight_col=weight_col):
+        yield pa.RecordBatch.from_pylist(
+            [row], schema=logreg_stats_arrow_schema()
+        )

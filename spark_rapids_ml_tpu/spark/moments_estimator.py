@@ -36,11 +36,13 @@ from spark_rapids_ml_tpu.spark.aggregate import (
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 
 
-def _collect_moments(dataset, fcol):
-    df = dataset.select(fcol)
+def _collect_moments(dataset, fcol, wcol=None):
+    cols = [fcol] + ([wcol] if wcol else [])
+    df = dataset.select(*cols)
 
     def job(batches):
-        yield from partition_moment_stats_arrow(batches, fcol)
+        yield from partition_moment_stats_arrow(batches, fcol,
+                                                weight_col=wcol)
 
     return combine_moment_stats(
         df.mapInArrow(job, moment_stats_spark_ddl()).collect()
@@ -148,4 +150,107 @@ class TruncatedSVD(_adapter.TruncatedSVD):
         local.copy_values_from(local_est)
         local.fit_timings_ = timer.as_dict()
         local.svd_solver_used_ = local_est._svd_solver_used
+        return self._model_cls(local)
+
+
+class LinearSVC(_adapter.LinearSVC):
+    """DataFrame LinearSVC on the executor statistics plane: the
+    squared-hinge generalized Newton decomposes exactly like the LogReg
+    plane — per partition (Xᵀ(aỹ), XᵀSX, XᵀS, Σaỹ, Σs, loss, Σw)
+    partials at the broadcast (w, b) (``aggregate.partition_svc_stats``,
+    sharing the logreg row schema/combine), one job per iteration, the
+    tiny (d+1)² solve on the driver. ``standardization=True`` runs ONE
+    weighted-moments pass first and optimizes in the scaled space
+    (coefficients unscale at the end); the per-feature std comes from the
+    f64 ONE-PASS moment identity — the same acceptance as the plane
+    StandardScaler — so a pathologically ill-conditioned column
+    (|mean|/sd ≳ 1e7) may standardize differently from the local fit's
+    two-pass std. The Newton iterates themselves are exact f64 matches
+    of the local fit. Rows never reach the driver."""
+
+    def _fit(self, dataset):
+        from spark_rapids_ml_tpu.models.linear_svc import (
+            LinearSVCModel as LocalSVCModel,
+            _assemble_svc_newton,
+        )
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            combine_logreg_stats,
+            logreg_stats_spark_ddl,
+            partition_svc_stats_arrow,
+        )
+
+        local_est = self._local
+        timer = PhaseTimer()
+        fcol = local_est.getInputCol()
+        lcol = local_est.getLabelCol()
+        lam = float(local_est.getRegParam())
+        fit_b = bool(local_est.getFitIntercept())
+        tol = float(local_est.getTol())
+        max_iter = int(local_est.getMaxIter())
+        wcol = local_est.get_or_default("weightCol") or None
+        cols = [fcol, lcol] + ([wcol] if wcol else [])
+        df = dataset.select(*cols).persist()
+        try:
+            scale = None
+            if local_est.getStandardization():
+                with timer.phase("moments"):
+                    count, s1, s2, _lo, _hi = _collect_moments(
+                        df, fcol, wcol=wcol
+                    )
+                n = s1.shape[0]
+                if count > 1.0:
+                    mu = s1 / count
+                    var = np.maximum(
+                        (s2 - count * mu * mu) / (count - 1.0), 0.0
+                    )
+                    sd = np.sqrt(var)
+                    scale = np.where(sd > 0, sd, 1.0)
+            else:
+                # no standardization: only the feature WIDTH is needed —
+                # one first() row, not a full moments scan
+                first = df.first()
+                if first is None:
+                    raise ValueError("empty dataset")
+                n = len(first[0])
+
+            w = np.zeros(n)
+            b = 0.0
+            n_iter = 0
+            with timer.phase("fit_kernel"):
+                for n_iter in range(1, max_iter + 1):
+                    frozen_w, frozen_b = w.copy(), b
+
+                    def job(batches, _w=frozen_w, _b=frozen_b):
+                        yield from partition_svc_stats_arrow(
+                            batches, fcol, lcol, _w, _b,
+                            scale=scale, weight_col=wcol,
+                        )
+
+                    rows = df.mapInArrow(
+                        job, logreg_stats_spark_ddl()
+                    ).collect()
+                    gx, hxx, hxb, aysum, ssum, _loss, cnt = (
+                        combine_logreg_stats(rows)
+                    )
+                    g, h = _assemble_svc_newton(
+                        gx, hxx, hxb, float(aysum), float(ssum),
+                        float(cnt), w, lam, fit_b,
+                    )
+                    delta = np.linalg.solve(h, g)
+                    w = w - delta[:n]
+                    if fit_b:
+                        b = b - delta[n]
+                    if np.max(np.abs(delta)) <= tol:
+                        break
+        finally:
+            df.unpersist()
+        coef = w / scale if scale is not None else w
+        local = LocalSVCModel(
+            coefficients=np.asarray(coef, dtype=np.float64),
+            intercept=float(b),
+        )
+        local.uid = local_est.uid
+        local.copy_values_from(local_est)
+        local.n_iter_ = int(n_iter)
+        local.fit_timings_ = timer.as_dict()
         return self._model_cls(local)

@@ -242,15 +242,14 @@ def test_collect_envelope_guard(spark, rng, monkeypatch):
     import spark_rapids_ml_tpu.spark.adapter as adapter_mod
 
     x = rng.normal(size=(60, 3))
-    y = (x[:, 0] > 0).astype(float)
-    df = _df(spark, x, y)
+    df = _df(spark, x)
     monkeypatch.setattr(adapter_mod, "_COLLECT_MAX_ROWS", 50)
     with pytest.raises(ValueError, match="onto the driver"):
-        LinearSVC().fit(df)
+        NearestNeighbors(k=3).fit(df)
     monkeypatch.setattr(adapter_mod, "_COLLECT_MAX_ROWS", 10_000)
     monkeypatch.setattr(adapter_mod, "_COLLECT_WARN_ROWS", 50)
     with pytest.warns(ResourceWarning):
-        LinearSVC(regParam=0.01).fit(df)
+        NearestNeighbors(k=3).fit(df)
 
 
 def test_fitted_state_is_host_resident(spark, rng):

@@ -787,3 +787,53 @@ def test_linreg_kmeans_plane_weight_col(spark, rng):
                                       weight_col="wt"))
     counts = np.asarray(row["counts"])
     np.testing.assert_allclose(counts, [50.0, 5000.0])
+
+
+def test_svc_plane_matches_local_exactly(spark, rng, monkeypatch):
+    """LinearSVC on the statistics plane: f64 Newton over executor
+    partials reproduces the LOCAL fit exactly (standardization on and
+    off, weighted and not) — and the driver-collect path never fires."""
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+    from spark_rapids_ml_tpu.models.linear_svc import (
+        LinearSVC as LocalSVC,
+    )
+    from spark_rapids_ml_tpu.spark import LinearSVC as PlaneSVC
+
+    def boom(self, dataset):
+        raise AssertionError("driver-collect fired on a plane family")
+
+    monkeypatch.setattr(
+        adapter_mod._AdapterEstimator, "_collect_frame", boom
+    )
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    n, d_ = 250, 4
+    x = rng.normal(size=(n, d_)) * np.array([1.0, 5.0, 0.3, 2.0])
+    y = ((x[:, 0] + 0.3 * x[:, 1]) > 0).astype(float)
+    w = rng.uniform(0.5, 2.0, size=n)
+    df = _vector_df(spark, x, extra_cols=[
+        ("label", y.tolist()), ("wt", w.tolist())
+    ])
+    frame = as_vector_frame(x, "features").with_column(
+        "label", y.tolist()
+    ).with_column("wt", w.tolist())
+
+    for std, use_w in ((True, False), (False, False), (True, True)):
+        kwargs = {"regParam": 0.02, "standardization": std}
+        if use_w:
+            kwargs["weightCol"] = "wt"
+        plane = PlaneSVC(**kwargs).fit(df)
+        local_est = LocalSVC().setRegParam(0.02).setStandardization(std)
+        # the local in-memory fit runs on the driver device in f32 by
+        # default; force the host-f64 path for exact comparison
+        local_est.set("useXlaDot", False)
+        if use_w:
+            local_est.setWeightCol("wt")
+        local = local_est.fit(frame)
+        np.testing.assert_allclose(
+            plane._local.coefficients, local.coefficients,
+            rtol=1e-8, atol=1e-10,
+        )
+        np.testing.assert_allclose(
+            plane._local.intercept, local.intercept, atol=1e-9
+        )
