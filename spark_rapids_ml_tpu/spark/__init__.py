@@ -45,6 +45,7 @@ _MOMENTS_PLANE_CLASSES = (
     "MaxAbsScaler",
     "TruncatedSVD",
     "LinearSVC",
+    "OneVsRest",
 )
 
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
@@ -62,7 +63,6 @@ _ADAPTER_CLASSES = (
     "NearestNeighbors",
     "NearestNeighborsModel",
     "TruncatedSVDModel",
-    "OneVsRest",
     "OneVsRestModel",
     "UMAP",
     "UMAPModel",

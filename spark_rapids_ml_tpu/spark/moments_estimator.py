@@ -254,3 +254,115 @@ class LinearSVC(_adapter.LinearSVC):
         local.n_iter_ = int(n_iter)
         local.fit_timings_ = timer.as_dict()
         return self._model_cls(local)
+
+
+class OneVsRest(_adapter.OneVsRest):
+    """DataFrame OneVsRest whose K binary sub-fits run on the statistics
+    planes: classes come from one label-discovery job, each class gets a
+    relabeling UDF column plus a plane LogisticRegression / LinearSVC
+    fit (statistics partials, rows never on the driver). Classifier
+    types without a plane front-end fall back to the adapter path."""
+
+    def _fit(self, dataset):
+        from spark_rapids_ml_tpu.models.linear_svc import (
+            LinearSVC as LocalSVCEst,
+        )
+        from spark_rapids_ml_tpu.models.logistic_regression import (
+            LogisticRegression as LocalLogReg,
+        )
+        from spark_rapids_ml_tpu.models.ovr import OneVsRestModel
+
+        local_ovr = self._local
+        clf = local_ovr.classifier
+        plane_kind = None
+        if clf is None or isinstance(clf, LocalLogReg):
+            plane_kind = "logreg"
+        elif isinstance(clf, LocalSVCEst):
+            plane_kind = "svc"
+        if plane_kind is None:
+            return super()._fit(dataset)
+
+        import pyarrow  # noqa: F401 - mapInArrow dependency, fail early
+
+        from spark_rapids_ml_tpu.spark._compat import pandas_udf
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            partition_label_values,
+        )
+
+        fcol = local_ovr.getInputCol()
+        lcol = local_ovr.getLabelCol()
+
+        def label_job(batches):
+            import pyarrow as pa
+
+            for row in partition_label_values(batches, lcol):
+                yield pa.RecordBatch.from_pylist(
+                    [row],
+                    schema=pa.schema([("labels", pa.list_(pa.float64()))]),
+                )
+
+        rows = dataset.select(lcol).mapInArrow(
+            label_job, "labels array<double>"
+        ).collect()
+        classes = np.asarray(sorted({
+            float(v) for r in rows for v in r["labels"]
+        }))
+        if classes.size < 2:
+            raise ValueError("OneVsRest needs at least two classes")
+        if not np.allclose(classes, np.round(classes)):
+            raise ValueError("labels must be integer class indices")
+
+        def sub_param(name, default):
+            if clf is not None and clf.has_param(name):
+                return clf.get_or_default(name)
+            return default
+
+        df = dataset.select(fcol, lcol).persist()
+        try:
+            models = []
+            for cls in classes:
+
+                @pandas_udf(returnType="double")
+                def bin_label(s, _c=float(cls)):
+                    import pandas as pd
+
+                    return pd.Series(
+                        (np.asarray(s, dtype=np.float64) == _c).astype(
+                            np.float64
+                        )
+                    )
+
+                df_c = df.withColumn("ovr_label", bin_label(df[lcol]))
+                if plane_kind == "logreg":
+                    from spark_rapids_ml_tpu.spark.estimator import (
+                        LogisticRegression as PlaneLR,
+                    )
+
+                    sub = PlaneLR(
+                        featuresCol=fcol, labelCol="ovr_label",
+                        regParam=float(sub_param("regParam", 0.0)),
+                        fitIntercept=bool(sub_param("fitIntercept", True)),
+                        maxIter=int(sub_param("maxIter", 25)),
+                        tol=float(sub_param("tol", 1e-8)),
+                    )
+                    models.append(sub.fit(df_c)._to_local())
+                else:
+                    sub = LinearSVC(
+                        featuresCol=fcol, labelCol="ovr_label",
+                        regParam=float(sub_param("regParam", 0.0)),
+                        fitIntercept=bool(sub_param("fitIntercept", True)),
+                        maxIter=int(sub_param("maxIter", 100)),
+                        tol=float(sub_param("tol", 1e-8)),
+                        standardization=bool(
+                            sub_param("standardization", True)
+                        ),
+                    )
+                    models.append(sub.fit(df_c)._local)
+        finally:
+            df.unpersist()
+        local_model = OneVsRestModel(
+            models=models, classes=classes.astype(np.int64)
+        )
+        local_model.uid = local_ovr.uid
+        local_model.copy_values_from(local_ovr)
+        return _adapter.OneVsRestModel(local_model)

@@ -283,3 +283,37 @@ def test_nearest_neighbors_frame_matches_driver_query(spark, rng):
     d_frame = np.stack([np.asarray(r["knn_distances"]) for r in out])
     np.testing.assert_array_equal(i_frame, i_ref)
     np.testing.assert_allclose(d_frame, d_ref, atol=1e-12)
+
+
+def test_ovr_plane_sub_fits(spark, rng, monkeypatch):
+    """OneVsRest on the statistics planes: K relabeled plane sub-fits
+    (LogReg default and LinearSVC), driver-collect never fires; exotic
+    classifiers still take the adapter path."""
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+    from spark_rapids_ml_tpu.spark import OneVsRest
+
+    def boom(self, dataset):
+        raise AssertionError("driver-collect fired on a plane family")
+
+    monkeypatch.setattr(
+        adapter_mod._AdapterEstimator, "_collect_frame", boom
+    )
+    k, d = 3, 4
+    centers = rng.normal(scale=4, size=(k, d))
+    y = rng.integers(0, k, size=360).astype(float)
+    x = rng.normal(size=(360, d)) + centers[y.astype(int)]
+    df = _df(spark, x, y)
+
+    m = OneVsRest().fit(df)  # default sub-classifier: LogisticRegression
+    pred = np.asarray([r["prediction"] for r in m.transform(df).collect()])
+    assert (pred == y).mean() > 0.85
+
+    from spark_rapids_ml_tpu.models.linear_svc import LinearSVC as LocalSVC
+
+    m2 = OneVsRest(
+        classifier=LocalSVC().setRegParam(0.01)
+    ).fit(df)
+    pred2 = np.asarray(
+        [r["prediction"] for r in m2.transform(df).collect()]
+    )
+    assert (pred2 == y).mean() > 0.85
