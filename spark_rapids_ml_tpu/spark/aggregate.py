@@ -1021,3 +1021,22 @@ def partition_svc_stats_arrow(batches, features_col: str, label_col: str,
         yield pa.RecordBatch.from_pylist(
             [row], schema=logreg_stats_arrow_schema()
         )
+
+
+def discover_label_values(dataset, label_col: str) -> np.ndarray:
+    """One label-only discovery job → sorted distinct label values — the
+    family='auto' pre-pass shared by LogisticRegression and OneVsRest
+    (never densifies the feature vectors)."""
+    import pyarrow as pa
+
+    def job(batches):
+        for row in partition_label_values(batches, label_col):
+            yield pa.RecordBatch.from_pylist(
+                [row],
+                schema=pa.schema([("labels", pa.list_(pa.float64()))]),
+            )
+
+    rows = dataset.select(label_col).mapInArrow(
+        job, "labels array<double>"
+    ).collect()
+    return np.asarray(sorted({float(v) for r in rows for v in r["labels"]}))

@@ -521,6 +521,11 @@ class _TpuLogRegParams(Params):
                       "per-row sample-weight column ('' = unweighted; "
                       "weighted fits run the host-f64 executor plane)",
                       typeConverter=TypeConverters.toString)
+    family = Param(Params._dummy(), "family",
+                   "auto (label-discovery pass picks) | binomial (skip "
+                   "discovery; labels validated 0/1 in executors) | "
+                   "multinomial (softmax plane regardless of class count)",
+                   typeConverter=TypeConverters.toString)
 
     def __init__(self):
         super().__init__()
@@ -528,10 +533,14 @@ class _TpuLogRegParams(Params):
                          predictionCol="prediction",
                          probabilityCol="probability", regParam=0.0,
                          fitIntercept=True, maxIter=25, tol=1e-8,
-                         executorDevice="auto", deviceId=-1, weightCol="")
+                         executorDevice="auto", deviceId=-1, weightCol="",
+                         family="auto")
 
     def setWeightCol(self, value):
         return self._set(weightCol=value)
+
+    def setFamily(self, value):
+        return self._set(family=value)
 
     def setThresholds(self, value):
         return self._set(thresholds=value)
@@ -569,7 +578,7 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
                  predictionCol="prediction", probabilityCol="probability",
                  regParam=0.0, fitIntercept=True, maxIter=25, tol=1e-8,
                  executorDevice="auto", deviceId=-1, thresholds=None,
-                 weightCol=""):
+                 weightCol="", family="auto"):
         super().__init__()
         self._set(**{k_: v for k_, v in self._input_kwargs.items()
                      if v is not None})
@@ -614,30 +623,21 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
             n = len(first[0])
 
             # family="auto": one cheap label-discovery pass picks binary
-            # vs multinomial (the softmax plane), like Spark's
+            # vs multinomial (the softmax plane), like Spark's;
+            # family="binomial" skips the pass entirely (labels are
+            # validated 0/1 inside the executor partials) — the OvR
+            # plane uses this, having just BUILT the binary column
+            family = self.getOrDefault(self.family)
+            if family not in ("auto", "binomial", "multinomial"):
+                raise ValueError(f"family {family!r}")
             from spark_rapids_ml_tpu.spark.aggregate import (
-                partition_label_values,
+                discover_label_values,
             )
 
-            def label_job(batches):
-                import pyarrow as pa
-
-                for row in partition_label_values(batches, lcol):
-                    yield pa.RecordBatch.from_pylist(
-                        [row],
-                        schema=pa.schema(
-                            [("labels", pa.list_(pa.float64()))]
-                        ),
-                    )
-
-            # label-only selection: the discovery pass never densifies
-            # the feature vectors
-            label_rows = dataset.select(lcol).mapInArrow(
-                label_job, "labels array<double>"
-            ).collect()
-            classes = np.asarray(sorted({
-                v for r in label_rows for v in r["labels"]
-            }))
+            classes = (
+                np.asarray([0.0, 1.0]) if family == "binomial"
+                else discover_label_values(dataset, lcol)
+            )
             if classes.size > 100:
                 raise ValueError(
                     f"{classes.size} distinct label values: looks "
@@ -652,7 +652,8 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
                     f"need at least 2 distinct label values to fit a "
                     f"classifier, got {classes.tolist()}"
                 )
-            if classes.size > 2 or not set(classes.tolist()) <= {0.0, 1.0}:
+            if family == "multinomial" or classes.size > 2 \
+                    or not set(classes.tolist()) <= {0.0, 1.0}:
                 # Two classes that are NOT {0,1} (e.g. {1,2}) take the
                 # softmax plane, which class-indexes arbitrary label
                 # values like Spark does — sending them down the binary

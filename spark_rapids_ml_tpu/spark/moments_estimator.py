@@ -272,13 +272,36 @@ class OneVsRest(_adapter.OneVsRest):
         )
         from spark_rapids_ml_tpu.models.ovr import OneVsRestModel
 
+        from spark_rapids_ml_tpu.spark.estimator import (
+            LogisticRegression as PlaneLR,
+        )
+
         local_ovr = self._local
         clf = local_ovr.classifier
         plane_kind = None
-        if clf is None or isinstance(clf, LocalLogReg):
+        if clf is None or isinstance(clf, (LocalLogReg, PlaneLR)):
             plane_kind = "logreg"
         elif isinstance(clf, LocalSVCEst):
             plane_kind = "svc"
+
+        def sub_param(name, default):
+            if clf is None:
+                return default
+            if hasattr(clf, "has_param"):          # local Params system
+                if clf.has_param(name):
+                    return clf.get_or_default(name)
+                return default
+            if hasattr(clf, name):                  # pyspark-style Params
+                return clf.getOrDefault(getattr(clf, name))
+            return default
+
+        if plane_kind == "logreg" and float(
+            sub_param("elasticNetParam", 0.0)
+        ) > 0.0:
+            # the plane LogReg has no elastic-net path; the adapter
+            # collect + local proximal-Newton fit preserves the
+            # configured penalty instead of silently dropping it
+            plane_kind = None
         if plane_kind is None:
             return super()._fit(dataset)
 
@@ -286,37 +309,20 @@ class OneVsRest(_adapter.OneVsRest):
 
         from spark_rapids_ml_tpu.spark._compat import pandas_udf
         from spark_rapids_ml_tpu.spark.aggregate import (
-            partition_label_values,
+            discover_label_values,
         )
 
         fcol = local_ovr.getInputCol()
         lcol = local_ovr.getLabelCol()
-
-        def label_job(batches):
-            import pyarrow as pa
-
-            for row in partition_label_values(batches, lcol):
-                yield pa.RecordBatch.from_pylist(
-                    [row],
-                    schema=pa.schema([("labels", pa.list_(pa.float64()))]),
-                )
-
-        rows = dataset.select(lcol).mapInArrow(
-            label_job, "labels array<double>"
-        ).collect()
-        classes = np.asarray(sorted({
-            float(v) for r in rows for v in r["labels"]
-        }))
+        classes = discover_label_values(dataset, lcol)
         if classes.size < 2:
             raise ValueError("OneVsRest needs at least two classes")
         if not np.allclose(classes, np.round(classes)):
             raise ValueError("labels must be integer class indices")
 
-        def sub_param(name, default):
-            if clf is not None and clf.has_param(name):
-                return clf.get_or_default(name)
-            return default
-
+        # uid-suffixed temp column: a dataset column literally named
+        # "ovr_label" (even the features column) must survive
+        bin_col = f"ovr_label_{local_ovr.uid}"
         df = dataset.select(fcol, lcol).persist()
         try:
             models = []
@@ -332,23 +338,22 @@ class OneVsRest(_adapter.OneVsRest):
                         )
                     )
 
-                df_c = df.withColumn("ovr_label", bin_label(df[lcol]))
+                df_c = df.withColumn(bin_col, bin_label(df[lcol]))
                 if plane_kind == "logreg":
-                    from spark_rapids_ml_tpu.spark.estimator import (
-                        LogisticRegression as PlaneLR,
-                    )
-
                     sub = PlaneLR(
-                        featuresCol=fcol, labelCol="ovr_label",
+                        featuresCol=fcol, labelCol=bin_col,
                         regParam=float(sub_param("regParam", 0.0)),
                         fitIntercept=bool(sub_param("fitIntercept", True)),
                         maxIter=int(sub_param("maxIter", 25)),
                         tol=float(sub_param("tol", 1e-8)),
+                        # the {0,1} column was just built: skip the
+                        # per-sub-fit label-discovery job
+                        family="binomial",
                     )
                     models.append(sub.fit(df_c)._to_local())
                 else:
                     sub = LinearSVC(
-                        featuresCol=fcol, labelCol="ovr_label",
+                        featuresCol=fcol, labelCol=bin_col,
                         regParam=float(sub_param("regParam", 0.0)),
                         fitIntercept=bool(sub_param("fitIntercept", True)),
                         maxIter=int(sub_param("maxIter", 100)),

@@ -837,3 +837,27 @@ def test_svc_plane_matches_local_exactly(spark, rng, monkeypatch):
         np.testing.assert_allclose(
             plane._local.intercept, local.intercept, atol=1e-9
         )
+
+
+def test_logreg_family_param(spark, rng):
+    """family='binomial' skips discovery (same fit); 'multinomial'
+    forces the softmax plane even for two classes."""
+    x = rng.normal(size=(150, 3))
+    y = (x[:, 0] > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    auto = LogisticRegression(regParam=0.05).fit(df)
+    binom = LogisticRegression(regParam=0.05, family="binomial").fit(df)
+    np.testing.assert_allclose(
+        auto.coefficients.toArray(), binom.coefficients.toArray(),
+        atol=1e-12,
+    )
+    multi = LogisticRegression(regParam=0.05, family="multinomial").fit(df)
+    assert multi.coefficientMatrix is not None  # softmax plane, K=2
+    pred = np.asarray(
+        [r["prediction"] for r in multi.transform(df).collect()]
+    )
+    assert (pred == y).mean() > 0.9
+    import pytest
+
+    with pytest.raises(ValueError, match="family"):
+        LogisticRegression(family="bogus").fit(df)
