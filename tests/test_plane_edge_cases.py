@@ -1,0 +1,97 @@
+"""Partition-layout edge cases for every statistics-plane family.
+
+The reference's executor architecture must tolerate whatever partitioning
+Spark hands it; these sweeps pin the planes against the awkward layouts —
+an EMPTY partition plus a single-row partition — which exercise the
+empty-partition guards in every partial and the driver-side combines.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.spark._compat import HAVE_PYSPARK
+from spark_rapids_ml_tpu.spark.local_engine import (
+    DenseVector,
+    LocalSparkSession,
+)
+
+if HAVE_PYSPARK:  # pragma: no cover
+    pytest.skip("real pyspark present: CI lane covers it",
+                allow_module_level=True)
+
+
+@pytest.fixture
+def skewed_spark():
+    # partition 0 gets everything, partition 1 exactly one row,
+    # partition 2 empty (createDataFrame round-robins; we force the
+    # layout below by building partitions directly)
+    return LocalSparkSession(n_partitions=3)
+
+
+def _skewed_df(spark, x, extra):
+    rows = []
+    for i, r in enumerate(x):
+        row = {"features": DenseVector(r)}
+        for name, values in extra:
+            row[name] = values[i]
+        rows.append(row)
+    df = spark.createDataFrame(rows)
+    # rebuild with a skewed layout: [all but one], [one], []
+    fields = df._fields
+    flat = [row for part in df._partitions for row in part]
+    df._partitions = [flat[:-1], flat[-1:], []]
+    assert sum(len(p) for p in df._partitions) == len(rows)
+    return df
+
+
+def test_planes_tolerate_skewed_partitions(skewed_spark, rng):
+    from spark_rapids_ml_tpu.spark import (
+        GBTRegressor,
+        KMeans,
+        LinearRegression,
+        LinearSVC,
+        LogisticRegression,
+        NaiveBayes,
+        PCA,
+        RandomForestClassifier,
+        StandardScaler,
+        TruncatedSVD,
+    )
+
+    n, d = 90, 4
+    x = rng.normal(size=(n, d))
+    y_bin = (x[:, 0] > 0).astype(float)
+    y_reg = x[:, 1] * 2.0
+    y_cnt = np.abs(x)
+
+    df_bin = _skewed_df(skewed_spark, x, [("label", y_bin.tolist())])
+    df_reg = _skewed_df(skewed_spark, x, [("label", y_reg.tolist())])
+    df_feat = _skewed_df(skewed_spark, x, [])
+    df_cnt = _skewed_df(skewed_spark, y_cnt, [("label", y_bin.tolist())])
+
+    assert PCA(k=2, inputCol="features").fit(df_feat).pc is not None
+    assert LinearRegression().fit(df_reg).coefficients is not None
+    assert LogisticRegression(regParam=0.05).fit(df_bin) is not None
+    assert KMeans(k=2, seed=0).fit(df_feat).trainingCost >= 0
+    assert NaiveBayes(modelType="gaussian").fit(df_bin) is not None
+    assert StandardScaler().fit(df_feat)._local.mean is not None
+    assert TruncatedSVD(k=2).fit(df_feat)._local.components is not None
+    assert LinearSVC(regParam=0.01).fit(df_bin) is not None
+    assert RandomForestClassifier(
+        numTrees=4, maxDepth=3, seed=1
+    ).fit(df_bin) is not None
+    assert GBTRegressor(maxIter=4, maxDepth=2, seed=1).fit(df_reg) \
+        is not None
+
+
+def test_planes_single_partition_single_row_errors(skewed_spark, rng):
+    """Degenerate inputs get clear driver-side errors, not executor
+    crashes."""
+    from spark_rapids_ml_tpu.spark import LogisticRegression, StandardScaler
+
+    x1 = rng.normal(size=(1, 3))
+    df1 = _skewed_df(skewed_spark, x1, [("label", [1.0])])
+    with pytest.raises(ValueError):
+        LogisticRegression().fit(df1)   # single class
+    with pytest.raises(ValueError, match="at least 2"):
+        StandardScaler().fit(_skewed_df(skewed_spark, x1, []))
