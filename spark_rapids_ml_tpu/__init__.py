@@ -69,6 +69,12 @@ from spark_rapids_ml_tpu.models.random_forest import (  # noqa: F401
     RandomForestRegressionModel,
     RandomForestRegressor,
 )
+from spark_rapids_ml_tpu.models.feature_scalers import (  # noqa: F401
+    Binarizer,
+    RobustScaler,
+    RobustScalerModel,
+)
+from spark_rapids_ml_tpu.models.imputer import Imputer, ImputerModel  # noqa: F401
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.models.evaluation import (  # noqa: F401
     BinaryClassificationEvaluator,
@@ -125,6 +131,11 @@ __all__ = [
     "RegressionEvaluator",
     "BinaryClassificationEvaluator",
     "MulticlassClassificationEvaluator",
+    "Binarizer",
+    "RobustScaler",
+    "RobustScalerModel",
+    "Imputer",
+    "ImputerModel",
     "ParamGridBuilder",
     "CrossValidator",
     "CrossValidatorModel",

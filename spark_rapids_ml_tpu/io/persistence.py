@@ -1006,3 +1006,69 @@ def load_nb_model(path: str):
     )
     model.uid = meta["uid"]
     return _restore_params(model, meta)
+
+
+def save_robust_model(model, path: str, overwrite: bool = False) -> None:
+    if model.median is None:
+        raise ValueError("cannot save an unfitted RobustScalerModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "median": _dense_vector_struct(model.median),
+        "range": _dense_vector_struct(model.qrange),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("median", _vector_arrow_type()),
+            ("range", _vector_arrow_type()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema,
+                    spark_fields=[("median", "vector"),
+                                  ("range", "vector")])
+
+
+def load_robust_model(path: str):
+    from spark_rapids_ml_tpu.models.feature_scalers import RobustScalerModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = RobustScalerModel(
+        median=_dense_vector_from_struct(row["median"]),
+        qrange=_dense_vector_from_struct(row["range"]),
+    )
+    model.uid = meta["uid"]
+    return _restore_params(model, meta)
+
+
+def save_imputer_model(model, path: str, overwrite: bool = False) -> None:
+    if model.surrogates is None:
+        raise ValueError("cannot save an unfitted ImputerModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {"surrogates": _dense_vector_struct(model.surrogates)}
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([("surrogates", _vector_arrow_type())])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema,
+                    spark_fields=[("surrogates", "vector")])
+
+
+def load_imputer_model(path: str):
+    from spark_rapids_ml_tpu.models.imputer import ImputerModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = ImputerModel(
+        surrogates=_dense_vector_from_struct(row["surrogates"])
+    )
+    model.uid = meta["uid"]
+    return _restore_params(model, meta)

@@ -36,6 +36,12 @@ from spark_rapids_ml_tpu.models.random_forest import (
     RandomForestRegressionModel,
     RandomForestRegressor,
 )
+from spark_rapids_ml_tpu.models.feature_scalers import (
+    Binarizer,
+    RobustScaler,
+    RobustScalerModel,
+)
+from spark_rapids_ml_tpu.models.imputer import Imputer, ImputerModel
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
 from spark_rapids_ml_tpu.models.evaluation import (
     BinaryClassificationEvaluator,
@@ -86,6 +92,11 @@ __all__ = [
     "OneVsRestModel",
     "Pipeline",
     "PipelineModel",
+    "Binarizer",
+    "RobustScaler",
+    "RobustScalerModel",
+    "Imputer",
+    "ImputerModel",
     "RegressionEvaluator",
     "BinaryClassificationEvaluator",
     "MulticlassClassificationEvaluator",

@@ -235,3 +235,131 @@ class Normalizer(HasInputCol, HasOutputCol, Params):
         from spark_rapids_ml_tpu.io.persistence import load_params
 
         return load_params(Normalizer, path)
+
+
+class Binarizer(HasInputCol, HasOutputCol, Params):
+    """Per-element thresholding — a pure Transformer (no fit), Spark's
+    ``Binarizer`` applied to this framework's vector-column idiom
+    (each feature dimension binarizes independently)."""
+
+    outputCol = Param("outputCol", "output column name",
+                      "binarized_features")
+    threshold = Param("threshold", "values > threshold map to 1.0", 0.0,
+                      validator=lambda v: np.isfinite(float(v)))
+
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        return frame.with_column(
+            self.getOutputCol(),
+            (x > float(self.getThreshold())).astype(np.float64),
+        )
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "Binarizer":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(Binarizer, path)
+
+
+class RobustScalerParams(HasInputCol, HasOutputCol):
+    """Spark 3.0 ``RobustScaler`` surface over the vector-column idiom:
+    center by median, scale by the (lower, upper) quantile range."""
+
+    outputCol = Param("outputCol", "output column name", "scaled_features")
+    withCentering = Param("withCentering", "subtract the median", False,
+                          validator=lambda v: isinstance(v, bool))
+    withScaling = Param("withScaling", "divide by the quantile range",
+                        True, validator=lambda v: isinstance(v, bool))
+    lower = Param("lower", "lower quantile", 0.25,
+                  validator=lambda v: 0.0 < float(v) < 1.0)
+    upper = Param("upper", "upper quantile", 0.75,
+                  validator=lambda v: 0.0 < float(v) < 1.0)
+
+
+class RobustScaler(RobustScalerParams):
+    """``RobustScaler().setWithCentering(True).fit(df)`` — quantile-based
+    scaling that ignores outliers (exact per-feature quantiles on the
+    in-memory fit; the DataFrame front-end collects under the adapter's
+    envelope guard — approximate-quantile planes are future work)."""
+
+    def fit(self, dataset) -> "RobustScalerModel":
+        timer = PhaseTimer()
+        if float(self.getLower()) >= float(self.getUpper()):
+            raise ValueError("lower must be below upper")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("fit"):
+            x = frame.vectors_as_matrix(self.getInputCol())
+            if x.shape[0] < 1:
+                raise ValueError("fit requires at least one row")
+            # nanquantile: NaN entries are ignored per feature (the
+            # sklearn/Spark convention); an all-NaN column has no
+            # quantiles to scale by
+            if np.isnan(x).all(axis=0).any():
+                raise ValueError(
+                    "a feature column is entirely NaN; impute first"
+                )
+            qs = np.nanquantile(
+                x,
+                [float(self.getLower()), 0.5, float(self.getUpper())],
+                axis=0,
+            )
+        model = RobustScalerModel(median=qs[1], qrange=qs[2] - qs[0])
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str):
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(cls, path)
+
+
+class RobustScalerModel(RobustScalerParams):
+    def __init__(self, median: Optional[np.ndarray] = None,
+                 qrange: Optional[np.ndarray] = None):
+        super().__init__()
+        self.median = median
+        self.qrange = qrange
+        self.fit_timings_ = {}
+
+    def _copy_internal_state(self, other: "RobustScalerModel") -> None:
+        other.median = self.median
+        other.qrange = self.qrange
+
+    def transform(self, dataset) -> VectorFrame:
+        if self.median is None:
+            raise ValueError("model is unfitted")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        out = x
+        if self.get_or_default("withCentering"):
+            out = out - self.median[None, :]
+        if self.get_or_default("withScaling"):
+            # zero-range columns pass through (sklearn/Spark convention)
+            denom = np.where(self.qrange > 0, self.qrange, 1.0)
+            out = out / denom[None, :]
+        return frame.with_column(self.getOutputCol(), out)
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_robust_model
+
+        save_robust_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "RobustScalerModel":
+        from spark_rapids_ml_tpu.io.persistence import load_robust_model
+
+        return load_robust_model(path)

@@ -60,6 +60,10 @@ _ADAPTER_CLASSES = (
     "StandardScalerModel",
     "MinMaxScalerModel",
     "MaxAbsScalerModel",
+    "RobustScaler",
+    "RobustScalerModel",
+    "Imputer",
+    "ImputerModel",
     "NearestNeighbors",
     "NearestNeighborsModel",
     "TruncatedSVDModel",

@@ -42,6 +42,10 @@ __all__ = [
     "GBTClassifier",
     "GBTRegressor",
     "LinearSVC",
+    "RobustScaler",
+    "RobustScalerModel",
+    "Imputer",
+    "ImputerModel",
     "MaxAbsScaler",
     "MinMaxScaler",
     "NaiveBayesModel",
@@ -441,6 +445,12 @@ from spark_rapids_ml_tpu.models.feature_scalers import (  # noqa: E402
     MaxAbsScalerModel as _LMAS_M,
     MinMaxScaler as _LMMS,
     MinMaxScalerModel as _LMMS_M,
+    RobustScaler as _LRS,
+    RobustScalerModel as _LRS_M,
+)
+from spark_rapids_ml_tpu.models.imputer import (  # noqa: E402
+    Imputer as _LIMP,
+    ImputerModel as _LIMP_M,
 )
 from spark_rapids_ml_tpu.models.random_forest import (  # noqa: E402
     RandomForestClassificationModel as _LRFC_M,
@@ -499,6 +509,17 @@ MinMaxScaler, MinMaxScalerModel = _make_pair(
 MaxAbsScaler, MaxAbsScalerModel = _make_pair(
     "MaxAbsScaler", _LMAS, _LMAS_M, needs_label=False,
     out_col_param="outputCol", out_kind="vector",
+)
+RobustScaler, RobustScalerModel = _make_pair(
+    "RobustScaler", _LRS, _LRS_M, needs_label=False,
+    out_col_param="outputCol", out_kind="vector",
+    doc="Quantile-range scaling; exact quantiles on the collected fit "
+        "(envelope-guarded).",
+)
+Imputer, ImputerModel = _make_pair(
+    "Imputer", _LIMP, _LIMP_M, needs_label=False,
+    out_col_param="outputCol", out_kind="vector",
+    doc="Per-feature missing-value replacement (mean/median/mode).",
 )
 TruncatedSVD, TruncatedSVDModel = _make_pair(
     "TruncatedSVD", _LSVD, _LSVD_M, needs_label=False,

@@ -130,3 +130,104 @@ def test_scalers_streamed_match_inmemory(rng):
     ss_m = StandardScaler().setUseXlaDot(False).fit(x)
     np.testing.assert_allclose(ss_s.mean, ss_m.mean, atol=1e-12)
     np.testing.assert_allclose(ss_s.std, ss_m.std, atol=1e-10)
+
+
+def test_robust_scaler_matches_sklearn(rng, tmp_path):
+    SkRobust = pytest.importorskip(
+        "sklearn.preprocessing"
+    ).RobustScaler
+
+    from spark_rapids_ml_tpu import RobustScaler, RobustScalerModel
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    x = rng.normal(size=(300, 5)) * np.array([1, 10, 0.1, 5, 2.0])
+    x[::17] *= 50.0  # outliers the quantile range must shrug off
+    frame = as_vector_frame(x, "features")
+    m = (
+        RobustScaler().setWithCentering(True).setWithScaling(True)
+        .fit(frame)
+    )
+    ours = np.stack(
+        list(m.transform(frame).column("scaled_features"))
+    )
+    sk = SkRobust(with_centering=True, with_scaling=True).fit(x)
+    np.testing.assert_allclose(ours, sk.transform(x), atol=1e-9)
+
+    m.save(str(tmp_path / "rs"))
+    loaded = RobustScalerModel.load(str(tmp_path / "rs"))
+    np.testing.assert_allclose(loaded.median, m.median)
+    np.testing.assert_allclose(loaded.qrange, m.qrange)
+
+
+def test_binarizer(rng):
+    from spark_rapids_ml_tpu import Binarizer
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    x = rng.normal(size=(50, 3))
+    out = np.stack(list(
+        Binarizer().setThreshold(0.5).transform(
+            as_vector_frame(x, "features")
+        ).column("binarized_features")
+    ))
+    np.testing.assert_array_equal(out, (x > 0.5).astype(float))
+
+
+def test_imputer_strategies(rng, tmp_path):
+    from spark_rapids_ml_tpu import Imputer, ImputerModel
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    x = rng.normal(size=(200, 3))
+    miss = rng.random(x.shape) < 0.2
+    x_miss = np.array(x)
+    x_miss[miss] = np.nan
+    frame = as_vector_frame(x_miss, "features")
+
+    for strategy, fn in (
+        ("mean", np.mean), ("median", np.median),
+    ):
+        m = Imputer().setStrategy(strategy).fit(frame)
+        for j in range(3):
+            expect = fn(x[~miss[:, j], j])
+            np.testing.assert_allclose(m.surrogates[j], expect)
+        out = np.stack(list(
+            m.transform(frame).column("imputed_features")
+        ))
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out[~miss], x[~miss])
+
+    # mode with ties breaking to the smallest value
+    xm = np.array([[1.0], [2.0], [2.0], [3.0], [3.0], [np.nan]])
+    mm = Imputer().setStrategy("mode").fit(
+        as_vector_frame(xm, "features")
+    )
+    assert mm.surrogates[0] == 2.0
+
+    # sentinel missingValue (non-NaN)
+    xs = np.array([[1.0], [-1.0], [3.0]])
+    ms = Imputer().setMissingValue(-1.0).fit(
+        as_vector_frame(xs, "features")
+    )
+    np.testing.assert_allclose(ms.surrogates[0], 2.0)
+
+    m = Imputer().setStrategy("median").fit(frame)
+    m.save(str(tmp_path / "imp"))
+    loaded = ImputerModel.load(str(tmp_path / "imp"))
+    np.testing.assert_allclose(loaded.surrogates, m.surrogates)
+    assert loaded.getStrategy() == "median"
+
+
+def test_robust_scaler_ignores_nan(rng):
+    from spark_rapids_ml_tpu import RobustScaler
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    x = rng.normal(size=(60, 2))
+    x[3, 0] = np.nan
+    m = RobustScaler().fit(as_vector_frame(x, "features"))
+    assert np.isfinite(m.median).all() and np.isfinite(m.qrange).all()
+    np.testing.assert_allclose(m.median[0], np.nanmedian(x[:, 0]))
+    x_bad = np.array(x)
+    x_bad[:, 1] = np.nan
+    import pytest
+
+    with pytest.raises(ValueError, match="entirely NaN"):
+        RobustScaler().fit(as_vector_frame(x_bad, "features"))

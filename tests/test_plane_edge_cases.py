@@ -37,7 +37,6 @@ def _skewed_df(spark, x, extra):
         rows.append(row)
     df = spark.createDataFrame(rows)
     # rebuild with a skewed layout: [all but one], [one], []
-    fields = df._fields
     flat = [row for part in df._partitions for row in part]
     df._partitions = [flat[:-1], flat[-1:], []]
     assert sum(len(p) for p in df._partitions) == len(rows)

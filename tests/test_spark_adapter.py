@@ -317,3 +317,27 @@ def test_ovr_plane_sub_fits(spark, rng, monkeypatch):
         [r["prediction"] for r in m2.transform(df).collect()]
     )
     assert (pred2 == y).mean() > 0.85
+
+
+def test_imputer_robust_front_ends(spark, rng):
+    from spark_rapids_ml_tpu.spark import Imputer, RobustScaler
+
+    x = rng.normal(size=(120, 3))
+    x_miss = np.array(x)
+    x_miss[::7, 1] = np.nan
+    df = _df(spark, x_miss)
+    m = Imputer(strategy="median").fit(df)
+    out = np.stack([
+        r["imputed_features"].toArray()
+        for r in m.transform(df).collect()
+    ])
+    assert np.isfinite(out).all()
+
+    rs = RobustScaler(withCentering=True).fit(_df(spark, x))
+    out2 = np.stack([
+        r["scaled_features"].toArray()
+        for r in rs.transform(_df(spark, x)).collect()
+    ])
+    np.testing.assert_allclose(
+        np.median(out2, axis=0), 0.0, atol=1e-9
+    )
