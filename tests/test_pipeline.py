@@ -124,3 +124,46 @@ def test_vector_rows_through_pipeline(rng):
     model = Pipeline(stages=[PCA().setK(2).setOutputCol("out")]).fit(frame)
     out = model.transform(frame)
     assert np.asarray(out.column("out")).shape == (20, 2)
+
+
+def test_pipeline_with_round4_transformers(rng):
+    """Imputer → RobustScaler → LogisticRegression composes through
+    Pipeline with persistence intact."""
+    from spark_rapids_ml_tpu import (
+        Imputer,
+        LogisticRegression,
+        Pipeline,
+        PipelineModel,
+        RobustScaler,
+    )
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    n = 240
+    x = rng.normal(size=(n, 4))
+    y = (x[:, 0] > 0).astype(float)
+    x_miss = np.array(x)
+    x_miss[::9, 2] = np.nan
+    frame = as_vector_frame(x_miss, "features").with_column(
+        "label", y.tolist()
+    )
+    pipe = Pipeline(stages=[
+        Imputer().setStrategy("median").setOutputCol("imp"),
+        RobustScaler().setInputCol("imp").setWithCentering(True)
+        .setOutputCol("scaled"),
+        LogisticRegression().setInputCol("scaled").setRegParam(0.05),
+    ])
+    model = pipe.fit(frame)
+    pred = np.asarray(
+        list(model.transform(frame).column("prediction"))
+    )
+    assert (pred == y).mean() > 0.9
+
+    import tempfile
+
+    path = tempfile.mkdtemp() + "/pipe4"
+    model.save(path)
+    loaded = PipelineModel.load(path)
+    pred2 = np.asarray(
+        list(loaded.transform(frame).column("prediction"))
+    )
+    np.testing.assert_array_equal(pred, pred2)
