@@ -46,6 +46,8 @@ _MOMENTS_PLANE_CLASSES = (
     "TruncatedSVD",
     "LinearSVC",
     "OneVsRest",
+    "RobustScaler",
+    "Imputer",
 )
 
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
@@ -60,9 +62,7 @@ _ADAPTER_CLASSES = (
     "StandardScalerModel",
     "MinMaxScalerModel",
     "MaxAbsScalerModel",
-    "RobustScaler",
     "RobustScalerModel",
-    "Imputer",
     "ImputerModel",
     "NearestNeighbors",
     "NearestNeighborsModel",

@@ -1040,3 +1040,112 @@ def discover_label_values(dataset, label_col: str) -> np.ndarray:
         job, "labels array<double>"
     ).collect()
     return np.asarray(sorted({float(v) for r in rows for v in r["labels"]}))
+
+
+def partition_feature_sample(
+    batches: Iterable,
+    input_col: str,
+    seed: int,
+    cap: int = 8192,
+    sample_stride: int = 1,
+) -> Iterator[Dict[str, object]]:
+    """One row per partition: a ≤``cap``-row approximately-uniform sample
+    of the feature vectors (NaNs preserved) plus the partition row count —
+    the features-only sibling of ``forest_plane.partition_forest_sample``,
+    feeding driver-side quantile statistics (RobustScaler / median
+    Imputer, the approxQuantile analogue). Strided partition gating keeps
+    the driver merge bounded exactly as the forest sampler does."""
+    from spark_rapids_ml_tpu.spark.forest_plane import partition_identity
+
+    pid = partition_identity()
+    emit_sample = pid % max(sample_stride, 1) == 0
+    rng = np.random.default_rng([seed & 0x7FFFFFFF, pid])
+    buf = []
+    buffered = 0
+    n_seen = 0
+    d_seen = 0
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(input_col))
+        else:
+            x = np.asarray(batch, dtype=np.float64)
+        if x.shape[0] == 0:
+            continue
+        n_seen += x.shape[0]
+        d_seen = x.shape[1]
+        if emit_sample:
+            buf.append(x)
+            buffered += x.shape[0]
+            if buffered > 4 * cap:
+                xa = np.concatenate(buf)
+                keep = rng.choice(xa.shape[0], 4 * cap, replace=False)
+                buf, buffered = [xa[keep]], 4 * cap
+    if n_seen == 0:
+        return
+    if emit_sample:
+        xa = np.concatenate(buf)
+        if xa.shape[0] > cap:
+            keep = rng.choice(xa.shape[0], cap, replace=False)
+            xa = xa[keep]
+        sample = xa.ravel().tolist()
+        d = int(xa.shape[1])
+    else:
+        sample = []
+        d = int(d_seen)
+    yield {"n": n_seen, "sample": sample, "d": d}
+
+
+def feature_sample_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema([
+        ("n", pa.int64()),
+        ("sample", pa.list_(pa.float64())),
+        ("d", pa.int64()),
+    ])
+
+
+def feature_sample_spark_ddl() -> str:
+    return "n long, sample array<double>, d long"
+
+
+def partition_imputer_stats(
+    batches: Iterable, input_col: str, missing_value: float
+) -> Iterator[Dict[str, object]]:
+    """One partition's PER-FEATURE non-missing (count, Σx) — the
+    missing-aware moments the mean Imputer needs exactly (NaN entries
+    and the sentinel are excluded per feature, Spark's null semantics)."""
+    s1: Optional[np.ndarray] = None
+    cnt: Optional[np.ndarray] = None
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(input_col))
+        else:
+            x = np.asarray(batch, dtype=np.float64)
+        if x.shape[0] == 0:
+            continue
+        missing = np.isnan(x)
+        if not np.isnan(missing_value):
+            missing |= x == missing_value
+        if s1 is None:
+            s1 = np.zeros(x.shape[1])
+            cnt = np.zeros(x.shape[1])
+        xv = np.where(missing, 0.0, x)
+        s1 += xv.sum(axis=0)
+        cnt += (~missing).sum(axis=0)
+    if s1 is None:
+        return
+    yield {"count_vec": cnt.tolist(), "s1": s1.tolist()}
+
+
+def imputer_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema([
+        ("count_vec", pa.list_(pa.float64())),
+        ("s1", pa.list_(pa.float64())),
+    ])
+
+
+def imputer_stats_spark_ddl() -> str:
+    return "count_vec array<double>, s1 array<double>"

@@ -603,3 +603,16 @@ def partition_gbt_leaf_stats(
     if not seen:
         return
     yield {"tree": tree_idx, "hist": stats.ravel().tolist()}
+
+
+def quantile_sample_cap(d: int, n_partitions: int) -> int:
+    """Per-partition row cap for the QUANTILE sampling planes
+    (RobustScaler / median Imputer): unlike the tree-plane sampler, every
+    partition must contribute (a skipped partition would bias the
+    model-defining medians on partition-clustered data), so the budget is
+    divided across ALL partitions instead of striding — small
+    per-partition samples rather than skipped partitions."""
+    budget_elems = 1 << 23
+    return int(np.clip(
+        budget_elems // max(d * n_partitions, 1), 16, 8192
+    ))

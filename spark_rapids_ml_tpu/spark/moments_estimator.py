@@ -371,3 +371,154 @@ class OneVsRest(_adapter.OneVsRest):
         local_model.uid = local_ovr.uid
         local_model.copy_values_from(local_ovr)
         return _adapter.OneVsRestModel(local_model)
+
+
+def _collect_feature_sample(dataset, fcol, seed=0):
+    """(sample matrix, n_total): bounded per-partition sampled rows for
+    driver-side quantile statistics — every partition contributes
+    (``forest_plane.quantile_sample_cap``)."""
+    from spark_rapids_ml_tpu.spark.aggregate import (
+        feature_sample_arrow_schema,
+        feature_sample_spark_ddl,
+        partition_feature_sample,
+    )
+    from spark_rapids_ml_tpu.spark.forest_estimator import _num_partitions
+    from spark_rapids_ml_tpu.spark.forest_plane import quantile_sample_cap
+
+    df = dataset.select(fcol)
+    first = df.first()
+    if first is None:
+        raise ValueError("empty dataset")
+    width = len(first[0])
+    n_parts = _num_partitions(df)
+    # every partition contributes (stride 1): a skipped partition would
+    # bias the quantiles on partition-clustered data
+    cap = quantile_sample_cap(width, n_parts)
+
+    def job(batches):
+        import pyarrow as pa
+
+        for row in partition_feature_sample(
+            batches, fcol, seed, cap=cap, sample_stride=1
+        ):
+            yield pa.RecordBatch.from_pylist(
+                [row], schema=feature_sample_arrow_schema()
+            )
+
+    rows = df.mapInArrow(job, feature_sample_spark_ddl()).collect()
+    if not rows:
+        raise ValueError("empty dataset")
+    d = int(rows[0]["d"])
+    xs = [
+        np.asarray(r["sample"], dtype=np.float64).reshape(-1, d)
+        for r in rows if len(r["sample"])
+    ]
+    if not xs:
+        raise ValueError("no sampled rows (all sampling partitions empty)")
+    return np.concatenate(xs), sum(int(r["n"]) for r in rows)
+
+
+class RobustScaler(_adapter.RobustScaler):
+    """RobustScaler on the statistics plane: quantiles come from ONE
+    bounded row sample covering EVERY partition (the approxQuantile
+    posture — Spark's RobustScaler also computes approximate quantiles),
+    reduced on the driver with NaN-ignoring quantiles. Rows never
+    collect in full."""
+
+    def _fit(self, dataset):
+        from spark_rapids_ml_tpu.models.feature_scalers import (
+            RobustScalerModel,
+        )
+
+        local_est = self._local
+        if float(local_est.getLower()) >= float(local_est.getUpper()):
+            raise ValueError("lower must be below upper")
+        timer = PhaseTimer()
+        fcol = local_est.getInputCol()
+        with timer.phase("fit"):
+            sample, _n = _collect_feature_sample(dataset, fcol)
+            if np.isnan(sample).all(axis=0).any():
+                raise ValueError(
+                    "a feature column is entirely NaN; impute first"
+                )
+            qs = np.nanquantile(
+                sample,
+                [float(local_est.getLower()), 0.5,
+                 float(local_est.getUpper())],
+                axis=0,
+            )
+        local = RobustScalerModel(median=qs[1], qrange=qs[2] - qs[0])
+        local.uid = local_est.uid
+        local.copy_values_from(local_est)
+        local.fit_timings_ = timer.as_dict()
+        return self._model_cls(local)
+
+
+class Imputer(_adapter.Imputer):
+    """Imputer on the statistics plane: strategy='mean' reduces EXACT
+    per-feature non-missing (count, Σx) partials; 'median' takes the
+    sampled-quantile pass (Spark's own median Imputer is approxQuantile);
+    'mode' needs exact value counts and keeps the adapter collect."""
+
+    def _fit(self, dataset):
+        from spark_rapids_ml_tpu.models.imputer import ImputerModel
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            imputer_stats_arrow_schema,
+            imputer_stats_spark_ddl,
+            partition_imputer_stats,
+        )
+
+        local_est = self._local
+        strategy = local_est.getStrategy()
+        if strategy == "mode":
+            return super()._fit(dataset)
+        timer = PhaseTimer()
+        fcol = local_est.getInputCol()
+        missing = float(local_est.getMissingValue())
+        with timer.phase("fit"):
+            if strategy == "mean":
+                def job(batches):
+                    import pyarrow as pa
+
+                    for row in partition_imputer_stats(
+                        batches, fcol, missing
+                    ):
+                        yield pa.RecordBatch.from_pylist(
+                            [row], schema=imputer_stats_arrow_schema()
+                        )
+
+                rows = dataset.select(fcol).mapInArrow(
+                    job, imputer_stats_spark_ddl()
+                ).collect()
+                if not rows:
+                    raise ValueError("empty dataset")
+                cnt = np.zeros(len(rows[0]["count_vec"]))
+                s1 = np.zeros_like(cnt)
+                for r in rows:
+                    cnt += np.asarray(r["count_vec"], dtype=np.float64)
+                    s1 += np.asarray(r["s1"], dtype=np.float64)
+                if (cnt == 0).any():
+                    j = int(np.argmax(cnt == 0))
+                    raise ValueError(
+                        f"feature {j} has no non-missing values to "
+                        f"impute from"
+                    )
+                surrogates = s1 / cnt
+            else:  # median via the sampled-quantile pass
+                sample, _n = _collect_feature_sample(dataset, fcol)
+                sentinel = missing
+                if not np.isnan(sentinel):
+                    sample = np.where(
+                        sample == sentinel, np.nan, sample
+                    )
+                if np.isnan(sample).all(axis=0).any():
+                    raise ValueError(
+                        "a feature column has no non-missing values to "
+                        "impute from"
+                    )
+                surrogates = np.nanmedian(sample, axis=0)
+        local = ImputerModel(surrogates=surrogates)
+        local.uid = local_est.uid
+        local.copy_values_from(local_est)
+        local.fit_timings_ = timer.as_dict()
+        return self._model_cls(local)
