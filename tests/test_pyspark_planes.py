@@ -106,3 +106,19 @@ def test_ovr_plane_pyspark(spark):
         [r["prediction"] for r in m.transform(df).collect()]
     )
     assert (pred == y).mean() > 0.85
+
+
+def test_imputer_robust_planes_pyspark(spark):
+    from spark_rapids_ml_tpu.spark import Imputer, RobustScaler
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(200, 3))
+    xm = np.array(x)
+    xm[::9, 1] = float("nan")
+    df = spark.createDataFrame(
+        [(Vectors.dense(r),) for r in xm], ["features"]
+    )
+    m = Imputer(strategy="mean").fit(df)
+    assert np.isfinite(m._local.surrogates).all()
+    rs = RobustScaler(withCentering=True).fit(df)
+    assert np.isfinite(rs._local.median).all()
