@@ -74,3 +74,36 @@ cv = CrossValidator(
 )
 best = cv.fit(VectorFrame({"features": x, "label": y}))
 print("cross-validation: avg rmse per grid point", [round(m, 4) for m in best.avgMetrics])
+
+
+def feature_transformers_example():
+    """Round-4 additions: Imputer, RobustScaler, Binarizer."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu import Binarizer, Imputer, RobustScaler
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(300, 4)) * np.array([1.0, 10.0, 0.1, 3.0])
+    x[::13, 1] = np.nan
+    frame = as_vector_frame(x, "features")
+
+    imp = Imputer().setStrategy("median").fit(frame)
+    filled = imp.transform(frame)
+    print("Imputer surrogates:", np.round(imp.surrogates, 3).tolist())
+
+    rs = (
+        RobustScaler().setInputCol("imputed_features")
+        .setWithCentering(True).fit(filled)
+    )
+    print("RobustScaler median:", np.round(rs.median, 3).tolist())
+
+    b = Binarizer().setThreshold(0.0).transform(frame)
+    print("Binarizer ones fraction:",
+          round(float(np.mean(np.stack(
+              list(b.column("binarized_features"))
+          ))), 3))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    feature_transformers_example()
