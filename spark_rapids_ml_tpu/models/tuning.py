@@ -105,6 +105,14 @@ class _TuningParams(Params):
         0.75,
         validator=lambda v: 0.0 < v < 1.0,
     )
+    foldCol = Param(
+        "foldCol",
+        "user-specified fold-index column for CrossValidator (Spark 3.1 "
+        "semantics: integer fold ids in [0, numFolds); '' = random "
+        "folds by seed)",
+        "",
+        validator=lambda v: isinstance(v, str),
+    )
     seed = Param(
         "seed", "shuffle seed", 0, validator=lambda v: isinstance(v, int)
     )
@@ -137,17 +145,35 @@ class CrossValidator(_TuningParams):
         folds = self.getNumFolds()
         if n < folds:
             raise ValueError(f"{n} rows cannot make {folds} folds")
-        rng = np.random.default_rng(self.getSeed())
-        perm = rng.permutation(n)
-        bounds = np.linspace(0, n, folds + 1).astype(int)
+        fold_col = self.get_or_default("foldCol")
+        if fold_col:
+            # Spark 3.1 foldCol: the dataset assigns its own folds
+            assign = np.asarray(frame.column(fold_col), dtype=np.float64)
+            if not np.allclose(assign, np.round(assign)):
+                raise ValueError("foldCol must hold integer fold ids")
+            assign = assign.astype(int)
+            if assign.min() < 0 or assign.max() >= folds:
+                raise ValueError(
+                    f"foldCol values must lie in [0, numFolds={folds})"
+                )
+            fold_indices = [np.where(assign == f)[0] for f in range(folds)]
+            if any(idx.size == 0 for idx in fold_indices):
+                raise ValueError("every fold in [0, numFolds) needs rows")
+        else:
+            rng = np.random.default_rng(self.getSeed())
+            perm = rng.permutation(n)
+            bounds = np.linspace(0, n, folds + 1).astype(int)
+            fold_indices = [
+                perm[bounds[f]:bounds[f + 1]] for f in range(folds)
+            ]
 
         avg_metrics = []
         for params in self.estimatorParamMaps:
             scores = []
             for f in range(folds):
-                val_idx = perm[bounds[f] : bounds[f + 1]]
+                val_idx = fold_indices[f]
                 train_idx = np.concatenate(
-                    [perm[: bounds[f]], perm[bounds[f + 1] :]]
+                    [fold_indices[g] for g in range(folds) if g != f]
                 )
                 model = _fit_with(
                     self.estimator, params, frame.select_rows(train_idx)

@@ -232,3 +232,40 @@ def test_cross_validator_multiclass(rng):
         [v for v in model.transform(frame).column("prediction")]
     )
     assert (pred == y).mean() > 0.85
+
+
+def test_cross_validator_fold_col(rng):
+    """foldCol (Spark 3.1): user-assigned folds drive the splits; bad
+    assignments get clear errors."""
+    from spark_rapids_ml_tpu import LinearRegression
+
+    n = 120
+    x = rng.normal(size=(n, 3))
+    y = x[:, 0] * 2 + 0.1 * rng.normal(size=n)
+    fold = np.arange(n) % 3
+    frame = VectorFrame({
+        "features": x, "label": y, "fold": fold.astype(float)
+    })
+    cv = CrossValidator(
+        estimator=LinearRegression(),
+        estimatorParamMaps=[{"regParam": 0.0}, {"regParam": 10.0}],
+        evaluator=RegressionEvaluator(),
+        numFolds=3,
+        foldCol="fold",
+    )
+    model = cv.fit(frame)
+    assert len(model.avgMetrics) == 2
+    assert model.avgMetrics[0] < model.avgMetrics[1]  # rmse: unreg wins
+
+    bad = VectorFrame({
+        "features": x, "label": y,
+        "fold": (np.arange(n) % 5).astype(float),  # ids up to 4 >= 3
+    })
+    with pytest.raises(ValueError, match="lie in"):
+        CrossValidator(
+            estimator=LinearRegression(),
+            estimatorParamMaps=[{}],
+            evaluator=RegressionEvaluator(),
+            numFolds=3,
+            foldCol="fold",
+        ).fit(bad)
