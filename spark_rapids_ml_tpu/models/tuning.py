@@ -3,8 +3,9 @@
 The Spark ML tuning surface (``org.apache.spark.ml.tuning``) that the
 reference's Estimators are consumed through. Semantics match Spark:
 k-fold (or single split) over shuffled rows, average metric per param
-map, winner refit on the FULL dataset; ``foldCol``-style custom folds are
-out of scope. Fitting is sequential over param maps — each inner fit
+map, winner refit on the FULL dataset; ``foldCol`` accepts user-assigned
+fold ids (Spark 3.1, CrossValidator only). Fitting is sequential over
+param maps — each inner fit
 already saturates the chip, so Spark's ``parallelism`` knob would only
 thrash HBM here.
 """
@@ -105,14 +106,6 @@ class _TuningParams(Params):
         0.75,
         validator=lambda v: 0.0 < v < 1.0,
     )
-    foldCol = Param(
-        "foldCol",
-        "user-specified fold-index column for CrossValidator (Spark 3.1 "
-        "semantics: integer fold ids in [0, numFolds); '' = random "
-        "folds by seed)",
-        "",
-        validator=lambda v: isinstance(v, str),
-    )
     seed = Param(
         "seed", "shuffle seed", 0, validator=lambda v: isinstance(v, int)
     )
@@ -121,6 +114,15 @@ class _TuningParams(Params):
 class CrossValidator(_TuningParams):
     """``CrossValidator(estimator=…, estimatorParamMaps=…, evaluator=…,
     numFolds=3)`` — Spark's k-fold model selection."""
+
+    foldCol = Param(
+        "foldCol",
+        "user-specified fold-index column (Spark 3.1 semantics: integer "
+        "fold ids in [0, numFolds); '' = random folds by seed). "
+        "CrossValidator-only, matching Spark",
+        "",
+        validator=lambda v: isinstance(v, str),
+    )
 
     def __init__(
         self,
@@ -151,7 +153,7 @@ class CrossValidator(_TuningParams):
             assign = np.asarray(frame.column(fold_col), dtype=np.float64)
             if not np.allclose(assign, np.round(assign)):
                 raise ValueError("foldCol must hold integer fold ids")
-            assign = assign.astype(int)
+            assign = np.round(assign).astype(int)
             if assign.min() < 0 or assign.max() >= folds:
                 raise ValueError(
                     f"foldCol values must lie in [0, numFolds={folds})"
