@@ -20,8 +20,6 @@ Deterministic by seed (Poisson subsampling weights, dense reductions).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame

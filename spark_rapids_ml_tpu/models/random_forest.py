@@ -14,8 +14,6 @@ thread-racy CPU forest builders.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame

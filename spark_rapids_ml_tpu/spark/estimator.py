@@ -1054,7 +1054,6 @@ class KMeans(Estimator, _TpuKMeansParams):
             combine_kmeans_stats,
             kmeans_stats_spark_ddl,
             partition_kmeans_stats,
-            vector_column_to_matrix,
         )
 
         fcol = self.getOrDefault(self.featuresCol)

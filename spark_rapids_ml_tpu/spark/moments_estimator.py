@@ -27,7 +27,6 @@ from spark_rapids_ml_tpu.spark import adapter as _adapter
 from spark_rapids_ml_tpu.spark.aggregate import (
     combine_moment_stats,
     combine_stats,
-    moment_stats_arrow_schema,
     moment_stats_spark_ddl,
     partition_gram_stats_arrow,
     partition_moment_stats_arrow,
