@@ -4,7 +4,15 @@
 drop-in the reference advertises (``/root/reference/README.md:12-28``),
 running against real pyspark DataFrames. The Arrow aggregation logic lives
 in ``spark.aggregate`` and imports without pyspark; the Estimator/Model
-classes require it.
+classes require it (or the in-repo local engine).
+
+Fit-strategy routing (resolved lazily below): bespoke statistics planes
+(``estimator.py``) for PCA/LinReg/LogReg/KMeans/NaiveBayes; per-level
+tree planes (``forest_estimator.py``) for RandomForest/GBT; moments/Gram/
+Newton planes (``moments_estimator.py``) for the scalers, TruncatedSVD,
+Imputer, RobustScaler, LinearSVC, and OneVsRest; the envelope-guarded
+driver-collect adapter (``adapter.py``) only for the non-decomposable
+fits (UMAP spectral init, KNN item capture) and every Model transform.
 """
 
 from spark_rapids_ml_tpu.spark.aggregate import (  # noqa: F401
