@@ -43,6 +43,10 @@ from spark_rapids_ml_tpu.models.linear_svc import (  # noqa: F401
     LinearSVC,
     LinearSVCModel,
 )
+from spark_rapids_ml_tpu.models.glm import (  # noqa: F401
+    GeneralizedLinearRegression,
+    GeneralizedLinearRegressionModel,
+)
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: F401
     NaiveBayes,
@@ -107,6 +111,8 @@ __all__ = [
     "LogisticRegressionModel",
     "LinearSVC",
     "LinearSVCModel",
+    "GeneralizedLinearRegression",
+    "GeneralizedLinearRegressionModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

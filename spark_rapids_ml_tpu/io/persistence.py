@@ -22,7 +22,7 @@ import json
 import os
 import shutil
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -56,6 +56,10 @@ _SPARK_CLASS_ALIASES = {
     "LinearSVCModel": "org.apache.spark.ml.classification.LinearSVCModel",
     "Pipeline": "org.apache.spark.ml.Pipeline",
     "PipelineModel": "org.apache.spark.ml.PipelineModel",
+    "GeneralizedLinearRegression":
+        "org.apache.spark.ml.regression.GeneralizedLinearRegression",
+    "GeneralizedLinearRegressionModel":
+        "org.apache.spark.ml.regression.GeneralizedLinearRegressionModel",
 }
 
 # Params a real Spark DefaultParamsReader recognizes per class. Extras
@@ -86,10 +90,19 @@ _SPARK_PARAM_ALLOWLIST = {
                        "standardization", "threshold", "weightCol"},
     "StandardScaler": {"withMean", "withStd", "inputCol", "outputCol"},
     "StandardScalerModel": {"withMean", "withStd", "inputCol", "outputCol"},
+    "GeneralizedLinearRegression": {
+        "labelCol", "predictionCol", "linkPredictionCol", "family", "link",
+        "variancePower", "linkPower", "offsetCol", "maxIter", "tol",
+        "regParam", "fitIntercept", "weightCol"},
+    "GeneralizedLinearRegressionModel": {
+        "labelCol", "predictionCol", "linkPredictionCol", "family", "link",
+        "variancePower", "linkPower", "offsetCol", "maxIter", "tol",
+        "regParam", "fitIntercept", "weightCol"},
 }
 
 
-def _write_metadata(path: str, cls: str, uid: str, param_map: Dict[str, Any]) -> None:
+def _write_metadata(path: str, cls: str, uid: str, param_map: Dict[str, Any],
+                    extra: Optional[Dict[str, Any]] = None) -> None:
     meta_dir = os.path.join(path, "metadata")
     os.makedirs(meta_dir, exist_ok=True)
     simple_name = cls.rsplit(".", 1)[-1]
@@ -110,6 +123,8 @@ def _write_metadata(path: str, cls: str, uid: str, param_map: Dict[str, Any]) ->
         "defaultParamMap": {},
         "tpuParamMap": extra_params,
     }
+    if extra:
+        metadata["extra"] = extra
     with open(os.path.join(meta_dir, "part-00000"), "w") as f:
         f.write(json.dumps(metadata))
     open(os.path.join(meta_dir, "_SUCCESS"), "w").close()
@@ -435,6 +450,61 @@ def save_linreg_model(model, path: str, overwrite: bool = False) -> None:
     _write_data_row(path, row, schema=schema, spark_fields=[
         ("coefficients", "vector"), ("intercept", "double"), ("scale", "double"),
     ])
+
+
+def save_glm_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark GeneralizedLinearRegressionModel layout: (intercept,
+    coefficients) — matching ``GeneralizedLinearRegressionModelWriter``
+    upstream; fit summary scalars ride in the metadata extras."""
+    if model.coefficients is None:
+        raise ValueError(
+            "cannot save an unfitted GeneralizedLinearRegressionModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    extras = {
+        "numIterations": int(model.num_iterations_),
+        "deviance": float(model.deviance_),
+        "weightSum": float(model.weight_sum_),
+    }
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata(),
+                    extra=extras)
+    row = {
+        "intercept": float(model.intercept),
+        "coefficients": _dense_vector_struct(model.coefficients),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("intercept", pa.float64()),
+                ("coefficients", _vector_arrow_type()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("intercept", "double"), ("coefficients", "vector"),
+    ])
+
+
+def load_glm_model(path: str):
+    from spark_rapids_ml_tpu.models.glm import (
+        GeneralizedLinearRegressionModel,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = GeneralizedLinearRegressionModel(
+        coefficients=_dense_vector_from_struct(row["coefficients"]),
+        intercept=float(row["intercept"]),
+        uid=meta["uid"],
+    )
+    extras = meta.get("extra", {})
+    model.num_iterations_ = int(extras.get("numIterations", 0))
+    model.deviance_ = float(extras.get("deviance", float("nan")))
+    model.weight_sum_ = float(extras.get("weightSum", 0.0))
+    return _restore_params(model, meta)
 
 
 def save_svc_model(model, path: str, overwrite: bool = False) -> None:

@@ -9,6 +9,10 @@ from spark_rapids_ml_tpu.models.logistic_regression import (
     LogisticRegressionModel,
 )
 from spark_rapids_ml_tpu.models.linear_svc import LinearSVC, LinearSVCModel
+from spark_rapids_ml_tpu.models.glm import (
+    GeneralizedLinearRegression,
+    GeneralizedLinearRegressionModel,
+)
 from spark_rapids_ml_tpu.models.nearest_neighbors import (
     NearestNeighbors,
     NearestNeighborsModel,
@@ -67,6 +71,8 @@ __all__ = [
     "LogisticRegressionModel",
     "LinearSVC",
     "LinearSVCModel",
+    "GeneralizedLinearRegression",
+    "GeneralizedLinearRegressionModel",
     "DBSCAN",
     "DBSCANModel",
     "NearestNeighbors",

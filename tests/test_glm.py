@@ -1,0 +1,321 @@
+"""GeneralizedLinearRegression: sklearn/own-model oracles, estimating-
+equation stationarity, host/device agreement, weights/offset/streaming,
+persistence."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    GeneralizedLinearRegression,
+    GeneralizedLinearRegressionModel,
+    LinearRegression,
+    LogisticRegression,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+from spark_rapids_ml_tpu.ops.glm_kernel import family_funcs, link_funcs
+
+ABS_TOL = 1e-5
+
+
+def make_glm_data(rng, family, n=400, p=4):
+    x = rng.normal(size=(n, p)) * 0.5
+    beta = rng.normal(size=p) * 0.4
+    b = 0.3
+    eta = x @ beta + b
+    if family == "gaussian":
+        y = eta + 0.1 * rng.normal(size=n)
+    elif family == "binomial":
+        y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-eta))).astype(float)
+    elif family == "poisson":
+        y = rng.poisson(np.exp(eta)).astype(float)
+    elif family == "gamma":
+        shape = 5.0
+        y = rng.gamma(shape, np.exp(eta) / shape)
+    elif family == "tweedie":
+        # compound Poisson-gamma sampled crudely: poisson count of gamma jumps
+        lam = np.exp(eta)
+        counts = rng.poisson(lam)
+        y = np.array([rng.gamma(2.0, 0.5 * max(m, 1) / 2.0) if c > 0 else 0.0
+                      for c, m in zip(counts, lam)])
+    return x, y, beta, b
+
+
+def _frame(x, y, extra=None):
+    cols = {"features": list(x), "label": y}
+    if extra:
+        cols.update(extra)
+    return VectorFrame(cols)
+
+
+def test_gaussian_identity_equals_linear_regression(rng):
+    x, y, _, _ = make_glm_data(rng, "gaussian")
+    glm = GeneralizedLinearRegression().fit(x, labels=y)
+    lin = LinearRegression().fit(x, labels=y)
+    np.testing.assert_allclose(glm.coefficients, lin.coefficients,
+                               atol=ABS_TOL)
+    assert glm.intercept == pytest.approx(lin.intercept, abs=ABS_TOL)
+
+
+def test_binomial_logit_equals_logistic_regression(rng):
+    x, y, _, _ = make_glm_data(rng, "binomial")
+    glm = GeneralizedLinearRegression(family="binomial").setTol(1e-12) \
+        .fit(x, labels=y)
+    log = LogisticRegression().setRegParam(0.0).setTol(1e-12) \
+        .fit(x, labels=y)
+    np.testing.assert_allclose(glm.coefficients, log.coefficients,
+                               atol=1e-4)
+    assert glm.intercept == pytest.approx(log.intercept, abs=1e-4)
+
+
+@pytest.mark.parametrize("family,power", [("poisson", 1.0), ("gamma", 2.0),
+                                          ("tweedie", 1.5)])
+def test_log_link_matches_sklearn(rng, family, power):
+    sk_lm = pytest.importorskip("sklearn.linear_model")
+    x, y, _, _ = make_glm_data(rng, family)
+    if family == "tweedie":
+        y = y + 0.01  # sklearn's Tweedie handles y=0; keep both in-domain
+        est = GeneralizedLinearRegression(family="tweedie") \
+            .setVariancePower(power).setLinkPower(0.0)
+        sk = sk_lm.TweedieRegressor(power=power, link="log", alpha=0.0,
+                                    max_iter=2000, tol=1e-10)
+    elif family == "poisson":
+        est = GeneralizedLinearRegression(family="poisson")
+        sk = sk_lm.PoissonRegressor(alpha=0.0, max_iter=2000, tol=1e-10)
+    else:
+        est = GeneralizedLinearRegression(family="gamma").setLink("log")
+        sk = sk_lm.GammaRegressor(alpha=0.0, max_iter=2000, tol=1e-10)
+    model = est.setTol(1e-12).setMaxIter(100).fit(x, labels=y)
+    sk.fit(x, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_, atol=1e-4)
+    assert model.intercept == pytest.approx(sk.intercept_, abs=1e-4)
+
+
+@pytest.mark.parametrize("family,link", [
+    ("binomial", "probit"), ("binomial", "cloglog"),
+    ("poisson", "sqrt"), ("gamma", "inverse"), ("gaussian", "log"),
+])
+def test_estimating_equations_stationary(rng, family, link):
+    """At the IRLS optimum the quasi-score vanishes:
+    sum_i w_i (y_i - mu_i) / (V(mu_i) g'(mu_i)) * [x_i, 1] = 0."""
+    x, y, _, _ = make_glm_data(rng, family)
+    if family == "gaussian" and link == "log":
+        y = np.exp(0.2 * x @ np.ones(x.shape[1]) + 0.1) \
+            + 0.05 * rng.normal(size=len(y))
+    model = GeneralizedLinearRegression(family=family).setLink(link) \
+        .setTol(1e-13).setMaxIter(200).fit(x, labels=y)
+    variance, _, clip_mu, _ = family_funcs(family, 0.0)
+    g, ginv, gprime = link_funcs(link)
+    eta = x @ model.coefficients + model.intercept
+    mu = clip_mu(np, np.asarray(ginv(np, eta)))
+    score_w = (y - mu) / (variance(np, mu) * np.asarray(gprime(np, mu)))
+    score = np.concatenate([x.T @ score_w, [score_w.sum()]])
+    scale = max(1.0, float(np.abs(y).sum()))
+    assert np.max(np.abs(score)) / scale < 1e-6
+
+
+def test_host_and_device_paths_agree(rng):
+    x, y, _, _ = make_glm_data(rng, "poisson")
+    dev = GeneralizedLinearRegression(family="poisson").fit(x, labels=y)
+    host = GeneralizedLinearRegression(family="poisson") \
+        .setUseXlaDot(False).fit(x, labels=y)
+    np.testing.assert_allclose(dev.coefficients, host.coefficients,
+                               atol=1e-8)
+    assert dev.intercept == pytest.approx(host.intercept, abs=1e-8)
+
+
+def test_integer_weights_equal_row_duplication(rng):
+    x, y, _, _ = make_glm_data(rng, "poisson", n=120)
+    w = rng.integers(1, 4, size=len(y)).astype(float)
+    weighted = GeneralizedLinearRegression(family="poisson") \
+        .setWeightCol("w").setTol(1e-12) \
+        .fit(_frame(x, y, {"w": w}))
+    xr = np.repeat(x, w.astype(int), axis=0)
+    yr = np.repeat(y, w.astype(int))
+    dup = GeneralizedLinearRegression(family="poisson").setTol(1e-12) \
+        .fit(xr, labels=yr)
+    np.testing.assert_allclose(weighted.coefficients, dup.coefficients,
+                               atol=1e-6)
+    assert weighted.intercept == pytest.approx(dup.intercept, abs=1e-6)
+
+
+def test_offset_acts_as_fixed_exposure(rng):
+    """Poisson with log link: offset = log(exposure). A model fit on
+    rate-scaled counts with the offset recovers the SAME rate
+    coefficients as an exposure-1 fit on the rates."""
+    x, _, beta, b = make_glm_data(rng, "poisson", n=3000)
+    exposure = rng.uniform(0.5, 4.0, size=x.shape[0])
+    mu = exposure * np.exp(x @ beta + b)
+    y = rng.poisson(mu).astype(float)
+    with_off = GeneralizedLinearRegression(family="poisson") \
+        .setOffsetCol("off").setTol(1e-12) \
+        .fit(_frame(x, y, {"off": np.log(exposure)}))
+    # the offset fit estimates the rate model; the recovered coefficients
+    # should be near the generating beta (n is large)
+    np.testing.assert_allclose(with_off.coefficients, beta, atol=0.1)
+    # and transform must apply the offset column when present
+    out = with_off.transform(_frame(x, y, {"off": np.log(exposure)}))
+    pred = np.asarray(out.column("prediction"))
+    eta = x @ with_off.coefficients + with_off.intercept + np.log(exposure)
+    np.testing.assert_allclose(pred, np.exp(eta), rtol=1e-10)
+
+
+def test_streamed_fit_matches_in_memory(rng):
+    x, y, _, _ = make_glm_data(rng, "poisson", n=600)
+
+    def chunks():
+        for i in range(0, len(y), 150):
+            yield (x[i:i + 150], y[i:i + 150])
+
+    streamed = GeneralizedLinearRegression(family="poisson").setTol(1e-12) \
+        .fit(chunks)
+    memory = GeneralizedLinearRegression(family="poisson").setTol(1e-12) \
+        .fit(x, labels=y)
+    np.testing.assert_allclose(streamed.coefficients, memory.coefficients,
+                               atol=1e-7)
+    assert streamed.intercept == pytest.approx(memory.intercept, abs=1e-7)
+
+
+def test_link_prediction_col_and_transform(rng):
+    x, y, _, _ = make_glm_data(rng, "gamma")
+    model = GeneralizedLinearRegression(family="gamma").setLink("log") \
+        .setLinkPredictionCol("linkPred").fit(x, labels=y)
+    out = model.transform(_frame(x, y))
+    eta = np.asarray(out.column("linkPred"))
+    mu = np.asarray(out.column("prediction"))
+    np.testing.assert_allclose(mu, np.exp(eta), rtol=1e-10)
+
+
+def test_evaluate_summary(rng):
+    x, y, _, _ = make_glm_data(rng, "poisson")
+    model = GeneralizedLinearRegression(family="poisson").fit(x, labels=y)
+    s = model.evaluate(_frame(x, y))
+    assert s["deviance"] <= s["nullDeviance"]
+    assert s["dispersion"] == 1.0  # poisson fixes dispersion at 1
+    assert s["numIterations"] >= 1
+    g = GeneralizedLinearRegression(family="gaussian").fit(x, labels=y)
+    sg = g.evaluate(_frame(x, y))
+    assert sg["dispersion"] > 0.0
+
+
+def test_regparam_shrinks_coefficients(rng):
+    x, y, _, _ = make_glm_data(rng, "poisson")
+    free = GeneralizedLinearRegression(family="poisson").fit(x, labels=y)
+    reg = GeneralizedLinearRegression(family="poisson").setRegParam(10.0) \
+        .fit(x, labels=y)
+    assert np.linalg.norm(reg.coefficients) < np.linalg.norm(
+        free.coefficients)
+
+
+def test_family_link_grid_validation(rng):
+    x, y, _, _ = make_glm_data(rng, "poisson")
+    with pytest.raises(ValueError, match="not supported"):
+        GeneralizedLinearRegression(family="poisson").setLink("logit") \
+            .fit(x, labels=y)
+    with pytest.raises(ValueError, match="non-negative"):
+        GeneralizedLinearRegression(family="poisson").fit(x, labels=y - 10)
+    with pytest.raises(ValueError, match="positive"):
+        GeneralizedLinearRegression(family="gamma").setLink("log") \
+            .fit(x, labels=np.zeros_like(y))
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        GeneralizedLinearRegression(family="binomial").fit(x, labels=y + 5)
+
+
+def test_no_intercept_inverse_link_is_finite(rng):
+    """eta=0 start would put inverse-link mu at a pole; the mustart-style
+    first iteration must keep fitIntercept=False fits finite."""
+    x, y, _, _ = make_glm_data(rng, "gamma")
+    for use_xla in (True, False):
+        model = GeneralizedLinearRegression(family="gamma") \
+            .setFitIntercept(False).setUseXlaDot(use_xla).fit(x, labels=y)
+        assert np.isfinite(model.coefficients).all()
+        assert np.isfinite(model.deviance_)
+        assert model.intercept == 0.0
+
+
+def test_streamed_inverse_link_is_finite(rng):
+    x, y, _, _ = make_glm_data(rng, "gamma")
+
+    def chunks():
+        for i in range(0, len(y), 100):
+            yield (x[i:i + 100], y[i:i + 100])
+
+    streamed = GeneralizedLinearRegression(family="gamma").setTol(1e-12) \
+        .fit(chunks)
+    memory = GeneralizedLinearRegression(family="gamma").setTol(1e-12) \
+        .fit(x, labels=y)
+    assert np.isfinite(streamed.coefficients).all()
+    np.testing.assert_allclose(streamed.coefficients, memory.coefficients,
+                               atol=1e-7)
+
+
+def test_one_shot_generator_rejected_up_front(rng):
+    x, y, _, _ = make_glm_data(rng, "poisson")
+    gen = ((x[i:i + 100], y[i:i + 100]) for i in range(0, len(y), 100))
+    with pytest.raises(ValueError, match="one pass per IRLS"):
+        GeneralizedLinearRegression(family="poisson").fit(gen)
+
+
+def test_transform_missing_offset_column_raises(rng):
+    x, _, beta, b = make_glm_data(rng, "poisson", n=200)
+    off = rng.uniform(0.1, 1.0, size=200)
+    y = rng.poisson(np.exp(x @ beta + b + off)).astype(float)
+    model = GeneralizedLinearRegression(family="poisson") \
+        .setOffsetCol("off").fit(_frame(x, y, {"off": off}))
+    with pytest.raises(ValueError, match="offsetCol"):
+        model.transform(_frame(x, y))
+
+
+def test_metadata_omits_unset_link_sentinels(rng, tmp_path):
+    """'' link / null linkPower would break a real Spark reader; unset
+    means canonical default, so they must not appear in the metadata."""
+    import json
+    import os
+
+    x, y, _, _ = make_glm_data(rng, "poisson")
+    model = GeneralizedLinearRegression(family="poisson").fit(x, labels=y)
+    path = str(tmp_path / "glm_sentinels")
+    model.save(path)
+    with open(os.path.join(path, "metadata", "part-00000")) as f:
+        meta = json.loads(f.readline())
+    merged = {**meta["paramMap"], **meta["tpuParamMap"]}
+    assert "link" not in merged
+    assert "linkPower" not in merged
+    loaded = GeneralizedLinearRegressionModel.load(path)
+    assert loaded.get_or_default("link") == ""
+    assert loaded.get_or_default("linkPower") is None
+
+
+def test_tweedie_default_link_power(rng):
+    """family=tweedie defaults linkPower to 1 - variancePower (Spark)."""
+    est = GeneralizedLinearRegression(family="tweedie").setVariancePower(1.5)
+    fam, link, vp, lp = est._resolved_family_link()
+    assert (fam, link, vp, lp) == ("tweedie", "power", 1.5, -0.5)
+
+
+def test_persistence_roundtrip(rng, tmp_path):
+    x, y, _, _ = make_glm_data(rng, "gamma")
+    model = GeneralizedLinearRegression(family="gamma").setLink("log") \
+        .fit(x, labels=y)
+    path = str(tmp_path / "glm_model")
+    model.save(path)
+    loaded = GeneralizedLinearRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+    assert loaded.intercept == model.intercept
+    assert loaded.get_or_default("family") == "gamma"
+    assert loaded.get_or_default("link") == "log"
+    assert loaded.num_iterations_ == model.num_iterations_
+    assert loaded.deviance_ == pytest.approx(model.deviance_)
+    out_a = model.transform(_frame(x, y)).column("prediction")
+    out_b = loaded.transform(_frame(x, y)).column("prediction")
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b))
+
+
+def test_estimator_persistence_roundtrip(rng, tmp_path):
+    est = GeneralizedLinearRegression(family="tweedie") \
+        .setVariancePower(1.3).setMaxIter(7)
+    path = str(tmp_path / "glm_est")
+    est.save(path)
+    loaded = GeneralizedLinearRegression.load(path)
+    assert loaded.get_or_default("family") == "tweedie"
+    assert loaded.get_or_default("variancePower") == 1.3
+    assert loaded.getMaxIter() == 7
