@@ -56,6 +56,7 @@ _MOMENTS_PLANE_CLASSES = (
     "OneVsRest",
     "RobustScaler",
     "Imputer",
+    "GeneralizedLinearRegression",
 )
 
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
@@ -67,6 +68,7 @@ _ADAPTER_CLASSES = (
     "GBTRegressorModel",
     "NaiveBayesModel",
     "LinearSVCModel",
+    "GeneralizedLinearRegressionModel",
     "StandardScalerModel",
     "MinMaxScalerModel",
     "MaxAbsScalerModel",

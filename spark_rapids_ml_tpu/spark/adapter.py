@@ -393,6 +393,82 @@ class _SVCAdapterModel(_AdapterModel):
         return result.withColumn(pred_col, pred_udf(result[raw_col]))
 
 
+class _GLMAdapterModel(_AdapterModel):
+    """GeneralizedLinearRegression variant: ONE feature pass computes
+    eta (linkPrediction); the mean prediction mu = g^-1(eta) derives
+    elementwise from it without a second densify/matmul. When ``offsetCol`` is set the model REQUIRES that column at
+    scoring time and adds it to eta — a deliberate deviation from Spark,
+    which silently ignores the training offset at transform; silently
+    dropping a fitted exposure produces wrong rates (documented in
+    ``models/glm.py``)."""
+
+    def _transform(self, dataset):
+        local = self._local
+        in_col = local.getInputCol()
+        pred_col = local.get_or_default("predictionCol")
+        link_col = local.get_or_default("linkPredictionCol")
+        offset_col = local.get_or_default("offsetCol")
+        if offset_col and offset_col not in dataset.columns:
+            raise ValueError(
+                f"offsetCol {offset_col!r} is set on the model but missing "
+                "from the input DataFrame"
+            )
+        from spark_rapids_ml_tpu.ops.glm_kernel import link_funcs
+
+        family, link, var_power, link_power = local._resolved_family_link()
+        _, ginv, _ = link_funcs(link, link_power)
+        coef = np.asarray(local.coefficients, dtype=np.float64)
+        b = float(local.intercept)
+
+        def _eta(feat_series, off_series):
+            x = _densify(feat_series)
+            eta = x @ coef + b
+            if off_series is not None:
+                eta = eta + np.asarray(off_series, dtype=np.float64)
+            return eta
+
+        def _feature_pass(col, to_mu):
+            """ONE densify + matmul pass producing eta (or mu) into col."""
+            if offset_col:
+                @pandas_udf(returnType="double")
+                def apply(feat, off):
+                    import pandas as pd
+
+                    eta = _eta(feat, off)
+                    vals = ginv(np, eta) if to_mu else eta
+                    return pd.Series(np.asarray(vals, dtype=np.float64))
+
+                return dataset.withColumn(
+                    col, apply(dataset[in_col], dataset[offset_col]))
+
+            @pandas_udf(returnType="double")
+            def apply(feat):
+                import pandas as pd
+
+                eta = _eta(feat, None)
+                vals = ginv(np, eta) if to_mu else eta
+                return pd.Series(np.asarray(vals, dtype=np.float64))
+
+            return dataset.withColumn(col, apply(dataset[in_col]))
+
+        if not link_col:
+            return _feature_pass(pred_col, True) if pred_col else dataset
+        result = _feature_pass(link_col, False)
+        if not pred_col:
+            return result
+
+        # mu derives elementwise from the already-computed eta column —
+        # no second densify/matmul pass (the _SVCAdapterModel pattern)
+        @pandas_udf(returnType="double")
+        def mu_from_eta(eta_series):
+            import pandas as pd
+
+            eta = np.asarray(eta_series, dtype=np.float64)
+            return pd.Series(np.asarray(ginv(np, eta), dtype=np.float64))
+
+        return result.withColumn(pred_col, mu_from_eta(result[link_col]))
+
+
 def _make_pair(name, local_est, local_model, *, needs_label,
                out_col_param="predictionCol", out_kind="double",
                classifier=False, proba_scalar=False, aliases=None, doc="",
@@ -436,6 +512,10 @@ from spark_rapids_ml_tpu.models.gbt import (  # noqa: E402
 from spark_rapids_ml_tpu.models.linear_svc import (  # noqa: E402
     LinearSVC as _LSVC,
     LinearSVCModel as _LSVC_M,
+)
+from spark_rapids_ml_tpu.models.glm import (  # noqa: E402
+    GeneralizedLinearRegression as _LGLM,
+    GeneralizedLinearRegressionModel as _LGLM_M,
 )
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: E402
     NaiveBayesModel as _LNB_M,
@@ -496,6 +576,13 @@ LinearSVC, LinearSVCModel = _make_pair(
     model_base=_SVCAdapterModel,
     doc="rawPrediction is Spark's 2-vector [-margin, margin]; prediction "
         "follows the margin-vs-threshold rule.",
+)
+GeneralizedLinearRegression, GeneralizedLinearRegressionModel = _make_pair(
+    "GeneralizedLinearRegression", _LGLM, _LGLM_M, needs_label=True,
+    model_base=_GLMAdapterModel,
+    doc="IRLS fit runs on the executor statistics plane "
+        "(spark/moments_estimator.py); transform emits mu and optional "
+        "linkPrediction eta.",
 )
 StandardScaler, StandardScalerModel = _make_pair(
     "StandardScaler", _LSS, _LSS_M, needs_label=False,

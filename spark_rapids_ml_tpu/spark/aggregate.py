@@ -1023,6 +1023,95 @@ def partition_svc_stats_arrow(batches, features_col: str, label_col: str,
         )
 
 
+def partition_glm_stats(
+    batches: Iterable,
+    features_col: str,
+    label_col: str,
+    coef: np.ndarray,
+    intercept: float,
+    *,
+    family: str,
+    link: str,
+    var_power: float,
+    link_power: float,
+    first: bool,
+    weight_col: Optional[str] = None,
+    offset_col: Optional[str] = None,
+) -> Iterator[Dict[str, object]]:
+    """One partition's GLM IRLS partials under broadcast (coef,
+    intercept) — the weighted-least-squares working statistics
+    (X'WX, X'Wz, sum(wx), sum(wz), sum(w)) plus the deviance, emitted in
+    the SAME row shape as ``partition_logreg_stats`` (gx≡X'Wz, hxx≡X'WX,
+    hxb≡sum(wx), rsum≡sum(wz), ssum≡sum(w), loss≡deviance, count≡rows)
+    so the logreg schema/combine are shared. ``first`` runs the
+    mustart-style starting iteration (``ops.glm_kernel.irls_step_math``).
+    """
+    from spark_rapids_ml_tpu.ops.glm_kernel import (
+        irls_step_math,
+        validate_label_range,
+    )
+
+    coef = np.asarray(coef, dtype=np.float64).reshape(-1)
+    totals = None
+    count = 0.0
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+            y = np.asarray(batch.column(label_col).to_pylist(),
+                           dtype=np.float64)
+        else:
+            x, y = batch
+            x = np.asarray(x, dtype=np.float64)
+            y = np.asarray(y, dtype=np.float64).reshape(-1)
+        if x.shape[0] == 0:
+            continue
+        validate_label_range(y, family=family, var_power=var_power)
+        wt = _batch_weights_agg(batch, weight_col)
+        if offset_col:
+            if not hasattr(batch, "column"):
+                raise ValueError(
+                    "plain (x, y) tuple batches cannot carry an offset "
+                    "column; use Arrow batches"
+                )
+            off = np.asarray(batch.column(offset_col).to_pylist(),
+                             dtype=np.float64)
+        else:
+            off = np.zeros(x.shape[0])
+        # count carries sum(prior weights), matching partition_logreg_stats
+        count += float(wt.sum()) if wt is not None else float(x.shape[0])
+        if wt is None:
+            wt = np.ones(x.shape[0])
+        out = irls_step_math(
+            np, x, y, wt, off, coef, float(intercept), family=family,
+            link=link, var_power=var_power, link_power=link_power,
+            use_init_mu=first,
+        )
+        totals = out if totals is None else type(out)(
+            *(a + b for a, b in zip(totals, out)))
+    if totals is None:
+        return
+    yield {
+        "gx": [float(v) for v in np.asarray(totals.xtz)],
+        "hxx": [float(v) for v in np.asarray(totals.xtx).reshape(-1)],
+        "hxb": [float(v) for v in np.asarray(totals.x_sum)],
+        "rsum": float(totals.z_sum),
+        "ssum": float(totals.w_sum),
+        "loss": float(totals.deviance),
+        "count": count,
+    }
+
+
+def partition_glm_stats_arrow(batches, features_col: str, label_col: str,
+                              coef: np.ndarray, intercept: float, **kw):
+    import pyarrow as pa
+
+    for row in partition_glm_stats(batches, features_col, label_col, coef,
+                                   intercept, **kw):
+        yield pa.RecordBatch.from_pylist(
+            [row], schema=logreg_stats_arrow_schema()
+        )
+
+
 def discover_label_values(dataset, label_col: str) -> np.ndarray:
     """One label-only discovery job → sorted distinct label values — the
     family='auto' pre-pass shared by LogisticRegression and OneVsRest

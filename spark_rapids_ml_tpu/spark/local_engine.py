@@ -399,9 +399,9 @@ def col(name: str) -> _Column:
 
 
 class _UdfExpr:
-    def __init__(self, fn: Callable, input_col: _Column, return_type):
+    def __init__(self, fn: Callable, input_cols, return_type):
         self.fn = fn
-        self.input_col = input_col
+        self.input_cols = tuple(input_cols)
         self.return_type = return_type
 
 
@@ -410,8 +410,9 @@ class _PandasUdf:
         self.fn = fn
         self.return_type = return_type
 
-    def __call__(self, column: _Column) -> _UdfExpr:
-        return _UdfExpr(self.fn, column, self.return_type)
+    def __call__(self, *columns: _Column) -> _UdfExpr:
+        # real pyspark pandas_udfs take one Series per input column
+        return _UdfExpr(self.fn, columns, self.return_type)
 
 
 def pandas_udf(f=None, returnType=None, functionType=None):
@@ -542,12 +543,15 @@ class LocalDataFrame:
             )
         import pandas as pd
 
-        in_idx = self._fields.index(expr.input_col.name)
+        in_cols = (expr.input_cols if isinstance(expr, _UdfExpr)
+                   else (expr.input_col,))
+        in_idx = [self._fields.index(c.name) for c in in_cols]
         out_parts = []
         for part in self._partitions:
             if part:
-                series = pd.Series([row[in_idx] for row in part])
-                result = list(expr.fn(series))
+                series = [pd.Series([row[i] for row in part])
+                          for i in in_idx]
+                result = list(expr.fn(*series))
                 if len(result) != len(part):
                     raise ValueError("pandas_udf returned wrong row count")
             else:

@@ -255,6 +255,84 @@ class LinearSVC(_adapter.LinearSVC):
         return self._model_cls(local)
 
 
+class GeneralizedLinearRegression(_adapter.GeneralizedLinearRegression):
+    """DataFrame GLM on the executor statistics plane: each IRLS
+    iteration is one mapInArrow job emitting per-partition weighted
+    working statistics (X'WX, X'Wz, sums, deviance) under the broadcast
+    (coef, intercept) — ``aggregate.partition_glm_stats`` — reduced by
+    the shared logreg combine; the tiny (d x d) weighted solve and the
+    deviance convergence check run on the driver. Rows never reach the
+    driver. The first job runs the family's mustart starting iteration
+    (same math as the local fit, ``models/glm.py::_irls``)."""
+
+    def _fit(self, dataset):
+        from spark_rapids_ml_tpu.models.glm import (
+            GeneralizedLinearRegressionModel as LocalGLMModel,
+        )
+        from spark_rapids_ml_tpu.ops.glm_kernel import GlmStepOut
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            combine_logreg_stats,
+            logreg_stats_spark_ddl,
+            partition_glm_stats_arrow,
+        )
+
+        local_est = self._local
+        timer = PhaseTimer()
+        family, link, var_power, link_power = (
+            local_est._resolved_family_link()
+        )
+        fcol = local_est.getInputCol()
+        lcol = local_est.getLabelCol()
+        wcol = local_est.get_or_default("weightCol") or None
+        ocol = local_est.get_or_default("offsetCol") or None
+        cols = [fcol, lcol] + ([wcol] if wcol else []) \
+            + ([ocol] if ocol else [])
+        df = dataset.select(*cols).persist()
+        try:
+            first_row = df.first()
+            if first_row is None:
+                raise ValueError("empty dataset")
+            n = len(first_row[0])
+            w_sum_box = [0.0]
+
+            def step(coef, intercept, first=False):
+                def job(batches, _c=np.array(coef), _b=float(intercept),
+                        _first=bool(first)):
+                    yield from partition_glm_stats_arrow(
+                        batches, fcol, lcol, _c, _b,
+                        family=family, link=link, var_power=var_power,
+                        link_power=link_power, first=_first,
+                        weight_col=wcol, offset_col=ocol,
+                    )
+
+                rows = df.mapInArrow(job, logreg_stats_spark_ddl()) \
+                    .collect()
+                xtz, xtx, x_sum, z_sum, wsum, dev, cnt = (
+                    combine_logreg_stats(rows)
+                )
+                w_sum_box[0] = float(cnt)
+                return GlmStepOut(xtx=np.asarray(xtx), xtz=xtz,
+                                  x_sum=x_sum, z_sum=z_sum, w_sum=wsum,
+                                  deviance=dev)
+
+            # the ONE IRLS driver loop (solve, convergence rule, mustart
+            # first pass, for/else final deviance) lives in models/glm.py
+            coef, intercept, n_iter, dev = local_est._irls(step, n, timer)
+        finally:
+            df.unpersist()
+        local = LocalGLMModel(
+            coefficients=np.asarray(coef, dtype=np.float64),
+            intercept=float(intercept),
+        )
+        local.uid = local_est.uid
+        local.copy_values_from(local_est)
+        local.num_iterations_ = int(n_iter)
+        local.deviance_ = float(dev)
+        local.weight_sum_ = w_sum_box[0]
+        local.fit_timings_ = timer.as_dict()
+        return self._model_cls(local)
+
+
 class OneVsRest(_adapter.OneVsRest):
     """DataFrame OneVsRest whose K binary sub-fits run on the statistics
     planes: classes come from one label-discovery job, each class gets a

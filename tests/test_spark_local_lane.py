@@ -904,3 +904,110 @@ def test_imputer_robust_planes(spark, rng, monkeypatch):
     monkeypatch.undo()
     m_mode = Imputer(strategy="mode").fit(df)
     assert np.isfinite(m_mode._local.surrogates).all()
+
+
+def test_glm_plane_never_collects_rows(spark, rng, monkeypatch):
+    """GeneralizedLinearRegression fits on the per-iteration IRLS
+    statistics plane: no driver collect, coefficients matching the local
+    host fit exactly (both run the shared f64 irls_step_math)."""
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+    from spark_rapids_ml_tpu import (
+        GeneralizedLinearRegression as LocalGLM,
+    )
+    from spark_rapids_ml_tpu.spark import GeneralizedLinearRegression
+
+    def boom(self, dataset):
+        raise AssertionError("driver-collect fired on a plane family")
+
+    monkeypatch.setattr(
+        adapter_mod._AdapterEstimator, "_collect_frame", boom
+    )
+    x = rng.normal(size=(300, 5)) * 0.5
+    y = rng.poisson(np.exp(x @ (0.3 * np.ones(5)) + 0.2)).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+
+    plane = GeneralizedLinearRegression(family="poisson", tol=1e-12) \
+        .fit(df)
+    local = LocalGLM(family="poisson", tol=1e-12).setUseXlaDot(False) \
+        .fit(x, labels=y)
+    np.testing.assert_allclose(
+        plane._local.coefficients, local.coefficients, atol=1e-10
+    )
+    assert plane._local.intercept == pytest.approx(local.intercept,
+                                                   abs=1e-10)
+    assert plane._local.num_iterations_ == local.num_iterations_
+    assert plane._local.deviance_ == pytest.approx(local.deviance_,
+                                                   rel=1e-9)
+
+    out = plane.setLinkPredictionCol("lp").transform(df).collect()
+    mu = np.asarray([r["prediction"] for r in out])
+    eta = np.asarray([r["lp"] for r in out])
+    np.testing.assert_allclose(mu, np.exp(eta), rtol=1e-10)
+    np.testing.assert_allclose(
+        eta, x @ local.coefficients + local.intercept, atol=1e-8
+    )
+
+
+def test_glm_plane_weight_and_offset(spark, rng, monkeypatch):
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+    from spark_rapids_ml_tpu import (
+        GeneralizedLinearRegression as LocalGLM,
+    )
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+    from spark_rapids_ml_tpu.spark import GeneralizedLinearRegression
+
+    def boom(self, dataset):
+        raise AssertionError("driver-collect fired on a plane family")
+
+    monkeypatch.setattr(
+        adapter_mod._AdapterEstimator, "_collect_frame", boom
+    )
+    n = 240
+    x = rng.normal(size=(n, 4)) * 0.4
+    w = rng.uniform(0.5, 2.0, size=n)
+    off = np.log(rng.uniform(0.5, 3.0, size=n))
+    y = rng.poisson(np.exp(x @ (0.25 * np.ones(4)) + 0.1 + off)) \
+        .astype(float)
+    df = _vector_df(spark, x, extra_cols=[
+        ("label", y.tolist()), ("w", w.tolist()), ("off", off.tolist()),
+    ])
+    plane = GeneralizedLinearRegression(
+        family="poisson", weightCol="w", offsetCol="off", tol=1e-12
+    ).fit(df)
+    local = LocalGLM(family="poisson", weightCol="w", offsetCol="off",
+                     tol=1e-12).setUseXlaDot(False).fit(
+        VectorFrame({"features": list(x), "label": y, "w": w, "off": off})
+    )
+    np.testing.assert_allclose(
+        plane._local.coefficients, local.coefficients, atol=1e-10
+    )
+    # transform honors the offset column (documented deviation from
+    # Spark, which drops the training offset at scoring time)
+    out = plane.transform(df).collect()
+    mu = np.asarray([r["prediction"] for r in out])
+    eta = x @ local.coefficients + local.intercept + off
+    np.testing.assert_allclose(mu, np.exp(eta), rtol=1e-8)
+    # and raises when the offset column is absent at scoring time
+    df_no_off = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    with pytest.raises(ValueError, match="offsetCol"):
+        plane.transform(df_no_off)
+
+
+def test_glm_plane_persistence(spark, rng, tmp_path):
+    from spark_rapids_ml_tpu.spark import GeneralizedLinearRegression
+    from spark_rapids_ml_tpu.spark.adapter import (
+        GeneralizedLinearRegressionModel,
+    )
+
+    x = rng.normal(size=(150, 3)) * 0.5
+    y = np.exp(x @ np.ones(3) * 0.2 + 0.1) \
+        + 0.01 * rng.random(150)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    model = GeneralizedLinearRegression(family="gamma", link="log").fit(df)
+    path = str(tmp_path / "glm_plane")
+    model.save(path)
+    loaded = GeneralizedLinearRegressionModel.load(path)
+    np.testing.assert_allclose(
+        loaded._local.coefficients, model._local.coefficients
+    )
+    assert loaded._local.get_or_default("family") == "gamma"
