@@ -47,6 +47,10 @@ from spark_rapids_ml_tpu.models.glm import (  # noqa: F401
     GeneralizedLinearRegression,
     GeneralizedLinearRegressionModel,
 )
+from spark_rapids_ml_tpu.models.gaussian_mixture import (  # noqa: F401
+    GaussianMixture,
+    GaussianMixtureModel,
+)
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: F401
     NaiveBayes,
@@ -113,6 +117,8 @@ __all__ = [
     "LinearSVCModel",
     "GeneralizedLinearRegression",
     "GeneralizedLinearRegressionModel",
+    "GaussianMixture",
+    "GaussianMixtureModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

@@ -411,6 +411,65 @@ def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
     ])
 
 
+def save_gmm_model(model, path: str, overwrite: bool = False) -> None:
+    """GaussianMixtureModel layout: (weights vector, means matrix, covs
+    stacked as a (k*d, d) matrix) — the covariance stack reshapes to
+    (k, d, d) on load; Spark's writer stores gaussians row-per-component,
+    an equivalent representation."""
+    if model.weights is None:
+        raise ValueError("cannot save an unfitted GaussianMixtureModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    extras = {
+        "numIterations": int(model.num_iterations_),
+        "logLikelihood": float(model.log_likelihood_),
+    }
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata(),
+                    extra=extras)
+    k, d = model.means.shape
+    row = {
+        "weights": _dense_vector_struct(model.weights),
+        "means": _dense_matrix_struct(model.means),
+        "covs": _dense_matrix_struct(model.covs.reshape(k * d, d)),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema(
+            [
+                ("weights", _vector_arrow_type()),
+                ("means", _matrix_arrow_type()),
+                ("covs", _matrix_arrow_type()),
+            ]
+        )
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("weights", "vector"), ("means", "matrix"), ("covs", "matrix"),
+    ])
+
+
+def load_gmm_model(path: str):
+    from spark_rapids_ml_tpu.models.gaussian_mixture import (
+        GaussianMixtureModel,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    means = _dense_matrix_from_struct(row["means"])
+    k, d = means.shape
+    model = GaussianMixtureModel(
+        weights=_dense_vector_from_struct(row["weights"]),
+        means=means,
+        covs=_dense_matrix_from_struct(row["covs"]).reshape(k, d, d),
+        uid=meta["uid"],
+    )
+    extras = meta.get("extra", {})
+    model.num_iterations_ = int(extras.get("numIterations", 0))
+    model.log_likelihood_ = float(extras.get("logLikelihood", float("nan")))
+    return _restore_params(model, meta)
+
+
 def load_kmeans_model(path: str):
     from spark_rapids_ml_tpu.models.kmeans import KMeansModel
 

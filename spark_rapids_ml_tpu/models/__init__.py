@@ -1,5 +1,9 @@
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
 from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel
+from spark_rapids_ml_tpu.models.gaussian_mixture import (
+    GaussianMixture,
+    GaussianMixtureModel,
+)
 from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegression,
     LinearRegressionModel,
@@ -65,6 +69,8 @@ __all__ = [
     "PCAModel",
     "KMeans",
     "KMeansModel",
+    "GaussianMixture",
+    "GaussianMixtureModel",
     "LinearRegression",
     "LinearRegressionModel",
     "LogisticRegression",

@@ -9,8 +9,9 @@ classes require it (or the in-repo local engine).
 Fit-strategy routing (resolved lazily below): bespoke statistics planes
 (``estimator.py``) for PCA/LinReg/LogReg/KMeans/NaiveBayes; per-level
 tree planes (``forest_estimator.py``) for RandomForest/GBT; moments/Gram/
-Newton planes (``moments_estimator.py``) for the scalers, TruncatedSVD,
-Imputer, RobustScaler, LinearSVC, and OneVsRest; the envelope-guarded
+Newton/EM planes (``moments_estimator.py``) for the scalers,
+TruncatedSVD, Imputer, RobustScaler, LinearSVC, OneVsRest,
+GeneralizedLinearRegression, and GaussianMixture; the envelope-guarded
 driver-collect adapter (``adapter.py``) only for the non-decomposable
 fits (UMAP spectral init, KNN item capture) and every Model transform.
 """
@@ -57,6 +58,7 @@ _MOMENTS_PLANE_CLASSES = (
     "RobustScaler",
     "Imputer",
     "GeneralizedLinearRegression",
+    "GaussianMixture",
 )
 
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
@@ -69,6 +71,7 @@ _ADAPTER_CLASSES = (
     "NaiveBayesModel",
     "LinearSVCModel",
     "GeneralizedLinearRegressionModel",
+    "GaussianMixtureModel",
     "StandardScalerModel",
     "MinMaxScalerModel",
     "MaxAbsScalerModel",

@@ -517,6 +517,10 @@ from spark_rapids_ml_tpu.models.glm import (  # noqa: E402
     GeneralizedLinearRegression as _LGLM,
     GeneralizedLinearRegressionModel as _LGLM_M,
 )
+from spark_rapids_ml_tpu.models.gaussian_mixture import (  # noqa: E402
+    GaussianMixture as _LGMM,
+    GaussianMixtureModel as _LGMM_M,
+)
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: E402
     NaiveBayesModel as _LNB_M,
 )
@@ -583,6 +587,13 @@ GeneralizedLinearRegression, GeneralizedLinearRegressionModel = _make_pair(
     doc="IRLS fit runs on the executor statistics plane "
         "(spark/moments_estimator.py); transform emits mu and optional "
         "linkPrediction eta.",
+)
+GaussianMixture, GaussianMixtureModel = _make_pair(
+    "GaussianMixture", _LGMM, _LGMM_M, needs_label=False,
+    classifier=True,
+    doc="EM fit runs on the executor statistics plane "
+        "(spark/moments_estimator.py); probability holds the "
+        "responsibility vector, prediction its argmax.",
 )
 StandardScaler, StandardScalerModel = _make_pair(
     "StandardScaler", _LSS, _LSS_M, needs_label=False,

@@ -1112,6 +1112,105 @@ def partition_glm_stats_arrow(batches, features_col: str, label_col: str,
         )
 
 
+def gmm_stats_spark_ddl() -> str:
+    return ("nk array<double>, mk array<double>, sk array<double>, "
+            "loglik double, wsum double")
+
+
+def gmm_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema(
+        [
+            ("nk", pa.list_(pa.float64())),
+            ("mk", pa.list_(pa.float64())),
+            ("sk", pa.list_(pa.float64())),
+            ("loglik", pa.float64()),
+            ("wsum", pa.float64()),
+        ]
+    )
+
+
+def partition_gmm_stats(
+    batches: Iterable,
+    features_col: str,
+    means: np.ndarray,
+    prec_chol: np.ndarray,
+    log_det: np.ndarray,
+    log_weights: np.ndarray,
+    weight_col: Optional[str] = None,
+) -> Iterator[Dict[str, object]]:
+    """One partition's GaussianMixture EM partials under the broadcast
+    mixture state: (sum r, sum r x, sum r x x^T, loglik, sum w) — the
+    per-iteration statistics-plane shape of ``ops.gmm_kernel``
+    (``estep_stats_math`` is the shared math)."""
+    from spark_rapids_ml_tpu.ops.gmm_kernel import (
+        GmmStats,
+        estep_stats_math,
+    )
+
+    means = np.asarray(means, dtype=np.float64)
+    totals = None
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+        else:
+            x = np.asarray(batch, dtype=np.float64)
+        if x.shape[0] == 0:
+            continue
+        wt = _batch_weights_agg(batch, weight_col)
+        if wt is None:
+            wt = np.ones(x.shape[0])
+        out = estep_stats_math(
+            np, x, wt, means, np.asarray(prec_chol), np.asarray(log_det),
+            np.asarray(log_weights))
+        totals = out if totals is None else GmmStats(
+            *(a + b for a, b in zip(totals, out)))
+    if totals is None:
+        return
+    yield {
+        "nk": [float(v) for v in np.asarray(totals.resp_sum)],
+        "mk": [float(v) for v in np.asarray(totals.mean_sum).reshape(-1)],
+        "sk": [float(v) for v in np.asarray(totals.sq_sum).reshape(-1)],
+        "loglik": float(totals.loglik),
+        "wsum": float(totals.w_sum),
+    }
+
+
+def partition_gmm_stats_arrow(batches, features_col: str, means, prec_chol,
+                              log_det, log_weights, **kw):
+    import pyarrow as pa
+
+    for row in partition_gmm_stats(batches, features_col, means, prec_chol,
+                                   log_det, log_weights, **kw):
+        yield pa.RecordBatch.from_pylist(
+            [row], schema=gmm_stats_arrow_schema()
+        )
+
+
+def combine_gmm_stats(rows: Iterable, k: int, d: int):
+    """Driver-side reduce of per-partition GMM partials → GmmStats."""
+    from spark_rapids_ml_tpu.ops.gmm_kernel import GmmStats
+
+    nk = np.zeros(k)
+    mk = np.zeros((k, d))
+    sk = np.zeros((k, d, d))
+    loglik = wsum = 0.0
+    seen = False
+    for row in rows:
+        get = row.get if isinstance(row, dict) else row.__getitem__
+        nk += np.asarray(get("nk"), dtype=np.float64)
+        mk += np.asarray(get("mk"), dtype=np.float64).reshape(k, d)
+        sk += np.asarray(get("sk"), dtype=np.float64).reshape(k, d, d)
+        loglik += float(get("loglik"))
+        wsum += float(get("wsum"))
+        seen = True
+    if not seen:
+        raise ValueError("no partition statistics to combine (empty dataset)")
+    return GmmStats(resp_sum=nk, mean_sum=mk, sq_sum=sk, loglik=loglik,
+                    w_sum=wsum)
+
+
 def discover_label_values(dataset, label_col: str) -> np.ndarray:
     """One label-only discovery job → sorted distinct label values — the
     family='auto' pre-pass shared by LogisticRegression and OneVsRest

@@ -333,6 +333,67 @@ class GeneralizedLinearRegression(_adapter.GeneralizedLinearRegression):
         return self._model_cls(local)
 
 
+class GaussianMixture(_adapter.GaussianMixture):
+    """DataFrame GaussianMixture on the executor statistics plane: init
+    is one moments pass + one capped feature-sample pass (seeded means);
+    each EM iteration is one mapInArrow job emitting per-partition
+    responsibility-weighted statistics (sum r, sum r x, sum r x x^T,
+    loglik) under the broadcast mixture state
+    (``aggregate.partition_gmm_stats``); the k x d x d M-step and the
+    mean-loglik convergence rule reuse the ONE EM driver loop in
+    ``models/gaussian_mixture.py::_fit_from_stepper``. Rows never reach
+    the driver."""
+
+    def _fit(self, dataset):
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            combine_gmm_stats,
+            gmm_stats_spark_ddl,
+            partition_gmm_stats_arrow,
+        )
+
+        local_est = self._local
+        timer = PhaseTimer()
+        k = int(local_est.getK())
+        fcol = local_est.getInputCol()
+        wcol = local_est.get_or_default("weightCol") or None
+        cols = [fcol] + ([wcol] if wcol else [])
+        df = dataset.select(*cols).persist()
+        try:
+            with timer.phase("init"):
+                from spark_rapids_ml_tpu.ops.gmm_kernel import (
+                    init_from_moments,
+                )
+
+                count, s1, s2, _lo, _hi = _collect_moments(df, fcol,
+                                                           wcol=wcol)
+                d = s1.shape[0]
+                sample, n_rows = _collect_feature_sample(
+                    df, fcol, seed=int(local_est.getSeed()))
+                # guard on the ROW count (n_rows), not the weighted mass
+                # `count` — tiny weights must not mask usable rows
+                if n_rows < k:
+                    raise ValueError(
+                        f"k={k} components need at least k rows")
+                rng = np.random.default_rng(int(local_est.getSeed()))
+                init = init_from_moments(count, s1, s2, sample, k, rng)
+
+            def stepper(means, prec, log_det, log_w):
+                def job(batches, _m=np.array(means), _p=np.array(prec),
+                        _ld=np.array(log_det), _lw=np.array(log_w)):
+                    yield from partition_gmm_stats_arrow(
+                        batches, fcol, _m, _p, _ld, _lw, weight_col=wcol)
+
+                rows = df.mapInArrow(job, gmm_stats_spark_ddl()).collect()
+                return combine_gmm_stats(rows, k, d)
+
+            # the ONE EM driver loop (M-step, mean-loglik tol) lives in
+            # models/gaussian_mixture.py
+            local = local_est._fit_from_stepper(stepper, init, timer)
+        finally:
+            df.unpersist()
+        return self._model_cls(local)
+
+
 class OneVsRest(_adapter.OneVsRest):
     """DataFrame OneVsRest whose K binary sub-fits run on the statistics
     planes: classes come from one label-discovery job, each class gets a

@@ -1011,3 +1011,87 @@ def test_glm_plane_persistence(spark, rng, tmp_path):
         loaded._local.coefficients, model._local.coefficients
     )
     assert loaded._local.get_or_default("family") == "gamma"
+
+
+def test_gmm_plane_never_collects_rows(spark, rng, monkeypatch):
+    """GaussianMixture fits on the per-iteration EM statistics plane:
+    init via moments + capped sample passes, then one stats job per EM
+    step; no driver collect; result is a valid converged mixture."""
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+    from spark_rapids_ml_tpu.spark import GaussianMixture
+
+    def boom(self, dataset):
+        raise AssertionError("driver-collect fired on a plane family")
+
+    monkeypatch.setattr(
+        adapter_mod._AdapterEstimator, "_collect_frame", boom
+    )
+    centers = np.array([[8.0, 0.0, 0.0], [0.0, 8.0, 0.0]])
+    labels = rng.integers(0, 2, size=300)
+    x = centers[labels] + rng.normal(size=(300, 3))
+    df = _vector_df(spark, x)
+
+    model = GaussianMixture(k=2, seed=1, maxIter=100, tol=1e-6).fit(df)
+    local = model._local
+    assert np.isfinite(local.log_likelihood_)
+    np.testing.assert_allclose(local.weights.sum(), 1.0, atol=1e-9)
+    # means recover the generating centers (order-free)
+    found = np.array(local.means)
+    for c in centers:
+        assert np.min(np.linalg.norm(found - c, axis=1)) < 0.5
+
+    out = model.transform(df).collect()
+    resp = np.stack([r["probability"].toArray() for r in out])
+    pred = np.asarray([r["prediction"] for r in out])
+    np.testing.assert_allclose(resp.sum(axis=1), 1.0, atol=1e-6)
+    np.testing.assert_array_equal(pred, np.argmax(resp, axis=1))
+    # soft assignment matches the generating labels up to relabel
+    acc = max(np.mean(pred == labels), np.mean(pred == 1 - labels))
+    assert acc > 0.98
+
+
+def test_gmm_plane_matches_local_em_fixed_point(spark, rng, monkeypatch):
+    """One plane EM step from a frozen state must equal the local
+    estep/mstep exactly (shared estep_stats_math f64)."""
+    from spark_rapids_ml_tpu.ops.gmm_kernel import (
+        estep_stats_math,
+        precision_cholesky,
+    )
+    from spark_rapids_ml_tpu.spark.aggregate import (
+        combine_gmm_stats,
+        gmm_stats_spark_ddl,
+        partition_gmm_stats_arrow,
+    )
+
+    x = rng.normal(size=(120, 3)) + np.array([2.0, 0.0, -1.0])
+    df = _vector_df(spark, x)
+    means = np.array([[1.0, 0.0, 0.0], [3.0, 0.0, -2.0]])
+    covs = np.tile(np.eye(3), (2, 1, 1))
+    weights = np.array([0.4, 0.6])
+    prec, log_det = precision_cholesky(covs)
+
+    def job(batches):
+        yield from partition_gmm_stats_arrow(
+            batches, "features", means, prec, log_det, np.log(weights))
+
+    rows = df.select("features").mapInArrow(
+        job, gmm_stats_spark_ddl()).collect()
+    plane = combine_gmm_stats(rows, 2, 3)
+    local = estep_stats_math(np, x, np.ones(120), means, prec, log_det,
+                             np.log(weights))
+    for a, b in zip(plane, local):
+        np.testing.assert_allclose(a, b, atol=1e-10)
+
+
+def test_gmm_plane_persistence(spark, rng, tmp_path):
+    from spark_rapids_ml_tpu.spark import GaussianMixture
+    from spark_rapids_ml_tpu.spark.adapter import GaussianMixtureModel
+
+    x = rng.normal(size=(150, 3))
+    df = _vector_df(spark, x)
+    model = GaussianMixture(k=2, seed=3, maxIter=10).fit(df)
+    path = str(tmp_path / "gmm_plane")
+    model.save(path)
+    loaded = GaussianMixtureModel.load(path)
+    np.testing.assert_allclose(loaded._local.means, model._local.means)
+    np.testing.assert_allclose(loaded._local.covs, model._local.covs)
