@@ -51,6 +51,11 @@ from spark_rapids_ml_tpu.models.gaussian_mixture import (  # noqa: F401
     GaussianMixture,
     GaussianMixtureModel,
 )
+from spark_rapids_ml_tpu.stat import (  # noqa: F401
+    ChiSquareTest,
+    Correlation,
+    Summarizer,
+)
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: F401
     NaiveBayes,
@@ -119,6 +124,9 @@ __all__ = [
     "GeneralizedLinearRegressionModel",
     "GaussianMixture",
     "GaussianMixtureModel",
+    "Correlation",
+    "ChiSquareTest",
+    "Summarizer",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

@@ -917,6 +917,107 @@ def moment_stats_spark_ddl() -> str:
             "lo array<double>, hi array<double>")
 
 
+def summary_accumulate(x: np.ndarray, wt: Optional[np.ndarray],
+                       acc: Optional[Dict[str, object]]) -> Dict[str, object]:
+    """The ONE Summarizer accumulation step (Spark
+    MultivariateOnlineSummarizer semantics): zero-weight rows are
+    skipped entirely; count/nnz are UNWEIGHTED row/entry counts;
+    s1/s2/l1 are weighted; wsq carries sum(w^2) for the
+    reliability-weighted variance denominator. Shared by the executor
+    partial and stat.Summarizer's in-memory path."""
+    if wt is not None:
+        keep = wt > 0
+        x, wt = x[keep], wt[keep]
+    if x.shape[0] == 0:
+        return acc
+    if acc is None:
+        d = x.shape[1]
+        acc = {
+            "count": 0.0, "wsum": 0.0, "wsq": 0.0,
+            "s1": np.zeros(d), "s2": np.zeros(d),
+            "lo": np.full(d, np.inf), "hi": np.full(d, -np.inf),
+            "nnz": np.zeros(d), "l1": np.zeros(d),
+        }
+    w = np.ones(x.shape[0]) if wt is None else wt
+    xw = x * w[:, None]
+    acc["count"] += float(x.shape[0])
+    acc["wsum"] += float(w.sum())
+    acc["wsq"] += float((w * w).sum())
+    acc["s1"] += xw.sum(axis=0)
+    acc["s2"] += (xw * x).sum(axis=0)
+    acc["nnz"] += (x != 0).sum(axis=0)
+    acc["l1"] += np.abs(xw).sum(axis=0)
+    acc["lo"] = np.minimum(acc["lo"], x.min(axis=0))
+    acc["hi"] = np.maximum(acc["hi"], x.max(axis=0))
+    return acc
+
+
+def partition_summary_stats(
+    batches: Iterable, input_col: str,
+    weight_col: Optional[str] = None,
+) -> Iterator[Dict[str, object]]:
+    """The moments partial extended with Summarizer's extra metrics —
+    one executor pass serves ``stat.Summarizer`` on DataFrames."""
+    acc = None
+    for batch in batches:
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(input_col))
+        else:
+            x = np.asarray(batch, dtype=np.float64)
+        if x.shape[0] == 0:
+            continue
+        acc = summary_accumulate(x, _batch_weights_agg(batch, weight_col),
+                                 acc)
+    if acc is None:
+        return
+    yield {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+           for k, v in acc.items()}
+
+
+_SUMMARY_FIELDS = ("count", "wsum", "wsq", "s1", "s2", "lo", "hi", "nnz",
+                   "l1")
+
+
+def summary_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema(
+        [(f, pa.float64()) for f in ("count", "wsum", "wsq")]
+        + [(f, pa.list_(pa.float64()))
+           for f in ("s1", "s2", "lo", "hi", "nnz", "l1")]
+    )
+
+
+def summary_stats_spark_ddl() -> str:
+    return ("count double, wsum double, wsq double, s1 array<double>, "
+            "s2 array<double>, lo array<double>, hi array<double>, "
+            "nnz array<double>, l1 array<double>")
+
+
+def combine_summary_stats(rows: Iterable) -> Dict[str, object]:
+    """Sum/min/max-merge of summary_accumulate partials."""
+    acc = None
+    for row in rows:
+        get = row.get if isinstance(row, dict) else row.__getitem__
+        if acc is None:
+            acc = {f: (np.asarray(get(f), dtype=np.float64).copy()
+                       if f not in ("count", "wsum", "wsq")
+                       else float(get(f)))
+                   for f in _SUMMARY_FIELDS}
+        else:
+            for f in ("count", "wsum", "wsq"):
+                acc[f] += float(get(f))
+            for f in ("s1", "s2", "nnz", "l1"):
+                acc[f] += np.asarray(get(f), dtype=np.float64)
+            acc["lo"] = np.minimum(
+                acc["lo"], np.asarray(get("lo"), dtype=np.float64))
+            acc["hi"] = np.maximum(
+                acc["hi"], np.asarray(get("hi"), dtype=np.float64))
+    if acc is None:
+        raise ValueError("no partition statistics to combine (empty dataset)")
+    return acc
+
+
 def combine_moment_stats(rows: Iterable):
     """(n, Σx, Σx², min, max) over all partitions."""
     s1 = s2 = lo = hi = None

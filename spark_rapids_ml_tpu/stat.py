@@ -1,0 +1,260 @@
+"""pyspark.ml.stat parity: Correlation, ChiSquareTest, Summarizer.
+
+The reference repo (spark-rapids-ml 21.12, PCA-only) ships none of these;
+they are beyond-parity surface following upstream
+``pyspark.ml.stat`` semantics. All three accept a feature matrix, a
+``VectorFrame``, or a DataFrame (pyspark / local engine): DataFrame
+inputs ride the executor statistics planes where the statistic
+decomposes (Pearson correlation = the PCA plane's Gram partial,
+``spark/aggregate.py::partition_gram_stats``; Summarizer = an extended
+moments partial), and fall back to an envelope-guarded collect only for
+the rank/contingency statistics that need global state (Spearman,
+chi-square).
+
+TPU mapping: Pearson's sufficient statistics (X'X, sum x, n) are the
+same MXU Gram pass PCA streams (``ops/streaming.py``); everything after
+is tiny host float64.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Correlation", "ChiSquareTest", "Summarizer"]
+
+
+def _is_dataframe(dataset) -> bool:
+    return hasattr(dataset, "mapInArrow") and hasattr(dataset, "select")
+
+
+def _collect_matrix(dataset, column: str) -> np.ndarray:
+    """Envelope-guarded DataFrame feature collect (the adapter
+    convention, ``spark/adapter.py::_check_collect_envelope``)."""
+    from spark_rapids_ml_tpu.spark.adapter import _check_collect_envelope
+    from spark_rapids_ml_tpu.spark.aggregate import vector_column_to_matrix
+
+    _check_collect_envelope(dataset, "ml.stat")
+    rows = dataset.select(column).collect()
+    return np.asarray(
+        [np.asarray(r[0], dtype=np.float64)
+         if not hasattr(r[0], "toArray") else r[0].toArray()
+         for r in rows],
+        dtype=np.float64,
+    )
+
+
+def _gram_stats(dataset, column: str, use_device: bool):
+    """(G = X'X, sum x, n) from any input shape."""
+    if _is_dataframe(dataset):
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            combine_stats,
+            partition_gram_stats,
+            stats_arrow_schema,
+            stats_spark_ddl,
+        )
+
+        def job(batches):
+            for row in partition_gram_stats(batches, column):
+                yield pa.RecordBatch.from_pylist(
+                    [row], schema=stats_arrow_schema())
+
+        rows = dataset.select(column).mapInArrow(
+            job, stats_spark_ddl()).collect()
+        return combine_stats(rows)
+    from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+    frame = as_vector_frame(dataset, column)
+    x = frame.vectors_as_matrix(column)
+    if use_device:
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.streaming import (
+            init_stats,
+            update_stats,
+        )
+
+        # f64 when the runtime allows it (CPU/x64 test posture); f32 on
+        # a default TPU runtime — the Gram still runs on the MXU, and
+        # ~1e-6 correlation error is within scoring use
+        dtype = (jnp.float64 if jax.config.jax_enable_x64
+                 else jnp.float32)
+        stats = init_stats(x.shape[1], dtype=dtype)
+        stats = update_stats(stats, jnp.asarray(x, dtype=dtype))
+        return (np.asarray(stats.gram, dtype=np.float64),
+                np.asarray(stats.col_sum, dtype=np.float64),
+                float(stats.count))
+    x = np.asarray(x, dtype=np.float64)
+    return x.T @ x, x.sum(axis=0), float(x.shape[0])
+
+
+def _corr_from_gram(gram: np.ndarray, col_sum: np.ndarray, n: float):
+    mu = col_sum / n
+    cov = gram / n - np.outer(mu, mu)
+    sd = np.sqrt(np.maximum(np.diag(cov), 0.0))
+    denom = np.outer(sd, sd)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        corr = cov / denom
+    corr[denom == 0] = np.nan   # constant columns: Spark emits NaN
+    np.fill_diagonal(corr, 1.0)
+    return corr
+
+
+class Correlation:
+    """``Correlation.corr(df, "features")`` -> (d, d) matrix.
+
+    ``pyspark.ml.stat.Correlation`` semantics: method 'pearson'
+    (default) or 'spearman'; constant columns correlate as NaN.
+    Pearson decomposes onto the executor Gram plane; Spearman needs
+    global ranks, so DataFrame inputs collect under the adapter
+    envelope.
+    """
+
+    @staticmethod
+    def corr(dataset, column: str = "features",
+             method: str = "pearson") -> np.ndarray:
+        if method not in ("pearson", "spearman"):
+            raise ValueError(f"unknown correlation method {method!r}")
+        if method == "spearman":
+            if _is_dataframe(dataset):
+                x = _collect_matrix(dataset, column)
+            else:
+                from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+                x = as_vector_frame(dataset, column) \
+                    .vectors_as_matrix(column).astype(np.float64)
+            from scipy.stats import rankdata
+
+            ranks = np.apply_along_axis(rankdata, 0, x)
+            g, s, n = ranks.T @ ranks, ranks.sum(axis=0), float(
+                ranks.shape[0])
+            return _corr_from_gram(g, s, n)
+        g, s, n = _gram_stats(dataset, column, use_device=True)
+        return _corr_from_gram(g, s, n)
+
+
+class ChiSquareTest:
+    """``ChiSquareTest.test(df, "features", "label")`` ->
+    {pValues, degreesOfFreedom, statistics} (one entry per feature).
+
+    ``pyspark.ml.stat.ChiSquareTest`` semantics: Pearson's independence
+    test on the (feature value x label value) contingency table of each
+    categorical feature.
+    """
+
+    @staticmethod
+    def test(dataset, featuresCol: str = "features",
+             labelCol: str = "label") -> dict:
+        from scipy.stats import chi2 as chi2_dist
+
+        if _is_dataframe(dataset):
+            from spark_rapids_ml_tpu.spark.adapter import (
+                _check_collect_envelope,
+            )
+
+            _check_collect_envelope(dataset, "ChiSquareTest")
+            rows = dataset.select(featuresCol, labelCol).collect()
+            x = np.asarray(
+                [r[0].toArray() if hasattr(r[0], "toArray")
+                 else np.asarray(r[0], dtype=np.float64) for r in rows])
+            y = np.asarray([float(r[1]) for r in rows])
+        else:
+            from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+            frame = as_vector_frame(dataset, featuresCol)
+            x = frame.vectors_as_matrix(featuresCol).astype(np.float64)
+            y = np.asarray(frame.column(labelCol), dtype=np.float64)
+        labels, y_idx = np.unique(y, return_inverse=True)
+        n = x.shape[0]
+        stats, dofs, pvals = [], [], []
+        for j in range(x.shape[1]):
+            values, v_idx = np.unique(x[:, j], return_inverse=True)
+            table = np.zeros((values.size, labels.size))
+            np.add.at(table, (v_idx, y_idx), 1.0)
+            row_tot = table.sum(axis=1, keepdims=True)
+            col_tot = table.sum(axis=0, keepdims=True)
+            expected = row_tot @ col_tot / n
+            with np.errstate(invalid="ignore", divide="ignore"):
+                contrib = (table - expected) ** 2 / expected
+            stat = float(np.nansum(contrib))
+            dof = int((values.size - 1) * (labels.size - 1))
+            stats.append(stat)
+            dofs.append(dof)
+            pvals.append(
+                float(chi2_dist.sf(stat, dof)) if dof > 0 else 1.0)
+        return {
+            "statistics": np.asarray(stats),
+            "degreesOfFreedom": np.asarray(dofs, dtype=np.int64),
+            "pValues": np.asarray(pvals),
+        }
+
+
+class Summarizer:
+    """``Summarizer.summarize(df, "features")`` -> dict of per-feature
+    vectors: mean, variance, std, count, numNonZeros, max, min, normL1,
+    normL2 (``pyspark.ml.stat.Summarizer``'s metric set, sample
+    variance like Spark). DataFrame inputs reduce one extended moments
+    partial on the executor plane."""
+
+    METRICS = ("mean", "variance", "std", "count", "numNonZeros",
+               "max", "min", "normL1", "normL2")
+
+    @staticmethod
+    def summarize(dataset, column: str = "features",
+                  weightCol: Optional[str] = None) -> dict:
+        from spark_rapids_ml_tpu.spark.aggregate import summary_accumulate
+
+        if _is_dataframe(dataset):
+            import pyarrow as pa
+
+            from spark_rapids_ml_tpu.spark.aggregate import (
+                combine_summary_stats,
+                partition_summary_stats,
+                summary_stats_arrow_schema,
+                summary_stats_spark_ddl,
+            )
+
+            cols = [column] + ([weightCol] if weightCol else [])
+
+            def job(batches):
+                for row in partition_summary_stats(
+                        batches, column, weight_col=weightCol):
+                    yield pa.RecordBatch.from_pylist(
+                        [row], schema=summary_stats_arrow_schema())
+
+            rows = dataset.select(*cols).mapInArrow(
+                job, summary_stats_spark_ddl()).collect()
+            acc = combine_summary_stats(rows)
+        else:
+            from spark_rapids_ml_tpu.data.frame import as_vector_frame
+
+            frame = as_vector_frame(dataset, column)
+            x = frame.vectors_as_matrix(column).astype(np.float64)
+            w = (np.asarray(frame.column(weightCol), dtype=np.float64)
+                 if weightCol else None)
+            acc = summary_accumulate(x, w, None)
+            if acc is None:
+                raise ValueError("empty dataset")
+        wsum = acc["wsum"]
+        mean = acc["s1"] / wsum
+        # Spark's reliability-weighted sample variance:
+        # M2n / (sum(w) - sum(w^2)/sum(w)); unweighted this is the usual
+        # (n-1) denominator
+        m2n = np.maximum(acc["s2"] - acc["s1"] ** 2 / wsum, 0.0)
+        denom = wsum - acc["wsq"] / wsum
+        var = m2n / denom if denom > 0 else np.zeros_like(m2n)
+        return {
+            "mean": mean,
+            "variance": var,
+            "std": np.sqrt(var),
+            "count": acc["count"],          # unweighted, Spark semantics
+            "numNonZeros": acc["nnz"],      # unweighted, Spark semantics
+            "max": acc["hi"],
+            "min": acc["lo"],
+            "normL1": acc["l1"],
+            "normL2": np.sqrt(acc["s2"]),
+        }

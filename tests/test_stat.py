@@ -1,0 +1,133 @@
+"""ml.stat parity: Correlation (pearson/spearman), ChiSquareTest,
+Summarizer — scipy/numpy oracles, matrix + DataFrame inputs."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import ChiSquareTest, Correlation, Summarizer
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def test_pearson_matches_numpy(rng):
+    x = rng.normal(size=(300, 5)) @ rng.normal(size=(5, 5))
+    ours = Correlation.corr(x, "features", "pearson")
+    np.testing.assert_allclose(ours, np.corrcoef(x, rowvar=False),
+                               atol=1e-10)
+
+
+def test_pearson_constant_column_nan(rng):
+    x = rng.normal(size=(100, 3))
+    x[:, 1] = 7.0
+    c = Correlation.corr(x)
+    assert np.isnan(c[0, 1]) and np.isnan(c[1, 2])
+    assert c[1, 1] == 1.0   # Spark keeps the diagonal at 1
+
+
+def test_spearman_matches_scipy(rng):
+    scipy_stats = pytest.importorskip("scipy.stats")
+    x = rng.normal(size=(200, 4)) ** 3
+    ours = Correlation.corr(x, method="spearman")
+    ref, _ = scipy_stats.spearmanr(x)
+    np.testing.assert_allclose(ours, ref, atol=1e-10)
+
+
+def test_unknown_method_raises(rng):
+    with pytest.raises(ValueError, match="unknown correlation"):
+        Correlation.corr(rng.normal(size=(10, 2)), method="kendall")
+
+
+def test_chisquare_matches_scipy(rng):
+    scipy_stats = pytest.importorskip("scipy.stats")
+    n = 400
+    x = np.column_stack([
+        rng.integers(0, 3, size=n),          # dependent-ish
+        rng.integers(0, 4, size=n),          # independent
+    ]).astype(float)
+    y = (x[:, 0] + rng.integers(0, 2, size=n)) % 3
+    frame = VectorFrame({"features": list(x), "label": y.astype(float)})
+    res = ChiSquareTest.test(frame, "features", "label")
+    for j in range(2):
+        table = np.zeros((len(np.unique(x[:, j])), len(np.unique(y))))
+        vi = {v: i for i, v in enumerate(np.unique(x[:, j]))}
+        yi = {v: i for i, v in enumerate(np.unique(y))}
+        for a, b in zip(x[:, j], y):
+            table[vi[a], yi[b]] += 1
+        stat, p, dof, _ = scipy_stats.chi2_contingency(table,
+                                                       correction=False)
+        assert res["statistics"][j] == pytest.approx(stat, rel=1e-10)
+        assert res["pValues"][j] == pytest.approx(p, abs=1e-12)
+        assert res["degreesOfFreedom"][j] == dof
+    # the dependent feature should reject independence, roughly
+    assert res["pValues"][0] < 0.01
+
+
+def test_summarizer_metrics(rng):
+    x = rng.normal(size=(150, 4))
+    x[x < -1.5] = 0.0
+    s = Summarizer.summarize(x, "features")
+    np.testing.assert_allclose(s["mean"], x.mean(axis=0), atol=1e-12)
+    np.testing.assert_allclose(s["variance"], x.var(axis=0, ddof=1),
+                               atol=1e-12)
+    np.testing.assert_allclose(s["std"], x.std(axis=0, ddof=1),
+                               atol=1e-12)
+    assert s["count"] == 150.0
+    np.testing.assert_allclose(s["numNonZeros"], (x != 0).sum(axis=0))
+    np.testing.assert_allclose(s["max"], x.max(axis=0))
+    np.testing.assert_allclose(s["min"], x.min(axis=0))
+    np.testing.assert_allclose(s["normL1"], np.abs(x).sum(axis=0),
+                               atol=1e-12)
+    np.testing.assert_allclose(s["normL2"],
+                               np.sqrt((x * x).sum(axis=0)), atol=1e-12)
+
+
+def test_summarizer_weighted_spark_semantics(rng):
+    """Spark MultivariateOnlineSummarizer: count/numNonZeros are
+    UNWEIGHTED; variance uses the reliability-weighted denominator
+    sum(w) - sum(w^2)/sum(w); zero-weight rows are skipped entirely."""
+    x = rng.normal(size=(80, 3))
+    w = rng.uniform(0.2, 2.0, size=80)
+    w[:5] = 0.0   # skipped rows
+    s = Summarizer.summarize(
+        VectorFrame({"features": list(x), "w": w}), "features",
+        weightCol="w")
+    keep = w > 0
+    xk, wk = x[keep], w[keep]
+    assert s["count"] == float(keep.sum())
+    np.testing.assert_allclose(s["numNonZeros"], (xk != 0).sum(axis=0))
+    mean = (wk[:, None] * xk).sum(axis=0) / wk.sum()
+    np.testing.assert_allclose(s["mean"], mean, atol=1e-12)
+    m2n = (wk[:, None] * (xk - mean) ** 2).sum(axis=0)
+    denom = wk.sum() - (wk ** 2).sum() / wk.sum()
+    np.testing.assert_allclose(s["variance"], m2n / denom, atol=1e-10)
+    np.testing.assert_allclose(s["min"], xk.min(axis=0))
+    np.testing.assert_allclose(
+        s["normL1"], (wk[:, None] * np.abs(xk)).sum(axis=0), atol=1e-12)
+
+
+def test_stat_on_local_engine_dataframe(rng):
+    """DataFrame inputs: Pearson rides the Gram plane partial,
+    Summarizer the extended moments partial, ChiSquareTest the guarded
+    collect — all through the local multiprocess engine front door."""
+    from spark_rapids_ml_tpu.spark.local_engine import (
+        DenseVector,
+        LocalSparkSession,
+    )
+
+    spark = LocalSparkSession(n_partitions=3)
+    x = rng.normal(size=(200, 4))
+    y = rng.integers(0, 2, size=200).astype(float)
+    df = spark.createDataFrame([
+        {"features": DenseVector(r), "label": lab}
+        for r, lab in zip(x, y)
+    ])
+    np.testing.assert_allclose(
+        Correlation.corr(df, "features"),
+        np.corrcoef(x, rowvar=False), atol=1e-10)
+    s = Summarizer.summarize(df, "features")
+    np.testing.assert_allclose(s["mean"], x.mean(axis=0), atol=1e-12)
+    np.testing.assert_allclose(s["normL1"], np.abs(x).sum(axis=0),
+                               atol=1e-12)
+    res = ChiSquareTest.test(df, "features", "label")
+    assert res["pValues"].shape == (4,)
+    sp = Correlation.corr(df, "features", "spearman")
+    assert sp.shape == (4, 4)
