@@ -51,6 +51,10 @@ from spark_rapids_ml_tpu.models.gaussian_mixture import (  # noqa: F401
     GaussianMixture,
     GaussianMixtureModel,
 )
+from spark_rapids_ml_tpu.models.mlp import (  # noqa: F401
+    MultilayerPerceptronClassifier,
+    MultilayerPerceptronModel,
+)
 from spark_rapids_ml_tpu.stat import (  # noqa: F401
     ChiSquareTest,
     Correlation,
@@ -127,6 +131,8 @@ __all__ = [
     "Correlation",
     "ChiSquareTest",
     "Summarizer",
+    "MultilayerPerceptronClassifier",
+    "MultilayerPerceptronModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

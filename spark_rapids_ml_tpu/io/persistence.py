@@ -60,6 +60,11 @@ _SPARK_CLASS_ALIASES = {
         "org.apache.spark.ml.regression.GeneralizedLinearRegression",
     "GeneralizedLinearRegressionModel":
         "org.apache.spark.ml.regression.GeneralizedLinearRegressionModel",
+    "MultilayerPerceptronClassifier":
+        "org.apache.spark.ml.classification.MultilayerPerceptronClassifier",
+    "MultilayerPerceptronModel":
+        "org.apache.spark.ml.classification."
+        "MultilayerPerceptronClassificationModel",
 }
 
 # Params a real Spark DefaultParamsReader recognizes per class. Extras
@@ -98,6 +103,14 @@ _SPARK_PARAM_ALLOWLIST = {
         "labelCol", "predictionCol", "linkPredictionCol", "family", "link",
         "variancePower", "linkPower", "offsetCol", "maxIter", "tol",
         "regParam", "fitIntercept", "weightCol"},
+    "MultilayerPerceptronClassifier": {
+        "layers", "labelCol", "predictionCol", "probabilityCol",
+        "rawPredictionCol", "maxIter", "tol", "seed", "solver",
+        "stepSize", "blockSize", "weightCol"},
+    "MultilayerPerceptronModel": {
+        "layers", "labelCol", "predictionCol", "probabilityCol",
+        "rawPredictionCol", "maxIter", "tol", "seed", "solver",
+        "stepSize", "blockSize", "weightCol"},
 }
 
 
@@ -409,6 +422,63 @@ def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
     _write_data_row(path, row, schema=schema, spark_fields=[
         ("clusterCenters", "matrix"), ("trainingCost", "double"),
     ])
+
+
+def save_mlp_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark MultilayerPerceptronClassificationModel layout: the layer
+    sizes plus ONE flat weight vector (per layer: W row-major then b) —
+    matching ``MultilayerPerceptronClassifierWriter`` upstream."""
+    if model.weights_ is None:
+        raise ValueError(
+            "cannot save an unfitted MultilayerPerceptronModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    extras = {
+        "numIterations": int(model.num_iterations_),
+        "finalLoss": float(model.final_loss_),
+        "layersFitted": [int(v) for v in model.layers_],
+    }
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata(),
+                    extra=extras)
+    row = {"weights": _dense_vector_struct(model.flat_weights)}
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([("weights", _vector_arrow_type())])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema,
+                    spark_fields=[("weights", "vector")])
+
+
+def load_mlp_model(path: str):
+    from spark_rapids_ml_tpu.models.mlp import (
+        MultilayerPerceptronModel,
+        weights_from_flat,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    extras = meta.get("extra", {})
+    # layersFitted is this writer's record; a genuine Spark-written
+    # directory carries layers only in its paramMap — fall back there
+    layers_raw = extras.get("layersFitted") \
+        or meta.get("paramMap", {}).get("layers") \
+        or meta.get("tpuParamMap", {}).get("layers")
+    if layers_raw is None:
+        raise ValueError(
+            f"{path}: metadata carries no layer sizes (layersFitted / "
+            "paramMap.layers)")
+    layers = [int(v) for v in layers_raw]
+    model = MultilayerPerceptronModel(
+        layers=layers,
+        weights=weights_from_flat(
+            _dense_vector_from_struct(row["weights"]), layers),
+        uid=meta["uid"],
+    )
+    model.num_iterations_ = int(extras.get("numIterations", 0))
+    model.final_loss_ = float(extras.get("finalLoss", float("nan")))
+    return _restore_params(model, meta)
 
 
 def save_gmm_model(model, path: str, overwrite: bool = False) -> None:

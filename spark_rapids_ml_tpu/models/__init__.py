@@ -4,6 +4,10 @@ from spark_rapids_ml_tpu.models.gaussian_mixture import (
     GaussianMixture,
     GaussianMixtureModel,
 )
+from spark_rapids_ml_tpu.models.mlp import (
+    MultilayerPerceptronClassifier,
+    MultilayerPerceptronModel,
+)
 from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegression,
     LinearRegressionModel,
@@ -71,6 +75,8 @@ __all__ = [
     "KMeansModel",
     "GaussianMixture",
     "GaussianMixtureModel",
+    "MultilayerPerceptronClassifier",
+    "MultilayerPerceptronModel",
     "LinearRegression",
     "LinearRegressionModel",
     "LogisticRegression",

@@ -1,0 +1,245 @@
+"""MultilayerPerceptronClassifier Estimator / Model.
+
+Spark ``org.apache.spark.ml.classification.MultilayerPerceptron
+Classifier`` param surface: layers (required, e.g. [in, h1, out]),
+maxIter, tol, seed, solver ('l-bfgs' default | 'gd'), stepSize,
+featuresCol(=inputCol), labelCol, predictionCol, probabilityCol,
+rawPredictionCol, weightCol. blockSize is accepted for surface parity
+and ignored — it tunes Spark's row-stacking BLAS batching, which is
+moot when the whole batch lives on the accelerator.
+
+The full training loop runs as ONE compiled XLA program
+(``ops/mlp_kernel.py``): sigmoid hidden layers + softmax cross-entropy,
+L-BFGS with zoom linesearch (optax) or plain GD, loss-tolerance stop
+evaluated on device. Labels are class indices 0..numClasses-1 like
+Spark. The fitted model persists Spark's layout: (layers, flat weight
+vector).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasWeightCol,
+    Param,
+)
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.ops.mlp_kernel import (
+    flatten_weights,
+    init_weights,
+    mlp_train_kernel,
+    unflatten_weights,
+)
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _valid_layers(v) -> bool:
+    return (isinstance(v, (list, tuple)) and len(v) >= 2
+            and all(isinstance(i, int) and i >= 1 for i in v))
+
+
+class MultilayerPerceptronParams(HasInputCol, HasDeviceId, HasWeightCol):
+    layers = Param("layers",
+                   "layer sizes input..output, e.g. [4, 8, 3]", None,
+                   validator=lambda v: v is None or _valid_layers(v))
+    labelCol = Param("labelCol",
+                     "class-index label column (0..numClasses-1)", "label")
+    predictionCol = Param("predictionCol", "predicted class column",
+                          "prediction")
+    probabilityCol = Param("probabilityCol",
+                           "softmax class-probability vector column",
+                           "probability")
+    rawPredictionCol = Param("rawPredictionCol",
+                             "pre-softmax logits vector column",
+                             "rawPrediction")
+    maxIter = Param("maxIter", "maximum optimizer iterations", 100,
+                    validator=lambda v: isinstance(v, int) and v >= 0)
+    tol = Param("tol", "loss-change convergence tolerance", 1e-6,
+                validator=lambda v: v >= 0)
+    seed = Param("seed", "weight-init seed", 0,
+                 validator=lambda v: isinstance(v, int))
+    solver = Param("solver", "optimizer: 'l-bfgs' (default) or 'gd'",
+                   "l-bfgs", validator=lambda v: v in ("l-bfgs", "gd"))
+    stepSize = Param("stepSize", "gd learning rate", 0.03,
+                     validator=lambda v: v > 0)
+    blockSize = Param(
+        "blockSize",
+        "accepted for Spark surface parity; ignored (BLAS row-stacking "
+        "is moot on an accelerator holding the whole batch)",
+        128, validator=lambda v: isinstance(v, int) and v >= 1)
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+class MultilayerPerceptronClassifier(MultilayerPerceptronParams):
+    """``MultilayerPerceptronClassifier(layers=[4, 8, 3]).fit(df)``."""
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "MultilayerPerceptronClassifier":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(MultilayerPerceptronClassifier, path)
+
+    def fit(self, dataset, labels=None) -> "MultilayerPerceptronModel":
+        import jax
+        import jax.numpy as jnp
+
+        timer = PhaseTimer()
+        layers = self.get_or_default("layers")
+        if layers is None:
+            raise ValueError("layers must be set, e.g. layers=[4, 8, 3]")
+        layers = [int(v) for v in layers]
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol()).astype(
+                np.float64, copy=False)
+            if labels is not None:
+                y = np.asarray(labels, dtype=np.float64).reshape(-1)
+            else:
+                y = np.asarray(frame.column(self.getLabelCol()),
+                               dtype=np.float64)
+        if y.shape[0] != x.shape[0]:
+            raise ValueError(
+                f"labels length {y.shape[0]} != rows {x.shape[0]}")
+        if x.shape[1] != layers[0]:
+            raise ValueError(
+                f"layers[0]={layers[0]} != feature width {x.shape[1]}")
+        n_classes = layers[-1]
+        y_idx = y.astype(np.int64)
+        if not np.array_equal(y_idx, y) or y_idx.min() < 0 \
+                or y_idx.max() >= n_classes:
+            raise ValueError(
+                f"labels must be class indices 0..{n_classes - 1} "
+                "(Spark MLP convention)")
+        w = self._extract_weights(frame, x.shape[0])
+        if w is None:
+            w = np.ones(x.shape[0])
+        y_onehot = np.zeros((x.shape[0], n_classes))
+        y_onehot[np.arange(x.shape[0]), y_idx] = 1.0
+
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        params0 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, dtype=dtype),
+            init_weights(layers, int(self.getSeed())))
+        with timer.phase("h2d"):
+            x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+            y_dev = jnp.asarray(y_onehot, dtype=dtype)
+            w_dev = jnp.asarray(w, dtype=dtype)
+        with timer.phase("fit_kernel"), TraceRange("mlp train",
+                                                   TraceColor.GREEN):
+            params, n_iter, loss = jax.block_until_ready(mlp_train_kernel(
+                params0, x_dev, y_dev, w_dev,
+                solver=self.get_or_default("solver"),
+                max_iter=int(self.getMaxIter()),
+                tol=float(self.getTol()),
+                step_size=float(self.getStepSize()),
+            ))
+        model = MultilayerPerceptronModel(
+            layers=layers,
+            weights=[{k: np.asarray(v, dtype=np.float64)
+                      for k, v in layer.items()} for layer in params],
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.num_iterations_ = int(n_iter)
+        model.final_loss_ = float(loss)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+
+class MultilayerPerceptronModel(MultilayerPerceptronParams):
+    def __init__(self, layers: Optional[List[int]] = None,
+                 weights: Optional[List[dict]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.layers_ = layers
+        self.weights_ = weights
+        self.num_iterations_ = 0
+        self.final_loss_ = float("nan")
+        self.fit_timings_ = {}
+
+    @property
+    def classes_(self) -> np.ndarray:
+        return np.arange(self.layers_[-1], dtype=np.float64)
+
+    @property
+    def flat_weights(self) -> np.ndarray:
+        """Spark's MLPModel weight layout (per layer: W row-major, b)."""
+        return flatten_weights(self.weights_)
+
+    def _copy_internal_state(self, other) -> None:
+        other.layers_ = self.layers_
+        other.weights_ = self.weights_
+        other.num_iterations_ = self.num_iterations_
+        other.final_loss_ = self.final_loss_
+
+    def _forward(self, x: np.ndarray):
+        import jax.numpy as jnp
+
+        if self.weights_ is None:
+            raise ValueError("model has no weights; fit first or load")
+        dtype = _resolve_dtype(self.getDtype())
+        params = [{k: jnp.asarray(v, dtype=dtype)
+                   for k, v in layer.items()} for layer in self.weights_]
+        from spark_rapids_ml_tpu.ops.mlp_kernel import forward_logits
+
+        logits = forward_logits(params, jnp.asarray(x, dtype=dtype))
+        return np.asarray(logits, dtype=np.float64)
+
+    def predict_proba(self, x) -> np.ndarray:
+        logits = self._forward(np.asarray(x, dtype=np.float64))
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        logits = self._forward(x)
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        proba = e / e.sum(axis=1, keepdims=True)
+        out = frame
+        raw_col = self.get_or_default("rawPredictionCol")
+        if raw_col:
+            out = out.with_column(raw_col, list(logits))
+        proba_col = self.get_or_default("probabilityCol")
+        if proba_col:
+            out = out.with_column(proba_col, list(proba))
+        pred_col = self.get_or_default("predictionCol")
+        if pred_col:
+            out = out.with_column(
+                pred_col, np.argmax(logits, axis=1).astype(np.float64))
+        return out
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_mlp_model
+
+        save_mlp_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "MultilayerPerceptronModel":
+        from spark_rapids_ml_tpu.io.persistence import load_mlp_model
+
+        return load_mlp_model(path)
+
+
+def weights_from_flat(flat: np.ndarray, layers: List[int]) -> List[dict]:
+    """Rebuild the per-layer pytree from Spark's flat vector."""
+    return unflatten_weights(np.asarray(flat, dtype=np.float64), layers)

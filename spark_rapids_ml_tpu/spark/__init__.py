@@ -13,7 +13,9 @@ Newton/EM planes (``moments_estimator.py``) for the scalers,
 TruncatedSVD, Imputer, RobustScaler, LinearSVC, OneVsRest,
 GeneralizedLinearRegression, and GaussianMixture; the envelope-guarded
 driver-collect adapter (``adapter.py``) only for the non-decomposable
-fits (UMAP spectral init, KNN item capture) and every Model transform.
+fits (UMAP spectral init, KNN item capture, the MLP's full-batch
+L-BFGS whose linesearch state does not split into cheap per-partition
+jobs) and every Model transform.
 """
 
 from spark_rapids_ml_tpu.spark.aggregate import (  # noqa: F401
@@ -83,6 +85,8 @@ _ADAPTER_CLASSES = (
     "OneVsRestModel",
     "UMAP",
     "UMAPModel",
+    "MultilayerPerceptronClassifier",
+    "MultilayerPerceptronClassifierModel",
 )
 
 __all__ = [

@@ -521,6 +521,10 @@ from spark_rapids_ml_tpu.models.gaussian_mixture import (  # noqa: E402
     GaussianMixture as _LGMM,
     GaussianMixtureModel as _LGMM_M,
 )
+from spark_rapids_ml_tpu.models.mlp import (  # noqa: E402
+    MultilayerPerceptronClassifier as _LMLP,
+    MultilayerPerceptronModel as _LMLP_M,
+)
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: E402
     NaiveBayesModel as _LNB_M,
 )
@@ -594,6 +598,16 @@ GaussianMixture, GaussianMixtureModel = _make_pair(
     doc="EM fit runs on the executor statistics plane "
         "(spark/moments_estimator.py); probability holds the "
         "responsibility vector, prediction its argmax.",
+)
+MultilayerPerceptronClassifier, MultilayerPerceptronClassifierModel = (
+    _make_pair(
+        "MultilayerPerceptronClassifier", _LMLP, _LMLP_M,
+        needs_label=True, classifier=True,
+        doc="Full-batch L-BFGS compiles the whole training loop into one "
+            "XLA program on the driver's device; fit collects under the "
+            "adapter envelope (L-BFGS linesearch state does not decompose "
+            "into cheap per-partition statistics jobs).",
+    )
 )
 StandardScaler, StandardScalerModel = _make_pair(
     "StandardScaler", _LSS, _LSS_M, needs_label=False,

@@ -1,0 +1,136 @@
+"""MultilayerPerceptronClassifier: nonlinear separability, solver
+comparison, weights, persistence, DataFrame front-end."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    MultilayerPerceptronClassifier,
+    MultilayerPerceptronModel,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def xor_data(rng, n=400):
+    x = rng.uniform(-1, 1, size=(n, 2))
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(float)
+    return x, y
+
+
+def test_learns_xor(rng):
+    """A linear model cannot pass 50%-ish on XOR; the MLP must."""
+    x, y = xor_data(rng)
+    model = MultilayerPerceptronClassifier(
+        layers=[2, 8, 2], seed=1, maxIter=200, tol=1e-9).fit(x, labels=y)
+    pred = np.argmax(model.predict_proba(x), axis=1)
+    assert np.mean(pred == y) > 0.95
+    assert model.num_iterations_ > 1
+    assert np.isfinite(model.final_loss_)
+
+
+def test_multiclass_blobs(rng):
+    centers = np.array([[6.0, 0], [0, 6.0], [-6.0, -6.0]])
+    labels = rng.integers(0, 3, size=450)
+    x = centers[labels] + rng.normal(size=(450, 2))
+    model = MultilayerPerceptronClassifier(
+        layers=[2, 6, 3], seed=0, maxIter=150).fit(x, labels=labels)
+    pred = np.argmax(model.predict_proba(x), axis=1)
+    assert np.mean(pred == labels) > 0.97
+
+
+def test_lbfgs_beats_gd_at_equal_iterations(rng):
+    x, y = xor_data(rng)
+    lb = MultilayerPerceptronClassifier(
+        layers=[2, 8, 2], seed=1, maxIter=100, tol=0.0).fit(x, labels=y)
+    gd = MultilayerPerceptronClassifier(
+        layers=[2, 8, 2], seed=1, maxIter=100, tol=0.0,
+        solver="gd").fit(x, labels=y)
+    assert lb.final_loss_ < gd.final_loss_
+
+
+def test_weighted_rows_shift_decision(rng):
+    # two overlapping blobs; upweighting one class pulls the boundary
+    x = np.vstack([rng.normal(size=(100, 2)) - 0.5,
+                   rng.normal(size=(100, 2)) + 0.5])
+    y = np.repeat([0.0, 1.0], 100)
+    w_hi = np.where(y == 1, 10.0, 1.0)
+    frame = VectorFrame({"features": list(x), "label": y, "w": w_hi})
+    m = MultilayerPerceptronClassifier(
+        layers=[2, 4, 2], seed=0, maxIter=100, weightCol="w").fit(frame)
+    pred = np.argmax(m.predict_proba(x), axis=1)
+    # the upweighted class dominates the overlap region
+    assert pred.mean() > 0.55
+
+
+def test_transform_columns(rng):
+    x, y = xor_data(rng, n=100)
+    model = MultilayerPerceptronClassifier(
+        layers=[2, 4, 2], seed=1, maxIter=50).fit(x, labels=y)
+    out = model.transform(x)
+    raw = np.stack([np.asarray(v) for v in out.column("rawPrediction")])
+    proba = np.stack([np.asarray(v) for v in out.column("probability")])
+    pred = np.asarray(out.column("prediction"))
+    assert raw.shape == proba.shape == (100, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    e = np.exp(raw - raw.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(proba, e / e.sum(axis=1, keepdims=True),
+                               atol=1e-6)
+    np.testing.assert_array_equal(pred, np.argmax(raw, axis=1))
+
+
+def test_validation(rng):
+    x, y = xor_data(rng, n=50)
+    with pytest.raises(ValueError, match="layers must be set"):
+        MultilayerPerceptronClassifier().fit(x, labels=y)
+    with pytest.raises(ValueError, match="feature width"):
+        MultilayerPerceptronClassifier(layers=[3, 4, 2]).fit(x, labels=y)
+    with pytest.raises(ValueError, match="class indices"):
+        MultilayerPerceptronClassifier(layers=[2, 4, 2]).fit(
+            x, labels=y + 0.5)
+    with pytest.raises(ValueError, match="class indices"):
+        MultilayerPerceptronClassifier(layers=[2, 4, 2]).fit(
+            x, labels=y + 5)
+
+
+def test_persistence_roundtrip(rng, tmp_path):
+    x, y = xor_data(rng, n=120)
+    model = MultilayerPerceptronClassifier(
+        layers=[2, 5, 2], seed=3, maxIter=60).fit(x, labels=y)
+    path = str(tmp_path / "mlp")
+    model.save(path)
+    loaded = MultilayerPerceptronModel.load(path)
+    assert loaded.layers_ == [2, 5, 2]
+    np.testing.assert_allclose(loaded.flat_weights, model.flat_weights)
+    np.testing.assert_allclose(
+        loaded.predict_proba(x[:10]), model.predict_proba(x[:10]),
+        atol=1e-12)
+    assert loaded.num_iterations_ == model.num_iterations_
+    # flat layout invariant: round-trips through Spark's vector shape
+    from spark_rapids_ml_tpu.models.mlp import weights_from_flat
+
+    rebuilt = weights_from_flat(model.flat_weights, [2, 5, 2])
+    for a, b in zip(rebuilt, model.weights_):
+        np.testing.assert_allclose(a["w"], b["w"])
+        np.testing.assert_allclose(a["b"], b["b"])
+
+
+def test_dataframe_front_end(rng):
+    from spark_rapids_ml_tpu.spark.local_engine import (
+        DenseVector,
+        LocalSparkSession,
+    )
+    from spark_rapids_ml_tpu.spark import MultilayerPerceptronClassifier \
+        as SparkMLP
+
+    spark = LocalSparkSession(n_partitions=2)
+    x, y = xor_data(rng, n=200)
+    df = spark.createDataFrame([
+        {"features": DenseVector(r), "label": lab}
+        for r, lab in zip(x, y)
+    ])
+    model = SparkMLP(layers=[2, 8, 2], seed=1, maxIter=150).fit(df)
+    rows = model.transform(df).collect()
+    proba = np.stack([r["probability"].toArray() for r in rows])
+    pred = np.asarray([r["prediction"] for r in rows])
+    assert np.mean(pred == y) > 0.95
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
