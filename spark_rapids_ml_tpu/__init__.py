@@ -55,6 +55,23 @@ from spark_rapids_ml_tpu.models.mlp import (  # noqa: F401
     MultilayerPerceptronClassifier,
     MultilayerPerceptronModel,
 )
+from spark_rapids_ml_tpu.models.feature_transformers import (  # noqa: F401
+    Bucketizer,
+    ChiSqSelector,
+    ChiSqSelectorModel,
+    ElementwiseProduct,
+    IndexToString,
+    OneHotEncoder,
+    OneHotEncoderModel,
+    PolynomialExpansion,
+    QuantileDiscretizer,
+    StringIndexer,
+    StringIndexerModel,
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+    VectorAssembler,
+    VectorSlicer,
+)
 from spark_rapids_ml_tpu.stat import (  # noqa: F401
     ChiSquareTest,
     Correlation,
@@ -133,6 +150,21 @@ __all__ = [
     "Summarizer",
     "MultilayerPerceptronClassifier",
     "MultilayerPerceptronModel",
+    "StringIndexer",
+    "StringIndexerModel",
+    "IndexToString",
+    "OneHotEncoder",
+    "OneHotEncoderModel",
+    "VectorAssembler",
+    "Bucketizer",
+    "QuantileDiscretizer",
+    "ElementwiseProduct",
+    "VectorSlicer",
+    "PolynomialExpansion",
+    "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel",
+    "ChiSqSelector",
+    "ChiSqSelectorModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

@@ -103,14 +103,15 @@ _SPARK_PARAM_ALLOWLIST = {
         "labelCol", "predictionCol", "linkPredictionCol", "family", "link",
         "variancePower", "linkPower", "offsetCol", "maxIter", "tol",
         "regParam", "fitIntercept", "weightCol"},
+    # NOTE: Spark's MLP has no weightCol param — it stays in tpuParamMap
     "MultilayerPerceptronClassifier": {
         "layers", "labelCol", "predictionCol", "probabilityCol",
         "rawPredictionCol", "maxIter", "tol", "seed", "solver",
-        "stepSize", "blockSize", "weightCol"},
+        "stepSize", "blockSize"},
     "MultilayerPerceptronModel": {
         "layers", "labelCol", "predictionCol", "probabilityCol",
         "rawPredictionCol", "maxIter", "tol", "seed", "solver",
-        "stepSize", "blockSize", "weightCol"},
+        "stepSize", "blockSize"},
 }
 
 
@@ -306,6 +307,15 @@ _SPARK_FIELD_TYPES = {
     "long": "long",
     "integer": "integer",
     "boolean": "boolean",
+    "array<int>": {"type": "array", "elementType": "integer",
+                   "containsNull": False},
+    "array<string>": {"type": "array", "elementType": "string",
+                      "containsNull": True},
+    "array<array<string>>": {
+        "type": "array",
+        "elementType": {"type": "array", "elementType": "string",
+                        "containsNull": True},
+        "containsNull": False},
 }
 
 
@@ -422,6 +432,95 @@ def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
     _write_data_row(path, row, schema=schema, spark_fields=[
         ("clusterCenters", "matrix"), ("trainingCost", "double"),
     ])
+
+
+def save_string_indexer_model(model, path: str,
+                              overwrite: bool = False) -> None:
+    """Spark StringIndexerModel layout: a data row carrying
+    ``labelsArray`` (Spark 3.x stores one labels list per input column;
+    we carry one)."""
+    if model.labels is None:
+        raise ValueError("cannot save an unfitted StringIndexerModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    _write_data_row(
+        path, {"labelsArray": [[str(v) for v in model.labels]]},
+        spark_fields=[("labelsArray", "array<array<string>>")])
+
+
+def load_string_indexer_model(path: str):
+    from spark_rapids_ml_tpu.models.feature_transformers import (
+        StringIndexerModel,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    # Spark 3.x writes labelsArray; Spark 2.x wrote labels
+    labels = (list(row["labelsArray"][0]) if "labelsArray" in row
+              else list(row["labels"]))
+    model = StringIndexerModel(
+        labels=[str(v) for v in labels], uid=meta["uid"])
+    return _restore_params(model, meta)
+
+
+def save_onehot_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark OneHotEncoderModel layout: a data row with categorySizes
+    (one entry per input column; we carry one)."""
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    _write_data_row(path, {"categorySizes": [int(model.category_size)]},
+                    spark_fields=[("categorySizes", "array<int>")])
+
+
+def load_onehot_model(path: str):
+    from spark_rapids_ml_tpu.models.feature_transformers import (
+        OneHotEncoderModel,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = OneHotEncoderModel(
+        category_size=int(list(row["categorySizes"])[0]),
+        uid=meta["uid"],
+    )
+    return _restore_params(model, meta)
+
+
+def save_selector_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark selector-model layout: a data row with selectedFeatures."""
+    if model.selected_features is None:
+        raise ValueError("cannot save an unfitted selector model")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(
+        path, cls, model.uid, model.param_map_for_metadata(),
+        extra={"selectorClass": type(model).__qualname__})
+    _write_data_row(
+        path,
+        {"selectedFeatures": [int(i) for i in model.selected_features]},
+        spark_fields=[("selectedFeatures", "array<int>")])
+
+
+_SELECTOR_MODEL_CLASSES = ("ChiSqSelectorModel",
+                           "VarianceThresholdSelectorModel")
+
+
+def load_selector_model(path: str):
+    from spark_rapids_ml_tpu.models import feature_transformers as ft
+
+    meta = _read_metadata(path)
+    name = meta.get("extra", {}).get("selectorClass", "ChiSqSelectorModel")
+    if name not in _SELECTOR_MODEL_CLASSES:
+        raise ValueError(
+            f"{path}: unknown selector model class {name!r} "
+            f"(expected one of {_SELECTOR_MODEL_CLASSES})")
+    row = _read_data_row(path)
+    model = getattr(ft, name)(
+        selected=[int(i) for i in row["selectedFeatures"]],
+        uid=meta["uid"])
+    return _restore_params(model, meta)
 
 
 def save_mlp_model(model, path: str, overwrite: bool = False) -> None:

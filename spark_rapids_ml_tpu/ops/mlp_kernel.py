@@ -78,7 +78,14 @@ def mlp_train_kernel(params, x, y_onehot, w, *, solver: str,
                                jnp.abs(value - prev) >= tol)
 
     if solver == "l-bfgs":
-        import optax   # only the l-bfgs branch needs it
+        try:
+            import optax   # only the l-bfgs branch needs it
+        except ImportError as exc:
+            raise ImportError(
+                "the MLP's default solver 'l-bfgs' needs optax (pip "
+                "install spark-rapids-ml-tpu[mlp]); alternatively set "
+                "solver='gd'"
+            ) from exc
 
         opt = optax.lbfgs()
         value_and_grad = optax.value_and_grad_from_state(loss_fn)

@@ -1,0 +1,251 @@
+"""Feature-transformer batch: Spark edge-case semantics (handleInvalid
+modes, dropLast, frequency ordering, polynomial term order), pyspark
+oracle where available via documented expected outputs."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    Bucketizer,
+    ChiSqSelector,
+    ChiSqSelectorModel,
+    ElementwiseProduct,
+    IndexToString,
+    OneHotEncoder,
+    OneHotEncoderModel,
+    PolynomialExpansion,
+    QuantileDiscretizer,
+    StringIndexer,
+    StringIndexerModel,
+    VarianceThresholdSelector,
+    VectorAssembler,
+    VectorSlicer,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+# ---------------- StringIndexer ----------------
+
+def test_string_indexer_frequency_desc():
+    df = VectorFrame({"cat": ["b", "a", "b", "c", "b", "a"]})
+    model = StringIndexer(inputCol="cat", outputCol="idx").fit(df)
+    # b(3) -> 0, a(2) -> 1, c(1) -> 2
+    assert model.labels == ["b", "a", "c"]
+    out = np.asarray(model.transform(df).column("idx"))
+    np.testing.assert_array_equal(out, [0, 1, 0, 2, 0, 1])
+
+
+def test_string_indexer_tie_breaks_alphabetical():
+    df = VectorFrame({"cat": ["z", "a", "z", "a"]})
+    model = StringIndexer(inputCol="cat").fit(df)
+    assert model.labels == ["a", "z"]   # equal counts: alphabetical
+
+
+def test_string_indexer_order_types():
+    df = VectorFrame({"cat": ["b", "a", "c"]})
+    asc = StringIndexer(inputCol="cat",
+                        stringOrderType="alphabetAsc").fit(df)
+    assert asc.labels == ["a", "b", "c"]
+    desc = StringIndexer(inputCol="cat",
+                         stringOrderType="alphabetDesc").fit(df)
+    assert desc.labels == ["c", "b", "a"]
+
+
+def test_string_indexer_handle_invalid():
+    train = VectorFrame({"cat": ["a", "b"]})
+    test = VectorFrame({"cat": ["a", "zzz", "b"]})
+    model = StringIndexer(inputCol="cat", outputCol="idx").fit(train)
+    with pytest.raises(ValueError, match="unseen"):
+        model.transform(test)
+    kept = model.copy({"handleInvalid": "keep"}).transform(test)
+    np.testing.assert_array_equal(
+        np.asarray(kept.column("idx")), [0, 2, 1])
+    skipped = model.copy({"handleInvalid": "skip"}).transform(test)
+    np.testing.assert_array_equal(
+        np.asarray(skipped.column("idx")), [0, 1])
+    assert list(skipped.column("cat")) == ["a", "b"]
+
+
+def test_string_indexer_roundtrip(tmp_path):
+    df = VectorFrame({"cat": ["x", "y", "x"]})
+    model = StringIndexer(inputCol="cat").fit(df)
+    path = str(tmp_path / "si")
+    model.save(path)
+    loaded = StringIndexerModel.load(path)
+    assert loaded.labels == model.labels
+    assert loaded.getInputCol() == "cat"
+
+
+def test_index_to_string_inverts():
+    df = VectorFrame({"cat": ["b", "a", "b", "c"]})
+    model = StringIndexer(inputCol="cat", outputCol="idx").fit(df)
+    out = model.transform(df)
+    inv = IndexToString(inputCol="idx", outputCol="orig",
+                        labels=model.labels).transform(out)
+    assert list(inv.column("orig")) == ["b", "a", "b", "c"]
+
+
+# ---------------- OneHotEncoder ----------------
+
+def test_onehot_drop_last():
+    df = VectorFrame({"idx": [0.0, 1.0, 2.0, 1.0]})
+    model = OneHotEncoder(inputCol="idx", outputCol="vec").fit(df)
+    out = np.stack([np.asarray(v) for v in
+                    model.transform(df).column("vec")])
+    # 3 categories, dropLast -> width 2; category 2 is all-zeros
+    np.testing.assert_array_equal(
+        out, [[1, 0], [0, 1], [0, 0], [0, 1]])
+
+
+def test_onehot_keep_invalid_and_no_drop(tmp_path):
+    train = VectorFrame({"idx": [0.0, 1.0]})
+    model = OneHotEncoder(inputCol="idx", outputCol="vec",
+                          dropLast=False).fit(train)
+    test = VectorFrame({"idx": [0.0, 5.0]})
+    with pytest.raises(ValueError, match="out of range"):
+        model.transform(test)
+    keep = model.copy({"handleInvalid": "keep"})
+    out = np.stack([np.asarray(v) for v in
+                    keep.transform(test).column("vec")])
+    # width 2 + invalid slot = 3
+    np.testing.assert_array_equal(out, [[1, 0, 0], [0, 0, 1]])
+    path = str(tmp_path / "ohe")
+    model.save(path)
+    loaded = OneHotEncoderModel.load(path)
+    assert loaded.category_size == 2
+    assert loaded.get_or_default("dropLast") is False
+
+
+# ---------------- VectorAssembler ----------------
+
+def test_vector_assembler_mixes_scalars_and_vectors():
+    df = VectorFrame({
+        "a": [1.0, 2.0],
+        "v": [np.array([10.0, 20.0]), np.array([30.0, 40.0])],
+        "b": [7.0, 8.0],
+    })
+    out = VectorAssembler(inputCols=["a", "v", "b"],
+                          outputCol="f").transform(df)
+    m = np.stack([np.asarray(r) for r in out.column("f")])
+    np.testing.assert_array_equal(m, [[1, 10, 20, 7], [2, 30, 40, 8]])
+
+
+def test_vector_assembler_handle_invalid():
+    df = VectorFrame({"a": [1.0, np.nan], "b": [2.0, 3.0]})
+    with pytest.raises(ValueError, match="NaN"):
+        VectorAssembler(inputCols=["a", "b"]).transform(df)
+    skipped = VectorAssembler(inputCols=["a", "b"],
+                              handleInvalid="skip").transform(df)
+    assert len(skipped) == 1
+    kept = VectorAssembler(inputCols=["a", "b"],
+                           handleInvalid="keep").transform(df)
+    assert len(kept) == 2
+
+
+# ---------------- Bucketizer / QuantileDiscretizer ----------------
+
+def test_bucketizer_spark_edges():
+    b = Bucketizer(inputCol="x", outputCol="b",
+                   splits=[0.0, 1.0, 2.0, 3.0])
+    df = VectorFrame({"x": [0.0, 0.5, 1.0, 2.5, 3.0]})
+    out = np.asarray(b.transform(df).column("b"))
+    # right edge of the LAST bucket is closed: 3.0 -> bucket 2
+    np.testing.assert_array_equal(out, [0, 0, 1, 2, 2])
+
+
+def test_bucketizer_handle_invalid():
+    b = Bucketizer(inputCol="x", outputCol="b", splits=[0.0, 1.0, 2.0])
+    df = VectorFrame({"x": [0.5, -1.0, np.nan]})
+    with pytest.raises(ValueError, match="handleInvalid"):
+        b.transform(df)
+    kept = b.copy({"handleInvalid": "keep"}).transform(df)
+    # invalids land in one extra bucket (index numBuckets)
+    np.testing.assert_array_equal(
+        np.asarray(kept.column("b")), [0, 2, 2])
+    skipped = b.copy({"handleInvalid": "skip"}).transform(df)
+    np.testing.assert_array_equal(np.asarray(skipped.column("b")), [0])
+
+
+def test_quantile_discretizer(rng):
+    x = rng.normal(size=2000)
+    qd = QuantileDiscretizer(inputCol="x", outputCol="b", numBuckets=4)
+    model = qd.fit(VectorFrame({"x": x}))
+    assert isinstance(model, Bucketizer)
+    out = np.asarray(model.transform(VectorFrame({"x": x})).column("b"))
+    counts = np.bincount(out.astype(int), minlength=4)
+    # quantile buckets are near-balanced
+    assert counts.min() > 0.8 * len(x) / 4
+
+
+def test_quantile_discretizer_constant_column():
+    model = QuantileDiscretizer(inputCol="x", numBuckets=3).fit(
+        VectorFrame({"x": np.ones(50)}))
+    out = np.asarray(model.transform(
+        VectorFrame({"x": np.ones(5)})).column("bucketed"))
+    assert np.isfinite(out).all()
+
+
+# ---------------- elementwise / slice / poly ----------------
+
+def test_elementwise_product():
+    df = VectorFrame({"features": [np.array([1.0, 2.0, 3.0])]})
+    out = ElementwiseProduct(scalingVec=[2.0, 0.5, 1.0],
+                             outputCol="s").transform(df)
+    np.testing.assert_array_equal(np.asarray(out.column("s")[0]),
+                                  [2.0, 1.0, 3.0])
+
+
+def test_vector_slicer():
+    df = VectorFrame({"features": [np.arange(5.0), np.arange(5.0) * 2]})
+    out = VectorSlicer(indices=[4, 0], outputCol="s").transform(df)
+    m = np.stack([np.asarray(r) for r in out.column("s")])
+    np.testing.assert_array_equal(m, [[4, 0], [8, 0]])
+    with pytest.raises(ValueError, match="out of range"):
+        VectorSlicer(indices=[9]).transform(df)
+
+
+def test_polynomial_expansion_spark_order():
+    """pyspark PolynomialExpansion(degree=2) on [x, y] emits
+    [x, x^2, y, x*y, y^2]; degree 3 appends the documented recursion."""
+    df = VectorFrame({"features": [np.array([2.0, 3.0])]})
+    out2 = PolynomialExpansion(degree=2, outputCol="e").transform(df)
+    np.testing.assert_array_equal(
+        np.asarray(out2.column("e")[0]), [2, 4, 3, 6, 9])
+    out3 = PolynomialExpansion(degree=3, outputCol="e").transform(df)
+    # x, x2, x3, y, xy, x2y, y2, xy2, y3
+    np.testing.assert_array_equal(
+        np.asarray(out3.column("e")[0]),
+        [2, 4, 8, 3, 6, 12, 9, 18, 27])
+
+
+# ---------------- selectors ----------------
+
+def test_variance_threshold_selector(rng):
+    x = rng.normal(size=(100, 4))
+    x[:, 2] = 5.0   # constant
+    model = VarianceThresholdSelector(varianceThreshold=0.0,
+                                      outputCol="s").fit(
+        VectorFrame({"features": list(x)}))
+    np.testing.assert_array_equal(model.selected_features, [0, 1, 3])
+    out = model.transform(VectorFrame({"features": list(x)}))
+    assert np.stack(
+        [np.asarray(v) for v in out.column("s")]).shape == (100, 3)
+
+
+def test_chisq_selector(rng, tmp_path):
+    n = 500
+    informative = rng.integers(0, 3, size=n).astype(float)
+    noise = rng.integers(0, 3, size=n).astype(float)
+    y = informative.copy()
+    x = np.column_stack([noise, informative, noise[::-1]])
+    df = VectorFrame({"features": list(x), "label": y})
+    model = ChiSqSelector(numTopFeatures=1).fit(df)
+    np.testing.assert_array_equal(model.selected_features, [1])
+    path = str(tmp_path / "selector")
+    model.save(path)
+    loaded = ChiSqSelectorModel.load(path)
+    np.testing.assert_array_equal(loaded.selected_features, [1])
+    fpr = ChiSqSelector(selectorType="fpr", fpr=1e-4).fit(df)
+    assert 1 in fpr.selected_features
+    assert 0 not in fpr.selected_features or len(
+        fpr.selected_features) < 3
