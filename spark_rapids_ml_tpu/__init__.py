@@ -72,6 +72,12 @@ from spark_rapids_ml_tpu.models.feature_transformers import (  # noqa: F401
     VectorAssembler,
     VectorSlicer,
 )
+from spark_rapids_ml_tpu.models.survival_regression import (  # noqa: F401
+    AFTSurvivalRegression,
+    AFTSurvivalRegressionModel,
+    IsotonicRegression,
+    IsotonicRegressionModel,
+)
 from spark_rapids_ml_tpu.stat import (  # noqa: F401
     ChiSquareTest,
     Correlation,
@@ -165,6 +171,10 @@ __all__ = [
     "VarianceThresholdSelectorModel",
     "ChiSqSelector",
     "ChiSqSelectorModel",
+    "AFTSurvivalRegression",
+    "AFTSurvivalRegressionModel",
+    "IsotonicRegression",
+    "IsotonicRegressionModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

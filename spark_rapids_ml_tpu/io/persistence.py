@@ -309,6 +309,8 @@ _SPARK_FIELD_TYPES = {
     "boolean": "boolean",
     "array<int>": {"type": "array", "elementType": "integer",
                    "containsNull": False},
+    "array<double>": {"type": "array", "elementType": "double",
+                      "containsNull": False},
     "array<string>": {"type": "array", "elementType": "string",
                       "containsNull": True},
     "array<array<string>>": {
@@ -432,6 +434,104 @@ def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
     _write_data_row(path, row, schema=schema, spark_fields=[
         ("clusterCenters", "matrix"), ("trainingCost", "double"),
     ])
+
+
+def save_aft_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark AFTSurvivalRegressionModel layout: (coefficients,
+    intercept, scale)."""
+    if model.coefficients is None:
+        raise ValueError("cannot save an unfitted AFT model")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata(),
+                    extra={"numIterations": int(model.num_iterations_),
+                           "finalLoss": float(model.final_loss_)})
+    row = {
+        "coefficients": _dense_vector_struct(model.coefficients),
+        "intercept": float(model.intercept),
+        "scale": float(model.scale),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("coefficients", _vector_arrow_type()),
+            ("intercept", pa.float64()),
+            ("scale", pa.float64()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("coefficients", "vector"), ("intercept", "double"),
+        ("scale", "double"),
+    ])
+
+
+def load_aft_model(path: str):
+    from spark_rapids_ml_tpu.models.survival_regression import (
+        AFTSurvivalRegressionModel,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = AFTSurvivalRegressionModel(
+        coefficients=_dense_vector_from_struct(row["coefficients"]),
+        intercept=float(row["intercept"]),
+        scale=float(row["scale"]),
+        uid=meta["uid"],
+    )
+    extras = meta.get("extra", {})
+    model.num_iterations_ = int(extras.get("numIterations", 0))
+    model.final_loss_ = float(extras.get("finalLoss", float("nan")))
+    return _restore_params(model, meta)
+
+
+def save_isotonic_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark IsotonicRegressionModelWriter layout: plain
+    ``array<double>`` boundaries/predictions columns plus the isotonic
+    boolean (NOT VectorUDT structs)."""
+    if model.boundaries is None:
+        raise ValueError("cannot save an unfitted IsotonicRegressionModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "boundaries": [float(v) for v in model.boundaries],
+        "predictions": [float(v) for v in model.predictions],
+        "isotonic": bool(model.get_or_default("isotonic")),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("boundaries", pa.list_(pa.float64())),
+            ("predictions", pa.list_(pa.float64())),
+            ("isotonic", pa.bool_()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("boundaries", "array<double>"), ("predictions", "array<double>"),
+        ("isotonic", "boolean"),
+    ])
+
+
+def load_isotonic_model(path: str):
+    from spark_rapids_ml_tpu.models.survival_regression import (
+        IsotonicRegressionModel,
+    )
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = IsotonicRegressionModel(
+        boundaries=np.asarray(list(row["boundaries"]), dtype=np.float64),
+        predictions=np.asarray(list(row["predictions"]), dtype=np.float64),
+        uid=meta["uid"],
+    )
+    model = _restore_params(model, meta)
+    if "isotonic" in row:
+        model.set("isotonic", bool(row["isotonic"]))
+    return model
 
 
 def save_string_indexer_model(model, path: str,

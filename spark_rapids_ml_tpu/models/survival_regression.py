@@ -1,0 +1,375 @@
+"""AFTSurvivalRegression and IsotonicRegression.
+
+Spark ``ml.regression`` parity (the two remaining non-tree regressors;
+the reference repo is PCA-only).
+
+AFT: Weibull accelerated-failure-time model. The negative
+log-likelihood over (beta, intercept, log sigma) minimizes ON DEVICE in
+one compiled L-BFGS program (``ops/optim.py::minimize_kernel`` — the
+same whole-loop-on-device shape as the MLP). Following Spark:
+``censorCol`` is 1.0 = event occurred (uncensored), 0.0 = censored;
+``predict`` returns exp(x.beta + intercept); quantiles come from the
+Weibull quantile function Q_p = predict * (-log(1-p))^sigma.
+
+Isotonic: pool-adjacent-violators on the driver (an inherently
+sequential O(n log n) scan — not accelerator-shaped), with Spark's
+linear interpolation between boundary points at predict time and the
+tie-handling Spark uses (average predictions inside equal-feature
+blocks before PAV).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
+from spark_rapids_ml_tpu.models.params import (
+    HasDeviceId,
+    HasInputCol,
+    HasWeightCol,
+    Param,
+    Params,
+)
+from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+# --------------------------------------------------------------------------
+# AFT survival regression
+# --------------------------------------------------------------------------
+
+def aft_neg_loglik(params, x, log_t, censor, w):
+    """-(1/n) Weibull AFT log-likelihood (constants in log t dropped).
+
+    epsilon_i = (log t_i - x_i.beta - b) / sigma;
+    loglik_i = delta_i * (epsilon_i - log sigma) - exp(epsilon_i).
+    Module-level so ``minimize_kernel`` caches one compilation.
+    """
+    import jax.numpy as jnp
+
+    eps = (log_t - x @ params["beta"] - params.get("intercept", 0.0)) \
+        / jnp.exp(params["log_sigma"])
+    ll = censor * (eps - params["log_sigma"]) - jnp.exp(eps)
+    return -(w * ll).sum() / w.sum()
+
+
+class AFTSurvivalRegressionParams(HasInputCol, HasDeviceId, HasWeightCol):
+    labelCol = Param("labelCol", "survival time column (> 0)", "label")
+    censorCol = Param("censorCol",
+                      "1.0 = event observed, 0.0 = censored (Spark)",
+                      "censor")
+    predictionCol = Param("predictionCol",
+                          "predicted mean scale exp(x.beta + b)",
+                          "prediction")
+    quantileProbabilities = Param(
+        "quantileProbabilities",
+        "probabilities for the quantiles column",
+        (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99),
+        validator=lambda v: all(0.0 < float(p) < 1.0 for p in v))
+    quantilesCol = Param("quantilesCol",
+                         "optional Weibull quantile vector column "
+                         "('' = not emitted)", "",
+                         validator=lambda v: isinstance(v, str))
+    maxIter = Param("maxIter", "maximum L-BFGS iterations", 100,
+                    validator=lambda v: isinstance(v, int) and v >= 0)
+    tol = Param("tol", "loss-change convergence tolerance", 1e-6,
+                validator=lambda v: v >= 0)
+    fitIntercept = Param("fitIntercept", "whether to fit an intercept",
+                         True, validator=lambda v: isinstance(v, bool))
+    dtype = Param("dtype", "device compute dtype", "auto",
+                  validator=lambda v: v in ("auto", "float32", "float64"))
+
+
+class AFTSurvivalRegression(AFTSurvivalRegressionParams):
+    """``AFTSurvivalRegression().fit(df)``; df carries features, label
+    (time > 0) and censor columns."""
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "AFTSurvivalRegression":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(AFTSurvivalRegression, path)
+
+    def fit(self, dataset) -> "AFTSurvivalRegressionModel":
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.optim import minimize_kernel
+
+        timer = PhaseTimer()
+        frame = as_vector_frame(dataset, self.getInputCol())
+        with timer.phase("densify"):
+            x = frame.vectors_as_matrix(self.getInputCol()).astype(
+                np.float64, copy=False)
+            t = np.asarray(frame.column(self.getLabelCol()),
+                           dtype=np.float64)
+            censor = np.asarray(frame.column(
+                self.get_or_default("censorCol")), dtype=np.float64)
+        if (t <= 0).any():
+            raise ValueError("survival times must be positive")
+        if not np.isin(censor, (0.0, 1.0)).all():
+            raise ValueError("censor column must be 0.0 or 1.0")
+        w = self._extract_weights(frame, x.shape[0])
+        if w is None:
+            w = np.ones(x.shape[0])
+        device = _resolve_device(self.getDeviceId())
+        dtype = _resolve_dtype(self.getDtype())
+        fit_b = self.getFitIntercept()
+        # fitIntercept=False: the intercept key is simply absent from
+        # the parameter pytree (the loss reads 0.0), so L-BFGS never
+        # moves it — no masking needed
+        params0 = {
+            "beta": jnp.zeros(x.shape[1], dtype=dtype),
+            "log_sigma": jnp.asarray(0.0, dtype=dtype),
+        }
+        if fit_b:
+            params0["intercept"] = jnp.asarray(
+                float(np.average(np.log(t), weights=w)), dtype=dtype)
+        with timer.phase("h2d"):
+            data = (
+                jax.device_put(jnp.asarray(x, dtype=dtype), device),
+                jnp.asarray(np.log(t), dtype=dtype),
+                jnp.asarray(censor, dtype=dtype),
+                jnp.asarray(w, dtype=dtype),
+            )
+        with timer.phase("fit_kernel"), TraceRange("aft lbfgs",
+                                                   TraceColor.GREEN):
+            params, n_iter, loss = jax.block_until_ready(minimize_kernel(
+                params0, data, loss_fn=aft_neg_loglik, solver="l-bfgs",
+                max_iter=int(self.getMaxIter()), tol=float(self.getTol())))
+        model = AFTSurvivalRegressionModel(
+            coefficients=np.asarray(params["beta"], dtype=np.float64),
+            intercept=float(params.get("intercept", 0.0)),
+            scale=float(np.exp(params["log_sigma"])),
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        model.num_iterations_ = int(n_iter)
+        model.final_loss_ = float(loss)
+        model.fit_timings_ = timer.as_dict()
+        return model
+
+
+class AFTSurvivalRegressionModel(AFTSurvivalRegressionParams):
+    def __init__(self, coefficients: Optional[np.ndarray] = None,
+                 intercept: float = 0.0, scale: float = 1.0,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.coefficients = coefficients
+        self.intercept = intercept
+        self.scale = scale
+        self.num_iterations_ = 0
+        self.final_loss_ = float("nan")
+        self.fit_timings_ = {}
+
+    def _copy_internal_state(self, other) -> None:
+        other.coefficients = self.coefficients
+        other.intercept = self.intercept
+        other.scale = self.scale
+        other.num_iterations_ = self.num_iterations_
+        other.final_loss_ = self.final_loss_
+
+    def predict(self, x) -> np.ndarray:
+        if self.coefficients is None:
+            raise ValueError("model has no coefficients; fit first or load")
+        x = np.asarray(x, dtype=np.float64)
+        return np.exp(x @ self.coefficients + self.intercept)
+
+    def predict_quantiles(self, x, base: Optional[np.ndarray] = None
+                          ) -> np.ndarray:
+        """Weibull quantiles; pass ``base=self.predict(x)`` if already
+        computed to skip the second matvec."""
+        probs = np.asarray(
+            self.get_or_default("quantileProbabilities"),
+            dtype=np.float64)
+        if base is None:
+            base = self.predict(x)
+        return base[:, None] * (-np.log1p(-probs))[None, :] ** self.scale
+
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        x = frame.vectors_as_matrix(self.getInputCol())
+        pred = self.predict(x)
+        out = frame.with_column(self.getPredictionCol(), pred)
+        qcol = self.get_or_default("quantilesCol")
+        if qcol:
+            out = out.with_column(
+                qcol, list(self.predict_quantiles(x, base=pred)))
+        return out
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_aft_model
+
+        save_aft_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "AFTSurvivalRegressionModel":
+        from spark_rapids_ml_tpu.io.persistence import load_aft_model
+
+        return load_aft_model(path)
+
+
+# --------------------------------------------------------------------------
+# Isotonic regression
+# --------------------------------------------------------------------------
+
+def pav(y: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Pool-adjacent-violators for a nondecreasing fit, O(n) stack form.
+
+    Returns the fitted values (same length as y, blockwise-constant).
+    """
+    n = y.shape[0]
+    # blocks as (weighted mean, weight, count), merged on violation
+    means = np.empty(n)
+    weights = np.empty(n)
+    counts = np.empty(n, dtype=np.int64)
+    top = 0
+    for i in range(n):
+        means[top] = y[i]
+        weights[top] = w[i]
+        counts[top] = 1
+        top += 1
+        while top > 1 and means[top - 2] > means[top - 1]:
+            wsum = weights[top - 2] + weights[top - 1]
+            means[top - 2] = (means[top - 2] * weights[top - 2]
+                              + means[top - 1] * weights[top - 1]) / wsum
+            weights[top - 2] = wsum
+            counts[top - 2] += counts[top - 1]
+            top -= 1
+    return np.repeat(means[:top], counts[:top])
+
+
+class IsotonicRegressionParams(HasInputCol, HasWeightCol):
+    labelCol = Param("labelCol", "label column name", "label")
+    predictionCol = Param("predictionCol", "prediction output column",
+                          "prediction")
+    isotonic = Param("isotonic",
+                     "True = nondecreasing (default), False = "
+                     "nonincreasing (antitonic)", True,
+                     validator=lambda v: isinstance(v, bool))
+    featureIndex = Param("featureIndex",
+                         "index into the feature vector to regress on",
+                         0, validator=lambda v: isinstance(v, int) and
+                         v >= 0)
+
+    def _feature_values(self, frame) -> np.ndarray:
+        col = frame.column(self.getInputCol())
+        first = col[0] if len(col) else 0.0
+        if np.ndim(first) >= 1:
+            x = frame.vectors_as_matrix(self.getInputCol())
+            return x[:, int(self.get_or_default("featureIndex"))]
+        return np.asarray(col, dtype=np.float64)
+
+
+class IsotonicRegression(IsotonicRegressionParams):
+    """``IsotonicRegression().fit(df)`` — Spark semantics: sort by
+    feature (secondary sort by label), average ties, PAV, keep only the
+    boundary points of constant blocks; predict by linear interpolation
+    and flat extrapolation."""
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "IsotonicRegression":
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(IsotonicRegression, path)
+
+    def fit(self, dataset) -> "IsotonicRegressionModel":
+        frame = as_vector_frame(dataset, self.getInputCol())
+        f = self._feature_values(frame)
+        y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
+        w = self._extract_weights(frame, f.shape[0])
+        if w is None:
+            w = np.ones(f.shape[0])
+        if not self.get_or_default("isotonic"):
+            y = -y
+        order = np.lexsort((y, f))
+        f_s, y_s, w_s = f[order], y[order], w[order]
+        # average equal-feature ties into one point (Spark's makeUnique),
+        # vectorized via segment reductions
+        uniq, start = np.unique(f_s, return_index=True)
+        w_t = np.add.reduceat(w_s, start)
+        wy_t = np.add.reduceat(w_s * y_s, start)
+        # zero-total-weight points carry no information: drop them
+        # (weights are validated non-negative; 0 is legal)
+        keep_w = w_t > 0
+        if not keep_w.any():
+            raise ValueError("all rows have zero weight")
+        uniq, w_t, wy_t = uniq[keep_w], w_t[keep_w], wy_t[keep_w]
+        y_t = wy_t / w_t
+        fitted = pav(y_t, w_t)
+        # boundaries: first/last point of every constant block
+        keep = np.zeros(fitted.shape[0], dtype=bool)
+        keep[0] = keep[-1] = True
+        keep[1:] |= fitted[1:] != fitted[:-1]
+        keep[:-1] |= fitted[:-1] != fitted[1:]
+        boundaries = uniq[keep]
+        predictions = fitted[keep]
+        if not self.get_or_default("isotonic"):
+            predictions = -predictions
+        model = IsotonicRegressionModel(
+            boundaries=np.asarray(boundaries, dtype=np.float64),
+            predictions=np.asarray(predictions, dtype=np.float64),
+        )
+        model.uid = self.uid
+        model.copy_values_from(self)
+        return model
+
+
+class IsotonicRegressionModel(IsotonicRegressionParams):
+    def __init__(self, boundaries: Optional[np.ndarray] = None,
+                 predictions: Optional[np.ndarray] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.boundaries = boundaries
+        self.predictions = predictions
+
+    def _copy_internal_state(self, other) -> None:
+        other.boundaries = self.boundaries
+        other.predictions = self.predictions
+
+    def predict(self, f: np.ndarray) -> np.ndarray:
+        """Linear interpolation between boundaries, flat beyond the
+        ends (Spark's predictionModel semantics)."""
+        if self.boundaries is None:
+            raise ValueError("model is unfitted")
+        return np.interp(np.asarray(f, dtype=np.float64),
+                         self.boundaries, self.predictions)
+
+    def transform(self, dataset) -> VectorFrame:
+        frame = as_vector_frame(dataset, self.getInputCol())
+        f = self._feature_values(frame)
+        return frame.with_column(self.getPredictionCol(), self.predict(f))
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from spark_rapids_ml_tpu.io.persistence import save_isotonic_model
+
+        save_isotonic_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "IsotonicRegressionModel":
+        from spark_rapids_ml_tpu.io.persistence import load_isotonic_model
+
+        return load_isotonic_model(path)

@@ -1,0 +1,162 @@
+"""AFTSurvivalRegression + IsotonicRegression: parameter recovery,
+score stationarity, sklearn/scipy oracles, persistence."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    AFTSurvivalRegression,
+    AFTSurvivalRegressionModel,
+    IsotonicRegression,
+    IsotonicRegressionModel,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def make_aft_data(rng, n=2000, p=3, sigma=0.5, censor_frac=0.3):
+    x = rng.normal(size=(n, p)) * 0.5
+    beta = np.array([0.6, -0.4, 0.2])[:p]
+    b = 1.0
+    # Weibull AFT: log T = x.beta + b + sigma * Gumbel(min)
+    gumbel = np.log(-np.log(rng.uniform(size=n)))
+    t = np.exp(x @ beta + b + sigma * gumbel)
+    # independent censoring at random horizons
+    c = np.exp(x @ beta + b + sigma * np.quantile(gumbel, 1 - censor_frac))
+    observed = (t <= c).astype(float)
+    time = np.minimum(t, c)
+    return x, time, observed, beta, b, sigma
+
+
+def test_aft_recovers_parameters(rng):
+    x, t, censor, beta, b, sigma = make_aft_data(rng, n=4000)
+    df = VectorFrame({"features": list(x), "label": t, "censor": censor})
+    model = AFTSurvivalRegression(maxIter=200, tol=1e-10).fit(df)
+    np.testing.assert_allclose(model.coefficients, beta, atol=0.08)
+    assert model.intercept == pytest.approx(b, abs=0.08)
+    assert model.scale == pytest.approx(sigma, abs=0.08)
+
+
+def test_aft_score_stationary_at_optimum(rng):
+    """The gradient of the negative log-likelihood vanishes at the fit."""
+    import jax
+
+    from spark_rapids_ml_tpu.models.survival_regression import (
+        aft_neg_loglik,
+    )
+
+    x, t, censor, *_ = make_aft_data(rng, n=800)
+    df = VectorFrame({"features": list(x), "label": t, "censor": censor})
+    model = AFTSurvivalRegression(maxIter=300, tol=1e-14).fit(df)
+    params = {
+        "beta": np.asarray(model.coefficients),
+        "intercept": np.asarray(model.intercept),
+        "log_sigma": np.asarray(np.log(model.scale)),
+    }
+    g = jax.grad(aft_neg_loglik)(
+        params, x, np.log(t), censor, np.ones(len(t)))
+    for key, val in g.items():
+        assert np.max(np.abs(np.asarray(val))) < 1e-4, key
+
+
+def test_aft_quantiles_and_transform(rng):
+    x, t, censor, *_ = make_aft_data(rng, n=500)
+    df = VectorFrame({"features": list(x), "label": t, "censor": censor})
+    model = AFTSurvivalRegression(quantilesCol="q").fit(df)
+    out = model.transform(df)
+    pred = np.asarray(out.column("prediction"))
+    np.testing.assert_allclose(
+        pred, np.exp(x @ model.coefficients + model.intercept),
+        rtol=1e-10)
+    q = np.stack([np.asarray(v) for v in out.column("q")])
+    assert q.shape == (500, 9)
+    assert (np.diff(q, axis=1) > 0).all()   # quantiles increase in p
+    # median quantile identity: Q_0.5 = pred * (ln 2)^sigma
+    np.testing.assert_allclose(
+        q[:, 4], pred * np.log(2.0) ** model.scale, rtol=1e-10)
+
+
+def test_aft_validation(rng):
+    x = rng.normal(size=(10, 2))
+    df = VectorFrame({"features": list(x), "label": np.zeros(10),
+                      "censor": np.ones(10)})
+    with pytest.raises(ValueError, match="positive"):
+        AFTSurvivalRegression().fit(df)
+    df2 = VectorFrame({"features": list(x), "label": np.ones(10),
+                       "censor": np.full(10, 0.5)})
+    with pytest.raises(ValueError, match="censor"):
+        AFTSurvivalRegression().fit(df2)
+
+
+def test_aft_persistence(rng, tmp_path):
+    x, t, censor, *_ = make_aft_data(rng, n=300)
+    df = VectorFrame({"features": list(x), "label": t, "censor": censor})
+    model = AFTSurvivalRegression().fit(df)
+    path = str(tmp_path / "aft")
+    model.save(path)
+    loaded = AFTSurvivalRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+    assert loaded.scale == model.scale
+    np.testing.assert_allclose(loaded.predict(x[:5]), model.predict(x[:5]))
+
+
+def test_isotonic_matches_sklearn(rng):
+    sk_iso = pytest.importorskip("sklearn.isotonic")
+    f = rng.uniform(0, 10, size=300)
+    y = 0.5 * f + rng.normal(size=300)
+    model = IsotonicRegression().fit(
+        VectorFrame({"features": f, "label": y}))
+    sk = sk_iso.IsotonicRegression(out_of_bounds="clip").fit(f, y)
+    grid = np.linspace(0, 10, 101)
+    np.testing.assert_allclose(model.predict(grid), sk.predict(grid),
+                               atol=1e-8)
+
+
+def test_isotonic_weighted_and_antitonic(rng):
+    f = np.arange(10.0)
+    y = np.array([1.0, 3.0, 2.0, 4.0, 5.0, 7.0, 6.0, 8.0, 9.0, 10.0])
+    w = rng.uniform(0.5, 2.0, size=10)
+    sk_iso = pytest.importorskip("sklearn.isotonic")
+    ours = IsotonicRegression(weightCol="w").fit(
+        VectorFrame({"features": f, "label": y, "w": w}))
+    sk = sk_iso.IsotonicRegression(out_of_bounds="clip").fit(
+        f, y, sample_weight=w)
+    np.testing.assert_allclose(ours.predict(f), sk.predict(f), atol=1e-8)
+    anti = IsotonicRegression(isotonic=False).fit(
+        VectorFrame({"features": f, "label": -y}))
+    plain = IsotonicRegression().fit(
+        VectorFrame({"features": f, "label": y}))
+    np.testing.assert_allclose(anti.predict(f), -plain.predict(f),
+                               atol=1e-8)
+
+
+def test_isotonic_vector_feature_index(rng):
+    f = rng.uniform(0, 5, size=100)
+    other = rng.normal(size=100)
+    y = f + 0.1 * rng.normal(size=100)
+    x = np.column_stack([other, f])
+    model = IsotonicRegression(featureIndex=1).fit(
+        VectorFrame({"features": list(x), "label": y}))
+    out = model.transform(VectorFrame({"features": list(x), "label": y}))
+    pred = np.asarray(out.column("prediction"))
+    assert np.corrcoef(pred, y)[0, 1] > 0.95
+
+
+def test_isotonic_interpolation_and_clipping():
+    model = IsotonicRegressionModel(
+        boundaries=np.array([1.0, 3.0]),
+        predictions=np.array([10.0, 20.0]))
+    np.testing.assert_allclose(
+        model.predict([0.0, 1.0, 2.0, 3.0, 9.0]),
+        [10.0, 10.0, 15.0, 20.0, 20.0])
+
+
+def test_isotonic_persistence(rng, tmp_path):
+    f = rng.uniform(0, 10, size=100)
+    y = f + rng.normal(size=100)
+    model = IsotonicRegression().fit(
+        VectorFrame({"features": f, "label": y}))
+    path = str(tmp_path / "iso")
+    model.save(path)
+    loaded = IsotonicRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.boundaries, model.boundaries)
+    np.testing.assert_allclose(loaded.predictions, model.predictions)
