@@ -78,6 +78,17 @@ from spark_rapids_ml_tpu.models.survival_regression import (  # noqa: F401
     IsotonicRegression,
     IsotonicRegressionModel,
 )
+from spark_rapids_ml_tpu.models.text import (  # noqa: F401
+    CountVectorizer,
+    CountVectorizerModel,
+    HashingTF,
+    IDF,
+    IDFModel,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+)
 from spark_rapids_ml_tpu.stat import (  # noqa: F401
     ChiSquareTest,
     Correlation,
@@ -175,6 +186,15 @@ __all__ = [
     "AFTSurvivalRegressionModel",
     "IsotonicRegression",
     "IsotonicRegressionModel",
+    "Tokenizer",
+    "RegexTokenizer",
+    "StopWordsRemover",
+    "NGram",
+    "HashingTF",
+    "CountVectorizer",
+    "CountVectorizerModel",
+    "IDF",
+    "IDFModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

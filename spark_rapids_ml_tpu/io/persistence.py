@@ -311,6 +311,8 @@ _SPARK_FIELD_TYPES = {
                    "containsNull": False},
     "array<double>": {"type": "array", "elementType": "double",
                       "containsNull": False},
+    "array<long>": {"type": "array", "elementType": "long",
+                    "containsNull": False},
     "array<string>": {"type": "array", "elementType": "string",
                       "containsNull": True},
     "array<array<string>>": {
@@ -434,6 +436,69 @@ def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
     _write_data_row(path, row, schema=schema, spark_fields=[
         ("clusterCenters", "matrix"), ("trainingCost", "double"),
     ])
+
+
+def save_countvec_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark CountVectorizerModel layout: a vocabulary array row."""
+    if model.vocabulary is None:
+        raise ValueError("cannot save an unfitted CountVectorizerModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    _write_data_row(
+        path, {"vocabulary": [str(t) for t in model.vocabulary]},
+        spark_fields=[("vocabulary", "array<string>")])
+
+
+def load_countvec_model(path: str):
+    from spark_rapids_ml_tpu.models.text import CountVectorizerModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = CountVectorizerModel(
+        vocabulary=[str(t) for t in row["vocabulary"]], uid=meta["uid"])
+    return _restore_params(model, meta)
+
+
+def save_idf_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark IDFModel layout: (idf vector, docFreq array, numDocs)."""
+    if model.idf is None:
+        raise ValueError("cannot save an unfitted IDFModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    row = {
+        "idf": _dense_vector_struct(model.idf),
+        "docFreq": [int(v) for v in np.asarray(model.doc_freq)],
+        "numDocs": int(model.num_docs),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("idf", _vector_arrow_type()),
+            ("docFreq", pa.list_(pa.int64())),
+            ("numDocs", pa.int64()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("idf", "vector"), ("docFreq", "array<long>"), ("numDocs", "long"),
+    ])
+
+
+def load_idf_model(path: str):
+    from spark_rapids_ml_tpu.models.text import IDFModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = IDFModel(
+        idf=_dense_vector_from_struct(row["idf"]),
+        doc_freq=np.asarray(list(row["docFreq"]), dtype=np.float64),
+        num_docs=int(row["numDocs"]),
+        uid=meta["uid"],
+    )
+    return _restore_params(model, meta)
 
 
 def save_aft_model(model, path: str, overwrite: bool = False) -> None:

@@ -30,7 +30,6 @@ from spark_rapids_ml_tpu.models.params import (
     HasInputCol,
     HasWeightCol,
     Param,
-    Params,
 )
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer

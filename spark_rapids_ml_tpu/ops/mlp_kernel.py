@@ -16,7 +16,6 @@ solvers 'l-bfgs' and 'gd'); the reference repo is PCA-only
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Sequence
 
 import jax
@@ -56,65 +55,23 @@ def mean_cross_entropy(params, x, y_onehot, w):
     return -(w[:, None] * y_onehot * logp).sum() / w.sum()
 
 
-@partial(jax.jit, static_argnames=("solver", "max_iter"))
 def mlp_train_kernel(params, x, y_onehot, w, *, solver: str,
                      max_iter: int, tol, step_size):
-    """Full-batch training to convergence in one compiled program.
+    """Full-batch training to convergence in one compiled program —
+    a thin wrapper over the shared whole-loop-on-device optimizer
+    (``ops/optim.py::minimize_kernel``) with the MLP's softmax
+    cross-entropy objective.
 
     solver='l-bfgs': optax.lbfgs (zoom linesearch) — Spark's default.
     solver='gd': plain gradient descent at ``step_size``.
     Stops when |loss - loss_prev| < tol or at ``max_iter``.
     Returns (params, n_iter, final_loss).
     """
-    def loss_fn(p):
-        return mean_cross_entropy(p, x, y_onehot, w)
+    from spark_rapids_ml_tpu.ops.optim import minimize_kernel
 
-    inf = jnp.asarray(jnp.inf, dtype=x.dtype)
-    zero = jnp.asarray(0.0, dtype=x.dtype)
-
-    def cond(carry):
-        _p, _state, value, prev, it = carry
-        return jnp.logical_and(it < max_iter,
-                               jnp.abs(value - prev) >= tol)
-
-    if solver == "l-bfgs":
-        try:
-            import optax   # only the l-bfgs branch needs it
-        except ImportError as exc:
-            raise ImportError(
-                "the MLP's default solver 'l-bfgs' needs optax (pip "
-                "install spark-rapids-ml-tpu[mlp]); alternatively set "
-                "solver='gd'"
-            ) from exc
-
-        opt = optax.lbfgs()
-        value_and_grad = optax.value_and_grad_from_state(loss_fn)
-
-        def body(carry):
-            p, state, value, _prev, it = carry
-            new_value, grad = value_and_grad(p, state=state)
-            updates, state = opt.update(
-                grad, state, p, value=new_value, grad=grad,
-                value_fn=loss_fn)
-            p = optax.apply_updates(p, updates)
-            return (p, state, new_value, value, it + 1)
-
-        state0 = opt.init(params)
-    else:
-        grad_fn = jax.value_and_grad(loss_fn)
-
-        def body(carry):
-            p, state, value, _prev, it = carry
-            new_value, g = grad_fn(p)
-            p = jax.tree_util.tree_map(
-                lambda a, b: a - step_size * b, p, g)
-            return (p, state, new_value, value, it + 1)
-
-        state0 = ()
-
-    p, _state, value, _prev, it = jax.lax.while_loop(
-        cond, body, (params, state0, inf, zero, jnp.asarray(0)))
-    return p, it, value
+    return minimize_kernel(
+        params, (x, y_onehot, w), loss_fn=mean_cross_entropy,
+        solver=solver, max_iter=max_iter, tol=tol, step_size=step_size)
 
 
 def flatten_weights(params: List[dict]) -> np.ndarray:

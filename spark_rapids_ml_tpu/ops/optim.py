@@ -31,8 +31,11 @@ def minimize_kernel(params, data, *, loss_fn, solver: str, max_iter: int,
     def objective(p):
         return loss_fn(p, *data)
 
-    inf = jnp.asarray(jnp.inf)
-    zero = jnp.asarray(0.0)
+    # carry slots must match the loss dtype exactly (float32 data under
+    # an x64 runtime would otherwise fail while_loop's type check)
+    val_dtype = jax.eval_shape(objective, params).dtype
+    inf = jnp.asarray(jnp.inf, dtype=val_dtype)
+    zero = jnp.asarray(0.0, dtype=val_dtype)
 
     def cond(carry):
         _p, _s, value, prev, it = carry
@@ -45,7 +48,7 @@ def minimize_kernel(params, data, *, loss_fn, solver: str, max_iter: int,
         except ImportError as exc:
             raise ImportError(
                 "solver 'l-bfgs' needs optax (pip install "
-                "spark-rapids-ml-tpu[mlp]); alternatively use "
+                "spark-rapids-ml-tpu[mlp]); alternatively set "
                 "solver='gd'"
             ) from exc
 

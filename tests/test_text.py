@@ -1,0 +1,166 @@
+"""Text pipeline: tokenizers, stop words, n-grams, HashingTF's exact
+Spark murmur3 buckets, CountVectorizer ordering/minDF/minTF, IDF."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    CountVectorizer,
+    CountVectorizerModel,
+    HashingTF,
+    IDF,
+    IDFModel,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+from spark_rapids_ml_tpu.models.text import murmur3_x86_32
+
+
+def test_murmur3_reference_vectors():
+    """Canonical MurmurHash3 x86_32 vectors (signed like the JVM)."""
+    assert murmur3_x86_32(b"", 0) == 0
+    assert murmur3_x86_32(b"a", 0) == 1009084850
+    assert murmur3_x86_32(b"abc", 0) == -1277324294
+    # 4-byte-block + tail path
+    assert murmur3_x86_32(b"abcd", 0) == 1139631978
+    # seed 42 is Spark's HashingTF seed
+    assert murmur3_x86_32(b"b", 42) != murmur3_x86_32(b"b", 0)
+
+
+def test_tokenizer_lowercases_and_splits():
+    df = VectorFrame({"text": ["Hi There  WORLD", "one two"]})
+    out = Tokenizer(inputCol="text").transform(df)
+    assert out.column("tokens") == [["hi", "there", "world"],
+                                    ["one", "two"]]
+
+
+def test_regex_tokenizer_modes():
+    df = VectorFrame({"text": ["a,bb,,ccc"]})
+    # default minTokenLength=1 drops the empty token (Spark behavior)
+    gaps = RegexTokenizer(inputCol="text", pattern=",").transform(df)
+    assert gaps.column("tokens") == [["a", "bb", "ccc"]]
+    keep_empty = RegexTokenizer(inputCol="text", pattern=",",
+                                minTokenLength=0).transform(df)
+    assert keep_empty.column("tokens") == [["a", "bb", "", "ccc"]]
+    min2 = RegexTokenizer(inputCol="text", pattern=",",
+                          minTokenLength=2).transform(df)
+    assert min2.column("tokens") == [["bb", "ccc"]]
+    match = RegexTokenizer(inputCol="text", pattern=r"\w+",
+                           gaps=False).transform(df)
+    assert match.column("tokens") == [["a", "bb", "ccc"]]
+    upper = RegexTokenizer(inputCol="text", pattern=",",
+                           toLowercase=False).transform(
+        VectorFrame({"text": ["A,B"]}))
+    assert upper.column("tokens") == [["A", "B"]]
+
+
+def test_stop_words_remover():
+    df = VectorFrame({"tokens": [["the", "Quick", "fox", "IS", "fast"]]})
+    out = StopWordsRemover(inputCol="tokens").transform(df)
+    assert out.column("filtered") == [["Quick", "fox", "fast"]]
+    cs = StopWordsRemover(inputCol="tokens", caseSensitive=True,
+                          stopWords=["the", "is"]).transform(df)
+    assert cs.column("filtered") == [["Quick", "fox", "IS", "fast"]]
+    assert "the" in StopWordsRemover.loadDefaultStopWords()
+
+
+def test_ngram():
+    df = VectorFrame({"tokens": [["a", "b", "c", "d"], ["x"]]})
+    out = NGram(inputCol="tokens", n=2).transform(df)
+    assert out.column("ngrams") == [["a b", "b c", "c d"], []]
+    out3 = NGram(inputCol="tokens", n=3).transform(df)
+    assert out3.column("ngrams") == [["a b c", "b c d"], []]
+
+
+def test_hashing_tf_buckets_and_counts():
+    tf = HashingTF(inputCol="tokens", numFeatures=64)
+    df = VectorFrame({"tokens": [["cat", "dog", "cat"], ["dog"]]})
+    out = tf.transform(df)
+    m = np.stack([np.asarray(v) for v in out.column("tf")])
+    cat, dog = tf.indexOf("cat"), tf.indexOf("dog")
+    assert m[0, cat] == 2.0 and m[0, dog] == 1.0
+    assert m[1, dog] == 1.0 and m.sum() == 4.0
+    # binary mode caps at 1
+    b = HashingTF(inputCol="tokens", numFeatures=64, binary=True)
+    mb = np.stack([np.asarray(v)
+                   for v in b.transform(df).column("tf")])
+    assert mb[0, cat] == 1.0
+    # bucket equals murmur3(seed 42) % numFeatures (Spark parity)
+    assert cat == murmur3_x86_32(b"cat", 42) % 64
+
+
+def test_count_vectorizer_ordering_and_thresholds():
+    docs = [["a", "b", "a"], ["a", "c"], ["a", "b"], ["d"]]
+    df = VectorFrame({"tokens": docs})
+    model = CountVectorizer(inputCol="tokens").fit(df)
+    # corpus counts: a=4, b=2, c=1, d=1 -> ties alphabetical
+    assert model.vocabulary == ["a", "b", "c", "d"]
+    out = np.stack([np.asarray(v)
+                    for v in model.transform(df).column("counts")])
+    np.testing.assert_array_equal(out[0], [2, 1, 0, 0])
+    # minDF as a count
+    mdf = CountVectorizer(inputCol="tokens", minDF=2.0).fit(df)
+    assert mdf.vocabulary == ["a", "b"]
+    # minDF as a fraction (0.5 of 4 docs = 2 docs)
+    mfr = CountVectorizer(inputCol="tokens", minDF=0.5).fit(df)
+    assert mfr.vocabulary == ["a", "b"]
+    # vocabSize cap keeps the most frequent
+    cap = CountVectorizer(inputCol="tokens", vocabSize=1).fit(df)
+    assert cap.vocabulary == ["a"]
+    # minTF at transform: drop sub-threshold in-document counts
+    mtf = model.copy({"minTF": 2.0})
+    out2 = np.stack([np.asarray(v)
+                     for v in mtf.transform(df).column("counts")])
+    np.testing.assert_array_equal(out2[0], [2, 0, 0, 0])
+
+
+def test_count_vectorizer_persistence(tmp_path):
+    df = VectorFrame({"tokens": [["x", "y"], ["y"]]})
+    model = CountVectorizer(inputCol="tokens").fit(df)
+    path = str(tmp_path / "cv")
+    model.save(path)
+    loaded = CountVectorizerModel.load(path)
+    assert loaded.vocabulary == model.vocabulary
+
+
+def test_idf_mllib_formula(tmp_path):
+    x = np.array([[1.0, 0.0, 2.0],
+                  [1.0, 1.0, 0.0],
+                  [0.0, 0.0, 0.0]])
+    df = VectorFrame({"tf": list(x)})
+    model = IDF(inputCol="tf", outputCol="out").fit(df)
+    expected = np.log((3 + 1.0) / (np.array([2, 1, 1]) + 1.0))
+    np.testing.assert_allclose(model.idf, expected, atol=1e-12)
+    out = np.stack([np.asarray(v)
+                    for v in model.transform(df).column("out")])
+    np.testing.assert_allclose(out, x * expected[None, :], atol=1e-12)
+    # minDocFreq zeroes rare terms
+    m2 = IDF(inputCol="tf", minDocFreq=2).fit(df)
+    assert m2.idf[1] == 0.0 and m2.idf[0] > 0.0
+    path = str(tmp_path / "idf")
+    model.save(path)
+    loaded = IDFModel.load(path)
+    np.testing.assert_allclose(loaded.idf, model.idf)
+    assert loaded.num_docs == 3
+
+
+def test_text_pipeline_composes(rng):
+    from spark_rapids_ml_tpu import NaiveBayes, Pipeline
+
+    spam = ["win money now", "free money win", "win win prize"]
+    ham = ["meeting at noon", "lunch at noon today", "project meeting"]
+    texts = spam + ham
+    y = np.array([1.0, 1.0, 1.0, 0.0, 0.0, 0.0])
+    df = VectorFrame({"text": texts, "label": y})
+    pipe = Pipeline(stages=[
+        Tokenizer(inputCol="text", outputCol="tokens"),
+        HashingTF(inputCol="tokens", outputCol="features",
+                  numFeatures=256),
+        NaiveBayes(),
+    ])
+    model = pipe.fit(df)
+    pred = np.asarray(model.transform(df).column("prediction"))
+    assert (pred == y).all()
