@@ -78,6 +78,12 @@ from spark_rapids_ml_tpu.models.survival_regression import (  # noqa: F401
     IsotonicRegression,
     IsotonicRegressionModel,
 )
+from spark_rapids_ml_tpu.models.fm import (  # noqa: F401
+    FMClassificationModel,
+    FMClassifier,
+    FMRegressionModel,
+    FMRegressor,
+)
 from spark_rapids_ml_tpu.models.text import (  # noqa: F401
     CountVectorizer,
     CountVectorizerModel,
@@ -195,6 +201,10 @@ __all__ = [
     "CountVectorizerModel",
     "IDF",
     "IDFModel",
+    "FMRegressor",
+    "FMRegressionModel",
+    "FMClassifier",
+    "FMClassificationModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

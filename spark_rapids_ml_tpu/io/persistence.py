@@ -438,6 +438,70 @@ def save_kmeans_model(model, path: str, overwrite: bool = False) -> None:
     ])
 
 
+_FM_MODEL_CLASSES = ("FMRegressionModel", "FMClassificationModel")
+
+
+def save_fm_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark FM model layout: (intercept, linear vector, factors
+    matrix)."""
+    if model.factors is None:
+        raise ValueError("cannot save an unfitted FM model")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(
+        path, cls, model.uid, model.param_map_for_metadata(),
+        extra={"fmClass": type(model).__qualname__,
+               "numIterations": int(model.num_iterations_),
+               "finalLoss": float(model.final_loss_)})
+    n = model.factors.shape[0]
+    linear = (model.linear if model.linear is not None
+              else np.zeros(n))
+    row = {
+        "intercept": float(model.intercept),
+        "linear": _dense_vector_struct(linear),
+        "factors": _dense_matrix_struct(model.factors),
+        "hasLinear": model.linear is not None,
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("intercept", pa.float64()),
+            ("linear", _vector_arrow_type()),
+            ("factors", _matrix_arrow_type()),
+            ("hasLinear", pa.bool_()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("intercept", "double"), ("linear", "vector"),
+        ("factors", "matrix"), ("hasLinear", "boolean"),
+    ])
+
+
+def load_fm_model(path: str):
+    from spark_rapids_ml_tpu.models import fm as fm_mod
+
+    meta = _read_metadata(path)
+    name = meta.get("extra", {}).get("fmClass", "FMRegressionModel")
+    if name not in _FM_MODEL_CLASSES:
+        raise ValueError(
+            f"{path}: unknown FM model class {name!r} "
+            f"(expected one of {_FM_MODEL_CLASSES})")
+    row = _read_data_row(path)
+    model = getattr(fm_mod, name)(
+        factors=_dense_matrix_from_struct(row["factors"]),
+        linear=(_dense_vector_from_struct(row["linear"])
+                if row.get("hasLinear", True) else None),
+        intercept=float(row["intercept"]),
+        uid=meta["uid"],
+    )
+    extras = meta.get("extra", {})
+    model.num_iterations_ = int(extras.get("numIterations", 0))
+    model.final_loss_ = float(extras.get("finalLoss", float("nan")))
+    return _restore_params(model, meta)
+
+
 def save_countvec_model(model, path: str, overwrite: bool = False) -> None:
     """Spark CountVectorizerModel layout: a vocabulary array row."""
     if model.vocabulary is None:

@@ -119,8 +119,14 @@ class RegexTokenizer(HasInputCol, HasOutputCol, Params):
         out = []
         for s in frame.column(self.getInputCol()):
             s = str(s).lower() if lower else str(s)
-            toks = (pattern.split(s) if self.get_or_default("gaps")
-                    else pattern.findall(s))
+            if self.get_or_default("gaps"):
+                toks = pattern.split(s)
+                # Java's Pattern.split (Spark) drops TRAILING empty
+                # tokens; Python's re.split keeps them
+                while toks and toks[-1] == "":
+                    toks.pop()
+            else:
+                toks = pattern.findall(s)
             out.append([t for t in toks if len(t) >= min_len])
         return frame.with_column(self.getOutputCol(), out)
 

@@ -65,6 +65,30 @@ def minimize_kernel(params, data, *, loss_fn, solver: str, max_iter: int,
             return (p, state, new_value, value, it + 1)
 
         state0 = opt.init(params)
+    elif solver == "adamW":
+        try:
+            import optax
+        except ImportError as exc:
+            raise ImportError(
+                "solver 'adamW' needs optax (pip install "
+                "spark-rapids-ml-tpu[mlp]); alternatively set "
+                "solver='gd'"
+            ) from exc
+
+        # weight_decay stays 0: regularization belongs to the loss
+        # (optax's 1e-4 default would silently shrink every parameter,
+        # intercepts included, on top of the objective's regParam)
+        opt = optax.adamw(learning_rate=step_size, weight_decay=0.0)
+        grad_fn = jax.value_and_grad(objective)
+
+        def body(carry):
+            p, state, value, _prev, it = carry
+            new_value, g = grad_fn(p)
+            updates, state = opt.update(g, state, p)
+            p = optax.apply_updates(p, updates)
+            return (p, state, new_value, value, it + 1)
+
+        state0 = opt.init(params)
     else:
         grad_fn = jax.value_and_grad(objective)
 

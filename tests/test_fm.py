@@ -1,0 +1,126 @@
+"""Factorization machines: interaction recovery, solver paths,
+classifier behavior on interaction-only data, persistence."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    FMClassificationModel,
+    FMClassifier,
+    FMRegressionModel,
+    FMRegressor,
+    LinearRegression,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def fm_truth(x, w0, w, v):
+    xv = x @ v
+    x2v2 = (x * x) @ (v * v)
+    return w0 + x @ w + 0.5 * (xv * xv - x2v2).sum(axis=1)
+
+
+def make_fm_data(rng, n=800, p=5, k=2, noise=0.01):
+    x = rng.normal(size=(n, p)) * 0.7
+    w0 = 0.5
+    w = rng.normal(size=p) * 0.3
+    v = rng.normal(size=(p, k)) * 0.5
+    y = fm_truth(x, w0, w, v) + noise * rng.normal(size=n)
+    return x, y, (w0, w, v)
+
+
+def test_regressor_beats_linear_on_interactions(rng):
+    x, y, _ = make_fm_data(rng)
+    fm = FMRegressor(factorSize=2, maxIter=800, stepSize=0.05,
+                     tol=1e-10, seed=1).fit(x, labels=y)
+    lin = LinearRegression().fit(x, labels=y)
+    fm_mse = float(np.mean((fm.predict(x) - y) ** 2))
+    lin_mse = float(np.mean(
+        (x @ lin.coefficients + lin.intercept - y) ** 2))
+    assert fm_mse < 0.25 * lin_mse
+    assert fm_mse < 0.05
+
+
+def test_solvers_all_converge(rng):
+    x, y, _ = make_fm_data(rng, n=400)
+    for solver, kwargs in (("adamW", {"stepSize": 0.05}),
+                           ("gd", {"stepSize": 0.02, "maxIter": 2000}),
+                           ("l-bfgs", {})):
+        m = FMRegressor(factorSize=2, solver=solver, tol=1e-12,
+                        seed=3, **kwargs).fit(x, labels=y)
+        assert np.isfinite(m.final_loss_)
+        mse = float(np.mean((m.predict(x) - y) ** 2))
+        assert mse < 0.5, solver
+
+
+def test_fit_linear_and_intercept_toggles(rng):
+    x, y, _ = make_fm_data(rng, n=300)
+    no_lin = FMRegressor(factorSize=2, fitLinear=False,
+                         maxIter=50).fit(x, labels=y)
+    assert no_lin.linear is None
+    no_int = FMRegressor(factorSize=2, fitIntercept=False,
+                         maxIter=50).fit(x, labels=y)
+    assert no_int.intercept == 0.0
+
+
+def test_classifier_learns_pure_interaction(rng):
+    """y = sign(x1 * x2): invisible to a linear model, native to FM."""
+    n = 1200
+    x = rng.normal(size=(n, 2))
+    y = (x[:, 0] * x[:, 1] > 0).astype(float)
+    m = FMClassifier(factorSize=2, maxIter=1500, stepSize=0.05,
+                     tol=1e-12, seed=5).fit(x, labels=y)
+    out = m.transform(x)
+    pred = np.asarray(out.column("prediction"))
+    proba = np.asarray(out.column("probability"))
+    assert np.mean(pred == y) > 0.9
+    assert ((proba >= 0) & (proba <= 1)).all()
+    np.testing.assert_array_equal(pred, (proba > 0.5).astype(float))
+
+
+def test_classifier_label_validation(rng):
+    x = rng.normal(size=(50, 2))
+    with pytest.raises(ValueError, match="0.0 or 1.0"):
+        FMClassifier().fit(x, labels=rng.normal(size=50))
+
+
+def test_weighted_rows(rng):
+    x, y, _ = make_fm_data(rng, n=200)
+    w = rng.integers(1, 3, size=200).astype(float)
+    weighted = FMRegressor(factorSize=2, seed=2, maxIter=300,
+                           stepSize=0.05, weightCol="w").fit(
+        VectorFrame({"features": list(x), "label": y, "w": w}))
+    dup = FMRegressor(factorSize=2, seed=2, maxIter=300,
+                      stepSize=0.05).fit(
+        np.repeat(x, w.astype(int), axis=0),
+        labels=np.repeat(y, w.astype(int)))
+    # same objective value (weighted == duplicated), allow optimizer
+    # wiggle on the params themselves
+    np.testing.assert_allclose(
+        np.mean((weighted.predict(x) - y) ** 2),
+        np.mean((dup.predict(x) - y) ** 2), atol=1e-2)
+
+
+def test_persistence_roundtrip(rng, tmp_path):
+    x, y, _ = make_fm_data(rng, n=200)
+    model = FMRegressor(factorSize=3, maxIter=100, stepSize=0.05,
+                        seed=4).fit(x, labels=y)
+    path = str(tmp_path / "fm_reg")
+    model.save(path)
+    loaded = FMRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.factors, model.factors)
+    np.testing.assert_allclose(loaded.linear, model.linear)
+    assert loaded.intercept == model.intercept
+    np.testing.assert_allclose(loaded.predict(x[:5]), model.predict(x[:5]))
+
+    yc = (y > np.median(y)).astype(float)
+    clf = FMClassifier(factorSize=2, maxIter=100, stepSize=0.05,
+                       seed=4).fit(x, labels=yc)
+    cpath = str(tmp_path / "fm_clf")
+    clf.save(cpath)
+    cloaded = FMClassificationModel.load(cpath)
+    np.testing.assert_allclose(
+        cloaded.predict_proba(x[:5]), clf.predict_proba(x[:5]))
+    # the class dispatch is validated: a regressor path loads a
+    # regression model, a classifier path a classification model
+    assert isinstance(cloaded, FMClassificationModel)

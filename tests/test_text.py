@@ -45,6 +45,11 @@ def test_regex_tokenizer_modes():
     keep_empty = RegexTokenizer(inputCol="text", pattern=",",
                                 minTokenLength=0).transform(df)
     assert keep_empty.column("tokens") == [["a", "bb", "", "ccc"]]
+    # Java Pattern.split (Spark) drops TRAILING empties only
+    trailing = RegexTokenizer(inputCol="text", pattern=",",
+                              minTokenLength=0).transform(
+        VectorFrame({"text": ["a,b,,"]}))
+    assert trailing.column("tokens") == [["a", "b"]]
     min2 = RegexTokenizer(inputCol="text", pattern=",",
                           minTokenLength=2).transform(df)
     assert min2.column("tokens") == [["bb", "ccc"]]
