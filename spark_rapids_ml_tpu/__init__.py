@@ -78,6 +78,10 @@ from spark_rapids_ml_tpu.models.survival_regression import (  # noqa: F401
     IsotonicRegression,
     IsotonicRegressionModel,
 )
+from spark_rapids_ml_tpu.models.bisecting_kmeans import (  # noqa: F401
+    BisectingKMeans,
+    BisectingKMeansModel,
+)
 from spark_rapids_ml_tpu.models.fm import (  # noqa: F401
     FMClassificationModel,
     FMClassifier,
@@ -205,6 +209,8 @@ __all__ = [
     "FMRegressionModel",
     "FMClassifier",
     "FMClassificationModel",
+    "BisectingKMeans",
+    "BisectingKMeansModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

@@ -868,17 +868,41 @@ def load_gmm_model(path: str):
     return _restore_params(model, meta)
 
 
-def load_kmeans_model(path: str):
-    from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+def save_bkm_model(model, path: str, overwrite: bool = False) -> None:
+    """BisectingKMeansModel: the KMeansModel data layout (leaf centers
+    matrix + training cost) — Spark persists its cluster tree, an
+    implementation detail our flat-leaves design does not carry.
+    Delegates to the KMeans writer so the wire format cannot drift."""
+    if model.cluster_centers is None:
+        raise ValueError("cannot save an unfitted BisectingKMeansModel")
+    save_kmeans_model(model, path, overwrite=overwrite)
 
+
+def load_bkm_model(path: str):
+    from spark_rapids_ml_tpu.models.bisecting_kmeans import (
+        BisectingKMeansModel,
+    )
+
+    return _load_centers_model(path, BisectingKMeansModel)
+
+
+def _load_centers_model(path: str, model_cls):
+    """(clusterCenters, trainingCost) layout shared by KMeansModel and
+    BisectingKMeansModel."""
     meta = _read_metadata(path)
     row = _read_data_row(path)
-    model = KMeansModel(
+    model = model_cls(
         cluster_centers=_dense_matrix_from_struct(row["clusterCenters"]),
         uid=meta["uid"],
     )
     model.training_cost_ = row.get("trainingCost")
     return _restore_params(model, meta)
+
+
+def load_kmeans_model(path: str):
+    from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+
+    return _load_centers_model(path, KMeansModel)
 
 
 def save_linreg_model(model, path: str, overwrite: bool = False) -> None:

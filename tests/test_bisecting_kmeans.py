@@ -1,0 +1,96 @@
+"""BisectingKMeans: blob recovery, divisibility rules, early stop,
+weighted fits, persistence."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import BisectingKMeans, BisectingKMeansModel
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def make_blobs(rng, sizes=(150, 150, 150, 150), d=3, sep=10.0):
+    centers = np.zeros((len(sizes), d))
+    for i in range(len(sizes)):
+        centers[i, i % d] = sep * (1 + i // d)
+    xs, labels = [], []
+    for i, n in enumerate(sizes):
+        xs.append(centers[i] + rng.normal(size=(n, d)))
+        labels.extend([i] * n)
+    return np.vstack(xs), centers, np.asarray(labels)
+
+
+def test_recovers_blobs(rng):
+    x, centers, labels = make_blobs(rng)
+    model = BisectingKMeans(k=4, seed=1).fit(x)
+    assert model.cluster_centers.shape == (4, 3)
+    for c in centers:
+        assert np.min(np.linalg.norm(
+            model.cluster_centers - c, axis=1)) < 0.5
+    out = model.transform(x)
+    pred = np.asarray(out.column("prediction"))
+    # each true blob maps to one predicted cluster
+    for i in range(4):
+        values, counts = np.unique(pred[labels == i],
+                                   return_counts=True)
+        assert counts.max() / counts.sum() > 0.98
+
+
+def test_fewer_leaves_when_nothing_divisible(rng):
+    # 4 identical points cannot be bisected past 1 cluster
+    x = np.ones((4, 2))
+    model = BisectingKMeans(k=3).fit(x)
+    assert model.cluster_centers.shape[0] == 1
+
+
+def test_min_divisible_cluster_size(rng):
+    x, _, _ = make_blobs(rng, sizes=(200, 10))
+    # fraction form: clusters under 40% of 210 rows are not divisible,
+    # so after the first split (200/10) only the 200-blob can split
+    model = BisectingKMeans(k=3, seed=2,
+                            minDivisibleClusterSize=0.4).fit(x)
+    assert model.cluster_centers.shape[0] == 3
+    sizes = np.bincount(np.asarray(
+        model.transform(x).column("prediction"), dtype=int))
+    assert sizes.min() >= 10
+
+
+def test_training_cost_decreases_with_k(rng):
+    x, _, _ = make_blobs(rng)
+    costs = [BisectingKMeans(k=k, seed=0).fit(x).training_cost_
+             for k in (1, 2, 4)]
+    assert costs[0] > costs[1] > costs[2]
+
+
+def test_compute_cost_matches_training_cost(rng):
+    x, _, _ = make_blobs(rng)
+    model = BisectingKMeans(k=4, seed=1).fit(x)
+    # unweighted: training cost (leaf SSEs to leaf means) >= assignment
+    # cost to the same centers; for well-separated blobs they agree
+    assert model.computeCost(x) == pytest.approx(
+        model.training_cost_, rel=1e-6)
+
+
+def test_weighted_fit(rng):
+    x, _, _ = make_blobs(rng, sizes=(100, 100))
+    w = np.ones(200)
+    w[:100] = 3.0
+    model = BisectingKMeans(k=2, seed=3, weightCol="w").fit(
+        VectorFrame({"features": list(x), "w": w}))
+    assert model.cluster_centers.shape == (2, 3)
+    pred = np.asarray(model.transform(x).column("prediction"))
+    assert len(np.unique(pred)) == 2
+
+
+def test_persistence(rng, tmp_path):
+    x, _, _ = make_blobs(rng)
+    model = BisectingKMeans(k=4, seed=1).fit(x)
+    path = str(tmp_path / "bkm")
+    model.save(path)
+    loaded = BisectingKMeansModel.load(path)
+    np.testing.assert_allclose(loaded.cluster_centers,
+                               model.cluster_centers)
+    assert loaded.training_cost_ == pytest.approx(model.training_cost_)
+    assert loaded.getK() == 4
+    np.testing.assert_array_equal(
+        np.asarray(loaded.transform(x[:20]).column("prediction")),
+        np.asarray(model.transform(x[:20]).column("prediction")))
