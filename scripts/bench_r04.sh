@@ -17,7 +17,7 @@ stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 for i in $(seq 1 40); do
   echo "probe $i start: $(stamp)" >> "$OUT/status.log"
   if python -c "import jax; d=jax.devices()[0]; print(d.platform, getattr(d,'device_kind',''))" \
-      > "$OUT/probe.log" 2>&1 && grep -q -v cpu "$OUT/probe.log"; then
+      > "$OUT/probe.log" 2>&1 && grep -q "^tpu " "$OUT/probe.log"; then
     echo "probe ok: $(stamp)" >> "$OUT/status.log"
 
     echo "bench config4 start: $(stamp)" >> "$OUT/status.log"

@@ -1,0 +1,166 @@
+"""Round-4 single-process bench orchestrator.
+
+The round-4 status snapshot (`records/bench_r04_status_snapshot.log`)
+taught the operative constraint: on the single-claim tunnel terminal only
+the FIRST process of a healthy window reaches the chip — every subsequent
+process hits the claim-release window and burns a ~25-minute UNAVAILABLE
+retry (config4 succeeded at 01:20Z; bench_models and config3, launched as
+fresh processes seconds later, both died rc=1 after exactly ~25 min).
+
+So: ONE process, ONE JAX client, every remaining record captured
+sequentially inside it, each written to ``records/r04/`` the moment it
+exists (a mid-run wedge keeps everything already captured). Steps, in
+evidence-priority order (VERDICT r3 tasks in parens):
+
+  1. bench_models      — config 5's first-ever committed record (#1, #3 r2)
+  2. bench.py config 3 — 1M×4096 with the gated solver (#4)
+  3. bench.py config 2 — 65536×784 refresh
+  4. gram sweep        — block-shape × precision arms (#10)
+  5. scale run         — DBSCAN/UMAP 200k×64 envelope proof (#5)
+  6. PJRT smoke        — native client re-verify (#7); runs LAST because
+     it creates a SECOND client against the already-claimed chip and may
+     legitimately fail inside this process — its failure must not cost
+     any JAX record.
+
+Exit codes: 0 = all steps attempted (individual failures recorded in
+status.log), 2 = no chip (wrapper loop retries).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import datetime
+import io
+import json
+import os
+import subprocess
+import sys
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "records", "r04")
+sys.path.insert(0, REPO)
+
+
+def stamp() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def log(msg: str) -> None:
+    os.makedirs(OUT, exist_ok=True)
+    with open(os.path.join(OUT, "status.log"), "a") as f:
+        f.write(f"{msg}: {stamp()}\n")
+
+
+def run_step(name: str, fn, env: dict[str, str] | None = None) -> bool:
+    """Run one bench main() in-process, stdout captured to records/r04/.
+
+    Env overrides are applied for the call and restored after — the bench
+    mains read their config from os.environ at call time.
+    """
+    log(f"{name} start")
+    saved: dict[str, str | None] = {}
+    for k, v in (env or {}).items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    buf = io.StringIO()
+    ok = False
+    try:
+        with contextlib.redirect_stdout(buf):
+            fn()
+        ok = True
+    except BaseException as exc:  # noqa: BLE001 - one step must not kill the batch
+        with open(os.path.join(OUT, f"{name}.err"), "w") as f:
+            f.write(f"{type(exc).__name__}: {exc}\n")
+            f.write(traceback.format_exc())
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            raise
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        text = buf.getvalue()
+        if text.strip():
+            # a crashed step must not leave a record-looking .json —
+            # partial output lands beside the .err as .partial
+            suffix = "json" if ok else "partial"
+            with open(os.path.join(OUT, f"{name}.{suffix}"), "w") as f:
+                f.write(text)
+    log(f"{name} {'ok' if ok else 'FAILED'}")
+    return ok
+
+
+def main() -> int:
+    os.makedirs(OUT, exist_ok=True)
+    # Force the TPU backend: a silent CPU fallback would burn the window
+    # measuring nothing. If the tunnel is wedged this raises after JAX's
+    # internal retry (~25 min) — the wrapper loop absorbs that.
+    os.environ.setdefault("JAX_PLATFORMS", "tpu")
+    log("probe start")
+    try:
+        import jax
+
+        device = jax.devices()[0]
+    except Exception as exc:  # noqa: BLE001
+        log(f"probe FAILED ({type(exc).__name__})")
+        return 2
+    if device.platform == "cpu":
+        log("probe FAILED (cpu backend)")
+        return 2
+    log(f"probe ok ({device.platform} "
+        f"{getattr(device, 'device_kind', '?')})")
+
+    import bench
+    import bench_models
+    from scripts import bench_gram_sweep, bench_scale
+
+    run_step("bench_models", bench_models.main)
+    run_step("bench_config3", bench.main, env={
+        "BENCH_SKIP_PROBE": "1", "BENCH_ROWS": "1048576",
+    })
+    run_step("bench_config2", bench.main, env={
+        "BENCH_SKIP_PROBE": "1", "BENCH_ROWS": "65536",
+        "BENCH_COLS": "784", "BENCH_K": "50", "BENCH_BATCH": "65536",
+    })
+    run_step("gram_sweep", bench_gram_sweep.main)
+    run_step("scale", bench_scale.main)
+
+    # PJRT smoke last: the native client needs the chip claim the JAX
+    # client above holds, so release it first and give the tunnel a
+    # moment. Even so this may fail on a slow claim-release window —
+    # which is why it runs after every JAX record is already on disk.
+    log("pjrt_smoke start")
+    try:
+        jax.clear_caches()
+        jax.clear_backends()
+    except Exception:  # noqa: BLE001 - best effort release
+        pass
+    import gc
+    import time
+
+    gc.collect()
+    time.sleep(30)
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_native.py",
+         "-k", "pjrt", "-q", "--no-header"],
+        env={**os.environ, "TPUML_PJRT_SMOKE": "1",
+             "JAX_PLATFORMS": "cpu"},
+        stdout=open(os.path.join(OUT, "pjrt_smoke.log"), "w"),
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+        timeout=None,
+    )
+    log(f"pjrt_smoke rc={rc}")
+
+    with open(os.path.join(OUT, "done"), "w") as f:
+        f.write(stamp() + "\n")
+    log("ALL DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

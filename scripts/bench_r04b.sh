@@ -17,7 +17,7 @@ for i in $(seq 1 60); do
   # no timeout on the probe: killing a process mid-client-init can
   # wedge the tunnel terminal (a failing probe self-terminates ~25 min)
   if python -c "import jax; d=jax.devices()[0]; print(d.platform, getattr(d,'device_kind',''))" \
-      > "$OUT/probe.log" 2>&1 && grep -q -v cpu "$OUT/probe.log"; then
+      > "$OUT/probe.log" 2>&1 && grep -q "^tpu " "$OUT/probe.log"; then
     echo "probe ok: $(stamp)" >> "$OUT/status.log"
     sleep 5
 

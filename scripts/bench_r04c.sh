@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-4 third bench loop: single-process orchestrator edition.
+#
+# bench_r04b.sh's window at 01:04Z proved the constraint: one chip claim
+# per process, and fresh processes launched right after a claim release
+# burn ~25-min UNAVAILABLE retries. bench_r04_once.py therefore captures
+# EVERY remaining record inside one process/claim; this wrapper just
+# retries it until the tunnel yields a window. Do NOT kill this script or
+# its child mid-claim (that wedges the tunnel terminal).
+cd /root/repo || exit 1
+export PYTHONPATH=/root/repo:/root/.axon_site
+OUT=/root/repo/records/r04
+mkdir -p "$OUT"
+
+for i in $(seq 1 48); do
+  echo "attempt $i start: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  python scripts/bench_r04_once.py >> "$OUT/loop.log" 2>&1
+  rc=$?
+  echo "attempt $i rc=$rc: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  [ -f "$OUT/done" ] && exit 0
+  sleep 300
+done
+echo "gave up: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
