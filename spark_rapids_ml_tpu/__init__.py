@@ -88,6 +88,7 @@ from spark_rapids_ml_tpu.models.fm import (  # noqa: F401
     FMRegressionModel,
     FMRegressor,
 )
+from spark_rapids_ml_tpu.models.als import ALS, ALSModel  # noqa: F401
 from spark_rapids_ml_tpu.models.text import (  # noqa: F401
     CountVectorizer,
     CountVectorizerModel,
@@ -206,6 +207,8 @@ __all__ = [
     "IDF",
     "IDFModel",
     "FMRegressor",
+    "ALS",
+    "ALSModel",
     "FMRegressionModel",
     "FMClassifier",
     "FMClassificationModel",

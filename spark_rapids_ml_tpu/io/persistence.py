@@ -48,12 +48,17 @@ _SPARK_CLASS_ALIASES = {
     "PCAModel": "org.apache.spark.ml.feature.PCAModel",
     "KMeans": "org.apache.spark.ml.clustering.KMeans",
     "KMeansModel": "org.apache.spark.ml.clustering.KMeansModel",
+    "BisectingKMeans": "org.apache.spark.ml.clustering.BisectingKMeans",
+    "BisectingKMeansModel":
+        "org.apache.spark.ml.clustering.BisectingKMeansModel",
     "LinearRegression": "org.apache.spark.ml.regression.LinearRegression",
     "LinearRegressionModel": "org.apache.spark.ml.regression.LinearRegressionModel",
     "LogisticRegression": "org.apache.spark.ml.classification.LogisticRegression",
     "LogisticRegressionModel": "org.apache.spark.ml.classification.LogisticRegressionModel",
     "LinearSVC": "org.apache.spark.ml.classification.LinearSVC",
     "LinearSVCModel": "org.apache.spark.ml.classification.LinearSVCModel",
+    "ALS": "org.apache.spark.ml.recommendation.ALS",
+    "ALSModel": "org.apache.spark.ml.recommendation.ALSModel",
     "Pipeline": "org.apache.spark.ml.Pipeline",
     "PipelineModel": "org.apache.spark.ml.PipelineModel",
     "GeneralizedLinearRegression":
@@ -93,6 +98,16 @@ _SPARK_PARAM_ALLOWLIST = {
     "LinearSVCModel": {"labelCol", "predictionCol", "rawPredictionCol",
                        "maxIter", "tol", "regParam", "fitIntercept",
                        "standardization", "threshold", "weightCol"},
+    "BisectingKMeans": {"k", "maxIter", "seed", "predictionCol",
+                        "minDivisibleClusterSize", "weightCol"},
+    "BisectingKMeansModel": {"k", "maxIter", "seed", "predictionCol",
+                             "minDivisibleClusterSize", "weightCol"},
+    "ALS": {"rank", "maxIter", "regParam", "implicitPrefs", "alpha",
+            "nonnegative", "userCol", "itemCol", "ratingCol",
+            "predictionCol", "coldStartStrategy", "seed",
+            "numUserBlocks", "numItemBlocks"},
+    "ALSModel": {"userCol", "itemCol", "predictionCol",
+                 "coldStartStrategy"},
     "StandardScaler": {"withMean", "withStd", "inputCol", "outputCol"},
     "StandardScalerModel": {"withMean", "withStd", "inputCol", "outputCol"},
     "GeneralizedLinearRegression": {
@@ -499,6 +514,61 @@ def load_fm_model(path: str):
     extras = meta.get("extra", {})
     model.num_iterations_ = int(extras.get("numIterations", 0))
     model.final_loss_ = float(extras.get("finalLoss", float("nan")))
+    return _restore_params(model, meta)
+
+
+def save_als_model(model, path: str, overwrite: bool = False) -> None:
+    """Spark ALSModel layout analogue: the two factor tables plus the
+    id vocabularies (Spark persists userFactors/itemFactors DataFrames;
+    one row with two matrices + two id vectors is the single-file
+    equivalent). Ids are float64-exact (validated < 2^53 at fit — Spark
+    itself restricts ALS ids to Integer range)."""
+    if model.user_factors is None:
+        raise ValueError("cannot save an unfitted ALSModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(
+        path, cls, model.uid, model.param_map_for_metadata(),
+        extra={"trainRmse": float(model.train_rmse_)})
+    row = {
+        "userFactors": _dense_matrix_struct(model.user_factors),
+        "itemFactors": _dense_matrix_struct(model.item_factors),
+        "userIds": _dense_vector_struct(
+            np.asarray(model.user_ids, dtype=np.float64)),
+        "itemIds": _dense_vector_struct(
+            np.asarray(model.item_ids, dtype=np.float64)),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("userFactors", _matrix_arrow_type()),
+            ("itemFactors", _matrix_arrow_type()),
+            ("userIds", _vector_arrow_type()),
+            ("itemIds", _vector_arrow_type()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("userFactors", "matrix"), ("itemFactors", "matrix"),
+        ("userIds", "vector"), ("itemIds", "vector"),
+    ])
+
+
+def load_als_model(path: str):
+    from spark_rapids_ml_tpu.models.als import ALSModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = ALSModel(
+        user_factors=_dense_matrix_from_struct(row["userFactors"]),
+        item_factors=_dense_matrix_from_struct(row["itemFactors"]),
+        user_ids=_dense_vector_from_struct(row["userIds"]),
+        item_ids=_dense_vector_from_struct(row["itemIds"]),
+        uid=meta["uid"],
+    )
+    model.train_rmse_ = float(
+        meta.get("extra", {}).get("trainRmse", float("nan")))
     return _restore_params(model, meta)
 
 

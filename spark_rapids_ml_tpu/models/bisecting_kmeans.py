@@ -172,11 +172,13 @@ class BisectingKMeansModel(BisectingKMeansParams):
 
     def computeCost(self, dataset) -> float:
         """Sum of squared distances to the nearest center."""
+        from spark_rapids_ml_tpu.models.kmeans import _sqdist
+
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())
-        d = ((x[:, None, :] - self.cluster_centers[None, :, :]) ** 2) \
-            .sum(axis=2)
-        return float(d.min(axis=1).sum())
+        # (n, k) expanded form — the (n, k, d) broadcast difference would
+        # be ~65 GB at the bench shapes (2M×64×64 f64)
+        return float(_sqdist(x, self.cluster_centers).min(axis=1).sum())
 
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_bkm_model

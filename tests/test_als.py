@@ -1,0 +1,241 @@
+"""ALS: normal-equation fixed-point oracle, implicit ranking, NNLS KKT,
+cold-start semantics, top-k recommendation, persistence.
+
+Oracle pattern per SURVEY.md §4: device results checked against NumPy
+closed forms at tight tolerances. The strongest check is the fixed-point
+one — the kernel's LAST half-sweep solves the item-side normal equations
+exactly, so each fitted item factor must satisfy
+``(Σ_u U_u U_uᵀ + λ n_i I) v_i = Σ_u r_ui U_u`` to solver precision.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import ALS, ALSModel
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _triples_frame(users, items, ratings):
+    return VectorFrame({
+        "user": list(np.asarray(users, dtype=np.int64)),
+        "item": list(np.asarray(items, dtype=np.int64)),
+        "rating": list(np.asarray(ratings, dtype=np.float64)),
+    })
+
+
+def _low_rank_triples(rng, n_users=20, n_items=15, rank=3, keep=1.0):
+    u_true = rng.normal(size=(n_users, rank))
+    v_true = rng.normal(size=(n_items, rank))
+    full = u_true @ v_true.T
+    uu, ii = np.meshgrid(np.arange(n_users), np.arange(n_items),
+                         indexing="ij")
+    uu, ii = uu.ravel(), ii.ravel()
+    if keep < 1.0:
+        sel = rng.random(uu.shape[0]) < keep
+        uu, ii = uu[sel], ii[sel]
+    return uu, ii, full[uu, ii]
+
+
+def test_reconstructs_low_rank_matrix(rng):
+    users, items, ratings = _low_rank_triples(rng)
+    model = ALS(rank=3, maxIter=15, regParam=1e-3, seed=1).fit(
+        _triples_frame(users, items, ratings))
+    pred = model.predict(users, items)
+    rmse = float(np.sqrt(np.mean((pred - ratings) ** 2)))
+    assert rmse < 0.05, rmse
+    assert model.train_rmse_ == pytest.approx(rmse, abs=1e-6)
+
+
+def test_item_factors_satisfy_normal_equations(rng):
+    users, items, ratings = _low_rank_triples(rng, keep=0.6)
+    reg = 0.07
+    model = ALS(rank=3, maxIter=5, regParam=reg, seed=3).fit(
+        _triples_frame(users, items, ratings))
+    u_idx = {int(v): j for j, v in enumerate(model.user_ids)}
+    for j, item_id in enumerate(model.item_ids):
+        sel = items == int(item_id)
+        rows = np.array([u_idx[int(u)] for u in users[sel]])
+        y = model.user_factors[rows]
+        a = y.T @ y + reg * len(rows) * np.eye(3)
+        b = y.T @ ratings[sel]
+        np.testing.assert_allclose(a @ model.item_factors[j], b,
+                                   atol=1e-6)
+
+
+def test_implicit_ranks_observed_above_unobserved(rng):
+    # two user groups, each consuming a disjoint item half
+    n_users, n_items = 30, 20
+    users, items = [], []
+    for u in range(n_users):
+        half = range(n_items // 2) if u < n_users // 2 else range(
+            n_items // 2, n_items)
+        for i in half:
+            if rng.random() < 0.7:
+                users.append(u)
+                items.append(i)
+    ratings = np.ones(len(users))
+    model = ALS(rank=4, maxIter=10, regParam=0.05, implicitPrefs=True,
+                alpha=10.0, seed=2).fit(
+        _triples_frame(users, items, ratings))
+    scores = model.user_factors @ model.item_factors.T
+    item_pos = {int(v): j for j, v in enumerate(model.item_ids)}
+    first_half = [item_pos[i] for i in range(n_items // 2)
+                  if i in item_pos]
+    second_half = [item_pos[i] for i in range(n_items // 2, n_items)
+                   if i in item_pos]
+    u0 = {int(v): j for j, v in enumerate(model.user_ids)}
+    group_a = [u0[u] for u in range(n_users // 2) if u in u0]
+    group_b = [u0[u] for u in range(n_users // 2, n_users) if u in u0]
+    assert scores[np.ix_(group_a, first_half)].mean() > \
+        scores[np.ix_(group_a, second_half)].mean() + 0.2
+    assert scores[np.ix_(group_b, second_half)].mean() > \
+        scores[np.ix_(group_b, first_half)].mean() + 0.2
+
+
+def test_implicit_negative_rating_is_confident_dislike(rng):
+    # Spark semantics: r < 0 contributes confidence alpha*|r| toward
+    # preference ZERO (NormalEquation b-weight 0 for r <= 0) — a
+    # disliked item must score BELOW an unrated one, never above
+    n_users, n_items = 24, 12
+    users, items, ratings = [], [], []
+    for u in range(n_users):
+        for i in range(n_items - 2):  # items 0..9 liked by everyone
+            if rng.random() < 0.8:
+                users.append(u)
+                items.append(i)
+                ratings.append(1.0)
+        # item 10 confidently disliked by all; item 11 never rated
+        users.append(u)
+        items.append(10)
+        ratings.append(-5.0)
+    model = ALS(rank=3, maxIter=10, regParam=0.05, implicitPrefs=True,
+                alpha=5.0, seed=9).fit(
+        _triples_frame(users, items, ratings))
+    item_pos = {int(v): j for j, v in enumerate(model.item_ids)}
+    scores = model.user_factors @ model.item_factors.T
+    disliked = scores[:, item_pos[10]].mean()
+    liked = scores[:, [item_pos[i] for i in range(10)]].mean()
+    assert liked > disliked + 0.3
+    assert disliked < 0.2  # pushed toward preference 0
+
+
+def test_nonnegative_factors_and_kkt(rng):
+    users, items, ratings = _low_rank_triples(rng)
+    ratings = np.abs(ratings)  # nonnegative target is representable
+    reg = 0.05
+    model = ALS(rank=3, maxIter=8, regParam=reg, nonnegative=True,
+                seed=4).fit(_triples_frame(users, items, ratings))
+    assert (model.user_factors >= 0).all()
+    assert (model.item_factors >= 0).all()
+    # KKT on the item side (last update): active coords solve exactly,
+    # clamped coords have nonnegative gradient
+    u_idx = {int(v): j for j, v in enumerate(model.user_ids)}
+    for j, item_id in enumerate(model.item_ids):
+        sel = items == int(item_id)
+        rows = np.array([u_idx[int(u)] for u in users[sel]])
+        y = model.user_factors[rows]
+        a = y.T @ y + reg * len(rows) * np.eye(3)
+        b = y.T @ ratings[sel]
+        v = model.item_factors[j]
+        grad = a @ v - b
+        assert np.all(grad[v > 1e-10] < 1e-4)
+        assert np.all(grad[v <= 1e-10] > -1e-4)
+
+
+def test_predict_matches_factor_dot(rng):
+    users, items, ratings = _low_rank_triples(rng, keep=0.5)
+    model = ALS(rank=2, maxIter=3, seed=0).fit(
+        _triples_frame(users, items, ratings))
+    u = int(model.user_ids[3])
+    i = int(model.item_ids[5])
+    expected = float(model.user_factors[3] @ model.item_factors[5])
+    assert model.predict([u], [i])[0] == pytest.approx(expected)
+
+
+def test_cold_start_nan_and_drop(rng):
+    users, items, ratings = _low_rank_triples(rng)
+    model = ALS(rank=2, maxIter=2, seed=0).fit(
+        _triples_frame(users, items, ratings))
+    test = _triples_frame([0, 999], [0, 0], [1.0, 1.0])
+    out = model.transform(test)
+    pred = np.asarray(out.column("prediction"))
+    assert np.isfinite(pred[0]) and np.isnan(pred[1])
+    model.set("coldStartStrategy", "drop")
+    out = model.transform(test)
+    assert len(out) == 1
+    assert np.isfinite(np.asarray(out.column("prediction"))).all()
+
+
+def test_recommend_matches_bruteforce_topk(rng):
+    users, items, ratings = _low_rank_triples(rng)
+    model = ALS(rank=3, maxIter=4, seed=5).fit(
+        _triples_frame(users, items, ratings))
+    recs = model.recommend_for_all_users(4)
+    scores = model.user_factors @ model.item_factors.T
+    rec_col = recs.column("recommendations")
+    for row, srow in zip(rec_col, scores):
+        got_ids = [int(i) for i, _ in row]
+        got_scores = [s for _, s in row]
+        order = np.argsort(-srow)[:4]
+        want_ids = [int(model.item_ids[j]) for j in order]
+        assert got_ids == want_ids
+        np.testing.assert_allclose(got_scores, srow[order], rtol=1e-5)
+        assert got_scores == sorted(got_scores, reverse=True)
+
+
+def test_recommend_for_user_subset(rng):
+    users, items, ratings = _low_rank_triples(rng)
+    model = ALS(rank=2, maxIter=3, seed=6).fit(
+        _triples_frame(users, items, ratings))
+    subset = [int(model.user_ids[2]), 424242]  # one seen, one unseen
+    recs = model.recommend_for_user_subset(subset, 3)
+    assert len(recs) == 1
+    assert int(np.asarray(recs.column("user"))[0]) == subset[0]
+
+
+def test_persistence_roundtrip(tmp_path, rng):
+    users, items, ratings = _low_rank_triples(rng, keep=0.7)
+    model = ALS(rank=3, maxIter=3, regParam=0.2, seed=7,
+                coldStartStrategy="drop").fit(
+        _triples_frame(users, items, ratings))
+    path = str(tmp_path / "als_model")
+    model.save(path)
+    loaded = ALSModel.load(path)
+    np.testing.assert_allclose(loaded.user_factors, model.user_factors)
+    np.testing.assert_allclose(loaded.item_factors, model.item_factors)
+    np.testing.assert_array_equal(loaded.user_ids, model.user_ids)
+    np.testing.assert_array_equal(loaded.item_ids, model.item_ids)
+    assert loaded.getRegParam() == 0.2
+    assert loaded.getColdStartStrategy() == "drop"
+    assert loaded.train_rmse_ == pytest.approx(model.train_rmse_)
+    # estimator round-trip (metadata only)
+    est_path = str(tmp_path / "als_est")
+    est = ALS(rank=5, implicitPrefs=True, alpha=3.0)
+    est.save(est_path)
+    est2 = ALS.load(est_path)
+    assert est2.getRank() == 5
+    assert est2.getImplicitPrefs() is True
+    assert est2.getAlpha() == 3.0
+
+
+def test_input_validation(rng):
+    with pytest.raises(ValueError, match="empty"):
+        ALS().fit(_triples_frame([], [], []))
+    with pytest.raises(ValueError, match="integer ids"):
+        ALS().fit(VectorFrame({
+            "user": [0.5, 1.0], "item": [0, 1], "rating": [1.0, 2.0]}))
+    with pytest.raises(ValueError, match="all ratings are zero"):
+        ALS(implicitPrefs=True).fit(
+            _triples_frame([0, 1], [0, 1], [0.0, 0.0]))
+
+
+def test_weighted_reg_changes_solution(rng):
+    # ALS-WR: a user with many ratings gets a proportionally larger
+    # ridge; reg=0 vs large reg must move the factors
+    users, items, ratings = _low_rank_triples(rng)
+    frame = _triples_frame(users, items, ratings)
+    m_small = ALS(rank=3, maxIter=5, regParam=1e-4, seed=8).fit(frame)
+    m_big = ALS(rank=3, maxIter=5, regParam=5.0, seed=8).fit(frame)
+    norm_small = np.linalg.norm(m_small.user_factors)
+    norm_big = np.linalg.norm(m_big.user_factors)
+    assert norm_big < 0.5 * norm_small
