@@ -1,0 +1,19 @@
+#!/bin/bash
+# Wave-2 wrapper: wait for the wave-1 orchestrator to finish (one claim
+# at a time), then retry the wave-2 single-process bench until it lands.
+cd /root/repo || exit 1
+export PYTHONPATH=/root/repo:/root/.axon_site
+OUT=/root/repo/records/r04
+mkdir -p "$OUT"
+
+while [ ! -f "$OUT/done" ]; do sleep 60; done
+
+for i in $(seq 1 36); do
+  echo "wave2 attempt $i start: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  python scripts/bench_r04_wave2.py >> "$OUT/loop.log" 2>&1
+  rc=$?
+  echo "wave2 attempt $i rc=$rc: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  [ -f "$OUT/wave2_done" ] && exit 0
+  sleep 300
+done
+echo "wave2 gave up: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"

@@ -1,0 +1,143 @@
+"""Round-4 wave-3: retry the UMAP half of the 200k scale demonstration.
+
+Wave 1's scale step recorded DBSCAN at 200k×64 (10.82s, tiled) but UMAP
+died at `block_until_ready` with UNAVAILABLE ("TPU device error") —
+either collateral from a concurrent claim or a real fault in the blocked
+UMAP path at this scale. This retry distinguishes the two: a clean pass
+lands the missing record; a repeat failure at the same spot is a bug.
+
+Single process, one claim; exit 2 when no chip (wrapper retries).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "records", "r04")
+sys.path.insert(0, REPO)
+
+
+def stamp() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def log(msg: str) -> None:
+    os.makedirs(OUT, exist_ok=True)
+    with open(os.path.join(OUT, "status.log"), "a") as f:
+        f.write(f"{msg}: {stamp()}\n")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "tpu")
+    log("wave3 probe start")
+    try:
+        import jax
+
+        device = jax.devices()[0]
+    except Exception as exc:  # noqa: BLE001
+        log(f"wave3 probe FAILED ({type(exc).__name__})")
+        return 2
+    if device.platform == "cpu":
+        log("wave3 probe FAILED (cpu backend)")
+        return 2
+    log("wave3 probe ok")
+
+    import numpy as np
+
+    from spark_rapids_ml_tpu.models.umap import UMAP
+
+    rows, cols, block, epochs = 200_000, 64, 4096, 50
+    rng = np.random.default_rng(0)
+    n_blobs = 16
+    centers = rng.normal(scale=12.0, size=(n_blobs, cols))
+    assign = rng.integers(0, n_blobs, size=rows)
+    x = centers[assign] + rng.normal(size=(rows, cols))
+
+    try:
+        t0 = time.perf_counter()
+        um = (UMAP().setNNeighbors(15).setNEpochs(epochs)
+              .setBlockRows(block).fit(x))
+        seconds = time.perf_counter() - t0
+        emb = np.asarray(um.embedding_)
+        assert np.isfinite(emb).all()
+        cent = np.stack([emb[assign == b].mean(axis=0)
+                         for b in range(n_blobs)])
+        intra = float(np.mean([
+            np.linalg.norm(emb[assign == b] - cent[b], axis=1).mean()
+            for b in range(n_blobs)]))
+        inter = float(np.linalg.norm(
+            cent[:, None, :] - cent[None, :, :], axis=-1
+        )[np.triu_indices(n_blobs, 1)].mean())
+        rec = {
+            "metric": f"UMAP.fit seconds ({rows}x{cols}, tiled "
+                      f"block={block}, epochs={epochs})",
+            "value": round(seconds, 2),
+            "unit": "seconds",
+            "rows": rows,
+            "platform": device.platform,
+            "device_kind": str(getattr(device, "device_kind", "?")),
+            "rows_per_sec": round(rows / seconds, 1),
+            "separation_ratio": round(inter / max(intra, 1e-9), 2),
+            "dense_equivalent_bytes": rows * rows * 4,
+            "fit_timings": um.fit_timings_,
+            "recorded_utc": stamp(),
+        }
+        assert inter > 1.15 * intra
+        with open(os.path.join(OUT, "scale_umap.json"), "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        log("wave3 umap ok")
+    except Exception as exc:  # noqa: BLE001
+        with open(os.path.join(OUT, "scale_umap.err"), "w") as f:
+            f.write(f"{type(exc).__name__}: {exc}\n")
+            f.write(traceback.format_exc())
+        log(f"wave3 umap FAILED ({type(exc).__name__})")
+        # a repeat UNAVAILABLE at the same spot is evidence of a real
+        # fault — still exit 0 so the wrapper doesn't burn retries on a
+        # deterministic failure (the .err file carries the verdict)
+    # Clean config-3 re-run: the wave-1 config3 record (03:24-03:45Z)
+    # overlapped a concurrent chip claim (an ALS verification drive), so
+    # its arms ran contended. This re-measure is the quiet-chip number.
+    log("wave3 config3 start")
+    import contextlib
+    import io
+
+    import bench
+
+    os.environ["BENCH_SKIP_PROBE"] = "1"
+    os.environ["BENCH_ROWS"] = "1048576"
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    except Exception as exc:  # noqa: BLE001
+        with open(os.path.join(OUT, "bench_config3_clean.err"), "w") as f:
+            f.write(f"{type(exc).__name__}: {exc}\n")
+            f.write(traceback.format_exc())
+        log("wave3 config3 FAILED")
+    else:
+        lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+        try:
+            rec = json.loads(lines[-1])
+            rec["recorded_utc"] = stamp()
+            rec["note"] = "quiet-chip re-measure of wave-1 config3"
+            lines[-1] = json.dumps(rec)
+        except Exception:  # noqa: BLE001
+            pass
+        with open(os.path.join(OUT, "bench_config3_clean.json"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+        log("wave3 config3 ok")
+
+    with open(os.path.join(OUT, "wave3_done"), "w") as f:
+        f.write(stamp() + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Wave-3 wrapper: after wave 2, retry the UMAP 200k record.
+cd /root/repo || exit 1
+export PYTHONPATH=/root/repo:/root/.axon_site
+OUT=/root/repo/records/r04
+mkdir -p "$OUT"
+
+while [ ! -f "$OUT/wave2_done" ]; do sleep 60; done
+
+for i in $(seq 1 24); do
+  echo "wave3 attempt $i start: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  python scripts/bench_r04_wave3.py >> "$OUT/loop.log" 2>&1
+  rc=$?
+  echo "wave3 attempt $i rc=$rc: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  [ -f "$OUT/wave3_done" ] && exit 0
+  sleep 300
+done

@@ -257,27 +257,31 @@ class ALSModel(_ALSParams):
         ids = target_ids[np.asarray(idx)]
         return ids, scores
 
+    @staticmethod
+    def _recs_frame(key_col: str, keys, ids, scores) -> VectorFrame:
+        """(keys, top-k ids, top-k scores) → Spark-shaped frame: one row
+        per key, `recommendations` = [(id, score), ...] best-first."""
+        return VectorFrame({
+            key_col: list(keys),
+            "recommendations": [list(map(tuple, zip(i, s)))
+                                for i, s in zip(ids, scores)],
+        })
+
     def recommend_for_all_users(self, num_items: int) -> VectorFrame:
         """Spark's ``recommendForAllUsers``: per user, top-N items as
         parallel (ids, scores) list columns."""
         self._require_fitted()
         ids, scores = self._recommend(self.user_factors, self.item_factors,
                                       self.item_ids, num_items)
-        return VectorFrame({
-            self.getUserCol(): list(self.user_ids),
-            "recommendations": [list(map(tuple, zip(i, s)))
-                                for i, s in zip(ids, scores)],
-        })
+        return self._recs_frame(self.getUserCol(), self.user_ids, ids,
+                                scores)
 
     def recommend_for_all_items(self, num_users: int) -> VectorFrame:
         self._require_fitted()
         ids, scores = self._recommend(self.item_factors, self.user_factors,
                                       self.user_ids, num_users)
-        return VectorFrame({
-            self.getItemCol(): list(self.item_ids),
-            "recommendations": [list(map(tuple, zip(i, s)))
-                                for i, s in zip(ids, scores)],
-        })
+        return self._recs_frame(self.getItemCol(), self.item_ids, ids,
+                                scores)
 
     def recommend_for_user_subset(self, users, num_items: int) -> VectorFrame:
         self._require_fitted()
@@ -287,11 +291,8 @@ class ALSModel(_ALSParams):
         ids, scores = self._recommend(self.user_factors[u[keep]],
                                       self.item_factors, self.item_ids,
                                       num_items)
-        return VectorFrame({
-            self.getUserCol(): list(users[keep]),
-            "recommendations": [list(map(tuple, zip(i, s)))
-                                for i, s in zip(ids, scores)],
-        })
+        return self._recs_frame(self.getUserCol(), users[keep], ids,
+                                scores)
 
     # Spark exposes userFactors/itemFactors as DataFrames(id, features)
     @property

@@ -37,8 +37,16 @@ from jax.experimental.pallas import tpu as pltpu
 # hi/lo split temps 4×(1024×512×2B) = 4 MB, f32 acc + output staging
 # ≈ 2 MB, mean/rowmul slivers — ≈ 17 MB total, past the 16 MB default
 # scoped limit, hence the vmem_limit_bytes override on the pallas_call.
-_BLOCK_N = 512
-_BLOCK_R = 1024
+_BLOCK_N = int(os.environ.get("TPUML_GRAM_BLOCK_N", "512"))
+_BLOCK_R = int(os.environ.get("TPUML_GRAM_BLOCK_R", "1024"))
+
+
+def gram_block_shape() -> "tuple[int, int]":
+    """Current production (block_n, block_r), read at call time so env
+    overrides (TPUML_GRAM_BLOCK_N/R) and bench monkeypatches reach the
+    streaming dispatch — Python binds keyword defaults at def time, so
+    callers that want the live constants must ask here."""
+    return _BLOCK_N, _BLOCK_R
 
 
 # One policy for "should this Gram use the Pallas kernel?" — shared by the

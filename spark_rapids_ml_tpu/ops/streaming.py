@@ -85,21 +85,34 @@ def finalize_stats(
     return PCAFitResult(components, evr, mean)
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def update_stats_fused(stats: GramStats, batch: jnp.ndarray) -> GramStats:
-    """``update_stats`` with the Gram computed by the Pallas symmetric
-    folded-grid kernel (``ops.pallas_gram``) instead of ``lax.dot_general``.
-    Requires tile-aligned batches (rows % _BLOCK_R == 0, an even number of
-    _BLOCK_N feature tiles) and no mask."""
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("bn", "br"))
+def _update_stats_fused_blocked(stats: GramStats, batch: jnp.ndarray,
+                                *, bn: int, br: int) -> GramStats:
     from spark_rapids_ml_tpu.ops.pallas_gram import fused_centered_gram
 
     b = batch.astype(stats.gram.dtype)
     zero_mean = jnp.zeros((b.shape[1],), dtype=b.dtype)
     ones = jnp.ones((b.shape[0],), dtype=b.dtype)
-    g = fused_centered_gram(b, zero_mean, ones)
+    g = fused_centered_gram(b, zero_mean, ones, block_n=bn, block_r=br)
     s = jnp.sum(b, axis=0)
     cnt = jnp.asarray(b.shape[0], dtype=jnp.int32)
     return GramStats(stats.gram + g, stats.col_sum + s, stats.count + cnt)
+
+
+def update_stats_fused(stats: GramStats, batch: jnp.ndarray) -> GramStats:
+    """``update_stats`` with the Gram computed by the Pallas symmetric
+    folded-grid kernel (``ops.pallas_gram``) instead of ``lax.dot_general``.
+    Requires tile-aligned batches (rows % block_r == 0, an even number of
+    block_n feature tiles) and no mask.
+
+    The block shape is read EAGERLY (outside jit) and passed as static
+    args — a `gram_block_shape()` call inside the traced body would bake
+    the first compile's shape into the jit cache and silently ignore
+    later env/bench overrides."""
+    from spark_rapids_ml_tpu.ops.pallas_gram import gram_block_shape
+
+    bn, br = gram_block_shape()
+    return _update_stats_fused_blocked(stats, batch, bn=bn, br=br)
 
 
 def _gram_platform(gram_acc) -> str:
@@ -125,14 +138,14 @@ def fused_update_applicable(gram_acc, batch, mask) -> bool:
         return False
     try:
         from spark_rapids_ml_tpu.ops.pallas_gram import (
-            _BLOCK_N,
-            _BLOCK_R,
+            gram_block_shape,
             pallas_gram_preferred,
         )
     except Exception:  # pallas unavailable on this JAX build
         return False
+    bn, br = gram_block_shape()
     rows, n = batch.shape
-    if rows % _BLOCK_R or n % _BLOCK_N or (n // _BLOCK_N) % 2:
+    if rows % br or n % bn or (n // bn) % 2:
         return False
     try:
         platform = _gram_platform(gram_acc)
@@ -214,13 +227,22 @@ def update_centered_gram(
     return gram_acc + gram(_masked(b, mask))
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _update_centered_gram_fused(gram_acc, batch, mean):
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("bn", "br"))
+def _update_centered_gram_fused_blocked(gram_acc, batch, mean, *, bn, br):
     from spark_rapids_ml_tpu.ops.pallas_gram import fused_centered_gram
 
     b = batch.astype(gram_acc.dtype)
     ones = jnp.ones((b.shape[0],), dtype=b.dtype)
-    return gram_acc + fused_centered_gram(b, mean.astype(b.dtype), ones)
+    return gram_acc + fused_centered_gram(b, mean.astype(b.dtype), ones,
+                                          block_n=bn, block_r=br)
+
+
+def _update_centered_gram_fused(gram_acc, batch, mean):
+    from spark_rapids_ml_tpu.ops.pallas_gram import gram_block_shape
+
+    bn, br = gram_block_shape()
+    return _update_centered_gram_fused_blocked(gram_acc, batch, mean,
+                                               bn=bn, br=br)
 
 
 def update_centered_gram_auto(gram_acc, batch, mean, mask=None):

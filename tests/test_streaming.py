@@ -154,3 +154,38 @@ def test_centered_gram_auto_matches_plain(rng, monkeypatch):
     a = update_centered_gram_auto(jnp.zeros((n, n), jnp.float32), batch, mean)
     b = update_centered_gram(jnp.zeros((n, n), jnp.float32), batch, mean)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_block_shape_reaches_fused_dispatch(monkeypatch):
+    """Block-shape overrides must reach the compiled kernel: the eager
+    wrapper reads gram_block_shape() per call and threads it as STATIC
+    jit args — a read inside the traced body would bake the first
+    compile's shape into the cache and silently ignore later overrides
+    (the bug the round-4 wave-2 A/B initially hit)."""
+    import spark_rapids_ml_tpu.ops.streaming as streaming
+    from spark_rapids_ml_tpu.ops import pallas_gram
+
+    seen = []
+
+    def fake_blocked(stats, batch, *, bn, br):
+        seen.append((bn, br))
+        return stats
+
+    monkeypatch.setattr(streaming, "_update_stats_fused_blocked",
+                        fake_blocked)
+    stats = streaming.init_stats(8, dtype=jnp.float32)
+    batch = jnp.zeros((4, 8), dtype=jnp.float32)
+
+    monkeypatch.setattr(pallas_gram, "_BLOCK_N", 512)
+    monkeypatch.setattr(pallas_gram, "_BLOCK_R", 1024)
+    streaming.update_stats_fused(stats, batch)
+    monkeypatch.setattr(pallas_gram, "_BLOCK_N", 1024)
+    streaming.update_stats_fused(stats, batch)
+    assert seen == [(512, 1024), (1024, 1024)]
+
+    # env override reaches gram_block_shape at import time is covered by
+    # the module reading os.environ; the call-time contract is the part
+    # that guards the A/B harness
+    monkeypatch.setattr(streaming, "_gram_platform", lambda acc: "tpu")
+    bn, br = pallas_gram.gram_block_shape()
+    assert (bn, br) == (1024, 1024)
