@@ -1,0 +1,107 @@
+"""Shared helpers for the round-4 chip bench orchestrators.
+
+One copy of the probe/log/record plumbing that bench_r04_once.py,
+bench_r04_wave2.py, and bench_r04_wave3.py previously each carried:
+keeping the probe contract (exit 2 → wrapper retries) and the
+"capture bench.main() stdout → annotate last JSON line → write record"
+sequence in one place means a fix lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import datetime
+import io
+import json
+import os
+import sys
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "records", "r04")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def stamp() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def log(msg: str) -> None:
+    os.makedirs(OUT, exist_ok=True)
+    with open(os.path.join(OUT, "status.log"), "a") as f:
+        f.write(f"{msg}: {stamp()}\n")
+
+
+def probe(tag: str):
+    """Claim the chip; return the device or None (caller exits 2 so the
+    wrapper loop retries). Forces the TPU backend — a silent CPU
+    fallback would burn the window measuring nothing."""
+    os.environ.setdefault("JAX_PLATFORMS", "tpu")
+    log(f"{tag} probe start")
+    try:
+        import jax
+
+        device = jax.devices()[0]
+    except Exception as exc:  # noqa: BLE001
+        log(f"{tag} probe FAILED ({type(exc).__name__})")
+        return None
+    if device.platform == "cpu":
+        log(f"{tag} probe FAILED (cpu backend)")
+        return None
+    log(f"{tag} probe ok")
+    return device
+
+
+def is_unavailable(exc: BaseException) -> bool:
+    """Chip-claim-lost errors (XLA UNAVAILABLE) — the caller should
+    abort and let the wrapper retry the whole window, NOT record the
+    failure as a per-step result."""
+    return "UNAVAILABLE" in f"{type(exc).__name__}: {exc}"
+
+
+def write_error(name: str, exc: BaseException) -> None:
+    with open(os.path.join(OUT, f"{name}.err"), "w") as f:
+        f.write(f"{type(exc).__name__}: {exc}\n")
+        f.write(traceback.format_exc())
+
+
+def run_bench_to_record(record_name: str, env: dict, annotate: dict,
+                        tag: str) -> bool:
+    """Run bench.main() under env overrides, annotate the final JSON
+    line, write records/r04/<record_name>. Returns success; raises
+    nothing (errors land in <record_name>.err). Chip-level UNAVAILABLE
+    re-raises so the caller can abort the window."""
+    import bench
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    except Exception as exc:  # noqa: BLE001
+        if is_unavailable(exc):
+            raise
+        write_error(record_name.removesuffix(".json"), exc)
+        log(f"{tag} FAILED")
+        return False
+    finally:
+        for k, val in saved.items():
+            if val is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = val
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    try:
+        rec = json.loads(lines[-1])
+        rec.update(annotate)
+        rec["recorded_utc"] = stamp()
+        lines[-1] = json.dumps(rec)
+    except Exception:  # noqa: BLE001 - keep raw text on parse issues
+        pass
+    with open(os.path.join(OUT, record_name), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    log(f"{tag} ok")
+    return True

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Wave-2b: the first wave-2 run measured every A/B arm through a stale
+# jit cache (block shape was read inside the traced body); after the
+# library fix, rerun the A/B + config-4 with real per-arm shapes.
+# Chains after wave 3 so only one claimant exists at a time.
+cd /root/repo || exit 1
+export PYTHONPATH=/root/repo:/root/.axon_site
+OUT=/root/repo/records/r04
+mkdir -p "$OUT"
+
+# gate: wave3_done, OR wave3's processes gone (its loop exhausted without
+# the marker). Never proceed while a wave-3 claimant may be live — two
+# concurrent claimants is the contention class that polluted wave-1's
+# config-3 record.
+while [ ! -f "$OUT/wave3_done" ] && pgrep -f bench_r04_wave3 > /dev/null; do
+  sleep 60
+done
+[ -f "$OUT/wave3_done" ] || \
+  echo "wave2b: wave3 exited without done marker; proceeding: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+rm -f "$OUT/wave2_done"
+
+for i in $(seq 1 24); do
+  echo "wave2b attempt $i start: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  python scripts/bench_r04_wave2.py >> "$OUT/loop.log" 2>&1
+  rc=$?
+  echo "wave2b attempt $i rc=$rc: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  [ -f "$OUT/wave2_done" ] && exit 0
+  sleep 300
+done

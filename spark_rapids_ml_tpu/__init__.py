@@ -89,6 +89,11 @@ from spark_rapids_ml_tpu.models.fm import (  # noqa: F401
     FMRegressor,
 )
 from spark_rapids_ml_tpu.models.als import ALS, ALSModel  # noqa: F401
+from spark_rapids_ml_tpu.models.lda import LDA, LDAModel  # noqa: F401
+from spark_rapids_ml_tpu.models.word2vec import (  # noqa: F401
+    Word2Vec,
+    Word2VecModel,
+)
 from spark_rapids_ml_tpu.models.text import (  # noqa: F401
     CountVectorizer,
     CountVectorizerModel,
@@ -209,6 +214,10 @@ __all__ = [
     "FMRegressor",
     "ALS",
     "ALSModel",
+    "LDA",
+    "LDAModel",
+    "Word2Vec",
+    "Word2VecModel",
     "FMRegressionModel",
     "FMClassifier",
     "FMClassificationModel",

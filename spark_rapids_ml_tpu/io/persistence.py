@@ -57,6 +57,10 @@ _SPARK_CLASS_ALIASES = {
     "LogisticRegressionModel": "org.apache.spark.ml.classification.LogisticRegressionModel",
     "LinearSVC": "org.apache.spark.ml.classification.LinearSVC",
     "LinearSVCModel": "org.apache.spark.ml.classification.LinearSVCModel",
+    "Word2Vec": "org.apache.spark.ml.feature.Word2Vec",
+    "Word2VecModel": "org.apache.spark.ml.feature.Word2VecModel",
+    "LDA": "org.apache.spark.ml.clustering.LDA",
+    "LDAModel": "org.apache.spark.ml.clustering.LocalLDAModel",
     "ALS": "org.apache.spark.ml.recommendation.ALS",
     "ALSModel": "org.apache.spark.ml.recommendation.ALSModel",
     "Pipeline": "org.apache.spark.ml.Pipeline",
@@ -98,6 +102,17 @@ _SPARK_PARAM_ALLOWLIST = {
     "LinearSVCModel": {"labelCol", "predictionCol", "rawPredictionCol",
                        "maxIter", "tol", "regParam", "fitIntercept",
                        "standardization", "threshold", "weightCol"},
+    "Word2Vec": {"vectorSize", "windowSize", "minCount", "maxIter",
+                 "stepSize", "seed", "maxSentenceLength", "numPartitions",
+                 "inputCol", "outputCol"},
+    "Word2VecModel": {"vectorSize", "windowSize", "minCount", "maxIter",
+                      "stepSize", "seed", "maxSentenceLength",
+                      "numPartitions", "inputCol", "outputCol"},
+    "LDA": {"k", "maxIter", "optimizer", "docConcentration",
+            "topicConcentration", "subsamplingRate", "learningOffset",
+            "learningDecay", "optimizeDocConcentration",
+            "topicDistributionCol", "seed"},
+    "LDAModel": {"k", "topicDistributionCol", "seed"},
     "BisectingKMeans": {"k", "maxIter", "seed", "predictionCol",
                         "minDivisibleClusterSize", "weightCol"},
     "BisectingKMeansModel": {"k", "maxIter", "seed", "predictionCol",
@@ -569,6 +584,95 @@ def load_als_model(path: str):
     )
     model.train_rmse_ = float(
         meta.get("extra", {}).get("trainRmse", float("nan")))
+    return _restore_params(model, meta)
+
+
+def save_word2vec_model(model, path: str, overwrite: bool = False) -> None:
+    """Word2Vec layout: vocabulary array + (vocab, dim) vector matrix
+    (Spark persists a wordVectors flat array + wordIndex map; one matrix
+    plus the word list is the single-file equivalent)."""
+    if model.vectors is None:
+        raise ValueError("cannot save an unfitted Word2VecModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(
+        path, cls, model.uid, model.param_map_for_metadata(),
+        extra={"numPairs": int(model.num_pairs_)})
+    row = {
+        "vocabulary": [str(t) for t in model.vocabulary],
+        "vectors": _dense_matrix_struct(model.vectors),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("vocabulary", pa.list_(pa.string())),
+            ("vectors", _matrix_arrow_type()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("vocabulary", "array<string>"), ("vectors", "matrix"),
+    ])
+
+
+def load_word2vec_model(path: str):
+    from spark_rapids_ml_tpu.models.word2vec import Word2VecModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = Word2VecModel(
+        vectors=_dense_matrix_from_struct(row["vectors"]),
+        vocabulary=[str(t) for t in row["vocabulary"]],
+        uid=meta["uid"],
+    )
+    model.num_pairs_ = int(meta.get("extra", {}).get("numPairs", 0))
+    return _restore_params(model, meta)
+
+
+def save_lda_model(model, path: str, overwrite: bool = False) -> None:
+    """LDA layout: topic-word λ matrix + learned α vector (Spark's
+    LocalLDAModel persists the same state: topicsMatrix +
+    docConcentration)."""
+    if model.topics is None:
+        raise ValueError("cannot save an unfitted LDAModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(
+        path, cls, model.uid, model.param_map_for_metadata(),
+        extra={"eta": float(model.eta), "numDocs": int(model.num_docs)})
+    row = {
+        "topics": _dense_matrix_struct(model.topics),
+        "alpha": _dense_vector_struct(
+            np.asarray(model.alpha, dtype=np.float64)),
+    }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("topics", _matrix_arrow_type()),
+            ("alpha", _vector_arrow_type()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("topics", "matrix"), ("alpha", "vector"),
+    ])
+
+
+def load_lda_model(path: str):
+    from spark_rapids_ml_tpu.models.lda import LDAModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    extras = meta.get("extra", {})
+    model = LDAModel(
+        topics=_dense_matrix_from_struct(row["topics"]),
+        alpha=_dense_vector_from_struct(row["alpha"]),
+        eta=float(extras.get("eta", 0.1)),
+        num_docs=int(extras.get("numDocs", 0)),
+        uid=meta["uid"],
+    )
     return _restore_params(model, meta)
 
 

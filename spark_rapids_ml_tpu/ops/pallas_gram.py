@@ -167,12 +167,6 @@ def _folded_triangle_maps(n_tiles):
     return _ij
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "interpret", "precision", "symmetric", "block_n", "block_r"
-    ),
-)
 def fused_centered_gram(
     x: jnp.ndarray,
     mean: jnp.ndarray,
@@ -180,8 +174,38 @@ def fused_centered_gram(
     interpret: bool = False,
     precision=None,
     symmetric: bool = True,
-    block_n: int = _BLOCK_N,
-    block_r: int = _BLOCK_R,
+    block_n: "int | None" = None,
+    block_r: "int | None" = None,
+) -> jnp.ndarray:
+    """Eager shim resolving block defaults at CALL time (None →
+    ``gram_block_shape()``) — def-time keyword defaults would freeze the
+    import-time constants and ignore env/bench overrides, the staleness
+    class the streaming wrappers guard against. See `_fused_centered_gram`
+    for the kernel contract."""
+    if block_n is None or block_r is None:
+        bn, br = gram_block_shape()
+        block_n = bn if block_n is None else block_n
+        block_r = br if block_r is None else block_r
+    return _fused_centered_gram(
+        x, mean, rowmul, interpret=interpret, precision=precision,
+        symmetric=symmetric, block_n=block_n, block_r=block_r)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "interpret", "precision", "symmetric", "block_n", "block_r"
+    ),
+)
+def _fused_centered_gram(
+    x: jnp.ndarray,
+    mean: jnp.ndarray,
+    rowmul: jnp.ndarray,
+    interpret: bool = False,
+    precision=None,
+    symmetric: bool = True,
+    block_n: int = 512,
+    block_r: int = 1024,
 ) -> jnp.ndarray:
     """``(diag(rowmul)·(X − mean))ᵀ (diag(rowmul)·(X − mean))`` in one pass.
 
@@ -282,7 +306,8 @@ def fused_centered_gram(
 
 
 def pad_for_fused_gram(x, mask=None, dtype=None,
-                       block_n: int = _BLOCK_N, block_r: int = _BLOCK_R):
+                       block_n: "int | None" = None,
+                       block_r: "int | None" = None):
     """Pad rows to ``block_r`` and features to ``block_n`` (the same
     block arguments ``fused_centered_gram`` takes); returns
     (x_padded, rowmask_padded, n_features_original).
@@ -293,6 +318,10 @@ def pad_for_fused_gram(x, mask=None, dtype=None,
     """
     import numpy as np
 
+    if block_n is None or block_r is None:
+        bn, br = gram_block_shape()
+        block_n = bn if block_n is None else block_n
+        block_r = br if block_r is None else block_r
     x = np.asarray(x)
     dtype = x.dtype if dtype is None else np.dtype(dtype)
     rows, n = x.shape
@@ -322,7 +351,9 @@ def covariance_fused(x, mask=None, mean_centering: bool = True,
     default device. Padding + dtype cast happen in a single host copy."""
     import numpy as np
 
-    x_p, rowmask, n = pad_for_fused_gram(x, mask, dtype=np.dtype(dtype))
+    bn, br = gram_block_shape()  # resolve ONCE so pad + kernel agree
+    x_p, rowmask, n = pad_for_fused_gram(x, mask, dtype=np.dtype(dtype),
+                                         block_n=bn, block_r=br)
     if device is not None:
         x_dev = jax.device_put(jnp.asarray(x_p), device)
         rowmask_dev = jax.device_put(jnp.asarray(rowmask), device)
@@ -336,6 +367,7 @@ def covariance_fused(x, mask=None, mean_centering: bool = True,
         mean = jnp.zeros((x_p.shape[1],), dtype=x_dev.dtype)
     scale = 1.0 / jnp.sqrt(jnp.maximum(cnt - 1.0, 1.0))
     cov_full = fused_centered_gram(
-        x_dev, mean, rowmask_dev * scale, interpret=interpret
+        x_dev, mean, rowmask_dev * scale, interpret=interpret,
+        block_n=bn, block_r=br,
     )
     return cov_full[:n, :n], mean[:n]

@@ -1,0 +1,59 @@
+"""Word2Vec device kernel: skip-gram negative-sampling SGD steps.
+
+The TPU-shaped replacement for Spark Word2Vec's hierarchical-softmax
+inner loop (see ``models/word2vec.py`` for the documented deviation):
+each step is a fixed-shape batch of embedding gathers, two batched
+contractions, and three scatter-adds, with negatives drawn on device
+from the unigram^{3/4} noise distribution. Embedding tables are donated,
+so the whole training run keeps exactly one (vocab, dim) pair resident.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("k_neg",))
+def sgns_batch_kernel(u, v, c_idx, ctx_idx, key, lr, noise_logits,
+                      k_neg: int):
+    """One negative-sampling SGD step over a (center, context) batch.
+
+    Returns (u, v, batch loss). Gradients follow Mikolov's SGNS:
+    ∂/∂u_c = (σ(u·v⁺)−1)·v⁺ + Σ_k σ(u·v⁻_k)·v⁻_k, symmetrical for v.
+    """
+    negs = jax.random.categorical(
+        key, noise_logits, shape=(c_idx.shape[0], k_neg))
+    uc = u[c_idx]                                   # (b, d)
+    vpos = v[ctx_idx]                               # (b, d)
+    vneg = v[negs]                                  # (b, K, d)
+    pos_score = jnp.sum(uc * vpos, axis=-1)
+    neg_score = jnp.einsum("bd,bkd->bk", uc, vneg)
+    gpos = jax.nn.sigmoid(pos_score) - 1.0          # (b,)
+    gneg = jax.nn.sigmoid(neg_score)                # (b, K)
+    guc = gpos[:, None] * vpos + jnp.einsum("bk,bkd->bd", gneg, vneg)
+    loss = -(jax.nn.log_sigmoid(pos_score).sum()
+             + jax.nn.log_sigmoid(-neg_score).sum())
+    # Per-word gradient AVERAGING: the reference word2vec applies pair
+    # updates sequentially, but a batched scatter-add SUMS every colliding
+    # contribution — on a small vocabulary hundreds of pairs hit the same
+    # row per batch and the summed step diverges. Dividing each row's
+    # accumulated gradient by its batch occurrence count keeps the
+    # per-row step at O(lr) for any batch/vocab ratio.
+    ones = jnp.ones_like(c_idx, dtype=u.dtype)
+    cnt_u = jnp.zeros((u.shape[0],), u.dtype).at[c_idx].add(ones)
+    cnt_v = (jnp.zeros((v.shape[0],), v.dtype)
+             .at[ctx_idx].add(ones)
+             .at[negs.reshape(-1)].add(1.0))
+    cnt_u = jnp.maximum(cnt_u, 1.0)
+    cnt_v = jnp.maximum(cnt_v, 1.0)
+    u = u.at[c_idx].add(-lr * guc / cnt_u[c_idx][:, None])
+    v = v.at[ctx_idx].add(
+        -lr * gpos[:, None] * uc / cnt_v[ctx_idx][:, None])
+    neg_flat = negs.reshape(-1)
+    v = v.at[neg_flat].add(
+        -lr * (gneg[..., None] * uc[:, None, :]).reshape(-1, uc.shape[1])
+        / cnt_v[neg_flat][:, None])
+    return u, v, loss

@@ -1,0 +1,139 @@
+"""LDA: planted-topic recovery, transform posterior concentration,
+perplexity monotonicity, describeTopics shape, persistence.
+
+Oracle pattern per SURVEY.md §4: synthetic corpora with disjoint
+vocabulary blocks per topic — variational Bayes must recover the block
+structure (top terms of each learned topic lie in one planted block)
+and document posteriors must concentrate on the planting topic.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import LDA, LDAModel
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _planted_corpus(rng, n_docs=120, vocab=60, k=3, doc_len=80):
+    """Each doc draws ~95% of its tokens from one topic's vocab block."""
+    block = vocab // k
+    counts = np.zeros((n_docs, vocab))
+    labels = np.zeros(n_docs, dtype=int)
+    for d in range(n_docs):
+        topic = d % k
+        labels[d] = topic
+        main = rng.integers(topic * block, (topic + 1) * block,
+                            size=int(doc_len * 0.95))
+        noise = rng.integers(0, vocab, size=doc_len - main.size)
+        for w in np.concatenate([main, noise]):
+            counts[d, w] += 1
+    return counts, labels
+
+
+def _frame(counts):
+    return VectorFrame({"features": counts})
+
+
+@pytest.mark.parametrize("optimizer", ["online", "em"])
+def test_recovers_planted_topic_blocks(rng, optimizer):
+    counts, _ = _planted_corpus(rng)
+    k, vocab = 3, counts.shape[1]
+    block = vocab // k
+    model = LDA(k=k, maxIter=25, optimizer=optimizer, seed=1,
+                subsamplingRate=0.25, learningOffset=10.0).fit(
+        _frame(counts))
+    topics = model.describe_topics(max_terms=10)
+    blocks_hit = set()
+    for terms in topics.column("termIndices"):
+        owners = [t // block for t in terms]
+        # every learned topic's top terms concentrate in ONE block
+        top_block = max(set(owners), key=owners.count)
+        assert owners.count(top_block) >= 8, owners
+        blocks_hit.add(top_block)
+    assert blocks_hit == {0, 1, 2}  # all planted topics recovered
+
+
+def test_transform_concentrates_on_planted_topic(rng):
+    counts, labels = _planted_corpus(rng)
+    model = LDA(k=3, maxIter=25, seed=2, subsamplingRate=0.25,
+                learningOffset=10.0).fit(_frame(counts))
+    out = model.transform(_frame(counts))
+    dist = np.asarray(out.column("topicDistribution"))
+    assert dist.shape == (counts.shape[0], 3)
+    np.testing.assert_allclose(dist.sum(axis=1), 1.0, atol=1e-6)
+    # documents planted on the same topic agree on their argmax; the
+    # learned topic ids are a permutation of the planted ones
+    arg = dist.argmax(axis=1)
+    perm = {}
+    for planted in range(3):
+        votes = arg[labels == planted]
+        winner = np.bincount(votes, minlength=3).argmax()
+        frac = (votes == winner).mean()
+        assert frac > 0.9, (planted, frac)
+        perm[planted] = winner
+    assert len(set(perm.values())) == 3
+
+
+def test_more_iterations_improve_perplexity(rng):
+    counts, _ = _planted_corpus(rng, n_docs=90)
+    frame = _frame(counts)
+    short = LDA(k=3, maxIter=1, seed=3, subsamplingRate=0.5,
+                learningOffset=10.0).fit(frame)
+    long = LDA(k=3, maxIter=20, seed=3, subsamplingRate=0.5,
+               learningOffset=10.0).fit(frame)
+    assert long.log_perplexity(frame) < short.log_perplexity(frame)
+    # the bound is a log-likelihood: negative, finite
+    ll = long.log_likelihood(frame)
+    assert np.isfinite(ll) and ll < 0
+
+
+def test_topics_matrix_is_column_stochastic(rng):
+    counts, _ = _planted_corpus(rng, n_docs=60)
+    model = LDA(k=3, maxIter=5, seed=4).fit(_frame(counts))
+    tm = model.topics_matrix()
+    assert tm.shape == (counts.shape[1], 3)
+    np.testing.assert_allclose(tm.sum(axis=0), 1.0, atol=1e-6)
+    assert model.vocab_size == counts.shape[1]
+
+
+def test_optimize_doc_concentration_moves_alpha(rng):
+    counts, _ = _planted_corpus(rng, n_docs=90)
+    fixed = LDA(k=3, maxIter=10, seed=5, learningOffset=10.0,
+                optimizeDocConcentration=False).fit(_frame(counts))
+    learned = LDA(k=3, maxIter=10, seed=5, learningOffset=10.0,
+                  optimizeDocConcentration=True).fit(_frame(counts))
+    np.testing.assert_allclose(fixed.alpha, 1.0 / 3, atol=1e-12)
+    assert not np.allclose(learned.alpha, 1.0 / 3)
+    assert (learned.alpha > 0).all()
+
+
+def test_persistence_roundtrip(tmp_path, rng):
+    counts, _ = _planted_corpus(rng, n_docs=60)
+    model = LDA(k=3, maxIter=5, seed=6, topicConcentration=0.2).fit(
+        _frame(counts))
+    path = str(tmp_path / "lda_model")
+    model.save(path)
+    loaded = LDAModel.load(path)
+    np.testing.assert_allclose(loaded.topics, model.topics)
+    np.testing.assert_allclose(loaded.alpha, model.alpha)
+    assert loaded.eta == pytest.approx(model.eta)
+    assert loaded.num_docs == model.num_docs
+    # loaded model transforms identically
+    a = np.asarray(model.transform(_frame(counts))
+                   .column("topicDistribution"))
+    b = np.asarray(loaded.transform(_frame(counts))
+                   .column("topicDistribution"))
+    np.testing.assert_allclose(a, b, atol=1e-8)
+    est = LDA(k=7, optimizer="em")
+    est_path = str(tmp_path / "lda_est")
+    est.save(est_path)
+    est2 = LDA.load(est_path)
+    assert est2.getK() == 7
+    assert est2.get_or_default("optimizer") == "em"
+
+
+def test_input_validation(rng):
+    with pytest.raises(ValueError, match="nonnegative"):
+        LDA(k=2).fit(_frame(np.array([[1.0, -2.0]])))
+    with pytest.raises(ValueError, match="empty"):
+        LDA(k=2).fit(_frame(np.zeros((0, 4))))

@@ -183,9 +183,5 @@ def test_block_shape_reaches_fused_dispatch(monkeypatch):
     streaming.update_stats_fused(stats, batch)
     assert seen == [(512, 1024), (1024, 1024)]
 
-    # env override reaches gram_block_shape at import time is covered by
-    # the module reading os.environ; the call-time contract is the part
-    # that guards the A/B harness
-    monkeypatch.setattr(streaming, "_gram_platform", lambda acc: "tpu")
     bn, br = pallas_gram.gram_block_shape()
     assert (bn, br) == (1024, 1024)

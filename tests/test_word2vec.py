@@ -1,0 +1,113 @@
+"""Word2Vec: co-occurrence-cluster synonym recovery, transform
+averaging oracle, vocabulary/minCount semantics, persistence.
+
+Oracle pattern per SURVEY.md §4: a synthetic corpus with two disjoint
+co-occurrence clusters — negative-sampling skip-gram must place
+same-cluster words closer (cosine) than cross-cluster words, and
+``transform`` must equal the NumPy mean of member vectors exactly.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import Word2Vec, Word2VecModel
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+A_WORDS = ["apple", "banana", "cherry", "date", "elder"]
+B_WORDS = ["wrench", "hammer", "pliers", "drill", "saw"]
+
+
+def _cluster_corpus(rng, n_sents=300, sent_len=8):
+    """Sentences draw all tokens from ONE cluster's vocabulary."""
+    sents = []
+    for i in range(n_sents):
+        words = A_WORDS if i % 2 == 0 else B_WORDS
+        sents.append(list(rng.choice(words, size=sent_len)))
+    return VectorFrame({"text": sents})
+
+
+def _fit(rng, **over):
+    params = dict(vectorSize=16, windowSize=3, minCount=1, maxIter=20,
+                  seed=7, inputCol="text", batchSize=512, stepSize=0.2)
+    params.update(over)
+    return Word2Vec(**params).fit(_cluster_corpus(rng))
+
+
+def test_synonyms_respect_cooccurrence_clusters(rng):
+    model = _fit(rng)
+    syn = model.find_synonyms("apple", 4)
+    words = list(syn.column("word"))
+    assert set(words) == set(A_WORDS) - {"apple"}, words
+    sims = list(syn.column("similarity"))
+    assert sims == sorted(sims, reverse=True)
+    # cross-cluster similarity is strictly lower than in-cluster
+    all_syn = model.find_synonyms("apple", 9)
+    ranked = list(all_syn.column("word"))
+    assert set(ranked[:4]) == set(A_WORDS) - {"apple"}
+
+
+def test_find_synonyms_excludes_query_and_validates(rng):
+    model = _fit(rng)
+    syn = model.find_synonyms("hammer", 9)
+    assert "hammer" not in list(syn.column("word"))
+    with pytest.raises(KeyError, match="not in the vocabulary"):
+        model.find_synonyms("unseen", 3)
+
+
+def test_transform_is_mean_of_member_vectors(rng):
+    model = _fit(rng)
+    vf = VectorFrame({"text": [["apple", "banana"],
+                               ["saw"],
+                               ["apple", "zzz-unknown"],
+                               ["zzz-unknown"]]})
+    out = np.asarray(model.transform(vf).column("w2v_features"))
+    vec = {w: model.vectors[model._index[w]]
+           for w in ("apple", "banana", "saw")}
+    np.testing.assert_allclose(
+        out[0], (vec["apple"] + vec["banana"]) / 2, atol=1e-12)
+    np.testing.assert_allclose(out[1], vec["saw"], atol=1e-12)
+    np.testing.assert_allclose(out[2], vec["apple"], atol=1e-12)
+    np.testing.assert_allclose(out[3], np.zeros(16), atol=0)
+
+
+def test_min_count_prunes_vocabulary(rng):
+    frame = VectorFrame({"text": [["a", "a", "a", "b"],
+                                  ["a", "b", "a", "a"]]})
+    model = Word2Vec(vectorSize=4, minCount=3, maxIter=1, seed=0,
+                     inputCol="text", windowSize=2).fit(frame)
+    assert model.vocabulary == ["a"]
+    with pytest.raises(ValueError, match="minCount"):
+        Word2Vec(vectorSize=4, minCount=99, inputCol="text").fit(frame)
+
+
+def test_get_vectors_frame(rng):
+    model = _fit(rng)
+    gv = model.get_vectors()
+    assert sorted(gv.column("word")) == sorted(A_WORDS + B_WORDS)
+    assert np.asarray(gv.column("vector")).shape == (10, 16)
+
+
+def test_persistence_roundtrip(tmp_path, rng):
+    model = _fit(rng, maxIter=2)
+    path = str(tmp_path / "w2v_model")
+    model.save(path)
+    loaded = Word2VecModel.load(path)
+    np.testing.assert_allclose(loaded.vectors, model.vectors)
+    assert loaded.vocabulary == model.vocabulary
+    syn_a = list(model.find_synonyms("apple", 3).column("word"))
+    syn_b = list(loaded.find_synonyms("apple", 3).column("word"))
+    assert syn_a == syn_b
+    est = Word2Vec(vectorSize=32, windowSize=2, inputCol="text")
+    est_path = str(tmp_path / "w2v_est")
+    est.save(est_path)
+    est2 = Word2Vec.load(est_path)
+    assert est2.get_or_default("vectorSize") == 32
+    assert est2.getWindowSize() == 2
+
+
+def test_string_sentences_are_split(rng):
+    frame = VectorFrame({"text": ["red green red green red",
+                                  "red green red red green"]})
+    model = Word2Vec(vectorSize=4, minCount=1, maxIter=1, seed=0,
+                     inputCol="text", windowSize=2).fit(frame)
+    assert sorted(model.vocabulary) == ["green", "red"]
