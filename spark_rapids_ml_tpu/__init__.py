@@ -94,6 +94,15 @@ from spark_rapids_ml_tpu.models.word2vec import (  # noqa: F401
     Word2Vec,
     Word2VecModel,
 )
+from spark_rapids_ml_tpu.models.decision_tree import (  # noqa: F401
+    DecisionTreeClassificationModel,
+    DecisionTreeClassifier,
+    DecisionTreeRegressionModel,
+    DecisionTreeRegressor,
+)
+from spark_rapids_ml_tpu.models.pic import (  # noqa: F401
+    PowerIterationClustering,
+)
 from spark_rapids_ml_tpu.models.text import (  # noqa: F401
     CountVectorizer,
     CountVectorizerModel,
@@ -218,6 +227,11 @@ __all__ = [
     "LDAModel",
     "Word2Vec",
     "Word2VecModel",
+    "DecisionTreeClassifier",
+    "DecisionTreeClassificationModel",
+    "DecisionTreeRegressor",
+    "DecisionTreeRegressionModel",
+    "PowerIterationClustering",
     "FMRegressionModel",
     "FMClassifier",
     "FMClassificationModel",

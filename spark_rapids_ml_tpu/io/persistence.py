@@ -57,6 +57,16 @@ _SPARK_CLASS_ALIASES = {
     "LogisticRegressionModel": "org.apache.spark.ml.classification.LogisticRegressionModel",
     "LinearSVC": "org.apache.spark.ml.classification.LinearSVC",
     "LinearSVCModel": "org.apache.spark.ml.classification.LinearSVCModel",
+    "DecisionTreeClassifier":
+        "org.apache.spark.ml.classification.DecisionTreeClassifier",
+    "DecisionTreeClassificationModel":
+        "org.apache.spark.ml.classification.DecisionTreeClassificationModel",
+    "DecisionTreeRegressor":
+        "org.apache.spark.ml.regression.DecisionTreeRegressor",
+    "DecisionTreeRegressionModel":
+        "org.apache.spark.ml.regression.DecisionTreeRegressionModel",
+    "PowerIterationClustering":
+        "org.apache.spark.ml.clustering.PowerIterationClustering",
     "Word2Vec": "org.apache.spark.ml.feature.Word2Vec",
     "Word2VecModel": "org.apache.spark.ml.feature.Word2VecModel",
     "LDA": "org.apache.spark.ml.clustering.LDA",
@@ -102,6 +112,20 @@ _SPARK_PARAM_ALLOWLIST = {
     "LinearSVCModel": {"labelCol", "predictionCol", "rawPredictionCol",
                        "maxIter", "tol", "regParam", "fitIntercept",
                        "standardization", "threshold", "weightCol"},
+    "DecisionTreeClassifier": {
+        "maxDepth", "maxBins", "minInstancesPerNode", "labelCol",
+        "predictionCol", "probabilityCol", "seed", "weightCol"},
+    "DecisionTreeClassificationModel": {
+        "maxDepth", "maxBins", "minInstancesPerNode", "labelCol",
+        "predictionCol", "probabilityCol", "seed", "weightCol"},
+    "DecisionTreeRegressor": {
+        "maxDepth", "maxBins", "minInstancesPerNode", "labelCol",
+        "predictionCol", "seed", "weightCol"},
+    "DecisionTreeRegressionModel": {
+        "maxDepth", "maxBins", "minInstancesPerNode", "labelCol",
+        "predictionCol", "seed", "weightCol"},
+    "PowerIterationClustering": {
+        "k", "maxIter", "initMode", "srcCol", "dstCol", "weightCol"},
     "Word2Vec": {"vectorSize", "windowSize", "minCount", "maxIter",
                  "stepSize", "seed", "maxSentenceLength", "numPartitions",
                  "inputCol", "outputCol"},

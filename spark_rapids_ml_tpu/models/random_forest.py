@@ -137,6 +137,9 @@ def _subset_counts(strategy, d: int, classification: bool = False) -> int:
 
 class _ForestBase(RandomForestParams):
     _classification = False
+    # single-tree subclasses (DecisionTree*) turn the Poisson bootstrap
+    # off: Spark's DecisionTree trains on the full unweighted sample
+    _bootstrap = True
 
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_params
@@ -227,7 +230,8 @@ class _ForestBase(RandomForestParams):
         with timer.phase("grow"), TraceRange("forest grow", TraceColor.RED):
             rate = float(self.getSubsamplingRate())
             for _ in range(self.getNumTrees()):
-                w_np = rng.poisson(rate, n).astype(np.float64)
+                w_np = (rng.poisson(rate, n).astype(np.float64)
+                        if self._bootstrap else np.ones(n))
                 if user_w is not None:
                     w_np *= user_w
                 w = jax.device_put(jnp.asarray(w_np, dtype=dtype), device)

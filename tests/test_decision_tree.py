@@ -1,0 +1,163 @@
+"""DecisionTree (single-tree) + PowerIterationClustering.
+
+DecisionTree: determinism (no bootstrap), sklearn-style purity on
+separable data, debug-string structure, persistence through the shared
+forest wire format. PIC: two-component graphs cluster exactly; degree
+init; input validation.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    DecisionTreeClassificationModel,
+    DecisionTreeClassifier,
+    DecisionTreeRegressionModel,
+    DecisionTreeRegressor,
+    PowerIterationClustering,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _separable(rng, n=400):
+    x = rng.normal(size=(n, 4))
+    y = (x[:, 1] > 0.3).astype(np.float64)
+    return x, y
+
+
+def test_classifier_fits_separable_split(rng):
+    x, y = _separable(rng)
+    model = DecisionTreeClassifier(maxDepth=3).fit(x, y)
+    pred = np.asarray(
+        model.transform(VectorFrame({"features": x, "label": y}))
+        .column("prediction"))
+    assert (pred == y).mean() > 0.98
+    assert model.depth_ == 3
+    assert model.num_nodes_ == 2 ** 4 - 1
+
+
+def test_single_tree_is_deterministic(rng):
+    x, y = _separable(rng)
+    a = DecisionTreeClassifier(maxDepth=4, seed=1).fit(x, y)
+    b = DecisionTreeClassifier(maxDepth=4, seed=99).fit(x, y)
+    # no bootstrap + all features ⇒ the seed cannot change the tree
+    np.testing.assert_array_equal(np.asarray(a.ensemble_.feature),
+                                  np.asarray(b.ensemble_.feature))
+    np.testing.assert_array_equal(np.asarray(a.ensemble_.threshold),
+                                  np.asarray(b.ensemble_.threshold))
+
+
+def test_regressor_fits_piecewise_constant(rng):
+    x = rng.normal(size=(500, 3))
+    y = np.where(x[:, 0] > 0, 5.0, -5.0)
+    model = DecisionTreeRegressor(maxDepth=2).fit(x, y)
+    pred = np.asarray(
+        model.transform(VectorFrame({"features": x, "label": y}))
+        .column("prediction"))
+    assert np.mean((pred - y) ** 2) < 0.5
+
+
+def test_debug_string_mentions_split_feature(rng):
+    x, y = _separable(rng)
+    model = DecisionTreeClassifier(maxDepth=2).fit(x, y)
+    text = model.to_debug_string()
+    assert "If (feature 1 <=" in text  # the separating feature
+    assert "Predict:" in text
+    assert text.count("Else") == text.count("If")
+
+
+def test_persistence_roundtrip(tmp_path, rng):
+    x, y = _separable(rng)
+    model = DecisionTreeClassifier(maxDepth=3).fit(x, y)
+    path = str(tmp_path / "dt")
+    model.save(path)
+    loaded = DecisionTreeClassificationModel.load(path)
+    assert isinstance(loaded, DecisionTreeClassificationModel)
+    np.testing.assert_array_equal(np.asarray(loaded.ensemble_.feature),
+                                  np.asarray(model.ensemble_.feature))
+    assert loaded.to_debug_string() == model.to_debug_string()
+    # regressor round-trip
+    yr = x[:, 0] * 2.0
+    reg = DecisionTreeRegressor(maxDepth=2).fit(x, yr)
+    rpath = str(tmp_path / "dtr")
+    reg.save(rpath)
+    rl = DecisionTreeRegressionModel.load(rpath)
+    assert isinstance(rl, DecisionTreeRegressionModel)
+    xs = x[:20]
+    np.testing.assert_allclose(
+        np.asarray(rl.transform(VectorFrame({"features": xs}))
+                   .column("prediction")),
+        np.asarray(reg.transform(VectorFrame({"features": xs}))
+                   .column("prediction")))
+
+
+def test_single_tree_pins_are_enforced():
+    with pytest.raises(ValueError, match="pins numTrees=1"):
+        DecisionTreeClassifier(numTrees=5)
+    with pytest.raises(ValueError, match="single-tree contract"):
+        DecisionTreeRegressor().set("featureSubsetStrategy", "sqrt")
+    # the pinned values themselves are accepted (idempotent)
+    DecisionTreeClassifier(numTrees=1, maxDepth=2)
+
+
+def test_debug_string_collapses_pure_subtrees(rng):
+    # maxDepth much deeper than the data needs: pure nodes become
+    # pass-through sentinels and must NOT print fabricated splits with
+    # unreachable Else branches
+    x = rng.normal(size=(200, 2))
+    y = (x[:, 0] > 0).astype(np.float64)
+    model = DecisionTreeClassifier(maxDepth=6).fit(x, y)
+    text = model.to_debug_string()
+    assert text.count("If") == text.count("Else")
+    # a depth-6 complete tree would print 63 Ifs; the collapsed render
+    # prints only real splits (at least the root, far fewer than 63)
+    assert 1 <= text.count("If (") < 63
+
+
+def _two_component_edges():
+    # clique {0,1,2} and the LARGER clique {10,11,12,13}, one weak
+    # bridge. Asymmetric sizes matter for initMode='degree': on a
+    # perfectly regular graph the degree vector IS W's stationary
+    # distribution, so the power iteration has no transient to cluster.
+    edges = [(0, 1, 1.0), (1, 2, 1.0), (0, 2, 1.0),
+             (10, 11, 1.0), (11, 12, 1.0), (10, 12, 1.0),
+             (10, 13, 1.0), (11, 13, 1.0), (12, 13, 1.0),
+             (2, 10, 0.01)]
+    src, dst, w = zip(*edges)
+    return VectorFrame({"src": list(src), "dst": list(dst),
+                        "weight": list(w)})
+
+
+@pytest.mark.parametrize("init", ["random", "degree"])
+def test_pic_separates_two_cliques(init):
+    pic = PowerIterationClustering(k=2, maxIter=30, weightCol="weight",
+                                  initMode=init, seed=3)
+    out = pic.assign_clusters(_two_component_edges())
+    ids = np.asarray(out.column("id"))
+    clusters = np.asarray(out.column("cluster"))
+    by_id = dict(zip(ids, clusters))
+    a = {by_id[i] for i in (0, 1, 2)}
+    b = {by_id[i] for i in (10, 11, 12, 13)}
+    assert len(a) == 1 and len(b) == 1 and a != b
+
+
+def test_pic_self_loop_counts_once():
+    # degree of vertex 0 = self-loop(2) + edge(1) = 3, not 5
+    pic = PowerIterationClustering(k=2, weightCol="weight")
+    frame = VectorFrame({"src": [0, 0], "dst": [0, 1],
+                         "weight": [2.0, 1.0]})
+    out = pic.assign_clusters(frame)
+    assert sorted(out.column("id")) == [0, 1]
+    assert all(isinstance(i, int) for i in out.column("id"))
+
+
+def test_pic_validation():
+    with pytest.raises(ValueError, match="empty"):
+        PowerIterationClustering(k=2).assign_clusters(
+            VectorFrame({"src": [], "dst": []}))
+    with pytest.raises(ValueError, match="nonnegative"):
+        PowerIterationClustering(k=2, weightCol="weight").assign_clusters(
+            VectorFrame({"src": [0], "dst": [1], "weight": [-1.0]}))
+    with pytest.raises(ValueError, match="maxDenseNodes"):
+        PowerIterationClustering(k=2, maxDenseNodes=2).assign_clusters(
+            VectorFrame({"src": [0, 1, 2], "dst": [1, 2, 0]}))
