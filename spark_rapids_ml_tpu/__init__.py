@@ -103,6 +103,12 @@ from spark_rapids_ml_tpu.models.decision_tree import (  # noqa: F401
 from spark_rapids_ml_tpu.models.pic import (  # noqa: F401
     PowerIterationClustering,
 )
+from spark_rapids_ml_tpu.models.lsh import (  # noqa: F401
+    BucketedRandomProjectionLSH,
+    BucketedRandomProjectionLSHModel,
+    MinHashLSH,
+    MinHashLSHModel,
+)
 from spark_rapids_ml_tpu.models.text import (  # noqa: F401
     CountVectorizer,
     CountVectorizerModel,
@@ -117,6 +123,7 @@ from spark_rapids_ml_tpu.models.text import (  # noqa: F401
 from spark_rapids_ml_tpu.stat import (  # noqa: F401
     ChiSquareTest,
     Correlation,
+    KolmogorovSmirnovTest,
     Summarizer,
 )
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel  # noqa: F401
@@ -154,7 +161,9 @@ from spark_rapids_ml_tpu.models.imputer import Imputer, ImputerModel  # noqa: F4
 from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel  # noqa: F401
 from spark_rapids_ml_tpu.models.evaluation import (  # noqa: F401
     BinaryClassificationEvaluator,
+    ClusteringEvaluator,
     MulticlassClassificationEvaluator,
+    RankingEvaluator,
     RegressionEvaluator,
 )
 from spark_rapids_ml_tpu.models.tuning import (  # noqa: F401
@@ -188,6 +197,9 @@ __all__ = [
     "GaussianMixture",
     "GaussianMixtureModel",
     "Correlation",
+    "KolmogorovSmirnovTest",
+    "ClusteringEvaluator",
+    "RankingEvaluator",
     "ChiSquareTest",
     "Summarizer",
     "MultilayerPerceptronClassifier",
@@ -232,6 +244,10 @@ __all__ = [
     "DecisionTreeRegressor",
     "DecisionTreeRegressionModel",
     "PowerIterationClustering",
+    "BucketedRandomProjectionLSH",
+    "BucketedRandomProjectionLSHModel",
+    "MinHashLSH",
+    "MinHashLSHModel",
     "FMRegressionModel",
     "FMClassifier",
     "FMClassificationModel",

@@ -69,6 +69,12 @@ _SPARK_CLASS_ALIASES = {
         "org.apache.spark.ml.clustering.PowerIterationClustering",
     "Word2Vec": "org.apache.spark.ml.feature.Word2Vec",
     "Word2VecModel": "org.apache.spark.ml.feature.Word2VecModel",
+    "BucketedRandomProjectionLSH":
+        "org.apache.spark.ml.feature.BucketedRandomProjectionLSH",
+    "BucketedRandomProjectionLSHModel":
+        "org.apache.spark.ml.feature.BucketedRandomProjectionLSHModel",
+    "MinHashLSH": "org.apache.spark.ml.feature.MinHashLSH",
+    "MinHashLSHModel": "org.apache.spark.ml.feature.MinHashLSHModel",
     "LDA": "org.apache.spark.ml.clustering.LDA",
     "LDAModel": "org.apache.spark.ml.clustering.LocalLDAModel",
     "ALS": "org.apache.spark.ml.recommendation.ALS",
@@ -126,6 +132,13 @@ _SPARK_PARAM_ALLOWLIST = {
         "predictionCol", "seed", "weightCol"},
     "PowerIterationClustering": {
         "k", "maxIter", "initMode", "srcCol", "dstCol", "weightCol"},
+    "BucketedRandomProjectionLSH": {
+        "inputCol", "outputCol", "numHashTables", "bucketLength", "seed"},
+    "BucketedRandomProjectionLSHModel": {
+        "inputCol", "outputCol", "numHashTables", "bucketLength", "seed"},
+    "MinHashLSH": {"inputCol", "outputCol", "numHashTables", "seed"},
+    "MinHashLSHModel": {"inputCol", "outputCol", "numHashTables",
+                        "seed"},
     "Word2Vec": {"vectorSize", "windowSize", "minCount", "maxIter",
                  "stepSize", "seed", "maxSentenceLength", "numPartitions",
                  "inputCol", "outputCol"},
@@ -608,6 +621,79 @@ def load_als_model(path: str):
     )
     model.train_rmse_ = float(
         meta.get("extra", {}).get("trainRmse", float("nan")))
+    return _restore_params(model, meta)
+
+
+def save_lsh_model(model, path: str, overwrite: bool = False) -> None:
+    """LSH models: random-projection matrix + bucketLength (BRP) or the
+    universal-hash coefficient pair (MinHash) — Spark persists the
+    equivalent randUnitVectors / randCoefficients."""
+    from spark_rapids_ml_tpu.models.lsh import (
+        BucketedRandomProjectionLSHModel,
+    )
+
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    if isinstance(model, BucketedRandomProjectionLSHModel):
+        if model.projections is None:
+            raise ValueError("cannot save an unfitted LSH model")
+        _write_metadata(
+            path, cls, model.uid, model.param_map_for_metadata(),
+            extra={"bucketLength": float(model.bucket_length)})
+        row = {
+            "projections": _dense_matrix_struct(model.projections),
+            "coeffA": _dense_vector_struct(np.zeros(0)),
+            "coeffB": _dense_vector_struct(np.zeros(0)),
+        }
+    else:
+        if model.coeff_a is None:
+            raise ValueError("cannot save an unfitted LSH model")
+        _write_metadata(path, cls, model.uid,
+                        model.param_map_for_metadata())
+        row = {
+            "projections": _dense_matrix_struct(np.zeros((0, 0))),
+            "coeffA": _dense_vector_struct(
+                np.asarray(model.coeff_a, dtype=np.float64)),
+            "coeffB": _dense_vector_struct(
+                np.asarray(model.coeff_b, dtype=np.float64)),
+        }
+    try:
+        import pyarrow as pa
+
+        schema = pa.schema([
+            ("projections", _matrix_arrow_type()),
+            ("coeffA", _vector_arrow_type()),
+            ("coeffB", _vector_arrow_type()),
+        ])
+    except ImportError:  # pragma: no cover
+        schema = None
+    _write_data_row(path, row, schema=schema, spark_fields=[
+        ("projections", "matrix"), ("coeffA", "vector"),
+        ("coeffB", "vector"),
+    ])
+
+
+def load_lsh_model(path: str):
+    import importlib
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    dotted = meta.get("pythonClass") or meta["class"]
+    module_name, cls_name = dotted.rsplit(".", 1)
+    model_cls = getattr(importlib.import_module(module_name), cls_name)
+    coeff_a = _dense_vector_from_struct(row["coeffA"])
+    if coeff_a.size:
+        model = model_cls(
+            coeff_a=coeff_a.astype(np.int64),
+            coeff_b=_dense_vector_from_struct(
+                row["coeffB"]).astype(np.int64),
+            uid=meta["uid"])
+    else:
+        model = model_cls(
+            projections=_dense_matrix_from_struct(row["projections"]),
+            bucket_length=float(
+                meta.get("extra", {}).get("bucketLength", 2.0)),
+            uid=meta["uid"])
     return _restore_params(model, meta)
 
 

@@ -181,3 +181,159 @@ class MulticlassClassificationEvaluator(Params):
         f1 = np.where(denom > 0, 2 * precision * recall
                       / np.maximum(denom, 1e-300), 0.0)
         return float((weights * f1).sum())
+
+
+class ClusteringEvaluator(Params):
+    """Silhouette over (featuresCol, predictionCol) — Spark's
+    ``ml.evaluation.ClusteringEvaluator`` (metricName='silhouette',
+    distanceMeasure 'squaredEuclidean' default | 'cosine').
+
+    Uses Spark's own aggregate trick rather than O(n²) pairwise
+    distances: with per-cluster sums ``S_C = Σy`` and squared norms
+    ``Q_C = Σ‖y‖²``, the total squared distance from point i to cluster
+    C is ``n_C·‖x_i‖² − 2·x_i·S_C + Q_C`` — so the whole silhouette is
+    one (n, d)×(d, k) matmul plus O(n·k) elementwise work. The cosine
+    variant applies the same identity to L2-normalized rows.
+    """
+
+    featuresCol = Param("featuresCol", "feature vector column",
+                        "features")
+    predictionCol = Param("predictionCol", "cluster id column",
+                          "prediction")
+    metricName = Param("metricName", "silhouette", "silhouette",
+                       validator=lambda v: v == "silhouette")
+    distanceMeasure = Param(
+        "distanceMeasure", "squaredEuclidean | cosine",
+        "squaredEuclidean",
+        validator=lambda v: v in ("squaredEuclidean", "cosine"))
+
+    def __init__(self, uid=None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+    def is_larger_better(self) -> bool:
+        return True
+
+    def evaluate(self, dataset) -> float:
+        frame = as_vector_frame(dataset, self.get_or_default("featuresCol"))
+        x = frame.vectors_as_matrix(self.get_or_default("featuresCol"))
+        labels = np.asarray(
+            frame.column(self.get_or_default("predictionCol")))
+        if x.shape[0] < 2:
+            raise ValueError("silhouette needs at least 2 points")
+        if self.get_or_default("distanceMeasure") == "cosine":
+            norms = np.linalg.norm(x, axis=1, keepdims=True)
+            if (norms == 0).any():
+                raise ValueError(
+                    "cosine distance undefined for zero vectors")
+            x = x / norms
+        clusters, inv = np.unique(labels, return_inverse=True)
+        k = len(clusters)
+        if k < 2:
+            raise ValueError("silhouette needs at least 2 clusters")
+        n_c = np.bincount(inv, minlength=k).astype(np.float64)
+        # per-cluster aggregates
+        s_c = np.zeros((k, x.shape[1]))
+        np.add.at(s_c, inv, x)
+        sq = (x * x).sum(axis=1)
+        q_c = np.zeros(k)
+        np.add.at(q_c, inv, sq)
+        # total squared distance from each point to each cluster:
+        # (n, k) = n_C·‖x‖² − 2·X·S_Cᵀ + Q_C
+        tot = (n_c[None, :] * sq[:, None] - 2.0 * (x @ s_c.T)
+               + q_c[None, :])
+        own = inv
+        n_own = n_c[own]
+        # a(i): mean distance to OTHER members of own cluster
+        a = np.where(n_own > 1,
+                     tot[np.arange(len(x)), own] / np.maximum(
+                         n_own - 1, 1.0),
+                     0.0)
+        mean_others = tot / n_c[None, :]
+        mean_others[np.arange(len(x)), own] = np.inf
+        b = mean_others.min(axis=1)
+        s = np.where(n_own > 1, (b - a) / np.maximum(a, b), 0.0)
+        # singleton clusters score 0 (sklearn/Spark convention)
+        return float(s.mean())
+
+
+class RankingEvaluator(Params):
+    """Spark 3.0 ``ml.evaluation.RankingEvaluator`` over array columns:
+    predictionCol holds ranked predicted ids, labelCol the relevant-id
+    ground truth. meanAveragePrecision (default) / precisionAtK /
+    ndcgAtK / recallAtK / meanAveragePrecisionAtK with param ``k``."""
+
+    labelCol = Param("labelCol", "ground-truth id arrays", "label")
+    predictionCol = Param("predictionCol", "ranked predicted id arrays",
+                          "prediction")
+    metricName = Param(
+        "metricName",
+        "meanAveragePrecision | meanAveragePrecisionAtK | precisionAtK "
+        "| ndcgAtK | recallAtK",
+        "meanAveragePrecision",
+        validator=lambda v: v in (
+            "meanAveragePrecision", "meanAveragePrecisionAtK",
+            "precisionAtK", "ndcgAtK", "recallAtK"))
+    k = Param("k", "ranking cutoff for the @K metrics", 10,
+              validator=lambda v: isinstance(v, int) and v >= 1)
+
+    def __init__(self, uid=None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+    def is_larger_better(self) -> bool:
+        return True
+
+    @staticmethod
+    def _avg_precision(pred, truth, cutoff, denom) -> float:
+        if not truth:
+            return 0.0
+        hits = 0
+        score = 0.0
+        for rank, p in enumerate(pred[:cutoff]):
+            if p in truth:
+                hits += 1
+                score += hits / (rank + 1.0)
+        return score / denom
+
+    def evaluate(self, dataset) -> float:
+        frame = as_vector_frame(dataset, self.getPredictionCol())
+        preds = frame.column(self.getPredictionCol())
+        labels = frame.column(self.getLabelCol())
+        name = self.getMetricName()
+        k = int(self.get_or_default("k"))
+        scores = []
+        for pred, truth in zip(preds, labels):
+            pred = list(pred)
+            truth = set(truth)
+            if name == "meanAveragePrecision":
+                # Spark's RankingMetrics: precSum / labSet.size — a
+                # truth set longer than the prediction list still
+                # divides by its FULL size (unreturned relevant items
+                # count against the score)
+                scores.append(self._avg_precision(
+                    pred, truth, len(pred), max(len(truth), 1)))
+            elif name == "meanAveragePrecisionAtK":
+                scores.append(self._avg_precision(
+                    pred, truth, k,
+                    min(max(len(truth), 1), k)))
+            elif name == "precisionAtK":
+                top = pred[:k]
+                scores.append(
+                    sum(p in truth for p in top) / float(k))
+            elif name == "recallAtK":
+                top = pred[:k]
+                scores.append(
+                    sum(p in truth for p in top)
+                    / max(len(truth), 1) if truth else 0.0)
+            else:  # ndcgAtK (binary relevance, Spark semantics)
+                dcg = sum(
+                    1.0 / np.log2(rank + 2.0)
+                    for rank, p in enumerate(pred[:k]) if p in truth)
+                ideal = sum(
+                    1.0 / np.log2(rank + 2.0)
+                    for rank in range(min(len(truth), k)))
+                scores.append(dcg / ideal if ideal > 0 else 0.0)
+        return float(np.mean(scores)) if scores else 0.0

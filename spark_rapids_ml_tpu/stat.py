@@ -258,3 +258,57 @@ class Summarizer:
             "normL1": acc["l1"],
             "normL2": np.sqrt(acc["s2"]),
         }
+
+
+class KolmogorovSmirnovTest:
+    """``ml.stat.KolmogorovSmirnovTest`` parity: one-sample, two-sided
+    KS test of a numeric column against a theoretical distribution.
+
+    ``test(dataset, sampleCol, "norm", mean, std)`` mirrors Spark's
+    surface (Spark supports 'norm' plus a user CDF; a Python callable
+    CDF is accepted here the way Spark accepts a lambda). Returns a
+    one-row frame (pValue, statistic). The p-value uses the asymptotic
+    Kolmogorov distribution Q(√n·D) with the Stephens √n correction —
+    the same approximation Spark inherits from commons-math.
+    """
+
+    @staticmethod
+    def test(dataset, sampleCol: str, distName="norm", *params):
+        from spark_rapids_ml_tpu.data.frame import (
+            VectorFrame,
+            as_vector_frame,
+        )
+
+        frame = as_vector_frame(dataset, sampleCol)
+        x = np.sort(np.asarray(frame.column(sampleCol),
+                               dtype=np.float64))
+        n = x.shape[0]
+        if n == 0:
+            raise ValueError("cannot run the KS test on an empty column")
+        if callable(distName):
+            cdf_vals = np.asarray([distName(v) for v in x],
+                                  dtype=np.float64)
+        elif distName == "norm":
+            mean = float(params[0]) if len(params) > 0 else 0.0
+            std = float(params[1]) if len(params) > 1 else 1.0
+            if std <= 0:
+                raise ValueError("std must be positive")
+            from spark_rapids_ml_tpu.ops.glm_kernel import _ndtr
+
+            cdf_vals = np.asarray(_ndtr(np, (x - mean) / std),
+                                  dtype=np.float64)
+        else:
+            raise ValueError(
+                f"unsupported distName {distName!r}: 'norm' or a "
+                "callable CDF")
+        ecdf_hi = np.arange(1, n + 1) / n
+        ecdf_lo = np.arange(0, n) / n
+        d = float(np.maximum(np.abs(ecdf_hi - cdf_vals),
+                             np.abs(cdf_vals - ecdf_lo)).max())
+        # asymptotic two-sided p-value: Q(t) = 2 Σ (−1)^{j−1} e^{−2 j² t²}
+        # with the Stephens finite-n correction
+        t = d * (np.sqrt(n) + 0.12 + 0.11 / np.sqrt(n))
+        terms = [2.0 * (-1.0) ** (j - 1) * np.exp(-2.0 * j * j * t * t)
+                 for j in range(1, 101)]
+        p = float(min(max(sum(terms), 0.0), 1.0))
+        return VectorFrame({"pValue": [p], "statistic": [d]})

@@ -1,0 +1,133 @@
+"""LSH: bucket-collision statistics, approx-NN exactness on recovered
+candidates, similarity-join thresholds, MinHash Jaccard properties,
+persistence.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    BucketedRandomProjectionLSH,
+    BucketedRandomProjectionLSHModel,
+    MinHashLSH,
+    MinHashLSHModel,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _blobs(rng, n=60, d=8, sep=30.0):
+    a = rng.normal(size=(n // 2, d))
+    b = rng.normal(size=(n // 2, d)) + sep
+    return np.vstack([a, b])
+
+
+def test_brp_transform_shape_and_floor(rng):
+    x = _blobs(rng)
+    model = BucketedRandomProjectionLSH(
+        bucketLength=1.0, numHashTables=4, seed=1,
+        inputCol="features").fit(VectorFrame({"features": x}))
+    out = model.transform(VectorFrame({"features": x}))
+    h = np.asarray(out.column("hashes"))
+    assert h.shape == (60, 4)
+    np.testing.assert_array_equal(h, np.floor(
+        x @ model.projections / model.bucket_length))
+
+
+def test_brp_nearby_points_collide_far_points_do_not(rng):
+    x = _blobs(rng, sep=100.0)
+    model = BucketedRandomProjectionLSH(
+        bucketLength=4.0, numHashTables=2, seed=0,
+        inputCol="features").fit(VectorFrame({"features": x}))
+    h = model._hashes(x)
+    same_blob = np.abs(h[0] - h[1:30]).min(axis=1)
+    other_blob = np.abs(h[0] - h[30:]).min(axis=1)
+    assert same_blob.mean() < other_blob.mean()
+
+
+def test_brp_approx_nn_returns_true_nearest(rng):
+    x = _blobs(rng)
+    frame = VectorFrame({"features": x})
+    model = BucketedRandomProjectionLSH(
+        bucketLength=2.0, numHashTables=6, seed=2,
+        inputCol="features").fit(frame)
+    key = x[7] + 0.01
+    out = model.approx_nearest_neighbors(frame, key, 3)
+    d = np.asarray(out.column("distCol"))
+    assert d.shape == (3,)
+    assert (np.diff(d) >= 0).all()
+    # the true nearest point must be found (it shares buckets at this L)
+    true_d = np.linalg.norm(x - key[None, :], axis=1)
+    np.testing.assert_allclose(d[0], np.sort(true_d)[0], atol=1e-9)
+
+
+def test_brp_similarity_join_threshold(rng):
+    xa = rng.normal(size=(20, 5))
+    xb = np.vstack([xa[:5] + 0.001, rng.normal(size=(10, 5)) + 50.0])
+    model = BucketedRandomProjectionLSH(
+        bucketLength=2.0, numHashTables=5, seed=3,
+        inputCol="features").fit(VectorFrame({"features": xa}))
+    out = model.approx_similarity_join(
+        VectorFrame({"features": xa}), VectorFrame({"features": xb}),
+        threshold=0.1)
+    ids_a = list(out.column("idA"))
+    ids_b = list(out.column("idB"))
+    assert set(zip(ids_a, ids_b)) >= {(i, i) for i in range(5)}
+    assert all(d <= 0.1 for d in out.column("distCol"))
+
+
+def test_minhash_jaccard_distance_and_collisions(rng):
+    # identical sets hash identically in EVERY table
+    x = np.zeros((4, 12))
+    x[0, [0, 1, 2]] = 1
+    x[1, [0, 1, 2]] = 1           # same set as row 0
+    x[2, [0, 1, 2, 3]] = 1        # jaccard dist 0.25 to row 0
+    x[3, [8, 9, 10, 11]] = 1      # disjoint from row 0
+    model = MinHashLSH(numHashTables=8, seed=4, inputCol="features").fit(
+        VectorFrame({"features": x}))
+    h = model._hashes(x)
+    np.testing.assert_array_equal(h[0], h[1])
+    d = model._key_distance(x[[0, 0, 0]], x[[1, 2, 3]])
+    np.testing.assert_allclose(d, [0.0, 0.25, 1.0])
+
+
+def test_minhash_rejects_empty_sets(rng):
+    x = np.zeros((2, 6))
+    x[0, 0] = 1
+    with pytest.raises(ValueError, match="empty sets"):
+        MinHashLSH(inputCol="features").fit(VectorFrame({"features": x}))
+
+
+def test_minhash_approx_nn(rng):
+    d = 30
+    x = (rng.random((40, d)) < 0.3).astype(np.float64)
+    x[x.sum(axis=1) == 0, 0] = 1
+    frame = VectorFrame({"features": x})
+    model = MinHashLSH(numHashTables=5, seed=5,
+                       inputCol="features").fit(frame)
+    out = model.approx_nearest_neighbors(frame, x[3], 2)
+    dist = np.asarray(out.column("distCol"))
+    assert dist[0] == 0.0  # the key itself is in the dataset
+
+
+def test_lsh_persistence_roundtrip(tmp_path, rng):
+    x = _blobs(rng)
+    frame = VectorFrame({"features": x})
+    brp = BucketedRandomProjectionLSH(
+        bucketLength=1.5, numHashTables=3, seed=6,
+        inputCol="features").fit(frame)
+    p1 = str(tmp_path / "brp")
+    brp.save(p1)
+    l1 = BucketedRandomProjectionLSHModel.load(p1)
+    np.testing.assert_allclose(l1.projections, brp.projections)
+    assert l1.bucket_length == brp.bucket_length
+    np.testing.assert_array_equal(l1._hashes(x), brp._hashes(x))
+
+    xb = (rng.random((10, 8)) < 0.4).astype(np.float64)
+    xb[xb.sum(axis=1) == 0, 0] = 1
+    mh = MinHashLSH(numHashTables=4, seed=7, inputCol="features").fit(
+        VectorFrame({"features": xb}))
+    p2 = str(tmp_path / "mh")
+    mh.save(p2)
+    l2 = MinHashLSHModel.load(p2)
+    np.testing.assert_array_equal(l2.coeff_a, mh.coeff_a)
+    np.testing.assert_array_equal(l2._hashes(xb), mh._hashes(xb))
