@@ -103,6 +103,11 @@ from spark_rapids_ml_tpu.models.decision_tree import (  # noqa: F401
 from spark_rapids_ml_tpu.models.pic import (  # noqa: F401
     PowerIterationClustering,
 )
+from spark_rapids_ml_tpu.models.fpm import (  # noqa: F401
+    FPGrowth,
+    FPGrowthModel,
+    PrefixSpan,
+)
 from spark_rapids_ml_tpu.models.lsh import (  # noqa: F401
     BucketedRandomProjectionLSH,
     BucketedRandomProjectionLSHModel,
@@ -248,6 +253,9 @@ __all__ = [
     "BucketedRandomProjectionLSHModel",
     "MinHashLSH",
     "MinHashLSHModel",
+    "FPGrowth",
+    "FPGrowthModel",
+    "PrefixSpan",
     "FMRegressionModel",
     "FMClassifier",
     "FMClassificationModel",

@@ -75,6 +75,9 @@ _SPARK_CLASS_ALIASES = {
         "org.apache.spark.ml.feature.BucketedRandomProjectionLSHModel",
     "MinHashLSH": "org.apache.spark.ml.feature.MinHashLSH",
     "MinHashLSHModel": "org.apache.spark.ml.feature.MinHashLSHModel",
+    "FPGrowth": "org.apache.spark.ml.fpm.FPGrowth",
+    "FPGrowthModel": "org.apache.spark.ml.fpm.FPGrowthModel",
+    "PrefixSpan": "org.apache.spark.ml.fpm.PrefixSpan",
     "LDA": "org.apache.spark.ml.clustering.LDA",
     "LDAModel": "org.apache.spark.ml.clustering.LocalLDAModel",
     "ALS": "org.apache.spark.ml.recommendation.ALS",
@@ -139,6 +142,12 @@ _SPARK_PARAM_ALLOWLIST = {
     "MinHashLSH": {"inputCol", "outputCol", "numHashTables", "seed"},
     "MinHashLSHModel": {"inputCol", "outputCol", "numHashTables",
                         "seed"},
+    "FPGrowth": {"itemsCol", "minSupport", "minConfidence",
+                 "numPartitions", "predictionCol"},
+    "FPGrowthModel": {"itemsCol", "minSupport", "minConfidence",
+                      "numPartitions", "predictionCol"},
+    "PrefixSpan": {"minSupport", "maxPatternLength",
+                   "maxLocalProjDBSize", "sequenceCol"},
     "Word2Vec": {"vectorSize", "windowSize", "minCount", "maxIter",
                  "stepSize", "seed", "maxSentenceLength", "numPartitions",
                  "inputCol", "outputCol"},
@@ -621,6 +630,36 @@ def load_als_model(path: str):
     )
     model.train_rmse_ = float(
         meta.get("extra", {}).get("trainRmse", float("nan")))
+    return _restore_params(model, meta)
+
+
+def save_fpgrowth_model(model, path: str, overwrite: bool = False) -> None:
+    """FPGrowthModel: the mined (items, freq) pairs as one JSON payload
+    column (items are JSON scalars — str/int/float — matching the
+    practical domain of Spark's item type)."""
+    if model.itemsets is None:
+        raise ValueError("cannot save an unfitted FPGrowthModel")
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(
+        path, cls, model.uid, model.param_map_for_metadata(),
+        extra={"numBaskets": int(model.num_baskets)})
+    payload = json.dumps([[list(s), int(c)] for s, c in model.itemsets])
+    _write_data_row(path, {"itemsets": payload})
+
+
+def load_fpgrowth_model(path: str):
+    from spark_rapids_ml_tpu.models.fpm import FPGrowthModel
+
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    itemsets = [(tuple(s), int(c))
+                for s, c in json.loads(row["itemsets"])]
+    model = FPGrowthModel(
+        itemsets=itemsets,
+        num_baskets=int(meta.get("extra", {}).get("numBaskets", 0)),
+        uid=meta["uid"],
+    )
     return _restore_params(model, meta)
 
 

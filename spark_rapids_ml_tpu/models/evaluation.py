@@ -253,8 +253,15 @@ class ClusteringEvaluator(Params):
         mean_others = tot / n_c[None, :]
         mean_others[np.arange(len(x)), own] = np.inf
         b = mean_others.min(axis=1)
-        s = np.where(n_own > 1, (b - a) / np.maximum(a, b), 0.0)
-        # singleton clusters score 0 (sklearn/Spark convention)
+        denom = np.maximum(a, b)
+        with np.errstate(invalid="ignore"):
+            ratio = np.where(denom > 0, (b - a) / np.where(
+                denom > 0, denom, 1.0), 0.0)
+        # singleton clusters AND coincident-duplicate points (a=b=0)
+        # score 0, the sklearn/Spark convention — a bare (b−a)/max(a,b)
+        # would put NaN into the mean for exact duplicates split
+        # across clusters
+        s = np.where(n_own > 1, ratio, 0.0)
         return float(s.mean())
 
 

@@ -139,3 +139,24 @@ def test_ks_callable_cdf(rng):
     with pytest.raises(ValueError, match="unsupported distName"):
         KolmogorovSmirnovTest.test(
             VectorFrame({"sample": [1.0]}), "sample", "poisson")
+
+
+def test_ks_perfect_fit_large_n_pvalue_one():
+    scipy_special = pytest.importorskip("scipy.special")
+    # evenly spaced uniform quantiles: the closest possible fit; the
+    # truncated alternating series used to report p≈0 here at n≥1e4
+    n = 100_000
+    x = (np.arange(n) + 0.5) / n
+    out = KolmogorovSmirnovTest.test(
+        VectorFrame({"sample": list(x)}), "sample",
+        lambda v: min(max(v, 0.0), 1.0))
+    assert out.column("statistic")[0] < 1e-4
+    assert out.column("pValue")[0] > 0.999
+    del scipy_special
+
+
+def test_silhouette_coincident_duplicates_zero_not_nan():
+    x = np.zeros((4, 2))
+    score = ClusteringEvaluator().evaluate(
+        VectorFrame({"features": x, "prediction": [0, 0, 1, 1]}))
+    assert score == 0.0

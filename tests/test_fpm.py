@@ -1,0 +1,137 @@
+"""FPGrowth + PrefixSpan against hand-computed oracles.
+
+The FPGrowth corpus is the Spark fpm documentation example (baskets of
+1/2/5), whose frequent itemsets and rules are known exactly; PrefixSpan
+uses the classic Pei et al. sequence database.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import FPGrowth, FPGrowthModel, PrefixSpan
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def _spark_doc_baskets():
+    return VectorFrame({"items": [
+        ["1", "2", "5"],
+        ["1", "2", "3", "5"],
+        ["1", "2"],
+    ]})
+
+
+def test_fpgrowth_frequent_itemsets_exact():
+    model = FPGrowth(minSupport=0.5, minConfidence=0.6).fit(
+        _spark_doc_baskets())
+    freq = {frozenset(s): c for s, c in zip(
+        model.freq_itemsets().column("items"),
+        model.freq_itemsets().column("freq"))}
+    expected = {
+        frozenset(["1"]): 3, frozenset(["2"]): 3,
+        frozenset(["5"]): 2,
+        frozenset(["1", "2"]): 3, frozenset(["1", "5"]): 2,
+        frozenset(["2", "5"]): 2, frozenset(["1", "2", "5"]): 2,
+    }
+    assert freq == expected
+
+
+def test_fpgrowth_association_rules_confidence_and_lift():
+    model = FPGrowth(minSupport=0.5, minConfidence=0.6).fit(
+        _spark_doc_baskets())
+    rules = model.association_rules()
+    by_rule = {
+        (frozenset(a), c[0]): (conf, lift, supp)
+        for a, c, conf, lift, supp in zip(
+            rules.column("antecedent"), rules.column("consequent"),
+            rules.column("confidence"), rules.column("lift"),
+            rules.column("support"))
+    }
+    # {5} -> 1 : conf 2/2 = 1, lift 1 / (3/3) = 1
+    conf, lift, supp = by_rule[(frozenset(["5"]), "1")]
+    assert conf == pytest.approx(1.0)
+    assert lift == pytest.approx(1.0)
+    assert supp == pytest.approx(2 / 3)
+    # {1} -> 5 : conf 2/3 < minConfidence? 0.667 >= 0.6 — included,
+    # lift = (2/3) / (2/3) = 1
+    conf, lift, supp = by_rule[(frozenset(["1"]), "5")]
+    assert conf == pytest.approx(2 / 3)
+    assert lift == pytest.approx(1.0)
+    # {1,2} -> 5 : conf 2/3, {1,5} -> 2 : conf 1, lift 1/(3/3)=1
+    assert by_rule[(frozenset(["1", "5"]), "2")][0] == pytest.approx(1.0)
+
+
+def test_fpgrowth_transform_predicts_consequents():
+    model = FPGrowth(minSupport=0.5, minConfidence=0.9).fit(
+        _spark_doc_baskets())
+    out = model.transform(VectorFrame({"items": [["5"], ["1", "2"]]}))
+    pred = out.column("prediction")
+    # rules at conf >= 0.9: {5}->1, {5}->2, {1,5}->2, {2,5}->1, ...
+    assert set(pred[0]) == {"1", "2"}
+    # basket already holding an item never re-predicts it
+    assert "1" not in pred[1] and "2" not in pred[1]
+
+
+def test_fpgrowth_min_support_prunes():
+    model = FPGrowth(minSupport=0.99).fit(_spark_doc_baskets())
+    freq = model.freq_itemsets()
+    assert all(c == 3 for c in freq.column("freq"))
+    with pytest.raises(ValueError, match="empty"):
+        FPGrowth().fit(VectorFrame({"items": []}))
+
+
+def test_fpgrowth_persistence(tmp_path):
+    model = FPGrowth(minSupport=0.5, minConfidence=0.7).fit(
+        _spark_doc_baskets())
+    path = str(tmp_path / "fpm")
+    model.save(path)
+    loaded = FPGrowthModel.load(path)
+    assert sorted(map(str, loaded.itemsets)) == sorted(
+        map(str, model.itemsets))
+    assert loaded.num_baskets == 3
+    a = loaded.association_rules()
+    b = model.association_rules()
+    assert sorted(map(str, a.column("confidence"))) == sorted(
+        map(str, b.column("confidence")))
+
+
+def test_prefixspan_spark_doc_example():
+    # Spark's PrefixSpan doc example:
+    # <(1 2)(3)>, <(1)(3 2)(1 2)>, <(1 2)(5)>, <(6)> at minSupport 0.5
+    frame = VectorFrame({"sequence": [
+        [[1, 2], [3]],
+        [[1], [3, 2], [1, 2]],
+        [[1, 2], [5]],
+        [[6]],
+    ]})
+    out = PrefixSpan(minSupport=0.5, maxPatternLength=5
+                     ).find_frequent_sequential_patterns(frame)
+    got = {tuple(tuple(s) for s in p): c
+           for p, c in zip(out.column("sequence"), out.column("freq"))}
+    # Spark's documented output
+    expected = {
+        ((1,),): 3,
+        ((3,),): 2,
+        ((2,),): 3,
+        ((1, 2),): 3,
+        ((1,), (3,)): 2,
+    }
+    assert got == expected
+
+
+def test_prefixspan_itemset_assembly_and_max_length():
+    frame = VectorFrame({"sequence": [
+        [["a"], ["a", "b"]],
+        [["a", "b"]],
+    ]})
+    out = PrefixSpan(minSupport=1.0, maxPatternLength=2
+                     ).find_frequent_sequential_patterns(frame)
+    got = {tuple(tuple(s) for s in p): c
+           for p, c in zip(out.column("sequence"), out.column("freq"))}
+    assert got[(("a",),)] == 2
+    assert got[(("b",),)] == 2
+    assert got[(("a", "b"),)] == 2  # assembled itemset
+    # maxPatternLength=1 drops the pairs
+    short = PrefixSpan(minSupport=1.0, maxPatternLength=1
+                       ).find_frequent_sequential_patterns(frame)
+    assert all(sum(len(s) for s in p) == 1
+               for p in short.column("sequence"))
