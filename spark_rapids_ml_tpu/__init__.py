@@ -103,6 +103,17 @@ from spark_rapids_ml_tpu.models.decision_tree import (  # noqa: F401
 from spark_rapids_ml_tpu.models.pic import (  # noqa: F401
     PowerIterationClustering,
 )
+from spark_rapids_ml_tpu.models.feature_transformers2 import (  # noqa: F401
+    DCT,
+    FeatureHasher,
+    Interaction,
+    RFormula,
+    RFormulaModel,
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+    VectorIndexer,
+    VectorIndexerModel,
+)
 from spark_rapids_ml_tpu.models.fpm import (  # noqa: F401
     FPGrowth,
     FPGrowthModel,
@@ -256,6 +267,15 @@ __all__ = [
     "FPGrowth",
     "FPGrowthModel",
     "PrefixSpan",
+    "DCT",
+    "Interaction",
+    "FeatureHasher",
+    "VectorIndexer",
+    "VectorIndexerModel",
+    "UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel",
+    "RFormula",
+    "RFormulaModel",
     "FMRegressionModel",
     "FMClassifier",
     "FMClassificationModel",

@@ -75,6 +75,18 @@ _SPARK_CLASS_ALIASES = {
         "org.apache.spark.ml.feature.BucketedRandomProjectionLSHModel",
     "MinHashLSH": "org.apache.spark.ml.feature.MinHashLSH",
     "MinHashLSHModel": "org.apache.spark.ml.feature.MinHashLSHModel",
+    "DCT": "org.apache.spark.ml.feature.DCT",
+    "Interaction": "org.apache.spark.ml.feature.Interaction",
+    "FeatureHasher": "org.apache.spark.ml.feature.FeatureHasher",
+    "VectorIndexer": "org.apache.spark.ml.feature.VectorIndexer",
+    "VectorIndexerModel":
+        "org.apache.spark.ml.feature.VectorIndexerModel",
+    "UnivariateFeatureSelector":
+        "org.apache.spark.ml.feature.UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel":
+        "org.apache.spark.ml.feature.UnivariateFeatureSelectorModel",
+    "RFormula": "org.apache.spark.ml.feature.RFormula",
+    "RFormulaModel": "org.apache.spark.ml.feature.RFormulaModel",
     "FPGrowth": "org.apache.spark.ml.fpm.FPGrowth",
     "FPGrowthModel": "org.apache.spark.ml.fpm.FPGrowthModel",
     "PrefixSpan": "org.apache.spark.ml.fpm.PrefixSpan",
@@ -142,6 +154,22 @@ _SPARK_PARAM_ALLOWLIST = {
     "MinHashLSH": {"inputCol", "outputCol", "numHashTables", "seed"},
     "MinHashLSHModel": {"inputCol", "outputCol", "numHashTables",
                         "seed"},
+    "DCT": {"inputCol", "outputCol", "inverse"},
+    "Interaction": {"inputCols", "outputCol"},
+    "FeatureHasher": {"inputCols", "outputCol", "numFeatures",
+                      "categoricalCols"},
+    "VectorIndexer": {"inputCol", "outputCol", "maxCategories",
+                      "handleInvalid"},
+    "VectorIndexerModel": {"inputCol", "outputCol", "maxCategories",
+                           "handleInvalid"},
+    "UnivariateFeatureSelector": {
+        "featuresCol", "outputCol", "labelCol", "featureType",
+        "labelType", "selectionMode", "selectionThreshold"},
+    "UnivariateFeatureSelectorModel": {
+        "featuresCol", "outputCol", "labelCol", "featureType",
+        "labelType", "selectionMode", "selectionThreshold"},
+    "RFormula": {"formula", "featuresCol", "labelCol"},
+    "RFormulaModel": {"formula", "featuresCol", "labelCol"},
     "FPGrowth": {"itemsCol", "minSupport", "minConfidence",
                  "numPartitions", "predictionCol"},
     "FPGrowthModel": {"itemsCol", "minSupport", "minConfidence",
@@ -631,6 +659,29 @@ def load_als_model(path: str):
     model.train_rmse_ = float(
         meta.get("extra", {}).get("trainRmse", float("nan")))
     return _restore_params(model, meta)
+
+
+def save_json_state_model(model, path: str, state: Dict[str, Any],
+                          overwrite: bool = False) -> None:
+    """Generic small-state model writer: Spark metadata/params layout
+    plus one JSON payload column — for models whose learned state is
+    structured (category maps, selections, encoders) rather than
+    matrix-shaped."""
+    _require_target(path, overwrite)
+    cls = f"{type(model).__module__}.{type(model).__qualname__}"
+    _write_metadata(path, cls, model.uid, model.param_map_for_metadata())
+    _write_data_row(path, {"jsonState": json.dumps(state)})
+
+
+def load_json_state_model(model_cls, path: str):
+    """Counterpart of ``save_json_state_model``: returns (model with
+    params restored, decoded state dict); the caller re-attaches its
+    typed state fields."""
+    meta = _read_metadata(path)
+    row = _read_data_row(path)
+    model = model_cls(uid=meta["uid"])
+    _restore_params(model, meta)
+    return model, json.loads(row["jsonState"])
 
 
 def save_fpgrowth_model(model, path: str, overwrite: bool = False) -> None:

@@ -276,36 +276,54 @@ class PrefixSpan(Params):
             float(self.get_or_default("minSupport")) * n)))
         max_len = int(self.get_or_default("maxPatternLength"))
 
-        items = sorted({i for seq in seqs for s in seq for i in s},
-                       key=str)
+        # per-item supporting-sequence sets: the anti-monotone prune —
+        # a pattern extended with `item` is supported only by sequences
+        # supporting BOTH the pattern and the item, so candidates whose
+        # intersection is already < min_count never pay a containment
+        # scan, and scans run over the parent's support set only (the
+        # projected-database idea without suffix bookkeeping)
+        item_seqs: Dict[object, set] = {}
+        for s_id, seq in enumerate(seqs):
+            for itemset in seq:
+                for item in itemset:
+                    item_seqs.setdefault(item, set()).add(s_id)
+        items = sorted((i for i, ss in item_seqs.items()
+                        if len(ss) >= min_count), key=str)
         results: List[Tuple[List[List[object]], int]] = []
 
-        def support(pattern: List[frozenset]) -> int:
-            return sum(self._contains(seq, pattern) for seq in seqs)
+        def supporting(pattern: List[frozenset],
+                       candidates: set) -> set:
+            return {s for s in candidates
+                    if self._contains(seqs[s], pattern)}
 
-        def dfs(pattern: List[frozenset], length: int):
+        def dfs(pattern: List[frozenset], support_ids: set,
+                length: int):
             if length >= max_len:
                 return
             for item in items:
+                cand = support_ids & item_seqs[item]
+                if len(cand) < min_count:
+                    continue
                 # sequence extension: new itemset [item]
                 ext = pattern + [frozenset([item])]
-                c = support(ext)
-                if c >= min_count:
+                sup = supporting(ext, cand)
+                if len(sup) >= min_count:
                     results.append(
-                        ([sorted(s, key=str) for s in ext], c))
-                    dfs(ext, length + 1)
+                        ([sorted(s, key=str) for s in ext], len(sup)))
+                    dfs(ext, sup, length + 1)
                 # itemset assembly: canonical order prevents emitting
                 # the same itemset twice
                 if pattern and item not in pattern[-1] and all(
                         str(item) > str(x) for x in pattern[-1]):
                     asm = pattern[:-1] + [pattern[-1] | {item}]
-                    c = support(asm)
-                    if c >= min_count:
+                    sup = supporting(asm, cand)
+                    if len(sup) >= min_count:
                         results.append(
-                            ([sorted(s, key=str) for s in asm], c))
-                        dfs(asm, length + 1)
+                            ([sorted(s, key=str) for s in asm],
+                             len(sup)))
+                        dfs(asm, sup, length + 1)
 
-        dfs([], 0)
+        dfs([], set(range(n)), 0)
         return VectorFrame({
             "sequence": [p for p, _ in results],
             "freq": [int(c) for _, c in results],

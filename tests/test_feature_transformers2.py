@@ -1,0 +1,225 @@
+"""Batch-2 feature transformers: DCT vs scipy oracle, Interaction outer
+products, FeatureHasher determinism, VectorIndexer category maps,
+UnivariateFeatureSelector score functions, RFormula encoding.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    DCT,
+    FeatureHasher,
+    Interaction,
+    RFormula,
+    RFormulaModel,
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+    VectorIndexer,
+    VectorIndexerModel,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+
+def test_dct_orthonormal_roundtrip(rng):
+    from scipy.fft import dct as scipy_dct
+
+    x = rng.normal(size=(10, 8))
+    frame = VectorFrame({"features": x})
+    fwd = np.asarray(DCT(inputCol="features").transform(frame)
+                     .column("dct"))
+    np.testing.assert_allclose(
+        fwd, scipy_dct(x, type=2, norm="ortho", axis=1), atol=1e-12)
+    # inverse round-trips
+    back = np.asarray(
+        DCT(inputCol="dct", outputCol="rec", inverse=True).transform(
+            VectorFrame({"dct": fwd})).column("rec"))
+    np.testing.assert_allclose(back, x, atol=1e-10)
+
+
+def test_interaction_outer_product():
+    frame = VectorFrame({
+        "a": np.array([[1.0, 2.0], [0.5, 1.0]]),
+        "b": [3.0, 4.0],
+        "c": np.array([[10.0, 20.0], [1.0, 2.0]]),
+    })
+    out = Interaction(inputCols=["a", "b", "c"]).transform(frame)
+    got = np.asarray(out.column("interacted"))
+    # row 0: outer([1,2]*3, [10,20]) flattened
+    expect0 = np.array([1 * 3 * 10, 1 * 3 * 20, 2 * 3 * 10, 2 * 3 * 20],
+                       dtype=np.float64)
+    np.testing.assert_allclose(got[0], expect0)
+    assert got.shape == (2, 4)
+    with pytest.raises(ValueError, match="at least 2"):
+        Interaction(inputCols=["a"]).transform(frame)
+
+
+def test_feature_hasher_semantics():
+    frame = VectorFrame({
+        "real": [2.2, 3.3],
+        "cat": ["a", "b"],
+    })
+    out = FeatureHasher(inputCols=["real", "cat"], numFeatures=64
+                        ).transform(frame)
+    h = np.asarray(out.column("hashed"))
+    assert h.shape == (2, 64)
+    # numeric column: same index both rows, cell = value
+    idx = np.flatnonzero(h[0] == 2.2)
+    assert h[1, idx[0]] == 3.3
+    # categorical column: 1.0 in a value-dependent slot
+    assert (h[0] == 1.0).sum() == 1
+    assert (h[1] == 1.0).sum() == 1
+    assert np.flatnonzero(h[0] == 1.0)[0] != np.flatnonzero(
+        h[1] == 1.0)[0]
+    # categoricalCols forces numeric to categorical treatment
+    out2 = FeatureHasher(inputCols=["real"], numFeatures=64,
+                         categoricalCols=["real"]).transform(frame)
+    h2 = np.asarray(out2.column("hashed"))
+    assert (h2 == 1.0).sum() == 2
+
+
+def test_vector_indexer_maps_and_invalid_modes(rng):
+    x = np.column_stack([
+        rng.normal(size=20),                  # continuous
+        rng.choice([0.0, 5.0, 10.0], size=20),  # categorical
+    ])
+    x[0, 1] = 5.0
+    frame = VectorFrame({"features": x})
+    model = VectorIndexer(inputCol="features", maxCategories=4).fit(
+        frame)
+    assert model.categorical_features_ == [1]
+    out = np.asarray(model.transform(frame).column("indexed"))
+    np.testing.assert_allclose(out[:, 0], x[:, 0])  # untouched
+    # categories mapped to 0..2 ascending
+    mapped = {v: i for v, i in model.category_maps[1].items()}
+    assert mapped == {0.0: 0, 5.0: 1, 10.0: 2}
+    # Spark's zero special-case: 0.0 takes index 0 even when negative
+    # categories sort before it
+    neg = VectorIndexer(inputCol="features", maxCategories=4).fit(
+        VectorFrame({"features": np.array(
+            [[-1.0], [0.0], [2.0], [0.0]])}))
+    assert neg.category_maps[0] == {0.0: 0, -1.0: 1, 2.0: 2}
+    # unseen category: error / keep / skip
+    bad = VectorFrame({"features": np.array([[0.0, 7.0]])})
+    with pytest.raises(ValueError, match="unseen category"):
+        model.transform(bad)
+    model.set("handleInvalid", "keep")
+    kept = np.asarray(model.transform(bad).column("indexed"))
+    assert kept[0, 1] == 3.0
+    model.set("handleInvalid", "skip")
+    assert len(model.transform(bad)) == 0
+
+
+def test_vector_indexer_persistence(tmp_path, rng):
+    x = np.column_stack([rng.normal(size=10),
+                         rng.choice([1.0, 2.0], size=10)])
+    model = VectorIndexer(inputCol="features", maxCategories=3).fit(
+        VectorFrame({"features": x}))
+    path = str(tmp_path / "vi")
+    model.save(path)
+    loaded = VectorIndexerModel.load(path)
+    assert loaded.category_maps == model.category_maps
+    assert loaded.num_features == 2
+
+
+def test_selector_anova_picks_informative_feature(rng):
+    n = 200
+    y = rng.integers(0, 2, size=n).astype(np.float64)
+    x = np.column_stack([
+        rng.normal(size=n),            # noise
+        y * 3.0 + rng.normal(size=n),  # informative
+        rng.normal(size=n),            # noise
+    ])
+    model = UnivariateFeatureSelector(
+        inputCol="features", featureType="continuous",
+        labelType="categorical", selectionMode="numTopFeatures",
+        selectionThreshold=1).fit(
+        VectorFrame({"features": x, "label": y}))
+    assert model.selected == [1]
+    out = np.asarray(model.transform(VectorFrame({"features": x}))
+                     .column("selected"))
+    np.testing.assert_allclose(out[:, 0], x[:, 1])
+
+
+def test_selector_modes_and_regression_scores(rng):
+    n = 300
+    y = rng.normal(size=n)
+    x = np.column_stack([y * 2 + rng.normal(size=n) * 0.1,
+                         rng.normal(size=n)])
+    fpr = UnivariateFeatureSelector(
+        inputCol="features", featureType="continuous",
+        labelType="continuous", selectionMode="fpr",
+        selectionThreshold=0.01).fit(
+        VectorFrame({"features": x, "label": y}))
+    assert fpr.selected == [0]
+    chi = UnivariateFeatureSelector(
+        inputCol="features", featureType="categorical",
+        labelType="categorical", selectionMode="numTopFeatures",
+        selectionThreshold=1)
+    xc = np.column_stack([
+        (rng.random(n) < 0.5).astype(float),       # independent of y
+        (y > 0).astype(float),                      # deterministic
+    ])
+    model = chi.fit(VectorFrame({"features": xc,
+                                 "label": (y > 0).astype(float)}))
+    assert model.selected == [1]
+    with pytest.raises(ValueError, match="no defined score"):
+        UnivariateFeatureSelector(
+            inputCol="features", featureType="categorical",
+            labelType="continuous").fit(
+            VectorFrame({"features": xc, "label": y}))
+
+
+def test_selector_persistence(tmp_path, rng):
+    model = UnivariateFeatureSelectorModel(selected=[0, 2])
+    model.set("outputCol", "sel")
+    path = str(tmp_path / "sel")
+    model.save(path)
+    loaded = UnivariateFeatureSelectorModel.load(path)
+    assert loaded.selected == [0, 2]
+    assert loaded.get_or_default("outputCol") == "sel"
+
+
+def test_rformula_numeric_and_categorical():
+    frame = VectorFrame({
+        "y": [1.0, 0.0, 1.0, 0.0],
+        "age": [10.0, 20.0, 30.0, 40.0],
+        "city": ["sf", "nyc", "sf", "la"],
+    })
+    model = RFormula(formula="y ~ age + city").fit(frame)
+    out = model.transform(frame)
+    feats = np.asarray(out.column("features"))
+    # age passthrough + 2 dummies. Spark's StringIndexer+OneHotEncoder
+    # composition: levels frequencyDesc with alpha-asc ties →
+    # ['sf'(2), 'la'(1), 'nyc'(1)], dropLast zeroes the least-frequent
+    # 'nyc'
+    assert feats.shape == (4, 3)
+    np.testing.assert_allclose(feats[:, 0], [10, 20, 30, 40])
+    np.testing.assert_allclose(feats[0, 1:], [1, 0])   # sf
+    np.testing.assert_allclose(feats[1, 1:], [0, 0])   # nyc (dropped)
+    np.testing.assert_allclose(feats[3, 1:], [0, 1])   # la
+    np.testing.assert_allclose(np.asarray(out.column("label")),
+                               [1, 0, 1, 0])
+
+
+def test_rformula_dot_and_string_label(tmp_path):
+    frame = VectorFrame({
+        "cls": ["yes", "no", "yes"],
+        "a": [1.0, 2.0, 3.0],
+        "b": [4.0, 5.0, 6.0],
+    })
+    model = RFormula(formula="cls ~ .").fit(frame)
+    out = model.transform(frame)
+    assert np.asarray(out.column("features")).shape == (3, 2)
+    # frequencyDesc labels (Spark's StringIndexer): yes(2)→0, no(1)→1
+    np.testing.assert_allclose(np.asarray(out.column("label")),
+                               [0, 1, 0])
+    path = str(tmp_path / "rf")
+    model.save(path)
+    loaded = RFormulaModel.load(path)
+    np.testing.assert_allclose(
+        np.asarray(loaded.transform(frame).column("features")),
+        np.asarray(out.column("features")))
+    with pytest.raises(ValueError, match="not supported"):
+        RFormula(formula="y ~ a:b").fit(frame)
+    with pytest.raises(ValueError, match="formula"):
+        RFormula(formula="nonsense").fit(frame)
