@@ -162,11 +162,14 @@ _SPARK_PARAM_ALLOWLIST = {
                       "handleInvalid"},
     "VectorIndexerModel": {"inputCol", "outputCol", "maxCategories",
                            "handleInvalid"},
+    # NOTE: Spark's selector param is featuresCol; this repo's selector
+    # convention (ChiSqSelector, VarianceThresholdSelector) is inputCol,
+    # which therefore rides the Spark-visible paramMap here
     "UnivariateFeatureSelector": {
-        "featuresCol", "outputCol", "labelCol", "featureType",
+        "inputCol", "outputCol", "labelCol", "featureType",
         "labelType", "selectionMode", "selectionThreshold"},
     "UnivariateFeatureSelectorModel": {
-        "featuresCol", "outputCol", "labelCol", "featureType",
+        "inputCol", "outputCol", "labelCol", "featureType",
         "labelType", "selectionMode", "selectionThreshold"},
     "RFormula": {"formula", "featuresCol", "labelCol"},
     "RFormulaModel": {"formula", "featuresCol", "labelCol"},
@@ -1107,11 +1110,13 @@ def save_selector_model(model, path: str, overwrite: bool = False) -> None:
 
 
 _SELECTOR_MODEL_CLASSES = ("ChiSqSelectorModel",
-                           "VarianceThresholdSelectorModel")
+                           "VarianceThresholdSelectorModel",
+                           "UnivariateFeatureSelectorModel")
 
 
 def load_selector_model(path: str):
     from spark_rapids_ml_tpu.models import feature_transformers as ft
+    from spark_rapids_ml_tpu.models import feature_transformers2 as ft2
 
     meta = _read_metadata(path)
     name = meta.get("extra", {}).get("selectorClass", "ChiSqSelectorModel")
@@ -1120,7 +1125,8 @@ def load_selector_model(path: str):
             f"{path}: unknown selector model class {name!r} "
             f"(expected one of {_SELECTOR_MODEL_CLASSES})")
     row = _read_data_row(path)
-    model = getattr(ft, name)(
+    model_cls = getattr(ft, name, None) or getattr(ft2, name)
+    model = model_cls(
         selected=[int(i) for i in row["selectedFeatures"]],
         uid=meta["uid"])
     return _restore_params(model, meta)

@@ -68,6 +68,18 @@ class StringIndexerParams(HasInputCol, HasOutputCol):
         "error", validator=lambda v: v in _INVALID_MODES)
 
 
+def frequency_ordered_levels(values, descending: bool = True):
+    """Spark's StringIndexer level ordering: by frequency (desc by
+    default) with ties broken alphabetically ascending — the ONE copy
+    of this rule (RFormula composes it too)."""
+    counts: dict = {}
+    for v in values:
+        counts[str(v)] = counts.get(str(v), 0) + 1
+    sign = -1 if descending else 1
+    return [v for v, _c in sorted(
+        counts.items(), key=lambda kv: (sign * kv[1], kv[0]))]
+
+
 @_persistable
 class StringIndexer(StringIndexerParams):
     """``StringIndexer(inputCol="cat").fit(df)`` — Spark semantics:
@@ -83,13 +95,8 @@ class StringIndexer(StringIndexerParams):
         values = [str(v) for v in frame.column(self.getInputCol())]
         order = self.get_or_default("stringOrderType")
         if order.startswith("frequency"):
-            counts = {}
-            for v in values:
-                counts[v] = counts.get(v, 0) + 1
-            sign = -1 if order == "frequencyDesc" else 1
-            # Spark breaks frequency ties alphabetically ascending
-            labels = [v for v, _c in sorted(
-                counts.items(), key=lambda kv: (sign * kv[1], kv[0]))]
+            labels = frequency_ordered_levels(
+                values, descending=(order == "frequencyDesc"))
         else:
             labels = sorted(set(values),
                             reverse=(order == "alphabetDesc"))

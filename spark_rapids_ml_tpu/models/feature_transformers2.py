@@ -19,7 +19,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
-from spark_rapids_ml_tpu.models.feature_transformers import _persistable
+from spark_rapids_ml_tpu.models.feature_transformers import (
+    _SelectorModelBase,
+    _persistable,
+)
 from spark_rapids_ml_tpu.models.params import (
     HasInputCol,
     HasOutputCol,
@@ -397,42 +400,17 @@ def _contingency(col: np.ndarray, y: np.ndarray) -> np.ndarray:
     return table
 
 
-class UnivariateFeatureSelectorModel(UnivariateFeatureSelectorParams):
-    def __init__(self, selected: Optional[List[int]] = None,
-                 uid: Optional[str] = None):
-        super().__init__(uid=uid)
-        self.selected = selected
+class UnivariateFeatureSelectorModel(UnivariateFeatureSelectorParams,
+                                     _SelectorModelBase):
+    """Column-slicing transform, unfitted guard, and selector-layout
+    persistence all come from ``_SelectorModelBase`` — the same base
+    ChiSqSelectorModel / VarianceThresholdSelectorModel share."""
 
-    def _copy_internal_state(self, other) -> None:
-        other.selected = self.selected
-
-    def transform(self, dataset) -> VectorFrame:
-        if self.selected is None:
-            raise ValueError("model has no selection; fit first or load")
-        frame = as_vector_frame(dataset, self.getInputCol())
-        x = frame.vectors_as_matrix(self.getInputCol())
-        return frame.with_column(self.getOutputCol(),
-                                 x[:, self.selected])
-
-    def save(self, path: str, overwrite: bool = False) -> None:
-        from spark_rapids_ml_tpu.io.persistence import (
-            save_json_state_model,
-        )
-
-        save_json_state_model(self, path,
-                              {"selected": list(self.selected)},
-                              overwrite=overwrite)
-
-    @staticmethod
-    def load(path: str) -> "UnivariateFeatureSelectorModel":
-        from spark_rapids_ml_tpu.io.persistence import (
-            load_json_state_model,
-        )
-
-        model, state = load_json_state_model(
-            UnivariateFeatureSelectorModel, path)
-        model.selected = [int(j) for j in state["selected"]]
-        return model
+    @property
+    def selected(self) -> Optional[List[int]]:
+        if self.selected_features is None:
+            return None
+        return [int(i) for i in self.selected_features]
 
 
 # --------------------------------------------------------------------------
@@ -474,13 +452,9 @@ class RFormula(RFormulaParams):
         terms = [t.strip() for t in rhs.split("+")]
         if terms == ["."]:
             terms = [c for c in frame.columns if c != lhs]
-        def freq_desc_levels(values) -> List[str]:
-            # Spark's RFormula runs StringIndexer underneath: levels
-            # ordered frequencyDesc, ties broken alphabetically asc
-            counts: Dict[str, int] = {}
-            for v in values:
-                counts[str(v)] = counts.get(str(v), 0) + 1
-            return sorted(counts, key=lambda s: (-counts[s], s))
+        from spark_rapids_ml_tpu.models.feature_transformers import (
+            frequency_ordered_levels as freq_desc_levels,
+        )
 
         encoders: List[tuple] = []  # (col, kind, categories)
         for t in terms:
@@ -552,8 +526,14 @@ class RFormulaModel(RFormulaParams):
             lab = list(frame.column(self.label_source))
             if self.label_levels is not None:
                 index = {c: i for i, c in enumerate(self.label_levels)}
-                y = np.asarray([index[str(v)] for v in lab],
-                               dtype=np.float64)
+                y = np.empty(len(lab))
+                for r, v in enumerate(lab):
+                    i = index.get(str(v))
+                    if i is None:
+                        raise ValueError(
+                            f"unseen level {v!r} in label column "
+                            f"{self.label_source!r}")
+                    y[r] = i
             else:
                 y = np.asarray(lab, dtype=np.float64)
             out = out.with_column(self.get_or_default("labelCol"), y)

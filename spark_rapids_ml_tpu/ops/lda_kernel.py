@@ -78,9 +78,13 @@ def e_step_kernel(
         change = jnp.abs(new_gamma - gamma).mean(axis=1).max()
         return new_gamma, change, it + 1
 
+    # the initial mean-change carry is tied to the data (inf + 0·Σc) so
+    # its sharding "varying" annotation matches the loop output when the
+    # kernel runs inside a shard_map (a bare replicated constant trips
+    # the carry-type check there)
+    init_change = jnp.asarray(jnp.inf, counts.dtype) + 0.0 * counts.sum()
     gamma, _, _ = lax.while_loop(
-        cond, body, (gamma0, jnp.asarray(jnp.inf, counts.dtype),
-                     jnp.asarray(0, jnp.int32)))
+        cond, body, (gamma0, init_change, jnp.asarray(0, jnp.int32)))
     elog_theta = dirichlet_expectation(gamma)
     exp_elog_theta = jnp.exp(elog_theta)
     phinorm = exp_elog_theta @ exp_elog_beta + 1e-100

@@ -25,6 +25,12 @@ from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     distributed_kmeans_fit,
     distributed_kmeans_fit_kernel,
 )
+from spark_rapids_ml_tpu.parallel.distributed_als import (
+    distributed_als_fit,
+)
+from spark_rapids_ml_tpu.parallel.distributed_lda import (
+    distributed_lda_fit,
+)
 from spark_rapids_ml_tpu.parallel.distributed_linreg import (
     distributed_linreg_fit,
     distributed_linreg_fit_kernel,
@@ -56,6 +62,8 @@ __all__ = [
     "distributed_gbt_fit",
     "distributed_kmeans_fit",
     "distributed_kmeans_fit_kernel",
+    "distributed_als_fit",
+    "distributed_lda_fit",
     "distributed_linreg_fit",
     "distributed_linreg_fit_kernel",
     "distributed_logreg_fit",

@@ -1,0 +1,106 @@
+"""Distributed ALS over the mesh.
+
+Row-parallel alternating least squares: the padded user table (and U)
+shard over the ``data`` axis, the padded item table (and V) likewise;
+each half-sweep is one SPMD program in which every device ``all_gather``s
+the small opposite factor table over ICI and solves ITS block of normal
+equations locally (batched MXU contractions + batched Cholesky — the
+same ``_solve_side`` the single-chip kernel runs). The gathered factor
+table (rows × rank) is the only collective payload — never ratings.
+
+This replaces Spark ALS's in-block/out-block shuffle topology: where
+Spark routes factor messages through a hash-partitioned shuffle each
+half-sweep, the mesh form is a single all-gather over ICI with the
+solve fused into the same compiled program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+from spark_rapids_ml_tpu.ops.als_kernel import _solve_side
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+
+def _pad_table(idx, val, mask, n_dev):
+    n = idx.shape[0]
+    pad = (-n) % n_dev
+    if pad:
+        idx = np.pad(idx, ((0, pad), (0, 0)))
+        val = np.pad(val, ((0, pad), (0, 0)))
+        mask = np.pad(mask, ((0, pad), (0, 0)))
+    return idx, val, mask, n
+
+
+def distributed_als_fit(
+    u_table: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    i_table: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    mesh: Mesh,
+    *,
+    rank: int = 10,
+    reg: float = 0.1,
+    alpha: float = 1.0,
+    max_iter: int = 10,
+    implicit: bool = False,
+    nonneg: bool = False,
+    seed: int = 0,
+    dtype=jnp.float32,
+):
+    """(user_factors, item_factors) from padded CSR tables
+    (``ops.als_kernel.build_padded_csr`` output). Padded rows carry
+    zero masks → identity systems → zero factors; they are sliced off
+    before returning."""
+    n_dev = mesh.devices.size
+    u_idx, u_val, u_mask, n_users = _pad_table(*u_table, n_dev)
+    i_idx, i_val, i_mask, n_items = _pad_table(*i_table, n_dev)
+
+    row_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    put = partial(jax.device_put, device=row_sh)
+    u_idx = put(jnp.asarray(u_idx))
+    u_val = put(jnp.asarray(u_val, dtype=dtype))
+    u_mask = put(jnp.asarray(u_mask, dtype=dtype))
+    i_idx = put(jnp.asarray(i_idx))
+    i_val = put(jnp.asarray(i_val, dtype=dtype))
+    i_mask = put(jnp.asarray(i_mask, dtype=dtype))
+
+    rng = np.random.default_rng(seed)
+    scale = 1.0 / np.sqrt(rank)
+    u0 = np.abs(rng.normal(size=(u_idx.shape[0], rank))) * scale
+    v0 = np.abs(rng.normal(size=(i_idx.shape[0], rank))) * scale
+    # pad rows start at ZERO: implicit mode's dense YᵀY Gram sums the
+    # whole gathered table, so random pad rows would bias the first
+    # half-sweep's normal equations relative to the single-chip kernel
+    u0[n_users:] = 0.0
+    v0[n_items:] = 0.0
+    u = put(jnp.asarray(u0, dtype=dtype))
+    v = put(jnp.asarray(v0, dtype=dtype))
+    reg_dev = jnp.asarray(reg, dtype=dtype)
+    alpha_dev = jnp.asarray(alpha, dtype=dtype)
+
+    @jax.jit  # compile the SPMD program once; bare shard_map re-traces
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
+                       P(DATA_AXIS, None), P(DATA_AXIS, None),
+                       P(DATA_AXIS, None), P(), P()),
+             out_specs=P(DATA_AXIS, None))
+    def half_sweep(other_shard, idx_s, val_s, mask_s, prev_s, reg_a,
+                   alpha_a):
+        # the opposite factor table rides ICI once; the solve is local
+        other_full = lax.all_gather(other_shard, DATA_AXIS, tiled=True)
+        return _solve_side(other_full, idx_s, val_s, mask_s, reg_a,
+                           implicit, alpha_a, nonneg, prev_s)
+
+    for _ in range(max_iter):
+        u = half_sweep(v, u_idx, u_val, u_mask, u, reg_dev, alpha_dev)
+        v = half_sweep(u, i_idx, i_val, i_mask, v, reg_dev, alpha_dev)
+    u = np.asarray(jax.block_until_ready(u), dtype=np.float64)
+    v = np.asarray(jax.block_until_ready(v), dtype=np.float64)
+    return u[:n_users], v[:n_items]
