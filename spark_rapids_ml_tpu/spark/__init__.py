@@ -89,8 +89,29 @@ _ADAPTER_CLASSES = (
     "MultilayerPerceptronClassifierModel",
 )
 
+# round-4 families on the generic adapter posture (spark/adapter2.py):
+# DTs + LDA + LSH via the shared factory; ALS (three scalar columns) and
+# Word2Vec (token lists) with bespoke collectors
+_ADAPTER2_CLASSES = (
+    "ALS",
+    "ALSModel",
+    "BucketedRandomProjectionLSH",
+    "BucketedRandomProjectionLSHModel",
+    "DecisionTreeClassifier",
+    "DecisionTreeClassifierModel",
+    "DecisionTreeRegressor",
+    "DecisionTreeRegressorModel",
+    "LDA",
+    "LDAModel",
+    "MinHashLSH",
+    "MinHashLSHModel",
+    "Word2Vec",
+    "Word2VecModel",
+)
+
 __all__ = [
     *_PYSPARK_CLASSES,
+    *_ADAPTER2_CLASSES,
     *_FOREST_PLANE_CLASSES,
     *_MOMENTS_PLANE_CLASSES,
     *_ADAPTER_CLASSES,
@@ -120,4 +141,8 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.spark import adapter
 
         return getattr(adapter, name)
+    if name in _ADAPTER2_CLASSES:
+        from spark_rapids_ml_tpu.spark import adapter2
+
+        return getattr(adapter2, name)
     raise AttributeError(name)

@@ -1,0 +1,197 @@
+"""DataFrame front-ends for the round-4 model families.
+
+Same generic-adapter posture as ``spark/adapter.py`` (driver-collect
+fit inside the documented envelope, executor ``pandas_udf`` transform):
+DecisionTrees and LDA ride the shared ``_make_pair`` factory; ALS and
+Word2Vec need bespoke collectors because their inputs are not a single
+vector column — ALS consumes three scalar columns (userCol/itemCol/
+ratingCol), Word2Vec a token-list column. The LSH models append their
+hash-signature vector via the standard vector-output path and expose
+the local approx-NN/join surface on collected frames.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_ml_tpu.spark._compat import (
+    DenseVector,
+    VectorUDT,
+    pandas_udf,
+)
+from spark_rapids_ml_tpu.spark.adapter import (
+    _AdapterEstimator,
+    _AdapterModel,
+    _check_collect_envelope,
+    _make_pair,
+)
+
+from spark_rapids_ml_tpu.models.decision_tree import (  # noqa: E402
+    DecisionTreeClassificationModel as _LDTC_M,
+    DecisionTreeClassifier as _LDTC,
+    DecisionTreeRegressionModel as _LDTR_M,
+    DecisionTreeRegressor as _LDTR,
+)
+from spark_rapids_ml_tpu.models.lda import (  # noqa: E402
+    LDA as _LLDA,
+    LDAModel as _LLDA_M,
+)
+from spark_rapids_ml_tpu.models.lsh import (  # noqa: E402
+    BucketedRandomProjectionLSH as _LBRP,
+    BucketedRandomProjectionLSHModel as _LBRP_M,
+    MinHashLSH as _LMH,
+    MinHashLSHModel as _LMH_M,
+)
+from spark_rapids_ml_tpu.models.als import (  # noqa: E402
+    ALS as _LALS,
+    ALSModel as _LALS_M,
+)
+from spark_rapids_ml_tpu.models.word2vec import (  # noqa: E402
+    Word2Vec as _LW2V,
+    Word2VecModel as _LW2V_M,
+)
+
+__all__ = [
+    "ALS",
+    "ALSModel",
+    "BucketedRandomProjectionLSH",
+    "BucketedRandomProjectionLSHModel",
+    "DecisionTreeClassifier",
+    "DecisionTreeClassifierModel",
+    "DecisionTreeRegressor",
+    "DecisionTreeRegressorModel",
+    "LDA",
+    "LDAModel",
+    "MinHashLSH",
+    "MinHashLSHModel",
+    "Word2Vec",
+    "Word2VecModel",
+]
+
+
+DecisionTreeClassifier, DecisionTreeClassifierModel = _make_pair(
+    "DecisionTreeClassifier", _LDTC, _LDTC_M, needs_label=True,
+    classifier=True,
+    doc="Deterministic single tree (no bootstrap, all features).")
+DecisionTreeRegressor, DecisionTreeRegressorModel = _make_pair(
+    "DecisionTreeRegressor", _LDTR, _LDTR_M, needs_label=True)
+LDA, LDAModel = _make_pair(
+    "LDA", _LLDA, _LLDA_M, needs_label=False,
+    out_col_param="topicDistributionCol", out_kind="vector",
+    doc="Variational Bayes over a count-vector column; transform "
+        "appends the per-document topic distribution.")
+BucketedRandomProjectionLSH, BucketedRandomProjectionLSHModel = _make_pair(
+    "BucketedRandomProjectionLSH", _LBRP, _LBRP_M, needs_label=False,
+    out_col_param="outputCol", out_kind="vector",
+    doc="Euclidean LSH; transform appends the hash-signature vector.")
+MinHashLSH, MinHashLSHModel = _make_pair(
+    "MinHashLSH", _LMH, _LMH_M, needs_label=False,
+    out_col_param="outputCol", out_kind="vector",
+    doc="Jaccard LSH over binary vectors.")
+
+
+class ALSModel(_AdapterModel):
+    """Fitted factor tables; transform appends predictionCol from the
+    (userCol, itemCol) pair per Arrow batch on executors."""
+
+    _local_model_cls = _LALS_M
+
+    def _transform(self, dataset):
+        local = self._local
+        ucol = local.getUserCol()
+        icol = local.getItemCol()
+        out_col = local.getPredictionCol()
+
+        @pandas_udf(returnType="double")
+        def score(users, items):
+            import pandas as pd
+
+            return pd.Series(local.predict(
+                np.asarray(users, dtype=np.float64),
+                np.asarray(items, dtype=np.float64)))
+
+        out = dataset.withColumn(out_col,
+                                 score(dataset[ucol], dataset[icol]))
+        if local.getColdStartStrategy() == "drop":
+            if hasattr(out, "where"):  # real pyspark
+                # Spark SQL defines NaN = NaN as TRUE (unlike IEEE /
+                # pandas), so a self-equality filter would keep every
+                # unseen-id row — isnan is the correct drop predicate
+                from pyspark.sql.functions import col, isnan
+
+                return out.where(~isnan(col(out_col)))
+            raise NotImplementedError(
+                "coldStartStrategy='drop' needs a row-filtering engine "
+                "(pyspark); the local engine supports 'nan' only")
+        return out
+
+
+class ALS(_AdapterEstimator):
+    """DataFrame front-end over ``models.ALS``: fit collects the three
+    scalar rating columns (the rating triples ARE the dataset — there
+    is no vector column to stream), transform scores (user, item)
+    pairs on executors via a two-column ``pandas_udf``."""
+
+    _local_cls = _LALS
+    _model_cls = ALSModel
+    _aliases: dict = {}  # ALS has no inputCol to alias featuresCol onto
+
+    def _collect_frame(self, dataset):
+        from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+        _check_collect_envelope(dataset, "ALS")
+        ucol = self._local.getUserCol()
+        icol = self._local.getItemCol()
+        rcol = self._local.getRatingCol()
+        rows = dataset.select(ucol, icol, rcol).collect()
+        return VectorFrame({
+            ucol: [float(r[0]) for r in rows],
+            icol: [float(r[1]) for r in rows],
+            rcol: [float(r[2]) for r in rows],
+        })
+
+
+class Word2VecModel(_AdapterModel):
+    """transform appends the mean word vector per document."""
+
+    _local_model_cls = _LW2V_M
+
+    def _transform(self, dataset):
+        local = self._local
+        in_col = local.getInputCol()
+        out_col = local.getOutputCol()
+
+        @pandas_udf(returnType=VectorUDT())
+        def embed(series):
+            import pandas as pd
+
+            from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+            frame = VectorFrame({in_col: [list(v) for v in series]})
+            out = local.transform(frame)
+            return pd.Series([DenseVector(np.asarray(v))
+                              for v in out.column(out_col)])
+
+        return dataset.withColumn(out_col, embed(dataset[in_col]))
+
+    def find_synonyms(self, word: str, num: int):
+        return self._local.find_synonyms(word, num)
+
+    def get_vectors(self):
+        return self._local.get_vectors()
+
+
+class Word2Vec(_AdapterEstimator):
+    """DataFrame front-end over ``models.Word2Vec`` (token-list input
+    column; fit collects the corpus inside the documented envelope)."""
+
+    _local_cls = _LW2V
+    _model_cls = Word2VecModel
+
+    def _collect_frame(self, dataset):
+        from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+        _check_collect_envelope(dataset, "Word2Vec")
+        in_col = self._local.getInputCol()
+        rows = dataset.select(in_col).collect()
+        return VectorFrame({in_col: [list(r[0]) for r in rows]})

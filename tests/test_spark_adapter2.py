@@ -1,0 +1,132 @@
+"""Round-4 family DataFrame front-ends (spark/adapter2.py) through the
+local engine: DTs, LDA, LSH, ALS, Word2Vec."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.spark._compat import HAVE_PYSPARK
+from spark_rapids_ml_tpu.spark.local_engine import (
+    DenseVector,
+    LocalSparkSession,
+)
+
+if HAVE_PYSPARK:  # pragma: no cover
+    pytest.skip("real pyspark present: CI lane covers it",
+                allow_module_level=True)
+
+from spark_rapids_ml_tpu.spark import (  # noqa: E402
+    ALS,
+    BucketedRandomProjectionLSH,
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    LDA,
+    MinHashLSH,
+    Word2Vec,
+)
+
+
+@pytest.fixture
+def spark():
+    return LocalSparkSession(n_partitions=2)
+
+
+def _df(spark, x, y=None):
+    rows = []
+    for i, r in enumerate(x):
+        row = {"features": DenseVector(r)}
+        if y is not None:
+            row["label"] = float(y[i])
+        rows.append(row)
+    return spark.createDataFrame(rows)
+
+
+def test_decision_tree_front_ends(spark, rng):
+    x = rng.normal(size=(200, 4))
+    y = (x[:, 1] > 0.2).astype(float)
+    df = _df(spark, x, y)
+    model = DecisionTreeClassifier(maxDepth=3).fit(df)
+    out = model.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    assert (pred == y).mean() > 0.95
+    assert "If (feature 1" in model.to_debug_string()
+
+    yr = x[:, 0] * 3.0
+    dfr = _df(spark, x, yr)
+    reg = DecisionTreeRegressor(maxDepth=4).fit(dfr)
+    outr = reg.transform(dfr).collect()
+    predr = np.asarray([r["prediction"] for r in outr])
+    assert np.mean((predr - yr) ** 2) < np.var(yr)
+
+
+def test_lda_front_end(spark, rng):
+    vocab, k = 30, 3
+    block = vocab // k
+    counts = np.zeros((60, vocab))
+    for d in range(60):
+        t = d % k
+        for w in rng.integers(t * block, (t + 1) * block, size=30):
+            counts[d, w] += 1
+    df = _df(spark, counts)
+    model = LDA(k=3, maxIter=10, optimizer="em", seed=1).fit(df)
+    out = model.transform(df).collect()
+    dist = np.stack([np.asarray(r["topicDistribution"].toArray()
+                                if hasattr(r["topicDistribution"],
+                                           "toArray")
+                                else r["topicDistribution"])
+                     for r in out])
+    assert dist.shape == (60, 3)
+    np.testing.assert_allclose(dist.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_lsh_front_ends(spark, rng):
+    x = rng.normal(size=(40, 6))
+    df = _df(spark, x)
+    brp = BucketedRandomProjectionLSH(
+        bucketLength=2.0, numHashTables=3, seed=1).fit(df)
+    out = brp.transform(df).collect()
+    h0 = out[0]["hashes"]
+    h0 = np.asarray(h0.toArray() if hasattr(h0, "toArray") else h0)
+    assert h0.shape == (3,)
+
+    xb = (rng.random((30, 10)) < 0.4).astype(np.float64)
+    xb[xb.sum(axis=1) == 0, 0] = 1
+    mh = MinHashLSH(numHashTables=4, seed=2).fit(_df(spark, xb))
+    outb = mh.transform(_df(spark, xb)).collect()
+    assert len(outb) == 30
+
+
+def test_als_front_end(spark, rng):
+    u_true = rng.normal(size=(15, 3))
+    v_true = rng.normal(size=(12, 3))
+    rows = []
+    for u in range(15):
+        for i in range(12):
+            if rng.random() < 0.8:
+                rows.append({"user": float(u), "item": float(i),
+                             "rating": float(u_true[u] @ v_true[i])})
+    df = spark.createDataFrame(rows)
+    model = ALS(rank=3, maxIter=10, regParam=1e-3, seed=1).fit(df)
+    out = model.transform(df).collect()
+    pred = np.asarray([r["prediction"] for r in out])
+    truth = np.asarray([r["rating"] for r in rows])
+    assert np.sqrt(np.mean((pred - truth) ** 2)) < 0.1
+    recs = model.recommend_for_all_users(3)
+    assert len(recs.column("recommendations")[0]) == 3
+
+
+def test_word2vec_front_end(spark, rng):
+    a_words = ["x", "y", "z"]
+    b_words = ["p", "q", "r"]
+    rows = [{"text": list(rng.choice(a_words if i % 2 == 0 else b_words,
+                                     size=6))}
+            for i in range(80)]
+    df = spark.createDataFrame(rows)
+    model = Word2Vec(vectorSize=8, minCount=1, maxIter=10, seed=3,
+                     inputCol="text", stepSize=0.2,
+                     batchSize=256).fit(df)
+    out = model.transform(df).collect()
+    vec = out[0]["w2v_features"]
+    vec = np.asarray(vec.toArray() if hasattr(vec, "toArray") else vec)
+    assert vec.shape == (8,)
+    syn = model.find_synonyms("x", 2)
+    assert set(syn.column("word")) <= {"y", "z", "p", "q", "r"}
