@@ -1,0 +1,96 @@
+"""Round-4 model families end-to-end: ALS, LDA, Word2Vec, FPGrowth,
+PrefixSpan, LSH, DecisionTree, PowerIterationClustering.
+
+Run: ``JAX_PLATFORMS=cpu python examples/recommendation_topics_example.py``
+(or on the chip with the default platform).
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu import (
+    ALS,
+    BucketedRandomProjectionLSH,
+    DecisionTreeClassifier,
+    FPGrowth,
+    LDA,
+    PowerIterationClustering,
+    PrefixSpan,
+    Word2Vec,
+)
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+rng = np.random.default_rng(0)
+
+# -- ALS: explicit ratings → factors → top-k recommendations -------------
+u_true = rng.normal(size=(30, 4))
+v_true = rng.normal(size=(20, 4))
+users, items = np.divmod(rng.choice(30 * 20, size=400, replace=False), 20)
+ratings = (u_true @ v_true.T)[users, items]
+als_model = ALS(rank=4, maxIter=10, regParam=1e-2, seed=1).fit(
+    VectorFrame({"user": list(users), "item": list(items),
+                 "rating": list(ratings)}))
+print(f"ALS train RMSE: {als_model.train_rmse_:.4f}")
+recs = als_model.recommend_for_all_users(3)
+print("user 0 recommendations:", recs.column("recommendations")[0])
+
+# -- LDA: planted topics recovered from count vectors --------------------
+vocab, k = 45, 3
+counts = np.zeros((90, vocab))
+for d in range(90):
+    t = d % k
+    for w in rng.integers(t * 15, (t + 1) * 15, size=40):
+        counts[d, w] += 1
+lda_model = LDA(k=3, maxIter=20, optimizer="em", seed=2).fit(
+    VectorFrame({"features": counts}))
+topics = lda_model.describe_topics(5)
+for t, terms in zip(topics.column("topic"), topics.column("termIndices")):
+    print(f"topic {t}: top terms {terms}")
+print(f"log perplexity: "
+      f"{lda_model.log_perplexity(VectorFrame({'features': counts})):.3f}")
+
+# -- Word2Vec: co-occurrence clusters → synonyms -------------------------
+fruit = ["apple", "pear", "plum"]
+tools = ["saw", "drill", "plane"]
+sents = [list(rng.choice(fruit if i % 2 == 0 else tools, size=6))
+         for i in range(200)]
+w2v = Word2Vec(vectorSize=12, minCount=1, maxIter=15, seed=3,
+               inputCol="text", stepSize=0.2, batchSize=512).fit(
+    VectorFrame({"text": sents}))
+print("synonyms of 'apple':",
+      list(w2v.find_synonyms("apple", 2).column("word")))
+
+# -- FPGrowth + PrefixSpan ----------------------------------------------
+fp = FPGrowth(minSupport=0.4, minConfidence=0.7).fit(VectorFrame({
+    "items": [["bread", "milk"], ["bread", "butter", "milk"],
+              ["milk", "eggs"], ["bread", "milk", "eggs"]]}))
+print("frequent itemsets:", list(zip(
+    fp.freq_itemsets().column("items"), fp.freq_itemsets().column("freq"))))
+ps = PrefixSpan(minSupport=0.5).find_frequent_sequential_patterns(
+    VectorFrame({"sequence": [[["a"], ["b"]], [["a"], ["c"], ["b"]],
+                              [["a", "b"]]]}))
+print("sequential patterns:", list(zip(ps.column("sequence"),
+                                       ps.column("freq"))))
+
+# -- LSH: approximate nearest neighbours ---------------------------------
+x = rng.normal(size=(200, 8))
+lsh_model = BucketedRandomProjectionLSH(
+    bucketLength=1.5, numHashTables=4, seed=4,
+    inputCol="features").fit(VectorFrame({"features": x}))
+nn = lsh_model.approx_nearest_neighbors(
+    VectorFrame({"features": x}), x[5] + 0.01, 3)
+print("approx NN distances:", list(nn.column("distCol")))
+
+# -- DecisionTree + PIC --------------------------------------------------
+y = (x[:, 2] > 0).astype(np.float64)
+dt = DecisionTreeClassifier(maxDepth=3).fit(x, y)
+print("decision tree:\n" + "\n".join(
+    dt.to_debug_string().splitlines()[:4]))
+
+edges = VectorFrame({"src": [0, 1, 2, 3, 4, 2],
+                     "dst": [1, 2, 0, 4, 5, 3],
+                     "weight": [1.0, 1.0, 1.0, 1.0, 1.0, 0.01]})
+pic = PowerIterationClustering(k=2, weightCol="weight", seed=5)
+print("PIC assignments:", list(zip(
+    pic.assign_clusters(edges).column("id"),
+    pic.assign_clusters(edges).column("cluster"))))
+print("example complete")

@@ -1,0 +1,136 @@
+"""Round-4 wave-4: chip throughput records for the new model families.
+
+ALS (padded-gather normal equations) and LDA (variational E-step) are
+the round's biggest new compute kernels; this wave records their
+steady-state single-chip rates the same way bench_models.py records
+KMeans/LogReg/RF — on-device synthetic data, compile excluded by a
+warm-up, host reads as the completion fence.
+
+Single process, one claim; exit 2 when no chip (wrapper retries).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from bench_common import OUT, log, probe, stamp, write_error
+
+
+def main() -> int:
+    device = probe("wave4")
+    if device is None:
+        return 2
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    results = []
+
+    # -- ALS: 1M ratings, 65536 users × 8192 items, rank 16 -------------
+    try:
+        from spark_rapids_ml_tpu.ops.als_kernel import (
+            als_fit_kernel,
+            build_padded_csr,
+        )
+
+        n_users, n_items, rank = 65536, 8192, 16
+        n_ratings = 1_048_576
+        rng = np.random.default_rng(0)
+        uu = rng.integers(0, n_users, size=n_ratings)
+        ii = rng.integers(0, n_items, size=n_ratings)
+        rr = rng.normal(size=n_ratings)
+        u_tab = build_padded_csr(uu, ii, rr, n_users)
+        i_tab = build_padded_csr(ii, uu, rr, n_items)
+        dev = [jax.device_put(jnp.asarray(
+            a, dtype=(jnp.int32 if a.dtype == np.int32
+                      else jnp.float32)), device)
+            for a in (*u_tab, *i_tab)]
+        key = jax.random.PRNGKey(0)
+        args = dict(rank=rank, reg=jnp.float32(0.1),
+                    alpha=jnp.float32(1.0), max_iter=5)
+        r = als_fit_kernel(*dev, key, **args)      # compile + run
+        np.asarray(r.train_rmse)                   # fence
+        t0 = time.perf_counter()
+        r = als_fit_kernel(*dev, key, **args)
+        np.asarray(r.train_rmse)
+        dt = time.perf_counter() - t0
+        results.append({
+            "metric": "ALS ratings/sec/chip (per sweep)",
+            "value": round(n_ratings * 5 / dt, 1),
+            "unit": "ratings/sec",
+            "config": f"{n_ratings} ratings, {n_users}x{n_items} "
+                      f"rank={rank}, 5 sweeps in {dt:.2f}s "
+                      f"(padded widths {u_tab[0].shape[1]}/"
+                      f"{i_tab[0].shape[1]})",
+            "seconds": round(dt, 3),
+        })
+        log("wave4 als ok")
+    except Exception as exc:  # noqa: BLE001
+        write_error("bench_als", exc)
+        if "UNAVAILABLE" in str(exc):
+            log("wave4 ABORT (claim lost)")
+            return 2
+        log("wave4 als FAILED")
+
+    # -- LDA: 32768 docs × 2048 vocab, k=64 online E-step ---------------
+    try:
+        from spark_rapids_ml_tpu.ops.lda_kernel import (
+            online_update_kernel,
+        )
+
+        docs, vocab, k = 32768, 2048, 64
+        rng = np.random.default_rng(1)
+        counts = jax.device_put(jnp.asarray(
+            rng.poisson(0.05, size=(docs, vocab)), dtype=jnp.float32),
+            device)
+        lam = jax.device_put(jnp.asarray(
+            rng.gamma(100.0, 0.01, size=(k, vocab)), dtype=jnp.float32),
+            device)
+        alpha = jnp.full((k,), 1.0 / k, dtype=jnp.float32)
+        key = jax.random.PRNGKey(2)
+        lam, _ = online_update_kernel(
+            lam, counts, alpha, jnp.float32(1.0 / k), jnp.float32(0.1),
+            jnp.float32(1.0), key)
+        np.asarray(lam[0, 0])                      # compile fence
+        t0 = time.perf_counter()
+        lam, _ = online_update_kernel(
+            lam, counts, alpha, jnp.float32(1.0 / k), jnp.float32(0.1),
+            jnp.float32(1.0), key)
+        np.asarray(lam[0, 0])
+        dt = time.perf_counter() - t0
+        results.append({
+            "metric": "LDA docs/sec/chip (online VB step)",
+            "value": round(docs / dt, 1),
+            "unit": "docs/sec",
+            "config": f"{docs}x{vocab} k={k}, one stochastic step "
+                      f"(inner while_loop to 1e-3) in {dt:.2f}s",
+            "seconds": round(dt, 3),
+        })
+        log("wave4 lda ok")
+    except Exception as exc:  # noqa: BLE001
+        write_error("bench_lda", exc)
+        if "UNAVAILABLE" in str(exc):
+            log("wave4 ABORT (claim lost)")
+            return 2
+        log("wave4 lda FAILED")
+
+    if results:
+        with open(os.path.join(OUT, "bench_families.json"), "w") as f:
+            for rec in results:
+                rec["platform"] = device.platform
+                rec["device_kind"] = str(
+                    getattr(device, "device_kind", "?"))
+                rec["recorded_utc"] = stamp()
+                f.write(json.dumps(rec) + "\n")
+    with open(os.path.join(OUT, "wave4_done"), "w") as f:
+        f.write(stamp() + "\n")
+    log("wave4 ALL DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Wave-4 wrapper: new-family chip benches, strictly after every earlier
+# wave claimant is gone (one chip claimant at a time).
+cd /root/repo || exit 1
+export PYTHONPATH=/root/repo:/root/.axon_site
+OUT=/root/repo/records/r04
+mkdir -p "$OUT"
+
+while [ ! -f "$OUT/wave2_done" ] || [ ! -f "$OUT/wave3_done" ] \
+      || pgrep -f "bench_r04_wave[23]" > /dev/null; do
+  sleep 60
+done
+
+for i in $(seq 1 24); do
+  echo "wave4 attempt $i start: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  python scripts/bench_r04_wave4.py >> "$OUT/loop.log" 2>&1
+  rc=$?
+  echo "wave4 attempt $i rc=$rc: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  [ -f "$OUT/wave4_done" ] && exit 0
+  sleep 300
+done

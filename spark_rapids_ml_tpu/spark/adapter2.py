@@ -101,6 +101,8 @@ class ALSModel(_AdapterModel):
         ucol = local.getUserCol()
         icol = local.getItemCol()
         out_col = local.getPredictionCol()
+        if not out_col:   # Spark convention: '' disables the column
+            return dataset
 
         @pandas_udf(returnType="double")
         def score(users, items):
@@ -113,7 +115,9 @@ class ALSModel(_AdapterModel):
         out = dataset.withColumn(out_col,
                                  score(dataset[ucol], dataset[icol]))
         if local.getColdStartStrategy() == "drop":
-            if hasattr(out, "where"):  # real pyspark
+            from spark_rapids_ml_tpu.spark._compat import HAVE_PYSPARK
+
+            if HAVE_PYSPARK:
                 # Spark SQL defines NaN = NaN as TRUE (unlike IEEE /
                 # pandas), so a self-equality filter would keep every
                 # unseen-id row — isnan is the correct drop predicate
@@ -160,6 +164,8 @@ class Word2VecModel(_AdapterModel):
         local = self._local
         in_col = local.getInputCol()
         out_col = local.getOutputCol()
+        if not out_col:   # Spark convention: '' disables the column
+            return dataset
 
         @pandas_udf(returnType=VectorUDT())
         def embed(series):
