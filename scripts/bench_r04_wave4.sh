@@ -6,10 +6,14 @@ export PYTHONPATH=/root/repo:/root/.axon_site
 OUT=/root/repo/records/r04
 mkdir -p "$OUT"
 
-while [ ! -f "$OUT/wave2_done" ] || [ ! -f "$OUT/wave3_done" ] \
-      || pgrep -f "bench_r04_wave[23]" > /dev/null; do
+# gate: earlier waves done, OR their claimant processes gone (a wave
+# that exhausts retries exits without its done marker — wave 4 must
+# still run in a later window rather than wait forever)
+while pgrep -f "bench_r04_wave[23]" > /dev/null; do
   sleep 60
 done
+[ -f "$OUT/wave2_done" ] && [ -f "$OUT/wave3_done" ] || \
+  echo "wave4: earlier waves exited without done markers; proceeding: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
 
 for i in $(seq 1 24); do
   echo "wave4 attempt $i start: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"

@@ -113,6 +113,7 @@ from spark_rapids_ml_tpu.models.feature_transformers2 import (  # noqa: F401
     UnivariateFeatureSelectorModel,
     VectorIndexer,
     VectorIndexerModel,
+    VectorSizeHint,
 )
 from spark_rapids_ml_tpu.models.fpm import (  # noqa: F401
     FPGrowth,
@@ -137,8 +138,10 @@ from spark_rapids_ml_tpu.models.text import (  # noqa: F401
     Tokenizer,
 )
 from spark_rapids_ml_tpu.stat import (  # noqa: F401
+    ANOVATest,
     ChiSquareTest,
     Correlation,
+    FValueTest,
     KolmogorovSmirnovTest,
     Summarizer,
 )
@@ -214,6 +217,8 @@ __all__ = [
     "GaussianMixtureModel",
     "Correlation",
     "KolmogorovSmirnovTest",
+    "ANOVATest",
+    "FValueTest",
     "ClusteringEvaluator",
     "RankingEvaluator",
     "ChiSquareTest",
@@ -272,6 +277,7 @@ __all__ = [
     "FeatureHasher",
     "VectorIndexer",
     "VectorIndexerModel",
+    "VectorSizeHint",
     "UnivariateFeatureSelector",
     "UnivariateFeatureSelectorModel",
     "RFormula",

@@ -322,9 +322,14 @@ class UnivariateFeatureSelector(UnivariateFeatureSelectorParams):
     def _p_values(self, x: np.ndarray, y: np.ndarray) -> np.ndarray:
         from scipy import stats
 
+        from spark_rapids_ml_tpu.stat import (
+            anova_f_scores,
+            f_regression_scores,
+        )
+
         ft = self.get_or_default("featureType")
         lt = self.get_or_default("labelType")
-        n, d = x.shape
+        d = x.shape[1]
         if ft == "categorical" and lt == "categorical":
             p = np.empty(d)
             for j in range(d):
@@ -336,23 +341,9 @@ class UnivariateFeatureSelector(UnivariateFeatureSelectorParams):
                                               correction=False)[1]
             return p
         if ft == "continuous" and lt == "categorical":
-            groups = [x[y == c] for c in np.unique(y)]
-            if len(groups) < 2:
-                raise ValueError("ANOVA needs at least 2 classes")
-            return np.asarray(
-                [stats.f_oneway(*(g[:, j] for g in groups)).pvalue
-                 for j in range(d)])
+            return anova_f_scores(x, y)[0]
         if ft == "continuous" and lt == "continuous":
-            p = np.empty(d)
-            for j in range(d):
-                r = np.corrcoef(x[:, j], y)[0, 1]
-                if not np.isfinite(r):
-                    p[j] = 1.0
-                    continue
-                dfree = n - 2
-                t2 = r * r * dfree / max(1.0 - r * r, 1e-300)
-                p[j] = stats.f.sf(t2, 1, dfree)
-            return p
+            return f_regression_scores(x, y)[0]
         raise ValueError(
             "featureType='categorical' with labelType='continuous' has "
             "no defined score function (Spark raises the same)")
@@ -562,3 +553,53 @@ class RFormulaModel(RFormulaParams):
         model.label_source = state["labelSource"]
         model.label_levels = state["labelLevels"]
         return model
+
+
+# --------------------------------------------------------------------------
+# VectorSizeHint
+# --------------------------------------------------------------------------
+
+@_persistable
+class VectorSizeHint(HasInputCol, Params):
+    """Spark's ``VectorSizeHint``: asserts/declares the size of a vector
+    column. handleInvalid: 'error' raises on mismatched/missing rows,
+    'skip' drops them, 'optimistic' passes everything through."""
+
+    size = Param("size", "declared vector size", None,
+                 validator=lambda v: v is None or (
+                     isinstance(v, int) and v >= 1))
+    handleInvalid = Param("handleInvalid",
+                          "error | skip | optimistic", "error",
+                          validator=lambda v: v in ("error", "skip",
+                                                    "optimistic"))
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+    def transform(self, dataset) -> VectorFrame:
+        size = self.get_or_default("size")
+        if size is None:
+            raise ValueError("VectorSizeHint requires the size param")
+        mode = self.get_or_default("handleInvalid")
+        frame = as_vector_frame(dataset, self.getInputCol())
+        if mode == "optimistic":
+            return frame
+        col = frame.column(self.getInputCol())
+
+        def row_len(row) -> int:
+            if row is None:
+                return -1  # null rows are invalid (Spark semantics)
+            return row.shape[0] if hasattr(row, "shape") else len(row)
+
+        lengths = np.asarray([row_len(row) for row in col])
+        bad = lengths != int(size)
+        if bad.any():
+            if mode == "error":
+                raise ValueError(
+                    f"{int(bad.sum())} rows have vector size != {size} "
+                    f"in column {self.getInputCol()!r} "
+                    "(handleInvalid='error')")
+            return frame.select_rows(np.flatnonzero(~bad))
+        return frame

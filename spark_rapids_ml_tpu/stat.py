@@ -22,8 +22,8 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["Correlation", "ChiSquareTest", "KolmogorovSmirnovTest",
-           "Summarizer"]
+__all__ = ["ANOVATest", "ChiSquareTest", "Correlation", "FValueTest",
+           "KolmogorovSmirnovTest", "Summarizer"]
 
 
 def _is_dataframe(dataset) -> bool:
@@ -325,3 +325,89 @@ class KolmogorovSmirnovTest:
                 for j in range(1, 101)))
         p = float(min(max(p, 0.0), 1.0))
         return VectorFrame({"pValue": [p], "statistic": [d]})
+
+
+class _FeatureTestBase:
+    """Shared frame plumbing for the per-feature hypothesis tests
+    (``ml.stat.ANOVATest`` / ``FValueTest``, Spark 3.1): one row out,
+    with parallel pValues / degreesOfFreedom / fValues arrays."""
+
+    @classmethod
+    def test(cls, dataset, featuresCol: str = "features",
+             labelCol: str = "label"):
+        from spark_rapids_ml_tpu.data.frame import (
+            VectorFrame,
+            as_vector_frame,
+        )
+
+        frame = as_vector_frame(dataset, featuresCol)
+        x = frame.vectors_as_matrix(featuresCol)
+        y = np.asarray(frame.column(labelCol), dtype=np.float64)
+        p, dof, f = cls._scores(x, y)
+        return VectorFrame({
+            "pValues": [list(map(float, p))],
+            "degreesOfFreedom": [list(map(int, dof))],
+            "fValues": [list(map(float, f))],
+        })
+
+
+def anova_f_scores(x: np.ndarray, y: np.ndarray):
+    """Per-feature one-way ANOVA (p, F) of continuous features against
+    a categorical label — the ONE copy shared by ``ANOVATest`` and
+    ``UnivariateFeatureSelector``."""
+    from scipy import stats
+
+    classes = np.unique(y)
+    if classes.size < 2:
+        raise ValueError("ANOVA needs at least 2 classes")
+    groups = [x[y == c] for c in classes]
+    d = x.shape[1]
+    p = np.empty(d)
+    f = np.empty(d)
+    for j in range(d):
+        res = stats.f_oneway(*(g[:, j] for g in groups))
+        p[j], f[j] = res.pvalue, res.statistic
+    return p, f
+
+
+def f_regression_scores(x: np.ndarray, y: np.ndarray):
+    """Per-feature F-regression (p, F) of continuous features against a
+    continuous label (squared correlation scaled by the residual dof);
+    non-finite correlations (constant columns) score (p=1, F=0)."""
+    from scipy import stats
+
+    n, d = x.shape
+    dof = n - 2
+    p = np.empty(d)
+    f = np.empty(d)
+    for j in range(d):
+        r = np.corrcoef(x[:, j], y)[0, 1]
+        if not np.isfinite(r):
+            p[j], f[j] = 1.0, 0.0
+            continue
+        f[j] = r * r * dof / max(1.0 - r * r, 1e-300)
+        p[j] = stats.f.sf(f[j], 1, dof)
+    return p, f
+
+
+class ANOVATest(_FeatureTestBase):
+    """One-way ANOVA F-test of each continuous feature against a
+    categorical label (``ml.stat.ANOVATest``). degreesOfFreedom follows
+    Spark's convention: dfbn + dfwn = (k−1) + (n−k) = n−1."""
+
+    @staticmethod
+    def _scores(x, y):
+        p, f = anova_f_scores(x, y)
+        d = x.shape[1]
+        return p, np.full(d, x.shape[0] - 1, dtype=np.int64), f
+
+
+class FValueTest(_FeatureTestBase):
+    """F-test of each continuous feature against a continuous label
+    (F-regression; dof = n − 2, the residual degrees)."""
+
+    @staticmethod
+    def _scores(x, y):
+        p, f = f_regression_scores(x, y)
+        d = x.shape[1]
+        return p, np.full(d, x.shape[0] - 2, dtype=np.int64), f

@@ -160,3 +160,38 @@ def test_silhouette_coincident_duplicates_zero_not_nan():
     score = ClusteringEvaluator().evaluate(
         VectorFrame({"features": x, "prediction": [0, 0, 1, 1]}))
     assert score == 0.0
+
+
+def test_anova_and_fvalue_tests_match_scipy(rng):
+    scipy_stats = pytest.importorskip("scipy.stats")
+    from spark_rapids_ml_tpu import ANOVATest, FValueTest
+
+    n = 120
+    y_cat = rng.integers(0, 3, size=n).astype(np.float64)
+    x = np.column_stack([rng.normal(size=n),
+                         y_cat * 2.0 + rng.normal(size=n)])
+    out = ANOVATest.test(VectorFrame({"features": x,
+                                      "label": y_cat}))
+    p = out.column("pValues")[0]
+    f = out.column("fValues")[0]
+    groups = [x[y_cat == c] for c in (0, 1, 2)]
+    for j in range(2):
+        ref = scipy_stats.f_oneway(*(g[:, j] for g in groups))
+        np.testing.assert_allclose(f[j], ref.statistic, rtol=1e-10)
+        np.testing.assert_allclose(p[j], ref.pvalue, rtol=1e-10)
+    # Spark's ANOVATest convention: dfbn + dfwn = n - 1
+    assert out.column("degreesOfFreedom")[0] == [n - 1, n - 1]
+    assert p[1] < 1e-10 < p[0]  # informative vs noise
+
+    y_cont = rng.normal(size=n)
+    xc = np.column_stack([y_cont * 3 + rng.normal(size=n) * 0.1,
+                          rng.normal(size=n)])
+    outf = FValueTest.test(VectorFrame({"features": xc,
+                                        "label": y_cont}))
+    pf = outf.column("pValues")[0]
+    assert pf[0] < 1e-10 < pf[1]
+    # f-regression identity check against the correlation t-statistic
+    r = np.corrcoef(xc[:, 0], y_cont)[0, 1]
+    expect_f = r * r * (n - 2) / (1 - r * r)
+    np.testing.assert_allclose(outf.column("fValues")[0][0], expect_f,
+                               rtol=1e-10)

@@ -223,3 +223,21 @@ def test_rformula_dot_and_string_label(tmp_path):
         RFormula(formula="y ~ a:b").fit(frame)
     with pytest.raises(ValueError, match="formula"):
         RFormula(formula="nonsense").fit(frame)
+
+
+def test_vector_size_hint_modes(rng):
+    from spark_rapids_ml_tpu import VectorSizeHint
+
+    rows = [np.ones(3), np.ones(3), np.ones(4)]
+    frame = VectorFrame({"features": rows})
+    with pytest.raises(ValueError, match="vector size != 3"):
+        VectorSizeHint(inputCol="features", size=3).transform(frame)
+    kept = VectorSizeHint(inputCol="features", size=3,
+                          handleInvalid="skip").transform(frame)
+    assert len(kept) == 2
+    passthrough = VectorSizeHint(inputCol="features", size=3,
+                                 handleInvalid="optimistic"
+                                 ).transform(frame)
+    assert len(passthrough) == 3
+    with pytest.raises(ValueError, match="requires the size"):
+        VectorSizeHint(inputCol="features").transform(frame)
