@@ -6,10 +6,23 @@ export PYTHONPATH=/root/repo:/root/.axon_site
 OUT=/root/repo/records/r04
 mkdir -p "$OUT"
 
-# gate: earlier waves done, OR their claimant processes gone (a wave
-# that exhausts retries exits without its done marker — wave 4 must
-# still run in a later window rather than wait forever)
-while pgrep -f "bench_r04_wave[23]" > /dev/null; do
+# gate: earlier waves done, OR their claimant processes absent for two
+# consecutive polls after a startup grace period (a wave that exhausts
+# retries exits without its done marker — wave 4 must still run in a
+# later window; the grace + double-poll avoids racing wrappers that
+# launched in the same breath but haven't exec'd yet)
+sleep 120
+absent=0
+while [ "$absent" -lt 2 ]; do
+  if [ -f "$OUT/wave2_done" ] && [ -f "$OUT/wave3_done" ] \
+     && ! pgrep -f "bench_r04_wave[23]" > /dev/null; then
+    break
+  fi
+  if pgrep -f "bench_r04_wave[23]" > /dev/null; then
+    absent=0
+  else
+    absent=$((absent + 1))
+  fi
   sleep 60
 done
 [ -f "$OUT/wave2_done" ] && [ -f "$OUT/wave3_done" ] || \

@@ -61,6 +61,7 @@ _MOMENTS_PLANE_CLASSES = (
     "Imputer",
     "GeneralizedLinearRegression",
     "GaussianMixture",
+    "LDA",
 )
 
 # generic-adapter front-ends (spark/adapter.py): driver-device fit +
@@ -101,7 +102,8 @@ _ADAPTER2_CLASSES = (
     "DecisionTreeClassifierModel",
     "DecisionTreeRegressor",
     "DecisionTreeRegressorModel",
-    "LDA",
+    # NOTE: "LDA" routes to the moments plane (EM iterations as
+    # executor statistics jobs); only the Model class lives here
     "LDAModel",
     "MinHashLSH",
     "MinHashLSHModel",

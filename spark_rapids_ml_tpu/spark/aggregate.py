@@ -1438,3 +1438,82 @@ def imputer_stats_arrow_schema():
 
 def imputer_stats_spark_ddl() -> str:
     return "count_vec array<double>, s1 array<double>"
+
+
+# --------------------------------------------------------------------------
+# LDA variational-EM statistics (per-iteration plane)
+# --------------------------------------------------------------------------
+
+def lda_stats_spark_ddl() -> str:
+    return "sstats array<double>, docs bigint"
+
+
+def lda_stats_arrow_schema():
+    import pyarrow as pa
+
+    return pa.schema([
+        ("sstats", pa.list_(pa.float64())),
+        ("docs", pa.int64()),
+    ])
+
+
+def partition_lda_stats(
+    batches: Iterable,
+    features_col: str,
+    exp_elog_beta: np.ndarray,
+    alpha: np.ndarray,
+    seed: int,
+) -> Iterator[Dict[str, object]]:
+    """One partition's LDA variational E-step partials under the
+    broadcast topic state: the (k, vocab) sufficient statistics of
+    ``ops.lda_kernel.e_step_kernel`` summed over the partition's
+    document panels — the same per-iteration plane shape as the GMM
+    EM partials (``partition_gmm_stats``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.lda_kernel import e_step_kernel
+    from spark_rapids_ml_tpu.utils.platform import force_cpu_if_requested
+
+    force_cpu_if_requested()
+    beta_dev = jnp.asarray(exp_elog_beta)
+    alpha_dev = jnp.asarray(alpha, dtype=beta_dev.dtype)
+    total = np.zeros(exp_elog_beta.shape, dtype=np.float64)
+    docs = 0
+    for i, batch in enumerate(batches):
+        if hasattr(batch, "column"):
+            x = vector_column_to_matrix(batch.column(features_col))
+        else:
+            x = np.asarray(batch, dtype=np.float64)
+        if x.shape[0] == 0:
+            continue
+        _, sstats = e_step_kernel(
+            jnp.asarray(x, dtype=beta_dev.dtype), beta_dev, alpha_dev,
+            jax.random.fold_in(jax.random.PRNGKey(seed), i))
+        total += np.asarray(sstats, dtype=np.float64)
+        docs += x.shape[0]
+    if docs:
+        yield {"sstats": total.ravel().tolist(), "docs": docs}
+
+
+def partition_lda_stats_arrow(batches, features_col: str, exp_elog_beta,
+                              alpha, seed: int):
+    import pyarrow as pa
+
+    for row in partition_lda_stats(batches, features_col, exp_elog_beta,
+                                   alpha, seed):
+        yield pa.RecordBatch.from_pylist(
+            [row], schema=lda_stats_arrow_schema())
+
+
+def combine_lda_stats(rows: Iterable, k: int, vocab: int):
+    """Driver-side reduce of per-partition LDA partials →
+    ((k, vocab) sstats, total docs)."""
+    total = np.zeros((k, vocab))
+    docs = 0
+    for row in rows:
+        get = row.get if isinstance(row, dict) else row.__getitem__
+        total += np.asarray(get("sstats"),
+                            dtype=np.float64).reshape(k, vocab)
+        docs += int(get("docs"))
+    return total, docs

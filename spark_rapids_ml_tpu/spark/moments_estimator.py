@@ -660,3 +660,80 @@ class Imputer(_adapter.Imputer):
         local.copy_values_from(local_est)
         local.fit_timings_ = timer.as_dict()
         return self._model_cls(local)
+
+
+from spark_rapids_ml_tpu.spark import adapter2 as _adapter2  # noqa: E402
+
+
+class LDA(_adapter2.LDA):
+    """DataFrame LDA whose EM optimizer runs on the executor statistics
+    plane: each variational-EM iteration is one ``mapInArrow`` job
+    emitting per-partition (k, vocab) sufficient statistics under the
+    broadcast topic state (``aggregate.partition_lda_stats``), reduced
+    on the driver into the λ update — rows never reach the driver, the
+    same per-iteration shape as the GaussianMixture EM plane. The
+    ``online`` optimizer keeps the adapter path (its minibatch schedule
+    samples globally, which a partition-local plane cannot reproduce)."""
+
+    def _fit(self, dataset):
+        local_est = self._local
+        if local_est.get_or_default("optimizer") != "em":
+            return super()._fit(dataset)
+
+        from spark_rapids_ml_tpu.models.lda import LDAModel as _LocalLDAM
+        from spark_rapids_ml_tpu.ops.lda_kernel import (
+            dirichlet_expectation,
+        )
+        from spark_rapids_ml_tpu.spark.aggregate import (
+            combine_lda_stats,
+            lda_stats_spark_ddl,
+            partition_lda_stats_arrow,
+        )
+
+        timer = PhaseTimer()
+        fcol = local_est.getInputCol()
+        k = int(local_est.getK())
+        seed = int(local_est.get_or_default("seed"))
+        df = dataset.select(fcol).persist()
+        try:
+            with timer.phase("schema"):
+                probe = df.select(fcol)
+                if hasattr(probe, "limit"):  # real pyspark: 1-row scan
+                    probe = probe.limit(1)
+                first = probe.collect()[:1]
+                if not first:
+                    raise ValueError("cannot fit LDA on an empty dataset")
+                v0 = first[0][0]
+                vocab = (v0.toArray() if hasattr(v0, "toArray")
+                         else np.asarray(v0)).shape[0]
+            alpha_val = local_est._resolved_alpha(k)
+            eta_val = local_est._resolved_eta(k)
+            rng = np.random.default_rng(seed)
+            lam = rng.gamma(100.0, 1.0 / 100.0, (k, vocab))
+            alpha = np.full((k,), alpha_val)
+            n_docs = 0
+            with timer.phase("em_plane"):
+                for it in range(int(local_est.getMaxIter())):
+                    beta = np.exp(np.asarray(dirichlet_expectation(
+                        np.asarray(lam))))
+
+                    def job(batches, _b=beta, _a=alpha, _s=seed + it):
+                        yield from partition_lda_stats_arrow(
+                            batches, fcol, _b, _a, _s)
+
+                    rows = df.mapInArrow(
+                        job, lda_stats_spark_ddl()).collect()
+                    sstats, n_docs = combine_lda_stats(rows, k, vocab)
+                    lam = eta_val + sstats
+        finally:
+            df.unpersist()
+        local = _LocalLDAM(
+            topics=np.asarray(lam, dtype=np.float64),
+            alpha=np.asarray(alpha, dtype=np.float64),
+            eta=float(eta_val),
+            num_docs=int(n_docs),
+        )
+        local.uid = local_est.uid
+        local.copy_values_from(local_est)
+        local.fit_timings_ = timer.as_dict()
+        return self._model_cls(local)

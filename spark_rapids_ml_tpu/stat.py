@@ -340,9 +340,23 @@ class _FeatureTestBase:
             as_vector_frame,
         )
 
-        frame = as_vector_frame(dataset, featuresCol)
-        x = frame.vectors_as_matrix(featuresCol)
-        y = np.asarray(frame.column(labelCol), dtype=np.float64)
+        if _is_dataframe(dataset):
+            # same envelope-guarded collect as ChiSquareTest: these are
+            # global per-feature tests, not partition-decomposable
+            from spark_rapids_ml_tpu.spark.adapter import (
+                _check_collect_envelope,
+            )
+
+            _check_collect_envelope(dataset, type(cls).__name__)
+            rows = dataset.select(featuresCol, labelCol).collect()
+            x = np.asarray(
+                [r[0].toArray() if hasattr(r[0], "toArray")
+                 else np.asarray(r[0], dtype=np.float64) for r in rows])
+            y = np.asarray([float(r[1]) for r in rows])
+        else:
+            frame = as_vector_frame(dataset, featuresCol)
+            x = frame.vectors_as_matrix(featuresCol)
+            y = np.asarray(frame.column(labelCol), dtype=np.float64)
         p, dof, f = cls._scores(x, y)
         return VectorFrame({
             "pValues": [list(map(float, p))],

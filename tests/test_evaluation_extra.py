@@ -195,3 +195,25 @@ def test_anova_and_fvalue_tests_match_scipy(rng):
     expect_f = r * r * (n - 2) / (1 - r * r)
     np.testing.assert_allclose(outf.column("fValues")[0][0], expect_f,
                                rtol=1e-10)
+
+
+def test_anova_test_accepts_dataframes(rng):
+    from spark_rapids_ml_tpu import ANOVATest
+    from spark_rapids_ml_tpu.spark._compat import HAVE_PYSPARK
+
+    if HAVE_PYSPARK:  # pragma: no cover - local-engine lane only
+        pytest.skip("local-engine lane")
+    from spark_rapids_ml_tpu.spark.local_engine import (
+        DenseVector,
+        LocalSparkSession,
+    )
+
+    spark = LocalSparkSession(n_partitions=2)
+    y = rng.integers(0, 2, size=40).astype(np.float64)
+    x = np.column_stack([rng.normal(size=40), y * 3.0])
+    df = spark.createDataFrame(
+        [{"features": DenseVector(r), "label": float(yy)}
+         for r, yy in zip(x, y)])
+    out = ANOVATest.test(df)
+    p = out.column("pValues")[0]
+    assert p[1] < 0.001 and p[0] > 0.001

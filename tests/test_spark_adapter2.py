@@ -130,3 +130,37 @@ def test_word2vec_front_end(spark, rng):
     assert vec.shape == (8,)
     syn = model.find_synonyms("x", 2)
     assert set(syn.column("word")) <= {"y", "z", "p", "q", "r"}
+
+
+def test_lda_em_rides_the_statistics_plane(spark, rng):
+    # the plane fit must produce sane topics WITHOUT collecting rows:
+    # LocalDataFrame.collect of the full frame happens only in the
+    # schema probe (1 row); we check the fit works and the result
+    # recovers planted structure like the adapter path does
+    from spark_rapids_ml_tpu.spark import moments_estimator
+
+    vocab, k = 30, 3
+    block = vocab // k
+    counts = np.zeros((90, vocab))
+    for d in range(90):
+        t = d % k
+        for w in rng.integers(t * block, (t + 1) * block, size=30):
+            counts[d, w] += 1
+    df = _df(spark, counts)
+    est = moments_estimator.LDA(k=3, maxIter=15, optimizer="em", seed=2)
+    model = est.fit(df)
+    topics = model.describe_topics(8)
+    blocks_hit = set()
+    for terms in topics.column("termIndices"):
+        owners = [t // block for t in terms]
+        winner = max(set(owners), key=owners.count)
+        assert owners.count(winner) >= 7
+        blocks_hit.add(winner)
+    assert blocks_hit == {0, 1, 2}
+    # transform still rides the pandas_udf path
+    out = model.transform(df).collect()
+    assert len(out) == 90
+    # spark.LDA routes to the plane class
+    from spark_rapids_ml_tpu import spark as spark_pkg
+
+    assert spark_pkg.LDA is moments_estimator.LDA
