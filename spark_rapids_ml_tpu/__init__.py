@@ -111,6 +111,7 @@ from spark_rapids_ml_tpu.models.feature_transformers2 import (  # noqa: F401
     RFormulaModel,
     UnivariateFeatureSelector,
     UnivariateFeatureSelectorModel,
+    SQLTransformer,
     VectorIndexer,
     VectorIndexerModel,
     VectorSizeHint,
@@ -182,6 +183,7 @@ from spark_rapids_ml_tpu.models.evaluation import (  # noqa: F401
     BinaryClassificationEvaluator,
     ClusteringEvaluator,
     MulticlassClassificationEvaluator,
+    MultilabelClassificationEvaluator,
     RankingEvaluator,
     RegressionEvaluator,
 )
@@ -278,6 +280,8 @@ __all__ = [
     "VectorIndexer",
     "VectorIndexerModel",
     "VectorSizeHint",
+    "SQLTransformer",
+    "MultilabelClassificationEvaluator",
     "UnivariateFeatureSelector",
     "UnivariateFeatureSelectorModel",
     "RFormula",

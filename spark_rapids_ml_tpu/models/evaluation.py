@@ -344,3 +344,96 @@ class RankingEvaluator(Params):
                     for rank in range(min(len(truth), k)))
                 scores.append(dcg / ideal if ideal > 0 else 0.0)
         return float(np.mean(scores)) if scores else 0.0
+
+
+class MultilabelClassificationEvaluator(Params):
+    """Spark 3.0 ``ml.evaluation.MultilabelClassificationEvaluator``
+    over array columns (predicted label sets vs true label sets):
+    f1Measure (default) / subsetAccuracy / accuracy / hammingLoss /
+    precision / recall / microPrecision / microRecall / microF1Measure
+    / precisionByLabel / recallByLabel / f1MeasureByLabel (with
+    ``metricLabel``)."""
+
+    labelCol = Param("labelCol", "true label-set arrays", "label")
+    predictionCol = Param("predictionCol", "predicted label-set arrays",
+                          "prediction")
+    metricName = Param(
+        "metricName",
+        "f1Measure | subsetAccuracy | accuracy | hammingLoss | "
+        "precision | recall | microPrecision | microRecall | "
+        "microF1Measure | precisionByLabel | recallByLabel | "
+        "f1MeasureByLabel",
+        "f1Measure",
+        validator=lambda v: v in (
+            "f1Measure", "subsetAccuracy", "accuracy", "hammingLoss",
+            "precision", "recall", "microPrecision", "microRecall",
+            "microF1Measure", "precisionByLabel", "recallByLabel",
+            "f1MeasureByLabel"))
+    metricLabel = Param("metricLabel", "target label for the ByLabel "
+                        "metrics", 0.0)
+
+    def __init__(self, uid=None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+    def is_larger_better(self) -> bool:
+        return self.getMetricName() != "hammingLoss"
+
+    def evaluate(self, dataset) -> float:
+        frame = as_vector_frame(dataset, self.getPredictionCol())
+        preds = [set(p) for p in frame.column(self.getPredictionCol())]
+        labels = [set(t) for t in frame.column(self.getLabelCol())]
+        name = self.getMetricName()
+        n = len(preds)
+        if n == 0:
+            return 0.0
+        if name.endswith("ByLabel"):
+            lab = self.get_or_default("metricLabel")
+            tp = sum(lab in p and lab in t
+                     for p, t in zip(preds, labels))
+            pp = sum(lab in p for p in preds)
+            ap = sum(lab in t for t in labels)
+            prec = tp / pp if pp else 0.0
+            rec = tp / ap if ap else 0.0
+            if name == "precisionByLabel":
+                return prec
+            if name == "recallByLabel":
+                return rec
+            return (2 * prec * rec / (prec + rec)
+                    if prec + rec else 0.0)
+        if name in ("microPrecision", "microRecall", "microF1Measure"):
+            tp = sum(len(p & t) for p, t in zip(preds, labels))
+            fp = sum(len(p - t) for p, t in zip(preds, labels))
+            fn = sum(len(t - p) for p, t in zip(preds, labels))
+            if name == "microPrecision":
+                return tp / (tp + fp) if tp + fp else 0.0
+            if name == "microRecall":
+                return tp / (tp + fn) if tp + fn else 0.0
+            return (2 * tp / (2 * tp + fp + fn)
+                    if 2 * tp + fp + fn else 0.0)
+        per_doc = []
+        for p, t in zip(preds, labels):
+            inter = len(p & t)
+            if name == "subsetAccuracy":
+                per_doc.append(float(p == t))
+            elif name == "accuracy":
+                union = len(p | t)
+                per_doc.append(inter / union if union else 1.0)
+            elif name == "hammingLoss":
+                per_doc.append(len(p ^ t))
+            elif name == "precision":
+                per_doc.append(inter / len(p) if p else 0.0)
+            elif name == "recall":
+                per_doc.append(inter / len(t) if t else 0.0)
+            else:  # f1Measure: 2|p∩t| / (|p| + |t|), Spark's per-doc F1
+                denom = len(p) + len(t)
+                per_doc.append(2 * inter / denom if denom else 0.0)
+        if name == "hammingLoss":
+            # Spark's MultilabelMetrics: numLabels counts distinct
+            # GROUND-TRUTH labels only — stray predicted labels do not
+            # enlarge the denominator
+            true_labels = set().union(*labels) if labels else set()
+            denom = n * max(len(true_labels), 1)
+            return float(sum(per_doc)) / denom
+        return float(np.mean(per_doc))

@@ -603,3 +603,85 @@ class VectorSizeHint(HasInputCol, Params):
                     "(handleInvalid='error')")
             return frame.select_rows(np.flatnonzero(~bad))
         return frame
+
+
+# --------------------------------------------------------------------------
+# SQLTransformer
+# --------------------------------------------------------------------------
+
+@_persistable
+class SQLTransformer(Params):
+    """Spark's ``SQLTransformer``, the scalar-expression subset:
+    ``SELECT <exprs> FROM __THIS__`` where each expr is ``*``, a column
+    name, or an arithmetic/comparison expression over scalar columns
+    with an ``AS alias`` (evaluated via ``pandas.eval`` — documented
+    subset; joins/aggregations/UDF calls are not supported and raise)."""
+
+    statement = Param("statement", "SELECT ... FROM __THIS__", None)
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+    def transform(self, dataset) -> VectorFrame:
+        import re
+
+        stmt = self.get_or_default("statement")
+        if not stmt:
+            raise ValueError("SQLTransformer requires the statement param")
+        m = re.fullmatch(
+            r"\s*SELECT\s+(?P<cols>.+?)\s+FROM\s+__THIS__"
+            r"(?P<rest>.*?)\s*;?\s*",
+            stmt, flags=re.IGNORECASE | re.DOTALL)
+        if not m:
+            raise ValueError(
+                "statement must look like 'SELECT ... FROM __THIS__' "
+                "(the scalar-expression subset; no joins/GROUP BY)")
+        if m.group("rest").strip():
+            raise ValueError(
+                f"clause after FROM __THIS__ is not supported "
+                f"(scalar-expression subset): {m.group('rest').strip()!r}")
+        for kw in ("JOIN", "GROUP BY", "ORDER BY", "WHERE", "HAVING"):
+            if re.search(rf"\b{kw}\b", m.group("cols"),
+                         flags=re.IGNORECASE):
+                raise ValueError(
+                    f"{kw} is not supported (scalar-expression subset)")
+        # split the select list on top-level commas
+        parts, depth, cur = [], 0, []
+        for ch in m.group("cols"):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            if ch == "," and depth == 0:
+                parts.append("".join(cur).strip())
+                cur = []
+            else:
+                cur.append(ch)
+        parts.append("".join(cur).strip())
+
+        frame = (dataset if isinstance(dataset, VectorFrame)
+                 else as_vector_frame(dataset, None))
+        pdf = None  # built lazily: bare-column selects never pay the
+        # full pandas materialization (2-D columns convert per row)
+        out = {}
+        for part in parts:
+            if part == "*":
+                for c in frame.columns:
+                    out[c] = frame.column(c)
+                continue
+            alias_m = re.fullmatch(
+                r"(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)", part,
+                flags=re.IGNORECASE | re.DOTALL)
+            expr = alias_m.group("expr") if alias_m else part
+            alias = (alias_m.group("alias") if alias_m
+                     else expr.strip())
+            expr = expr.strip()
+            if re.fullmatch(r"\w+", expr):
+                out[alias] = frame.column(expr)
+                continue
+            if pdf is None:
+                pdf = frame.to_pandas()
+            out[alias] = pdf.eval(expr).to_numpy()
+        return VectorFrame(out)

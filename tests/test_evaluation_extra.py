@@ -217,3 +217,56 @@ def test_anova_test_accepts_dataframes(rng):
     out = ANOVATest.test(df)
     p = out.column("pValues")[0]
     assert p[1] < 0.001 and p[0] > 0.001
+
+
+def test_multilabel_evaluator_hand_values():
+    from spark_rapids_ml_tpu import MultilabelClassificationEvaluator
+
+    # Spark MultilabelMetrics doc example
+    frame = VectorFrame({
+        "prediction": [[0.0, 1.0], [0.0, 2.0], [], [2.0],
+                       [2.0, 0.0], [0.0, 1.0, 2.0], [1.0]],
+        "label": [[0.0, 1.0], [0.0, 2.0], [0.0], [2.0],
+                  [2.0, 0.0], [0.0, 1.0], [1.0, 2.0]],
+    })
+
+    def ev(name, **kw):
+        return MultilabelClassificationEvaluator(
+            metricName=name, **kw).evaluate(frame)
+
+    np.testing.assert_allclose(ev("subsetAccuracy"), 4 / 7, atol=1e-12)
+    np.testing.assert_allclose(ev("accuracy"),
+                               (1 + 1 + 0 + 1 + 1 + 2 / 3 + 1 / 2) / 7,
+                               atol=1e-12)
+    np.testing.assert_allclose(
+        ev("hammingLoss"), (0 + 0 + 1 + 0 + 0 + 1 + 1) / (7 * 3),
+        atol=1e-12)
+    np.testing.assert_allclose(
+        ev("precision"),
+        (1 + 1 + 0 + 1 + 1 + 2 / 3 + 1) / 7, atol=1e-12)
+    np.testing.assert_allclose(
+        ev("recall"), (1 + 1 + 0 + 1 + 1 + 1 + 1 / 2) / 7, atol=1e-12)
+    # micro counts over all docs: tp = Σ|p∩t| = 2+2+0+1+2+2+1 = 10,
+    # fp = Σ|p−t| = 1 (doc 6's stray 2), fn = Σ|t−p| = 2 (doc 3's 0,
+    # doc 7's 2) — Spark's MultilabelMetrics doc values
+    np.testing.assert_allclose(ev("microPrecision"), 10 / 11, atol=1e-12)
+    np.testing.assert_allclose(ev("microRecall"), 10 / 12, atol=1e-12)
+    np.testing.assert_allclose(ev("microF1Measure"),
+                               2 * 10 / (2 * 10 + 1 + 2), atol=1e-12)
+    np.testing.assert_allclose(ev("precisionByLabel", metricLabel=0.0),
+                               4 / 4, atol=1e-12)
+    np.testing.assert_allclose(ev("recallByLabel", metricLabel=0.0),
+                               4 / 5, atol=1e-12)
+    assert not MultilabelClassificationEvaluator(
+        metricName="hammingLoss").is_larger_better()
+
+
+def test_multilabel_hamming_uses_truth_label_count():
+    from spark_rapids_ml_tpu import MultilabelClassificationEvaluator
+
+    # stray predicted label 2.0 must NOT enlarge the denominator
+    frame = VectorFrame({"prediction": [[0.0, 2.0]],
+                         "label": [[0.0, 1.0]]})
+    got = MultilabelClassificationEvaluator(
+        metricName="hammingLoss").evaluate(frame)
+    np.testing.assert_allclose(got, 2 / (1 * 2), atol=1e-12)

@@ -241,3 +241,25 @@ def test_vector_size_hint_modes(rng):
     assert len(passthrough) == 3
     with pytest.raises(ValueError, match="requires the size"):
         VectorSizeHint(inputCol="features").transform(frame)
+
+
+def test_sql_transformer_subset():
+    from spark_rapids_ml_tpu import SQLTransformer
+
+    frame = VectorFrame({"v1": [1.0, 2.0], "v2": [3.0, 4.0]})
+    out = SQLTransformer(
+        statement="SELECT *, (v1 + v2) AS v3, v1 * 2 AS dbl "
+                  "FROM __THIS__").transform(frame)
+    assert out.columns == ["v1", "v2", "v3", "dbl"]
+    np.testing.assert_allclose(out.column("v3"), [4.0, 6.0])
+    np.testing.assert_allclose(out.column("dbl"), [2.0, 4.0])
+    # bare column select
+    only = SQLTransformer(statement="SELECT v2 FROM __THIS__"
+                          ).transform(frame)
+    assert only.columns == ["v2"]
+    with pytest.raises(ValueError, match="not supported"):
+        SQLTransformer(statement="SELECT a FROM __THIS__ JOIN t"
+                       ).transform(frame)
+    with pytest.raises(ValueError, match="statement must look"):
+        SQLTransformer(statement="DELETE FROM __THIS__"
+                       ).transform(frame)
