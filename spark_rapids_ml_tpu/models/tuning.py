@@ -109,6 +109,18 @@ class _TuningParams(Params):
     seed = Param(
         "seed", "shuffle seed", 0, validator=lambda v: isinstance(v, int)
     )
+    parallelism = Param(
+        "parallelism",
+        "accepted for Spark surface parity; ignored (each device fit "
+        "already saturates the chip — see the module docstring)",
+        1, validator=lambda v: isinstance(v, int) and v >= 1,
+    )
+    collectSubModels = Param(
+        "collectSubModels",
+        "keep every (paramMap × fold) fitted model on the tuning model "
+        "(Spark semantics; memory scales with the grid)",
+        False, validator=lambda v: isinstance(v, bool),
+    )
 
 
 class CrossValidator(_TuningParams):
@@ -169,8 +181,12 @@ class CrossValidator(_TuningParams):
                 perm[bounds[f]:bounds[f + 1]] for f in range(folds)
             ]
 
+        keep_sub = bool(self.get_or_default("collectSubModels"))
         avg_metrics = []
-        for params in self.estimatorParamMaps:
+        # Spark's indexing: subModels[fold][paramMapIndex]
+        sub_models = ([[None] * len(self.estimatorParamMaps)
+                       for _ in range(folds)] if keep_sub else None)
+        for p_i, params in enumerate(self.estimatorParamMaps):
             scores = []
             for f in range(folds):
                 val_idx = fold_indices[f]
@@ -183,6 +199,8 @@ class CrossValidator(_TuningParams):
                 scores.append(
                     _score(model, self.evaluator, frame.select_rows(val_idx))
                 )
+                if keep_sub:
+                    sub_models[f][p_i] = model
             avg_metrics.append(float(np.mean(scores)))
 
         pick = np.argmax if self.evaluator.is_larger_better() else np.argmin
@@ -195,6 +213,7 @@ class CrossValidator(_TuningParams):
             avgMetrics=avg_metrics,
             bestIndex=best_i,
         )
+        out.subModels = sub_models
         out.uid = self.uid
         out.copy_values_from(self)
         return out
@@ -212,11 +231,13 @@ class CrossValidatorModel(_TuningParams):
         self.bestModel = bestModel
         self.avgMetrics = avgMetrics or []
         self.bestIndex = bestIndex
+        self.subModels = None  # [fold][paramMapIndex], Spark's indexing
 
     def _copy_internal_state(self, other: "CrossValidatorModel") -> None:
         other.bestModel = self.bestModel
         other.avgMetrics = self.avgMetrics
         other.bestIndex = self.bestIndex
+        other.subModels = self.subModels
 
     def transform(self, dataset):
         if self.bestModel is None:
@@ -258,10 +279,14 @@ class TrainValidationSplit(_TuningParams):
         train = frame.select_rows(perm[:n_train])
         val = frame.select_rows(perm[n_train:])
 
+        keep_sub = bool(self.get_or_default("collectSubModels"))
         metrics = []
+        sub_models = [] if keep_sub else None
         for params in self.estimatorParamMaps:
             model = _fit_with(self.estimator, params, train)
             metrics.append(float(_score(model, self.evaluator, val)))
+            if keep_sub:
+                sub_models.append(model)
 
         pick = np.argmax if self.evaluator.is_larger_better() else np.argmin
         best_i = int(pick(metrics))
@@ -271,6 +296,7 @@ class TrainValidationSplit(_TuningParams):
         out = TrainValidationSplitModel(
             bestModel=best_model, validationMetrics=metrics, bestIndex=best_i
         )
+        out.subModels = sub_models
         out.uid = self.uid
         out.copy_values_from(self)
         return out
@@ -288,11 +314,13 @@ class TrainValidationSplitModel(_TuningParams):
         self.bestModel = bestModel
         self.validationMetrics = validationMetrics or []
         self.bestIndex = bestIndex
+        self.subModels = None  # [paramMap] when collectSubModels
 
     def _copy_internal_state(self, other: "TrainValidationSplitModel") -> None:
         other.bestModel = self.bestModel
         other.validationMetrics = self.validationMetrics
         other.bestIndex = self.bestIndex
+        other.subModels = self.subModels
 
     def transform(self, dataset):
         if self.bestModel is None:

@@ -15,7 +15,10 @@ GeneralizedLinearRegression, and GaussianMixture; the envelope-guarded
 driver-collect adapter (``adapter.py``) only for the non-decomposable
 fits (UMAP spectral init, KNN item capture, the MLP's full-batch
 L-BFGS whose linesearch state does not split into cheap per-partition
-jobs) and every Model transform.
+jobs) and every Model transform. The round-4 families ride
+``adapter2.py`` (DTs/LSH and the bespoke ALS/Word2Vec collectors),
+except LDA whose EM optimizer runs per-iteration statistics jobs on
+the moments plane.
 """
 
 from spark_rapids_ml_tpu.spark.aggregate import (  # noqa: F401

@@ -1,4 +1,5 @@
-"""pyspark.ml.stat parity: Correlation, ChiSquareTest, Summarizer.
+"""pyspark.ml.stat parity: Correlation, ChiSquareTest, Summarizer,
+KolmogorovSmirnovTest, ANOVATest, FValueTest.
 
 The reference repo (spark-rapids-ml 21.12, PCA-only) ships none of these;
 they are beyond-parity surface following upstream

@@ -269,3 +269,45 @@ def test_cross_validator_fold_col(rng):
             numFolds=3,
             foldCol="fold",
         ).fit(bad)
+
+
+def test_collect_sub_models(rng):
+    from spark_rapids_ml_tpu import (
+        CrossValidator,
+        LinearRegression,
+        RegressionEvaluator,
+        TrainValidationSplit,
+    )
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    x = rng.normal(size=(60, 3))
+    y = x @ np.array([1.0, -2.0, 0.5])
+    frame = VectorFrame({"features": x, "label": y})
+    grid = [{"regParam": 1e-6}, {"regParam": 1.0}]
+    cv = CrossValidator(
+        estimator=LinearRegression(),
+        estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(),
+        numFolds=3,
+        collectSubModels=True,
+        parallelism=4,  # accepted for parity, documented as ignored
+    )
+    model = cv.fit(frame)
+    # Spark's indexing: subModels[fold][paramMapIndex]
+    assert len(model.subModels) == 3
+    assert all(len(fold) == 2 for fold in model.subModels)
+    assert all(m.coefficients is not None
+               for fold in model.subModels for m in fold)
+    # copy() preserves the collected sub-models
+    assert model.copy().subModels is model.subModels
+    # off by default
+    cv2 = CrossValidator(estimator=LinearRegression(),
+                         estimatorParamMaps=grid,
+                         evaluator=RegressionEvaluator(), numFolds=3)
+    assert cv2.fit(frame).subModels is None
+
+    tvs = TrainValidationSplit(
+        estimator=LinearRegression(), estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(), collectSubModels=True)
+    tm = tvs.fit(frame)
+    assert len(tm.subModels) == 2
