@@ -135,6 +135,24 @@ def _subset_counts(strategy, d: int, classification: bool = False) -> int:
     return min(d, max(1, int(np.ceil(value * d))))
 
 
+def _tree_batch_size(n: int, d: int, depth: int, n_bins: int,
+                     n_channels: int, budget_bytes: int,
+                     n_trees: int, itemsize: int = 4) -> int:
+    """Trees per vmapped grow call under the memory budget.
+
+    The dominant per-tree residents at the deepest level are the node
+    one-hot (n × 2^(depth−1)), the weighted channel matrix (n × C),
+    and the level histograms (C × 2^(depth−1) × d × n_bins) at the
+    resolved compute dtype's ``itemsize``, with 2× headroom for XLA
+    temporaries. The budget comes through the same seam as the
+    statistics-plane tree groups (``maxMemoryInMB``, overridable by
+    SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES)."""
+    deepest = 2 ** max(depth - 1, 0)
+    per_tree = itemsize * (n * deepest + n * n_channels
+                           + n_channels * deepest * d * n_bins) * 2
+    return max(1, min(n_trees, budget_bytes // max(per_tree, 1)))
+
+
 class _ForestBase(RandomForestParams):
     _classification = False
     # single-tree subclasses (DecisionTree*) turn the Poisson bootstrap
@@ -158,8 +176,6 @@ class _ForestBase(RandomForestParams):
 
         from spark_rapids_ml_tpu.ops.forest_kernel import (
             TreeEnsemble,
-            grow_tree_classification,
-            grow_tree_regression,
             quantile_bins,
         )
 
@@ -226,38 +242,64 @@ class _ForestBase(RandomForestParams):
         k_feats = _subset_counts(
             self.getFeatureSubsetStrategy(), d, self._classification
         )
+        n_trees = self.getNumTrees()
+        rate = float(self.getSubsamplingRate())
+        n_channels = len(classes) if self._classification else 3
+        from spark_rapids_ml_tpu.spark.forest_estimator import (
+            _group_budget_bytes,
+        )
+
+        group = _tree_batch_size(
+            n, d, depth, n_bins, n_channels, _group_budget_bytes(self),
+            n_trees, itemsize=jnp.dtype(dtype).itemsize)
         feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
         with timer.phase("grow"), TraceRange("forest grow", TraceColor.RED):
-            rate = float(self.getSubsamplingRate())
-            for _ in range(self.getNumTrees()):
-                w_np = (rng.poisson(rate, n).astype(np.float64)
-                        if self._bootstrap else np.ones(n))
-                if user_w is not None:
-                    w_np *= user_w
-                w = jax.device_put(jnp.asarray(w_np, dtype=dtype), device)
-                mask = np.zeros((depth, d), dtype=np.float64)
-                for lvl in range(depth):
-                    cols = rng.choice(d, size=k_feats, replace=False)
-                    mask[lvl, cols] = 1.0
-                mask_dev = jnp.asarray(mask, dtype=dtype)
+            from spark_rapids_ml_tpu.ops.forest_kernel import (
+                grow_trees_classification_batch,
+                grow_trees_regression_batch,
+            )
+
+            # per-tree bootstrap weights + per-level feature masks are
+            # drawn in the SAME rng order as the historical per-tree
+            # loop (poisson then level choices, tree by tree), filling
+            # only a GROUP-sized weight buffer at a time — never the
+            # full (n_trees, n) table
+            t_done = 0
+            while t_done < n_trees:
+                g_sz = min(group, n_trees - t_done)
+                w_grp = np.empty((g_sz, n), dtype=np.float64)
+                mask_grp = np.zeros((g_sz, depth, d), dtype=np.float64)
+                for g_i in range(g_sz):
+                    w_np = (rng.poisson(rate, n).astype(np.float64)
+                            if self._bootstrap else np.ones(n))
+                    if user_w is not None:
+                        w_np *= user_w
+                    w_grp[g_i] = w_np
+                    for lvl in range(depth):
+                        cols = rng.choice(d, size=k_feats, replace=False)
+                        mask_grp[g_i, lvl, cols] = 1.0
+                wb = jax.device_put(jnp.asarray(w_grp, dtype=dtype),
+                                    device)
+                mb = jnp.asarray(mask_grp, dtype=dtype)
                 if self._classification:
-                    f, t, leaf, g_tree = grow_tree_classification(
-                        binned, y_oh, w, mask_dev, depth, n_bins,
+                    f, t, leaf, g_tree = grow_trees_classification_batch(
+                        binned, y_oh, wb, mb, depth, n_bins,
                         len(classes), self.getMinInstancesPerNode(),
                     )
                 else:
-                    f, t, leaf, g_tree = grow_tree_regression(
-                        binned, y_dev, w, mask_dev, depth, n_bins,
+                    f, t, leaf, g_tree = grow_trees_regression_batch(
+                        binned, y_dev, wb, mb, depth, n_bins,
                         self.getMinInstancesPerNode(),
                     )
                 feats_l.append(f)
                 thrs_l.append(t)
                 leaves_l.append(leaf)
                 gains_l.append(g_tree)
+                t_done += g_sz
         ensemble = TreeEnsemble(
-            feature=jnp.stack(feats_l),
-            threshold=jnp.stack(thrs_l),
-            leaf_value=jnp.stack(leaves_l),
+            feature=jnp.concatenate(feats_l),
+            threshold=jnp.concatenate(thrs_l),
+            leaf_value=jnp.concatenate(leaves_l),
         )
         model = self._model_cls()(
             ensemble=jax.device_get(ensemble),
@@ -268,7 +310,7 @@ class _ForestBase(RandomForestParams):
 
         model.feature_importances_ = feature_importances(
             np.asarray(ensemble.feature),
-            np.stack([np.asarray(g) for g in gains_l]),
+            np.concatenate([np.asarray(g) for g in gains_l]),
             d,
         )
         model.uid = self.uid

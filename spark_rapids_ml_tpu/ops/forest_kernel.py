@@ -370,3 +370,54 @@ def feature_importances(features, gains, n_features: int):
             total += per / tree_sum
     grand = total.sum()
     return total / grand if grand > 0 else total
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "n_bins", "min_leaf", "n_classes"),
+)
+def grow_trees_classification_batch(
+    binned: jnp.ndarray,          # (n, d) shared across trees
+    y_onehot: jnp.ndarray,        # (n, C) shared
+    w_batch: jnp.ndarray,         # (T, n) per-tree bootstrap weights
+    feat_mask_batch: jnp.ndarray,  # (T, max_depth, d)
+    max_depth: int,
+    n_bins: int,
+    n_classes: int,
+    min_leaf: int = 1,
+) -> Tuple[jnp.ndarray, ...]:
+    """Grow T classification trees in ONE compiled program.
+
+    ``vmap`` over the tree axis turns the per-level histogram
+    contraction into a batched MXU matmul across all T trees — one
+    launch per forest instead of T sequential single-tree programs
+    (the shapes are identical per tree, only the bootstrap weights and
+    feature masks vary). Memory scales with T; callers group trees
+    under a budget (``models/random_forest.py::_tree_batch_size``)."""
+    def one(w, mask):
+        return grow_tree_classification(
+            binned, y_onehot, w, mask, max_depth, n_bins, n_classes,
+            min_leaf)
+
+    return jax.vmap(one)(w_batch, feat_mask_batch)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "n_bins", "min_leaf"),
+)
+def grow_trees_regression_batch(
+    binned: jnp.ndarray,
+    y: jnp.ndarray,
+    w_batch: jnp.ndarray,
+    feat_mask_batch: jnp.ndarray,
+    max_depth: int,
+    n_bins: int,
+    min_leaf: int = 1,
+) -> Tuple[jnp.ndarray, ...]:
+    """Regression analogue of ``grow_trees_classification_batch``."""
+    def one(w, mask):
+        return grow_tree_regression(
+            binned, y, w, mask, max_depth, n_bins, min_leaf)
+
+    return jax.vmap(one)(w_batch, feat_mask_batch)

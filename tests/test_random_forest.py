@@ -405,3 +405,26 @@ def test_gbt_thresholds_binary(rng):
     m.set("thresholds", [1e-9, 1.0])
     pred = np.asarray(list(m.transform(frame).column("prediction")))
     assert (pred == 0.0).all()
+
+
+def test_tree_batching_is_invariant_to_group_size(rng):
+    """The vmapped multi-tree grower must produce the SAME ensemble
+    whatever the memory-budgeted group size — group=all, group=1, and
+    anything between differ only in launch batching."""
+    from spark_rapids_ml_tpu import RandomForestClassifier
+
+    x = rng.normal(size=(300, 6))
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    big = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
+           .setSeed(11).setMaxMemoryInMB(4096).fit(x, y))
+    tiny = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
+            .setSeed(11).setMaxMemoryInMB(1).fit(x, y))
+    np.testing.assert_array_equal(np.asarray(big.ensemble_.feature),
+                                  np.asarray(tiny.ensemble_.feature))
+    np.testing.assert_array_equal(np.asarray(big.ensemble_.threshold),
+                                  np.asarray(tiny.ensemble_.threshold))
+    np.testing.assert_allclose(np.asarray(big.ensemble_.leaf_value),
+                               np.asarray(tiny.ensemble_.leaf_value),
+                               atol=1e-12)
+    np.testing.assert_allclose(big.feature_importances_,
+                               tiny.feature_importances_, atol=1e-12)
