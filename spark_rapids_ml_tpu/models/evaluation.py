@@ -15,7 +15,18 @@ from spark_rapids_ml_tpu.data.frame import as_vector_frame
 from spark_rapids_ml_tpu.models.params import Param, Params
 
 
-class RegressionEvaluator(Params):
+
+class _KwargsInit:
+    """Shared kwargs constructor for the evaluators: ``Ev(metricName=..)``
+    — one copy instead of six identical __init__ bodies."""
+
+    def __init__(self, uid=None, **params):
+        super().__init__(uid=uid)
+        for name, value in params.items():
+            self.set(name, value)
+
+
+class RegressionEvaluator(_KwargsInit, Params):
     """rmse (default) / mse / mae / r2 over (labelCol, predictionCol)."""
 
     labelCol = Param("labelCol", "label column name", "label")
@@ -52,7 +63,7 @@ class RegressionEvaluator(Params):
         return 1.0 - float((resid**2).sum()) / ss_tot
 
 
-class BinaryClassificationEvaluator(Params):
+class BinaryClassificationEvaluator(_KwargsInit, Params):
     """areaUnderROC (default) / areaUnderPR over (labelCol, score column).
 
     ``rawPredictionCol`` accepts any monotone score — this framework's
@@ -126,7 +137,7 @@ class BinaryClassificationEvaluator(Params):
         return float(trapezoid(precision, recall))
 
 
-class MulticlassClassificationEvaluator(Params):
+class MulticlassClassificationEvaluator(_KwargsInit, Params):
     """Spark's multiclass metric set over (labelCol, predictionCol):
     accuracy | f1 (default) | weightedPrecision | weightedRecall —
     ``org.apache.spark.ml.evaluation.MulticlassClassificationEvaluator``
@@ -183,7 +194,7 @@ class MulticlassClassificationEvaluator(Params):
         return float((weights * f1).sum())
 
 
-class ClusteringEvaluator(Params):
+class ClusteringEvaluator(_KwargsInit, Params):
     """Silhouette over (featuresCol, predictionCol) — Spark's
     ``ml.evaluation.ClusteringEvaluator`` (metricName='silhouette',
     distanceMeasure 'squaredEuclidean' default | 'cosine').
@@ -206,11 +217,6 @@ class ClusteringEvaluator(Params):
         "distanceMeasure", "squaredEuclidean | cosine",
         "squaredEuclidean",
         validator=lambda v: v in ("squaredEuclidean", "cosine"))
-
-    def __init__(self, uid=None, **params):
-        super().__init__(uid=uid)
-        for name, value in params.items():
-            self.set(name, value)
 
     def is_larger_better(self) -> bool:
         return True
@@ -265,7 +271,7 @@ class ClusteringEvaluator(Params):
         return float(s.mean())
 
 
-class RankingEvaluator(Params):
+class RankingEvaluator(_KwargsInit, Params):
     """Spark 3.0 ``ml.evaluation.RankingEvaluator`` over array columns:
     predictionCol holds ranked predicted ids, labelCol the relevant-id
     ground truth. meanAveragePrecision (default) / precisionAtK /
@@ -284,11 +290,6 @@ class RankingEvaluator(Params):
             "precisionAtK", "ndcgAtK", "recallAtK"))
     k = Param("k", "ranking cutoff for the @K metrics", 10,
               validator=lambda v: isinstance(v, int) and v >= 1)
-
-    def __init__(self, uid=None, **params):
-        super().__init__(uid=uid)
-        for name, value in params.items():
-            self.set(name, value)
 
     def is_larger_better(self) -> bool:
         return True
@@ -346,7 +347,7 @@ class RankingEvaluator(Params):
         return float(np.mean(scores)) if scores else 0.0
 
 
-class MultilabelClassificationEvaluator(Params):
+class MultilabelClassificationEvaluator(_KwargsInit, Params):
     """Spark 3.0 ``ml.evaluation.MultilabelClassificationEvaluator``
     over array columns (predicted label sets vs true label sets):
     f1Measure (default) / subsetAccuracy / accuracy / hammingLoss /
@@ -371,11 +372,6 @@ class MultilabelClassificationEvaluator(Params):
             "f1MeasureByLabel"))
     metricLabel = Param("metricLabel", "target label for the ByLabel "
                         "metrics", 0.0)
-
-    def __init__(self, uid=None, **params):
-        super().__init__(uid=uid)
-        for name, value in params.items():
-            self.set(name, value)
 
     def is_larger_better(self) -> bool:
         return self.getMetricName() != "hammingLoss"
@@ -437,3 +433,22 @@ class MultilabelClassificationEvaluator(Params):
             denom = n * max(len(true_labels), 1)
             return float(sum(per_doc)) / denom
         return float(np.mean(per_doc))
+
+
+def _attach_evaluator_persistence():
+    """Params-only save/load for every evaluator (Spark's evaluators are
+    DefaultParamsWritable; CrossValidator persistence nests them)."""
+    from spark_rapids_ml_tpu.io.persistence import load_params, save_params
+
+    def save(self, path, overwrite=False):
+        save_params(self, path, overwrite=overwrite)
+
+    for cls in (RegressionEvaluator, BinaryClassificationEvaluator,
+                MulticlassClassificationEvaluator, ClusteringEvaluator,
+                RankingEvaluator, MultilabelClassificationEvaluator):
+        cls.save = save
+        cls.load = classmethod(
+            lambda c, path: load_params(c, path))
+
+
+_attach_evaluator_persistence()

@@ -245,13 +245,19 @@ class _ForestBase(RandomForestParams):
         n_trees = self.getNumTrees()
         rate = float(self.getSubsamplingRate())
         n_channels = len(classes) if self._classification else 3
-        from spark_rapids_ml_tpu.spark.forest_estimator import (
-            _group_budget_bytes,
+        from spark_rapids_ml_tpu.utils.resources import (
+            tree_group_budget_bytes,
         )
 
         group = _tree_batch_size(
-            n, d, depth, n_bins, n_channels, _group_budget_bytes(self),
-            n_trees, itemsize=jnp.dtype(dtype).itemsize)
+            n, d, depth, n_bins, n_channels,
+            tree_group_budget_bytes(self), n_trees,
+            itemsize=jnp.dtype(dtype).itemsize)
+        # balanced groups: ceil-split so every launch shares ONE
+        # compiled shape (a greedy tail group would trigger a second
+        # multi-second XLA compile of the vmapped grower)
+        n_groups = -(-n_trees // group)
+        group = -(-n_trees // n_groups)
         feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
         with timer.phase("grow"), TraceRange("forest grow", TraceColor.RED):
             from spark_rapids_ml_tpu.ops.forest_kernel import (

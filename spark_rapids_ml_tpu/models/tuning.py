@@ -45,9 +45,14 @@ class ParamGridBuilder:
 
 def _input_frame(estimator, dataset):
     """Resolve the feature column: the estimator's own inputCol, or — for a
-    Pipeline, which has no inputCol — the first stage that declares one."""
+    Pipeline, which has no inputCol — the first stage that declares one.
+    Estimators without a vector column at all (ALS consumes scalar
+    rating triples) resolve on their primary key column instead, which
+    only validates presence — row subsetting works on any column."""
     if estimator.has_param("inputCol"):
         return as_vector_frame(dataset, estimator.getInputCol())
+    if estimator.has_param("userCol"):  # ALS-shaped input
+        return as_vector_frame(dataset, estimator.getUserCol())
     if hasattr(estimator, "getStages"):
         for stage in estimator.getStages():
             if hasattr(stage, "has_param") and stage.has_param("inputCol"):
@@ -214,6 +219,10 @@ class CrossValidator(_TuningParams):
             bestIndex=best_i,
         )
         out.subModels = sub_models
+        # Spark's model writer persists the provenance triple
+        out.estimator = self.estimator
+        out.evaluator = self.evaluator
+        out.estimatorParamMaps = self.estimatorParamMaps
         out.uid = self.uid
         out.copy_values_from(self)
         return out
@@ -232,12 +241,18 @@ class CrossValidatorModel(_TuningParams):
         self.avgMetrics = avgMetrics or []
         self.bestIndex = bestIndex
         self.subModels = None  # [fold][paramMapIndex], Spark's indexing
+        self.estimator = None
+        self.evaluator = None
+        self.estimatorParamMaps = None
 
     def _copy_internal_state(self, other: "CrossValidatorModel") -> None:
         other.bestModel = self.bestModel
         other.avgMetrics = self.avgMetrics
         other.bestIndex = self.bestIndex
         other.subModels = self.subModels
+        other.estimator = self.estimator
+        other.evaluator = self.evaluator
+        other.estimatorParamMaps = self.estimatorParamMaps
 
     def transform(self, dataset):
         if self.bestModel is None:
@@ -297,6 +312,9 @@ class TrainValidationSplit(_TuningParams):
             bestModel=best_model, validationMetrics=metrics, bestIndex=best_i
         )
         out.subModels = sub_models
+        out.estimator = self.estimator
+        out.evaluator = self.evaluator
+        out.estimatorParamMaps = self.estimatorParamMaps
         out.uid = self.uid
         out.copy_values_from(self)
         return out
@@ -315,14 +333,121 @@ class TrainValidationSplitModel(_TuningParams):
         self.validationMetrics = validationMetrics or []
         self.bestIndex = bestIndex
         self.subModels = None  # [paramMap] when collectSubModels
+        self.estimator = None
+        self.evaluator = None
+        self.estimatorParamMaps = None
 
     def _copy_internal_state(self, other: "TrainValidationSplitModel") -> None:
         other.bestModel = self.bestModel
         other.validationMetrics = self.validationMetrics
         other.bestIndex = self.bestIndex
         other.subModels = self.subModels
+        other.estimator = self.estimator
+        other.evaluator = self.evaluator
+        other.estimatorParamMaps = self.estimatorParamMaps
 
     def transform(self, dataset):
         if self.bestModel is None:
             raise ValueError("no bestModel; fit first")
         return self.bestModel.transform(dataset)
+
+
+def _save_tuning(obj, path: str, overwrite: bool, metrics_key: str,
+                 metrics) -> None:
+    """Shared writer for the tuning estimators/models: own params as
+    metadata (paramMaps + metrics in `extra`), the estimator/evaluator/
+    bestModel as nested self-describing directories (the Pipeline stage
+    convention — each loads back via its recorded pythonClass)."""
+    import os
+
+    from spark_rapids_ml_tpu.io.persistence import (
+        _require_target,
+        _write_metadata,
+    )
+    from spark_rapids_ml_tpu.models.pipeline import _save_stage
+
+    _require_target(path, overwrite)
+    extra = {"estimatorParamMaps": getattr(obj, "estimatorParamMaps",
+                                           None)}
+    if metrics is not None:
+        extra[metrics_key] = metrics
+    if hasattr(obj, "bestIndex"):
+        extra["bestIndex"] = int(obj.bestIndex)
+    cls = f"{type(obj).__module__}.{type(obj).__qualname__}"
+    _write_metadata(path, cls, obj.uid, obj.param_map_for_metadata(),
+                    extra=extra)
+    for name in ("estimator", "evaluator"):
+        sub = getattr(obj, name, None)
+        if sub is not None:
+            _save_stage(sub, os.path.join(path, name))
+    best = getattr(obj, "bestModel", None)
+    if best is not None:
+        _save_stage(best, os.path.join(path, "bestModel"))
+
+
+def _load_tuning(cls, path: str):
+    import os
+
+    from spark_rapids_ml_tpu.io.persistence import (
+        _read_metadata,
+        _restore_params,
+    )
+    from spark_rapids_ml_tpu.models.pipeline import _load_stage
+
+    meta = _read_metadata(path)
+    obj = cls(uid=meta["uid"])
+    _restore_params(obj, meta)
+    extra = meta.get("extra", {})
+    if extra.get("estimatorParamMaps") is not None and hasattr(
+            obj, "estimatorParamMaps"):
+        obj.estimatorParamMaps = extra["estimatorParamMaps"]
+    for name in ("estimator", "evaluator"):
+        sub_path = os.path.join(path, name)
+        if os.path.isdir(sub_path) and hasattr(obj, name):
+            setattr(obj, name, _load_stage(sub_path))
+    best_path = os.path.join(path, "bestModel")
+    if os.path.isdir(best_path) and hasattr(obj, "bestModel"):
+        obj.bestModel = _load_stage(best_path)
+    if hasattr(obj, "bestIndex") and "bestIndex" in extra:
+        obj.bestIndex = int(extra["bestIndex"])
+    if hasattr(obj, "avgMetrics") and "avgMetrics" in extra:
+        obj.avgMetrics = [float(v) for v in extra["avgMetrics"]]
+    if hasattr(obj, "validationMetrics") and (
+            "validationMetrics" in extra):
+        obj.validationMetrics = [float(v)
+                                 for v in extra["validationMetrics"]]
+    return obj
+
+
+def _attach_tuning_persistence():
+    """save/load for the four tuning classes (Spark's MLWritable
+    surface; subModels are not persisted, matching Spark's default
+    writer)."""
+
+    def est_save(self, path, overwrite=False):
+        _save_tuning(self, path, overwrite, "metrics", None)
+
+    CrossValidator.save = est_save
+    TrainValidationSplit.save = est_save
+    CrossValidator.load = classmethod(
+        lambda cls, path: _load_tuning(cls, path))
+    TrainValidationSplit.load = classmethod(
+        lambda cls, path: _load_tuning(cls, path))
+
+    def cvm_save(self, path, overwrite=False):
+        _save_tuning(self, path, overwrite, "avgMetrics",
+                     list(self.avgMetrics))
+
+    def tvsm_save(self, path, overwrite=False):
+        _save_tuning(self, path, overwrite, "validationMetrics",
+                     list(self.validationMetrics))
+
+    CrossValidatorModel.save = cvm_save
+    TrainValidationSplitModel.save = tvsm_save
+    CrossValidatorModel.load = classmethod(
+        lambda cls, path: _load_tuning(cls, path))
+    TrainValidationSplitModel.load = classmethod(
+        lambda cls, path: _load_tuning(cls, path))
+
+
+_attach_tuning_persistence()

@@ -73,8 +73,13 @@ def distributed_als_fit(
 
     rng = np.random.default_rng(seed)
     scale = 1.0 / np.sqrt(rank)
-    u0 = np.abs(rng.normal(size=(u_idx.shape[0], rank))) * scale
-    v0 = np.abs(rng.normal(size=(i_idx.shape[0], rank))) * scale
+    # signed init like the single-chip kernel (abs only for NNLS —
+    # see ops/als_kernel.py's init note)
+    u0 = rng.normal(size=(u_idx.shape[0], rank)) * scale
+    v0 = rng.normal(size=(i_idx.shape[0], rank)) * scale
+    if nonneg:
+        u0 = np.abs(u0)
+        v0 = np.abs(v0)
     # pad rows start at ZERO: implicit mode's dense YᵀY Gram sums the
     # whole gathered table, so random pad rows would bias the first
     # half-sweep's normal equations relative to the single-chip kernel

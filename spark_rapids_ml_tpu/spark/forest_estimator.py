@@ -48,27 +48,12 @@ from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 
 
 def _group_budget_bytes(local_est=None) -> int:
-    """Per-partition histogram payload budget for level-synchronous tree
-    groups: the estimator's ``maxMemoryInMB`` (Spark's aggregation-memory
-    knob, default 256), overridable by SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES.
-    Parsed lazily at fit time so a malformed env value fails the FIT with
-    a clear message (and later env changes take effect), not the package
-    import."""
-    raw = os.environ.get("SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES")
-    if raw is not None:
-        try:
-            value = int(raw)
-            if value < 1:
-                raise ValueError
-            return value
-        except ValueError:
-            raise ValueError(
-                f"SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES={raw!r}: expected "
-                "a positive integer byte count"
-            ) from None
-    if local_est is not None and local_est.has_param("maxMemoryInMB"):
-        return int(local_est.get_or_default("maxMemoryInMB")) * 1024 * 1024
-    return 64 * 1024 * 1024
+    """One budget seam for tree groups everywhere — delegates to
+    ``utils.resources.tree_group_budget_bytes`` (shared with the local
+    vmapped forest fit)."""
+    from spark_rapids_ml_tpu.utils.resources import tree_group_budget_bytes
+
+    return tree_group_budget_bytes(local_est)
 
 
 def _num_partitions(df) -> int:

@@ -165,3 +165,29 @@ def resolve_device_ordinal(
     if env.get(_ENV_TASK_DEVICE):
         return int(env[_ENV_TASK_DEVICE])
     return 0
+
+
+def tree_group_budget_bytes(local_est=None) -> int:
+    """Tree-group memory budget shared by the LOCAL vmapped forest fit
+    and the statistics-plane tree groups: the estimator's
+    ``maxMemoryInMB`` (Spark's aggregation-memory knob, default 256 on
+    the estimators; 64MB bare default), overridable by
+    SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES. Parsed lazily at fit time so
+    a malformed env value fails the FIT with a clear message."""
+    import os
+
+    raw = os.environ.get("SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES")
+    if raw is not None:
+        try:
+            value = int(raw)
+            if value < 1:
+                raise ValueError
+            return value
+        except ValueError:
+            raise ValueError(
+                f"SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES={raw!r}: expected "
+                "a positive integer byte count"
+            ) from None
+    if local_est is not None and local_est.has_param("maxMemoryInMB"):
+        return int(local_est.get_or_default("maxMemoryInMB")) * 1024 * 1024
+    return 64 * 1024 * 1024

@@ -415,10 +415,18 @@ def test_tree_batching_is_invariant_to_group_size(rng):
 
     x = rng.normal(size=(300, 6))
     y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    import os
+
     big = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
-           .setSeed(11).setMaxMemoryInMB(4096).fit(x, y))
-    tiny = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
-            .setSeed(11).setMaxMemoryInMB(1).fit(x, y))
+           .setSeed(11).fit(x, y))
+    # force group=1 through the shared env seam so the grouped RNG
+    # ordering + cross-group concatenation genuinely exercise
+    os.environ["SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES"] = "1"
+    try:
+        tiny = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
+                .setSeed(11).fit(x, y))
+    finally:
+        del os.environ["SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES"]
     np.testing.assert_array_equal(np.asarray(big.ensemble_.feature),
                                   np.asarray(tiny.ensemble_.feature))
     np.testing.assert_array_equal(np.asarray(big.ensemble_.threshold),
