@@ -17,13 +17,25 @@ from spark_rapids_ml_tpu.models.params import Param, Params
 
 
 class _KwargsInit:
-    """Shared kwargs constructor for the evaluators: ``Ev(metricName=..)``
-    — one copy instead of six identical __init__ bodies."""
+    """Shared evaluator base: the kwargs constructor
+    (``Ev(metricName=..)``) and Spark's DefaultParamsWritable-style
+    params-only persistence — one copy instead of six."""
 
     def __init__(self, uid=None, **params):
         super().__init__(uid=uid)
         for name, value in params.items():
             self.set(name, value)
+
+    def save(self, path, overwrite=False):
+        from spark_rapids_ml_tpu.io.persistence import save_params
+
+        save_params(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path):
+        from spark_rapids_ml_tpu.io.persistence import load_params
+
+        return load_params(cls, path)
 
 
 class RegressionEvaluator(_KwargsInit, Params):
@@ -433,22 +445,3 @@ class MultilabelClassificationEvaluator(_KwargsInit, Params):
             denom = n * max(len(true_labels), 1)
             return float(sum(per_doc)) / denom
         return float(np.mean(per_doc))
-
-
-def _attach_evaluator_persistence():
-    """Params-only save/load for every evaluator (Spark's evaluators are
-    DefaultParamsWritable; CrossValidator persistence nests them)."""
-    from spark_rapids_ml_tpu.io.persistence import load_params, save_params
-
-    def save(self, path, overwrite=False):
-        save_params(self, path, overwrite=overwrite)
-
-    for cls in (RegressionEvaluator, BinaryClassificationEvaluator,
-                MulticlassClassificationEvaluator, ClusteringEvaluator,
-                RankingEvaluator, MultilabelClassificationEvaluator):
-        cls.save = save
-        cls.load = classmethod(
-            lambda c, path: load_params(c, path))
-
-
-_attach_evaluator_persistence()

@@ -109,6 +109,13 @@ class LDA(_LDAParams):
             online_update_kernel,
         )
 
+        # out-of-core: a zero-arg chunk factory streams the corpus
+        # through fixed (batch, mask) buckets — the online optimizer's
+        # minibatches ARE the stream; EM accumulates one sufficient-
+        # statistics pass per iteration
+        if callable(dataset):
+            return _lda_fit_streamed(self, dataset)
+
         timer = PhaseTimer()
         frame = as_vector_frame(dataset, self.getInputCol())
         with timer.phase("densify"):
@@ -214,6 +221,124 @@ def _trigamma(x):
     series = inv + 0.5 * inv2 + inv2 * inv * (
         1.0 / 6.0 - inv2 * (1.0 / 30.0 - inv2 / 42.0))
     return acc + series
+
+
+def _finish_lda_model(est, lam, alpha, eta, n_docs, timer) -> "LDAModel":
+    import numpy as np
+
+    model = LDAModel(
+        topics=np.asarray(lam, dtype=np.float64),
+        alpha=np.asarray(alpha, dtype=np.float64),
+        eta=float(eta),
+        num_docs=int(n_docs),
+    )
+    model.uid = est.uid
+    model.copy_values_from(est)
+    model.fit_timings_ = timer.as_dict()
+    return model
+
+
+def _lda_fit_streamed(self, factory) -> "LDAModel":
+    """Out-of-core LDA over a zero-arg chunk factory.
+
+    Chunks re-block into fixed padded+masked buckets
+    (``data/batches.BatchSource``): padded documents carry zero counts
+    and contribute nothing to the statistics, so the kernels need no
+    mask plumbing — only the online corpus-scale uses the true valid
+    count. ``online`` treats each bucket as a stochastic minibatch
+    (one rho step per bucket, maxIter epochs over the stream); ``em``
+    accumulates one full sufficient-statistics pass per iteration.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_ml_tpu.data.batches import BatchSource, auto_batch_rows
+    from spark_rapids_ml_tpu.ops.lda_kernel import (
+        dirichlet_expectation,
+        e_step_kernel,
+        online_update_kernel,
+    )
+
+    from spark_rapids_ml_tpu.data.batches import _as_chunk
+
+    timer = PhaseTimer()
+    with timer.phase("count_pass"):
+        n_docs = 0
+        vocab = None
+        for chunk in factory():
+            arr = _as_chunk(chunk)  # BatchSource's chunk contract
+            if (arr < 0).any():
+                raise ValueError("LDA requires nonnegative term counts")
+            n_docs += arr.shape[0]
+            vocab = arr.shape[1] if vocab is None else vocab
+            if arr.shape[1] != vocab:
+                raise ValueError("inconsistent vocab width across chunks")
+        if not n_docs:
+            raise ValueError("cannot fit LDA on an empty dataset")
+    k = int(self.getK())
+    alpha0 = self._resolved_alpha(k)
+    eta = self._resolved_eta(k)
+    device = _resolve_device(self.getDeviceId())
+    dtype = _resolve_dtype(self.getDtype())
+    rng = np.random.default_rng(int(self.getSeed()))
+    key = jax.random.PRNGKey(int(self.getSeed()))
+    lam = jax.device_put(jnp.asarray(
+        rng.gamma(100.0, 1.0 / 100.0, (k, vocab)), dtype=dtype), device)
+    alpha = jnp.full((k,), alpha0, dtype=dtype)
+    eta_dev = jnp.asarray(eta, dtype=dtype)
+    # bucket rows: the bandwidth-targeted auto size, but never far past
+    # the corpus itself — padding a small corpus to a 128MB bucket would
+    # spend every e-step on zero-count rows
+    bucket = min(auto_batch_rows(vocab),
+                 1 << max(8, (n_docs - 1).bit_length()))
+    source = BatchSource(factory, batch_rows=bucket, n_features=vocab)
+    optimizer = self.get_or_default("optimizer")
+    with timer.phase("fit_kernel"), TraceRange("lda train",
+                                               TraceColor.GREEN):
+        if optimizer == "online":
+            tau0 = float(self.get_or_default("learningOffset"))
+            kappa = float(self.get_or_default("learningDecay"))
+            opt_alpha = bool(
+                self.get_or_default("optimizeDocConcentration"))
+            t = 0
+            for _ in range(int(self.getMaxIter())):
+                for batch, mask in source.batches():
+                    valid = (int(mask.sum()) if mask is not None
+                             else batch.shape[0])
+                    if not valid:
+                        continue
+                    rho = jnp.asarray((tau0 + t) ** (-kappa),
+                                      dtype=dtype)
+                    key, sub = jax.random.split(key)
+                    lam, gamma = online_update_kernel(
+                        lam,
+                        jax.device_put(jnp.asarray(batch, dtype=dtype),
+                                       device),
+                        alpha, eta_dev, rho,
+                        jnp.asarray(n_docs / valid, dtype=dtype), sub)
+                    if opt_alpha:
+                        g = np.asarray(gamma)
+                        if mask is not None:
+                            g = g[np.asarray(mask) > 0]
+                        alpha = _update_alpha(
+                            alpha, jnp.asarray(g, dtype=dtype), rho)
+                    t += 1
+        else:  # full-corpus EM, one statistics pass per iteration
+            for _ in range(int(self.getMaxIter())):
+                exp_elog_beta = jnp.exp(dirichlet_expectation(lam))
+                sstats = jnp.zeros((k, vocab), dtype=dtype)
+                for batch, _mask in source.batches():
+                    key, sub = jax.random.split(key)
+                    _, part = e_step_kernel(
+                        jax.device_put(jnp.asarray(batch, dtype=dtype),
+                                       device),
+                        exp_elog_beta, alpha, sub)
+                    sstats = sstats + part
+                lam = eta_dev + sstats
+        lam = jax.block_until_ready(lam)
+    return _finish_lda_model(self, lam, alpha, eta, n_docs, timer)
+
 
 
 class LDAModel(_LDAParams):

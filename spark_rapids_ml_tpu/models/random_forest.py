@@ -253,9 +253,10 @@ class _ForestBase(RandomForestParams):
             n, d, depth, n_bins, n_channels,
             tree_group_budget_bytes(self), n_trees,
             itemsize=jnp.dtype(dtype).itemsize)
-        # balanced groups: ceil-split so every launch shares ONE
-        # compiled shape (a greedy tail group would trigger a second
-        # multi-second XLA compile of the vmapped grower)
+        # balanced ceil-split, then PAD the tail group with zero-weight
+        # dummy trees (outputs sliced off) so every launch genuinely
+        # shares one compiled shape — an odd tail would otherwise
+        # trigger a second multi-second XLA compile of the grower
         n_groups = -(-n_trees // group)
         group = -(-n_trees // n_groups)
         feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
@@ -273,8 +274,8 @@ class _ForestBase(RandomForestParams):
             t_done = 0
             while t_done < n_trees:
                 g_sz = min(group, n_trees - t_done)
-                w_grp = np.empty((g_sz, n), dtype=np.float64)
-                mask_grp = np.zeros((g_sz, depth, d), dtype=np.float64)
+                w_grp = np.zeros((group, n), dtype=np.float64)
+                mask_grp = np.zeros((group, depth, d), dtype=np.float64)
                 for g_i in range(g_sz):
                     w_np = (rng.poisson(rate, n).astype(np.float64)
                             if self._bootstrap else np.ones(n))
@@ -297,10 +298,10 @@ class _ForestBase(RandomForestParams):
                         binned, y_dev, wb, mb, depth, n_bins,
                         self.getMinInstancesPerNode(),
                     )
-                feats_l.append(f)
-                thrs_l.append(t)
-                leaves_l.append(leaf)
-                gains_l.append(g_tree)
+                feats_l.append(f[:g_sz])
+                thrs_l.append(t[:g_sz])
+                leaves_l.append(leaf[:g_sz])
+                gains_l.append(g_tree[:g_sz])
                 t_done += g_sz
         ensemble = TreeEnsemble(
             feature=jnp.concatenate(feats_l),

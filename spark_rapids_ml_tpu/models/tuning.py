@@ -98,6 +98,22 @@ def _score(model, evaluator, frame):
     return evaluator.evaluate(model.transform(frame))
 
 
+def _best_index(metrics, larger_better: bool) -> int:
+    """NaN-safe winner pick: a NaN score (e.g. cold-start NaN
+    predictions reaching an RMSE evaluator) counts as the WORST
+    possible value instead of silently winning via np.argmin/argmax's
+    NaN propagation."""
+    worst = -np.inf if larger_better else np.inf
+    clean = [worst if not np.isfinite(m) else m for m in metrics]
+    if all(not np.isfinite(m) for m in metrics):
+        raise ValueError(
+            f"every candidate scored non-finite ({metrics}); for ALS "
+            "use coldStartStrategy='drop' so held-out unseen ids don't "
+            "poison the metric")
+    pick = np.argmax if larger_better else np.argmin
+    return int(pick(clean))
+
+
 class _TuningParams(Params):
     numFolds = Param(
         "numFolds",
@@ -208,8 +224,8 @@ class CrossValidator(_TuningParams):
                     sub_models[f][p_i] = model
             avg_metrics.append(float(np.mean(scores)))
 
-        pick = np.argmax if self.evaluator.is_larger_better() else np.argmin
-        best_i = int(pick(avg_metrics))
+        best_i = _best_index(avg_metrics,
+                             self.evaluator.is_larger_better())
         best_model = _fit_with(
             self.estimator, self.estimatorParamMaps[best_i], frame
         )
@@ -303,8 +319,8 @@ class TrainValidationSplit(_TuningParams):
             if keep_sub:
                 sub_models.append(model)
 
-        pick = np.argmax if self.evaluator.is_larger_better() else np.argmin
-        best_i = int(pick(metrics))
+        best_i = _best_index(metrics,
+                             self.evaluator.is_larger_better())
         best_model = _fit_with(
             self.estimator, self.estimatorParamMaps[best_i], frame
         )

@@ -25,7 +25,6 @@ those fits are not partition-decomposable.)
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 

@@ -407,7 +407,7 @@ def test_gbt_thresholds_binary(rng):
     assert (pred == 0.0).all()
 
 
-def test_tree_batching_is_invariant_to_group_size(rng):
+def test_tree_batching_is_invariant_to_group_size(rng, monkeypatch):
     """The vmapped multi-tree grower must produce the SAME ensemble
     whatever the memory-budgeted group size — group=all, group=1, and
     anything between differ only in launch batching."""
@@ -415,18 +415,22 @@ def test_tree_batching_is_invariant_to_group_size(rng):
 
     x = rng.normal(size=(300, 6))
     y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
-    import os
-
+    # isolate from any ambient override so 'big' truly batches all 6
+    monkeypatch.delenv("SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES",
+                       raising=False)
     big = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
            .setSeed(11).fit(x, y))
     # force group=1 through the shared env seam so the grouped RNG
     # ordering + cross-group concatenation genuinely exercise
-    os.environ["SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES"] = "1"
-    try:
-        tiny = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
-                .setSeed(11).fit(x, y))
-    finally:
-        del os.environ["SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES"]
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES", "1")
+    tiny = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
+            .setSeed(11).fit(x, y))
+    monkeypatch.delenv("SPARK_RAPIDS_ML_TPU_TREE_GROUP_BYTES")
+    # maxMemoryInMB path (the param seam, no env override in play)
+    mid = (RandomForestClassifier().setNumTrees(6).setMaxDepth(3)
+           .setSeed(11).setMaxMemoryInMB(1).fit(x, y))
+    np.testing.assert_array_equal(np.asarray(big.ensemble_.feature),
+                                  np.asarray(mid.ensemble_.feature))
     np.testing.assert_array_equal(np.asarray(big.ensemble_.feature),
                                   np.asarray(tiny.ensemble_.feature))
     np.testing.assert_array_equal(np.asarray(big.ensemble_.threshold),
