@@ -105,6 +105,8 @@ _ADAPTER2_CLASSES = (
     "DecisionTreeClassifierModel",
     "DecisionTreeRegressor",
     "DecisionTreeRegressorModel",
+    "FPGrowth",
+    "FPGrowthModel",
     # NOTE: "LDA" routes to the moments plane (EM iterations as
     # executor statistics jobs); only the Model class lives here
     "LDAModel",

@@ -50,10 +50,16 @@ from spark_rapids_ml_tpu.models.word2vec import (  # noqa: E402
     Word2Vec as _LW2V,
     Word2VecModel as _LW2V_M,
 )
+from spark_rapids_ml_tpu.models.fpm import (  # noqa: E402
+    FPGrowth as _LFPG,
+    FPGrowthModel as _LFPG_M,
+)
 
 __all__ = [
     "ALS",
     "ALSModel",
+    "FPGrowth",
+    "FPGrowthModel",
     "BucketedRandomProjectionLSH",
     "BucketedRandomProjectionLSHModel",
     "DecisionTreeClassifier",
@@ -199,5 +205,76 @@ class Word2Vec(_AdapterEstimator):
 
         _check_collect_envelope(dataset, "Word2Vec")
         in_col = self._local.getInputCol()
+        rows = dataset.select(in_col).collect()
+        return VectorFrame({in_col: [list(r[0]) for r in rows]})
+
+
+class FPGrowthModel(_AdapterModel):
+    """Mined itemsets; transform appends predictionCol (the rule-driven
+    consequent array per basket) via a string-array pandas_udf."""
+
+    _local_model_cls = _LFPG_M
+
+    def _transform(self, dataset):
+        local = self._local
+        in_col = local.get_or_default("itemsCol")
+        out_col = local.get_or_default("predictionCol")
+        if not out_col:   # Spark convention: '' disables the column
+            return dataset
+        # rules derive ONCE on the driver: the udf closes over the tiny
+        # (antecedent set, consequent) pairs, not the mined itemsets —
+        # regenerating association_rules() per Arrow batch would repeat
+        # the whole rule scan on every executor invocation
+        rules = local.association_rules()
+        ants = [frozenset(a) for a in rules.column("antecedent")]
+        cons = [c[0] for c in rules.column("consequent")]
+        # prediction element type follows the ITEM type (Spark derives
+        # array<item> from itemsCol; the local engine ignores the hint)
+        from spark_rapids_ml_tpu.spark._compat import HAVE_PYSPARK
+
+        if HAVE_PYSPARK:
+            from pyspark.sql.types import ArrayType
+
+            elem = dataset.schema[in_col].dataType.elementType
+            return_type = ArrayType(elem)
+        else:
+            return_type = "array<string>"
+
+        @pandas_udf(returnType=return_type)
+        def predict(series):
+            import pandas as pd
+
+            out = []
+            for basket in series:
+                bset = set(basket)
+                pred = []
+                for a, c in zip(ants, cons):
+                    if a <= bset and c not in bset and c not in pred:
+                        pred.append(c)
+                out.append(pred)
+            return pd.Series(out)
+
+        return dataset.withColumn(out_col, predict(dataset[in_col]))
+
+    def freq_itemsets(self):
+        return self._local.freq_itemsets()
+
+    def association_rules(self):
+        return self._local.association_rules()
+
+
+class FPGrowth(_AdapterEstimator):
+    """DataFrame front-end over ``models.FPGrowth`` (basket arrays in
+    ``itemsCol``; fit collects inside the documented envelope)."""
+
+    _local_cls = _LFPG
+    _model_cls = FPGrowthModel
+    _aliases: dict = {}  # FPGrowth has no inputCol to alias onto
+
+    def _collect_frame(self, dataset):
+        from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+        _check_collect_envelope(dataset, "FPGrowth")
+        in_col = self._local.get_or_default("itemsCol")
         rows = dataset.select(in_col).collect()
         return VectorFrame({in_col: [list(r[0]) for r in rows]})

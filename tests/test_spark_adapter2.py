@@ -164,3 +164,19 @@ def test_lda_em_rides_the_statistics_plane(spark, rng):
     from spark_rapids_ml_tpu import spark as spark_pkg
 
     assert spark_pkg.LDA is moments_estimator.LDA
+
+
+def test_fpgrowth_front_end(spark):
+    rows = [{"items": ["1", "2", "5"]},
+            {"items": ["1", "2", "3", "5"]},
+            {"items": ["1", "2"]}]
+    df = spark.createDataFrame(rows)
+    from spark_rapids_ml_tpu.spark import FPGrowth
+
+    model = FPGrowth(minSupport=0.5, minConfidence=0.9).fit(df)
+    freq = model.freq_itemsets()
+    assert frozenset(["1", "2"]) in {
+        frozenset(s) for s in freq.column("items")}
+    out = model.transform(spark.createDataFrame(
+        [{"items": ["5"]}])).collect()
+    assert set(out[0]["prediction"]) == {"1", "2"}
