@@ -35,7 +35,6 @@ def _collect_matrix(dataset, column: str) -> np.ndarray:
     """Envelope-guarded DataFrame feature collect (the adapter
     convention, ``spark/adapter.py::_check_collect_envelope``)."""
     from spark_rapids_ml_tpu.spark.adapter import _check_collect_envelope
-    from spark_rapids_ml_tpu.spark.aggregate import vector_column_to_matrix
 
     _check_collect_envelope(dataset, "ml.stat")
     rows = dataset.select(column).collect()
