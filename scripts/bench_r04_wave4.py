@@ -125,8 +125,34 @@ def main() -> int:
             return 2
         log("wave4 lda FAILED")
 
-    if not results:
-        # both benches failed without a captured record: leave NO done
+    # -- config-5 refresh: the vmapped tree-group grower landed after
+    # the first bench_models record (23.4k rows/s with sequential
+    # single-tree launches); re-measure so the committed number reflects
+    # the shipped fit path --------------------------------------------
+    try:
+        import contextlib
+        import io
+
+        import bench_models
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            bench_models.main()
+        with open(os.path.join(OUT, "bench_models_batched.json"),
+                  "w") as f:
+            f.write(buf.getvalue())
+        models_refreshed = True
+        log("wave4 bench_models ok")
+    except Exception as exc:  # noqa: BLE001
+        models_refreshed = False
+        write_error("bench_models_batched", exc)
+        if is_unavailable(exc):
+            log("wave4 ABORT (claim lost)")
+            return 2
+        log("wave4 bench_models FAILED")
+
+    if not results and not models_refreshed:
+        # every bench failed without a captured record: leave NO done
         # marker so the wrapper's remaining retries get their chance
         log("wave4 no records; retrying")
         return 2
