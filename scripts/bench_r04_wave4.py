@@ -151,10 +151,20 @@ def main() -> int:
             return 2
         log("wave4 bench_models FAILED")
 
-    if not results and not models_refreshed:
-        # every bench failed without a captured record: leave NO done
-        # marker so the wrapper's remaining retries get their chance
-        log("wave4 no records; retrying")
+    if not results or not models_refreshed:
+        # missing EITHER the family records or the config-5 refresh:
+        # keep whatever landed on disk but leave NO done marker so the
+        # wrapper's remaining retries can complete the set
+        log("wave4 incomplete; retrying")
+        if results:
+            with open(os.path.join(OUT, "bench_families.json"),
+                      "w") as f:
+                for rec in results:
+                    rec["platform"] = device.platform
+                    rec["device_kind"] = str(
+                        getattr(device, "device_kind", "?"))
+                    rec["recorded_utc"] = stamp()
+                    f.write(json.dumps(rec) + "\n")
         return 2
     with open(os.path.join(OUT, "bench_families.json"), "w") as f:
         for rec in results:

@@ -81,6 +81,32 @@ class _ALSParams(HasDeviceId, Params):
                   validator=lambda v: v in ("auto", "float32", "float64"))
 
 
+def _validate_ids(col: np.ndarray, name: str) -> None:
+    if not np.isfinite(col).all() or (col != np.round(col)).any():
+        raise ValueError(f"{name} must hold integer ids")
+    if np.abs(col).max(initial=0.0) >= _MAX_EXACT_ID:
+        raise ValueError(f"{name} ids exceed the exact-integer range")
+
+
+def _coerce_rating_chunk(chunk):
+    """(users, items, ratings) float64 arrays from an (n, 3) array or a
+    3-TUPLE of columns. Lists always mean row data (a list of exactly 3
+    rows would otherwise silently transpose into columns)."""
+    if isinstance(chunk, tuple) and len(chunk) == 3:
+        u, i, r = (np.asarray(c, dtype=np.float64).reshape(-1)
+                   for c in chunk)
+    else:
+        arr = np.asarray(chunk, dtype=np.float64)
+        if arr.ndim != 2 or arr.shape[1] != 3:
+            raise ValueError(
+                "rating chunks must be (n, 3) arrays or "
+                "(users, items, ratings) tuples")
+        u, i, r = arr[:, 0], arr[:, 1], arr[:, 2]
+    if not (u.shape == i.shape == r.shape):
+        raise ValueError("rating chunk columns must share a length")
+    return u, i, r
+
+
 def _ids_to_index(ids: np.ndarray, vocab: np.ndarray) -> np.ndarray:
     """Map id values onto their row in the sorted ``vocab``; −1 if unseen."""
     pos = np.searchsorted(vocab, ids)
@@ -110,13 +136,12 @@ class ALS(_ALSParams):
         return load_params(cls, path)
 
     def fit(self, dataset) -> "ALSModel":
-        import jax
-        import jax.numpy as jnp
+        from spark_rapids_ml_tpu.ops.als_kernel import build_padded_csr
 
-        from spark_rapids_ml_tpu.ops.als_kernel import (
-            als_fit_kernel,
-            build_padded_csr,
-        )
+        # out-of-core: a zero-arg factory of rating chunks streams
+        # through two passes (degree count, padded-table fill)
+        if callable(dataset):
+            return self._fit_streamed(dataset)
 
         timer = PhaseTimer()
         frame = as_vector_frame(dataset, self.getUserCol())
@@ -127,12 +152,8 @@ class ALS(_ALSParams):
                                dtype=np.float64)
             ratings = np.asarray(frame.column(self.getRatingCol()),
                                  dtype=np.float64)
-            for name, col in (("userCol", users), ("itemCol", items)):
-                if not np.isfinite(col).all() or (col != np.round(col)).any():
-                    raise ValueError(f"{name} must hold integer ids")
-                if np.abs(col).max(initial=0.0) >= _MAX_EXACT_ID:
-                    raise ValueError(
-                        f"{name} ids exceed the exact-integer range")
+            _validate_ids(users, "userCol")
+            _validate_ids(items, "itemCol")
             if users.shape[0] == 0:
                 raise ValueError("cannot fit ALS on an empty dataset")
             if self.getImplicitPrefs():
@@ -149,6 +170,19 @@ class ALS(_ALSParams):
         with timer.phase("pack"):
             u_tab = build_padded_csr(u_idx, i_idx, ratings, len(user_ids))
             i_tab = build_padded_csr(i_idx, u_idx, ratings, len(item_ids))
+        return self._fit_from_tables(u_tab, i_tab, user_ids, item_ids,
+                                     timer)
+
+    def _fit_from_tables(self, u_tab, i_tab, user_ids, item_ids,
+                         timer) -> "ALSModel":
+        """Device staging + the one-program kernel run, shared by the
+        in-memory and streamed ingestion paths (identical tables →
+        bit-identical models)."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.als_kernel import als_fit_kernel
+
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.getDtype())
         with timer.phase("h2d"):
@@ -180,6 +214,94 @@ class ALS(_ALSParams):
         model.train_rmse_ = float(result.train_rmse)
         model.fit_timings_ = timer.as_dict()
         return model
+
+    def _fit_streamed(self, factory) -> "ALSModel":
+        """Out-of-core ALS over a zero-arg factory of rating chunks
+        (each chunk: an (n, 3) array or (users, items, ratings) tuple).
+
+        Two passes, never holding the full triple list: pass 1 counts
+        per-id degrees (dict-sized state, O(users+items)); pass 2 fills
+        the preallocated padded tables chunk-by-chunk with running
+        per-row cursors — the exact tables ``build_padded_csr`` makes,
+        so streamed and in-memory fits are bit-identical up to rating
+        order within a row (the normal equations are order-invariant
+        sums)."""
+        timer = PhaseTimer()
+        implicit = bool(self.getImplicitPrefs())
+        with timer.phase("count_pass"):
+            u_count: dict = {}
+            i_count: dict = {}
+            total = 0
+            for chunk in factory():
+                u, i, r = _coerce_rating_chunk(chunk)
+                _validate_ids(u, "userCol")
+                _validate_ids(i, "itemCol")
+                if implicit:
+                    keep = r != 0.0
+                    u, i = u[keep], i[keep]
+                for store, col in ((u_count, u), (i_count, i)):
+                    ids, cnts = np.unique(col, return_counts=True)
+                    for v, c in zip(ids, cnts):  # small unique arrays
+                        store[v] = store.get(v, 0) + int(c)
+                total += u.shape[0]
+            if not total:
+                raise ValueError(
+                    "cannot fit ALS on an empty dataset" if not implicit
+                    else "implicitPrefs: all ratings are zero")
+            user_ids = np.asarray(sorted(u_count))
+            item_ids = np.asarray(sorted(i_count))
+
+        from spark_rapids_ml_tpu.ops.als_kernel import padded_row_width
+
+        def alloc(ids, counts):
+            width = padded_row_width(max(counts.values()))
+            n = len(ids)
+            return (np.zeros((n, width), dtype=np.int32),
+                    np.zeros((n, width), dtype=np.float64),
+                    np.zeros((n, width), dtype=np.float64),
+                    np.zeros(n, dtype=np.int64))
+
+        with timer.phase("pack_pass"):
+            u_idx_t, u_val_t, u_mask_t, u_cur = alloc(user_ids, u_count)
+            i_idx_t, i_val_t, i_mask_t, i_cur = alloc(item_ids, i_count)
+
+            def fill(idx_t, val_t, mask_t, cur, rows, cols, vals):
+                order = np.argsort(rows, kind="stable")
+                rows, cols, vals = rows[order], cols[order], vals[order]
+                uniq, starts = np.unique(rows, return_index=True)
+                within = np.arange(len(rows)) - np.repeat(
+                    starts, np.diff(np.append(starts, len(rows))))
+                pos = cur[rows] + within
+                idx_t[rows, pos] = cols
+                val_t[rows, pos] = vals
+                mask_t[rows, pos] = 1.0
+                np.add.at(cur, uniq,
+                          np.diff(np.append(starts, len(rows))))
+
+            for chunk in factory():
+                u, i, r = _coerce_rating_chunk(chunk)
+                if implicit:
+                    keep = r != 0.0
+                    u, i, r = u[keep], i[keep], r[keep]
+                ui = _ids_to_index(u, user_ids)
+                ii = _ids_to_index(i, item_ids)
+                fill(u_idx_t, u_val_t, u_mask_t, u_cur, ui, ii, r)
+                fill(i_idx_t, i_val_t, i_mask_t, i_cur, ii, ui, r)
+            # cross-pass consistency: a non-restartable factory (pass 2
+            # sees nothing) or drifting data (new ids, changed counts)
+            # must fail loudly, not return zero/corrupted factors
+            expect_u = np.asarray([u_count[v] for v in user_ids])
+            expect_i = np.asarray([i_count[v] for v in item_ids])
+            if not (np.array_equal(u_cur, expect_u)
+                    and np.array_equal(i_cur, expect_i)):
+                raise ValueError(
+                    "streamed ALS passes disagree: the chunk factory "
+                    "must return the SAME data on every call (a fresh "
+                    "iterable per invocation, not a shared generator)")
+        return self._fit_from_tables(
+            (u_idx_t, u_val_t, u_mask_t),
+            (i_idx_t, i_val_t, i_mask_t),
+            user_ids, item_ids, timer)
 
 
 class ALSModel(_ALSParams):

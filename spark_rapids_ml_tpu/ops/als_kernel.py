@@ -226,6 +226,13 @@ def topk_scores_kernel(
     return best_s, best_i
 
 
+def padded_row_width(max_degree: int) -> int:
+    """Padded table width for a max row degree: the next power of two —
+    the ONE copy of the rule (the streamed ALS ingestion builds the
+    same tables incrementally and must stay in lockstep)."""
+    return 1 << (max(1, max_degree) - 1).bit_length()
+
+
 def build_padded_csr(
     rows: "jnp.ndarray", cols: "jnp.ndarray", vals: "jnp.ndarray",
     n_rows: int, pad_to_pow2: bool = True,
@@ -246,9 +253,7 @@ def build_padded_csr(
     rows, cols, vals = rows[order], cols[order], vals[order]
     counts = np.bincount(rows, minlength=n_rows)
     max_deg = int(counts.max()) if counts.size else 1
-    width = max(1, max_deg)
-    if pad_to_pow2:
-        width = 1 << (width - 1).bit_length()
+    width = padded_row_width(max_deg) if pad_to_pow2 else max(1, max_deg)
     # values stay float64 on host: the device cast happens once at h2d,
     # so dtype='float64' fits see full-fidelity ratings (an f32 staging
     # copy would round >24-bit-mantissa values before the cast up)

@@ -239,3 +239,59 @@ def test_weighted_reg_changes_solution(rng):
     norm_small = np.linalg.norm(m_small.user_factors)
     norm_big = np.linalg.norm(m_big.user_factors)
     assert norm_big < 0.5 * norm_small
+
+
+def test_streamed_fit_matches_inmemory(rng):
+    users, items, ratings = _low_rank_triples(rng, keep=0.7)
+    frame = _triples_frame(users, items, ratings)
+    mem = ALS(rank=3, maxIter=6, regParam=0.05, seed=1).fit(frame)
+
+    triples = np.column_stack([users, items, ratings])
+    chunks = [triples[i:i + 37] for i in range(0, len(triples), 37)]
+    st = ALS(rank=3, maxIter=6, regParam=0.05, seed=1).fit(
+        lambda: iter(chunks))
+    np.testing.assert_array_equal(st.user_ids, mem.user_ids)
+    np.testing.assert_array_equal(st.item_ids, mem.item_ids)
+    # identical padded tables up to within-row rating order (the normal
+    # equations are order-invariant sums): factors agree to float eps
+    np.testing.assert_allclose(st.user_factors, mem.user_factors,
+                               rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(st.item_factors, mem.item_factors,
+                               rtol=1e-8, atol=1e-10)
+    # tuple-of-columns chunks work too
+    st2 = ALS(rank=3, maxIter=6, regParam=0.05, seed=1).fit(
+        lambda: iter([(users[:100], items[:100], ratings[:100]),
+                      (users[100:], items[100:], ratings[100:])]))
+    np.testing.assert_allclose(st2.user_factors, mem.user_factors,
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_streamed_fit_validation(rng):
+    with pytest.raises(ValueError, match="empty"):
+        ALS().fit(lambda: iter([]))
+    with pytest.raises(ValueError, match="\\(n, 3\\)"):
+        ALS().fit(lambda: iter([np.zeros((4, 2))]))
+    with pytest.raises(ValueError, match="integer ids"):
+        ALS().fit(lambda: iter([np.array([[0.5, 1.0, 2.0]])]))
+    # implicit all-zero
+    with pytest.raises(ValueError, match="all ratings are zero"):
+        ALS(implicitPrefs=True).fit(
+            lambda: iter([np.array([[0.0, 1.0, 0.0]])]))
+
+
+def test_streamed_fit_rejects_shared_generator(rng):
+    users, items, ratings = _low_rank_triples(rng, keep=0.5)
+    triples = np.column_stack([users, items, ratings])
+    gen = iter([triples])  # shared generator: pass 2 sees nothing
+    with pytest.raises(ValueError, match="SAME data on every call"):
+        ALS(rank=2, maxIter=2).fit(lambda: gen)
+
+
+def test_rating_chunk_list_of_three_rows_is_rows():
+    from spark_rapids_ml_tpu.models.als import _coerce_rating_chunk
+
+    u, i, r = _coerce_rating_chunk([[1, 4, 3.0], [2, 5, 2.0],
+                                    [3, 6, 1.0]])
+    np.testing.assert_array_equal(u, [1, 2, 3])
+    np.testing.assert_array_equal(i, [4, 5, 6])
+    np.testing.assert_array_equal(r, [3.0, 2.0, 1.0])
