@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from spark_rapids_ml_tpu.utils.numeric import sigmoid as _sigmoid
+
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -226,7 +228,7 @@ class _GBTBase(GBTParams):
                                       np.asarray(tt), depth)
                 ]
                 if classification:
-                    p = 1.0 / (1.0 + np.exp(-_f))
+                    p = _sigmoid(_f)
                     p = np.clip(p, 1e-12, 1 - 1e-12)
                     per_row = -(
                         y_val * np.log(p) + (1 - y_val) * np.log(1 - p)
@@ -375,7 +377,7 @@ class GBTClassificationModel(GBTClassifierParams, _GBTModelBase):
     def predict_proba(self, dataset) -> np.ndarray:
         frame = as_vector_frame(dataset, self.getInputCol())
         z = self._raw_score(frame.vectors_as_matrix(self.getInputCol()))
-        return 1.0 / (1.0 + np.exp(-z))
+        return _sigmoid(z)
 
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
@@ -447,7 +449,7 @@ def boosting_loop(y_padded, mask, n_real, init, max_iter, step_size,
     best_m = -1
     for m in range(max_iter):
         if classification:
-            p = 1.0 / (1.0 + np.exp(-f))
+            p = _sigmoid(f)
             r = y_padded - p
             hess = np.maximum(p * (1.0 - p), 1e-12)
         else:

@@ -16,6 +16,8 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.utils.numeric import sigmoid as _sigmoid
+
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -502,7 +504,7 @@ class LogisticRegression(LogisticRegressionParams):
                             dtype=np.float64,
                         )
                         xb, yb = zb[:, :n], zb[:, n]
-                        p = 1.0 / (1.0 + np.exp(-(xb @ w + b)))
+                        p = _sigmoid(xb @ w + b)
                         r = p - yb
                         s = p * (1.0 - p)
                         carry[0] += xb.T @ r
@@ -630,7 +632,7 @@ def _check_binary(y: np.ndarray, estimator: str = "LogisticRegression") -> None:
 
 def _full_grad_hess(x, y, w, b, lam, fit_intercept, weights=None):
     z = x @ w + b
-    p = 1.0 / (1.0 + np.exp(-z))
+    p = _sigmoid(z)
     r = p - y
     s = p * (1.0 - p)
     if weights is not None:
@@ -745,7 +747,7 @@ class LogisticRegressionModel(LogisticRegressionParams):
             )
         else:
             z = x @ self.coefficients + self.intercept
-            proba = 1.0 / (1.0 + np.exp(-z))
+            proba = _sigmoid(z)
         return proba.astype(np.float64)
 
     def transform(self, dataset) -> VectorFrame:

@@ -27,6 +27,8 @@ from typing import Dict, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from spark_rapids_ml_tpu.utils.numeric import sigmoid as _sigmoid
+
 from spark_rapids_ml_tpu.data.vector import rows_to_matrix
 
 # Spark VectorUDT struct tags (pyspark.ml.linalg.VectorUDT.serialize)
@@ -270,7 +272,7 @@ def partition_logreg_stats(
         _check_binary(y)
         wt = _batch_weights_agg(batch, weight_col)
         z = x @ w + b
-        p = 1.0 / (1.0 + np.exp(-z))
+        p = _sigmoid(z)
         r = p - y
         s = p * (1.0 - p)
         if wt is not None:

@@ -30,6 +30,8 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from spark_rapids_ml_tpu.utils.numeric import sigmoid as _sigmoid
+
 from spark_rapids_ml_tpu.spark.aggregate import vector_column_to_matrix
 
 
@@ -492,7 +494,7 @@ def _gbt_margin(
 
 def _gbt_residual_hess(y, f, classification: bool):
     if classification:
-        p = 1.0 / (1.0 + np.exp(-f))
+        p = _sigmoid(f)
         return y - p, np.maximum(p * (1.0 - p), 1e-12)
     return y - f, np.ones_like(f)
 

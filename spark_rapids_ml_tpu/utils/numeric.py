@@ -1,0 +1,24 @@
+"""Small shared numeric helpers (host-side)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+# The Gram MXU precision vocabulary — lives here (jax-free) so Param
+# validators can share it with ops/covariance.py without importing jax
+# at estimator-definition time.
+GRAM_PRECISIONS = ("default", "bfloat16", "bfloat16_3x", "float32",
+                   "highest")
+
+
+def sigmoid(z: np.ndarray) -> np.ndarray:
+    """Numerically stable logistic function: never evaluates exp on a
+    positive argument, so large |z| cannot overflow (the naive
+    ``1/(1+exp(-z))`` warns and round-trips through inf for z < -745)."""
+    z = np.asarray(z, dtype=np.float64)
+    out = np.empty_like(z)
+    pos = z >= 0
+    out[pos] = 1.0 / (1.0 + np.exp(-z[pos]))
+    ez = np.exp(z[~pos])
+    out[~pos] = ez / (1.0 + ez)
+    return out
