@@ -114,6 +114,20 @@ def as_vector_frame(dataset, input_col: str) -> VectorFrame:
             return VectorFrame.from_pandas(dataset)
     except ImportError:  # pragma: no cover
         pass
+    if hasattr(dataset, "collect") and hasattr(dataset, "columns"):
+        # a DataFrame (pyspark or the local engine): collect it whole.
+        # This is the driver-materialization path — evaluators scoring a
+        # validation fold and direct local-model use ride it; the guarded
+        # streaming routes are the spark/ planes and adapters.
+        names = list(dataset.columns)
+        rows = dataset.collect()
+        return VectorFrame({
+            name: [
+                row[i].toArray() if hasattr(row[i], "toArray") else row[i]
+                for row in rows
+            ]
+            for i, name in enumerate(names)
+        })
     if not isinstance(dataset, (list, tuple)):
         try:
             arr = np.asarray(dataset, dtype=np.float64)

@@ -15,6 +15,18 @@ from spark_rapids_ml_tpu.data.frame import as_vector_frame
 from spark_rapids_ml_tpu.models.params import Param, Params
 
 
+def _metric_frame(dataset, *cols):
+    """The metric columns of ``dataset`` as a VectorFrame. DataFrames
+    (pyspark or the local engine) are pruned to ``cols`` BEFORE the
+    driver materialization ``as_vector_frame`` performs — an evaluator
+    input is a transformed fold, and collecting the feature/probability
+    columns a scalar metric never reads would scale the collect with
+    feature width instead of O(rows)."""
+    if (hasattr(dataset, "select") and hasattr(dataset, "columns")
+            and hasattr(dataset, "collect")):
+        dataset = dataset.select(*cols)
+    return as_vector_frame(dataset, cols[0])
+
 
 class _KwargsInit:
     """Shared evaluator base: the kwargs constructor
@@ -56,7 +68,8 @@ class RegressionEvaluator(_KwargsInit, Params):
         return self.getMetricName() == "r2"
 
     def evaluate(self, dataset) -> float:
-        frame = as_vector_frame(dataset, self.getPredictionCol())
+        frame = _metric_frame(dataset, self.getPredictionCol(),
+                              self.getLabelCol())
         y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
         pred = np.asarray(
             frame.column(self.getPredictionCol()), dtype=np.float64
@@ -100,7 +113,8 @@ class BinaryClassificationEvaluator(_KwargsInit, Params):
         return True
 
     def evaluate(self, dataset) -> float:
-        frame = as_vector_frame(dataset, self.getRawPredictionCol())
+        frame = _metric_frame(dataset, self.getRawPredictionCol(),
+                              self.getLabelCol())
         y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
         y = (y >= 0.5).astype(np.int64)
         score = np.asarray(
@@ -175,7 +189,8 @@ class MulticlassClassificationEvaluator(_KwargsInit, Params):
         return True
 
     def evaluate(self, dataset) -> float:
-        frame = as_vector_frame(dataset, self.getPredictionCol())
+        frame = _metric_frame(dataset, self.getPredictionCol(),
+                              self.getLabelCol())
         y = np.asarray(frame.column(self.getLabelCol()), dtype=np.float64)
         pred = np.asarray(
             frame.column(self.getPredictionCol()), dtype=np.float64
@@ -234,7 +249,8 @@ class ClusteringEvaluator(_KwargsInit, Params):
         return True
 
     def evaluate(self, dataset) -> float:
-        frame = as_vector_frame(dataset, self.get_or_default("featuresCol"))
+        frame = _metric_frame(dataset, self.get_or_default("featuresCol"),
+                              self.get_or_default("predictionCol"))
         x = frame.vectors_as_matrix(self.get_or_default("featuresCol"))
         labels = np.asarray(
             frame.column(self.get_or_default("predictionCol")))
@@ -319,7 +335,8 @@ class RankingEvaluator(_KwargsInit, Params):
         return score / denom
 
     def evaluate(self, dataset) -> float:
-        frame = as_vector_frame(dataset, self.getPredictionCol())
+        frame = _metric_frame(dataset, self.getPredictionCol(),
+                              self.getLabelCol())
         preds = frame.column(self.getPredictionCol())
         labels = frame.column(self.getLabelCol())
         name = self.getMetricName()
@@ -389,7 +406,8 @@ class MultilabelClassificationEvaluator(_KwargsInit, Params):
         return self.getMetricName() != "hammingLoss"
 
     def evaluate(self, dataset) -> float:
-        frame = as_vector_frame(dataset, self.getPredictionCol())
+        frame = _metric_frame(dataset, self.getPredictionCol(),
+                              self.getLabelCol())
         preds = [set(p) for p in frame.column(self.getPredictionCol())]
         labels = [set(t) for t in frame.column(self.getLabelCol())]
         name = self.getMetricName()

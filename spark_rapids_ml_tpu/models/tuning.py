@@ -369,18 +369,22 @@ class TrainValidationSplitModel(_TuningParams):
 
 
 def _save_tuning(obj, path: str, overwrite: bool, metrics_key: str,
-                 metrics) -> None:
+                 metrics, save_stage=None) -> None:
     """Shared writer for the tuning estimators/models: own params as
     metadata (paramMaps + metrics in `extra`), the estimator/evaluator/
     bestModel as nested self-describing directories (the Pipeline stage
-    convention — each loads back via its recorded pythonClass)."""
+    convention — each loads back via its recorded pythonClass).
+    ``save_stage`` overrides the stage writer (the DataFrame front-end
+    layer passes its sidecar-aware one)."""
     import os
 
     from spark_rapids_ml_tpu.io.persistence import (
         _require_target,
         _write_metadata,
     )
-    from spark_rapids_ml_tpu.models.pipeline import _save_stage
+    if save_stage is None:
+        from spark_rapids_ml_tpu.models.pipeline import _save_stage
+        save_stage = _save_stage
 
     _require_target(path, overwrite)
     extra = {"estimatorParamMaps": getattr(obj, "estimatorParamMaps",
@@ -395,20 +399,22 @@ def _save_tuning(obj, path: str, overwrite: bool, metrics_key: str,
     for name in ("estimator", "evaluator"):
         sub = getattr(obj, name, None)
         if sub is not None:
-            _save_stage(sub, os.path.join(path, name))
+            save_stage(sub, os.path.join(path, name))
     best = getattr(obj, "bestModel", None)
     if best is not None:
-        _save_stage(best, os.path.join(path, "bestModel"))
+        save_stage(best, os.path.join(path, "bestModel"))
 
 
-def _load_tuning(cls, path: str):
+def _load_tuning(cls, path: str, load_stage=None):
     import os
 
     from spark_rapids_ml_tpu.io.persistence import (
         _read_metadata,
         _restore_params,
     )
-    from spark_rapids_ml_tpu.models.pipeline import _load_stage
+    if load_stage is None:
+        from spark_rapids_ml_tpu.models.pipeline import _load_stage
+        load_stage = _load_stage
 
     meta = _read_metadata(path)
     obj = cls(uid=meta["uid"])
@@ -420,10 +426,10 @@ def _load_tuning(cls, path: str):
     for name in ("estimator", "evaluator"):
         sub_path = os.path.join(path, name)
         if os.path.isdir(sub_path) and hasattr(obj, name):
-            setattr(obj, name, _load_stage(sub_path))
+            setattr(obj, name, load_stage(sub_path))
     best_path = os.path.join(path, "bestModel")
     if os.path.isdir(best_path) and hasattr(obj, "bestModel"):
-        obj.bestModel = _load_stage(best_path)
+        obj.bestModel = load_stage(best_path)
     if hasattr(obj, "bestIndex") and "bestIndex" in extra:
         obj.bestIndex = int(extra["bestIndex"])
     if hasattr(obj, "avgMetrics") and "avgMetrics" in extra:

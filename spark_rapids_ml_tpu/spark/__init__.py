@@ -18,7 +18,12 @@ L-BFGS whose linesearch state does not split into cheap per-partition
 jobs) and every Model transform. The round-4 families ride
 ``adapter2.py`` (DTs/LSH and the bespoke ALS/Word2Vec collectors),
 except LDA whose EM optimizer runs per-iteration statistics jobs on
-the moments plane.
+the moments plane. Round 5 closes the surface: the remaining estimator
+families (``adapter3.py``), the text/feature transformer batch as
+per-Arrow-batch ``pandas_udf`` front-ends (``transformers.py``),
+composition + model selection over DataFrame folds
+(``tuning_front.py``), and the evaluators (which score transformed
+DataFrames directly).
 """
 
 from spark_rapids_ml_tpu.spark.aggregate import (  # noqa: F401
@@ -116,12 +121,101 @@ _ADAPTER2_CLASSES = (
     "Word2VecModel",
 )
 
+# round-5 estimator families on the generic adapter posture
+# (spark/adapter3.py); PIC and PrefixSpan mirror Spark's no-model shape
+_ADAPTER3_CLASSES = (
+    "AFTSurvivalRegression",
+    "AFTSurvivalRegressionModel",
+    "BisectingKMeans",
+    "BisectingKMeansModel",
+    "DBSCAN",
+    "DBSCANModel",
+    "FMClassifier",
+    "FMClassificationModel",
+    "FMRegressor",
+    "FMRegressionModel",
+    "IsotonicRegression",
+    "IsotonicRegressionModel",
+    "PowerIterationClustering",
+    "PrefixSpan",
+)
+
+# row-wise transformer front-ends (spark/transformers.py): pandas_udf
+# per Arrow batch by default; row-dropping/reshaping configurations ride
+# the envelope-guarded rebuild path
+_TRANSFORMER_CLASSES = (
+    "Binarizer",
+    "Bucketizer",
+    "ChiSqSelector",
+    "ChiSqSelectorModel",
+    "CountVectorizer",
+    "CountVectorizerModel",
+    "DCT",
+    "ElementwiseProduct",
+    "FeatureHasher",
+    "HashingTF",
+    "IDF",
+    "IDFModel",
+    "IndexToString",
+    "Interaction",
+    "NGram",
+    "Normalizer",
+    "OneHotEncoder",
+    "OneHotEncoderModel",
+    "PolynomialExpansion",
+    "QuantileDiscretizer",
+    "RegexTokenizer",
+    "RFormula",
+    "RFormulaModel",
+    "SQLTransformer",
+    "StopWordsRemover",
+    "StringIndexer",
+    "StringIndexerModel",
+    "Tokenizer",
+    "UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel",
+    "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel",
+    "VectorAssembler",
+    "VectorIndexer",
+    "VectorIndexerModel",
+    "VectorSizeHint",
+    "VectorSlicer",
+)
+
+# composition + model selection over DataFrames (spark/tuning_front.py)
+_TUNING_CLASSES = (
+    "CrossValidator",
+    "CrossValidatorModel",
+    "ParamGridBuilder",
+    "Pipeline",
+    "PipelineModel",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
+)
+
+# the local evaluators accept transformed DataFrames directly
+# (data/frame.py::as_vector_frame duck-types DataFrames), so they ARE
+# the DataFrame evaluators
+_EVALUATOR_CLASSES = (
+    "BinaryClassificationEvaluator",
+    "ClusteringEvaluator",
+    "MulticlassClassificationEvaluator",
+    "MultilabelClassificationEvaluator",
+    "RankingEvaluator",
+    "RegressionEvaluator",
+)
+
 __all__ = [
     *_PYSPARK_CLASSES,
     *_ADAPTER2_CLASSES,
+    *_ADAPTER3_CLASSES,
     *_FOREST_PLANE_CLASSES,
     *_MOMENTS_PLANE_CLASSES,
     *_ADAPTER_CLASSES,
+    *_TRANSFORMER_CLASSES,
+    *_TUNING_CLASSES,
+    *_EVALUATOR_CLASSES,
     "combine_stats",
     "finalize_pca_from_stats",
     "partition_gram_stats",
@@ -152,4 +246,20 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.spark import adapter2
 
         return getattr(adapter2, name)
+    if name in _ADAPTER3_CLASSES:
+        from spark_rapids_ml_tpu.spark import adapter3
+
+        return getattr(adapter3, name)
+    if name in _TRANSFORMER_CLASSES:
+        from spark_rapids_ml_tpu.spark import transformers
+
+        return getattr(transformers, name)
+    if name in _TUNING_CLASSES:
+        from spark_rapids_ml_tpu.spark import tuning_front
+
+        return getattr(tuning_front, name)
+    if name in _EVALUATOR_CLASSES:
+        from spark_rapids_ml_tpu.models import evaluation
+
+        return getattr(evaluation, name)
     raise AttributeError(name)

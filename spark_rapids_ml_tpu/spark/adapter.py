@@ -123,6 +123,9 @@ class _AdapterEstimator(Estimator):
     _model_cls: Optional[Type] = None
     _needs_label = False
     _aliases: Dict[str, str] = {"featuresCol": "inputCol"}
+    # local param names whose values (when set) name additional scalar
+    # columns the fit consumes (e.g. AFT's censorCol)
+    _extra_scalar_cols: tuple = ()
 
     def __init__(self, **kwargs):
         super().__init__()
@@ -171,6 +174,12 @@ class _AdapterEstimator(Estimator):
             wcol = self._local.get_or_default("weightCol") or ""
             if wcol:
                 cols.append(wcol)
+        extra = []
+        for pname in self._extra_scalar_cols:
+            c = self._local.get_or_default(pname) or ""
+            if c:
+                cols.append(c)
+                extra.append(c)
         rows = dataset.select(*cols).collect()
         x = np.stack([
             r[0].toArray() if hasattr(r[0], "toArray")
@@ -185,6 +194,10 @@ class _AdapterEstimator(Estimator):
         if wcol:
             frame = frame.with_column(
                 wcol, [float(r[cols.index(wcol)]) for r in rows]
+            )
+        for c in extra:
+            frame = frame.with_column(
+                c, [float(r[cols.index(c)]) for r in rows]
             )
         return frame
 
@@ -472,7 +485,7 @@ class _GLMAdapterModel(_AdapterModel):
 def _make_pair(name, local_est, local_model, *, needs_label,
                out_col_param="predictionCol", out_kind="double",
                classifier=False, proba_scalar=False, aliases=None, doc="",
-               model_base=None):
+               model_base=None, extra_scalar_cols=()):
     base = model_base or (
         _ClassifierAdapterModel if classifier else _AdapterModel
     )
@@ -496,6 +509,7 @@ def _make_pair(name, local_est, local_model, *, needs_label,
             "_model_cls": model_cls,
             "_needs_label": needs_label,
             "_aliases": aliases or {"featuresCol": "inputCol"},
+            "_extra_scalar_cols": tuple(extra_scalar_cols),
             "__doc__": f"DataFrame front-end over "
                        f"``models.{local_est.__name__}``. {doc}",
         },

@@ -278,3 +278,12 @@ class FPGrowth(_AdapterEstimator):
         in_col = self._local.get_or_default("itemsCol")
         rows = dataset.select(in_col).collect()
         return VectorFrame({in_col: [list(r[0]) for r in rows]})
+
+
+# factory-created classes carry the factory's module by default; pin them
+# here so persistence sidecars and pickling resolve them where they live
+for _name in __all__:
+    _cls = globals().get(_name)
+    if isinstance(_cls, type):
+        _cls.__module__ = __name__
+del _name, _cls

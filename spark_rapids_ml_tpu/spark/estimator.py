@@ -263,21 +263,12 @@ class PCA(Estimator, _TpuPCAParams):
         )
         return self._copyValues(model)
 
-    def save(self, path: str) -> None:
-        from spark_rapids_ml_tpu.io.persistence import save_params
-
-        save_params(_LocalParamsProxy(self), path)
+    def save(self, path: str, overwrite: bool = False) -> None:
+        _save_estimator_params(self, path, overwrite=overwrite)
 
     @staticmethod
     def load(path: str) -> "PCA":
-        from spark_rapids_ml_tpu.io.persistence import _read_metadata
-
-        meta = _read_metadata(path)
-        est = PCA()
-        est._resetUid(meta["uid"])
-        _apply_param_map(est, meta.get("paramMap", {}))
-        _apply_param_map(est, meta.get("tpuParamMap", {}))
-        return est
+        return _load_estimator_params(PCA, path)
 
 
 class PCAModel(Model, _TpuPCAParams):
@@ -422,6 +413,13 @@ class LinearRegression(Estimator, _TpuLinRegParams):
     def setFitIntercept(self, value):
         return self._set(fitIntercept=value)
 
+    def save(self, path: str, overwrite: bool = False) -> None:
+        _save_estimator_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "LinearRegression":
+        return _load_estimator_params(LinearRegression, path)
+
     def _fit(self, dataset) -> "LinearRegressionModel":
         from spark_rapids_ml_tpu.spark.aggregate import (
             partition_xy_stats_arrow,
@@ -483,6 +481,51 @@ class LinearRegressionModel(Model, _TpuLinRegParams):
             self.getOrDefault(self.predictionCol),
             predict(dataset[self.getOrDefault(self.featuresCol)]),
         )
+
+    # -- persistence (shared wire format via the local model) --------------
+    def _to_local(self):
+        from spark_rapids_ml_tpu.models.linear_regression import (
+            LinearRegressionModel as LocalModel,
+        )
+
+        local = LocalModel(
+            coefficients=np.asarray(self.coefficients.toArray()),
+            intercept=float(self.intercept),
+            uid=self.uid,
+        )
+        for theirs, ours in (("featuresCol", "inputCol"),
+                             ("labelCol", "labelCol"),
+                             ("predictionCol", "predictionCol"),
+                             ("regParam", "regParam"),
+                             ("fitIntercept", "fitIntercept")):
+            value = self.getOrDefault(getattr(self, theirs))
+            if value is not None and local.has_param(ours):
+                local.set(ours, value)
+        return local
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        self._to_local().save(path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "LinearRegressionModel":
+        from spark_rapids_ml_tpu.models.linear_regression import (
+            LinearRegressionModel as LocalModel,
+        )
+
+        local = LocalModel.load(path)
+        model = LinearRegressionModel(
+            coefficients=DenseVector(
+                np.asarray(local.coefficients).tolist()),
+            intercept=float(local.intercept),
+        )
+        model._resetUid(local.uid)
+        if local.is_set("inputCol"):
+            model._set(featuresCol=local.get("inputCol"))
+        for name in ("labelCol", "predictionCol", "regParam",
+                     "fitIntercept"):
+            if local.is_set(name):
+                model._set(**{name: local.get(name)})
+        return model
 
 
 class _TpuLogRegParams(Params):
@@ -588,6 +631,13 @@ class LogisticRegression(Estimator, _TpuLogRegParams):
 
     def setFitIntercept(self, value):
         return self._set(fitIntercept=value)
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        _save_estimator_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "LogisticRegression":
+        return _load_estimator_params(LogisticRegression, path)
 
     def setMaxIter(self, value):
         return self._set(maxIter=value)
@@ -888,7 +938,8 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
         @pandas_udf(returnType="double")
         def proba(v: pd.Series) -> pd.Series:
             x = np.stack([row.toArray() for row in v])
-            return pd.Series(1.0 / (1.0 + np.exp(-(x @ coef + b))))
+            from spark_rapids_ml_tpu.utils.numeric import sigmoid
+            return pd.Series(sigmoid(x @ coef + b))
 
         out = dataset.withColumn(pcol, proba(dataset[fcol]))
         thr = self._thresholds_or_none()
@@ -1048,6 +1099,13 @@ class KMeans(Estimator, _TpuKMeansParams):
     def setWeightCol(self, value):
         return self._set(weightCol=value)
 
+    def save(self, path: str, overwrite: bool = False) -> None:
+        _save_estimator_params(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "KMeans":
+        return _load_estimator_params(KMeans, path)
+
     def _fit(self, dataset) -> "KMeansModel":
         from spark_rapids_ml_tpu.models.kmeans import _host_kmeans_pp
         from spark_rapids_ml_tpu.spark.aggregate import (
@@ -1167,6 +1225,50 @@ class KMeansModel(Model, _TpuKMeansParams):
             assign(dataset[self.getOrDefault(self.featuresCol)]),
         )
 
+    # -- persistence (shared wire format via the local model) --------------
+    def _to_local(self):
+        from spark_rapids_ml_tpu.models.kmeans import (
+            KMeansModel as LocalModel,
+        )
+
+        local = LocalModel(
+            cluster_centers=np.stack(
+                [c.toArray() for c in self._centers]),
+            uid=self.uid,
+        )
+        if self.trainingCost is not None:
+            local.training_cost_ = float(self.trainingCost)
+        for theirs, ours in (("featuresCol", "inputCol"),
+                             ("predictionCol", "predictionCol"),
+                             ("k", "k"), ("maxIter", "maxIter"),
+                             ("tol", "tol"), ("seed", "seed")):
+            value = self.getOrDefault(getattr(self, theirs))
+            if value is not None and local.has_param(ours):
+                local.set(ours, value)
+        return local
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        self._to_local().save(path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "KMeansModel":
+        from spark_rapids_ml_tpu.models.kmeans import (
+            KMeansModel as LocalModel,
+        )
+
+        local = LocalModel.load(path)
+        model = KMeansModel(clusterCenters=[
+            DenseVector(np.asarray(c).tolist())
+            for c in local.cluster_centers
+        ])
+        model._resetUid(local.uid)
+        if local.is_set("inputCol"):
+            model._set(featuresCol=local.get("inputCol"))
+        for name in ("predictionCol", "k", "maxIter", "tol", "seed"):
+            if local.is_set(name):
+                model._set(**{name: local.get(name)})
+        return model
+
 
 class _LocalParamsProxy:
     """Adapts a pyspark Params object to io.persistence's estimator
@@ -1190,6 +1292,28 @@ def _apply_param_map(obj, param_map):
     for name, value in param_map.items():
         if obj.hasParam(name) and value is not None:
             obj._set(**{name: value})
+
+
+def _save_estimator_params(est, path, overwrite=False):
+    """Params-only estimator persistence shared by the plane estimators
+    (PCA/LinearRegression/LogisticRegression/KMeans/NaiveBayes): a
+    dedicated proxy subclass so the metadata carries the estimator's own
+    class name."""
+    from spark_rapids_ml_tpu.io.persistence import save_params
+
+    proxy_cls = type(type(est).__name__, (_LocalParamsProxy,), {})
+    save_params(proxy_cls(est), path, overwrite=overwrite)
+
+
+def _load_estimator_params(cls, path):
+    from spark_rapids_ml_tpu.io.persistence import _read_metadata
+
+    meta = _read_metadata(path)
+    est = cls()
+    est._resetUid(meta["uid"])
+    _apply_param_map(est, meta.get("paramMap", {}))
+    _apply_param_map(est, meta.get("tpuParamMap", {}))
+    return est
 
 
 # type(estimator).__module__ resolution in save_params sees the proxy class;
@@ -1245,23 +1369,12 @@ class NaiveBayes(Estimator, Params):
     def setWeightCol(self, value):
         return self._set(weightCol=value)
 
-    def save(self, path: str) -> None:
-        from spark_rapids_ml_tpu.io.persistence import save_params
-
-        # dedicated proxy subclass so the metadata carries THIS class name
-        proxy_cls = type("NaiveBayes", (_LocalParamsProxy,), {})
-        save_params(proxy_cls(self), path)
+    def save(self, path: str, overwrite: bool = False) -> None:
+        _save_estimator_params(self, path, overwrite=overwrite)
 
     @staticmethod
     def load(path: str) -> "NaiveBayes":
-        from spark_rapids_ml_tpu.io.persistence import _read_metadata
-
-        meta = _read_metadata(path)
-        est = NaiveBayes()
-        est._resetUid(meta["uid"])
-        _apply_param_map(est, meta.get("paramMap", {}))
-        _apply_param_map(est, meta.get("tpuParamMap", {}))
-        return est
+        return _load_estimator_params(NaiveBayes, path)
 
     def _fit(self, dataset):
         from spark_rapids_ml_tpu.models.naive_bayes import (

@@ -393,6 +393,17 @@ class _Column:
     def __lt__(self, other):
         return self._cmp(lambda v: v < other)
 
+    # pyspark's Column overloads equality into an expression too; the
+    # default object hash is kept explicitly since defining __eq__ alone
+    # would otherwise make columns unhashable
+    __hash__ = object.__hash__
+
+    def __eq__(self, other):
+        return self._cmp(lambda v: v == other)
+
+    def __ne__(self, other):
+        return self._cmp(lambda v: v != other)
+
 
 def col(name: str) -> _Column:
     return _Column(name)
@@ -528,6 +539,69 @@ class LocalDataFrame:
         if name not in self._fields:
             raise KeyError(name)
         return _Column(name)
+
+    def where(self, expr) -> "LocalDataFrame":
+        if not isinstance(expr, _SeriesExpr):
+            raise TypeError(
+                "local engine supports where only with column expressions"
+            )
+        import pandas as pd
+
+        idx = self._fields.index(expr.input_col.name)
+        out_parts = []
+        for part in self._partitions:
+            if not part:
+                out_parts.append([])
+                continue
+            mask = list(expr.fn(pd.Series([row[idx] for row in part])))
+            out_parts.append(
+                [row for row, keep in zip(part, mask) if keep]
+            )
+        return LocalDataFrame(self._session, self._fields, out_parts)
+
+    filter = where
+
+    def union(self, other: "LocalDataFrame") -> "LocalDataFrame":
+        # pyspark's union resolves columns by POSITION; the local engine
+        # only supports the identical-schema case the front-ends use
+        if list(other._fields) != self._fields:
+            raise ValueError(
+                f"union needs matching schemas: {self._fields} vs "
+                f"{other._fields}"
+            )
+        return LocalDataFrame(
+            self._session, self._fields,
+            [*self._partitions, *other._partitions],
+        )
+
+    unionAll = union
+
+    def randomSplit(self, weights, seed: Optional[int] = None
+                    ) -> List["LocalDataFrame"]:
+        """pyspark semantics: each row lands in split i with probability
+        weights[i]/sum(weights), independently, partition structure
+        preserved."""
+        import numpy as _np
+
+        w = _np.asarray(list(weights), dtype=_np.float64)
+        if (w <= 0).any():
+            raise ValueError("split weights must be positive")
+        bounds = _np.cumsum(w / w.sum())
+        rng = _np.random.default_rng(seed)
+        split_parts: List[List[List[tuple]]] = [
+            [] for _ in range(len(w))
+        ]
+        for part in self._partitions:
+            draws = rng.random(len(part))
+            assign = _np.searchsorted(bounds, draws, side="right")
+            # a draw of exactly 1.0 cannot occur (random() < 1), so every
+            # row lands in [0, len(w))
+            for s in range(len(w)):
+                split_parts[s].append(
+                    [row for row, a in zip(part, assign) if a == s]
+                )
+        return [LocalDataFrame(self._session, self._fields, parts)
+                for parts in split_parts]
 
     # -- mapInArrow --------------------------------------------------------
     def mapInArrow(self, fn: Callable, schema: str,

@@ -1,0 +1,647 @@
+"""Round-5 DataFrame front-ends exercised through the local engine.
+
+Covers the front-end gap families (adapter3: BisectingKMeans, DBSCAN,
+FM, AFT, Isotonic, PIC, PrefixSpan), the transformer batches
+(spark/transformers.py), composition + model selection
+(spark/tuning_front.py), and the relational additions to the local
+engine (where/union/randomSplit) they ride on. Pattern matches
+``test_spark_local_lane.py``: every front-end compared against the
+local-model oracle on the same data.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.spark._compat import HAVE_PYSPARK
+from spark_rapids_ml_tpu.spark.local_engine import (
+    DenseVector,
+    LocalSparkSession,
+)
+
+if HAVE_PYSPARK:  # pragma: no cover - this sandbox has no pyspark
+    pytest.skip(
+        "real pyspark present: the pyspark lane runs in CI instead",
+        allow_module_level=True,
+    )
+
+import spark_rapids_ml_tpu.spark as S  # noqa: E402
+from spark_rapids_ml_tpu.data.frame import VectorFrame  # noqa: E402
+
+
+@pytest.fixture
+def spark():
+    return LocalSparkSession(n_partitions=3)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def _vector_df(spark, x, extra_cols=()):
+    rows = []
+    for i, r in enumerate(x):
+        row = {"features": DenseVector(r)}
+        for name, values in extra_cols:
+            row[name] = values[i]
+        rows.append(row)
+    return spark.createDataFrame(rows)
+
+
+# --------------------------------------------------------------------------
+# local engine relational additions
+# --------------------------------------------------------------------------
+
+def test_local_engine_where_eq(spark):
+    df = spark.createDataFrame([{"a": i % 3, "b": float(i)}
+                                for i in range(9)])
+    out = df.where(df["a"] == 1)
+    assert [r["b"] for r in out.collect()] == [1.0, 4.0, 7.0]
+    assert df.filter(df["a"] != 0).count() == 6
+
+
+def test_local_engine_union(spark):
+    df1 = spark.createDataFrame([{"a": 1}, {"a": 2}])
+    df2 = spark.createDataFrame([{"a": 3}])
+    assert [r["a"] for r in df1.union(df2).collect()] == [1, 2, 3]
+    with pytest.raises(ValueError, match="matching schemas"):
+        df1.union(spark.createDataFrame([{"b": 1}]))
+
+
+def test_local_engine_random_split(spark):
+    df = spark.createDataFrame([{"a": i} for i in range(200)])
+    splits = df.randomSplit([0.5, 0.5], seed=3)
+    counts = [s.count() for s in splits]
+    assert sum(counts) == 200
+    assert all(50 < c < 150 for c in counts)
+    # deterministic under the same seed
+    again = [s.count() for s in df.randomSplit([0.5, 0.5], seed=3)]
+    assert counts == again
+    # every row lands in exactly one split
+    seen = sorted(r["a"] for s in splits for r in s.collect())
+    assert seen == list(range(200))
+
+
+# --------------------------------------------------------------------------
+# transformers: text chain
+# --------------------------------------------------------------------------
+
+def test_text_chain_matches_local(spark):
+    texts = ["Hello World hello", "foo Bar foo baz", "hello foo"]
+    df = spark.createDataFrame([{"text": t} for t in texts])
+    tok = S.Tokenizer(inputCol="text", outputCol="toks")
+    tokens = [r["toks"] for r in tok.transform(df).collect()]
+    assert tokens[0] == ["hello", "world", "hello"]
+
+    tf = S.HashingTF(inputCol="toks", outputCol="tf", numFeatures=64)
+    out = tf.transform(tok.transform(df)).collect()
+    from spark_rapids_ml_tpu.models.text import HashingTF as LTF
+
+    local = LTF(inputCol="toks", outputCol="tf", numFeatures=64)
+    expect = local.transform(VectorFrame({"toks": tokens})).column("tf")
+    np.testing.assert_allclose(
+        np.stack([r["tf"].toArray() for r in out]), expect)
+
+    cv = S.CountVectorizer(inputCol="toks", outputCol="cnt", minDF=1.0)
+    cvm = cv.fit(tok.transform(df))
+    assert cvm.vocabulary[0] in ("hello", "foo")
+    counted = cvm.transform(tok.transform(df))
+    idfm = S.IDF(inputCol="cnt", outputCol="tfidf").fit(counted)
+    got = idfm.transform(counted).collect()
+    assert got[0]["tfidf"].toArray().shape[0] == len(cvm.vocabulary)
+
+    sw = S.StopWordsRemover(inputCol="toks", outputCol="clean")
+    cleaned = sw.transform(tok.transform(df)).collect()
+    assert "hello" in cleaned[0]["clean"]
+    ng = S.NGram(inputCol="toks", outputCol="grams", n=2)
+    grams = ng.transform(tok.transform(df)).collect()
+    assert grams[0]["grams"] == ["hello world", "world hello"]
+
+
+def test_regex_tokenizer_front(spark):
+    df = spark.createDataFrame([{"text": "a-b-ccc"}])
+    rt = S.RegexTokenizer(inputCol="text", outputCol="toks",
+                          pattern="-", minTokenLength=2)
+    assert rt.transform(df).collect()[0]["toks"] == ["ccc"]
+
+
+# --------------------------------------------------------------------------
+# transformers: indexing / encoding / bucketing
+# --------------------------------------------------------------------------
+
+def test_string_indexer_onehot_roundtrip(spark):
+    cats = ["a", "b", "a", "c", "a", "b"]
+    df = spark.createDataFrame([{"cat": c} for c in cats])
+    sim = S.StringIndexer(inputCol="cat", outputCol="idx").fit(df)
+    dfi = sim.transform(df)
+    assert [r["idx"] for r in dfi.collect()] == [0.0, 1.0, 0.0, 2.0,
+                                                 0.0, 1.0]
+    its = S.IndexToString(inputCol="idx", outputCol="back",
+                          labels=sim.labels)
+    assert [r["back"] for r in its.transform(dfi).collect()] == cats
+    ohm = S.OneHotEncoder(inputCol="idx", outputCol="oh").fit(dfi)
+    oh = np.stack([r["oh"].toArray()
+                   for r in ohm.transform(dfi).collect()])
+    assert oh.shape == (6, 2)  # dropLast=True over 3 categories
+    np.testing.assert_allclose(oh[0], [1.0, 0.0])
+
+
+def test_string_indexer_skip_drops_rows(spark):
+    fit_df = spark.createDataFrame([{"cat": c} for c in ["a", "b", "a"]])
+    sim = S.StringIndexer(inputCol="cat", outputCol="idx",
+                          handleInvalid="skip").fit(fit_df)
+    new_df = spark.createDataFrame([{"cat": c}
+                                    for c in ["a", "zz", "b"]])
+    out = sim.transform(new_df)
+    assert out.count() == 2  # 'zz' dropped via the rebuild path
+    assert [r["cat"] for r in out.collect()] == ["a", "b"]
+
+
+def test_bucketizer_and_quantile_discretizer(spark, rng):
+    vals = rng.normal(size=40)
+    df = spark.createDataFrame([{"v": float(v)} for v in vals])
+    bk = S.Bucketizer(inputCol="v", outputCol="b",
+                      splits=[-np.inf, 0.0, np.inf])
+    got = np.asarray([r["b"] for r in bk.transform(df).collect()])
+    np.testing.assert_allclose(got, (vals >= 0).astype(float))
+
+    qd = S.QuantileDiscretizer(inputCol="v", outputCol="b",
+                               numBuckets=4)
+    front_bk = qd.fit(df)
+    assert isinstance(front_bk, type(bk))  # Spark's fit -> Bucketizer
+    counts = np.bincount(np.asarray(
+        [int(r["b"]) for r in front_bk.transform(df).collect()]))
+    assert counts.size == 4 and counts.min() >= 8
+
+
+def test_vector_assembler_mixed_and_skip(spark):
+    df = spark.createDataFrame([
+        {"a": 1.0, "v": DenseVector([2.0, 3.0])},
+        {"a": float("nan"), "v": DenseVector([5.0, 6.0])},
+    ])
+    va = S.VectorAssembler(inputCols=["a", "v"], outputCol="feat",
+                           handleInvalid="skip")
+    out = va.transform(df)
+    assert out.count() == 1  # NaN row dropped on the rebuild path
+    np.testing.assert_allclose(
+        out.collect()[0]["feat"].toArray(), [1.0, 2.0, 3.0])
+    with pytest.raises(ValueError, match="NaN"):
+        S.VectorAssembler(inputCols=["a", "v"], outputCol="feat",
+                          handleInvalid="error").transform(df).collect()
+
+
+# --------------------------------------------------------------------------
+# transformers: vector math equivalences vs local
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("front_name,local_mod,local_name,kwargs", [
+    ("DCT", "feature_transformers2", "DCT", {}),
+    ("Normalizer", "feature_scalers", "Normalizer", {"p": 2.0}),
+    ("Binarizer", "feature_scalers", "Binarizer", {"threshold": 0.1}),
+    ("PolynomialExpansion", "feature_transformers",
+     "PolynomialExpansion", {"degree": 2}),
+    ("VectorSlicer", "feature_transformers", "VectorSlicer",
+     {"indices": [0, 2]}),
+    ("ElementwiseProduct", "feature_transformers", "ElementwiseProduct",
+     {"scalingVec": [1.0, 2.0, 0.5, -1.0]}),
+])
+def test_vector_transformers_match_local(spark, rng, front_name,
+                                         local_mod, local_name, kwargs):
+    import importlib
+
+    x = rng.normal(size=(10, 4))
+    df = _vector_df(spark, x)
+    front = getattr(S, front_name)(inputCol="features", outputCol="out",
+                                   **kwargs)
+    got = np.stack([r["out"].toArray()
+                    for r in front.transform(df).collect()])
+    local_cls = getattr(importlib.import_module(
+        f"spark_rapids_ml_tpu.models.{local_mod}"), local_name)
+    local = local_cls()
+    for k, v in {"inputCol": "features", "outputCol": "out",
+                 **kwargs}.items():
+        local.set(k, v)
+    expect = np.asarray(local.transform(
+        VectorFrame({"features": x})).column("out"), dtype=np.float64)
+    np.testing.assert_allclose(got, expect, rtol=1e-12)
+
+
+def test_interaction_and_feature_hasher(spark, rng):
+    x = rng.normal(size=(6, 2))
+    df = spark.createDataFrame([
+        {"s": float(i % 2), "v": DenseVector(r)}
+        for i, r in enumerate(x)
+    ])
+    inter = S.Interaction(inputCols=["s", "v"], outputCol="iv")
+    got = np.stack([r["iv"].toArray()
+                    for r in inter.transform(df).collect()])
+    expect = x * np.asarray([i % 2 for i in range(6)],
+                            dtype=np.float64)[:, None]
+    np.testing.assert_allclose(got, expect)
+
+    fh = S.FeatureHasher(inputCols=["s", "cat"], outputCol="h",
+                         numFeatures=16)
+    df2 = spark.createDataFrame([{"s": 2.0, "cat": "x"},
+                                 {"s": 3.0, "cat": "y"}])
+    h = np.stack([r["h"].toArray()
+                  for r in fh.transform(df2).collect()])
+    assert h.shape == (2, 16) and (h != 0).any()
+
+
+def test_selectors_match_local(spark, rng):
+    x = np.concatenate([rng.normal(size=(30, 2)),
+                        np.full((30, 1), 7.0)], axis=1)
+    y = (x[:, 0] > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    vts = S.VarianceThresholdSelector(
+        inputCol="features", outputCol="sel",
+        varianceThreshold=1e-9).fit(df)
+    got = np.stack([r["sel"].toArray()
+                    for r in vts.transform(df).collect()])
+    np.testing.assert_allclose(got, x[:, :2])  # constant col dropped
+
+    xc = rng.integers(0, 3, size=(40, 3)).astype(float)
+    yc = xc[:, 1]  # feature 1 fully determines the label
+    dfc = _vector_df(spark, xc, extra_cols=[("label", yc)])
+    chi = S.ChiSqSelector(inputCol="features", labelCol="label",
+                          outputCol="sel", numTopFeatures=1).fit(dfc)
+    got = np.stack([r["sel"].toArray()
+                    for r in chi.transform(dfc).collect()])
+    np.testing.assert_allclose(got[:, 0], xc[:, 1])
+
+    uni = S.UnivariateFeatureSelector(
+        inputCol="features", labelCol="label", outputCol="sel",
+        featureType="continuous", labelType="categorical",
+        selectionMode="numTopFeatures", selectionThreshold=1).fit(df)
+    got = np.stack([r["sel"].toArray()
+                    for r in uni.transform(df).collect()])
+    np.testing.assert_allclose(got[:, 0], x[:, 0])
+
+
+def test_vector_indexer_front(spark):
+    x = np.asarray([[0.0, 10.5], [1.0, -3.2], [0.0, 7.7], [2.0, 10.5]])
+    df = _vector_df(spark, x)
+    vim = S.VectorIndexer(inputCol="features", outputCol="ix",
+                          maxCategories=3).fit(df)
+    got = np.stack([r["ix"].toArray()
+                    for r in vim.transform(df).collect()])
+    # column 0 re-indexed (3 distinct), column 1 continuous (4 distinct
+    # would exceed?) -- 3 distinct values also categorical
+    assert got.shape == (4, 2)
+    assert set(got[:, 0]) == {0.0, 1.0, 2.0}
+
+
+def test_vector_size_hint_modes(spark):
+    df = spark.createDataFrame([{"v": DenseVector([1.0, 2.0])},
+                                {"v": DenseVector([3.0])}])
+    ok = spark.createDataFrame([{"v": DenseVector([1.0, 2.0])}])
+    hint = S.VectorSizeHint(inputCol="v", size=2)
+    assert hint.transform(ok).count() == 1
+    with pytest.raises(ValueError, match="size"):
+        hint.transform(df).collect()
+    skip = S.VectorSizeHint(inputCol="v", size=2, handleInvalid="skip")
+    assert skip.transform(df).count() == 1
+    opt = S.VectorSizeHint(inputCol="v", size=2,
+                           handleInvalid="optimistic")
+    assert opt.transform(df).count() == 2
+
+
+def test_sql_transformer_and_rformula(spark):
+    df = spark.createDataFrame([{"a": 1.0, "b": 2.0},
+                                {"a": 3.0, "b": 4.0}])
+    st = S.SQLTransformer(
+        statement="SELECT *, a + b AS s FROM __THIS__")
+    out = st.transform(df)
+    assert [r["s"] for r in out.collect()] == [3.0, 7.0]
+
+    rf = S.RFormula(formula="b ~ a").fit(df)
+    out2 = rf.transform(df).collect()
+    np.testing.assert_allclose(out2[0]["features"].toArray(), [1.0])
+    assert out2[0]["label"] == 2.0
+
+
+# --------------------------------------------------------------------------
+# adapter3 families
+# --------------------------------------------------------------------------
+
+def test_bisecting_kmeans_front(spark, rng):
+    centers = np.asarray([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]])
+    x = np.concatenate([c + rng.normal(scale=0.3, size=(30, 2))
+                        for c in centers])
+    df = _vector_df(spark, x)
+    model = S.BisectingKMeans(k=3, featuresCol="features",
+                              predictionCol="pred", seed=5).fit(df)
+    preds = np.asarray([r["pred"]
+                        for r in model.transform(df).collect()])
+    assert len(set(preds)) == 3
+    for g in range(3):
+        block = preds[g * 30:(g + 1) * 30]
+        assert len(set(block)) == 1  # each blob single-labeled
+
+
+def test_fm_front_matches_local(spark, rng):
+    x = rng.normal(size=(80, 3))
+    y = (x @ [1.5, -1.0, 0.2] > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    fmc = S.FMClassifier(featuresCol="features", labelCol="label",
+                         maxIter=40, factorSize=2, seed=0).fit(df)
+    out = fmc.transform(df).collect()
+    acc = np.mean([r["prediction"] for r in out] == y)
+    assert acc > 0.9
+    # probability column is the Spark 2-vector
+    p = out[0]["probability"].toArray()
+    assert p.shape == (2,) and abs(p.sum() - 1.0) < 1e-9
+
+    yr = x @ [2.0, 1.0, -0.5]
+    dfr = _vector_df(spark, x, extra_cols=[("label", yr)])
+    fmr = S.FMRegressor(featuresCol="features", labelCol="label",
+                        maxIter=60, factorSize=2, seed=0).fit(dfr)
+    pred = np.asarray([r["prediction"]
+                       for r in fmr.transform(dfr).collect()])
+    assert np.corrcoef(pred, yr)[0, 1] > 0.95
+
+
+def test_aft_front_quantiles_from_pred(spark, rng):
+    x = rng.normal(size=(60, 2))
+    t = np.exp(x @ [0.5, -0.3] + 1.0)
+    cens = np.ones(60)
+    df = _vector_df(spark, x, extra_cols=[("label", t),
+                                          ("censor", cens)])
+    aft = S.AFTSurvivalRegression(
+        featuresCol="features", labelCol="label", censorCol="censor",
+        quantilesCol="q", quantileProbabilities=[0.5]).fit(df)
+    out = aft.transform(df).collect()
+    from spark_rapids_ml_tpu.models.survival_regression import (
+        AFTSurvivalRegressionModel as LocalAFT,
+    )
+
+    assert isinstance(aft._local, LocalAFT)
+    pred = np.asarray([r["prediction"] for r in out])
+    expect = aft._local.predict(x)
+    np.testing.assert_allclose(pred, expect, rtol=1e-9)
+    # quantiles derive from the prediction column
+    q = np.stack([r["q"].toArray() for r in out])
+    np.testing.assert_allclose(
+        q, aft._local.predict_quantiles(x), rtol=1e-9)
+
+
+def test_isotonic_front(spark, rng):
+    f = np.sort(rng.normal(size=50))
+    y = f + rng.normal(scale=0.05, size=50)
+    x = np.stack([f, rng.normal(size=50)], axis=1)
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    iso = S.IsotonicRegression(featuresCol="features",
+                               labelCol="label").fit(df)
+    pred = np.asarray([r["prediction"]
+                       for r in iso.transform(df).collect()])
+    assert (np.diff(pred[np.argsort(f)]) >= -1e-12).all()
+
+
+def test_dbscan_front_and_mismatch(spark, rng):
+    pts = np.concatenate([rng.normal(0, 0.1, size=(15, 2)),
+                          rng.normal(5, 0.1, size=(15, 2))])
+    df = _vector_df(spark, pts)
+    model = S.DBSCAN(featuresCol="features", eps=0.5, minPts=3).fit(df)
+    out = model.transform(df)
+    labs = np.asarray([r["prediction"] for r in out.collect()])
+    assert set(labs) == {0, 1}
+    assert len(set(labs[:15])) == 1 and len(set(labs[15:])) == 1
+    with pytest.raises(ValueError, match="fitted dataset only"):
+        model.transform(_vector_df(spark, pts[:5]))
+
+
+def test_pic_front(spark):
+    edges = [{"src": 0, "dst": 1, "w": 1.0},
+             {"src": 1, "dst": 2, "w": 1.0},
+             {"src": 0, "dst": 2, "w": 1.0},
+             {"src": 3, "dst": 4, "w": 1.0},
+             {"src": 4, "dst": 5, "w": 1.0},
+             {"src": 3, "dst": 5, "w": 1.0}]
+    df = spark.createDataFrame(edges)
+    pic = S.PowerIterationClustering(k=2, weightCol="w", maxIter=20,
+                                     seed=1)
+    out = pic.assignClusters(df).collect()
+    clusters = {r["id"]: r["cluster"] for r in out}
+    assert clusters[0] == clusters[1] == clusters[2]
+    assert clusters[3] == clusters[4] == clusters[5]
+    assert clusters[0] != clusters[3]
+    with pytest.raises(TypeError, match="assignClusters"):
+        pic.fit(df)
+
+
+def test_prefix_span_front(spark):
+    seqs = [{"sequence": [["a"], ["b", "c"]]},
+            {"sequence": [["a"], ["b"]]},
+            {"sequence": [["a"]]}]
+    df = spark.createDataFrame(seqs)
+    ps = S.PrefixSpan(minSupport=0.6, sequenceCol="sequence")
+    got = {tuple(tuple(s) for s in r["sequence"]): r["freq"]
+           for r in ps.findFrequentSequentialPatterns(df).collect()}
+    assert got[(("a",),)] == 3
+    assert got[(("a",), ("b",))] == 2
+
+
+# --------------------------------------------------------------------------
+# tuning + pipeline
+# --------------------------------------------------------------------------
+
+def test_cross_validator_picks_right_param(spark, rng):
+    x = rng.normal(size=(150, 4))
+    y = x @ [1.0, -2.0, 0.5, 0.0] + 0.01 * rng.normal(size=150)
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    lr = S.LinearRegression(featuresCol="features", labelCol="label",
+                            predictionCol="prediction")
+    ev = S.RegressionEvaluator(metricName="rmse", labelCol="label",
+                               predictionCol="prediction")
+    grid = S.ParamGridBuilder().addGrid(
+        "regParam", [0.0, 100.0]).build()
+    cvm = S.CrossValidator(estimator=lr, estimatorParamMaps=grid,
+                           evaluator=ev, numFolds=3, seed=7).fit(df)
+    assert cvm.bestIndex == 0
+    assert cvm.avgMetrics[0] < cvm.avgMetrics[1]
+    pred = cvm.transform(df).collect()[0]
+    assert abs(pred["prediction"] - pred["label"]) < 1.0
+
+
+def test_cross_validator_fold_col(spark, rng):
+    x = rng.normal(size=(30, 2))
+    y = x @ [1.0, 1.0]
+    folds = [float(i % 3) for i in range(30)]
+    df = _vector_df(spark, x, extra_cols=[("label", y),
+                                          ("fold", folds)])
+    lr = S.LinearRegression(featuresCol="features", labelCol="label",
+                            predictionCol="prediction")
+    ev = S.RegressionEvaluator(metricName="rmse", labelCol="label",
+                               predictionCol="prediction")
+    cvm = S.CrossValidator(estimator=lr, estimatorParamMaps=[{}],
+                           evaluator=ev, numFolds=3,
+                           foldCol="fold").fit(df)
+    assert len(cvm.avgMetrics) == 1
+    bad = S.CrossValidator(estimator=lr, estimatorParamMaps=[{}],
+                           evaluator=ev, numFolds=4, foldCol="fold")
+    with pytest.raises(ValueError, match="fold"):
+        bad.fit(df)
+
+
+def test_train_validation_split_front(spark, rng):
+    x = rng.normal(size=(120, 3))
+    y = x @ [2.0, 0.0, -1.0]
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    lr = S.LinearRegression(featuresCol="features", labelCol="label",
+                            predictionCol="prediction")
+    ev = S.RegressionEvaluator(metricName="r2", labelCol="label",
+                               predictionCol="prediction")
+    grid = S.ParamGridBuilder().addGrid(
+        "regParam", [0.0, 50.0]).build()
+    tm = S.TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid, evaluator=ev,
+        trainRatio=0.75, seed=9, collectSubModels=True).fit(df)
+    assert tm.bestIndex == 0  # r2 larger-better
+    assert len(tm.subModels) == 2
+
+
+def test_pipeline_compose_and_tune(spark, rng):
+    x = rng.normal(size=(90, 3))
+    y = x @ [1.0, 0.5, -1.0] + 0.01 * rng.normal(size=90)
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    pipe = S.Pipeline(stages=[
+        S.VectorAssembler(inputCols=["features"], outputCol="f2"),
+        S.LinearRegression(featuresCol="f2", labelCol="label",
+                           predictionCol="prediction"),
+    ])
+    pm = pipe.fit(df)
+    got = pm.transform(df).collect()[0]
+    assert abs(got["prediction"] - got["label"]) < 0.5
+
+    ev = S.RegressionEvaluator(metricName="rmse", labelCol="label",
+                               predictionCol="prediction")
+    grid = S.ParamGridBuilder().addGrid(
+        "regParam", [0.0, 100.0]).build()
+    cvm = S.CrossValidator(estimator=pipe, estimatorParamMaps=grid,
+                           evaluator=ev, numFolds=3, seed=1).fit(df)
+    assert cvm.bestIndex == 0
+
+
+def test_pipeline_persistence_front_stages(spark, rng, tmp_path):
+    x = rng.normal(size=(40, 3))
+    y = x @ [1.0, -1.0, 2.0]
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    pipe = S.Pipeline(stages=[
+        S.VectorAssembler(inputCols=["features"], outputCol="f2"),
+        S.LinearRegression(featuresCol="f2", labelCol="label",
+                           predictionCol="prediction"),
+    ])
+    pm = pipe.fit(df)
+    path = str(tmp_path / "front_pipe")
+    pm.save(path)
+    loaded = S.PipelineModel.load(path)
+    # stages rewrap at the DataFrame layer, not the VectorFrame layer
+    assert type(loaded.stages[0]).__name__ == "VectorAssembler"
+    got = np.asarray([r["prediction"]
+                      for r in loaded.transform(df).collect()])
+    expect = np.asarray([r["prediction"]
+                         for r in pm.transform(df).collect()])
+    np.testing.assert_allclose(got, expect, rtol=1e-12)
+
+
+def test_cross_validator_model_persistence_front_layer(spark, rng,
+                                                       tmp_path):
+    x = rng.normal(size=(60, 3))
+    y = x @ [1.0, -1.0, 2.0]
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    lr = S.LinearRegression(featuresCol="features", labelCol="label",
+                            predictionCol="prediction")
+    ev = S.RegressionEvaluator(metricName="rmse", labelCol="label",
+                               predictionCol="prediction")
+    grid = S.ParamGridBuilder().addGrid("regParam", [0.0, 10.0]).build()
+    cvm = S.CrossValidator(estimator=lr, estimatorParamMaps=grid,
+                           evaluator=ev, numFolds=3, seed=7).fit(df)
+    path = str(tmp_path / "cvm")
+    cvm.save(path)
+    loaded = S.CrossValidatorModel.load(path)
+    # bestModel rewraps at the DataFrame layer (the sidecar), so the
+    # loaded model still transforms DataFrames, not VectorFrames
+    assert type(loaded.bestModel).__module__.endswith("spark.estimator")
+    out = loaded.transform(df)
+    assert hasattr(out, "withColumn")
+    np.testing.assert_allclose(loaded.avgMetrics, cvm.avgMetrics)
+
+    # the unfitted front estimator round-trips too
+    cv = S.CrossValidator(estimator=lr, estimatorParamMaps=grid,
+                          evaluator=ev, numFolds=3, seed=7)
+    est_path = str(tmp_path / "cv")
+    cv.save(est_path)
+    cv2 = S.CrossValidator.load(est_path)
+    assert type(cv2.estimator).__name__ == "LinearRegression"
+    assert cv2.getNumFolds() == 3
+
+
+def test_tuned_pipeline_keeps_prefit_stage_state(spark, rng):
+    x = rng.normal(size=(60, 3))
+    y = x @ [1.0, -1.0, 2.0]
+    df = _vector_df(spark, x, extra_cols=[("label", y)])
+    ev = S.RegressionEvaluator(metricName="rmse", labelCol="label",
+                               predictionCol="prediction")
+    # a PRE-FITTED model used as a pipeline transformer stage must keep
+    # its fitted state through the tuning clone
+    pca_model = S.PCA(k=2, inputCol="features", outputCol="p").fit(df)
+    pipe = S.Pipeline(stages=[
+        pca_model,
+        S.LinearRegression(featuresCol="p", labelCol="label",
+                           predictionCol="prediction"),
+    ])
+    cvp = S.CrossValidator(estimator=pipe, estimatorParamMaps=[{}],
+                           evaluator=ev, numFolds=2, seed=2).fit(df)
+    assert len(cvp.avgMetrics) == 1
+    assert np.isfinite(cvp.avgMetrics[0])
+
+
+def test_classic_spark_pipeline_end_to_end(spark, rng):
+    """The canonical Spark ML workflow, verbatim over this engine:
+    StringIndexer → OneHotEncoder → VectorAssembler → LogisticRegression,
+    wrapped in a CrossValidator over a param grid — mixed column types,
+    multi-stage composition, evaluator scoring, one flow."""
+    n = 120
+    cats = [["red", "green", "blue"][i % 3] for i in range(n)]
+    x = rng.normal(size=(n, 2))
+    # label depends on both the numeric features and the category
+    y = ((x[:, 0] + (np.asarray([c == "red" for c in cats]) * 2.0))
+         > 0.5).astype(float)
+    df = spark.createDataFrame([
+        {"color": c, "num": DenseVector(r), "label": float(v)}
+        for c, r, v in zip(cats, x, y)
+    ])
+    pipe = S.Pipeline(stages=[
+        S.StringIndexer(inputCol="color", outputCol="color_ix"),
+        S.OneHotEncoder(inputCol="color_ix", outputCol="color_oh"),
+        S.VectorAssembler(inputCols=["num", "color_oh"],
+                          outputCol="features"),
+        S.LogisticRegression(featuresCol="features", labelCol="label",
+                             predictionCol="prediction",
+                             probabilityCol="probability"),
+    ])
+    model = pipe.fit(df)
+    out = model.transform(df)
+    pred = np.asarray([r["prediction"] for r in out.collect()])
+    assert (pred == y).mean() > 0.9
+
+    ev = S.MulticlassClassificationEvaluator(
+        metricName="accuracy", labelCol="label",
+        predictionCol="prediction")
+    assert ev.evaluate(out) > 0.9
+    grid = S.ParamGridBuilder().addGrid(
+        "3.regParam", [0.0, 100.0]).build()
+    cvm = S.CrossValidator(estimator=pipe, estimatorParamMaps=grid,
+                           evaluator=ev, numFolds=3, seed=4).fit(df)
+    assert cvm.bestIndex == 0  # unregularized wins on accuracy
+
+
+def test_evaluators_accept_dataframes(spark, rng):
+    y = rng.normal(size=30)
+    pred = y + 0.1
+    df = spark.createDataFrame(
+        [{"label": float(a), "prediction": float(b)}
+         for a, b in zip(y, pred)])
+    ev = S.RegressionEvaluator(metricName="rmse", labelCol="label",
+                               predictionCol="prediction")
+    assert abs(ev.evaluate(df) - 0.1) < 1e-9
