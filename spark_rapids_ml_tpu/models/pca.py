@@ -37,6 +37,9 @@ from spark_rapids_ml_tpu.models.params import (
     Param,
     Params,
 )
+from spark_rapids_ml_tpu.utils.numeric import (
+    GRAM_PRECISIONS as _GRAM_PRECISIONS,
+)
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
@@ -100,6 +103,23 @@ class PCAParams(HasInputCol, HasOutputCol, HasDeviceId):
         "so one f32 batch is ~128 MiB",
         0,
         validator=lambda v: isinstance(v, int) and v >= 0,
+    )
+    gramPrecision = Param(
+        "gramPrecision",
+        "MXU precision for the Gram/covariance matmul — the documented "
+        "accuracy/speed trade (the analogue of the reference's "
+        "useGemm/useCuSolverSVD toggles, RapidsPCA.scala:30-75). "
+        "'auto' (default) defers to TPUML_GRAM_PRECISION (bfloat16_3x: "
+        "3-pass bf16 split with f32 accumulation — measured numerically "
+        "indistinguishable from 'highest' on the covariance oracle, "
+        "~1.3x faster). 'bfloat16' opts into the single-pass bf16 arm — "
+        "the chip's measured ceiling (records/r04/gram_sweep.json: "
+        "MFU 0.92) with a RELAXED accuracy contract: covariance error "
+        "grows with conditioning, so use it when the spectrum is "
+        "well-separated and ~1e-2 relative component error is "
+        "acceptable. 'float32'/'highest' force full-precision passes.",
+        "auto",
+        validator=lambda v: v == "auto" or v in _GRAM_PRECISIONS,
     )
 
 
@@ -268,6 +288,18 @@ class PCA(PCAParams):
         model.svd_solver_used_ = getattr(self, "_svd_solver_used", None)
         return model
 
+    def _gram_precision(self):
+        """The resolved ``gramPrecision`` param: None when 'auto' (each
+        kernel then defers to TPUML_GRAM_PRECISION at trace time), else
+        the validated explicit value — which wins over the env var and
+        participates in every jit cache key it reaches."""
+        value = self.get_or_default("gramPrecision")
+        if value == "auto":
+            return None
+        from spark_rapids_ml_tpu.ops.covariance import resolve_gram_precision
+
+        return resolve_gram_precision(value)
+
     # -- streamed (out-of-core) path -------------------------------------
     def _fit_streamed(self, source, k, use_xla_dot, use_xla_svd, timer):
         if use_xla_dot:
@@ -286,6 +318,7 @@ class PCA(PCAParams):
                     mean_centering=self.getMeanCentering(),
                     dtype=dtype,
                     device=device,
+                    precision=self._gram_precision(),
                 )
                 cov = jax.block_until_ready(cov)
             if self.getMeanCentering() and float(count) < 2:
@@ -331,6 +364,7 @@ class PCA(PCAParams):
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.getDtype())
         mean_centering = self.getMeanCentering()
+        precision = self._gram_precision()
 
         if use_xla_dot and _pallas_gram_enabled(device, dtype, x_host.shape[1]):
             # Fused Pallas center+scale+mask+Gram (ops/pallas_gram.py):
@@ -348,6 +382,7 @@ class PCA(PCAParams):
                     x_host,
                     mean_centering=mean_centering,
                     device=device,
+                    precision=precision,
                 )
                 cov = jax.block_until_ready(cov)
             if use_xla_svd:
@@ -378,10 +413,11 @@ class PCA(PCAParams):
                 ):
                     if mean_centering:
                         mean = column_means(x)
-                        cov = covariance(x, mean=mean)
+                        cov = covariance(x, mean=mean,
+                                         precision=precision)
                     else:
                         mean = jnp.zeros((x.shape[1],), dtype=x.dtype)
-                        cov = covariance(x)
+                        cov = covariance(x, precision=precision)
                 with timer.phase("solve"), TraceRange("xla eigh",
                                                       TraceColor.BLUE):
                     pc, evr = self._solve_cov_gated(cov, k)
@@ -393,6 +429,7 @@ class PCA(PCAParams):
             with timer.phase("fit_kernel"), TraceRange("compute cov", TraceColor.RED):
                 result = pca_fit_kernel(
                     x, k, mean_centering=mean_centering, solver=solver,
+                    precision=precision,
                 )
                 result = jax.block_until_ready(result)
             self._svd_solver_used = (
@@ -409,10 +446,10 @@ class PCA(PCAParams):
             with timer.phase("covariance"), TraceRange("compute cov", TraceColor.RED):
                 if mean_centering:
                     mean = column_means(x)
-                    cov = covariance(x, mean=mean)
+                    cov = covariance(x, mean=mean, precision=precision)
                 else:
                     mean = jnp.zeros((x.shape[1],), dtype=x.dtype)
-                    cov = covariance(x)
+                    cov = covariance(x, precision=precision)
                 cov = jax.block_until_ready(cov)
             with timer.phase("solve"), TraceRange("host eigh", TraceColor.BLUE):
                 pc, evr = _host_eig_topk(np.asarray(cov, dtype=np.float64), k)

@@ -41,7 +41,9 @@ from jax import lax
 # Note: inside jit-compiled kernels the value is read at TRACE time and baked
 # into the compiled program — changing the env var later affects new traces
 # (new shapes) but not already-cached executables.
-_ALLOWED_PRECISIONS = ("default", "bfloat16", "bfloat16_3x", "float32", "highest")
+from spark_rapids_ml_tpu.utils.numeric import (  # noqa: E402
+    GRAM_PRECISIONS as _ALLOWED_PRECISIONS,
+)
 
 
 def default_gram_precision() -> str:
@@ -50,6 +52,20 @@ def default_gram_precision() -> str:
     if value not in _ALLOWED_PRECISIONS:
         raise ValueError(
             f"TPUML_GRAM_PRECISION={value!r} is not one of {_ALLOWED_PRECISIONS}"
+        )
+    return value
+
+
+def resolve_gram_precision(value) -> str:
+    """An estimator's ``gramPrecision`` param → the concrete MXU
+    precision: ``None``/'auto' defers to the env-configured default;
+    an explicit value is validated and wins over the env var."""
+    if value is None or value == "auto":
+        return default_gram_precision()
+    if value not in _ALLOWED_PRECISIONS:
+        raise ValueError(
+            f"gramPrecision={value!r} is not one of "
+            f"('auto',) + {_ALLOWED_PRECISIONS}"
         )
     return value
 

@@ -344,7 +344,7 @@ def pad_for_fused_gram(x, mask=None, dtype=None,
 
 def covariance_fused(x, mask=None, mean_centering: bool = True,
                      interpret: bool = False, device=None,
-                     dtype=jnp.float32):
+                     dtype=jnp.float32, precision=None):
     """Covariance via the fused kernel: host-side padding + on-device
     mean pass + single fused Gram. Returns (cov[n,n], mean[n]); arrays land
     on ``device`` when given (the estimator's resolved chip), else the
@@ -368,6 +368,6 @@ def covariance_fused(x, mask=None, mean_centering: bool = True,
     scale = 1.0 / jnp.sqrt(jnp.maximum(cnt - 1.0, 1.0))
     cov_full = fused_centered_gram(
         x_dev, mean, rowmask_dev * scale, interpret=interpret,
-        block_n=bn, block_r=br,
+        precision=precision, block_n=bn, block_r=br,
     )
     return cov_full[:n, :n], mean[:n]

@@ -32,7 +32,9 @@ class PCAFitResult(NamedTuple):
 
 
 @partial(
-    jax.jit, static_argnames=("k", "mean_centering", "flip_signs", "solver")
+    jax.jit,
+    static_argnames=("k", "mean_centering", "flip_signs", "solver",
+                     "precision"),
 )
 def pca_fit_kernel(
     x: jnp.ndarray,
@@ -41,19 +43,23 @@ def pca_fit_kernel(
     mean_centering: bool = True,
     flip_signs: bool = True,
     solver: str = "eigh",
+    precision: Optional[str] = None,
 ) -> PCAFitResult:
     """Full PCA fit on one device: mean → centered Gram → eigh → top-k.
 
     Two-pass (explicit centering before the Gram) for parity with the
     reference's semantics; the distributed path offers a one-pass variant.
     ``mask`` marks valid rows when the batch is padded to a static shape.
+    ``precision`` is STATIC — part of the jit cache key, so switching the
+    Gram precision between fits retraces instead of silently reusing the
+    old executable.
     """
     if mean_centering:
         mean = column_means(x, mask)
-        cov = covariance(x, mean=mean, mask=mask)
+        cov = covariance(x, mean=mean, mask=mask, precision=precision)
     else:
         mean = jnp.zeros((x.shape[1],), dtype=x.dtype)
-        cov = covariance(x, mean=None, mask=mask)
+        cov = covariance(x, mean=None, mask=mask, precision=precision)
     components, evr = pca_from_covariance(
         cov, k, flip_signs=flip_signs, solver=solver
     )

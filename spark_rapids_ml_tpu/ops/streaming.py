@@ -48,13 +48,16 @@ def init_stats(n_features: int, dtype=jnp.float32, device=None) -> GramStats:
     return stats
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("precision",))
 def update_stats(
-    stats: GramStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+    stats: GramStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None,
+    precision: Optional[str] = None,
 ) -> GramStats:
     """Accumulate one batch. ``stats`` buffers are DONATED — XLA updates the
-    Gram in place (no n×n copy per batch)."""
-    g, s, cnt = partial_gram_stats(batch.astype(stats.gram.dtype), mask)
+    Gram in place (no n×n copy per batch). ``precision`` is static (part
+    of the jit key) so switching Gram precision retraces."""
+    g, s, cnt = partial_gram_stats(batch.astype(stats.gram.dtype), mask,
+                                   precision=precision)
     return GramStats(stats.gram + g, stats.col_sum + s, stats.count + cnt)
 
 
@@ -85,21 +88,26 @@ def finalize_stats(
     return PCAFitResult(components, evr, mean)
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnames=("bn", "br"))
+@partial(jax.jit, donate_argnums=(0,),
+         static_argnames=("bn", "br", "precision"))
 def _update_stats_fused_blocked(stats: GramStats, batch: jnp.ndarray,
-                                *, bn: int, br: int) -> GramStats:
+                                *, bn: int, br: int,
+                                precision: Optional[str] = None
+                                ) -> GramStats:
     from spark_rapids_ml_tpu.ops.pallas_gram import fused_centered_gram
 
     b = batch.astype(stats.gram.dtype)
     zero_mean = jnp.zeros((b.shape[1],), dtype=b.dtype)
     ones = jnp.ones((b.shape[0],), dtype=b.dtype)
-    g = fused_centered_gram(b, zero_mean, ones, block_n=bn, block_r=br)
+    g = fused_centered_gram(b, zero_mean, ones, precision=precision,
+                            block_n=bn, block_r=br)
     s = jnp.sum(b, axis=0)
     cnt = jnp.asarray(b.shape[0], dtype=jnp.int32)
     return GramStats(stats.gram + g, stats.col_sum + s, stats.count + cnt)
 
 
-def update_stats_fused(stats: GramStats, batch: jnp.ndarray) -> GramStats:
+def update_stats_fused(stats: GramStats, batch: jnp.ndarray,
+                       precision: Optional[str] = None) -> GramStats:
     """``update_stats`` with the Gram computed by the Pallas symmetric
     folded-grid kernel (``ops.pallas_gram``) instead of ``lax.dot_general``.
     Requires tile-aligned batches (rows % block_r == 0, an even number of
@@ -112,7 +120,8 @@ def update_stats_fused(stats: GramStats, batch: jnp.ndarray) -> GramStats:
     from spark_rapids_ml_tpu.ops.pallas_gram import gram_block_shape
 
     bn, br = gram_block_shape()
-    return _update_stats_fused_blocked(stats, batch, bn=bn, br=br)
+    return _update_stats_fused_blocked(stats, batch, bn=bn, br=br,
+                                       precision=precision)
 
 
 def _gram_platform(gram_acc) -> str:
@@ -155,13 +164,14 @@ def fused_update_applicable(gram_acc, batch, mask) -> bool:
 
 
 def update_stats_auto(
-    stats: GramStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+    stats: GramStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None,
+    precision: Optional[str] = None,
 ) -> GramStats:
     """The production accumulate step: picks the measured-fastest Gram
     kernel for this backend/shape (see ``fused_update_applicable``)."""
     if fused_update_applicable(stats.gram, batch, mask):
-        return update_stats_fused(stats, batch)
-    return update_stats(stats, batch, mask)
+        return update_stats_fused(stats, batch, precision=precision)
+    return update_stats(stats, batch, mask, precision=precision)
 
 
 class StreamingPCA:
@@ -214,44 +224,52 @@ def update_mean_stats(
     )
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("precision",))
 def update_centered_gram(
     gram_acc: jnp.ndarray,
     batch: jnp.ndarray,
     mean: jnp.ndarray,
     mask: Optional[jnp.ndarray] = None,
+    precision: Optional[str] = None,
 ) -> jnp.ndarray:
     from spark_rapids_ml_tpu.ops.covariance import _masked, gram
 
     b = batch.astype(gram_acc.dtype) - mean[None, :]
-    return gram_acc + gram(_masked(b, mask))
+    return gram_acc + gram(_masked(b, mask), precision=precision)
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnames=("bn", "br"))
-def _update_centered_gram_fused_blocked(gram_acc, batch, mean, *, bn, br):
+@partial(jax.jit, donate_argnums=(0,),
+         static_argnames=("bn", "br", "precision"))
+def _update_centered_gram_fused_blocked(gram_acc, batch, mean, *, bn, br,
+                                        precision=None):
     from spark_rapids_ml_tpu.ops.pallas_gram import fused_centered_gram
 
     b = batch.astype(gram_acc.dtype)
     ones = jnp.ones((b.shape[0],), dtype=b.dtype)
     return gram_acc + fused_centered_gram(b, mean.astype(b.dtype), ones,
+                                          precision=precision,
                                           block_n=bn, block_r=br)
 
 
-def _update_centered_gram_fused(gram_acc, batch, mean):
+def _update_centered_gram_fused(gram_acc, batch, mean, precision=None):
     from spark_rapids_ml_tpu.ops.pallas_gram import gram_block_shape
 
     bn, br = gram_block_shape()
     return _update_centered_gram_fused_blocked(gram_acc, batch, mean,
-                                               bn=bn, br=br)
+                                               bn=bn, br=br,
+                                               precision=precision)
 
 
-def update_centered_gram_auto(gram_acc, batch, mean, mask=None):
+def update_centered_gram_auto(gram_acc, batch, mean, mask=None,
+                              precision=None):
     """Centered-Gram accumulate via the measured-fastest kernel: the Pallas
     kernel centers in VMEM (no (X−μ) materialization at all), same policy
     gate as ``update_stats_auto``."""
     if fused_update_applicable(gram_acc, batch, mask):
-        return _update_centered_gram_fused(gram_acc, batch, mean)
-    return update_centered_gram(gram_acc, batch, mean, mask)
+        return _update_centered_gram_fused(gram_acc, batch, mean,
+                                           precision=precision)
+    return update_centered_gram(gram_acc, batch, mean, mask,
+                                precision=precision)
 
 
 def stream_covariance(
@@ -259,6 +277,7 @@ def stream_covariance(
     mean_centering: bool = True,
     dtype=jnp.float32,
     device=None,
+    precision: Optional[str] = None,
 ):
     """Stream a ``data.batches.BatchSource`` into (covariance, mean, count).
 
@@ -286,7 +305,8 @@ def stream_covariance(
             pass2_rows += batch.shape[0] if mask is None else int(mask.sum())
             gram_acc = update_centered_gram_auto(
                 gram_acc, jnp.asarray(batch, dtype=dtype), mean,
-                None if mask is None else jnp.asarray(mask))
+                None if mask is None else jnp.asarray(mask),
+                precision=precision)
         if pass2_rows != int(count):
             # A "re-iterable" factory that hands back a partially-consumed
             # iterator would silently zero the Gram; fail instead.
@@ -301,7 +321,8 @@ def stream_covariance(
     stats = init_stats(n, dtype=dtype, device=device)
     for batch, mask in source.batches():
         stats = update_stats_auto(stats, jnp.asarray(batch, dtype=dtype),
-                                  None if mask is None else jnp.asarray(mask))
+                                  None if mask is None else jnp.asarray(mask),
+                                  precision=precision)
     cov = covariance_from_stats(
         stats.gram, stats.col_sum, stats.count, mean_centering=mean_centering
     )

@@ -167,7 +167,7 @@ def test_block_shape_reaches_fused_dispatch(monkeypatch):
 
     seen = []
 
-    def fake_blocked(stats, batch, *, bn, br):
+    def fake_blocked(stats, batch, *, bn, br, precision=None):
         seen.append((bn, br))
         return stats
 
