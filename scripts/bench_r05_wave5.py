@@ -1,0 +1,276 @@
+"""Round-5 wave-5: MXU-meaningful config 5 + the gramPrecision ladder.
+
+VERDICT r4 #3/#5:
+1. Wide-shape KMeans/LogReg (2M×512 — d=512 contractions that actually
+   tile onto the 128×128 systolic array, unlike the d=64 narrow rows).
+2. GBT end-to-end fit throughput (the family had zero recorded perf).
+3. The ``gramPrecision='bfloat16'`` single-pass arm measured through the
+   PRODUCTION accumulate path (``update_stats_auto(precision=...)`` — the
+   exact function ``PCA.fit`` streams through) at the config-4 shape,
+   alongside a same-window bfloat16_3x reference arm, plus the accuracy
+   contract (covariance error vs a float64 oracle on ill-conditioned
+   data) so the BASELINE row documents BOTH sides of the trade.
+
+Single process, one claim; exit 2 when no chip (wrapper retries).
+Artifacts land under ``records/r05/``; logs join ``records/r04``'s
+status stream for round continuity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from bench_common import (
+    REPO,
+    is_unavailable,
+    log,
+    probe,
+    stamp,
+    write_error,
+)
+
+OUT5 = os.path.join(REPO, "records", "r05")
+
+
+def _emit(path: str, rows: list, device) -> None:
+    os.makedirs(OUT5, exist_ok=True)
+    with open(os.path.join(OUT5, path), "w") as f:
+        for rec in rows:
+            rec["platform"] = device.platform
+            rec["device_kind"] = str(getattr(device, "device_kind", "?"))
+            rec["recorded_utc"] = stamp()
+            f.write(json.dumps(rec) + "\n")
+
+
+def main() -> int:
+    device = probe("wave5")
+    if device is None:
+        return 2
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.utils.platform import PEAK_FLOPS_BF16
+
+    peak = PEAK_FLOPS_BF16.get(
+        str(getattr(device, "device_kind", device.platform)))
+
+    def fence(v):
+        return np.asarray(v).ravel()[0]
+
+    ok = {"wide": False, "gbt": False, "precision": False}
+
+    # -- 1. wide-shape KMeans + LogReg (2M×512) -------------------------
+    try:
+        rows, cols, k = 2_097_152, 512, 64
+        key = jax.random.PRNGKey(0)
+        x = jax.device_put(
+            jax.random.normal(key, (rows, cols), dtype=jnp.float32),
+            device)
+        out = []
+
+        from spark_rapids_ml_tpu.ops.kmeans_kernel import (
+            kmeans_fit_kernel,
+            kmeans_plus_plus_init,
+        )
+
+        iters = 10
+        init = kmeans_plus_plus_init(x, k, jax.random.PRNGKey(1))
+        fence(kmeans_fit_kernel(x, init, max_iter=iters, tol=0.0).centers)
+        t0 = time.perf_counter()
+        r = kmeans_fit_kernel(x, init, max_iter=iters, tol=0.0)
+        fence(r.centers)
+        dt = time.perf_counter() - t0
+        it_done = int(np.asarray(r.n_iter))
+        km_flops = 2.0 * rows * cols * k * max(it_done, 1)
+        out.append({
+            "metric": "KMeans Lloyd rows/sec/chip (wide)",
+            "value": round(rows * max(it_done, 1) / dt, 1),
+            "unit": "rows/sec (per Lloyd pass)",
+            "config": f"{rows}x{cols} k={k} iters={it_done}",
+            "seconds": round(dt, 3),
+            "util": round(km_flops / dt / peak, 4) if peak else None,
+        })
+        log("wave5 kmeans-wide ok")
+
+        from spark_rapids_ml_tpu.ops.logreg_kernel import logreg_fit_kernel
+
+        w_true = jax.random.normal(jax.random.PRNGKey(2), (cols,),
+                                   dtype=jnp.float32)
+        y = (x @ w_true > 0).astype(jnp.float32)
+        n_iter_cfg = 8
+        fence(logreg_fit_kernel(x, y, None, reg_param=1e-3,
+                                max_iter=n_iter_cfg,
+                                tol=0.0).coefficients)
+        t0 = time.perf_counter()
+        r = logreg_fit_kernel(x, y, None, reg_param=1e-3,
+                              max_iter=n_iter_cfg, tol=0.0)
+        fence(r.coefficients)
+        dt = time.perf_counter() - t0
+        it_done = int(np.asarray(r.n_iter))
+        lr_flops = (2.0 * rows * cols * cols + 6.0 * rows * cols) * max(
+            it_done, 1)
+        out.append({
+            "metric": "LogisticRegression Newton rows/sec/chip (wide)",
+            "value": round(rows * max(it_done, 1) / dt, 1),
+            "unit": "rows/sec (per Newton pass)",
+            "config": f"{rows}x{cols} iters={it_done}",
+            "seconds": round(dt, 3),
+            "util": round(lr_flops / dt / peak, 4) if peak else None,
+        })
+        del x, y
+        _emit("bench_models_wide.json", out, device)
+        ok["wide"] = True
+        log("wave5 logreg-wide ok")
+    except Exception as exc:  # noqa: BLE001
+        write_error("bench_wide", exc)
+        if is_unavailable(exc):
+            log("wave5 ABORT (claim lost)")
+            return 2
+        log("wave5 wide FAILED")
+
+    # -- 2. GBT end-to-end fit ------------------------------------------
+    try:
+        from spark_rapids_ml_tpu import GBTClassifier
+
+        gbt_rows, gbt_cols = 524_288, 64
+        rng = np.random.default_rng(3)
+        xg = rng.normal(size=(gbt_rows, gbt_cols)).astype(np.float32)
+        yg = (xg[:, 0] + 0.5 * xg[:, 1] > 0).astype(np.float64)
+        est = GBTClassifier().setMaxIter(20).setMaxDepth(5).setSeed(7)
+        est.fit(xg, yg)  # warm-up: compiles excluded
+        t0 = time.perf_counter()
+        model = est.fit(xg, yg)
+        dt = time.perf_counter() - t0
+        assert model is not None
+        _emit("bench_gbt.json", [{
+            "metric": "GBT fit rows/sec/chip",
+            "value": round(gbt_rows / dt, 1),
+            "unit": "rows/sec (20 rounds, depth 5, end-to-end fit)",
+            "config": f"{gbt_rows}x{gbt_cols} maxIter=20 depth=5",
+            "seconds": round(dt, 3),
+            "util": None,
+        }], device)
+        ok["gbt"] = True
+        log("wave5 gbt ok")
+    except Exception as exc:  # noqa: BLE001
+        write_error("bench_gbt", exc)
+        if is_unavailable(exc):
+            log("wave5 ABORT (claim lost)")
+            return 2
+        log("wave5 gbt FAILED")
+
+    # -- 3. gramPrecision ladder through the production accumulate ------
+    try:
+        from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance_gated
+        from spark_rapids_ml_tpu.ops.streaming import (
+            covariance_from_stats,
+            init_stats,
+            update_stats_auto,
+        )
+
+        batch, cols, k = 65_536, 4096, 256
+        rows_target = 10_485_760
+        n_steps = rows_target // batch
+        key = jax.random.PRNGKey(0)
+        col_scale = (1.0 + jnp.arange(cols, dtype=jnp.float32)) ** -0.5
+        x_batch = jax.device_put(
+            jax.random.normal(key, (batch, cols), dtype=jnp.float32)
+            * col_scale[None, :], device)
+
+        out = []
+        for prec, label in (("bfloat16", "single-pass bf16 opt-in"),
+                            ("bfloat16_3x", "production default")):
+            stats = init_stats(cols, dtype=jnp.float32, device=device)
+            stats = update_stats_auto(stats, x_batch, precision=prec)
+            int(np.asarray(stats.count))           # compile fence
+            stats = init_stats(cols, dtype=jnp.float32, device=device)
+            steps = 0
+            t0 = time.perf_counter()
+            while steps < n_steps:
+                burst = min(16, n_steps - steps)
+                for _ in range(burst):
+                    stats = update_stats_auto(stats, x_batch,
+                                              precision=prec)
+                int(np.asarray(stats.count))       # fence
+                steps += burst
+            acc_s = time.perf_counter() - t0
+            warm = pca_from_covariance_gated(
+                covariance_from_stats(stats.gram, stats.col_sum,
+                                      stats.count), k)
+            np.asarray(warm[0])
+            t0 = time.perf_counter()
+            cov = covariance_from_stats(stats.gram, stats.col_sum,
+                                        stats.count)
+            pc, evr, solver_used = pca_from_covariance_gated(cov, k)
+            np.asarray(pc)                          # fence
+            fin_s = time.perf_counter() - t0
+            measured = steps * batch
+            wall = acc_s + fin_s
+            # useful FLOPs: one symmetric Gram = n·d²; MFU vs bf16 peak
+            mfu = (measured * cols * cols / acc_s / peak
+                   if peak else None)
+            out.append({
+                "metric": f"PCA.fit rows/sec/chip "
+                          f"(gramPrecision={prec})",
+                "value": round(measured / wall, 1),
+                "unit": "rows/sec",
+                "config": f"{measured}x{cols} k={k} ({label}); "
+                          f"solver={solver_used}",
+                "seconds": round(wall, 3),
+                "phase_seconds": {"accumulate": round(acc_s, 3),
+                                  "finalize": round(fin_s, 3)},
+                "accumulate_rows_per_sec": round(measured / acc_s, 1),
+                "mfu_accumulate": round(mfu, 4) if mfu else None,
+            })
+            log(f"wave5 precision arm {prec} ok")
+
+        # accuracy contract on ill-conditioned data (f64 host oracle)
+        rng = np.random.default_rng(5)
+        d = 256
+        scales = 0.92 ** np.arange(d)
+        xa = (100.0 + rng.normal(size=(4096, d)) * scales[None, :])
+        cov_ref = np.cov(xa, rowvar=False)
+        scale = float(np.abs(cov_ref).max())
+        from spark_rapids_ml_tpu.ops.covariance import covariance
+
+        xd = jax.device_put(jnp.asarray(xa, dtype=jnp.float32), device)
+        errs = {}
+        for prec in ("bfloat16", "bfloat16_3x", "highest"):
+            cov_m = np.asarray(covariance(
+                xd, mean=jnp.mean(xd, axis=0), precision=prec))
+            errs[prec] = float(np.abs(cov_m - cov_ref).max() / scale)
+        out.append({
+            "metric": "gramPrecision covariance rel-err "
+                      "(ill-conditioned 4096x256, mean=100)",
+            "value": errs["bfloat16"],
+            "unit": "max|cov_err|/max|cov| per precision",
+            "config": json.dumps(errs),
+            "seconds": None,
+        })
+        _emit("gram_precision.json", out, device)
+        ok["precision"] = True
+        log("wave5 precision contract ok")
+    except Exception as exc:  # noqa: BLE001
+        write_error("bench_precision", exc)
+        if is_unavailable(exc):
+            log("wave5 ABORT (claim lost)")
+            return 2
+        log("wave5 precision FAILED")
+
+    if not all(ok.values()):
+        log(f"wave5 incomplete ({ok}); retrying")
+        return 2
+    os.makedirs(OUT5, exist_ok=True)
+    with open(os.path.join(OUT5, "wave5_done"), "w") as f:
+        f.write(stamp() + "\n")
+    log("wave5 ALL DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Wave-5 wrapper (round 5): MXU-meaningful config 5 + gramPrecision
+# ladder, strictly after every round-4 wave claimant is gone (one chip
+# claimant at a time). Gate pattern matches bench_r04_wave4.sh.
+cd /root/repo || exit 1
+export PYTHONPATH=/root/repo:/root/.axon_site
+OUT=/root/repo/records/r04
+OUT5=/root/repo/records/r05
+mkdir -p "$OUT" "$OUT5"
+
+sleep 120
+absent=0
+while [ "$absent" -lt 2 ]; do
+  if [ -f "$OUT/wave4_done" ] \
+     && ! pgrep -f "bench_r04_wave[234]" > /dev/null; then
+    break
+  fi
+  if pgrep -f "bench_r04_wave[234]" > /dev/null; then
+    absent=0
+  else
+    absent=$((absent + 1))
+  fi
+  sleep 60
+done
+[ -f "$OUT/wave4_done" ] || \
+  echo "wave5: earlier waves exited without done markers; proceeding: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+
+for i in $(seq 1 24); do
+  echo "wave5 attempt $i start: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  python scripts/bench_r05_wave5.py >> "$OUT/loop.log" 2>&1
+  rc=$?
+  echo "wave5 attempt $i rc=$rc: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
+  [ -f "$OUT5/wave5_done" ] && exit 0
+  sleep 300
+done
+echo "wave5 gave up: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"

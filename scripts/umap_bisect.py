@@ -1,0 +1,67 @@
+"""UMAP scale bisect: ONLY run if wave-3's 200k retry reproduces the
+UNAVAILABLE (VERDICT r4 #2 — a repeat failure means a real fault in the
+blocked repulsion/kNN path, ``models/umap.py::_fit_blocked`` /
+``ops/umap_kernel.py``, not transient claim collateral).
+
+Runs the tiled fit at increasing row counts on the live chip, recording
+each stage so the failing scale (and the last good one) are committed
+even when the failing program kills the claim. One process, one claim;
+exit 2 when no chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from bench_common import REPO, is_unavailable, log, probe, stamp
+
+OUT5 = os.path.join(REPO, "records", "r05")
+
+
+def main() -> int:
+    device = probe("umap_bisect")
+    if device is None:
+        return 2
+
+    import numpy as np
+
+    from spark_rapids_ml_tpu.models.umap import UMAP
+
+    os.makedirs(OUT5, exist_ok=True)
+    path = os.path.join(OUT5, "umap_bisect.json")
+    cols, epochs = 64, 20
+    rng = np.random.default_rng(0)
+    for rows in (50_000, 100_000, 150_000, 200_000):
+        x = rng.normal(size=(rows, cols)).astype(np.float32)
+        # two gaussian blobs so the embedding has structure to resolve
+        x[rows // 2:] += 4.0
+        rec = {"rows": rows, "cols": cols, "epochs": epochs,
+               "recorded_utc": stamp()}
+        try:
+            t0 = time.perf_counter()
+            um = (UMAP().setNNeighbors(15).setNEpochs(epochs)
+                  .setInputCol("features").fit(x))
+            emb = np.asarray(um.embedding_)
+            rec["seconds"] = round(time.perf_counter() - t0, 2)
+            rec["ok"] = bool(np.isfinite(emb).all())
+            log(f"umap_bisect {rows} ok ({rec['seconds']}s)")
+        except Exception as exc:  # noqa: BLE001
+            rec["ok"] = False
+            rec["error"] = f"{type(exc).__name__}: {exc}"[:500]
+            log(f"umap_bisect {rows} FAILED ({type(exc).__name__})")
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            # UNAVAILABLE kills the claim — record and stop; the failing
+            # scale is the diagnostic payload
+            return 2 if is_unavailable(exc) else 1
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    log("umap_bisect ALL scales ok (fault not reproduced)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
