@@ -1,0 +1,200 @@
+"""Round-5 DataFrame front-ends against REAL pyspark (CI lane only).
+
+Same gating as ``test_pyspark_planes.py``: no pyspark in this sandbox,
+so these skip locally and run in the CI pyspark lane — driving the
+round-5 surface (transformer batch, adapter3 families, Pipeline +
+CrossValidator over genuine DataFrame randomSplit/union folds, and the
+evaluators' DataFrame duck-path) through a genuine SparkSession. The
+local-engine lane (``test_spark_front_ends.py``) runs the identical
+front-end code everywhere else.
+"""
+
+import numpy as np
+import pytest
+
+pyspark = pytest.importorskip("pyspark")
+
+from pyspark.ml.linalg import Vectors  # noqa: E402
+from pyspark.sql import SparkSession  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = (
+        SparkSession.builder.master("local[2]")
+        .appName("tpu-front-end-smoke")
+        .config("spark.sql.shuffle.partitions", "2")
+        .getOrCreate()
+    )
+    yield s
+    s.stop()
+
+
+def test_text_chain_pyspark(spark):
+    from spark_rapids_ml_tpu.spark import (
+        CountVectorizer,
+        HashingTF,
+        IDF,
+        Tokenizer,
+    )
+
+    df = spark.createDataFrame(
+        [("Hello World hello",), ("foo Bar foo baz",)], ["text"]
+    )
+    toks = Tokenizer(inputCol="text", outputCol="toks").transform(df)
+    assert toks.collect()[0]["toks"] == ["hello", "world", "hello"]
+    tf = HashingTF(inputCol="toks", outputCol="tf",
+                   numFeatures=64).transform(toks)
+    assert tf.collect()[0]["tf"].toArray().shape == (64,)
+    cvm = CountVectorizer(inputCol="toks", outputCol="cnt").fit(toks)
+    counted = cvm.transform(toks)
+    idfm = IDF(inputCol="cnt", outputCol="tfidf").fit(counted)
+    out = idfm.transform(counted).collect()
+    assert out[0]["tfidf"].toArray().shape[0] == len(cvm.vocabulary)
+
+
+def test_indexing_assembly_pyspark(spark):
+    from spark_rapids_ml_tpu.spark import (
+        OneHotEncoder,
+        StringIndexer,
+        VectorAssembler,
+    )
+
+    df = spark.createDataFrame(
+        [("a", 1.0), ("b", 2.0), ("a", 3.0)], ["cat", "num"]
+    )
+    dfi = StringIndexer(inputCol="cat", outputCol="ix").fit(df)\
+        .transform(df)
+    assert [r["ix"] for r in dfi.collect()] == [0.0, 1.0, 0.0]
+    oh = OneHotEncoder(inputCol="ix", outputCol="oh").fit(dfi)\
+        .transform(dfi)
+    out = VectorAssembler(inputCols=["num", "oh"], outputCol="f")\
+        .transform(oh).collect()
+    np.testing.assert_allclose(out[0]["f"].toArray(), [1.0, 1.0])
+
+
+def test_adapter3_families_pyspark(spark):
+    from spark_rapids_ml_tpu.spark import (
+        AFTSurvivalRegression,
+        BisectingKMeans,
+        IsotonicRegression,
+    )
+
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(0, 0.3, size=(30, 2)),
+                        rng.normal(6, 0.3, size=(30, 2))])
+    df = spark.createDataFrame(
+        [(Vectors.dense(r),) for r in x], ["features"]
+    )
+    bkm = BisectingKMeans(k=2, featuresCol="features",
+                          predictionCol="pred", seed=3).fit(df)
+    preds = np.asarray([r["pred"]
+                        for r in bkm.transform(df).collect()])
+    assert len(set(preds[:30])) == 1 and preds[0] != preds[-1]
+
+    t = np.exp(x[:, 0] * 0.2 + 1.0)
+    aft_df = spark.createDataFrame(
+        [(Vectors.dense(r), float(ti), 1.0) for r, ti in zip(x, t)],
+        ["features", "label", "censor"],
+    )
+    aft = AFTSurvivalRegression(featuresCol="features",
+                                labelCol="label",
+                                censorCol="censor").fit(aft_df)
+    assert np.isfinite(
+        [r["prediction"] for r in aft.transform(aft_df).collect()]
+    ).all()
+
+    iso = IsotonicRegression(featuresCol="features",
+                             labelCol="label").fit(aft_df)
+    pred = np.asarray([r["prediction"]
+                       for r in iso.transform(aft_df).collect()])
+    order = np.argsort(x[:, 0])
+    assert (np.diff(pred[order]) >= -1e-9).all()
+
+
+def test_pic_prefixspan_pyspark(spark):
+    from spark_rapids_ml_tpu.spark import (
+        PowerIterationClustering,
+        PrefixSpan,
+    )
+
+    edges = spark.createDataFrame(
+        [(0, 1, 1.0), (1, 2, 1.0), (0, 2, 1.0),
+         (3, 4, 1.0), (4, 5, 1.0), (3, 5, 1.0)],
+        ["src", "dst", "w"],
+    )
+    pic = PowerIterationClustering(k=2, weightCol="w", maxIter=20,
+                                   seed=1)
+    got = {r["id"]: r["cluster"]
+           for r in pic.assignClusters(edges).collect()}
+    assert got[0] == got[1] == got[2] != got[3]
+
+    seqs = spark.createDataFrame(
+        [([["a"], ["b"]],), ([["a"]],)], ["sequence"]
+    )
+    ps = PrefixSpan(minSupport=0.9, sequenceCol="sequence")
+    pats = {tuple(tuple(s) for s in r["sequence"]): r["freq"]
+            for r in ps.findFrequentSequentialPatterns(seqs).collect()}
+    assert pats[(("a",),)] == 2
+
+
+def test_pipeline_cv_pyspark(spark):
+    from spark_rapids_ml_tpu.spark import (
+        CrossValidator,
+        LinearRegression,
+        ParamGridBuilder,
+        Pipeline,
+        RegressionEvaluator,
+        VectorAssembler,
+    )
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(150, 3))
+    y = x @ [1.0, -2.0, 0.5]
+    df = spark.createDataFrame(
+        [(Vectors.dense(r), float(v)) for r, v in zip(x, y)],
+        ["num", "label"],
+    )
+    pipe = Pipeline(stages=[
+        VectorAssembler(inputCols=["num"], outputCol="features"),
+        LinearRegression(featuresCol="features", labelCol="label",
+                         predictionCol="prediction"),
+    ])
+    ev = RegressionEvaluator(metricName="rmse", labelCol="label",
+                             predictionCol="prediction")
+    grid = ParamGridBuilder().addGrid("regParam", [0.0, 100.0]).build()
+    cvm = CrossValidator(estimator=pipe, estimatorParamMaps=grid,
+                         evaluator=ev, numFolds=3, seed=5).fit(df)
+    assert cvm.bestIndex == 0
+    # the evaluator consumed REAL pyspark DataFrames (the duck-typed
+    # as_vector_frame path) and the folds rode pyspark randomSplit/union
+    scored = cvm.transform(df)
+    assert ev.evaluate(scored) < 0.1
+
+
+def test_tuned_model_persistence_pyspark(spark, tmp_path):
+    from spark_rapids_ml_tpu.spark import (
+        LinearRegression,
+        Pipeline,
+        PipelineModel,
+        VectorAssembler,
+    )
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(60, 2))
+    y = x @ [2.0, 1.0]
+    df = spark.createDataFrame(
+        [(Vectors.dense(r), float(v)) for r, v in zip(x, y)],
+        ["num", "label"],
+    )
+    pm = Pipeline(stages=[
+        VectorAssembler(inputCols=["num"], outputCol="features"),
+        LinearRegression(featuresCol="features", labelCol="label",
+                         predictionCol="prediction"),
+    ]).fit(df)
+    path = str(tmp_path / "front_pipe")
+    pm.save(path)
+    loaded = PipelineModel.load(path)
+    a = [r["prediction"] for r in pm.transform(df).collect()]
+    b = [r["prediction"] for r in loaded.transform(df).collect()]
+    np.testing.assert_allclose(a, b, rtol=1e-12)
