@@ -8,7 +8,9 @@ classes require it (or the in-repo local engine).
 
 Fit-strategy routing (resolved lazily below): bespoke statistics planes
 (``estimator.py``) for PCA/LinReg/LogReg/KMeans/NaiveBayes; per-level
-tree planes (``forest_estimator.py``) for RandomForest/GBT; moments/Gram/
+tree planes (``forest_estimator.py``) for DecisionTree/RandomForest/GBT
+(the DT estimators moved here in round 5 — Spark's own single-tree =
+``RandomForest.run(numTrees=1)`` factoring); moments/Gram/
 Newton/EM planes (``moments_estimator.py``) for the scalers,
 TruncatedSVD, Imputer, RobustScaler, LinearSVC, OneVsRest,
 GeneralizedLinearRegression, and GaussianMixture; the envelope-guarded
@@ -16,9 +18,9 @@ driver-collect adapter (``adapter.py``) only for the non-decomposable
 fits (UMAP spectral init, KNN item capture, the MLP's full-batch
 L-BFGS whose linesearch state does not split into cheap per-partition
 jobs) and every Model transform. The round-4 families ride
-``adapter2.py`` (DTs/LSH and the bespoke ALS/Word2Vec collectors),
-except LDA whose EM optimizer runs per-iteration statistics jobs on
-the moments plane. Round 5 closes the surface: the remaining estimator
+``adapter2.py`` (LSH, the DT *Model* classes, and the bespoke
+ALS/Word2Vec collectors), except LDA whose EM optimizer runs
+per-iteration statistics jobs on the moments plane. Round 5 closes the surface: the remaining estimator
 families (``adapter3.py``), the text/feature transformer batch as
 per-Arrow-batch ``pandas_udf`` front-ends (``transformers.py``),
 composition + model selection over DataFrame folds
@@ -49,6 +51,8 @@ _PYSPARK_CLASSES = (
 # executor statistics plane (per-level histogram partials), never
 # collecting rows to the driver; transform stays the adapter pandas_udf
 _FOREST_PLANE_CLASSES = (
+    "DecisionTreeClassifier",
+    "DecisionTreeRegressor",
     "RandomForestClassifier",
     "RandomForestRegressor",
     "GBTClassifier",
@@ -106,9 +110,9 @@ _ADAPTER2_CLASSES = (
     "ALSModel",
     "BucketedRandomProjectionLSH",
     "BucketedRandomProjectionLSHModel",
-    "DecisionTreeClassifier",
+    # NOTE: the DecisionTree ESTIMATORS route to the forest statistics
+    # plane (round 5); only their Model classes live here
     "DecisionTreeClassifierModel",
-    "DecisionTreeRegressor",
     "DecisionTreeRegressorModel",
     "FPGrowth",
     "FPGrowthModel",

@@ -740,7 +740,8 @@ def partition_forest_histograms_device(
 
     streams = [
         _tree_weight_stream(rate, seed, int(t["tree"]), pid,
-                            always_poisson=True)
+                            always_poisson=True,
+                            bootstrap=bool(spec.get("bootstrap", True)))
         for t in trees
     ]
     tree_feats = [np.asarray(t["feature"]) for t in trees]

@@ -1,7 +1,11 @@
-"""DataFrame tree-ensemble fits on the executor statistics plane.
+"""DataFrame tree fits on the executor statistics plane.
 
 Replaces the generic adapter's driver-collect for RandomForest and GBT
-(VERDICT r3 #3): the reference's architecture keeps rows on executors and
+(VERDICT r3 #3) and — round 5 — for the DecisionTree estimators too
+(Spark's single tree IS ``RandomForest.run(numTrees=1, all features,
+no bootstrap)``; the spec carries ``bootstrap=False`` so the weight
+streams stay unit and the fit is deterministic):
+the reference's architecture keeps rows on executors and
 moves only additive partials (``RapidsRowMatrix.scala:168-202``); histogram
 trees decompose the same way PER LEVEL — executors bin + route + histogram
 their partitions (``spark/forest_plane.py``), the driver sums the tiny
@@ -29,6 +33,7 @@ from __future__ import annotations
 import numpy as np
 
 from spark_rapids_ml_tpu.spark import adapter as _adapter
+from spark_rapids_ml_tpu.spark import adapter2 as _adapter2
 from spark_rapids_ml_tpu.spark.forest_plane import (
     combine_hist_rows,
     hist_arrow_schema,
@@ -257,6 +262,8 @@ def _fit_forest_plane(local_est, dataset, classification):
                         "edges": edges, "n_bins": n_bins, "level": level,
                         "subsampling_rate": rate, "seed": seed,
                         "classes": classes, "weight_col": wcol,
+                        "bootstrap": getattr(local_est, "_bootstrap",
+                                             True),
                         "trees": [
                             {"tree": t, "feature": feature_arr[t],
                              "threshold": threshold_arr[t]}
@@ -286,6 +293,7 @@ def _fit_forest_plane(local_est, dataset, classification):
                     "edges": edges, "depth": depth,
                     "subsampling_rate": rate, "seed": seed,
                     "classes": classes, "weight_col": wcol,
+                    "bootstrap": getattr(local_est, "_bootstrap", True),
                     "trees": [
                         {"tree": t, "feature": feature_arr[t],
                          "threshold": threshold_arr[t]}
@@ -511,6 +519,31 @@ class GBTRegressor(_adapter.GBTRegressor):
 
     def _fit(self, dataset):
         local_model = _fit_gbt_plane(
+            self._local, dataset, classification=False
+        )
+        return self._model_cls(local_model)
+
+
+class DecisionTreeClassifier(_adapter2.DecisionTreeClassifier):
+    """DataFrame DecisionTreeClassifier on the executor statistics plane:
+    Spark's own factoring (a single tree IS RandomForest.run with
+    numTrees=1, all features, no bootstrap) applied to the per-level
+    histogram plane — the driver-collect adapter fit is replaced by
+    executor partials; transform stays the adapter pandas_udf."""
+
+    def _fit(self, dataset):
+        local_model = _fit_forest_plane(
+            self._local, dataset, classification=True
+        )
+        return self._model_cls(local_model)
+
+
+class DecisionTreeRegressor(_adapter2.DecisionTreeRegressor):
+    """DataFrame DecisionTreeRegressor on the executor statistics
+    plane."""
+
+    def _fit(self, dataset):
+        local_model = _fit_forest_plane(
             self._local, dataset, classification=False
         )
         return self._model_cls(local_model)

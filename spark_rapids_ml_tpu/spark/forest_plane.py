@@ -276,11 +276,16 @@ def histogram_channels_np(
 
 
 def _tree_weight_stream(rate: float, seed: int, tree: int, pid: int,
-                        always_poisson: bool):
+                        always_poisson: bool, bootstrap: bool = True):
     """Per-(tree, partition) bootstrap-weight generator, streamed across
     batches in row order. RF always draws Poisson(rate) (rate-sized
     bootstrap); GBT follows Spark's convention that rate ≥ 1.0 means NO
-    subsampling (unit weights)."""
+    subsampling (unit weights). ``bootstrap=False`` (DecisionTree's
+    single-tree contract) forces unit weights unconditionally — the gate
+    lives HERE so no caller can forget it and silently re-enable
+    Poisson resampling for a deterministic family."""
+    if not bootstrap:
+        return None  # unit weights, deterministic fit
     if not always_poisson and rate >= 1.0:
         return None  # unit weights
     return np.random.default_rng(
@@ -327,7 +332,8 @@ def partition_forest_histograms(
 
     streams = [
         _tree_weight_stream(rate, seed, int(t["tree"]), pid,
-                            always_poisson=True)
+                            always_poisson=True,
+                            bootstrap=bool(spec.get("bootstrap", True)))
         for t in trees
     ]
     hists = [
@@ -388,7 +394,8 @@ def partition_forest_leaf_stats(
 
     streams = [
         _tree_weight_stream(rate, seed, int(t["tree"]), pid,
-                            always_poisson=True)
+                            always_poisson=True,
+                            bootstrap=bool(spec.get("bootstrap", True)))
         for t in trees
     ]
     stats = [np.zeros((n_ch, n_leaves)) for _ in trees]

@@ -441,6 +441,48 @@ def test_prefix_span_front(spark):
     assert got[(("a",), ("b",))] == 2
 
 
+def test_decision_tree_plane_never_collects(spark, rng, monkeypatch):
+    """Round-5: the DecisionTree ESTIMATORS left the driver-collect
+    adapter for the forest statistics plane (Spark's own single-tree =
+    RandomForest.run(numTrees=1) factoring) — the collect path must
+    never fire, and the fit is deterministic (no bootstrap)."""
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+
+    def boom(self, dataset):
+        raise AssertionError("driver-collect fired on a plane family")
+
+    monkeypatch.setattr(
+        adapter_mod._AdapterEstimator, "_collect_frame", boom
+    )
+    x = rng.normal(size=(240, 5))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
+    df = _vector_df(spark, x, extra_cols=[("label", y.tolist())])
+    m1 = S.DecisionTreeClassifier(maxDepth=4, seed=1).fit(df)
+    pred = np.asarray(
+        [r["prediction"] for r in m1.transform(df).collect()]
+    )
+    assert (pred == y).mean() > 0.9
+    # no bootstrap => two plane fits produce the identical tree
+    m2 = S.DecisionTreeClassifier(maxDepth=4, seed=1).fit(df)
+    np.testing.assert_array_equal(
+        np.asarray(m1._local.ensemble_.feature),
+        np.asarray(m2._local.ensemble_.feature))
+    np.testing.assert_array_equal(
+        np.asarray(m1._local.ensemble_.leaf_value),
+        np.asarray(m2._local.ensemble_.leaf_value))
+    # the single-tree surface survives the plane fit
+    assert m1._local.depth_ == 4
+    assert m1._local.to_debug_string().startswith("If (feature")
+
+    yr = x @ [1.0, -0.5, 0.0, 0.2, 0.0]
+    dfr = _vector_df(spark, x, extra_cols=[("label", yr.tolist())])
+    mr = S.DecisionTreeRegressor(maxDepth=4, seed=1).fit(dfr)
+    pr = np.asarray(
+        [r["prediction"] for r in mr.transform(dfr).collect()]
+    )
+    assert np.corrcoef(pr, yr)[0, 1] > 0.9
+
+
 # --------------------------------------------------------------------------
 # tuning + pipeline
 # --------------------------------------------------------------------------
