@@ -63,6 +63,7 @@ _FOREST_PLANE_CLASSES = (
 # scalers share one executor moments pass; TruncatedSVD reduces the
 # uncentered Gram partial the PCA plane uses
 _MOMENTS_PLANE_CLASSES = (
+    "BisectingKMeans",
     "StandardScaler",
     "MinMaxScaler",
     "MaxAbsScaler",
@@ -130,7 +131,8 @@ _ADAPTER2_CLASSES = (
 _ADAPTER3_CLASSES = (
     "AFTSurvivalRegression",
     "AFTSurvivalRegressionModel",
-    "BisectingKMeans",
+    # NOTE: the BisectingKMeans ESTIMATOR routes to the statistics
+    # plane (moments_estimator.py); only the Model class lives here
     "BisectingKMeansModel",
     "DBSCAN",
     "DBSCANModel",

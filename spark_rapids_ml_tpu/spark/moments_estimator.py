@@ -24,6 +24,7 @@ from __future__ import annotations
 import numpy as np
 
 from spark_rapids_ml_tpu.spark import adapter as _adapter
+from spark_rapids_ml_tpu.spark import adapter3 as _adapter3
 from spark_rapids_ml_tpu.spark.aggregate import (
     combine_moment_stats,
     combine_stats,
@@ -554,6 +555,203 @@ def _collect_feature_sample(dataset, fcol, seed=0):
     if not xs:
         raise ValueError("no sampled rows (all sampling partitions empty)")
     return np.concatenate(xs), sum(int(r["n"]) for r in rows)
+
+
+def _fit_bisecting_plane(local_est, dataset):
+    """BisectingKMeans as executor statistics jobs: membership is a pure
+    function of the broadcast split hierarchy
+    (``aggregate.route_rows_bisecting``), so each bisection runs as a
+    bounded seeding-sample job + maxIter Lloyd partial jobs + one
+    moments job over the grown tree — rows never reach the driver.
+    Split selection (highest-SSE divisible leaf), the no-spread guard,
+    and minDivisibleClusterSize mirror ``models/bisecting_kmeans.py``;
+    the one documented deviation is sample-based k-means++ seeding per
+    split (the KMeans plane's ``df.limit`` posture) instead of the
+    local fit's full-data seeding."""
+    from spark_rapids_ml_tpu.models.bisecting_kmeans import (
+        BisectingKMeansModel,
+    )
+    from spark_rapids_ml_tpu.models.kmeans import _host_kmeans_pp
+    from spark_rapids_ml_tpu.spark.aggregate import (
+        bisecting_sample_spark_ddl,
+        bisecting_stats_spark_ddl,
+        combine_bisecting_stats,
+        partition_bisecting_lloyd_arrow,
+        partition_bisecting_moments_arrow,
+        partition_bisecting_sample_arrow,
+    )
+
+    timer = PhaseTimer()
+    fcol = local_est.getInputCol()
+    wcol = local_est.get_or_default("weightCol") or None
+    k = int(local_est.getK())
+    max_iter = int(local_est.getMaxIter())
+    seed = int(local_est.getSeed())
+    min_div = float(local_est.get_or_default("minDivisibleClusterSize"))
+    cols = [fcol] + ([wcol] if wcol else [])
+    df = dataset.select(*cols).persist()
+
+    nodes = []          # internal routing nodes
+    # leaves: leaf_id -> dict(center, sse, raw, divisible)
+    try:
+        def moments(n_leaves):
+            def job(batches, _nodes=list(nodes), _L=n_leaves):
+                yield from partition_bisecting_moments_arrow(
+                    batches, fcol, _nodes, _L, weight_col=wcol)
+
+            rows = df.mapInArrow(job, bisecting_stats_spark_ddl())\
+                .collect()
+            if not rows:
+                raise ValueError("empty dataset")
+            first = rows[0]
+            get = (first.get if isinstance(first, dict)
+                   else first.__getitem__)
+            d_local = len(get("sums")) // n_leaves
+            sums, counts, extra, _cost, _seen = combine_bisecting_stats(
+                rows, n_leaves, d_local, extra_per_group=3)
+            raws = extra[:n_leaves]
+            sqs = extra[n_leaves:2 * n_leaves]
+            mins = extra[2 * n_leaves:2 * n_leaves + n_leaves * d_local]\
+                .reshape(n_leaves, d_local)
+            maxs = extra[2 * n_leaves + n_leaves * d_local:]\
+                .reshape(n_leaves, d_local)
+            out = {}
+            for lf in range(n_leaves):
+                if counts[lf] <= 0:
+                    continue
+                center = sums[lf] / counts[lf]
+                # weighted SSE about the mean via the moments identity
+                sse = float(max(
+                    sqs[lf] - (sums[lf] @ sums[lf]) / counts[lf], 0.0))
+                spread = bool((maxs[lf] - mins[lf] > 0).any())
+                out[lf] = {"center": center, "sse": sse,
+                           "raw": float(raws[lf]), "spread": spread,
+                           "divisible": True}
+            return out, d_local
+
+        with timer.phase("init"):
+            leaves, d = moments(1)
+            n_total = sum(v["raw"] for v in leaves.values())
+            min_size = max(
+                min_div if min_div >= 1.0 else min_div * n_total, 2.0)
+
+        n_splits = 0
+        with timer.phase("fit_kernel"):
+            while len(leaves) < k:
+                order = sorted(leaves, key=lambda lf: leaves[lf]["sse"],
+                               reverse=True)
+                target = next(
+                    (lf for lf in order
+                     if leaves[lf]["divisible"]
+                     and leaves[lf]["raw"] >= min_size
+                     and leaves[lf]["spread"]),
+                    None)
+                if target is None:
+                    break
+                # bounded seeding sample of the target leaf
+                def sample_job(batches, _nodes=list(nodes), _t=target):
+                    yield from partition_bisecting_sample_arrow(
+                        batches, fcol, _nodes, _t, 4096)
+
+                srows = df.mapInArrow(
+                    sample_job, bisecting_sample_spark_ddl()).collect()
+                pieces = []
+                for row in srows:
+                    get = (row.get if isinstance(row, dict)
+                           else row.__getitem__)
+                    pieces.append(np.asarray(
+                        get("rows"), dtype=np.float64).reshape(-1, d))
+                sample = (np.concatenate(pieces) if pieces
+                          else np.zeros((0, d)))
+                if sample.shape[0] < 2:
+                    leaves[target]["divisible"] = False
+                    continue
+                rng = np.random.default_rng(seed + n_splits)
+                c2 = _host_kmeans_pp(sample, 2, rng)
+
+                def lloyd_stats(centers):
+                    def lloyd_job(batches, _nodes=list(nodes),
+                                  _t=target, _c=np.array(centers)):
+                        yield from partition_bisecting_lloyd_arrow(
+                            batches, fcol, _nodes, _t, _c,
+                            weight_col=wcol)
+
+                    return combine_bisecting_stats(
+                        df.mapInArrow(
+                            lloyd_job,
+                            bisecting_stats_spark_ddl()).collect(),
+                        2, d, extra_per_group=1)
+
+                for _ in range(max_iter):
+                    sums, counts, _extra, _cost, _n = lloyd_stats(c2)
+                    new_c = np.where(counts[:, None] > 0,
+                                     sums / np.maximum(
+                                         counts[:, None], 1e-300),
+                                     c2)
+                    shift = float(((new_c - c2) ** 2).sum())
+                    c2 = new_c
+                    if shift == 0.0:
+                        break
+                # the degenerate-split guard must see the assignment
+                # under the COMMITTED (final) centers — the loop's last
+                # stats describe the pre-update ones, and a final center
+                # move can empty a side (classic k-means emptying); this
+                # job also covers maxIter=0 (seeded centers commit
+                # directly)
+                _sums, _counts, extra, _cost, _n = lloyd_stats(c2)
+                raw_sides = extra[:2]
+                if (raw_sides <= 0).any():
+                    # a degenerate split (all rows one side): keep the
+                    # leaf, mark non-divisible so selection moves on
+                    leaves[target]["divisible"] = False
+                    continue
+                # grow the tree: target leaf becomes an internal node
+                # routing to two fresh leaves
+                left_id = target          # reuse the slot
+                right_id = max(leaves) + 1
+                nodes.append({"cl": c2[0], "cr": c2[1],
+                              "l": -(left_id) - 1,
+                              "r": -(right_id) - 1})
+                # re-point whichever parent routed to `target` (the
+                # slice excludes the node just appended, whose own left
+                # child legitimately reuses the target leaf id)
+                for node in nodes[:-1]:
+                    if node["l"] == -(target) - 1:
+                        node["l"] = len(nodes) - 1
+                    if node["r"] == -(target) - 1:
+                        node["r"] = len(nodes) - 1
+                n_splits += 1
+                # refresh every leaf's stats under the grown tree (one
+                # moments job; also validates the split's membership)
+                leaves_new, _d2 = moments(max(leaves) + 2)
+                for lf, rec in leaves_new.items():
+                    rec["divisible"] = leaves.get(
+                        lf, {"divisible": True})["divisible"] \
+                        if lf != left_id and lf != right_id else True
+                leaves = leaves_new
+    finally:
+        df.unpersist()
+
+    centers = np.stack([leaves[lf]["center"] for lf in sorted(leaves)])
+    model = BisectingKMeansModel(cluster_centers=centers)
+    model.uid = local_est.uid
+    model.copy_values_from(local_est)
+    model.training_cost_ = float(
+        sum(v["sse"] for v in leaves.values()))
+    model.fit_timings_ = timer.as_dict()
+    return model
+
+
+class BisectingKMeans(_adapter3.BisectingKMeans):
+    """DataFrame BisectingKMeans on the executor statistics plane:
+    membership re-derives from the broadcast split hierarchy on
+    executors, each bisection = seeding-sample job + Lloyd partial jobs
+    + one moments refresh — rows never reach the driver (the
+    driver-collect adapter fit this replaces held the whole dataset)."""
+
+    def _fit(self, dataset):
+        return self._model_cls(_fit_bisecting_plane(self._local,
+                                                    dataset))
 
 
 class RobustScaler(_adapter.RobustScaler):

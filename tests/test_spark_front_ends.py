@@ -441,6 +441,47 @@ def test_prefix_span_front(spark):
     assert got[(("a",), ("b",))] == 2
 
 
+def test_bisecting_kmeans_plane_never_collects(spark, rng, monkeypatch):
+    """Round-5: the BisectingKMeans ESTIMATOR left the driver-collect
+    adapter for the statistics plane — membership re-derives from the
+    broadcast split hierarchy on executors; only bounded seeding
+    samples and tiny additive partials reach the driver."""
+    import spark_rapids_ml_tpu.spark.adapter as adapter_mod
+
+    def boom(self, dataset):
+        raise AssertionError("driver-collect fired on a plane family")
+
+    monkeypatch.setattr(
+        adapter_mod._AdapterEstimator, "_collect_frame", boom
+    )
+    centers = np.asarray([[0.0, 0.0], [8.0, 8.0],
+                          [-8.0, 8.0], [0.0, -9.0]])
+    x = np.concatenate([c + rng.normal(scale=0.4, size=(40, 2))
+                        for c in centers])
+    df = _vector_df(spark, x)
+    m = S.BisectingKMeans(k=4, featuresCol="features",
+                          predictionCol="pred", seed=3).fit(df)
+    preds = np.asarray([r["pred"] for r in m.transform(df).collect()])
+    assert len(set(preds)) == 4
+    for g in range(4):
+        assert len(set(preds[g * 40:(g + 1) * 40])) == 1
+    assert m._local.training_cost_ > 0
+
+    # minDivisibleClusterSize stops the hierarchy exactly like the
+    # local fit: 160 -> 80/80 -> 40x4, then nothing is >= 50
+    m2 = S.BisectingKMeans(k=8, featuresCol="features",
+                           minDivisibleClusterSize=50.0, seed=3).fit(df)
+    assert len(m2._local.cluster_centers) == 4
+
+    # weighted fit runs the plane too
+    w = np.ones(len(x))
+    w[:40] = 3.0
+    dfw = _vector_df(spark, x, extra_cols=[("wt", w.tolist())])
+    mw = S.BisectingKMeans(k=4, featuresCol="features", weightCol="wt",
+                           seed=3).fit(dfw)
+    assert np.asarray(mw._local.cluster_centers).shape == (4, 2)
+
+
 def test_decision_tree_plane_never_collects(spark, rng, monkeypatch):
     """Round-5: the DecisionTree ESTIMATORS left the driver-collect
     adapter for the forest statistics plane (Spark's own single-tree =
