@@ -212,6 +212,23 @@ _EVALUATOR_CLASSES = (
     "RegressionEvaluator",
 )
 
+# pyspark's canonical model-class names (classification models are
+# *ClassificationModel in pyspark.ml) aliased onto the factory-made
+# front-ends, so a drop-in import of either spelling resolves
+_CANONICAL_ALIASES = {
+    "DecisionTreeClassificationModel": "DecisionTreeClassifierModel",
+    "DecisionTreeRegressionModel": "DecisionTreeRegressorModel",
+    "RandomForestClassificationModel": "RandomForestClassifierModel",
+    "RandomForestRegressionModel": "RandomForestRegressorModel",
+    "GBTClassificationModel": "GBTClassifierModel",
+    "GBTRegressionModel": "GBTRegressorModel",
+    "MultilayerPerceptronClassificationModel":
+        "MultilayerPerceptronClassifierModel",
+    "MultilayerPerceptronModel": "MultilayerPerceptronClassifierModel",
+    "FMClassifierModel": "FMClassificationModel",
+    "FMRegressorModel": "FMRegressionModel",
+}
+
 __all__ = [
     *_PYSPARK_CLASSES,
     *_ADAPTER2_CLASSES,
@@ -222,6 +239,7 @@ __all__ = [
     *_TRANSFORMER_CLASSES,
     *_TUNING_CLASSES,
     *_EVALUATOR_CLASSES,
+    *_CANONICAL_ALIASES,
     "combine_stats",
     "finalize_pca_from_stats",
     "partition_gram_stats",
@@ -232,6 +250,7 @@ __all__ = [
 def __getattr__(name):
     # binds to real pyspark when importable, else to the in-repo local
     # engine (spark/_compat.py) — same front-end code either way
+    name = _CANONICAL_ALIASES.get(name, name)
     if name in _PYSPARK_CLASSES:
         from spark_rapids_ml_tpu.spark import estimator
 
