@@ -30,7 +30,10 @@ for i in $(seq 1 24); do
   python scripts/bench_r05_wave5.py >> "$OUT/loop.log" 2>&1
   rc=$?
   echo "wave5 attempt $i rc=$rc: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
-  [ -f "$OUT5/wave5_done" ] && exit 0
+  if [ -f "$OUT5/wave5_done" ]; then
+    python scripts/compose_r05_measured.py >> "$OUT/loop.log" 2>&1
+    exit 0
+  fi
   sleep 300
 done
 echo "wave5 gave up: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
