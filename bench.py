@@ -87,7 +87,8 @@ def _best_known_chip_record():
                 return {
                     "stale": True,
                     "source": os.path.basename(path),
-                    "measured_utc": head.get("measured_utc"),
+                    "measured_utc": head.get("measured_utc")
+                    or head.get("recorded_utc"),
                     "metric": head.get("metric"),
                     "value": head.get("value"),
                     "unit": head.get("unit", "rows/sec"),

@@ -50,9 +50,11 @@ def main() -> None:
     if headline is None:
         # carry the committed round-4 headline forward
         prior = os.path.join(REPO, "BENCH_MEASURED_r04.json")
-        if os.path.exists(prior):
+        try:
             with open(prior) as f:
                 headline = json.load(f).get("headline")
+        except Exception:  # noqa: BLE001 - carry nothing, keep sections
+            headline = None
 
     sections = {}
     for rel, key in (
@@ -74,10 +76,13 @@ def main() -> None:
         print("compose_r05: nothing landed yet; not writing")
         return
 
-    commit = subprocess.run(
-        ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
-        text=True, cwd=REPO,
-    ).stdout.strip()
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=REPO,
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001 - metadata only
+        commit = "unknown"
     out = {
         "composed_utc": datetime.datetime.now(
             datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
@@ -86,8 +91,12 @@ def main() -> None:
         **sections,
     }
     path = os.path.join(REPO, "BENCH_MEASURED_r05.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+    try:
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    except Exception as exc:  # noqa: BLE001 - report, never crash a wave
+        print(f"compose_r05: write failed: {exc}")
+        return
     print(f"compose_r05: wrote {path} "
           f"(headline={'fresh' if headline and headline.get('source_file') else 'carried'}, "
           f"sections={sorted(sections)})")

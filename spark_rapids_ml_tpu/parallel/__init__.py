@@ -21,6 +21,10 @@ from spark_rapids_ml_tpu.parallel.distributed_forest import (
 from spark_rapids_ml_tpu.parallel.distributed_gbt import (
     distributed_gbt_fit,
 )
+from spark_rapids_ml_tpu.parallel.distributed_bisecting import (
+    BisectingKMeansResult,
+    distributed_bisecting_kmeans_fit,
+)
 from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     distributed_kmeans_fit,
     distributed_kmeans_fit_kernel,
@@ -56,6 +60,7 @@ __all__ = [
     "distributed_pca_fit_kernel",
     "distributed_kneighbors",
     "distributed_ivf_search",
+    "distributed_bisecting_kmeans_fit",
     "distributed_dbscan_labels",
     "distributed_umap_optimize",
     "distributed_forest_fit",

@@ -1,0 +1,183 @@
+"""Distributed BisectingKMeans over the mesh.
+
+The divisive hierarchy at mesh scale: rows stay sharded over ``data``
+for the whole fit, the per-row leaf assignment lives as a sharded
+int32 array updated ON DEVICE by each committed split, and every
+bisection is ONE compiled program — global Gumbel-max k-means++(2)
+seeding over the target leaf's rows, the psum'd Lloyd loop of
+``distributed_kmeans.py``, a final assignment, and the child moments
+(count, Σx, Σ‖x‖²) reduced with the same psum — so the host driver
+only sees O(d) statistics per split, never rows (the Spark-plane
+version of this algorithm is ``spark/moments_estimator.py``; this is
+its ICI-collective sibling).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops.kmeans_kernel import lloyd_iterations
+from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
+    _global_kmeans_pp,
+)
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    pad_rows_to_multiple,
+    row_sharding,
+)
+
+
+class BisectingKMeansResult(NamedTuple):
+    centers: jnp.ndarray        # (n_leaves, d) leaf centers
+    cost: float                 # Σ per-leaf SSE about its mean
+    labels: np.ndarray          # (n_rows,) compact center index per row
+
+
+@partial(jax.jit, static_argnames=("mesh", "max_iter"))
+def _bisect_split_kernel(
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    leaf: jnp.ndarray,
+    key: jax.Array,
+    target: jnp.ndarray,
+    new_id: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    max_iter: int = 20,
+    tol: float = 1e-4,
+):
+    """One bisection of leaf ``target`` as a single sharded program.
+
+    Returns (2-means centers, proposed leaf array with the target's
+    side-1 rows re-labelled ``new_id``, per-side (count, Σx, Σ‖x‖²)).
+    ``target``/``new_id`` are DYNAMIC replicated scalars, so every
+    split of a fit reuses one compiled executable.
+    """
+
+    def shard_fn(xs, ms, ls, key_repl, tgt, nid):
+        m2 = ms * (ls == tgt).astype(xs.dtype)
+        init = _global_kmeans_pp(xs, m2, key_repl, 2)
+        centers, _cost, _n_iter, _conv = lloyd_iterations(
+            xs, init, m2, max_iter, tol,
+            reduce_fn=lambda t: lax.psum(t, DATA_AXIS),
+        )
+        d = (
+            (xs * xs).sum(axis=1)[:, None]
+            + (centers * centers).sum(axis=1)[None, :]
+            - 2.0 * (xs @ centers.T)
+        )
+        side = jnp.argmin(d, axis=1)
+        new_ls = jnp.where(
+            m2 > 0, jnp.where(side == 0, tgt, nid), ls
+        ).astype(ls.dtype)
+        w = jnp.stack([m2 * (side == 0), m2 * (side == 1)])  # (2, m)
+        cnt = lax.psum(w.sum(axis=1), DATA_AXIS)             # (2,)
+        sums = lax.psum(w @ xs, DATA_AXIS)                   # (2, d)
+        sqs = lax.psum(w @ (xs * xs).sum(axis=1), DATA_AXIS)  # (2,)
+        return centers, new_ls, cnt, sums, sqs
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(), P(), P()),
+        out_specs=(P(), P(DATA_AXIS), P(), P(), P()),
+    )
+    return fn(x, mask, leaf, key, target, new_id)
+
+
+def distributed_bisecting_kmeans_fit(
+    x_host: np.ndarray,
+    k: int,
+    mesh: Mesh,
+    max_iter: int = 20,
+    seed: int = 0,
+    min_divisible: float = 2.0,
+    dtype=None,
+) -> BisectingKMeansResult:
+    """Host-side driver: pad + shard once, then one compiled bisection
+    program per split; the hierarchy bookkeeping (which leaf splits
+    next, divisibility) runs on O(leaves) statistics only."""
+    x_host = np.asarray(x_host)
+    n_rows = x_host.shape[0]
+    if n_rows == 0:
+        raise ValueError("empty dataset")
+    n_dev = mesh.devices.size
+    x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
+    if dtype is not None:
+        x_padded = x_padded.astype(dtype)
+        mask = mask.astype(dtype)
+    x_dev = jax.device_put(x_padded, row_sharding(mesh))
+    mask_dev = jax.device_put(
+        np.asarray(mask, dtype=x_padded.dtype),
+        NamedSharding(mesh, P(DATA_AXIS)),
+    )
+    leaf = jax.device_put(
+        np.zeros(x_padded.shape[0], dtype=np.int32),
+        NamedSharding(mesh, P(DATA_AXIS)),
+    )
+
+    # root stats on host (the driver already holds x_host — the same
+    # posture as distributed_kmeans_fit's input contract)
+    center0 = x_host.mean(axis=0)
+    sse0 = float(((x_host - center0[None, :]) ** 2).sum())
+    leaves = {0: {"center": center0, "sse": sse0,
+                  "count": float(n_rows), "divisible": True}}
+
+    n_splits = 0
+    while len(leaves) < k:
+        order = sorted(leaves, key=lambda lf: leaves[lf]["sse"],
+                       reverse=True)
+        target = next(
+            (lf for lf in order
+             if leaves[lf]["divisible"]
+             and leaves[lf]["count"] >= min_divisible),
+            None,
+        )
+        if target is None:
+            break
+        new_id = max(leaves) + 1
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), n_splits)
+        centers2, new_leaf, cnt, sums, sqs = jax.block_until_ready(
+            _bisect_split_kernel(
+                x_dev, mask_dev, leaf,
+                key,
+                jnp.asarray(target, dtype=jnp.int32),
+                jnp.asarray(new_id, dtype=jnp.int32),
+                mesh=mesh, max_iter=max_iter,
+            )
+        )
+        cnt = np.asarray(cnt, dtype=np.float64)
+        n_splits += 1
+        if (cnt <= 0).any():
+            # degenerate split (identical points / emptied side): keep
+            # the leaf, stop re-trying it
+            leaves[target]["divisible"] = False
+            continue
+        leaf = new_leaf  # commit the on-device assignment
+        sums = np.asarray(sums, dtype=np.float64)
+        sqs = np.asarray(sqs, dtype=np.float64)
+        for side, lf in ((0, target), (1, new_id)):
+            mean = sums[side] / cnt[side]
+            sse = float(max(
+                sqs[side] - (sums[side] @ sums[side]) / cnt[side], 0.0))
+            leaves[lf] = {"center": mean, "sse": sse,
+                          "count": float(cnt[side]), "divisible": True}
+
+    order = sorted(leaves)
+    centers = np.stack([leaves[lf]["center"] for lf in order])
+    lut = np.full(max(leaves) + 1, -1, dtype=np.int64)
+    lut[order] = np.arange(len(order))
+    labels = lut[np.asarray(leaf)[:n_rows]]
+    return BisectingKMeansResult(
+        centers=jnp.asarray(centers),
+        cost=float(sum(v["sse"] for v in leaves.values())),
+        labels=labels,
+    )
