@@ -80,7 +80,7 @@ def main() -> None:
         commit = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
             capture_output=True, text=True, cwd=REPO,
-        ).stdout.strip()
+        ).stdout.strip() or "unknown"
     except Exception:  # noqa: BLE001 - metadata only
         commit = "unknown"
     out = {

@@ -25,6 +25,10 @@ from spark_rapids_ml_tpu.parallel.distributed_bisecting import (
     BisectingKMeansResult,
     distributed_bisecting_kmeans_fit,
 )
+from spark_rapids_ml_tpu.parallel.distributed_gmm import (
+    distributed_gmm_fit,
+    distributed_gmm_stats_kernel,
+)
 from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     distributed_kmeans_fit,
     distributed_kmeans_fit_kernel,
@@ -62,6 +66,9 @@ __all__ = [
     "distributed_ivf_search",
     "distributed_bisecting_kmeans_fit",
     "distributed_dbscan_labels",
+    "distributed_gmm_fit",
+    "distributed_gmm_stats_kernel",
+    "BisectingKMeansResult",
     "distributed_umap_optimize",
     "distributed_forest_fit",
     "distributed_gbt_fit",

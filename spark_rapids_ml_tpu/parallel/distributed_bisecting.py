@@ -98,6 +98,7 @@ def distributed_bisecting_kmeans_fit(
     k: int,
     mesh: Mesh,
     max_iter: int = 20,
+    tol: float = 1e-4,
     seed: int = 0,
     min_divisible: float = 2.0,
     dtype=None,
@@ -151,7 +152,7 @@ def distributed_bisecting_kmeans_fit(
                 key,
                 jnp.asarray(target, dtype=jnp.int32),
                 jnp.asarray(new_id, dtype=jnp.int32),
-                mesh=mesh, max_iter=max_iter,
+                mesh=mesh, max_iter=max_iter, tol=tol,
             )
         )
         cnt = np.asarray(cnt, dtype=np.float64)

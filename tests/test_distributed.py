@@ -132,3 +132,39 @@ def test_distributed_bisecting_kmeans_degenerate(rng):
     res2 = distributed_bisecting_kmeans_fit(x, 3, mesh, seed=1)
     assert res2.labels.shape == (67,)
     assert np.isfinite(np.asarray(res2.centers)).all()
+
+
+def test_distributed_gmm_recovers_components(rng):
+    from spark_rapids_ml_tpu.models.gaussian_mixture import (
+        GaussianMixture,
+    )
+    from spark_rapids_ml_tpu.parallel import distributed_gmm_fit
+
+    means_true = np.asarray([[0.0, 0.0], [6.0, 6.0], [-6.0, 6.0]])
+    x = np.concatenate([m + rng.normal(scale=0.5, size=(60, 2))
+                        for m in means_true])
+    mesh = data_mesh(8)
+    model = distributed_gmm_fit(x, 3, mesh, seed=2)
+    got = np.asarray(model.means)
+    for m in means_true:
+        assert np.abs(got - m[None, :]).sum(axis=1).min() < 0.3
+    # same driver loop as the local fit: component means agree
+    local = GaussianMixture().setK(3).setSeed(2).fit(x)
+    lg = np.asarray(local.means)
+    for m in got:
+        assert np.abs(lg - m[None, :]).sum(axis=1).min() < 0.2
+    # model surface intact (same class every path produces)
+    assert abs(float(np.asarray(model.weights).sum()) - 1.0) < 1e-9
+    assert model.num_iterations_ >= 1
+
+
+def test_distributed_gmm_weighted_uneven(rng):
+    from spark_rapids_ml_tpu.parallel import distributed_gmm_fit
+
+    mesh = data_mesh(8)
+    x = np.concatenate([rng.normal(0, 0.5, size=(50, 3)),
+                        rng.normal(5, 0.5, size=(51, 3))])
+    w = np.linspace(0.5, 2.0, 101)
+    model = distributed_gmm_fit(x, 2, mesh, seed=1, weights=w)
+    assert np.asarray(model.means).shape == (2, 3)
+    assert np.isfinite(np.asarray(model.covs)).all()
