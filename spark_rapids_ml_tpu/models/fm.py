@@ -55,19 +55,31 @@ def _l2(params, lam):
     return 0.5 * lam * penalty
 
 
-def fm_squared_loss(params, x, y, w, lam):
+def fm_squared_rowloss(params, x, y):
+    """Per-row squared error — the ONE objective kernel the local and
+    mesh-distributed fits share (the reduction differs: plain weighted
+    mean here, psum'd global mean in parallel/distributed_optim.py)."""
     raw = fm_raw(params, x)
-    return (w * (y - raw) ** 2).sum() / w.sum() + _l2(params, lam)
+    return (y - raw) ** 2
 
 
-def fm_logistic_loss(params, x, y, w, lam):
+def fm_logistic_rowloss(params, x, y):
+    """Per-row stable log(1 + exp(-margin)) with y in {0, 1}."""
     import jax.numpy as jnp
 
     raw = fm_raw(params, x)
-    # stable log(1 + exp(-margin)) with y in {0, 1}
     margin = jnp.where(y > 0.5, raw, -raw)
-    loss = jnp.logaddexp(0.0, -margin)
-    return (w * loss).sum() / w.sum() + _l2(params, lam)
+    return jnp.logaddexp(0.0, -margin)
+
+
+def fm_squared_loss(params, x, y, w, lam):
+    rl = fm_squared_rowloss(params, x, y)
+    return (w * rl).sum() / w.sum() + _l2(params, lam)
+
+
+def fm_logistic_loss(params, x, y, w, lam):
+    rl = fm_logistic_rowloss(params, x, y)
+    return (w * rl).sum() / w.sum() + _l2(params, lam)
 
 
 class _FMParams(HasInputCol, HasDeviceId, HasWeightCol):

@@ -40,18 +40,24 @@ from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 # AFT survival regression
 # --------------------------------------------------------------------------
 
-def aft_neg_loglik(params, x, log_t, censor, w):
-    """-(1/n) Weibull AFT log-likelihood (constants in log t dropped).
+def aft_rowwise_loglik(params, x, log_t, censor):
+    """Per-row Weibull AFT log-likelihood (constants in log t dropped) —
+    the ONE objective kernel the local and mesh-distributed fits share.
 
     epsilon_i = (log t_i - x_i.beta - b) / sigma;
     loglik_i = delta_i * (epsilon_i - log sigma) - exp(epsilon_i).
-    Module-level so ``minimize_kernel`` caches one compilation.
     """
     import jax.numpy as jnp
 
     eps = (log_t - x @ params["beta"] - params.get("intercept", 0.0)) \
         / jnp.exp(params["log_sigma"])
-    ll = censor * (eps - params["log_sigma"]) - jnp.exp(eps)
+    return censor * (eps - params["log_sigma"]) - jnp.exp(eps)
+
+
+def aft_neg_loglik(params, x, log_t, censor, w):
+    """Weighted-mean negative log-likelihood. Module-level so
+    ``minimize_kernel`` caches one compilation."""
+    ll = aft_rowwise_loglik(params, x, log_t, censor)
     return -(w * ll).sum() / w.sum()
 
 

@@ -29,6 +29,11 @@ from spark_rapids_ml_tpu.parallel.distributed_gmm import (
     distributed_gmm_fit,
     distributed_gmm_stats_kernel,
 )
+from spark_rapids_ml_tpu.parallel.distributed_optim import (
+    distributed_aft_fit,
+    distributed_fm_fit,
+    distributed_minimize_kernel,
+)
 from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     distributed_kmeans_fit,
     distributed_kmeans_fit_kernel,
@@ -66,9 +71,12 @@ __all__ = [
     "distributed_ivf_search",
     "distributed_bisecting_kmeans_fit",
     "distributed_dbscan_labels",
+    "distributed_aft_fit",
+    "distributed_fm_fit",
     "distributed_gmm_fit",
     "distributed_gmm_stats_kernel",
     "BisectingKMeansResult",
+    "distributed_minimize_kernel",
     "distributed_umap_optimize",
     "distributed_forest_fit",
     "distributed_gbt_fit",

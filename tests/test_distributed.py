@@ -168,3 +168,58 @@ def test_distributed_gmm_weighted_uneven(rng):
     model = distributed_gmm_fit(x, 2, mesh, seed=1, weights=w)
     assert np.asarray(model.means).shape == (2, 3)
     assert np.isfinite(np.asarray(model.covs)).all()
+
+
+def test_distributed_fm_fit(rng):
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.models.fm import fm_raw
+    from spark_rapids_ml_tpu.parallel import distributed_fm_fit
+
+    mesh = data_mesh(8)
+    x = rng.normal(size=(400, 6))
+    y = x @ [1.5, -1.0, 0.2, 0.0, 0.0, 0.5]
+    params, n_iter, loss = distributed_fm_fit(
+        x, y, mesh, factor_size=2, max_iter=200, step_size=0.05, seed=0)
+    pred = np.asarray(fm_raw(
+        {k: jnp.asarray(v, dtype=jnp.float32)
+         for k, v in params.items()},
+        jnp.asarray(x, dtype=jnp.float32)))
+    assert np.corrcoef(pred, y)[0, 1] > 0.99
+    assert n_iter >= 1 and np.isfinite(loss)
+
+    yb = (y > 0).astype(float)
+    pc, _it, _l = distributed_fm_fit(
+        x, yb, mesh, classification=True, factor_size=2, max_iter=200,
+        step_size=0.05, seed=0)
+    pred2 = np.asarray(fm_raw(
+        {k: jnp.asarray(v, dtype=jnp.float32) for k, v in pc.items()},
+        jnp.asarray(x, dtype=jnp.float32)))
+    assert ((pred2 > 0) == yb).mean() > 0.95
+
+
+def test_distributed_aft_matches_local(rng):
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+    from spark_rapids_ml_tpu.models.survival_regression import (
+        AFTSurvivalRegression,
+    )
+    from spark_rapids_ml_tpu.parallel import distributed_aft_fit
+
+    mesh = data_mesh(8)
+    x = rng.normal(size=(300, 4))
+    t = np.exp(x @ [0.5, -0.3, 0.1, 0.0] + 1.0)
+    cens = (rng.random(300) > 0.2).astype(float)
+    params, n_iter, _loss = distributed_aft_fit(
+        x, t, cens, mesh, max_iter=100)
+    local = AFTSurvivalRegression().fit(VectorFrame({
+        "features": x, "label": t.tolist(), "censor": cens.tolist()}))
+    # the mesh objective is EXACTLY the local objective (global
+    # weighted mean via psum), so coefficients agree to f32 tolerance
+    np.testing.assert_allclose(
+        params["beta"], np.asarray(local.coefficients), atol=5e-2)
+    assert abs(float(params["intercept"])
+               - float(local.intercept)) < 5e-2
+    # uneven rows exercise the zero-weight padding
+    p2, _i, _l = distributed_aft_fit(x[:173], t[:173], cens[:173],
+                                     mesh, max_iter=20)
+    assert np.isfinite(p2["beta"]).all()
