@@ -69,3 +69,15 @@ def test_initialize_rejects_coordinator_mismatch(monkeypatch):
     assert mh.initialize_multihost(
         coordinator_address="hostA:1234"
     ) in (True, False)
+
+
+def test_two_process_multihost_job():
+    """The REAL multi-host path: a coordinator + worker pair of fresh
+    processes join one jax.distributed job, build the global mesh, load
+    host_local_shard slices, assemble with make_global_array, and run
+    the sharded PCA fit with an oracle check on rank 0 — the same
+    program the driver's dryrun executes (__graft_entry__). Guards the
+    init/global-mesh path against regressions between dryruns."""
+    import __graft_entry__ as g
+
+    g._dryrun_multihost(n_local=1, timeout=420.0)
