@@ -93,6 +93,50 @@ def _class_stats(x, y_oh, use_xla, device, dtype, need_sq):
     return counts, sums, sq
 
 
+def _prepare_nb_inputs(x, y, weights, model_type):
+    """Validated (classes, weighted one-hot) — the ONE statistics-input
+    prep the local fit and ``parallel.distributed_nb_fit`` share (the
+    closed forms already live once in
+    ``aggregate.finalize_nb_from_stats``; this keeps the input side
+    from drifting too). ``weights=None`` means unweighted."""
+    x = np.asarray(x)
+    y = np.asarray(y, dtype=np.float64).reshape(-1)
+    if model_type not in ("multinomial", "complement", "bernoulli",
+                          "gaussian"):
+        raise ValueError(
+            f"modelType {model_type!r}: expected multinomial | "
+            "complement | bernoulli | gaussian")
+    if y.shape[0] != x.shape[0]:
+        raise ValueError(
+            f"labels length {y.shape[0]} != rows {x.shape[0]}"
+        )
+    if model_type in ("multinomial", "complement") and (x < 0).any():
+        raise ValueError(
+            f"{model_type} NaiveBayes requires non-negative features"
+        )
+    if model_type == "bernoulli" and not np.isin(x, (0.0, 1.0)).all():
+        raise ValueError(
+            "bernoulli NaiveBayes requires {0,1} features (Spark raises "
+            "on anything else)"
+        )
+    classes = np.unique(y)
+    y_oh = np.eye(classes.size)[np.searchsorted(classes, y)]
+    if weights is not None:
+        w = np.asarray(weights, dtype=np.float64).reshape(-1)
+        if w.shape[0] != y.shape[0]:
+            raise ValueError(
+                f"weight column length {w.shape[0]} != rows {y.shape[0]}"
+            )
+        if not np.isfinite(w).all() or (w < 0).any():
+            raise ValueError(
+                "weights must be finite and non-negative"
+            )
+        # Spark weightCol: every per-class statistic becomes a WEIGHTED
+        # sum — one multiply into the one-hot before the matmuls
+        y_oh = y_oh * w[:, None]
+    return classes, y_oh
+
+
 class NaiveBayes(NaiveBayesParams):
     """``NaiveBayes().setModelType('gaussian').fit(df)``."""
 
@@ -118,28 +162,9 @@ class NaiveBayes(NaiveBayesParams):
                 y = np.asarray(
                     frame.column(self.getLabelCol()), dtype=np.float64
                 )
-        if y.shape[0] != x.shape[0]:
-            raise ValueError(
-                f"labels length {y.shape[0]} != rows {x.shape[0]}"
-            )
         kind = self.getModelType()
-        if kind in ("multinomial", "complement") and (x < 0).any():
-            raise ValueError(
-                f"{kind} NaiveBayes requires non-negative features"
-            )
-        if kind == "bernoulli" and not np.isin(x, (0.0, 1.0)).all():
-            raise ValueError(
-                "bernoulli NaiveBayes requires {0,1} features (Spark raises "
-                "on anything else)"
-            )
-        classes = np.unique(y)
-        y_idx = np.searchsorted(classes, y)
-        y_oh = np.eye(classes.size)[y_idx]
-        # Spark weightCol: every per-class statistic becomes a WEIGHTED
-        # sum — one multiply into the one-hot before the matmuls
         user_w = self._extract_weights(frame, x.shape[0])
-        if user_w is not None:
-            y_oh = y_oh * user_w[:, None]
+        classes, y_oh = _prepare_nb_inputs(x, y, user_w, kind)
         lam = float(self.getSmoothing())
 
         device = (

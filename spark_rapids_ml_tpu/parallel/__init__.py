@@ -29,6 +29,9 @@ from spark_rapids_ml_tpu.parallel.distributed_gmm import (
     distributed_gmm_fit,
     distributed_gmm_stats_kernel,
 )
+from spark_rapids_ml_tpu.parallel.distributed_nb import (
+    distributed_nb_fit,
+)
 from spark_rapids_ml_tpu.parallel.distributed_optim import (
     distributed_aft_fit,
     distributed_fm_fit,
@@ -74,6 +77,7 @@ __all__ = [
     "distributed_aft_fit",
     "distributed_fm_fit",
     "distributed_gmm_fit",
+    "distributed_nb_fit",
     "distributed_gmm_stats_kernel",
     "BisectingKMeansResult",
     "distributed_minimize_kernel",

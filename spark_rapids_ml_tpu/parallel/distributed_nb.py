@@ -1,0 +1,110 @@
+"""Distributed NaiveBayes over the mesh.
+
+The purest fit in the family for data parallelism: per-class
+sufficient statistics (Σ one-hot, one-hotᵀ·X, one-hotᵀ·X²) are three
+MXU contractions per shard plus ONE fused ``psum`` — then the
+per-family closed forms reuse ``aggregate.finalize_nb_from_stats``
+(the single copy the local fit and the Spark statistics plane already
+share, so all three paths cannot drift).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    pad_rows_to_multiple,
+    row_sharding,
+)
+
+
+@partial(jax.jit, static_argnames=("mesh", "need_sq"))
+def distributed_nb_stats_kernel(
+    x: jnp.ndarray,
+    y_oh: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    need_sq: bool,
+):
+    """Global (counts, Σx per class, Σx² per class): one program.
+    Padding rows carry an all-zero one-hot row and contribute nothing."""
+
+    def shard_fn(xs, oh):
+        def dot_t(a, b):
+            return lax.dot_general(
+                a, b, (((0,), (0,)), ((), ())),
+                precision=lax.Precision.HIGHEST,
+            )
+
+        counts = lax.psum(oh.sum(axis=0), DATA_AXIS)
+        sums = lax.psum(dot_t(oh, xs), DATA_AXIS)
+        sq = (lax.psum(dot_t(oh, xs * xs), DATA_AXIS)
+              if need_sq else jnp.zeros_like(sums))
+        return counts, sums, sq
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=(P(), P(), P()),
+    )
+    return fn(x, y_oh)
+
+
+def distributed_nb_fit(
+    x_host: np.ndarray,
+    y_host: np.ndarray,
+    mesh: Mesh,
+    model_type: str = "multinomial",
+    smoothing: float = 1.0,
+    weights: np.ndarray = None,
+    dtype=jnp.float32,
+):
+    """Host-side driver. Returns the standard ``NaiveBayesModel`` (same
+    class the local fit and the Spark plane produce)."""
+    from spark_rapids_ml_tpu.models.naive_bayes import (
+        NaiveBayesModel,
+        _prepare_nb_inputs,
+    )
+    from spark_rapids_ml_tpu.spark.aggregate import (
+        finalize_nb_from_stats,
+    )
+
+    x_host = np.asarray(x_host)
+    classes, y_oh = _prepare_nb_inputs(x_host, y_host, weights,
+                                       model_type)
+
+    n_dev = mesh.devices.size
+    x_padded, _mask = pad_rows_to_multiple(x_host, n_dev)
+    oh_padded = np.zeros((x_padded.shape[0], classes.size))
+    oh_padded[: y_oh.shape[0]] = y_oh
+    x_dev = jax.device_put(
+        np.asarray(x_padded, dtype=np.dtype(dtype)), row_sharding(mesh))
+    oh_dev = jax.device_put(
+        np.asarray(oh_padded, dtype=np.dtype(dtype)),
+        NamedSharding(mesh, P(DATA_AXIS, None)),
+    )
+    counts, sums, sq = jax.block_until_ready(
+        distributed_nb_stats_kernel(
+            x_dev, oh_dev, mesh=mesh,
+            need_sq=(model_type == "gaussian"))
+    )
+    pi, theta, sigma = finalize_nb_from_stats(
+        classes,
+        np.asarray(counts, dtype=np.float64),
+        np.asarray(sums, dtype=np.float64),
+        np.asarray(sq, dtype=np.float64),
+        model_type, float(smoothing),
+    )
+    model = NaiveBayesModel(pi=pi, theta=theta, sigma=sigma,
+                            classes=classes)
+    model.set("modelType", model_type)
+    model.set("smoothing", float(smoothing))
+    return model

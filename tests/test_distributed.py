@@ -223,3 +223,34 @@ def test_distributed_aft_matches_local(rng):
     p2, _i, _l = distributed_aft_fit(x[:173], t[:173], cens[:173],
                                      mesh, max_iter=20)
     assert np.isfinite(p2["beta"]).all()
+
+
+def test_distributed_naive_bayes_matches_local(rng):
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+    from spark_rapids_ml_tpu.models.naive_bayes import NaiveBayes
+    from spark_rapids_ml_tpu.parallel import distributed_nb_fit
+
+    mesh = data_mesh(8)
+    y = rng.integers(0, 3, size=301).astype(float)  # uneven rows
+    for kind in ("multinomial", "gaussian", "bernoulli", "complement"):
+        if kind == "bernoulli":
+            x = (rng.random(size=(301, 10)) > 0.6).astype(float)
+        elif kind == "gaussian":
+            x = rng.normal(size=(301, 10))
+        else:
+            x = rng.poisson(2.0, size=(301, 10)).astype(float)
+        dm = distributed_nb_fit(x, y, mesh, model_type=kind)
+        local = NaiveBayes().setModelType(kind).fit(x, labels=y)
+        np.testing.assert_allclose(dm.pi, local.pi, atol=1e-5)
+        np.testing.assert_allclose(dm.theta, local.theta, atol=1e-4)
+        if kind == "gaussian":
+            np.testing.assert_allclose(dm.sigma, local.sigma, atol=1e-4)
+
+    # weightCol semantics match the local weighted fit
+    w = rng.uniform(0.5, 2.0, size=301)
+    x = rng.poisson(2.0, size=(301, 10)).astype(float)
+    dm = distributed_nb_fit(x, y, mesh, weights=w)
+    frame = VectorFrame({"features": x, "label": y.tolist(),
+                         "wt": w.tolist()})
+    local = NaiveBayes().setWeightCol("wt").fit(frame)
+    np.testing.assert_allclose(dm.theta, local.theta, atol=1e-4)
