@@ -100,6 +100,39 @@ def main() -> None:
     )
     print("UMAP embedding:", emb.shape)
 
+    # round-5 additions: hierarchical clustering, mixtures, smooth-
+    # objective training, and NaiveBayes — all as sharded programs
+    from spark_rapids_ml_tpu.parallel import (
+        distributed_aft_fit,
+        distributed_bisecting_kmeans_fit,
+        distributed_fm_fit,
+        distributed_gmm_fit,
+        distributed_nb_fit,
+    )
+
+    bk = distributed_bisecting_kmeans_fit(blobs, 2, mesh, seed=1)
+    print("BisectingKMeans leaves:", np.asarray(bk.centers).shape[0],
+          "cost:", round(bk.cost, 2))
+
+    gm = distributed_gmm_fit(blobs, 2, mesh, seed=1)
+    print("GMM means:", np.round(np.asarray(gm.means), 1).tolist())
+
+    y_fm = (blobs[:, 0] > 4).astype(float)
+    fm_params, fm_iters, _ = distributed_fm_fit(
+        blobs, y_fm, mesh, classification=True, factor_size=2,
+        max_iter=100, step_size=0.05)
+    print("FM trained:", fm_iters, "iters, factors",
+          fm_params["factors"].shape)
+
+    t = np.exp(0.2 * blobs[:, 0] + 1.0)
+    aft_params, _i, _l = distributed_aft_fit(
+        blobs, t, np.ones_like(t), mesh)
+    print("AFT beta:", np.round(aft_params["beta"], 3).tolist())
+
+    nb = distributed_nb_fit(np.abs(blobs), y_fm, mesh,
+                            model_type="multinomial")
+    print("NaiveBayes theta:", np.asarray(nb.theta).shape)
+
 
 if __name__ == "__main__":
     main()
