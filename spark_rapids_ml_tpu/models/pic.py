@@ -45,6 +45,53 @@ def _power_iterate(w, v0, max_iter: int):
     return run(w, v0, steps=max_iter)
 
 
+def build_affinity(src, dst, wts, max_nodes, np_dtype, pad_rows=0):
+    """Validated edges → (ids, row-stochastic dense affinity, degrees) —
+    the ONE affinity builder the local and mesh-distributed PIC share.
+
+    ``pad_rows`` appends that many all-zero rows/columns by allocating
+    the final (n+pad)² buffer UP FRONT and scattering into the top-left
+    block — a post-hoc ``np.pad`` would transiently double the peak
+    host memory of the one allocation this builder exists to bound.
+    """
+    src = np.asarray(src, dtype=np.float64)
+    dst = np.asarray(dst, dtype=np.float64)
+    wts = np.asarray(wts, dtype=np.float64)
+    if (wts < 0).any():
+        raise ValueError("edge weights must be nonnegative")
+    if src.shape[0] == 0:
+        raise ValueError("cannot cluster an empty edge frame")
+    for name, col in (("srcCol", src), ("dstCol", dst)):
+        if (col != np.round(col)).any() or (
+                np.abs(col).max(initial=0.0) >= float(2**53)):
+            raise ValueError(
+                f"{name} must hold float64-exact integer ids "
+                "(< 2^53) — larger ids would silently collide")
+    ids = np.unique(np.concatenate([src, dst]))
+    n = len(ids)
+    if n > max_nodes:
+        raise ValueError(
+            f"{n} distinct ids exceed the dense-affinity "
+            f"envelope maxDenseNodes={max_nodes} (n² device bytes); "
+            "shard the graph or raise the cap explicitly")
+    si = np.searchsorted(ids, src)
+    di = np.searchsorted(ids, dst)
+    # build at the compute dtype and normalize in place: at the
+    # n=32768 cap an f64 matrix plus an out-of-place divide
+    # would peak at 16 GB host for a 4 GB device payload
+    a = np.zeros((n + pad_rows, n + pad_rows), dtype=np_dtype)
+    np.add.at(a, (si, di), wts)
+    off_diag = si != di  # a self-loop contributes its weight ONCE
+    np.add.at(a, (di[off_diag], si[off_diag]), wts[off_diag])
+    deg = a[:n].sum(axis=1, dtype=np.float64)
+    if (deg == 0).any():
+        raise ValueError("isolated vertex with zero degree")
+    # D^-1 A, row-stochastic; padding rows stay zero (divide by 1)
+    a /= np.concatenate([deg, np.ones(pad_rows)])[:, None].astype(
+        np_dtype)
+    return ids, a, deg
+
+
 class PowerIterationClustering(HasDeviceId):
     k = Param("k", "number of clusters", 2,
               validator=lambda v: isinstance(v, int) and v >= 2)
@@ -102,40 +149,12 @@ class PowerIterationClustering(HasDeviceId):
             wc = self.get_or_default("weightCol")
             wts = (np.asarray(frame.column(wc), dtype=np.float64)
                    if wc else np.ones(src.shape[0]))
-            if (wts < 0).any():
-                raise ValueError("edge weights must be nonnegative")
-            if src.shape[0] == 0:
-                raise ValueError("cannot cluster an empty edge frame")
-            for name, col in (("srcCol", src), ("dstCol", dst)):
-                if (col != np.round(col)).any() or (
-                        np.abs(col).max(initial=0.0) >= float(2**53)):
-                    raise ValueError(
-                        f"{name} must hold float64-exact integer ids "
-                        "(< 2^53) — larger ids would silently collide")
-            ids = np.unique(np.concatenate([src, dst]))
-            n = len(ids)
-            cap = int(self.get_or_default("maxDenseNodes"))
-            if n > cap:
-                raise ValueError(
-                    f"{n} distinct ids exceed the dense-affinity "
-                    f"envelope maxDenseNodes={cap} (n² device bytes); "
-                    "shard the graph or raise the cap explicitly")
-            si = np.searchsorted(ids, src)
-            di = np.searchsorted(ids, dst)
-            # build at the compute dtype and normalize in place: at the
-            # n=32768 cap an f64 matrix plus an out-of-place divide
-            # would peak at 16 GB host for a 4 GB device payload
             np_dtype = np.float32 if str(
                 self.get_or_default("dtype")) != "float64" else np.float64
-            a = np.zeros((n, n), dtype=np_dtype)
-            np.add.at(a, (si, di), wts)
-            off_diag = si != di  # a self-loop contributes its weight ONCE
-            np.add.at(a, (di[off_diag], si[off_diag]), wts[off_diag])
-            deg = a.sum(axis=1, dtype=np.float64)
-            if (deg == 0).any():
-                raise ValueError("isolated vertex with zero degree")
-            a /= deg[:, None].astype(np_dtype)  # D^-1 A, row-stochastic
-            w = a
+            ids, w, deg = build_affinity(
+                src, dst, wts,
+                int(self.get_or_default("maxDenseNodes")), np_dtype)
+            n = len(ids)
 
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.get_or_default("dtype"))

@@ -32,6 +32,9 @@ from spark_rapids_ml_tpu.parallel.distributed_gmm import (
 from spark_rapids_ml_tpu.parallel.distributed_nb import (
     distributed_nb_fit,
 )
+from spark_rapids_ml_tpu.parallel.distributed_pic import (
+    distributed_pic_assign,
+)
 from spark_rapids_ml_tpu.parallel.distributed_optim import (
     distributed_aft_fit,
     distributed_fm_fit,
@@ -78,6 +81,7 @@ __all__ = [
     "distributed_fm_fit",
     "distributed_gmm_fit",
     "distributed_nb_fit",
+    "distributed_pic_assign",
     "distributed_gmm_stats_kernel",
     "BisectingKMeansResult",
     "distributed_minimize_kernel",
