@@ -115,24 +115,14 @@ class MultilayerPerceptronClassifier(MultilayerPerceptronParams):
             else:
                 y = np.asarray(frame.column(self.getLabelCol()),
                                dtype=np.float64)
-        if y.shape[0] != x.shape[0]:
-            raise ValueError(
-                f"labels length {y.shape[0]} != rows {x.shape[0]}")
-        if x.shape[1] != layers[0]:
-            raise ValueError(
-                f"layers[0]={layers[0]} != feature width {x.shape[1]}")
-        n_classes = layers[-1]
-        y_idx = y.astype(np.int64)
-        if not np.array_equal(y_idx, y) or y_idx.min() < 0 \
-                or y_idx.max() >= n_classes:
-            raise ValueError(
-                f"labels must be class indices 0..{n_classes - 1} "
-                "(Spark MLP convention)")
+        from spark_rapids_ml_tpu.ops.mlp_kernel import (
+            validate_and_onehot,
+        )
+
+        y_onehot = validate_and_onehot(x, y, layers)
         w = self._extract_weights(frame, x.shape[0])
         if w is None:
             w = np.ones(x.shape[0])
-        y_onehot = np.zeros((x.shape[0], n_classes))
-        y_onehot[np.arange(x.shape[0]), y_idx] = 1.0
 
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.getDtype())

@@ -49,10 +49,46 @@ def forward_logits(params, x):
     return h @ last["w"] + last["b"]
 
 
-def mean_cross_entropy(params, x, y_onehot, w):
+def validate_and_onehot(x, y, layers):
+    """Spark MLP label conventions in ONE place (shared by the local
+    fit and ``parallel.distributed_mlp_fit``): layers[0] must match the
+    feature width, labels must be class indices 0..layers[-1]-1;
+    returns the (n, n_classes) one-hot matrix."""
+    import numpy as np
+
+    x = np.asarray(x)
+    y = np.asarray(y, dtype=np.float64).reshape(-1)
+    if y.shape[0] != x.shape[0]:
+        raise ValueError(
+            f"labels length {y.shape[0]} != rows {x.shape[0]}")
+    if x.shape[1] != layers[0]:
+        raise ValueError(
+            f"layers[0]={layers[0]} != feature width {x.shape[1]}")
+    n_classes = int(layers[-1])
+    y_idx = y.astype(np.int64)
+    if not np.array_equal(y_idx, y) or y_idx.min() < 0 \
+            or y_idx.max() >= n_classes:
+        raise ValueError(
+            f"labels must be class indices 0..{n_classes - 1} "
+            "(Spark MLP convention)")
+    y_onehot = np.zeros((y.shape[0], n_classes))
+    y_onehot[np.arange(y.shape[0]), y_idx] = 1.0
+    return y_onehot
+
+
+def rowwise_cross_entropy(params, x, y_onehot):
+    """Per-row softmax cross-entropy — the ONE objective kernel the
+    local and mesh-distributed MLP fits share (the reduction differs:
+    plain weighted mean here, psum'd global mean in
+    parallel/distributed_optim.py)."""
     logits = forward_logits(params, x)
     logp = jax.nn.log_softmax(logits, axis=1)
-    return -(w[:, None] * y_onehot * logp).sum() / w.sum()
+    return -(y_onehot * logp).sum(axis=1)
+
+
+def mean_cross_entropy(params, x, y_onehot, w):
+    return (w * rowwise_cross_entropy(params, x, y_onehot)).sum() \
+        / w.sum()
 
 
 def mlp_train_kernel(params, x, y_onehot, w, *, solver: str,

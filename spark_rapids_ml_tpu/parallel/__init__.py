@@ -39,6 +39,7 @@ from spark_rapids_ml_tpu.parallel.distributed_optim import (
     distributed_aft_fit,
     distributed_fm_fit,
     distributed_minimize_kernel,
+    distributed_mlp_fit,
 )
 from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     distributed_kmeans_fit,
@@ -80,6 +81,7 @@ __all__ = [
     "distributed_aft_fit",
     "distributed_fm_fit",
     "distributed_gmm_fit",
+    "distributed_mlp_fit",
     "distributed_nb_fit",
     "distributed_pic_assign",
     "distributed_gmm_stats_kernel",

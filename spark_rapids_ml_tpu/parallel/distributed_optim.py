@@ -64,6 +64,13 @@ def aft_neg_loglik_dp(params, x, log_t, censor, w):
     return -_global_mean((w * ll).sum(), w.sum())
 
 
+def mlp_cross_entropy_dp(params, x, y_onehot, w):
+    from spark_rapids_ml_tpu.ops.mlp_kernel import rowwise_cross_entropy
+
+    rl = rowwise_cross_entropy(params, x, y_onehot)
+    return _global_mean((w * rl).sum(), w.sum())
+
+
 @partial(jax.jit, static_argnames=("loss_fn", "solver", "max_iter",
                                    "mesh", "row_args"))
 def distributed_minimize_kernel(
@@ -105,14 +112,23 @@ def _pad_rows(mesh, x, *row_vectors, dtype=jnp.float32):
     vec_sharding = NamedSharding(mesh, P(DATA_AXIS))
     n_rows = np.asarray(x).shape[0]
     for v in row_vectors:
-        v = np.asarray(v, dtype=np.float64).reshape(-1)
+        v = np.asarray(v, dtype=np.float64)
+        if v.ndim != 2:
+            # scalars become (1,) so the length check below diagnoses
+            # them; (n,1) columns flatten — only a genuinely 2-D row
+            # matrix (the one-hot case) keeps its second axis
+            v = v.reshape(-1)
+        elif v.shape[1] == 1:
+            v = v.reshape(-1)
         if v.shape[0] != n_rows:
             raise ValueError(
                 f"per-row vector length {v.shape[0]} != rows {n_rows}")
-        v_padded = np.zeros(x_padded.shape[0])
+        v_padded = np.zeros((x_padded.shape[0],) + v.shape[1:])
         v_padded[: v.shape[0]] = v
+        sharding = (vec_sharding if v.ndim == 1
+                    else NamedSharding(mesh, P(DATA_AXIS, None)))
         out.append(jax.device_put(
-            np.asarray(v_padded, dtype=np.dtype(dtype)), vec_sharding))
+            np.asarray(v_padded, dtype=np.dtype(dtype)), sharding))
     return out
 
 
@@ -199,4 +215,48 @@ def distributed_aft_fit(
     )
     host = {k: np.asarray(v, dtype=np.float64)
             for k, v in params.items()}
+    return host, int(n_iter), float(loss)
+
+
+def distributed_mlp_fit(
+    x_host: np.ndarray,
+    y_host: np.ndarray,
+    layers,
+    mesh: Mesh,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    step_size: float = 0.03,
+    solver: str = "l-bfgs",
+    seed: int = 0,
+    weights: np.ndarray = None,
+    dtype=jnp.float32,
+):
+    """MultilayerPerceptron classifier trained over the mesh in one
+    compiled program (Spark MLP conventions: ``layers`` = [in, hidden...,
+    n_classes], labels are class indices). Returns (params pytree on
+    host, n_iter, final loss)."""
+    from spark_rapids_ml_tpu.ops.mlp_kernel import init_weights
+
+    from spark_rapids_ml_tpu.ops.mlp_kernel import validate_and_onehot
+
+    x_host = np.asarray(x_host)
+    layers = [int(v) for v in layers]
+    y_onehot = validate_and_onehot(x_host, y_host, layers)
+    w = np.ones(x_host.shape[0]) if weights is None else weights
+
+    params0 = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, dtype=dtype),
+        init_weights(layers, seed))
+    x_dev, oh_dev, w_dev = _pad_rows(mesh, x_host, y_onehot, w,
+                                     dtype=dtype)
+    params, n_iter, loss = jax.block_until_ready(
+        distributed_minimize_kernel(
+            params0, (x_dev, oh_dev, w_dev),
+            loss_fn=mlp_cross_entropy_dp, solver=solver,
+            max_iter=max_iter, tol=tol, step_size=step_size,
+            mesh=mesh, row_args=3,
+        )
+    )
+    host = jax.tree_util.tree_map(
+        lambda a: np.asarray(a, dtype=np.float64), params)
     return host, int(n_iter), float(loss)

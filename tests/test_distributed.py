@@ -297,3 +297,27 @@ def test_distributed_pic_matches_local(rng):
     agree = sum((l2[i] == l2[j]) == (ll[i] == ll[j])
                 for i, j in pairs)
     assert agree / len(pairs) >= 0.95
+
+
+def test_distributed_mlp_fit(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.mlp_kernel import forward_logits
+    from spark_rapids_ml_tpu.parallel import distributed_mlp_fit
+
+    mesh = data_mesh(8)
+    centers = np.asarray([[0, 0, 0, 0], [4, 4, 0, 0], [0, 4, 4, 0]],
+                         dtype=np.float64)
+    y = rng.integers(0, 3, size=301).astype(float)  # uneven rows
+    x = rng.normal(size=(301, 4)) + centers[y.astype(int)]
+    params, n_iter, loss = distributed_mlp_fit(
+        x, y, [4, 8, 3], mesh, max_iter=200, seed=1)
+    logits = np.asarray(forward_logits(
+        jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, jnp.float32), params),
+        jnp.asarray(x, jnp.float32)))
+    assert (logits.argmax(axis=1) == y).mean() > 0.9
+    assert n_iter >= 1 and np.isfinite(loss)
+    with pytest.raises(ValueError, match="class indices"):
+        distributed_mlp_fit(x, y + 0.5, [4, 8, 3], mesh)
