@@ -35,6 +35,9 @@ from spark_rapids_ml_tpu.parallel.distributed_nb import (
 from spark_rapids_ml_tpu.parallel.distributed_pic import (
     distributed_pic_assign,
 )
+from spark_rapids_ml_tpu.parallel.distributed_glm import (
+    distributed_glm_fit,
+)
 from spark_rapids_ml_tpu.parallel.distributed_optim import (
     distributed_aft_fit,
     distributed_fm_fit,
@@ -80,6 +83,7 @@ __all__ = [
     "distributed_dbscan_labels",
     "distributed_aft_fit",
     "distributed_fm_fit",
+    "distributed_glm_fit",
     "distributed_gmm_fit",
     "distributed_mlp_fit",
     "distributed_nb_fit",

@@ -1,0 +1,174 @@
+"""Distributed GeneralizedLinearRegression over the mesh.
+
+IRLS with the data plane sharded: every iteration's weighted working
+statistics (XᵀWX, XᵀWz, sums, deviance — ``GlmStepOut``) come from ONE
+sharded program (``irls_step_math`` per shard + a fused ``psum`` of the
+tuple), and the tiny host solve + convergence rule reuse the ONE IRLS
+driver loop every other GLM path shares
+(``models/glm.py::GeneralizedLinearRegression._irls``) — so the mesh,
+local, out-of-core, and Spark-plane fits walk identical driver code
+over different statistics planes, for every (family, link) pair.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops.glm_kernel import (
+    GlmStepOut,
+    irls_step_math,
+    validate_label_range,
+)
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    pad_rows_to_multiple,
+    row_sharding,
+)
+
+
+@partial(jax.jit, static_argnames=("mesh", "family", "link", "var_power",
+                                   "link_power", "use_init_mu"))
+def distributed_glm_step_kernel(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    w: jnp.ndarray,
+    offset: jnp.ndarray,
+    coef: jnp.ndarray,
+    intercept: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    family: str,
+    link: str,
+    var_power: float,
+    link_power: float,
+    use_init_mu: bool,
+) -> GlmStepOut:
+    """One global IRLS pass. Padding rows carry weight 0 (and a benign
+    y=1 dummy, valid for every family's domain), so every statistic
+    they touch is exactly zero."""
+
+    def shard_fn(xs, ys, ws, os_, c, b):
+        out = irls_step_math(
+            jnp, xs, ys, ws, os_, c, b, family=family, link=link,
+            var_power=var_power, link_power=link_power,
+            use_init_mu=use_init_mu)
+        return tuple(lax.psum(t, DATA_AXIS) for t in out)
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(), P()),
+        out_specs=tuple(P() for _ in GlmStepOut._fields),
+    )
+    return GlmStepOut(*fn(x, y, w, offset, coef, intercept))
+
+
+def distributed_glm_fit(
+    x_host: np.ndarray,
+    y_host: np.ndarray,
+    mesh: Mesh,
+    family: str = "gaussian",
+    link: str = None,
+    var_power: float = 0.0,
+    link_power: float = None,
+    max_iter: int = 25,
+    tol: float = 1e-6,
+    reg_param: float = 0.0,
+    weights: np.ndarray = None,
+    offset: np.ndarray = None,
+    dtype=jnp.float32,
+):
+    """Host-side driver. Returns the standard
+    ``GeneralizedLinearRegressionModel`` (same class every other GLM
+    path produces, with its summary surface populated)."""
+    from spark_rapids_ml_tpu.models.glm import (
+        GeneralizedLinearRegression,
+    )
+    from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+
+    x_host = np.asarray(x_host, dtype=np.float64)
+    y = np.asarray(y_host, dtype=np.float64).reshape(-1)
+    if y.shape[0] != x_host.shape[0]:
+        raise ValueError(
+            f"labels length {y.shape[0]} != rows {x_host.shape[0]}")
+    if x_host.shape[0] == 0:
+        raise ValueError("empty dataset")
+
+    est = GeneralizedLinearRegression()
+    est.set("family", family)
+    if link is not None:
+        est.set("link", link)
+    est.set("variancePower", float(var_power))
+    if link_power is not None:
+        est.set("linkPower", float(link_power))
+    est.set("maxIter", int(max_iter))
+    est.set("tol", float(tol))
+    est.set("regParam", float(reg_param))
+    family_r, link_r, var_power_r, link_power_r = \
+        est._resolved_family_link()
+    validate_label_range(y, family=family_r, var_power=var_power_r)
+
+    w = (np.ones(x_host.shape[0]) if weights is None
+         else np.asarray(weights, dtype=np.float64).reshape(-1))
+    o = (np.zeros(x_host.shape[0]) if offset is None
+         else np.asarray(offset, dtype=np.float64).reshape(-1))
+    for name, v in (("weights", w), ("offset", o)):
+        if v.shape[0] != x_host.shape[0]:
+            raise ValueError(
+                f"{name} length {v.shape[0]} != rows {x_host.shape[0]}")
+    if not np.isfinite(w).all() or (w < 0).any():
+        # the same contract every other GLM path enforces via
+        # _extract_weights — a NaN weight would otherwise psum into
+        # silently-NaN coefficients
+        raise ValueError("weights must be finite and non-negative")
+
+    n_dev = mesh.devices.size
+    x_padded, _mask = pad_rows_to_multiple(x_host, n_dev)
+    n_pad = x_padded.shape[0]
+
+    def pad_vec(v, fill=0.0):
+        out = np.full(n_pad, fill)
+        out[: v.shape[0]] = v
+        return out
+
+    nd = np.dtype(dtype)
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+    x_dev = jax.device_put(np.asarray(x_padded, dtype=nd),
+                           row_sharding(mesh))
+    # y=1 on padding rows: inside every family's domain, so unit_dev
+    # stays finite and the zero weight kills the contribution exactly
+    y_dev = jax.device_put(np.asarray(pad_vec(y, 1.0), dtype=nd), shard1)
+    w_dev = jax.device_put(np.asarray(pad_vec(w, 0.0), dtype=nd), shard1)
+    o_dev = jax.device_put(np.asarray(pad_vec(o, 0.0), dtype=nd), shard1)
+
+    def step(coef, intercept, first=False):
+        out = distributed_glm_step_kernel(
+            x_dev, y_dev, w_dev, o_dev,
+            jnp.asarray(coef, dtype=nd),
+            jnp.asarray(intercept, dtype=nd),
+            mesh=mesh, family=family_r, link=link_r,
+            var_power=float(var_power_r),
+            link_power=float(link_power_r),
+            use_init_mu=bool(first))
+        return GlmStepOut(*(np.asarray(v, dtype=np.float64)
+                            for v in out))
+
+    if offset is not None:
+        # the fitted model must refuse offset-less scoring, exactly as
+        # an offsetCol-trained local model does (predictions without
+        # the training exposure would be silently wrong) — name the
+        # column the caller must supply at transform time
+        est.set("offsetCol", "offset")
+
+    timer = PhaseTimer()
+    coef, intercept, n_iter, dev = est._irls(step, x_host.shape[1],
+                                             timer)
+    return est._finish(coef, intercept, n_iter, dev, float(w.sum()),
+                       timer)

@@ -321,3 +321,44 @@ def test_distributed_mlp_fit(rng):
     assert n_iter >= 1 and np.isfinite(loss)
     with pytest.raises(ValueError, match="class indices"):
         distributed_mlp_fit(x, y + 0.5, [4, 8, 3], mesh)
+
+
+def test_distributed_glm_matches_local(rng):
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+    from spark_rapids_ml_tpu.models.glm import (
+        GeneralizedLinearRegression,
+    )
+    from spark_rapids_ml_tpu.parallel import distributed_glm_fit
+
+    mesh = data_mesh(8)
+    x = rng.normal(size=(301, 4))  # uneven rows exercise padding
+
+    lam = np.exp(x @ [0.5, -0.3, 0.2, 0.0] + 1.0)
+    y = rng.poisson(lam).astype(float)
+    m = distributed_glm_fit(x, y, mesh, family="poisson")
+    local = GeneralizedLinearRegression().set("family", "poisson").fit(
+        VectorFrame({"features": x, "label": y.tolist()}))
+    np.testing.assert_allclose(np.asarray(m.coefficients),
+                               np.asarray(local.coefficients),
+                               atol=2e-3)
+    assert abs(float(m.intercept) - float(local.intercept)) < 2e-3
+
+    # binomial with weights + offset: the full statistics surface
+    p_ = 1.0 / (1.0 + np.exp(-(x @ [1.0, -1.0, 0.0, 0.5])))
+    yb = (rng.random(301) < p_).astype(float)
+    w = rng.uniform(0.5, 2.0, size=301)
+    off = rng.normal(scale=0.1, size=301)
+    mb = distributed_glm_fit(x, yb, mesh, family="binomial",
+                             weights=w, offset=off)
+    localb = (GeneralizedLinearRegression().set("family", "binomial")
+              .set("weightCol", "wt").set("offsetCol", "off")
+              .fit(VectorFrame({"features": x, "label": yb.tolist(),
+                                "wt": w.tolist(),
+                                "off": off.tolist()})))
+    np.testing.assert_allclose(np.asarray(mb.coefficients),
+                               np.asarray(localb.coefficients),
+                               atol=5e-3)
+
+    # domain validation still fires at the mesh layer
+    with pytest.raises(ValueError):
+        distributed_glm_fit(x, y - 100.0, mesh, family="poisson")
