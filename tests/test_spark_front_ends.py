@@ -752,6 +752,34 @@ def test_dataframe_surface_covers_local_surface():
     )
 
 
+def test_bisecting_plane_two_worker_processes(rng):
+    """The bisecting statistics plane with REAL spawned executor
+    processes: the routing-hierarchy closures (nodes dicts + numpy
+    centers) must cloudpickle across the process boundary and the
+    per-partition moments/Lloyd/sample partials must combine correctly
+    — the same isolation bar the PCA/forest planes are held to."""
+    spark = LocalSparkSession(
+        n_partitions=2,
+        executors="process",
+        executor_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        },
+    )
+    centers = np.asarray([[0.0, 0.0], [9.0, 9.0]])
+    x = np.concatenate([c + rng.normal(scale=0.3, size=(20, 2))
+                        for c in centers])
+    df = _vector_df(spark, x)
+    model = S.BisectingKMeans(k=2, featuresCol="features",
+                              predictionCol="pred", seed=0).fit(df)
+    got = np.asarray(model._local.cluster_centers)
+    for c in centers:
+        assert np.abs(got - c[None, :]).sum(axis=1).min() < 0.5
+    preds = np.asarray([r["pred"]
+                        for r in model.transform(df).collect()])
+    assert len(set(preds[:20])) == 1 and preds[0] != preds[-1]
+
+
 def test_evaluators_accept_dataframes(spark, rng):
     y = rng.normal(size=30)
     pred = y + 0.1
