@@ -5,7 +5,25 @@ export PYTHONPATH=/root/repo:/root/.axon_site
 OUT=/root/repo/records/r04
 mkdir -p "$OUT"
 
-while [ ! -f "$OUT/wave2_done" ]; do sleep 60; done
+# gate: wave2_done, OR wave-2's claimant processes absent for two
+# consecutive polls after a grace period (a wave 2 that exhausts its
+# retries without a window must not strand the UMAP retry forever)
+sleep 120
+absent=0
+while [ "$absent" -lt 2 ]; do
+  if [ -f "$OUT/wave2_done" ] \
+     && ! pgrep -f "bench_r04_wave2" > /dev/null; then
+    break
+  fi
+  if pgrep -f "bench_r04_wave2" > /dev/null; then
+    absent=0
+  else
+    absent=$((absent + 1))
+  fi
+  sleep 60
+done
+[ -f "$OUT/wave2_done" ] || \
+  echo "wave3: wave2 exited without done marker; proceeding: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
 
 for i in $(seq 1 24); do
   echo "wave3 attempt $i start: $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$OUT/loop.log"
