@@ -77,6 +77,60 @@ def _sentences(col) -> List[List[str]]:
     return out
 
 
+def prepare_corpus(token_sents, max_len, min_count, window, rng):
+    """Corpus → (vocab, counts, (2, n_pairs) center/context ids) — the
+    ONE prep the local and mesh-distributed Word2Vec fits share:
+    sentence chunking, minCount vocabulary (sorted), dynamic-window
+    skip-gram pair building."""
+    sents = [s[i:i + max_len] for s in token_sents
+             for i in range(0, max(len(s), 1), max_len)]
+    freq: Dict[str, int] = {}
+    for s in sents:
+        for t in s:
+            freq[t] = freq.get(t, 0) + 1
+    vocab = sorted(t for t, c in freq.items() if c >= min_count)
+    if not vocab:
+        raise ValueError(f"no token reaches minCount={min_count}")
+    index = {t: i for i, t in enumerate(vocab)}
+    id_sents = [[index[t] for t in s if t in index] for s in sents]
+    id_sents = [s for s in id_sents if len(s) >= 2]
+    if not id_sents:
+        raise ValueError("no sentence has 2+ in-vocabulary tokens")
+    pairs = _build_skipgram_pairs(id_sents, window, rng)
+    counts = np.zeros(len(vocab))
+    for t, c in freq.items():
+        if t in index:
+            counts[index[t]] = c
+    return vocab, counts, pairs
+
+
+def _build_skipgram_pairs(sents: List[List[int]], window: int,
+                          rng) -> np.ndarray:
+    """(center, context) pairs with word2vec's uniform dynamic
+    window (each center draws its radius from 1..window).
+
+    Vectorized per sentence: offsets ±1..±window are generated as a
+    (n, 2·window) grid and masked by the drawn radius + bounds — a
+    token-level Python loop would dominate fit wall-clock on real
+    corpora (~10-100M appends for a 10M-token corpus) before the
+    device ran a single step."""
+    offsets = np.concatenate([np.arange(-window, 0),
+                              np.arange(1, window + 1)])
+    centers, contexts = [], []
+    for sent in sents:
+        arr = np.asarray(sent, dtype=np.int32)
+        n = arr.shape[0]
+        radii = rng.integers(1, window + 1, size=n)
+        pos = np.arange(n)[:, None] + offsets[None, :]   # (n, 2w)
+        keep = ((np.abs(offsets)[None, :] <= radii[:, None])
+                & (pos >= 0) & (pos < n))
+        ctr_idx, off_idx = np.nonzero(keep)
+        centers.append(arr[ctr_idx])
+        contexts.append(arr[pos[ctr_idx, off_idx]])
+    return np.stack([np.concatenate(centers),
+                     np.concatenate(contexts)]).astype(np.int32)
+
+
 class Word2Vec(_Word2VecParams):
     """``Word2Vec(vectorSize=64).fit(frame)`` over a token-list column."""
 
@@ -97,32 +151,6 @@ class Word2Vec(_Word2VecParams):
 
         return load_params(cls, path)
 
-    def _build_pairs(self, sents: List[List[int]], window: int,
-                     rng) -> np.ndarray:
-        """(center, context) pairs with word2vec's uniform dynamic
-        window (each center draws its radius from 1..window).
-
-        Vectorized per sentence: offsets ±1..±window are generated as a
-        (n, 2·window) grid and masked by the drawn radius + bounds — a
-        token-level Python loop would dominate fit wall-clock on real
-        corpora (~10-100M appends for a 10M-token corpus) before the
-        device ran a single step."""
-        offsets = np.concatenate([np.arange(-window, 0),
-                                  np.arange(1, window + 1)])
-        centers, contexts = [], []
-        for sent in sents:
-            arr = np.asarray(sent, dtype=np.int32)
-            n = arr.shape[0]
-            radii = rng.integers(1, window + 1, size=n)
-            pos = np.arange(n)[:, None] + offsets[None, :]   # (n, 2w)
-            keep = ((np.abs(offsets)[None, :] <= radii[:, None])
-                    & (pos >= 0) & (pos < n))
-            ctr_idx, off_idx = np.nonzero(keep)
-            centers.append(arr[ctr_idx])
-            contexts.append(arr[pos[ctr_idx, off_idx]])
-        return np.stack([np.concatenate(centers),
-                         np.concatenate(contexts)]).astype(np.int32)
-
     def fit(self, dataset) -> "Word2VecModel":
         import jax
         import jax.numpy as jnp
@@ -133,31 +161,13 @@ class Word2Vec(_Word2VecParams):
 
         timer = PhaseTimer()
         frame = as_vector_frame(dataset, self.getInputCol())
-        with timer.phase("vocab"):
-            sents = _sentences(frame.column(self.getInputCol()))
-            max_len = int(self.get_or_default("maxSentenceLength"))
-            sents = [s[i:i + max_len] for s in sents
-                     for i in range(0, max(len(s), 1), max_len)]
-            freq: Dict[str, int] = {}
-            for s in sents:
-                for t in s:
-                    freq[t] = freq.get(t, 0) + 1
-            min_count = int(self.getMinCount())
-            vocab = sorted(t for t, c in freq.items() if c >= min_count)
-            if not vocab:
-                raise ValueError(
-                    f"no token reaches minCount={min_count}")
-            index = {t: i for i, t in enumerate(vocab)}
-            id_sents = [[index[t] for t in s if t in index]
-                        for s in sents]
-            id_sents = [s for s in id_sents if len(s) >= 2]
-        if not id_sents:
-            raise ValueError("no sentence has 2+ in-vocabulary tokens")
-
         rng = np.random.default_rng(int(self.getSeed()))
-        with timer.phase("pairs"):
-            pairs = self._build_pairs(
-                id_sents, int(self.getWindowSize()), rng)
+        with timer.phase("vocab"):
+            vocab, counts, pairs = prepare_corpus(
+                _sentences(frame.column(self.getInputCol())),
+                int(self.get_or_default("maxSentenceLength")),
+                int(self.getMinCount()),
+                int(self.getWindowSize()), rng)
         n_pairs = pairs.shape[1]
         dim = int(self.get_or_default("vectorSize"))
         k_neg = int(self.get_or_default("negativeSamples"))
@@ -165,10 +175,6 @@ class Word2Vec(_Word2VecParams):
         device = _resolve_device(self.getDeviceId())
         dtype = _resolve_dtype(self.getDtype())
 
-        counts = np.zeros(len(vocab))
-        for t, c in freq.items():
-            if t in index:
-                counts[index[t]] = c
         noise = counts ** 0.75
         noise_logits = jnp.asarray(np.log(noise / noise.sum()),
                                    dtype=dtype)

@@ -38,6 +38,9 @@ from spark_rapids_ml_tpu.parallel.distributed_pic import (
 from spark_rapids_ml_tpu.parallel.distributed_glm import (
     distributed_glm_fit,
 )
+from spark_rapids_ml_tpu.parallel.distributed_word2vec import (
+    distributed_word2vec_fit,
+)
 from spark_rapids_ml_tpu.parallel.distributed_optim import (
     distributed_aft_fit,
     distributed_fm_fit,
@@ -88,6 +91,7 @@ __all__ = [
     "distributed_mlp_fit",
     "distributed_nb_fit",
     "distributed_pic_assign",
+    "distributed_word2vec_fit",
     "distributed_gmm_stats_kernel",
     "BisectingKMeansResult",
     "distributed_minimize_kernel",

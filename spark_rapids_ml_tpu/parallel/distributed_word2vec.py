@@ -1,0 +1,169 @@
+"""Distributed Word2Vec over the mesh.
+
+Skip-gram negative sampling with the PAIR axis sharded: embedding
+tables stay replicated, each step shards its (center, context) batch
+over ``data``, every shard draws its own negatives (per-shard folded
+key) and accumulates dense gradient + occurrence-count tables, and ONE
+fused ``psum`` merges them before the replicated table update — the
+exact global equivalent of the single-device kernel's
+per-row-count-normalized step (``ops/word2vec_kernel.py``), so the
+distributed update rule is the local one computed over the union of
+shards. Corpus prep (vocabulary, dynamic-window pairs) reuses
+``models.word2vec.prepare_corpus`` — the single shared copy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("mesh", "k_neg"))
+def distributed_sgns_step_kernel(
+    u: jnp.ndarray,
+    v: jnp.ndarray,
+    c_idx: jnp.ndarray,
+    ctx_idx: jnp.ndarray,
+    key: jax.Array,
+    lr: jnp.ndarray,
+    noise_logits: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    k_neg: int,
+):
+    """One SGNS step over a mesh-sharded pair batch. Tables are donated
+    (one replicated (vocab, dim) pair resident per table for the whole
+    run) and updated identically on every shard from the psum'd global
+    gradient/count tables."""
+
+    def shard_fn(u_r, v_r, ci, xi, key_r, lr_r, nl_r):
+        j = lax.axis_index(DATA_AXIS)
+        sub = jax.random.fold_in(key_r, j)
+        negs = jax.random.categorical(
+            sub, nl_r, shape=(ci.shape[0], k_neg))
+        uc = u_r[ci]                                  # (b/P, d)
+        vpos = v_r[xi]
+        vneg = v_r[negs]                              # (b/P, K, d)
+        pos_score = jnp.sum(uc * vpos, axis=-1)
+        neg_score = jnp.einsum("bd,bkd->bk", uc, vneg)
+        gpos = jax.nn.sigmoid(pos_score) - 1.0
+        gneg = jax.nn.sigmoid(neg_score)
+        guc = gpos[:, None] * vpos \
+            + jnp.einsum("bk,bkd->bd", gneg, vneg)
+        loss_local = -(jax.nn.log_sigmoid(pos_score).sum()
+                       + jax.nn.log_sigmoid(-neg_score).sum())
+
+        ones = jnp.ones_like(ci, dtype=u_r.dtype)
+        vocab = u_r.shape[0]
+        gu = jnp.zeros_like(u_r).at[ci].add(guc)
+        cu = jnp.zeros((vocab,), u_r.dtype).at[ci].add(ones)
+        neg_flat = negs.reshape(-1)
+        gv = (jnp.zeros_like(v_r)
+              .at[xi].add(gpos[:, None] * uc)
+              .at[neg_flat].add(
+                  (gneg[..., None] * uc[:, None, :])
+                  .reshape(-1, uc.shape[1])))
+        cv = (jnp.zeros((vocab,), v_r.dtype)
+              .at[xi].add(ones)
+              .at[neg_flat].add(1.0))
+
+        gu = lax.psum(gu, DATA_AXIS)
+        cu = jnp.maximum(lax.psum(cu, DATA_AXIS), 1.0)
+        gv = lax.psum(gv, DATA_AXIS)
+        cv = jnp.maximum(lax.psum(cv, DATA_AXIS), 1.0)
+        loss = lax.psum(loss_local, DATA_AXIS)
+        u_new = u_r - lr_r * gu / cu[:, None]
+        v_new = v_r - lr_r * gv / cv[:, None]
+        return u_new, v_new, loss
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+        out_specs=(P(), P(), P()),
+    )
+    return fn(u, v, c_idx, ctx_idx, key, lr, noise_logits)
+
+
+def distributed_word2vec_fit(
+    token_sentences,
+    mesh: Mesh,
+    vector_size: int = 100,
+    window: int = 5,
+    min_count: int = 5,
+    max_iter: int = 1,
+    step_size: float = 0.025,
+    k_neg: int = 5,
+    batch_size: int = 16_384,
+    max_sentence_length: int = 1000,
+    seed: int = 0,
+    dtype=jnp.float32,
+):
+    """Host-side driver over raw token sentences. Returns the standard
+    ``Word2VecModel`` (same class the local fit produces)."""
+    from spark_rapids_ml_tpu.models.word2vec import (
+        Word2VecModel,
+        prepare_corpus,
+    )
+
+    rng = np.random.default_rng(seed)
+    vocab, counts, pairs = prepare_corpus(
+        [list(s) for s in token_sentences], max_sentence_length,
+        min_count, window, rng)
+    n_pairs = pairs.shape[1]
+    n_dev = mesh.devices.size
+    batch = min(batch_size, n_pairs)
+    batch = max(n_dev, (batch // n_dev) * n_dev)  # shardable batch
+
+    noise = counts ** 0.75
+    noise_logits = jnp.asarray(np.log(noise / noise.sum()), dtype=dtype)
+    repl = NamedSharding(mesh, P())
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+    u = jax.device_put(jnp.asarray(
+        (rng.random((len(vocab), vector_size)) - 0.5) / vector_size,
+        dtype=dtype), repl)
+    v = jax.device_put(
+        jnp.zeros((len(vocab), vector_size), dtype=dtype), repl)
+    key = jax.random.PRNGKey(seed)
+    lr0 = float(step_size)
+    n_batches = max(1, n_pairs // batch)
+    total_steps = max_iter * n_batches
+
+    step = 0
+    last_loss = float("nan")
+    for _ in range(max_iter):
+        perm = rng.permutation(n_pairs)
+        for b in range(n_batches):
+            sel = perm[b * batch:(b + 1) * batch]
+            if sel.size < batch:
+                # keep shapes static even when the whole corpus is
+                # smaller than one shardable batch: cycle the permuted
+                # pairs until the batch is full
+                sel = np.resize(perm, batch)
+            lr = jnp.asarray(
+                max(lr0 * (1 - step / total_steps), lr0 * 1e-4),
+                dtype=dtype)
+            key, sub = jax.random.split(key)
+            u, v, loss = distributed_sgns_step_kernel(
+                u, v,
+                jax.device_put(jnp.asarray(pairs[0, sel]), shard1),
+                jax.device_put(jnp.asarray(pairs[1, sel]), shard1),
+                sub, lr, noise_logits, mesh=mesh, k_neg=k_neg)
+            step += 1
+        last_loss = float(loss)
+    u = jax.block_until_ready(u)
+
+    model = Word2VecModel(
+        vectors=np.asarray(u, dtype=np.float64), vocabulary=vocab)
+    model.set("vectorSize", int(vector_size))
+    model.final_loss_ = last_loss
+    model.num_pairs_ = int(n_pairs)
+    return model

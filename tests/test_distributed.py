@@ -362,3 +362,29 @@ def test_distributed_glm_matches_local(rng):
     # domain validation still fires at the mesh layer
     with pytest.raises(ValueError):
         distributed_glm_fit(x, y - 100.0, mesh, family="poisson")
+
+
+def test_distributed_word2vec_cluster_recovery(rng):
+    """Same oracle as the local Word2Vec tests: two disjoint
+    co-occurrence clusters must land closer (cosine) within than
+    across. The mesh step is the local update rule computed over the
+    union of shards (psum'd gradient/count tables), so the established
+    corpus/hyperparameters transfer directly."""
+    from spark_rapids_ml_tpu.parallel import distributed_word2vec_fit
+
+    a_words = ["apple", "banana", "cherry", "date", "elder"]
+    b_words = ["wrench", "hammer", "pliers", "drill", "saw"]
+    sents = []
+    for i in range(300):
+        words = a_words if i % 2 == 0 else b_words
+        sents.append(list(rng.choice(words, size=8)))
+    mesh = data_mesh(8)
+    model = distributed_word2vec_fit(
+        sents, mesh, vector_size=16, window=3, min_count=1,
+        max_iter=20, batch_size=512, step_size=0.2, seed=7)
+    syn = model.find_synonyms("apple", 4)
+    assert set(syn.column("word")) == set(a_words) - {"apple"}
+    all_syn = model.find_synonyms("apple", 9)
+    assert set(list(all_syn.column("word"))[:4]) \
+        == set(a_words) - {"apple"}
+    assert model.num_pairs_ > 0 and np.isfinite(model.final_loss_)
