@@ -12,10 +12,10 @@ sleep 120
 absent=0
 while [ "$absent" -lt 2 ]; do
   if [ -f "$OUT/wave2_done" ] \
-     && ! pgrep -f "bench_r04_wave2" > /dev/null; then
+     && ! pgrep -f "bench_r04_wave2\." > /dev/null; then
     break
   fi
-  if pgrep -f "bench_r04_wave2" > /dev/null; then
+  if pgrep -f "bench_r04_wave2\." > /dev/null; then
     absent=0
   else
     absent=$((absent + 1))
