@@ -36,6 +36,25 @@ from spark_rapids_ml_tpu.utils.platform import (  # noqa: E402
 )
 
 
+def _emit_record(record: dict) -> None:
+    """Final-line emission through the ONE shared helper (embeds the
+    metrics-registry snapshot); falls back to a bare JSON line if the
+    scripts/ package is unreachable (e.g. bench.py copied elsewhere)."""
+    import sys
+
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"
+    )
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    try:
+        from bench_common import emit_record
+
+        emit_record(record)
+    except Exception:  # noqa: BLE001 - the bench number must still print
+        print(json.dumps(record))
+
+
 def _probe_with_backoff():
     """ONE bounded accelerator probe by default (≤60s), so a wedged tunnel
     costs a minute, not the whole bench budget. Round 3's 3×150s probes plus
@@ -359,7 +378,7 @@ def main() -> None:
         best = _best_known_chip_record()
         if best is not None:
             record["best_known_chip_record"] = best
-    print(json.dumps(record))
+    _emit_record(record)
 
 
 if __name__ == "__main__":

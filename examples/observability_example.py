@@ -1,0 +1,92 @@
+"""Tour of the unified telemetry subsystem (`spark_rapids_ml_tpu.obs`).
+
+Runs a PCA estimator fit and a distributed PCA fit with trace export
+enabled, then shows the three observability surfaces:
+
+1. ``fit_report_`` — the uniform per-fit artifact (phases, mesh,
+   collectives, health);
+2. Chrome-trace JSON files written under ``SPARK_RAPIDS_ML_TPU_TRACE_DIR``
+   (load them in Perfetto / chrome://tracing);
+3. the process metrics registry, as Prometheus text and over HTTP.
+
+CPU-safe: run with ``python examples/observability_example.py``.
+"""
+
+import glob
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+# runnable from anywhere: put the repo root ahead of the script dir
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "")
+     + " --xla_force_host_platform_device_count=8").strip(),
+)
+trace_dir = tempfile.mkdtemp(prefix="sparkml_traces_")
+os.environ["SPARK_RAPIDS_ML_TPU_TRACE_DIR"] = trace_dir
+
+import numpy as np  # noqa: E402
+
+from spark_rapids_ml_tpu import PCA, obs  # noqa: E402
+from spark_rapids_ml_tpu.parallel import (  # noqa: E402
+    data_mesh,
+    distributed_pca_fit,
+)
+
+
+def main() -> None:
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(512, 16))
+
+    # -- 1. per-fit reports ------------------------------------------------
+    model = PCA().setK(4).fit(x)
+    report = model.fit_report_
+    print("== estimator fit_report_")
+    print(f"  algo={report.algo}  rows={report.rows}  "
+          f"platform={report.device_platform}  healthy={report.healthy}")
+    print(f"  phases: { {k: round(v, 4) for k, v in report.phases.items()} }")
+
+    mesh = data_mesh()
+    res = distributed_pca_fit(x, 4, mesh)
+    dreport = res.fit_report_
+    print("== distributed driver fit_report_")
+    print(f"  mesh={dreport.mesh_shape} axes={dreport.mesh_axes}")
+    print(f"  collectives: {dreport.collectives}")
+    print(f"  total collective bytes: {dreport.total_collective_bytes()}")
+    print("  as JSON:", json.dumps(dreport.as_dict(), default=str)[:160],
+          "...")
+
+    # -- 2. exported Chrome traces ----------------------------------------
+    files = sorted(glob.glob(os.path.join(trace_dir, "*.json")))
+    print(f"== {len(files)} Chrome-trace file(s) in {trace_dir}")
+    doc = json.load(open(files[0]))
+    names = [e["name"] for e in doc["traceEvents"]]
+    print(f"  {os.path.basename(files[0])}: spans {names}")
+    print("  open in https://ui.perfetto.dev or chrome://tracing")
+
+    # -- 3. the metrics registry ------------------------------------------
+    registry = obs.get_registry()
+    print("== Prometheus text exposition (excerpt)")
+    for line in registry.prometheus_text().splitlines():
+        if "sparkml_fits_total" in line or "collective_bytes" in line:
+            print(" ", line)
+
+    server = obs.start_prometheus_server(port=0)
+    port = server.server_address[1]
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    print(f"== scraped {len(body)} bytes from http://127.0.0.1:{port}/metrics")
+    server.shutdown()
+    server.server_close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,10 +1,14 @@
-"""Shared helpers for the round-4 chip bench orchestrators.
+"""Shared helpers for the bench family: probe/log/record plumbing AND the
+single JSON-emission path.
 
-One copy of the probe/log/record plumbing that bench_r04_once.py,
-bench_r04_wave2.py, and bench_r04_wave3.py previously each carried:
-keeping the probe contract (exit 2 → wrapper retries) and the
-"capture bench.main() stdout → annotate last JSON line → write record"
-sequence in one place means a fix lands everywhere at once.
+One copy of what bench_r04_once.py, bench_r04_wave2.py, and
+bench_r04_wave3.py previously each carried: the probe contract (exit 2 →
+wrapper retries) and the "capture bench.main() stdout → annotate last JSON
+line → write record" sequence. ``emit_record`` is the ONE way every bench
+(bench.py, bench_scale.py, bench_gram_sweep.py, the wave scripts) emits its
+final JSON line — it stamps the record and embeds a metrics-registry
+snapshot, so per-fit collective/phase accounting rides along with every
+bench number instead of each script hand-rolling ``json.dumps``.
 """
 
 from __future__ import annotations
@@ -32,6 +36,40 @@ def log(msg: str) -> None:
     os.makedirs(OUT, exist_ok=True)
     with open(os.path.join(OUT, "status.log"), "a") as f:
         f.write(f"{msg}: {stamp()}\n")
+
+
+def metrics_snapshot() -> dict:
+    """The process metrics registry as a JSON-safe dict ({} when the
+    package (or its telemetry) is unavailable — emission never fails)."""
+    try:
+        from spark_rapids_ml_tpu.obs import get_registry
+
+        return get_registry().snapshot()
+    except Exception:  # noqa: BLE001 - emission must never fail
+        return {}
+
+
+def emit_record(record: dict, *, stream=None, include_metrics: bool = True,
+                flush: bool = True) -> dict:
+    """Emit one bench record as a single JSON line (the LAST stdout line
+    contract run_bench_to_record parses). Stamps ``emitted_utc`` and embeds
+    the metrics-registry snapshot under ``"metrics"``. Returns the emitted
+    dict. ``stream=None`` prints to stdout; pass an open file to append to
+    a record file instead."""
+    rec = dict(record)
+    rec.setdefault("emitted_utc", stamp())
+    if include_metrics and "metrics" not in rec:
+        snap = metrics_snapshot()
+        if snap:
+            rec["metrics"] = snap
+    line = json.dumps(rec)
+    if stream is None:
+        print(line, flush=flush)
+    else:
+        stream.write(line + "\n")
+        if flush:
+            stream.flush()
+    return rec
 
 
 def probe(tag: str):

@@ -22,6 +22,8 @@ import json
 import os
 import time
 
+from bench_common import emit_record
+
 import numpy as np
 
 
@@ -81,7 +83,7 @@ def main() -> None:
         if extra:
             rec.update(extra)
         results.append(rec)
-        print(json.dumps(rec), flush=True)
+        emit_record(rec, include_metrics=False)
 
     def time_arm(fn):
         acc = jnp.zeros((cols, cols), dtype=jnp.float32)
@@ -134,7 +136,7 @@ def main() -> None:
         if not arms:
             continue
         best = max(arms, key=lambda r: r["value"])
-        print(json.dumps({
+        emit_record({
             "metric": f"gram sweep winner ({prec})",
             "decides": ("production _BLOCK_N/_BLOCK_R"
                         if prec == "bfloat16_3x"
@@ -144,7 +146,7 @@ def main() -> None:
             "unit": "rows/sec",
             "mfu": best["mfu"],
             "rows": rows, "cols": cols, "steps": steps,
-        }), flush=True)
+        })
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ import sys
 import time
 
 from bench_common import (  # noqa: E402 (scripts/ on path via wrapper cwd)
+    emit_record,
     OUT,
     is_unavailable,
     log,
@@ -104,14 +105,14 @@ def main() -> int:
     ok_arms = [r for r in results if "value" in r]
     with open(os.path.join(OUT, "block_ab.json"), "w") as f:
         for r in results:
-            f.write(json.dumps(r) + "\n")
+            emit_record(r, stream=f, include_metrics=False)
         if ok_arms:
             best = max(ok_arms, key=lambda r: r["value"])
-            f.write(json.dumps({
+            emit_record({
                 "metric": "donated-harness block winner",
                 "arm": best["arm"], "value": best["value"],
                 "mfu": best["mfu"], "recorded_utc": stamp(),
-            }) + "\n")
+            }, stream=f)
     log("wave2 block_ab done")
 
     if ok_arms:

@@ -23,6 +23,7 @@ import sys
 import time
 
 from bench_common import (  # noqa: E402
+    emit_record,
     OUT,
     is_unavailable,
     log,
@@ -81,7 +82,7 @@ def main() -> int:
         }
         assert inter > 1.15 * intra
         with open(os.path.join(OUT, "scale_umap.json"), "w") as f:
-            f.write(json.dumps(rec) + "\n")
+            emit_record(rec, stream=f)
         log("wave3 umap ok")
         umap_ok = True
     except Exception as exc:  # noqa: BLE001

@@ -17,6 +17,7 @@ import sys
 import time
 
 from bench_common import (
+    emit_record,
     OUT,
     is_unavailable,
     log,
@@ -164,7 +165,8 @@ def main() -> int:
                     rec["device_kind"] = str(
                         getattr(device, "device_kind", "?"))
                     rec["recorded_utc"] = stamp()
-                    f.write(json.dumps(rec) + "\n")
+                    emit_record(rec, stream=f,
+                                include_metrics=rec is results[-1])
         return 2
     with open(os.path.join(OUT, "bench_families.json"), "w") as f:
         for rec in results:
@@ -172,7 +174,7 @@ def main() -> int:
             rec["device_kind"] = str(
                 getattr(device, "device_kind", "?"))
             rec["recorded_utc"] = stamp()
-            f.write(json.dumps(rec) + "\n")
+            emit_record(rec, stream=f, include_metrics=rec is results[-1])
     with open(os.path.join(OUT, "wave4_done"), "w") as f:
         f.write(stamp() + "\n")
     log("wave4 ALL DONE")

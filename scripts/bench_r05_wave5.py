@@ -24,6 +24,7 @@ import sys
 import time
 
 from bench_common import (
+    emit_record,
     REPO,
     is_unavailable,
     log,
@@ -42,7 +43,7 @@ def _emit(path: str, rows: list, device) -> None:
             rec["platform"] = device.platform
             rec["device_kind"] = str(getattr(device, "device_kind", "?"))
             rec["recorded_utc"] = stamp()
-            f.write(json.dumps(rec) + "\n")
+            emit_record(rec, stream=f, include_metrics=rec is rows[-1])
 
 
 def main() -> int:

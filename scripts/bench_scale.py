@@ -18,9 +18,11 @@ timeout against the chip tunnel.
 
 from __future__ import annotations
 
-import json
+import json  # noqa: F401 - kept for ad-hoc debugging
 import os
 import time
+
+from bench_common import emit_record
 
 import numpy as np
 
@@ -101,7 +103,7 @@ def main() -> None:
     # widely-separated blobs: (nearly) every blob must resolve
     assert n_clusters >= n_blobs // 2, f"degenerate clustering: {n_clusters}"
     records.append(rec)
-    print(json.dumps(rec), flush=True)
+    emit_record(rec)
 
     t0 = time.perf_counter()
     um = (
@@ -149,7 +151,7 @@ def main() -> None:
         f"blob structure lost: inter {inter:.2f} vs intra {intra:.2f}"
     )
     records.append(rec)
-    print(json.dumps(rec), flush=True)
+    emit_record(rec)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Static check: every distributed driver uses the shared instrumentation.
+
+Walks ``spark_rapids_ml_tpu/parallel/distributed_*.py`` and requires that
+every module-level public entry point (a ``distributed_*`` function that is
+not a ``*_kernel``) carries the ``@fit_instrumentation(...)`` decorator from
+``spark_rapids_ml_tpu.obs``. New drivers therefore cannot silently ship
+unobserved: tier-1 runs this via ``tests/test_obs_reports.py``.
+
+Pure ``ast`` — no jax import, no package import, so it runs anywhere in
+milliseconds. Exit 0 = all instrumented; exit 1 = offenders listed on
+stdout, one ``file:line name`` per line.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PARALLEL_GLOB = os.path.join(
+    REPO, "spark_rapids_ml_tpu", "parallel", "distributed_*.py"
+)
+DECORATOR_NAME = "fit_instrumentation"
+
+
+def _decorator_names(fn: ast.FunctionDef):
+    for dec in fn.decorator_list:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(node, ast.Attribute):
+            yield node.attr
+        elif isinstance(node, ast.Name):
+            yield node.id
+
+
+def _is_entry_point(fn: ast.FunctionDef) -> bool:
+    return (
+        fn.name.startswith("distributed_")
+        and not fn.name.endswith("_kernel")
+    )
+
+
+def check_file(path: str):
+    """Yield (lineno, name) for every uninstrumented entry point."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if not _is_entry_point(node):
+            continue
+        if DECORATOR_NAME not in set(_decorator_names(node)):
+            yield node.lineno, node.name
+
+
+def main() -> int:
+    files = sorted(glob.glob(PARALLEL_GLOB))
+    if not files:
+        print("ERROR: no parallel/distributed_*.py files found")
+        return 1
+    offenders = []
+    checked = 0
+    for path in files:
+        rel = os.path.relpath(path, REPO)
+        tree = ast.parse(open(path).read(), filename=path)
+        entry_points = [
+            n for n in tree.body
+            if isinstance(n, ast.FunctionDef) and _is_entry_point(n)
+        ]
+        checked += len(entry_points)
+        for lineno, name in check_file(path):
+            offenders.append(f"{rel}:{lineno} {name}")
+    if offenders:
+        print(
+            f"{len(offenders)} distributed driver(s) missing "
+            f"@{DECORATOR_NAME}:"
+        )
+        for line in offenders:
+            print(f"  {line}")
+        return 1
+    print(
+        f"OK: {checked} distributed entry point(s) across {len(files)} "
+        "driver module(s) all instrumented"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
@@ -81,6 +82,7 @@ class DBSCAN(DBSCANParams):
 
         return load_params(DBSCAN, path)
 
+    @observed_fit("dbscan")
     def fit(self, dataset) -> "DBSCANModel":
         timer = PhaseTimer()
         frame = as_vector_frame(dataset, self.getInputCol())

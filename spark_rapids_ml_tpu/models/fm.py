@@ -25,6 +25,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -136,6 +137,7 @@ class _FMEstimatorBase(_FMParams):
 
         return load_params(cls, path)
 
+    @observed_fit("fm")
     def fit(self, dataset, labels=None):
         import jax
         import jax.numpy as jnp

@@ -22,6 +22,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -92,6 +93,7 @@ class GaussianMixture(GaussianMixtureParams):
 
         return load_params(GaussianMixture, path)
 
+    @observed_fit("gmm")
     def fit(self, dataset) -> "GaussianMixtureModel":
         timer = PhaseTimer()
         k = int(self.getK())

@@ -27,6 +27,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.linear_regression import _centered_moments
 from spark_rapids_ml_tpu.models.params import (
@@ -155,6 +156,7 @@ class GeneralizedLinearRegression(GeneralizedLinearRegressionParams):
 
         return load_params(GeneralizedLinearRegression, path)
 
+    @observed_fit("glm")
     def fit(self, dataset, labels=None) -> "GeneralizedLinearRegressionModel":
         timer = PhaseTimer()
         family, link, var_power, link_power = self._resolved_family_link()

@@ -14,6 +14,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit, observed_transform
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -61,6 +62,7 @@ class KMeans(KMeansParams):
 
         return load_params(KMeans, path)
 
+    @observed_fit("kmeans")
     def fit(self, dataset) -> "KMeansModel":
         """Also accepts an out-of-core source: a zero-arg callable returning
         an iterable of row chunks (re-iterable — Lloyd needs one pass per
@@ -348,6 +350,7 @@ class KMeansModel(KMeansParams):
     def clusterCenters(self):
         return [c for c in self.cluster_centers]
 
+    @observed_transform("kmeans")
     def transform(self, dataset) -> VectorFrame:
         if self.cluster_centers is None:
             raise ValueError("model has no centers; fit first or load")

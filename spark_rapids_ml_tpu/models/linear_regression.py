@@ -15,6 +15,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -67,6 +68,7 @@ class LinearRegression(LinearRegressionParams):
 
         return load_params(LinearRegression, path)
 
+    @observed_fit("linreg")
     def fit(self, dataset, labels=None) -> "LinearRegressionModel":
         """``dataset`` may carry the label column, or pass ``labels``
         explicitly alongside a bare feature matrix. Out-of-core: ``dataset``

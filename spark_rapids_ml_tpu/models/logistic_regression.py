@@ -16,6 +16,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.utils.numeric import sigmoid as _sigmoid
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
@@ -81,6 +82,7 @@ class LogisticRegression(LogisticRegressionParams):
 
         return load_params(LogisticRegression, path)
 
+    @observed_fit("logreg")
     def fit(self, dataset, labels=None) -> "LogisticRegressionModel":
         timer = PhaseTimer()
         from spark_rapids_ml_tpu.models.linear_regression import (

@@ -21,6 +21,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -151,6 +152,7 @@ class NaiveBayes(NaiveBayesParams):
 
         return load_params(NaiveBayes, path)
 
+    @observed_fit("naive_bayes")
     def fit(self, dataset, labels=None) -> "NaiveBayesModel":
         timer = PhaseTimer()
         frame = as_vector_frame(dataset, self.getInputCol())

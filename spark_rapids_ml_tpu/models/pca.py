@@ -29,6 +29,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit, observed_transform
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -220,6 +221,7 @@ class PCA(PCAParams):
         self._svd_solver_used = used
         return jax.block_until_ready((pc, evr))
 
+    @observed_fit("pca")
     def fit(self, dataset) -> "PCAModel":
         timer = PhaseTimer()
         self._svd_solver_used = None  # set by device solves; None = host LAPACK
@@ -605,6 +607,7 @@ class PCAModel(PCAParams):
     def explainedVariance(self):
         return self.explained_variance
 
+    @observed_transform("pca")
     def transform(self, dataset) -> VectorFrame:
         """Batched on-device projection — one MXU matmul over the whole
         batch (the path the reference disabled, ``RapidsPCA.scala:172-190``).

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -170,6 +171,7 @@ class _ForestBase(RandomForestParams):
 
         return load_params(cls, path)
 
+    @observed_fit("random_forest")
     def fit(self, dataset, labels=None):
         import jax
         import jax.numpy as jnp

@@ -18,6 +18,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -57,6 +58,7 @@ class StandardScaler(StandardScalerParams):
 
         return load_params(StandardScaler, path)
 
+    @observed_fit("standard_scaler")
     def fit(self, dataset) -> "StandardScalerModel":
         timer = PhaseTimer()
         from spark_rapids_ml_tpu.data.batches import streaming_source

@@ -20,6 +20,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
@@ -94,6 +95,7 @@ class UMAP(UMAPParams):
 
         return load_params(UMAP, path)
 
+    @observed_fit("umap")
     def fit(self, dataset) -> "UMAPModel":
         import jax
         import jax.numpy as jnp

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -151,6 +152,7 @@ class Word2Vec(_Word2VecParams):
 
         return load_params(cls, path)
 
+    @observed_fit("word2vec")
     def fit(self, dataset) -> "Word2VecModel":
         import jax
         import jax.numpy as jnp

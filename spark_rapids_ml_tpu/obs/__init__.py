@@ -1,0 +1,94 @@
+"""Unified telemetry: metrics registry, trace spans, per-fit reports.
+
+One import surface for everything observability:
+
+* ``get_registry()`` — the process-wide metrics registry (counters,
+  gauges, histograms with labels; ``.snapshot()`` for JSON,
+  ``.prometheus_text()`` / ``start_prometheus_server()`` for scraping);
+* ``span(...)`` / ``get_recorder()`` — structured nested trace spans in a
+  ring buffer, exportable as Chrome-trace/Perfetto JSON (env-gated on
+  ``SPARK_RAPIDS_ML_TPU_TRACE_DIR``);
+* ``fit_instrumentation`` / ``observed_fit`` / ``current_fit`` — the
+  shared instrumentation entry points that give every distributed driver
+  and estimator a uniform ``fit_report_``;
+* back-compat re-exports of the underlying ``utils`` primitives
+  (``TraceRange``, ``PhaseTimer``, ``DeviceHealth``…), so telemetry
+  consumers need only this package.
+"""
+
+from spark_rapids_ml_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    DEFAULT_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    start_prometheus_server,
+)
+from spark_rapids_ml_tpu.obs.spans import (  # noqa: F401
+    SpanEvent,
+    SpanRecorder,
+    TRACE_DIR_ENV,
+    current_trace_id,
+    get_recorder,
+    maybe_export_trace,
+    new_trace_id,
+    span,
+)
+from spark_rapids_ml_tpu.obs.report import (  # noqa: F401
+    FitContext,
+    FitReport,
+    REPORT_ATTR,
+    attach_report,
+    current_fit,
+    fit_instrumentation,
+    last_fit_report,
+    observed_fit,
+    observed_transform,
+)
+
+# Back-compat shims: the pre-obs utils primitives, re-exported so telemetry
+# call sites can import everything from one place (utils.* keeps working).
+from spark_rapids_ml_tpu.utils.tracing import (  # noqa: F401
+    TraceColor,
+    TraceRange,
+)
+from spark_rapids_ml_tpu.utils.timing import PhaseTimer  # noqa: F401
+from spark_rapids_ml_tpu.utils.health import (  # noqa: F401
+    DeviceHealth,
+    check_devices,
+    check_devices_subprocess,
+)
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "DeviceHealth",
+    "FitContext",
+    "FitReport",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "PhaseTimer",
+    "REPORT_ATTR",
+    "SpanEvent",
+    "SpanRecorder",
+    "TRACE_DIR_ENV",
+    "TraceColor",
+    "TraceRange",
+    "attach_report",
+    "check_devices",
+    "check_devices_subprocess",
+    "current_fit",
+    "current_trace_id",
+    "fit_instrumentation",
+    "get_recorder",
+    "get_registry",
+    "last_fit_report",
+    "maybe_export_trace",
+    "new_trace_id",
+    "observed_fit",
+    "observed_transform",
+    "span",
+    "start_prometheus_server",
+]

@@ -1,0 +1,235 @@
+"""Structured trace spans: nested, per-fit trace ids, exportable.
+
+Layered on ``utils.tracing.TraceRange`` (which keeps forwarding to
+``jax.profiler.TraceAnnotation`` and the native ring buffer when present):
+every completed range/span lands in an in-process ring buffer, tagged with
+the innermost active trace id, and a whole fit's spans can be written out as
+Chrome-trace/Perfetto JSON. Export is env-gated on
+``SPARK_RAPIDS_ML_TPU_TRACE_DIR`` — unset (the default) means zero files,
+zero syscalls; the ring buffer alone costs one deque append per span.
+
+The division of labor with ``TraceRange``:
+
+* ``TraceRange`` is the raw annotation primitive (profiler + native
+  forwarding). On exit it files itself into this module's recorder via a
+  lazy hook, so EVERY existing instrumentation site feeds the exportable
+  timeline without being touched.
+* ``span(...)`` is the structured layer: it additionally participates in
+  the nesting stack (contextvar — correct across threads), inherits or
+  mints a trace id, and carries key/value args into the exported JSON.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+TRACE_DIR_ENV = "SPARK_RAPIDS_ML_TPU_TRACE_DIR"
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class SpanEvent:
+    """One completed span, Chrome-trace "complete event" shaped."""
+
+    name: str
+    ts_us: float
+    dur_us: float
+    trace_id: Optional[str]
+    depth: int
+    tid: int
+    color: Optional[str] = None
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+class SpanRecorder:
+    """Bounded in-process ring buffer of completed spans."""
+
+    def __init__(self, capacity: int = 8192):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    def record(self, event: SpanEvent) -> None:
+        with self._lock:
+            self._buf.append(event)
+
+    def events(self, trace_id: Optional[str] = None) -> List[SpanEvent]:
+        with self._lock:
+            evs = list(self._buf)
+        if trace_id is None:
+            return evs
+        return [e for e in evs if e.trace_id == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def chrome_trace(self, trace_id: Optional[str] = None) -> Dict[str, Any]:
+        """The buffer (optionally one fit's slice) as a Chrome-trace dict.
+
+        "Complete" events (``ph: "X"``) with microsecond ``ts``/``dur`` —
+        loadable by ``chrome://tracing`` and Perfetto directly.
+        """
+        pid = os.getpid()
+        trace_events = []
+        for e in self.events(trace_id):
+            args = dict(e.args)
+            if e.trace_id:
+                args["trace_id"] = e.trace_id
+            if e.color:
+                args["color"] = e.color
+            args["depth"] = e.depth
+            trace_events.append(
+                {
+                    "name": e.name,
+                    "cat": "spark_rapids_ml_tpu",
+                    "ph": "X",
+                    "ts": round(e.ts_us, 3),
+                    "dur": round(e.dur_us, 3),
+                    "pid": pid,
+                    "tid": e.tid,
+                    "args": args,
+                }
+            )
+        return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(
+        self, path: str, trace_id: Optional[str] = None
+    ) -> str:
+        doc = self.chrome_trace(trace_id)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+_recorder = SpanRecorder()
+
+
+def get_recorder() -> SpanRecorder:
+    return _recorder
+
+
+@dataclass(frozen=True)
+class _ActiveSpan:
+    name: str
+    trace_id: str
+
+
+_stack: contextvars.ContextVar = contextvars.ContextVar(
+    "sparkml_span_stack", default=()
+)
+
+
+def current_trace_id() -> Optional[str]:
+    st = _stack.get()
+    return st[-1].trace_id if st else None
+
+
+def record_trace_range(
+    name: str, color, t0_seconds: float, t1_seconds: float
+) -> None:
+    """Exit hook for ``TraceRange``: file the completed range under the
+    innermost active trace (trace id None when no span is open — still
+    recorded, just not attributable to one fit)."""
+    _recorder.record(
+        SpanEvent(
+            name=name,
+            ts_us=t0_seconds * 1e6,
+            dur_us=max(t1_seconds - t0_seconds, 0.0) * 1e6,
+            trace_id=current_trace_id(),
+            depth=len(_stack.get()),
+            tid=threading.get_ident(),
+            color=getattr(color, "name", None),
+        )
+    )
+
+
+@contextmanager
+def span(
+    name: str,
+    color: TraceColor = TraceColor.WHITE,
+    trace_id: Optional[str] = None,
+    **attrs,
+):
+    """Structured nested span. Yields the effective trace id.
+
+    Inherits the parent span's trace id (or mints one at the root) and
+    still pushes a ``TraceRange`` underneath so the profiler/native
+    timelines see the same name.
+    """
+    parent = _stack.get()
+    tid_ = trace_id or (parent[-1].trace_id if parent else new_trace_id())
+    token = _stack.set(parent + (_ActiveSpan(name, tid_),))
+    # record=False: this function records the event itself (with args and
+    # the right depth); letting TraceRange's exit hook also fire would
+    # duplicate it.
+    rng = TraceRange(name, color, record=False)
+    rng.__enter__()
+    t0 = time.perf_counter()
+    error_type: Optional[str] = None
+    try:
+        yield tid_
+    except BaseException as exc:
+        error_type = type(exc).__name__
+        raise
+    finally:
+        t1 = time.perf_counter()
+        rng.__exit__(None, None, None)
+        _stack.reset(token)
+        args = dict(attrs)
+        if error_type is not None:
+            args["error"] = error_type
+        _recorder.record(
+            SpanEvent(
+                name=name,
+                ts_us=t0 * 1e6,
+                dur_us=(t1 - t0) * 1e6,
+                trace_id=tid_,
+                depth=len(parent),
+                tid=threading.get_ident(),
+                color=getattr(color, "name", None),
+                args=args,
+            )
+        )
+
+
+def trace_dir() -> Optional[str]:
+    return os.environ.get(TRACE_DIR_ENV) or None
+
+
+def maybe_export_trace(trace_id: str, label: str) -> Optional[str]:
+    """Write one fit's spans as Chrome-trace JSON when the env gate is set.
+
+    Returns the written path, or None (gate unset / export failed — trace
+    export must never break a fit)."""
+    directory = trace_dir()
+    if not directory:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        safe_label = "".join(
+            c if (c.isalnum() or c in "-_") else "_" for c in label
+        )
+        path = os.path.join(
+            directory, f"trace_{safe_label}_{trace_id}.json"
+        )
+        return _recorder.export_chrome_trace(path, trace_id=trace_id)
+    except Exception:
+        return None

@@ -53,11 +53,15 @@ def minimize_kernel(params, data, *, loss_fn, solver: str, max_iter: int,
             ) from exc
 
         opt = optax.lbfgs()
-        value_and_grad = optax.value_and_grad_from_state(objective)
+        # NOT optax.value_and_grad_from_state: its reuse cond compares the
+        # init state's weak-f64 inf against the objective's value and
+        # rejects float32 objectives under an x64 runtime (optax 0.2.3).
+        # Recomputing at p is the same math, one extra fwd+bwd per iter.
+        value_and_grad = jax.value_and_grad(objective)
 
         def body(carry):
             p, state, value, _prev, it = carry
-            new_value, grad = value_and_grad(p, state=state)
+            new_value, grad = value_and_grad(p)
             updates, state = opt.update(
                 grad, state, p, value=new_value, grad=grad,
                 value_fn=objective)

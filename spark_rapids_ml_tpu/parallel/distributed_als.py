@@ -26,8 +26,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.als_kernel import _solve_side
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, collective_nbytes
 
 
 def _pad_table(idx, val, mask, n_dev):
@@ -40,6 +41,7 @@ def _pad_table(idx, val, mask, n_dev):
     return idx, val, mask, n
 
 
+@fit_instrumentation("distributed_als")
 def distributed_als_fit(
     u_table: Tuple[np.ndarray, np.ndarray, np.ndarray],
     i_table: Tuple[np.ndarray, np.ndarray, np.ndarray],
@@ -103,9 +105,20 @@ def distributed_als_fit(
         return _solve_side(other_full, idx_s, val_s, mask_s, reg_a,
                            implicit, alpha_a, nonneg, prev_s)
 
-    for _ in range(max_iter):
-        u = half_sweep(v, u_idx, u_val, u_mask, u, reg_dev, alpha_dev)
-        v = half_sweep(u, i_idx, i_val, i_mask, v, reg_dev, alpha_dev)
+    ctx = current_fit()
+    ctx.set_data(rows=n_users + n_items, features=rank)
+    ctx.set_iterations(max_iter)
+    with ctx.phase("execute"):
+        for _ in range(max_iter):
+            # each half-sweep all_gathers the OPPOSITE factor table over ICI
+            ctx.record_collective(
+                "all_gather",
+                nbytes=collective_nbytes((v0.shape[0], rank), dtype))
+            u = half_sweep(v, u_idx, u_val, u_mask, u, reg_dev, alpha_dev)
+            ctx.record_collective(
+                "all_gather",
+                nbytes=collective_nbytes((u0.shape[0], rank), dtype))
+            v = half_sweep(u, i_idx, i_val, i_mask, v, reg_dev, alpha_dev)
     u = np.asarray(jax.block_until_ready(u), dtype=np.float64)
     v = np.asarray(jax.block_until_ready(v), dtype=np.float64)
     return u[:n_users], v[:n_items]

@@ -23,12 +23,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.kmeans_kernel import lloyd_iterations
 from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     _global_kmeans_pp,
 )
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
+    collective_nbytes,
     pad_rows_to_multiple,
     row_sharding,
 )
@@ -93,6 +95,7 @@ def _bisect_split_kernel(
     return fn(x, mask, leaf, key, target, new_id)
 
 
+@fit_instrumentation("distributed_bisecting")
 def distributed_bisecting_kmeans_fit(
     x_host: np.ndarray,
     k: int,
@@ -146,6 +149,15 @@ def distributed_bisecting_kmeans_fit(
             break
         new_id = max(leaves) + 1
         key = jax.random.fold_in(jax.random.PRNGKey(seed), n_splits)
+        # per split: k-means++(2) seeding (pmax + 2 psums per center),
+        # the Lloyd loop's fused psum per iteration, and the final
+        # (count, Σx, Σ‖x‖²) child-moments psum
+        d = x_host.shape[1]
+        current_fit().record_collective(
+            "all_reduce",
+            nbytes=collective_nbytes((2 * d + 3,), x_padded.dtype),
+            count=max_iter + 3,
+        )
         centers2, new_leaf, cnt, sums, sqs = jax.block_until_ready(
             _bisect_split_kernel(
                 x_dev, mask_dev, leaf,

@@ -26,8 +26,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.knn_kernel import pairwise_sqdist
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    pad_rows_to_multiple,
+)
 
 
 @partial(jax.jit, static_argnames=("min_pts", "inner_block", "mesh"))
@@ -108,6 +113,7 @@ def _sharded_dbscan(x, valid, eps, min_pts: int, inner_block: int,
     return labels.reshape(n), core.reshape(n)
 
 
+@fit_instrumentation("distributed_dbscan")
 def distributed_dbscan_labels(
     x_host: np.ndarray,
     eps: float,
@@ -139,10 +145,23 @@ def distributed_dbscan_labels(
     valid = mask > 0
     x_dev = jax.device_put(jnp.asarray(x_pad), NamedSharding(mesh, P()))
     valid_dev = jax.device_put(jnp.asarray(valid), NamedSharding(mesh, P()))
-    labels, core = _sharded_dbscan(
-        x_dev, valid_dev, jnp.asarray(eps, dtype=x_dev.dtype), min_pts,
-        inner, mesh,
+    ctx = current_fit()
+    n_pad = x_pad.shape[0]
+    # one all_gather of the core mask, then one all_gather of the (n,)
+    # label vector per label-propagation sweep; the sweep count is
+    # data-dependent (compiled while_loop) — account the fixed payload once
+    # and record the per-sweep payload so consumers can scale it.
+    ctx.record_collective(
+        "all_gather", nbytes=collective_nbytes((n_pad,), x_dev.dtype),
+        count=2,
     )
+    ctx.note(dbscan_sweep_payload_bytes=collective_nbytes(
+        (n_pad,), x_dev.dtype))
+    with ctx.phase("execute"):
+        labels, core = _sharded_dbscan(
+            x_dev, valid_dev, jnp.asarray(eps, dtype=x_dev.dtype), min_pts,
+            inner, mesh,
+        )
     return (
         np.asarray(labels)[:n],
         np.asarray(core, dtype=bool)[:n],

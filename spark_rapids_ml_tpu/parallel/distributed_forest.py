@@ -19,13 +19,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.forest_kernel import (
     TreeEnsemble,
     grow_tree_classification,
     grow_tree_regression,
     quantile_bins,
 )
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    pad_rows_to_multiple,
+)
 
 
 @partial(
@@ -59,6 +64,7 @@ def _sharded_grow(
     )(binned, y_or_oh, w, feat_mask)
 
 
+@fit_instrumentation("distributed_forest")
 def distributed_forest_fit(
     x: np.ndarray,
     y: np.ndarray,
@@ -106,8 +112,16 @@ def distributed_forest_fit(
     else:
         y_dev = jax.device_put(jnp.asarray(y_p, dtype=dtype), vec_shard)
 
+    ctx = current_fit()
+    # per tree, one histogram psum per depth level: (channels, nodes ≤
+    # 2^depth, features, bins) — bounded program-level accounting
+    channels = (len(classes) + 1) if classification else 3
+    hist_nbytes = collective_nbytes(
+        (channels, 2 ** max_depth, d, n_bins), np.dtype(dtype))
     feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
     for _ in range(n_trees):
+        ctx.record_collective(
+            "all_reduce", nbytes=hist_nbytes, count=max_depth)
         w = rng.poisson(subsampling_rate, binned_p.shape[0]) * mask
         w_dev = jax.device_put(jnp.asarray(w, dtype=dtype), vec_shard)
         fm = jnp.asarray(

@@ -25,12 +25,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.forest_kernel import (
     TreeEnsemble,
     grow_tree_regression,
     quantile_bins,
 )
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    pad_rows_to_multiple,
+)
 
 
 @partial(
@@ -56,6 +61,7 @@ def _sharded_grow_with_leaf_ids(
     )(binned, r, w, feat_mask)
 
 
+@fit_instrumentation("distributed_gbt")
 def distributed_gbt_fit(
     x: np.ndarray,
     y: np.ndarray,
@@ -97,7 +103,14 @@ def distributed_gbt_fit(
 
     init = gbt_init_margin(y, classification)
 
+    ctx = current_fit()
+    # per boosted tree, one (count, Σr, Σr²) histogram psum per depth level
+    hist_nbytes = collective_nbytes(
+        (3, 2 ** max_depth, d, n_bins), np.dtype(dtype))
+
     def grow_fn(r, w):
+        ctx.record_collective(
+            "all_reduce", nbytes=hist_nbytes, count=max_depth)
         ft, tt, leaf, g_tree, leaf_ids_dev = _sharded_grow_with_leaf_ids(
             binned_dev,
             jax.device_put(jnp.asarray(r, dtype=dtype), vec_shard),

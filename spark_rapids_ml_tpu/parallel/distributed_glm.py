@@ -20,6 +20,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.glm_kernel import (
     GlmStepOut,
     irls_step_math,
@@ -27,6 +28,7 @@ from spark_rapids_ml_tpu.ops.glm_kernel import (
 )
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
+    collective_nbytes,
     pad_rows_to_multiple,
     row_sharding,
 )
@@ -70,6 +72,7 @@ def distributed_glm_step_kernel(
     return GlmStepOut(*fn(x, y, w, offset, coef, intercept))
 
 
+@fit_instrumentation("distributed_glm")
 def distributed_glm_fit(
     x_host: np.ndarray,
     y_host: np.ndarray,
@@ -148,7 +151,15 @@ def distributed_glm_fit(
     w_dev = jax.device_put(np.asarray(pad_vec(w, 0.0), dtype=nd), shard1)
     o_dev = jax.device_put(np.asarray(pad_vec(o, 0.0), dtype=nd), shard1)
 
+    ctx = current_fit()
+    n_feat = x_host.shape[1]
+    # each IRLS pass runs ONE fused psum of the GlmStepOut tuple
+    # (XᵀWX, XᵀWz, and the scalar sums) — recorded per actual invocation
+    step_nbytes = collective_nbytes(
+        (n_feat * n_feat + n_feat + len(GlmStepOut._fields),), nd)
+
     def step(coef, intercept, first=False):
+        ctx.record_collective("all_reduce", nbytes=step_nbytes)
         out = distributed_glm_step_kernel(
             x_dev, y_dev, w_dev, o_dev,
             jnp.asarray(coef, dtype=nd),

@@ -21,6 +21,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.gmm_kernel import (
     GmmStats,
     estep_stats_math,
@@ -28,6 +29,7 @@ from spark_rapids_ml_tpu.ops.gmm_kernel import (
 )
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
+    collective_nbytes,
     pad_rows_to_multiple,
     row_sharding,
 )
@@ -63,6 +65,7 @@ def distributed_gmm_stats_kernel(
     return GmmStats(*fn(x, w, means, prec_chol, log_det, log_weights))
 
 
+@fit_instrumentation("distributed_gmm")
 def distributed_gmm_fit(
     x_host: np.ndarray,
     k: int,
@@ -100,7 +103,15 @@ def distributed_gmm_fit(
         NamedSharding(mesh, P(DATA_AXIS)),
     )
 
+    ctx = current_fit()
+    d = x_host.shape[1]
+    # one fused psum of GmmStats (Σr, Σr·x, Σr·xxᵀ, loglik, w_sum) per
+    # EM pass — recorded per actual stepper invocation
+    step_nbytes = collective_nbytes(
+        (k + k * d + k * d * d + 2,), np.dtype(dt))
+
     def stepper(means, prec, log_det, log_w):
+        ctx.record_collective("all_reduce", nbytes=step_nbytes)
         out = distributed_gmm_stats_kernel(
             x_dev, w_dev,
             jnp.asarray(means, dtype=dt),

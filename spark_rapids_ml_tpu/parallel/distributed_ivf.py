@@ -27,8 +27,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.knn_kernel import ivf_search, ivfpq_search, knn_merge
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, collective_nbytes
 
 _FAR = 1e30  # padded-cell centroid fill: sorts after every real cell
 
@@ -90,6 +91,7 @@ def _sharded_ivf_pq(queries, centroids, codebooks, b_codes, b_ids, b_mask,
     )(queries, centroids, codebooks, b_codes, b_ids, b_mask)
 
 
+@fit_instrumentation("distributed_ivf")
 def distributed_ivf_search(
     model,
     queries: np.ndarray,
@@ -147,6 +149,17 @@ def distributed_ivf_search(
     cent_dev = jax.device_put(jnp.asarray(cent), shard_l)
     ids_dev = jax.device_put(jnp.asarray(ids), shard_l)
     mask_dev = jax.device_put(jnp.asarray(mask), shard_l)
+    ctx = current_fit()
+    ctx.set_data(rows=queries.shape[0], features=queries.shape[1])
+    # two-level reduction: all_gather of per-shard top-k distances + ids
+    ctx.record_collective(
+        "all_gather",
+        nbytes=collective_nbytes(
+            (queries.shape[0], per_shard * n_shards), dtype))
+    ctx.record_collective(
+        "all_gather",
+        nbytes=collective_nbytes(
+            (queries.shape[0], per_shard * n_shards), np.int32))
     if algorithm == "ivfflat":
         items = _pad_lists(
             np.asarray(b_items, dtype=np.dtype(dtype)), nlist_p, 0

@@ -18,11 +18,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jax import lax
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.kmeans_kernel import (
     KMeansResult,
     lloyd_iterations,
 )
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple, row_sharding
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    pad_rows_to_multiple,
+    row_sharding,
+)
 
 
 def _global_kmeans_pp(x_shard, mask_shard, key, n_clusters: int):
@@ -116,6 +122,7 @@ def distributed_kmeans_fit_kernel(
     return KMeansResult(centers, cost, n_iter, converged)
 
 
+@fit_instrumentation("distributed_kmeans")
 def distributed_kmeans_fit(
     x_host: np.ndarray,
     n_clusters: int,
@@ -125,18 +132,42 @@ def distributed_kmeans_fit(
     seed: int = 0,
     dtype=None,
 ) -> KMeansResult:
+    ctx = current_fit()
     x_host = np.asarray(x_host)
     n_dev = mesh.devices.size
-    x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
-    if dtype is not None:
-        x_padded = x_padded.astype(dtype)
-        mask = mask.astype(dtype)
-    x_dev = jax.device_put(x_padded, row_sharding(mesh))
-    mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
+    with ctx.phase("prepare"):
+        x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
+        if dtype is not None:
+            x_padded = x_padded.astype(dtype)
+            mask = mask.astype(dtype)
+    with ctx.phase("placement"):
+        x_dev = jax.device_put(x_padded, row_sharding(mesh))
+        mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
     key = jax.random.PRNGKey(seed)
-    return jax.block_until_ready(
-        distributed_kmeans_fit_kernel(
-            x_dev, mask_dev, key,
-            mesh=mesh, n_clusters=n_clusters, max_iter=max_iter, tol=tol,
+    with ctx.phase("execute"):
+        result = jax.block_until_ready(
+            distributed_kmeans_fit_kernel(
+                x_dev, mask_dev, key,
+                mesh=mesh, n_clusters=n_clusters, max_iter=max_iter, tol=tol,
+            )
         )
+    n = x_host.shape[1]
+    dt = x_padded.dtype
+    n_iter = int(result[2])
+    ctx.set_iterations(n_iter)
+    # k-means++ seeding: per center one pmax (scalar) + two psums
+    # (owner scalar + winning row)
+    ctx.record_collective(
+        "all_max", nbytes=collective_nbytes((1,), dt), count=n_clusters
     )
+    ctx.record_collective(
+        "all_reduce", nbytes=collective_nbytes((n + 1,), dt),
+        count=n_clusters,
+    )
+    # Lloyd: one fused psum of (k×n sums, k counts, cost) per iteration
+    ctx.record_collective(
+        "all_reduce",
+        nbytes=collective_nbytes((n_clusters * n + n_clusters + 1,), dt),
+        count=max(n_iter, 1),
+    )
+    return result

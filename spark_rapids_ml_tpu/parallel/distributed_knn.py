@@ -25,9 +25,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.knn_kernel import knn_merge, pairwise_sqdist
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
+    collective_nbytes,
     pad_rows_to_multiple,
     row_sharding,
 )
@@ -65,6 +67,7 @@ def _sharded_knn(queries, items_padded, item_mask, k: int, mesh: Mesh):
     )(queries, items_padded, item_mask)
 
 
+@fit_instrumentation("distributed_knn")
 def distributed_kneighbors(
     queries: np.ndarray,
     items: np.ndarray,
@@ -93,7 +96,18 @@ def distributed_kneighbors(
         jnp.asarray(np.asarray(queries, dtype=np.dtype(dtype))),
         NamedSharding(mesh, P()),
     )
-    d, i = _sharded_knn(q_dev, items_dev, mask_dev, k, mesh)
+    ctx = current_fit()
+    n_q = np.asarray(queries).shape[0]
+    # two all_gathers of the per-shard top-k candidates: (q, k·D) distances
+    # + (q, k·D) global indices
+    ctx.record_collective(
+        "all_gather",
+        nbytes=collective_nbytes((n_q, k * n_shards), items_dev.dtype))
+    ctx.record_collective(
+        "all_gather",
+        nbytes=collective_nbytes((n_q, k * n_shards), np.int32))
+    with ctx.phase("execute"):
+        d, i = _sharded_knn(q_dev, items_dev, mask_dev, k, mesh)
     return (
         np.sqrt(np.maximum(np.asarray(d), 0.0)),
         np.asarray(i, dtype=np.int64),

@@ -21,16 +21,19 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.lda_kernel import (
     dirichlet_expectation,
     e_step_kernel,
 )
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
+    collective_nbytes,
     pad_rows_to_multiple,
 )
 
 
+@fit_instrumentation("distributed_lda")
 def distributed_lda_fit(
     counts: np.ndarray,
     k: int,
@@ -71,9 +74,16 @@ def distributed_lda_fit(
                                   alpha_vec, shard_key)
         return lax.psum(sstats, DATA_AXIS)
 
+    ctx = current_fit()
+    ctx.set_data(rows=n_docs, features=vocab)
+    # each EM pass psums the (k, vocab) sufficient-statistics tensor
+    sstats_nbytes = collective_nbytes((k, vocab), dtype)
     key = jax.random.PRNGKey(seed)
-    for _ in range(max_iter):
-        key, sub = jax.random.split(key)
-        lam = eta_val + em_sstats(x, lam, alpha_vec, sub)
+    with ctx.phase("execute"):
+        for _ in range(max_iter):
+            key, sub = jax.random.split(key)
+            ctx.record_collective("all_reduce", nbytes=sstats_nbytes)
+            lam = eta_val + em_sstats(x, lam, alpha_vec, sub)
+    ctx.set_iterations(max_iter)
     return (np.asarray(jax.block_until_ready(lam), dtype=np.float64),
             np.asarray(alpha_vec, dtype=np.float64))

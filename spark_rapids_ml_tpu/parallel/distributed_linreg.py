@@ -14,12 +14,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.linreg_kernel import (
     LinRegResult,
     linreg_partial_stats,
     solve_normal_equations,
 )
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple, row_sharding
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    pad_rows_to_multiple,
+    row_sharding,
+)
 
 
 @partial(jax.jit, static_argnames=("mesh", "fit_intercept"))
@@ -47,6 +53,7 @@ def distributed_linreg_fit_kernel(
     return LinRegResult(coef, intercept)
 
 
+@fit_instrumentation("distributed_linreg")
 def distributed_linreg_fit(
     x_host: np.ndarray,
     y_host: np.ndarray,
@@ -55,22 +62,32 @@ def distributed_linreg_fit(
     fit_intercept: bool = True,
     dtype=None,
 ) -> LinRegResult:
+    ctx = current_fit()
     x_host = np.asarray(x_host)
     y_host = np.asarray(y_host).reshape(-1)
     n_dev = mesh.devices.size
-    x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
-    y_padded = np.zeros(x_padded.shape[0], dtype=y_host.dtype)
-    y_padded[: y_host.shape[0]] = y_host
-    if dtype is not None:
-        x_padded = x_padded.astype(dtype)
-        y_padded = y_padded.astype(dtype)
-        mask = mask.astype(dtype)
-    x_dev = jax.device_put(x_padded, row_sharding(mesh))
-    y_dev = jax.device_put(y_padded, NamedSharding(mesh, P(DATA_AXIS)))
-    mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
-    return jax.block_until_ready(
-        distributed_linreg_fit_kernel(
-            x_dev, y_dev, mask_dev,
-            mesh=mesh, reg_param=reg_param, fit_intercept=fit_intercept,
-        )
+    with ctx.phase("prepare"):
+        x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
+        y_padded = np.zeros(x_padded.shape[0], dtype=y_host.dtype)
+        y_padded[: y_host.shape[0]] = y_host
+        if dtype is not None:
+            x_padded = x_padded.astype(dtype)
+            y_padded = y_padded.astype(dtype)
+            mask = mask.astype(dtype)
+    with ctx.phase("placement"):
+        x_dev = jax.device_put(x_padded, row_sharding(mesh))
+        y_dev = jax.device_put(y_padded, NamedSharding(mesh, P(DATA_AXIS)))
+        mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
+    # ONE fused psum of (XᵀX, Xᵀy, Σx, Σy, n)
+    n = x_host.shape[1]
+    ctx.record_collective(
+        "all_reduce",
+        nbytes=collective_nbytes((n * n + 2 * n + 2,), x_padded.dtype),
     )
+    with ctx.phase("execute"):
+        return jax.block_until_ready(
+            distributed_linreg_fit_kernel(
+                x_dev, y_dev, mask_dev,
+                mesh=mesh, reg_param=reg_param, fit_intercept=fit_intercept,
+            )
+        )

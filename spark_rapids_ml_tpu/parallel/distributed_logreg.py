@@ -16,8 +16,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.logreg_kernel import LogRegResult, newton_iterations
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple, row_sharding
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    pad_rows_to_multiple,
+    row_sharding,
+)
 
 
 @partial(
@@ -54,6 +60,7 @@ def distributed_logreg_fit_kernel(
     return LogRegResult(coef, intercept, n_iter, converged)
 
 
+@fit_instrumentation("distributed_logreg")
 def distributed_logreg_fit(
     x_host: np.ndarray,
     y_host: np.ndarray,
@@ -64,24 +71,37 @@ def distributed_logreg_fit(
     tol: float = 1e-8,
     dtype=None,
 ) -> LogRegResult:
+    ctx = current_fit()
     x_host = np.asarray(x_host)
     y_host = np.asarray(y_host).reshape(-1)
     n_dev = mesh.devices.size
-    x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
-    y_padded = np.zeros(x_padded.shape[0], dtype=y_host.dtype)
-    y_padded[: y_host.shape[0]] = y_host
-    if dtype is not None:
-        x_padded = x_padded.astype(dtype)
-        y_padded = y_padded.astype(dtype)
-        mask = mask.astype(dtype)
-    x_dev = jax.device_put(x_padded, row_sharding(mesh))
-    shard1 = NamedSharding(mesh, P(DATA_AXIS))
-    y_dev = jax.device_put(y_padded, shard1)
-    mask_dev = jax.device_put(mask, shard1)
-    return jax.block_until_ready(
-        distributed_logreg_fit_kernel(
-            x_dev, y_dev, mask_dev,
-            mesh=mesh, reg_param=reg_param, fit_intercept=fit_intercept,
-            max_iter=max_iter, tol=tol,
+    with ctx.phase("prepare"):
+        x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
+        y_padded = np.zeros(x_padded.shape[0], dtype=y_host.dtype)
+        y_padded[: y_host.shape[0]] = y_host
+        if dtype is not None:
+            x_padded = x_padded.astype(dtype)
+            y_padded = y_padded.astype(dtype)
+            mask = mask.astype(dtype)
+    with ctx.phase("placement"):
+        x_dev = jax.device_put(x_padded, row_sharding(mesh))
+        shard1 = NamedSharding(mesh, P(DATA_AXIS))
+        y_dev = jax.device_put(y_padded, shard1)
+        mask_dev = jax.device_put(mask, shard1)
+    with ctx.phase("execute"):
+        result = jax.block_until_ready(
+            distributed_logreg_fit_kernel(
+                x_dev, y_dev, mask_dev,
+                mesh=mesh, reg_param=reg_param, fit_intercept=fit_intercept,
+                max_iter=max_iter, tol=tol,
+            )
         )
+    # one fused psum of (gradient, Hessian) per Newton iteration
+    d = x_host.shape[1] + (1 if fit_intercept else 0)
+    n_iter = int(result[2])
+    ctx.set_iterations(n_iter)
+    ctx.record_collective(
+        "all_reduce", nbytes=collective_nbytes((d * d + d,), x_padded.dtype),
+        count=max(n_iter, 1),
     )
+    return result

@@ -18,8 +18,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
+    collective_nbytes,
     pad_rows_to_multiple,
     row_sharding,
 )
@@ -58,6 +60,7 @@ def distributed_nb_stats_kernel(
     return fn(x, y_oh)
 
 
+@fit_instrumentation("distributed_nb")
 def distributed_nb_fit(
     x_host: np.ndarray,
     y_host: np.ndarray,
@@ -91,11 +94,21 @@ def distributed_nb_fit(
         np.asarray(oh_padded, dtype=np.dtype(dtype)),
         NamedSharding(mesh, P(DATA_AXIS, None)),
     )
-    counts, sums, sq = jax.block_until_ready(
-        distributed_nb_stats_kernel(
-            x_dev, oh_dev, mesh=mesh,
-            need_sq=(model_type == "gaussian"))
+    ctx = current_fit()
+    n_classes, n_feat = classes.size, x_host.shape[1]
+    need_sq = model_type == "gaussian"
+    # fused psum of (counts, Σx per class[, Σx² per class])
+    ctx.record_collective(
+        "all_reduce",
+        nbytes=collective_nbytes(
+            (n_classes * (1 + n_feat * (2 if need_sq else 1)),), dtype
+        ),
     )
+    with ctx.phase("execute"):
+        counts, sums, sq = jax.block_until_ready(
+            distributed_nb_stats_kernel(
+                x_dev, oh_dev, mesh=mesh, need_sq=need_sq)
+        )
     pi, theta, sigma = finalize_nb_from_stats(
         classes,
         np.asarray(counts, dtype=np.float64),

@@ -34,12 +34,29 @@ from spark_rapids_ml_tpu.models.fm import (
 from spark_rapids_ml_tpu.models.survival_regression import (
     aft_rowwise_loglik,
 )
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.optim import minimize_kernel
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     pad_rows_to_multiple,
     row_sharding,
 )
+
+
+def _note_grad_psums(ctx, params0, n_iter, dtype) -> None:
+    """Account the per-iteration gradient ``psum``: autodiff inserts one
+    all-reduce of the full parameter pytree (plus the 2-scalar loss mean)
+    per optimizer step."""
+    p_count = sum(
+        int(np.prod(np.shape(leaf)))
+        for leaf in jax.tree_util.tree_leaves(params0)
+    )
+    ctx.set_iterations(n_iter)
+    ctx.record_collective(
+        "all_reduce",
+        nbytes=(p_count + 2) * np.dtype(dtype).itemsize,
+        count=max(int(n_iter), 1),
+    )
 
 
 # -- module-level psum'd objectives (static jit args need stable ids) ------
@@ -132,6 +149,7 @@ def _pad_rows(mesh, x, *row_vectors, dtype=jnp.float32):
     return out
 
 
+@fit_instrumentation("distributed_fm")
 def distributed_fm_fit(
     x_host: np.ndarray,
     y_host: np.ndarray,
@@ -172,11 +190,13 @@ def distributed_fm_fit(
             step_size=step_size, mesh=mesh, row_args=3,
         )
     )
+    _note_grad_psums(current_fit(), params0, n_iter, dtype)
     host = {k: np.asarray(v, dtype=np.float64)
             for k, v in params.items()}
     return host, int(n_iter), float(loss)
 
 
+@fit_instrumentation("distributed_aft")
 def distributed_aft_fit(
     x_host: np.ndarray,
     t_host: np.ndarray,
@@ -213,11 +233,13 @@ def distributed_aft_fit(
             max_iter=max_iter, tol=tol, mesh=mesh, row_args=4,
         )
     )
+    _note_grad_psums(current_fit(), params0, n_iter, dtype)
     host = {k: np.asarray(v, dtype=np.float64)
             for k, v in params.items()}
     return host, int(n_iter), float(loss)
 
 
+@fit_instrumentation("distributed_mlp")
 def distributed_mlp_fit(
     x_host: np.ndarray,
     y_host: np.ndarray,
@@ -257,6 +279,7 @@ def distributed_mlp_fit(
             mesh=mesh, row_args=3,
         )
     )
+    _note_grad_psums(current_fit(), params0, n_iter, dtype)
     host = jax.tree_util.tree_map(
         lambda a: np.asarray(a, dtype=np.float64), params)
     return host, int(n_iter), float(loss)

@@ -33,8 +33,14 @@ from spark_rapids_ml_tpu.ops.covariance import (
     gram,
     partial_gram_stats,
 )
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple, row_sharding
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    pad_rows_to_multiple,
+    row_sharding,
+)
 
 
 class DistributedPCAResult(NamedTuple):
@@ -105,6 +111,7 @@ def distributed_pca_fit_kernel(
     return DistributedPCAResult(components, evr, mean)
 
 
+@fit_instrumentation("distributed_pca")
 def distributed_pca_fit(
     x_host: np.ndarray,
     k: int,
@@ -120,26 +127,45 @@ def distributed_pca_fit(
     host only pads and hands XLA a sharded array; all math and communication
     is on-device.
     """
+    ctx = current_fit()
     n_dev = mesh.devices.size
     x_host = np.asarray(x_host)
     if k > x_host.shape[1]:
         raise ValueError(
             f"k = {k} must be at most the number of features {x_host.shape[1]}"
         )
-    x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
-    if dtype is not None:
-        x_padded = x_padded.astype(dtype)
-        mask = mask.astype(dtype)
-    sharding = row_sharding(mesh)
-    x_dev = jax.device_put(x_padded, sharding)
-    mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
-    result = distributed_pca_fit_kernel(
-        x_dev,
-        mask_dev,
-        mesh=mesh,
-        k=k,
-        mean_centering=mean_centering,
-        one_pass=one_pass,
-        flip_signs=flip_signs,
-    )
-    return jax.block_until_ready(result)
+    with ctx.phase("prepare"):
+        x_padded, mask = pad_rows_to_multiple(x_host, n_dev)
+        if dtype is not None:
+            x_padded = x_padded.astype(dtype)
+            mask = mask.astype(dtype)
+    with ctx.phase("placement"):
+        sharding = row_sharding(mesh)
+        x_dev = jax.device_put(x_padded, sharding)
+        mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
+    n = x_host.shape[1]
+    dt = x_padded.dtype
+    if one_pass:
+        # ONE fused psum of (Gram, column sum, count)
+        ctx.record_collective(
+            "all_reduce", nbytes=collective_nbytes((n * n + n + 1,), dt)
+        )
+    else:
+        # psum of (column sum, count), then psum of the centered Gram
+        ctx.record_collective(
+            "all_reduce", nbytes=collective_nbytes((n + 1,), dt)
+        )
+        ctx.record_collective(
+            "all_reduce", nbytes=collective_nbytes((n, n), dt)
+        )
+    with ctx.phase("execute"):
+        result = distributed_pca_fit_kernel(
+            x_dev,
+            mask_dev,
+            mesh=mesh,
+            k=k,
+            mean_centering=mean_centering,
+            one_pass=one_pass,
+            flip_signs=flip_signs,
+        )
+        return jax.block_until_ready(result)

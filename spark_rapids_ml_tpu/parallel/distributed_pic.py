@@ -21,7 +21,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, row_sharding
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    row_sharding,
+)
 
 
 @partial(jax.jit, static_argnames=("mesh", "max_iter"))
@@ -58,6 +63,7 @@ def distributed_power_iterate_kernel(
     return fn(w_panels, v0)
 
 
+@fit_instrumentation("distributed_pic")
 def distributed_pic_assign(
     src,
     dst,
@@ -114,8 +120,16 @@ def distributed_pic_assign(
     v0_dev = jax.device_put(np.asarray(v0, dtype=np.dtype(dtype)),
                             NamedSharding(mesh, P()))
 
-    v = jax.block_until_ready(distributed_power_iterate_kernel(
-        w_dev, v0_dev, mesh=mesh, max_iter=max_iter))
+    ctx = current_fit()
+    ctx.set_iterations(max_iter)
+    # one all_gather of the (n,) iterate per power iteration
+    ctx.record_collective(
+        "all_gather", nbytes=collective_nbytes((n + pad,), dtype),
+        count=max_iter,
+    )
+    with ctx.phase("execute"):
+        v = jax.block_until_ready(distributed_power_iterate_kernel(
+            w_dev, v0_dev, mesh=mesh, max_iter=max_iter))
     # O(1) spread for k-means; the trailing 1-D cluster runs at the
     # SAME dtype as the iteration (the local path's behavior)
     emb = jnp.asarray(np.asarray(v)[:n, None] * n, dtype=dtype)

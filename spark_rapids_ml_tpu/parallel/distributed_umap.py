@@ -22,8 +22,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.knn_kernel import pairwise_sqdist
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    pad_rows_to_multiple,
+)
 
 
 @partial(jax.jit, static_argnames=("n_epochs", "mesh"))
@@ -96,6 +101,7 @@ def _sharded_umap_optimize(
     )(edge_i, edge_j, edge_p, edge_mask)
 
 
+@fit_instrumentation("distributed_umap")
 def distributed_umap_optimize(
     edge_i: np.ndarray,
     edge_j: np.ndarray,
@@ -124,6 +130,15 @@ def distributed_umap_optimize(
     ep, _ = pad_rows_to_multiple(
         np.asarray(edge_p, dtype=np.dtype(dtype)), n_dev
     )
+    ctx = current_fit()
+    ctx.set_data(rows=np.asarray(emb0).shape[0],
+                 features=np.asarray(emb0).shape[1])
+    ctx.set_iterations(n_epochs)
+    # per epoch: one all_gather of repulsion panels + one psum of the
+    # edge-force partials, each O(n·dim)
+    emb_nbytes = collective_nbytes(emb_pad.shape, dtype)
+    ctx.record_collective("all_gather", nbytes=emb_nbytes, count=n_epochs)
+    ctx.record_collective("all_reduce", nbytes=emb_nbytes, count=n_epochs)
     shard1 = NamedSharding(mesh, P(DATA_AXIS))
     repl = NamedSharding(mesh, P())
     out = _sharded_umap_optimize(

@@ -22,7 +22,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, collective_nbytes
 
 
 @partial(jax.jit, donate_argnums=(0, 1),
@@ -93,6 +94,7 @@ def distributed_sgns_step_kernel(
     return fn(u, v, c_idx, ctx_idx, key, lr, noise_logits)
 
 
+@fit_instrumentation("distributed_word2vec")
 def distributed_word2vec_fit(
     token_sentences,
     mesh: Mesh,
@@ -137,6 +139,12 @@ def distributed_word2vec_fit(
     n_batches = max(1, n_pairs // batch)
     total_steps = max_iter * n_batches
 
+    obs_ctx = current_fit()
+    obs_ctx.set_data(rows=n_pairs, features=vector_size)
+    # per SGNS step: fused psums of the two (vocab, dim) gradient tables,
+    # their (vocab,) touch counts, and the scalar loss
+    step_nbytes = collective_nbytes(
+        (2 * len(vocab) * (vector_size + 1) + 1,), dtype)
     step = 0
     last_loss = float("nan")
     for _ in range(max_iter):
@@ -152,6 +160,7 @@ def distributed_word2vec_fit(
                 max(lr0 * (1 - step / total_steps), lr0 * 1e-4),
                 dtype=dtype)
             key, sub = jax.random.split(key)
+            obs_ctx.record_collective("all_reduce", nbytes=step_nbytes)
             u, v, loss = distributed_sgns_step_kernel(
                 u, v,
                 jax.device_put(jnp.asarray(pairs[0, sel]), shard1),

@@ -26,6 +26,41 @@ DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
 
+def _install_shard_map_compat() -> None:
+    """Expose ``jax.shard_map`` and ``jax.lax.axis_size`` on older jax
+    (< 0.5), where shard_map lives at ``jax.experimental.shard_map`` and
+    the replication-check kwarg is ``check_rep`` rather than ``check_vma``.
+    Every driver in this package imports this module, so the aliases are
+    installed before any call site runs."""
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # old jax: the axis frame IS the (static) size
+            import jax.core as core
+
+            return core.axis_frame(axis_name)
+
+        jax.lax.axis_size = axis_size
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - very old jax; let call sites fail
+        return
+
+    def shard_map(f, *args, **kwargs):
+        kwargs.pop("check_vma", None)
+        # the old static replication checker lacks rules for while/argmax
+        # the kernels here rely on (newer jax proves them); disable it —
+        # out_specs still declare the contract
+        kwargs["check_rep"] = False
+        return _shard_map(f, *args, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+_install_shard_map_compat()
+
+
 def device_count() -> int:
     return len(jax.devices())
 
@@ -64,6 +99,26 @@ def row_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def mesh_shape(mesh: Mesh) -> dict:
+    """Axes/shape/device summary for fit reports and logs."""
+    first = mesh.devices.flat[0]
+    return {
+        "axes": tuple(str(a) for a in mesh.axis_names),
+        "shape": tuple(int(s) for s in mesh.devices.shape),
+        "devices": int(mesh.devices.size),
+        "platform": getattr(first, "platform", "unknown"),
+    }
+
+
+def collective_nbytes(shape, dtype) -> int:
+    """Payload bytes of one collective operand of ``shape``/``dtype`` —
+    the unit every driver's program-level collective accounting
+    (``FitContext.record_collective``) is declared in."""
+    return int(np.prod([int(s) for s in shape], dtype=np.int64)) * np.dtype(
+        dtype
+    ).itemsize
 
 
 def pad_rows_to_multiple(x: np.ndarray, multiple: int):

@@ -32,6 +32,20 @@ _initialized = False
 _initialized_coordinator: Optional[str] = None
 
 
+def _distributed_is_initialized(jax) -> bool:
+    """``jax.distributed.is_initialized()`` with an old-jax (< 0.5)
+    fallback that reads the same client state the real API wraps."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    try:  # pragma: no cover - version-dependent internal layout
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -49,7 +63,7 @@ def initialize_multihost(
     # Idempotency check must NOT touch backend-initializing APIs
     # (jax.process_count() would create the backend and make a later
     # initialize() impossible); is_initialized() only reads client state.
-    if _initialized or jax.distributed.is_initialized():
+    if _initialized or _distributed_is_initialized(jax):
         # Reuse is only safe when it is the SAME job: a second collective
         # fit in a long-lived executor process arrives with a freshly
         # picked driver coordinator, and silently reusing the first job's
@@ -95,11 +109,18 @@ def initialize_multihost(
         return False
 
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        from spark_rapids_ml_tpu.obs import get_registry, span
+
+        with span("multihost:initialize"):
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        get_registry().counter(
+            "sparkml_multihost_init_total",
+            "successful jax.distributed.initialize joins",
+        ).inc()
     except RuntimeError:
         # Backend already initialized (a JAX call ran first). With an
         # explicit coordinator this is a real misuse — surface it; from
@@ -181,6 +202,17 @@ def make_global_array(local_rows: np.ndarray, mesh, n_global_rows: int):
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
     sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    try:
+        from spark_rapids_ml_tpu.obs import current_fit, get_registry
+
+        nbytes = int(getattr(local_rows, "nbytes", 0))
+        get_registry().counter(
+            "sparkml_bytes_placed_total",
+            "host→device bytes placed onto the global mesh",
+        ).inc(nbytes)
+        current_fit().note(multihost_local_rows=int(local_rows.shape[0]))
+    except Exception:
+        pass
     if jax.process_count() == 1:
         return jax.device_put(local_rows, sharding)
     return jax.make_array_from_process_local_data(

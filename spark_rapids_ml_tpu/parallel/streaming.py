@@ -28,11 +28,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
 from spark_rapids_ml_tpu.ops.covariance import covariance_from_stats, partial_gram_stats
 from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
 from spark_rapids_ml_tpu.ops.pca_kernel import PCAFitResult
 from spark_rapids_ml_tpu.ops.streaming import GramStats
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, row_sharding
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_nbytes,
+    row_sharding,
+)
 
 
 @partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
@@ -130,6 +135,14 @@ class DistributedStreamingPCA:
     def finalize(
         self, k: int, mean_centering: bool = True, solver: str = "eigh"
     ) -> PCAFitResult:
+        # the ONE collective of the streamed fit: the axis-0 sum over the
+        # per-device (gram, col_sum, count) slices
+        n = self._n
+        current_fit().record_collective(
+            "all_reduce",
+            nbytes=collective_nbytes((n * n + n + 1,),
+                                     self._stats.gram.dtype),
+        )
         return jax.block_until_ready(
             finalize_stats_sharded(
                 self._stats, k, mean_centering=mean_centering, solver=solver
@@ -137,6 +150,7 @@ class DistributedStreamingPCA:
         )
 
 
+@fit_instrumentation("distributed_streaming_pca")
 def distributed_streaming_pca_fit(
     source,
     k: int,
@@ -157,10 +171,17 @@ def distributed_streaming_pca_fit(
             f"source batch_rows {source.batch_rows} must be a multiple of "
             f"the mesh size {d}"
         )
+    ctx = current_fit()
     acc = DistributedStreamingPCA(source.n_features, mesh, dtype=dtype)
     host_dtype = np.dtype(jnp.zeros((), dtype=dtype).dtype.name)
-    for batch, mask in source.batches():
-        acc.partial_fit(batch.astype(host_dtype, copy=False), mask)
+    n_batches = 0
+    with ctx.phase("stream"):
+        for batch, mask in source.batches():
+            acc.partial_fit(batch.astype(host_dtype, copy=False), mask)
+            n_batches += 1
+    ctx.set_data(rows=acc.rows_seen, features=source.n_features)
+    ctx.note(batches_streamed=n_batches)
     if mean_centering and acc.rows_seen < 2:
         raise ValueError("mean centering requires more than one row")
-    return acc.finalize(k, mean_centering=mean_centering, solver=solver)
+    with ctx.phase("finalize"):
+        return acc.finalize(k, mean_centering=mean_centering, solver=solver)

@@ -1,4 +1,18 @@
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.utils.health import (
+    DeviceHealth,
+    check_devices,
+    check_devices_subprocess,
+)
 
-__all__ = ["TraceColor", "TraceRange", "PhaseTimer"]
+# The canonical import surface for telemetry is now spark_rapids_ml_tpu.obs
+# (which re-exports all of the above); these names stay for back-compat.
+__all__ = [
+    "DeviceHealth",
+    "PhaseTimer",
+    "TraceColor",
+    "TraceRange",
+    "check_devices",
+    "check_devices_subprocess",
+]

@@ -45,15 +45,25 @@ class TraceRange:
     session and no native library.
     """
 
-    def __init__(self, name: str, color: TraceColor = TraceColor.WHITE):
+    def __init__(
+        self,
+        name: str,
+        color: TraceColor = TraceColor.WHITE,
+        record: bool = True,
+    ):
         self.name = name
         self.color = color
         self._annotation = None
         self._native = None
         self._t0: Optional[float] = None
+        self._elapsed: Optional[float] = None
+        # record=False lets obs.spans.span() own the ring-buffer event for
+        # ranges it creates itself (it carries extra args/trace context).
+        self._record = record
 
     def __enter__(self) -> "TraceRange":
         self._t0 = time.perf_counter()
+        self._elapsed = None  # a reused range must not report a stale freeze
         try:
             import jax.profiler
 
@@ -72,6 +82,9 @@ class TraceRange:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        if self._t0 is not None:
+            # freeze the duration — ``elapsed`` must stop growing after exit
+            self._elapsed = time.perf_counter() - self._t0
         if self._native is not None:
             try:
                 self._native.trace_pop()
@@ -82,7 +95,22 @@ class TraceRange:
                 self._annotation.__exit__(exc_type, exc, tb)
             except Exception:
                 pass
+        if self._record and self._t0 is not None:
+            # file the completed range into the exportable span ring buffer
+            # (lazy import: obs.spans imports this module at load time)
+            try:
+                from spark_rapids_ml_tpu.obs.spans import record_trace_range
+
+                record_trace_range(
+                    self.name, self.color, self._t0,
+                    self._t0 + self._elapsed,
+                )
+            except Exception:
+                pass
 
     @property
     def elapsed(self) -> float:
+        """Seconds inside the range: live while entered, frozen after exit."""
+        if self._elapsed is not None:
+            return self._elapsed
         return time.perf_counter() - self._t0 if self._t0 is not None else 0.0

@@ -55,3 +55,74 @@ def test_trace_colors_match_reference_palette():
     assert TraceColor.GREEN.value == 0xFF76B900
     assert TraceColor.RED.value == 0xFFFF0000
     assert len(TraceColor) == 9
+
+
+def test_phase_timer_nested_and_total():
+    t = PhaseTimer()
+    with t.phase("outer"):
+        with t.phase("inner"):  # re-entrant: must not deadlock or corrupt
+            pass
+    d = t.as_dict()
+    assert set(d) == {"outer", "inner"}
+    assert d["outer"] >= d["inner"]
+    assert t.total() == sum(d.values())
+    t.add("outer", 1.0)
+    assert t.as_dict()["outer"] >= 1.0
+
+
+def test_phase_timer_concurrent_threads():
+    import threading
+
+    t = PhaseTimer()
+
+    def worker(name):
+        for _ in range(200):
+            with t.phase(name):
+                pass
+
+    threads = [
+        threading.Thread(target=worker, args=(f"p{i % 2}",))
+        for i in range(4)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert set(t.as_dict()) == {"p0", "p1"}
+
+
+def test_check_devices_subprocess_timeout_verdict(monkeypatch):
+    """Degraded path: a wedged backend init must come back as a structured
+    unhealthy verdict naming the deadline, never a hang or a raise."""
+    import subprocess
+
+    from spark_rapids_ml_tpu.utils.health import check_devices_subprocess
+
+    def fake_run(*args, **kwargs):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=kwargs.get(
+            "timeout", 0.0))
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    verdict = check_devices_subprocess(timeout_seconds=0.25)
+    assert verdict.healthy is False
+    assert verdict.device_count == 0
+    assert "exceeded 0.25s" in verdict.error
+
+
+def test_check_devices_subprocess_crash_verdict(monkeypatch):
+    """Degraded path: a crashing probe child yields a structured verdict
+    carrying the child's stderr tail."""
+    import subprocess
+
+    from spark_rapids_ml_tpu.utils.health import check_devices_subprocess
+
+    class FakeProc:
+        returncode = 3
+        stdout = ""
+        stderr = "boom: device tunnel fell over"
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: FakeProc())
+    verdict = check_devices_subprocess(timeout_seconds=5)
+    assert verdict.healthy is False
+    assert "rc=3" in verdict.error
+    assert "device tunnel fell over" in verdict.error

@@ -1,0 +1,111 @@
+"""Trace spans: nesting, ring buffer, Chrome-trace export, env gating."""
+
+import json
+import time
+
+from spark_rapids_ml_tpu.obs import spans
+from spark_rapids_ml_tpu.obs.spans import SpanEvent, SpanRecorder, span
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def test_nested_spans_share_trace_id():
+    rec = spans.get_recorder()
+    rec.clear()
+    with span("outer") as tid:
+        assert spans.current_trace_id() == tid
+        with span("inner") as inner_tid:
+            assert inner_tid == tid
+    assert spans.current_trace_id() is None
+    names = [e.name for e in rec.events(tid)]
+    assert names == ["inner", "outer"]  # completion order
+    depths = {e.name: e.depth for e in rec.events(tid)}
+    assert depths == {"outer": 0, "inner": 1}
+
+
+def test_trace_range_feeds_recorder_under_span():
+    rec = spans.get_recorder()
+    rec.clear()
+    with span("fit") as tid:
+        with TraceRange("legacy-site", TraceColor.RED):
+            pass
+    by_name = {e.name: e for e in rec.events(tid)}
+    assert "legacy-site" in by_name
+    assert by_name["legacy-site"].color == "RED"
+
+
+def test_span_records_error_annotation():
+    rec = spans.get_recorder()
+    rec.clear()
+    try:
+        with span("failing") as tid:
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    (ev,) = rec.events(tid)
+    assert ev.args["error"] == "RuntimeError"
+    assert spans.current_trace_id() is None  # stack unwound
+
+
+def test_ring_buffer_bounded():
+    rec = SpanRecorder(capacity=4)
+    for i in range(10):
+        rec.record(SpanEvent(
+            name=f"s{i}", ts_us=0.0, dur_us=1.0, trace_id=None,
+            depth=0, tid=1,
+        ))
+    evs = rec.events()
+    assert len(evs) == 4
+    assert [e.name for e in evs] == ["s6", "s7", "s8", "s9"]
+
+
+def test_chrome_trace_export_valid(tmp_path):
+    rec = spans.get_recorder()
+    rec.clear()
+    with span("root", TraceColor.GREEN, phase="demo") as tid:
+        time.sleep(0.002)
+        with span("child"):
+            pass
+    path = rec.export_chrome_trace(str(tmp_path / "t.json"), trace_id=tid)
+    doc = json.loads(open(path).read())
+    events = doc["traceEvents"]
+    assert len(events) == 2
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], (int, float))
+        assert isinstance(ev["pid"], int)
+        assert ev["dur"] >= 0
+        assert ev["args"]["trace_id"] == tid
+    root = [e for e in events if e["name"] == "root"][0]
+    assert root["dur"] >= 2000  # ≥ 2ms in microseconds
+    assert root["args"]["phase"] == "demo"
+
+
+def test_maybe_export_trace_env_gated(tmp_path, monkeypatch):
+    rec = spans.get_recorder()
+    rec.clear()
+    # gate unset: no file, returns None
+    monkeypatch.delenv(spans.TRACE_DIR_ENV, raising=False)
+    with span("gated") as tid:
+        pass
+    assert spans.maybe_export_trace(tid, "algo") is None
+    # gate set: file written, loadable
+    monkeypatch.setenv(spans.TRACE_DIR_ENV, str(tmp_path))
+    path = spans.maybe_export_trace(tid, "algo/../x")  # label sanitized
+    assert path is not None and path.startswith(str(tmp_path))
+    doc = json.load(open(path))
+    assert doc["traceEvents"][0]["name"] == "gated"
+
+
+def test_trace_range_elapsed_frozen_after_exit():
+    with TraceRange("frozen") as tr:
+        time.sleep(0.002)
+    first = tr.elapsed
+    assert first >= 0.002
+    time.sleep(0.005)
+    assert tr.elapsed == first  # must not keep growing after __exit__
+    # re-entering the SAME range must drop the stale freeze and re-measure
+    with tr:
+        assert tr.elapsed < first or tr.elapsed >= 0.0
+        time.sleep(0.01)
+    assert tr.elapsed >= 0.01
+    assert tr.elapsed != first
