@@ -145,6 +145,7 @@ def main() -> None:
         probe = _probe_with_backoff()
         fallback = not probe.healthy or probe.platform == "cpu"
     fallback_reason = None
+    flight_dump_path = None
     if fallback:
         # unreachable accelerator OR a silent JAX cpu fallback (no plugin
         # installed): either way CPU can't chew the configured row count in
@@ -158,6 +159,18 @@ def main() -> None:
                 f"# accelerator unreachable ({probe.error}); benching on CPU",
                 flush=True,
             )
+            # a wedge must leave a diagnostic artifact, not just a
+            # fallback_reason string (the r04/r05 outages left nothing)
+            try:
+                from spark_rapids_ml_tpu.obs import flight
+
+                flight_dump_path = flight.dump(
+                    "accelerator_unreachable",
+                    extra={"probe": dict(probe.__dict__),
+                           "bench": "bench.py"},
+                )
+            except Exception:  # noqa: BLE001 - the bench must still run
+                pass
             os.environ["JAX_PLATFORMS"] = "cpu"
         else:
             fallback_reason = "jax platform is cpu (no accelerator plugin)"
@@ -375,6 +388,8 @@ def main() -> None:
         # artifact always holds the best-known chip truth even through a
         # tunnel outage (judge r3 task #2).
         record["fallback_reason"] = fallback_reason
+        if flight_dump_path is not None:
+            record["flight_dump"] = flight_dump_path
         best = _best_known_chip_record()
         if best is not None:
             record["best_known_chip_record"] = best

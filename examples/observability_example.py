@@ -1,13 +1,18 @@
 """Tour of the unified telemetry subsystem (`spark_rapids_ml_tpu.obs`).
 
 Runs a PCA estimator fit and a distributed PCA fit with trace export
-enabled, then shows the three observability surfaces:
+enabled, then shows the observability surfaces:
 
 1. ``fit_report_`` — the uniform per-fit artifact (phases, mesh,
-   collectives, health);
+   collectives, health), now including the XLA compile story (compile
+   wall-clock, recompile count, HLO cost-analysis FLOPs, per-phase
+   analytic MFU) and the device-memory watermark;
 2. Chrome-trace JSON files written under ``SPARK_RAPIDS_ML_TPU_TRACE_DIR``
    (load them in Perfetto / chrome://tracing);
-3. the process metrics registry, as Prometheus text and over HTTP.
+3. the process metrics registry, as Prometheus text and over HTTP;
+4. the flight recorder: a watchdog dump of thread stacks / open spans /
+   metrics under ``SPARK_RAPIDS_ML_TPU_DUMP_DIR`` when a phase overruns
+   its budget.
 
 CPU-safe: run with ``python examples/observability_example.py``.
 """
@@ -53,6 +58,24 @@ def main() -> None:
     print(f"  algo={report.algo}  rows={report.rows}  "
           f"platform={report.device_platform}  healthy={report.healthy}")
     print(f"  phases: { {k: round(v, 4) for k, v in report.phases.items()} }")
+    print("== compile report (obs.xprof via tracked_jit)")
+    print(f"  compiles={report.compiles}  recompiles={report.recompiles}  "
+          f"compile_seconds={report.compile_seconds:.3f}")
+    print(f"  analytic_flops={report.analytic_flops}  "
+          f"flops_by_phase={report.flops_by_phase}")
+    print(f"  analytic_mfu={report.analytic_mfu}  (None on CPU: no "
+          "published peak)")
+    agg = obs.compile_stats()
+    for label in sorted(agg)[:4]:
+        s = agg[label]
+        print(f"  {label}: {s['compiles']} compile(s), "
+              f"{s['compile_seconds']:.3f}s")
+    print("== device-memory watermark (obs.memory)")
+    print(f"  peak_device_bytes={report.peak_device_bytes}  "
+          f"source={(report.memory or {}).get('source')}")
+    wm = obs.memory_watermarks()
+    print(f"  live watermark: {wm['peak_bytes']} bytes "
+          f"({wm['source']}; host RSS {wm['host_peak_rss_bytes']})")
 
     mesh = data_mesh()
     res = distributed_pca_fit(x, 4, mesh)
@@ -86,6 +109,26 @@ def main() -> None:
     print(f"== scraped {len(body)} bytes from http://127.0.0.1:{port}/metrics")
     server.shutdown()
     server.server_close()
+
+    # -- 4. the flight recorder -------------------------------------------
+    import time
+
+    dump_dir = tempfile.mkdtemp(prefix="sparkml_dumps_")
+    os.environ["SPARK_RAPIDS_ML_TPU_DUMP_DIR"] = dump_dir
+    with obs.deadline("example_stalled_phase", budget_seconds=0.2):
+        time.sleep(0.8)  # overruns the budget -> watchdog dumps
+    deadline_t = time.monotonic() + 5.0
+    dumps = []
+    while not dumps and time.monotonic() < deadline_t:
+        dumps = sorted(glob.glob(os.path.join(dump_dir,
+                                              "flightdump_*.json")))
+        time.sleep(0.05)
+    print(f"== {len(dumps)} flight dump(s) in {dump_dir}")
+    if dumps:
+        doc = json.load(open(dumps[0]))
+        print(f"  reason={doc['reason']}  "
+              f"threads={len(doc['thread_stacks'])}  "
+              f"open_spans={[s['name'] for s in doc['open_spans']]}")
 
 
 if __name__ == "__main__":

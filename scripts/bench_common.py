@@ -72,10 +72,23 @@ def emit_record(record: dict, *, stream=None, include_metrics: bool = True,
     return rec
 
 
+def flight_dump(reason: str, **extra) -> str | None:
+    """Flight-recorder dump, guarded: a wedge produces a diagnostic
+    artifact (thread stacks, spans, metrics, cached health) in
+    ``SPARK_RAPIDS_ML_TPU_DUMP_DIR``, never a bench failure."""
+    try:
+        from spark_rapids_ml_tpu.obs import flight
+
+        return flight.dump(reason, extra=extra or None)
+    except Exception:  # noqa: BLE001 - dumps must never break a bench
+        return None
+
+
 def probe(tag: str):
     """Claim the chip; return the device or None (caller exits 2 so the
     wrapper loop retries). Forces the TPU backend — a silent CPU
-    fallback would burn the window measuring nothing."""
+    fallback would burn the window measuring nothing. A failed probe
+    leaves a flight-recorder dump, not just a status-log line."""
     os.environ.setdefault("JAX_PLATFORMS", "tpu")
     log(f"{tag} probe start")
     try:
@@ -84,9 +97,12 @@ def probe(tag: str):
         device = jax.devices()[0]
     except Exception as exc:  # noqa: BLE001
         log(f"{tag} probe FAILED ({type(exc).__name__})")
+        flight_dump("bench_probe_failed", tag=tag,
+                    error=f"{type(exc).__name__}: {exc}")
         return None
     if device.platform == "cpu":
         log(f"{tag} probe FAILED (cpu backend)")
+        flight_dump("bench_probe_cpu_fallback", tag=tag)
         return None
     log(f"{tag} probe ok")
     return device

@@ -26,17 +26,9 @@ from bench_common import emit_record
 
 import numpy as np
 
-
-def _peak_bytes(device) -> int | None:
-    try:
-        stats = device.memory_stats()
-    except Exception:  # noqa: BLE001 - backends without stats
-        return None
-    if not stats:
-        return None
-    return int(
-        stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
-    ) or None
+# the ONE watermark reader all benches share (obs/memory.py); the ad-hoc
+# device.memory_stats() parsing that used to live here is retired
+from spark_rapids_ml_tpu.obs.memory import peak_bytes_in_use as _peak_bytes
 
 
 def main() -> None:

@@ -1,11 +1,18 @@
 #!/usr/bin/env python
 """Static check: every distributed driver uses the shared instrumentation.
 
-Walks ``spark_rapids_ml_tpu/parallel/distributed_*.py`` and requires that
-every module-level public entry point (a ``distributed_*`` function that is
-not a ``*_kernel``) carries the ``@fit_instrumentation(...)`` decorator from
-``spark_rapids_ml_tpu.obs``. New drivers therefore cannot silently ship
-unobserved: tier-1 runs this via ``tests/test_obs_reports.py``.
+Two rules over ``spark_rapids_ml_tpu/parallel/distributed_*.py``:
+
+1. every module-level public entry point (a ``distributed_*`` function that
+   is not a ``*_kernel``) carries the ``@fit_instrumentation(...)``
+   decorator from ``spark_rapids_ml_tpu.obs``;
+2. no jitted entry point uses raw ``jax.jit`` — every jit decoration (and
+   every ``jax.jit(...)`` call) must go through ``obs.tracked_jit`` /
+   ``track_compiles``, so compile time, recompiles, and HLO cost analysis
+   are observable for every driver program.
+
+New drivers therefore cannot silently ship unobserved: tier-1 runs this
+via ``tests/test_obs_reports.py``.
 
 Pure ``ast`` — no jax import, no package import, so it runs anywhere in
 milliseconds. Exit 0 = all instrumented; exit 1 = offenders listed on
@@ -42,6 +49,40 @@ def _is_entry_point(fn: ast.FunctionDef) -> bool:
     )
 
 
+def _jax_aliases(tree: ast.Module):
+    """Names the module binds to the jax package (``import jax``,
+    ``import jax as j``) — so aliased ``j.jit`` can't evade the check."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax" or a.name.startswith("jax."):
+                    aliases.add((a.asname or a.name).split(".")[0])
+    return aliases or {"jax"}
+
+
+def _jit_name_imports(tree: ast.Module):
+    """Bare names bound to ``jax.jit`` via ``from jax import jit [as x]``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "jit":
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _is_raw_jit(node: ast.AST, aliases, jit_names) -> bool:
+    """A raw-jit reference in any spelling: ``jax.jit`` / ``j.jit``
+    attribute access, or a bare name imported from jax — whether used as a
+    decorator, a ``partial`` argument, or a direct call."""
+    if (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in aliases):
+        return True
+    return isinstance(node, ast.Name) and node.id in jit_names
+
+
 def check_file(path: str):
     """Yield (lineno, name) for every uninstrumented entry point."""
     tree = ast.parse(open(path).read(), filename=path)
@@ -52,6 +93,17 @@ def check_file(path: str):
             continue
         if DECORATOR_NAME not in set(_decorator_names(node)):
             yield node.lineno, node.name
+
+
+def check_raw_jit(path: str):
+    """Yield (lineno, context) for every raw ``jax.jit`` use anywhere in a
+    driver module — decorator, ``partial`` argument, or direct call."""
+    tree = ast.parse(open(path).read(), filename=path)
+    aliases = _jax_aliases(tree)
+    jit_names = _jit_name_imports(tree)
+    for node in ast.walk(tree):
+        if _is_raw_jit(node, aliases, jit_names):
+            yield node.lineno, "raw jax.jit (use obs.tracked_jit)"
 
 
 def main() -> int:
@@ -70,18 +122,18 @@ def main() -> int:
         ]
         checked += len(entry_points)
         for lineno, name in check_file(path):
-            offenders.append(f"{rel}:{lineno} {name}")
+            offenders.append(f"{rel}:{lineno} {name} "
+                             f"(missing @{DECORATOR_NAME})")
+        for lineno, why in check_raw_jit(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
-        print(
-            f"{len(offenders)} distributed driver(s) missing "
-            f"@{DECORATOR_NAME}:"
-        )
+        print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
             print(f"  {line}")
         return 1
     print(
         f"OK: {checked} distributed entry point(s) across {len(files)} "
-        "driver module(s) all instrumented"
+        f"driver module(s) all instrumented; all jit sites tracked"
     )
     return 0
 

@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Offline perf-regression sentinel: verdicts over bench records.
+
+Compares one bench record (any ``emit_record`` JSON line, a
+``BENCH_r0N.json`` driver wrapper, or a ``BENCH_MEASURED*.json`` headline)
+against the repo's committed measurement history (``BENCH_MEASURED*.json``,
+``BENCH_r0*.json``, ``records/**/*.json``) and emits ONE structured verdict
+line::
+
+    {"verdict": "PASS|REGRESSED|STALE|NO_BASELINE", ...}
+
+Verdicts:
+
+* **PASS** — value within (or better than) the noise band around the
+  comparable baseline (same metric, same platform).
+* **REGRESSED** — value worse than the band. Exit 1.
+* **STALE** — the record is NOT comparable to the best-known baseline: a
+  CPU fallback run (``fallback_reason`` / a ``best_known_chip_record``
+  marked stale) or a platform mismatch against a chip-measured history.
+  This is the r05 situation — a wedged tunnel must read as "chip baseline
+  is stale", never as a 679× regression. Exit 2.
+* **NO_BASELINE** — no history for this metric at all. Exit 3.
+
+The noise band is ``max(--tolerance, 2·MAD/median)`` over the historical
+values for (metric, platform): single-sample histories fall back to the
+tolerance (default 15% — measured round-to-round jitter on the chip
+records), multi-sample histories widen to the observed spread.
+
+Usage::
+
+    python scripts/perf_sentinel.py BENCH_r05.json
+    python scripts/perf_sentinel.py record.json --tolerance 0.1
+    some_bench | python scripts/perf_sentinel.py -
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXIT_CODES = {"PASS": 0, "REGRESSED": 1, "STALE": 2, "NO_BASELINE": 3}
+DEFAULT_TOLERANCE = 0.15
+
+
+# -- record extraction -----------------------------------------------------
+
+
+def _is_record(obj) -> bool:
+    return (isinstance(obj, dict) and "metric" in obj
+            and obj.get("value") is not None)
+
+
+def extract_record(obj) -> Optional[Dict[str, Any]]:
+    """The measurement record inside any of the repo's bench artifact
+    shapes: a raw record, a BENCH_rN driver wrapper ({"parsed": ...}),
+    or a BENCH_MEASURED composite ({"headline": ...})."""
+    if _is_record(obj):
+        return obj
+    if isinstance(obj, dict):
+        for key in ("parsed", "headline"):
+            inner = obj.get(key)
+            if _is_record(inner):
+                return inner
+    return None
+
+
+def load_candidate(path: str) -> Dict[str, Any]:
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    try:
+        rec = extract_record(json.loads(text))
+        if rec is not None:
+            return rec
+    except ValueError:
+        pass
+    # JSON-lines: last parseable record wins (emit_record's final-line
+    # contract)
+    rec = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            parsed = extract_record(json.loads(line))
+        except ValueError:
+            continue
+        if parsed is not None:
+            rec = parsed
+    if rec is None:
+        raise SystemExit(f"no bench record found in {path!r}")
+    return rec
+
+
+# -- history ---------------------------------------------------------------
+
+
+def iter_history(root: str, exclude: Optional[str] = None
+                 ) -> List[Dict[str, Any]]:
+    """Every committed measurement record, tagged with its source file."""
+    out: List[Dict[str, Any]] = []
+    exclude_real = os.path.realpath(exclude) if exclude else None
+
+    def _add(obj, source, when=None):
+        rec = extract_record(obj)
+        if rec is not None:
+            entry = dict(rec)
+            entry["_source"] = source
+            if when and "measured_utc" not in entry:
+                entry["_measured_utc"] = when
+            out.append(entry)
+
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_MEASURED*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "BENCH_r[0-9]*.json")))
+    for path in paths:
+        if exclude_real and os.path.realpath(path) == exclude_real:
+            continue
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        rel = os.path.relpath(path, root)
+        when = doc.get("collected_utc") if isinstance(doc, dict) else None
+        if isinstance(doc, dict):
+            _add(doc, rel, when)
+            # BENCH_MEASURED composites: every named sub-record counts
+            for key, val in doc.items():
+                if key in ("parsed", "headline"):
+                    continue
+                if _is_record(val):
+                    _add(val, f"{rel}#{key}", when)
+    for path in sorted(glob.glob(os.path.join(root, "records", "**",
+                                              "*.json"), recursive=True)):
+        if exclude_real and os.path.realpath(path) == exclude_real:
+            continue
+        rel = os.path.relpath(path, root)
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                _add(json.loads(line), rel)
+            except ValueError:
+                continue
+    return out
+
+
+# -- verdict logic ---------------------------------------------------------
+
+
+def higher_is_better(record: Dict[str, Any]) -> bool:
+    text = f"{record.get('unit', '')} {record.get('metric', '')}".lower()
+    if "rows/sec" in text or "/sec" in text:
+        return True
+    if "second" in text:
+        return False
+    return True  # throughput-style by default
+
+
+def _median(values: List[float]) -> float:
+    vs = sorted(values)
+    n = len(vs)
+    mid = n // 2
+    return vs[mid] if n % 2 else 0.5 * (vs[mid - 1] + vs[mid])
+
+
+def noise_band(values: List[float], tolerance: float) -> float:
+    """Relative half-width of the acceptance band around the median."""
+    if len(values) < 2:
+        return tolerance
+    med = _median(values)
+    if not med:
+        return tolerance
+    mad = _median([abs(v - med) for v in values])
+    return max(tolerance, 2.0 * mad / abs(med))
+
+
+def _is_fallback(record: Dict[str, Any]) -> bool:
+    if record.get("fallback_reason"):
+        return True
+    best = record.get("best_known_chip_record")
+    return bool(isinstance(best, dict) and best.get("stale"))
+
+
+def judge(record: Dict[str, Any], history: List[Dict[str, Any]],
+          tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
+    """The sentinel verdict for one record against the history."""
+    metric = record.get("metric")
+    platform = record.get("platform")
+    value = float(record["value"])
+    same_metric = [h for h in history if h.get("metric") == metric]
+    verdict: Dict[str, Any] = {
+        "metric": metric,
+        "value": value,
+        "unit": record.get("unit"),
+        "platform": platform,
+    }
+
+    if not same_metric:
+        verdict.update(
+            verdict="NO_BASELINE",
+            reason=f"no committed history for metric {metric!r}",
+        )
+        return verdict
+
+    chip_history = [h for h in same_metric
+                    if h.get("platform") not in (None, "cpu")]
+    if _is_fallback(record) or (
+        platform == "cpu" and chip_history
+    ):
+        # The r04/r05 situation: a fallback (or platform-mismatched) run
+        # can NEVER regress or clear a chip baseline — the baseline is
+        # stale, which is its own first-class state.
+        pick = max if higher_is_better(record) else min
+        best = pick(chip_history, key=lambda h: float(h["value"]),
+                    default=None) if chip_history else None
+        stale_baseline = record.get("best_known_chip_record") or (
+            {
+                "value": float(best["value"]),
+                "platform": best.get("platform"),
+                "source": best.get("_source"),
+                "measured_utc": best.get("measured_utc")
+                or best.get("_measured_utc"),
+            } if best else None
+        )
+        verdict.update(
+            verdict="STALE",
+            reason=(
+                f"record is a {platform or 'non-chip'} fallback run "
+                f"({record.get('fallback_reason') or 'platform mismatch'}); "
+                "the chip baseline is stale, not regressed — re-measure on "
+                "the chip before trusting either number"
+            ),
+            stale_baseline=stale_baseline,
+        )
+        return verdict
+
+    # Untagged history (older records without a platform field — the r04
+    # bench_models/gram_sweep lines were all chip runs) counts as
+    # comparable for accelerator candidates; CPU candidates only ever
+    # compare against explicitly-CPU history.
+    if platform == "cpu":
+        comparable = [h for h in same_metric if h.get("platform") == "cpu"]
+    else:
+        comparable = [h for h in same_metric
+                      if h.get("platform") in (platform, None)]
+    if not comparable:
+        verdict.update(
+            verdict="NO_BASELINE",
+            reason=(
+                f"history for {metric!r} exists only on other platforms "
+                f"({sorted({h.get('platform') for h in same_metric})})"
+            ),
+        )
+        return verdict
+
+    values = [float(h["value"]) for h in comparable]
+    center = _median(values)
+    band = noise_band(values, tolerance)
+    hib = higher_is_better(record)
+    floor = center * (1.0 - band)
+    ceil = center * (1.0 + band)
+    ratio = value / center if center else None
+    baseline = {
+        "value": center,
+        "n_samples": len(values),
+        "sources": sorted({h.get("_source") for h in comparable})[:8],
+        "platform": platform,
+    }
+    verdict.update(
+        baseline=baseline,
+        band={"relative": round(band, 4), "low": floor, "high": ceil},
+        ratio=round(ratio, 4) if ratio is not None else None,
+        higher_is_better=hib,
+    )
+    regressed = value < floor if hib else value > ceil
+    if regressed:
+        verdict.update(
+            verdict="REGRESSED",
+            reason=(
+                f"value {value:g} is {'below' if hib else 'above'} the "
+                f"noise band ({floor:g} .. {ceil:g}) around the "
+                f"{len(values)}-sample baseline median {center:g}"
+            ),
+        )
+    else:
+        verdict.update(
+            verdict="PASS",
+            reason=(
+                f"value {value:g} is within/beyond the noise band "
+                f"({floor:g} .. {ceil:g}) of baseline median {center:g}"
+            ),
+        )
+    return verdict
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("record", help="record file (or '-' for stdin): an "
+                        "emit_record line, BENCH_rN wrapper, or "
+                        "BENCH_MEASURED composite")
+    parser.add_argument("--history-root", default=REPO,
+                        help="repo root holding BENCH_MEASURED*/records/ "
+                        "(default: this repo)")
+    parser.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                        help="minimum relative noise band (default 0.15)")
+    parser.add_argument("--indent", type=int, default=None,
+                        help="pretty-print the verdict JSON")
+    args = parser.parse_args(argv)
+
+    record = load_candidate(args.record)
+    exclude = None if args.record == "-" else args.record
+    history = iter_history(args.history_root, exclude=exclude)
+    verdict = judge(record, history, tolerance=args.tolerance)
+    print(json.dumps(verdict, indent=args.indent, default=str))
+    return EXIT_CODES[verdict["verdict"]]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

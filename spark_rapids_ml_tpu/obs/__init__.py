@@ -29,12 +29,43 @@ from spark_rapids_ml_tpu.obs.spans import (  # noqa: F401
     SpanEvent,
     SpanRecorder,
     TRACE_DIR_ENV,
+    active_spans,
     current_trace_id,
     get_recorder,
     maybe_export_trace,
     new_trace_id,
     span,
 )
+from spark_rapids_ml_tpu.obs.xprof import (  # noqa: F401
+    CompileEvent,
+    STORM_ENV,
+    TrackedJit,
+    analytic_mfu,
+    compile_log,
+    compile_stats,
+    peak_flops_per_second,
+    reset_compile_log,
+    track_compiles,
+    tracked_jit,
+)
+from spark_rapids_ml_tpu.obs.memory import (  # noqa: F401
+    device_memory_stats,
+    host_peak_rss_bytes,
+    memory_watermarks,
+    peak_bytes_in_use,
+    record_memory_metrics,
+)
+from spark_rapids_ml_tpu.obs.flight import (  # noqa: F401
+    DUMP_DIR_ENV,
+    FIT_BUDGET_ENV,
+    Watchdog,
+    build_dump,
+    deadline,
+    dump,
+    dump_dir,
+    get_watchdog,
+)
+from spark_rapids_ml_tpu.obs import flight  # noqa: F401
 from spark_rapids_ml_tpu.obs.report import (  # noqa: F401
     FitContext,
     FitReport,
@@ -61,9 +92,12 @@ from spark_rapids_ml_tpu.utils.health import (  # noqa: F401
 )
 
 __all__ = [
+    "CompileEvent",
     "Counter",
     "DEFAULT_BUCKETS",
+    "DUMP_DIR_ENV",
     "DeviceHealth",
+    "FIT_BUDGET_ENV",
     "FitContext",
     "FitReport",
     "Gauge",
@@ -71,24 +105,46 @@ __all__ = [
     "MetricsRegistry",
     "PhaseTimer",
     "REPORT_ATTR",
+    "STORM_ENV",
     "SpanEvent",
     "SpanRecorder",
     "TRACE_DIR_ENV",
     "TraceColor",
     "TraceRange",
+    "TrackedJit",
+    "Watchdog",
+    "active_spans",
+    "analytic_mfu",
     "attach_report",
+    "build_dump",
     "check_devices",
     "check_devices_subprocess",
+    "compile_log",
+    "compile_stats",
     "current_fit",
     "current_trace_id",
+    "deadline",
+    "device_memory_stats",
+    "dump",
+    "dump_dir",
     "fit_instrumentation",
+    "flight",
     "get_recorder",
     "get_registry",
+    "get_watchdog",
+    "host_peak_rss_bytes",
     "last_fit_report",
     "maybe_export_trace",
+    "memory_watermarks",
     "new_trace_id",
     "observed_fit",
     "observed_transform",
+    "peak_bytes_in_use",
+    "peak_flops_per_second",
+    "record_memory_metrics",
+    "reset_compile_log",
     "span",
     "start_prometheus_server",
+    "track_compiles",
+    "tracked_jit",
 ]

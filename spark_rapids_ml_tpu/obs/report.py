@@ -62,6 +62,18 @@ class FitReport:
     health: Optional[Dict[str, Any]] = None
     collectives: Dict[str, Dict[str, int]] = field(default_factory=dict)
     n_iter: Optional[int] = None
+    # XLA compile attribution (obs.xprof tracked_jit accounting)
+    compiles: int = 0
+    recompiles: int = 0
+    compile_seconds: float = 0.0
+    # HLO cost-analysis accounting over every tracked program this fit ran
+    analytic_flops: Optional[float] = None
+    analytic_bytes: Optional[float] = None
+    flops_by_phase: Dict[str, float] = field(default_factory=dict)
+    analytic_mfu: Optional[float] = None
+    # Device-memory watermark (obs.memory; host RSS on statless backends)
+    peak_device_bytes: Optional[int] = None
+    memory: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def as_dict(self) -> Dict[str, Any]:
@@ -78,6 +90,24 @@ class FitReport:
     def total_collective_calls(self) -> int:
         return sum(int(v.get("count", 0)) for v in self.collectives.values())
 
+    def phase_mfu(self, peak_flops: Optional[float] = None
+                  ) -> Dict[str, Optional[float]]:
+        """Per-phase analytic MFU: cost-analysis FLOPs attributed to each
+        phase over that phase's wall-clock over the chip peak (None entries
+        when the peak or the phase time is unknown)."""
+        if peak_flops is None:
+            from spark_rapids_ml_tpu.obs.xprof import peak_flops_per_second
+
+            peak_flops = peak_flops_per_second()
+        out: Dict[str, Optional[float]] = {}
+        for phase, flops in self.flops_by_phase.items():
+            seconds = self.phases.get(phase)
+            if peak_flops and seconds:
+                out[phase] = flops / seconds / peak_flops
+            else:
+                out[phase] = None
+        return out
+
 
 class FitContext:
     """Mutable accounting for one in-flight fit.
@@ -90,6 +120,9 @@ class FitContext:
     __slots__ = (
         "algo", "trace_id", "timer", "collectives", "extra",
         "rows", "features", "bytes_processed", "n_iter", "_lock",
+        "compiles", "recompiles", "compile_seconds",
+        "analytic_flops", "analytic_bytes", "flops_by_phase",
+        "_phase_stack",
     )
 
     def __init__(self, algo: str, trace_id: Optional[str] = None):
@@ -102,6 +135,13 @@ class FitContext:
         self.features: Optional[int] = None
         self.bytes_processed: Optional[int] = None
         self.n_iter: Optional[int] = None
+        self.compiles = 0
+        self.recompiles = 0
+        self.compile_seconds = 0.0
+        self.analytic_flops = 0.0
+        self.analytic_bytes = 0.0
+        self.flops_by_phase: Dict[str, float] = {}
+        self._phase_stack: Tuple[str, ...] = ()
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -110,7 +150,41 @@ class FitContext:
         with self.timer.phase(name), spans.span(
             f"{self.algo}:{name}", TraceColor.CYAN
         ):
-            yield
+            # NOTE: the phase stack attributes tracked-program FLOPs to the
+            # innermost phase of whichever thread entered it last; drivers
+            # run phases sequentially on one thread, which is the contract.
+            prev = self._phase_stack
+            self._phase_stack = prev + (name,)
+            try:
+                yield
+            finally:
+                self._phase_stack = prev
+
+    def record_compile(self, label: str, seconds: float, *,
+                       recompile: bool = False) -> None:
+        """Called by ``obs.xprof`` when a tracked function compiles during
+        this fit."""
+        with self._lock:
+            self.compiles += 1
+            if recompile:
+                self.recompiles += 1
+            self.compile_seconds += float(seconds)
+
+    def record_program(self, label: str, flops: Optional[float],
+                       nbytes: Optional[float]) -> None:
+        """Called by ``obs.xprof`` on every tracked-program execution:
+        accumulates HLO cost-analysis FLOPs/bytes, attributed to the
+        innermost active phase."""
+        with self._lock:
+            if flops:
+                self.analytic_flops += float(flops)
+                phase = self._phase_stack[-1] if self._phase_stack \
+                    else "_unphased"
+                self.flops_by_phase[phase] = (
+                    self.flops_by_phase.get(phase, 0.0) + float(flops)
+                )
+            if nbytes:
+                self.analytic_bytes += float(nbytes)
 
     def record_collective(
         self,
@@ -171,6 +245,12 @@ class _NullFitContext(FitContext):
         yield
 
     def record_collective(self, *args, **kwargs) -> None:
+        pass
+
+    def record_compile(self, *args, **kwargs) -> None:
+        pass
+
+    def record_program(self, *args, **kwargs) -> None:
         pass
 
     def set_data(self, *args, **kwargs) -> None:
@@ -312,6 +392,21 @@ def _mesh_fields(mesh) -> Dict[str, Any]:
         return {}
 
 
+def _memory_fields() -> Dict[str, Any]:
+    """End-of-fit device-memory watermark (PJRT peak, host RSS fallback)."""
+    try:
+        from spark_rapids_ml_tpu.obs.memory import (
+            memory_watermarks,
+            record_memory_metrics,
+        )
+
+        wm = memory_watermarks()
+        record_memory_metrics(wm)
+        return {"peak_device_bytes": wm.get("peak_bytes"), "memory": wm}
+    except Exception:
+        return {}
+
+
 def _build_report(
     ctx: FitContext, started: str, wall: float, mesh
 ) -> FitReport:
@@ -322,6 +417,13 @@ def _build_report(
     if health:
         fields.setdefault("device_platform", health.get("platform"))
         fields.setdefault("device_count", health.get("device_count"))
+    fields.update(_memory_fields())
+    try:
+        from spark_rapids_ml_tpu.obs.xprof import analytic_mfu
+
+        mfu = analytic_mfu(ctx.analytic_flops, wall)
+    except Exception:
+        mfu = None
     return FitReport(
         algo=ctx.algo,
         trace_id=ctx.trace_id,
@@ -335,9 +437,26 @@ def _build_report(
         health=health,
         collectives={k: dict(v) for k, v in ctx.collectives.items()},
         n_iter=ctx.n_iter,
+        compiles=ctx.compiles,
+        recompiles=ctx.recompiles,
+        compile_seconds=ctx.compile_seconds,
+        analytic_flops=ctx.analytic_flops or None,
+        analytic_bytes=ctx.analytic_bytes or None,
+        flops_by_phase=dict(ctx.flops_by_phase),
+        analytic_mfu=mfu,
         extra=dict(ctx.extra),
         **fields,
     )
+
+
+def _flight_deadline(algo: str, trace_id: str):
+    """The watchdog context for one fit (no-op if flight is unavailable)."""
+    try:
+        from spark_rapids_ml_tpu.obs import flight
+
+        return flight.deadline(f"fit:{algo}", trace_id=trace_id)
+    except Exception:
+        return contextlib.nullcontext()
 
 
 def _record_metrics(report: FitReport) -> None:
@@ -346,6 +465,21 @@ def _record_metrics(report: FitReport) -> None:
     reg.counter(
         "sparkml_fits_total", "completed fits", ("algo",)
     ).inc(algo=algo)
+    if report.compiles:
+        reg.counter(
+            "sparkml_fit_compiles_total",
+            "XLA compilations attributed to fits", ("algo",),
+        ).inc(report.compiles, algo=algo)
+    if report.recompiles:
+        reg.counter(
+            "sparkml_fit_recompiles_total",
+            "XLA re-compilations attributed to fits", ("algo",),
+        ).inc(report.recompiles, algo=algo)
+    if report.analytic_flops:
+        reg.counter(
+            "sparkml_analytic_flops_total",
+            "HLO cost-analysis FLOPs executed by fits", ("algo",),
+        ).inc(report.analytic_flops, algo=algo)
     reg.histogram(
         "sparkml_fit_seconds", "fit wall-clock seconds", ("algo",)
     ).observe(report.wall_seconds, algo=algo)
@@ -456,7 +590,7 @@ def fit_instrumentation(algo: str, attach: bool = True):
             started = _utcnow()
             t0 = time.perf_counter()
             try:
-                with spans.span(
+                with _flight_deadline(algo, ctx.trace_id), spans.span(
                     f"fit:{algo}", TraceColor.GREEN, trace_id=ctx.trace_id
                 ), ctx.timer.phase("total"):
                     result = fn(*args, **kwargs)
@@ -494,7 +628,7 @@ def observed_fit(algo: str):
             started = _utcnow()
             t0 = time.perf_counter()
             try:
-                with spans.span(
+                with _flight_deadline(algo, ctx.trace_id), spans.span(
                     f"fit:{algo}", TraceColor.GREEN, trace_id=ctx.trace_id
                 ):
                     model = method(self, dataset, *args, **kwargs)

@@ -136,6 +136,50 @@ _stack: contextvars.ContextVar = contextvars.ContextVar(
     "sparkml_span_stack", default=()
 )
 
+# Cross-thread registry of OPEN spans (the flight recorder reads it from
+# the watchdog thread, where contextvars of the stalled thread are
+# invisible): id(token) -> info dict, guarded by one lock.
+_active_lock = threading.Lock()
+_active: Dict[int, Dict[str, Any]] = {}
+_active_seq = 0
+
+
+def active_spans() -> List[Dict[str, Any]]:
+    """Every currently-open span across all threads (oldest first):
+    ``{name, trace_id, tid, started_monotonic, elapsed_seconds}``."""
+    now = time.perf_counter()
+    with _active_lock:
+        entries = sorted(_active.values(), key=lambda e: e["seq"])
+        return [
+            {
+                "name": e["name"],
+                "trace_id": e["trace_id"],
+                "tid": e["tid"],
+                "elapsed_seconds": now - e["t0"],
+            }
+            for e in entries
+        ]
+
+
+def _activate(name: str, trace_id: str, t0: float) -> int:
+    global _active_seq
+    with _active_lock:
+        _active_seq += 1
+        handle = _active_seq
+        _active[handle] = {
+            "seq": handle,
+            "name": name,
+            "trace_id": trace_id,
+            "tid": threading.get_ident(),
+            "t0": t0,
+        }
+    return handle
+
+
+def _deactivate(handle: int) -> None:
+    with _active_lock:
+        _active.pop(handle, None)
+
 
 def current_trace_id() -> Optional[str]:
     st = _stack.get()
@@ -183,6 +227,7 @@ def span(
     rng = TraceRange(name, color, record=False)
     rng.__enter__()
     t0 = time.perf_counter()
+    active_handle = _activate(name, tid_, t0)
     error_type: Optional[str] = None
     try:
         yield tid_
@@ -191,6 +236,7 @@ def span(
         raise
     finally:
         t1 = time.perf_counter()
+        _deactivate(active_handle)
         rng.__exit__(None, None, None)
         _stack.reset(token)
         args = dict(attrs)

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from spark_rapids_ml_tpu.obs.xprof import tracked_jit
+
 
 class KMeansResult(NamedTuple):
     centers: jnp.ndarray      # (k, n_features)
@@ -43,7 +45,7 @@ def assign_clusters(x: jnp.ndarray, centers: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmin(_pairwise_sqdist(x, centers), axis=1)
 
 
-@partial(jax.jit, static_argnames=("n_clusters",))
+@partial(tracked_jit, static_argnames=("n_clusters",))
 def kmeans_plus_plus_init(
     x: jnp.ndarray,
     n_clusters: int,
@@ -153,7 +155,7 @@ def lloyd_iterations(
     return KMeansResult(centers, cost, n_iter, converged)
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@partial(tracked_jit, donate_argnums=(0,))
 def update_cluster_stats(
     carry,
     centers: jnp.ndarray,
@@ -176,7 +178,7 @@ def update_cluster_stats(
     return sums + s, counts + c.astype(counts.dtype), cost + co
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
+@partial(tracked_jit, static_argnames=("max_iter",))
 def kmeans_fit_kernel(
     x: jnp.ndarray,
     init_centers: jnp.ndarray,

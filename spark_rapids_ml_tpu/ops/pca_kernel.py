@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from spark_rapids_ml_tpu.obs.xprof import tracked_jit
 from spark_rapids_ml_tpu.ops.covariance import column_means, covariance
 from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
 
@@ -32,7 +33,7 @@ class PCAFitResult(NamedTuple):
 
 
 @partial(
-    jax.jit,
+    tracked_jit,
     static_argnames=("k", "mean_centering", "flip_signs", "solver",
                      "precision"),
 )
@@ -66,7 +67,7 @@ def pca_fit_kernel(
     return PCAFitResult(components, evr, mean)
 
 
-@jax.jit
+@tracked_jit
 def pca_transform_kernel(
     x: jnp.ndarray, components: jnp.ndarray
 ) -> jnp.ndarray:

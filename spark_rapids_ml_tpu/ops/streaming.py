@@ -21,6 +21,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from spark_rapids_ml_tpu.obs.xprof import tracked_jit
 from spark_rapids_ml_tpu.ops.covariance import covariance_from_stats, partial_gram_stats
 from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
 from spark_rapids_ml_tpu.ops.pca_kernel import PCAFitResult
@@ -48,7 +49,7 @@ def init_stats(n_features: int, dtype=jnp.float32, device=None) -> GramStats:
     return stats
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnames=("precision",))
+@partial(tracked_jit, donate_argnums=(0,), static_argnames=("precision",))
 def update_stats(
     stats: GramStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None,
     precision: Optional[str] = None,
@@ -62,7 +63,7 @@ def update_stats(
 
 
 @partial(
-    jax.jit, static_argnames=("k", "mean_centering", "flip_signs", "solver")
+    tracked_jit, static_argnames=("k", "mean_centering", "flip_signs", "solver")
 )
 def finalize_stats(
     stats: GramStats,
@@ -88,7 +89,7 @@ def finalize_stats(
     return PCAFitResult(components, evr, mean)
 
 
-@partial(jax.jit, donate_argnums=(0,),
+@partial(tracked_jit, donate_argnums=(0,),
          static_argnames=("bn", "br", "precision"))
 def _update_stats_fused_blocked(stats: GramStats, batch: jnp.ndarray,
                                 *, bn: int, br: int,
@@ -211,7 +212,7 @@ class MeanStats(NamedTuple):
     count: jnp.ndarray
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@partial(tracked_jit, donate_argnums=(0,))
 def update_mean_stats(
     stats: MeanStats, batch: jnp.ndarray, mask: Optional[jnp.ndarray] = None
 ) -> MeanStats:
@@ -224,7 +225,7 @@ def update_mean_stats(
     )
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnames=("precision",))
+@partial(tracked_jit, donate_argnums=(0,), static_argnames=("precision",))
 def update_centered_gram(
     gram_acc: jnp.ndarray,
     batch: jnp.ndarray,
@@ -238,7 +239,7 @@ def update_centered_gram(
     return gram_acc + gram(_masked(b, mask), precision=precision)
 
 
-@partial(jax.jit, donate_argnums=(0,),
+@partial(tracked_jit, donate_argnums=(0,),
          static_argnames=("bn", "br", "precision"))
 def _update_centered_gram_fused_blocked(gram_acc, batch, mean, *, bn, br,
                                         precision=None):

@@ -26,7 +26,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.als_kernel import _solve_side
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, collective_nbytes
 
@@ -92,7 +96,7 @@ def distributed_als_fit(
     reg_dev = jnp.asarray(reg, dtype=dtype)
     alpha_dev = jnp.asarray(alpha, dtype=dtype)
 
-    @jax.jit  # compile the SPMD program once; bare shard_map re-traces
+    @tracked_jit  # compile the SPMD program once; bare shard_map re-traces
     @partial(jax.shard_map, mesh=mesh,
              in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
                        P(DATA_AXIS, None), P(DATA_AXIS, None),

@@ -23,7 +23,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.kmeans_kernel import lloyd_iterations
 from spark_rapids_ml_tpu.parallel.distributed_kmeans import (
     _global_kmeans_pp,
@@ -42,7 +46,7 @@ class BisectingKMeansResult(NamedTuple):
     labels: np.ndarray          # (n_rows,) compact center index per row
 
 
-@partial(jax.jit, static_argnames=("mesh", "max_iter"))
+@partial(tracked_jit, static_argnames=("mesh", "max_iter"))
 def _bisect_split_kernel(
     x: jnp.ndarray,
     mask: jnp.ndarray,

@@ -26,7 +26,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.knn_kernel import pairwise_sqdist
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -35,7 +39,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("min_pts", "inner_block", "mesh"))
+@partial(tracked_jit, static_argnames=("min_pts", "inner_block", "mesh"))
 def _sharded_dbscan(x, valid, eps, min_pts: int, inner_block: int,
                     mesh: Mesh):
     n = x.shape[0]

@@ -19,7 +19,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.forest_kernel import (
     TreeEnsemble,
     grow_tree_classification,
@@ -34,7 +38,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 
 
 @partial(
-    jax.jit,
+    tracked_jit,
     static_argnames=("max_depth", "n_bins", "min_leaf", "n_classes", "mesh"),
 )
 def _sharded_grow(

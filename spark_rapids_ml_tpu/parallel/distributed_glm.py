@@ -20,7 +20,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.glm_kernel import (
     GlmStepOut,
     irls_step_math,
@@ -34,7 +38,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("mesh", "family", "link", "var_power",
+@partial(tracked_jit, static_argnames=("mesh", "family", "link", "var_power",
                                    "link_power", "use_init_mu"))
 def distributed_glm_step_kernel(
     x: jnp.ndarray,

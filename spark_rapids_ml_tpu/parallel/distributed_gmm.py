@@ -21,7 +21,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.gmm_kernel import (
     GmmStats,
     estep_stats_math,
@@ -35,7 +39,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("mesh",))
+@partial(tracked_jit, static_argnames=("mesh",))
 def distributed_gmm_stats_kernel(
     x: jnp.ndarray,
     w: jnp.ndarray,

@@ -27,7 +27,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.knn_kernel import ivf_search, ivfpq_search, knn_merge
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, collective_nbytes
 
@@ -43,7 +47,7 @@ def _pad_lists(arr: np.ndarray, nlist_padded: int, axis: int, fill=0):
     return np.pad(arr, widths, constant_values=fill)
 
 
-@partial(jax.jit, static_argnames=("k", "nprobe", "mesh"))
+@partial(tracked_jit, static_argnames=("k", "nprobe", "mesh"))
 def _sharded_ivf_flat(queries, centroids, b_items, b_ids, b_mask,
                       k: int, nprobe: int, mesh: Mesh):
     def per_shard(q, cent, items, ids, mask):
@@ -66,7 +70,7 @@ def _sharded_ivf_flat(queries, centroids, b_items, b_ids, b_mask,
     )(queries, centroids, b_items, b_ids, b_mask)
 
 
-@partial(jax.jit, static_argnames=("k", "nprobe", "mesh"))
+@partial(tracked_jit, static_argnames=("k", "nprobe", "mesh"))
 def _sharded_ivf_pq(queries, centroids, codebooks, b_codes, b_ids, b_mask,
                     k: int, nprobe: int, mesh: Mesh):
     def per_shard(q, cent, books, codes, ids, mask):

@@ -18,7 +18,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jax import lax
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.kmeans_kernel import (
     KMeansResult,
     lloyd_iterations,
@@ -84,7 +88,7 @@ def _global_kmeans_pp(x_shard, mask_shard, key, n_clusters: int):
 
 
 @partial(
-    jax.jit, static_argnames=("mesh", "n_clusters", "max_iter")
+    tracked_jit, static_argnames=("mesh", "n_clusters", "max_iter")
 )
 def distributed_kmeans_fit_kernel(
     x: jnp.ndarray,

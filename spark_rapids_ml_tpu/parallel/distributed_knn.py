@@ -25,7 +25,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.knn_kernel import knn_merge, pairwise_sqdist
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -35,7 +39,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("k", "mesh"))
+@partial(tracked_jit, static_argnames=("k", "mesh"))
 def _sharded_knn(queries, items_padded, item_mask, k: int, mesh: Mesh):
     def per_shard(q, x_shard, mask_shard):
         d2 = pairwise_sqdist(q, x_shard, mask_shard)

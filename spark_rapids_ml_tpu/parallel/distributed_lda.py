@@ -21,7 +21,11 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.lda_kernel import (
     dirichlet_expectation,
     e_step_kernel,
@@ -63,7 +67,7 @@ def distributed_lda_fit(
     lam = jnp.asarray(rng.gamma(100.0, 1.0 / 100.0, (k, vocab)),
                       dtype=dtype)
 
-    @jax.jit  # compile the SPMD program once; bare shard_map re-traces
+    @tracked_jit  # compile the SPMD program once; bare shard_map re-traces
     @partial(jax.shard_map, mesh=mesh,
              in_specs=(P(DATA_AXIS, None), P(), P(), P()),
              out_specs=P())

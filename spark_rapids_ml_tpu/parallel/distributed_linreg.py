@@ -14,7 +14,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.linreg_kernel import (
     LinRegResult,
     linreg_partial_stats,
@@ -28,7 +32,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("mesh", "fit_intercept"))
+@partial(tracked_jit, static_argnames=("mesh", "fit_intercept"))
 def distributed_linreg_fit_kernel(
     x: jnp.ndarray,
     y: jnp.ndarray,

@@ -18,7 +18,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     collective_nbytes,
@@ -27,7 +31,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("mesh", "need_sq"))
+@partial(tracked_jit, static_argnames=("mesh", "need_sq"))
 def distributed_nb_stats_kernel(
     x: jnp.ndarray,
     y_oh: jnp.ndarray,

@@ -34,7 +34,11 @@ from spark_rapids_ml_tpu.models.fm import (
 from spark_rapids_ml_tpu.models.survival_regression import (
     aft_rowwise_loglik,
 )
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.optim import minimize_kernel
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -88,7 +92,7 @@ def mlp_cross_entropy_dp(params, x, y_onehot, w):
     return _global_mean((w * rl).sum(), w.sum())
 
 
-@partial(jax.jit, static_argnames=("loss_fn", "solver", "max_iter",
+@partial(tracked_jit, static_argnames=("loss_fn", "solver", "max_iter",
                                    "mesh", "row_args"))
 def distributed_minimize_kernel(
     params, data, *, loss_fn, solver: str, max_iter: int, tol,

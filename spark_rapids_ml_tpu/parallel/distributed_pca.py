@@ -33,7 +33,11 @@ from spark_rapids_ml_tpu.ops.covariance import (
     gram,
     partial_gram_stats,
 )
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -77,7 +81,7 @@ def _shard_fit(x_shard, mask_shard, *, k, mean_centering, one_pass, flip_signs):
 
 
 @partial(
-    jax.jit,
+    tracked_jit,
     static_argnames=("mesh", "k", "mean_centering", "one_pass", "flip_signs"),
 )
 def distributed_pca_fit_kernel(

@@ -21,7 +21,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     collective_nbytes,
@@ -29,7 +33,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("mesh", "max_iter"))
+@partial(tracked_jit, static_argnames=("mesh", "max_iter"))
 def distributed_power_iterate_kernel(
     w_panels: jnp.ndarray,
     v0: jnp.ndarray,

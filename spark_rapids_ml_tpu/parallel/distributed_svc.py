@@ -15,7 +15,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.svm_kernel import SvcResult, svc_newton_iterations
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -26,7 +30,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 
 
 @partial(
-    jax.jit,
+    tracked_jit,
     static_argnames=("mesh", "fit_intercept", "max_iter"),
 )
 def distributed_svc_fit_kernel(

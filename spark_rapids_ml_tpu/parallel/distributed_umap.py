@@ -22,7 +22,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.knn_kernel import pairwise_sqdist
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -31,7 +35,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("n_epochs", "mesh"))
+@partial(tracked_jit, static_argnames=("n_epochs", "mesh"))
 def _sharded_umap_optimize(
     edge_i, edge_j, edge_p, edge_mask,   # (n_dev·e_per,) padded edge slices
     emb0, valid,                          # replicated (n_pad, dim), (n_pad,)

@@ -22,11 +22,15 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, collective_nbytes
 
 
-@partial(jax.jit, donate_argnums=(0, 1),
+@partial(tracked_jit, donate_argnums=(0, 1),
          static_argnames=("mesh", "k_neg"))
 def distributed_sgns_step_kernel(
     u: jnp.ndarray,

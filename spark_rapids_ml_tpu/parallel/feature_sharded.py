@@ -44,6 +44,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_ml_tpu.obs.xprof import tracked_jit
 from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
 from spark_rapids_ml_tpu.ops.randomized import (
     subspace_iteration,
@@ -126,7 +127,7 @@ def _local_trace(g_row: jnp.ndarray) -> jnp.ndarray:
 
 
 @partial(
-    jax.jit,
+    tracked_jit,
     static_argnames=("mesh", "mean_centering", "schedule"),
 )
 def feature_sharded_covariance_kernel(
@@ -178,7 +179,7 @@ def _randomized_shard(
 
 
 @partial(
-    jax.jit,
+    tracked_jit,
     static_argnames=(
         "mesh", "k", "oversample", "n_iter", "seed", "flip_signs"
     ),
@@ -216,7 +217,7 @@ def randomized_sharded_pca_kernel(
 # Module-level wrapper so repeated eigh-solver fits hit the jit cache
 # instead of re-tracing per call.
 _jitted_pca_from_covariance = partial(
-    jax.jit, static_argnames=("k", "flip_signs")
+    tracked_jit, static_argnames=("k", "flip_signs")
 )(pca_from_covariance)
 
 

@@ -28,7 +28,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.obs import current_fit, fit_instrumentation
+from spark_rapids_ml_tpu.obs import (
+    current_fit,
+    fit_instrumentation,
+    tracked_jit,
+)
 from spark_rapids_ml_tpu.ops.covariance import covariance_from_stats, partial_gram_stats
 from spark_rapids_ml_tpu.ops.eigh import pca_from_covariance
 from spark_rapids_ml_tpu.ops.pca_kernel import PCAFitResult
@@ -40,7 +44,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 
 
-@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
+@partial(tracked_jit, static_argnames=("mesh",), donate_argnums=(0,))
 def update_stats_sharded(
     stats: GramStats, batch: jnp.ndarray, mask: jnp.ndarray, *, mesh: Mesh
 ) -> GramStats:
@@ -71,7 +75,7 @@ def update_stats_sharded(
 
 
 @partial(
-    jax.jit, static_argnames=("k", "mean_centering", "flip_signs", "solver")
+    tracked_jit, static_argnames=("k", "mean_centering", "flip_signs", "solver")
 )
 def finalize_stats_sharded(
     stats: GramStats, k: int, mean_centering: bool = True,

@@ -34,6 +34,43 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def _optax_lbfgs_broken() -> bool:
+    """optax <= 0.2.3's zoom linesearch builds float64 scalars
+    (stepsize/decrease_error/...) into an otherwise-float32 state under jax
+    x64 mode, so ``lax.cond`` rejects the branch types with a TypeError.
+    Fixed upstream after 0.2.3; this container ships 0.2.3. The skip is
+    VERSION-CONDITIONAL so an optax upgrade re-arms the tests instead of
+    masking a real regression."""
+    try:
+        import optax
+
+        version = tuple(int(p) for p in optax.__version__.split(".")[:3])
+    except Exception:
+        return False
+    return version <= (0, 2, 3)
+
+
+# Triage marks for the pre-existing env-limited failures (PR 2): applied at
+# the affected test definitions so the tier-1 signal is clean without
+# masking anything this container could actually detect.
+optax_lbfgs_x64_skip = pytest.mark.skipif(
+    _optax_lbfgs_broken(),
+    reason="optax<=0.2.3 zoom linesearch mixes f64 scalars into f32 state "
+           "under jax x64 (TypeError in lax.cond branches); env-limited — "
+           "re-armed automatically by an optax upgrade",
+)
+# NOTE: plugin-presence detection cannot gate this — this container ships
+# libtpu with no reachable device, so only an explicit opt-in is reliable.
+multiprocess_cpu_skip = pytest.mark.skipif(
+    os.environ.get("SPARKML_RUN_MULTIPROCESS_TESTS") != "1",
+    reason="multiprocess-on-CPU env limit: spawned worker processes joining "
+           "one jax.distributed CPU job in this single-host container "
+           "wedge/diverge (pre-existing seed failure). Set "
+           "SPARKML_RUN_MULTIPROCESS_TESTS=1 to re-arm on hosts with "
+           "working multi-process device coordination (real TPU CI).",
+)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -13,7 +13,7 @@ import pytest
 from spark_rapids_ml_tpu.parallel import data_mesh, distributed_pca_fit
 from spark_rapids_ml_tpu.parallel.mesh import grid_mesh, pad_rows_to_multiple
 
-from conftest import numpy_pca_oracle
+from conftest import numpy_pca_oracle, optax_lbfgs_x64_skip
 
 ABS_TOL = 1e-5
 
@@ -198,6 +198,7 @@ def test_distributed_fm_fit(rng):
     assert ((pred2 > 0) == yb).mean() > 0.95
 
 
+@optax_lbfgs_x64_skip
 def test_distributed_aft_matches_local(rng):
     from spark_rapids_ml_tpu.data.frame import VectorFrame
     from spark_rapids_ml_tpu.models.survival_regression import (
@@ -299,6 +300,7 @@ def test_distributed_pic_matches_local(rng):
     assert agree / len(pairs) >= 0.95
 
 
+@optax_lbfgs_x64_skip
 def test_distributed_mlp_fit(rng):
     import jax
     import jax.numpy as jnp

@@ -9,6 +9,7 @@ partitions rather than a real cluster (SURVEY.md §4).
 
 import numpy as np
 
+from conftest import multiprocess_cpu_skip
 from spark_rapids_ml_tpu.parallel import distributed_pca_fit
 from spark_rapids_ml_tpu.parallel.multihost import (
     global_data_mesh,
@@ -71,6 +72,7 @@ def test_initialize_rejects_coordinator_mismatch(monkeypatch):
     ) in (True, False)
 
 
+@multiprocess_cpu_skip
 def test_two_process_multihost_job():
     """The REAL multi-host path: a coordinator + worker pair of fresh
     processes join one jax.distributed job, build the global mesh, load

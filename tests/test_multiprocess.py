@@ -14,6 +14,8 @@ import textwrap
 
 import numpy as np
 
+from conftest import multiprocess_cpu_skip
+
 _WORKER = textwrap.dedent(
     """
     import os
@@ -72,6 +74,7 @@ _WORKER = textwrap.dedent(
 )
 
 
+@multiprocess_cpu_skip
 def test_two_process_distributed_fit(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)

@@ -1,0 +1,204 @@
+"""Perf sentinel verdicts (scripts/perf_sentinel.py): PASS / REGRESSED /
+STALE / NO_BASELINE over fixture histories, and the real BENCH_r05.json
+stale-chip-record acceptance case."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+from perf_sentinel import (  # noqa: E402
+    EXIT_CODES,
+    extract_record,
+    iter_history,
+    judge,
+    load_candidate,
+    noise_band,
+)
+
+sys.path.pop(0)
+
+METRIC = "PCA.fit rows/sec/chip (1000x100, k=10)"
+
+
+def _history(*values, platform="tpu", metric=METRIC):
+    return [
+        {"metric": metric, "value": v, "unit": "rows/sec",
+         "platform": platform, "_source": f"fixture{i}.json"}
+        for i, v in enumerate(values)
+    ]
+
+
+def _record(value, platform="tpu", **extra):
+    rec = {"metric": METRIC, "value": value, "unit": "rows/sec",
+           "platform": platform}
+    rec.update(extra)
+    return rec
+
+
+def test_pass_within_band():
+    v = judge(_record(96_000.0), _history(100_000.0, 102_000.0, 98_000.0))
+    assert v["verdict"] == "PASS"
+    assert v["baseline"]["n_samples"] == 3
+    assert v["band"]["low"] < 96_000.0 < v["band"]["high"]
+
+
+def test_pass_when_faster_than_baseline():
+    v = judge(_record(150_000.0), _history(100_000.0))
+    assert v["verdict"] == "PASS"
+
+
+def test_regressed_below_band():
+    v = judge(_record(50_000.0), _history(100_000.0, 101_000.0))
+    assert v["verdict"] == "REGRESSED"
+    assert "below the noise band" in v["reason"]
+    assert EXIT_CODES[v["verdict"]] == 1
+
+
+def test_regressed_direction_flips_for_seconds():
+    hist = [
+        {"metric": "DBSCAN.fit seconds", "value": 10.0, "unit": "seconds",
+         "platform": "tpu", "_source": "fixture.json"},
+    ]
+    slow = judge({"metric": "DBSCAN.fit seconds", "value": 30.0,
+                  "unit": "seconds", "platform": "tpu"}, hist)
+    assert slow["verdict"] == "REGRESSED"
+    fast = judge({"metric": "DBSCAN.fit seconds", "value": 5.0,
+                  "unit": "seconds", "platform": "tpu"}, hist)
+    assert fast["verdict"] == "PASS"
+
+
+def test_stale_on_fallback_record():
+    """A CPU fallback run never reads as a regression of the chip
+    baseline — it reads as a stale baseline."""
+    rec = _record(
+        3_000.0, platform="cpu",
+        fallback_reason="backend init exceeded 60.0s",
+    )
+    v = judge(rec, _history(2_000_000.0))
+    assert v["verdict"] == "STALE"
+    assert "stale" in v["reason"]
+    assert v["stale_baseline"]["value"] == 2_000_000.0
+    assert EXIT_CODES[v["verdict"]] == 2
+
+
+def test_stale_on_platform_mismatch_without_fallback_marker():
+    v = judge(_record(3_000.0, platform="cpu"), _history(2_000_000.0))
+    assert v["verdict"] == "STALE"
+
+
+def test_cpu_history_comparable_for_cpu_record():
+    """With a CPU-only history, a CPU record is a real comparison."""
+    v = judge(_record(900.0, platform="cpu"),
+              _history(1_000.0, platform="cpu"))
+    assert v["verdict"] == "PASS"
+    v = judge(_record(100.0, platform="cpu"),
+              _history(1_000.0, platform="cpu"))
+    assert v["verdict"] == "REGRESSED"
+
+
+def test_no_baseline():
+    v = judge({"metric": "unseen metric", "value": 1.0, "unit": "rows/sec",
+               "platform": "tpu"}, _history(5.0))
+    assert v["verdict"] == "NO_BASELINE"
+    assert EXIT_CODES[v["verdict"]] == 3
+
+
+def test_noise_band_widens_with_spread():
+    assert noise_band([100.0], 0.15) == 0.15
+    wide = noise_band([100.0, 60.0, 140.0, 80.0, 120.0], 0.15)
+    assert wide > 0.15
+
+
+def test_extract_record_shapes():
+    raw = {"metric": "m", "value": 1.0}
+    assert extract_record(raw) == raw
+    assert extract_record({"parsed": raw}) == raw
+    assert extract_record({"headline": raw}) == raw
+    assert extract_record({"tail": "text"}) is None
+
+
+def test_load_candidate_json_lines(tmp_path):
+    path = tmp_path / "rec.json"
+    path.write_text(
+        '# comment\n{"not_a_record": true}\n'
+        '{"metric": "m1", "value": 1.0}\n{"metric": "m2", "value": 2.0}\n'
+    )
+    rec = load_candidate(str(path))
+    assert rec["metric"] == "m2"  # last record line wins
+
+
+def test_iter_history_reads_repo_shapes(tmp_path):
+    (tmp_path / "records" / "r1").mkdir(parents=True)
+    (tmp_path / "BENCH_MEASURED.json").write_text(json.dumps({
+        "note": "x",
+        "headline": {"metric": "m", "value": 10.0, "platform": "tpu"},
+        "sub": {"metric": "m2", "value": 5.0, "platform": "tpu"},
+    }))
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"metric": "m", "value": 9.0, "platform": "tpu"},
+    }))
+    (tmp_path / "records" / "r1" / "bench.json").write_text(
+        '{"metric": "m", "value": 11.0, "platform": "tpu"}\n'
+    )
+    hist = iter_history(str(tmp_path))
+    values = sorted(h["value"] for h in hist if h["metric"] == "m")
+    assert values == [9.0, 10.0, 11.0]
+    assert any(h["metric"] == "m2" for h in hist)
+    # exclusion: the candidate file is never its own baseline
+    hist2 = iter_history(str(tmp_path),
+                         exclude=str(tmp_path / "BENCH_r01.json"))
+    assert sorted(h["value"] for h in hist2 if h["metric"] == "m") == \
+        [10.0, 11.0]
+
+
+@pytest.mark.parametrize("target,expected_verdict,expected_rc", [
+    ("BENCH_r05.json", "STALE", 2),
+])
+def test_cli_on_real_repo_records(target, expected_verdict, expected_rc):
+    """Acceptance: `python scripts/perf_sentinel.py BENCH_r05.json` emits a
+    structured verdict distinguishing REGRESSED from STALE-baseline."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_sentinel.py"),
+         os.path.join(REPO, target)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == expected_rc, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout)
+    assert verdict["verdict"] == expected_verdict
+    assert verdict["stale_baseline"]["value"] > verdict["value"]
+
+
+def test_cli_regressed_vs_stale_distinguished(tmp_path):
+    """A genuinely slower chip run is REGRESSED; the same value as a CPU
+    fallback is STALE — the two states never conflate."""
+    (tmp_path / "BENCH_MEASURED.json").write_text(json.dumps({
+        "headline": {"metric": METRIC, "value": 2_000_000.0,
+                     "unit": "rows/sec", "platform": "tpu"},
+    }))
+    script = os.path.join(REPO, "scripts", "perf_sentinel.py")
+
+    slow_chip = tmp_path / "slow_chip.json"
+    slow_chip.write_text(json.dumps(_record(500_000.0)))
+    proc = subprocess.run(
+        [sys.executable, script, str(slow_chip),
+         "--history-root", str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert json.loads(proc.stdout)["verdict"] == "REGRESSED"
+    assert proc.returncode == 1
+
+    fallback = tmp_path / "fallback.json"
+    fallback.write_text(json.dumps(_record(
+        500_000.0, platform="cpu", fallback_reason="wedged")))
+    proc = subprocess.run(
+        [sys.executable, script, str(fallback),
+         "--history-root", str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert json.loads(proc.stdout)["verdict"] == "STALE"
+    assert proc.returncode == 2

@@ -26,6 +26,7 @@ if HAVE_PYSPARK:  # pragma: no cover - this sandbox has no pyspark
         allow_module_level=True,
     )
 
+from conftest import multiprocess_cpu_skip  # noqa: E402
 from spark_rapids_ml_tpu.spark.estimator import (  # noqa: E402
     KMeans,
     LinearRegression,
@@ -148,6 +149,7 @@ def test_pca_executor_device_two_worker_processes(rng):
     np.testing.assert_allclose(model.pc.toArray(), pc_oracle, atol=5e-4)
 
 
+@multiprocess_cpu_skip
 def test_pca_collective_barrier_two_worker_processes(rng):
     """The deepest executor-plane mode: a barrier stage where both worker
     processes join one jax.distributed job and the partial statistics are
@@ -170,6 +172,7 @@ def test_pca_collective_barrier_two_worker_processes(rng):
     np.testing.assert_allclose(model.pc.toArray(), pc_oracle, atol=5e-4)
 
 
+@multiprocess_cpu_skip
 def test_pca_collective_tolerates_empty_partition(rng):
     """An empty partition must still JOIN the collective (with zeros) —
     bailing out instead would strand the other barrier tasks in the
