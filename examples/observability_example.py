@@ -12,7 +12,11 @@ enabled, then shows the observability surfaces:
 3. the process metrics registry, as Prometheus text and over HTTP;
 4. the flight recorder: a watchdog dump of thread stacks / open spans /
    metrics under ``SPARK_RAPIDS_ML_TPU_DUMP_DIR`` when a phase overruns
-   its budget.
+   its budget;
+5. the serving tier: ``transform_report_`` per transform/predict call
+   (rows, bytes, device-put/compute/host-sync split, compile
+   attribution, numerics-sentinel verdict) and the live sketch-backed
+   p50/p95/p99 latency per algo.
 
 CPU-safe: run with ``python examples/observability_example.py``.
 """
@@ -129,6 +133,29 @@ def main() -> None:
         print(f"  reason={doc['reason']}  "
               f"threads={len(doc['thread_stacks'])}  "
               f"open_spans={[s['name'] for s in doc['open_spans']]}")
+
+    # -- 5. serving observability -----------------------------------------
+    print("== serving tier: TransformReport per transform/predict call")
+    for batch in range(30):
+        batch_rows = x[(batch * 16) % 256:][:64]
+        out = model.transform(batch_rows)
+    treport = model.transform_report_
+    print(f"  algo={treport.algo}  rows={treport.rows}  "
+          f"bytes_in={treport.bytes_in}  bytes_out={treport.bytes_out}")
+    print("  phase split:",
+          {k: round(v, 5) for k, v in treport.phases.items()})
+    print(f"  compiles={treport.compiles} (first call pays the XLA "
+          f"compile; later batches hit the cache)")
+    print(f"  numerics sentinel: {treport.numerics}")
+    print("  report rides on the output too:",
+          type(out).__name__, hasattr(out, "transform_report_"))
+    live = obs.latency_quantiles("pca")
+    print(f"  live sketch-backed latency: p50={live['p50']:.5f}s  "
+          f"p95={live['p95']:.5f}s  p99={live['p99']:.5f}s")
+    print("  as Prometheus summary lines:")
+    for line in obs.get_registry().prometheus_text().splitlines():
+        if "sparkml_transform_latency_seconds{" in line:
+            print("   ", line)
 
 
 if __name__ == "__main__":

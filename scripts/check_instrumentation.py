@@ -1,18 +1,26 @@
 #!/usr/bin/env python
-"""Static check: every distributed driver uses the shared instrumentation.
+"""Static check: every driver AND serving entry point is instrumented.
 
-Two rules over ``spark_rapids_ml_tpu/parallel/distributed_*.py``:
+Three rule families:
 
-1. every module-level public entry point (a ``distributed_*`` function that
-   is not a ``*_kernel``) carries the ``@fit_instrumentation(...)``
-   decorator from ``spark_rapids_ml_tpu.obs``;
-2. no jitted entry point uses raw ``jax.jit`` — every jit decoration (and
-   every ``jax.jit(...)`` call) must go through ``obs.tracked_jit`` /
-   ``track_compiles``, so compile time, recompiles, and HLO cost analysis
-   are observable for every driver program.
+1. over ``spark_rapids_ml_tpu/parallel/distributed_*.py``: every
+   module-level public entry point (a ``distributed_*`` function that is
+   not a ``*_kernel``) carries the ``@fit_instrumentation(...)`` decorator
+   from ``spark_rapids_ml_tpu.obs``;
+2. same files: no jitted entry point uses raw ``jax.jit`` — every jit
+   decoration (and every ``jax.jit(...)`` call) must go through
+   ``obs.tracked_jit`` / ``track_compiles``, so compile time, recompiles,
+   and HLO cost analysis are observable for every driver program;
+3. over ``spark_rapids_ml_tpu/models/*.py`` and
+   ``spark_rapids_ml_tpu/spark/*.py``: every class-level serving entry
+   point — a method named ``transform``/``predict``/``predict_proba``
+   (plus ``_transform``, the pyspark-convention hook the base class's
+   public ``transform`` delegates to, in ``spark/``) — carries the
+   ``@observed_transform`` decorator from ``obs.serving``, so no
+   transform/predict path ships as a telemetry black hole.
 
-New drivers therefore cannot silently ship unobserved: tier-1 runs this
-via ``tests/test_obs_reports.py``.
+New drivers and new models therefore cannot silently ship unobserved:
+tier-1 runs this via ``tests/test_obs_reports.py``.
 
 Pure ``ast`` — no jax import, no package import, so it runs anywhere in
 milliseconds. Exit 0 = all instrumented; exit 1 = offenders listed on
@@ -30,7 +38,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PARALLEL_GLOB = os.path.join(
     REPO, "spark_rapids_ml_tpu", "parallel", "distributed_*.py"
 )
+MODELS_GLOB = os.path.join(REPO, "spark_rapids_ml_tpu", "models", "*.py")
+SPARK_GLOB = os.path.join(REPO, "spark_rapids_ml_tpu", "spark", "*.py")
 DECORATOR_NAME = "fit_instrumentation"
+SERVING_DECORATOR = "observed_transform"
+SERVING_PUBLIC_NAMES = frozenset(
+    {"transform", "predict", "predict_proba"}
+)
 
 
 def _decorator_names(fn: ast.FunctionDef):
@@ -106,10 +120,80 @@ def check_raw_jit(path: str):
             yield node.lineno, "raw jax.jit (use obs.tracked_jit)"
 
 
+def _serving_names(path: str) -> frozenset:
+    """The method names that count as serving entry points in one file.
+
+    ``_transform`` counts only in ``spark/``: there the public
+    ``transform`` lives on a (possibly external pyspark) base class, so
+    the subclass hook is the only decoratable entry point. In ``models/``
+    the public method itself is the entry point.
+    """
+    if os.sep + "spark" + os.sep in path:
+        return SERVING_PUBLIC_NAMES | {"_transform"}
+    return SERVING_PUBLIC_NAMES
+
+
+def audit_serving_file(path: str):
+    """One parse per file: ``(entry_point_count, offenders)`` where
+    offenders is ``[(lineno, description), ...]``.
+
+    An offender is a class-level serving entry point
+    (``transform``/``predict``/``predict_proba``, ``_transform`` in
+    ``spark/``) missing ``@observed_transform`` — OR a class-body
+    *assignment* binding a serving name (``predict_proba = some_fn``),
+    which ships the alias unobserved and invisible to decorator checks:
+    serving entry points must be real decorated defs. Nested helper
+    functions (pandas_udf closures named ``predict`` etc.) are not
+    class-level and do not count.
+    """
+    tree = ast.parse(open(path).read(), filename=path)
+    names = _serving_names(path)
+    count = 0
+    offenders = []
+    for cls in tree.body:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in cls.body:
+            if isinstance(node, ast.FunctionDef) and node.name in names:
+                count += 1
+                if SERVING_DECORATOR not in set(_decorator_names(node)):
+                    offenders.append(
+                        (node.lineno, f"{cls.name}.{node.name}")
+                    )
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                # both `predict_proba = fn` and the annotated spelling
+                # `predict_proba: Callable = fn` are alias loopholes
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for target in targets:
+                    if isinstance(target, ast.Name) and target.id in names:
+                        count += 1
+                        offenders.append((
+                            node.lineno,
+                            f"{cls.name}.{target.id} (alias assignment — "
+                            f"make it a decorated def)",
+                        ))
+    return count, offenders
+
+
+def check_serving_file(path: str):
+    """Yield (lineno, name) for every serving offender in one file."""
+    _, offenders = audit_serving_file(path)
+    yield from offenders
+
+
 def main() -> int:
     files = sorted(glob.glob(PARALLEL_GLOB))
     if not files:
         print("ERROR: no parallel/distributed_*.py files found")
+        return 1
+    serving_files = sorted(
+        path
+        for path in glob.glob(MODELS_GLOB) + glob.glob(SPARK_GLOB)
+        if os.path.basename(path) not in ("__init__.py", "_compat.py")
+    )
+    if not serving_files:
+        print("ERROR: no models/ or spark/ files found")
         return 1
     offenders = []
     checked = 0
@@ -126,6 +210,14 @@ def main() -> int:
                              f"(missing @{DECORATOR_NAME})")
         for lineno, why in check_raw_jit(path):
             offenders.append(f"{rel}:{lineno} {why}")
+    serving_checked = 0
+    for path in serving_files:
+        rel = os.path.relpath(path, REPO)
+        count, serving_offenders = audit_serving_file(path)
+        serving_checked += count
+        for lineno, name in serving_offenders:
+            offenders.append(f"{rel}:{lineno} {name} "
+                             f"(missing @{SERVING_DECORATOR})")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -133,7 +225,9 @@ def main() -> int:
         return 1
     print(
         f"OK: {checked} distributed entry point(s) across {len(files)} "
-        f"driver module(s) all instrumented; all jit sites tracked"
+        f"driver module(s) all instrumented; all jit sites tracked; "
+        f"{serving_checked} serving entry point(s) across "
+        f"{len(serving_files)} models/spark module(s) all instrumented"
     )
     return 0
 

@@ -9,6 +9,12 @@ line::
 
     {"verdict": "PASS|REGRESSED|STALE|NO_BASELINE", ...}
 
+Records carrying latency-percentile fields (``p50``/``p95``/``p99`` at
+top level or under ``percentiles`` — what transform bench records emit
+from the serving quantile sketch) are judged **per percentile** against
+the same percentile in the history, and the overall verdict is the worst
+sub-verdict (tail regressions cannot hide behind a healthy mean).
+
 Verdicts:
 
 * **PASS** — value within (or better than) the noise band around the
@@ -46,6 +52,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXIT_CODES = {"PASS": 0, "REGRESSED": 1, "STALE": 2, "NO_BASELINE": 3}
 DEFAULT_TOLERANCE = 0.15
+PERCENTILE_KEYS = ("p50", "p95", "p99")
 
 
 # -- record extraction -----------------------------------------------------
@@ -53,7 +60,34 @@ DEFAULT_TOLERANCE = 0.15
 
 def _is_record(obj) -> bool:
     return (isinstance(obj, dict) and "metric" in obj
-            and obj.get("value") is not None)
+            and (obj.get("value") is not None
+                 or record_percentiles(obj)))
+
+
+def record_percentiles(record) -> Dict[str, float]:
+    """The latency-percentile fields of a record: a ``percentiles`` dict
+    and/or top-level ``p50``/``p95``/``p99`` keys (sketch-quantile output
+    from instrumented transform benches)."""
+    if not isinstance(record, dict):
+        return {}
+    out: Dict[str, float] = {}
+    nested = record.get("percentiles")
+    if isinstance(nested, dict):
+        for key in PERCENTILE_KEYS:
+            value = nested.get(key)
+            if value is not None:
+                try:
+                    out[key] = float(value)
+                except (TypeError, ValueError):
+                    continue  # one malformed field never kills the run
+    for key in PERCENTILE_KEYS:
+        value = record.get(key)
+        if value is not None:
+            try:
+                out[key] = float(value)
+            except (TypeError, ValueError):
+                continue
+    return out
 
 
 def extract_record(obj) -> Optional[Dict[str, Any]]:
@@ -157,6 +191,11 @@ def iter_history(root: str, exclude: Optional[str] = None
 
 
 def higher_is_better(record: Dict[str, Any]) -> bool:
+    # An explicit flag beats the text heuristic — percentile pseudo-records
+    # force lower-is-better even when the metric NAME contains "/sec".
+    explicit = record.get("higher_is_better")
+    if isinstance(explicit, bool):
+        return explicit
     text = f"{record.get('unit', '')} {record.get('metric', '')}".lower()
     if "rows/sec" in text or "/sec" in text:
         return True
@@ -196,7 +235,10 @@ def judge(record: Dict[str, Any], history: List[Dict[str, Any]],
     metric = record.get("metric")
     platform = record.get("platform")
     value = float(record["value"])
-    same_metric = [h for h in history if h.get("metric") == metric]
+    # percentile-only history entries carry no scalar value to compare
+    same_metric = [h for h in history
+                   if h.get("metric") == metric
+                   and h.get("value") is not None]
     verdict: Dict[str, Any] = {
         "metric": metric,
         "value": value,
@@ -302,6 +344,90 @@ def judge(record: Dict[str, Any], history: List[Dict[str, Any]],
     return verdict
 
 
+def _combine_verdicts(kinds) -> str:
+    """Worst-wins fold over sub-verdicts: a tail regression can never hide
+    behind a healthy mean; NO_BASELINE only when nothing was comparable."""
+    for kind in ("REGRESSED", "STALE"):
+        if kind in kinds:
+            return kind
+    if "PASS" in kinds:
+        return "PASS"
+    return "NO_BASELINE"
+
+
+def judge_percentiles(record: Dict[str, Any],
+                      history: List[Dict[str, Any]],
+                      tolerance: float = DEFAULT_TOLERANCE
+                      ) -> Dict[str, Any]:
+    """Per-percentile verdicts for a record carrying p50/p95/p99 fields.
+
+    Each percentile is judged by ``judge`` against the SAME percentile of
+    history records for the metric (a p99 only ever compares to p99s);
+    the scalar ``value``, when also present, is judged as before. The
+    overall verdict is the worst sub-verdict.
+    """
+    pcts = record_percentiles(record)
+    sub: Dict[str, Dict[str, Any]] = {}
+    carry = {
+        k: record[k]
+        for k in ("platform", "fallback_reason", "best_known_chip_record")
+        if k in record
+    }
+    # Latency percentiles are always lower-is-better, even when the
+    # record's scalar unit (or its metric NAME) says rows/sec; the
+    # pseudo-records carry an explicit direction, immune to the text
+    # heuristic.
+    pct_unit = record.get("percentile_unit") or "seconds"
+    for key, value in pcts.items():
+        pseudo = dict(carry)
+        pseudo.update(metric=record.get("metric"), value=value,
+                      unit=pct_unit, higher_is_better=False)
+        pseudo_history = []
+        for h in history:
+            if h.get("metric") != record.get("metric"):
+                continue
+            h_pcts = record_percentiles(h)
+            if key not in h_pcts:
+                continue
+            entry = dict(h)
+            entry["value"] = h_pcts[key]
+            pseudo_history.append(entry)
+        sub[key] = judge(pseudo, pseudo_history, tolerance=tolerance)
+    verdicts = list(sub.values())
+    if record.get("value") is not None:
+        scalar = judge(record, [h for h in history
+                                if h.get("value") is not None],
+                       tolerance=tolerance)
+        verdicts.append(scalar)
+    else:
+        scalar = None
+    overall = _combine_verdicts({v["verdict"] for v in verdicts})
+    reason_parts = [f"{key}: {v['verdict']}" for key, v in sub.items()]
+    if scalar is not None:
+        reason_parts.append(f"scalar: {scalar['verdict']}")
+    out: Dict[str, Any] = {
+        "metric": record.get("metric"),
+        "unit": record.get("unit"),
+        "platform": record.get("platform"),
+        "verdict": overall,
+        "percentiles": sub,
+        "reason": "; ".join(reason_parts),
+    }
+    if scalar is not None:
+        out["scalar"] = scalar
+        out["value"] = record.get("value")
+    return out
+
+
+def judge_record(record: Dict[str, Any], history: List[Dict[str, Any]],
+                 tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
+    """Dispatch: percentile-aware judging when the record carries
+    latency-percentile fields, scalar judging otherwise."""
+    if record_percentiles(record):
+        return judge_percentiles(record, history, tolerance=tolerance)
+    return judge(record, history, tolerance=tolerance)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("record", help="record file (or '-' for stdin): an "
@@ -319,7 +445,7 @@ def main(argv=None) -> int:
     record = load_candidate(args.record)
     exclude = None if args.record == "-" else args.record
     history = iter_history(args.history_root, exclude=exclude)
-    verdict = judge(record, history, tolerance=args.tolerance)
+    verdict = judge_record(record, history, tolerance=args.tolerance)
     print(json.dumps(verdict, indent=args.indent, default=str))
     return EXIT_CODES[verdict["verdict"]]
 

@@ -38,6 +38,7 @@ from spark_rapids_ml_tpu.models.params import (
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+from spark_rapids_ml_tpu.obs import observed_transform
 
 _MAX_EXACT_ID = float(2**53)  # float64-exact integer ceiling; Spark's ALS
 # restricts ids to Integer range, far inside this
@@ -337,6 +338,10 @@ class ALSModel(_ALSParams):
         if self.user_factors is None or self.item_factors is None:
             raise ValueError("model has no factors; fit first or load")
 
+    # NaN output is this model's CONTRACT (unseen ids / coldStartStrategy
+    # 'nan'), not an anomaly — the numerics sentinel would page on
+    # healthy traffic.
+    @observed_transform("als", check_numerics=False)
     def predict(self, users, items) -> np.ndarray:
         """Scores for id pairs; NaN where either id is unseen."""
         self._require_fitted()
@@ -352,6 +357,7 @@ class ALSModel(_ALSParams):
                 self.user_factors[u[ok]], self.item_factors[i[ok]])
         return out
 
+    @observed_transform("als", check_numerics=False)
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getUserCol())
         users = np.asarray(frame.column(self.getUserCol()),

@@ -28,6 +28,7 @@ from spark_rapids_ml_tpu.models.params import (
     Param,
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class BisectingKMeansParams(HasInputCol, HasDeviceId, HasWeightCol):
@@ -165,6 +166,7 @@ class BisectingKMeansModel(BisectingKMeansParams):
         # (inputCol, predictionCol, useXlaDot, dtype, deviceId) carry
         return km
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.cluster_centers is None:
             raise ValueError("model has no centers; fit first or load")

@@ -19,7 +19,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
@@ -175,6 +175,7 @@ class DBSCANModel(DBSCANParams):
             return 0
         return int(self.labels_.max()) + 1 if (self.labels_ >= 0).any() else 0
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         """Append the fitted labels. DBSCAN has no out-of-sample predict;
         the dataset must be the fitted one (length-checked)."""

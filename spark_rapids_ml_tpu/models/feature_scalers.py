@@ -29,6 +29,7 @@ from spark_rapids_ml_tpu.models.params import (
     Params,
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class MinMaxScalerParams(HasInputCol, HasOutputCol):
@@ -100,6 +101,7 @@ class MinMaxScalerModel(MinMaxScalerParams):
         other.original_min = self.original_min
         other.original_max = self.original_max
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.original_min is None:
             raise ValueError("model is unfitted")
@@ -181,6 +183,7 @@ class MaxAbsScalerModel(MaxAbsScalerParams):
     def _copy_internal_state(self, other: "MaxAbsScalerModel") -> None:
         other.max_abs = self.max_abs
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.max_abs is None:
             raise ValueError("model is unfitted")
@@ -210,6 +213,7 @@ class Normalizer(HasInputCol, HasOutputCol, Params):
     p = Param("p", "norm order (p >= 1; inf supported)", 2.0,
               validator=lambda v: v == float("inf") or float(v) >= 1.0)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())
@@ -247,6 +251,7 @@ class Binarizer(HasInputCol, HasOutputCol, Params):
     threshold = Param("threshold", "values > threshold map to 1.0", 0.0,
                       validator=lambda v: np.isfinite(float(v)))
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())
@@ -339,6 +344,7 @@ class RobustScalerModel(RobustScalerParams):
         other.median = self.median
         other.qrange = self.qrange
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.median is None:
             raise ValueError("model is unfitted")

@@ -28,6 +28,7 @@ from spark_rapids_ml_tpu.models.params import (
     Param,
     Params,
 )
+from spark_rapids_ml_tpu.obs import observed_transform
 
 _INVALID_MODES = ("error", "skip", "keep")
 
@@ -115,6 +116,7 @@ class StringIndexerModel(StringIndexerParams):
     def _copy_internal_state(self, other) -> None:
         other.labels = self.labels
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, None)
         index = {v: float(i) for i, v in enumerate(self.labels)}
@@ -164,6 +166,7 @@ class IndexToString(HasInputCol, HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         labels = self.get_or_default("labels")
         if not labels:
@@ -225,6 +228,7 @@ class OneHotEncoderModel(OneHotEncoderParams):
     def _copy_internal_state(self, other) -> None:
         other.category_size = self.category_size
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, None)
         idx = np.asarray(frame.column(self.getInputCol()),
@@ -279,6 +283,7 @@ class VectorAssembler(HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         cols = self.get_or_default("inputCols")
         if not cols:
@@ -343,6 +348,7 @@ class Bucketizer(BucketizerParams):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         splits = self.get_or_default("splits")
         if splits is None:
@@ -430,6 +436,7 @@ class ElementwiseProduct(HasInputCol, HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         scaling = self.get_or_default("scalingVec")
         if scaling is None:
@@ -458,6 +465,7 @@ class VectorSlicer(HasInputCol, HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         indices = self.get_or_default("indices")
         if not indices:
@@ -506,6 +514,7 @@ class PolynomialExpansion(HasInputCol, HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())
@@ -537,6 +546,7 @@ class _SelectorModelBase(HasInputCol, HasOutputCol, Params):
     def _copy_internal_state(self, other) -> None:
         other.selected_features = self.selected_features
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.selected_features is None:
             raise ValueError("selector model is unfitted")

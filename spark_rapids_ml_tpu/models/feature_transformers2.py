@@ -29,6 +29,7 @@ from spark_rapids_ml_tpu.models.params import (
     Param,
     Params,
 )
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 # --------------------------------------------------------------------------
@@ -49,6 +50,7 @@ class DCT(HasInputCol, HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         from scipy.fft import dct
 
@@ -76,6 +78,7 @@ class Interaction(HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         cols = self.get_or_default("inputCols")
         if not cols or len(cols) < 2:
@@ -121,6 +124,7 @@ class FeatureHasher(HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         from spark_rapids_ml_tpu.models.text import murmur3_x86_32
 
@@ -220,6 +224,7 @@ class VectorIndexerModel(VectorIndexerParams):
     def categorical_features_(self) -> List[int]:
         return sorted(self.category_maps or ())
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.category_maps is None:
             raise ValueError("model has no maps; fit first or load")
@@ -484,6 +489,7 @@ class RFormulaModel(RFormulaParams):
         other.label_source = self.label_source
         other.label_levels = self.label_levels
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.encoders is None:
             raise ValueError("model has no encoders; fit first or load")
@@ -578,6 +584,7 @@ class VectorSizeHint(HasInputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         size = self.get_or_default("size")
         if size is None:
@@ -624,6 +631,7 @@ class SQLTransformer(Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         import re
 

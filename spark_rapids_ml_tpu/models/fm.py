@@ -25,7 +25,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -242,9 +242,11 @@ class FMRegressor(_FMEstimatorBase):
 
 
 class FMRegressionModel(_FMModelBase):
+    @observed_transform
     def predict(self, x) -> np.ndarray:
         return self.raw_scores(x)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())
@@ -281,12 +283,14 @@ class FMClassificationModel(_FMModelBase):
     def classes_(self) -> np.ndarray:
         return np.asarray([0.0, 1.0])
 
+    @observed_transform
     def predict_proba(self, x) -> np.ndarray:
         from scipy.special import expit
 
         p1 = expit(self.raw_scores(x))
         return np.column_stack([1.0 - p1, p1])
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         from scipy.special import expit
 

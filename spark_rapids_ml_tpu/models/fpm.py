@@ -25,6 +25,7 @@ import numpy as np
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import Param, Params
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class _FPGrowthParams(Params):
@@ -183,6 +184,7 @@ class FPGrowthModel(_FPGrowthParams):
             "confidence": confs, "lift": lifts, "support": supps,
         })
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         """Spark semantics: for each basket, the union of consequents
         of rules whose antecedent is contained in the basket, minus

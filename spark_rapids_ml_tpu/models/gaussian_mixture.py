@@ -22,7 +22,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -282,6 +282,7 @@ class GaussianMixtureModel(GaussianMixtureParams):
         other.num_iterations_ = self.num_iterations_
         other.log_likelihood_ = self.log_likelihood_
 
+    @observed_transform
     def predict_proba(self, x) -> np.ndarray:
         """(n, k) responsibilities for a feature matrix."""
         if self.weights is None:
@@ -305,6 +306,7 @@ class GaussianMixtureModel(GaussianMixtureParams):
                 np, x, self.means, prec, log_det, np.log(self.weights))
         return np.asarray(resp, dtype=np.float64)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())

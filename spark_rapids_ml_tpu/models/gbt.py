@@ -35,6 +35,7 @@ from spark_rapids_ml_tpu.models.params import (
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class GBTParams(HasInputCol, HasDeviceId, HasWeightCol):
@@ -343,6 +344,7 @@ class GBTRegressor(_GBTBase):
 
 
 class GBTRegressionModel(_GBTModelBase):
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         pred = self._raw_score(frame.vectors_as_matrix(self.getInputCol()))
@@ -374,11 +376,13 @@ class GBTClassifier(GBTClassifierParams, _GBTBase):
 class GBTClassificationModel(GBTClassifierParams, _GBTModelBase):
     _classification = True
 
+    @observed_transform
     def predict_proba(self, dataset) -> np.ndarray:
         frame = as_vector_frame(dataset, self.getInputCol())
         z = self._raw_score(frame.vectors_as_matrix(self.getInputCol()))
         return _sigmoid(z)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         proba = self.predict_proba(frame)

@@ -27,7 +27,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.linear_regression import _centered_moments
 from spark_rapids_ml_tpu.models.params import (
@@ -389,6 +389,7 @@ class GeneralizedLinearRegressionModel(GeneralizedLinearRegressionParams):
         _, ginv, _ = link_funcs(link, link_power)
         return eta, np.asarray(ginv(np, eta), dtype=np.float64)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.coefficients is None:
             raise ValueError("model has no coefficients; fit first or load")

@@ -21,6 +21,7 @@ from spark_rapids_ml_tpu.models.params import (
     Param,
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class ImputerParams(HasInputCol, HasOutputCol):
@@ -100,6 +101,7 @@ class ImputerModel(ImputerParams):
     def _copy_internal_state(self, other: "ImputerModel") -> None:
         other.surrogates = self.surrogates
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.surrogates is None:
             raise ValueError("model is unfitted")

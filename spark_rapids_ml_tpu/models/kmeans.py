@@ -14,7 +14,11 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit, observed_transform
+from spark_rapids_ml_tpu.obs import (
+    observed_fit,
+    observed_transform,
+    transform_phase,
+)
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -360,17 +364,24 @@ class KMeansModel(KMeansParams):
             import jax
             import jax.numpy as jnp
 
-            from spark_rapids_ml_tpu.ops.kmeans_kernel import assign_clusters
+            from spark_rapids_ml_tpu.ops.kmeans_kernel import (
+                assign_clusters_jit,
+            )
 
             device = _resolve_device(self.getDeviceId())
             dtype = _resolve_dtype(self.getDtype())
-            x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
-            c_dev = jax.device_put(
-                jnp.asarray(self.cluster_centers, dtype=dtype), device
-            )
-            labels = np.asarray(jax.jit(assign_clusters)(x_dev, c_dev))
+            with transform_phase("device_put"):
+                x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
+                c_dev = jax.device_put(
+                    jnp.asarray(self.cluster_centers, dtype=dtype), device
+                )
+            with transform_phase("compute"):
+                labels_dev = assign_clusters_jit(x_dev, c_dev)
+            with transform_phase("host_sync"):
+                labels = np.asarray(jax.block_until_ready(labels_dev))
         else:
-            labels = _sqdist(x, self.cluster_centers).argmin(axis=1)
+            with transform_phase("compute"):
+                labels = _sqdist(x, self.cluster_centers).argmin(axis=1)
         return frame.with_column(
             self.getPredictionCol(), labels.astype(np.int32).tolist()
         )

@@ -31,6 +31,7 @@ from spark_rapids_ml_tpu.models.params import (
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class _LDAParams(HasInputCol, HasDeviceId):
@@ -410,6 +411,7 @@ class LDAModel(_LDAParams):
         gamma = np.asarray(gamma, dtype=np.float64)
         return gamma / gamma.sum(axis=1, keepdims=True)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         self._require_fitted()
         frame = as_vector_frame(dataset, self.getInputCol())

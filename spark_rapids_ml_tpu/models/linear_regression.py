@@ -15,7 +15,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -368,6 +368,7 @@ class LinearRegressionModel(LinearRegressionParams):
         other.coefficients = self.coefficients
         other.intercept = self.intercept
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.coefficients is None:
             raise ValueError("model has no coefficients; fit first or load")

@@ -49,6 +49,7 @@ from spark_rapids_ml_tpu.models.params import (
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class LinearSVCParams(HasInputCol, HasDeviceId, HasWeightCol):
@@ -407,13 +408,19 @@ class LinearSVCModel(LinearSVCParams):
             raw = x @ self.coefficients + self.intercept
         return raw.astype(np.float64)
 
-    # OneVsRest compatibility: per-class score = the margin
-    predict_proba = decision_function
+    # OneVsRest compatibility: per-class score = the margin (a real def,
+    # not an alias, so the serving instrumentation and its static check
+    # see it)
+    @observed_transform
+    def predict_proba(self, dataset) -> np.ndarray:
+        return self.decision_function(dataset)
 
+    @observed_transform
     def predict(self, dataset) -> np.ndarray:
         raw = self.decision_function(dataset)
         return (raw > float(self.getThreshold())).astype(np.float64)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         raw = self.decision_function(frame)

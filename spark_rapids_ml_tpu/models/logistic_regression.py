@@ -16,7 +16,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.utils.numeric import sigmoid as _sigmoid
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
@@ -717,6 +717,7 @@ class LogisticRegressionModel(LogisticRegressionParams):
         other.classes_ = self.classes_
         other.n_iter_ = self.n_iter_
 
+    @observed_transform
     def predict_proba(self, dataset) -> np.ndarray:
         """Binary: (n,) P(y=1). Multinomial: (n, K) softmax rows."""
         if self.coefficient_matrix is not None:
@@ -752,6 +753,7 @@ class LogisticRegressionModel(LogisticRegressionParams):
             proba = _sigmoid(z)
         return proba.astype(np.float64)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         proba = self.predict_proba(frame)  # reuse the built frame

@@ -34,6 +34,7 @@ from spark_rapids_ml_tpu.models.params import (
     Param,
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
+from spark_rapids_ml_tpu.obs import observed_transform
 
 _MINHASH_PRIME = 2038074743  # Spark's MinHashLSH.HASH_PRIME
 
@@ -56,6 +57,7 @@ class _LSHModelBase(_LSHParams):
     def _key_distance(self, x: np.ndarray, y: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())

@@ -38,6 +38,7 @@ from spark_rapids_ml_tpu.ops.mlp_kernel import (
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 def _valid_layers(v) -> bool:
@@ -194,11 +195,13 @@ class MultilayerPerceptronModel(MultilayerPerceptronParams):
         logits = forward_logits(params, jnp.asarray(x, dtype=dtype))
         return np.asarray(logits, dtype=np.float64)
 
+    @observed_transform
     def predict_proba(self, x) -> np.ndarray:
         logits = self._forward(np.asarray(x, dtype=np.float64))
         e = np.exp(logits - logits.max(axis=1, keepdims=True))
         return e / e.sum(axis=1, keepdims=True)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())

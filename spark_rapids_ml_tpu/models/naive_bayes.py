@@ -21,7 +21,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -246,6 +246,7 @@ class NaiveBayesModel(NaiveBayesParams):
         ).sum(axis=2)
         return self.pi[None, :] + ll
 
+    @observed_transform
     def predict_proba(self, dataset) -> np.ndarray:
         if self.theta is None:
             raise ValueError("model is unfitted")
@@ -256,6 +257,7 @@ class NaiveBayesModel(NaiveBayesParams):
         e = np.exp(jll)
         return e / e.sum(axis=1, keepdims=True)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         proba = self.predict_proba(frame)

@@ -19,6 +19,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import HasInputCol, Param
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class OneVsRestParams(HasInputCol):
@@ -123,6 +124,7 @@ class OneVsRestModel(OneVsRestParams):
                 )
         return np.stack(cols, axis=1)  # (n, K)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if not self.models:
             raise ValueError("no sub-models; fit first")

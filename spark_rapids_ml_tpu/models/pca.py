@@ -29,7 +29,11 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit, observed_transform
+from spark_rapids_ml_tpu.obs import (
+    observed_fit,
+    observed_transform,
+    transform_phase,
+)
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -631,20 +635,27 @@ class PCAModel(PCAParams):
             device = _resolve_device(self.getDeviceId())
             dtype = _resolve_dtype(self.getDtype())
             with TraceRange("xla transform", TraceColor.GREEN):
-                x = jax.device_put(jnp.asarray(x_host, dtype=dtype), device)
-                pc = jax.device_put(jnp.asarray(self.pc, dtype=dtype), device)
-                out = np.asarray(jax.block_until_ready(pca_transform_kernel(x, pc)))
+                with transform_phase("device_put"):
+                    x = jax.device_put(
+                        jnp.asarray(x_host, dtype=dtype), device)
+                    pc = jax.device_put(
+                        jnp.asarray(self.pc, dtype=dtype), device)
+                with transform_phase("compute"):
+                    out_dev = pca_transform_kernel(x, pc)
+                with transform_phase("host_sync"):
+                    out = np.asarray(jax.block_until_ready(out_dev))
         else:
             from spark_rapids_ml_tpu import native
 
             with TraceRange("host transform", TraceColor.GREEN):
-                if native.is_loaded():
-                    out = native.gemm(
-                        np.ascontiguousarray(x_host),
-                        np.ascontiguousarray(self.pc, dtype=np.float64),
-                    )
-                else:
-                    out = x_host @ self.pc
+                with transform_phase("compute"):
+                    if native.is_loaded():
+                        out = native.gemm(
+                            np.ascontiguousarray(x_host),
+                            np.ascontiguousarray(self.pc, dtype=np.float64),
+                        )
+                    else:
+                        out = x_host @ self.pc
         return frame.with_column(self.getOutputCol(), np.asarray(out, dtype=np.float64))
 
     def transform_schema(self, columns):

@@ -20,6 +20,7 @@ import os
 from typing import List, Optional
 
 from spark_rapids_ml_tpu.models.params import Params
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 def _is_estimator(stage) -> bool:
@@ -115,6 +116,7 @@ class PipelineModel(Params):
     def _copy_internal_state(self, other: "PipelineModel") -> None:
         other._stages = list(self._stages)
 
+    @observed_transform
     def transform(self, dataset):
         df = dataset
         for stage in self._stages:

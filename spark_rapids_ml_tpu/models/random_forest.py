@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -406,6 +406,7 @@ class RandomForestRegressor(_ForestBase):
 
 
 class RandomForestRegressionModel(_ForestModelBase):
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         pred = self._apply(frame.vectors_as_matrix(self.getInputCol()))
@@ -438,10 +439,12 @@ class RandomForestClassificationModel(
 ):
     _classification = True
 
+    @observed_transform
     def predict_proba(self, dataset) -> np.ndarray:
         frame = as_vector_frame(dataset, self.getInputCol())
         return self._apply(frame.vectors_as_matrix(self.getInputCol()))
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         proba = self._apply(frame.vectors_as_matrix(self.getInputCol()))

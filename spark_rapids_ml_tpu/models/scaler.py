@@ -18,7 +18,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -139,6 +139,7 @@ class StandardScalerModel(StandardScalerParams):
         other.mean = self.mean
         other.std = self.std
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.mean is None:
             raise ValueError("model has no statistics; fit first or load")

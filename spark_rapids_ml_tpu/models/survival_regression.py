@@ -34,6 +34,7 @@ from spark_rapids_ml_tpu.models.params import (
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 # --------------------------------------------------------------------------
@@ -187,6 +188,7 @@ class AFTSurvivalRegressionModel(AFTSurvivalRegressionParams):
         other.num_iterations_ = self.num_iterations_
         other.final_loss_ = self.final_loss_
 
+    @observed_transform
     def predict(self, x) -> np.ndarray:
         if self.coefficients is None:
             raise ValueError("model has no coefficients; fit first or load")
@@ -204,6 +206,7 @@ class AFTSurvivalRegressionModel(AFTSurvivalRegressionParams):
             base = self.predict(x)
         return base[:, None] * (-np.log1p(-probs))[None, :] ** self.scale
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         x = frame.vectors_as_matrix(self.getInputCol())
@@ -355,6 +358,7 @@ class IsotonicRegressionModel(IsotonicRegressionParams):
         other.boundaries = self.boundaries
         other.predictions = self.predictions
 
+    @observed_transform
     def predict(self, f: np.ndarray) -> np.ndarray:
         """Linear interpolation between boundaries, flat beyond the
         ends (Spark's predictionModel semantics)."""
@@ -363,6 +367,7 @@ class IsotonicRegressionModel(IsotonicRegressionParams):
         return np.interp(np.asarray(f, dtype=np.float64),
                          self.boundaries, self.predictions)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())
         f = self._feature_values(frame)

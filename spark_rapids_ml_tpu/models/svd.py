@@ -32,6 +32,7 @@ from spark_rapids_ml_tpu.models.params import (
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class TruncatedSVDParams(HasInputCol, HasOutputCol, HasDeviceId):
@@ -169,6 +170,7 @@ class TruncatedSVDModel(TruncatedSVDParams):
         other.singular_values = self.singular_values
         other.svd_solver_used_ = self.svd_solver_used_
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         """X @ V, batched on device (the posture the reference's transform
         path declared but disabled, ``RapidsPCA.scala:172-185``)."""

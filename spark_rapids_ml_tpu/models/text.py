@@ -28,6 +28,7 @@ from spark_rapids_ml_tpu.models.params import (
     Params,
 )
 from spark_rapids_ml_tpu.models.feature_transformers import _persistable
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 def murmur3_x86_32(data: bytes, seed: int = 42) -> int:
@@ -83,6 +84,7 @@ class Tokenizer(HasInputCol, HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, None)
         out = [str(s).lower().split()
@@ -111,6 +113,7 @@ class RegexTokenizer(HasInputCol, HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, None)
         pattern = re.compile(self.get_or_default("pattern"))
@@ -177,6 +180,7 @@ class StopWordsRemover(HasInputCol, HasOutputCol, Params):
                 "stopWords for other languages")
         return sorted(_ENGLISH_STOP_WORDS)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, None)
         words = self.get_or_default("stopWords")
@@ -205,6 +209,7 @@ class NGram(HasInputCol, HasOutputCol, Params):
         for name, value in params.items():
             self.set(name, value)
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, None)
         n = int(self.getN())
@@ -240,6 +245,7 @@ class HashingTF(HasInputCol, HasOutputCol, Params):
     # dense matrix (Spark emits SparseVectors), so cap the allocation
     _MAX_DENSE_BYTES = 2 << 30
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, None)
         m = int(self.get_or_default("numFeatures"))
@@ -319,6 +325,7 @@ class CountVectorizerModel(CountVectorizerParams):
     def _copy_internal_state(self, other) -> None:
         other.vocabulary = self.vocabulary
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, None)
         index = {t: i for i, t in enumerate(self.vocabulary)}
@@ -394,6 +401,7 @@ class IDFModel(IDFParams):
         other.doc_freq = self.doc_freq
         other.num_docs = self.num_docs
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         if self.idf is None:
             raise ValueError("IDFModel is unfitted")

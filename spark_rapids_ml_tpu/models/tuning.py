@@ -18,6 +18,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.data.frame import as_vector_frame
 from spark_rapids_ml_tpu.models.params import Param, Params
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 class ParamGridBuilder:
@@ -270,6 +271,7 @@ class CrossValidatorModel(_TuningParams):
         other.evaluator = self.evaluator
         other.estimatorParamMaps = self.estimatorParamMaps
 
+    @observed_transform
     def transform(self, dataset):
         if self.bestModel is None:
             raise ValueError("no bestModel; fit first")
@@ -362,6 +364,7 @@ class TrainValidationSplitModel(_TuningParams):
         other.evaluator = self.evaluator
         other.estimatorParamMaps = self.estimatorParamMaps
 
+    @observed_transform
     def transform(self, dataset):
         if self.bestModel is None:
             raise ValueError("no bestModel; fit first")

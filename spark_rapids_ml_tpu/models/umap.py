@@ -20,7 +20,7 @@ from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import HasDeviceId, HasInputCol, Param
 from spark_rapids_ml_tpu.models.pca import _resolve_device, _resolve_dtype
@@ -258,6 +258,7 @@ class UMAPModel(UMAPParams):
         other.train_items_ = self.train_items_
         other.ab_ = self.ab_
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         """Out-of-sample placement: each new row lands at the
         membership-weighted average of its nNeighbors nearest FITTED

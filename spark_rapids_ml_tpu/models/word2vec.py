@@ -22,7 +22,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import observed_fit
+from spark_rapids_ml_tpu.obs import observed_transform, observed_fit
 from spark_rapids_ml_tpu.data.frame import VectorFrame, as_vector_frame
 from spark_rapids_ml_tpu.models.params import (
     HasDeviceId,
@@ -277,6 +277,7 @@ class Word2VecModel(_Word2VecParams):
             "similarity": [float(sims[i]) for i in order],
         })
 
+    @observed_transform
     def transform(self, dataset) -> VectorFrame:
         """Document vector = mean of its in-vocabulary word vectors
         (zero vector for fully out-of-vocabulary docs, like Spark)."""

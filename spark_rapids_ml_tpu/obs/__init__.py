@@ -11,6 +11,10 @@ One import surface for everything observability:
 * ``fit_instrumentation`` / ``observed_fit`` / ``current_fit`` — the
   shared instrumentation entry points that give every distributed driver
   and estimator a uniform ``fit_report_``;
+* ``observed_transform`` / ``current_transform`` / ``transform_phase`` —
+  the serving tier (``obs.serving``): every transform/predict entry point
+  yields a ``TransformReport``, feeds the latency quantile sketch
+  (``obs.quantiles``), and passes the numerics sentinel;
 * back-compat re-exports of the underlying ``utils`` primitives
   (``TraceRange``, ``PhaseTimer``, ``DeviceHealth``…), so telemetry
   consumers need only this package.
@@ -22,8 +26,13 @@ from spark_rapids_ml_tpu.obs.metrics import (  # noqa: F401
     Gauge,
     Histogram,
     MetricsRegistry,
+    Summary,
     get_registry,
     start_prometheus_server,
+)
+from spark_rapids_ml_tpu.obs.quantiles import (  # noqa: F401
+    QuantileSketch,
+    merge_all,
 )
 from spark_rapids_ml_tpu.obs.spans import (  # noqa: F401
     SpanEvent,
@@ -58,6 +67,7 @@ from spark_rapids_ml_tpu.obs.memory import (  # noqa: F401
 from spark_rapids_ml_tpu.obs.flight import (  # noqa: F401
     DUMP_DIR_ENV,
     FIT_BUDGET_ENV,
+    TRANSFORM_BUDGET_ENV,
     Watchdog,
     build_dump,
     deadline,
@@ -75,7 +85,18 @@ from spark_rapids_ml_tpu.obs.report import (  # noqa: F401
     fit_instrumentation,
     last_fit_report,
     observed_fit,
+)
+from spark_rapids_ml_tpu.obs.serving import (  # noqa: F401
+    NUMERICS_SAMPLE_ENV,
+    TRANSFORM_REPORT_ATTR,
+    TransformContext,
+    TransformReport,
+    check_output_numerics,
+    current_transform,
+    last_transform_report,
+    latency_quantiles,
     observed_transform,
+    transform_phase,
 )
 
 # Back-compat shims: the pre-obs utils primitives, re-exported so telemetry
@@ -103,15 +124,22 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "NUMERICS_SAMPLE_ENV",
     "PhaseTimer",
+    "QuantileSketch",
     "REPORT_ATTR",
     "STORM_ENV",
     "SpanEvent",
     "SpanRecorder",
+    "Summary",
     "TRACE_DIR_ENV",
+    "TRANSFORM_BUDGET_ENV",
+    "TRANSFORM_REPORT_ATTR",
     "TraceColor",
     "TraceRange",
     "TrackedJit",
+    "TransformContext",
+    "TransformReport",
     "Watchdog",
     "active_spans",
     "analytic_mfu",
@@ -119,10 +147,12 @@ __all__ = [
     "build_dump",
     "check_devices",
     "check_devices_subprocess",
+    "check_output_numerics",
     "compile_log",
     "compile_stats",
     "current_fit",
     "current_trace_id",
+    "current_transform",
     "deadline",
     "device_memory_stats",
     "dump",
@@ -134,8 +164,11 @@ __all__ = [
     "get_watchdog",
     "host_peak_rss_bytes",
     "last_fit_report",
+    "last_transform_report",
+    "latency_quantiles",
     "maybe_export_trace",
     "memory_watermarks",
+    "merge_all",
     "new_trace_id",
     "observed_fit",
     "observed_transform",
@@ -147,4 +180,5 @@ __all__ = [
     "start_prometheus_server",
     "track_compiles",
     "tracked_jit",
+    "transform_phase",
 ]

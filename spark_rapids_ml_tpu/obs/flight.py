@@ -25,7 +25,6 @@ most once per armed context.
 from __future__ import annotations
 
 import contextlib
-import datetime
 import json
 import os
 import sys
@@ -37,7 +36,12 @@ from typing import Any, Dict, Optional
 
 DUMP_DIR_ENV = "SPARK_RAPIDS_ML_TPU_DUMP_DIR"
 FIT_BUDGET_ENV = "SPARK_RAPIDS_ML_TPU_FIT_BUDGET_SECONDS"
+TRANSFORM_BUDGET_ENV = "SPARK_RAPIDS_ML_TPU_TRANSFORM_BUDGET_SECONDS"
 _DEFAULT_FIT_BUDGET = 900.0
+# Serving calls are expected to be fast, but the first call through a cold
+# model pays the full XLA compile (tens of seconds at scale) — the default
+# budget must cover that, not just the steady-state per-batch latency.
+_DEFAULT_TRANSFORM_BUDGET = 120.0
 _SPAN_RING_TAIL = 128
 
 
@@ -54,10 +58,21 @@ def fit_budget_seconds() -> float:
     return budget if budget > 0 else float("inf")
 
 
+def transform_budget_seconds() -> float:
+    """Watchdog budget for one instrumented transform/predict call
+    (``SPARK_RAPIDS_ML_TPU_TRANSFORM_BUDGET_SECONDS``; <= 0 disarms)."""
+    try:
+        budget = float(os.environ.get(TRANSFORM_BUDGET_ENV,
+                                      _DEFAULT_TRANSFORM_BUDGET))
+    except ValueError:
+        return _DEFAULT_TRANSFORM_BUDGET
+    return budget if budget > 0 else float("inf")
+
+
 def _utcnow() -> str:
-    return datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%S.%fZ"
-    )
+    from spark_rapids_ml_tpu.obs.spans import utcnow_iso
+
+    return utcnow_iso()
 
 
 def _thread_stacks() -> Dict[str, Any]:
@@ -148,8 +163,12 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None
             f"_{os.getpid()}.json",
         )
         doc = build_dump(reason, extra=extra)
-        with open(path, "w") as f:
+        # atomic publish: consumers watching the dump dir (tests, ops
+        # tooling) must never observe a half-written JSON document
+        tmp_path = path + ".tmp"
+        with open(tmp_path, "w") as f:
             json.dump(doc, f, indent=1, default=str)
+        os.replace(tmp_path, path)
         print(f"# flight recorder: dumped {reason!r} -> {path}",
               file=sys.stderr, flush=True)
         try:

@@ -217,6 +217,68 @@ class Histogram(_Metric):
             }
 
 
+class Summary(_Metric):
+    """Quantile summary backed by a mergeable streaming sketch.
+
+    Where ``Histogram`` answers with fixed-bucket counts, ``Summary``
+    answers with true quantiles at a documented relative error
+    (``obs.quantiles.QuantileSketch``, DDSketch-style): ``observe`` is
+    O(1), ``quantile(q)`` is exact-rank over log buckets. The Prometheus
+    exposition emits ``name{quantile="0.5"}``-style lines (summary type)
+    alongside whatever ``_bucket`` series the histograms export.
+    """
+
+    kind = "summary"
+    DEFAULT_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
+    class _Child:
+        __slots__ = ("sketch",)
+
+        def __init__(self, alpha: float, max_bins: int):
+            from spark_rapids_ml_tpu.obs.quantiles import QuantileSketch
+
+            self.sketch = QuantileSketch(alpha=alpha, max_bins=max_bins)
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        labelnames: Tuple[str, ...] = (),
+        alpha: float = 0.01,
+        max_bins: int = 4096,
+        quantiles: Tuple[float, ...] = DEFAULT_QUANTILES,
+    ):
+        super().__init__(name, help_text, labelnames)
+        self.alpha = float(alpha)
+        self.max_bins = int(max_bins)
+        self.quantiles = tuple(float(q) for q in quantiles)
+
+    def _new_child(self):
+        return Summary._Child(self.alpha, self.max_bins)
+
+    def observe(self, value: float, **labels) -> None:
+        self._child(labels).sketch.observe(value)
+
+    def quantile(self, q: float, **labels):
+        return self._child(labels).sketch.quantile(q)
+
+    def sketch(self, **labels):
+        """The underlying ``QuantileSketch`` for one label set (merge it,
+        serialize it, embed it in a bench record)."""
+        return self._child(labels).sketch
+
+    def snapshot_child(self, **labels) -> Dict[str, object]:
+        sketch = self._child(labels).sketch
+        return {
+            "count": sketch.count,
+            "sum": sketch.sum,
+            "alpha": self.alpha,
+            "quantiles": {
+                _format_value(q): sketch.quantile(q) for q in self.quantiles
+            },
+        }
+
+
 class MetricsRegistry:
     """Process-wide metric family registry.
 
@@ -260,6 +322,15 @@ class MetricsRegistry:
             Histogram, name, help_text, labelnames, buckets=buckets
         )
 
+    def summary(
+        self, name, help_text="", labelnames=(), alpha=0.01,
+        max_bins=4096, quantiles=Summary.DEFAULT_QUANTILES,
+    ) -> Summary:
+        return self._get_or_create(
+            Summary, name, help_text, labelnames, alpha=alpha,
+            max_bins=max_bins, quantiles=quantiles,
+        )
+
     def reset(self) -> None:
         """Drop every family (tests / fresh bench windows)."""
         with self._lock:
@@ -278,7 +349,7 @@ class MetricsRegistry:
             samples = []
             for key, _child in metric._samples():
                 labels = metric._label_dict(key)
-                if isinstance(metric, Histogram):
+                if isinstance(metric, (Histogram, Summary)):
                     samples.append(
                         {"labels": labels,
                          **metric.snapshot_child(**labels)}
@@ -317,6 +388,24 @@ class MetricsRegistry:
                             f'le="{le}"'
                         lines.append(
                             f"{metric.name}_bucket{{{bl}}} {cum}"
+                        )
+                    suffix = f"{{{label_str}}}" if label_str else ""
+                    lines.append(
+                        f"{metric.name}_sum{suffix} "
+                        f"{_format_value(snap['sum'])}"
+                    )
+                    lines.append(
+                        f"{metric.name}_count{suffix} {snap['count']}"
+                    )
+                elif isinstance(metric, Summary):
+                    snap = metric.snapshot_child(**labels)
+                    for q, value in snap["quantiles"].items():
+                        if value is None:
+                            continue
+                        ql = (label_str + "," if label_str else "") + \
+                            f'quantile="{q}"'
+                        lines.append(
+                            f"{metric.name}{{{ql}}} {_format_value(value)}"
                         )
                     suffix = f"{{{label_str}}}" if label_str else ""
                     lines.append(

@@ -1,0 +1,302 @@
+"""Streaming quantile sketch: mergeable, bounded-memory, relative-error.
+
+The fixed-bucket histograms in ``obs.metrics`` answer "how many calls took
+under 100ms" but cannot report a true p99 — the answer is quantized to
+whatever bucket boundary the latency lands in, and serving-path latencies
+span six orders of magnitude (a cached 50µs scaler transform vs a 30s
+first-compile PCA projection). This module is the DDSketch-style fix
+(Masson et al., VLDB 2019 — the same family Flare-style query-path
+attribution leans on): logarithmic buckets sized so every quantile estimate
+is within a *relative* error ``alpha`` of a true sample value, regardless
+of the distribution's scale or shape.
+
+Guarantee (documented bound, tested in ``tests/test_obs_quantiles.py``):
+for any quantile ``q`` whose true sample value is ``x`` (positive or
+negative, within the un-collapsed index range), the estimate ``x̂``
+satisfies ``|x̂ - x| <= alpha * |x|``. Zero is represented exactly.
+
+Properties the serving tier needs:
+
+* **streaming** — ``observe`` is O(1) dict updates under one lock;
+* **mergeable** — ``merge``/``merged`` add bucket counts pointwise, so
+  per-thread / per-process / per-host sketches combine losslessly
+  (merge is associative and commutative — tested);
+* **bounded memory** — at most ``max_bins`` buckets per sign; overflowing
+  collapses the *smallest-magnitude* buckets together (the DDSketch
+  "collapse lowest" policy), preserving the bound for the large-magnitude
+  tail that p95/p99 live in;
+* **serializable** — ``to_dict``/``from_dict`` round-trip for embedding in
+  bench records and merging offline.
+
+Thread safety: all public methods take the instance lock; concurrent
+``observe`` from Spark-style worker threads is safe and lossless.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+DEFAULT_ALPHA = 0.01
+DEFAULT_MAX_BINS = 4096
+
+# Smallest magnitude the log-index can represent without float underflow;
+# observations below it (in magnitude) count into the zero bucket — for
+# latency/throughput/output values this is far below measurement noise.
+_MIN_INDEXABLE = 1e-300
+
+
+class QuantileSketch:
+    """DDSketch-style log-bucket quantile sketch (see module doc).
+
+    ``alpha`` is the guaranteed relative accuracy; ``max_bins`` bounds
+    memory per sign (4096 bins at alpha=0.01 covers ~36 decades — nothing
+    collapses in practice, the cap is a safety rail).
+    """
+
+    def __init__(self, alpha: float = DEFAULT_ALPHA,
+                 max_bins: int = DEFAULT_MAX_BINS):
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        if max_bins < 2:
+            raise ValueError("max_bins must be >= 2")
+        self.alpha = float(alpha)
+        self.max_bins = int(max_bins)
+        self._gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._log_gamma = math.log(self._gamma)
+        self._pos: Dict[int, int] = {}
+        self._neg: Dict[int, int] = {}
+        self._zero = 0
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self._collapsed = False
+        self._lock = threading.Lock()
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index(self, magnitude: float) -> int:
+        return int(math.ceil(math.log(magnitude) / self._log_gamma))
+
+    def _value(self, index: int) -> float:
+        # bucket i covers (gamma^(i-1), gamma^i]; its midpoint estimate
+        # 2*gamma^i/(gamma+1) is within alpha of every value in the range
+        try:
+            return 2.0 * math.exp(index * self._log_gamma) / (self._gamma + 1.0)
+        except OverflowError:
+            return math.inf
+
+    def _collapse_locked(self, store: Dict[int, int]) -> None:
+        """Merge smallest-magnitude buckets until under the cap — the
+        large-magnitude tail (upper quantiles of latency) keeps its bound."""
+        while len(store) > self.max_bins:
+            lowest = min(store)
+            second = min(k for k in store if k != lowest)
+            store[second] += store.pop(lowest)
+            self._collapsed = True
+
+    # -- ingestion ---------------------------------------------------------
+
+    def observe(self, value: float) -> None:
+        """Add one observation. NaN is ignored (a sketch of latencies or
+        outputs must never be poisoned by one bad sample); infinities are
+        clamped into the largest representable bucket."""
+        value = float(value)
+        if math.isnan(value):
+            return
+        with self._lock:
+            self._count += 1
+            self._sum += value if math.isfinite(value) else 0.0
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+            magnitude = abs(value)
+            if magnitude < _MIN_INDEXABLE:
+                self._zero += 1
+                return
+            store = self._pos if value > 0 else self._neg
+            if math.isinf(magnitude):
+                index = self._index(1e308)
+            else:
+                index = self._index(magnitude)
+            store[index] = store.get(index, 0) + 1
+            self._collapse_locked(store)
+
+    def add(self, values: Iterable[float]) -> None:
+        for v in values:
+            self.observe(v)
+
+    # -- merging -----------------------------------------------------------
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """In-place pointwise merge; sketches must share ``alpha``."""
+        if abs(other.alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge sketches with alpha {self.alpha} and "
+                f"{other.alpha}"
+            )
+        # Snapshot other under its lock, then fold under ours (consistent
+        # ordering is irrelevant: merge never takes both locks at once).
+        with other._lock:
+            pos = dict(other._pos)
+            neg = dict(other._neg)
+            zero, count, total = other._zero, other._count, other._sum
+            omin, omax = other._min, other._max
+            collapsed = other._collapsed
+        with self._lock:
+            for idx, c in pos.items():
+                self._pos[idx] = self._pos.get(idx, 0) + c
+            for idx, c in neg.items():
+                self._neg[idx] = self._neg.get(idx, 0) + c
+            self._zero += zero
+            self._count += count
+            self._sum += total
+            if omin is not None and (self._min is None or omin < self._min):
+                self._min = omin
+            if omax is not None and (self._max is None or omax > self._max):
+                self._max = omax
+            self._collapsed = self._collapsed or collapsed
+            self._collapse_locked(self._pos)
+            self._collapse_locked(self._neg)
+        return self
+
+    def merged(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Non-destructive merge returning a fresh sketch."""
+        out = QuantileSketch(alpha=self.alpha, max_bins=self.max_bins)
+        out.merge(self)
+        out.merge(other)
+        return out
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def min(self) -> Optional[float]:
+        with self._lock:
+            return self._min
+
+    @property
+    def max(self) -> Optional[float]:
+        with self._lock:
+            return self._max
+
+    @property
+    def collapsed(self) -> bool:
+        with self._lock:
+            return self._collapsed
+
+    def bin_count(self) -> int:
+        with self._lock:
+            return len(self._pos) + len(self._neg) + (1 if self._zero else 0)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """The value at quantile ``q`` in [0, 1], or None when empty.
+
+        q=0 and q=1 return the exact tracked min/max; interior quantiles
+        return the bucket estimate (within ``alpha`` relative error of a
+        true sample value at that rank).
+        """
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0:
+                return None
+            if q == 0.0:
+                return self._min
+            if q == 1.0:
+                return self._max
+            rank = q * (self._count - 1)
+            # ascending value order: negatives (large magnitude first),
+            # zero, positives (small magnitude first)
+            seen = 0
+            for idx in sorted(self._neg, reverse=True):
+                seen += self._neg[idx]
+                if seen > rank:
+                    estimate = -self._value(idx)
+                    if self._min is not None:
+                        estimate = max(estimate, self._min)
+                    if self._max is not None:
+                        estimate = min(estimate, self._max)
+                    return estimate
+            seen += self._zero
+            if self._zero and seen > rank:
+                return 0.0
+            for idx in sorted(self._pos):
+                seen += self._pos[idx]
+                if seen > rank:
+                    estimate = self._value(idx)
+                    if self._max is not None:
+                        estimate = min(estimate, self._max)
+                    if self._min is not None:
+                        estimate = max(estimate, self._min)
+                    return estimate
+            return self._max
+
+    def quantiles(self, qs: Iterable[float]) -> Dict[str, Optional[float]]:
+        """``{"p50": v, "p99": v, ...}`` for fractional ``qs`` — the shape
+        bench records and ``TransformReport`` embed."""
+        out: Dict[str, Optional[float]] = {}
+        for q in qs:
+            label = f"p{q * 100:g}".replace(".", "_")
+            out[label] = self.quantile(q)
+        return out
+
+    # -- serialization -----------------------------------------------------
+
+    def to_dict(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "alpha": self.alpha,
+                "max_bins": self.max_bins,
+                "pos": {str(k): v for k, v in self._pos.items()},
+                "neg": {str(k): v for k, v in self._neg.items()},
+                "zero": self._zero,
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "collapsed": self._collapsed,
+            }
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, object]) -> "QuantileSketch":
+        sketch = cls(alpha=float(doc.get("alpha", DEFAULT_ALPHA)),
+                     max_bins=int(doc.get("max_bins", DEFAULT_MAX_BINS)))
+        sketch._pos = {int(k): int(v)
+                       for k, v in dict(doc.get("pos", {})).items()}
+        sketch._neg = {int(k): int(v)
+                       for k, v in dict(doc.get("neg", {})).items()}
+        sketch._zero = int(doc.get("zero", 0))
+        sketch._count = int(doc.get("count", 0))
+        sketch._sum = float(doc.get("sum", 0.0))
+        sketch._min = None if doc.get("min") is None else float(doc["min"])
+        sketch._max = None if doc.get("max") is None else float(doc["max"])
+        sketch._collapsed = bool(doc.get("collapsed", False))
+        return sketch
+
+    def __repr__(self) -> str:
+        return (f"QuantileSketch(alpha={self.alpha}, count={self._count}, "
+                f"bins={len(self._pos) + len(self._neg)})")
+
+
+def merge_all(sketches: Iterable[QuantileSketch]) -> Optional[QuantileSketch]:
+    """Fold any number of sketches into one (None for an empty iterable)."""
+    out: Optional[QuantileSketch] = None
+    for sketch in sketches:
+        if out is None:
+            out = QuantileSketch(alpha=sketch.alpha,
+                                 max_bins=sketch.max_bins)
+        out.merge(sketch)
+    return out

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-import datetime
 import functools
 import threading
 import time
@@ -307,10 +306,7 @@ def _health_once() -> Optional[Dict[str, Any]]:
 # -- report assembly -------------------------------------------------------
 
 
-def _utcnow() -> str:
-    return datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%SZ"
-    )
+_utcnow = spans.utcnow_iso
 
 
 def _find_mesh(args, kwargs):
@@ -538,23 +534,23 @@ def _reporting_subclass(cls: type) -> type:
         return sub
 
 
-def attach_report(result, report: FitReport):
-    """Attach ``fit_report_`` to a fit result, wrapping when needed.
+def attach_report(result, report, attr: str = REPORT_ATTR):
+    """Attach a report to a result under ``attr``, wrapping when needed.
 
     Handles model objects (plain setattr), NamedTuples and tuples
     (attribute-capable subclass), and ndarrays (subclass view). Results
     that cannot carry attributes are returned unchanged — the report stays
-    reachable via ``last_fit_report()``.
+    reachable via ``last_fit_report()`` / ``last_transform_report()``.
     """
     try:
-        setattr(result, REPORT_ATTR, report)
+        setattr(result, attr, report)
         return result
     except (AttributeError, TypeError):
         pass
     try:
         if isinstance(result, np.ndarray):
             out = result.view(_reporting_subclass(type(result)))
-            setattr(out, REPORT_ATTR, report)
+            setattr(out, attr, report)
             return out
         if isinstance(result, tuple):
             cls = type(result)
@@ -563,7 +559,7 @@ def attach_report(result, report: FitReport):
                 out = sub._make(result)
             else:
                 out = tuple.__new__(sub, result)
-            setattr(out, REPORT_ATTR, report)
+            setattr(out, attr, report)
             return out
     except Exception:
         pass
@@ -661,32 +657,10 @@ def observed_fit(algo: str):
     return decorator
 
 
-def observed_transform(algo: str):
-    """Wrap an estimator/model ``transform``: span + rows counter (no
-    report object — transforms return data, not models)."""
+def observed_transform(algo=None):
+    """Moved: the serving-tier decorator lives in ``obs.serving`` (full
+    ``TransformReport`` + sketch latency + numerics sentinel). This alias
+    keeps old import paths working."""
+    from spark_rapids_ml_tpu.obs.serving import observed_transform as _ot
 
-    def decorator(method):
-        @functools.wraps(method)
-        def wrapper(self, dataset, *args, **kwargs):
-            with spans.span(f"transform:{algo}", TraceColor.PURPLE):
-                out = method(self, dataset, *args, **kwargs)
-            try:
-                reg = get_registry()
-                reg.counter(
-                    "sparkml_transforms_total", "completed transforms",
-                    ("algo",),
-                ).inc(algo=algo)
-                stats = _array_stats(dataset)
-                if stats is not None and stats[0]:
-                    reg.counter(
-                        "sparkml_rows_transformed_total",
-                        "rows seen by transforms", ("algo",),
-                    ).inc(stats[0], algo=algo)
-            except Exception:
-                pass
-            return out
-
-        wrapper.__obs_instrumented__ = algo
-        return wrapper
-
-    return decorator
+    return _ot(algo)

@@ -37,6 +37,17 @@ from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 TRACE_DIR_ENV = "SPARK_RAPIDS_ML_TPU_TRACE_DIR"
 
 
+def utcnow_iso() -> str:
+    """Microsecond-precision UTC timestamp — the one formatter every obs
+    artifact (fit/transform reports, flight dumps) shares, so telemetry
+    from different tiers orders correctly within a second."""
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ"
+    )
+
+
 def new_trace_id() -> str:
     return uuid.uuid4().hex[:16]
 

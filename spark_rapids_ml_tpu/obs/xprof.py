@@ -371,6 +371,13 @@ class TrackedJit:
                 event.lowering_seconds + event.compile_seconds,
                 recompile=event.recompile,
             )
+            from spark_rapids_ml_tpu.obs.serving import current_transform
+
+            current_transform().record_compile(
+                self.label,
+                event.lowering_seconds + event.compile_seconds,
+                recompile=event.recompile,
+            )
         except Exception:
             pass  # telemetry must never break a kernel
 
@@ -379,6 +386,11 @@ class TrackedJit:
             from spark_rapids_ml_tpu.obs.report import current_fit
 
             current_fit().record_program(
+                self.label, entry.flops, entry.bytes_accessed
+            )
+            from spark_rapids_ml_tpu.obs.serving import current_transform
+
+            current_transform().record_program(
                 self.label, entry.flops, entry.bytes_accessed
             )
         except Exception:

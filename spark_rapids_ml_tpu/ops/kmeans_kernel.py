@@ -45,6 +45,12 @@ def assign_clusters(x: jnp.ndarray, centers: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmin(_pairwise_sqdist(x, centers), axis=1)
 
 
+# Serving-path entry point: the standalone jitted assignment with compile
+# telemetry (models.KMeansModel.transform). assign_clusters itself stays
+# un-jitted so it fuses inside the training-loop programs.
+assign_clusters_jit = tracked_jit(assign_clusters, label="kmeans_assign")
+
+
 @partial(tracked_jit, static_argnames=("n_clusters",))
 def kmeans_plus_plus_init(
     x: jnp.ndarray,

@@ -37,6 +37,7 @@ from spark_rapids_ml_tpu.spark._compat import (
     VectorUDT,
     pandas_udf,
 )
+from spark_rapids_ml_tpu.obs import observed_transform
 
 __all__ = [
     "GBTClassifier",
@@ -270,6 +271,7 @@ class _AdapterModel(Model):
         # expose fitted attributes (feature_importances_, classes_, ...)
         return getattr(object.__getattribute__(self, "_local"), attr)
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         in_col = local.getInputCol()
@@ -292,6 +294,7 @@ class _AdapterModel(Model):
 
         return dataset.withColumn(out_col, apply_model(dataset[in_col]))
 
+    @observed_transform
     def transform(self, dataset, params=None):
         return self._transform(dataset)
 
@@ -312,6 +315,7 @@ class _ClassifierAdapterModel(_AdapterModel):
 
     _proba_scalar = False   # local probabilityCol holds P(y=1) scalars
 
+    @observed_transform
     def _transform(self, dataset):
         import numpy as np_
 
@@ -370,6 +374,7 @@ class _SVCAdapterModel(_AdapterModel):
     cheap margin-vs-threshold UDF. ``''`` in either column param disables
     that column (Spark convention)."""
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         in_col = local.getInputCol()
@@ -415,6 +420,7 @@ class _GLMAdapterModel(_AdapterModel):
     dropping a fitted exposure produces wrong rates (documented in
     ``models/glm.py``)."""
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         in_col = local.getInputCol()
@@ -778,6 +784,7 @@ class NearestNeighborsModel(_AdapterModel):
             job, "knn_indices array<bigint>, knn_distances array<double>"
         )
 
+    @observed_transform
     def _transform(self, dataset):
         raise NotImplementedError(
             "NearestNeighborsModel has no column-appending transform; "

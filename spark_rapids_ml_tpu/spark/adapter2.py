@@ -54,6 +54,7 @@ from spark_rapids_ml_tpu.models.fpm import (  # noqa: E402
     FPGrowth as _LFPG,
     FPGrowthModel as _LFPG_M,
 )
+from spark_rapids_ml_tpu.obs import observed_transform
 
 __all__ = [
     "ALS",
@@ -102,6 +103,7 @@ class ALSModel(_AdapterModel):
 
     _local_model_cls = _LALS_M
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         ucol = local.getUserCol()
@@ -166,6 +168,7 @@ class Word2VecModel(_AdapterModel):
 
     _local_model_cls = _LW2V_M
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         in_col = local.getInputCol()
@@ -215,6 +218,7 @@ class FPGrowthModel(_AdapterModel):
 
     _local_model_cls = _LFPG_M
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         in_col = local.get_or_default("itemsCol")

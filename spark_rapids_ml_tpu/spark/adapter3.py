@@ -57,6 +57,7 @@ from spark_rapids_ml_tpu.models.survival_regression import (  # noqa: E402
     IsotonicRegression as _LISO,
     IsotonicRegressionModel as _LISO_M,
 )
+from spark_rapids_ml_tpu.obs import observed_transform
 
 __all__ = [
     "AFTSurvivalRegression",
@@ -167,6 +168,7 @@ class AFTSurvivalRegressionModel(_AdapterModel):
 
     _local_model_cls = _LAFT_M
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         in_col = local.getInputCol()
@@ -230,6 +232,7 @@ class DBSCANModel(_AdapterModel):
 
     _local_model_cls = _LDBSCAN_M
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         if local.labels_ is None:

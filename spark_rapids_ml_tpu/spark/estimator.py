@@ -46,6 +46,7 @@ from spark_rapids_ml_tpu.spark.aggregate import (
     partition_gram_stats_arrow,
     stats_spark_ddl,
 )
+from spark_rapids_ml_tpu.obs import observed_transform
 
 
 def _select_stats_plane(executor_device, device_fn, host_fn):
@@ -281,6 +282,7 @@ class PCAModel(Model, _TpuPCAParams):
         self.explainedVariance = explainedVariance
         self.mean = mean
 
+    @observed_transform
     def _transform(self, dataset):
         import pandas as pd
         from spark_rapids_ml_tpu.spark._compat import pandas_udf
@@ -465,6 +467,7 @@ class LinearRegressionModel(Model, _TpuLinRegParams):
         self.coefficients = coefficients
         self.intercept = intercept
 
+    @observed_transform
     def _transform(self, dataset):
         import pandas as pd
         from spark_rapids_ml_tpu.spark._compat import pandas_udf
@@ -887,6 +890,7 @@ class LogisticRegressionModel(Model, _TpuLogRegParams):
     def hasSummary(self) -> bool:
         return self.objective_history_ is not None
 
+    @observed_transform
     def _transform(self, dataset):
         import pandas as pd
         from spark_rapids_ml_tpu.spark._compat import col, pandas_udf
@@ -1207,6 +1211,7 @@ class KMeansModel(Model, _TpuKMeansParams):
             k=len(self._centers),
         )
 
+    @observed_transform
     def _transform(self, dataset):
         import pandas as pd
         from spark_rapids_ml_tpu.spark._compat import pandas_udf

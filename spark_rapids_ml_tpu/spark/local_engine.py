@@ -29,6 +29,7 @@ import uuid
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
+from spark_rapids_ml_tpu.obs import observed_transform
 
 __all__ = [
     "DenseMatrix",
@@ -307,6 +308,7 @@ class Estimator(Params):
 
 
 class Model(Params):
+    @observed_transform
     def transform(self, dataset, params=None):
         return self._transform(dataset)
 

@@ -48,6 +48,7 @@ from spark_rapids_ml_tpu.models import feature_scalers as _fs  # noqa: E402
 from spark_rapids_ml_tpu.models import feature_transformers as _ft  # noqa: E402
 from spark_rapids_ml_tpu.models import feature_transformers2 as _ft2  # noqa: E402
 from spark_rapids_ml_tpu.models import text as _tx  # noqa: E402
+from spark_rapids_ml_tpu.obs import observed_transform
 
 __all__ = [
     "Binarizer",
@@ -142,6 +143,7 @@ class _FrontTransform(_AdapterModel):
         out = self._local.transform(dataset)  # as_vector_frame duck-path
         return _frame_to_df(_session_of(dataset), out)
 
+    @observed_transform
     def _transform(self, dataset):
         if self._row_dropping():
             return self._rebuild_transform(dataset)
@@ -305,6 +307,7 @@ class VectorSizeHint(_FrontTransform):
 
     _local_model_cls = _ft2.VectorSizeHint
 
+    @observed_transform
     def _transform(self, dataset):
         local = self._local
         mode = local.get_or_default("handleInvalid")
@@ -334,6 +337,7 @@ class SQLTransformer(_FrontTransform):
     _local_model_cls = _ft2.SQLTransformer
     _in_params: tuple = ()
 
+    @observed_transform
     def _transform(self, dataset):
         return self._rebuild_transform(dataset)
 
@@ -346,6 +350,7 @@ class RFormulaModel(_FrontTransform):
     _local_model_cls = _ft2.RFormulaModel
     _in_params: tuple = ()
 
+    @observed_transform
     def _transform(self, dataset):
         return self._rebuild_transform(dataset)
 

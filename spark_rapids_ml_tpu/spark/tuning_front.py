@@ -39,6 +39,7 @@ from spark_rapids_ml_tpu.models.tuning import (
     _TuningParams,
 )
 from spark_rapids_ml_tpu.models.params import Param, Params
+from spark_rapids_ml_tpu.obs import observed_transform
 
 __all__ = [
     "CrossValidator",
@@ -214,6 +215,7 @@ class PipelineModel(Params):
     def _copy_internal_state(self, other: "PipelineModel") -> None:
         other._stages = list(self._stages)
 
+    @observed_transform
     def transform(self, dataset):
         df = dataset
         for stage in self._stages:

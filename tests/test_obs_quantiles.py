@@ -1,0 +1,227 @@
+"""Streaming quantile sketch (obs.quantiles): the documented relative
+error bound on adversarial distributions, merge associativity, bounded
+memory under collapse, thread safety, and the Summary metric exposition."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs.metrics import MetricsRegistry
+from spark_rapids_ml_tpu.obs.quantiles import QuantileSketch, merge_all
+
+ALPHA = 0.01
+QS = (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 0.999)
+
+
+def _assert_within_bound(sketch, data, alpha=ALPHA, qs=QS):
+    """DDSketch bound: the estimate lies within alpha (relative) of an
+    actual sample value at the queried rank — bracket with the 'lower'
+    and 'higher' interpolations so numpy's midpoint averaging never
+    manufactures a spurious failure."""
+    for q in qs:
+        est = sketch.quantile(q)
+        lo = np.percentile(data, q * 100, method="lower")
+        hi = np.percentile(data, q * 100, method="higher")
+        floor = min(lo * (1 - alpha), lo * (1 + alpha))  # sign-safe
+        ceil = max(hi * (1 - alpha), hi * (1 + alpha))
+        assert floor - 1e-12 <= est <= ceil + 1e-12, (
+            f"q={q}: estimate {est} outside [{floor}, {ceil}] "
+            f"(true bracket [{lo}, {hi}])"
+        )
+
+
+# -- relative-error bound on adversarial shapes ----------------------------
+
+
+@pytest.mark.parametrize("name,data", [
+    ("lognormal_wide", np.random.default_rng(0).lognormal(0.0, 3.0, 20000)),
+    ("pareto_heavy_tail", (np.random.default_rng(1).pareto(1.1, 20000) + 1)
+     * 1e-3),
+    ("nine_decade_mixture", np.concatenate([
+        np.random.default_rng(2).uniform(1e-6, 1e-5, 5000),
+        np.random.default_rng(3).uniform(0.5, 2.0, 5000),
+        np.random.default_rng(4).uniform(1e5, 1e6, 5000),
+    ])),
+    ("negatives_and_positives", np.random.default_rng(5).normal(0, 100,
+                                                                20000)),
+    ("constant", np.full(1000, 42.5)),
+    ("with_zeros", np.concatenate([np.zeros(2000),
+                                   np.random.default_rng(6).uniform(
+                                       1.0, 10.0, 8000)])),
+])
+def test_relative_error_bound(name, data):
+    sketch = QuantileSketch(alpha=ALPHA)
+    sketch.add(data)
+    assert sketch.count == len(data)
+    _assert_within_bound(sketch, data)
+
+
+def test_exact_extremes_and_empty():
+    sketch = QuantileSketch()
+    assert sketch.quantile(0.5) is None
+    data = [5.0, 1.0, 9.0, -3.0]
+    sketch.add(data)
+    assert sketch.quantile(0.0) == -3.0
+    assert sketch.quantile(1.0) == 9.0
+    assert sketch.min == -3.0 and sketch.max == 9.0
+    assert sketch.sum == pytest.approx(12.0)
+
+
+def test_nan_ignored_inf_clamped():
+    sketch = QuantileSketch()
+    sketch.add([1.0, float("nan"), 2.0, float("inf")])
+    assert sketch.count == 3  # NaN dropped, inf kept
+    assert sketch.quantile(0.5) == pytest.approx(2.0, rel=ALPHA)
+
+
+# -- mergeability ----------------------------------------------------------
+
+
+def test_merge_associativity_and_commutativity():
+    rng = np.random.default_rng(7)
+    chunks = [rng.lognormal(0, 2, 5000), rng.normal(-50, 10, 5000),
+              rng.uniform(0, 1e4, 5000)]
+    sketches = []
+    for chunk in chunks:
+        s = QuantileSketch(alpha=ALPHA)
+        s.add(chunk)
+        sketches.append(s)
+    a, b, c = sketches
+    left = a.merged(b).merged(c)    # (a ⊕ b) ⊕ c
+    right = a.merged(b.merged(c))   # a ⊕ (b ⊕ c)
+    swapped = c.merged(a).merged(b)  # commuted order
+
+    def buckets(s):
+        # everything but "sum", whose float accumulation is order-sensitive
+        return {k: v for k, v in s.to_dict().items() if k != "sum"}
+
+    # bucket-exact equality, not just close quantiles
+    assert buckets(left) == buckets(right) == buckets(swapped)
+    assert right.sum == pytest.approx(left.sum)
+    # and the merged sketch equals one built from all the data at once
+    union = QuantileSketch(alpha=ALPHA)
+    union.add(np.concatenate(chunks))
+    assert buckets(left) == buckets(union)
+    _assert_within_bound(left, np.concatenate(chunks))
+
+
+def test_merge_alpha_mismatch_rejected():
+    with pytest.raises(ValueError, match="alpha"):
+        QuantileSketch(alpha=0.01).merge(QuantileSketch(alpha=0.05))
+
+
+def test_merge_all_and_serialization_round_trip():
+    rng = np.random.default_rng(8)
+    data = rng.lognormal(1, 2, 4000)
+    s1 = QuantileSketch()
+    s1.add(data[:2000])
+    s2 = QuantileSketch()
+    s2.add(data[2000:])
+    merged = merge_all([s1, s2])
+    doc = json.loads(json.dumps(merged.to_dict()))  # JSON-safe
+    restored = QuantileSketch.from_dict(doc)
+    assert restored.count == 4000
+    for q in (0.5, 0.95, 0.99):
+        assert restored.quantile(q) == merged.quantile(q)
+    assert merge_all([]) is None
+
+
+# -- bounded memory --------------------------------------------------------
+
+
+def test_collapse_bounds_bins_and_keeps_upper_tail():
+    """max_bins caps memory; collapsing merges the smallest-magnitude
+    buckets so p95/p99 keep their accuracy."""
+    data = np.logspace(-8, 8, 30000)  # 16 decades: ~1800 natural bins
+    sketch = QuantileSketch(alpha=ALPHA, max_bins=256)
+    sketch.add(data)
+    assert sketch.bin_count() <= 257  # pos bins capped (+ no zero bucket)
+    assert sketch.collapsed
+    # 256 bins at alpha=0.01 span ~2.2 decades: the p90+ tail of the
+    # 16-decade input stays in un-collapsed buckets and keeps its bound
+    for q in (0.9, 0.95, 0.99):
+        est = sketch.quantile(q)
+        hi = np.percentile(data, q * 100, method="higher")
+        lo = np.percentile(data, q * 100, method="lower")
+        assert lo * (1 - ALPHA) <= est <= hi * (1 + ALPHA)
+
+
+# -- thread safety ---------------------------------------------------------
+
+
+def test_concurrent_observe_is_lossless():
+    sketch = QuantileSketch(alpha=ALPHA)
+    per_thread = 10_000
+    n_threads = 8
+    values = np.random.default_rng(9).lognormal(0, 1, per_thread)
+
+    def work():
+        for v in values:
+            sketch.observe(v)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sketch.count == per_thread * n_threads
+    # every thread observed identical data, so quantiles match one copy
+    _assert_within_bound(sketch, values, qs=(0.5, 0.95, 0.99))
+
+
+# -- the Summary metric ----------------------------------------------------
+
+
+def test_summary_metric_snapshot_and_prometheus_text():
+    reg = MetricsRegistry()
+    summary = reg.summary(
+        "unit_latency_seconds", "unit test latency", ("algo",),
+        alpha=ALPHA, quantiles=(0.5, 0.95, 0.99),
+    )
+    values = np.random.default_rng(10).uniform(0.001, 0.5, 5000)
+    for v in values:
+        summary.observe(float(v), algo="demo")
+    snap = reg.snapshot()["unit_latency_seconds"]
+    assert snap["type"] == "summary"
+    sample = snap["samples"][0]
+    assert sample["labels"] == {"algo": "demo"}
+    assert sample["count"] == 5000
+    p99 = sample["quantiles"]["0.99"]
+    assert p99 == pytest.approx(np.percentile(values, 99), rel=5 * ALPHA)
+    text = reg.prometheus_text()
+    assert "# TYPE unit_latency_seconds summary" in text
+    assert 'unit_latency_seconds{algo="demo",quantile="0.5"}' in text
+    assert 'unit_latency_seconds{algo="demo",quantile="0.99"}' in text
+    assert 'unit_latency_seconds_count{algo="demo"} 5000' in text
+    # summaries coexist with histogram bucket lines in one exposition
+    reg.histogram("unit_hist_seconds", "h", ("algo",)).observe(
+        0.2, algo="demo")
+    text = reg.prometheus_text()
+    assert 'unit_hist_seconds_bucket{algo="demo",le="0.5"} 1' in text
+    assert 'quantile="0.99"' in text
+
+
+def test_summary_quantile_query_and_sketch_access():
+    reg = MetricsRegistry()
+    summary = reg.summary("unit_q", "q", ("algo",))
+    for v in range(1, 101):
+        summary.observe(float(v), algo="a")
+    assert summary.quantile(0.5, algo="a") == pytest.approx(50, rel=0.02)
+    sketch = summary.sketch(algo="a")
+    assert sketch.count == 100
+
+
+def test_negative_quantiles_are_monotone_and_clamped():
+    """Regression guard: negative-bucket estimates clamp to [min, max],
+    so p50 can never exceed p100 on negative-valued data."""
+    sketch = QuantileSketch(alpha=ALPHA)
+    sketch.observe(-5.0)
+    assert sketch.quantile(0.5) <= sketch.quantile(1.0) == -5.0
+    sketch2 = QuantileSketch(alpha=ALPHA)
+    data = -np.random.default_rng(11).lognormal(0, 2, 5000)
+    sketch2.add(data)
+    qs = [sketch2.quantile(q) for q in (0.0, 0.25, 0.5, 0.75, 0.99, 1.0)]
+    assert qs == sorted(qs)
+    assert qs[0] == data.min() and qs[-1] == data.max()

@@ -1,0 +1,510 @@
+"""Serving observability (obs.serving): TransformReport smoke on fitted
+PCA/KMeans models, phase splits, the numerics sentinel, sketch-backed
+latency quantiles, delegation dedupe, the transform watchdog, and the
+extended static instrumentation check."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import (
+    TransformReport,
+    check_output_numerics,
+    flight,
+    get_registry,
+    last_transform_report,
+    latency_quantiles,
+    observed_transform,
+    transform_phase,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter_value(name, **labels):
+    snap = get_registry().snapshot().get(name, {"samples": []})
+    for sample in snap["samples"]:
+        if sample["labels"] == labels:
+            return sample["value"]
+    return 0.0
+
+
+# -- tier-1 smoke: fitted-model transforms emit full reports ---------------
+
+
+def test_pca_transform_report_smoke(rng):
+    """Guards the decorator wiring: a fitted PCA transform must emit a
+    TransformReport with nonzero rows and the device-put/compute/
+    host-sync phase split."""
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(256, 12))
+    model = PCA().setK(4).fit(x)
+    out = model.transform(x)
+    rep = model.transform_report_
+    assert isinstance(rep, TransformReport)
+    assert rep.algo == "pca"
+    assert rep.rows == 256
+    assert rep.features == 12
+    assert rep.wall_seconds > 0
+    # populated phase split, all nested inside the total
+    for phase in ("device_put", "compute", "host_sync", "total"):
+        assert phase in rep.phases, rep.phases
+    assert rep.phases["total"] >= rep.phases["compute"]
+    assert rep.bytes_in and rep.bytes_in > 0
+    # the output frame carries the same report
+    assert getattr(out, "transform_report_", None) is rep
+    assert last_transform_report("pca") is rep
+    # sketch-backed registry quantiles are live for the algo
+    q = rep.latency_quantiles
+    assert q["p50"] is not None and q["p50"] > 0
+    assert q["p50"] <= q["p95"] <= q["p99"]
+
+
+def test_kmeans_transform_report_smoke(rng):
+    from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+    x = rng.normal(size=(200, 8))
+    model = KMeans().setK(3).fit(x)
+    model.transform(x)
+    rep = model.transform_report_
+    assert isinstance(rep, TransformReport)
+    assert rep.algo == "kmeans"
+    assert rep.rows == 200
+    for phase in ("device_put", "compute", "host_sync", "total"):
+        assert phase in rep.phases, rep.phases
+    # the tracked assignment kernel attributes its compiles to the call
+    assert rep.compiles >= 0  # 0 on a warm cache, >=1 cold
+
+
+def test_transform_metrics_side_effects(rng):
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(64, 6))
+    model = PCA().setK(2).fit(x)
+    before_calls = _counter_value("sparkml_transforms_total", algo="pca")
+    before_rows = _counter_value("sparkml_rows_transformed_total",
+                                 algo="pca")
+    model.transform(x)
+    assert _counter_value("sparkml_transforms_total",
+                          algo="pca") == before_calls + 1
+    assert _counter_value("sparkml_rows_transformed_total",
+                          algo="pca") == before_rows + 64
+
+
+# -- sketch quantiles ------------------------------------------------------
+
+
+def test_latency_quantiles_accumulate_per_algo():
+    class _Sleepy:
+        @observed_transform("qtest_sleepy")
+        def transform(self, x):
+            return np.asarray(x) * 2.0
+
+    model = _Sleepy()
+    for _ in range(20):
+        model.transform(np.ones((10, 2)))
+    sketch_q = latency_quantiles("qtest_sleepy")
+    assert sketch_q["p50"] is not None
+    assert sketch_q["p50"] <= sketch_q["p95"] <= sketch_q["p99"]
+    summary = get_registry().summary(
+        "sparkml_transform_latency_seconds", "", ("algo",))
+    assert summary.sketch(algo="qtest_sleepy").count >= 20
+    # exposed as Prometheus summary quantile lines
+    text = get_registry().prometheus_text()
+    assert 'sparkml_transform_latency_seconds{algo="qtest_sleepy"' in text
+    assert 'quantile="0.99"' in text
+
+
+# -- numerics sentinel -----------------------------------------------------
+
+
+def test_numerics_sentinel_counts_injected_nan_column(rng):
+    """Acceptance: an injected-NaN transform output increments the
+    sentinel counter and appears in the metrics snapshot."""
+
+    class _Poisoned:
+        @observed_transform("numerics_nan_algo")
+        def transform(self, x):
+            out = np.asarray(x, dtype=np.float64).copy()
+            out[:3, 0] = np.nan
+            return out
+
+    before = _counter_value("sparkml_numerics_anomalies_total",
+                            algo="numerics_nan_algo", kind="nan")
+    model = _Poisoned()
+    model.transform(rng.normal(size=(50, 4)))
+    rep = model.transform_report_
+    assert rep.numerics is not None
+    assert rep.numerics["nan_rows"] == 3
+    assert rep.numerics["inf_rows"] == 0
+    snap = get_registry().snapshot()
+    assert _counter_value("sparkml_numerics_anomalies_total",
+                          algo="numerics_nan_algo",
+                          kind="nan") == before + 3
+    assert "sparkml_numerics_anomalies_total" in snap
+    text = get_registry().prometheus_text()
+    assert 'sparkml_numerics_anomalies_total{algo="numerics_nan_algo"' \
+        in text
+
+
+def test_numerics_sentinel_inf_all_zero_and_frame_columns(rng):
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    x = rng.normal(size=(20, 3))
+    frame = VectorFrame({"features": x})
+    out = frame.with_column("pred", np.zeros((20, 2)))
+    verdict = check_output_numerics(out, input_columns=["features"])
+    assert verdict["columns"] == ["pred"]
+    assert verdict["all_zero"] is True
+    assert verdict["nan_rows"] == 0
+
+    out2 = frame.with_column("pred", np.array([[np.inf]] * 20))
+    verdict2 = check_output_numerics(out2, input_columns=["features"])
+    assert verdict2["inf_rows"] == 20
+
+    # non-numeric outputs are skipped, not crashed on
+    out3 = frame.with_column("tokens", [["a", "b"]] * 20)
+    assert check_output_numerics(out3, input_columns=["features"]) is None
+
+
+def test_numerics_sample_rate_env_disables(monkeypatch, rng):
+    from spark_rapids_ml_tpu.obs import serving
+
+    monkeypatch.setenv(serving.NUMERICS_SAMPLE_ENV, "0")
+
+    class _Quiet:
+        @observed_transform("numerics_gated_algo")
+        def transform(self, x):
+            out = np.asarray(x, dtype=np.float64).copy()
+            out[:, 0] = np.nan
+            return out
+
+    model = _Quiet()
+    model.transform(rng.normal(size=(10, 2)))
+    assert model.transform_report_.numerics is None
+    assert _counter_value("sparkml_numerics_anomalies_total",
+                          algo="numerics_gated_algo", kind="nan") == 0
+
+
+# -- delegation dedupe and nesting -----------------------------------------
+
+
+def test_delegation_shim_is_not_double_counted():
+    """Model.transform → self._transform (both decorated) must produce
+    ONE report per call, labeled by the shim's derived name."""
+
+    class _ShimModel:
+        @observed_transform
+        def transform(self, dataset):
+            return self._transform(dataset)
+
+        @observed_transform
+        def _transform(self, dataset):
+            return np.asarray(dataset) + 1.0
+
+    before = _counter_value("sparkml_transforms_total", algo="shim")
+    model = _ShimModel()
+    model.transform(np.ones((7, 2)))
+    assert _counter_value("sparkml_transforms_total",
+                          algo="shim") == before + 1
+    assert model.transform_report_.rows == 7
+
+
+def test_nested_distinct_models_each_report():
+    """Pipeline-style nesting: each distinct stage gets its own report,
+    tagged with the parent algo."""
+
+    class _Inner:
+        @observed_transform("nest_inner")
+        def transform(self, dataset):
+            return np.asarray(dataset) * 2.0
+
+    class _Outer:
+        def __init__(self):
+            self.stage = _Inner()
+
+        @observed_transform("nest_outer")
+        def transform(self, dataset):
+            return self.stage.transform(dataset)
+
+    model = _Outer()
+    model.transform(np.ones((5, 2)))
+    inner_rep = model.stage.transform_report_
+    outer_rep = model.transform_report_
+    assert inner_rep.algo == "nest_inner"
+    assert inner_rep.nested_in == "nest_outer"
+    assert outer_rep.nested_in is None
+
+
+# -- phases and context outside a call -------------------------------------
+
+
+def test_transform_phase_is_noop_outside_instrumented_call():
+    with transform_phase("compute"):
+        pass  # must not raise, must not record anywhere
+
+
+def test_report_as_dict_round_trips():
+    class _Tiny:
+        @observed_transform("asdict_algo")
+        def transform(self, x):
+            return np.asarray(x)
+
+    model = _Tiny()
+    model.transform(np.ones((3, 2)))
+    doc = json.loads(json.dumps(model.transform_report_.as_dict()))
+    assert doc["algo"] == "asdict_algo"
+    assert doc["rows"] == 3
+    assert "total" in doc["phases"]
+
+
+# -- the transform watchdog ------------------------------------------------
+
+
+def test_transform_budget_env_arms_watchdog(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.DUMP_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(flight.TRANSFORM_BUDGET_ENV, "0.15")
+
+    class _Stalled:
+        @observed_transform("watchdog_stall_algo")
+        def transform(self, x):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if glob.glob(os.path.join(str(tmp_path),
+                                          "flightdump_*.json")):
+                    break
+                time.sleep(0.05)
+            return np.asarray(x)
+
+    _Stalled().transform(np.ones((2, 2)))
+    files = glob.glob(os.path.join(str(tmp_path), "flightdump_*.json"))
+    assert files, "stalled transform produced no flight dump"
+    doc = json.load(open(files[0]))
+    assert doc["reason"] == \
+        "budget_exceeded:transform:watchdog_stall_algo"
+
+
+def test_transform_budget_default_and_disable(monkeypatch):
+    monkeypatch.delenv(flight.TRANSFORM_BUDGET_ENV, raising=False)
+    assert flight.transform_budget_seconds() == 120.0
+    monkeypatch.setenv(flight.TRANSFORM_BUDGET_ENV, "0")
+    assert flight.transform_budget_seconds() == float("inf")
+
+
+# -- static enforcement ----------------------------------------------------
+
+
+def test_check_instrumentation_covers_serving_paths():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_instrumentation.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "serving entry point(s)" in proc.stdout
+    assert "all instrumented" in proc.stdout
+
+
+def test_check_serving_catches_offender(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_instrumentation import check_serving_file
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "models" / "bad_model.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "class BadModel:\n"
+        "    def transform(self, dataset):\n"
+        "        return dataset\n"
+        "    def predict_proba(self, x):\n"
+        "        return x\n"
+        "    def _helper(self):\n"
+        "        def predict(series):\n"  # nested udf: must NOT count
+        "            return series\n"
+        "        return predict\n"
+    )
+    offenders = [name for _, name in check_serving_file(str(bad))]
+    assert offenders == ["BadModel.transform", "BadModel.predict_proba"]
+
+
+def test_check_serving_accepts_decorated(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_instrumentation import check_serving_file
+    finally:
+        sys.path.pop(0)
+    good = tmp_path / "spark" / "good.py"
+    good.parent.mkdir()
+    good.write_text(
+        "from spark_rapids_ml_tpu.obs import observed_transform\n"
+        "class GoodModel:\n"
+        "    @observed_transform('good')\n"
+        "    def transform(self, dataset):\n"
+        "        return dataset\n"
+        "    @observed_transform\n"
+        "    def _transform(self, dataset):\n"
+        "        return dataset\n"
+    )
+    assert list(check_serving_file(str(good))) == []
+
+
+def test_sentinel_excludes_model_input_columns(rng):
+    """Regression guard: a NaN in the INPUT features must not count as a
+    model-output anomaly, even when the input is a bare ndarray (the
+    output frame carries the input column along)."""
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(64, 6))
+    model = PCA().setK(2).fit(x)
+    bad_batch = x.copy()
+    bad_batch[0, 0] = np.nan
+    before = _counter_value("sparkml_numerics_anomalies_total",
+                            algo="pca", kind="nan")
+    model.transform(bad_batch)
+    rep = model.transform_report_
+    # the output column DOES contain a NaN row (NaN in -> NaN out through
+    # the matmul); only the carried-over input column is excluded
+    assert rep.numerics["columns"] == [model.getOutputCol()]
+
+
+def test_predict_proba_alias_is_now_instrumented(rng):
+    from spark_rapids_ml_tpu.models.linear_svc import LinearSVC
+
+    x = rng.normal(size=(60, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    model = LinearSVC().setMaxIter(5).fit(x, y)
+    model.predict_proba(x)
+    assert model.transform_report_.algo == "linear_svc"
+
+
+def test_checker_flags_serving_alias(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_instrumentation import check_serving_file
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "models" / "alias.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "class M:\n"
+        "    def decision_function(self, x):\n"
+        "        return x\n"
+        "    predict_proba = decision_function\n"
+    )
+    offenders = [name for _, name in check_serving_file(str(bad))]
+    assert len(offenders) == 1 and "alias" in offenders[0]
+
+
+def test_als_nan_contract_not_counted_as_anomaly(rng):
+    """ALS scores NaN for unseen ids BY CONTRACT — the sentinel must not
+    count healthy cold-start traffic as anomalies."""
+    from spark_rapids_ml_tpu.models.als import ALS
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+
+    frame = VectorFrame({
+        "user": [0, 0, 1, 1, 2],
+        "item": [0, 1, 0, 1, 1],
+        "rating": [5.0, 3.0, 4.0, 2.0, 4.0],
+    })
+    model = ALS().setMaxIter(2).setRank(2).fit(frame)
+    before = _counter_value("sparkml_numerics_anomalies_total",
+                            algo="als", kind="nan")
+    preds = model.predict(np.array([0.0, 99.0]), np.array([0.0, 99.0]))
+    assert np.isnan(preds[1])  # unseen id -> NaN, per contract
+    assert model.transform_report_.numerics is None  # sentinel opted out
+    assert _counter_value("sparkml_numerics_anomalies_total",
+                          algo="als", kind="nan") == before
+
+
+def test_raising_transform_increments_error_counter():
+    """A failing serving call must be visible: errors count per algo and
+    exception type, and the exception still propagates."""
+
+    class _Broken:
+        @observed_transform("error_test_algo")
+        def transform(self, x):
+            raise ValueError("schema mismatch")
+
+    before = _counter_value("sparkml_transform_errors_total",
+                            algo="error_test_algo", error="ValueError")
+    with pytest.raises(ValueError, match="schema mismatch"):
+        _Broken().transform(np.ones((3, 2)))
+    assert _counter_value("sparkml_transform_errors_total",
+                          algo="error_test_algo",
+                          error="ValueError") == before + 1
+    # failed calls never feed the success counters/sketch
+    assert _counter_value("sparkml_transforms_total",
+                          algo="error_test_algo") == 0
+
+
+def test_checker_flags_annotated_serving_alias(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_instrumentation import check_serving_file
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "models" / "ann_alias.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "from typing import Callable\n"
+        "class M:\n"
+        "    def decision_function(self, x):\n"
+        "        return x\n"
+        "    predict_proba: Callable = decision_function\n"
+    )
+    offenders = [name for _, name in check_serving_file(str(bad))]
+    assert len(offenders) == 1 and "alias" in offenders[0]
+
+
+def test_all_zero_is_informational_not_anomaly():
+    """Class-0/cluster-0/sparse-zero batches are healthy traffic: they
+    count in their own series, never the paging anomaly counter."""
+
+    class _AllZero:
+        @observed_transform("allzero_algo")
+        def transform(self, x):
+            return np.zeros_like(np.asarray(x, dtype=np.float64))
+
+    _AllZero().transform(np.ones((10, 3)))
+    assert _counter_value("sparkml_numerics_all_zero_total",
+                          algo="allzero_algo") == 1
+    assert _counter_value("sparkml_numerics_anomalies_total",
+                          algo="allzero_algo", kind="all_zero") == 0
+
+
+def test_dataset_stats_vector_list_bytes_per_element():
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+    from spark_rapids_ml_tpu.data.vector import DenseVector
+    from spark_rapids_ml_tpu.obs.serving import _dataset_stats
+
+    frame = VectorFrame({
+        "features": [DenseVector([0.0] * 100) for _ in range(10)]})
+    stats = _dataset_stats(frame)
+    assert stats["rows"] == 10
+    assert stats["nbytes"] == 10 * 100 * 8  # per element, not per row
+
+
+def test_report_quantiles_are_lazy_and_live():
+    class _Lazy:
+        @observed_transform("lazy_q_algo")
+        def transform(self, x):
+            return np.asarray(x)
+
+    model = _Lazy()
+    model.transform(np.ones((2, 2)))
+    first = model.transform_report_
+    for _ in range(10):
+        model.transform(np.ones((2, 2)))
+    # the first report's quantiles resolve against the LIVE sketch
+    assert first.latency_quantiles["p50"] is not None
+    assert first.p50 <= first.p95 <= first.p99
+    doc = json.loads(json.dumps(first.as_dict()))
+    assert doc["latency_quantiles"]["p99"] == first.p99

@@ -16,8 +16,11 @@ from perf_sentinel import (  # noqa: E402
     extract_record,
     iter_history,
     judge,
+    judge_percentiles,
+    judge_record,
     load_candidate,
     noise_band,
+    record_percentiles,
 )
 
 sys.path.pop(0)
@@ -202,3 +205,173 @@ def test_cli_regressed_vs_stale_distinguished(tmp_path):
     )
     assert json.loads(proc.stdout)["verdict"] == "STALE"
     assert proc.returncode == 2
+
+
+# -- latency-percentile records (serving quantile-sketch output) -----------
+
+LAT_METRIC = "pca.transform seconds/batch (4096x256)"
+
+
+def _pct_history(*pcts, platform="tpu", metric=LAT_METRIC):
+    return [
+        {"metric": metric, "unit": "seconds", "platform": platform,
+         "percentiles": dict(p), "_source": f"pfix{i}.json"}
+        for i, p in enumerate(pcts)
+    ]
+
+
+def _pct_record(p50, p95, p99, platform="tpu", **extra):
+    rec = {"metric": LAT_METRIC, "unit": "seconds", "platform": platform,
+           "percentiles": {"p50": p50, "p95": p95, "p99": p99}}
+    rec.update(extra)
+    return rec
+
+
+def test_record_percentiles_extraction():
+    assert record_percentiles(_pct_record(0.01, 0.02, 0.03)) == {
+        "p50": 0.01, "p95": 0.02, "p99": 0.03}
+    # top-level keys work too, and override the nested dict
+    rec = _pct_record(0.01, 0.02, 0.03)
+    rec["p99"] = 0.5
+    assert record_percentiles(rec)["p99"] == 0.5
+    assert record_percentiles({"metric": "m", "value": 1.0}) == {}
+
+
+def test_percentile_pass_within_band():
+    hist = _pct_history({"p50": 0.010, "p95": 0.020, "p99": 0.030},
+                        {"p50": 0.011, "p95": 0.019, "p99": 0.031},
+                        {"p50": 0.010, "p95": 0.021, "p99": 0.029})
+    v = judge_record(_pct_record(0.0105, 0.0205, 0.0305), hist)
+    assert v["verdict"] == "PASS"
+    assert set(v["percentiles"]) == {"p50", "p95", "p99"}
+    assert all(s["verdict"] == "PASS" for s in v["percentiles"].values())
+
+
+def test_tail_regression_cannot_hide_behind_healthy_mean():
+    """The satellite case: p50 healthy, p99 3x worse -> REGRESSED, and the
+    sub-verdict names the offending percentile."""
+    hist = _pct_history({"p50": 0.010, "p95": 0.020, "p99": 0.030},
+                        {"p50": 0.010, "p95": 0.020, "p99": 0.030})
+    v = judge_record(_pct_record(0.010, 0.020, 0.090), hist)
+    assert v["verdict"] == "REGRESSED"
+    assert v["percentiles"]["p50"]["verdict"] == "PASS"
+    assert v["percentiles"]["p99"]["verdict"] == "REGRESSED"
+    assert "p99: REGRESSED" in v["reason"]
+    assert EXIT_CODES[v["verdict"]] == 1
+
+
+def test_percentile_latency_lower_is_better():
+    """Latency percentiles judge in seconds: a FASTER p99 passes, never
+    regresses."""
+    hist = _pct_history({"p50": 0.010, "p95": 0.020, "p99": 0.030})
+    v = judge_record(_pct_record(0.002, 0.004, 0.006), hist)
+    assert v["verdict"] == "PASS"
+
+
+def test_percentile_no_baseline_and_scalar_mix():
+    v = judge_percentiles(_pct_record(0.01, 0.02, 0.03), [])
+    assert v["verdict"] == "NO_BASELINE"
+    # a percentile record with a scalar value judges the scalar too
+    hist = _history(100_000.0, metric=LAT_METRIC)
+    rec = _pct_record(0.01, 0.02, 0.03, value=50_000.0,
+                      )
+    rec["unit"] = "rows/sec"
+    v2 = judge_record(rec, hist)
+    assert v2["scalar"]["verdict"] == "REGRESSED"
+    assert v2["verdict"] == "REGRESSED"
+
+
+def test_percentile_fallback_record_is_stale():
+    hist = _pct_history({"p50": 0.010, "p95": 0.020, "p99": 0.030})
+    v = judge_record(
+        _pct_record(0.5, 0.9, 1.5, platform="cpu",
+                    fallback_reason="device tunnel wedged"),
+        hist,
+    )
+    assert v["verdict"] == "STALE"
+    assert all(s["verdict"] == "STALE" for s in v["percentiles"].values())
+
+
+def test_percentile_record_via_cli(tmp_path):
+    (tmp_path / "BENCH_MEASURED.json").write_text(json.dumps({
+        "headline": {"metric": LAT_METRIC, "unit": "seconds",
+                     "platform": "tpu",
+                     "percentiles": {"p50": 0.010, "p95": 0.020,
+                                     "p99": 0.030}},
+    }))
+    script = os.path.join(REPO, "scripts", "perf_sentinel.py")
+    rec = tmp_path / "rec.json"
+    rec.write_text(json.dumps(_pct_record(0.010, 0.021, 0.120)))
+    proc = subprocess.run(
+        [sys.executable, script, str(rec), "--history-root", str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    out = json.loads(proc.stdout)
+    assert out["verdict"] == "REGRESSED"
+    assert out["percentiles"]["p99"]["verdict"] == "REGRESSED"
+    assert proc.returncode == 1
+
+
+def test_percentiles_judge_lower_is_better_even_with_throughput_unit():
+    """Regression guard: a record whose SCALAR unit is rows/sec must still
+    judge its latency percentiles as lower-is-better — a 3x p99 blowup
+    can never read as an improvement."""
+    hist = [{"metric": LAT_METRIC, "unit": "rows/sec", "platform": "tpu",
+             "value": 100_000.0, "percentiles": {"p99": 0.030},
+             "_source": "h.json"}]
+    rec = {"metric": LAT_METRIC, "unit": "rows/sec", "platform": "tpu",
+           "value": 100_500.0, "percentiles": {"p99": 0.090}}
+    v = judge_record(rec, hist)
+    assert v["percentiles"]["p99"]["verdict"] == "REGRESSED"
+    assert v["verdict"] == "REGRESSED"
+    # and a FASTER p99 under the same throughput unit passes
+    rec_fast = dict(rec, percentiles={"p99": 0.010})
+    assert judge_record(rec_fast, hist)["verdict"] == "PASS"
+
+
+def test_percentiles_reason_names_scalar_offender():
+    hist = _pct_history({"p50": 0.010, "p95": 0.020, "p99": 0.030}) + \
+        _history(100_000.0, metric=LAT_METRIC)
+    rec = _pct_record(0.010, 0.020, 0.030, value=10_000.0)
+    rec["unit"] = "rows/sec"
+    v = judge_record(rec, hist)
+    assert v["verdict"] == "REGRESSED"
+    assert "scalar: REGRESSED" in v["reason"]
+
+
+def test_percentiles_lower_is_better_even_with_per_sec_metric_name():
+    """Regression guard: '/sec' in the metric NAME (not just the unit)
+    must not flip percentile judging back to higher-is-better."""
+    metric = "pca.transform rows/sec (4096x256)"
+    hist = [{"metric": metric, "unit": "rows/sec", "platform": "tpu",
+             "value": 100_000.0, "percentiles": {"p99": 0.030},
+             "_source": "h.json"}]
+    rec = {"metric": metric, "unit": "rows/sec", "platform": "tpu",
+           "value": 100_500.0, "percentiles": {"p99": 0.300}}
+    v = judge_record(rec, hist)
+    assert v["percentiles"]["p99"]["verdict"] == "REGRESSED"
+    assert v["verdict"] == "REGRESSED"
+
+
+def test_explicit_higher_is_better_flag_wins():
+    from perf_sentinel import higher_is_better
+
+    assert higher_is_better({"metric": "x rows/sec", "unit": "rows/sec",
+                             "higher_is_better": False}) is False
+    assert higher_is_better({"metric": "x seconds", "unit": "seconds",
+                             "higher_is_better": True}) is True
+
+
+def test_malformed_percentile_fields_are_skipped_not_fatal():
+    """Regression guard: a malformed percentile value in a record or the
+    committed history degrades to 'field skipped', never a crash."""
+    assert record_percentiles(
+        {"metric": "m", "percentiles": {"p50": "n/a", "p99": 0.03}}
+    ) == {"p99": 0.03}
+    assert record_percentiles({"metric": "m", "p95": "bogus"}) == {}
+    hist = _pct_history({"p50": 0.010, "p95": 0.020, "p99": 0.030}) + [
+        {"metric": LAT_METRIC, "unit": "seconds", "platform": "tpu",
+         "percentiles": {"p99": "corrupt"}, "_source": "bad.json"},
+    ]
+    v = judge_record(_pct_record(0.010, 0.020, 0.030), hist)
+    assert v["verdict"] == "PASS"
