@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Serving-engine bench: throughput, tail latency, batch occupancy.
+
+Registers a fitted PCA model, warms its shape buckets, then drives a
+fixed count of mixed-size predict requests through the engine from a
+thread pool — the closed-loop analogue of real ragged traffic — and
+emits ONE ``bench_common.emit_record`` JSON line so
+``scripts/perf_sentinel.py`` can judge serving regressions against the
+committed history from the next PR onward:
+
+* ``rows_per_sec``            — end-to-end serving throughput;
+* ``p50`` / ``p95`` / ``p99`` — request latency seconds (also under
+  ``percentiles``, the sentinel's per-percentile judging shape);
+* ``mean_batch_occupancy``    — real rows / bucket rows over the run
+  (how well coalescing fills the padded shapes);
+* ``recompile_count``         — distinct-signature compiles during the
+  serve phase; steady state must stay at 0 (warmup owns them all).
+
+Knobs (env): SPARKML_BENCH_SERVE_REQUESTS (default 512),
+SPARKML_BENCH_SERVE_FEATURES (64), SPARKML_BENCH_SERVE_K (16),
+SPARKML_BENCH_SERVE_THREADS (8), SPARKML_BENCH_SERVE_MAX_ROWS (512).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import bench_common  # noqa: E402 (scripts/ on path when run directly)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def main() -> int:
+    n_requests = _env_int("SPARKML_BENCH_SERVE_REQUESTS", 512)
+    n_features = _env_int("SPARKML_BENCH_SERVE_FEATURES", 64)
+    k = _env_int("SPARKML_BENCH_SERVE_K", 16)
+    n_threads = _env_int("SPARKML_BENCH_SERVE_THREADS", 8)
+    max_rows = _env_int("SPARKML_BENCH_SERVE_MAX_ROWS", 512)
+
+    import jax
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.obs import compile_stats, get_registry
+    from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+
+    device = jax.devices()[0]
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(4096, n_features))
+    model = PCA().setK(k).fit(x)
+
+    registry = ModelRegistry()
+    registry.register("bench_pca", model)
+    engine = ServeEngine(
+        registry, max_batch_rows=max_rows, max_wait_ms=2.0,
+        max_queue_depth=4 * n_requests,
+    )
+    registry.warmup("bench_pca", max_bucket_rows=max_rows)
+    compiles_before = sum(
+        s["compiles"] for s in compile_stats().values()
+    )
+
+    # Mixed-size closed-loop traffic: 1..256-row requests from N threads.
+    # Sizes AND offsets precomputed — numpy Generators are not thread-safe,
+    # and the seed must reproduce exactly for sentinel comparisons.
+    sizes = rng.integers(1, 257, size=n_requests).tolist()
+    starts = [int(rng.integers(0, x.shape[0] - n)) for n in sizes]
+    latencies = np.zeros(n_requests)
+    total_rows = int(sum(sizes))
+
+    def one(i: int) -> None:
+        n, start = sizes[i], starts[i]
+        t0 = time.perf_counter()
+        engine.predict("bench_pca", x[start:start + n])
+        latencies[i] = time.perf_counter() - t0
+
+    t_run = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(n_threads) as pool:
+        list(pool.map(one, range(n_requests)))
+    wall = time.perf_counter() - t_run
+    engine.shutdown()
+
+    compiles_after = sum(
+        s["compiles"] for s in compile_stats().values()
+    )
+
+    def _counter(name: str) -> float:
+        snap = get_registry().snapshot().get(name, {"samples": []})
+        return sum(s["value"] for s in snap["samples"])
+
+    batch_rows = _counter("sparkml_serve_batch_rows_total")
+    bucket_rows = _counter("sparkml_serve_bucket_rows_total")
+    p50, p95, p99 = (float(np.percentile(latencies, q))
+                     for q in (50, 95, 99))
+    bench_common.emit_record({
+        "bench": "serve_engine",
+        "platform": device.platform,
+        "device_kind": str(device.device_kind),
+        "requests": n_requests,
+        "threads": n_threads,
+        "rows": total_rows,
+        "seconds": wall,
+        "rows_per_sec": total_rows / wall if wall > 0 else 0.0,
+        "p50": p50,
+        "p95": p95,
+        "p99": p99,
+        "percentiles": {"p50": p50, "p95": p95, "p99": p99},
+        "mean_batch_occupancy": (
+            batch_rows / bucket_rows if bucket_rows else 0.0
+        ),
+        "recompile_count": int(compiles_after - compiles_before),
+        "batches": int(_counter("sparkml_serve_batches_total")),
+        "deadline_expired": int(
+            _counter("sparkml_serve_deadline_expired_total")
+        ),
+    })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,14 @@ Three rule families:
    (plus ``_transform``, the pyspark-convention hook the base class's
    public ``transform`` delegates to, in ``spark/``) — carries the
    ``@observed_transform`` decorator from ``obs.serving``, so no
-   transform/predict path ships as a telemetry black hole.
+   transform/predict path ships as a telemetry black hole;
+4. over ``spark_rapids_ml_tpu/serve/*.py`` (the serving engine): no raw
+   ``jax.jit`` (same rule as the drivers), and no *instrumentation
+   bypass* — the engine must drive models through their public,
+   ``@observed_transform``-decorated entry points, so calls to a
+   ``._transform(...)`` hook or directly into a ``*_kernel`` function
+   are rejected: an engine batch that skipped the decorator would be
+   invisible to the ``TransformReport``/numerics-sentinel layer.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -40,6 +47,7 @@ PARALLEL_GLOB = os.path.join(
 )
 MODELS_GLOB = os.path.join(REPO, "spark_rapids_ml_tpu", "models", "*.py")
 SPARK_GLOB = os.path.join(REPO, "spark_rapids_ml_tpu", "spark", "*.py")
+SERVE_GLOB = os.path.join(REPO, "spark_rapids_ml_tpu", "serve", "*.py")
 DECORATOR_NAME = "fit_instrumentation"
 SERVING_DECORATOR = "observed_transform"
 SERVING_PUBLIC_NAMES = frozenset(
@@ -182,6 +190,39 @@ def check_serving_file(path: str):
     yield from offenders
 
 
+def check_serve_engine_file(path: str):
+    """Rule 4: yield (lineno, description) for serving-engine offenders.
+
+    Inside ``serve/``, raw ``jax.jit`` is rejected exactly as in the
+    drivers, and so is any call that bypasses the ``@observed_transform``
+    layer: invoking a model's ``._transform(...)`` hook directly, or
+    calling a ``*_kernel`` function — engine batches must flow through
+    the public decorated entry points or they ship unobserved.
+    """
+    tree = ast.parse(open(path).read(), filename=path)
+    aliases = _jax_aliases(tree)
+    jit_names = _jit_name_imports(tree)
+    for node in ast.walk(tree):
+        if _is_raw_jit(node, aliases, jit_names):
+            yield node.lineno, "raw jax.jit (use obs.tracked_jit)"
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        if name == "_transform":
+            yield (node.lineno,
+                   "direct ._transform call (bypasses @observed_transform "
+                   "— call the public transform)")
+        elif name and name.endswith("_kernel"):
+            yield (node.lineno,
+                   f"direct {name} call (bypasses @observed_transform — "
+                   "drive the model's public entry point)")
+
+
 def main() -> int:
     files = sorted(glob.glob(PARALLEL_GLOB))
     if not files:
@@ -218,6 +259,14 @@ def main() -> int:
         for lineno, name in serving_offenders:
             offenders.append(f"{rel}:{lineno} {name} "
                              f"(missing @{SERVING_DECORATOR})")
+    serve_files = sorted(
+        path for path in glob.glob(SERVE_GLOB)
+        if os.path.basename(path) != "__init__.py"
+    )
+    for path in serve_files:
+        rel = os.path.relpath(path, REPO)
+        for lineno, why in check_serve_engine_file(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -227,7 +276,9 @@ def main() -> int:
         f"OK: {checked} distributed entry point(s) across {len(files)} "
         f"driver module(s) all instrumented; all jit sites tracked; "
         f"{serving_checked} serving entry point(s) across "
-        f"{len(serving_files)} models/spark module(s) all instrumented"
+        f"{len(serving_files)} models/spark module(s) all instrumented; "
+        f"{len(serve_files)} serve/ module(s) clean (no raw jit, no "
+        f"transform bypasses)"
     )
     return 0
 

@@ -1,8 +1,15 @@
 from spark_rapids_ml_tpu.io.persistence import (
+    load_model,
     load_pca_model,
     save_pca_model,
     load_params,
     save_params,
 )
 
-__all__ = ["load_pca_model", "save_pca_model", "load_params", "save_params"]
+__all__ = [
+    "load_model",
+    "load_pca_model",
+    "save_pca_model",
+    "load_params",
+    "save_params",
+]

@@ -18,10 +18,13 @@ Estimators (no learned state) persist metadata only, like Spark's
 
 from __future__ import annotations
 
+import functools
+import importlib
 import json
 import os
 import shutil
 import time
+import uuid
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -2003,3 +2006,73 @@ def load_imputer_model(path: str):
     )
     model.uid = meta["uid"]
     return _restore_params(model, meta)
+
+
+# -- generic load + atomic save layer --------------------------------------
+
+
+def load_model(path: str):
+    """Load any saved model/estimator by its metadata ``pythonClass``.
+
+    The serving registry's load-from-disk entry point: reads the Spark
+    metadata line, imports the recorded Python class, and delegates to its
+    ``load`` staticmethod — so one call handles every model family this
+    module can write, including ones added later.
+    """
+    meta = _read_metadata(path)
+    dotted = meta.get("pythonClass")
+    if not dotted:
+        raise ValueError(
+            f"{path}: metadata carries no 'pythonClass' (a Spark-written "
+            "directory?); load it with the class-specific reader instead"
+        )
+    module_name, cls_name = dotted.rsplit(".", 1)
+    cls = getattr(importlib.import_module(module_name), cls_name)
+    loader = getattr(cls, "load", None)
+    if loader is None:
+        raise ValueError(f"{dotted} has no load() entry point")
+    return loader(path)
+
+
+def _atomic_save(save_fn):
+    """Make a ``save_*`` writer atomic: the payload is written to a temp
+    sibling directory, then ``os.replace``d into place — the same
+    tmp+rename pattern the flight recorder uses for dumps. A save that
+    crashes mid-write leaves the target untouched (either the previous
+    model or nothing), never a half-written directory for the registry's
+    load path to pick up.
+    """
+
+    @functools.wraps(save_fn)
+    def wrapper(obj, path, *args, overwrite: bool = False, **kwargs):
+        if os.path.exists(path) and not overwrite:
+            _require_target(path, False)  # the standard FileExistsError
+        token = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        tmp = f"{path}.tmp-{token}"
+        old = f"{path}.old-{token}"
+        try:
+            save_fn(obj, tmp, *args, overwrite=True, **kwargs)
+            # Swap via rename-aside: both steps are atomic renames, so a
+            # crash at any point leaves either the previous model at
+            # ``path`` or the complete previous model at the ``.old``
+            # sibling — never a half-written directory, and never both
+            # copies gone (an rmtree-then-replace swap would have a
+            # lose-both window as wide as the rmtree).
+            if os.path.exists(path):  # validated overwrite=True above
+                os.replace(path, old)
+            os.replace(tmp, path)
+            shutil.rmtree(old, ignore_errors=True)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    wrapper.__wrapped_save__ = save_fn
+    return wrapper
+
+
+# Wrap every writer in this module (including ones future sections add
+# above this line). Delegating writers (save_bkm_model → save_kmeans_model)
+# stage twice, which is harmless; the outer replace is the one that counts.
+for _name, _fn in list(globals().items()):
+    if _name.startswith("save_") and callable(_fn):
+        globals()[_name] = _atomic_save(_fn)
+del _name, _fn

@@ -367,9 +367,18 @@ class KMeansModel(KMeansParams):
             from spark_rapids_ml_tpu.ops.kmeans_kernel import (
                 assign_clusters_jit,
             )
+            from spark_rapids_ml_tpu.utils.padding import (
+                pad_to_bucket,
+                transform_padding_enabled,
+            )
 
             device = _resolve_device(self.getDeviceId())
             dtype = _resolve_dtype(self.getDtype())
+            # Bucket-pad ragged batches so varying-size callers share a few
+            # compiled assign signatures; pad-row labels are sliced off.
+            n_rows = x.shape[0]
+            if transform_padding_enabled():
+                x, n_rows = pad_to_bucket(x)
             with transform_phase("device_put"):
                 x_dev = jax.device_put(jnp.asarray(x, dtype=dtype), device)
                 c_dev = jax.device_put(
@@ -378,7 +387,8 @@ class KMeansModel(KMeansParams):
             with transform_phase("compute"):
                 labels_dev = assign_clusters_jit(x_dev, c_dev)
             with transform_phase("host_sync"):
-                labels = np.asarray(jax.block_until_ready(labels_dev))
+                labels = np.asarray(
+                    jax.block_until_ready(labels_dev))[:n_rows]
         else:
             with transform_phase("compute"):
                 labels = _sqdist(x, self.cluster_centers).argmin(axis=1)

@@ -738,16 +738,25 @@ class LogisticRegressionModel(LogisticRegressionParams):
             from spark_rapids_ml_tpu.ops.logreg_kernel import (
                 logreg_predict_kernel,
             )
+            from spark_rapids_ml_tpu.utils.padding import (
+                pad_to_bucket,
+                transform_padding_enabled,
+            )
 
             device = _resolve_device(self.getDeviceId())
             dtype = _resolve_dtype(self.getDtype())
+            # Bucket-pad ragged batches (sigmoid(Xw+b) is row-independent)
+            # so per-request batch sizes reuse compiled signatures.
+            n_rows = x.shape[0]
+            if transform_padding_enabled():
+                x, n_rows = pad_to_bucket(x)
             proba = np.asarray(
                 logreg_predict_kernel(
                     jax.device_put(jnp.asarray(x, dtype=dtype), device),
                     jnp.asarray(self.coefficients, dtype=dtype),
                     jnp.asarray(self.intercept, dtype=dtype),
                 )
-            )
+            )[:n_rows]
         else:
             z = x @ self.coefficients + self.intercept
             proba = _sigmoid(z)

@@ -631,9 +631,20 @@ class PCAModel(PCAParams):
             import jax.numpy as jnp
 
             from spark_rapids_ml_tpu.ops.pca_kernel import pca_transform_kernel
+            from spark_rapids_ml_tpu.utils.padding import (
+                pad_to_bucket,
+                transform_padding_enabled,
+            )
 
             device = _resolve_device(self.getDeviceId())
             dtype = _resolve_dtype(self.getDtype())
+            # Pad ragged batch sizes up to a shape bucket so varying-size
+            # callers reuse a handful of compiled signatures (projection is
+            # row-independent — real rows are bit-identical; pad rows are
+            # sliced off before anyone sees them).
+            n_rows = x_host.shape[0]
+            if transform_padding_enabled():
+                x_host, n_rows = pad_to_bucket(x_host)
             with TraceRange("xla transform", TraceColor.GREEN):
                 with transform_phase("device_put"):
                     x = jax.device_put(
@@ -643,7 +654,7 @@ class PCAModel(PCAParams):
                 with transform_phase("compute"):
                     out_dev = pca_transform_kernel(x, pc)
                 with transform_phase("host_sync"):
-                    out = np.asarray(jax.block_until_ready(out_dev))
+                    out = np.asarray(jax.block_until_ready(out_dev))[:n_rows]
         else:
             from spark_rapids_ml_tpu import native
 

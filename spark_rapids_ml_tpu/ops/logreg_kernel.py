@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from spark_rapids_ml_tpu.obs.xprof import tracked_jit
+
 
 class LogRegResult(NamedTuple):
     coefficients: jnp.ndarray   # (n_features,)
@@ -158,10 +160,12 @@ def update_logreg_stats(carry, batch_z, w, b, mask=None):
     )
 
 
-@jax.jit
+@tracked_jit
 def logreg_predict_kernel(x, coefficients, intercept):
     """Class probabilities σ(X·w + b) — one batched MXU matmul (the
-    enabled-batch-transform posture shared with PCAModel.transform)."""
+    enabled-batch-transform posture shared with PCAModel.transform).
+    Tracked so serving calls carry compile/recompile attribution like the
+    PCA/KMeans transform kernels."""
     return jax.nn.sigmoid(x @ coefficients + intercept)
 
 

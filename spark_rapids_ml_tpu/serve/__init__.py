@@ -1,0 +1,61 @@
+"""The serving engine: model registry, shape-bucketed dynamic batching,
+admission control, and a stdlib HTTP front end.
+
+The transform path PR 3 instrumented becomes an actual inference engine:
+
+* ``ModelRegistry`` (``serve.registry``) — register / alias / version
+  fitted models, load from disk via ``io.persistence``, warm up each
+  model's transform at its shape buckets so deploys precompile instead of
+  the first user paying XLA lowering+compile;
+* ``MicroBatcher`` (``serve.batching``) — coalesce concurrent requests,
+  pad to power-of-two row buckets (``utils.padding.pad_to_bucket``), run
+  ONE compiled program per bucket, split results per request — padded
+  rows never leak;
+* ``ServeEngine`` (``serve.engine``) — the front door: bounded queues
+  with ``QueueFull`` rejection, per-request deadlines shed before device
+  time, graceful drain on shutdown;
+* ``start_serve_server`` (``serve.server``) — ``POST /predict`` /
+  ``GET /healthz`` / ``GET /metrics`` over ``http.server``, no new
+  dependencies.
+
+Every stage emits through ``obs``: queue-depth / occupancy /
+padding-waste gauges, stage latencies in quantile sketches, and each
+engine batch still produces a full ``TransformReport`` because the model
+call goes through the ``@observed_transform`` entry point.
+"""
+
+from spark_rapids_ml_tpu.serve.batching import (  # noqa: F401
+    BatcherClosed,
+    DeadlineExpired,
+    MicroBatcher,
+    QueueFull,
+)
+from spark_rapids_ml_tpu.serve.engine import (  # noqa: F401
+    ENV_PREFIX,
+    EngineClosed,
+    ServeEngine,
+    extract_output,
+)
+from spark_rapids_ml_tpu.serve.registry import (  # noqa: F401
+    ModelRegistry,
+    RegisteredModel,
+)
+from spark_rapids_ml_tpu.serve.server import (  # noqa: F401
+    make_handler,
+    start_serve_server,
+)
+
+__all__ = [
+    "BatcherClosed",
+    "DeadlineExpired",
+    "ENV_PREFIX",
+    "EngineClosed",
+    "MicroBatcher",
+    "ModelRegistry",
+    "QueueFull",
+    "RegisteredModel",
+    "ServeEngine",
+    "extract_output",
+    "make_handler",
+    "start_serve_server",
+]

@@ -1,0 +1,380 @@
+"""Shape-bucketed dynamic micro-batching for the serving engine.
+
+Requests enqueue; one worker per batcher coalesces them — up to
+``max_batch_rows`` rows or ``max_wait_ms`` of linger, whichever lands
+first — concatenates their row matrices, pads the coalesced batch up to
+the nearest configured row bucket (``utils.padding.pad_to_bucket``), runs
+ONE model call over it, and splits the result back per request in enqueue
+order. Steady-state traffic therefore executes a handful of compiled XLA
+signatures (one per bucket) no matter how ragged the request sizes are —
+the fixed-shape funnel of PAPERS.md's Flare / TPU-linear-algebra lineage.
+
+Correctness invariants (tested in ``tests/test_serve_batching.py``):
+
+* padded rows are masked out before the split — they never appear in any
+  response;
+* each request gets exactly its own rows back, in its own order, however
+  the coalescer grouped them;
+* a request whose deadline expired while queued is shed with
+  ``DeadlineExpired`` *before* touching the device, and its neighbours
+  still get their own rows;
+* a batch-level failure propagates the SAME exception to every request in
+  that batch, never a partial/shifted result.
+
+Every stage emits through ``obs``: queue-depth / batch-occupancy /
+padding-waste gauges, per-stage latency (queue wait, execute) into the
+``Summary`` quantile sketches, shed/rejection counters.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.obs import get_registry, span
+from spark_rapids_ml_tpu.utils.padding import (
+    bucket_for,
+    default_buckets,
+    pad_to_bucket,
+    padding_waste,
+)
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the bounded request queue is at
+    ``max_queue_depth`` — shed load at the door instead of building an
+    unbounded latency backlog."""
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed before (or while) it could be
+    served; it was shed without spending device time."""
+
+
+class BatcherClosed(RuntimeError):
+    """The batcher is draining/closed and accepts no new requests."""
+
+
+class _Request:
+    """One enqueued predict request; a latch the caller waits on."""
+
+    __slots__ = ("rows", "n", "enqueued", "deadline", "_event", "result",
+                 "error")
+
+    def __init__(self, rows: np.ndarray, deadline: Optional[float]):
+        self.rows = rows
+        self.n = int(rows.shape[0])
+        self.enqueued = time.monotonic()
+        self.deadline = deadline
+        self._event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now or time.monotonic()) >= self.deadline)
+
+    def set_result(self, value: np.ndarray) -> None:
+        self.result = value
+        self._event.set()
+
+    def set_error(self, exc: BaseException) -> None:
+        self.error = exc
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until served; raises the request's error if it was shed
+        or its batch failed."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not served within wait timeout")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class MicroBatcher:
+    """One model's request queue + coalescing worker.
+
+    ``transform_fn`` receives the PADDED (bucket, d) float matrix and must
+    return a row-aligned array-like (bucket rows, or at least the real
+    rows) — the batcher slices off padding and splits per request.
+    """
+
+    def __init__(
+        self,
+        transform_fn: Callable[[np.ndarray], Any],
+        *,
+        name: str = "model",
+        max_batch_rows: int = 1024,
+        max_wait_ms: float = 5.0,
+        max_queue_depth: int = 256,
+        buckets: Optional[Sequence[int]] = None,
+    ):
+        if max_batch_rows < 1:
+            raise ValueError("max_batch_rows must be >= 1")
+        self.transform_fn = transform_fn
+        self.name = name
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_wait_s = float(max_wait_ms) / 1000.0
+        self.max_queue_depth = int(max_queue_depth)
+        if buckets:
+            self.buckets: Tuple[int, ...] = tuple(
+                sorted(int(b) for b in buckets))
+            # An explicit ladder is a compiled-signature CONTRACT: never
+            # build a batch the ladder cannot hold, or the pow-2 fallback
+            # would compile unwarmed shapes under live traffic.
+            self.max_batch_rows = min(self.max_batch_rows, self.buckets[-1])
+        else:
+            self.buckets = default_buckets(self.max_batch_rows)
+        self._queue: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+        self._declare_metrics()
+        self._worker = threading.Thread(
+            target=self._run, name=f"sparkml-serve-{name}", daemon=True
+        )
+        self._worker.start()
+
+    def _declare_metrics(self) -> None:
+        """Create this model's serving series up front (a dashboard should
+        see a flat 0, not an absent series) and keep the family handles —
+        the hot path increments through them instead of re-resolving
+        name/help/labels per call."""
+        reg = get_registry()
+        self._m_depth = reg.gauge(
+            "sparkml_serve_queue_depth",
+            "requests waiting in the serving queue", ("model",),
+        )
+        self._m_depth.set(0, model=self.name)
+        self._m_occupancy = reg.gauge(
+            "sparkml_serve_batch_occupancy",
+            "real rows / bucket rows of the last executed batch",
+            ("model",),
+        )
+        self._m_occupancy.set(0.0, model=self.name)
+        self._m_waste = reg.gauge(
+            "sparkml_serve_padding_waste",
+            "fraction of the last executed batch that was padding",
+            ("model",),
+        )
+        self._m_waste.set(0.0, model=self.name)
+        self._m_expired = reg.counter(
+            "sparkml_serve_deadline_expired_total",
+            "requests shed because their deadline expired before serving",
+            ("model",),
+        )
+        self._m_expired.inc(0, model=self.name)
+        self._m_rejected = reg.counter(
+            "sparkml_serve_rejected_total",
+            "requests rejected by admission control (queue full)",
+            ("model",),
+        )
+        self._m_rejected.inc(0, model=self.name)
+        self._m_requests = reg.counter(
+            "sparkml_serve_requests_total",
+            "serving requests by outcome", ("model", "outcome"),
+        )
+        self._m_batches = reg.counter(
+            "sparkml_serve_batches_total",
+            "coalesced batches executed", ("model",),
+        )
+        self._m_batch_rows = reg.counter(
+            "sparkml_serve_batch_rows_total",
+            "real (caller) rows executed in coalesced batches", ("model",),
+        )
+        self._m_bucket_rows = reg.counter(
+            "sparkml_serve_bucket_rows_total",
+            "bucket (padded-shape) rows executed — with "
+            "sparkml_serve_batch_rows_total this yields mean occupancy",
+            ("model",),
+        )
+        self._m_coalesced = reg.counter(
+            "sparkml_serve_coalesced_requests_total",
+            "requests served via coalesced batches", ("model",),
+        )
+        self._m_stage = reg.summary(
+            "sparkml_serve_stage_latency_seconds",
+            "per-stage serving latency (queue wait, batch execute)",
+            ("model", "stage"),
+        )
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, rows: np.ndarray,
+               deadline: Optional[float] = None) -> _Request:
+        """Enqueue a (n, d) request; returns the latch to ``wait`` on.
+
+        Raises ``QueueFull`` past ``max_queue_depth`` (admission control)
+        and ``BatcherClosed`` after ``close()`` — both BEFORE the request
+        occupies queue memory.
+        """
+        rows = np.asarray(rows, dtype=np.float64)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2 or rows.shape[0] == 0:
+            raise ValueError(
+                f"expected a non-empty (n, d) request, got shape {rows.shape}"
+            )
+        if rows.shape[0] > self.max_batch_rows:
+            raise ValueError(
+                f"{self.name}: request of {rows.shape[0]} rows exceeds "
+                f"max_batch_rows {self.max_batch_rows} — split it, or "
+                "configure a larger top bucket"
+            )
+        req = _Request(rows, deadline)
+        with self._not_empty:
+            if self._closed:
+                raise BatcherClosed(f"batcher {self.name!r} is closed")
+            if len(self._queue) >= self.max_queue_depth:
+                self._m_requests.inc(model=self.name, outcome="rejected")
+                self._m_rejected.inc(model=self.name)
+                raise QueueFull(
+                    f"{self.name}: queue depth {len(self._queue)} >= "
+                    f"max_queue_depth {self.max_queue_depth}"
+                )
+            self._queue.append(req)
+            self._record_depth()
+            self._not_empty.notify()
+        return req
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting; with ``drain`` the worker serves what's already
+        queued, otherwise queued requests are failed with
+        ``BatcherClosed``. Idempotent."""
+        with self._not_empty:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    self._queue.popleft().set_error(
+                        BatcherClosed(f"batcher {self.name!r} shut down")
+                    )
+                self._record_depth()
+            self._not_empty.notify_all()
+        self._worker.join(timeout=timeout)
+
+    # -- the worker --------------------------------------------------------
+
+    def _pop_live(self) -> Optional[_Request]:
+        """Pop the next unexpired request; shed expired ones (counted,
+        errored) without touching the device. Caller holds the lock."""
+        while self._queue:
+            req = self._queue.popleft()
+            if req.expired():
+                self._shed(req)
+                continue
+            return req
+        return None
+
+    def _shed(self, req: _Request) -> None:
+        req.set_error(DeadlineExpired(
+            f"{self.name}: deadline expired after "
+            f"{time.monotonic() - req.enqueued:.3f}s in queue"
+        ))
+        self._m_requests.inc(model=self.name, outcome="expired")
+        self._m_expired.inc(model=self.name)
+
+    def _run(self) -> None:
+        while True:
+            with self._not_empty:
+                while not self._queue and not self._closed:
+                    self._not_empty.wait(timeout=0.1)
+                first = self._pop_live()
+                if first is None:
+                    if self._closed:
+                        return
+                    self._record_depth()
+                    continue
+                batch = [first]
+                rows = first.n
+                # Linger: coalesce until the row cap or the wait budget.
+                t0 = time.monotonic()
+                while rows < self.max_batch_rows:
+                    remaining = self.max_wait_s - (time.monotonic() - t0)
+                    if not self._queue:
+                        if remaining <= 0 or self._closed:
+                            break
+                        self._not_empty.wait(timeout=remaining)
+                        continue
+                    nxt = self._queue[0]
+                    if nxt.expired():
+                        self._queue.popleft()
+                        self._shed(nxt)
+                        continue
+                    if rows + nxt.n > self.max_batch_rows:
+                        break  # leave it for the next batch
+                    self._queue.popleft()
+                    batch.append(nxt)
+                    rows += nxt.n
+                self._record_depth()
+            try:
+                self._execute(batch)
+            except BaseException:  # noqa: BLE001 - worker must survive
+                pass  # _execute already errored the batch's requests
+
+    def _execute(self, batch: List[_Request]) -> None:
+        now = time.monotonic()
+        stage = self._m_stage
+        for req in batch:
+            stage.observe(now - req.enqueued, model=self.name, stage="queue")
+        matrix = (batch[0].rows if len(batch) == 1
+                  else np.concatenate([r.rows for r in batch], axis=0))
+        try:
+            padded, n = pad_to_bucket(matrix, self.buckets)
+            bucket = int(padded.shape[0])
+            t0 = time.monotonic()
+            with span(f"serve:batch:{self.name}"):
+                out = np.asarray(self.transform_fn(padded))
+            stage.observe(time.monotonic() - t0,
+                          model=self.name, stage="execute")
+            if out.shape[0] < n:
+                raise ValueError(
+                    f"{self.name}: transform returned {out.shape[0]} rows "
+                    f"for a batch of {n}"
+                )
+            out = out[:n]  # padding never leaks into any response
+        except BaseException as exc:  # noqa: BLE001
+            for req in batch:
+                req.set_error(exc)
+            self._m_requests.inc(len(batch), model=self.name,
+                                 outcome="error")
+            raise
+        offset = 0
+        for req in batch:
+            req.set_result(out[offset:offset + req.n])
+            offset += req.n
+        self._m_requests.inc(len(batch), model=self.name, outcome="ok")
+        self._record_batch(n, bucket, len(batch))
+
+    # -- metrics -----------------------------------------------------------
+
+    def _record_depth(self) -> None:
+        self._m_depth.set(len(self._queue), model=self.name)
+
+    def _record_batch(self, real_rows: int, bucket: int,
+                      n_requests: int) -> None:
+        self._m_occupancy.set(
+            real_rows / bucket if bucket else 0.0, model=self.name)
+        self._m_waste.set(padding_waste(real_rows, bucket), model=self.name)
+        self._m_batches.inc(model=self.name)
+        self._m_batch_rows.inc(real_rows, model=self.name)
+        self._m_bucket_rows.inc(bucket, model=self.name)
+        self._m_coalesced.inc(n_requests, model=self.name)
+
+    def expected_signatures(self) -> int:
+        """How many distinct compiled shapes steady-state traffic through
+        this batcher can produce (= the bucket count)."""
+        return len(self.buckets)
+
+    def bucket_for_rows(self, n: int) -> int:
+        return bucket_for(n, self.buckets)

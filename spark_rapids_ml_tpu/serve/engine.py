@@ -1,0 +1,316 @@
+"""The serving front door: admission control, deadlines, graceful drain.
+
+``ServeEngine`` ties the registry and the per-model micro-batchers into
+one synchronous ``predict(model_ref, rows)`` call a thread pool (or the
+stdlib HTTP server in ``serve.server``) can hammer:
+
+* **admission control** — each model's queue is bounded at
+  ``max_queue_depth``; a request arriving past it is rejected with
+  ``QueueFull`` immediately (shed at the door, never an unbounded
+  backlog);
+* **per-request deadlines** — ``deadline_ms`` (or the engine default)
+  stamps a monotonic deadline on the request; one that expires while
+  queued is shed with ``DeadlineExpired`` *before* wasting device time,
+  counted in ``sparkml_serve_deadline_expired_total``;
+* **graceful drain** — ``shutdown()`` stops admissions and serves (or
+  fails, with ``drain=False``) everything already queued before
+  returning.
+
+Model calls go through the model's own ``transform`` entry point, which
+is decorated with ``@observed_transform`` — so every engine batch yields
+a ``TransformReport``, feeds the latency sketches, and passes the
+numerics sentinel exactly like a direct call. The engine adds the serving
+layer's own series on top (queue depth, occupancy, padding waste,
+request outcomes, end-to-end latency).
+
+Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``, constructor args win):
+
+* ``..._MAX_BATCH_ROWS``  (default 1024) — coalescing row cap;
+* ``..._MAX_WAIT_MS``     (default 5)    — batching linger;
+* ``..._MAX_QUEUE_DEPTH`` (default 256)  — admission bound, requests;
+* ``..._DEADLINE_MS``     (default 0 = none) — default request deadline;
+* ``..._BUCKETS``         (e.g. ``"64,256,1024"``) — explicit row-bucket
+  ladder; unset = powers of two up to the row cap.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.serve.batching import (
+    BatcherClosed,
+    DeadlineExpired,
+    MicroBatcher,
+    QueueFull,
+)
+from spark_rapids_ml_tpu.serve.registry import ModelRegistry, RegisteredModel
+
+ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
+
+
+class EngineClosed(RuntimeError):
+    """The engine is shut down (or shutting down) and accepts no new
+    requests."""
+
+
+def _env_number(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(ENV_PREFIX + name, default))
+    except ValueError:
+        return default
+
+
+def _env_buckets() -> Optional[Tuple[int, ...]]:
+    raw = os.environ.get(ENV_PREFIX + "BUCKETS", "").strip()
+    if not raw:
+        return None
+    try:
+        out = tuple(sorted(int(v) for v in raw.split(",") if v.strip()))
+        return out or None
+    except ValueError:
+        return None
+
+
+# Output-column getters tried in order against the model when its
+# transform returns a frame: dimensionality reduction / feature output,
+# probability vectors, hard predictions.
+_OUTPUT_GETTERS = ("getOutputCol", "getProbabilityCol", "getPredictionCol")
+
+
+def extract_output(model, result) -> np.ndarray:
+    """The row-aligned prediction array from a model's transform result.
+
+    ndarray results pass through; frame results yield the model's output
+    column (outputCol, then probabilityCol, then predictionCol — the
+    first getter whose column the result actually carries).
+    """
+    if isinstance(result, np.ndarray):
+        return result
+    columns = getattr(result, "columns", None)
+    column = getattr(result, "column", None)
+    if columns and callable(column):
+        for getter in _OUTPUT_GETTERS:
+            fn = getattr(model, getter, None)
+            if not callable(fn):
+                continue
+            try:
+                name = fn()
+            except Exception:
+                continue
+            if name in columns:
+                return np.asarray(column(name))
+    raise TypeError(
+        f"cannot extract a serving output from {type(result).__name__} "
+        f"for {type(model).__name__}"
+    )
+
+
+class ServeEngine:
+    """Synchronous front door over a ``ModelRegistry``."""
+
+    def __init__(
+        self,
+        registry: Optional[ModelRegistry] = None,
+        *,
+        max_batch_rows: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        max_queue_depth: Optional[int] = None,
+        default_deadline_ms: Optional[float] = None,
+        buckets: Optional[Sequence[int]] = None,
+    ):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.max_batch_rows = int(
+            max_batch_rows if max_batch_rows is not None
+            else _env_number("MAX_BATCH_ROWS", 1024)
+        )
+        self.max_wait_ms = float(
+            max_wait_ms if max_wait_ms is not None
+            else _env_number("MAX_WAIT_MS", 5.0)
+        )
+        self.max_queue_depth = int(
+            max_queue_depth if max_queue_depth is not None
+            else _env_number("MAX_QUEUE_DEPTH", 256)
+        )
+        self.default_deadline_ms = float(
+            default_deadline_ms if default_deadline_ms is not None
+            else _env_number("DEADLINE_MS", 0.0)
+        )
+        self.buckets = tuple(buckets) if buckets else _env_buckets()
+        self._batchers: Dict[Tuple[str, int], MicroBatcher] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        # hot-path metric handle, resolved once (same convention as
+        # MicroBatcher._declare_metrics)
+        self._m_latency = get_registry().summary(
+            "sparkml_serve_request_latency_seconds",
+            "end-to-end serving request latency (admit → split)",
+            ("model",),
+        )
+
+    # -- the request path --------------------------------------------------
+
+    def predict(
+        self,
+        model_ref: str,
+        rows,
+        *,
+        deadline_ms: Optional[float] = None,
+        version: Optional[int] = None,
+        timeout: Optional[float] = 120.0,
+    ) -> np.ndarray:
+        """Serve one request: resolve, admit, coalesce, return its rows.
+
+        Raises ``KeyError`` (unknown model), ``QueueFull`` (admission),
+        ``DeadlineExpired`` (shed while queued), ``EngineClosed``.
+        """
+        if self._closed:
+            raise EngineClosed("serving engine is shut down")
+        t0 = time.perf_counter()
+        entry = self.registry.resolve_entry(model_ref, version)
+        batcher = self._batcher_for(entry)
+        budget_ms = (deadline_ms if deadline_ms is not None
+                     else self.default_deadline_ms)
+        deadline = (time.monotonic() + budget_ms / 1000.0
+                    if budget_ms and budget_ms > 0 else None)
+        req = batcher.submit(rows, deadline=deadline)
+        out = req.wait(timeout)
+        self._m_latency.observe(time.perf_counter() - t0, model=entry.name)
+        return out
+
+    # -- batcher plumbing --------------------------------------------------
+
+    def _batcher_for(self, entry: RegisteredModel) -> MicroBatcher:
+        key = (entry.name, entry.version)
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("serving engine is shut down")
+            batcher = self._batchers.get(key)
+            if batcher is None:
+                model = entry.model
+                buckets = self.buckets or entry.buckets
+                batcher = MicroBatcher(
+                    lambda matrix: extract_output(
+                        model, model.transform(matrix)
+                    ),
+                    name=entry.name,
+                    max_batch_rows=self.max_batch_rows,
+                    max_wait_ms=self.max_wait_ms,
+                    max_queue_depth=self.max_queue_depth,
+                    buckets=buckets,
+                )
+                self._batchers[key] = batcher
+            stale = self._stale_keys(entry.name)
+        # Outside the lock: retire batchers for versions the registry no
+        # longer knows (deregistered after a rollover) — otherwise every
+        # rolled version leaks a worker thread and pins its model forever.
+        # ``key`` itself just resolved, so it is never in the stale set.
+        for k in stale:
+            self.evict(*k)
+        return batcher
+
+    def _stale_keys(self, name: str):
+        """Batcher keys for ``name`` whose version the registry has
+        dropped. Pinned aliases keep their entries registered, so live
+        old-version traffic is never evicted. Caller holds the lock."""
+        stale = []
+        for key in self._batchers:
+            if key[0] != name:
+                continue
+            try:
+                self.registry.resolve_entry(key[0], key[1])
+            except KeyError:
+                stale.append(key)
+        return stale
+
+    def evict(self, name: str, version: int, drain: bool = True) -> bool:
+        """Close and drop one (name, version) batcher — call after
+        ``registry.deregister`` (or rely on the automatic sweep the next
+        time a new version's batcher is created). Returns whether a
+        batcher existed."""
+        with self._lock:
+            batcher = self._batchers.pop((name, version), None)
+        if batcher is None:
+            return False
+        batcher.close(drain=drain)
+        return True
+
+    def warmup(self, model_ref: str, *, n_features: Optional[int] = None):
+        """Warm ``model_ref`` at the buckets THIS engine will pad to
+        (engine-level ``buckets`` override the registry entry's), so the
+        compiled-signature set matches real traffic exactly — a registry
+        warmup can miss shapes when the engine is configured with its own
+        ladder."""
+        entry = self.registry.resolve_entry(model_ref)
+        # None falls through to the batcher's own default ladder
+        # (default_buckets(max_batch_rows)) — registry.warmup builds the
+        # same ladder from max_bucket_rows.
+        return self.registry.warmup(
+            model_ref, n_features=n_features,
+            buckets=self.buckets or entry.buckets,
+            max_bucket_rows=self.max_batch_rows,
+        )
+
+    # -- lifecycle / introspection ----------------------------------------
+
+    def queue_depth(self, model_ref: Optional[str] = None) -> int:
+        with self._lock:
+            batchers = list(self._batchers.items())
+        return sum(
+            b.depth() for (name, _v), b in batchers
+            if model_ref is None or name == model_ref
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            batchers = dict(self._batchers)
+        return {
+            "closed": self._closed,
+            "queues": {
+                f"{name}@{version}": {
+                    "depth": b.depth(),
+                    "buckets": list(b.buckets),
+                    "max_batch_rows": b.max_batch_rows,
+                }
+                for (name, version), b in batchers.items()
+            },
+        }
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Serve everything queued, keep accepting afterwards (a quiesce
+        point, e.g. before a model rollover)."""
+        deadline = time.monotonic() + timeout
+        while self.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop admissions, then drain (or fail, with ``drain=False``)
+        what's queued. Idempotent."""
+        with self._lock:
+            self._closed = True
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.close(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+__all__ = [
+    "BatcherClosed",
+    "DeadlineExpired",
+    "EngineClosed",
+    "ENV_PREFIX",
+    "MicroBatcher",
+    "QueueFull",
+    "ServeEngine",
+    "extract_output",
+]

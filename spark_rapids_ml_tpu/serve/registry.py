@@ -1,0 +1,270 @@
+"""Thread-safe model registry: the serving engine's model catalogue.
+
+Names map to immutable numbered versions of fitted models; aliases
+(``"prod" → ("pca_embedder", 3)``) give traffic a stable handle while new
+versions roll in behind it. Models arrive either in-process (``register``
+a freshly fitted model) or from disk (``load`` delegates to
+``io.persistence.load_model``, which dispatches on the saved metadata's
+``pythonClass`` — and since every ``save_*`` writer is atomic, a crashed
+save can never hand this loader a half-written directory).
+
+``warmup`` precompiles a model's transform at its configured shape buckets
+by pushing zero batches through it — so the first real request after a
+deploy hits a warm XLA cache instead of paying lowering+compile on the
+serving path (the recompile-storm cliff ``obs/xprof.py`` detects, paid
+once at deploy time instead).
+
+Everything observable rides the existing ``obs`` stack: registered-model
+gauge, load/warmup counters, warmup seconds per bucket in the returned
+report and the metrics registry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.obs import get_registry, span
+from spark_rapids_ml_tpu.obs.spans import utcnow_iso
+from spark_rapids_ml_tpu.utils.padding import default_buckets
+
+# Attributes probed (in order) to infer a model's expected feature count
+# for warmup batches when the caller does not pass one.
+_FEATURE_HINTS = (
+    ("pc", lambda v: v.shape[0]),                  # PCAModel (n_features, k)
+    ("cluster_centers", lambda v: v.shape[1]),     # KMeans (k, n_features)
+    ("coefficients", lambda v: np.asarray(v).shape[0]),
+    ("coefficient_matrix", lambda v: v.shape[1]),  # multinomial (K, d)
+)
+
+
+class RegisteredModel:
+    """One immutable (name, version) registry entry."""
+
+    __slots__ = ("name", "version", "model", "buckets", "registered_utc",
+                 "warmed_buckets", "source_path")
+
+    def __init__(self, name: str, version: int, model: Any,
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 source_path: Optional[str] = None):
+        self.name = name
+        self.version = version
+        self.model = model
+        self.buckets = tuple(buckets) if buckets else None
+        self.registered_utc = utcnow_iso()
+        self.warmed_buckets: Tuple[int, ...] = ()
+        self.source_path = source_path
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "model_class": type(self.model).__name__,
+            "buckets": list(self.buckets) if self.buckets else None,
+            "registered_utc": self.registered_utc,
+            "warmed_buckets": list(self.warmed_buckets),
+            "source_path": self.source_path,
+        }
+
+
+class ModelRegistry:
+    """register / alias / version fitted models; resolve by name."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._versions: Dict[str, Dict[int, RegisteredModel]] = {}
+        self._aliases: Dict[str, Tuple[str, Optional[int]]] = {}
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, name: str, model: Any, *,
+                 buckets: Optional[Sequence[int]] = None,
+                 source_path: Optional[str] = None) -> int:
+        """Register a fitted model under ``name``; returns the assigned
+        version (1 + the previous highest — versions are immutable, a
+        re-register is a new version, never a mutation)."""
+        if not name or "@" in name:
+            raise ValueError(
+                f"invalid model name {name!r} ('@' is the version separator)"
+            )
+        with self._lock:
+            versions = self._versions.setdefault(name, {})
+            version = max(versions, default=0) + 1
+            versions[version] = RegisteredModel(
+                name, version, model, buckets=buckets,
+                source_path=source_path,
+            )
+            self._record_gauge()
+        get_registry().counter(
+            "sparkml_serve_model_registrations_total",
+            "models registered into the serving registry", ("model",),
+        ).inc(model=name)
+        return version
+
+    def load(self, name: str, path: str, *,
+             buckets: Optional[Sequence[int]] = None) -> int:
+        """Load a saved model from ``path`` (``io.persistence.load_model``
+        dispatch) and register it; returns the assigned version."""
+        from spark_rapids_ml_tpu.io.persistence import load_model
+
+        with span(f"serve:load:{name}"):
+            model = load_model(path)
+        get_registry().counter(
+            "sparkml_serve_model_loads_total",
+            "models loaded from disk into the serving registry", ("model",),
+        ).inc(model=name)
+        return self.register(name, model, buckets=buckets, source_path=path)
+
+    def alias(self, alias: str, name: str,
+              version: Optional[int] = None) -> None:
+        """Point ``alias`` at ``name`` (pinned to ``version``, or floating
+        to the latest when None). Re-aliasing is how traffic rolls over."""
+        with self._lock:
+            if name not in self._versions:
+                raise KeyError(f"unknown model {name!r}")
+            if version is not None and version not in self._versions[name]:
+                raise KeyError(f"unknown version {name!r}@{version}")
+            self._aliases[alias] = (name, version)
+
+    def deregister(self, name: str, version: Optional[int] = None) -> None:
+        """Drop one version (or every version) of ``name``; aliases to it
+        dangle and resolve() will raise — deliberate, so a bad rollover is
+        loud rather than silently serving a deleted model."""
+        with self._lock:
+            if name not in self._versions:
+                raise KeyError(f"unknown model {name!r}")
+            if version is None:
+                del self._versions[name]
+            else:
+                del self._versions[name][version]
+                if not self._versions[name]:
+                    del self._versions[name]
+            self._record_gauge()
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_entry(self, ref: str,
+                      version: Optional[int] = None) -> RegisteredModel:
+        """``"name"`` (latest), ``"name@3"`` (pinned), or an alias."""
+        with self._lock:
+            if version is None and "@" in ref:
+                ref, _, v = ref.partition("@")
+                try:
+                    version = int(v)
+                except ValueError:
+                    # a client error, not an internal one — KeyError maps
+                    # to 404 at the HTTP layer like any unknown ref
+                    raise KeyError(
+                        f"bad version suffix in model ref {ref!r}@{v!r} "
+                        "(expected an integer)"
+                    ) from None
+            if ref in self._aliases and ref not in self._versions:
+                name, pinned = self._aliases[ref]
+                version = pinned if version is None else version
+                ref = name
+            versions = self._versions.get(ref)
+            if not versions:
+                raise KeyError(f"unknown model {ref!r}")
+            if version is None:
+                version = max(versions)
+            entry = versions.get(version)
+            if entry is None:
+                raise KeyError(f"unknown version {ref!r}@{version}")
+            return entry
+
+    def resolve(self, ref: str, version: Optional[int] = None) -> Any:
+        return self.resolve_entry(ref, version).model
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._versions)
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self, ref: str, *, n_features: Optional[int] = None,
+               buckets: Optional[Sequence[int]] = None,
+               max_bucket_rows: int = 1024) -> Dict[str, Any]:
+        """Precompile ``ref``'s transform at its shape buckets.
+
+        Pushes one all-zero batch per bucket through ``model.transform``
+        (row-independent kernels make zeros safe), so every steady-state
+        signature is compiled before real traffic arrives. Returns
+        ``{"buckets": {rows: seconds, ...}, "total_seconds": ...}``.
+        """
+        entry = self.resolve_entry(ref)
+        model = entry.model
+        if n_features is None:
+            n_features = _infer_features(model)
+        if n_features is None:
+            raise ValueError(
+                f"cannot infer feature count for {ref!r}; pass n_features="
+            )
+        chosen = tuple(buckets or entry.buckets
+                       or default_buckets(max_bucket_rows))
+        report: Dict[int, float] = {}
+        t_total = time.perf_counter()
+        for bucket in sorted(set(int(b) for b in chosen)):
+            zeros = np.zeros((bucket, int(n_features)))
+            t0 = time.perf_counter()
+            with span(f"serve:warmup:{entry.name}"):
+                model.transform(zeros)
+            report[bucket] = time.perf_counter() - t0
+        entry.warmed_buckets = tuple(sorted(report))
+        if entry.buckets is None:
+            entry.buckets = tuple(sorted(report))
+        get_registry().counter(
+            "sparkml_serve_warmups_total",
+            "warmup passes run against registered models", ("model",),
+        ).inc(model=entry.name)
+        get_registry().gauge(
+            "sparkml_serve_warmup_seconds",
+            "wall-clock of the last warmup pass", ("model",),
+        ).set(time.perf_counter() - t_total, model=entry.name)
+        return {
+            "model": entry.name,
+            "version": entry.version,
+            "buckets": report,
+            "total_seconds": time.perf_counter() - t_total,
+        }
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-safe registry state + the live metrics-registry snapshot
+        (queue depth, occupancy, deadline counters... — everything the
+        serving stack emits)."""
+        with self._lock:
+            models = {
+                name: [versions[v].as_dict() for v in sorted(versions)]
+                for name, versions in self._versions.items()
+            }
+            aliases = {
+                a: {"name": n, "version": v}
+                for a, (n, v) in self._aliases.items()
+            }
+        return {
+            "models": models,
+            "aliases": aliases,
+            "metrics": get_registry().snapshot(),
+        }
+
+    def _record_gauge(self) -> None:
+        n = sum(len(v) for v in self._versions.values())
+        get_registry().gauge(
+            "sparkml_serve_registered_models",
+            "model versions currently registered for serving",
+        ).set(n)
+
+
+def _infer_features(model) -> Optional[int]:
+    for attr, extract in _FEATURE_HINTS:
+        value = getattr(model, attr, None)
+        if value is not None:
+            try:
+                return int(extract(value))
+            except Exception:
+                continue
+    return None

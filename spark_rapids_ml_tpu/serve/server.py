@@ -1,0 +1,160 @@
+"""Stdlib HTTP front end: predict + healthz + metrics, zero dependencies.
+
+A thin JSON shim over ``ServeEngine`` so the whole serving stack is
+drivable end-to-end (curl, load generators, k8s probes) without adding a
+web framework to the container:
+
+* ``POST /predict`` — body ``{"model": "name[@version]",
+  "rows": [[...], ...], "deadline_ms": 250}`` → ``{"model", "version",
+  "outputs": [...]}``; admission rejection maps to **429**, a shed
+  deadline to **504**, an unknown model to **404**, malformed input to
+  **400**;
+* ``GET /healthz`` — engine liveness + registered models + queue depth
+  (the readiness probe target);
+* ``GET /metrics`` — the process metrics registry as Prometheus text
+  (same exposition ``obs.metrics.start_prometheus_server`` serves), so
+  one port carries traffic AND its observability.
+
+Threaded (one request per handler thread) — concurrency funnels into the
+engine's micro-batchers, which is the whole point.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import socketserver
+import threading
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.serve.batching import (
+    BatcherClosed,
+    DeadlineExpired,
+    QueueFull,
+)
+from spark_rapids_ml_tpu.serve.engine import EngineClosed, ServeEngine
+
+_MAX_BODY_BYTES = 64 * 1024 * 1024  # refuse absurd request bodies
+
+
+def _json_safe(outputs: np.ndarray):
+    return np.asarray(outputs).tolist()
+
+
+def make_handler(engine: ServeEngine):
+    """The request-handler class bound to one engine instance."""
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, status: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_text(self, status: int, text: str,
+                        content_type: str) -> None:
+            body = text.encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - http.server API
+            path = self.path.split("?")[0]
+            if path == "/healthz":
+                self._reply(200, {
+                    "status": "ok" if not engine._closed else "draining",
+                    "models": engine.registry.names(),
+                    "queue_depth": engine.queue_depth(),
+                })
+            elif path == "/metrics":
+                self._reply_text(
+                    200, get_registry().prometheus_text(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._reply(404, {"error": f"unknown path {path!r}"})
+
+        def do_POST(self):  # noqa: N802 - http.server API
+            path = self.path.split("?")[0]
+            if path != "/predict":
+                self._reply(404, {"error": f"unknown path {path!r}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if length <= 0 or length > _MAX_BODY_BYTES:
+                    raise ValueError(f"bad Content-Length {length}")
+                payload = json.loads(self.rfile.read(length))
+                model_ref = payload["model"]
+                rows = np.asarray(payload["rows"], dtype=np.float64)
+                deadline_ms = payload.get("deadline_ms")
+            except (KeyError, TypeError, ValueError) as exc:
+                # The body may be partially (or not at all) consumed —
+                # a keep-alive connection would desync, so close it.
+                self.close_connection = True
+                self._reply(400, {"error": f"bad request: {exc}"})
+                return
+            try:
+                # Resolve once and predict against the PINNED version, so
+                # the reported version is the one that actually served the
+                # request even if a concurrent register() bumps "latest".
+                entry = engine.registry.resolve_entry(model_ref)
+                outputs = engine.predict(
+                    entry.name, rows, version=entry.version,
+                    deadline_ms=deadline_ms,
+                )
+            except KeyError as exc:
+                self._reply(404, {"error": str(exc)})
+            except ValueError as exc:
+                # request-shape errors (empty / oversize batch) are the
+                # client's to fix
+                self._reply(400, {"error": str(exc)})
+            except QueueFull as exc:
+                self._reply(429, {"error": str(exc)})
+            except DeadlineExpired as exc:
+                self._reply(504, {"error": str(exc)})
+            except (BatcherClosed, EngineClosed) as exc:
+                # both mean "shutting down" — retryable 503, not a 5xx page
+                self._reply(503, {"error": str(exc)})
+            except Exception as exc:  # noqa: BLE001 - surface, don't die
+                self._reply(500, {
+                    "error": f"{type(exc).__name__}: {exc}"
+                })
+            else:
+                self._reply(200, {
+                    "model": entry.name,
+                    "version": entry.version,
+                    "outputs": _json_safe(outputs),
+                })
+
+        def log_message(self, *args):  # silence per-request stderr noise
+            pass
+
+    return _Handler
+
+
+class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def start_serve_server(
+    engine: ServeEngine, port: int = 0, addr: str = "127.0.0.1",
+) -> http.server.HTTPServer:
+    """Serve the engine on a daemon thread; returns the HTTPServer (bind
+    ``port=0`` for ephemeral — read ``server.server_address[1]``; stop
+    with ``server.shutdown()``, then ``engine.shutdown()`` to drain)."""
+    server = _Server((addr, port), make_handler(engine))
+    thread = threading.Thread(
+        target=server.serve_forever, name="sparkml-serve-http", daemon=True
+    )
+    thread.start()
+    return server

@@ -1,0 +1,113 @@
+"""Shape-bucket padding: funnel ragged batch sizes into few compiled shapes.
+
+XLA compiles one executable per distinct input shape, so serving traffic
+whose batch size varies per request re-pays lowering+compile on every new
+row count — the exact "recompile storm" ``obs/xprof.py`` warns about, and
+the latency cliff the Flare thesis attributes to interpreting arbitrary
+shapes instead of compiling a fixed kernel set (PAPERS.md, arXiv:1703.08219).
+The fix is the fixed-shape panel trick from the TPU linear-algebra work
+(arXiv:2112.09017): round every batch up to the nearest configured **row
+bucket** (powers of two by default), mask/slice the padding back off, and
+steady-state traffic hits a handful of compiled signatures.
+
+``pad_to_bucket`` is the one shared helper: the serving engine's
+micro-batcher pads coalesced request batches with it, and the PCA / KMeans /
+LogisticRegression transform bodies route direct (non-engine) callers
+through it too, so a caller looping over ragged pandas chunks stops
+triggering per-shape recompiles without ever seeing a padded row.
+
+Padding is semantically free for these kernels: every serving kernel in
+``ops/`` is row-independent (X @ PC, distance argmin, sigmoid(Xw+b)), so a
+real row's output is bit-identical whether or not zero rows ride below it;
+the pad rows are sliced off before any caller sees them.
+
+``SPARK_RAPIDS_ML_TPU_TRANSFORM_PAD=0`` disables transform-body padding
+(exact-shape execution, one compile per distinct batch size — the
+pre-bucketing behavior).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+TRANSFORM_PAD_ENV = "SPARK_RAPIDS_ML_TPU_TRANSFORM_PAD"
+
+# Below this row count every batch shares ONE bucket: tiny interactive
+# requests (1..8 rows) should hit a single compiled signature, not four.
+MIN_BUCKET_ROWS = 8
+
+
+def transform_padding_enabled() -> bool:
+    """Whether transform bodies pad direct callers to row buckets
+    (default on; ``SPARK_RAPIDS_ML_TPU_TRANSFORM_PAD=0`` restores
+    exact-shape execution)."""
+    return os.environ.get(TRANSFORM_PAD_ENV, "1") != "0"
+
+
+def default_buckets(max_rows: int) -> Tuple[int, ...]:
+    """The power-of-two bucket ladder up to (at least) ``max_rows``:
+    ``(8, 16, 32, ..., next_pow2(max_rows))``."""
+    out = []
+    b = MIN_BUCKET_ROWS
+    while True:
+        out.append(b)
+        if b >= max_rows:
+            return tuple(out)
+        b *= 2
+
+
+def bucket_for(n_rows: int, buckets: Optional[Sequence[int]] = None) -> int:
+    """The row bucket a batch of ``n_rows`` pads up to.
+
+    With an explicit ``buckets`` ladder: the smallest bucket >= n_rows,
+    or the largest bucket when the batch exceeds them all (the caller —
+    the engine's ``max_batch_rows`` — is expected to cap batches at the
+    top bucket; an oversize direct batch falls back to the next power of
+    two so it still compiles a reusable shape). Without one: the next
+    power of two, floored at ``MIN_BUCKET_ROWS``.
+    """
+    if n_rows < 1:
+        raise ValueError(f"n_rows must be >= 1, got {n_rows}")
+    if buckets:
+        for b in sorted(int(v) for v in buckets):
+            if b >= n_rows:
+                return b
+    # next power of two, floored
+    b = MIN_BUCKET_ROWS
+    while b < n_rows:
+        b *= 2
+    return b
+
+
+def pad_to_bucket(
+    rows: np.ndarray, buckets: Optional[Sequence[int]] = None
+) -> Tuple[np.ndarray, int]:
+    """Pad a (n, d) row matrix up to its shape bucket with zero rows.
+
+    Returns ``(padded, n)`` where ``padded.shape[0] == bucket_for(n)`` and
+    ``n`` is the original row count — the caller slices its result back to
+    ``[:n]`` so padding never leaks into any response. A batch already
+    sitting exactly on a bucket boundary is returned as-is (no copy), and
+    so is an EMPTY batch — a 0-row transform must keep returning 0 rows,
+    not raise.
+    """
+    rows = np.asarray(rows)
+    if rows.ndim != 2:
+        raise ValueError(f"expected a (n, d) matrix, got shape {rows.shape}")
+    n = int(rows.shape[0])
+    if n == 0:
+        return rows, 0
+    bucket = bucket_for(n, buckets)
+    if bucket == n:
+        return rows, n
+    return np.pad(rows, ((0, bucket - n), (0, 0))), n
+
+
+def padding_waste(n_rows: int, bucket: int) -> float:
+    """Fraction of the padded batch that is filler (0.0 on exact fit)."""
+    if bucket <= 0:
+        return 0.0
+    return max(bucket - n_rows, 0) / bucket

@@ -85,3 +85,107 @@ def test_estimator_roundtrip(tmp_path):
 def test_unfitted_model_save_fails(tmp_path):
     with pytest.raises(ValueError, match="unfitted"):
         PCAModel().save(str(tmp_path / "m"))
+
+
+def test_atomic_save_crash_leaves_no_half_written_model(tmp_path, rng,
+                                                        monkeypatch):
+    """A save that dies mid-write must leave the target absent (not a
+    half-written directory the serving registry's load path would trip
+    over) and clean up its temp sibling."""
+    from spark_rapids_ml_tpu.io import persistence
+
+    x = rng.normal(size=(20, 4))
+    model = PCA().setK(2).fit(x)
+    path = str(tmp_path / "model")
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("disk fell over mid-save")
+
+    monkeypatch.setattr(persistence, "_write_data_row", boom)
+    with pytest.raises(RuntimeError, match="mid-save"):
+        model.save(path)
+    assert not os.path.exists(path)
+    assert not [p for p in os.listdir(tmp_path) if ".tmp-" in p]
+
+
+def test_atomic_overwrite_crash_keeps_previous_model(tmp_path, rng,
+                                                     monkeypatch):
+    """A crashed overwrite keeps the PREVIOUS model loadable — the swap
+    only happens after the new payload is fully written."""
+    from spark_rapids_ml_tpu.io import persistence
+
+    x = rng.normal(size=(20, 4))
+    model = PCA().setK(2).fit(x)
+    path = str(tmp_path / "model")
+    model.save(path)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("disk fell over mid-save")
+
+    monkeypatch.setattr(persistence, "_write_data_row", boom)
+    with pytest.raises(RuntimeError, match="mid-save"):
+        model.save(path, overwrite=True)
+    assert not [p for p in os.listdir(tmp_path) if ".tmp-" in p]
+    loaded = PCAModel.load(path)  # previous payload intact
+    np.testing.assert_allclose(loaded.pc, model.pc, atol=0)
+
+
+def test_atomic_save_leaves_no_tmp_on_success(tmp_path, rng):
+    x = rng.normal(size=(20, 4))
+    model = PCA().setK(2).fit(x)
+    path = str(tmp_path / "model")
+    model.save(path)
+    model.save(path, overwrite=True)
+    assert sorted(os.listdir(tmp_path)) == ["model"]
+
+
+def test_generic_load_model_dispatch(tmp_path, rng):
+    """io.persistence.load_model resolves the saved pythonClass — the
+    serving registry's load-from-disk entry point."""
+    from spark_rapids_ml_tpu import KMeans
+    from spark_rapids_ml_tpu.io.persistence import load_model
+
+    x = rng.normal(size=(30, 4))
+    pca_path = str(tmp_path / "pca")
+    PCA().setK(2).fit(x).save(pca_path)
+    km_path = str(tmp_path / "km")
+    KMeans().setK(3).fit(x).save(km_path)
+    assert type(load_model(pca_path)).__name__ == "PCAModel"
+    assert type(load_model(km_path)).__name__ == "KMeansModel"
+    with pytest.raises(FileNotFoundError):
+        load_model(str(tmp_path / "ghost"))
+
+
+def test_atomic_overwrite_swap_crash_preserves_a_complete_copy(tmp_path, rng,
+                                                               monkeypatch):
+    """Even a crash INSIDE the swap itself (after the new payload is
+    complete) leaves a complete model on disk: the rename-aside step
+    parks the previous model at a .old sibling before the target flips."""
+    import os as _os
+
+    from spark_rapids_ml_tpu.io import persistence
+
+    x = rng.normal(size=(20, 4))
+    model = PCA().setK(2).fit(x)
+    path = str(tmp_path / "model")
+    model.save(path)
+
+    real_replace = _os.replace
+    calls = {"n": 0}
+
+    def crashy_replace(src, dst):
+        calls["n"] += 1
+        if calls["n"] == 1:          # the rename-aside of the old model
+            real_replace(src, dst)
+            raise RuntimeError("killed between the two renames")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(persistence.os, "replace", crashy_replace)
+    with pytest.raises(RuntimeError, match="between the two renames"):
+        model.save(path, overwrite=True)
+    monkeypatch.setattr(persistence.os, "replace", real_replace)
+    # the previous model survived, complete, at the .old sibling
+    old_dirs = [p for p in os.listdir(tmp_path) if ".old-" in p]
+    assert len(old_dirs) == 1
+    recovered = PCAModel.load(str(tmp_path / old_dirs[0]))
+    np.testing.assert_allclose(recovered.pc, model.pc, atol=0)

@@ -1,0 +1,267 @@
+"""MicroBatcher correctness invariants: padded rows never leak, per-request
+ordering survives coalesce/split, deadlines shed the right request,
+admission control bounds the queue, and concurrent submits see every row
+exactly once."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.serve.batching import (
+    BatcherClosed,
+    DeadlineExpired,
+    MicroBatcher,
+    QueueFull,
+)
+
+
+class _Recorder:
+    """An identity transform_fn that records every padded batch it ran —
+    returning the FULL padded matrix, so any padding leak would be
+    visible in a response."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.batches = []
+        self.lock = threading.Lock()
+
+    def __call__(self, matrix):
+        if self.delay:
+            time.sleep(self.delay)
+        with self.lock:
+            self.batches.append(np.array(matrix))
+        return matrix
+
+
+def _counter_value(name, **labels):
+    snap = get_registry().snapshot().get(name, {"samples": []})
+    for sample in snap["samples"]:
+        if sample["labels"] == labels:
+            return sample["value"]
+    return 0.0
+
+
+def test_padded_rows_never_leak(rng):
+    """Requests of non-bucket sizes get exactly their own rows back even
+    though the executed batch was padded (and the transform_fn returned
+    the padding too)."""
+    fn = _Recorder()
+    b = MicroBatcher(fn, name="leak", max_batch_rows=64, max_wait_ms=1)
+    try:
+        for n in (5, 7, 13):
+            x = rng.normal(size=(n, 3))
+            out = b.submit(x).wait(10)
+            assert out.shape == (n, 3)
+            np.testing.assert_array_equal(out, x)
+    finally:
+        b.close()
+    # every executed batch really was padded up to a bucket
+    for batch in fn.batches:
+        assert batch.shape[0] in b.buckets
+
+
+def test_ordering_survives_coalesce_and_split(rng):
+    """Several requests coalesced into one executed batch each get their
+    own rows, in their own order."""
+    fn = _Recorder(delay=0.2)  # plug: first call holds the worker busy
+    b = MicroBatcher(fn, name="order", max_batch_rows=256, max_wait_ms=20)
+    try:
+        plug = b.submit(rng.normal(size=(4, 3)))
+        time.sleep(0.05)  # the plug is now executing; these queue up
+        fn.delay = 0.0
+        xs = [np.full((n, 3), float(i)) + np.arange(n)[:, None]
+              for i, n in enumerate((5, 9, 3, 17))]
+        reqs = [b.submit(x) for x in xs]
+        plug.wait(10)
+        outs = [r.wait(10) for r in reqs]
+        for x, out in zip(xs, outs):
+            np.testing.assert_array_equal(out, x)
+        # they actually shared one coalesced executed batch
+        assert len(fn.batches) == 2  # plug + the coalesced batch
+        assert fn.batches[1].shape[0] >= sum(x.shape[0] for x in xs)
+    finally:
+        b.close()
+
+
+def test_deadline_expired_gets_error_not_neighbor_rows(rng):
+    """A request whose deadline lapses while queued is shed with its own
+    DeadlineExpired — and its neighbour still gets its own rows."""
+    fn = _Recorder(delay=0.25)
+    b = MicroBatcher(fn, name="deadline", max_batch_rows=64, max_wait_ms=1)
+    try:
+        before = _counter_value(
+            "sparkml_serve_deadline_expired_total", model="deadline")
+        plug = b.submit(rng.normal(size=(4, 3)))
+        time.sleep(0.05)
+        fn.delay = 0.0
+        doomed = b.submit(rng.normal(size=(6, 3)),
+                          deadline=time.monotonic() + 0.05)
+        healthy_x = rng.normal(size=(5, 3))
+        healthy = b.submit(healthy_x)
+        plug.wait(10)
+        with pytest.raises(DeadlineExpired):
+            doomed.wait(10)
+        np.testing.assert_array_equal(healthy.wait(10), healthy_x)
+        after = _counter_value(
+            "sparkml_serve_deadline_expired_total", model="deadline")
+        assert after == before + 1
+    finally:
+        b.close()
+
+
+def test_concurrent_submits_every_row_exactly_once(rng):
+    """8 threads submitting mixed-size requests concurrently: every row
+    comes back exactly once, to its submitter, in order."""
+    fn = _Recorder()
+    b = MicroBatcher(fn, name="conc", max_batch_rows=128, max_wait_ms=2)
+    results = {}
+    errors = []
+
+    def worker(tid):
+        try:
+            local_rng = np.random.default_rng(tid)
+            for j in range(6):
+                n = int(local_rng.integers(1, 30))
+                # feature 0 is a globally unique row id
+                base = (tid * 1000 + j * 100)
+                x = np.arange(base, base + n, dtype=np.float64)[:, None]
+                x = np.hstack([x, local_rng.normal(size=(n, 2))])
+                out = b.submit(x).wait(30)
+                results[(tid, j)] = (x, out)
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    b.close()
+    assert not errors
+    assert len(results) == 48
+    all_ids = []
+    for x, out in results.values():
+        np.testing.assert_array_equal(out, x)  # own rows, own order
+        all_ids.extend(out[:, 0].tolist())
+    assert len(all_ids) == len(set(all_ids))  # every row exactly once
+    total_rows = sum(x.shape[0] for x, _ in results.values())
+    assert len(all_ids) == total_rows
+
+
+def test_queue_full_rejects_at_the_door(rng):
+    fn = _Recorder(delay=0.3)
+    b = MicroBatcher(fn, name="full", max_batch_rows=8, max_wait_ms=1,
+                     max_queue_depth=2)
+    try:
+        plug = b.submit(rng.normal(size=(2, 3)))
+        time.sleep(0.05)  # plug executing; queue is empty again
+        fn.delay = 0.0
+        q1 = b.submit(rng.normal(size=(2, 3)))
+        q2 = b.submit(rng.normal(size=(2, 3)))
+        with pytest.raises(QueueFull):
+            b.submit(rng.normal(size=(2, 3)))
+        assert _counter_value(
+            "sparkml_serve_rejected_total", model="full") >= 1
+        for r in (plug, q1, q2):
+            r.wait(10)
+    finally:
+        b.close()
+
+
+def test_close_drains_queued_requests(rng):
+    fn = _Recorder(delay=0.2)
+    b = MicroBatcher(fn, name="drain", max_batch_rows=64, max_wait_ms=1)
+    plug = b.submit(rng.normal(size=(2, 3)))
+    time.sleep(0.05)
+    fn.delay = 0.0
+    x = rng.normal(size=(5, 3))
+    queued = b.submit(x)
+    b.close(drain=True)
+    np.testing.assert_array_equal(queued.wait(1), x)
+    plug.wait(1)
+    with pytest.raises(BatcherClosed):
+        b.submit(rng.normal(size=(2, 3)))
+
+
+def test_close_without_drain_fails_queued_requests(rng):
+    fn = _Recorder(delay=0.2)
+    b = MicroBatcher(fn, name="nodrain", max_batch_rows=64, max_wait_ms=1)
+    plug = b.submit(rng.normal(size=(2, 3)))
+    time.sleep(0.05)
+    queued = b.submit(rng.normal(size=(5, 3)))
+    b.close(drain=False)
+    plug.wait(1)  # in-flight work still completes
+    with pytest.raises(BatcherClosed):
+        queued.wait(1)
+
+
+def test_batch_failure_propagates_to_every_request_in_batch(rng):
+    calls = {"n": 0}
+
+    def flaky(matrix):
+        calls["n"] += 1
+        raise RuntimeError("device fell over")
+
+    b = MicroBatcher(flaky, name="flaky", max_batch_rows=64, max_wait_ms=5)
+    try:
+        reqs = [b.submit(rng.normal(size=(3, 2))) for _ in range(3)]
+        for r in reqs:
+            with pytest.raises(RuntimeError, match="device fell over"):
+                r.wait(10)
+    finally:
+        b.close()
+
+
+def test_occupancy_and_padding_metrics_recorded(rng):
+    fn = _Recorder()
+    b = MicroBatcher(fn, name="occmetrics", max_batch_rows=64, max_wait_ms=1)
+    try:
+        b.submit(rng.normal(size=(24, 3))).wait(10)  # bucket 32
+    finally:
+        b.close()
+    snap = get_registry().snapshot()
+    for name in ("sparkml_serve_queue_depth", "sparkml_serve_batch_occupancy",
+                 "sparkml_serve_padding_waste", "sparkml_serve_batches_total",
+                 "sparkml_serve_batch_rows_total",
+                 "sparkml_serve_bucket_rows_total"):
+        assert name in snap, name
+    assert _counter_value("sparkml_serve_batch_rows_total",
+                          model="occmetrics") == 24.0
+    assert _counter_value("sparkml_serve_bucket_rows_total",
+                          model="occmetrics") == 32.0
+    occ = _counter_value("sparkml_serve_batch_occupancy", model="occmetrics")
+    assert occ == pytest.approx(0.75)
+
+
+def test_rejects_empty_and_misshapen_requests():
+    b = MicroBatcher(lambda m: m, name="shape", max_batch_rows=8)
+    try:
+        with pytest.raises(ValueError):
+            b.submit(np.zeros((0, 3)))
+        with pytest.raises(ValueError):
+            b.submit(np.zeros((2, 3, 4)))
+        # a single 1-D row is promoted to (1, d)
+        out = b.submit(np.arange(3.0)).wait(10)
+        assert out.shape == (1, 3)
+    finally:
+        b.close()
+
+
+def test_explicit_ladder_clamps_batch_cap_and_rejects_oversize(rng):
+    """An explicit bucket ladder is a compiled-signature contract: the
+    coalescing cap clamps to the top bucket, and a single request larger
+    than the cap is rejected instead of silently compiling an unwarmed
+    power-of-two shape."""
+    b = MicroBatcher(lambda m: m, name="ladder", max_batch_rows=1024,
+                     max_wait_ms=1, buckets=(16, 64))
+    try:
+        assert b.max_batch_rows == 64
+        with pytest.raises(ValueError, match="exceeds max_batch_rows"):
+            b.submit(rng.normal(size=(65, 3)))
+        out = b.submit(rng.normal(size=(64, 3))).wait(10)
+        assert out.shape == (64, 3)
+    finally:
+        b.close()

@@ -1,0 +1,418 @@
+"""Serving engine end-to-end: registry (versions/aliases/load/warmup),
+the ISSUE acceptance test (>= 64 concurrent mixed-size PCA requests,
+bit-equal outputs, compiled signatures bounded by the bucket count,
+serving metrics in the registry snapshot), admission control and
+deadlines at the engine level, the HTTP front end, and the rule-4 static
+check on serve/."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.serve import (
+    DeadlineExpired,
+    EngineClosed,
+    ModelRegistry,
+    QueueFull,
+    ServeEngine,
+    extract_output,
+    start_serve_server,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _SlowModel:
+    """A registry-compatible stub whose transform sleeps — for exercising
+    queue buildup, deadlines, and admission control deterministically."""
+
+    def __init__(self, delay: float):
+        self.delay = delay
+
+    def transform(self, matrix):
+        time.sleep(self.delay)
+        return np.asarray(matrix)
+
+
+@pytest.fixture
+def pca_model(rng):
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(256, 16))
+    return PCA().setK(4).fit(x), x
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_registry_versions_and_aliases(pca_model):
+    model, _ = pca_model
+    reg = ModelRegistry()
+    assert reg.register("pca", model) == 1
+    assert reg.register("pca", model) == 2
+    reg.alias("prod", "pca", version=1)   # pinned
+    reg.alias("canary", "pca")            # floating → latest
+    assert reg.resolve_entry("prod").version == 1
+    assert reg.resolve_entry("canary").version == 2
+    assert reg.resolve_entry("pca@1").version == 1
+    assert reg.resolve_entry("pca").version == 2
+    assert reg.names() == ["pca"]
+    with pytest.raises(KeyError):
+        reg.resolve("nope")
+    with pytest.raises(KeyError):
+        reg.resolve_entry("pca@9")
+    with pytest.raises(ValueError):
+        reg.register("bad@name", model)
+    reg.deregister("pca", version=2)
+    assert reg.resolve_entry("pca").version == 1
+
+
+def test_registry_load_from_disk(pca_model, tmp_path):
+    model, x = pca_model
+    path = str(tmp_path / "pca_model")
+    model.save(path)
+    reg = ModelRegistry()
+    version = reg.load("pca", path)
+    loaded = reg.resolve("pca")
+    assert version == 1
+    np.testing.assert_array_equal(loaded.pc, model.pc)
+    assert reg.resolve_entry("pca").source_path == path
+
+
+def test_registry_warmup_precompiles_buckets(pca_model):
+    from spark_rapids_ml_tpu.ops.pca_kernel import pca_transform_kernel
+
+    model, _ = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(32, 64))
+    pca_transform_kernel.clear_cache()
+    report = reg.warmup("pca")
+    assert sorted(report["buckets"]) == [32, 64]
+    assert all(s > 0 for s in report["buckets"].values())
+    assert pca_transform_kernel.stats()["signatures"] == 2
+    assert reg.resolve_entry("pca").warmed_buckets == (32, 64)
+    # warmed signatures: a real request at a warmed bucket compiles nothing
+    model.transform(np.zeros((24, 16)))  # pads to 32
+    assert pca_transform_kernel.stats()["signatures"] == 2
+
+
+def test_registry_warmup_infers_features(pca_model):
+    model, _ = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16,))
+    report = reg.warmup("pca")  # n_features inferred from pc.shape[0]
+    assert list(report["buckets"]) == [16]
+
+
+# -- the acceptance test ----------------------------------------------------
+
+
+def test_engine_end_to_end_concurrent_mixed_size_pca(pca_model):
+    """ISSUE 4 acceptance: >= 64 concurrent mixed-size PCA predicts
+    through the engine — bit-equal to direct transform, compiled
+    signatures <= configured bucket count, serving metrics present in the
+    registry snapshot."""
+    from spark_rapids_ml_tpu.ops.pca_kernel import pca_transform_kernel
+
+    model, x = pca_model
+    buckets = (32, 64, 128)
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=buckets)
+    engine = ServeEngine(reg, max_batch_rows=128, max_wait_ms=2,
+                         buckets=buckets)
+    pca_transform_kernel.clear_cache()
+    reg.warmup("pca")
+
+    sizes = [1 + (7 * i) % 100 for i in range(64)]  # mixed 1..100 rows
+    outputs = {}
+    errors = []
+
+    def worker(i):
+        try:
+            outputs[i] = engine.predict("pca", x[i:i + sizes[i]])
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    engine.shutdown()
+    assert not errors
+    assert len(outputs) == 64
+
+    # compiled signatures bounded by the bucket ladder (warmup owns them)
+    assert pca_transform_kernel.stats()["signatures"] <= len(buckets)
+
+    # bit-equal to the direct transform of the same rows
+    for i in range(64):
+        direct = np.asarray(
+            model.transform(x[i:i + sizes[i]]).column("pca_features"))
+        np.testing.assert_array_equal(outputs[i], direct)
+
+    # serving metrics present in the registry snapshot
+    snap = reg.snapshot()
+    assert "pca" in snap["models"]
+    for name in ("sparkml_serve_queue_depth",
+                 "sparkml_serve_batch_occupancy",
+                 "sparkml_serve_padding_waste",
+                 "sparkml_serve_deadline_expired_total"):
+        assert name in snap["metrics"], name
+
+
+# -- engine behaviors -------------------------------------------------------
+
+
+def test_engine_deadline_sheds_before_device_time():
+    reg = ModelRegistry()
+    reg.register("slow", _SlowModel(0.25))
+    engine = ServeEngine(reg, max_batch_rows=8, max_wait_ms=1)
+    try:
+        plug = threading.Thread(
+            target=lambda: engine.predict("slow", np.zeros((2, 3))))
+        plug.start()
+        time.sleep(0.05)  # plug executing; next request will sit queued
+        with pytest.raises(DeadlineExpired):
+            engine.predict("slow", np.zeros((2, 3)), deadline_ms=50)
+        plug.join()
+    finally:
+        engine.shutdown()
+
+
+def test_engine_queue_full_rejects():
+    reg = ModelRegistry()
+    reg.register("slow", _SlowModel(0.3))
+    engine = ServeEngine(reg, max_batch_rows=2, max_wait_ms=1,
+                         max_queue_depth=1)
+    try:
+        threads = [threading.Thread(
+            target=lambda: engine.predict("slow", np.zeros((2, 3))))
+            for _ in range(2)]
+        threads[0].start()
+        time.sleep(0.05)   # first request executing
+        threads[1].start()
+        time.sleep(0.05)   # second queued: depth == max_queue_depth
+        with pytest.raises(QueueFull):
+            engine.predict("slow", np.zeros((2, 3)))
+        for t in threads:
+            t.join()
+    finally:
+        engine.shutdown()
+
+
+def test_engine_closed_after_shutdown(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model)
+    engine = ServeEngine(reg, max_wait_ms=1)
+    engine.predict("pca", x[:4])
+    engine.shutdown()
+    with pytest.raises(EngineClosed):
+        engine.predict("pca", x[:4])
+
+
+def test_extract_output_column_preference(pca_model, rng):
+    from spark_rapids_ml_tpu import KMeans
+
+    model, x = pca_model
+    out = extract_output(model, model.transform(x[:8]))
+    assert out.shape == (8, 4)       # PCA → outputCol vectors
+    km = KMeans().setK(2).fit(x)
+    labels = extract_output(km, km.transform(x[:8]))
+    assert labels.shape == (8,)      # KMeans → predictionCol labels
+    arr = rng.normal(size=(4, 2))
+    assert extract_output(model, arr) is arr  # ndarray passthrough
+    with pytest.raises(TypeError):
+        extract_output(model, {"not": "a frame"})
+
+
+# -- the HTTP front end -----------------------------------------------------
+
+
+def test_http_server_predict_healthz_metrics(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model)
+    engine = ServeEngine(reg, max_batch_rows=64, max_wait_ms=1)
+    server = start_serve_server(engine)
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        body = json.dumps({"model": "pca", "rows": x[:5].tolist()}).encode()
+        resp = json.loads(urllib.request.urlopen(urllib.request.Request(
+            f"{base}/predict", data=body,
+            headers={"Content-Type": "application/json"}), timeout=30
+        ).read())
+        assert resp["model"] == "pca" and resp["version"] == 1
+        direct = np.asarray(model.transform(x[:5]).column("pca_features"))
+        np.testing.assert_array_equal(np.asarray(resp["outputs"]), direct)
+
+        health = json.loads(urllib.request.urlopen(
+            f"{base}/healthz", timeout=30).read())
+        assert health["status"] == "ok" and "pca" in health["models"]
+
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30).read().decode()
+        assert "sparkml_serve_queue_depth" in metrics
+        assert "sparkml_transform_latency_seconds" in metrics
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/predict",
+                data=json.dumps({"model": "ghost", "rows": [[1.0]]}).encode(),
+            ), timeout=30)
+        assert err.value.code == 404
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/predict", data=b"not json"), timeout=30)
+        assert err.value.code == 400
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+# -- rule 4: the serve/ static check ---------------------------------------
+
+
+def _rule4(path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_instrumentation import check_serve_engine_file
+    finally:
+        sys.path.pop(0)
+    return list(check_serve_engine_file(str(path)))
+
+
+def test_rule4_accepts_current_serve_modules():
+    serve_dir = os.path.join(REPO, "spark_rapids_ml_tpu", "serve")
+    for fname in os.listdir(serve_dir):
+        if fname.endswith(".py"):
+            assert _rule4(os.path.join(serve_dir, fname)) == [], fname
+
+
+def test_rule4_rejects_raw_jit_in_serve(tmp_path):
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "import jax\n"
+        "fast = jax.jit(lambda x: x)\n"
+    )
+    offenders = _rule4(bad)
+    assert len(offenders) == 1 and "raw jax.jit" in offenders[0][1]
+
+
+def test_rule4_rejects_transform_bypass(tmp_path):
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "def run(model, batch):\n"
+        "    return model._transform(batch)\n"
+    )
+    offenders = _rule4(bad)
+    assert len(offenders) == 1 and "_transform" in offenders[0][1]
+
+
+def test_rule4_rejects_direct_kernel_call(tmp_path):
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "from spark_rapids_ml_tpu.ops.pca_kernel import "
+        "pca_transform_kernel\n"
+        "def run(x, pc):\n"
+        "    return pca_transform_kernel(x, pc)\n"
+    )
+    offenders = _rule4(bad)
+    assert len(offenders) == 1 and "pca_transform_kernel" in offenders[0][1]
+
+
+def test_main_checker_passes_repo():
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_instrumentation.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout
+    assert "serve/ module(s) clean" in out.stdout
+
+
+def test_engine_evicts_batchers_for_deregistered_versions(pca_model):
+    """A version rollover must not leak the old version's worker thread /
+    model: once the registry drops a version, the next batcher creation
+    sweeps its batcher (and evict() works directly)."""
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model)       # v1
+    engine = ServeEngine(reg, max_wait_ms=1)
+    try:
+        engine.predict("pca", x[:4])             # v1 batcher exists
+        assert ("pca", 1) in engine._batchers
+        reg.register("pca", model)   # v2 rolls in
+        reg.deregister("pca", version=1)
+        engine.predict("pca", x[:4])             # v2 batcher; v1 swept
+        assert ("pca", 1) not in engine._batchers
+        assert ("pca", 2) in engine._batchers
+        # explicit evict on a live version
+        assert engine.evict("pca", 2)
+        assert not engine.evict("pca", 2)
+        assert engine._batchers == {}
+    finally:
+        engine.shutdown()
+
+
+def test_engine_warmup_uses_engine_buckets(pca_model):
+    """engine.warmup compiles the shapes THIS engine pads to, even when
+    they differ from the registry entry's buckets."""
+    from spark_rapids_ml_tpu.ops.pca_kernel import pca_transform_kernel
+
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(64,))
+    engine = ServeEngine(reg, max_batch_rows=96, max_wait_ms=1,
+                         buckets=(48, 96))
+    try:
+        pca_transform_kernel.clear_cache()
+        report = engine.warmup("pca")
+        assert sorted(report["buckets"]) == [48, 96]
+        assert pca_transform_kernel.stats()["signatures"] == 2
+        engine.predict("pca", x[:40])  # pads to 48: already compiled
+        assert pca_transform_kernel.stats()["signatures"] == 2
+    finally:
+        engine.shutdown()
+
+
+def test_bad_version_suffix_is_a_client_error(pca_model):
+    """'name@latest' must surface as KeyError (HTTP 404), never an
+    internal 500."""
+    model, _ = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model)
+    with pytest.raises(KeyError, match="bad version suffix"):
+        reg.resolve_entry("pca@latest")
+
+
+def test_http_oversize_request_maps_to_400(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model)
+    engine = ServeEngine(reg, max_batch_rows=16, max_wait_ms=1)
+    server = start_serve_server(engine)
+    port = server.server_address[1]
+    try:
+        body = json.dumps(
+            {"model": "pca", "rows": x[:32].tolist()}).encode()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body), timeout=30)
+        assert err.value.code == 400
+        assert "exceeds max_batch_rows" in err.value.read().decode()
+    finally:
+        server.shutdown()
+        engine.shutdown()

@@ -1,0 +1,154 @@
+"""utils.padding: the shape-bucket helper, and the recompile guarantee it
+buys the PCA / KMeans transform bodies (direct, non-engine callers with
+ragged batch sizes hit one compiled signature per bucket — asserted via
+``track_compiles``-backed TrackedJit stats)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.utils.padding import (
+    MIN_BUCKET_ROWS,
+    bucket_for,
+    default_buckets,
+    pad_to_bucket,
+    padding_waste,
+    transform_padding_enabled,
+)
+
+
+def test_bucket_for_power_of_two_default():
+    assert bucket_for(1) == MIN_BUCKET_ROWS
+    assert bucket_for(8) == 8
+    assert bucket_for(9) == 16
+    assert bucket_for(100) == 128
+    assert bucket_for(128) == 128
+    assert bucket_for(129) == 256
+
+
+def test_bucket_for_explicit_ladder():
+    buckets = (32, 64, 128)
+    assert bucket_for(1, buckets) == 32
+    assert bucket_for(33, buckets) == 64
+    assert bucket_for(128, buckets) == 128
+    # past the ladder: falls back to the next power of two
+    assert bucket_for(129, buckets) == 256
+
+
+def test_bucket_for_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        bucket_for(0)
+
+
+def test_default_buckets_ladder():
+    assert default_buckets(128) == (8, 16, 32, 64, 128)
+    assert default_buckets(100) == (8, 16, 32, 64, 128)
+    assert default_buckets(8) == (8,)
+
+
+def test_pad_to_bucket_pads_with_zero_rows(rng):
+    x = rng.normal(size=(13, 4))
+    padded, n = pad_to_bucket(x)
+    assert n == 13
+    assert padded.shape == (16, 4)
+    np.testing.assert_array_equal(padded[:13], x)
+    assert not padded[13:].any()
+
+
+def test_pad_to_bucket_exact_fit_is_identity(rng):
+    x = rng.normal(size=(32, 4))
+    padded, n = pad_to_bucket(x)
+    assert padded is x and n == 32
+
+
+def test_pad_to_bucket_rejects_non_matrix():
+    with pytest.raises(ValueError):
+        pad_to_bucket(np.zeros(5))
+
+
+def test_padding_waste():
+    assert padding_waste(32, 32) == 0.0
+    assert padding_waste(24, 32) == 0.25
+    assert padding_waste(10, 0) == 0.0
+
+
+def test_env_kill_switch(monkeypatch):
+    assert transform_padding_enabled()
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_TRANSFORM_PAD", "0")
+    assert not transform_padding_enabled()
+
+
+# -- the recompile guarantee on the model transform bodies -----------------
+
+
+def test_pca_transform_ragged_sizes_share_one_signature(rng):
+    """Direct (non-engine) PCA callers with varying batch sizes inside one
+    bucket compile exactly ONE transform signature."""
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.ops.pca_kernel import pca_transform_kernel
+
+    x = rng.normal(size=(64, 6))
+    model = PCA().setK(2).fit(x)
+    pca_transform_kernel.clear_cache()
+    for n in (17, 23, 29, 31, 32):  # all pad to the 32-row bucket
+        out = np.asarray(model.transform(x[:n]).column("pca_features"))
+        assert out.shape == (n, 2)
+    assert pca_transform_kernel.stats()["signatures"] == 1
+
+
+def test_pca_padding_is_bit_exact(rng, monkeypatch):
+    """The padded projection of a row equals the exact-shape one bit for
+    bit (row-independent matmul) — padding changes compile behavior, not
+    numerics."""
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(64, 6))
+    model = PCA().setK(3).fit(x)
+    padded = np.asarray(model.transform(x[:21]).column("pca_features"))
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_TRANSFORM_PAD", "0")
+    exact = np.asarray(model.transform(x[:21]).column("pca_features"))
+    np.testing.assert_array_equal(padded, exact)
+
+
+def test_pca_transform_without_padding_recompiles_per_size(rng, monkeypatch):
+    """The kill switch restores exact-shape execution: every distinct batch
+    size is its own signature (the behavior padding exists to fix)."""
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.ops.pca_kernel import pca_transform_kernel
+
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_TRANSFORM_PAD", "0")
+    x = rng.normal(size=(64, 6))
+    model = PCA().setK(2).fit(x)
+    pca_transform_kernel.clear_cache()
+    for n in (17, 23, 29):
+        model.transform(x[:n])
+    assert pca_transform_kernel.stats()["signatures"] == 3
+
+
+def test_kmeans_transform_ragged_sizes_share_one_signature(rng):
+    """Same guarantee for the KMeans assign path."""
+    from spark_rapids_ml_tpu import KMeans
+    from spark_rapids_ml_tpu.ops.kmeans_kernel import assign_clusters_jit
+
+    x = rng.normal(size=(64, 5))
+    model = KMeans().setK(3).fit(x)
+    assign_clusters_jit.clear_cache()
+    labels = {}
+    for n in (17, 23, 29, 32):
+        labels[n] = list(model.transform(x[:n]).column("prediction"))
+        assert len(labels[n]) == n
+    assert assign_clusters_jit.stats()["signatures"] == 1
+    # padded rows' garbage labels were sliced off, real labels agree
+    assert labels[17] == labels[32][:17]
+
+
+def test_empty_batch_transforms_return_empty(rng):
+    """A 0-row transform keeps returning 0 rows under padding — an empty
+    ragged chunk must not raise."""
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(32, 6))
+    model = PCA().setK(2).fit(x)
+    padded, n = pad_to_bucket(x[:0])
+    assert n == 0 and padded.shape == (0, 6)
+    out = np.asarray(model.transform(x[:0]).column("pca_features"))
+    assert out.shape == (0, 2)
