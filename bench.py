@@ -61,7 +61,7 @@ def _probe_with_backoff():
     backoff waits burned 14 minutes and the driver's 20-minute cap then
     killed the CPU fallback mid-run — the round recorded *nothing* (judge
     task #2). Patient contexts that want to wait out a wedge should use the
-    retry-loop script (`scripts/bench_r04.sh`) with BENCH_SKIP_PROBE=1, not
+    retry-loop script (`scripts/archive/bench_r04.sh`) with BENCH_SKIP_PROBE=1, not
     probe attempts."""
     from spark_rapids_ml_tpu.utils.health import check_devices_subprocess
 

@@ -4,9 +4,12 @@ Fits a PCA model, registers it (with an alias, the way traffic would
 address it), warms its shape buckets so XLA compiles happen at deploy
 time, then drives 200 mixed-size predict requests through the engine
 from a small thread pool and prints what the serving telemetry saw:
-batch occupancy, padding waste, queue depth, deadline sheds, and the
+batch occupancy, padding waste, queue depth, deadline sheds, the
 sketch-backed p50/p95/p99 — all read back from the live registry
-snapshot. Runs on CPU (JAX_PLATFORMS=cpu) or any accelerator.
+snapshot — plus one request's ASSEMBLED trace tree (server → queue →
+fan-in batch → transform, Dapper-style) and the run's SLO verdict (burn
+rates per window, budget remaining, firing alerts). Runs on CPU
+(JAX_PLATFORMS=cpu) or any accelerator.
 """
 
 import concurrent.futures
@@ -22,7 +25,12 @@ sys.path.insert(
 )
 
 from spark_rapids_ml_tpu import PCA
-from spark_rapids_ml_tpu.obs import latency_quantiles
+from spark_rapids_ml_tpu.obs import (
+    assemble_trace,
+    latency_quantiles,
+    new_context,
+    tracectx,
+)
 from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
 
 BUCKETS = (32, 64, 128, 256)
@@ -52,8 +60,16 @@ def main():
     sizes = rng.integers(1, 200, size=200)
     starts = [int(rng.integers(0, x.shape[0] - int(n))) for n in sizes]
 
+    # one request runs under an explicit TraceContext so we can pull its
+    # assembled tree afterwards (header-less requests mint their own)
+    tracked_ctx = new_context(example="serve_example")
+
     def one(i):
         n = int(sizes[i])
+        if i == 100:
+            with tracectx.activate(tracked_ctx):
+                return engine.predict(
+                    "prod", x[starts[i]:starts[i] + n]).shape
         return engine.predict("prod", x[starts[i]:starts[i] + n]).shape
 
     t0 = time.perf_counter()
@@ -96,6 +112,38 @@ def main():
     names = [f"{m}@{versions[-1]['version']}"
              for m, versions in snap["models"].items()]
     print(f"  registered models:     {names}")
+
+    print("\n== one request, followed across every seam ==")
+    tree = assemble_trace(tracked_ctx.trace_id)
+
+    def show(node, indent=1):
+        extra = ""
+        if node.get("links"):
+            extra = f"  (fan-in: links {len(node['links'])} traces)"
+        elif node.get("link"):
+            extra = "  (shared batch subtree)"
+        print(f"{'  ' * indent}{node['name']:<28}"
+              f"{node['duration_ms']:9.3f} ms{extra}")
+        for child in node["children"]:
+            show(child, indent + 1)
+
+    print(f"  trace {tracked_ctx.trace_id} "
+          f"({tree['span_count']} spans):")
+    for root in tree["spans"]:
+        show(root)
+
+    print("\n== SLO verdict (obs.slo, fed by every predict) ==")
+    verdict = engine.slo_snapshot()
+    for slo in verdict["slos"]:
+        rates = "  ".join(f"{w}={r:.2f}"
+                          for w, r in slo["burn_rates"].items())
+        print(f"  {slo['name']:<20} target {slo['target']}: "
+              f"burn {rates}")
+        print(f"  {'':<20} budget remaining "
+              f"{slo['budget_remaining']:.1%}")
+    alerts = verdict["alerts"]
+    print(f"  firing alerts:       "
+          f"{[a['severity'] for a in alerts] if alerts else 'none'}")
 
 
 if __name__ == "__main__":

@@ -12,7 +12,7 @@ Precision arms: ``bfloat16_3x`` (production: 2-limb split, 3 MXU passes,
 ~3× fewer MXU passes at bf16 accuracy; recorded to quantify the
 speed/precision trade users opt into via TPUML_GRAM_PRECISION).
 
-Run via a patient context (scripts/bench_r04.sh) — never under a killable
+Run via a patient context (scripts/archive/bench_r04.sh) — never under a killable
 timeout against the chip tunnel.
 """
 

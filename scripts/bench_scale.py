@@ -12,7 +12,7 @@ when memory stats exist, that peak stays under the envelope.
 On a CPU fallback the row count and epoch/sweep budgets shrink (the
 point is the chip run; CPU only proves the code path end-to-end).
 
-Run via a patient context (scripts/bench_r04.sh) — never under a killable
+Run via a patient context (scripts/archive/bench_r04.sh) — never under a killable
 timeout against the chip tunnel.
 """
 

@@ -14,7 +14,13 @@ committed history from the next PR onward:
 * ``mean_batch_occupancy``    — real rows / bucket rows over the run
   (how well coalescing fills the padded shapes);
 * ``recompile_count``         — distinct-signature compiles during the
-  serve phase; steady state must stay at 0 (warmup owns them all).
+  serve phase; steady state must stay at 0 (warmup owns them all);
+* ``slo_fast_burn_rate``      — the worst fast-window (5 m) SLO burn rate
+  at the end of the run (``obs.slo``; > 14.4 would page);
+* ``slo_budget_remaining``    — the worst remaining error-budget fraction
+  across the engine's SLOs. The sentinel judges this one
+  HIGHER-is-better despite the fraction unit (see
+  ``perf_sentinel.higher_is_better``).
 
 Knobs (env): SPARKML_BENCH_SERVE_REQUESTS (default 512),
 SPARKML_BENCH_SERVE_FEATURES (64), SPARKML_BENCH_SERVE_K (16),
@@ -91,6 +97,13 @@ def main() -> int:
     with concurrent.futures.ThreadPoolExecutor(n_threads) as pool:
         list(pool.map(one, range(n_requests)))
     wall = time.perf_counter() - t_run
+    # The engine's SloSet saw every request; read the verdict before
+    # shutdown so the record carries the run's SLO posture.
+    slos = list(engine.slo)
+    slo_fast_burn = max(
+        (s.burn_rate(300.0) for s in slos), default=0.0)
+    slo_budget_remaining = min(
+        (s.budget_remaining() for s in slos), default=1.0)
     engine.shutdown()
 
     compiles_after = sum(
@@ -122,6 +135,8 @@ def main() -> int:
             batch_rows / bucket_rows if bucket_rows else 0.0
         ),
         "recompile_count": int(compiles_after - compiles_before),
+        "slo_fast_burn_rate": slo_fast_burn,
+        "slo_budget_remaining": slo_budget_remaining,
         "batches": int(_counter("sparkml_serve_batches_total")),
         "deadline_expired": int(
             _counter("sparkml_serve_deadline_expired_total")

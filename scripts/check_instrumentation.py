@@ -24,7 +24,17 @@ Three rule families:
    ``@observed_transform``-decorated entry points, so calls to a
    ``._transform(...)`` hook or directly into a ``*_kernel`` function
    are rejected: an engine batch that skipped the decorator would be
-   invisible to the ``TransformReport``/numerics-sentinel layer.
+   invisible to the ``TransformReport``/numerics-sentinel layer;
+5. same files: every queue/thread handoff goes through the
+   ``obs.tracectx`` capture/activate helpers — raw
+   ``threading.Thread(...)`` construction is rejected (use
+   ``tracectx.traced_thread``, which snapshots contextvars), a
+   ``.submit(...)`` enqueue without a ``trace_ctx=`` keyword is rejected
+   (the queue must carry the request's identity across), and a response
+   future resolution (``.set_result(...)`` / ``.set_error(...)``) inside
+   a function that never ``activate(...)``-restores a context is
+   rejected — a handoff that drops the ``TraceContext`` severs the
+   request's trace at that seam.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -223,6 +233,73 @@ def check_serve_engine_file(path: str):
                    "drive the model's public entry point)")
 
 
+def _call_name(node: ast.Call):
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _contains_activate_call(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _call_name(node) == "activate":
+            return True
+    return False
+
+
+def check_trace_handoffs(path: str):
+    """Rule 5: yield (lineno, description) for TraceContext-handoff
+    offenders in one serve/ module.
+
+    * ``threading.Thread(...)`` (any spelling whose callee name is
+      ``Thread``) — threads must be started via
+      ``obs.tracectx.traced_thread`` so the child runs under a
+      contextvars snapshot;
+    * a ``.submit(...)`` call without a ``trace_ctx=`` keyword — the
+      enqueue half of a queue handoff must carry the captured context;
+    * ``.set_result(...)`` / ``.set_error(...)`` inside a function that
+      never calls ``activate(...)`` — resolving a response future
+      without restoring the request's context attributes whatever the
+      resolution records to the wrong (or no) trace.
+
+    Method *definitions* named ``set_result``/``set_error`` are fine —
+    only call sites are judged, against their enclosing function.
+    """
+    tree = ast.parse(open(path).read(), filename=path)
+
+    def visit(node, enclosing_fn):
+        for child in ast.iter_child_nodes(node):
+            fn = child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) else enclosing_fn
+            if isinstance(child, ast.Call):
+                name = _call_name(child)
+                if name == "Thread":
+                    yield (child.lineno,
+                           "raw threading.Thread (use "
+                           "obs.tracectx.traced_thread — the handoff "
+                           "must snapshot contextvars)")
+                elif name == "submit":
+                    kwargs = {k.arg for k in child.keywords}
+                    if "trace_ctx" not in kwargs:
+                        yield (child.lineno,
+                               ".submit(...) without trace_ctx= (queue "
+                               "handoff drops the TraceContext — pass "
+                               "the captured context)")
+                elif name in ("set_result", "set_error"):
+                    if enclosing_fn is None or not \
+                            _contains_activate_call(enclosing_fn):
+                        yield (child.lineno,
+                               f".{name}(...) without a TraceContext "
+                               "restore (wrap the resolution in "
+                               "tracectx.activate(req.trace_ctx))")
+            yield from visit(child, fn)
+
+    yield from visit(tree, None)
+
+
 def main() -> int:
     files = sorted(glob.glob(PARALLEL_GLOB))
     if not files:
@@ -267,6 +344,8 @@ def main() -> int:
         rel = os.path.relpath(path, REPO)
         for lineno, why in check_serve_engine_file(path):
             offenders.append(f"{rel}:{lineno} {why}")
+        for lineno, why in check_trace_handoffs(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -278,7 +357,8 @@ def main() -> int:
         f"{serving_checked} serving entry point(s) across "
         f"{len(serving_files)} models/spark module(s) all instrumented; "
         f"{len(serve_files)} serve/ module(s) clean (no raw jit, no "
-        f"transform bypasses)"
+        f"transform bypasses, all queue/thread handoffs carry their "
+        f"TraceContext)"
     )
     return 0
 

@@ -1,6 +1,6 @@
 """Compose BENCH_MEASURED_r04.json from the patient bench loop's outputs.
 
-Reads /tmp/bench_r04/*.json (written by scripts/bench_r04.sh in the first
+Reads /tmp/bench_r04/*.json (written by scripts/archive/bench_r04.sh in the first
 healthy tunnel window), extracts every JSON record line, and writes the
 committed measurement file BASELINE.md cites — with UTC stamp and the
 repo commit so every number greps to a reproducible artifact (VERDICT r3
@@ -46,7 +46,7 @@ def main() -> None:
     doc = {
         "note": (
             "Live-chip measurements captured by the round-4 patient bench "
-            "loop (scripts/bench_r04.sh: probe -> full evidence batch in "
+            "loop (scripts/archive/bench_r04.sh: probe -> full evidence batch in "
             "one healthy window; logs in the loop's status.log). Committed "
             "so every BASELINE.md number greps to a recorded artifact."
         ),

@@ -197,6 +197,15 @@ def higher_is_better(record: Dict[str, Any]) -> bool:
     if isinstance(explicit, bool):
         return explicit
     text = f"{record.get('unit', '')} {record.get('metric', '')}".lower()
+    if "budget_remaining" in text:
+        # SLO error budget left: more is better, despite lacking a "/sec"
+        # unit — and despite any "seconds"-flavored unit text (a budget
+        # can be expressed as seconds of allowed badness remaining).
+        return True
+    if "burn_rate" in text:
+        # SLO burn rate: budget spend speed — lower is better, and the
+        # throughput-style default would invert the verdict.
+        return False
     if "rows/sec" in text or "/sec" in text:
         return True
     if "second" in text:

@@ -39,11 +39,36 @@ from spark_rapids_ml_tpu.obs.spans import (  # noqa: F401
     SpanRecorder,
     TRACE_DIR_ENV,
     active_spans,
+    assemble_trace,
+    current_span_id,
     current_trace_id,
     get_recorder,
     maybe_export_trace,
     new_trace_id,
+    recent_traces,
+    record_event,
     span,
+)
+from spark_rapids_ml_tpu.obs.tracectx import (  # noqa: F401
+    TRACEPARENT_HEADER,
+    TraceContext,
+    activate,
+    capture,
+    current_context,
+    ensure_context,
+    inflight_request,
+    inflight_requests,
+    new_context,
+    new_span_id,
+    parse_traceparent,
+    traced_thread,
+)
+from spark_rapids_ml_tpu.obs.slo import (  # noqa: F401
+    BURN_POLICIES,
+    SLO,
+    SloSet,
+    WindowedCounts,
+    default_slos,
 )
 from spark_rapids_ml_tpu.obs.xprof import (  # noqa: F401
     CompileEvent,
@@ -113,6 +138,7 @@ from spark_rapids_ml_tpu.utils.health import (  # noqa: F401
 )
 
 __all__ = [
+    "BURN_POLICIES",
     "CompileEvent",
     "Counter",
     "DEFAULT_BUCKETS",
@@ -128,57 +154,77 @@ __all__ = [
     "PhaseTimer",
     "QuantileSketch",
     "REPORT_ATTR",
+    "SLO",
     "STORM_ENV",
+    "SloSet",
     "SpanEvent",
     "SpanRecorder",
     "Summary",
+    "TRACEPARENT_HEADER",
     "TRACE_DIR_ENV",
     "TRANSFORM_BUDGET_ENV",
     "TRANSFORM_REPORT_ATTR",
     "TraceColor",
+    "TraceContext",
     "TraceRange",
     "TrackedJit",
     "TransformContext",
     "TransformReport",
     "Watchdog",
+    "activate",
     "active_spans",
     "analytic_mfu",
+    "assemble_trace",
     "attach_report",
     "build_dump",
+    "capture",
     "check_devices",
     "check_devices_subprocess",
     "check_output_numerics",
     "compile_log",
     "compile_stats",
+    "current_context",
     "current_fit",
+    "current_span_id",
     "current_trace_id",
     "current_transform",
     "deadline",
+    "default_slos",
     "device_memory_stats",
     "dump",
     "dump_dir",
+    "ensure_context",
     "fit_instrumentation",
     "flight",
     "get_recorder",
     "get_registry",
     "get_watchdog",
     "host_peak_rss_bytes",
+    "inflight_request",
+    "inflight_requests",
     "last_fit_report",
     "last_transform_report",
     "latency_quantiles",
     "maybe_export_trace",
     "memory_watermarks",
     "merge_all",
+    "new_context",
+    "new_span_id",
     "new_trace_id",
     "observed_fit",
     "observed_transform",
+    "parse_traceparent",
     "peak_bytes_in_use",
     "peak_flops_per_second",
+    "recent_traces",
+    "record_event",
     "record_memory_metrics",
     "reset_compile_log",
     "span",
     "start_prometheus_server",
+    "traced_thread",
     "track_compiles",
     "tracked_jit",
     "transform_phase",
+    "WindowedCounts",
 ]

@@ -106,6 +106,10 @@ def build_dump(reason: str, extra: Optional[Dict[str, Any]] = None
         "open_spans": _safe(
             lambda: [dict(s) for s in spans_mod.active_spans()], []
         ),
+        # The serving tier's in-flight request table: a watchdog dump
+        # names WHICH requests (trace ids, models, elapsed) were on the
+        # device when the process wedged, not just which threads.
+        "active_traces": _safe(_active_traces, []),
         "span_ring_tail": _safe(
             lambda: [
                 {"name": e.name, "dur_us": e.dur_us,
@@ -133,6 +137,12 @@ def build_dump(reason: str, extra: Optional[Dict[str, Any]] = None
     if extra:
         doc["extra"] = extra
     return doc
+
+
+def _active_traces():
+    from spark_rapids_ml_tpu.obs import tracectx
+
+    return tracectx.inflight_requests()
 
 
 def _cached_health():

@@ -24,7 +24,8 @@ import json
 import math
 import re
 import threading
-from typing import Dict, Iterable, Optional, Tuple
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -226,18 +227,32 @@ class Summary(_Metric):
     O(1), ``quantile(q)`` is exact-rank over log buckets. The Prometheus
     exposition emits ``name{quantile="0.5"}``-style lines (summary type)
     alongside whatever ``_bucket`` series the histograms export.
+
+    ``observe(value, trace_id=...)`` additionally files a **trace-id
+    exemplar**: each child keeps the ``EXEMPLAR_CAPACITY`` slowest
+    observations with their trace ids, so "the p99 got worse" comes with
+    the exact requests to go look at. Exemplars appear in ``snapshot()``
+    and as ``# exemplar: <name>{labels} trace_id="..."`` comment lines
+    in the text exposition (comments, because the endpoint advertises
+    text format 0.0.4 — inline OpenMetrics ``# {...}`` annotations would
+    abort a 0.0.4 scrape).
     """
 
     kind = "summary"
     DEFAULT_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+    EXEMPLAR_CAPACITY = 5
 
     class _Child:
-        __slots__ = ("sketch",)
+        __slots__ = ("sketch", "exemplars", "lock")
 
         def __init__(self, alpha: float, max_bins: int):
             from spark_rapids_ml_tpu.obs.quantiles import QuantileSketch
 
             self.sketch = QuantileSketch(alpha=alpha, max_bins=max_bins)
+            # slowest-N ring: [(value, trace_id, unix_ts)] kept sorted
+            # ascending so [0] is the cheapest candidate to evict
+            self.exemplars: List[Tuple[float, str, float]] = []
+            self.lock = threading.Lock()
 
     def __init__(
         self,
@@ -256,8 +271,33 @@ class Summary(_Metric):
     def _new_child(self):
         return Summary._Child(self.alpha, self.max_bins)
 
-    def observe(self, value: float, **labels) -> None:
-        self._child(labels).sketch.observe(value)
+    def observe(self, value: float, trace_id: Optional[str] = None,
+                **labels) -> None:
+        child = self._child(labels)
+        child.sketch.observe(value)
+        if trace_id:
+            self._note_exemplar(child, float(value), str(trace_id))
+
+    def _note_exemplar(self, child: "_Child", value: float,
+                       trace_id: str) -> None:
+        with child.lock:
+            ring = child.exemplars
+            if len(ring) >= self.EXEMPLAR_CAPACITY and value <= ring[0][0]:
+                return  # faster than every kept exemplar — not slowest-N
+            ring.append((value, trace_id, time.time()))
+            ring.sort(key=lambda e: e[0])
+            if len(ring) > self.EXEMPLAR_CAPACITY:
+                del ring[0]
+
+    def exemplars(self, **labels) -> List[Dict[str, object]]:
+        """The slowest-N exemplars for one label set, slowest first."""
+        child = self._child(labels)
+        with child.lock:
+            ring = list(child.exemplars)
+        return [
+            {"value": v, "trace_id": tid, "unix_ts": ts}
+            for v, tid, ts in reversed(ring)
+        ]
 
     def quantile(self, q: float, **labels):
         return self._child(labels).sketch.quantile(q)
@@ -276,6 +316,7 @@ class Summary(_Metric):
             "quantiles": {
                 _format_value(q): sketch.quantile(q) for q in self.quantiles
             },
+            "exemplars": self.exemplars(**labels),
         }
 
 
@@ -399,13 +440,34 @@ class MetricsRegistry:
                     )
                 elif isinstance(metric, Summary):
                     snap = metric.snapshot_child(**labels)
+                    emitted = []
                     for q, value in snap["quantiles"].items():
                         if value is None:
                             continue
                         ql = (label_str + "," if label_str else "") + \
                             f'quantile="{q}"'
-                        lines.append(
+                        emitted.append(
                             f"{metric.name}{{{ql}}} {_format_value(value)}"
+                        )
+                    lines.extend(emitted)
+                    exemplars = snap.get("exemplars") or []
+                    if emitted and exemplars:
+                        # The slowest observation's trace id — "p99 got
+                        # worse" names the request to go look at. Emitted
+                        # as a COMMENT line: inline `# {...}` exemplar
+                        # annotations are only legal in the OpenMetrics
+                        # exposition, and this endpoint advertises text
+                        # format 0.0.4, whose parser would abort the
+                        # whole scrape on one. Comments pass every 0.0.4
+                        # parser untouched.
+                        ex = exemplars[0]
+                        suffix = f"{{{label_str}}}" if label_str else ""
+                        lines.append(
+                            f"# exemplar: {metric.name}{suffix} "
+                            f'trace_id='
+                            f'"{_escape_label_value(ex["trace_id"])}" '
+                            f'{_format_value(ex["value"])} '
+                            f'{ex["unix_ts"]:.3f}'
                         )
                     suffix = f"{{{label_str}}}" if label_str else ""
                     lines.append(

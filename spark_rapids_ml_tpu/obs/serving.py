@@ -86,6 +86,7 @@ class TransformReport:
     trace_id: str
     started_utc: str
     wall_seconds: float
+    span_id: Optional[str] = None
     phases: Dict[str, float] = field(default_factory=dict)
     rows: Optional[int] = None
     features: Optional[int] = None
@@ -149,8 +150,8 @@ class TransformContext:
     """
 
     __slots__ = (
-        "algo", "trace_id", "timer", "rows", "features", "batches",
-        "bytes_in", "bytes_out", "compiles", "recompiles",
+        "algo", "trace_id", "span_id", "timer", "rows", "features",
+        "batches", "bytes_in", "bytes_out", "compiles", "recompiles",
         "compile_seconds", "analytic_flops", "extra",
         "owner_id", "explicit", "nested_in", "_lock",
     )
@@ -160,6 +161,7 @@ class TransformContext:
                  nested_in: Optional[str] = None):
         self.algo = algo
         self.trace_id = trace_id or spans.new_trace_id()
+        self.span_id: Optional[str] = None
         self.timer = PhaseTimer()
         self.rows: Optional[int] = None
         self.features: Optional[int] = None
@@ -521,6 +523,7 @@ def _build_report(ctx: TransformContext, started: str,
         trace_id=ctx.trace_id,
         started_utc=started,
         wall_seconds=wall,
+        span_id=ctx.span_id,
         phases=phases,
         rows=ctx.rows,
         features=ctx.features,
@@ -554,7 +557,9 @@ def _record_metrics(report: TransformReport) -> None:
         LATENCY_SUMMARY, "transform/predict call latency", ("algo",),
         alpha=SKETCH_ALPHA, quantiles=LATENCY_QUANTILES,
     )
-    summary.observe(report.wall_seconds, algo=algo)
+    # trace-id exemplar: a worsening p99 names the exact calls behind it
+    summary.observe(report.wall_seconds, trace_id=report.trace_id,
+                    algo=algo)
     report._sketch = summary.sketch(algo=algo)  # lazy quantile source
     if report.rows:
         reg.counter(
@@ -655,6 +660,7 @@ def _instrument(method, algo: Optional[str], check_numerics: bool = True):
                 f"transform:{name}", TraceColor.PURPLE,
                 trace_id=ctx.trace_id
             ), ctx.timer.phase("total"):
+                ctx.span_id = spans.current_span_id()
                 result = method(self, *args, **kwargs)
         except Exception as exc:
             # Failing serving traffic must be visible on the dashboard:

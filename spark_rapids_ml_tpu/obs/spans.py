@@ -32,6 +32,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from spark_rapids_ml_tpu.obs import tracectx
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 TRACE_DIR_ENV = "SPARK_RAPIDS_ML_TPU_TRACE_DIR"
@@ -54,7 +55,12 @@ def new_trace_id() -> str:
 
 @dataclass
 class SpanEvent:
-    """One completed span, Chrome-trace "complete event" shaped."""
+    """One completed span, Chrome-trace "complete event" shaped.
+
+    ``span_id``/``parent_span_id`` give each trace's events a tree
+    structure (``assemble_trace``); ``links`` carries OTHER trace ids this
+    span fans in — the coalesced serving batch span links every member
+    request's trace, the Dapper fan-in edge."""
 
     name: str
     ts_us: float
@@ -64,6 +70,9 @@ class SpanEvent:
     tid: int
     color: Optional[str] = None
     args: Dict[str, Any] = field(default_factory=dict)
+    span_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
+    links: tuple = ()
 
 
 class SpanRecorder:
@@ -104,6 +113,12 @@ class SpanRecorder:
             args = dict(e.args)
             if e.trace_id:
                 args["trace_id"] = e.trace_id
+            if e.span_id:
+                args["span_id"] = e.span_id
+            if e.parent_span_id:
+                args["parent_span_id"] = e.parent_span_id
+            if e.links:
+                args["links"] = list(e.links)
             if e.color:
                 args["color"] = e.color
             args["depth"] = e.depth
@@ -141,6 +156,7 @@ def get_recorder() -> SpanRecorder:
 class _ActiveSpan:
     name: str
     trace_id: str
+    span_id: str = ""
 
 
 _stack: contextvars.ContextVar = contextvars.ContextVar(
@@ -193,8 +209,24 @@ def _deactivate(handle: int) -> None:
 
 
 def current_trace_id() -> Optional[str]:
+    """The innermost open span's trace id; falls back to the activated
+    ``TraceContext`` (the serving request identity) when no span is open
+    in this thread yet."""
     st = _stack.get()
-    return st[-1].trace_id if st else None
+    if st:
+        return st[-1].trace_id
+    ctx = tracectx.current_context()
+    return ctx.trace_id if ctx is not None else None
+
+
+def current_span_id() -> Optional[str]:
+    """The innermost open span's id (None outside any span) — what a
+    ``TransformReport`` stamps so a report points at its exact span."""
+    st = _stack.get()
+    if st:
+        return st[-1].span_id or None
+    ctx = tracectx.current_context()
+    return ctx.span_id if ctx is not None else None
 
 
 def record_trace_range(
@@ -212,8 +244,45 @@ def record_trace_range(
             depth=len(_stack.get()),
             tid=threading.get_ident(),
             color=getattr(color, "name", None),
+            span_id=tracectx.new_span_id(),
+            parent_span_id=current_span_id(),
         )
     )
+
+
+def record_event(
+    name: str,
+    t0_seconds: float,
+    t1_seconds: float,
+    *,
+    trace_id: Optional[str] = None,
+    span_id: Optional[str] = None,
+    parent_span_id: Optional[str] = None,
+    links: tuple = (),
+    color: Optional[str] = None,
+    **args,
+) -> SpanEvent:
+    """File a span whose interval was measured elsewhere (queue-wait
+    spans: the enqueue thread stamps t0, the batcher worker files the
+    event at pop time — a ``with span(...)`` there would time the wrong
+    thing). Timestamps are ``time.perf_counter()`` seconds, the same
+    clock ``span`` uses, so manual and context-managed events interleave
+    correctly on one timeline."""
+    event = SpanEvent(
+        name=name,
+        ts_us=t0_seconds * 1e6,
+        dur_us=max(t1_seconds - t0_seconds, 0.0) * 1e6,
+        trace_id=trace_id,
+        depth=0,
+        tid=threading.get_ident(),
+        color=color,
+        args=dict(args),
+        span_id=span_id or tracectx.new_span_id(),
+        parent_span_id=parent_span_id,
+        links=tuple(links),
+    )
+    _recorder.record(event)
+    return event
 
 
 @contextmanager
@@ -221,17 +290,31 @@ def span(
     name: str,
     color: TraceColor = TraceColor.WHITE,
     trace_id: Optional[str] = None,
+    links: tuple = (),
     **attrs,
 ):
     """Structured nested span. Yields the effective trace id.
 
-    Inherits the parent span's trace id (or mints one at the root) and
+    Inherits the parent span's trace id — or, at the root, the activated
+    serving ``TraceContext``'s — minting one only when neither exists;
     still pushes a ``TraceRange`` underneath so the profiler/native
-    timelines see the same name.
+    timelines see the same name. ``links`` carries OTHER trace ids this
+    span fans in (the coalesced-batch → member-request edges).
     """
     parent = _stack.get()
-    tid_ = trace_id or (parent[-1].trace_id if parent else new_trace_id())
-    token = _stack.set(parent + (_ActiveSpan(name, tid_),))
+    ctx = tracectx.current_context() if not parent else None
+    tid_ = trace_id or (
+        parent[-1].trace_id if parent
+        else (ctx.trace_id if ctx is not None else new_trace_id())
+    )
+    span_id = tracectx.new_span_id()
+    if parent:
+        parent_span_id = parent[-1].span_id or None
+    elif ctx is not None and ctx.trace_id == tid_:
+        parent_span_id = ctx.span_id
+    else:
+        parent_span_id = None
+    token = _stack.set(parent + (_ActiveSpan(name, tid_, span_id),))
     # record=False: this function records the event itself (with args and
     # the right depth); letting TraceRange's exit hook also fire would
     # duplicate it.
@@ -263,8 +346,139 @@ def span(
                 tid=threading.get_ident(),
                 color=getattr(color, "name", None),
                 args=args,
+                span_id=span_id,
+                parent_span_id=parent_span_id,
+                links=tuple(links),
             )
         )
+
+
+# -- trace-tree assembly -----------------------------------------------------
+
+
+def _span_node(e: SpanEvent, link: bool = False) -> Dict[str, Any]:
+    node: Dict[str, Any] = {
+        "name": e.name,
+        "trace_id": e.trace_id,
+        "span_id": e.span_id,
+        "parent_span_id": e.parent_span_id,
+        "start_us": round(e.ts_us, 3),
+        "duration_ms": round(e.dur_us / 1000.0, 6),
+        "tid": e.tid,
+        "children": [],
+    }
+    if e.args:
+        node["args"] = dict(e.args)
+    if e.links:
+        node["links"] = list(e.links)
+    if link:
+        node["link"] = True  # fanned in from another trace
+    return node
+
+
+def _build_forest(events: List[SpanEvent], link: bool = False
+                  ) -> List[Dict[str, Any]]:
+    """Events of ONE trace → root nodes (children nested, sorted by
+    start). A parent missing from the ring (still open, or evicted)
+    promotes its children to roots — assembly degrades, never fails."""
+    nodes = {e.span_id: _span_node(e, link=link)
+             for e in events if e.span_id}
+    roots: List[Dict[str, Any]] = []
+    for e in sorted(events, key=lambda ev: ev.ts_us):
+        node = nodes.get(e.span_id)
+        if node is None:
+            continue
+        parent = nodes.get(e.parent_span_id) if e.parent_span_id else None
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    return roots
+
+
+def assemble_trace(trace_id: str,
+                   recorder: Optional[SpanRecorder] = None
+                   ) -> Dict[str, Any]:
+    """One request's trace tree from the span ring.
+
+    Spans whose ``trace_id`` matches nest by ``parent_span_id``; spans in
+    OTHER traces that ``links``-reference this trace (the coalesced batch
+    span and everything under it — the transform, its phases) are grafted
+    under the request's root marked ``"link": true``, so the returned
+    document is ONE tree spanning server → queue → batch → transform.
+    """
+    rec = recorder or _recorder
+    events = rec.events()
+    own = [e for e in events if e.trace_id == trace_id]
+    linked_trace_ids: List[str] = []
+    for e in events:
+        if e.links and trace_id in e.links and e.trace_id and \
+                e.trace_id != trace_id and e.trace_id not in linked_trace_ids:
+            linked_trace_ids.append(e.trace_id)
+    roots = _build_forest(own)
+    linked_forest: List[Dict[str, Any]] = []
+    for linked_tid in linked_trace_ids:
+        linked_events = [e for e in events if e.trace_id == linked_tid]
+        linked_forest.extend(_build_forest(linked_events, link=True))
+    if roots and linked_forest:
+        roots[0]["children"].extend(linked_forest)
+        linked_forest = []
+
+    def _count(nodes):
+        return sum(1 + _count(n["children"]) for n in nodes)
+
+    doc: Dict[str, Any] = {
+        "trace_id": trace_id,
+        "span_count": _count(roots) + _count(linked_forest),
+        "spans": roots,
+    }
+    if linked_forest:  # no own root to graft under (ring rolled over)
+        doc["linked"] = linked_forest
+    return doc
+
+
+def recent_traces(limit: int = 20,
+                  recorder: Optional[SpanRecorder] = None,
+                  name_prefix=None
+                  ) -> List[Dict[str, Any]]:
+    """Summaries of the most recent distinct traces in the ring (newest
+    first): ``{trace_id, root, spans, started_us, duration_ms, links}``.
+    ``name_prefix`` (a string or tuple of strings) keeps only traces
+    whose earliest span name starts with it (``("serve:http",
+    "serve:request")`` → request traces only, batch/fit traces filtered
+    out)."""
+    rec = recorder or _recorder
+    by_trace: Dict[str, List[SpanEvent]] = {}
+    order: List[str] = []
+    for e in rec.events():
+        if not e.trace_id:
+            continue
+        if e.trace_id not in by_trace:
+            by_trace[e.trace_id] = []
+            order.append(e.trace_id)
+        by_trace[e.trace_id].append(e)
+    out: List[Dict[str, Any]] = []
+    for tid in reversed(order):
+        events = by_trace[tid]
+        root = min(events, key=lambda ev: ev.ts_us)
+        if name_prefix and not root.name.startswith(name_prefix):
+            continue
+        t0 = min(e.ts_us for e in events)
+        t1 = max(e.ts_us + e.dur_us for e in events)
+        links: List[str] = []
+        for e in events:
+            links.extend(lk for lk in e.links if lk not in links)
+        out.append({
+            "trace_id": tid,
+            "root": root.name,
+            "spans": len(events),
+            "started_us": round(t0, 3),
+            "duration_ms": round((t1 - t0) / 1000.0, 6),
+            "links": links,
+        })
+        if len(out) >= limit:
+            break
+    return out
 
 
 def trace_dir() -> Optional[str]:

@@ -15,13 +15,18 @@ The transform path PR 3 instrumented becomes an actual inference engine:
   with ``QueueFull`` rejection, per-request deadlines shed before device
   time, graceful drain on shutdown;
 * ``start_serve_server`` (``serve.server``) — ``POST /predict`` /
-  ``GET /healthz`` / ``GET /metrics`` over ``http.server``, no new
-  dependencies.
+  ``GET /healthz`` / ``GET /metrics`` plus the ops surface
+  (``/debug/traces``, ``/debug/slo``, ``/dashboard``) over
+  ``http.server``, no new dependencies.
 
 Every stage emits through ``obs``: queue-depth / occupancy /
 padding-waste gauges, stage latencies in quantile sketches, and each
 engine batch still produces a full ``TransformReport`` because the model
-call goes through the ``@observed_transform`` entry point.
+call goes through the ``@observed_transform`` entry point. Every request
+additionally carries a ``TraceContext`` (``obs.tracectx``) across the
+queue/batch seams — W3C ``traceparent`` in/out, fan-in batch spans
+linking member traces, trace-id exemplars on the latency sketches — and
+feeds the engine's SLO burn-rate engine (``obs.slo``).
 """
 
 from spark_rapids_ml_tpu.serve.batching import (  # noqa: F401

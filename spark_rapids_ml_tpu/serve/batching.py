@@ -24,6 +24,16 @@ Correctness invariants (tested in ``tests/test_serve_batching.py``):
 Every stage emits through ``obs``: queue-depth / batch-occupancy /
 padding-waste gauges, per-stage latency (queue wait, execute) into the
 ``Summary`` quantile sketches, shed/rejection counters.
+
+Tracing: each request enqueues with its captured ``TraceContext``
+(``obs.tracectx``); the worker files a queue-wait span into the request's
+trace at pop time, runs the ONE coalesced transform under a **fan-in
+batch span** whose ``links`` carry every member request's trace id (the
+Dapper fan-in edge — ``assemble_trace`` grafts the batch subtree into
+each member's tree), and resolves every response latch with the member's
+context re-activated, so shed/error/result resolution attributes to the
+right trace. Rule 5 of ``scripts/check_instrumentation.py`` statically
+enforces this capture/activate contract on every handoff in ``serve/``.
 """
 
 from __future__ import annotations
@@ -35,7 +45,8 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import get_registry, span
+from spark_rapids_ml_tpu.obs import get_registry, span, tracectx
+from spark_rapids_ml_tpu.obs import spans as spans_mod
 from spark_rapids_ml_tpu.utils.padding import (
     bucket_for,
     default_buckets,
@@ -60,16 +71,23 @@ class BatcherClosed(RuntimeError):
 
 
 class _Request:
-    """One enqueued predict request; a latch the caller waits on."""
+    """One enqueued predict request; a latch the caller waits on.
 
-    __slots__ = ("rows", "n", "enqueued", "deadline", "_event", "result",
-                 "error")
+    ``trace_ctx`` is the submitter's captured ``TraceContext`` — the
+    worker re-activates it around every resolution (result, shed, batch
+    failure) and files the queue-wait span into its trace."""
 
-    def __init__(self, rows: np.ndarray, deadline: Optional[float]):
+    __slots__ = ("rows", "n", "enqueued", "enqueued_perf", "deadline",
+                 "trace_ctx", "_event", "result", "error")
+
+    def __init__(self, rows: np.ndarray, deadline: Optional[float],
+                 trace_ctx: Optional[tracectx.TraceContext] = None):
         self.rows = rows
         self.n = int(rows.shape[0])
         self.enqueued = time.monotonic()
+        self.enqueued_perf = time.perf_counter()  # spans' timeline clock
         self.deadline = deadline
+        self.trace_ctx = trace_ctx
         self._event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -135,8 +153,11 @@ class MicroBatcher:
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
         self._declare_metrics()
-        self._worker = threading.Thread(
-            target=self._run, name=f"sparkml-serve-{name}", daemon=True
+        # fresh=True: the worker outlives the request whose call created
+        # this batcher — it must not inherit that request's context.
+        self._worker = tracectx.traced_thread(
+            self._run, name=f"sparkml-serve-{name}", daemon=True,
+            fresh=True,
         )
         self._worker.start()
 
@@ -206,12 +227,17 @@ class MicroBatcher:
     # -- submission --------------------------------------------------------
 
     def submit(self, rows: np.ndarray,
-               deadline: Optional[float] = None) -> _Request:
+               deadline: Optional[float] = None,
+               trace_ctx: Optional[tracectx.TraceContext] = None,
+               ) -> _Request:
         """Enqueue a (n, d) request; returns the latch to ``wait`` on.
 
-        Raises ``QueueFull`` past ``max_queue_depth`` (admission control)
-        and ``BatcherClosed`` after ``close()`` — both BEFORE the request
-        occupies queue memory.
+        ``trace_ctx`` is the caller's captured ``TraceContext`` (rule 5:
+        every enqueue hands its identity across the queue — ``None`` only
+        for untraced internal traffic). Raises ``QueueFull`` past
+        ``max_queue_depth`` (admission control) and ``BatcherClosed``
+        after ``close()`` — both BEFORE the request occupies queue
+        memory.
         """
         rows = np.asarray(rows, dtype=np.float64)
         if rows.ndim == 1:
@@ -226,7 +252,8 @@ class MicroBatcher:
                 f"max_batch_rows {self.max_batch_rows} — split it, or "
                 "configure a larger top bucket"
             )
-        req = _Request(rows, deadline)
+        req = _Request(rows, deadline,
+                       trace_ctx=trace_ctx or tracectx.capture())
         with self._not_empty:
             if self._closed:
                 raise BatcherClosed(f"batcher {self.name!r} is closed")
@@ -256,9 +283,12 @@ class MicroBatcher:
             self._closed = True
             if not drain:
                 while self._queue:
-                    self._queue.popleft().set_error(
-                        BatcherClosed(f"batcher {self.name!r} shut down")
-                    )
+                    req = self._queue.popleft()
+                    with tracectx.activate(req.trace_ctx):
+                        req.set_error(
+                            BatcherClosed(
+                                f"batcher {self.name!r} shut down")
+                        )
                 self._record_depth()
             self._not_empty.notify_all()
         self._worker.join(timeout=timeout)
@@ -277,12 +307,30 @@ class MicroBatcher:
         return None
 
     def _shed(self, req: _Request) -> None:
-        req.set_error(DeadlineExpired(
-            f"{self.name}: deadline expired after "
-            f"{time.monotonic() - req.enqueued:.3f}s in queue"
-        ))
+        with tracectx.activate(req.trace_ctx):
+            self._record_queue_span(req, shed=True)
+            req.set_error(DeadlineExpired(
+                f"{self.name}: deadline expired after "
+                f"{time.monotonic() - req.enqueued:.3f}s in queue"
+            ))
         self._m_requests.inc(model=self.name, outcome="expired")
         self._m_expired.inc(model=self.name)
+
+    def _record_queue_span(self, req: _Request, shed: bool = False) -> None:
+        """File the queue-wait interval into the REQUEST's trace (the
+        enqueue thread stamped t0; this — pop — is t1)."""
+        ctx = req.trace_ctx
+        if ctx is None:
+            return
+        args = {"model": self.name, "rows": req.n}
+        if shed:
+            args["error"] = "DeadlineExpired"
+        spans_mod.record_event(
+            f"serve:queue:{self.name}",
+            req.enqueued_perf, time.perf_counter(),
+            trace_id=ctx.trace_id, parent_span_id=ctx.span_id,
+            **args,
+        )
 
     def _run(self) -> None:
         while True:
@@ -326,16 +374,33 @@ class MicroBatcher:
         now = time.monotonic()
         stage = self._m_stage
         for req in batch:
-            stage.observe(now - req.enqueued, model=self.name, stage="queue")
+            tid = req.trace_ctx.trace_id if req.trace_ctx else None
+            stage.observe(now - req.enqueued, trace_id=tid,
+                          model=self.name, stage="queue")
+            self._record_queue_span(req)
+        # The fan-in edge: ONE coalesced transform runs in its own batch
+        # trace whose `links` name every member request's trace, so each
+        # member's assembled tree grafts the shared batch/transform
+        # subtree in (Dapper's fan-in span).
+        member_ids: List[str] = []
+        for req in batch:
+            if req.trace_ctx and req.trace_ctx.trace_id not in member_ids:
+                member_ids.append(req.trace_ctx.trace_id)
+        batch_ctx = tracectx.new_context(model=self.name)
         matrix = (batch[0].rows if len(batch) == 1
                   else np.concatenate([r.rows for r in batch], axis=0))
         try:
             padded, n = pad_to_bucket(matrix, self.buckets)
             bucket = int(padded.shape[0])
             t0 = time.monotonic()
-            with span(f"serve:batch:{self.name}"):
+            with tracectx.activate(batch_ctx), span(
+                f"serve:batch:{self.name}",
+                trace_id=batch_ctx.trace_id, links=tuple(member_ids),
+                requests=len(batch), rows=n, bucket=bucket,
+            ):
                 out = np.asarray(self.transform_fn(padded))
             stage.observe(time.monotonic() - t0,
+                          trace_id=batch_ctx.trace_id,
                           model=self.name, stage="execute")
             if out.shape[0] < n:
                 raise ValueError(
@@ -345,13 +410,18 @@ class MicroBatcher:
             out = out[:n]  # padding never leaks into any response
         except BaseException as exc:  # noqa: BLE001
             for req in batch:
-                req.set_error(exc)
+                with tracectx.activate(req.trace_ctx):
+                    req.set_error(exc)
             self._m_requests.inc(len(batch), model=self.name,
                                  outcome="error")
             raise
         offset = 0
         for req in batch:
-            req.set_result(out[offset:offset + req.n])
+            # resolve under the member's own context: anything recorded
+            # during latch release attributes to ITS trace, not a
+            # neighbour's (rule 5's "response future resolution" leg)
+            with tracectx.activate(req.trace_ctx):
+                req.set_result(out[offset:offset + req.n])
             offset += req.n
         self._m_requests.inc(len(batch), model=self.name, outcome="ok")
         self._record_batch(n, bucket, len(batch))

@@ -23,6 +23,14 @@ numerics sentinel exactly like a direct call. The engine adds the serving
 layer's own series on top (queue depth, occupancy, padding waste,
 request outcomes, end-to-end latency).
 
+Tracing and SLOs: every ``predict`` runs under a ``TraceContext``
+(``obs.tracectx`` — the active one, or a freshly minted root so direct
+callers trace too), registers in the in-flight table flight dumps embed,
+captures its context into the batcher queue (rule 5), and records its
+outcome + latency into the engine's ``SloSet`` (``obs.slo``) — burn
+rates, budget remaining, and firing multi-window alerts are live at
+``engine.slo_snapshot()`` / ``GET /debug/slo``.
+
 Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``, constructor args win):
 
 * ``..._MAX_BATCH_ROWS``  (default 1024) — coalescing row cap;
@@ -31,6 +39,9 @@ Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``, constructor args win):
 * ``..._DEADLINE_MS``     (default 0 = none) — default request deadline;
 * ``..._BUCKETS``         (e.g. ``"64,256,1024"``) — explicit row-bucket
   ladder; unset = powers of two up to the row cap.
+
+SLO objectives come from ``SPARK_RAPIDS_ML_TPU_SLO_*`` (see ``obs.slo``):
+availability / latency targets, latency threshold, budget window.
 """
 
 from __future__ import annotations
@@ -42,7 +53,9 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.slo import SloSet, default_slos
 from spark_rapids_ml_tpu.serve.batching import (
     BatcherClosed,
     DeadlineExpired,
@@ -123,6 +136,7 @@ class ServeEngine:
         max_queue_depth: Optional[int] = None,
         default_deadline_ms: Optional[float] = None,
         buckets: Optional[Sequence[int]] = None,
+        slo: Optional[SloSet] = None,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
         self.max_batch_rows = int(
@@ -142,6 +156,7 @@ class ServeEngine:
             else _env_number("DEADLINE_MS", 0.0)
         )
         self.buckets = tuple(buckets) if buckets else _env_buckets()
+        self.slo = slo if slo is not None else default_slos()
         self._batchers: Dict[Tuple[str, int], MicroBatcher] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -166,21 +181,58 @@ class ServeEngine:
     ) -> np.ndarray:
         """Serve one request: resolve, admit, coalesce, return its rows.
 
-        Raises ``KeyError`` (unknown model), ``QueueFull`` (admission),
+        Runs under the active ``TraceContext`` (or mints a root one), so
+        the request is followable across the queue/batch handoffs and
+        appears in the flight recorder's in-flight table. Raises
+        ``KeyError`` (unknown model), ``QueueFull`` (admission),
         ``DeadlineExpired`` (shed while queued), ``EngineClosed``.
         """
         if self._closed:
             raise EngineClosed("serving engine is shut down")
         t0 = time.perf_counter()
         entry = self.registry.resolve_entry(model_ref, version)
-        batcher = self._batcher_for(entry)
-        budget_ms = (deadline_ms if deadline_ms is not None
-                     else self.default_deadline_ms)
-        deadline = (time.monotonic() + budget_ms / 1000.0
-                    if budget_ms and budget_ms > 0 else None)
-        req = batcher.submit(rows, deadline=deadline)
-        out = req.wait(timeout)
-        self._m_latency.observe(time.perf_counter() - t0, model=entry.name)
+        ctx = tracectx.ensure_context()
+        submitted = False
+        try:
+            with tracectx.activate(ctx), tracectx.inflight_request(
+                ctx, model=entry.name, version=entry.version,
+            ), spans_mod.span(
+                f"serve:request:{entry.name}", trace_id=ctx.trace_id,
+                model=entry.name, version=entry.version,
+            ):
+                # the queue handoff carries THIS span as the parent, so
+                # the worker-side queue span nests under the request span
+                handoff = tracectx.TraceContext(
+                    trace_id=ctx.trace_id,
+                    span_id=spans_mod.current_span_id() or ctx.span_id,
+                    sampled=ctx.sampled,
+                    baggage=ctx.baggage,
+                )
+                batcher = self._batcher_for(entry)
+                budget_ms = (deadline_ms if deadline_ms is not None
+                             else self.default_deadline_ms)
+                deadline = (time.monotonic() + budget_ms / 1000.0
+                            if budget_ms and budget_ms > 0 else None)
+                req = batcher.submit(rows, deadline=deadline,
+                                     trace_ctx=handoff)
+                submitted = True
+                out = req.wait(timeout)
+        except BaseException as exc:
+            # Client errors (unknown model, a bad request shape rejected
+            # AT submit) never spend the service's error budget — but a
+            # ValueError surfacing AFTER admission is the batch execution
+            # failing (e.g. the model returned too few rows), which is
+            # exactly the outage the SLO layer exists to see.
+            client_error = isinstance(exc, KeyError) or (
+                isinstance(exc, ValueError) and not submitted
+            )
+            if not client_error:
+                self.slo.record_request(False, time.perf_counter() - t0)
+            raise
+        elapsed = time.perf_counter() - t0
+        self.slo.record_request(True, elapsed)
+        self._m_latency.observe(elapsed, trace_id=ctx.trace_id,
+                                model=entry.name)
         return out
 
     # -- batcher plumbing --------------------------------------------------
@@ -280,6 +332,13 @@ class ServeEngine:
                 for (name, version), b in batchers.items()
             },
         }
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """Evaluate the engine's SLOs now: burn rates per window, budget
+        remaining, firing alerts — and mirror them into the metrics
+        registry (``sparkml_slo_*`` gauges). The ``GET /debug/slo``
+        document."""
+        return self.slo.publish(get_registry())
 
     def drain(self, timeout: float = 30.0) -> None:
         """Serve everything queued, keep accepting afterwards (a quiesce

@@ -1,4 +1,4 @@
-"""Stdlib HTTP front end: predict + healthz + metrics, zero dependencies.
+"""Stdlib HTTP front end: predict + health + metrics + ops surface.
 
 A thin JSON shim over ``ServeEngine`` so the whole serving stack is
 drivable end-to-end (curl, load generators, k8s probes) without adding a
@@ -6,17 +6,29 @@ web framework to the container:
 
 * ``POST /predict`` — body ``{"model": "name[@version]",
   "rows": [[...], ...], "deadline_ms": 250}`` → ``{"model", "version",
-  "outputs": [...]}``; admission rejection maps to **429**, a shed
-  deadline to **504**, an unknown model to **404**, malformed input to
-  **400**;
+  "outputs": [...], "trace_id"}``; admission rejection maps to **429**, a
+  shed deadline to **504**, an unknown model to **404**, malformed input
+  to **400**. An inbound W3C ``traceparent`` header continues the
+  caller's trace (Dapper-style propagation via ``obs.tracectx``); every
+  response carries a ``traceparent`` back, and every error path replies
+  with an explicit ``Content-Length``;
 * ``GET /healthz`` — engine liveness + registered models + queue depth
   (the readiness probe target);
 * ``GET /metrics`` — the process metrics registry as Prometheus text
   (same exposition ``obs.metrics.start_prometheus_server`` serves), so
-  one port carries traffic AND its observability.
+  one port carries traffic AND its observability;
+* ``GET /debug/traces[?limit=N]`` — recent request traces assembled into
+  trees from the span ring (server → queue → fan-in batch → transform);
+* ``GET /debug/slo`` — current burn rates per window, budget remaining,
+  and firing multi-window alerts from the engine's ``SloSet``;
+* ``GET /dashboard`` — one self-contained HTML page polling those
+  endpoints: the live ops view.
 
 Threaded (one request per handler thread) — concurrency funnels into the
-engine's micro-batchers, which is the whole point.
+engine's micro-batchers, which is the whole point. The per-request
+latency/counter metric family handles are resolved ONCE at handler-class
+creation (the same convention as ``MicroBatcher._declare_metrics``), and
+latency observations carry trace-id exemplars.
 """
 
 from __future__ import annotations
@@ -24,12 +36,14 @@ from __future__ import annotations
 import http.server
 import json
 import socketserver
-import threading
+import time
+import urllib.parse
 from typing import Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import spans as spans_mod
 from spark_rapids_ml_tpu.serve.batching import (
     BatcherClosed,
     DeadlineExpired,
@@ -38,6 +52,8 @@ from spark_rapids_ml_tpu.serve.batching import (
 from spark_rapids_ml_tpu.serve.engine import EngineClosed, ServeEngine
 
 _MAX_BODY_BYTES = 64 * 1024 * 1024  # refuse absurd request bodies
+_TRACE_ROOT_PREFIXES = ("serve:http", "serve:request")
+_DEFAULT_TRACE_LIMIT = 20
 
 
 def _json_safe(outputs: np.ndarray):
@@ -47,47 +63,124 @@ def _json_safe(outputs: np.ndarray):
 def make_handler(engine: ServeEngine):
     """The request-handler class bound to one engine instance."""
 
+    # Metric family handles resolved once per handler class, NOT per
+    # request — the hot path increments through closures.
+    reg = get_registry()
+    m_http_latency = reg.summary(
+        "sparkml_http_request_latency_seconds",
+        "HTTP front-end request latency by path and status "
+        "(trace-id exemplars on the slowest requests)",
+        ("path", "status"),
+    )
+    m_http_requests = reg.counter(
+        "sparkml_http_requests_total",
+        "HTTP front-end requests by path and status", ("path", "status"),
+    )
+
     class _Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
-        def _reply(self, status: int, payload: dict) -> None:
+        def _reply(self, status: int, payload: dict,
+                   trace_ctx: Optional[tracectx.TraceContext] = None,
+                   ) -> int:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if trace_ctx is not None:
+                self.send_header(tracectx.TRACEPARENT_HEADER,
+                                 trace_ctx.traceparent())
             self.end_headers()
             self.wfile.write(body)
+            return status
 
         def _reply_text(self, status: int, text: str,
-                        content_type: str) -> None:
+                        content_type: str) -> int:
             body = text.encode("utf-8")
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return status
 
         def do_GET(self):  # noqa: N802 - http.server API
-            path = self.path.split("?")[0]
+            parsed = urllib.parse.urlparse(self.path)
+            path = parsed.path
             if path == "/healthz":
-                self._reply(200, {
+                status = self._reply(200, {
                     "status": "ok" if not engine._closed else "draining",
                     "models": engine.registry.names(),
                     "queue_depth": engine.queue_depth(),
+                    "inflight": tracectx.inflight_requests(),
                 })
             elif path == "/metrics":
-                self._reply_text(
+                status = self._reply_text(
                     200, get_registry().prometheus_text(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif path == "/debug/traces":
+                try:
+                    limit = int(urllib.parse.parse_qs(parsed.query).get(
+                        "limit", [_DEFAULT_TRACE_LIMIT])[0])
+                except (TypeError, ValueError):
+                    limit = _DEFAULT_TRACE_LIMIT
+                summaries = spans_mod.recent_traces(
+                    max(1, min(limit, 200)),
+                    name_prefix=_TRACE_ROOT_PREFIXES,
+                )
+                status = self._reply(200, {
+                    "traces": [
+                        spans_mod.assemble_trace(s["trace_id"])
+                        for s in summaries
+                    ],
+                })
+            elif path == "/debug/slo":
+                snap = engine.slo_snapshot()
+                snap["queue_depth"] = engine.queue_depth()
+                snap["models"] = engine.registry.names()
+                snap["closed"] = engine._closed
+                status = self._reply(200, snap)
+            elif path == "/dashboard":
+                status = self._reply_text(
+                    200, DASHBOARD_HTML, "text/html; charset=utf-8")
             else:
-                self._reply(404, {"error": f"unknown path {path!r}"})
+                status = self._reply(404,
+                                     {"error": f"unknown path {path!r}"})
+                # arbitrary client URLs must not mint unbounded metric
+                # children (classic label-cardinality leak)
+                path = "(unknown)"
+            m_http_requests.inc(path=path, status=str(status))
 
         def do_POST(self):  # noqa: N802 - http.server API
             path = self.path.split("?")[0]
             if path != "/predict":
-                self._reply(404, {"error": f"unknown path {path!r}"})
+                status = self._reply(404,
+                                     {"error": f"unknown path {path!r}"})
+                m_http_requests.inc(path="(unknown)", status=str(status))
                 return
+            # Honor an inbound W3C traceparent (continue the caller's
+            # trace; our root span's parent is the caller's span id), or
+            # mint a fresh root for header-less traffic.
+            inbound = tracectx.parse_traceparent(
+                self.headers.get(tracectx.TRACEPARENT_HEADER))
+            ctx = inbound if inbound is not None else tracectx.new_context()
+            t0 = time.perf_counter()
+            with tracectx.activate(ctx), spans_mod.span(
+                "serve:http:predict", trace_id=ctx.trace_id,
+            ):
+                status = self._handle_predict(ctx)
+            m_http_latency.observe(
+                time.perf_counter() - t0, trace_id=ctx.trace_id,
+                path=path, status=str(status),
+            )
+            m_http_requests.inc(path=path, status=str(status))
+
+        def _handle_predict(self, ctx: tracectx.TraceContext) -> int:
+            """Parse, predict, reply; returns the HTTP status it sent.
+            Every reply — 200 and all error paths (400/404/429/503/504)
+            — goes through ``_reply``, so every response carries an
+            explicit ``Content-Length`` and the ``traceparent``."""
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 if length <= 0 or length > _MAX_BODY_BYTES:
@@ -100,8 +193,8 @@ def make_handler(engine: ServeEngine):
                 # The body may be partially (or not at all) consumed —
                 # a keep-alive connection would desync, so close it.
                 self.close_connection = True
-                self._reply(400, {"error": f"bad request: {exc}"})
-                return
+                return self._reply(400, {"error": f"bad request: {exc}"},
+                                   trace_ctx=ctx)
             try:
                 # Resolve once and predict against the PINNED version, so
                 # the reported version is the one that actually served the
@@ -112,28 +205,28 @@ def make_handler(engine: ServeEngine):
                     deadline_ms=deadline_ms,
                 )
             except KeyError as exc:
-                self._reply(404, {"error": str(exc)})
+                return self._reply(404, {"error": str(exc)}, trace_ctx=ctx)
             except ValueError as exc:
                 # request-shape errors (empty / oversize batch) are the
                 # client's to fix
-                self._reply(400, {"error": str(exc)})
+                return self._reply(400, {"error": str(exc)}, trace_ctx=ctx)
             except QueueFull as exc:
-                self._reply(429, {"error": str(exc)})
+                return self._reply(429, {"error": str(exc)}, trace_ctx=ctx)
             except DeadlineExpired as exc:
-                self._reply(504, {"error": str(exc)})
+                return self._reply(504, {"error": str(exc)}, trace_ctx=ctx)
             except (BatcherClosed, EngineClosed) as exc:
                 # both mean "shutting down" — retryable 503, not a 5xx page
-                self._reply(503, {"error": str(exc)})
+                return self._reply(503, {"error": str(exc)}, trace_ctx=ctx)
             except Exception as exc:  # noqa: BLE001 - surface, don't die
-                self._reply(500, {
+                return self._reply(500, {
                     "error": f"{type(exc).__name__}: {exc}"
-                })
-            else:
-                self._reply(200, {
-                    "model": entry.name,
-                    "version": entry.version,
-                    "outputs": _json_safe(outputs),
-                })
+                }, trace_ctx=ctx)
+            return self._reply(200, {
+                "model": entry.name,
+                "version": entry.version,
+                "outputs": _json_safe(outputs),
+                "trace_id": ctx.trace_id,
+            }, trace_ctx=ctx)
 
         def log_message(self, *args):  # silence per-request stderr noise
             pass
@@ -153,8 +246,198 @@ def start_serve_server(
     ``port=0`` for ephemeral — read ``server.server_address[1]``; stop
     with ``server.shutdown()``, then ``engine.shutdown()`` to drain)."""
     server = _Server((addr, port), make_handler(engine))
-    thread = threading.Thread(
-        target=server.serve_forever, name="sparkml-serve-http", daemon=True
+    thread = tracectx.traced_thread(
+        server.serve_forever, name="sparkml-serve-http", daemon=True,
+        fresh=True,
     )
     thread.start()
     return server
+
+
+# -- the live ops dashboard --------------------------------------------------
+#
+# One self-contained page, zero external assets: stat tiles + tables over
+# /healthz, /debug/slo, and /debug/traces. Status colors are the reserved
+# status palette and always ship with an icon + label (never color alone);
+# text wears text tokens; light/dark are both selected via custom props.
+
+DASHBOARD_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>spark_rapids_ml_tpu · serving ops</title>
+<style>
+  .viz-root {
+    color-scheme: light;
+    --surface-1: #fcfcfb;
+    --surface-2: #f0efec;
+    --text-primary: #0b0b0b;
+    --text-secondary: #52514e;
+    --status-good: #0ca30c;
+    --status-warning: #fab219;
+    --status-serious: #ec835a;
+    --status-critical: #d03b3b;
+    --border: #d9d8d4;
+  }
+  @media (prefers-color-scheme: dark) {
+    :root:where(:not([data-theme="light"])) .viz-root {
+      color-scheme: dark;
+      --surface-1: #1a1a19;
+      --surface-2: #383835;
+      --text-primary: #ffffff;
+      --text-secondary: #c3c2b7;
+      --border: #44443f;
+    }
+  }
+  :root[data-theme="dark"] .viz-root {
+    color-scheme: dark;
+    --surface-1: #1a1a19;
+    --surface-2: #383835;
+    --text-primary: #ffffff;
+    --text-secondary: #c3c2b7;
+    --border: #44443f;
+  }
+  body { margin: 0; }
+  .viz-root {
+    font: 14px/1.45 system-ui, -apple-system, "Segoe UI", sans-serif;
+    background: var(--surface-1); color: var(--text-primary);
+    min-height: 100vh; padding: 20px 24px; box-sizing: border-box;
+  }
+  h1 { font-size: 17px; font-weight: 600; margin: 0 0 2px; }
+  h2 { font-size: 13px; font-weight: 600; margin: 22px 0 8px;
+       color: var(--text-secondary); text-transform: uppercase;
+       letter-spacing: 0.04em; }
+  .sub { color: var(--text-secondary); margin: 0 0 18px; }
+  .tiles { display: flex; flex-wrap: wrap; gap: 12px; }
+  .tile { background: var(--surface-2); border-radius: 8px;
+          padding: 12px 16px; min-width: 150px; }
+  .tile .label { color: var(--text-secondary); font-size: 12px; }
+  .tile .value { font-size: 26px; font-weight: 600; margin-top: 2px; }
+  table { border-collapse: collapse; width: 100%; }
+  th { text-align: left; color: var(--text-secondary); font-weight: 500;
+       font-size: 12px; border-bottom: 1px solid var(--border);
+       padding: 4px 10px 4px 0; }
+  td { padding: 5px 10px 5px 0; border-bottom: 1px solid var(--border);
+       font-variant-numeric: tabular-nums; }
+  td.name { font-variant-numeric: normal; }
+  .status { display: inline-flex; align-items: center; gap: 6px; }
+  .dot { width: 9px; height: 9px; border-radius: 50%; display: inline-block; }
+  .good .dot { background: var(--status-good); }
+  .warning .dot { background: var(--status-warning); }
+  .serious .dot { background: var(--status-serious); }
+  .critical .dot { background: var(--status-critical); }
+  .mono { font-family: ui-monospace, monospace; font-size: 12px; }
+  details { margin: 4px 0; }
+  summary { cursor: pointer; color: var(--text-secondary); }
+  pre { background: var(--surface-2); border-radius: 6px; padding: 10px;
+        overflow-x: auto; font-size: 11px; }
+  .quiet { color: var(--text-secondary); }
+</style>
+</head>
+<body>
+<div class="viz-root">
+  <h1>Serving ops</h1>
+  <p class="sub">live view over <span class="mono">/debug/slo</span>,
+    <span class="mono">/debug/traces</span>, and
+    <span class="mono">/healthz</span> · refreshes every 2&thinsp;s</p>
+  <div class="tiles" id="tiles"></div>
+  <h2>SLO burn rates</h2>
+  <table><thead><tr><th>Objective</th><th>Target</th><th>5m</th><th>30m</th>
+    <th>1h</th><th>6h</th><th>Budget left</th><th>State</th></tr></thead>
+    <tbody id="slo-rows"></tbody></table>
+  <h2>Firing alerts</h2>
+  <div id="alerts" class="quiet">—</div>
+  <h2>Recent traces</h2>
+  <div id="traces" class="quiet">—</div>
+</div>
+<script>
+function fmtPct(v) {
+  return (v == null) ? "–" : (100 * v).toFixed(2) + "%";
+}
+function fmtBurn(v) {
+  return (v == null) ? "–" : v.toFixed(2);
+}
+function stateFor(slo) {
+  if (slo.alerts.some(a => a.severity === "page_fast"))
+    return ["critical", "\\u25cf paging (fast)"];
+  if (slo.alerts.length) return ["serious", "\\u25cf paging (slow)"];
+  var rates = Object.values(slo.burn_rates || {});
+  if (rates.some(r => r > 1)) return ["warning", "\\u25cf burning budget"];
+  return ["good", "\\u25cf within budget"];
+}
+function tile(label, value) {
+  return '<div class="tile"><div class="label">' + label +
+    '</div><div class="value">' + value + "</div></div>";
+}
+function statusSpan(cls, text) {
+  return '<span class="status ' + cls + '"><span class="dot"></span>' +
+    text.replace("\\u25cf ", "") + "</span>";
+}
+async function refresh() {
+  try {
+    var slo = await (await fetch("/debug/slo")).json();
+    var health = await (await fetch("/healthz")).json();
+    var tiles = [
+      tile("Service", statusSpan(
+        health.status === "ok" ? "good" : "warning", health.status)),
+      tile("Queue depth", health.queue_depth),
+      tile("In flight", (health.inflight || []).length),
+      tile("Firing alerts", (slo.alerts || []).length),
+    ];
+    (slo.slos || []).forEach(function (s) {
+      tiles.push(tile("Budget left · " + s.name,
+                      fmtPct(s.budget_remaining)));
+    });
+    document.getElementById("tiles").innerHTML = tiles.join("");
+    document.getElementById("slo-rows").innerHTML =
+      (slo.slos || []).map(function (s) {
+        var st = stateFor(s);
+        var b = s.burn_rates || {};
+        return "<tr><td class=name>" + s.objective + "</td><td>" +
+          s.target + "</td><td>" + fmtBurn(b["5m"]) + "</td><td>" +
+          fmtBurn(b["30m"]) + "</td><td>" + fmtBurn(b["1h"]) +
+          "</td><td>" + fmtBurn(b["6h"]) + "</td><td>" +
+          fmtPct(s.budget_remaining) + "</td><td>" +
+          statusSpan(st[0], st[1]) + "</td></tr>";
+      }).join("");
+    var alerts = slo.alerts || [];
+    document.getElementById("alerts").innerHTML = alerts.length
+      ? "<table><thead><tr><th>SLO</th><th>Severity</th><th>Short</th>" +
+        "<th>Long</th><th>Factor</th></tr></thead><tbody>" +
+        alerts.map(function (a) {
+          return "<tr><td class=name>" + a.slo + "</td><td>" +
+            statusSpan(a.severity === "page_fast" ? "critical" : "serious",
+                       a.severity) + "</td><td>" +
+            a.short_window + " @ " + fmtBurn(a.short_burn_rate) +
+            "</td><td>" + a.long_window + " @ " +
+            fmtBurn(a.long_burn_rate) + "</td><td>" + a.factor +
+            "</td></tr>";
+        }).join("") + "</tbody></table>"
+      : "no alerts firing";
+    var tr = await (await fetch("/debug/traces?limit=10")).json();
+    var traces = tr.traces || [];
+    document.getElementById("traces").innerHTML = traces.length
+      ? traces.map(function (t) {
+          var root = (t.spans && t.spans[0]) || {};
+          return "<details><summary><span class=mono>" + t.trace_id +
+            "</span> · " + (root.name || "?") + " · " + t.span_count +
+            " spans · " + (root.duration_ms || 0).toFixed(2) +
+            " ms</summary><pre>" +
+            JSON.stringify(t, null, 1) + "</pre></details>";
+        }).join("")
+      : "no traces yet";
+  } catch (err) {
+    document.getElementById("alerts").textContent =
+      "refresh failed: " + err;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""
+
+
+__all__ = ["DASHBOARD_HTML", "make_handler", "start_serve_server"]

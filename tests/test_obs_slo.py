@@ -1,0 +1,269 @@
+"""SLO burn-rate engine (obs/slo.py): windowed counts, burn-rate math,
+multi-window alert semantics under an injectable clock (zero real
+sleeps), budget remaining, registry publication, env-knob defaults."""
+
+import pytest
+
+from spark_rapids_ml_tpu.obs.metrics import MetricsRegistry
+from spark_rapids_ml_tpu.obs.slo import (
+    SLO,
+    SloSet,
+    WindowedCounts,
+    default_slos,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# -- WindowedCounts ---------------------------------------------------------
+
+
+def test_windowed_counts_basic_window_math():
+    clock = FakeClock()
+    counts = WindowedCounts(horizon_seconds=3600, bucket_seconds=10,
+                            clock=clock)
+    for _ in range(30):  # 5 minutes of 1 good + 1 bad per 10s
+        counts.record(True)
+        counts.record(False)
+        clock.advance(10)
+    good, total = counts.counts(300)
+    assert total == 60 and good == 30
+    # a narrower window sees proportionally less
+    good, total = counts.counts(100)
+    assert total == pytest.approx(20, abs=2)
+
+
+def test_windowed_counts_prunes_beyond_horizon():
+    clock = FakeClock()
+    counts = WindowedCounts(horizon_seconds=100, bucket_seconds=10,
+                            clock=clock)
+    for _ in range(100):
+        counts.record(True)
+        clock.advance(10)
+    assert len(counts._buckets) <= 12  # horizon/bucket + slack
+    good, total = counts.counts(50)
+    assert total == 5
+
+
+def test_windowed_counts_thread_safety():
+    import threading
+
+    clock = FakeClock()
+    counts = WindowedCounts(clock=clock)
+    threads = [
+        threading.Thread(
+            target=lambda: [counts.record(True) for _ in range(1000)])
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    good, total = counts.counts(60)
+    assert good == total == 8000
+
+
+# -- SLO objectives ---------------------------------------------------------
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError):
+        SLO("bad", target=1.5)
+    with pytest.raises(ValueError):
+        SLO("bad", kind="nope")
+    with pytest.raises(ValueError):
+        SLO("bad", kind="latency")  # threshold required
+
+
+def test_availability_burn_rate():
+    clock = FakeClock()
+    slo = SLO("avail", target=0.99, clock=clock)  # 1% budget
+    for _ in range(99):
+        slo.record(True)
+    slo.record(False)  # exactly the budget: 1% errors
+    assert slo.burn_rate(300) == pytest.approx(1.0)
+    assert slo.budget_remaining() == pytest.approx(0.0)
+
+
+def test_latency_slo_judges_threshold():
+    clock = FakeClock()
+    slo = SLO("lat", target=0.9, kind="latency",
+              latency_threshold_seconds=0.25, clock=clock)
+    slo.record(True, latency_seconds=0.1)   # good
+    slo.record(True, latency_seconds=0.5)   # too slow -> bad
+    slo.record(False, latency_seconds=0.1)  # errored -> bad
+    slo.record(True, latency_seconds=None)  # no latency -> bad
+    good, total = slo._counts.counts(300)
+    assert (good, total) == (1, 4)
+
+
+def test_idle_service_burns_nothing():
+    slo = SLO("avail", target=0.999, clock=FakeClock())
+    assert slo.burn_rate(300) == 0.0
+    assert slo.budget_remaining() == 1.0
+    assert slo.firing() == []
+
+
+def test_latency_spike_flips_fast_alert_slow_window_stays_quiet():
+    """The ISSUE acceptance case: steady good traffic for 6h, then a
+    15-minute latency spike — the fast (5m/1h) burn alert fires, the
+    slow (30m/6h) page stays quiet. Injectable clock, no real sleeps."""
+    clock = FakeClock()
+    slo = SLO("serve_latency", target=0.99, kind="latency",
+              latency_threshold_seconds=0.25, clock=clock)
+    # 6 hours of healthy traffic, one request per 10s
+    for _ in range(6 * 360):
+        slo.record(True, latency_seconds=0.01)
+        clock.advance(10)
+    assert slo.firing() == []
+    assert slo.budget_remaining() == pytest.approx(1.0)
+    # 15 minutes of injected latency (every request over threshold)
+    for _ in range(90):
+        slo.record(True, latency_seconds=1.0)
+        clock.advance(10)
+    rates = slo.burn_rates()
+    assert rates["5m"] > 14.4 and rates["1h"] > 14.4   # fast: both burn
+    assert rates["6h"] < 6.0                           # slow long window quiet
+    alerts = slo.firing()
+    assert [a["severity"] for a in alerts] == ["page_fast"]
+    assert alerts[0]["short_window"] == "5m"
+    assert alerts[0]["long_window"] == "1h"
+    # recovery: 30 minutes of healthy traffic clears the SHORT window,
+    # so the page stops even while the 1h window still remembers the spike
+    for _ in range(180):
+        slo.record(True, latency_seconds=0.01)
+        clock.advance(10)
+    assert slo.burn_rate(300) == 0.0
+    assert slo.firing() == []
+
+
+def test_sustained_outage_fires_slow_page_too():
+    clock = FakeClock()
+    slo = SLO("avail", target=0.99, clock=clock)
+    for _ in range(6 * 360):  # 6h of 10% errors: burn 10 everywhere
+        slo.record(True)
+        for _ in range(8):
+            slo.record(True)
+        slo.record(False)
+        clock.advance(10)
+    severities = {a["severity"] for a in slo.firing()}
+    assert severities == {"page_slow"}  # 10 > 6, but 10 < 14.4
+
+
+def test_snapshot_shape():
+    clock = FakeClock()
+    slo = SLO("avail", target=0.999, clock=clock)
+    slo.record(True)
+    snap = slo.snapshot()
+    assert snap["name"] == "avail" and snap["kind"] == "availability"
+    assert set(snap["burn_rates"]) == {"5m", "30m", "1h", "6h"}
+    assert snap["window_total"] == 1
+    assert "succeed" in snap["objective"]
+
+
+# -- SloSet -----------------------------------------------------------------
+
+
+def test_slo_set_feeds_all_and_publishes_gauges():
+    clock = FakeClock()
+    slo_set = SloSet([
+        SLO("avail", target=0.99, clock=clock),
+        SLO("lat", target=0.9, kind="latency",
+            latency_threshold_seconds=0.25, clock=clock),
+    ], clock=clock)
+    slo_set.record_request(True, 0.01)
+    slo_set.record_request(True, 0.9)   # slow but up: bad for lat only
+    slo_set.record_request(False, 0.01)
+    registry = MetricsRegistry()
+    snap = slo_set.publish(registry)
+    assert {s["name"] for s in snap["slos"]} == {"avail", "lat"}
+    burn = registry.gauge("sparkml_slo_burn_rate", "", ("slo", "window"))
+    assert burn.value(slo="avail", window="5m") == pytest.approx(
+        (1 / 3) / 0.01)
+    assert burn.value(slo="lat", window="5m") == pytest.approx(
+        (2 / 3) / 0.1)
+    budget = registry.gauge("sparkml_slo_budget_remaining", "", ("slo",))
+    assert budget.value(slo="avail") < 0  # budget blown
+    alert = registry.gauge("sparkml_slo_alert_firing", "",
+                           ("slo", "severity"))
+    # blown budget in EVERY window -> both alerts firing for both slos
+    assert alert.value(slo="avail", severity="page_fast") == 1.0
+    assert alert.value(slo="lat", severity="page_slow") == 1.0
+
+
+def test_default_slos_env_knobs(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SLO_AVAILABILITY_TARGET",
+                       "0.95")
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SLO_LATENCY_TARGET", "0.9")
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SLO_LATENCY_THRESHOLD_MS",
+                       "100")
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SLO_WINDOW_HOURS", "12")
+    slo_set = default_slos()
+    avail = slo_set.get("serve_availability")
+    lat = slo_set.get("serve_latency")
+    assert avail.target == 0.95
+    assert lat.target == 0.9
+    assert lat.latency_threshold_seconds == pytest.approx(0.1)
+    assert lat.window_seconds == 12 * 3600.0
+
+
+def test_default_slos_zero_target_disables(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SLO_AVAILABILITY_TARGET", "0")
+    slo_set = default_slos()
+    assert slo_set.get("serve_availability") is None
+    assert slo_set.get("serve_latency") is not None
+
+
+def test_engine_records_slo_outcomes(rng):
+    """ServeEngine.predict feeds its SloSet: good requests count good;
+    client errors (unknown model, oversize request rejected at submit)
+    never spend the budget; a SERVER-side batch failure that surfaces as
+    ValueError after admission (model returned too few rows) counts bad
+    — a fully-failing model must burn the budget, not hide behind the
+    client-error carve-out."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+
+    class _Echo:
+        def transform(self, matrix):
+            return np.asarray(matrix)
+
+    class _Short:
+        def transform(self, matrix):
+            return np.asarray(matrix)[:1]  # fewer rows than the batch
+
+    clock = FakeClock()
+    slo_set = SloSet([SLO("avail", target=0.99, clock=clock)], clock=clock)
+    reg = ModelRegistry()
+    reg.register("echo", _Echo())
+    reg.register("short", _Short())
+    engine = ServeEngine(reg, max_batch_rows=8, max_wait_ms=1,
+                         slo=slo_set)
+    try:
+        engine.predict("echo", rng.normal(size=(2, 3)))
+        good, total = slo_set.get("avail")._counts.counts(300)
+        assert (good, total) == (1, 1)
+        with pytest.raises(KeyError):
+            engine.predict("ghost", rng.normal(size=(2, 3)))
+        with pytest.raises(ValueError):  # oversize: rejected at submit
+            engine.predict("echo", rng.normal(size=(100, 3)))
+        # client errors never spend the budget
+        good, total = slo_set.get("avail")._counts.counts(300)
+        assert (good, total) == (1, 1)
+        with pytest.raises(ValueError):  # batch execution failure
+            engine.predict("short", rng.normal(size=(4, 3)))
+        good, total = slo_set.get("avail")._counts.counts(300)
+        assert (good, total) == (1, 2)  # the outage IS visible
+    finally:
+        engine.shutdown()

@@ -362,6 +362,50 @@ def test_explicit_higher_is_better_flag_wins():
                              "higher_is_better": True}) is True
 
 
+def test_budget_remaining_judges_higher_is_better():
+    """ISSUE 5 satellite: slo_budget_remaining is higher-is-better even
+    without a '/sec' unit — and even when the unit TEXT mentions seconds
+    (a budget can be phrased as seconds of allowed badness left)."""
+    from perf_sentinel import higher_is_better
+
+    assert higher_is_better({
+        "metric": "serve slo_budget_remaining (6h)", "unit": "fraction",
+    }) is True
+    assert higher_is_better({
+        "metric": "slo_budget_remaining",
+        "unit": "seconds of error budget",
+    }) is True
+    metric = "serve slo_budget_remaining (6h)"
+    hist = [{"metric": metric, "value": 0.9, "unit": "fraction",
+             "platform": "tpu", "_source": "f.json"}]
+    worse = judge({"metric": metric, "value": 0.2, "unit": "fraction",
+                   "platform": "tpu"}, hist)
+    assert worse["verdict"] == "REGRESSED"
+    assert "below the noise band" in worse["reason"]
+    better = judge({"metric": metric, "value": 0.99, "unit": "fraction",
+                    "platform": "tpu"}, hist)
+    assert better["verdict"] == "PASS"
+
+
+def test_burn_rate_judges_lower_is_better():
+    """slo_fast_burn_rate is budget spend SPEED: a jump to paging-level
+    burn must read REGRESSED, never 'better than the band'."""
+    from perf_sentinel import higher_is_better
+
+    assert higher_is_better({
+        "metric": "serve slo_fast_burn_rate (5m)", "unit": "fraction",
+    }) is False
+    metric = "serve slo_fast_burn_rate (5m)"
+    hist = [{"metric": metric, "value": 0.1, "unit": "fraction",
+             "platform": "tpu", "_source": "f.json"}]
+    paging = judge({"metric": metric, "value": 20.0, "unit": "fraction",
+                    "platform": "tpu"}, hist)
+    assert paging["verdict"] == "REGRESSED"
+    quiet = judge({"metric": metric, "value": 0.0, "unit": "fraction",
+                   "platform": "tpu"}, hist)
+    assert quiet["verdict"] == "PASS"
+
+
 def test_malformed_percentile_fields_are_skipped_not_fatal():
     """Regression guard: a malformed percentile value in a record or the
     committed history degrades to 'field skipped', never a crash."""

@@ -1,0 +1,395 @@
+"""End-to-end request tracing through the serving tier (ISSUE 5
+acceptance): W3C traceparent propagation over HTTP, trace-tree assembly
+spanning server → queue → fan-in batch → transform, slowest-request
+trace-id exemplars in the latency snapshot, the /debug + /dashboard
+operator surface, the flight recorder's active trace table, and the
+rule-5 static check on serve/ handoffs."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import flight, get_registry, tracectx
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.serve import (
+    ModelRegistry,
+    ServeEngine,
+    start_serve_server,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- TraceContext / traceparent unit behavior -------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = tracectx.new_context()
+    parsed = tracectx.parse_traceparent(ctx.traceparent())
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.span_id == ctx.span_id
+    assert parsed.sampled
+
+
+def test_traceparent_rejects_malformed():
+    bad = [
+        None, "", "garbage",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",   # forbidden version
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # short trace id
+    ]
+    for header in bad:
+        assert tracectx.parse_traceparent(header) is None
+
+
+def test_activate_capture_and_child():
+    assert tracectx.current_context() is None
+    ctx = tracectx.new_context(model="m")
+    with tracectx.activate(ctx):
+        assert tracectx.capture() is ctx
+        child = ctx.child(hop="queue")
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id != ctx.span_id
+        assert child.baggage == {"model": "m", "hop": "queue"}
+    assert tracectx.current_context() is None
+    with tracectx.activate(None):  # no-op branch never raises
+        assert tracectx.current_context() is None
+
+
+def test_traced_thread_inherits_and_fresh_isolates():
+    ctx = tracectx.new_context()
+    seen = {}
+
+    def probe(key):
+        seen[key] = tracectx.current_context()
+
+    with tracectx.activate(ctx):
+        inherit = tracectx.traced_thread(probe, args=("inherit",))
+        fresh = tracectx.traced_thread(probe, args=("fresh",), fresh=True)
+        inherit.start()
+        fresh.start()
+    inherit.join()
+    fresh.join()
+    assert seen["inherit"] is ctx
+    assert seen["fresh"] is None
+
+
+def test_span_inherits_activated_context():
+    ctx = tracectx.new_context()
+    with tracectx.activate(ctx):
+        with spans_mod.span("unit:test:root") as tid:
+            assert tid == ctx.trace_id
+    events = [e for e in spans_mod.get_recorder().events()
+              if e.name == "unit:test:root"]
+    assert events[-1].trace_id == ctx.trace_id
+    assert events[-1].parent_span_id == ctx.span_id
+
+
+# -- the acceptance test ----------------------------------------------------
+
+
+@pytest.fixture
+def served_pca(rng):
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(256, 16))
+    model = PCA().setK(4).fit(x)
+    reg = ModelRegistry()
+    reg.register("pca_traced", model, buckets=(32, 64))
+    engine = ServeEngine(reg, max_batch_rows=64, max_wait_ms=40,
+                         buckets=(32, 64))
+    reg.warmup("pca_traced")
+    server = start_serve_server(engine)
+    try:
+        yield engine, server, x
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+def _tree_names(nodes, acc=None):
+    acc = [] if acc is None else acc
+    for node in nodes:
+        acc.append(node["name"])
+        _tree_names(node["children"], acc)
+    return acc
+
+
+def test_concurrent_http_traceparent_end_to_end(served_pca):
+    """ISSUE 5 acceptance: N concurrent HTTP predicts with distinct
+    traceparent headers → every response's trace assembles into ONE tree
+    spanning server→queue→batch→transform, coalesced-batch spans link
+    >= 2 member trace_ids, and the latency snapshot carries trace-id
+    exemplars from these requests."""
+    engine, server, x = served_pca
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    n = 8
+    trace_ids = [tracectx.new_trace_id() for _ in range(n)]
+    responses = {}
+    errors = []
+    barrier = threading.Barrier(n)
+
+    def one(i):
+        try:
+            barrier.wait(timeout=10)  # maximize coalescing overlap
+            body = json.dumps({
+                "model": "pca_traced",
+                "rows": x[i:i + 3 + i].tolist(),
+            }).encode()
+            req = urllib.request.Request(
+                f"{base}/predict", data=body,
+                headers={
+                    "traceparent":
+                        f"00-{trace_ids[i]}-{tracectx.new_span_id()}-01",
+                },
+            )
+            resp = urllib.request.urlopen(req, timeout=30)
+            responses[i] = (json.loads(resp.read()),
+                            resp.headers.get("traceparent"))
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(responses) == n
+
+    for i in range(n):
+        payload, tp_header = responses[i]
+        # the response continues the CALLER's trace
+        assert payload["trace_id"] == trace_ids[i]
+        assert trace_ids[i] in tp_header
+        # ... and that trace assembles into one tree with every hop
+        tree = spans_mod.assemble_trace(trace_ids[i])
+        names = _tree_names(tree["spans"])
+        assert any(nm == "serve:http:predict" for nm in names), names
+        assert any(nm.startswith("serve:request:") for nm in names), names
+        assert any(nm.startswith("serve:queue:") for nm in names), names
+        assert any(nm.startswith("serve:batch:") for nm in names), names
+        assert any(nm.startswith("transform:") for nm in names), names
+        # single root: the http span owns everything (batch grafted in)
+        assert len(tree["spans"]) == 1
+        assert tree["spans"][0]["name"] == "serve:http:predict"
+
+    # the ONE coalesced transform's fan-in span links >= 2 member traces
+    batch_events = [
+        e for e in spans_mod.get_recorder().events()
+        if e.name == "serve:batch:pca_traced"
+    ]
+    assert any(len(e.links) >= 2 for e in batch_events), \
+        [len(e.links) for e in batch_events]
+    for e in batch_events:
+        assert set(e.links) <= set(trace_ids)
+
+    # slowest-request exemplars: the engine latency snapshot names these
+    # requests' trace ids, slowest first
+    summary = get_registry().summary(
+        "sparkml_serve_request_latency_seconds",
+        "end-to-end serving request latency (admit → split)", ("model",),
+    )
+    exemplars = summary.exemplars(model="pca_traced")
+    assert exemplars, "no exemplars recorded"
+    values = [e["value"] for e in exemplars]
+    assert values == sorted(values, reverse=True)  # slowest first
+    assert all(e["trace_id"] in trace_ids for e in exemplars)
+    # and the snapshot / text exposition carry them too
+    snap = get_registry().snapshot()
+    samples = snap["sparkml_serve_request_latency_seconds"]["samples"]
+    sample = next(s for s in samples
+                  if s["labels"]["model"] == "pca_traced")
+    assert sample["exemplars"][0]["trace_id"] == exemplars[0]["trace_id"]
+    text = get_registry().prometheus_text()
+    assert f'trace_id="{exemplars[0]["trace_id"]}"' in text
+    # exemplars are comment lines — a 0.0.4 scraper must never see an
+    # annotation after a sample value
+    for line in text.splitlines():
+        if 'trace_id="' in line:
+            assert line.startswith("# exemplar:"), line
+
+
+def test_debug_traces_endpoint_returns_trees(served_pca):
+    engine, server, x = served_pca
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    tid = tracectx.new_trace_id()
+    body = json.dumps({"model": "pca_traced",
+                       "rows": x[:4].tolist()}).encode()
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/predict", data=body,
+        headers={"traceparent":
+                 f"00-{tid}-{tracectx.new_span_id()}-01"}), timeout=30)
+    doc = json.loads(urllib.request.urlopen(
+        f"{base}/debug/traces?limit=50", timeout=30).read())
+    ours = [t for t in doc["traces"] if t["trace_id"] == tid]
+    assert len(ours) == 1
+    assert ours[0]["span_count"] >= 4
+    assert ours[0]["spans"][0]["name"] == "serve:http:predict"
+
+
+def test_debug_slo_and_dashboard_endpoints(served_pca):
+    engine, server, x = served_pca
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    body = json.dumps({"model": "pca_traced",
+                       "rows": x[:4].tolist()}).encode()
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/predict", data=body), timeout=30)
+    resp = urllib.request.urlopen(f"{base}/debug/slo", timeout=30)
+    doc = json.loads(resp.read())
+    assert resp.headers.get("Content-Length") is not None
+    names = {s["name"] for s in doc["slos"]}
+    assert names == {"serve_availability", "serve_latency"}
+    for slo in doc["slos"]:
+        assert set(slo["burn_rates"]) == {"5m", "30m", "1h", "6h"}
+        assert slo["alerts"] == []  # one healthy request pages nobody
+        assert slo["budget_remaining"] == pytest.approx(1.0)
+    assert "queue_depth" in doc and "models" in doc
+    # the SLO gauges got mirrored into the registry by the endpoint
+    snap = get_registry().snapshot()
+    assert "sparkml_slo_burn_rate" in snap
+    assert "sparkml_slo_budget_remaining" in snap
+    # the dashboard is one self-contained page naming its data sources
+    resp = urllib.request.urlopen(f"{base}/dashboard", timeout=30)
+    html = resp.read().decode()
+    assert resp.headers["Content-Type"].startswith("text/html")
+    assert "/debug/slo" in html and "/debug/traces" in html
+    assert "<script>" in html and "</html>" in html.rstrip()
+
+
+def test_healthz_includes_inflight_table(served_pca):
+    engine, server, _ = served_pca
+    port = server.server_address[1]
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=30).read())
+    assert "inflight" in health
+    assert health["status"] == "ok"
+
+
+def test_flight_dump_carries_active_trace_table():
+    """A watchdog dump shows WHICH requests were in flight: the engine
+    registers every predict in the tracectx in-flight table and
+    build_dump embeds it."""
+
+    class _Slow:
+        def transform(self, matrix):
+            time.sleep(0.4)
+            return np.asarray(matrix)
+
+    reg = ModelRegistry()
+    reg.register("slow_traced", _Slow())
+    engine = ServeEngine(reg, max_batch_rows=8, max_wait_ms=1)
+    try:
+        done = threading.Event()
+
+        def fire():
+            engine.predict("slow_traced", np.zeros((2, 3)))
+            done.set()
+
+        t = threading.Thread(target=fire)
+        t.start()
+        time.sleep(0.1)  # request now executing on the "device"
+        doc = flight.build_dump("unit_test")
+        t.join()
+        assert done.wait(5)
+        active = doc["active_traces"]
+        ours = [a for a in active
+                if a["info"].get("model") == "slow_traced"]
+        assert len(ours) == 1
+        assert ours[0]["elapsed_seconds"] > 0
+        assert len(ours[0]["trace_id"]) == 32
+    finally:
+        engine.shutdown()
+    # after completion the table is empty again for this model
+    assert not [a for a in tracectx.inflight_requests()
+                if a["info"].get("model") == "slow_traced"]
+
+
+# -- rule 5: the serve/ handoff static check --------------------------------
+
+
+def _rule5(path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_instrumentation import check_trace_handoffs
+    finally:
+        sys.path.pop(0)
+    return list(check_trace_handoffs(str(path)))
+
+
+def test_rule5_accepts_current_serve_modules():
+    serve_dir = os.path.join(REPO, "spark_rapids_ml_tpu", "serve")
+    for fname in os.listdir(serve_dir):
+        if fname.endswith(".py"):
+            assert _rule5(os.path.join(serve_dir, fname)) == [], fname
+
+
+def test_rule5_rejects_raw_thread(tmp_path):
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "import threading\n"
+        "t = threading.Thread(target=print)\n"
+    )
+    offenders = _rule5(bad)
+    assert len(offenders) == 1
+    assert "traced_thread" in offenders[0][1]
+
+
+def test_rule5_rejects_submit_without_trace_ctx(tmp_path):
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "def go(batcher, rows):\n"
+        "    return batcher.submit(rows, deadline=None)\n"
+    )
+    offenders = _rule5(bad)
+    assert len(offenders) == 1
+    assert "trace_ctx" in offenders[0][1]
+
+
+def test_rule5_rejects_future_resolution_without_restore(tmp_path):
+    bad = tmp_path / "batching.py"
+    bad.write_text(
+        "def resolve(batch, out):\n"
+        "    for req in batch:\n"
+        "        req.set_result(out)\n"
+    )
+    offenders = _rule5(bad)
+    assert len(offenders) == 1
+    assert "set_result" in offenders[0][1]
+
+
+def test_rule5_accepts_restored_resolution_and_traced_thread(tmp_path):
+    good = tmp_path / "batching.py"
+    good.write_text(
+        "from spark_rapids_ml_tpu.obs import tracectx\n"
+        "def resolve(batch, out):\n"
+        "    for req in batch:\n"
+        "        with tracectx.activate(req.trace_ctx):\n"
+        "            req.set_result(out)\n"
+        "def start(fn):\n"
+        "    return tracectx.traced_thread(fn, fresh=True)\n"
+        "def enqueue(batcher, rows):\n"
+        "    return batcher.submit(rows, trace_ctx=tracectx.capture())\n"
+    )
+    assert _rule5(good) == []
+
+
+def test_main_checker_reports_rule5():
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_instrumentation.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout
+    assert "TraceContext" in out.stdout
