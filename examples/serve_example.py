@@ -145,6 +145,42 @@ def main():
     print(f"  firing alerts:       "
           f"{[a['severity'] for a in alerts] if alerts else 'none'}")
 
+    print("\n== injected outage -> breaker opens -> degraded CPU "
+          "fallback -> recovery ==")
+    from spark_rapids_ml_tpu.serve import fault_plane
+
+    engine2 = ServeEngine(registry, max_batch_rows=256, max_wait_ms=1,
+                          buckets=BUCKETS, retries=1, backoff_ms=5,
+                          breaker_failures=3, breaker_cooldown_ms=300)
+    plane = fault_plane()
+    plane.inject("pca_embedder", "raise", count=None)  # 100% device errors
+
+    def state():
+        return engine2.breaker_snapshot()["pca_embedder"]["state"]
+
+    served_degraded = errored = 0
+    for i in range(8):
+        try:
+            r = engine2.predict_detailed("prod", x[i:i + 8])
+            if r.degraded:
+                served_degraded += 1
+                # bit-identical to the direct CPU projection
+                assert np.array_equal(r.outputs, x[i:i + 8] @ model.pc)
+        except Exception as exc:  # noqa: BLE001 - pre-open failures
+            errored += 1
+            print(f"  request {i}: {type(exc).__name__} "
+                  f"(breaker {state()})")
+    print(f"  outage: {errored} errored before the breaker opened, then "
+          f"{served_degraded} served DEGRADED from the CPU path "
+          f"(bit-checked) — breaker {state()}")
+
+    plane.clear()                       # "the device tunnel recovers"
+    time.sleep(0.35)                    # wait out the cooldown
+    r = engine2.predict_detailed("prod", x[:8])
+    print(f"  fault cleared: half-open probe served degraded={r.degraded} "
+          f"-> breaker {state()}")
+    engine2.shutdown()
+
 
 if __name__ == "__main__":
     main()

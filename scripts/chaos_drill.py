@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Chaos drill: run the fault matrix against a live serve server.
+
+Stands up the real stack — fitted PCA model, registry, engine with
+retries + breaker + degraded CPU fallback, stdlib HTTP server — then
+attacks it through the fault-injection plane (``serve.faults``), one
+fault class at a time, measuring what a client on the wire experiences:
+
+* **baseline**   — no faults: availability must be 1.0;
+* **raise**      — 100% backend errors: the breaker opens, traffic
+  degrades to the CPU fallback, availability stays high;
+* **stall**      — a transform wedges past the worker budget: the
+  watchdog fails it fast (``WorkerCrashed`` → 503), the worker
+  restarts, traffic continues;
+* **nan**        — corrupted outputs: the NaN guard converts poison
+  into retryable errors;
+* **latency**    — +spike on every call: answers stay correct, the SLO
+  latency burn shows it;
+* **recovery**   — faults cleared: a half-open probe closes the
+  breaker and availability returns to 1.0.
+
+Every request gets exactly one terminal outcome (the drill exits 1 if
+any hangs past its client timeout, or if availability under fault drops
+below ``SPARKML_CHAOS_MIN_AVAILABILITY``, default 0.5), and the drill
+emits ONE ``bench_common.emit_record`` line the perf sentinel can judge
+against committed history:
+
+* ``availability_baseline`` / ``availability_under_fault`` /
+  ``availability_recovery`` — fraction of requests answered 200
+  (degraded answers count: the service answered);
+* ``degraded_served``       — how many answers came from the CPU
+  fallback;
+* ``breaker_open_seconds``  — how long the breaker was open during the
+  drill (lower = faster recovery);
+* ``recovery_seconds``      — fault cleared → breaker closed again.
+
+Knobs (env): SPARKML_CHAOS_REQUESTS (per phase, default 24),
+SPARKML_CHAOS_FEATURES (16), SPARKML_CHAOS_K (4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import bench_common  # noqa: E402 (scripts/ on path when run directly)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _post_predict(base: str, model: str, rows, timeout: float = 15.0):
+    """One HTTP predict; returns (status, payload_dict). Never raises —
+    a drill request that cannot be categorized is itself a finding."""
+    body = json.dumps({"model": model, "rows": rows.tolist()}).encode()
+    req = urllib.request.Request(
+        f"{base}/predict", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            payload = json.loads(exc.read())
+        except ValueError:
+            payload = {}
+        return exc.code, payload
+    except Exception as exc:  # noqa: BLE001 - hang/reset IS the result
+        return 0, {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _phase(base: str, model: str, x, n_requests: int, rng):
+    """Drive one phase; returns per-phase stats."""
+    statuses = []
+    degraded = 0
+    hung = 0
+    for _ in range(n_requests):
+        n = int(rng.integers(1, 9))
+        start = int(rng.integers(0, x.shape[0] - n))
+        t0 = time.monotonic()
+        status, payload = _post_predict(base, model, x[start:start + n])
+        if status == 0:
+            hung += 1
+        if status == 200 and payload.get("degraded"):
+            degraded += 1
+        statuses.append(status)
+        _ = time.monotonic() - t0
+    ok = sum(1 for s in statuses if s == 200)
+    return {
+        "requests": n_requests,
+        "ok": ok,
+        "availability": ok / n_requests if n_requests else 0.0,
+        "degraded": degraded,
+        "hung": hung,
+        "statuses": sorted(set(statuses)),
+    }
+
+
+def main() -> int:
+    n_requests = _env_int("SPARKML_CHAOS_REQUESTS", 24)
+    n_features = _env_int("SPARKML_CHAOS_FEATURES", 16)
+    k = _env_int("SPARKML_CHAOS_K", 4)
+    min_availability = float(
+        os.environ.get("SPARKML_CHAOS_MIN_AVAILABILITY", 0.5))
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import (
+        ModelRegistry,
+        ServeEngine,
+        fault_plane,
+        start_serve_server,
+    )
+
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(1024, n_features))
+    model = PCA().setK(k).fit(x)
+
+    registry = ModelRegistry()
+    registry.register("chaos_pca", model, buckets=(16, 64))
+    engine = ServeEngine(
+        registry, max_batch_rows=64, max_wait_ms=1.0,
+        retries=2, backoff_ms=10,
+        breaker_failures=3, breaker_cooldown_ms=400,
+        worker_budget_ms=500, default_deadline_ms=10_000,
+    )
+    registry.warmup("chaos_pca")
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    plane = fault_plane()
+    phases = {}
+    breaker_open_at = None
+    breaker_open_seconds = 0.0
+
+    def breaker_state():
+        snap = engine.breaker_snapshot().get("chaos_pca")
+        return snap["state"] if snap else "closed"
+
+    def _await_closed(budget: float = 30.0) -> float:
+        """Drive probe traffic until the breaker closes (each fault
+        class must start from a healthy state); returns how long it
+        took."""
+        t0 = time.monotonic()
+        while (breaker_state() != "closed"
+               and time.monotonic() < t0 + budget):
+            time.sleep(0.1)
+            n = int(rng.integers(1, 9))
+            start = int(rng.integers(0, x.shape[0] - n))
+            _post_predict(base, "chaos_pca", x[start:start + n])
+        return time.monotonic() - t0
+
+    try:
+        bench_common.log("chaos baseline")
+        phases["baseline"] = _phase(base, "chaos_pca", x, n_requests, rng)
+
+        # -- the storm: each fault class in turn, each from a healthy
+        # breaker (otherwise the first class's open breaker routes every
+        # later phase around the device and the later faults never fire)
+        bench_common.log("chaos raise storm (100% backend errors)")
+        plane.inject("chaos_pca", "raise", count=None)
+        phases["raise"] = _phase(base, "chaos_pca", x, n_requests, rng)
+        if breaker_state() != "closed":
+            breaker_open_at = time.monotonic()
+        plane.clear()
+        opened_for = _await_closed()
+        if breaker_open_at is not None:
+            breaker_open_seconds += opened_for
+
+        bench_common.log("chaos stall (transform wedges past the budget)")
+        plane.inject("chaos_pca", "stall", count=1, seconds=2.0)
+        phases["stall"] = _phase(base, "chaos_pca", x, max(n_requests // 4, 4),
+                                 rng)
+        plane.clear()
+        _await_closed()
+
+        bench_common.log("chaos nan corruption")
+        plane.inject("chaos_pca", "nan", count=2)
+        phases["nan"] = _phase(base, "chaos_pca", x, max(n_requests // 4, 4),
+                               rng)
+        plane.clear()
+        _await_closed()
+
+        bench_common.log("chaos latency spike (+50ms per call)")
+        plane.inject("chaos_pca", "latency", count=None, seconds=0.05)
+        phases["latency"] = _phase(base, "chaos_pca", x,
+                                   max(n_requests // 4, 4), rng)
+        plane.clear()
+
+        # -- recovery: wait out the cooldown, let a probe close it -------
+        bench_common.log("chaos recovery (faults cleared)")
+        recovery_seconds = _await_closed()
+        phases["recovery"] = _phase(base, "chaos_pca", x, n_requests, rng)
+    finally:
+        plane.clear()
+        server.shutdown()
+        engine.shutdown()
+
+    fault_phases = ("raise", "stall", "nan", "latency")
+    fault_requests = sum(phases[p]["requests"] for p in fault_phases)
+    fault_ok = sum(phases[p]["ok"] for p in fault_phases)
+    hung_total = sum(p["hung"] for p in phases.values())
+    availability_under_fault = (fault_ok / fault_requests
+                                if fault_requests else 0.0)
+    record = {
+        "bench": "chaos_drill",
+        "availability_baseline": phases["baseline"]["availability"],
+        "availability_under_fault": availability_under_fault,
+        "availability_recovery": phases["recovery"]["availability"],
+        "degraded_served": sum(p["degraded"] for p in phases.values()),
+        "breaker_open_seconds": breaker_open_seconds,
+        "recovery_seconds": recovery_seconds,
+        "final_breaker_state": breaker_state(),
+        "phases": {name: {k: v for k, v in stats.items()
+                          if k != "statuses"}
+                   for name, stats in phases.items()},
+    }
+    bench_common.emit_record(record)
+    if hung_total:
+        bench_common.log(f"chaos FAIL: {hung_total} request(s) hung")
+        return 1
+    if availability_under_fault < min_availability:
+        bench_common.log(
+            f"chaos FAIL: availability under fault "
+            f"{availability_under_fault:.2f} < {min_availability}")
+        return 1
+    if record["final_breaker_state"] != "closed":
+        bench_common.log("chaos FAIL: breaker did not close after recovery")
+        return 1
+    bench_common.log("chaos drill PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

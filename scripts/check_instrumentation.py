@@ -34,7 +34,17 @@ Three rule families:
    future resolution (``.set_result(...)`` / ``.set_error(...)``) inside
    a function that never ``activate(...)``-restores a context is
    rejected — a handoff that drops the ``TraceContext`` severs the
-   request's trace at that seam.
+   request's trace at that seam;
+6. same files: no silent exception swallows — a bare ``except:`` is
+   rejected outright, and an ``except Exception``/``except
+   BaseException`` handler (including inside a tuple) whose body neither
+   re-``raise``s nor accounts for the error (an ``.inc(...)`` on an
+   error counter, a ``.set_error(...)`` delivering it to a waiter, or an
+   HTTP ``_reply(...)`` that the status counters see) is rejected: in a
+   self-healing serving tier an error that is swallowed without a
+   counter increment is an outage the dashboards cannot see. Handlers
+   for specific exception types (``except ValueError: return default``)
+   are fine — they are classification, not swallowing.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -300,6 +310,61 @@ def check_trace_handoffs(path: str):
     yield from visit(tree, None)
 
 
+# Calls that count as "accounting for" a swallowed exception in rule 6:
+# incrementing an error counter, delivering the error to the waiting
+# request, or replying over HTTP (every _reply status is counted).
+_ACCOUNTING_CALLS = frozenset({"inc", "set_error", "_reply"})
+_BROAD_EXCEPTIONS = frozenset({"Exception", "BaseException"})
+
+
+def _exception_names(node):
+    """The exception names an ``except`` clause catches (handles bare
+    names, dotted names, and tuples)."""
+    if node is None:
+        return []
+    nodes = node.elts if isinstance(node, ast.Tuple) else [node]
+    names = []
+    for n in nodes:
+        if isinstance(n, ast.Name):
+            names.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.append(n.attr)
+    return names
+
+
+def _handler_accounts(handler: ast.ExceptHandler) -> bool:
+    """Whether the handler body re-raises or accounts for the error."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in _ACCOUNTING_CALLS:
+                return True
+    return False
+
+
+def check_exception_hygiene(path: str):
+    """Rule 6: yield (lineno, description) for silent exception swallows
+    in one serve/ module."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            yield (node.lineno,
+                   "bare except: (name the exceptions, re-raise, or "
+                   "count the error)")
+            continue
+        caught = _exception_names(node.type)
+        if not any(name in _BROAD_EXCEPTIONS for name in caught):
+            continue
+        if not _handler_accounts(node):
+            yield (node.lineno,
+                   f"except {'/'.join(caught)} swallow without an error-"
+                   "counter .inc(), .set_error(), _reply(), or re-raise")
+
+
 def main() -> int:
     files = sorted(glob.glob(PARALLEL_GLOB))
     if not files:
@@ -346,6 +411,8 @@ def main() -> int:
             offenders.append(f"{rel}:{lineno} {why}")
         for lineno, why in check_trace_handoffs(path):
             offenders.append(f"{rel}:{lineno} {why}")
+        for lineno, why in check_exception_hygiene(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -358,7 +425,7 @@ def main() -> int:
         f"{len(serving_files)} models/spark module(s) all instrumented; "
         f"{len(serve_files)} serve/ module(s) clean (no raw jit, no "
         f"transform bypasses, all queue/thread handoffs carry their "
-        f"TraceContext)"
+        f"TraceContext, no silent exception swallows)"
     )
     return 0
 

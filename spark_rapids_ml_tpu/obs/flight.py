@@ -92,6 +92,28 @@ def _safe(fn, default=None):
         return default
 
 
+# Pluggable dump sections: subsystems outside obs/ (e.g. the serving
+# tier's circuit breakers) register a callable whose result is embedded
+# in every dump, right after the in-flight trace table — without flight
+# having to import them (no obs → serve layering inversion). Section
+# functions must be cheap and must never block on the thing being
+# diagnosed.
+_dump_sections: Dict[str, Any] = {}
+_dump_sections_lock = threading.Lock()
+
+
+def register_dump_section(name: str, fn) -> None:
+    """Embed ``fn()``'s result in every future dump under ``name``
+    (idempotent — re-registering replaces)."""
+    with _dump_sections_lock:
+        _dump_sections[name] = fn
+
+
+def unregister_dump_section(name: str) -> None:
+    with _dump_sections_lock:
+        _dump_sections.pop(name, None)
+
+
 def build_dump(reason: str, extra: Optional[Dict[str, Any]] = None
                ) -> Dict[str, Any]:
     """The dump document (separated from I/O so tests can inspect it)."""
@@ -110,6 +132,14 @@ def build_dump(reason: str, extra: Optional[Dict[str, Any]] = None
         # names WHICH requests (trace ids, models, elapsed) were on the
         # device when the process wedged, not just which threads.
         "active_traces": _safe(_active_traces, []),
+    }
+    # Registered sections land right here, next to the trace table
+    # (breaker events, and whatever future subsystems plug in).
+    with _dump_sections_lock:
+        sections = list(_dump_sections.items())
+    for name, fn in sections:
+        doc[name] = _safe(fn)
+    doc.update({
         "span_ring_tail": _safe(
             lambda: [
                 {"name": e.name, "dur_us": e.dur_us,
@@ -133,7 +163,7 @@ def build_dump(reason: str, extra: Optional[Dict[str, Any]] = None
             if k.startswith(("JAX_", "XLA_", "TPU", "SPARK_RAPIDS_ML_TPU_",
                              "TPUML_"))
         },
-    }
+    })
     if extra:
         doc["extra"] = extra
     return doc
@@ -199,13 +229,15 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None
 
 
 class _Armed:
-    __slots__ = ("label", "deadline", "info", "fired")
+    __slots__ = ("label", "deadline", "info", "fired", "on_expire")
 
-    def __init__(self, label: str, deadline: float, info: Dict[str, Any]):
+    def __init__(self, label: str, deadline: float, info: Dict[str, Any],
+                 on_expire=None):
         self.label = label
         self.deadline = deadline
         self.info = info
         self.fired = False
+        self.on_expire = on_expire
 
 
 class Watchdog:
@@ -227,12 +259,19 @@ class Watchdog:
             self._thread.start()
 
     def arm(self, label: str, budget_seconds: float,
-            info: Optional[Dict[str, Any]] = None) -> int:
+            info: Optional[Dict[str, Any]] = None,
+            on_expire=None) -> int:
+        """Arm one deadline. ``on_expire`` (optional) runs on the
+        watchdog thread when the budget blows, BEFORE the dump — the
+        hook the serving tier uses to fail a wedged worker's requests
+        fast. It must be quick, non-blocking, and is exception-guarded
+        (a broken callback never kills the watchdog)."""
         with self._cond:
             handle = self._next_id
             self._next_id += 1
             self._armed[handle] = _Armed(
-                label, time.monotonic() + budget_seconds, dict(info or {})
+                label, time.monotonic() + budget_seconds, dict(info or {}),
+                on_expire=on_expire,
             )
             self._ensure_thread()
             self._cond.notify()
@@ -256,6 +295,8 @@ class Watchdog:
                 wait = (max(min(pending) - now, self._poll_floor)
                         if pending else None)
             for a in expired:
+                if a.on_expire is not None:
+                    _safe(a.on_expire)
                 dump(
                     f"budget_exceeded:{a.label}",
                     extra={

@@ -193,14 +193,20 @@ class SLO:
                n: int = 1, now: Optional[float] = None) -> None:
         self._counts.record(self.is_good(ok, latency_seconds), n=n, now=now)
 
-    def burn_rate(self, window_seconds: float,
-                  now: Optional[float] = None) -> float:
-        """Error-rate over the window ÷ error budget (0.0 with no
-        traffic: an idle service burns nothing)."""
-        good, total = self._counts.counts(window_seconds, now=now)
+    def _rate(self, good: float, total: float) -> float:
+        """Error-rate ÷ error budget from window counts (0.0 with no
+        traffic: an idle service burns nothing). THE burn arithmetic —
+        ``burn_rate`` and ``SloSet.fast_burn_rate`` both go through it,
+        so the breaker trip signal can never diverge from alerting."""
         if total <= 0:
             return 0.0
         return ((total - good) / total) / self.error_budget
+
+    def burn_rate(self, window_seconds: float,
+                  now: Optional[float] = None) -> float:
+        """Error-rate over the window ÷ error budget."""
+        good, total = self._counts.counts(window_seconds, now=now)
+        return self._rate(good, total)
 
     def burn_rates(self, now: Optional[float] = None
                    ) -> Dict[str, float]:
@@ -292,6 +298,29 @@ class SloSet:
         for slo in self.slos:
             alerts.extend(slo.firing(now=now))
         return alerts
+
+    def fast_burn_rate(self, window_seconds: float = 300.0,
+                       min_total: float = 20.0,
+                       now: Optional[float] = None) -> float:
+        """The worst short-window burn rate across the set — the
+        serving tier's circuit-breaker trip signal.
+
+        ``min_total`` guards the low-traffic pathology: with 2 requests
+        in the window, one failure reads as burn 500 and a naive trip
+        wire would open the breaker on a single blip. Below the floor
+        this reports 0.0 (the consecutive-failure threshold still
+        protects low-traffic models)."""
+        worst = 0.0
+        for slo in self.slos:
+            t = slo.clock() if now is None else now
+            good, total = slo._counts.counts(window_seconds, now=t)
+            if total < min_total:
+                continue
+            # one window scan per SLO (this runs on the failure path,
+            # during exactly the bursts it exists for); the arithmetic
+            # is burn_rate's own, shared via _rate
+            worst = max(worst, slo._rate(good, total))
+        return worst
 
     def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = self.clock() if now is None else now

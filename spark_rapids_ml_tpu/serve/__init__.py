@@ -1,43 +1,77 @@
 """The serving engine: model registry, shape-bucketed dynamic batching,
-admission control, and a stdlib HTTP front end.
+admission control, a stdlib HTTP front end — and the fault-tolerance
+layer that keeps it answering when the device backend does not.
 
 The transform path PR 3 instrumented becomes an actual inference engine:
 
 * ``ModelRegistry`` (``serve.registry``) — register / alias / version
   fitted models, load from disk via ``io.persistence``, warm up each
   model's transform at its shape buckets so deploys precompile instead of
-  the first user paying XLA lowering+compile;
+  the first user paying XLA lowering+compile; with a ``manifest_path``
+  the registry persists its deployment state and **recovers it after a
+  process crash** (reload + optional re-warm);
 * ``MicroBatcher`` (``serve.batching``) — coalesce concurrent requests,
   pad to power-of-two row buckets (``utils.padding.pad_to_bucket``), run
   ONE compiled program per bucket, split results per request — padded
-  rows never leak;
+  rows never leak; a **supervised worker**: crashes restart, wedges are
+  watchdog-detected, and affected requests fail fast with
+  ``WorkerCrashed`` instead of hanging to deadline;
 * ``ServeEngine`` (``serve.engine``) — the front door: bounded queues
   with ``QueueFull`` rejection, per-request deadlines shed before device
-  time, graceful drain on shutdown;
+  time, graceful drain on shutdown; **bounded retries** with exponential
+  backoff + jitter for transient backend failures, a per-model
+  **circuit breaker** (``serve.breaker``), and a **degraded CPU
+  fallback** path (``serve.fallback``) so an open breaker answers
+  slowly instead of 5xx-ing;
+* ``fault_plane`` (``serve.faults``) — the injectable chaos plane that
+  proves all of the above: deterministic per-model raise / stall / NaN /
+  latency / worker-crash injection, via env or API;
 * ``start_serve_server`` (``serve.server``) — ``POST /predict`` /
   ``GET /healthz`` / ``GET /metrics`` plus the ops surface
   (``/debug/traces``, ``/debug/slo``, ``/dashboard``) over
   ``http.server``, no new dependencies.
 
 Every stage emits through ``obs``: queue-depth / occupancy /
-padding-waste gauges, stage latencies in quantile sketches, and each
-engine batch still produces a full ``TransformReport`` because the model
-call goes through the ``@observed_transform`` entry point. Every request
-additionally carries a ``TraceContext`` (``obs.tracectx``) across the
-queue/batch seams — W3C ``traceparent`` in/out, fan-in batch spans
-linking member traces, trace-id exemplars on the latency sketches — and
-feeds the engine's SLO burn-rate engine (``obs.slo``).
+padding-waste gauges, stage latencies in quantile sketches, breaker
+state / retry / degraded-mode counters, and each engine batch still
+produces a full ``TransformReport`` because the model call goes through
+the ``@observed_transform`` entry point. Every request additionally
+carries a ``TraceContext`` (``obs.tracectx``) across the queue/batch
+seams and feeds the engine's SLO burn-rate engine (``obs.slo``) — whose
+fast-burn signal can trip the breaker.
 """
 
+# Import order matters: ``faults`` (and ``breaker``/``fallback``) have no
+# intra-package dependencies and must initialize before ``batching`` /
+# ``engine``, which import them as modules of this partially-initialized
+# package.
+from spark_rapids_ml_tpu.serve.faults import (  # noqa: F401
+    FaultPlane,
+    FaultSpec,
+    InjectedBackendError,
+    InjectedWorkerCrash,
+    fault_plane,
+    reset_fault_plane,
+)
+from spark_rapids_ml_tpu.serve.breaker import (  # noqa: F401
+    BreakerOpen,
+    CircuitBreaker,
+    breaker_events,
+)
+from spark_rapids_ml_tpu.serve.fallback import cpu_fallback  # noqa: F401
 from spark_rapids_ml_tpu.serve.batching import (  # noqa: F401
     BatcherClosed,
     DeadlineExpired,
     MicroBatcher,
     QueueFull,
+    WaitTimeout,
+    WorkerCrashed,
 )
 from spark_rapids_ml_tpu.serve.engine import (  # noqa: F401
     ENV_PREFIX,
     EngineClosed,
+    NumericsError,
+    PredictResult,
     ServeEngine,
     extract_output,
 )
@@ -52,15 +86,29 @@ from spark_rapids_ml_tpu.serve.server import (  # noqa: F401
 
 __all__ = [
     "BatcherClosed",
+    "BreakerOpen",
+    "CircuitBreaker",
     "DeadlineExpired",
     "ENV_PREFIX",
     "EngineClosed",
+    "FaultPlane",
+    "FaultSpec",
+    "InjectedBackendError",
+    "InjectedWorkerCrash",
     "MicroBatcher",
     "ModelRegistry",
+    "NumericsError",
+    "PredictResult",
     "QueueFull",
     "RegisteredModel",
     "ServeEngine",
+    "WaitTimeout",
+    "WorkerCrashed",
+    "breaker_events",
+    "cpu_fallback",
     "extract_output",
+    "fault_plane",
     "make_handler",
+    "reset_fault_plane",
     "start_serve_server",
 ]

@@ -34,6 +34,27 @@ each member's tree), and resolves every response latch with the member's
 context re-activated, so shed/error/result resolution attributes to the
 right trace. Rule 5 of ``scripts/check_instrumentation.py`` statically
 enforces this capture/activate contract on every handoff in ``serve/``.
+
+Worker supervision (the r04 lesson — a wedged device tunnel must not
+take the whole batcher down with it):
+
+* a worker that **crashes** (an exception escaping the batch path — the
+  fault plane's ``crash_worker`` injects exactly this) has its in-flight
+  batch failed fast with ``WorkerCrashed`` and is **restarted** by its
+  supervisor (``sparkml_serve_worker_restarts_total``); once the restart
+  budget (``max_restarts``) is exhausted the batcher is marked dead and
+  every queued + future request fails fast instead of hanging to its
+  deadline;
+* a worker that **wedges** (one transform exceeding ``worker_budget_s``
+  — the ``obs.flight`` watchdog budget) is detected by an armed
+  watchdog deadline whose ``on_expire`` hook fails the wedged batch's
+  requests with ``WorkerCrashed``, abandons the stuck thread
+  (generation-guarded: its late result can never resolve an
+  already-failed latch), spawns a replacement worker, and still
+  produces the usual ``budget_exceeded`` flight dump;
+* ``close()`` ends with a final sweep: whatever the worker did not
+  serve (it crashed, wedged, or the join timed out) is failed — every
+  request gets exactly one terminal outcome, never a silent hang.
 """
 
 from __future__ import annotations
@@ -45,8 +66,12 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import get_registry, span, tracectx
+from spark_rapids_ml_tpu.obs import flight, get_registry, span, tracectx
 from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.serve.faults import (
+    InjectedWorkerCrash,
+    fault_plane,
+)
 from spark_rapids_ml_tpu.utils.padding import (
     bucket_for,
     default_buckets,
@@ -68,6 +93,22 @@ class DeadlineExpired(RuntimeError):
 
 class BatcherClosed(RuntimeError):
     """The batcher is draining/closed and accepts no new requests."""
+
+
+class WaitTimeout(TimeoutError):
+    """The caller's ``wait`` timeout elapsed before the batcher resolved
+    the request. Congestion, not a device verdict: the engine neither
+    retries it (the original request is still queued — a re-submit would
+    duplicate device work and multiply the caller's timeout) nor feeds
+    it to the breaker."""
+
+
+class WorkerCrashed(RuntimeError):
+    """The batcher's worker thread died or wedged past its watchdog
+    budget; the request is failed FAST (distinct from ``DeadlineExpired``
+    — the service broke, the client did nothing wrong) and counted in
+    ``sparkml_serve_errors_total{error="worker_crashed"}``. Retryable:
+    a supervised restart usually restores service immediately."""
 
 
 class _Request:
@@ -96,19 +137,28 @@ class _Request:
         return (self.deadline is not None
                 and (now or time.monotonic()) >= self.deadline)
 
-    def set_result(self, value: np.ndarray) -> None:
+    def set_result(self, value: np.ndarray) -> bool:
+        """First writer wins: a wedged worker's LATE result must never
+        overwrite the ``WorkerCrashed`` the watchdog already delivered
+        (exactly one terminal outcome per request)."""
+        if self._event.is_set():
+            return False
         self.result = value
         self._event.set()
+        return True
 
-    def set_error(self, exc: BaseException) -> None:
+    def set_error(self, exc: BaseException) -> bool:
+        if self._event.is_set():
+            return False
         self.error = exc
         self._event.set()
+        return True
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until served; raises the request's error if it was shed
         or its batch failed."""
         if not self._event.wait(timeout):
-            raise TimeoutError("request not served within wait timeout")
+            raise WaitTimeout("request not served within wait timeout")
         if self.error is not None:
             raise self.error
         return self.result
@@ -120,6 +170,13 @@ class MicroBatcher:
     ``transform_fn`` receives the PADDED (bucket, d) float matrix and must
     return a row-aligned array-like (bucket rows, or at least the real
     rows) — the batcher slices off padding and splits per request.
+
+    ``output_check`` (optional) runs over the REAL rows only — after the
+    padding slice, before the per-request split. Zero-padding rows can
+    legitimately map to NaN/Inf under log/reciprocal kernels, so a guard
+    that scanned the padded output would poison healthy batches; this
+    hook sees exactly what callers will receive. A raise here fails the
+    whole batch (same propagation as a transform failure).
     """
 
     def __init__(
@@ -131,14 +188,30 @@ class MicroBatcher:
         max_wait_ms: float = 5.0,
         max_queue_depth: int = 256,
         buckets: Optional[Sequence[int]] = None,
+        worker_budget_s: Optional[float] = None,
+        max_restarts: Optional[int] = None,
+        output_check: Optional[Callable[[np.ndarray], None]] = None,
     ):
         if max_batch_rows < 1:
             raise ValueError("max_batch_rows must be >= 1")
         self.transform_fn = transform_fn
+        self.output_check = output_check
         self.name = name
         self.max_batch_rows = int(max_batch_rows)
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.max_queue_depth = int(max_queue_depth)
+        # Worker supervision knobs: one transform exceeding the budget
+        # declares the worker wedged (None → the flight recorder's
+        # transform budget; <= 0 / inf disables wedge detection);
+        # max_restarts bounds crash/wedge recoveries (None = unlimited).
+        if worker_budget_s is None:
+            self.worker_budget_s = flight.transform_budget_seconds()
+        elif worker_budget_s <= 0:
+            self.worker_budget_s = float("inf")
+        else:
+            self.worker_budget_s = float(worker_budget_s)
+        self.max_restarts = (None if max_restarts is None
+                             else int(max_restarts))
         if buckets:
             self.buckets: Tuple[int, ...] = tuple(
                 sorted(int(b) for b in buckets))
@@ -152,14 +225,13 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
+        self._crashed = False
+        self._generation = 1
+        self._restarts = 0
+        self._inflight_batch: Optional[List[_Request]] = None
+        self._restart_pause_s = 0.02  # crash-storm brake
         self._declare_metrics()
-        # fresh=True: the worker outlives the request whose call created
-        # this batcher — it must not inherit that request's context.
-        self._worker = tracectx.traced_thread(
-            self._run, name=f"sparkml-serve-{name}", daemon=True,
-            fresh=True,
-        )
-        self._worker.start()
+        self._worker = self._spawn_worker()
 
     def _declare_metrics(self) -> None:
         """Create this model's serving series up front (a dashboard should
@@ -223,6 +295,18 @@ class MicroBatcher:
             "per-stage serving latency (queue wait, batch execute)",
             ("model", "stage"),
         )
+        self._m_errors = reg.counter(
+            "sparkml_serve_errors_total",
+            "serving errors by type: batch failures (exception class), "
+            "worker crashes/wedges, breaker rejections", ("model", "error"),
+        )
+        self._m_errors.inc(0, model=self.name, error="worker_crashed")
+        self._m_restarts = reg.counter(
+            "sparkml_serve_worker_restarts_total",
+            "batcher worker restarts after a crash or watchdog-declared "
+            "wedge", ("model",),
+        )
+        self._m_restarts.inc(0, model=self.name)
 
     # -- submission --------------------------------------------------------
 
@@ -257,6 +341,16 @@ class MicroBatcher:
         with self._not_empty:
             if self._closed:
                 raise BatcherClosed(f"batcher {self.name!r} is closed")
+            if self._crashed or not self._worker.is_alive():
+                # Fail FAST: a request accepted into a dead batcher's
+                # queue would hang until its deadline (or forever).
+                self._crashed = True
+                self._m_requests.inc(model=self.name, outcome="error")
+                self._m_errors.inc(model=self.name, error="worker_crashed")
+                raise WorkerCrashed(
+                    f"{self.name}: batcher worker is dead (restart "
+                    "budget exhausted) — evict and re-create the batcher"
+                )
             if len(self._queue) >= self.max_queue_depth:
                 self._m_requests.inc(model=self.name, outcome="rejected")
                 self._m_rejected.inc(model=self.name)
@@ -273,12 +367,28 @@ class MicroBatcher:
         with self._lock:
             return len(self._queue)
 
+    def dead(self) -> bool:
+        """Restart budget exhausted (or the worker died with none left):
+        every submit fails fast. The engine replaces a dead batcher with
+        a fresh one on the next request for its model — otherwise the
+        breaker's half-open probe could never reach the device again."""
+        with self._lock:
+            return self._crashed
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop accepting; with ``drain`` the worker serves what's already
         queued, otherwise queued requests are failed with
-        ``BatcherClosed``. Idempotent."""
+        ``BatcherClosed``. Idempotent.
+
+        Ends with a sweep-under-the-lock: anything still queued after
+        the worker joined (it crashed, wedged, or the join timed out —
+        the eviction race that used to drop error propagation) is failed
+        with ``BatcherClosed``, and a batch still IN FLIGHT on a worker
+        that outlived the join (wedged with wedge detection disabled) is
+        failed with ``WorkerCrashed`` — no request ever hangs to its
+        wait timeout."""
         with self._not_empty:
             self._closed = True
             if not drain:
@@ -292,6 +402,33 @@ class MicroBatcher:
                 self._record_depth()
             self._not_empty.notify_all()
         self._worker.join(timeout=timeout)
+        with self._not_empty:
+            leftovers = []
+            while self._queue:
+                leftovers.append(self._queue.popleft())
+            if leftovers:
+                self._record_depth()
+            stuck = None
+            if self._worker.is_alive() and self._inflight_batch is not None:
+                # join timed out with a batch on the wedged worker:
+                # retire the generation (its late result is discarded)
+                # and fail the batch instead of leaving it to hang.
+                stuck = self._inflight_batch
+                self._inflight_batch = None
+                self._generation += 1
+        if stuck:
+            self._fail_requests(stuck, WorkerCrashed(
+                f"{self.name}: batcher closed while its worker was stuck "
+                "in a transform; in-flight requests failed fast"
+            ))
+        if leftovers:
+            self._fail_requests(
+                leftovers,
+                BatcherClosed(
+                    f"batcher {self.name!r} shut down before serving "
+                    "queued requests"),
+                error_label="batcher_closed",
+            )
 
     # -- the worker --------------------------------------------------------
 
@@ -332,11 +469,115 @@ class MicroBatcher:
             **args,
         )
 
-    def _run(self) -> None:
+    def _spawn_worker(self) -> threading.Thread:
+        """Start a worker for the CURRENT generation. fresh=True: the
+        worker outlives the request whose call created this batcher —
+        it must not inherit that request's context."""
+        gen = self._generation
+        worker = tracectx.traced_thread(
+            self._supervise, name=f"sparkml-serve-{self.name}-g{gen}",
+            daemon=True, fresh=True, kwargs={"gen": gen},
+        )
+        worker.start()
+        return worker
+
+    def _supervise(self, gen: int) -> None:
+        """The worker thread's entry point: a crash escaping the serve
+        loop fails the in-flight batch fast and hands off to a
+        replacement worker (a fresh thread) instead of dying silently."""
+        try:
+            self._run(gen)
+        except BaseException as exc:  # noqa: BLE001 - supervised
+            self._m_errors.inc(model=self.name, error="worker_crashed")
+            self._on_worker_crash(exc, gen)
+
+    def _on_worker_crash(self, exc: BaseException, gen: int) -> None:
+        """Fail the crashed generation's in-flight batch fast, then
+        either hand off to a replacement worker or mark the batcher
+        dead (restart budget exhausted — queued requests fail too)."""
+        with self._not_empty:
+            if gen != self._generation:
+                return  # the wedge handler already took over
+            batch = self._inflight_batch
+            self._inflight_batch = None
+            self._generation += 1
+            can_restart = not self._closed and (
+                self.max_restarts is None
+                or self._restarts < self.max_restarts
+            )
+            to_fail = list(batch or ())
+            if not can_restart:
+                self._crashed = True
+                while self._queue:
+                    to_fail.append(self._queue.popleft())
+                self._record_depth()
+                self._not_empty.notify_all()
+        self._fail_requests(to_fail, WorkerCrashed(
+            f"{self.name}: batcher worker crashed "
+            f"({type(exc).__name__}: {exc}); in-flight requests failed fast"
+        ))
+        if can_restart:
+            time.sleep(self._restart_pause_s)
+            with self._not_empty:
+                if not self._closed:
+                    self._restarts += 1
+                    self._worker = self._spawn_worker()
+                    self._m_restarts.inc(model=self.name)
+
+    def _declare_wedged(self, gen: int, batch: List[_Request]) -> None:
+        """Watchdog ``on_expire`` hook (runs on the watchdog thread): the
+        worker has been inside ONE transform past ``worker_budget_s``.
+        Fail the wedged batch fast, abandon the stuck thread (its
+        generation is retired — a late result cannot resolve anything),
+        and spawn a replacement so the queue keeps draining."""
+        with self._not_empty:
+            if gen != self._generation or self._inflight_batch is not batch:
+                return  # resolved (or already handled) in the meantime
+            self._inflight_batch = None
+            self._generation += 1
+            can_restart = not self._closed and (
+                self.max_restarts is None
+                or self._restarts < self.max_restarts
+            )
+            to_fail = list(batch)
+            if can_restart:
+                self._restarts += 1
+                self._worker = self._spawn_worker()
+            else:
+                self._crashed = True
+                while self._queue:
+                    to_fail.append(self._queue.popleft())
+                self._record_depth()
+                self._not_empty.notify_all()
+        self._fail_requests(to_fail, WorkerCrashed(
+            f"{self.name}: batcher worker wedged — one transform exceeded "
+            f"the {self.worker_budget_s:g}s watchdog budget; in-flight "
+            "requests failed fast"
+        ))
+        if can_restart:
+            self._m_restarts.inc(model=self.name)
+
+    def _fail_requests(self, requests: List[_Request],
+                       exc: BaseException,
+                       error_label: str = "worker_crashed") -> None:
+        for req in requests:
+            with tracectx.activate(req.trace_ctx):
+                req.set_error(exc)
+        if requests:
+            self._m_requests.inc(len(requests), model=self.name,
+                                 outcome="error")
+            self._m_errors.inc(len(requests), model=self.name,
+                               error=error_label)
+
+    def _run(self, gen: int) -> None:
         while True:
             with self._not_empty:
+                if gen != self._generation:
+                    return  # abandoned after a wedge; a replacement runs
                 while not self._queue and not self._closed:
                     self._not_empty.wait(timeout=0.1)
+                    if gen != self._generation:
+                        return
                 first = self._pop_live()
                 if first is None:
                     if self._closed:
@@ -365,12 +606,24 @@ class MicroBatcher:
                     batch.append(nxt)
                     rows += nxt.n
                 self._record_depth()
+                # From here the batch is "in flight": a crash or wedge
+                # handler fails exactly these requests, nothing else.
+                self._inflight_batch = batch
+            spec = fault_plane().worker_fault(self.name)
+            if spec is not None:
+                raise InjectedWorkerCrash(
+                    f"injected worker crash on {self.name!r}"
+                )
             try:
-                self._execute(batch)
-            except BaseException:  # noqa: BLE001 - worker must survive
-                pass  # _execute already errored the batch's requests
+                self._execute(batch, gen)
+            except Exception as exc:  # noqa: BLE001 - batch-level failure
+                # _execute already delivered this error to every member;
+                # the worker survives it. Count it so failing batches are
+                # visible as an error series, not silence (rule 6).
+                self._m_errors.inc(model=self.name,
+                                   error=type(exc).__name__)
 
-    def _execute(self, batch: List[_Request]) -> None:
+    def _execute(self, batch: List[_Request], gen: int) -> None:
         now = time.monotonic()
         stage = self._m_stage
         for req in batch:
@@ -392,13 +645,28 @@ class MicroBatcher:
         try:
             padded, n = pad_to_bucket(matrix, self.buckets)
             bucket = int(padded.shape[0])
+            # Wedge watchdog: the budget expiring fails THIS batch fast
+            # (on_expire) and dumps a flight artifact — the r04 20-hour
+            # silent hang becomes a sub-budget WorkerCrashed plus a dump.
+            handle = None
+            if self.worker_budget_s and self.worker_budget_s != float("inf"):
+                handle = flight.get_watchdog().arm(
+                    f"serve_worker:{self.name}", self.worker_budget_s,
+                    info={"model": self.name, "requests": len(batch),
+                          "rows": n},
+                    on_expire=lambda: self._declare_wedged(gen, batch),
+                )
             t0 = time.monotonic()
-            with tracectx.activate(batch_ctx), span(
-                f"serve:batch:{self.name}",
-                trace_id=batch_ctx.trace_id, links=tuple(member_ids),
-                requests=len(batch), rows=n, bucket=bucket,
-            ):
-                out = np.asarray(self.transform_fn(padded))
+            try:
+                with tracectx.activate(batch_ctx), span(
+                    f"serve:batch:{self.name}",
+                    trace_id=batch_ctx.trace_id, links=tuple(member_ids),
+                    requests=len(batch), rows=n, bucket=bucket,
+                ):
+                    out = np.asarray(self.transform_fn(padded))
+            finally:
+                if handle is not None:
+                    flight.get_watchdog().disarm(handle)
             stage.observe(time.monotonic() - t0,
                           trace_id=batch_ctx.trace_id,
                           model=self.name, stage="execute")
@@ -408,13 +676,32 @@ class MicroBatcher:
                     f"for a batch of {n}"
                 )
             out = out[:n]  # padding never leaks into any response
+            if self.output_check is not None:
+                self.output_check(out)
         except BaseException as exc:  # noqa: BLE001
+            with self._not_empty:
+                stale = (gen != self._generation
+                         or self._inflight_batch is not batch)
+                if not stale:
+                    self._inflight_batch = None
+            if stale:
+                return  # the wedge handler already failed these requests
             for req in batch:
                 with tracectx.activate(req.trace_ctx):
                     req.set_error(exc)
             self._m_requests.inc(len(batch), model=self.name,
                                  outcome="error")
             raise
+        with self._not_empty:
+            stale = (gen != self._generation
+                     or self._inflight_batch is not batch)
+            if not stale:
+                self._inflight_batch = None
+        if stale:
+            # The watchdog declared this batch wedged (and failed it)
+            # while the transform was still running; the late result is
+            # discarded — first writer won.
+            return
         offset = 0
         for req in batch:
             # resolve under the member's own context: anything recorded

@@ -1,4 +1,5 @@
-"""The serving front door: admission control, deadlines, graceful drain.
+"""The serving front door: admission control, deadlines, graceful drain —
+and the self-healing layer: retries, circuit breakers, degraded mode.
 
 ``ServeEngine`` ties the registry and the per-model micro-batchers into
 one synchronous ``predict(model_ref, rows)`` call a thread pool (or the
@@ -12,6 +13,23 @@ stdlib HTTP server in ``serve.server``) can hammer:
   stamps a monotonic deadline on the request; one that expires while
   queued is shed with ``DeadlineExpired`` *before* wasting device time,
   counted in ``sparkml_serve_deadline_expired_total``;
+* **bounded retry with backoff** — a transient backend failure (an
+  injected/real device error, a crashed worker, a NaN-guard trip) is
+  retried up to ``retries`` times with exponential backoff + jitter;
+  retries re-enter the batcher **under the same deadline and trace
+  context** and appear as ``serve:retry`` child spans in the request's
+  tree (``sparkml_serve_retries_total``);
+* **per-model circuit breaker** (``serve.breaker``) — consecutive
+  backend failures (or the SLO fast-burn signal from ``obs.slo``) open
+  the breaker: requests stop touching the device until a half-open
+  probe proves recovery;
+* **degraded CPU fallback** (``serve.fallback``) — while a model's
+  breaker is open, models with a row-independent host equivalent are
+  served from the CPU path: numerics-sentinel-checked, traced under
+  ``serve:degraded`` spans, counted in ``sparkml_serve_degraded_total``
+  and tagged ``degraded=true`` in responses — the service answers
+  slowly instead of 5xx-ing. Models without a fallback shed fast with
+  ``BreakerOpen``;
 * **graceful drain** — ``shutdown()`` stops admissions and serves (or
   fails, with ``drain=False``) everything already queued before
   returning.
@@ -19,17 +37,16 @@ stdlib HTTP server in ``serve.server``) can hammer:
 Model calls go through the model's own ``transform`` entry point, which
 is decorated with ``@observed_transform`` — so every engine batch yields
 a ``TransformReport``, feeds the latency sketches, and passes the
-numerics sentinel exactly like a direct call. The engine adds the serving
-layer's own series on top (queue depth, occupancy, padding waste,
-request outcomes, end-to-end latency).
+numerics sentinel exactly like a direct call. On top, the engine's **NaN
+guard** turns a corrupted batch output into a hard ``NumericsError``
+(retryable, breaker-counted) instead of serving poison.
 
 Tracing and SLOs: every ``predict`` runs under a ``TraceContext``
-(``obs.tracectx`` — the active one, or a freshly minted root so direct
-callers trace too), registers in the in-flight table flight dumps embed,
+(``obs.tracectx``), registers in the in-flight table flight dumps embed,
 captures its context into the batcher queue (rule 5), and records its
-outcome + latency into the engine's ``SloSet`` (``obs.slo``) — burn
-rates, budget remaining, and firing multi-window alerts are live at
-``engine.slo_snapshot()`` / ``GET /debug/slo``.
+outcome + latency into the engine's ``SloSet`` (``obs.slo``). The
+fault-injection plane (``serve.faults``) hooks the coalesced transform
+call, so every behavior above is rehearsable on demand.
 
 Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``, constructor args win):
 
@@ -38,30 +55,53 @@ Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``, constructor args win):
 * ``..._MAX_QUEUE_DEPTH`` (default 256)  — admission bound, requests;
 * ``..._DEADLINE_MS``     (default 0 = none) — default request deadline;
 * ``..._BUCKETS``         (e.g. ``"64,256,1024"``) — explicit row-bucket
-  ladder; unset = powers of two up to the row cap.
+  ladder; unset = powers of two up to the row cap;
+* ``..._RETRIES``         (default 2)    — retry budget per request;
+* ``..._BACKOFF_MS``      (default 25)   — base backoff (doubles per
+  attempt, with jitter, capped by the request deadline);
+* ``..._BREAKER_FAILURES``     (default 5)    — consecutive backend
+  failures that open a model's breaker;
+* ``..._BREAKER_COOLDOWN_MS``  (default 5000) — open → half-open probe
+  cooldown;
+* ``..._BREAKER_BURN``         (default 14.4) — SLO fast-burn rate that
+  opens the breaker (0 disables the burn trip wire);
+* ``..._NAN_GUARD``       (default 1)    — fail batches whose REAL
+  output rows carry NaN/Inf (zero-padding rows are exempt; 0 disables —
+  for models whose contract emits NaN);
+* ``..._WORKER_BUDGET_MS`` (default 0 → the flight recorder's transform
+  budget) — one transform exceeding it declares the worker wedged;
+* ``..._WORKER_RESTARTS`` (default -1 = unlimited) — worker restart
+  budget before the batcher is declared dead.
 
-SLO objectives come from ``SPARK_RAPIDS_ML_TPU_SLO_*`` (see ``obs.slo``):
-availability / latency targets, latency threshold, budget window.
+SLO objectives come from ``SPARK_RAPIDS_ML_TPU_SLO_*`` (see ``obs.slo``).
 """
 
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
 from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.serving import check_output_numerics
 from spark_rapids_ml_tpu.obs.slo import SloSet, default_slos
+from spark_rapids_ml_tpu.serve import breaker as breaker_mod
+from spark_rapids_ml_tpu.serve import faults as faults_mod
 from spark_rapids_ml_tpu.serve.batching import (
     BatcherClosed,
     DeadlineExpired,
     MicroBatcher,
     QueueFull,
+    WaitTimeout,
+    WorkerCrashed,
 )
+from spark_rapids_ml_tpu.serve.breaker import BreakerOpen, CircuitBreaker
+from spark_rapids_ml_tpu.serve.fallback import cpu_fallback
 from spark_rapids_ml_tpu.serve.registry import ModelRegistry, RegisteredModel
 
 ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
@@ -70,6 +110,13 @@ ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
 class EngineClosed(RuntimeError):
     """The engine is shut down (or shutting down) and accepts no new
     requests."""
+
+
+class NumericsError(RuntimeError):
+    """A transform output failed the engine's NaN guard (or a degraded
+    fallback produced non-finite values) — serving poison is an error,
+    not a result. Retryable and breaker-counted: NaN corruption from a
+    sick device is a backend fault."""
 
 
 def _env_number(name: str, default: float) -> float:
@@ -114,7 +161,7 @@ def extract_output(model, result) -> np.ndarray:
                 continue
             try:
                 name = fn()
-            except Exception:
+            except (TypeError, ValueError, AttributeError, KeyError):
                 continue
             if name in columns:
                 return np.asarray(column(name))
@@ -122,6 +169,48 @@ def extract_output(model, result) -> np.ndarray:
         f"cannot extract a serving output from {type(result).__name__} "
         f"for {type(model).__name__}"
     )
+
+
+# Exception shapes that mean "the device backend failed", as opposed to
+# a client error or an orderly rejection: these feed the breaker and the
+# retry loop. Real backend stacks raise XlaRuntimeError/Unavailable
+# (matched by name — jax may not be importable here); the fault plane's
+# InjectedBackendError and the worker-supervision WorkerCrashed are the
+# rehearsal equivalents.
+_HARD_BACKEND_ERRORS = (OSError, ConnectionError, TimeoutError,
+                        MemoryError, SystemError)
+
+
+def is_backend_error(exc: BaseException) -> bool:
+    if isinstance(exc, WaitTimeout):
+        # the caller's wait elapsed; congestion, not a device verdict
+        # (and the request is STILL queued — retrying would duplicate it)
+        return False
+    if isinstance(exc, (faults_mod.InjectedBackendError, NumericsError,
+                        WorkerCrashed)):
+        return True
+    if isinstance(exc, _HARD_BACKEND_ERRORS):
+        return True
+    name = type(exc).__name__
+    return "XlaRuntimeError" in name or "Unavailable" in name
+
+
+class PredictResult:
+    """One served request: the outputs plus how they were produced
+    (``degraded`` CPU fallback? how many ``retries``?) — what the HTTP
+    layer stamps into the response payload."""
+
+    __slots__ = ("outputs", "model", "version", "degraded", "retries",
+                 "trace_id")
+
+    def __init__(self, outputs: np.ndarray, model: str, version: int,
+                 degraded: bool, retries: int, trace_id: str):
+        self.outputs = outputs
+        self.model = model
+        self.version = version
+        self.degraded = degraded
+        self.retries = retries
+        self.trace_id = trace_id
 
 
 class ServeEngine:
@@ -137,6 +226,15 @@ class ServeEngine:
         default_deadline_ms: Optional[float] = None,
         buckets: Optional[Sequence[int]] = None,
         slo: Optional[SloSet] = None,
+        retries: Optional[int] = None,
+        backoff_ms: Optional[float] = None,
+        breaker_failures: Optional[int] = None,
+        breaker_cooldown_ms: Optional[float] = None,
+        breaker_burn_threshold: Optional[float] = None,
+        nan_guard: Optional[bool] = None,
+        worker_budget_ms: Optional[float] = None,
+        max_worker_restarts: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
         self.max_batch_rows = int(
@@ -157,15 +255,69 @@ class ServeEngine:
         )
         self.buckets = tuple(buckets) if buckets else _env_buckets()
         self.slo = slo if slo is not None else default_slos()
+        self.retries = int(
+            retries if retries is not None else _env_number("RETRIES", 2)
+        )
+        self.backoff_ms = float(
+            backoff_ms if backoff_ms is not None
+            else _env_number("BACKOFF_MS", 25.0)
+        )
+        self.breaker_failures = int(
+            breaker_failures if breaker_failures is not None
+            else _env_number("BREAKER_FAILURES", 5)
+        )
+        self.breaker_cooldown_ms = float(
+            breaker_cooldown_ms if breaker_cooldown_ms is not None
+            else _env_number("BREAKER_COOLDOWN_MS", 5000.0)
+        )
+        self.breaker_burn_threshold = float(
+            breaker_burn_threshold if breaker_burn_threshold is not None
+            else _env_number("BREAKER_BURN", 14.4)
+        )
+        self.nan_guard = bool(
+            nan_guard if nan_guard is not None
+            else _env_number("NAN_GUARD", 1.0) > 0
+        )
+        budget_ms = (worker_budget_ms if worker_budget_ms is not None
+                     else _env_number("WORKER_BUDGET_MS", 0.0))
+        # 0 → None → the batcher falls back to the flight recorder's
+        # transform budget (the same default the decorator watchdog uses).
+        self.worker_budget_s: Optional[float] = (
+            budget_ms / 1000.0 if budget_ms and budget_ms > 0 else None
+        )
+        if max_worker_restarts is None:
+            env_restarts = _env_number("WORKER_RESTARTS", -1.0)
+            max_worker_restarts = (None if env_restarts < 0
+                                   else int(env_restarts))
+        self.max_worker_restarts = max_worker_restarts
+        self._clock = clock
         self._batchers: Dict[Tuple[str, int], MicroBatcher] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._fallbacks: Dict[Tuple[str, int], Any] = {}
         self._lock = threading.Lock()
         self._closed = False
-        # hot-path metric handle, resolved once (same convention as
+        # hot-path metric handles, resolved once (same convention as
         # MicroBatcher._declare_metrics)
-        self._m_latency = get_registry().summary(
+        reg = get_registry()
+        self._m_latency = reg.summary(
             "sparkml_serve_request_latency_seconds",
             "end-to-end serving request latency (admit → split)",
             ("model",),
+        )
+        self._m_retries = reg.counter(
+            "sparkml_serve_retries_total",
+            "predict attempts re-entered after a transient backend "
+            "failure", ("model",),
+        )
+        self._m_degraded = reg.counter(
+            "sparkml_serve_degraded_total",
+            "requests served by the degraded CPU fallback while the "
+            "model's breaker was open", ("model",),
+        )
+        self._m_errors = reg.counter(
+            "sparkml_serve_errors_total",
+            "serving errors by type: batch failures (exception class), "
+            "worker crashes/wedges, breaker rejections", ("model", "error"),
         )
 
     # -- the request path --------------------------------------------------
@@ -181,18 +333,43 @@ class ServeEngine:
     ) -> np.ndarray:
         """Serve one request: resolve, admit, coalesce, return its rows.
 
+        The thin wrapper over ``predict_detailed`` (same raises); callers
+        that need the degraded/retry metadata use that directly.
+        """
+        return self.predict_detailed(
+            model_ref, rows, deadline_ms=deadline_ms, version=version,
+            timeout=timeout,
+        ).outputs
+
+    def predict_detailed(
+        self,
+        model_ref: str,
+        rows,
+        *,
+        deadline_ms: Optional[float] = None,
+        version: Optional[int] = None,
+        timeout: Optional[float] = 120.0,
+    ) -> PredictResult:
+        """Serve one request with full fault handling.
+
         Runs under the active ``TraceContext`` (or mints a root one), so
         the request is followable across the queue/batch handoffs and
         appears in the flight recorder's in-flight table. Raises
         ``KeyError`` (unknown model), ``QueueFull`` (admission),
-        ``DeadlineExpired`` (shed while queued), ``EngineClosed``.
+        ``DeadlineExpired`` (shed while queued), ``WorkerCrashed``
+        (batcher worker dead — fast, never hangs to deadline),
+        ``BreakerOpen`` (breaker open, no fallback), ``EngineClosed``.
         """
         if self._closed:
             raise EngineClosed("serving engine is shut down")
         t0 = time.perf_counter()
         entry = self.registry.resolve_entry(model_ref, version)
+        brk = self._breaker_for(entry.name)
         ctx = tracectx.ensure_context()
-        submitted = False
+        # submitted[0] flips once a batcher accepted the request: a
+        # ValueError BEFORE that is the client's (bad shape), AFTER it is
+        # the batch execution failing — the outage the SLO layer sees.
+        submitted = [False]
         try:
             with tracectx.activate(ctx), tracectx.inflight_request(
                 ctx, model=entry.name, version=entry.version,
@@ -208,15 +385,19 @@ class ServeEngine:
                     sampled=ctx.sampled,
                     baggage=ctx.baggage,
                 )
-                batcher = self._batcher_for(entry)
                 budget_ms = (deadline_ms if deadline_ms is not None
                              else self.default_deadline_ms)
                 deadline = (time.monotonic() + budget_ms / 1000.0
                             if budget_ms and budget_ms > 0 else None)
-                req = batcher.submit(rows, deadline=deadline,
-                                     trace_ctx=handoff)
-                submitted = True
-                out = req.wait(timeout)
+                gate = brk.allow()
+                if gate == "open":
+                    out = self._degraded_predict(entry, rows, ctx)
+                    degraded, retries = True, 0
+                else:
+                    out, retries, degraded = self._attempts(
+                        entry, rows, deadline, handoff, timeout,
+                        brk, gate, ctx, submitted,
+                    )
         except BaseException as exc:
             # Client errors (unknown model, a bad request shape rejected
             # AT submit) never spend the service's error budget — but a
@@ -224,47 +405,271 @@ class ServeEngine:
             # failing (e.g. the model returned too few rows), which is
             # exactly the outage the SLO layer exists to see.
             client_error = isinstance(exc, KeyError) or (
-                isinstance(exc, ValueError) and not submitted
+                isinstance(exc, ValueError) and not submitted[0]
             )
             if not client_error:
                 self.slo.record_request(False, time.perf_counter() - t0)
+                # The SLO fast-burn trip wire: sustained backend-failure
+                # bursts open the breaker even when they are not
+                # consecutive. Only device-side failures feed it — a
+                # QueueFull/DeadlineExpired overload burst still burns
+                # the SLO budget above, but must not open (or, via the
+                # breaker's own BreakerOpen sheds saturating the window,
+                # re-open) a breaker guarding a healthy device.
+                if is_backend_error(exc) and brk.burn_threshold > 0:
+                    brk.note_burn(self.slo.fast_burn_rate())
             raise
         elapsed = time.perf_counter() - t0
         self.slo.record_request(True, elapsed)
         self._m_latency.observe(elapsed, trace_id=ctx.trace_id,
                                 model=entry.name)
+        return PredictResult(
+            outputs=out, model=entry.name, version=entry.version,
+            degraded=degraded, retries=retries, trace_id=ctx.trace_id,
+        )
+
+    # -- the retry / breaker / degraded machinery --------------------------
+
+    def _attempts(
+        self,
+        entry: RegisteredModel,
+        rows,
+        deadline: Optional[float],
+        handoff: tracectx.TraceContext,
+        timeout: Optional[float],
+        brk: CircuitBreaker,
+        gate: str,
+        ctx: tracectx.TraceContext,
+        submitted: List[bool],
+    ) -> Tuple[np.ndarray, int, bool]:
+        """The bounded-retry loop: (outputs, retries_used, degraded)."""
+        probe = gate == "probe"
+        max_attempts = 1 + max(self.retries, 0)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                if attempt == 1:
+                    out = self._one_attempt(entry, rows, deadline, handoff,
+                                            timeout, submitted,
+                                            revive=probe)
+                else:
+                    # Retries are child spans of the SAME request trace:
+                    # the tree shows every re-entry, not a flat mystery.
+                    with spans_mod.span(
+                        f"serve:retry:{entry.name}", trace_id=ctx.trace_id,
+                        model=entry.name, attempt=attempt - 1,
+                    ):
+                        out = self._one_attempt(entry, rows, deadline,
+                                                handoff, timeout, submitted)
+            except BaseException as exc:  # noqa: BLE001 - classified below
+                if isinstance(exc, (QueueFull, DeadlineExpired, KeyError,
+                                    EngineClosed, WaitTimeout)):
+                    # Orderly rejections / client errors: no breaker
+                    # verdict (the device was never consulted).
+                    if probe:
+                        brk.release_probe()
+                    raise
+                if isinstance(exc, ValueError) and not submitted[0]:
+                    if probe:
+                        brk.release_probe()
+                    raise
+                backend = is_backend_error(exc)
+                if backend:
+                    brk.record_failure(probe=probe,
+                                       error=type(exc).__name__)
+                elif probe:
+                    brk.release_probe()
+                probe = False
+                # The moment the breaker is open, stop touching the
+                # device — remaining retries would just hammer a dead
+                # backend through an open breaker. With a fallback the
+                # request degrades (including the one whose failure
+                # opened it: an answer, not a 5xx); without one, its own
+                # backend error propagates now (skipping the doomed
+                # retries) and the NEXT request sheds at the gate.
+                if brk.state == breaker_mod.OPEN:
+                    if self._fallback_for(entry) is not None:
+                        return (self._degraded_predict(entry, rows, ctx),
+                                attempt - 1, True)
+                    raise
+                retryable = backend or isinstance(exc, BatcherClosed)
+                if retryable and attempt < max_attempts:
+                    delay = self._backoff_delay(attempt)
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise  # same deadline governs every attempt
+                        delay = min(delay, max(remaining - 0.001, 0.0))
+                    self._m_retries.inc(model=entry.name)
+                    if delay > 0:
+                        time.sleep(delay)
+                    continue
+                raise
+            else:
+                brk.record_success(probe=probe)
+                return out, attempt - 1, False
+
+    def _one_attempt(self, entry, rows, deadline, handoff, timeout,
+                     submitted: List[bool],
+                     revive: bool = False) -> np.ndarray:
+        batcher = self._batcher_for(entry, revive=revive)
+        req = batcher.submit(rows, deadline=deadline, trace_ctx=handoff)
+        submitted[0] = True
+        return req.wait(timeout)
+
+    def _backoff_delay(self, failed_attempt: int) -> float:
+        """Exponential backoff with jitter: base · 2^(attempt-1), scaled
+        by a random factor in [0.5, 1.0] (decorrelates retry storms)."""
+        base = max(self.backoff_ms, 0.0) / 1000.0
+        return base * (2 ** (failed_attempt - 1)) * (
+            0.5 + 0.5 * random.random()
+        )
+
+    def _degraded_predict(self, entry: RegisteredModel, rows,
+                          ctx: tracectx.TraceContext) -> np.ndarray:
+        """Serve one request from the CPU fallback (breaker open)."""
+        fb = self._fallback_for(entry)
+        if fb is None:
+            self._m_errors.inc(model=entry.name, error="breaker_open")
+            raise BreakerOpen(
+                f"{entry.name}: circuit breaker open and the model has no "
+                "CPU fallback — shedding fast (retry after the cooldown)"
+            )
+        with spans_mod.span(
+            f"serve:degraded:{entry.name}", trace_id=ctx.trace_id,
+            model=entry.name, degraded=True,
+        ):
+            # fb validates/coerces the raw rows itself (fallback.as_rows
+            # — the one shared request-shape contract for this path).
+            out = np.asarray(fb(rows))
+        # The degraded path answers AROUND the instrumented transform, so
+        # it runs the numerics sentinel itself: a fallback emitting NaN
+        # is an outage, not a fallback.
+        verdict = check_output_numerics(out)
+        if verdict and (verdict["nan_rows"] or verdict["inf_rows"]):
+            self._m_errors.inc(model=entry.name, error="degraded_numerics")
+            raise NumericsError(
+                f"{entry.name}: degraded CPU fallback produced "
+                f"{verdict['nan_rows']} NaN / {verdict['inf_rows']} Inf "
+                "rows"
+            )
+        self._m_degraded.inc(model=entry.name)
         return out
 
-    # -- batcher plumbing --------------------------------------------------
+    # -- batcher / breaker / fallback plumbing -----------------------------
 
-    def _batcher_for(self, entry: RegisteredModel) -> MicroBatcher:
+    def _make_transform_fn(self, entry: RegisteredModel):
+        """The batcher's transform callable: fault-plane hook → the
+        model's observed entry point."""
+        model = entry.model
+        name = entry.name
+
+        def transform(matrix: np.ndarray) -> np.ndarray:
+            # resolve the plane per call (like batching._run): a batcher
+            # outliving reset_fault_plane() must consult the LIVE plane,
+            # or later-armed faults silently never fire on this model
+            spec = faults_mod.fault_plane().begin_call(name)
+            if spec is not None:
+                faults_mod.apply_pre(spec)
+            out = np.asarray(extract_output(model, model.transform(matrix)))
+            if spec is not None and spec.kind == "nan":
+                out = faults_mod.corrupt(spec, out)
+            return out
+
+        return transform
+
+    def _make_output_check(self, entry: RegisteredModel):
+        """The NaN guard, as the batcher's post-slice ``output_check``:
+        it must see only the REAL rows — zero-padding rows can map to
+        NaN/Inf under log/reciprocal kernels, and a guard over the
+        padded output would fail every off-bucket batch of a healthy
+        model."""
+        if not self.nan_guard:
+            return None
+        name = entry.name
+
+        def check(out: np.ndarray) -> None:
+            if (np.issubdtype(out.dtype, np.floating)
+                    and not np.all(np.isfinite(out))):
+                raise NumericsError(
+                    f"{name}: transform output contains NaN/Inf (NaN "
+                    "guard; disable with "
+                    "SPARK_RAPIDS_ML_TPU_SERVE_NAN_GUARD=0)"
+                )
+
+        return check
+
+    def _batcher_for(self, entry: RegisteredModel,
+                     revive: bool = False) -> MicroBatcher:
         key = (entry.name, entry.version)
+        corpse: Optional[MicroBatcher] = None
         with self._lock:
             if self._closed:
                 raise EngineClosed("serving engine is shut down")
             batcher = self._batchers.get(key)
+            if batcher is not None and batcher.dead() and revive:
+                # A dead batcher (restart budget exhausted) fails
+                # submits fast — the satellite contract — but the
+                # breaker's half-open PROBE must be able to reach the
+                # device again, or the model could never recover: the
+                # probe would fail without a device verdict and re-open
+                # the breaker forever. Probes therefore revive the
+                # batcher with a fresh worker; probe cadence (the
+                # breaker cooldown) is what bounds recreate storms, so
+                # max_restarts keeps meaning "stop restarting under
+                # sustained crashing".
+                corpse = self._batchers.pop(key)
+                batcher = None
             if batcher is None:
-                model = entry.model
                 buckets = self.buckets or entry.buckets
                 batcher = MicroBatcher(
-                    lambda matrix: extract_output(
-                        model, model.transform(matrix)
-                    ),
+                    self._make_transform_fn(entry),
                     name=entry.name,
                     max_batch_rows=self.max_batch_rows,
                     max_wait_ms=self.max_wait_ms,
                     max_queue_depth=self.max_queue_depth,
                     buckets=buckets,
+                    worker_budget_s=self.worker_budget_s,
+                    max_restarts=self.max_worker_restarts,
+                    output_check=self._make_output_check(entry),
                 )
                 self._batchers[key] = batcher
+                # flat-0 series for the engine-level counters too
+                self._m_retries.inc(0, model=entry.name)
+                self._m_degraded.inc(0, model=entry.name)
             stale = self._stale_keys(entry.name)
         # Outside the lock: retire batchers for versions the registry no
         # longer knows (deregistered after a rollover) — otherwise every
         # rolled version leaks a worker thread and pins its model forever.
         # ``key`` itself just resolved, so it is never in the stale set.
+        if corpse is not None:
+            # worker already dead — the close is just the final sweep
+            corpse.close(drain=False, timeout=0.1)
         for k in stale:
             self.evict(*k)
         return batcher
+
+    def _breaker_for(self, name: str) -> CircuitBreaker:
+        with self._lock:
+            brk = self._breakers.get(name)
+            if brk is None:
+                brk = CircuitBreaker(
+                    name,
+                    failure_threshold=self.breaker_failures,
+                    cooldown_seconds=self.breaker_cooldown_ms / 1000.0,
+                    burn_threshold=self.breaker_burn_threshold,
+                    clock=self._clock,
+                )
+                self._breakers[name] = brk
+            return brk
+
+    def _fallback_for(self, entry: RegisteredModel):
+        key = (entry.name, entry.version)
+        with self._lock:
+            if key not in self._fallbacks:
+                self._fallbacks[key] = cpu_fallback(entry.model)
+            return self._fallbacks[key]
 
     def _stale_keys(self, name: str):
         """Batcher keys for ``name`` whose version the registry has
@@ -284,9 +689,12 @@ class ServeEngine:
         """Close and drop one (name, version) batcher — call after
         ``registry.deregister`` (or rely on the automatic sweep the next
         time a new version's batcher is created). Returns whether a
-        batcher existed."""
+        batcher existed. The batcher's ``close`` ends with a sweep under
+        its own lock, so requests racing the eviction still get exactly
+        one terminal outcome."""
         with self._lock:
             batcher = self._batchers.pop((name, version), None)
+            self._fallbacks.pop((name, version), None)
         if batcher is None:
             return False
         batcher.close(drain=drain)
@@ -331,7 +739,15 @@ class ServeEngine:
                 }
                 for (name, version), b in batchers.items()
             },
+            "breakers": self.breaker_snapshot(),
         }
+
+    def breaker_snapshot(self) -> Dict[str, Any]:
+        """Per-model breaker state: the ``GET /debug/slo`` section and
+        the dashboard's breaker table."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {name: b.snapshot() for name, b in breakers.items()}
 
     def slo_snapshot(self) -> Dict[str, Any]:
         """Evaluate the engine's SLOs now: burn rates per window, budget
@@ -365,11 +781,17 @@ class ServeEngine:
 
 __all__ = [
     "BatcherClosed",
+    "BreakerOpen",
     "DeadlineExpired",
     "EngineClosed",
     "ENV_PREFIX",
     "MicroBatcher",
+    "NumericsError",
+    "PredictResult",
     "QueueFull",
     "ServeEngine",
+    "WaitTimeout",
+    "WorkerCrashed",
     "extract_output",
+    "is_backend_error",
 ]

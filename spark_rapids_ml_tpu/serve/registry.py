@@ -14,13 +14,26 @@ deploy hits a warm XLA cache instead of paying lowering+compile on the
 serving path (the recompile-storm cliff ``obs/xprof.py`` detects, paid
 once at deploy time instead).
 
+Crash recovery: with a ``manifest_path`` (or
+``SPARK_RAPIDS_ML_TPU_SERVE_MANIFEST``) the registry persists its
+deployment state — names, versions, aliases, bucket ladders, source
+paths — to one atomically-written JSON manifest after every mutation,
+and on startup **reloads the last persisted manifest**: every version
+with a ``source_path`` is re-loaded from disk at its ORIGINAL version
+number (pinned aliases keep meaning something), aliases are restored,
+and ``recover(warm=True)`` re-warms the shape buckets. A process crash
+no longer loses the deployment state; only in-process-registered models
+(no ``source_path``) cannot be recovered and are reported as skipped.
+
 Everything observable rides the existing ``obs`` stack: registered-model
-gauge, load/warmup counters, warmup seconds per bucket in the returned
-report and the metrics registry.
+gauge, load/warmup/recovery counters, warmup seconds per bucket in the
+returned report and the metrics registry.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -30,6 +43,9 @@ import numpy as np
 from spark_rapids_ml_tpu.obs import get_registry, span
 from spark_rapids_ml_tpu.obs.spans import utcnow_iso
 from spark_rapids_ml_tpu.utils.padding import default_buckets
+
+MANIFEST_ENV = "SPARK_RAPIDS_ML_TPU_SERVE_MANIFEST"
+_MANIFEST_VERSION = 1
 
 # Attributes probed (in order) to infer a model's expected feature count
 # for warmup batches when the caller does not pass one.
@@ -71,12 +87,39 @@ class RegisteredModel:
 
 
 class ModelRegistry:
-    """register / alias / version fitted models; resolve by name."""
+    """register / alias / version fitted models; resolve by name.
 
-    def __init__(self):
+    ``manifest_path`` (or ``SPARK_RAPIDS_ML_TPU_SERVE_MANIFEST``) turns
+    on crash recovery: every mutation persists the deployment state, and
+    construction (with ``recover=True``, the default) reloads the last
+    persisted manifest — see ``recover()``. The recovery report lands in
+    ``self.recovery_report_``.
+    """
+
+    def __init__(self, manifest_path: Optional[str] = None, *,
+                 recover: bool = True, warm_on_recover: bool = False):
         self._lock = threading.RLock()
         self._versions: Dict[str, Dict[int, RegisteredModel]] = {}
         self._aliases: Dict[str, Tuple[str, Optional[int]]] = {}
+        # Manifest entries recover() could NOT bring back (transient load
+        # failure, in-process registration): retained so the next
+        # manifest write does not erase them from disk (a later restart
+        # may succeed), and so register() never reuses their version
+        # numbers under a pinned alias. name -> {version -> entry}.
+        self._retained: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        self.manifest_path = (manifest_path
+                              or os.environ.get(MANIFEST_ENV) or None)
+        # Manifest writes happen OUTSIDE self._lock (disk latency must
+        # not stall resolve_entry on the serving path); the sequence
+        # numbers keep racing writers from landing an older doc last.
+        self._io_lock = threading.Lock()
+        self._mutation_seq = 0
+        self._written_seq = 0
+        self._recovering = False
+        self.recovery_report_: Optional[Dict[str, Any]] = None
+        if (recover and self.manifest_path
+                and os.path.exists(self.manifest_path)):
+            self.recovery_report_ = self.recover(warm=warm_on_recover)
 
     # -- registration ------------------------------------------------------
 
@@ -85,24 +128,64 @@ class ModelRegistry:
                  source_path: Optional[str] = None) -> int:
         """Register a fitted model under ``name``; returns the assigned
         version (1 + the previous highest — versions are immutable, a
-        re-register is a new version, never a mutation)."""
+        re-register is a new version, never a mutation). Versions held
+        by unrecovered manifest entries count toward the highest: a slot
+        a pinned alias may still point at is never reassigned to a new
+        model lineage."""
+        with self._lock:
+            version = max(
+                (*self._versions.get(name, ()),
+                 *self._retained.get(name, ())),
+                default=0,
+            ) + 1
+            self._register_entry(name, version, model, buckets=buckets,
+                                 source_path=source_path)
+            pending = self._pending_manifest()
+        self._write_manifest(pending)
+        self._count_registration(name)
+        return version
+
+    def _register_at(self, name: str, version: int, model: Any, *,
+                     buckets: Optional[Sequence[int]] = None,
+                     source_path: Optional[str] = None) -> None:
+        """Register at an EXPLICIT version — what recovery uses so
+        pinned aliases keep pointing at the deployment they meant.
+        Versions stay immutable: an occupied slot raises."""
+        with self._lock:
+            self._register_entry(name, version, model, buckets=buckets,
+                                 source_path=source_path)
+            pending = self._pending_manifest()
+        self._write_manifest(pending)
+        self._count_registration(name)
+
+    def _register_entry(self, name: str, version: int, model: Any, *,
+                        buckets: Optional[Sequence[int]] = None,
+                        source_path: Optional[str] = None) -> None:
+        """Validate and insert one version. Caller holds the lock."""
         if not name or "@" in name:
             raise ValueError(
                 f"invalid model name {name!r} ('@' is the version separator)"
             )
-        with self._lock:
-            versions = self._versions.setdefault(name, {})
-            version = max(versions, default=0) + 1
-            versions[version] = RegisteredModel(
-                name, version, model, buckets=buckets,
-                source_path=source_path,
+        versions = self._versions.setdefault(name, {})
+        if version in versions:
+            raise ValueError(
+                f"version {name!r}@{version} already registered "
+                "(versions are immutable)"
             )
-            self._record_gauge()
+        versions[version] = RegisteredModel(
+            name, version, model, buckets=buckets,
+            source_path=source_path,
+        )
+        # a retried recovery that succeeded reclaims its retained slot
+        self._retained.get(name, {}).pop(version, None)
+        self._record_gauge()
+
+    @staticmethod
+    def _count_registration(name: str) -> None:
         get_registry().counter(
             "sparkml_serve_model_registrations_total",
             "models registered into the serving registry", ("model",),
         ).inc(model=name)
-        return version
 
     def load(self, name: str, path: str, *,
              buckets: Optional[Sequence[int]] = None) -> int:
@@ -128,21 +211,38 @@ class ModelRegistry:
             if version is not None and version not in self._versions[name]:
                 raise KeyError(f"unknown version {name!r}@{version}")
             self._aliases[alias] = (name, version)
+            pending = self._pending_manifest()
+        self._write_manifest(pending)
 
     def deregister(self, name: str, version: Optional[int] = None) -> None:
         """Drop one version (or every version) of ``name``; aliases to it
         dangle and resolve() will raise — deliberate, so a bad rollover is
-        loud rather than silently serving a deleted model."""
+        loud rather than silently serving a deleted model. Also the
+        explicit way to erase a retained (unrecovered) manifest entry —
+        until then it survives every persist for the next restart to
+        retry."""
         with self._lock:
-            if name not in self._versions:
+            live = self._versions.get(name)
+            retained = self._retained.get(name)
+            if live is None and retained is None:
                 raise KeyError(f"unknown model {name!r}")
             if version is None:
-                del self._versions[name]
+                self._versions.pop(name, None)
+                self._retained.pop(name, None)
             else:
-                del self._versions[name][version]
-                if not self._versions[name]:
-                    del self._versions[name]
+                if live is not None and version in live:
+                    del live[version]
+                    if not live:
+                        del self._versions[name]
+                elif retained is not None and version in retained:
+                    del retained[version]
+                    if not retained:
+                        del self._retained[name]
+                else:
+                    raise KeyError(f"unknown version {name!r}@{version}")
             self._record_gauge()
+            pending = self._pending_manifest()
+        self._write_manifest(pending)
 
     # -- resolution --------------------------------------------------------
 
@@ -230,6 +330,189 @@ class ModelRegistry:
             "total_seconds": time.perf_counter() - t_total,
         }
 
+    # -- crash recovery ----------------------------------------------------
+
+    def manifest(self) -> Dict[str, Any]:
+        """The JSON-safe deployment state a crashed process needs back:
+        names → versions (with source paths + buckets) and aliases."""
+        with self._lock:
+            return {
+                "manifest_version": _MANIFEST_VERSION,
+                "saved_utc": utcnow_iso(),
+                "models": self._manifest_models(),
+                "aliases": {
+                    alias: {"name": n, "version": v}
+                    for alias, (n, v) in self._aliases.items()
+                },
+            }
+
+    def _manifest_models(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Live versions merged with retained (unrecovered) manifest
+        entries — a version that failed to load on the last restart
+        stays on disk so a later restart can retry it, instead of being
+        erased by the first post-recovery mutation. Caller holds the
+        lock."""
+        models: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        for name, versions in self._versions.items():
+            models[name] = {
+                v: {
+                    "version": v,
+                    "source_path": versions[v].source_path,
+                    "buckets": (list(versions[v].buckets)
+                                if versions[v].buckets else None),
+                }
+                for v in versions
+            }
+        for name, retained in self._retained.items():
+            slots = models.setdefault(name, {})
+            for v, entry in retained.items():
+                slots.setdefault(v, dict(entry))
+        return {
+            name: [slots[v] for v in sorted(slots)]
+            for name, slots in models.items()
+        }
+
+    def _pending_manifest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """The (sequence, doc) snapshot a mutation wants persisted —
+        built under the lock (consistent state), written by
+        ``_write_manifest`` AFTER the lock is released so disk latency
+        never stalls ``resolve_entry`` on the serving path. None without
+        a manifest_path, and suppressed DURING recovery so a crash
+        mid-recovery cannot overwrite the good manifest with a partial
+        one. Caller holds the lock."""
+        if not self.manifest_path or self._recovering:
+            return None
+        self._mutation_seq += 1
+        return self._mutation_seq, self.manifest()
+
+    def _write_manifest(self,
+                        pending: Optional[Tuple[int, Dict[str, Any]]],
+                        ) -> None:
+        """Write one pending manifest atomically (tmp + rename — a crash
+        mid-write leaves the previous manifest, never half a JSON).
+        Racing mutations serialize on the io lock; a doc older than the
+        last one written is dropped, so the file always holds the newest
+        state."""
+        if pending is None:
+            return
+        seq, doc = pending
+        with self._io_lock:
+            if seq <= self._written_seq:
+                return  # a newer mutation's doc already landed
+            try:
+                tmp = f"{self.manifest_path}.tmp-{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, indent=1)
+                os.replace(tmp, self.manifest_path)
+                self._written_seq = seq
+            except OSError:
+                # Persistence failure must not break serving — but it
+                # must be visible: a registry that silently stopped
+                # checkpointing has silently lost its crash recovery.
+                get_registry().counter(
+                    "sparkml_serve_manifest_errors_total",
+                    "failed registry-manifest writes", (),
+                ).inc()
+
+    def _retain(self, name: str, version: int,
+                entry: Dict[str, Any]) -> None:
+        with self._lock:
+            slot = dict(entry)
+            slot["version"] = int(version)
+            self._retained.setdefault(name, {})[int(version)] = slot
+
+    def recover(self, warm: bool = False) -> Dict[str, Any]:
+        """Reload the last persisted manifest: every version with a
+        ``source_path`` is loaded from disk at its ORIGINAL version
+        number, aliases are restored (dangling ones dropped), and with
+        ``warm=True`` each recovered model is re-warmed at its buckets.
+        Returns a report; never raises — a corrupt manifest or one bad
+        model path degrades to a partial recovery with the failure
+        recorded, not a crashed startup."""
+        report: Dict[str, Any] = {
+            "manifest_path": self.manifest_path,
+            "recovered": [], "skipped": [], "failed": [],
+            "aliases": 0, "warmed": {},
+        }
+        reg = get_registry()
+        m_recovered = reg.counter(
+            "sparkml_serve_recovered_models_total",
+            "model versions re-registered from the persisted manifest "
+            "after a restart", ("model",),
+        )
+        m_skipped = reg.counter(
+            "sparkml_serve_recovery_skipped_total",
+            "manifest entries that could not be recovered (no source "
+            "path, or the load failed)", ("model", "reason"),
+        )
+        try:
+            with open(self.manifest_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            report["error"] = f"{type(exc).__name__}: {exc}"
+            m_skipped.inc(model="(manifest)", reason="unreadable")
+            return report
+        self._recovering = True
+        try:
+            for name, entries in sorted(dict(doc.get("models", {})).items()):
+                for entry in entries:
+                    version = int(entry.get("version", 0))
+                    path = entry.get("source_path")
+                    ref = f"{name}@{version}"
+                    if not path:
+                        # in-process registrations have nothing on disk;
+                        # retain the slot so its version is never reused
+                        report["skipped"].append(ref)
+                        m_skipped.inc(model=name, reason="no_source_path")
+                        self._retain(name, version, entry)
+                        continue
+                    try:
+                        from spark_rapids_ml_tpu.io.persistence import (
+                            load_model,
+                        )
+
+                        with span(f"serve:recover:{name}"):
+                            model = load_model(path)
+                        self._register_at(
+                            name, version, model,
+                            buckets=entry.get("buckets"),
+                            source_path=path,
+                        )
+                    except Exception as exc:  # noqa: BLE001 - per-entry
+                        # one bad path must not sink the whole recovery;
+                        # counted per model so the partial recovery pages.
+                        # Retained: the entry stays in the manifest (the
+                        # next restart retries a transient failure) and
+                        # its version number is never reassigned.
+                        report["failed"].append(
+                            f"{ref}: {type(exc).__name__}: {exc}")
+                        m_skipped.inc(model=name, reason="load_failed")
+                        self._retain(name, version, entry)
+                        continue
+                    report["recovered"].append(ref)
+                    m_recovered.inc(model=name)
+            for alias, target in dict(doc.get("aliases", {})).items():
+                try:
+                    self.alias(alias, target.get("name"),
+                               target.get("version"))
+                except (KeyError, AttributeError, TypeError):
+                    report["failed"].append(f"alias {alias!r}: dangling")
+                    m_skipped.inc(model=str(target), reason="dangling_alias")
+                    continue
+                report["aliases"] += 1
+            if warm:
+                for name in self.names():
+                    try:
+                        report["warmed"][name] = self.warmup(
+                            name)["total_seconds"]
+                    except Exception as exc:  # noqa: BLE001 - per-model
+                        report["failed"].append(
+                            f"warmup {name!r}: {type(exc).__name__}: {exc}")
+                        m_skipped.inc(model=name, reason="warmup_failed")
+        finally:
+            self._recovering = False
+        return report
+
     # -- introspection -----------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
@@ -248,6 +531,7 @@ class ModelRegistry:
         return {
             "models": models,
             "aliases": aliases,
+            "manifest_path": self.manifest_path,
             "metrics": get_registry().snapshot(),
         }
 
@@ -265,6 +549,6 @@ def _infer_features(model) -> Optional[int]:
         if value is not None:
             try:
                 return int(extract(value))
-            except Exception:
+            except (TypeError, ValueError, AttributeError, IndexError):
                 continue
     return None

@@ -6,12 +6,17 @@ web framework to the container:
 
 * ``POST /predict`` — body ``{"model": "name[@version]",
   "rows": [[...], ...], "deadline_ms": 250}`` → ``{"model", "version",
-  "outputs": [...], "trace_id"}``; admission rejection maps to **429**, a
-  shed deadline to **504**, an unknown model to **404**, malformed input
-  to **400**. An inbound W3C ``traceparent`` header continues the
-  caller's trace (Dapper-style propagation via ``obs.tracectx``); every
-  response carries a ``traceparent`` back, and every error path replies
-  with an explicit ``Content-Length``;
+  "outputs": [...], "trace_id", "degraded", "retries"}``; admission
+  rejection maps to **429**, a shed deadline to **504**, an unknown
+  model to **404**, malformed input to **400**, and the fault-tolerance
+  outcomes to **503**: an open breaker with no CPU fallback
+  (``BreakerOpen``) and a dead batcher worker (``WorkerCrashed``) are
+  both retryable service states, not client errors. A request served by
+  the degraded CPU fallback still returns **200** with
+  ``"degraded": true``. An inbound W3C ``traceparent`` header continues
+  the caller's trace (Dapper-style propagation via ``obs.tracectx``);
+  every response carries a ``traceparent`` back, and every error path
+  replies with an explicit ``Content-Length``;
 * ``GET /healthz`` — engine liveness + registered models + queue depth
   (the readiness probe target);
 * ``GET /metrics`` — the process metrics registry as Prometheus text
@@ -20,7 +25,9 @@ web framework to the container:
 * ``GET /debug/traces[?limit=N]`` — recent request traces assembled into
   trees from the span ring (server → queue → fan-in batch → transform);
 * ``GET /debug/slo`` — current burn rates per window, budget remaining,
-  and firing multi-window alerts from the engine's ``SloSet``;
+  firing multi-window alerts from the engine's ``SloSet``, per-model
+  circuit-breaker states, and the fault plane's armed faults (a chaos
+  drill is auditable from the ops surface it is attacking);
 * ``GET /dashboard`` — one self-contained HTML page polling those
   endpoints: the live ops view.
 
@@ -48,8 +55,12 @@ from spark_rapids_ml_tpu.serve.batching import (
     BatcherClosed,
     DeadlineExpired,
     QueueFull,
+    WaitTimeout,
+    WorkerCrashed,
 )
+from spark_rapids_ml_tpu.serve.breaker import BreakerOpen
 from spark_rapids_ml_tpu.serve.engine import EngineClosed, ServeEngine
+from spark_rapids_ml_tpu.serve.faults import fault_plane
 
 _MAX_BODY_BYTES = 64 * 1024 * 1024  # refuse absurd request bodies
 _TRACE_ROOT_PREFIXES = ("serve:http", "serve:request")
@@ -58,6 +69,8 @@ _DEFAULT_TRACE_LIMIT = 20
 
 def _json_safe(outputs: np.ndarray):
     return np.asarray(outputs).tolist()
+
+
 
 
 def make_handler(engine: ServeEngine):
@@ -75,6 +88,24 @@ def make_handler(engine: ServeEngine):
     m_http_requests = reg.counter(
         "sparkml_http_requests_total",
         "HTTP front-end requests by path and status", ("path", "status"),
+    )
+    # /debug/slo totals: family handles summed per poll — an ops
+    # endpoint hit hardest during an outage must not pay for a full
+    # registry snapshot to read three counters.
+    m_degraded = reg.counter(
+        "sparkml_serve_degraded_total",
+        "requests served by the degraded CPU fallback while the "
+        "model's breaker was open", ("model",),
+    )
+    m_retries = reg.counter(
+        "sparkml_serve_retries_total",
+        "predict attempts re-entered after a transient backend "
+        "failure", ("model",),
+    )
+    m_restarts = reg.counter(
+        "sparkml_serve_worker_restarts_total",
+        "batcher worker restarts after a crash or watchdog-declared "
+        "wedge", ("model",),
     )
 
     class _Handler(http.server.BaseHTTPRequestHandler):
@@ -140,6 +171,11 @@ def make_handler(engine: ServeEngine):
                 snap["queue_depth"] = engine.queue_depth()
                 snap["models"] = engine.registry.names()
                 snap["closed"] = engine._closed
+                snap["breakers"] = engine.breaker_snapshot()
+                snap["faults"] = fault_plane().active()
+                snap["degraded_total"] = m_degraded.total()
+                snap["retries_total"] = m_retries.total()
+                snap["worker_restarts_total"] = m_restarts.total()
                 status = self._reply(200, snap)
             elif path == "/dashboard":
                 status = self._reply_text(
@@ -200,7 +236,7 @@ def make_handler(engine: ServeEngine):
                 # the reported version is the one that actually served the
                 # request even if a concurrent register() bumps "latest".
                 entry = engine.registry.resolve_entry(model_ref)
-                outputs = engine.predict(
+                result = engine.predict_detailed(
                     entry.name, rows, version=entry.version,
                     deadline_ms=deadline_ms,
                 )
@@ -212,8 +248,16 @@ def make_handler(engine: ServeEngine):
                 return self._reply(400, {"error": str(exc)}, trace_ctx=ctx)
             except QueueFull as exc:
                 return self._reply(429, {"error": str(exc)}, trace_ctx=ctx)
-            except DeadlineExpired as exc:
+            except (DeadlineExpired, WaitTimeout) as exc:
                 return self._reply(504, {"error": str(exc)}, trace_ctx=ctx)
+            except (BreakerOpen, WorkerCrashed) as exc:
+                # self-healing states: the breaker is shedding for this
+                # model / the worker is being restarted — retryable 503
+                # (and never a hang: both fail fast by construction)
+                return self._reply(503, {
+                    "error": str(exc),
+                    "retryable": True,
+                }, trace_ctx=ctx)
             except (BatcherClosed, EngineClosed) as exc:
                 # both mean "shutting down" — retryable 503, not a 5xx page
                 return self._reply(503, {"error": str(exc)}, trace_ctx=ctx)
@@ -224,8 +268,10 @@ def make_handler(engine: ServeEngine):
             return self._reply(200, {
                 "model": entry.name,
                 "version": entry.version,
-                "outputs": _json_safe(outputs),
+                "outputs": _json_safe(result.outputs),
                 "trace_id": ctx.trace_id,
+                "degraded": result.degraded,
+                "retries": result.retries,
             }, trace_ctx=ctx)
 
         def log_message(self, *args):  # silence per-request stderr noise
@@ -346,6 +392,8 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <table><thead><tr><th>Objective</th><th>Target</th><th>5m</th><th>30m</th>
     <th>1h</th><th>6h</th><th>Budget left</th><th>State</th></tr></thead>
     <tbody id="slo-rows"></tbody></table>
+  <h2>Circuit breakers</h2>
+  <div id="breakers" class="quiet">—</div>
   <h2>Firing alerts</h2>
   <div id="alerts" class="quiet">—</div>
   <h2>Recent traces</h2>
@@ -378,12 +426,22 @@ async function refresh() {
   try {
     var slo = await (await fetch("/debug/slo")).json();
     var health = await (await fetch("/healthz")).json();
+    var breakers = slo.breakers || {};
+    var breakerNames = Object.keys(breakers);
+    var openCount = breakerNames.filter(
+      function (n) { return breakers[n].state !== "closed"; }).length;
     var tiles = [
       tile("Service", statusSpan(
         health.status === "ok" ? "good" : "warning", health.status)),
       tile("Queue depth", health.queue_depth),
       tile("In flight", (health.inflight || []).length),
       tile("Firing alerts", (slo.alerts || []).length),
+      tile("Breakers open", openCount
+        ? statusSpan("critical", "\\u25cf " + openCount)
+        : statusSpan("good", "\\u25cf 0")),
+      tile("Degraded served", slo.degraded_total || 0),
+      tile("Retries", slo.retries_total || 0),
+      tile("Worker restarts", slo.worker_restarts_total || 0),
     ];
     (slo.slos || []).forEach(function (s) {
       tiles.push(tile("Budget left · " + s.name,
@@ -401,6 +459,24 @@ async function refresh() {
           fmtPct(s.budget_remaining) + "</td><td>" +
           statusSpan(st[0], st[1]) + "</td></tr>";
       }).join("");
+    document.getElementById("breakers").innerHTML = breakerNames.length
+      ? "<table><thead><tr><th>Model</th><th>State</th>" +
+        "<th>Consecutive failures</th><th>Opens</th><th>Open for</th>" +
+        "<th>Last error</th></tr></thead><tbody>" +
+        breakerNames.map(function (n) {
+          var b = breakers[n];
+          var cls = b.state === "closed" ? "good"
+            : (b.state === "half_open" ? "warning" : "critical");
+          return "<tr><td class=name>" + n + "</td><td>" +
+            statusSpan(cls, "\\u25cf " + b.state) + "</td><td>" +
+            b.consecutive_failures + " / " + b.failure_threshold +
+            "</td><td>" + b.opens + "</td><td>" +
+            (b.open_for_seconds == null ? "–"
+              : b.open_for_seconds.toFixed(1) + " s") +
+            "</td><td class=name>" + (b.last_error || "–") +
+            "</td></tr>";
+        }).join("") + "</tbody></table>"
+      : "no models served yet";
     var alerts = slo.alerts || [];
     document.getElementById("alerts").innerHTML = alerts.length
       ? "<table><thead><tr><th>SLO</th><th>Severity</th><th>Short</th>" +

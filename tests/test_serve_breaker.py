@@ -1,0 +1,283 @@
+"""Circuit-breaker state machine (fake clock, zero real sleeps), breaker
+observability (gauges, transition counters, flight-dump embedding), and
+the SLO fast-burn trip wire."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import flight, get_registry
+from spark_rapids_ml_tpu.obs.slo import SLO, SloSet
+from spark_rapids_ml_tpu.serve.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerOpen,
+    CircuitBreaker,
+    breaker_events,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+def _breaker(clock, **kw):
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("cooldown_seconds", 10.0)
+    return CircuitBreaker("test_model", clock=clock, **kw)
+
+
+def test_closed_until_consecutive_failures(clock):
+    brk = _breaker(clock)
+    assert brk.state == CLOSED
+    brk.record_failure(error="E1")
+    brk.record_failure(error="E2")
+    assert brk.state == CLOSED
+    # a success in between resets the consecutive count
+    brk.record_success()
+    brk.record_failure(error="E3")
+    brk.record_failure(error="E4")
+    assert brk.state == CLOSED
+    brk.record_failure(error="E5")
+    assert brk.state == OPEN
+    assert brk.snapshot()["last_error"] == "E5"
+
+
+def test_open_rejects_until_cooldown_then_one_probe(clock):
+    brk = _breaker(clock)
+    for _ in range(3):
+        brk.record_failure(error="X")
+    assert brk.allow() == "open"
+    clock.advance(9.9)
+    assert brk.allow() == "open"
+    clock.advance(0.2)  # cooldown elapsed → half-open
+    assert brk.allow() == "probe"
+    # exactly ONE probe: concurrent callers stay on the open path
+    assert brk.allow() == "open"
+    assert brk.state == HALF_OPEN
+
+
+def test_probe_success_closes_probe_failure_reopens(clock):
+    brk = _breaker(clock)
+    for _ in range(3):
+        brk.record_failure(error="X")
+    clock.advance(11)
+    assert brk.allow() == "probe"
+    brk.record_failure(probe=True, error="still down")
+    assert brk.state == OPEN
+    # fresh cooldown after the failed probe
+    clock.advance(5)
+    assert brk.allow() == "open"
+    clock.advance(6)
+    assert brk.allow() == "probe"
+    brk.record_success(probe=True)
+    assert brk.state == CLOSED
+    # ... and a later single failure does not flap it open
+    brk.record_failure(error="blip")
+    assert brk.state == CLOSED
+
+
+def test_release_probe_hands_the_token_back(clock):
+    brk = _breaker(clock)
+    for _ in range(3):
+        brk.record_failure(error="X")
+    clock.advance(11)
+    assert brk.allow() == "probe"
+    assert brk.allow() == "open"
+    brk.release_probe()  # probe shed before reaching the device
+    assert brk.allow() == "probe"
+
+
+def test_burn_threshold_opens_closed_breaker(clock):
+    brk = _breaker(clock, burn_threshold=14.4)
+    brk.note_burn(10.0)
+    assert brk.state == CLOSED
+    brk.note_burn(20.0)
+    assert brk.state == OPEN
+    assert "slo_fast_burn" in (brk.snapshot()["last_error"] or "")
+
+
+def test_burn_threshold_zero_disables(clock):
+    brk = _breaker(clock, burn_threshold=0.0)
+    brk.note_burn(1e9)
+    assert brk.state == CLOSED
+
+
+def test_state_gauge_and_transition_counters(clock):
+    brk = _breaker(clock)
+    for _ in range(3):
+        brk.record_failure(error="X")
+    clock.advance(11)
+    brk.allow()
+    brk.record_success(probe=True)
+
+    snap = get_registry().snapshot()
+    gauge = {
+        s["labels"]["model"]: s["value"]
+        for s in snap["sparkml_serve_breaker_state"]["samples"]
+    }
+    assert gauge["test_model"] == 0.0  # closed again
+    transitions = {
+        s["labels"]["state"]: s["value"]
+        for s in snap["sparkml_serve_breaker_transitions_total"]["samples"]
+        if s["labels"]["model"] == "test_model"
+    }
+    assert transitions["open"] >= 1
+    assert transitions["half_open"] >= 1
+    assert transitions["closed"] >= 1
+
+
+def test_breaker_events_in_flight_dump(clock):
+    brk = _breaker(clock)
+    for _ in range(3):
+        brk.record_failure(error="outage")
+    events = breaker_events()
+    assert any(
+        e["to_state"] == OPEN and e["model"] == "test_model"
+        for e in events
+    )
+    # the flight recorder embeds the section next to active_traces
+    doc = flight.build_dump("test_breaker_dump")
+    assert "breaker_events" in doc
+    assert any(
+        e["to_state"] == OPEN for e in doc["breaker_events"]["events"]
+    )
+    states = {s["model"]: s["state"]
+              for s in doc["breaker_events"]["states"]}
+    assert states.get("test_model") == OPEN
+    keys = list(doc)
+    assert keys.index("breaker_events") == keys.index("active_traces") + 1
+
+
+def test_register_dump_section_is_pluggable():
+    flight.register_dump_section("chaos_probe", lambda: {"armed": 7})
+    try:
+        doc = flight.build_dump("test_sections")
+        assert doc["chaos_probe"] == {"armed": 7}
+        # a broken section never breaks the dump
+        flight.register_dump_section(
+            "broken", lambda: (_ for _ in ()).throw(RuntimeError("no")))
+        doc = flight.build_dump("test_sections2")
+        assert doc["broken"] is None
+    finally:
+        flight.unregister_dump_section("chaos_probe")
+        flight.unregister_dump_section("broken")
+
+
+def test_breaker_open_error_is_runtime_error():
+    assert issubclass(BreakerOpen, RuntimeError)
+
+
+def test_snapshot_shape(clock):
+    brk = _breaker(clock)
+    snap = brk.snapshot()
+    for key in ("model", "state", "consecutive_failures",
+                "failure_threshold", "cooldown_seconds", "opens",
+                "open_for_seconds", "retry_after_seconds", "last_error"):
+        assert key in snap
+    for _ in range(3):
+        brk.record_failure(error="X")
+    snap = brk.snapshot()
+    assert snap["state"] == OPEN
+    assert snap["opens"] == 1
+    assert snap["retry_after_seconds"] == pytest.approx(10.0)
+    clock.advance(4.0)
+    assert brk.snapshot()["retry_after_seconds"] == pytest.approx(6.0)
+    assert brk.snapshot()["open_for_seconds"] == pytest.approx(4.0)
+
+
+def test_slo_fast_burn_rate_min_total_gating():
+    clock = FakeClock()
+    slo = SLO("avail", target=0.999, kind="availability", clock=clock)
+    slos = SloSet([slo], clock=clock)
+    # 2 requests, 1 bad: burn is enormous but the traffic floor gates it
+    slo.record(True)
+    slo.record(False)
+    assert slos.fast_burn_rate(min_total=20) == 0.0
+    assert slos.fast_burn_rate(min_total=0) > 100
+    # at volume, the same failure RATIO reads through
+    for _ in range(30):
+        slo.record(True)
+    for _ in range(10):
+        slo.record(False)
+    rate = slos.fast_burn_rate(min_total=20)
+    assert rate > 14.4  # ~26% errors vs 0.1% budget
+
+
+def test_watchdog_on_expire_callback_fires():
+    fired = []
+    wd = flight.get_watchdog()
+    handle = wd.arm("test_on_expire", 0.05,
+                    on_expire=lambda: fired.append(True))
+    try:
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fired == [True]
+    finally:
+        wd.disarm(handle)
+
+
+def test_watchdog_disarm_before_expiry_suppresses_callback():
+    import time
+
+    fired = []
+    wd = flight.get_watchdog()
+    handle = wd.arm("test_disarmed", 0.3,
+                    on_expire=lambda: fired.append(True))
+    wd.disarm(handle)
+    time.sleep(0.5)
+    assert fired == []
+
+
+def test_degraded_fallback_resolution():
+    from spark_rapids_ml_tpu.serve.fallback import cpu_fallback
+
+    class PcaLike:
+        pc = np.ones((4, 2))
+
+    class KmeansLike:
+        cluster_centers = np.array([[0.0, 0.0], [10.0, 10.0]])
+
+    class Custom:
+        def cpu_transform_(self, x):
+            return np.asarray(x) * 2
+
+    class Opaque:
+        pass
+
+    x = np.array([[1.0, 2.0, 3.0, 4.0]])
+    fb = cpu_fallback(PcaLike())
+    np.testing.assert_array_equal(fb(x), x @ PcaLike.pc)
+    labels = cpu_fallback(KmeansLike())(
+        np.array([[0.1, 0.2], [9.0, 9.5]]))
+    np.testing.assert_array_equal(labels, [0, 1])
+    custom = Custom()
+    assert cpu_fallback(custom)(np.ones((1, 2))).sum() == 4.0
+    assert cpu_fallback(Opaque()) is None
+
+
+def test_kmeans_fallback_matches_model_host_path(rng):
+    from spark_rapids_ml_tpu import KMeans
+    from spark_rapids_ml_tpu.serve.fallback import cpu_fallback
+
+    x = rng.normal(size=(128, 8))
+    model = KMeans().setK(3).fit(x)
+    fb = cpu_fallback(model)
+    direct = np.asarray(model.transform(x[:32]).column(
+        model.getPredictionCol()))
+    np.testing.assert_array_equal(fb(x[:32]), direct)

@@ -1,0 +1,387 @@
+"""Fault-injection plane + the chaos matrix: the real HTTP server under
+each injected fault class (raise / stall / NaN / latency), asserting
+breaker transitions, retry counts, degraded-mode responses, SLO burn
+behavior, and that every request gets exactly one terminal outcome —
+plus the ISSUE 6 acceptance test (100% backend failure on one model →
+breaker opens → bit-checked degraded CPU answers while another model
+serves normally → half-open probe closes the breaker after the fault
+clears)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.serve import (
+    ModelRegistry,
+    ServeEngine,
+    fault_plane,
+    reset_fault_plane,
+    start_serve_server,
+)
+from spark_rapids_ml_tpu.serve.faults import (
+    FaultSpec,
+    InjectedBackendError,
+    parse_fault_specs,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    reset_fault_plane()
+    yield
+    reset_fault_plane()
+
+
+@pytest.fixture(scope="module")
+def fitted_pca():
+    from spark_rapids_ml_tpu import PCA
+
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(512, 16))
+    return PCA().setK(4).fit(x), x
+
+
+def _counter(name, **labels):
+    snap = get_registry().snapshot().get(name, {"samples": []})
+    return sum(
+        s["value"] for s in snap["samples"]
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def _post(base, model, rows, timeout=30.0):
+    """(status, payload) for one HTTP predict; 0 = hung/reset (a chaos
+    suite failure)."""
+    body = json.dumps({"model": model, "rows": rows.tolist()}).encode()
+    req = urllib.request.Request(
+        f"{base}/predict", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+    except Exception as exc:  # noqa: BLE001 - hang IS the test failure
+        return 0, {"error": f"{type(exc).__name__}: {exc}"}
+
+
+# -- the fault plane itself -------------------------------------------------
+
+
+def test_deterministic_count_start_every_targeting():
+    plane = fault_plane()
+    plane.inject("m", "raise", count=2, start=1, every=2)
+    fired = []
+    for i in range(8):
+        spec = plane.begin_call("m")
+        fired.append(spec.kind if spec else None)
+    # fires at call indices 1 and 3 (start=1, every=2, count=2), never again
+    assert fired == [None, "raise", None, "raise", None, None, None, None]
+    assert _counter("sparkml_serve_faults_injected_total",
+                    model="m", kind="raise") >= 2
+
+
+def test_per_model_isolation_and_wildcard():
+    plane = fault_plane()
+    plane.inject("a", "latency", count=1, seconds=0.0)
+    assert plane.begin_call("b") is None   # other model untouched
+    assert plane.begin_call("a").kind == "latency"
+    plane.clear()
+    plane.inject("*", "raise", count=None)
+    assert plane.begin_call("anything").kind == "raise"
+    assert plane.begin_call("else").kind == "raise"
+
+
+def test_clear_resets_counters():
+    plane = fault_plane()
+    plane.inject("m", "raise", count=1, start=2)
+    assert plane.begin_call("m") is None
+    plane.clear()
+    plane.inject("m", "raise", count=1, start=2)
+    assert plane.begin_call("m") is None  # index restarted at 0
+    assert plane.begin_call("m") is None
+    assert plane.begin_call("m").kind == "raise"
+
+
+def test_worker_fault_site_is_separate():
+    plane = fault_plane()
+    plane.inject("m", "crash_worker", count=1)
+    assert plane.begin_call("m") is None       # transform site untouched
+    assert plane.worker_fault("m").kind == "crash_worker"
+    assert plane.worker_fault("m") is None     # count exhausted
+
+
+def test_env_spec_parsing():
+    specs = parse_fault_specs(
+        "pca_embedder:raise:5, *:latency:*:0:0.05 ,m:stall:1:3:2.5")
+    assert [s.kind for s in specs] == ["raise", "latency", "stall"]
+    assert specs[0].count == 5 and specs[0].model == "pca_embedder"
+    assert specs[1].count is None and specs[1].seconds == 0.05
+    assert specs[2].start == 3 and specs[2].seconds == 2.5
+    with pytest.raises(ValueError):
+        parse_fault_specs("just_a_model")
+    with pytest.raises(ValueError):
+        parse_fault_specs("m:not_a_kind")
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SERVE_FAULTS", "m:raise:1")
+    reset_fault_plane()
+    plane = fault_plane()
+    assert plane.active() and plane.active()[0]["kind"] == "raise"
+    spec = plane.begin_call("m")
+    assert isinstance(spec, FaultSpec)
+    with pytest.raises(InjectedBackendError):
+        from spark_rapids_ml_tpu.serve.faults import apply_pre
+
+        apply_pre(spec)
+
+
+# -- the chaos matrix over the real HTTP server -----------------------------
+
+
+def _stack(fitted_pca, **engine_kw):
+    model, x = fitted_pca
+    registry = ModelRegistry()
+    registry.register("pca", model, buckets=(16, 64))
+    kw = dict(max_batch_rows=64, max_wait_ms=1.0, retries=1, backoff_ms=5,
+              breaker_failures=3, breaker_cooldown_ms=250,
+              worker_budget_ms=300)
+    kw.update(engine_kw)
+    engine = ServeEngine(registry, **kw)
+    registry.warmup("pca")
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    return engine, server, base, model, x
+
+
+def test_chaos_raise_over_http(fitted_pca):
+    """100% backend errors: pre-open requests surface 500s, the breaker
+    opens, then traffic degrades to bit-correct CPU answers."""
+    engine, server, base, model, x = _stack(fitted_pca)
+    try:
+        fault_plane().inject("pca", "raise", count=None)
+        outcomes = []
+        for i in range(8):
+            status, payload = _post(base, "pca", x[i:i + 3])
+            outcomes.append((status, payload.get("degraded", False)))
+            assert status != 0, "request hung"
+            if status == 200 and payload["degraded"]:
+                np.testing.assert_array_equal(
+                    np.asarray(payload["outputs"]), x[i:i + 3] @ model.pc)
+        statuses = [s for s, _ in outcomes]
+        assert 200 in statuses and 500 in statuses
+        assert any(d for _, d in outcomes)
+        assert engine.breaker_snapshot()["pca"]["state"] == "open"
+        assert _counter("sparkml_serve_degraded_total", model="pca") > 0
+        assert _counter("sparkml_serve_retries_total", model="pca") > 0
+        # failed requests burned the SLO budget (server errors, not 4xx)
+        assert engine.slo.fast_burn_rate(min_total=1) > 0
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+def test_chaos_stall_over_http(fitted_pca):
+    """A wedged transform: the watchdog fails it fast (well before the
+    stall ends), the worker restarts, and the retry answers."""
+    engine, server, base, model, x = _stack(fitted_pca)
+    try:
+        restarts_before = _counter("sparkml_serve_worker_restarts_total",
+                                   model="pca")
+        fault_plane().inject("pca", "stall", count=1, seconds=2.0)
+        t0 = time.monotonic()
+        status, payload = _post(base, "pca", x[:4])
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert payload["retries"] >= 1          # WorkerCrashed was retried
+        assert elapsed < 1.8                    # failed FAST, not at 2s+
+        np.testing.assert_array_equal(
+            np.asarray(payload["outputs"]),
+            np.asarray(model.transform(x[:4]).column("pca_features")))
+        assert _counter("sparkml_serve_worker_restarts_total",
+                        model="pca") > restarts_before
+        assert _counter("sparkml_serve_errors_total", model="pca",
+                        error="worker_crashed") > 0
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+def test_chaos_nan_over_http(fitted_pca):
+    """Corrupted outputs: the NaN guard turns poison into a retryable
+    error; the retry serves clean data and nobody receives NaN."""
+    engine, server, base, model, x = _stack(fitted_pca)
+    try:
+        fault_plane().inject("pca", "nan", count=1)
+        status, payload = _post(base, "pca", x[:4])
+        assert status == 200
+        assert payload["retries"] >= 1
+        out = np.asarray(payload["outputs"])
+        assert np.all(np.isfinite(out))
+        np.testing.assert_array_equal(
+            out, np.asarray(model.transform(x[:4]).column("pca_features")))
+        assert _counter("sparkml_serve_errors_total", model="pca",
+                        error="NumericsError") > 0
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+def test_chaos_latency_spike_over_http(fitted_pca):
+    """A latency spike is served (slowly) — and lands in the SLO latency
+    objective's burn rather than availability."""
+    engine, server, base, model, x = _stack(fitted_pca)
+    try:
+        fault_plane().inject("pca", "latency", count=None, seconds=0.12)
+        t0 = time.monotonic()
+        status, payload = _post(base, "pca", x[:4])
+        elapsed = time.monotonic() - t0
+        assert status == 200 and not payload["degraded"]
+        assert payload["retries"] == 0
+        assert elapsed >= 0.12
+        assert engine.breaker_snapshot()["pca"]["state"] == "closed"
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+def test_chaos_no_fallback_model_sheds_with_503(fitted_pca):
+    """A model with no CPU fallback: the open breaker sheds fast with a
+    retryable 503 instead of hammering the dead backend."""
+
+    class _NoFallback:
+        def transform(self, matrix):
+            return np.asarray(matrix)[:, :2] * 2.0
+
+    registry = ModelRegistry()
+    registry.register("opaque", _NoFallback(), buckets=(16,))
+    engine = ServeEngine(registry, max_batch_rows=16, max_wait_ms=1.0,
+                         retries=0, breaker_failures=2,
+                         breaker_cooldown_ms=60_000)
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    x = np.ones((3, 4))
+    try:
+        fault_plane().inject("opaque", "raise", count=None)
+        statuses = [_post(base, "opaque", x)[0] for _ in range(5)]
+        assert statuses[:2] == [500, 500]       # pre-open backend errors
+        assert set(statuses[2:]) == {503}       # breaker open → shed fast
+        status, payload = _post(base, "opaque", x)
+        assert status == 503 and payload.get("retryable") is True
+        assert _counter("sparkml_serve_errors_total", model="opaque",
+                        error="breaker_open") > 0
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+# -- the ISSUE 6 acceptance test --------------------------------------------
+
+
+def test_acceptance_breaker_degraded_fallback_and_recovery(fitted_pca):
+    """ISSUE 6 acceptance: 100% backend failures on ONE model → its
+    breaker opens within N requests; its traffic returns degraded CPU
+    results bit-checked against the direct CPU transform while the OTHER
+    model serves normally; after the fault clears a half-open probe
+    closes the breaker — zero hung requests, every outcome visible in
+    the metrics snapshot."""
+    model, x = fitted_pca
+    registry = ModelRegistry()
+    registry.register("pca_a", model, buckets=(16, 64))
+    registry.register("pca_b", model, buckets=(16, 64))
+    engine = ServeEngine(registry, max_batch_rows=64, max_wait_ms=1.0,
+                         retries=1, backoff_ms=5,
+                         breaker_failures=3, breaker_cooldown_ms=250)
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    sent = answered = 0
+    try:
+        fault_plane().inject("pca_a", "raise", count=None)
+
+        # breaker opens within N requests (N = ceil(failures / attempts))
+        open_after = None
+        for i in range(6):
+            sent += 1
+            status, _ = _post(base, "pca_a", x[i:i + 2])
+            assert status != 0, "request hung"
+            answered += 1
+            if engine.breaker_snapshot()["pca_a"]["state"] == "open":
+                open_after = i + 1
+                break
+        assert open_after is not None and open_after <= 3
+
+        # model A: degraded CPU answers, bit-equal to the direct CPU path
+        for i in range(4):
+            sent += 1
+            status, payload = _post(base, "pca_a", x[i:i + 4])
+            assert status != 0, "request hung"
+            answered += 1
+            assert status == 200 and payload["degraded"] is True
+            np.testing.assert_array_equal(
+                np.asarray(payload["outputs"]), x[i:i + 4] @ model.pc)
+
+        # model B: untouched, serves the normal device path
+        for i in range(3):
+            sent += 1
+            status, payload = _post(base, "pca_b", x[i:i + 4])
+            assert status != 0, "request hung"
+            answered += 1
+            assert status == 200 and payload["degraded"] is False
+            np.testing.assert_array_equal(
+                np.asarray(payload["outputs"]),
+                np.asarray(model.transform(x[i:i + 4]).column(
+                    "pca_features")))
+        assert engine.breaker_snapshot()["pca_b"]["state"] == "closed"
+
+        # fault clears → cooldown → the next request is the half-open
+        # probe; it succeeds and CLOSES the breaker
+        fault_plane().clear()
+        time.sleep(0.3)
+        sent += 1
+        status, payload = _post(base, "pca_a", x[:4])
+        answered += 1
+        assert status == 200 and payload["degraded"] is False
+        assert engine.breaker_snapshot()["pca_a"]["state"] == "closed"
+        np.testing.assert_array_equal(
+            np.asarray(payload["outputs"]),
+            np.asarray(model.transform(x[:4]).column("pca_features")))
+
+        # zero hung requests, every outcome terminal
+        assert answered == sent
+
+        # ... and every outcome is visible in the metrics snapshot
+        snap = get_registry().snapshot()
+        assert _counter("sparkml_serve_degraded_total", model="pca_a") >= 4
+        assert _counter("sparkml_serve_faults_injected_total",
+                        model="pca_a", kind="raise") > 0
+        assert _counter("sparkml_serve_errors_total", model="pca_a",
+                        error="InjectedBackendError") > 0
+        transitions = {
+            (s["labels"]["model"], s["labels"]["state"]): s["value"]
+            for s in snap[
+                "sparkml_serve_breaker_transitions_total"]["samples"]
+        }
+        assert transitions[("pca_a", "open")] >= 1
+        assert transitions[("pca_a", "half_open")] >= 1
+        assert transitions[("pca_a", "closed")] >= 1
+        states = {
+            s["labels"]["model"]: s["value"]
+            for s in snap["sparkml_serve_breaker_state"]["samples"]
+        }
+        assert states["pca_a"] == 0.0 and states["pca_b"] == 0.0
+
+        # the ops surface carries the whole story too
+        slo_doc = json.loads(urllib.request.urlopen(
+            f"{base}/debug/slo", timeout=30).read())
+        assert slo_doc["breakers"]["pca_a"]["state"] == "closed"
+        assert slo_doc["degraded_total"] >= 4
+    finally:
+        server.shutdown()
+        engine.shutdown()

@@ -1,0 +1,688 @@
+"""Self-healing plumbing under the HTTP layer: retry/backoff semantics,
+worker crash fail-fast + supervised restart (the ISSUE 6 satellite
+bugfixes), the evict-vs-in-flight race, registry crash recovery from the
+persisted manifest, and the rule-6 exception-hygiene static check."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.serve import (
+    BatcherClosed,
+    BreakerOpen,
+    DeadlineExpired,
+    InjectedBackendError,
+    MicroBatcher,
+    ModelRegistry,
+    NumericsError,
+    ServeEngine,
+    WorkerCrashed,
+    fault_plane,
+    reset_fault_plane,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    reset_fault_plane()
+    yield
+    reset_fault_plane()
+
+
+@pytest.fixture
+def pca_model(rng):
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(256, 16))
+    return PCA().setK(4).fit(x), x
+
+
+def _counter(name, **labels):
+    snap = get_registry().snapshot().get(name, {"samples": []})
+    return sum(
+        s["value"] for s in snap["samples"]
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def _engine(reg, **kw):
+    defaults = dict(max_batch_rows=64, max_wait_ms=1.0, retries=2,
+                    backoff_ms=5, breaker_failures=50,
+                    breaker_cooldown_ms=60_000)
+    defaults.update(kw)
+    return ServeEngine(reg, **defaults)
+
+
+# -- retry / backoff --------------------------------------------------------
+
+
+def test_retry_recovers_from_transient_backend_failures(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16, 64))
+    engine = _engine(reg, retries=2)
+    try:
+        fault_plane().inject("pca", "raise", count=2)
+        before = _counter("sparkml_serve_retries_total", model="pca")
+        result = engine.predict_detailed("pca", x[:4])
+        assert result.retries == 2
+        assert not result.degraded
+        np.testing.assert_array_equal(
+            result.outputs,
+            np.asarray(model.transform(x[:4]).column("pca_features")))
+        assert _counter("sparkml_serve_retries_total",
+                        model="pca") == before + 2
+    finally:
+        engine.shutdown()
+
+
+def test_retry_budget_exhaustion_raises_the_backend_error(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16, 64))
+    engine = _engine(reg, retries=1)
+    try:
+        fault_plane().inject("pca", "raise", count=5)
+        with pytest.raises(InjectedBackendError):
+            engine.predict("pca", x[:4])
+        # failed request burned the SLO budget
+        assert engine.slo.fast_burn_rate(min_total=1) > 0
+    finally:
+        engine.shutdown()
+
+
+def test_retries_respect_the_original_deadline(pca_model):
+    """Retries re-enter under the SAME deadline: with a deadline shorter
+    than the backoff schedule, the request fails when the deadline
+    passes instead of retrying forever."""
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16, 64))
+    engine = _engine(reg, retries=10, backoff_ms=80)
+    try:
+        fault_plane().inject("pca", "raise", count=None)
+        t0 = time.monotonic()
+        with pytest.raises((InjectedBackendError, DeadlineExpired)):
+            engine.predict("pca", x[:4], deadline_ms=150)
+        assert time.monotonic() - t0 < 2.0  # nowhere near 10 backoffs
+    finally:
+        engine.shutdown()
+
+
+def test_open_breaker_stops_remaining_retries(pca_model):
+    """Once a request's own failure opens the breaker, the remaining
+    retries must NOT keep hitting the dead backend: with no fallback the
+    original backend error surfaces immediately, having spent exactly
+    one device call."""
+    _, x = pca_model
+
+    class _NoFallback:
+        def transform(self, matrix):
+            return np.asarray(matrix)[:, :2]
+
+    model = _NoFallback()
+    reg = ModelRegistry()
+    reg.register("opaque", model, buckets=(16,))
+    engine = _engine(reg, max_batch_rows=16, retries=3,
+                     breaker_failures=1)
+    try:
+        spec = fault_plane().inject("opaque", "raise", count=None)
+        with pytest.raises(InjectedBackendError):
+            engine.predict("opaque", x[:4])
+        assert engine.breaker_snapshot()["opaque"]["state"] == "open"
+        # one device call opened the breaker; retries 2..4 never fired
+        assert spec.fired == 1
+    finally:
+        engine.shutdown()
+
+
+def test_nan_guard_ignores_padding_rows(pca_model):
+    """A model whose kernel maps all-zero rows to -inf (log-style) must
+    serve off-bucket batches: the NaN guard checks only the REAL rows,
+    never the zero-padding the bucket added."""
+    _, x = pca_model
+
+    class _ReciprocalModel:
+        def transform(self, matrix):
+            m = np.asarray(matrix)
+            with np.errstate(divide="ignore"):
+                return 1.0 / m[:, :2].sum(axis=1, keepdims=True)
+
+    model = _ReciprocalModel()
+    reg = ModelRegistry()
+    reg.register("recip", model, buckets=(16,))
+    engine = _engine(reg, max_batch_rows=16, retries=0)
+    try:
+        rows = np.abs(x[:5, :4]) + 1.0  # 5 rows → bucket 16: 11 pad rows
+        out = engine.predict_detailed("recip", rows)
+        assert np.all(np.isfinite(out.outputs))
+        assert out.retries == 0 and not out.degraded
+        # the guard still fires when a REAL row is non-finite
+        with pytest.raises(NumericsError):
+            engine.predict("recip", np.zeros((2, 4)))
+    finally:
+        engine.shutdown()
+
+
+def test_overload_failures_do_not_trip_the_breaker(pca_model):
+    """QueueFull/DeadlineExpired sheds burn the SLO budget but must not
+    open the device breaker: only backend-classified failures feed the
+    fast-burn trip wire (a 429 burst is load, not a sick device)."""
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16, 64))
+    engine = _engine(reg, retries=0, breaker_failures=50,
+                     breaker_burn_threshold=1.0)
+    try:
+        # saturate the 5-minute failure window well past the threshold
+        for _ in range(40):
+            engine.slo.record_request(False, 0.01)
+        assert engine.slo.fast_burn_rate() > 1.0
+        # an overload shed against that window: breaker stays closed
+        with pytest.raises(DeadlineExpired):
+            engine.predict("pca", x[:4], deadline_ms=0.0001)
+        assert engine.breaker_snapshot().get("pca", {}).get(
+            "state", "closed") == "closed"
+        # a genuine backend failure against the same window trips it
+        fault_plane().inject("pca", "raise", count=1)
+        with pytest.raises(InjectedBackendError):
+            engine.predict("pca", x[:4])
+        assert engine.breaker_snapshot()["pca"]["state"] == "open"
+    finally:
+        engine.shutdown()
+
+
+def test_backoff_delay_grows_and_jitters(pca_model):
+    model, _ = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model)
+    engine = _engine(reg, backoff_ms=100)
+    try:
+        d1 = [engine._backoff_delay(1) for _ in range(20)]
+        d3 = [engine._backoff_delay(3) for _ in range(20)]
+        assert all(0.05 <= d <= 0.1 for d in d1)
+        assert all(0.2 <= d <= 0.4 for d in d3)
+        assert len(set(d1)) > 1  # jitter decorrelates
+    finally:
+        engine.shutdown()
+
+
+def test_retry_spans_are_children_of_the_request_trace(pca_model):
+    from spark_rapids_ml_tpu.obs import spans as spans_mod
+
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16, 64))
+    engine = _engine(reg, retries=1)
+    try:
+        fault_plane().inject("pca", "raise", count=1)
+        result = engine.predict_detailed("pca", x[:4])
+        assert result.retries == 1
+        tree = spans_mod.assemble_trace(result.trace_id)
+        names = []
+
+        def collect(nodes):
+            for node in nodes:
+                names.append(node["name"])
+                collect(node["children"])
+
+        collect(tree["spans"])
+        assert "serve:retry:pca" in names
+        assert any(n.startswith("serve:request:pca") for n in names)
+    finally:
+        engine.shutdown()
+
+
+# -- worker crash / wedge supervision ---------------------------------------
+
+
+def test_dead_worker_fails_fast_not_at_deadline(pca_model):
+    """ISSUE 6 satellite bugfix: predict on a model whose batcher worker
+    died must fail FAST with WorkerCrashed (counted), never block until
+    the deadline."""
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16,))
+    engine = _engine(reg, retries=0, max_worker_restarts=0)
+    try:
+        fault_plane().inject("pca", "crash_worker", count=1)
+        before = _counter("sparkml_serve_errors_total", model="pca",
+                          error="worker_crashed")
+        with pytest.raises(WorkerCrashed):
+            engine.predict("pca", x[:4], deadline_ms=30_000, timeout=10)
+        # the worker is dead (restart budget 0): the NEXT predict fails
+        # at submit time, immediately — nowhere near the 30s deadline
+        t0 = time.monotonic()
+        with pytest.raises(WorkerCrashed):
+            engine.predict("pca", x[:4], deadline_ms=30_000, timeout=10)
+        assert time.monotonic() - t0 < 1.0
+        assert _counter("sparkml_serve_errors_total", model="pca",
+                        error="worker_crashed") > before
+    finally:
+        engine.shutdown()
+
+
+def test_probe_revives_dead_batcher_after_backend_recovers(pca_model):
+    """A dead batcher (restart budget exhausted) must not strand the
+    model in permanent failure: the breaker's half-open probe revives it
+    with a fresh worker, and a recovered backend closes the breaker."""
+    model, x = pca_model
+
+    class _NoFallback:
+        def transform(self, matrix):
+            return np.asarray(matrix)[:, :2]
+
+    reg = ModelRegistry()
+    reg.register("opaque", _NoFallback(), buckets=(16,))
+    engine = _engine(reg, max_batch_rows=16, retries=0,
+                     max_worker_restarts=0, breaker_failures=1,
+                     breaker_cooldown_ms=100)
+    try:
+        fault_plane().inject("opaque", "crash_worker", count=1)
+        # crash kills the worker (budget 0 → dead batcher), opens breaker
+        with pytest.raises(WorkerCrashed):
+            engine.predict("opaque", x[:4, :4], timeout=10)
+        assert engine.breaker_snapshot()["opaque"]["state"] == "open"
+        # pre-cooldown: shed fast, the dead batcher is NOT revived
+        with pytest.raises(BreakerOpen):
+            engine.predict("opaque", x[:4, :4], timeout=10)
+        # post-cooldown: the probe revives the batcher and succeeds
+        time.sleep(0.15)
+        out = engine.predict("opaque", x[:4, :4], timeout=10)
+        np.testing.assert_array_equal(out, x[:4, :4][:, :2])
+        assert engine.breaker_snapshot()["opaque"]["state"] == "closed"
+    finally:
+        engine.shutdown()
+
+
+def test_worker_crash_restarts_and_recovers(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16, 64))
+    engine = _engine(reg, retries=1)
+    try:
+        restarts_before = _counter("sparkml_serve_worker_restarts_total",
+                                   model="pca")
+        fault_plane().inject("pca", "crash_worker", count=1)
+        # the crash fails the in-flight attempt; the retry lands on the
+        # restarted worker and succeeds
+        result = engine.predict_detailed("pca", x[:4], timeout=10)
+        assert result.retries >= 1
+        np.testing.assert_array_equal(
+            result.outputs,
+            np.asarray(model.transform(x[:4]).column("pca_features")))
+        assert _counter("sparkml_serve_worker_restarts_total",
+                        model="pca") == restarts_before + 1
+    finally:
+        engine.shutdown()
+
+
+def test_wedged_worker_watchdog_fails_batch_fast():
+    """A transform that wedges past worker_budget_s: the watchdog's
+    on_expire fails the batch with WorkerCrashed well before the wedge
+    resolves, and a replacement worker serves the next request."""
+    calls = []
+
+    def sometimes_wedges(matrix):
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(2.5)
+        return np.asarray(matrix)
+
+    batcher = MicroBatcher(sometimes_wedges, name="wedgy",
+                           max_batch_rows=8, max_wait_ms=1,
+                           worker_budget_s=0.25)
+    try:
+        t0 = time.monotonic()
+        req = batcher.submit(np.ones((2, 3)), trace_ctx=None)
+        with pytest.raises(WorkerCrashed):
+            req.wait(10)
+        assert time.monotonic() - t0 < 2.0  # failed fast, not at 2.5s
+        # the replacement worker serves new traffic immediately
+        req2 = batcher.submit(np.ones((2, 3)), trace_ctx=None)
+        np.testing.assert_array_equal(req2.wait(10), np.ones((2, 3)))
+        # the wedged thread's LATE result never overwrote the error
+        with pytest.raises(WorkerCrashed):
+            req.wait(0)
+    finally:
+        batcher.close(timeout=5)
+
+
+def test_wedge_disabled_with_nonpositive_budget():
+    batcher = MicroBatcher(lambda m: np.asarray(m), name="nobudget",
+                           max_batch_rows=8, max_wait_ms=1,
+                           worker_budget_s=0)
+    try:
+        assert batcher.worker_budget_s == float("inf")
+        req = batcher.submit(np.ones((2, 3)), trace_ctx=None)
+        np.testing.assert_array_equal(req.wait(10), np.ones((2, 3)))
+    finally:
+        batcher.close(timeout=5)
+
+
+# -- the evict / close race -------------------------------------------------
+
+
+def test_batch_failure_is_contained_but_counted():
+    """A transform exception is a BATCH failure, not a worker crash: the
+    members get the error, the worker survives, and the error series
+    sees it (rule 6's whole point)."""
+
+    def explode(matrix):
+        raise ValueError("model returned garbage")
+
+    batcher = MicroBatcher(explode, name="explody", max_batch_rows=4,
+                           max_wait_ms=1)
+    try:
+        req = batcher.submit(np.ones((2, 3)), trace_ctx=None)
+        with pytest.raises(ValueError):
+            req.wait(10)
+        assert batcher._worker.is_alive()  # contained, not crashed
+        assert _counter("sparkml_serve_errors_total", model="explody",
+                        error="ValueError") >= 1
+    finally:
+        batcher.close(timeout=5)
+
+
+def test_close_with_dead_worker_fails_queued_requests():
+    """The eviction-race satellite: close(drain=True) on a batcher whose
+    worker already died must fail whatever is queued — exactly one
+    terminal outcome each, never a hang to the wait timeout."""
+    from spark_rapids_ml_tpu.serve.batching import _Request
+
+    batcher = MicroBatcher(lambda m: np.asarray(m), name="deadclose",
+                           max_batch_rows=4, max_wait_ms=50,
+                           max_restarts=0)
+    fault_plane().inject("deadclose", "crash_worker", count=1)
+    # first request kills the worker (restart budget 0 → dead batcher)
+    req1 = batcher.submit(np.ones((2, 3)), trace_ctx=None)
+    with pytest.raises(WorkerCrashed):
+        req1.wait(10)
+    # sneak requests into the dead batcher's queue, bypassing the
+    # submit-side fail-fast (the race window close() must cover)
+    reqs = []
+    with batcher._not_empty:
+        for _ in range(3):
+            r = _Request(np.ones((1, 3)), None, trace_ctx=None)
+            batcher._queue.append(r)
+            reqs.append(r)
+    t0 = time.monotonic()
+    batcher.close(drain=True, timeout=2)
+    assert time.monotonic() - t0 < 5
+    for r in reqs:
+        with pytest.raises(BatcherClosed):
+            r.wait(0.1)  # resolved by the close sweep, not hanging
+
+
+def test_evict_racing_inflight_requests_leaves_no_hangs(pca_model):
+    """Concurrent predict traffic racing evict(): every request gets
+    exactly one terminal outcome (result or error), none hang."""
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("pca", model, buckets=(16, 64))
+    engine = _engine(reg, retries=0)
+    outcomes = []
+    lock = threading.Lock()
+
+    def worker(i):
+        try:
+            out = engine.predict("pca", x[i:i + 2], timeout=15)
+            with lock:
+                outcomes.append(("ok", out.shape))
+        except BaseException as exc:  # noqa: BLE001
+            with lock:
+                outcomes.append(("err", type(exc).__name__))
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(12)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == 5:
+                engine.evict("pca", 1, drain=False)
+        for t in threads:
+            t.join(timeout=20)
+        assert not any(t.is_alive() for t in threads), "a request hung"
+        assert len(outcomes) == 12
+        # no TimeoutError: every outcome was a served result or a
+        # deliberate serving error, never a dangling latch
+        assert all(name != "TimeoutError" for kind, name in outcomes
+                   if kind == "err")
+    finally:
+        engine.shutdown()
+
+
+# -- registry crash recovery ------------------------------------------------
+
+
+def test_registry_persists_and_recovers_manifest(pca_model, tmp_path):
+    model, x = pca_model
+    saved = str(tmp_path / "pca_model")
+    model.save(saved)
+    manifest = str(tmp_path / "registry_manifest.json")
+
+    reg1 = ModelRegistry(manifest_path=manifest)
+    v1 = reg1.load("pca", saved, buckets=(16, 64))
+    reg1.load("pca", saved)                      # v2
+    reg1.alias("prod", "pca", version=v1)        # pinned alias
+    reg1.alias("canary", "pca")                  # floating alias
+    reg1.register("inproc", model)               # NOT recoverable
+    assert os.path.exists(manifest)
+
+    # "the process crashes" — a brand-new registry recovers the state
+    reg2 = ModelRegistry(manifest_path=manifest)
+    report = reg2.recovery_report_
+    assert sorted(report["recovered"]) == ["pca@1", "pca@2"]
+    assert report["skipped"] == ["inproc@1"]
+    assert report["aliases"] == 2
+    assert reg2.resolve_entry("prod").version == v1   # pin survived
+    assert reg2.resolve_entry("canary").version == 2
+    assert reg2.resolve_entry("pca@1").buckets == (16, 64)
+    with pytest.raises(KeyError):
+        reg2.resolve("inproc")
+    np.testing.assert_array_equal(reg2.resolve("pca").pc, model.pc)
+    assert _counter("sparkml_serve_recovered_models_total",
+                    model="pca") >= 2
+    assert _counter("sparkml_serve_recovery_skipped_total",
+                    model="inproc", reason="no_source_path") >= 1
+
+    # the recovered registry serves through a fresh engine
+    engine = _engine(reg2, retries=0)
+    try:
+        out = engine.predict("prod", x[:4])
+        np.testing.assert_array_equal(
+            out, np.asarray(model.transform(x[:4]).column("pca_features")))
+    finally:
+        engine.shutdown()
+
+
+def test_registry_recovery_survives_corrupt_manifest(tmp_path):
+    manifest = str(tmp_path / "bad.json")
+    with open(manifest, "w") as f:
+        f.write("{not json")
+    reg = ModelRegistry(manifest_path=manifest)
+    assert reg.names() == []
+    assert "error" in reg.recovery_report_
+
+
+def test_registry_recovery_skips_missing_model_dirs(pca_model, tmp_path):
+    model, _ = pca_model
+    saved = str(tmp_path / "pca_model")
+    model.save(saved)
+    manifest = str(tmp_path / "manifest.json")
+    reg1 = ModelRegistry(manifest_path=manifest)
+    reg1.load("pca", saved)
+    # the artifact vanishes (disk wipe) — recovery degrades, not crashes
+    import shutil
+
+    shutil.rmtree(saved)
+    reg2 = ModelRegistry(manifest_path=manifest)
+    assert reg2.names() == []
+    assert any("pca@1" in f for f in reg2.recovery_report_["failed"])
+
+
+def test_failed_recovery_entry_survives_persists_and_retries(
+        pca_model, tmp_path):
+    """A version that fails to load during recover() must NOT be erased
+    from the manifest by the next successful mutation, its version
+    number must never be reused (a pinned alias would silently change
+    lineage), and a later restart — after the path recovers — must
+    bring it back."""
+    model, _ = pca_model
+    saved = str(tmp_path / "pca_model")
+    model.save(saved)
+    hidden = str(tmp_path / "pca_model_hidden")
+    manifest = str(tmp_path / "manifest.json")
+    reg1 = ModelRegistry(manifest_path=manifest)
+    reg1.load("pca", saved)                         # @1
+    # the artifact goes away transiently (NFS blip)
+    os.rename(saved, hidden)
+    reg2 = ModelRegistry(manifest_path=manifest)
+    assert any("pca@1" in f for f in reg2.recovery_report_["failed"])
+    # a successful mutation persists — the failed entry must survive it
+    reg2.register("other", model)
+    with open(manifest) as f:
+        doc = json.load(f)
+    assert [e["version"] for e in doc["models"]["pca"]] == [1]
+    # version 1 is retained: a re-register of "pca" gets a NEW version
+    assert reg2.register("pca", model) == 2
+    # the path comes back; the next restart recovers BOTH the retained
+    # @1 and nothing else at its slot
+    os.rename(hidden, saved)
+    reg3 = ModelRegistry(manifest_path=manifest)
+    assert "pca@1" in reg3.recovery_report_["recovered"]
+    assert reg3.resolve_entry("pca", version=1).source_path == saved
+    # deregister is the explicit way to erase the retained ghost
+    reg2.deregister("pca", version=1)
+    with open(manifest) as f:
+        doc = json.load(f)
+    assert [e["version"] for e in doc["models"]["pca"]] == [2]
+
+
+def test_registry_recovery_with_warm(pca_model, tmp_path):
+    model, _ = pca_model
+    saved = str(tmp_path / "pca_model")
+    model.save(saved)
+    manifest = str(tmp_path / "manifest.json")
+    reg1 = ModelRegistry(manifest_path=manifest)
+    reg1.load("pca", saved, buckets=(16,))
+    reg2 = ModelRegistry(manifest_path=manifest, warm_on_recover=True)
+    assert reg2.recovery_report_["warmed"]["pca"] > 0
+    assert reg2.resolve_entry("pca").warmed_buckets == (16,)
+
+
+def test_manifest_not_rewritten_during_recovery(pca_model, tmp_path):
+    """A crash mid-recovery must not overwrite the good manifest with a
+    partial one: recovery suppresses persistence."""
+    model, _ = pca_model
+    saved = str(tmp_path / "pca_model")
+    model.save(saved)
+    manifest = str(tmp_path / "manifest.json")
+    reg1 = ModelRegistry(manifest_path=manifest)
+    reg1.load("pca", saved)
+    mtime = os.path.getmtime(manifest)
+    time.sleep(0.05)
+    ModelRegistry(manifest_path=manifest)
+    assert os.path.getmtime(manifest) == mtime
+
+
+# -- rule 6: exception hygiene ----------------------------------------------
+
+
+def _rule6(path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_instrumentation import check_exception_hygiene
+    finally:
+        sys.path.pop(0)
+    return list(check_exception_hygiene(str(path)))
+
+
+def test_rule6_accepts_current_serve_modules():
+    serve_dir = os.path.join(REPO, "spark_rapids_ml_tpu", "serve")
+    for fname in os.listdir(serve_dir):
+        if fname.endswith(".py"):
+            assert _rule6(os.path.join(serve_dir, fname)) == [], fname
+
+
+def test_rule6_rejects_bare_except(tmp_path):
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        return None\n"
+    )
+    offenders = _rule6(bad)
+    assert len(offenders) == 1 and "bare except" in offenders[0][1]
+
+
+def test_rule6_rejects_broad_swallow(tmp_path):
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except (ValueError, BaseException):\n"
+        "        return 0\n"
+    )
+    offenders = _rule6(bad)
+    assert len(offenders) == 2
+    assert all("swallow" in why for _, why in offenders)
+
+
+def test_rule6_accepts_accounted_handlers(tmp_path):
+    good = tmp_path / "engine.py"
+    good.write_text(
+        "def a():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        counter.inc(model='m', error='x')\n"
+        "def b():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as exc:\n"
+        "        req.set_error(exc)\n"
+        "def c():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as exc:\n"
+        "        raise RuntimeError('wrapped') from exc\n"
+        "def d(self):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as exc:\n"
+        "        return self._reply(500, {'error': str(exc)})\n"
+        "def e():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except ValueError:\n"
+        "        return None\n"
+    )
+    assert _rule6(good) == []
+
+
+def test_main_checker_reports_rule6(tmp_path):
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_instrumentation.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout
+    assert "no silent exception swallows" in out.stdout
