@@ -7,9 +7,11 @@ from a small thread pool and prints what the serving telemetry saw:
 batch occupancy, padding waste, queue depth, deadline sheds, the
 sketch-backed p50/p95/p99 — all read back from the live registry
 snapshot — plus one request's ASSEMBLED trace tree (server → queue →
-fan-in batch → transform, Dapper-style) and the run's SLO verdict (burn
-rates per window, budget remaining, firing alerts). Runs on CPU
-(JAX_PLATFORMS=cpu) or any accelerator.
+fan-in batch → transform, Dapper-style), a 60-sample queue-depth /
+p99-latency HISTORY from the embedded time-series store (``obs.tsdb``
+sampling in the background while traffic ran), and the run's SLO
+verdict (burn rates per window, budget remaining, firing alerts). Runs
+on CPU (JAX_PLATFORMS=cpu) or any accelerator.
 """
 
 import concurrent.futures
@@ -31,14 +33,37 @@ from spark_rapids_ml_tpu.obs import (
     new_context,
     tracectx,
 )
+from spark_rapids_ml_tpu.obs import tsdb
 from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
 
 BUCKETS = (32, 64, 128, 256)
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values):
+    """A terminal sparkline over the samples (▁▂▃▄▅▆▇█)."""
+    if not values:
+        return "(no samples)"
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(
+        SPARK_BLOCKS[int((v - lo) / span * (len(SPARK_BLOCKS) - 1))]
+        for v in values
+    )
 
 
 def main():
     rng = np.random.default_rng(11)
     x = rng.normal(size=(4096, 64))
+
+    # Sample the registry into a fine-grained history store while the
+    # example runs: 20 ms resolution so a few seconds of traffic yields
+    # a dense queue-depth / latency timeline (the serve server does this
+    # automatically via tsdb.start_sampling() at the 1 s default).
+    hist_store = tsdb.TimeSeriesStore(tiers=((0.02, 120.0), (1.0, 600.0)))
+    hist_sampler = tsdb.MetricsSampler(hist_store, interval_seconds=0.02)
+    hist_sampler.start()
 
     print("== fit + register ==")
     model = PCA().setK(8).fit(x)
@@ -112,6 +137,38 @@ def main():
     names = [f"{m}@{versions[-1]['version']}"
              for m, versions in snap["models"].items()]
     print(f"  registered models:     {names}")
+
+    print("\n== 60-sample history from the embedded tsdb ==")
+    hist_sampler.stop()
+
+    def last_points(name, labels=None):
+        series = hist_store.range_query(name, labels, window=120.0)
+        return series[0]["points"][-60:] if series else []
+
+    qd = last_points("sparkml_serve_queue_depth",
+                     {"model": "pca_embedder"})
+    p99 = last_points("sparkml_serve_request_latency_seconds",
+                      {"quantile": "0.99"})
+    print(f"  sampler: {hist_sampler.sweeps} sweeps at "
+          f"{hist_sampler.interval_seconds * 1000:.0f} ms, "
+          f"{hist_store.series_count()} series")
+    if qd:
+        vals = [v for _ts, v in qd]
+        print(f"  queue depth  ({len(vals)} samples, "
+              f"min {min(vals):.0f} max {max(vals):.0f}):")
+        print(f"    {sparkline(vals)}")
+    if p99:
+        vals = [v * 1e3 for _ts, v in p99]
+        print(f"  p99 latency  ({len(vals)} samples, "
+              f"min {min(vals):.1f} ms max {max(vals):.1f} ms):")
+        print(f"    {sparkline(vals)}")
+    req_rate = hist_store.rate("sparkml_serve_requests_total",
+                               window=120.0)
+    delta = hist_store.delta("sparkml_serve_requests_total",
+                             window=120.0)
+    print(f"  request counter: delta {delta:.0f} over the window "
+          f"(rate {req_rate:.0f}/s) — reset-aware counter math over "
+          f"the sampled cumulative series")
 
     print("\n== one request, followed across every seam ==")
     tree = assemble_trace(tracked_ctx.trace_id)

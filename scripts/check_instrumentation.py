@@ -45,6 +45,14 @@ Three rule families:
    counter increment is an outage the dashboards cannot see. Handlers
    for specific exception types (``except ValueError: return default``)
    are fine — they are classification, not swallowing.
+7. over ALL of ``spark_rapids_ml_tpu/`` (library code; the in-package
+   ``scripts/`` helper dir is exempt, as are the repo-level ``scripts/``
+   and ``examples/`` trees, which are outside the package): no bare
+   ``print(`` calls — library output goes through the structured JSON
+   logger (``obs.logging.get_logger``), which carries severity, the
+   active trace id, and machine-parseable fields; a bare print is
+   invisible to log shippers and severs the request identity the
+   tracing layer threads through every queue.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -68,6 +76,10 @@ PARALLEL_GLOB = os.path.join(
 MODELS_GLOB = os.path.join(REPO, "spark_rapids_ml_tpu", "models", "*.py")
 SPARK_GLOB = os.path.join(REPO, "spark_rapids_ml_tpu", "spark", "*.py")
 SERVE_GLOB = os.path.join(REPO, "spark_rapids_ml_tpu", "serve", "*.py")
+LIBRARY_ROOT = os.path.join(REPO, "spark_rapids_ml_tpu")
+# rule 7 exemption: the in-package scripts/ dir holds operator shell
+# helpers whose stdout IS their interface, like the repo-level scripts/.
+PRINT_EXEMPT_DIRS = (os.path.join("spark_rapids_ml_tpu", "scripts"),)
 DECORATOR_NAME = "fit_instrumentation"
 SERVING_DECORATOR = "observed_transform"
 SERVING_PUBLIC_NAMES = frozenset(
@@ -365,6 +377,41 @@ def check_exception_hygiene(path: str):
                    "counter .inc(), .set_error(), _reply(), or re-raise")
 
 
+def check_print_calls(path: str):
+    """Rule 7: yield (lineno, description) for every bare ``print(``
+    call in one library module.
+
+    Pure AST — only actual ``print(...)`` CALLS count; the word inside
+    a string literal (e.g. generated subprocess code) does not. Library
+    output must go through ``obs.logging`` so it carries severity and
+    the active trace id."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            yield (node.lineno,
+                   "bare print( in library code (use "
+                   "obs.logging.get_logger(...) — structured, leveled, "
+                   "trace-id-stamped)")
+
+
+def library_files():
+    """Every .py under the package, minus the exempt helper dirs."""
+    out = []
+    for root, _dirs, files in os.walk(LIBRARY_ROOT):
+        rel_root = os.path.relpath(root, REPO)
+        # component-wise: "spark_rapids_ml_tpu/scripts_v2" must NOT
+        # match the "spark_rapids_ml_tpu/scripts" exemption
+        if any(rel_root == d or rel_root.startswith(d + os.sep)
+               for d in PRINT_EXEMPT_DIRS):
+            continue
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                out.append(os.path.join(root, fname))
+    return sorted(out)
+
+
 def main() -> int:
     files = sorted(glob.glob(PARALLEL_GLOB))
     if not files:
@@ -413,6 +460,11 @@ def main() -> int:
             offenders.append(f"{rel}:{lineno} {why}")
         for lineno, why in check_exception_hygiene(path):
             offenders.append(f"{rel}:{lineno} {why}")
+    lib_files = library_files()
+    for path in lib_files:
+        rel = os.path.relpath(path, REPO)
+        for lineno, why in check_print_calls(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -425,7 +477,8 @@ def main() -> int:
         f"{len(serving_files)} models/spark module(s) all instrumented; "
         f"{len(serve_files)} serve/ module(s) clean (no raw jit, no "
         f"transform bypasses, all queue/thread handoffs carry their "
-        f"TraceContext, no silent exception swallows)"
+        f"TraceContext, no silent exception swallows); "
+        f"{len(lib_files)} library module(s) free of bare print("
     )
     return 0
 

@@ -101,6 +101,23 @@ from spark_rapids_ml_tpu.obs.flight import (  # noqa: F401
     get_watchdog,
 )
 from spark_rapids_ml_tpu.obs import flight  # noqa: F401
+from spark_rapids_ml_tpu.obs.logging import (  # noqa: F401
+    StructuredLogger,
+    get_logger,
+)
+from spark_rapids_ml_tpu.obs.tsdb import (  # noqa: F401
+    MetricsSampler,
+    TimeSeriesStore,
+    get_sampler,
+    get_tsdb,
+    start_sampling,
+    stop_sampling,
+)
+from spark_rapids_ml_tpu.obs.devmon import (  # noqa: F401
+    DeviceMonitor,
+    get_device_monitor,
+)
+from spark_rapids_ml_tpu.obs import profiler  # noqa: F401
 from spark_rapids_ml_tpu.obs.report import (  # noqa: F401
     FitContext,
     FitReport,
@@ -144,12 +161,14 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "DUMP_DIR_ENV",
     "DeviceHealth",
+    "DeviceMonitor",
     "FIT_BUDGET_ENV",
     "FitContext",
     "FitReport",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "MetricsSampler",
     "NUMERICS_SAMPLE_ENV",
     "PhaseTimer",
     "QuantileSketch",
@@ -159,11 +178,13 @@ __all__ = [
     "SloSet",
     "SpanEvent",
     "SpanRecorder",
+    "StructuredLogger",
     "Summary",
     "TRACEPARENT_HEADER",
     "TRACE_DIR_ENV",
     "TRANSFORM_BUDGET_ENV",
     "TRANSFORM_REPORT_ATTR",
+    "TimeSeriesStore",
     "TraceColor",
     "TraceContext",
     "TraceRange",
@@ -196,8 +217,12 @@ __all__ = [
     "ensure_context",
     "fit_instrumentation",
     "flight",
+    "get_device_monitor",
+    "get_logger",
     "get_recorder",
     "get_registry",
+    "get_sampler",
+    "get_tsdb",
     "get_watchdog",
     "host_peak_rss_bytes",
     "inflight_request",
@@ -216,12 +241,15 @@ __all__ = [
     "parse_traceparent",
     "peak_bytes_in_use",
     "peak_flops_per_second",
+    "profiler",
     "recent_traces",
     "record_event",
     "record_memory_metrics",
     "reset_compile_log",
     "span",
     "start_prometheus_server",
+    "start_sampling",
+    "stop_sampling",
     "traced_thread",
     "track_compiles",
     "tracked_jit",

@@ -1,0 +1,222 @@
+"""Per-device fleet monitor: memory gauges + batch-time attribution.
+
+The ROADMAP's multi-chip serving item needs "per-device occupancy/queue
+metrics in the existing obs/ registry" before the mesh PR lands, and the
+hot-path latency item needs device-time evidence to attribute wins. This
+module publishes both, per ``jax.devices()`` entry:
+
+* ``sample()`` — ``memory_stats()`` in-use / limit / peak gauges labeled
+  by device id (``sparkml_device_mem_bytes_in_use{device,source}`` etc.,
+  ``source="pjrt"``). Backends without PJRT stats (CPU) fall back to the
+  host RSS reader in ``obs.memory`` (``source="host_rss"``) — a host
+  number is never mistaken for an HBM number. Registered as a sampler
+  collector by ``obs.tsdb.start_sampling``, so every gauge gets history.
+* ``note_batch(model, seconds)`` — per-device batch-time attribution,
+  wired from ``serve/batching.py``: every coalesced batch's execute time
+  lands in ``sparkml_serve_device_batch_seconds_total{model,device}``
+  (+ a batches counter), so per-chip occupancy is
+  ``rate(batch_seconds)`` straight out of the history store —
+  ``occupancy(window)`` computes exactly that. Never raises into the
+  batcher: attribution is telemetry, not control flow.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_ml_tpu.obs import memory as memory_mod
+from spark_rapids_ml_tpu.obs.metrics import get_registry
+
+
+def _devices() -> List[Any]:
+    try:
+        import jax
+
+        return list(jax.devices())
+    except Exception:
+        return []
+
+
+def _profiler_transition_pending() -> bool:
+    try:
+        from spark_rapids_ml_tpu.obs import profiler
+
+        return profiler.jax_transition_pending()
+    except Exception:
+        return False
+
+
+class DeviceMonitor:
+    """One process-wide monitor over the local device fleet."""
+
+    def __init__(self, devices_fn=_devices):
+        self._devices_fn = devices_fn
+        self._lock = threading.Lock()
+        self._default_device: Optional[str] = None
+        reg = get_registry()
+        self._m_in_use = reg.gauge(
+            "sparkml_device_mem_bytes_in_use",
+            "per-device bytes in use (PJRT memory_stats; host RSS on "
+            "backends without device stats)", ("device", "source"),
+        )
+        self._m_limit = reg.gauge(
+            "sparkml_device_mem_bytes_limit",
+            "per-device memory limit (PJRT memory_stats)",
+            ("device", "source"),
+        )
+        self._m_peak = reg.gauge(
+            "sparkml_device_mem_peak_bytes",
+            "per-device peak bytes in use (PJRT high-watermark; host RSS "
+            "peak on backends without device stats)", ("device", "source"),
+        )
+        self._m_batch_seconds = reg.counter(
+            "sparkml_serve_device_batch_seconds_total",
+            "device wall-clock attributed to coalesced serve batches — "
+            "rate() of this series is per-device occupancy",
+            ("model", "device"),
+        )
+        self._m_batches = reg.counter(
+            "sparkml_serve_device_batches_total",
+            "coalesced serve batches attributed per device",
+            ("model", "device"),
+        )
+        self._m_overhead = reg.counter(
+            "sparkml_obs_overhead_seconds_total",
+            "wall-clock the observability layer spends watching "
+            "(sampler sweeps, device monitor, profiler bookkeeping)",
+            ("component",),
+        )
+
+    # -- memory gauges -----------------------------------------------------
+
+    def sample(self) -> List[Dict[str, Any]]:
+        """Publish the fleet's memory gauges; returns what was read.
+
+        One entry per device: PJRT stats when the backend has them, the
+        process RSS (tagged ``host_rss``) otherwise — a CPU fleet still
+        shows a concrete, visibly host-sourced number per device."""
+        t0 = time.perf_counter()
+        out: List[Dict[str, Any]] = []
+        if _profiler_transition_pending():
+            # PJRT polls (memory_stats) stall jax.profiler.start_trace
+            # on some backends; skip this sweep only while start/stop
+            # is actually in flight — gauges keep updating through the
+            # capture window itself (a 5-minute capture must not hide
+            # the very memory ramp the operator is profiling).
+            return out
+        rss: Optional[int] = None
+        peak_rss: Optional[int] = None
+        for device in self._devices_fn():
+            label = str(device)
+            stats = memory_mod.device_memory_stats(device)
+            if stats is not None:
+                in_use = int(stats.get("bytes_in_use", 0))
+                peak = int(stats.get("peak_bytes_in_use", in_use))
+                entry: Dict[str, Any] = {
+                    "device": label, "source": "pjrt",
+                    "bytes_in_use": in_use, "peak_bytes_in_use": peak,
+                }
+                self._m_in_use.set(in_use, device=label, source="pjrt")
+                self._m_peak.set(peak, device=label, source="pjrt")
+                if "bytes_limit" in stats:
+                    limit = int(stats["bytes_limit"])
+                    entry["bytes_limit"] = limit
+                    self._m_limit.set(limit, device=label, source="pjrt")
+            else:
+                # in_use must be CURRENT RSS (goes down on free — a
+                # spike and a leak look different in the history),
+                # peak is the lifetime watermark; ru_maxrss only when
+                # /proc is unavailable (then in_use IS the watermark).
+                if rss is None:
+                    peak_rss = memory_mod.host_peak_rss_bytes() or 0
+                    rss = (memory_mod.host_current_rss_bytes()
+                           or peak_rss)
+                entry = {
+                    "device": label, "source": "host_rss",
+                    "bytes_in_use": rss, "peak_bytes_in_use": peak_rss,
+                }
+                self._m_in_use.set(rss, device=label, source="host_rss")
+                self._m_peak.set(peak_rss, device=label,
+                                 source="host_rss")
+            out.append(entry)
+        try:
+            self._m_overhead.inc(time.perf_counter() - t0,
+                                 component="devmon")
+        except Exception:
+            pass
+        return out
+
+    # -- batch-time attribution --------------------------------------------
+
+    def default_device_label(self) -> str:
+        """The device the single-replica batcher runs on (cached). The
+        mesh-serving PR passes an explicit device per dispatch; until
+        then every batch attributes to the process default device."""
+        with self._lock:
+            if self._default_device is None:
+                try:
+                    devices = self._devices_fn()
+                except Exception:
+                    devices = []
+                self._default_device = (str(devices[0]) if devices
+                                        else "unknown")
+            return self._default_device
+
+    def note_batch(self, model: str, seconds: float,
+                   device: Optional[str] = None) -> None:
+        """Attribute one coalesced batch's device time. NEVER raises —
+        this is called from the batcher's hot path."""
+        try:
+            label = device or self.default_device_label()
+            self._m_batch_seconds.inc(max(float(seconds), 0.0),
+                                      model=model, device=label)
+            self._m_batches.inc(model=model, device=label)
+        except Exception:
+            pass  # attribution must never fail a batch
+
+    def occupancy(self, window: float = 60.0) -> Dict[str, float]:
+        """Per-device busy fraction over the trailing window, computed
+        as ``rate(sparkml_serve_device_batch_seconds_total)`` from the
+        history store (empty dict before any sampling)."""
+        from spark_rapids_ml_tpu.obs import tsdb
+
+        store = tsdb.get_tsdb()
+        out: Dict[str, float] = {}
+        for series in store.rate_points(
+            "sparkml_serve_device_batch_seconds_total", window=window,
+        ):
+            device = series["labels"].get("device", "unknown")
+            points = series["points"]
+            if not points:
+                continue
+            mean = sum(v for _ts, v in points) / len(points)
+            out[device] = out.get(device, 0.0) + mean
+        return out
+
+
+_monitor: Optional[DeviceMonitor] = None
+_monitor_lock = threading.Lock()
+
+
+def get_device_monitor() -> DeviceMonitor:
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = DeviceMonitor()
+        return _monitor
+
+
+def reset_device_monitor() -> None:
+    """Drop the cached monitor (tests that reset the registry)."""
+    global _monitor
+    with _monitor_lock:
+        _monitor = None
+
+
+__all__ = [
+    "DeviceMonitor",
+    "get_device_monitor",
+    "reset_device_monitor",
+]

@@ -75,6 +75,12 @@ def _utcnow() -> str:
     return utcnow_iso()
 
 
+def _logger():
+    from spark_rapids_ml_tpu.obs.logging import get_logger
+
+    return get_logger("obs.flight")
+
+
 def _thread_stacks() -> Dict[str, Any]:
     """Every live thread's current stack, formatted."""
     names = {t.ident: t.name for t in threading.enumerate()}
@@ -209,8 +215,14 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None
         with open(tmp_path, "w") as f:
             json.dump(doc, f, indent=1, default=str)
         os.replace(tmp_path, path)
-        print(f"# flight recorder: dumped {reason!r} -> {path}",
-              file=sys.stderr, flush=True)
+        # structured stderr line (obs.logging), not a bare print — a
+        # dump notice must be shippable/parseable like every other log.
+        # error, the highest level the gate knows: a dump IS an incident
+        # artifact, and the pointer to it must survive ANY production
+        # log-level threshold (at warning it would vanish under
+        # SPARK_RAPIDS_ML_TPU_LOG_LEVEL=error).
+        _logger().error("flight dump written", reason=reason,
+                        path=path)
         try:
             from spark_rapids_ml_tpu.obs.metrics import get_registry
 

@@ -1,0 +1,150 @@
+"""Structured JSON logging for library code.
+
+Library modules must never ``print(`` (rule 7 of
+``scripts/check_instrumentation.py``): a bare print is invisible to log
+shippers, carries no severity, and loses the request identity that the
+tracing layer worked to thread through every queue. This module is the
+sanctioned spelling — one JSON object per line, machine-parseable, with
+the active ``TraceContext``'s trace id stamped automatically so a log
+line lands next to its request's spans in whatever aggregator reads the
+stream:
+
+    {"ts": "...", "level": "info", "logger": "obs.flight",
+     "message": "flight dump written", "trace_id": "…", "path": "…"}
+
+Design constraints:
+
+* stdlib only, and **never raises into the caller** — a logger that can
+  crash a dying error path is worse than silence;
+* the stream is resolved at emit time (default ``sys.stderr``), so
+  pytest's capture and stream redirection both just work;
+* level gate via ``SPARK_RAPIDS_ML_TPU_LOG_LEVEL``
+  (``debug``/``info``/``warning``/``error``, default ``info``);
+* every emitted line is counted in ``sparkml_log_lines_total{level}``
+  — log volume is itself a metric the history sampler can watch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+LEVEL_ENV = "SPARK_RAPIDS_ML_TPU_LOG_LEVEL"
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
+_DEFAULT_LEVEL = "info"
+
+
+def _threshold() -> int:
+    raw = os.environ.get(LEVEL_ENV, _DEFAULT_LEVEL).strip().lower()
+    return _LEVELS.get(raw, _LEVELS[_DEFAULT_LEVEL])
+
+
+class StructuredLogger:
+    """One named logger emitting single-line JSON records.
+
+    ``stream=None`` (the default) resolves ``sys.stderr`` at emit time;
+    pass an open file-like to redirect (tests, log files).
+    """
+
+    def __init__(self, name: str, stream=None):
+        self.name = name
+        self._stream = stream
+
+    def _emit(self, level: str, message: str,
+              fields: Dict[str, Any]) -> None:
+        if _LEVELS[level] < _threshold():
+            return
+        try:
+            record: Dict[str, Any] = {
+                "ts": _utcnow(),
+                "level": level,
+                "logger": self.name,
+                "message": message,
+            }
+            trace_id = _active_trace_id()
+            if trace_id:
+                record["trace_id"] = trace_id
+            for key, value in fields.items():
+                if key not in record:
+                    record[key] = value
+            line = json.dumps(record, default=str)
+            stream = self._stream if self._stream is not None else sys.stderr
+            stream.write(line + "\n")
+            flush = getattr(stream, "flush", None)
+            if callable(flush):
+                flush()
+            _count_line(level)
+        except Exception:
+            pass  # a logger must never raise into (or kill) its caller
+
+    def debug(self, message: str, **fields) -> None:
+        self._emit("debug", message, fields)
+
+    def info(self, message: str, **fields) -> None:
+        self._emit("info", message, fields)
+
+    def warning(self, message: str, **fields) -> None:
+        self._emit("warning", message, fields)
+
+    def error(self, message: str, **fields) -> None:
+        self._emit("error", message, fields)
+
+    def log(self, level: str, message: str, **fields) -> None:
+        if level not in _LEVELS:
+            level = "info"
+        self._emit(level, message, fields)
+
+
+def _utcnow() -> str:
+    from spark_rapids_ml_tpu.obs.spans import utcnow_iso
+
+    return utcnow_iso()
+
+
+def _active_trace_id() -> Optional[str]:
+    """The active request's trace id (activated ``TraceContext`` first,
+    then the innermost open span), or None outside any request."""
+    try:
+        from spark_rapids_ml_tpu.obs import tracectx
+
+        ctx = tracectx.current_context()
+        if ctx is not None:
+            return ctx.trace_id
+        from spark_rapids_ml_tpu.obs import spans
+
+        return spans.current_trace_id()
+    except Exception:
+        return None
+
+
+def _count_line(level: str) -> None:
+    try:
+        from spark_rapids_ml_tpu.obs.metrics import get_registry
+
+        get_registry().counter(
+            "sparkml_log_lines_total",
+            "structured log lines emitted, by level", ("level",),
+        ).inc(level=level)
+    except Exception:
+        pass
+
+
+_loggers: Dict[str, StructuredLogger] = {}
+_loggers_lock = threading.Lock()
+
+
+def get_logger(name: str) -> StructuredLogger:
+    """The process-wide logger for ``name`` (cached; one per name)."""
+    with _loggers_lock:
+        logger = _loggers.get(name)
+        if logger is None:
+            logger = StructuredLogger(name)
+            _loggers[name] = logger
+        return logger
+
+
+__all__ = ["LEVEL_ENV", "StructuredLogger", "get_logger"]

@@ -52,6 +52,21 @@ def host_peak_rss_bytes() -> Optional[int]:
         return None
 
 
+def host_current_rss_bytes() -> Optional[int]:
+    """CURRENT process RSS (``/proc/self/statm`` resident pages × page
+    size) — unlike ``ru_maxrss`` this goes DOWN when memory is freed, so
+    a gauge fed from it shows a trend, not a high-watermark. None where
+    /proc is unavailable (macOS)."""
+    try:
+        import resource
+
+        with open("/proc/self/statm") as f:
+            resident_pages = int(f.read().split()[1])
+        return resident_pages * resource.getpagesize()
+    except Exception:
+        return None
+
+
 def memory_watermarks(devices=None) -> Dict[str, Any]:
     """The uniform watermark snapshot every report/bench embeds.
 

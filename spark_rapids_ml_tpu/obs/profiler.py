@@ -1,0 +1,397 @@
+"""Guarded on-demand device profiling: ``POST /debug/profile`` backend.
+
+The hot-path latency work needs device-timeline evidence ("where did the
+batch's 4 ms go?") that metrics cannot give. This module turns one HTTP
+request into a bounded capture:
+
+* ``start_capture(seconds)`` drives ``jax.profiler.start_trace`` /
+  ``stop_trace`` into the profile dir
+  (``SPARK_RAPIDS_ML_TPU_OBS_PROFILE_DIR``, default
+  ``<dump_dir>/profiles``) — **single-flight** (a second start while
+  one is running raises ``CaptureInFlight``), auto-stopped by a timer
+  thread after ``seconds`` (clamped to ``MAX_SECONDS``), and works on
+  CPU backends too;
+* the jax profiler start/stop runs on its **own helper thread with a
+  bounded join**: on some runtimes ``start_trace`` stalls for tens of
+  seconds (or indefinitely) while other threads are mid-computation or
+  polling PJRT (measured on this container's CPU backend under live
+  serve traffic), and an ops endpoint must never inherit that stall.
+  A capture whose helper misses the join grace completes anyway
+  (``outcome="jax_wedged"``); the helper cleans up after itself when
+  the backend unblocks (start → sees the stop event → stop → exit),
+  and while it is still draining, new captures skip the jax trace
+  (``jax_enabled=false``) instead of stacking a second ``start_trace``
+  behind it. Every capture still lands a loadable artifact, because
+* every capture ALSO exports the span-ring as a Chrome-trace JSON into
+  the same directory (loadable in Perfetto / ``chrome://tracing``)
+  regardless of the native profiler's mood;
+* the capture itself is observable: an ``obs:profile`` span covering
+  the window, ``sparkml_obs_profile_captures_total{outcome}`` counts
+  (``started`` / ``completed`` / ``jax_unavailable`` / ``jax_wedged``),
+  and the bookkeeping cost lands in
+  ``sparkml_obs_overhead_seconds_total{component="profiler"}``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_ml_tpu.obs import flight
+from spark_rapids_ml_tpu.obs.logging import get_logger
+from spark_rapids_ml_tpu.obs.metrics import get_registry
+
+PROFILE_DIR_ENV = "SPARK_RAPIDS_ML_TPU_OBS_PROFILE_DIR"
+MAX_SECONDS = 300.0
+_DEFAULT_SECONDS = 5.0
+# How long past the capture window the jax helper thread gets to come
+# back before the backend is declared wedged.
+_JAX_JOIN_GRACE = 2.0
+
+_log = get_logger("obs.profiler")
+
+
+class CaptureInFlight(RuntimeError):
+    """A profile capture is already running — captures are single-flight
+    (two overlapping ``start_trace`` calls would corrupt the dump, and a
+    scrape loop must not be able to stack profiler overhead)."""
+
+
+def profile_dir() -> str:
+    return (os.environ.get(PROFILE_DIR_ENV)
+            or os.path.join(flight.dump_dir(), "profiles"))
+
+
+def _captures_counter():
+    return get_registry().counter(
+        "sparkml_obs_profile_captures_total",
+        "on-demand profiler captures by outcome", ("outcome",),
+    )
+
+
+def _overhead_counter():
+    return get_registry().counter(
+        "sparkml_obs_overhead_seconds_total",
+        "wall-clock the observability layer spends watching "
+        "(sampler sweeps, device monitor, profiler bookkeeping)",
+        ("component",),
+    )
+
+
+class _Capture:
+    __slots__ = ("id", "path", "seconds", "t0_perf", "started_unix",
+                 "stop_event", "thread", "jax_thread", "jax_started",
+                 "jax_result")
+
+    def __init__(self, cid: str, path: str, seconds: float):
+        self.id = cid
+        self.path = path
+        self.seconds = seconds
+        self.t0_perf = time.perf_counter()
+        self.started_unix = time.time()
+        self.stop_event = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+        self.jax_thread: Optional[threading.Thread] = None
+        self.jax_started = threading.Event()
+        self.jax_result: Optional[str] = None
+
+
+_lock = threading.Lock()
+_active: Optional[_Capture] = None
+_last: Optional[Dict[str, Any]] = None
+# The most recent jax helper thread. While it is still alive (wedged in
+# start/stop_trace behind a busy backend), new captures skip the jax
+# trace — two overlapping start_trace calls would corrupt the session —
+# and re-arm automatically once it drains and cleans up after itself.
+_jax_helper: Optional[threading.Thread] = None
+
+
+def jax_profiler_busy() -> bool:
+    """A previous capture's jax helper is still wedged in the backend
+    (new captures serve span-ring artifacts until it drains)."""
+    with _lock:
+        helper = _jax_helper
+    return helper is not None and helper.is_alive()
+
+
+def jax_transition_pending() -> bool:
+    """True only while a ``start_trace``/``stop_trace`` call is actually
+    in flight. The window between them — trace running, helper parked in
+    its ``stop_event`` wait — is NOT a transition: PJRT polls are safe
+    then, so a long capture must not blind the device monitor for its
+    whole duration."""
+    with _lock:
+        cap = _active
+        helper = _jax_helper
+    cap_thread = cap.jax_thread if cap is not None else None
+    if cap_thread is not None and cap_thread.is_alive():
+        if not cap.jax_started.is_set():
+            return True  # start_trace in flight
+        if cap.jax_result is None and (
+                cap.stop_event.is_set()
+                or time.perf_counter() - cap.t0_perf >= cap.seconds):
+            return True  # stop_trace in flight (or about to be)
+    if (helper is not None and helper is not cap_thread
+            and helper.is_alive()):
+        # an orphaned helper from an earlier capture is by definition
+        # stuck inside start/stop_trace
+        return True
+    return False
+
+
+def reset_jax_profiler_state() -> None:
+    """Forget the tracked helper thread (tests)."""
+    global _jax_helper
+    with _lock:
+        _jax_helper = None
+
+
+def capture_active() -> Optional[Dict[str, Any]]:
+    """The in-flight capture's info, or None."""
+    with _lock:
+        cap = _active
+    if cap is None:
+        return None
+    return {
+        "id": cap.id,
+        "path": cap.path,
+        "seconds": cap.seconds,
+        "elapsed_seconds": time.perf_counter() - cap.t0_perf,
+        "jax_trace": cap.jax_started.is_set(),
+    }
+
+
+def last_capture() -> Optional[Dict[str, Any]]:
+    """The most recent completed capture's result document."""
+    with _lock:
+        return dict(_last) if _last else None
+
+
+def start_capture(seconds: float = _DEFAULT_SECONDS,
+                  label: str = "ondemand") -> Dict[str, Any]:
+    """Begin a single-flight capture; auto-stops after ``seconds``.
+
+    Returns the capture info immediately (a worker thread finishes it);
+    raises ``CaptureInFlight`` when one is already running. ``seconds``
+    is clamped to ``(0, MAX_SECONDS]`` — an unbounded capture armed over
+    HTTP would be a denial-of-service knob pointed at the dump disk."""
+    global _active, _jax_helper
+    seconds = min(max(float(seconds), 0.05), MAX_SECONDS)
+    safe_label = "".join(
+        c if (c.isalnum() or c in "-_") else "_" for c in str(label)
+    )[:40] or "ondemand"
+    cid = f"{safe_label}_{int(time.time() * 1000)}_{os.getpid()}"
+    path = os.path.join(profile_dir(), cid)
+    with _lock:
+        if _active is not None:
+            raise CaptureInFlight(
+                f"profile capture {_active.id!r} is already running "
+                f"({_active.seconds:g}s window) — retry after it lands"
+            )
+        cap = _Capture(cid, path, seconds)
+        _active = cap
+        jax_enabled = _jax_helper is None or not _jax_helper.is_alive()
+    try:
+        os.makedirs(path, exist_ok=True)
+        from spark_rapids_ml_tpu.obs import tracectx
+
+        if jax_enabled:
+            # start AND stop live on one helper thread: if start_trace
+            # wedges, a later unwedge sees the stop event already set
+            # and cleans up after itself; the capture path never waits
+            # on it past the join grace.
+            cap.jax_thread = tracectx.traced_thread(
+                _jax_worker, name=f"sparkml-profile-jax-{cid}",
+                daemon=True, fresh=True, args=(cap,),
+            )
+            cap.jax_thread.start()
+            with _lock:
+                _jax_helper = cap.jax_thread
+        cap.thread = tracectx.traced_thread(
+            _run_capture, name=f"sparkml-profile-{cid}", daemon=True,
+            fresh=True, args=(cap,),
+        )
+        cap.thread.start()
+    except Exception:
+        # A failed start (unwritable dir, thread spawn failure) must
+        # not brick the endpoint: release the single-flight slot and
+        # end any helper that already launched, then surface the error.
+        cap.stop_event.set()
+        with _lock:
+            if _active is cap:
+                _active = None
+        _captures_counter().inc(outcome="start_failed")
+        raise
+    _captures_counter().inc(outcome="started")
+    _log.info("profile capture started", capture_id=cid, path=path,
+              seconds=seconds, jax_enabled=jax_enabled)
+    return {
+        "id": cid,
+        "path": path,
+        "seconds": seconds,
+        "jax_enabled": jax_enabled,
+    }
+
+
+def stop_capture() -> Optional[Dict[str, Any]]:
+    """End the in-flight capture early (no-op when none is running);
+    blocks until its artifacts are written and returns the result."""
+    with _lock:
+        cap = _active
+    if cap is None:
+        return last_capture()
+    cap.stop_event.set()
+    thread = cap.thread
+    if thread is not None:
+        thread.join(timeout=10.0)
+    return last_capture()
+
+
+def wait(timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
+    """Block until the in-flight capture (if any) lands AND its jax
+    helper thread drains; returns the last capture result. Call before
+    process exit in tests/short-lived tools — an abandoned helper stuck
+    inside the profiler C++ at interpreter teardown can crash it."""
+    with _lock:
+        cap = _active
+        helper = _jax_helper
+    if cap is not None and cap.thread is not None:
+        cap.thread.join(timeout=timeout)
+    if helper is not None and helper.is_alive():
+        helper.join(timeout=timeout)
+    return last_capture()
+
+
+def _jax_worker(cap: _Capture) -> None:
+    """start_trace → wait out the window → stop_trace, all on one
+    thread. Any step may block forever on a wedged backend; the capture
+    worker only ever joins this thread with a bounded timeout."""
+    try:
+        import jax
+
+        jax.profiler.start_trace(cap.path)
+    except Exception as exc:
+        cap.jax_result = "unavailable"
+        _log.warning("jax profiler unavailable; span-ring capture only",
+                     error=f"{type(exc).__name__}: {exc}")
+        return
+    cap.jax_started.set()
+    cap.stop_event.wait(cap.seconds)
+    try:
+        jax.profiler.stop_trace()
+        cap.jax_result = "ok"
+    except Exception as exc:
+        cap.jax_result = "stop_failed"
+        _log.warning("jax profiler stop_trace failed",
+                     error=f"{type(exc).__name__}: {exc}")
+
+
+def _run_capture(cap: _Capture) -> None:
+    cap.stop_event.wait(cap.seconds)
+    jax_outcome = "skipped_busy"
+    if cap.jax_thread is not None:
+        cap.stop_event.set()  # early-stop: release the helper's wait
+        cap.jax_thread.join(timeout=_JAX_JOIN_GRACE)
+        if cap.jax_thread.is_alive():
+            # start_trace (or stop_trace) has not come back — the known
+            # stall when other threads are mid-computation. The capture
+            # completes with span-ring artifacts; the helper cleans up
+            # when the backend unblocks, and until then new captures
+            # skip the jax trace instead of stacking behind it.
+            jax_outcome = "jax_wedged"
+            _captures_counter().inc(outcome="jax_wedged")
+            _log.warning(
+                "jax profiler wedged (start/stop_trace did not return "
+                "within the join grace); capture lands span-ring only",
+                capture_id=cap.id)
+        elif cap.jax_result == "unavailable":
+            jax_outcome = "jax_unavailable"
+            _captures_counter().inc(outcome="jax_unavailable")
+        else:
+            jax_outcome = cap.jax_result or "ok"
+    _finish(cap, jax_outcome)
+
+
+def _artifacts(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for root, _dirs, files in os.walk(path):
+        for fname in sorted(files):
+            fpath = os.path.join(root, fname)
+            try:
+                size = os.path.getsize(fpath)
+            except OSError:
+                continue
+            out.append({"path": fpath, "bytes": size})
+    return out
+
+
+def _finish(cap: _Capture, jax_outcome: str) -> None:
+    global _active, _last
+    t_finish = time.perf_counter()
+    # The span-ring view of the same window: always written, so every
+    # capture yields at least one loadable (Perfetto/chrome://tracing)
+    # artifact even without a native profiler backend.
+    spans_path: Optional[str] = os.path.join(
+        cap.path, f"spans_{cap.id}.json")
+    try:
+        from spark_rapids_ml_tpu.obs import spans as spans_mod
+
+        spans_mod.get_recorder().export_chrome_trace(spans_path)
+    except Exception as exc:
+        _log.warning("span-ring export failed",
+                     error=f"{type(exc).__name__}: {exc}")
+        spans_path = None
+    t1 = time.perf_counter()
+    try:
+        from spark_rapids_ml_tpu.obs import spans as spans_mod
+
+        spans_mod.record_event(
+            "obs:profile", cap.t0_perf, t1,
+            capture_id=cap.id, seconds=cap.seconds,
+            jax_outcome=jax_outcome,
+        )
+    except Exception:
+        pass
+    result = {
+        "id": cap.id,
+        "path": cap.path,
+        "seconds": cap.seconds,
+        "elapsed_seconds": t1 - cap.t0_perf,
+        # honest only on "ok": a failed/wedged stop_trace typically never
+        # flushed the buffer, so there is no loadable jax artifact
+        "jax_trace": jax_outcome == "ok",
+        "jax_outcome": jax_outcome,
+        "spans_trace": spans_path,
+        "artifacts": _artifacts(cap.path),
+        "finished_unix": time.time(),
+    }
+    with _lock:
+        _last = result
+        _active = None
+    _captures_counter().inc(outcome="completed")
+    try:
+        _overhead_counter().inc(time.perf_counter() - t_finish,
+                                component="profiler")
+    except Exception:
+        pass
+    _log.info("profile capture completed", capture_id=cap.id,
+              path=cap.path, artifacts=len(result["artifacts"]),
+              jax_outcome=jax_outcome)
+
+
+__all__ = [
+    "CaptureInFlight",
+    "MAX_SECONDS",
+    "PROFILE_DIR_ENV",
+    "capture_active",
+    "jax_profiler_busy",
+    "jax_transition_pending",
+    "last_capture",
+    "profile_dir",
+    "reset_jax_profiler_state",
+    "start_capture",
+    "stop_capture",
+    "wait",
+]

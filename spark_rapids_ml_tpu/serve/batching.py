@@ -68,6 +68,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.obs import flight, get_registry, span, tracectx
 from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.devmon import get_device_monitor
 from spark_rapids_ml_tpu.serve.faults import (
     InjectedWorkerCrash,
     fault_plane,
@@ -230,6 +231,9 @@ class MicroBatcher:
         self._restarts = 0
         self._inflight_batch: Optional[List[_Request]] = None
         self._restart_pause_s = 0.02  # crash-storm brake
+        # resolved once like the metric family handles below — the
+        # execute path must not take the monitor's global lock per batch
+        self._devmon = get_device_monitor()
         self._declare_metrics()
         self._worker = self._spawn_worker()
 
@@ -667,9 +671,13 @@ class MicroBatcher:
             finally:
                 if handle is not None:
                     flight.get_watchdog().disarm(handle)
-            stage.observe(time.monotonic() - t0,
+            execute_seconds = time.monotonic() - t0
+            stage.observe(execute_seconds,
                           trace_id=batch_ctx.trace_id,
                           model=self.name, stage="execute")
+            # per-device occupancy attribution (obs.devmon — never
+            # raises): the mesh-serving PR reads its evidence from this
+            self._devmon.note_batch(self.name, execute_seconds)
             if out.shape[0] < n:
                 raise ValueError(
                     f"{self.name}: transform returned {out.shape[0]} rows "

@@ -28,8 +28,19 @@ web framework to the container:
   firing multi-window alerts from the engine's ``SloSet``, per-model
   circuit-breaker states, and the fault plane's armed faults (a chaos
   drill is auditable from the ops surface it is attacking);
+* ``GET /debug/history`` — JSON range queries over the embedded
+  time-series store (``obs.tsdb``): ``?name=<metric>&window=<s>`` for
+  one family (``&rate=1`` adds reset-aware counter rate/delta), no
+  ``name`` for the default bundle of key serve/SLO/device series the
+  dashboard's sparklines plot (``start_serve_server`` starts the
+  background sampler);
+* ``POST /debug/profile?seconds=N`` — guarded on-demand device
+  profiling (``obs.profiler``): single-flight, auto-stopped, lands
+  ``jax.profiler`` + span-ring trace artifacts in the profile dir; a
+  second start while one runs is **409**. ``GET /debug/profile`` shows
+  the active/last capture;
 * ``GET /dashboard`` — one self-contained HTML page polling those
-  endpoints: the live ops view.
+  endpoints: the live ops view, now with history sparklines.
 
 Threaded (one request per handler thread) — concurrency funnels into the
 engine's micro-batchers, which is the whole point. The per-request
@@ -50,7 +61,9 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import profiler as profiler_mod
 from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
 from spark_rapids_ml_tpu.serve.batching import (
     BatcherClosed,
     DeadlineExpired,
@@ -65,10 +78,78 @@ from spark_rapids_ml_tpu.serve.faults import fault_plane
 _MAX_BODY_BYTES = 64 * 1024 * 1024  # refuse absurd request bodies
 _TRACE_ROOT_PREFIXES = ("serve:http", "serve:request")
 _DEFAULT_TRACE_LIMIT = 20
+_DEFAULT_HISTORY_WINDOW = 300.0
+_MAX_HISTORY_WINDOW = 24 * 3600.0
 
 
 def _json_safe(outputs: np.ndarray):
     return np.asarray(outputs).tolist()
+
+
+def _query_float(params, key: str, default: float,
+                 lo: float, hi: float) -> float:
+    try:
+        value = float(params.get(key, [default])[0])
+    except (TypeError, ValueError):
+        return default
+    return min(max(value, lo), hi)
+
+
+def history_document(params) -> dict:
+    """The ``GET /debug/history`` body for parsed query params.
+
+    ``?name=<metric>`` → every matching child series (``model=`` narrows
+    by label, ``rate=1`` adds reset-aware rate/delta for counters);
+    without ``name`` → the default bundle of key series the dashboard
+    sparklines plot, plus sampler health."""
+    store = tsdb_mod.get_tsdb()
+    window = _query_float(params, "window", _DEFAULT_HISTORY_WINDOW,
+                          1.0, _MAX_HISTORY_WINDOW)
+    name = (params.get("name", [None])[0] or "").strip()
+    model = (params.get("model", [None])[0] or "").strip()
+    labels = {"model": model} if model else None
+    if name:
+        doc = {
+            "name": name,
+            "window": window,
+            "series": store.range_query(name, labels, window),
+        }
+        if params.get("rate", [""])[0] in ("1", "true"):
+            doc["rate_series"] = store.rate_points(name, labels, window)
+            doc["rate_per_sec"] = store.rate(name, labels, window)
+            doc["delta"] = store.delta(name, labels, window)
+        return doc
+    sampler = tsdb_mod.get_sampler()
+    return {
+        "window": window,
+        "series_names": store.series_names(),
+        "sampler": {
+            "running": sampler.running,
+            "interval_seconds": sampler.interval_seconds,
+            "sweeps": sampler.sweeps,
+            "series_count": store.series_count(),
+            "dropped_series": store.dropped_series(),
+        },
+        "key": {
+            "queue_depth": store.range_query(
+                "sparkml_serve_queue_depth", None, window),
+            "p99_latency_seconds": store.range_query(
+                "sparkml_serve_request_latency_seconds",
+                {"quantile": "0.99"}, window),
+            "request_rate": store.rate_points(
+                "sparkml_serve_requests_total", None, window),
+            "requests_total": store.range_query(
+                "sparkml_serve_requests_total", None, window),
+            "device_mem_bytes_in_use": store.range_query(
+                "sparkml_device_mem_bytes_in_use", None, window),
+            "device_busy_rate": store.rate_points(
+                "sparkml_serve_device_batch_seconds_total", None, window),
+            "obs_overhead_rate": store.rate_points(
+                "sparkml_obs_overhead_seconds_total", None, window),
+            "slo_budget_remaining": store.range_query(
+                "sparkml_slo_budget_remaining", None, window),
+        },
+    }
 
 
 
@@ -177,6 +258,15 @@ def make_handler(engine: ServeEngine):
                 snap["retries_total"] = m_retries.total()
                 snap["worker_restarts_total"] = m_restarts.total()
                 status = self._reply(200, snap)
+            elif path == "/debug/history":
+                params = urllib.parse.parse_qs(parsed.query)
+                status = self._reply(200, history_document(params))
+            elif path == "/debug/profile":
+                status = self._reply(200, {
+                    "active": profiler_mod.capture_active(),
+                    "last": profiler_mod.last_capture(),
+                    "dir": profiler_mod.profile_dir(),
+                })
             elif path == "/dashboard":
                 status = self._reply_text(
                     200, DASHBOARD_HTML, "text/html; charset=utf-8")
@@ -189,7 +279,12 @@ def make_handler(engine: ServeEngine):
             m_http_requests.inc(path=path, status=str(status))
 
         def do_POST(self):  # noqa: N802 - http.server API
-            path = self.path.split("?")[0]
+            parsed = urllib.parse.urlparse(self.path)
+            path = parsed.path
+            if path == "/debug/profile":
+                status = self._handle_profile(parsed)
+                m_http_requests.inc(path=path, status=str(status))
+                return
             if path != "/predict":
                 status = self._reply(404,
                                      {"error": f"unknown path {path!r}"})
@@ -211,6 +306,39 @@ def make_handler(engine: ServeEngine):
                 path=path, status=str(status),
             )
             m_http_requests.inc(path=path, status=str(status))
+
+        def _handle_profile(self, parsed) -> int:
+            """``POST /debug/profile?seconds=N``: start a single-flight
+            on-demand capture (``obs.profiler``). 200 with the capture
+            info; 409 while one is already running."""
+            # Parameters ride the query string, but clients may still
+            # POST a body (curl -d '{}') — drain it, or a keep-alive
+            # connection parses the leftover bytes as its next request.
+            try:
+                length = int(self.headers.get("Content-Length", 0) or 0)
+            except (TypeError, ValueError):
+                length = -1
+            if 0 < length <= _MAX_BODY_BYTES:
+                self.rfile.read(length)
+            elif length != 0:
+                self.close_connection = True
+            params = urllib.parse.parse_qs(parsed.query)
+            seconds = _query_float(params, "seconds", 5.0,
+                                   0.05, profiler_mod.MAX_SECONDS)
+            label = (params.get("label", ["ondemand"])[0]
+                     or "ondemand")
+            try:
+                info = profiler_mod.start_capture(seconds, label=label)
+            except profiler_mod.CaptureInFlight as exc:
+                return self._reply(409, {
+                    "error": str(exc),
+                    "active": profiler_mod.capture_active(),
+                })
+            except Exception as exc:  # noqa: BLE001 - surface, don't die
+                return self._reply(500, {
+                    "error": f"{type(exc).__name__}: {exc}"
+                })
+            return self._reply(200, {"started": info})
 
         def _handle_predict(self, ctx: tracectx.TraceContext) -> int:
             """Parse, predict, reply; returns the HTTP status it sent.
@@ -290,7 +418,10 @@ def start_serve_server(
 ) -> http.server.HTTPServer:
     """Serve the engine on a daemon thread; returns the HTTPServer (bind
     ``port=0`` for ephemeral — read ``server.server_address[1]``; stop
-    with ``server.shutdown()``, then ``engine.shutdown()`` to drain)."""
+    with ``server.shutdown()``, then ``engine.shutdown()`` to drain).
+    Also starts the background history sampler (``obs.tsdb``) so
+    ``/debug/history`` and the dashboard sparklines have data."""
+    tsdb_mod.start_sampling()
     server = _Server((addr, port), make_handler(engine))
     thread = tracectx.traced_thread(
         server.serve_forever, name="sparkml-serve-http", daemon=True,
@@ -325,6 +456,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
     --status-serious: #ec835a;
     --status-critical: #d03b3b;
     --border: #d9d8d4;
+    --series-1: #2a78d6;
   }
   @media (prefers-color-scheme: dark) {
     :root:where(:not([data-theme="light"])) .viz-root {
@@ -334,6 +466,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
       --text-primary: #ffffff;
       --text-secondary: #c3c2b7;
       --border: #44443f;
+      --series-1: #3987e5;
     }
   }
   :root[data-theme="dark"] .viz-root {
@@ -343,6 +476,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
     --text-primary: #ffffff;
     --text-secondary: #c3c2b7;
     --border: #44443f;
+    --series-1: #3987e5;
   }
   body { margin: 0; }
   .viz-root {
@@ -379,15 +513,28 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   pre { background: var(--surface-2); border-radius: 6px; padding: 10px;
         overflow-x: auto; font-size: 11px; }
   .quiet { color: var(--text-secondary); }
+  svg.spark { display: block; margin-top: 6px; overflow: visible; }
+  svg.spark polyline { stroke: var(--series-1); fill: none;
+       stroke-width: 2; stroke-linejoin: round; stroke-linecap: round; }
+  svg.spark circle { fill: var(--series-1); }
+  #tip { position: fixed; display: none; pointer-events: none;
+       background: var(--surface-2); color: var(--text-primary);
+       border: 1px solid var(--border); border-radius: 4px;
+       padding: 2px 7px; font-size: 11px; z-index: 10;
+       font-variant-numeric: tabular-nums; }
 </style>
 </head>
 <body>
 <div class="viz-root">
   <h1>Serving ops</h1>
   <p class="sub">live view over <span class="mono">/debug/slo</span>,
+    <span class="mono">/debug/history</span>,
     <span class="mono">/debug/traces</span>, and
     <span class="mono">/healthz</span> · refreshes every 2&thinsp;s</p>
   <div class="tiles" id="tiles"></div>
+  <h2>Metrics history · last 5 min</h2>
+  <div class="tiles" id="history">—</div>
+  <div id="tip"></div>
   <h2>SLO burn rates</h2>
   <table><thead><tr><th>Objective</th><th>Target</th><th>5m</th><th>30m</th>
     <th>1h</th><th>6h</th><th>Budget left</th><th>State</th></tr></thead>
@@ -414,18 +561,149 @@ function stateFor(slo) {
   if (rates.some(r => r > 1)) return ["warning", "\\u25cf burning budget"];
   return ["good", "\\u25cf within budget"];
 }
-function tile(label, value) {
+function tile(label, value, trend) {
   return '<div class="tile"><div class="label">' + label +
-    '</div><div class="value">' + value + "</div></div>";
+    '</div><div class="value">' + value + "</div>" + (trend || "") +
+    "</div>";
 }
+function fmtVal(v) {
+  if (v == null || !isFinite(v)) return "\\u2013";
+  var a = Math.abs(v);
+  if (a >= 1e9) return (v / 1e9).toFixed(1) + "G";
+  if (a >= 1e6) return (v / 1e6).toFixed(1) + "M";
+  if (a >= 1e3) return (v / 1e3).toFixed(1) + "K";
+  if (a >= 100) return v.toFixed(0);
+  if (a >= 1) return v.toFixed(2);
+  if (a === 0) return "0";
+  return v.toPrecision(3);
+}
+var SPARK_W = 150, SPARK_H = 36;
+function sparkSvg(points) {
+  // one series per sparkline (the tile label names it — no legend);
+  // 2px line in --series-1, last point dotted, values live in #tip
+  if (!points || points.length < 2)
+    return '<div class="spark quiet" style="height:' + SPARK_H +
+      'px;font-size:11px;margin-top:6px">collecting\\u2026</div>';
+  var t0 = points[0][0], t1 = points[points.length - 1][0];
+  var vs = points.map(function (p) { return p[1]; });
+  var lo = Math.min.apply(null, vs), hi = Math.max.apply(null, vs);
+  if (hi === lo) hi = lo + 1;
+  var pad = 3;
+  function xy(p) {
+    var x = pad + (SPARK_W - 2 * pad) *
+      (t1 === t0 ? 1 : (p[0] - t0) / (t1 - t0));
+    var y = pad + (SPARK_H - 2 * pad) * (1 - (p[1] - lo) / (hi - lo));
+    return [x, y];
+  }
+  var line = points.map(function (p) {
+    var c = xy(p);
+    return c[0].toFixed(1) + "," + c[1].toFixed(1);
+  }).join(" ");
+  var last = xy(points[points.length - 1]);
+  return '<svg class="spark" width="' + SPARK_W + '" height="' +
+    SPARK_H + '" data-points=\\'' + JSON.stringify(points) +
+    '\\' role="img"><polyline points="' + line + '"/><circle cx="' +
+    last[0].toFixed(1) + '" cy="' + last[1].toFixed(1) +
+    '" r="2.5"/></svg>';
+}
+function seriesLabel(prefix, labels) {
+  var parts = [];
+  ["model", "device", "component", "outcome"].forEach(function (k) {
+    if (labels && labels[k]) parts.push(labels[k]);
+  });
+  return prefix + (parts.length ? " \\u00b7 " + parts.join(" / ") : "");
+}
+function trendTile(prefix, series, fmt) {
+  var pts = series.points || [];
+  var cur = pts.length ? pts[pts.length - 1][1] : null;
+  return tile(seriesLabel(prefix, series.labels),
+              (fmt || fmtVal)(cur), sparkSvg(pts));
+}
+function historyTiles(hist) {
+  var key = (hist && hist.key) || {};
+  var tiles = [];
+  (key.queue_depth || []).forEach(function (s) {
+    tiles.push(trendTile("queue depth", s));
+  });
+  (key.p99_latency_seconds || []).forEach(function (s) {
+    tiles.push(trendTile("p99 latency", s, function (v) {
+      return v == null ? "\\u2013" : (1000 * v).toFixed(1) + " ms";
+    }));
+  });
+  (key.request_rate || []).forEach(function (s) {
+    if (s.labels && s.labels.outcome && s.labels.outcome !== "ok")
+      return;  // error outcomes live in the SLO table
+    tiles.push(trendTile("req/s", s, function (v) {
+      return v == null ? "\\u2013" : fmtVal(v) + "/s";
+    }));
+  });
+  (key.device_mem_bytes_in_use || []).forEach(function (s) {
+    tiles.push(trendTile("mem in use", s, function (v) {
+      return v == null ? "\\u2013" : fmtVal(v) + "B";
+    }));
+  });
+  (key.device_busy_rate || []).forEach(function (s) {
+    tiles.push(trendTile("device busy", s, function (v) {
+      return v == null ? "\\u2013" : (100 * v).toFixed(1) + "%";
+    }));
+  });
+  (key.obs_overhead_rate || []).forEach(function (s) {
+    tiles.push(trendTile("obs overhead", s, function (v) {
+      return v == null ? "\\u2013" : (100 * v).toFixed(2) + "%";
+    }));
+  });
+  return tiles;
+}
+document.addEventListener("mousemove", function (e) {
+  var tip = document.getElementById("tip");
+  var svg = e.target && e.target.closest
+    ? e.target.closest("svg.spark") : null;
+  if (!svg) { if (tip) tip.style.display = "none"; return; }
+  var points = [];
+  try { points = JSON.parse(svg.getAttribute("data-points")); }
+  catch (err) { return; }
+  if (!points.length) return;
+  var rect = svg.getBoundingClientRect();
+  var frac = Math.min(Math.max(
+    (e.clientX - rect.left) / rect.width, 0), 1);
+  var idx = Math.round(frac * (points.length - 1));
+  var p = points[idx];
+  var ago = Math.max(0, Date.now() / 1000 - p[0]);
+  tip.textContent = fmtVal(p[1]) + " \\u00b7 " +
+    (ago < 120 ? ago.toFixed(0) + " s ago"
+               : (ago / 60).toFixed(1) + " min ago");
+  tip.style.left = (e.clientX + 12) + "px";
+  tip.style.top = (e.clientY + 12) + "px";
+  tip.style.display = "block";
+});
 function statusSpan(cls, text) {
   return '<span class="status ' + cls + '"><span class="dot"></span>' +
     text.replace("\\u25cf ", "") + "</span>";
+}
+function sumSeries(seriesList) {
+  // point-wise sum across children keyed by sample timestamp (every
+  // child shares the sampler's sweep timestamps) — the engine-wide
+  // overview tile must trend the SUM, not whichever model's series
+  // happened to come back first
+  var byTs = {};
+  seriesList.forEach(function (s) {
+    (s.points || []).forEach(function (p) {
+      byTs[p[0]] = (byTs[p[0]] || 0) + p[1];
+    });
+  });
+  return Object.keys(byTs).map(function (t) { return parseFloat(t); })
+    .sort(function (a, b) { return a - b; })
+    .map(function (t) { return [t, byTs[t]]; });
 }
 async function refresh() {
   try {
     var slo = await (await fetch("/debug/slo")).json();
     var health = await (await fetch("/healthz")).json();
+    var hist = {};
+    try { hist = await (await fetch("/debug/history")).json(); }
+    catch (err) { hist = {}; }
+    var qdSeries = ((hist.key || {}).queue_depth || []);
+    var qdPoints = qdSeries.length ? sumSeries(qdSeries) : null;
     var breakers = slo.breakers || {};
     var breakerNames = Object.keys(breakers);
     var openCount = breakerNames.filter(
@@ -433,7 +711,8 @@ async function refresh() {
     var tiles = [
       tile("Service", statusSpan(
         health.status === "ok" ? "good" : "warning", health.status)),
-      tile("Queue depth", health.queue_depth),
+      tile("Queue depth", health.queue_depth,
+           qdPoints ? sparkSvg(qdPoints) : ""),
       tile("In flight", (health.inflight || []).length),
       tile("Firing alerts", (slo.alerts || []).length),
       tile("Breakers open", openCount
@@ -448,6 +727,11 @@ async function refresh() {
                       fmtPct(s.budget_remaining)));
     });
     document.getElementById("tiles").innerHTML = tiles.join("");
+    var htiles = historyTiles(hist);
+    document.getElementById("history").innerHTML = htiles.length
+      ? htiles.join("")
+      : '<span class="quiet">no history yet \\u2014 the sampler ' +
+        'populates this within a few seconds</span>';
     document.getElementById("slo-rows").innerHTML =
       (slo.slos || []).map(function (s) {
         var st = stateFor(s);
@@ -516,4 +800,5 @@ setInterval(refresh, 2000);
 """
 
 
-__all__ = ["DASHBOARD_HTML", "make_handler", "start_serve_server"]
+__all__ = ["DASHBOARD_HTML", "history_document", "make_handler",
+           "start_serve_server"]

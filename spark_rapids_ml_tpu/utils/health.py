@@ -70,11 +70,14 @@ def check_devices_subprocess(timeout_seconds: float = 90.0) -> DeviceHealth:
     import subprocess
     import sys
 
+    # The child's stdout is a parsed protocol (last line = the verdict
+    # JSON), written directly — not print, not a logger (a log line is
+    # ALSO JSON and could be mistaken for the verdict).
     code = (
-        "import json\n"
+        "import json, sys\n"
         "from spark_rapids_ml_tpu.utils.health import check_devices\n"
         "h = check_devices()\n"
-        "print(json.dumps(h.__dict__))\n"
+        "sys.stdout.write(json.dumps(h.__dict__) + chr(10))\n"
     )
     t0 = time.perf_counter()
     try:

@@ -1,0 +1,117 @@
+"""obs.devmon: per-device memory gauges and batch-time attribution —
+on the CPU fleet this container has (8 virtual devices via conftest's
+``xla_force_host_platform_device_count``)."""
+
+import numpy as np
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.obs.devmon import DeviceMonitor
+from spark_rapids_ml_tpu.obs.tsdb import TimeSeriesStore
+
+
+def test_sample_publishes_gauges_for_every_cpu_device():
+    import jax
+
+    mon = DeviceMonitor()
+    out = mon.sample()
+    assert len(out) == len(jax.devices())
+    gauge = get_registry().gauge(
+        "sparkml_device_mem_bytes_in_use", "", ("device", "source"))
+    for entry in out:
+        # CPU devices expose no PJRT stats -> host-RSS fallback, and a
+        # host number is never mistaken for an HBM number
+        assert entry["source"] in ("pjrt", "host_rss")
+        assert entry["bytes_in_use"] > 0
+        assert gauge.value(device=entry["device"],
+                           source=entry["source"]) == entry["bytes_in_use"]
+
+
+def test_sample_pjrt_path_with_fake_devices():
+    class FakeDevice:
+        def __init__(self, i):
+            self.i = i
+
+        def memory_stats(self):
+            return {"bytes_in_use": 100 + self.i,
+                    "peak_bytes_in_use": 200 + self.i,
+                    "bytes_limit": 1000}
+
+        def __str__(self):
+            return f"FakeTPU:{self.i}"
+
+    mon = DeviceMonitor(devices_fn=lambda: [FakeDevice(0), FakeDevice(1)])
+    out = mon.sample()
+    assert [e["source"] for e in out] == ["pjrt", "pjrt"]
+    reg = get_registry()
+    assert reg.gauge("sparkml_device_mem_bytes_in_use", "",
+                     ("device", "source")).value(
+        device="FakeTPU:1", source="pjrt") == 101
+    assert reg.gauge("sparkml_device_mem_bytes_limit", "",
+                     ("device", "source")).value(
+        device="FakeTPU:0", source="pjrt") == 1000
+    assert reg.gauge("sparkml_device_mem_peak_bytes", "",
+                     ("device", "source")).value(
+        device="FakeTPU:1", source="pjrt") == 201
+
+
+def test_note_batch_attributes_device_time():
+    mon = DeviceMonitor()
+    mon.note_batch("devmon_model", 0.25)
+    mon.note_batch("devmon_model", 0.75)
+    device = mon.default_device_label()
+    reg = get_registry()
+    assert reg.counter(
+        "sparkml_serve_device_batch_seconds_total", "",
+        ("model", "device")).value(
+        model="devmon_model", device=device) == 1.0
+    assert reg.counter(
+        "sparkml_serve_device_batches_total", "",
+        ("model", "device")).value(
+        model="devmon_model", device=device) == 2.0
+
+
+def test_note_batch_never_raises_on_broken_device_fn():
+    def broken():
+        raise RuntimeError("no devices")
+
+    mon = DeviceMonitor(devices_fn=broken)
+    mon.note_batch("m", 0.1)  # must not raise
+    assert mon.default_device_label() == "unknown"
+
+
+def test_batcher_wires_attribution_through_devmon(rng):
+    """An executed micro-batch lands device seconds for its model."""
+    from spark_rapids_ml_tpu.serve.batching import MicroBatcher
+
+    batcher = MicroBatcher(lambda m: m * 2.0, name="devmon_wired",
+                           max_batch_rows=32, max_wait_ms=1.0)
+    try:
+        req = batcher.submit(rng.normal(size=(4, 3)))
+        req.wait(10.0)
+    finally:
+        batcher.close()
+    counter = get_registry().counter(
+        "sparkml_serve_device_batch_seconds_total", "",
+        ("model", "device"))
+    total = sum(
+        counter.value(**dict(zip(("model", "device"), key)))
+        for key, _child in counter._samples()
+        if key[0] == "devmon_wired"
+    )
+    assert total > 0.0
+
+
+def test_occupancy_reads_from_history(monkeypatch):
+    from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+
+    store = TimeSeriesStore(tiers=((1.0, 300.0),),
+                            clock=lambda: 1010.0)
+    # 1 s of device time per 1 s wall-clock = occupancy 1.0
+    for i in range(10):
+        store.record("sparkml_serve_device_batch_seconds_total",
+                     {"model": "m", "device": "d0"}, float(i),
+                     kind="counter", now=1000.0 + i)
+    monkeypatch.setattr(tsdb_mod, "_store", store)
+    mon = DeviceMonitor(devices_fn=lambda: [])
+    occ = mon.occupancy(window=60.0)
+    assert occ == {"d0": 1.0}

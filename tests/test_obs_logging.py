@@ -1,0 +1,162 @@
+"""obs.logging: structured JSON lines, level gating, trace-id stamping,
+and the flight recorder's dump notice going through it (not print)."""
+
+import io
+import json
+
+from spark_rapids_ml_tpu.obs import tracectx
+from spark_rapids_ml_tpu.obs.logging import (
+    LEVEL_ENV,
+    StructuredLogger,
+    get_logger,
+)
+
+
+def _lines(stream):
+    return [json.loads(line) for line in
+            stream.getvalue().splitlines() if line.strip()]
+
+
+def test_log_line_is_one_json_object_with_fields():
+    stream = io.StringIO()
+    log = StructuredLogger("test.module", stream=stream)
+    log.info("model registered", model="pca", version=3)
+    (rec,) = _lines(stream)
+    assert rec["level"] == "info"
+    assert rec["logger"] == "test.module"
+    assert rec["message"] == "model registered"
+    assert rec["model"] == "pca" and rec["version"] == 3
+    assert "T" in rec["ts"]  # ISO timestamp
+
+
+def test_level_gate_from_env(monkeypatch):
+    stream = io.StringIO()
+    log = StructuredLogger("gated", stream=stream)
+    monkeypatch.setenv(LEVEL_ENV, "warning")
+    log.info("dropped")
+    log.debug("dropped")
+    log.warning("kept")
+    log.error("kept too")
+    assert [r["level"] for r in _lines(stream)] == ["warning", "error"]
+    monkeypatch.setenv(LEVEL_ENV, "debug")
+    log.debug("now visible")
+    assert _lines(stream)[-1]["message"] == "now visible"
+
+
+def test_trace_id_stamped_from_active_context():
+    stream = io.StringIO()
+    log = StructuredLogger("traced", stream=stream)
+    ctx = tracectx.new_context()
+    with tracectx.activate(ctx):
+        log.info("inside request")
+    log.info("outside request")
+    inside, outside = _lines(stream)
+    assert inside["trace_id"] == ctx.trace_id
+    assert "trace_id" not in outside
+
+
+def test_non_serializable_fields_degrade_to_str():
+    stream = io.StringIO()
+    log = StructuredLogger("weird", stream=stream)
+    log.info("odd payload", payload=object())
+    (rec,) = _lines(stream)
+    assert "object object at" in rec["payload"]
+
+
+def test_logger_never_raises_on_broken_stream():
+    class Broken:
+        def write(self, _):
+            raise OSError("disk full")
+
+    log = StructuredLogger("broken", stream=Broken())
+    log.error("this must not raise")
+
+
+def test_get_logger_is_cached_per_name():
+    assert get_logger("same") is get_logger("same")
+    assert get_logger("same") is not get_logger("other")
+
+
+def test_log_lines_counted_in_registry():
+    from spark_rapids_ml_tpu.obs import get_registry
+
+    counter = get_registry().counter(
+        "sparkml_log_lines_total", "", ("level",))
+    before = counter.value(level="warning")
+    StructuredLogger("counted", stream=io.StringIO()).warning("one")
+    assert counter.value(level="warning") == before + 1
+
+
+def _rule7(path):
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        from check_instrumentation import check_print_calls
+    finally:
+        sys.path.pop(0)
+    return list(check_print_calls(str(path)))
+
+
+def test_rule7_accepts_current_library_modules():
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        from check_instrumentation import check_print_calls, library_files
+    finally:
+        sys.path.pop(0)
+    files = library_files()
+    assert files, "library_files() found nothing — glob broke"
+    for path in files:
+        assert list(check_print_calls(path)) == [], path
+
+
+def test_rule7_rejects_bare_print(tmp_path):
+    bad = tmp_path / "module.py"
+    bad.write_text(
+        "def f():\n"
+        "    print('debugging left in')\n"
+    )
+    offenders = _rule7(bad)
+    assert len(offenders) == 1
+    assert offenders[0][0] == 2
+    assert "bare print(" in offenders[0][1]
+
+
+def test_rule7_accepts_print_in_string_literal(tmp_path):
+    ok = tmp_path / "module.py"
+    ok.write_text(
+        'CODE = "print(json.dumps(h))"\n'
+        "def f(stream):\n"
+        "    stream.write('print is just a word here')\n"
+    )
+    assert _rule7(ok) == []
+
+
+def test_rule7_accepts_shadowed_attribute_print(tmp_path):
+    ok = tmp_path / "module.py"
+    ok.write_text(
+        "def f(console):\n"
+        "    console.print('rich-style method, not the builtin')\n"
+    )
+    assert _rule7(ok) == []
+
+
+def test_flight_dump_notice_is_structured(tmp_path, monkeypatch, capsys):
+    from spark_rapids_ml_tpu.obs import flight
+
+    monkeypatch.setenv(flight.DUMP_DIR_ENV, str(tmp_path))
+    path = flight.dump("logging_test")
+    assert path is not None
+    err = capsys.readouterr().err
+    recs = [json.loads(line) for line in err.splitlines()
+            if line.strip().startswith("{")]
+    notice = [r for r in recs if r.get("message") == "flight dump written"]
+    assert notice and notice[0]["reason"] == "logging_test"
+    assert notice[0]["path"] == path
+    assert notice[0]["logger"] == "obs.flight"

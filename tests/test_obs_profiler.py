@@ -1,0 +1,105 @@
+"""obs.profiler: guarded on-demand capture — single-flight, auto-stop,
+non-empty artifacts on CPU. Every test drains the capture (and its jax
+helper thread) before returning so nothing leaks into teardown.
+
+Only the smoke test exercises the REAL ``jax.profiler`` (the artifact
+contract). The logic tests (single-flight, early stop, span/status)
+fake it: the real ``start_trace`` can stall for ~30 s holding the GIL
+when other suite tests left threads mid-computation, which turns a
+timing-free logic assertion into a flake."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import get_registry, profiler, span
+
+
+@pytest.fixture
+def profile_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(profiler.PROFILE_DIR_ENV, str(tmp_path))
+    yield str(tmp_path)
+    profiler.wait(30.0)
+
+
+@pytest.fixture
+def fake_jax_profiler(monkeypatch):
+    """Instant start/stop_trace: capture-logic tests must not depend on
+    the real profiler backend's mood (or the suite's CPU load)."""
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda path: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    profiler.wait(30.0)  # drain any real helper a prior test left
+    yield
+    profiler.wait(30.0)  # drain before the fakes are torn down
+
+
+def _counter_value(outcome):
+    return get_registry().counter(
+        "sparkml_obs_profile_captures_total", "", ("outcome",)
+    ).value(outcome=outcome)
+
+
+def test_capture_lands_nonempty_trace_artifact(profile_dir):
+    started_before = _counter_value("started")
+    info = profiler.start_capture(0.3, label="smoke")
+    assert info["path"].startswith(profile_dir)
+    # activity inside the window so the span ring has content
+    with span("profiler_test_work", rows=8):
+        np.ones((64, 64)) @ np.ones((64, 64))
+    result = profiler.wait(30.0)
+    assert result is not None and result["id"] == info["id"]
+    assert result["artifacts"], "capture produced no artifacts"
+    assert any(a["bytes"] > 0 for a in result["artifacts"])
+    # the span-ring chrome trace is always one of them, and loads
+    assert result["spans_trace"] and os.path.exists(result["spans_trace"])
+    doc = json.load(open(result["spans_trace"]))
+    assert any(e["name"] == "profiler_test_work"
+               for e in doc["traceEvents"])
+    assert _counter_value("started") == started_before + 1
+    assert _counter_value("completed") >= 1
+    assert profiler.capture_active() is None
+
+
+def test_single_flight_second_start_rejected(profile_dir,
+                                             fake_jax_profiler):
+    profiler.start_capture(0.3, label="first")
+    with pytest.raises(profiler.CaptureInFlight):
+        profiler.start_capture(0.2, label="second")
+    profiler.wait(30.0)
+    # after it lands, a new capture is admitted again
+    profiler.start_capture(0.1, label="third")
+    result = profiler.wait(30.0)
+    assert result["id"].startswith("third")
+
+
+def test_stop_capture_ends_window_early(profile_dir, fake_jax_profiler):
+    profiler.start_capture(60.0, label="early")  # would run a minute
+    result = profiler.stop_capture()
+    assert result is not None and result["id"].startswith("early")
+    assert result["elapsed_seconds"] < 30.0
+    assert profiler.capture_active() is None
+
+
+def test_capture_records_profile_span_and_status(profile_dir,
+                                                 fake_jax_profiler):
+    from spark_rapids_ml_tpu.obs import get_recorder
+
+    profiler.start_capture(0.15, label="spanned")
+    result = profiler.wait(30.0)
+    events = [e for e in get_recorder().events()
+              if e.name == "obs:profile"
+              and e.args.get("capture_id") == result["id"]]
+    assert len(events) == 1
+    assert profiler.last_capture()["id"] == result["id"]
+
+
+def test_seconds_clamped_and_label_sanitized(profile_dir,
+                                             fake_jax_profiler):
+    info = profiler.start_capture(10_000, label="../we ird/..")
+    assert info["seconds"] == profiler.MAX_SECONDS
+    assert "/" not in os.path.basename(info["path"])
+    profiler.stop_capture()

@@ -1,0 +1,168 @@
+"""ISSUE 7 acceptance e2e: the serve engine under mixed traffic with
+≥30 s of injected-clock history samples — ``/debug/history`` returns
+non-empty, monotonically-timestamped series for queue depth and p99
+latency whose rate/delta math matches the registry's final counters,
+``/dashboard`` renders sparklines from it, and a ``/debug/profile``
+capture during traffic lands a loadable trace artifact."""
+
+import concurrent.futures
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import flight, get_registry
+from spark_rapids_ml_tpu.obs import profiler as profiler_mod
+from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+from spark_rapids_ml_tpu.serve import (
+    ModelRegistry,
+    ServeEngine,
+    start_serve_server,
+)
+
+
+@pytest.fixture
+def served_history_pca(rng, tmp_path, monkeypatch):
+    from spark_rapids_ml_tpu import PCA
+
+    monkeypatch.setenv(profiler_mod.PROFILE_DIR_ENV,
+                       str(tmp_path / "profiles"))
+    tsdb_mod.reset_tsdb()
+    x = rng.normal(size=(512, 16))
+    model = PCA().setK(4).fit(x)
+    reg = ModelRegistry()
+    reg.register("pca_hist", model, buckets=(32, 64))
+    engine = ServeEngine(reg, max_batch_rows=64, max_wait_ms=5,
+                         buckets=(32, 64))
+    reg.warmup("pca_hist")
+    server = start_serve_server(engine)  # also starts the sampler
+    try:
+        yield engine, server, x
+    finally:
+        server.shutdown()
+        engine.shutdown()
+        profiler_mod.wait(timeout=30.0)
+        tsdb_mod.stop_sampling()
+        flight.unregister_dump_section("metrics_history")
+        tsdb_mod.reset_tsdb()
+
+
+def _get(base, path):
+    resp = urllib.request.urlopen(f"{base}{path}", timeout=30)
+    return json.loads(resp.read())
+
+
+def _assert_monotonic(points):
+    ts = [p[0] for p in points]
+    assert ts == sorted(ts)
+    assert len(set(ts)) == len(ts)
+
+
+def test_history_profile_dashboard_e2e(served_history_pca):
+    engine, server, x = served_history_pca
+    host, port = server.server_address
+    base = f"http://{host}:{port}"
+
+    # Own the cadence: stop the background thread the server started and
+    # drive the SAME process-wide sampler with an injected clock — 36
+    # one-second samples cost zero real seconds. Timestamps are anchored
+    # just behind the wall clock so the HTTP window queries cover them.
+    sampler = tsdb_mod.get_sampler()
+    sampler.stop()
+    t_base = time.time() - 40.0
+
+    def predict(i):
+        n = 1 + (i * 7) % 48
+        start = (i * 13) % (x.shape[0] - n)
+        body = json.dumps(
+            {"model": "pca_hist", "rows": x[start:start + n].tolist()}
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+    # one request, then the first sample: the requests_total child
+    # exists from sample 0, so the history's delta covers the rest
+    predict(0)
+    sampler.sample_once(now=t_base)
+
+    # mixed traffic interleaved with 36 injected-clock seconds; a
+    # profile capture starts mid-traffic (single-flight, auto-stop)
+    profile_started = None
+    with concurrent.futures.ThreadPoolExecutor(4) as pool:
+        futures = [pool.submit(predict, i) for i in range(1, 41)]
+        for i in range(1, 37):
+            sampler.sample_once(now=t_base + i)
+            if i == 5:
+                req = urllib.request.Request(
+                    f"{base}/debug/profile?seconds=0.4&label=e2e",
+                    data=b"", method="POST")
+                profile_started = json.loads(
+                    urllib.request.urlopen(req, timeout=30).read())
+        results = [f.result() for f in futures]
+    assert all(len(r["outputs"]) >= 1 for r in results)
+    sampler.sample_once(now=t_base + 37.0)  # final counters, sampled
+
+    # -- /debug/history: the default bundle ------------------------------
+    hist = _get(base, "/debug/history?window=300")
+    qd = [s for s in hist["key"]["queue_depth"]
+          if s["labels"].get("model") == "pca_hist"]
+    assert qd and len(qd[0]["points"]) >= 30
+    _assert_monotonic(qd[0]["points"])
+    p99 = hist["key"]["p99_latency_seconds"]
+    assert p99 and all(len(s["points"]) >= 1 for s in p99)
+    for s in p99:
+        _assert_monotonic(s["points"])
+        assert all(v >= 0 for _ts, v in s["points"])
+    assert hist["sampler"]["series_count"] >= 2
+
+    # -- rate/delta math vs the registry's final counters ----------------
+    doc = _get(base, "/debug/history?name=sparkml_serve_requests_total"
+                     "&model=pca_hist&rate=1&window=300")
+    series = doc["series"]
+    assert series
+    reg_total = 0.0
+    snap = get_registry().snapshot()["sparkml_serve_requests_total"]
+    for sample in snap["samples"]:
+        if sample["labels"].get("model") == "pca_hist":
+            reg_total += sample["value"]
+    sampled_final = sum(s["points"][-1][1] for s in series)
+    sampled_first = sum(s["points"][0][1] for s in series)
+    assert sampled_final == reg_total  # last sample = the live counter
+    # no resets happened, so delta must be exactly last - first
+    assert doc["delta"] == pytest.approx(sampled_final - sampled_first)
+    assert doc["rate_per_sec"] == pytest.approx(
+        doc["delta"] / (37.0 - 0.0))
+    # and at least the 40 post-first-sample requests are in the delta
+    assert doc["delta"] >= 40
+
+    # -- /dashboard renders sparklines from the history ------------------
+    resp = urllib.request.urlopen(f"{base}/dashboard", timeout=30)
+    page = resp.read().decode()
+    assert "/debug/history" in page
+    assert "sparkSvg" in page and "svg.spark" in page
+    assert 'id="history"' in page
+
+    # -- the profile capture landed a loadable trace artifact ------------
+    assert profile_started is not None and "started" in profile_started
+    deadline = time.time() + 30.0
+    last = None
+    while time.time() < deadline:
+        status = _get(base, "/debug/profile")
+        last = status["last"]
+        if last is not None and status["active"] is None:
+            break
+        time.sleep(0.1)
+    assert last is not None, "profile capture never completed"
+    assert last["artifacts"], "capture produced no artifacts"
+    assert all(a["bytes"] > 0 for a in last["artifacts"])
+    assert last["spans_trace"]
+    with open(last["spans_trace"]) as f:
+        trace_doc = json.load(f)
+    events = (trace_doc["traceEvents"]
+              if isinstance(trace_doc, dict) else trace_doc)
+    assert events, "span-ring chrome trace is empty"
+    assert any("ts" in e for e in events)
