@@ -19,11 +19,22 @@ fault class at a time, measuring what a client on the wire experiences:
 * **recovery**   — faults cleared: a half-open probe closes the
   breaker and availability returns to 1.0.
 
+The drill also asserts the **auto-incident loop** (``obs.incidents``,
+installed on the sampler by the serve server): each injected fault
+class must open EXACTLY ONE deduped incident from its expected detector
+(``raise``/``stall``/``nan`` → ``serve_error_rate``, ``latency`` →
+``serve_p99_spike``) with an evidence bundle on disk (incident +
+implicated-series history + a flight dump), and that incident must
+auto-resolve after the fault clears. The drill compresses the loop via
+env knobs set below (100 ms sampling cadence, 8 s detector windows,
+1 s reopen cooldown) — the same engine, just faster.
+
 Every request gets exactly one terminal outcome (the drill exits 1 if
-any hangs past its client timeout, or if availability under fault drops
-below ``SPARKML_CHAOS_MIN_AVAILABILITY``, default 0.5), and the drill
-emits ONE ``bench_common.emit_record`` line the perf sentinel can judge
-against committed history:
+any hangs past its client timeout, if availability under fault drops
+below ``SPARKML_CHAOS_MIN_AVAILABILITY``, default 0.5, or if any
+fault class fails its incident contract), and the drill emits ONE
+``bench_common.emit_record`` line the perf sentinel can judge against
+committed history:
 
 * ``availability_baseline`` / ``availability_under_fault`` /
   ``availability_recovery`` — fraction of requests answered 200
@@ -32,7 +43,10 @@ against committed history:
   fallback;
 * ``breaker_open_seconds``  — how long the breaker was open during the
   drill (lower = faster recovery);
-* ``recovery_seconds``      — fault cleared → breaker closed again.
+* ``recovery_seconds``      — fault cleared → breaker closed again;
+* ``incidents_opened`` / ``incidents_resolved`` — auto-incident totals
+  over the drill (opened counts everything the detectors saw,
+  including cross-cutting ones like breaker flaps or SLO fast-burn).
 
 Knobs (env): SPARKML_CHAOS_REQUESTS (per phase, default 24),
 SPARKML_CHAOS_FEATURES (16), SPARKML_CHAOS_K (4).
@@ -47,7 +61,24 @@ import time
 import urllib.error
 import urllib.request
 
-import numpy as np
+# Compress the detect→diagnose→resolve loop BEFORE the package is
+# imported (the engine reads these at construction): 100 ms sampling
+# into a 100 ms-resolution history tier (the default 1 s tier would
+# quantize the 100 ms cadence right back to one point per second),
+# 8 s detector windows, 2-sweep hysteresis, 1 s reopen cooldown, and no
+# incident-triggered profile captures (the drill hammers the backend —
+# a capture here would only add noise to the thing being measured).
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_SAMPLE_MS", "100")
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_HISTORY",
+                      "0.1x120,1x600")
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_WINDOW_S", "8")
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_OPEN_AFTER", "2")
+os.environ.setdefault(
+    "SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_RESOLVE_AFTER", "3")
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_COOLDOWN_S", "1")
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_CAPTURE_S", "0")
+
+import numpy as np  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -82,6 +113,82 @@ def _post_predict(base: str, model: str, rows, timeout: float = 15.0):
         return exc.code, payload
     except Exception as exc:  # noqa: BLE001 - hang/reset IS the result
         return 0, {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _get_json(base: str, path: str, timeout: float = 10.0) -> dict:
+    try:
+        resp = urllib.request.urlopen(f"{base}{path}", timeout=timeout)
+        return json.loads(resp.read())
+    except Exception:  # noqa: BLE001 - a dead ops endpoint IS a finding
+        return {}
+
+
+def _incident_entries(doc: dict, detector: str) -> list:
+    return [i for i in (doc.get("open", []) + doc.get("recent", []))
+            if i.get("detector") == detector]
+
+
+def _await_new_incidents(base: str, detector: str, known_ids: set,
+                         budget: float = 15.0) -> list:
+    """Poll ``/debug/incidents`` until the detector grows a NEW
+    incident (then one more beat to catch a dedup failure); returns
+    every new entry seen."""
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        doc = _get_json(base, "/debug/incidents")
+        new = [i for i in _incident_entries(doc, detector)
+               if i.get("id") not in known_ids]
+        if new:
+            # one more detector cadence: continued firing must UPDATE
+            # the incident, not open a sibling
+            time.sleep(1.0)
+            doc = _get_json(base, "/debug/incidents")
+            return [i for i in _incident_entries(doc, detector)
+                    if i.get("id") not in known_ids]
+        time.sleep(0.2)
+    return []
+
+
+def _await_resolved(base: str, incident_id: str,
+                    budget: float = 30.0) -> bool:
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        doc = _get_json(base, "/debug/incidents")
+        for entry in doc.get("recent", []):
+            if (entry.get("id") == incident_id
+                    and entry.get("state") == "resolved"):
+                return True
+        time.sleep(0.2)
+    return False
+
+
+def _bundle_problems(incident: dict) -> list:
+    """What's missing from one incident's on-disk evidence bundle."""
+    problems = []
+    evidence = incident.get("evidence") or {}
+    directory = evidence.get("dir")
+    if not directory or not os.path.isdir(directory):
+        return [f"no evidence dir ({directory!r})"]
+    for fname in ("incident.json", "history.json"):
+        path = os.path.join(directory, fname)
+        if not os.path.isfile(path):
+            problems.append(f"missing {fname}")
+    history_path = os.path.join(directory, "history.json")
+    if os.path.isfile(history_path):
+        try:
+            with open(history_path) as f:
+                history = json.load(f)
+            implicated = history.get("implicated", {})
+            if not implicated.get("series"):
+                problems.append("history.json has no implicated series")
+            if implicated.get("metric") != incident.get("metric"):
+                problems.append("history.json implicates the wrong metric")
+        except ValueError:
+            problems.append("history.json unparseable")
+    dump_path = evidence.get("flight_dump")
+    if not dump_path or not os.path.isfile(dump_path):
+        problems.append(f"no flight dump ({dump_path!r})")
+    return problems
 
 
 def _phase(base: str, model: str, x, n_requests: int, rng):
@@ -143,6 +250,8 @@ def main() -> int:
     base = f"http://127.0.0.1:{server.server_address[1]}"
     plane = fault_plane()
     phases = {}
+    incidents = {}
+    incident_totals = {}
     breaker_open_at = None
     breaker_open_seconds = 0.0
 
@@ -163,14 +272,81 @@ def main() -> int:
             _post_predict(base, "chaos_pca", x[start:start + n])
         return time.monotonic() - t0
 
+    def _known_ids(detector: str) -> set:
+        doc = _get_json(base, "/debug/incidents")
+        return {i.get("id") for i in _incident_entries(doc, detector)}
+
+    def _check_incident_loop(detector: str, known_ids: set) -> dict:
+        """The auto-incident contract for one fault class: exactly one
+        NEW deduped incident from the expected detector, a complete
+        evidence bundle on disk, auto-resolved after recovery."""
+        new = _await_new_incidents(base, detector, known_ids)
+        result = {"detector": detector, "new_incidents": len(new)}
+        if len(new) != 1:
+            result["problems"] = [
+                f"expected exactly 1 new {detector} incident, "
+                f"saw {len(new)}"
+            ]
+            return result
+        incident = new[0]
+        result["incident_id"] = incident.get("id")
+        problems = _bundle_problems(incident)
+        resolved = _await_resolved(base, incident["id"])
+        result["resolved"] = resolved
+        if not resolved:
+            problems.append("did not auto-resolve after recovery")
+        if problems:
+            result["problems"] = problems
+        else:
+            bench_common.log(
+                f"chaos incident loop OK: {detector} opened "
+                f"{incident['id']} (bundle "
+                f"{(incident.get('evidence') or {}).get('dir')}) "
+                "and auto-resolved")
+        return result
+
+    def _warm(n: int) -> None:
+        """Healthy traffic right before an error-class storm: the
+        error-rate detector judges the error FRACTION over its short
+        window with a min-traffic floor, and by the time one fault
+        class's incident has resolved (its errors aged out of the
+        window) the previous phase's OK requests have aged out too —
+        without fresh denominator traffic, three burst errors read as
+        3/3 of nothing and the floor keeps the detector silent."""
+        for _ in range(n):
+            rows = int(rng.integers(1, 9))
+            start = int(rng.integers(0, x.shape[0] - rows))
+            _post_predict(base, "chaos_pca", x[start:start + rows])
+
     try:
         bench_common.log("chaos baseline")
         phases["baseline"] = _phase(base, "chaos_pca", x, n_requests, rng)
 
         # -- the storm: each fault class in turn, each from a healthy
         # breaker (otherwise the first class's open breaker routes every
-        # later phase around the device and the later faults never fire)
+        # later phase around the device and the later faults never
+        # fire), and each awaited through its auto-incident loop so the
+        # next error-class phase starts from a resolved detector (the
+        # dedup/cooldown contract is per (detector, series)).
+        #
+        # latency runs FIRST: the p99 detector judges the CUMULATIVE
+        # latency sketch, so the spike must land on a pristine tail —
+        # after a raise/stall phase the retry+backoff stragglers have
+        # already dragged p99 up and a further +50 ms cannot clear the
+        # detector's min_step/min_relative guards against paging twice
+        # on one regression.
+        bench_common.log("chaos latency spike (+50ms per call)")
+        known = _known_ids("serve_p99_spike")
+        plane.inject("chaos_pca", "latency", count=None, seconds=0.05)
+        phases["latency"] = _phase(base, "chaos_pca", x,
+                                   max(n_requests // 2, 8), rng)
+        plane.clear()
+        incidents["latency"] = _check_incident_loop("serve_p99_spike",
+                                                    known)
+
         bench_common.log("chaos raise storm (100% backend errors)")
+        _warm(max(n_requests // 2, 12))
+        known = _known_ids("serve_error_rate")
         plane.inject("chaos_pca", "raise", count=None)
         phases["raise"] = _phase(base, "chaos_pca", x, n_requests, rng)
         if breaker_state() != "closed":
@@ -179,31 +355,36 @@ def main() -> int:
         opened_for = _await_closed()
         if breaker_open_at is not None:
             breaker_open_seconds += opened_for
+        incidents["raise"] = _check_incident_loop("serve_error_rate",
+                                                  known)
 
         bench_common.log("chaos stall (transform wedges past the budget)")
-        plane.inject("chaos_pca", "stall", count=1, seconds=2.0)
-        phases["stall"] = _phase(base, "chaos_pca", x, max(n_requests // 4, 4),
-                                 rng)
+        _warm(max(n_requests // 2, 12))
+        known = _known_ids("serve_error_rate")
+        plane.inject("chaos_pca", "stall", count=3, seconds=2.0)
+        phases["stall"] = _phase(base, "chaos_pca", x,
+                                 max(n_requests // 2, 8), rng)
         plane.clear()
         _await_closed()
+        incidents["stall"] = _check_incident_loop("serve_error_rate",
+                                                  known)
 
         bench_common.log("chaos nan corruption")
-        plane.inject("chaos_pca", "nan", count=2)
-        phases["nan"] = _phase(base, "chaos_pca", x, max(n_requests // 4, 4),
-                               rng)
+        _warm(max(n_requests // 2, 12))
+        known = _known_ids("serve_error_rate")
+        plane.inject("chaos_pca", "nan", count=3)
+        phases["nan"] = _phase(base, "chaos_pca", x,
+                               max(n_requests // 2, 8), rng)
         plane.clear()
         _await_closed()
-
-        bench_common.log("chaos latency spike (+50ms per call)")
-        plane.inject("chaos_pca", "latency", count=None, seconds=0.05)
-        phases["latency"] = _phase(base, "chaos_pca", x,
-                                   max(n_requests // 4, 4), rng)
-        plane.clear()
+        incidents["nan"] = _check_incident_loop("serve_error_rate",
+                                                known)
 
         # -- recovery: wait out the cooldown, let a probe close it -------
         bench_common.log("chaos recovery (faults cleared)")
         recovery_seconds = _await_closed()
         phases["recovery"] = _phase(base, "chaos_pca", x, n_requests, rng)
+        incident_totals = _get_json(base, "/debug/incidents")
     finally:
         plane.clear()
         server.shutdown()
@@ -224,6 +405,9 @@ def main() -> int:
         "breaker_open_seconds": breaker_open_seconds,
         "recovery_seconds": recovery_seconds,
         "final_breaker_state": breaker_state(),
+        "incidents_opened": incident_totals.get("opened_total", 0),
+        "incidents_resolved": incident_totals.get("resolved_total", 0),
+        "incidents": incidents,
         "phases": {name: {k: v for k, v in stats.items()
                           if k != "statuses"}
                    for name, stats in phases.items()},
@@ -239,6 +423,14 @@ def main() -> int:
         return 1
     if record["final_breaker_state"] != "closed":
         bench_common.log("chaos FAIL: breaker did not close after recovery")
+        return 1
+    incident_failures = {name: check["problems"]
+                         for name, check in incidents.items()
+                         if check.get("problems")}
+    if incident_failures:
+        bench_common.log(
+            f"chaos FAIL: incident loop broke for "
+            f"{sorted(incident_failures)}: {incident_failures}")
         return 1
     bench_common.log("chaos drill PASS")
     return 0

@@ -53,6 +53,16 @@ Three rule families:
    active trace id, and machine-parseable fields; a bare print is
    invisible to log shippers and severs the request identity the
    tracing layer threads through every queue.
+8. over the clocked obs/ modules (``obs/tsdb.py``, ``obs/anomaly.py``,
+   ``obs/incidents.py`` — the TSDB/detector/incident code paths): no
+   direct ``time.time()`` or ``time.monotonic()`` CALLS. Those modules
+   carry an injectable clock precisely so tests can drive hours of
+   sampling, detection, and incident lifecycle with zero real sleeps —
+   a wall-clock call buried in a helper silently forks the timeline
+   from the injected one and the whole discipline rots. A *reference*
+   as a default (``clock: Callable = time.time``) is the sanctioned
+   spelling and passes; ``time.perf_counter()`` (duration
+   self-measurement, not a timestamp) passes too.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -80,6 +90,12 @@ LIBRARY_ROOT = os.path.join(REPO, "spark_rapids_ml_tpu")
 # rule 7 exemption: the in-package scripts/ dir holds operator shell
 # helpers whose stdout IS their interface, like the repo-level scripts/.
 PRINT_EXEMPT_DIRS = (os.path.join("spark_rapids_ml_tpu", "scripts"),)
+# rule 8 scope: the obs/ modules whose correctness rests on the
+# injectable-clock discipline (sampling, detection, incident lifecycle).
+CLOCKED_OBS_FILES = tuple(
+    os.path.join(REPO, "spark_rapids_ml_tpu", "obs", name)
+    for name in ("tsdb.py", "anomaly.py", "incidents.py")
+)
 DECORATOR_NAME = "fit_instrumentation"
 SERVING_DECORATOR = "observed_transform"
 SERVING_PUBLIC_NAMES = frozenset(
@@ -396,6 +412,67 @@ def check_print_calls(path: str):
                    "trace-id-stamped)")
 
 
+# rule 8: wall-clock reads forbidden in clocked obs/ code paths.
+_WALL_CLOCK_NAMES = frozenset({"time", "monotonic"})
+
+
+def _time_aliases(tree: ast.Module):
+    """Names the module binds to the time module (``import time``,
+    ``import time as t``) — aliased ``t.time()`` can't evade the
+    check."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    aliases.add(a.asname or a.name)
+    return aliases or {"time"}
+
+
+def _wall_clock_name_imports(tree: ast.Module):
+    """Bare names bound via ``from time import time/monotonic [as x]``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _WALL_CLOCK_NAMES:
+                    names.add(a.asname or a.name)
+    return names
+
+
+def check_clock_injection(path: str):
+    """Rule 8: yield (lineno, description) for every direct
+    ``time.time()``/``time.monotonic()`` CALL in a clocked obs/ module.
+
+    Only ``ast.Call`` nodes count: the default-argument *reference*
+    (``clock: Callable[[], float] = time.time``) is exactly how the
+    injectable clock is supposed to be spelled, and
+    ``time.perf_counter()`` (self-measured durations, not timestamps)
+    is exempt.
+    """
+    tree = ast.parse(open(path).read(), filename=path)
+    aliases = _time_aliases(tree)
+    bare_names = _wall_clock_name_imports(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        offender = None
+        if (isinstance(func, ast.Attribute)
+                and func.attr in _WALL_CLOCK_NAMES
+                and isinstance(func.value, ast.Name)
+                and func.value.id in aliases):
+            offender = f"time.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in bare_names:
+            offender = f"time.{func.id} (imported bare)"
+        if offender:
+            yield (node.lineno,
+                   f"direct {offender}() call bypasses the injectable "
+                   "clock (take/pass a clock= / now= instead — this "
+                   "code path must be drivable by tests with zero "
+                   "real sleeps)")
+
+
 def library_files():
     """Every .py under the package, minus the exempt helper dirs."""
     out = []
@@ -465,6 +542,11 @@ def main() -> int:
         rel = os.path.relpath(path, REPO)
         for lineno, why in check_print_calls(path):
             offenders.append(f"{rel}:{lineno} {why}")
+    clocked_files = [p for p in CLOCKED_OBS_FILES if os.path.exists(p)]
+    for path in clocked_files:
+        rel = os.path.relpath(path, REPO)
+        for lineno, why in check_clock_injection(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -478,7 +560,9 @@ def main() -> int:
         f"{len(serve_files)} serve/ module(s) clean (no raw jit, no "
         f"transform bypasses, all queue/thread handoffs carry their "
         f"TraceContext, no silent exception swallows); "
-        f"{len(lib_files)} library module(s) free of bare print("
+        f"{len(lib_files)} library module(s) free of bare print(; "
+        f"{len(clocked_files)} clocked obs module(s) free of direct "
+        f"wall-clock calls"
     )
     return 0
 

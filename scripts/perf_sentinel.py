@@ -43,12 +43,29 @@ from __future__ import annotations
 
 import argparse
 import glob
+import importlib.util
 import json
 import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_robust():
+    """The shared robust-statistics module (``obs/robust.py``), loaded
+    BY PATH: the sentinel and the online anomaly detectors must use
+    the same MAD/noise-band arithmetic, but judging a JSON record must
+    not import the package (and with it jax)."""
+    path = os.path.join(REPO, "spark_rapids_ml_tpu", "obs", "robust.py")
+    spec = importlib.util.spec_from_file_location(
+        "sparkml_obs_robust", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+_robust = _load_robust()
 
 EXIT_CODES = {"PASS": 0, "REGRESSED": 1, "STALE": 2, "NO_BASELINE": 3}
 DEFAULT_TOLERANCE = 0.15
@@ -214,21 +231,14 @@ def higher_is_better(record: Dict[str, Any]) -> bool:
 
 
 def _median(values: List[float]) -> float:
-    vs = sorted(values)
-    n = len(vs)
-    mid = n // 2
-    return vs[mid] if n % 2 else 0.5 * (vs[mid - 1] + vs[mid])
+    return _robust.median(values)
 
 
 def noise_band(values: List[float], tolerance: float) -> float:
-    """Relative half-width of the acceptance band around the median."""
-    if len(values) < 2:
-        return tolerance
-    med = _median(values)
-    if not med:
-        return tolerance
-    mad = _median([abs(v - med) for v in values])
-    return max(tolerance, 2.0 * mad / abs(med))
+    """Relative half-width of the acceptance band around the median —
+    THE shared arithmetic (``obs/robust.py``): the offline sentinel
+    and the online anomaly detectors judge against the same band."""
+    return _robust.noise_band(values, tolerance)
 
 
 def _is_fallback(record: Dict[str, Any]) -> bool:

@@ -105,6 +105,27 @@ from spark_rapids_ml_tpu.obs.logging import (  # noqa: F401
     StructuredLogger,
     get_logger,
 )
+from spark_rapids_ml_tpu.obs.robust import (  # noqa: F401
+    mad,
+    noise_band,
+    robust_zscore,
+)
+from spark_rapids_ml_tpu.obs.anomaly import (  # noqa: F401
+    Detector,
+    Finding,
+    MadSpikeDetector,
+    RateOfChangeDetector,
+    ThresholdDetector,
+    builtin_detectors,
+)
+from spark_rapids_ml_tpu.obs.incidents import (  # noqa: F401
+    Incident,
+    IncidentEngine,
+    IncidentManager,
+    get_incident_engine,
+    reset_incident_engine,
+)
+from spark_rapids_ml_tpu.obs import retention  # noqa: F401
 from spark_rapids_ml_tpu.obs.tsdb import (  # noqa: F401
     MetricsSampler,
     TimeSeriesStore,
@@ -160,13 +181,21 @@ __all__ = [
     "Counter",
     "DEFAULT_BUCKETS",
     "DUMP_DIR_ENV",
+    "Detector",
     "DeviceHealth",
     "DeviceMonitor",
     "FIT_BUDGET_ENV",
+    "Finding",
     "FitContext",
     "FitReport",
     "Gauge",
     "Histogram",
+    "Incident",
+    "IncidentEngine",
+    "IncidentManager",
+    "MadSpikeDetector",
+    "RateOfChangeDetector",
+    "ThresholdDetector",
     "MetricsRegistry",
     "MetricsSampler",
     "NUMERICS_SAMPLE_ENV",
@@ -198,6 +227,7 @@ __all__ = [
     "assemble_trace",
     "attach_report",
     "build_dump",
+    "builtin_detectors",
     "capture",
     "check_devices",
     "check_devices_subprocess",
@@ -218,6 +248,7 @@ __all__ = [
     "fit_instrumentation",
     "flight",
     "get_device_monitor",
+    "get_incident_engine",
     "get_logger",
     "get_recorder",
     "get_registry",
@@ -225,6 +256,7 @@ __all__ = [
     "get_tsdb",
     "get_watchdog",
     "host_peak_rss_bytes",
+    "mad",
     "inflight_request",
     "inflight_requests",
     "last_fit_report",
@@ -236,6 +268,7 @@ __all__ = [
     "new_context",
     "new_span_id",
     "new_trace_id",
+    "noise_band",
     "observed_fit",
     "observed_transform",
     "parse_traceparent",
@@ -246,6 +279,9 @@ __all__ = [
     "record_event",
     "record_memory_metrics",
     "reset_compile_log",
+    "reset_incident_engine",
+    "retention",
+    "robust_zscore",
     "span",
     "start_prometheus_server",
     "start_sampling",

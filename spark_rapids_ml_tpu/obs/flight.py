@@ -120,6 +120,18 @@ def unregister_dump_section(name: str) -> None:
         _dump_sections.pop(name, None)
 
 
+def run_dump_section(name: str):
+    """Evaluate ONE registered section outside a full dump (None when
+    unregistered or the section raised). The incident engine uses this
+    to put breaker state into an evidence bundle without an
+    obs → serve import."""
+    with _dump_sections_lock:
+        fn = _dump_sections.get(name)
+    if fn is None:
+        return None
+    return _safe(fn)
+
+
 def build_dump(reason: str, extra: Optional[Dict[str, Any]] = None
                ) -> Dict[str, Any]:
     """The dump document (separated from I/O so tests can inspect it)."""
@@ -230,6 +242,14 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None
                 "sparkml_flight_dumps_total", "flight-recorder dumps",
                 ("reason",),
             ).inc(reason=reason.split(":", 1)[0])
+        except Exception:
+            pass
+        # shared artifact GC: dumps, profiles, and incident bundles all
+        # land under the dump dir — a dump storm must not fill the disk
+        try:
+            from spark_rapids_ml_tpu.obs import retention
+
+            retention.maybe_gc("flight")
         except Exception:
             pass
         return path

@@ -21,7 +21,17 @@ Design constraints:
 * level gate via ``SPARK_RAPIDS_ML_TPU_LOG_LEVEL``
   (``debug``/``info``/``warning``/``error``, default ``info``);
 * every emitted line is counted in ``sparkml_log_lines_total{level}``
-  — log volume is itself a metric the history sampler can watch.
+  — log volume is itself a metric the history sampler can watch;
+* **per-(level, logger) token-bucket rate limiting**: an incident
+  storm emitting ERROR per sweep must not flood stderr into
+  uselessness. Each (level, logger) pair gets a burst of
+  ``SPARK_RAPIDS_ML_TPU_LOG_BURST`` lines (default 50) refilled at
+  ``SPARK_RAPIDS_ML_TPU_LOG_RATE`` lines/sec (default 10; <= 0
+  disables limiting). Dropped lines are counted in
+  ``sparkml_log_suppressed_total{level,logger}`` — suppression is
+  itself observable — and the first line emitted after a dry spell
+  carries ``suppressed_lines=N`` so a reader of the raw stream sees
+  the gap too.
 """
 
 from __future__ import annotations
@@ -30,17 +40,53 @@ import json
 import os
 import sys
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
 
 LEVEL_ENV = "SPARK_RAPIDS_ML_TPU_LOG_LEVEL"
+RATE_ENV = "SPARK_RAPIDS_ML_TPU_LOG_RATE"
+BURST_ENV = "SPARK_RAPIDS_ML_TPU_LOG_BURST"
 
 _LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
 _DEFAULT_LEVEL = "info"
+_DEFAULT_RATE = 10.0
+_DEFAULT_BURST = 50.0
 
 
 def _threshold() -> int:
     raw = os.environ.get(LEVEL_ENV, _DEFAULT_LEVEL).strip().lower()
     return _LEVELS.get(raw, _LEVELS[_DEFAULT_LEVEL])
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _TokenBucket:
+    """One (level, logger)'s admission state: ``tokens`` refill at
+    ``rate``/sec up to ``burst``; each emitted line spends one.
+    ``dropped`` accumulates between admissions so the next emitted
+    line can report the gap."""
+
+    __slots__ = ("tokens", "last_refill", "dropped")
+
+    def __init__(self, burst: float, now: float):
+        self.tokens = burst
+        self.last_refill = now
+        self.dropped = 0
+
+    def admit(self, rate: float, burst: float, now: float) -> bool:
+        elapsed = max(now - self.last_refill, 0.0)
+        self.last_refill = now
+        self.tokens = min(self.tokens + elapsed * rate, burst)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        self.dropped += 1
+        return False
 
 
 class StructuredLogger:
@@ -50,21 +96,49 @@ class StructuredLogger:
     pass an open file-like to redirect (tests, log files).
     """
 
-    def __init__(self, name: str, stream=None):
+    def __init__(self, name: str, stream=None,
+                 clock: Callable[[], float] = time.monotonic):
         self.name = name
         self._stream = stream
+        self._clock = clock
+        self._buckets: Dict[str, _TokenBucket] = {}
+        self._buckets_lock = threading.Lock()
+
+    def _admit(self, level: str) -> Tuple[bool, int]:
+        """Token-bucket gate per (level, this logger): (emit?, lines
+        suppressed since the last emitted one)."""
+        rate = _env_float(RATE_ENV, _DEFAULT_RATE)
+        if rate <= 0:
+            return True, 0
+        burst = max(_env_float(BURST_ENV, _DEFAULT_BURST), 1.0)
+        now = self._clock()
+        with self._buckets_lock:
+            bucket = self._buckets.get(level)
+            if bucket is None:
+                bucket = _TokenBucket(burst, now)
+                self._buckets[level] = bucket
+            if bucket.admit(rate, burst, now):
+                suppressed, bucket.dropped = bucket.dropped, 0
+                return True, suppressed
+        _count_suppressed(level, self.name)
+        return False, 0
 
     def _emit(self, level: str, message: str,
               fields: Dict[str, Any]) -> None:
         if _LEVELS[level] < _threshold():
             return
         try:
+            admitted, suppressed = self._admit(level)
+            if not admitted:
+                return
             record: Dict[str, Any] = {
                 "ts": _utcnow(),
                 "level": level,
                 "logger": self.name,
                 "message": message,
             }
+            if suppressed:
+                record["suppressed_lines"] = suppressed
             trace_id = _active_trace_id()
             if trace_id:
                 record["trace_id"] = trace_id
@@ -133,6 +207,20 @@ def _count_line(level: str) -> None:
         pass
 
 
+def _count_suppressed(level: str, logger_name: str) -> None:
+    try:
+        from spark_rapids_ml_tpu.obs.metrics import get_registry
+
+        get_registry().counter(
+            "sparkml_log_suppressed_total",
+            "structured log lines dropped by the per-(level,logger) "
+            "token bucket (raise SPARK_RAPIDS_ML_TPU_LOG_RATE/"
+            "_LOG_BURST, or fix the storm)", ("level", "logger"),
+        ).inc(level=level, logger=logger_name)
+    except Exception:
+        pass
+
+
 _loggers: Dict[str, StructuredLogger] = {}
 _loggers_lock = threading.Lock()
 
@@ -147,4 +235,5 @@ def get_logger(name: str) -> StructuredLogger:
         return logger
 
 
-__all__ = ["LEVEL_ENV", "StructuredLogger", "get_logger"]
+__all__ = ["BURST_ENV", "LEVEL_ENV", "RATE_ENV", "StructuredLogger",
+           "get_logger"]

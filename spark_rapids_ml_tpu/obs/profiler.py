@@ -372,6 +372,12 @@ def _finish(cap: _Capture, jax_outcome: str) -> None:
         _active = None
     _captures_counter().inc(outcome="completed")
     try:
+        from spark_rapids_ml_tpu.obs import retention
+
+        retention.maybe_gc("profile")
+    except Exception:
+        pass  # GC is best-effort; the capture already landed
+    try:
         _overhead_counter().inc(time.perf_counter() - t_finish,
                                 component="profiler")
     except Exception:

@@ -369,6 +369,23 @@ class SloSet:
         return snap
 
 
+def severity_for_burn(burn_rate: float) -> Optional[str]:
+    """Incident severity implied by a short-window burn rate, on the
+    same SRE-workbook ladder the alert policies page with: the
+    page_fast factor (14.4) is critical, page_slow (6.0) serious, any
+    budget overspend (>= 1.0) a warning, and an idle/healthy service
+    (None) implies nothing. ``obs.incidents`` escalates every opening
+    incident through this, so "severity" means the same thing on a
+    page and on an incident record."""
+    if burn_rate >= BURN_POLICIES[0]["factor"]:
+        return "critical"
+    if burn_rate >= BURN_POLICIES[1]["factor"]:
+        return "serious"
+    if burn_rate >= 1.0:
+        return "warning"
+    return None
+
+
 def _env_float(name: str, default: float) -> float:
     try:
         return float(os.environ.get(ENV_PREFIX + name, default))
@@ -405,4 +422,5 @@ __all__ = [
     "SloSet",
     "WindowedCounts",
     "default_slos",
+    "severity_for_burn",
 ]

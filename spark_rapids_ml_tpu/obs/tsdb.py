@@ -130,7 +130,7 @@ class _Tier:
 
 
 class _Series:
-    __slots__ = ("name", "labels", "kind", "tiers")
+    __slots__ = ("name", "labels", "kind", "tiers", "born")
 
     def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...],
                  kind: str, tiers: Sequence[Tuple[float, float]]):
@@ -138,8 +138,14 @@ class _Series:
         self.labels = labels
         self.kind = kind
         self.tiers = [_Tier(res, span) for res, span in tiers]
+        # first-ever record time; lets counter math distinguish "child
+        # born mid-window" (its first value IS increase) from "older
+        # points aged out of the ring" (it is not)
+        self.born: Optional[float] = None
 
     def add(self, ts: float, value: float) -> None:
+        if self.born is None:
+            self.born = ts
         for tier in self.tiers:
             tier.add(ts, value)
 
@@ -162,6 +168,25 @@ def counter_increase(points: Sequence[Sequence[float]]) -> float:
             total += value - prev if value >= prev else value
         prev = value
     return total
+
+
+def windowed_increase(series: Dict[str, Any],
+                      window_start: float) -> float:
+    """Counter increase of one ``range_query`` series doc over its
+    window, crediting a child BORN inside the window with its first
+    sampled value — a burst that mints a new labeled child (the first
+    ``outcome="error"`` of a fault storm) lands entirely between two
+    samples, so the plain first-to-last increase over ``[3, 3, ...]``
+    reads 0 and a detector watching the delta is blind to exactly the
+    event it exists for. ``born_ts`` (first-ever record time) is how we
+    tell that case from an old series whose early points merely aged
+    out of the ring."""
+    points = series.get("points") or []
+    inc = counter_increase(points)
+    born = series.get("born_ts")
+    if points and born is not None and born >= window_start:
+        inc += points[0][1]
+    return inc
 
 
 class TimeSeriesStore:
@@ -267,13 +292,14 @@ class TimeSeriesStore:
         ts = self.clock() if now is None else now
         with self._lock:
             matches = [
-                (dict(s.labels), s.kind,
+                (dict(s.labels), s.kind, s.born,
                  self._tier_for(s, window).query(ts - window, ts))
                 for s in self._matching(name, labels)
             ]
         return [
-            {"labels": lbls, "kind": kind, "points": pts}
-            for lbls, kind, pts in matches
+            {"labels": lbls, "kind": kind, "born_ts": born,
+             "points": pts}
+            for lbls, kind, born, pts in matches
         ]
 
     def delta(self, name: str, labels: Optional[Dict[str, str]] = None,
@@ -399,6 +425,7 @@ class MetricsSampler:
         self.prefixes = tuple(prefixes)
         self.clock = clock
         self._collectors: List[Callable[[], None]] = []
+        self._post_hooks: List[Callable[[float], None]] = []
         self._collectors_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -418,6 +445,20 @@ class MetricsSampler:
         with self._collectors_lock:
             if fn in self._collectors:
                 self._collectors.remove(fn)
+
+    def register_post_sweep(self, fn: Callable[[float], None]) -> None:
+        """Run ``fn(sweep_timestamp)`` at the END of every sweep, after
+        fresh samples landed in the store — the hook the auto-incident
+        engine detects from (same thread, same injectable clock, cost
+        inside the sweep's own overhead accounting). Idempotent."""
+        with self._collectors_lock:
+            if fn not in self._post_hooks:
+                self._post_hooks.append(fn)
+
+    def unregister_post_sweep(self, fn: Callable[[float], None]) -> None:
+        with self._collectors_lock:
+            if fn in self._post_hooks:
+                self._post_hooks.remove(fn)
 
     # -- one sweep ---------------------------------------------------------
 
@@ -443,9 +484,21 @@ class MetricsSampler:
                 recorded += self._sample_family(family, ts)
             except Exception:
                 continue  # one sick family must not starve the rest
+        # the sampler's own cost stops HERE: post-sweep hooks (the
+        # anomaly sweep) account for themselves under their own
+        # component label — timing them here too would double-count
+        # every detector sweep in the overhead total and make an
+        # evidence capture read as a sampler latency spike
         self._sweeps += 1
         elapsed = time.perf_counter() - t0
         self._publish_overhead(elapsed, recorded)
+        with self._collectors_lock:
+            post_hooks = list(self._post_hooks)
+        for fn in post_hooks:
+            try:
+                fn(ts)
+            except Exception:
+                self._count_collector_error(fn)
         return recorded
 
     def _sample_family(self, family, ts: float) -> int:
@@ -643,4 +696,5 @@ __all__ = [
     "sample_interval_seconds",
     "start_sampling",
     "stop_sampling",
+    "windowed_increase",
 ]

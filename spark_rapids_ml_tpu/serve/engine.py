@@ -82,6 +82,7 @@ import os
 import random
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -110,6 +111,35 @@ ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
 class EngineClosed(RuntimeError):
     """The engine is shut down (or shutting down) and accepts no new
     requests."""
+
+
+# Live engines, for the sampler-driven SLO publisher (weak: an engine
+# a test abandoned must be collectable, not pinned by telemetry).
+_live_engines: "weakref.WeakSet[ServeEngine]" = weakref.WeakSet()
+
+
+def publish_all_slos() -> None:
+    """Mirror every live engine's SLO verdict into the metrics registry.
+
+    Registered as a sampler collector by ``start_serve_server``, so the
+    ``sparkml_slo_burn_rate`` gauges are fresh every sweep — which is
+    what the auto-incident engine's SLO fast-burn detector reads.
+    Without this, the gauges only moved when someone polled
+    ``/debug/slo``: a burn nobody was watching was a burn the system
+    could not see.
+    """
+    for engine in list(_live_engines):
+        if engine._closed:
+            continue
+        try:
+            engine.slo.publish(get_registry())
+        except Exception:
+            get_registry().counter(
+                "sparkml_serve_errors_total",
+                "serving errors by type: batch failures (exception "
+                "class), worker crashes/wedges, breaker rejections",
+                ("model", "error"),
+            ).inc(model="(engine)", error="slo_publish")
 
 
 class NumericsError(RuntimeError):
@@ -319,6 +349,7 @@ class ServeEngine:
             "serving errors by type: batch failures (exception class), "
             "worker crashes/wedges, breaker rejections", ("model", "error"),
         )
+        _live_engines.add(self)
 
     # -- the request path --------------------------------------------------
 
@@ -794,4 +825,5 @@ __all__ = [
     "WorkerCrashed",
     "extract_output",
     "is_backend_error",
+    "publish_all_slos",
 ]

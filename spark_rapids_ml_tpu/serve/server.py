@@ -39,8 +39,15 @@ web framework to the container:
   ``jax.profiler`` + span-ring trace artifacts in the profile dir; a
   second start while one runs is **409**. ``GET /debug/profile`` shows
   the active/last capture;
+* ``GET /debug/incidents`` — the auto-incident engine
+  (``obs.incidents``): open + recent incidents with their on-disk
+  evidence-bundle paths, lifecycle totals, and the detector catalog.
+  ``start_serve_server`` installs the engine on the background sampler
+  (env kill switch ``SPARK_RAPIDS_ML_TPU_OBS_INCIDENTS=0``), so
+  detection runs at the sampling cadence with no extra thread;
 * ``GET /dashboard`` — one self-contained HTML page polling those
-  endpoints: the live ops view, now with history sparklines.
+  endpoints: the live ops view, with history sparklines and the
+  incident timeline.
 
 Threaded (one request per handler thread) — concurrency funnels into the
 engine's micro-batchers, which is the whole point. The per-request
@@ -61,6 +68,7 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import incidents as incidents_mod
 from spark_rapids_ml_tpu.obs import profiler as profiler_mod
 from spark_rapids_ml_tpu.obs import spans as spans_mod
 from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
@@ -72,7 +80,11 @@ from spark_rapids_ml_tpu.serve.batching import (
     WorkerCrashed,
 )
 from spark_rapids_ml_tpu.serve.breaker import BreakerOpen
-from spark_rapids_ml_tpu.serve.engine import EngineClosed, ServeEngine
+from spark_rapids_ml_tpu.serve.engine import (
+    EngineClosed,
+    ServeEngine,
+    publish_all_slos,
+)
 from spark_rapids_ml_tpu.serve.faults import fault_plane
 
 _MAX_BODY_BYTES = 64 * 1024 * 1024  # refuse absurd request bodies
@@ -267,6 +279,11 @@ def make_handler(engine: ServeEngine):
                     "last": profiler_mod.last_capture(),
                     "dir": profiler_mod.profile_dir(),
                 })
+            elif path == "/debug/incidents":
+                status = self._reply(
+                    200,
+                    incidents_mod.get_incident_engine().snapshot(),
+                )
             elif path == "/dashboard":
                 status = self._reply_text(
                     200, DASHBOARD_HTML, "text/html; charset=utf-8")
@@ -420,8 +437,18 @@ def start_serve_server(
     ``port=0`` for ephemeral — read ``server.server_address[1]``; stop
     with ``server.shutdown()``, then ``engine.shutdown()`` to drain).
     Also starts the background history sampler (``obs.tsdb``) so
-    ``/debug/history`` and the dashboard sparklines have data."""
-    tsdb_mod.start_sampling()
+    ``/debug/history`` and the dashboard sparklines have data, and —
+    unless ``SPARK_RAPIDS_ML_TPU_OBS_INCIDENTS=0`` — installs the
+    auto-incident engine on it: detectors run at the sampling cadence
+    on the sampler's own thread, and the SLO gauges are republished
+    every sweep so the fast-burn detector reads live values."""
+    sampler = tsdb_mod.start_sampling()
+    # SLO gauges republish every sweep REGARDLESS of the incident kill
+    # switch: turning off auto-incidents must not freeze the burn-rate
+    # history the dashboard and /debug/history plot.
+    sampler.register_collector(publish_all_slos)
+    if incidents_mod.enabled():
+        incidents_mod.get_incident_engine().install(sampler)
     server = _Server((addr, port), make_handler(engine))
     thread = tracectx.traced_thread(
         server.serve_forever, name="sparkml-serve-http", daemon=True,
@@ -529,6 +556,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <h1>Serving ops</h1>
   <p class="sub">live view over <span class="mono">/debug/slo</span>,
     <span class="mono">/debug/history</span>,
+    <span class="mono">/debug/incidents</span>,
     <span class="mono">/debug/traces</span>, and
     <span class="mono">/healthz</span> · refreshes every 2&thinsp;s</p>
   <div class="tiles" id="tiles"></div>
@@ -539,6 +567,8 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <table><thead><tr><th>Objective</th><th>Target</th><th>5m</th><th>30m</th>
     <th>1h</th><th>6h</th><th>Budget left</th><th>State</th></tr></thead>
     <tbody id="slo-rows"></tbody></table>
+  <h2>Incidents</h2>
+  <div id="incidents" class="quiet">—</div>
   <h2>Circuit breakers</h2>
   <div id="breakers" class="quiet">—</div>
   <h2>Firing alerts</h2>
@@ -680,6 +710,37 @@ function statusSpan(cls, text) {
   return '<span class="status ' + cls + '"><span class="dot"></span>' +
     text.replace("\\u25cf ", "") + "</span>";
 }
+function fmtAgo(ts) {
+  if (ts == null) return "\\u2013";
+  var ago = Math.max(0, Date.now() / 1000 - ts);
+  if (ago < 120) return ago.toFixed(0) + " s ago";
+  if (ago < 7200) return (ago / 60).toFixed(1) + " min ago";
+  return (ago / 3600).toFixed(1) + " h ago";
+}
+function severityClass(sev) {
+  if (sev === "critical") return "critical";
+  if (sev === "serious") return "serious";
+  return "warning";
+}
+function incidentRows(list, state) {
+  return list.map(function (inc) {
+    var labels = Object.keys(inc.labels || {}).map(function (k) {
+      return k + "=" + inc.labels[k];
+    }).join(" ");
+    return "<tr><td class=name>" + inc.detector +
+      (labels ? " \\u00b7 " + labels : "") + "</td><td>" +
+      statusSpan(state === "open" ? severityClass(inc.severity)
+                                  : "good",
+                 "\\u25cf " + inc.severity +
+                 (state === "open" ? "" : " (resolved)")) +
+      "</td><td>" + fmtAgo(inc.opened_ts) + "</td><td>" +
+      (inc.duration_seconds == null ? "\\u2013"
+        : inc.duration_seconds.toFixed(0) + " s") +
+      "</td><td>" + fmtVal(inc.value) + " vs " +
+      fmtVal(inc.baseline) + "</td><td class=name><span class=mono>" +
+      ((inc.evidence || {}).dir || "\\u2013") + "</span></td></tr>";
+  }).join("");
+}
 function sumSeries(seriesList) {
   // point-wise sum across children keyed by sample timestamp (every
   // child shares the sampler's sweep timestamps) — the engine-wide
@@ -702,6 +763,10 @@ async function refresh() {
     var hist = {};
     try { hist = await (await fetch("/debug/history")).json(); }
     catch (err) { hist = {}; }
+    var inc = {};
+    try { inc = await (await fetch("/debug/incidents")).json(); }
+    catch (err) { inc = {}; }
+    var incOpen = inc.open || [], incRecent = inc.recent || [];
     var qdSeries = ((hist.key || {}).queue_depth || []);
     var qdPoints = qdSeries.length ? sumSeries(qdSeries) : null;
     var breakers = slo.breakers || {};
@@ -717,6 +782,10 @@ async function refresh() {
       tile("Firing alerts", (slo.alerts || []).length),
       tile("Breakers open", openCount
         ? statusSpan("critical", "\\u25cf " + openCount)
+        : statusSpan("good", "\\u25cf 0")),
+      tile("Open incidents", incOpen.length
+        ? statusSpan(severityClass(incOpen[0].severity),
+                     "\\u25cf " + incOpen.length)
         : statusSpan("good", "\\u25cf 0")),
       tile("Degraded served", slo.degraded_total || 0),
       tile("Retries", slo.retries_total || 0),
@@ -743,6 +812,16 @@ async function refresh() {
           fmtPct(s.budget_remaining) + "</td><td>" +
           statusSpan(st[0], st[1]) + "</td></tr>";
       }).join("");
+    document.getElementById("incidents").innerHTML =
+      (incOpen.length || incRecent.length)
+        ? "<table><thead><tr><th>Detector</th><th>Severity</th>" +
+          "<th>Opened</th><th>Duration</th><th>Value vs baseline</th>" +
+          "<th>Evidence bundle</th></tr></thead><tbody>" +
+          incidentRows(incOpen, "open") +
+          incidentRows(incRecent, "resolved") + "</tbody></table>"
+        : "no incidents \\u2014 " + (inc.opened_total || 0) +
+          " opened / " + (inc.resolved_total || 0) +
+          " resolved since start";
     document.getElementById("breakers").innerHTML = breakerNames.length
       ? "<table><thead><tr><th>Model</th><th>State</th>" +
         "<th>Consecutive failures</th><th>Opens</th><th>Open for</th>" +

@@ -23,6 +23,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The auto-incident engine (obs.incidents) runs inside every serve
+# server's sampler by default, and the fault-injection tests
+# legitimately open incidents. Keep the engine ON (that path is under
+# test) but disable incident-TRIGGERED profile captures suite-wide: a
+# jax start_trace under live CPU traffic can wedge (obs/profiler.py),
+# and a capture helper thread abandoned at interpreter teardown can
+# crash it. The capture trigger itself is unit-tested with a stub.
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_CAPTURE_S", "0")
+
 import jax  # noqa: E402
 
 from spark_rapids_ml_tpu.utils.platform import force_cpu_if_requested  # noqa: E402
@@ -69,6 +78,22 @@ multiprocess_cpu_skip = pytest.mark.skipif(
            "SPARKML_RUN_MULTIPROCESS_TESTS=1 to re-arm on hosts with "
            "working multi-process device coordination (real TPU CI).",
 )
+
+
+@pytest.fixture(autouse=True)
+def _reset_leaked_incident_engine():
+    """Any test that touches ``start_serve_server`` installs the
+    process-wide auto-incident engine on the process-wide sampler. Left
+    running, it keeps detecting against whatever the test left in the
+    global registry (a fault-storm SLO burn gauge frozen at 500, say)
+    and writes incident flight dumps into LATER tests' dump dirs. The
+    engine is per-server-session state; drop a leaked one at teardown
+    (tests that manage it themselves already reset to None first)."""
+    yield
+    from spark_rapids_ml_tpu.obs import incidents
+
+    if incidents._engine is not None:
+        incidents.reset_incident_engine()
 
 
 @pytest.fixture

@@ -1,0 +1,313 @@
+"""obs.anomaly + obs.robust: detector arithmetic under an injected
+clock — MAD baselines on step-change vs noisy-but-flat series,
+rate-of-change plateau behavior, ratio/threshold/delta detectors, and
+the shared-band parity with the perf sentinel. Zero real sleeps."""
+
+import os
+import sys
+
+import pytest
+
+from spark_rapids_ml_tpu.obs import robust
+from spark_rapids_ml_tpu.obs.anomaly import (
+    DeltaDetector,
+    MadSpikeDetector,
+    RateOfChangeDetector,
+    RatioDetector,
+    ThresholdDetector,
+    builtin_detectors,
+)
+from spark_rapids_ml_tpu.obs.tsdb import TimeSeriesStore
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(clock):
+    return TimeSeriesStore(tiers=((1.0, 900.0),), clock=clock)
+
+
+def _fill(store, name, values, labels=None, start=1000.0, step=1.0):
+    for i, v in enumerate(values):
+        store.record(name, labels or {"model": "m"}, v,
+                     now=start + i * step)
+    return start + (len(values) - 1) * step
+
+
+# -- robust statistics: one arithmetic, two consumers ------------------------
+
+
+def test_robust_matches_perf_sentinel_band():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        import perf_sentinel
+    finally:
+        sys.path.pop(0)
+    for values in ([100.0], [100.0, 60.0, 140.0, 80.0, 120.0],
+                   [5.0, 5.1, 4.9, 5.0], [0.0, 0.0, 0.0]):
+        assert perf_sentinel.noise_band(values, 0.15) == \
+            robust.noise_band(values, 0.15)
+        if values:
+            assert perf_sentinel._median(values) == robust.median(values)
+
+
+def test_robust_zscore_basics():
+    flat = [10.0, 10.5, 9.5, 10.0, 10.2, 9.8]
+    assert abs(robust.robust_zscore(10.0, flat)) < 1.0
+    assert robust.robust_zscore(100.0, flat) > 50.0
+    # constant baseline: exact match is 0, any excursion is +/- inf
+    assert robust.robust_zscore(5.0, [5.0, 5.0, 5.0]) == 0.0
+    assert robust.robust_zscore(6.0, [5.0, 5.0, 5.0]) == float("inf")
+    assert robust.robust_zscore(4.0, [5.0, 5.0, 5.0]) == float("-inf")
+    assert robust.mad([1.0, 1.0, 1.0]) == 0.0
+
+
+# -- MAD spike: the satellite's step-change vs noisy-flat contract -----------
+
+
+def _mad_detector(**kw):
+    defaults = dict(baseline_window=300.0, spike_window=5.0,
+                    z_threshold=4.0, min_relative=0.5, min_step=0.0,
+                    min_value=0.0, min_points=8)
+    defaults.update(kw)
+    return MadSpikeDetector("d", "sparkml_serve_queue_depth", **defaults)
+
+
+def test_mad_spike_fires_on_step_change(store, clock):
+    last = _fill(store, "sparkml_serve_queue_depth",
+                 [2.0, 3.0, 2.0, 3.0, 2.0] * 12)  # noisy-ish flat
+    store.record("sparkml_serve_queue_depth", {"model": "m"}, 40.0,
+                 now=last + 1)
+    findings = _mad_detector().evaluate(store, last + 1)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.labels == {"model": "m"}
+    assert f.value == 40.0
+    assert f.baseline == pytest.approx(2.0, abs=1.0)
+    assert "z" in f.reason
+
+
+def test_mad_spike_quiet_on_noisy_but_flat_series(store, clock):
+    # wildly noisy but stationary: its own MAD widens the band
+    values = [10.0, 50.0, 20.0, 60.0, 15.0, 55.0, 25.0, 45.0] * 8
+    last = _fill(store, "sparkml_serve_queue_depth", values)
+    store.record("sparkml_serve_queue_depth", {"model": "m"}, 62.0,
+                 now=last + 1)
+    assert _mad_detector().evaluate(store, last + 1) == []
+
+
+def test_mad_spike_constant_baseline_needs_a_real_step(store, clock):
+    # constant baseline => MAD 0 => infinite z; the relative/absolute
+    # step guard is what keeps a 0.5% wiggle from paging
+    last = _fill(store, "sparkml_serve_queue_depth", [100.0] * 60)
+    store.record("sparkml_serve_queue_depth", {"model": "m"}, 100.5,
+                 now=last + 1)
+    assert _mad_detector().evaluate(store, last + 1) == []
+    store.record("sparkml_serve_queue_depth", {"model": "m"}, 200.0,
+                 now=last + 2)
+    assert len(_mad_detector().evaluate(store, last + 2)) == 1
+
+
+def test_mad_spike_zero_baseline_min_value_gate(store, clock):
+    last = _fill(store, "sparkml_serve_queue_depth", [0.0] * 40)
+    store.record("sparkml_serve_queue_depth", {"model": "m"}, 5.0,
+                 now=last + 1)
+    # below min_value: an idle queue blipping to 5 is not saturation
+    assert _mad_detector(min_value=8.0).evaluate(store, last + 1) == []
+    store.record("sparkml_serve_queue_depth", {"model": "m"}, 50.0,
+                 now=last + 2)
+    assert len(_mad_detector(min_value=8.0).evaluate(
+        store, last + 2)) == 1
+
+
+def test_mad_spike_needs_min_baseline_points(store, clock):
+    last = _fill(store, "sparkml_serve_queue_depth", [1.0] * 4)
+    store.record("sparkml_serve_queue_depth", {"model": "m"}, 99.0,
+                 now=last + 6)
+    assert _mad_detector(min_points=8).evaluate(store, last + 6) == []
+
+
+# -- rate of change: fires on the jump, resolves on the plateau --------------
+
+
+def _roc(**kw):
+    defaults = dict(lookback=30.0, min_relative=1.0, min_step=0.02,
+                    min_points=4)
+    defaults.update(kw)
+    return RateOfChangeDetector(
+        "p99", "sparkml_serve_request_latency_seconds",
+        labels={"quantile": "0.99"}, **defaults)
+
+
+def test_roc_fires_on_jump_then_quiets_on_plateau(store, clock):
+    labels = {"model": "m", "quantile": "0.99"}
+    name = "sparkml_serve_request_latency_seconds"
+    for i in range(20):
+        store.record(name, labels, 0.005, now=1000.0 + i)
+    # the jump: a cumulative sketch p99 steps up and STAYS there
+    for i in range(20, 80):
+        store.record(name, labels, 0.2, now=1000.0 + i)
+    det = _roc()
+    # just after the jump: oldest-in-window is pre-jump -> fires
+    assert len(det.evaluate(store, 1025.0)) == 1
+    # long after: the whole lookback is at the new level -> quiet,
+    # which is what RESOLVES an incident on a signal that can never
+    # come back down
+    assert det.evaluate(store, 1075.0) == []
+
+
+def test_roc_ignores_small_or_slow_drift(store, clock):
+    labels = {"model": "m", "quantile": "0.99"}
+    name = "sparkml_serve_request_latency_seconds"
+    for i in range(40):
+        store.record(name, labels, 0.100 + i * 0.0002, now=1000.0 + i)
+    # +6ms drift over the window: below min_step AND below 1x relative
+    assert _roc().evaluate(store, 1039.0) == []
+
+
+def test_roc_only_matches_selected_quantile(store, clock):
+    name = "sparkml_serve_request_latency_seconds"
+    for i in range(10):
+        store.record(name, {"model": "m", "quantile": "0.5"},
+                     0.001 if i < 5 else 1.0, now=1000.0 + i)
+    assert _roc().evaluate(store, 1009.0) == []
+
+
+# -- threshold -----------------------------------------------------------------
+
+
+def test_threshold_fires_and_skips_stale_series(store, clock):
+    det = ThresholdDetector(
+        "burn", "sparkml_slo_burn_rate", threshold=14.4,
+        labels={"window": "5m"}, stale_after=60.0)
+    store.record("sparkml_slo_burn_rate",
+                 {"slo": "serve_availability", "window": "5m"},
+                 120.0, now=1000.0)
+    findings = det.evaluate(store, 1010.0)
+    assert len(findings) == 1 and findings[0].value == 120.0
+    # same point, 200 s later: stale gauge, not a live anomaly
+    assert det.evaluate(store, 1200.0) == []
+    store.record("sparkml_slo_burn_rate",
+                 {"slo": "serve_availability", "window": "5m"},
+                 0.2, now=1201.0)
+    assert det.evaluate(store, 1202.0) == []
+
+
+# -- ratio: windowed error fraction per model --------------------------------
+
+
+def test_ratio_detector_error_fraction_per_model(store, clock):
+    name = "sparkml_serve_requests_total"
+    # model a: 100 ok then 30 errors; model b: clean
+    for i in range(11):
+        store.record(name, {"model": "a", "outcome": "ok"}, i * 10.0,
+                     kind="counter", now=1000.0 + i)
+        store.record(name, {"model": "a", "outcome": "error"},
+                     0.0 if i < 5 else (i - 4) * 5.0,
+                     kind="counter", now=1000.0 + i)
+        store.record(name, {"model": "b", "outcome": "ok"}, i * 10.0,
+                     kind="counter", now=1000.0 + i)
+    det = RatioDetector("err", name, select={"outcome": "error"},
+                        threshold=0.05, window=60.0, min_total=10.0)
+    findings = det.evaluate(store, 1010.0)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.labels == {"model": "a"}
+    assert f.value == pytest.approx(30.0 / 130.0)
+
+
+def test_ratio_detector_sees_burst_born_error_child(store, clock):
+    # the first error of a fault storm MINTS the outcome="error" child
+    # between two sampler sweeps: every sampled point is already 3, and
+    # a birth-blind windowed delta would read 0 errors forever
+    name = "sparkml_serve_requests_total"
+    for i in range(11):
+        store.record(name, {"model": "a", "outcome": "ok"}, i * 2.0,
+                     kind="counter", now=1000.0 + i)
+    store.record(name, {"model": "a", "outcome": "error"}, 3.0,
+                 kind="counter", now=1009.0)
+    store.record(name, {"model": "a", "outcome": "error"}, 3.0,
+                 kind="counter", now=1010.0)
+    det = RatioDetector("err", name, select={"outcome": "error"},
+                        threshold=0.05, window=60.0, min_total=10.0)
+    findings = det.evaluate(store, 1010.0)
+    assert len(findings) == 1
+    assert findings[0].value == pytest.approx(3.0 / 23.0)
+
+
+def test_ratio_detector_min_total_floor(store, clock):
+    name = "sparkml_serve_requests_total"
+    store.record(name, {"model": "a", "outcome": "error"}, 0.0,
+                 kind="counter", now=1000.0)
+    store.record(name, {"model": "a", "outcome": "error"}, 1.0,
+                 kind="counter", now=1001.0)
+    det = RatioDetector("err", name, select={"outcome": "error"},
+                        threshold=0.05, window=60.0, min_total=10.0)
+    # one failure among one request is 100% — and still not an outage
+    assert det.evaluate(store, 1002.0) == []
+
+
+# -- delta: breaker flaps ------------------------------------------------------
+
+
+def test_delta_detector_counts_flaps_not_single_opens(store, clock):
+    name = "sparkml_serve_breaker_transitions_total"
+    labels = {"model": "m", "state": "open"}
+    store.record(name, labels, 0.0, kind="counter", now=1000.0)
+    store.record(name, labels, 1.0, kind="counter", now=1010.0)
+    det = DeltaDetector("flap", name, labels={"state": "open"},
+                        min_delta=3.0, window=120.0)
+    assert det.evaluate(store, 1011.0) == []  # one open: self-healing
+    store.record(name, labels, 2.0, kind="counter", now=1020.0)
+    store.record(name, labels, 3.0, kind="counter", now=1030.0)
+    findings = det.evaluate(store, 1031.0)
+    assert len(findings) == 1 and findings[0].value == 3.0
+
+
+def test_delta_detector_counts_the_birth_transition(store, clock):
+    # the first open mints the state="open" child already at 1: three
+    # opens must read as delta 3 (the flap threshold), not 2
+    name = "sparkml_serve_breaker_transitions_total"
+    labels = {"model": "m", "state": "open"}
+    store.record(name, labels, 1.0, kind="counter", now=1000.0)
+    store.record(name, labels, 2.0, kind="counter", now=1010.0)
+    store.record(name, labels, 3.0, kind="counter", now=1020.0)
+    det = DeltaDetector("flap", name, labels={"state": "open"},
+                        min_delta=3.0, window=120.0)
+    findings = det.evaluate(store, 1021.0)
+    assert len(findings) == 1 and findings[0].value == 3.0
+
+
+# -- the catalog ---------------------------------------------------------------
+
+
+def test_builtin_catalog_names_and_env_window(monkeypatch):
+    names = {d.name for d in builtin_detectors()}
+    assert names == {
+        "serve_p99_spike", "serve_queue_depth", "serve_error_rate",
+        "device_mem_in_use", "breaker_flap", "slo_fast_burn",
+    }
+    from spark_rapids_ml_tpu.obs import anomaly
+
+    monkeypatch.setenv(anomaly.WINDOW_ENV, "8")
+    dets = {d.name: d for d in builtin_detectors()}
+    assert dets["serve_p99_spike"].query_window == 8.0
+    assert dets["serve_error_rate"].query_window == 8.0
+    monkeypatch.setenv(anomaly.WINDOW_ENV, "garbage")
+    assert {d.name: d for d in builtin_detectors()}[
+        "serve_p99_spike"].query_window == 60.0
+    for det in builtin_detectors():
+        doc = det.describe()
+        assert doc["name"] == det.name and doc["metric"] == det.metric

@@ -1,0 +1,460 @@
+"""obs.incidents + obs.retention + log rate limiting + checker rule 8.
+
+The incident lifecycle (hysteresis, dedup, cooldown, resolve) runs
+entirely under injected timestamps — zero real sleeps; evidence bundles
+land in a tmp dump dir via the env knob the writers already honor."""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+from spark_rapids_ml_tpu.obs import flight
+from spark_rapids_ml_tpu.obs import incidents as incidents_mod
+from spark_rapids_ml_tpu.obs import profiler as profiler_mod
+from spark_rapids_ml_tpu.obs import retention
+from spark_rapids_ml_tpu.obs.anomaly import Finding, ThresholdDetector
+from spark_rapids_ml_tpu.obs.incidents import (
+    IncidentEngine,
+    IncidentManager,
+)
+from spark_rapids_ml_tpu.obs.logging import (
+    BURST_ENV,
+    RATE_ENV,
+    StructuredLogger,
+)
+from spark_rapids_ml_tpu.obs.metrics import MetricsRegistry
+from spark_rapids_ml_tpu.obs.tsdb import MetricsSampler, TimeSeriesStore
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _finding(detector="det", kind="saturation", severity="warning",
+             labels=None, value=50.0):
+    return Finding(detector=detector, kind=kind, severity=severity,
+                   metric="sparkml_serve_queue_depth",
+                   labels=labels if labels is not None else {"model": "m"},
+                   value=value, baseline=2.0, reason="test finding")
+
+
+@pytest.fixture
+def dump_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.DUMP_DIR_ENV, str(tmp_path / "dumps"))
+    return tmp_path / "dumps"
+
+
+@pytest.fixture
+def manager(dump_dir):
+    return IncidentManager(open_after=2, resolve_after=3,
+                           cooldown_seconds=30.0, capture_seconds=0.0,
+                           registry=MetricsRegistry())
+
+
+# -- hysteresis / dedup / resolve / cooldown ----------------------------------
+
+
+def test_hysteresis_needs_consecutive_firing_sweeps(manager):
+    assert manager.observe([_finding()], now=1000.0) == []
+    # the streak BROKE: one quiet sweep resets it
+    assert manager.observe([], now=1001.0) == []
+    assert manager.observe([_finding()], now=1002.0) == []
+    opened = manager.observe([_finding()], now=1003.0)
+    assert len(opened) == 1
+    assert opened[0].opened_ts == 1003.0
+    assert manager.opened_total == 1
+
+
+def test_dedup_continued_firing_updates_not_duplicates(manager):
+    manager.observe([_finding(value=50.0)], now=1000.0)
+    (incident,) = manager.observe([_finding(value=50.0)], now=1001.0)
+    for i in range(5):
+        assert manager.observe([_finding(value=60.0 + i)],
+                               now=1002.0 + i) == []
+    assert manager.opened_total == 1
+    snap = manager.snapshot()
+    assert len(snap["open"]) == 1
+    assert snap["open"][0]["id"] == incident.id
+    assert snap["open"][0]["updates"] == 5
+    assert snap["open"][0]["value"] == 64.0  # latest firing value
+
+
+def test_resolve_after_quiet_sweeps_and_cooldown_suppression(manager):
+    manager.observe([_finding()], now=1000.0)
+    (incident,) = manager.observe([_finding()], now=1001.0)
+    # quiet, but not for resolve_after sweeps yet
+    manager.observe([], now=1002.0)
+    manager.observe([], now=1003.0)
+    assert len(manager.open_incidents()) == 1
+    manager.observe([], now=1004.0)
+    assert manager.open_incidents() == []
+    (recent,) = manager.recent_incidents()
+    assert recent["id"] == incident.id
+    assert recent["state"] == "resolved"
+    assert recent["resolved_ts"] == 1004.0
+    assert manager.resolved_total == 1
+    # refire inside the cooldown: suppressed, counted, never opened
+    for i in range(6):
+        assert manager.observe([_finding()], now=1010.0 + i) == []
+    assert manager.suppressed_total > 0
+    assert manager._reg().counter(
+        "sparkml_obs_incidents_suppressed_total", "", ("detector",),
+    ).value(detector="det") == manager.suppressed_total
+    # past the cooldown the key can open again (fresh hysteresis)
+    manager.observe([_finding()], now=1040.0)
+    opened = manager.observe([_finding()], now=1041.0)
+    assert len(opened) == 1 and opened[0].id != incident.id
+
+
+def test_distinct_series_open_distinct_incidents(manager):
+    a = _finding(labels={"model": "a"})
+    b = _finding(labels={"model": "b"})
+    manager.observe([a, b], now=1000.0)
+    opened = manager.observe([a, b], now=1001.0)
+    assert len(opened) == 2
+    assert manager._reg().gauge(
+        "sparkml_obs_incidents_open", "").value() == 2.0
+    # same detector, same sweep, same millisecond: the ids (and so the
+    # evidence directories) must still be distinct
+    assert opened[0].id != opened[1].id
+
+
+# -- evidence bundles ---------------------------------------------------------
+
+
+def test_evidence_bundle_lands_on_disk(manager, dump_dir):
+    store = TimeSeriesStore(tiers=((1.0, 600.0),),
+                            clock=FakeClock(1100.0))
+    for i in range(30):
+        store.record("sparkml_serve_queue_depth", {"model": "m"},
+                     float(i), now=1000.0 + i)
+    manager.observe([_finding()], now=1029.0, store=store)
+    (incident,) = manager.observe([_finding()], now=1030.0, store=store)
+    evidence = incident.evidence
+    bundle = evidence["dir"]
+    assert os.path.isdir(bundle)
+    assert str(dump_dir) in bundle
+    with open(os.path.join(bundle, "incident.json")) as f:
+        doc = json.load(f)
+    assert doc["id"] == incident.id
+    assert doc["detector"] == "det"
+    assert doc["state"] == "open"
+    with open(os.path.join(bundle, "history.json")) as f:
+        history = json.load(f)
+    implicated = history["implicated"]
+    assert implicated["metric"] == "sparkml_serve_queue_depth"
+    assert implicated["series"] and implicated["series"][0]["points"]
+    assert os.path.isfile(os.path.join(bundle, "traces.json"))
+    # the flight dump is a real dump in the same dump dir
+    assert evidence["flight_dump"] and os.path.isfile(
+        evidence["flight_dump"])
+    with open(evidence["flight_dump"]) as f:
+        dump_doc = json.load(f)
+    assert dump_doc["extra"]["incident_id"] == incident.id
+    # resolve rewrites incident.json with the final state
+    for i in range(3):
+        manager.observe([], now=1031.0 + i, store=store)
+    with open(os.path.join(bundle, "incident.json")) as f:
+        assert json.load(f)["state"] == "resolved"
+
+
+def test_profile_capture_guarded_single_flight(dump_dir, monkeypatch):
+    calls = []
+
+    def fake_start(seconds, label="x"):
+        calls.append((seconds, label))
+        if len(calls) > 1:
+            raise profiler_mod.CaptureInFlight("already running")
+        return {"id": "cap1", "seconds": seconds}
+
+    monkeypatch.setattr(profiler_mod, "start_capture", fake_start)
+    manager = IncidentManager(open_after=1, resolve_after=1,
+                              cooldown_seconds=0.0, capture_seconds=2.0,
+                              registry=MetricsRegistry())
+    latency = _finding(detector="lat", kind="latency",
+                       labels={"model": "a"})
+    (first,) = manager.observe([latency], now=1000.0)
+    assert first.evidence["profile"]["started"]["id"] == "cap1"
+    assert calls[0][0] == 2.0 and "incident_lat" in calls[0][1]
+    # a second latency incident while the capture runs: skipped, not
+    # stacked — and the skip is recorded in the bundle
+    other = _finding(detector="lat2", kind="latency",
+                     labels={"model": "b"})
+    (second,) = manager.observe([latency, other], now=1001.0)
+    assert second.evidence["profile"] == {
+        "skipped": "capture_in_flight"}
+    # non-latency/memory kinds never trigger a capture
+    err = _finding(detector="errs", kind="errors", labels={"model": "c"})
+    (third,) = manager.observe([latency, other, err], now=1002.0)
+    assert third.evidence["profile"] == {"skipped": "kind_errors"}
+    assert len(calls) == 2
+
+
+def test_severity_escalates_from_live_burn(dump_dir):
+    store = TimeSeriesStore(tiers=((1.0, 600.0),),
+                            clock=FakeClock(1000.0))
+    store.record("sparkml_slo_burn_rate",
+                 {"slo": "serve_availability", "window": "5m"},
+                 120.0, now=999.0)
+    manager = IncidentManager(open_after=1, resolve_after=1,
+                              cooldown_seconds=0.0, capture_seconds=0.0,
+                              registry=MetricsRegistry())
+    (incident,) = manager.observe([_finding(severity="warning")],
+                                  now=1000.0, store=store)
+    assert incident.severity == "critical"  # burn 120 >= page_fast 14.4
+
+
+# -- the engine on the sampler: no new thread, cost visible -------------------
+
+
+def test_engine_runs_inside_sampler_sweep(dump_dir):
+    clock = FakeClock(1000.0)
+    reg = MetricsRegistry()
+    gauge = reg.gauge("sparkml_serve_queue_depth", "", ("model",))
+    store = TimeSeriesStore(tiers=((1.0, 600.0),), clock=clock)
+    sampler = MetricsSampler(store, registry=reg, interval_seconds=1.0,
+                             clock=clock)
+    engine = IncidentEngine(
+        store=store,
+        detectors=[ThresholdDetector(
+            "qd", "sparkml_serve_queue_depth", threshold=10.0,
+            kind="saturation")],
+        manager=IncidentManager(open_after=2, resolve_after=2,
+                                cooldown_seconds=0.0,
+                                capture_seconds=0.0, registry=reg),
+        registry=reg,
+    )
+    try:
+        engine.install(sampler)
+        engine.install(sampler)  # idempotent: one sweep per sample
+        gauge.set(2, model="m")
+        sampler.sample_once(now=1000.0)
+        assert engine.sweeps == 1  # detection ran inside the sweep
+        gauge.set(99, model="m")
+        sampler.sample_once(now=1001.0)
+        sampler.sample_once(now=1002.0)
+        snap = engine.snapshot()
+        assert len(snap["open"]) == 1
+        assert snap["open"][0]["detector"] == "qd"
+        assert snap["sweeps"] == 3
+        # the detector sweep cost is visible in the obs overhead counter
+        assert reg.counter(
+            "sparkml_obs_overhead_seconds_total", "", ("component",),
+        ).value(component="anomaly") > 0.0
+        # open incidents ride every flight dump via the registered section
+        doc = flight.build_dump("test_incident_section")
+        assert doc["incidents"]["open"][0]["detector"] == "qd"
+        # recovery resolves through the same sweep path
+        gauge.set(1, model="m")
+        sampler.sample_once(now=1003.0)
+        sampler.sample_once(now=1004.0)
+        assert engine.snapshot()["open"] == []
+        assert engine.snapshot()["resolved_total"] == 1
+    finally:
+        engine.uninstall(sampler)
+        flight.unregister_dump_section("incidents")
+
+
+def test_broken_detector_counted_never_kills_sweep(dump_dir):
+    reg = MetricsRegistry()
+
+    class Broken:
+        name = "broken"
+
+        def evaluate(self, store, now):
+            raise RuntimeError("boom")
+
+        def describe(self):
+            return {"name": self.name}
+
+    store = TimeSeriesStore(tiers=((1.0, 60.0),), clock=FakeClock())
+    engine = IncidentEngine(store=store, detectors=[Broken()],
+                            manager=IncidentManager(
+                                registry=reg, capture_seconds=0.0),
+                            registry=reg)
+    try:
+        assert engine.sweep(now=1000.0) == []
+        assert reg.counter(
+            "sparkml_obs_detector_errors_total", "", ("detector",),
+        ).value(detector="broken") == 1.0
+    finally:
+        flight.unregister_dump_section("incidents")
+
+
+# -- retention GC -------------------------------------------------------------
+
+
+def _mk_file(path, size, mtime):
+    path.write_bytes(b"x" * size)
+    os.utime(path, (mtime, mtime))
+
+
+def test_retention_count_cap_oldest_first(tmp_path):
+    root = tmp_path / "dumps"
+    root.mkdir()
+    for i in range(6):
+        _mk_file(root / f"flightdump_r_{i}.json", 10, 1000.0 + i)
+    (root / "unrelated.txt").write_text("never touched")
+    (root / "flightdump_half.json.tmp").write_text("mid-rename")
+    from spark_rapids_ml_tpu.obs import get_registry
+
+    counter = get_registry().counter(
+        "sparkml_obs_artifacts_gc_total", "", ("kind",))
+    before = counter.value(kind="flight")
+    removed = retention.sweep_kind("flight", root=str(root), dirs=False,
+                                  keep_count=3, keep_bytes=0)
+    assert removed == 3
+    left = sorted(p.name for p in root.iterdir())
+    assert "flightdump_r_5.json" in left  # newest kept
+    assert "flightdump_r_0.json" not in left  # oldest gone
+    assert "unrelated.txt" in left and "flightdump_half.json.tmp" in left
+    assert counter.value(kind="flight") == before + 3
+
+
+def test_retention_byte_cap_on_directories(tmp_path):
+    root = tmp_path / "incidents"
+    root.mkdir()
+    for i in range(4):
+        d = root / f"inc_{i}"
+        d.mkdir()
+        _mk_file(d / "incident.json", 1000, 1000.0 + i)
+        os.utime(d, (1000.0 + i, 1000.0 + i))
+    removed = retention.sweep_kind("incident", root=str(root),
+                                   dirs=True, keep_count=0,
+                                   keep_bytes=2500)
+    assert removed == 2
+    assert sorted(p.name for p in root.iterdir()) == ["inc_2", "inc_3"]
+
+
+def test_retention_always_keeps_newest_artifact(tmp_path):
+    root = tmp_path / "dumps"
+    root.mkdir()
+    _mk_file(root / "flightdump_only.json", 10_000, 1000.0)
+    removed = retention.sweep_kind("flight", root=str(root), dirs=False,
+                                   keep_count=1, keep_bytes=1)
+    assert removed == 0  # the artifact just written always survives
+
+
+def test_retention_writer_hook_throttles(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.DUMP_DIR_ENV, str(tmp_path / "dumps"))
+    monkeypatch.setenv(retention.MAX_COUNT_ENV, "2")
+    monkeypatch.setattr(retention, "_last_sweep", {})
+    (tmp_path / "dumps").mkdir()
+    for i in range(5):
+        _mk_file(tmp_path / "dumps" / f"flightdump_{i}.json", 10,
+                 1000.0 + i)
+    assert retention.maybe_gc("flight", force=True) == 3
+    _mk_file(tmp_path / "dumps" / "flightdump_9.json", 10, 1009.0)
+    # inside the min interval the scan is skipped (a dump storm shares
+    # one sweep); force overrides
+    assert retention.maybe_gc("flight") == 0
+    assert retention.maybe_gc("flight", force=True) == 1
+
+
+# -- log rate limiting --------------------------------------------------------
+
+
+def _log_lines(stream):
+    return [json.loads(line) for line in
+            stream.getvalue().splitlines() if line.strip()]
+
+
+def test_log_token_bucket_suppresses_and_recovers(monkeypatch):
+    monkeypatch.setenv(RATE_ENV, "1")
+    monkeypatch.setenv(BURST_ENV, "5")
+    clock = FakeClock(0.0)
+    stream = io.StringIO()
+    log = StructuredLogger("stormy", stream=stream, clock=clock)
+    from spark_rapids_ml_tpu.obs import get_registry
+
+    suppressed = get_registry().counter(
+        "sparkml_log_suppressed_total", "", ("level", "logger"))
+    before = suppressed.value(level="error", logger="stormy")
+    for i in range(12):
+        log.error("incident storm", i=i)
+    lines = _log_lines(stream)
+    assert len(lines) == 5  # the burst
+    assert suppressed.value(level="error", logger="stormy") == before + 7
+    # refill: 3 seconds at 1 line/s admits more, and the first line
+    # after the dry spell names the gap
+    clock.t = 3.0
+    log.error("after the storm")
+    lines = _log_lines(stream)
+    assert len(lines) == 6
+    assert lines[-1]["suppressed_lines"] == 7
+    # levels are independent buckets: info was never throttled here
+    log.info("unrelated")
+    assert _log_lines(stream)[-1]["message"] == "unrelated"
+
+
+def test_log_rate_limit_disabled_with_nonpositive_rate(monkeypatch):
+    monkeypatch.setenv(RATE_ENV, "0")
+    stream = io.StringIO()
+    log = StructuredLogger("free", stream=stream, clock=FakeClock())
+    for i in range(100):
+        log.error("flood")
+    assert len(_log_lines(stream)) == 100
+
+
+# -- checker rule 8: the injectable-clock discipline is enforced --------------
+
+
+def _rule8(path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        from check_instrumentation import check_clock_injection
+    finally:
+        sys.path.pop(0)
+    return list(check_clock_injection(str(path)))
+
+
+def test_rule8_accepts_current_clocked_modules():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        from check_instrumentation import CLOCKED_OBS_FILES
+    finally:
+        sys.path.pop(0)
+    for path in CLOCKED_OBS_FILES:
+        assert os.path.exists(path), path
+        assert _rule8(path) == [], path
+
+
+def test_rule8_rejects_wall_clock_calls(tmp_path):
+    bad = tmp_path / "module.py"
+    bad.write_text(
+        "import time\n"
+        "import time as t\n"
+        "from time import monotonic as mono\n"
+        "def f(now=None):\n"
+        "    ts = time.time()\n"           # offender
+        "    ts2 = t.time()\n"             # aliased offender
+        "    ts3 = mono()\n"               # bare-name offender
+        "    dur = time.perf_counter()\n"  # allowed: duration, not ts
+        "    return ts, ts2, ts3, dur\n"
+    )
+    offenders = _rule8(bad)
+    assert [lineno for lineno, _ in offenders] == [5, 6, 7]
+    assert all("injectable clock" in why for _, why in offenders)
+
+
+def test_rule8_allows_clock_default_references(tmp_path):
+    ok = tmp_path / "module.py"
+    ok.write_text(
+        "import time\n"
+        "from typing import Callable\n"
+        "def f(clock: Callable[[], float] = time.time):\n"
+        "    return clock()\n"
+        "class C:\n"
+        "    def __init__(self, clock=time.monotonic):\n"
+        "        self.clock = clock\n"
+    )
+    assert _rule8(ok) == []

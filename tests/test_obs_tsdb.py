@@ -144,6 +144,28 @@ def test_counter_increase_handles_resets():
     assert counter_increase([]) == 0.0
 
 
+def test_windowed_increase_credits_births_inside_the_window(store,
+                                                            clock):
+    # a burst mints the child between two samples: its first sampled
+    # value is already 3 — first-to-last increase alone reads 0 and a
+    # windowed detector is blind to exactly the burst it watches for
+    store.record("c", {"o": "err"}, 3.0, kind="counter", now=1000.0)
+    store.record("c", {"o": "err"}, 3.0, kind="counter", now=1001.0)
+    clock.t = 1002.0
+    series = store.range_query("c", window=60.0)[0]
+    assert series["born_ts"] == 1000.0
+    assert tsdb_mod.counter_increase(series["points"]) == 0.0
+    assert tsdb_mod.windowed_increase(series, 1002.0 - 60.0) == 3.0
+    # the same series queried long after birth: the first value is now
+    # just the window edge of an old counter, not new increase
+    store.record("c", {"o": "err"}, 5.0, kind="counter", now=1200.0)
+    clock.t = 1201.0
+    series = store.range_query("c", window=5.0)[0]
+    assert tsdb_mod.windowed_increase(series, 1201.0 - 5.0) == 0.0
+    assert tsdb_mod.windowed_increase({"points": [], "born_ts": None},
+                                      0.0) == 0.0
+
+
 def test_rate_and_delta_over_reset(store, clock):
     values = [0, 10, 20, 5, 15]  # reset between 20 and 5
     for i, v in enumerate(values):

@@ -1,0 +1,218 @@
+"""ISSUE 8 acceptance e2e: with the sampler running against the real
+HTTP server, an injected latency fault opens EXACTLY ONE incident
+within two sweep cadences; its on-disk bundle contains the implicated
+series history, a flight dump, and at least one assembled trace tree;
+the incident auto-resolves after the fault clears — with the detector
+sweep cost visible in ``sparkml_obs_overhead_seconds_total`` and no
+thread beyond the existing sampler."""
+
+import gc
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import flight, get_registry
+from spark_rapids_ml_tpu.obs import incidents as incidents_mod
+from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+from spark_rapids_ml_tpu.serve import (
+    ModelRegistry,
+    ServeEngine,
+    fault_plane,
+    start_serve_server,
+)
+
+
+@pytest.fixture
+def served_incident_pca(rng, tmp_path, monkeypatch):
+    from spark_rapids_ml_tpu import PCA
+
+    monkeypatch.setenv(flight.DUMP_DIR_ENV, str(tmp_path / "dumps"))
+    # Lingering engines from other tests would keep republishing THEIR
+    # (possibly fault-storm) SLO burn gauges into our fresh store and
+    # could trip slo_fast_burn alongside the latency detector; dropping
+    # the dead ones keeps "exactly one incident" honest.
+    gc.collect()
+    tsdb_mod.reset_tsdb()
+    incidents_mod.reset_incident_engine()
+    x = rng.normal(size=(512, 16))
+    model = PCA().setK(4).fit(x)
+    reg = ModelRegistry()
+    reg.register("pca_inc", model, buckets=(32, 64))
+    engine = ServeEngine(reg, max_batch_rows=64, max_wait_ms=2,
+                         buckets=(32, 64))
+    reg.warmup("pca_inc")
+    server = start_serve_server(engine)  # sampler + incident engine
+    try:
+        yield engine, server, x
+    finally:
+        fault_plane().clear()
+        server.shutdown()
+        engine.shutdown()
+        tsdb_mod.stop_sampling()
+        flight.unregister_dump_section("metrics_history")
+        incidents_mod.reset_incident_engine()
+        tsdb_mod.reset_tsdb()
+
+
+def _get(base, path):
+    resp = urllib.request.urlopen(f"{base}{path}", timeout=30)
+    return json.loads(resp.read())
+
+
+def test_latency_fault_opens_one_incident_with_bundle_then_resolves(
+        served_incident_pca):
+    engine, server, x = served_incident_pca
+    host, port = server.server_address
+    base = f"http://{host}:{port}"
+
+    # Own the cadence: stop the background thread and drive the SAME
+    # process-wide sampler (with the incident engine installed on its
+    # post-sweep hook) under an injected clock — the whole
+    # detect→diagnose→resolve loop costs zero real seconds of sleeping.
+    sampler = tsdb_mod.get_sampler()
+    sampler.stop()
+    inc_engine = incidents_mod.get_incident_engine()
+    t_base = time.time() - 120.0
+
+    def predict(i, n=8):
+        start = (i * 13) % (x.shape[0] - n)
+        body = json.dumps(
+            {"model": "pca_inc", "rows": x[start:start + n].tolist()}
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+    overhead = get_registry().counter(
+        "sparkml_obs_overhead_seconds_total", "", ("component",))
+    anomaly_cost_before = overhead.value(component="anomaly")
+
+    # -- baseline: healthy traffic + 20 one-second sweeps ----------------
+    for i in range(20):
+        predict(i)
+        sampler.sample_once(now=t_base + i)
+    sweeps_before = inc_engine.sweeps
+    assert sweeps_before >= 20  # detection ran inside every sweep
+    assert _get(base, "/debug/incidents")["open"] == []
+
+    # -- the fault: +150 ms on every transform ---------------------------
+    fault_plane().inject("pca_inc", "latency", count=None, seconds=0.15)
+    for i in range(4):
+        predict(100 + i)
+
+    # exactly two sweep cadences later the incident is open
+    sampler.sample_once(now=t_base + 21)
+    sampler.sample_once(now=t_base + 22)
+    doc = _get(base, "/debug/incidents")
+    assert len(doc["open"]) == 1, doc["open"]
+    assert doc["opened_total"] == 1
+    incident = doc["open"][0]
+    assert incident["detector"] == "serve_p99_spike"
+    assert incident["kind"] == "latency"
+    assert incident["labels"]["model"] == "pca_inc"
+    assert incident["opened_ts"] == t_base + 22
+
+    # continued firing dedups into the same incident
+    sampler.sample_once(now=t_base + 23)
+    doc = _get(base, "/debug/incidents")
+    assert len(doc["open"]) == 1 and doc["opened_total"] == 1
+    assert doc["open"][0]["id"] == incident["id"]
+
+    # -- the evidence bundle ---------------------------------------------
+    evidence = incident["evidence"]
+    bundle = evidence["dir"]
+    assert os.path.isdir(bundle)
+    with open(os.path.join(bundle, "history.json")) as f:
+        history = json.load(f)
+    implicated = history["implicated"]
+    assert implicated["metric"] == \
+        "sparkml_serve_request_latency_seconds"
+    assert implicated["series"], "implicated series history missing"
+    assert all(s["points"] for s in implicated["series"])
+    assert evidence["flight_dump"] and os.path.isfile(
+        evidence["flight_dump"])
+    with open(os.path.join(bundle, "traces.json")) as f:
+        traces = json.load(f)
+    assert traces["trees"], "bundle carries no assembled trace tree"
+    tree = traces["trees"][0]
+    assert tree["span_count"] >= 1 and tree["spans"]
+    names = []
+
+    def walk(nodes):
+        for node in nodes:
+            names.append(node["name"])
+            walk(node["children"])
+
+    walk(tree["spans"])
+    assert any(name.startswith("serve:") for name in names), names
+
+    # -- cost and threading contracts ------------------------------------
+    assert overhead.value(component="anomaly") > anomaly_cost_before
+    assert not [t for t in threading.enumerate()
+                if "incident" in t.name.lower()
+                or "anomaly" in t.name.lower()]
+
+    # -- recovery: fault cleared, p99 plateaus, incident auto-resolves ---
+    fault_plane().clear()
+    for i in range(70):  # age the jump out of the 60 s lookback
+        sampler.sample_once(now=t_base + 24 + i)
+    doc = _get(base, "/debug/incidents")
+    assert doc["open"] == []
+    assert doc["resolved_total"] == 1
+    (resolved,) = [r for r in doc["recent"]
+                   if r["id"] == incident["id"]]
+    assert resolved["state"] == "resolved"
+    assert resolved["resolved_ts"] > resolved["opened_ts"]
+    # the bundle's incident.json carries the final lifecycle state
+    with open(os.path.join(bundle, "incident.json")) as f:
+        assert json.load(f)["state"] == "resolved"
+
+
+def test_incidents_endpoint_catalog_and_dashboard(served_incident_pca):
+    engine, server, x = served_incident_pca
+    host, port = server.server_address
+    base = f"http://{host}:{port}"
+    doc = _get(base, "/debug/incidents")
+    assert {d["name"] for d in doc["detectors"]} == {
+        "serve_p99_spike", "serve_queue_depth", "serve_error_rate",
+        "device_mem_in_use", "breaker_flap", "slo_fast_burn",
+    }
+    assert doc["open_after"] >= 1 and doc["resolve_after"] >= 1
+    html = urllib.request.urlopen(f"{base}/dashboard",
+                                  timeout=30).read().decode()
+    assert "/debug/incidents" in html
+    assert "Incidents" in html and "incidentRows" in html
+
+
+def test_incident_engine_disabled_by_env(rng, monkeypatch):
+    from spark_rapids_ml_tpu import PCA
+
+    monkeypatch.setenv(incidents_mod.ENABLED_ENV, "0")
+    tsdb_mod.reset_tsdb()
+    incidents_mod.reset_incident_engine()
+    x = np.asarray(rng.normal(size=(64, 8)))
+    model = PCA().setK(2).fit(x)
+    reg = ModelRegistry()
+    reg.register("pca_off", model, buckets=(16,))
+    engine = ServeEngine(reg, max_batch_rows=16, buckets=(16,))
+    server = start_serve_server(engine)
+    try:
+        sampler = tsdb_mod.get_sampler()
+        sampler.stop()
+        inc_engine = incidents_mod.get_incident_engine()
+        before = inc_engine.sweeps
+        sampler.sample_once(now=time.time())
+        assert inc_engine.sweeps == before  # not installed
+    finally:
+        server.shutdown()
+        engine.shutdown()
+        tsdb_mod.stop_sampling()
+        flight.unregister_dump_section("metrics_history")
+        incidents_mod.reset_incident_engine()
+        tsdb_mod.reset_tsdb()
