@@ -77,13 +77,21 @@ def main():
           f"buckets {BUCKETS}")
 
     print("\n== warmup (compiles happen HERE, not on user traffic) ==")
-    report = registry.warmup("prod")
-    for bucket, seconds in sorted(report["buckets"].items()):
-        print(f"  bucket {bucket:>4} rows: {seconds * 1000:7.1f} ms")
-
-    print("\n== 200 mixed-size requests through the engine ==")
     engine = ServeEngine(registry, max_batch_rows=256, max_wait_ms=3,
                          buckets=BUCKETS)
+    # engine.warmup = the registry's sync ladder PLUS the pipelined
+    # batcher's precision x bucket ladder (ServingProgram variants)
+    report = engine.warmup("prod")
+    for bucket, seconds in sorted(report["buckets"].items()):
+        print(f"  bucket {bucket:>4} rows: {seconds * 1000:7.1f} ms")
+    pipeline = report.get("pipeline")
+    if pipeline:
+        print(f"  pipeline ladder ({pipeline['precision']}, depth "
+              f"{engine.pipeline_depth}): "
+              + ", ".join(f"{b}:{s * 1000:.0f}ms"
+                          for b, s in sorted(pipeline["buckets"].items())))
+
+    print("\n== 200 mixed-size requests through the engine ==")
     # sizes/offsets precomputed: numpy Generators are not thread-safe
     sizes = rng.integers(1, 200, size=200)
     starts = [int(rng.integers(0, x.shape[0] - int(n))) for n in sizes]
@@ -137,6 +145,26 @@ def main():
     print(f"  transform p50/p95/p99: "
           f"{q['p50'] * 1e3:.1f} / {q['p95'] * 1e3:.1f} / "
           f"{q['p99'] * 1e3:.1f} ms")
+
+    # The hot-path pipeline's phase split: the last batch's
+    # TransformReport attributes stage (pad + host->device transfer),
+    # dispatch (async launch) and sync (the completion-step host sync)
+    # separately, and the busy/overlap counters show how much of the
+    # wall-clock the in-flight window kept the device fed.
+    from spark_rapids_ml_tpu.obs import last_transform_report
+
+    pipe_report = last_transform_report("pca")
+    if pipe_report and "stage" in (pipe_report.phases or {}):
+        ph = pipe_report.phases
+        print(f"  pipeline phase split:  stage {ph['stage'] * 1e3:.2f} / "
+              f"dispatch {ph['dispatch'] * 1e3:.2f} / "
+              f"sync {ph.get('sync', 0.0) * 1e3:.2f} ms (last batch)")
+    busy = scalar("sparkml_serve_device_busy_seconds_total",
+                  "pca_embedder")
+    overlap2 = scalar("sparkml_serve_pipeline_overlap_seconds_total",
+                      "pca_embedder")
+    print(f"  pipeline overlap:      device busy {busy / wall:.0%} of "
+          f"wall, >=2 batches in flight {overlap2 / wall:.0%}")
     names = [f"{m}@{versions[-1]['version']}"
              for m, versions in snap["models"].items()]
     print(f"  registered models:     {names}")

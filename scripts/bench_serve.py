@@ -10,9 +10,23 @@ committed history from the next PR onward:
 
 * ``rows_per_sec``            — end-to-end serving throughput;
 * ``p50`` / ``p95`` / ``p99`` — request latency seconds (also under
-  ``percentiles``, the sentinel's per-percentile judging shape);
+  ``percentiles``, the sentinel's per-percentile judging shape), plus
+  ``p99_ms`` (the same tail in milliseconds — the headline number the
+  pipeline PR is judged on);
+* ``p99_cold`` / ``p99_steady`` — the cold-vs-steady split: tail latency
+  over the first ~10% of requests (first-touch compiles, cache warming,
+  pipeline fill) vs the steady-state remainder — a warmup regression
+  and a hot-path regression stop hiding behind one blended number;
 * ``mean_batch_occupancy``    — real rows / bucket rows over the run
   (how well coalescing fills the padded shapes);
+* ``pipeline_overlap_fraction`` — union device-busy time ÷ wall time
+  (``sparkml_serve_device_busy_seconds_total`` over the run): how much
+  of the bench wall-clock had at least one batch in flight. > 0
+  whenever the pipelined batcher ran; the deeper companion
+  ``pipeline_overlap2_fraction`` (>= 2 batches in flight) is the
+  stage/compute overlap the PIPELINE_DEPTH=2 window buys;
+* ``pipeline_depth``          — the engine's in-flight window, so a
+  history line is attributable to its pipeline posture;
 * ``recompile_count``         — distinct-signature compiles during the
   serve phase; steady state must stay at 0 (warmup owns them all);
 * ``slo_fast_burn_rate``      — the worst fast-window (5 m) SLO burn rate
@@ -24,7 +38,8 @@ committed history from the next PR onward:
 
 Knobs (env): SPARKML_BENCH_SERVE_REQUESTS (default 512),
 SPARKML_BENCH_SERVE_FEATURES (64), SPARKML_BENCH_SERVE_K (16),
-SPARKML_BENCH_SERVE_THREADS (8), SPARKML_BENCH_SERVE_MAX_ROWS (512).
+SPARKML_BENCH_SERVE_THREADS (8), SPARKML_BENCH_SERVE_MAX_ROWS (512),
+plus the engine's SPARK_RAPIDS_ML_TPU_SERVE_{PIPELINE_DEPTH,PRECISION}.
 """
 
 from __future__ import annotations
@@ -74,7 +89,10 @@ def main() -> int:
         registry, max_batch_rows=max_rows, max_wait_ms=2.0,
         max_queue_depth=4 * n_requests,
     )
-    registry.warmup("bench_pca", max_bucket_rows=max_rows)
+    # engine.warmup also precompiles the pipeline's precision x bucket
+    # ladder, so the cold split below measures cache/queue warming, not
+    # first-request XLA compiles.
+    engine.warmup("bench_pca")
     compiles_before = sum(
         s["compiles"] for s in compile_stats().values()
     )
@@ -116,10 +134,27 @@ def main() -> int:
 
     batch_rows = _counter("sparkml_serve_batch_rows_total")
     bucket_rows = _counter("sparkml_serve_bucket_rows_total")
+    busy_s = _counter("sparkml_serve_device_busy_seconds_total")
+    overlap2_s = _counter("sparkml_serve_pipeline_overlap_seconds_total")
     p50, p95, p99 = (float(np.percentile(latencies, q))
                      for q in (50, 95, 99))
+    # Cold-vs-steady split: the first ~10% of requests (pool.map submits
+    # roughly in index order) carry first-touch costs — pipeline fill,
+    # allocator/cache warming — the steady tail should not pay.
+    n_cold = max(min(32, n_requests), n_requests // 10)
+    p99_cold = float(np.percentile(latencies[:n_cold], 99))
+    p99_steady = (float(np.percentile(latencies[n_cold:], 99))
+                  if n_requests > n_cold else p99_cold)
     bench_common.emit_record({
         "bench": "serve_engine",
+        # metric/value/unit make the record sentinel-judgeable as a
+        # scalar (p99 seconds, lower-is-better via the "second" unit
+        # heuristic) on top of the per-percentile judging that
+        # `percentiles` triggers — without "metric" the sentinel could
+        # not judge serve records at all.
+        "metric": "serve_engine_latency",
+        "value": float(np.percentile(latencies, 99)),
+        "unit": "seconds (p99 request latency)",
         "platform": device.platform,
         "device_kind": str(device.device_kind),
         "requests": n_requests,
@@ -130,10 +165,19 @@ def main() -> int:
         "p50": p50,
         "p95": p95,
         "p99": p99,
+        "p99_ms": p99 * 1000.0,
+        "p99_cold": p99_cold,
+        "p99_steady": p99_steady,
         "percentiles": {"p50": p50, "p95": p95, "p99": p99},
         "mean_batch_occupancy": (
             batch_rows / bucket_rows if bucket_rows else 0.0
         ),
+        "pipeline_overlap_fraction": busy_s / wall if wall > 0 else 0.0,
+        "pipeline_overlap2_fraction": (
+            overlap2_s / wall if wall > 0 else 0.0
+        ),
+        "pipeline_depth": engine.pipeline_depth,
+        "precision": engine.precision,
         "recompile_count": int(compiles_after - compiles_before),
         "slo_fast_burn_rate": slo_fast_burn,
         "slo_budget_remaining": slo_budget_remaining,

@@ -218,6 +218,50 @@ def _phase(base: str, model: str, x, n_requests: int, rng):
     }
 
 
+def _concurrent_burst(base: str, model: str, x, n_requests: int, rng,
+                      width: int = 4):
+    """Drive one phase from ``width`` client threads at once, so the
+    pipelined batcher genuinely holds batches in its in-flight window
+    while the fault fires (the serial ``_phase`` loop rarely gets two
+    batches in flight). Same stats shape as ``_phase``."""
+    import threading
+
+    jobs = [(int(rng.integers(1, 9)),
+             int(rng.integers(0, x.shape[0] - 9)))
+            for _ in range(n_requests)]
+    results = []
+    lock = threading.Lock()
+    cursor = {"i": 0}
+
+    def worker():
+        while True:
+            with lock:
+                if cursor["i"] >= len(jobs):
+                    return
+                n, start = jobs[cursor["i"]]
+                cursor["i"] += 1
+            status, payload = _post_predict(base, model,
+                                            x[start:start + n])
+            with lock:
+                results.append(
+                    (status, bool(payload.get("degraded"))))
+
+    threads = [threading.Thread(target=worker) for _ in range(width)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ok = sum(1 for s, _ in results if s == 200)
+    return {
+        "requests": n_requests,
+        "ok": ok,
+        "availability": ok / n_requests if n_requests else 0.0,
+        "degraded": sum(1 for _, d in results if d),
+        "hung": sum(1 for s, _ in results if s == 0),
+        "statuses": sorted({s for s, _ in results}),
+    }
+
+
 def main() -> int:
     n_requests = _env_int("SPARKML_CHAOS_REQUESTS", 24)
     n_features = _env_int("SPARKML_CHAOS_FEATURES", 16)
@@ -380,6 +424,42 @@ def main() -> int:
         incidents["nan"] = _check_incident_loop("serve_error_rate",
                                                 known)
 
+        # -- the pipelined drill: the same fault classes with batches
+        # genuinely IN FLIGHT (concurrent clients + the async window,
+        # PIPELINE_DEPTH default 2). The breaker/retry/incident
+        # machinery must behave identically, and a worker restart must
+        # leave no stuck in-flight window behind.
+        bench_common.log(
+            f"chaos pipelined latency (+20 ms, depth="
+            f"{engine.pipeline_depth}, concurrent clients)")
+        _warm(max(n_requests // 2, 12))
+        plane.inject("chaos_pca", "latency", count=None, seconds=0.02)
+        phases["pipelined_latency"] = _concurrent_burst(
+            base, "chaos_pca", x, max(n_requests // 2, 8), rng)
+        plane.clear()
+
+        bench_common.log(
+            "chaos pipelined stall (wedge mid-window -> restart)")
+        plane.inject("chaos_pca", "stall", count=1, seconds=2.0)
+        phases["pipelined_stall"] = _concurrent_burst(
+            base, "chaos_pca", x, max(n_requests // 2, 8), rng)
+        plane.clear()
+        # no stuck in-flight window after the restart: the queue drains
+        # and a fresh request answers once the breaker re-admits traffic
+        t0 = time.monotonic()
+        while engine.queue_depth() > 0 and time.monotonic() < t0 + 10:
+            time.sleep(0.05)
+        pipeline_stuck_window = engine.queue_depth() > 0
+        _await_closed()
+        status, _payload = _post_predict(base, "chaos_pca", x[:4])
+        pipeline_recovered = status == 200
+        # Let the abandoned wedged worker clear its 2 s stall and exit
+        # cleanly BEFORE the drill ends: a daemon thread still inside a
+        # jax call at interpreter teardown aborts the whole process
+        # ("terminate called without an active exception") after the
+        # verdict has already been decided.
+        time.sleep(2.5)
+
         # -- recovery: wait out the cooldown, let a probe close it -------
         bench_common.log("chaos recovery (faults cleared)")
         recovery_seconds = _await_closed()
@@ -393,6 +473,13 @@ def main() -> int:
     fault_phases = ("raise", "stall", "nan", "latency")
     fault_requests = sum(phases[p]["requests"] for p in fault_phases)
     fault_ok = sum(phases[p]["ok"] for p in fault_phases)
+    # The pipelined phases get their OWN gate (not folded into
+    # availability_under_fault, whose committed history predates them):
+    # the behavior-parity claim is that faults with batches in flight
+    # are no worse than the serial phases.
+    availability_pipelined = min(
+        phases[p]["availability"]
+        for p in ("pipelined_latency", "pipelined_stall"))
     hung_total = sum(p["hung"] for p in phases.values())
     availability_under_fault = (fault_ok / fault_requests
                                 if fault_requests else 0.0)
@@ -405,6 +492,10 @@ def main() -> int:
         "breaker_open_seconds": breaker_open_seconds,
         "recovery_seconds": recovery_seconds,
         "final_breaker_state": breaker_state(),
+        "pipeline_depth": engine.pipeline_depth,
+        "pipeline_stuck_window": pipeline_stuck_window,
+        "pipeline_recovered": pipeline_recovered,
+        "availability_pipelined": availability_pipelined,
         "incidents_opened": incident_totals.get("opened_total", 0),
         "incidents_resolved": incident_totals.get("resolved_total", 0),
         "incidents": incidents,
@@ -423,6 +514,21 @@ def main() -> int:
         return 1
     if record["final_breaker_state"] != "closed":
         bench_common.log("chaos FAIL: breaker did not close after recovery")
+        return 1
+    if availability_pipelined < min_availability:
+        bench_common.log(
+            f"chaos FAIL: pipelined-phase availability "
+            f"{availability_pipelined:.2f} < {min_availability}")
+        return 1
+    if record["pipeline_stuck_window"]:
+        bench_common.log(
+            "chaos FAIL: in-flight window stuck after the pipelined "
+            "worker restart (queue never drained)")
+        return 1
+    if not record["pipeline_recovered"]:
+        bench_common.log(
+            "chaos FAIL: no 200 answer after the pipelined stall "
+            "restart + breaker recovery")
         return 1
     incident_failures = {name: check["problems"]
                          for name, check in incidents.items()

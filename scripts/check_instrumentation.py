@@ -63,6 +63,18 @@ Three rule families:
    as a default (``clock: Callable = time.time``) is the sanctioned
    spelling and passes; ``time.perf_counter()`` (duration
    self-measurement, not a timestamp) passes too.
+9. over ``serve/batching.py`` (the pipelined micro-batcher): no
+   host-sync calls — ``np.asarray(...)``, ``block_until_ready(...)``,
+   or a direct ``.__array__()`` — anywhere in the worker loop except
+   the DESIGNATED completion step (``_complete_batch``) and the
+   submit-time dtype coercion at the door (``submit``, which runs on
+   the caller's thread before any device value exists). The whole
+   point of the async pipeline is that compute of batch N+1 overlaps
+   the transfer of N+2 and the result fetch of N; one stray
+   ``np.asarray`` on a device value inside the loop silently
+   re-serializes all three, and nothing else would fail — latency
+   would just quietly double. This rule makes that edit impossible to
+   ship unnoticed.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -473,6 +485,48 @@ def check_clock_injection(path: str):
                    "real sleeps)")
 
 
+# rule 9: host-sync call names forbidden in the batcher's worker loop,
+# and the only functions allowed to use them — the designated completion
+# step, plus the caller-thread dtype coercion at the submission door.
+_HOST_SYNC_CALLS = frozenset({"asarray", "block_until_ready", "__array__"})
+_HOST_SYNC_ALLOWED_FUNCS = frozenset({"_complete_batch", "submit"})
+BATCHING_FILE = os.path.join(
+    REPO, "spark_rapids_ml_tpu", "serve", "batching.py"
+)
+
+
+def check_pipeline_sync(path: str):
+    """Rule 9: yield (lineno, description) for every host-sync call in
+    ``serve/batching.py`` outside the designated completion step.
+
+    Judged per enclosing function (like rule 5): a call whose name is
+    ``asarray`` / ``block_until_ready`` / ``__array__`` — any spelling,
+    ``np.asarray`` or a bare import — inside any function except
+    ``_complete_batch`` (THE sync point) or ``submit`` (caller-thread
+    coercion) is an offender. A host sync smuggled into the stage or
+    dispatch step would silently re-serialize the pipeline.
+    """
+    tree = ast.parse(open(path).read(), filename=path)
+
+    def visit(node, enclosing_name):
+        for child in ast.iter_child_nodes(node):
+            name = enclosing_name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            if isinstance(child, ast.Call):
+                call = _call_name(child)
+                if call in _HOST_SYNC_CALLS and \
+                        enclosing_name not in _HOST_SYNC_ALLOWED_FUNCS:
+                    yield (child.lineno,
+                           f"host sync {call}(...) outside the designated "
+                           "completion step (move it into "
+                           "_complete_batch — a sync in the stage/"
+                           "dispatch path re-serializes the pipeline)")
+            yield from visit(child, name)
+
+    yield from visit(tree, None)
+
+
 def library_files():
     """Every .py under the package, minus the exempt helper dirs."""
     out = []
@@ -547,6 +601,10 @@ def main() -> int:
         rel = os.path.relpath(path, REPO)
         for lineno, why in check_clock_injection(path):
             offenders.append(f"{rel}:{lineno} {why}")
+    if os.path.exists(BATCHING_FILE):
+        rel = os.path.relpath(BATCHING_FILE, REPO)
+        for lineno, why in check_pipeline_sync(BATCHING_FILE):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -562,7 +620,8 @@ def main() -> int:
         f"TraceContext, no silent exception swallows); "
         f"{len(lib_files)} library module(s) free of bare print(; "
         f"{len(clocked_files)} clocked obs module(s) free of direct "
-        f"wall-clock calls"
+        f"wall-clock calls; serve/batching.py host-syncs only in its "
+        f"designated completion step"
     )
     return 0
 

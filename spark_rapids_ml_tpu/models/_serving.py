@@ -1,0 +1,90 @@
+"""Shared ``ServingProgram`` construction for the pipelined serving hook.
+
+Every model exposing ``serving_transform_program`` needs the same
+scaffolding: resolve the device and transform dtype, decide whether the
+donated kernel twin is worth using (donation is a warning no-op on CPU),
+look up the precision variant, stage the constant model weights to the
+device ONCE, and wrap the put / run / fetch closures into an
+``obs.serving.ServingProgram``. This module holds that scaffolding so
+PCA / KMeans / LogisticRegression (and future models) each contribute
+only what is genuinely theirs: the kernel table and the per-precision
+weight staging.
+
+Weight staging happens here exactly once per program: the bf16 variants
+receive pre-cast weights, the int8 variants receive pre-quantized
+(int8, scale) pairs (``ops.quantize.quantize_symmetric_host``) — the
+per-batch kernels quantize/cast only the batch operand, never the
+constant weights.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def resolve_serving_context(model) -> Tuple[object, object, bool]:
+    """``(device, dtype, donate)`` for a model's serving program: the
+    model's resolved device and transform dtype, plus whether the
+    donated kernel twin should be used (off-CPU only — on CPU donation
+    is a no-op that warns)."""
+    from spark_rapids_ml_tpu.models.pca import (
+        _resolve_device,
+        _resolve_dtype,
+    )
+
+    device = _resolve_device(model.getDeviceId())
+    dtype = _resolve_dtype(model.getDtype())
+    donate = getattr(device, "platform", "cpu") != "cpu"
+    return device, dtype, donate
+
+
+def build_serving_program(
+    *,
+    device,
+    dtype,
+    algo: str,
+    precision: str,
+    kernels: Dict[str, Callable],
+    weights: Tuple,
+    fetch_dtype: Optional[np.dtype] = None,
+):
+    """The shared put/run/fetch assembly.
+
+    ``kernels`` maps precision → jitted kernel; ``weights`` is the tuple
+    of device-staged constant operands the kernel takes after the batch
+    (already cast/quantized for this precision); ``fetch_dtype`` is the
+    host dtype the sync path's output carries (so pipeline outputs stay
+    bit-equal to it — None keeps the device result's own dtype).
+    Raises ``ValueError`` for an unknown precision.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.obs.serving import ServingProgram
+
+    kernel = kernels.get(precision)
+    if kernel is None:
+        raise ValueError(
+            f"unknown serving precision {precision!r} "
+            f"(one of {sorted(kernels)})"
+        )
+
+    def put(matrix):
+        return jax.device_put(jnp.asarray(matrix, dtype=dtype), device)
+
+    def run(x_dev):
+        return kernel(x_dev, *weights)
+
+    def fetch(out_dev):
+        out = np.asarray(out_dev)
+        if fetch_dtype is None:
+            return out
+        # astype(copy=False) converts when dtypes differ and is a no-op
+        # when they already match
+        return out.astype(fetch_dtype, copy=False)
+
+    return ServingProgram(put=put, run=run, fetch=fetch,
+                          dtype=np.dtype(dtype), algo=algo,
+                          precision=precision)

@@ -396,6 +396,48 @@ class KMeansModel(KMeansParams):
             self.getPredictionCol(), labels.astype(np.int32).tolist()
         )
 
+    def serving_transform_program(self, precision: str = "native"):
+        """Device-resident serving program for the pipelined batcher
+        (``obs.serving.ServingProgram``): centers staged once, ``run``
+        async-dispatches the assignment kernel (distance argmin — the
+        int8/bf16 variants reduce only the cross-term GEMM), ``fetch``
+        the completion-step sync. None for host-path models."""
+        if self.cluster_centers is None or not self.getUseXlaDot():
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models._serving import (
+            build_serving_program,
+            resolve_serving_context,
+        )
+        from spark_rapids_ml_tpu.ops import kmeans_kernel as _kk
+        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
+
+        device, dtype, donate = resolve_serving_context(self)
+        if precision == "bf16":
+            weights = (jax.device_put(jnp.asarray(
+                self.cluster_centers, dtype=jnp.bfloat16), device),)
+        elif precision == "int8":
+            q, scale = quantize_symmetric_host(self.cluster_centers)
+            weights = (jax.device_put(jnp.asarray(q), device), scale)
+        else:
+            weights = (jax.device_put(jnp.asarray(
+                self.cluster_centers, dtype=dtype), device),)
+        return build_serving_program(
+            device=device, dtype=dtype, algo="kmeans",
+            precision=precision,
+            kernels={
+                "native": (_kk.assign_clusters_serve if donate
+                           else _kk.assign_clusters_jit),
+                "bf16": _kk.assign_clusters_bf16,
+                "int8": _kk.assign_clusters_int8,
+            },
+            weights=weights,
+            # int32 labels, matching the sync path's prediction column
+            fetch_dtype=np.int32,
+        )
+
     def compute_cost(self, dataset) -> float:
         """Sum of squared distances to nearest center (Spark computeCost)."""
         frame = as_vector_frame(dataset, self.getInputCol())

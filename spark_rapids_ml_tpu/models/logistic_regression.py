@@ -762,6 +762,53 @@ class LogisticRegressionModel(LogisticRegressionParams):
             proba = _sigmoid(z)
         return proba.astype(np.float64)
 
+    def serving_transform_program(self, precision: str = "native"):
+        """Device-resident serving program for the pipelined batcher
+        (``obs.serving.ServingProgram``): σ(X·w + b) with the weights
+        staged once; the bf16/int8 variants reduce only the logit GEMM
+        (the sigmoid stays f32). Binary models only — the multinomial
+        path is a host softmax, and host-path models return None."""
+        if (self.coefficient_matrix is not None
+                or self.coefficients is None
+                or not self.getUseXlaDot()):
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models._serving import (
+            build_serving_program,
+            resolve_serving_context,
+        )
+        from spark_rapids_ml_tpu.ops import logreg_kernel as _lk
+        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
+
+        device, dtype, donate = resolve_serving_context(self)
+        b_dev = jax.device_put(
+            jnp.asarray(self.intercept, dtype=dtype), device)
+        if precision == "bf16":
+            weights = (jax.device_put(jnp.asarray(
+                self.coefficients, dtype=jnp.bfloat16), device), b_dev)
+        elif precision == "int8":
+            q, scale = quantize_symmetric_host(self.coefficients)
+            weights = (jax.device_put(jnp.asarray(q), device), scale,
+                       b_dev)
+        else:
+            weights = (jax.device_put(jnp.asarray(
+                self.coefficients, dtype=dtype), device), b_dev)
+        return build_serving_program(
+            device=device, dtype=dtype, algo="logistic_regression",
+            precision=precision,
+            kernels={
+                "native": (_lk.logreg_predict_serve if donate
+                           else _lk.logreg_predict_kernel),
+                "bf16": _lk.logreg_predict_bf16,
+                "int8": _lk.logreg_predict_int8,
+            },
+            weights=weights,
+            # f64 probabilities, matching predict_proba's sync output
+            fetch_dtype=np.float64,
+        )
+
     @observed_transform
     def transform(self, dataset) -> VectorFrame:
         frame = as_vector_frame(dataset, self.getInputCol())

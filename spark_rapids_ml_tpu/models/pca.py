@@ -669,6 +669,54 @@ class PCAModel(PCAParams):
                         out = x_host @ self.pc
         return frame.with_column(self.getOutputCol(), np.asarray(out, dtype=np.float64))
 
+    def serving_transform_program(self, precision: str = "native"):
+        """The device-resident serving program for the pipelined
+        micro-batcher (``obs.serving.ServingProgram``): components staged
+        to the device ONCE, ``put`` starting each batch's host→device
+        transfer, ``run`` async-dispatching the projection kernel
+        (donated staged input off-CPU), ``fetch`` the completion-step
+        host sync. ``precision`` selects the env-gated reduced-precision
+        variant ladder (bf16 / int8 GEMM — separate tracked signatures
+        per bucket, guarded by the engine's offline max-error check and
+        the numerics sentinel). Returns None for host-path models
+        (``useXlaDot=False``) — the engine then keeps the blocking sync
+        path."""
+        if self.pc is None or not self.getUseXlaDot():
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models._serving import (
+            build_serving_program,
+            resolve_serving_context,
+        )
+        from spark_rapids_ml_tpu.ops import pca_kernel as _pk
+        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
+
+        device, dtype, donate = resolve_serving_context(self)
+        if precision == "bf16":
+            weights = (jax.device_put(
+                jnp.asarray(self.pc, dtype=jnp.bfloat16), device),)
+        elif precision == "int8":
+            q, scale = quantize_symmetric_host(self.pc)
+            weights = (jax.device_put(jnp.asarray(q), device), scale)
+        else:
+            weights = (jax.device_put(
+                jnp.asarray(self.pc, dtype=dtype), device),)
+        return build_serving_program(
+            device=device, dtype=dtype, algo="pca", precision=precision,
+            kernels={
+                "native": (_pk.pca_transform_serve if donate
+                           else _pk.pca_transform_kernel),
+                "bf16": _pk.pca_transform_bf16,
+                "int8": _pk.pca_transform_int8,
+            },
+            weights=weights,
+            # f64 to match the sync path's output column exactly
+            # (bit-equal at native precision)
+            fetch_dtype=np.float64,
+        )
+
     def transform_schema(self, columns):
         """Output schema check: appends outputCol, k-sized vectors
         (``RapidsPCA.scala:193-200``)."""

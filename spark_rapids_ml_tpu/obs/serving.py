@@ -44,7 +44,7 @@ import re
 import threading
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import numpy as np
 
@@ -606,6 +606,131 @@ def _flight_deadline(algo: str, trace_id: str):
         )
     except Exception:
         return contextlib.nullcontext()
+
+
+# -- the pipelined (async-dispatch) serving path ---------------------------
+
+
+class ServingProgram(NamedTuple):
+    """A model's device-resident serving program for the pipelined
+    micro-batcher (``serve.batching``): the three hot-path steps split so
+    the batcher can overlap them across batches.
+
+    * ``put(host_matrix) → device_handle`` — start the host→device
+      transfer of a staged (bucket, d) batch (``jax.device_put``);
+    * ``run(device_handle) → device_result`` — launch the compiled
+      transform via JAX **async dispatch**, returning without forcing a
+      host sync;
+    * ``fetch(device_result) → np.ndarray`` — THE host sync
+      (``np.asarray``), called only from the batcher's designated
+      completion step (rule 9 of ``scripts/check_instrumentation.py``).
+
+    ``dtype`` is the numpy dtype the batcher coerces/stages requests in
+    (the model's transform dtype — the submit-time f64 blanket coercion
+    is gone); ``algo`` labels the per-batch TransformReport; ``precision``
+    records which variant ladder (native / bf16 / int8) is compiled.
+    """
+
+    put: Callable[[np.ndarray], Any]
+    run: Callable[[Any], Any]
+    fetch: Callable[[Any], np.ndarray]
+    dtype: Any
+    algo: str
+    precision: str = "native"
+
+
+class PipelineTransform:
+    """Per-batch observability for the pipelined serving path.
+
+    The async pipeline runs AROUND the models' decorated ``transform``
+    entry points (the decorator's blocking call-shape cannot span a
+    stage/dispatch/sync split that interleaves across batches), so this
+    object replaces it batch-for-batch: same ``TransformReport`` artifact,
+    same latency sketch, same numerics sentinel — with the phase split
+    attributed as ``stage`` (pad + host→device transfer), ``dispatch``
+    (async launch) and ``sync`` (the completion-step host sync) instead of
+    device_put/compute/host_sync. Compile events from ``tracked_jit``
+    attribute through ``dispatch_scope()`` exactly as they do for
+    decorated calls. Telemetry never breaks serving: ``finish`` is
+    exception-guarded end to end.
+    """
+
+    __slots__ = ("_ctx", "_started", "_t0")
+
+    def __init__(self, algo: str, trace_id: Optional[str] = None,
+                 precision: str = "native"):
+        self._ctx = TransformContext(algo, trace_id=trace_id)
+        if precision and precision != "native":
+            self._ctx.note(precision=precision)
+        self._ctx.note(pipelined=True)
+        self._started = _utcnow()
+        self._t0 = time.perf_counter()
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        """Accumulate one pre-measured pipeline phase (stage / dispatch /
+        sync) into the report's phase split."""
+        try:
+            self._ctx.timer.add(name, seconds)
+        except Exception:
+            pass
+
+    @contextlib.contextmanager
+    def dispatch_scope(self):
+        """Activate this batch's context around the async dispatch call so
+        ``tracked_jit`` compile/recompile events attribute to THIS batch's
+        report (warmup misses surface per batch, not as mystery stalls)."""
+        token = _current_ctx.set(self._ctx)
+        try:
+            yield self._ctx
+        finally:
+            _current_ctx.reset(token)
+
+    def finish(self, result: Optional[np.ndarray] = None, *,
+               rows: Optional[int] = None,
+               features: Optional[int] = None,
+               bytes_in: Optional[int] = None,
+               error: Optional[BaseException] = None,
+               parent_span_id: Optional[str] = None,
+               ) -> Optional[TransformReport]:
+        """Close the batch: build/record/publish its TransformReport (or
+        count the error — failed batches never feed the success sketch).
+        Also files the batch's ``transform:<algo>`` span (externally
+        timed, stage start → completion) so an assembled request tree
+        keeps the server → queue → batch → transform shape the decorated
+        sync path produces; ``parent_span_id`` nests it under the
+        batcher's fan-in batch span."""
+        try:
+            ctx = self._ctx
+            if error is not None:
+                get_registry().counter(
+                    "sparkml_transform_errors_total",
+                    "transform/predict calls that raised",
+                    ("algo", "error"),
+                ).inc(algo=ctx.algo, error=type(error).__name__)
+                return None
+            wall = time.perf_counter() - self._t0
+            ctx.span_id = spans.record_event(
+                f"transform:{ctx.algo}",
+                self._t0, self._t0 + wall,
+                trace_id=ctx.trace_id, parent_span_id=parent_span_id,
+                rows=rows, pipelined=True,
+            ).span_id
+            ctx.set_data(rows=rows, features=features, nbytes=bytes_in)
+            if result is not None and ctx.bytes_out is None:
+                ctx.bytes_out = _array_nbytes(result)
+            report = _build_report(ctx, self._started, wall)
+            rate = numerics_sample_rate()
+            if result is not None and rate > 0 and (
+                    rate >= 1.0 or random.random() < rate):
+                verdict = check_output_numerics(result)
+                if verdict is not None:
+                    report.numerics = verdict
+                    _record_numerics(ctx.algo, verdict)
+            _record_metrics(report)
+            _publish(report)
+            return report
+        except Exception:
+            return None  # telemetry must never break a serving batch
 
 
 # -- the decorator ---------------------------------------------------------

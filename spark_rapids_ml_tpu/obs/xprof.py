@@ -133,6 +133,19 @@ def compile_stats() -> Dict[str, Dict[str, Any]]:
     return out
 
 
+def signature_count(label_prefix: str) -> int:
+    """Distinct compiled signatures across tracked functions whose label
+    starts with ``label_prefix`` — the warmup-ladder assertion helper for
+    the serving tier, where the precision × bucket ladder registers one
+    label per variant (``pca_transform_serve``, ``pca_transform_bf16``,
+    ...) and one signature per bucket under each."""
+    return sum(
+        stats["signatures"]
+        for label, stats in compile_stats().items()
+        if label.startswith(label_prefix)
+    )
+
+
 def reset_compile_log() -> None:
     with _log_lock:
         _compile_log.clear()

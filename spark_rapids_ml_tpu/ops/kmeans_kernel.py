@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from spark_rapids_ml_tpu.obs.xprof import tracked_jit
+from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric
 
 
 class KMeansResult(NamedTuple):
@@ -49,6 +50,58 @@ def assign_clusters(x: jnp.ndarray, centers: jnp.ndarray) -> jnp.ndarray:
 # telemetry (models.KMeansModel.transform). assign_clusters itself stays
 # un-jitted so it fuses inside the training-loop programs.
 assign_clusters_jit = tracked_jit(assign_clusters, label="kmeans_assign")
+
+# Pipelined-serving variants (KMeansModel.serving_transform_program): the
+# *_serve form donates the staged batch buffer (the pipeline never re-reads
+# a staged buffer, and its retry path always re-stages from host rows);
+# the reduced-precision forms are separate tracked signatures per bucket,
+# env-gated + max-error-checked by the serving engine. Cluster assignment
+# only needs the argmin ORDER of the distances, so reduced-precision error
+# shows up as boundary-row flips, which the engine's mismatch-fraction
+# guard bounds.
+assign_clusters_serve = tracked_jit(
+    assign_clusters, label="kmeans_assign_serve", donate_argnums=(0,)
+)
+
+
+def _assign_bf16(x: jnp.ndarray, centers_bf16: jnp.ndarray) -> jnp.ndarray:
+    """bf16 cross-term matmul with f32 accumulation; norms in f32 of the
+    SAME bf16-rounded operands so the expanded ||x−c||² stays
+    consistent. Centers arrive PRE-CAST (staged once at program build)."""
+    xb = x.astype(jnp.bfloat16)
+    cross = lax.dot_general(
+        xb, centers_bf16, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    xf = xb.astype(jnp.float32)
+    cf = centers_bf16.astype(jnp.float32)
+    x2 = jnp.sum(xf * xf, axis=1, keepdims=True)
+    c2 = jnp.sum(cf * cf, axis=1)[None, :]
+    return jnp.argmin(x2 + c2 - 2.0 * cross, axis=1)
+
+
+assign_clusters_bf16 = tracked_jit(_assign_bf16, label="kmeans_assign_bf16")
+
+
+def _assign_int8(x: jnp.ndarray, centers_q: jnp.ndarray,
+                 centers_scale: jnp.ndarray) -> jnp.ndarray:
+    """int8 cross term with int32 accumulation (``ops.quantize``), norms
+    of the dequantized operands in f32 — distances consistent with the
+    quantized geometry, argmin unchanged under the shared scales.
+    Centers arrive PRE-QUANTIZED; only the batch quantizes per call."""
+    xq, sx = quantize_symmetric(x)
+    cross = lax.dot_general(
+        xq, centers_q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32) * (sx * centers_scale)
+    xf = xq.astype(jnp.float32) * sx
+    cf = centers_q.astype(jnp.float32) * centers_scale
+    x2 = jnp.sum(xf * xf, axis=1, keepdims=True)
+    c2 = jnp.sum(cf * cf, axis=1)[None, :]
+    return jnp.argmin(x2 + c2 - 2.0 * cross, axis=1)
+
+
+assign_clusters_int8 = tracked_jit(_assign_int8, label="kmeans_assign_int8")
 
 
 @partial(tracked_jit, static_argnames=("n_clusters",))

@@ -169,6 +169,52 @@ def logreg_predict_kernel(x, coefficients, intercept):
     return jax.nn.sigmoid(x @ coefficients + intercept)
 
 
+# Pipelined-serving variants (LogisticRegressionModel
+# .serving_transform_program): donated staged input for the *_serve form
+# (the pipeline never re-reads a staged buffer; retries re-stage from host
+# rows), plus env-gated reduced-precision logit GEMMs — the sigmoid always
+# runs in f32, only the X·w contraction drops precision.
+
+
+def _predict_sigmoid(x, coefficients, intercept):
+    return jax.nn.sigmoid(x @ coefficients + intercept)
+
+
+logreg_predict_serve = tracked_jit(
+    _predict_sigmoid, label="logreg_predict_serve", donate_argnums=(0,)
+)
+
+
+def _predict_bf16(x, coefficients_bf16, intercept):
+    """Coefficients arrive PRE-CAST (staged once at program build)."""
+    z = lax.dot_general(
+        x.astype(jnp.bfloat16),
+        coefficients_bf16[:, None],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    return jax.nn.sigmoid(z + intercept.astype(jnp.float32))
+
+
+logreg_predict_bf16 = tracked_jit(_predict_bf16, label="logreg_predict_bf16")
+
+
+def _predict_int8(x, coefficients_q, coefficients_scale, intercept):
+    """Coefficients arrive PRE-QUANTIZED (``quantize_symmetric_host``);
+    only the batch pays the quantization reduction per call."""
+    from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric
+
+    xq, sx = quantize_symmetric(x)
+    z = lax.dot_general(
+        xq, coefficients_q[:, None], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )[:, 0].astype(jnp.float32) * (sx * coefficients_scale)
+    return jax.nn.sigmoid(z + intercept.astype(jnp.float32))
+
+
+logreg_predict_int8 = tracked_jit(_predict_int8, label="logreg_predict_int8")
+
+
 # -- multinomial (softmax) family ------------------------------------------
 # Spark's LogisticRegression auto-selects multinomial when the label has
 # more than two classes. Parameterization matches Spark/sklearn: one

@@ -11,11 +11,17 @@ The transform path PR 3 instrumented becomes an actual inference engine:
   the registry persists its deployment state and **recovers it after a
   process crash** (reload + optional re-warm);
 * ``MicroBatcher`` (``serve.batching``) — coalesce concurrent requests,
-  pad to power-of-two row buckets (``utils.padding.pad_to_bucket``), run
-  ONE compiled program per bucket, split results per request — padded
-  rows never leak; a **supervised worker**: crashes restart, wedges are
-  watchdog-detected, and affected requests fail fast with
-  ``WorkerCrashed`` instead of hanging to deadline;
+  pad to power-of-two row buckets into reusable staging arrays
+  (``utils.padding``), run ONE compiled program per bucket, split
+  results per request — padded rows never leak; the inner loop is a
+  **two-stage pipeline** for models exposing a device-resident
+  ``ServingProgram`` (stage batch N+1's transfer while N computes, sync
+  results in a bounded in-flight window —
+  ``SPARK_RAPIDS_ML_TPU_SERVE_PIPELINE_DEPTH``), with env-gated
+  bf16/int8 reduced-precision variants
+  (``SPARK_RAPIDS_ML_TPU_SERVE_PRECISION``); a **supervised worker**:
+  crashes restart, wedges are watchdog-detected, and affected requests
+  fail fast with ``WorkerCrashed`` instead of hanging to deadline;
 * ``ServeEngine`` (``serve.engine``) — the front door: bounded queues
   with ``QueueFull`` rejection, per-request deadlines shed before device
   time, graceful drain on shutdown; **bounded retries** with exponential
@@ -60,12 +66,14 @@ from spark_rapids_ml_tpu.serve.breaker import (  # noqa: F401
 )
 from spark_rapids_ml_tpu.serve.fallback import cpu_fallback  # noqa: F401
 from spark_rapids_ml_tpu.serve.batching import (  # noqa: F401
+    AsyncTransformSpec,
     BatcherClosed,
     DeadlineExpired,
     MicroBatcher,
     QueueFull,
     WaitTimeout,
     WorkerCrashed,
+    pipeline_depth_from_env,
 )
 from spark_rapids_ml_tpu.serve.engine import (  # noqa: F401
     ENV_PREFIX,
@@ -85,6 +93,7 @@ from spark_rapids_ml_tpu.serve.server import (  # noqa: F401
 )
 
 __all__ = [
+    "AsyncTransformSpec",
     "BatcherClosed",
     "BreakerOpen",
     "CircuitBreaker",
@@ -109,6 +118,7 @@ __all__ = [
     "extract_output",
     "fault_plane",
     "make_handler",
+    "pipeline_depth_from_env",
     "reset_fault_plane",
     "start_serve_server",
 ]

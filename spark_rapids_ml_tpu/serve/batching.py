@@ -1,57 +1,101 @@
-"""Shape-bucketed dynamic micro-batching for the serving engine.
+"""Shape-bucketed dynamic micro-batching with a pipelined inner loop.
 
 Requests enqueue; one worker per batcher coalesces them — up to
 ``max_batch_rows`` rows or ``max_wait_ms`` of linger, whichever lands
-first — concatenates their row matrices, pads the coalesced batch up to
-the nearest configured row bucket (``utils.padding.pad_to_bucket``), runs
-ONE model call over it, and splits the result back per request in enqueue
-order. Steady-state traffic therefore executes a handful of compiled XLA
-signatures (one per bucket) no matter how ragged the request sizes are —
-the fixed-shape funnel of PAPERS.md's Flare / TPU-linear-algebra lineage.
+first — writes their row matrices into a reusable per-bucket staging
+array (``utils.padding.StagingPool``), runs ONE model call over it, and
+splits the result back per request in enqueue order. Steady-state
+traffic therefore executes a handful of compiled XLA signatures (one per
+bucket) no matter how ragged the request sizes are — the fixed-shape
+funnel of PAPERS.md's Flare / TPU-linear-algebra lineage.
 
-Correctness invariants (tested in ``tests/test_serve_batching.py``):
+The hot path is a **two-stage pipeline** (the PR 9 latency war). The
+pre-pipeline loop ran submit → f64 concat → pad → one BLOCKING transform
+→ host sync → split, serially, with the device idle during both host
+phases. Now each batch travels three steps the worker interleaves
+across batches:
+
+* **stage**   — pad batch N+1 into a rotating pinned staging array (in
+  the model's transform dtype — no blanket f64 copy) and start its
+  host→device transfer (``jax.device_put`` via the model's
+  ``ServingProgram.put``) while batch N computes;
+* **dispatch** — launch the compiled transform via JAX **async
+  dispatch** (``ServingProgram.run``) without forcing a sync; the
+  serving kernels donate the staged input buffer (``donate_argnums``),
+  which is safe because a retry always re-stages from host rows;
+* **complete** — the ``np.asarray`` host sync lives ONLY here
+  (``_complete_batch`` — rule 9 of ``scripts/check_instrumentation.py``
+  statically rejects host syncs anywhere else in this worker loop): the
+  oldest entry of a bounded in-flight window (depth
+  ``SPARK_RAPIDS_ML_TPU_SERVE_PIPELINE_DEPTH``, default 2) is drained,
+  padding sliced off, the output check run, and rows split to requests.
+
+So compute of batch N+1 overlaps both the transfer of N+2 and the
+result fetch of N. Models that expose no device-resident
+``serving_transform_program`` (``obs.serving.ServingProgram``) keep the
+exact pre-pipeline blocking path (window depth 1, f64 staging) — f32/f64
+outputs through the pipeline are bit-equal to that path because the
+dispatched program is the same XLA module.
+
+Correctness invariants (tested in ``tests/test_serve_batching.py`` and
+``tests/test_serve_pipeline.py``):
 
 * padded rows are masked out before the split — they never appear in any
-  response;
+  response, at any pipeline depth;
 * each request gets exactly its own rows back, in its own order, however
   the coalescer grouped them;
 * a request whose deadline expired while queued is shed with
   ``DeadlineExpired`` *before* touching the device, and its neighbours
   still get their own rows;
 * a batch-level failure propagates the SAME exception to every request in
-  that batch, never a partial/shifted result.
+  that batch — and ONLY that batch: the other entries of the in-flight
+  window complete normally;
+* a donated staged buffer is never one a retry still holds — the engine's
+  retry path re-enters ``submit`` with the caller's host rows and stages a
+  fresh buffer.
 
 Every stage emits through ``obs``: queue-depth / batch-occupancy /
-padding-waste gauges, per-stage latency (queue wait, execute) into the
-``Summary`` quantile sketches, shed/rejection counters.
+padding-waste gauges, per-stage latency (queue wait, stage, dispatch,
+sync, and the combined execute) into the ``Summary`` quantile sketches,
+shed/rejection counters, plus the pipeline posture itself —
+``sparkml_serve_device_busy_seconds_total`` (union time with >= 1 batch
+in flight; the bench's ``pipeline_overlap_fraction`` numerator),
+``sparkml_serve_pipeline_overlap_seconds_total`` (time with >= 2 in
+flight) and the ``sparkml_serve_pipeline_inflight`` gauge — all sampled
+into the TSDB for the dashboard. Async batches publish a per-batch
+``TransformReport`` with the stage/dispatch/sync phase split
+(``obs.serving.PipelineTransform``) since they run around the models'
+decorated entry points.
 
 Tracing: each request enqueues with its captured ``TraceContext``
 (``obs.tracectx``); the worker files a queue-wait span into the request's
-trace at pop time, runs the ONE coalesced transform under a **fan-in
-batch span** whose ``links`` carry every member request's trace id (the
-Dapper fan-in edge — ``assemble_trace`` grafts the batch subtree into
-each member's tree), and resolves every response latch with the member's
-context re-activated, so shed/error/result resolution attributes to the
-right trace. Rule 5 of ``scripts/check_instrumentation.py`` statically
-enforces this capture/activate contract on every handoff in ``serve/``.
+trace at pop time, runs the dispatch under a **fan-in batch span** whose
+``links`` carry every member request's trace id (the Dapper fan-in edge —
+``assemble_trace`` grafts the batch subtree into each member's tree),
+files the completion-side sync interval as a ``serve:sync`` child event,
+and resolves every response latch with the member's context re-activated.
+Rule 5 of ``scripts/check_instrumentation.py`` statically enforces this
+capture/activate contract on every handoff in ``serve/``.
 
 Worker supervision (the r04 lesson — a wedged device tunnel must not
 take the whole batcher down with it):
 
 * a worker that **crashes** (an exception escaping the batch path — the
-  fault plane's ``crash_worker`` injects exactly this) has its in-flight
-  batch failed fast with ``WorkerCrashed`` and is **restarted** by its
-  supervisor (``sparkml_serve_worker_restarts_total``); once the restart
-  budget (``max_restarts``) is exhausted the batcher is marked dead and
-  every queued + future request fails fast instead of hanging to its
-  deadline;
-* a worker that **wedges** (one transform exceeding ``worker_budget_s``
-  — the ``obs.flight`` watchdog budget) is detected by an armed
-  watchdog deadline whose ``on_expire`` hook fails the wedged batch's
-  requests with ``WorkerCrashed``, abandons the stuck thread
-  (generation-guarded: its late result can never resolve an
-  already-failed latch), spawns a replacement worker, and still
-  produces the usual ``budget_exceeded`` flight dump;
+  fault plane's ``crash_worker`` injects exactly this) has every batch in
+  its in-flight window failed fast with ``WorkerCrashed`` and is
+  **restarted** by its supervisor (``sparkml_serve_worker_restarts_total``);
+  once the restart budget (``max_restarts``) is exhausted the batcher is
+  marked dead and every queued + future request fails fast instead of
+  hanging to its deadline;
+* a worker that **wedges** (one batch exceeding ``worker_budget_s``
+  between dispatch and completion — the ``obs.flight`` watchdog budget,
+  armed per in-flight batch) is detected by the armed deadline whose
+  ``on_expire`` hook fails the ENTIRE in-flight window fast (the stuck
+  thread is the only one that could have drained it), abandons the stuck
+  thread (generation-guarded: its late results can never resolve
+  already-failed latches), spawns a replacement worker with a fresh
+  staging pool, and still produces the usual ``budget_exceeded`` flight
+  dump — no stuck in-flight window survives a restart;
 * ``close()`` ends with a final sweep: whatever the worker did not
   serve (it crashed, wedged, or the join timed out) is failed — every
   request gets exactly one terminal outcome, never a silent hang.
@@ -60,6 +104,7 @@ take the whole batcher down with it):
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -67,6 +112,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from spark_rapids_ml_tpu.obs import flight, get_registry, span, tracectx
+from spark_rapids_ml_tpu.obs import serving as obs_serving
 from spark_rapids_ml_tpu.obs import spans as spans_mod
 from spark_rapids_ml_tpu.obs.devmon import get_device_monitor
 from spark_rapids_ml_tpu.serve.faults import (
@@ -74,11 +120,23 @@ from spark_rapids_ml_tpu.serve.faults import (
     fault_plane,
 )
 from spark_rapids_ml_tpu.utils.padding import (
+    StagingPool,
     bucket_for,
     default_buckets,
     pad_to_bucket,
     padding_waste,
 )
+
+PIPELINE_DEPTH_ENV = "SPARK_RAPIDS_ML_TPU_SERVE_PIPELINE_DEPTH"
+
+
+def pipeline_depth_from_env(default: int = 2) -> int:
+    """The in-flight window depth for async-capable models (>= 1; 1
+    restores the fully synchronous pre-pipeline loop)."""
+    try:
+        return max(int(os.environ.get(PIPELINE_DEPTH_ENV, default)), 1)
+    except ValueError:
+        return default
 
 
 class QueueFull(RuntimeError):
@@ -110,6 +168,36 @@ class WorkerCrashed(RuntimeError):
     — the service broke, the client did nothing wrong) and counted in
     ``sparkml_serve_errors_total{error="worker_crashed"}``. Retryable:
     a supervised restart usually restores service immediately."""
+
+
+class AsyncTransformSpec:
+    """The engine-built async serving contract for one model — the three
+    pipeline steps the worker interleaves, plus the staging dtype.
+
+    ``stage(staged_host) → device_handle`` starts the host→device
+    transfer; ``dispatch(device_handle) → opaque`` launches the transform
+    via async dispatch (synchronous raises here fail only that batch);
+    ``complete(opaque) → array`` is the host sync, called only from the
+    batcher's designated completion step. ``dtype`` is what ``submit``
+    coerces request rows to (the model's transform dtype); ``algo`` /
+    ``precision`` label the per-batch ``TransformReport``.
+    """
+
+    __slots__ = ("stage", "dispatch", "complete", "dtype", "algo",
+                 "precision", "program")
+
+    def __init__(self, stage: Callable, dispatch: Callable,
+                 complete: Callable, dtype, algo: str,
+                 precision: str = "native", program=None):
+        self.stage = stage
+        self.dispatch = dispatch
+        self.complete = complete
+        self.dtype = np.dtype(dtype)
+        self.algo = algo
+        self.precision = precision
+        # the raw (fault-plane-free) ServingProgram, kept reachable for
+        # engine warmup so precompiling the ladder never eats armed faults
+        self.program = program
 
 
 class _Request:
@@ -165,12 +253,57 @@ class _Request:
         return self.result
 
 
-class MicroBatcher:
-    """One model's request queue + coalescing worker.
+class _InFlight:
+    """One dispatched batch traveling the stage → dispatch → complete
+    pipeline; the supervision unit crash/wedge handlers fail."""
 
-    ``transform_fn`` receives the PADDED (bucket, d) float matrix and must
+    __slots__ = ("batch", "ctx", "member_ids", "handle", "n", "bucket",
+                 "features", "bytes_in", "watchdog", "dispatched",
+                 "stage_seconds", "dispatch_seconds", "sync_seconds",
+                 "report", "batch_span_id")
+
+    def __init__(self, batch: List[_Request],
+                 ctx: tracectx.TraceContext,
+                 member_ids: Tuple[str, ...] = ()):
+        self.batch = batch
+        self.ctx = ctx
+        self.member_ids = member_ids
+        self.handle: Any = None
+        self.n = 0
+        self.bucket = 0
+        self.features: Optional[int] = None
+        self.bytes_in: Optional[int] = None
+        self.watchdog: Optional[int] = None
+        self.dispatched = False
+        self.stage_seconds = 0.0
+        self.dispatch_seconds = 0.0
+        self.sync_seconds = 0.0
+        self.report: Optional[obs_serving.PipelineTransform] = None
+        self.batch_span_id: Optional[str] = None
+
+
+def _identity(value):
+    return value
+
+
+class MicroBatcher:
+    """One model's request queue + pipelined coalescing worker.
+
+    ``transform_fn`` receives the staged (bucket, d) matrix and must
     return a row-aligned array-like (bucket rows, or at least the real
-    rows) — the batcher slices off padding and splits per request.
+    rows) — the batcher slices off padding and splits per request. It is
+    the BLOCKING path, used when no ``async_spec`` is given (window depth
+    is then pinned at 1, preserving the pre-pipeline behavior exactly).
+
+    ``async_spec`` (an ``AsyncTransformSpec``) replaces it with the
+    stage/dispatch/complete pipeline steps; ``pipeline_depth`` bounds the
+    in-flight window (None → ``SPARK_RAPIDS_ML_TPU_SERVE_PIPELINE_DEPTH``,
+    default 2).
+
+    ``dtype`` is what ``submit`` coerces request rows to — the model's
+    transform dtype, so a caller already sending matching rows pays zero
+    copies at the door (the old unconditional float64 coercion doubled
+    copy bytes for f32 models).
 
     ``output_check`` (optional) runs over the REAL rows only — after the
     padding slice, before the per-request split. Zero-padding rows can
@@ -192,6 +325,9 @@ class MicroBatcher:
         worker_budget_s: Optional[float] = None,
         max_restarts: Optional[int] = None,
         output_check: Optional[Callable[[np.ndarray], None]] = None,
+        dtype=np.float64,
+        async_spec: Optional[AsyncTransformSpec] = None,
+        pipeline_depth: Optional[int] = None,
     ):
         if max_batch_rows < 1:
             raise ValueError("max_batch_rows must be >= 1")
@@ -201,10 +337,31 @@ class MicroBatcher:
         self.max_batch_rows = int(max_batch_rows)
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.max_queue_depth = int(max_queue_depth)
-        # Worker supervision knobs: one transform exceeding the budget
-        # declares the worker wedged (None → the flight recorder's
-        # transform budget; <= 0 / inf disables wedge detection);
-        # max_restarts bounds crash/wedge recoveries (None = unlimited).
+        self.dtype = np.dtype(dtype)
+        self.async_spec = async_spec
+        if pipeline_depth is None:
+            pipeline_depth = pipeline_depth_from_env()
+        # Only an async spec can overlap batches; the blocking path keeps
+        # the exact pre-pipeline serial loop (depth 1).
+        self.pipeline_depth = (max(int(pipeline_depth), 1)
+                               if async_spec is not None else 1)
+        if async_spec is not None:
+            self._stage_fn = async_spec.stage
+            self._dispatch_fn = async_spec.dispatch
+            self._complete_fn = async_spec.complete
+            self._report_algo: Optional[str] = async_spec.algo
+            self._precision = async_spec.precision
+        else:
+            self._stage_fn = _identity
+            self._dispatch_fn = self._call_transform
+            self._complete_fn = _identity
+            self._report_algo = None
+            self._precision = "native"
+        # Worker supervision knobs: one batch exceeding the budget
+        # between dispatch and completion declares the worker wedged
+        # (None → the flight recorder's transform budget; <= 0 / inf
+        # disables wedge detection); max_restarts bounds crash/wedge
+        # recoveries (None = unlimited).
         if worker_budget_s is None:
             self.worker_budget_s = flight.transform_budget_seconds()
         elif worker_budget_s <= 0:
@@ -229,8 +386,15 @@ class MicroBatcher:
         self._crashed = False
         self._generation = 1
         self._restarts = 0
-        self._inflight_batch: Optional[List[_Request]] = None
+        self._inflight: List[_InFlight] = []
         self._restart_pause_s = 0.02  # crash-storm brake
+        # Union device-busy accounting for the pipeline occupancy
+        # metrics: its own tiny lock so completion never contends with
+        # the queue lock.
+        self._busy_lock = threading.Lock()
+        self._busy_active = 0
+        self._busy_marker = 0.0
+        self._overlap_marker = 0.0
         # resolved once like the metric family handles below — the
         # execute path must not take the monitor's global lock per batch
         self._devmon = get_device_monitor()
@@ -296,8 +460,8 @@ class MicroBatcher:
         )
         self._m_stage = reg.summary(
             "sparkml_serve_stage_latency_seconds",
-            "per-stage serving latency (queue wait, batch execute)",
-            ("model", "stage"),
+            "per-stage serving latency (queue wait, stage, dispatch, "
+            "sync, and the combined execute)", ("model", "stage"),
         )
         self._m_errors = reg.counter(
             "sparkml_serve_errors_total",
@@ -311,6 +475,24 @@ class MicroBatcher:
             "wedge", ("model",),
         )
         self._m_restarts.inc(0, model=self.name)
+        self._m_busy = reg.counter(
+            "sparkml_serve_device_busy_seconds_total",
+            "union wall-clock with >= 1 batch in flight (dispatched, not "
+            "yet completed) — the numerator of the bench's "
+            "pipeline_overlap_fraction", ("model",),
+        )
+        self._m_busy.inc(0, model=self.name)
+        self._m_overlap = reg.counter(
+            "sparkml_serve_pipeline_overlap_seconds_total",
+            "wall-clock with >= 2 batches in flight (stage/transfer of "
+            "batch N+1 overlapping compute of batch N)", ("model",),
+        )
+        self._m_overlap.inc(0, model=self.name)
+        self._m_window = reg.gauge(
+            "sparkml_serve_pipeline_inflight",
+            "batches currently in the async in-flight window", ("model",),
+        )
+        self._m_window.set(0, model=self.name)
 
     # -- submission --------------------------------------------------------
 
@@ -320,14 +502,17 @@ class MicroBatcher:
                ) -> _Request:
         """Enqueue a (n, d) request; returns the latch to ``wait`` on.
 
-        ``trace_ctx`` is the caller's captured ``TraceContext`` (rule 5:
-        every enqueue hands its identity across the queue — ``None`` only
-        for untraced internal traffic). Raises ``QueueFull`` past
-        ``max_queue_depth`` (admission control) and ``BatcherClosed``
-        after ``close()`` — both BEFORE the request occupies queue
-        memory.
+        Rows are coerced ONCE, here, to the model's transform ``dtype`` —
+        a caller already sending matching rows pays no copy (the old
+        unconditional float64 coercion doubled copy bytes for f32
+        models). ``trace_ctx`` is the caller's captured ``TraceContext``
+        (rule 5: every enqueue hands its identity across the queue —
+        ``None`` only for untraced internal traffic). Raises
+        ``QueueFull`` past ``max_queue_depth`` (admission control) and
+        ``BatcherClosed`` after ``close()`` — both BEFORE the request
+        occupies queue memory.
         """
-        rows = np.asarray(rows, dtype=np.float64)
+        rows = np.asarray(rows, dtype=self.dtype)
         if rows.ndim == 1:
             rows = rows[None, :]
         if rows.ndim != 2 or rows.shape[0] == 0:
@@ -383,14 +568,14 @@ class MicroBatcher:
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop accepting; with ``drain`` the worker serves what's already
-        queued, otherwise queued requests are failed with
-        ``BatcherClosed``. Idempotent.
+        queued (draining its in-flight window), otherwise queued requests
+        are failed with ``BatcherClosed``. Idempotent.
 
         Ends with a sweep-under-the-lock: anything still queued after
         the worker joined (it crashed, wedged, or the join timed out —
         the eviction race that used to drop error propagation) is failed
-        with ``BatcherClosed``, and a batch still IN FLIGHT on a worker
-        that outlived the join (wedged with wedge detection disabled) is
+        with ``BatcherClosed``, and batches still IN FLIGHT on a worker
+        that outlived the join (wedged with wedge detection disabled) are
         failed with ``WorkerCrashed`` — no request ever hangs to its
         wait timeout."""
         with self._not_empty:
@@ -412,19 +597,22 @@ class MicroBatcher:
                 leftovers.append(self._queue.popleft())
             if leftovers:
                 self._record_depth()
-            stuck = None
-            if self._worker.is_alive() and self._inflight_batch is not None:
-                # join timed out with a batch on the wedged worker:
-                # retire the generation (its late result is discarded)
-                # and fail the batch instead of leaving it to hang.
-                stuck = self._inflight_batch
-                self._inflight_batch = None
+            stuck: List[_InFlight] = []
+            if self._worker.is_alive() and self._inflight:
+                # join timed out with batches on the wedged worker:
+                # retire the generation (its late results are discarded)
+                # and fail the window instead of leaving it to hang.
+                stuck = list(self._inflight)
+                self._inflight = []
                 self._generation += 1
         if stuck:
-            self._fail_requests(stuck, WorkerCrashed(
-                f"{self.name}: batcher closed while its worker was stuck "
-                "in a transform; in-flight requests failed fast"
-            ))
+            self._disarm_entries(stuck)
+            self._fail_requests(
+                [req for e in stuck for req in e.batch],
+                WorkerCrashed(
+                    f"{self.name}: batcher closed while its worker was "
+                    "stuck in a transform; in-flight requests failed fast"
+                ))
         if leftovers:
             self._fail_requests(
                 leftovers,
@@ -487,7 +675,7 @@ class MicroBatcher:
 
     def _supervise(self, gen: int) -> None:
         """The worker thread's entry point: a crash escaping the serve
-        loop fails the in-flight batch fast and hands off to a
+        loop fails the in-flight window fast and hands off to a
         replacement worker (a fresh thread) instead of dying silently."""
         try:
             self._run(gen)
@@ -496,26 +684,27 @@ class MicroBatcher:
             self._on_worker_crash(exc, gen)
 
     def _on_worker_crash(self, exc: BaseException, gen: int) -> None:
-        """Fail the crashed generation's in-flight batch fast, then
+        """Fail the crashed generation's in-flight window fast, then
         either hand off to a replacement worker or mark the batcher
         dead (restart budget exhausted — queued requests fail too)."""
         with self._not_empty:
             if gen != self._generation:
                 return  # the wedge handler already took over
-            batch = self._inflight_batch
-            self._inflight_batch = None
+            stranded = list(self._inflight)
+            self._inflight = []
             self._generation += 1
             can_restart = not self._closed and (
                 self.max_restarts is None
                 or self._restarts < self.max_restarts
             )
-            to_fail = list(batch or ())
+            to_fail = [req for e in stranded for req in e.batch]
             if not can_restart:
                 self._crashed = True
                 while self._queue:
                     to_fail.append(self._queue.popleft())
                 self._record_depth()
                 self._not_empty.notify_all()
+        self._disarm_entries(stranded)
         self._fail_requests(to_fail, WorkerCrashed(
             f"{self.name}: batcher worker crashed "
             f"({type(exc).__name__}: {exc}); in-flight requests failed fast"
@@ -528,22 +717,25 @@ class MicroBatcher:
                     self._worker = self._spawn_worker()
                     self._m_restarts.inc(model=self.name)
 
-    def _declare_wedged(self, gen: int, batch: List[_Request]) -> None:
-        """Watchdog ``on_expire`` hook (runs on the watchdog thread): the
-        worker has been inside ONE transform past ``worker_budget_s``.
-        Fail the wedged batch fast, abandon the stuck thread (its
-        generation is retired — a late result cannot resolve anything),
-        and spawn a replacement so the queue keeps draining."""
+    def _declare_wedged(self, gen: int, entry: _InFlight) -> None:
+        """Watchdog ``on_expire`` hook (runs on the watchdog thread): one
+        batch has sat between dispatch and completion past
+        ``worker_budget_s`` — the worker is stuck. Fail the ENTIRE
+        in-flight window fast (only the stuck thread could have drained
+        the later entries), abandon the thread (its generation is retired
+        — late results cannot resolve anything), and spawn a replacement
+        with a fresh staging pool so the queue keeps draining."""
         with self._not_empty:
-            if gen != self._generation or self._inflight_batch is not batch:
+            if gen != self._generation or entry not in self._inflight:
                 return  # resolved (or already handled) in the meantime
-            self._inflight_batch = None
+            stranded = list(self._inflight)
+            self._inflight = []
             self._generation += 1
             can_restart = not self._closed and (
                 self.max_restarts is None
                 or self._restarts < self.max_restarts
             )
-            to_fail = list(batch)
+            to_fail = [req for e in stranded for req in e.batch]
             if can_restart:
                 self._restarts += 1
                 self._worker = self._spawn_worker()
@@ -553,13 +745,29 @@ class MicroBatcher:
                     to_fail.append(self._queue.popleft())
                 self._record_depth()
                 self._not_empty.notify_all()
+        self._disarm_entries(stranded, skip=entry)
         self._fail_requests(to_fail, WorkerCrashed(
-            f"{self.name}: batcher worker wedged — one transform exceeded "
-            f"the {self.worker_budget_s:g}s watchdog budget; in-flight "
-            "requests failed fast"
+            f"{self.name}: batcher worker wedged — one batch exceeded "
+            f"the {self.worker_budget_s:g}s watchdog budget; the "
+            "in-flight window failed fast"
         ))
         if can_restart:
             self._m_restarts.inc(model=self.name)
+
+    def _disarm_entries(self, entries: List[_InFlight],
+                        skip: Optional[_InFlight] = None) -> None:
+        """Release stranded entries: flush their device-busy intervals
+        (a stranded batch must not leave the pipeline-occupancy
+        accounting elevated forever) and disarm their watchdogs, both
+        OUTSIDE the batcher lock (the watchdog thread takes our lock in
+        ``on_expire`` — taking its lock while holding ours would invert
+        the order)."""
+        for e in entries:
+            self._note_complete(e)
+            if e is skip or e.watchdog is None:
+                continue
+            flight.get_watchdog().disarm(e.watchdog)
+            e.watchdog = None
 
     def _fail_requests(self, requests: List[_Request],
                        exc: BaseException,
@@ -574,152 +782,366 @@ class MicroBatcher:
                                error=error_label)
 
     def _run(self, gen: int) -> None:
+        # Each worker generation owns its staging pool, so an abandoned
+        # (wedged) predecessor can never scribble into a buffer this
+        # generation stages from. Slots cover the window plus the
+        # transfer possibly still reading the previous buffer. The pool
+        # exists only for the async pipeline: its `complete` step always
+        # materializes fresh host memory, so reusing the staging buffer
+        # is safe — whereas a blocking transform_fn may return (views
+        # of) its input, and per-request result slices must never alias
+        # a buffer the next batch will overwrite.
+        staging = (StagingPool(self.dtype,
+                               slots=self.pipeline_depth + 2)
+                   if self.async_spec is not None else None)
+        window: collections.deque = collections.deque()
         while True:
+            batch: Optional[List[_Request]] = None
             with self._not_empty:
                 if gen != self._generation:
                     return  # abandoned after a wedge; a replacement runs
-                while not self._queue and not self._closed:
+                while not self._queue and not self._closed and not window:
                     self._not_empty.wait(timeout=0.1)
                     if gen != self._generation:
                         return
                 first = self._pop_live()
-                if first is None:
+                if first is not None:
+                    batch = [first]
+                    rows = first.n
+                    # Linger: coalesce until the row cap or the wait
+                    # budget — but never idle-wait while batches are in
+                    # flight: with the device already busy, dispatching
+                    # what's queued NOW and then draining the oldest
+                    # batch beats holding its result for stragglers.
+                    t0 = time.monotonic()
+                    while rows < self.max_batch_rows:
+                        remaining = self.max_wait_s - (
+                            time.monotonic() - t0)
+                        if not self._queue:
+                            if remaining <= 0 or self._closed or window:
+                                break
+                            self._not_empty.wait(timeout=remaining)
+                            continue
+                        nxt = self._queue[0]
+                        if nxt.expired():
+                            self._queue.popleft()
+                            self._shed(nxt)
+                            continue
+                        if rows + nxt.n > self.max_batch_rows:
+                            break  # leave it for the next batch
+                        self._queue.popleft()
+                        batch.append(nxt)
+                        rows += nxt.n
+                    self._record_depth()
+                    # From here the batch is "in flight": registered
+                    # UNDER the lock, before any fault-prone work, so a
+                    # crash or wedge handler fails exactly these
+                    # requests — a crash between pop and dispatch can
+                    # never strand them.
+                    entry = _InFlight(
+                        batch, tracectx.new_context(model=self.name))
+                    self._inflight.append(entry)
+                elif not window:
                     if self._closed:
                         return
                     self._record_depth()
                     continue
-                batch = [first]
-                rows = first.n
-                # Linger: coalesce until the row cap or the wait budget.
-                t0 = time.monotonic()
-                while rows < self.max_batch_rows:
-                    remaining = self.max_wait_s - (time.monotonic() - t0)
-                    if not self._queue:
-                        if remaining <= 0 or self._closed:
-                            break
-                        self._not_empty.wait(timeout=remaining)
-                        continue
-                    nxt = self._queue[0]
-                    if nxt.expired():
-                        self._queue.popleft()
-                        self._shed(nxt)
-                        continue
-                    if rows + nxt.n > self.max_batch_rows:
-                        break  # leave it for the next batch
-                    self._queue.popleft()
-                    batch.append(nxt)
-                    rows += nxt.n
-                self._record_depth()
-                # From here the batch is "in flight": a crash or wedge
-                # handler fails exactly these requests, nothing else.
-                self._inflight_batch = batch
+            if batch is None:
+                # Queue empty with batches in flight: drain the oldest —
+                # the completion step, the pipeline's only host sync.
+                self._complete_oldest(window, gen)
+                continue
             spec = fault_plane().worker_fault(self.name)
             if spec is not None:
                 raise InjectedWorkerCrash(
                     f"injected worker crash on {self.name!r}"
                 )
-            try:
-                self._execute(batch, gen)
-            except Exception as exc:  # noqa: BLE001 - batch-level failure
-                # _execute already delivered this error to every member;
-                # the worker survives it. Count it so failing batches are
-                # visible as an error series, not silence (rule 6).
-                self._m_errors.inc(model=self.name,
-                                   error=type(exc).__name__)
+            entry = self._stage_dispatch(entry, gen, staging)
+            if entry is not None:
+                window.append(entry)
+            while len(window) >= self.pipeline_depth:
+                self._complete_oldest(window, gen)
+            if gen != self._generation:
+                return
 
-    def _execute(self, batch: List[_Request], gen: int) -> None:
+    def _call_transform(self, matrix: np.ndarray):
+        """The blocking (no-async-spec) dispatch: one model call."""
+        return self.transform_fn(matrix)
+
+    def _stage_dispatch(self, entry: _InFlight, gen: int,
+                        staging: Optional[StagingPool],
+                        ) -> Optional[_InFlight]:
+        """Stage (pad into a reusable buffer + start the host→device
+        transfer) and async-dispatch one coalesced batch (already
+        registered in the supervision window by ``_run``). Returns the
+        in-flight entry, or None when the batch failed synchronously —
+        in which case only ITS members are failed and the pipeline keeps
+        running (the mid-window-failure invariant)."""
+        batch = entry.batch
+        with self._not_empty:
+            if gen != self._generation:
+                # a wedge handler retired this generation between pop
+                # and dispatch — it already failed these requests
+                return None
         now = time.monotonic()
-        stage = self._m_stage
+        stage_metric = self._m_stage
         for req in batch:
             tid = req.trace_ctx.trace_id if req.trace_ctx else None
-            stage.observe(now - req.enqueued, trace_id=tid,
-                          model=self.name, stage="queue")
+            stage_metric.observe(now - req.enqueued, trace_id=tid,
+                                 model=self.name, stage="queue")
             self._record_queue_span(req)
-        # The fan-in edge: ONE coalesced transform runs in its own batch
+        # The fan-in edge: ONE coalesced dispatch runs in its own batch
         # trace whose `links` name every member request's trace, so each
-        # member's assembled tree grafts the shared batch/transform
-        # subtree in (Dapper's fan-in span).
+        # member's assembled tree grafts the shared batch subtree in
+        # (Dapper's fan-in span).
         member_ids: List[str] = []
         for req in batch:
             if req.trace_ctx and req.trace_ctx.trace_id not in member_ids:
                 member_ids.append(req.trace_ctx.trace_id)
-        batch_ctx = tracectx.new_context(model=self.name)
-        matrix = (batch[0].rows if len(batch) == 1
-                  else np.concatenate([r.rows for r in batch], axis=0))
+        entry.member_ids = tuple(member_ids)
+        if self._report_algo:
+            # Async batches bypass the models' decorated entry points, so
+            # the batcher publishes the per-batch TransformReport itself
+            # — stage/dispatch/sync phase split, latency sketch, numerics.
+            entry.report = obs_serving.PipelineTransform(
+                self._report_algo, trace_id=entry.ctx.trace_id,
+                precision=self._precision,
+            )
         try:
-            padded, n = pad_to_bucket(matrix, self.buckets)
-            bucket = int(padded.shape[0])
-            # Wedge watchdog: the budget expiring fails THIS batch fast
-            # (on_expire) and dumps a flight artifact — the r04 20-hour
-            # silent hang becomes a sub-budget WorkerCrashed plus a dump.
-            handle = None
+            # Wedge watchdog: armed BEFORE the host→device transfer —
+            # the r04 wedged-tunnel hang blocks inside device_put
+            # itself, so a budget armed after the stage step would never
+            # see it. The budget expiring fails the in-flight window
+            # fast (on_expire) and dumps a flight artifact: the 20-hour
+            # silent hang becomes a sub-budget WorkerCrashed plus a
+            # dump. Armed per batch, stage → completion.
             if self.worker_budget_s and self.worker_budget_s != float("inf"):
-                handle = flight.get_watchdog().arm(
+                entry.watchdog = flight.get_watchdog().arm(
                     f"serve_worker:{self.name}", self.worker_budget_s,
                     info={"model": self.name, "requests": len(batch),
-                          "rows": n},
-                    on_expire=lambda: self._declare_wedged(gen, batch),
+                          "rows": sum(r.n for r in batch)},
+                    on_expire=lambda: self._declare_wedged(gen, entry),
                 )
-            t0 = time.monotonic()
-            try:
-                with tracectx.activate(batch_ctx), span(
-                    f"serve:batch:{self.name}",
-                    trace_id=batch_ctx.trace_id, links=tuple(member_ids),
-                    requests=len(batch), rows=n, bucket=bucket,
-                ):
-                    out = np.asarray(self.transform_fn(padded))
-            finally:
-                if handle is not None:
-                    flight.get_watchdog().disarm(handle)
-            execute_seconds = time.monotonic() - t0
-            stage.observe(execute_seconds,
-                          trace_id=batch_ctx.trace_id,
-                          model=self.name, stage="execute")
-            # per-device occupancy attribution (obs.devmon — never
-            # raises): the mesh-serving PR reads its evidence from this
-            self._devmon.note_batch(self.name, execute_seconds)
-            if out.shape[0] < n:
+            t0 = time.perf_counter()
+            if staging is not None:
+                staged, n = staging.fill([r.rows for r in batch],
+                                         self.buckets)
+            else:
+                # blocking path: a fresh matrix per batch (the pre-
+                # pipeline allocation) — transform_fn may return views
+                # of its input, and result slices must not alias a
+                # reused buffer
+                matrix = (batch[0].rows if len(batch) == 1
+                          else np.concatenate([r.rows for r in batch],
+                                              axis=0))
+                staged, n = pad_to_bucket(matrix, self.buckets)
+            entry.n = n
+            entry.bucket = int(staged.shape[0])
+            entry.features = int(staged.shape[1])
+            entry.bytes_in = int(staged.nbytes)
+            handle = self._stage_fn(staged)
+            entry.stage_seconds = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self._note_dispatch(entry)
+            with tracectx.activate(entry.ctx), span(
+                f"serve:batch:{self.name}",
+                trace_id=entry.ctx.trace_id, links=entry.member_ids,
+                requests=len(batch), rows=n, bucket=entry.bucket,
+            ):
+                entry.batch_span_id = spans_mod.current_span_id()
+                if entry.report is not None:
+                    with entry.report.dispatch_scope():
+                        entry.handle = self._dispatch_fn(handle)
+                else:
+                    entry.handle = self._dispatch_fn(handle)
+            entry.dispatch_seconds = time.perf_counter() - t1
+            with self._not_empty:
+                retired = gen != self._generation
+            if retired:
+                # A wedge handler retired this generation while we were
+                # staging/dispatching. It already failed (and counted)
+                # this entry's requests, but it could not see the
+                # watchdog/busy state created above — release both here,
+                # or an orphaned deadline later fires a spurious dump
+                # and the pipeline-occupancy accounting stays elevated
+                # forever.
+                if entry.watchdog is not None:
+                    flight.get_watchdog().disarm(entry.watchdog)
+                    entry.watchdog = None
+                self._note_complete(entry)
+                return None
+            return entry
+        except Exception as exc:  # noqa: BLE001 - batch-level failure
+            # Only THIS batch fails; the worker (and the rest of the
+            # window) survives. Count it so failing batches are visible
+            # as an error series, not silence (rule 6).
+            self._m_errors.inc(model=self.name, error=type(exc).__name__)
+            if entry.watchdog is not None:
+                flight.get_watchdog().disarm(entry.watchdog)
+                entry.watchdog = None
+            self._note_complete(entry)
+            stale = self._retire_entry(entry, gen)
+            if entry.report is not None:
+                entry.report.finish(error=exc)
+            if not stale:
+                for req in batch:
+                    with tracectx.activate(req.trace_ctx):
+                        req.set_error(exc)
+                self._m_requests.inc(len(batch), model=self.name,
+                                     outcome="error")
+            return None
+
+    def _retire_entry(self, entry: _InFlight, gen: int) -> bool:
+        """Remove one entry from the supervision window; True when a
+        crash/wedge handler already owned (and failed) it."""
+        with self._not_empty:
+            if gen != self._generation or entry not in self._inflight:
+                return True
+            self._inflight.remove(entry)
+            return False
+
+    def _complete_oldest(self, window: collections.deque,
+                         gen: int) -> None:
+        """Drain the oldest in-flight batch: host-sync its result,
+        slice padding, run the output check, resolve every member."""
+        entry: _InFlight = window.popleft()
+        out = None
+        err: Optional[BaseException] = None
+        t0 = time.perf_counter()
+        try:
+            out = self._complete_batch(entry)
+            if out.shape[0] < entry.n:
                 raise ValueError(
                     f"{self.name}: transform returned {out.shape[0]} rows "
-                    f"for a batch of {n}"
+                    f"for a batch of {entry.n}"
                 )
-            out = out[:n]  # padding never leaks into any response
+            out = out[:entry.n]  # padding never leaks into any response
             if self.output_check is not None:
                 self.output_check(out)
-        except BaseException as exc:  # noqa: BLE001
-            with self._not_empty:
-                stale = (gen != self._generation
-                         or self._inflight_batch is not batch)
-                if not stale:
-                    self._inflight_batch = None
-            if stale:
-                return  # the wedge handler already failed these requests
-            for req in batch:
-                with tracectx.activate(req.trace_ctx):
-                    req.set_error(exc)
-            self._m_requests.inc(len(batch), model=self.name,
-                                 outcome="error")
-            raise
-        with self._not_empty:
-            stale = (gen != self._generation
-                     or self._inflight_batch is not batch)
-            if not stale:
-                self._inflight_batch = None
-        if stale:
-            # The watchdog declared this batch wedged (and failed it)
-            # while the transform was still running; the late result is
+        except Exception as exc:  # noqa: BLE001 - batch-level failure
+            self._m_errors.inc(model=self.name, error=type(exc).__name__)
+            err = exc
+        entry.sync_seconds = time.perf_counter() - t0
+        if entry.watchdog is not None:
+            flight.get_watchdog().disarm(entry.watchdog)
+            entry.watchdog = None
+        busy_delta = self._note_complete(entry)
+        # per-device occupancy attribution (obs.devmon — never raises):
+        # the mesh-serving PR reads its evidence from this. Union busy
+        # time, so overlapping window entries are not double-counted.
+        self._devmon.note_batch(self.name, busy_delta)
+        if self._retire_entry(entry, gen):
+            # The watchdog declared this window wedged (and failed it)
+            # while the result was still in flight; the late result is
             # discarded — first writer won.
             return
+        if err is not None:
+            if entry.report is not None:
+                entry.report.finish(error=err)
+            for req in entry.batch:
+                with tracectx.activate(req.trace_ctx):
+                    req.set_error(err)
+            self._m_requests.inc(len(entry.batch), model=self.name,
+                                 outcome="error")
+            return
         offset = 0
-        for req in batch:
+        for req in entry.batch:
             # resolve under the member's own context: anything recorded
             # during latch release attributes to ITS trace, not a
             # neighbour's (rule 5's "response future resolution" leg)
             with tracectx.activate(req.trace_ctx):
                 req.set_result(out[offset:offset + req.n])
             offset += req.n
-        self._m_requests.inc(len(batch), model=self.name, outcome="ok")
-        self._record_batch(n, bucket, len(batch))
+        self._m_requests.inc(len(entry.batch), model=self.name,
+                             outcome="ok")
+        self._record_batch(entry.n, entry.bucket, len(entry.batch))
+        self._record_pipeline(entry, out)
+
+    def _complete_batch(self, entry: _InFlight) -> np.ndarray:
+        """THE pipeline's designated host-sync point: the only place in
+        the worker loop allowed to force a device value to host (rule 9
+        of ``scripts/check_instrumentation.py`` rejects ``np.asarray`` /
+        ``block_until_ready`` anywhere else in this loop — a future edit
+        cannot silently re-serialize the pipeline)."""
+        return np.asarray(self._complete_fn(entry.handle))
+
+    # -- pipeline accounting -----------------------------------------------
+
+    def _note_dispatch(self, entry: _InFlight) -> None:
+        """Open ``entry``'s in-flight interval. ``dispatched`` flips
+        under the same lock the flush reads it under, so a wedge handler
+        racing this exact instant still sees a consistent pair."""
+        now = time.perf_counter()
+        with self._busy_lock:
+            entry.dispatched = True
+            self._busy_active += 1
+            if self._busy_active == 1:
+                self._busy_marker = now
+            elif self._busy_active == 2:
+                self._overlap_marker = now
+            # gauge set INSIDE the lock: a set landing after a racing
+            # thread's later set would leave the inflight series stale
+            self._m_window.set(self._busy_active, model=self.name)
+
+    def _note_complete(self, entry: _InFlight) -> float:
+        """Close ``entry``'s in-flight interval; flush the union
+        device-busy (and >=2-deep overlap) time accrued since the last
+        flush. Exactly-once per entry (``dispatched`` flips under the
+        busy lock): completion, the dispatch failure path, AND the
+        crash/wedge/close handlers all route here, so a stranded entry
+        can never leave the busy accounting elevated — and a late
+        completion by an abandoned worker can never double-flush."""
+        now = time.perf_counter()
+        with self._busy_lock:
+            if not entry.dispatched or self._busy_active <= 0:
+                return 0.0
+            entry.dispatched = False
+            busy = max(now - self._busy_marker, 0.0)
+            overlap = 0.0
+            if self._busy_active >= 2:
+                overlap = max(now - self._overlap_marker, 0.0)
+                self._overlap_marker = now
+            self._busy_active -= 1
+            self._busy_marker = now
+            self._m_window.set(self._busy_active, model=self.name)
+        if busy > 0:
+            self._m_busy.inc(busy, model=self.name)
+        if overlap > 0:
+            self._m_overlap.inc(overlap, model=self.name)
+        return busy
+
+    def _record_pipeline(self, entry: _InFlight, out: np.ndarray) -> None:
+        """Completion-side telemetry for one served batch: the
+        stage/dispatch/sync latency split, the ``serve:sync`` trace event,
+        and (async batches) the per-batch TransformReport."""
+        stage = self._m_stage
+        tid = entry.ctx.trace_id
+        execute = (entry.stage_seconds + entry.dispatch_seconds
+                   + entry.sync_seconds)
+        stage.observe(execute, trace_id=tid, model=self.name,
+                      stage="execute")
+        stage.observe(entry.stage_seconds, trace_id=tid, model=self.name,
+                      stage="stage")
+        stage.observe(entry.dispatch_seconds, trace_id=tid,
+                      model=self.name, stage="dispatch")
+        stage.observe(entry.sync_seconds, trace_id=tid, model=self.name,
+                      stage="sync")
+        now = time.perf_counter()
+        spans_mod.record_event(
+            f"serve:sync:{self.name}",
+            now - entry.sync_seconds, now,
+            trace_id=tid,
+            parent_span_id=entry.batch_span_id or entry.ctx.span_id,
+            model=self.name, rows=entry.n,
+        )
+        if entry.report is not None:
+            entry.report.add_phase("stage", entry.stage_seconds)
+            entry.report.add_phase("dispatch", entry.dispatch_seconds)
+            entry.report.add_phase("sync", entry.sync_seconds)
+            entry.report.finish(out, rows=entry.n,
+                                features=entry.features,
+                                bytes_in=entry.bytes_in,
+                                parent_span_id=entry.batch_span_id)
 
     # -- metrics -----------------------------------------------------------
 

@@ -71,7 +71,19 @@ Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``, constructor args win):
 * ``..._WORKER_BUDGET_MS`` (default 0 → the flight recorder's transform
   budget) — one transform exceeding it declares the worker wedged;
 * ``..._WORKER_RESTARTS`` (default -1 = unlimited) — worker restart
-  budget before the batcher is declared dead.
+  budget before the batcher is declared dead;
+* ``..._PIPELINE_DEPTH`` (default 2) — the async in-flight window of the
+  pipelined batcher for models exposing a device-resident
+  ``serving_transform_program`` (``obs.serving.ServingProgram``); 1
+  restores the fully synchronous pre-pipeline path (the kill switch);
+* ``..._PRECISION``       (default ``native``) — reduced-precision
+  serving variants (``bf16`` / ``int8``) for the GEMM/distance-dominated
+  models; enabled variants pass an offline max-error check against the
+  full-precision program (below) and stay under the numerics sentinel /
+  NaN guard at runtime, else the engine falls back to native and counts
+  ``sparkml_serve_precision_fallback_total``;
+* ``..._PRECISION_MAX_ERR`` (default 0.05) — the max-error bar: relative
+  max-abs error for float outputs, mismatch fraction for label outputs.
 
 SLO objectives come from ``SPARK_RAPIDS_ML_TPU_SLO_*`` (see ``obs.slo``).
 """
@@ -89,17 +101,22 @@ import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
 from spark_rapids_ml_tpu.obs import spans as spans_mod
-from spark_rapids_ml_tpu.obs.serving import check_output_numerics
+from spark_rapids_ml_tpu.obs.serving import (
+    ServingProgram,
+    check_output_numerics,
+)
 from spark_rapids_ml_tpu.obs.slo import SloSet, default_slos
 from spark_rapids_ml_tpu.serve import breaker as breaker_mod
 from spark_rapids_ml_tpu.serve import faults as faults_mod
 from spark_rapids_ml_tpu.serve.batching import (
+    AsyncTransformSpec,
     BatcherClosed,
     DeadlineExpired,
     MicroBatcher,
     QueueFull,
     WaitTimeout,
     WorkerCrashed,
+    pipeline_depth_from_env,
 )
 from spark_rapids_ml_tpu.serve.breaker import BreakerOpen, CircuitBreaker
 from spark_rapids_ml_tpu.serve.fallback import cpu_fallback
@@ -165,6 +182,21 @@ def _env_buckets() -> Optional[Tuple[int, ...]]:
         return out or None
     except ValueError:
         return None
+
+
+_PRECISION_ALIASES = {
+    "": "native", "native": "native", "f32": "native", "float32": "native",
+    "f64": "native", "float64": "native",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "int8": "int8",
+}
+
+
+def _normalize_precision(value: str) -> str:
+    """'native' / 'bf16' / 'int8'; unknown spellings degrade to native —
+    a typo in the env var must never enable a reduced-precision ladder
+    the operator did not ask for."""
+    return _PRECISION_ALIASES.get(str(value).strip().lower(), "native")
 
 
 # Output-column getters tried in order against the model when its
@@ -264,6 +296,8 @@ class ServeEngine:
         nan_guard: Optional[bool] = None,
         worker_budget_ms: Optional[float] = None,
         max_worker_restarts: Optional[int] = None,
+        pipeline_depth: Optional[int] = None,
+        precision: Optional[str] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
@@ -320,8 +354,17 @@ class ServeEngine:
             max_worker_restarts = (None if env_restarts < 0
                                    else int(env_restarts))
         self.max_worker_restarts = max_worker_restarts
+        self.pipeline_depth = max(
+            int(pipeline_depth) if pipeline_depth is not None
+            else pipeline_depth_from_env(), 1)
+        self.precision = _normalize_precision(
+            precision if precision is not None
+            else os.environ.get(ENV_PREFIX + "PRECISION", "native"))
+        self.precision_max_err = _env_number("PRECISION_MAX_ERR", 0.05)
         self._clock = clock
         self._batchers: Dict[Tuple[str, int], MicroBatcher] = {}
+        self._async_specs: Dict[
+            Tuple[str, int], Optional[AsyncTransformSpec]] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._fallbacks: Dict[Tuple[str, int], Any] = {}
         self._lock = threading.Lock()
@@ -631,13 +674,157 @@ class ServeEngine:
 
         return check
 
+    def _serving_program(self, entry: RegisteredModel,
+                         precision: str) -> Optional[ServingProgram]:
+        """The model's device-resident serving program at ``precision``,
+        or None (no hook / host-path model / program construction
+        failed). Failures are counted, never raised — the sync path is
+        always there."""
+        hook = getattr(entry.model, "serving_transform_program", None)
+        if not callable(hook):
+            return None
+        try:
+            prog = hook(precision=precision)
+        except Exception:
+            self._m_errors.inc(model=entry.name, error="serving_program")
+            return None
+        return prog
+
+    def _precision_ok(self, entry: RegisteredModel,
+                      native: ServingProgram,
+                      reduced: ServingProgram) -> bool:
+        """The offline max-error check gating reduced precision: run both
+        programs over one seeded random batch at the LARGEST bucket (a
+        tiny min bucket would let a single boundary-row flip read as a
+        12.5% mismatch and permanently disable a perfectly good ladder)
+        and compare. Float outputs: relative max-abs error <= the
+        ``PRECISION_MAX_ERR`` bar; label outputs: mismatch fraction <=
+        the same bar. A failed (or crashed) check means the reduced
+        ladder never serves traffic."""
+        reg = get_registry()
+        checks = reg.counter(
+            "sparkml_serve_precision_checks_total",
+            "offline reduced-precision max-error checks by verdict",
+            ("model", "precision", "verdict"),
+        )
+        try:
+            from spark_rapids_ml_tpu.serve.registry import _infer_features
+
+            n_features = _infer_features(entry.model)
+            if n_features is None:
+                checks.inc(model=entry.name, precision=reduced.precision,
+                           verdict="unknown_features")
+                return False
+            buckets = (self.buckets or entry.buckets
+                       or (self.max_batch_rows,))
+            bucket = int(max(buckets))
+            rng = np.random.default_rng(7)
+            x = rng.standard_normal((bucket, int(n_features))).astype(
+                native.dtype)
+            ref_raw = np.asarray(native.fetch(native.run(native.put(x))))
+            red_raw = np.asarray(
+                reduced.fetch(reduced.run(reduced.put(x.copy()))))
+            if ref_raw.shape != red_raw.shape:
+                checks.inc(model=entry.name, precision=reduced.precision,
+                           verdict="shape_mismatch")
+                return False
+            ref = ref_raw.astype(np.float64)
+            red = red_raw.astype(np.float64)
+            if np.issubdtype(ref_raw.dtype, np.integer):
+                err = float(np.mean(ref != red))
+            else:
+                scale = float(np.max(np.abs(ref))) or 1.0
+                err = float(np.max(np.abs(ref - red))) / scale
+            ok = np.isfinite(err) and err <= self.precision_max_err
+            checks.inc(model=entry.name, precision=reduced.precision,
+                       verdict="pass" if ok else "fail")
+            return ok
+        except Exception:
+            checks.inc(model=entry.name, precision=reduced.precision,
+                       verdict="error")
+            return False
+
+    def _async_spec_for(self, entry: RegisteredModel
+                        ) -> Optional[AsyncTransformSpec]:
+        """Build (and cache) the pipelined-batcher spec for one model
+        version: the model's ``ServingProgram`` at the engine's precision
+        (max-error-guarded, falling back to native), wrapped with the
+        fault plane — ``raise``/``stall``/``latency`` fire at dispatch,
+        ``nan`` corruption applies at the completion-step fetch so the
+        NaN guard sees it exactly like the sync path."""
+        key = (entry.name, entry.version)
+        with self._lock:
+            if key in self._async_specs:
+                return self._async_specs[key]
+        prog = self._serving_program(entry, self.precision)
+        if prog is not None and self.precision != "native":
+            native = self._serving_program(entry, "native")
+            if native is None or not self._precision_ok(
+                    entry, native, prog):
+                get_registry().counter(
+                    "sparkml_serve_precision_fallback_total",
+                    "models served at native precision because the "
+                    "reduced-precision max-error check failed",
+                    ("model", "precision"),
+                ).inc(model=entry.name, precision=self.precision)
+                prog = native
+        spec: Optional[AsyncTransformSpec] = None
+        if prog is not None:
+            name = entry.name
+
+            def dispatch(x_dev, _prog=prog):
+                # resolve the plane per call (like the sync closure): a
+                # batcher outliving reset_fault_plane() must consult the
+                # LIVE plane, or later-armed faults never fire here
+                spec_ = faults_mod.fault_plane().begin_call(name)
+                if spec_ is not None:
+                    faults_mod.apply_pre(spec_)
+                return _prog.run(x_dev), spec_
+
+            def complete(handle, _prog=prog):
+                out_dev, spec_ = handle
+                out = _prog.fetch(out_dev)
+                if spec_ is not None and spec_.kind == "nan":
+                    out = faults_mod.corrupt(spec_, out)
+                return out
+
+            spec = AsyncTransformSpec(
+                stage=prog.put, dispatch=dispatch, complete=complete,
+                dtype=prog.dtype, algo=prog.algo,
+                precision=prog.precision, program=prog,
+            )
+        with self._lock:
+            self._async_specs[key] = spec
+        return spec
+
     def _batcher_for(self, entry: RegisteredModel,
                      revive: bool = False) -> MicroBatcher:
         key = (entry.name, entry.version)
         corpse: Optional[MicroBatcher] = None
+        async_spec = None
+        with self._lock:
+            existing = self._batchers.get(key)
+            need_new = existing is None or (existing.dead() and revive)
+        if need_new and (self.pipeline_depth > 1
+                         or self.precision != "native"):
+            # Built OUTSIDE the engine lock: program construction touches
+            # the device (device_put of the model state, the offline
+            # precision check) and must not stall concurrent predicts.
+            # PIPELINE_DEPTH=1 at native precision is the kill switch:
+            # the batcher then runs the exact pre-pipeline blocking path.
+            async_spec = self._async_spec_for(entry)
         with self._lock:
             if self._closed:
                 raise EngineClosed("serving engine is shut down")
+            if async_spec is None:
+                # TOCTOU guard: the batcher can die between the pre-check
+                # (which saw it alive and skipped spec construction) and
+                # this lock — a revive here would otherwise rebuild it
+                # with async_spec=None, silently downgrading the model to
+                # the blocking f64 path forever. The cache holds the spec
+                # from the original construction (None only for genuinely
+                # sync-path models).
+                async_spec = self._async_specs.get(key)
             batcher = self._batchers.get(key)
             if batcher is not None and batcher.dead() and revive:
                 # A dead batcher (restart budget exhausted) fails
@@ -664,6 +851,10 @@ class ServeEngine:
                     worker_budget_s=self.worker_budget_s,
                     max_restarts=self.max_worker_restarts,
                     output_check=self._make_output_check(entry),
+                    dtype=(async_spec.dtype if async_spec is not None
+                           else np.float64),
+                    async_spec=async_spec,
+                    pipeline_depth=self.pipeline_depth,
                 )
                 self._batchers[key] = batcher
                 # flat-0 series for the engine-level counters too
@@ -726,6 +917,7 @@ class ServeEngine:
         with self._lock:
             batcher = self._batchers.pop((name, version), None)
             self._fallbacks.pop((name, version), None)
+            self._async_specs.pop((name, version), None)
         if batcher is None:
             return False
         batcher.close(drain=drain)
@@ -736,16 +928,52 @@ class ServeEngine:
         (engine-level ``buckets`` override the registry entry's), so the
         compiled-signature set matches real traffic exactly — a registry
         warmup can miss shapes when the engine is configured with its own
-        ladder."""
+        ladder.
+
+        Beyond the registry's sync-path warmup, this also precompiles the
+        **pipeline ladder**: the model's ``ServingProgram`` at the
+        engine's active precision, one signature per bucket (stage →
+        dispatch → complete on an all-zero batch), so the first real
+        request through the async path never pays an XLA compile — the
+        precision × bucket ladder is owned by the deploy, not the user."""
         entry = self.registry.resolve_entry(model_ref)
         # None falls through to the batcher's own default ladder
         # (default_buckets(max_batch_rows)) — registry.warmup builds the
         # same ladder from max_bucket_rows.
-        return self.registry.warmup(
+        report = self.registry.warmup(
             model_ref, n_features=n_features,
             buckets=self.buckets or entry.buckets,
             max_bucket_rows=self.max_batch_rows,
         )
+        spec = None
+        if self.pipeline_depth > 1 or self.precision != "native":
+            spec = self._async_spec_for(entry)
+        if spec is not None and spec.program is not None:
+            prog = spec.program
+            chosen = sorted(int(b) for b in report["buckets"])
+            if n_features is None:
+                from spark_rapids_ml_tpu.serve.registry import (
+                    _infer_features,
+                )
+
+                n_features = _infer_features(entry.model)
+            ladder: Dict[int, float] = {}
+            if n_features is not None:
+                for bucket in chosen:
+                    zeros = np.zeros((bucket, int(n_features)),
+                                     dtype=spec.dtype)
+                    t0 = time.perf_counter()
+                    with spans_mod.span(
+                        f"serve:warmup_pipeline:{entry.name}",
+                        precision=spec.precision, bucket=bucket,
+                    ):
+                        prog.fetch(prog.run(prog.put(zeros)))
+                    ladder[bucket] = time.perf_counter() - t0
+            report["pipeline"] = {
+                "precision": spec.precision,
+                "buckets": ladder,
+            }
+        return report
 
     # -- lifecycle / introspection ----------------------------------------
 

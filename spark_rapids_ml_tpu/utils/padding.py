@@ -111,3 +111,73 @@ def padding_waste(n_rows: int, bucket: int) -> float:
     if bucket <= 0:
         return 0.0
     return max(bucket - n_rows, 0) / bucket
+
+
+class StagingPool:
+    """Per-bucket reusable host staging arrays for the pipelined batcher.
+
+    The pre-pipeline hot path allocated a fresh concat + pad copy per
+    batch; the pipeline instead writes each request's rows straight into a
+    preallocated (bucket, d) staging array (zeroing only the padding
+    tail), then hands that array to ``jax.device_put``. Buffers ROTATE —
+    ``slots`` must cover the in-flight window plus the transfer possibly
+    still reading the previous buffer (the batcher sizes it at
+    ``pipeline_depth + 2``), so a staging array is never rewritten while
+    an earlier batch's host→device copy may still be consuming it.
+
+    Single-writer by design: only one worker thread fills a pool (each
+    worker generation builds its own), so there is no lock on the fill
+    path. A single request already sitting exactly on its bucket boundary
+    short-circuits to the caller's own array — zero copy, matching
+    ``pad_to_bucket``'s exact-fit behavior.
+    """
+
+    def __init__(self, dtype=np.float64, slots: int = 3):
+        self.dtype = np.dtype(dtype)
+        self.slots = max(int(slots), 2)
+        # (bucket, d) -> {"arrays": [...], "next": int}; arrays allocate
+        # lazily so an unused bucket costs nothing.
+        self._pools: dict = {}
+
+    def fill(self, parts: Sequence[np.ndarray],
+             buckets: Optional[Sequence[int]] = None,
+             ) -> Tuple[np.ndarray, int]:
+        """Stage one coalesced batch: ``(staged, n)`` where ``staged`` is
+        the (bucket, d) array holding the ``parts`` row blocks in order
+        with a zeroed padding tail, and ``n`` is the real row count."""
+        if not parts:
+            raise ValueError("cannot stage an empty batch")
+        n = sum(int(p.shape[0]) for p in parts)
+        d = int(parts[0].shape[1])
+        for p in parts[1:]:
+            # explicit width check: the slice assignment below would
+            # silently BROADCAST a width-1 block across all d features
+            # (np.concatenate raised here) — wrong results, not an error
+            if int(p.shape[1]) != d:
+                raise ValueError(
+                    f"cannot coalesce a {p.shape[1]}-feature request "
+                    f"into a {d}-feature batch"
+                )
+        bucket = bucket_for(n, buckets)
+        if (len(parts) == 1 and bucket == n
+                and parts[0].dtype == self.dtype):
+            return parts[0], n  # exact fit: no copy, like pad_to_bucket
+        key = (bucket, d)
+        pool = self._pools.get(key)
+        if pool is None:
+            pool = {"arrays": [], "next": 0}
+            self._pools[key] = pool
+        arrays = pool["arrays"]
+        idx = pool["next"]
+        if idx >= len(arrays):
+            arrays.append(np.zeros((bucket, d), dtype=self.dtype))
+        staged = arrays[idx]
+        pool["next"] = (idx + 1) % self.slots
+        offset = 0
+        for p in parts:
+            rows = int(p.shape[0])
+            staged[offset:offset + rows] = p  # coerces dtype if needed
+            offset += rows
+        if offset < bucket:
+            staged[offset:] = 0.0  # the reused buffer's stale tail
+        return staged, n

@@ -369,7 +369,9 @@ def test_engine_evicts_batchers_for_deregistered_versions(pca_model):
 
 def test_engine_warmup_uses_engine_buckets(pca_model):
     """engine.warmup compiles the shapes THIS engine pads to, even when
-    they differ from the registry entry's buckets."""
+    they differ from the registry entry's buckets — both the sync ladder
+    and the pipeline's precision x bucket ladder, so live traffic (which
+    rides the pipelined path) compiles nothing."""
     from spark_rapids_ml_tpu.ops.pca_kernel import pca_transform_kernel
 
     model, x = pca_model
@@ -381,9 +383,10 @@ def test_engine_warmup_uses_engine_buckets(pca_model):
         pca_transform_kernel.clear_cache()
         report = engine.warmup("pca")
         assert sorted(report["buckets"]) == [48, 96]
-        assert pca_transform_kernel.stats()["signatures"] == 2
+        assert sorted(report["pipeline"]["buckets"]) == [48, 96]
+        warmed = pca_transform_kernel.stats()["signatures"]
         engine.predict("pca", x[:40])  # pads to 48: already compiled
-        assert pca_transform_kernel.stats()["signatures"] == 2
+        assert pca_transform_kernel.stats()["signatures"] == warmed
     finally:
         engine.shutdown()
 
