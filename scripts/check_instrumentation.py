@@ -75,6 +75,16 @@ Three rule families:
    re-serializes all three, and nothing else would fail — latency
    would just quietly double. This rule makes that edit impossible to
    ship unnoticed.
+10. over ``serve/admission.py`` and ``serve/scheduler.py`` (the
+   multi-tenant admission/shed boundary): every **decision path** — a
+   ``raise`` of a decision exception (``ShedLoad`` / ``QueueFull`` /
+   ``OverQuota``) or a request resolution via ``.set_error(...)`` —
+   must, in the same enclosing function, either increment a decision
+   counter (``.inc(...)``) or file an audit span
+   (``record_event``/``span``). A shed that is neither counted nor in
+   the request's trace tree is a silent drop: the tenant sees a 503,
+   the operator sees nothing, and the fairness contract becomes
+   unauditable.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -527,6 +537,71 @@ def check_pipeline_sync(path: str):
     yield from visit(tree, None)
 
 
+# rule 10: decision exceptions and the accounting calls that make a
+# decision path attributable instead of a silent drop.
+_DECISION_EXCEPTIONS = frozenset({"ShedLoad", "QueueFull", "OverQuota"})
+_DECISION_ACCOUNTING = frozenset({"inc", "record_event", "span"})
+ADMISSION_FILES = tuple(
+    os.path.join(REPO, "spark_rapids_ml_tpu", "serve", name)
+    for name in ("admission.py", "scheduler.py")
+)
+
+
+def _raised_exception_name(node: ast.Raise):
+    if node.exc is None:
+        return None
+    target = node.exc.func if isinstance(node.exc, ast.Call) else node.exc
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    if isinstance(target, ast.Name):
+        return target.id
+    return None
+
+
+def check_admission_decisions(path: str):
+    """Rule 10: yield (lineno, description) for every unaccounted
+    admission/shed decision path in one admission/scheduler module.
+
+    A decision path is a ``raise`` of a decision exception
+    (``ShedLoad``/``QueueFull``/``OverQuota``) or a ``.set_error(...)``
+    resolution; judged per enclosing function (like rules 5/9): the
+    SAME function must carry a decision-counter ``.inc(...)`` or an
+    audit ``record_event``/``span`` call — a shed the metrics and the
+    trace tree both miss is a silent drop."""
+    tree = ast.parse(open(path).read(), filename=path)
+
+    def fn_accounts(fn) -> bool:
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and _call_name(node) in _DECISION_ACCOUNTING):
+                return True
+        return False
+
+    def visit(node, enclosing_fn):
+        for child in ast.iter_child_nodes(node):
+            fn = child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) else enclosing_fn
+            decision = None
+            if isinstance(child, ast.Raise):
+                name = _raised_exception_name(child)
+                if name in _DECISION_EXCEPTIONS:
+                    decision = f"raise {name}"
+            elif (isinstance(child, ast.Call)
+                  and _call_name(child) == "set_error"):
+                decision = ".set_error(...)"
+            if decision is not None and (
+                    enclosing_fn is None or not fn_accounts(enclosing_fn)):
+                yield (child.lineno,
+                       f"admission/shed decision ({decision}) without a "
+                       "decision-counter .inc(...) or audit "
+                       "record_event/span in the same function — a shed "
+                       "nobody can see is a silent drop (rule 10)")
+            yield from visit(child, fn)
+
+    yield from visit(tree, None)
+
+
 def library_files():
     """Every .py under the package, minus the exempt helper dirs."""
     out = []
@@ -605,6 +680,11 @@ def main() -> int:
         rel = os.path.relpath(BATCHING_FILE, REPO)
         for lineno, why in check_pipeline_sync(BATCHING_FILE):
             offenders.append(f"{rel}:{lineno} {why}")
+    admission_files = [p for p in ADMISSION_FILES if os.path.exists(p)]
+    for path in admission_files:
+        rel = os.path.relpath(path, REPO)
+        for lineno, why in check_admission_decisions(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -621,7 +701,9 @@ def main() -> int:
         f"{len(lib_files)} library module(s) free of bare print(; "
         f"{len(clocked_files)} clocked obs module(s) free of direct "
         f"wall-clock calls; serve/batching.py host-syncs only in its "
-        f"designated completion step"
+        f"designated completion step; {len(admission_files)} "
+        f"admission/scheduler module(s) with every shed/admission "
+        f"decision counted or audit-spanned"
     )
     return 0
 

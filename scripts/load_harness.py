@@ -1,0 +1,497 @@
+#!/usr/bin/env python
+"""Closed-loop multi-tenant load harness: prove overload survival.
+
+``bench_serve`` is open-loop and single-tenant — it measures the happy
+path. This harness measures the regime the ROADMAP's "millions of
+users" pillar actually lives in: **sustained offered load beyond
+capacity**, with tenants that do not cooperate. It stands up the REAL
+stack (fitted PCA model → registry → engine with quotas + weighted-fair
+scheduling + adaptive shedding → stdlib HTTP server) and drives it from
+closed-loop client threads over the wire:
+
+1. **calibrate** — one well-behaved tenant, closed loop, measures
+   single-tenant capacity (rows/sec at the configured concurrency);
+2. **overload soak** (``SPARKML_LOAD_SOAK_SECONDS``, default 60) — two
+   tenants at once:
+
+   * ``compliant`` — interactive priority, paced (Poisson think time)
+     at ~25% of capacity, inside its 30% quota: the tenant the
+     fairness contract protects;
+   * ``greedy`` — batch priority, zero think time from
+     ``SPARKML_LOAD_GREEDY_THREADS`` closed-loop threads, quota 45% of
+     capacity, request size AUTO-SCALED from calibration so its flood
+     pushes TOTAL offered load past 2× capacity — everything beyond
+     its quota is the over-quota excess the controller sheds. (The
+     quota split is work-conserving: in-quota greedy + compliant
+     traffic together carry near-capacity throughput while the excess
+     absorbs every rejection. The 10×-over-quota starvation case lives
+     in tests/test_serve_fairness.py with an injected clock.)
+
+The robustness acceptance judged on the emitted record:
+
+* compliant availability ≥ ``SPARKML_LOAD_MIN_AVAILABILITY`` (0.99) and
+  compliant p99 within its SLO (``SPARKML_LOAD_P99_MS``, default the
+  serve latency SLO threshold) — the greedy flood cannot starve the
+  in-SLO tenant;
+* total served throughput ≥ ``SPARKML_LOAD_THROUGHPUT_FRACTION`` (0.9)
+  × calibrated capacity — shedding sheds *excess*, not *capacity*;
+* every circuit breaker CLOSED at the end — overload must never read
+  as backend failure (the PR 6 invariant, extended);
+* the shedding lands on the greedy tenant (its availability and shed
+  counts are in the record; the compliant tenant's sheds must be 0).
+
+Emits ONE ``bench_common.emit_record`` line the perf sentinel judges
+(metric ``load_harness_compliant_availability``, explicitly
+higher-is-better) — committed history lives in
+``records/load_harness_r*.json``. Exit 0 = all gates pass.
+
+Knobs (env): SPARKML_LOAD_SOAK_SECONDS (60),
+SPARKML_LOAD_CALIBRATE_SECONDS (8), SPARKML_LOAD_FEATURES (32),
+SPARKML_LOAD_K (8), SPARKML_LOAD_GREEDY_THREADS (12),
+SPARKML_LOAD_COMPLIANT_THREADS (4), SPARKML_LOAD_MIN_AVAILABILITY
+(0.99), SPARKML_LOAD_THROUGHPUT_FRACTION (0.9), SPARKML_LOAD_P99_MS
+(the SLO threshold), plus every SPARK_RAPIDS_ML_TPU_SERVE_* engine knob.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+# The overload soak WILL open SLO-burn incidents (that is the point) —
+# but an incident-triggered jax profile capture mid-soak would measure
+# the profiler, not the scheduler (start_trace wedges on this
+# container's CPU backend under live traffic — the PR 7 lesson). Set
+# BEFORE the package import, like the chaos drill.
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_CAPTURE_S", "0")
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import bench_common  # noqa: E402 (scripts/ on path when run directly)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _post_predict(base: str, body: bytes, tenant: str, priority: str,
+                  timeout: float = 30.0):
+    """One HTTP predict; (status, retry_after_s, shed). Never raises.
+
+    Tenant/priority ride the HEADERS (as well as the body) so the
+    server's pre-parse fast-shed path can identify the request class
+    without touching the payload."""
+    req = urllib.request.Request(
+        f"{base}/predict", data=body,
+        headers={"Content-Type": "application/json",
+                 "X-Tenant": tenant, "X-Priority": priority},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        resp.read()
+        return resp.status, None, False
+    except urllib.error.HTTPError as exc:
+        retry_after = exc.headers.get("Retry-After")
+        try:
+            payload = json.loads(exc.read())
+        except ValueError:
+            payload = {}
+        return (exc.code,
+                float(retry_after) if retry_after else None,
+                bool(payload.get("shed")))
+    except Exception:  # noqa: BLE001 - a hang/reset IS the measurement
+        return 0, None, False
+
+
+class TenantLoad:
+    """One tenant's closed-loop client fleet.
+
+    Each thread loops: think (exponential, ``pace_rps`` per thread; 0 =
+    no think time — pure closed loop), pick a request size, POST, record
+    (status, latency, rows, shed). ``stop_at`` ends the phase."""
+
+    def __init__(self, base: str, model: str, x: np.ndarray, *,
+                 tenant: str, priority: str, threads: int,
+                 pace_rps_per_thread: float, rows_lo: int, rows_hi: int,
+                 reject_pause_s: float = 0.01,
+                 deadline_ms: float = 0.0, seed: int = 0):
+        self.base = base
+        self.model = model
+        self.x = x
+        self.tenant = tenant
+        self.priority = priority
+        self.threads = threads
+        self.pace = pace_rps_per_thread
+        self.rows_lo, self.rows_hi = rows_lo, rows_hi
+        self.reject_pause_s = reject_pause_s
+        self.deadline_ms = deadline_ms
+        self.seed = seed
+        self.lock = threading.Lock()
+        self.results = []  # (status, latency_s, rows, shed)
+
+    def _client(self, idx: int, stop_at: float) -> None:
+        rng = np.random.default_rng(self.seed * 1000 + idx)
+        while time.monotonic() < stop_at:
+            if self.pace > 0:
+                think = float(rng.exponential(1.0 / self.pace))
+                if time.monotonic() + think >= stop_at:
+                    return
+                time.sleep(think)
+            n = int(rng.integers(self.rows_lo, self.rows_hi + 1))
+            start = int(rng.integers(0, self.x.shape[0] - n))
+            payload = {
+                "model": self.model,
+                "rows": self.x[start:start + n].tolist(),
+                "tenant": self.tenant,
+                "priority": self.priority,
+            }
+            if self.deadline_ms > 0:
+                payload["deadline_ms"] = self.deadline_ms
+            body = json.dumps(payload).encode()
+            t0 = time.perf_counter()
+            status, _retry_after, shed = _post_predict(
+                self.base, body, self.tenant, self.priority)
+            latency = time.perf_counter() - t0
+            with self.lock:
+                self.results.append((status, latency, n, shed))
+            if status != 200 and self.reject_pause_s > 0:
+                # a rejected closed-loop client spinning at MHz would
+                # measure the client, not the server — tiny pause only
+                time.sleep(self.reject_pause_s)
+
+    def run(self, seconds: float) -> None:
+        stop_at = time.monotonic() + seconds
+        workers = [
+            threading.Thread(target=self._client, args=(i, stop_at),
+                             daemon=True)
+            for i in range(self.threads)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(seconds + 60.0)
+
+    def stats(self, wall: float) -> dict:
+        with self.lock:
+            results = list(self.results)
+        attempts = len(results)
+        ok = [(lat, n) for s, lat, n, _ in results if s == 200]
+        lat_ok = sorted(lat for lat, _n in ok)
+        served_rows = sum(n for _lat, n in ok)
+
+        def pct(q: float) -> float:
+            if not lat_ok:
+                return 0.0
+            return lat_ok[min(int(q * len(lat_ok)), len(lat_ok) - 1)]
+
+        return {
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "threads": self.threads,
+            "attempts": attempts,
+            "ok": len(ok),
+            "availability": len(ok) / attempts if attempts else 0.0,
+            "shed": sum(1 for s, _l, _n, shed in results
+                        if shed and s != 200),
+            "rejected_429": sum(1 for s, *_ in results if s == 429),
+            "status_5xx": sum(1 for s, *_ in results
+                              if 500 <= s <= 599),
+            "timeouts_504": sum(1 for s, *_ in results if s == 504),
+            "hung": sum(1 for s, *_ in results if s == 0),
+            "offered_rps": attempts / wall if wall > 0 else 0.0,
+            "offered_rows_per_sec": (sum(n for _s, _l, n, _ in results)
+                                     / wall if wall > 0 else 0.0),
+            "served_rows_per_sec": (served_rows / wall
+                                    if wall > 0 else 0.0),
+            "p50": pct(0.50),
+            "p99": pct(0.99),
+        }
+
+
+def _get_json(base: str, path: str) -> dict:
+    try:
+        resp = urllib.request.urlopen(f"{base}{path}", timeout=10.0)
+        return json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            return json.loads(exc.read())
+        except ValueError:
+            return {}
+    except Exception:  # noqa: BLE001 - a dead ops endpoint IS a finding
+        return {}
+
+
+def main() -> int:
+    soak_s = _env_float("SPARKML_LOAD_SOAK_SECONDS", 60.0)
+    calibrate_s = _env_float("SPARKML_LOAD_CALIBRATE_SECONDS", 8.0)
+    n_features = _env_int("SPARKML_LOAD_FEATURES", 16)
+    k = _env_int("SPARKML_LOAD_K", 8)
+    greedy_threads = _env_int("SPARKML_LOAD_GREEDY_THREADS", 24)
+    compliant_threads = _env_int("SPARKML_LOAD_COMPLIANT_THREADS", 4)
+    min_availability = _env_float("SPARKML_LOAD_MIN_AVAILABILITY", 0.99)
+    throughput_fraction = _env_float(
+        "SPARKML_LOAD_THROUGHPUT_FRACTION", 0.9)
+    # compliant p99 bar: explicit env wins; 0 (the default) derives it
+    # from calibration — max(the serve latency SLO threshold, 2x the
+    # single-tenant p99 at capacity). On a fast chip the SLO threshold
+    # governs; on a slow shared-GIL CPU container the relative bar still
+    # proves the fairness property (overload must not make the
+    # protected tenant materially slower than the unloaded system).
+    p99_bar_env = _env_float("SPARKML_LOAD_P99_MS", 0.0)
+
+    import jax
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import (
+        ModelRegistry,
+        ServeEngine,
+        ShedController,
+        start_serve_server,
+    )
+
+    device = jax.devices()[0]
+    rng = np.random.default_rng(17)
+    x = rng.normal(size=(2048, n_features))
+    model = PCA().setK(k).fit(x)
+    registry = ModelRegistry()
+    registry.register("load_pca", model)
+
+    # -- phase 1: calibrate single-tenant capacity -------------------------
+    bench_common.log("load_harness calibrate")
+    cal_engine = ServeEngine(registry, max_batch_rows=256, max_wait_ms=2.0,
+                             max_queue_depth=64)
+    cal_engine.warmup("load_pca")
+    cal_server = start_serve_server(cal_engine)
+    cal_base = f"http://127.0.0.1:{cal_server.server_address[1]}"
+    # Calibrate at the SOAK's total concurrency with a comparable size
+    # mix — capacity measured at a different operating point is not a
+    # capacity the soak's throughput can honestly be compared against.
+    cal = TenantLoad(cal_base, "load_pca", x, tenant="calibrate",
+                     priority="interactive",
+                     threads=compliant_threads + greedy_threads,
+                     pace_rps_per_thread=0.0, rows_lo=8, rows_hi=48,
+                     seed=1)
+    t0 = time.monotonic()
+    cal.run(calibrate_s)
+    cal_wall = time.monotonic() - t0
+    cal_stats = cal.stats(cal_wall)
+    cal_server.shutdown()
+    cal_engine.shutdown()
+    capacity_rows = max(cal_stats["served_rows_per_sec"], 1.0)
+    p99_bar_ms = p99_bar_env if p99_bar_env > 0 else max(
+        _env_float("SPARK_RAPIDS_ML_TPU_SLO_LATENCY_THRESHOLD_MS", 250.0),
+        2000.0 * cal_stats["p99"])
+    bench_common.log(
+        f"load_harness capacity {capacity_rows:,.0f} rows/s "
+        f"({cal_stats['offered_rps']:.0f} req/s), single-tenant p99 "
+        f"{cal_stats['p99'] * 1000:.0f} ms -> compliant bar "
+        f"{p99_bar_ms:.0f} ms")
+
+    # -- phase 2: the 2x overload soak -------------------------------------
+    # Work-conserving quota split from measured capacity: greedy is
+    # PROVISIONED 45% and compliant 30% (offered ~25%) — the greedy
+    # flood beyond its 45% is the over-quota excess the controller
+    # sheds, so total served stays near capacity while the excess
+    # absorbs every rejection.
+    greedy_quota = max(capacity_rows * 0.45, 50.0)
+    compliant_quota = max(capacity_rows * 0.30, 200.0)
+    # The shed controller targets a FIXED queue wait (default 100 ms,
+    # env SPARKML_LOAD_SHED_WAIT_MS) rather than a fraction of the p99
+    # bar: the controller's job is to keep queueing bounded; the bar
+    # only judges the outcome.
+    shed = ShedController(
+        queue_wait_target_s=_env_float(
+            "SPARKML_LOAD_SHED_WAIT_MS", 100.0) / 1000.0,
+        hold_seconds=1.0,
+    )
+    engine = ServeEngine(
+        registry, max_batch_rows=256, max_wait_ms=2.0,
+        max_queue_depth=64,
+        tenant_quotas={
+            "greedy": (greedy_quota, greedy_quota),
+            "compliant": (compliant_quota, 2.0 * compliant_quota),
+        },
+        shed=shed,
+    )
+    engine.warmup("load_pca")
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    # compliant pacing: ~25% of capacity in rows/s → req/s at the mean
+    # request size, split across its threads
+    mean_rows = (4 + 16) / 2.0
+    compliant_rps = max(capacity_rows * 0.25 / mean_rows, 1.0)
+    compliant = TenantLoad(
+        base, "load_pca", x, tenant="compliant", priority="interactive",
+        threads=compliant_threads,
+        pace_rps_per_thread=compliant_rps / max(compliant_threads, 1),
+        rows_lo=4, rows_hi=16, seed=2)
+    # Greedy request size auto-scales from calibration so the flood is
+    # a genuine 2x+ overload REGARDLESS of how fast this machine is
+    # today: a closed loop can only offer threads/latency requests per
+    # second, so the rows-per-request must carry the excess.
+    closed_loop_rps = greedy_threads / max(cal_stats["p50"], 0.02)
+    greedy_rows = int(min(max(
+        2.2 * capacity_rows / max(closed_loop_rps, 1.0), 32), 176))
+    greedy = TenantLoad(
+        base, "load_pca", x, tenant="greedy", priority="batch",
+        threads=greedy_threads, pace_rps_per_thread=0.0,
+        rows_lo=max(greedy_rows // 2, 16),
+        rows_hi=min(greedy_rows + greedy_rows // 2, 240),
+        reject_pause_s=0.02, deadline_ms=3000.0, seed=3)
+
+    bench_common.log(
+        f"load_harness soak {soak_s:.0f}s (greedy quota "
+        f"{greedy_quota:,.0f} rows/s, {greedy_threads} closed-loop "
+        f"threads)")
+    readyz_shedding_seen = False
+    shed_level_max = 0
+
+    def _watch_readyz(stop_at: float) -> None:
+        nonlocal readyz_shedding_seen, shed_level_max
+        while time.monotonic() < stop_at:
+            doc = _get_json(base, "/readyz")
+            if doc.get("status") == "shedding":
+                readyz_shedding_seen = True
+                shed_level_max = max(shed_level_max,
+                                     int(doc.get("shed_level", 1)))
+            time.sleep(0.5)
+
+    stop_at = time.monotonic() + soak_s
+    watcher = threading.Thread(target=_watch_readyz, args=(stop_at,),
+                               daemon=True)
+    watcher.start()
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=compliant.run, args=(soak_s,),
+                         daemon=True),
+        threading.Thread(target=greedy.run, args=(soak_s,), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(soak_s + 120.0)
+    wall = time.monotonic() - t0
+    watcher.join(5.0)
+
+    compliant_stats = compliant.stats(wall)
+    greedy_stats = greedy.stats(wall)
+    breakers = engine.breaker_snapshot()
+    overload = engine.overload_state()
+    slo_doc = _get_json(base, "/debug/slo")
+    server.shutdown()
+    engine.shutdown()
+    # Let the background sampler/worker threads leave their jax calls
+    # before interpreter teardown — a daemon thread mid-dispatch at exit
+    # aborts the process AFTER the verdict (the chaos-drill lesson).
+    from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+
+    tsdb_mod.get_sampler().stop()
+    time.sleep(1.0)
+
+    total_served = (compliant_stats["served_rows_per_sec"]
+                    + greedy_stats["served_rows_per_sec"])
+    total_offered = (compliant_stats["offered_rows_per_sec"]
+                     + greedy_stats["offered_rows_per_sec"])
+    breakers_closed = all(b["state"] == "closed"
+                          for b in breakers.values()) if breakers else True
+    record = {
+        "bench": "load_harness",
+        # the headline the sentinel judges: the fairness contract —
+        # explicit direction, immune to unit-text heuristics
+        "metric": "load_harness_compliant_availability",
+        "value": compliant_stats["availability"],
+        "unit": "fraction of compliant-tenant requests answered 200",
+        "higher_is_better": True,
+        "platform": device.platform,
+        "device_kind": str(device.device_kind),
+        "soak_seconds": wall,
+        "capacity_rows_per_sec": capacity_rows,
+        "offered_rows_per_sec": total_offered,
+        "offered_over_capacity": (total_offered / capacity_rows
+                                  if capacity_rows else 0.0),
+        "served_rows_per_sec": total_served,
+        "throughput_fraction": (total_served / capacity_rows
+                                if capacity_rows else 0.0),
+        "compliant": compliant_stats,
+        "greedy": greedy_stats,
+        "p50": compliant_stats["p50"],
+        "p99": compliant_stats["p99"],
+        "percentiles": {"p50": compliant_stats["p50"],
+                        "p99": compliant_stats["p99"]},
+        "calibrate_p50": cal_stats["p50"],
+        "calibrate_p99": cal_stats["p99"],
+        "p99_bar_ms": p99_bar_ms,
+        "readyz_shedding_seen": readyz_shedding_seen,
+        "shed_level_max": shed_level_max,
+        "breakers_closed": breakers_closed,
+        "shed_snapshot": overload.get("shed", {}),
+        "tenants": overload.get("tenants", {}),
+        "slo_alerts_firing": len(slo_doc.get("alerts", [])),
+    }
+    bench_common.emit_record(record)
+
+    failures = []
+    if compliant_stats["availability"] < min_availability:
+        failures.append(
+            f"compliant availability {compliant_stats['availability']:.4f}"
+            f" < {min_availability}")
+    if compliant_stats["p99"] * 1000.0 > p99_bar_ms:
+        failures.append(
+            f"compliant p99 {compliant_stats['p99'] * 1000:.1f} ms > "
+            f"{p99_bar_ms} ms bar")
+    if record["throughput_fraction"] < throughput_fraction:
+        failures.append(
+            f"throughput {record['throughput_fraction']:.2f} of capacity "
+            f"< {throughput_fraction}")
+    min_offered = _env_float("SPARKML_LOAD_MIN_OFFERED", 1.5)
+    if record["offered_over_capacity"] < min_offered:
+        failures.append(
+            f"offered load only {record['offered_over_capacity']:.2f}x "
+            f"capacity < {min_offered}x — not an overload soak")
+    if not breakers_closed:
+        failures.append(
+            "a circuit breaker opened under pure overload — overload "
+            "must never read as backend failure")
+    if compliant_stats["shed"] > 0:
+        failures.append(
+            f"{compliant_stats['shed']} compliant (in-quota interactive) "
+            "requests were shed — the controller must never shed them")
+    if compliant_stats["hung"] or greedy_stats["hung"]:
+        failures.append(
+            f"{compliant_stats['hung'] + greedy_stats['hung']} "
+            "request(s) hung")
+    if failures:
+        bench_common.log("load_harness FAIL: " + "; ".join(failures))
+        return 1
+    bench_common.log(
+        f"load_harness PASS: compliant availability "
+        f"{compliant_stats['availability']:.4f} at "
+        f"{record['offered_over_capacity']:.1f}x offered load, "
+        f"throughput {record['throughput_fraction']:.2f}x capacity, "
+        f"greedy availability {greedy_stats['availability']:.3f} "
+        f"({greedy_stats['shed']} shed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,16 @@ The transform path PR 3 instrumented becomes an actual inference engine:
   **circuit breaker** (``serve.breaker``), and a **degraded CPU
   fallback** path (``serve.fallback``) so an open breaker answers
   slowly instead of 5xx-ing;
+* ``AdmissionController`` (``serve.admission``) + the fair scheduler
+  (``serve.scheduler``) — overload survival: requests carry a tenant id
+  and priority class, pass per-tenant token-bucket quotas, are dequeued
+  by **start-time fair queuing** over row-cost virtual time (one
+  tenant's burst cannot starve the rest; interactive preempts batch
+  under pressure, including evicting lower-ranked work from a full
+  queue), and an **SLO-burn-adaptive shed controller** rejects only the
+  over-quota excess (``ShedLoad`` → HTTP 503 + ``Retry-After``, never
+  breaker food, every decision counted + audit-spanned;
+  ``SPARK_RAPIDS_ML_TPU_SERVE_SCHED=fifo`` restores plain FIFO);
 * ``fault_plane`` (``serve.faults``) — the injectable chaos plane that
   proves all of the above: deterministic per-model raise / stall / NaN /
   latency / worker-crash injection, via env or API;
@@ -65,6 +75,17 @@ from spark_rapids_ml_tpu.serve.breaker import (  # noqa: F401
     breaker_events,
 )
 from spark_rapids_ml_tpu.serve.fallback import cpu_fallback  # noqa: F401
+from spark_rapids_ml_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    ShedController,
+    ShedLoad,
+    TokenBucket,
+)
+from spark_rapids_ml_tpu.serve.scheduler import (  # noqa: F401
+    FairQueue,
+    FifoQueue,
+    fair_scheduling_from_env,
+)
 from spark_rapids_ml_tpu.serve.batching import (  # noqa: F401
     AsyncTransformSpec,
     BatcherClosed,
@@ -93,6 +114,7 @@ from spark_rapids_ml_tpu.serve.server import (  # noqa: F401
 )
 
 __all__ = [
+    "AdmissionController",
     "AsyncTransformSpec",
     "BatcherClosed",
     "BreakerOpen",
@@ -100,8 +122,10 @@ __all__ = [
     "DeadlineExpired",
     "ENV_PREFIX",
     "EngineClosed",
+    "FairQueue",
     "FaultPlane",
     "FaultSpec",
+    "FifoQueue",
     "InjectedBackendError",
     "InjectedWorkerCrash",
     "MicroBatcher",
@@ -111,11 +135,15 @@ __all__ = [
     "QueueFull",
     "RegisteredModel",
     "ServeEngine",
+    "ShedController",
+    "ShedLoad",
+    "TokenBucket",
     "WaitTimeout",
     "WorkerCrashed",
     "breaker_events",
     "cpu_fallback",
     "extract_output",
+    "fair_scheduling_from_env",
     "fault_plane",
     "make_handler",
     "pipeline_depth_from_env",

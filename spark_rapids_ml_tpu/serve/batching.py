@@ -115,10 +115,16 @@ from spark_rapids_ml_tpu.obs import flight, get_registry, span, tracectx
 from spark_rapids_ml_tpu.obs import serving as obs_serving
 from spark_rapids_ml_tpu.obs import spans as spans_mod
 from spark_rapids_ml_tpu.obs.devmon import get_device_monitor
+from spark_rapids_ml_tpu.serve.admission import (
+    INTERACTIVE,
+    ShedLoad,
+    retry_after_cap,
+)
 from spark_rapids_ml_tpu.serve.faults import (
     InjectedWorkerCrash,
     fault_plane,
 )
+from spark_rapids_ml_tpu.serve.scheduler import FifoQueue
 from spark_rapids_ml_tpu.utils.padding import (
     StagingPool,
     bucket_for,
@@ -205,19 +211,30 @@ class _Request:
 
     ``trace_ctx`` is the submitter's captured ``TraceContext`` — the
     worker re-activates it around every resolution (result, shed, batch
-    failure) and files the queue-wait span into its trace."""
+    failure) and files the queue-wait span into its trace.
+
+    ``tenant`` / ``priority`` / ``over_quota`` are the admission
+    controller's verdict (``serve.admission``) — what the weighted-fair
+    queue (``serve.scheduler``) schedules and the preemption path ranks
+    by."""
 
     __slots__ = ("rows", "n", "enqueued", "enqueued_perf", "deadline",
-                 "trace_ctx", "_event", "result", "error")
+                 "trace_ctx", "tenant", "priority", "over_quota",
+                 "_event", "result", "error")
 
     def __init__(self, rows: np.ndarray, deadline: Optional[float],
-                 trace_ctx: Optional[tracectx.TraceContext] = None):
+                 trace_ctx: Optional[tracectx.TraceContext] = None,
+                 tenant: str = "default", priority: str = INTERACTIVE,
+                 over_quota: bool = False):
         self.rows = rows
         self.n = int(rows.shape[0])
         self.enqueued = time.monotonic()
         self.enqueued_perf = time.perf_counter()  # spans' timeline clock
         self.deadline = deadline
         self.trace_ctx = trace_ctx
+        self.tenant = tenant
+        self.priority = priority
+        self.over_quota = over_quota
         self._event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -328,6 +345,7 @@ class MicroBatcher:
         dtype=np.float64,
         async_spec: Optional[AsyncTransformSpec] = None,
         pipeline_depth: Optional[int] = None,
+        queue=None,
     ):
         if max_batch_rows < 1:
             raise ValueError("max_batch_rows must be >= 1")
@@ -379,7 +397,16 @@ class MicroBatcher:
             self.max_batch_rows = min(self.max_batch_rows, self.buckets[-1])
         else:
             self.buckets = default_buckets(self.max_batch_rows)
-        self._queue: collections.deque = collections.deque()
+        # The queue DISCIPLINE is pluggable (``serve.scheduler``):
+        # FifoQueue is the pre-scheduler deque bit-for-bit; the engine
+        # passes a FairQueue for weighted-fair multi-tenant dispatch.
+        self._queue = queue if queue is not None else FifoQueue()
+        # queue-wait estimate: EWMA updated at every pop, decayed toward
+        # 0 while idle (an estimate frozen at the last overload would
+        # keep the shed controller shedding an empty queue). Worker-
+        # thread-only writes; readers tolerate torn staleness.
+        self._wait_ewma = 0.0
+        self._wait_ewma_at = time.monotonic()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
@@ -469,6 +496,11 @@ class MicroBatcher:
             "worker crashes/wedges, breaker rejections", ("model", "error"),
         )
         self._m_errors.inc(0, model=self.name, error="worker_crashed")
+        self._m_shed_tenant = reg.counter(
+            "sparkml_serve_shed_total",
+            "requests shed by the adaptive overload controller, by "
+            "tenant and reason", ("tenant", "reason"),
+        )
         self._m_restarts = reg.counter(
             "sparkml_serve_worker_restarts_total",
             "batcher worker restarts after a crash or watchdog-declared "
@@ -499,6 +531,8 @@ class MicroBatcher:
     def submit(self, rows: np.ndarray,
                deadline: Optional[float] = None,
                trace_ctx: Optional[tracectx.TraceContext] = None,
+               tenant: str = "default", priority: str = INTERACTIVE,
+               over_quota: bool = False,
                ) -> _Request:
         """Enqueue a (n, d) request; returns the latch to ``wait`` on.
 
@@ -507,10 +541,16 @@ class MicroBatcher:
         unconditional float64 coercion doubled copy bytes for f32
         models). ``trace_ctx`` is the caller's captured ``TraceContext``
         (rule 5: every enqueue hands its identity across the queue —
-        ``None`` only for untraced internal traffic). Raises
-        ``QueueFull`` past ``max_queue_depth`` (admission control) and
-        ``BatcherClosed`` after ``close()`` — both BEFORE the request
-        occupies queue memory.
+        ``None`` only for untraced internal traffic).
+        ``tenant``/``priority``/``over_quota`` are the admission
+        verdict the fair scheduler orders by. Raises ``QueueFull`` past
+        ``max_queue_depth`` (admission control) and ``BatcherClosed``
+        after ``close()`` — both BEFORE the request occupies queue
+        memory. Under the fair queue, a FULL queue may instead
+        **preempt** a strictly lower-ranked queued request: the victim
+        is shed with ``ShedLoad`` (counted, audited) and the arrival
+        takes its slot — interactive traffic cannot be starved by a
+        queue full of batch work.
         """
         rows = np.asarray(rows, dtype=self.dtype)
         if rows.ndim == 1:
@@ -526,7 +566,10 @@ class MicroBatcher:
                 "configure a larger top bucket"
             )
         req = _Request(rows, deadline,
-                       trace_ctx=trace_ctx or tracectx.capture())
+                       trace_ctx=trace_ctx or tracectx.capture(),
+                       tenant=tenant, priority=priority,
+                       over_quota=over_quota)
+        victim: Optional[_Request] = None
         with self._not_empty:
             if self._closed:
                 raise BatcherClosed(f"batcher {self.name!r} is closed")
@@ -541,16 +584,58 @@ class MicroBatcher:
                     "budget exhausted) — evict and re-create the batcher"
                 )
             if len(self._queue) >= self.max_queue_depth:
-                self._m_requests.inc(model=self.name, outcome="rejected")
-                self._m_rejected.inc(model=self.name)
-                raise QueueFull(
-                    f"{self.name}: queue depth {len(self._queue)} >= "
-                    f"max_queue_depth {self.max_queue_depth}"
-                )
+                # Priority preemption: a strictly lower-ranked queued
+                # request may be evicted for the arrival (FairQueue
+                # only; FifoQueue always declines — the pre-scheduler
+                # reject-the-newcomer behavior, bit-for-bit).
+                victim = self._queue.select_victim(req)
+                if victim is None:
+                    self._m_requests.inc(model=self.name,
+                                         outcome="rejected")
+                    self._m_rejected.inc(model=self.name)
+                    raise QueueFull(
+                        f"{self.name}: queue depth {len(self._queue)} >= "
+                        f"max_queue_depth {self.max_queue_depth}"
+                    )
             self._queue.append(req)
             self._record_depth()
             self._not_empty.notify()
+        if victim is not None:
+            self._shed_preempted(victim)
         return req
+
+    def _shed_preempted(self, victim: _Request) -> None:
+        """Resolve a queue-full preemption victim: shed with
+        ``ShedLoad`` (the arrival outranked it), counted per tenant and
+        as a distinct ``load_shed`` error — never a silent drop."""
+        with tracectx.activate(victim.trace_ctx):
+            # the victim's queue-wait interval still lands in its trace
+            # — the 503 it sees must be correlatable with how long it
+            # actually waited, same as every other queue-exit path
+            self._record_queue_span(victim, shed=True, error="ShedLoad")
+            victim.set_error(ShedLoad(
+                f"{self.name}: preempted from a full queue by a "
+                "higher-priority arrival",
+                retry_after=min(self.queue_wait_estimate() + 1.0,
+                                retry_after_cap()),
+                reason="preempted", tenant=victim.tenant,
+            ))
+        self._m_requests.inc(model=self.name, outcome="shed")
+        self._m_errors.inc(model=self.name, error="load_shed")
+        self._m_shed_tenant.inc(tenant=victim.tenant, reason="preempted")
+
+    def queue_wait_estimate(self) -> float:
+        """The live queue-wait estimate (seconds): an EWMA over recent
+        pop-time waits, decayed toward zero while the queue is idle —
+        one overload burst must not keep reading as pressure forever.
+        Feeds the shed controller and the HTTP ``Retry-After``."""
+        age = max(time.monotonic() - self._wait_ewma_at, 0.0)
+        return self._wait_ewma * (0.5 ** (age / 2.0))
+
+    def _note_queue_wait(self, wait_s: float) -> None:
+        self._wait_ewma = (0.8 * self.queue_wait_estimate()
+                           + 0.2 * max(wait_s, 0.0))
+        self._wait_ewma_at = time.monotonic()
 
     def depth(self) -> int:
         with self._lock:
@@ -626,7 +711,18 @@ class MicroBatcher:
 
     def _pop_live(self) -> Optional[_Request]:
         """Pop the next unexpired request; shed expired ones (counted,
-        errored) without touching the device. Caller holds the lock."""
+        errored) without touching the device. Caller holds the lock.
+
+        The fair queue first sweeps expired entries from the WHOLE
+        queue (``pop_expired``): under pressure the interactive-first
+        pick never reaches queued batch work, so an expired batch
+        request would otherwise neither serve nor shed — its client
+        hanging to the wait timeout while the dead entry pins queue
+        depth (and the pressure signal with it). FIFO's sweep is a
+        no-op: its head always drains, preserving the pre-scheduler
+        behavior exactly."""
+        for expired in self._queue.pop_expired():
+            self._shed(expired)
         while self._queue:
             req = self._queue.popleft()
             if req.expired():
@@ -636,6 +732,7 @@ class MicroBatcher:
         return None
 
     def _shed(self, req: _Request) -> None:
+        self._note_queue_wait(time.monotonic() - req.enqueued)
         with tracectx.activate(req.trace_ctx):
             self._record_queue_span(req, shed=True)
             req.set_error(DeadlineExpired(
@@ -645,15 +742,16 @@ class MicroBatcher:
         self._m_requests.inc(model=self.name, outcome="expired")
         self._m_expired.inc(model=self.name)
 
-    def _record_queue_span(self, req: _Request, shed: bool = False) -> None:
+    def _record_queue_span(self, req: _Request, shed: bool = False,
+                           error: str = "DeadlineExpired") -> None:
         """File the queue-wait interval into the REQUEST's trace (the
-        enqueue thread stamped t0; this — pop — is t1)."""
+        enqueue thread stamped t0; this — pop/shed — is t1)."""
         ctx = req.trace_ctx
         if ctx is None:
             return
         args = {"model": self.name, "rows": req.n}
         if shed:
-            args["error"] = "DeadlineExpired"
+            args["error"] = error
         spans_mod.record_event(
             f"serve:queue:{self.name}",
             req.enqueued_perf, time.perf_counter(),
@@ -822,7 +920,7 @@ class MicroBatcher:
                                 break
                             self._not_empty.wait(timeout=remaining)
                             continue
-                        nxt = self._queue[0]
+                        nxt = self._queue.peek()
                         if nxt.expired():
                             self._queue.popleft()
                             self._shed(nxt)
@@ -887,7 +985,9 @@ class MicroBatcher:
         stage_metric = self._m_stage
         for req in batch:
             tid = req.trace_ctx.trace_id if req.trace_ctx else None
-            stage_metric.observe(now - req.enqueued, trace_id=tid,
+            wait = now - req.enqueued
+            self._note_queue_wait(wait)
+            stage_metric.observe(wait, trace_id=tid,
                                  model=self.name, stage="queue")
             self._record_queue_span(req)
         # The fan-in edge: ONE coalesced dispatch runs in its own batch

@@ -83,7 +83,16 @@ Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``, constructor args win):
   NaN guard at runtime, else the engine falls back to native and counts
   ``sparkml_serve_precision_fallback_total``;
 * ``..._PRECISION_MAX_ERR`` (default 0.05) — the max-error bar: relative
-  max-abs error for float outputs, mismatch fraction for label outputs.
+  max-abs error for float outputs, mismatch fraction for label outputs;
+* ``..._SCHED``           (default ``fair``) — the queue discipline:
+  ``fifo`` is the kill switch restoring the plain FIFO deque;
+* ``..._TENANT_*`` / ``..._PRIORITY_*`` / ``..._SHED_*`` — multi-tenant
+  quotas, priority classes, and the adaptive load-shedding controller
+  (see ``serve.admission``); requests enter through the admission
+  boundary (quota verdict + shed gate + audit span) before any device
+  work, and are dequeued by a start-time-fair scheduler over row-cost
+  virtual time (``serve.scheduler``) so one tenant's burst cannot
+  starve the rest.
 
 SLO objectives come from ``SPARK_RAPIDS_ML_TPU_SLO_*`` (see ``obs.slo``).
 """
@@ -108,6 +117,15 @@ from spark_rapids_ml_tpu.obs.serving import (
 from spark_rapids_ml_tpu.obs.slo import SloSet, default_slos
 from spark_rapids_ml_tpu.serve import breaker as breaker_mod
 from spark_rapids_ml_tpu.serve import faults as faults_mod
+from spark_rapids_ml_tpu.serve.admission import (
+    AdmissionController,
+    ShedController,
+    ShedLoad,
+)
+from spark_rapids_ml_tpu.serve.scheduler import (
+    FairQueue,
+    fair_scheduling_from_env,
+)
 from spark_rapids_ml_tpu.serve.batching import (
     AsyncTransformSpec,
     BatcherClosed,
@@ -233,6 +251,21 @@ def extract_output(model, result) -> np.ndarray:
     )
 
 
+def _rows_estimate(rows) -> int:
+    """Row count of a raw request WITHOUT materializing it (the quota
+    cost must not pay an array copy before admission): ndarray shapes
+    are read directly, a flat sequence counts as one row."""
+    shape = getattr(rows, "shape", None)
+    if shape is not None:
+        return int(shape[0]) if len(shape) >= 2 else 1
+    try:
+        if rows and isinstance(rows[0], (list, tuple, np.ndarray)):
+            return len(rows)
+    except (TypeError, KeyError):
+        pass
+    return 1
+
+
 # Exception shapes that mean "the device backend failed", as opposed to
 # a client error or an orderly rejection: these feed the breaker and the
 # retry loop. Real backend stacks raise XlaRuntimeError/Unavailable
@@ -298,6 +331,11 @@ class ServeEngine:
         max_worker_restarts: Optional[int] = None,
         pipeline_depth: Optional[int] = None,
         precision: Optional[str] = None,
+        fair_scheduling: Optional[bool] = None,
+        admission: Optional[AdmissionController] = None,
+        tenant_quotas: Optional[Dict[str, Any]] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        shed: Optional[ShedController] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
@@ -362,6 +400,24 @@ class ServeEngine:
             else os.environ.get(ENV_PREFIX + "PRECISION", "native"))
         self.precision_max_err = _env_number("PRECISION_MAX_ERR", 0.05)
         self._clock = clock
+        # -- multi-tenant admission + weighted-fair scheduling ------------
+        # fair_scheduling defaults on; SPARK_RAPIDS_ML_TPU_SERVE_SCHED=
+        # fifo is the kill switch restoring the plain FIFO deque.
+        self.fair_scheduling = bool(
+            fair_scheduling if fair_scheduling is not None
+            else fair_scheduling_from_env())
+        if admission is not None:
+            self.admission = admission
+        else:
+            self.admission = AdmissionController(
+                tenant_quotas=tenant_quotas,
+                tenant_weights=tenant_weights,
+                shed=shed, clock=clock,
+            )
+        self.admission.bind(self._overload_signals,
+                            self.retry_after_estimate)
+        self._retry_after_max_s = _env_number("SHED_RETRY_AFTER_MAX_S",
+                                              30.0)
         self._batchers: Dict[Tuple[str, int], MicroBatcher] = {}
         self._async_specs: Dict[
             Tuple[str, int], Optional[AsyncTransformSpec]] = {}
@@ -392,6 +448,13 @@ class ServeEngine:
             "serving errors by type: batch failures (exception class), "
             "worker crashes/wedges, breaker rejections", ("model", "error"),
         )
+        self._m_tenant = reg.counter(
+            "sparkml_serve_tenant_requests_total",
+            "serving requests per tenant by outcome (ok, shed, "
+            "rejected, expired, error)", ("tenant", "outcome"),
+        )
+        self._m_tenant.inc(0, tenant=self.admission.default_tenant,
+                           outcome="ok")
         _live_engines.add(self)
 
     # -- the request path --------------------------------------------------
@@ -404,6 +467,8 @@ class ServeEngine:
         deadline_ms: Optional[float] = None,
         version: Optional[int] = None,
         timeout: Optional[float] = 120.0,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> np.ndarray:
         """Serve one request: resolve, admit, coalesce, return its rows.
 
@@ -412,7 +477,7 @@ class ServeEngine:
         """
         return self.predict_detailed(
             model_ref, rows, deadline_ms=deadline_ms, version=version,
-            timeout=timeout,
+            timeout=timeout, tenant=tenant, priority=priority,
         ).outputs
 
     def predict_detailed(
@@ -423,13 +488,19 @@ class ServeEngine:
         deadline_ms: Optional[float] = None,
         version: Optional[int] = None,
         timeout: Optional[float] = 120.0,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> PredictResult:
         """Serve one request with full fault handling.
 
         Runs under the active ``TraceContext`` (or mints a root one), so
         the request is followable across the queue/batch handoffs and
-        appears in the flight recorder's in-flight table. Raises
-        ``KeyError`` (unknown model), ``QueueFull`` (admission),
+        appears in the flight recorder's in-flight table.
+        ``tenant``/``priority`` feed the admission controller
+        (``serve.admission``): quota verdict, adaptive shed gate, and
+        the weighted-fair queue position. Raises ``KeyError`` (unknown
+        model), ``QueueFull`` (admission), ``ShedLoad`` (the adaptive
+        overload controller — orderly, never breaker food),
         ``DeadlineExpired`` (shed while queued), ``WorkerCrashed``
         (batcher worker dead — fast, never hangs to deadline),
         ``BreakerOpen`` (breaker open, no fallback), ``EngineClosed``.
@@ -444,6 +515,7 @@ class ServeEngine:
         # ValueError BEFORE that is the client's (bad shape), AFTER it is
         # the batch execution failing — the outage the SLO layer sees.
         submitted = [False]
+        tenant_id = self.admission.resolve_tenant(tenant)
         try:
             with tracectx.activate(ctx), tracectx.inflight_request(
                 ctx, model=entry.name, version=entry.version,
@@ -463,6 +535,14 @@ class ServeEngine:
                              else self.default_deadline_ms)
                 deadline = (time.monotonic() + budget_ms / 1000.0
                             if budget_ms and budget_ms > 0 else None)
+                # The admission boundary: quota verdict + the adaptive
+                # shed gate, BEFORE the breaker or any device work — an
+                # overload shed raises ShedLoad here (audited, counted,
+                # Retry-After attached; never a breaker verdict).
+                decision = self.admission.admit(
+                    tenant_id, priority, _rows_estimate(rows),
+                    model=entry.name,
+                )
                 gate = brk.allow()
                 if gate == "open":
                     out = self._degraded_predict(entry, rows, ctx)
@@ -470,7 +550,7 @@ class ServeEngine:
                 else:
                     out, retries, degraded = self._attempts(
                         entry, rows, deadline, handoff, timeout,
-                        brk, gate, ctx, submitted,
+                        brk, gate, ctx, submitted, decision,
                     )
         except BaseException as exc:
             # Client errors (unknown model, a bad request shape rejected
@@ -482,6 +562,23 @@ class ServeEngine:
                 isinstance(exc, ValueError) and not submitted[0]
             )
             if not client_error:
+                self._m_tenant.inc(
+                    tenant=tenant_id,
+                    outcome=("shed" if isinstance(exc, ShedLoad)
+                             else "rejected" if isinstance(exc, QueueFull)
+                             else "expired"
+                             if isinstance(exc, DeadlineExpired)
+                             else "error"),
+                )
+                if isinstance(exc, ShedLoad) and not submitted[0]:
+                    # distinct from QueueFull: a load-shed rejection is
+                    # the controller's choice, not a full queue. Only
+                    # ADMISSION sheds count here — a preemption victim
+                    # (submitted, then evicted) was already counted by
+                    # the batcher; counting it again would double every
+                    # preemption in the error series.
+                    self._m_errors.inc(model=entry.name,
+                                       error="load_shed")
                 self.slo.record_request(False, time.perf_counter() - t0)
                 # The SLO fast-burn trip wire: sustained backend-failure
                 # bursts open the breaker even when they are not
@@ -495,6 +592,7 @@ class ServeEngine:
             raise
         elapsed = time.perf_counter() - t0
         self.slo.record_request(True, elapsed)
+        self._m_tenant.inc(tenant=tenant_id, outcome="ok")
         self._m_latency.observe(elapsed, trace_id=ctx.trace_id,
                                 model=entry.name)
         return PredictResult(
@@ -515,6 +613,7 @@ class ServeEngine:
         gate: str,
         ctx: tracectx.TraceContext,
         submitted: List[bool],
+        decision=None,
     ) -> Tuple[np.ndarray, int, bool]:
         """The bounded-retry loop: (outputs, retries_used, degraded)."""
         probe = gate == "probe"
@@ -525,7 +624,7 @@ class ServeEngine:
             try:
                 if attempt == 1:
                     out = self._one_attempt(entry, rows, deadline, handoff,
-                                            timeout, submitted,
+                                            timeout, submitted, decision,
                                             revive=probe)
                 else:
                     # Retries are child spans of the SAME request trace:
@@ -535,10 +634,11 @@ class ServeEngine:
                         model=entry.name, attempt=attempt - 1,
                     ):
                         out = self._one_attempt(entry, rows, deadline,
-                                                handoff, timeout, submitted)
+                                                handoff, timeout,
+                                                submitted, decision)
             except BaseException as exc:  # noqa: BLE001 - classified below
-                if isinstance(exc, (QueueFull, DeadlineExpired, KeyError,
-                                    EngineClosed, WaitTimeout)):
+                if isinstance(exc, (QueueFull, ShedLoad, DeadlineExpired,
+                                    KeyError, EngineClosed, WaitTimeout)):
                     # Orderly rejections / client errors: no breaker
                     # verdict (the device was never consulted).
                     if probe:
@@ -585,10 +685,18 @@ class ServeEngine:
                 return out, attempt - 1, False
 
     def _one_attempt(self, entry, rows, deadline, handoff, timeout,
-                     submitted: List[bool],
+                     submitted: List[bool], decision=None,
                      revive: bool = False) -> np.ndarray:
         batcher = self._batcher_for(entry, revive=revive)
-        req = batcher.submit(rows, deadline=deadline, trace_ctx=handoff)
+        if decision is not None:
+            req = batcher.submit(rows, deadline=deadline,
+                                 trace_ctx=handoff,
+                                 tenant=decision.tenant,
+                                 priority=decision.priority,
+                                 over_quota=decision.over_quota)
+        else:
+            req = batcher.submit(rows, deadline=deadline,
+                                 trace_ctx=handoff)
         submitted[0] = True
         return req.wait(timeout)
 
@@ -855,6 +963,7 @@ class ServeEngine:
                            else np.float64),
                     async_spec=async_spec,
                     pipeline_depth=self.pipeline_depth,
+                    queue=self._make_queue(),
                 )
                 self._batchers[key] = batcher
                 # flat-0 series for the engine-level counters too
@@ -871,6 +980,91 @@ class ServeEngine:
         for k in stale:
             self.evict(*k)
         return batcher
+
+    def _make_queue(self):
+        """The queue discipline for a new batcher: the weighted-fair
+        scheduler (SFQ over row-cost virtual time, interactive-first
+        under shed pressure) — or None (→ the batcher's FIFO deque)
+        when the ``SCHED=fifo`` kill switch is set."""
+        if not self.fair_scheduling:
+            return None
+        return FairQueue(
+            tenant_weights=self.admission.tenant_weights,
+            pressure_fn=self.admission.shed.pressure,
+        )
+
+    # -- overload introspection --------------------------------------------
+
+    def _overload_signals(self) -> Dict[str, float]:
+        """The shed controller's live inputs: the worst short-window SLO
+        burn, the worst batcher queue-wait estimate, and the fullest
+        queue's depth fraction. Called through
+        ``ShedController.maybe_refresh`` at a bounded cadence — never
+        per request."""
+        with self._lock:
+            batchers = list(self._batchers.values())
+        wait = max((b.queue_wait_estimate() for b in batchers),
+                   default=0.0)
+        depth_frac = max(
+            (b.depth() / b.max_queue_depth
+             for b in batchers if b.max_queue_depth > 0),
+            default=0.0)
+        burn = self.slo.fast_burn_rate() if len(self.slo) else 0.0
+        return {"burn": burn, "queue_wait_s": wait,
+                "depth_frac": depth_frac}
+
+    def shed_posture(self):
+        """Refresh-then-read the shed controller, for probes.
+
+        ``/healthz`` and ``/readyz`` go through this instead of reading
+        the controller directly: signals otherwise only refresh on
+        predict traffic, so the moment a load balancer honors a
+        shedding 503 and drains the replica, nothing would ever run the
+        de-escalation timeline again and ``/readyz`` would answer 503
+        forever — a drained replica must be able to cool down and
+        re-enter rotation on its own probes."""
+        shed = self.admission.shed
+        if shed.enabled and not self._closed:
+            shed.maybe_refresh(self._overload_signals)
+        return shed
+
+    def fast_shed(self, tenant: Optional[str],
+                  priority: Optional[str]) -> Optional[ShedLoad]:
+        """The HTTP layer's pre-parse shed probe: a ``ShedLoad`` to
+        reply with (already counted/audited; also recorded here as an
+        SLO failure and a per-tenant shed, like any other shed) or None
+        (parse the body and run the full path). Headers only — the
+        whole point is skipping the body parse."""
+        if self._closed:
+            return None
+        exc = self.admission.fast_shed(tenant, priority)
+        if exc is None:
+            return None
+        self._m_tenant.inc(tenant=exc.tenant, outcome="shed")
+        self._m_errors.inc(model="(preparse)", error="load_shed")
+        self.slo.record_request(False, 0.0)
+        return exc
+
+    def retry_after_estimate(self) -> float:
+        """Seconds a rejected caller should wait before retrying,
+        derived from the live queue-wait estimate (clamped to
+        ``[1, SHED_RETRY_AFTER_MAX_S]``) — the ``Retry-After`` header
+        on 429/503/504 responses."""
+        with self._lock:
+            batchers = list(self._batchers.values())
+        wait = max((b.queue_wait_estimate() for b in batchers),
+                   default=0.0)
+        return float(min(max(2.0 * wait, 1.0),
+                         max(self._retry_after_max_s, 1.0)))
+
+    def overload_state(self) -> Dict[str, Any]:
+        """The overload posture for ``/readyz`` and ``/debug/slo``:
+        shed level + signals, fair-scheduling posture, per-tenant quota
+        snapshot, and the current Retry-After estimate."""
+        snap = self.admission.snapshot()
+        snap["fair_scheduling"] = self.fair_scheduling
+        snap["retry_after_seconds"] = self.retry_after_estimate()
+        return snap
 
     def _breaker_for(self, name: str) -> CircuitBreaker:
         with self._lock:
@@ -1049,6 +1243,7 @@ __all__ = [
     "PredictResult",
     "QueueFull",
     "ServeEngine",
+    "ShedLoad",
     "WaitTimeout",
     "WorkerCrashed",
     "extract_output",

@@ -5,20 +5,33 @@ drivable end-to-end (curl, load generators, k8s probes) without adding a
 web framework to the container:
 
 * ``POST /predict`` — body ``{"model": "name[@version]",
-  "rows": [[...], ...], "deadline_ms": 250}`` → ``{"model", "version",
-  "outputs": [...], "trace_id", "degraded", "retries"}``; admission
-  rejection maps to **429**, a shed deadline to **504**, an unknown
-  model to **404**, malformed input to **400**, and the fault-tolerance
-  outcomes to **503**: an open breaker with no CPU fallback
-  (``BreakerOpen``) and a dead batcher worker (``WorkerCrashed``) are
-  both retryable service states, not client errors. A request served by
-  the degraded CPU fallback still returns **200** with
-  ``"degraded": true``. An inbound W3C ``traceparent`` header continues
-  the caller's trace (Dapper-style propagation via ``obs.tracectx``);
-  every response carries a ``traceparent`` back, and every error path
-  replies with an explicit ``Content-Length``;
-* ``GET /healthz`` — engine liveness + registered models + queue depth
-  (the readiness probe target);
+  "rows": [[...], ...], "deadline_ms": 250, "tenant": "team-a",
+  "priority": "interactive|batch"}`` (tenant/priority also accepted as
+  ``X-Tenant`` / ``X-Priority`` headers; HEADERS win — the pre-parse
+  fast-shed path can only see headers, so they must be authoritative;
+  body fields serve header-less clients) → ``{"model",
+  "version", "outputs": [...], "trace_id", "degraded", "retries"}``;
+  admission rejection maps to **429**, an adaptive load-shed
+  (``ShedLoad`` — the overload controller's verdict, distinct from a
+  full queue) to **503** with ``"shed": true``, a shed deadline to
+  **504**, an unknown model to **404**, malformed input to **400**, and
+  the fault-tolerance outcomes to **503**: an open breaker with no CPU
+  fallback (``BreakerOpen``) and a dead batcher worker
+  (``WorkerCrashed``) are both retryable service states, not client
+  errors. Every 429/503/504 overload rejection carries a
+  ``Retry-After`` header derived from the live queue-wait estimate. A
+  request served by the degraded CPU fallback still returns **200**
+  with ``"degraded": true``. An inbound W3C ``traceparent`` header
+  continues the caller's trace (Dapper-style propagation via
+  ``obs.tracectx``); every response carries a ``traceparent`` back, and
+  every error path replies with an explicit ``Content-Length``;
+* ``GET /healthz`` — engine liveness + registered models + queue depth;
+  the ``status`` field is overload-aware (``ok`` / ``shedding`` /
+  ``draining``) but liveness stays 200 while shedding;
+* ``GET /readyz`` — the load-balancer drain signal: **503** while the
+  adaptive shed controller is actively shedding (or the engine is
+  draining), 200 otherwise — a saturated replica gets routed around
+  instead of hammered;
 * ``GET /metrics`` — the process metrics registry as Prometheus text
   (same exposition ``obs.metrics.start_prometheus_server`` serves), so
   one port carries traffic AND its observability;
@@ -72,6 +85,7 @@ from spark_rapids_ml_tpu.obs import incidents as incidents_mod
 from spark_rapids_ml_tpu.obs import profiler as profiler_mod
 from spark_rapids_ml_tpu.obs import spans as spans_mod
 from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+from spark_rapids_ml_tpu.serve.admission import ShedLoad
 from spark_rapids_ml_tpu.serve.batching import (
     BatcherClosed,
     DeadlineExpired,
@@ -206,17 +220,40 @@ def make_handler(engine: ServeEngine):
 
         def _reply(self, status: int, payload: dict,
                    trace_ctx: Optional[tracectx.TraceContext] = None,
+                   retry_after: Optional[float] = None,
                    ) -> int:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after is not None:
+                # overload rejections (429/503/504) tell the caller WHEN
+                # to come back — derived from the live queue-wait
+                # estimate, not a constant
+                self.send_header(
+                    "Retry-After",
+                    str(max(int(retry_after + 0.999), 1)))
             if trace_ctx is not None:
                 self.send_header(tracectx.TRACEPARENT_HEADER,
                                  trace_ctx.traceparent())
             self.end_headers()
             self.wfile.write(body)
             return status
+
+        def _drain_body(self) -> None:
+            """Read (and discard) the request body without parsing it —
+            replying before consuming the body would desync a keep-alive
+            connection. A zero-length/absent body needs no drain and the
+            connection stays open; an unparseable or oversize length
+            closes it."""
+            try:
+                length = int(self.headers.get("Content-Length", 0) or 0)
+            except (TypeError, ValueError):
+                length = -1
+            if 0 < length <= _MAX_BODY_BYTES:
+                self.rfile.read(length)
+            elif length != 0:
+                self.close_connection = True
 
         def _reply_text(self, status: int, text: str,
                         content_type: str) -> int:
@@ -232,12 +269,45 @@ def make_handler(engine: ServeEngine):
             parsed = urllib.parse.urlparse(self.path)
             path = parsed.path
             if path == "/healthz":
+                # liveness stays 200 even while shedding (the process is
+                # alive and answering); the STATUS FIELD carries the
+                # overload posture so anything reading /healthz sees it.
+                # shed_posture refreshes the controller's timeline: a
+                # drained replica has no predict traffic, so probes are
+                # what keep de-escalation possible.
+                shed = engine.shed_posture()
                 status = self._reply(200, {
-                    "status": "ok" if not engine._closed else "draining",
+                    "status": ("draining" if engine._closed
+                               else "shedding" if shed.shedding()
+                               else "ok"),
                     "models": engine.registry.names(),
                     "queue_depth": engine.queue_depth(),
+                    "shed_level": shed.level(),
                     "inflight": tracectx.inflight_requests(),
                 })
+            elif path == "/readyz":
+                # the load-balancer drain signal: a saturated replica
+                # that is actively shedding answers 503 here so the LB
+                # routes around it instead of hammering it — while
+                # /healthz keeps reporting the process alive. Probe
+                # reads refresh the controller (engine.shed_posture), so
+                # a drained replica cools down and re-enters rotation.
+                shedding = engine.shed_posture().shedding()
+                overload = engine.overload_state()
+                if engine._closed:
+                    status = self._reply(
+                        503, {"status": "draining", "ready": False})
+                elif shedding:
+                    status = self._reply(503, {
+                        "status": "shedding", "ready": False,
+                        "shed_level": overload["shed"]["level"],
+                        "overload": overload["shed"]["signals"],
+                    }, retry_after=overload["retry_after_seconds"])
+                else:
+                    status = self._reply(200, {
+                        "status": "ready", "ready": True,
+                        "models": engine.registry.names(),
+                    })
             elif path == "/metrics":
                 status = self._reply_text(
                     200, get_registry().prometheus_text(),
@@ -269,6 +339,7 @@ def make_handler(engine: ServeEngine):
                 snap["degraded_total"] = m_degraded.total()
                 snap["retries_total"] = m_retries.total()
                 snap["worker_restarts_total"] = m_restarts.total()
+                snap["overload"] = engine.overload_state()
                 status = self._reply(200, snap)
             elif path == "/debug/history":
                 params = urllib.parse.parse_qs(parsed.query)
@@ -331,14 +402,7 @@ def make_handler(engine: ServeEngine):
             # Parameters ride the query string, but clients may still
             # POST a body (curl -d '{}') — drain it, or a keep-alive
             # connection parses the leftover bytes as its next request.
-            try:
-                length = int(self.headers.get("Content-Length", 0) or 0)
-            except (TypeError, ValueError):
-                length = -1
-            if 0 < length <= _MAX_BODY_BYTES:
-                self.rfile.read(length)
-            elif length != 0:
-                self.close_connection = True
+            self._drain_body()
             params = urllib.parse.parse_qs(parsed.query)
             seconds = _query_float(params, "seconds", 5.0,
                                    0.05, profiler_mod.MAX_SECONDS)
@@ -362,6 +426,22 @@ def make_handler(engine: ServeEngine):
             Every reply — 200 and all error paths (400/404/429/503/504)
             — goes through ``_reply``, so every response carries an
             explicit ``Content-Length`` and the ``traceparent``."""
+            # Pre-parse fast path: when the shed controller is already
+            # rejecting this (header-identified) tenant/priority class,
+            # say no BEFORE paying the JSON body parse — under a reject
+            # storm, the cost of a rejection decides whether rejecting
+            # frees capacity or re-spends it. The body is drained raw
+            # (keep-alive must not desync) but never parsed.
+            shed_exc = engine.fast_shed(self.headers.get("X-Tenant"),
+                                        self.headers.get("X-Priority"))
+            if shed_exc is not None:
+                self._drain_body()
+                return self._reply(503, {
+                    "error": str(shed_exc),
+                    "retryable": True,
+                    "shed": True,
+                    "reason": shed_exc.reason,
+                }, trace_ctx=ctx, retry_after=shed_exc.retry_after)
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 if length <= 0 or length > _MAX_BODY_BYTES:
@@ -370,6 +450,17 @@ def make_handler(engine: ServeEngine):
                 model_ref = payload["model"]
                 rows = np.asarray(payload["rows"], dtype=np.float64)
                 deadline_ms = payload.get("deadline_ms")
+                # tenant/priority: HEADERS win over body fields — the
+                # pre-parse fast-shed path above can only see headers,
+                # so headers must be authoritative or a fast shed and a
+                # full admission could judge the same request as two
+                # different tenants. Body fields are the fallback for
+                # header-less clients; the admission controller applies
+                # env defaults and bounds label cardinality.
+                tenant = self.headers.get("X-Tenant") \
+                    or payload.get("tenant")
+                priority = self.headers.get("X-Priority") \
+                    or payload.get("priority")
             except (KeyError, TypeError, ValueError) as exc:
                 # The body may be partially (or not at all) consumed —
                 # a keep-alive connection would desync, so close it.
@@ -384,6 +475,7 @@ def make_handler(engine: ServeEngine):
                 result = engine.predict_detailed(
                     entry.name, rows, version=entry.version,
                     deadline_ms=deadline_ms,
+                    tenant=tenant, priority=priority,
                 )
             except KeyError as exc:
                 return self._reply(404, {"error": str(exc)}, trace_ctx=ctx)
@@ -392,9 +484,23 @@ def make_handler(engine: ServeEngine):
                 # client's to fix
                 return self._reply(400, {"error": str(exc)}, trace_ctx=ctx)
             except QueueFull as exc:
-                return self._reply(429, {"error": str(exc)}, trace_ctx=ctx)
+                return self._reply(
+                    429, {"error": str(exc)}, trace_ctx=ctx,
+                    retry_after=engine.retry_after_estimate())
+            except ShedLoad as exc:
+                # the adaptive overload controller's verdict: distinct
+                # from QueueFull (the queue may not even be full), with
+                # the controller's own Retry-After estimate
+                return self._reply(503, {
+                    "error": str(exc),
+                    "retryable": True,
+                    "shed": True,
+                    "reason": exc.reason,
+                }, trace_ctx=ctx, retry_after=exc.retry_after)
             except (DeadlineExpired, WaitTimeout) as exc:
-                return self._reply(504, {"error": str(exc)}, trace_ctx=ctx)
+                return self._reply(
+                    504, {"error": str(exc)}, trace_ctx=ctx,
+                    retry_after=engine.retry_after_estimate())
             except (BreakerOpen, WorkerCrashed) as exc:
                 # self-healing states: the breaker is shedding for this
                 # model / the worker is being restarted — retryable 503
@@ -402,7 +508,8 @@ def make_handler(engine: ServeEngine):
                 return self._reply(503, {
                     "error": str(exc),
                     "retryable": True,
-                }, trace_ctx=ctx)
+                }, trace_ctx=ctx,
+                    retry_after=engine.retry_after_estimate())
             except (BatcherClosed, EngineClosed) as exc:
                 # both mean "shutting down" — retryable 503, not a 5xx page
                 return self._reply(503, {"error": str(exc)}, trace_ctx=ctx)
@@ -428,6 +535,14 @@ def make_handler(engine: ServeEngine):
 class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # Overload survival: a shedding server churns connections far faster
+    # than socketserver's default 5-deep accept backlog — once the SYN
+    # queue overflows, clients silently sit in kernel retransmit
+    # (1+2+4+8… seconds) and the in-SLO tenant's tail blows up exactly
+    # when the application layer is shedding to stay fast. Measured
+    # directly in scripts/load_harness.py: compliant p99 went from ~15 s
+    # (the retransmit ladder) to the queue-wait target after this.
+    request_queue_size = 128
 
 
 def start_serve_server(
@@ -776,6 +891,9 @@ async function refresh() {
     var tiles = [
       tile("Service", statusSpan(
         health.status === "ok" ? "good" : "warning", health.status)),
+      tile("Shed level", health.shed_level
+        ? statusSpan("serious", "\\u25cf " + health.shed_level)
+        : statusSpan("good", "\\u25cf 0")),
       tile("Queue depth", health.queue_depth,
            qdPoints ? sparkSvg(qdPoints) : ""),
       tile("In flight", (health.inflight || []).length),

@@ -1,0 +1,768 @@
+"""Fairness invariants for the multi-tenant admission/scheduling layer:
+token-bucket quota refill over an injected clock, start-time fair
+queuing (a greedy tenant cannot starve a compliant one), priority
+preemption under a full queue, shed-decision audit spans assembled into
+the request trace tree, the overload HTTP surface (Retry-After,
+``/readyz``, distinct ``load_shed`` error label), the FIFO kill switch,
+and the rule-10 static check (no silent admission/shed drops)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs import tracectx
+from spark_rapids_ml_tpu.serve import (
+    FairQueue,
+    FifoQueue,
+    MicroBatcher,
+    ModelRegistry,
+    QueueFull,
+    ServeEngine,
+    ShedController,
+    ShedLoad,
+    TokenBucket,
+    fair_scheduling_from_env,
+    start_serve_server,
+)
+from spark_rapids_ml_tpu.serve.admission import (
+    OVERFLOW_TENANT,
+    AdmissionController,
+    parse_tenant_quotas,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _SlowModel:
+    """Registry-compatible stub; transform sleeps ``delay`` seconds."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+
+    def transform(self, matrix):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(matrix)
+
+
+def _req(n=8, tenant="default", priority="interactive",
+         over_quota=False):
+    """A scheduler-visible request stand-in (the FairQueue only reads
+    n/tenant/priority/over_quota)."""
+    return types.SimpleNamespace(n=n, tenant=tenant, priority=priority,
+                                 over_quota=over_quota)
+
+
+def _forced_shed_controller(level_signals=True) -> ShedController:
+    """A controller pinned at a shed level: signals injected once and
+    never refreshed (huge refresh interval), never de-escalated (huge
+    hold)."""
+    shed = ShedController(refresh_seconds=1e9, hold_seconds=1e9)
+    if level_signals:
+        shed.note_signals(burn=100.0, queue_wait_s=10.0, depth_frac=1.0)
+    return shed
+
+
+# -- token buckets ----------------------------------------------------------
+
+
+def test_token_bucket_refill_over_injected_clock():
+    clock = _FakeClock()
+    bucket = TokenBucket(100.0, 200.0, clock=clock)
+    assert bucket.take(200)          # full burst available
+    assert not bucket.take(1)        # drained
+    clock.advance(0.5)               # +50 tokens
+    assert bucket.take(50)
+    assert not bucket.take(1)
+    clock.advance(100.0)             # refills cap at burst
+    assert bucket.tokens() == pytest.approx(200.0)
+    assert bucket.take(200)
+
+
+def test_token_bucket_over_quota_consumes_nothing():
+    clock = _FakeClock()
+    bucket = TokenBucket(10.0, 50.0, clock=clock)
+    assert bucket.take(40)
+    # 10 tokens left; a 30-row request is over quota and must NOT
+    # drive the bucket into debt (no self-starvation spiral)
+    assert not bucket.take(30)
+    assert bucket.tokens() == pytest.approx(10.0)
+    assert bucket.take(10)
+
+
+def test_token_bucket_zero_rate_is_unlimited():
+    bucket = TokenBucket(0.0, clock=_FakeClock())
+    assert bucket.unlimited
+    for _ in range(100):
+        assert bucket.take(10_000)
+
+
+def test_parse_tenant_quotas():
+    quotas = parse_tenant_quotas("a:1000:2000, b:50;c:7")
+    assert quotas == {"a": (1000.0, 2000.0), "b": (50.0, 200.0),
+                      "c": (7.0, 28.0)}
+    # malformed entries are skipped, never armed
+    assert parse_tenant_quotas("bad,:5,x:y,ok:10") == {"ok": (10.0, 40.0)}
+
+
+def test_admission_quota_refill_injected_clock():
+    clock = _FakeClock()
+    ctrl = AdmissionController(
+        tenant_quotas={"t": (100.0, 100.0)}, clock=clock,
+        shed=ShedController(enabled=False, clock=clock),
+    )
+    d1 = ctrl.admit("t", "batch", 100, model="m")
+    assert d1.decision == "admit" and not d1.over_quota
+    d2 = ctrl.admit("t", "batch", 50, model="m")
+    assert d2.over_quota and d2.decision == "admit_over_quota"
+    clock.advance(1.0)  # full refill at 100 rows/s
+    d3 = ctrl.admit("t", "batch", 100, model="m")
+    assert not d3.over_quota
+
+
+def test_admission_tenant_cardinality_bounded():
+    ctrl = AdmissionController(
+        max_tenants=2, clock=_FakeClock(),
+        shed=ShedController(enabled=False, clock=_FakeClock()),
+    )
+    assert ctrl.admit("a", None, 1).tenant == "a"
+    assert ctrl.admit("b", None, 1).tenant == "b"
+    # beyond the cap, new ids collapse — no unbounded label children
+    assert ctrl.resolve_tenant("c") == OVERFLOW_TENANT
+    assert ctrl.admit("zz", None, 1).tenant == OVERFLOW_TENANT
+    assert ctrl.resolve_tenant("a") == "a"  # known ids keep resolving
+
+
+# -- the fair queue ---------------------------------------------------------
+
+
+def test_fair_queue_single_flow_is_fifo():
+    q = FairQueue()
+    reqs = [_req(n) for n in (8, 64, 1, 32, 8)]
+    for r in reqs:
+        q.append(r)
+    assert [q.popleft() for _ in range(len(reqs))] == reqs
+
+
+def test_fifo_queue_matches_deque_semantics():
+    q = FifoQueue()
+    reqs = [_req(i + 1) for i in range(4)]
+    for r in reqs:
+        q.append(r)
+    assert len(q) == 4 and q.peek() is reqs[0]
+    assert q.select_victim(_req(1, priority="interactive")) is None
+    assert [q.popleft() for _ in range(4)] == reqs
+    assert not q
+    with pytest.raises(IndexError):
+        q.popleft()
+
+
+def test_fair_queue_greedy_burst_cannot_starve_compliant():
+    q = FairQueue()
+    greedy = [_req(64, tenant="greedy") for _ in range(10)]
+    for r in greedy:
+        q.append(r)
+    compliant = [_req(8, tenant="compliant") for _ in range(3)]
+    for r in compliant:
+        q.append(r)  # arrives AFTER the whole greedy burst
+    order = [q.popleft() for _ in range(13)]
+    # virtual time: the greedy flood advanced its own timeline only —
+    # every compliant request dequeues ahead of most of the burst
+    positions = [order.index(r) for r in compliant]
+    assert positions[0] <= 1
+    assert max(positions) <= 5
+    # and within each tenant, order is preserved (FIFO among equals)
+    assert [r for r in order if r.tenant == "greedy"] == greedy
+    assert [r for r in order if r.tenant == "compliant"] == compliant
+
+
+def test_fair_queue_over_quota_demotion_and_weights():
+    q = FairQueue(tenant_weights={"vip": 4.0})
+    over = _req(8, tenant="bulk", over_quota=True)
+    q.append(over)
+    vip = _req(8, tenant="vip")
+    q.append(vip)
+    # same virtual start, but finish tags differ by 16x (4x tenant
+    # weight vs 0.25x over-quota demotion); start-tag tie broken FIFO —
+    # then the NEXT round shows the demotion: bulk's second request
+    # starts 16x later in virtual time
+    q.append(_req(8, tenant="bulk", over_quota=True))
+    q.append(_req(8, tenant="vip"))
+    order = [q.popleft() for _ in range(4)]
+    tenants = [r.tenant for r in order]
+    assert tenants[-1] == "bulk"  # the demoted flow drains last
+
+
+def test_fair_queue_pressure_prefers_interactive():
+    pressured = [False]
+    q = FairQueue(pressure_fn=lambda: pressured[0])
+    batch = [_req(8, priority="batch") for _ in range(3)]
+    for r in batch:
+        q.append(r)
+    inter = _req(8, priority="interactive")
+    q.append(inter)
+    # no pressure: SFQ order — the earlier batch requests win on tags
+    assert q.peek() is batch[0]
+    pressured[0] = True
+    # under pressure: interactive preempts the whole batch backlog
+    assert q.peek() is inter
+    assert q.popleft() is inter
+
+
+def test_fair_queue_peek_pop_coherent_under_pressure_flip():
+    """A pressure flip between the worker's peek and its popleft must
+    not change the pick: peek's choice is cached, so the request the
+    coalescer decided about is exactly the one removed (a divergence
+    silently dropped a request, which then hung to its wait timeout)."""
+    flip = {"v": False}
+
+    def pressure():
+        flip["v"] = not flip["v"]  # flips on EVERY evaluation
+        return flip["v"]
+
+    q = FairQueue(pressure_fn=pressure)
+    reqs = [_req(8, priority="batch") for _ in range(3)]
+    reqs.append(_req(8, priority="interactive"))
+    for r in reqs:
+        q.append(r)
+    popped = []
+    while q:
+        peeked = q.peek()
+        got = q.popleft()
+        assert got is peeked
+        popped.append(got)
+    assert len(popped) == 4 and set(map(id, popped)) == set(map(id, reqs))
+
+
+def _stub(priority="batch", over_quota=False, expired=False):
+    return types.SimpleNamespace(
+        n=8, tenant="t", priority=priority, over_quota=over_quota,
+        expired=lambda now=None, _e=expired: _e)
+
+
+def test_fair_queue_pop_expired_sweeps_every_band():
+    """Under pressure the pick never reaches batch entries, so expired
+    batch work must be swept from the WHOLE queue — otherwise its
+    client hangs to the wait timeout and the dead entry pins queue
+    depth (self-sustaining the pressure signal)."""
+    q = FairQueue(pressure_fn=lambda: True)
+    dead = _stub(priority="batch", over_quota=True, expired=True)
+    live = _stub(priority="batch")
+    inter = _stub(priority="interactive")
+    for r in (dead, live, inter):
+        q.append(r)
+    assert q.pop_expired() == [dead]
+    assert len(q) == 2 and q.pop_expired() == []
+    # FIFO keeps the pre-scheduler head-only behavior: sweep is a no-op
+    f = FifoQueue()
+    f.append(dead)
+    assert f.pop_expired() == [] and len(f) == 1
+
+
+def test_batcher_sheds_expired_batch_request_under_pressure():
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocking_transform(matrix):
+        started.set()
+        release.wait(10.0)
+        return matrix
+
+    batcher = MicroBatcher(
+        blocking_transform, name="sweep", max_batch_rows=8,
+        max_wait_ms=1.0, max_queue_depth=8,
+        queue=FairQueue(pressure_fn=lambda: True),
+    )
+    try:
+        batcher.submit(np.ones((8, 2)), trace_ctx=None)
+        assert started.wait(5.0)  # worker stuck in the first batch
+        doomed = batcher.submit(
+            np.ones((8, 2)), trace_ctx=None, tenant="g",
+            priority="batch", deadline=time.monotonic() + 0.05)
+        vip = batcher.submit(np.ones((8, 2)), trace_ctx=None,
+                             priority="interactive")
+        time.sleep(0.1)  # the batch request's deadline passes
+        release.set()
+        assert vip.wait(10.0).shape == (8, 2)
+        # the expired batch request was SWEPT (DeadlineExpired), not
+        # stranded behind the interactive-only pick until wait timeout
+        from spark_rapids_ml_tpu.serve import DeadlineExpired
+        with pytest.raises(DeadlineExpired):
+            doomed.wait(2.0)
+    finally:
+        release.set()
+        batcher.close(drain=False, timeout=5.0)
+
+
+def test_fair_queue_select_victim_ranks():
+    q = FairQueue()
+    b1 = _req(8, tenant="g", priority="batch", over_quota=True)
+    b2 = _req(8, tenant="g", priority="batch", over_quota=True)
+    ib = _req(8, tenant="c", priority="batch")
+    q.append(b1)
+    q.append(b2)
+    q.append(ib)
+    # an interactive arrival evicts the LEAST entitled queued request:
+    # over-quota batch, latest finish tag (b2 queued after b1)
+    victim = q.select_victim(_req(8, priority="interactive"))
+    assert victim is b2
+    assert len(q) == 2
+    # a batch arrival cannot evict an equal-or-higher-ranked request
+    assert q.select_victim(
+        _req(8, priority="batch", over_quota=True)) is None
+    # in-quota batch outranks over-quota batch
+    victim2 = q.select_victim(_req(8, priority="batch"))
+    assert victim2 is b1
+
+
+def test_preemption_under_full_queue_micro_batcher():
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocking_transform(matrix):
+        started.set()
+        release.wait(10.0)
+        return matrix
+
+    batcher = MicroBatcher(
+        blocking_transform, name="preempt", max_batch_rows=8,
+        max_wait_ms=1.0, max_queue_depth=2, queue=FairQueue(),
+    )
+    try:
+        first = batcher.submit(np.ones((8, 2)), trace_ctx=None)
+        assert started.wait(5.0)  # worker is now stuck in the batch
+        victims = [
+            batcher.submit(np.ones((8, 2)), trace_ctx=None,
+                           tenant="g", priority="batch",
+                           over_quota=True)
+            for _ in range(2)
+        ]
+        # queue full of low-rank work: an interactive arrival preempts
+        # instead of being rejected
+        vip = batcher.submit(np.ones((8, 2)), trace_ctx=None,
+                             tenant="c", priority="interactive")
+        shed = [v for v in victims if v.error is not None]
+        assert len(shed) == 1
+        with pytest.raises(ShedLoad) as exc_info:
+            shed[0].wait(0.1)
+        assert exc_info.value.reason == "preempted"
+        assert exc_info.value.retry_after >= 1.0
+        # and a batch arrival into the still-full queue is rejected
+        # (nothing strictly lower-ranked to evict)
+        with pytest.raises(QueueFull):
+            batcher.submit(np.ones((8, 2)), trace_ctx=None,
+                           tenant="g2", priority="batch",
+                           over_quota=True)
+        release.set()
+        assert vip.wait(10.0).shape == (8, 2)
+    finally:
+        release.set()
+        batcher.close(drain=False, timeout=5.0)
+
+
+# -- the shed controller ----------------------------------------------------
+
+
+def test_shed_controller_levels_and_hysteresis():
+    clock = _FakeClock()
+    shed = ShedController(
+        burn_threshold=14.4, queue_wait_target_s=0.1,
+        depth_frac_target=0.5, hold_seconds=2.0, clock=clock,
+    )
+    assert shed.level() == 0
+    assert shed.decide("batch", True) is None
+    # pressure without burn → level 1: over-quota batch sheds
+    shed.note_signals(burn=0.0, queue_wait_s=0.5, depth_frac=0.0)
+    assert shed.level() == 1
+    assert shed.decide("batch", True) == "over_quota_batch"
+    assert shed.decide("batch", False) is None      # in-quota: never
+    assert shed.decide("interactive", True) is None  # level 2 only
+    # pressure AND fast burn → level 2: all over-quota sheds
+    shed.note_signals(burn=20.0, queue_wait_s=0.5, depth_frac=0.0)
+    assert shed.level() == 2
+    assert shed.decide("interactive", True) == "over_quota"
+    assert shed.decide("interactive", False) is None  # in-quota: never
+    # healthy signals de-escalate only after the hold
+    shed.note_signals(burn=0.0, queue_wait_s=0.0, depth_frac=0.0)
+    assert shed.level() == 2
+    clock.advance(1.0)
+    shed.note_signals(burn=0.0, queue_wait_s=0.0, depth_frac=0.0)
+    assert shed.level() == 2  # hold not elapsed
+    clock.advance(1.5)
+    shed.note_signals(burn=0.0, queue_wait_s=0.0, depth_frac=0.0)
+    assert shed.level() == 0
+    # disabled controller never sheds
+    off = ShedController(enabled=False, clock=clock)
+    off.note_signals(burn=100.0, queue_wait_s=10.0, depth_frac=1.0)
+    assert off.level() == 0 and off.decide("batch", True) is None
+
+
+# -- engine-level fairness --------------------------------------------------
+
+
+def _engine(shed=None, **kw):
+    registry = ModelRegistry()
+    registry.register("fair_m", _SlowModel(kw.pop("delay", 0.002)))
+    eng = ServeEngine(
+        registry, max_batch_rows=8, max_wait_ms=1.0, retries=0,
+        shed=shed, **kw,
+    )
+    return eng
+
+
+def test_starvation_greedy_10x_quota_compliant_availability():
+    """The satellite acceptance: a greedy tenant at ~10x its quota
+    never drops the compliant tenant's availability below the bar."""
+    eng = _engine(
+        shed=_forced_shed_controller(),
+        tenant_quotas={"greedy": (1.0, 1.0)},  # any flood is 10x+ over
+    )
+    try:
+        stop = threading.Event()
+        greedy_counts = {"ok": 0, "shed": 0, "other": 0}
+        lock = threading.Lock()
+
+        def greedy_client():
+            while not stop.is_set():
+                try:
+                    eng.predict("fair_m", np.ones((4, 2)),
+                                tenant="greedy", priority="batch")
+                    with lock:
+                        greedy_counts["ok"] += 1
+                except ShedLoad:
+                    with lock:
+                        greedy_counts["shed"] += 1
+                except Exception:
+                    with lock:
+                        greedy_counts["other"] += 1
+                time.sleep(0.001)
+
+        workers = [threading.Thread(target=greedy_client, daemon=True)
+                   for _ in range(4)]
+        for w in workers:
+            w.start()
+        served = 0
+        for _ in range(30):
+            out = eng.predict("fair_m", np.ones((2, 2)),
+                              tenant="compliant", priority="interactive")
+            assert out.shape == (2, 2)
+            served += 1
+        stop.set()
+        for w in workers:
+            w.join(5.0)
+        assert served == 30  # compliant availability 1.0
+        assert greedy_counts["shed"] > 0   # the flood absorbed shedding
+        assert greedy_counts["other"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_shed_audit_span_lands_in_request_trace_tree():
+    eng = _engine(shed=_forced_shed_controller(),
+                  tenant_quotas={"g": (1.0, 1.0)})
+    try:
+        # drain g's one-token bucket so the flood below is over-quota
+        with pytest.raises(ShedLoad) as exc_info:
+            ctx = tracectx.new_context()
+            with tracectx.activate(ctx):
+                eng.predict("fair_m", np.ones((4, 2)),
+                            tenant="g", priority="batch")
+                # first call may be in-quota; push until the shed
+                eng.predict("fair_m", np.ones((4, 2)),
+                            tenant="g", priority="batch")
+        assert exc_info.value.retry_after >= 1.0
+        tree = spans_mod.assemble_trace(ctx.trace_id)
+
+        def find(nodes, name):
+            for node in nodes:
+                if node["name"] == name:
+                    return node
+                hit = find(node.get("children", []), name)
+                if hit is not None:
+                    return hit
+            return None
+
+        audit = find(tree["spans"], "serve:admission")
+        assert audit is not None, (
+            f"no serve:admission audit span in {tree}")
+        assert audit["args"]["decision"] == "shed"
+        assert audit["args"]["tenant"] == "g"
+        assert "retry_after" in audit["args"]
+        # the audit nests under the request span — attributable per
+        # request, not a floating orphan
+        request = find(tree["spans"], "serve:request:fair_m")
+        assert request is not None
+    finally:
+        eng.shutdown()
+
+
+def test_fast_shed_preparse_probe():
+    eng = _engine(shed=_forced_shed_controller(),
+                  tenant_quotas={"g": (0.000001, 0.000001)})
+    try:
+        eng.admission._bucket_for("g").take(1)  # dry the bucket
+        exc = eng.fast_shed("g", "batch")
+        assert isinstance(exc, ShedLoad) and exc.tenant == "g"
+        # in-quota (unlimited default tenant): full path decides
+        assert eng.fast_shed("someone", "batch") is None
+        # interactive only sheds at level 2 — forced controller IS at 2
+        assert isinstance(eng.fast_shed("g", "interactive"), ShedLoad)
+    finally:
+        eng.shutdown()
+
+
+def test_no_shedding_for_default_traffic_and_kill_switches(monkeypatch):
+    # default traffic (interactive, unlimited quota) is never shed even
+    # at a forced level-2 controller
+    eng = _engine(shed=_forced_shed_controller())
+    try:
+        for _ in range(5):
+            assert eng.predict("fair_m", np.ones((2, 2))).shape == (2, 2)
+    finally:
+        eng.shutdown()
+    # SCHED=fifo restores the FIFO queue discipline
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SERVE_SCHED", "fifo")
+    assert fair_scheduling_from_env() is False
+    eng2 = _engine()
+    try:
+        assert eng2.fair_scheduling is False
+        eng2.predict("fair_m", np.ones((2, 2)))
+        (batcher,) = eng2._batchers.values()
+        assert isinstance(batcher._queue, FifoQueue)
+    finally:
+        eng2.shutdown()
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SERVE_SCHED", "fair")
+    assert fair_scheduling_from_env() is True
+    # SHED=0 disables the controller entirely
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SERVE_SHED", "0")
+    assert ShedController().enabled is False
+
+
+# -- the HTTP overload surface ----------------------------------------------
+
+
+def _post(base, payload, headers=None):
+    body = json.dumps(payload).encode()
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(f"{base}/predict", data=body, headers=h)
+    try:
+        resp = urllib.request.urlopen(req, timeout=30.0)
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, dict(exc.headers), json.loads(exc.read())
+
+
+def _get(base, path):
+    try:
+        resp = urllib.request.urlopen(f"{base}{path}", timeout=10.0)
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, dict(exc.headers), json.loads(exc.read())
+
+
+def test_http_shed_surface_retry_after_readyz_and_error_label():
+    eng = _engine(shed=_forced_shed_controller(),
+                  tenant_quotas={"g": (0.000001, 0.000001)})
+    server = start_serve_server(eng)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        eng.admission._bucket_for("g").take(1)  # dry bucket
+        rows = [[1.0, 2.0]] * 4
+        # a shed: 503 + Retry-After + shed:true (distinct from 429)
+        status, headers, payload = _post(
+            base, {"model": "fair_m", "rows": rows},
+            headers={"X-Tenant": "g", "X-Priority": "batch"})
+        assert status == 503
+        assert payload["shed"] is True and payload["retryable"] is True
+        assert int(headers["Retry-After"]) >= 1
+        # body fields work too (no headers)
+        status, headers, payload = _post(
+            base, {"model": "fair_m", "rows": rows,
+                   "tenant": "g", "priority": "batch"})
+        assert status == 503 and payload["shed"] is True
+        # compliant interactive traffic still serves
+        status, _h, payload = _post(base, {"model": "fair_m",
+                                           "rows": rows})
+        assert status == 200
+        # /healthz stays 200 but reports the posture; /readyz drains
+        status, _h, health = _get(base, "/healthz")
+        assert status == 200 and health["status"] == "shedding"
+        assert health["shed_level"] == 2
+        status, headers, ready = _get(base, "/readyz")
+        assert status == 503 and ready["status"] == "shedding"
+        assert not ready["ready"]
+        assert int(headers["Retry-After"]) >= 1
+        # the shed is a DISTINCT error label + admission decision series
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert 'error="load_shed"' in text
+        assert 'decision="shed"' in text
+        assert "sparkml_serve_shed_level 2" in text
+        # /debug/slo carries the overload section
+        _s, _h, slo = _get(base, "/debug/slo")
+        assert slo["overload"]["shed"]["level"] == 2
+        assert "g" in slo["overload"]["tenants"]
+    finally:
+        server.shutdown()
+        eng.shutdown()
+
+
+def test_readyz_recovers_without_predict_traffic():
+    """A drained replica must cool down on its PROBES: once a load
+    balancer honors the shedding 503 and predict traffic stops,
+    nothing else would ever run the controller's de-escalation
+    timeline — /readyz reads refresh it, so the replica re-enters
+    rotation instead of answering 503 forever."""
+    shed = ShedController(refresh_seconds=0.0, hold_seconds=0.05)
+    eng = _engine(shed=shed)
+    server = start_serve_server(eng)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        shed.note_signals(burn=100.0, queue_wait_s=10.0, depth_frac=1.0)
+        status, _h, _p = _get(base, "/readyz")
+        assert status == 503
+        # NO predict traffic from here on — only probes. The engine is
+        # idle (healthy signals), so probe-driven refreshes walk the
+        # hold down and readiness returns.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            status, _h, ready = _get(base, "/readyz")
+            if status == 200:
+                break
+            time.sleep(0.05)
+        assert status == 200 and ready["ready"] is True
+    finally:
+        server.shutdown()
+        eng.shutdown()
+
+
+def test_http_readyz_ready_when_healthy():
+    eng = _engine()
+    server = start_serve_server(eng)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        status, _h, ready = _get(base, "/readyz")
+        assert status == 200 and ready["ready"] is True
+        status, _h, health = _get(base, "/healthz")
+        assert health["status"] == "ok"
+    finally:
+        server.shutdown()
+        eng.shutdown()
+
+
+def test_http_queue_full_gets_retry_after():
+    release = threading.Event()
+    started = threading.Event()
+
+    class _Blocking:
+        def transform(self, matrix):
+            started.set()
+            release.wait(10.0)
+            return np.asarray(matrix)
+
+    registry = ModelRegistry()
+    registry.register("blk", _Blocking())
+    eng = ServeEngine(registry, max_batch_rows=4, max_wait_ms=1.0,
+                      max_queue_depth=1, retries=0,
+                      fair_scheduling=False)
+    server = start_serve_server(eng)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        rows = [[1.0, 2.0]] * 4
+        hangers = []
+
+        def bg():
+            _post(base, {"model": "blk", "rows": rows})
+
+        for _ in range(2):  # one in flight + one queued
+            t = threading.Thread(target=bg, daemon=True)
+            t.start()
+            hangers.append(t)
+        assert started.wait(5.0)
+        # wait until the SECOND hanger actually occupies the queue slot
+        # (worker blocked in the first) — only then is the queue full
+        deadline = time.monotonic() + 5.0
+        while eng.queue_depth() < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.queue_depth() >= 1
+        status, headers, _p = _post(base, {"model": "blk", "rows": rows})
+        assert status == 429
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        release.set()
+        for t in hangers:
+            t.join(5.0)
+        server.shutdown()
+        eng.shutdown()
+
+
+# -- rule 10 ----------------------------------------------------------------
+
+
+def _checker():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_instrumentation as ci
+    finally:
+        sys.path.pop(0)
+    return ci
+
+
+def test_rule10_accepts_current_admission_and_scheduler():
+    ci = _checker()
+    for path in ci.ADMISSION_FILES:
+        assert list(ci.check_admission_decisions(path)) == [], path
+
+
+def test_rule10_rejects_silent_decisions(tmp_path):
+    ci = _checker()
+    bad = tmp_path / "bad_admission.py"
+    bad.write_text(
+        "class C:\n"
+        "    def admit(self, req):\n"
+        "        raise ShedLoad('silently')  # REJECT: no accounting\n"
+        "    def evict(self, req):\n"
+        "        req.set_error(ValueError('x'))  # REJECT: silent\n"
+        "    def full(self):\n"
+        "        raise QueueFull('nope')  # REJECT\n"
+    )
+    offenders = list(ci.check_admission_decisions(str(bad)))
+    assert len(offenders) == 3
+    assert all("silent drop" in why for _ln, why in offenders)
+
+
+def test_rule10_accepts_counted_and_audited_decisions(tmp_path):
+    ci = _checker()
+    good = tmp_path / "good_admission.py"
+    good.write_text(
+        "class C:\n"
+        "    def admit(self, req):\n"
+        "        self._m.inc(tenant='t', decision='shed')\n"
+        "        raise ShedLoad('counted')\n"
+        "    def evict(self, req):\n"
+        "        record_event('serve:admission', 0, 1, decision='shed')\n"
+        "        req.set_error(ValueError('x'))\n"
+        "    def other(self):\n"
+        "        raise ValueError('not a decision exception')\n"
+    )
+    assert list(ci.check_admission_decisions(str(good))) == []
