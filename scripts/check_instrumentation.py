@@ -75,6 +75,15 @@ Three rule families:
    re-serializes all three, and nothing else would fail — latency
    would just quietly double. This rule makes that edit impossible to
    ship unnoticed.
+11. over ``serve/server.py`` and ``serve/wire.py`` (the wire boundary):
+   request-body decoding in the HTTP front end must route through the
+   ``serve/wire.py`` decoders — a bare ``json.loads(...)`` call in
+   ``serve/server.py`` is rejected (handler code parsing bodies by hand
+   skips the negotiated binary format AND the parse-phase latency
+   accounting) — and every ``decode_*`` function in ``serve/wire.py``
+   must ``.observe(...)`` the parse latency: the protocol cost must
+   stay a measured number, or the binary-vs-JSON win silently rots
+   into an assertion.
 10. over ``serve/admission.py`` and ``serve/scheduler.py`` (the
    multi-tenant admission/shed boundary): every **decision path** — a
    ``raise`` of a decision exception (``ShedLoad`` / ``QueueFull`` /
@@ -602,6 +611,93 @@ def check_admission_decisions(path: str):
     yield from visit(tree, None)
 
 
+# rule 11: the wire boundary — server body decoding must route through
+# serve/wire.py, whose decoders must record the parse-phase latency.
+SERVER_FILE = os.path.join(
+    REPO, "spark_rapids_ml_tpu", "serve", "server.py"
+)
+WIRE_FILE = os.path.join(
+    REPO, "spark_rapids_ml_tpu", "serve", "wire.py"
+)
+
+
+def _json_aliases(tree: ast.Module):
+    """Names the module binds to the json module (``import json``,
+    ``import json as j``) — aliased ``j.loads`` can't evade the check."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "json":
+                    aliases.add(a.asname or a.name)
+    return aliases or {"json"}
+
+
+def _json_loads_names(tree: ast.Module):
+    """Bare names bound via ``from json import loads [as x]``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "json":
+            for a in node.names:
+                if a.name == "loads":
+                    names.add(a.asname or a.name)
+    return names
+
+
+def check_server_body_decoding(path: str):
+    """Rule 11a: yield (lineno, description) for every ``json.loads``
+    call in ``serve/server.py`` — request bodies must decode through
+    ``serve.wire`` (which negotiates the binary format and records the
+    parse-phase latency), never by hand in handler code."""
+    tree = ast.parse(open(path).read(), filename=path)
+    aliases = _json_aliases(tree)
+    bare = _json_loads_names(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        offender = (
+            (isinstance(func, ast.Attribute) and func.attr == "loads"
+             and isinstance(func.value, ast.Name)
+             and func.value.id in aliases)
+            or (isinstance(func, ast.Name) and func.id in bare)
+        )
+        if offender:
+            yield (node.lineno,
+                   "bare json.loads on a request body (route through "
+                   "serve/wire.py decode_body — the wire boundary "
+                   "negotiates the binary format and records the "
+                   "parse-phase latency)")
+
+
+def check_wire_parse_metrics(path: str):
+    """Rule 11b: yield (lineno, description) for every module-level
+    ``decode_*`` function in ``serve/wire.py`` that never
+    ``.observe(...)``s — a decoder that stops recording the parse stage
+    turns the measured protocol win back into an assertion."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        # the leaf REQUEST-body decoders (decode_request,
+        # decode_json_request, future decode_*_request); decode_body is
+        # the dispatcher and decode_response is the client side — no
+        # parse stage of their own to record
+        if not (node.name.startswith("decode_")
+                and node.name.endswith("request")):
+            continue
+        observes = any(
+            isinstance(n, ast.Call) and _call_name(n) == "observe"
+            for n in ast.walk(node)
+        )
+        if not observes:
+            yield (node.lineno,
+                   f"{node.name} decodes a request body without an "
+                   ".observe(...) of the parse-phase latency "
+                   "(sparkml_serve_parse_seconds) — the wire cost must "
+                   "stay measured")
+
+
 def library_files():
     """Every .py under the package, minus the exempt helper dirs."""
     out = []
@@ -685,6 +781,14 @@ def main() -> int:
         rel = os.path.relpath(path, REPO)
         for lineno, why in check_admission_decisions(path):
             offenders.append(f"{rel}:{lineno} {why}")
+    if os.path.exists(SERVER_FILE):
+        rel = os.path.relpath(SERVER_FILE, REPO)
+        for lineno, why in check_server_body_decoding(SERVER_FILE):
+            offenders.append(f"{rel}:{lineno} {why}")
+    if os.path.exists(WIRE_FILE):
+        rel = os.path.relpath(WIRE_FILE, REPO)
+        for lineno, why in check_wire_parse_metrics(WIRE_FILE):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -703,7 +807,8 @@ def main() -> int:
         f"wall-clock calls; serve/batching.py host-syncs only in its "
         f"designated completion step; {len(admission_files)} "
         f"admission/scheduler module(s) with every shed/admission "
-        f"decision counted or audit-spanned"
+        f"decision counted or audit-spanned; request-body decoding "
+        f"routed through serve/wire.py with the parse stage measured"
     )
     return 0
 

@@ -1,4 +1,5 @@
-"""Shared ``ServingProgram`` construction for the pipelined serving hook.
+"""Shared ``ServingProgram`` construction for the pipelined serving hook,
+plus the **fused whole-pipeline** composition layer.
 
 Every model exposing ``serving_transform_program`` needs the same
 scaffolding: resolve the device and transform dtype, decide whether the
@@ -15,29 +16,78 @@ receive pre-cast weights, the int8 variants receive pre-quantized
 (int8, scale) pairs (``ops.quantize.quantize_symmetric_host``) — the
 per-batch kernels quantize/cast only the batch operand, never the
 constant weights.
+
+**Fused pipelines** (the Flare transplant, arxiv 1703.08219): a
+multi-stage ``PipelineModel.transform`` pays one stage → dispatch →
+complete cycle — one host round trip — PER STAGE. Models additionally
+expose ``serving_stage(precision=...)`` returning a ``ServingStage``:
+the stage's pure, UN-jitted device function plus its device-staged
+constant weights. ``build_fused_pipeline_program`` composes the whole
+chain inside ONE ``tracked_jit`` XLA program (scaler → PCA → classifier
+as a single module — XLA fuses the elementwise stages straight into the
+GEMMs), so a pipelined predict dispatches once per batch no matter how
+many stages the pipeline holds. ``run_staged_pipeline`` is the
+N-round-trip reference the parity suite holds the fused program
+bit-equal to at f32/f64: each stage as its OWN jitted program with a
+host sync between stages — same arithmetic, N dispatches instead of 1.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 
-def resolve_serving_context(model) -> Tuple[object, object, bool]:
+class ServingStage(NamedTuple):
+    """One model's composable contribution to a fused pipeline program.
+
+    ``fn(x_dev, *weights) → y_dev`` is the PURE, un-jitted device
+    function (jitting happens once, around the whole composed chain);
+    ``weights`` are the device-staged constants for the requested
+    precision. ``terminal`` marks output-typed stages (cluster labels,
+    class probabilities) that can only sit LAST in a fused chain;
+    ``fetch_dtype`` is the host dtype the stage's output carries when it
+    IS last (matching the staged loop's output column exactly).
+    """
+
+    fn: Callable
+    weights: Tuple
+    algo: str
+    terminal: bool = False
+    fetch_dtype: Optional[np.dtype] = None
+
+
+def resolve_serving_context(model=None) -> Tuple[object, object, bool]:
     """``(device, dtype, donate)`` for a model's serving program: the
     model's resolved device and transform dtype, plus whether the
     donated kernel twin should be used (off-CPU only — on CPU donation
-    is a no-op that warns)."""
+    is a no-op that warns). Tolerant of models without device params
+    (host-stat scalers, ``PipelineModel`` itself): missing getters fall
+    back to the default device and ``auto`` dtype."""
     from spark_rapids_ml_tpu.models.pca import (
         _resolve_device,
         _resolve_dtype,
     )
 
-    device = _resolve_device(model.getDeviceId())
-    dtype = _resolve_dtype(model.getDtype())
+    get_dev = getattr(model, "getDeviceId", None)
+    get_dt = getattr(model, "getDtype", None)
+    device = _resolve_device(get_dev() if callable(get_dev) else -1)
+    dtype = _resolve_dtype(get_dt() if callable(get_dt) else "auto")
     donate = getattr(device, "platform", "cpu") != "cpu"
     return device, dtype, donate
+
+
+def resolve_pipeline_context(stages) -> Tuple[object, object, bool]:
+    """The shared ``(device, dtype, donate)`` a fused pipeline stages
+    every weight under: the first stage carrying device params decides
+    (a pipeline mixing device preferences is already incoherent for ONE
+    XLA program); an all-host-stat chain falls back to the defaults."""
+    for stage in stages:
+        if callable(getattr(stage, "getDeviceId", None)) and callable(
+                getattr(stage, "getDtype", None)):
+            return resolve_serving_context(stage)
+    return resolve_serving_context(None)
 
 
 def build_serving_program(
@@ -88,3 +138,146 @@ def build_serving_program(
     return ServingProgram(put=put, run=run, fetch=fetch,
                           dtype=np.dtype(dtype), algo=algo,
                           precision=precision)
+
+
+def build_host_stat_stage(model, fn, host_weights, algo: str,
+                          device, dtype) -> ServingStage:
+    """Shared ``serving_stage`` assembly for the host-stat scaler /
+    feature-transformer families: the per-feature constants staged to
+    the device once, the elementwise body left un-jitted for the
+    fused-pipeline composer. Precision variants are meaningless for
+    elementwise stages (the GEMM stages carry them), so every precision
+    shares the native body. Float constants stage at the chain dtype;
+    integer index arrays and boolean masks keep their own dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    if device is None or dtype is None:
+        device, dtype, _ = resolve_serving_context(model)
+    weights = tuple(
+        jax.device_put(
+            jnp.asarray(w, dtype=dtype if np.issubdtype(
+                np.asarray(w).dtype, np.floating) else None),
+            device)
+        for w in host_weights
+    )
+    return ServingStage(fn=fn, weights=weights, algo=algo,
+                        fetch_dtype=np.dtype(np.float64))
+
+
+# -- whole-pipeline fusion ---------------------------------------------------
+
+
+def collect_pipeline_stages(stages, precision: str, *, device, dtype,
+                            ) -> Optional[List[ServingStage]]:
+    """Every stage's ``ServingStage`` at ``precision`` under the shared
+    device/dtype, or None when the chain is not fusable: a stage without
+    the hook (host-path models, un-fusable families), a hook declining
+    (returning None), or an output-typed (``terminal``) stage anywhere
+    but last — labels cannot feed a downstream transformer."""
+    specs: List[ServingStage] = []
+    last = len(stages) - 1
+    for i, stage in enumerate(stages):
+        hook = getattr(stage, "serving_stage", None)
+        if not callable(hook):
+            return None
+        spec = hook(precision=precision, device=device, dtype=dtype)
+        if spec is None:
+            return None
+        if spec.terminal and i < last:
+            return None
+        specs.append(spec)
+    return specs or None
+
+
+def build_fused_pipeline_program(
+    *,
+    device,
+    dtype,
+    stages: List[ServingStage],
+    precision: str,
+    donate: bool,
+    algo: str = "pipeline",
+):
+    """ONE ``tracked_jit`` XLA program for a whole fused stage chain.
+
+    The composed function threads the batch through every stage body
+    inside a single jit scope — the compiler sees the full dataflow and
+    fuses elementwise stages into their neighboring GEMMs, and the
+    serving loop pays ONE dispatch/complete cycle per batch instead of
+    one per stage. Stage weights are passed flat (device-resident, zero
+    transfer per call); the staged batch buffer is donated off-CPU
+    exactly like the single-model serve kernels (a retry always
+    re-stages from host rows).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.obs.serving import ServingProgram
+    from spark_rapids_ml_tpu.obs.xprof import tracked_jit
+
+    fns = tuple(s.fn for s in stages)
+    arities = tuple(len(s.weights) for s in stages)
+    flat_weights = tuple(w for s in stages for w in s.weights)
+    fetch_dtype = stages[-1].fetch_dtype
+
+    def _fused(x, *flat):
+        i = 0
+        for fn, k in zip(fns, arities):
+            x = fn(x, *flat[i:i + k])
+            i += k
+        return x
+
+    label = "pipeline_fused_" + "_".join(s.algo for s in stages) \
+            + f"_{precision}"
+    kernel = tracked_jit(
+        _fused, label=label,
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def put(matrix):
+        return jax.device_put(jnp.asarray(matrix, dtype=dtype), device)
+
+    def run(x_dev):
+        return kernel(x_dev, *flat_weights)
+
+    def fetch(out_dev):
+        out = np.asarray(out_dev)
+        if fetch_dtype is None:
+            return out
+        return out.astype(fetch_dtype, copy=False)
+
+    return ServingProgram(put=put, run=run, fetch=fetch,
+                          dtype=np.dtype(dtype), algo=algo,
+                          precision=precision)
+
+
+def run_staged_pipeline(model, x, precision: str = "native") -> np.ndarray:
+    """The N-round-trip reference: each composable stage as its OWN
+    jitted program with a host sync between stages — the per-stage
+    dispatch/complete loop the fused program replaces, built from the
+    SAME stage bodies so the parity suite can hold fused bit-equal to
+    staged at f32/f64. Raises ``ValueError`` when the pipeline is not
+    fusable (mirrors the hook declining)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.obs.xprof import tracked_jit
+
+    stages = getattr(model, "stages", None) or []
+    device, dtype, _donate = resolve_pipeline_context(stages)
+    specs = collect_pipeline_stages(stages, precision,
+                                    device=device, dtype=dtype)
+    if not specs:
+        raise ValueError("pipeline has no fusable stage chain")
+    out = np.asarray(x)
+    for i, spec in enumerate(specs):
+        kernel = tracked_jit(
+            spec.fn, label=f"pipeline_staged_{spec.algo}_{i}_{precision}")
+        x_dev = jax.device_put(jnp.asarray(out, dtype=out.dtype
+                                           if i else dtype), device)
+        # the host sync between stages IS the point of comparison
+        out = np.asarray(kernel(x_dev, *spec.weights))
+    if specs[-1].fetch_dtype is not None:
+        out = out.astype(specs[-1].fetch_dtype, copy=False)
+    return out

@@ -13,6 +13,13 @@ Fitting is one pass of per-column extrema — the reductions are trivial,
 so these run as NumPy host ops regardless of backend (the same decision
 Spark makes: its scalers are Summarizer passes, not BLAS work). All carry
 the standard persistence surface.
+
+For SERVING, each fitted scaler/transformer additionally exposes a
+``serving_stage`` hook (``models._serving.ServingStage``): the same
+elementwise expression as its sync transform, as a pure jax body with
+the fitted statistics staged to the device once — what
+``PipelineModel.serving_transform_program`` composes into ONE fused XLA
+program so a scaler stage costs zero extra host round trips.
 """
 
 from __future__ import annotations
@@ -30,6 +37,16 @@ from spark_rapids_ml_tpu.models.params import (
 )
 from spark_rapids_ml_tpu.utils.timing import PhaseTimer
 from spark_rapids_ml_tpu.obs import observed_transform
+
+
+def _stage(model, fn, host_weights, algo: str, device, dtype):
+    """The shared host-stat stage assembly (``models._serving
+    .build_host_stat_stage``), imported lazily so the scalers stay
+    importable without jax."""
+    from spark_rapids_ml_tpu.models._serving import build_host_stat_stage
+
+    return build_host_stat_stage(model, fn, host_weights, algo,
+                                 device, dtype)
 
 
 class MinMaxScalerParams(HasInputCol, HasOutputCol):
@@ -117,6 +134,29 @@ class MinMaxScalerModel(MinMaxScalerParams):
         )
         return frame.with_column(self.getOutputCol(), scaled)
 
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Fused-pipeline stage: the sync transform's exact expression
+        — ``(x − min)/safe·(hi−lo) + lo``, constant columns to the
+        range midpoint — over device-staged extrema."""
+        if self.original_min is None:
+            return None
+        import jax.numpy as jnp
+
+        lo_t, hi_t = float(self.getMin()), float(self.getMax())
+        spread = self.original_max - self.original_min
+        safe = np.where(spread > 0, spread, 1.0)
+        mid = 0.5 * (lo_t + hi_t)
+
+        def fn(x, lo, safe_w, mask):
+            scaled = (x - lo[None, :]) / safe_w[None, :] \
+                * (hi_t - lo_t) + lo_t
+            return jnp.where(mask[None, :], scaled, mid)
+
+        return _stage(self, fn,
+                      (self.original_min, safe, spread > 0),
+                      "min_max_scaler", device, dtype)
+
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_minmax_model
 
@@ -193,6 +233,19 @@ class MaxAbsScalerModel(MaxAbsScalerParams):
         denom = np.where(self.max_abs > 0, self.max_abs, 1.0)
         return frame.with_column(self.getOutputCol(), x / denom[None, :])
 
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Fused-pipeline stage: ``x / denom`` over the device-staged
+        per-feature divisor (all-zero columns pass through)."""
+        if self.max_abs is None:
+            return None
+        denom = np.where(self.max_abs > 0, self.max_abs, 1.0)
+
+        def fn(x, denom_w):
+            return x / denom_w[None, :]
+
+        return _stage(self, fn, (denom,), "max_abs_scaler", device, dtype)
+
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_maxabs_model
 
@@ -229,6 +282,27 @@ class Normalizer(HasInputCol, HasOutputCol, Params):
             self.getOutputCol(), x / denom[:, None]
         )
 
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Fused-pipeline stage: per-row p-norm scaling, stateless (no
+        weights) — the norm reduction fuses into the surrounding
+        program."""
+        import jax.numpy as jnp
+
+        p = float(self.getP())
+
+        def fn(x):
+            if np.isinf(p):
+                norms = jnp.abs(x).max(axis=1)
+            else:
+                norms = jnp.power(
+                    jnp.power(jnp.abs(x), p).sum(axis=1), 1.0 / p
+                )
+            denom = jnp.where(norms > 0, norms, 1.0)
+            return x / denom[:, None]
+
+        return _stage(self, fn, (), "normalizer", device, dtype)
+
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_params
 
@@ -259,6 +333,18 @@ class Binarizer(HasInputCol, HasOutputCol, Params):
             self.getOutputCol(),
             (x > float(self.getThreshold())).astype(np.float64),
         )
+
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Fused-pipeline stage: elementwise thresholding, stateless —
+        the 0/1 output stays in the chain dtype so downstream GEMM
+        stages compose without a cast."""
+        threshold = float(self.getThreshold())
+
+        def fn(x):
+            return (x > threshold).astype(x.dtype)
+
+        return _stage(self, fn, (), "binarizer", device, dtype)
 
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_params
@@ -358,6 +444,37 @@ class RobustScalerModel(RobustScalerParams):
             denom = np.where(self.qrange > 0, self.qrange, 1.0)
             out = out / denom[None, :]
         return frame.with_column(self.getOutputCol(), out)
+
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Fused-pipeline stage: median-center / quantile-range-scale
+        over device-staged statistics, same flag semantics as the sync
+        transform."""
+        if self.median is None:
+            return None
+        centering = bool(self.get_or_default("withCentering"))
+        scaling = bool(self.get_or_default("withScaling"))
+        weights = []
+        if centering:
+            weights.append(self.median)
+        if scaling:
+            weights.append(np.where(self.qrange > 0, self.qrange, 1.0))
+
+        if centering and scaling:
+            def fn(x, median, denom):
+                return (x - median[None, :]) / denom[None, :]
+        elif centering:
+            def fn(x, median):
+                return x - median[None, :]
+        elif scaling:
+            def fn(x, denom):
+                return x / denom[None, :]
+        else:
+            def fn(x):
+                return x
+
+        return _stage(self, fn, tuple(weights), "robust_scaler",
+                      device, dtype)
 
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_robust_model

@@ -449,6 +449,23 @@ class ElementwiseProduct(HasInputCol, HasOutputCol, Params):
                 f"scalingVec length {s.shape[0]} != width {x.shape[1]}")
         return frame.with_column(self.getOutputCol(), x * s[None, :])
 
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Fused-pipeline stage (``models._serving.ServingStage``): the
+        Hadamard product with the device-staged scaling vector."""
+        scaling = self.get_or_default("scalingVec")
+        if scaling is None:
+            return None
+        from spark_rapids_ml_tpu.models._serving import build_host_stat_stage
+
+        s = np.asarray(scaling, dtype=np.float64).reshape(-1)
+
+        def fn(x, s_w):
+            return x * s_w[None, :]
+
+        return build_host_stat_stage(self, fn, (s,),
+                                     "elementwise_product", device, dtype)
+
 
 @_persistable
 class VectorSlicer(HasInputCol, HasOutputCol, Params):
@@ -477,6 +494,23 @@ class VectorSlicer(HasInputCol, HasOutputCol, Params):
             raise ValueError(
                 f"index out of range for width {x.shape[1]}")
         return frame.with_column(self.getOutputCol(), x[:, idx])
+
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Fused-pipeline stage: the column gather, with the index
+        vector staged to the device (a gather fuses for free)."""
+        indices = self.get_or_default("indices")
+        if not indices:
+            return None
+        from spark_rapids_ml_tpu.models._serving import build_host_stat_stage
+
+        idx = np.asarray(indices, dtype=np.int64)
+
+        def fn(x, idx_w):
+            return x[:, idx_w]
+
+        return build_host_stat_stage(self, fn, (idx,), "vector_slicer",
+                                     device, dtype)
 
 
 def _poly_index_sets(n_features: int, degree: int) -> List[List[int]]:
@@ -554,6 +588,21 @@ class _SelectorModelBase(HasInputCol, HasOutputCol, Params):
         x = frame.vectors_as_matrix(self.getInputCol())
         return frame.with_column(
             self.getOutputCol(), x[:, self.selected_features])
+
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Fused-pipeline stage: the fitted-selection column gather
+        (shared by the variance-threshold and chi-square selectors)."""
+        if self.selected_features is None:
+            return None
+        from spark_rapids_ml_tpu.models._serving import build_host_stat_stage
+
+        def fn(x, idx_w):
+            return x[:, idx_w]
+
+        return build_host_stat_stage(
+            self, fn, (self.selected_features,), "feature_selector",
+            device, dtype)
 
     def save(self, path: str, overwrite: bool = False) -> None:
         from spark_rapids_ml_tpu.io.persistence import save_selector_model

@@ -396,6 +396,49 @@ class KMeansModel(KMeansParams):
             self.getPredictionCol(), labels.astype(np.int32).tolist()
         )
 
+    def _serving_weights(self, precision: str, device, dtype):
+        """Device-staged centers for one precision — shared by the
+        standalone serving program and the fused-pipeline stage hook."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
+
+        if precision == "bf16":
+            return (jax.device_put(jnp.asarray(
+                self.cluster_centers, dtype=jnp.bfloat16), device),)
+        if precision == "int8":
+            q, scale = quantize_symmetric_host(self.cluster_centers)
+            return (jax.device_put(jnp.asarray(q), device), scale)
+        return (jax.device_put(jnp.asarray(
+            self.cluster_centers, dtype=dtype), device),)
+
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Composable fused-pipeline stage: the un-jitted assignment
+        body + staged centers. TERMINAL — labels are output-typed and
+        cannot feed a downstream transformer."""
+        if self.cluster_centers is None or not self.getUseXlaDot():
+            return None
+        from spark_rapids_ml_tpu.models._serving import (
+            ServingStage,
+            resolve_serving_context,
+        )
+        from spark_rapids_ml_tpu.ops import kmeans_kernel as _kk
+
+        if device is None or dtype is None:
+            device, dtype, _ = resolve_serving_context(self)
+        body = _kk.SERVING_STAGE_BODIES.get(precision)
+        if body is None:
+            raise ValueError(f"unknown serving precision {precision!r}")
+        return ServingStage(
+            fn=body,
+            weights=self._serving_weights(precision, device, dtype),
+            algo="kmeans",
+            terminal=True,
+            fetch_dtype=np.dtype(np.int32),
+        )
+
     def serving_transform_program(self, precision: str = "native"):
         """Device-resident serving program for the pipelined batcher
         (``obs.serving.ServingProgram``): centers staged once, ``run``
@@ -404,26 +447,14 @@ class KMeansModel(KMeansParams):
         the completion-step sync. None for host-path models."""
         if self.cluster_centers is None or not self.getUseXlaDot():
             return None
-        import jax
-        import jax.numpy as jnp
-
         from spark_rapids_ml_tpu.models._serving import (
             build_serving_program,
             resolve_serving_context,
         )
         from spark_rapids_ml_tpu.ops import kmeans_kernel as _kk
-        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
 
         device, dtype, donate = resolve_serving_context(self)
-        if precision == "bf16":
-            weights = (jax.device_put(jnp.asarray(
-                self.cluster_centers, dtype=jnp.bfloat16), device),)
-        elif precision == "int8":
-            q, scale = quantize_symmetric_host(self.cluster_centers)
-            weights = (jax.device_put(jnp.asarray(q), device), scale)
-        else:
-            weights = (jax.device_put(jnp.asarray(
-                self.cluster_centers, dtype=dtype), device),)
+        weights = self._serving_weights(precision, device, dtype)
         return build_serving_program(
             device=device, dtype=dtype, algo="kmeans",
             precision=precision,

@@ -762,6 +762,54 @@ class LogisticRegressionModel(LogisticRegressionParams):
             proba = _sigmoid(z)
         return proba.astype(np.float64)
 
+    def _serving_weights(self, precision: str, device, dtype):
+        """Device-staged (coefficients, [scale,] intercept) for one
+        precision — shared by the standalone serving program and the
+        fused-pipeline stage hook."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
+
+        b_dev = jax.device_put(
+            jnp.asarray(self.intercept, dtype=dtype), device)
+        if precision == "bf16":
+            return (jax.device_put(jnp.asarray(
+                self.coefficients, dtype=jnp.bfloat16), device), b_dev)
+        if precision == "int8":
+            q, scale = quantize_symmetric_host(self.coefficients)
+            return (jax.device_put(jnp.asarray(q), device), scale, b_dev)
+        return (jax.device_put(jnp.asarray(
+            self.coefficients, dtype=dtype), device), b_dev)
+
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Composable fused-pipeline stage: the un-jitted σ(X·w + b)
+        body + staged weights. TERMINAL — probabilities are the
+        pipeline's answer, not a feature column. Binary models only."""
+        if (self.coefficient_matrix is not None
+                or self.coefficients is None
+                or not self.getUseXlaDot()):
+            return None
+        from spark_rapids_ml_tpu.models._serving import (
+            ServingStage,
+            resolve_serving_context,
+        )
+        from spark_rapids_ml_tpu.ops import logreg_kernel as _lk
+
+        if device is None or dtype is None:
+            device, dtype, _ = resolve_serving_context(self)
+        body = _lk.SERVING_STAGE_BODIES.get(precision)
+        if body is None:
+            raise ValueError(f"unknown serving precision {precision!r}")
+        return ServingStage(
+            fn=body,
+            weights=self._serving_weights(precision, device, dtype),
+            algo="logistic_regression",
+            terminal=True,
+            fetch_dtype=np.dtype(np.float64),
+        )
+
     def serving_transform_program(self, precision: str = "native"):
         """Device-resident serving program for the pipelined batcher
         (``obs.serving.ServingProgram``): σ(X·w + b) with the weights
@@ -772,29 +820,14 @@ class LogisticRegressionModel(LogisticRegressionParams):
                 or self.coefficients is None
                 or not self.getUseXlaDot()):
             return None
-        import jax
-        import jax.numpy as jnp
-
         from spark_rapids_ml_tpu.models._serving import (
             build_serving_program,
             resolve_serving_context,
         )
         from spark_rapids_ml_tpu.ops import logreg_kernel as _lk
-        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
 
         device, dtype, donate = resolve_serving_context(self)
-        b_dev = jax.device_put(
-            jnp.asarray(self.intercept, dtype=dtype), device)
-        if precision == "bf16":
-            weights = (jax.device_put(jnp.asarray(
-                self.coefficients, dtype=jnp.bfloat16), device), b_dev)
-        elif precision == "int8":
-            q, scale = quantize_symmetric_host(self.coefficients)
-            weights = (jax.device_put(jnp.asarray(q), device), scale,
-                       b_dev)
-        else:
-            weights = (jax.device_put(jnp.asarray(
-                self.coefficients, dtype=dtype), device), b_dev)
+        weights = self._serving_weights(precision, device, dtype)
         return build_serving_program(
             device=device, dtype=dtype, algo="logistic_regression",
             precision=precision,

@@ -669,6 +669,51 @@ class PCAModel(PCAParams):
                         out = x_host @ self.pc
         return frame.with_column(self.getOutputCol(), np.asarray(out, dtype=np.float64))
 
+    def _serving_weights(self, precision: str, device, dtype):
+        """Device-staged constant operands (the components) for one
+        precision — staged ONCE per program, shared by the standalone
+        serving program and the fused-pipeline stage hook."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
+
+        if precision == "bf16":
+            return (jax.device_put(
+                jnp.asarray(self.pc, dtype=jnp.bfloat16), device),)
+        if precision == "int8":
+            q, scale = quantize_symmetric_host(self.pc)
+            return (jax.device_put(jnp.asarray(q), device), scale)
+        return (jax.device_put(
+            jnp.asarray(self.pc, dtype=dtype), device),)
+
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """The composable fused-pipeline stage (``models._serving
+        .ServingStage``): the un-jitted projection body + device-staged
+        components, for ``PipelineModel.serving_transform_program`` to
+        compose into ONE XLA program with its neighbours. Projection is
+        float → float, so PCA may sit anywhere in a fused chain."""
+        if self.pc is None or not self.getUseXlaDot():
+            return None
+        from spark_rapids_ml_tpu.models._serving import (
+            ServingStage,
+            resolve_serving_context,
+        )
+        from spark_rapids_ml_tpu.ops import pca_kernel as _pk
+
+        if device is None or dtype is None:
+            device, dtype, _ = resolve_serving_context(self)
+        body = _pk.SERVING_STAGE_BODIES.get(precision)
+        if body is None:
+            raise ValueError(f"unknown serving precision {precision!r}")
+        return ServingStage(
+            fn=body,
+            weights=self._serving_weights(precision, device, dtype),
+            algo="pca",
+            fetch_dtype=np.dtype(np.float64),
+        )
+
     def serving_transform_program(self, precision: str = "native"):
         """The device-resident serving program for the pipelined
         micro-batcher (``obs.serving.ServingProgram``): components staged
@@ -683,26 +728,14 @@ class PCAModel(PCAParams):
         path."""
         if self.pc is None or not self.getUseXlaDot():
             return None
-        import jax
-        import jax.numpy as jnp
-
         from spark_rapids_ml_tpu.models._serving import (
             build_serving_program,
             resolve_serving_context,
         )
         from spark_rapids_ml_tpu.ops import pca_kernel as _pk
-        from spark_rapids_ml_tpu.ops.quantize import quantize_symmetric_host
 
         device, dtype, donate = resolve_serving_context(self)
-        if precision == "bf16":
-            weights = (jax.device_put(
-                jnp.asarray(self.pc, dtype=jnp.bfloat16), device),)
-        elif precision == "int8":
-            q, scale = quantize_symmetric_host(self.pc)
-            weights = (jax.device_put(jnp.asarray(q), device), scale)
-        else:
-            weights = (jax.device_put(
-                jnp.asarray(self.pc, dtype=dtype), device),)
+        weights = self._serving_weights(precision, device, dtype)
         return build_serving_program(
             device=device, dtype=dtype, algo="pca", precision=precision,
             kernels={

@@ -123,6 +123,98 @@ class PipelineModel(Params):
             df = stage.transform(df)
         return df
 
+    # -- serving ----------------------------------------------------------
+    #
+    # The staged loop above pays one host round trip per stage; the fused
+    # program below is the Flare transplant (arxiv 1703.08219): the whole
+    # chain compiled into ONE XLA module per (bucket, precision), so a
+    # pipelined predict does one stage/dispatch/complete cycle total.
+
+    def _last_stage_col(self, getter: str) -> str:
+        """Delegate an output-column getter to the LAST stage so
+        ``serve.engine.extract_output`` can resolve the pipeline's
+        answer column from a staged-loop frame result exactly as it
+        does for the terminal model served alone."""
+        if not self._stages:
+            raise AttributeError(f"empty pipeline has no {getter}")
+        fn = getattr(self._stages[-1], getter, None)
+        if not callable(fn):
+            raise AttributeError(
+                f"last stage {type(self._stages[-1]).__name__} has no "
+                f"{getter}")
+        return fn()
+
+    def getOutputCol(self) -> str:
+        return self._last_stage_col("getOutputCol")
+
+    def getProbabilityCol(self) -> str:
+        return self._last_stage_col("getProbabilityCol")
+
+    def getPredictionCol(self) -> str:
+        return self._last_stage_col("getPredictionCol")
+
+    def _chain_is_wired(self) -> bool:
+        """Whether each stage's input column is the PREVIOUS stage's
+        output column. The fused program composes stages positionally
+        (stage i+1 consumes stage i's device output) — a pipeline wired
+        any other way (a stage reading the RAW features past a scaler,
+        say) is semantically a DAG, not a chain, and must keep the
+        staged frame loop. Stages without the getters (raw-matrix
+        transformers) pass — they consume whatever flows in."""
+        for prev, nxt in zip(self._stages, self._stages[1:]):
+            get_out = getattr(prev, "getOutputCol", None)
+            get_in = getattr(nxt, "getInputCol", None)
+            if not (callable(get_out) and callable(get_in)):
+                continue
+            try:
+                if get_out() != get_in():
+                    return False
+            except Exception:
+                return False
+        return True
+
+    def serving_stages(self, precision: str = "native"):
+        """The per-stage ``ServingStage`` chain at ``precision`` under
+        one shared device/dtype, or None when any stage is not fusable
+        (no hook, hook declined, an output-typed stage mid-chain, or
+        column wiring that is not a head-to-tail chain)."""
+        from spark_rapids_ml_tpu.models._serving import (
+            collect_pipeline_stages,
+            resolve_pipeline_context,
+        )
+
+        if not self._stages or not self._chain_is_wired():
+            return None
+        device, dtype, donate = resolve_pipeline_context(self._stages)
+        specs = collect_pipeline_stages(self._stages, precision,
+                                        device=device, dtype=dtype)
+        if not specs:
+            return None
+        return device, dtype, donate, specs
+
+    def serving_transform_program(self, precision: str = "native"):
+        """ONE fused ``ServingProgram`` for the whole pipeline: every
+        stage's pure device function composed inside a single
+        ``tracked_jit`` XLA program (weights staged once, batch buffer
+        donated off-CPU), registered with the micro-batcher's pipeline
+        path exactly like a single-model program — warmup precompiles
+        the fused bucket × precision ladder, and the bf16/int8 variants
+        compose through the stage hooks. Returns None when any stage
+        cannot compose — the engine then keeps the staged blocking
+        loop."""
+        resolved = self.serving_stages(precision)
+        if resolved is None:
+            return None
+        from spark_rapids_ml_tpu.models._serving import (
+            build_fused_pipeline_program,
+        )
+
+        device, dtype, donate, specs = resolved
+        return build_fused_pipeline_program(
+            device=device, dtype=dtype, stages=specs,
+            precision=precision, donate=donate, algo="pipeline",
+        )
+
     def save(self, path: str, overwrite: bool = False) -> None:
         _save_pipeline_like(self, self._stages, path, overwrite)
 

@@ -162,6 +162,57 @@ class StandardScalerModel(StandardScalerParams):
             out = out * factor[None, :]
         return frame.with_column(self.getOutputCol(), out)
 
+    def serving_stage(self, precision: str = "native", *,
+                      device=None, dtype=None):
+        """Composable fused-pipeline stage (``models._serving
+        .ServingStage``): the same ``(x − mean) · factor`` expression the
+        sync transform runs, as a pure jax body with the statistics
+        staged to the device once. Elementwise — precision variants are
+        meaningless here (the GEMM stages carry them), so every
+        precision shares the native body."""
+        if self.mean is None:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models._serving import (
+            ServingStage,
+            resolve_serving_context,
+        )
+
+        if device is None or dtype is None:
+            device, dtype, _ = resolve_serving_context(self)
+        with_mean = bool(self.getWithMean())
+        with_std = bool(self.getWithStd())
+        weights = []
+        if with_mean:
+            weights.append(jax.device_put(
+                jnp.asarray(self.mean, dtype=dtype), device))
+        if with_std:
+            # Spark semantics: zero-std columns get factor 0.0 — the
+            # same host-precomputed factor the sync transform applies
+            safe = np.where(self.std > 0, self.std, 1.0)
+            factor = np.where(self.std > 0, 1.0 / safe, 0.0)
+            weights.append(jax.device_put(
+                jnp.asarray(factor, dtype=dtype), device))
+
+        if with_mean and with_std:
+            def fn(x, mean, factor):
+                return (x - mean[None, :]) * factor[None, :]
+        elif with_mean:
+            def fn(x, mean):
+                return x - mean[None, :]
+        elif with_std:
+            def fn(x, factor):
+                return x * factor[None, :]
+        else:
+            def fn(x):
+                return x
+
+        return ServingStage(fn=fn, weights=tuple(weights),
+                            algo="standard_scaler",
+                            fetch_dtype=np.dtype(np.float64))
+
     def transform_schema(self, columns):
         out = list(columns)
         if self.getOutputCol() in out:

@@ -103,6 +103,15 @@ def _assign_int8(x: jnp.ndarray, centers_q: jnp.ndarray,
 
 assign_clusters_int8 = tracked_jit(_assign_int8, label="kmeans_assign_int8")
 
+# Un-jitted stage bodies for the fused whole-pipeline serving programs
+# (models._serving.build_fused_pipeline_program). Assignment is
+# output-typed (labels), so KMeans composes only as the TERMINAL stage.
+SERVING_STAGE_BODIES = {
+    "native": assign_clusters,
+    "bf16": _assign_bf16,
+    "int8": _assign_int8,
+}
+
 
 @partial(tracked_jit, static_argnames=("n_clusters",))
 def kmeans_plus_plus_init(

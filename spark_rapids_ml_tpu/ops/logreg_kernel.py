@@ -214,6 +214,16 @@ def _predict_int8(x, coefficients_q, coefficients_scale, intercept):
 
 logreg_predict_int8 = tracked_jit(_predict_int8, label="logreg_predict_int8")
 
+# Un-jitted stage bodies for the fused whole-pipeline serving programs
+# (models._serving.build_fused_pipeline_program). σ(X·w+b) is
+# output-typed (probabilities), so logreg composes only as the TERMINAL
+# stage of a fused chain.
+SERVING_STAGE_BODIES = {
+    "native": _predict_sigmoid,
+    "bf16": _predict_bf16,
+    "int8": _predict_int8,
+}
+
 
 # -- multinomial (softmax) family ------------------------------------------
 # Spark's LogisticRegression auto-selects multinomial when the label has

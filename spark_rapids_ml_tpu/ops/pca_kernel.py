@@ -140,3 +140,15 @@ def _project_int8(x: jnp.ndarray, components_q: jnp.ndarray,
 
 
 pca_transform_int8 = tracked_jit(_project_int8, label="pca_transform_int8")
+
+
+# Un-jitted stage bodies for the FUSED whole-pipeline serving programs
+# (models._serving.build_fused_pipeline_program): the same arithmetic as
+# the jitted serve kernels above, composed with the other stages inside
+# ONE tracked_jit so a multi-stage PipelineModel predict is a single XLA
+# dispatch. Keyed by precision exactly like the kernel tables.
+SERVING_STAGE_BODIES = {
+    "native": _project,
+    "bf16": _project_bf16,
+    "int8": _project_int8,
+}

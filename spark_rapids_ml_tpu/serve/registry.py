@@ -54,6 +54,12 @@ _FEATURE_HINTS = (
     ("cluster_centers", lambda v: v.shape[1]),     # KMeans (k, n_features)
     ("coefficients", lambda v: np.asarray(v).shape[0]),
     ("coefficient_matrix", lambda v: v.shape[1]),  # multinomial (K, d)
+    # scaler-family statistics: one entry per input feature (these also
+    # lead fitted pipelines, whose input width IS the first stage's)
+    ("mean", lambda v: np.asarray(v).shape[0]),    # StandardScalerModel
+    ("original_min", lambda v: np.asarray(v).shape[0]),  # MinMaxScaler
+    ("max_abs", lambda v: np.asarray(v).shape[0]),       # MaxAbsScaler
+    ("median", lambda v: np.asarray(v).shape[0]),        # RobustScaler
 )
 
 
@@ -544,6 +550,20 @@ class ModelRegistry:
 
 
 def _infer_features(model) -> Optional[int]:
+    # A fitted PipelineModel's input width is its FIRST stage's: recurse
+    # down the chain until a stage carries per-feature state (stateless
+    # elementwise stages — Normalizer, Binarizer — preserve width, so
+    # looking past them stays correct; width-changing stages all carry
+    # state and resolve before the recursion passes them).
+    stages = getattr(model, "stages", None)
+    if isinstance(stages, (list, tuple)):
+        for stage in stages:
+            got = _infer_features(stage)
+            if got is not None:
+                return got
+            if type(stage).__name__ not in ("Normalizer", "Binarizer"):
+                break  # unknown stateful stage: width past it is unknowable
+        return None
     for attr, extract in _FEATURE_HINTS:
         value = getattr(model, attr, None)
         if value is not None:

@@ -4,13 +4,28 @@ A thin JSON shim over ``ServeEngine`` so the whole serving stack is
 drivable end-to-end (curl, load generators, k8s probes) without adding a
 web framework to the container:
 
-* ``POST /predict`` — body ``{"model": "name[@version]",
+* ``POST /predict`` — JSON body ``{"model": "name[@version]",
   "rows": [[...], ...], "deadline_ms": 250, "tenant": "team-a",
   "priority": "interactive|batch"}`` (tenant/priority also accepted as
   ``X-Tenant`` / ``X-Priority`` headers; HEADERS win — the pre-parse
   fast-shed path can only see headers, so they must be authoritative;
   body fields serve header-less clients) → ``{"model",
-  "version", "outputs": [...], "trace_id", "degraded", "retries"}``;
+  "version", "outputs": [...], "trace_id", "degraded", "retries"}``.
+  **Binary columnar bodies** (``Content-Type:
+  application/x-sparkml-columnar`` — ``serve.wire``: 24-byte header +
+  contiguous row-major payload) skip the JSON parse entirely; the
+  response mirrors the request format (or follows an explicit
+  ``Accept``), with version/degraded/retries carried as ``X-Model-*``
+  headers. ALL body decoding — both formats — routes through
+  ``serve.wire`` decoders that record the parse-phase latency
+  (``sparkml_serve_parse_seconds{format}``; rule 11 of
+  ``scripts/check_instrumentation.py`` rejects bare ``json.loads`` on
+  request bodies here). A malformed binary frame (bad magic, wrong
+  version, unknown dtype, truncated/mismatched payload) replies
+  400/415 with the distinct ``error="bad_wire"`` label; the full body
+  was already read, so keep-alive never desyncs. Tenant/priority stay
+  header-borne for binary traffic, so the pre-parse fast shed fires on
+  it exactly as on JSON;
   admission rejection maps to **429**, an adaptive load-shed
   (``ShedLoad`` — the overload controller's verdict, distinct from a
   full queue) to **503** with ``"shed": true``, a shed deadline to
@@ -100,6 +115,7 @@ from spark_rapids_ml_tpu.serve.engine import (
     publish_all_slos,
 )
 from spark_rapids_ml_tpu.serve.faults import fault_plane
+from spark_rapids_ml_tpu.serve import wire
 
 _MAX_BODY_BYTES = 64 * 1024 * 1024  # refuse absurd request bodies
 _TRACE_ROOT_PREFIXES = ("serve:http", "serve:request")
@@ -217,6 +233,15 @@ def make_handler(engine: ServeEngine):
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # TCP_NODELAY: the response is two writes (headers, then body).
+        # With Nagle on, a body smaller than the path MSS sits in the
+        # kernel until the client ACKs the header segment — and a
+        # client running delayed ACKs takes ~40 ms to do that. JSON
+        # payloads are usually big enough to dodge it; the binary wire
+        # responses (a few KB of packed rows) hit it dead on: measured
+        # 48 ms p50 → 4 ms p50 on loopback with Nagle off. A serving
+        # tier always trades this sliver of bandwidth for latency.
+        disable_nagle_algorithm = True
 
         def _reply(self, status: int, payload: dict,
                    trace_ctx: Optional[tracectx.TraceContext] = None,
@@ -261,6 +286,26 @@ def make_handler(engine: ServeEngine):
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return status
+
+        def _reply_bytes(self, status: int, body: bytes,
+                         content_type: str,
+                         trace_ctx: Optional[tracectx.TraceContext] = None,
+                         extra_headers: Optional[dict] = None) -> int:
+            """A raw-bytes reply (the binary wire responses): explicit
+            Content-Length like every other path, traceparent back, and
+            the predict metadata as headers since a binary payload has
+            no JSON fields to carry it."""
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in (extra_headers or {}).items():
+                self.send_header(key, str(value))
+            if trace_ctx is not None:
+                self.send_header(tracectx.TRACEPARENT_HEADER,
+                                 trace_ctx.traceparent())
             self.end_headers()
             self.wfile.write(body)
             return status
@@ -446,35 +491,52 @@ def make_handler(engine: ServeEngine):
                 length = int(self.headers.get("Content-Length", 0))
                 if length <= 0 or length > _MAX_BODY_BYTES:
                     raise ValueError(f"bad Content-Length {length}")
-                payload = json.loads(self.rfile.read(length))
-                model_ref = payload["model"]
-                rows = np.asarray(payload["rows"], dtype=np.float64)
-                deadline_ms = payload.get("deadline_ms")
-                # tenant/priority: HEADERS win over body fields — the
-                # pre-parse fast-shed path above can only see headers,
-                # so headers must be authoritative or a fast shed and a
-                # full admission could judge the same request as two
-                # different tenants. Body fields are the fallback for
-                # header-less clients; the admission controller applies
-                # env defaults and bounds label cardinality.
-                tenant = self.headers.get("X-Tenant") \
-                    or payload.get("tenant")
-                priority = self.headers.get("X-Priority") \
-                    or payload.get("priority")
-            except (KeyError, TypeError, ValueError) as exc:
-                # The body may be partially (or not at all) consumed —
-                # a keep-alive connection would desync, so close it.
+                raw = self.rfile.read(length)
+            except (TypeError, ValueError) as exc:
+                # Nothing (or garbage) was read — a keep-alive
+                # connection would desync, so close it.
                 self.close_connection = True
                 return self._reply(400, {"error": f"bad request: {exc}"},
                                    trace_ctx=ctx)
             try:
+                # ALL body decoding routes through serve.wire (rule 11):
+                # binary columnar when the Content-Type negotiates it,
+                # the JSON text protocol otherwise — both recording the
+                # parse-phase latency the wire bench judges.
+                req = wire.decode_body(
+                    raw, self.headers.get("Content-Type"),
+                    trace_id=ctx.trace_id)
+            except wire.WireError as exc:
+                if exc.kind == "binary":
+                    # the full body was already read above, so the
+                    # connection stays in sync — no close needed; the
+                    # decode already counted the distinct bad_wire label
+                    return self._reply(exc.status, {
+                        "error": f"bad wire body: {exc}",
+                        "reason": exc.reason,
+                    }, trace_ctx=ctx)
+                # JSON parse errors keep the PR 4 bad-request semantics
+                self.close_connection = True
+                return self._reply(400, {"error": f"bad request: {exc}"},
+                                   trace_ctx=ctx)
+            # tenant/priority: HEADERS win over body fields — the
+            # pre-parse fast-shed path above can only see headers, so
+            # headers must be authoritative or a fast shed and a full
+            # admission could judge the same request as two different
+            # tenants. Body fields are the fallback for header-less
+            # JSON clients; binary bodies are header-only by design.
+            tenant = self.headers.get("X-Tenant") or req.tenant
+            priority = self.headers.get("X-Priority") or req.priority
+            binary_out = wire.wants_binary_response(
+                self.headers.get("Accept"), req.binary)
+            try:
                 # Resolve once and predict against the PINNED version, so
                 # the reported version is the one that actually served the
                 # request even if a concurrent register() bumps "latest".
-                entry = engine.registry.resolve_entry(model_ref)
+                entry = engine.registry.resolve_entry(req.model)
                 result = engine.predict_detailed(
-                    entry.name, rows, version=entry.version,
-                    deadline_ms=deadline_ms,
+                    entry.name, req.rows, version=entry.version,
+                    deadline_ms=req.deadline_ms,
                     tenant=tenant, priority=priority,
                 )
             except KeyError as exc:
@@ -517,6 +579,18 @@ def make_handler(engine: ServeEngine):
                 return self._reply(500, {
                     "error": f"{type(exc).__name__}: {exc}"
                 }, trace_ctx=ctx)
+            if binary_out:
+                # metadata travels as headers — the payload is pure rows
+                return self._reply_bytes(
+                    200, wire.encode_response(result.outputs),
+                    wire.BINARY_CONTENT_TYPE, trace_ctx=ctx,
+                    extra_headers={
+                        "X-Model": entry.name,
+                        "X-Model-Version": entry.version,
+                        "X-Trace-Id": ctx.trace_id,
+                        "X-Degraded": int(result.degraded),
+                        "X-Retries": result.retries,
+                    })
             return self._reply(200, {
                 "model": entry.name,
                 "version": entry.version,

@@ -1,0 +1,383 @@
+"""Fused whole-pipeline serving programs: the composable stage hooks
+across the scaler / feature-transformer / PCA / KMeans / logreg
+families, fused-vs-staged bit-equality at f32/f64 across ragged batch
+sizes (the Flare-transplant parity contract), fusion declining for
+unwired / terminal-mid-chain / host-path pipelines, the engine + warmup
+integration (one fused XLA program per bucket, zero compiles on
+traffic), and reduced-precision composition through the stage hooks."""
+
+import concurrent.futures
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import PCA
+from spark_rapids_ml_tpu.data.frame import VectorFrame
+from spark_rapids_ml_tpu.models._serving import run_staged_pipeline
+from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
+from spark_rapids_ml_tpu.models.scaler import StandardScaler
+from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+
+RAGGED_SIZES = (1, 3, 17, 64, 100)
+
+
+def _training_frame(rng, n=512, d=16):
+    x = rng.normal(size=(n, d))
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(float)
+    return VectorFrame({"features": x, "label": list(y)}), x
+
+
+def _fit_classifier_pipeline(rng, dtype="auto"):
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        LogisticRegression,
+    )
+
+    frame, x = _training_frame(rng)
+    pipeline = Pipeline(stages=[
+        StandardScaler().setWithMean(True).setOutputCol("scaled"),
+        PCA().setK(6).setInputCol("scaled").setOutputCol("reduced")
+        .setDtype(dtype),
+        LogisticRegression().setInputCol("reduced").setLabelCol("label"),
+    ])
+    return pipeline.fit(frame), x
+
+
+# -- fused vs staged bit-equality --------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["float64", "float32"])
+def test_fused_bit_equal_staged_loop_ragged(rng, dtype):
+    """The parity contract: the ONE-program fused pipeline is bit-equal
+    to the per-stage dispatch/complete loop (same stage bodies, one jit
+    per stage, host sync between) at f32 and f64, across ragged batch
+    sizes."""
+    model, x = _fit_classifier_pipeline(rng, dtype=dtype)
+    prog = model.serving_transform_program()
+    assert prog is not None and prog.algo == "pipeline"
+    for n in RAGGED_SIZES:
+        batch = x[:n]
+        fused = prog.fetch(prog.run(prog.put(batch)))
+        staged = run_staged_pipeline(model, batch)
+        assert fused.dtype == staged.dtype == np.dtype(np.float64)
+        assert np.array_equal(fused, staged), f"batch size {n}"
+
+
+def test_fused_matches_frame_loop(rng):
+    """Against the frame-by-frame ``PipelineModel.transform`` (host
+    numpy scalers + per-stage device kernels): equivalent within float
+    tolerance — the staged frame loop mixes host/device arithmetic, so
+    the contract there is closeness, not bits."""
+    model, x = _fit_classifier_pipeline(rng, dtype="float64")
+    prog = model.serving_transform_program()
+    batch = x[:48]
+    fused = prog.fetch(prog.run(prog.put(batch)))
+    frame_out = model.transform(batch)
+    proba = np.asarray(frame_out.column(model.getProbabilityCol()))
+    np.testing.assert_allclose(fused, proba, rtol=1e-9, atol=1e-12)
+
+
+def test_kmeans_terminal_pipeline(rng):
+    from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+    frame, x = _training_frame(rng)
+    model = Pipeline(stages=[
+        StandardScaler().setWithMean(True).setOutputCol("scaled"),
+        PCA().setK(4).setInputCol("scaled").setOutputCol("reduced")
+        .setDtype("float64"),
+        KMeans().setK(3).setInputCol("reduced"),
+    ]).fit(frame)
+    prog = model.serving_transform_program()
+    assert prog is not None
+    batch = x[:37]
+    fused = prog.fetch(prog.run(prog.put(batch)))
+    assert fused.dtype == np.dtype(np.int32)
+    assert np.array_equal(fused, run_staged_pipeline(model, batch))
+    labels = np.asarray(
+        model.transform(batch).column(model.getPredictionCol()))
+    assert np.array_equal(fused, labels)
+
+
+def test_scaler_only_pipeline_fuses(rng):
+    """A transformer-only chain (no terminal classifier) fuses too —
+    the last stage's f64 fetch matches the frame loop's column."""
+    frame, x = _training_frame(rng)
+    from spark_rapids_ml_tpu.models.feature_scalers import MinMaxScaler
+
+    model = Pipeline(stages=[
+        StandardScaler().setWithMean(True).setOutputCol("scaled"),
+        MinMaxScaler().setInputCol("scaled").setOutputCol("boxed"),
+    ]).fit(frame)
+    prog = model.serving_transform_program()
+    assert prog is not None
+    batch = x[:21]
+    fused = prog.fetch(prog.run(prog.put(batch)))
+    # A pure-elementwise chain may FMA-contract differently inside one
+    # fusion region than as two standalone programs (same arithmetic,
+    # ±1 ulp) — the bit-equality contract belongs to the GEMM-anchored
+    # chains the issue names; here the bound is machine epsilon.
+    staged = run_staged_pipeline(model, batch)
+    np.testing.assert_allclose(fused, staged, rtol=1e-6, atol=1e-7)
+    frame_out = np.asarray(model.transform(batch).column("boxed"))
+    np.testing.assert_allclose(fused, frame_out, rtol=1e-6, atol=1e-7)
+
+
+# -- the composable stage family ---------------------------------------------
+
+
+def _single_stage_output(model, x64):
+    """Run one model's serving_stage body jitted at f64 — the device
+    half of the family parity check."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.obs.xprof import tracked_jit
+
+    spec = model.serving_stage(device=jax.devices()[0],
+                               dtype=np.float64)
+    assert spec is not None
+    kernel = tracked_jit(spec.fn, label=f"stage_test_{spec.algo}")
+    return np.asarray(kernel(
+        jax.device_put(jnp.asarray(x64, dtype=jnp.float64)),
+        *spec.weights))
+
+
+def _family_cases(rng):
+    from spark_rapids_ml_tpu.models.feature_scalers import (
+        Binarizer,
+        MaxAbsScaler,
+        MinMaxScaler,
+        Normalizer,
+        RobustScaler,
+    )
+    from spark_rapids_ml_tpu.models.feature_transformers import (
+        ElementwiseProduct,
+        VarianceThresholdSelector,
+        VectorSlicer,
+    )
+
+    x = rng.normal(size=(64, 8))
+    x[:, 3] = 0.0  # a constant column exercises the zero-spread paths
+    frame = VectorFrame({"features": x})
+    weights = rng.normal(size=8).tolist()
+    return x, [
+        ("standard_scaler",
+         StandardScaler().setWithMean(True).fit(frame)),
+        ("min_max_scaler", MinMaxScaler().fit(frame)),
+        ("max_abs_scaler", MaxAbsScaler().fit(frame)),
+        ("robust_scaler",
+         RobustScaler().setWithCentering(True).fit(frame)),
+        ("normalizer", Normalizer()),
+        ("binarizer", Binarizer().setThreshold(0.25)),
+        ("elementwise_product",
+         ElementwiseProduct(scalingVec=weights)),
+        ("vector_slicer", VectorSlicer(indices=[0, 2, 5])),
+        ("feature_selector",
+         VarianceThresholdSelector().setVarianceThreshold(0.5)
+         .fit(frame)),
+    ]
+
+
+def test_stage_family_parity_with_sync_transforms(rng):
+    """Every composable family: the device stage body at f64 matches
+    the model's own (host numpy) transform column. Elementwise families
+    are exact; the Normalizer's norm reduction may differ in summation
+    order, so it gets float tolerance."""
+    x, cases = _family_cases(rng)
+    for algo, model in cases:
+        out_dev = _single_stage_output(model, x)
+        frame_out = model.transform(x)
+        col = np.asarray(frame_out.column(model.getOutputCol()))
+        if algo == "normalizer":
+            np.testing.assert_allclose(out_dev, col, rtol=1e-12,
+                                       err_msg=algo)
+        else:
+            assert np.array_equal(out_dev, col), algo
+
+
+def test_pca_kmeans_logreg_stage_hooks_exist(rng):
+    """The GEMM families expose the hook too, with terminal-ness
+    matching their output type."""
+    frame, x = _training_frame(rng)
+    from spark_rapids_ml_tpu.models.kmeans import KMeans
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        LogisticRegression,
+    )
+
+    pca = PCA().setK(3).fit(frame)
+    km = KMeans().setK(2).fit(frame)
+    lr = LogisticRegression().setLabelCol("label").fit(frame)
+    assert pca.serving_stage().terminal is False
+    assert km.serving_stage().terminal is True
+    assert lr.serving_stage().terminal is True
+
+
+# -- fusion declining --------------------------------------------------------
+
+
+def test_unwired_pipeline_declines_fusion(rng):
+    """A second stage reading the RAW features (not the scaler output)
+    is a DAG, not a chain — fusing it would silently change semantics,
+    so the hook declines and the staged loop keeps serving."""
+    frame, x = _training_frame(rng)
+    model = Pipeline(stages=[
+        StandardScaler().setWithMean(True).setOutputCol("scaled"),
+        PCA().setK(4),  # reads "features": NOT the scaler output
+    ]).fit(frame)
+    assert model.serving_transform_program() is None
+
+
+def test_terminal_stage_mid_chain_declines(rng):
+    from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+    frame, x = _training_frame(rng)
+    km = KMeans().setK(2).fit(frame)
+    scaler = StandardScaler().fit(frame)
+    model = PipelineModel(stages=[km, scaler])
+    assert model.serving_transform_program() is None
+
+
+def test_host_path_stage_declines(rng):
+    frame, x = _training_frame(rng)
+    pca = PCA().setK(4).setInputCol("scaled").setOutputCol("r") \
+        .setUseXlaDot(False).fit(
+            VectorFrame({"scaled": np.asarray(frame.column("features"))}))
+    scaler = StandardScaler().setWithMean(True).setOutputCol("scaled") \
+        .fit(frame)
+    model = PipelineModel(stages=[scaler, pca])
+    assert model.serving_transform_program() is None
+
+
+def test_empty_and_unfusable_stage_pipelines_decline():
+    assert PipelineModel(stages=[]).serving_transform_program() is None
+
+    class Opaque:
+        def transform(self, dataset):
+            return dataset
+
+    assert PipelineModel(
+        stages=[Opaque()]).serving_transform_program() is None
+
+
+# -- engine integration ------------------------------------------------------
+
+
+def test_engine_serves_fused_pipeline_e2e(rng):
+    """The registered PipelineModel rides the micro-batcher's pipeline
+    path: warmup owns the fused bucket ladder, concurrent ragged
+    traffic compiles NOTHING further, and every response is bit-equal
+    to the staged per-stage loop."""
+    from spark_rapids_ml_tpu.obs import compile_stats
+
+    model, x = _fit_classifier_pipeline(rng, dtype="float64")
+    registry = ModelRegistry()
+    registry.register("fused_pipe", model)
+    engine = ServeEngine(registry, max_batch_rows=128, max_wait_ms=1.0,
+                         buckets=(32, 128))
+    try:
+        report = engine.warmup("fused_pipe")
+        assert report.get("pipeline"), "fused ladder must be warmed"
+        assert sorted(report["pipeline"]["buckets"]) == [32, 128]
+        # the engine built a fused async spec, not the blocking loop
+        spec = engine._async_specs[("fused_pipe", 1)]
+        assert spec is not None and spec.algo == "pipeline"
+
+        # Bucket-exact single request: the batcher stages exactly the
+        # program's own (32, d) shape, so the answer is BIT-equal to a
+        # direct program call.
+        prog = spec.program
+        direct = prog.fetch(prog.run(prog.put(x[:32])))
+        assert np.array_equal(engine.predict("fused_pipe", x[:32]),
+                              direct)
+
+        sizes = [1, 7, 32, 64, 100, 13, 2, 90]
+        # the staged reference compiles its own per-stage programs —
+        # computed BEFORE the no-compile window opens
+        expected = {n: run_staged_pipeline(model, x[:n]) for n in
+                    set(sizes)}
+        compiles_before = sum(
+            s["compiles"] for s in compile_stats().values())
+
+        def one(n):
+            return n, engine.predict("fused_pipe", x[:n])
+
+        with concurrent.futures.ThreadPoolExecutor(6) as pool:
+            for n, out in pool.map(one, sizes * 4):
+                # coalescing/padding picks varying bucket shapes, and
+                # per-row GEMM tiling may differ by shape in the last
+                # ulp — the equality bar here is f64 epsilon; padding
+                # leaks or mis-splits would be off by whole values
+                np.testing.assert_allclose(
+                    out, expected[n], rtol=1e-12, atol=1e-14,
+                    err_msg=f"size {n}")
+        compiles_after = sum(
+            s["compiles"] for s in compile_stats().values())
+        assert compiles_after == compiles_before, \
+            "traffic after warmup must compile nothing"
+    finally:
+        engine.shutdown()
+
+
+def test_engine_staged_kill_switch_serves_same_rows(rng):
+    """pipeline_depth=1 at native precision keeps the blocking staged
+    loop (the kill switch) — answers equivalent to the fused path."""
+    model, x = _fit_classifier_pipeline(rng, dtype="float64")
+    registry = ModelRegistry()
+    registry.register("staged_pipe", model)
+    engine = ServeEngine(registry, max_batch_rows=128, max_wait_ms=1.0,
+                         pipeline_depth=1)
+    try:
+        out = engine.predict("staged_pipe", x[:20])
+        np.testing.assert_allclose(
+            out, run_staged_pipeline(model, x[:20]),
+            rtol=1e-9, atol=1e-12)
+    finally:
+        engine.shutdown()
+
+
+def test_registry_infers_pipeline_features(rng):
+    from spark_rapids_ml_tpu.serve.registry import _infer_features
+
+    model, _x = _fit_classifier_pipeline(rng)
+    assert _infer_features(model) == 16
+    # a stateless head is looked past (width-preserving)
+    from spark_rapids_ml_tpu.models.feature_scalers import Normalizer
+
+    assert _infer_features(
+        PipelineModel(stages=[Normalizer(), model.stages[0]])) == 16
+
+
+# -- reduced precision composes ----------------------------------------------
+
+
+@pytest.mark.parametrize("precision,bar", [("bf16", 0.02), ("int8", 0.05)])
+def test_reduced_precision_composes_through_fusion(rng, precision, bar):
+    model, x = _fit_classifier_pipeline(rng, dtype="float64")
+    native = model.serving_transform_program()
+    reduced = model.serving_transform_program(precision=precision)
+    assert reduced is not None and reduced.precision == precision
+    batch = x[:64]
+    ref = native.fetch(native.run(native.put(batch)))
+    red = reduced.fetch(reduced.run(reduced.put(batch.copy())))
+    assert ref.shape == red.shape
+    scale = float(np.max(np.abs(ref))) or 1.0
+    assert float(np.max(np.abs(ref - red))) / scale < bar
+
+
+def test_engine_precision_guard_runs_for_pipeline(rng):
+    """SERVE_PRECISION=bf16 on a pipeline model passes the offline
+    max-error gate and serves a bf16 fused ladder."""
+    model, x = _fit_classifier_pipeline(rng, dtype="float64")
+    registry = ModelRegistry()
+    registry.register("prec_pipe", model)
+    engine = ServeEngine(registry, max_batch_rows=64, max_wait_ms=1.0,
+                         precision="bf16")
+    try:
+        out = engine.predict("prec_pipe", x[:16])
+        spec = engine._async_specs[("prec_pipe", 1)]
+        assert spec is not None and spec.precision == "bf16"
+        staged = run_staged_pipeline(model, x[:16])
+        scale = float(np.max(np.abs(staged))) or 1.0
+        assert float(np.max(np.abs(out - staged))) / scale < 0.05
+    finally:
+        engine.shutdown()

@@ -1,0 +1,407 @@
+"""Binary columnar wire format (serve.wire): codec round trips, the
+malformed-frame matrix (bad magic / version / dtype / truncation / size
+mismatch → 400/415 with the distinct ``bad_wire`` label, keep-alive
+intact), JSON-vs-binary HTTP equivalence (same rows in → same outputs),
+header-authoritative tenant identity, pre-parse fast-shed firing on
+binary traffic, the parse-phase latency metric, and the rule-11 static
+check (server bodies decode only through serve/wire.py)."""
+
+import http.client
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine, wire
+from spark_rapids_ml_tpu.serve.admission import ShedController, ShedLoad
+from spark_rapids_ml_tpu.serve.server import start_serve_server
+from spark_rapids_ml_tpu.serve.wire import WireError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def served_pca():
+    """One PCA model behind a live HTTP server, shared by the module."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(512, 12))
+    from spark_rapids_ml_tpu import PCA
+
+    model = PCA().setK(4).fit(x)
+    registry = ModelRegistry()
+    registry.register("wire_pca", model)
+    engine = ServeEngine(registry, max_batch_rows=128, max_wait_ms=1.0)
+    server = start_serve_server(engine)
+    yield server.server_address[1], x, engine
+    server.shutdown()
+    engine.shutdown()
+
+
+def _counter(name, **labels):
+    snap = get_registry().snapshot().get(name, {"samples": []})
+    total = 0.0
+    found = False
+    for s in snap["samples"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+            found = True
+    return total if found else None
+
+
+# -- codec round trips -------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_request_codec_round_trip(dtype):
+    rows = np.arange(24, dtype=dtype).reshape(4, 6)
+    body = wire.encode_request("mymodel@3", rows, deadline_ms=250)
+    req = wire.decode_request(body)
+    assert req.model == "mymodel@3"
+    assert req.deadline_ms == 250.0
+    assert req.binary is True
+    assert req.rows.dtype == np.dtype(dtype)
+    assert np.array_equal(req.rows, rows)
+
+
+def test_request_codec_no_deadline_and_unicode_ref():
+    rows = np.ones((2, 3))
+    req = wire.decode_request(wire.encode_request("modèle_β", rows))
+    assert req.model == "modèle_β"
+    assert req.deadline_ms is None
+
+
+def test_request_codec_1d_rows_become_one_row():
+    req = wire.decode_request(wire.encode_request("m", np.arange(5.0)))
+    assert req.rows.shape == (1, 5)
+
+
+@pytest.mark.parametrize("outputs", [
+    np.arange(12.0).reshape(3, 4),          # 2-D float
+    np.asarray([0.25, 0.5, 0.75]),          # 1-D probabilities
+    np.asarray([1, 0, 2], dtype=np.int32),  # labels
+])
+def test_response_codec_round_trip(outputs):
+    out = wire.decode_response(wire.encode_response(outputs))
+    assert out.dtype == outputs.dtype
+    assert np.array_equal(out, outputs)
+
+
+# -- the malformed-frame matrix ----------------------------------------------
+
+
+def _good_body():
+    return wire.encode_request("m", np.ones((4, 3)))
+
+
+@pytest.mark.parametrize("mutate,reason,status", [
+    (lambda b: b"XXXX" + b[4:], "bad_magic", 400),
+    (lambda b: b[:4] + bytes([99]) + b[5:], "bad_version", 415),
+    (lambda b: b[:5] + bytes([77]) + b[6:], "bad_dtype", 415),
+    (lambda b: b[:10], "truncated", 400),            # inside the header
+    (lambda b: b[:-8], "truncated", 400),            # inside the payload
+    (lambda b: b + b"\x00" * 4, "size_mismatch", 400),
+])
+def test_malformed_binary_bodies(mutate, reason, status):
+    before = _counter("sparkml_serve_wire_errors_total",
+                      reason=reason) or 0
+    with pytest.raises(WireError) as exc_info:
+        wire.decode_request(mutate(_good_body()))
+    assert exc_info.value.reason == reason
+    assert exc_info.value.status == status
+    assert exc_info.value.kind == "binary"
+    assert _counter("sparkml_serve_wire_errors_total",
+                    reason=reason) == before + 1
+
+
+def test_malformed_binary_counts_distinct_bad_wire_label():
+    before = _counter("sparkml_serve_errors_total",
+                      model="(wire)", error="bad_wire") or 0
+    with pytest.raises(WireError):
+        wire.decode_request(b"garbage")
+    assert _counter("sparkml_serve_errors_total",
+                    model="(wire)", error="bad_wire") == before + 1
+
+
+def test_degenerate_shape_rejected():
+    body = bytearray(_good_body())
+    body[8:12] = (0).to_bytes(4, "little")  # n_rows = 0
+    with pytest.raises(WireError) as exc_info:
+        wire.decode_request(bytes(body))
+    assert exc_info.value.reason == "bad_header"
+
+
+def test_json_decoder_classifies_as_json_kind():
+    with pytest.raises(WireError) as exc_info:
+        wire.decode_json_request(b"{not json")
+    assert exc_info.value.kind == "json"
+    req = wire.decode_json_request(
+        json.dumps({"model": "m", "rows": [[1.0, 2.0]],
+                    "tenant": "t1", "priority": "batch"}).encode())
+    assert (req.model, req.tenant, req.priority) == ("m", "t1", "batch")
+    assert req.binary is False
+
+
+def test_parse_latency_recorded_per_format():
+    wire.decode_json_request(b'{"model": "m", "rows": [[1.0]]}')
+    wire.decode_request(_good_body())
+    for fmt in ("json", "binary"):
+        q = wire.parse_quantiles(fmt)
+        assert q["p99"] is not None and q["p99"] >= 0
+
+
+def test_content_negotiation():
+    assert wire.is_binary_content_type(wire.BINARY_CONTENT_TYPE)
+    assert wire.is_binary_content_type(
+        wire.BINARY_CONTENT_TYPE + "; charset=binary")
+    assert not wire.is_binary_content_type("application/json")
+    assert not wire.is_binary_content_type(None)
+    # explicit Accept wins; absent one the response mirrors the request
+    assert wire.wants_binary_response(wire.BINARY_CONTENT_TYPE, False)
+    assert not wire.wants_binary_response("application/json", True)
+    assert wire.wants_binary_response(None, True)
+    assert not wire.wants_binary_response(None, False)
+    # '*/*' is NO preference (requests/curl add it by default) — it
+    # mirrors the request format instead of forcing JSON on a binary
+    # client that cannot parse it
+    assert wire.wants_binary_response("*/*", True)
+    assert not wire.wants_binary_response("*/*", False)
+
+
+# -- HTTP equivalence --------------------------------------------------------
+
+
+def test_http_binary_round_trip_equals_json(served_pca):
+    port, x, _engine = served_pca
+    rows = x[:16]
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    conn.request("POST", "/predict",
+                 json.dumps({"model": "wire_pca", "rows": rows.tolist()}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    json_out = np.asarray(json.loads(resp.read())["outputs"])
+
+    conn.request("POST", "/predict", wire.encode_request("wire_pca", rows),
+                 {"Content-Type": wire.BINARY_CONTENT_TYPE})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == wire.BINARY_CONTENT_TYPE
+    assert resp.getheader("X-Model") == "wire_pca"
+    assert resp.getheader("X-Model-Version") == "1"
+    assert resp.getheader("X-Degraded") == "0"
+    body = resp.read()
+    binary_out = wire.decode_response(body)
+    # same rows in → the same outputs out, whatever the wire format
+    assert np.array_equal(json_out, binary_out)
+    conn.close()
+
+
+def test_http_binary_request_json_accept(served_pca):
+    port, x, _engine = served_pca
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    conn.request("POST", "/predict", wire.encode_request("wire_pca", x[:4]),
+                 {"Content-Type": wire.BINARY_CONTENT_TYPE,
+                  "Accept": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    doc = json.loads(resp.read())
+    assert doc["model"] == "wire_pca" and len(doc["outputs"]) == 4
+    conn.close()
+
+
+def test_http_malformed_binary_keeps_keepalive(served_pca):
+    """A malformed frame replies 400/415 WITHOUT desyncing the
+    connection: the full body was read before decoding, so the next
+    request on the same socket parses cleanly (the PR 4 invariant,
+    inherited by the binary path)."""
+    port, x, _engine = served_pca
+    good = wire.encode_request("wire_pca", x[:4])
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    for bad, status in ((b"XXXX" + good[4:], 400),
+                        (good[:-5], 400),
+                        (good[:4] + bytes([9]) + good[5:], 415)):
+        conn.request("POST", "/predict", bad,
+                     {"Content-Type": wire.BINARY_CONTENT_TYPE})
+        resp = conn.getresponse()
+        assert resp.status == status
+        doc = json.loads(resp.read())
+        assert doc["reason"] in ("bad_magic", "truncated", "bad_version")
+        # keep-alive: the SAME connection serves the next request
+        conn.request("POST", "/predict", good,
+                     {"Content-Type": wire.BINARY_CONTENT_TYPE})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+    conn.close()
+
+
+def test_http_binary_unknown_model_404(served_pca):
+    port, x, _engine = served_pca
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    conn.request("POST", "/predict", wire.encode_request("nope", x[:2]),
+                 {"Content-Type": wire.BINARY_CONTENT_TYPE})
+    resp = conn.getresponse()
+    assert resp.status == 404
+    resp.read()
+    conn.close()
+
+
+def test_http_fast_shed_fires_preparse_on_binary():
+    """At a forced shed level, a dry-bucket batch tenant identified by
+    HEADERS is rejected BEFORE the binary body parse — binary traffic
+    rides the same pre-parse fast path as JSON (tenant/priority are
+    deliberately header-borne on the wire)."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(256, 8))
+    from spark_rapids_ml_tpu import PCA
+
+    model = PCA().setK(2).fit(x)
+    registry = ModelRegistry()
+    registry.register("shed_pca", model)
+    shed = ShedController(refresh_seconds=1e9, hold_seconds=1e9)
+    shed.note_signals(burn=100.0, queue_wait_s=10.0, depth_frac=1.0)
+    engine = ServeEngine(registry, max_batch_rows=64, shed=shed,
+                         tenant_quotas={"greedy": (0.000001, 0.000001)})
+    engine.admission._bucket_for("greedy").take(1)  # dry the bucket
+    server = start_serve_server(engine)
+    port = server.server_address[1]
+    parse_before = (wire.parse_quantiles("binary") or {}).copy()
+    binary_count_before = _binary_parse_count()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        conn.request("POST", "/predict",
+                     wire.encode_request("shed_pca", x[:4]),
+                     {"Content-Type": wire.BINARY_CONTENT_TYPE,
+                      "X-Tenant": "greedy", "X-Priority": "batch"})
+        resp = conn.getresponse()
+        assert resp.status == 503
+        doc = json.loads(resp.read())
+        assert doc.get("shed") is True
+        assert resp.getheader("Retry-After") is not None
+        # the shed fired PRE-parse: the binary parse counter never moved
+        assert _binary_parse_count() == binary_count_before
+        del parse_before
+        # in-quota traffic on the same server still serves
+        conn.request("POST", "/predict",
+                     wire.encode_request("shed_pca", x[:4]),
+                     {"Content-Type": wire.BINARY_CONTENT_TYPE})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+        conn.close()
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+def _binary_parse_count():
+    snap = get_registry().snapshot().get(wire.PARSE_SUMMARY,
+                                         {"samples": []})
+    for s in snap["samples"]:
+        if s["labels"].get("format") == "binary":
+            return s["count"]
+    return 0
+
+
+def test_concurrent_mixed_format_traffic(served_pca):
+    """JSON and binary clients hammering the same server concurrently:
+    every response matches its own request's rows."""
+    port, x, _engine = served_pca
+    errors = []
+
+    def client(fmt, offset):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            for i in range(6):
+                rows = x[offset + i * 4:offset + i * 4 + 4]
+                if fmt == "json":
+                    conn.request(
+                        "POST", "/predict",
+                        json.dumps({"model": "wire_pca",
+                                    "rows": rows.tolist()}),
+                        {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    out = np.asarray(json.loads(resp.read())["outputs"])
+                else:
+                    conn.request(
+                        "POST", "/predict",
+                        wire.encode_request("wire_pca", rows),
+                        {"Content-Type": wire.BINARY_CONTENT_TYPE})
+                    resp = conn.getresponse()
+                    out = wire.decode_response(resp.read())
+                if resp.status != 200 or out.shape[0] != 4:
+                    errors.append(f"{fmt}@{offset}+{i}: {resp.status}")
+            conn.close()
+        except Exception as exc:  # noqa: BLE001 - surfaced via errors
+            errors.append(f"{fmt}@{offset}: {type(exc).__name__}: {exc}")
+
+    threads = [
+        threading.Thread(target=client,
+                         args=("json" if t % 2 else "binary", t * 32))
+        for t in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+
+
+# -- rule 11 -----------------------------------------------------------------
+
+
+def _checker():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_instrumentation as ci
+    finally:
+        sys.path.pop(0)
+    return ci
+
+
+def test_rule11_accepts_current_server_and_wire():
+    ci = _checker()
+    assert list(ci.check_server_body_decoding(ci.SERVER_FILE)) == []
+    assert list(ci.check_wire_parse_metrics(ci.WIRE_FILE)) == []
+
+
+def test_rule11_rejects_bare_json_loads_in_server(tmp_path):
+    ci = _checker()
+    bad = tmp_path / "bad_server.py"
+    bad.write_text(
+        "import json\n"
+        "import json as j\n"
+        "from json import loads\n"
+        "def _handle_predict(self):\n"
+        "    a = json.loads(self.rfile.read(10))\n"   # REJECT
+        "    b = j.loads(b'{}')\n"                    # REJECT (alias)
+        "    c = loads(b'{}')\n"                      # REJECT (bare)
+        "    d = json.dumps({})\n"                    # fine
+        "    return a, b, c, d\n"
+    )
+    offenders = list(ci.check_server_body_decoding(str(bad)))
+    assert len(offenders) == 3
+    assert all("serve/wire.py" in why for _ln, why in offenders)
+
+
+def test_rule11_rejects_unmeasured_wire_decoder(tmp_path):
+    ci = _checker()
+    bad = tmp_path / "bad_wire.py"
+    bad.write_text(
+        "def decode_request(body):\n"
+        "    return body  # REJECT: no parse-latency observe\n"
+        "def decode_json_request(body):\n"
+        "    _parse_summary().observe(0.0, format='json')\n"
+        "    return body  # fine\n"
+        "def decode_response(body):\n"
+        "    return body  # fine: client side, not a request decoder\n"
+        "def decode_body(body):\n"
+        "    return decode_request(body)  # fine: dispatcher\n"
+    )
+    offenders = list(ci.check_wire_parse_metrics(str(bad)))
+    assert len(offenders) == 1
+    assert "decode_request" in offenders[0][1]
