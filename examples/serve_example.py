@@ -11,9 +11,14 @@ fan-in batch → transform, Dapper-style), a 60-sample queue-depth /
 p99-latency HISTORY from the embedded time-series store (``obs.tsdb``
 sampling in the background while traffic ran), and the run's SLO
 verdict (burn rates per window, budget remaining, firing alerts) —
-then ends with the AUTO-INCIDENT loop: a latency fault is injected,
-the anomaly detectors notice the p99 jump, an incident opens with an
-evidence bundle on disk, and it auto-resolves after the fault clears.
+then the AUTO-INCIDENT loop: a latency fault is injected, the anomaly
+detectors notice the p99 jump, an incident opens with an evidence
+bundle on disk, and it auto-resolves after the fault clears — and
+finally the MULTI-DEVICE serving tier: the same model replicated onto
+both (forced) host devices, concurrent traffic split by least-loaded
+placement, the per-device batch split printed from the replica
+counters, a device-targeted fault draining one replica onto its
+sibling, and an oversize request served by the batch-sharded program.
 Runs on CPU (JAX_PLATFORMS=cpu) or any accelerator.
 """
 
@@ -21,6 +26,17 @@ import concurrent.futures
 import os
 import sys
 import time
+
+# The multi-device demo needs >= 2 devices; on a CPU host that means
+# forcing virtual host devices BEFORE the first jax import (device
+# count is fixed at backend init). Appended, so an operator's own
+# XLA_FLAGS survive; skipped when a forced count is already set.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
 
 import numpy as np
 
@@ -475,6 +491,87 @@ def main():
             break
         time.sleep(0.05)
     engine3.shutdown()
+
+    # -- multi-device serving: replicas, placement, drain, sharding ----
+    import jax
+
+    from spark_rapids_ml_tpu.obs import get_registry
+    from spark_rapids_ml_tpu.serve.placement import serving_devices
+
+    print("\n== multi-device serving tier (serve/placement.py) ==")
+    devices = serving_devices()
+    print(f"  visible devices: {[str(d) for d in devices]}")
+    if len(devices) < 2:
+        print("  (single device — run with XLA_FLAGS="
+              "--xla_force_host_platform_device_count=2 for the demo)")
+        return
+    engine4 = ServeEngine(registry, max_batch_rows=256, max_wait_ms=1,
+                          buckets=BUCKETS, replicas=len(devices))
+    report = engine4.warmup("prod")
+    print(f"  warmup staged the bucket ladder on "
+          f"{len(report.get('replicas', {1: 1}))} device(s); sharded "
+          f"program warmed at bucket "
+          f"{report.get('sharded', {}).get('bucket', '—')}")
+
+    def _split() -> dict:
+        samples = get_registry().snapshot()[
+            "sparkml_serve_replica_batches_total"]["samples"]
+        return {s["labels"]["device"]: int(s["value"]) for s in samples
+                if s["labels"]["model"] == "pca_embedder"}
+
+    before = _split()
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        list(pool.map(
+            lambda i: engine4.predict("prod", x[i % 128:i % 128 + 16]),
+            range(120)))
+    split = {dev: count - before.get(dev, 0)
+             for dev, count in _split().items()}
+    total = sum(split.values()) or 1
+    print("  per-device batch split over 120 concurrent requests:")
+    for device_label, batches in sorted(split.items()):
+        bar = "#" * int(30 * batches / total)
+        print(f"    {device_label:<14} {batches:>4} batches  {bar}")
+
+    # drain: fault ONE replica's device — traffic sheds onto the
+    # sibling (retries absorb the failures; availability holds).
+    # Concurrent clients, so the least-loaded pick keeps exercising
+    # both replicas until the victim's health trips.
+    rset = engine4._replicas[("pca_embedder", 1)]
+    victim = rset.replicas[1]
+    victim.health.cooldown_seconds = 1.0
+    spec = plane.inject("pca_embedder", "raise", count=None,
+                        device=victim.label)
+    with concurrent.futures.ThreadPoolExecutor(6) as pool:
+        served = [r is not None for r in pool.map(
+            lambda i: engine4.predict("prod", x[i:i + 8]), range(48))]
+    doc = engine4.replica_snapshot()["pca_embedder@1"]
+    print(f"  device-targeted fault on {victim.label}: "
+          f"{sum(served)}/48 served (the fault fired {spec.fired}x, "
+          f"every one absorbed by retries + the sibling); replica "
+          f"states now "
+          f"{[(r['device'], r['state']) for r in doc['replicas']]}")
+    plane.clear()
+    time.sleep(1.1)
+    for i in range(10):
+        engine4.predict("prod", x[i:i + 8])
+    print(f"  fault cleared: half-open probe re-entered the replica -> "
+          f"{victim.state()}")
+
+    # one HUGE request: above max_batch_rows it routes to the
+    # NamedSharding-over-("batch",) program and uses every chip
+    big = engine4.predict("prod", x[:2000])
+    sharded_events = [e for e in get_recorder_events()
+                      if e.name.startswith("serve:sharded:")]
+    print(f"  2000-row request served SHARDED across "
+          f"{len(devices)} devices -> output {big.shape} "
+          f"({len(sharded_events)} sharded dispatch(es))")
+    engine4.shutdown()
+
+
+def get_recorder_events():
+    from spark_rapids_ml_tpu.obs import spans as spans_mod
+
+    return spans_mod.get_recorder().events()
 
 
 if __name__ == "__main__":

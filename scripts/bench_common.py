@@ -38,6 +38,32 @@ def log(msg: str) -> None:
         f.write(f"{msg}: {stamp()}\n")
 
 
+def force_device_count_flags(n_devices: int, env: dict = None) -> str:
+    """The ``XLA_FLAGS`` value a subprocess child needs to see
+    ``n_devices`` forced host devices, preserving every other flag the
+    parent environment carries (device count is fixed at jax init, so
+    multi-device-count benches spawn one child per count). Shared by
+    bench_serve's multidevice scenario, load_harness's device-scaling
+    phase, and chaos_drill's replica_drain phase — one copy of the
+    flag-splicing logic."""
+    source = os.environ if env is None else env
+    kept = [f for f in source.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    return " ".join(kept)
+
+
+def prefixed_result(stdout: str, prefix: str):
+    """The machine-readable child-result line a subprocess leg printed
+    (``PREFIX {json}``), parsed — or None when the child never emitted
+    one (the caller reports rc/stderr)."""
+    line = next((ln for ln in (stdout or "").splitlines()
+                 if ln.startswith(prefix)), None)
+    if line is None:
+        return None
+    return json.loads(line[len(prefix):])
+
+
 def metrics_snapshot() -> dict:
     """The process metrics registry as a JSON-safe dict ({} when the
     package (or its telemetry) is unavailable — emission never fails)."""

@@ -52,7 +52,24 @@ Scenarios (``SPARKML_BENCH_SERVE_SCENARIO``):
   ``sparkml_serve_parse_seconds{format}`` sketch ``serve.wire``'s
   decoders feed — emitting ``metric="wire_parse_ms_p99"`` (the binary
   parse tail, explicit lower-is-better) with ``json_parse_ms_p99`` and
-  the parse speedup alongside.
+  the parse speedup alongside;
+* ``multidevice`` — the replicated serving tier's scaling proof: the
+  same closed-loop engine bench run in SUBPROCESSES at forced host
+  device counts 1 / 2 / 4 (``XLA_FLAGS=
+  --xla_force_host_platform_device_count=N`` — device count is fixed at
+  jax init, so each count needs its own process), emitting
+  ``rows_per_sec`` per count and ``metric="serve_multidevice_scaling_
+  efficiency"`` = (rows/sec at N ÷ rows/sec at 1) ÷ N (explicit
+  higher-is-better). **CPU-CI honesty**: a single-core container
+  cannot exhibit real FLOPS parallelism across virtual host devices,
+  so the scenario models a fixed per-batch device service time
+  (``SPARKML_BENCH_SERVE_DEVICE_MS``, default 60 — injected as a
+  ``latency`` fault at every replica dispatch, a GIL-released sleep)
+  and therefore judges the TIER: can placement + per-replica
+  batchers/staging-pools keep N devices concurrently busy? On real
+  multi-chip hardware set ``SPARKML_BENCH_SERVE_DEVICE_MS=0`` to
+  measure true compute scaling. The modeled service time is stamped
+  into the record so a baseline can never silently mix the two modes.
 
 Knobs (env): SPARKML_BENCH_SERVE_REQUESTS (default 512),
 SPARKML_BENCH_SERVE_FEATURES (64), SPARKML_BENCH_SERVE_K (16),
@@ -277,6 +294,156 @@ def scenario_wire(device) -> int:
     return 0
 
 
+CHILD_RESULT_PREFIX = "MULTIDEVICE_CHILD_RESULT "
+
+
+def scenario_multidevice() -> int:
+    """Parent leg: run the closed-loop child at device counts 1/2/4 in
+    subprocesses, aggregate into ONE sentinel-judged record. Runs
+    before any jax import — device count is fixed at jax init, so the
+    parent must never initialize a backend itself."""
+    import subprocess
+
+    counts = [int(v) for v in os.environ.get(
+        "SPARKML_BENCH_SERVE_DEVICES", "1,2,4").split(",") if v.strip()]
+    device_ms = float(os.environ.get("SPARKML_BENCH_SERVE_DEVICE_MS",
+                                     "60"))
+    results = {}
+    for n in counts:
+        env = dict(os.environ)
+        env["SPARKML_BENCH_SERVE_SCENARIO"] = "_multidevice_child"
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+        env["XLA_FLAGS"] = bench_common.force_device_count_flags(n)
+        # the child replicates onto every device it sees
+        env.pop("SPARK_RAPIDS_ML_TPU_SERVE_REPLICAS", None)
+        bench_common.log(f"bench_serve multidevice: child at "
+                         f"{n} device(s)")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        result = bench_common.prefixed_result(proc.stdout,
+                                              CHILD_RESULT_PREFIX)
+        if proc.returncode != 0 or result is None:
+            bench_common.log(
+                f"multidevice child at {n} device(s) FAILED "
+                f"(rc={proc.returncode}): {proc.stderr[-2000:]}")
+            return 1
+        results[n] = result
+    base_count, top = counts[0], counts[-1]
+    base = results[base_count]["rows_per_sec"]
+    speedups = {n: (results[n]["rows_per_sec"] / base if base else 0.0)
+                for n in counts}
+    # efficiency relative to the MEASURED base count — with the default
+    # counts "1,2,4" this is the classic (rps_N / rps_1) / N, but an
+    # operator benching only 2,4 gets the honest 2→4 efficiency instead
+    # of a silently mislabeled number
+    efficiency = (speedups[top] / (top / base_count)
+                  if top > base_count else 0.0)
+    record = {
+        "bench": "serve_multidevice",
+        "metric": "serve_multidevice_scaling_efficiency",
+        "value": efficiency,
+        "unit": (f"scaling efficiency: (rows/sec at {top} devices / "
+                 f"rows/sec at {base_count}) / ({top}/{base_count})"),
+        "higher_is_better": True,
+        "platform": results[top]["platform"],
+        "device_kind": results[top]["device_kind"],
+        "device_counts": counts,
+        "modeled_device_ms": device_ms,
+        "requests": results[top]["requests"],
+        "rows_per_request": results[top]["rows_per_request"],
+        "threads": results[top]["threads"],
+        "scaling_efficiency": efficiency,
+        "speedup_at_top": speedups[top],
+    }
+    for n in counts:
+        record[f"rows_per_sec_{n}"] = results[n]["rows_per_sec"]
+        record[f"p99_ms_{n}"] = results[n]["p99_ms"]
+        record[f"replica_split_{n}"] = results[n]["replica_split"]
+    bench_common.emit_record(record, include_metrics=False)
+    bench_common.log(
+        "bench_serve multidevice: " + ", ".join(
+            f"{n}dev {results[n]['rows_per_sec']:,.0f} rows/s"
+            for n in counts)
+        + f" -> speedup {speedups[top]:.2f}x at {top} devices "
+          f"(efficiency {efficiency:.2f})")
+    return 0
+
+
+def scenario_multidevice_child(device) -> int:
+    """One device count's closed-loop measurement (run in its own
+    process — see ``scenario_multidevice``). Emits a machine-readable
+    result line instead of a bench record; the parent aggregates."""
+    import json
+
+    n_requests = _env_int("SPARKML_BENCH_SERVE_MD_REQUESTS", 128)
+    n_features = _env_int("SPARKML_BENCH_SERVE_FEATURES", 32)
+    k = _env_int("SPARKML_BENCH_SERVE_K", 8)
+    n_threads = _env_int("SPARKML_BENCH_SERVE_THREADS", 16)
+    max_rows = _env_int("SPARKML_BENCH_SERVE_MAX_ROWS", 256)
+    # full-bucket requests: one request = one batch = one modeled
+    # device dispatch, so the measured scaling is the TIER's dispatch
+    # concurrency, not a coalescing-density artifact (spreading small
+    # requests across N queues thins batches — a real trade-off the
+    # engine scenario covers; this scenario isolates the replica win)
+    rows_per_request = _env_int("SPARKML_BENCH_SERVE_MD_ROWS", 256)
+    device_ms = float(os.environ.get("SPARKML_BENCH_SERVE_DEVICE_MS",
+                                     "60"))
+
+    import jax
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.obs import get_registry
+    from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+    from spark_rapids_ml_tpu.serve.faults import fault_plane
+
+    n_devices = len(jax.devices())
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(4096, n_features))
+    model = PCA().setK(k).fit(x)
+    registry = ModelRegistry()
+    registry.register("bench_md_pca", model)
+    engine = ServeEngine(
+        registry, max_batch_rows=max_rows, max_wait_ms=2.0,
+        max_queue_depth=4 * n_requests,
+    )
+    engine.warmup("bench_md_pca")
+    if device_ms > 0:
+        # the modeled per-batch device service time: a latency fault at
+        # EVERY replica dispatch (GIL-released sleep) — see the module
+        # docstring's CPU-CI honesty note
+        fault_plane().inject("bench_md_pca", "latency", count=None,
+                             seconds=device_ms / 1000.0)
+    starts = [int(rng.integers(0, x.shape[0] - rows_per_request))
+              for _ in range(n_requests)]
+    latencies, wall = _closed_loop(
+        lambda i: engine.predict(
+            "bench_md_pca",
+            x[starts[i]:starts[i] + rows_per_request]),
+        n_requests, n_threads)
+    snap = get_registry().snapshot().get(
+        "sparkml_serve_replica_batches_total", {"samples": []})
+    split = {s["labels"]["device"]: s["value"]
+             for s in snap["samples"] if s["value"] > 0}
+    engine.shutdown()
+    total_rows = n_requests * rows_per_request
+    result = {
+        "devices": n_devices,
+        "platform": device.platform,
+        "device_kind": str(device.device_kind),
+        "requests": n_requests,
+        "rows_per_request": rows_per_request,
+        "threads": n_threads,
+        "rows_per_sec": total_rows / wall if wall > 0 else 0.0,
+        "p99_ms": float(np.percentile(latencies, 99)) * 1000.0,
+        "replica_split": split,
+    }
+    sys.stdout.write(CHILD_RESULT_PREFIX + json.dumps(result) + "\n")
+    sys.stdout.flush()
+    return 0
+
+
 def main() -> int:
     n_requests = _env_int("SPARKML_BENCH_SERVE_REQUESTS", 512)
     n_features = _env_int("SPARKML_BENCH_SERVE_FEATURES", 64)
@@ -286,12 +453,19 @@ def main() -> int:
     scenario = os.environ.get(
         "SPARKML_BENCH_SERVE_SCENARIO", "engine").strip().lower()
 
+    if scenario == "multidevice":
+        # MUST dispatch before the jax import below: the parent spawns
+        # per-device-count children and never initializes a backend
+        return scenario_multidevice()
+
     import jax
 
     if scenario == "pipeline":
         return scenario_pipeline(jax.devices()[0])
     if scenario == "wire":
         return scenario_wire(jax.devices()[0])
+    if scenario == "_multidevice_child":
+        return scenario_multidevice_child(jax.devices()[0])
 
     from spark_rapids_ml_tpu import PCA
     from spark_rapids_ml_tpu.obs import compile_stats, get_registry

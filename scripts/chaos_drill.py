@@ -184,6 +184,145 @@ def _await_resolved(base: str, incident_id: str,
     return False
 
 
+REPLICA_DRAIN_PREFIX = "REPLICA_DRAIN_RESULT "
+
+
+def replica_drain_child() -> int:
+    """The replica-drain drill leg, run in its OWN process with 2
+    forced host devices (device count is fixed at jax init — the main
+    drill stays a faithful single-device rehearsal).
+
+    Contract (ISSUE 13): fault ONE device's replica → availability
+    >= 0.99 via the surviving replica (retries + placement drain),
+    exactly one ``serve_replica_degraded`` incident opens with a
+    complete evidence bundle and auto-resolves, and the drained replica
+    re-enters after its half-open probe succeeds."""
+    import concurrent.futures
+
+    import jax
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import (
+        ModelRegistry,
+        ServeEngine,
+        fault_plane,
+        start_serve_server,
+    )
+
+    result = {"devices": len(jax.devices())}
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(1024, 16))
+    model = PCA().setK(4).fit(x)
+    registry = ModelRegistry()
+    registry.register("drill_replica_pca", model, buckets=(16, 64))
+    engine = ServeEngine(
+        registry, max_batch_rows=64, max_wait_ms=1.0,
+        retries=2, backoff_ms=10, breaker_failures=8,
+        default_deadline_ms=10_000, replicas=2,
+    )
+    engine.warmup("drill_replica_pca")
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    plane = fault_plane()
+    try:
+        rset = engine._replicas[("drill_replica_pca", 1)]
+        victim = rset.replicas[1]
+        victim.health.cooldown_seconds = 1.0
+        result["victim_device"] = victim.label
+        doc = _get_json(base, "/debug/incidents")
+        known = {i.get("id") for i in
+                 _incident_entries(doc, "serve_replica_degraded")}
+        plane.inject("drill_replica_pca", "raise", count=None,
+                     device=victim.label)
+
+        statuses = []
+
+        def one(i: int) -> None:
+            n = int(rng.integers(1, 9))
+            start = int(rng.integers(0, x.shape[0] - n))
+            status, _payload = _post_predict(
+                base, "drill_replica_pca", x[start:start + n])
+            statuses.append(status)
+
+        with concurrent.futures.ThreadPoolExecutor(6) as pool:
+            list(pool.map(one, range(80)))
+        ok = sum(1 for s in statuses if s == 200)
+        result["requests"] = len(statuses)
+        result["availability"] = ok / len(statuses)
+        result["hung"] = sum(1 for s in statuses if s == 0)
+        result["victim_state_under_fault"] = victim.state()
+        result["breaker_state"] = engine.breaker_snapshot()[
+            "drill_replica_pca"]["state"]
+
+        new = _await_new_incidents(base, "serve_replica_degraded",
+                                   known)
+        result["incidents_opened"] = len(new)
+        problems = []
+        if len(new) != 1:
+            problems.append(
+                f"expected exactly 1 serve_replica_degraded incident, "
+                f"saw {len(new)}")
+        for incident in new:
+            problems.extend(_bundle_problems(incident))
+
+        # recovery: the fault clears, the half-open probe re-enters
+        plane.clear()
+        deadline = time.monotonic() + 20.0
+        while (victim.state() != "serving"
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+            n = int(rng.integers(1, 9))
+            start = int(rng.integers(0, x.shape[0] - n))
+            _post_predict(base, "drill_replica_pca",
+                          x[start:start + n])
+        result["reentered"] = victim.state() == "serving"
+        if not result["reentered"]:
+            problems.append("drained replica never re-entered")
+        resolved = all(
+            _await_resolved(base, incident["id"]) for incident in new)
+        result["incidents_resolved"] = resolved
+        if new and not resolved:
+            problems.append("replica incident did not auto-resolve")
+        result["problems"] = problems
+    finally:
+        plane.clear()
+        server.shutdown()
+        engine.shutdown()
+        from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+
+        tsdb_mod.get_sampler().stop()
+        time.sleep(1.0)
+    sys.stdout.write(REPLICA_DRAIN_PREFIX + json.dumps(result) + "\n")
+    sys.stdout.flush()
+    return 0 if not result.get("problems") else 1
+
+
+def run_replica_drain_phase() -> dict:
+    """Spawn the 2-device replica-drain child; returns its result (or
+    a synthesized failure entry when the child broke)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["SPARKML_CHAOS_PHASE"] = "replica_drain_child"
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    env["XLA_FLAGS"] = bench_common.force_device_count_flags(2)
+    env.pop("SPARK_RAPIDS_ML_TPU_SERVE_REPLICAS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    result = bench_common.prefixed_result(proc.stdout,
+                                          REPLICA_DRAIN_PREFIX)
+    if result is None:
+        return {"problems": [
+            f"replica-drain child produced no result "
+            f"(rc={proc.returncode}): {proc.stderr[-1500:]}"]}
+    if proc.returncode != 0 and not result.get("problems"):
+        result.setdefault("problems", []).append(
+            f"replica-drain child exited {proc.returncode}")
+    return result
+
+
 def _bundle_problems(incident: dict) -> list:
     """What's missing from one incident's on-disk evidence bundle."""
     problems = []
@@ -350,6 +489,8 @@ def _tenant_burst(base: str, model: str, x, seconds: float,
 
 
 def main() -> int:
+    if os.environ.get("SPARKML_CHAOS_PHASE") == "replica_drain_child":
+        return replica_drain_child()
     n_requests = _env_int("SPARKML_CHAOS_REQUESTS", 24)
     n_features = _env_int("SPARKML_CHAOS_FEATURES", 16)
     k = _env_int("SPARKML_CHAOS_K", 4)
@@ -611,6 +752,13 @@ def main() -> int:
         recovery_seconds = _await_closed()
         phases["recovery"] = _phase(base, "chaos_pca", x, n_requests, rng)
         incident_totals = _get_json(base, "/debug/incidents")
+
+        # -- replica drain: fault ONE device's replica (2-device
+        # subprocess — device count is fixed at jax init) and prove the
+        # placement tier sheds onto the sibling without taking the tier
+        # down, with its own incident loop.
+        bench_common.log("chaos replica drain (2-device subprocess)")
+        replica_drain = run_replica_drain_phase()
     finally:
         plane.clear()
         server.shutdown()
@@ -657,6 +805,9 @@ def main() -> int:
         "incidents_opened": incident_totals.get("opened_total", 0),
         "incidents_resolved": incident_totals.get("resolved_total", 0),
         "incidents": incidents,
+        "replica_drain": replica_drain,
+        "availability_replica_drain": replica_drain.get(
+            "availability", 0.0),
         "phases": {name: {k: v for k, v in stats.items()
                           if k != "statuses"}
                    for name, stats in phases.items()},
@@ -708,6 +859,20 @@ def main() -> int:
         bench_common.log(
             f"chaos FAIL: incident loop broke for "
             f"{sorted(incident_failures)}: {incident_failures}")
+        return 1
+    replica_min = float(
+        os.environ.get("SPARKML_CHAOS_REPLICA_AVAILABILITY", 0.99))
+    if replica_drain.get("availability", 0.0) < replica_min:
+        bench_common.log(
+            f"chaos FAIL: replica-drain availability "
+            f"{replica_drain.get('availability', 0.0):.3f} < "
+            f"{replica_min} — the surviving replica did not absorb "
+            "the faulted one")
+        return 1
+    if replica_drain.get("problems"):
+        bench_common.log(
+            f"chaos FAIL: replica-drain contract broke: "
+            f"{replica_drain['problems']}")
         return 1
     bench_common.log("chaos drill PASS")
     # final settle: any worker abandoned mid-jax-call must leave the

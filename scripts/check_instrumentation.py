@@ -698,6 +698,87 @@ def check_wire_parse_metrics(path: str):
                    "stay measured")
 
 
+# rule 12: device selection in serve/ must route through placement.py —
+# the multi-replica tier's one device-enumeration chokepoint. A
+# hard-coded jax.devices()[0] (or an implicit default-device
+# jax.device_put) silently pins serving work to device 0, which is
+# exactly the single-chip bottleneck the replica tier removed.
+PLACEMENT_FILE = os.path.join(
+    REPO, "spark_rapids_ml_tpu", "serve", "placement.py"
+)
+_DEVICE_ENUM_CALLS = frozenset({"devices", "local_devices"})
+
+
+def _jax_aliases(tree: ast.Module):
+    """Names the module binds to the jax module (``import jax``,
+    ``import jax as j``) — aliased ``j.devices()`` can't evade the
+    check."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    aliases.add(a.asname or a.name)
+    return aliases or {"jax"}
+
+
+def _jax_name_imports(tree: ast.Module, wanted) -> set:
+    """Bare names bound via ``from jax import devices/device_put``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name in wanted:
+                    names.add(a.asname or a.name)
+    return names
+
+
+def check_device_selection(path: str):
+    """Rule 12: yield (lineno, description) for device-selection calls
+    in a serve/ module other than placement.py.
+
+    Offenders: any ``jax.devices()`` / ``jax.local_devices()`` call
+    (including subscripted ``jax.devices()[0]`` — the call itself is
+    the offense), and ``jax.device_put`` with no explicit device/
+    sharding target (fewer than two positional args and no ``device=``
+    kwarg) — implicit default-device placement pins work to device 0
+    behind the placement tier's back."""
+    tree = ast.parse(open(path).read(), filename=path)
+    aliases = _jax_aliases(tree)
+    bare_enum = _jax_name_imports(tree, _DEVICE_ENUM_CALLS)
+    bare_put = _jax_name_imports(tree, {"device_put"})
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        enum = (
+            (isinstance(func, ast.Attribute)
+             and func.attr in _DEVICE_ENUM_CALLS
+             and isinstance(func.value, ast.Name)
+             and func.value.id in aliases)
+            or (isinstance(func, ast.Name) and func.id in bare_enum)
+        )
+        if enum:
+            yield (node.lineno,
+                   "device enumeration in serve/ outside placement.py "
+                   "(route through serve.placement.serving_devices — "
+                   "a hard-coded jax.devices()[0] pins the tier to one "
+                   "chip)")
+            continue
+        put = (
+            (isinstance(func, ast.Attribute) and func.attr == "device_put"
+             and isinstance(func.value, ast.Name)
+             and func.value.id in aliases)
+            or (isinstance(func, ast.Name) and func.id in bare_put)
+        )
+        if put and len(node.args) < 2 and not any(
+                kw.arg == "device" for kw in node.keywords):
+            yield (node.lineno,
+                   "implicit default-device jax.device_put in serve/ "
+                   "(pass the replica's device from serve/placement.py "
+                   "— default placement pins work to device 0)")
+
+
 def library_files():
     """Every .py under the package, minus the exempt helper dirs."""
     out = []
@@ -762,6 +843,9 @@ def main() -> int:
             offenders.append(f"{rel}:{lineno} {why}")
         for lineno, why in check_exception_hygiene(path):
             offenders.append(f"{rel}:{lineno} {why}")
+        if os.path.abspath(path) != os.path.abspath(PLACEMENT_FILE):
+            for lineno, why in check_device_selection(path):
+                offenders.append(f"{rel}:{lineno} {why}")
     lib_files = library_files()
     for path in lib_files:
         rel = os.path.relpath(path, REPO)
@@ -808,7 +892,8 @@ def main() -> int:
         f"designated completion step; {len(admission_files)} "
         f"admission/scheduler module(s) with every shed/admission "
         f"decision counted or audit-spanned; request-body decoding "
-        f"routed through serve/wire.py with the parse stage measured"
+        f"routed through serve/wire.py with the parse stage measured; "
+        f"serve/ device selection routed through serve/placement.py"
     )
     return 0
 

@@ -58,36 +58,62 @@ class ServingStage(NamedTuple):
     fetch_dtype: Optional[np.dtype] = None
 
 
-def resolve_serving_context(model=None) -> Tuple[object, object, bool]:
+def resolve_serving_context(model=None,
+                            device=None) -> Tuple[object, object, bool]:
     """``(device, dtype, donate)`` for a model's serving program: the
     model's resolved device and transform dtype, plus whether the
     donated kernel twin should be used (off-CPU only — on CPU donation
     is a no-op that warns). Tolerant of models without device params
     (host-stat scalers, ``PipelineModel`` itself): missing getters fall
-    back to the default device and ``auto`` dtype."""
+    back to the default device and ``auto`` dtype.
+
+    ``device`` (a concrete jax device from ``serve/placement.py`` — or
+    a ``jax.sharding.Sharding`` for the sharded-program builder, which
+    ``jax.device_put`` accepts in the device position) OVERRIDES the
+    model's own device resolution: the multi-replica serving tier
+    stages the same program onto every visible device."""
     from spark_rapids_ml_tpu.models.pca import (
         _resolve_device,
         _resolve_dtype,
     )
 
-    get_dev = getattr(model, "getDeviceId", None)
     get_dt = getattr(model, "getDtype", None)
-    device = _resolve_device(get_dev() if callable(get_dev) else -1)
     dtype = _resolve_dtype(get_dt() if callable(get_dt) else "auto")
-    donate = getattr(device, "platform", "cpu") != "cpu"
+    if device is None:
+        get_dev = getattr(model, "getDeviceId", None)
+        device = _resolve_device(get_dev() if callable(get_dev) else -1)
+        donate = getattr(device, "platform", "cpu") != "cpu"
+    else:
+        donate = _donate_for(device)
     return device, dtype, donate
 
 
-def resolve_pipeline_context(stages) -> Tuple[object, object, bool]:
+def _donate_for(device) -> bool:
+    """Donation posture for an explicit device OR sharding target
+    (donation is a warning no-op on CPU)."""
+    platform = getattr(device, "platform", None)
+    if platform is None:
+        # a Sharding: every mesh device shares a platform
+        devices = getattr(device, "device_set", None) or ()
+        for dev in devices:
+            platform = getattr(dev, "platform", "cpu")
+            break
+    return (platform or "cpu") != "cpu"
+
+
+def resolve_pipeline_context(stages,
+                             device=None) -> Tuple[object, object, bool]:
     """The shared ``(device, dtype, donate)`` a fused pipeline stages
     every weight under: the first stage carrying device params decides
     (a pipeline mixing device preferences is already incoherent for ONE
-    XLA program); an all-host-stat chain falls back to the defaults."""
+    XLA program); an all-host-stat chain falls back to the defaults.
+    ``device`` overrides the resolution for the replica tier, exactly
+    like ``resolve_serving_context``."""
     for stage in stages:
         if callable(getattr(stage, "getDeviceId", None)) and callable(
                 getattr(stage, "getDtype", None)):
-            return resolve_serving_context(stage)
-    return resolve_serving_context(None)
+            return resolve_serving_context(stage, device=device)
+    return resolve_serving_context(None, device=device)
 
 
 def build_serving_program(
@@ -243,6 +269,126 @@ def build_fused_pipeline_program(
 
     def fetch(out_dev):
         out = np.asarray(out_dev)
+        if fetch_dtype is None:
+            return out
+        return out.astype(fetch_dtype, copy=False)
+
+    return ServingProgram(put=put, run=run, fetch=fetch,
+                          dtype=np.dtype(dtype), algo=algo,
+                          precision=precision)
+
+
+# -- sharded big transforms ---------------------------------------------------
+
+
+BATCH_AXIS = "batch"
+
+
+def batch_mesh(devices):
+    """A 1-D ``("batch",)`` mesh over the serving devices — the sharded
+    big-transform layout (SNIPPETS.md [2]; arXiv:2112.09017: when the
+    batch dimension is the sharded one, the GEMM-shaped transforms
+    scale near-linearly)."""
+    import numpy as _np
+
+    from jax.sharding import Mesh
+
+    return Mesh(_np.asarray(list(devices)), (BATCH_AXIS,))
+
+
+def build_batch_sharded_program(
+    model,
+    *,
+    devices,
+    precision: str = "native",
+):
+    """A ``NamedSharding``-over-``("batch",)`` variant of a model's
+    serving program: one HUGE request uses ALL chips instead of one.
+
+    Rows are sharded across the mesh (``P("batch", None)``); the
+    constant model weights are replicated (``P()``) — staged once at
+    build, like every other serving program. The computation is built
+    from the SAME un-jitted stage bodies the fused-pipeline composer
+    uses (``serving_stage`` hooks, composed for pipelines exactly like
+    ``build_fused_pipeline_program``), so the sharded program's
+    arithmetic is the replicated program's arithmetic: every serving
+    kernel here is row-independent, which keeps sharded outputs equal
+    to single-device up to XLA's shape-dependent GEMM tiling (±ulp-
+    scale FMA/reduction-order differences — the documented ε; often
+    bit-equal in practice, tested in test_serve_multidevice.py).
+
+    Returns ``None`` when the model cannot shard: fewer than 2 devices,
+    no ``serving_stage`` hook (host-path families), a hook declining,
+    or an un-fusable pipeline chain. ``precision`` follows the stage
+    hooks (bf16/int8 compose exactly as in the fused path)."""
+    devices = list(devices)
+    if len(devices) < 2:
+        return None
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.obs.serving import ServingProgram
+    from spark_rapids_ml_tpu.obs.xprof import tracked_jit
+
+    mesh = batch_mesh(devices)
+    replicated = NamedSharding(mesh, P())
+    row_sharded = NamedSharding(mesh, P(BATCH_AXIS, None))
+
+    stages = getattr(model, "stages", None)
+    if isinstance(stages, (list, tuple)) and stages:
+        # a fused pipeline: same chain-wiring contract as the fused
+        # single-device program — an un-wired chain must not shard
+        wired = getattr(model, "_chain_is_wired", None)
+        if callable(wired) and not wired():
+            return None
+        _dev, dtype, _donate = resolve_pipeline_context(stages)
+        specs = collect_pipeline_stages(stages, precision,
+                                        device=replicated, dtype=dtype)
+        if not specs:
+            return None
+        algo = "pipeline"
+    else:
+        hook = getattr(model, "serving_stage", None)
+        if not callable(hook):
+            return None
+        _dev, dtype, _donate = resolve_serving_context(model)
+        spec = hook(precision=precision, device=replicated, dtype=dtype)
+        if spec is None:
+            return None
+        specs = [spec]
+        algo = spec.algo
+
+    fns = tuple(s.fn for s in specs)
+    arities = tuple(len(s.weights) for s in specs)
+    flat_weights = tuple(w for s in specs for w in s.weights)
+    fetch_dtype = specs[-1].fetch_dtype
+
+    def _chain(x, *flat):
+        i = 0
+        for fn, k in zip(fns, arities):
+            x = fn(x, *flat[i:i + k])
+            i += k
+        return x
+
+    label = (f"sharded_batch_{'_'.join(s.algo for s in specs)}"
+             f"_{precision}_x{len(devices)}")
+    kernel = tracked_jit(
+        _chain, label=label,
+        donate_argnums=(0,) if _donate_for(row_sharded) else (),
+    )
+
+    def put(matrix):
+        # the host rows scatter straight into per-device shards — the
+        # one host→device transfer a sharded request pays
+        return jax.device_put(jnp.asarray(matrix, dtype=dtype),
+                              row_sharded)
+
+    def run(x_dev):
+        return kernel(x_dev, *flat_weights)
+
+    def fetch(out_dev):
+        out = np.asarray(out_dev)  # gathers the shards
         if fetch_dtype is None:
             return out
         return out.astype(fetch_dtype, copy=False)

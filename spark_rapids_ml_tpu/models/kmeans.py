@@ -439,12 +439,15 @@ class KMeansModel(KMeansParams):
             fetch_dtype=np.dtype(np.int32),
         )
 
-    def serving_transform_program(self, precision: str = "native"):
+    def serving_transform_program(self, precision: str = "native",
+                                  device=None):
         """Device-resident serving program for the pipelined batcher
         (``obs.serving.ServingProgram``): centers staged once, ``run``
         async-dispatches the assignment kernel (distance argmin — the
         int8/bf16 variants reduce only the cross-term GEMM), ``fetch``
-        the completion-step sync. None for host-path models."""
+        the completion-step sync. ``device`` pins one replica's device
+        (the multi-device tier builds one program per chip). None for
+        host-path models."""
         if self.cluster_centers is None or not self.getUseXlaDot():
             return None
         from spark_rapids_ml_tpu.models._serving import (
@@ -453,7 +456,7 @@ class KMeansModel(KMeansParams):
         )
         from spark_rapids_ml_tpu.ops import kmeans_kernel as _kk
 
-        device, dtype, donate = resolve_serving_context(self)
+        device, dtype, donate = resolve_serving_context(self, device=device)
         weights = self._serving_weights(precision, device, dtype)
         return build_serving_program(
             device=device, dtype=dtype, algo="kmeans",

@@ -810,12 +810,15 @@ class LogisticRegressionModel(LogisticRegressionParams):
             fetch_dtype=np.dtype(np.float64),
         )
 
-    def serving_transform_program(self, precision: str = "native"):
+    def serving_transform_program(self, precision: str = "native",
+                                  device=None):
         """Device-resident serving program for the pipelined batcher
         (``obs.serving.ServingProgram``): σ(X·w + b) with the weights
         staged once; the bf16/int8 variants reduce only the logit GEMM
-        (the sigmoid stays f32). Binary models only — the multinomial
-        path is a host softmax, and host-path models return None."""
+        (the sigmoid stays f32). ``device`` pins one replica's device
+        (the multi-device tier builds one program per chip). Binary
+        models only — the multinomial path is a host softmax, and
+        host-path models return None."""
         if (self.coefficient_matrix is not None
                 or self.coefficients is None
                 or not self.getUseXlaDot()):
@@ -826,7 +829,7 @@ class LogisticRegressionModel(LogisticRegressionParams):
         )
         from spark_rapids_ml_tpu.ops import logreg_kernel as _lk
 
-        device, dtype, donate = resolve_serving_context(self)
+        device, dtype, donate = resolve_serving_context(self, device=device)
         weights = self._serving_weights(precision, device, dtype)
         return build_serving_program(
             device=device, dtype=dtype, algo="logistic_regression",

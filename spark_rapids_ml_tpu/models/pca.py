@@ -714,7 +714,8 @@ class PCAModel(PCAParams):
             fetch_dtype=np.dtype(np.float64),
         )
 
-    def serving_transform_program(self, precision: str = "native"):
+    def serving_transform_program(self, precision: str = "native",
+                                  device=None):
         """The device-resident serving program for the pipelined
         micro-batcher (``obs.serving.ServingProgram``): components staged
         to the device ONCE, ``put`` starting each batch's host→device
@@ -723,9 +724,11 @@ class PCAModel(PCAParams):
         host sync. ``precision`` selects the env-gated reduced-precision
         variant ladder (bf16 / int8 GEMM — separate tracked signatures
         per bucket, guarded by the engine's offline max-error check and
-        the numerics sentinel). Returns None for host-path models
-        (``useXlaDot=False``) — the engine then keeps the blocking sync
-        path."""
+        the numerics sentinel); ``device`` pins the program onto one
+        replica's device (``serve/placement.py`` builds one program per
+        visible device; None = the model's own device resolution).
+        Returns None for host-path models (``useXlaDot=False``) — the
+        engine then keeps the blocking sync path."""
         if self.pc is None or not self.getUseXlaDot():
             return None
         from spark_rapids_ml_tpu.models._serving import (
@@ -734,7 +737,7 @@ class PCAModel(PCAParams):
         )
         from spark_rapids_ml_tpu.ops import pca_kernel as _pk
 
-        device, dtype, donate = resolve_serving_context(self)
+        device, dtype, donate = resolve_serving_context(self, device=device)
         weights = self._serving_weights(precision, device, dtype)
         return build_serving_program(
             device=device, dtype=dtype, algo="pca", precision=precision,

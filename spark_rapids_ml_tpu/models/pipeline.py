@@ -173,11 +173,12 @@ class PipelineModel(Params):
                 return False
         return True
 
-    def serving_stages(self, precision: str = "native"):
+    def serving_stages(self, precision: str = "native", device=None):
         """The per-stage ``ServingStage`` chain at ``precision`` under
         one shared device/dtype, or None when any stage is not fusable
         (no hook, hook declined, an output-typed stage mid-chain, or
-        column wiring that is not a head-to-tail chain)."""
+        column wiring that is not a head-to-tail chain). ``device``
+        overrides the shared device for the replica tier."""
         from spark_rapids_ml_tpu.models._serving import (
             collect_pipeline_stages,
             resolve_pipeline_context,
@@ -185,24 +186,27 @@ class PipelineModel(Params):
 
         if not self._stages or not self._chain_is_wired():
             return None
-        device, dtype, donate = resolve_pipeline_context(self._stages)
+        device, dtype, donate = resolve_pipeline_context(
+            self._stages, device=device)
         specs = collect_pipeline_stages(self._stages, precision,
                                         device=device, dtype=dtype)
         if not specs:
             return None
         return device, dtype, donate, specs
 
-    def serving_transform_program(self, precision: str = "native"):
+    def serving_transform_program(self, precision: str = "native",
+                                  device=None):
         """ONE fused ``ServingProgram`` for the whole pipeline: every
         stage's pure device function composed inside a single
         ``tracked_jit`` XLA program (weights staged once, batch buffer
         donated off-CPU), registered with the micro-batcher's pipeline
         path exactly like a single-model program — warmup precompiles
         the fused bucket × precision ladder, and the bf16/int8 variants
-        compose through the stage hooks. Returns None when any stage
-        cannot compose — the engine then keeps the staged blocking
-        loop."""
-        resolved = self.serving_stages(precision)
+        compose through the stage hooks. ``device`` pins one replica's
+        device (the multi-device tier builds one fused program per
+        chip). Returns None when any stage cannot compose — the engine
+        then keeps the staged blocking loop."""
+        resolved = self.serving_stages(precision, device=device)
         if resolved is None:
             return None
         from spark_rapids_ml_tpu.models._serving import (

@@ -487,6 +487,18 @@ def builtin_detectors(
             kind="slo", severity="critical",
             stale_after=max(2 * w, 120.0),
         ),
+        # The replica tier (serve/placement.py): 0 serving, 1 draining,
+        # 2 dead — any replica above 0 means a device left the
+        # placement set and its traffic is shedding onto siblings. The
+        # incident auto-resolves when the half-open probe re-enters the
+        # replica (the gauge drops back to 0).
+        ThresholdDetector(
+            "serve_replica_degraded",
+            "sparkml_serve_replica_state",
+            threshold=0.5, direction=">",
+            kind="replica", severity="serious",
+            stale_after=max(2 * w, 120.0),
+        ),
     ]
 
 

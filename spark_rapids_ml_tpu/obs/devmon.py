@@ -55,6 +55,10 @@ class DeviceMonitor:
         self._devices_fn = devices_fn
         self._lock = threading.Lock()
         self._default_device: Optional[str] = None
+        # last sample() reading per device label — the placement tier's
+        # memory-pressure input (serve/placement.py) reads it without
+        # re-polling PJRT on the request path
+        self._last_sample: Dict[str, Dict[str, Any]] = {}
         reg = get_registry()
         self._m_in_use = reg.gauge(
             "sparkml_device_mem_bytes_in_use",
@@ -141,12 +145,35 @@ class DeviceMonitor:
                 self._m_peak.set(peak_rss, device=label,
                                  source="host_rss")
             out.append(entry)
+        with self._lock:
+            for entry in out:
+                self._last_sample[entry["device"]] = entry
         try:
             self._m_overhead.inc(time.perf_counter() - t0,
                                  component="devmon")
         except Exception:
             pass
         return out
+
+    def last_sample(self, device: str) -> Optional[Dict[str, Any]]:
+        """The most recent ``sample()`` reading for one device label
+        (None before any sweep has run)."""
+        with self._lock:
+            return self._last_sample.get(device)
+
+    def memory_pressure(self, device: str) -> Optional[float]:
+        """in-use / limit for one device from the last sample, or None
+        when unknowable — no sample yet, no limit reported, or the
+        reading is host RSS (a process-wide number is not a per-device
+        verdict; the placement tier must not drain every replica at
+        once off one host gauge)."""
+        entry = self.last_sample(device)
+        if entry is None or entry.get("source") != "pjrt":
+            return None
+        limit = entry.get("bytes_limit")
+        if not limit:
+            return None
+        return float(entry.get("bytes_in_use", 0)) / float(limit)
 
     # -- batch-time attribution --------------------------------------------
 

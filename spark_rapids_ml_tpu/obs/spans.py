@@ -188,7 +188,9 @@ def active_spans() -> List[Dict[str, Any]]:
         ]
 
 
-def _activate(name: str, trace_id: str, t0: float) -> int:
+def _activate(name: str, trace_id: str, t0: float,
+              span_id: Optional[str] = None,
+              parent_span_id: Optional[str] = None) -> int:
     global _active_seq
     with _active_lock:
         _active_seq += 1
@@ -199,6 +201,14 @@ def _activate(name: str, trace_id: str, t0: float) -> int:
             "trace_id": trace_id,
             "tid": threading.get_ident(),
             "t0": t0,
+            # span identity, so assemble_trace can synthesize a
+            # provisional node for a STILL-OPEN span: the serving root
+            # (serve:http:predict) is recorded at context exit, AFTER
+            # the response bytes hit the socket — a fast client
+            # assembling its trace in that window must still see one
+            # rooted tree, not orphaned children.
+            "span_id": span_id,
+            "parent_span_id": parent_span_id,
         }
     return handle
 
@@ -321,7 +331,8 @@ def span(
     rng = TraceRange(name, color, record=False)
     rng.__enter__()
     t0 = time.perf_counter()
-    active_handle = _activate(name, tid_, t0)
+    active_handle = _activate(name, tid_, t0, span_id=span_id,
+                              parent_span_id=parent_span_id)
     error_type: Optional[str] = None
     try:
         yield tid_
@@ -408,8 +419,42 @@ def assemble_trace(trace_id: str,
     document is ONE tree spanning server → queue → batch → transform.
     """
     rec = recorder or _recorder
+    open_entries: List[Dict[str, Any]] = []
+    if rec is _recorder:
+        # Snapshot the OPEN-span table BEFORE the ring: a span exiting
+        # between the two reads then lands in the ring snapshot — the
+        # other order would miss it in both and intermittently return
+        # an orphaned forest.
+        with _active_lock:
+            open_entries = [dict(e) for e in _active.values()
+                            if e["trace_id"] == trace_id
+                            and e.get("span_id")]
     events = rec.events()
     own = [e for e in events if e.trace_id == trace_id]
+    if open_entries:
+        # Graft still-open spans in as provisional nodes (duration-so-
+        # far, marked "open"): a span records only at context exit,
+        # which for the serving root (serve:http:predict) happens AFTER
+        # the response bytes are on the socket — a client assembling
+        # its trace immediately after the reply must still see ONE
+        # rooted tree. A span that exited between the snapshots is in
+        # both; the recorded event wins.
+        now = time.perf_counter()
+        have = {e.span_id for e in own}
+        for entry in open_entries:
+            if entry["span_id"] in have:
+                continue
+            own.append(SpanEvent(
+                name=entry["name"],
+                ts_us=entry["t0"] * 1e6,
+                dur_us=max(now - entry["t0"], 0.0) * 1e6,
+                trace_id=trace_id,
+                depth=0,
+                tid=entry["tid"],
+                args={"open": True},
+                span_id=entry["span_id"],
+                parent_span_id=entry.get("parent_span_id"),
+            ))
     linked_trace_ids: List[str] = []
     for e in events:
         if e.links and trace_id in e.links and e.trace_id and \

@@ -39,9 +39,19 @@ The transform path PR 3 instrumented becomes an actual inference engine:
   over-quota excess (``ShedLoad`` → HTTP 503 + ``Retry-After``, never
   breaker food, every decision counted + audit-spanned;
   ``SPARK_RAPIDS_ML_TPU_SERVE_SCHED=fifo`` restores plain FIFO);
+* ``DevicePlacer`` (``serve.placement``) — the multi-device tier: every
+  async-capable model is **replicated onto each visible device** (one
+  batcher / staging pool / fair queue per replica), requests route to
+  the least-loaded healthy replica (``serve:placement`` audit spans), a
+  sick device **drains onto its siblings** behind a per-replica health
+  breaker (cooldown → half-open probe → re-entry), and requests above
+  the shard threshold run a ``NamedSharding``-over-``("batch",)``
+  program so one huge batch uses every chip; this module is the ONE
+  place in ``serve/`` allowed to enumerate devices (rule 12);
 * ``fault_plane`` (``serve.faults``) — the injectable chaos plane that
   proves all of the above: deterministic per-model raise / stall / NaN /
-  latency / worker-crash injection, via env or API;
+  latency / worker-crash injection (optionally device-TARGETED, for
+  replica-drain drills), via env or API;
 * ``start_serve_server`` (``serve.server``) — ``POST /predict`` /
   ``GET /healthz`` / ``GET /metrics`` plus the ops surface
   (``/debug/traces``, ``/debug/slo``, ``/dashboard``) over
@@ -86,6 +96,13 @@ from spark_rapids_ml_tpu.serve.scheduler import (  # noqa: F401
     FifoQueue,
     fair_scheduling_from_env,
 )
+from spark_rapids_ml_tpu.serve.placement import (  # noqa: F401
+    DevicePlacer,
+    Replica,
+    ReplicaHealth,
+    ReplicaSet,
+    serving_devices,
+)
 from spark_rapids_ml_tpu.serve.batching import (  # noqa: F401
     AsyncTransformSpec,
     BatcherClosed,
@@ -120,6 +137,7 @@ __all__ = [
     "BreakerOpen",
     "CircuitBreaker",
     "DeadlineExpired",
+    "DevicePlacer",
     "ENV_PREFIX",
     "EngineClosed",
     "FairQueue",
@@ -134,6 +152,9 @@ __all__ = [
     "PredictResult",
     "QueueFull",
     "RegisteredModel",
+    "Replica",
+    "ReplicaHealth",
+    "ReplicaSet",
     "ServeEngine",
     "ShedController",
     "ShedLoad",
@@ -148,5 +169,6 @@ __all__ = [
     "make_handler",
     "pipeline_depth_from_env",
     "reset_fault_plane",
+    "serving_devices",
     "start_serve_server",
 ]

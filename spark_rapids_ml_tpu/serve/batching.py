@@ -346,12 +346,18 @@ class MicroBatcher:
         async_spec: Optional[AsyncTransformSpec] = None,
         pipeline_depth: Optional[int] = None,
         queue=None,
+        device_label: Optional[str] = None,
     ):
         if max_batch_rows < 1:
             raise ValueError("max_batch_rows must be >= 1")
         self.transform_fn = transform_fn
         self.output_check = output_check
         self.name = name
+        # The replica tier (serve/placement.py): which device this
+        # batcher's dispatches land on — per-device batch attribution
+        # (devmon) and the per-replica batches counter both key on it.
+        # None = the pre-replica single-device behavior bit-for-bit.
+        self.device_label = device_label
         self.max_batch_rows = int(max_batch_rows)
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.max_queue_depth = int(max_queue_depth)
@@ -525,6 +531,15 @@ class MicroBatcher:
             "batches currently in the async in-flight window", ("model",),
         )
         self._m_window.set(0, model=self.name)
+        self._m_replica_batches = reg.counter(
+            "sparkml_serve_replica_batches_total",
+            "coalesced batches served per (model, device) replica — the "
+            "multi-device tier's per-replica dispatch evidence",
+            ("model", "device"),
+        )
+        if self.device_label is not None:
+            self._m_replica_batches.inc(0, model=self.name,
+                                        device=self.device_label)
 
     # -- submission --------------------------------------------------------
 
@@ -640,6 +655,12 @@ class MicroBatcher:
     def depth(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def load(self) -> int:
+        """Queued requests plus in-flight batches — the placement
+        tier's least-loaded signal for this replica."""
+        with self._lock:
+            return len(self._queue) + len(self._inflight)
 
     def dead(self) -> bool:
         """Restart budget exhausted (or the worker died with none left):
@@ -1127,9 +1148,11 @@ class MicroBatcher:
             entry.watchdog = None
         busy_delta = self._note_complete(entry)
         # per-device occupancy attribution (obs.devmon — never raises):
-        # the mesh-serving PR reads its evidence from this. Union busy
-        # time, so overlapping window entries are not double-counted.
-        self._devmon.note_batch(self.name, busy_delta)
+        # the placement tier reads its least-loaded signal from this.
+        # Union busy time, so overlapping window entries are not
+        # double-counted; a replica batcher attributes to ITS device.
+        self._devmon.note_batch(self.name, busy_delta,
+                                device=self.device_label)
         if self._retire_entry(entry, gen):
             # The watchdog declared this window wedged (and failed it)
             # while the result was still in flight; the late result is
@@ -1144,6 +1167,22 @@ class MicroBatcher:
             self._m_requests.inc(len(entry.batch), model=self.name,
                                  outcome="error")
             return
+        # Batch telemetry BEFORE the latches resolve: the moment a
+        # member's latch releases, its HTTP response can land and the
+        # client may assemble its trace — the fan-in transform span and
+        # the serve:sync event must already be in the span ring by then
+        # (a resolve-first ordering made the assembled tree race the
+        # worker thread and intermittently miss the transform span).
+        # Exception-guarded: the reorder put telemetry UPSTREAM of the
+        # latch resolution, and the entry is already retired from the
+        # supervision window — a telemetry raise here would otherwise
+        # strand every member to its wait timeout with the results
+        # computed and lost.
+        try:
+            self._record_batch(entry.n, entry.bucket, len(entry.batch))
+            self._record_pipeline(entry, out)
+        except Exception:  # noqa: BLE001 - telemetry, not control flow
+            self._m_errors.inc(model=self.name, error="batch_telemetry")
         offset = 0
         for req in entry.batch:
             # resolve under the member's own context: anything recorded
@@ -1154,8 +1193,6 @@ class MicroBatcher:
             offset += req.n
         self._m_requests.inc(len(entry.batch), model=self.name,
                              outcome="ok")
-        self._record_batch(entry.n, entry.bucket, len(entry.batch))
-        self._record_pipeline(entry, out)
 
     def _complete_batch(self, entry: _InFlight) -> np.ndarray:
         """THE pipeline's designated host-sync point: the only place in
@@ -1257,6 +1294,9 @@ class MicroBatcher:
         self._m_batch_rows.inc(real_rows, model=self.name)
         self._m_bucket_rows.inc(bucket, model=self.name)
         self._m_coalesced.inc(n_requests, model=self.name)
+        if self.device_label is not None:
+            self._m_replica_batches.inc(model=self.name,
+                                        device=self.device_label)
 
     def expected_signatures(self) -> int:
         """How many distinct compiled shapes steady-state traffic through

@@ -86,6 +86,17 @@ Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``, constructor args win):
   max-abs error for float outputs, mismatch fraction for label outputs;
 * ``..._SCHED``           (default ``fair``) — the queue discipline:
   ``fifo`` is the kill switch restoring the plain FIFO deque;
+* ``..._REPLICAS``        (default 0 = all visible devices) — how many
+  devices the multi-replica tier (``serve.placement``) replicates each
+  async-capable model onto; 1 restores single-device serving;
+* ``..._SHARD_ROWS``      (default 0 = auto: > max_batch_rows) — rows
+  above which a request routes to the batch-sharded multi-device
+  program instead of the replicated batchers;
+* ``..._REPLICA_FAILURES`` / ``..._REPLICA_COOLDOWN_MS`` /
+  ``..._REPLICA_MEM_PRESSURE`` — the per-replica drain machinery: the
+  consecutive-failure threshold that removes a replica from the
+  placement set, the half-open probe cooldown, and the PJRT memory
+  in-use/limit fraction above which placement skips a replica;
 * ``..._TENANT_*`` / ``..._PRIORITY_*`` / ``..._SHED_*`` — multi-tenant
   quotas, priority classes, and the adaptive load-shedding controller
   (see ``serve.admission``); requests enter through the admission
@@ -110,6 +121,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
 from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.devmon import get_device_monitor
 from spark_rapids_ml_tpu.obs.serving import (
     ServingProgram,
     check_output_numerics,
@@ -138,7 +150,18 @@ from spark_rapids_ml_tpu.serve.batching import (
 )
 from spark_rapids_ml_tpu.serve.breaker import BreakerOpen, CircuitBreaker
 from spark_rapids_ml_tpu.serve.fallback import cpu_fallback
+from spark_rapids_ml_tpu.serve import placement as placement_mod
+from spark_rapids_ml_tpu.serve.placement import (
+    DevicePlacer,
+    Replica,
+    ReplicaHealth,
+    ReplicaSet,
+)
 from spark_rapids_ml_tpu.serve.registry import ModelRegistry, RegisteredModel
+from spark_rapids_ml_tpu.utils.padding import (
+    pad_to_shard_bucket,
+    shard_bucket,
+)
 
 ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
 
@@ -336,6 +359,9 @@ class ServeEngine:
         tenant_quotas: Optional[Dict[str, Any]] = None,
         tenant_weights: Optional[Dict[str, float]] = None,
         shed: Optional[ShedController] = None,
+        replicas: Optional[int] = None,
+        shard_rows: Optional[int] = None,
+        placement: Optional[DevicePlacer] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
@@ -418,9 +444,28 @@ class ServeEngine:
                             self.retry_after_estimate)
         self._retry_after_max_s = _env_number("SHED_RETRY_AFTER_MAX_S",
                                               30.0)
-        self._batchers: Dict[Tuple[str, int], MicroBatcher] = {}
+        # -- the multi-device replica tier (serve.placement) --------------
+        # Each async-capable model version is replicated onto every
+        # placement device: its own batcher/staging-pool/fair-queue per
+        # replica, requests routed least-loaded, sick replicas drained
+        # onto siblings. shard_rows (0 = auto: > max_batch_rows) routes
+        # oversize requests to the NamedSharding-over-("batch",) program
+        # so one huge request uses all chips instead of one.
+        if placement is not None:
+            self.placer = placement
+        elif replicas is not None:
+            self.placer = DevicePlacer(
+                devices=placement_mod.serving_devices(limit=replicas),
+                clock=clock)
+        else:
+            self.placer = DevicePlacer(clock=clock)
+        self.shard_rows = int(
+            shard_rows if shard_rows is not None
+            else _env_number("SHARD_ROWS", 0))
+        self._replicas: Dict[Tuple[str, int], ReplicaSet] = {}
         self._async_specs: Dict[
             Tuple[str, int], Optional[AsyncTransformSpec]] = {}
+        self._sharded_programs: Dict[Tuple[str, int], Any] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._fallbacks: Dict[Tuple[str, int], Any] = {}
         self._lock = threading.Lock()
@@ -455,7 +500,32 @@ class ServeEngine:
         )
         self._m_tenant.inc(0, tenant=self.admission.default_tenant,
                            outcome="ok")
+        self._m_sharded = reg.counter(
+            "sparkml_serve_sharded_requests_total",
+            "oversize requests served by the batch-sharded multi-device "
+            "program instead of one replica", ("model",),
+        )
+        self._m_sharded_rows = reg.counter(
+            "sparkml_serve_sharded_rows_total",
+            "rows served through the batch-sharded program", ("model",),
+        )
         _live_engines.add(self)
+
+    @property
+    def _batchers(self) -> Dict[Tuple[str, int], MicroBatcher]:
+        """Back-compat view: (name, version) → the PRIMARY replica's
+        batcher (the only replica on single-device processes — the
+        pre-replica shape, bit-for-bit). Read-only snapshot; the engine
+        itself iterates ``self._replicas``."""
+        with self._lock:
+            return {key: rset.primary.batcher
+                    for key, rset in self._replicas.items()}
+
+    def _all_batchers(self) -> List[MicroBatcher]:
+        with self._lock:
+            return [replica.batcher
+                    for rset in self._replicas.values()
+                    for replica in rset.replicas]
 
     # -- the request path --------------------------------------------------
 
@@ -548,9 +618,16 @@ class ServeEngine:
                     out = self._degraded_predict(entry, rows, ctx)
                     degraded, retries = True, 0
                 else:
+                    # Oversize requests route to the batch-sharded
+                    # multi-device program (one huge request uses every
+                    # chip) instead of being rejected at the batcher's
+                    # max_batch_rows door.
+                    shard = self._should_shard(
+                        entry, _rows_estimate(rows))
                     out, retries, degraded = self._attempts(
                         entry, rows, deadline, handoff, timeout,
                         brk, gate, ctx, submitted, decision,
+                        shard=shard,
                     )
         except BaseException as exc:
             # Client errors (unknown model, a bad request shape rejected
@@ -614,6 +691,7 @@ class ServeEngine:
         ctx: tracectx.TraceContext,
         submitted: List[bool],
         decision=None,
+        shard: bool = False,
     ) -> Tuple[np.ndarray, int, bool]:
         """The bounded-retry loop: (outputs, retries_used, degraded)."""
         probe = gate == "probe"
@@ -625,7 +703,7 @@ class ServeEngine:
                 if attempt == 1:
                     out = self._one_attempt(entry, rows, deadline, handoff,
                                             timeout, submitted, decision,
-                                            revive=probe)
+                                            revive=probe, shard=shard)
                 else:
                     # Retries are child spans of the SAME request trace:
                     # the tree shows every re-entry, not a flat mystery.
@@ -635,7 +713,8 @@ class ServeEngine:
                     ):
                         out = self._one_attempt(entry, rows, deadline,
                                                 handoff, timeout,
-                                                submitted, decision)
+                                                submitted, decision,
+                                                shard=shard)
             except BaseException as exc:  # noqa: BLE001 - classified below
                 if isinstance(exc, (QueueFull, ShedLoad, DeadlineExpired,
                                     KeyError, EngineClosed, WaitTimeout)):
@@ -686,19 +765,61 @@ class ServeEngine:
 
     def _one_attempt(self, entry, rows, deadline, handoff, timeout,
                      submitted: List[bool], decision=None,
-                     revive: bool = False) -> np.ndarray:
-        batcher = self._batcher_for(entry, revive=revive)
-        if decision is not None:
-            req = batcher.submit(rows, deadline=deadline,
-                                 trace_ctx=handoff,
-                                 tenant=decision.tenant,
-                                 priority=decision.priority,
-                                 over_quota=decision.over_quota)
-        else:
-            req = batcher.submit(rows, deadline=deadline,
-                                 trace_ctx=handoff)
-        submitted[0] = True
-        return req.wait(timeout)
+                     revive: bool = False,
+                     shard: bool = False) -> np.ndarray:
+        if shard:
+            # re-resolve HERE and hand the program down: an evict (a
+            # version rollover) between predict's _should_shard check
+            # and this attempt can drop the cached program, and a
+            # rebuild may legitimately fail — the request then falls
+            # through to the replicated path (whose submit raises the
+            # documented oversize ValueError) instead of crashing on a
+            # None program.
+            prog = self._sharded_program_for(entry)
+            if prog is not None:
+                return self._sharded_attempt(entry, rows, deadline,
+                                             handoff, submitted, prog)
+        rset = self._replica_set_for(entry)
+        replica = self.placer.pick(rset, trace_ctx=handoff)
+        multi = len(rset.replicas) > 1
+        if replica.batcher.dead() and (
+                revive or (multi and replica.health.probing)):
+            # the model-level breaker probe (single replica) or the
+            # replica-health half-open probe (multi-replica) revives a
+            # dead batcher — probe cadence bounds recreate storms
+            self._revive_replica(entry, replica)
+        batcher = replica.batcher
+        try:
+            if decision is not None:
+                req = batcher.submit(rows, deadline=deadline,
+                                     trace_ctx=handoff,
+                                     tenant=decision.tenant,
+                                     priority=decision.priority,
+                                     over_quota=decision.over_quota)
+            else:
+                req = batcher.submit(rows, deadline=deadline,
+                                     trace_ctx=handoff)
+            submitted[0] = True
+            out = req.wait(timeout)
+        except BaseException as exc:
+            # Per-replica drain: backend-classified failures count
+            # against THIS replica's health — past the threshold it
+            # leaves the placement set and traffic sheds onto siblings
+            # (the model-level breaker still sees the failure through
+            # the retry loop's own classification, unchanged). A
+            # non-backend outcome (orderly shed, caller timeout) on a
+            # half-open probe releases the claim without a verdict.
+            if multi:
+                if is_backend_error(exc):
+                    if replica.health.note_failure():
+                        self.placer.publish_state(rset)
+                else:
+                    replica.health.release_probe()
+            raise
+        if multi and replica.health.note_success():
+            # a successful half-open probe re-enters a drained replica
+            self.placer.publish_state(rset)
+        return out
 
     def _backoff_delay(self, failed_attempt: int) -> float:
         """Exponential backoff with jitter: base · 2^(attempt-1), scaled
@@ -782,17 +903,21 @@ class ServeEngine:
 
         return check
 
-    def _serving_program(self, entry: RegisteredModel,
-                         precision: str) -> Optional[ServingProgram]:
-        """The model's device-resident serving program at ``precision``,
-        or None (no hook / host-path model / program construction
-        failed). Failures are counted, never raised — the sync path is
-        always there."""
+    def _serving_program(self, entry: RegisteredModel, precision: str,
+                         device=None) -> Optional[ServingProgram]:
+        """The model's device-resident serving program at ``precision``
+        (pinned to ``device`` — one program per replica device; None =
+        the model's own resolution), or None (no hook / host-path model
+        / program construction failed). Failures are counted, never
+        raised — the sync path is always there."""
         hook = getattr(entry.model, "serving_transform_program", None)
         if not callable(hook):
             return None
         try:
-            prog = hook(precision=precision)
+            if device is not None:
+                prog = hook(precision=precision, device=device)
+            else:
+                prog = hook(precision=precision)
         except Exception:
             self._m_errors.inc(model=entry.name, error="serving_program")
             return None
@@ -852,21 +977,56 @@ class ServeEngine:
                        verdict="error")
             return False
 
-    def _async_spec_for(self, entry: RegisteredModel
+    def _make_async_spec(self, entry: RegisteredModel,
+                         prog: ServingProgram,
+                         device_label: Optional[str] = None,
+                         ) -> AsyncTransformSpec:
+        """Wrap one replica's ``ServingProgram`` with the fault plane —
+        ``raise``/``stall``/``latency`` fire at dispatch, ``nan``
+        corruption applies at the completion-step fetch so the NaN
+        guard sees it exactly like the sync path. ``device_label`` is
+        handed to the plane so device-TARGETED faults (the replica-
+        drain chaos drill) hit only their replica."""
+        name = entry.name
+
+        def dispatch(x_dev, _prog=prog):
+            # resolve the plane per call (like the sync closure): a
+            # batcher outliving reset_fault_plane() must consult the
+            # LIVE plane, or later-armed faults never fire here
+            spec_ = faults_mod.fault_plane().begin_call(
+                name, device=device_label)
+            if spec_ is not None:
+                faults_mod.apply_pre(spec_)
+            return _prog.run(x_dev), spec_
+
+        def complete(handle, _prog=prog):
+            out_dev, spec_ = handle
+            out = _prog.fetch(out_dev)
+            if spec_ is not None and spec_.kind == "nan":
+                out = faults_mod.corrupt(spec_, out)
+            return out
+
+        return AsyncTransformSpec(
+            stage=prog.put, dispatch=dispatch, complete=complete,
+            dtype=prog.dtype, algo=prog.algo,
+            precision=prog.precision, program=prog,
+        )
+
+    def _async_spec_for(self, entry: RegisteredModel, device=None,
+                        device_label: Optional[str] = None,
                         ) -> Optional[AsyncTransformSpec]:
-        """Build (and cache) the pipelined-batcher spec for one model
-        version: the model's ``ServingProgram`` at the engine's precision
-        (max-error-guarded, falling back to native), wrapped with the
-        fault plane — ``raise``/``stall``/``latency`` fire at dispatch,
-        ``nan`` corruption applies at the completion-step fetch so the
-        NaN guard sees it exactly like the sync path."""
+        """Build (and cache) the PRIMARY pipelined-batcher spec for one
+        model version: the model's ``ServingProgram`` at the engine's
+        precision (max-error-guarded, falling back to native), fault-
+        plane-wrapped. Secondary replicas are built by
+        ``_replica_specs`` at the precision this one resolved."""
         key = (entry.name, entry.version)
         with self._lock:
             if key in self._async_specs:
                 return self._async_specs[key]
-        prog = self._serving_program(entry, self.precision)
+        prog = self._serving_program(entry, self.precision, device=device)
         if prog is not None and self.precision != "native":
-            native = self._serving_program(entry, "native")
+            native = self._serving_program(entry, "native", device=device)
             if native is None or not self._precision_ok(
                     entry, native, prog):
                 get_registry().counter(
@@ -878,120 +1038,295 @@ class ServeEngine:
                 prog = native
         spec: Optional[AsyncTransformSpec] = None
         if prog is not None:
-            name = entry.name
-
-            def dispatch(x_dev, _prog=prog):
-                # resolve the plane per call (like the sync closure): a
-                # batcher outliving reset_fault_plane() must consult the
-                # LIVE plane, or later-armed faults never fire here
-                spec_ = faults_mod.fault_plane().begin_call(name)
-                if spec_ is not None:
-                    faults_mod.apply_pre(spec_)
-                return _prog.run(x_dev), spec_
-
-            def complete(handle, _prog=prog):
-                out_dev, spec_ = handle
-                out = _prog.fetch(out_dev)
-                if spec_ is not None and spec_.kind == "nan":
-                    out = faults_mod.corrupt(spec_, out)
-                return out
-
-            spec = AsyncTransformSpec(
-                stage=prog.put, dispatch=dispatch, complete=complete,
-                dtype=prog.dtype, algo=prog.algo,
-                precision=prog.precision, program=prog,
-            )
+            spec = self._make_async_spec(entry, prog,
+                                         device_label=device_label)
         with self._lock:
             self._async_specs[key] = spec
         return spec
 
-    def _batcher_for(self, entry: RegisteredModel,
-                     revive: bool = False) -> MicroBatcher:
+    def _replica_specs(self, entry: RegisteredModel,
+                       ) -> List[Tuple[Any, Optional[str],
+                                       Optional[AsyncTransformSpec]]]:
+        """The (device, label, spec) plan for one model version's
+        replica set — built OUTSIDE the engine lock (program
+        construction touches every device: weight staging, the offline
+        precision check).
+
+        Replication happens only for async-capable models: a model
+        without a ``ServingProgram`` runs the blocking host loop on the
+        process default device, which cannot be pinned per replica —
+        it stays a single replica exactly as before this tier existed.
+        PIPELINE_DEPTH=1 at native precision is still the kill switch:
+        one replica, the exact pre-pipeline blocking path.
+
+        A model EXPLICITLY pinned via ``setDeviceId`` keeps its pin:
+        one replica, on the model's own resolved device — replication
+        would silently override an operator's placement decision (and
+        before this tier existed, the serving program always honored
+        the pin)."""
+        if not callable(getattr(entry.model,
+                                "serving_transform_program", None)):
+            # host-path model: no program to replicate, and the
+            # placement tier must not even ENUMERATE devices for it —
+            # that first jax.devices() call initializes the backend,
+            # a ~tens-of-ms stall a pure-host serving process never
+            # paid before this tier existed
+            return [(None, None, None)]
+        devices = self.placer.devices()
+        pinned_id = -1
+        get_dev = getattr(entry.model, "getDeviceId", None)
+        if callable(get_dev):
+            try:
+                pinned_id = int(get_dev())
+            except (TypeError, ValueError):
+                pinned_id = -1
+        if pinned_id >= 0:
+            spec = None
+            if self.pipeline_depth > 1 or self.precision != "native":
+                # device=None: the model's own resolution (the pin)
+                spec = self._async_spec_for(entry)
+            pinned_dev = next(
+                (d for d in devices
+                 if getattr(d, "id", None) == pinned_id), None)
+            label = (placement_mod.device_label(pinned_dev)
+                     if pinned_dev is not None else None)
+            return [(pinned_dev, label, spec)]
+        primary_dev = devices[0] if devices else None
+        primary_label = (placement_mod.device_label(primary_dev)
+                         if primary_dev is not None else None)
+        spec = None
+        if self.pipeline_depth > 1 or self.precision != "native":
+            spec = self._async_spec_for(entry, device=primary_dev,
+                                        device_label=primary_label)
+        if spec is None:
+            # sync-path model (or the kill switch): single replica. The
+            # spec cache may still hold one from an earlier construction
+            # (the PR 9 TOCTOU lesson: a dead batcher revive must not
+            # silently downgrade an async model to the blocking path).
+            with self._lock:
+                spec = self._async_specs.get((entry.name, entry.version))
+            if spec is None:
+                return [(primary_dev, primary_label, None)]
+            return [(primary_dev, primary_label, spec)]
+        plan = [(primary_dev, primary_label, spec)]
+        for dev in devices[1:]:
+            label = placement_mod.device_label(dev)
+            # secondary replicas compile at the precision the PRIMARY's
+            # guard resolved — the max-error check runs once, and the
+            # ladder is identical on every chip
+            prog = self._serving_program(entry, spec.precision,
+                                         device=dev)
+            if prog is None:
+                continue
+            plan.append((dev, label,
+                         self._make_async_spec(entry, prog,
+                                               device_label=label)))
+        return plan
+
+    def _make_replica_batcher(self, entry: RegisteredModel,
+                              async_spec: Optional[AsyncTransformSpec],
+                              label: Optional[str],
+                              replicated: bool) -> MicroBatcher:
+        """One replica's batcher. Caller holds the engine lock (the
+        MicroBatcher constructor takes no device work — programs were
+        already staged when the spec was built)."""
+        buckets = self.buckets or entry.buckets
+        return MicroBatcher(
+            self._make_transform_fn(entry),
+            name=entry.name,
+            max_batch_rows=self.max_batch_rows,
+            max_wait_ms=self.max_wait_ms,
+            max_queue_depth=self.max_queue_depth,
+            buckets=buckets,
+            worker_budget_s=self.worker_budget_s,
+            max_restarts=self.max_worker_restarts,
+            output_check=self._make_output_check(entry),
+            dtype=(async_spec.dtype if async_spec is not None
+                   else np.float64),
+            async_spec=async_spec,
+            pipeline_depth=self.pipeline_depth,
+            queue=self._make_queue(label),
+            device_label=label if replicated else None,
+        )
+
+    def _replica_set_for(self, entry: RegisteredModel) -> ReplicaSet:
+        """The model version's replica set, built on first use: one
+        batcher (own worker, staging pool, fair queue) per placement
+        device for async-capable models; a single default-device
+        replica otherwise."""
         key = (entry.name, entry.version)
-        corpse: Optional[MicroBatcher] = None
-        async_spec = None
         with self._lock:
-            existing = self._batchers.get(key)
-            need_new = existing is None or (existing.dead() and revive)
-        if need_new and (self.pipeline_depth > 1
-                         or self.precision != "native"):
-            # Built OUTSIDE the engine lock: program construction touches
-            # the device (device_put of the model state, the offline
-            # precision check) and must not stall concurrent predicts.
-            # PIPELINE_DEPTH=1 at native precision is the kill switch:
-            # the batcher then runs the exact pre-pipeline blocking path.
-            async_spec = self._async_spec_for(entry)
+            rset = self._replicas.get(key)
+        if rset is not None:
+            return rset
+        plan = self._replica_specs(entry)
         with self._lock:
             if self._closed:
                 raise EngineClosed("serving engine is shut down")
-            if async_spec is None:
-                # TOCTOU guard: the batcher can die between the pre-check
-                # (which saw it alive and skipped spec construction) and
-                # this lock — a revive here would otherwise rebuild it
-                # with async_spec=None, silently downgrading the model to
-                # the blocking f64 path forever. The cache holds the spec
-                # from the original construction (None only for genuinely
-                # sync-path models).
-                async_spec = self._async_specs.get(key)
-            batcher = self._batchers.get(key)
-            if batcher is not None and batcher.dead() and revive:
-                # A dead batcher (restart budget exhausted) fails
-                # submits fast — the satellite contract — but the
-                # breaker's half-open PROBE must be able to reach the
-                # device again, or the model could never recover: the
-                # probe would fail without a device verdict and re-open
-                # the breaker forever. Probes therefore revive the
-                # batcher with a fresh worker; probe cadence (the
-                # breaker cooldown) is what bounds recreate storms, so
-                # max_restarts keeps meaning "stop restarting under
-                # sustained crashing".
-                corpse = self._batchers.pop(key)
-                batcher = None
-            if batcher is None:
-                buckets = self.buckets or entry.buckets
-                batcher = MicroBatcher(
-                    self._make_transform_fn(entry),
-                    name=entry.name,
-                    max_batch_rows=self.max_batch_rows,
-                    max_wait_ms=self.max_wait_ms,
-                    max_queue_depth=self.max_queue_depth,
-                    buckets=buckets,
-                    worker_budget_s=self.worker_budget_s,
-                    max_restarts=self.max_worker_restarts,
-                    output_check=self._make_output_check(entry),
-                    dtype=(async_spec.dtype if async_spec is not None
-                           else np.float64),
-                    async_spec=async_spec,
-                    pipeline_depth=self.pipeline_depth,
-                    queue=self._make_queue(),
-                )
-                self._batchers[key] = batcher
-                # flat-0 series for the engine-level counters too
-                self._m_retries.inc(0, model=entry.name)
-                self._m_degraded.inc(0, model=entry.name)
+            rset = self._replicas.get(key)
+            if rset is not None:
+                return rset  # lost the construction race; specs cached
+            replicated = len(plan) > 1
+            replicas: List[Replica] = []
+            for device, label, spec in plan:
+                batcher = self._make_replica_batcher(
+                    entry, spec, label, replicated)
+                replica = Replica(device, label or "default", batcher,
+                                  ReplicaHealth(clock=self._clock))
+                replica.spec = spec
+                replicas.append(replica)
+            rset = ReplicaSet(entry.name, entry.version, replicas)
+            self._replicas[key] = rset
+            # flat-0 series for the engine-level counters too
+            self._m_retries.inc(0, model=entry.name)
+            self._m_degraded.inc(0, model=entry.name)
             stale = self._stale_keys(entry.name)
-        # Outside the lock: retire batchers for versions the registry no
+        self.placer.publish_state(rset)
+        # Outside the lock: retire sets for versions the registry no
         # longer knows (deregistered after a rollover) — otherwise every
-        # rolled version leaks a worker thread and pins its model forever.
+        # rolled version leaks worker threads and pins its model forever.
         # ``key`` itself just resolved, so it is never in the stale set.
+        for k in stale:
+            self.evict(*k)
+        return rset
+
+    def _revive_replica(self, entry: RegisteredModel,
+                        replica: Replica) -> None:
+        """Replace one replica's DEAD batcher (restart budget exhausted)
+        with a fresh one — the half-open probe path: the model-level
+        breaker's probe (single replica) or the replica-health probe
+        (multi-replica) is what bounds recreate storms, so max_restarts
+        keeps meaning "stop restarting under sustained crashing"."""
+        corpse: Optional[MicroBatcher] = None
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("serving engine is shut down")
+            if replica.batcher is not None and replica.batcher.dead():
+                corpse = replica.batcher
+                replica.batcher = self._make_replica_batcher(
+                    entry, replica.spec, corpse.device_label,
+                    corpse.device_label is not None)
         if corpse is not None:
             # worker already dead — the close is just the final sweep
             corpse.close(drain=False, timeout=0.1)
-        for k in stale:
-            self.evict(*k)
-        return batcher
 
-    def _make_queue(self):
+    def _make_queue(self, device: Optional[str] = None):
         """The queue discipline for a new batcher: the weighted-fair
         scheduler (SFQ over row-cost virtual time, interactive-first
-        under shed pressure) — or None (→ the batcher's FIFO deque)
+        under shed pressure), stamped with its replica's device — one
+        virtual timeline PER REPLICA, so the fairness contract holds on
+        every device independently. None (→ the batcher's FIFO deque)
         when the ``SCHED=fifo`` kill switch is set."""
         if not self.fair_scheduling:
             return None
         return FairQueue(
             tenant_weights=self.admission.tenant_weights,
             pressure_fn=self.admission.shed.pressure,
+            device=device,
         )
+
+    # -- the sharded big-transform path ------------------------------------
+
+    def shard_threshold(self) -> int:
+        """Rows above which a request routes to the batch-sharded
+        program (``SPARK_RAPIDS_ML_TPU_SERVE_SHARD_ROWS``; 0 = auto:
+        anything the single-replica coalescer cannot hold, i.e.
+        > max_batch_rows)."""
+        return self.shard_rows if self.shard_rows > 0 \
+            else self.max_batch_rows
+
+    def _should_shard(self, entry: RegisteredModel, n_rows: int) -> bool:
+        if n_rows <= self.shard_threshold():
+            return False
+        return self._sharded_program_for(entry) is not None
+
+    def _sharded_program_for(self, entry: RegisteredModel):
+        """The model's ``NamedSharding``-over-``("batch",)`` program
+        (cached; None when unshardable: < 2 devices, no stage hooks,
+        un-wired pipeline chain, or construction failed — oversize
+        requests then keep the pre-shard ValueError)."""
+        key = (entry.name, entry.version)
+        with self._lock:
+            if key in self._sharded_programs:
+                return self._sharded_programs[key]
+        devices = self.placer.devices()
+        prog = None
+        if len(devices) >= 2:
+            from spark_rapids_ml_tpu.models._serving import (
+                build_batch_sharded_program,
+            )
+
+            try:
+                # native precision: the sharded path serves the huge
+                # analytical batches — full precision, the reduced
+                # ladders stay on the replicated small-request path
+                prog = build_batch_sharded_program(
+                    entry.model, devices=devices, precision="native")
+            except Exception:
+                self._m_errors.inc(model=entry.name,
+                                   error="sharded_program")
+                prog = None
+        with self._lock:
+            self._sharded_programs[key] = prog
+        return prog
+
+    def _sharded_attempt(self, entry: RegisteredModel, rows, deadline,
+                         handoff: tracectx.TraceContext,
+                         submitted: List[bool], prog) -> np.ndarray:
+        """Serve one oversize request through the batch-sharded program
+        (``prog``, resolved by the caller): rows scatter across the
+        ``("batch",)`` mesh, every chip computes its shard of the one
+        GEMM-shaped transform, the fetch gathers. Runs inline on the
+        caller's thread (a request this large IS a batch — coalescing
+        it with others would only delay it), inside the same
+        retry/breaker machinery as the replicated path."""
+        devices = self.placer.devices()
+        n_dev = len(devices)
+        x = np.asarray(rows, dtype=prog.dtype)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2 or x.shape[0] == 0:
+            raise ValueError(
+                f"expected a non-empty (n, d) request, got shape {x.shape}"
+            )
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExpired(
+                f"{entry.name}: deadline expired before the sharded "
+                "dispatch")
+        padded, n = pad_to_shard_bucket(x, n_dev)
+        submitted[0] = True
+        t0 = time.perf_counter()
+        with spans_mod.span(
+            f"serve:sharded:{entry.name}", trace_id=handoff.trace_id,
+            model=entry.name, rows=n, devices=n_dev,
+            bucket=int(padded.shape[0]),
+        ):
+            # the fault plane hooks this path like every other dispatch
+            # site, so chaos drills can fault the sharded program too
+            spec_ = faults_mod.fault_plane().begin_call(entry.name)
+            if spec_ is not None:
+                faults_mod.apply_pre(spec_)
+            out = prog.fetch(prog.run(prog.put(padded)))
+            if spec_ is not None and spec_.kind == "nan":
+                out = faults_mod.corrupt(spec_, out)
+        if out.shape[0] < n:
+            raise ValueError(
+                f"{entry.name}: sharded transform returned "
+                f"{out.shape[0]} rows for a batch of {n}")
+        out = out[:n]
+        check = self._make_output_check(entry)
+        if check is not None:
+            check(out)
+        elapsed = time.perf_counter() - t0
+        self._m_sharded.inc(model=entry.name)
+        self._m_sharded_rows.inc(n, model=entry.name)
+        # per-device attribution: the one sharded dispatch occupied
+        # every chip for (approximately) the same interval
+        monitor = get_device_monitor()
+        for dev in devices:
+            monitor.note_batch(entry.name, elapsed / n_dev,
+                               device=placement_mod.device_label(dev))
+        return out
 
     # -- overload introspection --------------------------------------------
 
@@ -1001,8 +1336,7 @@ class ServeEngine:
         queue's depth fraction. Called through
         ``ShedController.maybe_refresh`` at a bounded cadence — never
         per request."""
-        with self._lock:
-            batchers = list(self._batchers.values())
+        batchers = self._all_batchers()
         wait = max((b.queue_wait_estimate() for b in batchers),
                    default=0.0)
         depth_frac = max(
@@ -1050,8 +1384,7 @@ class ServeEngine:
         derived from the live queue-wait estimate (clamped to
         ``[1, SHED_RETRY_AFTER_MAX_S]``) — the ``Retry-After`` header
         on 429/503/504 responses."""
-        with self._lock:
-            batchers = list(self._batchers.values())
+        batchers = self._all_batchers()
         wait = max((b.queue_wait_estimate() for b in batchers),
                    default=0.0)
         return float(min(max(2.0 * wait, 1.0),
@@ -1088,11 +1421,11 @@ class ServeEngine:
             return self._fallbacks[key]
 
     def _stale_keys(self, name: str):
-        """Batcher keys for ``name`` whose version the registry has
+        """Replica-set keys for ``name`` whose version the registry has
         dropped. Pinned aliases keep their entries registered, so live
         old-version traffic is never evicted. Caller holds the lock."""
         stale = []
-        for key in self._batchers:
+        for key in self._replicas:
             if key[0] != name:
                 continue
             try:
@@ -1102,19 +1435,21 @@ class ServeEngine:
         return stale
 
     def evict(self, name: str, version: int, drain: bool = True) -> bool:
-        """Close and drop one (name, version) batcher — call after
+        """Close and drop one (name, version) replica set — call after
         ``registry.deregister`` (or rely on the automatic sweep the next
-        time a new version's batcher is created). Returns whether a
-        batcher existed. The batcher's ``close`` ends with a sweep under
-        its own lock, so requests racing the eviction still get exactly
-        one terminal outcome."""
+        time a new version's set is created). Returns whether one
+        existed. Each batcher's ``close`` ends with a sweep under its
+        own lock, so requests racing the eviction still get exactly one
+        terminal outcome."""
         with self._lock:
-            batcher = self._batchers.pop((name, version), None)
+            rset = self._replicas.pop((name, version), None)
             self._fallbacks.pop((name, version), None)
             self._async_specs.pop((name, version), None)
-        if batcher is None:
+            self._sharded_programs.pop((name, version), None)
+        if rset is None:
             return False
-        batcher.close(drain=drain)
+        for replica in rset.replicas:
+            replica.batcher.close(drain=drain)
         return True
 
     def warmup(self, model_ref: str, *, n_features: Optional[int] = None):
@@ -1139,33 +1474,65 @@ class ServeEngine:
             buckets=self.buckets or entry.buckets,
             max_bucket_rows=self.max_batch_rows,
         )
-        spec = None
-        if self.pipeline_depth > 1 or self.precision != "native":
-            spec = self._async_spec_for(entry)
-        if spec is not None and spec.program is not None:
-            prog = spec.program
-            chosen = sorted(int(b) for b in report["buckets"])
-            if n_features is None:
-                from spark_rapids_ml_tpu.serve.registry import (
-                    _infer_features,
-                )
+        # The replica tier: building the set stages every replica's
+        # ServingProgram (weights device_put once per device); warming
+        # then precompiles the full bucket × precision ladder ON EVERY
+        # DEVICE — the first real request through any replica never
+        # pays an XLA compile, whichever chip placement picks.
+        rset = self._replica_set_for(entry)
+        chosen = sorted(int(b) for b in report["buckets"])
+        if n_features is None:
+            from spark_rapids_ml_tpu.serve.registry import (
+                _infer_features,
+            )
 
-                n_features = _infer_features(entry.model)
+            n_features = _infer_features(entry.model)
+        replica_report: Dict[str, Dict[int, float]] = {}
+        primary_spec = rset.primary.spec
+        for replica in rset.replicas:
+            spec = replica.spec
+            if spec is None or spec.program is None \
+                    or n_features is None:
+                continue
+            prog = spec.program
             ladder: Dict[int, float] = {}
-            if n_features is not None:
-                for bucket in chosen:
-                    zeros = np.zeros((bucket, int(n_features)),
-                                     dtype=spec.dtype)
-                    t0 = time.perf_counter()
-                    with spans_mod.span(
-                        f"serve:warmup_pipeline:{entry.name}",
-                        precision=spec.precision, bucket=bucket,
-                    ):
-                        prog.fetch(prog.run(prog.put(zeros)))
-                    ladder[bucket] = time.perf_counter() - t0
+            for bucket in chosen:
+                zeros = np.zeros((bucket, int(n_features)),
+                                 dtype=spec.dtype)
+                t0 = time.perf_counter()
+                with spans_mod.span(
+                    f"serve:warmup_pipeline:{entry.name}",
+                    precision=spec.precision, bucket=bucket,
+                    device=replica.label,
+                ):
+                    prog.fetch(prog.run(prog.put(zeros)))
+                ladder[bucket] = time.perf_counter() - t0
+            replica_report[replica.label] = ladder
+        if primary_spec is not None and primary_spec.program is not None:
             report["pipeline"] = {
-                "precision": spec.precision,
-                "buckets": ladder,
+                "precision": primary_spec.precision,
+                "buckets": replica_report.get(rset.primary.label, {}),
+            }
+            if len(replica_report) > 1:
+                report["replicas"] = replica_report
+        # … and the sharded big-transform program (one signature at the
+        # sharded bucket just past the threshold).
+        sharded = self._sharded_program_for(entry)
+        if sharded is not None and n_features is not None:
+            n_dev = len(self.placer.devices())
+            bucket = shard_bucket(self.shard_threshold() + 1, n_dev)
+            zeros = np.zeros((bucket, int(n_features)),
+                             dtype=sharded.dtype)
+            t0 = time.perf_counter()
+            with spans_mod.span(
+                f"serve:warmup_sharded:{entry.name}",
+                bucket=bucket, devices=n_dev,
+            ):
+                sharded.fetch(sharded.run(sharded.put(zeros)))
+            report["sharded"] = {
+                "bucket": bucket,
+                "devices": n_dev,
+                "seconds": time.perf_counter() - t0,
             }
         return report
 
@@ -1173,27 +1540,49 @@ class ServeEngine:
 
     def queue_depth(self, model_ref: Optional[str] = None) -> int:
         with self._lock:
-            batchers = list(self._batchers.items())
+            sets = list(self._replicas.items())
         return sum(
-            b.depth() for (name, _v), b in batchers
+            replica.batcher.depth()
+            for (name, _v), rset in sets
+            for replica in rset.replicas
             if model_ref is None or name == model_ref
         )
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            batchers = dict(self._batchers)
+            sets = dict(self._replicas)
         return {
             "closed": self._closed,
             "queues": {
                 f"{name}@{version}": {
-                    "depth": b.depth(),
-                    "buckets": list(b.buckets),
-                    "max_batch_rows": b.max_batch_rows,
+                    "depth": sum(r.batcher.depth()
+                                 for r in rset.replicas),
+                    "buckets": list(rset.primary.batcher.buckets),
+                    "max_batch_rows":
+                        rset.primary.batcher.max_batch_rows,
+                    "replicas": len(rset.replicas),
                 }
-                for (name, version), b in batchers.items()
+                for (name, version), rset in sets.items()
             },
             "breakers": self.breaker_snapshot(),
         }
+
+    def replica_snapshot(self) -> Dict[str, Any]:
+        """Per-replica placement state for ``/debug/slo`` and the
+        dashboard tiles: device, serving|draining|dead, queue depth,
+        in-flight load, health counters — the operator's view of where
+        traffic can land right now."""
+        with self._lock:
+            sets = dict(self._replicas)
+        out: Dict[str, Any] = {}
+        for (name, version), rset in sets.items():
+            self.placer.publish_state(rset)
+            out[f"{name}@{version}"] = {
+                "replicas": rset.snapshot(),
+                "healthy": rset.healthy_count(),
+                "total": len(rset.replicas),
+            }
+        return out
 
     def breaker_snapshot(self) -> Dict[str, Any]:
         """Per-model breaker state: the ``GET /debug/slo`` section and
@@ -1221,7 +1610,9 @@ class ServeEngine:
         what's queued. Idempotent."""
         with self._lock:
             self._closed = True
-            batchers = list(self._batchers.values())
+            batchers = [replica.batcher
+                        for rset in self._replicas.values()
+                        for replica in rset.replicas]
         for b in batchers:
             b.close(drain=drain, timeout=timeout)
 

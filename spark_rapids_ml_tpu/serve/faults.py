@@ -80,14 +80,21 @@ class InjectedWorkerCrash(BaseException):
 
 
 class FaultSpec:
-    """One armed fault: targeting + what to do when it fires."""
+    """One armed fault: targeting + what to do when it fires.
+
+    ``device`` (None = any) narrows the fault to ONE replica's device —
+    the replica-drain chaos drill faults a single chip's dispatches and
+    proves the placement tier sheds onto the siblings. A device-
+    targeted spec never fires at call sites that carry no device
+    identity (the blocking sync path, the worker loop)."""
 
     __slots__ = ("model", "kind", "count", "start", "every", "seconds",
-                 "fired")
+                 "device", "fired")
 
     def __init__(self, model: str = "*", kind: str = "raise", *,
                  count: Optional[int] = 1, start: int = 0, every: int = 1,
-                 seconds: Optional[float] = None):
+                 seconds: Optional[float] = None,
+                 device: Optional[str] = None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
         if every < 1:
@@ -99,10 +106,14 @@ class FaultSpec:
         self.every = int(every)
         self.seconds = (float(seconds) if seconds is not None
                         else _DEFAULT_SECONDS.get(kind, 0.0))
+        self.device = device
         self.fired = 0
 
-    def matches(self, model: str, index: int) -> bool:
+    def matches(self, model: str, index: int,
+                device: Optional[str] = None) -> bool:
         if self.model not in ("*", model):
+            return False
+        if self.device is not None and device != self.device:
             return False
         if index < self.start or (index - self.start) % self.every != 0:
             return False
@@ -116,6 +127,7 @@ class FaultSpec:
             "start": self.start,
             "every": self.every,
             "seconds": self.seconds,
+            "device": self.device,
             "fired": self.fired,
         }
 
@@ -171,11 +183,13 @@ class FaultPlane:
 
     def inject(self, model: str = "*", kind: str = "raise", *,
                count: Optional[int] = 1, start: int = 0, every: int = 1,
-               seconds: Optional[float] = None) -> FaultSpec:
+               seconds: Optional[float] = None,
+               device: Optional[str] = None) -> FaultSpec:
         """Arm one fault; returns the live spec (its ``fired`` counter
-        updates as the fault fires)."""
+        updates as the fault fires). ``device`` narrows it to one
+        replica's dispatch site (the replica-drain drill)."""
         spec = FaultSpec(model, kind, count=count, start=start,
-                         every=every, seconds=seconds)
+                         every=every, seconds=seconds, device=device)
         with self._lock:
             self._specs.append(spec)
         return spec
@@ -204,12 +218,13 @@ class FaultPlane:
     # -- firing ------------------------------------------------------------
 
     def _next(self, counters: Dict[str, int], model: str,
-              kinds) -> Optional[FaultSpec]:
+              kinds, device: Optional[str] = None) -> Optional[FaultSpec]:
         with self._lock:
             index = counters.get(model, 0)
             counters[model] = index + 1
             for spec in self._specs:
-                if spec.kind in kinds and spec.matches(model, index):
+                if spec.kind in kinds and spec.matches(model, index,
+                                                      device):
                     spec.fired += 1
                     break
             else:
@@ -217,12 +232,16 @@ class FaultPlane:
         self._m_injected.inc(model=model, kind=spec.kind)
         return spec
 
-    def begin_call(self, model: str) -> Optional[FaultSpec]:
+    def begin_call(self, model: str,
+                   device: Optional[str] = None) -> Optional[FaultSpec]:
         """Advance ``model``'s transform-site call index and return the
         fault (if any) that fires on this call. The caller applies it:
         ``apply_pre`` before the model call, ``corrupt`` on the output
-        for ``nan``."""
-        return self._next(self._calls, model, _TRANSFORM_KINDS)
+        for ``nan``. ``device`` is the dispatching replica's device
+        label (None at device-less sites) — device-targeted specs only
+        fire when it matches."""
+        return self._next(self._calls, model, _TRANSFORM_KINDS,
+                          device=device)
 
     def worker_fault(self, model: str) -> Optional[FaultSpec]:
         """The worker-loop site: a matched ``crash_worker`` spec (the

@@ -1,0 +1,506 @@
+"""Device placement for the multi-replica serving tier.
+
+The fit side has had a mesh since PR 3 (``parallel/mesh.py``); until
+this module the serve side ran ONE replica on ONE device no matter how
+many chips the process could see. This is the missing tier: every model
+with a device-resident ``ServingProgram`` is **replicated** onto each
+visible device (its own ``MicroBatcher``, its own staging pool, its own
+fair queue — overlapped transfers never contend across replicas), and
+each request is routed to the **least-loaded healthy replica**.
+
+This module is also THE device-selection chokepoint for ``serve/``:
+rule 12 of ``scripts/check_instrumentation.py`` statically rejects
+``jax.devices()[0]``-style hard-coding and implicit default-device
+``device_put`` anywhere else under ``serve/`` — a serving path that
+silently pins work to device 0 is exactly the bug this tier exists to
+remove.
+
+* ``serving_devices()`` — the devices the serving tier replicates onto
+  (``SPARK_RAPIDS_ML_TPU_SERVE_REPLICAS`` caps the count; 0/unset = all
+  visible devices). On CPU CI, ``XLA_FLAGS=
+  --xla_force_host_platform_device_count=N`` makes this N host devices —
+  the recipe every multi-device test/bench here uses.
+* ``ReplicaHealth`` — a per-replica mini breaker (injectable clock):
+  ``failure_threshold`` consecutive dispatch/complete failures mark the
+  replica **draining** (removed from the placement set — traffic sheds
+  onto its siblings without taking the tier down); after
+  ``cooldown_seconds`` ONE probe request is admitted (half-open) and a
+  success re-enters the replica, a failure restarts the cooldown.
+* ``Replica`` / ``ReplicaSet`` — one model version's replicas: the
+  device, its batcher, its health. ``Replica.state()`` is
+  serving | draining | dead (dead = the batcher's worker-restart budget
+  is exhausted), published as the
+  ``sparkml_serve_replica_state{model,device}`` gauge (0 / 1 / 2) that
+  the ``serve_replica_degraded`` anomaly detector watches.
+* ``DevicePlacer.pick`` — the dispatch decision: among allowed replicas
+  choose the least-loaded by ``(queue depth + in-flight batches,
+  devmon occupancy)`` — the per-device occupancy ``obs/devmon.py`` has
+  published since PR 7 finally becomes a *control input*, not just a
+  chart. Replicas under device memory pressure (PJRT in-use/limit above
+  ``SPARK_RAPIDS_ML_TPU_SERVE_REPLICA_MEM_PRESSURE``, default 0.92) are
+  skipped like draining ones. Every multi-replica decision is recorded
+  as a ``serve:placement`` audit span in the request's trace plus
+  ``sparkml_serve_placement_total{model,device}`` — a routing decision
+  nobody can see is a routing decision nobody can debug.
+
+Numerics contract: placement must never change results — every replica
+runs the SAME XLA program (same module, different device), so replicated
+outputs are bit-equal to single-device at f32/f64 for the same bucket
+(tested in ``tests/test_serve_multidevice.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.devmon import get_device_monitor
+
+ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
+
+SERVING = "serving"
+DRAINING = "draining"
+DEAD = "dead"
+
+# the gauge encoding the anomaly detector thresholds on (> 0.5 fires)
+STATE_VALUES = {SERVING: 0, DRAINING: 1, DEAD: 2}
+
+
+def _env_number(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(ENV_PREFIX + name, default))
+    except ValueError:
+        return default
+
+
+def serving_devices(limit: Optional[int] = None) -> List[Any]:
+    """The devices the serving tier replicates onto — THE one place in
+    ``serve/`` allowed to enumerate devices (rule 12).
+
+    ``limit`` (or ``SPARK_RAPIDS_ML_TPU_SERVE_REPLICAS``; 0/unset = all)
+    caps the replica count. Returns ``[]`` when jax is unavailable —
+    callers fall back to default-device single-replica behavior."""
+    try:
+        import jax
+
+        devices = list(jax.devices())
+    except Exception:
+        # jax-less host: visible (counted), and the caller degrades to
+        # default-device single-replica behavior (rule 6)
+        get_registry().counter(
+            "sparkml_serve_errors_total",
+            "serving errors by type: batch failures (exception class), "
+            "worker crashes/wedges, breaker rejections",
+            ("model", "error"),
+        ).inc(model="(placement)", error="no_devices")
+        return []
+    cap = int(limit if limit is not None else _env_number("REPLICAS", 0))
+    if cap > 0:
+        devices = devices[:cap]
+    return devices
+
+
+def device_label(device: Any) -> str:
+    """The stable string id a device carries through metrics/spans."""
+    return str(device)
+
+
+def default_device() -> Optional[Any]:
+    """The single-replica fallback device (sync-path models, jax-less
+    environments return None → the process default)."""
+    devices = serving_devices(limit=1)
+    return devices[0] if devices else None
+
+
+class ReplicaHealth:
+    """Per-replica failure tracking with half-open re-entry.
+
+    NOT the model-level ``serve.breaker.CircuitBreaker`` — that one
+    guards the MODEL (all replicas; its verdict gates the degraded CPU
+    fallback). This one guards ONE device's replica so a sick chip
+    sheds onto its siblings while the model stays up. Thread-safe;
+    clock injectable so tests drive the cooldown without sleeping."""
+
+    def __init__(self, *, failure_threshold: Optional[int] = None,
+                 cooldown_seconds: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = int(
+            failure_threshold if failure_threshold is not None
+            else _env_number("REPLICA_FAILURES", 3))
+        self.cooldown_seconds = float(
+            cooldown_seconds if cooldown_seconds is not None
+            else _env_number("REPLICA_COOLDOWN_MS", 2000.0) / 1000.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._draining = False
+        self._drained_at = 0.0
+        self._probe_inflight = False
+        # which thread holds the half-open claim: the probe is carried
+        # by the REQUEST the claiming pick routed here, which resolves
+        # on the claiming thread — only that thread may give the claim
+        # back (another request of this replica dying of a no-verdict
+        # outcome must not release someone else's in-flight probe)
+        self._probe_owner: Optional[int] = None
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    @property
+    def probing(self) -> bool:
+        """A half-open probe is currently in flight on this replica."""
+        with self._lock:
+            return self._probe_inflight
+
+    def allow(self) -> bool:
+        """Whether placement may route a request here: serving always;
+        draining only as the single half-open probe once the cooldown
+        has elapsed (the claim belongs to the calling thread, which is
+        the thread that will carry the probe request)."""
+        now = self._clock()
+        with self._lock:
+            if not self._draining:
+                return True
+            if self._probe_inflight:
+                return False
+            if now - self._drained_at < self.cooldown_seconds:
+                return False
+            # half-open: exactly one probe at a time
+            self._probe_inflight = True
+            self._probe_owner = threading.get_ident()
+            return True
+
+    def force_drain(self) -> bool:
+        """Mark draining WITHOUT counting a failure — how a DEAD
+        replica (worker-restart budget exhausted) enters the same
+        cooldown → probe → revive cycle as a failure-drained one.
+        Returns True on the transition."""
+        with self._lock:
+            if self._draining:
+                return False
+            self._draining = True
+            self._drained_at = self._clock()
+            return True
+
+    def _release_if_owner(self) -> None:
+        """Caller holds the lock: clear the probe claim only when the
+        CURRENT thread holds it — a stale request of this replica
+        resolving mid-probe must not release another thread's claim
+        (which would admit a second concurrent probe)."""
+        if self._probe_owner == threading.get_ident():
+            self._probe_inflight = False
+            self._probe_owner = None
+
+    def release_probe(self) -> None:
+        """Give back a claimed half-open probe without a verdict (the
+        probe request died of something that says nothing about this
+        device — an orderly shed, a caller timeout); the next allowed
+        pick may probe again. Owner-thread only — a no-op from any
+        other request's thread."""
+        with self._lock:
+            self._release_if_owner()
+
+    def note_success(self) -> bool:
+        """A dispatch/complete succeeded; returns True when this
+        success RE-ENTERED a draining replica (a genuine success is
+        device evidence whoever carried it, so re-entry is not
+        owner-gated — and re-entry dissolves any outstanding claim)."""
+        with self._lock:
+            self._consecutive = 0
+            if self._draining:
+                self._draining = False
+                self._probe_inflight = False
+                self._probe_owner = None
+                return True
+            self._release_if_owner()
+            return False
+
+    def note_failure(self) -> bool:
+        """A dispatch/complete failed; returns True when this failure
+        TRANSITIONED the replica into draining."""
+        now = self._clock()
+        with self._lock:
+            self._consecutive += 1
+            self._release_if_owner()
+            if self._draining:
+                # a failed probe (or any fresh device evidence while
+                # draining) restarts the cooldown
+                self._drained_at = now
+                return False
+            if self._consecutive >= self.failure_threshold:
+                self._draining = True
+                self._drained_at = now
+                return True
+            return False
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "draining": self._draining,
+                "consecutive_failures": self._consecutive,
+                "failure_threshold": self.failure_threshold,
+                "cooldown_seconds": self.cooldown_seconds,
+            }
+
+
+class Replica:
+    """One (model version, device) serving replica: the device handle,
+    its dedicated batcher (own worker, own staging pool, own fair
+    queue), and its health."""
+
+    __slots__ = ("device", "label", "batcher", "health", "spec",
+                 "_last_state")
+
+    def __init__(self, device: Any, label: str, batcher,
+                 health: Optional[ReplicaHealth] = None):
+        self.device = device
+        self.label = label
+        self.batcher = batcher
+        self.health = health if health is not None else ReplicaHealth()
+        # the engine parks this replica's AsyncTransformSpec here so a
+        # dead-batcher revive rebuilds with the SAME staged program
+        self.spec = None
+        self._last_state: Optional[str] = None
+
+    def state(self) -> str:
+        if self.batcher is not None and self.batcher.dead():
+            return DEAD
+        return DRAINING if self.health.draining else SERVING
+
+    def load(self) -> int:
+        """Queued + in-flight work on this replica — the primary
+        least-loaded signal."""
+        if self.batcher is None:
+            return 0
+        return int(self.batcher.load())
+
+    def snapshot(self) -> Dict[str, Any]:
+        doc = {
+            "device": self.label,
+            "state": self.state(),
+            "queue_depth": (self.batcher.depth()
+                            if self.batcher is not None else 0),
+            "load": self.load(),
+        }
+        doc.update(self.health.snapshot())
+        return doc
+
+
+class ReplicaSet:
+    """One model version's replicas, in device order (index 0 is the
+    primary — the device single-replica models land on)."""
+
+    __slots__ = ("name", "version", "replicas")
+
+    def __init__(self, name: str, version: int,
+                 replicas: List[Replica]):
+        self.name = name
+        self.version = version
+        self.replicas = list(replicas)
+
+    @property
+    def primary(self) -> Replica:
+        return self.replicas[0]
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def healthy_count(self) -> int:
+        return sum(1 for r in self.replicas if r.state() == SERVING)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return [r.snapshot() for r in self.replicas]
+
+
+class DevicePlacer:
+    """The per-request placement policy: least-loaded healthy replica.
+
+    ``occupancy_window`` bounds the devmon occupancy read (the PR 7
+    per-device busy rate out of the TSDB); ``pressure_threshold`` skips
+    replicas whose device memory in-use/limit exceeds it (PJRT-sourced
+    only — a host-RSS number is process-wide, not a device verdict).
+    """
+
+    def __init__(self, *,
+                 devices: Optional[List[Any]] = None,
+                 occupancy_window: float = 5.0,
+                 pressure_threshold: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._devices = devices
+        self.occupancy_window = float(occupancy_window)
+        self.pressure_threshold = float(
+            pressure_threshold if pressure_threshold is not None
+            else _env_number("REPLICA_MEM_PRESSURE", 0.92))
+        self._clock = clock
+        self._devmon = get_device_monitor()
+        # round-robin tie-break cursor: strict least-loaded alone pins
+        # every idle-tier pick to replica 0 (ties resolve to the first
+        # candidate), so sequential traffic would never exercise the
+        # siblings — equals rotate instead
+        self._rr_lock = threading.Lock()
+        self._rr = 0
+        # occupancy is a TSDB range query (store lock + window scan):
+        # refreshed at a bounded cadence, never per request — the PR 10
+        # shed-controller lesson applied to the placement signal (it is
+        # a slow-moving tiebreak; queue/in-flight load is the live key)
+        self._occ_refresh_s = 0.25
+        self._occ_cache: Dict[str, float] = {}
+        self._occ_at = 0.0
+        reg = get_registry()
+        self._m_state = reg.gauge(
+            "sparkml_serve_replica_state",
+            "per-replica serving state: 0 serving, 1 draining, 2 dead "
+            "(the serve_replica_degraded detector fires above 0.5)",
+            ("model", "device"),
+        )
+        self._m_placement = reg.counter(
+            "sparkml_serve_placement_total",
+            "multi-replica placement decisions by chosen device",
+            ("model", "device"),
+        )
+        self._m_unplaceable = reg.counter(
+            "sparkml_serve_placement_fallback_total",
+            "placement decisions that found no healthy replica and fell "
+            "back to the primary", ("model",),
+        )
+
+    def devices(self) -> List[Any]:
+        """The placement device set (injected list wins — tests)."""
+        if self._devices is not None:
+            return list(self._devices)
+        return serving_devices()
+
+    # -- state publication -------------------------------------------------
+
+    def publish_state(self, rset: ReplicaSet) -> None:
+        """Re-assert every replica's state gauge (cheap; called on
+        transitions and snapshots, not per request)."""
+        for replica in rset.replicas:
+            self._set_state(rset.name, replica)
+
+    def _set_state(self, model: str, replica: Replica) -> None:
+        state = replica.state()
+        if state != replica._last_state:
+            replica._last_state = state
+            self._m_state.set(STATE_VALUES.get(state, 1), model=model,
+                              device=replica.label)
+
+    # -- the decision ------------------------------------------------------
+
+    def _memory_pressured(self, label: str) -> bool:
+        frac = self._devmon.memory_pressure(label)
+        return frac is not None and frac >= self.pressure_threshold
+
+    def _occupancy(self) -> Dict[str, float]:
+        """The per-device occupancy tiebreak, cached at a bounded
+        cadence (one thread refreshes; racers read slightly stale —
+        fine for a tiebreak)."""
+        now = time.perf_counter()
+        if now - self._occ_at >= self._occ_refresh_s:
+            self._occ_at = now
+            try:
+                self._occ_cache = self._devmon.occupancy(
+                    self.occupancy_window)
+            except Exception:
+                # the tiebreak degrades to load-only; visible (rule 6)
+                get_registry().counter(
+                    "sparkml_serve_errors_total",
+                    "serving errors by type: batch failures (exception "
+                    "class), worker crashes/wedges, breaker rejections",
+                    ("model", "error"),
+                ).inc(model="(placement)", error="occupancy")
+        return self._occ_cache
+
+    def pick(self, rset: ReplicaSet,
+             trace_ctx=None) -> Replica:
+        """The least-loaded allowed replica.
+
+        Single-replica sets short-circuit (no span, no counter — the
+        single-device hot path stays exactly as cheap as before this
+        tier existed). With no allowed replica the PRIMARY is returned
+        (and counted): the model-level breaker machinery decides what
+        happens to a request on a fully-sick set — placement never
+        invents a new failure mode."""
+        if len(rset.replicas) == 1:
+            replica = rset.replicas[0]
+            self._set_state(rset.name, replica)
+            return replica
+        t0 = time.perf_counter()
+        best: Optional[Replica] = None
+        best_key = None
+        probe: Optional[Replica] = None
+        occupancy = self._occupancy()
+        candidates = 0
+        with self._rr_lock:
+            self._rr += 1
+            rotate = self._rr
+        n = len(rset.replicas)
+        for idx, replica in enumerate(rset.replicas):
+            if replica.state() == DEAD:
+                # a dead batcher rides the same cooldown → probe →
+                # revive cycle as a failure-drained replica
+                replica.health.force_drain()
+            self._set_state(rset.name, replica)
+            if replica.health.draining:
+                # allow() CLAIMS the half-open probe, so a claimed
+                # replica must carry THIS request — a claim the pick
+                # then ignored would never be released and the replica
+                # could never re-enter
+                if probe is None and replica.health.allow():
+                    probe = replica
+                continue
+            if self._memory_pressured(replica.label):
+                continue
+            candidates += 1
+            key = (replica.load(),
+                   occupancy.get(replica.label, 0.0),
+                   (idx - rotate) % n)
+            if best is None or key < best_key:
+                best, best_key = replica, key
+        if probe is not None:
+            # the half-open probe outranks the load decision: one
+            # request after the cooldown is how a drained replica
+            # proves recovery and re-enters the set
+            best, best_key = probe, (probe.load(), 0.0, 0)
+            candidates += 1
+        fallback = best is None
+        if fallback:
+            best = rset.primary
+            best_key = (best.load(), 0.0, 0)
+            self._m_unplaceable.inc(model=rset.name)
+        self._m_placement.inc(model=rset.name, device=best.label)
+        # the audit span: which device, why (load/occupancy), out of how
+        # many candidates — grafted into the request's own trace
+        trace_id = getattr(trace_ctx, "trace_id", None)
+        parent = getattr(trace_ctx, "span_id", None)
+        spans_mod.record_event(
+            f"serve:placement:{rset.name}",
+            t0, time.perf_counter(),
+            trace_id=trace_id, parent_span_id=parent,
+            device=best.label, load=int(best_key[0]),
+            occupancy=round(float(best_key[1]), 4),
+            candidates=candidates, replicas=len(rset.replicas),
+            fallback=fallback,
+        )
+        return best
+
+
+__all__ = [
+    "DEAD",
+    "DRAINING",
+    "DevicePlacer",
+    "Replica",
+    "ReplicaHealth",
+    "ReplicaSet",
+    "SERVING",
+    "STATE_VALUES",
+    "default_device",
+    "device_label",
+    "serving_devices",
+]

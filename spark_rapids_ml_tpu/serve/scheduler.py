@@ -130,7 +130,18 @@ class FairQueue:
     already runs under the batcher lock, exactly like the deque it
     replaces. ``pressure_fn`` (optional) flips strict
     interactive-first dequeue on while the shed controller reports
-    pressure."""
+    pressure.
+
+    **The device dimension** (the multi-replica tier): each serving
+    replica owns its OWN FairQueue, so the virtual-time cost model —
+    start/finish tags, per-flow timelines, over-quota demotion — runs
+    per device, and the fairness contract holds on every replica
+    independently, not just globally: a tenant flooding one device's
+    queue advances only its own timeline *on that device* and cannot
+    starve a compliant tenant on any replica. ``device`` stamps the
+    queue with its replica's device label (part of the flow identity:
+    flows are ``(tenant, priority)`` *within* this device's timeline)
+    so placement/debug surfaces can attribute a queue to its chip."""
 
     def __init__(
         self,
@@ -139,7 +150,9 @@ class FairQueue:
         priority_weights: Optional[Dict[str, float]] = None,
         over_quota_factor: float = DEFAULT_OVER_QUOTA_FACTOR,
         pressure_fn: Optional[Callable[[], bool]] = None,
+        device: Optional[str] = None,
     ):
+        self.device = device
         self.tenant_weights = dict(tenant_weights or {})
         self.priority_weights = dict(priority_weights
                                      or DEFAULT_PRIORITY_WEIGHTS)

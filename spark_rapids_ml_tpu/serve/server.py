@@ -339,6 +339,23 @@ def make_handler(engine: ServeEngine):
                 # a drained replica cools down and re-enters rotation.
                 shedding = engine.shed_posture().shedding()
                 overload = engine.overload_state()
+
+                # the replica tier's contract: /readyz stays 200 while
+                # >= 1 replica is healthy — a sick device DRAINS onto
+                # its siblings, it does not take the tier out of
+                # rotation (only shedding/closing does). Computed
+                # lazily: probes hit this at ~1 Hz and the snapshot
+                # walks every replica's locks — the closed branch must
+                # not pay for a summary it discards.
+                def replica_health() -> dict:
+                    replicas = engine.replica_snapshot()
+                    return {
+                        "healthy": sum(doc["healthy"]
+                                       for doc in replicas.values()),
+                        "total": sum(doc["total"]
+                                     for doc in replicas.values()),
+                    }
+
                 if engine._closed:
                     status = self._reply(
                         503, {"status": "draining", "ready": False})
@@ -347,12 +364,28 @@ def make_handler(engine: ServeEngine):
                         "status": "shedding", "ready": False,
                         "shed_level": overload["shed"]["level"],
                         "overload": overload["shed"]["signals"],
+                        "replicas": replica_health(),
                     }, retry_after=overload["retry_after_seconds"])
                 else:
-                    status = self._reply(200, {
-                        "status": "ready", "ready": True,
-                        "models": engine.registry.names(),
-                    })
+                    health = replica_health()
+                    if health["total"] > 0 and health["healthy"] == 0:
+                        # the other half of the replica contract:
+                        # EVERY replica draining/dead means the tier
+                        # can only answer via the degraded fallback —
+                        # the LB should prefer a replica that can
+                        # actually reach a device (probes keep hitting
+                        # this endpoint, and the half-open re-entry
+                        # flips it back to 200)
+                        status = self._reply(503, {
+                            "status": "unhealthy", "ready": False,
+                            "replicas": health,
+                        }, retry_after=overload["retry_after_seconds"])
+                    else:
+                        status = self._reply(200, {
+                            "status": "ready", "ready": True,
+                            "models": engine.registry.names(),
+                            "replicas": health,
+                        })
             elif path == "/metrics":
                 status = self._reply_text(
                     200, get_registry().prometheus_text(),
@@ -385,6 +418,7 @@ def make_handler(engine: ServeEngine):
                 snap["retries_total"] = m_retries.total()
                 snap["worker_restarts_total"] = m_restarts.total()
                 snap["overload"] = engine.overload_state()
+                snap["replicas"] = engine.replica_snapshot()
                 status = self._reply(200, snap)
             elif path == "/debug/history":
                 params = urllib.parse.parse_qs(parsed.query)
@@ -756,6 +790,8 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <table><thead><tr><th>Objective</th><th>Target</th><th>5m</th><th>30m</th>
     <th>1h</th><th>6h</th><th>Budget left</th><th>State</th></tr></thead>
     <tbody id="slo-rows"></tbody></table>
+  <h2>Serving replicas</h2>
+  <div id="replicas" class="quiet">—</div>
   <h2>Incidents</h2>
   <div id="incidents" class="quiet">—</div>
   <h2>Circuit breakers</h2>
@@ -1004,6 +1040,26 @@ async function refresh() {
           fmtPct(s.budget_remaining) + "</td><td>" +
           statusSpan(st[0], st[1]) + "</td></tr>";
       }).join("");
+    var replicaSets = slo.replicas || {};
+    var replicaModels = Object.keys(replicaSets);
+    document.getElementById("replicas").innerHTML = replicaModels.length
+      ? replicaModels.map(function (m) {
+          var doc = replicaSets[m];
+          var tiles = (doc.replicas || []).map(function (r) {
+            var cls = r.state === "serving" ? "good"
+              : (r.state === "draining" ? "warning" : "critical");
+            return tile(m + " \\u00b7 " + r.device,
+              statusSpan(cls, "\\u25cf " + r.state) +
+              '<div class="label" style="margin-top:4px">queue ' +
+              r.queue_depth + " \\u00b7 load " + r.load +
+              (r.consecutive_failures
+                ? " \\u00b7 fails " + r.consecutive_failures : "") +
+              "</div>");
+          });
+          return '<div class="tiles" style="margin-bottom:10px">' +
+            tiles.join("") + "</div>";
+        }).join("")
+      : "no models served yet";
     document.getElementById("incidents").innerHTML =
       (incOpen.length || incRecent.length)
         ? "<table><thead><tr><th>Detector</th><th>Severity</th>" +

@@ -106,6 +106,41 @@ def pad_to_bucket(
     return np.pad(rows, ((0, bucket - n), (0, 0))), n
 
 
+def shard_bucket(n_rows: int, n_shards: int) -> int:
+    """The row bucket a SHARDED batch pads up to: the next power of two
+    (floored at ``MIN_BUCKET_ROWS``) rounded up to a multiple of
+    ``n_shards`` — XLA shardings need equal per-device extents, and the
+    serving tier's sharded big-transform path (``serve/placement.py``'s
+    mesh over ``("batch",)``) still wants the few-compiled-signatures
+    funnel, so sharded requests reuse the same pow-2 ladder (already
+    divisible by any pow-2 device count) with a lcm bump for odd mesh
+    sizes."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    bucket = bucket_for(n_rows)
+    rem = bucket % n_shards
+    if rem:
+        bucket += n_shards - rem
+    return bucket
+
+
+def pad_to_shard_bucket(rows: np.ndarray,
+                        n_shards: int) -> Tuple[np.ndarray, int]:
+    """Pad a (n, d) matrix to its ``shard_bucket`` with zero rows;
+    returns ``(padded, n)`` like ``pad_to_bucket`` (exact fits and
+    empty batches are returned as-is)."""
+    rows = np.asarray(rows)
+    if rows.ndim != 2:
+        raise ValueError(f"expected a (n, d) matrix, got shape {rows.shape}")
+    n = int(rows.shape[0])
+    if n == 0:
+        return rows, 0
+    bucket = shard_bucket(n, n_shards)
+    if bucket == n:
+        return rows, n
+    return np.pad(rows, ((0, bucket - n), (0, 0))), n
+
+
 def padding_waste(n_rows: int, bucket: int) -> float:
     """Fraction of the padded batch that is filler (0.0 on exact fit)."""
     if bucket <= 0:

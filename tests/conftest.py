@@ -32,6 +32,17 @@ if "xla_force_host_platform_device_count" not in flags:
 # crash it. The capture trigger itself is unit-tested with a stub.
 os.environ.setdefault("SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_CAPTURE_S", "0")
 
+# The 8-device mesh above exists for the DISTRIBUTED-FIT tests. The
+# serving tier would replicate every engine onto all 8 (its production
+# default), but the legacy serve suites assert single-queue contracts —
+# queue-full admission, preemption, one batcher per model, signature
+# counts per bucket ladder — that are single-replica properties by
+# design. Pin the suite default to ONE replica; the multi-device suite
+# (tests/test_serve_multidevice.py) opts into N replicas explicitly per
+# engine via the ``replicas=`` / ``placement=`` constructor args, which
+# override this env default.
+os.environ.setdefault("SPARK_RAPIDS_ML_TPU_SERVE_REPLICAS", "1")
+
 import jax  # noqa: E402
 
 from spark_rapids_ml_tpu.utils.platform import force_cpu_if_requested  # noqa: E402

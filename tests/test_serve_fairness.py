@@ -694,11 +694,17 @@ def test_http_queue_full_gets_retry_after():
         def bg():
             _post(base, {"model": "blk", "rows": rows})
 
-        for _ in range(2):  # one in flight + one queued
-            t = threading.Thread(target=bg, daemon=True)
-            t.start()
-            hangers.append(t)
+        # one in flight FIRST (wait for its transform to start — two
+        # simultaneous posts race the worker's pop for the single
+        # queue slot and the second can 429 before the first is ever
+        # popped), THEN one queued
+        t = threading.Thread(target=bg, daemon=True)
+        t.start()
+        hangers.append(t)
         assert started.wait(5.0)
+        t = threading.Thread(target=bg, daemon=True)
+        t.start()
+        hangers.append(t)
         # wait until the SECOND hanger actually occupies the queue slot
         # (worker blocked in the first) — only then is the queue full
         deadline = time.monotonic() + 5.0

@@ -1,0 +1,711 @@
+"""Multi-device serving tier (ISSUE 13): replicated programs with
+least-loaded placement, per-replica drain/re-entry, sharded big
+transforms over a ("batch",) mesh, the operator surfaces, and the
+rule-12 static check (device selection routes through
+serve/placement.py).
+
+The conftest forces 8 virtual CPU devices for the whole suite and pins
+the serve default to ONE replica (the legacy suites assert single-queue
+contracts); every engine here opts into N replicas explicitly."""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.serve import (
+    ModelRegistry,
+    ServeEngine,
+    start_serve_server,
+)
+from spark_rapids_ml_tpu.serve import placement as placement_mod
+from spark_rapids_ml_tpu.serve.faults import FaultSpec, fault_plane
+from spark_rapids_ml_tpu.serve.placement import (
+    DEAD,
+    DRAINING,
+    SERVING,
+    DevicePlacer,
+    Replica,
+    ReplicaHealth,
+    ReplicaSet,
+    serving_devices,
+)
+from spark_rapids_ml_tpu.serve.scheduler import FairQueue
+from spark_rapids_ml_tpu.utils.padding import (
+    pad_to_shard_bucket,
+    shard_bucket,
+)
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def pca_model(rng):
+    from spark_rapids_ml_tpu import PCA
+
+    x = rng.normal(size=(1024, 16))
+    return PCA().setK(4).fit(x), x
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fault_plane().clear()
+    yield
+    fault_plane().clear()
+
+
+# -- padding: the sharded bucket ladder -------------------------------------
+
+
+def test_shard_bucket_rounds_to_pow2_times_shards():
+    assert shard_bucket(1, 1) == 8
+    assert shard_bucket(100, 4) == 128       # pow2 already divisible
+    assert shard_bucket(129, 4) == 256
+    assert shard_bucket(10, 3) == 18         # 16 -> +2 to hit 3 | bucket
+    with pytest.raises(ValueError):
+        shard_bucket(4, 0)
+
+
+def test_pad_to_shard_bucket_pads_and_exact_fits():
+    x = np.ones((100, 4))
+    padded, n = pad_to_shard_bucket(x, 4)
+    assert padded.shape == (128, 4) and n == 100
+    assert np.all(padded[100:] == 0.0)
+    exact = np.ones((128, 4))
+    same, n2 = pad_to_shard_bucket(exact, 4)
+    assert same is exact and n2 == 128
+
+
+# -- replica health: drain, probe, re-entry ---------------------------------
+
+
+def test_replica_health_drain_probe_reenter():
+    now = [0.0]
+    h = ReplicaHealth(failure_threshold=3, cooldown_seconds=5.0,
+                      clock=lambda: now[0])
+    assert h.allow() and not h.draining
+    assert not h.note_failure()
+    assert not h.note_failure()
+    assert h.note_failure()                  # 3rd failure transitions
+    assert h.draining
+    assert not h.allow()                     # cooldown pending
+    now[0] = 4.9
+    assert not h.allow()
+    now[0] = 5.1
+    assert h.allow()                         # the half-open probe
+    assert h.probing
+    assert not h.allow()                     # one probe at a time
+    assert h.note_success()                  # probe succeeded: re-enter
+    assert not h.draining and h.allow()
+
+
+def test_replica_health_failed_probe_restarts_cooldown():
+    now = [0.0]
+    h = ReplicaHealth(failure_threshold=1, cooldown_seconds=5.0,
+                      clock=lambda: now[0])
+    assert h.note_failure()
+    now[0] = 6.0
+    assert h.allow()                         # probe claimed
+    assert not h.note_failure()              # failed probe: no transition
+    assert not h.allow()                     # cooldown restarted at t=6
+    now[0] = 11.5
+    assert h.allow()
+
+
+def test_probe_claim_is_owner_thread_only():
+    """A stale request of the replica resolving with a no-verdict
+    outcome must NOT release another thread's in-flight probe claim
+    (that would admit a second concurrent probe to a sick device)."""
+    now = [0.0]
+    h = ReplicaHealth(failure_threshold=1, cooldown_seconds=1.0,
+                      clock=lambda: now[0])
+    h.note_failure()
+    now[0] = 2.0
+    claimed = []
+    t = threading.Thread(target=lambda: claimed.append(h.allow()))
+    t.start()
+    t.join()
+    assert claimed == [True] and h.probing
+    # this thread never claimed: its release is a no-op
+    h.release_probe()
+    assert h.probing
+    assert not h.allow()       # still exactly one probe outstanding
+    # a genuine success re-enters regardless of who carried it
+    assert h.note_success()
+    assert not h.probing and not h.draining
+
+
+def test_replica_health_force_drain_and_release_probe():
+    now = [0.0]
+    h = ReplicaHealth(failure_threshold=3, cooldown_seconds=1.0,
+                      clock=lambda: now[0])
+    assert h.force_drain()
+    assert not h.force_drain()               # idempotent
+    now[0] = 2.0
+    assert h.allow()                         # probe claimed
+    h.release_probe()                        # no-verdict outcome
+    assert h.allow()                         # claim returned: probe again
+
+
+# -- the placer: least-loaded pick ------------------------------------------
+
+
+class _StubBatcher:
+    def __init__(self, load=0, dead=False, label=None):
+        self._load = load
+        self._dead = dead
+        self.device_label = label
+
+    def load(self):
+        return self._load
+
+    def depth(self):
+        return self._load
+
+    def dead(self):
+        return self._dead
+
+
+def _stub_set(name, loads, dead=(), clock=None):
+    replicas = []
+    for i, load in enumerate(loads):
+        health = ReplicaHealth(failure_threshold=2, cooldown_seconds=5.0,
+                               clock=clock or time.monotonic)
+        replicas.append(Replica(None, f"dev{i}",
+                                _StubBatcher(load, dead=i in dead,
+                                             label=f"dev{i}"),
+                                health))
+    return ReplicaSet(name, 1, replicas)
+
+
+def test_placer_picks_least_loaded():
+    placer = DevicePlacer(devices=[])
+    rset = _stub_set("pick_m", [5, 0, 3])
+    assert placer.pick(rset).label == "dev1"
+
+
+def test_placer_rotates_ties():
+    placer = DevicePlacer(devices=[])
+    rset = _stub_set("tie_m", [0, 0, 0])
+    picked = {placer.pick(rset).label for _ in range(6)}
+    assert picked == {"dev0", "dev1", "dev2"}
+
+
+def test_placer_skips_draining_and_dead_and_falls_back():
+    now = [0.0]
+    placer = DevicePlacer(devices=[])
+    rset = _stub_set("drain_m", [0, 0, 9], dead=(1,),
+                     clock=lambda: now[0])
+    # drain dev0 (threshold 2)
+    rset.replicas[0].health.note_failure()
+    rset.replicas[0].health.note_failure()
+    assert rset.replicas[0].state() == DRAINING
+    assert rset.replicas[1].state() == DEAD
+    # only dev2 (loaded) remains placeable
+    assert placer.pick(rset).label == "dev2"
+    # every replica sick: fallback to primary, counted
+    rset.replicas[2].health.note_failure()
+    rset.replicas[2].health.note_failure()
+    # cooldowns pending -> no probes admitted
+    assert placer.pick(rset).label == "dev0"
+
+
+def test_placer_routes_the_probe_after_cooldown():
+    now = [0.0]
+    placer = DevicePlacer(devices=[])
+    rset = _stub_set("probe_m", [0, 0], clock=lambda: now[0])
+    rset.replicas[1].health.note_failure()
+    rset.replicas[1].health.note_failure()
+    assert rset.replicas[1].state() == DRAINING
+    for _ in range(4):
+        assert placer.pick(rset).label == "dev0"
+    now[0] = 6.0
+    # the claimed probe must carry the next request
+    assert placer.pick(rset).label == "dev1"
+    # claim outstanding: the next pick goes back to healthy siblings
+    assert placer.pick(rset).label == "dev0"
+
+
+def test_placer_skips_memory_pressured(monkeypatch):
+    placer = DevicePlacer(devices=[], pressure_threshold=0.9)
+    monkeypatch.setattr(
+        placer._devmon, "memory_pressure",
+        lambda label: 0.95 if label == "dev0" else 0.2)
+    rset = _stub_set("mem_m", [0, 4])
+    assert placer.pick(rset).label == "dev1"
+
+
+def test_placer_publishes_state_gauge():
+    placer = DevicePlacer(devices=[])
+    rset = _stub_set("gauge_m", [0, 0], dead=(1,))
+    rset.replicas[0].health.force_drain()
+    placer.publish_state(rset)
+    snap = get_registry().snapshot()["sparkml_serve_replica_state"]
+    values = {s["labels"]["device"]: s["value"] for s in snap["samples"]
+              if s["labels"]["model"] == "gauge_m"}
+    assert values == {"dev0": 1, "dev1": 2}
+
+
+def test_single_replica_pick_short_circuits_without_span():
+    placer = DevicePlacer(devices=[])
+    rset = _stub_set("solo_m", [7])
+    before = sum(1 for e in spans_mod.get_recorder().events()
+                 if e.name.startswith("serve:placement:solo_m"))
+    assert placer.pick(rset).label == "dev0"
+    after = sum(1 for e in spans_mod.get_recorder().events()
+                if e.name.startswith("serve:placement:solo_m"))
+    assert after == before
+
+
+def test_serving_devices_cap(monkeypatch):
+    all_devices = serving_devices(limit=0)
+    assert len(all_devices) == 8  # the conftest's forced mesh
+    assert len(serving_devices(limit=3)) == 3
+    monkeypatch.setenv("SPARK_RAPIDS_ML_TPU_SERVE_REPLICAS", "2")
+    assert len(serving_devices()) == 2
+
+
+# -- device-targeted faults --------------------------------------------------
+
+
+def test_fault_spec_device_targeting():
+    spec = FaultSpec("m", "raise", count=None, device="devA")
+    assert spec.matches("m", 0, "devA")
+    assert not spec.matches("m", 0, "devB")
+    assert not spec.matches("m", 0, None)   # device-less site never fires
+    untargeted = FaultSpec("m", "raise", count=None)
+    assert untargeted.matches("m", 0, "devA")
+    assert untargeted.matches("m", 0, None)
+
+
+def test_fault_plane_begin_call_device():
+    plane = fault_plane()
+    spec = plane.inject("dev_fault_m", "raise", count=None,
+                        device="devX")
+    assert plane.begin_call("dev_fault_m", device="devY") is None
+    assert plane.begin_call("dev_fault_m", device="devX") is spec
+    assert spec.fired == 1
+    assert spec.as_dict()["device"] == "devX"
+
+
+# -- the fair queue's device dimension --------------------------------------
+
+
+def test_fairqueue_carries_its_replica_device():
+    q = FairQueue(device="TFRT_CPU_3")
+    assert q.device == "TFRT_CPU_3"
+    assert FairQueue().device is None
+
+
+# -- engine integration: replication ----------------------------------------
+
+
+def test_engine_defaults_to_single_replica_under_suite_pin(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("solo_pca", model, buckets=(16, 32))
+    engine = ServeEngine(reg, max_batch_rows=32, max_wait_ms=1.0,
+                         buckets=(16, 32))
+    try:
+        engine.predict("solo_pca", x[:4])
+        rset = engine._replicas[("solo_pca", 1)]
+        assert len(rset.replicas) == 1
+        # the back-compat view still shows one batcher per key
+        assert ("solo_pca", 1) in engine._batchers
+    finally:
+        engine.shutdown()
+
+
+def test_engine_replicated_warmup_split_and_bit_equality(pca_model):
+    """The tentpole acceptance: warmup stages the ladder on EVERY
+    device, concurrent traffic spreads across replicas, and replicated
+    outputs are BIT-equal to the single-device program at f64 for the
+    same bucket (placement must not change numerics)."""
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("multi_pca", model, buckets=(16, 32))
+    engine = ServeEngine(reg, max_batch_rows=32, max_wait_ms=1.0,
+                         buckets=(16, 32), replicas=4)
+    try:
+        report = engine.warmup("multi_pca")
+        assert sorted(report["pipeline"]["buckets"]) == [16, 32]
+        assert len(report["replicas"]) == 4  # one ladder per device
+        rset = engine._replicas[("multi_pca", 1)]
+        assert len(rset.replicas) == 4
+        labels = [r.label for r in rset.replicas]
+        assert len(set(labels)) == 4
+
+        # bit-equality across the replicas' compiled programs
+        ref = None
+        for replica in rset.replicas:
+            prog = replica.spec.program
+            out = prog.fetch(prog.run(prog.put(x[:16])))
+            if ref is None:
+                ref = out
+            else:
+                assert np.array_equal(ref, out)
+
+        # concurrent traffic spreads, answers stay bit-equal to direct
+        direct = {n: np.asarray(
+            model.transform(x[:n]).column("pca_features"))
+            for n in (4, 9, 16)}
+        errors = []
+
+        def worker(i):
+            n = (4, 9, 16)[i % 3]
+            try:
+                out = engine.predict("multi_pca", x[:n])
+                if not np.array_equal(out, direct[n]):
+                    errors.append(f"mismatch at {n} rows")
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(48)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        snap = get_registry().snapshot()[
+            "sparkml_serve_replica_batches_total"]
+        served = {s["labels"]["device"]: s["value"]
+                  for s in snap["samples"]
+                  if s["labels"]["model"] == "multi_pca"
+                  and s["value"] > 0}
+        assert len(served) >= 2, f"no spread: {served}"
+
+        # placement decisions are audited spans
+        events = [e for e in spans_mod.get_recorder().events()
+                  if e.name == "serve:placement:multi_pca"]
+        assert events and all(e.args.get("device") for e in events)
+    finally:
+        engine.shutdown()
+
+
+def test_engine_drains_faulted_replica_and_reenters(pca_model):
+    """The per-replica drain acceptance: a device-targeted fault drains
+    ONE replica (availability holds via retries + siblings, the
+    model-level breaker stays closed), the state gauge shows draining,
+    and the half-open probe re-enters it after the fault clears."""
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("drain_pca", model, buckets=(16, 32))
+    engine = ServeEngine(reg, max_batch_rows=32, max_wait_ms=1.0,
+                         buckets=(16, 32), replicas=3,
+                         retries=2, backoff_ms=2)
+    try:
+        engine.warmup("drain_pca")
+        rset = engine._replicas[("drain_pca", 1)]
+        victim = rset.replicas[1]
+        # tight cooldown so the re-entry leg needs no long sleep
+        victim.health.cooldown_seconds = 0.3
+        spec = fault_plane().inject("drain_pca", "raise", count=None,
+                                    device=victim.label)
+        ok = 0
+        for i in range(40):
+            try:
+                engine.predict("drain_pca", x[i:i + 4])
+                ok += 1
+            except Exception:  # noqa: BLE001
+                pass
+        assert ok == 40          # retries absorb the faulted replica
+        assert spec.fired >= victim.health.failure_threshold
+        assert victim.state() == DRAINING
+        assert rset.healthy_count() == 2
+        assert engine.breaker_snapshot()["drain_pca"]["state"] == "closed"
+        gauge = get_registry().snapshot()["sparkml_serve_replica_state"]
+        state = {s["labels"]["device"]: s["value"]
+                 for s in gauge["samples"]
+                 if s["labels"]["model"] == "drain_pca"}
+        assert state[victim.label] == 1
+
+        fault_plane().clear()
+        time.sleep(0.35)
+        for i in range(12):
+            engine.predict("drain_pca", x[i:i + 4])
+        assert victim.state() == SERVING
+        assert rset.healthy_count() == 3
+    finally:
+        engine.shutdown()
+
+
+def test_replica_snapshot_shape(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("snap_pca", model, buckets=(16,))
+    engine = ServeEngine(reg, max_batch_rows=16, max_wait_ms=1.0,
+                         buckets=(16,), replicas=2)
+    try:
+        engine.predict("snap_pca", x[:4])
+        doc = engine.replica_snapshot()["snap_pca@1"]
+        assert doc["total"] == 2 and doc["healthy"] == 2
+        for replica in doc["replicas"]:
+            assert replica["state"] == SERVING
+            assert "queue_depth" in replica and "load" in replica
+            assert "consecutive_failures" in replica
+    finally:
+        engine.shutdown()
+
+
+# -- engine integration: the sharded big-transform path ---------------------
+
+
+def test_oversize_request_shards_across_devices(pca_model):
+    """Rows above the threshold route to the NamedSharding-over-
+    ("batch",) program: served (not rejected), counted, within the
+    documented ε of the direct transform (bit-equal here: the serving
+    kernels are row-independent)."""
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("shard_pca", model, buckets=(16, 32))
+    engine = ServeEngine(reg, max_batch_rows=32, max_wait_ms=1.0,
+                         buckets=(16, 32), replicas=4)
+    try:
+        report = engine.warmup("shard_pca")
+        assert report["sharded"]["devices"] == 4
+        out = engine.predict("shard_pca", x[:300])   # >> max_batch_rows
+        direct = np.asarray(
+            model.transform(x[:300]).column("pca_features"))
+        scale = float(np.max(np.abs(direct))) or 1.0
+        # ε for XLA shape-dependent GEMM tiling; observed bit-equal
+        assert float(np.max(np.abs(out - direct))) / scale < 1e-12
+        snap = get_registry().snapshot()
+        served = {s["labels"]["model"]: s["value"] for s in
+                  snap["sparkml_serve_sharded_requests_total"]["samples"]}
+        assert served.get("shard_pca", 0) >= 1
+        rows = {s["labels"]["model"]: s["value"] for s in
+                snap["sparkml_serve_sharded_rows_total"]["samples"]}
+        assert rows.get("shard_pca", 0) >= 300
+        events = [e for e in spans_mod.get_recorder().events()
+                  if e.name == "serve:sharded:shard_pca"]
+        assert events and events[-1].args.get("devices") == 4
+    finally:
+        engine.shutdown()
+
+
+def test_oversize_without_sharding_keeps_the_value_error(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("noshard_pca", model, buckets=(16,))
+    engine = ServeEngine(reg, max_batch_rows=16, max_wait_ms=1.0,
+                         buckets=(16,), replicas=1)
+    try:
+        with pytest.raises(ValueError, match="exceeds max_batch_rows"):
+            engine.predict("noshard_pca", x[:64])
+    finally:
+        engine.shutdown()
+
+
+def test_shard_threshold_env_and_ctor(pca_model, monkeypatch):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("thresh_pca", model, buckets=(16, 32))
+    engine = ServeEngine(reg, max_batch_rows=32, max_wait_ms=1.0,
+                         buckets=(16, 32), replicas=2, shard_rows=100)
+    try:
+        assert engine.shard_threshold() == 100
+        entry = reg.resolve_entry("thresh_pca")
+        assert not engine._should_shard(entry, 100)
+        assert engine._should_shard(entry, 101)
+    finally:
+        engine.shutdown()
+
+
+def test_sharded_pipeline_parity(rng):
+    """A fused scaler→PCA→logreg pipeline shards end to end: the whole
+    chain runs inside ONE sharded XLA program, outputs within ε of the
+    fused single-device program."""
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.data.frame import VectorFrame
+    from spark_rapids_ml_tpu.models._serving import (
+        build_batch_sharded_program,
+    )
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        LogisticRegression,
+    )
+    from spark_rapids_ml_tpu.models.pipeline import Pipeline
+    from spark_rapids_ml_tpu.models.scaler import StandardScaler
+
+    x = rng.normal(size=(512, 12))
+    y = (x[:, 0] > 0).astype(float)
+    frame = VectorFrame({"features": x, "label": list(y)})
+    model = Pipeline(stages=[
+        StandardScaler().setWithMean(True).setOutputCol("s"),
+        PCA().setK(4).setInputCol("s").setOutputCol("r"),
+        LogisticRegression().setInputCol("r").setLabelCol("label"),
+    ]).fit(frame)
+    devices = serving_devices(limit=4)
+    sharded = build_batch_sharded_program(model, devices=devices)
+    assert sharded is not None
+    fused = model.serving_transform_program()
+    big = rng.normal(size=(512, 12))
+    out_sharded = sharded.fetch(sharded.run(sharded.put(big)))
+    out_fused = fused.fetch(fused.run(fused.put(big)))
+    scale = float(np.max(np.abs(out_fused))) or 1.0
+    assert float(np.max(np.abs(out_sharded - out_fused))) / scale < 1e-12
+
+
+def test_sharded_builder_declines_one_device_and_hostpath(pca_model):
+    from spark_rapids_ml_tpu.models._serving import (
+        build_batch_sharded_program,
+    )
+
+    model, _x = pca_model
+    assert build_batch_sharded_program(
+        model, devices=serving_devices(limit=1)) is None
+    assert build_batch_sharded_program(
+        object(), devices=serving_devices(limit=2)) is None
+
+
+# -- HTTP surfaces -----------------------------------------------------------
+
+
+def test_http_replica_sections(pca_model):
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("http_multi_pca", model, buckets=(16,))
+    engine = ServeEngine(reg, max_batch_rows=16, max_wait_ms=1.0,
+                         buckets=(16,), replicas=2)
+    server = start_serve_server(engine)
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        body = json.dumps({"model": "http_multi_pca",
+                           "rows": x[:4].tolist()}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/predict", data=body), timeout=30).read()
+        slo = json.loads(urllib.request.urlopen(
+            f"{base}/debug/slo", timeout=10).read())
+        doc = slo["replicas"]["http_multi_pca@1"]
+        assert doc["total"] == 2 and doc["healthy"] == 2
+        ready = json.loads(urllib.request.urlopen(
+            f"{base}/readyz", timeout=10).read())
+        assert ready["ready"] is True
+        assert ready["replicas"]["total"] == 2
+        assert ready["replicas"]["healthy"] == 2
+        # dashboard carries the replica tiles section
+        html = urllib.request.urlopen(
+            f"{base}/dashboard", timeout=10).read().decode()
+        assert "Serving replicas" in html
+
+        # the other half of the readiness contract: EVERY replica
+        # sick -> 503 "unhealthy"; one replica recovering -> 200 again
+        rset = engine._replicas[("http_multi_pca", 1)]
+        for replica in rset.replicas:
+            replica.health.force_drain()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/readyz", timeout=10)
+        assert err.value.code == 503
+        assert json.loads(err.value.read())["status"] == "unhealthy"
+        rset.replicas[0].health.note_success()
+        ready2 = json.loads(urllib.request.urlopen(
+            f"{base}/readyz", timeout=10).read())
+        assert ready2["ready"] is True
+        assert ready2["replicas"]["healthy"] == 1
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+# -- rule 12: device selection through placement.py -------------------------
+
+
+def _ci():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import check_instrumentation as ci
+
+    return ci
+
+
+def test_rule12_accepts_current_serve_modules():
+    ci = _ci()
+    import glob
+
+    for path in glob.glob(ci.SERVE_GLOB):
+        if os.path.abspath(path) == os.path.abspath(ci.PLACEMENT_FILE):
+            continue
+        assert list(ci.check_device_selection(path)) == [], path
+
+
+def test_rule12_rejects_hardcoded_device_zero(tmp_path):
+    ci = _ci()
+    bad = tmp_path / "bad_serve.py"
+    bad.write_text(
+        "import jax as j\n"
+        "def pick():\n"
+        "    return j.devices()[0]\n"
+        "def put(x):\n"
+        "    import jax\n"
+        "    return jax.device_put(x)\n"
+    )
+    offenders = list(ci.check_device_selection(str(bad)))
+    assert len(offenders) == 2
+    assert any("device enumeration" in why for _ln, why in offenders)
+    assert any("implicit default-device" in why
+               for _ln, why in offenders)
+
+
+def test_rule12_accepts_explicit_device_put(tmp_path):
+    ci = _ci()
+    good = tmp_path / "good_serve.py"
+    good.write_text(
+        "import jax\n"
+        "from spark_rapids_ml_tpu.serve.placement import serving_devices\n"
+        "def put(x, device):\n"
+        "    return jax.device_put(x, device)\n"
+        "def put_kw(x, device):\n"
+        "    return jax.device_put(x, device=device)\n"
+    )
+    assert list(ci.check_device_selection(str(good))) == []
+
+
+def test_rule12_rejects_bare_from_import(tmp_path):
+    ci = _ci()
+    bad = tmp_path / "bad_from.py"
+    bad.write_text(
+        "from jax import devices as devs, device_put as dput\n"
+        "def pick():\n"
+        "    return devs()[0]\n"
+        "def put(x):\n"
+        "    return dput(x)\n"
+    )
+    offenders = list(ci.check_device_selection(str(bad)))
+    assert len(offenders) == 2
+
+
+# -- warmup owns every replica's compiles -----------------------------------
+
+
+def test_warmup_compiles_every_replica_predicts_compile_nothing(
+        pca_model):
+    from spark_rapids_ml_tpu.obs import compile_stats
+
+    model, x = pca_model
+    reg = ModelRegistry()
+    reg.register("warm_multi_pca", model, buckets=(16, 32))
+    engine = ServeEngine(reg, max_batch_rows=32, max_wait_ms=1.0,
+                         buckets=(16, 32), replicas=3)
+    try:
+        engine.warmup("warm_multi_pca")
+        before = sum(s["compiles"] for s in compile_stats().values())
+        threads = [threading.Thread(
+            target=lambda i=i: engine.predict("warm_multi_pca",
+                                              x[i:i + 8]))
+            for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        after = sum(s["compiles"] for s in compile_stats().values())
+        assert after == before, "predict compiled after warmup"
+    finally:
+        engine.shutdown()
