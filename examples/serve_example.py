@@ -18,7 +18,12 @@ finally the MULTI-DEVICE serving tier: the same model replicated onto
 both (forced) host devices, concurrent traffic split by least-loaded
 placement, the per-device batch split printed from the replica
 counters, a device-targeted fault draining one replica onto its
-sibling, and an oversize request served by the batch-sharded program.
+sibling, and an oversize request served by the batch-sharded program — and
+closes with the LIVE ROLLOUT loop (``serve.rollout``): a streaming
+trainer publishes a candidate version from live batches, a canary
+routes 40% of alias traffic onto it under a shadow tenant, an injected
+candidate-targeted fault regresses it, and the controller rolls the
+alias back to the incumbent on its own.
 Runs on CPU (JAX_PLATFORMS=cpu) or any accelerator.
 """
 
@@ -566,6 +571,85 @@ def main():
           f"{len(devices)} devices -> output {big.shape} "
           f"({len(sharded_events)} sharded dispatch(es))")
     engine4.shutdown()
+
+    _rollout_demo(x)
+
+
+def _rollout_demo(x):
+    """Live rollout (serve/rollout.py): stream-fit a candidate while
+    the incumbent serves, canary it on live alias traffic, inject a
+    candidate-targeted regression, and watch the controller roll the
+    alias back on its own."""
+    import tempfile
+
+    from spark_rapids_ml_tpu.serve import (
+        RolloutController,
+        StreamingTrainer,
+        fault_plane,
+    )
+
+    print("\n== live rollout: streaming fit -> canary -> injected "
+          "regression -> auto-rollback ==")
+    model = PCA().setK(8).fit(x)
+    registry = ModelRegistry()
+    registry.register("rollout_pca", model, buckets=(32, 64))
+    engine = ServeEngine(registry, max_batch_rows=64, max_wait_ms=1,
+                         retries=0, breaker_failures=1000,
+                         breaker_burn_threshold=0)
+    rollout = RolloutController(
+        engine, "rollout_pca", alias="live",
+        fraction=0.4, shadow_tenant="canary_shadow",
+        min_requests=6, eval_interval_s=0.05, regressed_hold_s=2.0)
+    engine.attach_rollout(rollout)
+    rollout.promote(1)
+    print("  v1 promoted behind alias 'live' (warmed, then one pinned "
+          "alias flip)")
+
+    trainer = StreamingTrainer(
+        registry, "rollout_pca", x.shape[1], 8, batches_per_version=4,
+        artifact_dir=tempfile.mkdtemp(prefix="sparkml_rollout_demo_"),
+        rollout=rollout)
+    for i in range(4):
+        trainer.feed(x[i * 128:(i + 1) * 128])
+    print(f"  streaming trainer folded 4 live batches -> published "
+          f"candidate v{rollout.candidate} "
+          f"(artifact persisted, manifest-recoverable)")
+
+    rollout.start_canary()
+    print(f"  canary started: 40% of 'live' traffic -> v2, pinned to "
+          f"tenant 'canary_shadow' (the fairness ledger audits it)")
+    plane = fault_plane()
+    plane.inject("rollout_pca", "raise", count=None,
+                 version=rollout.canary_version)
+    print("  injected: 100% backend errors targeted at v2 ONLY")
+
+    served = {1: 0, 2: 0}
+    errors = 0
+    for i in range(60):
+        if not rollout.canary_active:
+            break
+        try:
+            engine.predict("live", x[i % 400:i % 400 + 8])
+            served[1] += 1
+        except Exception:
+            errors += 1
+            served[2] += 1
+    decisions = [d for d in rollout.decisions
+                 if d["action"] == "rollback"]
+    print(f"  drove traffic: v1 answered {served[1]}, v2 failed "
+          f"{errors} -> auto-rollback: {bool(decisions)}")
+    if decisions:
+        print(f"    reason: {decisions[0]['reason']}")
+    print(f"  alias 'live' now serves "
+          f"v{registry.resolve_entry('live').version}; "
+          f"sparkml_serve_canary_regressed{{candidate=\"2\"}} raised -> "
+          f"the serve_canary_regressed incident names the candidate")
+    plane.clear()
+    for i in range(10):
+        engine.predict("live", x[i:i + 8])
+    print("  post-rollback: 10/10 alias requests served by the "
+          "incumbent (the armed fault targets only v2)")
+    engine.shutdown()
 
 
 def get_recorder_events():

@@ -25,7 +25,14 @@ fault class at a time, measuring what a client on the wire experiences:
   never read as backend failure (the PR 6 invariant extended to the
   admission/shed layer);
 * **recovery**   — faults cleared: a half-open probe closes the
-  breaker and availability returns to 1.0.
+  breaker and availability returns to 1.0;
+* **canary_rollback** — train-while-serving (own subprocess): a
+  streaming-fit candidate version canaries a slice of live alias
+  traffic, a fault targeted at the CANDIDATE VERSION fires, the
+  incumbent's traffic stays at availability 1.0, the rollout
+  controller auto-rolls the alias back within the detector window,
+  and exactly one ``serve_canary_regressed`` incident (labels naming
+  the candidate version, complete bundle) opens and auto-resolves.
 
 The drill also asserts the **auto-incident loop** (``obs.incidents``,
 installed on the sampler by the serve server): each injected fault
@@ -297,6 +304,240 @@ def replica_drain_child() -> int:
     return 0 if not result.get("problems") else 1
 
 
+CANARY_ROLLBACK_PREFIX = "CANARY_ROLLBACK_RESULT "
+
+
+def canary_rollback_child() -> int:
+    """The canary-rollback drill leg, run in its OWN process (fresh
+    incident engine, fresh metrics, nothing shared with the main
+    drill's detectors).
+
+    Contract (ISSUE 14): stream-fit a candidate version while the
+    incumbent serves, canary a slice of live alias traffic onto it,
+    inject a fault targeted at the CANDIDATE VERSION ONLY → every
+    incumbent-served request stays 200 (non-canary availability 1.0),
+    the controller auto-rolls the alias back within the detector
+    window, exactly one ``serve_canary_regressed`` incident opens with
+    a complete evidence bundle whose labels name the candidate
+    version, and the incident auto-resolves once the regressed gauge's
+    hold elapses."""
+    import concurrent.futures
+    import tempfile
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import (
+        ModelRegistry,
+        RolloutController,
+        ServeEngine,
+        StreamingTrainer,
+        fault_plane,
+        start_serve_server,
+    )
+
+    result = {}
+    problems = []
+    rng = np.random.default_rng(14)
+    n_features, k = 12, 3
+    x = rng.normal(size=(1024, n_features))
+    incumbent_model = PCA().setK(k).fit(x)
+    registry = ModelRegistry()
+    registry.register("canary_pca", incumbent_model, buckets=(16, 64))
+    # The model-level breaker stays OUT of this phase's way (huge
+    # failure threshold, burn trip disabled): the actuator under test
+    # is the ROLLOUT controller — a canary storm must be answered by an
+    # alias rollback, not by the incumbent's breaker opening.
+    engine = ServeEngine(
+        registry, max_batch_rows=64, max_wait_ms=1.0,
+        retries=1, backoff_ms=5,
+        breaker_failures=1000, breaker_burn_threshold=0,
+        default_deadline_ms=10_000,
+    )
+    rollout = RolloutController(
+        engine, "canary_pca", alias="canary_prod",
+        fraction=0.35, shadow_tenant="canary_shadow",
+        min_requests=8, window_s=30.0, eval_interval_s=0.1,
+        burn_threshold=14.4, availability_target=0.99,
+        regressed_hold_s=3.0,
+    )
+    engine.attach_rollout(rollout)
+    rollout.promote(1)  # initial deploy: warm, then pin the alias
+    trainer = StreamingTrainer(
+        registry, "canary_pca", n_features, k,
+        batches_per_version=4,
+        artifact_dir=tempfile.mkdtemp(prefix="sparkml_canary_drill_"),
+        rollout=rollout,
+    )
+    # live-traffic shape: the trainer streams the SAME distribution the
+    # incumbent was fitted on, so the candidate is numerically honest —
+    # the injected fault, not the model, is what burns the canary
+    for i in range(4):
+        trainer.feed(x[i * 256:(i + 1) * 256])
+    result["candidate"] = rollout.candidate
+    if rollout.candidate is None:
+        problems.append("streaming trainer never published a candidate")
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    plane = fault_plane()
+    try:
+        doc = _get_json(base, "/debug/incidents")
+        known = {i.get("id") for i in
+                 _incident_entries(doc, "serve_canary_regressed")}
+        rollout.start_canary()
+        candidate = rollout.canary_version
+        result["canary_version"] = candidate
+        plane.inject("canary_pca", "raise", count=None,
+                     version=candidate)
+
+        import threading
+
+        outcomes = []
+        lock = threading.Lock()
+
+        def one(i: int) -> None:
+            # per-task generator: numpy Generators are not thread-safe,
+            # and a corrupted draw could slice a bad request shape that
+            # reads as an incumbent failure (the _tenant_burst lesson)
+            local_rng = np.random.default_rng(2000 + i)
+            n = int(local_rng.integers(1, 9))
+            start = int(local_rng.integers(0, x.shape[0] - n))
+            status, payload = _post_predict(
+                base, "canary_prod", x[start:start + n])
+            with lock:
+                outcomes.append((status, payload.get("version")))
+
+        with concurrent.futures.ThreadPoolExecutor(6) as pool:
+            list(pool.map(one, range(120)))
+
+        incumbent_hits = [s for s, v in outcomes if v == 1]
+        canary_hits = [s for s, v in outcomes if v == candidate]
+        unattributed = [s for s, v in outcomes
+                        if v not in (1, candidate)]
+        result["requests"] = len(outcomes)
+        result["incumbent_requests"] = len(incumbent_hits)
+        result["canary_requests"] = len(canary_hits)
+        result["canary_errors"] = sum(1 for s in canary_hits
+                                      if s != 200)
+        result["unattributed"] = len(unattributed)
+        result["non_canary_availability"] = (
+            sum(1 for s in incumbent_hits if s == 200)
+            / len(incumbent_hits) if incumbent_hits else 0.0)
+        if unattributed:
+            problems.append(
+                f"{len(unattributed)} response(s) carried no serving "
+                "version (cannot attribute to an arm)")
+
+        # rollback within the detector window: the controller judges at
+        # its eval cadence as results stream in; give it a short grace
+        # of trickle traffic in case the burst ended right at the floor
+        deadline = time.monotonic() + 10.0
+        while rollout.canary_active and time.monotonic() < deadline:
+            n = int(rng.integers(1, 9))
+            start = int(rng.integers(0, x.shape[0] - n))
+            _post_predict(base, "canary_prod", x[start:start + n])
+            time.sleep(0.05)
+        decisions = list(rollout.decisions)
+        rollbacks = [d for d in decisions if d["action"] == "rollback"]
+        result["rolled_back"] = bool(rollbacks)
+        result["rollback_reason"] = (rollbacks[0].get("reason")
+                                     if rollbacks else None)
+        if not rollbacks:
+            problems.append(
+                "canary never auto-rolled back under a candidate-"
+                "targeted 100% fault")
+        alias_entry = registry.resolve_entry("canary_prod")
+        result["alias_version_after"] = alias_entry.version
+        if alias_entry.version != 1:
+            problems.append(
+                f"alias points at v{alias_entry.version} after "
+                "rollback (expected the incumbent v1)")
+
+        # post-rollback: ALL alias traffic serves the incumbent at
+        # availability 1.0 (the fault is still armed — it targets the
+        # candidate version, which no longer sees traffic)
+        post = []
+        for _ in range(30):
+            n = int(rng.integers(1, 9))
+            start = int(rng.integers(0, x.shape[0] - n))
+            status, payload = _post_predict(
+                base, "canary_prod", x[start:start + n])
+            post.append((status, payload.get("version")))
+        result["post_rollback_availability"] = (
+            sum(1 for s, _v in post if s == 200) / len(post))
+        result["post_rollback_canary_hits"] = sum(
+            1 for _s, v in post if v == candidate)
+        if result["post_rollback_canary_hits"]:
+            problems.append(
+                "candidate still served alias traffic after rollback")
+
+        new = _await_new_incidents(base, "serve_canary_regressed",
+                                   known)
+        result["incidents_opened"] = len(new)
+        if len(new) != 1:
+            problems.append(
+                f"expected exactly 1 serve_canary_regressed incident, "
+                f"saw {len(new)}")
+        for incident in new:
+            problems.extend(_bundle_problems(incident))
+            named = str(incident.get("labels", {}).get("candidate"))
+            if named != str(candidate):
+                problems.append(
+                    f"incident names candidate {named!r}, expected "
+                    f"{candidate!r}")
+        # the regressed gauge clears after its hold (ticked by rollout
+        # polls), then the detector's quiet sweeps auto-resolve
+        resolved = True
+        for incident in new:
+            inc_deadline = time.monotonic() + 30.0
+            done = False
+            while time.monotonic() < inc_deadline:
+                _get_json(base, "/debug/rollout")  # ticks the hold
+                if _await_resolved(base, incident["id"], budget=0.5):
+                    done = True
+                    break
+            if not done:
+                resolved = False
+                problems.append(
+                    f"{incident['id']} did not auto-resolve after the "
+                    "regressed hold")
+        result["incidents_resolved"] = resolved
+        result["problems"] = problems
+    finally:
+        plane.clear()
+        server.shutdown()
+        engine.shutdown()
+        from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+
+        tsdb_mod.get_sampler().stop()
+        time.sleep(1.0)
+    sys.stdout.write(CANARY_ROLLBACK_PREFIX + json.dumps(result) + "\n")
+    sys.stdout.flush()
+    return 0 if not result.get("problems") else 1
+
+
+def run_canary_rollback_phase() -> dict:
+    """Spawn the canary-rollback child; returns its result (or a
+    synthesized failure entry when the child broke)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["SPARKML_CHAOS_PHASE"] = "canary_rollback_child"
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    result = bench_common.prefixed_result(proc.stdout,
+                                          CANARY_ROLLBACK_PREFIX)
+    if result is None:
+        return {"problems": [
+            f"canary-rollback child produced no result "
+            f"(rc={proc.returncode}): {proc.stderr[-1500:]}"]}
+    if proc.returncode != 0 and not result.get("problems"):
+        result.setdefault("problems", []).append(
+            f"canary-rollback child exited {proc.returncode}")
+    return result
+
+
 def run_replica_drain_phase() -> dict:
     """Spawn the 2-device replica-drain child; returns its result (or
     a synthesized failure entry when the child broke)."""
@@ -491,6 +732,8 @@ def _tenant_burst(base: str, model: str, x, seconds: float,
 def main() -> int:
     if os.environ.get("SPARKML_CHAOS_PHASE") == "replica_drain_child":
         return replica_drain_child()
+    if os.environ.get("SPARKML_CHAOS_PHASE") == "canary_rollback_child":
+        return canary_rollback_child()
     n_requests = _env_int("SPARKML_CHAOS_REQUESTS", 24)
     n_features = _env_int("SPARKML_CHAOS_FEATURES", 16)
     k = _env_int("SPARKML_CHAOS_K", 4)
@@ -759,6 +1002,13 @@ def main() -> int:
         # down, with its own incident loop.
         bench_common.log("chaos replica drain (2-device subprocess)")
         replica_drain = run_replica_drain_phase()
+
+        # -- canary rollback: stream-fit a candidate, canary it on live
+        # alias traffic, fault ONLY the candidate version, and prove the
+        # rollout tier rolls the alias back (own subprocess — fresh
+        # incident engine, nothing shared with this drill's detectors).
+        bench_common.log("chaos canary rollback (train-while-serving)")
+        canary_rollback = run_canary_rollback_phase()
     finally:
         plane.clear()
         server.shutdown()
@@ -808,6 +1058,9 @@ def main() -> int:
         "replica_drain": replica_drain,
         "availability_replica_drain": replica_drain.get(
             "availability", 0.0),
+        "canary_rollback": canary_rollback,
+        "availability_canary_incumbent": canary_rollback.get(
+            "non_canary_availability", 0.0),
         "phases": {name: {k: v for k, v in stats.items()
                           if k != "statuses"}
                    for name, stats in phases.items()},
@@ -873,6 +1126,18 @@ def main() -> int:
         bench_common.log(
             f"chaos FAIL: replica-drain contract broke: "
             f"{replica_drain['problems']}")
+        return 1
+    if canary_rollback.get("non_canary_availability", 0.0) < 0.999:
+        bench_common.log(
+            f"chaos FAIL: non-canary availability "
+            f"{canary_rollback.get('non_canary_availability', 0.0):.3f} "
+            "< 1.0 — a candidate-targeted fault leaked onto the "
+            "incumbent's traffic")
+        return 1
+    if canary_rollback.get("problems"):
+        bench_common.log(
+            f"chaos FAIL: canary-rollback contract broke: "
+            f"{canary_rollback['problems']}")
         return 1
     bench_common.log("chaos drill PASS")
     # final settle: any worker abandoned mid-jax-call must leave the

@@ -94,6 +94,15 @@ Three rule families:
    the request's trace tree is a silent drop: the tenant sees a 503,
    the operator sees nothing, and the fairness contract becomes
    unauditable.
+13. over ``serve/rollout.py`` and ``serve/registry.py`` (the rollout
+   control plane): every **alias-flip path** — a function named
+   ``alias``/``promote``/``rollback``/``abort``, or any function that
+   *calls* an ``.alias(...)``/``.promote(...)`` mutation — must, in
+   the same enclosing function, record a ``serve:rollout`` audit span
+   (``span``/``record_event``) or increment a decision counter
+   (``.inc(...)``). What a model alias points at IS what live traffic
+   serves: a promote or rollback that neither the metrics nor the
+   trace tree can see is an unauditable deployment change.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -611,6 +620,55 @@ def check_admission_decisions(path: str):
     yield from visit(tree, None)
 
 
+# rule 13: the rollout control plane — alias promote/rollback/abort
+# paths must be audit-spanned or decision-counted in the same function.
+ROLLOUT_FILES = tuple(
+    os.path.join(REPO, "spark_rapids_ml_tpu", "serve", name)
+    for name in ("rollout.py", "registry.py")
+)
+_ROLLOUT_MUTATOR_NAMES = frozenset({"alias", "promote", "rollback",
+                                    "abort"})
+_ROLLOUT_MUTATION_CALLS = frozenset({"alias", "promote"})
+_ROLLOUT_ACCOUNTING = frozenset({"inc", "record_event", "span"})
+
+
+def check_rollout_audit(path: str):
+    """Rule 13: yield (lineno, description) for every unaudited
+    alias-flip path in one rollout/registry module.
+
+    A flip path is a function DEF named ``alias``/``promote``/
+    ``rollback``/``abort`` or a function whose body calls an
+    ``.alias(...)``/``.promote(...)`` mutation; the same function must
+    carry a ``span``/``record_event`` audit call or a decision-counter
+    ``.inc(...)`` — an alias mutation nobody can see is an unauditable
+    deployment change."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        is_flip_path = node.name in _ROLLOUT_MUTATOR_NAMES
+        if not is_flip_path:
+            for child in ast.walk(node):
+                if (isinstance(child, ast.Call)
+                        and _call_name(child) in _ROLLOUT_MUTATION_CALLS):
+                    is_flip_path = True
+                    break
+        if not is_flip_path:
+            continue
+        accounts = any(
+            isinstance(child, ast.Call)
+            and _call_name(child) in _ROLLOUT_ACCOUNTING
+            for child in ast.walk(node)
+        )
+        if not accounts:
+            yield (node.lineno,
+                   f"alias-flip path {node.name}() without a "
+                   "serve:rollout audit span/record_event or a "
+                   "decision-counter .inc(...) in the same function — "
+                   "an alias mutation nobody can see is an unauditable "
+                   "deployment change (rule 13)")
+
+
 # rule 11: the wire boundary — server body decoding must route through
 # serve/wire.py, whose decoders must record the parse-phase latency.
 SERVER_FILE = os.path.join(
@@ -873,6 +931,11 @@ def main() -> int:
         rel = os.path.relpath(WIRE_FILE, REPO)
         for lineno, why in check_wire_parse_metrics(WIRE_FILE):
             offenders.append(f"{rel}:{lineno} {why}")
+    rollout_files = [p for p in ROLLOUT_FILES if os.path.exists(p)]
+    for path in rollout_files:
+        rel = os.path.relpath(path, REPO)
+        for lineno, why in check_rollout_audit(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -893,7 +956,10 @@ def main() -> int:
         f"admission/scheduler module(s) with every shed/admission "
         f"decision counted or audit-spanned; request-body decoding "
         f"routed through serve/wire.py with the parse stage measured; "
-        f"serve/ device selection routed through serve/placement.py"
+        f"serve/ device selection routed through serve/placement.py; "
+        f"{len(rollout_files)} rollout/registry module(s) with every "
+        f"alias promote/rollback/abort path audit-spanned or "
+        f"decision-counted"
     )
     return 0
 

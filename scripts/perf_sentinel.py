@@ -248,6 +248,44 @@ def _is_fallback(record: Dict[str, Any]) -> bool:
     return bool(isinstance(best, dict) and best.get("stale"))
 
 
+def _parse_utc(value) -> Optional[float]:
+    """Epoch seconds from an ISO-8601 UTC stamp (``...Z`` or offset
+    spelled out); None when unparseable — a malformed timestamp must
+    never break a verdict."""
+    if not value:
+        return None
+    from datetime import datetime, timezone
+
+    text = str(value).strip()
+    if text.endswith("Z"):
+        text = text[:-1] + "+00:00"
+    try:
+        dt = datetime.fromisoformat(text)
+    except ValueError:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
+
+
+def stale_baseline_age_days(stale_baseline,
+                            now: Optional[float] = None
+                            ) -> Optional[float]:
+    """How many days old the stale chip baseline is — the number that
+    turns 'STALE' from prose into an actionable age. None when the
+    baseline carries no parseable measurement timestamp."""
+    if not isinstance(stale_baseline, dict):
+        return None
+    measured = _parse_utc(stale_baseline.get("measured_utc"))
+    if measured is None:
+        return None
+    if now is None:
+        import time as _time
+
+        now = _time.time()
+    return max((now - measured) / 86400.0, 0.0)
+
+
 def judge(record: Dict[str, Any], history: List[Dict[str, Any]],
           tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
     """The sentinel verdict for one record against the history."""
@@ -302,6 +340,22 @@ def judge(record: Dict[str, Any], history: List[Dict[str, Any]],
             ),
             stale_baseline=stale_baseline,
         )
+        # The r04+ situation surfaced as a NUMBER, not prose: every
+        # STALE verdict states how long the chip baseline has gone
+        # un-re-measured while rounds fall back to CPU.
+        age_days = stale_baseline_age_days(stale_baseline)
+        if age_days is not None:
+            verdict["stale_baseline_age_days"] = round(age_days, 2)
+            cause = (
+                "this round's device tunnel fell back to CPU"
+                if record.get("fallback_reason")
+                else f"this round ran on {platform or 'another platform'}"
+            )
+            verdict["stale_warning"] = (
+                f"chip baseline is {age_days:.1f} days old and {cause} "
+                "— the committed chip numbers have not been re-measured "
+                "since; treat every chip-derived claim as aging"
+            )
         return verdict
 
     # Untagged history (older records without a platform field — the r04

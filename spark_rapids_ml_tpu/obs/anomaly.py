@@ -499,6 +499,20 @@ def builtin_detectors(
             kind="replica", severity="serious",
             stale_after=max(2 * w, 120.0),
         ),
+        # The rollout tier (serve/rollout.py): the canary controller
+        # raises this gauge to 1 when an experiment auto-rolls back —
+        # the series labels {model, candidate} NAME the regressed
+        # candidate version, so the incident (and its evidence bundle)
+        # carries exactly which version burned the canary. The
+        # controller clears the gauge after ROLLOUT_REGRESSED_HOLD_S,
+        # which is what lets the incident auto-resolve.
+        ThresholdDetector(
+            "serve_canary_regressed",
+            "sparkml_serve_canary_regressed",
+            threshold=0.5, direction=">",
+            kind="rollout", severity="critical",
+            stale_after=max(2 * w, 120.0),
+        ),
     ]
 
 

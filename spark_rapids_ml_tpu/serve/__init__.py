@@ -125,6 +125,10 @@ from spark_rapids_ml_tpu.serve.registry import (  # noqa: F401
     ModelRegistry,
     RegisteredModel,
 )
+from spark_rapids_ml_tpu.serve.rollout import (  # noqa: F401
+    RolloutController,
+    StreamingTrainer,
+)
 from spark_rapids_ml_tpu.serve.server import (  # noqa: F401
     make_handler,
     start_serve_server,
@@ -155,7 +159,9 @@ __all__ = [
     "Replica",
     "ReplicaHealth",
     "ReplicaSet",
+    "RolloutController",
     "ServeEngine",
+    "StreamingTrainer",
     "ShedController",
     "ShedLoad",
     "TokenBucket",

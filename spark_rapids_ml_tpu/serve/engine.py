@@ -470,6 +470,9 @@ class ServeEngine:
         self._fallbacks: Dict[Tuple[str, int], Any] = {}
         self._lock = threading.Lock()
         self._closed = False
+        # the live-rollout control plane (serve.rollout), attached via
+        # attach_rollout: canary routing + per-arm outcome attribution
+        self._rollout = None
         # hot-path metric handles, resolved once (same convention as
         # MicroBatcher._declare_metrics)
         reg = get_registry()
@@ -579,8 +582,22 @@ class ServeEngine:
             raise EngineClosed("serving engine is shut down")
         t0 = time.perf_counter()
         entry = self.registry.resolve_entry(model_ref, version)
-        brk = self._breaker_for(entry.name)
         ctx = tracectx.ensure_context()
+        # The rollout tier's canary router: alias traffic without an
+        # explicit version pin may deterministically route to the
+        # candidate version (the HTTP layer routes via route_entry and
+        # then pins, so it never re-routes here). Canary-routed
+        # requests are optionally pinned to the shadow tenant so the
+        # fairness ledger audits the experiment as its own tenant.
+        rollout = self._rollout
+        if rollout is not None:
+            if version is None:
+                entry, canary = rollout.route(model_ref, entry,
+                                              ctx.trace_id)
+                if canary and rollout.shadow_tenant:
+                    tenant = rollout.shadow_tenant
+            rollout.maybe_mirror(entry.name, rows)
+        brk = self._breaker_for(entry.name)
         # submitted[0] flips once a batcher accepted the request: a
         # ValueError BEFORE that is the client's (bad shape), AFTER it is
         # the batch execution failing — the outage the SLO layer sees.
@@ -657,18 +674,56 @@ class ServeEngine:
                     self._m_errors.inc(model=entry.name,
                                        error="load_shed")
                 self.slo.record_request(False, time.perf_counter() - t0)
+                # classify BEFORE note_result: the note may itself
+                # trigger the auto-rollback that ends the experiment,
+                # and this failure — the one that tipped the verdict —
+                # must still count as a canary failure below
+                canary_failure = (
+                    rollout is not None
+                    and rollout.is_canary_version(entry.name,
+                                                  entry.version))
+                if rollout is not None:
+                    # per-arm attribution for the canary verdict:
+                    # backend failures AND timeout-class outcomes charge
+                    # the serving arm (each version owns its batcher
+                    # queue, so a deadline/wait expiry is arm-specific
+                    # signal — a STALLING candidate must roll back, not
+                    # just a raising one); orderly capacity sheds
+                    # (ShedLoad/QueueFull) say nothing about the model
+                    # and charge neither arm (note_result ignores
+                    # backend=False).
+                    rollout.note_result(
+                        entry.name, entry.version, ok=False,
+                        latency_s=time.perf_counter() - t0,
+                        backend=(is_backend_error(exc)
+                                 or isinstance(exc, (DeadlineExpired,
+                                                     WaitTimeout))))
                 # The SLO fast-burn trip wire: sustained backend-failure
                 # bursts open the breaker even when they are not
                 # consecutive. Only device-side failures feed it — a
                 # QueueFull/DeadlineExpired overload burst still burns
                 # the SLO budget above, but must not open (or, via the
                 # breaker's own BreakerOpen sheds saturating the window,
-                # re-open) a breaker guarding a healthy device.
-                if is_backend_error(exc) and brk.burn_threshold > 0:
+                # re-open) a breaker guarding a healthy device. Failures
+                # served by an ACTIVE canary candidate are also exempt:
+                # the model-level breaker is shared per NAME, and a sick
+                # candidate at 5% traffic burns the shared budget hard
+                # enough (5% error ÷ 0.1% budget = burn 50) to open the
+                # breaker against the healthy incumbent before the
+                # canary verdict floor is met — the rollout controller
+                # is the actuator for candidate failures (it rolls the
+                # alias back); the consecutive-failure threshold stays
+                # shared, so a genuinely sick device that fails BOTH
+                # arms still opens the breaker.
+                if (is_backend_error(exc) and brk.burn_threshold > 0
+                        and not canary_failure):
                     brk.note_burn(self.slo.fast_burn_rate())
             raise
         elapsed = time.perf_counter() - t0
         self.slo.record_request(True, elapsed)
+        if rollout is not None:
+            rollout.note_result(entry.name, entry.version, ok=True,
+                                latency_s=elapsed)
         self._m_tenant.inc(tenant=tenant_id, outcome="ok")
         self._m_latency.observe(elapsed, trace_id=ctx.trace_id,
                                 model=entry.name)
@@ -867,12 +922,14 @@ class ServeEngine:
         model's observed entry point."""
         model = entry.model
         name = entry.name
+        version = entry.version
 
         def transform(matrix: np.ndarray) -> np.ndarray:
             # resolve the plane per call (like batching._run): a batcher
             # outliving reset_fault_plane() must consult the LIVE plane,
             # or later-armed faults silently never fire on this model
-            spec = faults_mod.fault_plane().begin_call(name)
+            spec = faults_mod.fault_plane().begin_call(name,
+                                                      version=version)
             if spec is not None:
                 faults_mod.apply_pre(spec)
             out = np.asarray(extract_output(model, model.transform(matrix)))
@@ -986,15 +1043,18 @@ class ServeEngine:
         corruption applies at the completion-step fetch so the NaN
         guard sees it exactly like the sync path. ``device_label`` is
         handed to the plane so device-TARGETED faults (the replica-
-        drain chaos drill) hit only their replica."""
+        drain chaos drill) hit only their replica, and the entry's
+        version so version-TARGETED faults (the canary-rollback drill)
+        hit only their registry version."""
         name = entry.name
+        version = entry.version
 
         def dispatch(x_dev, _prog=prog):
             # resolve the plane per call (like the sync closure): a
             # batcher outliving reset_fault_plane() must consult the
             # LIVE plane, or later-armed faults never fire here
             spec_ = faults_mod.fault_plane().begin_call(
-                name, device=device_label)
+                name, device=device_label, version=version)
             if spec_ is not None:
                 faults_mod.apply_pre(spec_)
             return _prog.run(x_dev), spec_
@@ -1303,7 +1363,8 @@ class ServeEngine:
         ):
             # the fault plane hooks this path like every other dispatch
             # site, so chaos drills can fault the sharded program too
-            spec_ = faults_mod.fault_plane().begin_call(entry.name)
+            spec_ = faults_mod.fault_plane().begin_call(
+                entry.name, version=entry.version)
             if spec_ is not None:
                 faults_mod.apply_pre(spec_)
             out = prog.fetch(prog.run(prog.put(padded)))
@@ -1398,6 +1459,42 @@ class ServeEngine:
         snap["fair_scheduling"] = self.fair_scheduling
         snap["retry_after_seconds"] = self.retry_after_estimate()
         return snap
+
+    # -- the live-rollout control plane (serve.rollout) --------------------
+
+    def attach_rollout(self, controller) -> None:
+        """Install a ``serve.rollout.RolloutController``: alias traffic
+        consults its canary router, every served outcome feeds its
+        per-arm comparison, and ``/debug/rollout`` serves its state."""
+        self._rollout = controller
+
+    def rollout_controller(self):
+        return self._rollout
+
+    def route_entry(self, ref: str, trace_id: Optional[str] = None
+                    ) -> Tuple[RegisteredModel, Optional[str]]:
+        """Resolve ``ref`` through the canary router: ``(entry,
+        shadow_tenant_or_None)``. The HTTP layer resolves here ONCE and
+        then predicts against the pinned version, so the reported
+        version is the one that actually served — and the engine never
+        re-routes a pinned request."""
+        entry = self.registry.resolve_entry(ref)
+        rollout = self._rollout
+        if rollout is None:
+            return entry, None
+        entry, canary = rollout.route(ref, entry, trace_id)
+        return entry, (rollout.shadow_tenant
+                       if canary and rollout.shadow_tenant else None)
+
+    def rollout_snapshot(self) -> Dict[str, Any]:
+        """The ``GET /debug/rollout`` document (``{"enabled": False}``
+        without an attached controller)."""
+        rollout = self._rollout
+        if rollout is None:
+            return {"enabled": False}
+        doc = rollout.snapshot()
+        doc["enabled"] = True
+        return doc
 
     def _breaker_for(self, name: str) -> CircuitBreaker:
         with self._lock:

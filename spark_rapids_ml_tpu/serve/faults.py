@@ -84,17 +84,23 @@ class FaultSpec:
 
     ``device`` (None = any) narrows the fault to ONE replica's device —
     the replica-drain chaos drill faults a single chip's dispatches and
-    proves the placement tier sheds onto the siblings. A device-
-    targeted spec never fires at call sites that carry no device
-    identity (the blocking sync path, the worker loop)."""
+    proves the placement tier sheds onto the siblings. ``version``
+    (None = any) narrows it to ONE registry version's call sites — the
+    canary-rollback drill faults only the CANDIDATE version's
+    dispatches and proves the rollout tier rolls the alias back while
+    the incumbent keeps serving. A device- or version-targeted spec
+    never fires at call sites that carry no matching identity (the
+    worker loop is version-less; the blocking sync path is
+    device-less)."""
 
     __slots__ = ("model", "kind", "count", "start", "every", "seconds",
-                 "device", "fired")
+                 "device", "version", "fired")
 
     def __init__(self, model: str = "*", kind: str = "raise", *,
                  count: Optional[int] = 1, start: int = 0, every: int = 1,
                  seconds: Optional[float] = None,
-                 device: Optional[str] = None):
+                 device: Optional[str] = None,
+                 version: Optional[int] = None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
         if every < 1:
@@ -107,13 +113,17 @@ class FaultSpec:
         self.seconds = (float(seconds) if seconds is not None
                         else _DEFAULT_SECONDS.get(kind, 0.0))
         self.device = device
+        self.version = None if version is None else int(version)
         self.fired = 0
 
     def matches(self, model: str, index: int,
-                device: Optional[str] = None) -> bool:
+                device: Optional[str] = None,
+                version: Optional[int] = None) -> bool:
         if self.model not in ("*", model):
             return False
         if self.device is not None and device != self.device:
+            return False
+        if self.version is not None and version != self.version:
             return False
         if index < self.start or (index - self.start) % self.every != 0:
             return False
@@ -128,6 +138,7 @@ class FaultSpec:
             "every": self.every,
             "seconds": self.seconds,
             "device": self.device,
+            "version": self.version,
             "fired": self.fired,
         }
 
@@ -184,12 +195,17 @@ class FaultPlane:
     def inject(self, model: str = "*", kind: str = "raise", *,
                count: Optional[int] = 1, start: int = 0, every: int = 1,
                seconds: Optional[float] = None,
-               device: Optional[str] = None) -> FaultSpec:
+               device: Optional[str] = None,
+               version: Optional[int] = None) -> FaultSpec:
         """Arm one fault; returns the live spec (its ``fired`` counter
         updates as the fault fires). ``device`` narrows it to one
-        replica's dispatch site (the replica-drain drill)."""
+        replica's dispatch site (the replica-drain drill); ``version``
+        narrows it to one registry version's call sites (the
+        canary-rollback drill — a candidate-targeted fault never fires
+        on the incumbent)."""
         spec = FaultSpec(model, kind, count=count, start=start,
-                         every=every, seconds=seconds, device=device)
+                         every=every, seconds=seconds, device=device,
+                         version=version)
         with self._lock:
             self._specs.append(spec)
         return spec
@@ -218,13 +234,14 @@ class FaultPlane:
     # -- firing ------------------------------------------------------------
 
     def _next(self, counters: Dict[str, int], model: str,
-              kinds, device: Optional[str] = None) -> Optional[FaultSpec]:
+              kinds, device: Optional[str] = None,
+              version: Optional[int] = None) -> Optional[FaultSpec]:
         with self._lock:
             index = counters.get(model, 0)
             counters[model] = index + 1
             for spec in self._specs:
                 if spec.kind in kinds and spec.matches(model, index,
-                                                      device):
+                                                      device, version):
                     spec.fired += 1
                     break
             else:
@@ -233,15 +250,17 @@ class FaultPlane:
         return spec
 
     def begin_call(self, model: str,
-                   device: Optional[str] = None) -> Optional[FaultSpec]:
+                   device: Optional[str] = None,
+                   version: Optional[int] = None) -> Optional[FaultSpec]:
         """Advance ``model``'s transform-site call index and return the
         fault (if any) that fires on this call. The caller applies it:
         ``apply_pre`` before the model call, ``corrupt`` on the output
         for ``nan``. ``device`` is the dispatching replica's device
-        label (None at device-less sites) — device-targeted specs only
-        fire when it matches."""
+        label and ``version`` the serving registry version (None at
+        sites without that identity) — targeted specs only fire when
+        theirs matches."""
         return self._next(self._calls, model, _TRANSFORM_KINDS,
-                          device=device)
+                          device=device, version=version)
 
     def worker_fault(self, model: str) -> Optional[FaultSpec]:
         """The worker-loop site: a matched ``crash_worker`` spec (the

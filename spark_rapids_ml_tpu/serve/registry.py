@@ -210,7 +210,14 @@ class ModelRegistry:
     def alias(self, alias: str, name: str,
               version: Optional[int] = None) -> None:
         """Point ``alias`` at ``name`` (pinned to ``version``, or floating
-        to the latest when None). Re-aliasing is how traffic rolls over."""
+        to the latest when None). Re-aliasing is how traffic rolls over.
+
+        The flip is ONE mutation under the registry lock, and
+        ``resolve_entry`` reads the alias map and the version table
+        under the same lock — a resolver racing the flip observes
+        either the old or the new target in full, never a half-promoted
+        state. Every flip is counted (rule 13: an alias mutation the
+        metrics cannot see is an unauditable rollover)."""
         with self._lock:
             if name not in self._versions:
                 raise KeyError(f"unknown model {name!r}")
@@ -219,6 +226,36 @@ class ModelRegistry:
             self._aliases[alias] = (name, version)
             pending = self._pending_manifest()
         self._write_manifest(pending)
+        get_registry().counter(
+            "sparkml_serve_alias_flips_total",
+            "alias mutations (rollover / promote / rollback flips)",
+            ("alias", "model"),
+        ).inc(alias=alias, model=name)
+
+    def promote(self, alias: str, name: str, version: int) -> None:
+        """Atomically point ``alias`` at PINNED ``name@version`` — the
+        rollout tier's hot-swap flip.
+
+        Unlike a floating alias (``version=None``), where a concurrent
+        ``register`` instantly changes what the alias resolves to (a
+        just-published candidate would leak into live traffic BEFORE
+        anyone promoted it), a promote is always pinned: traffic serves
+        exactly the promoted version until the next explicit flip."""
+        if version is None:
+            raise ValueError(
+                "promote() requires an explicit version — a floating "
+                "alias cannot promote atomically (a racing register "
+                "would change what it serves)")
+        with span(f"serve:rollout:alias_flip:{name}", alias=alias,
+                  model=name, version=int(version)):
+            self.alias(alias, name, int(version))
+
+    def alias_target(self, alias: str) -> Optional[Tuple[str,
+                                                         Optional[int]]]:
+        """The ``(name, pinned_version)`` an alias points at (None when
+        unknown) — one atomic read under the registry lock."""
+        with self._lock:
+            return self._aliases.get(alias)
 
     def deregister(self, name: str, version: Optional[int] = None) -> None:
         """Drop one version (or every version) of ``name``; aliases to it

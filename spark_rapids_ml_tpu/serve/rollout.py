@@ -1,0 +1,1028 @@
+"""Live-traffic model rollout: streaming fit → immutable candidate
+versions → canary routing → atomic hot-swap, with auto-rollback.
+
+Until now the serving tier answered from a frozen artifact: the registry
+could hold many immutable versions behind a floating alias, but nothing
+made *changing* the served model safe while requests were in flight.
+This module is the rollout control plane that closes the loop:
+
+* ``StreamingTrainer`` — a background partial-fit loop over
+  ``parallel.streaming.DistributedStreamingPCA`` (the one-pass update
+  form of arxiv 1612.08709): every incoming batch folds into the
+  donated gram accumulator, and every N batches the trainer finalizes,
+  **persists the fitted artifact to disk** (``io.persistence``), and
+  registers it as a new immutable registry version with a
+  ``source_path`` — so the registry manifest makes every mid-rollout
+  candidate crash-recoverable: a restart restores the incumbent AND the
+  not-yet-promoted candidate.
+* ``RolloutController`` — the actuator:
+
+  - **canary routing**: while an experiment is active, a deterministic
+    per-request hash of the trace id routes ``fraction`` of the
+    alias's traffic to the candidate version (same request → same arm,
+    run after run); canary traffic is optionally **pinned to a shadow
+    tenant** so the PR 10 fairness ledger audits the experiment like
+    any other tenant;
+  - **live comparison**: per-arm windowed error counts
+    (``obs.slo.WindowedCounts``, injectable clock), per-arm latency
+    sketches (``obs.quantiles.QuantileSketch``), and a
+    numerics-divergence probe that replays **mirrored sample batches**
+    through both versions and compares outputs;
+  - **auto-rollback**: a bad verdict — candidate SLO fast-burn ≥
+    ``CANARY_BURN``, candidate error rate past the incumbent-relative
+    ratio bar, candidate p99 past the latency ratio bar, or output
+    divergence past ``CANARY_DIVERGENCE_MAX`` — re-pins the alias to
+    the incumbent in one atomic registry mutation and raises the
+    ``sparkml_serve_canary_regressed{model,candidate}`` gauge, which
+    the ``serve_canary_regressed`` incident detector
+    (``obs.anomaly.builtin_detectors``) turns into exactly one
+    auto-incident whose labels (and evidence bundle) **name the
+    candidate version**; the controller clears the gauge after
+    ``ROLLOUT_REGRESSED_HOLD_S`` so the incident auto-resolves;
+  - **atomic hot-swap promotion**: ``promote()`` precompiles the
+    candidate's full bucket × precision ladder on every replica device
+    (``engine.warmup``) *before* flipping the alias — live traffic
+    never pays a cold XLA compile — and the flip itself is one pinned
+    ``registry.alias`` mutation under the registry lock, so a
+    concurrent resolve sees either the old or the new version, never a
+    half-promoted state. The old version's replica sets stay alive:
+    in-flight requests on the incumbent drain, they are never dropped.
+
+Every promote / rollback / abort / canary-start is a
+``serve:rollout:*`` audit span plus a
+``sparkml_serve_rollouts_total{model,action}`` decision counter (rule
+13 of ``scripts/check_instrumentation.py`` rejects an alias-flip path
+that records neither), and lands in a bounded decision history the
+``GET /debug/rollout`` endpoint serves.
+
+Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_*``; constructor args win):
+
+* ``..._ROLLOUT_BATCHES_PER_VERSION`` (8) — trainer publish cadence;
+* ``..._ROLLOUT_ARTIFACT_DIR`` — where streamed fits persist (default
+  ``<tmp>/sparkml_rollout_artifacts``);
+* ``..._ROLLOUT_REGRESSED_HOLD_S`` (30) — how long the regressed gauge
+  stays up after a rollback (must span the incident detector's
+  open hysteresis; the clear is what lets the incident auto-resolve);
+* ``..._CANARY_FRACTION`` (0.05) — traffic share routed to the
+  candidate while a canary is active;
+* ``..._CANARY_SHADOW_TENANT`` ("" = keep the request's own tenant) —
+  pin canary traffic to this tenant id;
+* ``..._CANARY_MIN_REQUESTS`` (20) — verdict floor: no judgment (and
+  no rollback) before the candidate arm saw this much traffic in the
+  window;
+* ``..._CANARY_WINDOW_S`` (60) — the comparison window;
+* ``..._CANARY_EVAL_MS`` (500) — verdict cadence (bounded, never per
+  request);
+* ``..._CANARY_BURN`` (14.4) — candidate error-rate ÷ canary error
+  budget that triggers rollback (the SRE page_fast factor);
+* ``..._CANARY_AVAILABILITY_TARGET`` (0.99) — the canary arm's own
+  availability objective (its error budget feeds the burn arithmetic;
+  looser than production's 0.999 so a single noisy request cannot
+  kill a healthy candidate);
+* ``..._CANARY_ERROR_RATIO`` (3.0) — candidate error rate vs
+  incumbent error rate ratio bar (with one error budget as the
+  absolute floor);
+* ``..._CANARY_LATENCY_RATIO`` (2.5) and ``..._CANARY_LATENCY_MIN_MS``
+  (10) — candidate p99 vs incumbent p99 bar, with an absolute floor so
+  scheduler noise on a microsecond path cannot page;
+* ``..._CANARY_DIVERGENCE_MAX`` (1e-6) — relative max-abs output
+  divergence bar over mirrored batches (both arms are the same
+  algorithm at f64 — honest candidates diverge only by accumulation
+  order);
+* ``..._CANARY_MIRROR_EVERY`` (16) — mirror-sampling cadence (1-in-K
+  canary-eligible requests contribute a ≤64-row batch to the ring).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.logging import get_logger
+from spark_rapids_ml_tpu.obs.quantiles import QuantileSketch
+from spark_rapids_ml_tpu.obs.slo import WindowedCounts
+
+ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
+
+_log = get_logger("serve.rollout")
+
+
+def _env_number(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(ENV_PREFIX + name, default))
+    except ValueError:
+        return default
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(ENV_PREFIX + name, default).strip()
+
+
+def default_artifact_dir() -> str:
+    """Where streamed fits persist
+    (``SPARK_RAPIDS_ML_TPU_SERVE_ROLLOUT_ARTIFACT_DIR``)."""
+    configured = _env_str("ROLLOUT_ARTIFACT_DIR", "")
+    return configured or os.path.join(tempfile.gettempdir(),
+                                      "sparkml_rollout_artifacts")
+
+
+def canary_bucket(trace_id: Optional[str]) -> int:
+    """Deterministic per-request routing bucket in [0, 10000): the same
+    trace id always lands in the same bucket, so a request's arm is a
+    pure function of its identity (replayable run after run)."""
+    digest = hashlib.blake2b((trace_id or "").encode("utf-8", "replace"),
+                             digest_size=4).digest()
+    return int.from_bytes(digest, "big") % 10_000
+
+
+class ArmStats:
+    """One canary arm's live scoreboard: windowed good/bad counts (the
+    burn arithmetic's input), a latency sketch, and lifetime totals."""
+
+    __slots__ = ("version", "counts", "sketch", "requests", "errors")
+
+    def __init__(self, version: int, window_s: float,
+                 clock: Callable[[], float]):
+        self.version = int(version)
+        # horizon covers a few windows; buckets fine enough that drills
+        # with sub-second windows still resolve the timeline
+        self.counts = WindowedCounts(
+            horizon_seconds=max(4.0 * window_s, 60.0),
+            bucket_seconds=max(window_s / 30.0, 0.1),
+            clock=clock,
+        )
+        self.sketch = QuantileSketch()
+        self.requests = 0
+        self.errors = 0
+
+    def note(self, ok: bool, latency_s: float) -> None:
+        self.counts.record(ok)
+        self.requests += 1
+        if not ok:
+            self.errors += 1
+        if ok and latency_s >= 0:
+            self.sketch.observe(latency_s)
+
+    def error_rate(self, window_s: float,
+                   now: Optional[float] = None) -> Tuple[float, float]:
+        """(error fraction, total) over the trailing window."""
+        good, total = self.counts.counts(window_s, now=now)
+        if total <= 0:
+            return 0.0, 0.0
+        return (total - good) / total, total
+
+    def p99(self) -> Optional[float]:
+        return self.sketch.quantile(0.99)
+
+    def snapshot(self, window_s: float,
+                 now: Optional[float] = None) -> Dict[str, Any]:
+        rate, total = self.error_rate(window_s, now=now)
+        p99 = self.p99()
+        return {
+            "version": self.version,
+            "requests": self.requests,
+            "errors": self.errors,
+            "window_error_rate": rate,
+            "window_total": total,
+            "p99_seconds": p99,
+            "p50_seconds": self.sketch.quantile(0.5),
+        }
+
+
+class RolloutController:
+    """The rollout actuator for ONE model name behind ONE alias.
+
+    Attach it to the engine (``engine.attach_rollout``): the predict
+    path consults ``route`` for alias traffic, feeds ``note_result``
+    with every served outcome, and ``maybe_mirror`` samples request
+    rows for the divergence probe. All verdict state uses the
+    injectable ``clock`` — tests drive the whole canary lifecycle with
+    zero sleeps.
+    """
+
+    def __init__(
+        self,
+        engine,
+        name: str,
+        alias: str = "prod",
+        *,
+        fraction: Optional[float] = None,
+        shadow_tenant: Optional[str] = None,
+        min_requests: Optional[int] = None,
+        window_s: Optional[float] = None,
+        eval_interval_s: Optional[float] = None,
+        burn_threshold: Optional[float] = None,
+        availability_target: Optional[float] = None,
+        error_ratio: Optional[float] = None,
+        latency_ratio: Optional[float] = None,
+        latency_floor_s: Optional[float] = None,
+        divergence_max: Optional[float] = None,
+        mirror_every: Optional[int] = None,
+        regressed_hold_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.engine = engine
+        self.registry = engine.registry
+        self.name = name
+        self.alias = alias
+        self.fraction = float(
+            fraction if fraction is not None
+            else _env_number("CANARY_FRACTION", 0.05))
+        self.shadow_tenant = (
+            shadow_tenant if shadow_tenant is not None
+            else (_env_str("CANARY_SHADOW_TENANT", "") or None))
+        self.min_requests = int(
+            min_requests if min_requests is not None
+            else _env_number("CANARY_MIN_REQUESTS", 20))
+        self.window_s = float(
+            window_s if window_s is not None
+            else _env_number("CANARY_WINDOW_S", 60.0))
+        self.eval_interval_s = float(
+            eval_interval_s if eval_interval_s is not None
+            else _env_number("CANARY_EVAL_MS", 500.0) / 1000.0)
+        self.burn_threshold = float(
+            burn_threshold if burn_threshold is not None
+            else _env_number("CANARY_BURN", 14.4))
+        self.availability_target = float(
+            availability_target if availability_target is not None
+            else _env_number("CANARY_AVAILABILITY_TARGET", 0.99))
+        self.error_ratio = float(
+            error_ratio if error_ratio is not None
+            else _env_number("CANARY_ERROR_RATIO", 3.0))
+        self.latency_ratio = float(
+            latency_ratio if latency_ratio is not None
+            else _env_number("CANARY_LATENCY_RATIO", 2.5))
+        self.latency_floor_s = float(
+            latency_floor_s if latency_floor_s is not None
+            else _env_number("CANARY_LATENCY_MIN_MS", 10.0) / 1000.0)
+        self.divergence_max = float(
+            divergence_max if divergence_max is not None
+            else _env_number("CANARY_DIVERGENCE_MAX", 1e-6))
+        self.mirror_every = max(int(
+            mirror_every if mirror_every is not None
+            else _env_number("CANARY_MIRROR_EVERY", 16)), 1)
+        self.regressed_hold_s = float(
+            regressed_hold_s if regressed_hold_s is not None
+            else _env_number("ROLLOUT_REGRESSED_HOLD_S", 30.0))
+        self._clock = clock
+        self._lock = threading.RLock()
+        self.incumbent: Optional[int] = None
+        self.candidate: Optional[int] = None   # latest published
+        self._canary_version: Optional[int] = None
+        self._canary_starting = False
+        self._canary_fraction = self.fraction
+        self._arm_incumbent: Optional[ArmStats] = None
+        self._arm_candidate: Optional[ArmStats] = None
+        self._mirror: deque = deque(maxlen=4)
+        self._mirror_tick = 0
+        self._seq = 0
+        self._last_eval = 0.0
+        # candidate version -> rollback timestamp; PER-CANDIDATE so a
+        # second rollback inside the first one's hold can never orphan
+        # the first gauge (each clears on its own timeline)
+        self._regressed: Dict[int, float] = {}
+        self.decisions: deque = deque(maxlen=32)
+        reg = get_registry()
+        self._m_routed = reg.counter(
+            "sparkml_serve_canary_routed_total",
+            "alias requests routed per canary arm while an experiment "
+            "is active", ("model", "arm"),
+        )
+        self._m_rollouts = reg.counter(
+            "sparkml_serve_rollouts_total",
+            "rollout control-plane decisions (publish, canary_start, "
+            "promote, rollback, abort)", ("model", "action"),
+        )
+        self._m_regressed = reg.gauge(
+            "sparkml_serve_canary_regressed",
+            "1 while a canary experiment has auto-rolled back and its "
+            "regression is unacknowledged — the serve_canary_regressed "
+            "incident detector's input; labels name the candidate "
+            "version", ("model", "candidate"),
+        )
+        self._m_errors = reg.counter(
+            "sparkml_serve_errors_total",
+            "serving errors by type: batch failures (exception class), "
+            "worker crashes/wedges, breaker rejections",
+            ("model", "error"),
+        )
+
+    # -- request-path hooks (hot; must never raise) -------------------------
+
+    def route(self, ref: str, entry, trace_id: Optional[str]
+              ) -> Tuple[Any, bool]:
+        """The per-request routing decision: ``(entry, is_canary)``.
+
+        Only ALIAS traffic participates (a client that pinned
+        ``name@version`` said exactly what it wants); outside an active
+        canary the entry passes through untouched. Never raises — a
+        broken route must serve the incumbent, not 500."""
+        self._maybe_tick()
+        if (self._canary_version is None or ref != self.alias
+                or getattr(entry, "name", None) != self.name):
+            return entry, False
+        cand = self._canary_version
+        if getattr(entry, "version", None) == cand:
+            return entry, True
+        if trace_id:
+            bucket = canary_bucket(trace_id)
+        else:
+            # header-less/in-process callers without a trace id still
+            # split deterministically, just round-robin by sequence
+            with self._lock:
+                self._seq += 1
+                bucket = (self._seq * 211) % 10_000
+        if bucket >= int(self._canary_fraction * 10_000):
+            self._m_routed.inc(model=self.name, arm="incumbent")
+            return entry, False
+        try:
+            routed = self.registry.resolve_entry(self.name, cand)
+        except KeyError:
+            # the candidate vanished (operator deregister) — serve the
+            # incumbent and count the miss, never fail the request
+            self._m_errors.inc(model=self.name, error="canary_missing")
+            return entry, False
+        self._m_routed.inc(model=self.name, arm="candidate")
+        return routed, True
+
+    def note_result(self, name: str, version: int, ok: bool,
+                    latency_s: float, backend: bool = False) -> None:
+        """Attribute one served outcome to its arm (by the version that
+        actually served it) and run the bounded-cadence verdict.
+
+        ``backend`` marks a failure as chargeable to the arm: the
+        engine sets it for backend-classified errors AND timeout-class
+        outcomes (each version owns its batcher queue, so a deadline or
+        wait expiry is arm-specific — a stalling candidate must roll
+        back too). Orderly capacity rejections (shed, queue-full) say
+        nothing about the model — they are recorded on neither arm."""
+        if name != self.name:
+            return
+        self._maybe_tick()
+        with self._lock:
+            if self._canary_version is None:
+                return
+            arm = (self._arm_candidate
+                   if version == self._canary_version
+                   else self._arm_incumbent
+                   if version == self.incumbent else None)
+        if arm is None:
+            return
+        if ok:
+            arm.note(True, latency_s)
+        elif backend:
+            arm.note(False, latency_s)
+        self._maybe_evaluate()
+
+    #: every mirrored batch is padded/truncated to EXACTLY this many
+    #: rows: one fixed shape means one compiled signature per arm — a
+    #: ragged mirror would make the divergence probe pay a fresh XLA
+    #: compile (tens of ms, on a serving thread) per novel row count.
+    #: Zero-pad rows are valid probe inputs for row-independent
+    #: transforms: both arms see the identical padded batch.
+    MIRROR_ROWS = 32
+
+    def maybe_mirror(self, name: str, rows) -> None:
+        """Sample request rows into the mirror ring (1-in-``mirror_every``
+        canary-eligible requests, fixed ``MIRROR_ROWS`` shape) — the
+        divergence probe's input. Cheap and never raises."""
+        if self._canary_version is None or name != self.name:
+            return
+        self._mirror_tick += 1
+        if self._mirror_tick % self.mirror_every:
+            return
+        try:
+            x = np.asarray(rows, dtype=np.float64)
+        except (TypeError, ValueError):
+            return
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2 or x.shape[0] == 0 or x.shape[1] == 0:
+            return
+        batch = np.zeros((self.MIRROR_ROWS, x.shape[1]),
+                         dtype=np.float64)
+        n = min(x.shape[0], self.MIRROR_ROWS)
+        batch[:n] = x[:n]
+        with self._lock:
+            self._mirror.append(batch)
+
+    # -- the verdict --------------------------------------------------------
+
+    def _maybe_evaluate(self) -> None:
+        now = self._clock()
+        with self._lock:
+            if self._canary_version is None:
+                return
+            if now - self._last_eval < self.eval_interval_s:
+                return
+            self._last_eval = now
+        reason = self.judge(now=now)
+        if reason is not None:
+            self.rollback(reason)
+
+    def judge(self, now: Optional[float] = None) -> Optional[str]:
+        """One verdict pass over the live arm stats: the rollback reason,
+        or None while the candidate still looks healthy (or the floor
+        has not been met — no judgment on no evidence)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            cand = self._arm_candidate
+            inc = self._arm_incumbent
+        if cand is None:
+            return None
+        err_c, total_c = cand.error_rate(self.window_s, now=now)
+        if total_c < self.min_requests:
+            return None
+        budget = max(1.0 - self.availability_target, 1e-9)
+        burn = err_c / budget
+        if self.burn_threshold > 0 and burn >= self.burn_threshold:
+            return (
+                f"slo_fast_burn: candidate burn {burn:.1f} >= "
+                f"{self.burn_threshold:g} (error rate {err_c:.1%} over "
+                f"{total_c:g} requests in {self.window_s:g}s)"
+            )
+        err_i, total_i = (inc.error_rate(self.window_s, now=now)
+                          if inc is not None else (0.0, 0.0))
+        if total_i >= self.min_requests and err_c > 0:
+            # the incumbent-relative bar, floored at one error budget so
+            # a spotless incumbent cannot make a single blip page
+            bar = max(self.error_ratio * err_i, budget)
+            if err_c >= bar:
+                return (
+                    f"error_ratio: candidate error rate {err_c:.1%} vs "
+                    f"incumbent {err_i:.1%} (bar {bar:.1%} = max("
+                    f"{self.error_ratio:g}x incumbent, canary budget))"
+                )
+        p99_c = cand.p99()
+        p99_i = inc.p99() if inc is not None else None
+        if (p99_c is not None and p99_i is not None and p99_i > 0
+                and cand.sketch.count >= self.min_requests
+                and inc.sketch.count >= self.min_requests):
+            bar = max(self.latency_ratio * p99_i,
+                      p99_i + self.latency_floor_s)
+            if p99_c > bar:
+                return (
+                    f"latency_regression: candidate p99 "
+                    f"{p99_c * 1000:.1f} ms vs incumbent "
+                    f"{p99_i * 1000:.1f} ms (bar {bar * 1000:.1f} ms)"
+                )
+        divergence = self._divergence()
+        if divergence is not None and divergence > self.divergence_max:
+            return (
+                f"numerics_divergence: mirrored-batch relative max-abs "
+                f"error {divergence:g} > {self.divergence_max:g}"
+            )
+        return None
+
+    def _divergence(self) -> Optional[float]:
+        """Worst relative max-abs output difference between incumbent
+        and candidate over the mirrored batches (None = no evidence).
+        Direct host transforms — the probe measures numerics, not the
+        serving path, so injected serving faults do not fire here."""
+        with self._lock:
+            # snapshot under the lock: maybe_mirror appends (and the
+            # maxlen evicts) from other request threads mid-iteration
+            batches = list(self._mirror)
+            cand_v = self._canary_version
+            inc_v = self.incumbent
+        if not batches or cand_v is None or inc_v is None:
+            return None
+        try:
+            from spark_rapids_ml_tpu.serve.engine import extract_output
+
+            m_inc = self.registry.resolve(self.name, inc_v)
+            m_cand = self.registry.resolve(self.name, cand_v)
+            worst = 0.0
+            for x in batches:
+                a = np.asarray(extract_output(m_inc, m_inc.transform(x)),
+                               dtype=np.float64)
+                b = np.asarray(extract_output(m_cand, m_cand.transform(x)),
+                               dtype=np.float64)
+                if a.shape != b.shape:
+                    return float("inf")
+                scale = float(np.max(np.abs(a))) or 1.0
+                worst = max(worst,
+                            float(np.max(np.abs(a - b))) / scale)
+            return worst
+        except Exception:
+            # a probe that cannot run is absence of evidence, not a
+            # verdict — counted so a silently-dead probe is visible
+            self._m_errors.inc(model=self.name, error="canary_mirror")
+            return None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def publish(self, version: int) -> None:
+        """A new candidate landed (the trainer's callback). A running
+        experiment keeps ITS version — the new one is the next canary's
+        candidate, never a mid-experiment switch."""
+        with self._lock:
+            self.candidate = int(version)
+        self._decide("publish", version=int(version))
+
+    def start_canary(self, version: Optional[int] = None,
+                     fraction: Optional[float] = None,
+                     warm: bool = True) -> int:
+        """Begin a canary experiment: warm the candidate's full ladder
+        (no cold compile on live canary traffic), reset the arm stats,
+        start routing. Returns the candidate version under test."""
+        with self._lock:
+            if self._canary_version is not None or self._canary_starting:
+                # replacing a live (or mid-start: the warmup below is a
+                # seconds-wide race window) experiment would discard
+                # its arm stats and end it with neither a rollback nor
+                # an abort in the decision history — the operator must
+                # close it explicitly first. The claim is taken HERE,
+                # under the lock, before the slow warmup.
+                raise ValueError(
+                    f"{self.name}: a canary of version "
+                    f"{self._canary_version} is already active — "
+                    "abort() or promote() it before starting another")
+            self._canary_starting = True
+            v = int(version if version is not None
+                    else (self.candidate or 0))
+            incumbent = self.incumbent
+        try:
+            if incumbent is None:
+                # derive the incumbent from the pinned alias (a
+                # controller attached after a restart); a floating or
+                # missing alias cannot canary — there is no rollback
+                # target, and a floating alias already resolves to the
+                # just-registered candidate, so "rollback" would keep
+                # serving the regressed version
+                target = self.registry.alias_target(self.alias)
+                if (target is not None and target[0] == self.name
+                        and target[1] is not None):
+                    incumbent = int(target[1])
+                    with self._lock:
+                        self.incumbent = incumbent
+                else:
+                    raise ValueError(
+                        f"{self.name}: alias {self.alias!r} is "
+                        f"{'floating' if target else 'missing'} — "
+                        "promote() a pinned incumbent before starting "
+                        "a canary (a floating alias has no rollback "
+                        "target)")
+            if v <= 0:
+                raise ValueError(
+                    f"{self.name}: no candidate version to canary "
+                    "(publish one first or pass version=)")
+            if v == incumbent:
+                raise ValueError(
+                    f"{self.name}@{v} is already the incumbent")
+            self.registry.resolve_entry(self.name, v)  # KeyError if gone
+            with spans_mod.span(
+                    f"serve:rollout:canary_start:{self.name}",
+                    model=self.name, version=v):
+                if warm:
+                    self.engine.warmup(f"{self.name}@{v}")
+                now = self._clock()
+                with self._lock:
+                    self._canary_version = v
+                    self._canary_fraction = float(
+                        fraction if fraction is not None
+                        else self.fraction)
+                    self._arm_candidate = ArmStats(v, self.window_s,
+                                                   self._clock)
+                    self._arm_incumbent = ArmStats(
+                        incumbent, self.window_s, self._clock)
+                    self._mirror.clear()
+                    self._last_eval = now
+                self._m_rollouts.inc(model=self.name,
+                                     action="canary_start")
+        finally:
+            with self._lock:
+                self._canary_starting = False
+        self._decide("canary_start", version=v,
+                     fraction=self._canary_fraction)
+        _log.info("canary started", model=self.name, candidate=v,
+                  fraction=self._canary_fraction,
+                  shadow_tenant=self.shadow_tenant)
+        return v
+
+    def promote(self, version: Optional[int] = None) -> int:
+        """Atomic hot-swap: warm the target's bucket × precision ladder
+        on every replica device FIRST, then flip the alias in one
+        pinned registry mutation. The previous incumbent's replica
+        sets stay registered — in-flight requests drain, never drop."""
+        with self._lock:
+            v = version if version is not None else (
+                self._canary_version or self.candidate)
+        if v is None:
+            raise ValueError(
+                f"{self.name}: nothing to promote (no candidate)")
+        v = int(v)
+        self.registry.resolve_entry(self.name, v)  # KeyError if missing
+        with spans_mod.span(f"serve:rollout:promote:{self.name}",
+                            model=self.name, version=v):
+            # the whole point of the hot swap: the candidate is fully
+            # compiled on every replica BEFORE any live request can
+            # resolve to it
+            self.engine.warmup(f"{self.name}@{v}")
+            with self._lock:
+                self.registry.promote(self.alias, self.name, v)
+                previous = self.incumbent
+                self.incumbent = v
+                self._canary_version = None
+                self._arm_candidate = None
+                self._arm_incumbent = None
+            self._m_rollouts.inc(model=self.name, action="promote")
+        self._decide("promote", version=v, previous=previous)
+        _log.info("alias promoted", model=self.name, alias=self.alias,
+                  version=v, previous=previous)
+        return v
+
+    def rollback(self, reason: str) -> bool:
+        """Auto- (or operator-) rollback: re-pin the alias to the
+        incumbent, end the experiment, raise the regressed gauge that
+        opens the ``serve_canary_regressed`` incident naming the
+        candidate. Idempotent — one experiment rolls back once."""
+        with self._lock:
+            v = self._canary_version
+            incumbent = self.incumbent
+            if v is None:
+                return False
+            self._canary_version = None
+            arm_c = self._arm_candidate
+            arm_i = self._arm_incumbent
+            self._arm_candidate = None
+            self._arm_incumbent = None
+        with spans_mod.span(f"serve:rollout:rollback:{self.name}",
+                            model=self.name, candidate=v, reason=reason):
+            if incumbent is not None:
+                # re-pin: idempotent if the alias never moved (it did
+                # not — canary routing never touches the alias), but
+                # explicit, audited, and atomic under the registry lock
+                self.registry.promote(self.alias, self.name, incumbent)
+            self._m_rollouts.inc(model=self.name, action="rollback")
+            self._m_regressed.set(1.0, model=self.name,
+                                  candidate=str(v))
+            with self._lock:
+                self._regressed[v] = self._clock()
+        now = self._clock()
+        self._decide(
+            "rollback", version=v, incumbent=incumbent, reason=reason,
+            candidate_arm=(arm_c.snapshot(self.window_s, now=now)
+                           if arm_c is not None else None),
+            incumbent_arm=(arm_i.snapshot(self.window_s, now=now)
+                           if arm_i is not None else None),
+        )
+        _log.error("canary rolled back", model=self.name, candidate=v,
+                   incumbent=incumbent, reason=reason)
+        return True
+
+    def abort(self, reason: str = "operator") -> bool:
+        """End the experiment without judgment: stop routing, keep the
+        incumbent serving, no regression raised (the candidate stays
+        registered and canary-able later)."""
+        with self._lock:
+            v = self._canary_version
+            if v is None:
+                return False
+            self._canary_version = None
+            self._arm_candidate = None
+            self._arm_incumbent = None
+        with spans_mod.span(f"serve:rollout:abort:{self.name}",
+                            model=self.name, candidate=v, reason=reason):
+            self._m_rollouts.inc(model=self.name, action="abort")
+        self._decide("abort", version=v, reason=reason)
+        _log.info("canary aborted", model=self.name, candidate=v,
+                  reason=reason)
+        return True
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _maybe_tick(self) -> None:
+        """Clear each regressed candidate's gauge once ITS hold elapses
+        — the clear is what lets the serve_canary_regressed incident
+        auto-resolve (per candidate: a second rollback inside the
+        first one's hold must never orphan the first gauge). Driven
+        opportunistically from the request path and snapshot polls
+        (both keep flowing after a rollback)."""
+        with self._lock:
+            if not self._regressed:
+                return
+            now = self._clock()
+            elapsed = [v for v, at in self._regressed.items()
+                       if now - at >= self.regressed_hold_s]
+            for v in elapsed:
+                del self._regressed[v]
+        for v in elapsed:
+            self._m_regressed.set(0.0, model=self.name,
+                                  candidate=str(v))
+
+    def _decide(self, action: str, **fields) -> None:
+        entry = {"action": action, "utc": spans_mod.utcnow_iso()}
+        entry.update(fields)
+        with self._lock:
+            self.decisions.append(entry)
+
+    @property
+    def canary_active(self) -> bool:
+        return self._canary_version is not None
+
+    def is_canary_version(self, name: str, version: int) -> bool:
+        """Whether (name, version) is the ACTIVE canary candidate —
+        the engine exempts its backend failures from the shared
+        per-name breaker's SLO-burn trip (this controller, not the
+        breaker, is the actuator for candidate regressions)."""
+        return name == self.name and version == self._canary_version
+
+    @property
+    def canary_version(self) -> Optional[int]:
+        return self._canary_version
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``GET /debug/rollout`` document."""
+        self._maybe_tick()
+        now = self._clock()
+        with self._lock:
+            arm_c = self._arm_candidate
+            arm_i = self._arm_incumbent
+            doc: Dict[str, Any] = {
+                "model": self.name,
+                "alias": self.alias,
+                "incumbent": self.incumbent,
+                "candidate": self.candidate,
+                "canary": {
+                    "active": self._canary_version is not None,
+                    "version": self._canary_version,
+                    "fraction": self._canary_fraction,
+                    "shadow_tenant": self.shadow_tenant,
+                    "min_requests": self.min_requests,
+                    "window_seconds": self.window_s,
+                },
+                "bars": {
+                    "burn": self.burn_threshold,
+                    "availability_target": self.availability_target,
+                    "error_ratio": self.error_ratio,
+                    "latency_ratio": self.latency_ratio,
+                    "latency_floor_ms": self.latency_floor_s * 1000.0,
+                    "divergence_max": self.divergence_max,
+                },
+                "regressed": sorted(self._regressed),
+                "decisions": list(self.decisions),
+            }
+        if arm_c is not None:
+            doc["canary"]["candidate_arm"] = arm_c.snapshot(
+                self.window_s, now=now)
+        if arm_i is not None:
+            doc["canary"]["incumbent_arm"] = arm_i.snapshot(
+                self.window_s, now=now)
+        return doc
+
+
+class StreamingTrainer:
+    """Background partial-fit loop publishing immutable registry
+    versions every N batches.
+
+    ``feed(batch)`` folds one host batch into the distributed streaming
+    accumulator (``DistributedStreamingPCA.partial_fit`` — per-device
+    local compute, no per-batch collective); every
+    ``batches_per_version`` batches the accumulated statistics finalize
+    into a fitted ``PCAModel``, the artifact persists to
+    ``artifact_dir`` via ``io.persistence`` (atomic writers), and the
+    model registers as a new immutable version WITH its
+    ``source_path`` — the registry manifest then makes the mid-rollout
+    state crash-recoverable. The trainer never flips the alias: the
+    ``RolloutController`` (``rollout=``) is told about each published
+    candidate and owns promotion.
+
+    ``start(source)`` runs the loop on a traced daemon thread over any
+    batch iterable; ``feed`` is also directly callable for synchronous
+    drivers and tests. Tail rows that do not divide the mesh are padded
+    and masked, never dropped.
+    """
+
+    def __init__(
+        self,
+        registry,
+        name: str,
+        n_features: int,
+        k: int,
+        *,
+        batches_per_version: Optional[int] = None,
+        artifact_dir: Optional[str] = None,
+        mean_centering: bool = True,
+        buckets: Optional[Sequence[int]] = None,
+        mesh=None,
+        rollout: Optional[RolloutController] = None,
+    ):
+        self.registry = registry
+        self.name = name
+        self.n_features = int(n_features)
+        self.k = int(k)
+        self.batches_per_version = max(int(
+            batches_per_version if batches_per_version is not None
+            else _env_number("ROLLOUT_BATCHES_PER_VERSION", 8)), 1)
+        self.artifact_dir = artifact_dir or default_artifact_dir()
+        self.mean_centering = bool(mean_centering)
+        self.buckets = tuple(buckets) if buckets else None
+        self._mesh = mesh
+        self._rollout = rollout
+        self._acc = None
+        self._lock = threading.Lock()
+        self._batches = 0
+        self._published: List[int] = []
+        self._stop = threading.Event()
+        self._thread = None
+        reg = get_registry()
+        self._m_batches = reg.counter(
+            "sparkml_serve_trainer_batches_total",
+            "batches folded into the streaming-fit accumulator",
+            ("model",),
+        )
+        self._m_published = reg.counter(
+            "sparkml_serve_trainer_published_total",
+            "candidate model versions published by the streaming "
+            "trainer", ("model",),
+        )
+        self._m_errors = reg.counter(
+            "sparkml_serve_errors_total",
+            "serving errors by type: batch failures (exception class), "
+            "worker crashes/wedges, breaker rejections",
+            ("model", "error"),
+        )
+
+    # -- the accumulator (lazy: jax only when training actually runs) ------
+
+    def _accumulator(self):
+        if self._acc is None:
+            import jax
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.parallel.mesh import data_mesh
+            from spark_rapids_ml_tpu.parallel.streaming import (
+                DistributedStreamingPCA,
+            )
+
+            if self._mesh is None:
+                # a background trainer sharing the host with serving
+                # defaults to ONE device; pass mesh= to spread the fit
+                self._mesh = data_mesh(n_devices=1)
+            # f64 accumulation when the process allows it (the documented
+            # serve-parity ε assumes it); f32 otherwise — requesting f64
+            # under disabled x64 would silently truncate with a warning
+            dtype = (jnp.float64 if jax.config.jax_enable_x64
+                     else jnp.float32)
+            self._acc = DistributedStreamingPCA(
+                self.n_features, self._mesh, dtype=dtype)
+        return self._acc
+
+    def feed(self, batch, mask=None) -> Optional[int]:
+        """Fold one batch; returns the newly published version when
+        this batch crossed the publish cadence, else None."""
+        acc = self._accumulator()
+        x = np.asarray(batch, dtype=np.float64)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2 or x.shape[1] != self.n_features:
+            raise ValueError(
+                f"expected (n, {self.n_features}) batch, got shape "
+                f"{x.shape}")
+        if mask is None:
+            mask = np.ones((x.shape[0],), dtype=bool)
+        else:
+            mask = np.asarray(mask, dtype=bool)
+        d = self._mesh.devices.size
+        rem = (-x.shape[0]) % d
+        if rem:
+            # pad + mask the tail to the mesh multiple — masked rows
+            # contribute nothing to the accumulated statistics
+            x = np.concatenate(
+                [x, np.zeros((rem, x.shape[1]), dtype=x.dtype)])
+            mask = np.concatenate([mask, np.zeros((rem,), dtype=bool)])
+        with self._lock:
+            acc.partial_fit(x, mask)
+            self._batches += 1
+            n_batches = self._batches
+        self._m_batches.inc(model=self.name)
+        if n_batches % self.batches_per_version == 0:
+            return self.publish_version()
+        return None
+
+    def publish_version(self) -> Optional[int]:
+        """Finalize the accumulated statistics into a fitted model,
+        persist the artifact, register it as a new immutable version
+        (manifest-backed), and tell the rollout controller. Returns the
+        version, or None when there is not yet enough data."""
+        with self._lock:
+            acc = self._acc
+            if acc is None:
+                return None
+            if self.mean_centering and acc.rows_seen < 2:
+                return None
+            with spans_mod.span(f"serve:rollout:publish:{self.name}",
+                                model=self.name):
+                result = acc.finalize(self.k,
+                                      mean_centering=self.mean_centering)
+                model = self._build_model(result)
+                path = self._persist(model)
+                version = self.registry.register(
+                    self.name, model, buckets=self.buckets,
+                    source_path=path)
+                self._published.append(version)
+        self._m_published.inc(model=self.name)
+        _log.info("streaming trainer published", model=self.name,
+                  version=version, batches=self._batches,
+                  rows_seen=acc.rows_seen, source_path=path)
+        if self._rollout is not None:
+            self._rollout.publish(version)
+        return version
+
+    def _build_model(self, result):
+        from spark_rapids_ml_tpu.models.pca import PCAModel
+
+        model = PCAModel(
+            pc=np.asarray(result.components, dtype=np.float64),
+            explained_variance=np.asarray(result.explained_variance,
+                                          dtype=np.float64),
+            mean=np.asarray(result.mean, dtype=np.float64),
+        )
+        model.set("k", self.k)
+        return model
+
+    def _persist(self, model) -> str:
+        from spark_rapids_ml_tpu.io.persistence import save_pca_model
+
+        os.makedirs(self.artifact_dir, exist_ok=True)
+        path = os.path.join(
+            self.artifact_dir,
+            f"{self.name}_{uuid.uuid4().hex[:10]}")
+        save_pca_model(model, path, overwrite=True)
+        return path
+
+    # -- the background loop ------------------------------------------------
+
+    def start(self, source) -> None:
+        """Consume ``source`` (any iterable of batches) on a traced
+        daemon thread, feeding every batch until exhausted or
+        ``stop()``."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(f"trainer for {self.name!r} already running")
+        self._stop.clear()
+
+        def _loop():
+            try:
+                for batch in source:
+                    if self._stop.is_set():
+                        break
+                    self.feed(batch)
+            except Exception:
+                # the trainer dying must be visible, never silent — and
+                # must never take the serving process with it
+                self._m_errors.inc(model=self.name, error="trainer")
+                _log.error("streaming trainer loop failed",
+                           model=self.name, batches=self._batches)
+
+        self._thread = tracectx.traced_thread(
+            _loop, name=f"sparkml-trainer-{self.name}", daemon=True,
+            fresh=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+
+    @property
+    def batches_fed(self) -> int:
+        return self._batches
+
+    @property
+    def published_versions(self) -> List[int]:
+        return list(self._published)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "model": self.name,
+            "batches_fed": self._batches,
+            "batches_per_version": self.batches_per_version,
+            "published_versions": list(self._published),
+            "rows_seen": (self._acc.rows_seen
+                          if self._acc is not None else 0),
+            "artifact_dir": self.artifact_dir,
+            "running": bool(self._thread is not None
+                            and self._thread.is_alive()),
+        }
+
+
+__all__ = [
+    "ArmStats",
+    "ENV_PREFIX",
+    "RolloutController",
+    "StreamingTrainer",
+    "canary_bucket",
+    "default_artifact_dir",
+]

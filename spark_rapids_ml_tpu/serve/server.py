@@ -73,6 +73,12 @@ web framework to the container:
   ``start_serve_server`` installs the engine on the background sampler
   (env kill switch ``SPARK_RAPIDS_ML_TPU_OBS_INCIDENTS=0``), so
   detection runs at the sampling cadence with no extra thread;
+* ``GET /debug/rollout`` + ``POST /debug/rollout/{promote,abort,
+  canary}`` — the live-rollout control plane (``serve.rollout``):
+  incumbent/candidate/canary state with per-arm live comparison, and
+  the operator verbs (atomic warm-then-flip promotion, canary start,
+  abort). Requires a ``RolloutController`` attached via
+  ``engine.attach_rollout`` (409 otherwise);
 * ``GET /dashboard`` — one self-contained HTML page polling those
   endpoints: the live ops view, with history sparklines and the
   incident timeline.
@@ -419,6 +425,7 @@ def make_handler(engine: ServeEngine):
                 snap["worker_restarts_total"] = m_restarts.total()
                 snap["overload"] = engine.overload_state()
                 snap["replicas"] = engine.replica_snapshot()
+                snap["rollout"] = engine.rollout_snapshot()
                 status = self._reply(200, snap)
             elif path == "/debug/history":
                 params = urllib.parse.parse_qs(parsed.query)
@@ -434,6 +441,8 @@ def make_handler(engine: ServeEngine):
                     200,
                     incidents_mod.get_incident_engine().snapshot(),
                 )
+            elif path == "/debug/rollout":
+                status = self._reply(200, engine.rollout_snapshot())
             elif path == "/dashboard":
                 status = self._reply_text(
                     200, DASHBOARD_HTML, "text/html; charset=utf-8")
@@ -450,6 +459,11 @@ def make_handler(engine: ServeEngine):
             path = parsed.path
             if path == "/debug/profile":
                 status = self._handle_profile(parsed)
+                m_http_requests.inc(path=path, status=str(status))
+                return
+            if path in ("/debug/rollout/promote", "/debug/rollout/abort",
+                        "/debug/rollout/canary"):
+                status = self._handle_rollout(parsed, path)
                 m_http_requests.inc(path=path, status=str(status))
                 return
             if path != "/predict":
@@ -499,6 +513,54 @@ def make_handler(engine: ServeEngine):
                     "error": f"{type(exc).__name__}: {exc}"
                 })
             return self._reply(200, {"started": info})
+
+        def _handle_rollout(self, parsed, path: str) -> int:
+            """``POST /debug/rollout/{promote,abort,canary}`` — the
+            rollout control plane's operator verbs. ``promote``
+            hot-swaps the alias to ``?version=N`` (or the live
+            candidate), ``abort`` ends the canary without judgment,
+            ``canary`` starts an experiment (``?version=N&fraction=F``).
+            409 without an attached controller."""
+            self._drain_body()
+            controller = engine.rollout_controller()
+            if controller is None:
+                return self._reply(409, {
+                    "error": "no rollout controller attached to this "
+                             "engine (serve.rollout.RolloutController + "
+                             "engine.attach_rollout)",
+                })
+            params = urllib.parse.parse_qs(parsed.query)
+            version_raw = (params.get("version", [None])[0] or "").strip()
+            version = None
+            if version_raw:
+                try:
+                    version = int(version_raw)
+                except ValueError:
+                    return self._reply(400, {
+                        "error": f"bad version {version_raw!r}"})
+            try:
+                if path.endswith("/promote"):
+                    promoted = controller.promote(version)
+                    doc = {"promoted": promoted}
+                elif path.endswith("/abort"):
+                    reason = (params.get("reason", ["operator"])[0]
+                              or "operator")
+                    doc = {"aborted": controller.abort(reason=reason)}
+                else:
+                    fraction = params.get("fraction", [None])[0]
+                    doc = {"canary": controller.start_canary(
+                        version,
+                        fraction=(float(fraction)
+                                  if fraction else None))}
+            except KeyError as exc:
+                return self._reply(404, {"error": str(exc)})
+            except ValueError as exc:
+                return self._reply(400, {"error": str(exc)})
+            except Exception as exc:  # noqa: BLE001 - surface, don't die
+                return self._reply(500, {
+                    "error": f"{type(exc).__name__}: {exc}"})
+            doc["rollout"] = engine.rollout_snapshot()
+            return self._reply(200, doc)
 
         def _handle_predict(self, ctx: tracectx.TraceContext) -> int:
             """Parse, predict, reply; returns the HTTP status it sent.
@@ -563,11 +625,23 @@ def make_handler(engine: ServeEngine):
             priority = self.headers.get("X-Priority") or req.priority
             binary_out = wire.wants_binary_response(
                 self.headers.get("Accept"), req.binary)
+            served = {}
             try:
-                # Resolve once and predict against the PINNED version, so
-                # the reported version is the one that actually served the
-                # request even if a concurrent register() bumps "latest".
-                entry = engine.registry.resolve_entry(req.model)
+                # Resolve once — through the rollout tier's canary
+                # router — and predict against the PINNED version, so
+                # the reported version is the one that actually served
+                # the request even if a concurrent register() bumps
+                # "latest". Canary-routed requests pin to the shadow
+                # tenant (when configured) so the fairness ledger
+                # audits the experiment as its own tenant.
+                entry, canary_tenant = engine.route_entry(
+                    req.model, trace_id=ctx.trace_id)
+                if canary_tenant:
+                    tenant = canary_tenant
+                # error replies carry the version that failed the
+                # request: during a canary, "which arm broke" must be
+                # readable from the wire
+                served = {"model": entry.name, "version": entry.version}
                 result = engine.predict_detailed(
                     entry.name, req.rows, version=entry.version,
                     deadline_ms=req.deadline_ms,
@@ -578,10 +652,11 @@ def make_handler(engine: ServeEngine):
             except ValueError as exc:
                 # request-shape errors (empty / oversize batch) are the
                 # client's to fix
-                return self._reply(400, {"error": str(exc)}, trace_ctx=ctx)
+                return self._reply(400, {"error": str(exc), **served},
+                                   trace_ctx=ctx)
             except QueueFull as exc:
                 return self._reply(
-                    429, {"error": str(exc)}, trace_ctx=ctx,
+                    429, {"error": str(exc), **served}, trace_ctx=ctx,
                     retry_after=engine.retry_after_estimate())
             except ShedLoad as exc:
                 # the adaptive overload controller's verdict: distinct
@@ -592,10 +667,11 @@ def make_handler(engine: ServeEngine):
                     "retryable": True,
                     "shed": True,
                     "reason": exc.reason,
+                    **served,
                 }, trace_ctx=ctx, retry_after=exc.retry_after)
             except (DeadlineExpired, WaitTimeout) as exc:
                 return self._reply(
-                    504, {"error": str(exc)}, trace_ctx=ctx,
+                    504, {"error": str(exc), **served}, trace_ctx=ctx,
                     retry_after=engine.retry_after_estimate())
             except (BreakerOpen, WorkerCrashed) as exc:
                 # self-healing states: the breaker is shedding for this
@@ -604,14 +680,17 @@ def make_handler(engine: ServeEngine):
                 return self._reply(503, {
                     "error": str(exc),
                     "retryable": True,
+                    **served,
                 }, trace_ctx=ctx,
                     retry_after=engine.retry_after_estimate())
             except (BatcherClosed, EngineClosed) as exc:
                 # both mean "shutting down" — retryable 503, not a 5xx page
-                return self._reply(503, {"error": str(exc)}, trace_ctx=ctx)
+                return self._reply(503, {"error": str(exc), **served},
+                                   trace_ctx=ctx)
             except Exception as exc:  # noqa: BLE001 - surface, don't die
                 return self._reply(500, {
-                    "error": f"{type(exc).__name__}: {exc}"
+                    "error": f"{type(exc).__name__}: {exc}",
+                    **served,
                 }, trace_ctx=ctx)
             if binary_out:
                 # metadata travels as headers — the payload is pure rows

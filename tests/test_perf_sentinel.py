@@ -21,6 +21,7 @@ from perf_sentinel import (  # noqa: E402
     load_candidate,
     noise_band,
     record_percentiles,
+    stale_baseline_age_days,
 )
 
 sys.path.pop(0)
@@ -92,6 +93,51 @@ def test_stale_on_fallback_record():
 def test_stale_on_platform_mismatch_without_fallback_marker():
     v = judge(_record(3_000.0, platform="cpu"), _history(2_000_000.0))
     assert v["verdict"] == "STALE"
+
+
+def test_stale_verdict_carries_baseline_age_warning():
+    """The r04+ situation as a NUMBER: a CPU-fallback round against a
+    dated chip baseline states how many days the baseline has gone
+    un-re-measured, not just prose."""
+    history = _history(2_000_000.0)
+    history[0]["measured_utc"] = "2026-01-15T00:00:00Z"
+    rec = _record(3_000.0, platform="cpu",
+                  fallback_reason="device tunnel wedged")
+    v = judge(rec, history)
+    assert v["verdict"] == "STALE"
+    assert v["stale_baseline_age_days"] > 100  # Jan 2026 vs today
+    assert "days old" in v["stale_warning"]
+    assert "fell back to CPU" in v["stale_warning"]
+
+
+def test_stale_age_helper_parses_and_degrades():
+    # Z-suffix and explicit-offset spellings both parse
+    day = stale_baseline_age_days(
+        {"measured_utc": "2026-01-01T00:00:00Z"},
+        now=1767225600.0 + 86400.0)  # 2026-01-02T00:00:00Z
+    assert day == pytest.approx(1.0, abs=0.01)
+    assert stale_baseline_age_days(
+        {"measured_utc": "2026-01-01T00:00:00+00:00"},
+        now=1767225600.0) == pytest.approx(0.0, abs=0.01)
+    # malformed / absent timestamps degrade to None, never raise
+    assert stale_baseline_age_days({"measured_utc": "not a date"}) is None
+    assert stale_baseline_age_days({}) is None
+    assert stale_baseline_age_days(None) is None
+    # a STALE verdict without a parseable stamp omits the age fields
+    v = judge(_record(3_000.0, platform="cpu"), _history(2_000_000.0))
+    assert v["verdict"] == "STALE"
+    assert "stale_baseline_age_days" not in v
+
+
+def test_stale_warning_wording_distinguishes_mismatch_from_fallback():
+    """A deliberately-CPU round (platform mismatch, no tunnel failure)
+    must not claim the device tunnel fell back."""
+    history = _history(2_000_000.0)
+    history[0]["measured_utc"] = "2026-01-15T00:00:00Z"
+    v = judge(_record(3_000.0, platform="cpu"), history)
+    assert v["verdict"] == "STALE"
+    assert "fell back" not in v["stale_warning"]
+    assert "ran on cpu" in v["stale_warning"]
 
 
 def test_cpu_history_comparable_for_cpu_record():

@@ -1,0 +1,961 @@
+"""Live-traffic rollout (serve/rollout.py): streaming trainer publish
+cadence + artifact persistence, streaming-vs-offline serve parity (the
+acceptance ε), atomic promote/resolve under an 8-thread hammer,
+mid-rollout manifest recovery, deterministic canary routing + shadow
+tenant, auto-rollback on a candidate-targeted fault with the regressed
+gauge feeding the serve_canary_regressed detector, version-targeted
+FaultSpec, the HTTP control surface, and the rule-13 fixtures."""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import get_registry
+from spark_rapids_ml_tpu.serve import (
+    ModelRegistry,
+    RolloutController,
+    ServeEngine,
+    StreamingTrainer,
+    fault_plane,
+    reset_fault_plane,
+    start_serve_server,
+)
+from spark_rapids_ml_tpu.serve.faults import FaultSpec
+from spark_rapids_ml_tpu.serve.rollout import canary_bucket
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_FEATURES = 12
+K = 3
+
+# The documented serve-parity bar: a streaming-fit-promoted model's
+# outputs vs an offline fit on the same data, both at f64 (README
+# "Live rollout & canary"). The two paths accumulate the same
+# covariance in different orders, so they agree to accumulation noise,
+# not bit-exactly.
+STREAMING_PARITY_ATOL = 1e-6
+
+
+@pytest.fixture
+def data(rng):
+    return rng.normal(size=(1024, N_FEATURES))
+
+
+@pytest.fixture
+def fitted(data):
+    from spark_rapids_ml_tpu import PCA
+
+    return PCA().setK(K).fit(data)
+
+
+def _engine(registry, **kw):
+    kw.setdefault("max_batch_rows", 64)
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("retries", 0)
+    kw.setdefault("backoff_ms", 1)
+    kw.setdefault("breaker_failures", 1000)
+    kw.setdefault("breaker_burn_threshold", 0)
+    return ServeEngine(registry, **kw)
+
+
+def _controller(engine, **kw):
+    kw.setdefault("min_requests", 5)
+    kw.setdefault("window_s", 30.0)
+    kw.setdefault("eval_interval_s", 0.0)
+    kw.setdefault("regressed_hold_s", 5.0)
+    return RolloutController(engine, "roll_pca", alias="prod", **kw)
+
+
+# -- StreamingTrainer --------------------------------------------------------
+
+
+def test_trainer_publishes_every_n_batches(data, tmp_path):
+    reg = ModelRegistry()
+    trainer = StreamingTrainer(
+        reg, "roll_pca", N_FEATURES, K,
+        batches_per_version=2, artifact_dir=str(tmp_path))
+    versions = []
+    for i in range(4):
+        v = trainer.feed(data[i * 256:(i + 1) * 256])
+        if v is not None:
+            versions.append(v)
+    assert versions == [1, 2]
+    assert trainer.batches_fed == 4
+    assert trainer.published_versions == [1, 2]
+    # every published version persisted its artifact and registered it
+    # WITH the source path (crash recovery needs it)
+    for v in versions:
+        entry = reg.resolve_entry("roll_pca", v)
+        assert entry.source_path and os.path.isdir(entry.source_path)
+
+
+def test_trainer_pads_ragged_batches(data, tmp_path):
+    reg = ModelRegistry()
+    trainer = StreamingTrainer(
+        reg, "roll_pca", N_FEATURES, K,
+        batches_per_version=3, artifact_dir=str(tmp_path))
+    # ragged rows: the trainer pads + masks to the mesh multiple, never
+    # drops rows or raises
+    trainer.feed(data[:97])
+    trainer.feed(data[97:300])
+    v = trainer.feed(data[300:512])
+    assert v == 1
+    assert trainer.snapshot()["rows_seen"] == 512
+
+
+def test_trainer_background_loop_consumes_source(data, tmp_path):
+    reg = ModelRegistry()
+    trainer = StreamingTrainer(
+        reg, "roll_pca", N_FEATURES, K,
+        batches_per_version=2, artifact_dir=str(tmp_path))
+    batches = [data[i * 128:(i + 1) * 128] for i in range(8)]
+    trainer.start(iter(batches))
+    trainer._thread.join(30.0)
+    trainer.stop()
+    assert trainer.batches_fed == 8
+    assert trainer.published_versions == [1, 2, 3, 4]
+
+
+def test_streaming_fit_matches_offline_fit_through_the_engine(
+        data, fitted, tmp_path):
+    """The acceptance ε: a streaming-fit-promoted model's SERVED outputs
+    match an offline fit on the same data within the documented bar."""
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    trainer = StreamingTrainer(
+        reg, "roll_pca", N_FEATURES, K,
+        batches_per_version=4, artifact_dir=str(tmp_path))
+    for i in range(4):
+        v = trainer.feed(data[i * 256:(i + 1) * 256])
+    assert v == 2
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine)
+        engine.attach_rollout(rollout)
+        rollout.promote(2)
+        served = engine.predict("prod", data[:64])
+        offline = np.asarray(
+            fitted.transform(data[:64]).column(fitted.getOutputCol()))
+        # sign-align per component: eigenvector sign is a convention,
+        # both paths flip deterministically but near-ties may differ
+        for j in range(served.shape[1]):
+            dot = float(np.dot(served[:, j], offline[:, j]))
+            if dot < 0:
+                served[:, j] = -served[:, j]
+        np.testing.assert_allclose(served, offline,
+                                   atol=STREAMING_PARITY_ATOL)
+    finally:
+        engine.shutdown()
+
+
+# -- registry: atomic promote under concurrent resolve ----------------------
+
+
+def test_promote_requires_pinned_version(fitted):
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted)
+    with pytest.raises(ValueError):
+        reg.promote("prod", "roll_pca", None)
+    with pytest.raises(KeyError):
+        reg.promote("prod", "roll_pca", 99)
+    reg.promote("prod", "roll_pca", 1)
+    assert reg.resolve_entry("prod").version == 1
+    assert reg.alias_target("prod") == ("roll_pca", 1)
+
+
+def test_promote_resolve_hammer_no_half_promoted_state(fitted):
+    """8 resolver threads hammer the alias while versions register and
+    promote: every resolution must observe a version that was PROMOTED
+    — never a just-registered candidate (the floating-alias leak) and
+    never a half-flipped state."""
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted)
+    reg.promote("prod", "roll_pca", 1)
+    promoted = {1}
+    promoted_lock = threading.Lock()
+    stop = threading.Event()
+    observed = set()
+    errors = []
+
+    def resolver():
+        local = set()
+        while not stop.is_set():
+            try:
+                entry = reg.resolve_entry("prod")
+            except Exception as exc:  # noqa: BLE001 - the assertion
+                errors.append(repr(exc))
+                return
+            with promoted_lock:
+                if entry.version not in promoted:
+                    errors.append(
+                        f"observed unpromoted version {entry.version}")
+                    return
+            local.add(entry.version)
+        observed.update(local)
+
+    threads = [threading.Thread(target=resolver) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for v in range(2, 30):
+        assert reg.register("roll_pca", fitted) == v
+        # the just-registered version is NOT yet promoted: resolvers
+        # racing this window must keep seeing the previous target
+        with promoted_lock:
+            promoted.add(v)
+            reg.promote("prod", "roll_pca", v)
+    stop.set()
+    for t in threads:
+        t.join(10.0)
+    assert errors == []
+    assert observed  # the hammer actually observed resolutions
+
+
+def test_manifest_recovers_mid_rollout_state(data, fitted, tmp_path):
+    """Candidate persisted but alias not yet flipped → a restart
+    resumes with the incumbent serving and the candidate still
+    canary-able."""
+    manifest = str(tmp_path / "manifest.json")
+    incumbent_path = str(tmp_path / "incumbent_model")
+    from spark_rapids_ml_tpu.io.persistence import save_pca_model
+
+    save_pca_model(fitted, incumbent_path)
+    reg = ModelRegistry(manifest_path=manifest)
+    assert reg.load("roll_pca", incumbent_path) == 1
+    reg.promote("prod", "roll_pca", 1)
+    trainer = StreamingTrainer(
+        reg, "roll_pca", N_FEATURES, K, batches_per_version=2,
+        artifact_dir=str(tmp_path / "artifacts"))
+    trainer.feed(data[:256])
+    assert trainer.feed(data[256:512]) == 2
+    # crash here: candidate v2 persisted + in the manifest, alias still
+    # pinned to v1 — a new process recovers BOTH
+    reg2 = ModelRegistry(manifest_path=manifest)
+    report = reg2.recovery_report_
+    assert sorted(report["recovered"]) == ["roll_pca@1", "roll_pca@2"]
+    assert reg2.resolve_entry("prod").version == 1       # incumbent serves
+    assert reg2.resolve_entry("roll_pca", 2) is not None  # canary-able
+    engine = _engine(reg2)
+    try:
+        rollout = _controller(engine)
+        engine.attach_rollout(rollout)
+        rollout.start_canary(2, fraction=0.5, warm=False)
+        assert rollout.canary_version == 2
+    finally:
+        engine.shutdown()
+
+
+# -- canary routing ----------------------------------------------------------
+
+
+def test_canary_routing_deterministic_and_fractional(fitted):
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16,))
+    reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine, fraction=0.5)
+        engine.attach_rollout(rollout)
+        reg.promote("prod", "roll_pca", 1)
+        rollout.incumbent = 1
+        rollout.publish(2)
+        rollout.start_canary(warm=False)
+        incumbent_entry = reg.resolve_entry("prod")
+        trace_ids = [f"{i:032x}" for i in range(400)]
+        arms = {}
+        for tid in trace_ids:
+            entry, canary = rollout.route("prod", incumbent_entry, tid)
+            arms[tid] = (entry.version, canary)
+            # deterministic: the same trace id always routes the same way
+            again, canary2 = rollout.route("prod", incumbent_entry, tid)
+            assert (again.version, canary2) == arms[tid]
+            # and the decision is the pure hash split
+            expect_canary = canary_bucket(tid) < 5000
+            assert canary == expect_canary
+        canaried = sum(1 for v, c in arms.values() if c)
+        assert 100 < canaried < 300  # ~50% of 400
+        # pinned refs and foreign refs never route
+        entry, canary = rollout.route("roll_pca@1", incumbent_entry,
+                                      trace_ids[0])
+        assert not canary
+    finally:
+        engine.shutdown()
+
+
+def test_canary_fraction_bounds(fitted):
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16,))
+    reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine, fraction=0.0)
+        engine.attach_rollout(rollout)
+        reg.promote("prod", "roll_pca", 1)
+        rollout.incumbent = 1
+        rollout.publish(2)
+        rollout.start_canary(warm=False)
+        incumbent_entry = reg.resolve_entry("prod")
+        assert not any(
+            rollout.route("prod", incumbent_entry, f"{i:032x}")[1]
+            for i in range(100))
+        rollout.abort()
+        rollout.start_canary(fraction=1.0, warm=False)
+        assert all(
+            rollout.route("prod", incumbent_entry, f"{i:032x}")[1]
+            for i in range(100))
+    finally:
+        engine.shutdown()
+
+
+def test_canary_shadow_tenant_pins_experiment_traffic(data, fitted):
+    """fraction=1.0 + shadow tenant: every alias request serves the
+    candidate under the shadow tenant, so the fairness ledger audits
+    the experiment as its own tenant."""
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine, fraction=1.0,
+                              shadow_tenant="canary_shadow")
+        engine.attach_rollout(rollout)
+        rollout.promote(1)
+        rollout.start_canary(2, warm=False)
+        before = get_registry().counter(
+            "sparkml_serve_tenant_requests_total",
+            "serving requests per tenant by outcome (ok, shed, "
+            "rejected, expired, error)", ("tenant", "outcome"),
+        ).value(tenant="canary_shadow", outcome="ok")
+        for _ in range(4):
+            out = engine.predict("prod", data[:8])
+            assert out.shape == (8, K)
+        after = get_registry().counter(
+            "sparkml_serve_tenant_requests_total",
+            "serving requests per tenant by outcome (ok, shed, "
+            "rejected, expired, error)", ("tenant", "outcome"),
+        ).value(tenant="canary_shadow", outcome="ok")
+        assert after - before == 4
+        snap = rollout.snapshot()
+        assert snap["canary"]["candidate_arm"]["requests"] == 4
+        assert snap["canary"]["candidate_arm"]["errors"] == 0
+    finally:
+        engine.shutdown()
+
+
+# -- auto-rollback -----------------------------------------------------------
+
+
+def test_auto_rollback_on_candidate_targeted_fault(data, fitted):
+    """A 100%-error fault targeted at the candidate version trips the
+    canary burn verdict: the alias re-pins to the incumbent, the
+    regressed gauge names the candidate, and post-rollback traffic
+    never touches the candidate."""
+    reset_fault_plane()
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    engine = _engine(reg, retries=0)
+    try:
+        rollout = _controller(engine, fraction=1.0, min_requests=4)
+        engine.attach_rollout(rollout)
+        rollout.promote(1)
+        rollout.start_canary(2, warm=False)
+        fault_plane().inject("roll_pca", "raise", count=None, version=2)
+        failures = 0
+        for _ in range(20):
+            if not rollout.canary_active:
+                break
+            try:
+                engine.predict("prod", data[:8])
+            except Exception:  # noqa: BLE001 - injected
+                failures += 1
+        assert failures >= 4
+        assert not rollout.canary_active
+        decisions = [d for d in rollout.decisions
+                     if d["action"] == "rollback"]
+        assert len(decisions) == 1
+        assert "slo_fast_burn" in decisions[0]["reason"]
+        assert decisions[0]["candidate_arm"]["errors"] >= 4
+        assert reg.resolve_entry("prod").version == 1
+        gauge = get_registry().gauge(
+            "sparkml_serve_canary_regressed",
+            "1 while a canary experiment has auto-rolled back and its "
+            "regression is unacknowledged — the serve_canary_regressed "
+            "incident detector's input; labels name the candidate "
+            "version", ("model", "candidate"))
+        assert gauge.value(model="roll_pca", candidate="2") == 1.0
+        # post-rollback: alias traffic serves the incumbent cleanly
+        # (the fault is still armed, but it targets only v2)
+        for _ in range(4):
+            out = engine.predict("prod", data[:8])
+            assert out.shape == (8, K)
+        assert rollout.snapshot()["regressed"] == [2]
+    finally:
+        reset_fault_plane()
+        engine.shutdown()
+
+
+def test_stalling_candidate_rolls_back_on_timeout_class_failures(
+        data, fitted):
+    """A candidate that STALLS (timeout-class outcomes, not backend
+    raises) must charge its arm and roll back too — each version owns
+    its batcher queue, so a wait expiry is arm-specific signal."""
+    reset_fault_plane()
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    engine = _engine(reg, retries=0, worker_budget_ms=60_000)
+    try:
+        rollout = _controller(engine, fraction=1.0, min_requests=3)
+        engine.attach_rollout(rollout)
+        rollout.promote(1)
+        rollout.start_canary(2, warm=False)
+        fault_plane().inject("roll_pca", "stall", count=None,
+                             version=2, seconds=0.4)
+        for _ in range(6):
+            if not rollout.canary_active:
+                break
+            try:
+                engine.predict("prod", data[:8], timeout=0.05)
+            except Exception:  # noqa: BLE001 - WaitTimeout expected
+                pass
+        assert not rollout.canary_active
+        rollbacks = [d for d in rollout.decisions
+                     if d["action"] == "rollback"]
+        assert len(rollbacks) == 1
+        assert rollbacks[0]["candidate_arm"]["errors"] >= 3
+        assert reg.resolve_entry("prod").version == 1
+    finally:
+        reset_fault_plane()
+        engine.shutdown()
+
+
+def test_canary_failures_do_not_trip_the_shared_breaker_burn(
+        data, fitted):
+    """The model-level breaker is shared per NAME: a sick candidate's
+    burn must be answered by the ROLLOUT controller (alias rollback),
+    never by opening the breaker against the healthy incumbent."""
+    reset_fault_plane()
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    # burn trip ENABLED (the production default), consecutive-failure
+    # threshold high enough that only the burn path could open it
+    engine = ServeEngine(reg, max_batch_rows=64, max_wait_ms=1.0,
+                         retries=0, backoff_ms=1,
+                         breaker_failures=50,
+                         breaker_burn_threshold=14.4)
+    try:
+        rollout = _controller(engine, fraction=1.0, min_requests=4)
+        engine.attach_rollout(rollout)
+        rollout.promote(1)
+        # enough window traffic that fast_burn_rate clears its
+        # min-traffic floor once the candidate starts failing
+        for _ in range(24):
+            engine.predict("prod", data[:8])
+        rollout.start_canary(2, warm=False)
+        fault_plane().inject("roll_pca", "raise", count=None, version=2)
+        for _ in range(20):
+            if not rollout.canary_active:
+                break
+            try:
+                engine.predict("prod", data[:8])
+            except Exception:  # noqa: BLE001 - injected
+                pass
+        assert not rollout.canary_active  # the controller acted...
+        assert engine.breaker_snapshot()["roll_pca"]["state"] == "closed"
+        # ...and the incumbent keeps serving through the SAME breaker
+        out = engine.predict("prod", data[:8])
+        assert out.shape == (8, K)
+    finally:
+        reset_fault_plane()
+        engine.shutdown()
+
+
+def test_regressed_gauge_clears_after_hold_with_injected_clock(fitted):
+    now = [1000.0]
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16,))
+    reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine, fraction=1.0,
+                              regressed_hold_s=30.0,
+                              clock=lambda: now[0])
+        engine.attach_rollout(rollout)
+        reg.promote("prod", "roll_pca", 1)
+        rollout.incumbent = 1
+        rollout.start_canary(2, warm=False)
+        assert rollout.rollback("test_reason")
+        gauge = get_registry().gauge(
+            "sparkml_serve_canary_regressed",
+            "1 while a canary experiment has auto-rolled back and its "
+            "regression is unacknowledged — the serve_canary_regressed "
+            "incident detector's input; labels name the candidate "
+            "version", ("model", "candidate"))
+        assert gauge.value(model="roll_pca", candidate="2") == 1.0
+        now[0] += 29.0
+        rollout.snapshot()
+        assert gauge.value(model="roll_pca", candidate="2") == 1.0
+        now[0] += 2.0
+        rollout.snapshot()  # the tick past the hold clears it
+        assert gauge.value(model="roll_pca", candidate="2") == 0.0
+        # a rollback ends the experiment: a second one is a no-op
+        assert not rollout.rollback("again")
+    finally:
+        engine.shutdown()
+
+
+def test_overlapping_rollback_holds_clear_independently(fitted):
+    """A second rollback inside the first one's hold must not orphan
+    the first candidate's regressed gauge — each clears on its own
+    timeline, so each incident can auto-resolve."""
+    now = [1000.0]
+    reg = ModelRegistry()
+    for _ in range(3):
+        reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine, fraction=1.0,
+                              regressed_hold_s=30.0,
+                              clock=lambda: now[0])
+        engine.attach_rollout(rollout)
+        reg.promote("prod", "roll_pca", 1)
+        rollout.incumbent = 1
+        rollout.start_canary(2, warm=False)
+        rollout.rollback("first")
+        now[0] += 15.0
+        rollout.start_canary(3, warm=False)
+        rollout.rollback("second")
+        gauge = get_registry().gauge(
+            "sparkml_serve_canary_regressed",
+            "1 while a canary experiment has auto-rolled back and its "
+            "regression is unacknowledged — the serve_canary_regressed "
+            "incident detector's input; labels name the candidate "
+            "version", ("model", "candidate"))
+        assert gauge.value(model="roll_pca", candidate="2") == 1.0
+        assert gauge.value(model="roll_pca", candidate="3") == 1.0
+        now[0] += 16.0  # t=31: v2's hold elapsed, v3's (t=15+30) not
+        rollout.snapshot()
+        assert gauge.value(model="roll_pca", candidate="2") == 0.0
+        assert gauge.value(model="roll_pca", candidate="3") == 1.0
+        now[0] += 15.0  # t=46: v3's hold elapsed too
+        rollout.snapshot()
+        assert gauge.value(model="roll_pca", candidate="3") == 0.0
+        assert rollout.snapshot()["regressed"] == []
+    finally:
+        engine.shutdown()
+
+
+def test_start_canary_refuses_to_replace_a_live_experiment(fitted):
+    reg = ModelRegistry()
+    for _ in range(3):
+        reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine)
+        engine.attach_rollout(rollout)
+        reg.promote("prod", "roll_pca", 1)
+        rollout.incumbent = 1
+        rollout.start_canary(2, warm=False)
+        # replacing a live experiment would end it with no decision
+        # record — the operator must abort/promote first
+        with pytest.raises(ValueError, match="already active"):
+            rollout.start_canary(3, warm=False)
+        assert rollout.canary_version == 2
+        rollout.abort()
+        assert rollout.start_canary(3, warm=False) == 3
+    finally:
+        engine.shutdown()
+
+
+def test_start_canary_refuses_floating_alias_and_derives_pinned(fitted):
+    """A floating alias has no rollback target (and already resolves to
+    the just-registered candidate) — canarying it must refuse; a PINNED
+    alias is derived as the incumbent by a freshly-attached controller
+    (the post-restart case)."""
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16,))
+    reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine)
+        engine.attach_rollout(rollout)
+        with pytest.raises(ValueError, match="missing"):
+            rollout.start_canary(2, warm=False)  # no alias at all
+        reg.alias("prod", "roll_pca")            # floating
+        with pytest.raises(ValueError, match="floating"):
+            rollout.start_canary(2, warm=False)
+        reg.promote("prod", "roll_pca", 1)       # pinned
+        assert rollout.incumbent is None         # fresh controller...
+        rollout.start_canary(2, warm=False)
+        assert rollout.incumbent == 1            # ...derived the pin
+        # and a failed verdict has a real rollback target
+        assert rollout.rollback("test")
+        assert reg.resolve_entry("prod").version == 1
+    finally:
+        engine.shutdown()
+
+
+def test_start_canary_claim_blocks_concurrent_start_during_warmup(
+        fitted):
+    """The 'already active' guard claims the experiment slot BEFORE the
+    (seconds-wide) warmup window — a concurrent start_canary inside it
+    must be refused, not silently replace the first experiment."""
+    reg = ModelRegistry()
+    for _ in range(3):
+        reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine)
+        engine.attach_rollout(rollout)
+        reg.promote("prod", "roll_pca", 1)
+        rollout.incumbent = 1
+        raced = {}
+
+        real_warmup = engine.warmup
+
+        def racing_warmup(ref, **kw):
+            # another operator starts a canary while this one's warmup
+            # is still compiling
+            try:
+                rollout.start_canary(3, warm=False)
+                raced["outcome"] = "replaced"
+            except ValueError as exc:
+                raced["outcome"] = str(exc)
+            return real_warmup(ref, **kw)
+
+        engine.warmup = racing_warmup
+        assert rollout.start_canary(2, warm=True) == 2
+        assert "already active" in raced["outcome"]
+        assert rollout.canary_version == 2
+    finally:
+        engine.shutdown()
+
+
+def test_judge_numerics_divergence_on_mirrored_batches(data, fitted):
+    """A candidate whose outputs diverge from the incumbent past the ε
+    bar is judged numerics_divergence on the mirrored batches."""
+    from spark_rapids_ml_tpu.models.pca import PCAModel
+
+    diverged = PCAModel(
+        pc=np.asarray(fitted.pc) + 0.05,
+        explained_variance=np.asarray(fitted.explained_variance),
+        mean=np.asarray(fitted.mean),
+    )
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    reg.register("roll_pca", diverged, buckets=(16, 64))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine, fraction=1.0, min_requests=2,
+                              mirror_every=1, divergence_max=1e-6)
+        engine.attach_rollout(rollout)
+        rollout.promote(1)
+        rollout.start_canary(2, warm=False)
+        # healthy traffic (errors are not the signal here): the mirror
+        # ring fills, the bounded-cadence verdict runs, and the
+        # divergence probe alone rolls the canary back
+        for _ in range(6):
+            if not rollout.canary_active:
+                break
+            engine.predict("prod", data[:8])
+        assert not rollout.canary_active
+        rollbacks = [d for d in rollout.decisions
+                     if d["action"] == "rollback"]
+        assert len(rollbacks) == 1
+        assert "numerics_divergence" in rollbacks[0]["reason"]
+        assert reg.resolve_entry("prod").version == 1
+    finally:
+        engine.shutdown()
+
+
+def test_canary_regressed_detector_opens_and_resolves_incident():
+    """The regressed gauge drives the builtin serve_canary_regressed
+    detector through the incident lifecycle — injected clock and
+    hand-fed TSDB samples, zero sleeps."""
+    from spark_rapids_ml_tpu.obs.anomaly import builtin_detectors
+    from spark_rapids_ml_tpu.obs.incidents import (
+        IncidentEngine,
+        IncidentManager,
+    )
+    from spark_rapids_ml_tpu.obs.tsdb import TimeSeriesStore
+
+    now = [5000.0]
+    store = TimeSeriesStore(clock=lambda: now[0])
+    detector = [d for d in builtin_detectors()
+                if d.name == "serve_canary_regressed"]
+    assert len(detector) == 1
+    manager = IncidentManager(open_after=2, resolve_after=2,
+                              cooldown_seconds=1.0, capture_seconds=0)
+    ie = IncidentEngine(store=store, detectors=detector,
+                        manager=manager)
+    labels = {"model": "roll_pca", "candidate": "7"}
+    for _ in range(3):
+        store.record("sparkml_serve_canary_regressed", labels, 1.0)
+        ie.sweep(now=now[0])
+        now[0] += 1.0
+    opened = manager.open_incidents()
+    assert len(opened) == 1
+    assert opened[0]["labels"] == labels  # the bundle names the candidate
+    assert opened[0]["detector"] == "serve_canary_regressed"
+    for _ in range(3):
+        store.record("sparkml_serve_canary_regressed", labels, 0.0)
+        ie.sweep(now=now[0])
+        now[0] += 1.0
+    assert manager.open_incidents() == []
+    assert manager.resolved_total == 1
+
+
+# -- promotion semantics -----------------------------------------------------
+
+
+def test_promote_warms_before_flip_and_old_version_drains(data, fitted):
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine)
+        engine.attach_rollout(rollout)
+        order = []
+        real_warmup = engine.warmup
+        real_promote = reg.promote
+
+        def spy_warmup(ref, **kw):
+            order.append(("warmup", ref))
+            return real_warmup(ref, **kw)
+
+        def spy_promote(alias, name, version):
+            order.append(("flip", version))
+            return real_promote(alias, name, version)
+
+        engine.warmup = spy_warmup
+        reg.promote = spy_promote
+        rollout.promote(1)
+        engine.predict("prod", data[:8])  # incumbent serving
+        rollout.promote(2)
+        # the candidate's ladder compiles BEFORE the alias flips — live
+        # traffic never lands on a cold program
+        assert order == [("warmup", "roll_pca@1"), ("flip", 1),
+                         ("warmup", "roll_pca@2"), ("flip", 2)]
+        assert reg.resolve_entry("prod").version == 2
+        # the old version stays registered: in-flight / pinned traffic
+        # drains rather than drops
+        assert reg.resolve_entry("roll_pca", 1) is not None
+        out = engine.predict("roll_pca@1", data[:8])
+        assert out.shape == (8, K)
+    finally:
+        engine.shutdown()
+
+
+def test_start_canary_rejects_incumbent_and_missing_versions(fitted):
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    try:
+        rollout = _controller(engine)
+        engine.attach_rollout(rollout)
+        rollout.promote(1)
+        with pytest.raises(ValueError):
+            rollout.start_canary()  # no candidate published
+        with pytest.raises(ValueError):
+            rollout.start_canary(1)  # already the incumbent
+        with pytest.raises(KeyError):
+            rollout.start_canary(9)  # never registered
+    finally:
+        engine.shutdown()
+
+
+# -- version-targeted faults -------------------------------------------------
+
+
+def test_fault_spec_version_targeting():
+    spec = FaultSpec("m", "raise", count=None, version=2)
+    assert spec.matches("m", 0, None, 2)
+    assert not spec.matches("m", 0, None, 1)
+    # a version-targeted spec never fires at a version-less site
+    assert not spec.matches("m", 0, None, None)
+    assert spec.as_dict()["version"] == 2
+    untargeted = FaultSpec("m", "raise", count=None)
+    assert untargeted.matches("m", 0, None, 2)
+    assert untargeted.matches("m", 0, None, None)
+
+
+def test_version_targeted_fault_only_fires_on_its_version(data, fitted):
+    reset_fault_plane()
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    engine = _engine(reg, retries=0)
+    try:
+        fault_plane().inject("roll_pca", "raise", count=None, version=2)
+        out = engine.predict("roll_pca@1", data[:8])  # incumbent: clean
+        assert out.shape == (8, K)
+        with pytest.raises(Exception):
+            engine.predict("roll_pca@2", data[:8])    # candidate: faulted
+        out = engine.predict("roll_pca@1", data[:8])
+        assert out.shape == (8, K)
+    finally:
+        reset_fault_plane()
+        engine.shutdown()
+
+
+# -- the HTTP control surface ------------------------------------------------
+
+
+def _post(base, path):
+    req = urllib.request.Request(f"{base}{path}", data=b"", method="POST")
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _get(base, path):
+    resp = urllib.request.urlopen(f"{base}{path}", timeout=10)
+    return json.loads(resp.read())
+
+
+def test_http_rollout_surface(data, fitted):
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    engine = _engine(reg)
+    rollout = _controller(engine, fraction=0.25)
+    engine.attach_rollout(rollout)
+    rollout.promote(1)
+    rollout.publish(2)
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        doc = _get(base, "/debug/rollout")
+        assert doc["enabled"] is True
+        assert doc["incumbent"] == 1 and doc["candidate"] == 2
+        assert not doc["canary"]["active"]
+        # /debug/slo mirrors the rollout state
+        assert _get(base, "/debug/slo")["rollout"]["incumbent"] == 1
+
+        status, doc = _post(base, "/debug/rollout/canary?version=2"
+                                  "&fraction=0.5")
+        assert status == 200 and doc["canary"] == 2
+        assert doc["rollout"]["canary"]["active"]
+        assert doc["rollout"]["canary"]["fraction"] == 0.5
+
+        status, doc = _post(base, "/debug/rollout/abort?reason=drill")
+        assert status == 200 and doc["aborted"] is True
+        assert not doc["rollout"]["canary"]["active"]
+
+        status, doc = _post(base, "/debug/rollout/promote?version=2")
+        assert status == 200 and doc["promoted"] == 2
+        assert reg.resolve_entry("prod").version == 2
+
+        status, doc = _post(base, "/debug/rollout/promote?version=77")
+        assert status == 404
+        status, doc = _post(base, "/debug/rollout/promote?version=bogus")
+        assert status == 400
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+def test_http_rollout_409_without_controller(fitted):
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16,))
+    engine = _engine(reg)
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        assert _get(base, "/debug/rollout") == {"enabled": False}
+        status, doc = _post(base, "/debug/rollout/promote?version=1")
+        assert status == 409
+        assert "no rollout controller" in doc["error"]
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+def test_http_error_payloads_name_the_serving_version(data, fitted):
+    """During a canary, 'which arm broke' must be readable from the
+    wire: error replies carry the version that failed the request."""
+    reset_fault_plane()
+    reg = ModelRegistry()
+    reg.register("roll_pca", fitted, buckets=(16, 64))
+    engine = _engine(reg, retries=0)
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        fault_plane().inject("roll_pca", "raise", count=None, version=1)
+        body = json.dumps({"model": "roll_pca",
+                           "rows": data[:4].tolist()}).encode()
+        req = urllib.request.Request(
+            f"{base}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(req, timeout=10)
+        payload = json.loads(excinfo.value.read())
+        assert payload["model"] == "roll_pca"
+        assert payload["version"] == 1
+    finally:
+        reset_fault_plane()
+        server.shutdown()
+        engine.shutdown()
+
+
+# -- rule 13 fixtures --------------------------------------------------------
+
+
+def _checker():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_instrumentation as ci
+    finally:
+        sys.path.pop(0)
+    return ci
+
+
+def test_rule13_accepts_current_rollout_and_registry():
+    ci = _checker()
+    for path in ci.ROLLOUT_FILES:
+        assert list(ci.check_rollout_audit(path)) == [], path
+
+
+def test_rule13_rejects_unaudited_alias_flips(tmp_path):
+    ci = _checker()
+    bad = tmp_path / "bad_rollout.py"
+    bad.write_text(
+        "class C:\n"
+        "    def promote(self, v):\n"
+        "        self.registry.alias('prod', 'm', v)  # REJECT\n"
+        "    def rollback(self):\n"
+        "        self.registry.alias('prod', 'm', 1)  # REJECT\n"
+        "    def helper(self):\n"
+        "        self.registry.promote('prod', 'm', 2)  # REJECT\n"
+        "    def unrelated(self):\n"
+        "        return 1  # fine: not a flip path\n"
+    )
+    offenders = list(ci.check_rollout_audit(str(bad)))
+    assert len(offenders) == 3
+    assert all("rule 13" in why for _ln, why in offenders)
+
+
+def test_rule13_accepts_audited_alias_flips(tmp_path):
+    ci = _checker()
+    good = tmp_path / "good_rollout.py"
+    good.write_text(
+        "class C:\n"
+        "    def promote(self, v):\n"
+        "        with span('serve:rollout:promote', version=v):\n"
+        "            self.registry.alias('prod', 'm', v)\n"
+        "    def rollback(self):\n"
+        "        self._m.inc(model='m', action='rollback')\n"
+        "        self.registry.alias('prod', 'm', 1)\n"
+        "    def abort(self):\n"
+        "        record_event('serve:rollout', 0, 1, action='abort')\n"
+    )
+    assert list(ci.check_rollout_audit(str(good))) == []
