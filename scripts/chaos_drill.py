@@ -72,6 +72,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -222,9 +223,13 @@ def replica_drain_child() -> int:
     model = PCA().setK(4).fit(x)
     registry = ModelRegistry()
     registry.register("drill_replica_pca", model, buckets=(16, 64))
+    # retries=3 covers the drain threshold (3): the ISSUE 15
+    # small-request concentration pins the idle tier (and its retries)
+    # to the SAME replica until its health trips, so the first faulted
+    # request's surviving attempt is the fourth
     engine = ServeEngine(
         registry, max_batch_rows=64, max_wait_ms=1.0,
-        retries=2, backoff_ms=10, breaker_failures=8,
+        retries=3, backoff_ms=10, breaker_failures=8,
         default_deadline_ms=10_000, replicas=2,
     )
     engine.warmup("drill_replica_pca")
@@ -233,7 +238,9 @@ def replica_drain_child() -> int:
     plane = fault_plane()
     try:
         rset = engine._replicas[("drill_replica_pca", 1)]
-        victim = rset.replicas[1]
+        # replica 0: the concentration target — a fault targeted at the
+        # spread-to sibling would never fire on light serial traffic
+        victim = rset.replicas[0]
         victim.health.cooldown_seconds = 1.0
         result["victim_device"] = victim.label
         doc = _get_json(base, "/debug/incidents")
@@ -302,6 +309,180 @@ def replica_drain_child() -> int:
     sys.stdout.write(REPLICA_DRAIN_PREFIX + json.dumps(result) + "\n")
     sys.stdout.flush()
     return 0 if not result.get("problems") else 1
+
+
+AUTOSCALE_FLAP_PREFIX = "AUTOSCALE_FLAP_RESULT "
+
+
+def autoscale_flap_child() -> int:
+    """The autoscale anti-flap drill leg, run in its OWN process with 4
+    forced host devices.
+
+    Contract (ISSUE 15): under a load square-wave OSCILLATING faster
+    than the hysteresis hold, the controller must not flap — no two
+    scale actions land closer than the cooldown, every request keeps
+    answering 200, the breaker stays closed, and a deliberate
+    scale-down never opens a ``serve_replica_degraded`` incident (a
+    retired replica is an operator decision, not a sick device —
+    exactly the incident-dedup discipline the other phases keep)."""
+    import jax
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import (
+        AutoscaleController,
+        ModelRegistry,
+        ServeEngine,
+        fault_plane,
+        start_serve_server,
+    )
+
+    result = {"devices": len(jax.devices())}
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(1024, 16))
+    model = PCA().setK(4).fit(x)
+    registry = ModelRegistry()
+    registry.register("flap_pca", model, buckets=(64, 256))
+    engine = ServeEngine(registry, max_batch_rows=256, max_wait_ms=1.0,
+                         max_queue_depth=256,
+                         default_deadline_ms=15_000)
+    engine.warmup("flap_pca")
+    engine.scale_replicas(1)
+    # the modeled per-batch device time that makes capacity
+    # replica-bound (the multidevice phases' CPU-CI honesty device)
+    fault_plane().inject("flap_pca", "latency", count=None,
+                         seconds=0.04)
+    controller = AutoscaleController(
+        engine, min_replicas=1, max_replicas=4, interval_s=0.2,
+        up_queue_wait_s=0.05, up_hold_s=0.4, down_hold_s=1.0,
+        cooldown_s=2.0, down_queue_wait_s=0.03, down_occupancy=0.6,
+    )
+    controller.start()
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    statuses = []
+    try:
+        doc = _get_json(base, "/debug/incidents")
+        known = {i.get("id") for i in
+                 _incident_entries(doc, "serve_replica_degraded")}
+        # the square wave: ~1.2 s SATURATING burst (6 closed-loop
+        # threads of full-bucket requests — several times the
+        # 1-replica capacity), ~1.2 s silence — a period far shorter
+        # than down_hold + cooldown, so a naive controller would flap
+        # every cycle
+        import concurrent.futures
+
+        lock = threading.Lock()
+
+        def _burst_client(worker: int, edge: float) -> None:
+            # per-task rng: shared numpy Generators across threads can
+            # corrupt draws into bad request shapes (the _tenant_burst
+            # lesson)
+            wrng = np.random.default_rng(1000 + worker)
+            while time.monotonic() < edge:
+                start = int(wrng.integers(0, x.shape[0] - 256))
+                status, _payload = _post_predict(
+                    base, "flap_pca", x[start:start + 256],
+                    timeout=30.0)
+                with lock:
+                    statuses.append(status)
+
+        stop_at = time.monotonic() + 14.0
+        burst = True
+        cycle = 0
+        with concurrent.futures.ThreadPoolExecutor(6) as pool:
+            while time.monotonic() < stop_at:
+                edge = min(time.monotonic() + 1.2, stop_at)
+                if burst:
+                    cycle += 1
+                    list(pool.map(
+                        lambda w: _burst_client(w + 6 * cycle, edge),
+                        range(6)))
+                else:
+                    time.sleep(max(edge - time.monotonic(), 0.0))
+                burst = not burst
+        ok = sum(1 for s in statuses if s == 200)
+        result["requests"] = len(statuses)
+        result["availability"] = ok / len(statuses) if statuses else 0.0
+        result["hung"] = sum(1 for s in statuses if s == 0)
+        history = controller.decision_history()
+        actions = [h for h in history
+                   if h["decision"] in ("scale_up", "scale_down")]
+        gaps = [round(b["at"] - a["at"], 3)
+                for a, b in zip(actions, actions[1:])]
+        result["scale_actions"] = [
+            {"decision": h["decision"], "from": h["from"],
+             "to": h["to"]} for h in actions]
+        result["action_gaps_s"] = gaps
+        result["cooldown_s"] = controller.cooldown_s
+        result["breaker_state"] = engine.breaker_snapshot().get(
+            "flap_pca", {}).get("state", "closed")
+        new = [i for i in _incident_entries(
+            _get_json(base, "/debug/incidents"),
+            "serve_replica_degraded") if i.get("id") not in known]
+        result["replica_incidents"] = len(new)
+        problems = []
+        if not actions:
+            problems.append(
+                "the oscillating load never drove a single scale "
+                "action — the phase did not exercise the controller")
+        bad = [g for g in gaps if g < controller.cooldown_s - 0.05]
+        if bad:
+            problems.append(
+                f"scale actions {bad} s apart — flapping faster than "
+                f"the {controller.cooldown_s} s cooldown")
+        if result["availability"] < 0.99:
+            problems.append(
+                f"availability {result['availability']:.3f} < 0.99 "
+                "under the oscillating load")
+        if result["hung"]:
+            problems.append(f"{result['hung']} request(s) hung")
+        if result["breaker_state"] != "closed":
+            problems.append(
+                "breaker opened under pure load oscillation")
+        if new:
+            problems.append(
+                f"{len(new)} serve_replica_degraded incident(s) opened "
+                "by deliberate scale-downs — retirement must never "
+                "page as a sick device")
+        result["problems"] = problems
+    finally:
+        fault_plane().clear()
+        controller.stop()
+        server.shutdown()
+        engine.shutdown()
+        from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+
+        tsdb_mod.get_sampler().stop()
+        time.sleep(1.0)
+    sys.stdout.write(AUTOSCALE_FLAP_PREFIX + json.dumps(result) + "\n")
+    sys.stdout.flush()
+    return 0 if not result.get("problems") else 1
+
+
+def run_autoscale_flap_phase() -> dict:
+    """Spawn the 4-device autoscale-flap child; returns its result (or
+    a synthesized failure entry when the child broke)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["SPARKML_CHAOS_PHASE"] = "autoscale_flap_child"
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    env["XLA_FLAGS"] = bench_common.force_device_count_flags(4)
+    env.pop("SPARK_RAPIDS_ML_TPU_SERVE_REPLICAS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    result = bench_common.prefixed_result(proc.stdout,
+                                          AUTOSCALE_FLAP_PREFIX)
+    if result is None:
+        return {"problems": [
+            f"autoscale-flap child produced no result "
+            f"(rc={proc.returncode}): {proc.stderr[-1500:]}"]}
+    if proc.returncode != 0 and not result.get("problems"):
+        result.setdefault("problems", []).append(
+            f"autoscale-flap child exited {proc.returncode}")
+    return result
 
 
 CANARY_ROLLBACK_PREFIX = "CANARY_ROLLBACK_RESULT "
@@ -734,6 +915,8 @@ def main() -> int:
         return replica_drain_child()
     if os.environ.get("SPARKML_CHAOS_PHASE") == "canary_rollback_child":
         return canary_rollback_child()
+    if os.environ.get("SPARKML_CHAOS_PHASE") == "autoscale_flap_child":
+        return autoscale_flap_child()
     n_requests = _env_int("SPARKML_CHAOS_REQUESTS", 24)
     n_features = _env_int("SPARKML_CHAOS_FEATURES", 16)
     k = _env_int("SPARKML_CHAOS_K", 4)
@@ -1009,6 +1192,12 @@ def main() -> int:
         # incident engine, nothing shared with this drill's detectors).
         bench_common.log("chaos canary rollback (train-while-serving)")
         canary_rollback = run_canary_rollback_phase()
+
+        # -- autoscale flap: an oscillating load square-wave must not
+        # flap the replica controller faster than its hysteresis hold
+        # (4-device subprocess, own incident engine).
+        bench_common.log("chaos autoscale flap (4-device subprocess)")
+        autoscale_flap = run_autoscale_flap_phase()
     finally:
         plane.clear()
         server.shutdown()
@@ -1061,6 +1250,9 @@ def main() -> int:
         "canary_rollback": canary_rollback,
         "availability_canary_incumbent": canary_rollback.get(
             "non_canary_availability", 0.0),
+        "autoscale_flap": autoscale_flap,
+        "availability_autoscale_flap": autoscale_flap.get(
+            "availability", 0.0),
         "phases": {name: {k: v for k, v in stats.items()
                           if k != "statuses"}
                    for name, stats in phases.items()},
@@ -1138,6 +1330,11 @@ def main() -> int:
         bench_common.log(
             f"chaos FAIL: canary-rollback contract broke: "
             f"{canary_rollback['problems']}")
+        return 1
+    if autoscale_flap.get("problems"):
+        bench_common.log(
+            f"chaos FAIL: autoscale-flap contract broke: "
+            f"{autoscale_flap['problems']}")
         return 1
     bench_common.log("chaos drill PASS")
     # final settle: any worker abandoned mid-jax-call must leave the

@@ -669,6 +669,68 @@ def check_rollout_audit(path: str):
                    "deployment change (rule 13)")
 
 
+# rule 14: the zero-cold-start tier — every executable-cache decision
+# path (hit/miss/store/evict/invalidate) and every autoscale replica
+# mutation must be counted or audit-spanned in the same function. A
+# cache that silently misses is a restart paying full recompiles with
+# nothing on the dashboard; a replica-count change nobody can see is
+# an unauditable capacity change.
+CACHE_AUTOSCALE_FILES = (
+    os.path.join(REPO, "spark_rapids_ml_tpu", "obs", "aotcache.py"),
+    os.path.join(REPO, "spark_rapids_ml_tpu", "serve", "autoscale.py"),
+)
+_CACHE_DECISION_NAMES = frozenset({"load", "store"})
+_CACHE_DECISION_PREFIXES = ("evict", "invalidate", "scale_up",
+                            "scale_down")
+_SCALE_MUTATION_CALLS = frozenset({"scale_replicas"})
+# the sanctioned accounting spellings: a metrics .inc / audit span
+# directly, or the cache module's own counting helpers (which resolve
+# to the sparkml_serve_cache_* counters + serve:cache events)
+_CACHE_ACCOUNTING = frozenset({"inc", "record_event", "span",
+                               "_count", "_count_error", "_audit"})
+
+
+def check_cache_autoscale_audit(path: str):
+    """Rule 14: yield (lineno, description) for every unaccounted
+    cache/autoscale decision path in one aotcache/autoscale module.
+
+    A decision path is a function DEF named ``load``/``store`` (or
+    prefixed ``evict``/``invalidate``/``scale_up``/``scale_down``,
+    underscore-insensitive), or any function whose body calls the
+    ``.scale_replicas(...)`` replica mutation; the same function must
+    carry a counter ``.inc(...)``, an audit ``record_event``/``span``,
+    or one of the cache module's ``_count``/``_count_error``/``_audit``
+    accounting helpers."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        bare = node.name.lstrip("_")
+        is_decision = (bare in _CACHE_DECISION_NAMES
+                       or bare.startswith(_CACHE_DECISION_PREFIXES))
+        if not is_decision:
+            for child in ast.walk(node):
+                if (isinstance(child, ast.Call)
+                        and _call_name(child) in _SCALE_MUTATION_CALLS):
+                    is_decision = True
+                    break
+        if not is_decision:
+            continue
+        accounts = any(
+            isinstance(child, ast.Call)
+            and _call_name(child) in _CACHE_ACCOUNTING
+            for child in ast.walk(node)
+        )
+        if not accounts:
+            yield (node.lineno,
+                   f"cache/autoscale decision path {node.name}() "
+                   "without a counter .inc(...), audit "
+                   "record_event/span, or cache accounting helper in "
+                   "the same function — a silent cache miss or an "
+                   "unaudited replica-count change is invisible "
+                   "capacity drift (rule 14)")
+
+
 # rule 11: the wire boundary — server body decoding must route through
 # serve/wire.py, whose decoders must record the parse-phase latency.
 SERVER_FILE = os.path.join(
@@ -936,6 +998,11 @@ def main() -> int:
         rel = os.path.relpath(path, REPO)
         for lineno, why in check_rollout_audit(path):
             offenders.append(f"{rel}:{lineno} {why}")
+    cache_files = [p for p in CACHE_AUTOSCALE_FILES if os.path.exists(p)]
+    for path in cache_files:
+        rel = os.path.relpath(path, REPO)
+        for lineno, why in check_cache_autoscale_audit(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -959,7 +1026,9 @@ def main() -> int:
         f"serve/ device selection routed through serve/placement.py; "
         f"{len(rollout_files)} rollout/registry module(s) with every "
         f"alias promote/rollback/abort path audit-spanned or "
-        f"decision-counted"
+        f"decision-counted; {len(cache_files)} cache/autoscale "
+        f"module(s) with every hit/miss/evict/invalidate and "
+        f"scale-up/scale-down decision counted or audit-spanned"
     )
     return 0
 

@@ -116,6 +116,33 @@ def resolve_pipeline_context(stages,
     return resolve_serving_context(None, device=device)
 
 
+def _prime_hook(kernel, weights: Tuple, device, dtype,
+                ) -> Optional[Callable]:
+    """The program's compile-without-execute hook: ``TrackedJit.prime``
+    over an ABSTRACT batch spec (``jax.ShapeDtypeStruct`` carrying the
+    staging sharding — signature-key-identical to a real staged batch,
+    verified in the aotcache tests) plus the program's device-resident
+    weight operands. Priming a bucket neither allocates nor transfers
+    the batch: the warm-restart replay is pure executable loading. None
+    for kernels without AOT priming (plain callables) — warmup then
+    falls back to the execute path."""
+    prime_fn = getattr(kernel, "prime", None)
+    if not callable(prime_fn):
+        return None
+
+    def prime(n_rows: int, n_features: int) -> bool:
+        import jax
+        from jax.sharding import Sharding, SingleDeviceSharding
+
+        sharding = (device if isinstance(device, Sharding)
+                    else SingleDeviceSharding(device))
+        spec = jax.ShapeDtypeStruct((int(n_rows), int(n_features)),
+                                    dtype, sharding=sharding)
+        return bool(prime_fn(spec, *weights))
+
+    return prime
+
+
 def build_serving_program(
     *,
     device,
@@ -163,7 +190,8 @@ def build_serving_program(
 
     return ServingProgram(put=put, run=run, fetch=fetch,
                           dtype=np.dtype(dtype), algo=algo,
-                          precision=precision)
+                          precision=precision,
+                          prime=_prime_hook(kernel, weights, device, dtype))
 
 
 def build_host_stat_stage(model, fn, host_weights, algo: str,
@@ -275,7 +303,8 @@ def build_fused_pipeline_program(
 
     return ServingProgram(put=put, run=run, fetch=fetch,
                           dtype=np.dtype(dtype), algo=algo,
-                          precision=precision)
+                          precision=precision,
+                          prime=_prime_hook(kernel, flat_weights, device, dtype))
 
 
 # -- sharded big transforms ---------------------------------------------------
@@ -395,7 +424,12 @@ def build_batch_sharded_program(
 
     return ServingProgram(put=put, run=run, fetch=fetch,
                           dtype=np.dtype(dtype), algo=algo,
-                          precision=precision)
+                          precision=precision,
+                          # the batch operand's sharding IS the prime
+                          # spec's placement (the hook accepts a
+                          # Sharding in the device slot)
+                          prime=_prime_hook(kernel, flat_weights,
+                                            row_sharded, dtype))
 
 
 def run_staged_pipeline(model, x, precision: str = "native") -> np.ndarray:

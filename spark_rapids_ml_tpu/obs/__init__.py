@@ -75,12 +75,19 @@ from spark_rapids_ml_tpu.obs.xprof import (  # noqa: F401
     STORM_ENV,
     TrackedJit,
     analytic_mfu,
+    clear_all_signature_caches,
     compile_log,
     compile_stats,
     peak_flops_per_second,
     reset_compile_log,
+    signature_count,
     track_compiles,
     tracked_jit,
+)
+from spark_rapids_ml_tpu.obs.aotcache import (  # noqa: F401
+    ExecutableCache,
+    configure_executable_cache,
+    get_executable_cache,
 )
 from spark_rapids_ml_tpu.obs.memory import (  # noqa: F401
     device_memory_stats,
@@ -233,7 +240,12 @@ __all__ = [
     "check_devices_subprocess",
     "check_output_numerics",
     "compile_log",
+    "clear_all_signature_caches",
     "compile_stats",
+    "configure_executable_cache",
+    "ExecutableCache",
+    "get_executable_cache",
+    "signature_count",
     "current_context",
     "current_fit",
     "current_span_id",

@@ -1,0 +1,524 @@
+"""Persistent XLA executable cache: zero-cold-start serving.
+
+Every serve replica used to pay a full XLA recompile of its bucket ×
+precision ladder on process start — the one latency the pipeline work
+(PR 9–13) cannot hide, and the reason replica count could not safely
+move under load. This module persists the executables ``tracked_jit``
+(``obs/xprof.py``) compiles through its AOT lower+compile path, keyed on
+the exact signature it already computes, so a fresh process warms a
+model's full ladder from disk in **milliseconds** instead of seconds:
+
+* **key** — the content digest covers the tracked label plus the full
+  abstract signature (pytree structure, per-leaf shape/dtype/weak-type/
+  sharding, static argument values). The **environment fingerprint**
+  (jax/jaxlib version, backend platform + platform version, device
+  kind, the ``SPARK_RAPIDS_ML_TPU_SERVE_PRECISION`` posture, x64 mode)
+  is stored in the entry header and checked at load: a jaxlib bump, a
+  different chip, or a changed precision env var is an **invalidation**
+  (counted, stale file dropped), never a silently-wrong executable.
+  Serving weights are *runtime arguments* of every serving program
+  (``models/_serving.py`` stages them as operands, not closures), so a
+  cached executable is weight-independent by construction — new model
+  versions reuse it.
+* **write** — atomic tmp + ``os.replace``; a crash mid-write leaves no
+  half-entry. Size is bounded (``..._CACHE_MAX_BYTES``) with
+  oldest-mtime LRU eviction (hits ``os.utime`` their entry).
+* **read** — corruption-tolerant: a truncated file, bad magic, foreign
+  pickle, or a deserialization failure is a MISS plus a
+  ``sparkml_serve_cache_errors_total{reason}`` increment — never an
+  exception on the serving path.
+* **observability** — ``sparkml_serve_cache_total{event}`` counts
+  hit / miss / store / evict / invalidate; every hit/miss/store/evict
+  decision files a ``serve:cache`` audit event (rule 14 of
+  ``scripts/check_instrumentation.py`` rejects a cache decision path
+  that is neither counted nor audit-spanned).
+
+The cache is OFF unless ``SPARK_RAPIDS_ML_TPU_SERVE_CACHE_DIR`` points
+somewhere (or ``configure_executable_cache`` is called): fit-side and
+test processes keep the exact pre-cache behavior by default.
+
+Entry format (one file per signature)::
+
+    SMLAOTC1 | u32 header_len | header JSON | pickle(payload, trees)
+
+where the header carries the environment fingerprint plus the compile
+metadata (flops / bytes_accessed / memory sizes from the original
+``cost_analysis``) so a cache hit keeps feeding analytic-MFU accounting
+without re-running the analysis.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
+CACHE_DIR_ENV = ENV_PREFIX + "CACHE_DIR"
+CACHE_MAX_BYTES_ENV = ENV_PREFIX + "CACHE_MAX_BYTES"
+PRECISION_ENV = ENV_PREFIX + "PRECISION"
+
+_MAGIC = b"SMLAOTC1"
+_HEADER_STRUCT = struct.Struct("<I")
+_DEFAULT_MAX_BYTES = 512 * 1024 * 1024
+
+# header fields that must match the live process for an entry to be
+# servable; a mismatch is an INVALIDATION (the honest-key satellite:
+# a jaxlib bump / device-kind change / precision flip MUST miss)
+_FINGERPRINT_KEYS = ("jax", "jaxlib", "platform", "platform_version",
+                     "device_kind", "precision", "x64")
+
+
+def _env_max_bytes() -> int:
+    try:
+        return int(float(os.environ.get(CACHE_MAX_BYTES_ENV,
+                                        _DEFAULT_MAX_BYTES)))
+    except ValueError:
+        return _DEFAULT_MAX_BYTES
+
+
+def environment_fingerprint() -> Dict[str, str]:
+    """The live process's compile environment: everything that changes
+    what an XLA executable MEANS without changing the abstract call
+    signature. Stored in every entry header and compared at load."""
+    fp: Dict[str, str] = {}
+    try:
+        import jax
+        import jaxlib
+
+        fp["jax"] = str(jax.__version__)
+        fp["jaxlib"] = str(jaxlib.__version__)
+        fp["x64"] = str(bool(jax.config.jax_enable_x64))
+        try:
+            # explicit submodule import: attribute access alone raises
+            # until something else imported it, which would make the
+            # fingerprint depend on IMPORT ORDER (observed live: the
+            # same process computed platform_version '' before a fit
+            # and 'cpu' after one — every warm restart invalidated)
+            from jax.extend import backend as jax_backend
+
+            backend = jax_backend.get_backend()
+            fp["platform"] = str(backend.platform)
+            fp["platform_version"] = str(
+                getattr(backend, "platform_version", ""))
+        except Exception:
+            fp["platform"] = str(jax.default_backend())
+            fp["platform_version"] = ""
+        try:
+            fp["device_kind"] = str(jax.devices()[0].device_kind)
+        except Exception:
+            fp["device_kind"] = ""
+    except Exception:
+        # a jax-less probe still produces a fingerprint; the entries it
+        # writes can never load anyway (no backend to deserialize into)
+        fp.setdefault("jax", "")
+        fp.setdefault("jaxlib", "")
+    fp["precision"] = os.environ.get(PRECISION_ENV, "native")
+    return fp
+
+
+def _canonical(obj: Any) -> str:
+    """A stable textual form of one signature component. Primitives
+    spell themselves; containers recurse; everything else (PyTreeDef,
+    Sharding, dtype objects) uses its repr — stable within one
+    jax/jaxlib version, which the fingerprint pins anyway."""
+    if isinstance(obj, (str, bytes, int, float, bool)) or obj is None:
+        return repr(obj)
+    if isinstance(obj, (tuple, list)):
+        return "(" + ",".join(_canonical(v) for v in obj) + ")"
+    if isinstance(obj, dict):
+        items = sorted((repr(k), _canonical(v)) for k, v in obj.items())
+        return "{" + ",".join(f"{k}:{v}" for k, v in items) + "}"
+    if isinstance(obj, frozenset):
+        return "fs(" + ",".join(sorted(_canonical(v) for v in obj)) + ")"
+    return repr(obj)
+
+
+def signature_digest(label: str, signature_key: Any) -> str:
+    """The entry filename digest: blake2b over (label, canonical
+    signature). The environment fingerprint deliberately stays OUT of
+    the digest and in the header — so a fingerprint mismatch is an
+    observable *invalidation* of a found entry, not an invisible miss."""
+    text = f"{label}\x00{_canonical(signature_key)}"
+    return hashlib.blake2b(text.encode(), digest_size=20).hexdigest()
+
+
+def _sanitize(label: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "_"
+                   for c in label)[:64] or "fn"
+
+
+class CachedExecutable:
+    """One loaded entry: the deserialized compiled executable plus the
+    compile metadata its header carried."""
+
+    __slots__ = ("compiled", "flops", "bytes_accessed", "memory")
+
+    def __init__(self, compiled, flops, bytes_accessed, memory):
+        self.compiled = compiled
+        self.flops = flops
+        self.bytes_accessed = bytes_accessed
+        self.memory = memory or {}
+
+
+class ExecutableCache:
+    """Disk-backed persistent compilation cache (see module doc).
+
+    Thread-safe: loads are lock-free file reads; stores/evictions
+    serialize on an instance lock (atomic replace keeps readers safe
+    either way). ``fingerprint`` is injectable for the key-matrix
+    tests."""
+
+    def __init__(self, path: str, *,
+                 max_bytes: Optional[int] = None,
+                 fingerprint: Optional[Dict[str, str]] = None):
+        self.path = os.path.abspath(path)
+        self.max_bytes = int(max_bytes if max_bytes is not None
+                             else _env_max_bytes())
+        self._fingerprint = fingerprint
+        # two locks: _lock guards the local counter tally (taken inside
+        # _count/_count_error), _evict_lock serializes eviction sweeps.
+        # They must be distinct — an eviction failure counts an error,
+        # and counting under the eviction lock would self-deadlock.
+        self._lock = threading.Lock()
+        self._evict_lock = threading.Lock()
+        self._local = {"hit": 0, "miss": 0, "store": 0, "evict": 0,
+                       "invalidate": 0, "error": 0}
+        os.makedirs(self.path, exist_ok=True)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def fingerprint(self) -> Dict[str, str]:
+        if self._fingerprint is None:
+            self._fingerprint = environment_fingerprint()
+        return self._fingerprint
+
+    def _entry_path(self, label: str, digest: str) -> str:
+        return os.path.join(self.path, f"{_sanitize(label)}-{digest}.aotx")
+
+    def _count(self, event: str) -> None:
+        with self._lock:
+            self._local[event] = self._local.get(event, 0) + 1
+        try:
+            from spark_rapids_ml_tpu.obs.metrics import get_registry
+
+            get_registry().counter(
+                "sparkml_serve_cache_total",
+                "persistent executable-cache decisions "
+                "(hit/miss/store/evict/invalidate)", ("event",),
+            ).inc(event=event)
+        except Exception:
+            # telemetry must never break the serving path; the local
+            # tally above still records the decision for stats()
+            with self._lock:
+                self._local["error"] = self._local.get("error", 0) + 1
+
+    def _count_error(self, reason: str) -> None:
+        with self._lock:
+            self._local["error"] = self._local.get("error", 0) + 1
+        try:
+            from spark_rapids_ml_tpu.obs.metrics import get_registry
+
+            get_registry().counter(
+                "sparkml_serve_cache_errors_total",
+                "persistent executable-cache load/store failures by "
+                "reason (a bad entry is a MISS, never a crash)",
+                ("reason",),
+            ).inc(reason=reason)
+        except Exception:
+            with self._lock:
+                self._local["error"] = self._local.get("error", 0) + 1
+
+    def _audit(self, event: str, label: str, t0: float, **attrs) -> None:
+        """The ``serve:cache`` audit trail (rule 14): every cache
+        decision lands in the span ring with its label and outcome."""
+        try:
+            from spark_rapids_ml_tpu.obs import spans as spans_mod
+
+            spans_mod.record_event(
+                f"serve:cache:{event}", t0, time.perf_counter(),
+                label=label, **attrs)
+        except Exception:
+            self._count_error("audit")
+
+    # -- the read path -----------------------------------------------------
+
+    def load(self, label: str,
+             signature_key: Any) -> Optional[CachedExecutable]:
+        """The cached executable for (label, signature), or None (MISS).
+        Corruption-tolerant: every failure mode degrades to a counted
+        miss."""
+        t0 = time.perf_counter()
+        digest = signature_digest(label, signature_key)
+        path = self._entry_path(label, digest)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            self._count("miss")
+            self._audit("miss", label, t0, digest=digest)
+            return None
+        except OSError:
+            self._count_error("io_read")
+            self._count("miss")
+            self._audit("miss", label, t0, digest=digest, error="io_read")
+            return None
+        header = self._parse_header(blob, label, t0, digest, path)
+        if header is None:
+            return None
+        stale = {
+            k: (header.get("fingerprint", {}).get(k), v)
+            for k, v in self.fingerprint().items()
+            if k in _FINGERPRINT_KEYS
+            and header.get("fingerprint", {}).get(k) != v
+        }
+        if stale:
+            # honest invalidation: the entry was compiled under a
+            # different jaxlib/platform/device-kind/precision world —
+            # drop it so the slot recompiles fresh
+            self._count("invalidate")
+            self._count("miss")
+            self._audit("invalidate", label, t0, digest=digest,
+                        stale_keys=sorted(stale))
+            self._remove(path, count_evict=False)
+            return None
+        try:
+            offset = len(_MAGIC) + _HEADER_STRUCT.size + header["_len"]
+            payload, in_tree, out_tree = pickle.loads(blob[offset:])
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            compiled = deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as exc:
+            self._count_error(f"deserialize_{type(exc).__name__}"[:40])
+            self._count("miss")
+            self._audit("miss", label, t0, digest=digest,
+                        error=type(exc).__name__)
+            self._remove(path, count_evict=False)
+            return None
+        try:
+            os.utime(path)  # LRU touch
+        except OSError:
+            self._count_error("utime")
+        self._count("hit")
+        self._audit("hit", label, t0, digest=digest,
+                    bytes=len(blob))
+        return CachedExecutable(
+            compiled,
+            header.get("flops"),
+            header.get("bytes_accessed"),
+            header.get("memory") or {},
+        )
+
+    def _parse_header(self, blob: bytes, label: str, t0: float,
+                      digest: str, path: str) -> Optional[Dict[str, Any]]:
+        if len(blob) < len(_MAGIC) + _HEADER_STRUCT.size:
+            self._count_error("truncated")
+            self._count("miss")
+            self._audit("miss", label, t0, digest=digest,
+                        error="truncated")
+            self._remove(path, count_evict=False)
+            return None
+        if blob[:len(_MAGIC)] != _MAGIC:
+            self._count_error("bad_magic")
+            self._count("miss")
+            self._audit("miss", label, t0, digest=digest,
+                        error="bad_magic")
+            self._remove(path, count_evict=False)
+            return None
+        (hlen,) = _HEADER_STRUCT.unpack(
+            blob[len(_MAGIC):len(_MAGIC) + _HEADER_STRUCT.size])
+        start = len(_MAGIC) + _HEADER_STRUCT.size
+        if len(blob) < start + hlen:
+            self._count_error("truncated")
+            self._count("miss")
+            self._audit("miss", label, t0, digest=digest,
+                        error="truncated")
+            self._remove(path, count_evict=False)
+            return None
+        try:
+            header = json.loads(blob[start:start + hlen])
+        except ValueError:
+            self._count_error("bad_header")
+            self._count("miss")
+            self._audit("miss", label, t0, digest=digest,
+                        error="bad_header")
+            self._remove(path, count_evict=False)
+            return None
+        header["_len"] = hlen
+        return header
+
+    # -- the write path ----------------------------------------------------
+
+    def store(self, label: str, signature_key: Any, compiled, *,
+              flops: Optional[float] = None,
+              bytes_accessed: Optional[float] = None,
+              memory: Optional[Dict[str, int]] = None,
+              compile_seconds: Optional[float] = None) -> bool:
+        """Persist one compiled executable (atomic write-then-rename;
+        bounded by LRU eviction). Returns whether it landed; a failure
+        (unserializable backend, disk trouble) is counted and ignored —
+        the in-memory path is always intact."""
+        t0 = time.perf_counter()
+        digest = signature_digest(label, signature_key)
+        path = self._entry_path(label, digest)
+        try:
+            from jax.experimental.serialize_executable import serialize
+
+            payload, in_tree, out_tree = serialize(compiled)
+            body = pickle.dumps((payload, in_tree, out_tree),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as exc:
+            self._count_error(f"serialize_{type(exc).__name__}"[:40])
+            return False
+        header = json.dumps({
+            "label": label,
+            "fingerprint": self.fingerprint(),
+            "flops": flops,
+            "bytes_accessed": bytes_accessed,
+            "memory": dict(memory or {}),
+            "compile_seconds": compile_seconds,
+        }).encode()
+        blob = (_MAGIC + _HEADER_STRUCT.pack(len(header)) + header + body)
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            self._count_error("io_write")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                self._count_error("io_cleanup")
+            return False
+        self._count("store")
+        self._audit("store", label, t0, digest=digest, bytes=len(blob))
+        self._evict_to_cap()
+        return True
+
+    def _remove(self, path: str, *, count_evict: bool) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            return
+        if count_evict:
+            self._count("evict")
+
+    def _evict_to_cap(self) -> None:
+        """Oldest-mtime LRU eviction down to ``max_bytes`` (hits touch
+        their entry's mtime). Serialized on the instance lock so racing
+        stores don't double-delete."""
+        if self.max_bytes <= 0:
+            return
+        t0 = time.perf_counter()
+        with self._evict_lock:
+            try:
+                entries = []
+                total = 0
+                with os.scandir(self.path) as it:
+                    for e in it:
+                        if not e.name.endswith(".aotx"):
+                            continue
+                        st = e.stat()
+                        entries.append((st.st_mtime, st.st_size, e.path))
+                        total += st.st_size
+            except OSError:
+                self._count_error("io_scan")
+                return
+            evicted = []
+            for mtime, size, path in sorted(entries):
+                if total <= self.max_bytes:
+                    break
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+                total -= size
+                evicted.append(os.path.basename(path))
+        for name in evicted:
+            self._count("evict")
+            self._audit("evict", name, t0)
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        entries = 0
+        total = 0
+        try:
+            with os.scandir(self.path) as it:
+                for e in it:
+                    if e.name.endswith(".aotx"):
+                        entries += 1
+                        total += e.stat().st_size
+        except OSError:
+            self._count_error("io_scan")
+        with self._lock:
+            counters = dict(self._local)
+        return {
+            "path": self.path,
+            "max_bytes": self.max_bytes,
+            "entries": entries,
+            "bytes": total,
+            **counters,
+        }
+
+
+# -- the process-global cache handle -----------------------------------------
+
+_global_lock = threading.Lock()
+_global_cache: Optional[ExecutableCache] = None
+_global_config: Optional[Tuple] = None
+_configured: Optional[Tuple[Optional[str], Optional[int]]] = None
+
+
+def configure_executable_cache(path: Optional[str], *,
+                               max_bytes: Optional[int] = None) -> None:
+    """Programmatic override of the env-var configuration (tests, the
+    cold-start bench). ``path=None`` restores env-driven resolution."""
+    global _configured, _global_cache, _global_config
+    with _global_lock:
+        _configured = (path, max_bytes) if path else None
+        _global_cache = None
+        _global_config = None
+
+
+def get_executable_cache() -> Optional[ExecutableCache]:
+    """The process cache, or None when disabled. Re-resolves when the
+    governing env vars change (the precision env is part of the entry
+    fingerprint, so a flipped posture must rebuild the handle)."""
+    global _global_cache, _global_config
+    if _configured is not None:
+        path, max_bytes = _configured
+        key = ("cfg", path, max_bytes,
+               os.environ.get(PRECISION_ENV, "native"))
+    else:
+        path = os.environ.get(CACHE_DIR_ENV, "").strip() or None
+        max_bytes = None
+        key = ("env", path, _env_max_bytes(),
+               os.environ.get(PRECISION_ENV, "native"))
+    if path is None:
+        return None
+    with _global_lock:
+        if _global_cache is None or _global_config != key:
+            _global_cache = ExecutableCache(path, max_bytes=max_bytes)
+            _global_config = key
+        return _global_cache
+
+
+__all__ = [
+    "CACHE_DIR_ENV",
+    "CACHE_MAX_BYTES_ENV",
+    "CachedExecutable",
+    "ExecutableCache",
+    "configure_executable_cache",
+    "environment_fingerprint",
+    "get_executable_cache",
+    "signature_digest",
+]

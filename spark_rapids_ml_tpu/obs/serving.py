@@ -637,6 +637,11 @@ class ServingProgram(NamedTuple):
     dtype: Any
     algo: str
     precision: str = "native"
+    # optional compile-without-execute hook (``TrackedJit.prime``): the
+    # warm-restart replay primes each bucket's executable — a disk-cache
+    # load when the persistent cache is on — without paying a zero-batch
+    # execution per bucket. None → warmup falls back to put/run/fetch.
+    prime: Optional[Callable[[Any], bool]] = None
 
 
 class PipelineTransform:

@@ -129,6 +129,9 @@ from spark_rapids_ml_tpu.serve.rollout import (  # noqa: F401
     RolloutController,
     StreamingTrainer,
 )
+from spark_rapids_ml_tpu.serve.autoscale import (  # noqa: F401
+    AutoscaleController,
+)
 from spark_rapids_ml_tpu.serve.server import (  # noqa: F401
     make_handler,
     start_serve_server,
@@ -137,6 +140,7 @@ from spark_rapids_ml_tpu.serve.server import (  # noqa: F401
 __all__ = [
     "AdmissionController",
     "AsyncTransformSpec",
+    "AutoscaleController",
     "BatcherClosed",
     "BreakerOpen",
     "CircuitBreaker",

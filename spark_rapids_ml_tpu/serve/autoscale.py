@@ -1,0 +1,433 @@
+"""The autoscaling replica controller: spend the zero-cold-start win.
+
+The persistent executable cache (``obs/aotcache.py``) makes replica
+start nearly free — a new replica's bucket × precision ladder loads
+from disk in milliseconds instead of recompiling. This module spends
+that capability: a control loop that watches the live overload signals
+the stack already computes and moves the replica count against them
+through the existing placement machinery:
+
+* **signals** (read at a bounded cadence, never per request):
+
+  - the batchers' **queue-wait EWMA** (``engine._overload_signals`` —
+    the same live estimate the shed controller and Retry-After use);
+  - the adaptive **shed level** (``serve.admission.ShedController`` —
+    a controller that is already shedding is a controller that wants
+    more capacity);
+  - the **SLO fast-burn rate** (``obs.slo`` — the 5 m burn window);
+  - mean **per-device occupancy** out of the TSDB
+    (``obs.devmon.DeviceMonitor.occupancy`` — the PR 7 busy rate,
+    already a placement input, now a capacity input).
+
+* **actuation** — ``engine.scale_replicas(target)``: scale-up grows
+  replica sets (un-retire first, then build fresh replicas whose
+  ladders warm through the persistent cache); scale-down retires the
+  highest-index replicas, which drain through their own workers and
+  are reaped once empty — **never dropped** (the PR 13 ReplicaHealth
+  drain posture, reused).
+
+* **hysteresis** — a hot signal must persist ``UP_HOLD`` before a
+  scale-up, a cold one ``DOWN_HOLD`` before a scale-down, and any two
+  actions are separated by ``COOLDOWN`` regardless of direction: an
+  oscillating load cannot flap replicas faster than the hold (the
+  chaos drill's ``autoscale_flap`` phase asserts exactly this).
+
+* **observability** — every decision increments
+  ``sparkml_serve_autoscale_total{decision}`` and files a
+  ``serve:autoscale`` audit event with the triggering signals (rule 14
+  of ``scripts/check_instrumentation.py``); the current replica target
+  is the ``sparkml_serve_autoscale_replicas`` gauge; a bounded decision
+  history serves ``/debug/slo``'s autoscale section and the
+  ``serve_autoscale`` dashboard tile.
+
+Env knobs (all ``SPARK_RAPIDS_ML_TPU_SERVE_AUTOSCALE_*``; constructor
+args win):
+
+* ``..._MIN`` / ``..._MAX``   — replica bounds (MAX 0 = all visible
+  devices);
+* ``..._INTERVAL_MS``         (500)  — evaluation cadence;
+* ``..._UP_QUEUE_WAIT_MS``    (80)   — queue-wait EWMA above this is
+  hot;
+* ``..._UP_BURN``             (14.4) — SLO fast-burn at/above this is
+  hot (0 disables the burn trigger);
+* ``..._UP_OCCUPANCY``        (0.85) — mean active-device occupancy
+  at/above this is hot;
+* ``..._DOWN_QUEUE_WAIT_MS``  (10)   — queue wait below this (with a
+  quiet shed/burn/occupancy picture) is cold;
+* ``..._DOWN_OCCUPANCY``      (0.35) — occupancy below this is cold;
+* ``..._UP_HOLD_MS``          (1000) — how long hot must persist;
+* ``..._DOWN_HOLD_MS``        (5000) — how long cold must persist
+  (deliberately slower: adding capacity is cheap, removing it risks a
+  re-ramp);
+* ``..._COOLDOWN_MS``         (2000) — minimum spacing between ANY two
+  scale actions (the anti-flap floor);
+* ``..._STEP``                (1)    — replicas moved per decision.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.devmon import get_device_monitor
+from spark_rapids_ml_tpu.obs.logging import get_logger
+
+ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_AUTOSCALE_"
+
+SCALE_UP = "scale_up"
+SCALE_DOWN = "scale_down"
+HOLD = "hold"
+
+_log = get_logger("serve.autoscale")
+
+
+def _env_number(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(ENV_PREFIX + name, default))
+    except ValueError:
+        return default
+
+
+class AutoscaleController:
+    """Closed-loop replica-count control over one ``ServeEngine``.
+
+    Clock-injectable and drivable step-by-step (``evaluate_once``) so
+    tests exercise hours of hysteresis with zero sleeps; ``start()``
+    runs the same evaluation on a traced daemon thread (rule 5)."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        min_replicas: Optional[int] = None,
+        max_replicas: Optional[int] = None,
+        interval_s: Optional[float] = None,
+        up_queue_wait_s: Optional[float] = None,
+        up_burn: Optional[float] = None,
+        up_occupancy: Optional[float] = None,
+        down_queue_wait_s: Optional[float] = None,
+        down_occupancy: Optional[float] = None,
+        up_hold_s: Optional[float] = None,
+        down_hold_s: Optional[float] = None,
+        cooldown_s: Optional[float] = None,
+        step: Optional[int] = None,
+        occupancy_window_s: float = 5.0,
+        signals_fn: Optional[Callable[[], Dict[str, float]]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.engine = engine
+        base = max(engine.placer.base_device_count(), 1)
+        self.min_replicas = max(int(
+            min_replicas if min_replicas is not None
+            else _env_number("MIN", 1)), 1)
+        env_max = int(max_replicas if max_replicas is not None
+                      else _env_number("MAX", 0))
+        self.max_replicas = base if env_max <= 0 else min(env_max, base)
+        self.max_replicas = max(self.max_replicas, self.min_replicas)
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else _env_number("INTERVAL_MS", 500.0) / 1000.0)
+        self.up_queue_wait_s = float(
+            up_queue_wait_s if up_queue_wait_s is not None
+            else _env_number("UP_QUEUE_WAIT_MS", 80.0) / 1000.0)
+        self.up_burn = float(
+            up_burn if up_burn is not None
+            else _env_number("UP_BURN", 14.4))
+        self.up_occupancy = float(
+            up_occupancy if up_occupancy is not None
+            else _env_number("UP_OCCUPANCY", 0.85))
+        self.down_queue_wait_s = float(
+            down_queue_wait_s if down_queue_wait_s is not None
+            else _env_number("DOWN_QUEUE_WAIT_MS", 10.0) / 1000.0)
+        self.down_occupancy = float(
+            down_occupancy if down_occupancy is not None
+            else _env_number("DOWN_OCCUPANCY", 0.35))
+        self.up_hold_s = float(
+            up_hold_s if up_hold_s is not None
+            else _env_number("UP_HOLD_MS", 1000.0) / 1000.0)
+        self.down_hold_s = float(
+            down_hold_s if down_hold_s is not None
+            else _env_number("DOWN_HOLD_MS", 5000.0) / 1000.0)
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else _env_number("COOLDOWN_MS", 2000.0) / 1000.0)
+        self.step = max(int(step if step is not None
+                            else _env_number("STEP", 1)), 1)
+        self.occupancy_window_s = float(occupancy_window_s)
+        self._signals_fn = signals_fn
+        self._clock = clock
+        self._devmon = get_device_monitor()
+        self._lock = threading.Lock()
+        self._hot_since: Optional[float] = None
+        self._cold_since: Optional[float] = None
+        self._last_action_at: Optional[float] = None
+        self._last_signals: Dict[str, float] = {}
+        self._history: collections.deque = collections.deque(maxlen=64)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = get_registry()
+        self._m_decisions = reg.counter(
+            "sparkml_serve_autoscale_total",
+            "autoscale controller decisions (scale_up / scale_down)",
+            ("decision",),
+        )
+        self._m_replicas = reg.gauge(
+            "sparkml_serve_autoscale_replicas",
+            "the autoscale controller's current replica target",
+        )
+        self._m_errors = reg.counter(
+            "sparkml_serve_errors_total",
+            "serving errors by type: batch failures (exception class), "
+            "worker crashes/wedges, breaker rejections",
+            ("model", "error"),
+        )
+        self._m_decisions.inc(0, decision=SCALE_UP)
+        self._m_decisions.inc(0, decision=SCALE_DOWN)
+        # clamp the engine into bounds so the loop starts from a sane
+        # actuator state (an engine at 8 replicas under a max of 4 would
+        # otherwise take max/step ticks just to reach its own ceiling)
+        start = min(max(engine.replica_scale(), self.min_replicas),
+                    self.max_replicas)
+        if start != engine.replica_scale():
+            self._apply(start, "bound", {"reason": "startup_clamp"})
+        self._m_replicas.set(engine.replica_scale())
+
+    # -- signals -----------------------------------------------------------
+
+    def signals(self) -> Dict[str, float]:
+        """The live control inputs (one bounded read each — the PR 10
+        never-per-request lesson): queue-wait EWMA, shed level, SLO
+        fast-burn, mean active-device occupancy from the TSDB."""
+        if self._signals_fn is not None:
+            return dict(self._signals_fn())
+        overload = self.engine._overload_signals()
+        shed_level = 0
+        try:
+            # shed_posture(), not a raw level() read: de-escalation
+            # only runs inside maybe_refresh, and once an LB drains a
+            # shedding replica there may be NO predict traffic left to
+            # refresh it — a stale level would block scale-down forever
+            # (the PR 10 /readyz lesson applied to this controller)
+            shed_level = int(self.engine.shed_posture().level())
+        except Exception:
+            self._m_errors.inc(model="(autoscale)", error="shed_signal")
+        occupancy = 0.0
+        try:
+            occ = self._devmon.occupancy(self.occupancy_window_s)
+            active = [
+                occ.get(label, 0.0)
+                for label in self._active_labels()
+            ]
+            if active:
+                occupancy = float(sum(active) / len(active))
+        except Exception:
+            self._m_errors.inc(model="(autoscale)", error="occupancy")
+        return {
+            "queue_wait_s": float(overload.get("queue_wait_s", 0.0)),
+            "depth_frac": float(overload.get("depth_frac", 0.0)),
+            "burn": float(overload.get("burn", 0.0)),
+            "shed_level": float(shed_level),
+            "occupancy": occupancy,
+        }
+
+    def _active_labels(self) -> List[str]:
+        from spark_rapids_ml_tpu.serve import placement as placement_mod
+
+        return [placement_mod.device_label(d)
+                for d in self.engine.placer.active_devices()]
+
+    def _is_hot(self, s: Dict[str, float]) -> List[str]:
+        reasons = []
+        if s.get("queue_wait_s", 0.0) >= self.up_queue_wait_s:
+            reasons.append("queue_wait")
+        if s.get("shed_level", 0.0) > 0:
+            reasons.append("shed_level")
+        if self.up_burn > 0 and s.get("burn", 0.0) >= self.up_burn:
+            reasons.append("slo_burn")
+        if s.get("occupancy", 0.0) >= self.up_occupancy:
+            reasons.append("occupancy")
+        return reasons
+
+    def _is_cold(self, s: Dict[str, float]) -> bool:
+        return (s.get("queue_wait_s", 0.0) <= self.down_queue_wait_s
+                and s.get("shed_level", 0.0) <= 0
+                and (self.up_burn <= 0
+                     or s.get("burn", 0.0) < self.up_burn / 2.0)
+                and s.get("occupancy", 1.0) <= self.down_occupancy)
+
+    # -- the decision loop -------------------------------------------------
+
+    def evaluate_once(self) -> str:
+        """One control tick: read signals, run the hysteresis state
+        machine, maybe actuate. Returns the decision
+        (``scale_up`` / ``scale_down`` / ``hold``)."""
+        now = self._clock()
+        signals = self.signals()
+        scale = self.engine.replica_scale()
+        with self._lock:
+            self._last_signals = dict(signals)
+        hot_reasons = self._is_hot(signals)
+        cold = self._is_cold(signals)
+        decision = HOLD
+        if hot_reasons:
+            with self._lock:
+                self._cold_since = None
+                if self._hot_since is None:
+                    self._hot_since = now
+                held = now - self._hot_since
+                ready = (held >= self.up_hold_s
+                         and self._cooldown_over(now)
+                         and scale < self.max_replicas)
+            if ready:
+                decision = SCALE_UP
+                self._apply(
+                    min(scale + self.step, self.max_replicas),
+                    SCALE_UP,
+                    {**signals, "reasons": ",".join(hot_reasons)})
+        elif cold:
+            with self._lock:
+                self._hot_since = None
+                if self._cold_since is None:
+                    self._cold_since = now
+                held = now - self._cold_since
+                ready = (held >= self.down_hold_s
+                         and self._cooldown_over(now)
+                         and scale > self.min_replicas)
+            if ready:
+                decision = SCALE_DOWN
+                self._apply(
+                    max(scale - self.step, self.min_replicas),
+                    SCALE_DOWN, {**signals, "reasons": "cold"})
+        else:
+            with self._lock:
+                self._hot_since = None
+                self._cold_since = None
+        # the reaper rides the control cadence: retired replicas whose
+        # queues drained are closed here, never on the request path
+        self.engine.reap_retired()
+        self._m_replicas.set(self.engine.replica_scale())
+        return decision
+
+    def _cooldown_over(self, now: float) -> bool:
+        """Caller holds the lock. The anti-flap floor: no two scale
+        actions (either direction) closer than ``cooldown_s``."""
+        return (self._last_action_at is None
+                or now - self._last_action_at >= self.cooldown_s)
+
+    def _apply(self, target: int, decision: str,
+               signals: Dict[str, Any]) -> None:
+        """Actuate one decision: resize the engine, count it, file the
+        ``serve:autoscale`` audit event, append to the bounded history
+        (rule 14: a replica-count change nobody can see is an
+        unauditable capacity change)."""
+        t0 = time.perf_counter()
+        now = self._clock()
+        before = self.engine.replica_scale()
+        try:
+            report = self.engine.scale_replicas(target)
+        except Exception as exc:  # noqa: BLE001 - loop must survive
+            self._m_errors.inc(model="(autoscale)", error="scale")
+            _log.error("autoscale actuation failed", decision=decision,
+                       target=target, error=type(exc).__name__)
+            return
+        after = self.engine.replica_scale()
+        if decision in (SCALE_UP, SCALE_DOWN):
+            self._m_decisions.inc(decision=decision)
+        self._m_replicas.set(after)
+        attrs = {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in signals.items()}
+        spans_mod.record_event(
+            f"serve:autoscale:{decision}", t0, time.perf_counter(),
+            replicas_before=before, replicas_after=after, **attrs)
+        with self._lock:
+            self._last_action_at = now
+            self._hot_since = None
+            self._cold_since = None
+            self._history.append({
+                "at": now,
+                "decision": decision,
+                "from": before,
+                "to": after,
+                "signals": dict(signals),
+                "resized": report.get("resized", {}),
+            })
+
+    # -- the background loop -----------------------------------------------
+
+    def start(self) -> None:
+        """Run the control loop on a traced daemon thread at
+        ``interval_s`` cadence until ``stop()``."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("autoscale controller already running")
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.is_set():
+                try:
+                    self.evaluate_once()
+                except Exception:  # noqa: BLE001 - loop must survive
+                    # visible, never silent: a dead controller is a
+                    # frozen replica count under moving load
+                    self._m_errors.inc(model="(autoscale)",
+                                       error="controller")
+                self._stop.wait(self.interval_s)
+
+        self._thread = tracectx.traced_thread(
+            _loop, name="sparkml-autoscale", daemon=True, fresh=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+
+    @property
+    def running(self) -> bool:
+        return bool(self._thread is not None and self._thread.is_alive())
+
+    # -- introspection -----------------------------------------------------
+
+    def decision_history(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._history)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/debug/slo`` autoscale section / dashboard tile."""
+        with self._lock:
+            history = list(self._history)[-16:]
+            signals = dict(self._last_signals)
+            last_action = self._last_action_at
+        return {
+            "replicas": self.engine.replica_scale(),
+            "min": self.min_replicas,
+            "max": self.max_replicas,
+            "running": self.running,
+            "signals": signals,
+            "thresholds": {
+                "up_queue_wait_s": self.up_queue_wait_s,
+                "up_burn": self.up_burn,
+                "up_occupancy": self.up_occupancy,
+                "down_queue_wait_s": self.down_queue_wait_s,
+                "down_occupancy": self.down_occupancy,
+                "up_hold_s": self.up_hold_s,
+                "down_hold_s": self.down_hold_s,
+                "cooldown_s": self.cooldown_s,
+            },
+            "last_action_at": last_action,
+            "history": history,
+        }
+
+
+__all__ = [
+    "AutoscaleController",
+    "ENV_PREFIX",
+    "HOLD",
+    "SCALE_DOWN",
+    "SCALE_UP",
+]

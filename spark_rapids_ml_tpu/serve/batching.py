@@ -670,6 +670,13 @@ class MicroBatcher:
         with self._lock:
             return self._crashed
 
+    def closed(self) -> bool:
+        """Whether ``close()`` ran — how the autoscale reaper and a
+        scale-up's un-retire tell a drained-and-reaped batcher from a
+        merely idle one."""
+        with self._lock:
+            return self._closed
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:

@@ -473,6 +473,9 @@ class ServeEngine:
         # the live-rollout control plane (serve.rollout), attached via
         # attach_rollout: canary routing + per-arm outcome attribution
         self._rollout = None
+        # the autoscale control plane (serve.autoscale), attached via
+        # attach_autoscale: replica-count actuation + /debug surface
+        self._autoscale = None
         # hot-path metric handles, resolved once (same convention as
         # MicroBatcher._declare_metrics)
         reg = get_registry()
@@ -835,7 +838,12 @@ class ServeEngine:
                 return self._sharded_attempt(entry, rows, deadline,
                                              handoff, submitted, prog)
         rset = self._replica_set_for(entry)
-        replica = self.placer.pick(rset, trace_ctx=handoff)
+        # the small-request tier (under a quarter of the coalescing cap)
+        # concentrates onto fewer replicas under light load so batches
+        # stay dense — see DevicePlacer.pick
+        replica = self.placer.pick(
+            rset, trace_ctx=handoff,
+            small=4 * _rows_estimate(rows) <= self.max_batch_rows)
         multi = len(rset.replicas) > 1
         if replica.batcher.dead() and (
                 revive or (multi and replica.health.probing)):
@@ -1132,7 +1140,9 @@ class ServeEngine:
             # a ~tens-of-ms stall a pure-host serving process never
             # paid before this tier existed
             return [(None, None, None)]
-        devices = self.placer.devices()
+        # active_devices caps at the autoscale target: a set built while
+        # scaled down starts small and scale_replicas grows it later
+        devices = self.placer.active_devices()
         pinned_id = -1
         get_dev = getattr(entry.model, "getDeviceId", None)
         if callable(get_dev):
@@ -1588,7 +1598,7 @@ class ServeEngine:
         primary_spec = rset.primary.spec
         for replica in rset.replicas:
             spec = replica.spec
-            if spec is None or spec.program is None \
+            if replica.retired or spec is None or spec.program is None \
                     or n_features is None:
                 continue
             prog = spec.program
@@ -1632,6 +1642,314 @@ class ServeEngine:
                 "seconds": time.perf_counter() - t0,
             }
         return report
+
+    def warm_from_manifest(self) -> Dict[str, Any]:
+        """Replay the registry's warm manifest: every recovered model
+        version that was warm at the last persist is re-warmed at its
+        recorded bucket ladder. With the persistent executable cache
+        configured (``SPARK_RAPIDS_ML_TPU_SERVE_CACHE_DIR``) each replay
+        step is a disk load instead of an XLA compile — a restarted
+        replica serves its first request without a single fresh compile
+        (asserted by the ``bench_serve`` cold-start scenario and the
+        warm-restart integration test). Per-model failures are counted,
+        never raised: a restart that can only partially warm must still
+        come up."""
+        report: Dict[str, Any] = {"warmed": {}, "failed": []}
+        for name, version, buckets in self.registry.warm_entries():
+            ref = f"{name}@{version}"
+            try:
+                t0 = time.perf_counter()
+                with spans_mod.span(f"serve:warm_restart:{name}",
+                                    model=name, version=version,
+                                    buckets=len(buckets)):
+                    entry = self.registry.resolve_entry(ref)
+                    if not self._prime_replicas(entry, buckets):
+                        # no primeable program (host-path model, or a
+                        # kernel without AOT priming): the full warmup
+                        # executes the ladder — still zero fresh
+                        # compiles when the cache holds it, just paid
+                        # in zero-batch executions
+                        self.warmup(ref)
+                report["warmed"][ref] = time.perf_counter() - t0
+            except Exception as exc:  # noqa: BLE001 - per-model
+                self._m_errors.inc(model=name, error="warm_restart")
+                report["failed"].append(
+                    f"{ref}: {type(exc).__name__}: {exc}")
+        return report
+
+    def _prime_replicas(self, entry: RegisteredModel,
+                        buckets: Sequence[int]) -> bool:
+        """Prime (compile-without-execute — a disk-cache load per
+        signature when the persistent cache is on) every replica's
+        bucket ladder. Returns False when the model has no primeable
+        program (the caller falls back to the executing warmup)."""
+        rset = self._replica_set_for(entry)
+        spec = rset.primary.spec
+        if (spec is None or spec.program is None
+                or spec.program.prime is None):
+            return False
+        from spark_rapids_ml_tpu.serve.registry import _infer_features
+
+        n_features = _infer_features(entry.model)
+        if n_features is None:
+            return False
+        chosen = sorted(set(int(b) for b in (
+            self.buckets or buckets or entry.buckets or ())))
+        if not chosen:
+            return False
+        all_primed = True
+        for replica in rset.replicas:
+            rspec = replica.spec
+            if (replica.retired or rspec is None
+                    or rspec.program is None
+                    or rspec.program.prime is None):
+                continue
+            prog = rspec.program
+            for bucket in chosen:
+                # abstract prime: no batch allocation, no transfer —
+                # per signature, a warm restart pays exactly one
+                # executable load
+                if not prog.prime(bucket, int(n_features)):
+                    all_primed = False
+        # a prime that fell back (AOT quirk for this signature) left
+        # that executable UNcompiled — report failure so the caller
+        # runs the executing warmup instead of claiming a warm ladder
+        # the first request would then pay for (already-primed buckets
+        # make that fallback pass cheap)
+        return all_primed
+
+    # -- the autoscale tier (serve.autoscale drives these) -----------------
+
+    def replica_scale(self) -> int:
+        """The current replica target (the autoscale controller's
+        actuator state): the placer target, or the visible-device count
+        when no controller has set one."""
+        target = self.placer.target_count
+        if target is not None:
+            return target
+        return max(self.placer.base_device_count(), 1)
+
+    def scale_replicas(self, target: int) -> Dict[str, Any]:
+        """Move every async-capable replica set to ``target`` replicas
+        (clamped to [1, visible devices]).
+
+        Scale-UP is cheap by construction: un-retiring a drained
+        replica just clears its flag (reviving the reaped batcher with
+        the SAME staged program), and building a brand-new replica
+        compiles its ladder through the persistent executable cache —
+        milliseconds, not a recompile. Scale-DOWN retires the
+        highest-index replicas (never the primary): they leave the
+        placement set immediately, queued work drains through their
+        workers (never dropped — the PR 13 ReplicaHealth drain
+        posture), and ``reap_retired`` closes them once empty."""
+        with self._lock:
+            if self._closed:
+                # checked BEFORE the placer mutation: a shut-down
+                # engine must not be left advertising a target that
+                # was never actuated
+                raise EngineClosed("serving engine is shut down")
+        target = self.placer.set_target(target)
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("serving engine is shut down")
+            sets = dict(self._replicas)
+        report: Dict[str, Any] = {"target": target, "resized": {}}
+        for (name, version), rset in sets.items():
+            try:
+                entry = self.registry.resolve_entry(name, version)
+            except KeyError:
+                continue  # stale set; the usual eviction sweep owns it
+            delta = self._resize_replica_set(entry, rset, target)
+            if delta:
+                report["resized"][f"{name}@{version}"] = delta
+        self.reap_retired()
+        return report
+
+    def _resize_replica_set(self, entry: RegisteredModel,
+                            rset: ReplicaSet,
+                            target: int) -> Optional[Dict[str, int]]:
+        """Resize ONE replica set toward ``target`` active replicas.
+        Sync-path/pinned/host models (single-replica by design) never
+        resize. Returns {"added": n, "retired": n} or None."""
+        if rset.primary.spec is None or len(rset.replicas) == 0:
+            return None  # not an async-capable set: cannot replicate
+        added = retired = 0
+        active = rset.active_count()
+        if target < active:
+            # retire from the tail; index 0 (the primary) never retires
+            for replica in reversed(rset.replicas[1:]):
+                if active <= target:
+                    break
+                if not replica.retired:
+                    replica.retired = True
+                    retired += 1
+                    active -= 1
+            self.placer.publish_state(rset)
+            return {"added": 0, "retired": retired}
+        if target == active:
+            return None
+        # scale up: first un-retire (cheapest — the program is staged,
+        # the executables warm), then build fresh replicas on devices
+        # the set has never touched
+        for replica in rset.replicas:
+            if active >= target:
+                break
+            if replica.retired:
+                self._unretire_replica(entry, replica)
+                added += 1
+                active += 1
+        if active < target:
+            added += self._grow_replica_set(entry, rset, target - active)
+        self.placer.publish_state(rset)
+        return {"added": added, "retired": 0} if added else None
+
+    def _unretire_replica(self, entry: RegisteredModel,
+                          replica: Replica) -> None:
+        """Bring one retired replica back into rotation: clear the flag
+        and, if the reaper already closed (or CLAIMED — the close may
+        be mid-flight on another thread), or the worker killed, its
+        batcher, rebuild one around the SAME staged program spec. The
+        whole transition runs under the engine lock so it is atomic
+        against the reaper's claim step."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("serving engine is shut down")
+            rebuild = (replica.reaping or replica.batcher is None
+                       or replica.batcher.closed()
+                       or replica.batcher.dead())
+            if rebuild:
+                replica.batcher = self._make_replica_batcher(
+                    entry, replica.spec, replica.label, True)
+            replica.retired = False
+
+    def _grow_replica_set(self, entry: RegisteredModel, rset: ReplicaSet,
+                          count: int) -> int:
+        """Append up to ``count`` brand-new replicas on the next unused
+        placement devices, at the precision the PRIMARY's guard already
+        resolved. Program construction runs OUTSIDE the engine lock
+        (device work); the replica list swap is atomic. New ladders are
+        warmed immediately — through the persistent cache when
+        configured, so a scale-up costs milliseconds."""
+        # grow onto devices the set does NOT already occupy — indexing
+        # by len(replicas) would double-place a device whenever the
+        # original plan skipped one (a transient program-build failure
+        # leaves the replica list non-contiguous over the device list)
+        used = {replica.label for replica in rset.replicas}
+        devices = [d for d in self.placer.devices()
+                   if placement_mod.device_label(d) not in used]
+        primary_spec = rset.primary.spec
+        grown: List[Replica] = []
+        for dev in devices[:count]:
+            label = placement_mod.device_label(dev)
+            prog = self._serving_program(entry, primary_spec.precision,
+                                         device=dev)
+            if prog is None:
+                continue
+            spec = self._make_async_spec(entry, prog, device_label=label)
+            with self._lock:
+                if self._closed:
+                    raise EngineClosed("serving engine is shut down")
+                batcher = self._make_replica_batcher(entry, spec, label,
+                                                     True)
+            replica = Replica(dev, label, batcher,
+                              ReplicaHealth(clock=self._clock))
+            replica.spec = spec
+            grown.append(replica)
+        if not grown:
+            return 0
+        self._warm_new_replicas(entry, grown)
+        with self._lock:
+            rset.replicas = rset.replicas + grown
+        return len(grown)
+
+    def _warm_new_replicas(self, entry: RegisteredModel,
+                           replicas: List[Replica]) -> None:
+        """Precompile a freshly-grown replica's bucket ladder before it
+        takes traffic (a disk-cache hit per bucket when the persistent
+        cache is on). Failures are counted and tolerated — the first
+        request would compile lazily like any cold signature."""
+        buckets = (self.buckets or entry.buckets
+                   or entry.warmed_buckets or ())
+        if not buckets:
+            return
+        from spark_rapids_ml_tpu.serve.registry import _infer_features
+
+        n_features = _infer_features(entry.model)
+        if n_features is None:
+            return
+        for replica in replicas:
+            prog = replica.spec.program if replica.spec else None
+            if prog is None:
+                continue
+            try:
+                with spans_mod.span(
+                    f"serve:warmup_scaleup:{entry.name}",
+                    device=replica.label, buckets=len(buckets),
+                ):
+                    for bucket in sorted(set(int(b) for b in buckets)):
+                        # compile-without-execute — a disk-cache load
+                        # per bucket when the persistent cache is on:
+                        # what makes scale-up cheap. A prime that fell
+                        # back (or a primeless program) warms by
+                        # executing instead — the replica must not
+                        # enter rotation with a cold signature
+                        if (prog.prime is None
+                                or not prog.prime(bucket,
+                                                  int(n_features))):
+                            zeros = np.zeros((bucket, int(n_features)),
+                                             dtype=replica.spec.dtype)
+                            prog.fetch(prog.run(prog.put(zeros)))
+            except Exception:  # noqa: BLE001 - warm is best-effort
+                self._m_errors.inc(model=entry.name,
+                                   error="scaleup_warmup")
+
+    def reap_retired(self) -> int:
+        """Close retired replicas whose queues have fully drained (the
+        autoscale loop calls this every tick). A retired replica with
+        work still queued keeps its worker until empty — scale-down
+        drains, never drops. Returns how many batchers were closed.
+
+        Claim-then-close: the reap CLAIMS each victim under the engine
+        lock (``replica.reaping``) and closes the CAPTURED batcher
+        outside it — a concurrent scale-up's un-retire sees the claim
+        and rebuilds a fresh batcher instead of racing back into the
+        one being closed (an in-rotation replica must never end up
+        with a closed batcher)."""
+        claims: List[Tuple[Replica, MicroBatcher]] = []
+        with self._lock:
+            for rset in self._replicas.values():
+                for replica in rset.replicas:
+                    batcher = replica.batcher
+                    if (replica.retired and not replica.reaping
+                            and batcher is not None
+                            and not batcher.closed()
+                            and batcher.load() == 0):
+                        replica.reaping = True
+                        claims.append((replica, batcher))
+        for replica, corpse in claims:
+            corpse.close(drain=True, timeout=5.0)
+            with self._lock:
+                replica.reaping = False
+        return len(claims)
+
+    def attach_autoscale(self, controller) -> None:
+        """Install a ``serve.autoscale.AutoscaleController``: its
+        snapshot serves ``/debug/slo``'s autoscale section and the
+        ``serve_autoscale`` dashboard tile."""
+        self._autoscale = controller
+
+    def autoscale_controller(self):
+        return getattr(self, "_autoscale", None)
+
+    def autoscale_snapshot(self) -> Dict[str, Any]:
+        """The ``/debug/slo`` autoscale section (``{"enabled": False}``
+        without an attached controller)."""
+        controller = getattr(self, "_autoscale", None)
+        if controller is None:
+            return {"enabled": False}
+        doc = controller.snapshot()
+        doc["enabled"] = True
+        return doc
 
     # -- lifecycle / introspection ----------------------------------------
 

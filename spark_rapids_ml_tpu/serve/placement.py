@@ -65,9 +65,13 @@ ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
 SERVING = "serving"
 DRAINING = "draining"
 DEAD = "dead"
+RETIRED = "retired"
 
-# the gauge encoding the anomaly detector thresholds on (> 0.5 fires)
-STATE_VALUES = {SERVING: 0, DRAINING: 1, DEAD: 2}
+# the gauge encoding the anomaly detector thresholds on (> 0.5 fires).
+# RETIRED publishes 0: a deliberate autoscale scale-down is an operator
+# decision, not a degradation — the serve_replica_degraded detector
+# must never page on it (the autoscale surface has its own gauge).
+STATE_VALUES = {SERVING: 0, DRAINING: 1, DEAD: 2, RETIRED: 0}
 
 
 def _env_number(name: str, default: float) -> float:
@@ -252,10 +256,14 @@ class ReplicaHealth:
 class Replica:
     """One (model version, device) serving replica: the device handle,
     its dedicated batcher (own worker, own staging pool, own fair
-    queue), and its health."""
+    queue), and its health. ``retired`` marks an autoscale scale-down:
+    the replica leaves the placement set (no probe re-entry — this is a
+    decision, not an illness), its queue drains through its worker, and
+    the reaper closes the batcher once empty; a scale-up simply clears
+    the flag (and revives the batcher if the reaper got there first)."""
 
     __slots__ = ("device", "label", "batcher", "health", "spec",
-                 "_last_state")
+                 "retired", "reaping", "_last_state")
 
     def __init__(self, device: Any, label: str, batcher,
                  health: Optional[ReplicaHealth] = None):
@@ -266,9 +274,16 @@ class Replica:
         # the engine parks this replica's AsyncTransformSpec here so a
         # dead-batcher revive rebuilds with the SAME staged program
         self.spec = None
+        self.retired = False
+        # the reaper's claim (set under the engine lock): an un-retire
+        # racing a claimed reap must rebuild a FRESH batcher — the
+        # claimed one is being closed regardless of the flag flip
+        self.reaping = False
         self._last_state: Optional[str] = None
 
     def state(self) -> str:
+        if self.retired:
+            return RETIRED
         if self.batcher is not None and self.batcher.dead():
             return DEAD
         return DRAINING if self.health.draining else SERVING
@@ -314,6 +329,11 @@ class ReplicaSet:
     def healthy_count(self) -> int:
         return sum(1 for r in self.replicas if r.state() == SERVING)
 
+    def active_count(self) -> int:
+        """Replicas in rotation (not retired) — the autoscale
+        controller's notion of the current scale."""
+        return sum(1 for r in self.replicas if not r.retired)
+
     def snapshot(self) -> List[Dict[str, Any]]:
         return [r.snapshot() for r in self.replicas]
 
@@ -331,12 +351,31 @@ class DevicePlacer:
                  devices: Optional[List[Any]] = None,
                  occupancy_window: float = 5.0,
                  pressure_threshold: Optional[float] = None,
+                 concentrate: Optional[bool] = None,
+                 concentrate_spill_load: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         self._devices = devices
         self.occupancy_window = float(occupancy_window)
         self.pressure_threshold = float(
             pressure_threshold if pressure_threshold is not None
             else _env_number("REPLICA_MEM_PRESSURE", 0.92))
+        # Load-aware coalescing concentration (the PR 13 bench finding:
+        # spreading SMALL requests across N replica queues thins batches
+        # ~1.6 req/batch at 4 replicas vs ~4 at 1). Under light load the
+        # small-request tier concentrates onto the lowest-index healthy
+        # replicas — the first whose (queue + in-flight) load is below
+        # the spill threshold — recovering batch density; as depth grows
+        # the tier spills to siblings, so the scaling win is untouched
+        # under pressure. Full-bucket requests always route least-loaded.
+        self.concentrate = bool(
+            concentrate if concentrate is not None
+            else _env_number("CONCENTRATE", 1.0) > 0)
+        self.concentrate_spill_load = int(
+            concentrate_spill_load if concentrate_spill_load is not None
+            else _env_number("CONCENTRATE_SPILL_LOAD", 3))
+        # the autoscale target: None = every visible device; the replica
+        # controller moves this and the engine resizes live replica sets
+        self._target_count: Optional[int] = None
         self._clock = clock
         self._devmon = get_device_monitor()
         # round-robin tie-break cursor: strict least-loaded alone pins
@@ -375,6 +414,34 @@ class DevicePlacer:
         if self._devices is not None:
             return list(self._devices)
         return serving_devices()
+
+    def base_device_count(self) -> int:
+        """The hardware ceiling the autoscale target is clamped to."""
+        return len(self.devices())
+
+    @property
+    def target_count(self) -> Optional[int]:
+        return self._target_count
+
+    def set_target(self, count: Optional[int]) -> int:
+        """Set the autoscale replica target (clamped to [1, visible
+        devices]); None restores the all-devices default. New replica
+        sets build at the target; live ones are resized by
+        ``ServeEngine.scale_replicas``. Returns the clamped target."""
+        if count is None:
+            self._target_count = None
+            return self.base_device_count() or 1
+        ceiling = max(self.base_device_count(), 1)
+        self._target_count = max(1, min(int(count), ceiling))
+        return self._target_count
+
+    def active_devices(self) -> List[Any]:
+        """The devices new replica sets replicate onto: the base set
+        capped at the autoscale target."""
+        devices = self.devices()
+        if self._target_count is not None:
+            return devices[:max(self._target_count, 1)]
+        return devices
 
     # -- state publication -------------------------------------------------
 
@@ -418,15 +485,19 @@ class DevicePlacer:
         return self._occ_cache
 
     def pick(self, rset: ReplicaSet,
-             trace_ctx=None) -> Replica:
+             trace_ctx=None, small: bool = False) -> Replica:
         """The least-loaded allowed replica.
 
         Single-replica sets short-circuit (no span, no counter — the
         single-device hot path stays exactly as cheap as before this
-        tier existed). With no allowed replica the PRIMARY is returned
-        (and counted): the model-level breaker machinery decides what
-        happens to a request on a fully-sick set — placement never
-        invents a new failure mode."""
+        tier existed). ``small`` marks a request from the small-request
+        tier: under light load those CONCENTRATE onto the lowest-index
+        lightly-loaded replica to recover batch density, spilling to
+        siblings as depth grows (see ``concentrate``). Retired replicas
+        (autoscale scale-down) never take new traffic. With no allowed
+        replica the PRIMARY is returned (and counted): the model-level
+        breaker machinery decides what happens to a request on a
+        fully-sick set — placement never invents a new failure mode."""
         if len(rset.replicas) == 1:
             replica = rset.replicas[0]
             self._set_state(rset.name, replica)
@@ -434,6 +505,7 @@ class DevicePlacer:
         t0 = time.perf_counter()
         best: Optional[Replica] = None
         best_key = None
+        concentrated: Optional[Replica] = None
         probe: Optional[Replica] = None
         occupancy = self._occupancy()
         candidates = 0
@@ -441,7 +513,14 @@ class DevicePlacer:
             self._rr += 1
             rotate = self._rr
         n = len(rset.replicas)
+        concentrate = self.concentrate and small
         for idx, replica in enumerate(rset.replicas):
+            if replica.retired:
+                # an autoscale-retired replica drains its queue and
+                # leaves rotation — no probe, no re-entry until the
+                # controller scales it back in
+                self._set_state(rset.name, replica)
+                continue
             if replica.state() == DEAD:
                 # a dead batcher rides the same cooldown → probe →
                 # revive cycle as a failure-drained replica
@@ -458,11 +537,21 @@ class DevicePlacer:
             if self._memory_pressured(replica.label):
                 continue
             candidates += 1
+            if (concentrate and concentrated is None
+                    and replica.load() < self.concentrate_spill_load):
+                # first (lowest-index) lightly-loaded replica wins the
+                # small-request tier — index order, NOT rotation, is
+                # the whole point: every light-load small request lands
+                # the same queue so the coalescer sees full batches
+                concentrated = replica
             key = (replica.load(),
                    occupancy.get(replica.label, 0.0),
                    (idx - rotate) % n)
             if best is None or key < best_key:
                 best, best_key = replica, key
+        if concentrated is not None and probe is None:
+            best = concentrated
+            best_key = (concentrated.load(), 0.0, 0)
         if probe is not None:
             # the half-open probe outranks the load decision: one
             # request after the cooldown is how a drained replica
@@ -486,6 +575,8 @@ class DevicePlacer:
             device=best.label, load=int(best_key[0]),
             occupancy=round(float(best_key[1]), 4),
             candidates=candidates, replicas=len(rset.replicas),
+            concentrated=bool(concentrated is best
+                              and concentrated is not None),
             fallback=fallback,
         )
         return best
@@ -495,6 +586,7 @@ __all__ = [
     "DEAD",
     "DRAINING",
     "DevicePlacer",
+    "RETIRED",
     "Replica",
     "ReplicaHealth",
     "ReplicaSet",

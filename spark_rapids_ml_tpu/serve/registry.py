@@ -325,6 +325,18 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._versions)
 
+    def warm_entries(self) -> List[Tuple[str, int, Tuple[int, ...]]]:
+        """Every registered ``(name, version, warmed_buckets)`` that was
+        warm at the last manifest persist — the restart replay list
+        ``ServeEngine.warm_from_manifest`` walks."""
+        with self._lock:
+            return [
+                (name, v, versions[v].warmed_buckets)
+                for name, versions in sorted(self._versions.items())
+                for v in sorted(versions)
+                if versions[v].warmed_buckets
+            ]
+
     # -- warmup ------------------------------------------------------------
 
     def warmup(self, ref: str, *, n_features: Optional[int] = None,
@@ -358,6 +370,12 @@ class ModelRegistry:
         entry.warmed_buckets = tuple(sorted(report))
         if entry.buckets is None:
             entry.buckets = tuple(sorted(report))
+        # persist the warm ladder: the manifest must record which
+        # buckets were warm at shutdown so a restart can replay them
+        # (the zero-cold-start contract rides this record)
+        with self._lock:
+            pending = self._pending_manifest()
+        self._write_manifest(pending)
         get_registry().counter(
             "sparkml_serve_warmups_total",
             "warmup passes run against registered models", ("model",),
@@ -403,6 +421,13 @@ class ModelRegistry:
                     "source_path": versions[v].source_path,
                     "buckets": (list(versions[v].buckets)
                                 if versions[v].buckets else None),
+                    # the warm manifest: which bucket ladders were warm
+                    # at the last persist — a restarted process replays
+                    # them through engine.warmup, where the persistent
+                    # executable cache turns each into a ms-scale disk
+                    # load instead of an XLA compile
+                    "warmed_buckets": (list(versions[v].warmed_buckets)
+                                       or None),
                 }
                 for v in versions
             }
@@ -521,6 +546,14 @@ class ModelRegistry:
                             buckets=entry.get("buckets"),
                             source_path=path,
                         )
+                        warmed = entry.get("warmed_buckets")
+                        if warmed:
+                            # restore the warm-manifest record so
+                            # engine.warm_from_manifest knows exactly
+                            # which ladders to replay through the
+                            # persistent executable cache
+                            self._versions[name][version].warmed_buckets \
+                                = tuple(int(b) for b in warmed)
                     except Exception as exc:  # noqa: BLE001 - per-entry
                         # one bad path must not sink the whole recovery;
                         # counted per model so the partial recovery pages.

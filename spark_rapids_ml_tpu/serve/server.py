@@ -426,6 +426,7 @@ def make_handler(engine: ServeEngine):
                 snap["overload"] = engine.overload_state()
                 snap["replicas"] = engine.replica_snapshot()
                 snap["rollout"] = engine.rollout_snapshot()
+                snap["autoscale"] = engine.autoscale_snapshot()
                 status = self._reply(200, snap)
             elif path == "/debug/history":
                 params = urllib.parse.parse_qs(parsed.query)
@@ -443,6 +444,8 @@ def make_handler(engine: ServeEngine):
                 )
             elif path == "/debug/rollout":
                 status = self._reply(200, engine.rollout_snapshot())
+            elif path == "/debug/autoscale":
+                status = self._reply(200, engine.autoscale_snapshot())
             elif path == "/dashboard":
                 status = self._reply_text(
                     200, DASHBOARD_HTML, "text/html; charset=utf-8")
@@ -1098,6 +1101,14 @@ async function refresh() {
       tile("Retries", slo.retries_total || 0),
       tile("Worker restarts", slo.worker_restarts_total || 0),
     ];
+    var autoscale = slo.autoscale || {};
+    if (autoscale.enabled) {
+      tiles.push(tile(
+        "Autoscale replicas",
+        autoscale.replicas + " / [" + autoscale.min + "\\u2013"
+          + autoscale.max + "]"
+          + (autoscale.running ? "" : " (stopped)")));
+    }
     (slo.slos || []).forEach(function (s) {
       tiles.push(tile("Budget left · " + s.name,
                       fmtPct(s.budget_remaining)));

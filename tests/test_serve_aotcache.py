@@ -1,0 +1,371 @@
+"""The persistent executable cache (ISSUE 15): disk round trips keyed
+on the tracked_jit signature, honest invalidation across the
+environment-fingerprint matrix, corruption tolerance, LRU bounds, and
+the warm-restart integration contract — a rebuilt engine serves its
+first request with ZERO fresh XLA compiles and bit-equal outputs."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from spark_rapids_ml_tpu.obs import aotcache, xprof
+from spark_rapids_ml_tpu.obs.aotcache import (
+    ExecutableCache,
+    configure_executable_cache,
+    environment_fingerprint,
+    get_executable_cache,
+    signature_digest,
+)
+from spark_rapids_ml_tpu.obs.metrics import get_registry
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    """A configured process cache for the test, torn back down after
+    (other suites must keep the exact cache-off behavior)."""
+    path = str(tmp_path / "aot_cache")
+    configure_executable_cache(path)
+    yield path
+    configure_executable_cache(None)
+
+
+def _fresh_fn(label):
+    return xprof.tracked_jit(lambda x, w: x @ w + 1.0, label=label)
+
+
+def _compiles_total():
+    return sum(s["compiles"] for s in xprof.compile_stats().values())
+
+
+def _counter_total(name, **labels):
+    snap = get_registry().snapshot().get(name, {"samples": []})
+    return sum(
+        s["value"] for s in snap["samples"]
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def test_cache_disabled_by_default(monkeypatch):
+    monkeypatch.delenv(aotcache.CACHE_DIR_ENV, raising=False)
+    configure_executable_cache(None)
+    assert get_executable_cache() is None
+
+
+def test_round_trip_zero_fresh_compiles(cache_dir):
+    f = _fresh_fn("aot_round_trip")
+    x = np.ones((8, 4), np.float64)
+    w = np.ones((4, 2), np.float64)
+    out1 = np.asarray(f(x, w))
+    cache = get_executable_cache()
+    assert cache.stats()["store"] == 1
+    # "restart": forget the in-memory executables, count fresh compiles
+    f.clear_cache()
+    xprof.reset_compile_log()
+    out2 = np.asarray(f(x, w))
+    assert _compiles_total() == 0          # the disk hit owned it
+    assert cache.stats()["hit"] == 1
+    assert np.array_equal(out1, out2)
+
+
+def test_hit_and_miss_counters_and_audit_events(cache_dir):
+    from spark_rapids_ml_tpu.obs import spans as spans_mod
+
+    f = _fresh_fn("aot_counted")
+    x = np.ones((4, 4), np.float64)
+    w = np.ones((4, 4), np.float64)
+    miss0 = _counter_total("sparkml_serve_cache_total", event="miss")
+    hit0 = _counter_total("sparkml_serve_cache_total", event="hit")
+    f(x, w)                                # miss + store
+    f.clear_cache()
+    f(x, w)                                # hit
+    assert _counter_total("sparkml_serve_cache_total",
+                          event="miss") == miss0 + 1
+    assert _counter_total("sparkml_serve_cache_total",
+                          event="hit") == hit0 + 1
+    names = {e.name for e in spans_mod.get_recorder().events()}
+    assert "serve:cache:miss" in names
+    assert "serve:cache:store" in names
+    assert "serve:cache:hit" in names
+
+
+def test_signature_digest_distinguishes_shapes_and_label():
+    key_a = ("tree", (("arr", (8, 4), "float64", False, None),), ())
+    key_b = ("tree", (("arr", (16, 4), "float64", False, None),), ())
+    assert signature_digest("f", key_a) != signature_digest("f", key_b)
+    assert signature_digest("f", key_a) != signature_digest("g", key_a)
+    assert signature_digest("f", key_a) == signature_digest("f", key_a)
+
+
+def test_invalidation_matrix_both_ways(tmp_path):
+    """The honest-key satellite: a jaxlib bump, a different device
+    kind, or a flipped precision env MUST miss (counted as an
+    invalidation, stale file dropped); the unchanged fingerprint keeps
+    hitting."""
+    import jax
+
+    fp = environment_fingerprint()
+    writer = ExecutableCache(str(tmp_path), fingerprint=dict(fp))
+    f = jax.jit(lambda x: x * 2.0)
+    x = np.ones((4, 2), np.float32)
+    compiled = f.lower(x).compile()
+    key = ("sig", (("arr", (4, 2), "float32", False, None),), ())
+    assert writer.store("inv_fn", key, compiled)
+
+    # same fingerprint → HIT
+    same = ExecutableCache(str(tmp_path), fingerprint=dict(fp))
+    assert same.load("inv_fn", key) is not None
+
+    for field, value in (("jaxlib", "9.9.9"),
+                         ("device_kind", "TPU v9"),
+                         ("precision", "bf16"),
+                         ("x64", "flipped")):
+        # re-store (the invalidating load below drops the stale file)
+        assert writer.store("inv_fn", key, compiled)
+        stale_fp = dict(fp)
+        stale_fp[field] = value
+        reader = ExecutableCache(str(tmp_path), fingerprint=stale_fp)
+        inv0 = reader.stats()["invalidate"]
+        assert reader.load("inv_fn", key) is None, field
+        assert reader.stats()["invalidate"] == inv0 + 1, field
+        # ... and the stale entry was dropped from disk
+        assert reader.stats()["entries"] == 0, field
+
+
+def test_precision_env_is_part_of_the_live_fingerprint(monkeypatch):
+    monkeypatch.setenv(aotcache.PRECISION_ENV, "int8")
+    assert environment_fingerprint()["precision"] == "int8"
+    monkeypatch.delenv(aotcache.PRECISION_ENV)
+    assert environment_fingerprint()["precision"] == "native"
+
+
+def test_corrupt_entries_load_as_miss_never_raise(cache_dir):
+    """Truncated / bad-magic / garbage-pickle entries are a MISS with
+    ``sparkml_serve_cache_errors_total{reason}`` incremented — and the
+    next call recompiles and repairs the slot."""
+    f = _fresh_fn("aot_corrupt")
+    x = np.ones((8, 3), np.float64)
+    w = np.ones((3, 3), np.float64)
+    out1 = np.asarray(f(x, w))
+    cache = get_executable_cache()
+    [entry] = [os.path.join(cache_dir, n) for n in os.listdir(cache_dir)
+               if n.endswith(".aotx")]
+    blob = open(entry, "rb").read()
+    for corruption, reason in (
+        (blob[:6], "truncated"),
+        (b"NOTMAGIC" + blob[8:], "bad_magic"),
+        (blob[:len(aotcache._MAGIC) + 4] + b"{bad json"
+         + blob[len(aotcache._MAGIC) + 4 + 20:], None),
+        (blob[:-40], None),   # truncated payload → deserialize error
+    ):
+        with open(entry, "wb") as fh:
+            fh.write(corruption)
+        err0 = _counter_total("sparkml_serve_cache_errors_total")
+        f.clear_cache()
+        xprof.reset_compile_log()
+        out2 = np.asarray(f(x, w))       # corrupt → miss → recompile
+        assert np.array_equal(out1, out2)
+        assert _compiles_total() == 1
+        assert _counter_total(
+            "sparkml_serve_cache_errors_total") == err0 + 1
+        if reason is not None:
+            assert _counter_total("sparkml_serve_cache_errors_total",
+                                  reason=reason) >= 1
+        # the recompile re-stored a good entry for the next round
+        assert os.path.exists(entry)
+
+
+def test_lru_eviction_bounds_cache_size(tmp_path):
+    import jax
+
+    cache = ExecutableCache(str(tmp_path), max_bytes=1)
+    f = jax.jit(lambda x: x + 1)
+    for i, rows in enumerate((2, 3, 4)):
+        x = np.ones((rows, 2), np.float32)
+        compiled = f.lower(x).compile()
+        assert cache.store(f"lru_fn_{i}", ("k", rows), compiled)
+        time.sleep(0.01)  # distinct mtimes for deterministic ordering
+    stats = cache.stats()
+    # max_bytes=1: every store immediately evicts down to at most one
+    # survivor (the newest — eviction is oldest-mtime first)
+    assert stats["evict"] >= 2
+    assert stats["entries"] <= 1
+    names = os.listdir(str(tmp_path))
+    assert all("lru_fn_2" in n for n in names if n.endswith(".aotx"))
+
+
+def test_atomic_store_leaves_no_tmp_files(cache_dir):
+    f = _fresh_fn("aot_atomic")
+    f(np.ones((4, 2), np.float64), np.ones((2, 2), np.float64))
+    leftovers = [n for n in os.listdir(cache_dir) if ".tmp-" in n]
+    assert leftovers == []
+
+
+def test_prime_is_signature_identical_to_a_real_call(cache_dir):
+    """The abstract-prime contract the warm replay rides: priming with
+    a ShapeDtypeStruct (sharding-stamped) populates the SAME signature
+    a real staged batch resolves to — the real call then compiles
+    nothing."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    from spark_rapids_ml_tpu.serve import placement as placement_mod
+
+    dev = placement_mod.serving_devices(limit=1)[0]
+    f = _fresh_fn("aot_prime")
+    w = jax.device_put(jnp.zeros((4, 2), jnp.float64), dev)
+    spec = jax.ShapeDtypeStruct((8, 4), jnp.float64,
+                                sharding=SingleDeviceSharding(dev))
+    xprof.reset_compile_log()
+    assert f.prime(spec, w)
+    assert _compiles_total() == 1          # the prime owns the compile
+    x = jax.device_put(jnp.asarray(np.ones((8, 4)),
+                                   dtype=jnp.float64), dev)
+    np.asarray(f(x, w))
+    assert _compiles_total() == 1          # the real call added none
+
+
+def test_serving_program_prime_hook_compiles_without_execute():
+    from spark_rapids_ml_tpu import PCA
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(256, 12))
+    model = PCA().setK(4).fit(x)
+    prog = model.serving_transform_program()
+    assert prog is not None and prog.prime is not None
+    xprof.reset_compile_log()
+    assert prog.prime(64, 12)
+    primed = _compiles_total()
+    assert primed >= 1
+    # the real execution reuses the primed executable
+    out = prog.fetch(prog.run(prog.put(np.zeros((64, 12)))))
+    assert out.shape == (64, 4)
+    assert _compiles_total() == primed
+
+
+# -- the warm-restart integration contract (ISSUE 15 satellite) --------------
+
+
+def test_warm_restart_zero_fresh_compiles_bit_equal(tmp_path):
+    """fit → warm → snapshot manifest → kill the process state →
+    rebuild engine from manifest + cache → ZERO fresh compiles
+    (signature-counted) and bit-equal outputs vs the pre-restart
+    engine."""
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.io.persistence import save_pca_model
+    from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+
+    cache_path = str(tmp_path / "cache")
+    manifest = str(tmp_path / "manifest.json")
+    model_path = str(tmp_path / "pca_model")
+    configure_executable_cache(cache_path)
+    try:
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(512, 24))
+        model = PCA().setK(6).fit(x)
+        save_pca_model(model, model_path, overwrite=True)
+
+        registry = ModelRegistry(manifest_path=manifest)
+        registry.load("restart_pca", model_path)
+        engine = ServeEngine(registry, max_batch_rows=128,
+                             max_wait_ms=1.0)
+        engine.warmup("restart_pca")
+        before = engine.predict("restart_pca", x[:32])
+        engine.shutdown()
+
+        # the manifest recorded the warm ladder
+        entry = registry.resolve_entry("restart_pca")
+        assert entry.warmed_buckets
+        import json
+
+        doc = json.load(open(manifest))
+        persisted = doc["models"]["restart_pca"][0]
+        assert persisted["warmed_buckets"] == sorted(
+            entry.warmed_buckets)
+
+        # "kill the process": every in-memory executable is forgotten
+        xprof.clear_all_signature_caches()
+        xprof.reset_compile_log()
+
+        registry2 = ModelRegistry(manifest_path=manifest)
+        assert registry2.recovery_report_["recovered"] == [
+            "restart_pca@1"]
+        assert registry2.warm_entries() == [
+            ("restart_pca", 1, tuple(sorted(entry.warmed_buckets)))]
+        engine2 = ServeEngine(registry2, max_batch_rows=128,
+                              max_wait_ms=1.0)
+        report = engine2.warm_from_manifest()
+        assert report["warmed"] and not report["failed"]
+        after = engine2.predict("restart_pca", x[:32])
+        engine2.shutdown()
+
+        assert _compiles_total() == 0, xprof.compile_stats()
+        assert xprof.signature_count("pca_transform") == 0
+        np.testing.assert_array_equal(np.asarray(before),
+                                      np.asarray(after))
+    finally:
+        configure_executable_cache(None)
+
+
+# -- rule 14 fixtures --------------------------------------------------------
+
+
+def _checker():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_instrumentation as ci
+    finally:
+        sys.path.pop(0)
+    return ci
+
+
+def test_rule14_accepts_current_cache_and_autoscale():
+    ci = _checker()
+    for path in ci.CACHE_AUTOSCALE_FILES:
+        assert list(ci.check_cache_autoscale_audit(path)) == [], path
+
+
+def test_rule14_rejects_unaccounted_decisions(tmp_path):
+    ci = _checker()
+    bad = tmp_path / "bad_cache.py"
+    bad.write_text(
+        "class C:\n"
+        "    def load(self, key):\n"
+        "        return self._entries.get(key)  # REJECT\n"
+        "    def store(self, key, value):\n"
+        "        self._entries[key] = value  # REJECT\n"
+        "    def _evict_to_cap(self):\n"
+        "        self._entries.clear()  # REJECT\n"
+        "    def tick(self):\n"
+        "        self.engine.scale_replicas(2)  # REJECT\n"
+        "    def unrelated(self):\n"
+        "        return 1  # fine: not a decision path\n"
+    )
+    offenders = list(ci.check_cache_autoscale_audit(str(bad)))
+    assert len(offenders) == 4
+    assert all("rule 14" in why for _ln, why in offenders)
+
+
+def test_rule14_accepts_accounted_decisions(tmp_path):
+    ci = _checker()
+    good = tmp_path / "good_cache.py"
+    good.write_text(
+        "class C:\n"
+        "    def load(self, key):\n"
+        "        self._count('hit')\n"
+        "        return self._entries.get(key)\n"
+        "    def store(self, key, value):\n"
+        "        self._m.inc(event='store')\n"
+        "        self._entries[key] = value\n"
+        "    def _evict_to_cap(self):\n"
+        "        record_event('serve:cache:evict', 0, 1)\n"
+        "    def scale_up(self):\n"
+        "        with span('serve:autoscale:scale_up'):\n"
+        "            self.engine.scale_replicas(2)\n"
+    )
+    assert list(ci.check_cache_autoscale_audit(str(good))) == []

@@ -1,0 +1,466 @@
+"""The autoscaling replica controller (ISSUE 15): hysteresis decision
+matrix over injected clocks/signals, min/max bounds, the anti-flap
+cooldown, live engine scale-up/scale-down with drain-never-drop
+retirement, un-retire revival, and the load-aware small-request
+concentration satellite in ``DevicePlacer``."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.metrics import get_registry
+from spark_rapids_ml_tpu.serve import placement as placement_mod
+from spark_rapids_ml_tpu.serve.autoscale import (
+    HOLD,
+    SCALE_DOWN,
+    SCALE_UP,
+    AutoscaleController,
+)
+from spark_rapids_ml_tpu.serve.placement import (
+    RETIRED,
+    DevicePlacer,
+    Replica,
+    ReplicaHealth,
+    ReplicaSet,
+)
+
+
+# -- controller decision matrix (stub engine, injected clock+signals) --------
+
+
+class _StubEngine:
+    """Just enough engine for the controller: a replica-scale actuator
+    plus the placer surface the signal reader touches."""
+
+    def __init__(self, base=4, scale=1):
+        self._scale = scale
+        self.scaled_to = []
+        self.reaps = 0
+        self.placer = SimpleNamespace(
+            base_device_count=lambda: base,
+            target_count=None,
+            active_devices=lambda: [],
+        )
+
+    def replica_scale(self):
+        return self._scale
+
+    def scale_replicas(self, target):
+        self._scale = target
+        self.scaled_to.append(target)
+        return {"target": target, "resized": {}}
+
+    def reap_retired(self):
+        self.reaps += 1
+        return 0
+
+
+QUIET = {"queue_wait_s": 0.0, "shed_level": 0, "burn": 0.0,
+         "occupancy": 0.0, "depth_frac": 0.0}
+HOT = {"queue_wait_s": 0.5, "shed_level": 0, "burn": 0.0,
+       "occupancy": 0.0, "depth_frac": 0.5}
+COLD = {"queue_wait_s": 0.0, "shed_level": 0, "burn": 0.0,
+        "occupancy": 0.1, "depth_frac": 0.0}
+
+
+def _controller(engine, signals, now, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_hold_s", 1.0)
+    kw.setdefault("down_hold_s", 5.0)
+    kw.setdefault("cooldown_s", 2.0)
+    return AutoscaleController(
+        engine, signals_fn=lambda: dict(signals[0]),
+        clock=lambda: now[0], **kw)
+
+
+def test_scale_up_waits_for_the_hold_then_fires():
+    engine = _StubEngine()
+    now = [0.0]
+    signals = [HOT]
+    ctl = _controller(engine, signals, now)
+    assert ctl.evaluate_once() == HOLD       # hot observed, hold starts
+    now[0] = 0.5
+    assert ctl.evaluate_once() == HOLD       # still inside up_hold
+    now[0] = 1.1
+    assert ctl.evaluate_once() == SCALE_UP
+    assert engine.replica_scale() == 2
+    assert engine.reaps >= 3                 # the reaper rides every tick
+
+
+def test_hold_resets_when_the_signal_clears():
+    engine = _StubEngine()
+    now = [0.0]
+    signals = [dict(HOT)]
+    ctl = _controller(engine, signals, now)
+    ctl.evaluate_once()
+    now[0] = 0.8
+    signals[0] = dict(QUIET)                 # neither hot nor cold
+    ctl.evaluate_once()
+    signals[0] = dict(HOT)
+    now[0] = 1.5                             # 1.5s total, but hold reset
+    assert ctl.evaluate_once() == HOLD
+    now[0] = 2.6
+    assert ctl.evaluate_once() == SCALE_UP
+
+
+def test_scale_down_needs_the_longer_hold_and_floor():
+    engine = _StubEngine(scale=3)
+    now = [0.0]
+    signals = [COLD]
+    ctl = _controller(engine, signals, now)
+    assert ctl.evaluate_once() == HOLD
+    now[0] = 5.1
+    assert ctl.evaluate_once() == SCALE_DOWN
+    assert engine.replica_scale() == 2
+    # floor: repeated cold at min never goes below
+    engine._scale = 1
+    now[0] = 20.0
+    ctl.evaluate_once()
+    now[0] = 30.0
+    assert ctl.evaluate_once() == HOLD
+    assert engine.replica_scale() == 1
+
+
+def test_max_bound_holds():
+    engine = _StubEngine(scale=4)
+    now = [0.0]
+    ctl = _controller(engine, [HOT], now)
+    now[0] = 5.0
+    assert ctl.evaluate_once() == HOLD       # already at max
+    assert engine.replica_scale() == 4
+
+
+def test_cooldown_is_the_anti_flap_floor():
+    """Oscillating hot/cold faster than the holds must not produce
+    actions spaced closer than the cooldown — the chaos drill's
+    autoscale_flap contract, driven here with zero sleeps."""
+    engine = _StubEngine()
+    now = [0.0]
+    signals = [dict(HOT)]
+    ctl = _controller(engine, signals, now, up_hold_s=0.2,
+                      down_hold_s=0.2, cooldown_s=3.0)
+    action_times = []
+    for step in range(120):
+        now[0] = step * 0.25
+        signals[0] = dict(HOT) if (step // 4) % 2 == 0 else dict(COLD)
+        if ctl.evaluate_once() in (SCALE_UP, SCALE_DOWN):
+            action_times.append(now[0])
+    assert action_times, "the oscillation never drove an action"
+    gaps = [b - a for a, b in zip(action_times, action_times[1:])]
+    assert all(g >= 3.0 for g in gaps), gaps
+
+
+def test_decisions_are_counted_audited_and_historied():
+    engine = _StubEngine()
+    now = [0.0]
+    ctl = _controller(engine, [HOT], now)
+
+    def _count(decision):
+        snap = get_registry().snapshot()[
+            "sparkml_serve_autoscale_total"]
+        return sum(s["value"] for s in snap["samples"]
+                   if s["labels"]["decision"] == decision)
+
+    ups0 = _count(SCALE_UP)
+    assert ctl.evaluate_once() == HOLD       # hot hold starts
+    now[0] = 2.0
+    assert ctl.evaluate_once() == SCALE_UP
+    assert _count(SCALE_UP) == ups0 + 1
+    history = ctl.decision_history()
+    assert history[-1]["decision"] == SCALE_UP
+    assert history[-1]["from"] == 1 and history[-1]["to"] == 2
+    assert "queue_wait_s" in history[-1]["signals"]
+    names = [e.name for e in spans_mod.get_recorder().events()]
+    assert "serve:autoscale:scale_up" in names
+    snap = ctl.snapshot()
+    assert snap["replicas"] == 2
+    assert snap["thresholds"]["cooldown_s"] == 2.0
+    assert snap["history"]
+
+
+def test_hot_reasons_cover_every_signal():
+    engine = _StubEngine()
+    now = [0.0]
+    ctl = _controller(engine, [QUIET], now)
+    assert ctl._is_hot({**QUIET, "queue_wait_s": 9}) == ["queue_wait"]
+    assert ctl._is_hot({**QUIET, "shed_level": 1}) == ["shed_level"]
+    assert ctl._is_hot({**QUIET, "burn": 99.0}) == ["slo_burn"]
+    assert ctl._is_hot({**QUIET, "occupancy": 0.95}) == ["occupancy"]
+    assert ctl._is_hot(QUIET) == []
+    assert ctl._is_cold(COLD)
+    assert not ctl._is_cold({**COLD, "occupancy": 0.9})
+
+
+def test_background_loop_starts_and_stops():
+    engine = _StubEngine()
+    ctl = AutoscaleController(
+        engine, signals_fn=lambda: dict(QUIET), interval_s=0.01,
+        min_replicas=1, max_replicas=4)
+    ctl.start()
+    with pytest.raises(RuntimeError):
+        ctl.start()
+    deadline = time.monotonic() + 5.0
+    while engine.reaps == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    ctl.stop()
+    assert not ctl.running
+    assert engine.reaps > 0
+
+
+def test_startup_clamps_engine_into_bounds():
+    engine = _StubEngine(base=8, scale=8)
+    AutoscaleController(engine, signals_fn=lambda: dict(QUIET),
+                        min_replicas=1, max_replicas=2)
+    assert engine.replica_scale() == 2
+    assert engine.scaled_to == [2]
+
+
+# -- live engine scaling -----------------------------------------------------
+
+
+@pytest.fixture
+def scaled_engine():
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(512, 16))
+    model = PCA().setK(4).fit(x)
+    registry = ModelRegistry()
+    registry.register("scale_pca", model)
+    placer = DevicePlacer(
+        devices=placement_mod.serving_devices(limit=4))
+    placer.set_target(1)
+    engine = ServeEngine(registry, max_batch_rows=128, max_wait_ms=1.0,
+                         placement=placer, pipeline_depth=2)
+    engine.warmup("scale_pca")
+    yield engine, x
+    engine.shutdown()
+
+
+def test_engine_scale_up_adds_replicas_bit_equal(scaled_engine):
+    engine, x = scaled_engine
+    before = engine.predict("scale_pca", x[:32])
+    rset = engine._replicas[("scale_pca", 1)]
+    assert rset.active_count() == 1
+    report = engine.scale_replicas(3)
+    assert report["target"] == 3
+    assert report["resized"]["scale_pca@1"] == {"added": 2,
+                                                "retired": 0}
+    assert rset.active_count() == 3
+    for _ in range(6):
+        np.testing.assert_array_equal(
+            np.asarray(engine.predict("scale_pca", x[:32])),
+            np.asarray(before))
+
+
+def test_engine_scale_down_retires_tail_never_primary(scaled_engine):
+    engine, x = scaled_engine
+    engine.predict("scale_pca", x[:16])
+    engine.scale_replicas(3)
+    rset = engine._replicas[("scale_pca", 1)]
+    engine.scale_replicas(1)
+    assert rset.active_count() == 1
+    assert not rset.primary.retired
+    assert all(r.retired for r in rset.replicas[1:])
+    assert all(r.state() == RETIRED for r in rset.replicas[1:])
+    # retired replicas publish state 0 — a deliberate scale-down must
+    # never read as degradation to the serve_replica_degraded detector
+    snap = get_registry().snapshot()["sparkml_serve_replica_state"]
+    values = {s["labels"]["device"]: s["value"]
+              for s in snap["samples"]
+              if s["labels"]["model"] == "scale_pca"}
+    assert all(v == 0 for v in values.values()), values
+    # traffic keeps landing on the survivor
+    out = engine.predict("scale_pca", x[:16])
+    assert np.asarray(out).shape == (16, 4)
+
+
+def test_reap_closes_drained_retired_batchers_then_revive(scaled_engine):
+    engine, x = scaled_engine
+    engine.predict("scale_pca", x[:16])
+    engine.scale_replicas(2)
+    rset = engine._replicas[("scale_pca", 1)]
+    tail = rset.replicas[1]
+    engine.scale_replicas(1)
+    # the scale-down's own reap already closed the idle tail batcher
+    assert tail.retired
+    assert tail.batcher.closed()
+    # scale back up: the retired replica revives with a fresh batcher
+    # around the SAME staged program spec
+    report = engine.scale_replicas(2)
+    assert report["resized"]["scale_pca@1"] == {"added": 1,
+                                                "retired": 0}
+    assert not tail.retired
+    assert not tail.batcher.closed()
+    out = engine.predict("scale_pca", x[:32])
+    assert np.asarray(out).shape == (32, 4)
+
+
+def test_retired_replica_drains_queued_work_never_drops(scaled_engine):
+    """Scale-down with work still queued: the retired replica's worker
+    serves its queue (the reaper waits), and the queued requests all
+    complete."""
+    engine, x = scaled_engine
+    engine.predict("scale_pca", x[:16])
+    engine.scale_replicas(2)
+    rset = engine._replicas[("scale_pca", 1)]
+    tail = rset.replicas[1]
+    # queue work directly on the tail replica's batcher, then retire it
+    reqs = [tail.batcher.submit(x[i:i + 4]) for i in range(0, 20, 4)]
+    tail.retired = True
+    assert engine.reap_retired() == 0       # still draining: not closed
+    outs = [r.wait(30.0) for r in reqs]
+    assert all(np.asarray(o).shape == (4, 4) for o in outs)
+    deadline = time.monotonic() + 10.0
+    while engine.reap_retired() == 0 and time.monotonic() < deadline:
+        if tail.batcher.closed():
+            break
+        time.sleep(0.01)
+    assert tail.batcher.closed()
+
+
+def test_scale_is_clamped_to_device_ceiling(scaled_engine):
+    engine, _x = scaled_engine
+    assert engine.scale_replicas(99)["target"] == 4
+    assert engine.scale_replicas(0)["target"] == 1
+
+
+def test_sync_path_models_never_resize():
+    from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+
+    class HostModel:
+        def transform(self, m):
+            return np.asarray(m)[:, :2].copy()
+
+        def getOutputCol(self):
+            return "out"
+
+    registry = ModelRegistry()
+    registry.register("host_m", HostModel())
+    engine = ServeEngine(registry, max_batch_rows=64, max_wait_ms=1.0)
+    try:
+        engine.predict("host_m", np.ones((4, 4)))
+        report = engine.scale_replicas(4)
+        assert report["resized"] == {}
+        rset = engine._replicas[("host_m", 1)]
+        assert len(rset.replicas) == 1
+    finally:
+        engine.shutdown()
+
+
+def test_engine_autoscale_snapshot_surface(scaled_engine):
+    engine, _x = scaled_engine
+    assert engine.autoscale_snapshot() == {"enabled": False}
+    ctl = AutoscaleController(engine, signals_fn=lambda: dict(QUIET),
+                              min_replicas=1, max_replicas=4)
+    engine.attach_autoscale(ctl)
+    doc = engine.autoscale_snapshot()
+    assert doc["enabled"] is True
+    assert doc["min"] == 1 and doc["max"] == 4
+    assert doc["replicas"] == engine.replica_scale()
+
+
+# -- the concentration satellite (DevicePlacer) ------------------------------
+
+
+class _StubBatcher:
+    def __init__(self, load=0, label=None):
+        self._load = load
+        self.device_label = label
+
+    def load(self):
+        return self._load
+
+    def depth(self):
+        return self._load
+
+    def dead(self):
+        return False
+
+
+def _stub_set(name, loads):
+    replicas = []
+    for i, load in enumerate(loads):
+        replicas.append(Replica(
+            None, f"dev{i}", _StubBatcher(load, label=f"dev{i}"),
+            ReplicaHealth(failure_threshold=2, cooldown_seconds=5.0)))
+    return ReplicaSet(name, 1, replicas)
+
+
+def test_small_requests_concentrate_on_lowest_index():
+    placer = DevicePlacer(devices=[], concentrate=True,
+                          concentrate_spill_load=3)
+    rset = _stub_set("conc_m", [1, 0, 0, 0])
+    # least-loaded would pick dev1/2/3; the small-request tier sticks
+    # to dev0 (load 1 < spill 3) so the coalescer sees dense batches
+    for _ in range(5):
+        assert placer.pick(rset, small=True).label == "dev0"
+
+
+def test_small_requests_spill_past_the_threshold():
+    placer = DevicePlacer(devices=[], concentrate=True,
+                          concentrate_spill_load=2)
+    rset = _stub_set("spill_m", [5, 1, 0, 0])
+    # dev0 is past the spill bar → the tier concentrates on dev1
+    assert placer.pick(rset, small=True).label == "dev1"
+    # everyone past the bar → plain least-loaded takes over
+    rset2 = _stub_set("spill_m2", [5, 4, 3, 6])
+    assert placer.pick(rset2, small=True).label == "dev2"
+
+
+def test_large_requests_keep_least_loaded():
+    placer = DevicePlacer(devices=[], concentrate=True)
+    rset = _stub_set("large_m", [1, 0, 2, 3])
+    assert placer.pick(rset, small=False).label == "dev1"
+
+
+def test_concentrate_kill_switch():
+    placer = DevicePlacer(devices=[], concentrate=False)
+    rset = _stub_set("kill_m", [1, 0, 2, 3])
+    assert placer.pick(rset, small=True).label == "dev1"
+
+
+def test_probe_outranks_concentration():
+    now = [0.0]
+    placer = DevicePlacer(devices=[], concentrate=True)
+    replicas = []
+    for i in range(2):
+        replicas.append(Replica(
+            None, f"dev{i}", _StubBatcher(0, label=f"dev{i}"),
+            ReplicaHealth(failure_threshold=2, cooldown_seconds=1.0,
+                          clock=lambda: now[0])))
+    rset = ReplicaSet("probe_conc_m", 1, replicas)
+    rset.replicas[1].health.note_failure()
+    rset.replicas[1].health.note_failure()
+    now[0] = 2.0
+    # the half-open probe must carry the next request even though the
+    # small-request tier would concentrate on dev0
+    assert placer.pick(rset, small=True).label == "dev1"
+
+
+def test_retired_replicas_never_picked():
+    placer = DevicePlacer(devices=[])
+    rset = _stub_set("retired_m", [0, 0, 0])
+    rset.replicas[0].retired = True
+    rset.replicas[2].retired = True
+    for _ in range(4):
+        assert placer.pick(rset).label == "dev1"
+    assert rset.active_count() == 1
+    assert rset.replicas[0].snapshot()["state"] == RETIRED
+
+
+def test_placer_target_clamps():
+    devices = placement_mod.serving_devices(limit=4)
+    placer = DevicePlacer(devices=devices)
+    assert placer.set_target(99) == 4
+    assert len(placer.active_devices()) == 4
+    assert placer.set_target(2) == 2
+    assert len(placer.active_devices()) == 2
+    placer.set_target(None)
+    assert len(placer.active_devices()) == 4

@@ -399,13 +399,21 @@ def test_engine_drains_faulted_replica_and_reenters(pca_model):
     model, x = pca_model
     reg = ModelRegistry()
     reg.register("drain_pca", model, buckets=(16, 32))
+    # retries must cover the drain threshold (3): with concentration
+    # every attempt of the FIRST request lands the same sick replica
+    # until its health trips, so the surviving attempt is the fourth
     engine = ServeEngine(reg, max_batch_rows=32, max_wait_ms=1.0,
                          buckets=(16, 32), replicas=3,
-                         retries=2, backoff_ms=2)
+                         retries=3, backoff_ms=2)
     try:
         engine.warmup("drain_pca")
         rset = engine._replicas[("drain_pca", 1)]
-        victim = rset.replicas[1]
+        # the victim is replica 0: the ISSUE 15 small-request
+        # concentration routes the idle-tier 4-row requests below to
+        # the lowest-index lightly-loaded replica, so a fault targeted
+        # anywhere else would never fire on this serial traffic (the
+        # same spread lesson PR 13's rotation fixed, inverted)
+        victim = rset.replicas[0]
         # tight cooldown so the re-entry leg needs no long sleep
         victim.health.cooldown_seconds = 0.3
         spec = fault_plane().inject("drain_pca", "raise", count=None,
