@@ -574,6 +574,7 @@ def main():
 
     _rollout_demo(x)
     _coldstart_demo(x)
+    _costs_demo(x)
 
 
 def _coldstart_demo(x):
@@ -726,6 +727,64 @@ def _rollout_demo(x):
     print("  post-rollback: 10/10 alias requests served by the "
           "incumbent (the armed fault targets only v2)")
     engine.shutdown()
+
+
+def _costs_demo(x):
+    """The closing number: the per-model cost attribution plane
+    (obs/accounting.py). Two models share the engine — one hot, one
+    idle after a brief burst — and the LIVE ``/debug/costs`` rollup is
+    read back over the wire: accounted HBM residency by component,
+    device-seconds reconciled against devmon at the same batch seam,
+    and the ranked cold-model report a tiering controller would evict
+    by."""
+    import json
+    import urllib.request
+
+    from spark_rapids_ml_tpu.serve import start_serve_server
+
+    print("\n== per-model cost attribution: GET /debug/costs ==")
+    registry = ModelRegistry()
+    registry.register("hot_embedder", PCA().setK(8).fit(x))
+    registry.register("idle_embedder", PCA().setK(8).fit(x))
+    engine = ServeEngine(registry, max_batch_rows=128, max_wait_ms=2)
+    server = start_serve_server(engine)
+    try:
+        engine.warmup("hot_embedder")
+        engine.warmup("idle_embedder")
+        # one opening burst each, then only the hot model keeps serving
+        for name in ("hot_embedder", "idle_embedder"):
+            for i in range(3):
+                engine.predict(name, x[i * 32:(i + 1) * 32])
+        for i in range(60):
+            engine.predict("hot_embedder", x[i * 16:i * 16 + 24])
+        time.sleep(0.3)  # let the last completions land on both meters
+
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/debug/costs", timeout=30).read())
+        print(f"  live rollup from {base}/debug/costs:")
+        for name, m in sorted(doc["models"].items()):
+            hbm = m["hbm_bytes"]
+            print(f"    {name:<14} hbm {m['hbm_total_bytes']:>6} B "
+                  f"(weights {hbm['weights']}, reserve {hbm['reserve']}, "
+                  f"executables {hbm['executables']})  "
+                  f"device {m['device_seconds'] * 1000:7.1f} ms  "
+                  f"rows {m['rows']:>5}  ewma {m['ewma_rps']:8.1f} r/s  "
+                  f"last hit {m['last_hit_age_seconds']:.1f}s ago")
+        rec = doc["reconcile"]
+        print(f"  reconcile vs devmon (same seam, independent meter): "
+              f"verdict={rec['verdict']}, worst drift "
+              f"{rec['worst_drift_ratio']:.4f} "
+              f"(tolerance {rec['tolerance']})")
+        print("  cold-model report (coldest first — the eviction order "
+              "a tiering controller reads):")
+        for row in doc["cold_report"]:
+            print(f"    {row['model']:<14} score {row['cold_score']:12.1f}"
+                  f"  ({row['resident_bytes']} B resident, "
+                  f"{row['ewma_rps']:.1f} r/s)")
+    finally:
+        server.shutdown()
+        engine.shutdown()
 
 
 def get_recorder_events():

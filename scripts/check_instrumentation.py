@@ -731,6 +731,52 @@ def check_cache_autoscale_audit(path: str):
                    "capacity drift (rule 14)")
 
 
+# rule 15: the resource ledger (obs/accounting.py) is the number the
+# tiering/eviction and predictive-autoscaling controllers will trust.
+# A ledger mutation that leaves no metrics trail is a ledger that can
+# silently diverge from the devices — every charge/release/reconcile
+# path must announce itself.
+ACCOUNTING_FILE = os.path.join(
+    REPO, "spark_rapids_ml_tpu", "obs", "accounting.py"
+)
+_LEDGER_MUTATION_PREFIXES = ("charge", "release", "reconcile",
+                             "retire", "revive", "note")
+# same sanctioned spellings as rule 14: a counter .inc / audit
+# record_event/span directly, or a module counting helper
+_LEDGER_ACCOUNTING = frozenset({"inc", "record_event", "span",
+                                "_count", "_count_error", "_audit"})
+
+
+def check_ledger_audit(path: str):
+    """Rule 15: yield (lineno, description) for every silent ledger
+    mutation path in the resource-accounting module.
+
+    A mutation path is any function DEF whose name starts with
+    ``charge``/``release``/``reconcile``/``retire``/``revive``/``note``
+    (underscore-insensitive — ``_charge_attribution`` counts); the same
+    function must carry a counter ``.inc(...)``, an audit
+    ``record_event``/``span``, or a module accounting helper."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        bare = node.name.lstrip("_")
+        if not bare.startswith(_LEDGER_MUTATION_PREFIXES):
+            continue
+        accounts = any(
+            isinstance(child, ast.Call)
+            and _call_name(child) in _LEDGER_ACCOUNTING
+            for child in ast.walk(node)
+        )
+        if not accounts:
+            yield (node.lineno,
+                   f"ledger mutation path {node.name}() without a "
+                   "counter .inc(...), audit record_event/span, or "
+                   "accounting helper in the same function — a silent "
+                   "ledger mutation is a cost number nobody can "
+                   "cross-check against the devices (rule 15)")
+
+
 # rule 11: the wire boundary — server body decoding must route through
 # serve/wire.py, whose decoders must record the parse-phase latency.
 SERVER_FILE = os.path.join(
@@ -1003,6 +1049,10 @@ def main() -> int:
         rel = os.path.relpath(path, REPO)
         for lineno, why in check_cache_autoscale_audit(path):
             offenders.append(f"{rel}:{lineno} {why}")
+    if os.path.exists(ACCOUNTING_FILE):
+        rel = os.path.relpath(ACCOUNTING_FILE, REPO)
+        for lineno, why in check_ledger_audit(ACCOUNTING_FILE):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -1028,7 +1078,8 @@ def main() -> int:
         f"alias promote/rollback/abort path audit-spanned or "
         f"decision-counted; {len(cache_files)} cache/autoscale "
         f"module(s) with every hit/miss/evict/invalidate and "
-        f"scale-up/scale-down decision counted or audit-spanned"
+        f"scale-up/scale-down decision counted or audit-spanned; "
+        f"cost-ledger mutation paths all counted or audit-spanned"
     )
     return 0
 

@@ -605,6 +605,242 @@ def run_ramp_phase() -> int:
     return 0
 
 
+ACCOUNTING_CHILD_PREFIX = "ACCOUNTING_CHILD_RESULT "
+
+
+def accounting_child() -> int:
+    """The cost-attribution phase (own process — forced 2 host devices):
+    three PCA models at 2 replicas each behind the REAL HTTP server,
+    driven with a Zipf-weighted mix (hot takes most of the traffic, mid
+    a trickle, cold goes quiet after a brief opening burst). What the
+    parent judges from this child's output:
+
+    * the ledger's summed per-model device-seconds RECONCILE against
+      the independent devmon counter (both meters ride the same batch-
+      completion seam, so drift beyond the documented tolerance means
+      an attribution bug, not noise);
+    * the ``/debug/costs`` cold-model report ranks the idle model
+      colder than the hot one — resident bytes with no traffic is
+      exactly what tiering wants surfaced;
+    * scale-down releases accounted residency: after the soak the hot
+      model drops to 1 replica, the reap moves the retired replica's
+      weights bytes into the ``reserve`` component (the program is
+      RETAINED for zero-cold-start revival, not freed)."""
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import (
+        ModelRegistry,
+        ServeEngine,
+        start_serve_server,
+    )
+
+    soak_s = _env_float("SPARKML_LOAD_ACCT_SECONDS", 10.0)
+    n_features = _env_int("SPARKML_LOAD_FEATURES", 16)
+    k = _env_int("SPARKML_LOAD_K", 8)
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(2048, n_features))
+    registry = ModelRegistry()
+    models = ("acct_hot_pca", "acct_mid_pca", "acct_cold_pca")
+    for name in models:
+        registry.register(name, PCA().setK(k).fit(x))
+    engine = ServeEngine(registry, max_batch_rows=256, max_wait_ms=2.0,
+                         max_queue_depth=256)
+    for name in models:
+        engine.warmup(name)
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    # opening burst: every model takes a little traffic, so the cold
+    # model has real rows on the meter — "cold" must mean went-idle
+    # (age + ewma), not never-seen
+    for name in models:
+        burst = TenantLoad(base, name, x, tenant="acct",
+                           priority="interactive", threads=2,
+                           pace_rps_per_thread=0.0, rows_lo=16,
+                           rows_hi=64, seed=7)
+        burst.run(1.0)
+    # Zipf-weighted soak: hot closed-loop, mid paced at a trickle, cold
+    # silent — the 1/rank^s shape collapsed onto three tiers
+    hot = TenantLoad(base, "acct_hot_pca", x, tenant="acct",
+                     priority="interactive", threads=6,
+                     pace_rps_per_thread=0.0, rows_lo=16, rows_hi=96,
+                     seed=8)
+    mid = TenantLoad(base, "acct_mid_pca", x, tenant="acct",
+                     priority="interactive", threads=2,
+                     pace_rps_per_thread=4.0, rows_lo=8, rows_hi=32,
+                     seed=9)
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=hot.run, args=(soak_s,), daemon=True),
+        threading.Thread(target=mid.run, args=(soak_s,), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(soak_s + 60.0)
+    wall = time.monotonic() - t0
+    # let in-flight batches complete so both meters stop moving, then
+    # read the rollup over the wire — the endpoint under test
+    time.sleep(1.0)
+    costs = _get_json(base, "/debug/costs")
+
+    # scale-down leg: hot model to 1 replica, reap, re-read residency
+    weights_before = {
+        m: costs.get("models", {}).get(m, {}).get(
+            "hbm_bytes", {}).get("weights", 0)
+        for m in models
+    }
+    # scale_replicas reaps drained retirees itself; the loop only
+    # covers replicas whose queues were still draining at that instant
+    scale_report = engine.scale_replicas(1)
+    retired = sum(d.get("retired", 0)
+                  for d in scale_report.get("resized", {}).values())
+    reap_deadline = time.monotonic() + 20.0
+    while time.monotonic() < reap_deadline:
+        engine.reap_retired()
+        if engine.replica_scale() == 1:
+            break
+        time.sleep(0.25)
+    costs_after = _get_json(base, "/debug/costs")
+    hot_after = costs_after.get("models", {}).get(
+        "acct_hot_pca", {}).get("hbm_bytes", {})
+
+    server.shutdown()
+    engine.shutdown()
+    from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+
+    tsdb_mod.get_sampler().stop()
+    time.sleep(1.0)
+
+    hot_stats = hot.stats(wall)
+    mid_stats = mid.stats(wall)
+    # live replicas only — synthetic rows like "(sharded)" / "(aot)"
+    # must not satisfy the >= 2-replica gate
+    replica_counts = {
+        m: sum(1 for key in
+               costs.get("models", {}).get(m, {}).get("replicas", {})
+               if not key.startswith("("))
+        for m in models
+    }
+    result = {
+        "devices": 2,
+        "soak_seconds": wall,
+        "hot_served_rows_per_sec": hot_stats["served_rows_per_sec"],
+        "mid_served_rows_per_sec": mid_stats["served_rows_per_sec"],
+        "hot_availability": hot_stats["availability"],
+        "replica_counts": replica_counts,
+        "reconcile": costs.get("reconcile", {}),
+        "cold_report": costs.get("cold_report", []),
+        "models": {
+            m: {key: doc.get(key) for key in
+                ("hbm_total_bytes", "device_seconds", "rows",
+                 "ewma_rps", "last_hit_age_seconds")}
+            for m, doc in costs.get("models", {}).items()
+        },
+        "weights_before": weights_before,
+        "hot_weights_after": hot_after.get("weights", -1),
+        "hot_reserve_after": hot_after.get("reserve", -1),
+        "retired": retired,
+    }
+    sys.stdout.write(ACCOUNTING_CHILD_PREFIX + json.dumps(result) + "\n")
+    sys.stdout.flush()
+    return 0
+
+
+def run_accounting_phase() -> int:
+    """Parent leg of the cost-attribution phase: spawn the 2-device
+    child, judge the gates, emit the sentinel record. Gates:
+
+    * ledger-vs-devmon reconciliation verdict ``ok`` with worst drift
+      within the documented tolerance
+      (``SPARK_RAPIDS_ML_TPU_OBS_RECONCILE_TOL``, default 5%), at
+      least one model over the attribution floor;
+    * the cold-model report ranks the idle model colder than the hot
+      one under the Zipf mix;
+    * every model ran >= 2 replicas, and the scale-down reap moved the
+      hot model's retired weights bytes into ``reserve`` (released
+      from the live-weights component, retained for revival)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["SPARKML_LOAD_PHASE"] = "accounting_child"
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    env["XLA_FLAGS"] = bench_common.force_device_count_flags(2)
+    env.pop("SPARK_RAPIDS_ML_TPU_SERVE_REPLICAS", None)
+    bench_common.log("load_harness accounting: child at 2 device(s), "
+                     "Zipf hot/mid/cold mix across 3 models")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    result = bench_common.prefixed_result(proc.stdout,
+                                          ACCOUNTING_CHILD_PREFIX)
+    if result is None:
+        bench_common.log(
+            f"load_harness accounting FAIL: child produced no result "
+            f"(rc={proc.returncode}): {proc.stderr[-2000:]}")
+        return 1
+    reconcile = result["reconcile"]
+    worst_drift = float(reconcile.get("worst_drift_ratio", 1.0))
+    tolerance = float(reconcile.get("tolerance", 0.0))
+    cold_rank = {doc["model"]: i
+                 for i, doc in enumerate(result["cold_report"])}
+    record = {
+        "bench": "load_harness_accounting",
+        "metric": "load_harness_accounting_worst_drift",
+        "value": worst_drift,
+        "unit": ("worst per-model relative drift between ledger and "
+                 "devmon device-seconds at the batch-completion seam"),
+        "higher_is_better": False,
+        "platform": "cpu",
+        "device_kind": "cpu",
+        **{key: result[key] for key in
+           ("devices", "soak_seconds", "replica_counts", "reconcile",
+            "cold_report", "models", "weights_before",
+            "hot_weights_after", "hot_reserve_after", "retired",
+            "hot_served_rows_per_sec", "hot_availability")},
+    }
+    bench_common.emit_record(record, include_metrics=False)
+    failures = []
+    if reconcile.get("verdict") != "ok":
+        failures.append(
+            f"reconcile verdict {reconcile.get('verdict')!r} "
+            f"(worst drift {worst_drift:.4f} vs tolerance "
+            f"{tolerance:.4f})")
+    if int(reconcile.get("models_checked", 0)) < 1:
+        failures.append("no model crossed the reconcile attribution "
+                        "floor — the soak metered nothing")
+    if cold_rank.get("acct_cold_pca", 99) > cold_rank.get(
+            "acct_hot_pca", -1):
+        failures.append(
+            f"cold report ranked hot before idle: {cold_rank}")
+    thin = {m: n for m, n in result["replica_counts"].items() if n < 2}
+    if thin:
+        failures.append(f"models below 2 replicas during the soak: "
+                        f"{thin}")
+    hot_before = int(result["weights_before"].get("acct_hot_pca", 0))
+    if not (0 <= result["hot_weights_after"] < hot_before):
+        failures.append(
+            f"scale-down did not release accounted weights bytes: "
+            f"{hot_before} -> {result['hot_weights_after']}")
+    if result["hot_reserve_after"] <= 0:
+        failures.append(
+            "reaped replica's bytes did not land in the reserve "
+            "component — the retained program would be invisible")
+    if failures:
+        bench_common.log("load_harness accounting FAIL: "
+                         + "; ".join(failures))
+        return 1
+    bench_common.log(
+        f"load_harness accounting PASS: worst drift "
+        f"{worst_drift:.4f} (tolerance {tolerance:.4f}, "
+        f"{reconcile.get('models_checked')} model(s) checked), cold "
+        f"report ranks {result['cold_report'][0]['model']} coldest, "
+        f"hot weights {hot_before} -> {result['hot_weights_after']} "
+        f"bytes with {result['hot_reserve_after']} in reserve after "
+        f"scale-down")
+    return 0
+
+
 def main() -> int:
     if os.environ.get("SPARKML_LOAD_PHASE") == "device_capacity_child":
         return device_capacity_child()
@@ -612,6 +848,10 @@ def main() -> int:
         return ramp_child()
     if os.environ.get("SPARKML_LOAD_PHASE") == "ramp":
         return run_ramp_phase()
+    if os.environ.get("SPARKML_LOAD_PHASE") == "accounting_child":
+        return accounting_child()
+    if os.environ.get("SPARKML_LOAD_PHASE") == "accounting":
+        return run_accounting_phase()
     soak_s = _env_float("SPARKML_LOAD_SOAK_SECONDS", 60.0)
     calibrate_s = _env_float("SPARKML_LOAD_CALIBRATE_SECONDS", 8.0)
     n_features = _env_int("SPARKML_LOAD_FEATURES", 16)

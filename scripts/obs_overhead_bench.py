@@ -24,10 +24,18 @@ Phase order is off→on→off→on (two interleaved rounds per arm, means
 compared) so drift in the container's background load lands on both
 arms instead of biasing whichever phase ran last.
 
+A second experiment reuses the same tape to price the per-model cost
+ledger (``obs.accounting.ResourceLedger``) riding the request- and
+batch-completion seams: sampler OFF, ledger toggled off→on→off→on, and
+a SECOND record (``bench: obs_overhead_accounting``) is emitted whose
+``accounting_overhead_fraction`` is judged against the same documented
+bar (``SPARKML_BENCH_OBS_ACCT_BAR``, default 0.02). The process exits
+non-zero when the ledger arm misses that bar, so CI can gate on it.
+
 Knobs (env): SPARKML_BENCH_OBS_REQUESTS (default 384, per phase),
 SPARKML_BENCH_OBS_FEATURES (64), SPARKML_BENCH_OBS_K (16),
 SPARKML_BENCH_OBS_THREADS (8), SPARKML_BENCH_OBS_MAX_ROWS (512),
-SPARKML_BENCH_OBS_SAMPLE_MS (100).
+SPARKML_BENCH_OBS_SAMPLE_MS (100), SPARKML_BENCH_OBS_ACCT_BAR (0.02).
 """
 
 from __future__ import annotations
@@ -123,6 +131,33 @@ def main() -> int:
         on_wall += time.perf_counter() - t_on
         sampler.stop()
         self_reported += obs_overhead_total() - overhead_before
+
+    # ---- accounting arm: what does the cost ledger's meter cost? ----
+    # Same tape, sampler OFF, per-model ledger toggled per phase. The
+    # ledger rides the request-completion (note_request) and
+    # batch-completion (note_batch_seconds) seams, so this prices
+    # exactly the hot-path toll tiering/autoscaling pay for their
+    # numbers. The `enabled` flip is honored at the top of every hot
+    # method, so the singleton held by the engine/batchers obeys it.
+    from spark_rapids_ml_tpu.obs import accounting
+
+    acct_bar = float(
+        os.environ.get("SPARKML_BENCH_OBS_ACCT_BAR", "0.02"))
+    ledger = accounting.get_ledger()
+
+    def ledger_mutations_total() -> float:
+        snap = get_registry().snapshot().get(
+            "sparkml_model_ledger_mutations_total", {"samples": []})
+        return sum(s["value"] for s in snap["samples"])
+
+    acct_off_rates, acct_on_rates = [], []
+    mutations_before = ledger_mutations_total()
+    for _round in range(2):
+        ledger.enabled = False
+        acct_off_rates.append(run_phase())
+        ledger.enabled = True
+        acct_on_rates.append(run_phase())
+    ledger_mutations = ledger_mutations_total() - mutations_before
     engine.shutdown()
 
     rows_per_sec_off = float(np.mean(off_rates))
@@ -154,6 +189,37 @@ def main() -> int:
             self_reported / on_wall if on_wall > 0 else 0.0
         ),
     })
+
+    acct_off = float(np.mean(acct_off_rates))
+    acct_on = float(np.mean(acct_on_rates))
+    accounting_overhead = max(
+        0.0, 1.0 - acct_on / acct_off
+    ) if acct_off > 0 else 0.0
+    gate_ok = accounting_overhead <= acct_bar
+    bench_common.emit_record({
+        "bench": "obs_overhead_accounting",
+        "metric": "accounting_overhead_fraction",
+        "value": accounting_overhead,
+        "unit": "fraction of serve throughput lost to the cost ledger",
+        "higher_is_better": False,
+        "platform": device.platform,
+        "device_kind": str(device.device_kind),
+        "requests_per_phase": n_requests,
+        "threads": n_threads,
+        "rows_per_phase": total_rows,
+        "rows_per_sec_off": acct_off,
+        "rows_per_sec_on": acct_on,
+        "rows_per_sec_off_rounds": acct_off_rates,
+        "rows_per_sec_on_rounds": acct_on_rates,
+        "ledger_mutations_during_on_phases": ledger_mutations,
+        "gate_bar": acct_bar,
+        "gate_ok": gate_ok,
+    }, include_metrics=False)
+    if not gate_ok:
+        bench_common.log(
+            f"accounting overhead {accounting_overhead:.4f} exceeds "
+            f"bar {acct_bar:.4f}")
+        return 1
     return 0
 
 

@@ -143,6 +143,22 @@ def _prime_hook(kernel, weights: Tuple, device, dtype,
     return prime
 
 
+def staged_weight_bytes(weights, copies: int = 1) -> int:
+    """Device bytes a program's staged constant weights occupy — the
+    number the resource ledger (``obs.accounting``) charges per replica.
+    Summed from each staged array's ``nbytes`` (jax and numpy arrays
+    both carry it; weightless entries count 0), times ``copies`` for
+    replicated sharding, where every mesh device holds a full physical
+    copy."""
+    total = 0
+    for w in weights:
+        try:
+            total += int(getattr(w, "nbytes", 0) or 0)
+        except (TypeError, ValueError):
+            pass
+    return total * max(int(copies), 1)
+
+
 def build_serving_program(
     *,
     device,
@@ -191,7 +207,8 @@ def build_serving_program(
     return ServingProgram(put=put, run=run, fetch=fetch,
                           dtype=np.dtype(dtype), algo=algo,
                           precision=precision,
-                          prime=_prime_hook(kernel, weights, device, dtype))
+                          prime=_prime_hook(kernel, weights, device, dtype),
+                          weight_bytes=staged_weight_bytes(weights))
 
 
 def build_host_stat_stage(model, fn, host_weights, algo: str,
@@ -304,7 +321,9 @@ def build_fused_pipeline_program(
     return ServingProgram(put=put, run=run, fetch=fetch,
                           dtype=np.dtype(dtype), algo=algo,
                           precision=precision,
-                          prime=_prime_hook(kernel, flat_weights, device, dtype))
+                          prime=_prime_hook(kernel, flat_weights, device,
+                                            dtype),
+                          weight_bytes=staged_weight_bytes(flat_weights))
 
 
 # -- sharded big transforms ---------------------------------------------------
@@ -429,7 +448,11 @@ def build_batch_sharded_program(
                           # spec's placement (the hook accepts a
                           # Sharding in the device slot)
                           prime=_prime_hook(kernel, flat_weights,
-                                            row_sharded, dtype))
+                                            row_sharded, dtype),
+                          # replicated weights: every mesh device holds
+                          # a full physical copy
+                          weight_bytes=staged_weight_bytes(
+                              flat_weights, copies=len(devices)))
 
 
 def run_staged_pipeline(model, x, precision: str = "native") -> np.ndarray:

@@ -642,6 +642,12 @@ class ServingProgram(NamedTuple):
     # load when the persistent cache is on — without paying a zero-batch
     # execution per bucket. None → warmup falls back to put/run/fetch.
     prime: Optional[Callable[[Any], bool]] = None
+    # device bytes the program's staged weights occupy (summed over the
+    # weights actually device_put at build time; replicated sharding
+    # counts every physical copy). The resource ledger
+    # (``obs.accounting``) charges this per replica — 0 means the
+    # builder could not size its weights, not that they are free.
+    weight_bytes: int = 0
 
 
 class PipelineTransform:

@@ -59,23 +59,45 @@ DEFAULT_TIERS: Tuple[Tuple[float, float], ...] = (
     (10.0, 3600.0),
 )
 # Metric-name prefixes the sampler records by default: the serving tier,
-# its SLOs, the HTTP front end, device/host memory, and the obs layer's
-# own overhead series.
+# its SLOs, the HTTP front end, device/host memory, the per-model cost
+# ledger, and the obs layer's own overhead series.
 DEFAULT_PREFIXES: Tuple[str, ...] = (
     "sparkml_serve_",
     "sparkml_slo_",
     "sparkml_http_",
     "sparkml_device_",
     "sparkml_host_",
+    "sparkml_model_",
     "sparkml_numerics_",
     "sparkml_obs_",
     "sparkml_log_",
+)
+# Families matched by a prefix above that do NOT earn a history ring:
+# high-cardinality operational counters (per-model × outcome/op/event
+# children) that are scraped via /metrics and rolled up by
+# /debug/costs, but whose time dimension nobody queries. Every child
+# here would otherwise cost a full ring ladder per (model, label)
+# combination — the store's series budget is spent on the families the
+# dashboard and detectors actually read over time.
+SAMPLE_EXCLUDE: Tuple[str, ...] = (
+    "sparkml_model_requests_total",
+    "sparkml_model_rows_total",
+    "sparkml_model_compile_seconds_total",
+    "sparkml_model_compiles_total",
+    "sparkml_model_aot_cache_total",
+    "sparkml_model_ledger_mutations_total",
+    "sparkml_model_reconcile_checks_total",
+    "sparkml_model_last_hit_age_seconds",
 )
 # The series a flight dump's history tail embeds (kept tighter than the
 # sampler set: a dump is read by a human mid-incident).
 DUMP_PREFIXES: Tuple[str, ...] = ("sparkml_serve_", "sparkml_slo_")
 DUMP_TAIL_SECONDS = 300.0
-_MAX_SERIES = 2048
+# Sized for the per-model cost ledger's worst case (OBS_MODEL_MAX
+# models × their sampled families) ON TOP of the serve/SLO/device
+# families — at the old 2048 a full model roster could crowd out
+# late-born serve series, and the store drops NEW series at the cap.
+_MAX_SERIES = 3072
 
 
 def default_tiers() -> Tuple[Tuple[float, float], ...]:
@@ -414,6 +436,7 @@ class MetricsSampler:
         interval_seconds: Optional[float] = None,
         prefixes: Sequence[str] = DEFAULT_PREFIXES,
         clock: Callable[[], float] = time.time,
+        exclude: Sequence[str] = SAMPLE_EXCLUDE,
     ):
         self.store = store if store is not None else TimeSeriesStore(
             clock=clock)
@@ -423,6 +446,7 @@ class MetricsSampler:
             else sample_interval_seconds()
         )
         self.prefixes = tuple(prefixes)
+        self.exclude = frozenset(exclude)
         self.clock = clock
         self._collectors: List[Callable[[], None]] = []
         self._post_hooks: List[Callable[[float], None]] = []
@@ -478,7 +502,8 @@ class MetricsSampler:
                 self._count_collector_error(fn)
         recorded = 0
         for family in self._reg().families():
-            if not family.name.startswith(self.prefixes):
+            if (not family.name.startswith(self.prefixes)
+                    or family.name in self.exclude):
                 continue
             try:
                 recorded += self._sample_family(family, ts)
@@ -686,6 +711,7 @@ __all__ = [
     "DUMP_PREFIXES",
     "HISTORY_ENV",
     "MetricsSampler",
+    "SAMPLE_EXCLUDE",
     "SAMPLE_MS_ENV",
     "TimeSeriesStore",
     "counter_increase",

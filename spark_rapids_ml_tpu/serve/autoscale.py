@@ -403,12 +403,30 @@ class AutoscaleController:
             history = list(self._history)[-16:]
             signals = dict(self._last_signals)
             last_action = self._last_action_at
+        # the cost ledger's per-model resident bytes: what scale-down
+        # actually frees (weights) vs parks (reserve) — the meter the
+        # predictive-scaling roadmap item reads next to the signals
+        try:
+            from spark_rapids_ml_tpu.obs import accounting
+
+            ledger = accounting.get_ledger()
+            accounted = {
+                "weights_bytes": ledger.memory_bytes(
+                    component=accounting.COMPONENT_WEIGHTS),
+                "reserve_bytes": ledger.memory_bytes(
+                    component=accounting.COMPONENT_RESERVE),
+            }
+        except Exception:
+            # snapshot degrades to signals-only; visible (rule 6)
+            self._m_errors.inc(model="(autoscale)", error="ledger_read")
+            accounted = {}
         return {
             "replicas": self.engine.replica_scale(),
             "min": self.min_replicas,
             "max": self.max_replicas,
             "running": self.running,
             "signals": signals,
+            "accounted": accounted,
             "thresholds": {
                 "up_queue_wait_s": self.up_queue_wait_s,
                 "up_burn": self.up_burn,

@@ -111,6 +111,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from spark_rapids_ml_tpu.obs import accounting
 from spark_rapids_ml_tpu.obs import flight, get_registry, span, tracectx
 from spark_rapids_ml_tpu.obs import serving as obs_serving
 from spark_rapids_ml_tpu.obs import spans as spans_mod
@@ -431,6 +432,7 @@ class MicroBatcher:
         # resolved once like the metric family handles below — the
         # execute path must not take the monitor's global lock per batch
         self._devmon = get_device_monitor()
+        self._ledger = accounting.get_ledger()
         self._declare_metrics()
         self._worker = self._spawn_worker()
 
@@ -1160,6 +1162,10 @@ class MicroBatcher:
         # double-counted; a replica batcher attributes to ITS device.
         self._devmon.note_batch(self.name, busy_delta,
                                 device=self.device_label)
+        # same seam, same number, into the per-model cost ledger — so
+        # reconcile() can hold the two attributions to each other
+        self._ledger.note_batch_seconds(self.name, busy_delta,
+                                        device=self.device_label)
         if self._retire_entry(entry, gen):
             # The watchdog declared this window wedged (and failed it)
             # while the result was still in flight; the late result is

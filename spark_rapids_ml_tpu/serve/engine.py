@@ -120,6 +120,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import accounting as accounting_mod
 from spark_rapids_ml_tpu.obs import spans as spans_mod
 from spark_rapids_ml_tpu.obs.devmon import get_device_monitor
 from spark_rapids_ml_tpu.obs.serving import (
@@ -515,6 +516,10 @@ class ServeEngine:
             "sparkml_serve_sharded_rows_total",
             "rows served through the batch-sharded program", ("model",),
         )
+        # the per-model cost ledger (obs.accounting): replica builds
+        # charge HBM residency, reap/revive move it, predict feeds the
+        # traffic vitals — resolved once, like the metric handles
+        self._ledger = accounting_mod.get_ledger()
         _live_engines.add(self)
 
     @property
@@ -659,14 +664,16 @@ class ServeEngine:
                 isinstance(exc, ValueError) and not submitted[0]
             )
             if not client_error:
-                self._m_tenant.inc(
-                    tenant=tenant_id,
-                    outcome=("shed" if isinstance(exc, ShedLoad)
-                             else "rejected" if isinstance(exc, QueueFull)
-                             else "expired"
-                             if isinstance(exc, DeadlineExpired)
-                             else "error"),
-                )
+                outcome = ("shed" if isinstance(exc, ShedLoad)
+                           else "rejected" if isinstance(exc, QueueFull)
+                           else "expired"
+                           if isinstance(exc, DeadlineExpired)
+                           else "error")
+                self._m_tenant.inc(tenant=tenant_id, outcome=outcome)
+                self._ledger.note_request(
+                    entry.name, entry.version, tenant_id,
+                    self.admission.resolve_priority(priority),
+                    _rows_estimate(rows), outcome)
                 if isinstance(exc, ShedLoad) and not submitted[0]:
                     # distinct from QueueFull: a load-shed rejection is
                     # the controller's choice, not a full queue. Only
@@ -728,6 +735,10 @@ class ServeEngine:
             rollout.note_result(entry.name, entry.version, ok=True,
                                 latency_s=elapsed)
         self._m_tenant.inc(tenant=tenant_id, outcome="ok")
+        self._ledger.note_request(
+            entry.name, entry.version, tenant_id,
+            self.admission.resolve_priority(priority),
+            _rows_estimate(rows), "ok")
         self._m_latency.observe(elapsed, trace_id=ctx.trace_id,
                                 model=entry.name)
         return PredictResult(
@@ -1229,7 +1240,10 @@ class ServeEngine:
             rset = self._replicas.get(key)
         if rset is not None:
             return rset
-        plan = self._replica_specs(entry)
+        # compiles triggered by the spec build (and any AOT cache
+        # traffic) bill to this model in the cost ledger
+        with self._ledger.compile_attribution(entry.name, entry.version):
+            plan = self._replica_specs(entry)
         with self._lock:
             if self._closed:
                 raise EngineClosed("serving engine is shut down")
@@ -1251,6 +1265,8 @@ class ServeEngine:
             self._m_retries.inc(0, model=entry.name)
             self._m_degraded.inc(0, model=entry.name)
             stale = self._stale_keys(entry.name)
+        for replica in rset.replicas:
+            self._charge_replica(entry, replica)
         self.placer.publish_state(rset)
         # Outside the lock: retire sets for versions the registry no
         # longer knows (deregistered after a rollover) — otherwise every
@@ -1279,6 +1295,23 @@ class ServeEngine:
         if corpse is not None:
             # worker already dead — the close is just the final sweep
             corpse.close(drain=False, timeout=0.1)
+
+    def _charge_replica(self, entry: RegisteredModel,
+                        replica: Replica) -> None:
+        """Account one replica's staged weights to the cost ledger.
+        Host-path replicas (no ServingProgram) charge 0 — the key still
+        lands so ``/debug/costs`` shows the replica exists; a builder
+        that could not size its weights also shows 0, visibly distinct
+        from an absent replica. Never raises into the build path."""
+        spec = getattr(replica, "spec", None)
+        prog = getattr(spec, "program", None) if spec is not None else None
+        try:
+            self._ledger.charge_memory(
+                entry.name, entry.version, replica.label,
+                accounting_mod.COMPONENT_WEIGHTS,
+                int(getattr(prog, "weight_bytes", 0) or 0))
+        except Exception:
+            self._m_errors.inc(model=entry.name, error="ledger_charge")
 
     def _make_queue(self, device: Optional[str] = None):
         """The queue discipline for a new batcher: the weighted-fair
@@ -1330,14 +1363,27 @@ class ServeEngine:
                 # native precision: the sharded path serves the huge
                 # analytical batches — full precision, the reduced
                 # ladders stay on the replicated small-request path
-                prog = build_batch_sharded_program(
-                    entry.model, devices=devices, precision="native")
+                with self._ledger.compile_attribution(entry.name,
+                                                      entry.version):
+                    prog = build_batch_sharded_program(
+                        entry.model, devices=devices, precision="native")
             except Exception:
                 self._m_errors.inc(model=entry.name,
                                    error="sharded_program")
                 prog = None
         with self._lock:
             self._sharded_programs[key] = prog
+        if prog is not None:
+            try:
+                # replicated weights on every mesh device — the
+                # builder's weight_bytes already counts each copy
+                self._ledger.charge_memory(
+                    entry.name, entry.version, "(sharded)",
+                    accounting_mod.COMPONENT_WEIGHTS,
+                    int(getattr(prog, "weight_bytes", 0) or 0))
+            except Exception:
+                self._m_errors.inc(model=entry.name,
+                                   error="ledger_charge")
         return prog
 
     def _sharded_attempt(self, entry: RegisteredModel, rows, deadline,
@@ -1395,8 +1441,12 @@ class ServeEngine:
         # every chip for (approximately) the same interval
         monitor = get_device_monitor()
         for dev in devices:
+            label = placement_mod.device_label(dev)
             monitor.note_batch(entry.name, elapsed / n_dev,
-                               device=placement_mod.device_label(dev))
+                               device=label)
+            # same number into the cost ledger, so reconcile() holds
+            self._ledger.note_batch_seconds(entry.name, elapsed / n_dev,
+                                            device=label)
         return out
 
     # -- overload introspection --------------------------------------------
@@ -1557,6 +1607,13 @@ class ServeEngine:
             return False
         for replica in rset.replicas:
             replica.batcher.close(drain=drain)
+        # eviction is the path that actually FREES accounted residency:
+        # weights, reaped reserve, and AOT executable bytes all drop
+        try:
+            self._ledger.release_memory(name, version)
+        except Exception:
+            # accounting is telemetry, eviction already happened
+            self._m_errors.inc(model=name, error="ledger_release")
         return True
 
     def warmup(self, model_ref: str, *, n_features: Optional[int] = None):
@@ -1573,6 +1630,13 @@ class ServeEngine:
         request through the async path never pays an XLA compile — the
         precision × bucket ladder is owned by the deploy, not the user."""
         entry = self.registry.resolve_entry(model_ref)
+        # every compile and AOT-cache event inside the warm bills to
+        # this model in the cost ledger (obs.accounting)
+        with self._ledger.compile_attribution(entry.name, entry.version):
+            return self._warmup_entry(entry, model_ref, n_features)
+
+    def _warmup_entry(self, entry: RegisteredModel, model_ref: str,
+                      n_features: Optional[int]):
         # None falls through to the batcher's own default ladder
         # (default_buckets(max_batch_rows)) — registry.warmup builds the
         # same ladder from max_bucket_rows.
@@ -1663,13 +1727,15 @@ class ServeEngine:
                                     model=name, version=version,
                                     buckets=len(buckets)):
                     entry = self.registry.resolve_entry(ref)
-                    if not self._prime_replicas(entry, buckets):
-                        # no primeable program (host-path model, or a
-                        # kernel without AOT priming): the full warmup
-                        # executes the ladder — still zero fresh
-                        # compiles when the cache holds it, just paid
-                        # in zero-batch executions
-                        self.warmup(ref)
+                    with self._ledger.compile_attribution(
+                            entry.name, entry.version):
+                        if not self._prime_replicas(entry, buckets):
+                            # no primeable program (host-path model, or
+                            # a kernel without AOT priming): the full
+                            # warmup executes the ladder — still zero
+                            # fresh compiles when the cache holds it,
+                            # just paid in zero-batch executions
+                            self.warmup(ref)
                 report["warmed"][ref] = time.perf_counter() - t0
             except Exception as exc:  # noqa: BLE001 - per-model
                 self._m_errors.inc(model=name, error="warm_restart")
@@ -1821,6 +1887,11 @@ class ServeEngine:
                 replica.batcher = self._make_replica_batcher(
                     entry, replica.spec, replica.label, True)
             replica.retired = False
+        # the reaper parked this replica's staged bytes under the
+        # ledger's reserve component; back in rotation they are live
+        # weights again (no-op for a replica that was never reaped)
+        self._ledger.revive_replica(entry.name, entry.version,
+                                    replica.label)
 
     def _grow_replica_set(self, entry: RegisteredModel, rset: ReplicaSet,
                           count: int) -> int:
@@ -1860,6 +1931,8 @@ class ServeEngine:
         self._warm_new_replicas(entry, grown)
         with self._lock:
             rset.replicas = rset.replicas + grown
+        for replica in grown:
+            self._charge_replica(entry, replica)
         return len(grown)
 
     def _warm_new_replicas(self, entry: RegisteredModel,
@@ -1877,31 +1950,35 @@ class ServeEngine:
         n_features = _infer_features(entry.model)
         if n_features is None:
             return
-        for replica in replicas:
-            prog = replica.spec.program if replica.spec else None
-            if prog is None:
-                continue
-            try:
-                with spans_mod.span(
-                    f"serve:warmup_scaleup:{entry.name}",
-                    device=replica.label, buckets=len(buckets),
-                ):
-                    for bucket in sorted(set(int(b) for b in buckets)):
-                        # compile-without-execute — a disk-cache load
-                        # per bucket when the persistent cache is on:
-                        # what makes scale-up cheap. A prime that fell
-                        # back (or a primeless program) warms by
-                        # executing instead — the replica must not
-                        # enter rotation with a cold signature
-                        if (prog.prime is None
-                                or not prog.prime(bucket,
-                                                  int(n_features))):
-                            zeros = np.zeros((bucket, int(n_features)),
-                                             dtype=replica.spec.dtype)
-                            prog.fetch(prog.run(prog.put(zeros)))
-            except Exception:  # noqa: BLE001 - warm is best-effort
-                self._m_errors.inc(model=entry.name,
-                                   error="scaleup_warmup")
+        with self._ledger.compile_attribution(entry.name, entry.version):
+            for replica in replicas:
+                prog = replica.spec.program if replica.spec else None
+                if prog is None:
+                    continue
+                try:
+                    with spans_mod.span(
+                        f"serve:warmup_scaleup:{entry.name}",
+                        device=replica.label, buckets=len(buckets),
+                    ):
+                        for bucket in sorted(set(int(b)
+                                                 for b in buckets)):
+                            # compile-without-execute — a disk-cache
+                            # load per bucket when the persistent cache
+                            # is on: what makes scale-up cheap. A prime
+                            # that fell back (or a primeless program)
+                            # warms by executing instead — the replica
+                            # must not enter rotation with a cold
+                            # signature
+                            if (prog.prime is None
+                                    or not prog.prime(bucket,
+                                                      int(n_features))):
+                                zeros = np.zeros(
+                                    (bucket, int(n_features)),
+                                    dtype=replica.spec.dtype)
+                                prog.fetch(prog.run(prog.put(zeros)))
+                except Exception:  # noqa: BLE001 - warm is best-effort
+                    self._m_errors.inc(model=entry.name,
+                                       error="scaleup_warmup")
 
     def reap_retired(self) -> int:
         """Close retired replicas whose queues have fully drained (the
@@ -1915,7 +1992,7 @@ class ServeEngine:
         and rebuilds a fresh batcher instead of racing back into the
         one being closed (an in-rotation replica must never end up
         with a closed batcher)."""
-        claims: List[Tuple[Replica, MicroBatcher]] = []
+        claims: List[Tuple[ReplicaSet, Replica, MicroBatcher]] = []
         with self._lock:
             for rset in self._replicas.values():
                 for replica in rset.replicas:
@@ -1925,11 +2002,18 @@ class ServeEngine:
                             and not batcher.closed()
                             and batcher.load() == 0):
                         replica.reaping = True
-                        claims.append((replica, batcher))
-        for replica, corpse in claims:
+                        claims.append((rset, replica, batcher))
+        for rset, replica, corpse in claims:
             corpse.close(drain=True, timeout=5.0)
             with self._lock:
                 replica.reaping = False
+            # the staged program is RETAINED for cheap revival (the
+            # zero-cold-start property), so its bytes are still
+            # device-resident: the ledger moves them weights → reserve
+            # rather than pretending the reap freed them. evict() is
+            # what actually releases.
+            self._ledger.retire_replica(rset.name, rset.version,
+                                        replica.label)
         return len(claims)
 
     def attach_autoscale(self, controller) -> None:
@@ -1949,6 +2033,15 @@ class ServeEngine:
             return {"enabled": False}
         doc = controller.snapshot()
         doc["enabled"] = True
+        return doc
+
+    def costs_snapshot(self) -> Dict[str, Any]:
+        """The ``GET /debug/costs`` payload: the resource ledger's
+        per-model rollups + cold-model ranking + reconciliation verdict,
+        with the engine's replica states attached so residency can be
+        read next to what each replica is doing right now."""
+        doc = self._ledger.costs_document()
+        doc["replica_states"] = self.replica_snapshot()
         return doc
 
     # -- lifecycle / introspection ----------------------------------------

@@ -335,7 +335,31 @@ class ReplicaSet:
         return sum(1 for r in self.replicas if not r.retired)
 
     def snapshot(self) -> List[Dict[str, Any]]:
-        return [r.snapshot() for r in self.replicas]
+        docs = [r.snapshot() for r in self.replicas]
+        # accounted HBM residency per replica (obs.accounting) — the set
+        # knows its (name, version), the replica alone does not; a
+        # replica row shows its cost next to its state. Telemetry:
+        # an unavailable ledger must not break placement introspection.
+        try:
+            from spark_rapids_ml_tpu.obs import accounting
+
+            ledger = accounting.get_ledger()
+            snap = ledger.snapshot()
+            label = ledger.resolve_model(self.name)
+            for replica, doc in zip(self.replicas, docs):
+                prefix = f"{label} {self.version} {replica.label} "
+                doc["accounted_bytes"] = sum(
+                    nbytes for key, nbytes in snap["memory"].items()
+                    if key.startswith(prefix))
+        except Exception:
+            # rows render without cost columns; visible (rule 6)
+            get_registry().counter(
+                "sparkml_serve_errors_total",
+                "serving errors by type: batch failures (exception "
+                "class), worker crashes/wedges, breaker rejections",
+                ("model", "error"),
+            ).inc(model="(placement)", error="ledger_read")
+        return docs
 
 
 class DevicePlacer:

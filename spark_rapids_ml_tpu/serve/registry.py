@@ -192,6 +192,22 @@ class ModelRegistry:
             "sparkml_serve_model_registrations_total",
             "models registered into the serving registry", ("model",),
         ).inc(model=name)
+        # claim the model's cost-ledger label slot in REGISTRATION
+        # order: which models overflow past MODEL_MAX is then
+        # deterministic (late registrations), not an accident of which
+        # model happened to take traffic first. Telemetry — a ledger
+        # hiccup must never fail a registration.
+        try:
+            from spark_rapids_ml_tpu.obs import accounting
+
+            accounting.get_ledger().resolve_model(name)
+        except Exception:
+            get_registry().counter(
+                "sparkml_serve_errors_total",
+                "serving errors by type: batch failures (exception "
+                "class), worker crashes/wedges, breaker rejections",
+                ("model", "error"),
+            ).inc(model="(registry)", error="ledger_resolve")
 
     def load(self, name: str, path: str, *,
              buckets: Optional[Sequence[int]] = None) -> int:

@@ -316,6 +316,37 @@ class RolloutController:
             "worker crashes/wedges, breaker rejections",
             ("model", "error"),
         )
+        # the per-arm scoreboards were PRIVATE to the verdict math;
+        # these gauges mirror them at tick cadence so the TSDB sampler
+        # gives canary arms history — the dashboard's canary sparklines
+        self._m_arm_p50 = reg.gauge(
+            "sparkml_serve_canary_arm_p50_seconds",
+            "per-arm p50 latency while a canary experiment is active "
+            "(0 between experiments)", ("model", "arm"),
+        )
+        self._m_arm_p99 = reg.gauge(
+            "sparkml_serve_canary_arm_p99_seconds",
+            "per-arm p99 latency while a canary experiment is active "
+            "(0 between experiments)", ("model", "arm"),
+        )
+        self._m_arm_err = reg.gauge(
+            "sparkml_serve_canary_arm_error_rate",
+            "per-arm windowed error fraction while a canary experiment "
+            "is active", ("model", "arm"),
+        )
+        self._m_arm_requests = reg.gauge(
+            "sparkml_serve_canary_arm_requests",
+            "per-arm lifetime request count for the active experiment",
+            ("model", "arm"),
+        )
+        for arm in ("candidate", "incumbent"):
+            # flat-0 series: a dashboard should see an idle experiment
+            # plane, not absent series
+            self._m_arm_p50.set(0.0, model=self.name, arm=arm)
+            self._m_arm_p99.set(0.0, model=self.name, arm=arm)
+            self._m_arm_err.set(0.0, model=self.name, arm=arm)
+            self._m_arm_requests.set(0.0, model=self.name, arm=arm)
+        self._last_arm_publish = 0.0
 
     # -- request-path hooks (hot; must never raise) -------------------------
 
@@ -708,7 +739,9 @@ class RolloutController:
         auto-resolve (per candidate: a second rollback inside the
         first one's hold must never orphan the first gauge). Driven
         opportunistically from the request path and snapshot polls
-        (both keep flowing after a rollback)."""
+        (both keep flowing after a rollback). Also republishes the
+        per-arm gauges at the evaluation cadence."""
+        self._publish_arms()
         with self._lock:
             if not self._regressed:
                 return
@@ -720,6 +753,40 @@ class RolloutController:
         for v in elapsed:
             self._m_regressed.set(0.0, model=self.name,
                                   candidate=str(v))
+
+    def _publish_arms(self) -> None:
+        """Mirror the per-arm scoreboards into the ``..._canary_arm_*``
+        gauges, at most once per ``eval_interval_s`` (the request path
+        drives this — a hot alias must not pay a sketch quantile per
+        request). Cleared arms (experiment over) publish zeros, so the
+        sparkline shows the experiment ending instead of freezing at
+        its last live value."""
+        with self._lock:
+            now = self._clock()
+            if now - self._last_arm_publish < max(
+                    self.eval_interval_s, 0.05):
+                return
+            self._last_arm_publish = now
+            arms = {"candidate": self._arm_candidate,
+                    "incumbent": self._arm_incumbent}
+            docs = {arm: (stats.snapshot(self.window_s, now=now)
+                          if stats is not None else None)
+                    for arm, stats in arms.items()}
+        for arm, doc in docs.items():
+            if doc is None:
+                self._m_arm_p50.set(0.0, model=self.name, arm=arm)
+                self._m_arm_p99.set(0.0, model=self.name, arm=arm)
+                self._m_arm_err.set(0.0, model=self.name, arm=arm)
+                self._m_arm_requests.set(0.0, model=self.name, arm=arm)
+                continue
+            self._m_arm_p50.set(doc["p50_seconds"] or 0.0,
+                                model=self.name, arm=arm)
+            self._m_arm_p99.set(doc["p99_seconds"] or 0.0,
+                                model=self.name, arm=arm)
+            self._m_arm_err.set(doc["window_error_rate"],
+                                model=self.name, arm=arm)
+            self._m_arm_requests.set(doc["requests"],
+                                     model=self.name, arm=arm)
 
     def _decide(self, action: str, **fields) -> None:
         entry = {"action": action, "utc": spans_mod.utcnow_iso()}

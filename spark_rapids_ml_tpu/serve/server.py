@@ -102,6 +102,7 @@ from typing import Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import accounting as accounting_mod
 from spark_rapids_ml_tpu.obs import incidents as incidents_mod
 from spark_rapids_ml_tpu.obs import profiler as profiler_mod
 from spark_rapids_ml_tpu.obs import spans as spans_mod
@@ -196,6 +197,21 @@ def history_document(params) -> dict:
                 "sparkml_obs_overhead_seconds_total", None, window),
             "slo_budget_remaining": store.range_query(
                 "sparkml_slo_budget_remaining", None, window),
+            # the per-model cost ledger (obs.accounting): residency by
+            # component, device-time rate, and traffic temperature —
+            # the dashboard's per-model sparklines
+            "model_hbm_bytes": store.range_query(
+                "sparkml_model_hbm_bytes", None, window),
+            "model_device_rate": store.rate_points(
+                "sparkml_model_device_seconds_total", None, window),
+            "model_ewma_rps": store.range_query(
+                "sparkml_model_ewma_rps", None, window),
+            # canary per-arm vitals (serve.rollout publishes its private
+            # arm sketches at tick cadence)
+            "canary_arm_p99_seconds": store.range_query(
+                "sparkml_serve_canary_arm_p99_seconds", None, window),
+            "canary_arm_error_rate": store.range_query(
+                "sparkml_serve_canary_arm_error_rate", None, window),
         },
     }
 
@@ -446,6 +462,8 @@ def make_handler(engine: ServeEngine):
                 status = self._reply(200, engine.rollout_snapshot())
             elif path == "/debug/autoscale":
                 status = self._reply(200, engine.autoscale_snapshot())
+            elif path == "/debug/costs":
+                status = self._reply(200, engine.costs_snapshot())
             elif path == "/dashboard":
                 status = self._reply_text(
                     200, DASHBOARD_HTML, "text/html; charset=utf-8")
@@ -752,6 +770,10 @@ def start_serve_server(
     # switch: turning off auto-incidents must not freeze the burn-rate
     # history the dashboard and /debug/history plot.
     sampler.register_collector(publish_all_slos)
+    # the cost ledger's time-derived gauges (last-hit age, EWMA rps)
+    # refresh every sweep, so the per-model series get history even
+    # when nobody polls /debug/costs
+    sampler.register_collector(accounting_mod.get_ledger().publish)
     if incidents_mod.enabled():
         incidents_mod.get_incident_engine().install(sampler)
     server = _Server((addr, port), make_handler(engine))
@@ -945,9 +967,10 @@ function sparkSvg(points) {
 }
 function seriesLabel(prefix, labels) {
   var parts = [];
-  ["model", "device", "component", "outcome"].forEach(function (k) {
-    if (labels && labels[k]) parts.push(labels[k]);
-  });
+  ["model", "device", "component", "arm", "outcome"].forEach(
+    function (k) {
+      if (labels && labels[k]) parts.push(labels[k]);
+    });
   return prefix + (parts.length ? " \\u00b7 " + parts.join(" / ") : "");
 }
 function trendTile(prefix, series, fmt) {
@@ -986,6 +1009,34 @@ function historyTiles(hist) {
   });
   (key.obs_overhead_rate || []).forEach(function (s) {
     tiles.push(trendTile("obs overhead", s, function (v) {
+      return v == null ? "\\u2013" : (100 * v).toFixed(2) + "%";
+    }));
+  });
+  // the per-model cost ledger (/debug/costs): residency by component,
+  // attributed device time, traffic temperature
+  (key.model_hbm_bytes || []).forEach(function (s) {
+    tiles.push(trendTile("model HBM", s, function (v) {
+      return v == null ? "\\u2013" : fmtVal(v) + "B";
+    }));
+  });
+  (key.model_device_rate || []).forEach(function (s) {
+    tiles.push(trendTile("model device", s, function (v) {
+      return v == null ? "\\u2013" : (100 * v).toFixed(1) + "%";
+    }));
+  });
+  (key.model_ewma_rps || []).forEach(function (s) {
+    tiles.push(trendTile("model rows/s", s, function (v) {
+      return v == null ? "\\u2013" : fmtVal(v) + "/s";
+    }));
+  });
+  // canary per-arm sparklines (candidate vs incumbent)
+  (key.canary_arm_p99_seconds || []).forEach(function (s) {
+    tiles.push(trendTile("canary p99", s, function (v) {
+      return v == null ? "\\u2013" : (1000 * v).toFixed(1) + " ms";
+    }));
+  });
+  (key.canary_arm_error_rate || []).forEach(function (s) {
+    tiles.push(trendTile("canary err", s, function (v) {
       return v == null ? "\\u2013" : (100 * v).toFixed(2) + "%";
     }));
   });

@@ -1,0 +1,525 @@
+"""Per-model resource accounting & cost attribution (ISSUE 16): the
+``obs.accounting.ResourceLedger`` — HBM residency components
+(weights/reserve/executables) through the replica churn lifecycle,
+device-seconds reconciliation against devmon at the shared
+batch-completion seam under concurrent multi-replica traffic, bounded
+model-label cardinality, the ranked cold-model report, the
+``/debug/costs`` surface, the canary per-arm gauges (satellite 1), and
+the rule-15 ledger-audit checker fixtures (satellite 5)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.obs import accounting
+from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+from spark_rapids_ml_tpu.obs.accounting import (
+    COMPONENT_EXECUTABLES,
+    COMPONENT_RESERVE,
+    COMPONENT_WEIGHTS,
+    MODEL_MAX_ENV,
+    OVERFLOW_MODEL,
+    RECONCILE_MIN_ENV,
+    ResourceLedger,
+)
+from spark_rapids_ml_tpu.obs.metrics import get_registry
+from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+from spark_rapids_ml_tpu.serve import placement as placement_mod
+from spark_rapids_ml_tpu.serve.placement import DevicePlacer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_FEATURES = 12
+K = 3
+
+
+@pytest.fixture
+def data(rng):
+    return rng.normal(size=(1024, N_FEATURES))
+
+
+@pytest.fixture
+def fitted(data):
+    from spark_rapids_ml_tpu import PCA
+
+    return PCA().setK(K).fit(data)
+
+
+@pytest.fixture
+def fresh_ledger():
+    """Engines capture the singleton at construction — reset it BEFORE
+    building the engine so the test reads a ledger whose vitals and
+    residency map belong to this test alone (the metric families are
+    process-global and cumulative by design; assertions go through the
+    ledger's own documents, keyed by this test's unique model names)."""
+    accounting.reset_ledger()
+    yield accounting.get_ledger()
+    accounting.reset_ledger()
+
+
+def _placed_engine(registry, target=1, limit=4, **kw):
+    placer = DevicePlacer(devices=placement_mod.serving_devices(limit=limit))
+    placer.set_target(target)
+    kw.setdefault("max_batch_rows", 64)
+    kw.setdefault("max_wait_ms", 1.0)
+    return ServeEngine(registry, placement=placer, **kw)
+
+
+def _weights_by_replica(ledger, model):
+    """{replica_label: bytes} for the model's live ``weights`` entries
+    (synthetic rows like ``(sharded)`` excluded)."""
+    out = {}
+    for key, nbytes in ledger.snapshot()["memory"].items():
+        label, _version, replica, component = key.split(" ")
+        if (label == model and component == COMPONENT_WEIGHTS
+                and not replica.startswith("(")):
+            out[replica] = nbytes
+    return out
+
+
+# -- ledger unit surface (fake clock, standalone instance) -------------------
+
+
+def test_charge_retire_revive_release_roundtrip():
+    now = [100.0]
+    ledger = ResourceLedger(clock=lambda: now[0], enabled=True)
+    ledger.charge_memory("unit_a_pca", 1, "dev0", COMPONENT_WEIGHTS, 700)
+    ledger.charge_memory("unit_a_pca", 1, "dev1", COMPONENT_WEIGHTS, 700)
+    assert ledger.memory_bytes("unit_a_pca") == {"unit_a_pca": 1400}
+    # re-charge overwrites, never stacks
+    ledger.charge_memory("unit_a_pca", 1, "dev0", COMPONENT_WEIGHTS, 512)
+    assert ledger.memory_bytes("unit_a_pca") == {"unit_a_pca": 1212}
+    # retire moves weights -> reserve (bytes stay visible: the program
+    # is retained for revival, not freed)
+    assert ledger.retire_replica("unit_a_pca", 1, "dev1") == 700
+    assert ledger.memory_bytes(
+        "unit_a_pca", COMPONENT_WEIGHTS) == {"unit_a_pca": 512}
+    assert ledger.memory_bytes(
+        "unit_a_pca", COMPONENT_RESERVE) == {"unit_a_pca": 700}
+    # idempotent: a second retire of the same replica moves nothing
+    assert ledger.retire_replica("unit_a_pca", 1, "dev1") == 0
+    # revive reverses it
+    assert ledger.revive_replica("unit_a_pca", 1, "dev1") == 700
+    assert ledger.memory_bytes(
+        "unit_a_pca", COMPONENT_WEIGHTS) == {"unit_a_pca": 1212}
+    assert ledger.memory_bytes("unit_a_pca", COMPONENT_RESERVE) == {}
+    # wildcard release (the eviction path) frees everything
+    assert ledger.release_memory("unit_a_pca") == 1212
+    assert ledger.memory_bytes("unit_a_pca") == {}
+
+
+def test_charge_rejects_bad_component_and_negative_bytes():
+    ledger = ResourceLedger(enabled=True)
+    with pytest.raises(ValueError):
+        ledger.charge_memory("unit_b_pca", 1, "dev0", "hbm", 1)
+    with pytest.raises(ValueError):
+        ledger.charge_memory("unit_b_pca", 1, "dev0",
+                             COMPONENT_WEIGHTS, -1)
+
+
+def test_disabled_ledger_is_inert():
+    ledger = ResourceLedger(enabled=False)
+    ledger.charge_memory("unit_c_pca", 1, "dev0", COMPONENT_WEIGHTS, 99)
+    ledger.note_request("unit_c_pca", 1, "t", "interactive", 10, "ok")
+    ledger.note_batch_seconds("unit_c_pca", 1.0)
+    assert ledger.memory_bytes() == {}
+    assert ledger.snapshot()["memory"] == {}
+
+
+def test_model_label_cardinality_bounds(monkeypatch):
+    monkeypatch.setenv(MODEL_MAX_ENV, "2")
+    ledger = ResourceLedger(enabled=True)
+    assert ledger.model_max == 2
+    assert ledger.resolve_model("card_a") == "card_a"
+    assert ledger.resolve_model("card_b") == "card_b"
+    # third distinct name collapses — mirroring the tenant guard
+    assert ledger.resolve_model("card_c") == OVERFLOW_MODEL
+    # known names keep resolving to themselves
+    assert ledger.resolve_model("card_a") == "card_a"
+    # hot-path vitals for an overflow model fold under the bucket
+    ledger.note_request("card_d", 1, "t", "interactive", 5, "ok")
+    doc = ledger.costs_document()["models"]
+    assert OVERFLOW_MODEL in doc and doc[OVERFLOW_MODEL]["rows"] == 5
+    assert "card_d" not in doc
+
+
+def test_cold_report_ranks_idle_resident_model_coldest():
+    now = [0.0]
+    ledger = ResourceLedger(clock=lambda: now[0], enabled=True)
+    for name in ("cold_idle_pca", "cold_hot_pca"):
+        ledger.charge_memory(name, 1, "dev0", COMPONENT_WEIGHTS, 4096)
+    # both take traffic at t=0 — "cold" must mean went-idle, not
+    # never-seen
+    for name in ("cold_idle_pca", "cold_hot_pca"):
+        ledger.note_request(name, 1, "t", "interactive", 100, "ok")
+    # only the hot model keeps serving while the clock advances
+    for _ in range(60):
+        now[0] += 1.0
+        ledger.note_request("cold_hot_pca", 1, "t", "interactive",
+                            100, "ok")
+    doc = ledger.costs_document()
+    report = doc["cold_report"]
+    rank = {row["model"]: i for i, row in enumerate(report)}
+    assert rank["cold_idle_pca"] < rank["cold_hot_pca"], report
+    idle = doc["models"]["cold_idle_pca"]
+    hot = doc["models"]["cold_hot_pca"]
+    assert idle["last_hit_age_seconds"] == pytest.approx(60.0)
+    assert hot["ewma_rps"] > idle["ewma_rps"]
+    # a model with traffic but no resident bytes never appears: there
+    # is nothing for a tiering controller to evict
+    ledger.note_request("cold_ghost_pca", 1, "t", "interactive", 9, "ok")
+    report2 = ledger.costs_document()["cold_report"]
+    assert all(row["model"] != "cold_ghost_pca" for row in report2)
+
+
+def test_tenant_priority_rollups_in_costs_document():
+    ledger = ResourceLedger(enabled=True)
+    ledger.note_request("ten_pca", 1, "acme", "interactive", 10, "ok")
+    ledger.note_request("ten_pca", 1, "acme", "interactive", 5, "ok")
+    ledger.note_request("ten_pca", 1, "acme", "batch", 7, "ok")
+    ledger.note_request("ten_pca", 1, "zeta", "batch", 3, "shed")
+    doc = ledger.costs_document()["models"]["ten_pca"]
+    assert doc["tenants"]["acme|interactive"]["rows"] == 15
+    assert doc["tenants"]["acme|batch"]["rows"] == 7
+    assert doc["requests"] == {"ok": 3, "shed": 1}
+
+
+# -- churn lifecycle through the real engine ---------------------------------
+
+
+def test_churn_lifecycle_releases_exactly_accounted_bytes(
+        data, fitted, fresh_ledger):
+    """register -> warm -> scale-up -> retire -> reap: the weights
+    component drops by EXACTLY the retired replicas' accounted bytes
+    (moved to reserve, since reap retains the staged program), revive
+    moves them back, and eviction frees everything."""
+    ledger = fresh_ledger
+    registry = ModelRegistry()
+    registry.register("churn_pca", fitted)
+    engine = _placed_engine(registry, target=1)
+    try:
+        engine.warmup("churn_pca")
+        engine.predict("churn_pca", data[:16])
+        engine.scale_replicas(3)
+        per_replica = _weights_by_replica(ledger, "churn_pca")
+        assert len(per_replica) == 3
+        assert all(nbytes > 0 for nbytes in per_replica.values())
+        weights_before = ledger.memory_bytes(
+            "churn_pca", COMPONENT_WEIGHTS).get("churn_pca", 0)
+
+        rset = engine._replicas[("churn_pca", 1)]
+        tail_labels = [r.label for r in rset.replicas[1:]]
+        expected_moved = sum(per_replica[label] for label in tail_labels)
+
+        engine.scale_replicas(1)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            engine.reap_retired()
+            reserve = ledger.memory_bytes(
+                "churn_pca", COMPONENT_RESERVE).get("churn_pca", 0)
+            if reserve >= expected_moved:
+                break
+            time.sleep(0.01)
+        weights_after = ledger.memory_bytes(
+            "churn_pca", COMPONENT_WEIGHTS).get("churn_pca", 0)
+        reserve_after = ledger.memory_bytes(
+            "churn_pca", COMPONENT_RESERVE).get("churn_pca", 0)
+        assert weights_after == weights_before - expected_moved
+        assert reserve_after == expected_moved
+
+        # scale back up: the revived replica's bytes move back to live
+        engine.scale_replicas(2)
+        revived = _weights_by_replica(ledger, "churn_pca")
+        assert len(revived) == 2
+        reserve_now = ledger.memory_bytes(
+            "churn_pca", COMPONENT_RESERVE).get("churn_pca", 0)
+        assert reserve_now < reserve_after
+
+        # eviction is the path that actually FREES accounted residency
+        assert engine.evict("churn_pca", 1)
+        assert ledger.memory_bytes("churn_pca") == {}
+    finally:
+        engine.shutdown()
+
+
+def test_autoscale_scale_down_releases_accounted_bytes(
+        data, fitted, fresh_ledger):
+    """The same release property driven by the REAL autoscale
+    controller's cold-path decision (injected clock + signals), not a
+    direct ``scale_replicas`` call."""
+    from spark_rapids_ml_tpu.serve.autoscale import AutoscaleController
+
+    ledger = fresh_ledger
+    registry = ModelRegistry()
+    registry.register("asdown_pca", fitted)
+    engine = _placed_engine(registry, target=2)
+    try:
+        engine.warmup("asdown_pca")
+        engine.predict("asdown_pca", data[:16])
+        assert len(_weights_by_replica(ledger, "asdown_pca")) == 2
+        weights_before = ledger.memory_bytes(
+            "asdown_pca", COMPONENT_WEIGHTS).get("asdown_pca", 0)
+
+        cold = {"queue_wait_s": 0.0, "shed_level": 0, "burn": 0.0,
+                "occupancy": 0.1, "depth_frac": 0.0}
+        now = [1000.0]
+        ctl = AutoscaleController(
+            engine, signals_fn=lambda: dict(cold),
+            clock=lambda: now[0], min_replicas=1, max_replicas=2,
+            up_hold_s=0.5, down_hold_s=0.5, cooldown_s=0.0)
+        decisions = []
+        for _ in range(20):
+            decisions.append(ctl.evaluate_once())
+            now[0] += 0.3
+            if engine.replica_scale() == 1:
+                break
+        assert "scale_down" in decisions, decisions
+
+        deadline = time.monotonic() + 10.0
+        reserve = 0
+        while time.monotonic() < deadline:
+            engine.reap_retired()
+            reserve = ledger.memory_bytes(
+                "asdown_pca", COMPONENT_RESERVE).get("asdown_pca", 0)
+            if reserve > 0:
+                break
+            time.sleep(0.01)
+        weights_after = ledger.memory_bytes(
+            "asdown_pca", COMPONENT_WEIGHTS).get("asdown_pca", 0)
+        assert reserve > 0
+        assert weights_after == weights_before - reserve
+    finally:
+        engine.shutdown()
+
+
+# -- device-seconds reconciliation at the shared seam ------------------------
+
+
+def test_device_seconds_reconcile_with_devmon_under_concurrency(
+        data, fitted, fresh_ledger, monkeypatch):
+    """Ledger and devmon meter the SAME busy_delta at the SAME batcher
+    completion seam — under concurrent multi-replica traffic the
+    per-model attributions must agree within the documented tolerance
+    (here: exactly, since neither meter samples)."""
+    monkeypatch.setenv(RECONCILE_MIN_ENV, "0.0001")
+    accounting.reset_ledger()
+    ledger = accounting.get_ledger()
+    registry = ModelRegistry()
+    registry.register("recon_pca", fitted)
+    engine = _placed_engine(registry, target=2)
+    try:
+        engine.warmup("recon_pca")
+
+        def hammer(seed):
+            local = np.random.default_rng(seed)
+            for _ in range(30):
+                n = int(local.integers(4, 48))
+                start = int(local.integers(0, data.shape[0] - n))
+                engine.predict("recon_pca", data[start:start + n])
+
+        workers = [threading.Thread(target=hammer, args=(s,))
+                   for s in range(4)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(120.0)
+        time.sleep(0.3)  # stragglers: let every completion land
+
+        report = ledger.reconcile()
+        entry = report["models"].get("recon_pca")
+        assert entry and not entry.get("skipped"), report
+        assert entry["ledger_seconds"] > 0
+        assert entry["drift_ratio"] <= report["tolerance"], entry
+        # the drift gauge published for dashboards/alerts
+        snap = get_registry().snapshot()[
+            "sparkml_model_reconcile_drift_ratio"]
+        drift = {s["labels"]["model"]: s["value"]
+                 for s in snap["samples"]}
+        assert drift.get("recon_pca", 1.0) <= report["tolerance"]
+    finally:
+        engine.shutdown()
+    accounting.reset_ledger()
+
+
+# -- /debug/costs over the wire ----------------------------------------------
+
+
+def test_debug_costs_endpoint_serves_live_rollup(data, fitted,
+                                                 fresh_ledger):
+    from spark_rapids_ml_tpu.serve import start_serve_server
+
+    registry = ModelRegistry()
+    registry.register("costs_pca", fitted)
+    engine = ServeEngine(registry, max_batch_rows=64, max_wait_ms=1.0)
+    server = start_serve_server(engine)
+    try:
+        engine.warmup("costs_pca")
+        for i in range(4):
+            engine.predict("costs_pca", data[i * 16:(i + 1) * 16])
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/debug/costs", timeout=30).read())
+        model = doc["models"]["costs_pca"]
+        assert model["hbm_bytes"][COMPONENT_WEIGHTS] > 0
+        assert model["rows"] == 64
+        assert model["requests"]["ok"] == 4
+        assert model["device_seconds"] > 0
+        assert any(not rep.startswith("(")
+                   for rep in model["replicas"])
+        assert {"models", "cold_report", "reconcile",
+                "replica_states"} <= set(doc)
+        assert any(row["model"] == "costs_pca"
+                   for row in doc["cold_report"])
+        # per-replica accounted bytes ride the placement snapshot too
+        states = doc["replica_states"].get("costs_pca@1", {})
+        replicas = states.get("replicas", [])
+        assert replicas and all(
+            r.get("accounted_bytes", 0) > 0 for r in replicas), states
+        # the ledger series are history-sampled for sparklines
+        assert "sparkml_model_" in tsdb_mod.DEFAULT_PREFIXES
+        hist = json.loads(urllib.request.urlopen(
+            f"{base}/debug/history?window=300", timeout=30).read())
+        assert "model_hbm_bytes" in hist["key"]
+        assert "canary_arm_p99_seconds" in hist["key"]
+    finally:
+        server.shutdown()
+        engine.shutdown()
+
+
+# -- satellite 1: canary per-arm gauges --------------------------------------
+
+
+def test_canary_arm_gauges_published_and_sampled(fitted):
+    from spark_rapids_ml_tpu.serve.rollout import RolloutController
+
+    registry = ModelRegistry()
+    registry.register("arm_pca", fitted, buckets=(16,))
+    registry.register("arm_pca", fitted, buckets=(16,))
+    engine = ServeEngine(registry, max_batch_rows=64, max_wait_ms=1.0)
+    try:
+        rollout = RolloutController(
+            engine, "arm_pca", alias="prod", min_requests=100,
+            window_s=30.0, eval_interval_s=0.0, regressed_hold_s=5.0)
+        engine.attach_rollout(rollout)
+        registry.promote("prod", "arm_pca", 1)
+        rollout.incumbent = 1
+        rollout.publish(2)
+        rollout.start_canary(warm=False)
+        # flat-0 initialized at construction: the series exist (and are
+        # sampled) before the first canary request ever lands
+        names = ("sparkml_serve_canary_arm_p50_seconds",
+                 "sparkml_serve_canary_arm_p99_seconds",
+                 "sparkml_serve_canary_arm_error_rate",
+                 "sparkml_serve_canary_arm_requests")
+        snap = get_registry().snapshot()
+        for name in names:
+            arms = {s["labels"]["arm"] for s in snap[name]["samples"]
+                    if s["labels"]["model"] == "arm_pca"}
+            assert arms == {"candidate", "incumbent"}, (name, arms)
+
+        for _ in range(8):
+            rollout.note_result("arm_pca", 2, True, 0.010)
+            rollout.note_result("arm_pca", 1, True, 0.004)
+        rollout.note_result("arm_pca", 2, False, 0.050, backend=True)
+        time.sleep(0.06)  # past the publish cadence floor
+        rollout.snapshot()  # the poll path drives the republish
+
+        snap = get_registry().snapshot()
+
+        def arm_value(name, arm):
+            for s in snap[name]["samples"]:
+                if (s["labels"]["model"] == "arm_pca"
+                        and s["labels"]["arm"] == arm):
+                    return s["value"]
+            raise AssertionError(f"{name} missing arm {arm}")
+
+        assert arm_value("sparkml_serve_canary_arm_requests",
+                         "candidate") == 9
+        assert arm_value("sparkml_serve_canary_arm_requests",
+                         "incumbent") == 8
+        assert arm_value("sparkml_serve_canary_arm_p99_seconds",
+                         "candidate") >= 0.010
+        assert arm_value("sparkml_serve_canary_arm_p99_seconds",
+                         "incumbent") == pytest.approx(0.004, abs=1e-3)
+        assert arm_value("sparkml_serve_canary_arm_error_rate",
+                         "candidate") > 0
+        assert arm_value("sparkml_serve_canary_arm_error_rate",
+                         "incumbent") == 0
+
+        # the TSDB sampler picks the arm series up for /debug/history
+        store = tsdb_mod.TimeSeriesStore()
+        sampler = tsdb_mod.MetricsSampler(store, interval_seconds=999.0)
+        sampler.sample_once(now=1000.0)
+        series = store.range_query(
+            "sparkml_serve_canary_arm_p99_seconds", None, 3600.0)
+        assert any(s["labels"].get("model") == "arm_pca"
+                   for s in series)
+    finally:
+        engine.shutdown()
+
+
+# -- satellite 5: rule-15 checker fixtures -----------------------------------
+
+
+def _checker():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_instrumentation as ci
+    finally:
+        sys.path.pop(0)
+    return ci
+
+
+def test_rule15_accepts_current_ledger():
+    ci = _checker()
+    assert os.path.exists(ci.ACCOUNTING_FILE)
+    assert list(ci.check_ledger_audit(ci.ACCOUNTING_FILE)) == []
+
+
+def test_rule15_rejects_silent_ledger_mutations(tmp_path):
+    ci = _checker()
+    bad = tmp_path / "bad_ledger.py"
+    bad.write_text(
+        "class Ledger:\n"
+        "    def charge_memory(self, m, n):\n"
+        "        self._mem[m] = n  # REJECT\n"
+        "    def release_memory(self, m):\n"
+        "        self._mem.pop(m, None)  # REJECT\n"
+        "    def retire_replica(self, m):\n"
+        "        return 0  # REJECT\n"
+        "    def note_request(self, m):\n"
+        "        self._rows += 1  # REJECT\n"
+        "    def reconcile(self):\n"
+        "        return {}  # REJECT\n"
+        "    def memory_bytes(self):\n"
+        "        return dict(self._mem)  # fine: a read, not a mutation\n"
+    )
+    offenders = list(ci.check_ledger_audit(str(bad)))
+    assert len(offenders) == 5
+    assert all("rule 15" in why for _ln, why in offenders)
+
+
+def test_rule15_accepts_accounted_ledger_mutations(tmp_path):
+    ci = _checker()
+    good = tmp_path / "good_ledger.py"
+    good.write_text(
+        "class Ledger:\n"
+        "    def charge_memory(self, m, n):\n"
+        "        self._mem[m] = n\n"
+        "        self._m_mutations.inc(model=m, op='charge')\n"
+        "    def release_memory(self, m):\n"
+        "        self._mem.pop(m, None)\n"
+        "        self._count('release')\n"
+        "    def retire_replica(self, m):\n"
+        "        record_event('obs:ledger:retire', 0, 1)\n"
+        "    def note_batch_seconds(self, m, s):\n"
+        "        with span('obs:ledger:note'):\n"
+        "            self._seconds += s\n"
+    )
+    assert list(ci.check_ledger_audit(str(good))) == []

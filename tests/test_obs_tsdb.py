@@ -278,6 +278,34 @@ def test_sampler_snapshots_selected_families(clock):
     assert "unrelated_total" not in names
 
 
+def test_sampler_excludes_high_cardinality_ledger_families(clock):
+    # SAMPLE_EXCLUDE: families whose per-(model, outcome/op/event)
+    # children would each cost a ring ladder but whose time dimension
+    # nobody queries — they stay on /metrics, not in the store. The
+    # families the dashboard reads over time DO land.
+    reg = _fixture_registry()
+    reg.counter("sparkml_model_ledger_mutations_total", "",
+                ("model", "op")).inc(3, model="m", op="charge_memory")
+    reg.counter("sparkml_model_requests_total", "",
+                ("model", "outcome")).inc(2, model="m", outcome="ok")
+    reg.gauge("sparkml_model_hbm_bytes", "",
+              ("model", "component")).set(512, model="m",
+                                          component="weights")
+    reg.counter("sparkml_model_device_seconds_total", "",
+                ("model",)).inc(0.25, model="m")
+    store = TimeSeriesStore(tiers=((1.0, 300.0),), clock=clock)
+    sampler = MetricsSampler(store, registry=reg, interval_seconds=1.0,
+                             clock=clock)
+    assert sampler.sample_once(now=1000.0) > 0
+    names = store.series_names()
+    assert "sparkml_model_hbm_bytes" in names
+    assert "sparkml_model_device_seconds_total" in names
+    for excluded in ("sparkml_model_ledger_mutations_total",
+                     "sparkml_model_requests_total"):
+        assert excluded in tsdb_mod.SAMPLE_EXCLUDE
+        assert excluded not in names
+
+
 def test_sampler_counter_delta_matches_registry(clock):
     reg = _fixture_registry()
     counter = reg.counter("sparkml_serve_requests_total", "",
