@@ -64,6 +64,57 @@ def prefixed_result(stdout: str, prefix: str):
     return json.loads(line[len(prefix):])
 
 
+_REQUIRE_PLATFORM_ENV = "SPARKML_BENCH_REQUIRE_PLATFORM"
+
+
+def backend_provenance() -> dict:
+    """The RESOLVED jax backend (not the requested one): platform,
+    device kind, device count. {} when jax is unavailable — provenance
+    must never fail a bench. Callers on the emit path have already
+    initialized the backend, so this never triggers a fresh init cost."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "platform": devices[0].platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "device_count": len(devices),
+        }
+    except Exception:  # noqa: BLE001 - provenance must never fail a bench
+        return {}
+
+
+def required_platform() -> str | None:
+    """The platform this bench run REQUIRES (``SPARKML_BENCH_REQUIRE_
+    PLATFORM=tpu``), or None when any resolved backend is acceptable."""
+    value = os.environ.get(_REQUIRE_PLATFORM_ENV, "").strip().lower()
+    return value or None
+
+
+def enforce_required_platform(provenance: dict | None = None) -> dict:
+    """Refuse to continue when the resolved backend is not the required
+    one — a record measured on a silent CPU fallback is worse than no
+    record (the r04 lesson). Exit code 3 distinguishes the refusal from
+    a probe retry (2). Returns the provenance when the check passes."""
+    want = required_platform()
+    prov = provenance if provenance is not None else backend_provenance()
+    if want is None:
+        return prov
+    got = (prov.get("platform") or "").lower()
+    if got != want:
+        log(f"backend mismatch: required {want}, resolved {got or 'none'}")
+        flight_dump("bench_backend_mismatch", required=want,
+                    resolved=got or None)
+        print(json.dumps({
+            "error": "backend_mismatch",
+            "required_platform": want,
+            "resolved_platform": got or None,
+        }), flush=True)
+        raise SystemExit(3)
+    return prov
+
+
 def metrics_snapshot() -> dict:
     """The process metrics registry as a JSON-safe dict ({} when the
     package (or its telemetry) is unavailable — emission never fails)."""
@@ -84,6 +135,17 @@ def emit_record(record: dict, *, stream=None, include_metrics: bool = True,
     a record file instead."""
     rec = dict(record)
     rec.setdefault("emitted_utc", stamp())
+    if "backend" not in rec:
+        # every record names the backend it was measured on — the
+        # perf sentinel compares records only within one backend and
+        # flags cross-backend drift as backend_mismatch, not regression
+        prov = backend_provenance()
+        if prov:
+            rec["backend"] = prov
+        want = required_platform()
+        if want is not None:
+            rec["required_platform"] = want
+            enforce_required_platform(prov)
     if include_metrics and "metrics" not in rec:
         snap = metrics_snapshot()
         if snap:
@@ -129,6 +191,13 @@ def probe(tag: str):
     if device.platform == "cpu":
         log(f"{tag} probe FAILED (cpu backend)")
         flight_dump("bench_probe_cpu_fallback", tag=tag)
+        return None
+    want = required_platform()
+    if want is not None and device.platform.lower() != want:
+        log(f"{tag} probe FAILED (platform {device.platform} != "
+            f"required {want})")
+        flight_dump("bench_backend_mismatch", tag=tag, required=want,
+                    resolved=device.platform)
         return None
     log(f"{tag} probe ok")
     return device

@@ -103,6 +103,14 @@ Three rule families:
    (``.inc(...)``). What a model alias points at IS what live traffic
    serves: a promote or rollback that neither the metrics nor the
    trace tree can see is an unauditable deployment change.
+16. over ``spark_rapids_ml_tpu/parallel/distributed_*.py`` again: every
+   public **fit** entry point (a ``distributed_*`` function with "fit"
+   in its name that is not a ``*_kernel``) must enter a fit-step span —
+   a ``.step(...)`` call (``current_run().step`` / a FitRun method)
+   somewhere in its body, nested per-pass steppers included. A fit that
+   never opens a step is invisible to ``/debug/fit``: no per-step
+   device time, no rows/sec, no MFU attribution — the whole fit-path
+   observability plane silently skips it.
 
 New drivers and new models therefore cannot silently ship unobserved:
 tier-1 runs this via ``tests/test_obs_reports.py``.
@@ -134,7 +142,7 @@ PRINT_EXEMPT_DIRS = (os.path.join("spark_rapids_ml_tpu", "scripts"),)
 # injectable-clock discipline (sampling, detection, incident lifecycle).
 CLOCKED_OBS_FILES = tuple(
     os.path.join(REPO, "spark_rapids_ml_tpu", "obs", name)
-    for name in ("tsdb.py", "anomaly.py", "incidents.py")
+    for name in ("tsdb.py", "anomaly.py", "incidents.py", "fitmon.py")
 )
 DECORATOR_NAME = "fit_instrumentation"
 SERVING_DECORATOR = "observed_transform"
@@ -945,6 +953,33 @@ def check_device_selection(path: str):
                    "— default placement pins work to device 0)")
 
 
+def check_fit_step_monitoring(path: str):
+    """Rule 16: every public fit entry point must enter a fit-step span.
+
+    A ``.step(...)`` attribute call anywhere inside the function body
+    (``ast.walk``, so nested per-pass steppers like GLM's IRLS closure
+    count) satisfies the rule — that is the ``current_run().step``
+    seam the fitmon plane meters. A fit without one produces no
+    per-step device time, rows/sec, or MFU in ``/debug/fit``."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for fn in tree.body:
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if not _is_entry_point(fn) or "fit" not in fn.name:
+            continue
+        has_step = any(
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "step"
+            for node in ast.walk(fn)
+        )
+        if not has_step:
+            yield (fn.lineno,
+                   f"{fn.name} (fit entry point never enters a fitmon "
+                   "step — wrap the blocked kernel pass in "
+                   "current_run().step(...))")
+
+
 def library_files():
     """Every .py under the package, minus the exempt helper dirs."""
     out = []
@@ -988,6 +1023,8 @@ def main() -> int:
             offenders.append(f"{rel}:{lineno} {name} "
                              f"(missing @{DECORATOR_NAME})")
         for lineno, why in check_raw_jit(path):
+            offenders.append(f"{rel}:{lineno} {why}")
+        for lineno, why in check_fit_step_monitoring(path):
             offenders.append(f"{rel}:{lineno} {why}")
     serving_checked = 0
     for path in serving_files:
@@ -1079,7 +1116,8 @@ def main() -> int:
         f"decision-counted; {len(cache_files)} cache/autoscale "
         f"module(s) with every hit/miss/evict/invalidate and "
         f"scale-up/scale-down decision counted or audit-spanned; "
-        f"cost-ledger mutation paths all counted or audit-spanned"
+        f"cost-ledger mutation paths all counted or audit-spanned; "
+        f"every fit entry point enters a fitmon step span"
     )
     return 0
 

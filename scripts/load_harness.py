@@ -841,6 +841,288 @@ def run_accounting_phase() -> int:
     return 0
 
 
+FITMON_CHILD_PREFIX = "FITMON_CHILD_RESULT "
+
+
+def fitmon_child() -> int:
+    """The fit-observability phase (own process — forced 2 host devices,
+    fast sampler, fast watchdog, 1-sweep incident hysteresis). Four
+    drills, all judged by the parent:
+
+    * **visibility** — PCA and KMeans fits under the live stack (every
+      ``@fit_instrumentation`` driver opens a FitRun), then
+      ``GET /debug/fit`` over the wire must show per-step device time,
+      rows/sec, and MFU for both algos. CPU has no real peak table, so
+      the parent injects a synthetic one via
+      ``SPARK_RAPIDS_ML_TPU_FITMON_PEAK_FLOPS`` — absent MFU on a
+      configured-peaks backend is a broken attribution path, not an
+      unknown device kind;
+    * **reconcile** — fitmon's summed ``sparkml_fit_device_seconds_
+      total`` against devmon's ``fit:*`` batch-seconds (the one
+      measured duration feeds both meters, so drift is an attribution
+      bug, not noise);
+    * **straggler** — an injected per-host delay in a run's host-step
+      table must trip the straggler flag for exactly that host;
+    * **watchdog** — flipping the watchdog's expected platform to
+      "tpu" (resolved: cpu) must open exactly ONE auto-resolving
+      ``fit_backend_degraded`` incident; clearing the expectation must
+      resolve it."""
+    import jax
+
+    from spark_rapids_ml_tpu.obs import fitmon, get_registry
+    from spark_rapids_ml_tpu.serve import (
+        ModelRegistry,
+        ServeEngine,
+        start_serve_server,
+    )
+
+    n_features = _env_int("SPARKML_LOAD_FEATURES", 32)
+    k = _env_int("SPARKML_LOAD_K", 8)
+    n_fits = _env_int("SPARKML_LOAD_FITMON_FITS", 3)
+
+    registry = ModelRegistry()
+    engine = ServeEngine(registry, max_batch_rows=128, max_wait_ms=2.0,
+                         max_queue_depth=64)
+    server = start_serve_server(engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def metric_sum(name: str, label: str = None,
+                   prefix: str = None) -> float:
+        snap = get_registry().snapshot().get(name, {"samples": []})
+        total = 0.0
+        for s in snap["samples"]:
+            if prefix is not None and not str(
+                    s["labels"].get(label, "")).startswith(prefix):
+                continue
+            total += s["value"]
+        return total
+
+    # -- visibility: monitored DISTRIBUTED fits under the live stack -------
+    # (the parallel drivers are the instrumented surface — the forced
+    # 2-device mesh is exactly what a real pod slice shard looks like)
+    from spark_rapids_ml_tpu.parallel import (
+        distributed_kmeans_fit,
+        distributed_pca_fit,
+    )
+    from spark_rapids_ml_tpu.parallel.mesh import data_mesh
+
+    mesh = data_mesh()
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(4096, n_features))
+    for seed in range(n_fits):
+        distributed_pca_fit(x, k, mesh)
+        distributed_kmeans_fit(x, k, mesh, max_iter=10, seed=seed)
+    fit_doc = _get_json(base, "/debug/fit")
+    runs = fit_doc.get("recent", []) + fit_doc.get("active", [])
+
+    def algo_evidence(algo: str) -> dict:
+        mine = [r for r in runs if r.get("algo") == algo]
+        return {
+            "runs": len(mine),
+            "steps": sum(r.get("steps", 0) for r in mine),
+            "device_seconds": sum(
+                r.get("device_seconds") or 0.0 for r in mine),
+            "rows_per_sec_present": any(
+                r.get("rows_per_sec") for r in mine),
+            "mfu_present": any(
+                r.get("mfu_mean") is not None for r in mine),
+        }
+
+    evidence = {
+        "distributed_pca": algo_evidence("distributed_pca"),
+        "distributed_kmeans": algo_evidence("distributed_kmeans"),
+    }
+
+    # -- reconcile: fitmon device-seconds vs the devmon meter --------------
+    fitmon_s = metric_sum("sparkml_fit_device_seconds_total")
+    devmon_s = metric_sum("sparkml_serve_device_batch_seconds_total",
+                          label="model", prefix="fit:")
+    drift = (abs(fitmon_s - devmon_s) / fitmon_s) if fitmon_s > 0 else 1.0
+
+    # -- straggler: injected per-host delay --------------------------------
+    monitor = fitmon.get_fit_monitor()
+    run = monitor.start_run("straggler_drill")
+    with run.step("drill", rows=256):
+        pass
+    run.note_host_step("host0", 0.10)
+    run.note_host_step("host1", 0.11)
+    run.note_host_step("host2", 0.45)  # the injected delay
+    skew = run.skew()
+    monitor.finish_run(run)
+
+    # -- watchdog: platform-mismatch drill over the REAL pipeline ----------
+    # (watchdog check → gauge → sampler sweep → ThresholdDetector →
+    # incident engine), all on the live sampler thread at its fast
+    # cadence. The expectation flip is the injected fault.
+    wd = monitor.watchdog
+
+    def fit_backend_incidents(doc: dict, state: str) -> list:
+        return [i for i in doc.get(state, [])
+                if i.get("detector") == fitmon.INCIDENT_NAME]
+
+    def wait_for(predicate, timeout_s: float = 30.0) -> dict:
+        deadline = time.monotonic() + timeout_s
+        doc = {}
+        while time.monotonic() < deadline:
+            doc = _get_json(base, "/debug/incidents")
+            if predicate(doc):
+                return doc
+            time.sleep(0.2)
+        return doc
+
+    wd.expected_platform = None
+    wd.check()  # healthy baseline lands backend_ok=1 in the store
+    time.sleep(1.0)
+    wd.expected_platform = "tpu"  # resolved platform is cpu: degraded
+    opened_doc = wait_for(
+        lambda d: len(fit_backend_incidents(d, "open")) >= 1)
+    open_incidents = fit_backend_incidents(opened_doc, "open")
+    mismatch_verdict = wd.last_verdict() or {}
+    wd.expected_platform = None  # fault cleared: must auto-resolve
+    resolved_doc = wait_for(
+        lambda d: not fit_backend_incidents(d, "open")
+        and fit_backend_incidents(d, "recent"))
+    resolved = fit_backend_incidents(resolved_doc, "recent")
+
+    server.shutdown()
+    engine.shutdown()
+    from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+
+    tsdb_mod.get_sampler().stop()
+    time.sleep(1.0)
+
+    result = {
+        "devices": jax.device_count(),
+        "fits_per_algo": n_fits,
+        "algos": evidence,
+        "fit_doc_peaks": fit_doc.get("peaks", {}),
+        "fitmon_device_seconds": fitmon_s,
+        "devmon_fit_batch_seconds": devmon_s,
+        "device_seconds_drift": drift,
+        "skew": skew,
+        "watchdog_mismatch_verdict": {
+            key: mismatch_verdict.get(key)
+            for key in ("ok", "reason", "platform", "expected_platform")
+        },
+        "incidents_opened": len(open_incidents),
+        "incident_detectors": sorted(
+            {i.get("detector") for i in open_incidents}),
+        "incidents_resolved": len(resolved),
+        "incident_states": sorted(
+            {i.get("state") for i in resolved}),
+    }
+    sys.stdout.write(FITMON_CHILD_PREFIX + json.dumps(result) + "\n")
+    sys.stdout.flush()
+    return 0
+
+
+def run_fitmon_phase() -> int:
+    """Parent leg of the fit-observability phase: spawn the 2-device
+    child with fast observability cadences, judge the gates, emit the
+    sentinel record. Gates:
+
+    * both fitted algos show steps with device time, rows/sec, AND MFU
+      in ``/debug/fit`` (synthetic peak table injected — MFU absent
+      would mean the TrackedJit→fitmon attribution path is severed);
+    * fitmon's device-seconds reconcile with devmon's ``fit:*`` meter
+      within ``SPARKML_LOAD_FITMON_DRIFT`` (default 5%);
+    * the injected per-host delay flags exactly that host a straggler;
+    * the platform-mismatch drill opens exactly one
+      ``fit_backend_degraded`` incident and it auto-resolves once the
+      expectation is cleared."""
+    import subprocess
+
+    drift_bar = _env_float("SPARKML_LOAD_FITMON_DRIFT", 0.05)
+    env = dict(os.environ)
+    env["SPARKML_LOAD_PHASE"] = "fitmon_child"
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    env["XLA_FLAGS"] = bench_common.force_device_count_flags(2)
+    env["SPARK_RAPIDS_ML_TPU_OBS_SAMPLE_MS"] = "100"
+    env["SPARK_RAPIDS_ML_TPU_FITMON_WATCHDOG_S"] = "0.2"
+    env["SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_OPEN_AFTER"] = "1"
+    env["SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_RESOLVE_AFTER"] = "2"
+    env["SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_COOLDOWN_S"] = "0"
+    env["SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_CAPTURE_S"] = "0"
+    # CPU has no peak table; a synthetic one makes MFU a hard assertion
+    env["SPARK_RAPIDS_ML_TPU_FITMON_PEAK_FLOPS"] = "1e12"
+    env["SPARK_RAPIDS_ML_TPU_FITMON_PEAK_BW"] = "1e11"
+    env.pop("SPARK_RAPIDS_ML_TPU_FITMON_EXPECT_PLATFORM", None)
+    bench_common.log("load_harness fitmon: child at 2 device(s), "
+                     "PCA+KMeans fits + straggler + watchdog drills")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    result = bench_common.prefixed_result(proc.stdout,
+                                          FITMON_CHILD_PREFIX)
+    if result is None:
+        bench_common.log(
+            f"load_harness fitmon FAIL: child produced no result "
+            f"(rc={proc.returncode}): {proc.stderr[-2000:]}")
+        return 1
+    drift = float(result["device_seconds_drift"])
+    record = {
+        "bench": "load_harness_fitmon",
+        "metric": "load_harness_fitmon_device_drift",
+        "value": drift,
+        "unit": ("relative drift between fitmon step device-seconds "
+                 "and the devmon fit:* batch meter"),
+        "higher_is_better": False,
+        "platform": "cpu",
+        "device_kind": "cpu",
+        "drift_bar": drift_bar,
+        **{key: result[key] for key in
+           ("devices", "fits_per_algo", "algos", "fitmon_device_seconds",
+            "devmon_fit_batch_seconds", "skew",
+            "watchdog_mismatch_verdict", "incidents_opened",
+            "incident_detectors", "incidents_resolved",
+            "incident_states")},
+    }
+    bench_common.emit_record(record, include_metrics=False)
+    failures = []
+    for algo, doc in result["algos"].items():
+        if doc["runs"] < 1 or doc["steps"] < 1:
+            failures.append(f"{algo}: no monitored runs/steps in "
+                            f"/debug/fit ({doc})")
+        if not doc["rows_per_sec_present"]:
+            failures.append(f"{algo}: no per-step rows/sec")
+        if doc["device_seconds"] <= 0:
+            failures.append(f"{algo}: no per-step device time")
+        if not doc["mfu_present"]:
+            failures.append(f"{algo}: MFU absent despite injected peaks "
+                            "— TrackedJit cost attribution severed")
+    if drift > drift_bar:
+        failures.append(
+            f"fitmon/devmon device-seconds drift {drift:.4f} exceeds "
+            f"{drift_bar:.4f} ({result['fitmon_device_seconds']:.4f}s "
+            f"vs {result['devmon_fit_batch_seconds']:.4f}s)")
+    if result["skew"].get("stragglers") != ["host2"]:
+        failures.append(
+            f"injected host2 delay not flagged: {result['skew']}")
+    if result["incidents_opened"] != 1 or result[
+            "incident_detectors"] != ["fit_backend_degraded"]:
+        failures.append(
+            f"platform-mismatch drill opened "
+            f"{result['incidents_opened']} incident(s) "
+            f"({result['incident_detectors']}), wanted exactly one "
+            f"fit_backend_degraded")
+    if result["incidents_resolved"] < 1 or result[
+            "incident_states"] != ["resolved"]:
+        failures.append(
+            f"fit_backend_degraded did not auto-resolve after the "
+            f"expectation was cleared: {result['incident_states']}")
+    if failures:
+        bench_common.log("load_harness fitmon FAIL: "
+                         + "; ".join(failures))
+        return 1
+    bench_common.log(
+        f"load_harness fitmon PASS: {result['fits_per_algo']} fit(s) "
+        f"per algo visible with MFU, device-seconds drift "
+        f"{drift:.4f} (bar {drift_bar:.4f}), straggler host2 flagged, "
+        f"one fit_backend_degraded incident opened and auto-resolved")
+    return 0
+
+
 def main() -> int:
     if os.environ.get("SPARKML_LOAD_PHASE") == "device_capacity_child":
         return device_capacity_child()
@@ -852,6 +1134,10 @@ def main() -> int:
         return accounting_child()
     if os.environ.get("SPARKML_LOAD_PHASE") == "accounting":
         return run_accounting_phase()
+    if os.environ.get("SPARKML_LOAD_PHASE") == "fitmon_child":
+        return fitmon_child()
+    if os.environ.get("SPARKML_LOAD_PHASE") == "fitmon":
+        return run_fitmon_phase()
     soak_s = _env_float("SPARKML_LOAD_SOAK_SECONDS", 60.0)
     calibrate_s = _env_float("SPARKML_LOAD_CALIBRATE_SECONDS", 8.0)
     n_features = _env_int("SPARKML_LOAD_FEATURES", 16)

@@ -32,10 +32,18 @@ a SECOND record (``bench: obs_overhead_accounting``) is emitted whose
 bar (``SPARKML_BENCH_OBS_ACCT_BAR``, default 0.02). The process exits
 non-zero when the ledger arm misses that bar, so CI can gate on it.
 
+A third experiment prices the fit-path step monitor (``obs.fitmon``):
+a tape of repeated PCA fits, each wrapped in ``fitmon.fit_run`` so the
+step-monitor call sites execute in BOTH arms, with the monitor toggled
+off→on→off→on. A THIRD record (``bench: obs_overhead_fitmon``) carries
+``fitmon_overhead_fraction`` judged against ``SPARKML_BENCH_OBS_FITMON_
+BAR`` (default 0.02); a miss also exits non-zero.
+
 Knobs (env): SPARKML_BENCH_OBS_REQUESTS (default 384, per phase),
 SPARKML_BENCH_OBS_FEATURES (64), SPARKML_BENCH_OBS_K (16),
 SPARKML_BENCH_OBS_THREADS (8), SPARKML_BENCH_OBS_MAX_ROWS (512),
-SPARKML_BENCH_OBS_SAMPLE_MS (100), SPARKML_BENCH_OBS_ACCT_BAR (0.02).
+SPARKML_BENCH_OBS_SAMPLE_MS (100), SPARKML_BENCH_OBS_ACCT_BAR (0.02),
+SPARKML_BENCH_OBS_FITS (24), SPARKML_BENCH_OBS_FITMON_BAR (0.02).
 """
 
 from __future__ import annotations
@@ -215,12 +223,79 @@ def main() -> int:
         "gate_bar": acct_bar,
         "gate_ok": gate_ok,
     }, include_metrics=False)
+
+    # ---- fitmon arm: what does the fit-path step monitor cost? ----
+    # Same toggling discipline, but the tape is repeated FITS: the
+    # step-monitor call sites (fit_run + current_run().step inside the
+    # distributed drivers) execute in BOTH arms — OFF prices the
+    # disabled null-run path, ON prices real step recording — so the
+    # fraction is exactly the seam's toll, not fit-vs-serve drift.
+    from spark_rapids_ml_tpu.obs import fitmon
+
+    fitmon_bar = float(
+        os.environ.get("SPARKML_BENCH_OBS_FITMON_BAR", "0.02"))
+    n_fits = _env_int("SPARKML_BENCH_OBS_FITS", 24)
+    monitor = fitmon.get_fit_monitor()
+    x_fit = x[:1024]
+    fit_rows_per_phase = n_fits * x_fit.shape[0]
+
+    def run_fit_phase() -> float:
+        """Replay the fit tape; returns rows/sec."""
+        t0 = time.perf_counter()
+        for _ in range(n_fits):
+            with fitmon.fit_run("bench_fitmon"):
+                PCA().setK(k).fit(x_fit)
+        wall = time.perf_counter() - t0
+        return fit_rows_per_phase / wall if wall > 0 else 0.0
+
+    saved_enabled = monitor.enabled
+    monitor.enabled = True
+    run_fit_phase()  # untimed warm pass: compile cache for the fit shape
+    fit_off_rates, fit_on_rates = [], []
+    for _round in range(2):
+        monitor.enabled = False
+        fit_off_rates.append(run_fit_phase())
+        monitor.enabled = True
+        fit_on_rates.append(run_fit_phase())
+    monitor.enabled = saved_enabled
+
+    fit_off = float(np.mean(fit_off_rates))
+    fit_on = float(np.mean(fit_on_rates))
+    fitmon_overhead = max(
+        0.0, 1.0 - fit_on / fit_off
+    ) if fit_off > 0 else 0.0
+    fitmon_ok = fitmon_overhead <= fitmon_bar
+    bench_common.emit_record({
+        "bench": "obs_overhead_fitmon",
+        "metric": "fitmon_overhead_fraction",
+        "value": fitmon_overhead,
+        "unit": "fraction of fit throughput lost to the step monitor",
+        "higher_is_better": False,
+        "platform": device.platform,
+        "device_kind": str(device.device_kind),
+        "fits_per_phase": n_fits,
+        "rows_per_phase": fit_rows_per_phase,
+        "rows_per_sec_off": fit_off,
+        "rows_per_sec_on": fit_on,
+        "rows_per_sec_off_rounds": fit_off_rates,
+        "rows_per_sec_on_rounds": fit_on_rates,
+        "monitored_runs": len(monitor.recent_runs()),
+        "gate_bar": fitmon_bar,
+        "gate_ok": fitmon_ok,
+    }, include_metrics=False)
+
+    failed = False
     if not gate_ok:
         bench_common.log(
             f"accounting overhead {accounting_overhead:.4f} exceeds "
             f"bar {acct_bar:.4f}")
-        return 1
-    return 0
+        failed = True
+    if not fitmon_ok:
+        bench_common.log(
+            f"fitmon overhead {fitmon_overhead:.4f} exceeds "
+            f"bar {fitmon_bar:.4f}")
+        failed = True
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -241,6 +241,27 @@ def noise_band(values: List[float], tolerance: float) -> float:
     return _robust.noise_band(values, tolerance)
 
 
+def backend_mismatch_reason(record: Dict[str, Any]) -> Optional[str]:
+    """Why this record's RESOLVED backend (the ``backend`` provenance
+    stamp ``emit_record`` adds) disagrees with the backend it was
+    supposed to run on — None when provenance is absent (older records)
+    or consistent. A mismatch means the number itself is untrustworthy,
+    which is a different failure from a slow-but-honest measurement."""
+    resolved = (record.get("backend") or {}).get("platform")
+    if not resolved:
+        return None
+    resolved = str(resolved).lower()
+    required = record.get("required_platform")
+    if required and resolved != str(required).lower():
+        return (f"record required platform {required!r} but the resolved "
+                f"jax backend was {resolved!r}")
+    claimed = record.get("platform")
+    if claimed and str(claimed).lower() != resolved:
+        return (f"record claims platform {claimed!r} but the resolved jax "
+                f"backend was {resolved!r} (silent fallback)")
+    return None
+
+
 def _is_fallback(record: Dict[str, Any]) -> bool:
     if record.get("fallback_reason"):
         return True
@@ -495,7 +516,28 @@ def judge_percentiles(record: Dict[str, Any],
 def judge_record(record: Dict[str, Any], history: List[Dict[str, Any]],
                  tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
     """Dispatch: percentile-aware judging when the record carries
-    latency-percentile fields, scalar judging otherwise."""
+    latency-percentile fields, scalar judging otherwise. A record whose
+    backend provenance contradicts its declared/required platform is
+    judged STALE with ``reason_code: backend_mismatch`` before any
+    number comparison — the measurement itself is untrustworthy, and
+    the live-side watchdog raises the same condition as the
+    ``fit_backend_degraded`` incident."""
+    mismatch = backend_mismatch_reason(record)
+    if mismatch:
+        return {
+            "metric": record.get("metric"),
+            "value": record.get("value"),
+            "unit": record.get("unit"),
+            "platform": record.get("platform"),
+            "verdict": "STALE",
+            "reason_code": "backend_mismatch",
+            "incident": "fit_backend_degraded",
+            "reason": (
+                f"{mismatch} — the number was measured on the wrong "
+                "backend; the comparable baseline is stale, not regressed "
+                "(live side raises incident fit_backend_degraded)"
+            ),
+        }
     if record_percentiles(record):
         return judge_percentiles(record, history, tolerance=tolerance)
     return judge(record, history, tolerance=tolerance)

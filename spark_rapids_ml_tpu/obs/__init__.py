@@ -146,6 +146,22 @@ from spark_rapids_ml_tpu.obs.devmon import (  # noqa: F401
     get_device_monitor,
 )
 from spark_rapids_ml_tpu.obs import profiler  # noqa: F401
+from spark_rapids_ml_tpu.obs.fitmon import (  # noqa: F401
+    BackendWatchdog,
+    FitMonitor,
+    FitRun,
+    StepMonitor,
+    current_run,
+    debug_fit_doc,
+    detect_stragglers,
+    device_peaks,
+    fit_report,
+    fit_run,
+    get_fit_monitor,
+    reset_fitmon,
+    roofline_bound,
+    step_mfu,
+)
 from spark_rapids_ml_tpu.obs.report import (  # noqa: F401
     FitContext,
     FitReport,
@@ -188,13 +204,17 @@ __all__ = [
     "Counter",
     "DEFAULT_BUCKETS",
     "DUMP_DIR_ENV",
+    "BackendWatchdog",
     "Detector",
     "DeviceHealth",
     "DeviceMonitor",
     "FIT_BUDGET_ENV",
     "Finding",
     "FitContext",
+    "FitMonitor",
     "FitReport",
+    "FitRun",
+    "StepMonitor",
     "Gauge",
     "Histogram",
     "Incident",
@@ -248,18 +268,25 @@ __all__ = [
     "signature_count",
     "current_context",
     "current_fit",
+    "current_run",
     "current_span_id",
     "current_trace_id",
     "current_transform",
     "deadline",
+    "debug_fit_doc",
     "default_slos",
+    "detect_stragglers",
     "device_memory_stats",
+    "device_peaks",
     "dump",
     "dump_dir",
     "ensure_context",
     "fit_instrumentation",
+    "fit_report",
+    "fit_run",
     "flight",
     "get_device_monitor",
+    "get_fit_monitor",
     "get_incident_engine",
     "get_logger",
     "get_recorder",
@@ -291,9 +318,12 @@ __all__ = [
     "record_event",
     "record_memory_metrics",
     "reset_compile_log",
+    "reset_fitmon",
     "reset_incident_engine",
     "retention",
     "robust_zscore",
+    "roofline_bound",
+    "step_mfu",
     "span",
     "start_prometheus_server",
     "start_sampling",

@@ -513,6 +513,21 @@ def builtin_detectors(
             kind="rollout", severity="critical",
             stale_after=max(2 * w, 120.0),
         ),
+        # The fit-path backend watchdog (obs/fitmon.py): the gauge drops
+        # to 0 when the resolved JAX platform silently differs from the
+        # configured expectation or the canary dispatch wedges — the r04
+        # failure mode, where every bench round fell back to CPU and
+        # nobody noticed until the perf sentinel read the records. The
+        # unlabeled gauge is a single series, so the dedup key yields
+        # exactly ONE incident, auto-resolving when the watchdog's next
+        # check publishes 1 again.
+        ThresholdDetector(
+            "fit_backend_degraded",
+            "sparkml_fit_backend_ok",
+            threshold=0.5, direction="<",
+            kind="backend", severity="critical",
+            stale_after=max(2 * w, 120.0),
+        ),
     ]
 
 

@@ -83,7 +83,7 @@ def _overhead_counter():
 class _Capture:
     __slots__ = ("id", "path", "seconds", "t0_perf", "started_unix",
                  "stop_event", "thread", "jax_thread", "jax_started",
-                 "jax_result")
+                 "jax_result", "fit_run_id")
 
     def __init__(self, cid: str, path: str, seconds: float):
         self.id = cid
@@ -96,6 +96,9 @@ class _Capture:
         self.jax_thread: Optional[threading.Thread] = None
         self.jax_started = threading.Event()
         self.jax_result: Optional[str] = None
+        # the FitRun active when the capture was armed (the monitor's
+        # latest, NOT the contextvar — captures run on worker threads)
+        self.fit_run_id: Optional[str] = None
 
 
 _lock = threading.Lock()
@@ -160,6 +163,7 @@ def capture_active() -> Optional[Dict[str, Any]]:
         "seconds": cap.seconds,
         "elapsed_seconds": time.perf_counter() - cap.t0_perf,
         "jax_trace": cap.jax_started.is_set(),
+        "fit_run_id": cap.fit_run_id,
     }
 
 
@@ -193,6 +197,12 @@ def start_capture(seconds: float = _DEFAULT_SECONDS,
         cap = _Capture(cid, path, seconds)
         _active = cap
         jax_enabled = _jax_helper is None or not _jax_helper.is_alive()
+    try:
+        from spark_rapids_ml_tpu.obs import fitmon
+
+        cap.fit_run_id = fitmon.get_fit_monitor().latest_active_run_id()
+    except Exception:
+        cap.fit_run_id = None
     try:
         os.makedirs(path, exist_ok=True)
         from spark_rapids_ml_tpu.obs import tracectx
@@ -232,6 +242,7 @@ def start_capture(seconds: float = _DEFAULT_SECONDS,
         "path": path,
         "seconds": seconds,
         "jax_enabled": jax_enabled,
+        "fit_run_id": cap.fit_run_id,
     }
 
 
@@ -366,6 +377,7 @@ def _finish(cap: _Capture, jax_outcome: str) -> None:
         "spans_trace": spans_path,
         "artifacts": _artifacts(cap.path),
         "finished_unix": time.time(),
+        "fit_run_id": cap.fit_run_id,
     }
     with _lock:
         _last = result

@@ -208,6 +208,16 @@ class FitContext:
             )
             entry["count"] += int(count)
             entry["bytes"] += int(nbytes) * int(count)
+        try:
+            # mirror into the live fit-path monitor so /debug/fit shows
+            # comms accounting while the fit is still running
+            from spark_rapids_ml_tpu.obs import fitmon
+
+            fitmon.current_run().record_collective(
+                kind, nbytes=int(nbytes), count=int(count)
+            )
+        except Exception:
+            pass
 
     def set_data(
         self,
@@ -455,6 +465,19 @@ def _flight_deadline(algo: str, trace_id: str):
         return contextlib.nullcontext()
 
 
+def _fitmon_run(algo: str, trace_id: str):
+    """The fit-path step monitor's run context (obs/fitmon.py): every
+    instrumented driver is a monitored FitRun, so its steps land in
+    ``/debug/fit`` and the ``sparkml_fit_*`` history. No-op when fitmon
+    is disabled or unavailable."""
+    try:
+        from spark_rapids_ml_tpu.obs import fitmon
+
+        return fitmon.fit_run(algo, trace_id=trace_id)
+    except Exception:
+        return contextlib.nullcontext()
+
+
 def _record_metrics(report: FitReport) -> None:
     reg = get_registry()
     algo = report.algo
@@ -585,8 +608,11 @@ def fit_instrumentation(algo: str, attach: bool = True):
             token = _current_ctx.set(ctx)
             started = _utcnow()
             t0 = time.perf_counter()
+            fitmon_run = None
             try:
-                with _flight_deadline(algo, ctx.trace_id), spans.span(
+                with _flight_deadline(algo, ctx.trace_id), _fitmon_run(
+                    algo, ctx.trace_id
+                ) as fitmon_run, spans.span(
                     f"fit:{algo}", TraceColor.GREEN, trace_id=ctx.trace_id
                 ), ctx.timer.phase("total"):
                     result = fn(*args, **kwargs)
@@ -599,6 +625,19 @@ def fit_instrumentation(algo: str, attach: bool = True):
                     ctx, started, wall, _find_mesh(args, kwargs)
                 )
                 _publish(report)
+                if fitmon_run is not None and getattr(
+                    fitmon_run, "run_id", None
+                ):
+                    # join the finished run to its uniform report so
+                    # /debug/fit shows the same rollup the model carries
+                    fitmon_run.report = {
+                        "wall_seconds": report.wall_seconds,
+                        "rows": report.rows,
+                        "n_iter": report.n_iter,
+                        "analytic_mfu": report.analytic_mfu,
+                        "collective_bytes":
+                            report.total_collective_bytes(),
+                    }
                 if attach:
                     result = attach_report(result, report)
             except Exception:

@@ -71,6 +71,7 @@ DEFAULT_PREFIXES: Tuple[str, ...] = (
     "sparkml_numerics_",
     "sparkml_obs_",
     "sparkml_log_",
+    "sparkml_fit_",
 )
 # Families matched by a prefix above that do NOT earn a history ring:
 # high-cardinality operational counters (per-model × outcome/op/event
@@ -680,6 +681,13 @@ def start_sampling(interval_seconds: Optional[float] = None
         sampler.register_collector(devmon.get_device_monitor().sample)
     except Exception:
         pass  # no jax / no devices: plain registry history still works
+    try:
+        from spark_rapids_ml_tpu.obs import fitmon
+
+        sampler.register_collector(
+            fitmon.get_fit_monitor().watchdog_collector)
+    except Exception:
+        pass  # watchdog is advisory: registry history still works
     from spark_rapids_ml_tpu.obs import flight
 
     flight.register_dump_section("metrics_history", _dump_history_tail)

@@ -460,6 +460,11 @@ class TrackedJit:
             current_transform().record_program(
                 self.label, entry.flops, entry.bytes_accessed
             )
+            from spark_rapids_ml_tpu.obs import fitmon
+
+            fitmon.record_program(
+                self.label, entry.flops, entry.bytes_accessed
+            )
         except Exception:
             pass
 

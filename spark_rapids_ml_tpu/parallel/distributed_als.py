@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -113,16 +114,26 @@ def distributed_als_fit(
     ctx.set_data(rows=n_users + n_items, features=rank)
     ctx.set_iterations(max_iter)
     with ctx.phase("execute"):
-        for _ in range(max_iter):
-            # each half-sweep all_gathers the OPPOSITE factor table over ICI
-            ctx.record_collective(
-                "all_gather",
-                nbytes=collective_nbytes((v0.shape[0], rank), dtype))
-            u = half_sweep(v, u_idx, u_val, u_mask, u, reg_dev, alpha_dev)
-            ctx.record_collective(
-                "all_gather",
-                nbytes=collective_nbytes((u0.shape[0], rank), dtype))
-            v = half_sweep(u, i_idx, i_val, i_mask, v, reg_dev, alpha_dev)
+        for sweep in range(max_iter):
+            # both half-sweeps run inside one monitored step; blocking
+            # on v bounds the step at the sweep's true completion
+            with current_run().step(
+                "als_sweep", rows=n_users + n_items
+            ) as mon:
+                # each half-sweep all_gathers the OPPOSITE factor table
+                # over ICI
+                ctx.record_collective(
+                    "all_gather",
+                    nbytes=collective_nbytes((v0.shape[0], rank), dtype))
+                u = half_sweep(v, u_idx, u_val, u_mask, u, reg_dev,
+                               alpha_dev)
+                ctx.record_collective(
+                    "all_gather",
+                    nbytes=collective_nbytes((u0.shape[0], rank), dtype))
+                v = half_sweep(u, i_idx, i_val, i_mask, v, reg_dev,
+                               alpha_dev)
+                jax.block_until_ready(v)
+                mon.note(sweep=float(sweep))
     u = np.asarray(jax.block_until_ready(u), dtype=np.float64)
     v = np.asarray(jax.block_until_ready(v), dtype=np.float64)
     return u[:n_users], v[:n_items]

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -162,15 +163,17 @@ def distributed_bisecting_kmeans_fit(
             nbytes=collective_nbytes((2 * d + 3,), x_padded.dtype),
             count=max_iter + 3,
         )
-        centers2, new_leaf, cnt, sums, sqs = jax.block_until_ready(
-            _bisect_split_kernel(
-                x_dev, mask_dev, leaf,
-                key,
-                jnp.asarray(target, dtype=jnp.int32),
-                jnp.asarray(new_id, dtype=jnp.int32),
-                mesh=mesh, max_iter=max_iter, tol=tol,
+        with current_run().step("bisect_split", rows=n_rows) as mon:
+            centers2, new_leaf, cnt, sums, sqs = jax.block_until_ready(
+                _bisect_split_kernel(
+                    x_dev, mask_dev, leaf,
+                    key,
+                    jnp.asarray(target, dtype=jnp.int32),
+                    jnp.asarray(new_id, dtype=jnp.int32),
+                    mesh=mesh, max_iter=max_iter, tol=tol,
+                )
             )
-        )
+            mon.note(n_leaves=float(len(leaves)), target=float(target))
         cnt = np.asarray(cnt, dtype=np.float64)
         n_splits += 1
         if (cnt <= 0).any():

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -123,7 +124,7 @@ def distributed_forest_fit(
     hist_nbytes = collective_nbytes(
         (channels, 2 ** max_depth, d, n_bins), np.dtype(dtype))
     feats_l, thrs_l, leaves_l, gains_l = [], [], [], []
-    for _ in range(n_trees):
+    for tree in range(n_trees):
         ctx.record_collective(
             "all_reduce", nbytes=hist_nbytes, count=max_depth)
         w = rng.poisson(subsampling_rate, binned_p.shape[0]) * mask
@@ -131,14 +132,20 @@ def distributed_forest_fit(
         fm = jnp.asarray(
             np.ones((max_depth, d)), dtype=dtype
         )  # feature subsets: host-side choice mirrors the local fit
-        f, t, leaf, g = _sharded_grow(
-            binned_dev, y_dev, w_dev, fm, max_depth, n_bins, min_leaf,
-            len(classes) if classification else 0, mesh,
-        )
-        feats_l.append(np.asarray(f))
-        thrs_l.append(np.asarray(t))
-        leaves_l.append(np.asarray(leaf))
-        gains_l.append(np.asarray(g))
+        # the np.asarray conversions block on the grown tree, so the
+        # step's wall time covers the full level-synchronous growth
+        with current_run().step(
+            "grow_tree", rows=x.shape[0]
+        ) as mon:
+            f, t, leaf, g = _sharded_grow(
+                binned_dev, y_dev, w_dev, fm, max_depth, n_bins,
+                min_leaf, len(classes) if classification else 0, mesh,
+            )
+            feats_l.append(np.asarray(f))
+            thrs_l.append(np.asarray(t))
+            leaves_l.append(np.asarray(leaf))
+            gains_l.append(np.asarray(g))
+            mon.note(tree=float(tree))
     ensemble = TreeEnsemble(
         feature=np.stack(feats_l),
         threshold=np.stack(thrs_l),

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -115,14 +116,20 @@ def distributed_gbt_fit(
     def grow_fn(r, w):
         ctx.record_collective(
             "all_reduce", nbytes=hist_nbytes, count=max_depth)
-        ft, tt, leaf, g_tree, leaf_ids_dev = _sharded_grow_with_leaf_ids(
-            binned_dev,
-            jax.device_put(jnp.asarray(r, dtype=dtype), vec_shard),
-            jax.device_put(jnp.asarray(w, dtype=dtype), vec_shard),
-            full_mask, max_depth, n_bins, min_leaf, mesh,
-        )
-        return (np.asarray(ft), np.asarray(tt), np.asarray(leaf),
-                np.asarray(g_tree), np.asarray(leaf_ids_dev))
+        # the np.asarray conversions block on the grown tree, so the
+        # step's wall time covers the full boosted-tree growth
+        with current_run().step("boost_tree", rows=n):
+            ft, tt, leaf, g_tree, leaf_ids_dev = \
+                _sharded_grow_with_leaf_ids(
+                    binned_dev,
+                    jax.device_put(jnp.asarray(r, dtype=dtype),
+                                   vec_shard),
+                    jax.device_put(jnp.asarray(w, dtype=dtype),
+                                   vec_shard),
+                    full_mask, max_depth, n_bins, min_leaf, mesh,
+                )
+            return (np.asarray(ft), np.asarray(tt), np.asarray(leaf),
+                    np.asarray(g_tree), np.asarray(leaf_ids_dev))
 
     ensemble, gains = boosting_loop(
         y_padded=y_p, mask=mask, n_real=n, init=init, max_iter=max_iter,

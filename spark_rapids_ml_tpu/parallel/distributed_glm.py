@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -164,16 +165,19 @@ def distributed_glm_fit(
 
     def step(coef, intercept, first=False):
         ctx.record_collective("all_reduce", nbytes=step_nbytes)
-        out = distributed_glm_step_kernel(
-            x_dev, y_dev, w_dev, o_dev,
-            jnp.asarray(coef, dtype=nd),
-            jnp.asarray(intercept, dtype=nd),
-            mesh=mesh, family=family_r, link=link_r,
-            var_power=float(var_power_r),
-            link_power=float(link_power_r),
-            use_init_mu=bool(first))
-        return GlmStepOut(*(np.asarray(v, dtype=np.float64)
-                            for v in out))
+        # host→float64 conversion blocks on the result, so the step's
+        # wall time covers the full IRLS pass, not just the dispatch
+        with current_run().step("irls_pass", rows=x_host.shape[0]):
+            out = distributed_glm_step_kernel(
+                x_dev, y_dev, w_dev, o_dev,
+                jnp.asarray(coef, dtype=nd),
+                jnp.asarray(intercept, dtype=nd),
+                mesh=mesh, family=family_r, link=link_r,
+                var_power=float(var_power_r),
+                link_power=float(link_power_r),
+                use_init_mu=bool(first))
+            return GlmStepOut(*(np.asarray(v, dtype=np.float64)
+                                for v in out))
 
     if offset is not None:
         # the fitted model must refuse offset-less scoring, exactly as

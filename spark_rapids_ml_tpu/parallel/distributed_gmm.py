@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -116,15 +117,19 @@ def distributed_gmm_fit(
 
     def stepper(means, prec, log_det, log_w):
         ctx.record_collective("all_reduce", nbytes=step_nbytes)
-        out = distributed_gmm_stats_kernel(
-            x_dev, w_dev,
-            jnp.asarray(means, dtype=dt),
-            jnp.asarray(prec, dtype=dt),
-            jnp.asarray(log_det, dtype=dt),
-            jnp.asarray(log_w, dtype=dt),
-            mesh=mesh,
-        )
-        return GmmStats(*(np.asarray(v, dtype=np.float64) for v in out))
+        # host→float64 conversion blocks on the result, so the step's
+        # wall time covers the full E-step pass, not just the dispatch
+        with current_run().step("em_pass", rows=n_rows):
+            out = distributed_gmm_stats_kernel(
+                x_dev, w_dev,
+                jnp.asarray(means, dtype=dt),
+                jnp.asarray(prec, dtype=dt),
+                jnp.asarray(log_det, dtype=dt),
+                jnp.asarray(log_w, dtype=dt),
+                mesh=mesh,
+            )
+            return GmmStats(
+                *(np.asarray(v, dtype=np.float64) for v in out))
 
     est = GaussianMixture()
     est.set("k", int(k))

@@ -20,6 +20,7 @@ from jax import lax
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -148,13 +149,20 @@ def distributed_kmeans_fit(
         x_dev = jax.device_put(x_padded, row_sharding(mesh))
         mask_dev = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
     key = jax.random.PRNGKey(seed)
-    with ctx.phase("execute"):
+    # The Lloyd loop runs INSIDE the compiled program (fori_loop + psum),
+    # so the host-visible step is the whole blocked pass; the realized
+    # iteration count and final cost ride along as convergence scalars.
+    with ctx.phase("execute"), current_run().step(
+        "lloyd", rows=x_host.shape[0]
+    ) as step:
         result = jax.block_until_ready(
             distributed_kmeans_fit_kernel(
                 x_dev, mask_dev, key,
                 mesh=mesh, n_clusters=n_clusters, max_iter=max_iter, tol=tol,
             )
         )
+        step.note(n_iter=int(result[2]), cost=float(result[1]),
+                  converged=int(result[3]))
     n = x_host.shape[1]
     dt = x_padded.dtype
     n_iter = int(result[2])

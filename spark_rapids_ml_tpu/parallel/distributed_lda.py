@@ -23,6 +23,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -83,11 +84,17 @@ def distributed_lda_fit(
     # each EM pass psums the (k, vocab) sufficient-statistics tensor
     sstats_nbytes = collective_nbytes((k, vocab), dtype)
     key = jax.random.PRNGKey(seed)
-    with ctx.phase("execute"):
+    with ctx.phase("execute"), current_run().step(
+        "variational_em", rows=n_docs
+    ) as mon:
         for _ in range(max_iter):
             key, sub = jax.random.split(key)
             ctx.record_collective("all_reduce", nbytes=sstats_nbytes)
             lam = eta_val + em_sstats(x, lam, alpha_vec, sub)
+        # EM passes pipeline on device; block inside the step so its
+        # wall time covers the whole chain, not just the dispatches
+        lam = jax.block_until_ready(lam)
+        mon.note(n_iter=float(max_iter))
     ctx.set_iterations(max_iter)
-    return (np.asarray(jax.block_until_ready(lam), dtype=np.float64),
+    return (np.asarray(lam, dtype=np.float64),
             np.asarray(alpha_vec, dtype=np.float64))

@@ -16,6 +16,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -88,7 +89,9 @@ def distributed_linreg_fit(
         "all_reduce",
         nbytes=collective_nbytes((n * n + 2 * n + 2,), x_padded.dtype),
     )
-    with ctx.phase("execute"):
+    with ctx.phase("execute"), current_run().step(
+        "normal_equations", rows=x_host.shape[0]
+    ):
         return jax.block_until_ready(
             distributed_linreg_fit_kernel(
                 x_dev, y_dev, mask_dev,

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -92,7 +93,12 @@ def distributed_logreg_fit(
         shard1 = NamedSharding(mesh, P(DATA_AXIS))
         y_dev = jax.device_put(y_padded, shard1)
         mask_dev = jax.device_put(mask, shard1)
-    with ctx.phase("execute"):
+    # Newton iterations run inside the compiled while_loop, so the
+    # host-visible step is the whole blocked pass; realized iteration
+    # count rides along as a convergence scalar.
+    with ctx.phase("execute"), current_run().step(
+        "newton", rows=x_host.shape[0]
+    ) as step:
         result = jax.block_until_ready(
             distributed_logreg_fit_kernel(
                 x_dev, y_dev, mask_dev,
@@ -100,6 +106,7 @@ def distributed_logreg_fit(
                 max_iter=max_iter, tol=tol,
             )
         )
+        step.note(n_iter=int(result[2]), converged=int(result[3]))
     # one fused psum of (gradient, Hessian) per Newton iteration
     d = x_host.shape[1] + (1 if fit_intercept else 0)
     n_iter = int(result[2])

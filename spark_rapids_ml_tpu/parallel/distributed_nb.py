@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -108,7 +109,9 @@ def distributed_nb_fit(
             (n_classes * (1 + n_feat * (2 if need_sq else 1)),), dtype
         ),
     )
-    with ctx.phase("execute"):
+    with ctx.phase("execute"), current_run().step(
+        "class_stats", rows=x_host.shape[0]
+    ):
         counts, sums, sq = jax.block_until_ready(
             distributed_nb_stats_kernel(
                 x_dev, oh_dev, mesh=mesh, need_sq=need_sq)

@@ -36,6 +36,7 @@ from spark_rapids_ml_tpu.models.survival_regression import (
 )
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -186,14 +187,19 @@ def distributed_fm_fit(
     x_dev, y_dev, w_dev = _pad_rows(mesh, x_host, y_host, w, dtype=dtype)
     loss_fn = fm_logistic_loss_dp if classification else \
         fm_squared_loss_dp
-    params, n_iter, loss = jax.block_until_ready(
-        distributed_minimize_kernel(
-            params0,
-            (x_dev, y_dev, w_dev, jnp.asarray(reg_param, dtype=dtype)),
-            loss_fn=loss_fn, solver=solver, max_iter=max_iter, tol=tol,
-            step_size=step_size, mesh=mesh, row_args=3,
+    with current_run().step(
+        "minimize", rows=x_host.shape[0]
+    ) as mon:
+        params, n_iter, loss = jax.block_until_ready(
+            distributed_minimize_kernel(
+                params0,
+                (x_dev, y_dev, w_dev,
+                 jnp.asarray(reg_param, dtype=dtype)),
+                loss_fn=loss_fn, solver=solver, max_iter=max_iter,
+                tol=tol, step_size=step_size, mesh=mesh, row_args=3,
+            )
         )
-    )
+        mon.note(n_iter=int(n_iter), loss=float(loss))
     _note_grad_psums(current_fit(), params0, n_iter, dtype)
     host = {k: np.asarray(v, dtype=np.float64)
             for k, v in params.items()}
@@ -230,13 +236,17 @@ def distributed_aft_fit(
     w = np.ones(x_host.shape[0]) if weights is None else weights
     x_dev, logt_dev, cens_dev, w_dev = _pad_rows(
         mesh, x_host, np.log(t), cens, w, dtype=dtype)
-    params, n_iter, loss = jax.block_until_ready(
-        distributed_minimize_kernel(
-            params0, (x_dev, logt_dev, cens_dev, w_dev),
-            loss_fn=aft_neg_loglik_dp, solver=solver,
-            max_iter=max_iter, tol=tol, mesh=mesh, row_args=4,
+    with current_run().step(
+        "minimize", rows=x_host.shape[0]
+    ) as mon:
+        params, n_iter, loss = jax.block_until_ready(
+            distributed_minimize_kernel(
+                params0, (x_dev, logt_dev, cens_dev, w_dev),
+                loss_fn=aft_neg_loglik_dp, solver=solver,
+                max_iter=max_iter, tol=tol, mesh=mesh, row_args=4,
+            )
         )
-    )
+        mon.note(n_iter=int(n_iter), loss=float(loss))
     _note_grad_psums(current_fit(), params0, n_iter, dtype)
     host = {k: np.asarray(v, dtype=np.float64)
             for k, v in params.items()}
@@ -275,14 +285,18 @@ def distributed_mlp_fit(
         init_weights(layers, seed))
     x_dev, oh_dev, w_dev = _pad_rows(mesh, x_host, y_onehot, w,
                                      dtype=dtype)
-    params, n_iter, loss = jax.block_until_ready(
-        distributed_minimize_kernel(
-            params0, (x_dev, oh_dev, w_dev),
-            loss_fn=mlp_cross_entropy_dp, solver=solver,
-            max_iter=max_iter, tol=tol, step_size=step_size,
-            mesh=mesh, row_args=3,
+    with current_run().step(
+        "minimize", rows=x_host.shape[0]
+    ) as mon:
+        params, n_iter, loss = jax.block_until_ready(
+            distributed_minimize_kernel(
+                params0, (x_dev, oh_dev, w_dev),
+                loss_fn=mlp_cross_entropy_dp, solver=solver,
+                max_iter=max_iter, tol=tol, step_size=step_size,
+                mesh=mesh, row_args=3,
+            )
         )
-    )
+        mon.note(n_iter=int(n_iter), loss=float(loss))
     _note_grad_psums(current_fit(), params0, n_iter, dtype)
     host = jax.tree_util.tree_map(
         lambda a: np.asarray(a, dtype=np.float64), params)

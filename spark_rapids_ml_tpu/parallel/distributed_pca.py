@@ -35,6 +35,7 @@ from spark_rapids_ml_tpu.ops.covariance import (
 )
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -162,14 +163,19 @@ def distributed_pca_fit(
         ctx.record_collective(
             "all_reduce", nbytes=collective_nbytes((n, n), dt)
         )
-    with ctx.phase("execute"):
-        result = distributed_pca_fit_kernel(
-            x_dev,
-            mask_dev,
-            mesh=mesh,
-            k=k,
-            mean_centering=mean_centering,
-            one_pass=one_pass,
-            flip_signs=flip_signs,
+    with ctx.phase("execute"), current_run().step(
+        "covariance_eigh", rows=x_host.shape[0]
+    ) as step:
+        result = jax.block_until_ready(
+            distributed_pca_fit_kernel(
+                x_dev,
+                mask_dev,
+                mesh=mesh,
+                k=k,
+                mean_centering=mean_centering,
+                one_pass=one_pass,
+                flip_signs=flip_signs,
+            )
         )
-        return jax.block_until_ready(result)
+        step.note(k=k, one_pass=int(one_pass))
+        return result

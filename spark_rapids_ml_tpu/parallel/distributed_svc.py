@@ -17,6 +17,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -91,7 +92,9 @@ def distributed_svc_fit(
         shard1 = NamedSharding(mesh, P(DATA_AXIS))
         y_dev = jax.device_put(y_padded, shard1)
         mask_dev = jax.device_put(mask, shard1)
-    with ctx.phase("execute"):
+    with ctx.phase("execute"), current_run().step(
+        "newton", rows=x_host.shape[0]
+    ) as step:
         result = jax.block_until_ready(
             distributed_svc_fit_kernel(
                 x_dev, y_dev, mask_dev,
@@ -99,6 +102,7 @@ def distributed_svc_fit(
                 max_iter=max_iter, tol=tol,
             )
         )
+        step.note(n_iter=int(result[2]), converged=int(result[3]))
     # one fused psum of (gradient, Hessian) per generalized-Newton iteration
     d = x_host.shape[1] + (1 if fit_intercept else 0)
     n_iter = int(result[2])

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -151,27 +152,32 @@ def distributed_word2vec_fit(
         (2 * len(vocab) * (vector_size + 1) + 1,), dtype)
     step = 0
     last_loss = float("nan")
-    for _ in range(max_iter):
+    for epoch in range(max_iter):
         perm = rng.permutation(n_pairs)
-        for b in range(n_batches):
-            sel = perm[b * batch:(b + 1) * batch]
-            if sel.size < batch:
-                # keep shapes static even when the whole corpus is
-                # smaller than one shardable batch: cycle the permuted
-                # pairs until the batch is full
-                sel = np.resize(perm, batch)
-            lr = jnp.asarray(
-                max(lr0 * (1 - step / total_steps), lr0 * 1e-4),
-                dtype=dtype)
-            key, sub = jax.random.split(key)
-            obs_ctx.record_collective("all_reduce", nbytes=step_nbytes)
-            u, v, loss = distributed_sgns_step_kernel(
-                u, v,
-                jax.device_put(jnp.asarray(pairs[0, sel]), shard1),
-                jax.device_put(jnp.asarray(pairs[1, sel]), shard1),
-                sub, lr, noise_logits, mesh=mesh, k_neg=k_neg)
-            step += 1
-        last_loss = float(loss)
+        # the epoch-end float(loss) blocks on the last dispatched step,
+        # so the monitored step's wall time covers the whole epoch
+        with current_run().step("sgns_epoch", rows=n_pairs) as mon:
+            for b in range(n_batches):
+                sel = perm[b * batch:(b + 1) * batch]
+                if sel.size < batch:
+                    # keep shapes static even when the whole corpus is
+                    # smaller than one shardable batch: cycle the
+                    # permuted pairs until the batch is full
+                    sel = np.resize(perm, batch)
+                lr = jnp.asarray(
+                    max(lr0 * (1 - step / total_steps), lr0 * 1e-4),
+                    dtype=dtype)
+                key, sub = jax.random.split(key)
+                obs_ctx.record_collective("all_reduce",
+                                          nbytes=step_nbytes)
+                u, v, loss = distributed_sgns_step_kernel(
+                    u, v,
+                    jax.device_put(jnp.asarray(pairs[0, sel]), shard1),
+                    jax.device_put(jnp.asarray(pairs[1, sel]), shard1),
+                    sub, lr, noise_logits, mesh=mesh, k_neg=k_neg)
+                step += 1
+            last_loss = float(loss)
+            mon.note(loss=last_loss, epoch=float(epoch))
     u = jax.block_until_ready(u)
 
     model = Word2VecModel(

@@ -196,16 +196,18 @@ def make_global_array(local_rows: np.ndarray, mesh, n_global_rows: int):
     Multi-process: ``jax.make_array_from_process_local_data``, which places
     each host's rows on its local chips without any cross-host copy.
     """
+    import time
+
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
     sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    nbytes = int(getattr(local_rows, "nbytes", 0))
     try:
         from spark_rapids_ml_tpu.obs import current_fit, get_registry
 
-        nbytes = int(getattr(local_rows, "nbytes", 0))
         get_registry().counter(
             "sparkml_bytes_placed_total",
             "host→device bytes placed onto the global mesh",
@@ -213,8 +215,29 @@ def make_global_array(local_rows: np.ndarray, mesh, n_global_rows: int):
         current_fit().note(multihost_local_rows=int(local_rows.shape[0]))
     except Exception:
         pass
+    t0 = time.perf_counter()
     if jax.process_count() == 1:
-        return jax.device_put(local_rows, sharding)
-    return jax.make_array_from_process_local_data(
-        sharding, local_rows, (n_global_rows,) + local_rows.shape[1:]
-    )
+        out = jax.device_put(local_rows, sharding)
+    else:
+        out = jax.make_array_from_process_local_data(
+            sharding, local_rows, (n_global_rows,) + local_rows.shape[1:]
+        )
+    t1 = time.perf_counter()
+    try:
+        # this host's placement seconds are the skew/straggler input:
+        # each process reports its own seam time into the live FitRun,
+        # and the driver's skew() compares them against the fleet median
+        from spark_rapids_ml_tpu.obs import fitmon, spans
+
+        spans.record_event(
+            "multihost:placement", t0, t1,
+            rows=int(local_rows.shape[0]), nbytes=nbytes,
+        )
+        run = fitmon.current_run()
+        run.note_host_step(f"host{jax.process_index()}", t1 - t0)
+        run.record_collective(
+            "placement", nbytes=nbytes, count=1, seconds=t1 - t0
+        )
+    except Exception:
+        pass
+    return out

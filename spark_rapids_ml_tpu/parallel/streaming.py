@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.obs import (
     current_fit,
+    current_run,
     fit_instrumentation,
     tracked_jit,
 )
@@ -181,11 +182,20 @@ def distributed_streaming_pca_fit(
     n_batches = 0
     with ctx.phase("stream"):
         for batch, mask in source.batches():
-            acc.partial_fit(batch.astype(host_dtype, copy=False), mask)
+            # accumulator updates pipeline on device — each fold's step
+            # measures the host-side fold time (placement + dispatch)
+            with current_run().step(
+                "stream_fold", rows=batch.shape[0]
+            ) as mon:
+                acc.partial_fit(
+                    batch.astype(host_dtype, copy=False), mask)
+                mon.note(fold=float(n_batches))
             n_batches += 1
     ctx.set_data(rows=acc.rows_seen, features=source.n_features)
     ctx.note(batches_streamed=n_batches)
     if mean_centering and acc.rows_seen < 2:
         raise ValueError("mean centering requires more than one row")
-    with ctx.phase("finalize"):
+    with ctx.phase("finalize"), current_run().step(
+        "finalize", rows=acc.rows_seen
+    ):
         return acc.finalize(k, mean_centering=mean_centering, solver=solver)

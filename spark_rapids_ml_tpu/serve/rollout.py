@@ -106,7 +106,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import fitmon, get_registry, tracectx
 from spark_rapids_ml_tpu.obs import spans as spans_mod
 from spark_rapids_ml_tpu.obs.logging import get_logger
 from spark_rapids_ml_tpu.obs.quantiles import QuantileSketch
@@ -899,6 +899,7 @@ class StreamingTrainer:
         self._rollout = rollout
         self._acc = None
         self._lock = threading.Lock()
+        self._fit_run = None
         self._batches = 0
         self._published: List[int] = []
         self._stop = threading.Event()
@@ -946,6 +947,25 @@ class StreamingTrainer:
                 self.n_features, self._mesh, dtype=dtype)
         return self._acc
 
+    def _fitmon_run(self):
+        """The FitRun covering the current publish cycle (lazy, one per
+        published version). Fold steps and the publish finalize land in
+        it, so ``GET /debug/fit`` shows the streaming fit's history the
+        same way it shows one-shot distributed fits. Never raises."""
+        try:
+            monitor = fitmon.get_fit_monitor()
+            if not monitor.enabled:
+                return None
+            if self._fit_run is None:
+                self._fit_run = monitor.start_run(
+                    f"streaming_trainer:{self.name}")
+            return self._fit_run
+        except Exception:
+            # monitoring must never take the trainer down — but a broken
+            # fitmon seam must still be a counted error, not a silent one
+            self._m_errors.inc(model=self.name, error="fitmon")
+            return None
+
     def feed(self, batch, mask=None) -> Optional[int]:
         """Fold one batch; returns the newly published version when
         this batch crossed the publish cadence, else None."""
@@ -970,7 +990,12 @@ class StreamingTrainer:
                 [x, np.zeros((rem, x.shape[1]), dtype=x.dtype)])
             mask = np.concatenate([mask, np.zeros((rem,), dtype=bool)])
         with self._lock:
-            acc.partial_fit(x, mask)
+            # disabled fitmon: current_run() is the inert null run,
+            # whose step() costs nothing
+            run = self._fitmon_run() or fitmon.current_run()
+            with run.step("fold", rows=x.shape[0]) as mon:
+                acc.partial_fit(x, mask)
+                mon.note(fold=float(self._batches))
             self._batches += 1
             n_batches = self._batches
         self._m_batches.inc(model=self.name)
@@ -989,16 +1014,33 @@ class StreamingTrainer:
                 return None
             if self.mean_centering and acc.rows_seen < 2:
                 return None
+            run = self._fitmon_run() or fitmon.current_run()
             with spans_mod.span(f"serve:rollout:publish:{self.name}",
                                 model=self.name):
-                result = acc.finalize(self.k,
-                                      mean_centering=self.mean_centering)
+                with run.step("publish_finalize",
+                              rows=acc.rows_seen) as mon:
+                    result = acc.finalize(
+                        self.k, mean_centering=self.mean_centering)
+                    mon.note(k=float(self.k))
                 model = self._build_model(result)
                 path = self._persist(model)
                 version = self.registry.register(
                     self.name, model, buckets=self.buckets,
                     source_path=path)
                 self._published.append(version)
+            finished_run, self._fit_run = self._fit_run, None
+        if finished_run is not None:
+            # one FitRun per published version: close it with the
+            # publish outcome so /debug/fit's history maps 1:1 to the
+            # registry's version stream
+            try:
+                fitmon.get_fit_monitor().finish_run(finished_run, report={
+                    "version": int(version),
+                    "rows": int(acc.rows_seen),
+                    "batches": int(self._batches),
+                })
+            except Exception:
+                self._m_errors.inc(model=self.name, error="fitmon")
         self._m_published.inc(model=self.name)
         _log.info("streaming trainer published", model=self.name,
                   version=version, batches=self._batches,
@@ -1062,6 +1104,17 @@ class StreamingTrainer:
         thread = self._thread
         if thread is not None:
             thread.join(timeout)
+        with self._lock:
+            run, self._fit_run = self._fit_run, None
+        if run is not None:
+            # close a mid-cycle run so it doesn't linger as active in
+            # /debug/fit after the trainer is gone
+            try:
+                fitmon.get_fit_monitor().finish_run(
+                    run, report={"aborted": True,
+                                 "batches": int(self._batches)})
+            except Exception:
+                self._m_errors.inc(model=self.name, error="fitmon")
 
     @property
     def batches_fed(self) -> int:

@@ -103,6 +103,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
 from spark_rapids_ml_tpu.obs import accounting as accounting_mod
+from spark_rapids_ml_tpu.obs import fitmon as fitmon_mod
 from spark_rapids_ml_tpu.obs import incidents as incidents_mod
 from spark_rapids_ml_tpu.obs import profiler as profiler_mod
 from spark_rapids_ml_tpu.obs import spans as spans_mod
@@ -464,6 +465,8 @@ def make_handler(engine: ServeEngine):
                 status = self._reply(200, engine.autoscale_snapshot())
             elif path == "/debug/costs":
                 status = self._reply(200, engine.costs_snapshot())
+            elif path == "/debug/fit":
+                status = self._reply(200, fitmon_mod.debug_fit_doc())
             elif path == "/dashboard":
                 status = self._reply_text(
                     200, DASHBOARD_HTML, "text/html; charset=utf-8")
@@ -896,6 +899,8 @@ DASHBOARD_HTML = """<!DOCTYPE html>
     <tbody id="slo-rows"></tbody></table>
   <h2>Serving replicas</h2>
   <div id="replicas" class="quiet">—</div>
+  <h2>Fit runs</h2>
+  <div id="fit" class="quiet">—</div>
   <h2>Incidents</h2>
   <div id="incidents" class="quiet">—</div>
   <h2>Circuit breakers</h2>
@@ -1124,6 +1129,9 @@ async function refresh() {
     var inc = {};
     try { inc = await (await fetch("/debug/incidents")).json(); }
     catch (err) { inc = {}; }
+    var fit = {};
+    try { fit = await (await fetch("/debug/fit")).json(); }
+    catch (err) { fit = {}; }
     var incOpen = inc.open || [], incRecent = inc.recent || [];
     var qdSeries = ((hist.key || {}).queue_depth || []);
     var qdPoints = qdSeries.length ? sumSeries(qdSeries) : null;
@@ -1159,6 +1167,15 @@ async function refresh() {
         autoscale.replicas + " / [" + autoscale.min + "\\u2013"
           + autoscale.max + "]"
           + (autoscale.running ? "" : " (stopped)")));
+    }
+    var wd = fit.watchdog || null;
+    if (wd && wd.checked_unix != null) {
+      tiles.push(tile("Fit backend", wd.ok
+        ? statusSpan("good", "\\u25cf " + (wd.platform || "ok"))
+        : statusSpan("critical", "\\u25cf " + (wd.reason || "degraded"))));
+    }
+    if ((fit.active || []).length) {
+      tiles.push(tile("Active fits", fit.active.length));
     }
     (slo.slos || []).forEach(function (s) {
       tiles.push(tile("Budget left · " + s.name,
@@ -1201,6 +1218,26 @@ async function refresh() {
             tiles.join("") + "</div>";
         }).join("")
       : "no models served yet";
+    var fitRuns = (fit.active || []).concat(fit.recent || []);
+    document.getElementById("fit").innerHTML = fitRuns.length
+      ? "<table><thead><tr><th>Run</th><th>Algo</th><th>Status</th>" +
+        "<th>Steps</th><th>Rows/s</th><th>Device s</th><th>MFU</th>" +
+        "<th>Stragglers</th></tr></thead><tbody>" +
+        fitRuns.map(function (r) {
+          var mfu = r.mfu_mean == null ? "\\u2013"
+            : (100 * r.mfu_mean).toFixed(1) + "%";
+          var strag = (r.stragglers || []).join(" ") || "\\u2013";
+          return "<tr><td class=mono>" + r.run_id + "</td>" +
+            "<td class=name>" + r.algo + "</td><td>" +
+            statusSpan(r.status === "running" ? "warning" : "good",
+                       "\\u25cf " + r.status) + "</td><td>" + r.steps +
+            (r.steps_failed ? " (" + r.steps_failed + " failed)" : "") +
+            "</td><td>" + fmtVal(r.rows_per_sec) + "</td><td>" +
+            fmtVal(r.device_seconds) + "</td><td>" + mfu + "</td>" +
+            "<td class=name>" + strag + "</td></tr>";
+        }).join("") + "</tbody></table>"
+      : "no fit runs yet \\u2014 distributed fits and the streaming " +
+        "trainer report here";
     document.getElementById("incidents").innerHTML =
       (incOpen.length || incRecent.length)
         ? "<table><thead><tr><th>Detector</th><th>Severity</th>" +

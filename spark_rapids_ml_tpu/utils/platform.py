@@ -35,3 +35,18 @@ PEAK_FLOPS_BF16 = {
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
 }
+
+# Peak per-chip HBM bandwidth (bytes/s) by device kind — the roofline's
+# second axis. A step whose arithmetic intensity (FLOPs / bytes accessed)
+# sits below the ridge point ``peak_flops / peak_bw`` is memory-bound;
+# above it, compute-bound. Same contract as the FLOPs table: unknown
+# kinds (CPU included) report None, never a made-up number.
+PEAK_HBM_BYTES_PER_SECOND = {
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
