@@ -785,6 +785,67 @@ def check_ledger_audit(path: str):
                    "cross-check against the devices (rule 15)")
 
 
+# rule 17: the tiering lifecycle (serve/tiering.py) is the plane that
+# unloads models from the devices — every tier-transition path must
+# carry a decision counter .inc or a serve:tiering audit span in the
+# same function. A model that goes COLD with nothing on the dashboard
+# is capacity that vanished unauditably; a reactivation nobody can see
+# is an unexplainable first-hit latency cliff.
+TIERING_FILE = os.path.join(
+    REPO, "spark_rapids_ml_tpu", "serve", "tiering.py"
+)
+_TIER_TRANSITION_NAMES = frozenset({"pin", "unpin"})
+_TIER_TRANSITION_PREFIXES = ("deactivate", "reactivate", "evaluate",
+                             "transition", "tick")
+_TIER_MUTATION_CALLS = frozenset({"deactivate", "reactivate",
+                                  "_deactivate", "_reactivate"})
+# the sanctioned accounting spellings: a metrics .inc / audit span
+# directly, or the tiering module's own _event funnel (which resolves
+# to sparkml_serve_tiering_total + serve:tiering audit events)
+_TIER_ACCOUNTING = frozenset({"inc", "record_event", "span", "_event",
+                              "_count", "_audit"})
+
+
+def check_tiering_transitions(path: str):
+    """Rule 17: yield (lineno, description) for every unaccounted
+    tier-transition path in the tiering module.
+
+    A transition path is a function DEF named ``pin``/``unpin`` (or
+    prefixed ``deactivate``/``reactivate``/``evaluate``/``transition``/
+    ``tick``, underscore-insensitive), or any function whose body calls
+    a ``deactivate``/``reactivate`` lifecycle mutation; the same
+    function must carry a decision counter ``.inc(...)``, an audit
+    ``record_event``/``span``, or the module's ``_event`` accounting
+    funnel."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        bare = node.name.lstrip("_")
+        is_transition = (bare in _TIER_TRANSITION_NAMES
+                         or bare.startswith(_TIER_TRANSITION_PREFIXES))
+        if not is_transition:
+            for child in ast.walk(node):
+                if (isinstance(child, ast.Call)
+                        and _call_name(child) in _TIER_MUTATION_CALLS):
+                    is_transition = True
+                    break
+        if not is_transition:
+            continue
+        accounts = any(
+            isinstance(child, ast.Call)
+            and _call_name(child) in _TIER_ACCOUNTING
+            for child in ast.walk(node)
+        )
+        if not accounts:
+            yield (node.lineno,
+                   f"tier-transition path {node.name}() without a "
+                   "decision counter .inc(...) or serve:tiering audit "
+                   "record_event/span in the same function — a model "
+                   "that changes tier with nothing on the dashboard is "
+                   "unauditable capacity drift (rule 17)")
+
+
 # rule 11: the wire boundary — server body decoding must route through
 # serve/wire.py, whose decoders must record the parse-phase latency.
 SERVER_FILE = os.path.join(
@@ -1090,6 +1151,10 @@ def main() -> int:
         rel = os.path.relpath(ACCOUNTING_FILE, REPO)
         for lineno, why in check_ledger_audit(ACCOUNTING_FILE):
             offenders.append(f"{rel}:{lineno} {why}")
+    if os.path.exists(TIERING_FILE):
+        rel = os.path.relpath(TIERING_FILE, REPO)
+        for lineno, why in check_tiering_transitions(TIERING_FILE):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -1117,7 +1182,8 @@ def main() -> int:
         f"module(s) with every hit/miss/evict/invalidate and "
         f"scale-up/scale-down decision counted or audit-spanned; "
         f"cost-ledger mutation paths all counted or audit-spanned; "
-        f"every fit entry point enters a fitmon step span"
+        f"every fit entry point enters a fitmon step span; "
+        f"tiering tier-transition paths all counted or audit-spanned"
     )
     return 0
 

@@ -620,6 +620,21 @@ class ResourceLedger:
         residency breakdown, the ranked cold-model report (the exact
         input a tiering controller evicts by), and the reconciliation
         verdict."""
+        models = self._model_rollups()
+        cold = self._cold_report(models)
+        return {"models": models, "cold_report": cold,
+                "reconcile": self.reconcile()}
+
+    def cold_report(self) -> List[Dict[str, Any]]:
+        """The ranked cold-model report alone — the ONE source of truth
+        the tiering controller's eviction scorer reads, identical row
+        for row to ``costs_document()["cold_report"]`` (and therefore to
+        ``GET /debug/costs``)."""
+        return self._cold_report(self._model_rollups())
+
+    def _model_rollups(self) -> Dict[str, Any]:
+        """Per-model rollups (residency components, replicas, traffic
+        vitals) shared by ``costs_document`` and ``cold_report``."""
         now = self._clock()
         with self._lock:
             labels = sorted(set(self._vitals)
@@ -657,9 +672,7 @@ class ResourceLedger:
                         for (tenant, priority), row
                         in sorted(vitals.tenants.items())},
                 }
-        cold = self._cold_report(models)
-        return {"models": models, "cold_report": cold,
-                "reconcile": self.reconcile()}
+        return models
 
     @staticmethod
     def _cold_report(models: Dict[str, Any]) -> List[Dict[str, Any]]:

@@ -56,7 +56,7 @@ import pickle
 import struct
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_"
 CACHE_DIR_ENV = ENV_PREFIX + "CACHE_DIR"
@@ -188,7 +188,12 @@ class ExecutableCache:
         self._lock = threading.Lock()
         self._evict_lock = threading.Lock()
         self._local = {"hit": 0, "miss": 0, "store": 0, "evict": 0,
-                       "invalidate": 0, "error": 0}
+                       "evict_forced": 0, "invalidate": 0, "error": 0}
+        # tiering protection hook (set_protect): predicate over entry
+        # labels marking executables a COLD-but-registered model still
+        # needs, plus the byte floor their population never drops below
+        self._protect_fn: Optional[Callable[[str], bool]] = None
+        self._protect_floor = 0
         os.makedirs(self.path, exist_ok=True)
 
     # -- plumbing ----------------------------------------------------------
@@ -411,14 +416,38 @@ class ExecutableCache:
         if count_evict:
             self._count("evict")
 
+    def set_protect(self, predicate: Optional[Callable[[str], bool]],
+                    floor_bytes: int = 0) -> None:
+        """Install the tiering protection hook. ``predicate`` receives
+        each entry's (sanitized) label and marks executables that a
+        COLD-but-registered model still depends on for its fast
+        reactivation: protected entries are evicted LAST, and only while
+        the protected population would stay at or above ``floor_bytes``
+        — every such eviction is FORCED (counted as ``evict_forced``).
+        ``predicate=None`` clears the hook."""
+        with self._evict_lock:
+            self._protect_fn = predicate
+            self._protect_floor = max(int(floor_bytes), 0)
+
+    @staticmethod
+    def _entry_label(path: str) -> str:
+        """The sanitized label portion of an entry filename
+        (``{label}-{digest}.aotx`` — the digest never contains '-')."""
+        return os.path.basename(path)[:-len(".aotx")].rsplit("-", 1)[0]
+
     def _evict_to_cap(self) -> None:
         """Oldest-mtime LRU eviction down to ``max_bytes`` (hits touch
-        their entry's mtime). Serialized on the instance lock so racing
+        their entry's mtime), in two passes: unprotected entries first;
+        then, only if still over cap, protected entries — stopping at
+        the protected floor, each deletion counted as a forced eviction
+        (``set_protect``). Serialized on the eviction lock so racing
         stores don't double-delete."""
         if self.max_bytes <= 0:
             return
         t0 = time.perf_counter()
         with self._evict_lock:
+            protect = self._protect_fn
+            floor = self._protect_floor
             try:
                 entries = []
                 total = 0
@@ -432,8 +461,22 @@ class ExecutableCache:
             except OSError:
                 self._count_error("io_scan")
                 return
-            evicted = []
-            for mtime, size, path in sorted(entries):
+            plain, shielded = [], []
+            shielded_total = 0
+            for row in sorted(entries):
+                keep = False
+                if protect is not None:
+                    try:
+                        keep = bool(protect(self._entry_label(row[2])))
+                    except Exception:
+                        self._count_error("protect")
+                if keep:
+                    shielded.append(row)
+                    shielded_total += row[1]
+                else:
+                    plain.append(row)
+            evicted, forced = [], []
+            for _mtime, size, path in plain:
                 if total <= self.max_bytes:
                     break
                 try:
@@ -442,9 +485,27 @@ class ExecutableCache:
                     continue
                 total -= size
                 evicted.append(os.path.basename(path))
+            for _mtime, size, path in shielded:
+                if total <= self.max_bytes:
+                    break
+                if shielded_total - size < floor:
+                    # the floor wins over the cap: a COLD model's
+                    # reactivation path outranks disk pressure
+                    break
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+                total -= size
+                shielded_total -= size
+                forced.append(os.path.basename(path))
         for name in evicted:
             self._count("evict")
             self._audit("evict", name, t0)
+        for name in forced:
+            self._count("evict")
+            self._count("evict_forced")
+            self._audit("evict", name, t0, forced=True)
 
     # -- introspection -----------------------------------------------------
 

@@ -132,6 +132,9 @@ from spark_rapids_ml_tpu.serve.rollout import (  # noqa: F401
 from spark_rapids_ml_tpu.serve.autoscale import (  # noqa: F401
     AutoscaleController,
 )
+from spark_rapids_ml_tpu.serve.tiering import (  # noqa: F401
+    TieringController,
+)
 from spark_rapids_ml_tpu.serve.server import (  # noqa: F401
     make_handler,
     start_serve_server,
@@ -166,6 +169,7 @@ __all__ = [
     "RolloutController",
     "ServeEngine",
     "StreamingTrainer",
+    "TieringController",
     "ShedController",
     "ShedLoad",
     "TokenBucket",

@@ -118,9 +118,15 @@ class AutoscaleController:
         step: Optional[int] = None,
         occupancy_window_s: float = 5.0,
         signals_fn: Optional[Callable[[], Dict[str, float]]] = None,
+        model: Optional[str] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.engine = engine
+        # model-scoped envelope (the tiering plane attaches one per
+        # ACTIVE model): signals and actuation read/resize ONLY that
+        # model's replica sets — scale decisions on model A never
+        # resize model B. None = the engine-wide controller.
+        self.model = str(model) if model else None
         base = max(engine.placer.base_device_count(), 1)
         self.min_replicas = max(int(
             min_replicas if min_replicas is not None
@@ -180,6 +186,13 @@ class AutoscaleController:
             "sparkml_serve_autoscale_replicas",
             "the autoscale controller's current replica target",
         )
+        self._m_model_replicas = reg.gauge(
+            "sparkml_serve_autoscale_model_replicas",
+            "a model-scoped autoscale envelope's current replica "
+            "target", ("model",),
+        )
+        self._err_label = (f"(autoscale:{self.model})" if self.model
+                           else "(autoscale)")
         self._m_errors = reg.counter(
             "sparkml_serve_errors_total",
             "serving errors by type: batch failures (exception class), "
@@ -191,21 +204,38 @@ class AutoscaleController:
         # clamp the engine into bounds so the loop starts from a sane
         # actuator state (an engine at 8 replicas under a max of 4 would
         # otherwise take max/step ticks just to reach its own ceiling)
-        start = min(max(engine.replica_scale(), self.min_replicas),
+        start = min(max(self._scale(), self.min_replicas),
                     self.max_replicas)
-        if start != engine.replica_scale():
+        if start != self._scale():
             self._apply(start, "bound", {"reason": "startup_clamp"})
-        self._m_replicas.set(engine.replica_scale())
+        self._set_replica_gauge(self._scale())
+
+    # -- the model-scoped indirection --------------------------------------
+
+    def _scale(self) -> int:
+        """The actuator state this controller owns: one model's replica
+        count when scoped, the engine-wide target otherwise."""
+        if self.model:
+            return self.engine.model_replica_scale(self.model)
+        return self.engine.replica_scale()
+
+    def _set_replica_gauge(self, value: int) -> None:
+        if self.model:
+            self._m_model_replicas.set(value, model=self.model)
+        else:
+            self._m_replicas.set(value)
 
     # -- signals -----------------------------------------------------------
 
     def signals(self) -> Dict[str, float]:
         """The live control inputs (one bounded read each — the PR 10
         never-per-request lesson): queue-wait EWMA, shed level, SLO
-        fast-burn, mean active-device occupancy from the TSDB."""
+        fast-burn, mean active-device occupancy from the TSDB. A
+        model-scoped envelope reads ITS model's queue signals."""
         if self._signals_fn is not None:
             return dict(self._signals_fn())
-        overload = self.engine._overload_signals()
+        overload = (self.engine._overload_signals_for(self.model)
+                    if self.model else self.engine._overload_signals())
         shed_level = 0
         try:
             # shed_posture(), not a raw level() read: de-escalation
@@ -215,7 +245,7 @@ class AutoscaleController:
             # (the PR 10 /readyz lesson applied to this controller)
             shed_level = int(self.engine.shed_posture().level())
         except Exception:
-            self._m_errors.inc(model="(autoscale)", error="shed_signal")
+            self._m_errors.inc(model=self._err_label, error="shed_signal")
         occupancy = 0.0
         try:
             occ = self._devmon.occupancy(self.occupancy_window_s)
@@ -226,7 +256,7 @@ class AutoscaleController:
             if active:
                 occupancy = float(sum(active) / len(active))
         except Exception:
-            self._m_errors.inc(model="(autoscale)", error="occupancy")
+            self._m_errors.inc(model=self._err_label, error="occupancy")
         return {
             "queue_wait_s": float(overload.get("queue_wait_s", 0.0)),
             "depth_frac": float(overload.get("depth_frac", 0.0)),
@@ -268,7 +298,7 @@ class AutoscaleController:
         (``scale_up`` / ``scale_down`` / ``hold``)."""
         now = self._clock()
         signals = self.signals()
-        scale = self.engine.replica_scale()
+        scale = self._scale()
         with self._lock:
             self._last_signals = dict(signals)
         hot_reasons = self._is_hot(signals)
@@ -310,7 +340,7 @@ class AutoscaleController:
         # the reaper rides the control cadence: retired replicas whose
         # queues drained are closed here, never on the request path
         self.engine.reap_retired()
-        self._m_replicas.set(self.engine.replica_scale())
+        self._set_replica_gauge(self._scale())
         return decision
 
     def _cooldown_over(self, now: float) -> bool:
@@ -327,20 +357,25 @@ class AutoscaleController:
         unauditable capacity change)."""
         t0 = time.perf_counter()
         now = self._clock()
-        before = self.engine.replica_scale()
+        before = self._scale()
         try:
-            report = self.engine.scale_replicas(target)
+            report = (self.engine.scale_model_replicas(self.model,
+                                                       target)
+                      if self.model
+                      else self.engine.scale_replicas(target))
         except Exception as exc:  # noqa: BLE001 - loop must survive
-            self._m_errors.inc(model="(autoscale)", error="scale")
+            self._m_errors.inc(model=self._err_label, error="scale")
             _log.error("autoscale actuation failed", decision=decision,
                        target=target, error=type(exc).__name__)
             return
-        after = self.engine.replica_scale()
+        after = self._scale()
         if decision in (SCALE_UP, SCALE_DOWN):
             self._m_decisions.inc(decision=decision)
-        self._m_replicas.set(after)
+        self._set_replica_gauge(after)
         attrs = {k: (round(v, 4) if isinstance(v, float) else v)
                  for k, v in signals.items()}
+        if self.model:
+            attrs["model"] = self.model
         spans_mod.record_event(
             f"serve:autoscale:{decision}", t0, time.perf_counter(),
             replicas_before=before, replicas_after=after, **attrs)
@@ -373,12 +408,14 @@ class AutoscaleController:
                 except Exception:  # noqa: BLE001 - loop must survive
                     # visible, never silent: a dead controller is a
                     # frozen replica count under moving load
-                    self._m_errors.inc(model="(autoscale)",
+                    self._m_errors.inc(model=self._err_label,
                                        error="controller")
                 self._stop.wait(self.interval_s)
 
+        name = ("sparkml-autoscale" if not self.model
+                else f"sparkml-autoscale-{self.model}")
         self._thread = tracectx.traced_thread(
-            _loop, name="sparkml-autoscale", daemon=True, fresh=True)
+            _loop, name=name, daemon=True, fresh=True)
         self._thread.start()
 
     def stop(self, timeout: float = 10.0) -> None:
@@ -418,10 +455,11 @@ class AutoscaleController:
             }
         except Exception:
             # snapshot degrades to signals-only; visible (rule 6)
-            self._m_errors.inc(model="(autoscale)", error="ledger_read")
+            self._m_errors.inc(model=self._err_label, error="ledger_read")
             accounted = {}
         return {
-            "replicas": self.engine.replica_scale(),
+            "model": self.model,
+            "replicas": self._scale(),
             "min": self.min_replicas,
             "max": self.max_replicas,
             "running": self.running,

@@ -477,6 +477,10 @@ class ServeEngine:
         # the autoscale control plane (serve.autoscale), attached via
         # attach_autoscale: replica-count actuation + /debug surface
         self._autoscale = None
+        # the hot/cold tiering plane (serve.tiering), attached via
+        # attach_tiering: lifecycle actuation + admission reactivation
+        # gate + /debug/tiering surface
+        self._tiering = None
         # hot-path metric handles, resolved once (same convention as
         # MicroBatcher._declare_metrics)
         reg = get_registry()
@@ -1468,6 +1472,30 @@ class ServeEngine:
         return {"burn": burn, "queue_wait_s": wait,
                 "depth_frac": depth_frac}
 
+    def _overload_signals_for(self, model: str) -> Dict[str, float]:
+        """Per-model overload signals for a model-scoped autoscale
+        envelope (``serve.autoscale`` with ``model=``): queue wait and
+        depth fraction over THIS model's batchers only — a hot model's
+        queues never resize a quiet one. Burn stays engine-global (the
+        SLO ledger is not segmented by model)."""
+        with self._lock:
+            batchers = [
+                replica.batcher
+                for (name, _v), rset in self._replicas.items()
+                if name == model
+                for replica in rset.replicas
+                if replica.batcher is not None
+            ]
+        wait = max((b.queue_wait_estimate() for b in batchers),
+                   default=0.0)
+        depth_frac = max(
+            (b.depth() / b.max_queue_depth
+             for b in batchers if b.max_queue_depth > 0),
+            default=0.0)
+        burn = self.slo.fast_burn_rate() if len(self.slo) else 0.0
+        return {"burn": burn, "queue_wait_s": wait,
+                "depth_frac": depth_frac}
+
     def shed_posture(self):
         """Refresh-then-read the shed controller, for probes.
 
@@ -1831,6 +1859,46 @@ class ServeEngine:
         self.reap_retired()
         return report
 
+    def model_replica_scale(self, model: str) -> int:
+        """The current replica count of ONE model's sets (the actuator
+        state a model-scoped autoscale envelope reads). Falls back to
+        the engine-wide target when the model holds no sets yet (first
+        tick before warmup, or a COLD model)."""
+        with self._lock:
+            counts = [rset.active_count()
+                      for (name, _v), rset in self._replicas.items()
+                      if name == model]
+        if counts:
+            return max(counts)
+        return self.replica_scale()
+
+    def scale_model_replicas(self, model: str,
+                             target: int) -> Dict[str, Any]:
+        """Move ONE model's async-capable replica sets to ``target``
+        replicas (clamped to [1, visible devices]) without touching any
+        other model or the engine-wide placer target — the actuator a
+        model-scoped autoscale envelope drives, so scale decisions on
+        model A never resize model B."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("serving engine is shut down")
+            sets = {key: rset for key, rset in self._replicas.items()
+                    if key[0] == model}
+        target = max(1, min(int(target),
+                            max(self.placer.base_device_count(), 1)))
+        report: Dict[str, Any] = {"model": model, "target": target,
+                                  "resized": {}}
+        for (name, version), rset in sets.items():
+            try:
+                entry = self.registry.resolve_entry(name, version)
+            except KeyError:
+                continue  # stale set; the usual eviction sweep owns it
+            delta = self._resize_replica_set(entry, rset, target)
+            if delta:
+                report["resized"][f"{name}@{version}"] = delta
+        self.reap_retired()
+        return report
+
     def _resize_replica_set(self, entry: RegisteredModel,
                             rset: ReplicaSet,
                             target: int) -> Optional[Dict[str, int]]:
@@ -2034,6 +2102,85 @@ class ServeEngine:
         doc = controller.snapshot()
         doc["enabled"] = True
         return doc
+
+    # -- the tiering plane (serve.tiering drives these) --------------------
+
+    def deactivate(self, name: str) -> List[str]:
+        """Park every (name, *) replica set COLD: batchers close with a
+        full drain (queued work is never dropped), and the staged
+        weights + reaped reserve + executable bytes leave the accounted
+        residency via the ledger — while the registry entry, its
+        manifest ``warmed_buckets``, and the on-disk ``.aotx``
+        executables all SURVIVE, so reactivation is a disk replay, not
+        a recompile. Returns the version refs that were parked."""
+        with self._lock:
+            versions = sorted(v for (n, v) in self._replicas
+                              if n == name)
+        dropped = []
+        for version in versions:
+            if self.evict(name, version, drain=True):
+                dropped.append(f"{name}@{version}")
+        return dropped
+
+    def reactivate(self, name: str) -> Dict[str, Any]:
+        """Rebuild a COLD model's replica tier from its warm manifest
+        through the persistent executable cache: one ``prime()`` per
+        signature — a disk load, never a fresh XLA compile (the tiering
+        tests count signatures to hold this). Models without a
+        primeable program fall back to the executing warmup."""
+        entry = self.registry.resolve_entry(name)
+        buckets = entry.warmed_buckets or entry.buckets or ()
+        with self._ledger.compile_attribution(entry.name, entry.version):
+            if not self._prime_replicas(entry, buckets):
+                self.warmup(name)
+        return {"model": entry.name, "version": entry.version,
+                "buckets": sorted(int(b) for b in buckets)}
+
+    def model_algos(self, name: str) -> Tuple[str, ...]:
+        """The kernel-label algo prefixes this model's serving programs
+        compile under (``pca``, ``kmeans``, ``pipeline_fused_…``) —
+        what the tiering controller keeps protected in the executable
+        cache while the model is COLD. Reads the live replica sets when
+        present, else derives from the registered model's class."""
+        algos = set()
+        with self._lock:
+            rsets = [rset for (n, _v), rset in self._replicas.items()
+                     if n == name]
+        for rset in rsets:
+            for replica in rset.replicas:
+                prog = replica.spec.program if replica.spec else None
+                algo = getattr(prog, "algo", None)
+                if algo:
+                    algos.add(str(algo))
+        if not algos:
+            try:
+                entry = self.registry.resolve_entry(name)
+            except KeyError:
+                return ()
+            from spark_rapids_ml_tpu.obs.serving import _derive_algo
+
+            algos.add(_derive_algo(entry.model))
+        return tuple(sorted(algos))
+
+    def attach_tiering(self, controller) -> None:
+        """Install a ``serve.tiering.TieringController``: its
+        ``ensure_active`` gate binds into admission (the first request
+        to a COLD model blocks there through reactivation instead of
+        404ing), and its snapshot serves ``GET /debug/tiering`` + the
+        dashboard tile."""
+        self._tiering = controller
+        self.admission.bind_tiering(controller.ensure_active)
+
+    def tiering_controller(self):
+        return getattr(self, "_tiering", None)
+
+    def tiering_snapshot(self) -> Dict[str, Any]:
+        """The ``GET /debug/tiering`` payload (``{"enabled": False}``
+        without an attached controller)."""
+        controller = getattr(self, "_tiering", None)
+        if controller is None:
+            return {"enabled": False}
+        return controller.snapshot()
 
     def costs_snapshot(self) -> Dict[str, Any]:
         """The ``GET /debug/costs`` payload: the resource ledger's

@@ -444,6 +444,7 @@ def make_handler(engine: ServeEngine):
                 snap["replicas"] = engine.replica_snapshot()
                 snap["rollout"] = engine.rollout_snapshot()
                 snap["autoscale"] = engine.autoscale_snapshot()
+                snap["tiering"] = engine.tiering_snapshot()
                 status = self._reply(200, snap)
             elif path == "/debug/history":
                 params = urllib.parse.parse_qs(parsed.query)
@@ -463,6 +464,8 @@ def make_handler(engine: ServeEngine):
                 status = self._reply(200, engine.rollout_snapshot())
             elif path == "/debug/autoscale":
                 status = self._reply(200, engine.autoscale_snapshot())
+            elif path == "/debug/tiering":
+                status = self._reply(200, engine.tiering_snapshot())
             elif path == "/debug/costs":
                 status = self._reply(200, engine.costs_snapshot())
             elif path == "/debug/fit":
@@ -917,6 +920,12 @@ function fmtPct(v) {
 function fmtBurn(v) {
   return (v == null) ? "–" : v.toFixed(2);
 }
+function fmtBytes(v) {
+  if (v == null) return "–";
+  var units = ["B", "KiB", "MiB", "GiB", "TiB"], i = 0;
+  while (v >= 1024 && i < units.length - 1) { v /= 1024; i += 1; }
+  return v.toFixed(v >= 10 || i === 0 ? 0 : 1) + " " + units[i];
+}
 function stateFor(slo) {
   if (slo.alerts.some(a => a.severity === "page_fast"))
     return ["critical", "\\u25cf paging (fast)"];
@@ -1167,6 +1176,18 @@ async function refresh() {
         autoscale.replicas + " / [" + autoscale.min + "\\u2013"
           + autoscale.max + "]"
           + (autoscale.running ? "" : " (stopped)")));
+    }
+    var tiering = slo.tiering || {};
+    if (tiering.enabled) {
+      var tc = tiering.state_counts || {};
+      tiles.push(tile(
+        "Model tiers",
+        (tc.active || 0) + " hot / " + (tc.cold || 0) + " cold"
+          + (tiering.hbm_budget_bytes
+             ? " \\u00b7 " + fmtBytes(tiering.resident_bytes || 0)
+               + " of " + fmtBytes(tiering.hbm_budget_bytes)
+             : "")
+          + (tiering.running ? "" : " (stopped)")));
     }
     var wd = fit.watchdog || null;
     if (wd && wd.checked_unix != null) {

@@ -1,0 +1,613 @@
+"""Model tiering: the hot/cold lifecycle plane for thousand-model
+density under an HBM budget.
+
+Every piece of the paging machinery already exists in this stack — the
+persistent executable cache (``obs/aotcache.py``) makes reactivation a
+~100 ms disk replay instead of a compile storm, the registry's warm
+manifest remembers each model's bucket ladder, and the cost ledger
+(``obs/accounting.py``) ranks resident models coldest-first by
+``resident_bytes * (age + 1) / (ewma_rps + 1)``. What was missing is
+the controller that CONNECTS them: nothing ever moved a registered
+model OFF the device, so a host's model count was capped by HBM, not by
+the registry. This module is that connection — the Alchemist-style
+"many models, one shared accelerator pool" economics (arxiv 1805.11800)
+applied per host.
+
+Lifecycle (per registered model, driven on the controller cadence with
+an injectable clock — tests run hours of policy in zero wall time):
+
+    ACTIVE ──deactivate──▶ DEACTIVATING ──▶ COLD
+      ▲                                       │
+      └────── REACTIVATING ◀───first hit──────┘
+
+* **COLD transition** (``ServeEngine.deactivate``): every replica set
+  drains through its own workers (queued work is never dropped — the
+  PR 13 drain posture), staged weights + reaped reserve + executable
+  bytes leave the accounted residency, while the registry entry, the
+  manifest's ``warmed_buckets`` and the on-disk ``.aotx`` executables
+  all SURVIVE. A cold model costs registry metadata, not HBM.
+
+* **REACTIVATION** rides admission: ``AdmissionController.bind_tiering``
+  installs ``ensure_active`` so the FIRST request to a COLD model
+  blocks briefly (after quota + shed — an already-shed request never
+  triggers a replay) while ``ServeEngine.reactivate`` primes the
+  bucket ladder through the executable cache — disk loads, zero fresh
+  XLA compiles (the tiering tests count signatures to hold this), then
+  serves. Never a 404, never a silent recompile storm; the first-hit
+  latency lands in ``sparkml_serve_tiering_first_hit_seconds{model}``.
+
+* **Eviction policy**: a per-host HBM budget
+  (``SPARK_RAPIDS_ML_TPU_TIERING_HBM_BUDGET``) enforced by weighted
+  LRU over the ledger's ``cold_report()`` — the SAME ranking
+  ``GET /debug/costs`` serves, one source of truth — skipping pinned
+  models and anything inside the flap floor (hysteresis: a model
+  oscillating around the traffic threshold cannot thrash through the
+  lifecycle faster than ``FLAP_FLOOR``).
+
+* **Per-model autoscale envelopes** (closing the PR 15 gap): each
+  model holding live replica sets gets its own model-scoped
+  ``AutoscaleController`` (``model=`` — per-model queue signals,
+  ``engine.scale_model_replicas`` actuation), driven ticklessly from
+  this controller's cadence, so a hot model and a barely-warm one stop
+  sharing one global replica count.
+
+* **Executable-cache protection**: while a model is COLD its
+  reactivation depends on the on-disk executables, so the controller
+  installs ``ExecutableCache.set_protect`` — the cache's LRU sweep
+  evicts those entries LAST and never below the protected floor
+  (forced evictions are counted).
+
+* **Observability** (rule 17 of ``scripts/check_instrumentation.py``):
+  every tier transition increments
+  ``sparkml_serve_tiering_total{event}`` and files a
+  ``serve:tiering:*`` audit event; the per-model state rides the
+  ``sparkml_serve_tiering_state{model}`` gauge (3 ACTIVE /
+  2 REACTIVATING / 1 DEACTIVATING / 0 COLD); ``snapshot()`` serves
+  ``GET /debug/tiering`` and the dashboard tile.
+
+Env knobs (all ``SPARK_RAPIDS_ML_TPU_TIERING_*``; constructor args
+win):
+
+* ``..._HBM_BUDGET``       (0)     — per-host resident-byte budget the
+  eviction loop enforces (0 = unlimited: lifecycle + gate stay live,
+  nothing is ever evicted for budget);
+* ``..._INTERVAL_MS``      (1000)  — controller cadence;
+* ``..._FLAP_FLOOR_MS``    (10000) — minimum time since a model's last
+  transition before it may deactivate again (the thrash floor);
+* ``..._ENABLED``          (1)     — 0 renders the controller inert:
+  no ticks act, the admission gate passes through;
+* ``..._AOT_FLOOR_BYTES``  (256 MiB) — executable bytes the cache's
+  protected population never drops below;
+* ``..._PER_MODEL_AUTOSCALE`` (1)  — attach model-scoped autoscale
+  envelopes to models holding live replica sets.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from spark_rapids_ml_tpu.obs import get_registry, tracectx
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.logging import get_logger
+
+ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_TIERING_"
+
+ACTIVE = "active"
+DEACTIVATING = "deactivating"
+COLD = "cold"
+REACTIVATING = "reactivating"
+
+# gauge encoding for sparkml_serve_tiering_state{model}
+STATE_CODES = {COLD: 0, DEACTIVATING: 1, REACTIVATING: 2, ACTIVE: 3}
+
+_log = get_logger("serve.tiering")
+
+
+def _env_number(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(ENV_PREFIX + name, default))
+    except ValueError:
+        return default
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(ENV_PREFIX + name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+class TieringController:
+    """Hot/cold lifecycle control over one ``ServeEngine`` (see module
+    doc). Clock-injectable and drivable step-by-step
+    (``evaluate_once``) so tests exercise the whole policy with zero
+    sleeps; ``start()`` runs the same tick on a traced daemon thread
+    (rule 5)."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        hbm_budget_bytes: Optional[int] = None,
+        interval_s: Optional[float] = None,
+        flap_floor_s: Optional[float] = None,
+        enabled: Optional[bool] = None,
+        per_model_autoscale: Optional[bool] = None,
+        aot_floor_bytes: Optional[int] = None,
+        autoscale_kwargs: Optional[Dict[str, Any]] = None,
+        pins: Tuple[str, ...] = (),
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._engine = engine
+        self._clock = clock
+        self.enabled = bool(
+            enabled if enabled is not None else _env_flag("ENABLED", True))
+        self.hbm_budget_bytes = max(int(
+            hbm_budget_bytes if hbm_budget_bytes is not None
+            else _env_number("HBM_BUDGET", 0)), 0)
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else _env_number("INTERVAL_MS", 1000.0) / 1000.0)
+        self.flap_floor_s = float(
+            flap_floor_s if flap_floor_s is not None
+            else _env_number("FLAP_FLOOR_MS", 10000.0) / 1000.0)
+        self.per_model_autoscale = bool(
+            per_model_autoscale if per_model_autoscale is not None
+            else _env_flag("PER_MODEL_AUTOSCALE", True))
+        self.aot_floor_bytes = max(int(
+            aot_floor_bytes if aot_floor_bytes is not None
+            else _env_number("AOT_FLOOR_BYTES", float(256 << 20))), 0)
+        self._autoscale_kwargs = dict(autoscale_kwargs or {})
+        self._ledger = engine._ledger
+        self._lock = threading.Lock()
+        # one lock per model serializes its transitions: the first
+        # request to a COLD model blocks on this while ONE reactivation
+        # replay runs (concurrent cold hits share the same replay), and
+        # the controller's deactivation can never interleave with it
+        self._model_locks: Dict[str, threading.Lock] = {}
+        self._states: Dict[str, str] = {}
+        self._last_change: Dict[str, float] = {}
+        self._pinned = set(str(p) for p in pins)
+        # algo label prefixes each COLD model's executables compile
+        # under — what the cache protection predicate shields
+        self._cold_algos: Dict[str, Tuple[str, ...]] = {}
+        self._envelopes: Dict[str, Any] = {}
+        self._history: collections.deque = collections.deque(maxlen=64)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = get_registry()
+        self._m_events = reg.counter(
+            "sparkml_serve_tiering_total",
+            "tiering lifecycle events (deactivate / cold_hit / "
+            "reactivate / skip_pinned / skip_flap / gate_wait / "
+            "failures)", ("event",),
+        )
+        self._m_state = reg.gauge(
+            "sparkml_serve_tiering_state",
+            "per-model tier state (3 active / 2 reactivating / "
+            "1 deactivating / 0 cold)", ("model",),
+        )
+        self._m_first_hit = reg.summary(
+            "sparkml_serve_tiering_first_hit_seconds",
+            "cold-model first-hit latency: admission-blocked "
+            "reactivation replay through the executable cache",
+            ("model",),
+        )
+        self._m_errors = reg.counter(
+            "sparkml_serve_errors_total",
+            "serving errors by type: batch failures (exception class), "
+            "worker crashes/wedges, breaker rejections",
+            ("model", "error"),
+        )
+        for event in ("deactivate", "cold_hit", "reactivate"):
+            self._m_events.inc(0, event=event)
+        self._install_cache_protection()
+        self._sync_registry()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _model_lock(self, name: str) -> threading.Lock:
+        with self._lock:
+            lock = self._model_locks.get(name)
+            if lock is None:
+                lock = threading.Lock()
+                self._model_locks[name] = lock
+            return lock
+
+    def _event(self, event: str, model: str, t0: float,
+               **attrs) -> None:
+        """The rule-17 accounting funnel: every lifecycle decision
+        lands in the tiering counter AND the ``serve:tiering`` audit
+        span ring with its model and outcome."""
+        self._m_events.inc(event=event)
+        try:
+            spans_mod.record_event(
+                f"serve:tiering:{event}", t0, time.perf_counter(),
+                model=model, **attrs)
+        except Exception:  # noqa: BLE001 - telemetry must not break
+            self._m_errors.inc(model=model, error="tiering_audit")
+
+    def _set_state(self, name: str, state: str) -> None:
+        with self._lock:
+            self._states[name] = state
+        self._m_state.set(STATE_CODES[state], model=name)
+
+    def state(self, name: str) -> str:
+        """The model's current tier state (unknown models read ACTIVE:
+        the registry is the membership authority, not this map)."""
+        with self._lock:
+            return self._states.get(name, ACTIVE)
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._states)
+
+    # -- pins --------------------------------------------------------------
+
+    def pin(self, name: str) -> None:
+        """Exempt one model from budget eviction (the min-replica /
+        latency-critical override). Counted + audited like any other
+        lifecycle decision."""
+        t0 = time.perf_counter()
+        with self._lock:
+            self._pinned.add(name)
+        self._event("pin", name, t0)
+
+    def unpin(self, name: str) -> None:
+        t0 = time.perf_counter()
+        with self._lock:
+            self._pinned.discard(name)
+        self._event("unpin", name, t0)
+
+    def pinned(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._pinned))
+
+    # -- the admission gate ------------------------------------------------
+
+    def ensure_active(self, name: str) -> None:
+        """The admission-side reactivation gate
+        (``AdmissionController.bind_tiering``): returns immediately for
+        ACTIVE/unknown models; for a COLD one, blocks on the model's
+        transition lock while ONE reactivation replay runs, then
+        returns with the model serving. Raises only if the replay
+        itself fails (the request then fails like any backend error —
+        never a silent 404)."""
+        if not self.enabled:
+            return
+        state = self._states.get(name)
+        if state is None or state == ACTIVE:
+            return
+        t0 = time.perf_counter()
+        with self._model_lock(name):
+            if self._states.get(name, ACTIVE) == ACTIVE:
+                # another request won the race: this one just waited
+                # out the replay and can proceed straight to serving
+                self._event("gate_wait", name, t0)
+                return
+            self._reactivate(name)
+
+    # -- transitions -------------------------------------------------------
+
+    def _deactivate(self, name: str, row: Dict[str, Any]) -> bool:
+        """ACTIVE → DEACTIVATING → COLD for one model (the budget
+        loop's actuation). Drains and drops replicas/batchers/weights,
+        keeps registry + manifest + executables."""
+        t0 = time.perf_counter()
+        with self._model_lock(name):
+            if self._states.get(name, ACTIVE) != ACTIVE:
+                return False
+            self._set_state(name, DEACTIVATING)
+            try:
+                algos = self._engine.model_algos(name)
+                dropped = self._engine.deactivate(name)
+            except Exception as exc:  # noqa: BLE001 - tick must survive
+                self._m_errors.inc(model=name, error="deactivate")
+                self._set_state(name, ACTIVE)
+                self._event("deactivate_failed", name, t0,
+                            error=type(exc).__name__)
+                return False
+            with self._lock:
+                self._cold_algos[name] = algos
+            self._drop_envelope(name)
+            self._set_state(name, COLD)
+            now = self._clock()
+            with self._lock:
+                self._last_change[name] = now
+        self._event(
+            "deactivate", name, t0,
+            resident_bytes=int(row.get("resident_bytes", 0)),
+            cold_score=round(float(row.get("cold_score", 0.0)), 3),
+            versions=",".join(dropped))
+        self._note_history("deactivate", name,
+                           resident_bytes=int(row.get("resident_bytes",
+                                                      0)))
+        return True
+
+    def _reactivate(self, name: str) -> None:
+        """COLD → REACTIVATING → ACTIVE. Caller holds the model lock.
+        The replay primes the warm-manifest bucket ladder through the
+        persistent executable cache — disk loads, zero fresh
+        compiles."""
+        t0 = time.perf_counter()
+        self._set_state(name, REACTIVATING)
+        self._event("cold_hit", name, t0)
+        try:
+            report = self._engine.reactivate(name)
+        except Exception as exc:
+            self._set_state(name, COLD)
+            self._m_errors.inc(model=name, error="reactivate")
+            self._event("reactivate_failed", name, t0,
+                        error=type(exc).__name__)
+            raise
+        with self._lock:
+            self._cold_algos.pop(name, None)
+        self._set_state(name, ACTIVE)
+        now = self._clock()
+        with self._lock:
+            self._last_change[name] = now
+        elapsed = time.perf_counter() - t0
+        self._m_first_hit.observe(elapsed, model=name)
+        self._event("reactivate", name, t0,
+                    seconds=round(elapsed, 6),
+                    buckets=len(report.get("buckets", ())))
+        self._note_history("reactivate", name,
+                           seconds=round(elapsed, 6))
+
+    def _note_history(self, event: str, model: str, **extra) -> None:
+        with self._lock:
+            self._history.append({
+                "at": self._clock(), "event": event, "model": model,
+                **extra,
+            })
+
+    # -- the control tick --------------------------------------------------
+
+    def evaluate_once(self) -> List[Dict[str, Any]]:
+        """One control tick (bounded: one ledger ranking read, at most
+        one pass over it): adopt registry changes, enforce the HBM
+        budget coldest-first with pin + flap-floor overrides, then
+        drive the per-model autoscale envelopes. Returns the
+        deactivation actions taken. Inert when disabled."""
+        if not self.enabled:
+            return []
+        t0 = time.perf_counter()
+        now = self._clock()
+        self._sync_registry()
+        actions: List[Dict[str, Any]] = []
+        if self.hbm_budget_bytes > 0:
+            known = set(self._registry_names())
+            report = self._ledger.cold_report()
+            total = sum(int(r.get("resident_bytes", 0)) for r in report)
+            for row in report:
+                if total <= self.hbm_budget_bytes:
+                    break
+                name = str(row.get("model", ""))
+                if name not in known or self.state(name) != ACTIVE:
+                    continue
+                if name in self.pinned():
+                    self._event("skip_pinned", name, t0)
+                    continue
+                with self._lock:
+                    last = self._last_change.get(name)
+                if last is not None and now - last < self.flap_floor_s:
+                    self._event("skip_flap", name, t0,
+                                held=round(now - last, 3))
+                    continue
+                if self._deactivate(name, row):
+                    total -= int(row.get("resident_bytes", 0))
+                    actions.append({
+                        "model": name,
+                        "resident_bytes": int(
+                            row.get("resident_bytes", 0)),
+                        "cold_score": row.get("cold_score"),
+                    })
+        self._drive_envelopes()
+        return actions
+
+    def _registry_names(self) -> List[str]:
+        try:
+            return list(self._engine.registry.names())
+        except Exception:  # noqa: BLE001 - tick must survive
+            self._m_errors.inc(model="(tiering)", error="registry_read")
+            return []
+
+    def _sync_registry(self) -> None:
+        """Adopt registry membership: new models enter ACTIVE, models
+        deregistered behind our back drop out of the state map (their
+        gauge parks at COLD — deregistration IS maximally cold)."""
+        names = set(self._registry_names())
+        with self._lock:
+            tracked = set(self._states)
+        for name in names - tracked:
+            self._set_state(name, ACTIVE)
+        for name in tracked - names:
+            with self._lock:
+                self._states.pop(name, None)
+                self._last_change.pop(name, None)
+                self._cold_algos.pop(name, None)
+            self._m_state.set(STATE_CODES[COLD], model=name)
+            self._drop_envelope(name)
+
+    # -- per-model autoscale envelopes -------------------------------------
+
+    def _live_models(self) -> List[str]:
+        """Models currently holding replica sets (the only ones whose
+        queues can produce scale signals)."""
+        engine = self._engine
+        try:
+            with engine._lock:
+                return sorted({name for (name, _v) in engine._replicas})
+        except AttributeError:
+            # stub engines in tests may not model replica sets
+            return []
+
+    def _drive_envelopes(self) -> None:
+        """Tickless per-model autoscale: one model-scoped
+        ``AutoscaleController`` per model with live replica sets,
+        evaluated on THIS controller's cadence (no extra threads). A
+        model leaving the live set (deactivated/deregistered) drops its
+        envelope."""
+        if not self.per_model_autoscale:
+            return
+        live = set(self._live_models())
+        with self._lock:
+            stale = [n for n in self._envelopes if n not in live]
+        for name in stale:
+            self._drop_envelope(name)
+        for name in sorted(live):
+            if self.state(name) != ACTIVE:
+                continue
+            envelope = self._envelope_for(name)
+            if envelope is None:
+                continue
+            try:
+                envelope.evaluate_once()
+            except Exception:  # noqa: BLE001 - tick must survive
+                self._m_errors.inc(model=name, error="envelope")
+
+    def _envelope_for(self, name: str):
+        with self._lock:
+            envelope = self._envelopes.get(name)
+        if envelope is not None:
+            return envelope
+        from spark_rapids_ml_tpu.serve.autoscale import (
+            AutoscaleController,
+        )
+
+        try:
+            envelope = AutoscaleController(
+                self._engine, model=name, clock=self._clock,
+                **self._autoscale_kwargs)
+        except Exception:  # noqa: BLE001 - tick must survive
+            self._m_errors.inc(model=name, error="envelope_build")
+            return None
+        with self._lock:
+            self._envelopes[name] = envelope
+        return envelope
+
+    def _drop_envelope(self, name: str) -> None:
+        with self._lock:
+            self._envelopes.pop(name, None)
+
+    # -- executable-cache protection ---------------------------------------
+
+    def _install_cache_protection(self) -> None:
+        """Shield COLD models' executables from the cache's LRU sweep:
+        reactivation depends on them (``aotcache.set_protect`` — the
+        floor wins over the cap; forced evictions are counted)."""
+        try:
+            from spark_rapids_ml_tpu.obs.aotcache import (
+                get_executable_cache,
+            )
+
+            cache = get_executable_cache()
+        except Exception:  # noqa: BLE001 - cache is optional
+            self._m_errors.inc(model="(tiering)", error="cache_protect")
+            return
+        if cache is not None:
+            cache.set_protect(self._aot_protected, self.aot_floor_bytes)
+
+    def _aot_protected(self, label: str) -> bool:
+        """The cache-eviction shield predicate: an entry whose label
+        carries an algo some COLD-but-registered model compiled under
+        must survive for that model's reactivation replay."""
+        with self._lock:
+            algos = set()
+            for name, model_algos in self._cold_algos.items():
+                if self._states.get(name) == COLD:
+                    algos.update(model_algos)
+        return any(label.startswith(algo) or algo in label
+                   for algo in algos)
+
+    # -- the background loop -----------------------------------------------
+
+    def start(self) -> None:
+        """Run the control tick on a traced daemon thread at
+        ``interval_s`` cadence until ``stop()``."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("tiering controller already running")
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.is_set():
+                try:
+                    self.evaluate_once()
+                except Exception:  # noqa: BLE001 - loop must survive
+                    # visible, never silent: a dead controller is a
+                    # frozen residency picture under a moving mix
+                    self._m_errors.inc(model="(tiering)",
+                                       error="controller")
+                self._stop.wait(self.interval_s)
+
+        self._thread = tracectx.traced_thread(
+            _loop, name="sparkml-tiering", daemon=True, fresh=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+
+    @property
+    def running(self) -> bool:
+        return bool(self._thread is not None
+                    and self._thread.is_alive())
+
+    # -- introspection -----------------------------------------------------
+
+    def lifecycle_history(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._history)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``GET /debug/tiering`` payload / dashboard tile. The
+        ``cold_report`` here is the ledger's OWN ranking — the same
+        source of truth ``GET /debug/costs`` serves (identical rows
+        under a frozen ledger clock; identical ORDER always —
+        tested)."""
+        report = self._ledger.cold_report()
+        with self._lock:
+            states = dict(self._states)
+            pinned = sorted(self._pinned)
+            history = list(self._history)[-16:]
+            envelopes = dict(self._envelopes)
+        counts: Dict[str, int] = {s: 0 for s in STATE_CODES}
+        for state in states.values():
+            counts[state] = counts.get(state, 0) + 1
+        return {
+            "enabled": self.enabled,
+            "running": self.running,
+            "hbm_budget_bytes": self.hbm_budget_bytes,
+            "resident_bytes": sum(int(r.get("resident_bytes", 0))
+                                  for r in report),
+            "flap_floor_s": self.flap_floor_s,
+            "interval_s": self.interval_s,
+            "states": states,
+            "state_counts": counts,
+            "pinned": pinned,
+            "cold_report": report,
+            "envelopes": {
+                name: {"replicas": env._scale(),
+                       "min": env.min_replicas,
+                       "max": env.max_replicas}
+                for name, env in sorted(envelopes.items())
+            },
+            "history": history,
+        }
+
+
+__all__ = [
+    "TieringController",
+    "ENV_PREFIX",
+    "ACTIVE",
+    "DEACTIVATING",
+    "COLD",
+    "REACTIVATING",
+    "STATE_CODES",
+]

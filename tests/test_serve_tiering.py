@@ -1,0 +1,671 @@
+"""The model-tiering lifecycle plane (ISSUE 19): hot/cold transitions
+under injected clocks (zero sleeps), budget math byte-exact against the
+resource ledger, the cold-model first hit reactivating through the
+executable cache with ZERO fresh XLA compiles, registry + manifest
+survival across deactivation, thrash hysteresis, pinned-model immunity,
+disabled-controller inertness, per-model autoscale envelopes, the
+aotcache protection floor, and the rule-17 fixtures both ways."""
+
+import os
+import sys
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from spark_rapids_ml_tpu.obs import accounting, xprof
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs.accounting import ResourceLedger
+from spark_rapids_ml_tpu.obs.aotcache import (
+    ExecutableCache,
+    configure_executable_cache,
+    get_executable_cache,
+)
+from spark_rapids_ml_tpu.obs.metrics import get_registry
+from spark_rapids_ml_tpu.serve import placement as placement_mod
+from spark_rapids_ml_tpu.serve.admission import AdmissionController
+from spark_rapids_ml_tpu.serve.autoscale import AutoscaleController
+from spark_rapids_ml_tpu.serve.placement import DevicePlacer
+from spark_rapids_ml_tpu.serve.tiering import (
+    ACTIVE,
+    COLD,
+    STATE_CODES,
+    TieringController,
+)
+
+
+def _counter_total(name, **labels):
+    snap = get_registry().snapshot().get(name, {"samples": []})
+    return sum(
+        s["value"] for s in snap["samples"]
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def _gauge_value(name, **labels):
+    snap = get_registry().snapshot().get(name, {"samples": []})
+    for s in snap["samples"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return None
+
+
+QUIET = {"queue_wait_s": 0.0, "shed_level": 0, "burn": 0.0,
+         "occupancy": 0.0, "depth_frac": 0.0}
+HOT = {"queue_wait_s": 0.5, "shed_level": 0, "burn": 0.0,
+       "occupancy": 0.0, "depth_frac": 0.5}
+
+
+class _TierEngine:
+    """Just enough engine for the controller's policy surface: a real
+    (clock-injected) ledger, registry names, the tiering actuators, and
+    the model-scoped autoscale surface."""
+
+    def __init__(self, clock, sizes=None):
+        sizes = dict(sizes or {"m0": 3000, "m1": 2000, "m2": 1000})
+        self._ledger = ResourceLedger(clock=clock, enabled=True)
+        self.sizes = sizes
+        self._names = list(sizes)
+        self.registry = SimpleNamespace(names=lambda: list(self._names))
+        self._replicas = {}
+        self._lock = threading.Lock()
+        self.deactivated = []
+        self.reactivated = []
+        self.fail_reactivate = False
+        self.signals = {}
+        self._scales = {}
+        self.model_scaled = []
+        self.global_scaled = []
+        self.placer = SimpleNamespace(
+            base_device_count=lambda: 4,
+            target_count=None,
+            active_devices=lambda: [],
+        )
+        for name, nbytes in sizes.items():
+            self._ledger.charge_memory(
+                name, 1, "cpu:0", accounting.COMPONENT_WEIGHTS, nbytes)
+
+    # -- tiering actuators --------------------------------------------------
+
+    def deactivate(self, name):
+        self.deactivated.append(name)
+        self._ledger.release_memory(name)
+        return [f"{name}@1"]
+
+    def reactivate(self, name):
+        if self.fail_reactivate:
+            raise RuntimeError("replay failed")
+        self.reactivated.append(name)
+        self._ledger.charge_memory(
+            name, 1, "cpu:0", accounting.COMPONENT_WEIGHTS,
+            self.sizes[name])
+        return {"model": name, "version": 1, "buckets": [64]}
+
+    def model_algos(self, name):
+        return ("pca",)
+
+    # -- the autoscale surface ----------------------------------------------
+
+    def replica_scale(self):
+        return max(self._scales.values(), default=1)
+
+    def scale_replicas(self, target):
+        self.global_scaled.append(target)
+        return {"target": target, "resized": {}}
+
+    def model_replica_scale(self, model):
+        return self._scales.get(model, 1)
+
+    def scale_model_replicas(self, model, target):
+        self._scales[model] = target
+        self.model_scaled.append((model, target))
+        return {"model": model, "target": target, "resized": {}}
+
+    def _overload_signals_for(self, model):
+        return dict(self.signals.get(model, QUIET))
+
+    def reap_retired(self):
+        return 0
+
+
+def _controller(engine, now, **kw):
+    kw.setdefault("hbm_budget_bytes", 0)
+    kw.setdefault("flap_floor_s", 0.0)
+    kw.setdefault("enabled", True)
+    kw.setdefault("per_model_autoscale", False)
+    return TieringController(engine, clock=lambda: now[0], **kw)
+
+
+# -- budget eviction (stub engine, injected clocks, zero sleeps) -------------
+
+
+def test_budget_deactivates_coldest_first_until_under():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    # all never-hit: cold_score orders by resident bytes, m0 coldest
+    ctl = _controller(engine, now, hbm_budget_bytes=3500)
+    actions = ctl.evaluate_once()
+    assert [a["model"] for a in actions] == ["m0"]
+    assert engine.deactivated == ["m0"]
+    assert ctl.state("m0") == COLD
+    assert ctl.state("m1") == ACTIVE and ctl.state("m2") == ACTIVE
+    # byte-exact against the ledger: 2000 + 1000 remain, under budget
+    remaining = sum(engine._ledger.memory_bytes().values())
+    assert remaining == 3000
+    assert ctl.snapshot()["resident_bytes"] == remaining
+    # the action carries the exact bytes the ledger released
+    assert actions[0]["resident_bytes"] == 3000
+
+
+def test_budget_evicts_repeatedly_until_satisfied():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=1000)
+    actions = ctl.evaluate_once()
+    assert [a["model"] for a in actions] == ["m0", "m1"]
+    assert sum(engine._ledger.memory_bytes().values()) == 1000
+    # a second tick is idempotent: already at budget
+    assert ctl.evaluate_once() == []
+
+
+def test_budget_zero_means_unlimited():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=0)
+    assert ctl.evaluate_once() == []
+    assert engine.deactivated == []
+    assert all(s == ACTIVE for s in ctl.states().values())
+
+
+def test_disabled_controller_is_inert():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=1, enabled=False)
+    assert ctl.evaluate_once() == []
+    assert engine.deactivated == []
+    # the admission gate passes straight through, no reactivation
+    ctl.ensure_active("m0")
+    assert engine.reactivated == []
+    assert ctl.snapshot()["enabled"] is False
+
+
+def test_pinned_model_is_immune_and_counted():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=3500, pins=("m0",))
+    skip0 = _counter_total("sparkml_serve_tiering_total",
+                           event="skip_pinned")
+    actions = ctl.evaluate_once()
+    # the coldest (m0) is pinned: eviction falls through to m1 then m2
+    assert [a["model"] for a in actions] == ["m1", "m2"]
+    assert ctl.state("m0") == ACTIVE
+    assert "m0" not in engine.deactivated
+    assert _counter_total("sparkml_serve_tiering_total",
+                          event="skip_pinned") == skip0 + 1
+    assert ctl.pinned() == ("m0",)
+    ctl.unpin("m0")
+    assert ctl.pinned() == ()
+
+
+def test_flap_floor_hysteresis_blocks_thrash():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=5000,
+                      flap_floor_s=10.0)
+    assert [a["model"] for a in ctl.evaluate_once()] == ["m0"]
+    # the model comes right back (first hit) — inside the flap floor
+    now[0] = 1.0
+    ctl.ensure_active("m0")
+    assert ctl.state("m0") == ACTIVE
+    skip0 = _counter_total("sparkml_serve_tiering_total",
+                           event="skip_flap")
+    now[0] = 5.0
+    actions = ctl.evaluate_once()
+    # m0 (still coldest) is held by hysteresis; m1 pays instead
+    assert [a["model"] for a in actions] == ["m1"]
+    assert ctl.state("m0") == ACTIVE
+    assert _counter_total("sparkml_serve_tiering_total",
+                          event="skip_flap") == skip0 + 1
+    # past the floor the hold releases
+    now[0] = 20.0
+    ctl.ensure_active("m1")                  # re-exceed the budget
+    assert [a["model"] for a in ctl.evaluate_once()] == ["m0"]
+    assert ctl.state("m0") == COLD
+
+
+# -- the admission-side reactivation gate ------------------------------------
+
+
+def test_ensure_active_reactivates_cold_model_and_counts():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=3500)
+    ctl.evaluate_once()
+    assert ctl.state("m0") == COLD
+    hit0 = _counter_total("sparkml_serve_tiering_total",
+                          event="cold_hit")
+    react0 = _counter_total("sparkml_serve_tiering_total",
+                            event="reactivate")
+    ctl.ensure_active("m0")
+    assert ctl.state("m0") == ACTIVE
+    assert engine.reactivated == ["m0"]
+    assert _counter_total("sparkml_serve_tiering_total",
+                          event="cold_hit") == hit0 + 1
+    assert _counter_total("sparkml_serve_tiering_total",
+                          event="reactivate") == react0 + 1
+    # the ledger got its bytes back, byte-exact
+    assert engine._ledger.memory_bytes(model="m0") == {"m0": 3000}
+    # first-hit latency landed in the summary
+    snap = get_registry().snapshot().get(
+        "sparkml_serve_tiering_first_hit_seconds", {"samples": []})
+    assert any(s["labels"].get("model") == "m0"
+               for s in snap["samples"])
+    # and the audit ring carries the lifecycle events
+    names = {e.name for e in spans_mod.get_recorder().events()}
+    assert "serve:tiering:deactivate" in names
+    assert "serve:tiering:cold_hit" in names
+    assert "serve:tiering:reactivate" in names
+
+
+def test_ensure_active_is_a_noop_for_active_and_unknown_models():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now)
+    ctl.ensure_active("m0")                  # ACTIVE
+    ctl.ensure_active("never-registered")    # unknown
+    assert engine.reactivated == []
+
+
+def test_reactivate_failure_restores_cold_and_raises():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=3500)
+    ctl.evaluate_once()
+    engine.fail_reactivate = True
+    err0 = _counter_total("sparkml_serve_errors_total",
+                          model="m0", error="reactivate")
+    with pytest.raises(RuntimeError):
+        ctl.ensure_active("m0")
+    # never a silent 404: the model is back COLD for the next attempt
+    assert ctl.state("m0") == COLD
+    assert _counter_total("sparkml_serve_errors_total",
+                          model="m0", error="reactivate") == err0 + 1
+    engine.fail_reactivate = False
+    ctl.ensure_active("m0")
+    assert ctl.state("m0") == ACTIVE
+
+
+# -- state map, gauge, registry sync -----------------------------------------
+
+
+def test_state_gauge_publishes_the_tier_codes():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=3500)
+    assert _gauge_value("sparkml_serve_tiering_state",
+                        model="m0") == STATE_CODES[ACTIVE]
+    ctl.evaluate_once()
+    assert _gauge_value("sparkml_serve_tiering_state",
+                        model="m0") == STATE_CODES[COLD]
+    ctl.ensure_active("m0")
+    assert _gauge_value("sparkml_serve_tiering_state",
+                        model="m0") == STATE_CODES[ACTIVE]
+
+
+def test_registry_sync_adopts_and_drops_models():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now)
+    assert set(ctl.states()) == {"m0", "m1", "m2"}
+    engine._names.append("m3")
+    ctl.evaluate_once()
+    assert ctl.states()["m3"] == ACTIVE
+    engine._names.remove("m0")
+    ctl.evaluate_once()
+    assert "m0" not in ctl.states()
+    # a deregistered model's gauge parks COLD
+    assert _gauge_value("sparkml_serve_tiering_state",
+                        model="m0") == STATE_CODES[COLD]
+
+
+def test_snapshot_cold_report_is_the_ledgers_own_ranking():
+    """The one-source-of-truth satellite: under a frozen ledger clock
+    the snapshot's cold_report is row-for-row identical to what
+    ``costs_document()`` (GET /debug/costs) serves."""
+    now = [100.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now)
+    snap_report = ctl.snapshot()["cold_report"]
+    costs_report = engine._ledger.costs_document()["cold_report"]
+    assert snap_report == costs_report
+    assert snap_report == engine._ledger.cold_report()
+    # ranking: coldest (largest resident, never hit) first
+    assert [r["model"] for r in snap_report] == ["m0", "m1", "m2"]
+
+
+def test_lifecycle_history_records_transitions():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    ctl = _controller(engine, now, hbm_budget_bytes=3500)
+    ctl.evaluate_once()
+    now[0] = 2.0
+    ctl.ensure_active("m0")
+    events = [(h["event"], h["model"]) for h in ctl.lifecycle_history()]
+    assert ("deactivate", "m0") in events
+    assert ("reactivate", "m0") in events
+    snap = ctl.snapshot()
+    assert snap["history"]
+    assert snap["state_counts"][ACTIVE] == 3
+
+
+# -- per-model autoscale envelopes (the PR 15 gap) ---------------------------
+
+
+def test_model_scoped_autoscale_never_resizes_other_models():
+    engine = _TierEngine(lambda: 0.0)
+    now = [0.0]
+    engine.signals["m0"] = dict(HOT)
+    ctl = AutoscaleController(
+        engine, model="m0", clock=lambda: now[0],
+        min_replicas=1, max_replicas=4, up_hold_s=1.0,
+        down_hold_s=5.0, cooldown_s=2.0)
+    ctl.evaluate_once()
+    now[0] = 1.1
+    ctl.evaluate_once()
+    # only m0 was resized, through the model-scoped actuator
+    assert engine.model_scaled == [("m0", 2)]
+    assert engine.global_scaled == []
+    assert engine.model_replica_scale("m1") == 1
+    assert ctl.snapshot()["model"] == "m0"
+
+
+def test_tiering_drives_per_model_envelopes_and_drops_stale():
+    now = [0.0]
+    engine = _TierEngine(lambda: now[0])
+    engine._replicas[("m0", 1)] = object()   # m0 holds live replicas
+    engine.signals["m0"] = dict(HOT)
+    ctl = _controller(
+        engine, now, per_model_autoscale=True,
+        autoscale_kwargs=dict(min_replicas=1, max_replicas=4,
+                              up_hold_s=1.0, down_hold_s=5.0,
+                              cooldown_s=2.0))
+    ctl.evaluate_once()                       # hold starts
+    now[0] = 1.1
+    ctl.evaluate_once()                       # hold expires → scale up
+    assert engine.model_scaled == [("m0", 2)]
+    assert "m0" in ctl.snapshot()["envelopes"]
+    # only models with live replica sets get an envelope
+    assert "m1" not in ctl.snapshot()["envelopes"]
+    # the model leaving the live set drops its envelope
+    engine._replicas.clear()
+    ctl.evaluate_once()
+    assert ctl.snapshot()["envelopes"] == {}
+
+
+# -- executable-cache protection (the aotcache satellite) --------------------
+
+
+def _fake_entry(path, label, size, mtime):
+    full = os.path.join(path, f"{label}-{'0' * 8}.aotx")
+    with open(full, "wb") as f:
+        f.write(b"x" * size)
+    os.utime(full, (mtime, mtime))
+    return full
+
+
+def _aotx_labels(path):
+    return sorted(ExecutableCache._entry_label(n)
+                  for n in os.listdir(path) if n.endswith(".aotx"))
+
+
+def test_protected_entries_are_evicted_last(tmp_path):
+    cache = ExecutableCache(str(tmp_path), max_bytes=2048)
+    cache.set_protect(lambda label: label.startswith("pca"), 0)
+    # the PROTECTED entry is the oldest — plain LRU would kill it first
+    _fake_entry(cache.path, "pca_transform", 1024, 1)
+    _fake_entry(cache.path, "tree_infer", 1024, 2)
+    _fake_entry(cache.path, "tree_infer_b64", 1024, 3)
+    cache._evict_to_cap()
+    assert _aotx_labels(cache.path) == ["pca_transform",
+                                        "tree_infer_b64"]
+    stats = cache.stats()
+    assert stats["evict"] == 1
+    assert stats["evict_forced"] == 0
+
+
+def test_protection_floor_wins_over_the_cap(tmp_path):
+    cache = ExecutableCache(str(tmp_path), max_bytes=1024)
+    cache.set_protect(lambda label: label.startswith("pca"), 2048)
+    _fake_entry(cache.path, "pca_transform", 1024, 1)
+    _fake_entry(cache.path, "pca_transform_b64", 1024, 2)
+    cache._evict_to_cap()
+    # over cap, but the protected population may not drop below the
+    # floor: nothing is deleted
+    assert len(_aotx_labels(cache.path)) == 2
+    assert cache.stats()["evict_forced"] == 0
+
+
+def test_forced_eviction_above_the_floor_is_counted(tmp_path):
+    cache = ExecutableCache(str(tmp_path), max_bytes=1024)
+    cache.set_protect(lambda label: label.startswith("pca"), 1024)
+    _fake_entry(cache.path, "pca_transform", 1024, 1)
+    _fake_entry(cache.path, "pca_transform_b64", 1024, 2)
+    cache._evict_to_cap()
+    # one protected entry had to go (floor still satisfied after) —
+    # that is a FORCED eviction and it is counted as such
+    assert _aotx_labels(cache.path) == ["pca_transform_b64"]
+    stats = cache.stats()
+    assert stats["evict"] == 1
+    assert stats["evict_forced"] == 1
+
+
+def test_broken_protect_predicate_is_counted_not_fatal(tmp_path):
+    cache = ExecutableCache(str(tmp_path), max_bytes=1024)
+
+    def _boom(label):
+        raise ValueError("bad predicate")
+
+    cache.set_protect(_boom, 4096)
+    _fake_entry(cache.path, "pca_transform", 1024, 1)
+    _fake_entry(cache.path, "tree_infer", 1024, 2)
+    err0 = cache.stats()["error"]
+    cache._evict_to_cap()
+    # the sweep survives: entries fall back to unprotected LRU
+    assert len(_aotx_labels(cache.path)) == 1
+    assert cache.stats()["error"] > err0
+
+
+def test_controller_shields_cold_models_algos(tmp_path):
+    configure_executable_cache(str(tmp_path / "aot"))
+    try:
+        now = [0.0]
+        engine = _TierEngine(lambda: now[0])
+        ctl = _controller(engine, now, hbm_budget_bytes=3500)
+        cache = get_executable_cache()
+        assert cache._protect_fn is not None
+        # nothing COLD yet: nothing shielded
+        assert not ctl._aot_protected("pca_transform")
+        ctl.evaluate_once()                   # m0 goes COLD (algo pca)
+        assert ctl._aot_protected("pca_transform")
+        assert ctl._aot_protected("pipeline_fused_scaler_pca")
+        assert not ctl._aot_protected("tree_infer")
+        ctl.ensure_active("m0")               # back ACTIVE
+        assert not ctl._aot_protected("pca_transform")
+    finally:
+        configure_executable_cache(None)
+
+
+# -- the admission gate wiring -----------------------------------------------
+
+
+def test_admission_calls_the_bound_gate_after_admit():
+    adm = AdmissionController()
+    gated = []
+    adm.bind_tiering(gated.append)
+    adm.admit("tenant-a", "interactive", 4, model="m0")
+    assert gated == ["m0"]
+    # no model ref → no gate call (health probes, etc.)
+    adm.admit("tenant-a", "interactive", 4)
+    assert gated == ["m0"]
+
+
+# -- live engine: the full lifecycle -----------------------------------------
+
+
+@pytest.fixture
+def tiered_engine(tmp_path):
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import ModelRegistry, ServeEngine
+
+    configure_executable_cache(str(tmp_path / "aot"))
+    # earlier tests in the same process may have pushed the global
+    # ledger past its model-label fold; these tests assert byte-exact
+    # per-model residency, so they need fresh labels
+    accounting.reset_ledger()
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(256, 16))
+    model = PCA().setK(4).fit(x)
+    registry = ModelRegistry()
+    registry.register("tier_a", model)
+    registry.register("tier_b", model)
+    placer = DevicePlacer(
+        devices=placement_mod.serving_devices(limit=2))
+    placer.set_target(1)
+    engine = ServeEngine(registry, max_batch_rows=128, max_wait_ms=1.0,
+                         placement=placer, buckets=(64,))
+    engine.warmup("tier_a")
+    engine.warmup("tier_b")
+    try:
+        yield engine, x
+    finally:
+        engine.shutdown()
+        configure_executable_cache(None)
+        accounting.reset_ledger()
+
+
+def test_live_cold_hit_reactivates_with_zero_fresh_compiles(
+        tiered_engine):
+    engine, x = tiered_engine
+    before = np.asarray(engine.predict("tier_a", x[:8]))
+    engine.predict("tier_b", x[:8])
+    resident_a = sum(
+        engine._ledger.memory_bytes(model="tier_a").values())
+    assert resident_a > 0
+    total = sum(engine._ledger.memory_bytes().values())
+    aot_files = set(os.listdir(get_executable_cache().path))
+    assert aot_files
+
+    # budget admits all but one model; tier_a is coldest (never hit
+    # after tier_b's request) and goes COLD
+    ctl = TieringController(
+        engine, hbm_budget_bytes=total - 1, flap_floor_s=0.0,
+        per_model_autoscale=False, enabled=True)
+    engine.attach_tiering(ctl)
+    assert engine.admission._tiering_gate is not None
+    actions = ctl.evaluate_once()
+    assert [a["model"] for a in actions] == ["tier_a"]
+    assert ctl.state("tier_a") == COLD
+    assert ctl.state("tier_b") == ACTIVE
+
+    # deactivation SURVIVORS: registry entry, warm manifest, aot files
+    assert "tier_a" in engine.registry.names()
+    entry = engine.registry.resolve_entry("tier_a")
+    assert entry.warmed_buckets
+    assert set(os.listdir(get_executable_cache().path)) == aot_files
+    # and the ledger released every accounted byte
+    assert sum(
+        engine._ledger.memory_bytes(model="tier_a").values()) == 0
+    snap = engine.tiering_snapshot()
+    assert snap["enabled"] is True
+    assert snap["states"]["tier_a"] == COLD
+
+    # the first request to the COLD model blocks through admission,
+    # reactivates via the executable cache — ZERO fresh XLA compiles —
+    # and serves bit-equal output
+    hit0 = _counter_total("sparkml_serve_tiering_total",
+                          event="cold_hit")
+    xprof.reset_compile_log()
+    after = np.asarray(engine.predict("tier_a", x[:8]))
+    assert sum(s["compiles"]
+               for s in xprof.compile_stats().values()) == 0
+    assert ctl.state("tier_a") == ACTIVE
+    assert _counter_total("sparkml_serve_tiering_total",
+                          event="cold_hit") == hit0 + 1
+    np.testing.assert_array_equal(after, before)
+    # residency is re-accounted after the replay
+    assert sum(
+        engine._ledger.memory_bytes(model="tier_a").values()) > 0
+
+
+def test_live_scale_model_replicas_is_isolated(tiered_engine):
+    engine, x = tiered_engine
+    engine.predict("tier_a", x[:8])
+    engine.predict("tier_b", x[:8])
+    report = engine.scale_model_replicas("tier_a", 2)
+    assert report["model"] == "tier_a"
+    assert report["target"] == 2
+    assert engine._replicas[("tier_a", 1)].active_count() == 2
+    # model B's replica tier is untouched — the per-model envelope
+    # contract: scale decisions on A never resize B
+    assert engine._replicas[("tier_b", 1)].active_count() == 1
+    assert engine.model_replica_scale("tier_a") == 2
+    assert engine.model_replica_scale("tier_b") == 1
+    out = np.asarray(engine.predict("tier_a", x[:8]))
+    assert out.shape == (8, 4)
+
+
+# -- rule 17 fixtures --------------------------------------------------------
+
+
+def _checker():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_instrumentation as ci
+    finally:
+        sys.path.pop(0)
+    return ci
+
+
+def test_rule17_accepts_current_tiering_module():
+    ci = _checker()
+    assert list(ci.check_tiering_transitions(ci.TIERING_FILE)) == []
+
+
+def test_rule17_rejects_unaccounted_transitions(tmp_path):
+    ci = _checker()
+    bad = tmp_path / "bad_tiering.py"
+    bad.write_text(
+        "class C:\n"
+        "    def deactivate_model(self):\n"
+        "        self.parked.append('m')  # REJECT: named transition\n"
+        "    def pin(self, name):\n"
+        "        self._pinned.add(name)  # REJECT: named transition\n"
+        "    def gate(self):\n"
+        "        self._reactivate('m')  # REJECT: mutation call\n"
+        "    def helper(self):\n"
+        "        return 1  # fine: not a transition path\n"
+    )
+    offenders = list(ci.check_tiering_transitions(str(bad)))
+    assert len(offenders) == 3
+    assert all("rule 17" in why for _ln, why in offenders)
+
+
+def test_rule17_accepts_accounted_transitions(tmp_path):
+    ci = _checker()
+    good = tmp_path / "good_tiering.py"
+    good.write_text(
+        "class C:\n"
+        "    def deactivate_model(self):\n"
+        "        self._event('deactivate', 'm', 0.0)\n"
+        "        self.parked.append('m')\n"
+        "    def pin(self, name):\n"
+        "        self._m.inc(event='pin')\n"
+        "        self._pinned.add(name)\n"
+        "    def gate(self):\n"
+        "        with span('serve:tiering:gate'):\n"
+        "            self._reactivate('m')\n"
+    )
+    assert list(ci.check_tiering_transitions(str(good))) == []
