@@ -142,7 +142,8 @@ PRINT_EXEMPT_DIRS = (os.path.join("spark_rapids_ml_tpu", "scripts"),)
 # injectable-clock discipline (sampling, detection, incident lifecycle).
 CLOCKED_OBS_FILES = tuple(
     os.path.join(REPO, "spark_rapids_ml_tpu", "obs", name)
-    for name in ("tsdb.py", "anomaly.py", "incidents.py", "fitmon.py")
+    for name in ("tsdb.py", "anomaly.py", "incidents.py", "fitmon.py",
+                 "federation.py", "forecast.py")
 )
 DECORATOR_NAME = "fit_instrumentation"
 SERVING_DECORATOR = "observed_transform"
@@ -846,6 +847,70 @@ def check_tiering_transitions(path: str):
                    "unauditable capacity drift (rule 17)")
 
 
+# rule 18: the fleet federation + predictive signal plane
+# (obs/federation.py, obs/forecast.py) is what a fleet operator trusts
+# to SEE other hosts — every peer-poll outcome (ok/stale/unreachable),
+# every merged delta, every incident-dedup decision, and every
+# predictive-autoscale shadow/actuate consult must carry a counter
+# .inc / span / audit event in the same function. A silently-failed
+# poll is a host that looks healthy while dark; an uncounted shadow
+# decision makes the shadow-mode evidence trail worthless.
+FEDERATION_FILES = (
+    os.path.join(REPO, "spark_rapids_ml_tpu", "obs", "federation.py"),
+    os.path.join(REPO, "spark_rapids_ml_tpu", "obs", "forecast.py"),
+)
+_FLEET_DECISION_NAMES = frozenset({"fleet_export", "poll_once", "tick"})
+_FLEET_DECISION_PREFIXES = ("poll", "merge", "dedup", "shadow",
+                            "actuate")
+_FLEET_MUTATION_CALLS = frozenset({"predictive_scale_up",
+                                   "scale_replicas"})
+# same sanctioned accounting spellings as rules 14/15/17
+_FLEET_ACCOUNTING = frozenset({"inc", "record_event", "span",
+                               "_count", "_count_error", "_audit"})
+
+
+def check_federation_signals(path: str):
+    """Rule 18: yield (lineno, description) for every unaccounted
+    federation/forecast decision path in one module.
+
+    A decision path is a function DEF named
+    ``fleet_export``/``poll_once``/``tick`` (or prefixed ``poll``/
+    ``merge``/``dedup``/``shadow``/``actuate``,
+    underscore-insensitive), or any function whose body calls the
+    ``predictive_scale_up``/``scale_replicas`` replica mutations; the
+    same function must carry a counter ``.inc(...)``, an audit
+    ``record_event``/``span``, or a module ``_count``/``_count_error``/
+    ``_audit`` accounting helper."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        bare = node.name.lstrip("_")
+        is_decision = (bare in _FLEET_DECISION_NAMES
+                       or bare.startswith(_FLEET_DECISION_PREFIXES))
+        if not is_decision:
+            for child in ast.walk(node):
+                if (isinstance(child, ast.Call)
+                        and _call_name(child) in _FLEET_MUTATION_CALLS):
+                    is_decision = True
+                    break
+        if not is_decision:
+            continue
+        accounts = any(
+            isinstance(child, ast.Call)
+            and _call_name(child) in _FLEET_ACCOUNTING
+            for child in ast.walk(node)
+        )
+        if not accounts:
+            yield (node.lineno,
+                   f"federation/forecast decision path {node.name}() "
+                   "without a counter .inc(...), audit "
+                   "record_event/span, or accounting helper in the "
+                   "same function — an uncounted peer poll or "
+                   "predictive consult is a fleet view that can lie "
+                   "silently (rule 18)")
+
+
 # rule 11: the wire boundary — server body decoding must route through
 # serve/wire.py, whose decoders must record the parse-phase latency.
 SERVER_FILE = os.path.join(
@@ -1155,6 +1220,11 @@ def main() -> int:
         rel = os.path.relpath(TIERING_FILE, REPO)
         for lineno, why in check_tiering_transitions(TIERING_FILE):
             offenders.append(f"{rel}:{lineno} {why}")
+    federation_files = [p for p in FEDERATION_FILES if os.path.exists(p)]
+    for path in federation_files:
+        rel = os.path.relpath(path, REPO)
+        for lineno, why in check_federation_signals(path):
+            offenders.append(f"{rel}:{lineno} {why}")
     if offenders:
         print(f"{len(offenders)} instrumentation offender(s):")
         for line in offenders:
@@ -1183,7 +1253,10 @@ def main() -> int:
         f"scale-up/scale-down decision counted or audit-spanned; "
         f"cost-ledger mutation paths all counted or audit-spanned; "
         f"every fit entry point enters a fitmon step span; "
-        f"tiering tier-transition paths all counted or audit-spanned"
+        f"tiering tier-transition paths all counted or audit-spanned; "
+        f"{len(federation_files)} federation/forecast module(s) with "
+        f"every peer-poll, merge, incident-dedup, and predictive "
+        f"shadow/actuate path counted or audit-spanned"
     )
     return 0
 

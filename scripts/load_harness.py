@@ -1470,6 +1470,274 @@ def run_density_phase() -> int:
     return 0
 
 
+FLEET_CHILD_PREFIX = "FLEET_CHILD_READY "
+
+
+def fleet_child() -> int:
+    """One fleet peer: a self-driving serving process on a fixed port.
+
+    The child stands up the REAL stack (fitted PCA → registry → engine →
+    HTTP server with the live sampler, so ``/debug/fleet/export`` has a
+    populated store to walk) and then generates its own modest predict
+    traffic forever — the parent aggregator polls it over the wire and
+    SIGKILLs it mid-drill, so this function never returns normally. The
+    parent pins ``SPARK_RAPIDS_ML_TPU_FLEET_HOST`` so a respawned peer
+    keeps its host identity and the ``fleet_host_down`` incident
+    auto-resolves instead of leaking a ghost host."""
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.serve import (
+        ModelRegistry,
+        ServeEngine,
+        start_serve_server,
+    )
+
+    port = _env_int("SPARKML_LOAD_FLEET_PORT", 0)
+    n_features = _env_int("SPARKML_LOAD_FEATURES", 16)
+    k = _env_int("SPARKML_LOAD_K", 4)
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(1024, n_features))
+    model = PCA().setK(k).fit(x)
+    registry = ModelRegistry()
+    registry.register("fleet_pca", model)
+    engine = ServeEngine(registry, max_batch_rows=128, max_wait_ms=2.0,
+                         max_queue_depth=256)
+    server = start_serve_server(engine, port=port)
+    sys.stdout.write(FLEET_CHILD_PREFIX + json.dumps(
+        {"port": server.server_address[1]}) + "\n")
+    sys.stdout.flush()
+    while True:  # until SIGKILL — the parent owns this lifetime
+        n = int(rng.integers(8, 64))
+        start = int(rng.integers(0, x.shape[0] - n))
+        try:
+            engine.predict("fleet_pca", x[start:start + n])
+        except Exception:  # noqa: BLE001 - shed/overload is fine here
+            pass
+        time.sleep(0.02)
+
+
+def run_fleet_phase() -> int:
+    """The fleet-federation phase: 2 serving subprocesses through ONE
+    in-process aggregator. The parent IS the fleet brain — it runs the
+    sampler + incident engine + forecaster + ``FleetAggregator`` that a
+    real deployment would run on its coordinator host. Gates:
+
+    * both peers polled ok and the MERGED store carries the same series
+      under both ``host=`` labels (federation actually federates);
+    * SIGKILLing peer B opens exactly ONE ``fleet_host_down`` incident
+      (for hostB only — hostA must stay clean) through the standard
+      sampler→detector→incident pipeline, and respawning the peer on
+      the same host identity + port auto-resolves it;
+    * the Holt forecaster's backtest relative error on the fleet
+      request-rate signal is under ``SPARKML_LOAD_FLEET_FORECAST_ERR``
+      (default 0.5) after the soak — the predictive plane's evidence
+      that its projections track reality."""
+    import socket
+    import subprocess
+
+    forecast_err_bar = _env_float("SPARKML_LOAD_FLEET_FORECAST_ERR", 0.5)
+    soak_s = _env_float("SPARKML_LOAD_FLEET_SOAK_SECONDS", 12.0)
+
+    # fast cadences BEFORE the obs singletons are constructed (children
+    # inherit these via the spawn env, so both sides sweep at 100 ms
+    # and incidents open after 1 sweep / resolve after 2)
+    os.environ["SPARK_RAPIDS_ML_TPU_OBS_SAMPLE_MS"] = "100"
+    os.environ["SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_OPEN_AFTER"] = "1"
+    os.environ["SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_RESOLVE_AFTER"] = "2"
+    os.environ["SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_COOLDOWN_S"] = "0"
+    os.environ["SPARK_RAPIDS_ML_TPU_OBS_INCIDENT_CAPTURE_S"] = "0"
+
+    from spark_rapids_ml_tpu.obs import (
+        federation,
+        forecast,
+        incidents as incidents_mod,
+        tsdb as tsdb_mod,
+    )
+
+    def free_port() -> int:
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        return port
+
+    ports = {"hostA": free_port(), "hostB": free_port()}
+    bases = {h: f"http://127.0.0.1:{p}" for h, p in ports.items()}
+    procs: dict = {}
+
+    def spawn(host: str) -> None:
+        env = dict(os.environ)
+        env["SPARKML_LOAD_PHASE"] = "fleet_child"
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+        env["SPARKML_LOAD_FLEET_PORT"] = str(ports[host])
+        env["SPARK_RAPIDS_ML_TPU_FLEET_HOST"] = host
+        procs[host] = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def wait_ready(host: str, timeout_s: float = 90.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if _get_json(bases[host], "/healthz"):  # {} while booting
+                return True
+            time.sleep(0.2)
+        return False
+
+    def wait_for(predicate, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.2)
+        return False
+
+    def fleet_incidents(state: str) -> list:
+        digest = inc_engine.digest()
+        return [i for i in digest.get(state, [])
+                if i.get("detector") == federation.INCIDENT_NAME]
+
+    bench_common.log("load_harness fleet: spawning 2 serving peers "
+                     f"(hostA:{ports['hostA']}, hostB:{ports['hostB']})")
+    for host in sorted(ports):
+        spawn(host)
+    agg = None
+    failures = []
+    try:
+        for host in sorted(ports):
+            if not wait_ready(host):
+                bench_common.log(
+                    f"load_harness fleet FAIL: {host} never became "
+                    f"ready on {bases[host]}")
+                return 1
+
+        sampler = tsdb_mod.start_sampling()
+        inc_engine = incidents_mod.get_incident_engine()
+        inc_engine.install(sampler)
+        forecaster = forecast.get_forecaster()
+        forecaster.install(sampler)
+        agg = federation.FleetAggregator(
+            [(host, bases[host]) for host in sorted(ports)],
+            poll_interval_s=0.25, stale_after_s=1.0,
+            fetch_timeout_s=1.0, forecaster=forecaster)
+        federation.set_aggregator(agg)
+        agg.start()
+
+        # -- soak: merged series must carry BOTH host labels ---------------
+        def merged_hosts() -> set:
+            found = set()
+            for row in agg.store().range_query(
+                    "sparkml_serve_requests_total", window=120.0):
+                host = row["labels"].get("host")
+                if host:
+                    found.add(host)
+            return found
+
+        time.sleep(soak_s)
+        both_merged = wait_for(
+            lambda: merged_hosts() >= set(ports), timeout_s=30.0)
+        hosts_seen = sorted(merged_hosts())
+        rollup = agg.rollup()
+        if not both_merged:
+            failures.append(
+                f"merged store carries host labels {hosts_seen}, "
+                f"wanted both of {sorted(ports)}")
+        if rollup["hosts_up"] != len(ports):
+            failures.append(
+                f"{rollup['hosts_up']}/{len(ports)} hosts up after "
+                f"soak: {rollup['hosts']}")
+
+        # -- kill drill: SIGKILL hostB → exactly one fleet_host_down -------
+        procs["hostB"].kill()
+        procs["hostB"].wait()
+        opened = wait_for(lambda: len(fleet_incidents("open")) >= 1)
+        open_incs = fleet_incidents("open")
+        open_hosts = sorted({(i.get("labels") or {}).get("host")
+                             for i in open_incs})
+        if not opened or len(open_incs) != 1 or open_hosts != ["hostB"]:
+            failures.append(
+                f"kill drill wanted exactly one open "
+                f"{federation.INCIDENT_NAME} for hostB, got "
+                f"{len(open_incs)} for hosts {open_hosts}")
+
+        # -- respawn on the SAME identity + port → must auto-resolve -------
+        spawn("hostB")
+        if not wait_ready("hostB"):
+            failures.append("respawned hostB never became ready")
+        resolved = wait_for(
+            lambda: not fleet_incidents("open")
+            and any(i.get("state") == "resolved"
+                    for i in fleet_incidents("recent")))
+        total_fleet_incidents = (
+            len(fleet_incidents("open")) + len(fleet_incidents("recent")))
+        if not resolved:
+            failures.append(
+                f"{federation.INCIDENT_NAME} did not auto-resolve after "
+                f"respawn: open={fleet_incidents('open')} "
+                f"recent={fleet_incidents('recent')}")
+        if total_fleet_incidents != 1:
+            failures.append(
+                f"kill drill produced {total_fleet_incidents} "
+                f"{federation.INCIDENT_NAME} incident(s), wanted "
+                f"exactly one (flapping or a ghost host)")
+
+        # -- forecaster backtest over the merged fleet rate ----------------
+        fc = forecaster.snapshot()
+        rps = fc["signals"].get("rps", {})
+        backtest = rps.get("backtest", {})
+        rel_err = backtest.get("rel_err_mean")
+        if rps.get("updates", 0) < 10 or rel_err is None:
+            failures.append(
+                f"forecaster starved: {rps.get('updates', 0)} rps "
+                f"updates, rel_err={rel_err}")
+        elif rel_err > forecast_err_bar:
+            failures.append(
+                f"forecast backtest rel err {rel_err:.4f} exceeds "
+                f"bar {forecast_err_bar:.4f}")
+        rollup = agg.rollup()
+    finally:
+        if agg is not None:
+            agg.stop()
+            federation.set_aggregator(None)
+        for proc in procs.values():
+            try:
+                proc.kill()
+            except Exception:  # noqa: BLE001 - already dead is fine
+                pass
+
+    record = {
+        "bench": "load_harness_fleet",
+        "metric": "load_harness_fleet_forecast_rel_err",
+        "value": rel_err if rel_err is not None else 1.0,
+        "unit": ("Holt backtest |err| / |value| on the merged fleet "
+                 "request-rate signal over the soak"),
+        "higher_is_better": False,
+        "platform": "cpu",
+        "device_kind": "cpu",
+        "peers": len(ports),
+        "soak_seconds": soak_s,
+        "forecast_err_bar": forecast_err_bar,
+        "merged_host_labels": hosts_seen,
+        "hosts_up_after_soak": rollup["hosts_up"],
+        "merged_points": {
+            row["host"]: row["merged_points"]
+            for row in rollup["hosts"]},
+        "fleet_incidents_total": total_fleet_incidents,
+        "incident_auto_resolved": resolved,
+        "forecast": fc["signals"],
+    }
+    bench_common.emit_record(record, include_metrics=False)
+    if failures:
+        bench_common.log("load_harness fleet FAIL: "
+                         + "; ".join(failures))
+        return 1
+    bench_common.log(
+        f"load_harness fleet PASS: both peers merged under host labels "
+        f"{hosts_seen}, kill drill opened exactly one auto-resolving "
+        f"{federation.INCIDENT_NAME}, forecast backtest rel err "
+        f"{rel_err:.4f} (bar {forecast_err_bar:.4f})")
+    return 0
+
+
 def main() -> int:
     if os.environ.get("SPARKML_LOAD_PHASE") == "device_capacity_child":
         return device_capacity_child()
@@ -1489,6 +1757,10 @@ def main() -> int:
         return density_child()
     if os.environ.get("SPARKML_LOAD_PHASE") == "density":
         return run_density_phase()
+    if os.environ.get("SPARKML_LOAD_PHASE") == "fleet_child":
+        return fleet_child()
+    if os.environ.get("SPARKML_LOAD_PHASE") == "fleet":
+        return run_fleet_phase()
     soak_s = _env_float("SPARKML_LOAD_SOAK_SECONDS", 60.0)
     calibrate_s = _env_float("SPARKML_LOAD_CALIBRATE_SECONDS", 8.0)
     n_features = _env_int("SPARKML_LOAD_FEATURES", 16)

@@ -32,6 +32,15 @@ a SECOND record (``bench: obs_overhead_accounting``) is emitted whose
 bar (``SPARKML_BENCH_OBS_ACCT_BAR``, default 0.02). The process exits
 non-zero when the ledger arm misses that bar, so CI can gate on it.
 
+A FOURTH experiment prices being a polled fleet peer
+(``obs.federation.fleet_export``): sampler ON in both sub-arms (the
+export needs real series to walk), with an aggregator-shaped background
+thread polling ``fleet_export(cursor)`` at ``SPARKML_BENCH_OBS_FED_MS``
+(default 100 ms — far hotter than the 2 s shipping poll cadence)
+toggled off→on→off→on. The record (``bench: obs_overhead_federation``)
+carries ``federation_overhead_fraction`` judged against
+``SPARKML_BENCH_OBS_FED_BAR`` (default 0.02); a miss exits non-zero.
+
 A third experiment prices the fit-path step monitor (``obs.fitmon``):
 a tape of repeated PCA fits, each wrapped in ``fitmon.fit_run`` so the
 step-monitor call sites execute in BOTH arms, with the monitor toggled
@@ -43,7 +52,8 @@ Knobs (env): SPARKML_BENCH_OBS_REQUESTS (default 384, per phase),
 SPARKML_BENCH_OBS_FEATURES (64), SPARKML_BENCH_OBS_K (16),
 SPARKML_BENCH_OBS_THREADS (8), SPARKML_BENCH_OBS_MAX_ROWS (512),
 SPARKML_BENCH_OBS_SAMPLE_MS (100), SPARKML_BENCH_OBS_ACCT_BAR (0.02),
-SPARKML_BENCH_OBS_FITS (24), SPARKML_BENCH_OBS_FITMON_BAR (0.02).
+SPARKML_BENCH_OBS_FITS (24), SPARKML_BENCH_OBS_FITMON_BAR (0.02),
+SPARKML_BENCH_OBS_FED_MS (100), SPARKML_BENCH_OBS_FED_BAR (0.02).
 """
 
 from __future__ import annotations
@@ -51,6 +61,7 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -166,6 +177,47 @@ def main() -> int:
         ledger.enabled = True
         acct_on_rates.append(run_phase())
     ledger_mutations = ledger_mutations_total() - mutations_before
+
+    # ---- federation arm: what does being a polled fleet peer cost? ----
+    # Same tape, but the toggle is an aggregator-shaped export poller: a
+    # background thread calling obs.federation.fleet_export(cursor) at
+    # SPARKML_BENCH_OBS_FED_MS cadence against the live sampler store
+    # (the sampler runs in BOTH sub-arms so the export has real series
+    # to walk — the fraction prices only the peer-side export toll, not
+    # the sampler it rides on). Cursor advances between polls exactly
+    # like FleetAggregator's, so steady-state polls ship small deltas.
+    from spark_rapids_ml_tpu.obs import federation
+
+    fed_bar = float(os.environ.get("SPARKML_BENCH_OBS_FED_BAR", "0.02"))
+    fed_ms = _env_int("SPARKML_BENCH_OBS_FED_MS", 100)
+    sampler.start()
+    fed_stop = threading.Event()
+    fed_stats = {"polls": 0, "points": 0}
+
+    def fed_poller() -> None:
+        cursor = 0.0
+        while not fed_stop.wait(fed_ms / 1000.0):
+            try:
+                doc = federation.fleet_export(
+                    cursor, store=sampler.store, engine=engine)
+            except Exception:  # noqa: BLE001 - poller must not die mid-arm
+                continue
+            cursor = float(doc.get("cursor", cursor))
+            fed_stats["polls"] += 1
+            fed_stats["points"] += sum(
+                len(s["points"]) for s in doc.get("series", ()))
+
+    fed_off_rates, fed_on_rates = [], []
+    for _round in range(2):
+        fed_off_rates.append(run_phase())
+        fed_stop.clear()
+        fed_thread = threading.Thread(
+            target=fed_poller, name="bench-fed-poller", daemon=True)
+        fed_thread.start()
+        fed_on_rates.append(run_phase())
+        fed_stop.set()
+        fed_thread.join(timeout=5.0)
+    sampler.stop()
     engine.shutdown()
 
     rows_per_sec_off = float(np.mean(off_rates))
@@ -222,6 +274,35 @@ def main() -> int:
         "ledger_mutations_during_on_phases": ledger_mutations,
         "gate_bar": acct_bar,
         "gate_ok": gate_ok,
+    }, include_metrics=False)
+
+    fed_off = float(np.mean(fed_off_rates))
+    fed_on = float(np.mean(fed_on_rates))
+    federation_overhead = max(
+        0.0, 1.0 - fed_on / fed_off
+    ) if fed_off > 0 else 0.0
+    fed_ok = federation_overhead <= fed_bar
+    bench_common.emit_record({
+        "bench": "obs_overhead_federation",
+        "metric": "federation_overhead_fraction",
+        "value": federation_overhead,
+        "unit": "fraction of serve throughput lost to fleet export polls",
+        "higher_is_better": False,
+        "platform": device.platform,
+        "device_kind": str(device.device_kind),
+        "requests_per_phase": n_requests,
+        "threads": n_threads,
+        "rows_per_phase": total_rows,
+        "poll_interval_ms": fed_ms,
+        "sample_interval_ms": sample_ms,
+        "rows_per_sec_off": fed_off,
+        "rows_per_sec_on": fed_on,
+        "rows_per_sec_off_rounds": fed_off_rates,
+        "rows_per_sec_on_rounds": fed_on_rates,
+        "export_polls": fed_stats["polls"],
+        "export_points_shipped": fed_stats["points"],
+        "gate_bar": fed_bar,
+        "gate_ok": fed_ok,
     }, include_metrics=False)
 
     # ---- fitmon arm: what does the fit-path step monitor cost? ----
@@ -294,6 +375,11 @@ def main() -> int:
         bench_common.log(
             f"fitmon overhead {fitmon_overhead:.4f} exceeds "
             f"bar {fitmon_bar:.4f}")
+        failed = True
+    if not fed_ok:
+        bench_common.log(
+            f"federation overhead {federation_overhead:.4f} exceeds "
+            f"bar {fed_bar:.4f}")
         failed = True
     return 1 if failed else 0
 

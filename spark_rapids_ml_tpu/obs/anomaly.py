@@ -528,6 +528,20 @@ def builtin_detectors(
             kind="backend", severity="critical",
             stale_after=max(2 * w, 120.0),
         ),
+        # Fleet federation (obs/federation.py): the aggregator holds
+        # sparkml_fleet_host_up{host} at 1 while a peer's export
+        # endpoint answers within the staleness grace and drops it to 0
+        # when the peer goes silent. Per-host labels make the dedup key
+        # per-host, so a dead peer is exactly ONE incident that
+        # auto-resolves when the (respawned) peer answers again under
+        # the SAME SPARK_RAPIDS_ML_TPU_FLEET_HOST identity.
+        ThresholdDetector(
+            "fleet_host_down",
+            "sparkml_fleet_host_up",
+            threshold=0.5, direction="<",
+            kind="fleet", severity="critical",
+            stale_after=max(2 * w, 120.0),
+        ),
     ]
 
 

@@ -1,0 +1,688 @@
+"""Fleet telemetry federation: per-process export snapshots and the
+aggregator that merges N serving processes into one host-labeled view.
+
+Every TSDB, incident engine, and ``/debug/*`` surface in this repo is
+process-local; a fleet of serving processes is only operable with one
+merged view. This module is that plane:
+
+* ``fleet_export(cursor)`` — the ``GET /debug/fleet/export`` document:
+  TSDB ring deltas since the caller's cursor (points strictly newer
+  than ``cursor``; the reply's ``cursor`` field is what to send next —
+  re-polling with an old cursor is harmless because the merge is
+  last-in-bucket idempotent), mergeable quantile-sketch states
+  (``obs.quantiles`` sketches serialize losslessly and merge
+  associatively — NEVER averaged percentiles), compact incident
+  digests, the engine's replica/tiering/autoscale state, host identity
+  and backend provenance. Aggregator-derived series
+  (``sparkml_fleet_*``, ``sparkml_forecast_*``, anything already
+  host-labeled) are excluded so federation stays one level deep.
+* ``FleetAggregator`` — polls peer export URLs at a bounded
+  injectable-clock cadence (``poll_once(now)`` is fully test-drivable;
+  ``fetch_fn`` is injectable so tests use fake peers, zero sockets) and
+  merges each peer's series into the local store under a ``host=``
+  label. It publishes ``sparkml_fleet_host_up{host}`` /
+  ``sparkml_fleet_host_staleness_seconds{host}`` gauges into the
+  process registry, so an unreachable/stale peer flows through the
+  EXISTING sampler → ``fleet_host_down`` ThresholdDetector →
+  IncidentEngine pipeline and raises exactly one auto-resolving
+  incident per host — no parallel alerting path. Open incidents that
+  share (detector, labels) across hosts dedup into ONE fleet incident
+  carrying per-host evidence.
+* ``rollup()`` — the ``GET /debug/fleet`` document: per-host table
+  (up/staleness/cursor/replica state), fleet-wide SLO burn from the
+  merged host-labeled burn series, merged-sketch latency quantiles,
+  and the forecast panel when a ``Forecaster`` is attached.
+
+Host identity is ``SPARK_RAPIDS_ML_TPU_FLEET_HOST`` when set (the load
+harness pins it per child so a respawned peer keeps its label and its
+``fleet_host_down`` incident can resolve), else ``hostname:pid``.
+
+Every peer-poll outcome (ok / unreachable / stale), merged point, and
+incident-dedup decision increments a counter in the same function that
+took it (``check_instrumentation`` rule 18), and this module never
+reads the wall clock directly (rule 8) — time flows from the injected
+``clock`` or the caller's ``now``.
+
+Knobs (env): SPARK_RAPIDS_ML_TPU_FLEET_HOST (identity override),
+SPARK_RAPIDS_ML_TPU_FLEET_POLL_S (2.0 — aggregator cadence),
+SPARK_RAPIDS_ML_TPU_FLEET_STALE_S (10.0 — grace before a silent peer
+counts as down), SPARK_RAPIDS_ML_TPU_FLEET_TIMEOUT_S (1.0 — per-fetch
+HTTP timeout), SPARK_RAPIDS_ML_TPU_FLEET_PEERS (comma-separated peer
+base URLs, optionally ``host=url``; consumed by ``peers_from_env``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from spark_rapids_ml_tpu.obs import metrics as metrics_mod
+from spark_rapids_ml_tpu.obs import quantiles as quantiles_mod
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+from spark_rapids_ml_tpu.obs.logging import get_logger
+
+HOST_ENV = "SPARK_RAPIDS_ML_TPU_FLEET_HOST"
+POLL_ENV = "SPARK_RAPIDS_ML_TPU_FLEET_POLL_S"
+STALE_ENV = "SPARK_RAPIDS_ML_TPU_FLEET_STALE_S"
+TIMEOUT_ENV = "SPARK_RAPIDS_ML_TPU_FLEET_TIMEOUT_S"
+PEERS_ENV = "SPARK_RAPIDS_ML_TPU_FLEET_PEERS"
+
+EXPORT_VERSION = 1
+HOST_UP_METRIC = "sparkml_fleet_host_up"
+INCIDENT_NAME = "fleet_host_down"
+
+_DEFAULT_POLL_S = 2.0
+_DEFAULT_STALE_S = 10.0
+_DEFAULT_TIMEOUT_S = 1.0
+# export size guards: a snapshot is a poll payload, not an archive
+_MAX_EXPORT_SERIES = 512
+_MAX_EXPORT_SKETCHES = 128
+_MAX_ROLLUP_SKETCHES = 32
+_DIGEST_RECENT = 8
+# series the export refuses: aggregator-local families would otherwise
+# echo back and forth between two aggregating processes
+_EXPORT_EXCLUDE_PREFIXES = ("sparkml_fleet_", "sparkml_forecast_")
+
+_log = get_logger("obs.federation")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def host_identity() -> str:
+    """This process's stable fleet label: the env override when set
+    (respawned peers keep their label, so their ``fleet_host_down``
+    incident can auto-resolve), else ``hostname:pid``."""
+    override = os.environ.get(HOST_ENV, "").strip()
+    if override:
+        return override
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+def backend_provenance() -> Dict[str, Any]:
+    """Which accelerator stack this process actually resolved — guarded
+    (an export must work on a process that never imported jax)."""
+    doc: Dict[str, Any] = {"pid": os.getpid()}
+    try:
+        import jax
+
+        doc["jax_platform"] = jax.default_backend()
+        doc["device_count"] = jax.device_count()
+    except Exception as exc:  # noqa: BLE001 - provenance is best-effort
+        doc["jax_error"] = f"{type(exc).__name__}: {exc}"
+    return doc
+
+
+def peers_from_env() -> List[Tuple[Optional[str], str]]:
+    """Parse ``SPARK_RAPIDS_ML_TPU_FLEET_PEERS``: comma-separated base
+    URLs, each optionally prefixed ``host=`` to pin the label before
+    the first successful poll."""
+    out: List[Tuple[Optional[str], str]] = []
+    for part in os.environ.get(PEERS_ENV, "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part and not part.split("=", 1)[0].startswith("http"):
+            host, url = part.split("=", 1)
+            out.append((host.strip() or None, url.strip()))
+        else:
+            out.append((None, part))
+    return out
+
+
+# -- the export side (every serving process) ----------------------------------
+
+
+def _sketch_states(registry: metrics_mod.MetricsRegistry,
+                   limit: int = _MAX_EXPORT_SKETCHES
+                   ) -> List[Dict[str, Any]]:
+    """Every Summary family's per-child sketch state — the mergeable
+    transport (states merge losslessly; percentiles would not)."""
+    out: List[Dict[str, Any]] = []
+    for family in registry.families():
+        if not isinstance(family, metrics_mod.Summary):
+            continue
+        for labels, state in family.sketch_states():
+            out.append({"name": family.name, "labels": labels,
+                        "state": state})
+            if len(out) >= limit:
+                return out
+    return out
+
+
+def _incident_digest(engine=None) -> Dict[str, Any]:
+    """Compact open/recent incident digests (no evidence bundles — an
+    export is a poll payload)."""
+    if engine is None:
+        try:
+            from spark_rapids_ml_tpu.obs import incidents as incidents_mod
+
+            if not incidents_mod.enabled():
+                return {"open": [], "recent": []}
+            engine = incidents_mod.get_incident_engine()
+        except Exception:  # noqa: BLE001 - digest is best-effort
+            return {"open": [], "recent": []}
+    try:
+        return engine.digest()
+    except Exception:  # noqa: BLE001
+        return {"open": [], "recent": []}
+
+
+def fleet_export(cursor: float = 0.0, *,
+                 store: Optional[tsdb_mod.TimeSeriesStore] = None,
+                 registry: Optional[metrics_mod.MetricsRegistry] = None,
+                 engine=None, incident_engine=None,
+                 now: Optional[float] = None) -> Dict[str, Any]:
+    """Assemble one ``GET /debug/fleet/export`` snapshot.
+
+    ``cursor`` is the ``cursor`` field of the previous reply (0 for the
+    first poll → the full retained window). Points returned are
+    STRICTLY newer than ``cursor``; because the aggregator's merge is
+    last-in-bucket idempotent, overlap from a stale cursor never
+    double-counts.
+    """
+    store = store if store is not None else tsdb_mod.get_tsdb()
+    registry = (registry if registry is not None
+                else metrics_mod.get_registry())
+    ts = store.clock() if now is None else float(now)
+    max_window = max(span for _, span in store.tiers)
+    window = max_window if cursor <= 0 else min(
+        max(ts - cursor, 0.0) + 1.0, max_window)
+    m_export = registry.counter(
+        "sparkml_fleet_export_total",
+        "fleet export snapshots served, by outcome", ("outcome",))
+    series_out: List[Dict[str, Any]] = []
+    truncated = 0
+    for name in store.series_names():
+        if name.startswith(_EXPORT_EXCLUDE_PREFIXES):
+            continue
+        for child in store.range_query(name, None, window, now=ts):
+            if "host" in child["labels"]:
+                continue  # already federated once — stay one level deep
+            points = [[p_ts, p_v] for p_ts, p_v in child["points"]
+                      if p_ts > cursor]
+            if not points:
+                continue
+            if len(series_out) >= _MAX_EXPORT_SERIES:
+                truncated += 1
+                continue
+            series_out.append({
+                "name": name,
+                "labels": child["labels"],
+                "kind": child["kind"],
+                "points": points,
+            })
+    state: Dict[str, Any] = {}
+    if engine is not None:
+        try:
+            state = engine.fleet_state()
+        except Exception:  # noqa: BLE001 - state is best-effort
+            state = {}
+    doc = {
+        "version": EXPORT_VERSION,
+        "host": host_identity(),
+        "now": ts,
+        "cursor": ts,
+        "backend": backend_provenance(),
+        "series": series_out,
+        "series_truncated": truncated,
+        "sketches": _sketch_states(registry),
+        "incidents": _incident_digest(incident_engine),
+        "state": state,
+    }
+    m_export.inc(outcome="truncated" if truncated else "ok")
+    return doc
+
+
+def _http_fetch(url: str, timeout: float) -> Dict[str, Any]:
+    """Default ``fetch_fn``: one bounded HTTP GET returning the parsed
+    export document."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+# -- the aggregator side ------------------------------------------------------
+
+
+class _PeerState:
+    __slots__ = ("url", "host", "cursor", "last_ok_ts", "polls",
+                 "failures", "consecutive_failures", "sketches",
+                 "incidents", "state", "backend", "last_error",
+                 "merged_points")
+
+    def __init__(self, url: str, host: Optional[str]):
+        self.url = url
+        self.host = host  # learned from the first export when None
+        self.cursor = 0.0
+        self.last_ok_ts: Optional[float] = None
+        self.polls = 0
+        self.failures = 0
+        self.consecutive_failures = 0
+        self.sketches: List[Dict[str, Any]] = []
+        self.incidents: Dict[str, Any] = {"open": [], "recent": []}
+        self.state: Dict[str, Any] = {}
+        self.backend: Dict[str, Any] = {}
+        self.last_error: Optional[str] = None
+        self.merged_points = 0
+
+    def label(self) -> str:
+        if self.host:
+            return self.host
+        # never-seen peer: a url-derived label keeps its down incident
+        # addressable before the first successful poll
+        return "".join(c if (c.isalnum() or c in ".-_") else "_"
+                       for c in self.url.split("://")[-1])[:60]
+
+
+class FleetAggregator:
+    """Polls peer export endpoints and maintains the merged fleet view.
+
+    Runnable inside any serving process or standalone: the merge target
+    defaults to the process TSDB/registry, so ``/debug/history?host=``
+    and the incident pipeline see federated series with zero extra
+    plumbing. ``poll_once(now)`` is the whole cadence unit — the
+    background thread just calls it on an interval; tests call it
+    directly with injected clocks and fake ``fetch_fn`` peers.
+    """
+
+    def __init__(
+        self,
+        peers,
+        *,
+        store: Optional[tsdb_mod.TimeSeriesStore] = None,
+        registry: Optional[metrics_mod.MetricsRegistry] = None,
+        poll_interval_s: Optional[float] = None,
+        stale_after_s: Optional[float] = None,
+        fetch_timeout_s: Optional[float] = None,
+        fetch_fn: Optional[Callable[[str, float], Dict[str, Any]]] = None,
+        forecaster=None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._store = store
+        self._registry = registry
+        self.poll_interval_s = float(
+            poll_interval_s if poll_interval_s is not None
+            else _env_float(POLL_ENV, _DEFAULT_POLL_S))
+        self.stale_after_s = float(
+            stale_after_s if stale_after_s is not None
+            else _env_float(STALE_ENV, _DEFAULT_STALE_S))
+        self.fetch_timeout_s = float(
+            fetch_timeout_s if fetch_timeout_s is not None
+            else _env_float(TIMEOUT_ENV, _DEFAULT_TIMEOUT_S))
+        self.fetch_fn = fetch_fn if fetch_fn is not None else _http_fetch
+        self.forecaster = forecaster
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._peers: List[_PeerState] = []
+        for entry in peers:
+            if isinstance(entry, str):
+                self._peers.append(_PeerState(entry, None))
+            else:
+                host, url = entry
+                self._peers.append(_PeerState(url, host))
+        self._polls = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = self._reg()
+        self._m_polls = reg.counter(
+            "sparkml_fleet_polls_total",
+            "peer poll outcomes (ok / unreachable within grace / stale "
+            "beyond grace)", ("outcome",))
+        for outcome in ("ok", "unreachable", "stale"):
+            self._m_polls.inc(0, outcome=outcome)
+        self._m_merged = reg.counter(
+            "sparkml_fleet_merged_points_total",
+            "series points merged into the fleet store, by host",
+            ("host",))
+        self._m_dedup = reg.counter(
+            "sparkml_fleet_incident_dedup_total",
+            "fleet incident grouping decisions (grouped = the same "
+            "(detector, labels) was open on 2+ hosts)", ("outcome",))
+        self._g_up = reg.gauge(
+            HOST_UP_METRIC,
+            "1 while the peer's export endpoint answers within the "
+            "staleness grace; the fleet_host_down detector pages on 0",
+            ("host",))
+        self._g_staleness = reg.gauge(
+            "sparkml_fleet_host_staleness_seconds",
+            "seconds since the peer's last successful export poll",
+            ("host",))
+
+    def _reg(self) -> metrics_mod.MetricsRegistry:
+        return (self._registry if self._registry is not None
+                else metrics_mod.get_registry())
+
+    def store(self) -> tsdb_mod.TimeSeriesStore:
+        return (self._store if self._store is not None
+                else tsdb_mod.get_tsdb())
+
+    @property
+    def total_polls(self) -> int:
+        return self._polls
+
+    def peer_hosts(self) -> List[str]:
+        with self._lock:
+            return [p.label() for p in self._peers]
+
+    # -- the cadence unit ---------------------------------------------------
+
+    def poll_once(self, now: Optional[float] = None) -> Dict[str, str]:
+        """Poll every peer once; returns {host_label: outcome} with
+        outcome ∈ ok | unreachable (failed, within grace) | stale
+        (failed, beyond grace → host_up drops to 0). Each outcome is
+        counted here, in the function that decided it (rule 18)."""
+        ts = self.clock() if now is None else float(now)
+        outcomes: Dict[str, str] = {}
+        with self._lock:
+            self._polls += 1
+            peers = list(self._peers)
+        for peer in peers:
+            url = (peer.url.rstrip("/")
+                   + f"/debug/fleet/export?cursor={peer.cursor!r}")
+            try:
+                doc = self.fetch_fn(url, self.fetch_timeout_s)
+                self._merge_export(peer, doc, ts)
+                outcome = "ok"
+                self._m_polls.inc(outcome="ok")
+            except Exception as exc:  # noqa: BLE001 - a dead peer is data
+                with self._lock:
+                    peer.polls += 1
+                    peer.failures += 1
+                    peer.consecutive_failures += 1
+                    peer.last_error = f"{type(exc).__name__}: {exc}"
+                    last_ok = peer.last_ok_ts
+                beyond_grace = (last_ok is None
+                                or ts - last_ok > self.stale_after_s)
+                outcome = "stale" if beyond_grace else "unreachable"
+                self._m_polls.inc(outcome=outcome)
+            self._publish_host_health(peer, ts)
+            outcomes[peer.label()] = outcome
+        return outcomes
+
+    def _merge_export(self, peer: _PeerState, doc: Dict[str, Any],
+                      ts: float) -> int:
+        """Fold one export document into the fleet view; returns and
+        counts the number of points merged."""
+        host = str(doc.get("host") or peer.label())
+        store = self.store()
+        merged = 0
+        for series in doc.get("series", ()):
+            labels = dict(series.get("labels") or {})
+            labels["host"] = host
+            name = str(series.get("name"))
+            kind = str(series.get("kind") or "gauge")
+            for p_ts, p_v in series.get("points", ()):
+                # record at the PEER's timestamp: last-in-bucket makes
+                # re-merging an overlapping delta idempotent
+                store.record(name, labels, float(p_v), kind=kind,
+                             now=float(p_ts))
+                merged += 1
+        with self._lock:
+            peer.host = host
+            peer.cursor = float(doc.get("cursor") or ts)
+            peer.last_ok_ts = ts
+            peer.polls += 1
+            peer.consecutive_failures = 0
+            peer.last_error = None
+            peer.merged_points += merged
+            peer.sketches = list(doc.get("sketches") or ())
+            peer.incidents = dict(
+                doc.get("incidents") or {"open": [], "recent": []})
+            peer.state = dict(doc.get("state") or {})
+            peer.backend = dict(doc.get("backend") or {})
+        if merged:
+            self._m_merged.inc(merged, host=host)
+        return merged
+
+    def _publish_host_health(self, peer: _PeerState, ts: float) -> None:
+        host = peer.label()
+        last_ok = peer.last_ok_ts
+        staleness = (ts - last_ok) if last_ok is not None else float(
+            "inf")
+        up = 1.0 if staleness <= self.stale_after_s else 0.0
+        self._g_up.set(up, host=host)
+        self._g_staleness.set(
+            staleness if staleness != float("inf") else -1.0, host=host)
+
+    # -- fleet incident dedup -----------------------------------------------
+
+    def _dedup_fleet_incidents(self) -> List[Dict[str, Any]]:
+        """Group peers' open incidents by (detector, labels): the same
+        anomaly on N hosts is ONE fleet incident with per-host
+        evidence, not N pages. Counts every grouping decision."""
+        grouped: Dict[Tuple, Dict[str, Any]] = {}
+        with self._lock:
+            peers = [(p.label(), dict(p.incidents)) for p in self._peers]
+        for host, digest in peers:
+            for inc in digest.get("open", ()):
+                labels = dict(inc.get("labels") or {})
+                key = (inc.get("detector"),
+                       tuple(sorted(labels.items())))
+                entry = grouped.get(key)
+                if entry is None:
+                    grouped[key] = {
+                        "detector": inc.get("detector"),
+                        "kind": inc.get("kind"),
+                        "severity": inc.get("severity"),
+                        "metric": inc.get("metric"),
+                        "labels": labels,
+                        "hosts": {},
+                    }
+                    entry = grouped[key]
+                entry["hosts"][host] = {
+                    "id": inc.get("id"),
+                    "opened_ts": inc.get("opened_ts"),
+                    "value": inc.get("value"),
+                    "reason": inc.get("reason"),
+                }
+        out: List[Dict[str, Any]] = []
+        for entry in grouped.values():
+            entry["host_count"] = len(entry["hosts"])
+            self._m_dedup.inc(outcome=(
+                "grouped" if entry["host_count"] > 1 else "single"))
+            out.append(entry)
+        out.sort(key=lambda e: (-e["host_count"],
+                                str(e["detector"])))
+        return out
+
+    # -- merged sketch view -------------------------------------------------
+
+    def _sketch_rollup(self) -> List[Dict[str, Any]]:
+        """Merge identical (name, labels) sketch states across hosts —
+        pooled-observation quantiles, never averaged percentiles."""
+        with self._lock:
+            states: List[Dict[str, Any]] = []
+            for peer in self._peers:
+                states.extend(peer.sketches)
+        merged: Dict[Tuple, quantiles_mod.QuantileSketch] = {}
+        meta: Dict[Tuple, Tuple[str, Dict[str, str]]] = {}
+        for doc in states:
+            try:
+                sketch = quantiles_mod.QuantileSketch.from_dict(
+                    doc["state"])
+            except Exception:  # noqa: BLE001 - a bad state is skipped
+                continue
+            labels = dict(doc.get("labels") or {})
+            key = (doc.get("name"), tuple(sorted(labels.items())))
+            if key in merged:
+                try:
+                    merged[key].merge(sketch)
+                except ValueError:
+                    continue  # alpha mismatch across versions: skip
+            else:
+                merged[key] = sketch
+                meta[key] = (str(doc.get("name")), labels)
+        out: List[Dict[str, Any]] = []
+        for key, sketch in merged.items():
+            name, labels = meta[key]
+            out.append({
+                "name": name,
+                "labels": labels,
+                "count": sketch.count,
+                "sum": sketch.sum,
+                "quantiles": {
+                    "p50": sketch.quantile(0.5),
+                    "p95": sketch.quantile(0.95),
+                    "p99": sketch.quantile(0.99),
+                },
+            })
+        out.sort(key=lambda e: (-e["count"], e["name"]))
+        return out[:_MAX_ROLLUP_SKETCHES]
+
+    # -- the /debug/fleet document ------------------------------------------
+
+    def rollup(self, now: Optional[float] = None) -> Dict[str, Any]:
+        ts = self.clock() if now is None else float(now)
+        store = self.store()
+        hosts: List[Dict[str, Any]] = []
+        up_count = 0
+        with self._lock:
+            peers = list(self._peers)
+        for peer in peers:
+            last_ok = peer.last_ok_ts
+            staleness = (ts - last_ok) if last_ok is not None else None
+            up = (staleness is not None
+                  and staleness <= self.stale_after_s)
+            up_count += 1 if up else 0
+            state = dict(peer.state)
+            hosts.append({
+                "host": peer.label(),
+                "url": peer.url,
+                "up": up,
+                "staleness_seconds": staleness,
+                "cursor": peer.cursor,
+                "polls": peer.polls,
+                "failures": peer.failures,
+                "consecutive_failures": peer.consecutive_failures,
+                "last_error": peer.last_error,
+                "merged_points": peer.merged_points,
+                "open_incidents": len(peer.incidents.get("open", ())),
+                "replicas": state.get("replicas"),
+                "backend": dict(peer.backend),
+            })
+        burn_by_host: Dict[str, float] = {}
+        for series in store.range_query(
+                "sparkml_slo_burn_rate", None, 120.0, now=ts):
+            labels = series["labels"]
+            if labels.get("window") != "5m" or "host" not in labels:
+                continue
+            if series["points"]:
+                host = labels["host"]
+                burn_by_host[host] = max(
+                    burn_by_host.get(host, 0.0),
+                    series["points"][-1][1])
+        doc = {
+            "now": ts,
+            "aggregator_host": host_identity(),
+            "poll_interval_s": self.poll_interval_s,
+            "stale_after_s": self.stale_after_s,
+            "polls": self._polls,
+            "hosts_total": len(hosts),
+            "hosts_up": up_count,
+            "hosts": hosts,
+            "fleet_incidents": self._dedup_fleet_incidents(),
+            "slo_burn": {
+                "by_host": burn_by_host,
+                "max": max(burn_by_host.values(), default=0.0),
+            },
+            "merged_sketches": self._sketch_rollup(),
+        }
+        if self.forecaster is not None:
+            try:
+                doc["forecast"] = self.forecaster.snapshot()
+            except Exception:  # noqa: BLE001 - panel is best-effort
+                doc["forecast"] = None
+        return doc
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the polling thread (idempotent)."""
+        from spark_rapids_ml_tpu.obs import tracectx
+
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = tracectx.traced_thread(
+                self._run, name="sparkml-fleet-aggregator",
+                daemon=True, fresh=True)
+            self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._stop.set()
+            thread = self._thread
+            self._thread = None
+        if thread is not None:
+            thread.join(timeout)
+
+    @property
+    def running(self) -> bool:
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                _log.warning("fleet poll failed", exc_info=True)
+            spans_mod.record_event(
+                "fleet:poll", t0, time.perf_counter(),
+                peers=len(self._peers))
+            self._stop.wait(self.poll_interval_s)
+
+
+# -- the process-wide aggregator ----------------------------------------------
+
+_singleton_lock = threading.Lock()
+_aggregator: Optional[FleetAggregator] = None
+
+
+def get_aggregator() -> Optional[FleetAggregator]:
+    """The aggregator serving ``/debug/fleet`` in this process (None
+    when this process does not aggregate)."""
+    with _singleton_lock:
+        return _aggregator
+
+
+def set_aggregator(aggregator: Optional[FleetAggregator]
+                   ) -> Optional[FleetAggregator]:
+    """Install (or clear, with None) the process-wide aggregator;
+    returns the previous one so callers can stop it."""
+    global _aggregator
+    with _singleton_lock:
+        previous = _aggregator
+        _aggregator = aggregator
+        return previous
+
+
+__all__ = [
+    "EXPORT_VERSION",
+    "FleetAggregator",
+    "HOST_ENV",
+    "HOST_UP_METRIC",
+    "INCIDENT_NAME",
+    "PEERS_ENV",
+    "POLL_ENV",
+    "STALE_ENV",
+    "TIMEOUT_ENV",
+    "backend_provenance",
+    "fleet_export",
+    "get_aggregator",
+    "host_identity",
+    "peers_from_env",
+    "set_aggregator",
+]

@@ -1,0 +1,606 @@
+"""Short-horizon trend forecasting over the TSDB rings, and the
+predictive input it feeds the serve autoscaler.
+
+The reactive autoscaler (``serve.autoscale``) scales when queue wait has
+ALREADY breached its threshold — by then requests have eaten the wait.
+The ROADMAP asks for the other half: *scale before the queue builds,
+not after*. This module produces that signal from history the process
+already keeps:
+
+* ``HoltState`` — incremental Holt double exponential smoothing
+  (level + trend) over irregularly-spaced samples. The trend is kept in
+  units-per-second so ``project(horizon_s)`` is just
+  ``level + trend * horizon_s``. Every update first makes a one-step
+  prediction for the incoming sample and records ``|value - predicted|``
+  — a CONTINUOUS backtest, so the forecast's own error is a published
+  metric, not a claim.
+* ``Forecaster`` — reads configured target series out of a
+  ``TimeSeriesStore`` once per sampler sweep (``install()`` hooks
+  ``register_post_sweep`` — no new thread), feeds the Holt state, and
+  publishes per-horizon projection gauges
+  (``sparkml_forecast_queue_wait_ms{horizon}``,
+  ``sparkml_forecast_rps{horizon}``) plus the backtest error gauge
+  (``sparkml_forecast_abs_err{signal}``). Default targets: queue wait
+  from the ``sparkml_serve_queue_wait_seconds`` gauge the serve stack
+  republishes every sweep, and request rate from the
+  ``sparkml_serve_requests_total`` counter's windowed rate.
+* ``PredictiveAutoscaler`` — the ``AutoscaleController`` predictive
+  input. Consulted when the reactive path HOLDs, it fires when the
+  projected queue wait at ``horizon`` would breach the SAME
+  ``up_queue_wait_s`` threshold the reactive path uses. It runs
+  SHADOW-MODE first: by default a would-scale tick only counts
+  ``sparkml_serve_autoscale_total{decision="predictive_shadow"}`` and
+  records a span — actuation (``controller.predictive_scale_up``)
+  requires ``SPARK_RAPIDS_ML_TPU_AUTOSCALE_PREDICTIVE=1``. Operators
+  watch the shadow counter against real traffic before trusting the
+  forecast with replicas.
+
+Every poll/feed outcome and every shadow/actuate decision increments a
+counter in the SAME function that took it (``check_instrumentation``
+rule 18). Clocks are injectable and this module never reads the wall
+clock directly (rule 8): timestamps flow from the sampler's sweep
+``now`` or the constructor-injected ``clock``.
+
+Knobs (env): SPARK_RAPIDS_ML_TPU_FORECAST (default 1),
+SPARK_RAPIDS_ML_TPU_FORECAST_ALPHA (0.4) / _BETA (0.2) — Holt
+smoothing factors, SPARK_RAPIDS_ML_TPU_FORECAST_HORIZONS_S ("30,120"),
+SPARK_RAPIDS_ML_TPU_FORECAST_WINDOW_S (30 — rate/read window),
+SPARK_RAPIDS_ML_TPU_AUTOSCALE_PREDICTIVE (default 0 = shadow only),
+SPARK_RAPIDS_ML_TPU_AUTOSCALE_PREDICTIVE_HORIZON_S (60).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from spark_rapids_ml_tpu.obs import metrics as metrics_mod
+from spark_rapids_ml_tpu.obs import spans as spans_mod
+from spark_rapids_ml_tpu.obs import tsdb as tsdb_mod
+from spark_rapids_ml_tpu.obs.logging import get_logger
+
+ENABLED_ENV = "SPARK_RAPIDS_ML_TPU_FORECAST"
+ALPHA_ENV = "SPARK_RAPIDS_ML_TPU_FORECAST_ALPHA"
+BETA_ENV = "SPARK_RAPIDS_ML_TPU_FORECAST_BETA"
+HORIZONS_ENV = "SPARK_RAPIDS_ML_TPU_FORECAST_HORIZONS_S"
+WINDOW_ENV = "SPARK_RAPIDS_ML_TPU_FORECAST_WINDOW_S"
+PREDICTIVE_ENV = "SPARK_RAPIDS_ML_TPU_AUTOSCALE_PREDICTIVE"
+PREDICTIVE_HORIZON_ENV = "SPARK_RAPIDS_ML_TPU_AUTOSCALE_PREDICTIVE_HORIZON_S"
+
+_DEFAULT_ALPHA = 0.4
+_DEFAULT_BETA = 0.2
+_DEFAULT_HORIZONS = (30.0, 120.0)
+_DEFAULT_WINDOW_S = 30.0
+_DEFAULT_PREDICTIVE_HORIZON_S = 60.0
+
+# The gauge serve.server republishes from the engine's live overload
+# signals every sweep — the forecaster's queue-wait input series.
+QUEUE_WAIT_SERIES = "sparkml_serve_queue_wait_seconds"
+
+_log = get_logger("obs.forecast")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def enabled() -> bool:
+    """The forecaster's kill switch (default on)."""
+    return os.environ.get(ENABLED_ENV, "1").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+def predictive_actuation_enabled() -> bool:
+    """Shadow→actuate gate: predictive scale-ups only touch replicas
+    when this is explicitly switched on."""
+    return os.environ.get(PREDICTIVE_ENV, "0").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def horizons_from_env() -> Tuple[float, ...]:
+    raw = os.environ.get(HORIZONS_ENV, "")
+    if not raw.strip():
+        return _DEFAULT_HORIZONS
+    out: List[float] = []
+    for part in raw.split(","):
+        try:
+            h = float(part)
+        except ValueError:
+            continue
+        if h > 0:
+            out.append(h)
+    return tuple(out) or _DEFAULT_HORIZONS
+
+
+def horizon_label(horizon_s: float) -> str:
+    """``30s`` / ``120s`` — the ``{horizon=}`` label value."""
+    if float(horizon_s).is_integer():
+        return f"{int(horizon_s)}s"
+    return f"{horizon_s:g}s"
+
+
+class HoltState:
+    """Incremental Holt level+trend smoothing with one-step backtest.
+
+    The update recurrence over an irregular gap ``dt = ts - last_ts``:
+
+        predicted = level + trend * dt          # one-step forecast
+        err       = |value - predicted|         # backtest residual
+        level'    = alpha * value + (1 - alpha) * predicted
+        trend'    = beta * (level' - level) / dt + (1 - beta) * trend
+
+    An exact linear ramp is a fixed point (trend converges to the slope,
+    err → 0) and a flat series keeps trend at exactly 0 — both are
+    hand-computable test fixtures. Not thread-safe on its own; the
+    owning ``Forecaster`` serialises updates.
+    """
+
+    __slots__ = ("alpha", "beta", "level", "trend", "last_ts",
+                 "updates", "abs_err_sum", "abs_value_sum", "err_count",
+                 "last_err")
+
+    def __init__(self, alpha: float = _DEFAULT_ALPHA,
+                 beta: float = _DEFAULT_BETA):
+        if not (0.0 < alpha <= 1.0) or not (0.0 <= beta <= 1.0):
+            raise ValueError(
+                f"alpha must be in (0, 1], beta in [0, 1]; "
+                f"got alpha={alpha} beta={beta}")
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.level: Optional[float] = None
+        self.trend = 0.0  # units per second
+        self.last_ts: Optional[float] = None
+        self.updates = 0
+        self.abs_err_sum = 0.0
+        self.abs_value_sum = 0.0
+        self.err_count = 0
+        self.last_err: Optional[float] = None
+
+    def update(self, ts: float, value: float) -> Optional[float]:
+        """Feed one sample; returns the backtest residual (None for the
+        seed sample or a non-advancing timestamp)."""
+        value = float(value)
+        if self.level is None:
+            self.level = value
+            self.last_ts = float(ts)
+            self.updates += 1
+            return None
+        dt = float(ts) - self.last_ts
+        if dt <= 0:
+            return None
+        predicted = self.level + self.trend * dt
+        err = abs(value - predicted)
+        self.abs_err_sum += err
+        self.abs_value_sum += abs(value)
+        self.err_count += 1
+        self.last_err = err
+        new_level = self.alpha * value + (1.0 - self.alpha) * predicted
+        self.trend = (self.beta * (new_level - self.level) / dt
+                      + (1.0 - self.beta) * self.trend)
+        self.level = new_level
+        self.last_ts = float(ts)
+        self.updates += 1
+        return err
+
+    def project(self, horizon_s: float) -> Optional[float]:
+        """Pure projection ``level + trend * horizon`` (None while
+        unseeded). Never negative-projects below zero for the serve
+        signals this module forecasts — wait and rate are both
+        non-negative quantities."""
+        if self.level is None:
+            return None
+        return max(0.0, self.level + self.trend * float(horizon_s))
+
+    def abs_err_mean(self) -> Optional[float]:
+        if self.err_count == 0:
+            return None
+        return self.abs_err_sum / self.err_count
+
+    def rel_err_mean(self) -> Optional[float]:
+        """Mean |residual| over mean |value| — the scale-free backtest
+        number the load-harness fleet gate judges."""
+        if self.err_count == 0 or self.abs_value_sum <= 0:
+            return None
+        return self.abs_err_sum / self.abs_value_sum
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "level": self.level,
+            "trend_per_s": self.trend,
+            "last_ts": self.last_ts,
+            "updates": self.updates,
+            "backtest": {
+                "samples": self.err_count,
+                "abs_err_mean": self.abs_err_mean(),
+                "rel_err_mean": self.rel_err_mean(),
+                "last_abs_err": self.last_err,
+            },
+        }
+
+
+class ForecastTarget:
+    """One forecasted signal: where to read it and how to interpret it.
+
+    ``mode="gauge"`` feeds the latest sample (at ITS timestamp — the
+    backtest is honest about when the value was observed);
+    ``mode="rate"`` feeds the counter's windowed per-second rate at the
+    tick timestamp. ``scale`` converts stored units to published units
+    (seconds → ms for queue wait).
+    """
+
+    __slots__ = ("signal", "series", "labels", "mode", "scale")
+
+    def __init__(self, signal: str, series: str, *,
+                 labels: Optional[Dict[str, str]] = None,
+                 mode: str = "gauge", scale: float = 1.0):
+        if mode not in ("gauge", "rate"):
+            raise ValueError(f"mode must be gauge|rate, got {mode!r}")
+        self.signal = signal
+        self.series = series
+        self.labels = dict(labels) if labels else None
+        self.mode = mode
+        self.scale = float(scale)
+
+
+def default_targets() -> List[ForecastTarget]:
+    """The two signals the ISSUE names: queue wait (ms) and request
+    rate (rps)."""
+    return [
+        ForecastTarget("queue_wait_ms", QUEUE_WAIT_SERIES,
+                       mode="gauge", scale=1000.0),
+        ForecastTarget("rps", "sparkml_serve_requests_total",
+                       mode="rate", scale=1.0),
+    ]
+
+
+class Forecaster:
+    """Per-sweep Holt forecasting over TSDB series.
+
+    ``tick(now)`` is the one entry point (hooked to the sampler via
+    ``install()``); each tick reads every target, feeds its Holt state,
+    and republishes the projection + backtest gauges. Outcomes per
+    (signal, tick) are counted in
+    ``sparkml_forecast_ticks_total{signal,outcome}``:
+
+    * ``fed`` — a fresh sample advanced the state;
+    * ``stale`` — the series has no sample newer than the last one fed;
+    * ``no_data`` — the series does not exist (yet) in the store;
+    * ``disabled`` — the kill switch is off (state untouched).
+    """
+
+    def __init__(
+        self,
+        store: Optional[tsdb_mod.TimeSeriesStore] = None,
+        registry: Optional[metrics_mod.MetricsRegistry] = None,
+        *,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        horizons: Optional[Tuple[float, ...]] = None,
+        window_seconds: Optional[float] = None,
+        targets: Optional[List[ForecastTarget]] = None,
+        clock: Callable[[], float] = time.time,
+        enabled_fn: Callable[[], bool] = enabled,
+    ):
+        self._store = store
+        self._registry = registry
+        self.alpha = float(alpha if alpha is not None
+                           else _env_float(ALPHA_ENV, _DEFAULT_ALPHA))
+        self.beta = float(beta if beta is not None
+                          else _env_float(BETA_ENV, _DEFAULT_BETA))
+        self.horizons = tuple(horizons) if horizons else horizons_from_env()
+        self.window_seconds = float(
+            window_seconds if window_seconds is not None
+            else _env_float(WINDOW_ENV, _DEFAULT_WINDOW_S))
+        self.targets = (list(targets) if targets is not None
+                        else default_targets())
+        self.clock = clock
+        self._enabled_fn = enabled_fn
+        self._lock = threading.Lock()
+        self._states: Dict[str, HoltState] = {
+            t.signal: HoltState(self.alpha, self.beta)
+            for t in self.targets
+        }
+        self._ticks = 0
+        reg = self._reg()
+        self._m_ticks = reg.counter(
+            "sparkml_forecast_ticks_total",
+            "forecaster feed outcomes per signal per tick",
+            ("signal", "outcome"),
+        )
+        self._m_abs_err = reg.gauge(
+            "sparkml_forecast_abs_err",
+            "mean one-step backtest |error| per forecast signal, in "
+            "the signal's published units", ("signal",),
+        )
+        self._g_projection: Dict[str, metrics_mod.Gauge] = {}
+        for target in self.targets:
+            self._g_projection[target.signal] = reg.gauge(
+                f"sparkml_forecast_{target.signal}",
+                f"Holt projection of {target.signal} at each horizon",
+                ("horizon",),
+            )
+
+    def _reg(self) -> metrics_mod.MetricsRegistry:
+        return (self._registry if self._registry is not None
+                else metrics_mod.get_registry())
+
+    def store(self) -> tsdb_mod.TimeSeriesStore:
+        return (self._store if self._store is not None
+                else tsdb_mod.get_tsdb())
+
+    @property
+    def ticks(self) -> int:
+        return self._ticks
+
+    def state(self, signal: str) -> Optional[HoltState]:
+        return self._states.get(signal)
+
+    # -- the sweep entry point ---------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, str]:
+        """One forecast pass; returns {signal: outcome}. A disabled
+        forecaster is inert: no reads, no state changes, no gauge
+        writes — only the ``disabled`` outcome counters move."""
+        ts = self.clock() if now is None else float(now)
+        outcomes: Dict[str, str] = {}
+        if not self._enabled_fn():
+            for target in self.targets:
+                outcomes[target.signal] = "disabled"
+                self._m_ticks.inc(signal=target.signal,
+                                  outcome="disabled")
+            return outcomes
+        store = self.store()
+        with self._lock:
+            self._ticks += 1
+            for target in self.targets:
+                outcome = self._feed_target(store, target, ts)
+                outcomes[target.signal] = outcome
+                self._m_ticks.inc(signal=target.signal, outcome=outcome)
+        return outcomes
+
+    def _feed_target(self, store: tsdb_mod.TimeSeriesStore,
+                     target: ForecastTarget, now: float) -> str:
+        """Read one target out of the store and advance its Holt state.
+        Caller holds the lock and counts the returned outcome."""
+        series = store.range_query(
+            target.series, target.labels, self.window_seconds, now=now)
+        if not series:
+            return "no_data"
+        state = self._states[target.signal]
+        if target.mode == "rate":
+            value = store.rate(target.series, target.labels,
+                               window=self.window_seconds, now=now)
+            sample_ts = now
+        else:
+            # latest sample across children, summed at the max timestamp
+            sample_ts = None
+            value = 0.0
+            for child in series:
+                if not child["points"]:
+                    continue
+                pt_ts, pt_v = child["points"][-1]
+                value += pt_v
+                sample_ts = pt_ts if sample_ts is None else max(
+                    sample_ts, pt_ts)
+            if sample_ts is None:
+                return "no_data"
+        if state.last_ts is not None and sample_ts <= state.last_ts:
+            return "stale"
+        state.update(sample_ts, value * target.scale)
+        self._publish_target(target, state)
+        return "fed"
+
+    def _publish_target(self, target: ForecastTarget,
+                        state: HoltState) -> None:
+        gauge = self._g_projection[target.signal]
+        for horizon in self.horizons:
+            projection = state.project(horizon)
+            if projection is not None:
+                gauge.set(projection, horizon=horizon_label(horizon))
+        err = state.abs_err_mean()
+        if err is not None:
+            self._m_abs_err.set(err, signal=target.signal)
+
+    # -- sampler hook -------------------------------------------------------
+
+    def install(self, sampler: tsdb_mod.MetricsSampler) -> None:
+        """Forecast after every sampler sweep, on the sampler thread —
+        idempotent (bound methods of one forecaster compare equal)."""
+        sampler.register_post_sweep(self._post_sweep)
+
+    def uninstall(self, sampler: tsdb_mod.MetricsSampler) -> None:
+        sampler.unregister_post_sweep(self._post_sweep)
+
+    def _post_sweep(self, ts: float) -> None:
+        try:
+            self.tick(now=ts)
+        except Exception:
+            _log.warning("forecast tick failed", exc_info=True)
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/debug/fleet`` forecast panel."""
+        with self._lock:
+            signals: Dict[str, Any] = {}
+            for target in self.targets:
+                state = self._states[target.signal]
+                doc = state.as_dict()
+                doc["series"] = target.series
+                doc["mode"] = target.mode
+                doc["projections"] = {
+                    horizon_label(h): state.project(h)
+                    for h in self.horizons
+                }
+                signals[target.signal] = doc
+            return {
+                "enabled": self._enabled_fn(),
+                "alpha": self.alpha,
+                "beta": self.beta,
+                "horizons_s": list(self.horizons),
+                "window_seconds": self.window_seconds,
+                "ticks": self._ticks,
+                "signals": signals,
+            }
+
+
+class PredictiveAutoscaler:
+    """The autoscaler's forecast consult: shadow first, actuate by flag.
+
+    Wired via ``controller.attach_predictive(pred.tick)`` — the reactive
+    ``evaluate_once`` consults it only on HOLD decisions, so the
+    predictive path can never fight an in-flight reactive action. A
+    would-scale tick in shadow mode counts
+    ``sparkml_serve_autoscale_total{decision="predictive_shadow"}``; with
+    ``SPARK_RAPIDS_ML_TPU_AUTOSCALE_PREDICTIVE=1`` it calls
+    ``controller.predictive_scale_up`` (which re-checks cooldown and
+    max_replicas under the controller's own lock).
+    """
+
+    MIN_UPDATES = 3  # an unseeded trend must not move replicas
+
+    def __init__(
+        self,
+        controller,
+        forecaster: Forecaster,
+        *,
+        signal: str = "queue_wait_ms",
+        horizon_s: Optional[float] = None,
+        threshold_ms: Optional[float] = None,
+        registry: Optional[metrics_mod.MetricsRegistry] = None,
+        actuate_fn: Callable[[], bool] = predictive_actuation_enabled,
+    ):
+        self.controller = controller
+        self.forecaster = forecaster
+        self.signal = signal
+        self.horizon_s = float(
+            horizon_s if horizon_s is not None
+            else _env_float(PREDICTIVE_HORIZON_ENV,
+                            _DEFAULT_PREDICTIVE_HORIZON_S))
+        # breach the SAME bar the reactive path scales on
+        self.threshold_ms = float(
+            threshold_ms if threshold_ms is not None
+            else controller.up_queue_wait_s * 1000.0)
+        self._actuate_fn = actuate_fn
+        reg = (registry if registry is not None
+               else metrics_mod.get_registry())
+        self._m_decisions = reg.counter(
+            "sparkml_serve_autoscale_total",
+            "autoscaler decisions, by kind", ("decision",),
+        )
+        self._m_decisions.inc(0, decision="predictive_shadow")
+        self._m_ticks = reg.counter(
+            "sparkml_forecast_predictive_total",
+            "predictive-autoscale consult outcomes", ("outcome",),
+        )
+        self._last_outcome = "never"
+        self._last_projection: Optional[float] = None
+
+    def tick(self) -> str:
+        """One consult (called from ``evaluate_once`` on HOLD). Returns
+        and counts the outcome: ``cold`` (trend unseeded), ``below``
+        (projection under threshold), ``at_max``, ``shadow``, or
+        ``actuated``."""
+        t0 = time.perf_counter()
+        state = self.forecaster.state(self.signal)
+        if state is None or state.updates < self.MIN_UPDATES:
+            return self._count("cold", None)
+        projection = state.project(self.horizon_s)
+        if projection is None:
+            return self._count("cold", None)
+        if projection < self.threshold_ms:
+            return self._count("below", projection)
+        if self.controller.replicas() >= self.controller.max_replicas:
+            return self._count("at_max", projection)
+        if not self._actuate_fn():
+            # shadow: the action we WOULD have taken, visible in the
+            # same decision family the real actions count in
+            self._m_decisions.inc(decision="predictive_shadow")
+            spans_mod.record_event(
+                "serve:autoscale:predictive_shadow", t0,
+                time.perf_counter(),
+                signal=self.signal, projection=projection,
+                threshold_ms=self.threshold_ms,
+                horizon_s=self.horizon_s,
+            )
+            return self._count("shadow", projection)
+        acted = self.controller.predictive_scale_up({
+            "signal": self.signal,
+            "projection": projection,
+            "threshold_ms": self.threshold_ms,
+            "horizon_s": self.horizon_s,
+        })
+        return self._count("actuated" if acted else "held", projection)
+
+    def _count(self, outcome: str, projection: Optional[float]) -> str:
+        self._last_outcome = outcome
+        self._last_projection = projection
+        self._m_ticks.inc(outcome=outcome)
+        return outcome
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "signal": self.signal,
+            "horizon_s": self.horizon_s,
+            "threshold_ms": self.threshold_ms,
+            "actuation_enabled": self._actuate_fn(),
+            "last_outcome": self._last_outcome,
+            "last_projection": self._last_projection,
+        }
+
+
+# -- the process-wide forecaster ----------------------------------------------
+
+_singleton_lock = threading.Lock()
+_forecaster: Optional[Forecaster] = None
+
+
+def get_forecaster() -> Forecaster:
+    """The process-wide forecaster ``serve.server`` installs on the
+    sampler (get-or-create)."""
+    global _forecaster
+    with _singleton_lock:
+        if _forecaster is None:
+            _forecaster = Forecaster()
+        return _forecaster
+
+
+def reset_forecaster() -> None:
+    """Drop the process-wide forecaster (tests). Unhooks it from the
+    current sampler."""
+    global _forecaster
+    with _singleton_lock:
+        forecaster = _forecaster
+        _forecaster = None
+    if forecaster is not None:
+        try:
+            forecaster.uninstall(tsdb_mod.get_sampler())
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+
+
+__all__ = [
+    "ALPHA_ENV",
+    "BETA_ENV",
+    "ENABLED_ENV",
+    "ForecastTarget",
+    "Forecaster",
+    "HORIZONS_ENV",
+    "HoltState",
+    "PREDICTIVE_ENV",
+    "PREDICTIVE_HORIZON_ENV",
+    "PredictiveAutoscaler",
+    "QUEUE_WAIT_SERIES",
+    "WINDOW_ENV",
+    "default_targets",
+    "enabled",
+    "get_forecaster",
+    "horizon_label",
+    "predictive_actuation_enabled",
+    "reset_forecaster",
+]

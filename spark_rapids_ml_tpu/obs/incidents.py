@@ -674,6 +674,23 @@ class IncidentEngine:
         doc["detectors"] = [d.describe() for d in self.detectors]
         return doc
 
+    def digest(self, recent_limit: int = 8) -> Dict[str, Any]:
+        """Compact open/recent digests for the fleet export
+        (``obs.federation``): lifecycle fields only, no evidence
+        bundles — an export is a poll payload, not an archive."""
+        fields = ("id", "detector", "kind", "severity", "metric",
+                  "labels", "state", "opened_ts", "resolved_ts",
+                  "value", "reason")
+        snap = self.manager.snapshot()
+        return {
+            "open": [{k: inc.get(k) for k in fields}
+                     for inc in snap["open"]],
+            "recent": [{k: inc.get(k) for k in fields}
+                       for inc in snap["recent"][:max(recent_limit, 0)]],
+            "opened_total": snap["opened_total"],
+            "resolved_total": snap["resolved_total"],
+        }
+
 
 # -- the process-wide engine --------------------------------------------------
 

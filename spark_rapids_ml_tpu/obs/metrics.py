@@ -316,6 +316,16 @@ class Summary(_Metric):
         serialize it, embed it in a bench record)."""
         return self._child(labels).sketch
 
+    def sketch_states(self) -> List[Tuple[Dict[str, str], Dict[str, object]]]:
+        """Every child's serialized sketch state as
+        ``[(labels, state), ...]`` — the fleet-export transport
+        (``obs.federation``): states merge losslessly across hosts
+        where already-computed percentiles could only be averaged."""
+        return [
+            (self._label_dict(key), child.sketch.to_dict())
+            for key, child in self._samples()
+        ]
+
     def snapshot_child(self, **labels) -> Dict[str, object]:
         sketch = self._child(labels).sketch
         return {

@@ -72,6 +72,8 @@ DEFAULT_PREFIXES: Tuple[str, ...] = (
     "sparkml_obs_",
     "sparkml_log_",
     "sparkml_fit_",
+    "sparkml_fleet_",
+    "sparkml_forecast_",
 )
 # Families matched by a prefix above that do NOT earn a history ring:
 # high-cardinality operational counters (per-model × outcome/op/event

@@ -82,6 +82,9 @@ ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_SERVE_AUTOSCALE_"
 SCALE_UP = "scale_up"
 SCALE_DOWN = "scale_down"
 HOLD = "hold"
+# the forecast consult's shadow decision (obs/forecast.py): counted in
+# the same family, never actuated without the predictive flag
+PREDICTIVE_SHADOW = "predictive_shadow"
 
 _log = get_logger("serve.autoscale")
 
@@ -201,6 +204,10 @@ class AutoscaleController:
         )
         self._m_decisions.inc(0, decision=SCALE_UP)
         self._m_decisions.inc(0, decision=SCALE_DOWN)
+        self._m_decisions.inc(0, decision=PREDICTIVE_SHADOW)
+        # the forecast consult (obs.forecast.PredictiveAutoscaler.tick),
+        # attached after construction; evaluated only on HOLD ticks
+        self._predictive: Optional[Callable[[], str]] = None
         # clamp the engine into bounds so the loop starts from a sane
         # actuator state (an engine at 8 replicas under a max of 4 would
         # otherwise take max/step ticks just to reach its own ceiling)
@@ -224,6 +231,38 @@ class AutoscaleController:
             self._m_model_replicas.set(value, model=self.model)
         else:
             self._m_replicas.set(value)
+
+    def replicas(self) -> int:
+        """The current replica count this controller owns (public for
+        the predictive consult)."""
+        return self._scale()
+
+    # -- the predictive input ----------------------------------------------
+
+    def attach_predictive(self, consult: Callable[[], str]) -> None:
+        """Install the forecast consult
+        (``obs.forecast.PredictiveAutoscaler.tick``). It runs only on
+        HOLD ticks — the predictive path can never fight an in-flight
+        reactive action."""
+        self._predictive = consult
+
+    def predictive_scale_up(self, signals: Dict[str, Any]) -> bool:
+        """Actuate one forecast-driven scale-up (the consult calls this
+        only under ``SPARK_RAPIDS_ML_TPU_AUTOSCALE_PREDICTIVE=1``).
+        Re-checks the ceiling and the anti-flap cooldown under the
+        controller's own lock; the action lands in the same counter,
+        audit event, and history as a reactive one. Returns whether a
+        resize happened."""
+        now = self._clock()
+        scale = self._scale()
+        with self._lock:
+            ready = (self._cooldown_over(now)
+                     and scale < self.max_replicas)
+        if not ready:
+            return False
+        self._apply(min(scale + self.step, self.max_replicas),
+                    SCALE_UP, {**signals, "reasons": "predictive"})
+        return True
 
     # -- signals -----------------------------------------------------------
 
@@ -337,6 +376,12 @@ class AutoscaleController:
             with self._lock:
                 self._hot_since = None
                 self._cold_since = None
+        if decision == HOLD and self._predictive is not None:
+            try:
+                self._predictive()
+            except Exception:  # noqa: BLE001 - loop must survive
+                self._m_errors.inc(model=self._err_label,
+                                   error="predictive")
         # the reaper rides the control cadence: retired replicas whose
         # queues drained are closed here, never on the request path
         self.engine.reap_retired()
@@ -477,6 +522,7 @@ class AutoscaleController:
             },
             "last_action_at": last_action,
             "history": history,
+            "predictive_attached": self._predictive is not None,
         }
 
 
@@ -484,6 +530,7 @@ __all__ = [
     "AutoscaleController",
     "ENV_PREFIX",
     "HOLD",
+    "PREDICTIVE_SHADOW",
     "SCALE_DOWN",
     "SCALE_UP",
 ]

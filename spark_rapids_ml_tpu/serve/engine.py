@@ -2191,6 +2191,24 @@ class ServeEngine:
         doc["replica_states"] = self.replica_snapshot()
         return doc
 
+    def fleet_state(self) -> Dict[str, Any]:
+        """The compact per-process state bundle a fleet export carries
+        (``obs.federation``): enough for the aggregator's per-host
+        rollup row, nothing a poll payload can't afford."""
+        replica_sets = self.replica_snapshot()
+        return {
+            "closed": self._closed,
+            "replicas": sum(doc["total"]
+                            for doc in replica_sets.values()),
+            "replicas_healthy": sum(doc["healthy"]
+                                    for doc in replica_sets.values()),
+            "models": len(replica_sets),
+            "queue_depth": self.queue_depth(),
+            "autoscale": self.autoscale_snapshot(),
+            "tiering_enabled": getattr(self, "_tiering", None)
+            is not None,
+        }
+
     # -- lifecycle / introspection ----------------------------------------
 
     def queue_depth(self, model_ref: Optional[str] = None) -> int:

@@ -103,7 +103,9 @@ import numpy as np
 
 from spark_rapids_ml_tpu.obs import get_registry, tracectx
 from spark_rapids_ml_tpu.obs import accounting as accounting_mod
+from spark_rapids_ml_tpu.obs import federation as federation_mod
 from spark_rapids_ml_tpu.obs import fitmon as fitmon_mod
+from spark_rapids_ml_tpu.obs import forecast as forecast_mod
 from spark_rapids_ml_tpu.obs import incidents as incidents_mod
 from spark_rapids_ml_tpu.obs import profiler as profiler_mod
 from spark_rapids_ml_tpu.obs import spans as spans_mod
@@ -149,15 +151,22 @@ def history_document(params) -> dict:
     """The ``GET /debug/history`` body for parsed query params.
 
     ``?name=<metric>`` → every matching child series (``model=`` narrows
-    by label, ``rate=1`` adds reset-aware rate/delta for counters);
-    without ``name`` → the default bundle of key series the dashboard
+    by label, ``host=`` narrows federated fleet series to one peer,
+    ``rate=1`` adds reset-aware rate/delta for counters); without
+    ``name`` → the default bundle of key series the dashboard
     sparklines plot, plus sampler health."""
     store = tsdb_mod.get_tsdb()
     window = _query_float(params, "window", _DEFAULT_HISTORY_WINDOW,
                           1.0, _MAX_HISTORY_WINDOW)
     name = (params.get("name", [None])[0] or "").strip()
     model = (params.get("model", [None])[0] or "").strip()
-    labels = {"model": model} if model else None
+    host = (params.get("host", [None])[0] or "").strip()
+    labels = {}
+    if model:
+        labels["model"] = model
+    if host:
+        labels["host"] = host
+    labels = labels or None
     if name:
         doc = {
             "name": name,
@@ -213,6 +222,14 @@ def history_document(params) -> dict:
                 "sparkml_serve_canary_arm_p99_seconds", None, window),
             "canary_arm_error_rate": store.range_query(
                 "sparkml_serve_canary_arm_error_rate", None, window),
+            # fleet federation + forecast (obs.federation/forecast):
+            # per-host liveness and the predictive signal sparklines
+            "fleet_host_up": store.range_query(
+                "sparkml_fleet_host_up", None, window),
+            "forecast_queue_wait_ms": store.range_query(
+                "sparkml_forecast_queue_wait_ms", None, window),
+            "forecast_rps": store.range_query(
+                "sparkml_forecast_rps", None, window),
         },
     }
 
@@ -415,21 +432,38 @@ def make_handler(engine: ServeEngine):
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
             elif path == "/debug/traces":
-                try:
-                    limit = int(urllib.parse.parse_qs(parsed.query).get(
-                        "limit", [_DEFAULT_TRACE_LIMIT])[0])
-                except (TypeError, ValueError):
-                    limit = _DEFAULT_TRACE_LIMIT
-                summaries = spans_mod.recent_traces(
-                    max(1, min(limit, 200)),
-                    name_prefix=_TRACE_ROOT_PREFIXES,
-                )
-                status = self._reply(200, {
-                    "traces": [
-                        spans_mod.assemble_trace(s["trace_id"])
-                        for s in summaries
-                    ],
-                })
+                params = urllib.parse.parse_qs(parsed.query)
+                trace_id = (params.get("trace_id", [None])[0]
+                            or "").strip()
+                if trace_id:
+                    # single-trace lookup: the resolver for the
+                    # trace-id exemplars /metrics and the quantile
+                    # snapshots already emit
+                    tree = spans_mod.assemble_trace(trace_id)
+                    if tree.get("span_count"):
+                        status = self._reply(200, tree)
+                    else:
+                        status = self._reply(404, {
+                            "error": "unknown trace_id (not in the "
+                                     "span ring, or already evicted)",
+                            "trace_id": trace_id,
+                        })
+                else:
+                    try:
+                        limit = int(params.get(
+                            "limit", [_DEFAULT_TRACE_LIMIT])[0])
+                    except (TypeError, ValueError):
+                        limit = _DEFAULT_TRACE_LIMIT
+                    summaries = spans_mod.recent_traces(
+                        max(1, min(limit, 200)),
+                        name_prefix=_TRACE_ROOT_PREFIXES,
+                    )
+                    status = self._reply(200, {
+                        "traces": [
+                            spans_mod.assemble_trace(s["trace_id"])
+                            for s in summaries
+                        ],
+                    })
             elif path == "/debug/slo":
                 snap = engine.slo_snapshot()
                 snap["queue_depth"] = engine.queue_depth()
@@ -470,6 +504,25 @@ def make_handler(engine: ServeEngine):
                 status = self._reply(200, engine.costs_snapshot())
             elif path == "/debug/fit":
                 status = self._reply(200, fitmon_mod.debug_fit_doc())
+            elif path == "/debug/fleet/export":
+                params = urllib.parse.parse_qs(parsed.query)
+                cursor = _query_float(params, "cursor", 0.0,
+                                      0.0, float("inf"))
+                status = self._reply(200, federation_mod.fleet_export(
+                    cursor, engine=engine))
+            elif path == "/debug/fleet":
+                aggregator = federation_mod.get_aggregator()
+                doc = {
+                    "host": federation_mod.host_identity(),
+                    "aggregating": aggregator is not None,
+                    "rollup": (aggregator.rollup()
+                               if aggregator is not None else None),
+                }
+                if (aggregator is None
+                        or aggregator.forecaster is None):
+                    doc["forecast"] = (
+                        forecast_mod.get_forecaster().snapshot())
+                status = self._reply(200, doc)
             elif path == "/dashboard":
                 status = self._reply_text(
                     200, DASHBOARD_HTML, "text/html; charset=utf-8")
@@ -782,6 +835,34 @@ def start_serve_server(
     sampler.register_collector(accounting_mod.get_ledger().publish)
     if incidents_mod.enabled():
         incidents_mod.get_incident_engine().install(sampler)
+    # republish the engine's live queue-wait estimate as a gauge every
+    # sweep: the forecaster's input series (obs.forecast) and the
+    # /debug/history queue-wait sparkline — the overload signal itself
+    # is computed on demand and would otherwise never earn history
+    g_queue_wait = get_registry().gauge(
+        forecast_mod.QUEUE_WAIT_SERIES,
+        "the live queue-wait EWMA (the autoscale/shed signal), "
+        "republished every sampler sweep for history + forecasting",
+    )
+
+    m_collector_errors = get_registry().counter(
+        "sparkml_serve_collector_errors_total",
+        "sampler collector callbacks that raised (and were swallowed "
+        "so the sweep survives)",
+        ("collector",),
+    )
+
+    def _publish_queue_wait():
+        try:
+            g_queue_wait.set(float(
+                engine._overload_signals().get("queue_wait_s", 0.0)))
+        except Exception:  # noqa: BLE001 - a collector must not kill sweeps
+            m_collector_errors.inc(collector="queue_wait")
+
+    sampler.register_collector(_publish_queue_wait)
+    # the short-horizon forecaster rides the same sweep (kill switch
+    # SPARK_RAPIDS_ML_TPU_FORECAST=0 leaves it installed but inert)
+    forecast_mod.get_forecaster().install(sampler)
     server = _Server((addr, port), make_handler(engine))
     thread = tracectx.traced_thread(
         server.serve_forever, name="sparkml-serve-http", daemon=True,
@@ -900,6 +981,8 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <table><thead><tr><th>Objective</th><th>Target</th><th>5m</th><th>30m</th>
     <th>1h</th><th>6h</th><th>Budget left</th><th>State</th></tr></thead>
     <tbody id="slo-rows"></tbody></table>
+  <h2>Fleet</h2>
+  <div id="fleet" class="quiet">—</div>
   <h2>Serving replicas</h2>
   <div id="replicas" class="quiet">—</div>
   <h2>Fit runs</h2>
@@ -981,7 +1064,8 @@ function sparkSvg(points) {
 }
 function seriesLabel(prefix, labels) {
   var parts = [];
-  ["model", "device", "component", "arm", "outcome"].forEach(
+  ["model", "device", "component", "arm", "outcome", "host",
+   "horizon"].forEach(
     function (k) {
       if (labels && labels[k]) parts.push(labels[k]);
     });
@@ -1052,6 +1136,20 @@ function historyTiles(hist) {
   (key.canary_arm_error_rate || []).forEach(function (s) {
     tiles.push(trendTile("canary err", s, function (v) {
       return v == null ? "\\u2013" : (100 * v).toFixed(2) + "%";
+    }));
+  });
+  // fleet liveness + the forecaster's predictive signals
+  (key.fleet_host_up || []).forEach(function (s) {
+    tiles.push(trendTile("host up", s));
+  });
+  (key.forecast_queue_wait_ms || []).forEach(function (s) {
+    tiles.push(trendTile("fc queue wait", s, function (v) {
+      return v == null ? "\\u2013" : fmtVal(v) + " ms";
+    }));
+  });
+  (key.forecast_rps || []).forEach(function (s) {
+    tiles.push(trendTile("fc req/s", s, function (v) {
+      return v == null ? "\\u2013" : fmtVal(v) + "/s";
     }));
   });
   return tiles;
@@ -1301,6 +1399,58 @@ async function refresh() {
             "</td></tr>";
         }).join("") + "</tbody></table>"
       : "no alerts firing";
+    var fleet = {};
+    try { fleet = await (await fetch("/debug/fleet")).json(); }
+    catch (err) { fleet = {}; }
+    var rollup = fleet.rollup || null;
+    var fc = (rollup && rollup.forecast) || fleet.forecast || null;
+    var fleetTiles = [];
+    if (rollup) {
+      fleetTiles.push(tile("Hosts up",
+        statusSpan(rollup.hosts_up === rollup.hosts_total
+                     ? "good" : "critical",
+                   "\\u25cf " + rollup.hosts_up + " / " +
+                     rollup.hosts_total)));
+      (rollup.hosts || []).forEach(function (h) {
+        fleetTiles.push(tile(h.host,
+          statusSpan(h.up ? "good" : "critical",
+                     "\\u25cf " + (h.up ? "up" : "down")) +
+          '<div class="label" style="margin-top:4px">' +
+          (h.staleness_seconds == null ? "never polled"
+            : "stale " + h.staleness_seconds.toFixed(1) + " s") +
+          (h.replicas != null ? " \\u00b7 " + h.replicas + " repl"
+                              : "") +
+          (h.open_incidents ? " \\u00b7 " + h.open_incidents + " inc"
+                            : "") + "</div>"));
+      });
+      var finc = rollup.fleet_incidents || [];
+      fleetTiles.push(tile("Fleet incidents", finc.length
+        ? statusSpan("critical", "\\u25cf " + finc.length)
+        : statusSpan("good", "\\u25cf 0")));
+      if (rollup.slo_burn && rollup.slo_burn.max != null) {
+        fleetTiles.push(tile("Fleet burn (5m max)",
+                             fmtBurn(rollup.slo_burn.max)));
+      }
+    }
+    if (fc && fc.signals) {
+      Object.keys(fc.signals).forEach(function (sig) {
+        var doc = fc.signals[sig] || {};
+        var projections = doc.projections || {};
+        var parts = Object.keys(projections).map(function (h) {
+          return h + ": " + fmtVal(projections[h]);
+        });
+        var backtest = (doc.backtest || {});
+        fleetTiles.push(tile("forecast \\u00b7 " + sig,
+          (parts.join(" \\u00b7 ") || "\\u2013") +
+          '<div class="label" style="margin-top:4px">backtest ' +
+          (backtest.abs_err_mean == null ? "\\u2013"
+            : "|err| " + fmtVal(backtest.abs_err_mean)) + "</div>"));
+      });
+    }
+    document.getElementById("fleet").innerHTML = fleetTiles.length
+      ? '<div class="tiles">' + fleetTiles.join("") + "</div>"
+      : "not aggregating \\u2014 attach a FleetAggregator " +
+        "(obs.federation) to federate peers into this process";
     var tr = await (await fetch("/debug/traces?limit=10")).json();
     var traces = tr.traces || [];
     document.getElementById("traces").innerHTML = traces.length

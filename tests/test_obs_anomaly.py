@@ -299,7 +299,7 @@ def test_builtin_catalog_names_and_env_window(monkeypatch):
         "serve_p99_spike", "serve_queue_depth", "serve_error_rate",
         "device_mem_in_use", "breaker_flap", "slo_fast_burn",
         "serve_replica_degraded", "serve_canary_regressed",
-        "fit_backend_degraded",
+        "fit_backend_degraded", "fleet_host_down",
     }
     from spark_rapids_ml_tpu.obs import anomaly
 

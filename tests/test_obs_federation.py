@@ -1,0 +1,376 @@
+"""obs.federation: export/merge semantics, peer health, incident
+dedup, and the rule-18 checker fixtures.
+
+Every aggregator case runs with injected clocks and a fake ``fetch_fn``
+that routes to in-memory peers (real ``fleet_export`` documents, zero
+sockets, zero sleeps). The properties under test are the ones the fleet
+view's trustworthiness rests on: sketch merges equal pooled
+observations (never averaged percentiles), re-polling a cursor is
+idempotent, staleness ages honestly, and the same anomaly on N hosts is
+ONE fleet incident.
+"""
+
+import os
+import sys
+
+import pytest
+
+from spark_rapids_ml_tpu.obs import federation as federation_mod
+from spark_rapids_ml_tpu.obs.anomaly import builtin_detectors
+from spark_rapids_ml_tpu.obs.federation import (
+    FleetAggregator,
+    fleet_export,
+)
+from spark_rapids_ml_tpu.obs.metrics import MetricsRegistry
+from spark_rapids_ml_tpu.obs.quantiles import QuantileSketch
+from spark_rapids_ml_tpu.obs.tsdb import TimeSeriesStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class FakePeer:
+    """An in-memory serving process: its own store + registry, answering
+    real ``fleet_export`` documents through the aggregator's injected
+    ``fetch_fn``."""
+
+    def __init__(self, host, clock):
+        self.host = host
+        self.clock = clock
+        self.store = TimeSeriesStore(tiers=((1.0, 300.0),), clock=clock)
+        self.registry = MetricsRegistry()
+        self.incident_docs = {"open": [], "recent": []}
+        self.down = False
+        self.ignore_cursor = False
+
+    def fetch(self, url, timeout):
+        if self.down:
+            raise OSError("connection refused")
+        cursor = float(url.split("cursor=")[-1])
+        if self.ignore_cursor:
+            cursor = 0.0
+        doc = fleet_export(cursor, store=self.store,
+                           registry=self.registry, now=self.clock())
+        doc["host"] = self.host  # one process runs every fake peer
+        doc["incidents"] = self.incident_docs
+        return doc
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def peers(clock):
+    return {
+        "http://a": FakePeer("hostA", clock),
+        "http://b": FakePeer("hostB", clock),
+    }
+
+
+@pytest.fixture
+def registry():
+    return MetricsRegistry()
+
+
+@pytest.fixture
+def agg(peers, clock, registry):
+    def fetch(url, timeout):
+        return peers[url.split("/debug/")[0]].fetch(url, timeout)
+
+    return FleetAggregator(
+        [("hostA", "http://a"), ("hostB", "http://b")],
+        store=TimeSeriesStore(tiers=((1.0, 300.0),), clock=clock),
+        registry=registry,
+        poll_interval_s=1.0, stale_after_s=2.0, fetch_timeout_s=1.0,
+        fetch_fn=fetch, clock=clock)
+
+
+def _sample_value(registry, name, **labels):
+    snap = registry.snapshot().get(name, {"samples": []})
+    for sample in snap["samples"]:
+        if sample["labels"] == labels:
+            return sample["value"]
+    return None
+
+
+def _merged_hosts(agg, name):
+    return sorted(
+        row["labels"].get("host")
+        for row in agg.store().range_query(name, window=300.0)
+        if row["labels"].get("host"))
+
+
+# -- export ------------------------------------------------------------------
+
+
+def test_export_cursor_returns_only_newer_points(clock):
+    store = TimeSeriesStore(tiers=((1.0, 300.0),), clock=clock)
+    registry = MetricsRegistry()
+    for i in range(5):
+        store.record("sparkml_serve_queue_depth", None, float(i),
+                     now=996.0 + i)
+    doc = fleet_export(0.0, store=store, registry=registry, now=clock())
+    (series,) = [s for s in doc["series"]
+                 if s["name"] == "sparkml_serve_queue_depth"]
+    assert len(series["points"]) == 5
+    assert doc["cursor"] == clock()
+    # re-export from the returned cursor: nothing new
+    doc2 = fleet_export(doc["cursor"], store=store, registry=registry,
+                        now=clock())
+    assert [s for s in doc2["series"]
+            if s["name"] == "sparkml_serve_queue_depth"] == []
+    # a newer point crosses the cursor
+    store.record("sparkml_serve_queue_depth", None, 9.0,
+                 now=clock.advance(1.0))
+    doc3 = fleet_export(doc["cursor"], store=store, registry=registry,
+                        now=clock())
+    (series3,) = [s for s in doc3["series"]
+                  if s["name"] == "sparkml_serve_queue_depth"]
+    assert series3["points"] == [[1001.0, 9.0]]
+
+
+def test_export_excludes_fleet_series_and_host_labeled_children(clock):
+    store = TimeSeriesStore(tiers=((1.0, 300.0),), clock=clock)
+    registry = MetricsRegistry()
+    store.record("sparkml_fleet_host_up", {"host": "x"}, 1.0,
+                 now=clock())
+    store.record("sparkml_forecast_rps", {"horizon": "30s"}, 1.0,
+                 now=clock())
+    store.record("sparkml_serve_queue_depth", {"host": "other"}, 1.0,
+                 now=clock())
+    doc = fleet_export(0.0, store=store, registry=registry, now=clock())
+    assert doc["series"] == []  # federation stays one level deep
+
+
+# -- aggregator merge --------------------------------------------------------
+
+
+def test_merge_carries_both_host_labels(agg, peers, clock):
+    for peer in peers.values():
+        peer.store.record("sparkml_serve_queue_depth", None, 3.0,
+                          now=clock())
+    outcomes = agg.poll_once(now=clock())
+    assert outcomes == {"hostA": "ok", "hostB": "ok"}
+    assert _merged_hosts(agg, "sparkml_serve_queue_depth") == [
+        "hostA", "hostB"]
+    rollup = agg.rollup(now=clock())
+    assert rollup["hosts_up"] == 2
+    assert {row["host"]: row["merged_points"]
+            for row in rollup["hosts"]} == {"hostA": 1, "hostB": 1}
+
+
+def test_repoll_with_cursor_is_idempotent(agg, peers, clock, registry):
+    peers["http://a"].store.record(
+        "sparkml_serve_queue_depth", None, 3.0, now=clock())
+    agg.poll_once(now=clock())
+    merged_first = _sample_value(
+        registry, "sparkml_fleet_merged_points_total", host="hostA")
+    assert merged_first == 1.0
+    # nothing new on the peer: the advanced cursor ships zero points
+    clock.advance(1.0)
+    agg.poll_once(now=clock())
+    assert _sample_value(
+        registry, "sparkml_fleet_merged_points_total",
+        host="hostA") == merged_first
+
+
+def test_overlap_remerge_does_not_duplicate_points(agg, peers, clock):
+    peer = peers["http://a"]
+    peer.ignore_cursor = True  # a stale/reset cursor re-ships history
+    for i in range(4):
+        peer.store.record("sparkml_serve_queue_depth", None, float(i),
+                          now=997.0 + i)
+    agg.poll_once(now=clock())
+    first = agg.store().range_query(
+        "sparkml_serve_queue_depth",
+        {"host": "hostA"}, window=300.0, now=clock())[0]["points"]
+    clock.advance(1.0)
+    agg.poll_once(now=clock())  # same 4 points arrive again
+    again = agg.store().range_query(
+        "sparkml_serve_queue_depth",
+        {"host": "hostA"}, window=300.0, now=clock())[0]["points"]
+    assert again == first  # last-in-bucket: re-merge is a no-op
+
+
+def test_sketch_merge_equals_pooled_observations(agg, peers, clock):
+    for offset, peer in ((0.0, peers["http://a"]),
+                         (10.0, peers["http://b"])):
+        summary = peer.registry.summary(
+            "sparkml_serve_request_seconds", "request latency")
+        for i in range(1, 11):
+            summary.observe(offset + float(i))
+    agg.poll_once(now=clock())
+    rollup = agg.rollup(now=clock())
+    (merged,) = [s for s in rollup["merged_sketches"]
+                 if s["name"] == "sparkml_serve_request_seconds"]
+    assert merged["count"] == 20
+    assert merged["sum"] == pytest.approx(sum(range(1, 11)) * 2 + 100.0)
+    # the merged quantile equals a hand-pooled sketch's, exactly —
+    # sketch states merge; percentiles are never averaged
+    pooled = QuantileSketch()
+    pooled.add(float(v) for v in
+               list(range(1, 11)) + [10.0 + i for i in range(1, 11)])
+    assert merged["quantiles"]["p95"] == pytest.approx(
+        pooled.quantile(0.95))
+
+
+# -- peer health -------------------------------------------------------------
+
+
+def test_unreachable_within_grace_then_stale_beyond(agg, peers, clock,
+                                                    registry):
+    agg.poll_once(now=clock())  # both ok: last_ok = t0
+    peers["http://b"].down = True
+    clock.advance(1.0)  # 1 s silent < stale_after 2 s
+    assert agg.poll_once(now=clock())["hostB"] == "unreachable"
+    assert _sample_value(registry, federation_mod.HOST_UP_METRIC,
+                         host="hostB") == 1.0
+    clock.advance(2.0)  # 3 s silent > stale_after
+    assert agg.poll_once(now=clock())["hostB"] == "stale"
+    assert _sample_value(registry, federation_mod.HOST_UP_METRIC,
+                         host="hostB") == 0.0
+    assert _sample_value(
+        registry, "sparkml_fleet_host_staleness_seconds",
+        host="hostB") == pytest.approx(3.0)
+    # hostA kept answering: still up
+    assert _sample_value(registry, federation_mod.HOST_UP_METRIC,
+                         host="hostA") == 1.0
+    # recovery: one good poll restores up and resets staleness
+    peers["http://b"].down = False
+    clock.advance(1.0)
+    assert agg.poll_once(now=clock())["hostB"] == "ok"
+    assert _sample_value(registry, federation_mod.HOST_UP_METRIC,
+                         host="hostB") == 1.0
+
+
+def test_never_polled_peer_is_stale_with_sentinel_staleness(
+        agg, peers, clock, registry):
+    peers["http://a"].down = True
+    peers["http://b"].down = True
+    outcomes = agg.poll_once(now=clock())
+    assert outcomes == {"hostA": "stale", "hostB": "stale"}
+    assert _sample_value(
+        registry, "sparkml_fleet_host_staleness_seconds",
+        host="hostA") == -1.0  # never seen: age is unknowable
+
+
+def test_fleet_host_down_detector_registered():
+    detectors = {d.name: d for d in builtin_detectors()}
+    det = detectors[federation_mod.INCIDENT_NAME]
+    assert det.metric == federation_mod.HOST_UP_METRIC
+
+
+# -- fleet incident dedup ----------------------------------------------------
+
+
+def test_same_incident_on_two_hosts_dedups_to_one(agg, peers, clock,
+                                                  registry):
+    shared = {"detector": "serve_queue_overload", "kind": "anomaly",
+              "severity": "warning", "metric": "sparkml_serve_queue_depth",
+              "labels": {"model": "m"}, "state": "open",
+              "opened_ts": 999.0, "value": 50.0, "reason": "queue deep"}
+    only_b = dict(shared, detector="serve_error_rate",
+                  metric="sparkml_serve_errors_total")
+    peers["http://a"].incident_docs = {"open": [dict(shared, id="a1")],
+                                       "recent": []}
+    peers["http://b"].incident_docs = {
+        "open": [dict(shared, id="b1"), dict(only_b, id="b2")],
+        "recent": []}
+    agg.poll_once(now=clock())
+    fleet = agg.rollup(now=clock())["fleet_incidents"]
+    assert [(f["detector"], f["host_count"]) for f in fleet] == [
+        ("serve_queue_overload", 2), ("serve_error_rate", 1)]
+    grouped = fleet[0]
+    assert sorted(grouped["hosts"]) == ["hostA", "hostB"]
+    assert grouped["hosts"]["hostA"]["id"] == "a1"
+    assert grouped["hosts"]["hostB"]["id"] == "b1"
+    assert _sample_value(
+        registry, "sparkml_fleet_incident_dedup_total",
+        outcome="grouped") == 1.0
+    assert _sample_value(
+        registry, "sparkml_fleet_incident_dedup_total",
+        outcome="single") == 1.0
+
+
+# -- rule 18 fixtures --------------------------------------------------------
+
+
+def _checker():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_instrumentation as ci
+    finally:
+        sys.path.pop(0)
+    return ci
+
+
+def test_rule18_accepts_current_modules():
+    ci = _checker()
+    for path in ci.FEDERATION_FILES:
+        assert list(ci.check_federation_signals(path)) == []
+
+
+def test_rule8_clocked_set_includes_federation_and_forecast():
+    ci = _checker()
+    names = {os.path.basename(p) for p in ci.CLOCKED_OBS_FILES}
+    assert {"federation.py", "forecast.py"} <= names
+
+
+def test_rule18_rejects_unaccounted_paths(tmp_path):
+    ci = _checker()
+    bad = tmp_path / "bad_federation.py"
+    bad.write_text(
+        "class C:\n"
+        "    def poll_once(self):\n"
+        "        return 1  # REJECT: named decision path\n"
+        "    def merge_doc(self, doc):\n"
+        "        self.merged += 1  # REJECT: merge prefix\n"
+        "    def _dedup_hosts(self):\n"
+        "        return []  # REJECT: dedup prefix\n"
+        "    def shadow_consult(self):\n"
+        "        return 'shadow'  # REJECT: shadow prefix\n"
+        "    def consult(self):\n"
+        "        self.ctl.predictive_scale_up({})  # REJECT: mutation\n"
+        "    def helper(self):\n"
+        "        return 2  # fine: not a decision path\n"
+    )
+    offenders = list(ci.check_federation_signals(str(bad)))
+    assert len(offenders) == 5
+    assert all("rule 18" in why for _ln, why in offenders)
+
+
+def test_rule18_accepts_accounted_paths(tmp_path):
+    ci = _checker()
+    good = tmp_path / "good_federation.py"
+    good.write_text(
+        "class C:\n"
+        "    def poll_once(self):\n"
+        "        self._m_polls.inc(outcome='ok')\n"
+        "        return 1\n"
+        "    def merge_doc(self, doc):\n"
+        "        self._m_merged.inc(1, host='h')\n"
+        "        self.merged += 1\n"
+        "    def _dedup_hosts(self):\n"
+        "        self._count('grouped', None)\n"
+        "        return []\n"
+        "    def shadow_consult(self):\n"
+        "        record_event('serve:autoscale:predictive_shadow', 0, 1)\n"
+        "        return 'shadow'\n"
+        "    def consult(self):\n"
+        "        with span('serve:autoscale:predictive'):\n"
+        "            self.ctl.predictive_scale_up({})\n"
+    )
+    assert list(ci.check_federation_signals(str(good))) == []

@@ -1,0 +1,308 @@
+"""obs.forecast: Holt smoothing hand-math, the per-sweep Forecaster,
+and the predictive autoscale consult.
+
+Every Holt fixture is hand-computed from the update recurrence in the
+module docstring (alpha = beta = 0.5 makes the arithmetic exact in
+binary floats), and every Forecaster/PredictiveAutoscaler case runs on
+an injected clock + private store/registry — zero sleeps, zero wall
+clock, zero process singletons.
+"""
+
+import pytest
+
+from spark_rapids_ml_tpu.obs import forecast as forecast_mod
+from spark_rapids_ml_tpu.obs.forecast import (
+    ForecastTarget,
+    Forecaster,
+    HoltState,
+    PredictiveAutoscaler,
+    horizon_label,
+)
+from spark_rapids_ml_tpu.obs.metrics import MetricsRegistry
+from spark_rapids_ml_tpu.obs.tsdb import TimeSeriesStore
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(clock):
+    return TimeSeriesStore(tiers=((1.0, 300.0),), clock=clock)
+
+
+@pytest.fixture
+def registry():
+    return MetricsRegistry()
+
+
+def _sample_value(registry, name, **labels):
+    snap = registry.snapshot().get(name, {"samples": []})
+    for sample in snap["samples"]:
+        if sample["labels"] == labels:
+            return sample["value"]
+    return None
+
+
+# -- HoltState hand fixtures --------------------------------------------------
+
+
+def test_holt_hand_computed_two_steps():
+    # alpha = beta = 0.5 over (0, 0), (1, 10), (2, 20):
+    #   step 1: predicted=0, err=10, level=5,     trend=2.5
+    #   step 2: predicted=7.5, err=12.5, level=13.75, trend=5.625
+    st = HoltState(alpha=0.5, beta=0.5)
+    assert st.update(0.0, 0.0) is None  # seed sample: no residual
+    assert st.update(1.0, 10.0) == pytest.approx(10.0)
+    assert st.level == pytest.approx(5.0)
+    assert st.trend == pytest.approx(2.5)
+    assert st.update(2.0, 20.0) == pytest.approx(12.5)
+    assert st.level == pytest.approx(13.75)
+    assert st.trend == pytest.approx(5.625)
+    assert st.project(2.0) == pytest.approx(25.0)
+
+
+def test_holt_ramp_recovers_level_and_trend():
+    # an exact linear ramp is a fixed point: trend -> slope, err -> 0
+    st = HoltState(alpha=0.5, beta=0.5)
+    for i in range(60):
+        st.update(float(i), 2.0 * i)
+    assert st.trend == pytest.approx(2.0, abs=1e-6)
+    assert st.level == pytest.approx(2.0 * 59, abs=1e-4)
+    assert st.last_err == pytest.approx(0.0, abs=1e-6)
+    # projecting h seconds ahead lands on the ramp's future value
+    assert st.project(10.0) == pytest.approx(2.0 * 69, abs=1e-3)
+
+
+def test_holt_flat_series_keeps_zero_trend():
+    st = HoltState(alpha=0.4, beta=0.2)
+    for i in range(20):
+        st.update(float(i), 7.0)
+    assert st.trend == 0.0
+    assert st.level == pytest.approx(7.0)
+    assert st.abs_err_mean() == pytest.approx(0.0)
+    assert st.project(1e6) == pytest.approx(7.0)
+
+
+def test_holt_backtest_accounting():
+    st = HoltState(alpha=0.5, beta=0.5)
+    st.update(0.0, 0.0)
+    st.update(1.0, 10.0)
+    st.update(2.0, 20.0)
+    # residuals 10 and 12.5 over |values| 10 and 20
+    assert st.err_count == 2
+    assert st.abs_err_mean() == pytest.approx(11.25)
+    assert st.rel_err_mean() == pytest.approx(22.5 / 30.0)
+    assert st.as_dict()["backtest"]["last_abs_err"] == pytest.approx(12.5)
+
+
+def test_holt_non_advancing_timestamp_is_dropped():
+    st = HoltState(alpha=0.5, beta=0.5)
+    st.update(10.0, 1.0)
+    before = (st.level, st.trend, st.updates)
+    assert st.update(10.0, 99.0) is None  # dt == 0
+    assert st.update(9.0, 99.0) is None   # dt < 0
+    assert (st.level, st.trend, st.updates) == before
+
+
+def test_holt_rejects_degenerate_factors():
+    with pytest.raises(ValueError):
+        HoltState(alpha=0.0)
+    with pytest.raises(ValueError):
+        HoltState(alpha=0.5, beta=1.5)
+
+
+def test_horizon_label():
+    assert horizon_label(30.0) == "30s"
+    assert horizon_label(2.5) == "2.5s"
+
+
+# -- Forecaster over a store --------------------------------------------------
+
+
+def _forecaster(store, registry, clock, **kw):
+    kw.setdefault("targets", [
+        ForecastTarget("queue_wait_ms", forecast_mod.QUEUE_WAIT_SERIES,
+                       mode="gauge", scale=1000.0),
+    ])
+    kw.setdefault("alpha", 0.5)
+    kw.setdefault("beta", 0.5)
+    kw.setdefault("horizons", (30.0,))
+    kw.setdefault("window_seconds", 30.0)
+    return Forecaster(store, registry, clock=clock, **kw)
+
+
+def test_forecaster_feeds_and_publishes(store, registry, clock):
+    fc = _forecaster(store, registry, clock)
+    assert fc.tick() == {"queue_wait_ms": "no_data"}
+    store.record(forecast_mod.QUEUE_WAIT_SERIES, None, 0.010,
+                 now=clock.t)
+    assert fc.tick() == {"queue_wait_ms": "fed"}
+    # same sample again: nothing newer than the state's last_ts
+    assert fc.tick() == {"queue_wait_ms": "stale"}
+    clock.advance(1.0)
+    store.record(forecast_mod.QUEUE_WAIT_SERIES, None, 0.020,
+                 now=clock.t)
+    assert fc.tick() == {"queue_wait_ms": "fed"}
+    state = fc.state("queue_wait_ms")
+    # stored seconds arrive scaled to ms: samples 10.0 then 20.0
+    assert state.level == pytest.approx(0.5 * 20.0 + 0.5 * 10.0)
+    assert _sample_value(
+        registry, "sparkml_forecast_queue_wait_ms",
+        horizon="30s") is not None
+    assert _sample_value(
+        registry, "sparkml_forecast_abs_err",
+        signal="queue_wait_ms") == pytest.approx(10.0)
+    assert _sample_value(
+        registry, "sparkml_forecast_ticks_total",
+        signal="queue_wait_ms", outcome="fed") == 2.0
+
+
+def test_forecaster_rate_mode(store, registry, clock):
+    fc = _forecaster(
+        store, registry, clock,
+        targets=[ForecastTarget("rps", "sparkml_serve_requests_total",
+                                mode="rate")])
+    # a counter climbing 5/s for 10 s
+    for i in range(11):
+        store.record("sparkml_serve_requests_total", None, 5.0 * i,
+                     kind="counter", now=clock.t + i)
+    clock.advance(10.0)
+    assert fc.tick() == {"rps": "fed"}
+    assert fc.state("rps").level == pytest.approx(5.0, rel=0.2)
+
+
+def test_disabled_forecaster_is_inert(store, registry, clock):
+    fc = _forecaster(store, registry, clock, enabled_fn=lambda: False)
+    store.record(forecast_mod.QUEUE_WAIT_SERIES, None, 0.5, now=clock.t)
+    assert fc.tick() == {"queue_wait_ms": "disabled"}
+    assert fc.ticks == 0
+    assert fc.state("queue_wait_ms").updates == 0
+    assert _sample_value(
+        registry, "sparkml_forecast_ticks_total",
+        signal="queue_wait_ms", outcome="disabled") == 1.0
+    # no projection gauge was written
+    assert _sample_value(
+        registry, "sparkml_forecast_queue_wait_ms", horizon="30s") is None
+
+
+def test_forecaster_snapshot_shape(store, registry, clock):
+    fc = _forecaster(store, registry, clock)
+    store.record(forecast_mod.QUEUE_WAIT_SERIES, None, 0.010,
+                 now=clock.t)
+    fc.tick()
+    snap = fc.snapshot()
+    doc = snap["signals"]["queue_wait_ms"]
+    assert doc["series"] == forecast_mod.QUEUE_WAIT_SERIES
+    assert doc["projections"]["30s"] == pytest.approx(10.0)
+    assert snap["ticks"] == 1
+
+
+# -- PredictiveAutoscaler -----------------------------------------------------
+
+
+class FakeController:
+    up_queue_wait_s = 0.080  # threshold_ms derives to 80
+    max_replicas = 4
+
+    def __init__(self, replicas=1, accept=True):
+        self._replicas = replicas
+        self._accept = accept
+        self.calls = []
+
+    def replicas(self):
+        return self._replicas
+
+    def predictive_scale_up(self, signals):
+        self.calls.append(signals)
+        if self._accept:
+            self._replicas += 1
+            return True
+        return False
+
+
+def _predictive(store, registry, clock, controller, *, actuate,
+                feeds=4, slope_ms_per_s=10.0):
+    fc = _forecaster(store, registry, clock)
+    for _ in range(feeds):
+        # stored in seconds; the target's scale publishes ms
+        wait_s = slope_ms_per_s / 1000.0 * (clock.t - 1000.0)
+        store.record(forecast_mod.QUEUE_WAIT_SERIES, None, wait_s,
+                     now=clock.t)
+        fc.tick()
+        clock.advance(1.0)
+    return PredictiveAutoscaler(
+        controller, fc, horizon_s=60.0, registry=registry,
+        actuate_fn=lambda: actuate)
+
+
+def test_predictive_cold_until_min_updates(store, registry, clock):
+    ctl = FakeController()
+    pred = _predictive(store, registry, clock, ctl, actuate=False,
+                       feeds=1)
+    assert pred.tick() == "cold"
+    assert ctl.calls == []
+
+
+def test_predictive_below_threshold_holds(store, registry, clock):
+    ctl = FakeController()
+    # flat near-zero queue wait: projection stays under 80 ms
+    pred = _predictive(store, registry, clock, ctl, actuate=True,
+                       slope_ms_per_s=0.001)
+    assert pred.tick() == "below"
+    assert ctl.calls == []
+
+
+def test_predictive_shadow_counts_without_touching_replicas(
+        store, registry, clock):
+    ctl = FakeController()
+    # 10 ms/s ramp projected 60 s out clears the 80 ms bar
+    pred = _predictive(store, registry, clock, ctl, actuate=False)
+    assert pred.tick() == "shadow"
+    assert ctl.calls == []  # shadow mode NEVER calls the controller
+    assert ctl.replicas() == 1
+    assert _sample_value(
+        registry, "sparkml_serve_autoscale_total",
+        decision="predictive_shadow") == 1.0
+    assert pred.snapshot()["last_outcome"] == "shadow"
+
+
+def test_predictive_actuates_under_flag(store, registry, clock):
+    ctl = FakeController()
+    pred = _predictive(store, registry, clock, ctl, actuate=True)
+    assert pred.tick() == "actuated"
+    assert len(ctl.calls) == 1
+    assert ctl.calls[0]["signal"] == "queue_wait_ms"
+    assert ctl.replicas() == 2
+    assert _sample_value(
+        registry, "sparkml_forecast_predictive_total",
+        outcome="actuated") == 1.0
+
+
+def test_predictive_at_max_never_calls_controller(store, registry,
+                                                  clock):
+    ctl = FakeController(replicas=4)
+    pred = _predictive(store, registry, clock, ctl, actuate=True)
+    assert pred.tick() == "at_max"
+    assert ctl.calls == []
+
+
+def test_predictive_held_when_controller_declines(store, registry,
+                                                  clock):
+    ctl = FakeController(accept=False)  # cooldown says no
+    pred = _predictive(store, registry, clock, ctl, actuate=True)
+    assert pred.tick() == "held"
+    assert ctl.replicas() == 1

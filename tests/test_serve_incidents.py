@@ -183,7 +183,7 @@ def test_incidents_endpoint_catalog_and_dashboard(served_incident_pca):
         "serve_p99_spike", "serve_queue_depth", "serve_error_rate",
         "device_mem_in_use", "breaker_flap", "slo_fast_burn",
         "serve_replica_degraded", "serve_canary_regressed",
-        "fit_backend_degraded",
+        "fit_backend_degraded", "fleet_host_down",
     }
     assert doc["open_after"] >= 1 and doc["resolve_after"] >= 1
     html = urllib.request.urlopen(f"{base}/dashboard",
